@@ -1,0 +1,55 @@
+#!/usr/bin/env python3
+"""Headline benchmark: on-device echo goodput.
+
+Mirrors the reference's headline (BASELINE.md): 2.3 GB/s max echo throughput
+on its 2012-era test box (docs/cn/benchmark.md:104).  Here the echo data
+plane is HBM-resident: one jitted step receives the 64MB payload, produces
+the response copy, and checksums it — the single-chip form of the ICI echo
+path.  Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from brpc_tpu.models.echo import single_chip_echo_step
+
+BASELINE_GBPS = 2.3
+PAYLOAD_BYTES = 64 * 1024 * 1024
+ITERS = 30
+
+
+def main() -> None:
+    payload = jnp.arange(PAYLOAD_BYTES // 4, dtype=jnp.uint32)
+    step = jax.jit(single_chip_echo_step, donate_argnums=0)
+    # Warm up + compile.
+    resp, csum = step(payload)
+    jax.block_until_ready((resp, csum))
+
+    # Chain each echo on the previous response so iterations cannot overlap
+    # or be deduplicated — every step really moves the payload through HBM.
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        resp, csum = step(resp)
+    jax.block_until_ready((resp, csum))
+    dt = time.perf_counter() - t0
+
+    gbps = PAYLOAD_BYTES * ITERS / dt / 1e9
+    print(
+        json.dumps(
+            {
+                "metric": "echo_goodput_64MB",
+                "value": round(gbps, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

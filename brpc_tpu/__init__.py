@@ -1,0 +1,12 @@
+"""brpc_tpu — a TPU-native RPC/data-movement framework.
+
+Re-designs the capability set of apache/brpc (reference: /root/reference) for
+TPU: the bulk data plane is compiled XLA collectives over the ICI mesh
+(`brpc_tpu.transport`, `brpc_tpu.channels`), while the host runtime (fibers,
+sockets, protocols, metrics) is native C++ under cpp/ bound via
+`brpc_tpu.rpc`.  See ARCHITECTURE.md.
+"""
+
+from brpc_tpu.parallel.fabric import Fabric  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,11 @@
+from brpc_tpu.channels.combo import (  # noqa: F401
+    ParallelChannel,
+    PartitionChannel,
+    SelectiveChannel,
+)
+from brpc_tpu.channels.balancer import (  # noqa: F401
+    ConsistentHash,
+    RandomBalancer,
+    RoundRobin,
+    WeightedRandom,
+)

@@ -1,0 +1,192 @@
+"""Combo channels — declarative scatter/gather lowered to XLA collectives.
+
+Reference parity (SURVEY.md §2.4):
+
+- ``ParallelChannel`` (/root/reference/src/brpc/parallel_channel.h:202) fans
+  one request out to N sub-channels with a ``CallMapper`` (:102) and merges
+  responses with a ``ResponseMerger`` (:141).  TPU-native: the fan-out is
+  SPMD replication, each peer runs its handler shard, and the merger is a
+  collective (all_gather / psum / pmax) — one compiled program instead of N
+  sockets and a malloc'd sub-done block (parallel_channel.cpp:88-153).
+- ``PartitionChannel`` (partition_channel.h:75) shards the request by a
+  ``PartitionParser``; here partitioning IS the input PartitionSpec.
+- ``SelectiveChannel`` (selective_channel.h:52) load-balances over
+  heterogeneous sub-channels; here selection is a traced peer index and the
+  reply is masked-psum'd back (no data-dependent branching outside lax).
+
+Handlers are SPMD functions ``handler(peer_index, request_shard) ->
+response_shard`` — the analogue of a service method body.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from jax.tree_util import tree_map
+
+from brpc_tpu.parallel.fabric import Fabric
+
+__all__ = ["ParallelChannel", "PartitionChannel", "SelectiveChannel"]
+
+Handler = Callable  # handler(peer_index, request) -> response
+
+
+class _MergerLib:
+    """Named response mergers (ResponseMerger parity)."""
+
+    @staticmethod
+    def get(name_or_fn, axis):
+        if callable(name_or_fn):
+            return lambda r: name_or_fn(r, axis)
+        table = {
+            "gather": lambda r: tree_map(
+                lambda t: lax.all_gather(t, axis, tiled=False), r
+            ),
+            "concat": lambda r: tree_map(
+                lambda t: lax.all_gather(t, axis, tiled=True), r
+            ),
+            "sum": lambda r: lax.psum(r, axis),
+            "max": lambda r: lax.pmax(r, axis),
+            "min": lambda r: lax.pmin(r, axis),
+            "none": lambda r: r,  # keep responses sharded
+        }
+        return table[name_or_fn]
+
+
+class _BoundCache:
+    """bind() results memoized per handler so repeated call()s reuse the
+    compiled program (jit caches by function identity; a fresh closure per
+    call would recompile every time)."""
+
+    def __init__(self):
+        self._cache: dict = {}
+
+    def get_or_build(self, handler, builder):
+        fn = self._cache.get(handler)
+        if fn is None:
+            fn = self._cache[handler] = builder()
+        return fn
+
+
+class ParallelChannel:
+    """Fan a replicated request out to every peer on `axis`; merge replies.
+
+    `out_spec` describes the merged response's global layout; it defaults to
+    replicated for the named mergers and MUST be given for a callable merger
+    that keeps its result sharded (e.g. a psum_scatter merger).
+    """
+
+    def __init__(
+        self,
+        fabric: Fabric,
+        axis: str = "link",
+        call_mapper: Callable | None = None,
+        response_merger="gather",
+        out_spec=None,
+    ):
+        self.fabric = fabric
+        self.axis = axis
+        self.call_mapper = call_mapper
+        self.response_merger = response_merger
+        if out_spec is None:
+            out_spec = P(axis) if response_merger == "none" else P()
+        self.out_spec = out_spec
+        self._bound = _BoundCache()
+
+    def bind(self, handler: Handler):
+        """Compile `handler` behind this channel; returns request -> merged."""
+        axis = self.axis
+        merge = _MergerLib.get(self.response_merger, axis)
+        mapper = self.call_mapper
+
+        def build():
+            def spmd(request):
+                i = lax.axis_index(axis)
+                sub = mapper(i, request) if mapper is not None else request
+                return merge(handler(i, sub))
+
+            fn = self.fabric.spmd(spmd, in_specs=P(), out_specs=self.out_spec)
+            return jax.jit(fn)
+
+        return self._bound.get_or_build(handler, build)
+
+    def call(self, handler: Handler, request):
+        return self.bind(handler)(request)
+
+
+class PartitionChannel:
+    """Shard the request along its leading dim across peers on `axis`."""
+
+    def __init__(
+        self,
+        fabric: Fabric,
+        axis: str = "link",
+        response_merger="concat",
+        out_spec=None,
+    ):
+        self.fabric = fabric
+        self.axis = axis
+        self.response_merger = response_merger
+        if out_spec is None:
+            out_spec = P(axis) if response_merger == "none" else P()
+        self.out_spec = out_spec
+        self._bound = _BoundCache()
+
+    def bind(self, handler: Handler):
+        axis = self.axis
+        merge = _MergerLib.get(self.response_merger, axis)
+
+        def build():
+            def spmd(request):
+                i = lax.axis_index(axis)
+                return merge(handler(i, request))
+
+            fn = self.fabric.spmd(spmd, in_specs=P(axis), out_specs=self.out_spec)
+            return jax.jit(fn)
+
+        return self._bound.get_or_build(handler, build)
+
+    def call(self, handler: Handler, request):
+        return self.bind(handler)(request)
+
+
+class SelectiveChannel:
+    """Route each request to ONE peer chosen at call time.
+
+    The chosen index is a traced scalar, so one compiled program serves any
+    routing decision — the host-side balancer (`brpc_tpu.channels.balancer`)
+    plays the role of the LB inside selective_channel.cpp.  Handlers may
+    return pytrees; every leaf is masked and psum'd back.
+    """
+
+    def __init__(self, fabric: Fabric, axis: str = "link"):
+        self.fabric = fabric
+        self.axis = axis
+        self._bound = _BoundCache()
+
+    def bind(self, handler: Handler):
+        axis = self.axis
+
+        def build():
+            def spmd(request, chosen):
+                i = lax.axis_index(axis)
+                resp = handler(i, request)
+                picked = tree_map(
+                    lambda t: t * (i == chosen[0]).astype(t.dtype), resp
+                )
+                return lax.psum(picked, axis)
+
+            fn = self.fabric.spmd(spmd, in_specs=(P(), P()), out_specs=P())
+            jitted = jax.jit(fn)
+            return lambda request, chosen: jitted(
+                request, jnp.asarray([chosen], dtype=jnp.int32)
+            )
+
+        return self._bound.get_or_build(handler, build)
+
+    def call(self, handler: Handler, request, chosen: int):
+        return self.bind(handler)(request, chosen)

@@ -1,0 +1,5 @@
+from brpc_tpu.models.echo import (  # noqa: F401
+    make_full_dataplane_step,
+    make_nton_exchange,
+    single_chip_echo_step,
+)

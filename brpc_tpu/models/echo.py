@@ -1,0 +1,96 @@
+"""Benchmark workloads — the framework's "flagship models".
+
+Parity targets (BASELINE.md configs):
+- ``single_chip_echo_step``  → example/echo_c++ (single sync echo, one chip)
+- ``make_nton_exchange``     → example/rdma_performance N-to-N 64MB exchange
+  (/root/reference/example/rdma_performance/client.cpp:35-54)
+- ``make_full_dataplane_step`` → the combined fan-out + partition + ring step
+  the driver dry-runs over a multi-device mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from brpc_tpu.ops.checksum import sum32
+from brpc_tpu.parallel.fabric import Fabric
+from brpc_tpu.transport.ici import IciTransport, _ring_perm
+
+
+def single_chip_echo_step(payload: jnp.ndarray):
+    """One echo round trip on one chip: the server 'receives' the request
+    buffer, verifies it, and materializes the response copy in HBM.
+
+    Returns (response, checksum).  The copy is forced (payload + 0 would fold
+    away; we roll by one lane so XLA must move the bytes) — the HBM write is
+    the on-device analogue of the NIC's echo write-back.
+    """
+    resp = jnp.roll(payload, 1)
+    return resp, sum32(resp)
+
+
+def make_nton_exchange(fabric: Fabric, axis: str = "link"):
+    """Every peer sends a distinct row to every other peer and checksums what
+    it received — one compiled all-to-all riding the ICI mesh.
+
+    Input layout per shard: (n, chunk) uint32, row j destined for peer j.
+    Returns (received, checksum_per_peer).
+    """
+    t = IciTransport(fabric, axis)
+
+    def spmd(local):
+        recv = t.all_to_all(local)
+        return recv, sum32(recv)[None]
+
+    fn = fabric.spmd(spmd, in_specs=P(axis), out_specs=(P(axis), P(axis)))
+    return jax.jit(fn)
+
+
+def make_ring_exchange(fabric: Fabric, axis: str = "link"):
+    """Explicit ppermute-ring N-to-N (the schedule variant): N-1 hops, each
+    hop's arrival checksummed while the next hop is in flight."""
+    t = IciTransport(fabric, axis)
+
+    def spmd(local):
+        buf, carry, _ = t.ring_exchange(local)
+        return buf, carry[None]
+
+    fn = fabric.spmd(spmd, in_specs=P(axis), out_specs=(P(axis), P(axis)))
+    return jax.jit(fn)
+
+
+def make_full_dataplane_step(fabric: Fabric, fan_axis: str = "dp", part_axis: str = "link"):
+    """The composite step exercising every channel kind at once:
+
+    - the request tensor is partitioned over `part_axis` (PartitionChannel),
+    - replicated over `fan_axis` where each replica applies its own handler
+      transform (ParallelChannel fan-out),
+    - replicas' responses merge with psum over `fan_axis` (ResponseMerger),
+    - partitions then run one ppermute ring hop over `part_axis` to their
+      neighbor and back (streaming echo), and
+    - a final fletcher-style checksum verifies the whole exchange.
+
+    Returns a jitted fn: (payload[(rows, cols) f32]) -> (response, checksum).
+    """
+    n_part = fabric.axis_size(part_axis)
+    perm = _ring_perm(n_part, 1)
+    perm_back = _ring_perm(n_part, -1)
+
+    def spmd(payload):
+        rep = lax.axis_index(fan_axis).astype(payload.dtype)
+        handled = payload * (rep + 1.0)  # per-replica handler
+        merged = lax.psum(handled, fan_axis)  # ResponseMerger: sum
+        sent = lax.ppermute(merged, part_axis, perm)  # stream out
+        back = lax.ppermute(sent, part_axis, perm_back)  # echo back
+        csum = lax.psum(jnp.sum(back), part_axis)
+        return back, csum[None]
+
+    fn = fabric.spmd(
+        spmd,
+        in_specs=P(part_axis, None),
+        out_specs=(P(part_axis, None), P()),
+    )
+    return jax.jit(fn)

@@ -1,0 +1,1 @@
+from brpc_tpu.ops.checksum import fletcher32, sum32  # noqa: F401

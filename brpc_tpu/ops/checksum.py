@@ -1,0 +1,30 @@
+"""Payload checksums — the verification op of the echo benchmarks.
+
+The reference checksums RPC payloads with hardware crc32c
+(/root/reference/src/butil/crc32c.cc, policy/crc32c_checksum.cpp).  CRC's
+bit-serial carry chain is hostile to the VPU, so the TPU-native integrity
+check is a Fletcher-style two-lane sum — fully data-parallel, one pass over
+HBM, fused by XLA into whatever op produced the payload.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["sum32", "fletcher32"]
+
+
+def sum32(x) -> jnp.ndarray:
+    """Plain 32-bit wrapping sum of the payload (fast integrity check)."""
+    return jnp.sum(x.astype(jnp.uint32).ravel(), dtype=jnp.uint32)
+
+
+def fletcher32(x) -> jnp.ndarray:
+    """Fletcher-like checksum: (sum, position-weighted sum) packed in uint32x2.
+
+    Position weighting catches reorderings a plain sum misses — the property
+    that matters for verifying ring-exchange hop schedules.
+    """
+    v = x.astype(jnp.uint32).ravel()
+    idx = jnp.arange(v.shape[0], dtype=jnp.uint32) + jnp.uint32(1)
+    return jnp.stack([jnp.sum(v, dtype=jnp.uint32), jnp.sum(v * idx, dtype=jnp.uint32)])

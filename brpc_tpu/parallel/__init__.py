@@ -1,0 +1,1 @@
+from brpc_tpu.parallel.fabric import Fabric, shard_map  # noqa: F401

@@ -1,0 +1,1 @@
+from brpc_tpu.streaming.stream import ring_stream, stream_echo  # noqa: F401

@@ -1,0 +1,1 @@
+from brpc_tpu.transport.ici import IciTransport  # noqa: F401

@@ -1,0 +1,18 @@
+"""Test fixture: virtual 8-device CPU mesh (SURVEY.md §4 — the reference
+tests distributed behavior with in-process loopback; ours is a forced
+multi-device CPU backend)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The image's axon plugin force-sets jax_platforms at import; override it
+# back to cpu before any device is touched.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
