@@ -60,7 +60,15 @@ class _MergerLib:
 class _BoundCache:
     """bind() results memoized per handler so repeated call()s reuse the
     compiled program (jit caches by function identity; a fresh closure per
-    call would recompile every time)."""
+    call would recompile every time).
+
+    Callers on a hot path must pass a STABLE callable — a lambda constructed
+    inside the request loop misses this cache every time and re-traces.  The
+    cache is bounded: oldest half is dropped past `kMax` entries so stale
+    handlers (and the arrays they close over) can't accumulate forever.
+    """
+
+    kMax = 64
 
     def __init__(self):
         self._cache: dict = {}
@@ -68,6 +76,9 @@ class _BoundCache:
     def get_or_build(self, handler, builder):
         fn = self._cache.get(handler)
         if fn is None:
+            if len(self._cache) >= self.kMax:
+                for key in list(self._cache)[: self.kMax // 2]:
+                    del self._cache[key]
             fn = self._cache[handler] = builder()
         return fn
 
@@ -175,8 +186,11 @@ class SelectiveChannel:
             def spmd(request, chosen):
                 i = lax.axis_index(axis)
                 resp = handler(i, request)
+                # where-select, not mask-multiply: a non-chosen peer emitting
+                # inf/nan must not poison the psum (0 * inf = nan).
                 picked = tree_map(
-                    lambda t: t * (i == chosen[0]).astype(t.dtype), resp
+                    lambda t: jnp.where(i == chosen[0], t, jnp.zeros_like(t)),
+                    resp,
                 )
                 return lax.psum(picked, axis)
 

@@ -1,0 +1,1 @@
+from brpc_tpu.rpc._lib import IOBuf, load_library, parse_endpoint  # noqa: F401

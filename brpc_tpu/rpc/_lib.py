@@ -1,0 +1,124 @@
+"""ctypes bindings to the native runtime (cpp/ → build/libtpurpc.so).
+
+The C++ half is the host runtime (fibers, sockets, protocols — ARCHITECTURE.md);
+these bindings are how the Python data plane hands payloads to it.  Builds the
+library on demand with cmake if it isn't present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import subprocess
+import threading
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+_BUILD = _REPO / "build"
+_LIB_PATH = _BUILD / "libtpurpc.so"
+_lock = threading.Lock()
+_lib = None
+
+
+def _ensure_built() -> None:
+    if _LIB_PATH.exists():
+        return
+    subprocess.run(
+        ["cmake", "-S", str(_REPO / "cpp"), "-B", str(_BUILD)],
+        check=True,
+        capture_output=True,
+    )
+    subprocess.run(
+        ["cmake", "--build", str(_BUILD), "-j", "2", "--target", "tpurpc"],
+        check=True,
+        capture_output=True,
+    )
+
+
+def load_library() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is None:
+            _ensure_built()
+            lib = ctypes.CDLL(str(_LIB_PATH))
+            lib.trpc_iobuf_create.restype = ctypes.c_void_p
+            lib.trpc_iobuf_destroy.argtypes = [ctypes.c_void_p]
+            lib.trpc_iobuf_append.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+            ]
+            lib.trpc_iobuf_size.argtypes = [ctypes.c_void_p]
+            lib.trpc_iobuf_size.restype = ctypes.c_size_t
+            lib.trpc_iobuf_copy_to.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_size_t,
+            ]
+            lib.trpc_iobuf_copy_to.restype = ctypes.c_size_t
+            lib.trpc_iobuf_cutn.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+            ]
+            lib.trpc_iobuf_cutn.restype = ctypes.c_size_t
+            lib.trpc_iobuf_pop_front.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+            lib.trpc_iobuf_pop_front.restype = ctypes.c_size_t
+            lib.trpc_iobuf_block_count.argtypes = [ctypes.c_void_p]
+            lib.trpc_iobuf_block_count.restype = ctypes.c_size_t
+            lib.trpc_endpoint_parse.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+            ]
+            lib.trpc_endpoint_parse.restype = ctypes.c_int
+            _lib = lib
+    return _lib
+
+
+class IOBuf:
+    """Python view of trpc::IOBuf (zero-copy chained buffer)."""
+
+    def __init__(self, data: bytes | None = None):
+        self._lib = load_library()
+        self._ptr = self._lib.trpc_iobuf_create()
+        if data:
+            self.append(data)
+
+    def __del__(self):
+        ptr, self._ptr = getattr(self, "_ptr", None), None
+        if ptr:
+            self._lib.trpc_iobuf_destroy(ptr)
+
+    def __len__(self) -> int:
+        return self._lib.trpc_iobuf_size(self._ptr)
+
+    def append(self, data: bytes) -> None:
+        self._lib.trpc_iobuf_append(self._ptr, data, len(data))
+
+    def to_bytes(self) -> bytes:
+        n = len(self)
+        out = ctypes.create_string_buffer(n)
+        got = self._lib.trpc_iobuf_copy_to(self._ptr, out, n, 0)
+        return out.raw[:got]
+
+    def cutn(self, n: int) -> "IOBuf":
+        out = IOBuf()
+        self._lib.trpc_iobuf_cutn(self._ptr, out._ptr, n)
+        return out
+
+    def pop_front(self, n: int) -> int:
+        return self._lib.trpc_iobuf_pop_front(self._ptr, n)
+
+    @property
+    def block_count(self) -> int:
+        return self._lib.trpc_iobuf_block_count(self._ptr)
+
+
+def parse_endpoint(addr: str) -> str:
+    """Normalize 'host:port[/device]' via the native EndPoint parser."""
+    lib = load_library()
+    out = ctypes.create_string_buffer(64)
+    if lib.trpc_endpoint_parse(addr.encode(), out, 64) != 0:
+        raise ValueError(f"bad endpoint: {addr!r}")
+    return out.value.decode()

@@ -1,0 +1,23 @@
+
+# Consider dependencies only in project.
+set(CMAKE_DEPENDS_IN_PROJECT_ONLY OFF)
+
+# The set of languages for which implicit dependencies are needed:
+set(CMAKE_DEPENDS_LANGUAGES
+  )
+
+# The set of dependency files which are needed:
+set(CMAKE_DEPENDS_DEPENDENCY_FILES
+  "/root/repo/cpp/base/arena.cc" "CMakeFiles/tpurpc.dir/base/arena.cc.o" "gcc" "CMakeFiles/tpurpc.dir/base/arena.cc.o.d"
+  "/root/repo/cpp/base/endpoint.cc" "CMakeFiles/tpurpc.dir/base/endpoint.cc.o" "gcc" "CMakeFiles/tpurpc.dir/base/endpoint.cc.o.d"
+  "/root/repo/cpp/base/iobuf.cc" "CMakeFiles/tpurpc.dir/base/iobuf.cc.o" "gcc" "CMakeFiles/tpurpc.dir/base/iobuf.cc.o.d"
+  "/root/repo/cpp/base/logging.cc" "CMakeFiles/tpurpc.dir/base/logging.cc.o" "gcc" "CMakeFiles/tpurpc.dir/base/logging.cc.o.d"
+  "/root/repo/cpp/capi/base_capi.cc" "CMakeFiles/tpurpc.dir/capi/base_capi.cc.o" "gcc" "CMakeFiles/tpurpc.dir/capi/base_capi.cc.o.d"
+  )
+
+# Targets to which this target links.
+set(CMAKE_TARGET_LINKED_INFO_FILES
+  )
+
+# Fortran module output directory.
+set(CMAKE_Fortran_TARGET_MODULE_DIR "")

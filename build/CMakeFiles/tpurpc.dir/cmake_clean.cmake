@@ -1,0 +1,19 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/tpurpc.dir/base/arena.cc.o"
+  "CMakeFiles/tpurpc.dir/base/arena.cc.o.d"
+  "CMakeFiles/tpurpc.dir/base/endpoint.cc.o"
+  "CMakeFiles/tpurpc.dir/base/endpoint.cc.o.d"
+  "CMakeFiles/tpurpc.dir/base/iobuf.cc.o"
+  "CMakeFiles/tpurpc.dir/base/iobuf.cc.o.d"
+  "CMakeFiles/tpurpc.dir/base/logging.cc.o"
+  "CMakeFiles/tpurpc.dir/base/logging.cc.o.d"
+  "CMakeFiles/tpurpc.dir/capi/base_capi.cc.o"
+  "CMakeFiles/tpurpc.dir/capi/base_capi.cc.o.d"
+  "libtpurpc.pdb"
+  "libtpurpc.so"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/tpurpc.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

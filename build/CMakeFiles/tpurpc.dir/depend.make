@@ -1,0 +1,2 @@
+# Empty dependencies file for tpurpc.
+# This may be replaced when dependencies are built.

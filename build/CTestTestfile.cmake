@@ -1,0 +1,8 @@
+# CMake generated Testfile for 
+# Source directory: /root/repo/cpp
+# Build directory: /root/repo/build
+# 
+# This file includes the relevant testing commands required for 
+# testing this directory and lists subdirectories to be tested as well.
+add_test(test_base "/root/repo/build/test_base")
+set_tests_properties(test_base PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/cpp/CMakeLists.txt;33;add_test;/root/repo/cpp/CMakeLists.txt;0;")

@@ -1,0 +1,93 @@
+#include "base/arena.h"
+
+#include <cstdlib>
+#include <new>
+#include <vector>
+
+namespace trpc {
+
+namespace {
+
+struct TlsBlockCache {
+  std::vector<Block*> blocks;
+  ~TlsBlockCache() {
+    for (Block* b : blocks) {
+      free(b);
+    }
+    blocks.clear();
+  }
+};
+
+thread_local TlsBlockCache g_tls_cache;
+constexpr size_t kMaxCachedBlocks = 64;
+
+}  // namespace
+
+void Block::release() {
+  if (ref.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+    if (user_deleter != nullptr) {
+      user_deleter(data, user_ctx);
+      free(this);
+    } else {
+      arena->deallocate(this);
+    }
+  }
+}
+
+HostArena* HostArena::instance() {
+  static HostArena arena;
+  return &arena;
+}
+
+Block* HostArena::allocate(uint32_t min_cap) {
+  if (min_cap <= kDefaultBlockSize && !g_tls_cache.blocks.empty()) {
+    Block* b = g_tls_cache.blocks.back();
+    g_tls_cache.blocks.pop_back();
+    b->ref.store(1, std::memory_order_relaxed);
+    b->size = 0;
+    return b;
+  }
+  const uint32_t cap = min_cap <= kDefaultBlockSize
+                           ? kDefaultBlockSize
+                           : min_cap;
+  void* mem = malloc(sizeof(Block) + cap);
+  if (mem == nullptr) {
+    throw std::bad_alloc();
+  }
+  Block* b = new (mem) Block();
+  b->cap = cap;
+  b->arena = this;
+  b->data = reinterpret_cast<char*>(mem) + sizeof(Block);
+  return b;
+}
+
+void HostArena::deallocate(Block* b) {
+  if (b->cap == kDefaultBlockSize &&
+      g_tls_cache.blocks.size() < kMaxCachedBlocks) {
+    g_tls_cache.blocks.push_back(b);
+    return;
+  }
+  free(b);
+}
+
+void HostArena::flush_tls_cache() {
+  for (Block* b : g_tls_cache.blocks) {
+    free(b);
+  }
+  g_tls_cache.blocks.clear();
+}
+
+Block* make_user_block(void* data, uint32_t len,
+                       void (*deleter)(void*, void*), void* ctx,
+                       uint64_t meta) {
+  Block* b = new (malloc(sizeof(Block))) Block();
+  b->cap = len;
+  b->size = len;
+  b->data = static_cast<char*>(data);
+  b->user_deleter = deleter;
+  b->user_ctx = ctx;
+  b->user_meta = meta;
+  return b;
+}
+
+}  // namespace trpc

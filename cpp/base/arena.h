@@ -1,0 +1,66 @@
+// Block arenas — the pluggable allocator seam under IOBuf.
+//
+// Parity: butil::IOBuf's 8KB ref-counted blocks
+// (/root/reference/src/butil/iobuf.cpp:47, iobuf.h:82) plus the fork's RDMA
+// block_pool which swaps IOBuf allocation to DMA-registered memory
+// (/root/reference/src/brpc/rdma/block_pool.cpp).  Designed day-1 for two
+// arenas: the host heap arena below, and an HBM/DMA-registered arena with the
+// same interface so device-visible buffers flow through the same IOBuf type
+// (`user_meta` carries the device handle where RDMA carried lkeys).
+#pragma once
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+
+namespace trpc {
+
+class BlockArena;
+
+// A ref-counted contiguous region.  `size` is the append cursor: bytes
+// [0, size) are immutable once another reference can observe them; an IOBuf
+// may extend [size, cap) only while it holds the sole reference.
+struct Block {
+  std::atomic<int32_t> ref{1};
+  uint32_t cap = 0;
+  uint32_t size = 0;
+  BlockArena* arena = nullptr;
+  char* data = nullptr;
+  // Set for user-owned memory blocks (zero-copy append_user_data):
+  void (*user_deleter)(void* data, void* ctx) = nullptr;
+  void* user_ctx = nullptr;
+  uint64_t user_meta = 0;  // device handle / lkey analogue
+
+  void add_ref() { ref.fetch_add(1, std::memory_order_relaxed); }
+  void release();  // frees via arena or user_deleter when count hits 0
+};
+
+class BlockArena {
+ public:
+  virtual ~BlockArena() = default;
+  // Returns a block with ref == 1, size == 0, cap >= min_cap.
+  virtual Block* allocate(uint32_t min_cap) = 0;
+  virtual void deallocate(Block* b) = 0;
+};
+
+// Default heap arena: header+payload in one allocation, thread-local free
+// cache of default-size blocks (parity: iobuf TLS block caching used at
+// input_messenger.cpp:239).
+class HostArena : public BlockArena {
+ public:
+  static constexpr uint32_t kDefaultBlockSize = 8192;
+  static HostArena* instance();
+
+  Block* allocate(uint32_t min_cap) override;
+  void deallocate(Block* b) override;
+
+  // Drop this thread's cached blocks (called on thread exit / tests).
+  static void flush_tls_cache();
+};
+
+// Wraps caller-owned memory in a Block without copying.
+Block* make_user_block(void* data, uint32_t len,
+                       void (*deleter)(void*, void*), void* ctx,
+                       uint64_t meta);
+
+}  // namespace trpc

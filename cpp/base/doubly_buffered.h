@@ -1,0 +1,108 @@
+// DoublyBufferedData — RCU-like read-mostly data.
+//
+// Parity: butil::DoublyBufferedData
+// (/root/reference/src/butil/containers/doubly_buffered_data.h:574): readers
+// take a per-thread mutex (never contended by other readers) and read the
+// foreground copy; writers modify the background copy, flip the index, then
+// briefly take every reader mutex to prove no reader still sees the old
+// foreground, and modify it too.  This is what makes load-balancer
+// SelectServer nearly contention-free (load_balancer.h:72).
+#pragma once
+
+#include <memory>
+#include <mutex>
+#include <vector>
+
+namespace trpc {
+
+template <typename T>
+class DoublyBufferedData {
+ public:
+  class ScopedPtr {
+   public:
+    ScopedPtr() = default;
+    ScopedPtr(const T* data, std::mutex* mu) : data_(data), mu_(mu) {}
+    ScopedPtr(ScopedPtr&& o) noexcept : data_(o.data_), mu_(o.mu_) {
+      o.mu_ = nullptr;
+    }
+    ~ScopedPtr() {
+      if (mu_ != nullptr) {
+        mu_->unlock();
+      }
+    }
+    const T* get() const { return data_; }
+    const T& operator*() const { return *data_; }
+    const T* operator->() const { return data_; }
+
+   private:
+    const T* data_ = nullptr;
+    std::mutex* mu_ = nullptr;
+  };
+
+  DoublyBufferedData() : index_(0) {
+    static std::atomic<uint64_t> next_id{1};
+    id_ = next_id.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  // Read the foreground copy under this thread's own mutex.
+  ScopedPtr Read() {
+    ThreadMutex* tm = tls_mutex();
+    tm->mu.lock();
+    const T* fg = &data_[index_.load(std::memory_order_acquire)];
+    return ScopedPtr(fg, &tm->mu);
+  }
+
+  // fn(T&) -> bool; applied to background, then (after flip + reader drain)
+  // to the old foreground.  Returns false if the first application fails.
+  template <typename Fn>
+  bool Modify(Fn&& fn) {
+    std::lock_guard<std::mutex> g(modify_mu_);
+    const int bg = 1 - index_.load(std::memory_order_relaxed);
+    if (!fn(data_[bg])) {
+      return false;
+    }
+    index_.store(bg, std::memory_order_release);
+    // Drain: once we've held each reader's mutex, no reader can still be
+    // inside the old foreground.
+    std::lock_guard<std::mutex> rg(registry_mu_);
+    for (auto& tm : mutexes_) {
+      std::lock_guard<std::mutex> r(tm->mu);
+    }
+    fn(data_[1 - bg]);
+    return true;
+  }
+
+ private:
+  struct ThreadMutex {
+    std::mutex mu;
+  };
+
+  // TLS is keyed by a process-unique instance id (never by `this`, which
+  // the allocator can reuse), and holds a shared_ptr so a mutex outlives a
+  // destroyed instance until the thread exits — no use-after-free either way.
+  ThreadMutex* tls_mutex() {
+    static thread_local std::vector<
+        std::pair<uint64_t, std::shared_ptr<ThreadMutex>>> tls;
+    for (auto& p : tls) {
+      if (p.first == id_) {
+        return p.second.get();
+      }
+    }
+    auto tm = std::make_shared<ThreadMutex>();
+    {
+      std::lock_guard<std::mutex> g(registry_mu_);
+      mutexes_.push_back(tm);
+    }
+    tls.emplace_back(id_, tm);
+    return tm.get();
+  }
+
+  T data_[2];
+  std::atomic<int> index_;
+  uint64_t id_ = 0;
+  std::mutex modify_mu_;
+  std::mutex registry_mu_;
+  std::vector<std::shared_ptr<ThreadMutex>> mutexes_;
+};
+
+}  // namespace trpc

@@ -1,0 +1,96 @@
+#include "base/endpoint.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+
+#include <cstdio>
+#include <cstring>
+
+namespace trpc {
+
+int str2endpoint(const char* s, EndPoint* out) {
+  char host[128];
+  int port = 0;
+  int dev = -1;
+  const char* colon = strrchr(s, ':');
+  if (colon == nullptr || colon == s ||
+      static_cast<size_t>(colon - s) >= sizeof(host)) {
+    return -1;
+  }
+  memcpy(host, s, colon - s);
+  host[colon - s] = '\0';
+  if (sscanf(colon + 1, "%d/%d", &port, &dev) < 1) {
+    return -1;
+  }
+  if (port < 0 || port > 65535) {
+    return -1;
+  }
+  in_addr addr;
+  if (inet_aton(host, &addr) == 0) {
+    return -1;
+  }
+  out->ip = addr.s_addr;
+  out->port = port;
+  out->device_ordinal = dev;
+  return 0;
+}
+
+int hostname2endpoint(const char* s, EndPoint* out) {
+  if (str2endpoint(s, out) == 0) {
+    return 0;
+  }
+  const char* colon = strrchr(s, ':');
+  if (colon == nullptr) {
+    return -1;
+  }
+  char* end = nullptr;
+  const long port = strtol(colon + 1, &end, 10);
+  if (end == colon + 1 || *end != '\0' || port < 0 || port > 65535) {
+    return -1;
+  }
+  std::string host(s, colon - s);
+  addrinfo hints = {};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 ||
+      res == nullptr) {
+    return -1;
+  }
+  out->ip = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr.s_addr;
+  out->port = static_cast<int>(port);
+  out->device_ordinal = -1;
+  freeaddrinfo(res);
+  return 0;
+}
+
+std::string endpoint2str(const EndPoint& ep) {
+  in_addr addr;
+  addr.s_addr = ep.ip;
+  char ip[INET_ADDRSTRLEN] = {};
+  inet_ntop(AF_INET, &addr, ip, sizeof(ip));  // thread-safe, unlike inet_ntoa
+  char buf[64];
+  if (ep.device_ordinal >= 0) {
+    snprintf(buf, sizeof(buf), "%s:%d/%d", ip, ep.port, ep.device_ordinal);
+  } else {
+    snprintf(buf, sizeof(buf), "%s:%d", ip, ep.port);
+  }
+  return buf;
+}
+
+sockaddr_in endpoint2sockaddr(const EndPoint& ep) {
+  sockaddr_in sa = {};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = ep.ip;
+  sa.sin_port = htons(static_cast<uint16_t>(ep.port));
+  return sa;
+}
+
+EndPoint sockaddr2endpoint(const sockaddr_in& sa) {
+  EndPoint ep;
+  ep.ip = sa.sin_addr.s_addr;
+  ep.port = ntohs(sa.sin_port);
+  return ep;
+}
+
+}  // namespace trpc

@@ -1,0 +1,47 @@
+// EndPoint — peer address value type.
+//
+// Parity: butil::EndPoint (/root/reference/src/butil/endpoint.h:253)
+// extended with an optional device ordinal so an ICI peer ("chip 3 behind
+// host 10.0.0.2") is first-class, the way the fork's transports key sockets
+// by (EndPoint, SocketMode).
+#pragma once
+
+#include <netinet/in.h>
+
+#include <cstdint>
+#include <string>
+
+namespace trpc {
+
+struct EndPoint {
+  uint32_t ip = 0;          // network byte order
+  int port = 0;
+  int device_ordinal = -1;  // -1 = host endpoint; >=0 = TPU chip behind host
+
+  bool operator==(const EndPoint& o) const {
+    return ip == o.ip && port == o.port && device_ordinal == o.device_ordinal;
+  }
+  bool operator!=(const EndPoint& o) const { return !(*this == o); }
+};
+
+// "1.2.3.4:80" or "1.2.3.4:80/3" (ICI device suffix); returns 0 on success.
+int str2endpoint(const char* s, EndPoint* out);
+// Resolves "host:port" via getaddrinfo when not dotted-quad.
+int hostname2endpoint(const char* s, EndPoint* out);
+std::string endpoint2str(const EndPoint& ep);
+sockaddr_in endpoint2sockaddr(const EndPoint& ep);
+EndPoint sockaddr2endpoint(const sockaddr_in& sa);
+
+struct EndPointHash {
+  size_t operator()(const EndPoint& ep) const {
+    uint64_t v = (static_cast<uint64_t>(ep.ip) << 32) ^
+                 (static_cast<uint64_t>(ep.port) << 8) ^
+                 static_cast<uint64_t>(ep.device_ordinal + 1);
+    v ^= v >> 33;
+    v *= 0xff51afd7ed558ccdull;
+    v ^= v >> 33;
+    return static_cast<size_t>(v);
+  }
+};
+
+}  // namespace trpc

@@ -1,0 +1,132 @@
+// FlatMap — open-addressing hash map for hot lookup tables.
+//
+// Parity: butil::FlatMap (/root/reference/src/butil/containers/flat_map.h),
+// used for method tables and protocol dispatch.  Re-designed: linear probing
+// with backward-shift deletion over a power-of-two slot array (the reference
+// chains within buckets).
+#pragma once
+
+#include <cassert>
+#include <cstddef>
+#include <functional>
+#include <utility>
+#include <vector>
+
+namespace trpc {
+
+template <typename K, typename V, typename Hash = std::hash<K>>
+class FlatMap {
+ public:
+  explicit FlatMap(size_t initial_cap = 16) { rehash(round_up(initial_cap)); }
+
+  size_t size() const { return size_; }
+  bool empty() const { return size_ == 0; }
+
+  V* seek(const K& key) {
+    const size_t mask = slots_.size() - 1;
+    size_t i = Hash()(key) & mask;
+    while (slots_[i].state == kFull) {
+      if (slots_[i].kv.first == key) {
+        return &slots_[i].kv.second;
+      }
+      i = (i + 1) & mask;
+    }
+    return nullptr;
+  }
+  const V* seek(const K& key) const {
+    return const_cast<FlatMap*>(this)->seek(key);
+  }
+
+  V& operator[](const K& key) {
+    if (size_ * 4 >= slots_.size() * 3) {
+      rehash(slots_.size() * 2);
+    }
+    const size_t mask = slots_.size() - 1;
+    size_t i = Hash()(key) & mask;
+    while (slots_[i].state == kFull) {
+      if (slots_[i].kv.first == key) {
+        return slots_[i].kv.second;
+      }
+      i = (i + 1) & mask;
+    }
+    slots_[i].state = kFull;
+    slots_[i].kv.first = key;
+    slots_[i].kv.second = V();
+    ++size_;
+    return slots_[i].kv.second;
+  }
+
+  bool insert(const K& key, const V& value) {
+    V& v = (*this)[key];
+    v = value;
+    return true;
+  }
+
+  // Backward-shift deletion keeps probe chains intact without tombstones.
+  bool erase(const K& key) {
+    const size_t mask = slots_.size() - 1;
+    size_t i = Hash()(key) & mask;
+    while (slots_[i].state == kFull) {
+      if (slots_[i].kv.first == key) {
+        size_t hole = i;
+        size_t j = (i + 1) & mask;
+        while (slots_[j].state == kFull) {
+          const size_t home = Hash()(slots_[j].kv.first) & mask;
+          // Can slot j legally move into the hole?
+          const bool wraps = hole <= j ? (home <= hole || home > j)
+                                       : (home <= hole && home > j);
+          if (wraps) {
+            slots_[hole].kv = std::move(slots_[j].kv);
+            hole = j;
+          }
+          j = (j + 1) & mask;
+        }
+        slots_[hole].state = kEmpty;
+        --size_;
+        return true;
+      }
+      i = (i + 1) & mask;
+    }
+    return false;
+  }
+
+  template <typename Fn>
+  void for_each(Fn&& fn) const {
+    for (const Slot& s : slots_) {
+      if (s.state == kFull) {
+        fn(s.kv.first, s.kv.second);
+      }
+    }
+  }
+
+ private:
+  enum State : uint8_t { kEmpty = 0, kFull = 1 };
+  struct Slot {
+    State state = kEmpty;
+    std::pair<K, V> kv;
+  };
+
+  static size_t round_up(size_t n) {
+    size_t p = 8;
+    while (p < n) {
+      p <<= 1;
+    }
+    return p;
+  }
+
+  void rehash(size_t new_cap) {
+    std::vector<Slot> old = std::move(slots_);
+    slots_.assign(new_cap, Slot());
+    size_ = 0;
+    for (Slot& s : old) {
+      if (s.state == kFull) {
+        (*this)[s.kv.first] = std::move(s.kv.second);
+      }
+    }
+  }
+
+  std::vector<Slot> slots_;
+  size_t size_ = 0;
+};
+
+}  // namespace trpc

@@ -1,0 +1,97 @@
+// IOBuf — zero-copy chained buffer, THE data plane type.
+//
+// Parity: butil::IOBuf (/root/reference/src/butil/iobuf.h:68): ref-counted
+// block chain, cheap copy/cut/append by reference, scatter-gather to fds,
+// user-owned memory with deleter+meta for device registration.  Re-designed:
+// refs live in a std::vector (no small/big union), a block is extendable
+// only while singly-referenced (no shared TLS tail cursor), and the arena is
+// pluggable per-append for the HBM path.
+#pragma once
+
+#include <sys/uio.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "base/arena.h"
+
+namespace trpc {
+
+class IOBuf {
+ public:
+  struct BlockRef {
+    uint32_t offset = 0;
+    uint32_t length = 0;
+    Block* block = nullptr;
+  };
+
+  IOBuf() = default;
+  explicit IOBuf(BlockArena* arena) : arena_(arena) {}
+  IOBuf(const IOBuf& other);
+  IOBuf& operator=(const IOBuf& other);
+  IOBuf(IOBuf&& other) noexcept;
+  IOBuf& operator=(IOBuf&& other) noexcept;
+  ~IOBuf() { clear(); }
+
+  size_t size() const { return size_; }
+  bool empty() const { return size_ == 0; }
+  size_t block_count() const { return refs_.size(); }
+  void clear();
+
+  // -- writing ---------------------------------------------------------
+  void append(const void* data, size_t n);
+  void append(const std::string& s) { append(s.data(), s.size()); }
+  void append(const char* s) { append(s, strlen(s)); }
+  // Share the other buffer's blocks (zero copy).
+  void append(const IOBuf& other);
+  // Move other's refs to our tail (zero copy, clears other).
+  void append(IOBuf&& other);
+  // Wrap caller-owned memory without copying; deleter runs when the last
+  // reference drops (parity: iobuf.h:257 append_user_data_with_meta; `meta`
+  // carries the device/DMA handle).
+  void append_user_data(void* data, size_t n, void (*deleter)(void*, void*),
+                        void* ctx = nullptr, uint64_t meta = 0);
+
+  // Reserve n contiguous writable bytes at the tail; returns pointer.
+  // Caller must fill them before any other operation.
+  char* reserve(size_t n);
+
+  // -- reading / cutting ----------------------------------------------
+  // Copy up to n bytes starting at pos into dst; returns bytes copied.
+  size_t copy_to(void* dst, size_t n, size_t pos = 0) const;
+  std::string to_string() const;
+  // Move the first n bytes into *out (zero copy); returns bytes moved.
+  size_t cutn(IOBuf* out, size_t n);
+  // Drop the first n bytes; returns bytes dropped.
+  size_t pop_front(size_t n);
+  // Drop the last n bytes; returns bytes dropped.
+  size_t pop_back(size_t n);
+  // First byte (buf must be non-empty).
+  char front() const { return refs_.front().block->data[refs_.front().offset]; }
+
+  // -- scatter-gather --------------------------------------------------
+  // Fill up to max_iov iovecs covering at most max_bytes; returns count.
+  int fill_iovec(iovec* iov, int max_iov,
+                 size_t max_bytes = SIZE_MAX) const;
+  // Append by taking ownership semantics from readv-style writes:
+  // append up to n bytes read from fd; returns bytes read or -1.
+  ssize_t append_from_fd(int fd, size_t max_bytes);
+  // Write to fd with writev, popping written bytes; returns written or -1.
+  ssize_t cut_into_fd(int fd, size_t max_bytes = SIZE_MAX);
+
+  // Raw ref access (transports iterate blocks for DMA posting).
+  const BlockRef& ref_at(size_t i) const { return refs_[i]; }
+
+  bool equals(const void* data, size_t n) const;
+
+ private:
+  void push_ref(Block* b, uint32_t offset, uint32_t length);  // takes 1 ref
+  Block* extendable_tail(size_t want) const;
+
+  std::vector<BlockRef> refs_;
+  size_t size_ = 0;
+  BlockArena* arena_ = nullptr;  // nullptr → HostArena::instance()
+};
+
+}  // namespace trpc

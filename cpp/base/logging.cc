@@ -1,0 +1,42 @@
+#include "base/logging.h"
+
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+
+namespace trpc {
+
+std::atomic<int>& log_min_level() {
+  static std::atomic<int> level{static_cast<int>(LogLevel::kInfo)};
+  return level;
+}
+
+LogMessage::LogMessage(LogLevel level, const char* file, int line)
+    : level_(level) {
+  static const char* names = "DIWEF";
+  const char* base = strrchr(file, '/');
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  tm t;
+  localtime_r(&ts.tv_sec, &t);
+  char prefix[96];
+  snprintf(prefix, sizeof(prefix), "%c%02d%02d %02d:%02d:%02d.%06ld %s:%d] ",
+           names[static_cast<int>(level)], t.tm_mon + 1, t.tm_mday, t.tm_hour,
+           t.tm_min, t.tm_sec, ts.tv_nsec / 1000, base ? base + 1 : file,
+           line);
+  stream_ << prefix;
+}
+
+LogMessage::~LogMessage() {
+  stream_ << '\n';
+  const std::string s = stream_.str();
+  ssize_t rc = write(STDERR_FILENO, s.data(), s.size());
+  (void)rc;
+  if (level_ == LogLevel::kFatal) {
+    abort();
+  }
+}
+
+}  // namespace trpc

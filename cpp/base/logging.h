@@ -1,0 +1,54 @@
+// Stream-style logging (parity: butil/logging.h LOG() macros,
+// /root/reference/src/butil/logging.h — re-designed minimal, not a port).
+#pragma once
+
+#include <atomic>
+#include <cstdlib>
+#include <sstream>
+
+namespace trpc {
+
+enum class LogLevel : int { kDebug = 0, kInfo, kWarning, kError, kFatal };
+
+// Runtime-adjustable minimum level (default Info).
+std::atomic<int>& log_min_level();
+
+class LogMessage {
+ public:
+  LogMessage(LogLevel level, const char* file, int line);
+  ~LogMessage();  // flushes; aborts on kFatal
+  std::ostream& stream() { return stream_; }
+
+ private:
+  LogLevel level_;
+  std::ostringstream stream_;
+};
+
+class LogVoidify {
+ public:
+  void operator&(std::ostream&) {}
+};
+
+}  // namespace trpc
+
+#define TRPC_LOG_IS_ON(level) \
+  (static_cast<int>(::trpc::LogLevel::level) >= ::trpc::log_min_level().load(std::memory_order_relaxed))
+
+#define LOG(level)                                                   \
+  !TRPC_LOG_IS_ON(k##level)                                          \
+      ? (void)0                                                      \
+      : ::trpc::LogVoidify() &                                       \
+            ::trpc::LogMessage(::trpc::LogLevel::k##level, __FILE__, \
+                               __LINE__)                             \
+                .stream()
+
+#define LOG_IF(level, cond) \
+  (!(cond)) ? (void)0 : LOG(level)
+
+#define CHECK(cond)                                                       \
+  (cond) ? (void)0                                                        \
+         : ::trpc::LogVoidify() &                                         \
+               ::trpc::LogMessage(::trpc::LogLevel::kFatal, __FILE__,     \
+                                  __LINE__)                               \
+                   .stream()                                              \
+               << "Check failed: " #cond " "

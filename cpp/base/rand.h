@@ -1,0 +1,31 @@
+// Per-thread xorshift RNG (parity: butil/fast_rand.h,
+// /root/reference/src/butil/fast_rand.cpp — used for steal victims and LB).
+#pragma once
+
+#include <cstdint>
+#include <ctime>
+
+namespace trpc {
+
+inline uint64_t fast_rand() {
+  static thread_local uint64_t s0 = 0, s1 = 0;
+  if (s0 == 0 && s1 == 0) {
+    timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    s0 = static_cast<uint64_t>(ts.tv_nsec) * 2654435761u + 1;
+    s1 = reinterpret_cast<uintptr_t>(&s0) ^ 0x9e3779b97f4a7c15ull;
+  }
+  // xorshift128+
+  uint64_t x = s0;
+  const uint64_t y = s1;
+  s0 = y;
+  x ^= x << 23;
+  s1 = x ^ y ^ (x >> 17) ^ (y >> 26);
+  return s1 + y;
+}
+
+inline uint64_t fast_rand_less_than(uint64_t bound) {
+  return bound ? fast_rand() % bound : 0;
+}
+
+}  // namespace trpc

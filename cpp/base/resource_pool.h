@@ -1,0 +1,128 @@
+// Index-addressed object pool — foundation of versioned handles.
+//
+// Parity: butil::ResourcePool (/root/reference/src/butil/resource_pool.h):
+// 32-bit ids addressing slab-allocated objects, recycled without destruction
+// so id-version fields in the object survive reuse (the ABA armor behind
+// fiber ids and SocketId).  Re-designed: lazily allocated fixed segments +
+// thread-local free lists with a mutexed global overflow, instead of the
+// reference's block-group machinery.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+#include <vector>
+
+namespace trpc {
+
+template <typename T>
+class ResourcePool {
+ public:
+  static constexpr uint32_t kItemsPerSegBits = 8;
+  static constexpr uint32_t kItemsPerSeg = 1u << kItemsPerSegBits;
+  static constexpr uint32_t kMaxSegs = 1u << 16;  // ~16.7M items
+
+  static ResourcePool* instance() {
+    static ResourcePool pool;
+    return &pool;
+  }
+
+  ResourcePool(const ResourcePool&) = delete;
+  ResourcePool& operator=(const ResourcePool&) = delete;
+
+  // Returns the index of a (possibly recycled) default-constructed object.
+  // Recycled objects are NOT re-constructed: callers reset state and bump
+  // their embedded version.
+  uint32_t acquire(T** out) {
+    TlsCache& tls = tls_cache();
+    if (tls.free.empty()) {
+      refill(&tls);
+    }
+    if (!tls.free.empty()) {
+      const uint32_t idx = tls.free.back();
+      tls.free.pop_back();
+      *out = at(idx);
+      return idx;
+    }
+    const uint32_t idx = hwm_.fetch_add(1, std::memory_order_relaxed);
+    const uint32_t seg = idx >> kItemsPerSegBits;
+    if (seg >= kMaxSegs) {  // pool exhausted: fail loudly, not OOB
+      *out = nullptr;
+      return UINT32_MAX;
+    }
+    T* items = segs_[seg].load(std::memory_order_acquire);
+    if (items == nullptr) {
+      T* fresh = new T[kItemsPerSeg];
+      if (!segs_[seg].compare_exchange_strong(items, fresh,
+                                              std::memory_order_acq_rel)) {
+        delete[] fresh;  // another thread won
+      } else {
+        items = fresh;
+      }
+      if (items == nullptr) {
+        items = segs_[seg].load(std::memory_order_acquire);
+      }
+    }
+    *out = &items[idx & (kItemsPerSeg - 1)];
+    return idx;
+  }
+
+  void release(uint32_t idx) {
+    TlsCache& tls = tls_cache();
+    tls.free.push_back(idx);
+    if (tls.free.size() >= kTlsHighWater) {
+      std::lock_guard<std::mutex> g(global_mu_);
+      global_free_.insert(global_free_.end(),
+                          tls.free.begin() + kTlsLowWater, tls.free.end());
+      tls.free.resize(kTlsLowWater);
+    }
+  }
+
+  T* at(uint32_t idx) {
+    const uint32_t seg = idx >> kItemsPerSegBits;
+    if (seg >= kMaxSegs) {
+      return nullptr;
+    }
+    T* items = segs_[seg].load(std::memory_order_acquire);
+    return items ? &items[idx & (kItemsPerSeg - 1)] : nullptr;
+  }
+
+ private:
+  ResourcePool() = default;  // singleton per T: TLS free lists assume it
+
+  static constexpr size_t kTlsHighWater = 128;
+  static constexpr size_t kTlsLowWater = 32;
+
+  struct TlsCache {
+    ResourcePool* owner = nullptr;
+    std::vector<uint32_t> free;
+    ~TlsCache() {
+      if (owner != nullptr && !free.empty()) {
+        std::lock_guard<std::mutex> g(owner->global_mu_);
+        owner->global_free_.insert(owner->global_free_.end(), free.begin(),
+                                   free.end());
+      }
+    }
+  };
+
+  TlsCache& tls_cache() {
+    static thread_local TlsCache tls;
+    tls.owner = this;
+    return tls;
+  }
+
+  void refill(TlsCache* tls) {
+    std::lock_guard<std::mutex> g(global_mu_);
+    const size_t take = std::min<size_t>(kTlsLowWater, global_free_.size());
+    tls->free.insert(tls->free.end(), global_free_.end() - take,
+                     global_free_.end());
+    global_free_.resize(global_free_.size() - take);
+  }
+
+  std::atomic<T*> segs_[kMaxSegs] = {};
+  std::atomic<uint32_t> hwm_{0};
+  std::mutex global_mu_;
+  std::vector<uint32_t> global_free_;
+};
+
+}  // namespace trpc

@@ -1,0 +1,26 @@
+// Time helpers (parity: butil/time.h cpuwide_time_ns/gettimeofday_us,
+// /root/reference/src/butil/time.h — CLOCK_MONOTONIC based here; rdtsc
+// calibration is not worth its drift complexity on modern kernels).
+#pragma once
+
+#include <cstdint>
+#include <ctime>
+
+namespace trpc {
+
+inline int64_t monotonic_time_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+inline int64_t monotonic_time_us() { return monotonic_time_ns() / 1000; }
+inline int64_t monotonic_time_ms() { return monotonic_time_ns() / 1000000; }
+
+inline int64_t realtime_us() {
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return ts.tv_sec * 1000000LL + ts.tv_nsec / 1000;
+}
+
+}  // namespace trpc

@@ -1,0 +1,219 @@
+// L1 base library unit tests (parity model: the reference's butil
+// unittests, /root/reference/test/iobuf_unittest.cpp etc.)
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <thread>
+
+#include "base/doubly_buffered.h"
+#include "base/endpoint.h"
+#include "base/flat_map.h"
+#include "base/iobuf.h"
+#include "base/rand.h"
+#include "base/resource_pool.h"
+#include "base/time.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+TEST_CASE(iobuf_append_copy) {
+  IOBuf buf;
+  buf.append("hello ");
+  buf.append(std::string("world"));
+  EXPECT_EQ(buf.size(), 11u);
+  EXPECT(buf.to_string() == "hello world");
+
+  char tmp[6] = {};
+  EXPECT_EQ(buf.copy_to(tmp, 5, 6), 5u);
+  EXPECT(memcmp(tmp, "world", 5) == 0);
+}
+
+TEST_CASE(iobuf_large_append_spans_blocks) {
+  IOBuf buf;
+  std::string big(100000, 'x');
+  for (size_t i = 0; i < big.size(); ++i) {
+    big[i] = static_cast<char>('a' + i % 26);
+  }
+  buf.append(big);
+  EXPECT_EQ(buf.size(), big.size());
+  EXPECT(buf.block_count() >= big.size() / HostArena::kDefaultBlockSize);
+  EXPECT(buf.to_string() == big);
+}
+
+TEST_CASE(iobuf_zero_copy_share) {
+  IOBuf a;
+  a.append("0123456789");
+  IOBuf b = a;  // shares blocks
+  EXPECT_EQ(b.size(), 10u);
+  a.clear();
+  EXPECT(b.to_string() == "0123456789");  // b keeps blocks alive
+}
+
+TEST_CASE(iobuf_copy_then_append_does_not_corrupt) {
+  IOBuf a;
+  a.append("abc");
+  IOBuf b = a;   // block now multi-referenced
+  a.append("X");  // must NOT extend the shared block in place
+  EXPECT(b.to_string() == "abc");
+  EXPECT(a.to_string() == "abcX");
+}
+
+TEST_CASE(iobuf_cutn_pop) {
+  IOBuf a;
+  a.append("header|body-bytes");
+  IOBuf head;
+  EXPECT_EQ(a.cutn(&head, 7), 7u);
+  EXPECT(head.to_string() == "header|");
+  EXPECT(a.to_string() == "body-bytes");
+  EXPECT_EQ(a.pop_front(5), 5u);
+  EXPECT(a.to_string() == "bytes");
+  EXPECT_EQ(a.pop_back(1), 1u);
+  EXPECT(a.to_string() == "byte");
+}
+
+TEST_CASE(iobuf_user_data_deleter) {
+  static std::atomic<int> deleted{0};
+  static char payload[] = "device-buffer";
+  {
+    IOBuf a;
+    a.append_user_data(
+        payload, 13, [](void*, void*) { deleted.fetch_add(1); }, nullptr,
+        0x1234);
+    IOBuf b = a;
+    a.clear();
+    EXPECT_EQ(deleted.load(), 0);
+    EXPECT(b.to_string() == "device-buffer");
+    EXPECT_EQ(b.ref_at(0).block->user_meta, 0x1234u);
+  }
+  EXPECT_EQ(deleted.load(), 1);
+}
+
+TEST_CASE(iobuf_fd_roundtrip) {
+  int fds[2];
+  EXPECT_EQ(pipe(fds), 0);
+  IOBuf w;
+  std::string msg(20000, 'q');
+  w.append(msg);
+  size_t sent = 0;
+  while (sent < msg.size()) {
+    ssize_t rc = w.cut_into_fd(fds[1]);
+    EXPECT(rc > 0);
+    sent += rc;
+  }
+  IOBuf r;
+  while (r.size() < msg.size()) {
+    ssize_t rc = r.append_from_fd(fds[0], msg.size() - r.size());
+    EXPECT(rc > 0);
+  }
+  EXPECT(r.to_string() == msg);
+  close(fds[0]);
+  close(fds[1]);
+}
+
+TEST_CASE(resource_pool_reuse) {
+  struct Obj {
+    uint32_t version = 0;
+    int payload = 0;
+  };
+  auto* pool = ResourcePool<Obj>::instance();
+  Obj* o1 = nullptr;
+  const uint32_t id1 = pool->acquire(&o1);
+  o1->version = 7;
+  o1->payload = 42;
+  pool->release(id1);
+  Obj* o2 = nullptr;
+  const uint32_t id2 = pool->acquire(&o2);
+  EXPECT_EQ(id2, id1);       // recycled
+  EXPECT_EQ(o2->version, 7u);  // state survives recycle (version armor)
+  EXPECT(pool->at(id2) == o2);
+}
+
+TEST_CASE(flat_map_basics) {
+  FlatMap<std::string, int> m;
+  for (int i = 0; i < 100; ++i) {
+    m["key" + std::to_string(i)] = i;
+  }
+  EXPECT_EQ(m.size(), 100u);
+  for (int i = 0; i < 100; ++i) {
+    int* v = m.seek("key" + std::to_string(i));
+    EXPECT(v != nullptr && *v == i);
+  }
+  EXPECT(m.seek("missing") == nullptr);
+  EXPECT(m.erase("key50"));
+  EXPECT(!m.erase("key50"));
+  EXPECT(m.seek("key50") == nullptr);
+  EXPECT_EQ(m.size(), 99u);
+  // All other keys still reachable after backward-shift deletion.
+  for (int i = 0; i < 100; ++i) {
+    if (i != 50) {
+      EXPECT(m.seek("key" + std::to_string(i)) != nullptr);
+    }
+  }
+}
+
+TEST_CASE(doubly_buffered_read_write) {
+  DoublyBufferedData<std::vector<int>> dbd;
+  dbd.Modify([](std::vector<int>& v) {
+    v = {1, 2, 3};
+    return true;
+  });
+  {
+    auto ptr = dbd.Read();
+    EXPECT_EQ(ptr->size(), 3u);
+  }
+  std::atomic<bool> stop{false};
+  std::thread reader([&] {
+    while (!stop.load()) {
+      auto ptr = dbd.Read();
+      EXPECT(ptr->size() == 3u || ptr->size() == 4u);
+    }
+  });
+  for (int i = 0; i < 100; ++i) {
+    dbd.Modify([i](std::vector<int>& v) {
+      v = (i % 2 == 0) ? std::vector<int>{1, 2, 3, 4}
+                       : std::vector<int>{1, 2, 3};
+      return true;
+    });
+  }
+  stop.store(true);
+  reader.join();
+}
+
+TEST_CASE(endpoint_parse_format) {
+  EndPoint ep;
+  EXPECT_EQ(str2endpoint("10.1.2.3:8080", &ep), 0);
+  EXPECT_EQ(ep.port, 8080);
+  EXPECT(endpoint2str(ep) == "10.1.2.3:8080");
+
+  EXPECT_EQ(str2endpoint("10.1.2.3:8080/2", &ep), 0);
+  EXPECT_EQ(ep.device_ordinal, 2);
+  EXPECT(endpoint2str(ep) == "10.1.2.3:8080/2");
+
+  EXPECT(str2endpoint("nonsense", &ep) != 0);
+  EXPECT(str2endpoint("1.2.3.4:99999", &ep) != 0);
+
+  EXPECT_EQ(hostname2endpoint("localhost:80", &ep), 0);
+  EXPECT(endpoint2str(ep) == "127.0.0.1:80");
+
+  sockaddr_in sa = endpoint2sockaddr(ep);
+  EndPoint back = sockaddr2endpoint(sa);
+  EXPECT(back.ip == ep.ip && back.port == ep.port);
+}
+
+TEST_CASE(fast_rand_spread) {
+  uint64_t seen_buckets = 0;
+  for (int i = 0; i < 1000; ++i) {
+    seen_buckets |= 1ull << (fast_rand_less_than(64));
+  }
+  EXPECT(__builtin_popcountll(seen_buckets) > 48);
+}
+
+TEST_CASE(time_monotonic) {
+  const int64_t a = monotonic_time_ns();
+  const int64_t b = monotonic_time_ns();
+  EXPECT(b >= a);
+  EXPECT(realtime_us() > 1600000000000000LL);  // sane wall clock
+}
+
+TEST_MAIN

@@ -1,0 +1,71 @@
+// Minimal assert-style test harness for the C++ unit binaries (the repo's
+// pytest suite invokes these; see tests/test_cpp.py).
+#pragma once
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <functional>
+#include <string>
+#include <vector>
+
+namespace trpc_test {
+
+struct Registry {
+  static Registry& get() {
+    static Registry r;
+    return r;
+  }
+  std::vector<std::pair<std::string, std::function<void()>>> tests;
+};
+
+struct Registrar {
+  Registrar(const char* name, std::function<void()> fn) {
+    Registry::get().tests.emplace_back(name, std::move(fn));
+  }
+};
+
+#define TEST_CASE(name)                                              \
+  static void test_##name();                                         \
+  static ::trpc_test::Registrar reg_##name(#name, test_##name);      \
+  static void test_##name()
+
+#define EXPECT(cond)                                                    \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);   \
+      exit(1);                                                          \
+    }                                                                   \
+  } while (0)
+
+#define EXPECT_EQ(a, b)                                                    \
+  do {                                                                    \
+    auto va = (a);                                                        \
+    auto vb = (b);                                                        \
+    if (!(va == vb)) {                                                    \
+      fprintf(stderr, "FAIL %s:%d: %s == %s (%lld vs %lld)\n", __FILE__,  \
+              __LINE__, #a, #b, (long long)va, (long long)vb);            \
+      exit(1);                                                            \
+    }                                                                     \
+  } while (0)
+
+inline int run_all(int argc, char** argv) {
+  const char* filter = argc > 1 ? argv[1] : nullptr;
+  int ran = 0;
+  for (auto& [name, fn] : Registry::get().tests) {
+    if (filter != nullptr && name.find(filter) == std::string::npos) {
+      continue;
+    }
+    fprintf(stderr, "[ RUN  ] %s\n", name.c_str());
+    fn();
+    fprintf(stderr, "[  OK  ] %s\n", name.c_str());
+    ++ran;
+  }
+  fprintf(stderr, "PASSED %d tests\n", ran);
+  return 0;
+}
+
+}  // namespace trpc_test
+
+#define TEST_MAIN \
+  int main(int argc, char** argv) { return ::trpc_test::run_all(argc, argv); }

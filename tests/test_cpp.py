@@ -1,0 +1,46 @@
+"""Build the C++ runtime and run its assert-based unit binaries.
+
+Mirrors the reference's per-layer gtest strategy (SURVEY.md §4) with pytest
+as the single green gate.
+"""
+
+import pathlib
+import subprocess
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+BUILD = REPO / "build"
+
+
+def _build():
+    subprocess.run(
+        ["cmake", "-S", str(REPO / "cpp"), "-B", str(BUILD), "-DCMAKE_BUILD_TYPE=RelWithDebInfo"],
+        check=True,
+        capture_output=True,
+    )
+    subprocess.run(
+        ["cmake", "--build", str(BUILD), "-j", "2"],
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+
+
+@pytest.fixture(scope="session", autouse=True)
+def built():
+    try:
+        _build()
+    except subprocess.CalledProcessError as e:
+        pytest.fail(f"C++ build failed:\n{e.stdout}\n{e.stderr}")
+
+
+def _run(binary, timeout=120):
+    proc = subprocess.run(
+        [str(BUILD / binary)], capture_output=True, text=True, timeout=timeout
+    )
+    assert proc.returncode == 0, f"{binary} failed:\n{proc.stdout}\n{proc.stderr}"
+
+
+def test_base():
+    _run("test_base")

@@ -1,0 +1,31 @@
+import pytest
+
+from brpc_tpu.rpc import IOBuf, parse_endpoint
+
+
+def test_iobuf_roundtrip():
+    buf = IOBuf(b"hello ")
+    buf.append(b"world")
+    assert len(buf) == 11
+    assert buf.to_bytes() == b"hello world"
+    head = buf.cutn(6)
+    assert head.to_bytes() == b"hello "
+    assert buf.to_bytes() == b"world"
+    buf.pop_front(1)
+    assert buf.to_bytes() == b"orld"
+
+
+def test_iobuf_large():
+    payload = bytes(range(256)) * 1000  # 256 KB spans many 8KB blocks
+    buf = IOBuf(payload)
+    assert len(buf) == len(payload)
+    assert buf.block_count >= 31
+    assert buf.to_bytes() == payload
+
+
+def test_parse_endpoint():
+    assert parse_endpoint("127.0.0.1:8000") == "127.0.0.1:8000"
+    assert parse_endpoint("127.0.0.1:8000/3") == "127.0.0.1:8000/3"
+    assert parse_endpoint("localhost:80") == "127.0.0.1:80"
+    with pytest.raises(ValueError):
+        parse_endpoint("not-an-endpoint")
