@@ -19,19 +19,36 @@ _lock = threading.Lock()
 _lib = None
 
 
-def _ensure_built() -> None:
-    if _LIB_PATH.exists():
+def _newest_source_mtime() -> float:
+    newest = 0.0
+    for path in (_REPO / "cpp").rglob("*"):
+        if path.suffix in (".cc", ".h", ".S", ".txt"):
+            newest = max(newest, path.stat().st_mtime)
+    return newest
+
+
+def ensure_built(all_targets: bool = False) -> None:
+    """(Re)build the native library when missing or older than any cpp/
+    source.  Shared by the bindings and the pytest fixture so there is one
+    build recipe."""
+    stale = (
+        not _LIB_PATH.exists()
+        or _LIB_PATH.stat().st_mtime < _newest_source_mtime()
+    )
+    if not stale and not all_targets:
         return
     subprocess.run(
         ["cmake", "-S", str(_REPO / "cpp"), "-B", str(_BUILD)],
         check=True,
         capture_output=True,
     )
-    subprocess.run(
-        ["cmake", "--build", str(_BUILD), "-j", "2", "--target", "tpurpc"],
-        check=True,
-        capture_output=True,
-    )
+    cmd = ["cmake", "--build", str(_BUILD), "-j", "2"]
+    if not all_targets:
+        cmd += ["--target", "tpurpc"]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+_ensure_built = ensure_built
 
 
 def load_library() -> ctypes.CDLL:

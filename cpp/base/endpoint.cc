@@ -8,10 +8,32 @@
 
 namespace trpc {
 
+namespace {
+
+// Parses "port" or "port/device" strictly (no trailing garbage).
+int parse_port_dev(const char* s, int* port, int* dev) {
+  char* end = nullptr;
+  const long p = strtol(s, &end, 10);
+  if (end == s || p < 0 || p > 65535) {
+    return -1;
+  }
+  *port = static_cast<int>(p);
+  *dev = -1;
+  if (*end == '/') {
+    const char* ds = end + 1;
+    const long d = strtol(ds, &end, 10);
+    if (end == ds || d < 0) {
+      return -1;
+    }
+    *dev = static_cast<int>(d);
+  }
+  return *end == '\0' ? 0 : -1;
+}
+
+}  // namespace
+
 int str2endpoint(const char* s, EndPoint* out) {
   char host[128];
-  int port = 0;
-  int dev = -1;
   const char* colon = strrchr(s, ':');
   if (colon == nullptr || colon == s ||
       static_cast<size_t>(colon - s) >= sizeof(host)) {
@@ -19,10 +41,9 @@ int str2endpoint(const char* s, EndPoint* out) {
   }
   memcpy(host, s, colon - s);
   host[colon - s] = '\0';
-  if (sscanf(colon + 1, "%d/%d", &port, &dev) < 1) {
-    return -1;
-  }
-  if (port < 0 || port > 65535) {
+  int port = 0;
+  int dev = -1;
+  if (parse_port_dev(colon + 1, &port, &dev) != 0) {
     return -1;
   }
   in_addr addr;
@@ -43,9 +64,9 @@ int hostname2endpoint(const char* s, EndPoint* out) {
   if (colon == nullptr) {
     return -1;
   }
-  char* end = nullptr;
-  const long port = strtol(colon + 1, &end, 10);
-  if (end == colon + 1 || *end != '\0' || port < 0 || port > 65535) {
+  int port = 0;
+  int dev = -1;
+  if (parse_port_dev(colon + 1, &port, &dev) != 0) {
     return -1;
   }
   std::string host(s, colon - s);
@@ -58,8 +79,8 @@ int hostname2endpoint(const char* s, EndPoint* out) {
     return -1;
   }
   out->ip = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr.s_addr;
-  out->port = static_cast<int>(port);
-  out->device_ordinal = -1;
+  out->port = port;
+  out->device_ordinal = dev;
   freeaddrinfo(res);
   return 0;
 }

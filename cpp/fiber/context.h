@@ -1,0 +1,28 @@
+// Userspace context switching — the fcontext core of the fiber runtime.
+//
+// Parity: bthread's boost.context-derived assembly
+// (/root/reference/src/bthread/context.h:80-90).  Re-designed minimal for
+// x86_64 SysV: a suspended context IS its stack pointer; jump saves the six
+// callee-saved registers + mxcsr/x87cw on the current stack and switches.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+// Saves the current continuation (sp stored into *save_sp), switches to
+// target_sp, and makes `arg` the return value observed by the resumed
+// context (or the entry argument of a fresh context).
+void* trpc_jump_context(void** save_sp, void* target_sp, void* arg);
+
+}  // extern "C"
+
+namespace trpc {
+
+// Builds a fresh suspended context on [stack_base, stack_base+size).
+// When first jumped to, calls entry(arg) where arg is the jump's 3rd
+// argument.  entry must never return (switch away instead).
+void* make_context(void* stack_base, size_t size, void (*entry)(void*));
+
+}  // namespace trpc

@@ -1,0 +1,286 @@
+#include "fiber/event.h"
+
+#include <linux/futex.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <cerrno>
+
+#include "base/logging.h"
+#include "base/time.h"
+#include "fiber/scheduler.h"
+#include "fiber/timer.h"
+
+namespace trpc {
+
+namespace {
+
+int futex_wait_private(std::atomic<int>* addr, int expected,
+                       const timespec* timeout) {
+  return syscall(SYS_futex, reinterpret_cast<int*>(addr), FUTEX_WAIT_PRIVATE,
+                 expected, timeout, nullptr, 0);
+}
+
+int futex_wake_private(std::atomic<int>* addr, int n) {
+  return syscall(SYS_futex, reinterpret_cast<int*>(addr), FUTEX_WAKE_PRIVATE,
+                 n, nullptr, nullptr, 0);
+}
+
+}  // namespace
+
+// Waiter node.  Fiber waiters are heap-allocated and ref-counted because a
+// timeout timer can outlive the wait; pthread waiters live on the caller's
+// stack (unlinked under the event lock before return).
+struct EventWaiter {
+  EventWaiter* next = nullptr;
+  EventWaiter* prev = nullptr;
+  Event* ev = nullptr;
+  FiberMeta* fiber = nullptr;          // null → pthread waiter
+  std::atomic<int> pword{0};           // pthread futex word (1 = woken)
+  std::atomic<int> refs{1};
+  uint64_t timer_id = 0;
+  int64_t deadline_us = -1;            // >=0 → publish schedules a timer
+  uint32_t expected = 0;
+  bool linked = false;
+  bool timedout = false;
+  bool no_link = false;  // value changed before publish; wait returns 0
+
+  void unref() {
+    if (refs.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+      delete this;
+    }
+  }
+};
+
+void Event::lock() {
+  while (lock_.test_and_set(std::memory_order_acquire)) {
+#if defined(__x86_64__)
+    __builtin_ia32_pause();
+#endif
+  }
+}
+
+void Event::unlock() { lock_.clear(std::memory_order_release); }
+
+Event::~Event() {
+  // Waiters must be gone; waking here would race with destruction anyway.
+  lock();
+  CHECK(head_ == nullptr) << "Event destroyed with waiters";
+  unlock();
+}
+
+void event_timeout_cb(void* p) {
+  EventWaiter* w = static_cast<EventWaiter*>(p);
+  Event* ev = w->ev;
+  FiberMeta* to_wake = nullptr;
+  ev->lock();
+  if (w->linked) {
+    // Unlink and wake with the timeout flag.
+    if (w->prev != nullptr) {
+      w->prev->next = w->next;
+    } else {
+      ev->head_ = w->next;
+    }
+    if (w->next != nullptr) {
+      w->next->prev = w->prev;
+    } else {
+      ev->tail_ = w->prev;
+    }
+    w->linked = false;
+    w->timedout = true;
+    to_wake = w->fiber;
+  }
+  ev->unlock();
+  if (to_wake != nullptr) {
+    Scheduler::instance()->ready_to_run(to_wake);
+  }
+  w->unref();
+}
+
+// Runs on the scheduler context after the waiting fiber switched away.
+// a1 = Event*, a2 = EventWaiter*.
+void Event::publish_post(void* a1, void* a2) {
+  Event* ev = static_cast<Event*>(a1);
+  EventWaiter* w = static_cast<EventWaiter*>(a2);
+  bool requeue = false;
+  ev->lock();
+  if (ev->value.load(std::memory_order_relaxed) != w->expected) {
+    // Raced with a change: don't block after all.
+    w->no_link = true;
+    requeue = true;
+  } else {
+    w->linked = true;
+    w->prev = ev->tail_;
+    w->next = nullptr;
+    if (ev->tail_ != nullptr) {
+      ev->tail_->next = w;
+    } else {
+      ev->head_ = w;
+    }
+    ev->tail_ = w;
+    if (w->deadline_us >= 0) {
+      w->refs.fetch_add(1, std::memory_order_relaxed);
+      w->timer_id = TimerThread::instance()->schedule(w->deadline_us,
+                                                      event_timeout_cb, w);
+    }
+  }
+  ev->unlock();
+  if (requeue) {
+    Scheduler::instance()->ready_to_run(w->fiber);
+  }
+}
+
+int Event::wait(uint32_t expected, int64_t deadline_us) {
+  if (value.load(std::memory_order_acquire) != expected) {
+    return EWOULDBLOCK;
+  }
+  Worker* w = tls_worker;
+  if (w != nullptr && w->current() != nullptr) {
+    // -- fiber path --
+    EventWaiter* node = new EventWaiter();
+    node->ev = this;
+    node->fiber = w->current();
+    node->expected = expected;
+    node->deadline_us = deadline_us;
+    w->suspend_current(&Event::publish_post, this, node);
+    // Resumed: either woken, timed out, or never linked.
+    int rc = 0;
+    uint64_t timer_to_cancel = 0;
+    lock();
+    if (node->timedout) {
+      rc = ETIMEDOUT;
+    } else if (!node->no_link && node->timer_id != 0) {
+      timer_to_cancel = node->timer_id;
+    }
+    unlock();
+    if (timer_to_cancel != 0 &&
+        TimerThread::instance()->unschedule(timer_to_cancel)) {
+      node->unref();  // timer will never run
+    }
+    node->unref();
+    return rc;
+  }
+  // -- pthread path --
+  EventWaiter node;
+  node.ev = this;
+  node.expected = expected;
+  lock();
+  if (value.load(std::memory_order_relaxed) != expected) {
+    unlock();
+    return EWOULDBLOCK;
+  }
+  node.linked = true;
+  node.prev = tail_;
+  if (tail_ != nullptr) {
+    tail_->next = &node;
+  } else {
+    head_ = &node;
+  }
+  tail_ = &node;
+  unlock();
+
+  int rc = 0;
+  while (node.pword.load(std::memory_order_acquire) == 0) {
+    timespec ts;
+    timespec* tsp = nullptr;
+    if (deadline_us >= 0) {
+      const int64_t now = monotonic_time_us();
+      int64_t left = deadline_us - now;
+      if (left <= 0) {
+        rc = ETIMEDOUT;
+        break;
+      }
+      ts.tv_sec = left / 1000000;
+      ts.tv_nsec = (left % 1000000) * 1000;
+      tsp = &ts;
+    }
+    futex_wait_private(&node.pword, 0, tsp);
+  }
+  if (rc == ETIMEDOUT) {
+    lock();
+    const bool was_linked = node.linked;
+    if (was_linked) {
+      if (node.prev != nullptr) {
+        node.prev->next = node.next;
+      } else {
+        head_ = node.next;
+      }
+      if (node.next != nullptr) {
+        node.next->prev = node.prev;
+      } else {
+        tail_ = node.prev;
+      }
+      node.linked = false;
+    }
+    unlock();
+    if (!was_linked) {
+      // Woken concurrently with the timeout: the waker will still store to
+      // our stack node; wait for it so the access finishes before return.
+      rc = 0;
+      while (node.pword.load(std::memory_order_acquire) == 0) {
+        futex_wait_private(&node.pword, 0, nullptr);
+      }
+    }
+  }
+  return rc;
+}
+
+int Event::wake(int n) {
+  FiberMeta* fibers[16];
+  int woken = 0;
+  while (woken < n) {
+    int batch_fibers = 0;
+    EventWaiter* pthread_nodes[16];
+    int batch_pthreads = 0;
+    lock();
+    while (woken < n && head_ != nullptr && batch_fibers < 16 &&
+           batch_pthreads < 16) {
+      EventWaiter* w = head_;
+      head_ = w->next;
+      if (head_ != nullptr) {
+        head_->prev = nullptr;
+      } else {
+        tail_ = nullptr;
+      }
+      w->linked = false;
+      if (w->fiber != nullptr) {
+        fibers[batch_fibers++] = w->fiber;
+      } else {
+        pthread_nodes[batch_pthreads++] = w;
+      }
+      ++woken;
+    }
+    const bool more = head_ != nullptr;
+    unlock();
+    for (int i = 0; i < batch_fibers; ++i) {
+      Scheduler::instance()->ready_to_run(fibers[i]);
+    }
+    for (int i = 0; i < batch_pthreads; ++i) {
+      pthread_nodes[i]->pword.store(1, std::memory_order_release);
+      futex_wake_private(&pthread_nodes[i]->pword, 1);
+    }
+    if (!more || woken >= n) {
+      break;
+    }
+  }
+  return woken;
+}
+
+void fiber_sleep_until_us(int64_t deadline_us) {
+  Worker* w = tls_worker;
+  if (w == nullptr || w->current() == nullptr) {
+    const int64_t left = deadline_us - monotonic_time_us();
+    if (left > 0) {
+      usleep(static_cast<useconds_t>(left));
+    }
+    return;
+  }
+  Event ev;  // nobody wakes it; the deadline does
+  ev.wait(0, deadline_us);
+}
+
+void fiber_sleep_us(int64_t us) {
+  fiber_sleep_until_us(monotonic_time_us() + us);
+}
+
+}  // namespace trpc

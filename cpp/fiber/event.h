@@ -1,0 +1,49 @@
+// Event — futex semantics on a 32-bit word for fibers AND pthreads.
+//
+// Parity: bthread's butex (/root/reference/src/bthread/butex.h:41-84), THE
+// blocking primitive everything above reduces to.  wait() blocks only while
+// value == expected (checked again under the internal lock after the context
+// switch — the publish-after-switch pattern); wake() moves fiber waiters
+// back to a run queue and kicks pthread waiters' kernel futex.  This is the
+// seam where "park on DMA completion" plugs in: whatever thread observes a
+// completion just calls wake().
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+
+namespace trpc {
+
+struct FiberMeta;
+struct EventWaiter;
+
+class Event {
+ public:
+  std::atomic<uint32_t> value{0};
+
+  // Blocks while value == expected.  Returns 0 when woken, EWOULDBLOCK if
+  // value != expected on entry, ETIMEDOUT when deadline_us (monotonic,
+  // -1 = none) passes.  Callable from fibers and plain pthreads.
+  int wait(uint32_t expected, int64_t deadline_us = -1);
+  // Wakes up to n waiters; returns the number woken.
+  int wake(int n);
+  int wake_all() { return wake(1 << 30); }
+
+  ~Event();
+
+ private:
+  friend struct EventWaiter;
+  friend void event_timeout_cb(void* p);
+  void lock();
+  void unlock();
+  static void publish_post(void* a1, void* a2);
+
+  std::atomic_flag lock_ = ATOMIC_FLAG_INIT;
+  EventWaiter* head_ = nullptr;  // doubly-linked FIFO
+  EventWaiter* tail_ = nullptr;
+};
+
+// Sleep usable from fibers (parks on a private Event) and pthreads.
+void fiber_sleep_until_us(int64_t deadline_us_monotonic);
+
+}  // namespace trpc

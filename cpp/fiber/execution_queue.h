@@ -1,0 +1,140 @@
+// ExecutionQueue — MPSC serialized executor.
+//
+// Parity: bthread ExecutionQueue
+// (/root/reference/src/bthread/execution_queue.h:163-196): lock-free
+// multi-producer push, one consumer fiber draining batches in order; used by
+// streaming RPC and LB feedback.  Re-designed: Treiber push + reverse drain
+// (the reference threads an intrusive doubly list through nodes).
+#pragma once
+
+#include <atomic>
+
+#include "fiber/fiber.h"
+
+namespace trpc {
+
+template <typename T>
+class ExecutionQueue {
+ public:
+  // handler(meta, items, n): consume a FIFO batch.  Return nonzero to stop.
+  using Handler = int (*)(void* meta, T* items, size_t n);
+
+  void start(Handler handler, void* meta) {
+    handler_ = handler;
+    meta_ = meta;
+  }
+
+  // Callable from any thread/fiber.  Returns 0, or -1 after stop().
+  int execute(const T& item) {
+    if (stopped_.load(std::memory_order_acquire)) {
+      return -1;
+    }
+    Node* n = new Node{item, nullptr};
+    Node* old = head_.load(std::memory_order_relaxed);
+    do {
+      n->next = old;
+    } while (!head_.compare_exchange_weak(old, n, std::memory_order_release,
+                                          std::memory_order_relaxed));
+    if (old == nullptr) {
+      // Queue was empty: become (or spawn) the consumer.
+      schedule_consumer();
+    }
+    return 0;
+  }
+
+  void stop() { stopped_.store(true, std::memory_order_release); }
+
+  ~ExecutionQueue() {
+    Node* rest = head_.exchange(nullptr, std::memory_order_acquire);
+    while (rest != nullptr) {
+      Node* next = rest->next;
+      delete rest;
+      rest = next;
+    }
+  }
+
+  bool idle() const {
+    return head_.load(std::memory_order_acquire) == nullptr &&
+           !running_.load(std::memory_order_acquire);
+  }
+
+ private:
+  struct Node {
+    T value;
+    Node* next;
+  };
+
+  void schedule_consumer() {
+    bool expect = false;
+    if (!running_.compare_exchange_strong(expect, true,
+                                          std::memory_order_acq_rel)) {
+      return;  // a consumer is already live; it will re-check before idling
+    }
+    fiber_start(nullptr, &ExecutionQueue::consume_thunk, this, 0);
+  }
+
+  static void consume_thunk(void* self) {
+    static_cast<ExecutionQueue*>(self)->consume();
+  }
+
+  void consume() {
+    while (true) {
+      Node* chain = head_.exchange(nullptr, std::memory_order_acquire);
+      if (chain == nullptr) {
+        running_.store(false, std::memory_order_release);
+        // Producers that pushed after our exchange saw old==non-null only if
+        // they raced before it; re-check to close the window.
+        if (head_.load(std::memory_order_acquire) != nullptr) {
+          bool expect = false;
+          if (running_.compare_exchange_strong(expect, true,
+                                               std::memory_order_acq_rel)) {
+            continue;
+          }
+        }
+        return;
+      }
+      // Reverse the LIFO chain into FIFO order.
+      Node* fifo = nullptr;
+      size_t count = 0;
+      while (chain != nullptr) {
+        Node* next = chain->next;
+        chain->next = fifo;
+        fifo = chain;
+        chain = next;
+        ++count;
+      }
+      // Copy into a flat batch for the handler.
+      T* batch = new T[count];
+      size_t i = 0;
+      while (fifo != nullptr) {
+        batch[i++] = fifo->value;
+        Node* done = fifo;
+        fifo = fifo->next;
+        delete done;
+      }
+      const int rc = handler_(meta_, batch, count);
+      delete[] batch;
+      if (rc != 0) {
+        // Handler asked to stop: refuse new work, then drain (and free)
+        // anything pushed concurrently so nodes can't leak.
+        stopped_.store(true, std::memory_order_release);
+        Node* rest = head_.exchange(nullptr, std::memory_order_acquire);
+        while (rest != nullptr) {
+          Node* next = rest->next;
+          delete rest;
+          rest = next;
+        }
+        running_.store(false, std::memory_order_release);
+        return;
+      }
+    }
+  }
+
+  Handler handler_ = nullptr;
+  void* meta_ = nullptr;
+  std::atomic<Node*> head_{nullptr};
+  std::atomic<bool> running_{false};
+  std::atomic<bool> stopped_{false};
+};
+
+}  // namespace trpc

@@ -1,0 +1,48 @@
+// Fiber runtime public API — pthread-like M:N userspace threads.
+//
+// Parity: the bthread C API (/root/reference/src/bthread/bthread.h —
+// bthread_start_urgent/background, join, yield, usleep) over a
+// TaskControl/TaskGroup-style work-stealing scheduler
+// (/root/reference/src/bthread/task_group.h).  Re-designed: a fiber switches
+// through its worker's scheduler context (two-hop switch) instead of
+// fiber→fiber chaining, and deferred "publish after switch" actions replace
+// the reference's set_remained machinery.
+#pragma once
+
+#include <cstdint>
+
+namespace trpc {
+
+// version<<32 | pool slot; 0 is invalid (parity: bthread_t,
+// task_group_inl.h:28-38).
+using fiber_t = uint64_t;
+
+constexpr int kFiberUrgent = 1;  // run ASAP (caller's queue front)
+
+// Start the scheduler with n worker pthreads (idempotent; auto-started with
+// a default on first fiber_start).
+void fiber_init(int workers);
+int fiber_worker_count();
+
+int fiber_start(fiber_t* out, void (*fn)(void*), void* arg, int flags = 0);
+// Waits until the fiber finishes.  Returns 0 (also for already-gone ids).
+int fiber_join(fiber_t f);
+// True if the id refers to a live fiber.
+bool fiber_exists(fiber_t f);
+void fiber_yield();
+void fiber_sleep_us(int64_t us);
+// Id of the calling fiber (0 when not on a fiber).
+fiber_t fiber_self();
+bool in_fiber();
+
+// -- fiber-local storage (parity: bthread_key_*, src/bthread/key.cpp) ----
+struct fls_key_t {
+  uint32_t index = 0;
+  uint32_t version = 0;
+};
+int fls_key_create(fls_key_t* key, void (*dtor)(void*));
+int fls_key_delete(fls_key_t key);
+int fls_set(fls_key_t key, void* value);
+void* fls_get(fls_key_t key);
+
+}  // namespace trpc

@@ -1,0 +1,127 @@
+#include "fiber/fid.h"
+
+#include <cerrno>
+
+#include "base/resource_pool.h"
+#include "fiber/scheduler.h"
+#include "fiber/sync.h"
+
+namespace trpc {
+
+namespace {
+
+struct IdMeta {
+  std::atomic<uint32_t> version{0};  // even = dead slot, odd = live
+  FiberMutex mu;
+  Event join_ev;  // value = live version; bumped at destroy
+  void* data = nullptr;
+  int (*on_error)(fid_t, void*, int) = nullptr;
+  uint32_t slot = 0;
+};
+
+using IdPool = ResourcePool<IdMeta>;
+
+IdMeta* meta_of(fid_t id) {
+  const uint32_t ver = static_cast<uint32_t>(id >> 32);
+  if ((ver & 1) == 0) {
+    return nullptr;
+  }
+  IdMeta* m = IdPool::instance()->at(static_cast<uint32_t>(id));
+  if (m == nullptr || m->version.load(std::memory_order_acquire) != ver) {
+    return nullptr;
+  }
+  return m;
+}
+
+}  // namespace
+
+int fid_create(fid_t* id, void* data, int (*on_error)(fid_t, void*, int)) {
+  IdMeta* m = nullptr;
+  const uint32_t slot = IdPool::instance()->acquire(&m);
+  if (m == nullptr) {
+    return ENOMEM;
+  }
+  m->slot = slot;
+  m->data = data;
+  m->on_error = on_error;
+  const uint32_t ver = m->version.load(std::memory_order_relaxed) + 1;  // odd
+  m->join_ev.value.store(ver, std::memory_order_relaxed);
+  m->version.store(ver, std::memory_order_release);
+  *id = (static_cast<uint64_t>(ver) << 32) | slot;
+  return 0;
+}
+
+int fid_lock(fid_t id, void** data) {
+  IdMeta* m = meta_of(id);
+  if (m == nullptr) {
+    return EINVAL;
+  }
+  m->mu.lock();
+  // Re-validate: the id may have been destroyed while we queued on the lock.
+  if (m->version.load(std::memory_order_acquire) !=
+      static_cast<uint32_t>(id >> 32)) {
+    m->mu.unlock();
+    return EINVAL;
+  }
+  if (data != nullptr) {
+    *data = m->data;
+  }
+  return 0;
+}
+
+int fid_unlock(fid_t id) {
+  IdMeta* m = meta_of(id);
+  if (m == nullptr) {
+    return EINVAL;
+  }
+  m->mu.unlock();
+  return 0;
+}
+
+int fid_unlock_and_destroy(fid_t id) {
+  const uint32_t ver = static_cast<uint32_t>(id >> 32);
+  IdMeta* m = meta_of(id);
+  if (m == nullptr) {
+    return EINVAL;
+  }
+  // Kill the version first (holders of the lock queue will re-validate),
+  // then release the lock, wake joiners, recycle.
+  m->version.store(ver + 1, std::memory_order_release);
+  m->mu.unlock();
+  m->join_ev.value.store(ver + 1, std::memory_order_release);
+  m->join_ev.wake_all();
+  IdPool::instance()->release(m->slot);
+  return 0;
+}
+
+int fid_error(fid_t id, int error_code) {
+  void* data = nullptr;
+  const int rc = fid_lock(id, &data);
+  if (rc != 0) {
+    return rc;
+  }
+  IdMeta* m = meta_of(id);
+  if (m != nullptr && m->on_error != nullptr) {
+    return m->on_error(id, data, error_code);  // must unlock/destroy
+  }
+  return fid_unlock_and_destroy(id);
+}
+
+int fid_join(fid_t id) {
+  const uint32_t ver = static_cast<uint32_t>(id >> 32);
+  if ((ver & 1) == 0) {
+    return 0;
+  }
+  IdMeta* m = IdPool::instance()->at(static_cast<uint32_t>(id));
+  if (m == nullptr) {
+    return 0;
+  }
+  while (m->join_ev.value.load(std::memory_order_acquire) == ver) {
+    m->join_ev.wait(ver, -1);
+  }
+  return 0;
+}
+
+bool fid_exists(fid_t id) { return meta_of(id) != nullptr; }
+
+}  // namespace trpc

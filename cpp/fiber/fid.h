@@ -1,0 +1,32 @@
+// fid — versioned correlation id with built-in locking and join.
+//
+// Parity: bthread_id (/root/reference/src/bthread/id.h:46-78), the machinery
+// that lets racing RPC responses / timeouts / retries serialize on one id
+// and makes stale responses harmless (versioned handle + exclusive lock +
+// destroy-join).  Re-designed condensed: a FiberMutex guards the payload, a
+// join Event signals destruction, and validity is a version match against
+// the pooled meta (the reference additionally queues pending errors).
+#pragma once
+
+#include <cstdint>
+
+namespace trpc {
+
+using fid_t = uint64_t;  // version<<32 | pool slot; 0 invalid
+
+// on_error(id, data, error_code) is invoked WITH the id locked; it must end
+// by calling fid_unlock or fid_unlock_and_destroy.  Null on_error → error()
+// destroys the id.
+int fid_create(fid_t* id, void* data,
+               int (*on_error)(fid_t, void*, int));
+// Locks the id for exclusive use.  Returns 0 (data out), EINVAL if gone.
+int fid_lock(fid_t id, void** data);
+int fid_unlock(fid_t id);
+int fid_unlock_and_destroy(fid_t id);
+// Locks and runs on_error.  EINVAL if gone.
+int fid_error(fid_t id, int error_code);
+// Blocks until the id is destroyed (0 even if already gone).
+int fid_join(fid_t id);
+bool fid_exists(fid_t id);
+
+}  // namespace trpc

@@ -1,0 +1,100 @@
+// Fiber-local storage (parity: bthread_key_create/[gs]etspecific,
+// /root/reference/src/bthread/key.cpp — versioned keys so deleted keys
+// can't read stale values; destructors run at fiber exit).
+#include <mutex>
+#include <vector>
+
+#include "fiber/scheduler.h"
+
+namespace trpc {
+
+namespace {
+
+struct KeyInfo {
+  uint32_t version = 0;  // even = free, odd = live (like fiber versions)
+  void (*dtor)(void*) = nullptr;
+};
+
+std::mutex g_keys_mu;
+std::vector<KeyInfo> g_keys;
+std::vector<uint32_t> g_free_keys;
+
+}  // namespace
+
+int fls_key_create(fls_key_t* key, void (*dtor)(void*)) {
+  std::lock_guard<std::mutex> g(g_keys_mu);
+  uint32_t index;
+  if (!g_free_keys.empty()) {
+    index = g_free_keys.back();
+    g_free_keys.pop_back();
+  } else {
+    index = static_cast<uint32_t>(g_keys.size());
+    g_keys.emplace_back();
+  }
+  g_keys[index].version += 1;  // → odd (live)
+  g_keys[index].dtor = dtor;
+  key->index = index;
+  key->version = g_keys[index].version;
+  return 0;
+}
+
+int fls_key_delete(fls_key_t key) {
+  std::lock_guard<std::mutex> g(g_keys_mu);
+  if (key.index >= g_keys.size() || g_keys[key.index].version != key.version) {
+    return -1;
+  }
+  g_keys[key.index].version += 1;  // → even (free)
+  g_keys[key.index].dtor = nullptr;
+  g_free_keys.push_back(key.index);
+  return 0;
+}
+
+int fls_set(fls_key_t key, void* value) {
+  Worker* w = tls_worker;
+  if (w == nullptr || w->current() == nullptr) {
+    return -1;
+  }
+  FiberMeta* m = w->current();
+  if (m->fls.size() <= key.index) {
+    m->fls.resize(key.index + 1);
+  }
+  m->fls[key.index].value = value;
+  m->fls[key.index].version = key.version;
+  return 0;
+}
+
+void* fls_get(fls_key_t key) {
+  Worker* w = tls_worker;
+  if (w == nullptr || w->current() == nullptr) {
+    return nullptr;
+  }
+  FiberMeta* m = w->current();
+  if (m->fls.size() <= key.index ||
+      m->fls[key.index].version != key.version) {
+    return nullptr;
+  }
+  return m->fls[key.index].value;
+}
+
+void run_fls_destructors(FiberMeta* m) {
+  for (uint32_t i = 0; i < m->fls.size(); ++i) {
+    void* value = m->fls[i].value;
+    if (value == nullptr) {
+      continue;
+    }
+    void (*dtor)(void*) = nullptr;
+    {
+      std::lock_guard<std::mutex> g(g_keys_mu);
+      if (i < g_keys.size() && g_keys[i].version == m->fls[i].version) {
+        dtor = g_keys[i].dtor;
+      }
+    }
+    m->fls[i].value = nullptr;
+    if (dtor != nullptr) {
+      dtor(value);
+    }
+  }
+  m->fls.clear();
+}
+
+}  // namespace trpc

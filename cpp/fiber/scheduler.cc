@@ -1,0 +1,284 @@
+#include "fiber/scheduler.h"
+
+#include <linux/futex.h>
+#include <pthread.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <cstring>
+
+#include "base/logging.h"
+#include "base/rand.h"
+#include "base/resource_pool.h"
+#include "fiber/context.h"
+#include "fiber/event.h"
+
+namespace trpc {
+
+thread_local Worker* tls_worker = nullptr;
+
+namespace {
+
+int sys_futex(std::atomic<int>* addr, int op, int val) {
+  return syscall(SYS_futex, reinterpret_cast<int*>(addr), op, val, nullptr,
+                 nullptr, 0);
+}
+
+using FiberPool = ResourcePool<FiberMeta>;
+
+void requeue_post(void* a1, void*) {
+  Scheduler::instance()->ready_to_run(static_cast<FiberMeta*>(a1));
+}
+
+void finish_fiber_post(void* p, void*) {
+  FiberMeta* m = static_cast<FiberMeta*>(p);
+  const uint32_t ver = m->version.load(std::memory_order_relaxed);
+  release_stack(m->stack);
+  m->stack = StackMem{};
+  m->sp = nullptr;
+  // Even version = idle slot; the bumped done word releases joiners.  The
+  // meta is pool-recycled, never freed, so late joiners touching the event
+  // see the new value and return (type-stable memory, like TaskMeta).
+  m->version.store(ver + 1, std::memory_order_release);
+  m->done_event.value.store(ver + 1, std::memory_order_release);
+  m->done_event.wake_all();
+  FiberPool::instance()->release(m->slot);
+}
+
+void fiber_entry(void* p) {
+  FiberMeta* m = static_cast<FiberMeta*>(p);
+  m->fn(m->arg);
+  run_fls_destructors(m);
+  Worker* w = tls_worker;  // worker we ended on (may differ from start)
+  w->suspend_current(finish_fiber_post, m, nullptr);
+  CHECK(false) << "resumed a finished fiber";
+}
+
+}  // namespace
+
+FiberMeta* fiber_meta_of(fiber_t f) {
+  const uint32_t slot = static_cast<uint32_t>(f);
+  const uint32_t ver = static_cast<uint32_t>(f >> 32);
+  if ((ver & 1) == 0) {
+    return nullptr;
+  }
+  FiberMeta* m = FiberPool::instance()->at(slot);
+  if (m == nullptr || m->version.load(std::memory_order_acquire) != ver) {
+    return nullptr;
+  }
+  return m;
+}
+
+void ParkingLot::signal(int n) {
+  seq_.fetch_add(1, std::memory_order_release);
+  sys_futex(&seq_, FUTEX_WAKE_PRIVATE, n);
+}
+
+void ParkingLot::wait(int stamp) {
+  sys_futex(&seq_, FUTEX_WAIT_PRIVATE, stamp);
+}
+
+Scheduler* Scheduler::instance() {
+  static Scheduler s;
+  return &s;
+}
+
+void Scheduler::start(int workers) {
+  std::call_once(start_once_, [this, workers] {
+    int n = workers;
+    if (n <= 0) {
+      const long ncpu = sysconf(_SC_NPROCESSORS_ONLN);
+      n = std::max(4L, std::min(8L, ncpu));
+    }
+    n = std::min(n, kMaxWorkers);
+    for (int i = 0; i < n; ++i) {
+      workers_[i] = new Worker(this, i);
+      pthread_t tid;
+      pthread_create(
+          &tid, nullptr,
+          [](void* w) -> void* {
+            static_cast<Worker*>(w)->main_loop();
+            return nullptr;
+          },
+          workers_[i]);
+      pthread_detach(tid);
+    }
+    nworkers_.store(n, std::memory_order_release);
+  });
+}
+
+void Scheduler::ready_to_run(FiberMeta* m, bool urgent) {
+  Worker* w = tls_worker;
+  if (w != nullptr) {
+    if (urgent) {
+      // Claim the worker's one-deep priority slot; it runs before the queue.
+      FiberMeta* expect = nullptr;
+      if (w->urgent_.compare_exchange_strong(expect, m,
+                                             std::memory_order_acq_rel)) {
+        parking_lot.signal(2);
+        return;
+      }
+    }
+    if (!w->runq().push(m)) {
+      push_remote(m);
+    }
+  } else {
+    push_remote(m);
+  }
+  parking_lot.signal(urgent ? 2 : 1);
+}
+
+void Scheduler::push_remote(FiberMeta* m) {
+  std::lock_guard<std::mutex> g(remote_mu_);
+  remote_q_.push_back(m);
+}
+
+bool Scheduler::pop_remote(FiberMeta** out) {
+  std::lock_guard<std::mutex> g(remote_mu_);
+  if (remote_q_.empty()) {
+    return false;
+  }
+  *out = remote_q_.front();
+  remote_q_.pop_front();
+  return true;
+}
+
+bool Scheduler::steal(FiberMeta** out, Worker* thief) {
+  const int n = nworkers_.load(std::memory_order_acquire);
+  if (n <= 1) {
+    return false;
+  }
+  const uint64_t start = fast_rand_less_than(n);
+  for (int i = 0; i < n; ++i) {
+    Worker* victim = workers_[(start + i) % n];
+    if (victim == nullptr || victim == thief) {
+      continue;
+    }
+    if (victim->runq().steal(out)) {
+      return true;
+    }
+  }
+  return false;
+}
+
+Worker::Worker(Scheduler* sched, int index) : sched_(sched), index_(index) {}
+
+FiberMeta* Worker::pick_next() {
+  FiberMeta* m = urgent_.exchange(nullptr, std::memory_order_acq_rel);
+  if (m != nullptr) {
+    return m;
+  }
+  if (runq_.pop(&m)) {
+    return m;
+  }
+  if (sched_->pop_remote(&m)) {
+    return m;
+  }
+  if (sched_->steal(&m, this)) {
+    return m;
+  }
+  return nullptr;
+}
+
+void Worker::run_fiber(FiberMeta* m) {
+  current_ = m;
+  trpc_jump_context(&sched_sp_, m->sp, m);
+  current_ = nullptr;
+  if (post_fn_ != nullptr) {
+    PostSwitchFn fn = post_fn_;
+    post_fn_ = nullptr;
+    fn(post_a1_, post_a2_);
+  }
+}
+
+void Worker::suspend_current(PostSwitchFn post_fn, void* a1, void* a2) {
+  FiberMeta* m = current_;
+  post_fn_ = post_fn;
+  post_a1_ = a1;
+  post_a2_ = a2;
+  trpc_jump_context(&m->sp, sched_sp_, nullptr);
+  // Resumed (possibly on another worker's scheduler context).
+}
+
+void Worker::main_loop() {
+  tls_worker = this;
+  while (true) {
+    FiberMeta* m = pick_next();
+    if (m != nullptr) {
+      run_fiber(m);
+      continue;
+    }
+    const int stamp = sched_->parking_lot.stamp();
+    m = pick_next();  // re-check after stamp: closes the missed-signal window
+    if (m != nullptr) {
+      run_fiber(m);
+      continue;
+    }
+    sched_->parking_lot.wait(stamp);
+  }
+}
+
+// ---- public API ---------------------------------------------------------
+
+void fiber_init(int workers) { Scheduler::instance()->start(workers); }
+
+int fiber_worker_count() { return Scheduler::instance()->worker_count(); }
+
+int fiber_start(fiber_t* out, void (*fn)(void*), void* arg, int flags) {
+  Scheduler* sched = Scheduler::instance();
+  if (!sched->started()) {
+    sched->start(0);
+  }
+  FiberMeta* m = nullptr;
+  const uint32_t slot = FiberPool::instance()->acquire(&m);
+  if (m == nullptr) {
+    return -1;
+  }
+  m->slot = slot;
+  m->fn = fn;
+  m->arg = arg;
+  const uint32_t ver = m->version.load(std::memory_order_relaxed) + 1;  // odd
+  m->done_event.value.store(ver, std::memory_order_relaxed);
+  m->version.store(ver, std::memory_order_relaxed);
+  m->stack = allocate_stack(kDefaultStackSize);
+  m->sp = make_context(m->stack.base, m->stack.size, fiber_entry);
+  if (out != nullptr) {
+    *out = m->id();
+  }
+  sched->ready_to_run(m, (flags & kFiberUrgent) != 0);
+  return 0;
+}
+
+int fiber_join(fiber_t f) {
+  const uint32_t ver = static_cast<uint32_t>(f >> 32);
+  FiberMeta* m = fiber_meta_of(f);
+  if (m == nullptr) {
+    return 0;  // already gone (or never existed)
+  }
+  // The done event's value holds the live version until exit bumps it; the
+  // meta is type-stable, so waiting on a recycled slot just returns.
+  while (m->done_event.value.load(std::memory_order_acquire) == ver) {
+    m->done_event.wait(ver, -1);
+  }
+  return 0;
+}
+
+bool fiber_exists(fiber_t f) { return fiber_meta_of(f) != nullptr; }
+
+fiber_t fiber_self() {
+  Worker* w = tls_worker;
+  return (w != nullptr && w->current() != nullptr) ? w->current()->id() : 0;
+}
+
+bool in_fiber() { return tls_worker != nullptr && tls_worker->current() != nullptr; }
+
+void fiber_yield() {
+  Worker* w = tls_worker;
+  if (w == nullptr || w->current() == nullptr) {
+    sched_yield();
+    return;
+  }
+  w->suspend_current(requeue_post, w->current(), nullptr);
+}
+
+}  // namespace trpc

@@ -1,0 +1,122 @@
+// Scheduler internals shared by event/timer/sync (not user-facing).
+//
+// Parity map: Scheduler ≈ bthread TaskControl (task_control.h:46), Worker ≈
+// TaskGroup (task_group.h), FiberMeta ≈ TaskMeta, ParkingLot ≈ parking_lot.h.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+#include "fiber/event.h"
+#include "fiber/fiber.h"
+#include "fiber/stack.h"
+#include "fiber/wsqueue.h"
+
+namespace trpc {
+
+class Worker;
+
+// Deferred action run by the scheduler AFTER the fiber's context has been
+// switched away — the publish-after-switch pattern that closes the
+// "woken before fully suspended" race (parity: TaskGroup::set_remained,
+// task_group.h:124).
+using PostSwitchFn = void (*)(void* arg1, void* arg2);
+
+struct FiberMeta {
+  void (*fn)(void*) = nullptr;
+  void* arg = nullptr;
+  void* sp = nullptr;  // suspended continuation
+  StackMem stack;
+  // Even = idle slot; odd = live fiber.  The version half of fiber_t.
+  std::atomic<uint32_t> version{0};
+  // Join event: value holds the live version while running; bumped at exit.
+  Event done_event;
+  struct FlsSlot {
+    void* value = nullptr;
+    uint32_t version = 0;
+  };
+  std::vector<FlsSlot> fls;
+  uint32_t slot = 0;  // own index in the pool
+
+  fiber_t id() const {
+    return (static_cast<uint64_t>(version.load(std::memory_order_relaxed))
+            << 32) |
+           slot;
+  }
+};
+
+FiberMeta* fiber_meta_of(fiber_t f);  // nullptr if stale/invalid
+void run_fls_destructors(FiberMeta* m);
+
+class ParkingLot {
+ public:
+  // Returns a stamp to pass to wait().
+  int stamp() const { return seq_.load(std::memory_order_acquire); }
+  void signal(int n);
+  void wait(int stamp);
+
+ private:
+  std::atomic<int> seq_{0};
+};
+
+class Scheduler {
+ public:
+  static Scheduler* instance();
+  void start(int workers);
+  bool started() const { return nworkers_.load(std::memory_order_acquire) > 0; }
+  int worker_count() const { return nworkers_.load(std::memory_order_acquire); }
+
+  // Make a runnable fiber visible to some worker (from any thread).
+  void ready_to_run(FiberMeta* m, bool urgent = false);
+  bool steal(FiberMeta** out, Worker* thief);
+  bool pop_remote(FiberMeta** out);
+  void push_remote(FiberMeta* m);
+
+  ParkingLot parking_lot;
+
+ private:
+  Scheduler() = default;
+  static constexpr int kMaxWorkers = 64;
+  Worker* workers_[kMaxWorkers] = {};
+  std::atomic<int> nworkers_{0};
+  std::mutex remote_mu_;
+  std::deque<FiberMeta*> remote_q_;
+  std::once_flag start_once_;
+};
+
+class Worker {
+ public:
+  explicit Worker(Scheduler* sched, int index);
+  void main_loop();  // pthread entry
+
+  // Called from a running fiber: switch back to the scheduler context.
+  // post_fn(arg1, arg2) runs on the scheduler context after the switch.
+  void suspend_current(PostSwitchFn post_fn, void* a1, void* a2);
+
+  FiberMeta* current() const { return current_; }
+  WorkStealingQueue<FiberMeta*>& runq() { return runq_; }
+  int index() const { return index_; }
+
+ private:
+  friend class Scheduler;
+  FiberMeta* pick_next();
+  void run_fiber(FiberMeta* m);
+
+  Scheduler* sched_;
+  int index_;
+  // One-deep priority slot checked before the run queue (kFiberUrgent).
+  std::atomic<FiberMeta*> urgent_{nullptr};
+  WorkStealingQueue<FiberMeta*> runq_;
+  FiberMeta* current_ = nullptr;
+  void* sched_sp_ = nullptr;  // scheduler continuation while a fiber runs
+  PostSwitchFn post_fn_ = nullptr;
+  void* post_a1_ = nullptr;
+  void* post_a2_ = nullptr;
+};
+
+extern thread_local Worker* tls_worker;
+
+}  // namespace trpc

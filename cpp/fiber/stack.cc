@@ -1,0 +1,75 @@
+#include "fiber/stack.h"
+
+#include <sys/mman.h>
+#include <unistd.h>
+
+#include <vector>
+
+#include "base/logging.h"
+#include "fiber/context.h"
+
+namespace trpc {
+
+namespace {
+
+struct TlsStackCache {
+  std::vector<StackMem> stacks;
+  ~TlsStackCache() {
+    for (StackMem& s : stacks) {
+      munmap(s.base, s.size);
+    }
+  }
+};
+
+thread_local TlsStackCache g_stack_cache;
+constexpr size_t kMaxCachedStacks = 32;
+
+}  // namespace
+
+StackMem allocate_stack(size_t size) {
+  if (!g_stack_cache.stacks.empty()) {
+    StackMem s = g_stack_cache.stacks.back();
+    g_stack_cache.stacks.pop_back();
+    if (s.size == size) {
+      return s;
+    }
+    munmap(s.base, s.size);
+  }
+  const size_t page = static_cast<size_t>(sysconf(_SC_PAGESIZE));
+  void* mem = mmap(nullptr, size, PROT_READ | PROT_WRITE,
+                   MAP_PRIVATE | MAP_ANONYMOUS | MAP_STACK, -1, 0);
+  CHECK(mem != MAP_FAILED) << "stack mmap failed";
+  // Guard page at the low end catches overflow.
+  CHECK(mprotect(mem, page, PROT_NONE) == 0);
+  return StackMem{mem, size};
+}
+
+void release_stack(StackMem s) {
+  if (g_stack_cache.stacks.size() < kMaxCachedStacks) {
+    g_stack_cache.stacks.push_back(s);
+    return;
+  }
+  munmap(s.base, s.size);
+}
+
+extern "C" void trpc_context_trampoline();
+
+void* make_context(void* stack_base, size_t size, void (*entry)(void*)) {
+  uintptr_t top = (reinterpret_cast<uintptr_t>(stack_base) + size) & ~15ull;
+  // Layout (context.S): 64 bytes — fpu word, 6 regs, ret addr.
+  uint64_t* frame = reinterpret_cast<uint64_t*>(top - 64);
+  uint32_t mxcsr = 0;
+  uint16_t fcw = 0;
+  __asm__ volatile("stmxcsr %0; fnstcw %1" : "=m"(mxcsr), "=m"(fcw));
+  frame[0] = static_cast<uint64_t>(mxcsr) | (static_cast<uint64_t>(fcw) << 32);
+  frame[1] = 0;                                     // r15
+  frame[2] = 0;                                     // r14
+  frame[3] = 0;                                     // r13
+  frame[4] = 0;                                     // r12
+  frame[5] = reinterpret_cast<uint64_t>(entry);     // rbx → trampoline target
+  frame[6] = 0;                                     // rbp
+  frame[7] = reinterpret_cast<uint64_t>(&trpc_context_trampoline);
+  return frame;
+}
+
+}  // namespace trpc

@@ -1,0 +1,19 @@
+// Fiber stacks: mmap'd with a low guard page, cached per thread.
+// Parity: bthread stacks (/root/reference/src/bthread/stack.h:56-73).
+#pragma once
+
+#include <cstddef>
+
+namespace trpc {
+
+struct StackMem {
+  void* base = nullptr;
+  size_t size = 0;
+};
+
+constexpr size_t kDefaultStackSize = 256 * 1024;
+
+StackMem allocate_stack(size_t size);
+void release_stack(StackMem s);
+
+}  // namespace trpc

@@ -1,0 +1,87 @@
+// Fiber-aware synchronization built on Event (parity: bthread mutex /
+// condition / countdown_event, /root/reference/src/bthread/mutex.cpp,
+// countdown_event.cpp — blocking parks the fiber, never the worker pthread).
+#pragma once
+
+#include <cerrno>
+
+#include "fiber/event.h"
+
+namespace trpc {
+
+// Futex-style mutex: 0 unlocked, 1 locked, 2 locked with waiters.
+class FiberMutex {
+ public:
+  void lock() {
+    uint32_t c = 0;
+    if (ev_.value.compare_exchange_strong(c, 1, std::memory_order_acquire,
+                                          std::memory_order_relaxed)) {
+      return;
+    }
+    do {
+      if (c == 2 ||
+          ev_.value.compare_exchange_strong(c, 2, std::memory_order_acquire,
+                                            std::memory_order_relaxed)) {
+        ev_.wait(2, -1);
+      }
+      c = 0;
+    } while (!ev_.value.compare_exchange_strong(c, 2,
+                                                std::memory_order_acquire,
+                                                std::memory_order_relaxed));
+  }
+
+  bool try_lock() {
+    uint32_t c = 0;
+    return ev_.value.compare_exchange_strong(c, 1, std::memory_order_acquire,
+                                             std::memory_order_relaxed);
+  }
+
+  void unlock() {
+    if (ev_.value.exchange(0, std::memory_order_release) == 2) {
+      ev_.wake(1);
+    }
+  }
+
+ private:
+  Event ev_;
+};
+
+// Countdown latch (parity: bthread::CountdownEvent).
+class CountdownEvent {
+ public:
+  explicit CountdownEvent(int count) : count_(count) { ev_.value.store(0); }
+
+  void signal(int n = 1) {
+    if (count_.fetch_sub(n, std::memory_order_acq_rel) <= n) {
+      ev_.value.store(1, std::memory_order_release);
+      ev_.wake_all();
+    }
+  }
+
+  // Returns 0, or ETIMEDOUT.
+  int wait(int64_t deadline_us = -1) {
+    while (count_.load(std::memory_order_acquire) > 0) {
+      const int rc = ev_.wait(0, deadline_us);
+      if (rc == ETIMEDOUT) {
+        return rc;
+      }
+    }
+    return 0;
+  }
+
+ private:
+  std::atomic<int> count_;
+  Event ev_;
+};
+
+template <typename Mutex>
+class LockGuard {
+ public:
+  explicit LockGuard(Mutex& mu) : mu_(mu) { mu_.lock(); }
+  ~LockGuard() { mu_.unlock(); }
+
+ private:
+  Mutex& mu_;
+};
+
+}  // namespace trpc

@@ -1,0 +1,98 @@
+#include "fiber/timer.h"
+
+#include <pthread.h>
+
+#include <condition_variable>
+#include <mutex>
+#include <queue>
+#include <unordered_set>
+#include <vector>
+
+#include "base/time.h"
+
+namespace trpc {
+
+struct TimerEntry {
+  int64_t deadline_us;
+  uint64_t id;
+  TimerThread::Fn fn;
+  void* arg;
+  bool operator>(const TimerEntry& o) const {
+    return deadline_us > o.deadline_us;
+  }
+};
+
+struct TimerThread::Impl {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::priority_queue<TimerEntry, std::vector<TimerEntry>,
+                      std::greater<TimerEntry>>
+      heap;
+  std::unordered_set<uint64_t> pending;
+  uint64_t next_id = 1;
+};
+
+TimerThread* TimerThread::instance() {
+  static TimerThread t;
+  return &t;
+}
+
+TimerThread::TimerThread() : impl_(new Impl) {
+  pthread_t tid;
+  pthread_create(
+      &tid, nullptr,
+      [](void* self) -> void* {
+        static_cast<TimerThread*>(self)->run();
+        return nullptr;
+      },
+      this);
+  pthread_detach(tid);
+}
+
+uint64_t TimerThread::schedule(int64_t deadline_us, Fn fn, void* arg) {
+  std::unique_lock<std::mutex> g(impl_->mu);
+  const uint64_t id = impl_->next_id++;
+  impl_->heap.push(TimerEntry{deadline_us, id, fn, arg});
+  impl_->pending.insert(id);
+  // Wake the loop if the new timer is the earliest.
+  if (impl_->heap.top().id == id) {
+    impl_->cv.notify_one();
+  }
+  return id;
+}
+
+bool TimerThread::unschedule(uint64_t id) {
+  std::lock_guard<std::mutex> g(impl_->mu);
+  return impl_->pending.erase(id) > 0;  // heap entry skipped lazily
+}
+
+void TimerThread::run() {
+  std::unique_lock<std::mutex> g(impl_->mu);
+  while (true) {
+    while (!impl_->heap.empty()) {
+      TimerEntry top = impl_->heap.top();
+      if (impl_->pending.count(top.id) == 0) {  // cancelled
+        impl_->heap.pop();
+        continue;
+      }
+      const int64_t now = monotonic_time_us();
+      if (top.deadline_us > now) {
+        break;
+      }
+      impl_->heap.pop();
+      impl_->pending.erase(top.id);
+      g.unlock();
+      top.fn(top.arg);
+      g.lock();
+    }
+    if (impl_->heap.empty()) {
+      impl_->cv.wait(g);
+    } else {
+      impl_->cv.wait_for(g, std::chrono::microseconds(
+                                impl_->heap.top().deadline_us -
+                                monotonic_time_us()));
+    }
+  }
+}
+
+}  // namespace trpc

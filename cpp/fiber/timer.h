@@ -1,0 +1,32 @@
+// TimerThread — one global timing pthread.
+//
+// Parity: bthread TimerThread (/root/reference/src/bthread/timer_thread.h:53)
+// which backs RPC deadlines and sleeps.  Re-designed: mutex+condvar min-heap
+// with a pending-id set for O(1) lazy cancellation (the reference hashes
+// timers into buckets).
+#pragma once
+
+#include <cstdint>
+
+namespace trpc {
+
+class TimerThread {
+ public:
+  using Fn = void (*)(void*);
+
+  static TimerThread* instance();
+
+  // Runs fn(arg) at monotonic deadline_us (in the timer thread; keep it
+  // cheap — typically just an Event::wake).  Returns a cancellation id.
+  uint64_t schedule(int64_t deadline_us, Fn fn, void* arg);
+  // True if the timer was removed before firing (fn will NOT run).
+  bool unschedule(uint64_t id);
+
+ private:
+  TimerThread();
+  void run();
+  struct Impl;
+  Impl* impl_;
+};
+
+}  // namespace trpc

@@ -1,0 +1,92 @@
+// Chase-Lev work-stealing deque (single owner push/pop at the bottom,
+// concurrent thieves steal at the top).
+// Parity: bthread WorkStealingQueue
+// (/root/reference/src/bthread/work_stealing_queue.h:32).
+#pragma once
+
+#include <atomic>
+#include <cstddef>
+
+namespace trpc {
+
+template <typename T>
+class WorkStealingQueue {
+ public:
+  explicit WorkStealingQueue(size_t cap = 8192)
+      : cap_(cap), mask_(cap - 1), buf_(new std::atomic<T>[cap]) {
+    static_assert(sizeof(T) <= sizeof(void*), "T must be pointer-sized");
+  }
+  ~WorkStealingQueue() { delete[] buf_; }
+
+  // Owner only.  Returns false when full.
+  bool push(T item) {
+    const size_t b = bottom_.load(std::memory_order_relaxed);
+    const size_t t = top_.load(std::memory_order_acquire);
+    if (b - t >= cap_) {
+      return false;
+    }
+    buf_[b & mask_].store(item, std::memory_order_relaxed);
+    bottom_.store(b + 1, std::memory_order_release);
+    return true;
+  }
+
+  // Owner only.
+  bool pop(T* out) {
+    size_t b = bottom_.load(std::memory_order_relaxed);
+    const size_t t0 = top_.load(std::memory_order_relaxed);
+    if (t0 >= b) {
+      return false;
+    }
+    b -= 1;
+    bottom_.store(b, std::memory_order_relaxed);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    size_t t = top_.load(std::memory_order_relaxed);
+    if (t > b) {  // emptied by thieves
+      bottom_.store(b + 1, std::memory_order_relaxed);
+      return false;
+    }
+    T item = buf_[b & mask_].load(std::memory_order_relaxed);
+    if (t == b) {  // last element: race with thieves via CAS on top
+      if (!top_.compare_exchange_strong(t, t + 1, std::memory_order_seq_cst,
+                                        std::memory_order_relaxed)) {
+        bottom_.store(b + 1, std::memory_order_relaxed);
+        return false;
+      }
+      bottom_.store(b + 1, std::memory_order_relaxed);
+    }
+    *out = item;
+    return true;
+  }
+
+  // Any thread.
+  bool steal(T* out) {
+    size_t t = top_.load(std::memory_order_acquire);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    const size_t b = bottom_.load(std::memory_order_acquire);
+    if (t >= b) {
+      return false;
+    }
+    T item = buf_[t & mask_].load(std::memory_order_relaxed);
+    if (!top_.compare_exchange_strong(t, t + 1, std::memory_order_seq_cst,
+                                      std::memory_order_relaxed)) {
+      return false;  // lost the race; caller retries elsewhere
+    }
+    *out = item;
+    return true;
+  }
+
+  size_t approx_size() const {
+    const size_t b = bottom_.load(std::memory_order_relaxed);
+    const size_t t = top_.load(std::memory_order_relaxed);
+    return b > t ? b - t : 0;
+  }
+
+ private:
+  const size_t cap_;
+  const size_t mask_;
+  std::atomic<T>* buf_;
+  alignas(64) std::atomic<size_t> top_{1};
+  alignas(64) std::atomic<size_t> bottom_{1};
+};
+
+}  // namespace trpc

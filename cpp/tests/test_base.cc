@@ -192,9 +192,16 @@ TEST_CASE(endpoint_parse_format) {
 
   EXPECT(str2endpoint("nonsense", &ep) != 0);
   EXPECT(str2endpoint("1.2.3.4:99999", &ep) != 0);
+  EXPECT(str2endpoint("1.2.3.4:80oops", &ep) != 0);
+  EXPECT(str2endpoint("1.2.3.4:80/3junk", &ep) != 0);
+  EXPECT(hostname2endpoint("1.2.3.4:99999", &ep) != 0);
+  EXPECT(hostname2endpoint("localhost:-5", &ep) != 0);
+  EXPECT(hostname2endpoint("localhost:abc", &ep) != 0);
 
   EXPECT_EQ(hostname2endpoint("localhost:80", &ep), 0);
   EXPECT(endpoint2str(ep) == "127.0.0.1:80");
+  EXPECT_EQ(hostname2endpoint("localhost:80/3", &ep), 0);
+  EXPECT_EQ(ep.device_ordinal, 3);
 
   sockaddr_in sa = endpoint2sockaddr(ep);
   EndPoint back = sockaddr2endpoint(sa);

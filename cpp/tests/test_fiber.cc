@@ -1,0 +1,301 @@
+// L2 fiber runtime unit tests (parity model: the reference's
+// test/bthread_*_unittest.cpp matrix — start/join, butex, mutex, sleep,
+// work stealing, fls).
+#include <unistd.h>
+
+#include <atomic>
+#include <thread>
+#include <vector>
+
+#include "base/time.h"
+#include "fiber/event.h"
+#include "fiber/execution_queue.h"
+#include "fiber/fiber.h"
+#include "fiber/fid.h"
+#include "fiber/sync.h"
+#include "fiber/timer.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+TEST_CASE(start_and_join) {
+  fiber_init(4);
+  static std::atomic<int> ran{0};
+  fiber_t f;
+  EXPECT_EQ(fiber_start(&f, [](void*) { ran.fetch_add(1); }, nullptr), 0);
+  EXPECT_EQ(fiber_join(f), 0);
+  EXPECT_EQ(ran.load(), 1);
+  EXPECT(!fiber_exists(f));
+  EXPECT_EQ(fiber_join(f), 0);  // joining a finished fiber is a no-op
+}
+
+TEST_CASE(many_fibers) {
+  static std::atomic<int> count{0};
+  count = 0;
+  std::vector<fiber_t> ids(2000);
+  for (auto& f : ids) {
+    EXPECT_EQ(fiber_start(&f, [](void*) { count.fetch_add(1); }, nullptr), 0);
+  }
+  for (auto& f : ids) {
+    fiber_join(f);
+  }
+  EXPECT_EQ(count.load(), 2000);
+}
+
+TEST_CASE(yield_interleaves) {
+  static std::atomic<int> progress{0};
+  fiber_t f;
+  fiber_start(&f, [](void*) {
+    for (int i = 0; i < 10; ++i) {
+      progress.fetch_add(1);
+      fiber_yield();
+    }
+  }, nullptr);
+  fiber_join(f);
+  EXPECT_EQ(progress.load(), 10);
+}
+
+TEST_CASE(nested_fibers) {
+  static std::atomic<int> total{0};
+  total = 0;
+  fiber_t f;
+  fiber_start(&f, [](void*) {
+    fiber_t inner[10];
+    for (auto& g : inner) {
+      fiber_start(&g, [](void*) { total.fetch_add(1); }, nullptr);
+    }
+    for (auto& g : inner) {
+      fiber_join(g);  // join from inside a fiber parks, not blocks
+    }
+    total.fetch_add(100);
+  }, nullptr);
+  fiber_join(f);
+  EXPECT_EQ(total.load(), 110);
+}
+
+TEST_CASE(sleep_wakes_on_time) {
+  static std::atomic<int64_t> slept_us{0};
+  fiber_t f;
+  fiber_start(&f, [](void*) {
+    const int64_t t0 = monotonic_time_us();
+    fiber_sleep_us(20000);
+    slept_us.store(monotonic_time_us() - t0);
+  }, nullptr);
+  fiber_join(f);
+  EXPECT(slept_us.load() >= 19000);
+  EXPECT(slept_us.load() < 500000);
+}
+
+TEST_CASE(event_wake_from_pthread) {
+  static Event ev;
+  static std::atomic<int> woke{0};
+  ev.value.store(0);
+  woke = 0;
+  fiber_t f;
+  fiber_start(&f, [](void*) {
+    while (ev.value.load() == 0) {
+      ev.wait(0, -1);
+    }
+    woke.fetch_add(1);
+  }, nullptr);
+  usleep(20000);
+  EXPECT_EQ(woke.load(), 0);  // parked, not finished
+  ev.value.store(1);
+  ev.wake_all();
+  fiber_join(f);
+  EXPECT_EQ(woke.load(), 1);
+}
+
+TEST_CASE(event_pthread_waiter) {
+  static Event ev;
+  ev.value.store(0);
+  std::thread waker([&] {
+    usleep(10000);
+    ev.value.store(7);
+    ev.wake_all();
+  });
+  while (ev.value.load() == 0) {
+    const int rc = ev.wait(0, -1);  // pthread path (not on a fiber)
+    (void)rc;
+  }
+  EXPECT_EQ(ev.value.load(), 7u);
+  waker.join();
+}
+
+TEST_CASE(event_timeout) {
+  static Event ev;
+  ev.value.store(0);
+  // pthread path
+  const int64_t t0 = monotonic_time_us();
+  const int rc = ev.wait(0, monotonic_time_us() + 30000);
+  EXPECT_EQ(rc, ETIMEDOUT);
+  EXPECT(monotonic_time_us() - t0 >= 29000);
+  // fiber path
+  static std::atomic<int> frc{-1};
+  fiber_t f;
+  fiber_start(&f, [](void*) {
+    frc.store(ev.wait(0, monotonic_time_us() + 30000));
+  }, nullptr);
+  fiber_join(f);
+  EXPECT_EQ(frc.load(), ETIMEDOUT);
+}
+
+TEST_CASE(fiber_mutex_contention) {
+  static FiberMutex mu;
+  static int counter = 0;
+  counter = 0;
+  std::vector<fiber_t> ids(64);
+  for (auto& f : ids) {
+    fiber_start(&f, [](void*) {
+      for (int i = 0; i < 100; ++i) {
+        LockGuard<FiberMutex> g(mu);
+        counter += 1;  // data race iff mutex broken
+      }
+    }, nullptr);
+  }
+  for (auto& f : ids) {
+    fiber_join(f);
+  }
+  EXPECT_EQ(counter, 6400);
+}
+
+TEST_CASE(countdown_event) {
+  static CountdownEvent latch(5);
+  for (int i = 0; i < 5; ++i) {
+    fiber_t f;
+    fiber_start(&f, [](void*) { latch.signal(); }, nullptr);
+  }
+  EXPECT_EQ(latch.wait(monotonic_time_us() + 1000000), 0);
+}
+
+TEST_CASE(timer_fires_and_cancels) {
+  static std::atomic<int> fired{0};
+  fired = 0;
+  TimerThread::instance()->schedule(monotonic_time_us() + 10000,
+                                    [](void*) { fired.fetch_add(1); },
+                                    nullptr);
+  const uint64_t id2 = TimerThread::instance()->schedule(
+      monotonic_time_us() + 10000, [](void*) { fired.fetch_add(100); },
+      nullptr);
+  EXPECT(TimerThread::instance()->unschedule(id2));
+  usleep(60000);
+  EXPECT_EQ(fired.load(), 1);
+  EXPECT(!TimerThread::instance()->unschedule(id2));  // already gone
+}
+
+TEST_CASE(fls_basic) {
+  static fls_key_t key;
+  static std::atomic<int> dtor_runs{0};
+  EXPECT_EQ(fls_key_create(&key, [](void* v) {
+    dtor_runs.fetch_add(static_cast<int>(reinterpret_cast<intptr_t>(v)));
+  }), 0);
+  fiber_t f;
+  fiber_start(&f, [](void*) {
+    EXPECT(fls_get(key) == nullptr);
+    fls_set(key, reinterpret_cast<void*>(7));
+    fiber_yield();  // survives suspension
+    EXPECT(fls_get(key) == reinterpret_cast<void*>(7));
+  }, nullptr);
+  fiber_join(f);
+  EXPECT_EQ(dtor_runs.load(), 7);  // destructor ran at fiber exit
+  EXPECT_EQ(fls_key_delete(key), 0);
+  EXPECT_EQ(fls_key_delete(key), -1);  // stale key rejected
+}
+
+TEST_CASE(execution_queue_serializes) {
+  static ExecutionQueue<int> q;
+  static std::vector<int> seen;
+  static FiberMutex seen_mu;
+  seen.clear();
+  q.start(
+      [](void*, int* items, size_t n) -> int {
+        LockGuard<FiberMutex> g(seen_mu);
+        for (size_t i = 0; i < n; ++i) {
+          seen.push_back(items[i]);
+        }
+        return 0;
+      },
+      nullptr);
+  std::vector<std::thread> producers;
+  for (int t = 0; t < 4; ++t) {
+    producers.emplace_back([t] {
+      for (int i = 0; i < 100; ++i) {
+        q.execute(t * 1000 + i);
+      }
+    });
+  }
+  for (auto& th : producers) {
+    th.join();
+  }
+  for (int spin = 0; spin < 1000 && !q.idle(); ++spin) {
+    usleep(1000);
+  }
+  EXPECT(q.idle());
+  EXPECT_EQ(seen.size(), 400u);
+  // Per-producer FIFO order must be preserved.
+  int last[4] = {-1, -1, -1, -1};
+  for (int v : seen) {
+    const int t = v / 1000;
+    EXPECT(v % 1000 > last[t]);
+    last[t] = v % 1000;
+  }
+}
+
+TEST_CASE(fid_lifecycle) {
+  fid_t id;
+  static std::atomic<int> errors{0};
+  EXPECT_EQ(fid_create(&id, reinterpret_cast<void*>(0x42),
+                       [](fid_t i, void*, int code) -> int {
+                         errors.fetch_add(code);
+                         return fid_unlock_and_destroy(i);
+                       }),
+            0);
+  EXPECT(fid_exists(id));
+  void* data = nullptr;
+  EXPECT_EQ(fid_lock(id, &data), 0);
+  EXPECT(data == reinterpret_cast<void*>(0x42));
+  EXPECT_EQ(fid_unlock(id), 0);
+
+  // join from a fiber while another errors the id.
+  static fid_t shared_id;
+  shared_id = id;
+  fiber_t joiner;
+  static std::atomic<bool> joined{false};
+  joined = false;
+  fiber_start(&joiner, [](void*) {
+    fid_join(shared_id);
+    joined.store(true);
+  }, nullptr);
+  usleep(20000);
+  EXPECT(!joined.load());
+  EXPECT_EQ(fid_error(id, 5), 0);  // on_error destroys
+  fiber_join(joiner);
+  EXPECT(joined.load());
+  EXPECT_EQ(errors.load(), 5);
+  EXPECT(!fid_exists(id));
+  EXPECT_EQ(fid_lock(id, &data), EINVAL);  // stale id rejected
+  EXPECT_EQ(fid_join(id), 0);              // joining dead id returns
+}
+
+TEST_CASE(cross_thread_start) {
+  // Fibers startable from plain pthreads (remote queue path).
+  static std::atomic<int> done{0};
+  done = 0;
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 4; ++t) {
+    threads.emplace_back([] {
+      for (int i = 0; i < 50; ++i) {
+        fiber_t f;
+        EXPECT_EQ(fiber_start(&f, [](void*) { done.fetch_add(1); }, nullptr),
+                  0);
+        fiber_join(f);
+      }
+    });
+  }
+  for (auto& th : threads) {
+    th.join();
+  }
+  EXPECT_EQ(done.load(), 200);
+}
+
+TEST_MAIN

@@ -13,24 +13,12 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 BUILD = REPO / "build"
 
 
-def _build():
-    subprocess.run(
-        ["cmake", "-S", str(REPO / "cpp"), "-B", str(BUILD), "-DCMAKE_BUILD_TYPE=RelWithDebInfo"],
-        check=True,
-        capture_output=True,
-    )
-    subprocess.run(
-        ["cmake", "--build", str(BUILD), "-j", "2"],
-        check=True,
-        capture_output=True,
-        text=True,
-    )
-
-
 @pytest.fixture(scope="session", autouse=True)
 def built():
+    from brpc_tpu.rpc._lib import ensure_built
+
     try:
-        _build()
+        ensure_built(all_targets=True)
     except subprocess.CalledProcessError as e:
         pytest.fail(f"C++ build failed:\n{e.stdout}\n{e.stderr}")
 
@@ -44,3 +32,7 @@ def _run(binary, timeout=120):
 
 def test_base():
     _run("test_base")
+
+
+def test_fiber():
+    _run("test_fiber")
