@@ -41,11 +41,12 @@ def ensure_built(all_targets: bool = False) -> None:
         ["cmake", "-S", str(_REPO / "cpp"), "-B", str(_BUILD)],
         check=True,
         capture_output=True,
+        text=True,
     )
     cmd = ["cmake", "--build", str(_BUILD), "-j", "2"]
     if not all_targets:
         cmd += ["--target", "tpurpc"]
-    subprocess.run(cmd, check=True, capture_output=True)
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
 
 
 _ensure_built = ensure_built

@@ -1,0 +1,173 @@
+#include "net/channel.h"
+
+#include <errno.h>
+
+#include "base/logging.h"
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "fiber/timer.h"
+#include "net/messenger.h"
+#include "net/protocol.h"
+
+namespace trpc {
+
+namespace {
+
+// Completes a call that is currently LOCKED via its fid: records latency,
+// cancels the timeout timer, destroys the id (waking sync joiners) and runs
+// the async done.  Mirrors Controller::OnVersionedRPCReturned ordering
+// (controller.cpp:611): state is finalized before anyone can observe it.
+void complete_locked_call(fid_t cid, Controller* cntl) {
+  cntl->set_latency_us(monotonic_time_us() - cntl->call().start_us);
+  const uint64_t timer = cntl->call().timeout_timer;
+  Closure done = std::move(cntl->call().done);
+  fid_unlock_and_destroy(cid);
+  if (timer != 0) {
+    TimerThread::instance()->unschedule(timer);
+  }
+  if (done) {
+    done();
+  }
+}
+
+int on_call_error(fid_t cid, void* data, int code) {
+  Controller* cntl = static_cast<Controller*>(data);
+  cntl->SetFailed(code, code == ETIMEDOUT ? "rpc timeout" : "rpc failed");
+  complete_locked_call(cid, cntl);
+  return 0;
+}
+
+void timeout_fiber(void* arg) {
+  fid_error(reinterpret_cast<fid_t>(arg), ETIMEDOUT);
+}
+
+// Runs on the TimerThread: must stay cheap (timer.h contract).  The actual
+// completion — fid locking and the user's done() — moves to a fiber.
+void timeout_cb(void* arg) {
+  fiber_start(nullptr, timeout_fiber, arg, 0);
+}
+
+}  // namespace
+
+// Response path installed into the tstd protocol (messenger dispatch).
+void tstd_process_response(InputMessage&& msg) {
+  const fid_t cid = msg.meta.correlation_id;
+  void* data = nullptr;
+  if (fid_lock(cid, &data) != 0) {
+    return;  // stale response (timed out / retried away): harmless
+  }
+  Controller* cntl = static_cast<Controller*>(data);
+  if (msg.meta.error_code != 0) {
+    cntl->SetFailed(msg.meta.error_code, msg.meta.error_text);
+  } else {
+    IOBuf payload = std::move(msg.payload);
+    if (msg.meta.attachment_size > 0 &&
+        msg.meta.attachment_size <= payload.size()) {
+      IOBuf body;
+      payload.cutn(&body, payload.size() - msg.meta.attachment_size);
+      cntl->response_attachment() = std::move(payload);
+      payload = std::move(body);
+    }
+    if (cntl->call().response != nullptr) {
+      *cntl->call().response = std::move(payload);
+    }
+  }
+  complete_locked_call(cid, cntl);
+}
+
+int Channel::Init(const std::string& addr, const Options* opts) {
+  fiber_init(0);
+  tstd_protocol();
+  if (opts != nullptr) {
+    opts_ = *opts;
+  }
+  return hostname2endpoint(addr.c_str(), &ep_);
+}
+
+int Channel::ensure_socket(SocketId* out) {
+  std::lock_guard<std::mutex> g(sock_mu_);
+  Socket* s = Socket::Address(sock_);
+  if (s != nullptr) {
+    if (!s->Failed()) {
+      *out = sock_;
+      s->Dereference();
+      return 0;
+    }
+    s->Dereference();
+  }
+  Socket::Options sopts;
+  sopts.fd = -1;  // lazy connect in the write fiber
+  sopts.remote = ep_;
+  sopts.on_readable = &messenger_on_readable;
+  if (Socket::Create(sopts, &sock_) != 0) {
+    return -1;
+  }
+  *out = sock_;
+  return 0;
+}
+
+void Channel::CallMethod(const std::string& method, const IOBuf& request,
+                         IOBuf* response, Controller* cntl, Closure done) {
+  cntl->set_method(method);
+  cntl->call().response = response;
+  cntl->call().done = std::move(done);
+  cntl->call().start_us = monotonic_time_us();
+  const bool sync = !cntl->call().done;
+
+  fid_t cid = 0;
+  if (fid_create(&cid, cntl, on_call_error) != 0) {
+    cntl->SetFailed(ENOMEM, "out of call ids");
+    if (!sync && cntl->call().done) {
+      cntl->call().done();
+    }
+    return;
+  }
+  cntl->call().cid = cid;
+  // Hold the call lock through setup so a racing response or an eager
+  // timeout cannot complete (and free) the call mid-construction —
+  // responses/timeouts queue on the fid until we unlock (channel.cpp:481
+  // parity).
+  CHECK(fid_lock(cid, nullptr) == 0);
+
+  SocketId sid = 0;
+  if (ensure_socket(&sid) != 0) {
+    fid_unlock(cid);
+    fid_error(cid, ECONNREFUSED);
+    if (sync) {
+      fid_join(cid);
+    }
+    return;
+  }
+  cntl->call().socket_id = sid;
+
+  if (cntl->timeout_ms() > 0) {
+    cntl->call().timeout_timer = TimerThread::instance()->schedule(
+        cntl->call().start_us + cntl->timeout_ms() * 1000, timeout_cb,
+        reinterpret_cast<void*>(cid));
+  }
+
+  RpcMeta meta;
+  meta.type = RpcMeta::kRequest;
+  meta.correlation_id = cid;
+  meta.method = method;
+  IOBuf body = request;  // zero-copy share
+  if (!cntl->request_attachment().empty()) {
+    meta.attachment_size =
+        static_cast<uint32_t>(cntl->request_attachment().size());
+    body.append(cntl->request_attachment());
+  }
+  IOBuf frame;
+  tstd_pack(&frame, meta, body);
+
+  SocketRef s(Socket::Address(sid));
+  const bool write_ok = s && s->Write(std::move(frame)) == 0;
+  fid_unlock(cid);
+  if (!write_ok) {
+    fid_error(cid, ECONNRESET);
+  }
+  if (sync) {
+    fid_join(cid);
+  }
+}
+
+}  // namespace trpc

@@ -1,0 +1,45 @@
+// Channel — the client stub.
+//
+// Parity: brpc::Channel (/root/reference/src/brpc/channel.cpp:446-630
+// CallMethod: correlation-id lock, timeout timer, IssueRPC write, sync
+// Join) condensed to the single-server pooled-connection case; combo
+// channels and LB compose above this (SURVEY.md §2.4).
+#pragma once
+
+#include <mutex>
+#include <string>
+
+#include "base/endpoint.h"
+#include "net/controller.h"
+#include "net/socket.h"
+
+namespace trpc {
+
+class Channel {
+ public:
+  struct Options {
+    int64_t timeout_ms = 1000;
+    int max_retry = 0;  // retries on connection failure (not timeouts)
+  };
+
+  // addr: "ip:port" or "host:port".  Returns 0 on success.
+  int Init(const std::string& addr, const Options* opts = nullptr);
+
+  // done == nullptr → synchronous (parks the calling fiber / blocks the
+  // calling pthread on the call's fid).  On return/completion, cntl holds
+  // the status and *response the payload.
+  void CallMethod(const std::string& method, const IOBuf& request,
+                  IOBuf* response, Controller* cntl, Closure done = nullptr);
+
+  const EndPoint& endpoint() const { return ep_; }
+
+ private:
+  int ensure_socket(SocketId* out);
+
+  EndPoint ep_;
+  Options opts_;
+  std::mutex sock_mu_;
+  SocketId sock_ = 0;
+};
+
+}  // namespace trpc

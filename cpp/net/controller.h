@@ -1,0 +1,73 @@
+// Controller — per-RPC state visible to user code on both sides.
+//
+// Parity: brpc::Controller (/root/reference/src/brpc/controller.h) condensed:
+// error state, timeout, attachment, correlation id.  The client call
+// lifecycle (response/timeout/failure racing) serializes on the fid the
+// controller owns, mirroring the bthread_id protocol in controller.cpp:611.
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <string>
+
+#include "base/iobuf.h"
+#include "fiber/fid.h"
+
+namespace trpc {
+
+using Closure = std::function<void()>;
+
+class Controller {
+ public:
+  // -- status ----------------------------------------------------------
+  bool Failed() const { return error_code_ != 0; }
+  int error_code() const { return error_code_; }
+  const std::string& error_text() const { return error_text_; }
+  void SetFailed(int code, const std::string& text) {
+    error_code_ = code;
+    error_text_ = text;
+  }
+  void Reset() {
+    error_code_ = 0;
+    error_text_.clear();
+    request_attachment_.clear();
+    response_attachment_.clear();
+  }
+
+  // -- knobs (client) --------------------------------------------------
+  void set_timeout_ms(int64_t ms) { timeout_ms_ = ms; }
+  int64_t timeout_ms() const { return timeout_ms_; }
+
+  // Payload carried outside the main body (parity: attachment in
+  // baidu_std; rides the same frame after the response body).
+  IOBuf& request_attachment() { return request_attachment_; }
+  IOBuf& response_attachment() { return response_attachment_; }
+
+  int64_t latency_us() const { return latency_us_; }
+  const std::string& method() const { return method_; }
+
+  // -- internal (framework) --------------------------------------------
+  struct CallState {
+    fid_t cid = 0;
+    uint64_t timeout_timer = 0;
+    IOBuf* response = nullptr;
+    Closure done;
+    int64_t start_us = 0;
+    uint64_t socket_id = 0;
+  };
+  CallState& call() { return call_; }
+  void set_method(const std::string& m) { method_ = m; }
+  void set_latency_us(int64_t us) { latency_us_ = us; }
+
+ private:
+  int error_code_ = 0;
+  std::string error_text_;
+  std::string method_;
+  int64_t timeout_ms_ = 1000;
+  int64_t latency_us_ = 0;
+  IOBuf request_attachment_;
+  IOBuf response_attachment_;
+  CallState call_;
+};
+
+}  // namespace trpc

@@ -1,0 +1,62 @@
+#include "net/dispatcher.h"
+
+#include <pthread.h>
+#include <sys/epoll.h>
+
+#include "base/logging.h"
+#include "net/socket.h"
+
+namespace trpc {
+
+EventDispatcher* EventDispatcher::instance() {
+  static EventDispatcher d;
+  return &d;
+}
+
+EventDispatcher::EventDispatcher() {
+  epfd_ = epoll_create1(EPOLL_CLOEXEC);
+  CHECK(epfd_ >= 0);
+  pthread_t tid;
+  pthread_create(
+      &tid, nullptr,
+      [](void* self) -> void* {
+        static_cast<EventDispatcher*>(self)->run();
+        return nullptr;
+      },
+      this);
+  pthread_detach(tid);
+}
+
+int EventDispatcher::add(int fd, uint64_t socket_id) {
+  epoll_event ev = {};
+  ev.events = EPOLLIN | EPOLLOUT | EPOLLET | EPOLLRDHUP;
+  ev.data.u64 = socket_id;
+  return epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+}
+
+int EventDispatcher::remove(int fd) {
+  return epoll_ctl(epfd_, EPOLL_CTL_DEL, fd, nullptr);
+}
+
+void EventDispatcher::run() {
+  constexpr int kMaxEvents = 64;
+  epoll_event events[kMaxEvents];
+  while (true) {
+    const int n = epoll_wait(epfd_, events, kMaxEvents, -1);
+    for (int i = 0; i < n; ++i) {
+      Socket* s = Socket::Address(events[i].data.u64);
+      if (s == nullptr) {
+        continue;  // stale event on a recycled slot
+      }
+      if (events[i].events & (EPOLLOUT)) {
+        s->on_output_event();
+      }
+      if (events[i].events & (EPOLLIN | EPOLLRDHUP | EPOLLERR | EPOLLHUP)) {
+        s->on_input_event();
+      }
+      s->Dereference();
+    }
+  }
+}
+
+}  // namespace trpc

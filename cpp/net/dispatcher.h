@@ -1,0 +1,30 @@
+// EventDispatcher — the epoll-ET loop feeding sockets.
+//
+// Parity: brpc EventDispatcher (/root/reference/src/brpc/event_dispatcher.h:
+// 96-197; Run loop event_dispatcher_epoll.cpp:207-213).  The epoll payload
+// is the versioned SocketId, never a pointer, so stale events on recycled
+// slots are dropped by the version check in Socket::Address — the same
+// armor as the reference's IOEventDataId.  Re-designed: the loop runs in a
+// dedicated pthread (the reference runs it in a bthread) since our fibers
+// park on Events, not fds.
+#pragma once
+
+#include <cstdint>
+
+namespace trpc {
+
+class EventDispatcher {
+ public:
+  static EventDispatcher* instance();
+
+  // Registers fd for edge-triggered IN|OUT with the given versioned id.
+  int add(int fd, uint64_t socket_id);
+  int remove(int fd);
+
+ private:
+  EventDispatcher();
+  void run();
+  int epfd_ = -1;
+};
+
+}  // namespace trpc

@@ -1,0 +1,101 @@
+#include "net/messenger.h"
+
+#include <errno.h>
+
+#include "base/logging.h"
+#include "fiber/fiber.h"
+#include "net/protocol.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr size_t kReadChunk = 512 * 1024;
+
+void process_message_fiber(void* arg) {
+  InputMessage* msg = static_cast<InputMessage*>(arg);
+  const Protocol* p = protocol_at(0);  // resolved below via pinned index
+  Socket* s = Socket::Address(msg->socket);
+  if (s != nullptr) {
+    p = protocol_at(s->pinned_protocol);
+    s->Dereference();
+  }
+  if (p != nullptr) {
+    if (msg->meta.type == RpcMeta::kRequest) {
+      p->process_request(std::move(*msg));
+    } else {
+      p->process_response(std::move(*msg));
+    }
+  }
+  delete msg;
+}
+
+// Cut as many whole messages as available; dispatch each in its own fiber
+// (the last one inline, like input_messenger.cpp:307-309's batch flush).
+void cut_and_dispatch(Socket* s, SocketId id) {
+  IOBuf& buf = s->read_buf();
+  while (!buf.empty()) {
+    InputMessage* msg = new InputMessage();
+    msg->socket = id;
+    ParseError rc = ParseError::kTryOtherProtocol;
+    if (s->pinned_protocol >= 0) {
+      rc = protocol_at(s->pinned_protocol)->parse(&buf, msg);
+    } else {
+      for (int i = 0; i < protocol_count(); ++i) {
+        rc = protocol_at(i)->parse(&buf, msg);
+        if (rc == ParseError::kOk || rc == ParseError::kNotEnoughData) {
+          s->pinned_protocol = i;
+          break;
+        }
+        if (rc == ParseError::kCorrupted) {
+          break;
+        }
+      }
+    }
+    switch (rc) {
+      case ParseError::kOk:
+        fiber_start(nullptr, process_message_fiber, msg, 0);
+        continue;
+      case ParseError::kNotEnoughData:
+        delete msg;
+        return;
+      default:
+        LOG(Warning) << "corrupted input on " << endpoint2str(s->remote())
+                     << ", closing";
+        delete msg;
+        s->SetFailed(EBADMSG);
+        return;
+    }
+  }
+}
+
+}  // namespace
+
+void messenger_on_readable(SocketId id, void* /*ctx*/) {
+  Socket* s = Socket::Address(id);
+  if (s == nullptr) {
+    return;
+  }
+  while (!s->Failed()) {
+    const ssize_t rc =
+        s->transport()->append_to_iobuf(s, &s->read_buf(), kReadChunk);
+    if (rc > 0) {
+      cut_and_dispatch(s, id);
+      continue;
+    }
+    if (rc == 0) {
+      break;  // EAGAIN: drained
+    }
+    // EOF or error.  A not-yet-connected client socket gets spurious
+    // HUP/ERR edges from epoll registration racing the non-blocking
+    // connect — the connect path owns failure reporting there.
+    if (!s->connected()) {
+      break;
+    }
+    s->SetFailed(errno != 0 ? errno : ECONNRESET);
+    break;
+  }
+  s->Dereference();
+}
+
+}  // namespace trpc

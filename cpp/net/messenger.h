@@ -1,0 +1,15 @@
+// InputMessenger — cuts messages off the read buffer and dispatches.
+//
+// Parity: brpc InputMessenger (/root/reference/src/brpc/input_messenger.cpp:
+// 83 CutInputMessage protocol multiplexing with per-socket pinning, :195
+// ProcessNewMessage batching).  Runs inside the socket's read fiber.
+#pragma once
+
+#include "net/socket.h"
+
+namespace trpc {
+
+// Socket::Options::on_readable for any RPC connection (server or client).
+void messenger_on_readable(SocketId id, void* ctx);
+
+}  // namespace trpc

@@ -1,0 +1,171 @@
+#include "net/protocol.h"
+
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+#include "base/logging.h"
+
+namespace trpc {
+
+namespace {
+
+// Fixed-capacity registry: entries are address-stable for the lifetime of
+// the process, so hot-path Protocol* caches can never dangle on a
+// concurrent registration (a growing vector would reallocate).
+constexpr int kMaxProtocols = 16;
+std::mutex g_proto_mu;
+Protocol g_protocols[kMaxProtocols];
+std::atomic<int> g_proto_count{0};
+
+// -- little-endian scalar helpers ----------------------------------------
+
+void put_u32(std::string* s, uint32_t v) {
+  char b[4];
+  memcpy(b, &v, 4);
+  s->append(b, 4);
+}
+
+void put_u64(std::string* s, uint64_t v) {
+  char b[8];
+  memcpy(b, &v, 8);
+  s->append(b, 8);
+}
+
+uint32_t get_u32(const char* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+uint64_t get_u64(const char* p) {
+  uint64_t v;
+  memcpy(&v, p, 8);
+  return v;
+}
+
+constexpr char kMagic[4] = {'T', 'R', 'P', '1'};
+constexpr size_t kHeaderLen = 4 + 4 + 8;  // magic | meta_len | payload_len
+
+std::string encode_meta(const RpcMeta& m) {
+  std::string s;
+  s.push_back(static_cast<char>(m.type));
+  put_u64(&s, m.correlation_id);
+  put_u32(&s, static_cast<uint32_t>(m.error_code));
+  put_u32(&s, m.attachment_size);
+  put_u32(&s, static_cast<uint32_t>(m.method.size()));
+  s.append(m.method);
+  put_u32(&s, static_cast<uint32_t>(m.error_text.size()));
+  s.append(m.error_text);
+  return s;
+}
+
+bool decode_meta(const std::string& s, RpcMeta* m) {
+  const char* p = s.data();
+  const char* end = p + s.size();
+  if (end - p < 1 + 8 + 4 + 4 + 4) {
+    return false;
+  }
+  m->type = static_cast<RpcMeta::Type>(*p++);
+  m->correlation_id = get_u64(p);
+  p += 8;
+  m->error_code = static_cast<int32_t>(get_u32(p));
+  p += 4;
+  m->attachment_size = get_u32(p);
+  p += 4;
+  const uint32_t mlen = get_u32(p);
+  p += 4;
+  // 64-bit arithmetic: mlen near UINT32_MAX must not wrap the bound check.
+  if (static_cast<uint64_t>(end - p) < static_cast<uint64_t>(mlen) + 4) {
+    return false;
+  }
+  m->method.assign(p, mlen);
+  p += mlen;
+  const uint32_t elen = get_u32(p);
+  p += 4;
+  if (static_cast<uint64_t>(end - p) < static_cast<uint64_t>(elen)) {
+    return false;
+  }
+  m->error_text.assign(p, elen);
+  return true;
+}
+
+ParseError tstd_parse(IOBuf* source, InputMessage* out) {
+  if (source->size() < kHeaderLen) {
+    return ParseError::kNotEnoughData;
+  }
+  char header[kHeaderLen];
+  source->copy_to(header, kHeaderLen);
+  if (memcmp(header, kMagic, 4) != 0) {
+    return ParseError::kTryOtherProtocol;
+  }
+  const uint32_t meta_len = get_u32(header + 4);
+  const uint64_t payload_len = get_u64(header + 8);
+  if (meta_len > 64 * 1024 * 1024 || payload_len > (1ull << 40)) {
+    return ParseError::kCorrupted;
+  }
+  if (source->size() < kHeaderLen + meta_len + payload_len) {
+    return ParseError::kNotEnoughData;
+  }
+  source->pop_front(kHeaderLen);
+  std::string meta_bytes;
+  {
+    IOBuf meta_buf;
+    source->cutn(&meta_buf, meta_len);
+    meta_bytes = meta_buf.to_string();
+  }
+  if (!decode_meta(meta_bytes, &out->meta)) {
+    return ParseError::kCorrupted;
+  }
+  source->cutn(&out->payload, payload_len);
+  return ParseError::kOk;
+}
+
+}  // namespace
+
+void tstd_pack(IOBuf* out, const RpcMeta& meta, const IOBuf& payload) {
+  const std::string meta_bytes = encode_meta(meta);
+  std::string header;
+  header.append(kMagic, 4);
+  put_u32(&header, static_cast<uint32_t>(meta_bytes.size()));
+  put_u64(&header, payload.size());
+  out->append(header);
+  out->append(meta_bytes);
+  out->append(payload);  // zero-copy block share
+}
+
+int register_protocol(const Protocol& p) {
+  std::lock_guard<std::mutex> g(g_proto_mu);
+  const int n = g_proto_count.load(std::memory_order_relaxed);
+  if (n >= kMaxProtocols) {
+    return -1;
+  }
+  g_protocols[n] = p;
+  g_proto_count.store(n + 1, std::memory_order_release);
+  return n;
+}
+
+const Protocol* protocol_at(int index) {
+  if (index < 0 || index >= g_proto_count.load(std::memory_order_acquire)) {
+    return nullptr;
+  }
+  return &g_protocols[index];
+}
+
+int protocol_count() {
+  return g_proto_count.load(std::memory_order_acquire);
+}
+
+// process_request / process_response are installed by server.cc/channel.cc.
+void tstd_process_request(InputMessage&& msg);
+void tstd_process_response(InputMessage&& msg);
+
+const Protocol& tstd_protocol() {
+  static Protocol p = {"tstd", tstd_parse, tstd_process_request,
+                       tstd_process_response};
+  static int registered = register_protocol(p);
+  (void)registered;
+  return p;
+}
+
+}  // namespace trpc

@@ -1,0 +1,66 @@
+// Protocol registry + the default framed protocol ("tstd").
+//
+// Parity: brpc's Protocol vtable + registry (/root/reference/src/brpc/
+// protocol.h:77-186) and the baidu_std wire format (policy/
+// baidu_rpc_protocol.cpp: 12-byte "PRPC" header + pb RpcMeta).  Re-designed
+// wire: magic "TRP1" | meta_len u32 | payload_len u64, meta is a hand-rolled
+// little-endian TLV (no protobuf dependency in the runtime) carrying type,
+// correlation id, method, error code/text, attachment split.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "base/iobuf.h"
+
+namespace trpc {
+
+class Socket;
+using SocketId = uint64_t;
+
+enum class ParseError : int {
+  kOk = 0,
+  kNotEnoughData = 1,   // keep bytes, wait for more
+  kTryOtherProtocol = 2,
+  kCorrupted = 3,       // kill the connection
+};
+
+struct RpcMeta {
+  enum Type : uint8_t { kRequest = 0, kResponse = 1 };
+  Type type = kRequest;
+  uint64_t correlation_id = 0;
+  int32_t error_code = 0;
+  uint32_t attachment_size = 0;  // trailing bytes of payload
+  std::string method;
+  std::string error_text;
+};
+
+struct InputMessage {
+  RpcMeta meta;
+  IOBuf payload;  // body (+ attachment tail per meta.attachment_size)
+  SocketId socket = 0;
+};
+
+struct Protocol {
+  const char* name;
+  // Cuts ONE complete message off `source` (or reports NotEnoughData).
+  ParseError (*parse)(IOBuf* source, InputMessage* out);
+  // Server side: handle a request message (runs in its own fiber).
+  void (*process_request)(InputMessage&& msg);
+  // Client side: handle a response message.
+  void (*process_response)(InputMessage&& msg);
+};
+
+// Registry (parity: RegisterProtocol, protocol.h:186).  Index is pinned on
+// the socket after first successful parse.
+int register_protocol(const Protocol& p);
+const Protocol* protocol_at(int index);
+int protocol_count();
+
+// The default framed protocol; registered on first use by Server/Channel.
+const Protocol& tstd_protocol();
+
+// Helpers shared by server/channel: build one framed message.
+void tstd_pack(IOBuf* out, const RpcMeta& meta, const IOBuf& payload);
+
+}  // namespace trpc

@@ -1,0 +1,166 @@
+#include "net/server.h"
+
+#include <errno.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include "base/logging.h"
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "net/messenger.h"
+#include "net/protocol.h"
+
+namespace trpc {
+
+int Server::RegisterMethod(const std::string& full_name, Handler handler) {
+  if (running()) {
+    return -1;
+  }
+  methods_[full_name] = std::move(handler);
+  return 0;
+}
+
+int Server::Start(int port) {
+  fiber_init(0);
+  tstd_protocol();  // ensure registered
+  const int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) {
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sa = {};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  sa.sin_port = htons(port > 0 ? static_cast<uint16_t>(port) : 0);
+  if (bind(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0 ||
+      listen(fd, 1024) != 0) {
+    close(fd);
+    return -1;
+  }
+  socklen_t len = sizeof(sa);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&sa), &len);
+  port_ = ntohs(sa.sin_port);
+
+  Socket::Options opts;
+  opts.fd = fd;
+  opts.on_readable = &Server::on_acceptable;
+  opts.ctx = this;
+  opts.user_data = this;
+  if (Socket::Create(opts, &listen_id_) != 0) {
+    close(fd);
+    return -1;
+  }
+  running_.store(true, std::memory_order_release);
+  LOG(Info) << "server started on 127.0.0.1:" << port_;
+  return 0;
+}
+
+void Server::Stop() {
+  if (!running_.exchange(false)) {
+    return;
+  }
+  Socket* s = Socket::Address(listen_id_);
+  if (s != nullptr) {
+    s->SetFailed(ESHUTDOWN);
+    s->Dereference();
+  }
+}
+
+// Accept-until-EAGAIN (acceptor.cpp:251 parity); runs in the listen
+// socket's read fiber.
+void Server::on_acceptable(SocketId id, void* ctx) {
+  Server* srv = static_cast<Server*>(ctx);
+  Socket* listener = Socket::Address(id);
+  if (listener == nullptr) {
+    return;
+  }
+  while (true) {
+    const int fd = accept4(listener->fd(), nullptr, nullptr,
+                           SOCK_NONBLOCK | SOCK_CLOEXEC);
+    if (fd < 0) {
+      break;  // EAGAIN or error; ET will refire on next connection
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    Socket::Options opts;
+    opts.fd = fd;
+    opts.on_readable = &messenger_on_readable;
+    opts.user_data = srv;
+    SocketId conn_id = 0;
+    if (Socket::Create(opts, &conn_id) != 0) {
+      close(fd);
+      continue;
+    }
+  }
+  listener->Dereference();
+}
+
+// ---- request execution (tstd protocol hook) -----------------------------
+
+void tstd_process_request(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  Server* srv = static_cast<Server*>(sock->user_data);
+  const SocketId socket_id = msg.socket;
+  const uint64_t cid = msg.meta.correlation_id;
+  const std::string method = msg.meta.method;
+
+  auto* cntl = new Controller();
+  cntl->set_method(method);
+  auto* response = new IOBuf();
+  const int64_t start_us = monotonic_time_us();
+
+  Closure done = [socket_id, cid, cntl, response, start_us, srv] {
+    RpcMeta meta;
+    meta.type = RpcMeta::kResponse;
+    meta.correlation_id = cid;
+    meta.error_code = cntl->error_code();
+    meta.error_text = cntl->error_text();
+    IOBuf frame;
+    if (!cntl->response_attachment().empty()) {
+      meta.attachment_size =
+          static_cast<uint32_t>(cntl->response_attachment().size());
+      response->append(std::move(cntl->response_attachment()));
+    }
+    tstd_pack(&frame, meta, *response);
+    SocketRef s(Socket::Address(socket_id));
+    if (s) {
+      s->Write(std::move(frame));
+    }
+    if (srv != nullptr) {
+      srv->requests_served.fetch_add(1, std::memory_order_relaxed);
+    }
+    (void)start_us;
+    delete response;
+    delete cntl;
+  };
+
+  if (srv == nullptr || !srv->running()) {
+    cntl->SetFailed(ESHUTDOWN, "server stopped");
+    done();
+    return;
+  }
+  const Server::Handler* handler = srv->find_method(method);
+  if (handler == nullptr) {
+    cntl->SetFailed(ENOENT, "no such method: " + method);
+    done();
+    return;
+  }
+  // Split the attachment tail off the payload.
+  IOBuf request = std::move(msg.payload);
+  if (msg.meta.attachment_size > 0 &&
+      msg.meta.attachment_size <= request.size()) {
+    IOBuf body;
+    request.cutn(&body, request.size() - msg.meta.attachment_size);
+    cntl->request_attachment() = std::move(request);
+    request = std::move(body);
+  }
+  (*handler)(cntl, request, response, std::move(done));
+}
+
+}  // namespace trpc

@@ -1,0 +1,54 @@
+// Server — service registry + acceptor + request execution.
+//
+// Parity: brpc::Server (/root/reference/src/brpc/server.h:489 AddService /
+// Start lifecycle; server.cpp:831 StartInternal; acceptor.cpp:52,251 the
+// accept-until-EAGAIN loop).  Condensed: services are method-name → handler
+// entries in a FlatMap; each request runs in its own fiber with a done
+// closure that packs and writes the response on the wait-free socket path.
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <string>
+
+#include "base/flat_map.h"
+#include "fiber/sync.h"
+#include "net/controller.h"
+#include "net/socket.h"
+
+namespace trpc {
+
+class Server {
+ public:
+  // Handler runs in a fiber; it may block on fiber primitives freely.
+  // Call done() exactly once (async responses allowed).
+  using Handler = std::function<void(
+      Controller* cntl, const IOBuf& request, IOBuf* response, Closure done)>;
+
+  ~Server() { Stop(); }
+
+  // Register before Start.  Name format "Service.Method" by convention.
+  int RegisterMethod(const std::string& full_name, Handler handler);
+
+  // port <= 0 picks an ephemeral port (see port() after).  Returns 0 on ok.
+  int Start(int port);
+  void Stop();
+  int port() const { return port_; }
+  bool running() const { return running_.load(std::memory_order_acquire); }
+
+  // -- internals --------------------------------------------------------
+  const Handler* find_method(const std::string& name) const {
+    return methods_.seek(name);
+  }
+  std::atomic<int64_t> requests_served{0};
+
+ private:
+  static void on_acceptable(SocketId id, void* ctx);
+
+  FlatMap<std::string, Handler> methods_;
+  SocketId listen_id_ = 0;
+  int port_ = -1;
+  std::atomic<bool> running_{false};
+};
+
+}  // namespace trpc

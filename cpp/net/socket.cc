@@ -1,0 +1,303 @@
+#include "net/socket.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include "base/logging.h"
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "fiber/scheduler.h"
+#include "net/dispatcher.h"
+
+namespace trpc {
+
+namespace {
+using SocketPool = ResourcePool<Socket>;
+
+constexpr uint64_t kRefUnit = 1;
+inline uint32_t ver_of(uint64_t rv) { return static_cast<uint32_t>(rv >> 32); }
+inline uint32_t ref_of(uint64_t rv) { return static_cast<uint32_t>(rv); }
+inline uint64_t pack(uint32_t ver, uint32_t ref) {
+  return (static_cast<uint64_t>(ver) << 32) | ref;
+}
+}  // namespace
+
+int Socket::Create(const Options& opts, SocketId* out) {
+  Socket* s = nullptr;
+  const uint32_t slot = SocketPool::instance()->acquire(&s);
+  if (s == nullptr) {
+    return -1;
+  }
+  s->slot_.store(slot, std::memory_order_relaxed);
+  s->reset_for_reuse(opts);
+  const uint32_t ver =
+      ver_of(s->ref_ver_.load(std::memory_order_relaxed)) + 1;  // → odd
+  // One owner reference.
+  s->ref_ver_.store(pack(ver, 1), std::memory_order_release);
+  *out = pack(ver, 0) | slot;  // ver<<32 | slot (ref bits reused as slot)
+  if (s->fd_ >= 0) {
+    make_nonblocking(s->fd_);
+    if (EventDispatcher::instance()->add(s->fd_, *out) != 0) {
+      LOG(Error) << "epoll add failed for fd " << s->fd_;
+    }
+  }
+  return 0;
+}
+
+void Socket::reset_for_reuse(const Options& opts) {
+  fd_ = opts.fd;
+  remote_ = opts.remote;
+  transport_ = tcp_transport();
+  failed_.store(false, std::memory_order_relaxed);
+  connected_.store(opts.fd >= 0, std::memory_order_relaxed);
+  nevent_.store(0, std::memory_order_relaxed);
+  on_readable_ = opts.on_readable;
+  ctx_ = opts.ctx;
+  read_buf_.clear();
+  pinned_protocol = -1;
+  user_data = opts.user_data;
+  wr_ev_.value.store(0, std::memory_order_relaxed);
+  writing_.store(false, std::memory_order_relaxed);
+  wq_head_.store(nullptr, std::memory_order_relaxed);
+}
+
+Socket* Socket::Address(SocketId id) {
+  const uint32_t slot = static_cast<uint32_t>(id);
+  const uint32_t ver = static_cast<uint32_t>(id >> 32);
+  if ((ver & 1) == 0) {
+    return nullptr;
+  }
+  Socket* s = SocketPool::instance()->at(slot);
+  if (s == nullptr) {
+    return nullptr;
+  }
+  uint64_t rv = s->ref_ver_.load(std::memory_order_acquire);
+  while (true) {
+    if (ver_of(rv) != ver) {
+      return nullptr;
+    }
+    if (s->ref_ver_.compare_exchange_weak(rv, rv + kRefUnit,
+                                          std::memory_order_acq_rel)) {
+      return s;
+    }
+  }
+}
+
+SocketId Socket::id() const {
+  return pack(ver_of(ref_ver_.load(std::memory_order_acquire)), 0) |
+         slot_.load(std::memory_order_relaxed);
+}
+
+void Socket::Dereference() {
+  const uint64_t prev = ref_ver_.fetch_sub(kRefUnit, std::memory_order_acq_rel);
+  if (ref_of(prev) == 1) {
+    // Last reference.  SetFailed already bumped the version to even, so
+    // Address() cannot revive this slot — teardown is single-threaded here.
+    if (fd_ >= 0) {
+      EventDispatcher::instance()->remove(fd_);
+      close(fd_);
+      fd_ = -1;
+    }
+    drop_write_queue();
+    read_buf_.clear();
+    SocketPool::instance()->release(slot_.load(std::memory_order_relaxed));
+  }
+}
+
+void Socket::SetFailed(int err) {
+  bool expect = false;
+  if (!failed_.compare_exchange_strong(expect, true,
+                                       std::memory_order_acq_rel)) {
+    return;  // already failed
+  }
+  (void)err;
+  // Bump the version to even FIRST: from this point Address() fails, so the
+  // refcount can only drain — the teardown in Dereference can never race a
+  // revival (the ordering socket.h:498's versioned-ref pattern exists for).
+  uint64_t rv = ref_ver_.load(std::memory_order_relaxed);
+  while (!ref_ver_.compare_exchange_weak(
+      rv, pack(ver_of(rv) + 1, ref_of(rv)), std::memory_order_acq_rel)) {
+  }
+  // Wake any fiber parked on writability so it observes the failure.
+  wr_ev_.value.fetch_add(1, std::memory_order_release);
+  wr_ev_.wake_all();
+  // Drop the owner reference (Create's).
+  Dereference();
+}
+
+void Socket::drop_write_queue() {
+  WriteNode* n = wq_head_.exchange(nullptr, std::memory_order_acquire);
+  while (n != nullptr) {
+    WriteNode* next = n->next;
+    delete n;
+    n = next;
+  }
+}
+
+// ---- input path ---------------------------------------------------------
+
+void Socket::on_input_event() {
+  if (nevent_.fetch_add(1, std::memory_order_acq_rel) == 0 &&
+      on_readable_ != nullptr) {
+    // Hand off to a fiber carrying the versioned id (the fiber re-Addresses).
+    fiber_start(nullptr, &Socket::read_fiber_thunk,
+                reinterpret_cast<void*>(id()), kFiberUrgent);
+  }
+}
+
+void Socket::read_fiber_thunk(void* arg) {
+  const SocketId id = reinterpret_cast<uint64_t>(arg);
+  Socket* s = Socket::Address(id);
+  if (s == nullptr) {
+    return;
+  }
+  while (true) {
+    const int seen = s->nevent_.load(std::memory_order_acquire);
+    s->on_readable_(id, s->ctx_);
+    int expect = seen;
+    if (s->nevent_.compare_exchange_strong(expect, 0,
+                                           std::memory_order_acq_rel)) {
+      break;
+    }
+  }
+  s->Dereference();
+}
+
+void Socket::on_output_event() {
+  wr_ev_.value.fetch_add(1, std::memory_order_release);
+  wr_ev_.wake_all();
+}
+
+int Socket::wait_writable(uint32_t snap, int64_t deadline_us) {
+  const int rc = wr_ev_.wait(snap, deadline_us);
+  return rc == ETIMEDOUT ? rc : 0;
+}
+
+// ---- connect ------------------------------------------------------------
+
+int Socket::ensure_connected() {
+  if (connected_.load(std::memory_order_acquire)) {
+    return 0;
+  }
+  if (fd_ < 0) {
+    const int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) {
+      return -1;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    fd_ = fd;
+    if (EventDispatcher::instance()->add(fd_, id()) != 0) {
+      return -1;
+    }
+  }
+  const int rc = transport_->connect(this);
+  if (rc == 0) {
+    connected_.store(true, std::memory_order_release);
+  }
+  return rc;
+}
+
+// ---- wait-free write path ----------------------------------------------
+
+int Socket::Write(IOBuf&& data) {
+  if (Failed()) {
+    return -1;
+  }
+  WriteNode* node = new WriteNode{std::move(data), nullptr};
+  WriteNode* old = wq_head_.load(std::memory_order_relaxed);
+  do {
+    node->next = old;
+  } while (!wq_head_.compare_exchange_weak(old, node,
+                                           std::memory_order_release,
+                                           std::memory_order_relaxed));
+  if (old == nullptr) {
+    bool expect = false;
+    if (writing_.compare_exchange_strong(expect, true,
+                                         std::memory_order_acq_rel)) {
+      // Become the writer.  Take a strong ref for the fiber's lifetime.
+      Socket* self = Socket::Address(id());
+      if (self == nullptr) {
+        writing_.store(false, std::memory_order_release);
+        return -1;
+      }
+      fiber_start(nullptr, &Socket::keep_write_thunk, self, kFiberUrgent);
+    }
+  }
+  return 0;
+}
+
+void Socket::keep_write_thunk(void* arg) {
+  Socket* s = static_cast<Socket*>(arg);
+  s->keep_write();
+  s->Dereference();
+}
+
+void Socket::keep_write() {
+  IOBuf pending;
+  while (true) {
+    // Drain newly queued nodes (LIFO chain → FIFO).
+    WriteNode* chain = wq_head_.exchange(nullptr, std::memory_order_acquire);
+    if (chain == nullptr && pending.empty()) {
+      writing_.store(false, std::memory_order_release);
+      // Close the race with producers that saw head non-null.
+      if (wq_head_.load(std::memory_order_acquire) != nullptr) {
+        bool expect = false;
+        if (writing_.compare_exchange_strong(expect, true,
+                                             std::memory_order_acq_rel)) {
+          continue;
+        }
+      }
+      return;
+    }
+    WriteNode* fifo = nullptr;
+    while (chain != nullptr) {
+      WriteNode* next = chain->next;
+      chain->next = fifo;
+      fifo = chain;
+      chain = next;
+    }
+    while (fifo != nullptr) {
+      pending.append(std::move(fifo->data));
+      WriteNode* done = fifo;
+      fifo = fifo->next;
+      delete done;
+    }
+    if (ensure_connected() != 0) {
+      SetFailed(errno);
+      pending.clear();
+      drop_write_queue();
+      return;
+    }
+    while (!pending.empty()) {
+      const uint32_t snap = writable_snap();
+      const ssize_t rc = transport_->cut_from_iobuf(this, &pending);
+      if (rc < 0) {
+        SetFailed(errno);
+        pending.clear();
+        drop_write_queue();
+        return;
+      }
+      if (rc == 0) {  // EAGAIN: park until the writable edge
+        if (Failed()) {
+          pending.clear();
+          drop_write_queue();
+          return;
+        }
+        wait_writable(snap, -1);
+      }
+    }
+  }
+}
+
+// ---- misc ---------------------------------------------------------------
+
+void make_nonblocking(int fd) {
+  const int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+}  // namespace trpc

@@ -1,0 +1,83 @@
+// TCP transport (parity: the fork's TcpTransport,
+// /root/reference/src/brpc/tcp_transport.cpp:42-104 — writev scatter-gather
+// from IOBuf refs; connect parks the calling fiber on the writable edge).
+#include <errno.h>
+#include <sys/socket.h>
+
+#include "base/time.h"
+#include "net/socket.h"
+#include "net/transport.h"
+
+namespace trpc {
+
+namespace {
+
+class TcpTransport final : public Transport {
+ public:
+  ssize_t cut_from_iobuf(Socket* s, IOBuf* from) override {
+    const ssize_t rc = from->cut_into_fd(s->fd());
+    if (rc < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      return 0;
+    }
+    return rc;
+  }
+
+  ssize_t append_to_iobuf(Socket* s, IOBuf* to, size_t max) override {
+    const ssize_t rc = to->append_from_fd(s->fd(), max);
+    if (rc < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      return 0;
+    }
+    if (rc == 0) {
+      errno = 0;  // orderly EOF
+      return -1;
+    }
+    return rc;
+  }
+
+  int connect(Socket* s) override {
+    sockaddr_in sa = endpoint2sockaddr(s->remote());
+    while (true) {
+      const uint32_t snap = s->writable_snap();
+      const int rc =
+          ::connect(s->fd(), reinterpret_cast<sockaddr*>(&sa), sizeof(sa));
+      if (rc == 0) {
+        return 0;
+      }
+      if (errno == EISCONN) {
+        return 0;
+      }
+      if (errno == EINPROGRESS || errno == EALREADY) {
+        // Park until the writable edge, then re-check with SO_ERROR.
+        s->wait_writable(snap, monotonic_time_us() + 10 * 1000 * 1000);
+        int err = 0;
+        socklen_t len = sizeof(err);
+        if (getsockopt(s->fd(), SOL_SOCKET, SO_ERROR, &err, &len) == 0 &&
+            err == 0) {
+          int probe = ::connect(s->fd(), reinterpret_cast<sockaddr*>(&sa),
+                                sizeof(sa));
+          if (probe == 0 || errno == EISCONN) {
+            return 0;
+          }
+          continue;
+        }
+        errno = err != 0 ? err : ETIMEDOUT;
+        return -1;
+      }
+      if (errno == EINTR) {
+        continue;
+      }
+      return -1;
+    }
+  }
+
+  const char* name() const override { return "tcp"; }
+};
+
+}  // namespace
+
+Transport* tcp_transport() {
+  static TcpTransport t;
+  return &t;
+}
+
+}  // namespace trpc

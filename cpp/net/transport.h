@@ -1,0 +1,44 @@
+// Transport — pluggable byte-moving strategy per socket.
+//
+// Parity: the fork's Transport seam (/root/reference/src/brpc/transport.h:
+// 26-64, selected by SocketMode via transport_factory.cpp) — the exact place
+// the reference hangs TCP, RDMA and shared-memory backends, and where our
+// ICI endpoint goes.  Condensed to the byte-plane methods; fiber-spawn
+// policy lives in the messenger.
+#pragma once
+
+#include <sys/types.h>
+
+#include "base/iobuf.h"
+
+namespace trpc {
+
+class Socket;
+
+enum class SocketMode : int {
+  kTcp = 0,
+  kIci = 1,  // device DMA rings; see net/ici_transport.*
+};
+
+class Transport {
+ public:
+  virtual ~Transport() = default;
+
+  // Move bytes from `from` into the connection; pops what was sent.
+  // Returns bytes written, 0 on EAGAIN-equivalent, -1 on error.
+  virtual ssize_t cut_from_iobuf(Socket* s, IOBuf* from) = 0;
+
+  // Read available bytes into `to`; returns bytes read, 0 on
+  // EAGAIN-equivalent, -1 on error/EOF(-with errno 0).
+  virtual ssize_t append_to_iobuf(Socket* s, IOBuf* to, size_t max) = 0;
+
+  // Establish the connection if needed (non-blocking; may park the calling
+  // fiber).  Returns 0 on success.
+  virtual int connect(Socket* s) = 0;
+
+  virtual const char* name() const = 0;
+};
+
+Transport* tcp_transport();  // stateless singleton
+
+}  // namespace trpc

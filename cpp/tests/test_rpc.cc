@@ -1,0 +1,215 @@
+// L4 end-to-end RPC tests — in-process server+client over loopback, the
+// reference's integration style (/root/reference/test/brpc_channel_unittest.cpp
+// fixtures; SURVEY.md §4 "the loopback stack IS the fixture").
+#include <unistd.h>
+
+#include <atomic>
+#include <string>
+
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "net/channel.h"
+#include "net/controller.h"
+#include "net/server.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+namespace {
+
+Server* g_server = nullptr;
+int g_port = 0;
+
+void start_server_once() {
+  if (g_server != nullptr) {
+    return;
+  }
+  g_server = new Server();
+  g_server->RegisterMethod(
+      "Echo.Echo", [](Controller* cntl, const IOBuf& req, IOBuf* resp,
+                      Closure done) {
+        resp->append(req);
+        if (!cntl->request_attachment().empty()) {
+          cntl->response_attachment() = cntl->request_attachment();
+        }
+        done();
+      });
+  g_server->RegisterMethod(
+      "Echo.Slow", [](Controller* cntl, const IOBuf& req, IOBuf* resp,
+                      Closure done) {
+        fiber_sleep_us(300000);  // parks the fiber, not the worker
+        resp->append(req);
+        done();
+      });
+  g_server->RegisterMethod(
+      "Echo.Fail", [](Controller* cntl, const IOBuf&, IOBuf*, Closure done) {
+        cntl->SetFailed(42, "deliberate failure");
+        done();
+      });
+  EXPECT_EQ(g_server->Start(0), 0);
+  g_port = g_server->port();
+}
+
+std::string addr() { return "127.0.0.1:" + std::to_string(g_port); }
+
+}  // namespace
+
+TEST_CASE(sync_echo) {
+  start_server_once();
+  Channel ch;
+  EXPECT_EQ(ch.Init(addr()), 0);
+  Controller cntl;
+  IOBuf req, resp;
+  req.append("hello rpc");
+  ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+  EXPECT(resp.to_string() == "hello rpc");
+  EXPECT(cntl.latency_us() > 0);
+}
+
+TEST_CASE(large_payload_echo) {
+  Channel ch;
+  EXPECT_EQ(ch.Init(addr()), 0);
+  std::string big(5 * 1024 * 1024, 'x');
+  for (size_t i = 0; i < big.size(); i += 37) {
+    big[i] = static_cast<char>('a' + i % 26);
+  }
+  Controller cntl;
+  cntl.set_timeout_ms(10000);
+  IOBuf req, resp;
+  req.append(big);
+  ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+  EXPECT_EQ(resp.size(), big.size());
+  EXPECT(resp.to_string() == big);
+}
+
+TEST_CASE(async_echo) {
+  Channel ch;
+  EXPECT_EQ(ch.Init(addr()), 0);
+  static CountdownEvent latch(1);
+  auto* cntl = new Controller();
+  auto* resp = new IOBuf();
+  IOBuf req;
+  req.append("async");
+  ch.CallMethod("Echo.Echo", req, resp, cntl, [cntl, resp] {
+    if (cntl->Failed()) {
+      fprintf(stderr, "async failed: code=%d text=%s\n", cntl->error_code(),
+              cntl->error_text().c_str());
+    }
+    EXPECT(!cntl->Failed());
+    EXPECT(resp->to_string() == "async");
+    latch.signal();
+  });
+  EXPECT_EQ(latch.wait(monotonic_time_us() + 5000000), 0);
+  delete cntl;
+  delete resp;
+}
+
+TEST_CASE(concurrent_calls_multiplexed) {
+  // 32 fibers × 30 calls over ONE pooled connection.
+  Channel ch;
+  EXPECT_EQ(ch.Init(addr()), 0);
+  static std::atomic<int> ok{0};
+  ok = 0;
+  static Channel* pch = &ch;
+  std::vector<fiber_t> ids(32);
+  for (size_t i = 0; i < ids.size(); ++i) {
+    fiber_start(&ids[i], [](void* arg) {
+      const int base = static_cast<int>(reinterpret_cast<intptr_t>(arg));
+      for (int k = 0; k < 30; ++k) {
+        Controller cntl;
+        cntl.set_timeout_ms(5000);
+        IOBuf req, resp;
+        req.append("payload-" + std::to_string(base * 1000 + k));
+        pch->CallMethod("Echo.Echo", req, &resp, &cntl);
+        if (!cntl.Failed() &&
+            resp.to_string() == "payload-" + std::to_string(base * 1000 + k)) {
+          ok.fetch_add(1);
+        }
+      }
+    }, reinterpret_cast<void*>(static_cast<intptr_t>(i)));
+  }
+  for (auto f : ids) {
+    fiber_join(f);
+  }
+  EXPECT_EQ(ok.load(), 32 * 30);
+}
+
+TEST_CASE(timeout_fires) {
+  Channel ch;
+  EXPECT_EQ(ch.Init(addr()), 0);
+  Controller cntl;
+  cntl.set_timeout_ms(50);  // Echo.Slow takes 300ms
+  IOBuf req, resp;
+  req.append("x");
+  const int64_t t0 = monotonic_time_us();
+  ch.CallMethod("Echo.Slow", req, &resp, &cntl);
+  EXPECT(cntl.Failed());
+  EXPECT_EQ(cntl.error_code(), ETIMEDOUT);
+  EXPECT(monotonic_time_us() - t0 < 250000);  // returned before handler done
+}
+
+TEST_CASE(slow_call_succeeds_with_budget) {
+  Channel ch;
+  EXPECT_EQ(ch.Init(addr()), 0);
+  Controller cntl;
+  cntl.set_timeout_ms(2000);
+  IOBuf req, resp;
+  req.append("patience");
+  ch.CallMethod("Echo.Slow", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+  EXPECT(resp.to_string() == "patience");
+}
+
+TEST_CASE(server_side_error_propagates) {
+  Channel ch;
+  EXPECT_EQ(ch.Init(addr()), 0);
+  Controller cntl;
+  IOBuf req, resp;
+  req.append("x");
+  ch.CallMethod("Echo.Fail", req, &resp, &cntl);
+  EXPECT(cntl.Failed());
+  EXPECT_EQ(cntl.error_code(), 42);
+  EXPECT(cntl.error_text() == "deliberate failure");
+}
+
+TEST_CASE(unknown_method_rejected) {
+  Channel ch;
+  EXPECT_EQ(ch.Init(addr()), 0);
+  Controller cntl;
+  IOBuf req, resp;
+  req.append("x");
+  ch.CallMethod("No.Such", req, &resp, &cntl);
+  EXPECT(cntl.Failed());
+  EXPECT_EQ(cntl.error_code(), ENOENT);
+}
+
+TEST_CASE(attachment_roundtrip) {
+  Channel ch;
+  EXPECT_EQ(ch.Init(addr()), 0);
+  Controller cntl;
+  cntl.request_attachment().append("ATTACHMENT-BYTES");
+  IOBuf req, resp;
+  req.append("body");
+  ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+  EXPECT(resp.to_string() == "body");
+  EXPECT(cntl.response_attachment().to_string() == "ATTACHMENT-BYTES");
+}
+
+TEST_CASE(connect_refused_times_out) {
+  Channel ch;
+  EXPECT_EQ(ch.Init("127.0.0.1:1"), 0);  // nothing listens on port 1
+  Controller cntl;
+  cntl.set_timeout_ms(200);
+  IOBuf req, resp;
+  req.append("x");
+  const int64_t t0 = monotonic_time_us();
+  ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+  EXPECT(cntl.Failed());
+  EXPECT(monotonic_time_us() - t0 < 2000000);
+}
+
+TEST_MAIN

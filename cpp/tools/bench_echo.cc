@@ -1,0 +1,107 @@
+// Echo benchmark — the reference's headline workload
+// (docs/cn/benchmark.md: multi-threaded sync echo; BASELINE.md).
+//
+// Usage: bench_echo [nfibers] [payload_bytes] [seconds]
+// Prints QPS, throughput and latency percentiles for sync echo over one
+// pooled loopback connection.
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "net/channel.h"
+#include "net/server.h"
+
+using namespace trpc;
+
+namespace {
+
+struct WorkerArgs {
+  Channel* ch;
+  std::string payload;
+  int64_t stop_us;
+  std::atomic<long>* calls;
+  std::atomic<long>* failures;
+  std::vector<int64_t>* latencies;  // per-fiber, merged later
+};
+
+void bench_fiber(void* p) {
+  WorkerArgs* a = static_cast<WorkerArgs*>(p);
+  IOBuf req;
+  req.append(a->payload);
+  while (monotonic_time_us() < a->stop_us) {
+    Controller cntl;
+    cntl.set_timeout_ms(5000);
+    IOBuf resp;
+    const int64_t t0 = monotonic_time_us();
+    a->ch->CallMethod("Echo.Echo", req, &resp, &cntl);
+    const int64_t dt = monotonic_time_us() - t0;
+    if (cntl.Failed() || resp.size() != a->payload.size()) {
+      a->failures->fetch_add(1);
+    } else {
+      a->calls->fetch_add(1);
+      a->latencies->push_back(dt);
+    }
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const int nfibers = argc > 1 ? atoi(argv[1]) : 64;
+  const size_t payload = argc > 2 ? atoi(argv[2]) : 1024;
+  const int seconds = argc > 3 ? atoi(argv[3]) : 3;
+
+  Server server;
+  server.RegisterMethod("Echo.Echo", [](Controller*, const IOBuf& req,
+                                        IOBuf* resp, Closure done) {
+    resp->append(req);
+    done();
+  });
+  if (server.Start(0) != 0) {
+    fprintf(stderr, "server start failed\n");
+    return 1;
+  }
+  Channel ch;
+  ch.Init("127.0.0.1:" + std::to_string(server.port()));
+
+  std::atomic<long> calls{0}, failures{0};
+  std::vector<std::vector<int64_t>> lat(nfibers);
+  std::vector<WorkerArgs> args(nfibers);
+  std::vector<fiber_t> fibers(nfibers);
+  const int64_t stop_us = monotonic_time_us() + seconds * 1000000LL;
+  const int64_t t0 = monotonic_time_us();
+  for (int i = 0; i < nfibers; ++i) {
+    args[i] = WorkerArgs{&ch, std::string(payload, 'x'), stop_us, &calls,
+                         &failures, &lat[i]};
+    fiber_start(&fibers[i], bench_fiber, &args[i]);
+  }
+  for (auto f : fibers) {
+    fiber_join(f);
+  }
+  const double secs = (monotonic_time_us() - t0) / 1e6;
+
+  std::vector<int64_t> all;
+  for (auto& v : lat) {
+    all.insert(all.end(), v.begin(), v.end());
+  }
+  std::sort(all.begin(), all.end());
+  auto pct = [&](double p) -> long {
+    if (all.empty()) return 0;
+    return all[std::min(all.size() - 1,
+                        static_cast<size_t>(p * all.size()))];
+  };
+  const double qps = calls.load() / secs;
+  printf("{\"fibers\": %d, \"payload\": %zu, \"qps\": %.0f, "
+         "\"throughput_MBps\": %.1f, \"p50_us\": %ld, \"p99_us\": %ld, "
+         "\"p999_us\": %ld, \"failures\": %ld}\n",
+         nfibers, payload, qps, qps * payload * 2 / 1e6, pct(0.5), pct(0.99),
+         pct(0.999), failures.load());
+  return 0;
+}

@@ -36,3 +36,7 @@ def test_base():
 
 def test_fiber():
     _run("test_fiber")
+
+
+def test_rpc():
+    _run("test_rpc", timeout=180)
