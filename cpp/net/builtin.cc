@@ -1,0 +1,57 @@
+// Builtin introspection services (parity: src/brpc/builtin/ — /vars,
+// /status, /health, /version, /connections registered at server start,
+// server.cpp:501-604).
+#include <unistd.h>
+
+#include <atomic>
+#include <string>
+
+#include "base/time.h"
+#include "net/http_protocol.h"
+#include "net/server.h"
+#include "stat/variable.h"
+
+namespace trpc {
+
+std::atomic<int64_t> g_socket_count{0};
+
+bool builtin_http_dispatch(Server* srv, const std::string& path,
+                           std::string* body, std::string* content_type) {
+  if (path == "/health") {
+    *body = "OK\n";
+    return true;
+  }
+  if (path == "/version") {
+    *body = "tpu-rpc/0.1.0\n";
+    return true;
+  }
+  if (path == "/vars" || path == "/vars/") {
+    std::string out;
+    for (auto& [name, value] : Variable::dump_exposed()) {
+      out += name + " : " + value + "\n";
+    }
+    *body = std::move(out);
+    return true;
+  }
+  if (path == "/status") {
+    const int64_t up_us = monotonic_time_us() - srv->start_time_us();
+    std::string out = "server 127.0.0.1:" + std::to_string(srv->port()) +
+                      "\nuptime_s " + std::to_string(up_us / 1000000) +
+                      "\nrequests_served " +
+                      std::to_string(srv->requests_served.load()) +
+                      "\nmethods:\n";
+    srv->for_each_method(
+        [&out](const std::string& name) { out += "  " + name + "\n"; });
+    *body = std::move(out);
+    return true;
+  }
+  if (path == "/connections") {
+    *body = "live_sockets " +
+            std::to_string(g_socket_count.load(std::memory_order_relaxed)) +
+            "\n";
+    return true;
+  }
+  return false;
+}
+
+}  // namespace trpc

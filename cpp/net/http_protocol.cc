@@ -1,0 +1,173 @@
+#include "net/http_protocol.h"
+
+#include <cstring>
+#include <string>
+
+#include "base/logging.h"
+#include "base/time.h"
+#include "net/server.h"
+#include "net/socket.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr size_t kMaxHeaderBytes = 64 * 1024;
+
+bool looks_like_http(const IOBuf& buf) {
+  char start[8] = {};
+  const size_t n = buf.copy_to(start, sizeof(start));
+  static const char* kMethods[] = {"GET ",    "POST ",  "PUT ",
+                                   "DELETE ", "HEAD ",  "OPTIONS ",
+                                   "PATCH "};
+  for (const char* m : kMethods) {
+    // Prefix match on however many bytes we have: "G" alone must count as
+    // possibly-HTTP so the messenger waits instead of killing the socket.
+    const size_t l = std::min(n, strlen(m));
+    if (l > 0 && memcmp(start, m, l) == 0) {
+      return true;
+    }
+  }
+  return false;
+}
+
+// InputMessage reuse for HTTP: meta.method carries "VERB PATH"; payload is
+// the body.
+ParseError http_parse(IOBuf* source, InputMessage* out) {
+  if (source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  if (!looks_like_http(*source)) {
+    return ParseError::kTryOtherProtocol;
+  }
+  const size_t scan = std::min(source->size(), kMaxHeaderBytes);
+  std::string head;
+  head.resize(scan);
+  source->copy_to(head.data(), scan);
+  const size_t hdr_end = head.find("\r\n\r\n");
+  if (hdr_end == std::string::npos) {
+    return scan >= kMaxHeaderBytes ? ParseError::kCorrupted
+                                   : ParseError::kNotEnoughData;
+  }
+  // Request line.
+  const size_t line_end = head.find("\r\n");
+  const std::string line = head.substr(0, line_end);
+  const size_t sp1 = line.find(' ');
+  const size_t sp2 = line.rfind(' ');
+  if (sp1 == std::string::npos || sp2 <= sp1) {
+    return ParseError::kCorrupted;
+  }
+  const std::string verb = line.substr(0, sp1);
+  const std::string path = line.substr(sp1 + 1, sp2 - sp1 - 1);
+  // Content-Length: matched as a header NAME (leading "\r\n"), never as a
+  // substring of another header or the request line; capped so a hostile
+  // value can neither wrap the total nor buffer unboundedly.
+  constexpr uint64_t kMaxBody = 1ull << 30;  // 1 GB
+  uint64_t content_len = 0;
+  {
+    std::string lower = head.substr(0, hdr_end + 2);
+    for (char& c : lower) {
+      c = static_cast<char>(tolower(c));
+    }
+    const size_t pos = lower.find("\r\ncontent-length:");
+    if (pos != std::string::npos) {
+      char* end = nullptr;
+      content_len = strtoull(lower.c_str() + pos + 17, &end, 10);
+      if (content_len > kMaxBody) {
+        return ParseError::kCorrupted;
+      }
+    }
+  }
+  const uint64_t total = static_cast<uint64_t>(hdr_end) + 4 + content_len;
+  if (source->size() < total) {
+    return ParseError::kNotEnoughData;
+  }
+  source->pop_front(hdr_end + 4);
+  source->cutn(&out->payload, content_len);
+  out->meta.type = RpcMeta::kRequest;
+  out->meta.method = verb + " " + path;
+  return ParseError::kOk;
+}
+
+void http_respond(SocketId sid, int status, const std::string& reason,
+                  const std::string& content_type, const std::string& body) {
+  std::string head = "HTTP/1.1 " + std::to_string(status) + " " + reason +
+                     "\r\nContent-Type: " + content_type +
+                     "\r\nContent-Length: " + std::to_string(body.size()) +
+                     "\r\nConnection: keep-alive\r\n\r\n";
+  IOBuf out;
+  out.append(head);
+  out.append(body);
+  SocketRef s(Socket::Address(sid));
+  if (s) {
+    s->Write(std::move(out));
+  }
+}
+
+void http_process_request(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  Server* srv = static_cast<Server*>(sock->user_data);
+  const size_t sp = msg.meta.method.find(' ');
+  std::string path = msg.meta.method.substr(sp + 1);
+  const size_t q = path.find('?');
+  if (q != std::string::npos) {
+    path = path.substr(0, q);
+  }
+  std::string body, ctype = "text/plain";
+  if (srv != nullptr && builtin_http_dispatch(srv, path, &body, &ctype)) {
+    http_respond(msg.socket, 200, "OK", ctype, body);
+    return;
+  }
+  // RPC-over-HTTP: POST /Service.Method with the request payload as body
+  // (parity: brpc's http access to pb services).
+  const std::string rpc_name = path.empty() ? "" : path.substr(1);
+  const Server::MethodProperty* prop =
+      srv != nullptr ? srv->find_method(rpc_name) : nullptr;
+  if (prop == nullptr) {
+    http_respond(msg.socket, 404, "Not Found", "text/plain",
+                 "no such path or method: " + path + "\n");
+    return;
+  }
+  auto* cntl = new Controller();
+  cntl->set_method(rpc_name);
+  auto* response = new IOBuf();
+  const SocketId sid = msg.socket;
+  const int64_t start_us = monotonic_time_us();
+  std::shared_ptr<LatencyRecorder> lat = prop->latency;
+  Closure done = [sid, cntl, response, srv, lat, start_us] {
+    if (cntl->Failed()) {
+      http_respond(sid, 500, "Internal Server Error", "text/plain",
+                   cntl->error_text() + "\n");
+    } else {
+      http_respond(sid, 200, "OK", "application/octet-stream",
+                   response->to_string());
+    }
+    srv->requests_served.fetch_add(1, std::memory_order_relaxed);
+    if (lat != nullptr) {
+      *lat << (monotonic_time_us() - start_us);
+    }
+    delete response;
+    delete cntl;
+  };
+  prop->handler(cntl, msg.payload, response, std::move(done));
+}
+
+void http_process_response(InputMessage&&) {
+  // Server-side only for now; the RPC client speaks tstd.
+}
+
+}  // namespace
+
+void register_http_protocol() {
+  static int once = [] {
+    Protocol p = {"http", http_parse, http_process_request,
+                  http_process_response, /*process_in_order=*/true};
+    return register_protocol(p);
+  }();
+  (void)once;
+}
+
+}  // namespace trpc

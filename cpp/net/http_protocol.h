@@ -1,0 +1,23 @@
+// Minimal HTTP/1.1 server-side protocol.
+//
+// Parity: brpc's http support (/root/reference/src/brpc/policy/
+// http_rpc_protocol.cpp + builtin services server.cpp:501-604): the same
+// port serves RPC framing AND HTTP — the messenger tries protocols in
+// registration order and pins the match (input_messenger.cpp:83).
+// Re-designed minimal: request-line + headers + Content-Length bodies;
+// keep-alive; no chunked/h2 yet.
+#pragma once
+
+#include "net/protocol.h"
+
+namespace trpc {
+
+// Registers the HTTP protocol (idempotent).  Server::Start calls this.
+void register_http_protocol();
+
+// Builtin service dispatch: returns true if `path` was handled.
+class Server;
+bool builtin_http_dispatch(Server* srv, const std::string& path,
+                           std::string* body, std::string* content_type);
+
+}  // namespace trpc

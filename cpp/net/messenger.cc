@@ -41,21 +41,38 @@ void cut_and_dispatch(Socket* s, SocketId id) {
     if (s->pinned_protocol >= 0) {
       rc = protocol_at(s->pinned_protocol)->parse(&buf, msg);
     } else {
+      // Pin ONLY on a successful parse: with a partial prefix several
+      // protocols may legitimately say "need more data", and pinning early
+      // would misroute the connection once the real format shows.
       for (int i = 0; i < protocol_count(); ++i) {
         rc = protocol_at(i)->parse(&buf, msg);
-        if (rc == ParseError::kOk || rc == ParseError::kNotEnoughData) {
+        if (rc == ParseError::kOk) {
           s->pinned_protocol = i;
           break;
         }
-        if (rc == ParseError::kCorrupted) {
+        if (rc == ParseError::kNotEnoughData ||
+            rc == ParseError::kCorrupted) {
           break;
         }
       }
     }
     switch (rc) {
-      case ParseError::kOk:
-        fiber_start(nullptr, process_message_fiber, msg, 0);
+      case ParseError::kOk: {
+        const Protocol* p = protocol_at(s->pinned_protocol);
+        if (p != nullptr && p->process_in_order) {
+          // FIFO protocols (no correlation id): run inline, keeping this
+          // connection's response order.
+          if (msg->meta.type == RpcMeta::kRequest) {
+            p->process_request(std::move(*msg));
+          } else {
+            p->process_response(std::move(*msg));
+          }
+          delete msg;
+        } else {
+          fiber_start(nullptr, process_message_fiber, msg, 0);
+        }
         continue;
+      }
       case ParseError::kNotEnoughData:
         delete msg;
         return;

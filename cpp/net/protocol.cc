@@ -91,13 +91,15 @@ bool decode_meta(const std::string& s, RpcMeta* m) {
 }
 
 ParseError tstd_parse(IOBuf* source, InputMessage* out) {
-  if (source->size() < kHeaderLen) {
-    return ParseError::kNotEnoughData;
-  }
+  // Reject a wrong magic as soon as the available prefix disagrees, so the
+  // messenger can offer the bytes to other protocols without waiting.
   char header[kHeaderLen];
-  source->copy_to(header, kHeaderLen);
-  if (memcmp(header, kMagic, 4) != 0) {
+  const size_t avail = source->copy_to(header, kHeaderLen);
+  if (memcmp(header, kMagic, std::min<size_t>(avail, 4)) != 0) {
     return ParseError::kTryOtherProtocol;
+  }
+  if (avail < kHeaderLen) {
+    return ParseError::kNotEnoughData;
   }
   const uint32_t meta_len = get_u32(header + 4);
   const uint64_t payload_len = get_u64(header + 8);

@@ -49,6 +49,10 @@ struct Protocol {
   void (*process_request)(InputMessage&& msg);
   // Client side: handle a response message.
   void (*process_response)(InputMessage&& msg);
+  // True for protocols WITHOUT correlation ids (HTTP/1.1): messages on one
+  // connection are processed in order in the read fiber so responses stay
+  // FIFO; tstd dispatches each message to its own fiber instead.
+  bool process_in_order = false;
 };
 
 // Registry (parity: RegisterProtocol, protocol.h:186).  Index is pinned on

@@ -9,6 +9,7 @@
 #include "base/logging.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
+#include "net/http_protocol.h"
 #include "net/messenger.h"
 #include "net/protocol.h"
 
@@ -18,13 +19,19 @@ int Server::RegisterMethod(const std::string& full_name, Handler handler) {
   if (running()) {
     return -1;
   }
-  methods_[full_name] = std::move(handler);
+  MethodProperty prop;
+  prop.handler = std::move(handler);
+  prop.latency = std::make_shared<LatencyRecorder>();
+  prop.latency->expose("rpc_server_" + full_name);
+  methods_[full_name] = std::move(prop);
   return 0;
 }
 
 int Server::Start(int port) {
   fiber_init(0);
-  tstd_protocol();  // ensure registered
+  tstd_protocol();  // ensure registered (first: most traffic is RPC)
+  register_http_protocol();
+  start_time_us_ = monotonic_time_us();
   const int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   if (fd < 0) {
     return -1;
@@ -114,8 +121,12 @@ void tstd_process_request(InputMessage&& msg) {
   cntl->set_method(method);
   auto* response = new IOBuf();
   const int64_t start_us = monotonic_time_us();
+  const Server::MethodProperty* prop =
+      (srv != nullptr && srv->running()) ? srv->find_method(method) : nullptr;
+  std::shared_ptr<LatencyRecorder> lat =
+      prop != nullptr ? prop->latency : nullptr;
 
-  Closure done = [socket_id, cid, cntl, response, start_us, srv] {
+  Closure done = [socket_id, cid, cntl, response, start_us, srv, lat] {
     RpcMeta meta;
     meta.type = RpcMeta::kResponse;
     meta.correlation_id = cid;
@@ -135,7 +146,9 @@ void tstd_process_request(InputMessage&& msg) {
     if (srv != nullptr) {
       srv->requests_served.fetch_add(1, std::memory_order_relaxed);
     }
-    (void)start_us;
+    if (lat != nullptr) {
+      *lat << (monotonic_time_us() - start_us);
+    }
     delete response;
     delete cntl;
   };
@@ -145,8 +158,7 @@ void tstd_process_request(InputMessage&& msg) {
     done();
     return;
   }
-  const Server::Handler* handler = srv->find_method(method);
-  if (handler == nullptr) {
+  if (prop == nullptr) {
     cntl->SetFailed(ENOENT, "no such method: " + method);
     done();
     return;
@@ -160,7 +172,7 @@ void tstd_process_request(InputMessage&& msg) {
     cntl->request_attachment() = std::move(request);
     request = std::move(body);
   }
-  (*handler)(cntl, request, response, std::move(done));
+  prop->handler(cntl, request, response, std::move(done));
 }
 
 }  // namespace trpc

@@ -9,12 +9,14 @@
 
 #include <atomic>
 #include <functional>
+#include <memory>
 #include <string>
 
 #include "base/flat_map.h"
 #include "fiber/sync.h"
 #include "net/controller.h"
 #include "net/socket.h"
+#include "stat/latency_recorder.h"
 
 namespace trpc {
 
@@ -24,6 +26,13 @@ class Server {
   // Call done() exactly once (async responses allowed).
   using Handler = std::function<void(
       Controller* cntl, const IOBuf& request, IOBuf* response, Closure done)>;
+
+  // Per-method properties (parity: MethodProperty + MethodStatus,
+  // server.h:399 / details/method_status.h — auto-created qps/latency vars).
+  struct MethodProperty {
+    Handler handler;
+    std::shared_ptr<LatencyRecorder> latency;
+  };
 
   ~Server() { Stop(); }
 
@@ -37,15 +46,22 @@ class Server {
   bool running() const { return running_.load(std::memory_order_acquire); }
 
   // -- internals --------------------------------------------------------
-  const Handler* find_method(const std::string& name) const {
+  const MethodProperty* find_method(const std::string& name) const {
     return methods_.seek(name);
   }
+  template <typename Fn>
+  void for_each_method(Fn&& fn) const {
+    methods_.for_each(
+        [&fn](const std::string& name, const MethodProperty&) { fn(name); });
+  }
   std::atomic<int64_t> requests_served{0};
+  int64_t start_time_us() const { return start_time_us_; }
 
  private:
   static void on_acceptable(SocketId id, void* ctx);
+  int64_t start_time_us_ = 0;
 
-  FlatMap<std::string, Handler> methods_;
+  FlatMap<std::string, MethodProperty> methods_;
   SocketId listen_id_ = 0;
   int port_ = -1;
   std::atomic<bool> running_{false};

@@ -14,6 +14,8 @@
 
 namespace trpc {
 
+extern std::atomic<int64_t> g_socket_count;  // exposed via /connections
+
 namespace {
 using SocketPool = ResourcePool<Socket>;
 
@@ -37,6 +39,7 @@ int Socket::Create(const Options& opts, SocketId* out) {
       ver_of(s->ref_ver_.load(std::memory_order_relaxed)) + 1;  // → odd
   // One owner reference.
   s->ref_ver_.store(pack(ver, 1), std::memory_order_release);
+  g_socket_count.fetch_add(1, std::memory_order_relaxed);
   *out = pack(ver, 0) | slot;  // ver<<32 | slot (ref bits reused as slot)
   if (s->fd_ >= 0) {
     make_nonblocking(s->fd_);
@@ -103,6 +106,7 @@ void Socket::Dereference() {
     }
     drop_write_queue();
     read_buf_.clear();
+    g_socket_count.fetch_sub(1, std::memory_order_relaxed);
     SocketPool::instance()->release(slot_.load(std::memory_order_relaxed));
   }
 }

@@ -1,0 +1,108 @@
+#include "stat/latency_recorder.h"
+
+#include <algorithm>
+
+#include "base/rand.h"
+#include "stat/sampler.h"
+
+namespace trpc {
+
+LatencyRecorder::LatencyRecorder() {
+  reservoir_.reserve(kReservoir);
+  window_.resize(kWindowSecs);
+  Sampler::instance()->add(this);
+}
+
+LatencyRecorder::~LatencyRecorder() {
+  hide();  // deregister from /vars BEFORE members start dying
+  Sampler::instance()->remove(this);
+}
+
+void LatencyRecorder::operator<<(int64_t latency_us) {
+  const int64_t n = interval_count_.fetch_add(1, std::memory_order_relaxed);
+  interval_sum_.fetch_add(latency_us, std::memory_order_relaxed);
+  total_count_.fetch_add(1, std::memory_order_relaxed);
+  int64_t cur_max = max_us_.load(std::memory_order_relaxed);
+  while (latency_us > cur_max &&
+         !max_us_.compare_exchange_weak(cur_max, latency_us,
+                                        std::memory_order_relaxed)) {
+  }
+  std::lock_guard<std::mutex> g(res_mu_);
+  if (static_cast<int>(reservoir_.size()) < kReservoir) {
+    reservoir_.push_back(latency_us);
+  } else {
+    // Reservoir sampling keeps the sample uniform over the interval.
+    const uint64_t j = fast_rand_less_than(static_cast<uint64_t>(n) + 1);
+    if (j < kReservoir) {
+      reservoir_[j] = latency_us;
+    }
+  }
+}
+
+void LatencyRecorder::take_sample() {
+  Second sec;
+  {
+    std::lock_guard<std::mutex> g(res_mu_);
+    sec.sorted_latencies.swap(reservoir_);
+    reservoir_.reserve(kReservoir);
+  }
+  sec.count = interval_count_.exchange(0, std::memory_order_relaxed);
+  sec.sum = interval_sum_.exchange(0, std::memory_order_relaxed);
+  std::sort(sec.sorted_latencies.begin(), sec.sorted_latencies.end());
+  std::lock_guard<std::mutex> g(window_mu_);
+  window_[window_pos_] = std::move(sec);
+  window_pos_ = (window_pos_ + 1) % kWindowSecs;
+}
+
+int64_t LatencyRecorder::qps() const {
+  std::lock_guard<std::mutex> g(window_mu_);
+  int64_t total = 0;
+  int secs = 0;
+  for (const Second& s : window_) {
+    total += s.count;
+    ++secs;
+  }
+  return secs > 0 ? total / secs : 0;
+}
+
+int64_t LatencyRecorder::latency_avg_us() const {
+  std::lock_guard<std::mutex> g(window_mu_);
+  int64_t total = 0, cnt = 0;
+  for (const Second& s : window_) {
+    total += s.sum;
+    cnt += s.count;
+  }
+  return cnt > 0 ? total / cnt : 0;
+}
+
+int64_t LatencyRecorder::latency_percentile_us(double p) const {
+  std::lock_guard<std::mutex> g(window_mu_);
+  std::vector<int64_t> merged;
+  for (const Second& s : window_) {
+    merged.insert(merged.end(), s.sorted_latencies.begin(),
+                  s.sorted_latencies.end());
+  }
+  if (merged.empty()) {
+    return 0;
+  }
+  std::sort(merged.begin(), merged.end());
+  const size_t idx = std::min(merged.size() - 1,
+                              static_cast<size_t>(p * merged.size()));
+  return merged[idx];
+}
+
+int64_t LatencyRecorder::latency_max_us() const {
+  return max_us_.load(std::memory_order_relaxed);
+}
+
+std::string LatencyRecorder::value_str() const {
+  return "{\"qps\":" + std::to_string(qps()) +
+         ",\"avg_us\":" + std::to_string(latency_avg_us()) +
+         ",\"p50_us\":" + std::to_string(latency_percentile_us(0.5)) +
+         ",\"p99_us\":" + std::to_string(latency_percentile_us(0.99)) +
+         ",\"p999_us\":" + std::to_string(latency_percentile_us(0.999)) +
+         ",\"max_us\":" + std::to_string(latency_max_us()) +
+         ",\"count\":" + std::to_string(count()) + "}";
+}
+
+}  // namespace trpc

@@ -1,0 +1,62 @@
+// LatencyRecorder — per-second qps/avg/percentiles.
+//
+// Parity: bvar::LatencyRecorder (/root/reference/src/bvar/
+// latency_recorder.h:32-75 over detail/percentile.h reservoir sampling and
+// the one-background-thread Sampler, detail/sampler.cpp:60-135).
+// Re-designed: one reservoir per recorder, swapped each second by the
+// sampler thread into a trailing window of sorted snapshots.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "stat/reducer.h"
+#include "stat/variable.h"
+
+namespace trpc {
+
+class LatencyRecorder : public Variable {
+ public:
+  static constexpr int kReservoir = 1024;
+  static constexpr int kWindowSecs = 10;
+
+  LatencyRecorder();
+  ~LatencyRecorder() override;
+
+  void operator<<(int64_t latency_us);
+
+  int64_t qps() const;              // trailing-window average per second
+  int64_t latency_avg_us() const;   // trailing window
+  int64_t latency_percentile_us(double p) const;  // 0 < p < 1
+  int64_t latency_max_us() const;
+  int64_t count() const { return total_count_.load(std::memory_order_relaxed); }
+
+  std::string value_str() const override;
+
+  // Called by the sampler thread once per second.
+  void take_sample();
+
+ private:
+  struct Second {
+    std::vector<int64_t> sorted_latencies;
+    int64_t count = 0;
+    int64_t sum = 0;
+  };
+
+  // Active reservoir (written by hot path, swapped by sampler).
+  mutable std::mutex res_mu_;
+  std::vector<int64_t> reservoir_;
+  std::atomic<int64_t> interval_count_{0};
+  std::atomic<int64_t> interval_sum_{0};
+  std::atomic<int64_t> total_count_{0};
+  std::atomic<int64_t> max_us_{0};
+
+  mutable std::mutex window_mu_;
+  std::vector<Second> window_;  // ring of last kWindowSecs
+  size_t window_pos_ = 0;
+};
+
+}  // namespace trpc

@@ -1,0 +1,112 @@
+// Reducers — write-mostly counters combined on read.
+//
+// Parity: bvar::Adder/Maxer/Miner (/root/reference/src/bvar/reducer.h:
+// 335-493 over detail/agent_group.h thread-local agents).  A write touches
+// only this thread's cache-line-private agent; reads walk the agent list.
+// Re-designed: agents are registered in a per-reducer list keyed by a
+// process-unique id (same TLS pattern as DoublyBufferedData).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <limits>
+#include <memory>
+#include <mutex>
+#include <vector>
+
+#include "stat/variable.h"
+
+namespace trpc {
+
+template <typename Op>
+class Reducer : public Variable {
+ public:
+  Reducer() {
+    static std::atomic<uint64_t> next_id{1};
+    id_ = next_id.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  ~Reducer() override {
+    hide();  // deregister from /vars BEFORE members start dying
+  }
+
+  void operator<<(int64_t v) {
+    Agent* a = tls_agent();
+    int64_t cur = a->value.load(std::memory_order_relaxed);
+    while (!a->value.compare_exchange_weak(cur, Op::combine(cur, v),
+                                           std::memory_order_relaxed)) {
+    }
+  }
+
+  int64_t get_value() const {
+    std::lock_guard<std::mutex> g(agents_mu_);
+    int64_t acc = terminated_;
+    for (const auto& a : agents_) {
+      acc = Op::combine(acc, a->value.load(std::memory_order_relaxed));
+    }
+    return acc;
+  }
+
+  // Atomically reads and clears (used by per-second windows; only
+  // meaningful for Adder semantics).
+  int64_t reset() {
+    std::lock_guard<std::mutex> g(agents_mu_);
+    int64_t acc = terminated_;
+    terminated_ = Op::identity();
+    for (const auto& a : agents_) {
+      acc = Op::combine(acc, a->value.exchange(Op::identity(),
+                                               std::memory_order_relaxed));
+    }
+    return acc;
+  }
+
+  std::string value_str() const override {
+    return std::to_string(get_value());
+  }
+
+ private:
+  struct Agent {
+    std::atomic<int64_t> value{Op::identity()};
+  };
+
+  Agent* tls_agent() {
+    static thread_local std::vector<
+        std::pair<uint64_t, std::shared_ptr<Agent>>> tls;
+    for (auto& p : tls) {
+      if (p.first == id_) {
+        return p.second.get();
+      }
+    }
+    auto agent = std::make_shared<Agent>();
+    {
+      std::lock_guard<std::mutex> g(agents_mu_);
+      agents_.push_back(agent);
+    }
+    tls.emplace_back(id_, agent);
+    return agent.get();
+  }
+
+  uint64_t id_ = 0;
+  mutable std::mutex agents_mu_;
+  std::vector<std::shared_ptr<Agent>> agents_;
+  int64_t terminated_ = Op::identity();
+};
+
+struct OpAdd {
+  static int64_t identity() { return 0; }
+  static int64_t combine(int64_t a, int64_t b) { return a + b; }
+};
+struct OpMax {
+  static int64_t identity() { return std::numeric_limits<int64_t>::min(); }
+  static int64_t combine(int64_t a, int64_t b) { return a > b ? a : b; }
+};
+struct OpMin {
+  static int64_t identity() { return std::numeric_limits<int64_t>::max(); }
+  static int64_t combine(int64_t a, int64_t b) { return a < b ? a : b; }
+};
+
+using Adder = Reducer<OpAdd>;
+using Maxer = Reducer<OpMax>;
+using Miner = Reducer<OpMin>;
+
+}  // namespace trpc

@@ -1,0 +1,50 @@
+#include "stat/sampler.h"
+
+#include <pthread.h>
+#include <unistd.h>
+
+#include <algorithm>
+
+#include "stat/latency_recorder.h"
+
+namespace trpc {
+
+Sampler* Sampler::instance() {
+  static Sampler s;
+  return &s;
+}
+
+Sampler::Sampler() {
+  pthread_t tid;
+  pthread_create(
+      &tid, nullptr,
+      [](void* self) -> void* {
+        static_cast<Sampler*>(self)->run();
+        return nullptr;
+      },
+      this);
+  pthread_detach(tid);
+}
+
+void Sampler::add(LatencyRecorder* r) {
+  std::lock_guard<std::mutex> g(mu_);
+  recorders_.push_back(r);
+}
+
+void Sampler::remove(LatencyRecorder* r) {
+  std::lock_guard<std::mutex> g(mu_);
+  recorders_.erase(std::remove(recorders_.begin(), recorders_.end(), r),
+                   recorders_.end());
+}
+
+void Sampler::run() {
+  while (true) {
+    usleep(1000000);
+    std::lock_guard<std::mutex> g(mu_);
+    for (LatencyRecorder* r : recorders_) {
+      r->take_sample();
+    }
+  }
+}
+
+}  // namespace trpc

@@ -1,0 +1,26 @@
+// Sampler — ONE background thread snapshots every recorder each second
+// (parity: bvar SamplerCollector, /root/reference/src/bvar/detail/
+// sampler.cpp:60-135).
+#pragma once
+
+#include <mutex>
+#include <vector>
+
+namespace trpc {
+
+class LatencyRecorder;
+
+class Sampler {
+ public:
+  static Sampler* instance();
+  void add(LatencyRecorder* r);
+  void remove(LatencyRecorder* r);
+
+ private:
+  Sampler();
+  void run();
+  std::mutex mu_;
+  std::vector<LatencyRecorder*> recorders_;
+};
+
+}  // namespace trpc

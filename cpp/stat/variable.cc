@@ -1,0 +1,49 @@
+#include "stat/variable.h"
+
+#include <map>
+#include <mutex>
+
+namespace trpc {
+
+namespace {
+std::mutex g_vars_mu;
+std::map<std::string, Variable*>& vars() {
+  static std::map<std::string, Variable*> m;
+  return m;
+}
+}  // namespace
+
+Variable::~Variable() { hide(); }
+
+int Variable::expose(const std::string& name) {
+  std::lock_guard<std::mutex> g(g_vars_mu);
+  if (!name_.empty()) {
+    vars().erase(name_);
+  }
+  name_ = name;
+  vars()[name] = this;
+  return 0;
+}
+
+void Variable::hide() {
+  std::lock_guard<std::mutex> g(g_vars_mu);
+  if (!name_.empty()) {
+    auto it = vars().find(name_);
+    if (it != vars().end() && it->second == this) {
+      vars().erase(it);
+    }
+    name_.clear();
+  }
+}
+
+std::vector<std::pair<std::string, std::string>> Variable::dump_exposed() {
+  std::lock_guard<std::mutex> g(g_vars_mu);
+  std::vector<std::pair<std::string, std::string>> out;
+  out.reserve(vars().size());
+  for (auto& [name, var] : vars()) {
+    out.emplace_back(name, var->value_str());
+  }
+  return out;
+}
+
+}  // namespace trpc

@@ -1,0 +1,44 @@
+// Variable — named metric registry (parity: bvar::Variable,
+// /root/reference/src/bvar/variable.h:118 expose/dump_exposed, the substrate
+// of the /vars builtin service).
+#pragma once
+
+#include <functional>
+#include <string>
+#include <vector>
+
+namespace trpc {
+
+class Variable {
+ public:
+  virtual ~Variable();
+  virtual std::string value_str() const = 0;
+
+  // Registers under `name` (replaces any previous owner of the name).
+  int expose(const std::string& name);
+  void hide();
+  const std::string& name() const { return name_; }
+
+  static std::vector<std::pair<std::string, std::string>> dump_exposed();
+
+ private:
+  std::string name_;
+};
+
+// Pull-based variable: value computed by a callback at dump time (parity:
+// bvar::PassiveStatus).
+template <typename T>
+class PassiveStatus : public Variable {
+ public:
+  explicit PassiveStatus(std::function<T()> fn) : fn_(std::move(fn)) {}
+  ~PassiveStatus() override { hide(); }
+  std::string value_str() const override {
+    return std::to_string(fn_());
+  }
+  T get_value() const { return fn_(); }
+
+ private:
+  std::function<T()> fn_;
+};
+
+}  // namespace trpc

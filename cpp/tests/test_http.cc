@@ -1,0 +1,149 @@
+// HTTP + builtin services tests: one port serves BOTH tstd RPC and HTTP
+// (the multi-protocol feature, input_messenger.cpp:83 parity).
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <string>
+
+#include "base/time.h"
+#include "net/channel.h"
+#include "net/server.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+namespace {
+
+Server* g_server = nullptr;
+int g_port = 0;
+
+void start_once() {
+  if (g_server != nullptr) {
+    return;
+  }
+  g_server = new Server();
+  g_server->RegisterMethod("Echo.Echo", [](Controller*, const IOBuf& req,
+                                           IOBuf* resp, Closure done) {
+    resp->append(req);
+    done();
+  });
+  EXPECT_EQ(g_server->Start(0), 0);
+  g_port = g_server->port();
+}
+
+// Plain-socket HTTP client (the test is the wire).
+std::string http_get(const std::string& req_text) {
+  const int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in sa = {};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  sa.sin_port = htons(static_cast<uint16_t>(g_port));
+  EXPECT_EQ(connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)), 0);
+  EXPECT(write(fd, req_text.data(), req_text.size()) ==
+         static_cast<ssize_t>(req_text.size()));
+  std::string out;
+  char buf[4096];
+  // Read until headers+body complete (Content-Length framing).
+  while (true) {
+    const ssize_t n = read(fd, buf, sizeof(buf));
+    if (n <= 0) {
+      break;
+    }
+    out.append(buf, n);
+    const size_t he = out.find("\r\n\r\n");
+    if (he != std::string::npos) {
+      const size_t cl = out.find("Content-Length: ");
+      if (cl != std::string::npos) {
+        const size_t len = strtoul(out.c_str() + cl + 16, nullptr, 10);
+        if (out.size() >= he + 4 + len) {
+          break;
+        }
+      }
+    }
+  }
+  close(fd);
+  return out;
+}
+
+}  // namespace
+
+TEST_CASE(health_and_version) {
+  start_once();
+  std::string r = http_get("GET /health HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("200 OK") != std::string::npos);
+  EXPECT(r.find("OK\n") != std::string::npos);
+  r = http_get("GET /version HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("tpu-rpc/") != std::string::npos);
+}
+
+TEST_CASE(vars_and_status) {
+  // Generate some RPC traffic first so method vars exist.
+  Channel ch;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(g_port)), 0);
+  for (int i = 0; i < 5; ++i) {
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("ping");
+    ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+  }
+  std::string r = http_get("GET /vars HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("rpc_server_Echo.Echo") != std::string::npos);
+  r = http_get("GET /status HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("requests_served") != std::string::npos);
+  EXPECT(r.find("Echo.Echo") != std::string::npos);
+  r = http_get("GET /connections HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("live_sockets") != std::string::npos);
+}
+
+TEST_CASE(rpc_over_http) {
+  std::string body = "http-body-payload";
+  std::string req = "POST /Echo.Echo HTTP/1.1\r\nHost: x\r\nContent-Length: " +
+                    std::to_string(body.size()) + "\r\n\r\n" + body;
+  const std::string r = http_get(req);
+  EXPECT(r.find("200 OK") != std::string::npos);
+  EXPECT(r.find(body) != std::string::npos);
+}
+
+TEST_CASE(http_404) {
+  const std::string r = http_get("GET /nope HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("404") != std::string::npos);
+}
+
+TEST_CASE(keep_alive_multiple_requests) {
+  const int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in sa = {};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  sa.sin_port = htons(static_cast<uint16_t>(g_port));
+  EXPECT_EQ(connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)), 0);
+  for (int i = 0; i < 3; ++i) {
+    const std::string req = "GET /health HTTP/1.1\r\nHost: x\r\n\r\n";
+    EXPECT(write(fd, req.data(), req.size()) ==
+           static_cast<ssize_t>(req.size()));
+    char buf[1024];
+    ssize_t n = read(fd, buf, sizeof(buf));
+    EXPECT(n > 0);
+    EXPECT(std::string(buf, n).find("200 OK") != std::string::npos);
+  }
+  close(fd);
+}
+
+TEST_CASE(mixed_protocols_one_port) {
+  // tstd RPC and HTTP hitting the same port concurrently.
+  Channel ch;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(g_port)), 0);
+  for (int i = 0; i < 10; ++i) {
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("mixed");
+    ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+    const std::string r = http_get("GET /health HTTP/1.1\r\nHost: x\r\n\r\n");
+    EXPECT(r.find("200 OK") != std::string::npos);
+  }
+}
+
+TEST_MAIN

@@ -1,0 +1,93 @@
+// L3 stat library unit tests (parity model: test/bvar_* in the reference).
+#include <unistd.h>
+
+#include <thread>
+#include <vector>
+
+#include "stat/latency_recorder.h"
+#include "stat/reducer.h"
+#include "stat/variable.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+TEST_CASE(adder_multi_thread) {
+  Adder a;
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 8; ++t) {
+    ts.emplace_back([&a] {
+      for (int i = 0; i < 10000; ++i) {
+        a << 1;
+      }
+    });
+  }
+  for (auto& t : ts) {
+    t.join();
+  }
+  EXPECT_EQ(a.get_value(), 80000);
+  EXPECT_EQ(a.reset(), 80000);
+  EXPECT_EQ(a.get_value(), 0);
+}
+
+TEST_CASE(maxer_miner) {
+  Maxer mx;
+  Miner mn;
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 4; ++t) {
+    ts.emplace_back([&, t] {
+      for (int i = 0; i < 1000; ++i) {
+        mx << (t * 1000 + i);
+        mn << (t * 1000 + i);
+      }
+    });
+  }
+  for (auto& t : ts) {
+    t.join();
+  }
+  EXPECT_EQ(mx.get_value(), 3999);
+  EXPECT_EQ(mn.get_value(), 0);
+}
+
+TEST_CASE(variable_registry) {
+  Adder a;
+  a << 42;
+  a.expose("test_adder_var");
+  bool found = false;
+  for (auto& [name, value] : Variable::dump_exposed()) {
+    if (name == "test_adder_var") {
+      found = true;
+      EXPECT(value == "42");
+    }
+  }
+  EXPECT(found);
+  a.hide();
+  for (auto& [name, value] : Variable::dump_exposed()) {
+    EXPECT(name != "test_adder_var");
+  }
+}
+
+TEST_CASE(passive_status) {
+  int x = 7;
+  PassiveStatus<int> ps([&x] { return x * 2; });
+  EXPECT(ps.value_str() == "14");
+  x = 10;
+  EXPECT_EQ(ps.get_value(), 20);
+}
+
+TEST_CASE(latency_recorder_percentiles) {
+  LatencyRecorder rec;
+  for (int i = 1; i <= 1000; ++i) {
+    rec << i;  // 1..1000 us
+  }
+  EXPECT_EQ(rec.count(), 1000);
+  EXPECT_EQ(rec.latency_max_us(), 1000);
+  // Force a sample without waiting a wall-clock second.
+  rec.take_sample();
+  const int64_t p50 = rec.latency_percentile_us(0.5);
+  EXPECT(p50 > 350 && p50 < 650);
+  const int64_t p99 = rec.latency_percentile_us(0.99);
+  EXPECT(p99 > 900);
+  EXPECT(rec.latency_avg_us() > 400 && rec.latency_avg_us() < 600);
+}
+
+TEST_MAIN

@@ -40,3 +40,11 @@ def test_fiber():
 
 def test_rpc():
     _run("test_rpc", timeout=180)
+
+
+def test_stat():
+    _run("test_stat")
+
+
+def test_http():
+    _run("test_http")
