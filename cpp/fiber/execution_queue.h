@@ -19,9 +19,25 @@ class ExecutionQueue {
   // handler(meta, items, n): consume a FIFO batch.  Return nonzero to stop.
   using Handler = int (*)(void* meta, T* items, size_t n);
 
-  void start(Handler handler, void* meta) {
+  // drop_fn (optional) disposes items discarded by a stop-drain (e.g. heap
+  // payloads the handler would have freed).
+  using DropFn = void (*)(T&);
+
+  void start(Handler handler, void* meta, DropFn drop_fn = nullptr) {
     handler_ = handler;
     meta_ = meta;
+    drop_fn_ = drop_fn;
+  }
+
+  // Reuse after a stop(): drains leftovers and accepts work again.  Only
+  // legal when no consumer is live (idle()).
+  void restart(Handler handler, void* meta, DropFn drop_fn = nullptr) {
+    drain(head_.exchange(nullptr, std::memory_order_acquire));
+    handler_ = handler;
+    meta_ = meta;
+    drop_fn_ = drop_fn;
+    running_.store(false, std::memory_order_relaxed);
+    stopped_.store(false, std::memory_order_release);
   }
 
   // Callable from any thread/fiber.  Returns 0, or -1 after stop().
@@ -45,12 +61,7 @@ class ExecutionQueue {
   void stop() { stopped_.store(true, std::memory_order_release); }
 
   ~ExecutionQueue() {
-    Node* rest = head_.exchange(nullptr, std::memory_order_acquire);
-    while (rest != nullptr) {
-      Node* next = rest->next;
-      delete rest;
-      rest = next;
-    }
+    drain(head_.exchange(nullptr, std::memory_order_acquire));
   }
 
   bool idle() const {
@@ -118,19 +129,26 @@ class ExecutionQueue {
         // Handler asked to stop: refuse new work, then drain (and free)
         // anything pushed concurrently so nodes can't leak.
         stopped_.store(true, std::memory_order_release);
-        Node* rest = head_.exchange(nullptr, std::memory_order_acquire);
-        while (rest != nullptr) {
-          Node* next = rest->next;
-          delete rest;
-          rest = next;
-        }
+        drain(head_.exchange(nullptr, std::memory_order_acquire));
         running_.store(false, std::memory_order_release);
         return;
       }
     }
   }
 
+  void drain(Node* chain) {
+    while (chain != nullptr) {
+      Node* next = chain->next;
+      if (drop_fn_ != nullptr) {
+        drop_fn_(chain->value);
+      }
+      delete chain;
+      chain = next;
+    }
+  }
+
   Handler handler_ = nullptr;
+  DropFn drop_fn_ = nullptr;
   void* meta_ = nullptr;
   std::atomic<Node*> head_{nullptr};
   std::atomic<bool> running_{false};

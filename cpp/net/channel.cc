@@ -8,6 +8,7 @@
 #include "fiber/timer.h"
 #include "net/messenger.h"
 #include "net/protocol.h"
+#include "net/stream.h"
 
 namespace trpc {
 
@@ -57,6 +58,12 @@ void tstd_process_response(InputMessage&& msg) {
     return;  // stale response (timed out / retried away): harmless
   }
   Controller* cntl = static_cast<Controller*>(data);
+  if (msg.meta.stream_id != 0 && cntl->call().offered_stream != 0) {
+    // Server accepted our stream: bind ids + adopt its advertised window.
+    stream_on_accept_response(cntl->call().offered_stream,
+                              msg.meta.stream_id, cntl->call().socket_id,
+                              msg.meta.ack_bytes);
+  }
   if (msg.meta.error_code != 0) {
     cntl->SetFailed(msg.meta.error_code, msg.meta.error_text);
   } else {
@@ -150,6 +157,10 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
   meta.type = RpcMeta::kRequest;
   meta.correlation_id = cid;
   meta.method = method;
+  meta.stream_id = cntl->call().offered_stream;  // stream offer piggyback
+  if (meta.stream_id != 0) {
+    meta.ack_bytes = stream_recv_window(meta.stream_id);  // advertise window
+  }
   IOBuf body = request;  // zero-copy share
   if (!cntl->request_attachment().empty()) {
     meta.attachment_size =

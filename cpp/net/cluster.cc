@@ -1,0 +1,389 @@
+#include "net/cluster.h"
+
+#include <errno.h>
+
+#include <algorithm>
+#include <atomic>
+#include <fstream>
+#include <sstream>
+
+#include "base/logging.h"
+#include "base/rand.h"
+#include "base/time.h"
+#include "fiber/fiber.h"
+
+namespace trpc {
+
+// ---- load balancers -------------------------------------------------------
+
+namespace {
+
+class RoundRobinLB : public LoadBalancer {
+ public:
+  size_t select(const std::vector<size_t>& healthy,
+                const std::vector<ServerNode>&, uint64_t, int) override {
+    return healthy[next_.fetch_add(1, std::memory_order_relaxed) %
+                   healthy.size()];
+  }
+
+ private:
+  std::atomic<uint64_t> next_{0};
+};
+
+class RandomLB : public LoadBalancer {
+ public:
+  size_t select(const std::vector<size_t>& healthy,
+                const std::vector<ServerNode>&, uint64_t, int) override {
+    return healthy[fast_rand_less_than(healthy.size())];
+  }
+};
+
+// Ketama-style ring on endpoint text (parity: policy/
+// consistent_hashing_load_balancer).
+class ConsistentHashLB : public LoadBalancer {
+ public:
+  size_t select(const std::vector<size_t>& healthy,
+                const std::vector<ServerNode>& nodes, uint64_t key,
+                int attempt) override {
+    // Jump to the first healthy node clockwise from hash(key); retries walk
+    // further clockwise.
+    size_t best = healthy[0];
+    uint64_t best_dist = UINT64_MAX;
+    const uint64_t h = mix(key);
+    for (size_t idx : healthy) {
+      const uint64_t nh = mix(EndPointHash()(nodes[idx].ep));
+      const uint64_t dist = nh - h;  // wrapping distance clockwise
+      if (dist < best_dist) {
+        best_dist = dist;
+        best = idx;
+      }
+    }
+    if (attempt > 0) {
+      return healthy[(std::find(healthy.begin(), healthy.end(), best) -
+                      healthy.begin() + attempt) %
+                     healthy.size()];
+    }
+    return best;
+  }
+
+ private:
+  static uint64_t mix(uint64_t v) {
+    v ^= v >> 33;
+    v *= 0xff51afd7ed558ccdull;
+    v ^= v >> 33;
+    return v;
+  }
+};
+
+}  // namespace
+
+LoadBalancer* LoadBalancer::create(const std::string& name) {
+  if (name == "rr" || name.empty()) {
+    return new RoundRobinLB();
+  }
+  if (name == "random") {
+    return new RandomLB();
+  }
+  if (name == "c_hash") {
+    return new ConsistentHashLB();
+  }
+  return nullptr;
+}
+
+// ---- naming services ------------------------------------------------------
+
+namespace {
+
+int parse_server_list(const std::string& text, std::vector<EndPoint>* out) {
+  std::stringstream ss(text);
+  std::string token;
+  while (std::getline(ss, token, ',')) {
+    // Trim whitespace/newlines.
+    const size_t b = token.find_first_not_of(" \t\r\n");
+    const size_t e = token.find_last_not_of(" \t\r\n");
+    if (b == std::string::npos) {
+      continue;
+    }
+    token = token.substr(b, e - b + 1);
+    EndPoint ep;
+    if (hostname2endpoint(token.c_str(), &ep) == 0) {
+      out->push_back(ep);
+    } else {
+      LOG(Warning) << "bad server '" << token << "' in list";
+    }
+  }
+  return out->empty() ? -1 : 0;
+}
+
+class ListNS : public NamingService {
+ public:
+  int resolve(const std::string& param, std::vector<EndPoint>* out) override {
+    return parse_server_list(param, out);
+  }
+};
+
+// One server per line (or comma separated), re-read each refresh.
+class FileNS : public NamingService {
+ public:
+  int resolve(const std::string& param, std::vector<EndPoint>* out) override {
+    std::ifstream in(param);
+    if (!in) {
+      return -1;
+    }
+    std::stringstream buf;
+    buf << in.rdbuf();
+    std::string text = buf.str();
+    for (char& c : text) {
+      if (c == '\n') {
+        c = ',';
+      }
+    }
+    return parse_server_list(text, out);
+  }
+};
+
+}  // namespace
+
+std::unique_ptr<NamingService> NamingService::create(const std::string& url,
+                                                     std::string* param) {
+  if (url.rfind("list://", 0) == 0) {
+    *param = url.substr(7);
+    return std::make_unique<ListNS>();
+  }
+  if (url.rfind("file://", 0) == 0) {
+    *param = url.substr(7);
+    return std::make_unique<FileNS>();
+  }
+  // Bare "host:port" degenerates to a one-server list.
+  *param = url;
+  return std::make_unique<ListNS>();
+}
+
+// ---- ClusterChannel -------------------------------------------------------
+
+ClusterChannel::~ClusterChannel() {
+  stopping_.store(true, std::memory_order_release);
+  if (refresher_started_.load(std::memory_order_acquire)) {
+    // Wake the refresher out of its sleep and wait for it to exit — it
+    // holds `this`, so destruction must not race it.
+    refresh_wake_.value.fetch_add(1, std::memory_order_release);
+    refresh_wake_.wake_all();
+    while (refresh_done_.value.load(std::memory_order_acquire) == 0) {
+      refresh_done_.wait(0, -1);
+    }
+  }
+}
+
+int ClusterChannel::Init(const std::string& naming_url,
+                         const std::string& lb_name, const Options* opts) {
+  if (opts != nullptr) {
+    opts_ = *opts;
+  }
+  lb_.reset(LoadBalancer::create(lb_name));
+  if (lb_ == nullptr) {
+    return -1;
+  }
+  ns_ = NamingService::create(naming_url, &ns_param_);
+  return refresh();
+}
+
+int ClusterChannel::refresh() {
+  std::vector<EndPoint> eps;
+  if (ns_->resolve(ns_param_, &eps) != 0) {
+    return -1;
+  }
+  // Preserve breaker state + channels of endpoints that survive.
+  auto fresh = std::make_shared<Cluster>();
+  {
+    auto cur = cluster_.Read();
+    const Cluster* old = cur->get();
+    for (const EndPoint& ep : eps) {
+      ServerNode node;
+      node.ep = ep;
+      std::shared_ptr<Channel> ch;
+      if (old != nullptr) {
+        for (size_t i = 0; i < old->nodes.size(); ++i) {
+          if (old->nodes[i].ep == ep) {
+            node = old->nodes[i];
+            ch = old->channels[i];
+            break;
+          }
+        }
+      }
+      if (ch == nullptr) {
+        ch = std::make_shared<Channel>();
+        Channel::Options copts;
+        copts.timeout_ms = opts_.timeout_ms;
+        if (ch->Init(endpoint2str(ep), &copts) != 0) {
+          continue;
+        }
+      }
+      fresh->nodes.push_back(std::move(node));
+      fresh->channels.push_back(std::move(ch));
+    }
+  }
+  if (fresh->nodes.empty()) {
+    return -1;
+  }
+  cluster_.Modify([&fresh](std::shared_ptr<Cluster>& c) {
+    c = fresh;
+    return true;
+  });
+  // Start the periodic refresher once.
+  bool expect = false;
+  if (refresher_started_.compare_exchange_strong(expect, true)) {
+    fiber_init(0);
+    fiber_start(nullptr, &ClusterChannel::refresh_fiber, this, 0);
+  }
+  return 0;
+}
+
+void ClusterChannel::refresh_fiber(void* arg) {
+  auto* self = static_cast<ClusterChannel*>(arg);
+  while (!self->stopping_.load(std::memory_order_acquire)) {
+    // Interruptible sleep: the destructor bumps refresh_wake_ to end it.
+    const uint32_t snap =
+        self->refresh_wake_.value.load(std::memory_order_acquire);
+    self->refresh_wake_.wait(
+        snap, monotonic_time_us() + self->opts_.refresh_interval_ms * 1000);
+    if (self->stopping_.load(std::memory_order_acquire)) {
+      break;
+    }
+    self->refresh();  // PeriodicNamingService parity
+  }
+  self->refresh_done_.value.store(1, std::memory_order_release);
+  self->refresh_done_.wake_all();
+}
+
+size_t ClusterChannel::healthy_count() {
+  auto cur = cluster_.Read();
+  const Cluster* c = cur->get();
+  if (c == nullptr) {
+    return 0;
+  }
+  const int64_t now = monotonic_time_us();
+  size_t n = 0;
+  for (const ServerNode& node : c->nodes) {
+    if (node.quarantined_until_us->load(std::memory_order_relaxed) <= now) {
+      ++n;
+    }
+  }
+  return n;
+}
+
+namespace {
+struct AsyncCall {
+  ClusterChannel* ch;
+  std::string method;
+  IOBuf request;
+  IOBuf* response;
+  Controller* cntl;
+  Closure done;
+  uint64_t hash_key;
+};
+}  // namespace
+
+void ClusterChannel::CallMethod(const std::string& method,
+                                const IOBuf& request, IOBuf* response,
+                                Controller* cntl, Closure done,
+                                uint64_t hash_key) {
+  if (done) {
+    // Async: the retry loop must not block the caller — run it in a fiber.
+    auto* call = new AsyncCall{this,     method, request, response,
+                               cntl,     {},     hash_key};
+    call->done = std::move(done);
+    fiber_start(
+        nullptr,
+        [](void* arg) {
+          std::unique_ptr<AsyncCall> c(static_cast<AsyncCall*>(arg));
+          c->ch->CallMethod(c->method, c->request, c->response, c->cntl,
+                            nullptr, c->hash_key);
+          c->done();
+        },
+        call, 0);
+    return;
+  }
+  std::shared_ptr<Cluster> cluster;
+  {
+    auto cur = cluster_.Read();
+    cluster = *cur;
+  }
+  if (cluster == nullptr || cluster->nodes.empty()) {
+    cntl->SetFailed(ENOENT, "no servers in cluster");
+    if (done) {
+      done();
+    }
+    return;
+  }
+  // Retry loop (sync under the hood; async wraps the final completion).
+  // Parity: retries pick a different node and quarantined nodes are skipped
+  // (circuit_breaker + cluster_recover semantics condensed).
+  const int attempts = 1 + opts_.max_retry;
+  std::vector<size_t> tried;
+  for (int attempt = 0; attempt < attempts; ++attempt) {
+    const int64_t now = monotonic_time_us();
+    std::vector<size_t> healthy;
+    for (size_t i = 0; i < cluster->nodes.size(); ++i) {
+      const ServerNode& n = cluster->nodes[i];
+      const bool quarantined =
+          n.quarantined_until_us->load(std::memory_order_relaxed) > now;
+      const bool already_tried =
+          std::find(tried.begin(), tried.end(), i) != tried.end();
+      if (!quarantined && !already_tried) {
+        healthy.push_back(i);
+      }
+    }
+    if (healthy.empty()) {
+      // All quarantined/tried: fall back to every untried node (cluster
+      // recovery — never fail purely because breakers are open).
+      for (size_t i = 0; i < cluster->nodes.size(); ++i) {
+        if (std::find(tried.begin(), tried.end(), i) == tried.end()) {
+          healthy.push_back(i);
+        }
+      }
+    }
+    if (healthy.empty()) {
+      break;  // genuinely nothing left
+    }
+    const size_t idx = lb_->select(healthy, cluster->nodes, hash_key, attempt);
+    tried.push_back(idx);
+    ServerNode& node = cluster->nodes[idx];
+
+    // Reset per-attempt state but preserve the caller's attachment (shared
+    // zero-copy, so re-attaching per retry is free).
+    IOBuf attachment = cntl->request_attachment();
+    cntl->Reset();
+    cntl->request_attachment() = std::move(attachment);
+    cntl->set_timeout_ms(opts_.timeout_ms);
+    const bool last_attempt = attempt == attempts - 1;
+    cluster->channels[idx]->CallMethod(method, request, response, cntl);
+    if (!cntl->Failed()) {
+      node.consecutive_failures->store(0, std::memory_order_relaxed);
+      if (done) {
+        done();
+      }
+      return;
+    }
+    // Failure: feed the breaker (exponential quarantine).
+    const int fails =
+        node.consecutive_failures->fetch_add(1, std::memory_order_relaxed) +
+        1;
+    int64_t quarantine_ms = opts_.quarantine_base_ms;
+    for (int i = 1; i < fails && quarantine_ms < opts_.quarantine_max_ms;
+         ++i) {
+      quarantine_ms *= 2;
+    }
+    quarantine_ms = std::min(quarantine_ms, opts_.quarantine_max_ms);
+    node.quarantined_until_us->store(
+        monotonic_time_us() + quarantine_ms * 1000,
+        std::memory_order_relaxed);
+    if (last_attempt) {
+      break;
+    }
+  }
+  if (done) {
+    done();
+  }
+}
+
+}  // namespace trpc

@@ -1,0 +1,97 @@
+// Cluster client: naming services + load balancers + health quarantine.
+//
+// Parity (SURVEY.md §2.4): LoadBalancer over DoublyBufferedData
+// (/root/reference/src/brpc/load_balancer.h:35-95; policy/
+// {round_robin,randomized,consistent_hashing,p2c_ewma}_load_balancer),
+// NamingService push model (naming_service.h:45-56) with list:// and
+// file:// resolvers and periodic re-resolve, per-node CircuitBreaker
+// (circuit_breaker.h:25-58) quarantining failed endpoints with growing
+// isolation windows, and retry with server exclusion.
+#pragma once
+
+#include <atomic>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "base/doubly_buffered.h"
+#include "base/endpoint.h"
+#include "fiber/event.h"
+#include "net/channel.h"
+#include "net/controller.h"
+
+namespace trpc {
+
+struct ServerNode {
+  EndPoint ep;
+  // Circuit-breaker state.
+  std::shared_ptr<std::atomic<int64_t>> quarantined_until_us =
+      std::make_shared<std::atomic<int64_t>>(0);
+  std::shared_ptr<std::atomic<int>> consecutive_failures =
+      std::make_shared<std::atomic<int>>(0);
+};
+
+class LoadBalancer {
+ public:
+  virtual ~LoadBalancer() = default;
+  // Picks an index into `nodes` (already filtered to healthy ones).
+  // `key` is the request hash for consistent hashing; `attempt` excludes
+  // previously tried nodes on retry.
+  virtual size_t select(const std::vector<size_t>& healthy,
+                        const std::vector<ServerNode>& nodes, uint64_t key,
+                        int attempt) = 0;
+  static LoadBalancer* create(const std::string& name);  // rr|random|c_hash
+};
+
+class NamingService {
+ public:
+  virtual ~NamingService() = default;
+  virtual int resolve(const std::string& param,
+                      std::vector<EndPoint>* out) = 0;
+  // "list://h1:p1,h2:p2" | "file:///path" | "host:port"
+  static std::unique_ptr<NamingService> create(const std::string& url,
+                                               std::string* param);
+};
+
+// Channel over a resolved cluster (parity: Channel::Init(ns_url, lb, opts)
+// composed via details/load_balancer_with_naming).
+class ClusterChannel {
+ public:
+  struct Options {
+    int64_t timeout_ms = 1000;
+    int max_retry = 2;                   // additional attempts on failure
+    int64_t refresh_interval_ms = 5000;  // periodic re-resolve
+    int64_t quarantine_base_ms = 100;    // doubles per consecutive failure
+    int64_t quarantine_max_ms = 10000;
+  };
+
+  ~ClusterChannel();
+  int Init(const std::string& naming_url, const std::string& lb_name,
+           const Options* opts = nullptr);
+  void CallMethod(const std::string& method, const IOBuf& request,
+                  IOBuf* response, Controller* cntl, Closure done = nullptr,
+                  uint64_t hash_key = 0);
+
+  // Re-resolves now (also runs periodically in a refresh fiber).
+  int refresh();
+  size_t healthy_count();
+
+ private:
+  struct Cluster {
+    std::vector<ServerNode> nodes;
+    std::vector<std::shared_ptr<Channel>> channels;  // parallel to nodes
+  };
+  static void refresh_fiber(void* arg);
+
+  std::unique_ptr<NamingService> ns_;
+  std::string ns_param_;
+  std::unique_ptr<LoadBalancer> lb_;
+  Options opts_;
+  DoublyBufferedData<std::shared_ptr<Cluster>> cluster_;
+  std::atomic<bool> stopping_{false};
+  std::atomic<bool> refresher_started_{false};
+  Event refresh_wake_;  // interrupts the refresher's sleep at shutdown
+  Event refresh_done_;  // value 1 once the refresher has exited
+};
+
+}  // namespace trpc

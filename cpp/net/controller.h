@@ -54,6 +54,12 @@ class Controller {
     Closure done;
     int64_t start_us = 0;
     uint64_t socket_id = 0;
+    // Streaming piggyback (net/stream.h): client-offered / request-carried /
+    // server-accepted stream ids.
+    uint64_t offered_stream = 0;
+    uint64_t peer_stream = 0;
+    uint64_t peer_stream_window = 0;
+    uint64_t accepted_stream = 0;
   };
   CallState& call() { return call_; }
   void set_method(const std::string& m) { method_ = m; }

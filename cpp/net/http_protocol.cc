@@ -3,8 +3,11 @@
 #include <cstring>
 #include <string>
 
+#include <memory>
+
 #include "base/logging.h"
 #include "base/time.h"
+#include "fiber/sync.h"
 #include "net/server.h"
 #include "net/socket.h"
 
@@ -137,7 +140,11 @@ void http_process_request(InputMessage&& msg) {
   const SocketId sid = msg.socket;
   const int64_t start_us = monotonic_time_us();
   std::shared_ptr<LatencyRecorder> lat = prop->latency;
-  Closure done = [sid, cntl, response, srv, lat, start_us] {
+  // HTTP/1.1 has no correlation id: responses must leave in request order.
+  // The read fiber parks on this latch until done() fires, so even an
+  // asynchronous handler cannot let a later pipelined response overtake.
+  auto latch = std::make_shared<CountdownEvent>(1);
+  Closure done = [sid, cntl, response, srv, lat, start_us, latch] {
     if (cntl->Failed()) {
       http_respond(sid, 500, "Internal Server Error", "text/plain",
                    cntl->error_text() + "\n");
@@ -151,8 +158,10 @@ void http_process_request(InputMessage&& msg) {
     }
     delete response;
     delete cntl;
+    latch->signal();
   };
   prop->handler(cntl, msg.payload, response, std::move(done));
+  latch->wait(-1);
 }
 
 void http_process_response(InputMessage&&) {

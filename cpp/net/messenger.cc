@@ -5,6 +5,7 @@
 #include "base/logging.h"
 #include "fiber/fiber.h"
 #include "net/protocol.h"
+#include "net/stream.h"
 
 namespace trpc {
 
@@ -58,6 +59,13 @@ void cut_and_dispatch(Socket* s, SocketId id) {
     }
     switch (rc) {
       case ParseError::kOk: {
+        if (msg->meta.type == RpcMeta::kStreamFrame) {
+          // Stream frames keep per-connection arrival order: handled inline
+          // (the per-stream ExecutionQueue serializes the user callback).
+          stream_on_frame(std::move(*msg));
+          delete msg;
+          continue;
+        }
         const Protocol* p = protocol_at(s->pinned_protocol);
         if (p != nullptr && p->process_in_order) {
           // FIFO protocols (no correlation id): run inline, keeping this

@@ -53,6 +53,9 @@ std::string encode_meta(const RpcMeta& m) {
   put_u64(&s, m.correlation_id);
   put_u32(&s, static_cast<uint32_t>(m.error_code));
   put_u32(&s, m.attachment_size);
+  put_u64(&s, m.stream_id);
+  s.push_back(static_cast<char>(m.stream_flags));
+  put_u64(&s, m.ack_bytes);
   put_u32(&s, static_cast<uint32_t>(m.method.size()));
   s.append(m.method);
   put_u32(&s, static_cast<uint32_t>(m.error_text.size()));
@@ -63,7 +66,7 @@ std::string encode_meta(const RpcMeta& m) {
 bool decode_meta(const std::string& s, RpcMeta* m) {
   const char* p = s.data();
   const char* end = p + s.size();
-  if (end - p < 1 + 8 + 4 + 4 + 4) {
+  if (end - p < 1 + 8 + 4 + 4 + 8 + 1 + 8 + 4) {
     return false;
   }
   m->type = static_cast<RpcMeta::Type>(*p++);
@@ -73,6 +76,11 @@ bool decode_meta(const std::string& s, RpcMeta* m) {
   p += 4;
   m->attachment_size = get_u32(p);
   p += 4;
+  m->stream_id = get_u64(p);
+  p += 8;
+  m->stream_flags = static_cast<uint8_t>(*p++);
+  m->ack_bytes = get_u64(p);
+  p += 8;
   const uint32_t mlen = get_u32(p);
   p += 4;
   // 64-bit arithmetic: mlen near UINT32_MAX must not wrap the bound check.

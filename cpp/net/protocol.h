@@ -26,11 +26,23 @@ enum class ParseError : int {
 };
 
 struct RpcMeta {
-  enum Type : uint8_t { kRequest = 0, kResponse = 1 };
+  enum Type : uint8_t { kRequest = 0, kResponse = 1, kStreamFrame = 2 };
+  // Stream flags (parity: streaming_rpc_meta.proto frame types).
+  enum StreamFlags : uint8_t {
+    kStreamData = 0,
+    kStreamClose = 1,
+    kStreamAck = 2,  // ack_bytes reopens the sender's credit window
+  };
   Type type = kRequest;
   uint64_t correlation_id = 0;
   int32_t error_code = 0;
   uint32_t attachment_size = 0;  // trailing bytes of payload
+  // Streaming: a request/response carrying stream_id offers/accepts a
+  // stream (stream settings piggyback, baidu_rpc_protocol.cpp:633 parity);
+  // a kStreamFrame addresses the RECEIVER's stream id.
+  uint64_t stream_id = 0;
+  uint8_t stream_flags = 0;
+  uint64_t ack_bytes = 0;
   std::string method;
   std::string error_text;
 };

@@ -11,6 +11,7 @@
 #include "fiber/fiber.h"
 #include "net/http_protocol.h"
 #include "net/messenger.h"
+#include "net/stream.h"
 #include "net/protocol.h"
 
 namespace trpc {
@@ -119,6 +120,9 @@ void tstd_process_request(InputMessage&& msg) {
 
   auto* cntl = new Controller();
   cntl->set_method(method);
+  cntl->call().socket_id = socket_id;
+  cntl->call().peer_stream = msg.meta.stream_id;
+  cntl->call().peer_stream_window = msg.meta.ack_bytes;
   auto* response = new IOBuf();
   const int64_t start_us = monotonic_time_us();
   const Server::MethodProperty* prop =
@@ -132,6 +136,10 @@ void tstd_process_request(InputMessage&& msg) {
     meta.correlation_id = cid;
     meta.error_code = cntl->error_code();
     meta.error_text = cntl->error_text();
+    meta.stream_id = cntl->call().accepted_stream;  // acceptance piggyback
+    if (meta.stream_id != 0) {
+      meta.ack_bytes = stream_recv_window(meta.stream_id);
+    }
     IOBuf frame;
     if (!cntl->response_attachment().empty()) {
       meta.attachment_size =

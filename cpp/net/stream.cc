@@ -1,0 +1,403 @@
+#include "net/stream.h"
+
+#include <cerrno>
+
+#include "base/logging.h"
+#include "base/resource_pool.h"
+#include "base/time.h"
+#include "fiber/event.h"
+#include "fiber/execution_queue.h"
+#include "fiber/fiber.h"
+#include "net/protocol.h"
+#include "net/socket.h"
+
+namespace trpc {
+
+namespace {
+
+struct StreamMeta {
+  std::atomic<uint32_t> version{0};  // even = idle slot
+  uint32_t slot = 0;
+  // Guards version transitions vs queue submission (closes the
+  // validated-then-recycled race on arriving frames).
+  std::atomic_flag mu = ATOMIC_FLAG_INIT;
+  void lock() {
+    while (mu.test_and_set(std::memory_order_acquire)) {
+    }
+  }
+  void unlock() { mu.clear(std::memory_order_release); }
+
+  SocketId sock = 0;
+  std::atomic<uint64_t> peer_sid{0};  // 0 until established
+  Event established_ev;               // value flips 0→1 when peer_sid set
+
+  StreamOptions opts;
+
+  // Sender credit (bytes we may still send before more ACKs).
+  std::atomic<int64_t> send_window{0};
+  Event window_ev;  // bumped on every ACK / close
+
+  // Receiver: consumed-but-unacked bytes; ACK when above half window.
+  std::atomic<int64_t> unacked{0};
+
+  std::atomic<bool> closed{false};
+  Event close_ev;  // value flips 0→1 on close
+
+  // Allocated once per slot and REUSED across stream incarnations (type-
+  // stable, like the meta itself) so late frames can never touch freed
+  // memory; stopped_ rejects them instead.
+  ExecutionQueue<IOBuf*>* consume_q = nullptr;
+
+  StreamId id() const {
+    return (static_cast<uint64_t>(version.load(std::memory_order_relaxed))
+            << 32) |
+           slot;
+  }
+};
+
+using StreamPool = ResourcePool<StreamMeta>;
+
+void mark_closed(StreamMeta* m);
+
+void drop_chunk(IOBuf*& chunk) { delete chunk; }
+
+StreamMeta* stream_of(StreamId id) {
+  const uint32_t ver = static_cast<uint32_t>(id >> 32);
+  if ((ver & 1) == 0) {
+    return nullptr;
+  }
+  StreamMeta* m = StreamPool::instance()->at(static_cast<uint32_t>(id));
+  if (m == nullptr || m->version.load(std::memory_order_acquire) != ver) {
+    return nullptr;
+  }
+  return m;
+}
+
+// Sends accumulated credit back when above half the granted window.  A
+// stream whose peer is not yet bound (early frames racing the accept
+// response) keeps accumulating; the bind path re-tries the ack.
+void maybe_send_ack(StreamMeta* m) {
+  const uint64_t peer = m->peer_sid.load(std::memory_order_acquire);
+  if (peer == 0) {
+    return;
+  }
+  const int64_t unacked = m->unacked.load(std::memory_order_acquire);
+  if (unacked < m->opts.window_bytes / 2) {
+    return;
+  }
+  m->unacked.fetch_sub(unacked, std::memory_order_acq_rel);
+  RpcMeta ack;
+  ack.type = RpcMeta::kStreamFrame;
+  ack.stream_flags = RpcMeta::kStreamAck;
+  ack.stream_id = peer;
+  ack.ack_bytes = static_cast<uint64_t>(unacked);
+  IOBuf frame;
+  tstd_pack(&frame, ack, IOBuf());
+  SocketRef s(Socket::Address(m->sock));
+  if (s) {
+    s->Write(std::move(frame));
+  }
+}
+
+int consume_handler(void* meta, IOBuf** chunks, size_t n) {
+  StreamMeta* m = static_cast<StreamMeta*>(meta);
+  const StreamId sid = m->id();
+  for (size_t i = 0; i < n; ++i) {
+    IOBuf* chunk = chunks[i];
+    if (chunk == nullptr) {
+      // CLOSE sentinel: rides the queue so every data chunk ahead of it is
+      // delivered first (ordered close).  Nothing may touch `m` after
+      // mark_closed — on_closed typically calls StreamClose which recycles
+      // the meta.
+      mark_closed(m);
+      return 1;
+    }
+    const size_t bytes = chunk->size();
+    if (m->opts.on_message && !m->closed.load(std::memory_order_acquire)) {
+      m->opts.on_message(sid, std::move(*chunk));
+    }
+    delete chunk;
+    m->unacked.fetch_add(bytes, std::memory_order_acq_rel);
+    maybe_send_ack(m);  // feedback frame parity
+  }
+  return 0;
+}
+
+StreamId new_stream(const StreamOptions& opts) {
+  StreamMeta* m = nullptr;
+  const uint32_t slot = StreamPool::instance()->acquire(&m);
+  if (m == nullptr) {
+    return 0;
+  }
+  m->slot = slot;
+  m->opts = opts;
+  m->sock = 0;
+  m->peer_sid.store(0, std::memory_order_relaxed);
+  m->established_ev.value.store(0, std::memory_order_relaxed);
+  m->send_window.store(opts.window_bytes, std::memory_order_relaxed);
+  m->window_ev.value.store(0, std::memory_order_relaxed);
+  m->unacked.store(0, std::memory_order_relaxed);
+  m->closed.store(false, std::memory_order_relaxed);
+  m->close_ev.value.store(0, std::memory_order_relaxed);
+  if (m->consume_q != nullptr) {
+    // Previous incarnation's consumer must finish before the queue is
+    // reconfigured (frames can't enter: it is stopped).
+    while (!m->consume_q->idle()) {
+      if (in_fiber()) {
+        fiber_yield();
+      } else {
+        sched_yield();
+      }
+    }
+  }
+  m->lock();
+  if (m->consume_q == nullptr) {
+    m->consume_q = new ExecutionQueue<IOBuf*>();
+    m->consume_q->start(consume_handler, m, drop_chunk);
+  } else {
+    m->consume_q->restart(consume_handler, m, drop_chunk);
+  }
+  const uint32_t ver = m->version.load(std::memory_order_relaxed) + 1;
+  m->version.store(ver, std::memory_order_release);
+  m->unlock();
+  return m->id();
+}
+
+void mark_closed(StreamMeta* m) {
+  if (m->closed.exchange(true, std::memory_order_acq_rel)) {
+    return;
+  }
+  m->close_ev.value.store(1, std::memory_order_release);
+  m->close_ev.wake_all();
+  m->window_ev.value.fetch_add(1, std::memory_order_release);
+  m->window_ev.wake_all();
+  if (m->opts.on_closed) {
+    m->opts.on_closed(m->id());
+  }
+}
+
+}  // namespace
+
+int StreamCreate(StreamId* out, Controller* cntl, const StreamOptions& opts) {
+  const StreamId sid = new_stream(opts);
+  if (sid == 0) {
+    return ENOMEM;
+  }
+  cntl->call().offered_stream = sid;
+  *out = sid;
+  return 0;
+}
+
+int StreamAccept(StreamId* out, Controller* cntl, const StreamOptions& opts) {
+  if (cntl->call().peer_stream == 0) {
+    return EINVAL;  // request offered no stream
+  }
+  const StreamId sid = new_stream(opts);
+  if (sid == 0) {
+    return ENOMEM;
+  }
+  StreamMeta* m = stream_of(sid);
+  m->sock = cntl->call().socket_id;
+  m->peer_sid.store(cntl->call().peer_stream, std::memory_order_release);
+  // Our send credit is whatever receive window the CLIENT advertised.
+  m->send_window.store(
+      static_cast<int64_t>(cntl->call().peer_stream_window),
+      std::memory_order_release);
+  m->established_ev.value.store(1, std::memory_order_release);
+  m->established_ev.wake_all();
+  cntl->call().accepted_stream = sid;  // rides back in the response meta
+  *out = sid;
+  return 0;
+}
+
+int StreamWrite(StreamId id, IOBuf&& data) {
+  StreamMeta* m = stream_of(id);
+  if (m == nullptr) {
+    return EINVAL;
+  }
+  // Wait for establishment (client side: response not yet back).
+  while (m->established_ev.value.load(std::memory_order_acquire) == 0) {
+    if (m->closed.load(std::memory_order_acquire)) {
+      return EPIPE;
+    }
+    m->established_ev.wait(0, monotonic_time_us() + 10 * 1000 * 1000);
+    if (stream_of(id) != m) {
+      return EINVAL;
+    }
+  }
+  const int64_t bytes = static_cast<int64_t>(data.size());
+  // Credit gate: park until the window admits this chunk.  Each wakeup
+  // also probes the connection so a dead peer (no CLOSE ever arriving)
+  // unparks the writer within one probe interval.
+  int64_t window = m->send_window.load(std::memory_order_acquire);
+  while (true) {
+    if (m->closed.load(std::memory_order_acquire) || stream_of(id) != m) {
+      return EPIPE;
+    }
+    {
+      SocketRef s(Socket::Address(m->sock));
+      if (!s || s->Failed()) {
+        mark_closed(m);
+        return EPIPE;
+      }
+    }
+    if (window >= bytes) {
+      if (m->send_window.compare_exchange_weak(window, window - bytes,
+                                               std::memory_order_acq_rel)) {
+        break;
+      }
+      continue;  // `window` reloaded by the failed CAS
+    }
+    const uint32_t snap = m->window_ev.value.load(std::memory_order_acquire);
+    window = m->send_window.load(std::memory_order_acquire);
+    if (window >= bytes) {
+      continue;  // refilled between checks
+    }
+    m->window_ev.wait(snap, monotonic_time_us() + 1000 * 1000);
+    window = m->send_window.load(std::memory_order_acquire);
+  }
+  RpcMeta meta;
+  meta.type = RpcMeta::kStreamFrame;
+  meta.stream_flags = RpcMeta::kStreamData;
+  meta.stream_id = m->peer_sid.load(std::memory_order_acquire);
+  IOBuf frame;
+  tstd_pack(&frame, meta, data);
+  SocketRef s(Socket::Address(m->sock));
+  if (!s || s->Write(std::move(frame)) != 0) {
+    mark_closed(m);
+    return EPIPE;
+  }
+  return 0;
+}
+
+int StreamClose(StreamId id) {
+  StreamMeta* m = stream_of(id);
+  if (m == nullptr) {
+    return EINVAL;
+  }
+  // Best-effort CLOSE to the peer.
+  const uint64_t peer = m->peer_sid.load(std::memory_order_acquire);
+  if (peer != 0 && !m->closed.load(std::memory_order_acquire)) {
+    RpcMeta meta;
+    meta.type = RpcMeta::kStreamFrame;
+    meta.stream_flags = RpcMeta::kStreamClose;
+    meta.stream_id = peer;
+    IOBuf frame;
+    tstd_pack(&frame, meta, IOBuf());
+    SocketRef s(Socket::Address(m->sock));
+    if (s) {
+      s->Write(std::move(frame));
+    }
+  }
+  mark_closed(m);
+  // Destroy the local id under the meta lock: frame submission validates
+  // the version under the same lock, so no frame can enter the queue after
+  // the bump; the queue itself is persistent (stopped, reused on next
+  // incarnation after it drains).
+  const uint32_t ver = static_cast<uint32_t>(id >> 32);
+  m->lock();
+  uint32_t expect = ver;
+  if (!m->version.compare_exchange_strong(expect, ver + 1,
+                                          std::memory_order_acq_rel)) {
+    m->unlock();
+    return 0;  // someone else destroyed concurrently
+  }
+  m->consume_q->stop();
+  m->unlock();
+  StreamPool::instance()->release(m->slot);
+  return 0;
+}
+
+int StreamWait(StreamId id, int64_t deadline_us) {
+  StreamMeta* m = stream_of(id);
+  if (m == nullptr) {
+    return 0;  // already gone == closed
+  }
+  while (!m->closed.load(std::memory_order_acquire)) {
+    if (stream_of(id) != m) {
+      return 0;
+    }
+    const int rc = m->close_ev.wait(0, deadline_us);
+    if (rc == ETIMEDOUT) {
+      return rc;
+    }
+  }
+  return 0;
+}
+
+bool StreamExists(StreamId id) { return stream_of(id) != nullptr; }
+
+// ---- wiring ---------------------------------------------------------------
+
+void stream_on_frame(InputMessage&& msg) {
+  StreamMeta* m = stream_of(msg.meta.stream_id);
+  if (m == nullptr) {
+    return;  // stale frame after close: harmless (versioned id armor)
+  }
+  switch (msg.meta.stream_flags) {
+    case RpcMeta::kStreamData: {
+      auto* chunk = new IOBuf(std::move(msg.payload));
+      // Submit under the meta lock so a concurrent StreamClose (version
+      // bump + queue stop under the same lock) can't recycle the slot
+      // between our validation and the enqueue.
+      m->lock();
+      const bool ok =
+          m->version.load(std::memory_order_relaxed) ==
+              static_cast<uint32_t>(msg.meta.stream_id >> 32) &&
+          m->consume_q != nullptr && m->consume_q->execute(chunk) == 0;
+      m->unlock();
+      if (!ok) {
+        delete chunk;
+      }
+      break;
+    }
+    case RpcMeta::kStreamAck:
+      m->send_window.fetch_add(static_cast<int64_t>(msg.meta.ack_bytes),
+                               std::memory_order_acq_rel);
+      m->window_ev.value.fetch_add(1, std::memory_order_release);
+      m->window_ev.wake_all();
+      break;
+    case RpcMeta::kStreamClose: {
+      // Ordered close: deliver queued data first via the sentinel.
+      m->lock();
+      const bool ver_ok =
+          m->version.load(std::memory_order_relaxed) ==
+          static_cast<uint32_t>(msg.meta.stream_id >> 32);
+      const bool queued =
+          ver_ok && m->consume_q != nullptr &&
+          m->consume_q->execute(nullptr) == 0;
+      m->unlock();
+      if (ver_ok && !queued) {
+        mark_closed(m);
+      }
+      break;
+    }
+    default:
+      break;
+  }
+}
+
+void stream_on_accept_response(uint64_t local_sid, uint64_t peer_sid,
+                               uint64_t socket_id, uint64_t peer_window) {
+  StreamMeta* m = stream_of(local_sid);
+  if (m == nullptr) {
+    return;
+  }
+  m->sock = socket_id;
+  m->peer_sid.store(peer_sid, std::memory_order_release);
+  m->send_window.store(static_cast<int64_t>(peer_window),
+                       std::memory_order_release);
+  m->established_ev.value.store(1, std::memory_order_release);
+  m->established_ev.wake_all();
+}
+
+uint64_t stream_recv_window(StreamId id) {
+  StreamMeta* m = stream_of(id);
+  return m != nullptr ? static_cast<uint64_t>(m->opts.window_bytes) : 0;
+}
+
+void stream_on_connection_failed(uint64_t) {
+  // v1: streams discover death via write failure / close timeout.
+}
+
+}  // namespace trpc

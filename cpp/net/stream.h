@@ -1,0 +1,71 @@
+// Streaming RPC — ordered byte-chunk streams with credit flow control.
+//
+// Parity: brpc streaming (/root/reference/src/brpc/stream.h:106-150,
+// stream.cpp: Create :78, ExecutionQueue consumer :109/:582, credit-window
+// AppendIfNotFull :326, feedback frames via streaming_rpc_meta.proto).
+// Re-designed: a stream is a pooled versioned object bound to an existing
+// connection; frames ride the tstd protocol (meta.type = kStreamFrame) and
+// are consumed through a per-stream ExecutionQueue so handlers see chunks
+// in order; ACK frames reopen the writer's window, writers park on an
+// Event when credit runs out.
+//
+// Establishment piggybacks on a normal RPC (like the reference):
+//   client: StreamCreate(&sid, &cntl, opts); channel.CallMethod(...);
+//   server handler: StreamAccept(&sid, cntl, opts); ... done();
+// After the response returns, both sides may StreamWrite / receive
+// on_message callbacks.  Each side must StreamClose its own id.
+#pragma once
+
+#include <cstdint>
+#include <functional>
+
+#include "base/iobuf.h"
+#include "net/controller.h"
+
+namespace trpc {
+
+using StreamId = uint64_t;  // version<<32 | slot
+
+struct StreamOptions {
+  // Called in arrival order (serialized per stream), from a fiber.
+  std::function<void(StreamId, IOBuf&&)> on_message;
+  // Peer closed (or connection died).
+  std::function<void(StreamId)> on_closed;
+  int64_t window_bytes = 2 * 1024 * 1024;  // receive window we grant
+};
+
+// Client side: create a local stream and attach it to `cntl` so the next
+// CallMethod on that controller offers it to the server.
+int StreamCreate(StreamId* out, Controller* cntl, const StreamOptions& opts);
+
+// Server side: accept the stream offered by the current request (fails if
+// the request carries none).  Must be called before done().
+int StreamAccept(StreamId* out, Controller* cntl, const StreamOptions& opts);
+
+// Ordered write; parks the calling fiber while the peer's window is
+// exhausted.  Returns 0, EINVAL (gone), EPIPE (closed/conn dead).
+int StreamWrite(StreamId id, IOBuf&& data);
+
+// Graceful close: sends CLOSE (best effort) and destroys the local id.
+int StreamClose(StreamId id);
+
+// Park until the peer closes the stream (or it dies).  0 on close.
+int StreamWait(StreamId id, int64_t deadline_us = -1);
+
+// True while the id refers to a live stream.
+bool StreamExists(StreamId id);
+
+// -- internal (messenger hook) -------------------------------------------
+struct InputMessage;
+void stream_on_frame(InputMessage&& msg);
+// Bind the client stream to the server's accepted id (response path).
+// `peer_window` is the receive window the peer advertised — it becomes our
+// send credit (windows are exchanged at establishment, like the stream
+// settings in streaming_rpc_meta.proto).
+void stream_on_accept_response(uint64_t local_sid, uint64_t peer_sid,
+                               uint64_t socket_id, uint64_t peer_window);
+// The receive window a local stream grants (advertised to the peer).
+uint64_t stream_recv_window(StreamId id);
+void stream_on_connection_failed(uint64_t socket_id);
+
+}  // namespace trpc

@@ -7,6 +7,7 @@
 // process-unique id (same TLS pattern as DoublyBufferedData).
 #pragma once
 
+#include <algorithm>
 #include <atomic>
 #include <cstdint>
 #include <limits>
@@ -76,6 +77,15 @@ class Reducer : public Variable {
       if (p.first == id_) {
         return p.second.get();
       }
+    }
+    // Prune agents whose reducer died (we hold the only reference) so the
+    // per-thread list can't grow without bound across reducer lifetimes.
+    if (tls.size() > 64) {
+      tls.erase(std::remove_if(tls.begin(), tls.end(),
+                               [](const auto& p) {
+                                 return p.second.use_count() == 1;
+                               }),
+                tls.end());
     }
     auto agent = std::make_shared<Agent>();
     {

@@ -1,0 +1,169 @@
+// Cluster client tests: naming, LB spread, retry + circuit-breaker routing
+// around dead nodes (the reference tests LB/health with N in-process
+// servers, SURVEY.md §4).
+#include <unistd.h>
+
+#include <atomic>
+#include <fstream>
+#include <set>
+#include <string>
+
+#include "base/time.h"
+#include "fiber/sync.h"
+#include "net/cluster.h"
+#include "net/server.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+namespace {
+
+struct Node {
+  Server server;
+  int port = 0;
+};
+
+Node g_nodes[3];
+bool g_started = false;
+
+void start_nodes() {
+  if (g_started) {
+    return;
+  }
+  g_started = true;
+  for (int i = 0; i < 3; ++i) {
+    g_nodes[i].server.RegisterMethod(
+        "Echo.WhoAmI",
+        [i](Controller*, const IOBuf&, IOBuf* resp, Closure done) {
+          resp->append("node-" + std::to_string(i));
+          done();
+        });
+    EXPECT_EQ(g_nodes[i].server.Start(0), 0);
+    g_nodes[i].port = g_nodes[i].server.port();
+  }
+}
+
+std::string list_url() {
+  start_nodes();
+  std::string url = "list://";
+  for (int i = 0; i < 3; ++i) {
+    url += "127.0.0.1:" + std::to_string(g_nodes[i].port);
+    if (i < 2) {
+      url += ",";
+    }
+  }
+  return url;
+}
+
+std::string call_once(ClusterChannel& ch, uint64_t key = 0) {
+  Controller cntl;
+  IOBuf req, resp;
+  req.append("x");
+  ch.CallMethod("Echo.WhoAmI", req, &resp, &cntl, nullptr, key);
+  return cntl.Failed() ? "FAILED:" + std::to_string(cntl.error_code())
+                       : resp.to_string();
+}
+
+}  // namespace
+
+TEST_CASE(round_robin_spreads) {
+  ClusterChannel ch;
+  EXPECT_EQ(ch.Init(list_url(), "rr"), 0);
+  std::set<std::string> seen;
+  for (int i = 0; i < 9; ++i) {
+    seen.insert(call_once(ch));
+  }
+  EXPECT_EQ(seen.size(), 3u);  // all nodes hit
+}
+
+TEST_CASE(consistent_hash_stable) {
+  ClusterChannel ch;
+  EXPECT_EQ(ch.Init(list_url(), "c_hash"), 0);
+  const std::string first = call_once(ch, 12345);
+  EXPECT(first.rfind("node-", 0) == 0);
+  for (int i = 0; i < 10; ++i) {
+    EXPECT(call_once(ch, 12345) == first);  // same key → same node
+  }
+  std::set<std::string> spread;
+  for (uint64_t k = 0; k < 40; ++k) {
+    spread.insert(call_once(ch, k * 7919));
+  }
+  EXPECT(spread.size() >= 2);  // different keys spread
+}
+
+TEST_CASE(random_lb_works) {
+  ClusterChannel ch;
+  EXPECT_EQ(ch.Init(list_url(), "random"), 0);
+  for (int i = 0; i < 10; ++i) {
+    EXPECT(call_once(ch).rfind("node-", 0) == 0);
+  }
+}
+
+TEST_CASE(retry_routes_around_dead_node) {
+  start_nodes();
+  // Cluster includes a dead port; rr will hit it, retry must recover.
+  std::string url = list_url() + ",127.0.0.1:1";
+  ClusterChannel::Options opts;
+  opts.timeout_ms = 300;
+  opts.max_retry = 2;
+  ClusterChannel ch;
+  EXPECT_EQ(ch.Init(url, "rr", &opts), 0);
+  int ok = 0;
+  for (int i = 0; i < 12; ++i) {
+    if (call_once(ch).rfind("node-", 0) == 0) {
+      ++ok;
+    }
+  }
+  EXPECT_EQ(ok, 12);  // every call succeeded despite the dead node
+  // Breaker quarantined the dead node.
+  EXPECT(ch.healthy_count() <= 3u);
+}
+
+TEST_CASE(file_naming_service_and_refresh) {
+  start_nodes();
+  const std::string path = "/tmp/trpc_test_servers.txt";
+  {
+    std::ofstream out(path);
+    out << "127.0.0.1:" << g_nodes[0].port << "\n";
+  }
+  ClusterChannel::Options opts;
+  opts.refresh_interval_ms = 100;
+  ClusterChannel ch;
+  EXPECT_EQ(ch.Init("file://" + path, "rr", &opts), 0);
+  EXPECT(call_once(ch) == "node-0");
+  // Add the other two nodes; periodic refresh must pick them up.
+  {
+    std::ofstream out(path);
+    for (int i = 0; i < 3; ++i) {
+      out << "127.0.0.1:" << g_nodes[i].port << "\n";
+    }
+  }
+  std::set<std::string> seen;
+  const int64_t deadline = monotonic_time_us() + 3000000;
+  while (seen.size() < 3 && monotonic_time_us() < deadline) {
+    seen.insert(call_once(ch));
+    usleep(20000);
+  }
+  EXPECT_EQ(seen.size(), 3u);
+  unlink(path.c_str());
+}
+
+TEST_CASE(async_cluster_call) {
+  ClusterChannel ch;
+  EXPECT_EQ(ch.Init(list_url(), "rr"), 0);
+  static CountdownEvent latch(1);
+  auto* cntl = new Controller();
+  auto* resp = new IOBuf();
+  IOBuf req;
+  req.append("x");
+  ch.CallMethod("Echo.WhoAmI", req, resp, cntl, [cntl, resp] {
+    EXPECT(!cntl->Failed());
+    EXPECT(resp->to_string().rfind("node-", 0) == 0);
+    latch.signal();
+  });
+  EXPECT_EQ(latch.wait(monotonic_time_us() + 5000000), 0);
+  delete cntl;
+  delete resp;
+}
+
+TEST_MAIN

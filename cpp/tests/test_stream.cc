@@ -1,0 +1,192 @@
+// Streaming RPC tests (parity: test/brpc_streaming_rpc_unittest.cpp model —
+// establish over a normal RPC, ordered chunks, flow control, close).
+#include <unistd.h>
+
+#include <atomic>
+#include <string>
+#include <vector>
+
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "net/channel.h"
+#include "net/server.h"
+#include "net/stream.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+namespace {
+
+Server* g_server = nullptr;
+int g_port = 0;
+
+// Server-side stream state for assertions.
+std::atomic<int64_t> g_srv_bytes{0};
+std::atomic<int> g_srv_chunks{0};
+std::atomic<int> g_srv_closed{0};
+std::atomic<uint64_t> g_srv_last_seq{0};
+std::atomic<bool> g_srv_order_ok{true};
+std::atomic<int64_t> g_consume_delay_us{0};
+
+void start_once() {
+  if (g_server != nullptr) {
+    return;
+  }
+  g_server = new Server();
+  g_server->RegisterMethod(
+      "Stream.Open", [](Controller* cntl, const IOBuf&, IOBuf* resp,
+                        Closure done) {
+        StreamOptions opts;
+        opts.on_message = [](StreamId, IOBuf&& chunk) {
+          if (g_consume_delay_us.load() > 0) {
+            fiber_sleep_us(g_consume_delay_us.load());
+          }
+          // First 8 bytes carry a sequence number.
+          uint64_t seq = 0;
+          chunk.copy_to(&seq, 8);
+          const uint64_t last = g_srv_last_seq.exchange(seq);
+          if (seq != last + 1) {
+            g_srv_order_ok.store(false);
+          }
+          g_srv_bytes.fetch_add(chunk.size());
+          g_srv_chunks.fetch_add(1);
+        };
+        opts.on_closed = [](StreamId sid) {
+          g_srv_closed.fetch_add(1);
+          StreamClose(sid);
+        };
+        StreamId sid = 0;
+        if (StreamAccept(&sid, cntl, opts) != 0) {
+          resp->append("no-stream");
+          done();
+          return;
+        }
+        resp->append("accepted");
+        done();
+      });
+  EXPECT_EQ(g_server->Start(0), 0);
+  g_port = g_server->port();
+}
+
+}  // namespace
+
+TEST_CASE(stream_establish_write_close) {
+  start_once();
+  g_srv_bytes = 0;
+  g_srv_chunks = 0;
+  g_srv_last_seq = 0;
+  g_srv_order_ok = true;
+
+  Channel ch;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(g_port)), 0);
+  Controller cntl;
+  StreamId sid = 0;
+  EXPECT_EQ(StreamCreate(&sid, &cntl, StreamOptions{}), 0);
+  IOBuf req, resp;
+  req.append("open");
+  ch.CallMethod("Stream.Open", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+  EXPECT(resp.to_string() == "accepted");
+
+  // Write 100 ordered chunks from a fiber.
+  static StreamId s_sid;
+  s_sid = sid;
+  fiber_t writer;
+  fiber_start(&writer, [](void*) {
+    for (uint64_t seq = 1; seq <= 100; ++seq) {
+      IOBuf chunk;
+      chunk.append(&seq, 8);
+      chunk.append(std::string(1000, 'd'));
+      EXPECT_EQ(StreamWrite(s_sid, std::move(chunk)), 0);
+    }
+    StreamClose(s_sid);
+  }, nullptr);
+  fiber_join(writer);
+
+  const int64_t deadline = monotonic_time_us() + 5000000;
+  while ((g_srv_chunks.load() < 100 || g_srv_closed.load() < 1) &&
+         monotonic_time_us() < deadline) {
+    usleep(10000);
+  }
+  EXPECT_EQ(g_srv_chunks.load(), 100);
+  EXPECT_EQ(g_srv_bytes.load(), 100 * 1008);
+  EXPECT(g_srv_order_ok.load());     // strict arrival order
+  EXPECT_EQ(g_srv_closed.load(), 1);  // close propagated
+  EXPECT(!StreamExists(sid));
+}
+
+TEST_CASE(flow_control_backpressure) {
+  start_once();
+  g_srv_bytes = 0;
+  g_srv_chunks = 0;
+  g_srv_last_seq = 0;
+  g_srv_order_ok = true;
+  g_srv_closed = 0;
+  g_consume_delay_us = 20000;  // slow consumer: 20ms/chunk
+
+  Channel ch;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(g_port)), 0);
+  Controller cntl;
+  StreamId sid = 0;
+  StreamOptions copts;
+  copts.window_bytes = 256 * 1024;
+  EXPECT_EQ(StreamCreate(&sid, &cntl, copts), 0);
+  IOBuf req, resp;
+  req.append("open");
+  ch.CallMethod("Stream.Open", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+
+  // 40 chunks of 64KB = 2.5MB >> default 2MB server window with a slow
+  // consumer: the writer MUST be throttled (not instant).
+  static StreamId s_sid2;
+  s_sid2 = sid;
+  static std::atomic<int64_t> write_time_us{0};
+  fiber_t writer;
+  fiber_start(&writer, [](void*) {
+    const int64_t t0 = monotonic_time_us();
+    for (uint64_t seq = 1; seq <= 40; ++seq) {
+      IOBuf chunk;
+      chunk.append(&seq, 8);
+      chunk.append(std::string(64 * 1024 - 8, 'f'));
+      EXPECT_EQ(StreamWrite(s_sid2, std::move(chunk)), 0);
+    }
+    write_time_us.store(monotonic_time_us() - t0);
+    StreamClose(s_sid2);
+  }, nullptr);
+  fiber_join(writer);
+
+  const int64_t deadline = monotonic_time_us() + 10000000;
+  while (g_srv_chunks.load() < 40 && monotonic_time_us() < deadline) {
+    usleep(10000);
+  }
+  EXPECT_EQ(g_srv_chunks.load(), 40);
+  EXPECT(g_srv_order_ok.load());
+  // 40 chunks × 20ms consume = 800ms total; a writer outpacing a 2MB window
+  // (32 chunks) must have been blocked for a good fraction of that.
+  EXPECT(write_time_us.load() > 100000);
+  g_consume_delay_us = 0;
+}
+
+TEST_CASE(write_without_stream_fails) {
+  EXPECT_EQ(StreamWrite(0, IOBuf()), EINVAL);
+  EXPECT_EQ(StreamWrite((0xdeadull << 33) | 1, IOBuf()), EINVAL);
+  EXPECT_EQ(StreamClose(0), EINVAL);
+  EXPECT_EQ(StreamWait(0), 0);
+}
+
+TEST_CASE(accept_without_offer_fails) {
+  start_once();
+  Channel ch;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(g_port)), 0);
+  // Register a method that tries to accept when nothing was offered.
+  // (Covered implicitly: call Stream.Open WITHOUT StreamCreate.)
+  Controller cntl;
+  IOBuf req, resp;
+  req.append("open");
+  ch.CallMethod("Stream.Open", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+  EXPECT(resp.to_string() == "no-stream");
+}
+
+TEST_MAIN

@@ -46,5 +46,13 @@ def test_stat():
     _run("test_stat")
 
 
+def test_cluster():
+    _run("test_cluster", timeout=180)
+
+
+def test_stream():
+    _run("test_stream", timeout=180)
+
+
 def test_http():
     _run("test_http")
