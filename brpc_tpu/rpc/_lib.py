@@ -90,6 +90,44 @@ def load_library() -> ctypes.CDLL:
                 ctypes.c_size_t,
             ]
             lib.trpc_endpoint_parse.restype = ctypes.c_int
+            # RPC surface (capi/rpc_capi.cc).
+            lib.trpc_server_create.restype = ctypes.c_void_p
+            lib.trpc_server_destroy.argtypes = [ctypes.c_void_p]
+            lib.trpc_server_register.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                ctypes.c_void_p,
+            ]
+            lib.trpc_server_register.restype = ctypes.c_int
+            lib.trpc_call_respond.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_int, ctypes.c_char_p,
+            ]
+            lib.trpc_call_respond.restype = ctypes.c_int
+            lib.trpc_server_start.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.trpc_server_start.restype = ctypes.c_int
+            lib.trpc_server_port.argtypes = [ctypes.c_void_p]
+            lib.trpc_server_port.restype = ctypes.c_int
+            lib.trpc_server_stop.argtypes = [ctypes.c_void_p]
+            lib.trpc_channel_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+            lib.trpc_channel_create.restype = ctypes.c_void_p
+            lib.trpc_channel_destroy.argtypes = [ctypes.c_void_p]
+            lib.trpc_channel_call.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_size_t, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.trpc_channel_call.restype = ctypes.c_int
+            lib.trpc_cluster_create.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
+            ]
+            lib.trpc_cluster_create.restype = ctypes.c_void_p
+            lib.trpc_cluster_destroy.argtypes = [ctypes.c_void_p]
+            lib.trpc_cluster_call.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_size_t, ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.trpc_cluster_call.restype = ctypes.c_int
             _lib = lib
     return _lib
 

@@ -1,0 +1,67 @@
+"""Python RPC clients: single-server Channel and ClusterChannel."""
+
+from __future__ import annotations
+
+import ctypes
+
+from brpc_tpu.rpc._lib import IOBuf, load_library
+
+
+class RpcError(Exception):
+    def __init__(self, code: int, text: str):
+        super().__init__(f"rpc failed (code {code}): {text}")
+        self.code = code
+        self.text = text
+
+
+def _call(lib, fn, ptr, method: str, request: bytes, extra) -> bytes:
+    resp = IOBuf()
+    err = ctypes.create_string_buffer(256)
+    rc = fn(ptr, method.encode(), request, len(request), resp._ptr, extra,
+            err, 256)
+    if rc != 0:
+        raise RpcError(rc, err.value.decode(errors="replace"))
+    return resp.to_bytes()
+
+
+class Channel:
+    """Client stub for one server (parity: cpp/net/channel.h)."""
+
+    def __init__(self, addr: str, timeout_ms: int = 1000):
+        self._lib = load_library()
+        self._ptr = self._lib.trpc_channel_create(addr.encode(), timeout_ms)
+        if not self._ptr:
+            raise ValueError(f"bad address: {addr!r}")
+
+    def call(self, method: str, request: bytes, timeout_ms: int = 0) -> bytes:
+        return _call(self._lib, self._lib.trpc_channel_call, self._ptr,
+                     method, request, timeout_ms)
+
+    def close(self) -> None:
+        ptr, self._ptr = self._ptr, None
+        if ptr:
+            self._lib.trpc_channel_destroy(ptr)
+
+
+class ClusterChannel:
+    """Client over a named cluster with LB + retry + circuit breaking
+    (parity: cpp/net/cluster.h).  naming_url: list://h:p,... or file://path;
+    lb: rr | random | c_hash."""
+
+    def __init__(self, naming_url: str, lb: str = "rr",
+                 timeout_ms: int = 1000, max_retry: int = 2):
+        self._lib = load_library()
+        self._ptr = self._lib.trpc_cluster_create(
+            naming_url.encode(), lb.encode(), timeout_ms, max_retry
+        )
+        if not self._ptr:
+            raise ValueError(f"cluster init failed: {naming_url!r}")
+
+    def call(self, method: str, request: bytes, hash_key: int = 0) -> bytes:
+        return _call(self._lib, self._lib.trpc_cluster_call, self._ptr,
+                     method, request, hash_key)
+
+    def close(self) -> None:
+        ptr, self._ptr = self._ptr, None
+        if ptr:
+            self._lib.trpc_cluster_destroy(ptr)

@@ -1,0 +1,167 @@
+// C ABI for the RPC runtime (Python ctypes binding surface).
+//
+// Handlers registered from Python are invoked on fiber stacks; ctypes
+// callbacks re-acquire the GIL themselves.  Responses are completed via
+// trpc_call_respond (sync or later — async handlers just stash the call
+// handle).
+#include <atomic>
+#include <cstring>
+#include <string>
+
+#include "base/iobuf.h"
+#include "fiber/event.h"
+#include "net/channel.h"
+#include "net/cluster.h"
+#include "net/controller.h"
+#include "net/server.h"
+
+using namespace trpc;
+
+namespace {
+
+struct PendingCall {
+  Controller* cntl;
+  IOBuf* response;
+  Closure done;
+  std::atomic<bool> responded{false};
+};
+
+using HandlerCb = void (*)(void* call_handle, const char* req, size_t req_len,
+                           void* user_ctx);
+
+}  // namespace
+
+extern "C" {
+
+// ---- server -------------------------------------------------------------
+
+void* trpc_server_create() { return new Server(); }
+
+void trpc_server_destroy(void* srv) { delete static_cast<Server*>(srv); }
+
+int trpc_server_register(void* srv, const char* method, HandlerCb cb,
+                         void* user_ctx) {
+  return static_cast<Server*>(srv)->RegisterMethod(
+      method, [cb, user_ctx](Controller* cntl, const IOBuf& req,
+                             IOBuf* resp, Closure done) {
+        auto* pending = new PendingCall();
+        pending->cntl = cntl;
+        pending->response = resp;
+        pending->done = std::move(done);
+        const std::string flat = req.to_string();
+        cb(pending, flat.data(), flat.size(), user_ctx);
+      });
+}
+
+// Completes a call (callable from the handler callback or any thread
+// later).  Idempotent: a second respond on the same handle is ignored, so
+// an async-handler/error-path race cannot double-complete.  err_text may be
+// null.  Returns 0 if this call completed the RPC, -1 if already done.
+int trpc_call_respond(void* call_handle, const char* data, size_t len,
+                      int err_code, const char* err_text) {
+  auto* pending = static_cast<PendingCall*>(call_handle);
+  bool expect = false;
+  if (!pending->responded.compare_exchange_strong(
+          expect, true, std::memory_order_acq_rel)) {
+    return -1;
+  }
+  if (err_code != 0) {
+    pending->cntl->SetFailed(err_code, err_text != nullptr ? err_text : "");
+  } else if (data != nullptr && len > 0) {
+    pending->response->append(data, len);
+  }
+  pending->done();
+  delete pending;
+  return 0;
+}
+
+int trpc_server_start(void* srv, int port) {
+  return static_cast<Server*>(srv)->Start(port);
+}
+
+int trpc_server_port(void* srv) { return static_cast<Server*>(srv)->port(); }
+
+void trpc_server_stop(void* srv) { static_cast<Server*>(srv)->Stop(); }
+
+// ---- single-server channel ---------------------------------------------
+
+void* trpc_channel_create(const char* addr, int64_t timeout_ms) {
+  auto* ch = new Channel();
+  Channel::Options opts;
+  opts.timeout_ms = timeout_ms;
+  if (ch->Init(addr, &opts) != 0) {
+    delete ch;
+    return nullptr;
+  }
+  return ch;
+}
+
+void trpc_channel_destroy(void* ch) { delete static_cast<Channel*>(ch); }
+
+// Synchronous call.  Returns 0 on success and fills *resp (a trpc_iobuf
+// handle created by the caller); on failure returns the error code and
+// copies the error text into err_buf.
+int trpc_channel_call(void* ch, const char* method, const char* req,
+                      size_t req_len, void* resp_iobuf, int64_t timeout_ms,
+                      char* err_buf, size_t err_buf_len) {
+  // GIL safety: a ctypes caller must return on the pthread it entered on,
+  // so any park inside the sync call blocks the thread, never migrates.
+  ScopedPthreadWait pin;
+  Controller cntl;
+  if (timeout_ms > 0) {
+    cntl.set_timeout_ms(timeout_ms);
+  }
+  IOBuf request;
+  request.append(req, req_len);
+  static_cast<Channel*>(ch)->CallMethod(
+      method, request, static_cast<IOBuf*>(resp_iobuf), &cntl);
+  if (cntl.Failed()) {
+    if (err_buf != nullptr && err_buf_len > 0) {
+      strncpy(err_buf, cntl.error_text().c_str(), err_buf_len - 1);
+      err_buf[err_buf_len - 1] = '\0';
+    }
+    return cntl.error_code() != 0 ? cntl.error_code() : -1;
+  }
+  return 0;
+}
+
+// ---- cluster channel ----------------------------------------------------
+
+void* trpc_cluster_create(const char* naming_url, const char* lb,
+                          int64_t timeout_ms, int max_retry) {
+  auto* ch = new ClusterChannel();
+  ClusterChannel::Options opts;
+  opts.timeout_ms = timeout_ms;
+  opts.max_retry = max_retry;
+  if (ch->Init(naming_url, lb, &opts) != 0) {
+    delete ch;
+    return nullptr;
+  }
+  return ch;
+}
+
+void trpc_cluster_destroy(void* ch) {
+  delete static_cast<ClusterChannel*>(ch);
+}
+
+int trpc_cluster_call(void* ch, const char* method, const char* req,
+                      size_t req_len, void* resp_iobuf, uint64_t hash_key,
+                      char* err_buf, size_t err_buf_len) {
+  ScopedPthreadWait pin;  // see trpc_channel_call
+  Controller cntl;
+  IOBuf request;
+  request.append(req, req_len);
+  static_cast<ClusterChannel*>(ch)->CallMethod(
+      method, request, static_cast<IOBuf*>(resp_iobuf), &cntl, nullptr,
+      hash_key);
+  if (cntl.Failed()) {
+    if (err_buf != nullptr && err_buf_len > 0) {
+      strncpy(err_buf, cntl.error_text().c_str(), err_buf_len - 1);
+      err_buf[err_buf_len - 1] = '\0';
+    }
+    return cntl.error_code() != 0 ? cntl.error_code() : -1;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -130,12 +130,22 @@ void Event::publish_post(void* a1, void* a2) {
   }
 }
 
+thread_local bool tls_force_pthread_wait = false;
+
+ScopedPthreadWait::ScopedPthreadWait() : prev_(tls_force_pthread_wait) {
+  tls_force_pthread_wait = true;
+}
+
+ScopedPthreadWait::~ScopedPthreadWait() { tls_force_pthread_wait = prev_; }
+
+bool in_pthread_wait_mode() { return tls_force_pthread_wait; }
+
 int Event::wait(uint32_t expected, int64_t deadline_us) {
   if (value.load(std::memory_order_acquire) != expected) {
     return EWOULDBLOCK;
   }
   Worker* w = tls_worker;
-  if (w != nullptr && w->current() != nullptr) {
+  if (w != nullptr && w->current() != nullptr && !tls_force_pthread_wait) {
     // -- fiber path --
     EventWaiter* node = new EventWaiter();
     node->ev = this;

@@ -46,4 +46,22 @@ class Event {
 // Sleep usable from fibers (parks on a private Event) and pthreads.
 void fiber_sleep_until_us(int64_t deadline_us_monotonic);
 
+// While set on the calling thread, Event::wait blocks the PTHREAD even when
+// called from a fiber (no context switch, no migration).  Embedded-language
+// callbacks (ctypes) need this: CPython's GIL state is thread-bound, so a
+// parked fiber resuming on another worker would corrupt it.  Costs a worker
+// thread while blocked — the usercode_in_pthread trade-off
+// (/root/reference/src/brpc/details/usercode_backup_pool.h).
+class ScopedPthreadWait {
+ public:
+  ScopedPthreadWait();
+  ~ScopedPthreadWait();
+
+ private:
+  bool prev_;
+};
+
+// True while the calling thread is inside a ScopedPthreadWait region.
+bool in_pthread_wait_mode();
+
 }  // namespace trpc

@@ -109,6 +109,11 @@ void Scheduler::start(int workers) {
 
 void Scheduler::ready_to_run(FiberMeta* m, bool urgent) {
   Worker* w = tls_worker;
+  // A thread about to block pthread-style must not trap work in its own
+  // queues — it won't return to its scheduler loop until woken.
+  if (w != nullptr && in_pthread_wait_mode()) {
+    w = nullptr;
+  }
   if (w != nullptr) {
     if (urgent) {
       // Claim the worker's one-deep priority slot; it runs before the queue.
@@ -155,6 +160,14 @@ bool Scheduler::steal(FiberMeta** out, Worker* thief) {
       continue;
     }
     if (victim->runq().steal(out)) {
+      return true;
+    }
+    // The victim may be pthread-blocked with a fiber parked in its urgent
+    // slot; claim it so it can't starve.
+    FiberMeta* urgent =
+        victim->urgent_.exchange(nullptr, std::memory_order_acq_rel);
+    if (urgent != nullptr) {
+      *out = urgent;
       return true;
     }
   }

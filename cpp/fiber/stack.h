@@ -11,7 +11,9 @@ struct StackMem {
   size_t size = 0;
 };
 
-constexpr size_t kDefaultStackSize = 256 * 1024;
+// 1MB like the reference's NORMAL stacks (stack.h:56): pages commit lazily,
+// and embedded-language callbacks (Python handlers via capi) need headroom.
+constexpr size_t kDefaultStackSize = 1024 * 1024;
 
 StackMem allocate_stack(size_t size);
 void release_stack(StackMem s);

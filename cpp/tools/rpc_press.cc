@@ -1,0 +1,127 @@
+// rpc_press — generic load generator (parity: tools/rpc_press, the
+// benchmark harness named in BASELINE.json).
+//
+// Usage: rpc_press <addr|list://...> <method> [qps=0(max)] [payload=1024]
+//                  [fibers=32] [seconds=5] [lb=rr]
+// Prints one JSON line with qps achieved, goodput and latency percentiles.
+#include <algorithm>
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "net/cluster.h"
+#include "net/controller.h"
+
+using namespace trpc;
+
+namespace {
+
+struct PressArgs {
+  ClusterChannel* ch;
+  std::string method;
+  std::string payload;
+  int64_t stop_us;
+  int64_t interval_us;  // 0 = no rate limit
+  std::atomic<long>* ok;
+  std::atomic<long>* failed;
+  std::atomic<long>* resp_bytes;
+  std::vector<int64_t>* lat;
+};
+
+void press_fiber(void* p) {
+  PressArgs* a = static_cast<PressArgs*>(p);
+  IOBuf req;
+  req.append(a->payload);
+  int64_t next = monotonic_time_us();
+  while (monotonic_time_us() < a->stop_us) {
+    if (a->interval_us > 0) {
+      const int64_t now = monotonic_time_us();
+      if (now < next) {
+        fiber_sleep_us(next - now);
+      }
+      next += a->interval_us;
+    }
+    Controller cntl;
+    IOBuf resp;
+    const int64_t t0 = monotonic_time_us();
+    a->ch->CallMethod(a->method, req, &resp, &cntl);
+    if (cntl.Failed()) {
+      a->failed->fetch_add(1);
+    } else {
+      a->ok->fetch_add(1);
+      a->resp_bytes->fetch_add(static_cast<long>(resp.size()));
+      a->lat->push_back(monotonic_time_us() - t0);
+    }
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr,
+            "usage: %s <addr|list://h:p,...> <method> [qps=0] [payload=1024]"
+            " [fibers=32] [seconds=5] [lb=rr]\n",
+            argv[0]);
+    return 1;
+  }
+  const std::string addr = argv[1];
+  const std::string method = argv[2];
+  const long target_qps = argc > 3 ? atol(argv[3]) : 0;
+  const size_t payload = argc > 4 ? atol(argv[4]) : 1024;
+  const int fibers = argc > 5 ? atoi(argv[5]) : 32;
+  const int seconds = argc > 6 ? atoi(argv[6]) : 5;
+  const std::string lb = argc > 7 ? argv[7] : "rr";
+
+  ClusterChannel ch;
+  ClusterChannel::Options opts;
+  opts.timeout_ms = 5000;
+  if (ch.Init(addr, lb, &opts) != 0) {
+    fprintf(stderr, "cannot resolve %s\n", addr.c_str());
+    return 1;
+  }
+  std::atomic<long> ok{0}, failed{0}, resp_bytes{0};
+  std::vector<std::vector<int64_t>> lat(fibers);
+  std::vector<PressArgs> args(fibers);
+  std::vector<fiber_t> ids(fibers);
+  const int64_t t0 = monotonic_time_us();
+  const int64_t stop_us = t0 + seconds * 1000000LL;
+  const int64_t interval =
+      target_qps > 0 ? fibers * 1000000LL / target_qps : 0;
+  for (int i = 0; i < fibers; ++i) {
+    args[i] = PressArgs{&ch,     method,      std::string(payload, 'p'),
+                        stop_us, interval,    &ok,
+                        &failed, &resp_bytes, &lat[i]};
+    fiber_start(&ids[i], press_fiber, &args[i]);
+  }
+  for (auto f : ids) {
+    fiber_join(f);
+  }
+  const double secs = (monotonic_time_us() - t0) / 1e6;
+  std::vector<int64_t> all;
+  for (auto& v : lat) {
+    all.insert(all.end(), v.begin(), v.end());
+  }
+  std::sort(all.begin(), all.end());
+  auto pct = [&](double p) -> long {
+    return all.empty() ? 0
+                       : all[std::min(all.size() - 1,
+                                      static_cast<size_t>(p * all.size()))];
+  };
+  // Goodput counts bytes actually moved: requests out + responses in.
+  const double goodput =
+      (ok.load() * static_cast<double>(payload) + resp_bytes.load()) / secs /
+      1e6;
+  printf(
+      "{\"method\": \"%s\", \"fibers\": %d, \"payload\": %zu, "
+      "\"qps\": %.0f, \"goodput_MBps\": %.1f, \"p50_us\": %ld, "
+      "\"p99_us\": %ld, \"p999_us\": %ld, \"failures\": %ld}\n",
+      method.c_str(), fibers, payload, ok.load() / secs, goodput, pct(0.5),
+      pct(0.99), pct(0.999), failed.load());
+  return 0;
+}
