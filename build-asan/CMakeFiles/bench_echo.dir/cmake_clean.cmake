@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/bench_echo.dir/tools/bench_echo.cc.o"
+  "CMakeFiles/bench_echo.dir/tools/bench_echo.cc.o.d"
+  "bench_echo"
+  "bench_echo.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/bench_echo.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/rpc_press.dir/tools/rpc_press.cc.o"
+  "CMakeFiles/rpc_press.dir/tools/rpc_press.cc.o.d"
+  "rpc_press"
+  "rpc_press.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/rpc_press.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/rpc_replay.dir/tools/rpc_replay.cc.o"
+  "CMakeFiles/rpc_replay.dir/tools/rpc_replay.cc.o.d"
+  "rpc_replay"
+  "rpc_replay.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/rpc_replay.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/test_base.dir/tests/test_base.cc.o"
+  "CMakeFiles/test_base.dir/tests/test_base.cc.o.d"
+  "test_base"
+  "test_base.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/test_base.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

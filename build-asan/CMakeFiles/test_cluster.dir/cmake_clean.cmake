@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/test_cluster.dir/tests/test_cluster.cc.o"
+  "CMakeFiles/test_cluster.dir/tests/test_cluster.cc.o.d"
+  "test_cluster"
+  "test_cluster.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/test_cluster.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/test_fiber.dir/tests/test_fiber.cc.o"
+  "CMakeFiles/test_fiber.dir/tests/test_fiber.cc.o.d"
+  "test_fiber"
+  "test_fiber.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/test_fiber.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

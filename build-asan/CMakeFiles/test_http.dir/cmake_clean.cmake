@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/test_http.dir/tests/test_http.cc.o"
+  "CMakeFiles/test_http.dir/tests/test_http.cc.o.d"
+  "test_http"
+  "test_http.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/test_http.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/test_stat.dir/tests/test_stat.cc.o"
+  "CMakeFiles/test_stat.dir/tests/test_stat.cc.o.d"
+  "test_stat"
+  "test_stat.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/test_stat.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

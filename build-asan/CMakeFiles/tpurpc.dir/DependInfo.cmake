@@ -1,0 +1,61 @@
+
+# Consider dependencies only in project.
+set(CMAKE_DEPENDS_IN_PROJECT_ONLY OFF)
+
+# The set of languages for which implicit dependencies are needed:
+set(CMAKE_DEPENDS_LANGUAGES
+  "ASM"
+  )
+# The set of files for implicit dependencies of each language:
+set(CMAKE_DEPENDS_CHECK_ASM
+  "/root/repo/cpp/fiber/context.S" "/root/repo/build-asan/CMakeFiles/tpurpc.dir/fiber/context.S.o"
+  )
+set(CMAKE_ASM_COMPILER_ID "GNU")
+
+# Preprocessor definitions for this target.
+set(CMAKE_TARGET_DEFINITIONS_ASM
+  "tpurpc_EXPORTS"
+  )
+
+# The include file search paths:
+set(CMAKE_ASM_TARGET_INCLUDE_PATH
+  "/root/repo/cpp"
+  )
+
+# The set of dependency files which are needed:
+set(CMAKE_DEPENDS_DEPENDENCY_FILES
+  "/root/repo/cpp/base/arena.cc" "CMakeFiles/tpurpc.dir/base/arena.cc.o" "gcc" "CMakeFiles/tpurpc.dir/base/arena.cc.o.d"
+  "/root/repo/cpp/base/endpoint.cc" "CMakeFiles/tpurpc.dir/base/endpoint.cc.o" "gcc" "CMakeFiles/tpurpc.dir/base/endpoint.cc.o.d"
+  "/root/repo/cpp/base/iobuf.cc" "CMakeFiles/tpurpc.dir/base/iobuf.cc.o" "gcc" "CMakeFiles/tpurpc.dir/base/iobuf.cc.o.d"
+  "/root/repo/cpp/base/logging.cc" "CMakeFiles/tpurpc.dir/base/logging.cc.o" "gcc" "CMakeFiles/tpurpc.dir/base/logging.cc.o.d"
+  "/root/repo/cpp/base/recordio.cc" "CMakeFiles/tpurpc.dir/base/recordio.cc.o" "gcc" "CMakeFiles/tpurpc.dir/base/recordio.cc.o.d"
+  "/root/repo/cpp/capi/base_capi.cc" "CMakeFiles/tpurpc.dir/capi/base_capi.cc.o" "gcc" "CMakeFiles/tpurpc.dir/capi/base_capi.cc.o.d"
+  "/root/repo/cpp/capi/rpc_capi.cc" "CMakeFiles/tpurpc.dir/capi/rpc_capi.cc.o" "gcc" "CMakeFiles/tpurpc.dir/capi/rpc_capi.cc.o.d"
+  "/root/repo/cpp/fiber/event.cc" "CMakeFiles/tpurpc.dir/fiber/event.cc.o" "gcc" "CMakeFiles/tpurpc.dir/fiber/event.cc.o.d"
+  "/root/repo/cpp/fiber/fid.cc" "CMakeFiles/tpurpc.dir/fiber/fid.cc.o" "gcc" "CMakeFiles/tpurpc.dir/fiber/fid.cc.o.d"
+  "/root/repo/cpp/fiber/fls.cc" "CMakeFiles/tpurpc.dir/fiber/fls.cc.o" "gcc" "CMakeFiles/tpurpc.dir/fiber/fls.cc.o.d"
+  "/root/repo/cpp/fiber/scheduler.cc" "CMakeFiles/tpurpc.dir/fiber/scheduler.cc.o" "gcc" "CMakeFiles/tpurpc.dir/fiber/scheduler.cc.o.d"
+  "/root/repo/cpp/fiber/stack.cc" "CMakeFiles/tpurpc.dir/fiber/stack.cc.o" "gcc" "CMakeFiles/tpurpc.dir/fiber/stack.cc.o.d"
+  "/root/repo/cpp/fiber/timer.cc" "CMakeFiles/tpurpc.dir/fiber/timer.cc.o" "gcc" "CMakeFiles/tpurpc.dir/fiber/timer.cc.o.d"
+  "/root/repo/cpp/net/builtin.cc" "CMakeFiles/tpurpc.dir/net/builtin.cc.o" "gcc" "CMakeFiles/tpurpc.dir/net/builtin.cc.o.d"
+  "/root/repo/cpp/net/channel.cc" "CMakeFiles/tpurpc.dir/net/channel.cc.o" "gcc" "CMakeFiles/tpurpc.dir/net/channel.cc.o.d"
+  "/root/repo/cpp/net/cluster.cc" "CMakeFiles/tpurpc.dir/net/cluster.cc.o" "gcc" "CMakeFiles/tpurpc.dir/net/cluster.cc.o.d"
+  "/root/repo/cpp/net/dispatcher.cc" "CMakeFiles/tpurpc.dir/net/dispatcher.cc.o" "gcc" "CMakeFiles/tpurpc.dir/net/dispatcher.cc.o.d"
+  "/root/repo/cpp/net/http_protocol.cc" "CMakeFiles/tpurpc.dir/net/http_protocol.cc.o" "gcc" "CMakeFiles/tpurpc.dir/net/http_protocol.cc.o.d"
+  "/root/repo/cpp/net/messenger.cc" "CMakeFiles/tpurpc.dir/net/messenger.cc.o" "gcc" "CMakeFiles/tpurpc.dir/net/messenger.cc.o.d"
+  "/root/repo/cpp/net/protocol.cc" "CMakeFiles/tpurpc.dir/net/protocol.cc.o" "gcc" "CMakeFiles/tpurpc.dir/net/protocol.cc.o.d"
+  "/root/repo/cpp/net/server.cc" "CMakeFiles/tpurpc.dir/net/server.cc.o" "gcc" "CMakeFiles/tpurpc.dir/net/server.cc.o.d"
+  "/root/repo/cpp/net/socket.cc" "CMakeFiles/tpurpc.dir/net/socket.cc.o" "gcc" "CMakeFiles/tpurpc.dir/net/socket.cc.o.d"
+  "/root/repo/cpp/net/stream.cc" "CMakeFiles/tpurpc.dir/net/stream.cc.o" "gcc" "CMakeFiles/tpurpc.dir/net/stream.cc.o.d"
+  "/root/repo/cpp/net/tcp_transport.cc" "CMakeFiles/tpurpc.dir/net/tcp_transport.cc.o" "gcc" "CMakeFiles/tpurpc.dir/net/tcp_transport.cc.o.d"
+  "/root/repo/cpp/stat/latency_recorder.cc" "CMakeFiles/tpurpc.dir/stat/latency_recorder.cc.o" "gcc" "CMakeFiles/tpurpc.dir/stat/latency_recorder.cc.o.d"
+  "/root/repo/cpp/stat/sampler.cc" "CMakeFiles/tpurpc.dir/stat/sampler.cc.o" "gcc" "CMakeFiles/tpurpc.dir/stat/sampler.cc.o.d"
+  "/root/repo/cpp/stat/variable.cc" "CMakeFiles/tpurpc.dir/stat/variable.cc.o" "gcc" "CMakeFiles/tpurpc.dir/stat/variable.cc.o.d"
+  )
+
+# Targets to which this target links.
+set(CMAKE_TARGET_LINKED_INFO_FILES
+  )
+
+# Fortran module output directory.
+set(CMAKE_Fortran_TARGET_MODULE_DIR "")

@@ -1,0 +1,20 @@
+# CMake generated Testfile for 
+# Source directory: /root/repo/cpp
+# Build directory: /root/repo/build-asan
+# 
+# This file includes the relevant testing commands required for 
+# testing this directory and lists subdirectories to be tested as well.
+add_test(test_base "/root/repo/build-asan/test_base")
+set_tests_properties(test_base PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/cpp/CMakeLists.txt;41;add_test;/root/repo/cpp/CMakeLists.txt;0;")
+add_test(test_cluster "/root/repo/build-asan/test_cluster")
+set_tests_properties(test_cluster PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/cpp/CMakeLists.txt;41;add_test;/root/repo/cpp/CMakeLists.txt;0;")
+add_test(test_fiber "/root/repo/build-asan/test_fiber")
+set_tests_properties(test_fiber PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/cpp/CMakeLists.txt;41;add_test;/root/repo/cpp/CMakeLists.txt;0;")
+add_test(test_http "/root/repo/build-asan/test_http")
+set_tests_properties(test_http PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/cpp/CMakeLists.txt;41;add_test;/root/repo/cpp/CMakeLists.txt;0;")
+add_test(test_rpc "/root/repo/build-asan/test_rpc")
+set_tests_properties(test_rpc PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/cpp/CMakeLists.txt;41;add_test;/root/repo/cpp/CMakeLists.txt;0;")
+add_test(test_stat "/root/repo/build-asan/test_stat")
+set_tests_properties(test_stat PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/cpp/CMakeLists.txt;41;add_test;/root/repo/cpp/CMakeLists.txt;0;")
+add_test(test_stream "/root/repo/build-asan/test_stream")
+set_tests_properties(test_stream PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/cpp/CMakeLists.txt;41;add_test;/root/repo/cpp/CMakeLists.txt;0;")

@@ -8,17 +8,45 @@ namespace trpc {
 
 namespace {
 
+// Heap-owned TLS cache behind trivially-destructible thread_locals: blocks
+// are released during static destruction (sockets in static servers), after
+// this thread's non-trivial TLS has already died.
 struct TlsBlockCache {
   std::vector<Block*> blocks;
-  ~TlsBlockCache() {
-    for (Block* b : blocks) {
-      free(b);
+};
+
+struct TlsCacheGuard {
+  TlsBlockCache** slot = nullptr;
+  bool* dead = nullptr;
+  ~TlsCacheGuard() {
+    if (slot != nullptr && *slot != nullptr) {
+      for (Block* b : (*slot)->blocks) {
+        free(b);
+      }
+      delete *slot;
+      *slot = nullptr;
     }
-    blocks.clear();
+    if (dead != nullptr) {
+      *dead = true;
+    }
   }
 };
 
-thread_local TlsBlockCache g_tls_cache;
+TlsBlockCache* tls_cache() {
+  static thread_local TlsBlockCache* cache = nullptr;  // trivial dtor
+  static thread_local bool cache_dead = false;
+  static thread_local TlsCacheGuard guard;
+  if (cache_dead) {
+    return nullptr;
+  }
+  if (cache == nullptr) {
+    cache = new TlsBlockCache();
+    guard.slot = &cache;
+    guard.dead = &cache_dead;
+  }
+  return cache;
+}
+
 constexpr size_t kMaxCachedBlocks = 64;
 
 }  // namespace
@@ -35,14 +63,17 @@ void Block::release() {
 }
 
 HostArena* HostArena::instance() {
-  static HostArena arena;
-  return &arena;
+  // Deliberately leaked: blocks may be released at/after static destruction.
+  static HostArena* arena = new HostArena();
+  return arena;
 }
 
 Block* HostArena::allocate(uint32_t min_cap) {
-  if (min_cap <= kDefaultBlockSize && !g_tls_cache.blocks.empty()) {
-    Block* b = g_tls_cache.blocks.back();
-    g_tls_cache.blocks.pop_back();
+  TlsBlockCache* cache = tls_cache();
+  if (min_cap <= kDefaultBlockSize && cache != nullptr &&
+      !cache->blocks.empty()) {
+    Block* b = cache->blocks.back();
+    cache->blocks.pop_back();
     b->ref.store(1, std::memory_order_relaxed);
     b->size = 0;
     return b;
@@ -62,19 +93,24 @@ Block* HostArena::allocate(uint32_t min_cap) {
 }
 
 void HostArena::deallocate(Block* b) {
-  if (b->cap == kDefaultBlockSize &&
-      g_tls_cache.blocks.size() < kMaxCachedBlocks) {
-    g_tls_cache.blocks.push_back(b);
+  TlsBlockCache* cache = tls_cache();
+  if (b->cap == kDefaultBlockSize && cache != nullptr &&
+      cache->blocks.size() < kMaxCachedBlocks) {
+    cache->blocks.push_back(b);
     return;
   }
   free(b);
 }
 
 void HostArena::flush_tls_cache() {
-  for (Block* b : g_tls_cache.blocks) {
+  TlsBlockCache* cache = tls_cache();
+  if (cache == nullptr) {
+    return;
+  }
+  for (Block* b : cache->blocks) {
     free(b);
   }
-  g_tls_cache.blocks.clear();
+  cache->blocks.clear();
 }
 
 Block* make_user_block(void* data, uint32_t len,

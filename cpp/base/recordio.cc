@@ -1,0 +1,68 @@
+#include "base/recordio.h"
+
+#include <cstring>
+#include <vector>
+
+namespace trpc {
+
+namespace {
+constexpr char kMagic[4] = {'T', 'R', 'E', 'C'};
+constexpr size_t kMaxRecord = 256 * 1024 * 1024;
+}  // namespace
+
+RecordWriter::RecordWriter(const std::string& path)
+    : file_(fopen(path.c_str(), "ab")) {}
+
+RecordWriter::~RecordWriter() {
+  if (file_ != nullptr) {
+    fclose(file_);
+  }
+}
+
+bool RecordWriter::write(const IOBuf& record) {
+  if (file_ == nullptr) {
+    return false;
+  }
+  const uint32_t len = static_cast<uint32_t>(record.size());
+  if (fwrite(kMagic, 1, 4, file_) != 4 ||
+      fwrite(&len, 1, 4, file_) != 4) {
+    return false;
+  }
+  const std::string flat = record.to_string();
+  return fwrite(flat.data(), 1, flat.size(), file_) == flat.size();
+}
+
+void RecordWriter::flush() {
+  if (file_ != nullptr) {
+    fflush(file_);
+  }
+}
+
+RecordReader::RecordReader(const std::string& path)
+    : file_(fopen(path.c_str(), "rb")) {}
+
+RecordReader::~RecordReader() {
+  if (file_ != nullptr) {
+    fclose(file_);
+  }
+}
+
+bool RecordReader::read(IOBuf* record) {
+  if (file_ == nullptr) {
+    return false;
+  }
+  char magic[4];
+  uint32_t len = 0;
+  if (fread(magic, 1, 4, file_) != 4 || memcmp(magic, kMagic, 4) != 0 ||
+      fread(&len, 1, 4, file_) != 4 || len > kMaxRecord) {
+    return false;
+  }
+  std::vector<char> buf(len);
+  if (fread(buf.data(), 1, len, file_) != len) {
+    return false;
+  }
+  record->append(buf.data(), len);
+  return true;
+}
+
+}  // namespace trpc

@@ -1,0 +1,40 @@
+// recordio — length-prefixed record files.
+//
+// Parity: butil recordio (/root/reference/src/butil/recordio.h), the format
+// under rpc_dump / rpc_replay.  Wire: "TREC" magic | u32 payload len |
+// payload, repeated.
+#pragma once
+
+#include <cstdio>
+#include <string>
+
+#include "base/iobuf.h"
+
+namespace trpc {
+
+class RecordWriter {
+ public:
+  // Appends to path; returns nullptr-equivalent invalid writer on failure.
+  explicit RecordWriter(const std::string& path);
+  ~RecordWriter();
+  bool valid() const { return file_ != nullptr; }
+  bool write(const IOBuf& record);
+  void flush();
+
+ private:
+  FILE* file_ = nullptr;
+};
+
+class RecordReader {
+ public:
+  explicit RecordReader(const std::string& path);
+  ~RecordReader();
+  bool valid() const { return file_ != nullptr; }
+  // False at EOF or on corruption.
+  bool read(IOBuf* record);
+
+ private:
+  FILE* file_ = nullptr;
+};
+
+}  // namespace trpc

@@ -23,8 +23,10 @@ class ResourcePool {
   static constexpr uint32_t kMaxSegs = 1u << 16;  // ~16.7M items
 
   static ResourcePool* instance() {
-    static ResourcePool pool;
-    return &pool;
+    // Deliberately leaked: pooled objects (sockets, fibers) are touched by
+    // detached threads during and after static destruction.
+    static ResourcePool* pool = new ResourcePool();
+    return pool;
   }
 
   ResourcePool(const ResourcePool&) = delete;
@@ -34,15 +36,26 @@ class ResourcePool {
   // Recycled objects are NOT re-constructed: callers reset state and bump
   // their embedded version.
   uint32_t acquire(T** out) {
-    TlsCache& tls = tls_cache();
-    if (tls.free.empty()) {
-      refill(&tls);
-    }
-    if (!tls.free.empty()) {
-      const uint32_t idx = tls.free.back();
-      tls.free.pop_back();
-      *out = at(idx);
-      return idx;
+    TlsCache* tls = tls_cache();
+    if (tls != nullptr) {
+      if (tls->free.empty()) {
+        refill(tls);
+      }
+      if (!tls->free.empty()) {
+        const uint32_t idx = tls->free.back();
+        tls->free.pop_back();
+        *out = at(idx);
+        return idx;
+      }
+    } else {
+      // TLS cache already destructed (static-destruction path): go global.
+      std::lock_guard<std::mutex> g(global_mu_);
+      if (!global_free_.empty()) {
+        const uint32_t idx = global_free_.back();
+        global_free_.pop_back();
+        *out = at(idx);
+        return idx;
+      }
     }
     const uint32_t idx = hwm_.fetch_add(1, std::memory_order_relaxed);
     const uint32_t seg = idx >> kItemsPerSegBits;
@@ -68,13 +81,18 @@ class ResourcePool {
   }
 
   void release(uint32_t idx) {
-    TlsCache& tls = tls_cache();
-    tls.free.push_back(idx);
-    if (tls.free.size() >= kTlsHighWater) {
+    TlsCache* tls = tls_cache();
+    if (tls == nullptr) {  // static-destruction path
+      std::lock_guard<std::mutex> g(global_mu_);
+      global_free_.push_back(idx);
+      return;
+    }
+    tls->free.push_back(idx);
+    if (tls->free.size() >= kTlsHighWater) {
       std::lock_guard<std::mutex> g(global_mu_);
       global_free_.insert(global_free_.end(),
-                          tls.free.begin() + kTlsLowWater, tls.free.end());
-      tls.free.resize(kTlsLowWater);
+                          tls->free.begin() + kTlsLowWater, tls->free.end());
+      tls->free.resize(kTlsLowWater);
     }
   }
 
@@ -96,19 +114,47 @@ class ResourcePool {
   struct TlsCache {
     ResourcePool* owner = nullptr;
     std::vector<uint32_t> free;
-    ~TlsCache() {
-      if (owner != nullptr && !free.empty()) {
-        std::lock_guard<std::mutex> g(owner->global_mu_);
-        owner->global_free_.insert(owner->global_free_.end(), free.begin(),
-                                   free.end());
+  };
+
+  // TLS destruction order vs static destruction is undefined, and pooled
+  // objects (sockets in static Servers) ARE released during static
+  // destruction.  The cache is heap-owned behind trivially-destructible
+  // thread_locals; after the guard runs, callers fall back to the global
+  // list instead of touching a dead vector.
+  struct TlsGuard {
+    TlsCache** slot = nullptr;
+    bool* dead = nullptr;
+    ~TlsGuard() {
+      if (slot != nullptr && *slot != nullptr) {
+        TlsCache* c = *slot;
+        if (c->owner != nullptr && !c->free.empty()) {
+          std::lock_guard<std::mutex> g(c->owner->global_mu_);
+          c->owner->global_free_.insert(c->owner->global_free_.end(),
+                                        c->free.begin(), c->free.end());
+        }
+        delete c;
+        *slot = nullptr;
+      }
+      if (dead != nullptr) {
+        *dead = true;
       }
     }
   };
 
-  TlsCache& tls_cache() {
-    static thread_local TlsCache tls;
-    tls.owner = this;
-    return tls;
+  TlsCache* tls_cache() {
+    static thread_local TlsCache* cache = nullptr;   // trivial dtor
+    static thread_local bool cache_dead = false;     // trivial dtor
+    static thread_local TlsGuard guard;
+    if (cache_dead) {
+      return nullptr;
+    }
+    if (cache == nullptr) {
+      cache = new TlsCache();
+      cache->owner = this;
+      guard.slot = &cache;
+      guard.dead = &cache_dead;
+    }
+    return cache;
   }
 
   void refill(TlsCache* tls) {

@@ -15,37 +15,47 @@ struct KeyInfo {
   void (*dtor)(void*) = nullptr;
 };
 
-std::mutex g_keys_mu;
-std::vector<KeyInfo> g_keys;
-std::vector<uint32_t> g_free_keys;
+// Deliberately leaked: fiber exit paths may run during static destruction.
+std::mutex& keys_mu() {
+  static std::mutex* mu = new std::mutex();
+  return *mu;
+}
+std::vector<KeyInfo>& keys() {
+  static auto* v = new std::vector<KeyInfo>();
+  return *v;
+}
+std::vector<uint32_t>& free_keys() {
+  static auto* v = new std::vector<uint32_t>();
+  return *v;
+}
 
 }  // namespace
 
 int fls_key_create(fls_key_t* key, void (*dtor)(void*)) {
-  std::lock_guard<std::mutex> g(g_keys_mu);
+  std::lock_guard<std::mutex> g(keys_mu());
   uint32_t index;
-  if (!g_free_keys.empty()) {
-    index = g_free_keys.back();
-    g_free_keys.pop_back();
+  if (!free_keys().empty()) {
+    index = free_keys().back();
+    free_keys().pop_back();
   } else {
-    index = static_cast<uint32_t>(g_keys.size());
-    g_keys.emplace_back();
+    index = static_cast<uint32_t>(keys().size());
+    keys().emplace_back();
   }
-  g_keys[index].version += 1;  // → odd (live)
-  g_keys[index].dtor = dtor;
+  keys()[index].version += 1;  // → odd (live)
+  keys()[index].dtor = dtor;
   key->index = index;
-  key->version = g_keys[index].version;
+  key->version = keys()[index].version;
   return 0;
 }
 
 int fls_key_delete(fls_key_t key) {
-  std::lock_guard<std::mutex> g(g_keys_mu);
-  if (key.index >= g_keys.size() || g_keys[key.index].version != key.version) {
+  std::lock_guard<std::mutex> g(keys_mu());
+  if (key.index >= keys().size() || keys()[key.index].version != key.version) {
     return -1;
   }
-  g_keys[key.index].version += 1;  // → even (free)
-  g_keys[key.index].dtor = nullptr;
-  g_free_keys.push_back(key.index);
+  keys()[key.index].version += 1;  // → even (free)
+  keys()[key.index].dtor = nullptr;
+  free_keys().push_back(key.index);
   return 0;
 }
 
@@ -84,9 +94,9 @@ void run_fls_destructors(FiberMeta* m) {
     }
     void (*dtor)(void*) = nullptr;
     {
-      std::lock_guard<std::mutex> g(g_keys_mu);
-      if (i < g_keys.size() && g_keys[i].version == m->fls[i].version) {
-        dtor = g_keys[i].dtor;
+      std::lock_guard<std::mutex> g(keys_mu());
+      if (i < keys().size() && keys()[i].version == m->fls[i].version) {
+        dtor = keys()[i].dtor;
       }
     }
     m->fls[i].value = nullptr;

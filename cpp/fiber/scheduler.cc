@@ -13,6 +13,31 @@
 #include "fiber/context.h"
 #include "fiber/event.h"
 
+// ASan fiber-switch annotations (parity: the reference's ASan-aware stack
+// switching, task_group.h:311 asan_task_runner + stack poisoning).  No-ops
+// unless built with -fsanitize=address.
+#if defined(__has_feature)
+#if __has_feature(address_sanitizer)
+#define TRPC_HAS_ASAN_FEATURE 1
+#endif
+#endif
+#if defined(__SANITIZE_ADDRESS__) || defined(TRPC_HAS_ASAN_FEATURE)
+extern "C" {
+void __sanitizer_start_switch_fiber(void** fake_stack_save,
+                                    const void* bottom, size_t size);
+void __sanitizer_finish_switch_fiber(void* fake_stack_save,
+                                     const void** bottom_old,
+                                     size_t* size_old);
+}
+#define TRPC_ASAN_FIBERS 1
+#else
+#define TRPC_ASAN_FIBERS 0
+static inline void __sanitizer_start_switch_fiber(void**, const void*,
+                                                  size_t) {}
+static inline void __sanitizer_finish_switch_fiber(void*, const void**,
+                                                   size_t*) {}
+#endif
+
 namespace trpc {
 
 thread_local Worker* tls_worker = nullptr;
@@ -47,10 +72,12 @@ void finish_fiber_post(void* p, void*) {
 
 void fiber_entry(void* p) {
   FiberMeta* m = static_cast<FiberMeta*>(p);
+  // Complete the ASan handshake for the first entry onto this stack.
+  __sanitizer_finish_switch_fiber(nullptr, nullptr, nullptr);
   m->fn(m->arg);
   run_fls_destructors(m);
   Worker* w = tls_worker;  // worker we ended on (may differ from start)
-  w->suspend_current(finish_fiber_post, m, nullptr);
+  w->suspend_current(finish_fiber_post, m, nullptr, /*dying=*/true);
   CHECK(false) << "resumed a finished fiber";
 }
 
@@ -79,8 +106,9 @@ void ParkingLot::wait(int stamp) {
 }
 
 Scheduler* Scheduler::instance() {
-  static Scheduler s;
-  return &s;
+  // Deliberately leaked: worker pthreads outlive static destruction.
+  static Scheduler* s = new Scheduler();
+  return s;
 }
 
 void Scheduler::start(int workers) {
@@ -195,7 +223,10 @@ FiberMeta* Worker::pick_next() {
 
 void Worker::run_fiber(FiberMeta* m) {
   current_ = m;
+  __sanitizer_start_switch_fiber(&asan_fake_stack_, m->stack.base,
+                                 m->stack.size);
   trpc_jump_context(&sched_sp_, m->sp, m);
+  __sanitizer_finish_switch_fiber(asan_fake_stack_, nullptr, nullptr);
   current_ = nullptr;
   if (post_fn_ != nullptr) {
     PostSwitchFn fn = post_fn_;
@@ -204,17 +235,31 @@ void Worker::run_fiber(FiberMeta* m) {
   }
 }
 
-void Worker::suspend_current(PostSwitchFn post_fn, void* a1, void* a2) {
+void Worker::suspend_current(PostSwitchFn post_fn, void* a1, void* a2,
+                             bool dying) {
   FiberMeta* m = current_;
   post_fn_ = post_fn;
   post_a1_ = a1;
   post_a2_ = a2;
+  // A dying fiber passes nullptr fake-stack storage so ASan retires its
+  // fake frames instead of preserving them for a resume.
+  __sanitizer_start_switch_fiber(dying ? nullptr : &m->asan_fake_stack,
+                                 pthread_stack_base_, pthread_stack_size_);
   trpc_jump_context(&m->sp, sched_sp_, nullptr);
   // Resumed (possibly on another worker's scheduler context).
+  __sanitizer_finish_switch_fiber(m->asan_fake_stack, nullptr, nullptr);
 }
 
 void Worker::main_loop() {
   tls_worker = this;
+#if TRPC_ASAN_FIBERS
+  {
+    pthread_attr_t attr;
+    pthread_getattr_np(pthread_self(), &attr);
+    pthread_attr_getstack(&attr, &pthread_stack_base_, &pthread_stack_size_);
+    pthread_attr_destroy(&attr);
+  }
+#endif
   while (true) {
     FiberMeta* m = pick_next();
     if (m != nullptr) {

@@ -30,6 +30,7 @@ struct FiberMeta {
   void* arg = nullptr;
   void* sp = nullptr;  // suspended continuation
   StackMem stack;
+  void* asan_fake_stack = nullptr;  // ASan fiber handshake state
   // Even = idle slot; odd = live fiber.  The version half of fiber_t.
   std::atomic<uint32_t> version{0};
   // Join event: value holds the live version while running; bumped at exit.
@@ -94,7 +95,9 @@ class Worker {
 
   // Called from a running fiber: switch back to the scheduler context.
   // post_fn(arg1, arg2) runs on the scheduler context after the switch.
-  void suspend_current(PostSwitchFn post_fn, void* a1, void* a2);
+  // dying = the fiber never resumes (lets ASan retire its fake frames).
+  void suspend_current(PostSwitchFn post_fn, void* a1, void* a2,
+                       bool dying = false);
 
   FiberMeta* current() const { return current_; }
   WorkStealingQueue<FiberMeta*>& runq() { return runq_; }
@@ -112,6 +115,9 @@ class Worker {
   WorkStealingQueue<FiberMeta*> runq_;
   FiberMeta* current_ = nullptr;
   void* sched_sp_ = nullptr;  // scheduler continuation while a fiber runs
+  void* asan_fake_stack_ = nullptr;
+  void* pthread_stack_base_ = nullptr;  // this worker pthread's stack
+  size_t pthread_stack_size_ = 0;
   PostSwitchFn post_fn_ = nullptr;
   void* post_a1_ = nullptr;
   void* post_a2_ = nullptr;

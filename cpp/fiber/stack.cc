@@ -12,24 +12,53 @@ namespace trpc {
 
 namespace {
 
+// Heap-owned TLS cache behind trivially-destructible thread_locals (same
+// static-destruction hazard as the resource-pool caches).
 struct TlsStackCache {
   std::vector<StackMem> stacks;
-  ~TlsStackCache() {
-    for (StackMem& s : stacks) {
-      munmap(s.base, s.size);
+};
+
+struct TlsStackGuard {
+  TlsStackCache** slot = nullptr;
+  bool* dead = nullptr;
+  ~TlsStackGuard() {
+    if (slot != nullptr && *slot != nullptr) {
+      for (StackMem& s : (*slot)->stacks) {
+        munmap(s.base, s.size);
+      }
+      delete *slot;
+      *slot = nullptr;
+    }
+    if (dead != nullptr) {
+      *dead = true;
     }
   }
 };
 
-thread_local TlsStackCache g_stack_cache;
+TlsStackCache* tls_stack_cache() {
+  static thread_local TlsStackCache* cache = nullptr;  // trivial dtor
+  static thread_local bool cache_dead = false;
+  static thread_local TlsStackGuard guard;
+  if (cache_dead) {
+    return nullptr;
+  }
+  if (cache == nullptr) {
+    cache = new TlsStackCache();
+    guard.slot = &cache;
+    guard.dead = &cache_dead;
+  }
+  return cache;
+}
+
 constexpr size_t kMaxCachedStacks = 32;
 
 }  // namespace
 
 StackMem allocate_stack(size_t size) {
-  if (!g_stack_cache.stacks.empty()) {
-    StackMem s = g_stack_cache.stacks.back();
-    g_stack_cache.stacks.pop_back();
+  TlsStackCache* cache = tls_stack_cache();
+  if (cache != nullptr && !cache->stacks.empty()) {
+    StackMem s = cache->stacks.back();
+    cache->stacks.pop_back();
     if (s.size == size) {
       return s;
     }
@@ -45,8 +74,9 @@ StackMem allocate_stack(size_t size) {
 }
 
 void release_stack(StackMem s) {
-  if (g_stack_cache.stacks.size() < kMaxCachedStacks) {
-    g_stack_cache.stacks.push_back(s);
+  TlsStackCache* cache = tls_stack_cache();
+  if (cache != nullptr && cache->stacks.size() < kMaxCachedStacks) {
+    cache->stacks.push_back(s);
     return;
   }
   munmap(s.base, s.size);

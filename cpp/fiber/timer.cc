@@ -33,8 +33,9 @@ struct TimerThread::Impl {
 };
 
 TimerThread* TimerThread::instance() {
-  static TimerThread t;
-  return &t;
+  // Deliberately leaked: the timer pthread outlives static destruction.
+  static TimerThread* t = new TimerThread();
+  return t;
 }
 
 TimerThread::TimerThread() : impl_(new Impl) {

@@ -38,24 +38,28 @@ class RandomLB : public LoadBalancer {
   }
 };
 
-// Ketama-style ring on endpoint text (parity: policy/
-// consistent_hashing_load_balancer).
+// Ketama-style ring with virtual nodes (parity: policy/
+// consistent_hashing_load_balancer — single hash points skew badly on small
+// clusters, so each endpoint contributes kReplicas ring points).
 class ConsistentHashLB : public LoadBalancer {
  public:
+  static constexpr int kReplicas = 32;
+
   size_t select(const std::vector<size_t>& healthy,
                 const std::vector<ServerNode>& nodes, uint64_t key,
                 int attempt) override {
-    // Jump to the first healthy node clockwise from hash(key); retries walk
-    // further clockwise.
     size_t best = healthy[0];
     uint64_t best_dist = UINT64_MAX;
     const uint64_t h = mix(key);
     for (size_t idx : healthy) {
-      const uint64_t nh = mix(EndPointHash()(nodes[idx].ep));
-      const uint64_t dist = nh - h;  // wrapping distance clockwise
-      if (dist < best_dist) {
-        best_dist = dist;
-        best = idx;
+      const uint64_t base = EndPointHash()(nodes[idx].ep);
+      for (int r = 0; r < kReplicas; ++r) {
+        const uint64_t nh = mix(base + r * 0x9e3779b97f4a7c15ull);
+        const uint64_t dist = nh - h;  // wrapping distance clockwise
+        if (dist < best_dist) {
+          best_dist = dist;
+          best = idx;
+        }
       }
     }
     if (attempt > 0) {
@@ -171,6 +175,12 @@ ClusterChannel::~ClusterChannel() {
     while (refresh_done_.value.load(std::memory_order_acquire) == 0) {
       refresh_done_.wait(0, -1);
     }
+    // The wake that satisfied us may still be INSIDE refresh_done_.wake_all
+    // touching the Event; spin until the fiber's final store says it is
+    // completely done with this object.
+    while (!refresher_exited_.load(std::memory_order_acquire)) {
+      sched_yield();
+    }
   }
 }
 
@@ -253,6 +263,8 @@ void ClusterChannel::refresh_fiber(void* arg) {
   }
   self->refresh_done_.value.store(1, std::memory_order_release);
   self->refresh_done_.wake_all();
+  // LAST access to *self (see ~ClusterChannel).
+  self->refresher_exited_.store(true, std::memory_order_release);
 }
 
 size_t ClusterChannel::healthy_count() {
@@ -283,6 +295,177 @@ struct AsyncCall {
 };
 }  // namespace
 
+void ClusterChannel::feed_breaker(ServerNode& node, bool success) {
+  if (success) {
+    node.consecutive_failures->store(0, std::memory_order_relaxed);
+    return;
+  }
+  const int fails =
+      node.consecutive_failures->fetch_add(1, std::memory_order_relaxed) + 1;
+  int64_t quarantine_ms = opts_.quarantine_base_ms;
+  for (int i = 1; i < fails && quarantine_ms < opts_.quarantine_max_ms; ++i) {
+    quarantine_ms *= 2;
+  }
+  quarantine_ms = std::min(quarantine_ms, opts_.quarantine_max_ms);
+  node.quarantined_until_us->store(monotonic_time_us() + quarantine_ms * 1000,
+                                   std::memory_order_relaxed);
+}
+
+namespace {
+
+// Shared state of one hedged call; attempt fibers keep it alive past the
+// caller (a losing attempt may still be in flight when the call returns).
+struct HedgeCtx {
+  std::shared_ptr<void> cluster_keepalive;
+  std::string method;
+  IOBuf request;
+  std::shared_ptr<Channel> channels[2];
+  std::shared_ptr<std::atomic<int>> node_fail_counters[2];
+  std::shared_ptr<std::atomic<int64_t>> node_quarantines[2];
+  Controller cntls[2];
+  IOBuf responses[2];
+  std::atomic<int> winner{-1};   // first successful attempt index
+  std::atomic<int> failures{0};
+  std::atomic<int> launched{1};
+  Event ev;  // bumped on every attempt completion
+
+  bool settled() const {
+    return winner.load(std::memory_order_acquire) >= 0 ||
+           failures.load(std::memory_order_acquire) >=
+               launched.load(std::memory_order_acquire);
+  }
+
+  void on_attempt_done(int i) {
+    if (!cntls[i].Failed()) {
+      int expect = -1;
+      winner.compare_exchange_strong(expect, i);
+    } else {
+      failures.fetch_add(1, std::memory_order_acq_rel);
+    }
+    ev.value.fetch_add(1, std::memory_order_release);
+    ev.wake_all();
+  }
+};
+
+struct HedgeFiberArg {
+  std::shared_ptr<HedgeCtx> ctx;
+  int index;
+};
+
+void hedge_attempt_fiber(void* p) {
+  std::unique_ptr<HedgeFiberArg> arg(static_cast<HedgeFiberArg*>(p));
+  HedgeCtx* ctx = arg->ctx.get();
+  const int i = arg->index;
+  ctx->channels[i]->CallMethod(ctx->method, ctx->request,
+                               &ctx->responses[i], &ctx->cntls[i]);
+  ctx->on_attempt_done(i);
+}
+
+void wait_settled(HedgeCtx* ctx, int64_t deadline_us) {
+  while (!ctx->settled()) {
+    const uint32_t snap = ctx->ev.value.load(std::memory_order_acquire);
+    if (ctx->settled()) {
+      break;
+    }
+    if (ctx->ev.wait(snap, deadline_us) == ETIMEDOUT) {
+      break;
+    }
+  }
+}
+
+}  // namespace
+
+// Hedged execution: fire the primary, and if it hasn't answered within
+// backup_request_ms (or failed outright), race a backup on a different
+// node; the first success wins and the loser's late response dies on its
+// stale correlation id — the same guarantee that makes brpc's backup
+// requests safe (channel.cpp:582-603).
+void ClusterChannel::call_hedged(std::shared_ptr<Cluster> cluster,
+                                 const std::string& method,
+                                 const IOBuf& request, IOBuf* response,
+                                 Controller* cntl, uint64_t hash_key) {
+  const int64_t now = monotonic_time_us();
+  std::vector<size_t> healthy;
+  for (size_t i = 0; i < cluster->nodes.size(); ++i) {
+    if (cluster->nodes[i].quarantined_until_us->load(
+            std::memory_order_relaxed) <= now) {
+      healthy.push_back(i);
+    }
+  }
+  if (healthy.empty()) {
+    for (size_t i = 0; i < cluster->nodes.size(); ++i) {
+      healthy.push_back(i);
+    }
+  }
+  auto ctx = std::make_shared<HedgeCtx>();
+  ctx->cluster_keepalive = cluster;
+  ctx->method = method;
+  ctx->request = request;  // zero-copy share
+
+  auto arm = [&](int slot, size_t node_idx) {
+    ctx->channels[slot] = cluster->channels[node_idx];
+    ctx->node_fail_counters[slot] =
+        cluster->nodes[node_idx].consecutive_failures;
+    ctx->node_quarantines[slot] =
+        cluster->nodes[node_idx].quarantined_until_us;
+    ctx->cntls[slot].set_timeout_ms(opts_.timeout_ms);
+    fiber_start(nullptr, hedge_attempt_fiber,
+                new HedgeFiberArg{ctx, slot}, 0);
+  };
+
+  const size_t primary = lb_->select(healthy, cluster->nodes, hash_key, 0);
+  arm(0, primary);
+  wait_settled(ctx.get(), now + opts_.backup_request_ms * 1000);
+
+  if (ctx->winner.load(std::memory_order_acquire) < 0) {
+    // Slow or failed primary: race a backup on another node if one exists.
+    std::vector<size_t> others;
+    for (size_t i : healthy) {
+      if (i != primary) {
+        others.push_back(i);
+      }
+    }
+    if (!others.empty()) {
+      ctx->launched.store(2, std::memory_order_release);
+      arm(1, lb_->select(others, cluster->nodes, hash_key, 1));
+    }
+    wait_settled(ctx.get(), -1);
+  }
+
+  const int w = ctx->winner.load(std::memory_order_acquire);
+  const int chosen = w >= 0 ? w : 0;
+  // Breaker feedback: judge the chosen attempt; a failed primary that a
+  // backup rescued still counts against the primary's node.
+  for (int i = 0; i < 2; ++i) {
+    if (ctx->node_fail_counters[i] == nullptr) {
+      continue;
+    }
+    if (i == w) {
+      ctx->node_fail_counters[i]->store(0, std::memory_order_relaxed);
+    } else if (ctx->cntls[i].Failed()) {
+      const int fails = ctx->node_fail_counters[i]->fetch_add(
+                            1, std::memory_order_relaxed) +
+                        1;
+      int64_t quarantine_ms = opts_.quarantine_base_ms;
+      for (int k = 1; k < fails && quarantine_ms < opts_.quarantine_max_ms;
+           ++k) {
+        quarantine_ms *= 2;
+      }
+      ctx->node_quarantines[i]->store(
+          monotonic_time_us() +
+              std::min(quarantine_ms, opts_.quarantine_max_ms) * 1000,
+          std::memory_order_relaxed);
+    }
+  }
+  if (w < 0) {
+    cntl->SetFailed(ctx->cntls[chosen].error_code(),
+                    ctx->cntls[chosen].error_text());
+  } else {
+    *response = std::move(ctx->responses[w]);
+    cntl->set_latency_us(ctx->cntls[w].latency_us());
+  }
+}
+
 void ClusterChannel::CallMethod(const std::string& method,
                                 const IOBuf& request, IOBuf* response,
                                 Controller* cntl, Closure done,
@@ -310,6 +493,13 @@ void ClusterChannel::CallMethod(const std::string& method,
   }
   if (cluster == nullptr || cluster->nodes.empty()) {
     cntl->SetFailed(ENOENT, "no servers in cluster");
+    if (done) {
+      done();
+    }
+    return;
+  }
+  if (opts_.backup_request_ms > 0) {
+    call_hedged(cluster, method, request, response, cntl, hash_key);
     if (done) {
       done();
     }
@@ -358,25 +548,13 @@ void ClusterChannel::CallMethod(const std::string& method,
     const bool last_attempt = attempt == attempts - 1;
     cluster->channels[idx]->CallMethod(method, request, response, cntl);
     if (!cntl->Failed()) {
-      node.consecutive_failures->store(0, std::memory_order_relaxed);
+      feed_breaker(node, true);
       if (done) {
         done();
       }
       return;
     }
-    // Failure: feed the breaker (exponential quarantine).
-    const int fails =
-        node.consecutive_failures->fetch_add(1, std::memory_order_relaxed) +
-        1;
-    int64_t quarantine_ms = opts_.quarantine_base_ms;
-    for (int i = 1; i < fails && quarantine_ms < opts_.quarantine_max_ms;
-         ++i) {
-      quarantine_ms *= 2;
-    }
-    quarantine_ms = std::min(quarantine_ms, opts_.quarantine_max_ms);
-    node.quarantined_until_us->store(
-        monotonic_time_us() + quarantine_ms * 1000,
-        std::memory_order_relaxed);
+    feed_breaker(node, false);  // exponential quarantine
     if (last_attempt) {
       break;
     }

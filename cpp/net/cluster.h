@@ -60,6 +60,12 @@ class ClusterChannel {
   struct Options {
     int64_t timeout_ms = 1000;
     int max_retry = 2;                   // additional attempts on failure
+    // Hedging (parity: backup_request_policy.h + the backup timer in
+    // channel.cpp:582-603): if > 0 and the first attempt hasn't answered
+    // within this budget, a second attempt races it on another node; the
+    // first success wins and the loser's late response is dropped by its
+    // correlation id.
+    int64_t backup_request_ms = 0;       // 0 = disabled
     int64_t refresh_interval_ms = 5000;  // periodic re-resolve
     int64_t quarantine_base_ms = 100;    // doubles per consecutive failure
     int64_t quarantine_max_ms = 10000;
@@ -82,6 +88,10 @@ class ClusterChannel {
     std::vector<std::shared_ptr<Channel>> channels;  // parallel to nodes
   };
   static void refresh_fiber(void* arg);
+  void call_hedged(std::shared_ptr<Cluster> cluster, const std::string& method,
+                   const IOBuf& request, IOBuf* response, Controller* cntl,
+                   uint64_t hash_key);
+  void feed_breaker(ServerNode& node, bool success);
 
   std::unique_ptr<NamingService> ns_;
   std::string ns_param_;
@@ -92,6 +102,9 @@ class ClusterChannel {
   std::atomic<bool> refresher_started_{false};
   Event refresh_wake_;  // interrupts the refresher's sleep at shutdown
   Event refresh_done_;  // value 1 once the refresher has exited
+  // Set strictly AFTER the refresher's last touch of this object; the
+  // destructor spins on it so it can't free members mid-wake.
+  std::atomic<bool> refresher_exited_{false};
 };
 
 }  // namespace trpc

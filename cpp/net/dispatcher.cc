@@ -9,8 +9,9 @@
 namespace trpc {
 
 EventDispatcher* EventDispatcher::instance() {
-  static EventDispatcher d;
-  return &d;
+  // Deliberately leaked: detached threads outlive static destruction.
+  static EventDispatcher* d = new EventDispatcher();
+  return d;
 }
 
 EventDispatcher::EventDispatcher() {

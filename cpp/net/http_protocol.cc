@@ -143,6 +143,7 @@ void http_process_request(InputMessage&& msg) {
   // HTTP/1.1 has no correlation id: responses must leave in request order.
   // The read fiber parks on this latch until done() fires, so even an
   // asynchronous handler cannot let a later pipelined response overtake.
+  srv->in_flight.fetch_add(1, std::memory_order_acq_rel);
   auto latch = std::make_shared<CountdownEvent>(1);
   Closure done = [sid, cntl, response, srv, lat, start_us, latch] {
     if (cntl->Failed()) {
@@ -152,12 +153,13 @@ void http_process_request(InputMessage&& msg) {
       http_respond(sid, 200, "OK", "application/octet-stream",
                    response->to_string());
     }
-    srv->requests_served.fetch_add(1, std::memory_order_relaxed);
     if (lat != nullptr) {
       *lat << (monotonic_time_us() - start_us);
     }
     delete response;
     delete cntl;
+    srv->requests_served.fetch_add(1, std::memory_order_relaxed);
+    srv->in_flight.fetch_sub(1, std::memory_order_acq_rel);
     latch->signal();
   };
   prop->handler(cntl, msg.payload, response, std::move(done));

@@ -14,7 +14,10 @@ namespace {
 // the process, so hot-path Protocol* caches can never dangle on a
 // concurrent registration (a growing vector would reallocate).
 constexpr int kMaxProtocols = 16;
-std::mutex g_proto_mu;
+std::mutex& proto_mu() {
+  static std::mutex* mu = new std::mutex();
+  return *mu;
+}
 Protocol g_protocols[kMaxProtocols];
 std::atomic<int> g_proto_count{0};
 
@@ -145,7 +148,7 @@ void tstd_pack(IOBuf* out, const RpcMeta& meta, const IOBuf& payload) {
 }
 
 int register_protocol(const Protocol& p) {
-  std::lock_guard<std::mutex> g(g_proto_mu);
+  std::lock_guard<std::mutex> g(proto_mu());
   const int n = g_proto_count.load(std::memory_order_relaxed);
   if (n >= kMaxProtocols) {
     return -1;

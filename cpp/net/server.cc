@@ -7,6 +7,8 @@
 #include <unistd.h>
 
 #include "base/logging.h"
+#include "base/rand.h"
+#include "base/recordio.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
 #include "net/http_protocol.h"
@@ -15,6 +17,16 @@
 #include "net/protocol.h"
 
 namespace trpc {
+
+Server::~Server() {
+  Stop();
+  Join();
+  // Grace period: a request fiber that Address()'d its socket just before
+  // Stop failed it may still be between reading user_data and bumping
+  // in_flight; give it time to either register or bail.
+  usleep(20000);
+  Join();
+}
 
 int Server::RegisterMethod(const std::string& full_name, Handler handler) {
   if (running()) {
@@ -75,6 +87,49 @@ void Server::Stop() {
     s->SetFailed(ESHUTDOWN);
     s->Dereference();
   }
+  // Fail live connections so no NEW request can reach this server while it
+  // is being torn down (their user_data points at us).
+  std::lock_guard<std::mutex> g(conns_mu_);
+  for (SocketId id : conns_) {
+    Socket* conn = Socket::Address(id);
+    if (conn != nullptr) {
+      conn->SetFailed(ESHUTDOWN);
+      conn->Dereference();
+    }
+  }
+  conns_.clear();
+}
+
+int Server::Join(int64_t timeout_ms) {
+  const int64_t deadline =
+      timeout_ms >= 0 ? monotonic_time_us() + timeout_ms * 1000 : INT64_MAX;
+  while (in_flight.load(std::memory_order_acquire) > 0) {
+    if (monotonic_time_us() >= deadline) {
+      return ETIMEDOUT;
+    }
+    if (in_fiber()) {
+      fiber_sleep_us(1000);
+    } else {
+      usleep(1000);
+    }
+  }
+  return 0;
+}
+
+void Server::track_connection(SocketId id) {
+  std::lock_guard<std::mutex> g(conns_mu_);
+  if (conns_.size() > 4096) {  // prune stale versioned ids occasionally
+    std::vector<SocketId> live;
+    for (SocketId sid : conns_) {
+      Socket* s = Socket::Address(sid);
+      if (s != nullptr) {
+        live.push_back(sid);
+        s->Dereference();
+      }
+    }
+    conns_.swap(live);
+  }
+  conns_.push_back(id);
 }
 
 // Accept-until-EAGAIN (acceptor.cpp:251 parity); runs in the listen
@@ -102,8 +157,41 @@ void Server::on_acceptable(SocketId id, void* ctx) {
       close(fd);
       continue;
     }
+    srv->track_connection(conn_id);
   }
   listener->Dereference();
+}
+
+int Server::EnableDump(const std::string& path, double sample_rate) {
+  auto writer = std::make_unique<RecordWriter>(path);
+  if (!writer->valid()) {
+    return -1;
+  }
+  LockGuard<FiberMutex> g(dump_mu_);
+  dump_writer_ = std::move(writer);
+  dump_rate_ = sample_rate;
+  return 0;
+}
+
+void Server::maybe_dump(const std::string& method, uint32_t attachment_size,
+                        const IOBuf& payload) {
+  if (dump_rate_ <= 0.0 ||
+      fast_rand_less_than(1000000) >=
+          static_cast<uint64_t>(dump_rate_ * 1000000)) {
+    return;
+  }
+  // Each record is a complete tstd request frame — replay just re-sends it.
+  RpcMeta meta;
+  meta.type = RpcMeta::kRequest;
+  meta.method = method;
+  meta.attachment_size = attachment_size;
+  IOBuf frame;
+  tstd_pack(&frame, meta, payload);
+  LockGuard<FiberMutex> g(dump_mu_);
+  if (dump_writer_ != nullptr) {
+    dump_writer_->write(frame);
+    dump_writer_->flush();
+  }
 }
 
 // ---- request execution (tstd protocol hook) -----------------------------
@@ -130,6 +218,9 @@ void tstd_process_request(InputMessage&& msg) {
   std::shared_ptr<LatencyRecorder> lat =
       prop != nullptr ? prop->latency : nullptr;
 
+  if (srv != nullptr) {
+    srv->in_flight.fetch_add(1, std::memory_order_acq_rel);
+  }
   Closure done = [socket_id, cid, cntl, response, start_us, srv, lat] {
     RpcMeta meta;
     meta.type = RpcMeta::kResponse;
@@ -151,14 +242,16 @@ void tstd_process_request(InputMessage&& msg) {
     if (s) {
       s->Write(std::move(frame));
     }
-    if (srv != nullptr) {
-      srv->requests_served.fetch_add(1, std::memory_order_relaxed);
-    }
     if (lat != nullptr) {
       *lat << (monotonic_time_us() - start_us);
     }
     delete response;
     delete cntl;
+    if (srv != nullptr) {
+      srv->requests_served.fetch_add(1, std::memory_order_relaxed);
+      // LAST touch of srv: once in_flight hits 0, Join may free the server.
+      srv->in_flight.fetch_sub(1, std::memory_order_acq_rel);
+    }
   };
 
   if (srv == nullptr || !srv->running()) {
@@ -171,6 +264,7 @@ void tstd_process_request(InputMessage&& msg) {
     done();
     return;
   }
+  srv->maybe_dump(method, msg.meta.attachment_size, msg.payload);
   // Split the attachment tail off the payload.
   IOBuf request = std::move(msg.payload);
   if (msg.meta.attachment_size > 0 &&

@@ -13,6 +13,7 @@
 #include <string>
 
 #include "base/flat_map.h"
+#include "base/recordio.h"
 #include "fiber/sync.h"
 #include "net/controller.h"
 #include "net/socket.h"
@@ -34,14 +35,19 @@ class Server {
     std::shared_ptr<LatencyRecorder> latency;
   };
 
-  ~Server() { Stop(); }
+  ~Server();
 
   // Register before Start.  Name format "Service.Method" by convention.
   int RegisterMethod(const std::string& full_name, Handler handler);
 
   // port <= 0 picks an ephemeral port (see port() after).  Returns 0 on ok.
   int Start(int port);
+  // Stops accepting, fails live connections; in-flight handlers finish.
   void Stop();
+  // Parks until every in-flight request has completed (bounded by
+  // timeout_ms; -1 = forever).  ~Server runs Stop()+Join() so destruction
+  // can never race a handler touching server state.
+  int Join(int64_t timeout_ms = 5000);
   int port() const { return port_; }
   bool running() const { return running_.load(std::memory_order_acquire); }
 
@@ -55,16 +61,29 @@ class Server {
         [&fn](const std::string& name, const MethodProperty&) { fn(name); });
   }
   std::atomic<int64_t> requests_served{0};
+  std::atomic<int> in_flight{0};
   int64_t start_time_us() const { return start_time_us_; }
+  void track_connection(SocketId id);
+
+  // rpc_dump parity (/root/reference/src/brpc/rpc_dump.h:40-67): sample
+  // incoming requests into a recordio file replayable by tools/rpc_replay.
+  int EnableDump(const std::string& path, double sample_rate = 0.01);
+  void maybe_dump(const std::string& method, uint32_t attachment_size,
+                  const IOBuf& payload);
 
  private:
   static void on_acceptable(SocketId id, void* ctx);
   int64_t start_time_us_ = 0;
+  std::unique_ptr<RecordWriter> dump_writer_;
+  FiberMutex dump_mu_;
+  double dump_rate_ = 0.0;
 
   FlatMap<std::string, MethodProperty> methods_;
   SocketId listen_id_ = 0;
   int port_ = -1;
   std::atomic<bool> running_{false};
+  std::mutex conns_mu_;
+  std::vector<SocketId> conns_;  // stale ids harmless (versioned)
 };
 
 }  // namespace trpc

@@ -10,8 +10,9 @@
 namespace trpc {
 
 Sampler* Sampler::instance() {
-  static Sampler s;
-  return &s;
+  // Deliberately leaked: the sampler pthread outlives static destruction.
+  static Sampler* s = new Sampler();
+  return s;
 }
 
 Sampler::Sampler() {

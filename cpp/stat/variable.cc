@@ -6,17 +6,23 @@
 namespace trpc {
 
 namespace {
-std::mutex g_vars_mu;
+// Deliberately leaked: Variables with static storage (e.g. per-method
+// recorders inside static Servers) deregister during static destruction,
+// which can run after this TU's statics would have died.
+std::mutex& vars_mu() {
+  static std::mutex* mu = new std::mutex();
+  return *mu;
+}
 std::map<std::string, Variable*>& vars() {
-  static std::map<std::string, Variable*> m;
-  return m;
+  static auto* m = new std::map<std::string, Variable*>();
+  return *m;
 }
 }  // namespace
 
 Variable::~Variable() { hide(); }
 
 int Variable::expose(const std::string& name) {
-  std::lock_guard<std::mutex> g(g_vars_mu);
+  std::lock_guard<std::mutex> g(vars_mu());
   if (!name_.empty()) {
     vars().erase(name_);
   }
@@ -26,7 +32,7 @@ int Variable::expose(const std::string& name) {
 }
 
 void Variable::hide() {
-  std::lock_guard<std::mutex> g(g_vars_mu);
+  std::lock_guard<std::mutex> g(vars_mu());
   if (!name_.empty()) {
     auto it = vars().find(name_);
     if (it != vars().end() && it->second == this) {
@@ -37,7 +43,7 @@ void Variable::hide() {
 }
 
 std::vector<std::pair<std::string, std::string>> Variable::dump_exposed() {
-  std::lock_guard<std::mutex> g(g_vars_mu);
+  std::lock_guard<std::mutex> g(vars_mu());
   std::vector<std::pair<std::string, std::string>> out;
   out.reserve(vars().size());
   for (auto& [name, var] : vars()) {

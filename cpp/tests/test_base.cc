@@ -11,6 +11,7 @@
 #include "base/flat_map.h"
 #include "base/iobuf.h"
 #include "base/rand.h"
+#include "base/recordio.h"
 #include "base/resource_pool.h"
 #include "base/time.h"
 #include "tests/test_util.h"
@@ -206,6 +207,34 @@ TEST_CASE(endpoint_parse_format) {
   sockaddr_in sa = endpoint2sockaddr(ep);
   EndPoint back = sockaddr2endpoint(sa);
   EXPECT(back.ip == ep.ip && back.port == ep.port);
+}
+
+TEST_CASE(recordio_roundtrip) {
+  #define RECPATH "/tmp/trpc_test_recordio.dat"
+  unlink(RECPATH);
+  {
+    RecordWriter w(RECPATH);
+    EXPECT(w.valid());
+    for (int i = 0; i < 10; ++i) {
+      IOBuf rec;
+      rec.append("record-" + std::to_string(i) + std::string(i * 100, 'r'));
+      EXPECT(w.write(rec));
+    }
+    w.flush();
+  }
+  RecordReader r(RECPATH);
+  EXPECT(r.valid());
+  int count = 0;
+  IOBuf rec;
+  while (r.read(&rec)) {
+    const std::string s = rec.to_string();
+    EXPECT(s.rfind("record-" + std::to_string(count), 0) == 0);
+    EXPECT_EQ(s.size(), 8 + count * 100);
+    rec.clear();
+    ++count;
+  }
+  EXPECT_EQ(count, 10);
+  unlink(RECPATH);
 }
 
 TEST_CASE(fast_rand_spread) {

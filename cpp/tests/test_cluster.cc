@@ -9,6 +9,7 @@
 #include <string>
 
 #include "base/time.h"
+#include "fiber/fiber.h"
 #include "fiber/sync.h"
 #include "net/cluster.h"
 #include "net/server.h"
@@ -146,6 +147,53 @@ TEST_CASE(file_naming_service_and_refresh) {
   }
   EXPECT_EQ(seen.size(), 3u);
   unlink(path.c_str());
+}
+
+TEST_CASE(backup_request_hedging) {
+  start_nodes();
+  // Add a slow method on every node: node 0 is slow, others fast.
+  static Server slow_nodes[2];
+  static int slow_ports[2];
+  for (int i = 0; i < 2; ++i) {
+    slow_nodes[i].RegisterMethod(
+        "Echo.MaybeSlow",
+        [i](Controller*, const IOBuf&, IOBuf* resp, Closure done) {
+          if (i == 0) {
+            fiber_sleep_us(400000);  // slow primary
+          }
+          resp->append("slow-node-" + std::to_string(i));
+          done();
+        });
+    EXPECT_EQ(slow_nodes[i].Start(0), 0);
+    slow_ports[i] = slow_nodes[i].port();
+  }
+  ClusterChannel::Options opts;
+  opts.timeout_ms = 2000;
+  opts.backup_request_ms = 50;  // hedge after 50ms
+  ClusterChannel ch;
+  // rr alternates primaries; whichever is primary, the result must arrive
+  // fast when the OTHER node can serve it.
+  EXPECT_EQ(ch.Init("list://127.0.0.1:" + std::to_string(slow_ports[0]) +
+                        ",127.0.0.1:" + std::to_string(slow_ports[1]),
+                    "rr", &opts),
+            0);
+  int fast_wins = 0;
+  for (int i = 0; i < 6; ++i) {
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("x");
+    const int64_t t0 = monotonic_time_us();
+    ch.CallMethod("Echo.MaybeSlow", req, &resp, &cntl);
+    const int64_t dt = monotonic_time_us() - t0;
+    EXPECT(!cntl.Failed());
+    if (dt < 300000) {
+      ++fast_wins;  // answered before the slow node could (hedge won)
+      EXPECT(resp.to_string() == "slow-node-1");
+    }
+  }
+  // Every call must beat the 400ms sleeper: either node 1 was primary, or
+  // the backup fired at 50ms and won.
+  EXPECT_EQ(fast_wins, 6);
 }
 
 TEST_CASE(async_cluster_call) {

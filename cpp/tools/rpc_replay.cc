@@ -1,0 +1,65 @@
+// rpc_replay — re-sends rpc_dump'd traffic (parity: tools/rpc_replay).
+//
+// Usage: rpc_replay <recordio_file> <addr|list://...> [qps=0(max)] [lb=rr]
+// Each record is a full tstd request frame written by Server::EnableDump.
+#include <cstdio>
+#include <cstdlib>
+#include <unistd.h>
+
+#include <string>
+
+#include "base/recordio.h"
+#include "base/time.h"
+#include "net/cluster.h"
+#include "net/protocol.h"
+
+using namespace trpc;
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <file> <addr|list://...> [qps=0] [lb=rr]\n",
+            argv[0]);
+    return 1;
+  }
+  const long qps = argc > 3 ? atol(argv[3]) : 0;
+  ClusterChannel ch;
+  ClusterChannel::Options opts;
+  opts.timeout_ms = 5000;
+  if (ch.Init(argv[2], argc > 4 ? argv[4] : "rr", &opts) != 0) {
+    fprintf(stderr, "cannot resolve %s\n", argv[2]);
+    return 1;
+  }
+  RecordReader reader(argv[1]);
+  if (!reader.valid()) {
+    fprintf(stderr, "cannot open %s\n", argv[1]);
+    return 1;
+  }
+  long sent = 0, ok = 0;
+  const int64_t t0 = monotonic_time_us();
+  int64_t next = t0;
+  IOBuf record;
+  while (reader.read(&record)) {
+    InputMessage msg;
+    if (tstd_protocol().parse(&record, &msg) != ParseError::kOk) {
+      fprintf(stderr, "corrupt record #%ld, stopping\n", sent);
+      break;
+    }
+    record.clear();
+    if (qps > 0) {
+      const int64_t now = monotonic_time_us();
+      if (now < next) {
+        usleep(static_cast<useconds_t>(next - now));
+      }
+      next += 1000000 / qps;
+    }
+    Controller cntl;
+    IOBuf resp;
+    ch.CallMethod(msg.meta.method, msg.payload, &resp, &cntl);
+    ++sent;
+    ok += !cntl.Failed();
+  }
+  const double secs = (monotonic_time_us() - t0) / 1e6;
+  printf("{\"replayed\": %ld, \"ok\": %ld, \"qps\": %.0f}\n", sent, ok,
+         sent / secs);
+  return 0;
+}
