@@ -20,8 +20,8 @@ RecordWriter::~RecordWriter() {
 }
 
 bool RecordWriter::write(const IOBuf& record) {
-  if (file_ == nullptr) {
-    return false;
+  if (file_ == nullptr || record.size() > kMaxRecord) {
+    return false;  // reject what the reader would reject (or worse, desync)
   }
   const uint32_t len = static_cast<uint32_t>(record.size());
   if (fwrite(kMagic, 1, 4, file_) != 4 ||

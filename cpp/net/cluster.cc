@@ -319,11 +319,14 @@ struct HedgeCtx {
   std::shared_ptr<void> cluster_keepalive;
   std::string method;
   IOBuf request;
+  IOBuf attachment;
   std::shared_ptr<Channel> channels[2];
-  std::shared_ptr<std::atomic<int>> node_fail_counters[2];
-  std::shared_ptr<std::atomic<int64_t>> node_quarantines[2];
+  size_t node_idx[2] = {0, 0};
   Controller cntls[2];
   IOBuf responses[2];
+  // An attempt's cntls[i]/responses[i] may only be read after done[i]
+  // (release-stored when its fiber finished writing them).
+  std::atomic<bool> done[2] = {{false}, {false}};
   std::atomic<int> winner{-1};   // first successful attempt index
   std::atomic<int> failures{0};
   std::atomic<int> launched{1};
@@ -336,6 +339,7 @@ struct HedgeCtx {
   }
 
   void on_attempt_done(int i) {
+    done[i].store(true, std::memory_order_release);
     if (!cntls[i].Failed()) {
       int expect = -1;
       winner.compare_exchange_strong(expect, i);
@@ -397,18 +401,23 @@ void ClusterChannel::call_hedged(std::shared_ptr<Cluster> cluster,
       healthy.push_back(i);
     }
   }
+  // Reset per-call state on the caller's controller, preserving the
+  // attachment (mirrors the retry path's contract).
+  IOBuf attachment = cntl->request_attachment();
+  cntl->Reset();
+  cntl->request_attachment() = attachment;
+
   auto ctx = std::make_shared<HedgeCtx>();
   ctx->cluster_keepalive = cluster;
   ctx->method = method;
   ctx->request = request;  // zero-copy share
+  ctx->attachment = attachment;
 
   auto arm = [&](int slot, size_t node_idx) {
     ctx->channels[slot] = cluster->channels[node_idx];
-    ctx->node_fail_counters[slot] =
-        cluster->nodes[node_idx].consecutive_failures;
-    ctx->node_quarantines[slot] =
-        cluster->nodes[node_idx].quarantined_until_us;
+    ctx->node_idx[slot] = node_idx;
     ctx->cntls[slot].set_timeout_ms(opts_.timeout_ms);
+    ctx->cntls[slot].request_attachment() = ctx->attachment;
     fiber_start(nullptr, hedge_attempt_fiber,
                 new HedgeFiberArg{ctx, slot}, 0);
   };
@@ -433,35 +442,26 @@ void ClusterChannel::call_hedged(std::shared_ptr<Cluster> cluster,
   }
 
   const int w = ctx->winner.load(std::memory_order_acquire);
-  const int chosen = w >= 0 ? w : 0;
-  // Breaker feedback: judge the chosen attempt; a failed primary that a
-  // backup rescued still counts against the primary's node.
+  // Breaker feedback: judge only attempts that COMPLETED (done[i] is the
+  // release barrier for their controllers; a still-flying loser is not
+  // touched — its late completion only writes ctx, which the fibers keep
+  // alive via shared_ptr).  A failed primary a backup rescued still counts
+  // against the primary's node.
   for (int i = 0; i < 2; ++i) {
-    if (ctx->node_fail_counters[i] == nullptr) {
+    if (ctx->channels[i] == nullptr ||
+        !ctx->done[i].load(std::memory_order_acquire)) {
       continue;
     }
-    if (i == w) {
-      ctx->node_fail_counters[i]->store(0, std::memory_order_relaxed);
-    } else if (ctx->cntls[i].Failed()) {
-      const int fails = ctx->node_fail_counters[i]->fetch_add(
-                            1, std::memory_order_relaxed) +
-                        1;
-      int64_t quarantine_ms = opts_.quarantine_base_ms;
-      for (int k = 1; k < fails && quarantine_ms < opts_.quarantine_max_ms;
-           ++k) {
-        quarantine_ms *= 2;
-      }
-      ctx->node_quarantines[i]->store(
-          monotonic_time_us() +
-              std::min(quarantine_ms, opts_.quarantine_max_ms) * 1000,
-          std::memory_order_relaxed);
-    }
+    feed_breaker(cluster->nodes[ctx->node_idx[i]], !ctx->cntls[i].Failed());
   }
   if (w < 0) {
+    const int chosen = ctx->done[1].load(std::memory_order_acquire) ? 1 : 0;
     cntl->SetFailed(ctx->cntls[chosen].error_code(),
                     ctx->cntls[chosen].error_text());
   } else {
     *response = std::move(ctx->responses[w]);
+    cntl->response_attachment() =
+        std::move(ctx->cntls[w].response_attachment());
     cntl->set_latency_us(ctx->cntls[w].latency_us());
   }
 }

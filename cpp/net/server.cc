@@ -20,11 +20,19 @@ namespace trpc {
 
 Server::~Server() {
   Stop();
-  Join();
-  // Grace period: a request fiber that Address()'d its socket just before
-  // Stop failed it may still be between reading user_data and bumping
-  // in_flight; give it time to either register or bail.
-  usleep(20000);
+  // A request fiber holds a strong socket ref across its entry section
+  // (user_data read + in_flight registration), so once every failed
+  // connection's refs have drained, in_flight is complete and Join() is
+  // exact — no timing-based grace needed.
+  const int64_t deadline = monotonic_time_us() + 5000000;
+  {
+    std::lock_guard<std::mutex> g(conns_mu_);
+    for (SocketId id : drain_ids_) {
+      while (Socket::Draining(id) && monotonic_time_us() < deadline) {
+        usleep(1000);
+      }
+    }
+  }
   Join();
 }
 
@@ -95,6 +103,7 @@ void Server::Stop() {
     if (conn != nullptr) {
       conn->SetFailed(ESHUTDOWN);
       conn->Dereference();
+      drain_ids_.push_back(id);  // ~Server waits for their refs to drain
     }
   }
   conns_.clear();
@@ -169,15 +178,15 @@ int Server::EnableDump(const std::string& path, double sample_rate) {
   }
   LockGuard<FiberMutex> g(dump_mu_);
   dump_writer_ = std::move(writer);
-  dump_rate_ = sample_rate;
+  dump_rate_.store(sample_rate, std::memory_order_release);
   return 0;
 }
 
 void Server::maybe_dump(const std::string& method, uint32_t attachment_size,
                         const IOBuf& payload) {
-  if (dump_rate_ <= 0.0 ||
-      fast_rand_less_than(1000000) >=
-          static_cast<uint64_t>(dump_rate_ * 1000000)) {
+  const double rate = dump_rate_.load(std::memory_order_acquire);
+  if (rate <= 0.0 ||
+      fast_rand_less_than(1000000) >= static_cast<uint64_t>(rate * 1000000)) {
     return;
   }
   // Each record is a complete tstd request frame — replay just re-sends it.

@@ -76,14 +76,15 @@ class Server {
   int64_t start_time_us_ = 0;
   std::unique_ptr<RecordWriter> dump_writer_;
   FiberMutex dump_mu_;
-  double dump_rate_ = 0.0;
+  std::atomic<double> dump_rate_{0.0};
 
   FlatMap<std::string, MethodProperty> methods_;
   SocketId listen_id_ = 0;
   int port_ = -1;
   std::atomic<bool> running_{false};
   std::mutex conns_mu_;
-  std::vector<SocketId> conns_;  // stale ids harmless (versioned)
+  std::vector<SocketId> conns_;      // stale ids harmless (versioned)
+  std::vector<SocketId> drain_ids_;  // failed at Stop; awaited in ~Server
 };
 
 }  // namespace trpc

@@ -89,6 +89,16 @@ Socket* Socket::Address(SocketId id) {
   }
 }
 
+bool Socket::Draining(SocketId id) {
+  Socket* s = SocketPool::instance()->at(static_cast<uint32_t>(id));
+  if (s == nullptr) {
+    return false;
+  }
+  const uint64_t rv = s->ref_ver_.load(std::memory_order_acquire);
+  // SetFailed bumped the generation to id_ver+1 (even); refs drain to 0.
+  return ver_of(rv) == static_cast<uint32_t>(id >> 32) + 1 && ref_of(rv) > 0;
+}
+
 SocketId Socket::id() const {
   return pack(ver_of(ref_ver_.load(std::memory_order_acquire)), 0) |
          slot_.load(std::memory_order_relaxed);

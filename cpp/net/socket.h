@@ -42,6 +42,9 @@ class Socket {
   // Wait-free strong ref; nullptr if the id is stale or failed.
   static Socket* Address(SocketId id);
   void Dereference();
+  // True while a failed socket of this id's generation still has strong
+  // references draining (holders may still be inside request entry paths).
+  static bool Draining(SocketId id);
 
   // Marks failed: future Address() fails, fd closed once refs drain, the
   // owner reference is dropped, waiters woken.
