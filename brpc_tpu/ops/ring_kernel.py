@@ -1,0 +1,112 @@
+"""Pallas ring all-gather — the explicit ICI schedule as a kernel.
+
+The XLA `lax.all_gather` already rides ICI; this kernel is the hand-rolled
+equivalent (N-1 neighbor hops with double-buffered `make_async_remote_copy`
+RDMA, per the TPU kernel playbook) for when the schedule itself must be
+controlled — e.g. overlapping each arriving chunk with consumer compute, the
+role brpc's RDMA endpoint plays for ibverbs
+(/root/reference/src/brpc/rdma/rdma_endpoint.cpp).
+
+Only constructible on a real multi-chip TPU backend; everywhere else use
+`ring_all_gather_reference` (identical math via collectives), which the
+equivalence test runs on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from brpc_tpu.parallel.fabric import Fabric
+
+
+def ring_all_gather_reference(fabric: Fabric, axis: str = "link"):
+    """Collective-based reference: out[j] = shard j's row, on every peer."""
+
+    def spmd(x):
+        return lax.all_gather(x, axis, tiled=True)
+
+    return jax.jit(fabric.spmd(spmd, in_specs=P(axis), out_specs=P()))
+
+
+def _ring_kernel(num_devices, chunk_rows, row_len, local_ref, out_ref,
+                 comm_ref, send_sem, recv_sem):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+    from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
+
+    my_id = lax.axis_index("link")
+    left = lax.rem(my_id - 1 + num_devices, num_devices)
+    right = lax.rem(my_id + 1, num_devices)
+    barrier = pltpu.get_barrier_semaphore()
+
+    def neighbor_barrier():
+        # Both neighbors must pass this point before anyone's remote write
+        # may land in our scratch (and vice versa).
+        pltpu.semaphore_signal(barrier, inc=1, device_id=left)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=right)
+        pltpu.semaphore_wait(barrier, 2)
+
+    neighbor_barrier()  # peers are inside the kernel; scratch is ours
+
+    # Place the local chunk into its slot and seed the comm buffer.
+    out_ref[pl.ds(my_id * chunk_rows, chunk_rows)] = local_ref[...]
+    comm_ref[0] = local_ref[...]
+
+    def hop(step, _):
+        send_slot = lax.rem(step, 2)
+        recv_slot = lax.rem(step + 1, 2)
+        src = lax.rem(my_id - step - 1 + 2 * num_devices, num_devices)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm_ref.at[send_slot],
+            dst_ref=comm_ref.at[recv_slot],
+            send_sem=send_sem.at[send_slot],
+            recv_sem=recv_sem.at[recv_slot],
+            device_id=(right,),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        out_ref[pl.ds(src * chunk_rows, chunk_rows)] = comm_ref[recv_slot]
+        # Flow control: nobody starts hop step+1 (which reuses the other
+        # slot parity) until both neighbors consumed this hop's chunk —
+        # prevents a fast sender lapping a slow receiver's 2-slot buffer.
+        neighbor_barrier()
+        return 0
+
+    lax.fori_loop(0, num_devices - 1, hop, 0)
+
+
+def ring_all_gather_pallas(fabric: Fabric, axis: str = "link"):
+    """Build the kernel-backed all-gather (TPU multi-chip only)."""
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+    from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
+
+    n = fabric.axis_size(axis)
+    if jax.devices()[0].platform != "tpu" or n < 2:
+        raise RuntimeError("pallas ring kernel needs a multi-chip TPU mesh; "
+                           "use ring_all_gather_reference elsewhere")
+
+    def spmd(x):
+        chunk_rows, row_len = x.shape
+        kernel = functools.partial(_ring_kernel, n, chunk_rows, row_len)
+        # Chunks stay in VMEM (direct loads/stores are only legal there);
+        # total VMEM footprint = (n + 3) * chunk — callers keep chunks small
+        # and loop over larger payloads.
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((n * chunk_rows, row_len), x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((2, chunk_rows, row_len), x.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+            compiler_params=pltpu.CompilerParams(collective_id=7),
+        )(x)
+
+    return jax.jit(fabric.spmd(spmd, in_specs=P(axis), out_specs=P()))

@@ -1,0 +1,42 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from brpc_tpu.ops.echo_kernel import _BLOCK, echo_fused, echo_reference
+from brpc_tpu.ops.ring_kernel import ring_all_gather_reference
+from brpc_tpu.parallel.fabric import Fabric
+
+
+def test_echo_kernel_matches_reference():
+    payload = jnp.arange(2 * _BLOCK, dtype=jnp.uint32)
+    copy, csum = echo_fused(payload, interpret=True)
+    ref_copy, ref_sum = echo_reference(payload)
+    np.testing.assert_array_equal(np.asarray(copy), np.asarray(ref_copy))
+    assert int(csum) == int(ref_sum)
+
+
+def test_echo_kernel_rejects_unaligned():
+    with pytest.raises(AssertionError):
+        echo_fused(jnp.zeros((100,), jnp.uint32), interpret=True)
+
+
+def test_ring_all_gather_reference():
+    fabric = Fabric.auto((8,), ("link",))
+    fn = ring_all_gather_reference(fabric, "link")
+    local = fabric.put(
+        jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4), "link"
+    )
+    out = fn(local)
+    # Every peer ends with the full concatenation.
+    np.testing.assert_array_equal(
+        np.asarray(out), np.arange(32, dtype=np.float32).reshape(8, 4)
+    )
+
+
+def test_ring_pallas_gated_off_tpu():
+    from brpc_tpu.ops.ring_kernel import ring_all_gather_pallas
+
+    fabric = Fabric.auto((8,), ("link",))
+    with pytest.raises(RuntimeError):
+        ring_all_gather_pallas(fabric, "link")
