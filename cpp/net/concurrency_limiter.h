@@ -1,0 +1,157 @@
+// Concurrency limiters — per-method admission control.
+//
+// Parity: brpc's ConcurrencyLimiter extension (/root/reference/src/brpc/
+// concurrency_limiter.h; policy/auto_concurrency_limiter.cpp) with its
+// "constant" and "auto" policies and MethodStatus gating
+// (details/method_status.h).  "auto" is a condensed AIMD on latency: the
+// limit grows additively while latency stays near the no-load EMA and
+// backs off multiplicatively when it inflates.
+#pragma once
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <memory>
+#include <string>
+#include <utility>
+
+namespace trpc {
+
+// Rejected-by-limiter error code (parity: brpc::ELIMIT).
+constexpr int kELimit = 2004;
+
+class ConcurrencyLimiter {
+ public:
+  virtual ~ConcurrencyLimiter() = default;
+  // True = admitted (caller MUST later call on_response exactly once).
+  virtual bool on_request() = 0;
+  virtual void on_response(int64_t latency_us, bool error) = 0;
+  virtual int64_t current_limit() const = 0;
+
+  // spec: "" (unlimited → nullptr), "<N>" (constant), "auto".
+  static std::unique_ptr<ConcurrencyLimiter> create(const std::string& spec);
+};
+
+class ConstantLimiter final : public ConcurrencyLimiter {
+ public:
+  explicit ConstantLimiter(int64_t limit) : limit_(limit) {}
+
+  bool on_request() override {
+    if (inflight_.fetch_add(1, std::memory_order_acq_rel) >= limit_) {
+      inflight_.fetch_sub(1, std::memory_order_acq_rel);
+      return false;
+    }
+    return true;
+  }
+
+  void on_response(int64_t, bool) override {
+    inflight_.fetch_sub(1, std::memory_order_acq_rel);
+  }
+
+  int64_t current_limit() const override { return limit_; }
+
+ private:
+  const int64_t limit_;
+  std::atomic<int64_t> inflight_{0};
+};
+
+class AutoLimiter final : public ConcurrencyLimiter {
+ public:
+  bool on_request() override {
+    const int64_t limit = limit_.load(std::memory_order_acquire);
+    if (inflight_.fetch_add(1, std::memory_order_acq_rel) >= limit) {
+      inflight_.fetch_sub(1, std::memory_order_acq_rel);
+      return false;
+    }
+    return true;
+  }
+
+  void on_response(int64_t latency_us, bool error) override {
+    const int64_t inflight_now =
+        inflight_.fetch_sub(1, std::memory_order_acq_rel);
+    if (error || latency_us <= 0) {
+      return;
+    }
+    // No-load latency: EMA sampled while the method is nearly idle.
+    if (inflight_now <= 2) {
+      int64_t noload = noload_us_.load(std::memory_order_relaxed);
+      noload = noload == 0 ? latency_us : (noload * 7 + latency_us) / 8;
+      noload_us_.store(noload, std::memory_order_relaxed);
+    }
+    int64_t peak = peak_inflight_.load(std::memory_order_relaxed);
+    while (inflight_now > peak &&
+           !peak_inflight_.compare_exchange_weak(
+               peak, inflight_now, std::memory_order_relaxed)) {
+    }
+    latency_sum_us_.fetch_add(latency_us, std::memory_order_relaxed);
+    const int64_t n = samples_.fetch_add(1, std::memory_order_acq_rel) + 1;
+    if (n < kInterval) {
+      return;
+    }
+    // One adjuster per interval (the CAS winner).
+    int64_t expect = n;
+    if (!samples_.compare_exchange_strong(expect, 0,
+                                          std::memory_order_acq_rel)) {
+      return;
+    }
+    const int64_t avg =
+        latency_sum_us_.exchange(0, std::memory_order_acq_rel) / n;
+    const int64_t interval_peak =
+        peak_inflight_.exchange(0, std::memory_order_acq_rel);
+    const int64_t noload = noload_us_.load(std::memory_order_relaxed);
+    int64_t limit = limit_.load(std::memory_order_relaxed);
+    if (noload == 0 || avg <= noload + noload / 2) {
+      // Additive increase ONLY while the limit is actually being exercised;
+      // an idle-but-healthy method must not inflate the limit until it can
+      // never bind under a later overload.
+      if (interval_peak >= limit - limit / 4) {
+        limit += 4;
+      }
+    } else {
+      limit = limit * 9 / 10;  // multiplicative decrease once it inflates
+    }
+    limit_.store(std::max<int64_t>(limit, kMinLimit),
+                 std::memory_order_release);
+  }
+
+  int64_t current_limit() const override {
+    return limit_.load(std::memory_order_acquire);
+  }
+
+ private:
+  static constexpr int64_t kInterval = 64;  // responses per adjustment
+  static constexpr int64_t kMinLimit = 4;
+  std::atomic<int64_t> limit_{64};
+  std::atomic<int64_t> inflight_{0};
+  std::atomic<int64_t> peak_inflight_{0};
+  std::atomic<int64_t> noload_us_{0};
+  std::atomic<int64_t> latency_sum_us_{0};
+  std::atomic<int64_t> samples_{0};
+};
+
+// Returns {ok, limiter}: ok=false means the spec was unparseable (distinct
+// from ""/unlimited so callers can reject typos instead of silently
+// removing a limit).
+inline std::pair<bool, std::unique_ptr<ConcurrencyLimiter>>
+parse_concurrency_spec(const std::string& spec) {
+  if (spec.empty()) {
+    return {true, nullptr};
+  }
+  if (spec == "auto") {
+    return {true, std::make_unique<AutoLimiter>()};
+  }
+  char* end = nullptr;
+  const long n = strtol(spec.c_str(), &end, 10);
+  if (end == spec.c_str() || *end != '\0' || n <= 0) {
+    return {false, nullptr};
+  }
+  return {true, std::make_unique<ConstantLimiter>(n)};
+}
+
+inline std::unique_ptr<ConcurrencyLimiter> ConcurrencyLimiter::create(
+    const std::string& spec) {
+  return parse_concurrency_spec(spec).second;
+}
+
+}  // namespace trpc

@@ -134,6 +134,14 @@ void http_process_request(InputMessage&& msg) {
                  "no such path or method: " + path + "\n");
     return;
   }
+  // Admission gate — same limiter instance as the tstd path, so the
+  // configured per-method limit holds regardless of serving protocol.
+  std::shared_ptr<ConcurrencyLimiter> limiter = prop->limiter;
+  if (limiter != nullptr && !limiter->on_request()) {
+    http_respond(msg.socket, 503, "Service Unavailable", "text/plain",
+                 "rejected by concurrency limiter\n");
+    return;
+  }
   auto* cntl = new Controller();
   cntl->set_method(rpc_name);
   auto* response = new IOBuf();
@@ -145,7 +153,10 @@ void http_process_request(InputMessage&& msg) {
   // asynchronous handler cannot let a later pipelined response overtake.
   srv->in_flight.fetch_add(1, std::memory_order_acq_rel);
   auto latch = std::make_shared<CountdownEvent>(1);
-  Closure done = [sid, cntl, response, srv, lat, start_us, latch] {
+  Closure done = [sid, cntl, response, srv, lat, start_us, latch, limiter] {
+    if (limiter != nullptr) {
+      limiter->on_response(monotonic_time_us() - start_us, cntl->Failed());
+    }
     if (cntl->Failed()) {
       http_respond(sid, 500, "Internal Server Error", "text/plain",
                    cntl->error_text() + "\n");

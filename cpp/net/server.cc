@@ -48,6 +48,23 @@ int Server::RegisterMethod(const std::string& full_name, Handler handler) {
   return 0;
 }
 
+int Server::SetMethodMaxConcurrency(const std::string& method,
+                                    const std::string& spec) {
+  if (running()) {
+    return -1;
+  }
+  MethodProperty* prop = methods_.seek(method);
+  if (prop == nullptr) {
+    return -1;
+  }
+  auto [ok, limiter] = parse_concurrency_spec(spec);
+  if (!ok) {
+    return -1;  // typo'd spec must not silently mean "unlimited"
+  }
+  prop->limiter = std::move(limiter);
+  return 0;
+}
+
 int Server::Start(int port) {
   fiber_init(0);
   tstd_protocol();  // ensure registered (first: most traffic is RPC)
@@ -226,11 +243,20 @@ void tstd_process_request(InputMessage&& msg) {
       (srv != nullptr && srv->running()) ? srv->find_method(method) : nullptr;
   std::shared_ptr<LatencyRecorder> lat =
       prop != nullptr ? prop->latency : nullptr;
+  std::shared_ptr<ConcurrencyLimiter> limiter =
+      prop != nullptr ? prop->limiter : nullptr;
+  // Admission gate (MethodStatus parity): rejected calls never reach the
+  // handler and answer immediately with kELimit.
+  const bool admitted = limiter == nullptr || limiter->on_request();
+  if (!admitted) {
+    limiter = nullptr;  // no on_response for rejected calls
+  }
 
   if (srv != nullptr) {
     srv->in_flight.fetch_add(1, std::memory_order_acq_rel);
   }
-  Closure done = [socket_id, cid, cntl, response, start_us, srv, lat] {
+  Closure done = [socket_id, cid, cntl, response, start_us, srv, lat,
+                  limiter] {
     RpcMeta meta;
     meta.type = RpcMeta::kResponse;
     meta.correlation_id = cid;
@@ -251,8 +277,12 @@ void tstd_process_request(InputMessage&& msg) {
     if (s) {
       s->Write(std::move(frame));
     }
+    const int64_t latency_us = monotonic_time_us() - start_us;
+    if (limiter != nullptr) {
+      limiter->on_response(latency_us, cntl->Failed());
+    }
     if (lat != nullptr) {
-      *lat << (monotonic_time_us() - start_us);
+      *lat << latency_us;
     }
     delete response;
     delete cntl;
@@ -270,6 +300,11 @@ void tstd_process_request(InputMessage&& msg) {
   }
   if (prop == nullptr) {
     cntl->SetFailed(ENOENT, "no such method: " + method);
+    done();
+    return;
+  }
+  if (!admitted) {
+    cntl->SetFailed(kELimit, "rejected by concurrency limiter");
     done();
     return;
   }

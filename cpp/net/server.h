@@ -15,6 +15,7 @@
 #include "base/flat_map.h"
 #include "base/recordio.h"
 #include "fiber/sync.h"
+#include "net/concurrency_limiter.h"
 #include "net/controller.h"
 #include "net/socket.h"
 #include "stat/latency_recorder.h"
@@ -33,7 +34,13 @@ class Server {
   struct MethodProperty {
     Handler handler;
     std::shared_ptr<LatencyRecorder> latency;
+    std::shared_ptr<ConcurrencyLimiter> limiter;  // null = unlimited
   };
+
+  // Admission control for one method: "" unlimited, "<N>" constant, "auto"
+  // (AIMD).  Call before Start.
+  int SetMethodMaxConcurrency(const std::string& method,
+                              const std::string& spec);
 
   ~Server();
 

@@ -199,6 +199,54 @@ TEST_CASE(attachment_roundtrip) {
   EXPECT(cntl.response_attachment().to_string() == "ATTACHMENT-BYTES");
 }
 
+TEST_CASE(concurrency_limiter_constant) {
+  static Server lim_srv;
+  lim_srv.RegisterMethod("Lim.Slow", [](Controller*, const IOBuf& req,
+                                        IOBuf* resp, Closure done) {
+    fiber_sleep_us(150000);
+    resp->append(req);
+    done();
+  });
+  EXPECT_EQ(lim_srv.SetMethodMaxConcurrency("Lim.Slow", "2"), 0);
+  EXPECT(lim_srv.SetMethodMaxConcurrency("No.Such", "2") != 0);
+  EXPECT(lim_srv.SetMethodMaxConcurrency("Lim.Slow", "1O0") != 0);  // typo
+  EXPECT(lim_srv.SetMethodMaxConcurrency("Lim.Slow", "0") != 0);
+  EXPECT_EQ(lim_srv.Start(0), 0);
+  static Channel lch;
+  EXPECT_EQ(lch.Init("127.0.0.1:" + std::to_string(lim_srv.port())), 0);
+  static std::atomic<int> ok{0}, limited{0};
+  std::vector<fiber_t> ids(8);
+  for (auto& f : ids) {
+    fiber_start(&f, [](void*) {
+      Controller cntl;
+      cntl.set_timeout_ms(2000);
+      IOBuf req, resp;
+      req.append("x");
+      lch.CallMethod("Lim.Slow", req, &resp, &cntl);
+      if (!cntl.Failed()) {
+        ok.fetch_add(1);
+      } else if (cntl.error_code() == kELimit) {
+        limited.fetch_add(1);
+      }
+    }, nullptr);
+  }
+  for (auto f : ids) {
+    fiber_join(f);
+  }
+  // 8 concurrent calls, limit 2, 150ms each, 2s budget: the first wave of
+  // up to 2 runs; the rest answer kELimit instantly.
+  EXPECT_EQ(ok.load() + limited.load(), 8);
+  EXPECT(limited.load() >= 5);
+  EXPECT(ok.load() >= 2);
+  // Capacity frees up afterwards.
+  Controller cntl;
+  cntl.set_timeout_ms(2000);
+  IOBuf req, resp;
+  req.append("later");
+  lch.CallMethod("Lim.Slow", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+}
+
 TEST_CASE(connect_refused_times_out) {
   Channel ch;
   EXPECT_EQ(ch.Init("127.0.0.1:1"), 0);  // nothing listens on port 1
