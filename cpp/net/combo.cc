@@ -1,0 +1,222 @@
+#include "net/combo.h"
+
+#include <errno.h>
+
+#include <algorithm>
+
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+
+namespace trpc {
+
+namespace {
+
+class PlainSub final : public SubChannel {
+ public:
+  explicit PlainSub(std::shared_ptr<Channel> ch) : ch_(std::move(ch)) {}
+  void Call(const std::string& method, const IOBuf& request, IOBuf* response,
+            Controller* cntl) override {
+    ch_->CallMethod(method, request, response, cntl);
+  }
+
+ private:
+  std::shared_ptr<Channel> ch_;
+};
+
+class ClusterSub final : public SubChannel {
+ public:
+  explicit ClusterSub(std::shared_ptr<ClusterChannel> ch)
+      : ch_(std::move(ch)) {}
+  void Call(const std::string& method, const IOBuf& request, IOBuf* response,
+            Controller* cntl) override {
+    ch_->CallMethod(method, request, response, cntl);
+  }
+
+ private:
+  std::shared_ptr<ClusterChannel> ch_;
+};
+
+// One fan-out sub-call, run in its own fiber (sub-done aggregation parity,
+// parallel_channel.cpp:88-153 — ours is a shared ctx + countdown).  The ctx
+// (including the latch) is shared_ptr-held by every fiber so the LAST
+// signaler can still be inside the latch when the caller's frame moves on.
+// Fibers write only their own cntls[i]/responses[i] slot; success flags are
+// derived from the controllers AFTER the join (no concurrent bit-vector
+// writes).
+struct FanoutCtx {
+  explicit FanoutCtx(int n) : latch(n) {
+    responses.resize(n);
+    cntls.resize(n);
+  }
+  std::vector<std::shared_ptr<SubChannel>> subs;
+  std::string method;
+  std::vector<IOBuf> requests;
+  std::vector<IOBuf> responses;
+  std::vector<Controller> cntls;
+  std::vector<bool> oks;  // filled after the join
+  CountdownEvent latch;
+};
+
+struct FanoutArg {
+  std::shared_ptr<FanoutCtx> ctx;
+  int index;
+};
+
+void fanout_fiber(void* p) {
+  std::unique_ptr<FanoutArg> arg(static_cast<FanoutArg*>(p));
+  FanoutCtx* ctx = arg->ctx.get();
+  const int i = arg->index;
+  ctx->subs[i]->Call(ctx->method, ctx->requests[i], &ctx->responses[i],
+                     &ctx->cntls[i]);
+  ctx->latch.signal();
+}
+
+void run_fanout(const std::shared_ptr<FanoutCtx>& ctx) {
+  const int n = static_cast<int>(ctx->subs.size());
+  for (int i = 0; i < n; ++i) {
+    if (fiber_start(nullptr, fanout_fiber, new FanoutArg{ctx, i}, 0) != 0) {
+      // Spawn failure must not hang the join.
+      ctx->cntls[i].SetFailed(EAGAIN, "fiber_start failed");
+      ctx->latch.signal();
+    }
+  }
+  ctx->latch.wait(-1);
+  ctx->oks.resize(n);
+  for (int i = 0; i < n; ++i) {
+    ctx->oks[i] = !ctx->cntls[i].Failed();
+  }
+}
+
+void concat_merger(const std::vector<IOBuf>& subs, const std::vector<bool>& oks,
+                   IOBuf* merged) {
+  for (size_t i = 0; i < subs.size(); ++i) {
+    if (oks[i]) {
+      merged->append(subs[i]);
+    }
+  }
+}
+
+}  // namespace
+
+std::shared_ptr<SubChannel> make_sub_channel(std::shared_ptr<Channel> ch) {
+  return std::make_shared<PlainSub>(std::move(ch));
+}
+
+std::shared_ptr<SubChannel> make_sub_channel(
+    std::shared_ptr<ClusterChannel> ch) {
+  return std::make_shared<ClusterSub>(std::move(ch));
+}
+
+void ParallelChannel::CallMethod(const std::string& method,
+                                 const IOBuf& request, IOBuf* response,
+                                 Controller* cntl, const Options* opts) {
+  if (subs_.empty()) {
+    cntl->SetFailed(ENOENT, "no sub channels");
+    return;
+  }
+  fiber_init(0);
+  Options defaults;
+  const Options& o = opts != nullptr ? *opts : defaults;
+
+  auto ctx = std::make_shared<FanoutCtx>(static_cast<int>(subs_.size()));
+  ctx->subs = subs_;
+  ctx->method = method;
+  ctx->requests.reserve(subs_.size());
+  for (size_t i = 0; i < subs_.size(); ++i) {
+    ctx->requests.push_back(o.mapper
+                                ? o.mapper(static_cast<int>(i), request)
+                                : request);  // broadcast shares blocks
+    ctx->cntls[i].set_timeout_ms(cntl->timeout_ms());
+  }
+  run_fanout(ctx);
+
+  int failures = 0;
+  for (bool ok : ctx->oks) {
+    failures += !ok;
+  }
+  const int fail_limit = o.fail_limit < 0 ? 0 : o.fail_limit;
+  if (failures > fail_limit) {
+    // Report the first failure's code (fail_limit semantics).
+    for (size_t i = 0; i < ctx->oks.size(); ++i) {
+      if (!ctx->oks[i]) {
+        cntl->SetFailed(ctx->cntls[i].error_code(),
+                        "parallel: " + std::to_string(failures) + "/" +
+                            std::to_string(subs_.size()) + " subs failed: " +
+                            ctx->cntls[i].error_text());
+        return;
+      }
+    }
+  }
+  if (o.merger) {
+    o.merger(ctx->responses, ctx->oks, response);
+  } else {
+    concat_merger(ctx->responses, ctx->oks, response);
+  }
+}
+
+void SelectiveChannel::CallMethod(const std::string& method,
+                                  const IOBuf& request, IOBuf* response,
+                                  Controller* cntl, int max_failover) {
+  if (subs_.empty()) {
+    cntl->SetFailed(ENOENT, "no sub channels");
+    return;
+  }
+  const size_t start = next_.fetch_add(1, std::memory_order_relaxed);
+  const int attempts =
+      std::min<int>(1 + max_failover, static_cast<int>(subs_.size()));
+  IOBuf attachment = cntl->request_attachment();  // survive per-try Reset
+  for (int a = 0; a < attempts; ++a) {
+    cntl->Reset();
+    cntl->request_attachment() = attachment;
+    response->clear();
+    subs_[(start + a) % subs_.size()]->Call(method, request, response, cntl);
+    if (!cntl->Failed()) {
+      return;
+    }
+  }
+}
+
+void PartitionChannel::CallMethod(const std::string& method,
+                                  const IOBuf& request, IOBuf* response,
+                                  Controller* cntl, Partitioner partitioner,
+                                  ParallelChannel::ResponseMerger merger) {
+  if (subs_.empty()) {
+    cntl->SetFailed(ENOENT, "no partitions");
+    return;
+  }
+  if (!partitioner) {
+    cntl->SetFailed(EINVAL, "null partitioner");
+    return;
+  }
+  fiber_init(0);
+  std::vector<IOBuf> parts = partitioner(request, subs_.size());
+  if (parts.size() != subs_.size()) {
+    cntl->SetFailed(EINVAL, "partitioner returned wrong count");
+    return;
+  }
+  auto ctx = std::make_shared<FanoutCtx>(static_cast<int>(subs_.size()));
+  ctx->subs = subs_;
+  ctx->method = method;
+  ctx->requests = std::move(parts);
+  for (size_t i = 0; i < subs_.size(); ++i) {
+    ctx->cntls[i].set_timeout_ms(cntl->timeout_ms());
+  }
+  run_fanout(ctx);
+  for (size_t i = 0; i < ctx->oks.size(); ++i) {
+    if (!ctx->oks[i]) {  // partitions are all-or-nothing
+      cntl->SetFailed(ctx->cntls[i].error_code(),
+                      "partition " + std::to_string(i) + " failed: " +
+                          ctx->cntls[i].error_text());
+      return;
+    }
+  }
+  if (merger) {
+    merger(ctx->responses, ctx->oks, response);
+  } else {
+    for (const IOBuf& r : ctx->responses) {
+      response->append(r);
+    }
+  }
+}
+
+}  // namespace trpc

@@ -1,0 +1,101 @@
+// Combo channels — declarative scatter/gather over sub-channels.
+//
+// Parity (SURVEY.md §2.4): ParallelChannel
+// (/root/reference/src/brpc/parallel_channel.h:202 with CallMapper :102 and
+// ResponseMerger :141, fail_limit semantics), SelectiveChannel
+// (selective_channel.h:52 — LB over heterogeneous sub-channels with
+// failover), PartitionChannel (partition_channel.h:75 — shard one logical
+// request across partitions).  The TPU-native twins lower these onto XLA
+// collectives (brpc_tpu/channels/combo.py); this is the host-side form for
+// byte-payload RPCs.
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "net/cluster.h"
+#include "net/controller.h"
+
+namespace trpc {
+
+// Sub-call abstraction: anything that can CallMethod (Channel or
+// ClusterChannel) — heterogeneous subs are the SelectiveChannel use case.
+class SubChannel {
+ public:
+  virtual ~SubChannel() = default;
+  virtual void Call(const std::string& method, const IOBuf& request,
+                    IOBuf* response, Controller* cntl) = 0;
+};
+
+std::shared_ptr<SubChannel> make_sub_channel(std::shared_ptr<Channel> ch);
+std::shared_ptr<SubChannel> make_sub_channel(std::shared_ptr<ClusterChannel> ch);
+
+class ParallelChannel {
+ public:
+  // Maps the logical request to sub-call i's request (null = broadcast).
+  using CallMapper = std::function<IOBuf(int index, const IOBuf& request)>;
+  // Merges sub-responses (failed subs have empty slots; check oks).
+  using ResponseMerger = std::function<void(
+      const std::vector<IOBuf>& sub_responses, const std::vector<bool>& oks,
+      IOBuf* merged)>;
+
+  struct Options {
+    int fail_limit = -1;  // -1 = all subs must succeed
+    CallMapper mapper;
+    ResponseMerger merger;  // default: concatenate successful responses
+  };
+
+  void add_sub_channel(std::shared_ptr<SubChannel> sub) {
+    subs_.push_back(std::move(sub));
+  }
+  size_t sub_count() const { return subs_.size(); }
+
+  // Fans out to every sub concurrently, waits for all, merges.
+  // cntl fails when failures > fail_limit (parallel_channel fail_limit
+  // semantics: the call succeeds while at most fail_limit subs fail).
+  void CallMethod(const std::string& method, const IOBuf& request,
+                  IOBuf* response, Controller* cntl,
+                  const Options* opts = nullptr);
+
+ private:
+  std::vector<std::shared_ptr<SubChannel>> subs_;
+};
+
+// LB over heterogeneous sub-channels with failover to the next sub.
+class SelectiveChannel {
+ public:
+  void add_sub_channel(std::shared_ptr<SubChannel> sub) {
+    subs_.push_back(std::move(sub));
+  }
+  void CallMethod(const std::string& method, const IOBuf& request,
+                  IOBuf* response, Controller* cntl, int max_failover = 1);
+
+ private:
+  std::vector<std::shared_ptr<SubChannel>> subs_;
+  std::atomic<uint64_t> next_{0};
+};
+
+// Shards one logical request across partition sub-channels.
+class PartitionChannel {
+ public:
+  // Splits the request into one IOBuf per partition.
+  using Partitioner = std::function<std::vector<IOBuf>(
+      const IOBuf& request, size_t num_partitions)>;
+
+  void add_partition(std::shared_ptr<SubChannel> sub) {
+    subs_.push_back(std::move(sub));
+  }
+  // All partitions must succeed; responses concatenate in partition order
+  // unless `merger` is given.
+  void CallMethod(const std::string& method, const IOBuf& request,
+                  IOBuf* response, Controller* cntl, Partitioner partitioner,
+                  ParallelChannel::ResponseMerger merger = nullptr);
+
+ private:
+  std::vector<std::shared_ptr<SubChannel>> subs_;
+};
+
+}  // namespace trpc

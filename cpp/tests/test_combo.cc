@@ -1,0 +1,177 @@
+// Combo channel tests: fan-out/merge, fail_limit, selective failover,
+// partitioned calls (the reference drives these against N in-process
+// servers, SURVEY.md §4).
+#include <atomic>
+#include <memory>
+#include <string>
+
+#include "base/time.h"
+#include "net/combo.h"
+#include "net/server.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+namespace {
+
+Server* g_nodes[3];
+int g_ports[3];
+bool g_started = false;
+
+void start_nodes() {
+  if (g_started) {
+    return;
+  }
+  g_started = true;
+  for (int i = 0; i < 3; ++i) {
+    g_nodes[i] = new Server();
+    g_nodes[i]->RegisterMethod(
+        "C.Tag", [i](Controller*, const IOBuf& req, IOBuf* resp,
+                     Closure done) {
+          resp->append("[" + std::to_string(i) + ":" + req.to_string() + "]");
+          done();
+        });
+    g_nodes[i]->RegisterMethod(
+        "C.Sum", [](Controller*, const IOBuf& req, IOBuf* resp,
+                    Closure done) {
+          // Sums bytes of its partition.
+          long total = 0;
+          const std::string s = req.to_string();
+          for (char c : s) {
+            total += static_cast<unsigned char>(c);
+          }
+          resp->append(std::to_string(total) + ";");
+          done();
+        });
+    EXPECT_EQ(g_nodes[i]->Start(0), 0);
+    g_ports[i] = g_nodes[i]->port();
+  }
+}
+
+std::shared_ptr<SubChannel> sub(int i) {
+  auto ch = std::make_shared<Channel>();
+  EXPECT_EQ(ch->Init("127.0.0.1:" + std::to_string(g_ports[i])), 0);
+  return make_sub_channel(ch);
+}
+
+std::shared_ptr<SubChannel> dead_sub() {
+  auto ch = std::make_shared<Channel>();
+  Channel::Options o;
+  o.timeout_ms = 200;
+  EXPECT_EQ(ch->Init("127.0.0.1:1", &o), 0);
+  return make_sub_channel(ch);
+}
+
+}  // namespace
+
+TEST_CASE(parallel_broadcast_merge) {
+  start_nodes();
+  ParallelChannel pch;
+  for (int i = 0; i < 3; ++i) {
+    pch.add_sub_channel(sub(i));
+  }
+  Controller cntl;
+  IOBuf req, resp;
+  req.append("hi");
+  pch.CallMethod("C.Tag", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+  // Default merger concatenates (order = sub order since all succeed).
+  EXPECT(resp.to_string() == "[0:hi][1:hi][2:hi]");
+}
+
+TEST_CASE(parallel_call_mapper) {
+  start_nodes();
+  ParallelChannel pch;
+  for (int i = 0; i < 3; ++i) {
+    pch.add_sub_channel(sub(i));
+  }
+  ParallelChannel::Options opts;
+  opts.mapper = [](int i, const IOBuf&) {
+    IOBuf b;
+    b.append("sub" + std::to_string(i));
+    return b;
+  };
+  Controller cntl;
+  IOBuf req, resp;
+  req.append("ignored");
+  pch.CallMethod("C.Tag", req, &resp, &cntl, &opts);
+  EXPECT(!cntl.Failed());
+  EXPECT(resp.to_string() == "[0:sub0][1:sub1][2:sub2]");
+}
+
+TEST_CASE(parallel_fail_limit) {
+  start_nodes();
+  ParallelChannel pch;
+  pch.add_sub_channel(sub(0));
+  pch.add_sub_channel(dead_sub());
+  pch.add_sub_channel(sub(2));
+
+  // Default fail_limit 0: one dead sub fails the call.
+  {
+    Controller cntl;
+    cntl.set_timeout_ms(500);
+    IOBuf req, resp;
+    req.append("x");
+    pch.CallMethod("C.Tag", req, &resp, &cntl);
+    EXPECT(cntl.Failed());
+  }
+  // fail_limit 1 tolerates it and merges the survivors.
+  {
+    ParallelChannel::Options opts;
+    opts.fail_limit = 1;
+    Controller cntl;
+    cntl.set_timeout_ms(500);
+    IOBuf req, resp;
+    req.append("x");
+    pch.CallMethod("C.Tag", req, &resp, &cntl, &opts);
+    EXPECT(!cntl.Failed());
+    EXPECT(resp.to_string() == "[0:x][2:x]");
+  }
+}
+
+TEST_CASE(selective_failover) {
+  start_nodes();
+  SelectiveChannel sch;
+  sch.add_sub_channel(dead_sub());
+  sch.add_sub_channel(sub(1));
+  int ok = 0;
+  for (int i = 0; i < 6; ++i) {
+    Controller cntl;
+    cntl.set_timeout_ms(500);
+    IOBuf req, resp;
+    req.append("s");
+    sch.CallMethod("C.Tag", req, &resp, &cntl, /*max_failover=*/1);
+    if (!cntl.Failed()) {
+      EXPECT(resp.to_string() == "[1:s]");
+      ++ok;
+    }
+  }
+  EXPECT_EQ(ok, 6);  // failover always reaches the live sub
+}
+
+TEST_CASE(partition_channel_shards) {
+  start_nodes();
+  PartitionChannel pch;
+  for (int i = 0; i < 3; ++i) {
+    pch.add_partition(sub(i));
+  }
+  Controller cntl;
+  IOBuf req, resp;
+  req.append("abcdef");  // 6 bytes → 2 per partition
+  pch.CallMethod(
+      "C.Sum", req, &resp, &cntl,
+      [](const IOBuf& r, size_t n) {
+        std::vector<IOBuf> parts(n);
+        IOBuf copy = r;
+        const size_t each = r.size() / n;
+        for (size_t i = 0; i < n; ++i) {
+          copy.cutn(&parts[i], i + 1 == n ? copy.size() : each);
+        }
+        return parts;
+      });
+  EXPECT(!cntl.Failed());
+  // 'a'+'b'=195, 'c'+'d'=199, 'e'+'f'=203
+  EXPECT(resp.to_string() == "195;199;203;");
+}
+
+TEST_MAIN

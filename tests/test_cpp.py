@@ -54,5 +54,9 @@ def test_stream():
     _run("test_stream", timeout=180)
 
 
+def test_combo():
+    _run("test_combo", timeout=180)
+
+
 def test_http():
     _run("test_http")
