@@ -1,5 +1,7 @@
 #include "net/cluster.h"
 
+#include "net/concurrency_limiter.h"
+
 #include <errno.h>
 
 #include <algorithm>
@@ -11,6 +13,7 @@
 #include "base/rand.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
+#include "fiber/sync.h"
 
 namespace trpc {
 
@@ -259,12 +262,90 @@ void ClusterChannel::refresh_fiber(void* arg) {
     if (self->stopping_.load(std::memory_order_acquire)) {
       break;
     }
-    self->refresh();  // PeriodicNamingService parity
+    self->refresh();       // PeriodicNamingService parity
+    self->health_check();  // details/health_check.cpp parity
   }
   self->refresh_done_.value.store(1, std::memory_order_release);
   self->refresh_done_.wake_all();
   // LAST access to *self (see ~ClusterChannel).
   self->refresher_exited_.store(true, std::memory_order_release);
+}
+
+namespace {
+
+struct ProbeCtx {
+  std::shared_ptr<void> cluster_keepalive;
+  std::shared_ptr<Channel> channel;
+  std::shared_ptr<std::atomic<int64_t>> quarantined_until;
+  std::shared_ptr<std::atomic<int>> fail_counter;
+  std::string method;
+  int64_t timeout_ms;
+  std::shared_ptr<CountdownEvent> latch;
+};
+
+void probe_fiber(void* p) {
+  std::unique_ptr<ProbeCtx> ctx(static_cast<ProbeCtx*>(p));
+  Controller cntl;
+  cntl.set_timeout_ms(ctx->timeout_ms);
+  IOBuf req, resp;
+  ctx->channel->CallMethod(ctx->method, req, &resp, &cntl);
+  // ALLOWLIST of "the server definitely answered": success, or the
+  // server-side errors a probe legitimately produces (no such method,
+  // admission-limited).  Everything else — including local failures like
+  // fid exhaustion — must NOT revive the node.
+  const bool answered = !cntl.Failed() || cntl.error_code() == ENOENT ||
+                        cntl.error_code() == kELimit ||
+                        cntl.error_code() == ESHUTDOWN;
+  if (answered) {
+    ctx->quarantined_until->store(0, std::memory_order_relaxed);
+    ctx->fail_counter->store(0, std::memory_order_relaxed);
+  }
+  ctx->latch->signal();
+}
+
+}  // namespace
+
+void ClusterChannel::health_check() {
+  if (opts_.health_check_method.empty()) {
+    return;
+  }
+  std::shared_ptr<Cluster> cluster;
+  {
+    auto cur = cluster_.Read();
+    cluster = *cur;
+  }
+  if (cluster == nullptr) {
+    return;
+  }
+  // Probes fan out concurrently so N blackholed nodes cost one probe
+  // timeout per tick, not N (and shutdown isn't stalled behind them).
+  const int64_t now = monotonic_time_us();
+  std::vector<ProbeCtx*> probes;
+  for (size_t i = 0; i < cluster->nodes.size(); ++i) {
+    ServerNode& node = cluster->nodes[i];
+    if (node.quarantined_until_us->load(std::memory_order_relaxed) <= now) {
+      continue;  // healthy (or already expired)
+    }
+    probes.push_back(new ProbeCtx{cluster, cluster->channels[i],
+                                  node.quarantined_until_us,
+                                  node.consecutive_failures,
+                                  opts_.health_check_method,
+                                  opts_.health_check_timeout_ms, nullptr});
+  }
+  if (probes.empty()) {
+    return;
+  }
+  auto latch =
+      std::make_shared<CountdownEvent>(static_cast<int>(probes.size()));
+  for (ProbeCtx* p : probes) {
+    p->latch = latch;
+    if (fiber_start(nullptr, probe_fiber, p, 0) != 0) {
+      latch->signal();
+      delete p;
+    }
+  }
+  latch->wait(monotonic_time_us() + opts_.health_check_timeout_ms * 1000 +
+              1000000);
 }
 
 size_t ClusterChannel::healthy_count() {

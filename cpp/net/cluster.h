@@ -60,6 +60,13 @@ class ClusterChannel {
   struct Options {
     int64_t timeout_ms = 1000;
     int max_retry = 2;                   // additional attempts on failure
+    // Health checking (parity: details/health_check.cpp): quarantined nodes
+    // are probed every refresh tick with this method; ANY response — even a
+    // method-not-found error — proves the transport alive and revives the
+    // node early (socket.h:498-505 revive semantics).  "" disables probing
+    // (nodes then revive only when their quarantine window expires).
+    std::string health_check_method = "Echo.Health";
+    int64_t health_check_timeout_ms = 300;
     // Hedging (parity: backup_request_policy.h + the backup timer in
     // channel.cpp:582-603): if > 0 and the first attempt hasn't answered
     // within this budget, a second attempt races it on another node; the
@@ -80,6 +87,8 @@ class ClusterChannel {
 
   // Re-resolves now (also runs periodically in a refresh fiber).
   int refresh();
+  // Probes quarantined nodes; revives any that answer (runs periodically).
+  void health_check();
   size_t healthy_count();
 
  private:

@@ -127,6 +127,7 @@ void ParallelChannel::CallMethod(const std::string& method,
                                 ? o.mapper(static_cast<int>(i), request)
                                 : request);  // broadcast shares blocks
     ctx->cntls[i].set_timeout_ms(cntl->timeout_ms());
+    ctx->cntls[i].request_attachment() = cntl->request_attachment();
   }
   run_fanout(ctx);
 
@@ -200,6 +201,7 @@ void PartitionChannel::CallMethod(const std::string& method,
   ctx->requests = std::move(parts);
   for (size_t i = 0; i < subs_.size(); ++i) {
     ctx->cntls[i].set_timeout_ms(cntl->timeout_ms());
+    ctx->cntls[i].request_attachment() = cntl->request_attachment();
   }
   run_fanout(ctx);
   for (size_t i = 0; i < ctx->oks.size(); ++i) {

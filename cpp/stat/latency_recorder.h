@@ -14,11 +14,12 @@
 #include <vector>
 
 #include "stat/reducer.h"
+#include "stat/sampler.h"
 #include "stat/variable.h"
 
 namespace trpc {
 
-class LatencyRecorder : public Variable {
+class LatencyRecorder : public Variable, public Sampled {
  public:
   static constexpr int kReservoir = 1024;
   static constexpr int kWindowSecs = 10;
@@ -37,7 +38,7 @@ class LatencyRecorder : public Variable {
   std::string value_str() const override;
 
   // Called by the sampler thread once per second.
-  void take_sample();
+  void take_sample() override;
 
  private:
   struct Second {

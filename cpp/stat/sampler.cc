@@ -5,8 +5,6 @@
 
 #include <algorithm>
 
-#include "stat/latency_recorder.h"
-
 namespace trpc {
 
 Sampler* Sampler::instance() {
@@ -27,23 +25,23 @@ Sampler::Sampler() {
   pthread_detach(tid);
 }
 
-void Sampler::add(LatencyRecorder* r) {
+void Sampler::add(Sampled* s) {
   std::lock_guard<std::mutex> g(mu_);
-  recorders_.push_back(r);
+  sampled_.push_back(s);
 }
 
-void Sampler::remove(LatencyRecorder* r) {
+void Sampler::remove(Sampled* s) {
   std::lock_guard<std::mutex> g(mu_);
-  recorders_.erase(std::remove(recorders_.begin(), recorders_.end(), r),
-                   recorders_.end());
+  sampled_.erase(std::remove(sampled_.begin(), sampled_.end(), s),
+                 sampled_.end());
 }
 
 void Sampler::run() {
   while (true) {
     usleep(1000000);
     std::lock_guard<std::mutex> g(mu_);
-    for (LatencyRecorder* r : recorders_) {
-      r->take_sample();
+    for (Sampled* s : sampled_) {
+      s->take_sample();
     }
   }
 }

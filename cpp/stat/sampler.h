@@ -1,5 +1,5 @@
-// Sampler — ONE background thread snapshots every recorder each second
-// (parity: bvar SamplerCollector, /root/reference/src/bvar/detail/
+// Sampler — ONE background thread snapshots every registered object each
+// second (parity: bvar SamplerCollector, /root/reference/src/bvar/detail/
 // sampler.cpp:60-135).
 #pragma once
 
@@ -8,19 +8,24 @@
 
 namespace trpc {
 
-class LatencyRecorder;
+// Anything needing a once-per-second snapshot tick.
+class Sampled {
+ public:
+  virtual ~Sampled() = default;
+  virtual void take_sample() = 0;
+};
 
 class Sampler {
  public:
   static Sampler* instance();
-  void add(LatencyRecorder* r);
-  void remove(LatencyRecorder* r);
+  void add(Sampled* s);
+  void remove(Sampled* s);
 
  private:
   Sampler();
   void run();
   std::mutex mu_;
-  std::vector<LatencyRecorder*> recorders_;
+  std::vector<Sampled*> sampled_;
 };
 
 }  // namespace trpc

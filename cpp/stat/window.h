@@ -1,0 +1,57 @@
+// Window / PerSecond — trailing-window views over reducers.
+//
+// Parity: bvar::Window / bvar::PerSecond (/root/reference/src/bvar/window.h):
+// a Window<Adder> shows the delta accumulated over the last N seconds; a
+// PerSecond divides it by the span.  Backed by the shared once-per-second
+// Sampler thread.
+#pragma once
+
+#include <mutex>
+#include <vector>
+
+#include "stat/reducer.h"
+#include "stat/sampler.h"
+#include "stat/variable.h"
+
+namespace trpc {
+
+class WindowedAdder : public Variable, public Sampled {
+ public:
+  explicit WindowedAdder(Adder* base, int window_secs = 10)
+      : base_(base), samples_(static_cast<size_t>(std::max(window_secs, 1)) + 1, 0) {
+    Sampler::instance()->add(this);
+  }
+  ~WindowedAdder() override {
+    hide();
+    Sampler::instance()->remove(this);
+  }
+
+  // Sum accumulated during the trailing window.
+  int64_t get_value() const {
+    std::lock_guard<std::mutex> g(mu_);
+    const size_t n = samples_.size();
+    return samples_[(pos_ + n - 1) % n] - samples_[pos_ % n];
+  }
+
+  int64_t per_second() const {
+    return get_value() / static_cast<int64_t>(samples_.size() - 1);
+  }
+
+  std::string value_str() const override {
+    return std::to_string(get_value());
+  }
+
+  void take_sample() override {
+    std::lock_guard<std::mutex> g(mu_);
+    samples_[pos_ % samples_.size()] = base_->get_value();
+    ++pos_;
+  }
+
+ private:
+  Adder* base_;
+  mutable std::mutex mu_;
+  std::vector<int64_t> samples_;  // ring of cumulative snapshots
+  size_t pos_ = 0;
+};
+
+}  // namespace trpc

@@ -196,6 +196,76 @@ TEST_CASE(backup_request_hedging) {
   EXPECT_EQ(fast_wins, 6);
 }
 
+TEST_CASE(health_check_revives_node) {
+  start_nodes();
+  ClusterChannel::Options opts;
+  opts.timeout_ms = 300;
+  opts.max_retry = 0;
+  opts.refresh_interval_ms = 50;       // probe quickly
+  opts.quarantine_base_ms = 60000;     // quarantine would last a minute...
+  // Dead port + live node: the breaker quarantines the dead one.
+  ClusterChannel ch2;
+  EXPECT_EQ(ch2.Init("list://127.0.0.1:1,127.0.0.1:" +
+                         std::to_string(g_nodes[0].port),
+                     "rr", &opts),
+            0);
+  // Drive calls until the dead node lands in quarantine.
+  for (int i = 0; i < 4; ++i) {
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("x");
+    ch2.CallMethod("Echo.WhoAmI", req, &resp, &cntl);
+  }
+  // Probe ticks run every 50ms; the dead port can't answer, so after
+  // several ticks it remains quarantined (revive only works on live nodes).
+  usleep(300000);
+  EXPECT_EQ(ch2.healthy_count(), 1u);  // live node healthy, dead one not
+
+  // Now quarantine the LIVE node artificially by failing calls to a
+  // stopped server, then restarting it: simulate with node churn instead —
+  // probe revival is covered by: quarantine the live node via the breaker
+  // on a method that times out.
+  static Server slow;
+  slow.RegisterMethod("Echo.WhoAmI", [](Controller*, const IOBuf&,
+                                        IOBuf* resp, Closure done) {
+    resp->append("slow-alive");
+    done();
+  });
+  slow.RegisterMethod("Echo.Stall", [](Controller*, const IOBuf&, IOBuf*,
+                                       Closure done) {
+    fiber_sleep_us(600000);  // > timeout: breaker counts failures
+    done();
+  });
+  EXPECT_EQ(slow.Start(0), 0);
+  ClusterChannel ch3;
+  EXPECT_EQ(ch3.Init("list://127.0.0.1:" + std::to_string(slow.port()), "rr",
+                     &opts),
+            0);
+  for (int i = 0; i < 2; ++i) {
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("x");
+    ch3.CallMethod("Echo.Stall", req, &resp, &cntl);  // times out → breaker
+    EXPECT(cntl.Failed());
+  }
+  // (healthy_count may already be back to 1 if a probe tick raced in —
+  // the durable assertion is revival well inside the 60s window below.)
+  // Health probe (Echo.Health → ENOENT from this server = alive) must
+  // revive it far sooner than the 60s window.
+  const int64_t deadline = monotonic_time_us() + 3000000;
+  while (ch3.healthy_count() == 0 && monotonic_time_us() < deadline) {
+    usleep(20000);
+  }
+  EXPECT_EQ(ch3.healthy_count(), 1u);
+  // And traffic flows again.
+  Controller cntl;
+  IOBuf req, resp;
+  req.append("x");
+  ch3.CallMethod("Echo.WhoAmI", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+  EXPECT(resp.to_string() == "slow-alive");
+}
+
 TEST_CASE(async_cluster_call) {
   ClusterChannel ch;
   EXPECT_EQ(ch.Init(list_url(), "rr"), 0);

@@ -7,6 +7,7 @@
 #include "stat/latency_recorder.h"
 #include "stat/reducer.h"
 #include "stat/variable.h"
+#include "stat/window.h"
 #include "tests/test_util.h"
 
 using namespace trpc;
@@ -72,6 +73,24 @@ TEST_CASE(passive_status) {
   EXPECT(ps.value_str() == "14");
   x = 10;
   EXPECT_EQ(ps.get_value(), 20);
+}
+
+TEST_CASE(windowed_adder) {
+  Adder base;
+  WindowedAdder win(&base, 5);
+  base << 100;
+  win.take_sample();  // cumulative snapshot: 100
+  base << 50;
+  win.take_sample();  // 150
+  // Window delta = newest - oldest retained.
+  EXPECT(win.get_value() >= 100);
+  for (int i = 0; i < 10; ++i) {
+    win.take_sample();  // ring wraps; no growth without new adds
+  }
+  EXPECT_EQ(win.get_value(), 0);  // no adds in the trailing window
+  base << 7;
+  win.take_sample();
+  EXPECT_EQ(win.get_value(), 7);
 }
 
 TEST_CASE(latency_recorder_percentiles) {
