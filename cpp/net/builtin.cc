@@ -45,6 +45,10 @@ bool builtin_http_dispatch(Server* srv, const std::string& path,
     *body = std::move(out);
     return true;
   }
+  if (path == "/brpc_metrics" || path == "/metrics") {
+    *body = Variable::dump_prometheus();
+    return true;
+  }
   if (path == "/connections") {
     *body = "live_sockets " +
             std::to_string(g_socket_count.load(std::memory_order_relaxed)) +

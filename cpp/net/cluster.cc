@@ -344,8 +344,17 @@ void ClusterChannel::health_check() {
       delete p;
     }
   }
-  latch->wait(monotonic_time_us() + opts_.health_check_timeout_ms * 1000 +
-              1000000);
+  // Sliced wait so a concurrent destructor (stopping_) isn't stalled a full
+  // probe timeout behind blackholed nodes; probe fibers own their state via
+  // shared_ptrs and finish safely after we stop waiting.
+  const int64_t wait_deadline =
+      monotonic_time_us() + opts_.health_check_timeout_ms * 1000 + 1000000;
+  while (!stopping_.load(std::memory_order_acquire) &&
+         monotonic_time_us() < wait_deadline) {
+    if (latch->wait(monotonic_time_us() + 50000) == 0) {
+      break;
+    }
+  }
 }
 
 size_t ClusterChannel::healthy_count() {

@@ -95,6 +95,24 @@ int64_t LatencyRecorder::latency_max_us() const {
   return max_us_.load(std::memory_order_relaxed);
 }
 
+std::string LatencyRecorder::prometheus_str(const std::string& name) const {
+  const std::string metric = sanitize_metric_name(name);
+  std::string out = "# TYPE " + metric + "_latency_us summary\n";
+  static const std::pair<const char*, double> kQuantiles[] = {
+      {"0.5", 0.5}, {"0.9", 0.9}, {"0.99", 0.99}, {"0.999", 0.999}};
+  for (const auto& [label, q] : kQuantiles) {
+    out += metric + "_latency_us{quantile=\"" + label + "\"} " +
+           std::to_string(latency_percentile_us(q)) + "\n";
+  }
+  out += "# TYPE " + metric + "_qps gauge\n" + metric + "_qps " +
+         std::to_string(qps()) + "\n";
+  out += "# TYPE " + metric + "_count counter\n" + metric + "_count " +
+         std::to_string(count()) + "\n";
+  out += "# TYPE " + metric + "_latency_max_us gauge\n" + metric +
+         "_latency_max_us " + std::to_string(latency_max_us()) + "\n";
+  return out;
+}
+
 std::string LatencyRecorder::value_str() const {
   return "{\"qps\":" + std::to_string(qps()) +
          ",\"avg_us\":" + std::to_string(latency_avg_us()) +

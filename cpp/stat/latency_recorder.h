@@ -36,6 +36,8 @@ class LatencyRecorder : public Variable, public Sampled {
   int64_t count() const { return total_count_.load(std::memory_order_relaxed); }
 
   std::string value_str() const override;
+  // Quantile/qps/count series (prometheus_metrics_service parity).
+  std::string prometheus_str(const std::string& name) const override;
 
   // Called by the sampler thread once per second.
   void take_sample() override;

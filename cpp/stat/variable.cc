@@ -42,6 +42,43 @@ void Variable::hide() {
   }
 }
 
+// Prometheus metric names: [a-zA-Z_:][a-zA-Z0-9_:]*
+std::string Variable::sanitize_metric_name(const std::string& name) {
+  std::string out = name;
+  for (char& c : out) {
+    const bool ok = (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+                    (c >= '0' && c <= '9') || c == '_' || c == ':';
+    if (!ok) {
+      c = '_';
+    }
+  }
+  if (!out.empty() && out[0] >= '0' && out[0] <= '9') {
+    out = "_" + out;
+  }
+  return out;
+}
+
+std::string Variable::prometheus_str(const std::string& name) const {
+  const std::string v = value_str();
+  // Emit only plainly numeric values as gauges.
+  char* end = nullptr;
+  strtod(v.c_str(), &end);
+  if (end == v.c_str() || *end != '\0') {
+    return "";
+  }
+  const std::string metric = sanitize_metric_name(name);
+  return "# TYPE " + metric + " gauge\n" + metric + " " + v + "\n";
+}
+
+std::string Variable::dump_prometheus() {
+  std::lock_guard<std::mutex> g(vars_mu());
+  std::string out;
+  for (auto& [name, var] : vars()) {
+    out += var->prometheus_str(name);
+  }
+  return out;
+}
+
 std::vector<std::pair<std::string, std::string>> Variable::dump_exposed() {
   std::lock_guard<std::mutex> g(vars_mu());
   std::vector<std::pair<std::string, std::string>> out;

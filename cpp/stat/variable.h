@@ -13,6 +13,9 @@ class Variable {
  public:
   virtual ~Variable();
   virtual std::string value_str() const = 0;
+  // Prometheus exposition lines for this variable (may be several series,
+  // e.g. latency quantiles).  Default: one gauge when value_str is numeric.
+  virtual std::string prometheus_str(const std::string& name) const;
 
   // Registers under `name` (replaces any previous owner of the name).
   int expose(const std::string& name);
@@ -20,6 +23,11 @@ class Variable {
   const std::string& name() const { return name_; }
 
   static std::vector<std::pair<std::string, std::string>> dump_exposed();
+  // Rewrites a name into the Prometheus metric charset.
+  static std::string sanitize_metric_name(const std::string& name);
+  // Full Prometheus text-format dump (parity: builtin/
+  // prometheus_metrics_service.*, served at /brpc_metrics).
+  static std::string dump_prometheus();
 
  private:
   std::string name_;

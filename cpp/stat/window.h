@@ -18,7 +18,11 @@ namespace trpc {
 class WindowedAdder : public Variable, public Sampled {
  public:
   explicit WindowedAdder(Adder* base, int window_secs = 10)
-      : base_(base), samples_(static_cast<size_t>(std::max(window_secs, 1)) + 1, 0) {
+      : base_(base),
+        // Seed with the CURRENT total: an already-running counter's history
+        // must not appear as trailing-window activity.
+        samples_(static_cast<size_t>(std::max(window_secs, 1)) + 1,
+                 base->get_value()) {
     Sampler::instance()->add(this);
   }
   ~WindowedAdder() override {
@@ -34,7 +38,12 @@ class WindowedAdder : public Variable, public Sampled {
   }
 
   int64_t per_second() const {
-    return get_value() / static_cast<int64_t>(samples_.size() - 1);
+    std::lock_guard<std::mutex> g(mu_);
+    const size_t n = samples_.size();
+    // Divide by the span actually sampled so young windows aren't diluted.
+    const int64_t span = static_cast<int64_t>(
+        std::min(pos_ > 0 ? pos_ : 1, n - 1));
+    return (samples_[(pos_ + n - 1) % n] - samples_[pos_ % n]) / span;
   }
 
   std::string value_str() const override {

@@ -91,6 +91,9 @@ TEST_CASE(vars_and_status) {
   }
   std::string r = http_get("GET /vars HTTP/1.1\r\nHost: x\r\n\r\n");
   EXPECT(r.find("rpc_server_Echo.Echo") != std::string::npos);
+  r = http_get("GET /brpc_metrics HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("rpc_server_Echo_Echo_latency_us{quantile=\"0.5\"") != std::string::npos);
+  EXPECT(r.find("_qps ") != std::string::npos);
   r = http_get("GET /status HTTP/1.1\r\nHost: x\r\n\r\n");
   EXPECT(r.find("requests_served") != std::string::npos);
   EXPECT(r.find("Echo.Echo") != std::string::npos);
