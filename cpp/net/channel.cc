@@ -8,6 +8,7 @@
 #include "fiber/timer.h"
 #include "net/messenger.h"
 #include "net/protocol.h"
+#include "net/shm_transport.h"
 #include "net/stream.h"
 
 namespace trpc {
@@ -82,6 +83,13 @@ void tstd_process_response(InputMessage&& msg) {
   complete_locked_call(cid, cntl);
 }
 
+Channel::~Channel() {
+  SocketRef s(Socket::Address(sock_));
+  if (s) {
+    s->SetFailed(ESHUTDOWN);
+  }
+}
+
 int Channel::Init(const std::string& addr, const Options* opts) {
   fiber_init(0);
   tstd_protocol();
@@ -92,7 +100,7 @@ int Channel::Init(const std::string& addr, const Options* opts) {
 }
 
 int Channel::ensure_socket(SocketId* out) {
-  std::lock_guard<std::mutex> g(sock_mu_);
+  LockGuard<FiberMutex> g(sock_mu_);
   Socket* s = Socket::Address(sock_);
   if (s != nullptr) {
     if (!s->Failed()) {
@@ -101,6 +109,32 @@ int Channel::ensure_socket(SocketId* out) {
       return 0;
     }
     s->Dereference();
+  }
+  if (opts_.use_shm) {
+    // Handshake a ring segment over a throwaway TCP channel, then run the
+    // connection fd-less (rdma_handshake-over-TCP parity).
+    std::string name;
+    auto conn = shm_conn_create(&name);
+    if (conn != nullptr) {
+      Channel tcp;
+      Channel::Options topts;
+      topts.timeout_ms = opts_.timeout_ms;
+      if (tcp.Init(endpoint2str(ep_), &topts) == 0) {
+        Controller cntl;
+        cntl.set_timeout_ms(opts_.timeout_ms);
+        IOBuf req, resp;
+        req.append(name);
+        tcp.CallMethod(kShmConnectMethod, req, &resp, &cntl);
+        if (!cntl.Failed() && resp.equals("ok", 2) &&
+            shm_socket_create(conn, &messenger_on_readable, nullptr,
+                              &sock_) == 0) {
+          *out = sock_;
+          return 0;
+        }
+      }
+      LOG(Warning) << "shm handshake with " << endpoint2str(ep_)
+                   << " failed; falling back to tcp";
+    }
   }
   Socket::Options sopts;
   sopts.fd = -1;  // lazy connect in the write fiber

@@ -10,6 +10,7 @@
 #include <string>
 
 #include "base/endpoint.h"
+#include "fiber/sync.h"
 #include "net/controller.h"
 #include "net/socket.h"
 
@@ -20,7 +21,14 @@ class Channel {
   struct Options {
     int64_t timeout_ms = 1000;
     int max_retry = 0;  // retries on connection failure (not timeouts)
+    // Same-host shared-memory transport (net/shm_transport.h): the channel
+    // handshakes a ring segment over TCP, then calls flow through shm.
+    // Falls back to TCP transparently if the handshake fails.
+    bool use_shm = false;
   };
+
+  ~Channel();  // fails the pooled socket so its resources (fd / shm
+               // segment) are reclaimed on clean shutdown
 
   // addr: "ip:port" or "host:port".  Returns 0 on success.
   int Init(const std::string& addr, const Options* opts = nullptr);
@@ -38,7 +46,11 @@ class Channel {
 
   EndPoint ep_;
   Options opts_;
-  std::mutex sock_mu_;
+  // FiberMutex, NOT std::mutex: ensure_socket can block (shm handshake is a
+  // sync RPC) and contenders must park their fibers, never wedge worker
+  // pthreads — with a std::mutex, N concurrent first-calls deadlock the
+  // scheduler.
+  FiberMutex sock_mu_;
   SocketId sock_ = 0;
 };
 

@@ -13,6 +13,7 @@
 #include "fiber/fiber.h"
 #include "net/http_protocol.h"
 #include "net/messenger.h"
+#include "net/shm_transport.h"
 #include "net/stream.h"
 #include "net/protocol.h"
 
@@ -70,6 +71,27 @@ int Server::Start(int port) {
   tstd_protocol();  // ensure registered (first: most traffic is RPC)
   register_http_protocol();
   start_time_us_ = monotonic_time_us();
+  // Shared-memory transport handshake (net/shm_transport.h): a client sends
+  // the segment name it created; we map it and serve that connection over
+  // the rings.  Registered for every server — harmless if unused.
+  if (methods_.seek(kShmConnectMethod) == nullptr) {
+    RegisterMethod(kShmConnectMethod,
+                   [this](Controller* cntl, const IOBuf& req, IOBuf* resp,
+                          Closure done) {
+                     auto conn = shm_conn_open(req.to_string());
+                     SocketId sid = 0;
+                     if (conn == nullptr ||
+                         shm_socket_create(conn, &messenger_on_readable,
+                                           this, &sid) != 0) {
+                       cntl->SetFailed(EINVAL, "bad shm segment");
+                       done();
+                       return;
+                     }
+                     track_connection(sid);
+                     resp->append("ok");
+                     done();
+                   });
+  }
   const int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   if (fd < 0) {
     return -1;

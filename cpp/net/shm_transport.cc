@@ -1,0 +1,373 @@
+#include "net/shm_transport.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+#include "base/logging.h"
+#include "base/rand.h"
+#include "base/time.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr uint32_t kRingCap = 1 << 20;  // 1MB per direction (power of 2)
+constexpr uint64_t kShmMagic = 0x54525053484d3154ull;  // "TRPSHM1T"
+
+// SPSC byte ring; head/tail are free-running cursors (cap power of 2).
+struct Ring {
+  // Cursors on separate cache lines (cross-process false sharing would sit
+  // on the hottest path), data likewise aligned.
+  alignas(64) std::atomic<uint64_t> head;  // producer cursor
+  alignas(64) std::atomic<uint64_t> tail;  // consumer cursor
+  alignas(64) char data[kRingCap];
+
+  uint32_t readable() const {
+    return static_cast<uint32_t>(head.load(std::memory_order_acquire) -
+                                 tail.load(std::memory_order_acquire));
+  }
+  uint32_t writable() const { return kRingCap - readable(); }
+
+  uint32_t write(const char* src, uint32_t n) {
+    const uint64_t h = head.load(std::memory_order_relaxed);
+    const uint32_t space =
+        kRingCap -
+        static_cast<uint32_t>(h - tail.load(std::memory_order_acquire));
+    n = std::min(n, space);
+    const uint32_t off = static_cast<uint32_t>(h) & (kRingCap - 1);
+    const uint32_t first = std::min(n, kRingCap - off);
+    memcpy(data + off, src, first);
+    memcpy(data, src + first, n - first);
+    head.store(h + n, std::memory_order_release);
+    return n;
+  }
+
+  uint32_t read(char* dst, uint32_t n) {
+    const uint64_t t = tail.load(std::memory_order_relaxed);
+    const uint32_t avail =
+        static_cast<uint32_t>(head.load(std::memory_order_acquire) - t);
+    n = std::min(n, avail);
+    const uint32_t off = static_cast<uint32_t>(t) & (kRingCap - 1);
+    const uint32_t first = std::min(n, kRingCap - off);
+    memcpy(dst, data + off, first);
+    memcpy(dst + first, data, n - first);
+    tail.store(t + n, std::memory_order_release);
+    return n;
+  }
+};
+
+struct Segment {
+  uint64_t magic;
+  Ring c2s;
+  Ring s2c;
+};
+
+}  // namespace
+
+void shm_conn_release_name(const std::string& name);
+
+struct ShmConn {
+  Segment* seg = nullptr;
+  std::string name;
+  bool is_client = false;  // client writes c2s, reads s2c
+  bool creator = false;
+
+  Ring& tx() { return is_client ? seg->c2s : seg->s2c; }
+  Ring& rx() { return is_client ? seg->s2c : seg->c2s; }
+
+  ~ShmConn() {
+    if (seg != nullptr) {
+      munmap(seg, sizeof(Segment));
+    }
+    if (creator) {
+      shm_unlink(name.c_str());
+    } else {
+      shm_conn_release_name(name);
+    }
+  }
+};
+
+namespace {
+
+// ---- poller (the reference's polling completion mode) -------------------
+
+struct PolledRing {
+  std::weak_ptr<ShmConn> conn;
+  SocketId socket = 0;
+  uint64_t last_rx_head = 0;
+  uint64_t last_tx_tail = 0;
+  int64_t created_us = 0;
+};
+
+class ShmPoller {
+ public:
+  static ShmPoller* instance() {
+    // Deliberately leaked (detached thread outlives static destruction).
+    static ShmPoller* p = new ShmPoller();
+    return p;
+  }
+
+  void add(std::shared_ptr<ShmConn> conn, SocketId socket) {
+    std::lock_guard<std::mutex> g(mu_);
+    rings_.push_back(PolledRing{conn, socket, 0, 0, monotonic_time_us()});
+  }
+
+ private:
+  ShmPoller() {
+    pthread_t tid;
+    pthread_create(
+        &tid, nullptr,
+        [](void* self) -> void* {
+          static_cast<ShmPoller*>(self)->run();
+          return nullptr;
+        },
+        this);
+    pthread_detach(tid);
+  }
+
+  void run() {
+    int idle_spins = 0;
+    while (true) {
+      bool any = false;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        for (size_t i = 0; i < rings_.size();) {
+          PolledRing& pr = rings_[i];
+          std::shared_ptr<ShmConn> conn = pr.conn.lock();
+          if (conn == nullptr) {  // socket torn down; drop the entry
+            rings_[i] = rings_.back();
+            rings_.pop_back();
+            continue;
+          }
+          const uint64_t rx_head =
+              conn->rx().head.load(std::memory_order_acquire);
+          // A connection whose peer NEVER wrote (failed/abandoned
+          // handshake) is reaped so the mapping can't leak server-side.
+          if (rx_head == 0 &&
+              monotonic_time_us() - pr.created_us > 30 * 1000 * 1000) {
+            SocketRef dead(Socket::Address(pr.socket));
+            if (dead) {
+              dead->SetFailed(ETIMEDOUT);
+            }
+            rings_[i] = rings_.back();
+            rings_.pop_back();
+            continue;
+          }
+          if (rx_head != pr.last_rx_head) {
+            pr.last_rx_head = rx_head;
+            any = true;
+            SocketRef s(Socket::Address(pr.socket));
+            if (s) {
+              s->on_input_event();
+            }
+          }
+          const uint64_t tx_tail =
+              conn->tx().tail.load(std::memory_order_acquire);
+          if (tx_tail != pr.last_tx_tail) {
+            pr.last_tx_tail = tx_tail;
+            any = true;
+            SocketRef s(Socket::Address(pr.socket));
+            if (s) {
+              s->on_output_event();  // peer consumed → writable edge
+            }
+          }
+          ++i;
+        }
+      }
+      if (any) {
+        idle_spins = 0;
+        continue;  // hot: stay on the rings
+      }
+      if (++idle_spins < 1000) {
+        sched_yield();
+      } else {
+        usleep(100);  // adaptive backoff when quiet
+      }
+    }
+  }
+
+  std::mutex mu_;
+  std::vector<PolledRing> rings_;
+};
+
+// ---- the Transport ------------------------------------------------------
+
+class ShmRingTransport final : public Transport {
+ public:
+  ssize_t cut_from_iobuf(Socket* s, IOBuf* from) override {
+    auto* conn = static_cast<ShmConn*>(s->transport_ctx);
+    if (conn == nullptr) {
+      errno = ENOTCONN;
+      return -1;
+    }
+    Ring& tx = conn->tx();
+    size_t total = 0;
+    while (!from->empty()) {
+      const IOBuf::BlockRef& ref = from->ref_at(0);
+      const uint32_t wrote =
+          tx.write(ref.block->data + ref.offset, ref.length);
+      if (wrote == 0) {
+        break;  // ring full
+      }
+      from->pop_front(wrote);
+      total += wrote;
+    }
+    return static_cast<ssize_t>(total);  // 0 = EAGAIN-equivalent
+  }
+
+  ssize_t append_to_iobuf(Socket* s, IOBuf* to, size_t max) override {
+    auto* conn = static_cast<ShmConn*>(s->transport_ctx);
+    if (conn == nullptr) {
+      errno = ENOTCONN;
+      return -1;
+    }
+    Ring& rx = conn->rx();
+    char tmp[16 * 1024];
+    size_t total = 0;
+    while (total < max) {
+      const uint32_t got = rx.read(
+          tmp, static_cast<uint32_t>(std::min(sizeof(tmp), max - total)));
+      if (got == 0) {
+        break;
+      }
+      to->append(tmp, got);
+      total += got;
+    }
+    return static_cast<ssize_t>(total);  // 0 = drained
+  }
+
+  int connect(Socket*) override { return 0; }  // established at handshake
+  const char* name() const override { return "shm_ring"; }
+};
+
+ShmRingTransport* shm_transport() {
+  static ShmRingTransport t;
+  return &t;
+}
+
+Segment* map_segment(int fd) {
+  void* mem = mmap(nullptr, sizeof(Segment), PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  return mem == MAP_FAILED ? nullptr : static_cast<Segment*>(mem);
+}
+
+}  // namespace
+
+std::shared_ptr<ShmConn> shm_conn_create(std::string* name_out) {
+  char name[64];
+  snprintf(name, sizeof(name), "/trpc_%d_%llx", getpid(),
+           static_cast<unsigned long long>(fast_rand()));
+  const int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) {
+    return nullptr;
+  }
+  if (ftruncate(fd, sizeof(Segment)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  Segment* seg = map_segment(fd);
+  if (seg == nullptr) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  memset(static_cast<void*>(seg), 0, sizeof(Segment));
+  seg->magic = kShmMagic;
+  auto conn = std::make_shared<ShmConn>();
+  conn->seg = seg;
+  conn->name = name;
+  conn->is_client = true;
+  conn->creator = true;
+  *name_out = name;
+  return conn;
+}
+
+namespace {
+// One server-side consumer per segment, ever: re-opening a name would put
+// two readers on one SPSC ring.
+std::mutex& open_names_mu() {
+  static std::mutex* mu = new std::mutex();
+  return *mu;
+}
+std::vector<std::string>& open_names() {
+  static auto* v = new std::vector<std::string>();
+  return *v;
+}
+}  // namespace
+
+void shm_conn_release_name(const std::string& name) {
+  std::lock_guard<std::mutex> g(open_names_mu());
+  auto& v = open_names();
+  v.erase(std::remove(v.begin(), v.end(), name), v.end());
+}
+
+std::shared_ptr<ShmConn> shm_conn_open(const std::string& name) {
+  // Only names our handshake mints are acceptable (the peer is untrusted
+  // input at this boundary).
+  if (name.empty() || name[0] != '/' || name.rfind("/trpc_", 0) != 0 ||
+      name.size() > 60) {
+    return nullptr;
+  }
+  {
+    std::lock_guard<std::mutex> g(open_names_mu());
+    auto& v = open_names();
+    if (std::find(v.begin(), v.end(), name) != v.end()) {
+      return nullptr;  // duplicate consumer attempt
+    }
+    v.push_back(name);
+  }
+  const int fd = shm_open(name.c_str(), O_RDWR, 0600);
+  if (fd < 0) {
+    shm_conn_release_name(name);
+    return nullptr;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size != sizeof(Segment)) {
+    close(fd);
+    shm_conn_release_name(name);
+    return nullptr;
+  }
+  Segment* seg = map_segment(fd);
+  if (seg == nullptr || seg->magic != kShmMagic) {
+    if (seg != nullptr) {
+      munmap(seg, sizeof(Segment));
+    }
+    shm_conn_release_name(name);
+    return nullptr;
+  }
+  auto conn = std::make_shared<ShmConn>();
+  conn->seg = seg;
+  conn->name = name;
+  conn->is_client = false;
+  return conn;
+}
+
+int shm_socket_create(std::shared_ptr<ShmConn> conn,
+                      void (*on_readable)(SocketId, void*), void* user_data,
+                      SocketId* out) {
+  Socket::Options opts;
+  opts.fd = -1;
+  opts.mode = SocketMode::kShm;  // fd-less: no epoll registration
+  opts.on_readable = on_readable;
+  opts.user_data = user_data;
+  opts.transport = shm_transport();
+  opts.transport_ctx_holder = conn;  // keeps the mapping alive w/ the socket
+  if (Socket::Create(opts, out) != 0) {
+    return -1;
+  }
+  ShmPoller::instance()->add(conn, *out);
+  return 0;
+}
+
+}  // namespace trpc

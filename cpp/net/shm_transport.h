@@ -1,0 +1,48 @@
+// Shared-memory ring transport — same-host RPC without the kernel socket
+// path.
+//
+// Parity: the fork's UBRing transport (/root/reference/src/brpc/ubshm/:
+// ring buffers in POSIX shm with head/tail control words, ub_ring.h:165;
+// poller registration mirroring epoll, ub_endpoint.h:93-120; selected via
+// SocketMode::UBRING).  Re-designed condensed:
+//
+// - A connection is one shm segment holding two SPSC byte rings (c2s, s2c)
+//   with atomic head/tail cursors — cross-process visible, lock-free.
+// - Establishment mirrors rdma_handshake-over-TCP: the client creates and
+//   maps the segment, then registers it with the server via a normal RPC
+//   ("__shm.Connect") carrying the segment name; each side then runs a
+//   dedicated fd-less Socket whose Transport is the ring pair.  No
+//   transport rebinding on live sockets — no torn frames.
+// - Readiness is a polling thread (the reference's rdma_use_polling mode,
+//   input_messenger.cpp:300-306): it watches all registered rings and
+//   injects on_input_event / on_output_event exactly like the epoll
+//   dispatcher, with adaptive backoff when idle.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+
+#include "net/socket.h"
+
+namespace trpc {
+
+struct ShmConn;  // mapped segment + direction binding
+
+// Creates a new segment (1MB rings each way) and maps it as the CLIENT side.
+// Returns nullptr on failure; *name_out is the segment name to send to the
+// server.
+std::shared_ptr<ShmConn> shm_conn_create(std::string* name_out);
+// Maps an existing segment as the SERVER side.
+std::shared_ptr<ShmConn> shm_conn_open(const std::string& name);
+
+// Builds the fd-less socket bound to `conn` and registers it with the
+// poller.  on_readable/user_data as for Socket::Create.
+int shm_socket_create(std::shared_ptr<ShmConn> conn,
+                      void (*on_readable)(SocketId, void*), void* user_data,
+                      SocketId* out);
+
+// The handshake method name Servers auto-register.
+inline const char* kShmConnectMethod = "__shm.Connect";
+
+}  // namespace trpc

@@ -52,10 +52,15 @@ int Socket::Create(const Options& opts, SocketId* out) {
 
 void Socket::reset_for_reuse(const Options& opts) {
   fd_ = opts.fd;
+  mode_ = opts.mode;
   remote_ = opts.remote;
-  transport_ = tcp_transport();
+  transport_ = opts.transport != nullptr ? opts.transport : tcp_transport();
+  transport_ctx_holder_ = opts.transport_ctx_holder;
+  transport_ctx = transport_ctx_holder_.get();
   failed_.store(false, std::memory_order_relaxed);
-  connected_.store(opts.fd >= 0, std::memory_order_relaxed);
+  // fd-less transports (shm/ICI) are born connected.
+  connected_.store(opts.fd >= 0 || opts.transport != nullptr,
+                   std::memory_order_relaxed);
   nevent_.store(0, std::memory_order_relaxed);
   on_readable_ = opts.on_readable;
   ctx_ = opts.ctx;
@@ -116,6 +121,8 @@ void Socket::Dereference() {
     }
     drop_write_queue();
     read_buf_.clear();
+    transport_ctx = nullptr;
+    transport_ctx_holder_.reset();  // releases e.g. the shm mapping
     g_socket_count.fetch_sub(1, std::memory_order_relaxed);
     SocketPool::instance()->release(slot_.load(std::memory_order_relaxed));
   }
@@ -301,7 +308,9 @@ void Socket::keep_write() {
           drop_write_queue();
           return;
         }
-        wait_writable(snap, -1);
+        // Sliced wait: fd-less transports have no HUP edge, so a dead peer
+        // is only noticed through Failed() re-checks.
+        wait_writable(snap, monotonic_time_us() + 1000000);
       }
     }
   }

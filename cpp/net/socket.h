@@ -11,6 +11,7 @@
 
 #include <atomic>
 #include <functional>
+#include <memory>
 
 #include "base/endpoint.h"
 #include "base/iobuf.h"
@@ -34,6 +35,11 @@ class Socket {
     // Owner context (Server*/Channel*); set BEFORE the fd is registered
     // with the dispatcher so the first event can never observe null.
     void* user_data = nullptr;
+    // Non-TCP transports (shm rings, ICI): the transport instance and its
+    // per-connection context.  The holder keeps the context (e.g. a mapped
+    // segment) alive exactly as long as the socket generation.
+    Transport* transport = nullptr;
+    std::shared_ptr<void> transport_ctx_holder;
   };
 
   // Creates a socket with one owner reference; registers with the
@@ -62,6 +68,7 @@ class Socket {
   int Write(IOBuf&& data);
 
   int fd() const { return fd_; }
+  SocketMode mode() const { return mode_; }
   SocketId id() const;
   const EndPoint& remote() const { return remote_; }
   Transport* transport() const { return transport_; }
@@ -69,6 +76,7 @@ class Socket {
   // Protocol index pinned after first successful parse (-1 = unknown).
   int pinned_protocol = -1;
   void* user_data = nullptr;  // Server*/Channel* context, set by owner
+  void* transport_ctx = nullptr;  // per-connection transport state
 
   // -- dispatcher integration (internal) -------------------------------
   void on_input_event();    // readable edge (any thread)
@@ -95,6 +103,7 @@ class Socket {
   std::atomic<uint64_t> ref_ver_{0};  // version<<32 | refcount
   std::atomic<uint32_t> slot_{0};
   int fd_ = -1;
+  SocketMode mode_ = SocketMode::kTcp;
   EndPoint remote_;
   Transport* transport_ = nullptr;
   std::atomic<bool> failed_{false};
@@ -103,6 +112,7 @@ class Socket {
   void (*on_readable_)(SocketId, void*) = nullptr;
   void* ctx_ = nullptr;
   IOBuf read_buf_;
+  std::shared_ptr<void> transport_ctx_holder_;
   Event wr_ev_;  // writable-edge counter
   // MPSC write queue.
   std::atomic<WriteNode*> wq_head_{nullptr};

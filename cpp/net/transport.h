@@ -17,7 +17,8 @@ class Socket;
 
 enum class SocketMode : int {
   kTcp = 0,
-  kIci = 1,  // device DMA rings; see net/ici_transport.*
+  kIci = 1,  // device DMA rings (the north-star seam)
+  kShm = 2,  // same-host shared-memory rings (net/shm_transport.*)
 };
 
 class Transport {

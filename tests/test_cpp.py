@@ -60,3 +60,7 @@ def test_combo():
 
 def test_http():
     _run("test_http")
+
+
+def test_shm():
+    _run("test_shm", timeout=180)
