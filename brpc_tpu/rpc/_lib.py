@@ -110,7 +110,14 @@ def load_library() -> ctypes.CDLL:
             lib.trpc_server_stop.argtypes = [ctypes.c_void_p]
             lib.trpc_channel_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
             lib.trpc_channel_create.restype = ctypes.c_void_p
+            lib.trpc_channel_create_shm.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+            ]
+            lib.trpc_channel_create_shm.restype = ctypes.c_void_p
             lib.trpc_channel_destroy.argtypes = [ctypes.c_void_p]
+            lib.trpc_channel_transport.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ]
             lib.trpc_channel_call.argtypes = [
                 ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
                 ctypes.c_size_t, ctypes.c_void_p, ctypes.c_int64,

@@ -25,17 +25,30 @@ def _call(lib, fn, ptr, method: str, request: bytes, extra) -> bytes:
 
 
 class Channel:
-    """Client stub for one server (parity: cpp/net/channel.h)."""
+    """Client stub for one server (parity: cpp/net/channel.h).
 
-    def __init__(self, addr: str, timeout_ms: int = 1000):
+    use_shm routes same-host calls over shared-memory rings (TCP-handshaked;
+    transparent TCP fallback)."""
+
+    def __init__(self, addr: str, timeout_ms: int = 1000,
+                 use_shm: bool = False):
         self._lib = load_library()
-        self._ptr = self._lib.trpc_channel_create(addr.encode(), timeout_ms)
+        create = (self._lib.trpc_channel_create_shm if use_shm
+                  else self._lib.trpc_channel_create)
+        self._ptr = create(addr.encode(), timeout_ms)
         if not self._ptr:
             raise ValueError(f"bad address: {addr!r}")
 
     def call(self, method: str, request: bytes, timeout_ms: int = 0) -> bytes:
         return _call(self._lib, self._lib.trpc_channel_call, self._ptr,
                      method, request, timeout_ms)
+
+    @property
+    def transport(self) -> str:
+        """Live transport name ("tcp", "shm_ring"); "" before first call."""
+        out = ctypes.create_string_buffer(32)
+        self._lib.trpc_channel_transport(self._ptr, out, 32)
+        return out.value.decode()
 
     def close(self) -> None:
         ptr, self._ptr = self._ptr, None

@@ -85,15 +85,35 @@ void trpc_server_stop(void* srv) { static_cast<Server*>(srv)->Stop(); }
 
 // ---- single-server channel ---------------------------------------------
 
-void* trpc_channel_create(const char* addr, int64_t timeout_ms) {
+namespace {
+void* create_channel(const char* addr, int64_t timeout_ms, bool use_shm) {
   auto* ch = new Channel();
   Channel::Options opts;
   opts.timeout_ms = timeout_ms;
+  opts.use_shm = use_shm;
   if (ch->Init(addr, &opts) != 0) {
     delete ch;
     return nullptr;
   }
   return ch;
+}
+}  // namespace
+
+void* trpc_channel_create(const char* addr, int64_t timeout_ms) {
+  return create_channel(addr, timeout_ms, false);
+}
+
+// Same-host shared-memory variant (falls back to TCP if the handshake
+// fails; see net/shm_transport.h).
+void* trpc_channel_create_shm(const char* addr, int64_t timeout_ms) {
+  return create_channel(addr, timeout_ms, true);
+}
+
+// Copies the live transport name ("tcp", "shm_ring", "" if unconnected).
+void trpc_channel_transport(void* ch, char* out, size_t out_len) {
+  const std::string name = static_cast<Channel*>(ch)->transport_name();
+  strncpy(out, name.c_str(), out_len - 1);
+  out[out_len - 1] = '\0';
 }
 
 void trpc_channel_destroy(void* ch) { delete static_cast<Channel*>(ch); }

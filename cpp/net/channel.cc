@@ -99,6 +99,11 @@ int Channel::Init(const std::string& addr, const Options* opts) {
   return hostname2endpoint(addr.c_str(), &ep_);
 }
 
+std::string Channel::transport_name() {
+  SocketRef s(Socket::Address(sock_));
+  return s ? s->transport()->name() : "";
+}
+
 int Channel::ensure_socket(SocketId* out) {
   LockGuard<FiberMutex> g(sock_mu_);
   Socket* s = Socket::Address(sock_);

@@ -40,6 +40,9 @@ class Channel {
                   IOBuf* response, Controller* cntl, Closure done = nullptr);
 
   const EndPoint& endpoint() const { return ep_; }
+  // Name of the live connection's transport ("tcp", "shm_ring"), or "" if
+  // no socket has been established yet.
+  std::string transport_name();
 
  private:
   int ensure_socket(SocketId* out);
