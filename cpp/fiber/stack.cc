@@ -8,6 +8,26 @@
 #include "base/logging.h"
 #include "fiber/context.h"
 
+// ASan's fiber support (__sanitizer_start_switch_fiber in scheduler.cc)
+// tags fiber stacks in shadow memory; munmap does NOT clear shadow, so a
+// later unrelated mmap reusing the range would inherit stale stack poison
+// and trip false positives.  Unpoison before every stack unmap.
+#if defined(__SANITIZE_ADDRESS__)
+#define TRPC_HAS_ASAN 1
+#elif defined(__has_feature)
+#if __has_feature(address_sanitizer)
+#define TRPC_HAS_ASAN 1
+#endif
+#endif
+#ifdef TRPC_HAS_ASAN
+extern "C" void __asan_unpoison_memory_region(void const volatile*, size_t);
+#define TRPC_UNPOISON_STACK(p, n) __asan_unpoison_memory_region(p, n)
+#else
+#define TRPC_UNPOISON_STACK(p, n) \
+  do {                            \
+  } while (0)
+#endif
+
 namespace trpc {
 
 namespace {
@@ -24,6 +44,7 @@ struct TlsStackGuard {
   ~TlsStackGuard() {
     if (slot != nullptr && *slot != nullptr) {
       for (StackMem& s : (*slot)->stacks) {
+        TRPC_UNPOISON_STACK(s.base, s.size);
         munmap(s.base, s.size);
       }
       delete *slot;
@@ -62,6 +83,7 @@ StackMem allocate_stack(size_t size) {
     if (s.size == size) {
       return s;
     }
+    TRPC_UNPOISON_STACK(s.base, s.size);
     munmap(s.base, s.size);
   }
   const size_t page = static_cast<size_t>(sysconf(_SC_PAGESIZE));
@@ -79,6 +101,7 @@ void release_stack(StackMem s) {
     cache->stacks.push_back(s);
     return;
   }
+  TRPC_UNPOISON_STACK(s.base, s.size);
   munmap(s.base, s.size);
 }
 

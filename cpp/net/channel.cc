@@ -186,9 +186,10 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
   }
   cntl->call().socket_id = sid;
 
-  if (cntl->timeout_ms() > 0) {
+  const int64_t eff_timeout_ms = cntl->timeout_ms_or(opts_.timeout_ms);
+  if (eff_timeout_ms > 0) {
     cntl->call().timeout_timer = TimerThread::instance()->schedule(
-        cntl->call().start_us + cntl->timeout_ms() * 1000, timeout_cb,
+        cntl->call().start_us + eff_timeout_ms * 1000, timeout_cb,
         reinterpret_cast<void*>(cid));
   }
 

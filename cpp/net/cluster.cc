@@ -417,6 +417,9 @@ struct HedgeCtx {
   // An attempt's cntls[i]/responses[i] may only be read after done[i]
   // (release-stored when its fiber finished writing them).
   std::atomic<bool> done[2] = {{false}, {false}};
+  // False when the attempt never ran (fiber spawn failed): its synthetic
+  // EAGAIN must not shadow a real error from the other attempt.
+  bool spawned[2] = {true, true};
   std::atomic<int> winner{-1};   // first successful attempt index
   std::atomic<int> failures{0};
   std::atomic<int> launched{1};
@@ -492,7 +495,10 @@ void ClusterChannel::call_hedged(std::shared_ptr<Cluster> cluster,
     }
   }
   // Reset per-call state on the caller's controller, preserving the
-  // attachment (mirrors the retry path's contract).
+  // attachment (mirrors the retry path's contract).  The caller's own
+  // timeout takes precedence over the channel default (as in the
+  // reference, where the controller wins over ChannelOptions).
+  const int64_t eff_timeout_ms = cntl->timeout_ms_or(opts_.timeout_ms);
   IOBuf attachment = cntl->request_attachment();
   cntl->Reset();
   cntl->request_attachment() = attachment;
@@ -506,10 +512,17 @@ void ClusterChannel::call_hedged(std::shared_ptr<Cluster> cluster,
   auto arm = [&](int slot, size_t node_idx) {
     ctx->channels[slot] = cluster->channels[node_idx];
     ctx->node_idx[slot] = node_idx;
-    ctx->cntls[slot].set_timeout_ms(opts_.timeout_ms);
+    ctx->cntls[slot].set_timeout_ms(eff_timeout_ms);
     ctx->cntls[slot].request_attachment() = ctx->attachment;
-    fiber_start(nullptr, hedge_attempt_fiber,
-                new HedgeFiberArg{ctx, slot}, 0);
+    auto* arg = new HedgeFiberArg{ctx, slot};
+    if (fiber_start(nullptr, hedge_attempt_fiber, arg, 0) != 0) {
+      // A failed spawn must still settle the slot, or wait_settled(-1)
+      // blocks forever (mirrors run_fanout's spawn-failure path).
+      delete arg;
+      ctx->spawned[slot] = false;
+      ctx->cntls[slot].SetFailed(EAGAIN, "fiber_start failed");
+      ctx->on_attempt_done(slot);
+    }
   };
 
   const size_t primary = lb_->select(healthy, cluster->nodes, hash_key, 0);
@@ -545,7 +558,14 @@ void ClusterChannel::call_hedged(std::shared_ptr<Cluster> cluster,
     feed_breaker(cluster->nodes[ctx->node_idx[i]], !ctx->cntls[i].Failed());
   }
   if (w < 0) {
-    const int chosen = ctx->done[1].load(std::memory_order_acquire) ? 1 : 0;
+    // Prefer an attempt that actually ran; among those, the backup's
+    // (fresher) error, matching the reference's last-error reporting.
+    int chosen = ctx->done[1].load(std::memory_order_acquire) ? 1 : 0;
+    if (!ctx->spawned[chosen] &&
+        ctx->done[1 - chosen].load(std::memory_order_acquire) &&
+        ctx->spawned[1 - chosen]) {
+      chosen = 1 - chosen;
+    }
     cntl->SetFailed(ctx->cntls[chosen].error_code(),
                     ctx->cntls[chosen].error_text());
   } else {
@@ -565,15 +585,21 @@ void ClusterChannel::CallMethod(const std::string& method,
     auto* call = new AsyncCall{this,     method, request, response,
                                cntl,     {},     hash_key};
     call->done = std::move(done);
-    fiber_start(
-        nullptr,
-        [](void* arg) {
-          std::unique_ptr<AsyncCall> c(static_cast<AsyncCall*>(arg));
-          c->ch->CallMethod(c->method, c->request, c->response, c->cntl,
-                            nullptr, c->hash_key);
-          c->done();
-        },
-        call, 0);
+    if (fiber_start(
+            nullptr,
+            [](void* arg) {
+              std::unique_ptr<AsyncCall> c(static_cast<AsyncCall*>(arg));
+              c->ch->CallMethod(c->method, c->request, c->response, c->cntl,
+                                nullptr, c->hash_key);
+              c->done();
+            },
+            call, 0) != 0) {
+      // Spawn failure must still complete the call (fiber_start does not
+      // take ownership of arg on failure).
+      std::unique_ptr<AsyncCall> c(call);
+      cntl->SetFailed(EAGAIN, "fiber_start failed");
+      c->done();
+    }
     return;
   }
   std::shared_ptr<Cluster> cluster;
@@ -597,7 +623,10 @@ void ClusterChannel::CallMethod(const std::string& method,
   }
   // Retry loop (sync under the hood; async wraps the final completion).
   // Parity: retries pick a different node and quarantined nodes are skipped
-  // (circuit_breaker + cluster_recover semantics condensed).
+  // (circuit_breaker + cluster_recover semantics condensed).  Captured
+  // before the first Reset: the caller's own timeout outranks the channel
+  // default on every attempt.
+  const int64_t eff_timeout_ms = cntl->timeout_ms_or(opts_.timeout_ms);
   const int attempts = 1 + opts_.max_retry;
   std::vector<size_t> tried;
   for (int attempt = 0; attempt < attempts; ++attempt) {
@@ -630,11 +659,12 @@ void ClusterChannel::CallMethod(const std::string& method,
     ServerNode& node = cluster->nodes[idx];
 
     // Reset per-attempt state but preserve the caller's attachment (shared
-    // zero-copy, so re-attaching per retry is free).
+    // zero-copy, so re-attaching per retry is free) and the caller's own
+    // timeout, which takes precedence over the channel default.
     IOBuf attachment = cntl->request_attachment();
     cntl->Reset();
     cntl->request_attachment() = std::move(attachment);
-    cntl->set_timeout_ms(opts_.timeout_ms);
+    cntl->set_timeout_ms(eff_timeout_ms);
     const bool last_attempt = attempt == attempts - 1;
     cluster->channels[idx]->CallMethod(method, request, response, cntl);
     if (!cntl->Failed()) {

@@ -74,8 +74,11 @@ void fanout_fiber(void* p) {
 void run_fanout(const std::shared_ptr<FanoutCtx>& ctx) {
   const int n = static_cast<int>(ctx->subs.size());
   for (int i = 0; i < n; ++i) {
-    if (fiber_start(nullptr, fanout_fiber, new FanoutArg{ctx, i}, 0) != 0) {
-      // Spawn failure must not hang the join.
+    auto* arg = new FanoutArg{ctx, i};
+    if (fiber_start(nullptr, fanout_fiber, arg, 0) != 0) {
+      // Spawn failure must not hang the join (fiber_start does not take
+      // ownership of arg on failure).
+      delete arg;
       ctx->cntls[i].SetFailed(EAGAIN, "fiber_start failed");
       ctx->latch.signal();
     }

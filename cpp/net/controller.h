@@ -35,8 +35,17 @@ class Controller {
   }
 
   // -- knobs (client) --------------------------------------------------
+  // timeout_ms is kUnsetTimeoutMs until the caller sets it; channels then
+  // substitute their own Options::timeout_ms. An explicit 0 disables the
+  // timer. A reachable legal value (like 1000) must NOT be the sentinel or
+  // callers could never ask for it explicitly.
+  static constexpr int64_t kUnsetTimeoutMs = -1;
   void set_timeout_ms(int64_t ms) { timeout_ms_ = ms; }
   int64_t timeout_ms() const { return timeout_ms_; }
+  // The caller's timeout if set, else the channel's default.
+  int64_t timeout_ms_or(int64_t dflt) const {
+    return timeout_ms_ != kUnsetTimeoutMs ? timeout_ms_ : dflt;
+  }
 
   // Payload carried outside the main body (parity: attachment in
   // baidu_std; rides the same frame after the response body).
@@ -69,7 +78,7 @@ class Controller {
   int error_code_ = 0;
   std::string error_text_;
   std::string method_;
-  int64_t timeout_ms_ = 1000;
+  int64_t timeout_ms_ = kUnsetTimeoutMs;
   int64_t latency_us_ = 0;
   IOBuf request_attachment_;
   IOBuf response_attachment_;

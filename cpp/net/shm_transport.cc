@@ -3,6 +3,7 @@
 #include <errno.h>
 #include <fcntl.h>
 #include <pthread.h>
+#include <signal.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
@@ -22,7 +23,7 @@ namespace trpc {
 namespace {
 
 constexpr uint32_t kRingCap = 1 << 20;  // 1MB per direction (power of 2)
-constexpr uint64_t kShmMagic = 0x54525053484d3154ull;  // "TRPSHM1T"
+constexpr uint64_t kShmMagic = 0x54525053484d3254ull;  // "TRPSHM2T"
 
 // SPSC byte ring; head/tail are free-running cursors (cap power of 2).
 struct Ring {
@@ -68,6 +69,17 @@ struct Ring {
 
 struct Segment {
   uint64_t magic;
+  // Liveness: each side publishes its pid at map time and its poller
+  // bumps a heartbeat word ~1/s. A peer is reaped (crash cleanup) when
+  // its process is verifiably gone (ESRCH) OR its heartbeat stalls long
+  // enough — the heartbeat covers pid recycling and EPERM ambiguity,
+  // where kill(pid, 0) cannot prove liveness. A healthy idle peer is
+  // never timed out (ubshm/ keeps segments alive with a shm manager +
+  // timers; this is the single-host equivalent).
+  std::atomic<int32_t> client_pid;
+  std::atomic<int32_t> server_pid;
+  std::atomic<uint64_t> client_beat;
+  std::atomic<uint64_t> server_beat;
   Ring c2s;
   Ring s2c;
 };
@@ -84,14 +96,30 @@ struct ShmConn {
 
   Ring& tx() { return is_client ? seg->c2s : seg->s2c; }
   Ring& rx() { return is_client ? seg->s2c : seg->c2s; }
+  int32_t peer_pid() const {
+    return (is_client ? seg->server_pid : seg->client_pid)
+        .load(std::memory_order_acquire);
+  }
+  uint64_t peer_beat() const {
+    return (is_client ? seg->server_beat : seg->client_beat)
+        .load(std::memory_order_acquire);
+  }
+  void bump_self_beat() {
+    (is_client ? seg->client_beat : seg->server_beat)
+        .fetch_add(1, std::memory_order_acq_rel);
+  }
+  // Reaping a crashed peer promotes this side to cleanup duty even if it
+  // was not the creator: the creator is gone and can never unlink.
+  bool unlink_on_close = false;
 
   ~ShmConn() {
     if (seg != nullptr) {
       munmap(seg, sizeof(Segment));
     }
-    if (creator) {
+    if (creator || unlink_on_close) {
       shm_unlink(name.c_str());
-    } else {
+    }
+    if (!creator) {
       shm_conn_release_name(name);
     }
   }
@@ -107,6 +135,9 @@ struct PolledRing {
   uint64_t last_rx_head = 0;
   uint64_t last_tx_tail = 0;
   int64_t created_us = 0;
+  int64_t last_liveness_us = 0;
+  uint64_t last_peer_beat = 0;
+  int64_t peer_beat_changed_us = 0;
 };
 
 class ShmPoller {
@@ -140,6 +171,8 @@ class ShmPoller {
     while (true) {
       bool any = false;
       {
+        // One clock read per pass (the loop below is the hot spin path).
+        const int64_t now_us = monotonic_time_us();
         std::lock_guard<std::mutex> g(mu_);
         for (size_t i = 0; i < rings_.size();) {
           PolledRing& pr = rings_[i];
@@ -151,17 +184,45 @@ class ShmPoller {
           }
           const uint64_t rx_head =
               conn->rx().head.load(std::memory_order_acquire);
-          // A connection whose peer NEVER wrote (failed/abandoned
-          // handshake) is reaped so the mapping can't leak server-side.
-          if (rx_head == 0 &&
-              monotonic_time_us() - pr.created_us > 30 * 1000 * 1000) {
-            SocketRef dead(Socket::Address(pr.socket));
-            if (dead) {
-              dead->SetFailed(ETIMEDOUT);
+          // Liveness, rate-limited to ~1/s per ring (kill() is a syscall
+          // and beats are cross-core cache traffic). Reap when:
+          //  - the peer never published a pid (hostile/foreign segment
+          //    content; our own handshake always publishes pre-poll), or
+          //  - the peer pid verifiably exited (ESRCH), or
+          //  - the peer heartbeat stalled >30s (covers pid recycling and
+          //    kill() EPERM, where the pid alone proves nothing).
+          if (now_us - pr.last_liveness_us > 1000 * 1000) {
+            pr.last_liveness_us = now_us;
+            conn->bump_self_beat();
+            const uint64_t beat = conn->peer_beat();
+            if (beat != pr.last_peer_beat || pr.peer_beat_changed_us == 0) {
+              pr.last_peer_beat = beat;
+              pr.peer_beat_changed_us = now_us;
             }
-            rings_[i] = rings_.back();
-            rings_.pop_back();
-            continue;
+            const int32_t peer = conn->peer_pid();
+            const bool no_pid =
+                peer == 0 && now_us - pr.created_us > 30 * 1000 * 1000;
+            const bool dead_pid =
+                peer != 0 && kill(static_cast<pid_t>(peer), 0) != 0 &&
+                errno == ESRCH;
+            const bool stalled =
+                now_us - pr.peer_beat_changed_us > 30 * 1000 * 1000;
+            if (no_pid || dead_pid || stalled) {
+              LOG(Warning) << "shm peer lost (" << conn->name << ", pid "
+                           << peer << ", "
+                           << (dead_pid ? "exited"
+                                        : (no_pid ? "never published"
+                                                  : "heartbeat stalled"))
+                           << "); reaping segment";
+              conn->unlink_on_close = true;  // peer can't clean up; we do
+              SocketRef dead(Socket::Address(pr.socket));
+              if (dead) {
+                dead->SetFailed(no_pid ? ETIMEDOUT : ECONNRESET);
+              }
+              rings_[i] = rings_.back();
+              rings_.pop_back();
+              continue;
+            }
           }
           if (rx_head != pr.last_rx_head) {
             pr.last_rx_head = rx_head;
@@ -284,6 +345,8 @@ std::shared_ptr<ShmConn> shm_conn_create(std::string* name_out) {
   }
   memset(static_cast<void*>(seg), 0, sizeof(Segment));
   seg->magic = kShmMagic;
+  seg->client_pid.store(static_cast<int32_t>(getpid()),
+                        std::memory_order_release);
   auto conn = std::make_shared<ShmConn>();
   conn->seg = seg;
   conn->name = name;
@@ -346,11 +409,18 @@ std::shared_ptr<ShmConn> shm_conn_open(const std::string& name) {
     shm_conn_release_name(name);
     return nullptr;
   }
+  seg->server_pid.store(static_cast<int32_t>(getpid()),
+                        std::memory_order_release);
   auto conn = std::make_shared<ShmConn>();
   conn->seg = seg;
   conn->name = name;
   conn->is_client = false;
   return conn;
+}
+
+void shm_conn_set_self_pid(ShmConn& c, int32_t pid) {
+  (c.is_client ? c.seg->client_pid : c.seg->server_pid)
+      .store(pid, std::memory_order_release);
 }
 
 int shm_socket_create(std::shared_ptr<ShmConn> conn,

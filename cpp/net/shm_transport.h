@@ -45,4 +45,9 @@ int shm_socket_create(std::shared_ptr<ShmConn> conn,
 // The handshake method name Servers auto-register.
 inline const char* kShmConnectMethod = "__shm.Connect";
 
+// Overrides the pid this side published in the segment (liveness is
+// pid-based; tests use this to impersonate a crashed peer without a full
+// client process).
+void shm_conn_set_self_pid(ShmConn& c, int32_t pid);
+
 }  // namespace trpc

@@ -106,9 +106,14 @@ int consume_handler(void* meta, IOBuf** chunks, size_t n) {
     IOBuf* chunk = chunks[i];
     if (chunk == nullptr) {
       // CLOSE sentinel: rides the queue so every data chunk ahead of it is
-      // delivered first (ordered close).  Nothing may touch `m` after
-      // mark_closed — on_closed typically calls StreamClose which recycles
-      // the meta.
+      // delivered first (ordered close).  Data frames racing the close may
+      // land BEHIND the sentinel in this same batch — they are dropped, but
+      // their heap chunks must still be freed (consume() only deletes the
+      // batch array).  Nothing may touch `m` after mark_closed — on_closed
+      // typically calls StreamClose which recycles the meta.
+      for (size_t j = i + 1; j < n; ++j) {
+        delete chunks[j];
+      }
       mark_closed(m);
       return 1;
     }

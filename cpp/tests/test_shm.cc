@@ -1,6 +1,10 @@
 // Shared-memory ring transport tests (UBRing parity): handshake over TCP,
 // calls over the rings, payloads larger than the ring capacity (wrap +
 // backpressure), concurrency.
+#include <errno.h>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/wait.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -120,6 +124,68 @@ TEST_CASE(shm_bad_segment_rejected) {
     tcp.CallMethod(kShmConnectMethod, req, &resp, &cntl);
     EXPECT(cntl.Failed());
     EXPECT_EQ(cntl.error_code(), EINVAL);
+  }
+}
+
+TEST_CASE(shm_dead_peer_reaped_and_segment_unlinked) {
+  start_once();
+  // Full handshake, then impersonate a crashed client (kill -9 analogue):
+  // publish a real-but-dead pid as the client pid. The server's poller
+  // must reap the connection and unlink the segment even though the
+  // creator (client) never cleaned up.
+  std::string name;
+  auto client = shm_conn_create(&name);
+  EXPECT(client != nullptr);
+  {
+    Channel tcp;
+    EXPECT_EQ(tcp.Init("127.0.0.1:" + std::to_string(g_port)), 0);
+    Controller cntl;
+    IOBuf req, resp;
+    req.append(name);
+    tcp.CallMethod(kShmConnectMethod, req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+  }
+  pid_t child = fork();
+  if (child == 0) {
+    _exit(0);
+  }
+  int status = 0;
+  waitpid(child, &status, 0);  // child fully dead; pid not yet recycled
+  shm_conn_set_self_pid(*client, static_cast<int32_t>(child));
+
+  // Liveness check runs ~1/s; allow a few rounds for reap + teardown.
+  bool unlinked = false;
+  for (int i = 0; i < 80 && !unlinked; ++i) {
+    usleep(100 * 1000);
+    const int fd = shm_open(name.c_str(), O_RDWR, 0600);
+    if (fd < 0 && errno == ENOENT) {
+      unlinked = true;
+    } else if (fd >= 0) {
+      close(fd);
+    }
+  }
+  EXPECT(unlinked);
+  // Idle-but-alive control: a fresh connection whose peer (us) stays
+  // alive must NOT be reaped. A few liveness rounds (~1/s) with zero
+  // traffic are enough to catch an eager reaper; the 30s no-pid/stall
+  // windows themselves are too slow to exercise in a unit test.
+  std::string name2;
+  auto client2 = shm_conn_create(&name2);
+  EXPECT(client2 != nullptr);
+  {
+    Channel tcp;
+    EXPECT_EQ(tcp.Init("127.0.0.1:" + std::to_string(g_port)), 0);
+    Controller cntl;
+    IOBuf req, resp;
+    req.append(name2);
+    tcp.CallMethod(kShmConnectMethod, req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+  }
+  usleep(2500 * 1000);  // several liveness rounds, zero traffic
+  const int fd2 = shm_open(name2.c_str(), O_RDWR, 0600);
+  EXPECT(fd2 >= 0);
+  if (fd2 >= 0) {
+    close(fd2);
   }
 }
 
