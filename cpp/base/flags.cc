@@ -1,0 +1,201 @@
+#include "base/flags.h"
+
+#include <algorithm>
+#include <cstdlib>
+#include <map>
+
+namespace trpc {
+
+namespace {
+
+// Leaked singletons (runtime registries outlive every static destructor —
+// the repo-wide invariant).
+std::mutex& registry_mu() {
+  static std::mutex* mu = new std::mutex();
+  return *mu;
+}
+std::map<std::string, Flag*>& registry() {
+  static auto* m = new std::map<std::string, Flag*>();
+  return *m;
+}
+
+bool parse_bool(const std::string& v, bool* out) {
+  if (v == "true" || v == "1" || v == "on") {
+    *out = true;
+    return true;
+  }
+  if (v == "false" || v == "0" || v == "off") {
+    *out = false;
+    return true;
+  }
+  return false;
+}
+
+}  // namespace
+
+Flag::Flag(std::string name, Type t, std::string dflt, std::string desc)
+    : name_(std::move(name)),
+      type_(t),
+      default_str_(std::move(dflt)),
+      desc_(std::move(desc)) {
+  // Seed typed storage from the default (defaults are trusted input).
+  switch (type_) {
+    case Type::kBool: {
+      bool b = false;
+      parse_bool(default_str_, &b);
+      num_.store(b ? 1 : 0, std::memory_order_release);
+      break;
+    }
+    case Type::kInt64:
+      num_.store(strtoll(default_str_.c_str(), nullptr, 10),
+                 std::memory_order_release);
+      break;
+    case Type::kDouble:
+      real_.store(strtod(default_str_.c_str(), nullptr),
+                  std::memory_order_release);
+      break;
+    case Type::kString:
+      str_ = default_str_;
+      break;
+  }
+}
+
+Flag* Flag::define(const std::string& name, Type t, const std::string& dflt,
+                   const std::string& desc) {
+  std::lock_guard<std::mutex> g(registry_mu());
+  auto it = registry().find(name);
+  if (it != registry().end()) {
+    return it->second->type_ == t ? it->second : nullptr;
+  }
+  Flag* f = new Flag(name, t, dflt, desc);  // leaked with the registry
+  registry()[name] = f;
+  return f;
+}
+
+Flag* Flag::define_bool(const std::string& name, bool dflt,
+                        const std::string& desc) {
+  return define(name, Type::kBool, dflt ? "true" : "false", desc);
+}
+Flag* Flag::define_int64(const std::string& name, int64_t dflt,
+                         const std::string& desc) {
+  return define(name, Type::kInt64, std::to_string(dflt), desc);
+}
+Flag* Flag::define_double(const std::string& name, double dflt,
+                          const std::string& desc) {
+  return define(name, Type::kDouble, std::to_string(dflt), desc);
+}
+Flag* Flag::define_string(const std::string& name, const std::string& dflt,
+                          const std::string& desc) {
+  return define(name, Type::kString, dflt, desc);
+}
+
+Flag* Flag::find(const std::string& name) {
+  std::lock_guard<std::mutex> g(registry_mu());
+  auto it = registry().find(name);
+  return it == registry().end() ? nullptr : it->second;
+}
+
+std::vector<Flag*> Flag::all() {
+  std::lock_guard<std::mutex> g(registry_mu());
+  std::vector<Flag*> out;
+  out.reserve(registry().size());
+  for (auto& [_, f] : registry()) {
+    out.push_back(f);  // map iteration is already name-sorted
+  }
+  return out;
+}
+
+int Flag::set(const std::string& name, const std::string& value) {
+  Flag* f = find(name);
+  if (f == nullptr) {
+    return -1;
+  }
+  return f->set_from_string(value);
+}
+
+int Flag::set_from_string(const std::string& value) {
+  if (!reloadable_.load(std::memory_order_acquire)) {
+    return -3;
+  }
+  std::function<bool(const std::string&)> validator;
+  std::function<void(Flag*)> update_cb;
+  {
+    std::lock_guard<std::mutex> g(hook_mu_);
+    validator = validator_;
+    update_cb = update_cb_;
+  }
+  if (validator && !validator(value)) {
+    return -2;
+  }
+  switch (type_) {
+    case Type::kBool: {
+      bool b = false;
+      if (!parse_bool(value, &b)) {
+        return -2;
+      }
+      num_.store(b ? 1 : 0, std::memory_order_release);
+      break;
+    }
+    case Type::kInt64: {
+      char* end = nullptr;
+      const int64_t v = strtoll(value.c_str(), &end, 10);
+      if (end == value.c_str() || *end != '\0') {
+        return -2;
+      }
+      num_.store(v, std::memory_order_release);
+      break;
+    }
+    case Type::kDouble: {
+      char* end = nullptr;
+      const double v = strtod(value.c_str(), &end);
+      if (end == value.c_str() || *end != '\0') {
+        return -2;
+      }
+      real_.store(v, std::memory_order_release);
+      break;
+    }
+    case Type::kString: {
+      std::lock_guard<std::mutex> g(str_mu_);
+      str_ = value;
+      break;
+    }
+  }
+  if (update_cb) {
+    update_cb(this);
+  }
+  return 0;
+}
+
+std::string Flag::string_value() const {
+  std::lock_guard<std::mutex> g(str_mu_);
+  return str_;
+}
+
+std::string Flag::value_string() const {
+  switch (type_) {
+    case Type::kBool:
+      return bool_value() ? "true" : "false";
+    case Type::kInt64:
+      return std::to_string(int64_value());
+    case Type::kDouble: {
+      char buf[32];
+      snprintf(buf, sizeof(buf), "%g", double_value());
+      return buf;
+    }
+    case Type::kString:
+      return string_value();
+  }
+  return "";
+}
+
+void Flag::set_validator(std::function<bool(const std::string&)> v) {
+  std::lock_guard<std::mutex> g(hook_mu_);
+  validator_ = std::move(v);
+}
+
+void Flag::on_update(std::function<void(Flag*)> cb) {
+  std::lock_guard<std::mutex> g(hook_mu_);
+  update_cb_ = std::move(cb);
+}
+
+}  // namespace trpc

@@ -1,0 +1,92 @@
+// Runtime flag registry — reloadable configuration knobs.
+//
+// Parity: the reference's gflags + reloadable-flag pattern
+// (/root/reference/src/butil/reloadable_flags.h: a validator registered per
+// flag makes it safely mutable at runtime; /root/reference/src/brpc/builtin/
+// flags_service.* exposes them over HTTP).  Redesigned condensed: one
+// registry, typed atomic storage, optional validator + on-update hook so a
+// flip can push into live components (e.g. a concurrency limiter bound).
+//
+// Usage:
+//   static Flag* g_limit = Flag::define_int64(
+//       "echo_max_concurrency", 128, "admission bound for Echo");
+//   ... g_limit->int64_value() ...           // lock-free read
+//   Flag::set("echo_max_concurrency", "64")  // validated runtime flip
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace trpc {
+
+class Flag {
+ public:
+  enum class Type { kBool, kInt64, kDouble, kString };
+
+  // Define-or-get: defining the same name twice returns the first instance
+  // (types must match; mismatch returns nullptr).  Thread-safe.
+  static Flag* define_bool(const std::string& name, bool dflt,
+                           const std::string& desc);
+  static Flag* define_int64(const std::string& name, int64_t dflt,
+                            const std::string& desc);
+  static Flag* define_double(const std::string& name, double dflt,
+                             const std::string& desc);
+  static Flag* define_string(const std::string& name, const std::string& dflt,
+                             const std::string& desc);
+
+  // Registry.
+  static Flag* find(const std::string& name);
+  static std::vector<Flag*> all();  // sorted by name
+  // Validated set; returns 0 on success, -1 unknown flag, -2 bad value /
+  // rejected by validator, -3 not reloadable.
+  static int set(const std::string& name, const std::string& value);
+
+  // -- per-flag API ----------------------------------------------------
+  const std::string& name() const { return name_; }
+  const std::string& description() const { return desc_; }
+  Type type() const { return type_; }
+  bool reloadable() const { return reloadable_; }
+  void set_reloadable(bool r) { reloadable_ = r; }
+  const std::string& default_value() const { return default_str_; }
+
+  bool bool_value() const {
+    return num_.load(std::memory_order_acquire) != 0;
+  }
+  int64_t int64_value() const { return num_.load(std::memory_order_acquire); }
+  double double_value() const { return real_.load(std::memory_order_acquire); }
+  std::string string_value() const;
+  std::string value_string() const;  // any type, rendered
+
+  int set_from_string(const std::string& value);
+
+  // Rejects a candidate value before it lands (reloadable_flags.h parity:
+  // the validator IS what makes runtime mutation safe).
+  void set_validator(std::function<bool(const std::string&)> v);
+  // Runs after a successful set — push the new value into live components.
+  void on_update(std::function<void(Flag*)> cb);
+
+ private:
+  Flag(std::string name, Type t, std::string dflt, std::string desc);
+  static Flag* define(const std::string& name, Type t,
+                      const std::string& dflt, const std::string& desc);
+
+  const std::string name_;
+  const Type type_;
+  const std::string default_str_;
+  const std::string desc_;
+  std::atomic<bool> reloadable_{true};
+  std::atomic<int64_t> num_{0};     // bool / int64
+  std::atomic<double> real_{0.0};   // double
+  mutable std::mutex str_mu_;       // string payload
+  std::string str_;
+  std::mutex hook_mu_;
+  std::function<bool(const std::string&)> validator_;
+  std::function<void(Flag*)> update_cb_;
+};
+
+}  // namespace trpc

@@ -1,12 +1,17 @@
-// Builtin introspection services (parity: src/brpc/builtin/ — /vars,
-// /status, /health, /version, /connections registered at server start,
-// server.cpp:501-604).
+// Builtin introspection services (parity: src/brpc/builtin/ — registered at
+// server start, server.cpp:501-604: /status /vars /connections /flags
+// /index /version /health /list /protobufs /threads /memory /metrics ...).
+#include <stdio.h>
+#include <string.h>
 #include <unistd.h>
 
 #include <atomic>
+#include <cstdlib>
 #include <string>
 
+#include "base/flags.h"
 #include "base/time.h"
+#include "fiber/fiber.h"
 #include "net/http_protocol.h"
 #include "net/server.h"
 #include "stat/variable.h"
@@ -15,14 +20,55 @@ namespace trpc {
 
 std::atomic<int64_t> g_socket_count{0};
 
-bool builtin_http_dispatch(Server* srv, const std::string& path,
+namespace {
+
+// /proc/self introspection for /memory and /threads (parity:
+// bvar/default_variables.cpp reads the same files).
+long proc_status_kb(const char* key) {
+  FILE* f = fopen("/proc/self/status", "r");
+  if (f == nullptr) {
+    return -1;
+  }
+  char line[256];
+  long val = -1;
+  const size_t klen = strlen(key);
+  while (fgets(line, sizeof(line), f) != nullptr) {
+    if (strncmp(line, key, klen) == 0) {
+      val = atol(line + klen);
+      break;
+    }
+  }
+  fclose(f);
+  return val;
+}
+
+std::string flags_text() {
+  std::string out;
+  for (Flag* f : Flag::all()) {
+    out += f->name() + " = " + f->value_string();
+    if (f->value_string() != f->default_value()) {
+      out += " (default: " + f->default_value() + ")";
+    }
+    if (!f->reloadable()) {
+      out += " [immutable]";
+    }
+    out += "  # " + f->description() + "\n";
+  }
+  return out;
+}
+
+}  // namespace
+
+bool builtin_http_dispatch(Server* srv, const HttpRequest& req, int* status,
                            std::string* body, std::string* content_type) {
+  const std::string& path = req.path;
+  *status = 200;
   if (path == "/health") {
     *body = "OK\n";
     return true;
   }
   if (path == "/version") {
-    *body = "tpu-rpc/0.1.0\n";
+    *body = "tpu-rpc/0.2.0\n";
     return true;
   }
   if (path == "/vars" || path == "/vars/") {
@@ -33,12 +79,25 @@ bool builtin_http_dispatch(Server* srv, const std::string& path,
     *body = std::move(out);
     return true;
   }
+  if (path.rfind("/vars/", 0) == 0) {  // single variable
+    const std::string want = path.substr(6);
+    for (auto& [name, value] : Variable::dump_exposed()) {
+      if (name == want) {
+        *body = name + " : " + value + "\n";
+        return true;
+      }
+    }
+    *status = 404;
+    *body = "no such var: " + want + "\n";
+    return true;
+  }
   if (path == "/status") {
     const int64_t up_us = monotonic_time_us() - srv->start_time_us();
-    std::string out = "server 127.0.0.1:" + std::to_string(srv->port()) +
+    std::string out = "server port " + std::to_string(srv->port()) +
                       "\nuptime_s " + std::to_string(up_us / 1000000) +
                       "\nrequests_served " +
                       std::to_string(srv->requests_served.load()) +
+                      "\nin_flight " + std::to_string(srv->in_flight.load()) +
                       "\nmethods:\n";
     srv->for_each_method(
         [&out](const std::string& name) { out += "  " + name + "\n"; });
@@ -55,6 +114,66 @@ bool builtin_http_dispatch(Server* srv, const std::string& path,
             "\n";
     return true;
   }
+  // ---- round-2 additions -------------------------------------------------
+  if (path == "/flags" || path == "/flags/") {
+    *body = flags_text();
+    return true;
+  }
+  if (path.rfind("/flags/", 0) == 0) {
+    const std::string name = path.substr(7);
+    Flag* f = Flag::find(name);
+    if (f == nullptr) {
+      *status = 404;
+      *body = "no such flag: " + name + "\n";
+      return true;
+    }
+    // ?setvalue=v mutates (reference: /flags/<name>?setvalue=... with a
+    // registered validator making the flip safe).
+    const std::string* setv = req.query("setvalue");
+    if (setv != nullptr) {
+      const int rc = f->set_from_string(*setv);
+      if (rc == 0) {
+        *body = name + " = " + f->value_string() + "\n";
+      } else {
+        *status = rc == -3 ? 403 : 400;
+        *body = (rc == -3 ? std::string("flag is immutable: ")
+                          : std::string("bad value for ")) +
+                name + "\n";
+      }
+      return true;
+    }
+    *body = name + " = " + f->value_string() + "  # " + f->description() +
+            "\n";
+    return true;
+  }
+  if (path == "/threads") {
+    *body = "fiber_workers " + std::to_string(fiber_worker_count()) +
+            "\nos_threads " + std::to_string(proc_status_kb("Threads:")) +
+            "\n";
+    return true;
+  }
+  if (path == "/memory") {
+    *body = "vm_rss_kb " + std::to_string(proc_status_kb("VmRSS:")) +
+            "\nvm_size_kb " + std::to_string(proc_status_kb("VmSize:")) +
+            "\nvm_hwm_kb " + std::to_string(proc_status_kb("VmHWM:")) + "\n";
+    return true;
+  }
+  if (path == "/list" || path == "/protobufs") {
+    // Method inventory (the pb-less analogue of /protobufs).
+    std::string out;
+    srv->for_each_method(
+        [&out](const std::string& name) { out += name + "\n"; });
+    *body = std::move(out);
+    return true;
+  }
+  if (path == "/index" || path == "/") {
+    *body =
+        "/health\n/version\n/status\n/vars\n/vars/<name>\n/brpc_metrics\n"
+        "/connections\n/flags\n/flags/<name>[?setvalue=v]\n/threads\n"
+        "/memory\n/list\n/protobufs\n/index\n";
+    return true;
+  }
+  (void)content_type;
   return false;
 }
 

@@ -38,7 +38,8 @@ class ConstantLimiter final : public ConcurrencyLimiter {
   explicit ConstantLimiter(int64_t limit) : limit_(limit) {}
 
   bool on_request() override {
-    if (inflight_.fetch_add(1, std::memory_order_acq_rel) >= limit_) {
+    const int64_t limit = limit_.load(std::memory_order_acquire);
+    if (inflight_.fetch_add(1, std::memory_order_acq_rel) >= limit) {
       inflight_.fetch_sub(1, std::memory_order_acq_rel);
       return false;
     }
@@ -49,10 +50,17 @@ class ConstantLimiter final : public ConcurrencyLimiter {
     inflight_.fetch_sub(1, std::memory_order_acq_rel);
   }
 
-  int64_t current_limit() const override { return limit_; }
+  int64_t current_limit() const override {
+    return limit_.load(std::memory_order_acquire);
+  }
+  // Runtime retarget (a /flags flip lands here; in-flight admissions are
+  // unaffected, the new bound gates subsequent requests).
+  void set_limit(int64_t limit) {
+    limit_.store(limit, std::memory_order_release);
+  }
 
  private:
-  const int64_t limit_;
+  std::atomic<int64_t> limit_;
   std::atomic<int64_t> inflight_{0};
 };
 

@@ -1,0 +1,408 @@
+#include "net/http_message.h"
+
+#include <cstring>
+
+#include <algorithm>
+
+namespace trpc {
+
+namespace {
+
+constexpr size_t kMaxHeaderBytes = 64 * 1024;
+constexpr uint64_t kMaxBody = 1ull << 30;  // 1 GB
+
+bool ci_equal(const std::string& a, const char* b) {
+  const size_t n = strlen(b);
+  if (a.size() != n) {
+    return false;
+  }
+  for (size_t i = 0; i < n; ++i) {
+    if (tolower(static_cast<unsigned char>(a[i])) !=
+        tolower(static_cast<unsigned char>(b[i]))) {
+      return false;
+    }
+  }
+  return true;
+}
+
+bool ci_contains(const std::string& haystack, const char* needle) {
+  std::string lower = haystack;
+  for (char& c : lower) {
+    c = static_cast<char>(tolower(static_cast<unsigned char>(c)));
+  }
+  return lower.find(needle) != std::string::npos;
+}
+
+std::string trim_ows(const std::string& s) {
+  size_t b = 0;
+  size_t e = s.size();
+  while (b < e && (s[b] == ' ' || s[b] == '\t')) {
+    ++b;
+  }
+  while (e > b && (s[e - 1] == ' ' || s[e - 1] == '\t')) {
+    --e;
+  }
+  return s.substr(b, e - b);
+}
+
+int hex_val(char c) {
+  if (c >= '0' && c <= '9') {
+    return c - '0';
+  }
+  if (c >= 'a' && c <= 'f') {
+    return c - 'a' + 10;
+  }
+  if (c >= 'A' && c <= 'F') {
+    return c - 'A' + 10;
+  }
+  return -1;
+}
+
+constexpr size_t kMaxTrailerBytes = 16 * 1024;
+
+// Resumable chunked-body scan.  st->pos is the absolute offset of the
+// validated frontier in the connection's input buffer: completed chunks
+// are moved into st->body and the frontier advances, so a retry with more
+// bytes only re-copies the unvalidated tail (a partial line or partial
+// chunk), never the whole buffer — no O(n^2) rescans.
+struct ChunkedState {
+  size_t pos = 0;        // absolute offset of the validated frontier
+  std::string body;      // de-chunked payload so far
+  bool in_trailers = false;
+  size_t trailer_bytes = 0;  // completed trailer-line bytes (capped)
+};
+
+ParseError parse_chunked(const IOBuf& source, ChunkedState* st,
+                         IOBuf* body, size_t* consumed) {
+  // ONE copy of the unvalidated tail per parse attempt; the loop below
+  // scans every chunk inside this window via `off` (window-relative
+  // frontier) — copying inside the loop would be O(bytes x chunks).
+  std::string tail;
+  tail.resize(source.size() - st->pos);
+  source.copy_to(tail.data(), tail.size(), st->pos);
+  size_t off = 0;
+
+  while (true) {
+    if (st->in_trailers) {
+      // Trailer section: zero or more (ignored) header lines, then CRLF.
+      // Bounded so an endless trailer stream cannot grow the buffer
+      // forever.
+      while (true) {
+        const size_t t_end = tail.find("\r\n", off);
+        if (t_end == std::string::npos) {
+          st->pos += off;  // completed trailer lines are consumed
+          if (st->trailer_bytes + (tail.size() - off) > kMaxTrailerBytes) {
+            return ParseError::kCorrupted;
+          }
+          return ParseError::kNotEnoughData;
+        }
+        if (t_end == off) {  // empty line closes the message
+          st->pos += off + 2;
+          body->append(st->body);
+          *consumed = st->pos;
+          return ParseError::kOk;
+        }
+        st->trailer_bytes += t_end + 2 - off;
+        if (st->trailer_bytes > kMaxTrailerBytes) {
+          return ParseError::kCorrupted;
+        }
+        off = t_end + 2;
+      }
+    }
+
+    // chunk-size line: hex [; extensions] CRLF
+    const size_t line_end = tail.find("\r\n", off);
+    if (line_end == std::string::npos) {
+      st->pos += off;
+      return tail.size() - off > 64
+                 ? ParseError::kCorrupted  // absurd size line
+                 : ParseError::kNotEnoughData;
+    }
+    uint64_t size = 0;
+    size_t i = off;
+    bool any = false;
+    for (; i < line_end; ++i) {
+      const int v = hex_val(tail[i]);
+      if (v < 0) {
+        break;  // extensions start (';') or garbage
+      }
+      any = true;
+      size = size * 16 + static_cast<uint64_t>(v);
+      if (size > kMaxBody) {
+        return ParseError::kCorrupted;
+      }
+    }
+    if (!any || (i < line_end && tail[i] != ';')) {
+      return ParseError::kCorrupted;
+    }
+    if (size == 0) {
+      off = line_end + 2;
+      st->in_trailers = true;
+      continue;
+    }
+    if (st->body.size() + size > kMaxBody) {
+      return ParseError::kCorrupted;
+    }
+    const size_t data_off = line_end + 2;
+    if (data_off + size + 2 > tail.size()) {
+      // Frontier stays at the size line until the whole chunk (+CRLF) is
+      // visible; the next attempt's copied tail is bounded by one chunk.
+      st->pos += off;
+      return ParseError::kNotEnoughData;
+    }
+    if (tail[data_off + size] != '\r' || tail[data_off + size + 1] != '\n') {
+      return ParseError::kCorrupted;
+    }
+    st->body.append(tail, data_off, size);
+    off = data_off + size + 2;
+  }
+}
+
+
+}  // namespace
+
+const std::string* HttpRequest::header(const std::string& name) const {
+  for (const auto& [k, v] : headers) {
+    if (ci_equal(k, name.c_str())) {
+      return &v;
+    }
+  }
+  return nullptr;
+}
+
+const std::string* HttpRequest::query(const std::string& name) const {
+  for (const auto& [k, v] : queries) {
+    if (k == name) {
+      return &v;
+    }
+  }
+  return nullptr;
+}
+
+bool percent_decode(const std::string& in, std::string* out, bool for_query) {
+  out->clear();
+  out->reserve(in.size());
+  for (size_t i = 0; i < in.size(); ++i) {
+    const char c = in[i];
+    if (c == '%') {
+      if (i + 2 >= in.size()) {
+        return false;
+      }
+      const int hi = hex_val(in[i + 1]);
+      const int lo = hex_val(in[i + 2]);
+      if (hi < 0 || lo < 0) {
+        return false;
+      }
+      out->push_back(static_cast<char>(hi * 16 + lo));
+      i += 2;
+    } else if (for_query && c == '+') {
+      out->push_back(' ');
+    } else {
+      out->push_back(c);
+    }
+  }
+  return true;
+}
+
+void parse_query_string(
+    const std::string& qs,
+    std::vector<std::pair<std::string, std::string>>* out) {
+  size_t pos = 0;
+  while (pos <= qs.size()) {
+    size_t amp = qs.find('&', pos);
+    if (amp == std::string::npos) {
+      amp = qs.size();
+    }
+    const std::string pair = qs.substr(pos, amp - pos);
+    pos = amp + 1;
+    if (pair.empty()) {
+      if (amp == qs.size()) {
+        break;
+      }
+      continue;
+    }
+    const size_t eq = pair.find('=');
+    std::string k;
+    std::string v;
+    const bool ok =
+        eq == std::string::npos
+            ? percent_decode(pair, &k, true)
+            : percent_decode(pair.substr(0, eq), &k, true) &&
+                  percent_decode(pair.substr(eq + 1), &v, true);
+    if (ok && !k.empty()) {
+      out->emplace_back(std::move(k), std::move(v));
+    }
+    if (amp == qs.size()) {
+      break;
+    }
+  }
+}
+
+ParseError http_parse_request(IOBuf* source, HttpRequest* req, IOBuf* body,
+                              std::shared_ptr<void>* state) {
+  // Header window only — the non-chunked body is cut straight from the
+  // IOBuf without ever being copied here (a 1GB upload must not be
+  // re-copied on every parse retry).
+  const size_t scan = std::min(source->size(), kMaxHeaderBytes);
+  std::string window;
+  window.resize(scan);
+  source->copy_to(window.data(), window.size());
+
+  const size_t hdr_end = window.find("\r\n\r\n");
+  if (hdr_end == std::string::npos) {
+    return scan >= kMaxHeaderBytes ? ParseError::kCorrupted
+                                   : ParseError::kNotEnoughData;
+  }
+  if (hdr_end + 4 > kMaxHeaderBytes) {
+    return ParseError::kCorrupted;
+  }
+
+  // ---- request line ----------------------------------------------------
+  const size_t line_end = window.find("\r\n");
+  const std::string line = window.substr(0, line_end);
+  const size_t sp1 = line.find(' ');
+  const size_t sp2 = line.rfind(' ');
+  if (sp1 == std::string::npos || sp2 == sp1) {
+    return ParseError::kCorrupted;
+  }
+  req->verb = line.substr(0, sp1);
+  const std::string version = line.substr(sp2 + 1);
+  if (version.rfind("HTTP/", 0) != 0) {
+    return ParseError::kCorrupted;
+  }
+  req->http_1_0 = version == "HTTP/1.0";
+  std::string target = line.substr(sp1 + 1, sp2 - sp1 - 1);
+  const size_t frag = target.find('#');
+  if (frag != std::string::npos) {
+    target.resize(frag);
+  }
+  const size_t qmark = target.find('?');
+  std::string raw_path = target;
+  if (qmark != std::string::npos) {
+    raw_path = target.substr(0, qmark);
+    req->query_string = target.substr(qmark + 1);
+    parse_query_string(req->query_string, &req->queries);
+  }
+  if (!percent_decode(raw_path, &req->path, false)) {
+    return ParseError::kCorrupted;
+  }
+
+  // ---- headers ----------------------------------------------------------
+  req->headers.clear();
+  bool have_content_length = false;
+  uint64_t content_len = 0;
+  size_t pos = line_end + 2;
+  while (pos < hdr_end + 2) {
+    size_t eol = window.find("\r\n", pos);
+    if (eol == std::string::npos || eol > hdr_end) {
+      eol = hdr_end;
+    }
+    const std::string hline = window.substr(pos, eol - pos);
+    pos = eol + 2;
+    if (hline.empty()) {
+      break;
+    }
+    const size_t colon = hline.find(':');
+    if (colon == std::string::npos || colon == 0) {
+      return ParseError::kCorrupted;  // a header line without a name
+    }
+    std::string name = hline.substr(0, colon);
+    // RFC 7230 §3.2.4: whitespace between field-name and colon must be
+    // rejected — "Content-Length :" would otherwise dodge the framing
+    // logic while a fronting proxy honors it (request smuggling).
+    if (name.back() == ' ' || name.back() == '\t') {
+      return ParseError::kCorrupted;
+    }
+    std::string value = trim_ows(hline.substr(colon + 1));
+    if (ci_equal(name, "content-length")) {
+      // Duplicate or list-valued Content-Length desyncs framing: reject
+      // outright rather than trusting either copy (request smuggling).
+      if (have_content_length ||
+          value.find(',') != std::string::npos) {
+        return ParseError::kCorrupted;
+      }
+      // 1*DIGIT only (RFC 7230): strtoull's leading '+'/whitespace
+      // tolerance is a smuggling desync vector behind stricter proxies.
+      if (value.empty() || value[0] < '0' || value[0] > '9') {
+        return ParseError::kCorrupted;
+      }
+      char* end = nullptr;
+      content_len = strtoull(value.c_str(), &end, 10);
+      if (end == value.c_str() || *end != '\0' || content_len > kMaxBody) {
+        return ParseError::kCorrupted;
+      }
+      have_content_length = true;
+    } else if (ci_equal(name, "transfer-encoding")) {
+      if (!ci_contains(value, "chunked")) {
+        return ParseError::kCorrupted;  // unsupported encoding
+      }
+      req->chunked = true;
+    } else if (ci_equal(name, "connection")) {
+      if (ci_contains(value, "close")) {
+        req->keep_alive = false;
+      } else if (ci_contains(value, "keep-alive")) {
+        req->keep_alive = true;
+      }
+    }
+    req->headers.emplace_back(std::move(name), std::move(value));
+  }
+  if (req->http_1_0 && req->header("Connection") == nullptr) {
+    req->keep_alive = false;
+  }
+  // A message with BOTH is a smuggling vector: reject (RFC 7230 §3.3.3).
+  if (req->chunked && have_content_length) {
+    return ParseError::kCorrupted;
+  }
+
+  // ---- body --------------------------------------------------------------
+  const size_t body_off = hdr_end + 4;
+  if (req->chunked) {
+    std::shared_ptr<ChunkedState> st;
+    if (state != nullptr && *state != nullptr) {
+      st = std::static_pointer_cast<ChunkedState>(*state);
+    } else {
+      st = std::make_shared<ChunkedState>();
+      st->pos = body_off;
+      if (state != nullptr) {
+        *state = st;
+      }
+    }
+    size_t consumed = 0;
+    const ParseError rc = parse_chunked(*source, st.get(), body, &consumed);
+    if (rc == ParseError::kOk) {
+      if (state != nullptr) {
+        state->reset();
+      }
+      source->pop_front(consumed);
+    } else if (rc == ParseError::kCorrupted && state != nullptr) {
+      state->reset();
+    }
+    return rc;
+  }
+  const uint64_t total = static_cast<uint64_t>(body_off) + content_len;
+  if (source->size() < total) {
+    return ParseError::kNotEnoughData;
+  }
+  source->pop_front(body_off);
+  source->cutn(body, content_len);
+  return ParseError::kOk;
+}
+
+std::string http_status_line(int status) {
+  const char* reason = "OK";
+  switch (status) {
+    case 200: reason = "OK"; break;
+    case 204: reason = "No Content"; break;
+    case 400: reason = "Bad Request"; break;
+    case 403: reason = "Forbidden"; break;
+    case 404: reason = "Not Found"; break;
+    case 405: reason = "Method Not Allowed"; break;
+    case 500: reason = "Internal Server Error"; break;
+    case 501: reason = "Not Implemented"; break;
+    case 503: reason = "Service Unavailable"; break;
+    default: reason = "Unknown"; break;
+  }
+  return "HTTP/1.1 " + std::to_string(status) + " " + reason;
+}
+
+}  // namespace trpc

@@ -1,0 +1,63 @@
+// HTTP/1.x message parsing — request lines, headers, chunked bodies, URIs.
+//
+// Parity: the reference's HTTP front (/root/reference/src/brpc/details/
+// http_message.*, http_parser.* (vendored node parser), uri.*,
+// http_header.*, ~6,500 LoC with transcoding).  Redesigned condensed: a
+// re-scanning parser over the accumulating input buffer (the InputMessenger
+// retries parse as bytes arrive, so per-connection parser state is
+// unnecessary), strict on the invariants that desync framing — duplicate
+// Content-Length, malformed chunk sizes, header caps — and tolerant
+// elsewhere.  Unit-testable from raw bytes without sockets (the reference's
+// protocol-unit style, test/brpc_http_parser_unittest.cpp).
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "base/iobuf.h"
+#include "net/protocol.h"
+
+namespace trpc {
+
+struct HttpRequest {
+  std::string verb;          // GET / POST / ...
+  std::string path;          // percent-decoded, query stripped
+  std::string query_string;  // raw (undecoded) query part
+  bool http_1_0 = false;
+  bool keep_alive = true;    // Connection semantics (1.0 defaults close)
+  bool chunked = false;      // body arrived chunked
+  // Original-case names; lookup is case-insensitive.
+  std::vector<std::pair<std::string, std::string>> headers;
+  std::vector<std::pair<std::string, std::string>> queries;  // decoded
+
+  // nullptr when absent; case-insensitive on name.
+  const std::string* header(const std::string& name) const;
+  // nullptr when absent ("?k" alone yields an empty value, not nullptr).
+  const std::string* query(const std::string& name) const;
+};
+
+// Cuts ONE complete request off `source` into *req + *body.
+// kNotEnoughData leaves `source` untouched.  `state` (may be null) lets
+// chunked bodies resume scanning where the previous attempt stopped
+// instead of re-walking the whole buffer on every retry; callers pass the
+// same slot across retries (Socket::parse_state) and it is reset when a
+// message completes or fails.
+ParseError http_parse_request(IOBuf* source, HttpRequest* req, IOBuf* body,
+                              std::shared_ptr<void>* state = nullptr);
+
+// Percent-decodes `in` ('+' becomes space when for_query).  Returns false
+// on malformed escapes (which a strict parser rejects).
+bool percent_decode(const std::string& in, std::string* out, bool for_query);
+
+// Splits "a=1&b=%20c" into decoded pairs (malformed pairs are skipped).
+void parse_query_string(
+    const std::string& qs,
+    std::vector<std::pair<std::string, std::string>>* out);
+
+// Response head for the given status; body appended by the caller.
+std::string http_status_line(int status);
+
+}  // namespace trpc

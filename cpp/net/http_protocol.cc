@@ -1,21 +1,19 @@
 #include "net/http_protocol.h"
 
 #include <cstring>
-#include <string>
-
 #include <memory>
+#include <string>
 
 #include "base/logging.h"
 #include "base/time.h"
 #include "fiber/sync.h"
+#include "net/http_message.h"
 #include "net/server.h"
 #include "net/socket.h"
 
 namespace trpc {
 
 namespace {
-
-constexpr size_t kMaxHeaderBytes = 64 * 1024;
 
 bool looks_like_http(const IOBuf& buf) {
   char start[8] = {};
@@ -34,76 +32,45 @@ bool looks_like_http(const IOBuf& buf) {
   return false;
 }
 
-// InputMessage reuse for HTTP: meta.method carries "VERB PATH"; payload is
-// the body.
-ParseError http_parse(IOBuf* source, InputMessage* out) {
+ParseError http_parse(IOBuf* source, InputMessage* out, Socket* sock) {
   if (source->empty()) {
     return ParseError::kNotEnoughData;
   }
   if (!looks_like_http(*source)) {
     return ParseError::kTryOtherProtocol;
   }
-  const size_t scan = std::min(source->size(), kMaxHeaderBytes);
-  std::string head;
-  head.resize(scan);
-  source->copy_to(head.data(), scan);
-  const size_t hdr_end = head.find("\r\n\r\n");
-  if (hdr_end == std::string::npos) {
-    return scan >= kMaxHeaderBytes ? ParseError::kCorrupted
-                                   : ParseError::kNotEnoughData;
+  auto req = std::make_shared<HttpRequest>();
+  const ParseError rc = http_parse_request(
+      source, req.get(), &out->payload,
+      sock != nullptr ? &sock->parse_state : nullptr);
+  if (rc != ParseError::kOk) {
+    return rc;
   }
-  // Request line.
-  const size_t line_end = head.find("\r\n");
-  const std::string line = head.substr(0, line_end);
-  const size_t sp1 = line.find(' ');
-  const size_t sp2 = line.rfind(' ');
-  if (sp1 == std::string::npos || sp2 <= sp1) {
-    return ParseError::kCorrupted;
-  }
-  const std::string verb = line.substr(0, sp1);
-  const std::string path = line.substr(sp1 + 1, sp2 - sp1 - 1);
-  // Content-Length: matched as a header NAME (leading "\r\n"), never as a
-  // substring of another header or the request line; capped so a hostile
-  // value can neither wrap the total nor buffer unboundedly.
-  constexpr uint64_t kMaxBody = 1ull << 30;  // 1 GB
-  uint64_t content_len = 0;
-  {
-    std::string lower = head.substr(0, hdr_end + 2);
-    for (char& c : lower) {
-      c = static_cast<char>(tolower(c));
-    }
-    const size_t pos = lower.find("\r\ncontent-length:");
-    if (pos != std::string::npos) {
-      char* end = nullptr;
-      content_len = strtoull(lower.c_str() + pos + 17, &end, 10);
-      if (content_len > kMaxBody) {
-        return ParseError::kCorrupted;
-      }
-    }
-  }
-  const uint64_t total = static_cast<uint64_t>(hdr_end) + 4 + content_len;
-  if (source->size() < total) {
-    return ParseError::kNotEnoughData;
-  }
-  source->pop_front(hdr_end + 4);
-  source->cutn(&out->payload, content_len);
   out->meta.type = RpcMeta::kRequest;
-  out->meta.method = verb + " " + path;
+  out->meta.method = req->verb + " " + req->path;
+  out->ctx = std::move(req);
   return ParseError::kOk;
 }
 
-void http_respond(SocketId sid, int status, const std::string& reason,
+// Response write; honors HEAD (headers only) and Connection semantics
+// (keep-alive by default, flush-then-close on `close`).
+void http_respond(SocketId sid, const HttpRequest& req, int status,
                   const std::string& content_type, const std::string& body) {
-  std::string head = "HTTP/1.1 " + std::to_string(status) + " " + reason +
-                     "\r\nContent-Type: " + content_type +
-                     "\r\nContent-Length: " + std::to_string(body.size()) +
-                     "\r\nConnection: keep-alive\r\n\r\n";
+  std::string head =
+      http_status_line(status) + "\r\nContent-Type: " + content_type +
+      "\r\nContent-Length: " + std::to_string(body.size()) +
+      (req.keep_alive ? "\r\nConnection: keep-alive\r\n\r\n"
+                      : "\r\nConnection: close\r\n\r\n");
   IOBuf out;
   out.append(head);
-  out.append(body);
+  if (req.verb != "HEAD") {
+    out.append(body);
+  }
   SocketRef s(Socket::Address(sid));
   if (s) {
-    s->Write(std::move(out));
+    // close_after rides the write node: the socket fails itself only once
+    // THIS response has flushed, immune to races with earlier drains.
+    s->Write(std::move(out), /*close_after=*/!req.keep_alive);
   }
 }
 
@@ -113,32 +80,40 @@ void http_process_request(InputMessage&& msg) {
     return;
   }
   Server* srv = static_cast<Server*>(sock->user_data);
-  const size_t sp = msg.meta.method.find(' ');
-  std::string path = msg.meta.method.substr(sp + 1);
-  const size_t q = path.find('?');
-  if (q != std::string::npos) {
-    path = path.substr(0, q);
-  }
-  std::string body, ctype = "text/plain";
-  if (srv != nullptr && builtin_http_dispatch(srv, path, &body, &ctype)) {
-    http_respond(msg.socket, 200, "OK", ctype, body);
+  auto req = std::static_pointer_cast<HttpRequest>(msg.ctx);
+  CHECK(req != nullptr);
+
+  // 1. Builtin observability endpoints.
+  std::string body;
+  std::string ctype = "text/plain";
+  int status = 200;
+  if (srv != nullptr &&
+      builtin_http_dispatch(srv, *req, &status, &body, &ctype)) {
+    http_respond(msg.socket, *req, status, ctype, body);
     return;
   }
-  // RPC-over-HTTP: POST /Service.Method with the request payload as body
-  // (parity: brpc's http access to pb services).
-  const std::string rpc_name = path.empty() ? "" : path.substr(1);
-  const Server::MethodProperty* prop =
-      srv != nullptr ? srv->find_method(rpc_name) : nullptr;
+
+  // 2. Restful patterns, then direct /Service.Method access (parity:
+  //    RestfulMap + http access to pb services).
+  const Server::MethodProperty* prop = nullptr;
+  std::string rpc_name;
+  if (srv != nullptr) {
+    prop = srv->find_restful(req->path, &rpc_name);
+    if (prop == nullptr) {
+      rpc_name = req->path.empty() ? "" : req->path.substr(1);
+      prop = srv->find_method(rpc_name);
+    }
+  }
   if (prop == nullptr) {
-    http_respond(msg.socket, 404, "Not Found", "text/plain",
-                 "no such path or method: " + path + "\n");
+    http_respond(msg.socket, *req, 404, "text/plain",
+                 "no such path or method: " + req->path + "\n");
     return;
   }
   // Admission gate — same limiter instance as the tstd path, so the
   // configured per-method limit holds regardless of serving protocol.
   std::shared_ptr<ConcurrencyLimiter> limiter = prop->limiter;
   if (limiter != nullptr && !limiter->on_request()) {
-    http_respond(msg.socket, 503, "Service Unavailable", "text/plain",
+    http_respond(msg.socket, *req, 503, "text/plain",
                  "rejected by concurrency limiter\n");
     return;
   }
@@ -153,15 +128,15 @@ void http_process_request(InputMessage&& msg) {
   // asynchronous handler cannot let a later pipelined response overtake.
   srv->in_flight.fetch_add(1, std::memory_order_acq_rel);
   auto latch = std::make_shared<CountdownEvent>(1);
-  Closure done = [sid, cntl, response, srv, lat, start_us, latch, limiter] {
+  Closure done = [sid, req, cntl, response, srv, lat, start_us, latch,
+                  limiter] {
     if (limiter != nullptr) {
       limiter->on_response(monotonic_time_us() - start_us, cntl->Failed());
     }
     if (cntl->Failed()) {
-      http_respond(sid, 500, "Internal Server Error", "text/plain",
-                   cntl->error_text() + "\n");
+      http_respond(sid, *req, 500, "text/plain", cntl->error_text() + "\n");
     } else {
-      http_respond(sid, 200, "OK", "application/octet-stream",
+      http_respond(sid, *req, 200, "application/octet-stream",
                    response->to_string());
     }
     if (lat != nullptr) {

@@ -1,13 +1,17 @@
-// Minimal HTTP/1.1 server-side protocol.
+// HTTP/1.x serving protocol — builtin services + RPC-over-HTTP + restful.
 //
 // Parity: brpc's http support (/root/reference/src/brpc/policy/
 // http_rpc_protocol.cpp + builtin services server.cpp:501-604): the same
 // port serves RPC framing AND HTTP — the messenger tries protocols in
 // registration order and pins the match (input_messenger.cpp:83).
-// Re-designed minimal: request-line + headers + Content-Length bodies;
-// keep-alive; no chunked/h2 yet.
+// Request parsing (chunked bodies, URIs, percent-decoding) lives in
+// net/http_message.*; this layer routes: builtin endpoints, restful
+// patterns (Server::MapRestful), then POST /Service.Method RPC access.
 #pragma once
 
+#include <string>
+
+#include "net/http_message.h"
 #include "net/protocol.h"
 
 namespace trpc {
@@ -15,9 +19,10 @@ namespace trpc {
 // Registers the HTTP protocol (idempotent).  Server::Start calls this.
 void register_http_protocol();
 
-// Builtin service dispatch: returns true if `path` was handled.
+// Builtin service dispatch (/vars, /status, /flags, ...).  Returns true
+// when the path is a builtin; fills status/body/content_type.
 class Server;
-bool builtin_http_dispatch(Server* srv, const std::string& path,
+bool builtin_http_dispatch(Server* srv, const HttpRequest& req, int* status,
                            std::string* body, std::string* content_type);
 
 }  // namespace trpc

@@ -40,13 +40,13 @@ void cut_and_dispatch(Socket* s, SocketId id) {
     msg->socket = id;
     ParseError rc = ParseError::kTryOtherProtocol;
     if (s->pinned_protocol >= 0) {
-      rc = protocol_at(s->pinned_protocol)->parse(&buf, msg);
+      rc = protocol_at(s->pinned_protocol)->parse(&buf, msg, s);
     } else {
       // Pin ONLY on a successful parse: with a partial prefix several
       // protocols may legitimately say "need more data", and pinning early
       // would misroute the connection once the real format shows.
       for (int i = 0; i < protocol_count(); ++i) {
-        rc = protocol_at(i)->parse(&buf, msg);
+        rc = protocol_at(i)->parse(&buf, msg, s);
         if (rc == ParseError::kOk) {
           s->pinned_protocol = i;
           break;

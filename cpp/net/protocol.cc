@@ -101,7 +101,7 @@ bool decode_meta(const std::string& s, RpcMeta* m) {
   return true;
 }
 
-ParseError tstd_parse(IOBuf* source, InputMessage* out) {
+ParseError tstd_parse(IOBuf* source, InputMessage* out, Socket*) {
   // Reject a wrong magic as soon as the available prefix disagrees, so the
   // messenger can offer the bytes to other protocols without waiting.
   char header[kHeaderLen];

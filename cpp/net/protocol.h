@@ -9,6 +9,7 @@
 #pragma once
 
 #include <cstdint>
+#include <memory>
 #include <string>
 
 #include "base/iobuf.h"
@@ -51,12 +52,18 @@ struct InputMessage {
   RpcMeta meta;
   IOBuf payload;  // body (+ attachment tail per meta.attachment_size)
   SocketId socket = 0;
+  // Protocol-private context (the reference subclasses InputMessageBase per
+  // protocol; an opaque pointer is the condensed seam).  HTTP stores its
+  // parsed HttpRequest here.
+  std::shared_ptr<void> ctx;
 };
 
 struct Protocol {
   const char* name;
   // Cuts ONE complete message off `source` (or reports NotEnoughData).
-  ParseError (*parse)(IOBuf* source, InputMessage* out);
+  // `sock` may be null (protocol unit tests); parsers use it only for
+  // incremental state (Socket::parse_state).
+  ParseError (*parse)(IOBuf* source, InputMessage* out, Socket* sock);
   // Server side: handle a request message (runs in its own fiber).
   void (*process_request)(InputMessage&& msg);
   // Client side: handle a response message.

@@ -6,6 +6,11 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
+#include <map>
+#include <mutex>
+
+#include "base/flags.h"
 #include "base/logging.h"
 #include "base/rand.h"
 #include "base/recordio.h"
@@ -37,6 +42,79 @@ Server::~Server() {
   Join();
 }
 
+namespace {
+std::vector<std::string> split_path(const std::string& p) {
+  std::vector<std::string> segs;
+  size_t pos = 0;
+  while (pos < p.size()) {
+    while (pos < p.size() && p[pos] == '/') {
+      ++pos;
+    }
+    size_t end = p.find('/', pos);
+    if (end == std::string::npos) {
+      end = p.size();
+    }
+    if (end > pos) {
+      segs.push_back(p.substr(pos, end - pos));
+    }
+    pos = end;
+  }
+  return segs;
+}
+}  // namespace
+
+int Server::MapRestful(const std::string& pattern, const std::string& method) {
+  if (running()) {
+    return -1;  // same contract as RegisterMethod: configure before Start
+  }
+  if (methods_.seek(method) == nullptr) {
+    return -1;  // map only registered methods
+  }
+  RestfulRule rule;
+  rule.segs = split_path(pattern);
+  if (!rule.segs.empty() && rule.segs.back() == "*") {
+    // A trailing '*' matches one-or-more remaining segments.
+    rule.tail_wild = true;
+    rule.segs.pop_back();
+  }
+  rule.method = method;
+  restful_.push_back(std::move(rule));
+  // Longest (most specific) pattern wins at lookup.
+  std::stable_sort(restful_.begin(), restful_.end(),
+                   [](const RestfulRule& a, const RestfulRule& b) {
+                     return a.segs.size() > b.segs.size();
+                   });
+  return 0;
+}
+
+const Server::MethodProperty* Server::find_restful(
+    const std::string& path, std::string* method_name) const {
+  if (restful_.empty()) {
+    return nullptr;
+  }
+  const std::vector<std::string> segs = split_path(path);
+  for (const RestfulRule& rule : restful_) {
+    if (rule.tail_wild ? segs.size() <= rule.segs.size()
+                       : segs.size() != rule.segs.size()) {
+      continue;
+    }
+    bool ok = true;
+    for (size_t i = 0; i < rule.segs.size(); ++i) {
+      if (rule.segs[i] != "*" && rule.segs[i] != segs[i]) {
+        ok = false;
+        break;
+      }
+    }
+    if (ok) {
+      if (method_name != nullptr) {
+        *method_name = rule.method;
+      }
+      return methods_.seek(rule.method);
+    }
+  }
+  return nullptr;
+}
+
 int Server::RegisterMethod(const std::string& full_name, Handler handler) {
   if (running()) {
     return -1;
@@ -63,6 +141,55 @@ int Server::SetMethodMaxConcurrency(const std::string& method,
     return -1;  // typo'd spec must not silently mean "unlimited"
   }
   prop->limiter = std::move(limiter);
+  // A constant bound is exposed as a reloadable flag so /flags?setvalue
+  // retargets the LIVE limiter (reloadable_flags.h + flags_service parity).
+  // Flags are process-global while limiters are per-Server: the update
+  // hook fans out to EVERY limiter ever bound to the name (weak refs, so
+  // dead servers drop out) instead of the latest binding hijacking it.
+  auto* constant = dynamic_cast<ConstantLimiter*>(prop->limiter.get());
+  if (constant != nullptr) {
+    std::string flag_name = "max_concurrency_" + method;
+    for (char& c : flag_name) {
+      if (c == '.') {
+        c = '_';
+      }
+    }
+    static std::mutex* bindings_mu = new std::mutex();
+    static auto* bindings =
+        new std::map<std::string,
+                     std::vector<std::weak_ptr<ConcurrencyLimiter>>>();
+    {
+      std::lock_guard<std::mutex> g(*bindings_mu);
+      (*bindings)[flag_name].push_back(prop->limiter);
+    }
+    Flag* f = Flag::define_int64(flag_name, constant->current_limit(),
+                                 "admission bound for " + method);
+    if (f != nullptr) {
+      f->set_validator([](const std::string& v) {
+        char* end = nullptr;
+        const long n = strtol(v.c_str(), &end, 10);
+        return end != v.c_str() && *end == '\0' && n > 0;
+      });
+      f->on_update([flag_name](Flag* self) {
+        std::lock_guard<std::mutex> g(*bindings_mu);
+        auto& vec = (*bindings)[flag_name];
+        for (auto it = vec.begin(); it != vec.end();) {
+          if (auto l = it->lock()) {
+            static_cast<ConstantLimiter*>(l.get())
+                ->set_limit(self->int64_value());
+            ++it;
+          } else {
+            it = vec.erase(it);
+          }
+        }
+      });
+      // Explicit configuration is authoritative: push this limit into the
+      // flag, which fans out to every limiter bound to the name (one knob,
+      // one value — a pre-existing flag's stale value must not silently
+      // override what this server just configured).
+      f->set_from_string(std::to_string(constant->current_limit()));
+    }
+  }
   return 0;
 }
 

@@ -11,6 +11,7 @@
 #include <functional>
 #include <memory>
 #include <string>
+#include <vector>
 
 #include "base/flat_map.h"
 #include "base/recordio.h"
@@ -46,6 +47,16 @@ class Server {
 
   // Register before Start.  Name format "Service.Method" by convention.
   int RegisterMethod(const std::string& full_name, Handler handler);
+
+  // Maps an HTTP path pattern onto a registered method (parity: the
+  // reference's RestfulMap, restful.h:62).  Patterns match whole path
+  // segments; '*' matches exactly one segment, a trailing '*' matches the
+  // remainder ("/v1/echo/*").  Call before Start.
+  int MapRestful(const std::string& pattern, const std::string& method);
+  // Method mapped by the best-matching pattern, or nullptr;
+  // *method_name receives the mapped method's registered name.
+  const MethodProperty* find_restful(const std::string& path,
+                                     std::string* method_name = nullptr) const;
 
   // port <= 0 picks an ephemeral port (see port() after).  Returns 0 on ok.
   int Start(int port);
@@ -86,6 +97,13 @@ class Server {
   std::atomic<double> dump_rate_{0.0};
 
   FlatMap<std::string, MethodProperty> methods_;
+  // (pattern segments, trailing-wildcard, method name), longest first.
+  struct RestfulRule {
+    std::vector<std::string> segs;
+    bool tail_wild = false;
+    std::string method;
+  };
+  std::vector<RestfulRule> restful_;
   SocketId listen_id_ = 0;
   int port_ = -1;
   std::atomic<bool> running_{false};

@@ -69,6 +69,7 @@ void Socket::reset_for_reuse(const Options& opts) {
   user_data = opts.user_data;
   wr_ev_.value.store(0, std::memory_order_relaxed);
   writing_.store(false, std::memory_order_relaxed);
+  parse_state.reset();
   wq_head_.store(nullptr, std::memory_order_relaxed);
 }
 
@@ -224,11 +225,11 @@ int Socket::ensure_connected() {
 
 // ---- wait-free write path ----------------------------------------------
 
-int Socket::Write(IOBuf&& data) {
+int Socket::Write(IOBuf&& data, bool close_after) {
   if (Failed()) {
     return -1;
   }
-  WriteNode* node = new WriteNode{std::move(data), nullptr};
+  WriteNode* node = new WriteNode{std::move(data), close_after, nullptr};
   WriteNode* old = wq_head_.load(std::memory_order_relaxed);
   do {
     node->next = old;
@@ -281,8 +282,10 @@ void Socket::keep_write() {
       fifo = chain;
       chain = next;
     }
+    bool close_after = false;
     while (fifo != nullptr) {
       pending.append(std::move(fifo->data));
+      close_after |= fifo->close_after;
       WriteNode* done = fifo;
       fifo = fifo->next;
       delete done;
@@ -312,6 +315,13 @@ void Socket::keep_write() {
         // is only noticed through Failed() re-checks.
         wait_writable(snap, monotonic_time_us() + 1000000);
       }
+    }
+    if (close_after) {
+      // This batch carried a Connection: close response and it has fully
+      // flushed — graceful close (anything enqueued after it is void).
+      drop_write_queue();
+      SetFailed(ESHUTDOWN);
+      return;
     }
   }
 }

@@ -65,7 +65,11 @@ class Socket {
   // Appends data to the wait-free write queue; the queue guarantees FIFO
   // per socket and writes happen in a KeepWrite fiber (first try inline).
   // Returns 0 if queued/sent, -1 if the socket is failed.
-  int Write(IOBuf&& data);
+  // close_after (Connection: close semantics) rides the write NODE — the
+  // socket fails itself only after this write (and everything queued with
+  // it) has flushed, so a racing drain of earlier responses can never
+  // close before this one leaves.
+  int Write(IOBuf&& data, bool close_after = false);
 
   int fd() const { return fd_; }
   SocketMode mode() const { return mode_; }
@@ -77,6 +81,10 @@ class Socket {
   int pinned_protocol = -1;
   void* user_data = nullptr;  // Server*/Channel* context, set by owner
   void* transport_ctx = nullptr;  // per-connection transport state
+  // Incremental parser state for protocols that need it (HTTP chunked
+  // bodies resume scanning instead of re-walking the buffer).  Owned by
+  // the read fiber; cleared on socket reuse.
+  std::shared_ptr<void> parse_state;
 
   // -- dispatcher integration (internal) -------------------------------
   void on_input_event();    // readable edge (any thread)
@@ -91,6 +99,7 @@ class Socket {
   friend class ResourcePool<Socket>;
   struct WriteNode {
     IOBuf data;
+    bool close_after = false;
     WriteNode* next = nullptr;
   };
 

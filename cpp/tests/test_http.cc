@@ -5,7 +5,9 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <string>
+#include <thread>
 
 #include "base/time.h"
 #include "net/channel.h"
@@ -29,6 +31,15 @@ void start_once() {
     resp->append(req);
     done();
   });
+  g_server->RegisterMethod("Gate.Slow", [](Controller*, const IOBuf& req,
+                                           IOBuf* resp, Closure done) {
+    usleep(50 * 1000);
+    resp->append(req);
+    done();
+  });
+  EXPECT_EQ(g_server->SetMethodMaxConcurrency("Gate.Slow", "2"), 0);
+  EXPECT_EQ(g_server->MapRestful("/v1/echo/*", "Echo.Echo"), 0);
+  EXPECT_EQ(g_server->MapRestful("/v1/ping", "Echo.Echo"), 0);
   EXPECT_EQ(g_server->Start(0), 0);
   g_port = g_server->port();
 }
@@ -147,6 +158,197 @@ TEST_CASE(mixed_protocols_one_port) {
     const std::string r = http_get("GET /health HTTP/1.1\r\nHost: x\r\n\r\n");
     EXPECT(r.find("200 OK") != std::string::npos);
   }
+}
+
+TEST_CASE(chunked_request_body) {
+  // Transfer-Encoding: chunked, decoded and delivered to the method.
+  const std::string req =
+      "POST /Echo.Echo HTTP/1.1\r\nHost: x\r\n"
+      "Transfer-Encoding: chunked\r\n\r\n"
+      "4\r\nWiki\r\n5\r\npedia\r\nE\r\n in\r\n\r\nchunks.\r\n"
+      "0\r\n\r\n";
+  const std::string r = http_get(req);
+  EXPECT(r.find("200 OK") != std::string::npos);
+  EXPECT(r.find("Wikipedia in\r\n\r\nchunks.") != std::string::npos);
+}
+
+TEST_CASE(smuggling_vectors_rejected) {
+  // Duplicate Content-Length and chunked+Content-Length both desync
+  // framing: the server must kill the connection, not guess.
+  for (const char* req :
+       {"POST /Echo.Echo HTTP/1.1\r\nHost: x\r\nContent-Length: 3\r\n"
+        "Content-Length: 5\r\n\r\nabcde",
+        "POST /Echo.Echo HTTP/1.1\r\nHost: x\r\nContent-Length: 5\r\n"
+        "Transfer-Encoding: chunked\r\n\r\n5\r\nabcde\r\n0\r\n\r\n"}) {
+    const std::string r = http_get(req);
+    EXPECT(r.empty());  // connection killed without a response
+  }
+}
+
+TEST_CASE(uri_query_and_percent_decoding) {
+  start_once();
+  // Unknown flag name exercises the decoded single-target path.
+  std::string r = http_get(
+      "GET /flags/no%20such%20flag HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("404") != std::string::npos);
+  EXPECT(r.find("no such flag: no such flag") != std::string::npos);
+}
+
+TEST_CASE(restful_mapping) {
+  start_once();
+  std::string body = "restful!";
+  std::string req =
+      "POST /v1/echo/anything HTTP/1.1\r\nHost: x\r\nContent-Length: " +
+      std::to_string(body.size()) + "\r\n\r\n" + body;
+  std::string r = http_get(req);
+  EXPECT(r.find("200 OK") != std::string::npos);
+  EXPECT(r.find(body) != std::string::npos);
+  // Exact rule.
+  req = "POST /v1/ping HTTP/1.1\r\nHost: x\r\nContent-Length: 2\r\n\r\nok";
+  r = http_get(req);
+  EXPECT(r.find("200 OK") != std::string::npos);
+  // Prefix alone (no extra segment) does NOT match the wildcard rule.
+  r = http_get("GET /v1/echo HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("404") != std::string::npos);
+}
+
+TEST_CASE(head_and_connection_close) {
+  start_once();
+  // HEAD: headers with the body's Content-Length but no body bytes.
+  const int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in sa = {};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  sa.sin_port = htons(static_cast<uint16_t>(g_port));
+  EXPECT_EQ(connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)), 0);
+  const std::string req =
+      "HEAD /health HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n";
+  EXPECT(write(fd, req.data(), req.size()) ==
+         static_cast<ssize_t>(req.size()));
+  std::string out;
+  char buf[2048];
+  ssize_t n;
+  while ((n = read(fd, buf, sizeof(buf))) > 0) {
+    out.append(buf, n);  // server must close (EOF ends this loop)
+  }
+  close(fd);
+  EXPECT(out.find("200 OK") != std::string::npos);
+  EXPECT(out.find("Content-Length: 3") != std::string::npos);
+  EXPECT(out.find("Connection: close") != std::string::npos);
+  EXPECT(out.find("OK\n") == std::string::npos);  // no body after HEAD
+}
+
+TEST_CASE(flags_list_get_set_live_limiter) {
+  start_once();
+  // Listed.
+  std::string r = http_get("GET /flags HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("max_concurrency_Gate_Slow = 2") != std::string::npos);
+  // Get one.
+  r = http_get("GET /flags/max_concurrency_Gate_Slow HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("= 2") != std::string::npos);
+  // Bad value rejected by the validator.
+  r = http_get(
+      "GET /flags/max_concurrency_Gate_Slow?setvalue=-3 HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("400") != std::string::npos);
+  // Flip to 1 and verify the LIVE limiter tightened: two concurrent slow
+  // calls must now collide (one 503).
+  r = http_get(
+      "GET /flags/max_concurrency_Gate_Slow?setvalue=1 HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("= 1") != std::string::npos);
+  std::atomic<int> ok{0}, rejected{0};
+  std::thread t1([&] {
+    const std::string body = "a";
+    const std::string rq =
+        "POST /Gate.Slow HTTP/1.1\r\nHost: x\r\nContent-Length: 1\r\n\r\na";
+    const std::string rr = http_get(rq);
+    (rr.find("200 OK") != std::string::npos ? ok : rejected).fetch_add(1);
+  });
+  usleep(10 * 1000);  // first call is in the 50ms handler
+  const std::string rr2 = http_get(
+      "POST /Gate.Slow HTTP/1.1\r\nHost: x\r\nContent-Length: 1\r\n\r\nb");
+  (rr2.find("200 OK") != std::string::npos ? ok : rejected).fetch_add(1);
+  t1.join();
+  EXPECT_EQ(ok.load(), 1);
+  EXPECT_EQ(rejected.load(), 1);
+  // Restore for other tests.
+  http_get("GET /flags/max_concurrency_Gate_Slow?setvalue=2 HTTP/1.1\r\nHost: x\r\n\r\n");
+}
+
+TEST_CASE(chunked_trickled_bytes_resume) {
+  start_once();
+  // The chunked body arrives in many tiny segments: the resumable parser
+  // state (Socket::parse_state) must assemble it across retries.
+  const int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in sa = {};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  sa.sin_port = htons(static_cast<uint16_t>(g_port));
+  EXPECT_EQ(connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)), 0);
+  std::string payload;
+  std::string wire =
+      "POST /Echo.Echo HTTP/1.1\r\nHost: x\r\n"
+      "Transfer-Encoding: chunked\r\n\r\n";
+  for (int i = 0; i < 64; ++i) {
+    const std::string chunk = "chunk-" + std::to_string(i) + "-payload";
+    payload += chunk;
+    char size_hex[16];
+    snprintf(size_hex, sizeof(size_hex), "%zx", chunk.size());
+    wire += std::string(size_hex) + "\r\n" + chunk + "\r\n";
+  }
+  wire += "0\r\nX-Trailer: ignored\r\n\r\n";
+  for (size_t off = 0; off < wire.size(); off += 7) {
+    const size_t n = std::min<size_t>(7, wire.size() - off);
+    EXPECT(write(fd, wire.data() + off, n) == static_cast<ssize_t>(n));
+    if (off % 70 == 0) {
+      usleep(1000);  // force separate reads server-side
+    }
+  }
+  std::string out;
+  char buf[8192];
+  while (true) {
+    const ssize_t n = read(fd, buf, sizeof(buf));
+    if (n <= 0) {
+      break;
+    }
+    out.append(buf, n);
+    if (out.find(payload) != std::string::npos) {
+      break;
+    }
+  }
+  close(fd);
+  EXPECT(out.find("200 OK") != std::string::npos);
+  EXPECT(out.find(payload) != std::string::npos);
+}
+
+TEST_CASE(chunked_trailer_bomb_rejected) {
+  start_once();
+  // An endless trailer stream must kill the connection (bounded memory),
+  // not buffer forever.
+  const int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in sa = {};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  sa.sin_port = htons(static_cast<uint16_t>(g_port));
+  EXPECT_EQ(connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)), 0);
+  std::string wire =
+      "POST /Echo.Echo HTTP/1.1\r\nHost: x\r\n"
+      "Transfer-Encoding: chunked\r\n\r\n2\r\nhi\r\n0\r\n";
+  EXPECT(write(fd, wire.data(), wire.size()) ==
+         static_cast<ssize_t>(wire.size()));
+  // Pump >16KB of trailer lines, never the terminating CRLF.
+  const std::string line = "X-Bomb: " + std::string(120, 'b') + "\r\n";
+  bool killed = false;
+  for (int i = 0; i < 400 && !killed; ++i) {
+    if (write(fd, line.data(), line.size()) !=
+        static_cast<ssize_t>(line.size())) {
+      killed = true;  // server closed on us mid-write
+    }
+  }
+  // Server must close the connection (read returns EOF), with no response.
+  char buf[256];
+  const ssize_t n = read(fd, buf, sizeof(buf));
+  EXPECT(n <= 0);
+  close(fd);
 }
 
 TEST_MAIN

@@ -40,7 +40,7 @@ int main(int argc, char** argv) {
   IOBuf record;
   while (reader.read(&record)) {
     InputMessage msg;
-    if (tstd_protocol().parse(&record, &msg) != ParseError::kOk) {
+    if (tstd_protocol().parse(&record, &msg, nullptr) != ParseError::kOk) {
       fprintf(stderr, "corrupt record #%ld, stopping\n", sent);
       break;
     }
