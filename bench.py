@@ -1,16 +1,31 @@
 #!/usr/bin/env python3
-"""Headline benchmark: on-device echo goodput.
+"""Headline benchmark: echo goodput + RTT percentiles, 1KB-64MB sweep.
 
-Mirrors the reference's headline (BASELINE.md): 2.3 GB/s max echo throughput
-on its 2012-era test box (docs/cn/benchmark.md:104).  Here the echo data
-plane is HBM-resident: one jitted step receives the 64MB payload, produces
-the response copy, and checksums it — the single-chip form of the ICI echo
-path.  Prints ONE JSON line.
+BASELINE.json's metric is rpc_press-style goodput AND p99 RTT across
+1KB-64MB echo (the reference measures both: docs/cn/benchmark.md:104 for
+the 2.3 GB/s pooled-connection headline, example/rdma_performance/client.cpp
+for the per-size attachment echo sweep). This driver measures the same
+two quantities on the TPU data plane:
+
+- per size in {1KB .. 64MB}: RTT percentiles (p50/p99 over synchronous,
+  device-blocking echo steps) and goodput (chained steps, one sync at the
+  end, each iteration data-dependent on the last so nothing overlaps or
+  folds away);
+- the fused Pallas kernel (one HBM pass for copy+checksum) carries sizes
+  it tiles; smaller payloads use the jitted XLA echo step;
+- the C++ runtime's loopback numbers (bench_echo: 64-fiber sync echo via
+  Server/Channel, the multi_threaded_echo_c++ analogue) ride along under
+  "cpp" when the binary exists.
+
+Prints ONE JSON line. Headline metric stays the 64MB echo goodput vs the
+reference's 2.3 GB/s; the sweep rows are under "sweep".
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import time
 
 import jax
@@ -19,44 +34,99 @@ import jax.numpy as jnp
 from brpc_tpu.models.echo import single_chip_echo_step
 
 BASELINE_GBPS = 2.3
-PAYLOAD_BYTES = 64 * 1024 * 1024
-ITERS = 30
+SIZES = [1 << 10, 1 << 16, 1 << 20, 1 << 24, 1 << 26]  # 1KB .. 64MB
+FUSED_MIN_BYTES = 1 << 20  # fused kernel tiles 256KB blocks; use it from 1MB
 
 
-def _step_fn():
-    """Prefer the fused Pallas kernel (one HBM pass) on TPU.  The off-TPU
-    fallback (roll-based) does different work — the recorded metric is the
-    TPU number."""
-    if jax.devices()[0].platform == "tpu":
+def _steps():
+    """size_bytes -> jitted echo step (payload: uint32[size/4])."""
+    on_tpu = jax.devices()[0].platform == "tpu"
+    fused = None
+    if on_tpu:
         from brpc_tpu.ops.echo_kernel import echo_fused
 
-        return jax.jit(echo_fused, donate_argnums=0)
-    return jax.jit(single_chip_echo_step, donate_argnums=0)
+        fused = jax.jit(echo_fused, donate_argnums=0)
+    plain = jax.jit(single_chip_echo_step, donate_argnums=0)
+
+    def pick(size: int):
+        if fused is not None and size >= FUSED_MIN_BYTES:
+            return fused
+        return plain
+
+    return pick
 
 
-def main() -> None:
-    payload = jnp.arange(PAYLOAD_BYTES // 4, dtype=jnp.uint32)
-    step = _step_fn()
-    # Warm up + compile.
-    resp, csum = step(payload)
+def _bench_size(step, size: int) -> dict:
+    lanes = size // 4
+    payload = jnp.arange(lanes, dtype=jnp.uint32)
+    resp, csum = step(payload)  # compile + warm
     jax.block_until_ready((resp, csum))
 
-    # Chain each echo on the previous response so iterations cannot overlap
-    # or be deduplicated — every step really moves the payload through HBM.
+    # RTT: synchronous steps, blocking per call — the per-call latency a
+    # client of the device data plane observes.
+    iters_lat = max(20, min(200, (16 << 20) // size))
+    lats = []
+    for _ in range(iters_lat):
+        t0 = time.perf_counter()
+        resp, csum = step(resp)
+        jax.block_until_ready(csum)
+        lats.append(time.perf_counter() - t0)
+    lats.sort()
+
+    # Goodput: chained (each iteration consumes the previous response), one
+    # sync at the end.
+    iters_tp = max(10, min(300, (256 << 20) // size))
     t0 = time.perf_counter()
-    for _ in range(ITERS):
+    for _ in range(iters_tp):
         resp, csum = step(resp)
     jax.block_until_ready((resp, csum))
     dt = time.perf_counter() - t0
 
-    gbps = PAYLOAD_BYTES * ITERS / dt / 1e9
+    def pct(p: float) -> float:
+        return lats[min(len(lats) - 1, int(p * len(lats)))]
+
+    return {
+        "size": size,
+        "goodput_gbps": round(size * iters_tp / dt / 1e9, 3),
+        "p50_us": round(pct(0.50) * 1e6, 1),
+        "p99_us": round(pct(0.99) * 1e6, 1),
+    }
+
+
+def _cpp_rows() -> list:
+    """Loopback numbers from the C++ runtime (multi_threaded_echo analogue);
+    skipped when the binary isn't built."""
+    exe = os.path.join(os.path.dirname(os.path.abspath(__file__)), "build",
+                       "bench_echo")
+    if not os.path.exists(exe):
+        return []
+    rows = []
+    for fibers, payload in ((64, 1024), (8, 2 << 20)):
+        try:
+            out = subprocess.run(
+                [exe, str(fibers), str(payload), "2"],
+                capture_output=True, text=True, timeout=60,
+            )
+            line = out.stdout.strip().splitlines()[-1]
+            rows.append(json.loads(line))
+        except Exception:  # noqa: BLE001 — bench must still print its line
+            pass
+    return rows
+
+
+def main() -> None:
+    pick = _steps()
+    sweep = [_bench_size(pick(size), size) for size in SIZES]
+    head = sweep[-1]  # 64MB row
     print(
         json.dumps(
             {
                 "metric": "echo_goodput_64MB",
-                "value": round(gbps, 3),
+                "value": head["goodput_gbps"],
                 "unit": "GB/s",
-                "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+                "vs_baseline": round(head["goodput_gbps"] / BASELINE_GBPS, 3),
+                "sweep": sweep,
+                "cpp": _cpp_rows(),
             }
         )
     )

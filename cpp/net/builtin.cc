@@ -14,6 +14,7 @@
 #include "fiber/fiber.h"
 #include "net/http_protocol.h"
 #include "net/server.h"
+#include "net/span.h"
 #include "stat/variable.h"
 
 namespace trpc {
@@ -146,6 +147,41 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req, int* status,
             "\n";
     return true;
   }
+  if (path == "/rpcz") {
+    if (!rpcz_enabled()) {
+      *body =
+          "rpcz is off; enable with /flags/rpcz_enabled?setvalue=true\n";
+      return true;
+    }
+    uint64_t want_trace = 0;
+    const std::string* tq = req.query("trace_id");
+    if (tq != nullptr) {
+      want_trace = strtoull(tq->c_str(), nullptr, 16);
+    }
+    char line[512];
+    std::string out =
+        "trace_id         span_id          parent           side   latency_us"
+        " err  method (annotations)\n";
+    for (const Span& s : recent_spans(200, want_trace)) {
+      snprintf(line, sizeof(line),
+               "%016llx %016llx %016llx %-6s %10lld %4d  %s",
+               static_cast<unsigned long long>(s.trace_id),
+               static_cast<unsigned long long>(s.span_id),
+               static_cast<unsigned long long>(s.parent_span_id),
+               s.server_side ? "server" : "client",
+               static_cast<long long>(s.end_us - s.start_us), s.error_code,
+               s.method.c_str());
+      out += line;
+      for (const auto& [ts, text] : s.annotations) {
+        snprintf(line, sizeof(line), " [+%lldus %s]",
+                 static_cast<long long>(ts - s.start_us), text.c_str());
+        out += line;
+      }
+      out += "\n";
+    }
+    *body = std::move(out);
+    return true;
+  }
   if (path == "/threads") {
     *body = "fiber_workers " + std::to_string(fiber_worker_count()) +
             "\nos_threads " + std::to_string(proc_status_kb("Threads:")) +
@@ -170,7 +206,7 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req, int* status,
     *body =
         "/health\n/version\n/status\n/vars\n/vars/<name>\n/brpc_metrics\n"
         "/connections\n/flags\n/flags/<name>[?setvalue=v]\n/threads\n"
-        "/memory\n/list\n/protobufs\n/index\n";
+        "/memory\n/list\n/protobufs\n/index\n/rpcz[?trace_id=hex]\n";
     return true;
   }
   (void)content_type;

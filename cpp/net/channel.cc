@@ -9,6 +9,7 @@
 #include "net/messenger.h"
 #include "net/protocol.h"
 #include "net/shm_transport.h"
+#include "net/span.h"
 #include "net/stream.h"
 
 namespace trpc {
@@ -21,6 +22,14 @@ namespace {
 // (controller.cpp:611): state is finalized before anyone can observe it.
 void complete_locked_call(fid_t cid, Controller* cntl) {
   cntl->set_latency_us(monotonic_time_us() - cntl->call().start_us);
+  auto* span = static_cast<Span*>(cntl->call().span);
+  if (span != nullptr) {
+    cntl->call().span = nullptr;
+    if (cntl->call().response != nullptr) {
+      span->response_bytes = cntl->call().response->size();
+    }
+    submit_span(span, cntl->error_code());
+  }
   const uint64_t timer = cntl->call().timeout_timer;
   Closure done = std::move(cntl->call().done);
   fid_unlock_and_destroy(cid);
@@ -159,10 +168,22 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
   cntl->call().done = std::move(done);
   cntl->call().start_us = monotonic_time_us();
   const bool sync = !cntl->call().done;
+  // rpcz: client span; a handler fiber's ambient server span becomes the
+  // parent (channel.cpp:506-527 parity).
+  Span* span = nullptr;
+  if (rpcz_enabled()) {
+    span = start_span(/*server_side=*/false, method);
+    span->request_bytes = request.size();
+    cntl->call().span = span;
+  }
 
   fid_t cid = 0;
   if (fid_create(&cid, cntl, on_call_error) != 0) {
     cntl->SetFailed(ENOMEM, "out of call ids");
+    if (span != nullptr) {
+      cntl->call().span = nullptr;  // never reaches complete_locked_call
+      submit_span(span, ENOMEM);
+    }
     if (!sync && cntl->call().done) {
       cntl->call().done();
     }
@@ -200,6 +221,11 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
   meta.stream_id = cntl->call().offered_stream;  // stream offer piggyback
   if (meta.stream_id != 0) {
     meta.ack_bytes = stream_recv_window(meta.stream_id);  // advertise window
+  }
+  if (span != nullptr) {
+    meta.trace_id = span->trace_id;   // server links as our child
+    meta.span_id = span->span_id;
+    span_annotate(span, "request packed");
   }
   IOBuf body = request;  // zero-copy share
   if (!cntl->request_attachment().empty()) {

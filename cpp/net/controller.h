@@ -59,6 +59,7 @@ class Controller {
   struct CallState {
     fid_t cid = 0;
     uint64_t timeout_timer = 0;
+    void* span = nullptr;  // rpcz client Span (owned until submit)
     IOBuf* response = nullptr;
     Closure done;
     int64_t start_us = 0;

@@ -63,6 +63,15 @@ std::string encode_meta(const RpcMeta& m) {
   s.append(m.method);
   put_u32(&s, static_cast<uint32_t>(m.error_text.size()));
   s.append(m.error_text);
+  // Trace-context tail, only when a trace is active: decoders treat it
+  // as optional (they read by field lengths and only look past
+  // error_text when bytes remain), so presence/absence are both
+  // wire-compatible — and the streaming hot path never pays for it.
+  if (m.trace_id != 0) {
+    put_u64(&s, m.trace_id);
+    put_u64(&s, m.span_id);
+    put_u64(&s, m.parent_span_id);
+  }
   return s;
 }
 
@@ -98,6 +107,12 @@ bool decode_meta(const std::string& s, RpcMeta* m) {
     return false;
   }
   m->error_text.assign(p, elen);
+  p += elen;
+  if (end - p >= 24) {  // optional trace-context tail
+    m->trace_id = get_u64(p);
+    m->span_id = get_u64(p + 8);
+    m->parent_span_id = get_u64(p + 16);
+  }
   return true;
 }
 

@@ -44,6 +44,12 @@ struct RpcMeta {
   uint64_t stream_id = 0;
   uint8_t stream_flags = 0;
   uint64_t ack_bytes = 0;
+  // rpcz trace context (span.h parity: trace_id/span_id/parent propagate
+  // inside the meta like the reference's RpcMeta).  Optional wire tail —
+  // absent (zero) when the peer predates it or rpcz is off.
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+  uint64_t parent_span_id = 0;
   std::string method;
   std::string error_text;
 };

@@ -19,6 +19,7 @@
 #include "net/http_protocol.h"
 #include "net/messenger.h"
 #include "net/shm_transport.h"
+#include "net/span.h"
 #include "net/stream.h"
 #include "net/protocol.h"
 
@@ -388,6 +389,30 @@ void tstd_process_request(InputMessage&& msg) {
   cntl->call().peer_stream_window = msg.meta.ack_bytes;
   auto* response = new IOBuf();
   const int64_t start_us = monotonic_time_us();
+  // rpcz: server span, linked to the client span via the meta's trace
+  // context (baidu_rpc_protocol.cpp:648-661 parity).  Ambient context
+  // makes client calls issued from inside the handler children of this
+  // span.
+  Span* span = nullptr;
+  if (rpcz_enabled()) {
+    span = start_span(/*server_side=*/true, method, msg.meta.trace_id,
+                      msg.meta.span_id);
+    span->request_bytes = msg.payload.size();
+    set_ambient_span(span);
+  }
+  // The ambient context must be cleared by THIS fiber on every exit path
+  // (the read fiber processes the last message of a batch inline and then
+  // keeps serving the connection — stale ambient would leak into later
+  // requests).  The done closure may run on a different fiber entirely,
+  // so it is the wrong place to clear.
+  struct AmbientGuard {
+    bool active;
+    ~AmbientGuard() {
+      if (active) {
+        set_ambient_span(nullptr);
+      }
+    }
+  } ambient_guard{span != nullptr};
   const Server::MethodProperty* prop =
       (srv != nullptr && srv->running()) ? srv->find_method(method) : nullptr;
   std::shared_ptr<LatencyRecorder> lat =
@@ -405,7 +430,7 @@ void tstd_process_request(InputMessage&& msg) {
     srv->in_flight.fetch_add(1, std::memory_order_acq_rel);
   }
   Closure done = [socket_id, cid, cntl, response, start_us, srv, lat,
-                  limiter] {
+                  limiter, span] {
     RpcMeta meta;
     meta.type = RpcMeta::kResponse;
     meta.correlation_id = cid;
@@ -432,6 +457,10 @@ void tstd_process_request(InputMessage&& msg) {
     }
     if (lat != nullptr) {
       *lat << latency_us;
+    }
+    if (span != nullptr) {
+      span->response_bytes = response->size();
+      submit_span(span, cntl->error_code());
     }
     delete response;
     delete cntl;

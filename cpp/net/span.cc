@@ -1,0 +1,153 @@
+#include "net/span.h"
+
+#include <mutex>
+
+#include "base/flags.h"
+#include "base/rand.h"
+#include "base/time.h"
+#include "fiber/fiber.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr size_t kRingSize = 4096;
+
+Flag* rpcz_flag() {
+  static Flag* f = Flag::define_bool(
+      "rpcz_enabled", false,
+      "collect per-RPC spans, browsable via /rpcz (reference: -enable_rpcz)");
+  return f;
+}
+
+// Leaked ring of finished spans (runtime registries outlive statics).
+std::mutex& ring_mu() {
+  static std::mutex* mu = new std::mutex();
+  return *mu;
+}
+struct SpanRing {
+  std::vector<Span> slots{kRingSize};
+  size_t next = 0;
+  size_t count = 0;
+};
+SpanRing& ring() {
+  static SpanRing* r = new SpanRing();
+  return *r;
+}
+
+// Ambient (fiber-local) trace context.  Stored by VALUE in two u64s
+// packed into the fls pointer slots (the Span itself may die before a
+// child fiber reads the context).
+struct Ambient {
+  uint64_t trace_id;
+  uint64_t span_id;
+};
+
+void ambient_dtor(void* p) { delete static_cast<Ambient*>(p); }
+
+fls_key_t ambient_key() {
+  static fls_key_t key = [] {
+    fls_key_t k;
+    fls_key_create(&k, ambient_dtor);
+    return k;
+  }();
+  return key;
+}
+
+}  // namespace
+
+bool rpcz_enabled() { return rpcz_flag()->bool_value(); }
+
+uint64_t new_span_id() {
+  uint64_t id;
+  do {
+    id = fast_rand();
+  } while (id == 0);
+  return id;
+}
+
+Span* start_span(bool server_side, const std::string& method,
+                 uint64_t trace_id, uint64_t parent_span_id) {
+  auto* s = new Span();
+  s->server_side = server_side;
+  s->method = method;
+  s->start_us = monotonic_time_us();
+  s->span_id = new_span_id();
+  if (trace_id != 0) {
+    s->trace_id = trace_id;
+    s->parent_span_id = parent_span_id;
+  } else {
+    uint64_t amb_trace = 0;
+    uint64_t amb_span = 0;
+    get_ambient_trace(&amb_trace, &amb_span);
+    if (amb_trace != 0) {
+      s->trace_id = amb_trace;
+      s->parent_span_id = amb_span;
+    } else {
+      s->trace_id = new_span_id();  // fresh trace rooted here
+    }
+  }
+  return s;
+}
+
+void span_annotate(Span* s, const std::string& text) {
+  if (s != nullptr) {
+    s->annotations.emplace_back(monotonic_time_us(), text);
+  }
+}
+
+void submit_span(Span* s, int32_t error_code) {
+  if (s == nullptr) {
+    return;
+  }
+  s->end_us = monotonic_time_us();
+  s->error_code = error_code;
+  {
+    std::lock_guard<std::mutex> g(ring_mu());
+    SpanRing& r = ring();
+    r.slots[r.next] = std::move(*s);
+    r.next = (r.next + 1) % kRingSize;
+    if (r.count < kRingSize) {
+      ++r.count;
+    }
+  }
+  delete s;
+}
+
+void set_ambient_span(const Span* s) {
+  auto* prev = static_cast<Ambient*>(fls_get(ambient_key()));
+  delete prev;
+  if (s == nullptr) {
+    fls_set(ambient_key(), nullptr);
+    return;
+  }
+  fls_set(ambient_key(), new Ambient{s->trace_id, s->span_id});
+}
+
+void get_ambient_trace(uint64_t* trace_id, uint64_t* span_id) {
+  auto* a = static_cast<Ambient*>(fls_get(ambient_key()));
+  if (a == nullptr) {
+    *trace_id = 0;
+    *span_id = 0;
+    return;
+  }
+  *trace_id = a->trace_id;
+  *span_id = a->span_id;
+}
+
+std::vector<Span> recent_spans(size_t limit, uint64_t trace_id) {
+  std::vector<Span> out;
+  std::lock_guard<std::mutex> g(ring_mu());
+  const SpanRing& r = ring();
+  for (size_t i = 0; i < r.count && out.size() < limit; ++i) {
+    // Newest first: walk backward from next-1.
+    const size_t idx = (r.next + kRingSize - 1 - i) % kRingSize;
+    const Span& s = r.slots[idx];
+    if (trace_id == 0 || s.trace_id == trace_id) {
+      out.push_back(s);
+    }
+  }
+  return out;
+}
+
+}  // namespace trpc

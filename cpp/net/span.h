@@ -1,0 +1,60 @@
+// rpcz tracing spans — per-RPC timing records with trace propagation.
+//
+// Parity: the reference's Span machinery (/root/reference/src/brpc/
+// span.h:52-88: CreateClientSpan/CreateServerSpan wired at
+// channel.cpp:506-527 and baidu_rpc_protocol.cpp:648-661; trace context
+// trace_id/span_id/parent_span_id rides inside the RpcMeta; spans browsed
+// via /rpcz, builtin/rpcz_service.*).  Redesigned condensed: spans land in
+// a fixed-size in-memory ring (the reference persists to a per-process
+// leveldb — an embedded KV store is out of scope; the ring holds the
+// recent window /rpcz actually shows), collection is gated by the
+// reloadable flag `rpcz_enabled`, and the ambient trace context lives in
+// fiber-local storage so nested client calls inherit the server span.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace trpc {
+
+struct Span {
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+  uint64_t parent_span_id = 0;
+  bool server_side = false;
+  std::string method;
+  int64_t start_us = 0;
+  int64_t end_us = 0;
+  int32_t error_code = 0;
+  uint64_t request_bytes = 0;
+  uint64_t response_bytes = 0;
+  std::vector<std::pair<int64_t, std::string>> annotations;
+};
+
+// True when span collection is on (flag `rpcz_enabled`, default off —
+// same default as the reference's -enable_rpcz).
+bool rpcz_enabled();
+
+// Starts a span.  trace_id/parent resolution order: explicit args (from
+// wire meta) > ambient fiber context > fresh trace.  The returned span is
+// owned by the caller until submit_span.
+Span* start_span(bool server_side, const std::string& method,
+                 uint64_t trace_id = 0, uint64_t parent_span_id = 0);
+void span_annotate(Span* s, const std::string& text);
+// Finishes the span and moves it into the ring (frees it).
+void submit_span(Span* s, int32_t error_code);
+
+// Ambient trace context (fiber-local): the server span a request handler
+// runs under; client spans started on this fiber become its children.
+void set_ambient_span(const Span* s);  // nullptr clears
+void get_ambient_trace(uint64_t* trace_id, uint64_t* span_id);
+
+// /rpcz support: most-recent spans, newest first (bounded by ring size);
+// trace_id filter when nonzero.
+std::vector<Span> recent_spans(size_t limit, uint64_t trace_id = 0);
+
+uint64_t new_span_id();
+
+}  // namespace trpc

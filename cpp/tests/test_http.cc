@@ -351,4 +351,64 @@ TEST_CASE(chunked_trailer_bomb_rejected) {
   close(fd);
 }
 
+TEST_CASE(rpcz_linked_spans) {
+  start_once();
+  // Off by default.
+  std::string r = http_get("GET /rpcz HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("rpcz is off") != std::string::npos);
+  // Flip on, make a call, expect a linked client+server pair.
+  http_get("GET /flags/rpcz_enabled?setvalue=true HTTP/1.1\r\nHost: x\r\n\r\n");
+  Channel ch;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(g_port)), 0);
+  Controller cntl;
+  IOBuf req, resp;
+  req.append("traced");
+  ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+  // The server submits its span AFTER writing the response; poll briefly
+  // so a preempted server fiber can finish.
+  bool linked = false;
+  std::string client_trace, client_span;
+  for (int attempt = 0; attempt < 50 && !linked; ++attempt) {
+    usleep(10 * 1000);
+    r = http_get("GET /rpcz HTTP/1.1\r\nHost: x\r\n\r\n");
+  // Find the client span's trace id and check a server span shares it
+  // with parent == client span id.
+  size_t pos = 0;
+  client_trace.clear();
+  while (true) {
+    const size_t line_start = r.find('\n', pos);
+    if (line_start == std::string::npos) {
+      break;
+    }
+    pos = line_start + 1;
+    const std::string line = r.substr(pos, r.find('\n', pos) - pos);
+    if (line.size() > 57 && line.find("client") != std::string::npos &&
+        line.find("Echo.Echo") != std::string::npos) {
+      client_trace = line.substr(0, 16);
+      client_span = line.substr(17, 16);
+    }
+  }
+  if (client_trace.empty()) {
+    continue;
+  }
+  pos = 0;
+  while (true) {
+    const size_t nl = r.find('\n', pos);
+    if (nl == std::string::npos) {
+      break;
+    }
+    const std::string line = r.substr(pos, nl - pos);
+    pos = nl + 1;
+    if (line.size() > 57 && line.substr(0, 16) == client_trace &&
+        line.substr(34, 16) == client_span &&
+        line.find("server") != std::string::npos) {
+      linked = true;
+    }
+  }
+  }
+  EXPECT(linked);
+  http_get("GET /flags/rpcz_enabled?setvalue=false HTTP/1.1\r\nHost: x\r\n\r\n");
+}
+
 TEST_MAIN
