@@ -26,6 +26,7 @@ from __future__ import annotations
 import json
 import os
 import subprocess
+import sys
 import time
 
 import jax
@@ -114,7 +115,9 @@ def _cpp_rows() -> list:
     return rows
 
 
-def main() -> None:
+def _run_sweep() -> None:
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
     pick = _steps()
     sweep = [_bench_size(pick(size), size) for size in SIZES]
     head = sweep[-1]  # 64MB row
@@ -125,10 +128,65 @@ def main() -> None:
                 "value": head["goodput_gbps"],
                 "unit": "GB/s",
                 "vs_baseline": round(head["goodput_gbps"] / BASELINE_GBPS, 3),
+                "platform": jax.devices()[0].platform,
                 "sweep": sweep,
                 "cpp": _cpp_rows(),
             }
         )
+    )
+
+
+def main() -> None:
+    if os.environ.get("BENCH_CHILD"):
+        _run_sweep()
+        return
+    # Watchdog: the axon TPU tunnel can wedge hard (uninterruptible hangs
+    # inside backend init).  Run the sweep in a child with a deadline; if
+    # the TPU leg never completes, fall back to a CPU run so the driver
+    # always records a JSON line (marked by "platform").
+    here = os.path.abspath(__file__)
+    last_err = ""
+    for attempt_env, deadline in (({}, 420), ({"BENCH_FORCE_CPU": "1"}, 300)):
+        env = dict(os.environ)
+        env["BENCH_CHILD"] = "1"
+        env.update(attempt_env)
+        # Own session so the whole group can be SIGKILLed; and do NOT
+        # block on reaping — a child wedged in uninterruptible TPU-init
+        # sleep may ignore even SIGKILL, and waiting on it would hang the
+        # watchdog in exactly the scenario it guards against.
+        with open("/tmp/bench_child.out", "w+") as out_f, open(
+            "/tmp/bench_child.err", "w+"
+        ) as err_f:
+            child = subprocess.Popen(
+                [sys.executable, here], env=env, stdout=out_f,
+                stderr=err_f, start_new_session=True,
+            )
+            t0 = time.time()
+            rc = None
+            while time.time() - t0 < deadline:
+                rc = child.poll()
+                if rc is not None:
+                    break
+                time.sleep(1.0)
+            if rc is None:
+                import signal
+
+                try:
+                    os.killpg(child.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+                continue  # move on even if the corpse cannot be reaped
+            out_f.seek(0)
+            stdout = out_f.read()
+            err_f.seek(0)
+            last_err = err_f.read()[-2000:]
+        lines = [ln for ln in stdout.splitlines() if ln.startswith("{")]
+        if rc == 0 and lines:
+            print(lines[-1])
+            return
+    raise RuntimeError(
+        "bench failed on both TPU and CPU fallback; last stderr:\n" +
+        last_err
     )
 
 

@@ -1,0 +1,203 @@
+#include "base/compress.h"
+
+#include <zlib.h>
+
+#include <cstring>
+#include <vector>
+
+namespace trpc {
+
+namespace {
+
+// ---- zlib-backed compressors -------------------------------------------
+// windowBits selects the wrapping: 15+16 = gzip, 15 = zlib (RFC 1950).
+
+bool deflate_iobuf(const IOBuf& in, IOBuf* out, int window_bits) {
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  if (deflateInit2(&zs, Z_DEFAULT_COMPRESSION, Z_DEFLATED, window_bits, 8,
+                   Z_DEFAULT_STRATEGY) != Z_OK) {
+    return false;
+  }
+  // Feed block by block (zero copies beyond zlib's own window).
+  std::vector<char> buf(64 * 1024);
+  const size_t nblocks = in.block_count();
+  for (size_t b = 0; b < nblocks; ++b) {
+    const IOBuf::BlockRef& ref = in.ref_at(b);
+    zs.next_in =
+        reinterpret_cast<Bytef*>(const_cast<char*>(ref.block->data) +
+                                 ref.offset);
+    zs.avail_in = ref.length;
+    const int flush = b + 1 == nblocks ? Z_FINISH : Z_NO_FLUSH;
+    do {
+      zs.next_out = reinterpret_cast<Bytef*>(buf.data());
+      zs.avail_out = static_cast<uInt>(buf.size());
+      const int rc = deflate(&zs, flush);
+      if (rc == Z_STREAM_ERROR) {
+        deflateEnd(&zs);
+        return false;
+      }
+      out->append(buf.data(), buf.size() - zs.avail_out);
+    } while (zs.avail_out == 0);
+  }
+  if (nblocks == 0) {  // empty input still needs the trailer
+    zs.next_out = reinterpret_cast<Bytef*>(buf.data());
+    zs.avail_out = static_cast<uInt>(buf.size());
+    deflate(&zs, Z_FINISH);
+    out->append(buf.data(), buf.size() - zs.avail_out);
+  }
+  deflateEnd(&zs);
+  return true;
+}
+
+bool inflate_iobuf(const IOBuf& in, IOBuf* out, int window_bits,
+                   uint64_t size_limit) {
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  if (inflateInit2(&zs, window_bits) != Z_OK) {
+    return false;
+  }
+  std::vector<char> buf(64 * 1024);
+  uint64_t total = 0;
+  int rc = Z_OK;
+  const size_t nblocks = in.block_count();
+  for (size_t b = 0; b < nblocks && rc != Z_STREAM_END; ++b) {
+    const IOBuf::BlockRef& ref = in.ref_at(b);
+    zs.next_in =
+        reinterpret_cast<Bytef*>(const_cast<char*>(ref.block->data) +
+                                 ref.offset);
+    zs.avail_in = ref.length;
+    while (zs.avail_in > 0 && rc != Z_STREAM_END) {
+      zs.next_out = reinterpret_cast<Bytef*>(buf.data());
+      zs.avail_out = static_cast<uInt>(buf.size());
+      rc = inflate(&zs, Z_NO_FLUSH);
+      if (rc != Z_OK && rc != Z_STREAM_END) {
+        inflateEnd(&zs);
+        return false;  // corrupt stream
+      }
+      const size_t produced = buf.size() - zs.avail_out;
+      total += produced;
+      if (total > size_limit) {  // zip-bomb guard
+        inflateEnd(&zs);
+        return false;
+      }
+      out->append(buf.data(), produced);
+    }
+  }
+  inflateEnd(&zs);
+  return rc == Z_STREAM_END;
+}
+
+bool gzip_compress(const IOBuf& in, IOBuf* out) {
+  return deflate_iobuf(in, out, 15 + 16);
+}
+bool gzip_decompress(const IOBuf& in, IOBuf* out, uint64_t limit) {
+  return inflate_iobuf(in, out, 15 + 16, limit);
+}
+bool zlib_compress(const IOBuf& in, IOBuf* out) {
+  return deflate_iobuf(in, out, 15);
+}
+bool zlib_decompress(const IOBuf& in, IOBuf* out, uint64_t limit) {
+  return inflate_iobuf(in, out, 15, limit);
+}
+
+const Compressor kGzipC = {"gzip", gzip_compress, gzip_decompress};
+const Compressor kZlibC = {"zlib", zlib_compress, zlib_decompress};
+
+// ---- crc32c -------------------------------------------------------------
+
+// Software table (Castagnoli polynomial 0x1EDC6F41, reflected 0x82F63B78).
+const uint32_t* sw_table() {
+  static uint32_t* table = [] {
+    auto* t = new uint32_t[256];
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) {
+        c = (c & 1) ? 0x82F63B78u ^ (c >> 1) : c >> 1;
+      }
+      t[i] = c;
+    }
+    return t;
+  }();
+  return table;
+}
+
+uint32_t crc32c_sw(const uint8_t* p, size_t n, uint32_t crc) {
+  const uint32_t* t = sw_table();
+  for (size_t i = 0; i < n; ++i) {
+    crc = t[(crc ^ p[i]) & 0xff] ^ (crc >> 8);
+  }
+  return crc;
+}
+
+#if defined(__x86_64__)
+__attribute__((target("sse4.2"))) uint32_t crc32c_hw(const uint8_t* p,
+                                                     size_t n, uint32_t crc) {
+  uint64_t c = crc;
+  while (n >= 8) {
+    uint64_t v;
+    memcpy(&v, p, 8);
+    c = __builtin_ia32_crc32di(c, v);
+    p += 8;
+    n -= 8;
+  }
+  uint32_t c32 = static_cast<uint32_t>(c);
+  while (n > 0) {
+    c32 = __builtin_ia32_crc32qi(c32, *p);
+    ++p;
+    --n;
+  }
+  return c32;
+}
+
+bool have_sse42() {
+  static const bool have = __builtin_cpu_supports("sse4.2");
+  return have;
+}
+#endif
+
+}  // namespace
+
+const Compressor* find_compressor(CompressType type) {
+  switch (type) {
+    case CompressType::kGzip:
+      return &kGzipC;
+    case CompressType::kZlib:
+      return &kZlibC;
+    case CompressType::kNone:
+    default:
+      return nullptr;
+  }
+}
+
+uint32_t crc32c(const void* data, size_t n, uint32_t seed) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  uint32_t crc = seed ^ 0xffffffffu;
+#if defined(__x86_64__)
+  if (have_sse42()) {
+    return crc32c_hw(p, n, crc) ^ 0xffffffffu;
+  }
+#endif
+  return crc32c_sw(p, n, crc) ^ 0xffffffffu;
+}
+
+uint32_t crc32c(const IOBuf& buf, uint32_t seed) {
+  // Running CRC across the block chain: fold each block's raw bytes in
+  // without the init/final xor (applied once at the ends).
+  uint32_t crc = seed ^ 0xffffffffu;
+  for (size_t b = 0; b < buf.block_count(); ++b) {
+    const IOBuf::BlockRef& ref = buf.ref_at(b);
+    const uint8_t* p =
+        reinterpret_cast<const uint8_t*>(ref.block->data) + ref.offset;
+#if defined(__x86_64__)
+    if (have_sse42()) {
+      crc = crc32c_hw(p, ref.length, crc);
+      continue;
+    }
+#endif
+    crc = crc32c_sw(p, ref.length, crc);
+  }
+  return crc ^ 0xffffffffu;
+}
+
+}  // namespace trpc

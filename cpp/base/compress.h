@@ -1,0 +1,39 @@
+// Compression + checksum registries.
+//
+// Parity: the reference's extension registries for compress handlers
+// (gzip/zlib/snappy, /root/reference/src/brpc/policy/gzip_compress.*,
+// registered global.cpp:421-433) and checksum handlers (crc32c,
+// policy/crc32c_checksum.*, global.cpp:435-441), negotiated per call via
+// the request meta.  Redesigned condensed: a fixed id → vtable table
+// (gzip + zlib via libz; snappy's library isn't in this image, slot kept),
+// and hardware-accelerated crc32c (SSE4.2) with a software fallback.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "base/iobuf.h"
+
+namespace trpc {
+
+// Wire ids (meta.compress_type).  0 = none.
+enum class CompressType : uint8_t {
+  kNone = 0,
+  kGzip = 1,
+  kZlib = 2,
+};
+
+struct Compressor {
+  const char* name;
+  bool (*compress)(const IOBuf& in, IOBuf* out);
+  bool (*decompress)(const IOBuf& in, IOBuf* out, uint64_t size_limit);
+};
+
+// nullptr for kNone or an unknown id.
+const Compressor* find_compressor(CompressType type);
+
+// crc32c (Castagnoli), HW-accelerated where SSE4.2 exists.
+uint32_t crc32c(const void* data, size_t n, uint32_t seed = 0);
+uint32_t crc32c(const IOBuf& buf, uint32_t seed = 0);
+
+}  // namespace trpc

@@ -2,6 +2,7 @@
 
 #include <errno.h>
 
+#include "base/compress.h"
 #include "base/logging.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
@@ -84,6 +85,18 @@ void tstd_process_response(InputMessage&& msg) {
       payload.cutn(&body, payload.size() - msg.meta.attachment_size);
       cntl->response_attachment() = std::move(payload);
       payload = std::move(body);
+    }
+    if (msg.meta.compress_type != 0) {
+      const Compressor* c = find_compressor(
+          static_cast<CompressType>(msg.meta.compress_type));
+      IOBuf plain;
+      if (c == nullptr ||
+          !c->decompress(payload, &plain, 1ull << 30)) {
+        cntl->SetFailed(EBADMSG, "response decompression failed");
+        complete_locked_call(cid, cntl);
+        return;
+      }
+      payload = std::move(plain);
     }
     if (cntl->call().response != nullptr) {
       *cntl->call().response = std::move(payload);
@@ -228,10 +241,29 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
     span_annotate(span, "request packed");
   }
   IOBuf body = request;  // zero-copy share
+  if (cntl->request_compress_type() != 0) {
+    const Compressor* c = find_compressor(
+        static_cast<CompressType>(cntl->request_compress_type()));
+    IOBuf squeezed;
+    if (c == nullptr || !c->compress(body, &squeezed)) {
+      fid_unlock(cid);
+      fid_error(cid, EINVAL);
+      if (sync) {
+        fid_join(cid);
+      }
+      return;
+    }
+    body = std::move(squeezed);
+    meta.compress_type = cntl->request_compress_type();
+  }
   if (!cntl->request_attachment().empty()) {
     meta.attachment_size =
         static_cast<uint32_t>(cntl->request_attachment().size());
     body.append(cntl->request_attachment());
+  }
+  if (cntl->checksum_enabled()) {
+    meta.has_checksum = true;
+    meta.checksum = crc32c(body);
   }
   IOBuf frame;
   tstd_pack(&frame, meta, body);

@@ -47,6 +47,17 @@ class Controller {
     return timeout_ms_ != kUnsetTimeoutMs ? timeout_ms_ : dflt;
   }
 
+  // Compression of the request body (client) / response body (server),
+  // negotiated in the meta (gzip_compress.* parity).  Attachments stay
+  // raw, like the reference.
+  void set_request_compress_type(uint8_t t) { req_compress_ = t; }
+  uint8_t request_compress_type() const { return req_compress_; }
+  void set_response_compress_type(uint8_t t) { resp_compress_ = t; }
+  uint8_t response_compress_type() const { return resp_compress_; }
+  // crc32c over the on-wire payload, verified by the receiving parser.
+  void set_enable_checksum(bool on) { checksum_ = on; }
+  bool checksum_enabled() const { return checksum_; }
+
   // Payload carried outside the main body (parity: attachment in
   // baidu_std; rides the same frame after the response body).
   IOBuf& request_attachment() { return request_attachment_; }
@@ -80,6 +91,9 @@ class Controller {
   std::string error_text_;
   std::string method_;
   int64_t timeout_ms_ = kUnsetTimeoutMs;
+  uint8_t req_compress_ = 0;
+  uint8_t resp_compress_ = 0;
+  bool checksum_ = false;
   int64_t latency_us_ = 0;
   IOBuf request_attachment_;
   IOBuf response_attachment_;

@@ -1,5 +1,7 @@
 #include "net/protocol.h"
 
+#include "base/compress.h"
+
 #include <cstring>
 #include <mutex>
 #include <vector>
@@ -63,14 +65,20 @@ std::string encode_meta(const RpcMeta& m) {
   s.append(m.method);
   put_u32(&s, static_cast<uint32_t>(m.error_text.size()));
   s.append(m.error_text);
-  // Trace-context tail, only when a trace is active: decoders treat it
-  // as optional (they read by field lengths and only look past
-  // error_text when bytes remain), so presence/absence are both
-  // wire-compatible — and the streaming hot path never pays for it.
-  if (m.trace_id != 0) {
+  // Optional tail, only when any of its fields is active: decoders treat
+  // it as length-gated (they only look past error_text when bytes
+  // remain), so presence/absence are both wire-compatible — and the
+  // streaming hot path never pays for it.  Layout: trace(24B) then
+  // compress+checksum(5B); the second group implies the first.
+  if (m.trace_id != 0 || m.compress_type != 0 || m.has_checksum) {
     put_u64(&s, m.trace_id);
     put_u64(&s, m.span_id);
     put_u64(&s, m.parent_span_id);
+    if (m.compress_type != 0 || m.has_checksum) {
+      s.push_back(static_cast<char>(m.compress_type));
+      s.push_back(m.has_checksum ? 1 : 0);
+      put_u32(&s, m.checksum);
+    }
   }
   return s;
 }
@@ -112,6 +120,12 @@ bool decode_meta(const std::string& s, RpcMeta* m) {
     m->trace_id = get_u64(p);
     m->span_id = get_u64(p + 8);
     m->parent_span_id = get_u64(p + 16);
+    p += 24;
+    if (end - p >= 6) {  // optional compress/checksum group
+      m->compress_type = static_cast<uint8_t>(*p++);
+      m->has_checksum = *p++ != 0;
+      m->checksum = get_u32(p);
+    }
   }
   return true;
 }
@@ -146,6 +160,12 @@ ParseError tstd_parse(IOBuf* source, InputMessage* out, Socket*) {
     return ParseError::kCorrupted;
   }
   source->cutn(&out->payload, payload_len);
+  if (out->meta.has_checksum &&
+      crc32c(out->payload) != out->meta.checksum) {
+    // The transport delivered different bytes than were sent: the
+    // connection's framing can no longer be trusted.
+    return ParseError::kCorrupted;
+  }
   return ParseError::kOk;
 }
 

@@ -50,6 +50,12 @@ struct RpcMeta {
   uint64_t trace_id = 0;
   uint64_t span_id = 0;
   uint64_t parent_span_id = 0;
+  // Negotiated per call (policy/gzip_compress.* + crc32c_checksum.*
+  // parity): payload compression id and crc32c over the on-wire payload
+  // (0 = unchecked).  Ride the optional tail with the trace context.
+  uint8_t compress_type = 0;
+  bool has_checksum = false;  // presence flag: a zero CRC is still a CRC
+  uint32_t checksum = 0;
   std::string method;
   std::string error_text;
 };

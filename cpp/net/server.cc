@@ -10,6 +10,7 @@
 #include <map>
 #include <mutex>
 
+#include "base/compress.h"
 #include "base/flags.h"
 #include "base/logging.h"
 #include "base/rand.h"
@@ -441,10 +442,23 @@ void tstd_process_request(InputMessage&& msg) {
       meta.ack_bytes = stream_recv_window(meta.stream_id);
     }
     IOBuf frame;
+    if (!cntl->Failed() && cntl->response_compress_type() != 0) {
+      const Compressor* c = find_compressor(
+          static_cast<CompressType>(cntl->response_compress_type()));
+      IOBuf squeezed;
+      if (c != nullptr && c->compress(*response, &squeezed)) {
+        *response = std::move(squeezed);
+        meta.compress_type = cntl->response_compress_type();
+      }
+    }
     if (!cntl->response_attachment().empty()) {
       meta.attachment_size =
           static_cast<uint32_t>(cntl->response_attachment().size());
       response->append(std::move(cntl->response_attachment()));
+    }
+    if (cntl->checksum_enabled()) {
+      meta.has_checksum = true;
+      meta.checksum = crc32c(*response);
     }
     tstd_pack(&frame, meta, *response);
     SocketRef s(Socket::Address(socket_id));
@@ -495,6 +509,26 @@ void tstd_process_request(InputMessage&& msg) {
     request.cutn(&body, request.size() - msg.meta.attachment_size);
     cntl->request_attachment() = std::move(request);
     request = std::move(body);
+  }
+  if (msg.meta.compress_type != 0) {
+    const Compressor* c = find_compressor(
+        static_cast<CompressType>(msg.meta.compress_type));
+    IOBuf plain;
+    if (c == nullptr || !c->decompress(request, &plain, 1ull << 30)) {
+      cntl->SetFailed(EBADMSG, "request decompression failed");
+      done();
+      return;
+    }
+    request = std::move(plain);
+    // Symmetric default: reply compressed the same way unless the
+    // handler overrides (reference: response follows request unless
+    // set_response_compress_type).
+    if (cntl->response_compress_type() == 0) {
+      cntl->set_response_compress_type(msg.meta.compress_type);
+    }
+  }
+  if (msg.meta.has_checksum) {
+    cntl->set_enable_checksum(true);  // checksum the response too
   }
   prop->handler(cntl, request, response, std::move(done));
 }

@@ -35,20 +35,23 @@ SpanRing& ring() {
   return *r;
 }
 
-// Ambient (fiber-local) trace context.  Stored by VALUE in two u64s
-// packed into the fls pointer slots (the Span itself may die before a
-// child fiber reads the context).
-struct Ambient {
-  uint64_t trace_id;
-  uint64_t span_id;
-};
-
-void ambient_dtor(void* p) { delete static_cast<Ambient*>(p); }
-
-fls_key_t ambient_key() {
+// Ambient (fiber-local) trace context, stored by VALUE: the two u64 ids
+// ride directly in two fls pointer slots (no per-RPC allocation, no
+// destructor, and the Span object may die before a child fiber reads the
+// context).
+fls_key_t ambient_trace_key() {
   static fls_key_t key = [] {
     fls_key_t k;
-    fls_key_create(&k, ambient_dtor);
+    fls_key_create(&k, nullptr);
+    return k;
+  }();
+  return key;
+}
+
+fls_key_t ambient_span_key() {
+  static fls_key_t key = [] {
+    fls_key_t k;
+    fls_key_create(&k, nullptr);
     return k;
   }();
   return key;
@@ -115,24 +118,15 @@ void submit_span(Span* s, int32_t error_code) {
 }
 
 void set_ambient_span(const Span* s) {
-  auto* prev = static_cast<Ambient*>(fls_get(ambient_key()));
-  delete prev;
-  if (s == nullptr) {
-    fls_set(ambient_key(), nullptr);
-    return;
-  }
-  fls_set(ambient_key(), new Ambient{s->trace_id, s->span_id});
+  fls_set(ambient_trace_key(),
+          reinterpret_cast<void*>(s != nullptr ? s->trace_id : 0));
+  fls_set(ambient_span_key(),
+          reinterpret_cast<void*>(s != nullptr ? s->span_id : 0));
 }
 
 void get_ambient_trace(uint64_t* trace_id, uint64_t* span_id) {
-  auto* a = static_cast<Ambient*>(fls_get(ambient_key()));
-  if (a == nullptr) {
-    *trace_id = 0;
-    *span_id = 0;
-    return;
-  }
-  *trace_id = a->trace_id;
-  *span_id = a->span_id;
+  *trace_id = reinterpret_cast<uint64_t>(fls_get(ambient_trace_key()));
+  *span_id = reinterpret_cast<uint64_t>(fls_get(ambient_span_key()));
 }
 
 std::vector<Span> recent_spans(size_t limit, uint64_t trace_id) {

@@ -6,6 +6,7 @@
 #include <atomic>
 #include <string>
 
+#include "base/compress.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
 #include "fiber/sync.h"
@@ -258,6 +259,63 @@ TEST_CASE(connect_refused_times_out) {
   ch.CallMethod("Echo.Echo", req, &resp, &cntl);
   EXPECT(cntl.Failed());
   EXPECT(monotonic_time_us() - t0 < 2000000);
+}
+
+TEST_CASE(compression_and_checksum) {
+  start_server_once();
+  Channel ch;
+  EXPECT_EQ(ch.Init(addr()), 0);
+  // Compressible payload; gzip roundtrip with checksum on.
+  std::string big(256 * 1024, 'a');
+  for (size_t i = 0; i < big.size(); i += 17) {
+    big[i] = static_cast<char>('b' + i % 7);
+  }
+  for (uint8_t ct : {uint8_t(1) /*gzip*/, uint8_t(2) /*zlib*/}) {
+    Controller cntl;
+    cntl.set_timeout_ms(5000);
+    cntl.set_request_compress_type(ct);
+    cntl.set_enable_checksum(true);
+    IOBuf req, resp;
+    req.append(big);
+    ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+    EXPECT_EQ(resp.size(), big.size());
+    EXPECT(resp.to_string() == big);
+  }
+  // Empty body with checksum on: presence must still be signaled (a
+  // zero CRC is a valid CRC) and the response must come back checked.
+  {
+    Controller cntl;
+    cntl.set_timeout_ms(5000);
+    cntl.set_enable_checksum(true);
+    IOBuf req, resp;
+    ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+    EXPECT_EQ(resp.size(), 0u);
+  }
+  // Unknown compress id fails cleanly client-side.
+  Controller cntl;
+  cntl.set_request_compress_type(99);
+  IOBuf req, resp;
+  req.append("x");
+  ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+  EXPECT(cntl.Failed());
+}
+
+TEST_CASE(crc32c_known_vectors) {
+  // RFC 3720 test vectors (crc32c of 32 zero bytes, and "123456789").
+  uint8_t zeros[32] = {};
+  EXPECT_EQ(crc32c(zeros, sizeof(zeros)), 0x8A9136AAu);
+  const char* digits = "123456789";
+  EXPECT_EQ(crc32c(digits, 9), 0xE3069283u);
+  // IOBuf form matches flat form across block boundaries.
+  IOBuf buf;
+  std::string chunk(5000, 'q');
+  for (int i = 0; i < 5; ++i) {
+    buf.append(chunk);
+  }
+  std::string flat = buf.to_string();
+  EXPECT_EQ(crc32c(buf), crc32c(flat.data(), flat.size()));
 }
 
 TEST_MAIN
