@@ -102,10 +102,14 @@ def _cpp_rows() -> list:
     if not os.path.exists(exe):
         return []
     rows = []
-    for fibers, payload in ((64, 1024), (8, 2 << 20)):
+    for fibers, payload, conn in (
+        (64, 1024, "single"),
+        (8, 2 << 20, "single"),
+        (8, 2 << 20, "pooled"),
+    ):
         try:
             out = subprocess.run(
-                [exe, str(fibers), str(payload), "2"],
+                [exe, str(fibers), str(payload), "2", conn],
                 capture_output=True, text=True, timeout=60,
             )
             line = out.stdout.strip().splitlines()[-1]

@@ -67,7 +67,11 @@ bool inflate_iobuf(const IOBuf& in, IOBuf* out, int window_bits,
         reinterpret_cast<Bytef*>(const_cast<char*>(ref.block->data) +
                                  ref.offset);
     zs.avail_in = ref.length;
-    while (zs.avail_in > 0 && rc != Z_STREAM_END) {
+    // Keep inflating while input remains OR the last call filled the
+    // output chunk (pending window output with avail_in already 0 —
+    // stopping there would truncate a valid stream).
+    bool out_full = true;
+    while ((zs.avail_in > 0 || out_full) && rc != Z_STREAM_END) {
       zs.next_out = reinterpret_cast<Bytef*>(buf.data());
       zs.avail_out = static_cast<uInt>(buf.size());
       rc = inflate(&zs, Z_NO_FLUSH);
@@ -76,6 +80,7 @@ bool inflate_iobuf(const IOBuf& in, IOBuf* out, int window_bits,
         return false;  // corrupt stream
       }
       const size_t produced = buf.size() - zs.avail_out;
+      out_full = zs.avail_out == 0;
       total += produced;
       if (total > size_limit) {  // zip-bomb guard
         inflateEnd(&zs);

@@ -10,6 +10,7 @@
 #include "net/messenger.h"
 #include "net/protocol.h"
 #include "net/shm_transport.h"
+#include "net/socket_map.h"
 #include "net/span.h"
 #include "net/stream.h"
 
@@ -23,6 +24,31 @@ namespace {
 // (controller.cpp:611): state is finalized before anyone can observe it.
 void complete_locked_call(fid_t cid, Controller* cntl) {
   cntl->set_latency_us(monotonic_time_us() - cntl->call().start_us);
+  // Connection-type epilogue: pooled connections go back to the shared
+  // pool (socket.h:611-627 parity), short ones close now.
+  const SocketId conn = cntl->call().socket_id;
+  if (conn != 0) {
+    const auto ct = static_cast<ConnectionType>(cntl->call().conn_type);
+    if (ct == ConnectionType::kPooled) {
+      SocketRef s(Socket::Address(conn));
+      if (s) {
+        if (cntl->Failed()) {
+          // A failed/timed-out call may still have its response in
+          // flight: pooling the connection would queue the next caller
+          // behind stale bytes (the reference drops pooled sockets on
+          // error for the same reason).
+          s->SetFailed(ESHUTDOWN);
+        } else {
+          SocketMap::instance()->give_back(s->remote(), conn);
+        }
+      }
+    } else if (ct == ConnectionType::kShort) {
+      SocketRef s(Socket::Address(conn));
+      if (s) {
+        s->SetFailed(ESHUTDOWN);
+      }
+    }
+  }
   auto* span = static_cast<Span*>(cntl->call().span);
   if (span != nullptr) {
     cntl->call().span = nullptr;
@@ -118,6 +144,14 @@ int Channel::Init(const std::string& addr, const Options* opts) {
   if (opts != nullptr) {
     opts_ = *opts;
   }
+  ConnectionType ct;
+  if (!parse_connection_type(opts_.connection_type, &ct)) {
+    return -1;  // typo'd type must not silently mean "single"
+  }
+  if (opts_.use_shm && ct != ConnectionType::kSingle) {
+    return -1;  // shm rings are inherently single-connection
+  }
+  conn_type_ = static_cast<uint8_t>(ct);
   return hostname2endpoint(addr.c_str(), &ep_);
 }
 
@@ -180,6 +214,10 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
   cntl->call().response = response;
   cntl->call().done = std::move(done);
   cntl->call().start_us = monotonic_time_us();
+  // Controller reuse: a previous call's connection ownership must not
+  // leak into this call's early-failure paths.
+  cntl->call().socket_id = 0;
+  cntl->call().conn_type = 0;
   const bool sync = !cntl->call().done;
   // rpcz: client span; a handler fiber's ambient server span becomes the
   // parent (channel.cpp:506-527 parity).
@@ -210,7 +248,31 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
   CHECK(fid_lock(cid, nullptr) == 0);
 
   SocketId sid = 0;
-  if (ensure_socket(&sid) != 0) {
+  const auto ct = static_cast<ConnectionType>(conn_type_);
+  if (cntl->call().offered_stream != 0 && ct != ConnectionType::kSingle) {
+    // A stream outlives the call and pins its connection; pooled/short
+    // connections are per-call by definition.
+    fid_unlock(cid);
+    fid_error(cid, EINVAL);
+    if (sync) {
+      fid_join(cid);
+    }
+    return;
+  }
+  int sock_rc;
+  switch (ct) {
+    case ConnectionType::kPooled:
+      sock_rc = SocketMap::instance()->take_pooled(ep_, &sid);
+      break;
+    case ConnectionType::kShort:
+      sock_rc = SocketMap::instance()->create_short(ep_, &sid);
+      break;
+    case ConnectionType::kSingle:
+    default:
+      sock_rc = ensure_socket(&sid);
+      break;
+  }
+  if (sock_rc != 0) {
     fid_unlock(cid);
     fid_error(cid, ECONNREFUSED);
     if (sync) {
@@ -219,6 +281,7 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
     return;
   }
   cntl->call().socket_id = sid;
+  cntl->call().conn_type = static_cast<uint8_t>(ct);
 
   const int64_t eff_timeout_ms = cntl->timeout_ms_or(opts_.timeout_ms);
   if (eff_timeout_ms > 0) {

@@ -21,6 +21,10 @@ class Channel {
   struct Options {
     int64_t timeout_ms = 1000;
     int max_retry = 0;  // retries on connection failure (not timeouts)
+    // Connection type matrix (socket_map.h: "single" multiplexes one
+    // shared connection; "pooled" gives each call an exclusive one from
+    // a shared per-endpoint pool; "short" is one per call).
+    std::string connection_type = "single";
     // Same-host shared-memory transport (net/shm_transport.h): the channel
     // handshakes a ring segment over TCP, then calls flow through shm.
     // Falls back to TCP transparently if the handshake fails.
@@ -55,6 +59,7 @@ class Channel {
   // scheduler.
   FiberMutex sock_mu_;
   SocketId sock_ = 0;
+  uint8_t conn_type_ = 0;  // ConnectionType, parsed once in Init
 };
 
 }  // namespace trpc

@@ -82,6 +82,65 @@ class ConsistentHashLB : public LoadBalancer {
   }
 };
 
+// Weighted round robin: node i is picked weight_i times per cycle,
+// interleaved (parity: policy/weighted_round_robin_load_balancer.*,
+// condensed to the smooth-wrr scheme).
+class WeightedRoundRobinLB : public LoadBalancer {
+ public:
+  size_t select(const std::vector<size_t>& healthy,
+                const std::vector<ServerNode>& nodes, uint64_t,
+                int) override {
+    // Smooth WRR over the healthy subset using a stateless stride: walk
+    // the cumulative weights with an incrementing cursor.
+    int64_t total = 0;
+    for (size_t idx : healthy) {
+      total += std::max(1, nodes[idx].weight);
+    }
+    int64_t tick = static_cast<int64_t>(
+        cursor_.fetch_add(1, std::memory_order_relaxed) % total);
+    for (size_t idx : healthy) {
+      tick -= std::max(1, nodes[idx].weight);
+      if (tick < 0) {
+        return idx;
+      }
+    }
+    return healthy.back();
+  }
+
+ private:
+  std::atomic<uint64_t> cursor_{0};
+};
+
+// Power-of-two-choices with EWMA latency x in-flight scoring (parity:
+// policy/p2c_ewma and the locality-aware balancer's latency/load feedback
+// tree, condensed: same feedback signals, two-probe selection).
+class P2cEwmaLB : public LoadBalancer {
+ public:
+  size_t select(const std::vector<size_t>& healthy,
+                const std::vector<ServerNode>& nodes, uint64_t,
+                int attempt) override {
+    if (healthy.size() == 1) {
+      return healthy[0];
+    }
+    const size_t a = healthy[fast_rand_less_than(healthy.size())];
+    size_t b = healthy[fast_rand_less_than(healthy.size())];
+    if (a == b) {
+      b = healthy[(std::find(healthy.begin(), healthy.end(), a) -
+                   healthy.begin() + 1 + attempt) %
+                  healthy.size()];
+    }
+    return score(nodes[a]) <= score(nodes[b]) ? a : b;
+  }
+
+ private:
+  static int64_t score(const ServerNode& n) {
+    // Untried nodes (ewma 0) score lowest so every node gets probed.
+    const int64_t lat = n.ewma_latency_us->load(std::memory_order_relaxed);
+    const int64_t load = n.inflight->load(std::memory_order_relaxed) + 1;
+    return lat * load / std::max(1, n.weight);
+  }
+};
+
 }  // namespace
 
 LoadBalancer* LoadBalancer::create(const std::string& name) {
@@ -94,6 +153,12 @@ LoadBalancer* LoadBalancer::create(const std::string& name) {
   if (name == "c_hash") {
     return new ConsistentHashLB();
   }
+  if (name == "wrr") {
+    return new WeightedRoundRobinLB();
+  }
+  if (name == "p2c" || name == "la") {
+    return new P2cEwmaLB();
+  }
   return nullptr;
 }
 
@@ -101,7 +166,8 @@ LoadBalancer* LoadBalancer::create(const std::string& name) {
 
 namespace {
 
-int parse_server_list(const std::string& text, std::vector<EndPoint>* out) {
+int parse_server_list(const std::string& text,
+                      std::vector<std::pair<EndPoint, int>>* out) {
   std::stringstream ss(text);
   std::string token;
   while (std::getline(ss, token, ',')) {
@@ -112,9 +178,16 @@ int parse_server_list(const std::string& text, std::vector<EndPoint>* out) {
       continue;
     }
     token = token.substr(b, e - b + 1);
+    // Optional "host:port <weight>" (file-NS column parity, for wrr).
+    int weight = 1;
+    const size_t sp = token.find_first_of(" \t");
+    if (sp != std::string::npos) {
+      weight = std::max(1, atoi(token.c_str() + sp + 1));
+      token = token.substr(0, sp);
+    }
     EndPoint ep;
     if (hostname2endpoint(token.c_str(), &ep) == 0) {
-      out->push_back(ep);
+      out->emplace_back(ep, weight);
     } else {
       LOG(Warning) << "bad server '" << token << "' in list";
     }
@@ -124,7 +197,8 @@ int parse_server_list(const std::string& text, std::vector<EndPoint>* out) {
 
 class ListNS : public NamingService {
  public:
-  int resolve(const std::string& param, std::vector<EndPoint>* out) override {
+  int resolve(const std::string& param,
+              std::vector<std::pair<EndPoint, int>>* out) override {
     return parse_server_list(param, out);
   }
 };
@@ -132,7 +206,8 @@ class ListNS : public NamingService {
 // One server per line (or comma separated), re-read each refresh.
 class FileNS : public NamingService {
  public:
-  int resolve(const std::string& param, std::vector<EndPoint>* out) override {
+  int resolve(const std::string& param,
+              std::vector<std::pair<EndPoint, int>>* out) override {
     std::ifstream in(param);
     if (!in) {
       return -1;
@@ -201,7 +276,7 @@ int ClusterChannel::Init(const std::string& naming_url,
 }
 
 int ClusterChannel::refresh() {
-  std::vector<EndPoint> eps;
+  std::vector<std::pair<EndPoint, int>> eps;
   if (ns_->resolve(ns_param_, &eps) != 0) {
     return -1;
   }
@@ -210,14 +285,16 @@ int ClusterChannel::refresh() {
   {
     auto cur = cluster_.Read();
     const Cluster* old = cur->get();
-    for (const EndPoint& ep : eps) {
+    for (const auto& [ep, weight] : eps) {
       ServerNode node;
       node.ep = ep;
+      node.weight = weight;
       std::shared_ptr<Channel> ch;
       if (old != nullptr) {
         for (size_t i = 0; i < old->nodes.size(); ++i) {
           if (old->nodes[i].ep == ep) {
             node = old->nodes[i];
+            node.weight = weight;  // refresh may re-weight
             ch = old->channels[i];
             break;
           }
@@ -385,6 +462,19 @@ struct AsyncCall {
 };
 }  // namespace
 
+namespace {
+// EWMA latency feedback for p2c/la (OnComplete parity, controller.cpp:804).
+void feed_latency(ServerNode& node, int64_t lat_us) {
+  if (lat_us <= 0) {
+    return;
+  }
+  const int64_t prev =
+      node.ewma_latency_us->load(std::memory_order_relaxed);
+  node.ewma_latency_us->store(prev == 0 ? lat_us : (prev * 7 + lat_us) / 8,
+                              std::memory_order_relaxed);
+}
+}  // namespace
+
 void ClusterChannel::feed_breaker(ServerNode& node, bool success) {
   if (success) {
     node.consecutive_failures->store(0, std::memory_order_relaxed);
@@ -513,6 +603,8 @@ void ClusterChannel::call_hedged(std::shared_ptr<Cluster> cluster,
     ctx->channels[slot] = cluster->channels[node_idx];
     ctx->node_idx[slot] = node_idx;
     ctx->cntls[slot].set_timeout_ms(eff_timeout_ms);
+    ctx->cntls[slot].set_request_compress_type(cntl->request_compress_type());
+    ctx->cntls[slot].set_enable_checksum(cntl->checksum_enabled());
     ctx->cntls[slot].request_attachment() = ctx->attachment;
     auto* arg = new HedgeFiberArg{ctx, slot};
     if (fiber_start(nullptr, hedge_attempt_fiber, arg, 0) != 0) {
@@ -556,6 +648,10 @@ void ClusterChannel::call_hedged(std::shared_ptr<Cluster> cluster,
       continue;
     }
     feed_breaker(cluster->nodes[ctx->node_idx[i]], !ctx->cntls[i].Failed());
+    if (!ctx->cntls[i].Failed()) {
+      feed_latency(cluster->nodes[ctx->node_idx[i]],
+                   ctx->cntls[i].latency_us());
+    }
   }
   if (w < 0) {
     // Prefer an attempt that actually ran; among those, the backup's
@@ -666,9 +762,12 @@ void ClusterChannel::CallMethod(const std::string& method,
     cntl->request_attachment() = std::move(attachment);
     cntl->set_timeout_ms(eff_timeout_ms);
     const bool last_attempt = attempt == attempts - 1;
+    node.inflight->fetch_add(1, std::memory_order_relaxed);
     cluster->channels[idx]->CallMethod(method, request, response, cntl);
+    node.inflight->fetch_sub(1, std::memory_order_relaxed);
     if (!cntl->Failed()) {
       feed_breaker(node, true);
+      feed_latency(node, cntl->latency_us());
       if (done) {
         done();
       }

@@ -12,6 +12,7 @@
 #include <atomic>
 #include <memory>
 #include <string>
+#include <utility>
 #include <vector>
 
 #include "base/doubly_buffered.h"
@@ -24,11 +25,19 @@ namespace trpc {
 
 struct ServerNode {
   EndPoint ep;
+  // Static weight (wrr; parsed from the server list, default 1).
+  int weight = 1;
   // Circuit-breaker state.
   std::shared_ptr<std::atomic<int64_t>> quarantined_until_us =
       std::make_shared<std::atomic<int64_t>>(0);
   std::shared_ptr<std::atomic<int>> consecutive_failures =
       std::make_shared<std::atomic<int>>(0);
+  // Feedback for latency-aware balancing (p2c-EWMA / locality-aware
+  // parity): smoothed per-call latency and live in-flight count.
+  std::shared_ptr<std::atomic<int64_t>> ewma_latency_us =
+      std::make_shared<std::atomic<int64_t>>(0);
+  std::shared_ptr<std::atomic<int64_t>> inflight =
+      std::make_shared<std::atomic<int64_t>>(0);
 };
 
 class LoadBalancer {
@@ -46,8 +55,10 @@ class LoadBalancer {
 class NamingService {
  public:
   virtual ~NamingService() = default;
+  // Resolves to (endpoint, weight) pairs; weight defaults to 1 and feeds
+  // the wrr/p2c balancers.
   virtual int resolve(const std::string& param,
-                      std::vector<EndPoint>* out) = 0;
+                      std::vector<std::pair<EndPoint, int>>* out) = 0;
   // "list://h1:p1,h2:p2" | "file:///path" | "host:port"
   static std::unique_ptr<NamingService> create(const std::string& url,
                                                std::string* param);

@@ -71,6 +71,9 @@ class Controller {
     fid_t cid = 0;
     uint64_t timeout_timer = 0;
     void* span = nullptr;  // rpcz client Span (owned until submit)
+    // Connection ownership for pooled/short calls (socket_map.h): the
+    // completion path gives pooled sockets back / closes short ones.
+    uint8_t conn_type = 0;  // ConnectionType
     IOBuf* response = nullptr;
     Closure done;
     int64_t start_us = 0;

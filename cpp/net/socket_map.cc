@@ -1,0 +1,82 @@
+#include "net/socket_map.h"
+
+#include "net/messenger.h"
+
+namespace trpc {
+
+bool parse_connection_type(const std::string& s, ConnectionType* out) {
+  if (s.empty() || s == "single") {
+    *out = ConnectionType::kSingle;
+    return true;
+  }
+  if (s == "pooled") {
+    *out = ConnectionType::kPooled;
+    return true;
+  }
+  if (s == "short") {
+    *out = ConnectionType::kShort;
+    return true;
+  }
+  return false;
+}
+
+SocketMap* SocketMap::instance() {
+  static SocketMap* m = new SocketMap();  // leaked registry
+  return m;
+}
+
+int SocketMap::create_socket(const EndPoint& ep, SocketId* out) {
+  Socket::Options sopts;
+  sopts.fd = -1;  // lazy connect in the write fiber
+  sopts.remote = ep;
+  sopts.on_readable = &messenger_on_readable;
+  return Socket::Create(sopts, out);
+}
+
+int SocketMap::take_pooled(const EndPoint& ep, SocketId* out) {
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = pools_.find(ep);
+    while (it != pools_.end() && !it->second.empty()) {
+      const SocketId id = it->second.back();
+      it->second.pop_back();
+      Socket* s = Socket::Address(id);
+      if (s != nullptr) {
+        if (!s->Failed()) {
+          s->Dereference();
+          *out = id;
+          return 0;
+        }
+        s->Dereference();
+      }
+      // Stale/failed: drop and keep scanning.
+    }
+  }
+  return create_socket(ep, out);
+}
+
+void SocketMap::give_back(const EndPoint& ep, SocketId id) {
+  Socket* s = Socket::Address(id);
+  if (s == nullptr) {
+    return;  // died in flight; nothing to pool
+  }
+  const bool healthy = !s->Failed();
+  s->Dereference();
+  if (!healthy) {
+    return;
+  }
+  std::lock_guard<std::mutex> g(mu_);
+  pools_[ep].push_back(id);
+}
+
+int SocketMap::create_short(const EndPoint& ep, SocketId* out) {
+  return create_socket(ep, out);
+}
+
+size_t SocketMap::pooled_count(const EndPoint& ep) {
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = pools_.find(ep);
+  return it == pools_.end() ? 0 : it->second.size();
+}
+
+}  // namespace trpc

@@ -284,4 +284,74 @@ TEST_CASE(async_cluster_call) {
   delete resp;
 }
 
+TEST_CASE(wrr_weight_distribution) {
+  // Two servers, weights 3 and 1: wrr sends ~3x the traffic to the first.
+  Server s1, s2;
+  std::atomic<int> c1{0}, c2{0};
+  s1.RegisterMethod("W.Hit", [&c1](Controller*, const IOBuf&, IOBuf* r,
+                                   Closure done) {
+    c1.fetch_add(1);
+    r->append("1");
+    done();
+  });
+  s2.RegisterMethod("W.Hit", [&c2](Controller*, const IOBuf&, IOBuf* r,
+                                   Closure done) {
+    c2.fetch_add(1);
+    r->append("2");
+    done();
+  });
+  EXPECT_EQ(s1.Start(0), 0);
+  EXPECT_EQ(s2.Start(0), 0);
+  ClusterChannel ch;
+  const std::string url = "list://127.0.0.1:" + std::to_string(s1.port()) +
+                          " 3,127.0.0.1:" + std::to_string(s2.port()) + " 1";
+  EXPECT_EQ(ch.Init(url, "wrr"), 0);
+  for (int i = 0; i < 80; ++i) {
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("x");
+    ch.CallMethod("W.Hit", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+  }
+  EXPECT_EQ(c1.load() + c2.load(), 80);
+  EXPECT_EQ(c1.load(), 60);  // smooth wrr is exact over full cycles
+  EXPECT_EQ(c2.load(), 20);
+}
+
+TEST_CASE(p2c_prefers_fast_server) {
+  // One slow (20ms) and one fast server: p2c-EWMA shifts load to the
+  // fast one once feedback accumulates.
+  Server fast, slow;
+  std::atomic<int> cf{0}, cs{0};
+  fast.RegisterMethod("P.Hit", [&cf](Controller*, const IOBuf&, IOBuf* r,
+                                     Closure done) {
+    cf.fetch_add(1);
+    r->append("f");
+    done();
+  });
+  slow.RegisterMethod("P.Hit", [&cs](Controller*, const IOBuf&, IOBuf* r,
+                                     Closure done) {
+    cs.fetch_add(1);
+    fiber_sleep_us(20000);
+    r->append("s");
+    done();
+  });
+  EXPECT_EQ(fast.Start(0), 0);
+  EXPECT_EQ(slow.Start(0), 0);
+  ClusterChannel ch;
+  const std::string url = "list://127.0.0.1:" + std::to_string(fast.port()) +
+                          ",127.0.0.1:" + std::to_string(slow.port());
+  EXPECT_EQ(ch.Init(url, "p2c"), 0);
+  for (int i = 0; i < 60; ++i) {
+    Controller cntl;
+    cntl.set_timeout_ms(2000);
+    IOBuf req, resp;
+    req.append("x");
+    ch.CallMethod("P.Hit", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+  }
+  EXPECT_EQ(cf.load() + cs.load(), 60);
+  EXPECT(cf.load() > cs.load() * 2);  // strongly skewed to the fast node
+}
+
 TEST_MAIN

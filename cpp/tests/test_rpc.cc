@@ -7,6 +7,7 @@
 #include <string>
 
 #include "base/compress.h"
+#include "net/socket_map.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
 #include "fiber/sync.h"
@@ -316,6 +317,64 @@ TEST_CASE(crc32c_known_vectors) {
   }
   std::string flat = buf.to_string();
   EXPECT_EQ(crc32c(buf), crc32c(flat.data(), flat.size()));
+}
+
+TEST_CASE(pooled_and_short_connections) {
+  start_server_once();
+  // Pooled: concurrent calls each own a connection; they return to the
+  // shared pool afterwards.
+  Channel pooled;
+  Channel::Options popts;
+  popts.connection_type = "pooled";
+  popts.timeout_ms = 5000;
+  EXPECT_EQ(pooled.Init(addr(), &popts), 0);
+  EndPoint ep;
+  EXPECT_EQ(hostname2endpoint(addr().c_str(), &ep), 0);
+  static std::atomic<int> ok{0};
+  ok = 0;
+  std::vector<fiber_t> ids(8);
+  static Channel* pch = &pooled;
+  for (size_t i = 0; i < ids.size(); ++i) {
+    fiber_start(&ids[i], [](void*) {
+      for (int k = 0; k < 10; ++k) {
+        Controller cntl;
+        cntl.set_timeout_ms(5000);
+        IOBuf req, resp;
+        req.append(std::string(1000, 'p'));
+        pch->CallMethod("Echo.Echo", req, &resp, &cntl);
+        if (!cntl.Failed() && resp.size() == req.size()) {
+          ok.fetch_add(1);
+        }
+      }
+    }, nullptr);
+  }
+  for (auto f : ids) {
+    fiber_join(f);
+  }
+  EXPECT_EQ(ok.load(), 80);
+  // All exclusive connections came home.
+  EXPECT(SocketMap::instance()->pooled_count(ep) >= 1);
+
+  // Short: a fresh connection per call, gone afterwards (never pooled).
+  const size_t pool_before = SocketMap::instance()->pooled_count(ep);
+  Channel shortc;
+  Channel::Options sopts;
+  sopts.connection_type = "short";
+  EXPECT_EQ(shortc.Init(addr(), &sopts), 0);
+  for (int i = 0; i < 3; ++i) {
+    Controller cntl;
+    cntl.set_timeout_ms(5000);
+    IOBuf req, resp;
+    req.append("short");
+    shortc.CallMethod("Echo.Echo", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+  }
+  EXPECT_EQ(SocketMap::instance()->pooled_count(ep), pool_before);
+  // Unknown type rejected at Init.
+  Channel bad;
+  Channel::Options bopts;
+  bopts.connection_type = "pool";  // typo
+  EXPECT(bad.Init(addr(), &bopts) != 0);
 }
 
 TEST_MAIN

@@ -1,7 +1,7 @@
 // Echo benchmark — the reference's headline workload
 // (docs/cn/benchmark.md: multi-threaded sync echo; BASELINE.md).
 //
-// Usage: bench_echo [nfibers] [payload_bytes] [seconds]
+// Usage: bench_echo [nfibers] [payload_bytes] [seconds] [single|pooled|short]
 // Prints QPS, throughput and latency percentiles for sync echo over one
 // pooled loopback connection.
 #include <unistd.h>
@@ -57,6 +57,7 @@ int main(int argc, char** argv) {
   const int nfibers = argc > 1 ? atoi(argv[1]) : 64;
   const size_t payload = argc > 2 ? atoi(argv[2]) : 1024;
   const int seconds = argc > 3 ? atoi(argv[3]) : 3;
+  const char* conn_type = argc > 4 ? argv[4] : "single";
 
   Server server;
   server.RegisterMethod("Echo.Echo", [](Controller*, const IOBuf& req,
@@ -69,7 +70,12 @@ int main(int argc, char** argv) {
     return 1;
   }
   Channel ch;
-  ch.Init("127.0.0.1:" + std::to_string(server.port()));
+  Channel::Options copts;
+  copts.connection_type = conn_type;
+  if (ch.Init("127.0.0.1:" + std::to_string(server.port()), &copts) != 0) {
+    fprintf(stderr, "bad connection type %s\n", conn_type);
+    return 1;
+  }
 
   std::atomic<long> calls{0}, failures{0};
   std::vector<std::vector<int64_t>> lat(nfibers);
@@ -98,10 +104,10 @@ int main(int argc, char** argv) {
                         static_cast<size_t>(p * all.size()))];
   };
   const double qps = calls.load() / secs;
-  printf("{\"fibers\": %d, \"payload\": %zu, \"qps\": %.0f, "
+  printf("{\"fibers\": %d, \"conn\": \"%s\", \"payload\": %zu, \"qps\": %.0f, "
          "\"throughput_MBps\": %.1f, \"p50_us\": %ld, \"p99_us\": %ld, "
          "\"p999_us\": %ld, \"failures\": %ld}\n",
-         nfibers, payload, qps, qps * payload * 2 / 1e6, pct(0.5), pct(0.99),
-         pct(0.999), failures.load());
+         nfibers, conn_type, payload, qps, qps * payload * 2 / 1e6, pct(0.5),
+         pct(0.99), pct(0.999), failures.load());
   return 0;
 }
