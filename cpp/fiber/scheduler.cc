@@ -38,6 +38,25 @@ static inline void __sanitizer_finish_switch_fiber(void*, const void**,
                                                    size_t*) {}
 #endif
 
+// TSan fiber-switch annotations: without them TSan sees one pthread's
+// shadow stack teleporting between fiber stacks and reports phantom
+// races.  No-ops unless built with -fsanitize=thread.
+#if defined(__SANITIZE_THREAD__)
+extern "C" {
+void* __tsan_get_current_fiber();
+void* __tsan_create_fiber(unsigned flags);
+void __tsan_destroy_fiber(void* fiber);
+void __tsan_switch_to_fiber(void* fiber, unsigned flags);
+}
+#define TRPC_TSAN_FIBERS 1
+#else
+#define TRPC_TSAN_FIBERS 0
+static inline void* __tsan_get_current_fiber() { return nullptr; }
+static inline void* __tsan_create_fiber(unsigned) { return nullptr; }
+static inline void __tsan_destroy_fiber(void*) {}
+static inline void __tsan_switch_to_fiber(void*, unsigned) {}
+#endif
+
 namespace trpc {
 
 thread_local Worker* tls_worker = nullptr;
@@ -58,6 +77,10 @@ void requeue_post(void* a1, void*) {
 void finish_fiber_post(void* p, void*) {
   FiberMeta* m = static_cast<FiberMeta*>(p);
   const uint32_t ver = m->version.load(std::memory_order_relaxed);
+  if (TRPC_TSAN_FIBERS && m->tsan_fiber != nullptr) {
+    __tsan_destroy_fiber(m->tsan_fiber);
+    m->tsan_fiber = nullptr;
+  }
   release_stack(m->stack);
   m->stack = StackMem{};
   m->sp = nullptr;
@@ -225,6 +248,12 @@ void Worker::run_fiber(FiberMeta* m) {
   current_ = m;
   __sanitizer_start_switch_fiber(&asan_fake_stack_, m->stack.base,
                                  m->stack.size);
+  if (TRPC_TSAN_FIBERS) {
+    if (m->tsan_fiber == nullptr) {
+      m->tsan_fiber = __tsan_create_fiber(0);
+    }
+    __tsan_switch_to_fiber(m->tsan_fiber, 0);
+  }
   trpc_jump_context(&sched_sp_, m->sp, m);
   __sanitizer_finish_switch_fiber(asan_fake_stack_, nullptr, nullptr);
   current_ = nullptr;
@@ -245,6 +274,9 @@ void Worker::suspend_current(PostSwitchFn post_fn, void* a1, void* a2,
   // fake frames instead of preserving them for a resume.
   __sanitizer_start_switch_fiber(dying ? nullptr : &m->asan_fake_stack,
                                  pthread_stack_base_, pthread_stack_size_);
+  if (TRPC_TSAN_FIBERS) {
+    __tsan_switch_to_fiber(tsan_sched_fiber_, 0);
+  }
   trpc_jump_context(&m->sp, sched_sp_, nullptr);
   // Resumed (possibly on another worker's scheduler context).
   __sanitizer_finish_switch_fiber(m->asan_fake_stack, nullptr, nullptr);
@@ -252,6 +284,9 @@ void Worker::suspend_current(PostSwitchFn post_fn, void* a1, void* a2,
 
 void Worker::main_loop() {
   tls_worker = this;
+  if (TRPC_TSAN_FIBERS) {
+    tsan_sched_fiber_ = __tsan_get_current_fiber();
+  }
 #if TRPC_ASAN_FIBERS
   {
     pthread_attr_t attr;

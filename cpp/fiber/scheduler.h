@@ -31,6 +31,7 @@ struct FiberMeta {
   void* sp = nullptr;  // suspended continuation
   StackMem stack;
   void* asan_fake_stack = nullptr;  // ASan fiber handshake state
+  void* tsan_fiber = nullptr;       // TSan fiber identity (tsan builds)
   // Even = idle slot; odd = live fiber.  The version half of fiber_t.
   std::atomic<uint32_t> version{0};
   // Join event: value holds the live version while running; bumped at exit.
@@ -116,6 +117,7 @@ class Worker {
   FiberMeta* current_ = nullptr;
   void* sched_sp_ = nullptr;  // scheduler continuation while a fiber runs
   void* asan_fake_stack_ = nullptr;
+  void* tsan_sched_fiber_ = nullptr;  // this worker's scheduler context
   void* pthread_stack_base_ = nullptr;  // this worker pthread's stack
   size_t pthread_stack_size_ = 0;
   PostSwitchFn post_fn_ = nullptr;

@@ -1,0 +1,184 @@
+// Deterministic mutation fuzzing of the untrusted-byte parsers.
+//
+// Parity: the reference ships 18 libFuzzer targets (/root/reference/test/
+// fuzzing/: fuzz_baidu_rpc, fuzz_http, fuzz_hpack, ...).  This image's
+// GCC has no libFuzzer, so this is the same idea as a deterministic
+// harness: seed corpus of valid messages, structure-aware mutations
+// (bit flips, truncations, splices, length-field corruption) from a
+// fixed-seed xorshift, run under the ASan build in CI.  Every input must
+// parse without crashing and uphold the parser invariants; kCorrupted /
+// kNotEnoughData are both fine answers.
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "base/iobuf.h"
+#include "net/http_message.h"
+#include "net/protocol.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+namespace {
+
+uint64_t g_rng = 0x9e3779b97f4a7c15ull;  // fixed seed: runs are repeatable
+
+uint64_t rng() {
+  g_rng ^= g_rng << 13;
+  g_rng ^= g_rng >> 7;
+  g_rng ^= g_rng << 17;
+  return g_rng;
+}
+
+std::string mutate(const std::string& base) {
+  std::string m = base;
+  switch (rng() % 6) {
+    case 0: {  // bit flip(s)
+      for (int i = 0; i < 1 + static_cast<int>(rng() % 8); ++i) {
+        if (!m.empty()) {
+          m[rng() % m.size()] ^= static_cast<char>(1 << (rng() % 8));
+        }
+      }
+      break;
+    }
+    case 1:  // truncate
+      m.resize(rng() % (m.size() + 1));
+      break;
+    case 2: {  // splice two random halves
+      const size_t cut = m.empty() ? 0 : rng() % m.size();
+      m = m.substr(cut) + m.substr(0, cut);
+      break;
+    }
+    case 3: {  // stomp a 4-byte window with a hostile length
+      if (m.size() >= 4) {
+        const uint32_t evil =
+            (rng() % 2) ? 0xffffffffu : static_cast<uint32_t>(rng());
+        memcpy(m.data() + rng() % (m.size() - 3), &evil, 4);
+      }
+      break;
+    }
+    case 4: {  // insert garbage
+      const size_t at = m.empty() ? 0 : rng() % m.size();
+      std::string junk;
+      for (int i = 0; i < static_cast<int>(rng() % 32); ++i) {
+        junk.push_back(static_cast<char>(rng()));
+      }
+      m.insert(at, junk);
+      break;
+    }
+    case 5:  // pure noise
+      m.clear();
+      for (int i = 0; i < static_cast<int>(rng() % 256); ++i) {
+        m.push_back(static_cast<char>(rng()));
+      }
+      break;
+  }
+  return m;
+}
+
+std::vector<std::string> tstd_corpus() {
+  std::vector<std::string> out;
+  for (int variant = 0; variant < 4; ++variant) {
+    RpcMeta meta;
+    meta.type = variant % 2 == 0 ? RpcMeta::kRequest : RpcMeta::kResponse;
+    meta.correlation_id = 0x1234 + variant;
+    meta.method = "Svc.Method";
+    if (variant == 1) {
+      meta.error_code = 42;
+      meta.error_text = "deliberate";
+    }
+    if (variant == 2) {
+      meta.trace_id = 0xabcdef;
+      meta.span_id = 0x1111;
+      meta.compress_type = 1;
+      meta.has_checksum = true;
+      meta.checksum = 0xdeadbeef;
+    }
+    if (variant == 3) {
+      meta.type = RpcMeta::kStreamFrame;
+      meta.stream_id = 7;
+      meta.ack_bytes = 1 << 20;
+    }
+    IOBuf frame;
+    IOBuf payload;
+    payload.append(std::string(32 + variant * 100, 'x'));
+    tstd_pack(&frame, meta, payload);
+    out.push_back(frame.to_string());
+  }
+  return out;
+}
+
+std::vector<std::string> http_corpus() {
+  return {
+      "GET /vars HTTP/1.1\r\nHost: a\r\n\r\n",
+      "POST /Echo.Echo HTTP/1.1\r\nHost: a\r\nContent-Length: 5\r\n\r\n"
+      "hello",
+      "POST /x HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n"
+      "4\r\nWiki\r\n0\r\nX-T: v\r\n\r\n",
+      "GET /flags/a?setvalue=%31+2&k HTTP/1.0\r\nConnection: "
+      "keep-alive\r\n\r\n",
+      "HEAD /health#frag HTTP/1.1\r\nA: b\r\nC: d\r\n\r\n",
+  };
+}
+
+}  // namespace
+
+TEST_CASE(fuzz_tstd_parser) {
+  const auto corpus = tstd_corpus();
+  for (int iter = 0; iter < 60000; ++iter) {
+    const std::string input = mutate(corpus[rng() % corpus.size()]);
+    IOBuf buf;
+    buf.append(input);
+    InputMessage msg;
+    const size_t before = buf.size();
+    const ParseError rc = tstd_protocol().parse(&buf, &msg, nullptr);
+    // Invariants: never consume on NotEnoughData; never grow the buffer.
+    if (rc == ParseError::kNotEnoughData) {
+      EXPECT_EQ(buf.size(), before);
+    }
+    EXPECT(buf.size() <= before);
+  }
+}
+
+TEST_CASE(fuzz_http_parser) {
+  const auto corpus = http_corpus();
+  for (int iter = 0; iter < 40000; ++iter) {
+    const std::string input = mutate(corpus[rng() % corpus.size()]);
+    IOBuf buf;
+    buf.append(input);
+    HttpRequest req;
+    IOBuf body;
+    const size_t before = buf.size();
+    const ParseError rc = http_parse_request(&buf, &req, &body);
+    if (rc == ParseError::kNotEnoughData) {
+      EXPECT_EQ(buf.size(), before);
+    }
+    EXPECT(buf.size() <= before);
+  }
+}
+
+TEST_CASE(fuzz_http_trickled_state) {
+  // The resumable chunked path: feed each (mutated) input in random-sized
+  // slices against one persistent state slot, as a socket would.
+  const auto corpus = http_corpus();
+  for (int iter = 0; iter < 4000; ++iter) {
+    const std::string input = mutate(corpus[2]);  // chunked seed
+    IOBuf buf;
+    std::shared_ptr<void> state;
+    size_t off = 0;
+    while (off < input.size()) {
+      const size_t n =
+          std::min<size_t>(1 + rng() % 16, input.size() - off);
+      buf.append(input.data() + off, n);
+      off += n;
+      HttpRequest req;
+      IOBuf body;
+      const ParseError rc = http_parse_request(&buf, &req, &body, &state);
+      if (rc == ParseError::kOk || rc == ParseError::kCorrupted) {
+        break;
+      }
+    }
+  }
+}
+
+TEST_MAIN
