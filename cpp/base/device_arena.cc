@@ -1,0 +1,158 @@
+#include "base/device_arena.h"
+
+#include <fcntl.h>
+#include <stdlib.h>
+#include <sys/mman.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+
+#include "base/logging.h"
+#include "base/rand.h"
+
+namespace trpc {
+
+DeviceArena::DeviceArena(const Options& opts) : opts_(opts) {
+  if (opts_.block_size < 4096) {
+    opts_.block_size = 4096;
+  }
+  if (opts_.blocks_per_slab == 0) {
+    opts_.blocks_per_slab = 1;
+  }
+}
+
+DeviceArena::~DeviceArena() {
+  std::lock_guard<std::mutex> g(mu_);
+  for (Block* b : free_blocks_) {
+    delete b;
+  }
+  for (Slab& s : slabs_) {
+    if (opts_.unregister_slab != nullptr) {
+      opts_.unregister_slab(s.base, s.len, opts_.reg_ctx, s.handle);
+    }
+    if (!s.shm_name.empty()) {
+      munmap(s.base, s.len);
+      shm_unlink(s.shm_name.c_str());
+    } else {
+      free(s.base);
+    }
+  }
+}
+
+int DeviceArena::grow_locked() {
+  Slab slab;
+  slab.len = static_cast<size_t>(opts_.block_size) * opts_.blocks_per_slab;
+  if (opts_.shm_backed) {
+    char name[64];
+    snprintf(name, sizeof(name), "/trpc_arena_%d_%llx", getpid(),
+             static_cast<unsigned long long>(fast_rand()));
+    const int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) {
+      return -1;
+    }
+    if (ftruncate(fd, static_cast<off_t>(slab.len)) != 0) {
+      close(fd);
+      shm_unlink(name);
+      return -1;
+    }
+    void* mem = mmap(nullptr, slab.len, PROT_READ | PROT_WRITE, MAP_SHARED,
+                     fd, 0);
+    close(fd);
+    if (mem == MAP_FAILED) {
+      shm_unlink(name);
+      return -1;
+    }
+    slab.base = static_cast<char*>(mem);
+    slab.shm_name = name;
+  } else {
+    void* mem = nullptr;
+    if (posix_memalign(&mem, 4096, slab.len) != 0) {
+      return -1;
+    }
+    slab.base = static_cast<char*>(mem);
+  }
+  if (opts_.register_slab != nullptr &&
+      opts_.register_slab(slab.base, slab.len, opts_.reg_ctx,
+                          &slab.handle) != 0) {
+    if (!slab.shm_name.empty()) {
+      munmap(slab.base, slab.len);
+      shm_unlink(slab.shm_name.c_str());
+    } else {
+      free(slab.base);
+    }
+    return -1;
+  }
+  const uint32_t slab_id = static_cast<uint32_t>(slabs_.size());
+  slabs_.push_back(slab);
+  for (uint32_t i = 0; i < opts_.blocks_per_slab; ++i) {
+    auto* b = new Block();
+    b->cap = opts_.block_size;
+    b->arena = this;
+    b->data = slab.base + static_cast<size_t>(i) * opts_.block_size;
+    // The "lkey" the transport ships instead of bytes.
+    b->user_meta = (static_cast<uint64_t>(slab_id) << 32) |
+                   (i * opts_.block_size);
+    free_blocks_.push_back(b);
+  }
+  return 0;
+}
+
+Block* DeviceArena::allocate(uint32_t min_cap) {
+  if (min_cap > opts_.block_size) {
+    // Device blocks are fixed-granularity (registration is per-slab); a
+    // larger request spans multiple blocks at the IOBuf layer instead.
+    LOG(Warning) << "device arena block request " << min_cap << " > "
+                 << opts_.block_size;
+    return nullptr;
+  }
+  std::lock_guard<std::mutex> g(mu_);
+  if (free_blocks_.empty() && grow_locked() != 0) {
+    return nullptr;
+  }
+  Block* b = free_blocks_.back();
+  free_blocks_.pop_back();
+  b->ref.store(1, std::memory_order_relaxed);
+  b->size = 0;
+  ++in_use_;
+  return b;
+}
+
+void DeviceArena::deallocate(Block* b) {
+  std::lock_guard<std::mutex> g(mu_);
+  b->size = 0;
+  free_blocks_.push_back(b);
+  --in_use_;
+}
+
+bool DeviceArena::locate(const void* data, void** slab_base,
+                         uint64_t* handle, uint32_t* offset) const {
+  const char* p = static_cast<const char*>(data);
+  std::lock_guard<std::mutex> g(mu_);
+  for (const Slab& s : slabs_) {
+    if (p >= s.base && p < s.base + s.len) {
+      *slab_base = s.base;
+      *handle = s.handle;
+      *offset = static_cast<uint32_t>(p - s.base);
+      return true;
+    }
+  }
+  return false;
+}
+
+size_t DeviceArena::slab_count() const {
+  std::lock_guard<std::mutex> g(mu_);
+  return slabs_.size();
+}
+
+size_t DeviceArena::blocks_in_use() const {
+  std::lock_guard<std::mutex> g(mu_);
+  return in_use_;
+}
+
+std::string DeviceArena::slab_shm_name(size_t i) const {
+  std::lock_guard<std::mutex> g(mu_);
+  return i < slabs_.size() ? slabs_[i].shm_name : "";
+}
+
+}  // namespace trpc

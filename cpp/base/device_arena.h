@@ -1,0 +1,73 @@
+// DeviceArena — slab-backed staging memory behind the IOBuf arena seam.
+//
+// Parity: the fork's RDMA block_pool (/root/reference/src/brpc/rdma/
+// block_pool.cpp), which takes over IOBuf allocation with NIC-registered
+// memory so payloads are DMA-able without copies; rdma_endpoint sends
+// BlockRefs whose lkeys ride each block.  TPU-native redesign: the arena
+// owns large aligned slabs that a device backend registers ONCE (the
+// registration hook is where PJRT/ICI pinning goes — host staging memory
+// the TPU DMAs from/to directly), blocks are carved from slabs on a lock-
+// free-enough free list, and every block's `user_meta` carries
+// (slab_id << 32 | offset) — the lkey analogue the transport ships instead
+// of bytes.  Slabs can be POSIX-shm-backed so two processes on one host
+// can exchange BlockRef descriptors over a ring and never copy payloads.
+#pragma once
+
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "base/arena.h"
+
+namespace trpc {
+
+class DeviceArena : public BlockArena {
+ public:
+  struct Options {
+    uint32_t block_size = 256 * 1024;  // device DMA granularity
+    uint32_t blocks_per_slab = 64;     // 16MB slabs by default
+    bool shm_backed = false;           // name slabs in /dev/shm
+    // Registration seam (block_pool::RegisterMemory parity): called once
+    // per new slab; *handle becomes the high bits context a backend needs
+    // (PJRT buffer id, ICI window id...).  Null = host-only staging.
+    int (*register_slab)(void* base, size_t len, void* ctx,
+                         uint64_t* handle) = nullptr;
+    void (*unregister_slab)(void* base, size_t len, void* ctx,
+                            uint64_t handle) = nullptr;
+    void* reg_ctx = nullptr;
+  };
+
+  explicit DeviceArena(const Options& opts);
+  ~DeviceArena() override;
+
+  Block* allocate(uint32_t min_cap) override;
+  void deallocate(Block* b) override;
+
+  // (slab base, handle) for the slab containing `data`; false if foreign.
+  bool locate(const void* data, void** slab_base, uint64_t* handle,
+              uint32_t* offset) const;
+
+  size_t slab_count() const;
+  size_t blocks_in_use() const;
+  uint32_t block_size() const { return opts_.block_size; }
+  // Name of slab i's shm segment ("" when heap-backed).
+  std::string slab_shm_name(size_t i) const;
+
+ private:
+  struct Slab {
+    char* base = nullptr;
+    size_t len = 0;
+    uint64_t handle = 0;
+    std::string shm_name;
+  };
+  int grow_locked();
+
+  Options opts_;
+  mutable std::mutex mu_;
+  std::vector<Slab> slabs_;
+  std::vector<Block*> free_blocks_;
+  size_t in_use_ = 0;
+};
+
+}  // namespace trpc

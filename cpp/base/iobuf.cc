@@ -1,5 +1,7 @@
 #include "base/iobuf.h"
 
+#include "base/logging.h"
+
 #include <errno.h>
 #include <unistd.h>
 
@@ -88,8 +90,14 @@ void IOBuf::append(const void* data, size_t n) {
       n -= take;
       continue;
     }
-    Block* nb = arena->allocate(
-        std::min<size_t>(n, HostArena::kDefaultBlockSize));
+    // Ask for ONE byte so every arena serves at its own granularity (a
+    // device arena hands out full fixed-size blocks; large appends span
+    // as many as needed).  Genuine exhaustion (slab growth failure) is a
+    // hard programming/resource error at this copying entry point — the
+    // zero-copy path (append_block/trpc_arena_alloc) reports it
+    // recoverably instead.
+    Block* nb = arena->allocate(1);
+    CHECK(nb != nullptr) << "arena exhausted appending " << n << " bytes";
     const size_t take = std::min<size_t>(n, nb->cap);
     memcpy(nb->data, p, take);
     nb->size = take;
@@ -134,6 +142,7 @@ char* IOBuf::reserve(size_t n) {
   Block* b = extendable_tail(n);
   if (b == nullptr || b->cap - b->size < n) {
     b = arena->allocate(n);
+    CHECK(b != nullptr) << "arena cannot reserve " << n << " bytes";
     b->size = n;
     push_ref(b, 0, n);
     return b->data;

@@ -52,6 +52,12 @@ class IOBuf {
   // carries the device/DMA handle).
   void append_user_data(void* data, size_t n, void (*deleter)(void*, void*),
                         void* ctx = nullptr, uint64_t meta = 0);
+  // Appends an arena Block, CONSUMING the caller's reference (the block
+  // returns to its arena when the last IOBuf ref drops).  The zero-copy
+  // entry for device-arena payloads (block_pool parity).
+  void append_block(Block* b, uint32_t offset, uint32_t length) {
+    push_ref(b, offset, length);
+  }
 
   // Reserve n contiguous writable bytes at the tail; returns pointer.
   // Caller must fill them before any other operation.

@@ -121,9 +121,10 @@ void trpc_channel_destroy(void* ch) { delete static_cast<Channel*>(ch); }
 // Synchronous call.  Returns 0 on success and fills *resp (a trpc_iobuf
 // handle created by the caller); on failure returns the error code and
 // copies the error text into err_buf.
-int trpc_channel_call(void* ch, const char* method, const char* req,
-                      size_t req_len, void* resp_iobuf, int64_t timeout_ms,
-                      char* err_buf, size_t err_buf_len) {
+namespace {
+int call_channel_sync(void* ch, const char* method, const IOBuf& request,
+                      void* resp_iobuf, int64_t timeout_ms, char* err_buf,
+                      size_t err_buf_len) {
   // GIL safety: a ctypes caller must return on the pthread it entered on,
   // so any park inside the sync call blocks the thread, never migrates.
   ScopedPthreadWait pin;
@@ -131,8 +132,6 @@ int trpc_channel_call(void* ch, const char* method, const char* req,
   if (timeout_ms > 0) {
     cntl.set_timeout_ms(timeout_ms);
   }
-  IOBuf request;
-  request.append(req, req_len);
   static_cast<Channel*>(ch)->CallMethod(
       method, request, static_cast<IOBuf*>(resp_iobuf), &cntl);
   if (cntl.Failed()) {
@@ -143,6 +142,26 @@ int trpc_channel_call(void* ch, const char* method, const char* req,
     return cntl.error_code() != 0 ? cntl.error_code() : -1;
   }
   return 0;
+}
+}  // namespace
+
+int trpc_channel_call(void* ch, const char* method, const char* req,
+                      size_t req_len, void* resp_iobuf, int64_t timeout_ms,
+                      char* err_buf, size_t err_buf_len) {
+  IOBuf request;
+  request.append(req, req_len);
+  return call_channel_sync(ch, method, request, resp_iobuf, timeout_ms,
+                           err_buf, err_buf_len);
+}
+
+// IOBuf-request variant: the request IOBuf handle is used as-is (no
+// flattening/copy — arena blocks ride straight to the wire).  The handle
+// remains caller-owned; its payload is shared, not consumed.
+int trpc_channel_call_buf(void* ch, const char* method, void* req_iobuf,
+                          void* resp_iobuf, int64_t timeout_ms,
+                          char* err_buf, size_t err_buf_len) {
+  return call_channel_sync(ch, method, *static_cast<IOBuf*>(req_iobuf),
+                           resp_iobuf, timeout_ms, err_buf, err_buf_len);
 }
 
 // ---- cluster channel ----------------------------------------------------
