@@ -1,0 +1,671 @@
+#include "net/h2_protocol.h"
+
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+
+#include "base/logging.h"
+#include "base/time.h"
+#include "fiber/sync.h"
+#include "net/hpack.h"
+#include "net/http_protocol.h"
+#include "net/server.h"
+#include "net/socket.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+constexpr size_t kPrefaceLen = 24;
+constexpr uint32_t kFrameHeaderLen = 9;
+constexpr uint32_t kMaxFrameSize = 16384;        // our advertised max
+constexpr uint32_t kDefaultWindow = 65535;
+constexpr uint32_t kRecvWindow = 1 << 20;        // what we grant peers
+
+enum FrameType : uint8_t {
+  kData = 0x0,
+  kHeaders = 0x1,
+  kPriority = 0x2,
+  kRstStream = 0x3,
+  kSettings = 0x4,
+  kPushPromise = 0x5,
+  kPing = 0x6,
+  kGoaway = 0x7,
+  kWindowUpdate = 0x8,
+  kContinuation = 0x9,
+};
+
+enum Flags : uint8_t {
+  kEndStream = 0x1,
+  kEndHeaders = 0x4,
+  kPadded = 0x8,
+  kPriorityFlag = 0x20,
+  kAck = 0x1,
+};
+
+void put_u24(std::string* s, uint32_t v) {
+  s->push_back(static_cast<char>(v >> 16));
+  s->push_back(static_cast<char>(v >> 8));
+  s->push_back(static_cast<char>(v));
+}
+void put_u32(std::string* s, uint32_t v) {
+  s->push_back(static_cast<char>(v >> 24));
+  s->push_back(static_cast<char>(v >> 16));
+  s->push_back(static_cast<char>(v >> 8));
+  s->push_back(static_cast<char>(v));
+}
+uint32_t get_u24(const uint8_t* p) {
+  return (static_cast<uint32_t>(p[0]) << 16) |
+         (static_cast<uint32_t>(p[1]) << 8) | p[2];
+}
+uint32_t get_u31(const uint8_t* p) {
+  return ((static_cast<uint32_t>(p[0]) & 0x7f) << 24) |
+         (static_cast<uint32_t>(p[1]) << 16) |
+         (static_cast<uint32_t>(p[2]) << 8) | p[3];
+}
+
+std::string frame_header(uint32_t len, uint8_t type, uint8_t flags,
+                         uint32_t stream_id) {
+  std::string h;
+  put_u24(&h, len);
+  h.push_back(static_cast<char>(type));
+  h.push_back(static_cast<char>(flags));
+  put_u32(&h, stream_id);
+  return h;
+}
+
+// One in-progress request stream.
+struct H2Stream {
+  HeaderList headers;
+  std::string header_block;  // fragments until END_HEADERS
+  IOBuf body;
+  bool headers_done = false;
+  int32_t send_window = kDefaultWindow;  // peer's grant for our DATA
+  // Response bytes still waiting for window (flow-controlled remainder),
+  // and — for gRPC — the trailer HEADERS that may only follow the LAST
+  // DATA frame (status rides the trailers, so ordering is correctness).
+  std::string pending_data;
+  bool pending_end = false;
+  std::string pending_trailers;  // pre-framed; sent once data drains
+};
+
+// Per-connection h2 state, hung on Socket::parse_state.
+struct H2Conn {
+  bool preface_done = false;
+  HpackDecoder decoder;
+  HpackEncoder encoder;
+  std::mutex mu;  // response path vs parse path (different fibers)
+  std::map<uint32_t, H2Stream> streams;
+  uint32_t continuation_stream = 0;  // nonzero while CONTINUATIONs expected
+  int32_t conn_send_window = kDefaultWindow;
+  // Peer's SETTINGS_INITIAL_WINDOW_SIZE: seeds NEW streams; a repeated
+  // SETTINGS adjusts open streams by the delta from the PREVIOUS value.
+  int32_t peer_initial_window = kDefaultWindow;
+  uint32_t peer_max_frame = kMaxFrameSize;
+};
+
+const char kH2StateTag = 0;  // address used as the parse_state owner tag
+
+H2Conn* conn_of(Socket* s) {
+  if (s->parse_state == nullptr || s->parse_state_owner != &kH2StateTag) {
+    s->parse_state = std::make_shared<H2Conn>();
+    s->parse_state_owner = &kH2StateTag;
+  }
+  return static_cast<H2Conn*>(s->parse_state.get());
+}
+
+void send_frames(SocketId sid, std::string&& bytes) {
+  SocketRef s(Socket::Address(sid));
+  if (s) {
+    IOBuf out;
+    out.append(bytes);
+    s->Write(std::move(out));
+  }
+}
+
+// Writes as much of the stream's pending response DATA as the windows
+// allow.  Call with conn->mu held.
+void flush_pending_locked(H2Conn* c, SocketId sid, uint32_t stream_id,
+                          H2Stream* st) {
+  std::string out;
+  while (!st->pending_data.empty() && st->send_window > 0 &&
+         c->conn_send_window > 0) {
+    const uint32_t chunk = std::min<uint32_t>(
+        {static_cast<uint32_t>(st->pending_data.size()),
+         static_cast<uint32_t>(st->send_window),
+         static_cast<uint32_t>(c->conn_send_window), c->peer_max_frame});
+    const bool last = chunk == st->pending_data.size() && st->pending_end;
+    out += frame_header(chunk, kData, last ? kEndStream : 0, stream_id);
+    out.append(st->pending_data, 0, chunk);
+    st->pending_data.erase(0, chunk);
+    st->send_window -= static_cast<int32_t>(chunk);
+    c->conn_send_window -= static_cast<int32_t>(chunk);
+  }
+  const bool done = st->pending_data.empty();
+  if (done && !st->pending_trailers.empty()) {
+    out += st->pending_trailers;  // trailers strictly after the last DATA
+    st->pending_trailers.clear();
+  }
+  if (!out.empty()) {
+    send_frames(sid, std::move(out));
+  }
+  if (done) {
+    c->streams.erase(stream_id);
+  }
+}
+
+// gRPC length-prefixed message framing (details/grpc.* parity).
+std::string grpc_frame(const std::string& msg) {
+  std::string out;
+  out.push_back(0);  // uncompressed
+  put_u32(&out, static_cast<uint32_t>(msg.size()));
+  out += msg;
+  return out;
+}
+
+bool grpc_unframe(const IOBuf& body, IOBuf* msg) {
+  if (body.size() < 5) {
+    return false;
+  }
+  uint8_t head[5];
+  body.copy_to(head, 5);
+  if (head[0] != 0) {
+    return false;  // compressed grpc messages unsupported (negotiated off)
+  }
+  const uint32_t len = (static_cast<uint32_t>(head[1]) << 24) |
+                       (static_cast<uint32_t>(head[2]) << 16) |
+                       (static_cast<uint32_t>(head[3]) << 8) | head[4];
+  if (body.size() < 5ull + len) {
+    return false;
+  }
+  IOBuf tmp = body;
+  tmp.pop_front(5);
+  tmp.cutn(msg, len);
+  return true;
+}
+
+const std::string* find_header(const HeaderList& h, const char* name) {
+  for (const auto& [k, v] : h) {
+    if (k == name) {
+      return &v;
+    }
+  }
+  return nullptr;
+}
+
+// Response writer: HEADERS (+DATA, window-limited) (+gRPC trailers).
+void h2_respond(SocketId sid, uint32_t stream_id, int status,
+                const std::string& content_type, const std::string& body,
+                bool grpc, int grpc_status, const std::string& grpc_msg) {
+  SocketRef sref(Socket::Address(sid));
+  if (!sref) {
+    return;
+  }
+  H2Conn* c = conn_of(sref.get());
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->streams.find(stream_id);
+  if (it == c->streams.end()) {
+    return;  // reset by the peer meanwhile
+  }
+  H2Stream* st = &it->second;
+
+  HeaderList resp_headers = {
+      {":status", std::to_string(status)},
+      {"content-type", content_type},
+  };
+  std::string block;
+  c->encoder.encode(resp_headers, &block);
+  std::string out =
+      frame_header(static_cast<uint32_t>(block.size()), kHeaders,
+                   kEndHeaders, stream_id) +
+      block;
+
+  std::string payload = grpc ? grpc_frame(body) : body;
+  if (grpc) {
+    // Trailers carry the status and may only follow the LAST DATA frame:
+    // queue them behind the (window-limited) data so a big response
+    // cannot see END_STREAM before its bytes.
+    st->pending_data = std::move(payload);
+    st->pending_end = false;
+    HeaderList trailers = {
+        {"grpc-status", std::to_string(grpc_status)},
+    };
+    if (!grpc_msg.empty()) {
+      trailers.push_back({"grpc-message", grpc_msg});
+    }
+    std::string tblock;
+    c->encoder.encode(trailers, &tblock);
+    st->pending_trailers =
+        frame_header(static_cast<uint32_t>(tblock.size()), kHeaders,
+                     kEndHeaders | kEndStream, stream_id) +
+        tblock;
+    send_frames(sid, std::move(out));
+    flush_pending_locked(c, sid, stream_id, st);
+    return;
+  }
+  st->pending_data = std::move(payload);
+  st->pending_end = true;
+  if (st->pending_data.empty()) {
+    // Header-only response: END_STREAM rides the HEADERS frame.
+    out = frame_header(static_cast<uint32_t>(block.size()), kHeaders,
+                       kEndHeaders | kEndStream, stream_id) +
+          block;
+    send_frames(sid, std::move(out));
+    c->streams.erase(stream_id);
+    return;
+  }
+  send_frames(sid, std::move(out));
+  flush_pending_locked(c, sid, stream_id, st);
+}
+
+// ---- frame parsing -------------------------------------------------------
+
+bool looks_like_h2(const IOBuf& buf) {
+  char start[kPrefaceLen] = {};
+  const size_t n = buf.copy_to(start, sizeof(start));
+  return memcmp(start, kPreface, std::min(n, kPrefaceLen)) == 0;
+}
+
+ParseError h2_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr) {
+    return ParseError::kTryOtherProtocol;  // h2 needs connection state
+  }
+  if (source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  // During probing (not yet pinned), this connection is ours iff we
+  // already claimed it on an earlier round (preface consumed, state
+  // tagged) or the preface is on the wire now.
+  const bool claimed = sock->parse_state_owner == &kH2StateTag;
+  if (sock->pinned_protocol < 0 && !claimed) {
+    if (!looks_like_h2(*source)) {
+      return ParseError::kTryOtherProtocol;
+    }
+    if (source->size() < kPrefaceLen) {
+      return ParseError::kNotEnoughData;
+    }
+  }
+  H2Conn* c = conn_of(sock);
+  std::lock_guard<std::mutex> g(c->mu);
+  if (!c->preface_done) {
+    source->pop_front(kPrefaceLen);
+    c->preface_done = true;
+    // Our SETTINGS: max frame size + a big connection receive window.
+    std::string settings;
+    std::string payload;
+    payload.append("\x00\x05", 2);  // MAX_FRAME_SIZE
+    put_u32(&payload, kMaxFrameSize);
+    payload.append("\x00\x04", 2);  // INITIAL_WINDOW_SIZE
+    put_u32(&payload, kRecvWindow);
+    settings += frame_header(static_cast<uint32_t>(payload.size()),
+                             kSettings, 0, 0) +
+                payload;
+    // Grow the connection-level receive window too.
+    std::string wu;
+    put_u32(&wu, kRecvWindow - kDefaultWindow);
+    settings += frame_header(4, kWindowUpdate, 0, 0) + wu;
+    send_frames(sock->id(), std::move(settings));
+  }
+
+  while (true) {
+    uint8_t head[kFrameHeaderLen];
+    if (source->copy_to(head, kFrameHeaderLen) < kFrameHeaderLen) {
+      return ParseError::kNotEnoughData;
+    }
+    const uint32_t len = get_u24(head);
+    const uint8_t type = head[3];
+    const uint8_t flags = head[4];
+    const uint32_t stream_id = get_u31(head + 5);
+    if (len > kMaxFrameSize) {
+      return ParseError::kCorrupted;  // exceeds our advertised limit
+    }
+    if (source->size() < kFrameHeaderLen + len) {
+      return ParseError::kNotEnoughData;
+    }
+    source->pop_front(kFrameHeaderLen);
+    std::string payload;
+    payload.resize(len);
+    source->copy_to(payload.data(), len);
+    source->pop_front(len);
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(payload.data());
+
+    // A CONTINUATION barrier: nothing else may interleave.
+    if (c->continuation_stream != 0 &&
+        (type != kContinuation || stream_id != c->continuation_stream)) {
+      return ParseError::kCorrupted;
+    }
+
+    switch (type) {
+      case kSettings: {
+        if (stream_id != 0 || (len % 6 != 0 && (flags & kAck) == 0)) {
+          return ParseError::kCorrupted;
+        }
+        if (flags & kAck) {
+          break;
+        }
+        for (uint32_t off = 0; off + 6 <= len; off += 6) {
+          const uint16_t id = static_cast<uint16_t>(p[off]) << 8 | p[off + 1];
+          const uint32_t val = (static_cast<uint32_t>(p[off + 2]) << 24) |
+                               (static_cast<uint32_t>(p[off + 3]) << 16) |
+                               (static_cast<uint32_t>(p[off + 4]) << 8) |
+                               p[off + 5];
+          if (id == 0x5) {  // MAX_FRAME_SIZE
+            if (val >= 16384 && val <= 1 << 24) {
+              c->peer_max_frame = std::min<uint32_t>(val, 1 << 20);
+            }
+          } else if (id == 0x4) {  // INITIAL_WINDOW_SIZE
+            if (val > 0x7fffffffu) {
+              return ParseError::kCorrupted;
+            }
+            const int32_t delta =
+                static_cast<int32_t>(val) - c->peer_initial_window;
+            c->peer_initial_window = static_cast<int32_t>(val);
+            for (auto& [sid2, st] : c->streams) {
+              st.send_window += delta;
+            }
+          }
+        }
+        send_frames(sock->id(), frame_header(0, kSettings, kAck, 0));
+        break;
+      }
+      case kPing: {
+        if (len != 8 || stream_id != 0) {
+          return ParseError::kCorrupted;
+        }
+        if ((flags & kAck) == 0) {
+          send_frames(sock->id(),
+                      frame_header(8, kPing, kAck, 0) + payload);
+        }
+        break;
+      }
+      case kWindowUpdate: {
+        if (len != 4) {
+          return ParseError::kCorrupted;
+        }
+        const uint32_t inc = get_u31(p);
+        if (inc == 0) {
+          return ParseError::kCorrupted;
+        }
+        if (stream_id == 0) {
+          c->conn_send_window += static_cast<int32_t>(inc);
+        } else {
+          auto it = c->streams.find(stream_id);
+          if (it != c->streams.end()) {
+            it->second.send_window += static_cast<int32_t>(inc);
+            flush_pending_locked(c, sock->id(), stream_id, &it->second);
+          }
+        }
+        break;
+      }
+      case kRstStream: {
+        if (len != 4 || stream_id == 0) {
+          return ParseError::kCorrupted;
+        }
+        c->streams.erase(stream_id);
+        break;
+      }
+      case kGoaway:
+        // Graceful shutdown: in-flight streams finish; the peer closes
+        // the connection when done (EOF path), so just consume it.
+        break;
+      case kPriority:
+      case kPushPromise:
+        break;  // ignored (we never accept pushes; priority is advisory)
+      case kHeaders:
+      case kContinuation: {
+        if (stream_id == 0) {
+          return ParseError::kCorrupted;
+        }
+        if (c->streams.find(stream_id) == c->streams.end()) {
+          if (c->streams.size() >= 256) {
+            // Unbounded half-open streams are a memory DoS; a conforming
+            // client stays far below this.
+            return ParseError::kCorrupted;
+          }
+          c->streams[stream_id].send_window = c->peer_initial_window;
+        }
+        H2Stream& st = c->streams[stream_id];
+        const uint8_t* frag = p;
+        uint32_t frag_len = len;
+        if (type == kHeaders) {
+          uint32_t pad = 0;
+          if (flags & kPadded) {
+            if (frag_len < 1) {
+              return ParseError::kCorrupted;
+            }
+            pad = *frag;
+            ++frag;
+            --frag_len;
+          }
+          if (flags & kPriorityFlag) {
+            if (frag_len < 5) {
+              return ParseError::kCorrupted;
+            }
+            frag += 5;
+            frag_len -= 5;
+          }
+          if (pad > frag_len) {
+            return ParseError::kCorrupted;
+          }
+          frag_len -= pad;
+          if (flags & kEndStream) {
+            st.headers_done = true;  // no body coming
+          }
+        }
+        st.header_block.append(reinterpret_cast<const char*>(frag),
+                               frag_len);
+        if (st.header_block.size() > 256 * 1024) {
+          return ParseError::kCorrupted;
+        }
+        if ((flags & kEndHeaders) == 0) {
+          c->continuation_stream = stream_id;
+          break;
+        }
+        c->continuation_stream = 0;
+        if (!c->decoder.decode(
+                reinterpret_cast<const uint8_t*>(st.header_block.data()),
+                st.header_block.size(), &st.headers)) {
+          return ParseError::kCorrupted;
+        }
+        st.header_block.clear();
+        if (st.headers_done) {  // END_STREAM rode the HEADERS
+          out->meta.type = RpcMeta::kRequest;
+          out->meta.stream_id = stream_id;
+          out->ctx = std::make_shared<HeaderList>(std::move(st.headers));
+          st.headers.clear();
+          return ParseError::kOk;
+        }
+        break;
+      }
+      case kData: {
+        if (stream_id == 0) {
+          return ParseError::kCorrupted;
+        }
+        auto it = c->streams.find(stream_id);
+        if (it == c->streams.end()) {
+          // Reset stream: discard the bytes but still replenish the
+          // CONNECTION window, or the credit leaks away forever.
+          if (len > 0) {
+            std::string wu;
+            put_u32(&wu, len);
+            send_frames(sock->id(),
+                        frame_header(4, kWindowUpdate, 0, 0) + wu);
+          }
+          break;
+        }
+        H2Stream& st = it->second;
+        const uint8_t* d = p;
+        uint32_t dlen = len;
+        if (flags & kPadded) {
+          if (dlen < 1 || d[0] > dlen - 1) {
+            return ParseError::kCorrupted;
+          }
+          dlen -= d[0] + 1;
+          ++d;
+        }
+        st.body.append(d, dlen);
+        if (st.body.size() > (1ull << 30)) {
+          return ParseError::kCorrupted;
+        }
+        // Replenish receive windows as we consume (credit flow control).
+        if (len > 0) {
+          std::string wu;
+          put_u32(&wu, len);
+          std::string frames = frame_header(4, kWindowUpdate, 0, 0) + wu;
+          std::string wu2;
+          put_u32(&wu2, len);
+          frames +=
+              frame_header(4, kWindowUpdate, 0, stream_id) + wu2;
+          send_frames(sock->id(), std::move(frames));
+        }
+        if (flags & kEndStream) {
+          out->meta.type = RpcMeta::kRequest;
+          out->meta.stream_id = stream_id;
+          out->ctx = std::make_shared<HeaderList>(std::move(st.headers));
+          out->payload = std::move(st.body);
+          st.headers.clear();
+          st.body.clear();
+          return ParseError::kOk;
+        }
+        break;
+      }
+      default:
+        break;  // unknown frame types are ignored (RFC 7540 §4.1)
+    }
+    if (source->empty()) {
+      return ParseError::kNotEnoughData;
+    }
+  }
+}
+
+// ---- request processing --------------------------------------------------
+
+void h2_process_request(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  Server* srv = static_cast<Server*>(sock->user_data);
+  auto headers = std::static_pointer_cast<HeaderList>(msg.ctx);
+  const uint32_t stream_id = static_cast<uint32_t>(msg.meta.stream_id);
+  const std::string* path = find_header(*headers, ":path");
+  const std::string* ct = find_header(*headers, "content-type");
+  const bool grpc = ct != nullptr && ct->rfind("application/grpc", 0) == 0;
+  const std::string resp_ct =
+      grpc ? (ct != nullptr ? *ct : "application/grpc") : "text/plain";
+  if (path == nullptr || srv == nullptr) {
+    h2_respond(msg.socket, stream_id, 400, "text/plain", "bad request\n",
+               grpc, 13, "missing :path");
+    return;
+  }
+  // Strip any query for dispatch; reuse the HTTP/1 query machinery.
+  HttpRequest req;
+  const size_t q = path->find('?');
+  req.path = q == std::string::npos ? *path : path->substr(0, q);
+  if (q != std::string::npos) {
+    req.query_string = path->substr(q + 1);
+    parse_query_string(req.query_string, &req.queries);
+  }
+  const std::string* verb = find_header(*headers, ":method");
+  req.verb = verb != nullptr ? *verb : "GET";
+
+  // 1. Builtin endpoints (same table as HTTP/1).
+  std::string body;
+  std::string ctype = "text/plain";
+  int status = 200;
+  if (!grpc && builtin_http_dispatch(srv, req, &status, &body, &ctype)) {
+    h2_respond(msg.socket, stream_id, status, ctype, body, false, 0, "");
+    return;
+  }
+  // 2. Restful, then /Service.Method (gRPC uses /Service/Method).
+  std::string rpc_name;
+  const Server::MethodProperty* prop = srv->find_restful(req.path, &rpc_name);
+  if (prop == nullptr) {
+    rpc_name = req.path.empty() ? "" : req.path.substr(1);
+    if (grpc) {
+      const size_t slash = rpc_name.find('/');
+      if (slash != std::string::npos) {
+        rpc_name[slash] = '.';  // grpc path form → method registry form
+      }
+    }
+    prop = srv->find_method(rpc_name);
+  }
+  if (prop == nullptr) {
+    h2_respond(msg.socket, stream_id, grpc ? 200 : 404, resp_ct, "", grpc,
+               12, "unimplemented: " + rpc_name);
+    return;
+  }
+  std::shared_ptr<ConcurrencyLimiter> limiter = prop->limiter;
+  if (limiter != nullptr && !limiter->on_request()) {
+    h2_respond(msg.socket, stream_id, grpc ? 200 : 503, resp_ct, "", grpc,
+               8, "resource exhausted");
+    return;
+  }
+  IOBuf request;
+  if (grpc) {
+    if (msg.payload.size() > 0 && !grpc_unframe(msg.payload, &request)) {
+      if (limiter != nullptr) {
+        limiter->on_response(0, true);
+      }
+      h2_respond(msg.socket, stream_id, 200, resp_ct, "", true, 13,
+                 "bad grpc framing");
+      return;
+    }
+  } else {
+    request = std::move(msg.payload);
+  }
+
+  auto* cntl = new Controller();
+  cntl->set_method(rpc_name);
+  auto* response = new IOBuf();
+  const SocketId sid = msg.socket;
+  const int64_t start_us = monotonic_time_us();
+  std::shared_ptr<LatencyRecorder> lat = prop->latency;
+  srv->in_flight.fetch_add(1, std::memory_order_acq_rel);
+  auto latch = std::make_shared<CountdownEvent>(1);
+  Closure done = [sid, stream_id, cntl, response, srv, lat, start_us, latch,
+                  limiter, grpc, resp_ct] {
+    if (limiter != nullptr) {
+      limiter->on_response(monotonic_time_us() - start_us, cntl->Failed());
+    }
+    if (cntl->Failed()) {
+      h2_respond(sid, stream_id, grpc ? 200 : 500, resp_ct,
+                 grpc ? "" : cntl->error_text() + "\n", grpc, 2,
+                 cntl->error_text());
+    } else {
+      h2_respond(sid, stream_id, 200,
+                 grpc ? resp_ct : "application/octet-stream",
+                 response->to_string(), grpc, 0, "");
+    }
+    if (lat != nullptr) {
+      *lat << (monotonic_time_us() - start_us);
+    }
+    delete response;
+    delete cntl;
+    srv->requests_served.fetch_add(1, std::memory_order_relaxed);
+    srv->in_flight.fetch_sub(1, std::memory_order_acq_rel);
+    latch->signal();
+  };
+  prop->handler(cntl, request, response, std::move(done));
+  latch->wait(-1);
+}
+
+void h2_process_response(InputMessage&&) {
+  // Server-side only (the RPC client speaks tstd).
+}
+
+}  // namespace
+
+void register_h2_protocol() {
+  static int once = [] {
+    Protocol p = {"h2", h2_parse, h2_process_request, h2_process_response,
+                  /*process_in_order=*/true};
+    return register_protocol(p);
+  }();
+  (void)once;
+}
+
+}  // namespace trpc

@@ -1,0 +1,22 @@
+// HTTP/2 + gRPC serving protocol.
+//
+// Parity: the reference's h2 stack (/root/reference/src/brpc/policy/
+// http2_rpc_protocol.cpp + details/hpack.* + details/grpc.*, ~3,800 LoC).
+// Redesigned condensed, server-side: connection preface pinning, frame
+// parsing (SETTINGS/PING/HEADERS+CONTINUATION/DATA/WINDOW_UPDATE/
+// RST_STREAM/GOAWAY), HPACK header blocks (net/hpack.h), credit-window
+// flow control on BOTH directions (receive windows replenished after
+// delivery; response DATA honors the peer's connection+stream windows,
+// queueing the remainder until WINDOW_UPDATE — the same
+// bounded-window/KeepWrite interaction the RDMA endpoint has), and gRPC
+// message framing + trailers for application/grpc requests.  Requests
+// dispatch exactly like HTTP/1.x: builtin endpoints, restful map, then
+// /Service.Method.
+#pragma once
+
+namespace trpc {
+
+// Registers the h2 protocol (idempotent).  Server::Start calls this.
+void register_h2_protocol();
+
+}  // namespace trpc

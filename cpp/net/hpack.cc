@@ -1,0 +1,352 @@
+#include "net/hpack.h"
+
+#include <cstring>
+
+namespace trpc {
+
+namespace {
+
+#include "net/hpack_huffman.inc"
+
+// RFC 7541 Appendix A static table (1-based).
+struct StaticEntry {
+  const char* name;
+  const char* value;
+};
+const StaticEntry kStatic[] = {
+    {"", ""},  // index 0 unused
+    {":authority", ""},
+    {":method", "GET"},
+    {":method", "POST"},
+    {":path", "/"},
+    {":path", "/index.html"},
+    {":scheme", "http"},
+    {":scheme", "https"},
+    {":status", "200"},
+    {":status", "204"},
+    {":status", "206"},
+    {":status", "304"},
+    {":status", "400"},
+    {":status", "404"},
+    {":status", "500"},
+    {"accept-charset", ""},
+    {"accept-encoding", "gzip, deflate"},
+    {"accept-language", ""},
+    {"accept-ranges", ""},
+    {"accept", ""},
+    {"access-control-allow-origin", ""},
+    {"age", ""},
+    {"allow", ""},
+    {"authorization", ""},
+    {"cache-control", ""},
+    {"content-disposition", ""},
+    {"content-encoding", ""},
+    {"content-language", ""},
+    {"content-length", ""},
+    {"content-location", ""},
+    {"content-range", ""},
+    {"content-type", ""},
+    {"cookie", ""},
+    {"date", ""},
+    {"etag", ""},
+    {"expect", ""},
+    {"expires", ""},
+    {"from", ""},
+    {"host", ""},
+    {"if-match", ""},
+    {"if-modified-since", ""},
+    {"if-none-match", ""},
+    {"if-range", ""},
+    {"if-unmodified-since", ""},
+    {"last-modified", ""},
+    {"link", ""},
+    {"location", ""},
+    {"max-forwards", ""},
+    {"proxy-authenticate", ""},
+    {"proxy-authorization", ""},
+    {"range", ""},
+    {"referer", ""},
+    {"refresh", ""},
+    {"retry-after", ""},
+    {"server", ""},
+    {"set-cookie", ""},
+    {"strict-transport-security", ""},
+    {"transfer-encoding", ""},
+    {"user-agent", ""},
+    {"vary", ""},
+    {"via", ""},
+    {"www-authenticate", ""},
+};
+constexpr uint64_t kStaticCount = 61;
+
+constexpr size_t kEntryOverhead = 32;  // RFC 7541 §4.1
+constexpr size_t kMaxHeaderBytes = 256 * 1024;  // decoded-size bomb guard
+
+}  // namespace
+
+bool hpack_decode_int(const uint8_t** p, const uint8_t* end, int prefix_bits,
+                      uint64_t* out) {
+  if (*p >= end) {
+    return false;
+  }
+  const uint64_t mask = (1u << prefix_bits) - 1;
+  uint64_t v = **p & mask;
+  ++*p;
+  if (v < mask) {
+    *out = v;
+    return true;
+  }
+  uint64_t shift = 0;
+  while (*p < end) {
+    const uint8_t b = **p;
+    ++*p;
+    v += static_cast<uint64_t>(b & 0x7f) << shift;
+    if (shift > 56 || v > (1ull << 62)) {
+      return false;  // unbounded varint
+    }
+    if ((b & 0x80) == 0) {
+      *out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;  // truncated
+}
+
+void hpack_encode_int(uint64_t v, int prefix_bits, uint8_t first_byte_flags,
+                      std::string* out) {
+  const uint64_t mask = (1u << prefix_bits) - 1;
+  if (v < mask) {
+    out->push_back(static_cast<char>(first_byte_flags | v));
+    return;
+  }
+  out->push_back(static_cast<char>(first_byte_flags | mask));
+  v -= mask;
+  while (v >= 0x80) {
+    out->push_back(static_cast<char>(0x80 | (v & 0x7f)));
+    v >>= 7;
+  }
+  out->push_back(static_cast<char>(v));
+}
+
+bool hpack_huffman_decode(const uint8_t* data, size_t len,
+                          std::string* out) {
+  // Canonical decoding: accumulate bits msb-first; at each code length
+  // with assigned symbols, test whether the accumulated code falls in
+  // that length's [min, min+count) range.
+  uint32_t code = 0;
+  int bits = 0;
+  size_t len_idx = 0;  // next candidate row in kHuffLens
+  for (size_t i = 0; i < len; ++i) {
+    for (int b = 7; b >= 0; --b) {
+      code = (code << 1) | ((data[i] >> b) & 1);
+      ++bits;
+      while (len_idx < sizeof(kHuffLens) / sizeof(kHuffLens[0]) &&
+             kHuffLens[len_idx].bits < bits) {
+        ++len_idx;
+      }
+      if (len_idx >= sizeof(kHuffLens) / sizeof(kHuffLens[0])) {
+        return false;  // longer than any code: invalid
+      }
+      const HuffLen& row = kHuffLens[len_idx];
+      if (row.bits == bits && code >= row.min_code &&
+          code < row.min_code + row.count) {
+        const uint16_t sym = kHuffSyms[row.first_sym_idx +
+                                       (code - row.min_code)];
+        if (sym == 256) {
+          return false;  // EOS inside the stream is a coding error
+        }
+        out->push_back(static_cast<char>(sym));
+        if (out->size() > kMaxHeaderBytes) {
+          return false;
+        }
+        code = 0;
+        bits = 0;
+        len_idx = 0;
+      }
+    }
+  }
+  // Padding must be the EOS prefix: all ones, shorter than a byte.
+  if (bits >= 8) {
+    return false;
+  }
+  return code == (1u << bits) - 1;
+}
+
+namespace {
+
+// Reads a §5.2 string literal (optionally huffman-coded).
+bool read_string(const uint8_t** p, const uint8_t* end, std::string* out) {
+  if (*p >= end) {
+    return false;
+  }
+  const bool huff = (**p & 0x80) != 0;
+  uint64_t len = 0;
+  if (!hpack_decode_int(p, end, 7, &len)) {
+    return false;
+  }
+  if (len > static_cast<uint64_t>(end - *p) || len > kMaxHeaderBytes) {
+    return false;
+  }
+  if (huff) {
+    if (!hpack_huffman_decode(*p, len, out)) {
+      return false;
+    }
+  } else {
+    out->assign(reinterpret_cast<const char*>(*p), len);
+  }
+  *p += len;
+  return true;
+}
+
+}  // namespace
+
+bool HpackDecoder::lookup(uint64_t index, std::string* name,
+                          std::string* value) const {
+  if (index == 0) {
+    return false;
+  }
+  if (index <= kStaticCount) {
+    *name = kStatic[index].name;
+    *value = kStatic[index].value;
+    return true;
+  }
+  const uint64_t d = index - kStaticCount - 1;
+  if (d >= dynamic_.size()) {
+    return false;
+  }
+  *name = dynamic_[d].first;
+  *value = dynamic_[d].second;
+  return true;
+}
+
+void HpackDecoder::evict_to(size_t limit) {
+  while (dyn_bytes_ > limit && !dynamic_.empty()) {
+    dyn_bytes_ -= dynamic_.back().first.size() +
+                  dynamic_.back().second.size() + kEntryOverhead;
+    dynamic_.pop_back();
+  }
+}
+
+void HpackDecoder::insert(const std::string& name,
+                          const std::string& value) {
+  const size_t sz = name.size() + value.size() + kEntryOverhead;
+  if (sz > max_size_) {  // larger than the table: empties it (§4.4)
+    evict_to(0);
+    return;
+  }
+  evict_to(max_size_ - sz);
+  dynamic_.insert(dynamic_.begin(), {name, value});
+  dyn_bytes_ += sz;
+}
+
+bool HpackDecoder::decode(const uint8_t* data, size_t len,
+                          HeaderList* out) {
+  const uint8_t* p = data;
+  const uint8_t* end = data + len;
+  size_t total = 0;
+  while (p < end) {
+    const uint8_t b = *p;
+    if (b & 0x80) {  // §6.1 indexed
+      uint64_t index = 0;
+      if (!hpack_decode_int(&p, end, 7, &index)) {
+        return false;
+      }
+      std::string name;
+      std::string value;
+      if (!lookup(index, &name, &value)) {
+        return false;
+      }
+      total += name.size() + value.size();
+      out->emplace_back(std::move(name), std::move(value));
+    } else if (b & 0x40) {  // §6.2.1 literal with incremental indexing
+      uint64_t index = 0;
+      if (!hpack_decode_int(&p, end, 6, &index)) {
+        return false;
+      }
+      std::string name;
+      std::string value;
+      if (index != 0) {
+        std::string unused;
+        if (!lookup(index, &name, &unused)) {
+          return false;
+        }
+      } else if (!read_string(&p, end, &name)) {
+        return false;
+      }
+      if (!read_string(&p, end, &value)) {
+        return false;
+      }
+      insert(name, value);
+      total += name.size() + value.size();
+      out->emplace_back(std::move(name), std::move(value));
+    } else if (b & 0x20) {  // §6.3 dynamic table size update
+      uint64_t sz = 0;
+      if (!hpack_decode_int(&p, end, 5, &sz)) {
+        return false;
+      }
+      if (sz > settings_cap_) {
+        return false;  // must not exceed the SETTINGS ceiling
+      }
+      max_size_ = static_cast<uint32_t>(sz);
+      evict_to(max_size_);
+    } else {  // §6.2.2/§6.2.3 literal without indexing / never indexed
+      uint64_t index = 0;
+      if (!hpack_decode_int(&p, end, 4, &index)) {
+        return false;
+      }
+      std::string name;
+      std::string value;
+      if (index != 0) {
+        std::string unused;
+        if (!lookup(index, &name, &unused)) {
+          return false;
+        }
+      } else if (!read_string(&p, end, &name)) {
+        return false;
+      }
+      if (!read_string(&p, end, &value)) {
+        return false;
+      }
+      total += name.size() + value.size();
+      out->emplace_back(std::move(name), std::move(value));
+    }
+    if (total > kMaxHeaderBytes) {
+      return false;
+    }
+  }
+  return true;
+}
+
+void HpackEncoder::encode(const HeaderList& headers, std::string* out) {
+  for (const auto& [name, value] : headers) {
+    // Exact static match → one indexed byte.
+    uint64_t exact = 0;
+    uint64_t name_only = 0;
+    for (uint64_t i = 1; i <= kStaticCount; ++i) {
+      if (name == kStatic[i].name) {
+        if (name_only == 0) {
+          name_only = i;
+        }
+        if (value == kStatic[i].value) {
+          exact = i;
+          break;
+        }
+      }
+    }
+    if (exact != 0) {
+      hpack_encode_int(exact, 7, 0x80, out);
+      continue;
+    }
+    // Literal without indexing; indexed name when the static table has it.
+    hpack_encode_int(name_only, 4, 0x00, out);
+    if (name_only == 0) {
+      hpack_encode_int(name.size(), 7, 0x00, out);
+      out->append(name);
+    }
+    hpack_encode_int(value.size(), 7, 0x00, out);
+    out->append(value);
+  }
+}
+
+}  // namespace trpc

@@ -1,0 +1,58 @@
+// HPACK — HTTP/2 header compression (RFC 7541).
+//
+// Parity: the reference's hpack.cpp/hpack-static-table.h
+// (/root/reference/src/brpc/details/hpack.cpp — ~1,700 LoC with a
+// node-tree Huffman decoder).  Redesigned condensed: canonical-Huffman
+// decoding by bit-length groups (the RFC code assignment is canonical, so
+// per-length [min_code, max_code] ranges + a symbol array replace the
+// tree entirely), one dynamic table with RFC size accounting, and an
+// encoder that emits never-indexed literals (legal and simple — peers
+// still send us fully indexed/huffman forms, which we decode).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace trpc {
+
+using HeaderList = std::vector<std::pair<std::string, std::string>>;
+
+class HpackDecoder {
+ public:
+  explicit HpackDecoder(uint32_t max_dynamic_size = 4096)
+      : max_size_(max_dynamic_size) {}
+
+  // Decodes one complete header block; false on any malformed input
+  // (connection error per RFC 7540 §4.3).
+  bool decode(const uint8_t* data, size_t len, HeaderList* out);
+
+  size_t dynamic_size() const { return dyn_bytes_; }
+
+ private:
+  bool lookup(uint64_t index, std::string* name, std::string* value) const;
+  void insert(const std::string& name, const std::string& value);
+  void evict_to(size_t limit);
+
+  uint32_t max_size_;
+  uint32_t settings_cap_ = 4096;  // ceiling for table-size updates
+  std::vector<std::pair<std::string, std::string>> dynamic_;  // newest front
+  size_t dyn_bytes_ = 0;
+};
+
+class HpackEncoder {
+ public:
+  // Appends one header block for `headers` to *out (static-table indexed
+  // where an exact match exists; literal-never-indexed otherwise).
+  void encode(const HeaderList& headers, std::string* out);
+};
+
+// Exposed for tests: RFC 7541 §5.1 prefix integers and §5.2 huffman.
+bool hpack_decode_int(const uint8_t** p, const uint8_t* end, int prefix_bits,
+                      uint64_t* out);
+void hpack_encode_int(uint64_t v, int prefix_bits, uint8_t first_byte_flags,
+                      std::string* out);
+bool hpack_huffman_decode(const uint8_t* data, size_t len, std::string* out);
+
+}  // namespace trpc

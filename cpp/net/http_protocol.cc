@@ -32,6 +32,8 @@ bool looks_like_http(const IOBuf& buf) {
   return false;
 }
 
+const char kHttpStateTag = 0;  // parse_state owner tag (see socket.h)
+
 ParseError http_parse(IOBuf* source, InputMessage* out, Socket* sock) {
   if (source->empty()) {
     return ParseError::kNotEnoughData;
@@ -39,10 +41,21 @@ ParseError http_parse(IOBuf* source, InputMessage* out, Socket* sock) {
   if (!looks_like_http(*source)) {
     return ParseError::kTryOtherProtocol;
   }
+  std::shared_ptr<void>* state = nullptr;
+  if (sock != nullptr) {
+    if (sock->parse_state_owner != &kHttpStateTag) {
+      sock->parse_state.reset();  // not ours (or absent): start fresh
+      sock->parse_state_owner = nullptr;
+    }
+    state = &sock->parse_state;
+  }
   auto req = std::make_shared<HttpRequest>();
-  const ParseError rc = http_parse_request(
-      source, req.get(), &out->payload,
-      sock != nullptr ? &sock->parse_state : nullptr);
+  const ParseError rc =
+      http_parse_request(source, req.get(), &out->payload, state);
+  if (sock != nullptr) {
+    sock->parse_state_owner =
+        sock->parse_state != nullptr ? &kHttpStateTag : nullptr;
+  }
   if (rc != ParseError::kOk) {
     return rc;
   }

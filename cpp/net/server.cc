@@ -17,6 +17,7 @@
 #include "base/recordio.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
+#include "net/h2_protocol.h"
 #include "net/http_protocol.h"
 #include "net/messenger.h"
 #include "net/shm_transport.h"
@@ -199,6 +200,7 @@ int Server::Start(int port) {
   fiber_init(0);
   tstd_protocol();  // ensure registered (first: most traffic is RPC)
   register_http_protocol();
+  register_h2_protocol();
   start_time_us_ = monotonic_time_us();
   // Shared-memory transport handshake (net/shm_transport.h): a client sends
   // the segment name it created; we map it and serve that connection over

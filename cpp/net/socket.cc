@@ -70,6 +70,7 @@ void Socket::reset_for_reuse(const Options& opts) {
   wr_ev_.value.store(0, std::memory_order_relaxed);
   writing_.store(false, std::memory_order_relaxed);
   parse_state.reset();
+  parse_state_owner = nullptr;
   wq_head_.store(nullptr, std::memory_order_relaxed);
 }
 

@@ -82,9 +82,14 @@ class Socket {
   void* user_data = nullptr;  // Server*/Channel* context, set by owner
   void* transport_ctx = nullptr;  // per-connection transport state
   // Incremental parser state for protocols that need it (HTTP chunked
-  // bodies resume scanning instead of re-walking the buffer).  Owned by
-  // the read fiber; cleared on socket reuse.
+  // bodies resume scanning; h2 connection state).  Owned by the read
+  // fiber; cleared on socket reuse.  `parse_state_owner` tags WHICH
+  // protocol the state belongs to (a unique static address per protocol):
+  // during protocol probing several parsers see the same socket, and one
+  // that consumed a prefix (h2's preface) must reclaim its state on the
+  // next round instead of misreading another protocol's.
   std::shared_ptr<void> parse_state;
+  const void* parse_state_owner = nullptr;
 
   // -- dispatcher integration (internal) -------------------------------
   void on_input_event();    // readable edge (any thread)

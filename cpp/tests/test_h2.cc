@@ -1,0 +1,556 @@
+// HPACK + HTTP/2 framing tests from hand-built byte sequences (the
+// reference's protocol-unit style, e.g. test/brpc_http_parser_unittest).
+// HPACK vectors are from RFC 7541 Appendix C.
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include "net/channel.h"
+#include "net/hpack.h"
+#include "net/server.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+namespace {
+
+std::string unhex(const char* h) {
+  std::string out;
+  for (size_t i = 0; h[i] != '\0' && h[i + 1] != '\0'; i += 2) {
+    auto val = [](char c) {
+      return c <= '9' ? c - '0' : c - 'a' + 10;
+    };
+    out.push_back(static_cast<char>(val(h[i]) * 16 + val(h[i + 1])));
+  }
+  return out;
+}
+
+const uint8_t* u8(const std::string& s) {
+  return reinterpret_cast<const uint8_t*>(s.data());
+}
+
+}  // namespace
+
+TEST_CASE(hpack_integers_rfc_c1) {
+  // C.1.1: 10 in a 5-bit prefix = 0x0a.
+  std::string enc;
+  hpack_encode_int(10, 5, 0, &enc);
+  EXPECT_EQ(enc.size(), 1u);
+  EXPECT_EQ(static_cast<uint8_t>(enc[0]), 0x0a);
+  // C.1.2: 1337 in a 5-bit prefix = 1f 9a 0a.
+  enc.clear();
+  hpack_encode_int(1337, 5, 0, &enc);
+  EXPECT(enc == unhex("1f9a0a"));
+  // Roundtrip.
+  const uint8_t* p = u8(enc);
+  uint64_t v = 0;
+  EXPECT(hpack_decode_int(&p, u8(enc) + enc.size(), 5, &v));
+  EXPECT_EQ(v, 1337u);
+}
+
+TEST_CASE(hpack_huffman_rfc_vectors) {
+  // C.4.1: "www.example.com" huffman-coded.
+  std::string s = unhex("f1e3c2e5f23a6ba0ab90f4ff");
+  std::string out;
+  EXPECT(hpack_huffman_decode(u8(s), s.size(), &out));
+  EXPECT(out == "www.example.com");
+  // C.4.2: "no-cache".
+  s = unhex("a8eb10649cbf");
+  out.clear();
+  EXPECT(hpack_huffman_decode(u8(s), s.size(), &out));
+  EXPECT(out == "no-cache");
+  // C.6.1: "Mon, 21 Oct 2013 20:13:21 GMT".
+  s = unhex("d07abe941054d444a8200595040b8166e082a62d1bff");
+  out.clear();
+  EXPECT(hpack_huffman_decode(u8(s), s.size(), &out));
+  EXPECT(out == "Mon, 21 Oct 2013 20:13:21 GMT");
+  // Bad padding (zeros) must fail.
+  s = unhex("f1e3c2e5f23a6ba0ab90f400");
+  out.clear();
+  EXPECT(!hpack_huffman_decode(u8(s), s.size(), &out));
+}
+
+TEST_CASE(hpack_decode_rfc_c3_request_sequence) {
+  // C.3: three requests WITHOUT huffman on one connection (dynamic table
+  // evolution across blocks).
+  HpackDecoder dec;
+  HeaderList h;
+  std::string b1 = unhex(
+      "828684410f7777772e6578616d706c652e636f6d");
+  EXPECT(dec.decode(u8(b1), b1.size(), &h));
+  EXPECT_EQ(h.size(), 4u);
+  EXPECT(h[0].first == ":method" && h[0].second == "GET");
+  EXPECT(h[1].first == ":scheme" && h[1].second == "http");
+  EXPECT(h[2].first == ":path" && h[2].second == "/");
+  EXPECT(h[3].first == ":authority" && h[3].second == "www.example.com");
+  EXPECT_EQ(dec.dynamic_size(), 57u);
+
+  h.clear();
+  std::string b2 = unhex("828684be58086e6f2d6361636865");
+  EXPECT(dec.decode(u8(b2), b2.size(), &h));
+  EXPECT_EQ(h.size(), 5u);
+  EXPECT(h[3].second == "www.example.com");  // from the dynamic table
+  EXPECT(h[4].first == "cache-control" && h[4].second == "no-cache");
+
+  h.clear();
+  std::string b3 = unhex(
+      "828785bf400a637573746f6d2d6b65790c637573746f6d2d76616c7565");
+  EXPECT(dec.decode(u8(b3), b3.size(), &h));
+  EXPECT_EQ(h.size(), 5u);
+  EXPECT(h[1].second == "https");
+  EXPECT(h[2].second == "/index.html");
+  EXPECT(h[4].first == "custom-key" && h[4].second == "custom-value");
+  EXPECT_EQ(dec.dynamic_size(), 164u);
+}
+
+TEST_CASE(hpack_decode_rfc_c4_huffman_sequence) {
+  // C.4: the same requests WITH huffman coding.
+  HpackDecoder dec;
+  HeaderList h;
+  std::string b1 = unhex("828684418cf1e3c2e5f23a6ba0ab90f4ff");
+  EXPECT(dec.decode(u8(b1), b1.size(), &h));
+  EXPECT_EQ(h.size(), 4u);
+  EXPECT(h[3].second == "www.example.com");
+  h.clear();
+  std::string b2 = unhex("828684be5886a8eb10649cbf");
+  EXPECT(dec.decode(u8(b2), b2.size(), &h));
+  EXPECT(h[4].second == "no-cache");
+  h.clear();
+  std::string b3 = unhex(
+      "828785bf408825a849e95ba97d7f8925a849e95bb8e8b4bf");
+  EXPECT(dec.decode(u8(b3), b3.size(), &h));
+  EXPECT(h[4].first == "custom-key" && h[4].second == "custom-value");
+}
+
+TEST_CASE(hpack_encoder_roundtrip) {
+  HpackEncoder enc;
+  HeaderList in = {
+      {":method", "POST"},
+      {":path", "/Svc.Method"},
+      {":status", "200"},
+      {"content-type", "application/grpc"},
+      {"x-custom", "v1"},
+  };
+  std::string block;
+  enc.encode(in, &block);
+  HpackDecoder dec;
+  HeaderList out;
+  EXPECT(dec.decode(u8(block), block.size(), &out));
+  EXPECT_EQ(out.size(), in.size());
+  for (size_t i = 0; i < in.size(); ++i) {
+    EXPECT(out[i] == in[i]);
+  }
+}
+
+TEST_CASE(hpack_malformed_rejected) {
+  HpackDecoder dec;
+  HeaderList h;
+  // Index 0 is invalid.
+  std::string bad = unhex("80");
+  EXPECT(!dec.decode(u8(bad), bad.size(), &h));
+  // Truncated varint.
+  bad = unhex("1fff");
+  EXPECT(!dec.decode(u8(bad), bad.size(), &h));
+  // Reference beyond the tables.
+  bad = unhex("ff80808001");
+  EXPECT(!dec.decode(u8(bad), bad.size(), &h));
+}
+
+namespace {
+
+Server* g_server = nullptr;
+int g_port = 0;
+
+void start_once() {
+  if (g_server != nullptr) {
+    return;
+  }
+  g_server = new Server();
+  g_server->RegisterMethod("Echo.Echo", [](Controller*, const IOBuf& req,
+                                           IOBuf* resp, Closure done) {
+    resp->append(req);
+    done();
+  });
+  EXPECT_EQ(g_server->Start(0), 0);
+  g_port = g_server->port();
+}
+
+std::string fh(uint32_t len, uint8_t type, uint8_t flags, uint32_t sid) {
+  std::string h;
+  h.push_back(static_cast<char>(len >> 16));
+  h.push_back(static_cast<char>(len >> 8));
+  h.push_back(static_cast<char>(len));
+  h.push_back(static_cast<char>(type));
+  h.push_back(static_cast<char>(flags));
+  h.push_back(static_cast<char>(sid >> 24));
+  h.push_back(static_cast<char>(sid >> 16));
+  h.push_back(static_cast<char>(sid >> 8));
+  h.push_back(static_cast<char>(sid));
+  return h;
+}
+
+struct H2TestClient {
+  int fd = -1;
+  std::string inbuf;
+
+  bool connect_and_preface() {
+    fd = socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in sa = {};
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    sa.sin_port = htons(static_cast<uint16_t>(g_port));
+    if (connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0) {
+      return false;
+    }
+    const std::string pre = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+    std::string wire = pre + fh(0, 0x4, 0, 0);  // empty SETTINGS
+    return send_all(wire);
+  }
+
+  bool send_all(const std::string& w) {
+    size_t off = 0;
+    while (off < w.size()) {
+      const ssize_t n = write(fd, w.data() + off, w.size() - off);
+      if (n <= 0) {
+        return false;
+      }
+      off += n;
+    }
+    return true;
+  }
+
+  // Reads one full frame (header + payload); appends nothing else.
+  bool read_frame(uint8_t* type, uint8_t* flags, uint32_t* sid,
+                  std::string* payload) {
+    while (true) {
+      if (inbuf.size() >= 9) {
+        const uint32_t len =
+            (static_cast<uint32_t>(static_cast<uint8_t>(inbuf[0])) << 16) |
+            (static_cast<uint32_t>(static_cast<uint8_t>(inbuf[1])) << 8) |
+            static_cast<uint8_t>(inbuf[2]);
+        if (inbuf.size() >= 9ull + len) {
+          *type = static_cast<uint8_t>(inbuf[3]);
+          *flags = static_cast<uint8_t>(inbuf[4]);
+          *sid =
+              ((static_cast<uint32_t>(static_cast<uint8_t>(inbuf[5])) & 0x7f)
+               << 24) |
+              (static_cast<uint32_t>(static_cast<uint8_t>(inbuf[6])) << 16) |
+              (static_cast<uint32_t>(static_cast<uint8_t>(inbuf[7])) << 8) |
+              static_cast<uint8_t>(inbuf[8]);
+          payload->assign(inbuf, 9, len);
+          inbuf.erase(0, 9 + len);
+          return true;
+        }
+      }
+      char buf[8192];
+      const ssize_t n = read(fd, buf, sizeof(buf));
+      if (n <= 0) {
+        return false;
+      }
+      inbuf.append(buf, n);
+    }
+  }
+
+  ~H2TestClient() {
+    if (fd >= 0) {
+      close(fd);
+    }
+  }
+};
+
+}  // namespace
+
+TEST_CASE(h2_end_to_end_echo) {
+  start_once();
+  H2TestClient cli;
+  EXPECT(cli.connect_and_preface());
+  // Request: POST /Echo.Echo with a body across two DATA frames.
+  HpackEncoder enc;
+  HeaderList req_headers = {
+      {":method", "POST"},
+      {":scheme", "http"},
+      {":path", "/Echo.Echo"},
+      {":authority", "test"},
+  };
+  std::string block;
+  enc.encode(req_headers, &block);
+  std::string wire =
+      fh(static_cast<uint32_t>(block.size()), 0x1, 0x4, 1) + block;
+  const std::string part1 = "hello-";
+  const std::string part2 = "http2!";
+  wire += fh(static_cast<uint32_t>(part1.size()), 0x0, 0, 1) + part1;
+  wire += fh(static_cast<uint32_t>(part2.size()), 0x0, 0x1, 1) + part2;
+  EXPECT(cli.send_all(wire));
+
+  // Walk frames until stream 1's DATA arrives.
+  HpackDecoder dec;
+  bool got_headers = false;
+  std::string resp_body;
+  bool end_stream = false;
+  while (!end_stream) {
+    uint8_t type = 0;
+    uint8_t flags = 0;
+    uint32_t sid = 0;
+    std::string payload;
+    EXPECT(cli.read_frame(&type, &flags, &sid, &payload));
+    if (type == 0x1 && sid == 1) {  // HEADERS
+      HeaderList h;
+      EXPECT(dec.decode(reinterpret_cast<const uint8_t*>(payload.data()),
+                        payload.size(), &h));
+      EXPECT(!h.empty() && h[0].first == ":status" && h[0].second == "200");
+      got_headers = true;
+      end_stream = (flags & 0x1) != 0;
+    } else if (type == 0x0 && sid == 1) {  // DATA
+      resp_body += payload;
+      end_stream = (flags & 0x1) != 0;
+    }
+  }
+  EXPECT(got_headers);
+  EXPECT(resp_body == "hello-http2!");
+}
+
+TEST_CASE(h2_grpc_roundtrip) {
+  start_once();
+  H2TestClient cli;
+  EXPECT(cli.connect_and_preface());
+  HpackEncoder enc;
+  HeaderList req_headers = {
+      {":method", "POST"},
+      {":scheme", "http"},
+      {":path", "/Echo/Echo"},  // grpc path form
+      {":authority", "test"},
+      {"content-type", "application/grpc"},
+      {"te", "trailers"},
+  };
+  std::string block;
+  enc.encode(req_headers, &block);
+  std::string msg = "grpc-payload";
+  std::string framed;
+  framed.push_back(0);
+  framed.push_back(0);
+  framed.push_back(0);
+  framed.push_back(0);
+  framed.push_back(static_cast<char>(msg.size()));
+  framed += msg;
+  std::string wire =
+      fh(static_cast<uint32_t>(block.size()), 0x1, 0x4, 1) + block +
+      fh(static_cast<uint32_t>(framed.size()), 0x0, 0x1, 1) + framed;
+  EXPECT(cli.send_all(wire));
+
+  HpackDecoder dec;
+  std::string body;
+  bool got_trailers = false;
+  std::string grpc_status;
+  while (!got_trailers) {
+    uint8_t type = 0;
+    uint8_t flags = 0;
+    uint32_t sid = 0;
+    std::string payload;
+    EXPECT(cli.read_frame(&type, &flags, &sid, &payload));
+    if (type == 0x1 && sid == 1) {
+      HeaderList h;
+      EXPECT(dec.decode(reinterpret_cast<const uint8_t*>(payload.data()),
+                        payload.size(), &h));
+      for (auto& [k, v] : h) {
+        if (k == "grpc-status") {
+          grpc_status = v;
+          got_trailers = true;
+        }
+      }
+    } else if (type == 0x0 && sid == 1) {
+      body += payload;
+    }
+  }
+  EXPECT(grpc_status == "0");
+  // Response = grpc frame header + echoed message.
+  EXPECT_EQ(body.size(), 5 + msg.size());
+  EXPECT(body.substr(5) == msg);
+}
+
+TEST_CASE(h2_builtins_and_multiplex) {
+  start_once();
+  H2TestClient cli;
+  EXPECT(cli.connect_and_preface());
+  HpackEncoder enc;
+  // Two GETs on interleaved streams 1 and 3.
+  std::string wire;
+  for (uint32_t sid : {1u, 3u}) {
+    HeaderList h = {
+        {":method", "GET"},
+        {":scheme", "http"},
+        {":path", sid == 1 ? "/health" : "/version"},
+        {":authority", "test"},
+    };
+    std::string block;
+    enc.encode(h, &block);
+    wire += fh(static_cast<uint32_t>(block.size()), 0x1, 0x4 | 0x1, sid) +
+            block;
+  }
+  EXPECT(cli.send_all(wire));
+  HpackDecoder dec;
+  std::string b1;
+  std::string b3;
+  int open_streams = 2;
+  while (open_streams > 0) {
+    uint8_t type = 0;
+    uint8_t flags = 0;
+    uint32_t sid = 0;
+    std::string payload;
+    EXPECT(cli.read_frame(&type, &flags, &sid, &payload));
+    if (type == 0x0) {
+      (sid == 1 ? b1 : b3) += payload;
+    }
+    if ((type == 0x0 || type == 0x1) && (flags & 0x1) != 0) {
+      --open_streams;
+    }
+    if (type == 0x1) {
+      HeaderList h;
+      EXPECT(dec.decode(reinterpret_cast<const uint8_t*>(payload.data()),
+                        payload.size(), &h));
+    }
+  }
+  EXPECT(b1 == "OK\n");
+  EXPECT(b3.find("tpu-rpc/") != std::string::npos);
+}
+
+TEST_CASE(h2_trickled_bytes) {
+  // The wire arrives in tiny slices: the preface is consumed on an early
+  // parse round BEFORE any complete request exists, so the socket is not
+  // yet pinned — the h2 state tag must keep the connection claimed across
+  // probing rounds.
+  start_once();
+  H2TestClient cli;
+  cli.fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in sa = {};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  sa.sin_port = htons(static_cast<uint16_t>(g_port));
+  EXPECT_EQ(connect(cli.fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)),
+            0);
+  HpackEncoder enc;
+  HeaderList req_headers = {
+      {":method", "POST"},
+      {":scheme", "http"},
+      {":path", "/Echo.Echo"},
+      {":authority", "t"},
+  };
+  std::string block;
+  enc.encode(req_headers, &block);
+  const std::string body = "trickle";
+  std::string wire = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+  wire += fh(0, 0x4, 0, 0);
+  wire += fh(static_cast<uint32_t>(block.size()), 0x1, 0x4, 1) + block;
+  wire += fh(static_cast<uint32_t>(body.size()), 0x0, 0x1, 1) + body;
+  for (size_t off = 0; off < wire.size(); off += 5) {
+    const size_t n = std::min<size_t>(5, wire.size() - off);
+    EXPECT(cli.send_all(wire.substr(off, n)));
+    usleep(2000);  // force separate reads (and separate parse rounds)
+  }
+  std::string resp_body;
+  bool end_stream = false;
+  HpackDecoder dec;
+  while (!end_stream) {
+    uint8_t type = 0;
+    uint8_t flags = 0;
+    uint32_t sid = 0;
+    std::string payload;
+    EXPECT(cli.read_frame(&type, &flags, &sid, &payload));
+    if (type == 0x1 && sid == 1) {
+      HeaderList h;
+      EXPECT(dec.decode(reinterpret_cast<const uint8_t*>(payload.data()),
+                        payload.size(), &h));
+      end_stream = (flags & 0x1) != 0;
+    } else if (type == 0x0 && sid == 1) {
+      resp_body += payload;
+      end_stream = (flags & 0x1) != 0;
+    }
+  }
+  EXPECT(resp_body == body);
+}
+
+TEST_CASE(h2_grpc_large_response_window_drain) {
+  // A gRPC response bigger than the default 64KB window: DATA must stall
+  // at the window, resume on our WINDOW_UPDATEs, and the grpc-status
+  // trailers must arrive strictly AFTER the last DATA byte.
+  static Server big;
+  static std::string blob(200 * 1024, 'G');
+  if (big.port() < 0) {
+    big.RegisterMethod("Big.Get", [](Controller*, const IOBuf&, IOBuf* r,
+                                     Closure done) {
+      r->append(blob);
+      done();
+    });
+    EXPECT_EQ(big.Start(0), 0);
+  }
+  H2TestClient cli;
+  int save_port = g_port;
+  g_port = big.port();
+  EXPECT(cli.connect_and_preface());
+  g_port = save_port;
+  HpackEncoder enc;
+  HeaderList h = {
+      {":method", "POST"},
+      {":scheme", "http"},
+      {":path", "/Big/Get"},
+      {":authority", "t"},
+      {"content-type", "application/grpc"},
+  };
+  std::string block;
+  enc.encode(h, &block);
+  std::string framed;
+  framed.push_back(0);
+  framed.push_back(0);
+  framed.push_back(0);
+  framed.push_back(0);
+  framed.push_back(0);
+  std::string wire =
+      fh(static_cast<uint32_t>(block.size()), 0x1, 0x4, 1) + block +
+      fh(static_cast<uint32_t>(framed.size()), 0x0, 0x1, 1) + framed;
+  EXPECT(cli.send_all(wire));
+
+  HpackDecoder dec;
+  std::string body;
+  bool got_status = false;
+  bool data_after_trailers = false;
+  while (!got_status) {
+    uint8_t type = 0;
+    uint8_t flags = 0;
+    uint32_t sid = 0;
+    std::string payload;
+    EXPECT(cli.read_frame(&type, &flags, &sid, &payload));
+    if (type == 0x0 && sid == 1) {
+      if (got_status) {
+        data_after_trailers = true;
+      }
+      body += payload;
+      // Grant more window as a real client would.
+      std::string wu;
+      wu.push_back(0);
+      wu.push_back(1);
+      wu.push_back(0);
+      wu.push_back(0);  // 65536 increment
+      EXPECT(cli.send_all(fh(4, 0x8, 0, 0) + wu));
+      EXPECT(cli.send_all(fh(4, 0x8, 0, 1) + wu));
+    } else if (type == 0x1 && sid == 1) {
+      HeaderList hh;
+      EXPECT(dec.decode(reinterpret_cast<const uint8_t*>(payload.data()),
+                        payload.size(), &hh));
+      for (auto& [k, v] : hh) {
+        if (k == "grpc-status") {
+          EXPECT(v == "0");
+          got_status = true;
+        }
+      }
+    }
+  }
+  EXPECT(!data_after_trailers);
+  EXPECT_EQ(body.size(), 5 + blob.size());
+  EXPECT(body.substr(5) == blob);
+}
+
+TEST_MAIN
