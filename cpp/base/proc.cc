@@ -1,0 +1,41 @@
+#include "base/proc.h"
+
+#include <dirent.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+namespace trpc {
+
+long proc_status_kb(const char* key) {
+  FILE* f = fopen("/proc/self/status", "r");
+  if (f == nullptr) {
+    return -1;
+  }
+  char line[256];
+  long val = -1;
+  const size_t klen = strlen(key);
+  while (fgets(line, sizeof(line), f) != nullptr) {
+    if (strncmp(line, key, klen) == 0) {
+      val = atol(line + klen);
+      break;
+    }
+  }
+  fclose(f);
+  return val;
+}
+
+long proc_fd_count() {
+  DIR* d = opendir("/proc/self/fd");
+  if (d == nullptr) {
+    return -1;
+  }
+  long n = 0;
+  while (readdir(d) != nullptr) {
+    ++n;
+  }
+  closedir(d);
+  return n - 2;  // . and ..
+}
+
+}  // namespace trpc

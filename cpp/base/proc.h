@@ -1,0 +1,12 @@
+// /proc/self introspection shared by /memory, /threads and the default
+// process variables (bvar/default_variables.cpp parity).
+#pragma once
+
+namespace trpc {
+
+// Value of a "Key:  <n> kB"-style line in /proc/self/status; -1 if absent.
+long proc_status_kb(const char* key);
+// Open fd count for this process (-1 on failure).
+long proc_fd_count();
+
+}  // namespace trpc

@@ -5,7 +5,9 @@
 
 #include <cerrno>
 
+#include "base/time.h"
 #include "fiber/event.h"
+#include "stat/profiler.h"
 
 namespace trpc {
 
@@ -18,6 +20,9 @@ class FiberMutex {
                                           std::memory_order_relaxed)) {
       return;
     }
+    // Contended slow path: sampled by the contention profiler (parity:
+    // bthread/mutex.cpp's lock-wait sampling feeding /contention).
+    const int64_t t0 = monotonic_time_us();
     do {
       if (c == 2 ||
           ev_.value.compare_exchange_strong(c, 2, std::memory_order_acquire,
@@ -28,6 +33,8 @@ class FiberMutex {
     } while (!ev_.value.compare_exchange_strong(c, 2,
                                                 std::memory_order_acquire,
                                                 std::memory_order_relaxed));
+    contention_record(__builtin_return_address(0),
+                      monotonic_time_us() - t0);
   }
 
   bool try_lock() {

@@ -10,11 +10,13 @@
 #include <string>
 
 #include "base/flags.h"
+#include "base/proc.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
 #include "net/http_protocol.h"
 #include "net/server.h"
 #include "net/span.h"
+#include "stat/profiler.h"
 #include "stat/variable.h"
 
 namespace trpc {
@@ -22,26 +24,6 @@ namespace trpc {
 std::atomic<int64_t> g_socket_count{0};
 
 namespace {
-
-// /proc/self introspection for /memory and /threads (parity:
-// bvar/default_variables.cpp reads the same files).
-long proc_status_kb(const char* key) {
-  FILE* f = fopen("/proc/self/status", "r");
-  if (f == nullptr) {
-    return -1;
-  }
-  char line[256];
-  long val = -1;
-  const size_t klen = strlen(key);
-  while (fgets(line, sizeof(line), f) != nullptr) {
-    if (strncmp(line, key, klen) == 0) {
-      val = atol(line + klen);
-      break;
-    }
-  }
-  fclose(f);
-  return val;
-}
 
 std::string flags_text() {
   std::string out;
@@ -182,6 +164,27 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req, int* status,
     *body = std::move(out);
     return true;
   }
+  if (path == "/hotspots") {
+    // CPU profile: SIGPROF sampling for ?seconds=N (default 2, cap 30),
+    // rendered as a flat symbolized profile (hotspots_service parity).
+    int seconds = 2;
+    const std::string* sq = req.query("seconds");
+    if (sq != nullptr) {
+      seconds = atoi(sq->c_str());
+    }
+    if (seconds < 1) {
+      seconds = 1;
+    }
+    if (seconds > 30) {
+      seconds = 30;
+    }
+    *body = profile_cpu_for(seconds);
+    return true;
+  }
+  if (path == "/contention") {
+    *body = contention_dump();
+    return true;
+  }
   if (path == "/threads") {
     *body = "fiber_workers " + std::to_string(fiber_worker_count()) +
             "\nos_threads " + std::to_string(proc_status_kb("Threads:")) +
@@ -206,7 +209,8 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req, int* status,
     *body =
         "/health\n/version\n/status\n/vars\n/vars/<name>\n/brpc_metrics\n"
         "/connections\n/flags\n/flags/<name>[?setvalue=v]\n/threads\n"
-        "/memory\n/list\n/protobufs\n/index\n/rpcz[?trace_id=hex]\n";
+        "/memory\n/list\n/protobufs\n/index\n/rpcz[?trace_id=hex]\n"
+        "/hotspots[?seconds=N]\n/contention\n";
     return true;
   }
   (void)content_type;

@@ -196,8 +196,11 @@ int Server::SetMethodMaxConcurrency(const std::string& method,
   return 0;
 }
 
+void expose_default_variables();  // stat/default_variables.cc
+
 int Server::Start(int port) {
   fiber_init(0);
+  expose_default_variables();
   tstd_protocol();  // ensure registered (first: most traffic is RPC)
   register_http_protocol();
   register_h2_protocol();

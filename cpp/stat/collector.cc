@@ -1,0 +1,47 @@
+#include "stat/collector.h"
+
+#include "base/time.h"
+
+namespace trpc {
+
+Collector::Collector(int64_t samples_per_second)
+    : budget_(samples_per_second), tokens_(samples_per_second) {}
+
+void Collector::refill_if_due() {
+  const int64_t now = monotonic_time_us();
+  int64_t last = last_refill_us_.load(std::memory_order_relaxed);
+  if (now - last < 1000000) {
+    return;
+  }
+  if (last_refill_us_.compare_exchange_strong(last, now,
+                                              std::memory_order_relaxed)) {
+    tokens_.store(budget_, std::memory_order_relaxed);
+  }
+}
+
+bool Collector::sample() {
+  refill_if_due();
+  if (tokens_.load(std::memory_order_relaxed) <= 0) {
+    return false;
+  }
+  return tokens_.fetch_sub(1, std::memory_order_relaxed) > 0;
+}
+
+void Collector::submit(std::string bytes) {
+  submitted_.fetch_add(1, std::memory_order_relaxed);
+  std::lock_guard<std::mutex> g(mu_);
+  queue_.push_back(std::move(bytes));
+  // Bound queue growth if no drainer is attached.
+  if (queue_.size() > 65536) {
+    queue_.erase(queue_.begin(), queue_.begin() + 32768);
+  }
+}
+
+std::vector<std::string> Collector::drain() {
+  std::vector<std::string> out;
+  std::lock_guard<std::mutex> g(mu_);
+  out.swap(queue_);
+  return out;
+}
+
+}  // namespace trpc

@@ -1,0 +1,51 @@
+// Collector — bounded-rate sampled-object aggregation.
+//
+// Parity: bvar::Collector (/root/reference/src/bvar/collector.h): callers
+// submit objects ("should I be sampled?"), a global budget caps the
+// per-second intake, and a background consumer drains batches to a sink
+// (the reference feeds rpc_dump and latency sampling through it).
+// Condensed: a token bucket answers sampling cheaply on the hot path and
+// an MPSC-ish mutex queue hands batches to the registered drainer.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace trpc {
+
+class Collector {
+ public:
+  // samples_per_second: global intake budget (reference default 1000).
+  explicit Collector(int64_t samples_per_second = 1000);
+
+  // Hot-path gate: true when the caller should hand over a sample now
+  // (consumes one token).  Wait-free-ish: one fetch_sub on the bucket.
+  bool sample();
+
+  // Submits a sampled payload (only after sample() said yes).
+  void submit(std::string bytes);
+
+  // Drains everything queued since the last drain (the background
+  // consumer calls this; tests call it directly).
+  std::vector<std::string> drain();
+
+  int64_t submitted() const {
+    return submitted_.load(std::memory_order_relaxed);
+  }
+
+ private:
+  void refill_if_due();
+
+  const int64_t budget_;
+  std::atomic<int64_t> tokens_;
+  std::atomic<int64_t> last_refill_us_{0};
+  std::atomic<int64_t> submitted_{0};
+  std::mutex mu_;
+  std::vector<std::string> queue_;
+};
+
+}  // namespace trpc

@@ -1,0 +1,72 @@
+#include "stat/mvariable.h"
+
+namespace trpc {
+
+namespace {
+// Prometheus label-value escaping: backslash, quote, newline.
+std::string escape_label(const std::string& v) {
+  std::string out;
+  out.reserve(v.size());
+  for (char c : v) {
+    if (c == '\\' || c == '"') {
+      out.push_back('\\');
+      out.push_back(c);
+    } else if (c == '\n') {
+      out += "\\n";
+    } else {
+      out.push_back(c);
+    }
+  }
+  return out;
+}
+}  // namespace
+
+void MAdder::add(const std::vector<std::string>& label_values,
+                 int64_t delta) {
+  if (label_values.size() != label_names_.size()) {
+    return;  // dimensional mismatch: drop (reference CHECKs; we degrade)
+  }
+  std::lock_guard<std::mutex> g(mu_);
+  series_[label_values] += delta;
+}
+
+int64_t MAdder::get(const std::vector<std::string>& label_values) const {
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = series_.find(label_values);
+  return it == series_.end() ? 0 : it->second;
+}
+
+size_t MAdder::count_series() const {
+  std::lock_guard<std::mutex> g(mu_);
+  return series_.size();
+}
+
+std::string MAdder::value_str() const {
+  std::lock_guard<std::mutex> g(mu_);
+  std::string out;
+  for (const auto& [labels, v] : series_) {
+    out += "{";
+    for (size_t i = 0; i < labels.size(); ++i) {
+      out += (i != 0 ? "," : "") + label_names_[i] + "=" + labels[i];
+    }
+    out += "}=" + std::to_string(v) + " ";
+  }
+  return out;
+}
+
+std::string MAdder::prometheus_str(const std::string& name) const {
+  const std::string metric = sanitize_metric_name(name);
+  std::lock_guard<std::mutex> g(mu_);
+  std::string out = "# TYPE " + metric + " counter\n";
+  for (const auto& [labels, v] : series_) {
+    out += metric + "{";
+    for (size_t i = 0; i < labels.size(); ++i) {
+      out += (i != 0 ? "," : "") + sanitize_metric_name(label_names_[i]) +
+             "=\"" + escape_label(labels[i]) + "\"";
+    }
+    out += "} " + std::to_string(v) + "\n";
+  }
+  return out;
+}
+
+}  // namespace trpc

@@ -1,0 +1,45 @@
+// MVariable — labeled (multi-dimensional) metrics.
+//
+// Parity: bvar::MVariable (/root/reference/src/bvar/multi_dimension.h):
+// one logical metric fanned out over label tuples, each combination
+// backed by its own underlying variable, dumped as labeled Prometheus
+// series.  Condensed: a mutex-guarded map from label values to a stat
+// object; the hot path (per-label add) is the underlying reducer's
+// thread-local combine, the map lookup amortizes via a caller-held
+// handle.
+#pragma once
+
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "stat/variable.h"
+
+namespace trpc {
+
+// M-dimensional counter family: MAdder("rpc_errors", {"method", "code"}).
+class MAdder : public Variable {
+ public:
+  MAdder(const std::string& name, std::vector<std::string> label_names)
+      : label_names_(std::move(label_names)) {
+    expose(name);
+  }
+  ~MAdder() override { hide(); }
+
+  // Adds to the series for `label_values` (size must match label_names).
+  void add(const std::vector<std::string>& label_values, int64_t delta);
+  int64_t get(const std::vector<std::string>& label_values) const;
+  size_t count_series() const;
+
+  std::string value_str() const override;
+  std::string prometheus_str(const std::string& name) const override;
+
+ private:
+  std::vector<std::string> label_names_;
+  mutable std::mutex mu_;
+  std::map<std::vector<std::string>, int64_t> series_;
+};
+
+}  // namespace trpc

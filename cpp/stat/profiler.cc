@@ -1,0 +1,197 @@
+#include "stat/profiler.h"
+
+#include <dlfcn.h>
+#include <execinfo.h>
+#include <signal.h>
+#include <string.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <map>
+#include <mutex>
+#include <vector>
+
+#include "base/time.h"
+#include "fiber/fiber.h"
+
+namespace trpc {
+
+namespace {
+
+// ---- CPU sampling ring ---------------------------------------------------
+
+constexpr int kMaxDepth = 24;
+constexpr size_t kRingSize = 16384;  // samples
+
+struct Sample {
+  int depth;
+  void* frames[kMaxDepth];
+};
+
+// Fixed-size ring written by the signal handler (no locks, no allocation;
+// the writer is single — signals are per-process and serialized).
+Sample* g_ring = nullptr;
+std::atomic<size_t> g_ring_next{0};
+std::atomic<bool> g_profiling{false};
+
+void sigprof_handler(int, siginfo_t*, void*) {
+  if (!g_profiling.load(std::memory_order_relaxed) || g_ring == nullptr) {
+    return;
+  }
+  const size_t slot = g_ring_next.fetch_add(1, std::memory_order_relaxed);
+  if (slot >= kRingSize) {
+    return;  // ring full: drop further samples
+  }
+  Sample& s = g_ring[slot];
+  // backtrace() is not strictly async-signal-safe but is the standard
+  // practice for SIGPROF samplers (gperftools does its own unwind); the
+  // first call pre-loads libgcc outside the handler (profiler_start).
+  s.depth = backtrace(s.frames, kMaxDepth);
+}
+
+std::string symbolize(void* addr) {
+  Dl_info info;
+  if (dladdr(addr, &info) != 0 && info.dli_sname != nullptr) {
+    return info.dli_sname;
+  }
+  char buf[32];
+  snprintf(buf, sizeof(buf), "%p", addr);
+  return buf;
+}
+
+// One profile at a time.  An atomic flag, NOT a mutex: the /hotspots
+// fiber sleeps between start and stop and may resume on a different OS
+// thread (work stealing), where unlocking a std::mutex would be UB.
+std::atomic<bool> g_prof_busy{false};
+
+// ---- contention aggregate ------------------------------------------------
+
+struct ContentionStat {
+  int64_t count = 0;
+  int64_t total_wait_us = 0;
+};
+std::mutex g_cont_mu;
+std::map<void*, ContentionStat>& contention_map() {
+  static auto* m = new std::map<void*, ContentionStat>();
+  return *m;
+}
+
+}  // namespace
+
+bool profiler_start(int hz) {
+  bool expect = false;
+  if (!g_prof_busy.compare_exchange_strong(expect, true,
+                                           std::memory_order_acq_rel)) {
+    return false;
+  }
+  // Pre-load the unwinder outside signal context.
+  void* warm[4];
+  backtrace(warm, 4);
+  if (g_ring == nullptr) {
+    g_ring = new Sample[kRingSize];  // leaked with the profiler
+  }
+  g_ring_next.store(0, std::memory_order_relaxed);
+  struct sigaction sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sa_sigaction = sigprof_handler;
+  sa.sa_flags = SA_SIGINFO | SA_RESTART;
+  sigaction(SIGPROF, &sa, nullptr);
+  g_profiling.store(true, std::memory_order_release);
+  itimerval tv;
+  tv.it_interval.tv_sec = 0;
+  tv.it_interval.tv_usec = 1000000 / (hz > 0 ? hz : 100);
+  tv.it_value = tv.it_interval;
+  setitimer(ITIMER_PROF, &tv, nullptr);
+  return true;
+}
+
+std::string profiler_stop_and_dump(size_t max_rows) {
+  itimerval off;
+  memset(&off, 0, sizeof(off));
+  setitimer(ITIMER_PROF, &off, nullptr);
+  g_profiling.store(false, std::memory_order_release);
+  // A handler delivered just before the disarm may still be mid-write on
+  // another thread; give it a beat before reading the ring.
+  usleep(2000);
+  const size_t n =
+      std::min(g_ring_next.load(std::memory_order_relaxed), kRingSize);
+
+  // Aggregate leaf-ward frames (skip the handler's own frames).
+  std::map<std::string, int64_t> by_frame;
+  for (size_t i = 0; i < n; ++i) {
+    const Sample& s = g_ring[i];
+    // frames[0..1] are the signal trampoline/handler; count the rest,
+    // each frame once per sample (inclusive counting).
+    for (int d = 2; d < s.depth; ++d) {
+      ++by_frame[symbolize(s.frames[d])];
+    }
+  }
+  std::vector<std::pair<int64_t, std::string>> rows;
+  rows.reserve(by_frame.size());
+  for (auto& [sym, cnt] : by_frame) {
+    rows.push_back({cnt, sym});
+  }
+  std::sort(rows.rbegin(), rows.rend());
+  std::string out = "samples " + std::to_string(n) + "\n";
+  char line[512];
+  for (size_t i = 0; i < rows.size() && i < max_rows; ++i) {
+    snprintf(line, sizeof(line), "%8lld  %5.1f%%  %s\n",
+             static_cast<long long>(rows[i].first),
+             n > 0 ? 100.0 * rows[i].first / n : 0.0,
+             rows[i].second.c_str());
+    out += line;
+  }
+  g_prof_busy.store(false, std::memory_order_release);
+  return out;
+}
+
+std::string profile_cpu_for(int seconds, int hz) {
+  if (!profiler_start(hz)) {
+    return "another profile is already running\n";
+  }
+  fiber_sleep_us(static_cast<int64_t>(seconds) * 1000000);
+  return profiler_stop_and_dump();
+}
+
+void contention_record(void* site, int64_t wait_us) {
+  // Sampled 1/16 (thread-local counter): recording EVERY contended wait
+  // through one global mutex would itself become a process-wide
+  // serialization point — the reference samples too (bthread/mutex.cpp).
+  static thread_local uint32_t counter = 0;
+  if ((counter++ & 15) != 0) {
+    return;
+  }
+  std::lock_guard<std::mutex> g(g_cont_mu);
+  auto& m = contention_map();
+  if (m.size() > 4096 && m.find(site) == m.end()) {
+    return;  // bounded
+  }
+  ContentionStat& s = m[site];
+  ++s.count;
+  s.total_wait_us += wait_us;
+}
+
+std::string contention_dump(size_t max_rows) {
+  std::vector<std::pair<int64_t, std::string>> rows;
+  {
+    std::lock_guard<std::mutex> g(g_cont_mu);
+    for (auto& [site, st] : contention_map()) {
+      char line[512];
+      snprintf(line, sizeof(line), "%10lld us  %8lld waits  %s",
+               static_cast<long long>(st.total_wait_us),
+               static_cast<long long>(st.count),
+               symbolize(site).c_str());
+      rows.push_back({st.total_wait_us, line});
+    }
+  }
+  std::sort(rows.rbegin(), rows.rend());
+  std::string out = "contended lock sites (by total wait)\n";
+  for (size_t i = 0; i < rows.size() && i < max_rows; ++i) {
+    out += rows[i].second + "\n";
+  }
+  return out;
+}
+
+}  // namespace trpc

@@ -1,0 +1,37 @@
+// Sampling profilers: CPU (/hotspots) and lock contention (/contention).
+//
+// Parity: the reference's /hotspots service (builtin/hotspots_service.cpp
+// — weak-linked gperftools ProfilerStart at :36, ContentionProfilerStart
+// at :41, bthread mutex wait sampling in bthread/mutex.cpp).  Redesigned
+// self-contained: a SIGPROF itimer samples backtraces into a fixed ring
+// (no allocation in the handler), aggregation + symbolization (dladdr)
+// happen at dump time; contention events are recorded by the FiberMutex
+// slow path with their wait duration and aggregated by call site.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+namespace trpc {
+
+// ---- CPU profiler --------------------------------------------------------
+
+// Starts SIGPROF sampling at `hz` (one profile at a time; false if one is
+// already running).
+bool profiler_start(int hz = 100);
+// Stops sampling and renders a flat text profile: sample counts per
+// symbolized frame, callers included, most-hit first.
+std::string profiler_stop_and_dump(size_t max_rows = 60);
+// Convenience for /hotspots: profile this process for `seconds` (the
+// calling fiber sleeps through it).
+std::string profile_cpu_for(int seconds, int hz = 100);
+
+// ---- contention profiler -------------------------------------------------
+
+// Records one contended-lock wait (called by FiberMutex's slow path; keeps
+// a bounded aggregate keyed by return address).
+void contention_record(void* site, int64_t wait_us);
+// Renders aggregated contention sites: total wait, count, symbol.
+std::string contention_dump(size_t max_rows = 40);
+
+}  // namespace trpc

@@ -8,7 +8,16 @@
 #include "stat/reducer.h"
 #include "stat/variable.h"
 #include "stat/window.h"
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "stat/collector.h"
+#include "stat/mvariable.h"
+#include "stat/profiler.h"
 #include "tests/test_util.h"
+
+namespace trpc {
+void expose_default_variables();  // stat/default_variables.cc
+}
 
 using namespace trpc;
 
@@ -107,6 +116,112 @@ TEST_CASE(latency_recorder_percentiles) {
   const int64_t p99 = rec.latency_percentile_us(0.99);
   EXPECT(p99 > 900);
   EXPECT(rec.latency_avg_us() > 400 && rec.latency_avg_us() < 600);
+}
+
+TEST_CASE(mvariable_labeled_series) {
+  MAdder errors("rpc_errors_total", {"method", "code"});
+  errors.add({"Echo.Echo", "0"}, 5);
+  errors.add({"Echo.Echo", "14"}, 2);
+  errors.add({"Other.M", "0"}, 1);
+  errors.add({"Echo.Echo", "0"}, 3);
+  errors.add({"bad"}, 9);  // dimensional mismatch: dropped
+  EXPECT_EQ(errors.count_series(), 3u);
+  EXPECT_EQ(errors.get({"Echo.Echo", "0"}), 8);
+  EXPECT_EQ(errors.get({"Echo.Echo", "14"}), 2);
+  const std::string prom = errors.prometheus_str("rpc_errors_total");
+  EXPECT(prom.find("rpc_errors_total{method=\"Echo.Echo\",code=\"0\"} 8") !=
+         std::string::npos);
+  EXPECT(prom.find("# TYPE rpc_errors_total counter") != std::string::npos);
+  // Registered: shows up in the exposed dump.
+  bool found = false;
+  for (auto& [name, value] : Variable::dump_exposed()) {
+    if (name == "rpc_errors_total") {
+      found = true;
+    }
+  }
+  EXPECT(found);
+}
+
+TEST_CASE(collector_budget_and_drain) {
+  Collector c(10);  // 10 samples/second
+  int admitted = 0;
+  for (int i = 0; i < 1000; ++i) {
+    if (c.sample()) {
+      ++admitted;
+      c.submit("s" + std::to_string(i));
+    }
+  }
+  EXPECT_EQ(admitted, 10);  // budget caps intake within the window
+  auto batch = c.drain();
+  EXPECT_EQ(batch.size(), 10u);
+  EXPECT(c.drain().empty());
+  EXPECT_EQ(c.submitted(), 10);
+}
+
+TEST_CASE(default_variables_exposed) {
+  // Server::Start wires these; call the exposer directly here.
+  trpc::expose_default_variables();
+  bool rss = false;
+  bool cpu = false;
+  for (auto& [name, value] : Variable::dump_exposed()) {
+    if (name == "process_memory_rss_kb" && atol(value.c_str()) > 0) {
+      rss = true;
+    }
+    if (name == "process_cpu_percent") {
+      cpu = true;
+    }
+  }
+  EXPECT(rss);
+  EXPECT(cpu);
+}
+
+TEST_CASE(contention_profiler_records_waits) {
+  static FiberMutex mu;
+  static std::atomic<int> sum{0};
+  std::vector<fiber_t> ids(4);
+  for (auto& f : ids) {
+    fiber_start(&f, [](void*) {
+      for (int i = 0; i < 200; ++i) {
+        mu.lock();
+        sum.fetch_add(1);
+        fiber_sleep_us(100);  // hold briefly so others contend
+        mu.unlock();
+      }
+    }, nullptr);
+  }
+  for (auto f : ids) {
+    fiber_join(f);
+  }
+  EXPECT_EQ(sum.load(), 800);
+  const std::string dump = contention_dump();
+  // At least one data row: "<total> us  <count> waits  <symbol>" with a
+  // nonzero total (800 contended acquisitions, sampled 1/16).
+  const size_t nl = dump.find('\n');
+  EXPECT(nl != std::string::npos && nl + 1 < dump.size());
+  const std::string row =
+      dump.substr(nl + 1, dump.find('\n', nl + 1) - nl - 1);
+  EXPECT(row.find("waits") != std::string::npos);
+  EXPECT(atol(row.c_str()) > 0);
+}
+
+TEST_CASE(cpu_profiler_samples_a_hot_loop) {
+  EXPECT(profiler_start(250));
+  // Burn CPU so SIGPROF fires (ITIMER_PROF counts cpu time).
+  volatile uint64_t x = 0;
+  const int64_t until = monotonic_time_us() + 600 * 1000;
+  while (monotonic_time_us() < until) {
+    for (int i = 0; i < 10000; ++i) {
+      x += i * i;
+    }
+  }
+  const std::string prof = profiler_stop_and_dump();
+  // Some samples landed and were symbolized.
+  EXPECT(prof.find("samples ") == 0);
+  const long n = atol(prof.c_str() + 8);
+  EXPECT(n > 5);
+  // A second profile can start after the first finished.
+  EXPECT(profiler_start(100));
+  profiler_stop_and_dump();
 }
 
 TEST_MAIN
