@@ -1,0 +1,32 @@
+// Authentication seam — per-connection credential verify.
+//
+// Parity: the reference's Authenticator (/root/reference/src/brpc/
+// authenticator.h: GenerateCredential on the client's first message,
+// VerifyCredential server-side; the "auth fight" in
+// input_messenger.cpp:271-289 makes exactly one first message verify per
+// connection).  Condensed: the client sends one kAuth-typed frame as the
+// FIRST write on a new connection (FIFO write queue = guaranteed
+// ordering); the server verifies it once, marks the socket, and rejects
+// any request arriving on an unverified socket when an authenticator is
+// installed.
+#pragma once
+
+#include <string>
+
+#include "base/endpoint.h"
+
+namespace trpc {
+
+class Authenticator {
+ public:
+  virtual ~Authenticator() = default;
+  // Client: fills the credential carried by the connection's first frame.
+  // Nonzero fails the connect.
+  virtual int generate_credential(std::string* auth_str) const = 0;
+  // Server: verifies a peer's credential.  Nonzero rejects (and fails)
+  // the connection.
+  virtual int verify_credential(const std::string& auth_str,
+                                const EndPoint& peer) const = 0;
+};
+
+}  // namespace trpc

@@ -39,7 +39,10 @@ void complete_locked_call(fid_t cid, Controller* cntl) {
           // error for the same reason).
           s->SetFailed(ESHUTDOWN);
         } else {
-          SocketMap::instance()->give_back(s->remote(), conn);
+          SocketMap::instance()->give_back(
+              s->remote(),
+              static_cast<const Authenticator*>(cntl->call().conn_auth),
+              conn);
         }
       }
     } else if (ct == ConnectionType::kShort) {
@@ -160,6 +163,26 @@ std::string Channel::transport_name() {
   return s ? s->transport()->name() : "";
 }
 
+// First write on a fresh connection: the credential frame (FIFO write
+// queue guarantees it precedes every request).
+static int send_credential(SocketId sid, const Authenticator* auth) {
+  if (auth == nullptr) {
+    return 0;
+  }
+  std::string cred;
+  if (auth->generate_credential(&cred) != 0) {
+    return -1;
+  }
+  RpcMeta meta;
+  meta.type = RpcMeta::kAuth;
+  IOBuf payload;
+  payload.append(cred);
+  IOBuf frame;
+  tstd_pack(&frame, meta, payload);
+  SocketRef s(Socket::Address(sid));
+  return s && s->Write(std::move(frame)) == 0 ? 0 : -1;
+}
+
 int Channel::ensure_socket(SocketId* out) {
   LockGuard<FiberMutex> g(sock_mu_);
   Socket* s = Socket::Address(sock_);
@@ -204,6 +227,13 @@ int Channel::ensure_socket(SocketId* out) {
   if (Socket::Create(sopts, &sock_) != 0) {
     return -1;
   }
+  if (send_credential(sock_, opts_.auth) != 0) {
+    SocketRef dead(Socket::Address(sock_));
+    if (dead) {
+      dead->SetFailed(EACCES);
+    }
+    return -1;
+  }
   *out = sock_;
   return 0;
 }
@@ -218,6 +248,7 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
   // leak into this call's early-failure paths.
   cntl->call().socket_id = 0;
   cntl->call().conn_type = 0;
+  cntl->call().conn_auth = nullptr;
   const bool sync = !cntl->call().done;
   // rpcz: client span; a handler fiber's ambient server span becomes the
   // parent (channel.cpp:506-527 parity).
@@ -261,11 +292,20 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
   }
   int sock_rc;
   switch (ct) {
-    case ConnectionType::kPooled:
-      sock_rc = SocketMap::instance()->take_pooled(ep_, &sid);
+    case ConnectionType::kPooled: {
+      bool fresh = false;
+      sock_rc =
+          SocketMap::instance()->take_pooled(ep_, opts_.auth, &sid, &fresh);
+      if (sock_rc == 0 && fresh) {
+        sock_rc = send_credential(sid, opts_.auth);
+      }
       break;
+    }
     case ConnectionType::kShort:
       sock_rc = SocketMap::instance()->create_short(ep_, &sid);
+      if (sock_rc == 0) {
+        sock_rc = send_credential(sid, opts_.auth);
+      }
       break;
     case ConnectionType::kSingle:
     default:
@@ -273,6 +313,14 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
       break;
   }
   if (sock_rc != 0) {
+    if (sid != 0) {
+      // The socket exists but the credential could not be sent: close it
+      // rather than leaking a connected fd per failed call.
+      SocketRef dead(Socket::Address(sid));
+      if (dead) {
+        dead->SetFailed(EACCES);
+      }
+    }
     fid_unlock(cid);
     fid_error(cid, ECONNREFUSED);
     if (sync) {
@@ -282,6 +330,7 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
   }
   cntl->call().socket_id = sid;
   cntl->call().conn_type = static_cast<uint8_t>(ct);
+  cntl->call().conn_auth = opts_.auth;
 
   const int64_t eff_timeout_ms = cntl->timeout_ms_or(opts_.timeout_ms);
   if (eff_timeout_ms > 0) {

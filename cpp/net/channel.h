@@ -10,6 +10,7 @@
 #include <string>
 
 #include "base/endpoint.h"
+#include "net/auth.h"
 #include "fiber/sync.h"
 #include "net/controller.h"
 #include "net/socket.h"
@@ -25,6 +26,8 @@ class Channel {
     // shared connection; "pooled" gives each call an exclusive one from
     // a shared per-endpoint pool; "short" is one per call).
     std::string connection_type = "single";
+    // Client credential for servers requiring auth (auth.h; not owned).
+    const Authenticator* auth = nullptr;
     // Same-host shared-memory transport (net/shm_transport.h): the channel
     // handshakes a ring segment over TCP, then calls flow through shm.
     // Falls back to TCP transparently if the handshake fails.

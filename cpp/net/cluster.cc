@@ -2,7 +2,11 @@
 
 #include "net/concurrency_limiter.h"
 
+#include <arpa/inet.h>
 #include <errno.h>
+#include <netdb.h>
+#include <string.h>
+#include <sys/socket.h>
 
 #include <algorithm>
 #include <atomic>
@@ -224,6 +228,39 @@ class FileNS : public NamingService {
   }
 };
 
+// dns://host:port — getaddrinfo resolution of EVERY address behind the
+// name, re-resolved on each refresher cycle (parity: the http:// DNS
+// naming service + details/naming_service_thread periodic re-resolve).
+class DnsNS : public NamingService {
+ public:
+  int resolve(const std::string& param,
+              std::vector<std::pair<EndPoint, int>>* out) override {
+    const size_t colon = param.rfind(':');
+    if (colon == std::string::npos) {
+      return -1;
+    }
+    const std::string host = param.substr(0, colon);
+    const std::string port = param.substr(colon + 1);
+    addrinfo hints;
+    memset(&hints, 0, sizeof(hints));
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0) {
+      return -1;
+    }
+    for (addrinfo* p = res; p != nullptr; p = p->ai_next) {
+      const auto* sa = reinterpret_cast<sockaddr_in*>(p->ai_addr);
+      EndPoint ep;
+      ep.ip = sa->sin_addr.s_addr;
+      ep.port = ntohs(sa->sin_port);
+      out->emplace_back(ep, 1);
+    }
+    freeaddrinfo(res);
+    return out->empty() ? -1 : 0;
+  }
+};
+
 }  // namespace
 
 std::unique_ptr<NamingService> NamingService::create(const std::string& url,
@@ -235,6 +272,10 @@ std::unique_ptr<NamingService> NamingService::create(const std::string& url,
   if (url.rfind("file://", 0) == 0) {
     *param = url.substr(7);
     return std::make_unique<FileNS>();
+  }
+  if (url.rfind("dns://", 0) == 0) {
+    *param = url.substr(6);
+    return std::make_unique<DnsNS>();
   }
   // Bare "host:port" degenerates to a one-server list.
   *param = url;

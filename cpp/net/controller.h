@@ -73,7 +73,8 @@ class Controller {
     void* span = nullptr;  // rpcz client Span (owned until submit)
     // Connection ownership for pooled/short calls (socket_map.h): the
     // completion path gives pooled sockets back / closes short ones.
-    uint8_t conn_type = 0;  // ConnectionType
+    uint8_t conn_type = 0;      // ConnectionType
+    const void* conn_auth = nullptr;  // pool key half (Authenticator*)
     IOBuf* response = nullptr;
     Closure done;
     int64_t start_us = 0;

@@ -553,6 +553,15 @@ void h2_process_request(InputMessage&& msg) {
   const uint32_t stream_id = static_cast<uint32_t>(msg.meta.stream_id);
   const std::string* path = find_header(*headers, ":path");
   const std::string* ct = find_header(*headers, "content-type");
+  if (srv != nullptr && srv->authenticator() != nullptr &&
+      !sock->auth_ok.load(std::memory_order_acquire) &&
+      (path == nullptr || *path != "/health")) {
+    // Same-port auth gate as HTTP/1 (h2 clients carry no kAuth frame).
+    h2_respond(msg.socket, static_cast<uint32_t>(msg.meta.stream_id), 403,
+               "text/plain", "connection not authenticated\n", false, 16,
+               "unauthenticated");
+    return;
+  }
   const bool grpc = ct != nullptr && ct->rfind("application/grpc", 0) == 0;
   const std::string resp_ct =
       grpc ? (ct != nullptr ? *ct : "application/grpc") : "text/plain";

@@ -95,6 +95,17 @@ void http_process_request(InputMessage&& msg) {
   Server* srv = static_cast<Server*>(sock->user_data);
   auto req = std::static_pointer_cast<HttpRequest>(msg.ctx);
   CHECK(req != nullptr);
+  // An installed authenticator gates EVERY serving protocol: HTTP/h2
+  // clients cannot present a kAuth credential, so only the liveness
+  // probe stays open (otherwise auth would be bypassable by speaking a
+  // different protocol to the same port).
+  if (srv != nullptr && srv->authenticator() != nullptr &&
+      !sock->auth_ok.load(std::memory_order_acquire) &&
+      req->path != "/health") {
+    http_respond(msg.socket, *req, 403, "text/plain",
+                 "connection not authenticated\n");
+    return;
+  }
 
   // 1. Builtin observability endpoints.
   std::string body;

@@ -22,10 +22,11 @@ void process_message_fiber(void* arg) {
     s->Dereference();
   }
   if (p != nullptr) {
-    if (msg->meta.type == RpcMeta::kRequest) {
-      p->process_request(std::move(*msg));
-    } else {
+    // kResponse is the only client-bound type; kAuth etc. are served.
+    if (msg->meta.type == RpcMeta::kResponse) {
       p->process_response(std::move(*msg));
+    } else {
+      p->process_request(std::move(*msg));
     }
   }
   delete msg;
@@ -67,13 +68,24 @@ void cut_and_dispatch(Socket* s, SocketId id) {
           continue;
         }
         const Protocol* p = protocol_at(s->pinned_protocol);
+        if (p != nullptr && msg->meta.type == RpcMeta::kAuth) {
+          // Credential frames verify INLINE in the read fiber: requests
+          // cut after this frame must observe auth_ok (the reference's
+          // first-message verify fight, input_messenger.cpp:271-289 —
+          // spawning a fiber here would let a request race the verify).
+          p->process_request(std::move(*msg));
+          delete msg;
+          continue;
+        }
         if (p != nullptr && p->process_in_order) {
           // FIFO protocols (no correlation id): run inline, keeping this
           // connection's response order.
-          if (msg->meta.type == RpcMeta::kRequest) {
-            p->process_request(std::move(*msg));
-          } else {
+          // kResponse is the only client-bound type; everything else
+          // (requests, kAuth credentials) belongs to the serving path.
+          if (msg->meta.type == RpcMeta::kResponse) {
             p->process_response(std::move(*msg));
+          } else {
+            p->process_request(std::move(*msg));
           }
           delete msg;
         } else {

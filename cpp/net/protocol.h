@@ -27,7 +27,13 @@ enum class ParseError : int {
 };
 
 struct RpcMeta {
-  enum Type : uint8_t { kRequest = 0, kResponse = 1, kStreamFrame = 2 };
+  enum Type : uint8_t {
+    kRequest = 0,
+    kResponse = 1,
+    kStreamFrame = 2,
+    // Connection-scoped credential, sent as the FIRST frame (auth.h).
+    kAuth = 3,
+  };
   // Stream flags (parity: streaming_rpc_meta.proto frame types).
   enum StreamFlags : uint8_t {
     kStreamData = 0,

@@ -384,6 +384,36 @@ void tstd_process_request(InputMessage&& msg) {
     return;
   }
   Server* srv = static_cast<Server*>(sock->user_data);
+  // Connection authentication (auth.h; input_messenger.cpp:271-289
+  // parity).  The credential frame verifies once and marks the socket;
+  // with an authenticator installed, requests on an unverified socket
+  // are refused and the connection failed.
+  if (msg.meta.type == RpcMeta::kAuth) {
+    const Authenticator* auth =
+        srv != nullptr ? srv->authenticator() : nullptr;
+    if (auth != nullptr &&
+        auth->verify_credential(msg.payload.to_string(), sock->remote()) ==
+            0) {
+      sock->auth_ok.store(true, std::memory_order_release);
+    } else if (auth != nullptr) {
+      LOG(Warning) << "auth credential rejected; closing connection";
+      sock->SetFailed(EACCES);
+    }
+    return;  // credential frames carry no request
+  }
+  if (srv != nullptr && srv->authenticator() != nullptr &&
+      !sock->auth_ok.load(std::memory_order_acquire)) {
+    RpcMeta meta;
+    meta.type = RpcMeta::kResponse;
+    meta.correlation_id = msg.meta.correlation_id;
+    meta.error_code = EACCES;
+    meta.error_text = "connection not authenticated";
+    IOBuf frame;
+    tstd_pack(&frame, meta, IOBuf());
+    sock->Write(std::move(frame));
+    sock->SetFailed(EACCES);
+    return;
+  }
   const SocketId socket_id = msg.socket;
   const uint64_t cid = msg.meta.correlation_id;
   const std::string method = msg.meta.method;

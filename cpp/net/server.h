@@ -14,6 +14,7 @@
 #include <vector>
 
 #include "base/flat_map.h"
+#include "net/auth.h"
 #include "base/recordio.h"
 #include "fiber/sync.h"
 #include "net/concurrency_limiter.h"
@@ -42,6 +43,12 @@ class Server {
   // (AIMD).  Call before Start.
   int SetMethodMaxConcurrency(const std::string& method,
                               const std::string& spec);
+
+  // Installs connection authentication (auth.h; not owned).  Call before
+  // Start.  With an authenticator set, every framed-protocol connection
+  // must open with a valid kAuth credential or its requests are refused.
+  void set_authenticator(const Authenticator* auth) { auth_ = auth; }
+  const Authenticator* authenticator() const { return auth_; }
 
   ~Server();
 
@@ -96,6 +103,7 @@ class Server {
   FiberMutex dump_mu_;
   std::atomic<double> dump_rate_{0.0};
 
+  const Authenticator* auth_ = nullptr;
   FlatMap<std::string, MethodProperty> methods_;
   // (pattern segments, trailing-wildcard, method name), longest first.
   struct RestfulRule {

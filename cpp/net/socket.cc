@@ -71,6 +71,7 @@ void Socket::reset_for_reuse(const Options& opts) {
   writing_.store(false, std::memory_order_relaxed);
   parse_state.reset();
   parse_state_owner = nullptr;
+  auth_ok.store(false, std::memory_order_relaxed);
   wq_head_.store(nullptr, std::memory_order_relaxed);
 }
 

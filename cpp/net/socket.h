@@ -79,6 +79,10 @@ class Socket {
   IOBuf& read_buf() { return read_buf_; }
   // Protocol index pinned after first successful parse (-1 = unknown).
   int pinned_protocol = -1;
+  // Set once the server verified this connection's kAuth credential
+  // (auth.h); requests on unverified sockets are rejected when the
+  // server has an authenticator installed.
+  std::atomic<bool> auth_ok{false};
   void* user_data = nullptr;  // Server*/Channel* context, set by owner
   void* transport_ctx = nullptr;  // per-connection transport state
   // Incremental parser state for protocols that need it (HTTP chunked

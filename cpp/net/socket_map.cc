@@ -33,10 +33,14 @@ int SocketMap::create_socket(const EndPoint& ep, SocketId* out) {
   return Socket::Create(sopts, out);
 }
 
-int SocketMap::take_pooled(const EndPoint& ep, SocketId* out) {
+int SocketMap::take_pooled(const EndPoint& ep, const Authenticator* auth,
+                           SocketId* out, bool* fresh) {
+  if (fresh != nullptr) {
+    *fresh = false;
+  }
   {
     std::lock_guard<std::mutex> g(mu_);
-    auto it = pools_.find(ep);
+    auto it = pools_.find(PoolKey{ep, auth});
     while (it != pools_.end() && !it->second.empty()) {
       const SocketId id = it->second.back();
       it->second.pop_back();
@@ -52,10 +56,14 @@ int SocketMap::take_pooled(const EndPoint& ep, SocketId* out) {
       // Stale/failed: drop and keep scanning.
     }
   }
+  if (fresh != nullptr) {
+    *fresh = true;
+  }
   return create_socket(ep, out);
 }
 
-void SocketMap::give_back(const EndPoint& ep, SocketId id) {
+void SocketMap::give_back(const EndPoint& ep, const Authenticator* auth,
+                          SocketId id) {
   Socket* s = Socket::Address(id);
   if (s == nullptr) {
     return;  // died in flight; nothing to pool
@@ -66,16 +74,17 @@ void SocketMap::give_back(const EndPoint& ep, SocketId id) {
     return;
   }
   std::lock_guard<std::mutex> g(mu_);
-  pools_[ep].push_back(id);
+  pools_[PoolKey{ep, auth}].push_back(id);
 }
 
 int SocketMap::create_short(const EndPoint& ep, SocketId* out) {
   return create_socket(ep, out);
 }
 
-size_t SocketMap::pooled_count(const EndPoint& ep) {
+size_t SocketMap::pooled_count(const EndPoint& ep,
+                               const Authenticator* auth) {
   std::lock_guard<std::mutex> g(mu_);
-  auto it = pools_.find(ep);
+  auto it = pools_.find(PoolKey{ep, auth});
   return it == pools_.end() ? 0 : it->second.size();
 }
 

@@ -24,6 +24,8 @@
 
 namespace trpc {
 
+class Authenticator;
+
 enum class ConnectionType : uint8_t {
   kSingle = 0,
   kPooled = 1,
@@ -41,20 +43,37 @@ class SocketMap {
   // Exclusive pooled connection to ep: reuses a healthy free one or
   // creates a new one.  Returns 0 and a socket the caller owns until
   // give_back.
-  int take_pooled(const EndPoint& ep, SocketId* out);
+  // The pool key includes the channel's authenticator: a connection
+  // authenticated under one identity must never serve another (the
+  // reference keys SocketMap by auth for the same reason).
+  int take_pooled(const EndPoint& ep, const Authenticator* auth,
+                  SocketId* out, bool* fresh = nullptr);
   // Returns the connection for reuse (failed ones are dropped).
-  void give_back(const EndPoint& ep, SocketId id);
+  void give_back(const EndPoint& ep, const Authenticator* auth, SocketId id);
   // Fresh one-shot connection; the caller fails it after the call.
   int create_short(const EndPoint& ep, SocketId* out);
 
   // Free connections currently pooled for ep (tests/introspection).
-  size_t pooled_count(const EndPoint& ep);
+  size_t pooled_count(const EndPoint& ep, const Authenticator* auth = nullptr);
 
  private:
+  struct PoolKey {
+    EndPoint ep;
+    const Authenticator* auth;
+    bool operator==(const PoolKey& o) const {
+      return ep == o.ep && auth == o.auth;
+    }
+  };
+  struct PoolKeyHash {
+    size_t operator()(const PoolKey& k) const {
+      return EndPointHash()(k.ep) ^
+             std::hash<const void*>()(k.auth);
+    }
+  };
   int create_socket(const EndPoint& ep, SocketId* out);
 
   std::mutex mu_;
-  std::unordered_map<EndPoint, std::vector<SocketId>, EndPointHash> pools_;
+  std::unordered_map<PoolKey, std::vector<SocketId>, PoolKeyHash> pools_;
 };
 
 }  // namespace trpc

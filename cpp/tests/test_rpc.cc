@@ -1,6 +1,9 @@
 // L4 end-to-end RPC tests — in-process server+client over loopback, the
 // reference's integration style (/root/reference/test/brpc_channel_unittest.cpp
 // fixtures; SURVEY.md §4 "the loopback stack IS the fixture").
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -426,6 +429,102 @@ TEST_CASE(device_arena_zero_copy_rpc) {
   // Block lifecycle: dropping the request returns the blocks.
   req.clear();
   EXPECT_EQ(arena.blocks_in_use(), 0u);
+}
+
+namespace {
+class TokenAuth : public Authenticator {
+ public:
+  explicit TokenAuth(std::string tok) : tok_(std::move(tok)) {}
+  int generate_credential(std::string* out) const override {
+    *out = tok_;
+    return 0;
+  }
+  int verify_credential(const std::string& cred,
+                        const EndPoint&) const override {
+    return cred == tok_ ? 0 : -1;
+  }
+
+ private:
+  std::string tok_;
+};
+}  // namespace
+
+TEST_CASE(authenticated_connections) {
+  static TokenAuth good("sesame");
+  static TokenAuth bad("wrong");
+  static Server auth_srv;
+  auth_srv.RegisterMethod("A.Echo", [](Controller*, const IOBuf& req,
+                                       IOBuf* resp, Closure done) {
+    resp->append(req);
+    done();
+  });
+  auth_srv.set_authenticator(&good);
+  EXPECT_EQ(auth_srv.Start(0), 0);
+  const std::string srv_addr = "127.0.0.1:" + std::to_string(auth_srv.port());
+
+  // Correct credential: calls flow.
+  {
+    Channel ch;
+    Channel::Options opts;
+    opts.auth = &good;
+    EXPECT_EQ(ch.Init(srv_addr, &opts), 0);
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("authed");
+    ch.CallMethod("A.Echo", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+    EXPECT(resp.to_string() == "authed");
+  }
+  // Wrong credential: connection refused at first request.
+  {
+    Channel ch;
+    Channel::Options opts;
+    opts.auth = &bad;
+    EXPECT_EQ(ch.Init(srv_addr, &opts), 0);
+    Controller cntl;
+    cntl.set_timeout_ms(1000);
+    IOBuf req, resp;
+    req.append("nope");
+    ch.CallMethod("A.Echo", req, &resp, &cntl);
+    EXPECT(cntl.Failed());
+  }
+  // No credential at all: rejected with EACCES by the server.
+  {
+    Channel ch;
+    EXPECT_EQ(ch.Init(srv_addr), 0);
+    Controller cntl;
+    cntl.set_timeout_ms(1000);
+    IOBuf req, resp;
+    req.append("anon");
+    ch.CallMethod("A.Echo", req, &resp, &cntl);
+    EXPECT(cntl.Failed());
+    EXPECT_EQ(cntl.error_code(), EACCES);
+  }
+  // The HTTP path cannot bypass the authenticator (same-port gate);
+  // only the liveness probe stays open.
+  {
+    const int fd = socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in sa = {};
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    sa.sin_port = htons(static_cast<uint16_t>(auth_srv.port()));
+    EXPECT_EQ(connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)), 0);
+    const std::string rq =
+        "POST /A.Echo HTTP/1.1\r\nHost: x\r\nContent-Length: 1\r\n\r\nz";
+    EXPECT(write(fd, rq.data(), rq.size()) ==
+           static_cast<ssize_t>(rq.size()));
+    char buf[512];
+    const ssize_t n = read(fd, buf, sizeof(buf));
+    EXPECT(n > 0);
+    EXPECT(std::string(buf, n).find("403") != std::string::npos);
+    const std::string hq = "GET /health HTTP/1.1\r\nHost: x\r\n\r\n";
+    EXPECT(write(fd, hq.data(), hq.size()) ==
+           static_cast<ssize_t>(hq.size()));
+    const ssize_t n2 = read(fd, buf, sizeof(buf));
+    EXPECT(n2 > 0);
+    EXPECT(std::string(buf, n2).find("200 OK") != std::string::npos);
+    close(fd);
+  }
 }
 
 TEST_MAIN

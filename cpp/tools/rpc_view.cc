@@ -1,0 +1,150 @@
+// rpc_view — eavesdropping proxy: forwards a port to a target server and
+// pretty-prints what flows through.
+//
+// Parity: /root/reference/tools/rpc_view (an HTTP proxy used to inspect
+// any brpc port).  Condensed: a byte-level TCP proxy with protocol
+// sniffing — framed-protocol metas and HTTP request/status lines are
+// summarized per direction as they pass.
+//
+// Usage: rpc_view <listen_port> <target_host:port>
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "base/endpoint.h"
+#include "base/iobuf.h"
+#include <thread>
+
+#include "net/protocol.h"
+
+using namespace trpc;
+
+namespace {
+
+std::atomic<long> g_conn_seq{0};
+
+void describe(const char* dir, long conn, IOBuf* pending) {
+  // Try to cut complete framed messages for display; fall back to HTTP
+  // first-lines; otherwise byte counts.
+  while (true) {
+    InputMessage msg;
+    const ParseError rc = tstd_protocol().parse(pending, &msg, nullptr);
+    if (rc == ParseError::kOk) {
+      printf("[conn %ld %s] tstd %s method='%s' cid=%llu payload=%zuB%s\n",
+             conn, dir,
+             msg.meta.type == RpcMeta::kRequest    ? "request"
+             : msg.meta.type == RpcMeta::kResponse ? "response"
+             : msg.meta.type == RpcMeta::kAuth     ? "auth"
+                                                   : "stream",
+             msg.meta.method.c_str(),
+             static_cast<unsigned long long>(msg.meta.correlation_id),
+             msg.payload.size(),
+             msg.meta.error_code != 0 ? " [ERROR]" : "");
+      continue;
+    }
+    if (rc == ParseError::kNotEnoughData) {
+      return;  // keep the tail for the next read
+    }
+    // Not framed: show HTTP-ish first lines once, then just counts.
+    const std::string text = pending->to_string();
+    const size_t eol = text.find("\r\n");
+    if (eol != std::string::npos && eol < 200) {
+      printf("[conn %ld %s] %s (+%zuB)\n", conn, dir,
+             text.substr(0, eol).c_str(), text.size() - eol);
+    } else {
+      printf("[conn %ld %s] %zu bytes\n", conn, dir, text.size());
+    }
+    pending->clear();
+    return;
+  }
+}
+
+struct PumpArgs {
+  int from;
+  int to;
+  const char* dir;
+  long conn;
+};
+
+// Runs on a plain pthread: pumps do fully blocking IO, which would pin
+// the fiber runtime's few worker threads (a proxy's connections are
+// long-lived and mostly idle).
+void pump(PumpArgs* a) {
+  IOBuf pending;
+  char buf[64 * 1024];
+  while (true) {
+    const ssize_t n = read(a->from, buf, sizeof(buf));
+    if (n <= 0) {
+      break;
+    }
+    size_t off = 0;
+    while (off < static_cast<size_t>(n)) {
+      const ssize_t w = write(a->to, buf + off, n - off);
+      if (w <= 0) {
+        goto done;
+      }
+      off += w;
+    }
+    pending.append(buf, n);
+    describe(a->dir, a->conn, &pending);
+  }
+done:
+  shutdown(a->to, SHUT_WR);
+  shutdown(a->from, SHUT_RD);
+  delete a;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  setvbuf(stdout, nullptr, _IOLBF, 0);
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <listen_port> <target_host:port>\n", argv[0]);
+    return 1;
+  }
+  EndPoint target;
+  if (hostname2endpoint(argv[2], &target) != 0) {
+    fprintf(stderr, "bad target %s\n", argv[2]);
+    return 1;
+  }
+  const int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sa = {};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_ANY);
+  sa.sin_port = htons(static_cast<uint16_t>(atoi(argv[1])));
+  if (bind(lfd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0 ||
+      listen(lfd, 64) != 0) {
+    perror("bind/listen");
+    return 1;
+  }
+  printf("rpc_view: forwarding :%s -> %s\n", argv[1], argv[2]);
+  while (true) {
+    const int cfd = accept(lfd, nullptr, nullptr);
+    if (cfd < 0) {
+      continue;
+    }
+    const int tfd = socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in ta = {};
+    ta.sin_family = AF_INET;
+    ta.sin_addr.s_addr = target.ip;
+    ta.sin_port = htons(static_cast<uint16_t>(target.port));
+    if (connect(tfd, reinterpret_cast<sockaddr*>(&ta), sizeof(ta)) != 0) {
+      perror("connect target");
+      close(cfd);
+      close(tfd);
+      continue;
+    }
+    const long conn = g_conn_seq.fetch_add(1);
+    printf("[conn %ld] accepted\n", conn);
+    std::thread(pump, new PumpArgs{cfd, tfd, "->", conn}).detach();
+    std::thread(pump, new PumpArgs{tfd, cfd, "<-", conn}).detach();
+  }
+}
