@@ -108,6 +108,13 @@ void Event::publish_post(void* a1, void* a2) {
     // Raced with a change: don't block after all.
     w->no_link = true;
     requeue = true;
+  } else if (w->fiber->interrupted.load(std::memory_order_acquire)) {
+    // A pending interrupt that arrived before we could link would be lost
+    // (the interrupter's wake found no node): don't park at all — the
+    // wait converts the flag to EINTR.  Decided UNDER the lock; touching
+    // the node after unlock would race a concurrent waker freeing it.
+    w->no_link = true;
+    requeue = true;
   } else {
     w->linked = true;
     w->prev = ev->tail_;
@@ -152,8 +159,17 @@ int Event::wait(uint32_t expected, int64_t deadline_us) {
     node->fiber = w->current();
     node->expected = expected;
     node->deadline_us = deadline_us;
+    node->fiber->park_lock();
+    node->fiber->parked_on.store(this, std::memory_order_release);
+    node->fiber->park_unlock();
     w->suspend_current(&Event::publish_post, this, node);
-    // Resumed: either woken, timed out, or never linked.
+    // Resumed: either woken, timed out, interrupted, or never linked.
+    // Clearing parked_on under the park lock guarantees no interrupter is
+    // still inside wake_all on this Event when we return (and possibly
+    // destroy it — fiber_sleep parks on a stack Event).
+    node->fiber->park_lock();
+    node->fiber->parked_on.store(nullptr, std::memory_order_release);
+    node->fiber->park_unlock();
     int rc = 0;
     uint64_t timer_to_cancel = 0;
     lock();
@@ -167,7 +183,11 @@ int Event::wait(uint32_t expected, int64_t deadline_us) {
         TimerThread::instance()->unschedule(timer_to_cancel)) {
       node->unref();  // timer will never run
     }
+    FiberMeta* self = node->fiber;  // pool memory, outlives the node
     node->unref();
+    if (self->interrupted.exchange(false, std::memory_order_acq_rel)) {
+      rc = EINTR;  // fiber_interrupt consumed by this wait
+    }
     return rc;
   }
   // -- pthread path --

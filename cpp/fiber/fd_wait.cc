@@ -1,0 +1,167 @@
+// fiber_fd_wait — park a fiber until an arbitrary fd is ready.
+//
+// Parity: bthread_fd_wait/timedwait (/root/reference/src/bthread/fd.cpp):
+// fibers wait on fds they do not own through the event machinery instead
+// of blocking worker pthreads.  Redesigned: a dedicated poller pthread
+// runs its own epoll of ONESHOT registrations keyed by fd; each fd keeps
+// a waiter LIST (concurrent waits on one fd — reader and writer — are
+// armed with the union of their masks and woken selectively), and each
+// wait parks on a per-call Event the poller wakes.  (Sockets owned by the
+// runtime keep using the main dispatcher; this path serves user fds.)
+#include <errno.h>
+#include <pthread.h>
+#include <sys/epoll.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <map>
+#include <mutex>
+#include <vector>
+
+#include "base/logging.h"
+#include "fiber/event.h"
+#include "fiber/fiber.h"
+
+namespace trpc {
+
+namespace {
+
+struct FdWait {
+  Event ev;               // value 0 = pending; 1 = ready
+  int want = 0;           // EPOLLIN / EPOLLOUT / ...
+  std::atomic<int> revents{0};
+};
+
+class FdPoller {
+ public:
+  static FdPoller* instance() {
+    static FdPoller* p = new FdPoller();  // leaked singleton
+    return p;
+  }
+
+  int wait(int fd, int events, int64_t deadline_us) {
+    FdWait w;
+    w.want = events;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      fds_[fd].push_back(&w);
+      if (rearm_locked(fd) != 0) {
+        const int saved = errno;
+        unregister_locked(fd, &w);
+        errno = saved;
+        return -1;
+      }
+    }
+    const int rc = w.ev.wait(0, deadline_us);
+    {
+      // Removing ourselves under the lock guarantees the poller is not
+      // mid-wake on our stack-resident Event after we return.
+      std::lock_guard<std::mutex> g(mu_);
+      unregister_locked(fd, &w);
+    }
+    if (rc == ETIMEDOUT || rc == EINTR) {
+      errno = rc;
+      return -1;
+    }
+    return w.revents.load(std::memory_order_acquire);
+  }
+
+ private:
+  FdPoller() {
+    epfd_ = epoll_create1(EPOLL_CLOEXEC);
+    CHECK(epfd_ >= 0);
+    pthread_t tid;
+    pthread_create(
+        &tid, nullptr,
+        [](void* self) -> void* {
+          static_cast<FdPoller*>(self)->run();
+          return nullptr;
+        },
+        this);
+    pthread_detach(tid);
+  }
+
+  // (Re)arms fd with the UNION of all waiters' masks, ONESHOT.  Call with
+  // mu_ held.  No waiters → deregisters.
+  int rearm_locked(int fd) {
+    auto it = fds_.find(fd);
+    if (it == fds_.end() || it->second.empty()) {
+      epoll_ctl(epfd_, EPOLL_CTL_DEL, fd, nullptr);
+      fds_.erase(fd);
+      return 0;
+    }
+    uint32_t mask = EPOLLONESHOT;
+    for (const FdWait* w : it->second) {
+      mask |= static_cast<uint32_t>(w->want);
+    }
+    epoll_event ee;
+    ee.events = mask;
+    ee.data.u64 = static_cast<uint64_t>(fd);
+    if (epoll_ctl(epfd_, EPOLL_CTL_MOD, fd, &ee) == 0) {
+      return 0;
+    }
+    if (errno == ENOENT && epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ee) == 0) {
+      return 0;
+    }
+    return -1;
+  }
+
+  void unregister_locked(int fd, FdWait* w) {
+    auto it = fds_.find(fd);
+    if (it == fds_.end()) {
+      return;
+    }
+    auto& v = it->second;
+    for (auto vit = v.begin(); vit != v.end(); ++vit) {
+      if (*vit == w) {
+        v.erase(vit);
+        break;
+      }
+    }
+    rearm_locked(fd);  // drops or narrows the registration
+  }
+
+  void run() {
+    epoll_event events[16];
+    while (true) {
+      const int n = epoll_wait(epfd_, events, 16, -1);
+      for (int i = 0; i < n; ++i) {
+        const int fd = static_cast<int>(events[i].data.u64);
+        const uint32_t got = events[i].events;
+        std::lock_guard<std::mutex> g(mu_);
+        auto it = fds_.find(fd);
+        if (it == fds_.end()) {
+          continue;  // all waiters abandoned (timeout beat readiness)
+        }
+        auto& v = it->second;
+        for (auto vit = v.begin(); vit != v.end();) {
+          FdWait* w = *vit;
+          // Errors/hangups wake everyone; otherwise only matching masks.
+          if ((got & (EPOLLERR | EPOLLHUP)) != 0 ||
+              (got & static_cast<uint32_t>(w->want)) != 0) {
+            w->revents.store(static_cast<int>(got),
+                             std::memory_order_release);
+            w->ev.value.store(1, std::memory_order_release);
+            w->ev.wake_all();
+            vit = v.erase(vit);
+          } else {
+            ++vit;
+          }
+        }
+        rearm_locked(fd);  // remaining waiters (e.g. writer) re-arm
+      }
+    }
+  }
+
+  int epfd_ = -1;
+  std::mutex mu_;
+  std::map<int, std::vector<FdWait*>> fds_;
+};
+
+}  // namespace
+
+int fiber_fd_wait(int fd, int events, int64_t deadline_us) {
+  return FdPoller::instance()->wait(fd, events, deadline_us);
+}
+
+}  // namespace trpc

@@ -27,6 +27,16 @@ int fiber_worker_count();
 int fiber_start(fiber_t* out, void (*fn)(void*), void* arg, int flags = 0);
 // Waits until the fiber finishes.  Returns 0 (also for already-gone ids).
 int fiber_join(fiber_t f);
+// Parks the calling fiber until `fd` has any of `events` (EPOLLIN /
+// EPOLLOUT / ...) or deadline_us passes (parity: bthread_fd_wait,
+// bthread/fd.cpp).  Returns the ready events, or -1 with errno
+// ETIMEDOUT / EINTR / epoll errors.
+int fiber_fd_wait(int fd, int events, int64_t deadline_us = -1);
+// Interrupts a parked fiber (parity: TaskGroup::interrupt, task_group.h:208
+// / bthread_stop): its current (or next) blocking Event::wait returns
+// EINTR.  Cooperative — the fiber decides how to unwind.  Returns 0, or
+// ESRCH for a dead/stale id.
+int fiber_interrupt(fiber_t f);
 // True if the id refers to a live fiber.
 bool fiber_exists(fiber_t f);
 void fiber_yield();

@@ -330,6 +330,8 @@ int fiber_start(fiber_t* out, void (*fn)(void*), void* arg, int flags) {
   m->slot = slot;
   m->fn = fn;
   m->arg = arg;
+  m->interrupted.store(false, std::memory_order_relaxed);
+  m->parked_on.store(nullptr, std::memory_order_relaxed);
   const uint32_t ver = m->version.load(std::memory_order_relaxed) + 1;  // odd
   m->done_event.value.store(ver, std::memory_order_relaxed);
   m->version.store(ver, std::memory_order_relaxed);
@@ -339,6 +341,32 @@ int fiber_start(fiber_t* out, void (*fn)(void*), void* arg, int flags) {
     *out = m->id();
   }
   sched->ready_to_run(m, (flags & kFiberUrgent) != 0);
+  return 0;
+}
+
+int fiber_interrupt(fiber_t f) {
+  FiberMeta* m = fiber_meta_of(f);
+  if (m == nullptr) {
+    return ESRCH;
+  }
+  // Everything under the park lock: (a) the version re-check closes the
+  // recycled-slot race (a delayed interrupted.store must not EINTR an
+  // unrelated new fiber), and (b) the waiter cannot clear parked_on and
+  // destroy the Event while we are inside wake_all (stack Events —
+  // fiber_sleep — die right after the wait returns).  Spurious wakes of
+  // co-waiters are part of the Event contract (callers re-check).
+  m->park_lock();
+  if (m->version.load(std::memory_order_acquire) !=
+      static_cast<uint32_t>(f >> 32)) {
+    m->park_unlock();
+    return ESRCH;
+  }
+  m->interrupted.store(true, std::memory_order_release);
+  Event* ev = m->parked_on.load(std::memory_order_acquire);
+  if (ev != nullptr) {
+    ev->wake_all();
+  }
+  m->park_unlock();
   return 0;
 }
 

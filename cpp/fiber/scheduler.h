@@ -5,6 +5,7 @@
 #pragma once
 
 #include <atomic>
+#include <sched.h>
 #include <cstdint>
 #include <deque>
 #include <mutex>
@@ -34,6 +35,23 @@ struct FiberMeta {
   void* tsan_fiber = nullptr;       // TSan fiber identity (tsan builds)
   // Even = idle slot; odd = live fiber.  The version half of fiber_t.
   std::atomic<uint32_t> version{0};
+  // Interruption (parity: TaskGroup::interrupt / bthread_stop): the Event
+  // this fiber is currently parked on (null while runnable), and a
+  // pending-interrupt flag consumed by the next Event::wait return.
+  // park_mu serializes interrupters against park/unpark: an interrupter
+  // may only touch the Event while holding it, and the waiter clears
+  // parked_on under it BEFORE the Event can be destroyed — so wake_all
+  // from fiber_interrupt can never run on a dead Event.
+  std::atomic<class Event*> parked_on{nullptr};
+  std::atomic<bool> interrupted{false};
+  std::atomic_flag park_mu = ATOMIC_FLAG_INIT;
+
+  void park_lock() {
+    while (park_mu.test_and_set(std::memory_order_acquire)) {
+      sched_yield();
+    }
+  }
+  void park_unlock() { park_mu.clear(std::memory_order_release); }
   // Join event: value holds the live version while running; bumped at exit.
   Event done_event;
   struct FlsSlot {

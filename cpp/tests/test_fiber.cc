@@ -10,6 +10,9 @@
 #include "base/time.h"
 #include "fiber/event.h"
 #include "fiber/execution_queue.h"
+#include <sched.h>
+#include <sys/epoll.h>
+
 #include "fiber/fiber.h"
 #include "fiber/fid.h"
 #include "fiber/sync.h"
@@ -296,6 +299,70 @@ TEST_CASE(cross_thread_start) {
     th.join();
   }
   EXPECT_EQ(done.load(), 200);
+}
+
+TEST_CASE(fiber_interrupt_wakes_parked_fiber) {
+  static Event never;
+  static std::atomic<int> rc_seen{-1};
+  fiber_t f;
+  fiber_start(&f, [](void*) {
+    rc_seen.store(never.wait(0, -1));  // parks forever unless interrupted
+  }, nullptr);
+  fiber_sleep_us(20000);  // let it park
+  EXPECT_EQ(fiber_interrupt(f), 0);
+  EXPECT_EQ(fiber_join(f), 0);
+  EXPECT_EQ(rc_seen.load(), EINTR);
+  // Interrupting a dead fiber: ESRCH.
+  EXPECT_EQ(fiber_interrupt(f), ESRCH);
+  // Interrupt BEFORE the park: the pending flag makes the very next wait
+  // return EINTR promptly (the publish-after-switch path re-checks it).
+  static Event never2;
+  static std::atomic<int> rc2{-1};
+  static std::atomic<bool> go{false};
+  fiber_t g;
+  fiber_start(&g, [](void*) {
+    while (!go.load(std::memory_order_acquire)) {
+      sched_yield();  // runnable, NOT parked — parked_on stays null
+    }
+    rc2.store(never2.wait(0, -1));
+  }, nullptr);
+  fiber_sleep_us(10000);  // the fiber is spinning now
+  EXPECT_EQ(fiber_interrupt(g), 0);  // flag set while runnable
+  go.store(true, std::memory_order_release);
+  EXPECT_EQ(fiber_join(g), 0);
+  EXPECT_EQ(rc2.load(), EINTR);
+}
+
+TEST_CASE(fiber_fd_wait_readiness_and_timeout) {
+  int fds[2];
+  EXPECT_EQ(pipe(fds), 0);
+  // Timeout first: nothing readable.
+  static int pipe_rd = fds[0];
+  static int pipe_wr = fds[1];
+  static std::atomic<int> got{-2};
+  fiber_t f;
+  fiber_start(&f, [](void*) {
+    got.store(fiber_fd_wait(pipe_rd, EPOLLIN,
+                            monotonic_time_us() + 50 * 1000));
+  }, nullptr);
+  fiber_join(f);
+  EXPECT_EQ(got.load(), -1);  // timed out
+  // Readiness: a writer fiber makes the fd readable while we park.
+  fiber_t r, w;
+  static std::atomic<int> revents{0};
+  fiber_start(&r, [](void*) {
+    revents.store(fiber_fd_wait(pipe_rd, EPOLLIN,
+                                monotonic_time_us() + 2000 * 1000));
+  }, nullptr);
+  fiber_start(&w, [](void*) {
+    fiber_sleep_us(30000);
+    EXPECT(write(pipe_wr, "x", 1) == 1);
+  }, nullptr);
+  fiber_join(r);
+  fiber_join(w);
+  EXPECT((revents.load() & EPOLLIN) != 0);
+  close(fds[0]);
+  close(fds[1]);
 }
 
 TEST_MAIN
