@@ -96,6 +96,11 @@ class ResourcePool {
     }
   }
 
+  // Allocation high-water mark: every ever-created slot is < hwm().
+  // Enumeration (diagnostics: /fibers) walks [0, hwm) and filters by the
+  // object's own liveness (version parity).
+  uint32_t hwm() const { return hwm_.load(std::memory_order_acquire); }
+
   T* at(uint32_t idx) {
     const uint32_t seg = idx >> kItemsPerSegBits;
     if (seg >= kMaxSegs) {

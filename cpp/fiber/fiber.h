@@ -10,6 +10,7 @@
 #pragma once
 
 #include <cstdint>
+#include <string>
 
 namespace trpc {
 
@@ -32,6 +33,12 @@ int fiber_join(fiber_t f);
 // bthread/fd.cpp).  Returns the ready events, or -1 with errno
 // ETIMEDOUT / EINTR / epoll errors.
 int fiber_fd_wait(int fd, int events, int64_t deadline_us = -1);
+// Diagnostic dump of all live fibers: id, state (parked/runnable) and
+// the symbolized entry function (parity: the TaskTracer-backed /bthreads
+// service, task_tracer.cpp — condensed to registry introspection; full
+// foreign-stack unwinds need a signal+libunwind machinery this runtime
+// deliberately avoids).
+std::string fiber_dump_all(size_t max_rows = 200);
 // Interrupts a parked fiber (parity: TaskGroup::interrupt, task_group.h:208
 // / bthread_stop): its current (or next) blocking Event::wait returns
 // EINTR.  Cooperative — the fiber decides how to unwind.  Returns 0, or

@@ -11,6 +11,8 @@
 #include "base/rand.h"
 #include "base/resource_pool.h"
 #include "fiber/context.h"
+#include <dlfcn.h>
+
 #include "fiber/event.h"
 
 // ASan fiber-switch annotations (parity: the reference's ASan-aware stack
@@ -342,6 +344,39 @@ int fiber_start(fiber_t* out, void (*fn)(void*), void* arg, int flags) {
   }
   sched->ready_to_run(m, (flags & kFiberUrgent) != 0);
   return 0;
+}
+
+std::string fiber_dump_all(size_t max_rows) {
+  std::string out = "live fibers (id  state  entry)\n";
+  const uint32_t hwm = FiberPool::instance()->hwm();
+  size_t shown = 0;
+  for (uint32_t slot = 0; slot < hwm && shown < max_rows; ++slot) {
+    FiberMeta* m = FiberPool::instance()->at(slot);
+    if (m == nullptr) {
+      continue;
+    }
+    const uint32_t ver = m->version.load(std::memory_order_acquire);
+    if ((ver & 1) == 0) {
+      continue;  // even = idle slot
+    }
+    const Event* parked = m->parked_on.load(std::memory_order_acquire);
+    char line[256];
+    const char* sym = "?";
+    Dl_info info;
+    void* fn = reinterpret_cast<void*>(m->fn);
+    if (fn != nullptr && dladdr(fn, &info) != 0 &&
+        info.dli_sname != nullptr) {
+      sym = info.dli_sname;
+    }
+    snprintf(line, sizeof(line), "%016llx  %-8s %s\n",
+             static_cast<unsigned long long>(
+                 (static_cast<uint64_t>(ver) << 32) | slot),
+             parked != nullptr ? "parked" : "runnable", sym);
+    out += line;
+    ++shown;
+  }
+  out += std::to_string(shown) + " live\n";
+  return out;
 }
 
 int fiber_interrupt(fiber_t f) {

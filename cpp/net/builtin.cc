@@ -185,6 +185,10 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req, int* status,
     *body = contention_dump();
     return true;
   }
+  if (path == "/fibers" || path == "/bthreads") {
+    *body = fiber_dump_all();
+    return true;
+  }
   if (path == "/threads") {
     *body = "fiber_workers " + std::to_string(fiber_worker_count()) +
             "\nos_threads " + std::to_string(proc_status_kb("Threads:")) +
@@ -210,7 +214,7 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req, int* status,
         "/health\n/version\n/status\n/vars\n/vars/<name>\n/brpc_metrics\n"
         "/connections\n/flags\n/flags/<name>[?setvalue=v]\n/threads\n"
         "/memory\n/list\n/protobufs\n/index\n/rpcz[?trace_id=hex]\n"
-        "/hotspots[?seconds=N]\n/contention\n";
+        "/hotspots[?seconds=N]\n/contention\n/fibers\n";
     return true;
   }
   (void)content_type;
