@@ -1,0 +1,371 @@
+#include "base/json.h"
+
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace trpc {
+
+Json Json::boolean(bool b) {
+  Json j;
+  j.type_ = Type::kBool;
+  j.bool_ = b;
+  return j;
+}
+Json Json::number(double d) {
+  Json j;
+  j.type_ = Type::kNumber;
+  j.num_ = d;
+  return j;
+}
+Json Json::str(std::string s) {
+  Json j;
+  j.type_ = Type::kString;
+  j.str_ = std::move(s);
+  return j;
+}
+Json Json::array() {
+  Json j;
+  j.type_ = Type::kArray;
+  return j;
+}
+Json Json::object() {
+  Json j;
+  j.type_ = Type::kObject;
+  return j;
+}
+
+void Json::push_back(Json v) {
+  type_ = Type::kArray;
+  arr_.push_back(std::move(v));
+}
+
+void Json::set(const std::string& key, Json v) {
+  type_ = Type::kObject;
+  obj_[key] = std::move(v);
+}
+
+const Json* Json::find(const std::string& key) const {
+  auto it = obj_.find(key);
+  return it == obj_.end() ? nullptr : &it->second;
+}
+
+namespace {
+
+void escape_into(const std::string& s, std::string* out) {
+  out->push_back('"');
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\n': *out += "\\n"; break;
+      case '\r': *out += "\\r"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          *out += buf;
+        } else {
+          out->push_back(static_cast<char>(c));
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+}  // namespace
+
+std::string Json::dump() const {
+  std::string out;
+  switch (type_) {
+    case Type::kNull:
+      out = "null";
+      break;
+    case Type::kBool:
+      out = bool_ ? "true" : "false";
+      break;
+    case Type::kNumber: {
+      if (!std::isfinite(num_)) {
+        out = "null";  // JSON has no inf/nan (and casting them is UB)
+        break;
+      }
+      char buf[32];
+      if (std::fabs(num_) < 1e15 && num_ == static_cast<int64_t>(num_)) {
+        snprintf(buf, sizeof(buf), "%lld",
+                 static_cast<long long>(num_));
+      } else {
+        snprintf(buf, sizeof(buf), "%.17g", num_);
+      }
+      out = buf;
+      break;
+    }
+    case Type::kString:
+      escape_into(str_, &out);
+      break;
+    case Type::kArray: {
+      out = "[";
+      for (size_t i = 0; i < arr_.size(); ++i) {
+        out += (i != 0 ? "," : "") + arr_[i].dump();
+      }
+      out += "]";
+      break;
+    }
+    case Type::kObject: {
+      out = "{";
+      bool first = true;
+      for (const auto& [k, v] : obj_) {
+        if (!first) {
+          out += ",";
+        }
+        first = false;
+        escape_into(k, &out);
+        out += ":" + v.dump();
+      }
+      out += "}";
+      break;
+    }
+  }
+  return out;
+}
+
+namespace {
+
+struct Parser {
+  const char* p;
+  const char* end;
+  int depth = 0;
+
+  void ws() {
+    while (p < end &&
+           (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) {
+      ++p;
+    }
+  }
+
+  bool value(Json* out) {
+    if (++depth > 64) {
+      return false;  // depth bomb
+    }
+    ws();
+    if (p >= end) {
+      return false;
+    }
+    bool ok = false;
+    switch (*p) {
+      case '{': ok = object(out); break;
+      case '[': ok = array(out); break;
+      case '"': {
+        std::string s;
+        ok = string_lit(&s);
+        if (ok) {
+          *out = Json::str(std::move(s));
+        }
+        break;
+      }
+      case 't':
+        ok = literal("true");
+        if (ok) {
+          *out = Json::boolean(true);
+        }
+        break;
+      case 'f':
+        ok = literal("false");
+        if (ok) {
+          *out = Json::boolean(false);
+        }
+        break;
+      case 'n':
+        ok = literal("null");
+        if (ok) {
+          *out = Json::null();
+        }
+        break;
+      default: ok = number_lit(out); break;
+    }
+    --depth;
+    return ok;
+  }
+
+  bool literal(const char* lit) {
+    const size_t n = strlen(lit);
+    if (static_cast<size_t>(end - p) < n || memcmp(p, lit, n) != 0) {
+      return false;
+    }
+    p += n;
+    return true;
+  }
+
+  bool number_lit(Json* out) {
+    // RFC 8259 grammar gate before strtod (which would also accept
+    // nan/inf/hex floats/leading '+').
+    const char* q = p;
+    if (q < end && *q == '-') {
+      ++q;
+    }
+    if (q >= end || *q < '0' || *q > '9') {
+      return false;
+    }
+    char* num_end = nullptr;
+    const double v = strtod(p, &num_end);
+    if (num_end == p || num_end > end || !std::isfinite(v)) {
+      return false;
+    }
+    p = num_end;
+    *out = Json::number(v);
+    return true;
+  }
+
+  bool hex4(unsigned* out) {
+    unsigned v = 0;
+    for (int i = 0; i < 4; ++i) {
+      if (p >= end) {
+        return false;
+      }
+      const char c = *p++;
+      v <<= 4;
+      if (c >= '0' && c <= '9') {
+        v |= c - '0';
+      } else if (c >= 'a' && c <= 'f') {
+        v |= c - 'a' + 10;
+      } else if (c >= 'A' && c <= 'F') {
+        v |= c - 'A' + 10;
+      } else {
+        return false;
+      }
+    }
+    *out = v;
+    return true;
+  }
+
+  bool string_lit(std::string* out) {
+    if (p >= end || *p != '"') {
+      return false;
+    }
+    ++p;
+    while (p < end && *p != '"') {
+      if (*p == '\\') {
+        ++p;
+        if (p >= end) {
+          return false;
+        }
+        switch (*p) {
+          case '"': out->push_back('"'); break;
+          case '\\': out->push_back('\\'); break;
+          case '/': out->push_back('/'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case 'n': out->push_back('\n'); break;
+          case 'r': out->push_back('\r'); break;
+          case 't': out->push_back('\t'); break;
+          case 'u': {
+            ++p;
+            unsigned cp = 0;
+            if (!hex4(&cp)) {
+              return false;
+            }
+            // Basic-plane UTF-8 encode (surrogates passed through as-is).
+            if (cp < 0x80) {
+              out->push_back(static_cast<char>(cp));
+            } else if (cp < 0x800) {
+              out->push_back(static_cast<char>(0xC0 | (cp >> 6)));
+              out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+            } else {
+              out->push_back(static_cast<char>(0xE0 | (cp >> 12)));
+              out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+            }
+            continue;  // p already advanced past the 4 hex digits
+          }
+          default: return false;
+        }
+        ++p;
+      } else {
+        out->push_back(*p++);
+      }
+    }
+    if (p >= end) {
+      return false;  // unterminated
+    }
+    ++p;  // closing quote
+    return true;
+  }
+
+  bool array(Json* out) {
+    ++p;  // '['
+    *out = Json::array();
+    ws();
+    if (p < end && *p == ']') {
+      ++p;
+      return true;
+    }
+    while (true) {
+      Json v;
+      if (!value(&v)) {
+        return false;
+      }
+      out->push_back(std::move(v));
+      ws();
+      if (p < end && *p == ',') {
+        ++p;
+        continue;
+      }
+      if (p < end && *p == ']') {
+        ++p;
+        return true;
+      }
+      return false;
+    }
+  }
+
+  bool object(Json* out) {
+    ++p;  // '{'
+    *out = Json::object();
+    ws();
+    if (p < end && *p == '}') {
+      ++p;
+      return true;
+    }
+    while (true) {
+      ws();
+      std::string key;
+      if (!string_lit(&key)) {
+        return false;
+      }
+      ws();
+      if (p >= end || *p != ':') {
+        return false;
+      }
+      ++p;
+      Json v;
+      if (!value(&v)) {
+        return false;
+      }
+      out->set(key, std::move(v));
+      ws();
+      if (p < end && *p == ',') {
+        ++p;
+        continue;
+      }
+      if (p < end && *p == '}') {
+        ++p;
+        return true;
+      }
+      return false;
+    }
+  }
+};
+
+}  // namespace
+
+bool Json::parse(const std::string& text, Json* out) {
+  Parser ps{text.data(), text.data() + text.size()};
+  if (!ps.value(out)) {
+    return false;
+  }
+  ps.ws();
+  return ps.p == ps.end;  // no trailing garbage
+}
+
+}  // namespace trpc

@@ -1,0 +1,63 @@
+// Minimal JSON value + parser/writer.
+//
+// Parity role: the reference's json2pb bridge (/root/reference/src/
+// json2pb/, 2,068 LoC pb⇄json transcoding).  This runtime is
+// deliberately protobuf-free (the framed meta is a hand-rolled TLV), so
+// the bridge's form here is a standalone JSON codec: builtin services
+// render structured output (?format=json), tools parse JSON inputs, and
+// Python/C++ handlers exchange structured payloads without a schema
+// compiler.  Strict parser: rejects trailing garbage, caps depth.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace trpc {
+
+class Json {
+ public:
+  enum class Type { kNull, kBool, kNumber, kString, kArray, kObject };
+
+  Json() = default;
+  static Json null() { return Json(); }
+  static Json boolean(bool b);
+  static Json number(double d);
+  static Json str(std::string s);
+  static Json array();
+  static Json object();
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::kNull; }
+  bool as_bool() const { return bool_; }
+  double as_number() const { return num_; }
+  const std::string& as_string() const { return str_; }
+
+  // Arrays.
+  void push_back(Json v);
+  size_t size() const { return arr_.size(); }
+  const Json& operator[](size_t i) const { return arr_[i]; }
+
+  // Objects.
+  void set(const std::string& key, Json v);
+  const Json* find(const std::string& key) const;  // nullptr when absent
+  const std::map<std::string, Json>& items() const { return obj_; }
+
+  // Serialization (compact; strings escaped per RFC 8259).
+  std::string dump() const;
+
+  // Strict parse of the WHOLE input; false on any error.
+  static bool parse(const std::string& text, Json* out);
+
+ private:
+  Type type_ = Type::kNull;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  std::vector<Json> arr_;
+  std::map<std::string, Json> obj_;
+};
+
+}  // namespace trpc

@@ -5,6 +5,8 @@
 #include <stdlib.h>
 #include <string.h>
 
+#include <cmath>
+
 namespace trpc {
 
 long proc_status_kb(const char* key) {
@@ -36,6 +38,28 @@ long proc_fd_count() {
   }
   closedir(d);
   return n - 2;  // . and ..
+}
+
+bool parse_plain_number(const char* s, double* out) {
+  if (s == nullptr || *s == '\0') {
+    return false;
+  }
+  // RFC 8259 number grammar head: '-'? digit...  (rejects nan/inf/hex/'+'
+  // which strtod would happily accept).
+  const char* p = s;
+  if (*p == '-') {
+    ++p;
+  }
+  if (*p < '0' || *p > '9') {
+    return false;
+  }
+  char* end = nullptr;
+  const double v = strtod(s, &end);
+  if (end == s || *end != '\0' || !std::isfinite(v)) {
+    return false;
+  }
+  *out = v;
+  return true;
 }
 
 }  // namespace trpc

@@ -6,6 +6,11 @@ namespace trpc {
 
 // Value of a "Key:  <n> kB"-style line in /proc/self/status; -1 if absent.
 long proc_status_kb(const char* key);
+
+// True when `s` is one plain finite decimal number (the shared "render a
+// metric value as a JSON/Prometheus number or fall back to a string"
+// classification); fills *out.
+bool parse_plain_number(const char* s, double* out);
 // Open fd count for this process (-1 on failure).
 long proc_fd_count();
 

@@ -99,7 +99,7 @@ void fiber_entry(void* p) {
   FiberMeta* m = static_cast<FiberMeta*>(p);
   // Complete the ASan handshake for the first entry onto this stack.
   __sanitizer_finish_switch_fiber(nullptr, nullptr, nullptr);
-  m->fn(m->arg);
+  m->fn.load(std::memory_order_relaxed)(m->arg);
   run_fls_destructors(m);
   Worker* w = tls_worker;  // worker we ended on (may differ from start)
   w->suspend_current(finish_fiber_post, m, nullptr, /*dying=*/true);
@@ -330,7 +330,7 @@ int fiber_start(fiber_t* out, void (*fn)(void*), void* arg, int flags) {
     return -1;
   }
   m->slot = slot;
-  m->fn = fn;
+  m->fn.store(fn, std::memory_order_relaxed);
   m->arg = arg;
   m->interrupted.store(false, std::memory_order_relaxed);
   m->parked_on.store(nullptr, std::memory_order_relaxed);
@@ -350,7 +350,8 @@ std::string fiber_dump_all(size_t max_rows) {
   std::string out = "live fibers (id  state  entry)\n";
   const uint32_t hwm = FiberPool::instance()->hwm();
   size_t shown = 0;
-  for (uint32_t slot = 0; slot < hwm && shown < max_rows; ++slot) {
+  size_t live = 0;
+  for (uint32_t slot = 0; slot < hwm; ++slot) {
     FiberMeta* m = FiberPool::instance()->at(slot);
     if (m == nullptr) {
       continue;
@@ -359,11 +360,16 @@ std::string fiber_dump_all(size_t max_rows) {
     if ((ver & 1) == 0) {
       continue;  // even = idle slot
     }
+    ++live;
+    if (shown >= max_rows) {
+      continue;  // keep counting; rows are capped
+    }
     const Event* parked = m->parked_on.load(std::memory_order_acquire);
     char line[256];
     const char* sym = "?";
     Dl_info info;
-    void* fn = reinterpret_cast<void*>(m->fn);
+    void* fn = reinterpret_cast<void*>(
+        m->fn.load(std::memory_order_relaxed));
     if (fn != nullptr && dladdr(fn, &info) != 0 &&
         info.dli_sname != nullptr) {
       sym = info.dli_sname;
@@ -375,7 +381,11 @@ std::string fiber_dump_all(size_t max_rows) {
     out += line;
     ++shown;
   }
-  out += std::to_string(shown) + " live\n";
+  out += std::to_string(live) + " live";
+  if (live > shown) {
+    out += " (rows truncated at " + std::to_string(shown) + ")";
+  }
+  out += "\n";
   return out;
 }
 

@@ -27,7 +27,8 @@ class Worker;
 using PostSwitchFn = void (*)(void* arg1, void* arg2);
 
 struct FiberMeta {
-  void (*fn)(void*) = nullptr;
+  // Atomic: /fibers dumps read it concurrently with slot recycling.
+  std::atomic<void (*)(void*)> fn{nullptr};
   void* arg = nullptr;
   void* sp = nullptr;  // suspended continuation
   StackMem stack;

@@ -10,6 +10,7 @@
 #include <string>
 
 #include "base/flags.h"
+#include "base/json.h"
 #include "base/proc.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
@@ -55,6 +56,21 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req, int* status,
     return true;
   }
   if (path == "/vars" || path == "/vars/") {
+    const std::string* fmt = req.query("format");
+    if (fmt != nullptr && *fmt == "json") {
+      Json j = Json::object();
+      for (auto& [name, value] : Variable::dump_exposed()) {
+        double num = 0;
+        if (parse_plain_number(value.c_str(), &num)) {
+          j.set(name, Json::number(num));
+        } else {
+          j.set(name, Json::str(value));
+        }
+      }
+      *body = j.dump();
+      *content_type = "application/json";
+      return true;
+    }
     std::string out;
     for (auto& [name, value] : Variable::dump_exposed()) {
       out += name + " : " + value + "\n";
@@ -75,6 +91,25 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req, int* status,
     return true;
   }
   if (path == "/status") {
+    const std::string* fmt = req.query("format");
+    if (fmt != nullptr && *fmt == "json") {
+      Json j = Json::object();
+      j.set("port", Json::number(srv->port()));
+      j.set("uptime_s",
+            Json::number((monotonic_time_us() - srv->start_time_us()) /
+                         1000000.0));
+      j.set("requests_served",
+            Json::number(static_cast<double>(srv->requests_served.load())));
+      j.set("in_flight", Json::number(srv->in_flight.load()));
+      Json methods = Json::array();
+      srv->for_each_method([&methods](const std::string& name) {
+        methods.push_back(Json::str(name));
+      });
+      j.set("methods", std::move(methods));
+      *body = j.dump();
+      *content_type = "application/json";
+      return true;
+    }
     const int64_t up_us = monotonic_time_us() - srv->start_time_us();
     std::string out = "server port " + std::to_string(srv->port()) +
                       "\nuptime_s " + std::to_string(up_us / 1000000) +

@@ -14,6 +14,7 @@
 #include "base/recordio.h"
 #include "base/resource_pool.h"
 #include "base/time.h"
+#include "base/json.h"
 #include "tests/test_util.h"
 
 using namespace trpc;
@@ -250,6 +251,33 @@ TEST_CASE(time_monotonic) {
   const int64_t b = monotonic_time_ns();
   EXPECT(b >= a);
   EXPECT(realtime_us() > 1600000000000000LL);  // sane wall clock
+}
+
+TEST_CASE(json_roundtrip_and_strictness) {
+  Json j;
+  EXPECT(Json::parse(
+      "{\"a\": [1, 2.5, true, null, \"x\\n\\u0041\"], \"b\": {\"c\": -3}}",
+      &j));
+  EXPECT(j.find("a") != nullptr);
+  EXPECT_EQ(j.find("a")->size(), 5u);
+  EXPECT_EQ((*j.find("a"))[0].as_number(), 1.0);
+  EXPECT((*j.find("a"))[2].as_bool());
+  EXPECT((*j.find("a"))[3].is_null());
+  EXPECT((*j.find("a"))[4].as_string() == "x\nA");
+  EXPECT_EQ(j.find("b")->find("c")->as_number(), -3.0);
+  // Dump → parse roundtrip is stable.
+  Json j2;
+  EXPECT(Json::parse(j.dump(), &j2));
+  EXPECT(j2.dump() == j.dump());
+  // Strictness: trailing garbage, unterminated, depth bomb.
+  EXPECT(!Json::parse("{} x", &j));
+  EXPECT(!Json::parse("\"abc", &j));
+  EXPECT(!Json::parse("[1,]", &j));
+  std::string bomb(100, '[');
+  EXPECT(!Json::parse(bomb, &j));
+  // Escaping in dump.
+  Json s1 = Json::str("a\"b\\c\n");
+  EXPECT(s1.dump() == "\"a\\\"b\\\\c\\n\"");
 }
 
 TEST_MAIN
