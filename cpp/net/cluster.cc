@@ -345,6 +345,8 @@ int ClusterChannel::refresh() {
         ch = std::make_shared<Channel>();
         Channel::Options copts;
         copts.timeout_ms = opts_.timeout_ms;
+        copts.connection_type = opts_.connection_type;
+        copts.auth = opts_.auth;
         if (ch->Init(endpoint2str(ep), &copts) != 0) {
           continue;
         }

@@ -18,6 +18,7 @@
 #include "base/doubly_buffered.h"
 #include "base/endpoint.h"
 #include "fiber/event.h"
+#include "net/auth.h"
 #include "net/channel.h"
 #include "net/controller.h"
 
@@ -87,6 +88,10 @@ class ClusterChannel {
     int64_t refresh_interval_ms = 5000;  // periodic re-resolve
     int64_t quarantine_base_ms = 100;    // doubles per consecutive failure
     int64_t quarantine_max_ms = 10000;
+    // Passed through to every member Channel (socket_map.h connection
+    // matrix / auth.h credentials).
+    std::string connection_type = "single";
+    const Authenticator* auth = nullptr;
   };
 
   ~ClusterChannel();
