@@ -59,6 +59,15 @@ def load_library() -> ctypes.CDLL:
             _ensure_built()
             lib = ctypes.CDLL(str(_LIB_PATH))
             lib.trpc_iobuf_create.restype = ctypes.c_void_p
+            lib.trpc_channel_create_ex.restype = ctypes.c_void_p
+            lib.trpc_channel_create_ex.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p,
+                ctypes.c_int,
+            ]
+            lib.trpc_flag_set.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+            lib.trpc_flag_get.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+            ]
             lib.trpc_iobuf_destroy.argtypes = [ctypes.c_void_p]
             lib.trpc_iobuf_append.argtypes = [
                 ctypes.c_void_p,

@@ -31,13 +31,14 @@ class Channel:
     transparent TCP fallback)."""
 
     def __init__(self, addr: str, timeout_ms: int = 1000,
-                 use_shm: bool = False):
+                 use_shm: bool = False, connection_type: str = "single"):
         self._lib = load_library()
-        create = (self._lib.trpc_channel_create_shm if use_shm
-                  else self._lib.trpc_channel_create)
-        self._ptr = create(addr.encode(), timeout_ms)
+        self._ptr = self._lib.trpc_channel_create_ex(
+            addr.encode(), ctypes.c_int64(timeout_ms),
+            connection_type.encode(), ctypes.c_int(1 if use_shm else 0))
         if not self._ptr:
-            raise ValueError(f"bad address: {addr!r}")
+            raise ValueError(
+                f"bad address or options: {addr!r} / {connection_type!r}")
 
     def call(self, method: str, request: bytes, timeout_ms: int = 0) -> bytes:
         return _call(self._lib, self._lib.trpc_channel_call, self._ptr,

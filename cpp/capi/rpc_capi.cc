@@ -10,6 +10,8 @@
 
 #include "base/iobuf.h"
 #include "fiber/event.h"
+#include "base/flags.h"
+#include "net/span.h"
 #include "net/channel.h"
 #include "net/cluster.h"
 #include "net/controller.h"
@@ -86,17 +88,27 @@ void trpc_server_stop(void* srv) { static_cast<Server*>(srv)->Stop(); }
 // ---- single-server channel ---------------------------------------------
 
 namespace {
-void* create_channel(const char* addr, int64_t timeout_ms, bool use_shm) {
+void* create_channel(const char* addr, int64_t timeout_ms, bool use_shm,
+                     const char* conn_type = nullptr) {
   auto* ch = new Channel();
   Channel::Options opts;
   opts.timeout_ms = timeout_ms;
   opts.use_shm = use_shm;
+  if (conn_type != nullptr && conn_type[0] != '\0') {
+    opts.connection_type = conn_type;
+  }
   if (ch->Init(addr, &opts) != 0) {
     delete ch;
     return nullptr;
   }
   return ch;
 }
+
+// Flags register lazily from function-local statics (rpcz_enabled on its
+// first check, per-method bounds at registration); a fresh process using
+// ONLY the flag API would otherwise see "unknown flag".  Touch the static
+// runtime flags here.
+void ensure_runtime_flags() { rpcz_enabled(); }
 }  // namespace
 
 void* trpc_channel_create(const char* addr, int64_t timeout_ms) {
@@ -107,6 +119,36 @@ void* trpc_channel_create(const char* addr, int64_t timeout_ms) {
 // fails; see net/shm_transport.h).
 void* trpc_channel_create_shm(const char* addr, int64_t timeout_ms) {
   return create_channel(addr, timeout_ms, true);
+}
+
+// Full-option creation: conn_type "single"/"pooled"/"short"
+// (socket_map.h matrix).  Returns nullptr on bad address/options.
+void* trpc_channel_create_ex(const char* addr, int64_t timeout_ms,
+                             const char* conn_type, int use_shm) {
+  return create_channel(addr, timeout_ms, use_shm != 0, conn_type);
+}
+
+// Runtime flag access (base/flags.h; the /flags service's programmatic
+// form).  Returns 0 on success (set) / found (get).
+int trpc_flag_set(const char* name, const char* value) {
+  ensure_runtime_flags();
+  return Flag::set(name, value);
+}
+
+// Returns 0 on success, -1 unknown flag, -2 when the value does not fit
+// (nothing written in that case; also guards degenerate buffers).
+int trpc_flag_get(const char* name, char* out, size_t out_len) {
+  ensure_runtime_flags();
+  Flag* f = Flag::find(name);
+  if (f == nullptr) {
+    return -1;
+  }
+  const std::string v = f->value_string();
+  if (out == nullptr || out_len == 0 || v.size() + 1 > out_len) {
+    return -2;
+  }
+  memcpy(out, v.c_str(), v.size() + 1);
+  return 0;
 }
 
 // Copies the live transport name ("tcp", "shm_ring", "" if unconnected).

@@ -1,6 +1,8 @@
 """Device arena: zero-copy staging path between JAX arrays and the C++ RPC
 runtime (RDMA block_pool parity — VERDICT r1 'bridge the two halves')."""
 
+import time
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -29,7 +31,12 @@ def test_jax_array_through_arena_rpc(echo_server):
     n = block.put(x)  # the single device->host landing
     assert n == 4096 * 4
     resp = call_with_block(ch, "Echo.Echo", block, n)
-    # The consumed block went back to the arena with the request IOBuf.
+    # The consumed block returns to the arena once the write fiber drops
+    # the last reference — a hair after the response lands; poll briefly.
+    for _ in range(200):
+        if arena.blocks_in_use == 0:
+            break
+        time.sleep(0.005)
     assert arena.blocks_in_use == 0
     got = np.frombuffer(resp, dtype=np.uint32)
     np.testing.assert_array_equal(got, np.asarray(x))

@@ -35,6 +35,30 @@ def server():
     srv.stop()
 
 
+def test_pooled_connection_and_flags(server):
+    from brpc_tpu.rpc import get_flag, set_flag
+
+    # Flags FIRST: a fresh process must see the runtime flags without any
+    # RPC having incidentally touched their lazy registration.
+    set_flag("rpcz_enabled", "true")
+    assert get_flag("rpcz_enabled") == "true"
+    set_flag("rpcz_enabled", "false")
+
+    ch = Channel(f"127.0.0.1:{server.port}", connection_type="pooled",
+                 timeout_ms=3000)
+    assert ch.call("Echo.Echo", b"pooled") == b"pooled"
+    ch.close()
+    with pytest.raises(ValueError):
+        Channel(f"127.0.0.1:{server.port}", connection_type="bogus")
+    set_flag("rpcz_enabled", "true")
+    assert get_flag("rpcz_enabled") == "true"
+    set_flag("rpcz_enabled", "false")
+    with pytest.raises(ValueError):
+        set_flag("rpcz_enabled", "not-a-bool")
+    with pytest.raises(KeyError):
+        get_flag("no_such_flag_xyz")
+
+
 def test_python_echo(server):
     ch = Channel(f"127.0.0.1:{server.port}")
     assert ch.call("Echo.Echo", b"hello from python") == b"hello from python"
