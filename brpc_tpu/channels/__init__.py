@@ -1,4 +1,5 @@
 from brpc_tpu.channels.combo import (  # noqa: F401
+    DynamicPartitionChannel,
     ParallelChannel,
     PartitionChannel,
     SelectiveChannel,

@@ -204,3 +204,62 @@ class SelectiveChannel:
 
     def call(self, handler: Handler, request, chosen: int):
         return self.bind(handler)(request, chosen)
+
+
+class DynamicPartitionChannel:
+    """Traffic split across COEXISTING partitioning schemes.
+
+    Parity: the reference's DynamicPartitionChannel
+    (/root/reference/src/brpc/partition_channel.h:136) — during a
+    resharding migration both the old N-way and the new M-way partition
+    groups serve, each receiving traffic proportional to its capacity, so
+    the fleet migrates without a flag day.  TPU-native form: each scheme
+    is a PartitionChannel over its own mesh axis/fabric; calls are routed
+    host-side by capacity weights (default: the scheme's partition count),
+    which can be re-weighted live as the migration progresses.
+    """
+
+    def __init__(self, schemes, weights=None, seed: int = 0):
+        """schemes: list of PartitionChannel; weights: per-scheme capacity
+        (defaults to each scheme's partition count)."""
+        if not schemes:
+            raise ValueError("need at least one partition scheme")
+        self.schemes = list(schemes)
+        if weights is None:
+            weights = [s.fabric.axis_size(s.axis) for s in self.schemes]
+        self.set_weights(weights)
+        self._counts = [0] * len(self.schemes)
+        self._seq = seed
+
+    def set_weights(self, weights):
+        """Live re-weighting (e.g. drain the old scheme to 0)."""
+        if len(weights) != len(self.schemes) or any(w < 0 for w in weights):
+            raise ValueError("one non-negative weight per scheme")
+        if sum(weights) <= 0:
+            raise ValueError("at least one scheme must have weight > 0")
+        self.weights = list(weights)
+
+    def _pick(self) -> int:
+        # Deterministic low-discrepancy rotation (no RNG in the data path):
+        # scheme i gets weight_i of every sum(weights) consecutive calls.
+        total = sum(self.weights)
+        tick = self._seq % total
+        self._seq += 1
+        for i, w in enumerate(self.weights):
+            tick -= w
+            if tick < 0:
+                return i
+        return len(self.weights) - 1
+
+    def call(self, handler: Handler, request):
+        """Routes one request to a scheme; returns (scheme_index, result).
+        `request` must be shaped for ANY scheme (leading dim divisible by
+        every scheme's partition count)."""
+        i = self._pick()
+        self._counts[i] += 1
+        return i, self.schemes[i].call(handler, request)
+
+    @property
+    def counts(self):
+        """Requests served per scheme (migration progress observability)."""
+        return tuple(self._counts)

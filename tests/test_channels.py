@@ -97,3 +97,42 @@ def test_balancers():
     # peer 3 has the lowest EWMA; p2c should prefer it when sampled.
     picks = [p2c.pick() for _ in range(100)]
     assert picks.count(3) > 25
+
+
+def test_dynamic_partition_channel_migration():
+    """Two coexisting partition schemes (4-way and 8-way) share traffic by
+    capacity; re-weighting drains the old scheme (partition_channel.h:136
+    parity)."""
+    import jax
+
+    from brpc_tpu.channels import DynamicPartitionChannel, PartitionChannel
+    from brpc_tpu.parallel.fabric import Fabric
+
+    old = PartitionChannel(Fabric.auto((4,), ("link",),
+                                       devices=jax.devices()[:4]), "link")
+    new = PartitionChannel(Fabric.auto((8,), ("link",)), "link")
+    dyn = DynamicPartitionChannel([old, new])
+
+    def handler(i, shard):
+        return shard * 2.0
+
+    x = jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4)  # fits 4 and 8
+    results = []
+    for _ in range(12):  # one full weight cycle (4 + 8)
+        scheme, out = dyn.call(handler, x)
+        results.append(scheme)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x) * 2.0)
+    # Capacity-proportional split: 4-way gets 4 of every 12, 8-way gets 8.
+    assert results.count(0) == 4
+    assert results.count(1) == 8
+    # Drain the old scheme.
+    dyn.set_weights([0, 1])
+    for _ in range(5):
+        scheme, _ = dyn.call(handler, x)
+        assert scheme == 1
+    assert dyn.counts[1] > dyn.counts[0]
+    # Bad weights rejected.
+    import pytest
+
+    with pytest.raises(ValueError):
+        dyn.set_weights([0, 0])
