@@ -613,6 +613,18 @@ void h2_process_request(InputMessage&& msg) {
                8, "resource exhausted");
     return;
   }
+  if (srv->interceptor()) {
+    int ec = EACCES;
+    std::string et = "rejected by interceptor";
+    if (!srv->interceptor()(rpc_name, &ec, &et)) {
+      if (limiter != nullptr) {
+        limiter->on_response(0, true);
+      }
+      h2_respond(msg.socket, stream_id, grpc ? 200 : 403, resp_ct,
+                 grpc ? "" : et + "\n", grpc, 7, et);
+      return;
+    }
+  }
   IOBuf request;
   if (grpc) {
     if (msg.payload.size() > 0 && !grpc_unframe(msg.payload, &request)) {

@@ -141,6 +141,17 @@ void http_process_request(InputMessage&& msg) {
                  "rejected by concurrency limiter\n");
     return;
   }
+  if (srv->interceptor()) {
+    int ec = EACCES;
+    std::string et = "rejected by interceptor";
+    if (!srv->interceptor()(rpc_name, &ec, &et)) {
+      if (limiter != nullptr) {
+        limiter->on_response(0, true);
+      }
+      http_respond(msg.socket, *req, 403, "text/plain", et + "\n");
+      return;
+    }
+  }
   auto* cntl = new Controller();
   cntl->set_method(rpc_name);
   auto* response = new IOBuf();

@@ -535,6 +535,15 @@ void tstd_process_request(InputMessage&& msg) {
     done();
     return;
   }
+  if (srv->interceptor()) {
+    int ec = EACCES;
+    std::string et = "rejected by interceptor";
+    if (!srv->interceptor()(method, &ec, &et)) {
+      cntl->SetFailed(ec, et);
+      done();
+      return;
+    }
+  }
   srv->maybe_dump(method, msg.meta.attachment_size, msg.payload);
   // Split the attachment tail off the payload.
   IOBuf request = std::move(msg.payload);

@@ -50,6 +50,15 @@ class Server {
   void set_authenticator(const Authenticator* auth) { auth_ = auth; }
   const Authenticator* authenticator() const { return auth_; }
 
+  // Request interceptor (parity: brpc::Interceptor, interceptor.h:26):
+  // runs before EVERY accepted request on every serving protocol; return
+  // false (optionally setting *error_code/*error_text) to reject without
+  // reaching the handler.  Call before Start.
+  using Interceptor = std::function<bool(
+      const std::string& method, int* error_code, std::string* error_text)>;
+  void set_interceptor(Interceptor icpt) { interceptor_ = std::move(icpt); }
+  const Interceptor& interceptor() const { return interceptor_; }
+
   ~Server();
 
   // Register before Start.  Name format "Service.Method" by convention.
@@ -104,6 +113,7 @@ class Server {
   std::atomic<double> dump_rate_{0.0};
 
   const Authenticator* auth_ = nullptr;
+  Interceptor interceptor_;
   FlatMap<std::string, MethodProperty> methods_;
   // (pattern segments, trailing-wildcard, method name), longest first.
   struct RestfulRule {

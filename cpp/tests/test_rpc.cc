@@ -527,4 +527,47 @@ TEST_CASE(authenticated_connections) {
   }
 }
 
+TEST_CASE(interceptor_gates_every_protocol) {
+  static Server srv;
+  srv.RegisterMethod("I.Echo", [](Controller*, const IOBuf& req,
+                                  IOBuf* resp, Closure done) {
+    resp->append(req);
+    done();
+  });
+  srv.RegisterMethod("I.Secret", [](Controller*, const IOBuf&, IOBuf*,
+                                    Closure done) { done(); });
+  static std::atomic<int> seen{0};
+  srv.set_interceptor([](const std::string& method, int* ec,
+                         std::string* et) {
+    seen.fetch_add(1);
+    if (method == "I.Echo") {
+      return true;
+    }
+    *ec = 77;
+    *et = "blocked by policy";
+    return false;
+  });
+  EXPECT_EQ(srv.Start(0), 0);
+  Channel ch;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(srv.port())), 0);
+  // Allowed method flows.
+  {
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("ok");
+    ch.CallMethod("I.Echo", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+  }
+  // A blocked KNOWN method gets the interceptor's error, not the handler.
+  {
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("x");
+    ch.CallMethod("I.Secret", req, &resp, &cntl);
+    EXPECT(cntl.Failed());
+    EXPECT_EQ(cntl.error_code(), 77);
+  }
+  EXPECT(seen.load() >= 2);
+}
+
 TEST_MAIN
