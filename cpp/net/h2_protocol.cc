@@ -581,6 +581,22 @@ void h2_process_request(InputMessage&& msg) {
   const std::string* verb = find_header(*headers, ":method");
   req.verb = verb != nullptr ? *verb : "GET";
 
+  // Interceptor gate — covers builtins too; /health stays open.
+  {
+    int ec = 0;
+    std::string et;
+    if (req.path != "/health" &&
+        !srv->accept_request(req.path, sock->remote(), &ec, &et)) {
+      // gRPC's status space is its own: PERMISSION_DENIED with the
+      // caller's code folded into the message; plain h2 gets 403.
+      h2_respond(msg.socket, stream_id, grpc ? 200 : 403, resp_ct,
+                 grpc ? "" : "error " + std::to_string(ec) + ": " + et +
+                                "\n",
+                 grpc, 7, "error " + std::to_string(ec) + ": " + et);
+      return;
+    }
+  }
+
   // 1. Builtin endpoints (same table as HTTP/1).
   std::string body;
   std::string ctype = "text/plain";
@@ -613,18 +629,7 @@ void h2_process_request(InputMessage&& msg) {
                8, "resource exhausted");
     return;
   }
-  if (srv->interceptor()) {
-    int ec = EACCES;
-    std::string et = "rejected by interceptor";
-    if (!srv->interceptor()(rpc_name, &ec, &et)) {
-      if (limiter != nullptr) {
-        limiter->on_response(0, true);
-      }
-      h2_respond(msg.socket, stream_id, grpc ? 200 : 403, resp_ct,
-                 grpc ? "" : et + "\n", grpc, 7, et);
-      return;
-    }
-  }
+
   IOBuf request;
   if (grpc) {
     if (msg.payload.size() > 0 && !grpc_unframe(msg.payload, &request)) {

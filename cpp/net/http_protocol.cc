@@ -107,6 +107,18 @@ void http_process_request(InputMessage&& msg) {
     return;
   }
 
+  // Interceptor gate — BEFORE builtin dispatch too (an access policy
+  // must cover the observability pages; /health stays open like auth).
+  if (srv != nullptr && req->path != "/health") {
+    int ec = 0;
+    std::string et;
+    if (!srv->accept_request(req->path, sock->remote(), &ec, &et)) {
+      http_respond(msg.socket, *req, 403, "text/plain",
+                   "error " + std::to_string(ec) + ": " + et + "\n");
+      return;
+    }
+  }
+
   // 1. Builtin observability endpoints.
   std::string body;
   std::string ctype = "text/plain";
@@ -141,17 +153,7 @@ void http_process_request(InputMessage&& msg) {
                  "rejected by concurrency limiter\n");
     return;
   }
-  if (srv->interceptor()) {
-    int ec = EACCES;
-    std::string et = "rejected by interceptor";
-    if (!srv->interceptor()(rpc_name, &ec, &et)) {
-      if (limiter != nullptr) {
-        limiter->on_response(0, true);
-      }
-      http_respond(msg.socket, *req, 403, "text/plain", et + "\n");
-      return;
-    }
-  }
+
   auto* cntl = new Controller();
   cntl->set_method(rpc_name);
   auto* response = new IOBuf();

@@ -323,8 +323,11 @@ void Server::on_acceptable(SocketId id, void* ctx) {
     return;
   }
   while (true) {
-    const int fd = accept4(listener->fd(), nullptr, nullptr,
-                           SOCK_NONBLOCK | SOCK_CLOEXEC);
+    sockaddr_in peer_sa = {};
+    socklen_t peer_len = sizeof(peer_sa);
+    const int fd =
+        accept4(listener->fd(), reinterpret_cast<sockaddr*>(&peer_sa),
+                &peer_len, SOCK_NONBLOCK | SOCK_CLOEXEC);
     if (fd < 0) {
       break;  // EAGAIN or error; ET will refire on next connection
     }
@@ -332,6 +335,8 @@ void Server::on_acceptable(SocketId id, void* ctx) {
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     Socket::Options opts;
     opts.fd = fd;
+    opts.remote.ip = peer_sa.sin_addr.s_addr;
+    opts.remote.port = ntohs(peer_sa.sin_port);
     opts.on_readable = &messenger_on_readable;
     opts.user_data = srv;
     SocketId conn_id = 0;
@@ -535,10 +540,10 @@ void tstd_process_request(InputMessage&& msg) {
     done();
     return;
   }
-  if (srv->interceptor()) {
-    int ec = EACCES;
-    std::string et = "rejected by interceptor";
-    if (!srv->interceptor()(method, &ec, &et)) {
+  {
+    int ec = 0;
+    std::string et;
+    if (!srv->accept_request(method, sock->remote(), &ec, &et)) {
       cntl->SetFailed(ec, et);
       done();
       return;

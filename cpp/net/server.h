@@ -13,6 +13,8 @@
 #include <string>
 #include <vector>
 
+#include <errno.h>
+
 #include "base/flat_map.h"
 #include "net/auth.h"
 #include "base/recordio.h"
@@ -50,14 +52,28 @@ class Server {
   void set_authenticator(const Authenticator* auth) { auth_ = auth; }
   const Authenticator* authenticator() const { return auth_; }
 
-  // Request interceptor (parity: brpc::Interceptor, interceptor.h:26):
-  // runs before EVERY accepted request on every serving protocol; return
-  // false (optionally setting *error_code/*error_text) to reject without
-  // reaching the handler.  Call before Start.
+  // Request interceptor (parity: brpc::Interceptor, interceptor.h:26,
+  // whose Accept sees the Controller): runs before EVERY request on every
+  // serving protocol — RPC methods AND builtin observability paths (only
+  // /health stays open, like auth) — with the method-or-path and the
+  // peer.  Return false (optionally setting *error_code/*error_text) to
+  // reject without reaching the handler.  Call before Start.
   using Interceptor = std::function<bool(
-      const std::string& method, int* error_code, std::string* error_text)>;
+      const std::string& method, const EndPoint& peer, int* error_code,
+      std::string* error_text)>;
   void set_interceptor(Interceptor icpt) { interceptor_ = std::move(icpt); }
   const Interceptor& interceptor() const { return interceptor_; }
+  // Shared acceptance check (one body for all protocols).  True = admit;
+  // false fills *error_code/*error_text.
+  bool accept_request(const std::string& method, const EndPoint& peer,
+                      int* error_code, std::string* error_text) const {
+    if (!interceptor_) {
+      return true;
+    }
+    *error_code = EACCES;
+    *error_text = "rejected by interceptor";
+    return interceptor_(method, peer, error_code, error_text);
+  }
 
   ~Server();
 

@@ -537,10 +537,11 @@ TEST_CASE(interceptor_gates_every_protocol) {
   srv.RegisterMethod("I.Secret", [](Controller*, const IOBuf&, IOBuf*,
                                     Closure done) { done(); });
   static std::atomic<int> seen{0};
-  srv.set_interceptor([](const std::string& method, int* ec,
-                         std::string* et) {
+  srv.set_interceptor([](const std::string& method, const EndPoint& peer,
+                         int* ec, std::string* et) {
     seen.fetch_add(1);
-    if (method == "I.Echo") {
+    EXPECT(peer.port != 0);  // peer context is available to policies
+    if (method == "I.Echo" || method == "/health") {
       return true;
     }
     *ec = 77;
@@ -568,6 +569,31 @@ TEST_CASE(interceptor_gates_every_protocol) {
     EXPECT_EQ(cntl.error_code(), 77);
   }
   EXPECT(seen.load() >= 2);
+  // HTTP path: the same policy covers RPC-over-HTTP AND builtins.
+  {
+    const int fd = socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in sa = {};
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    sa.sin_port = htons(static_cast<uint16_t>(srv.port()));
+    EXPECT_EQ(connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)), 0);
+    const std::string rq = "GET /vars HTTP/1.1\r\nHost: x\r\n\r\n";
+    EXPECT(write(fd, rq.data(), rq.size()) ==
+           static_cast<ssize_t>(rq.size()));
+    char buf[512];
+    ssize_t n = read(fd, buf, sizeof(buf));
+    EXPECT(n > 0);
+    const std::string r1(buf, n);
+    EXPECT(r1.find("403") != std::string::npos);
+    EXPECT(r1.find("error 77") != std::string::npos);
+    const std::string hq = "GET /health HTTP/1.1\r\nHost: x\r\n\r\n";
+    EXPECT(write(fd, hq.data(), hq.size()) ==
+           static_cast<ssize_t>(hq.size()));
+    n = read(fd, buf, sizeof(buf));
+    EXPECT(n > 0);
+    EXPECT(std::string(buf, n).find("200 OK") != std::string::npos);
+    close(fd);
+  }
 }
 
 TEST_MAIN
