@@ -1,10 +1,12 @@
 #include "net/h2_protocol.h"
 
+#include <algorithm>
 #include <cstring>
 #include <map>
 #include <memory>
 #include <mutex>
 #include <string>
+#include <vector>
 
 #include "base/logging.h"
 #include "base/time.h"
@@ -24,6 +26,8 @@ constexpr uint32_t kFrameHeaderLen = 9;
 constexpr uint32_t kMaxFrameSize = 16384;        // our advertised max
 constexpr uint32_t kDefaultWindow = 65535;
 constexpr uint32_t kRecvWindow = 1 << 20;        // what we grant peers
+constexpr uint32_t kMaxConcurrentStreams = 256;  // advertised in SETTINGS
+constexpr uint32_t kRefusedStream = 0x7;         // RST_STREAM error code
 
 enum FrameType : uint8_t {
   kData = 0x0,
@@ -100,6 +104,15 @@ struct H2Conn {
   std::mutex mu;  // response path vs parse path (different fibers)
   std::map<uint32_t, H2Stream> streams;
   uint32_t continuation_stream = 0;  // nonzero while CONTINUATIONs expected
+  // Stream being refused over MAX_CONCURRENT_STREAMS: its header block is
+  // still HPACK-decoded (the dynamic table is connection state — skipping
+  // a block would desync every later stream) but into a throwaway list,
+  // then RST_STREAM(REFUSED_STREAM) instead of tearing the connection down.
+  uint32_t refusing_stream = 0;
+  H2Stream refused_scratch;  // header-block accumulator for refused streams
+  // Highest client stream id ever opened or refused: frames on an unknown
+  // id at or below this belong to a closed/refused stream, not a new one.
+  uint32_t max_stream_id = 0;
   int32_t conn_send_window = kDefaultWindow;
   // Peer's SETTINGS_INITIAL_WINDOW_SIZE: seeds NEW streams; a repeated
   // SETTINGS adjusts open streams by the delta from the PREVIOUS value.
@@ -300,6 +313,8 @@ ParseError h2_parse(IOBuf* source, InputMessage* out, Socket* sock) {
     put_u32(&payload, kMaxFrameSize);
     payload.append("\x00\x04", 2);  // INITIAL_WINDOW_SIZE
     put_u32(&payload, kRecvWindow);
+    payload.append("\x00\x03", 2);  // MAX_CONCURRENT_STREAMS
+    put_u32(&payload, kMaxConcurrentStreams);
     settings += frame_header(static_cast<uint32_t>(payload.size()),
                              kSettings, 0, 0) +
                 payload;
@@ -364,6 +379,9 @@ ParseError h2_parse(IOBuf* source, InputMessage* out, Socket* sock) {
                 static_cast<int32_t>(val) - c->peer_initial_window;
             c->peer_initial_window = static_cast<int32_t>(val);
             for (auto& [sid2, st] : c->streams) {
+              if (delta > 0 && st.send_window > INT32_MAX - delta) {
+                return ParseError::kCorrupted;  // RFC 9113 §6.9.2 overflow
+              }
               st.send_window += delta;
             }
           }
@@ -390,10 +408,36 @@ ParseError h2_parse(IOBuf* source, InputMessage* out, Socket* sock) {
           return ParseError::kCorrupted;
         }
         if (stream_id == 0) {
+          if (c->conn_send_window > INT32_MAX - static_cast<int32_t>(inc)) {
+            return ParseError::kCorrupted;  // RFC 9113 §6.9.1 overflow
+          }
           c->conn_send_window += static_cast<int32_t>(inc);
+          // A bigger connection window can unblock streams stalled on it
+          // ALONE (their per-stream window never emptied, so no per-stream
+          // WINDOW_UPDATE is coming to resume them).  flush erases
+          // completed streams, so collect ids before touching the map.
+          std::vector<uint32_t> stalled;
+          for (auto& [sid2, st2] : c->streams) {
+            if (!st2.pending_data.empty()) {
+              stalled.push_back(sid2);
+            }
+          }
+          for (uint32_t sid2 : stalled) {
+            if (c->conn_send_window <= 0) {
+              break;
+            }
+            auto it2 = c->streams.find(sid2);
+            if (it2 != c->streams.end()) {
+              flush_pending_locked(c, sock->id(), sid2, &it2->second);
+            }
+          }
         } else {
           auto it = c->streams.find(stream_id);
           if (it != c->streams.end()) {
+            if (it->second.send_window >
+                INT32_MAX - static_cast<int32_t>(inc)) {
+              return ParseError::kCorrupted;  // per-stream window overflow
+            }
             it->second.send_window += static_cast<int32_t>(inc);
             flush_pending_locked(c, sock->id(), stream_id, &it->second);
           }
@@ -419,15 +463,6 @@ ParseError h2_parse(IOBuf* source, InputMessage* out, Socket* sock) {
         if (stream_id == 0) {
           return ParseError::kCorrupted;
         }
-        if (c->streams.find(stream_id) == c->streams.end()) {
-          if (c->streams.size() >= 256) {
-            // Unbounded half-open streams are a memory DoS; a conforming
-            // client stays far below this.
-            return ParseError::kCorrupted;
-          }
-          c->streams[stream_id].send_window = c->peer_initial_window;
-        }
-        H2Stream& st = c->streams[stream_id];
         const uint8_t* frag = p;
         uint32_t frag_len = len;
         if (type == kHeaders) {
@@ -451,13 +486,43 @@ ParseError h2_parse(IOBuf* source, InputMessage* out, Socket* sock) {
             return ParseError::kCorrupted;
           }
           frag_len -= pad;
-          if (flags & kEndStream) {
-            st.headers_done = true;  // no body coming
+        }
+        // CONTINUATION is only legal while a header block is open on this
+        // stream (RFC 7540 §6.10); a bare one must not create stream state.
+        if (type == kContinuation && c->continuation_stream != stream_id) {
+          return ParseError::kCorrupted;
+        }
+        const bool known = c->streams.count(stream_id) != 0;
+        // A stream over the advertised MAX_CONCURRENT_STREAMS — or on a
+        // stale id (closed/refused earlier) — is refused with
+        // RST_STREAM/REFUSED_STREAM instead of tearing down the whole
+        // connection.  Its header block still passes through the shared
+        // machinery below (accumulate, cap, HPACK-decode) because the
+        // HPACK dynamic table is connection state: skipping a block would
+        // desync every later stream.  Only the destination differs: a
+        // scratch stream whose decoded headers are discarded.
+        const bool refused =
+            !known && (c->refusing_stream == stream_id ||
+                       stream_id <= c->max_stream_id ||
+                       c->streams.size() >= kMaxConcurrentStreams);
+        H2Stream* st;
+        if (refused) {
+          c->refusing_stream = stream_id;
+          c->max_stream_id = std::max(c->max_stream_id, stream_id);
+          st = &c->refused_scratch;
+        } else {
+          if (!known) {
+            c->streams[stream_id].send_window = c->peer_initial_window;
+            c->max_stream_id = std::max(c->max_stream_id, stream_id);
+          }
+          st = &c->streams[stream_id];
+          if (type == kHeaders && (flags & kEndStream)) {
+            st->headers_done = true;  // no (more) body coming
           }
         }
-        st.header_block.append(reinterpret_cast<const char*>(frag),
-                               frag_len);
-        if (st.header_block.size() > 256 * 1024) {
+        st->header_block.append(reinterpret_cast<const char*>(frag),
+                                frag_len);
+        if (st->header_block.size() > 256 * 1024) {
           return ParseError::kCorrupted;
         }
         if ((flags & kEndHeaders) == 0) {
@@ -466,16 +531,30 @@ ParseError h2_parse(IOBuf* source, InputMessage* out, Socket* sock) {
         }
         c->continuation_stream = 0;
         if (!c->decoder.decode(
-                reinterpret_cast<const uint8_t*>(st.header_block.data()),
-                st.header_block.size(), &st.headers)) {
+                reinterpret_cast<const uint8_t*>(st->header_block.data()),
+                st->header_block.size(), &st->headers)) {
           return ParseError::kCorrupted;
         }
-        st.header_block.clear();
-        if (st.headers_done) {  // END_STREAM rode the HEADERS
+        st->header_block.clear();
+        if (refused) {
+          st->headers.clear();
+          c->refusing_stream = 0;
+          std::string rst;
+          put_u32(&rst, kRefusedStream);
+          send_frames(sock->id(),
+                      frame_header(4, kRstStream, 0, stream_id) + rst);
+          break;
+        }
+        if (st->headers_done) {  // END_STREAM rode the HEADERS
           out->meta.type = RpcMeta::kRequest;
           out->meta.stream_id = stream_id;
-          out->ctx = std::make_shared<HeaderList>(std::move(st.headers));
-          st.headers.clear();
+          // Trailing HEADERS after DATA (legal HTTP/2): the decoder
+          // appended the trailer fields to st->headers, and the body
+          // accumulated so far must ride along.
+          out->ctx = std::make_shared<HeaderList>(std::move(st->headers));
+          out->payload = std::move(st->body);
+          st->headers.clear();
+          st->body.clear();
           return ParseError::kOk;
         }
         break;

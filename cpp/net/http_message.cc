@@ -333,8 +333,13 @@ ParseError http_parse_request(IOBuf* source, HttpRequest* req, IOBuf* body,
       }
       have_content_length = true;
     } else if (ci_equal(name, "transfer-encoding")) {
-      if (!ci_contains(value, "chunked")) {
-        return ParseError::kCorrupted;  // unsupported encoding
+      // Only the exact value "chunked" is supported (value is already
+      // OWS-trimmed).  A substring match would accept "chunked, gzip" —
+      // where the body framing is gzip-of-chunks — as plain chunked (a
+      // desync vector behind proxies honoring the full coding list), and
+      // "gzip, chunked" would hand still-compressed bytes to the handler.
+      if (!ci_equal(value, "chunked")) {
+        return ParseError::kCorrupted;  // unsupported coding list
       }
       req->chunked = true;
     } else if (ci_equal(name, "connection")) {

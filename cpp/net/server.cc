@@ -415,8 +415,10 @@ void tstd_process_request(InputMessage&& msg) {
     meta.error_text = "connection not authenticated";
     IOBuf frame;
     tstd_pack(&frame, meta, IOBuf());
-    sock->Write(std::move(frame));
-    sock->SetFailed(EACCES);
+    // Flush-then-close: an explicit SetFailed would bump the socket
+    // version before the KeepWrite fiber re-Addresses it, dropping the
+    // EACCES reply and leaving the client with a bare reset.
+    sock->Write(std::move(frame), /*close_after=*/true);
     return;
   }
   const SocketId socket_id = msg.socket;

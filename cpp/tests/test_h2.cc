@@ -553,4 +553,144 @@ TEST_CASE(h2_grpc_large_response_window_drain) {
   EXPECT(body.substr(5) == blob);
 }
 
+TEST_CASE(h2_trailers_after_data_carry_body) {
+  // END_STREAM arriving on a trailing HEADERS frame (trailers after DATA,
+  // legal HTTP/2) must not lose the accumulated body.
+  start_once();
+  H2TestClient cli;
+  EXPECT(cli.connect_and_preface());
+  HpackEncoder enc;
+  HeaderList req_headers = {
+      {":method", "POST"},
+      {":scheme", "http"},
+      {":path", "/Echo.Echo"},
+      {":authority", "t"},
+  };
+  std::string block;
+  enc.encode(req_headers, &block);
+  const std::string body = "body-before-trailers";
+  HeaderList trailers = {{"x-checksum", "fletcher"}};
+  std::string tblock;
+  enc.encode(trailers, &tblock);
+  std::string wire =
+      fh(static_cast<uint32_t>(block.size()), 0x1, 0x4, 1) + block +
+      fh(static_cast<uint32_t>(body.size()), 0x0, 0, 1) + body +
+      // trailing HEADERS: END_HEADERS | END_STREAM
+      fh(static_cast<uint32_t>(tblock.size()), 0x1, 0x4 | 0x1, 1) + tblock;
+  EXPECT(cli.send_all(wire));
+  HpackDecoder dec;
+  std::string resp_body;
+  bool end_stream = false;
+  while (!end_stream) {
+    uint8_t type = 0;
+    uint8_t flags = 0;
+    uint32_t sid = 0;
+    std::string payload;
+    EXPECT(cli.read_frame(&type, &flags, &sid, &payload));
+    if (type == 0x1 && sid == 1) {
+      HeaderList h;
+      EXPECT(dec.decode(reinterpret_cast<const uint8_t*>(payload.data()),
+                        payload.size(), &h));
+      end_stream = (flags & 0x1) != 0;
+    } else if (type == 0x0 && sid == 1) {
+      resp_body += payload;
+      end_stream = (flags & 0x1) != 0;
+    }
+  }
+  EXPECT(resp_body == body);
+}
+
+TEST_CASE(h2_window_update_overflow_kills_connection) {
+  // A WINDOW_UPDATE pushing the connection send window past 2^31-1 is a
+  // flow-control error (RFC 9113 §6.9.1) — the connection must die, not
+  // wrap negative and stall.
+  start_once();
+  H2TestClient cli;
+  EXPECT(cli.connect_and_preface());
+  std::string inc;
+  inc.push_back(0x7f);
+  inc.push_back(static_cast<char>(0xff));
+  inc.push_back(static_cast<char>(0xff));
+  inc.push_back(static_cast<char>(0xff));  // +2147483647 on stream 0
+  EXPECT(cli.send_all(fh(4, 0x8, 0, 0) + inc));
+  // Connection must be closed by the server: reads drain then EOF.
+  uint8_t type = 0;
+  uint8_t flags = 0;
+  uint32_t sid = 0;
+  std::string payload;
+  bool closed = false;
+  for (int i = 0; i < 64 && !closed; ++i) {
+    closed = !cli.read_frame(&type, &flags, &sid, &payload);
+  }
+  EXPECT(closed);
+}
+
+TEST_CASE(h2_stream_flood_refused_not_fatal) {
+  // Opening more than the advertised MAX_CONCURRENT_STREAMS must refuse
+  // the excess stream (RST_STREAM/REFUSED_STREAM) while the earlier
+  // streams keep working — not tear down the whole connection.
+  start_once();
+  H2TestClient cli;
+  EXPECT(cli.connect_and_preface());
+  HpackEncoder enc;
+  std::string wire;
+  // 257 half-open request streams (headers sent, body pending).
+  for (uint32_t i = 0; i < 257; ++i) {
+    const uint32_t sid = 1 + 2 * i;
+    HeaderList h = {
+        {":method", "POST"},
+        {":scheme", "http"},
+        {":path", "/Echo.Echo"},
+        {":authority", "t"},
+    };
+    std::string block;
+    enc.encode(h, &block);
+    wire += fh(static_cast<uint32_t>(block.size()), 0x1, 0x4, sid) + block;
+  }
+  EXPECT(cli.send_all(wire));
+  // Expect RST_STREAM(REFUSED_STREAM) for the 257th (sid 513).
+  bool refused = false;
+  while (!refused) {
+    uint8_t type = 0;
+    uint8_t flags = 0;
+    uint32_t sid = 0;
+    std::string payload;
+    EXPECT(cli.read_frame(&type, &flags, &sid, &payload));
+    if (type == 0x3 && sid == 513) {
+      EXPECT_EQ(payload.size(), 4u);
+      const uint32_t code =
+          (static_cast<uint32_t>(static_cast<uint8_t>(payload[0])) << 24) |
+          (static_cast<uint32_t>(static_cast<uint8_t>(payload[1])) << 16) |
+          (static_cast<uint32_t>(static_cast<uint8_t>(payload[2])) << 8) |
+          static_cast<uint8_t>(payload[3]);
+      EXPECT_EQ(code, 0x7u);  // REFUSED_STREAM
+      refused = true;
+    }
+  }
+  // Stream 1 still completes end-to-end on the same connection.
+  const std::string body = "still-alive";
+  EXPECT(cli.send_all(fh(static_cast<uint32_t>(body.size()), 0x0, 0x1, 1) +
+                      body));
+  HpackDecoder dec;
+  std::string resp_body;
+  bool end_stream = false;
+  while (!end_stream) {
+    uint8_t type = 0;
+    uint8_t flags = 0;
+    uint32_t sid = 0;
+    std::string payload;
+    EXPECT(cli.read_frame(&type, &flags, &sid, &payload));
+    if (type == 0x1 && sid == 1) {
+      HeaderList h;
+      EXPECT(dec.decode(reinterpret_cast<const uint8_t*>(payload.data()),
+                        payload.size(), &h));
+      end_stream = (flags & 0x1) != 0;
+    } else if (type == 0x0 && sid == 1) {
+      resp_body += payload;
+      end_stream = (flags & 0x1) != 0;
+    }
+  }
+  EXPECT(resp_body == body);
+}
+
 TEST_MAIN

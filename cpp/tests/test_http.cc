@@ -185,6 +185,24 @@ TEST_CASE(smuggling_vectors_rejected) {
   }
 }
 
+TEST_CASE(transfer_encoding_chunked_must_be_exact) {
+  // "chunked, gzip" frames the body as gzip-of-chunks (desync behind
+  // proxies honoring the full list); "gzip, chunked" would deliver
+  // still-compressed bytes.  Only the exact value "chunked" is accepted.
+  for (const char* te : {"chunked, gzip", "gzip, chunked", "chunkedx"}) {
+    const std::string r = http_get(
+        std::string("POST /Echo.Echo HTTP/1.1\r\nHost: x\r\n"
+                    "Transfer-Encoding: ") +
+        te + "\r\n\r\n5\r\nabcde\r\n0\r\n\r\n");
+    EXPECT(r.empty());  // connection killed without a response
+  }
+  // "chunked" with surrounding whitespace stays accepted (OWS trim).
+  const std::string ok = http_get(
+      "POST /Echo.Echo HTTP/1.1\r\nHost: x\r\n"
+      "Transfer-Encoding:  chunked \r\n\r\n5\r\nabcde\r\n0\r\n\r\n");
+  EXPECT(ok.find("200") != std::string::npos);
+}
+
 TEST_CASE(uri_query_and_percent_decoding) {
   start_once();
   // Unknown flag name exercises the decoded single-target path.
