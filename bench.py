@@ -17,81 +17,210 @@ two quantities on the TPU data plane:
   Server/Channel, the multi_threaded_echo_c++ analogue) ride along under
   "cpp" when the binary exists.
 
+Robustness contract (the axon TPU tunnel can wedge uninterruptibly, even
+to SIGKILL): the sweep child emits ONE JSON ROW PER SIZE incrementally;
+the parent enforces a per-row deadline, keeps every completed row when a
+size wedges, and re-runs only the MISSING sizes on a CPU fallback child.
+Each row is tagged with the platform it actually ran on, so a partial
+TPU leg yields partial TPU rows instead of a silently-CPU artifact.
+Children share a persistent XLA compilation cache so re-runs skip the
+20-40s first-compile cost.
+
 Prints ONE JSON line. Headline metric stays the 64MB echo goodput vs the
 reference's 2.3 GB/s; the sweep rows are under "sweep".
+
+Env knobs: BENCH_FORCE_CPU=1 skips the TPU leg entirely; BENCH_CHILD=1
+runs the row-emitting sweep in-process (sizes from BENCH_SIZES, csv of
+bytes); BENCH_BUDGET=seconds caps the parent's total wall clock.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import select
+import signal
 import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-
-from brpc_tpu.models.echo import single_chip_echo_step
-
 BASELINE_GBPS = 2.3
 SIZES = [1 << 10, 1 << 16, 1 << 20, 1 << 24, 1 << 26]  # 1KB .. 64MB
 FUSED_MIN_BYTES = 1 << 20  # fused kernel tiles 256KB blocks; use it from 1MB
+CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache")
 
 
-def _steps():
-    """size_bytes -> jitted echo step (payload: uint32[size/4])."""
-    on_tpu = jax.devices()[0].platform == "tpu"
+# ---------------------------------------------------------------- child ----
+
+def _child_sweep(sizes: list[int]) -> None:
+    """Runs in a subprocess: one JSON row per size, flushed immediately."""
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass
+
+    from brpc_tpu.models.echo import single_chip_echo_step
+
+    platform = jax.devices()[0].platform
     fused = None
-    if on_tpu:
+    if platform == "tpu":
         from brpc_tpu.ops.echo_kernel import echo_fused
 
         fused = jax.jit(echo_fused, donate_argnums=0)
     plain = jax.jit(single_chip_echo_step, donate_argnums=0)
 
-    def pick(size: int):
-        if fused is not None and size >= FUSED_MIN_BYTES:
-            return fused
-        return plain
+    for size in sizes:
+        step = fused if (fused is not None and size >= FUSED_MIN_BYTES) \
+            else plain
+        lanes = size // 4
+        payload = jnp.arange(lanes, dtype=jnp.uint32)
+        resp, csum = step(payload)  # compile + warm
+        jax.block_until_ready((resp, csum))
 
-    return pick
+        # RTT: synchronous steps, blocking per call — the per-call latency
+        # a client of the device data plane observes.
+        iters_lat = max(20, min(200, (16 << 20) // size))
+        lats = []
+        for _ in range(iters_lat):
+            t0 = time.perf_counter()
+            resp, csum = step(resp)
+            jax.block_until_ready(csum)
+            lats.append(time.perf_counter() - t0)
+        lats.sort()
 
-
-def _bench_size(step, size: int) -> dict:
-    lanes = size // 4
-    payload = jnp.arange(lanes, dtype=jnp.uint32)
-    resp, csum = step(payload)  # compile + warm
-    jax.block_until_ready((resp, csum))
-
-    # RTT: synchronous steps, blocking per call — the per-call latency a
-    # client of the device data plane observes.
-    iters_lat = max(20, min(200, (16 << 20) // size))
-    lats = []
-    for _ in range(iters_lat):
+        # Goodput: chained (each iteration consumes the previous response),
+        # one sync at the end.
+        iters_tp = max(10, min(300, (256 << 20) // size))
         t0 = time.perf_counter()
-        resp, csum = step(resp)
-        jax.block_until_ready(csum)
-        lats.append(time.perf_counter() - t0)
-    lats.sort()
+        for _ in range(iters_tp):
+            resp, csum = step(resp)
+        jax.block_until_ready((resp, csum))
+        dt = time.perf_counter() - t0
 
-    # Goodput: chained (each iteration consumes the previous response), one
-    # sync at the end.
-    iters_tp = max(10, min(300, (256 << 20) // size))
+        def pct(p: float) -> float:
+            return lats[min(len(lats) - 1, int(p * len(lats)))]
+
+        print(json.dumps({
+            "size": size,
+            "goodput_gbps": round(size * iters_tp / dt / 1e9, 3),
+            "p50_us": round(pct(0.50) * 1e6, 1),
+            "p99_us": round(pct(0.99) * 1e6, 1),
+            "platform": platform,
+        }), flush=True)
+
+
+def _child_zerocopy() -> None:
+    """Loopback RPC echo: bytes-copy path vs zero-copy (dlpack reference)
+    path — the staged-vs-copied delta VERDICT r2 asked to measure."""
+    import numpy as np
+
+    from brpc_tpu.rpc import zerocopy
+    from brpc_tpu.rpc.client import Channel
+    from brpc_tpu.rpc.server import Server
+
+    srv = Server()
+    srv.register("Echo.Echo", lambda call, req: call.respond(req))
+    srv.start(0)
+    ch = Channel(f"127.0.0.1:{srv.port}", timeout_ms=10000)
+    size = 4 << 20
+    payload = np.arange(size // 4, dtype=np.uint32)
+    iters = 30
+
+    ch.call("Echo.Echo", payload.tobytes())  # warm both directions
+    zerocopy.call_zero_copy(ch, "Echo.Echo", payload)
+
     t0 = time.perf_counter()
-    for _ in range(iters_tp):
-        resp, csum = step(resp)
-    jax.block_until_ready((resp, csum))
-    dt = time.perf_counter() - t0
+    for _ in range(iters):
+        ch.call("Echo.Echo", payload.tobytes())
+    copied_dt = time.perf_counter() - t0
 
-    def pct(p: float) -> float:
-        return lats[min(len(lats) - 1, int(p * len(lats)))]
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        zerocopy.call_zero_copy(ch, "Echo.Echo", payload)
+    zc_dt = time.perf_counter() - t0
 
-    return {
-        "size": size,
-        "goodput_gbps": round(size * iters_tp / dt / 1e9, 3),
-        "p50_us": round(pct(0.50) * 1e6, 1),
-        "p99_us": round(pct(0.99) * 1e6, 1),
-    }
+    print(json.dumps({
+        "kind": "py_loopback_4MB",
+        "copied_gbps": round(size * iters / copied_dt / 1e9, 3),
+        "zerocopy_gbps": round(size * iters / zc_dt / 1e9, 3),
+    }), flush=True)
+    ch.close()
+    srv.stop()
+
+
+# --------------------------------------------------------------- parent ----
+
+class _RowReader:
+    """Runs a sweep child, harvesting JSON rows under per-row deadlines.
+
+    The child gets its own session so the whole group can be SIGKILLed,
+    and is never blockingly reaped — a child wedged in uninterruptible
+    TPU-init sleep can ignore even SIGKILL, and waiting on it would hang
+    the parent in exactly the scenario it guards against.
+    """
+
+    def __init__(self, sizes: list[int], force_cpu: bool):
+        env = dict(os.environ)
+        env["BENCH_CHILD"] = "1"
+        env["BENCH_SIZES"] = ",".join(str(s) for s in sizes)
+        env["JAX_COMPILATION_CACHE_DIR"] = CACHE_DIR
+        if force_cpu:
+            env["BENCH_FORCE_CPU"] = "1"
+        self.err_f = open("/tmp/bench_child.err", "w+")
+        self.child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=self.err_f,
+            start_new_session=True,
+        )
+        self.buf = b""
+
+    def next_row(self, deadline_s: float) -> dict | None:
+        """One parsed row, or None on child exit/deadline (child killed)."""
+        fd = self.child.stdout.fileno()
+        t_end = time.time() + deadline_s
+        while True:
+            nl = self.buf.find(b"\n")
+            if nl >= 0:
+                line = self.buf[:nl].decode("utf-8", "replace").strip()
+                self.buf = self.buf[nl + 1:]
+                if line.startswith("{"):
+                    try:
+                        return json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                continue
+            left = t_end - time.time()
+            if left <= 0:
+                self.kill()
+                return None
+            ready, _, _ = select.select([fd], [], [], min(left, 1.0))
+            if not ready:
+                continue
+            chunk = os.read(fd, 65536)
+            if not chunk:  # EOF: child finished (or died)
+                return None
+            self.buf += chunk
+
+    def kill(self) -> None:
+        try:
+            os.killpg(self.child.pid, signal.SIGKILL)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        self.kill()
+        try:
+            self.child.stdout.close()
+            self.err_f.close()
+        except OSError:
+            pass
 
 
 def _cpp_rows() -> list:
@@ -119,79 +248,85 @@ def _cpp_rows() -> list:
     return rows
 
 
-def _run_sweep() -> None:
-    if os.environ.get("BENCH_FORCE_CPU"):
-        jax.config.update("jax_platforms", "cpu")
-    pick = _steps()
-    sweep = [_bench_size(pick(size), size) for size in SIZES]
-    head = sweep[-1]  # 64MB row
-    print(
-        json.dumps(
-            {
-                "metric": "echo_goodput_64MB",
-                "value": head["goodput_gbps"],
-                "unit": "GB/s",
-                "vs_baseline": round(head["goodput_gbps"] / BASELINE_GBPS, 3),
-                "platform": jax.devices()[0].platform,
-                "sweep": sweep,
-                "cpp": _cpp_rows(),
-            }
-        )
-    )
+def _harvest(sizes: list[int], force_cpu: bool, budget_end: float,
+             first_row_s: float, row_s: float) -> dict[int, dict]:
+    """Collect rows for `sizes` from one child; partial results kept."""
+    rows: dict[int, dict] = {}
+    reader = _RowReader(sizes, force_cpu)
+    try:
+        deadline = first_row_s
+        while len(rows) < len(sizes):
+            deadline = min(deadline, budget_end - time.time())
+            if deadline <= 0:
+                break
+            row = reader.next_row(deadline)
+            if row is None:
+                break
+            if isinstance(row.get("size"), int):
+                rows[row["size"]] = row
+            deadline = row_s
+    finally:
+        reader.close()
+    return rows
 
 
 def main() -> None:
-    if os.environ.get("BENCH_CHILD"):
-        _run_sweep()
+    if os.environ.get("BENCH_ZC"):
+        _child_zerocopy()
         return
-    # Watchdog: the axon TPU tunnel can wedge hard (uninterruptible hangs
-    # inside backend init).  Run the sweep in a child with a deadline; if
-    # the TPU leg never completes, fall back to a CPU run so the driver
-    # always records a JSON line (marked by "platform").
-    here = os.path.abspath(__file__)
-    last_err = ""
-    for attempt_env, deadline in (({}, 420), ({"BENCH_FORCE_CPU": "1"}, 300)):
-        env = dict(os.environ)
-        env["BENCH_CHILD"] = "1"
-        env.update(attempt_env)
-        # Own session so the whole group can be SIGKILLed; and do NOT
-        # block on reaping — a child wedged in uninterruptible TPU-init
-        # sleep may ignore even SIGKILL, and waiting on it would hang the
-        # watchdog in exactly the scenario it guards against.
-        with open("/tmp/bench_child.out", "w+") as out_f, open(
-            "/tmp/bench_child.err", "w+"
-        ) as err_f:
-            child = subprocess.Popen(
-                [sys.executable, here], env=env, stdout=out_f,
-                stderr=err_f, start_new_session=True,
-            )
-            t0 = time.time()
-            rc = None
-            while time.time() - t0 < deadline:
-                rc = child.poll()
-                if rc is not None:
-                    break
-                time.sleep(1.0)
-            if rc is None:
-                import signal
+    if os.environ.get("BENCH_CHILD"):
+        sizes = [int(s) for s in
+                 os.environ.get("BENCH_SIZES", "").split(",") if s] or SIZES
+        _child_sweep(sizes)
+        return
 
-                try:
-                    os.killpg(child.pid, signal.SIGKILL)
-                except OSError:
-                    pass
-                continue  # move on even if the corpse cannot be reaped
-            out_f.seek(0)
-            stdout = out_f.read()
-            err_f.seek(0)
-            last_err = err_f.read()[-2000:]
-        lines = [ln for ln in stdout.splitlines() if ln.startswith("{")]
-        if rc == 0 and lines:
-            print(lines[-1])
-            return
-    raise RuntimeError(
-        "bench failed on both TPU and CPU fallback; last stderr:\n" +
-        last_err
-    )
+    budget = float(os.environ.get("BENCH_BUDGET", "500"))
+    budget_end = time.time() + budget
+    os.makedirs(CACHE_DIR, exist_ok=True)
+
+    rows: dict[int, dict] = {}
+    if not os.environ.get("BENCH_FORCE_CPU"):
+        # TPU leg: generous first-row deadline (backend init + first
+        # compile), tighter steady-state; reserve tail budget for the CPU
+        # fallback of whatever is missing.
+        tpu_end = budget_end - 90
+        rows = _harvest(SIZES, force_cpu=False, budget_end=tpu_end,
+                        first_row_s=240, row_s=120)
+    missing = [s for s in SIZES if s not in rows]
+    if missing:
+        cpu_rows = _harvest(missing, force_cpu=True, budget_end=budget_end,
+                            first_row_s=90, row_s=60)
+        rows.update(cpu_rows)
+
+    sweep = [rows[s] for s in SIZES if s in rows]
+    if not sweep:
+        raise RuntimeError(
+            "bench produced no rows on TPU or CPU; last child stderr:\n" +
+            open("/tmp/bench_child.err").read()[-2000:])
+    zerocopy = None
+    try:
+        env = dict(os.environ)
+        env["BENCH_ZC"] = "1"
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=60)
+        for ln in out.stdout.splitlines():
+            if ln.startswith("{"):
+                zerocopy = json.loads(ln)
+    except Exception:  # noqa: BLE001 — bench must still print its line
+        pass
+
+    head = sweep[-1]  # largest completed size (64MB when all rows landed)
+    print(json.dumps({
+        "metric": "echo_goodput_64MB",
+        "value": head["goodput_gbps"],
+        "unit": "GB/s",
+        "vs_baseline": round(head["goodput_gbps"] / BASELINE_GBPS, 3),
+        "platform": head["platform"],
+        "sweep": sweep,
+        "cpp": _cpp_rows(),
+        "zerocopy": zerocopy,
+    }))
 
 
 if __name__ == "__main__":

@@ -74,10 +74,15 @@ class ArenaBlock:
         self.view = np.frombuffer(buf, dtype=np.uint8)  # writable, no copy
 
     def put(self, array) -> int:
-        """Lands a (host or device) array's bytes in the staging block —
-        the single device→host DMA of the transport hop.  Returns the byte
-        length."""
-        flat = np.asarray(array).reshape(-1).view(np.uint8)
+        """Lands a (host or device) array's bytes in the staging block.
+        Host-backed arrays enter via a dlpack VIEW (one memcpy into the
+        slab, no intermediate); TPU-resident arrays take one device→host
+        DMA then the memcpy.  Returns the byte length.  For the fully
+        copy-free path, see rpc.zerocopy.append_jax — a slab only pays off
+        when the block must live in registered/shm-backed memory."""
+        from brpc_tpu.rpc.zerocopy import host_view
+
+        flat, _owner = host_view(array)
         n = flat.size
         if n > self.view.size:
             raise ValueError(f"{n} bytes > block size {self.view.size}")

@@ -91,6 +91,27 @@ int trpc_iobuf_in_arena(void* buf, void* arena, size_t pos) {
   return 0;
 }
 
+// Wrap caller-owned memory (e.g. a dlpack-exported JAX host buffer)
+// without copying: the bytes enter the IOBuf by reference and
+// deleter(data, ctx) runs when the LAST IOBuf reference drops — which may
+// be on a fiber worker after the wire write completes, so a Python ctypes
+// deleter must be re-entrant-safe (ctypes acquires the GIL itself).
+void trpc_iobuf_append_user_data(void* buf, void* data, size_t n,
+                                 void (*deleter)(void*, void*), void* ctx) {
+  static_cast<IOBuf*>(buf)->append_user_data(data, n, deleter, ctx);
+}
+
+// Data pointer of block ref i (pointer-identity introspection for the
+// zero-copy tests: proves the caller's buffer itself is on the wire).
+void* trpc_iobuf_block_ptr(void* buf, size_t i) {
+  auto* b = static_cast<IOBuf*>(buf);
+  if (i >= b->block_count()) {
+    return nullptr;
+  }
+  const IOBuf::BlockRef& r = b->ref_at(i);
+  return r.block->data + r.offset;
+}
+
 void* trpc_iobuf_create() { return new IOBuf(); }
 
 void trpc_iobuf_destroy(void* buf) { delete static_cast<IOBuf*>(buf); }

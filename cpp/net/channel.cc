@@ -7,6 +7,7 @@
 #include "base/time.h"
 #include "fiber/fiber.h"
 #include "fiber/timer.h"
+#include "net/h2_client.h"
 #include "net/messenger.h"
 #include "net/protocol.h"
 #include "net/shm_transport.h"
@@ -16,14 +17,22 @@
 
 namespace trpc {
 
-namespace {
-
 // Completes a call that is currently LOCKED via its fid: records latency,
 // cancels the timeout timer, destroys the id (waking sync joiners) and runs
 // the async done.  Mirrors Controller::OnVersionedRPCReturned ordering
 // (controller.cpp:611): state is finalized before anyone can observe it.
+// Shared with the h2 client response path (h2_client.cc).
 void complete_locked_call(fid_t cid, Controller* cntl) {
   cntl->set_latency_us(monotonic_time_us() - cntl->call().start_us);
+  // h2 calls completing WITHOUT a response (timeout / local failure) must
+  // drop their client-side stream state, or dead streams accumulate on
+  // the multiplexed connection for its whole lifetime.
+  if (cntl->call().h2_stream != 0) {
+    if (cntl->Failed()) {
+      h2_client_cancel(cntl->call().socket_id, cntl->call().h2_stream);
+    }
+    cntl->call().h2_stream = 0;
+  }
   // Connection-type epilogue: pooled connections go back to the shared
   // pool (socket.h:611-627 parity), short ones close now.
   const SocketId conn = cntl->call().socket_id;
@@ -70,6 +79,8 @@ void complete_locked_call(fid_t cid, Controller* cntl) {
     done();
   }
 }
+
+namespace {
 
 int on_call_error(fid_t cid, void* data, int code) {
   Controller* cntl = static_cast<Controller*>(data);
@@ -147,12 +158,27 @@ int Channel::Init(const std::string& addr, const Options* opts) {
   if (opts != nullptr) {
     opts_ = *opts;
   }
+  if (opts_.protocol == "tstd") {
+    proto_ = 0;
+  } else if (opts_.protocol == "h2") {
+    proto_ = 1;
+  } else if (opts_.protocol == "grpc") {
+    proto_ = 2;
+  } else {
+    return -1;  // unknown protocol must not silently mean tstd
+  }
   ConnectionType ct;
   if (!parse_connection_type(opts_.connection_type, &ct)) {
     return -1;  // typo'd type must not silently mean "single"
   }
   if (opts_.use_shm && ct != ConnectionType::kSingle) {
     return -1;  // shm rings are inherently single-connection
+  }
+  if (proto_ != 0) {
+    if (ct != ConnectionType::kSingle || opts_.use_shm) {
+      return -1;  // h2 multiplexes one connection by design
+    }
+    h2_client_protocol_index();  // register before any response arrives
   }
   conn_type_ = static_cast<uint8_t>(ct);
   return hostname2endpoint(addr.c_str(), &ep_);
@@ -227,6 +253,14 @@ int Channel::ensure_socket(SocketId* out) {
   if (Socket::Create(sopts, &sock_) != 0) {
     return -1;
   }
+  if (proto_ != 0) {
+    // h2/grpc: pin + install connection state while still single-threaded
+    // (sock_mu_ held); the credential rides the "authorization" header per
+    // request (h2_client_issue), not a tstd kAuth frame.
+    h2_client_bind(sock_);
+    *out = sock_;
+    return 0;
+  }
   if (send_credential(sock_, opts_.auth) != 0) {
     SocketRef dead(Socket::Address(sock_));
     if (dead) {
@@ -249,6 +283,7 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
   cntl->call().socket_id = 0;
   cntl->call().conn_type = 0;
   cntl->call().conn_auth = nullptr;
+  cntl->call().h2_stream = 0;
   const bool sync = !cntl->call().done;
   // rpcz: client span; a handler fiber's ambient server span becomes the
   // parent (channel.cpp:506-527 parity).
@@ -280,6 +315,18 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
 
   SocketId sid = 0;
   const auto ct = static_cast<ConnectionType>(conn_type_);
+  if (proto_ != 0 &&
+      (cntl->call().offered_stream != 0 ||
+       cntl->request_compress_type() != 0)) {
+    // Streaming offers and tstd-negotiated compression have no h2
+    // carrier; failing loudly beats silently dropping the option.
+    fid_unlock(cid);
+    fid_error(cid, EINVAL);
+    if (sync) {
+      fid_join(cid);
+    }
+    return;
+  }
   if (cntl->call().offered_stream != 0 && ct != ConnectionType::kSingle) {
     // A stream outlives the call and pins its connection; pooled/short
     // connections are per-call by definition.
@@ -337,6 +384,39 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
     cntl->call().timeout_timer = TimerThread::instance()->schedule(
         cntl->call().start_us + eff_timeout_ms * 1000, timeout_cb,
         reinterpret_cast<void*>(cid));
+  }
+
+  if (proto_ != 0) {  // h2 / grpc path: PackH2Request-equivalent
+    std::string auth_hdr;
+    if (opts_.auth != nullptr &&
+        opts_.auth->generate_credential(&auth_hdr) != 0) {
+      fid_unlock(cid);
+      fid_error(cid, EACCES);
+      if (sync) {
+        fid_join(cid);
+      }
+      return;
+    }
+    IOBuf body = request;  // zero-copy share
+    if (!cntl->request_attachment().empty()) {
+      body.append(cntl->request_attachment());  // h2 has no split concept
+    }
+    if (span != nullptr) {
+      span_annotate(span, "request packed");
+    }
+    uint32_t stream_id = 0;
+    const bool ok = h2_client_issue(sid, cid, method, body, proto_ == 2,
+                                    endpoint2str(ep_), auth_hdr,
+                                    &stream_id) == 0;
+    cntl->call().h2_stream = stream_id;
+    fid_unlock(cid);
+    if (!ok) {
+      fid_error(cid, ECONNRESET);
+    }
+    if (sync) {
+      fid_join(cid);
+    }
+    return;
   }
 
   RpcMeta meta;

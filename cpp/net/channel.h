@@ -17,6 +17,12 @@
 
 namespace trpc {
 
+// Finalizes a call whose fid is currently LOCKED by the caller: records
+// latency, runs the connection-type epilogue, cancels the timeout timer,
+// destroys the id (waking sync joiners) and runs the async done.  Shared
+// by the tstd and h2 client response paths.
+void complete_locked_call(fid_t cid, Controller* cntl);
+
 class Channel {
  public:
   struct Options {
@@ -28,6 +34,11 @@ class Channel {
     std::string connection_type = "single";
     // Client credential for servers requiring auth (auth.h; not owned).
     const Authenticator* auth = nullptr;
+    // Wire protocol this channel speaks: "tstd" (default framed RPC),
+    // "h2" (HTTP/2, response body = payload), or "grpc" (h2 + gRPC
+    // path/framing/trailers).  h2/grpc connections are multiplexed and
+    // require connection_type "single".
+    std::string protocol = "tstd";
     // Same-host shared-memory transport (net/shm_transport.h): the channel
     // handshakes a ring segment over TCP, then calls flow through shm.
     // Falls back to TCP transparently if the handshake fails.
@@ -56,6 +67,7 @@ class Channel {
 
   EndPoint ep_;
   Options opts_;
+  uint8_t proto_ = 0;  // 0 = tstd, 1 = h2, 2 = grpc (parsed in Init)
   // FiberMutex, NOT std::mutex: ensure_socket can block (shm handshake is a
   // sync RPC) and contenders must park their fibers, never wedge worker
   // pthreads — with a std::mutex, N concurrent first-calls deadlock the

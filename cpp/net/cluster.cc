@@ -347,6 +347,7 @@ int ClusterChannel::refresh() {
         copts.timeout_ms = opts_.timeout_ms;
         copts.connection_type = opts_.connection_type;
         copts.auth = opts_.auth;
+        copts.protocol = opts_.protocol;
         if (ch->Init(endpoint2str(ep), &copts) != 0) {
           continue;
         }

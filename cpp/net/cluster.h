@@ -89,9 +89,10 @@ class ClusterChannel {
     int64_t quarantine_base_ms = 100;    // doubles per consecutive failure
     int64_t quarantine_max_ms = 10000;
     // Passed through to every member Channel (socket_map.h connection
-    // matrix / auth.h credentials).
+    // matrix / auth.h credentials / wire protocol: "tstd", "h2", "grpc").
     std::string connection_type = "single";
     const Authenticator* auth = nullptr;
+    std::string protocol = "tstd";
   };
 
   ~ClusterChannel();

@@ -85,6 +85,9 @@ class Controller {
     uint64_t peer_stream = 0;
     uint64_t peer_stream_window = 0;
     uint64_t accepted_stream = 0;
+    // h2/grpc calls: the stream id issued for this call, so a failed call
+    // (timeout) can cancel its client-side stream state (h2_client.h).
+    uint32_t h2_stream = 0;
   };
   CallState& call() { return call_; }
   void set_method(const std::string& m) { method_ = m; }

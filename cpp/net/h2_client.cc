@@ -1,0 +1,569 @@
+#include "net/h2_client.h"
+
+#include <algorithm>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include <errno.h>
+
+#include "base/logging.h"
+#include "net/channel.h"
+#include "net/controller.h"
+#include "net/h2_frames.h"
+#include "net/hpack.h"
+#include "net/protocol.h"
+
+namespace trpc {
+
+namespace {
+
+using namespace h2;
+
+// One in-flight request stream (client view).
+struct H2CliStream {
+  uint64_t cid = 0;          // the call this stream answers
+  HeaderList headers;        // response headers (+trailers, appended)
+  std::string header_block;  // fragments until END_HEADERS
+  IOBuf body;
+  int32_t send_window = kDefaultWindow;  // peer's grant for our DATA
+  // Request bytes awaiting window — an IOBuf so window-limited drains
+  // cut chunks by reference instead of memmoving a string tail.
+  IOBuf pending_data;
+  bool pending_end = false;
+  bool request_done = false;  // our END_STREAM has been sent
+  bool response_end = false;  // peer's END_STREAM seen (may precede
+                              // END_HEADERS when trailers span frames)
+};
+
+// Per-connection client state, hung on Socket::parse_state.
+struct H2CliConn {
+  bool preface_sent = false;
+  HpackEncoder encoder;
+  HpackDecoder decoder;
+  std::mutex mu;  // issue path vs parse path (different fibers)
+  std::map<uint32_t, H2CliStream> streams;
+  uint32_t next_stream_id = 1;  // client streams are odd
+  uint32_t continuation_stream = 0;
+  int32_t conn_send_window = kDefaultWindow;
+  int32_t peer_initial_window = kDefaultWindow;
+  uint32_t peer_max_frame = kMaxFrameSize;
+};
+
+const char kH2CliStateTag = 0;  // parse_state owner tag
+
+H2CliConn* conn_of(Socket* s) {
+  if (s->parse_state == nullptr || s->parse_state_owner != &kH2CliStateTag) {
+    s->parse_state = std::make_shared<H2CliConn>();
+    s->parse_state_owner = &kH2CliStateTag;
+  }
+  return static_cast<H2CliConn*>(s->parse_state.get());
+}
+
+void send_frames(SocketId sid, std::string&& bytes) {
+  SocketRef s(Socket::Address(sid));
+  if (s) {
+    IOBuf out;
+    out.append(bytes);
+    s->Write(std::move(out));
+  }
+}
+
+void send_wire(SocketId sid, IOBuf&& wire) {
+  if (wire.empty()) {
+    return;
+  }
+  SocketRef s(Socket::Address(sid));
+  if (s) {
+    s->Write(std::move(wire));
+  }
+}
+
+// Appends as much of the stream's pending request DATA as the windows
+// allow to *wire (chunks are CUT by reference, not copied).  Call with
+// conn->mu held.
+void flush_pending_locked(H2CliConn* c, uint32_t stream_id, H2CliStream* st,
+                          IOBuf* wire) {
+  while (!st->pending_data.empty() && st->send_window > 0 &&
+         c->conn_send_window > 0) {
+    const uint32_t chunk = std::min<uint32_t>(
+        {static_cast<uint32_t>(st->pending_data.size()),
+         static_cast<uint32_t>(st->send_window),
+         static_cast<uint32_t>(c->conn_send_window), c->peer_max_frame});
+    const bool last = chunk == st->pending_data.size() && st->pending_end;
+    wire->append(frame_header(chunk, kData, last ? kEndStream : 0,
+                              stream_id));
+    IOBuf part;
+    st->pending_data.cutn(&part, chunk);
+    wire->append(std::move(part));
+    st->send_window -= static_cast<int32_t>(chunk);
+    c->conn_send_window -= static_cast<int32_t>(chunk);
+    if (last) {
+      st->request_done = true;
+    }
+  }
+}
+
+// Builds the response InputMessage for a completed (END_STREAM) stream and
+// erases it.  Call with conn->mu held.
+void complete_stream_locked(H2CliConn* c, uint32_t stream_id,
+                            H2CliStream* st, InputMessage* out) {
+  out->meta.type = RpcMeta::kResponse;
+  out->meta.correlation_id = st->cid;
+  out->meta.stream_id = stream_id;
+  out->ctx = std::make_shared<HeaderList>(std::move(st->headers));
+  out->payload = std::move(st->body);
+  c->streams.erase(stream_id);
+}
+
+// ---- frame parsing (server → client direction) ---------------------------
+
+ParseError h2c_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr) {
+    return ParseError::kTryOtherProtocol;  // needs connection state
+  }
+  if (source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  H2CliConn* c = conn_of(sock);
+  std::lock_guard<std::mutex> g(c->mu);
+  while (true) {
+    uint8_t head[kFrameHeaderLen];
+    if (source->copy_to(head, kFrameHeaderLen) < kFrameHeaderLen) {
+      return ParseError::kNotEnoughData;
+    }
+    const uint32_t len = get_u24(head);
+    const uint8_t type = head[3];
+    const uint8_t flags = head[4];
+    const uint32_t stream_id = get_u31(head + 5);
+    if (len > kMaxFrameSize) {
+      return ParseError::kCorrupted;
+    }
+    if (source->size() < kFrameHeaderLen + len) {
+      return ParseError::kNotEnoughData;
+    }
+    source->pop_front(kFrameHeaderLen);
+    std::string payload;
+    payload.resize(len);
+    source->copy_to(payload.data(), len);
+    source->pop_front(len);
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(payload.data());
+
+    if (c->continuation_stream != 0 &&
+        (type != kContinuation || stream_id != c->continuation_stream)) {
+      return ParseError::kCorrupted;  // CONTINUATION barrier
+    }
+
+    switch (type) {
+      case kSettings: {
+        if (stream_id != 0 || (len % 6 != 0 && (flags & kAck) == 0)) {
+          return ParseError::kCorrupted;
+        }
+        if (flags & kAck) {
+          break;
+        }
+        IOBuf wire;
+        for (uint32_t off = 0; off + 6 <= len; off += 6) {
+          const uint16_t id = static_cast<uint16_t>(p[off]) << 8 | p[off + 1];
+          const uint32_t val = (static_cast<uint32_t>(p[off + 2]) << 24) |
+                               (static_cast<uint32_t>(p[off + 3]) << 16) |
+                               (static_cast<uint32_t>(p[off + 4]) << 8) |
+                               p[off + 5];
+          if (id == 0x5) {  // MAX_FRAME_SIZE
+            if (val >= 16384 && val <= 1 << 24) {
+              c->peer_max_frame = std::min<uint32_t>(val, 1 << 20);
+            }
+          } else if (id == 0x4) {  // INITIAL_WINDOW_SIZE
+            if (val > 0x7fffffffu) {
+              return ParseError::kCorrupted;
+            }
+            const int32_t delta =
+                static_cast<int32_t>(val) - c->peer_initial_window;
+            c->peer_initial_window = static_cast<int32_t>(val);
+            for (auto& [sid2, st] : c->streams) {
+              if (delta > 0 && st.send_window > INT32_MAX - delta) {
+                return ParseError::kCorrupted;  // RFC 9113 §6.9.2
+              }
+              st.send_window += delta;
+              flush_pending_locked(c, sid2, &st, &wire);
+            }
+          }
+        }
+        wire.append(frame_header(0, kSettings, kAck, 0));
+        send_wire(sock->id(), std::move(wire));
+        break;
+      }
+      case kPing: {
+        if (len != 8 || stream_id != 0) {
+          return ParseError::kCorrupted;
+        }
+        if ((flags & kAck) == 0) {
+          send_frames(sock->id(), frame_header(8, kPing, kAck, 0) + payload);
+        }
+        break;
+      }
+      case kWindowUpdate: {
+        if (len != 4) {
+          return ParseError::kCorrupted;
+        }
+        const uint32_t inc = get_u31(p);
+        if (inc == 0) {
+          return ParseError::kCorrupted;
+        }
+        IOBuf wire;
+        if (stream_id == 0) {
+          if (c->conn_send_window > INT32_MAX - static_cast<int32_t>(inc)) {
+            return ParseError::kCorrupted;  // RFC 9113 §6.9.1 overflow
+          }
+          c->conn_send_window += static_cast<int32_t>(inc);
+          for (auto& [sid2, st] : c->streams) {
+            if (c->conn_send_window <= 0) {
+              break;
+            }
+            flush_pending_locked(c, sid2, &st, &wire);
+          }
+        } else {
+          auto it = c->streams.find(stream_id);
+          if (it != c->streams.end()) {
+            if (it->second.send_window >
+                INT32_MAX - static_cast<int32_t>(inc)) {
+              return ParseError::kCorrupted;
+            }
+            it->second.send_window += static_cast<int32_t>(inc);
+            flush_pending_locked(c, stream_id, &it->second, &wire);
+          }
+        }
+        send_wire(sock->id(), std::move(wire));
+        break;
+      }
+      case kRstStream: {
+        if (len != 4 || stream_id == 0) {
+          return ParseError::kCorrupted;
+        }
+        auto it = c->streams.find(stream_id);
+        if (it != c->streams.end()) {
+          // Surface as an errored response so the call fails promptly
+          // instead of waiting out its timeout.
+          complete_stream_locked(c, stream_id, &it->second, out);
+          out->meta.error_code = ECONNRESET;
+          out->meta.error_text =
+              "h2 stream reset by peer (code " +
+              std::to_string(get_u31(p)) + ")";
+          return ParseError::kOk;
+        }
+        break;
+      }
+      case kGoaway:
+        // Streams above last_stream_id will never complete; the server
+        // closes the connection when done and socket failure wakes the
+        // rest.  Consume.
+        break;
+      case kPriority:
+      case kPushPromise:
+        break;  // we never enable push; priority is advisory
+      case kHeaders:
+      case kContinuation: {
+        if (stream_id == 0) {
+          return ParseError::kCorrupted;
+        }
+        const uint8_t* frag = p;
+        uint32_t frag_len = len;
+        bool end_stream = false;
+        if (type == kHeaders) {
+          uint32_t pad = 0;
+          if (flags & kPadded) {
+            if (frag_len < 1) {
+              return ParseError::kCorrupted;
+            }
+            pad = *frag;
+            ++frag;
+            --frag_len;
+          }
+          if (flags & kPriorityFlag) {
+            if (frag_len < 5) {
+              return ParseError::kCorrupted;
+            }
+            frag += 5;
+            frag_len -= 5;
+          }
+          if (pad > frag_len) {
+            return ParseError::kCorrupted;
+          }
+          frag_len -= pad;
+          end_stream = (flags & kEndStream) != 0;
+        }
+        if (type == kContinuation && c->continuation_stream != stream_id) {
+          return ParseError::kCorrupted;  // RFC 7540 §6.10
+        }
+        auto it = c->streams.find(stream_id);
+        if (it == c->streams.end()) {
+          // Response on a stream we never opened (or already completed):
+          // connection state is corrupt.
+          return ParseError::kCorrupted;
+        }
+        H2CliStream& st = it->second;
+        if (end_stream) {
+          st.pending_end = false;  // no point sending more request bytes
+          st.pending_data.clear();
+          st.response_end = true;  // persists across CONTINUATIONs
+        }
+        st.header_block.append(reinterpret_cast<const char*>(frag),
+                               frag_len);
+        if (st.header_block.size() > 256 * 1024) {
+          return ParseError::kCorrupted;
+        }
+        if ((flags & kEndHeaders) == 0) {
+          c->continuation_stream = stream_id;
+          break;
+        }
+        c->continuation_stream = 0;
+        if (!c->decoder.decode(
+                reinterpret_cast<const uint8_t*>(st.header_block.data()),
+                st.header_block.size(), &st.headers)) {
+          return ParseError::kCorrupted;
+        }
+        st.header_block.clear();
+        if (st.response_end) {
+          complete_stream_locked(c, stream_id, &st, out);
+          return ParseError::kOk;
+        }
+        break;
+      }
+      case kData: {
+        if (stream_id == 0) {
+          return ParseError::kCorrupted;
+        }
+        auto it = c->streams.find(stream_id);
+        const uint8_t* d = p;
+        uint32_t dlen = len;
+        if (flags & kPadded) {
+          if (dlen < 1 || d[0] > dlen - 1) {
+            return ParseError::kCorrupted;
+          }
+          dlen -= d[0] + 1;
+          ++d;
+        }
+        // Replenish receive windows regardless (credit must not leak).
+        if (len > 0) {
+          std::string wu;
+          put_u32(&wu, len);
+          std::string frames = frame_header(4, kWindowUpdate, 0, 0) + wu;
+          if (it != c->streams.end()) {
+            std::string wu2;
+            put_u32(&wu2, len);
+            frames += frame_header(4, kWindowUpdate, 0, stream_id) + wu2;
+          }
+          send_frames(sock->id(), std::move(frames));
+        }
+        if (it == c->streams.end()) {
+          break;  // stale stream (reset/completed): discard
+        }
+        H2CliStream& st = it->second;
+        st.body.append(d, dlen);
+        if (st.body.size() > (1ull << 30)) {
+          return ParseError::kCorrupted;
+        }
+        if (flags & kEndStream) {
+          complete_stream_locked(c, stream_id, &st, out);
+          return ParseError::kOk;
+        }
+        break;
+      }
+      default:
+        break;  // unknown frame types are ignored (RFC 7540 §4.1)
+    }
+    if (source->empty()) {
+      return ParseError::kNotEnoughData;
+    }
+  }
+}
+
+// ---- response processing -------------------------------------------------
+
+void h2c_process_response(InputMessage&& msg) {
+  const fid_t cid = msg.meta.correlation_id;
+  void* data = nullptr;
+  if (fid_lock(cid, &data) != 0) {
+    return;  // stale (timed out): harmless
+  }
+  Controller* cntl = static_cast<Controller*>(data);
+  if (msg.meta.error_code != 0) {  // RST_STREAM path
+    cntl->SetFailed(msg.meta.error_code, msg.meta.error_text);
+    complete_locked_call(cid, cntl);
+    return;
+  }
+  auto headers = std::static_pointer_cast<HeaderList>(msg.ctx);
+  const std::string* status = find_header(*headers, ":status");
+  const std::string* grpc_status = find_header(*headers, "grpc-status");
+  const std::string* ct = find_header(*headers, "content-type");
+  const bool grpc =
+      grpc_status != nullptr ||
+      (ct != nullptr && ct->rfind("application/grpc", 0) == 0);
+  if (grpc) {
+    const int gs = grpc_status != nullptr ? atoi(grpc_status->c_str()) : 2;
+    if (gs != 0) {
+      const std::string* gm = find_header(*headers, "grpc-message");
+      cntl->SetFailed(EREMOTE, gm != nullptr
+                                   ? *gm
+                                   : "grpc-status " + std::to_string(gs));
+      complete_locked_call(cid, cntl);
+      return;
+    }
+    IOBuf unframed;
+    if (msg.payload.size() > 0 && !grpc_unframe(msg.payload, &unframed)) {
+      cntl->SetFailed(EBADMSG, "bad grpc response framing");
+      complete_locked_call(cid, cntl);
+      return;
+    }
+    if (cntl->call().response != nullptr) {
+      *cntl->call().response = std::move(unframed);
+    }
+    complete_locked_call(cid, cntl);
+    return;
+  }
+  if (status == nullptr || *status != "200") {
+    cntl->SetFailed(EREMOTE,
+                    "http status " + (status != nullptr ? *status : "?") +
+                        ": " + msg.payload.to_string().substr(0, 200));
+    complete_locked_call(cid, cntl);
+    return;
+  }
+  if (cntl->call().response != nullptr) {
+    *cntl->call().response = std::move(msg.payload);
+  }
+  complete_locked_call(cid, cntl);
+}
+
+void h2c_process_request(InputMessage&&) {
+  // Client side only: servers never arrive here (sockets are pre-pinned).
+}
+
+}  // namespace
+
+int h2_client_protocol_index() {
+  static const int index = [] {
+    Protocol p = {"h2c", h2c_parse, h2c_process_request,
+                  h2c_process_response,
+                  /*process_in_order=*/false};
+    return register_protocol(p);
+  }();
+  return index;
+}
+
+void h2_client_bind(SocketId sid) {
+  SocketRef s(Socket::Address(sid));
+  if (s) {
+    s->pinned_protocol = h2_client_protocol_index();
+    conn_of(s.get());  // install state while single-threaded
+  }
+}
+
+int h2_client_issue(SocketId sid, uint64_t cid, const std::string& method,
+                    const IOBuf& request, bool grpc,
+                    const std::string& authority,
+                    const std::string& auth_header,
+                    uint32_t* stream_id_out) {
+  SocketRef s(Socket::Address(sid));
+  if (!s) {
+    return -1;
+  }
+  H2CliConn* c = conn_of(s.get());
+  std::lock_guard<std::mutex> g(c->mu);
+  IOBuf wire;
+  if (!c->preface_sent) {
+    c->preface_sent = true;
+    std::string pre(kPreface, kPrefaceLen);
+    std::string settings;
+    settings.append("\x00\x05", 2);  // MAX_FRAME_SIZE
+    put_u32(&settings, kMaxFrameSize);
+    settings.append("\x00\x04", 2);  // INITIAL_WINDOW_SIZE
+    put_u32(&settings, kRecvWindow);
+    pre += frame_header(static_cast<uint32_t>(settings.size()), kSettings,
+                        0, 0) +
+           settings;
+    std::string wu;  // grow the connection-level receive window too
+    put_u32(&wu, kRecvWindow - kDefaultWindow);
+    pre += frame_header(4, kWindowUpdate, 0, 0) + wu;
+    wire.append(pre);
+  }
+  const uint32_t stream_id = c->next_stream_id;
+  c->next_stream_id += 2;
+  H2CliStream& st = c->streams[stream_id];
+  st.cid = cid;
+  st.send_window = c->peer_initial_window;
+  if (stream_id_out != nullptr) {
+    *stream_id_out = stream_id;
+  }
+
+  std::string path = "/" + method;
+  if (grpc) {
+    // gRPC paths are /package.Service/Method: the LAST dot splits the
+    // service from the method ("pkg.Svc.Method" → "/pkg.Svc/Method").
+    const size_t dot = path.rfind('.');
+    if (dot != std::string::npos) {
+      path[dot] = '/';
+    }
+  }
+  HeaderList req_headers = {
+      {":method", "POST"},
+      {":scheme", "http"},
+      {":path", path},
+      {":authority", authority},
+  };
+  if (grpc) {
+    req_headers.push_back({"content-type", "application/grpc"});
+    req_headers.push_back({"te", "trailers"});
+  }
+  if (!auth_header.empty()) {
+    req_headers.push_back({"authorization", auth_header});
+  }
+  std::string block;
+  c->encoder.encode(req_headers, &block);
+
+  IOBuf body = request;  // zero-copy share
+  if (grpc) {
+    std::string prefix;
+    prefix.push_back(0);  // uncompressed
+    put_u32(&prefix, static_cast<uint32_t>(body.size()));
+    IOBuf framed;
+    framed.append(prefix);
+    framed.append(std::move(body));
+    body = std::move(framed);
+  }
+  if (body.empty()) {
+    wire.append(frame_header(static_cast<uint32_t>(block.size()), kHeaders,
+                             kEndHeaders | kEndStream, stream_id) +
+                block);
+    st.request_done = true;
+  } else {
+    wire.append(frame_header(static_cast<uint32_t>(block.size()), kHeaders,
+                             kEndHeaders, stream_id) +
+                block);
+    st.pending_data = std::move(body);
+    st.pending_end = true;
+    flush_pending_locked(c, stream_id, &st, &wire);
+  }
+  return s->Write(std::move(wire)) == 0 ? 0 : -1;
+}
+
+void h2_client_cancel(SocketId sid, uint32_t stream_id) {
+  SocketRef s(Socket::Address(sid));
+  if (!s || s->parse_state_owner != &kH2CliStateTag) {
+    return;
+  }
+  auto* c = static_cast<H2CliConn*>(s->parse_state.get());
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->streams.find(stream_id);
+  if (it == c->streams.end()) {
+    return;  // already completed normally
+  }
+  c->streams.erase(it);
+  std::string rst;
+  put_u32(&rst, 0x8);  // CANCEL
+  send_frames(sid, frame_header(4, kRstStream, 0, stream_id) + rst);
+}
+
+}  // namespace trpc

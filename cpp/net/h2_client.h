@@ -1,0 +1,51 @@
+// HTTP/2 + gRPC client side.
+//
+// Parity: the reference's PackH2Request / H2UnsentRequest machinery
+// (/root/reference/src/brpc/policy/http2_rpc_protocol.cpp:1793): client
+// connection preface, stream-id allocation, HPACK-encoded request headers,
+// flow-control-aware DATA, and trailer (grpc-status) handling.  Channel
+// routes calls here when Options::protocol is "h2" or "grpc"; responses
+// come back through the protocol registry like tstd's, correlated by a
+// per-connection stream-id → call-id map instead of a wire correlation id.
+#pragma once
+
+#include <string>
+
+#include "base/iobuf.h"
+#include "net/socket.h"
+
+namespace trpc {
+
+// Registers the client-side h2 protocol (idempotent) and returns its
+// registry index — client sockets are PRE-pinned to it: the client knows
+// what it speaks, and the server's first bytes (a SETTINGS frame) carry
+// no distinctive magic for probing.
+int h2_client_protocol_index();
+
+// Binds a fresh client socket to the h2 client protocol: pins the
+// protocol index and installs the per-connection state.  Must run once
+// BEFORE concurrent h2_client_issue calls can race (Channel does it under
+// its socket mutex right after creating the connection).
+void h2_client_bind(SocketId sid);
+
+// Issues one request on an h2 client connection: writes the connection
+// preface + SETTINGS on first use, allocates the next odd stream id,
+// HPACK-encodes the request headers and sends DATA as the peer's flow
+// windows allow (the remainder is queued and drains on WINDOW_UPDATE).
+// `grpc` selects gRPC path form (/pkg.Svc/Method), content-type and
+// message framing; `auth_header` rides as "authorization" when non-empty.
+// `*stream_id_out` receives the allocated stream id (for cancel on call
+// failure).  Returns 0 when the frames were queued to the socket.
+int h2_client_issue(SocketId sid, uint64_t cid, const std::string& method,
+                    const IOBuf& request, bool grpc,
+                    const std::string& authority,
+                    const std::string& auth_header,
+                    uint32_t* stream_id_out = nullptr);
+
+// Drops a stream whose call completed without a response (timeout /
+// local failure): erases the client-side state — otherwise dead streams
+// and their queued request bytes accumulate for the connection's
+// lifetime — and tells the server via RST_STREAM(CANCEL).
+void h2_client_cancel(SocketId sid, uint32_t stream_id);
+
+}  // namespace trpc

@@ -1,0 +1,122 @@
+// HTTP/2 wire primitives shared by the server (h2_protocol.cc) and client
+// (h2_client.cc) halves: frame-header build/read helpers, the RFC 7540
+// frame-type/flag constants, and the gRPC length-prefixed message framing
+// (the values are RFC constants; the connection state machines on either
+// side are separate by design — server parses requests, client parses
+// responses).
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "base/iobuf.h"
+#include "net/hpack.h"
+
+namespace trpc {
+namespace h2 {
+
+constexpr char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+constexpr size_t kPrefaceLen = 24;
+constexpr uint32_t kFrameHeaderLen = 9;
+constexpr uint32_t kMaxFrameSize = 16384;  // our advertised max
+constexpr uint32_t kDefaultWindow = 65535;
+constexpr uint32_t kRecvWindow = 1 << 20;  // what we grant peers
+constexpr uint32_t kRefusedStream = 0x7;   // RST_STREAM error code
+
+enum FrameType : uint8_t {
+  kData = 0x0,
+  kHeaders = 0x1,
+  kPriority = 0x2,
+  kRstStream = 0x3,
+  kSettings = 0x4,
+  kPushPromise = 0x5,
+  kPing = 0x6,
+  kGoaway = 0x7,
+  kWindowUpdate = 0x8,
+  kContinuation = 0x9,
+};
+
+enum Flags : uint8_t {
+  kEndStream = 0x1,
+  kEndHeaders = 0x4,
+  kPadded = 0x8,
+  kPriorityFlag = 0x20,
+  kAck = 0x1,
+};
+
+inline void put_u24(std::string* s, uint32_t v) {
+  s->push_back(static_cast<char>(v >> 16));
+  s->push_back(static_cast<char>(v >> 8));
+  s->push_back(static_cast<char>(v));
+}
+
+inline void put_u32(std::string* s, uint32_t v) {
+  s->push_back(static_cast<char>(v >> 24));
+  s->push_back(static_cast<char>(v >> 16));
+  s->push_back(static_cast<char>(v >> 8));
+  s->push_back(static_cast<char>(v));
+}
+
+inline uint32_t get_u24(const uint8_t* p) {
+  return (static_cast<uint32_t>(p[0]) << 16) |
+         (static_cast<uint32_t>(p[1]) << 8) | p[2];
+}
+
+inline uint32_t get_u31(const uint8_t* p) {
+  return ((static_cast<uint32_t>(p[0]) & 0x7f) << 24) |
+         (static_cast<uint32_t>(p[1]) << 16) |
+         (static_cast<uint32_t>(p[2]) << 8) | p[3];
+}
+
+inline std::string frame_header(uint32_t len, uint8_t type, uint8_t flags,
+                                uint32_t stream_id) {
+  std::string h;
+  put_u24(&h, len);
+  h.push_back(static_cast<char>(type));
+  h.push_back(static_cast<char>(flags));
+  put_u32(&h, stream_id);
+  return h;
+}
+
+// gRPC length-prefixed message framing (details/grpc.* parity).
+inline std::string grpc_frame(const std::string& msg) {
+  std::string out;
+  out.push_back(0);  // uncompressed
+  put_u32(&out, static_cast<uint32_t>(msg.size()));
+  out += msg;
+  return out;
+}
+
+inline bool grpc_unframe(const IOBuf& body, IOBuf* msg) {
+  if (body.size() < 5) {
+    return false;
+  }
+  uint8_t head[5];
+  body.copy_to(head, 5);
+  if (head[0] != 0) {
+    return false;  // compressed grpc messages unsupported (negotiated off)
+  }
+  const uint32_t len = (static_cast<uint32_t>(head[1]) << 24) |
+                       (static_cast<uint32_t>(head[2]) << 16) |
+                       (static_cast<uint32_t>(head[3]) << 8) | head[4];
+  if (body.size() < 5ull + len) {
+    return false;
+  }
+  IOBuf tmp = body;
+  tmp.pop_front(5);
+  tmp.cutn(msg, len);
+  return true;
+}
+
+inline const std::string* find_header(const HeaderList& h,
+                                      const char* name) {
+  for (const auto& [k, v] : h) {
+    if (k == name) {
+      return &v;
+    }
+  }
+  return nullptr;
+}
+
+}  // namespace h2
+}  // namespace trpc

@@ -11,6 +11,7 @@
 #include "base/logging.h"
 #include "base/time.h"
 #include "fiber/sync.h"
+#include "net/h2_frames.h"
 #include "net/hpack.h"
 #include "net/http_protocol.h"
 #include "net/server.h"
@@ -20,66 +21,9 @@ namespace trpc {
 
 namespace {
 
-constexpr char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
-constexpr size_t kPrefaceLen = 24;
-constexpr uint32_t kFrameHeaderLen = 9;
-constexpr uint32_t kMaxFrameSize = 16384;        // our advertised max
-constexpr uint32_t kDefaultWindow = 65535;
-constexpr uint32_t kRecvWindow = 1 << 20;        // what we grant peers
+using namespace h2;  // frame constants/helpers shared with h2_client.cc
+
 constexpr uint32_t kMaxConcurrentStreams = 256;  // advertised in SETTINGS
-constexpr uint32_t kRefusedStream = 0x7;         // RST_STREAM error code
-
-enum FrameType : uint8_t {
-  kData = 0x0,
-  kHeaders = 0x1,
-  kPriority = 0x2,
-  kRstStream = 0x3,
-  kSettings = 0x4,
-  kPushPromise = 0x5,
-  kPing = 0x6,
-  kGoaway = 0x7,
-  kWindowUpdate = 0x8,
-  kContinuation = 0x9,
-};
-
-enum Flags : uint8_t {
-  kEndStream = 0x1,
-  kEndHeaders = 0x4,
-  kPadded = 0x8,
-  kPriorityFlag = 0x20,
-  kAck = 0x1,
-};
-
-void put_u24(std::string* s, uint32_t v) {
-  s->push_back(static_cast<char>(v >> 16));
-  s->push_back(static_cast<char>(v >> 8));
-  s->push_back(static_cast<char>(v));
-}
-void put_u32(std::string* s, uint32_t v) {
-  s->push_back(static_cast<char>(v >> 24));
-  s->push_back(static_cast<char>(v >> 16));
-  s->push_back(static_cast<char>(v >> 8));
-  s->push_back(static_cast<char>(v));
-}
-uint32_t get_u24(const uint8_t* p) {
-  return (static_cast<uint32_t>(p[0]) << 16) |
-         (static_cast<uint32_t>(p[1]) << 8) | p[2];
-}
-uint32_t get_u31(const uint8_t* p) {
-  return ((static_cast<uint32_t>(p[0]) & 0x7f) << 24) |
-         (static_cast<uint32_t>(p[1]) << 16) |
-         (static_cast<uint32_t>(p[2]) << 8) | p[3];
-}
-
-std::string frame_header(uint32_t len, uint8_t type, uint8_t flags,
-                         uint32_t stream_id) {
-  std::string h;
-  put_u24(&h, len);
-  h.push_back(static_cast<char>(type));
-  h.push_back(static_cast<char>(flags));
-  put_u32(&h, stream_id);
-  return h;
-}
 
 // One in-progress request stream.
 struct H2Stream {
@@ -88,10 +32,12 @@ struct H2Stream {
   IOBuf body;
   bool headers_done = false;
   int32_t send_window = kDefaultWindow;  // peer's grant for our DATA
-  // Response bytes still waiting for window (flow-controlled remainder),
-  // and — for gRPC — the trailer HEADERS that may only follow the LAST
-  // DATA frame (status rides the trailers, so ordering is correctness).
-  std::string pending_data;
+  // Response bytes still waiting for window (flow-controlled remainder,
+  // an IOBuf so drains cut chunks by reference instead of memmoving a
+  // string tail), and — for gRPC — the trailer HEADERS that may only
+  // follow the LAST DATA frame (status rides the trailers, so ordering
+  // is correctness).
+  IOBuf pending_data;
   bool pending_end = false;
   std::string pending_trailers;  // pre-framed; sent once data drains
 };
@@ -140,10 +86,11 @@ void send_frames(SocketId sid, std::string&& bytes) {
 }
 
 // Writes as much of the stream's pending response DATA as the windows
-// allow.  Call with conn->mu held.
+// allow (chunks are CUT by reference, not copied).  Call with conn->mu
+// held.
 void flush_pending_locked(H2Conn* c, SocketId sid, uint32_t stream_id,
                           H2Stream* st) {
-  std::string out;
+  IOBuf out;
   while (!st->pending_data.empty() && st->send_window > 0 &&
          c->conn_send_window > 0) {
     const uint32_t chunk = std::min<uint32_t>(
@@ -151,62 +98,28 @@ void flush_pending_locked(H2Conn* c, SocketId sid, uint32_t stream_id,
          static_cast<uint32_t>(st->send_window),
          static_cast<uint32_t>(c->conn_send_window), c->peer_max_frame});
     const bool last = chunk == st->pending_data.size() && st->pending_end;
-    out += frame_header(chunk, kData, last ? kEndStream : 0, stream_id);
-    out.append(st->pending_data, 0, chunk);
-    st->pending_data.erase(0, chunk);
+    out.append(frame_header(chunk, kData, last ? kEndStream : 0,
+                            stream_id));
+    IOBuf part;
+    st->pending_data.cutn(&part, chunk);
+    out.append(std::move(part));
     st->send_window -= static_cast<int32_t>(chunk);
     c->conn_send_window -= static_cast<int32_t>(chunk);
   }
   const bool done = st->pending_data.empty();
   if (done && !st->pending_trailers.empty()) {
-    out += st->pending_trailers;  // trailers strictly after the last DATA
+    out.append(st->pending_trailers);  // trailers strictly after last DATA
     st->pending_trailers.clear();
   }
   if (!out.empty()) {
-    send_frames(sid, std::move(out));
+    SocketRef s(Socket::Address(sid));
+    if (s) {
+      s->Write(std::move(out));
+    }
   }
   if (done) {
     c->streams.erase(stream_id);
   }
-}
-
-// gRPC length-prefixed message framing (details/grpc.* parity).
-std::string grpc_frame(const std::string& msg) {
-  std::string out;
-  out.push_back(0);  // uncompressed
-  put_u32(&out, static_cast<uint32_t>(msg.size()));
-  out += msg;
-  return out;
-}
-
-bool grpc_unframe(const IOBuf& body, IOBuf* msg) {
-  if (body.size() < 5) {
-    return false;
-  }
-  uint8_t head[5];
-  body.copy_to(head, 5);
-  if (head[0] != 0) {
-    return false;  // compressed grpc messages unsupported (negotiated off)
-  }
-  const uint32_t len = (static_cast<uint32_t>(head[1]) << 24) |
-                       (static_cast<uint32_t>(head[2]) << 16) |
-                       (static_cast<uint32_t>(head[3]) << 8) | head[4];
-  if (body.size() < 5ull + len) {
-    return false;
-  }
-  IOBuf tmp = body;
-  tmp.pop_front(5);
-  tmp.cutn(msg, len);
-  return true;
-}
-
-const std::string* find_header(const HeaderList& h, const char* name) {
-  for (const auto& [k, v] : h) {
-    if (k == name) {
-      return &v;
-    }
-  }
-  return nullptr;
 }
 
 // Response writer: HEADERS (+DATA, window-limited) (+gRPC trailers).
@@ -241,7 +154,8 @@ void h2_respond(SocketId sid, uint32_t stream_id, int status,
     // Trailers carry the status and may only follow the LAST DATA frame:
     // queue them behind the (window-limited) data so a big response
     // cannot see END_STREAM before its bytes.
-    st->pending_data = std::move(payload);
+    st->pending_data.clear();
+    st->pending_data.append(payload);
     st->pending_end = false;
     HeaderList trailers = {
         {"grpc-status", std::to_string(grpc_status)},
@@ -259,7 +173,8 @@ void h2_respond(SocketId sid, uint32_t stream_id, int status,
     flush_pending_locked(c, sid, stream_id, st);
     return;
   }
-  st->pending_data = std::move(payload);
+  st->pending_data.clear();
+  st->pending_data.append(payload);
   st->pending_end = true;
   if (st->pending_data.empty()) {
     // Header-only response: END_STREAM rides the HEADERS frame.
@@ -378,11 +293,25 @@ ParseError h2_parse(IOBuf* source, InputMessage* out, Socket* sock) {
             const int32_t delta =
                 static_cast<int32_t>(val) - c->peer_initial_window;
             c->peer_initial_window = static_cast<int32_t>(val);
+            std::vector<uint32_t> stalled;
             for (auto& [sid2, st] : c->streams) {
               if (delta > 0 && st.send_window > INT32_MAX - delta) {
                 return ParseError::kCorrupted;  // RFC 9113 §6.9.2 overflow
               }
               st.send_window += delta;
+              if (delta > 0 && !st.pending_data.empty()) {
+                stalled.push_back(sid2);
+              }
+            }
+            // A raised initial window must RESUME stalled responses
+            // (RFC 9113 §6.9.2): no per-stream WINDOW_UPDATE is coming
+            // for a window that never emptied from the peer's view.
+            // flush erases completed streams — iterate collected ids.
+            for (uint32_t sid2 : stalled) {
+              auto it2 = c->streams.find(sid2);
+              if (it2 != c->streams.end()) {
+                flush_pending_locked(c, sock->id(), sid2, &it2->second);
+              }
             }
           }
         }
@@ -633,9 +562,21 @@ void h2_process_request(InputMessage&& msg) {
   const std::string* path = find_header(*headers, ":path");
   const std::string* ct = find_header(*headers, "content-type");
   if (srv != nullptr && srv->authenticator() != nullptr &&
+      !sock->auth_ok.load(std::memory_order_acquire)) {
+    // h2 clients carry no kAuth frame; the credential rides the
+    // "authorization" header instead (our h2 client sends it on every
+    // request until the connection is marked).
+    const std::string* cred = find_header(*headers, "authorization");
+    if (cred != nullptr &&
+        srv->authenticator()->verify_credential(*cred, sock->remote()) ==
+            0) {
+      sock->auth_ok.store(true, std::memory_order_release);
+    }
+  }
+  if (srv != nullptr && srv->authenticator() != nullptr &&
       !sock->auth_ok.load(std::memory_order_acquire) &&
       (path == nullptr || *path != "/health")) {
-    // Same-port auth gate as HTTP/1 (h2 clients carry no kAuth frame).
+    // Same-port auth gate as HTTP/1.
     h2_respond(msg.socket, static_cast<uint32_t>(msg.meta.stream_id), 403,
                "text/plain", "connection not authenticated\n", false, 16,
                "unauthenticated");

@@ -2,7 +2,7 @@
 // benchmark harness named in BASELINE.json).
 //
 // Usage: rpc_press <addr|list://...> <method> [qps=0(max)] [payload=1024]
-//                  [fibers=32] [seconds=5] [lb=rr]
+//                  [fibers=32] [seconds=5] [lb=rr] [protocol=tstd|h2|grpc]
 // Prints one JSON line with qps achieved, goodput and latency percentiles.
 #include <algorithm>
 #include <atomic>
@@ -66,7 +66,7 @@ int main(int argc, char** argv) {
   if (argc < 3) {
     fprintf(stderr,
             "usage: %s <addr|list://h:p,...> <method> [qps=0] [payload=1024]"
-            " [fibers=32] [seconds=5] [lb=rr]\n",
+            " [fibers=32] [seconds=5] [lb=rr] [protocol=tstd|h2|grpc]\n",
             argv[0]);
     return 1;
   }
@@ -77,10 +77,12 @@ int main(int argc, char** argv) {
   const int fibers = argc > 5 ? atoi(argv[5]) : 32;
   const int seconds = argc > 6 ? atoi(argv[6]) : 5;
   const std::string lb = argc > 7 ? argv[7] : "rr";
+  const std::string protocol = argc > 8 ? argv[8] : "tstd";
 
   ClusterChannel ch;
   ClusterChannel::Options opts;
   opts.timeout_ms = 5000;
+  opts.protocol = protocol;
   if (ch.Init(addr, lb, &opts) != 0) {
     fprintf(stderr, "cannot resolve %s\n", addr.c_str());
     return 1;

@@ -44,6 +44,57 @@ def test_jax_array_through_arena_rpc(echo_server):
     arena.close()
 
 
+def test_zero_copy_pointer_identity():
+    """The JAX buffer ITSELF must be on the wire: the IOBuf block ref's
+    data pointer equals the dlpack-imported host pointer of the array —
+    no staging copy anywhere (VERDICT r2 item 2)."""
+    import ctypes
+
+    import jax.numpy as jnp
+
+    from brpc_tpu.rpc import zerocopy
+    from brpc_tpu.rpc._lib import load_library
+
+    lib = load_library()
+    lib.trpc_iobuf_create.restype = ctypes.c_void_p
+    x = jnp.arange(8192, dtype=jnp.uint32)
+    jax_ptr = np.from_dlpack(x).ctypes.data  # the buffer JAX owns
+    req = lib.trpc_iobuf_create()
+    try:
+        n = zerocopy.append_jax(req, x, lib)
+        assert n == 8192 * 4
+        assert zerocopy.live_sends() >= 1
+        assert zerocopy.block_ptr(req, 0, lib) == jax_ptr
+    finally:
+        lib.trpc_iobuf_destroy(ctypes.c_void_p(req))
+    # Destroying the IOBuf ran the deleter: the array is unpinned.
+    for _ in range(200):
+        if zerocopy.live_sends() == 0:
+            break
+        time.sleep(0.005)
+    assert zerocopy.live_sends() == 0
+
+
+def test_zero_copy_rpc_roundtrip(echo_server):
+    """jax array → RPC echo with the staging copy gone (the wire writes
+    straight from the dlpack-imported buffer)."""
+    from brpc_tpu.rpc import zerocopy
+
+    ch = Channel(f"127.0.0.1:{echo_server.port}", timeout_ms=5000)
+    x = jnp.arange(1 << 18, dtype=jnp.uint32)  # 1MB payload
+    resp = zerocopy.call_zero_copy(ch, "Echo.Echo", x)
+    got = np.frombuffer(resp, dtype=np.uint32)
+    np.testing.assert_array_equal(got, np.asarray(x))
+    # The write fiber drops the last IOBuf reference a hair after the
+    # response lands; the keepalive registry must drain to zero.
+    for _ in range(200):
+        if zerocopy.live_sends() == 0:
+            break
+        time.sleep(0.005)
+    assert zerocopy.live_sends() == 0
+    ch.close()
+
+
 def test_arena_block_meta_and_release(echo_server):
     arena = DeviceArena(block_size=16 * 1024, blocks_per_slab=2)
     a = arena.alloc()
