@@ -14,6 +14,7 @@
 #include "net/socket_map.h"
 #include "net/span.h"
 #include "net/stream.h"
+#include "net/tls.h"
 
 namespace trpc {
 
@@ -174,6 +175,11 @@ int Channel::Init(const std::string& addr, const Options* opts) {
   if (opts_.use_shm && ct != ConnectionType::kSingle) {
     return -1;  // shm rings are inherently single-connection
   }
+  if (opts_.use_tls &&
+      (ct != ConnectionType::kSingle || opts_.use_shm ||
+       !tls_available())) {
+    return -1;  // TLS rides the single TCP connection
+  }
   if (proto_ != 0) {
     if (ct != ConnectionType::kSingle || opts_.use_shm) {
       return -1;  // h2 multiplexes one connection by design
@@ -250,6 +256,16 @@ int Channel::ensure_socket(SocketId* out) {
   sopts.fd = -1;  // lazy connect in the write fiber
   sopts.remote = ep_;
   sopts.on_readable = &messenger_on_readable;
+  if (opts_.use_tls) {
+    std::string err;
+    void* ctx = tls_client_ctx(&err);
+    if (ctx == nullptr) {
+      LOG(Warning) << "tls client init failed: " << err;
+      return -1;
+    }
+    sopts.transport = tls_transport();
+    sopts.transport_ctx_holder = tls_conn_client(ctx);
+  }
   if (Socket::Create(sopts, &sock_) != 0) {
     return -1;
   }

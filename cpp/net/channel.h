@@ -43,6 +43,11 @@ class Channel {
     // handshakes a ring segment over TCP, then calls flow through shm.
     // Falls back to TCP transparently if the handshake fails.
     bool use_shm = false;
+    // TLS to the server (net/tls.h).  Requires connection_type "single"
+    // (the TLS session rides the one multiplexed connection) and excludes
+    // use_shm.  No peer verification by default, like the reference's
+    // default ChannelSSLOptions.
+    bool use_tls = false;
   };
 
   ~Channel();  // fails the pooled socket so its resources (fd / shm

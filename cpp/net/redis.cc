@@ -1,0 +1,730 @@
+#include "net/redis.h"
+
+#include <errno.h>
+
+#include <cstring>
+
+#include <algorithm>
+#include <atomic>
+#include <deque>
+#include <memory>
+#include <mutex>
+
+#include "base/logging.h"
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "net/messenger.h"
+#include "net/protocol.h"
+#include "net/server.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr size_t kMaxBulk = 64ull << 20;   // bound one bulk string
+constexpr size_t kMaxElements = 1 << 20;   // bound one array
+constexpr int kMaxDepth = 8;               // bound reply nesting
+constexpr size_t kMaxLine = 64 * 1024;     // bound one status/error line
+
+// The parsers are templated over a byte source so the wire paths scan
+// the socket's IOBuf IN PLACE (no per-wakeup flatten — a trickled 64MB
+// bulk must not memcpy the whole accumulation on every readable edge)
+// while the public std::string entry points (tests, fuzzer) share the
+// exact same logic.
+
+struct StringSrc {
+  const std::string& s;
+  size_t size() const { return s.size(); }
+  // Copies up to n bytes at pos into dst; returns bytes copied.
+  size_t copy(size_t pos, size_t n, char* dst) const {
+    if (pos >= s.size()) {
+      return 0;
+    }
+    const size_t take = std::min(n, s.size() - pos);
+    memcpy(dst, s.data() + pos, take);
+    return take;
+  }
+  void extract(size_t pos, size_t n, std::string* out) const {
+    out->assign(s, pos, n);
+  }
+};
+
+struct IOBufSrc {
+  const IOBuf* b;
+  size_t size() const { return b->size(); }
+  size_t copy(size_t pos, size_t n, char* dst) const {
+    return b->copy_to(dst, n, pos);
+  }
+  void extract(size_t pos, size_t n, std::string* out) const {
+    out->resize(n);
+    b->copy_to(out->data(), n, pos);
+  }
+};
+
+// Finds "\r\n" starting at `from`, scanning at most `max_scan` bytes of
+// available data, in bounded chunks (one byte of overlap catches a CRLF
+// spanning a chunk edge).  Returns the \r offset, SIZE_MAX when not
+// found within the available bytes, SIZE_MAX - 1 when the scan limit was
+// exhausted (malformed: the line is too long).
+template <class Src>
+size_t find_crlf(const Src& src, size_t from, size_t max_scan) {
+  char buf[4096];
+  const size_t end = std::min(src.size(), from + max_scan);
+  size_t pos = from;
+  while (pos < end) {
+    const size_t want = std::min(sizeof(buf), end - pos + 1);
+    const size_t got = src.copy(pos, want, buf);
+    if (got < 2) {
+      break;
+    }
+    for (size_t i = 0; i + 1 < got; ++i) {
+      if (buf[i] == '\r' && buf[i + 1] == '\n') {
+        return pos + i;
+      }
+    }
+    pos += got - 1;  // overlap one byte
+    if (pos + 1 >= end && end < src.size()) {
+      return SIZE_MAX - 1;  // scanned the full budget without a CRLF
+    }
+    if (got < want) {
+      break;
+    }
+  }
+  return from + max_scan <= src.size() ? SIZE_MAX - 1 : SIZE_MAX;
+}
+
+// Reads "<digits>\r\n" (optionally signed) at *pos.  1 ok / 0 partial /
+// -1 malformed.
+template <class Src>
+int parse_int_line(const Src& data, size_t* pos, int64_t* out) {
+  char buf[36];
+  const size_t got = data.copy(*pos, sizeof(buf), buf);
+  size_t nl = SIZE_MAX;
+  for (size_t i = 0; i + 1 < got; ++i) {
+    if (buf[i] == '\r' && buf[i + 1] == '\n') {
+      nl = i;
+      break;
+    }
+  }
+  if (nl == SIZE_MAX) {
+    return got >= 34 ? -1 : 0;  // int lines are short
+  }
+  if (nl == 0) {
+    return -1;
+  }
+  size_t i = 0;
+  bool neg = false;
+  if (buf[0] == '-') {
+    neg = true;
+    ++i;
+  }
+  if (i == nl) {
+    return -1;
+  }
+  int64_t v = 0;
+  for (; i < nl; ++i) {
+    if (buf[i] < '0' || buf[i] > '9') {
+      return -1;
+    }
+    const int d = buf[i] - '0';
+    if (v > (INT64_MAX - d) / 10) {
+      return -1;  // would overflow (checked BEFORE multiplying: UB-free)
+    }
+    v = v * 10 + d;
+  }
+  *out = neg ? -v : v;
+  *pos += nl + 2;
+  return 1;
+}
+
+}  // namespace
+
+void RedisReply::serialize(std::string* out) const {
+  switch (type) {
+    case kNil:
+      out->append("$-1\r\n");
+      break;
+    case kStatus:
+      out->push_back('+');
+      out->append(str);
+      out->append("\r\n");
+      break;
+    case kError:
+      out->push_back('-');
+      out->append(str);
+      out->append("\r\n");
+      break;
+    case kInteger:
+      out->push_back(':');
+      out->append(std::to_string(integer));
+      out->append("\r\n");
+      break;
+    case kString:
+      out->push_back('$');
+      out->append(std::to_string(str.size()));
+      out->append("\r\n");
+      out->append(str);
+      out->append("\r\n");
+      break;
+    case kArray:
+      out->push_back('*');
+      out->append(std::to_string(elements.size()));
+      out->append("\r\n");
+      for (const RedisReply& e : elements) {
+        e.serialize(out);
+      }
+      break;
+  }
+}
+
+namespace {
+
+template <class Src>
+char marker_at(const Src& data, size_t pos) {
+  char c = 0;
+  data.copy(pos, 1, &c);
+  return c;
+}
+
+// Verifies the two bytes at `pos` are CRLF.  1 ok / 0 partial / -1 bad.
+template <class Src>
+int check_crlf(const Src& data, size_t pos) {
+  char crlf[2];
+  if (data.copy(pos, 2, crlf) < 2) {
+    return 0;
+  }
+  return crlf[0] == '\r' && crlf[1] == '\n' ? 1 : -1;
+}
+
+template <class Src>
+int parse_reply_t(const Src& data, size_t* pos, RedisReply* out,
+                  int depth) {
+  if (depth > kMaxDepth) {
+    return -1;
+  }
+  if (*pos >= data.size()) {
+    return 0;
+  }
+  const char marker = marker_at(data, *pos);
+  size_t p = *pos + 1;
+  switch (marker) {
+    case '+':
+    case '-': {
+      const size_t nl = find_crlf(data, p, kMaxLine);
+      if (nl == SIZE_MAX) {
+        return 0;
+      }
+      if (nl == SIZE_MAX - 1) {
+        return -1;  // line exceeds the scan budget
+      }
+      out->type = marker == '+' ? RedisReply::kStatus : RedisReply::kError;
+      data.extract(p, nl - p, &out->str);
+      *pos = nl + 2;
+      return 1;
+    }
+    case ':': {
+      int64_t v = 0;
+      const int rc = parse_int_line(data, &p, &v);
+      if (rc != 1) {
+        return rc;
+      }
+      out->type = RedisReply::kInteger;
+      out->integer = v;
+      *pos = p;
+      return 1;
+    }
+    case '$': {
+      int64_t len = 0;
+      const int rc = parse_int_line(data, &p, &len);
+      if (rc != 1) {
+        return rc;
+      }
+      if (len < 0) {
+        out->type = RedisReply::kNil;  // null bulk
+        *pos = p;
+        return 1;
+      }
+      if (static_cast<size_t>(len) > kMaxBulk) {
+        return -1;
+      }
+      if (data.size() - p < static_cast<size_t>(len) + 2) {
+        return 0;
+      }
+      const int crc = check_crlf(data, p + len);
+      if (crc != 1) {
+        return crc;
+      }
+      out->type = RedisReply::kString;
+      data.extract(p, len, &out->str);
+      *pos = p + len + 2;
+      return 1;
+    }
+    case '*': {
+      int64_t n = 0;
+      const int rc = parse_int_line(data, &p, &n);
+      if (rc != 1) {
+        return rc;
+      }
+      if (n < 0) {
+        out->type = RedisReply::kNil;  // null array
+        *pos = p;
+        return 1;
+      }
+      if (static_cast<size_t>(n) > kMaxElements) {
+        return -1;
+      }
+      out->type = RedisReply::kArray;
+      out->elements.clear();
+      out->elements.reserve(std::min<size_t>(n, 1024));
+      for (int64_t i = 0; i < n; ++i) {
+        RedisReply e;
+        const int erc = parse_reply_t(data, &p, &e, depth + 1);
+        if (erc != 1) {
+          return erc;
+        }
+        out->elements.push_back(std::move(e));
+      }
+      *pos = p;
+      return 1;
+    }
+    default:
+      return -1;
+  }
+}
+
+template <class Src>
+int parse_command_t(const Src& data, size_t* pos,
+                    std::vector<std::string>* args) {
+  if (*pos >= data.size()) {
+    return 0;
+  }
+  if (marker_at(data, *pos) != '*') {
+    return -1;  // inline commands unsupported (real clients send arrays)
+  }
+  size_t p = *pos + 1;
+  int64_t n = 0;
+  int rc = parse_int_line(data, &p, &n);
+  if (rc != 1) {
+    return rc;
+  }
+  if (n <= 0 || static_cast<size_t>(n) > kMaxElements) {
+    return -1;
+  }
+  args->clear();
+  args->reserve(std::min<size_t>(n, 64));
+  for (int64_t i = 0; i < n; ++i) {
+    if (p >= data.size()) {
+      return 0;
+    }
+    if (marker_at(data, p) != '$') {
+      return -1;  // commands are arrays of BULK strings only
+    }
+    ++p;
+    int64_t len = 0;
+    rc = parse_int_line(data, &p, &len);
+    if (rc != 1) {
+      return rc;
+    }
+    if (len < 0 || static_cast<size_t>(len) > kMaxBulk) {
+      return -1;
+    }
+    if (data.size() - p < static_cast<size_t>(len) + 2) {
+      return 0;
+    }
+    const int crc = check_crlf(data, p + len);
+    if (crc != 1) {
+      return crc;
+    }
+    std::string arg;
+    data.extract(p, len, &arg);
+    args->push_back(std::move(arg));
+    p += len + 2;
+  }
+  *pos = p;
+  return 1;
+}
+
+}  // namespace
+
+int resp_parse_reply(const std::string& data, size_t* pos, RedisReply* out,
+                     int depth) {
+  return parse_reply_t(StringSrc{data}, pos, out, depth);
+}
+
+int resp_parse_command(const std::string& data, size_t* pos,
+                       std::vector<std::string>* args) {
+  return parse_command_t(StringSrc{data}, pos, args);
+}
+
+void resp_pack_command(const std::vector<std::string>& args,
+                       std::string* out) {
+  out->push_back('*');
+  out->append(std::to_string(args.size()));
+  out->append("\r\n");
+  for (const std::string& a : args) {
+    out->push_back('$');
+    out->append(std::to_string(a.size()));
+    out->append("\r\n");
+    out->append(a);
+    out->append("\r\n");
+  }
+}
+
+// ---- service registry ----------------------------------------------------
+
+bool RedisService::AddCommandHandler(const std::string& name,
+                                     CommandHandler handler) {
+  std::string lower = name;
+  std::transform(lower.begin(), lower.end(), lower.begin(), ::tolower);
+  return handlers_.emplace(std::move(lower), std::move(handler)).second;
+}
+
+const RedisService::CommandHandler* RedisService::FindCommandHandler(
+    const std::string& lower) const {
+  auto it = handlers_.find(lower);
+  return it == handlers_.end() ? nullptr : &it->second;
+}
+
+// ---- server protocol -----------------------------------------------------
+
+namespace {
+
+ParseError redis_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  // Claim only connections to a redis-enabled server, and only when the
+  // bytes look like a command array ('*' is unambiguous among our
+  // protocols: tstd opens "TRP1", HTTP with a method, h2 with "PRI").
+  Server* srv = static_cast<Server*>(sock->user_data);
+  if (sock->pinned_protocol < 0) {
+    if (srv == nullptr || srv->redis_service() == nullptr ||
+        source->front() != '*') {
+      return ParseError::kTryOtherProtocol;
+    }
+  }
+  size_t pos = 0;
+  auto args = std::make_shared<std::vector<std::string>>();
+  const int rc = parse_command_t(IOBufSrc{source}, &pos, args.get());
+  if (rc == 0) {
+    return ParseError::kNotEnoughData;
+  }
+  if (rc < 0) {
+    return ParseError::kCorrupted;
+  }
+  source->pop_front(pos);
+  out->meta.type = RpcMeta::kRequest;
+  out->ctx = std::move(args);
+  out->socket = sock->id();
+  return ParseError::kOk;
+}
+
+void redis_respond(Socket* sock, const RedisReply& reply,
+                   bool close_after = false) {
+  std::string wire;
+  reply.serialize(&wire);
+  IOBuf out;
+  out.append(wire);
+  sock->Write(std::move(out), close_after);
+}
+
+// Runs INLINE in the read fiber (process_in_order): commands on one
+// connection execute strictly in arrival order, like redis-server.
+void redis_process_request(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  Server* srv = static_cast<Server*>(sock->user_data);
+  auto args = std::static_pointer_cast<std::vector<std::string>>(msg.ctx);
+  if (srv == nullptr || args == nullptr || args->empty()) {
+    return;
+  }
+  std::string cmd = (*args)[0];
+  std::transform(cmd.begin(), cmd.end(), cmd.begin(), ::tolower);
+
+  // Connection auth: redis's own AUTH command maps onto the server's
+  // authenticator (parity with the kAuth frame / authorization header).
+  if (srv->authenticator() != nullptr) {
+    if (cmd == "auth") {
+      if (args->size() >= 2 &&
+          srv->authenticator()->verify_credential(
+              args->back(), sock->remote()) == 0) {
+        sock->auth_ok.store(true, std::memory_order_release);
+        redis_respond(sock.get(), RedisReply::Status("OK"));
+      } else {
+        redis_respond(sock.get(),
+                      RedisReply::Error("ERR invalid password"));
+      }
+      return;
+    }
+    if (!sock->auth_ok.load(std::memory_order_acquire) && cmd != "ping" &&
+        cmd != "quit") {
+      redis_respond(sock.get(),
+                    RedisReply::Error("NOAUTH Authentication required."));
+      return;
+    }
+  }
+
+  // Interceptor gate (same body as every other serving protocol).
+  {
+    int ec = 0;
+    std::string et;
+    if (cmd != "ping" && !srv->accept_request(cmd, sock->remote(), &ec, &et)) {
+      redis_respond(sock.get(), RedisReply::Error(
+                                    "ERR " + std::to_string(ec) + ": " + et));
+      return;
+    }
+  }
+
+  const RedisService::CommandHandler* handler =
+      srv->redis_service()->FindCommandHandler(cmd);
+  if (handler != nullptr) {
+    redis_respond(sock.get(), (*handler)(*args));
+    srv->requests_served.fetch_add(1, std::memory_order_relaxed);
+    return;
+  }
+  // Builtin fallbacks a stock redis client expects during handshake.
+  if (cmd == "ping") {
+    redis_respond(sock.get(), args->size() > 1
+                                  ? RedisReply::Bulk((*args)[1])
+                                  : RedisReply::Status("PONG"));
+  } else if (cmd == "echo" && args->size() > 1) {
+    redis_respond(sock.get(), RedisReply::Bulk((*args)[1]));
+  } else if (cmd == "quit") {
+    redis_respond(sock.get(), RedisReply::Status("OK"),
+                  /*close_after=*/true);
+  } else if (cmd == "select") {
+    redis_respond(sock.get(), RedisReply::Status("OK"));
+  } else if (cmd == "command") {
+    redis_respond(sock.get(), RedisReply::Array({}));
+  } else {
+    redis_respond(sock.get(),
+                  RedisReply::Error("ERR unknown command '" + cmd + "'"));
+  }
+  srv->requests_served.fetch_add(1, std::memory_order_relaxed);
+}
+
+void redis_process_response(InputMessage&&) {
+  // Server protocol entry: the client speaks through "redisc" below.
+}
+
+}  // namespace
+
+void register_redis_protocol() {
+  static int once = [] {
+    Protocol p = {"redis", redis_parse, redis_process_request,
+                  redis_process_response,
+                  /*process_in_order=*/true};
+    return register_protocol(p);
+  }();
+  (void)once;
+}
+
+// ---- client --------------------------------------------------------------
+
+namespace {
+
+// A pipelined call waiting for its FIFO slot's reply.  Abandoned waiters
+// (timeouts) stay in the queue so later replies keep their alignment —
+// the reply simply lands in a slot nobody reads.
+struct RedisWaiter {
+  CountdownEvent ev{1};
+  RedisReply reply;
+};
+
+struct RedisCliConn {
+  std::mutex mu;  // queue order must match wire order
+  std::deque<std::shared_ptr<RedisWaiter>> pending;
+};
+
+const char kRedisCliTag = 0;
+
+RedisCliConn* cli_conn_of(Socket* s) {
+  if (s->parse_state == nullptr || s->parse_state_owner != &kRedisCliTag) {
+    s->parse_state = std::make_shared<RedisCliConn>();
+    s->parse_state_owner = &kRedisCliTag;
+  }
+  return static_cast<RedisCliConn*>(s->parse_state.get());
+}
+
+ParseError redisc_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  if (sock->pinned_protocol < 0) {
+    // Client sockets are PRE-pinned by RedisClient; an unpinned socket in
+    // the probing loop belongs to some other protocol — a registered
+    // redis client must never hijack (or corrupt-kill) server-side
+    // probing in the same process.
+    return ParseError::kTryOtherProtocol;
+  }
+  size_t pos = 0;
+  auto reply = std::make_shared<RedisReply>();
+  const int rc = parse_reply_t(IOBufSrc{source}, &pos, reply.get(), 0);
+  if (rc == 0) {
+    return ParseError::kNotEnoughData;
+  }
+  if (rc < 0) {
+    return ParseError::kCorrupted;
+  }
+  source->pop_front(pos);
+  out->meta.type = RpcMeta::kResponse;
+  out->ctx = std::move(reply);
+  out->socket = sock->id();
+  return ParseError::kOk;
+}
+
+// Inline in the read fiber (process_in_order): pops the FIFO waiter.
+void redisc_process_response(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  auto reply = std::static_pointer_cast<RedisReply>(msg.ctx);
+  RedisCliConn* c = cli_conn_of(sock.get());
+  std::shared_ptr<RedisWaiter> w;
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    if (c->pending.empty()) {
+      return;  // unsolicited reply: drop
+    }
+    w = std::move(c->pending.front());
+    c->pending.pop_front();
+  }
+  w->reply = std::move(*reply);
+  w->ev.signal();
+}
+
+void redisc_process_request(InputMessage&&) {}
+
+int redisc_protocol_index() {
+  static const int index = [] {
+    Protocol p = {"redisc", redisc_parse, redisc_process_request,
+                  redisc_process_response,
+                  /*process_in_order=*/true};
+    return register_protocol(p);
+  }();
+  return index;
+}
+
+RedisReply client_error(const std::string& text) {
+  return RedisReply::Error("(client) " + text);
+}
+
+}  // namespace
+
+RedisClient::~RedisClient() {
+  SocketRef s(Socket::Address(sock_));
+  if (s) {
+    s->SetFailed(ESHUTDOWN);
+  }
+}
+
+int RedisClient::Init(const std::string& addr, const Options* opts) {
+  fiber_init(0);
+  if (opts != nullptr) {
+    opts_ = *opts;
+  }
+  redisc_protocol_index();
+  return hostname2endpoint(addr.c_str(), &ep_);
+}
+
+int RedisClient::ensure_socket(SocketId* out) {
+  LockGuard<FiberMutex> g(sock_mu_);
+  Socket* s = Socket::Address(sock_);
+  if (s != nullptr) {
+    if (!s->Failed()) {
+      *out = sock_;
+      s->Dereference();
+      return 0;
+    }
+    s->Dereference();
+  }
+  Socket::Options sopts;
+  sopts.fd = -1;  // lazy connect in the write fiber
+  sopts.remote = ep_;
+  sopts.on_readable = &messenger_on_readable;
+  if (Socket::Create(sopts, &sock_) != 0) {
+    return -1;
+  }
+  SocketRef fresh(Socket::Address(sock_));
+  if (!fresh) {
+    return -1;
+  }
+  fresh->pinned_protocol = redisc_protocol_index();
+  cli_conn_of(fresh.get());  // install state while single-threaded
+  if (!opts_.password.empty()) {
+    // AUTH rides the FIFO like any command; its waiter keeps alignment.
+    RedisCliConn* c = cli_conn_of(fresh.get());
+    std::string wire;
+    resp_pack_command({"AUTH", opts_.password}, &wire);
+    auto w = std::make_shared<RedisWaiter>();
+    std::lock_guard<std::mutex> cg(c->mu);
+    c->pending.push_back(w);
+    IOBuf frame;
+    frame.append(wire);
+    if (fresh->Write(std::move(frame)) != 0) {
+      return -1;
+    }
+  }
+  *out = sock_;
+  return 0;
+}
+
+std::vector<RedisReply> RedisClient::pipeline(
+    const std::vector<std::vector<std::string>>& cmds) {
+  std::vector<RedisReply> replies(cmds.size());
+  SocketId sid = 0;
+  if (ensure_socket(&sid) != 0) {
+    std::fill(replies.begin(), replies.end(),
+              client_error("cannot reach " + endpoint2str(ep_)));
+    return replies;
+  }
+  SocketRef s(Socket::Address(sid));
+  if (!s) {
+    std::fill(replies.begin(), replies.end(),
+              client_error("connection failed"));
+    return replies;
+  }
+  RedisCliConn* c = cli_conn_of(s.get());
+  std::string wire;
+  std::vector<std::shared_ptr<RedisWaiter>> waiters;
+  waiters.reserve(cmds.size());
+  for (const auto& cmd : cmds) {
+    resp_pack_command(cmd, &wire);
+    waiters.push_back(std::make_shared<RedisWaiter>());
+  }
+  {
+    // Queue order must equal wire order: both happen under one lock.
+    std::lock_guard<std::mutex> g(c->mu);
+    for (auto& w : waiters) {
+      c->pending.push_back(w);
+    }
+    IOBuf frame;
+    frame.append(wire);
+    if (s->Write(std::move(frame)) != 0) {
+      for (size_t i = 0; i < waiters.size(); ++i) {
+        replies[i] = client_error("write failed");
+      }
+      return replies;
+    }
+  }
+  const int64_t deadline =
+      monotonic_time_us() + opts_.timeout_ms * 1000;
+  for (size_t i = 0; i < waiters.size(); ++i) {
+    if (waiters[i]->ev.wait(deadline) == 0) {
+      replies[i] = std::move(waiters[i]->reply);
+    } else {
+      replies[i] = client_error("timeout");
+    }
+  }
+  return replies;
+}
+
+RedisReply RedisClient::execute(const std::vector<std::string>& args) {
+  std::vector<RedisReply> r = pipeline({args});
+  return r.empty() ? client_error("empty pipeline") : std::move(r[0]);
+}
+
+}  // namespace trpc

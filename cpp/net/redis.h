@@ -1,0 +1,159 @@
+// Redis (RESP) protocol — server AND client, with pipelining.
+//
+// Parity: the reference speaks redis both ways
+// (/root/reference/src/brpc/redis.h:194 RedisService lets a user build a
+// redis-speaking server; policy/redis_protocol.cpp parses commands;
+// redis_command.cpp packs them; socket.h:392 pipelined_count correlates
+// in-flight requests FIFO).  Condensed tpu-native form: RedisReply is a
+// plain value type (no arena), the service registers std::function
+// handlers like Server::RegisterMethod, and the client correlates
+// pipelined replies through a FIFO waiter queue on the connection —
+// the pipelining substrate SURVEY §5 names for long-context streams.
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "base/endpoint.h"
+#include "base/iobuf.h"
+#include "fiber/sync.h"
+#include "net/socket.h"
+
+namespace trpc {
+
+class Server;
+
+// One RESP value (request args arrive as flat string vectors instead).
+struct RedisReply {
+  enum Type : uint8_t {
+    kNil = 0,      // $-1\r\n (null bulk) or *-1\r\n (null array)
+    kStatus = 1,   // +OK\r\n
+    kError = 2,    // -ERR ...\r\n
+    kInteger = 3,  // :42\r\n
+    kString = 4,   // $3\r\nfoo\r\n (bulk)
+    kArray = 5,    // *N\r\n followed by N replies
+  };
+  Type type = kNil;
+  int64_t integer = 0;
+  std::string str;  // status / error text / bulk payload
+  std::vector<RedisReply> elements;
+
+  static RedisReply Status(std::string s) {
+    RedisReply r;
+    r.type = kStatus;
+    r.str = std::move(s);
+    return r;
+  }
+  static RedisReply Error(std::string s) {
+    RedisReply r;
+    r.type = kError;
+    r.str = std::move(s);
+    return r;
+  }
+  static RedisReply Integer(int64_t v) {
+    RedisReply r;
+    r.type = kInteger;
+    r.integer = v;
+    return r;
+  }
+  static RedisReply Bulk(std::string s) {
+    RedisReply r;
+    r.type = kString;
+    r.str = std::move(s);
+    return r;
+  }
+  static RedisReply Nil() { return RedisReply(); }
+  static RedisReply Array(std::vector<RedisReply> el) {
+    RedisReply r;
+    r.type = kArray;
+    r.elements = std::move(el);
+    return r;
+  }
+
+  bool is_error() const { return type == kError; }
+  // RESP serialization (both directions use the same encoding).
+  void serialize(std::string* out) const;
+};
+
+// ---- codec (exposed for tests + the fuzzer) ------------------------------
+
+// Parses one complete reply starting at (*data)[*pos].  Returns 1 and
+// advances *pos past it on success, 0 when more bytes are needed, -1 on
+// malformed input.  Depth/size-bounded.
+int resp_parse_reply(const std::string& data, size_t* pos, RedisReply* out,
+                     int depth = 0);
+
+// Parses one complete command — a RESP array of bulk strings, the only
+// form real clients send.  Same return convention.
+int resp_parse_command(const std::string& data, size_t* pos,
+                       std::vector<std::string>* args);
+
+// Packs a command in the array-of-bulk-strings form clients send.
+void resp_pack_command(const std::vector<std::string>& args,
+                       std::string* out);
+
+// ---- server side ---------------------------------------------------------
+
+// Container of command handlers; assign to Server::set_redis_service to
+// make the server speak redis on its port (alongside tstd/HTTP/h2 —
+// protocol probing routes by the leading '*').  Handlers run inline in
+// the read fiber, strictly in per-connection arrival order, exactly like
+// redis-server (redis.h:246 Run() ordering contract).
+class RedisService {
+ public:
+  // args[0] is the command name (matched case-insensitively).
+  using CommandHandler =
+      std::function<RedisReply(const std::vector<std::string>& args)>;
+
+  // Registers `handler` for command `name`.  False if already present.
+  bool AddCommandHandler(const std::string& name, CommandHandler handler);
+  const CommandHandler* FindCommandHandler(const std::string& lower) const;
+
+ private:
+  std::map<std::string, CommandHandler> handlers_;
+};
+
+// Registers the redis server protocol with the registry (idempotent);
+// Server::Start calls it when a redis_service is installed.
+void register_redis_protocol();
+
+// ---- client side ---------------------------------------------------------
+
+// Redis client over the runtime's socket layer with FIFO pipelining:
+// execute() is one round trip; pipeline() writes N commands in one batch
+// and collects the N replies in order (socket.h:392 pipelined_count
+// parity — correlation is arrival order, there are no ids on the wire).
+class RedisClient {
+ public:
+  struct Options {
+    int64_t timeout_ms = 1000;
+    // AUTH command sent on fresh connections ("" = none).
+    std::string password;
+  };
+
+  ~RedisClient();
+  int Init(const std::string& addr, const Options* opts = nullptr);
+
+  // One command, one reply.  Error replies come back as kError (not a
+  // transport failure); transport/timeout failures return kError with
+  // str "(client) ...".
+  RedisReply execute(const std::vector<std::string>& args);
+
+  // Pipelines all commands in one write; replies arrive in order.
+  std::vector<RedisReply> pipeline(
+      const std::vector<std::vector<std::string>>& cmds);
+
+ private:
+  int ensure_socket(SocketId* out);
+
+  EndPoint ep_;
+  Options opts_;
+  FiberMutex sock_mu_;
+  SocketId sock_ = 0;
+};
+
+}  // namespace trpc

@@ -19,6 +19,8 @@
 #include "fiber/fiber.h"
 #include "net/h2_protocol.h"
 #include "net/http_protocol.h"
+#include "net/redis.h"
+#include "net/tls.h"
 #include "net/messenger.h"
 #include "net/shm_transport.h"
 #include "net/span.h"
@@ -204,6 +206,9 @@ int Server::Start(int port) {
   tstd_protocol();  // ensure registered (first: most traffic is RPC)
   register_http_protocol();
   register_h2_protocol();
+  if (redis_service_ != nullptr) {
+    register_redis_protocol();
+  }
   start_time_us_ = monotonic_time_us();
   // Shared-memory transport handshake (net/shm_transport.h): a client sends
   // the segment name it created; we map it and serve that connection over
@@ -339,6 +344,11 @@ void Server::on_acceptable(SocketId id, void* ctx) {
     opts.remote.port = ntohs(peer_sa.sin_port);
     opts.on_readable = &messenger_on_readable;
     opts.user_data = srv;
+    if (srv->tls_ctx_ != nullptr) {
+      // First-byte sniff decides TLS vs plaintext per connection.
+      opts.transport = tls_transport();
+      opts.transport_ctx_holder = tls_conn_server(srv->tls_ctx_);
+    }
     SocketId conn_id = 0;
     if (Socket::Create(opts, &conn_id) != 0) {
       close(fd);
@@ -347,6 +357,17 @@ void Server::on_acceptable(SocketId id, void* ctx) {
     srv->track_connection(conn_id);
   }
   listener->Dereference();
+}
+
+int Server::EnableTls(const std::string& cert_file,
+                      const std::string& key_file) {
+  std::string err;
+  tls_ctx_ = tls_server_ctx(cert_file, key_file, &err);
+  if (tls_ctx_ == nullptr) {
+    LOG(Warning) << "EnableTls failed: " << err;
+    return -1;
+  }
+  return 0;
 }
 
 int Server::EnableDump(const std::string& path, double sample_rate) {

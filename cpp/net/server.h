@@ -26,6 +26,8 @@
 
 namespace trpc {
 
+class RedisService;  // net/redis.h
+
 class Server {
  public:
   // Handler runs in a fiber; it may block on fiber primitives freely.
@@ -63,6 +65,20 @@ class Server {
       std::string* error_text)>;
   void set_interceptor(Interceptor icpt) { interceptor_ = std::move(icpt); }
   const Interceptor& interceptor() const { return interceptor_; }
+
+  // Makes this server speak redis (RESP) on its port alongside the other
+  // protocols (net/redis.h; parity: ServerOptions::redis_service,
+  // redis.h:194).  Not owned.  Call before Start.
+  void set_redis_service(RedisService* rs) { redis_service_ = rs; }
+  RedisService* redis_service() const { return redis_service_; }
+
+  // Serves TLS on this server's port (net/tls.h; parity: ServerOptions::
+  // mutable_ssl_options, details/ssl_helper.cpp).  Plaintext clients KEEP
+  // working on the same port — each accepted connection sniffs its first
+  // byte (0x16 = TLS handshake record) and picks the path, like the
+  // reference's sniffing acceptor.  PEM cert + key.  Call before Start;
+  // returns 0 on success.
+  int EnableTls(const std::string& cert_file, const std::string& key_file);
   // Shared acceptance check (one body for all protocols).  True = admit;
   // false fills *error_code/*error_text.
   bool accept_request(const std::string& method, const EndPoint& peer,
@@ -130,6 +146,8 @@ class Server {
 
   const Authenticator* auth_ = nullptr;
   Interceptor interceptor_;
+  RedisService* redis_service_ = nullptr;
+  void* tls_ctx_ = nullptr;  // SSL_CTX (leaked singleton; net/tls.h)
   FlatMap<std::string, MethodProperty> methods_;
   // (pattern segments, trailing-wildcard, method name), longest first.
   struct RestfulRule {

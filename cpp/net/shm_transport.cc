@@ -308,6 +308,7 @@ class ShmRingTransport final : public Transport {
   }
 
   int connect(Socket*) override { return 0; }  // established at handshake
+  bool fd_based() const override { return false; }
   const char* name() const override { return "shm_ring"; }
 };
 

@@ -59,7 +59,8 @@ void Socket::reset_for_reuse(const Options& opts) {
   transport_ctx = transport_ctx_holder_.get();
   failed_.store(false, std::memory_order_relaxed);
   // fd-less transports (shm/ICI) are born connected.
-  connected_.store(opts.fd >= 0 || opts.transport != nullptr,
+  connected_.store(opts.fd >= 0 ||
+                       (opts.transport != nullptr && !opts.transport->fd_based()),
                    std::memory_order_relaxed);
   nevent_.store(0, std::memory_order_relaxed);
   on_readable_ = opts.on_readable;

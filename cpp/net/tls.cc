@@ -1,0 +1,414 @@
+#include "net/tls.h"
+
+#include <dlfcn.h>
+#include <errno.h>
+#include <sys/socket.h>
+
+#include <cstdint>
+#include <mutex>
+
+#include "base/logging.h"
+#include "net/socket.h"
+
+namespace trpc {
+
+namespace {
+
+// ---- minimal libssl ABI (OpenSSL 3; headers absent from the image) -------
+
+using SSL = void;
+using SSL_CTX = void;
+using SSL_METHOD = void;
+
+constexpr int kSslErrorWantRead = 2;
+constexpr int kSslErrorWantWrite = 3;
+constexpr int kSslErrorZeroReturn = 6;
+constexpr int kSslFiletypePem = 1;
+
+struct SslApi {
+  const SSL_METHOD* (*TLS_method)();
+  SSL_CTX* (*SSL_CTX_new)(const SSL_METHOD*);
+  void (*SSL_CTX_free)(SSL_CTX*);
+  int (*SSL_CTX_use_certificate_chain_file)(SSL_CTX*, const char*);
+  int (*SSL_CTX_use_PrivateKey_file)(SSL_CTX*, const char*, int);
+  int (*SSL_CTX_check_private_key)(const SSL_CTX*);
+  SSL* (*SSL_new)(SSL_CTX*);
+  void (*SSL_free)(SSL*);
+  int (*SSL_set_fd)(SSL*, int);
+  void (*SSL_set_accept_state)(SSL*);
+  void (*SSL_set_connect_state)(SSL*);
+  int (*SSL_do_handshake)(SSL*);
+  int (*SSL_read)(SSL*, void*, int);
+  int (*SSL_write)(SSL*, const void*, int);
+  int (*SSL_get_error)(const SSL*, int);
+  int (*SSL_shutdown)(SSL*);
+  unsigned long (*ERR_get_error)();
+  void (*ERR_error_string_n)(unsigned long, char*, size_t);
+  void (*ERR_clear_error)();
+
+  bool ok = false;
+};
+
+const SslApi& api() {
+  static SslApi a = [] {
+    SslApi s = {};
+    void* ssl = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (ssl == nullptr) {
+      ssl = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
+    }
+    // ERR_* live in libcrypto; RTLD_GLOBAL above lets one handle serve,
+    // but resolve via an explicit handle as well for robustness.
+    void* crypto = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (ssl == nullptr) {
+      return s;
+    }
+    auto sym = [&](const char* name) -> void* {
+      void* p = dlsym(ssl, name);
+      if (p == nullptr && crypto != nullptr) {
+        p = dlsym(crypto, name);
+      }
+      return p;
+    };
+    s.TLS_method =
+        reinterpret_cast<const SSL_METHOD* (*)()>(sym("TLS_method"));
+    s.SSL_CTX_new =
+        reinterpret_cast<SSL_CTX* (*)(const SSL_METHOD*)>(sym("SSL_CTX_new"));
+    s.SSL_CTX_free =
+        reinterpret_cast<void (*)(SSL_CTX*)>(sym("SSL_CTX_free"));
+    s.SSL_CTX_use_certificate_chain_file =
+        reinterpret_cast<int (*)(SSL_CTX*, const char*)>(
+            sym("SSL_CTX_use_certificate_chain_file"));
+    s.SSL_CTX_use_PrivateKey_file =
+        reinterpret_cast<int (*)(SSL_CTX*, const char*, int)>(
+            sym("SSL_CTX_use_PrivateKey_file"));
+    s.SSL_CTX_check_private_key = reinterpret_cast<int (*)(const SSL_CTX*)>(
+        sym("SSL_CTX_check_private_key"));
+    s.SSL_new = reinterpret_cast<SSL* (*)(SSL_CTX*)>(sym("SSL_new"));
+    s.SSL_free = reinterpret_cast<void (*)(SSL*)>(sym("SSL_free"));
+    s.SSL_set_fd = reinterpret_cast<int (*)(SSL*, int)>(sym("SSL_set_fd"));
+    s.SSL_set_accept_state =
+        reinterpret_cast<void (*)(SSL*)>(sym("SSL_set_accept_state"));
+    s.SSL_set_connect_state =
+        reinterpret_cast<void (*)(SSL*)>(sym("SSL_set_connect_state"));
+    s.SSL_do_handshake =
+        reinterpret_cast<int (*)(SSL*)>(sym("SSL_do_handshake"));
+    s.SSL_read =
+        reinterpret_cast<int (*)(SSL*, void*, int)>(sym("SSL_read"));
+    s.SSL_write = reinterpret_cast<int (*)(SSL*, const void*, int)>(
+        sym("SSL_write"));
+    s.SSL_get_error =
+        reinterpret_cast<int (*)(const SSL*, int)>(sym("SSL_get_error"));
+    s.SSL_shutdown = reinterpret_cast<int (*)(SSL*)>(sym("SSL_shutdown"));
+    s.ERR_get_error =
+        reinterpret_cast<unsigned long (*)()>(sym("ERR_get_error"));
+    s.ERR_error_string_n =
+        reinterpret_cast<void (*)(unsigned long, char*, size_t)>(
+            sym("ERR_error_string_n"));
+    s.ERR_clear_error =
+        reinterpret_cast<void (*)()>(sym("ERR_clear_error"));
+    s.ok = s.TLS_method != nullptr && s.SSL_CTX_new != nullptr &&
+           s.SSL_CTX_use_certificate_chain_file != nullptr &&
+           s.SSL_CTX_use_PrivateKey_file != nullptr &&
+           s.SSL_new != nullptr && s.SSL_free != nullptr &&
+           s.SSL_set_fd != nullptr && s.SSL_set_accept_state != nullptr &&
+           s.SSL_set_connect_state != nullptr &&
+           s.SSL_do_handshake != nullptr && s.SSL_read != nullptr &&
+           s.SSL_write != nullptr && s.SSL_get_error != nullptr &&
+           s.ERR_get_error != nullptr;
+    return s;
+  }();
+  return a;
+}
+
+std::string last_ssl_error() {
+  const SslApi& a = api();
+  char buf[256] = "unknown ssl error";
+  if (a.ERR_get_error != nullptr && a.ERR_error_string_n != nullptr) {
+    const unsigned long e = a.ERR_get_error();
+    if (e != 0) {
+      a.ERR_error_string_n(e, buf, sizeof(buf));
+    }
+  }
+  return buf;
+}
+
+// ---- per-connection state ------------------------------------------------
+
+struct TlsConnState {
+  enum Phase : uint8_t {
+    kSniff = 0,        // server: first byte decides TLS vs passthrough
+    kHandshaking = 1,
+    kEstablished = 2,
+    kPlain = 3,        // passthrough: plaintext client on a TLS port
+  };
+  std::mutex mu;  // SSL objects are not thread-safe; read fiber vs
+                  // KeepWrite fiber both drive the same SSL*
+  SSL* ssl = nullptr;
+  SSL_CTX* ctx = nullptr;  // not owned (contexts are leaked singletons)
+  Phase phase = kSniff;
+  bool client = false;
+
+  ~TlsConnState() {
+    if (ssl != nullptr) {
+      api().SSL_free(ssl);  // frees buffered state; fd is socket-owned
+    }
+  }
+};
+
+// Drives the handshake one step; call with st->mu held and ssl set.
+// Returns 1 done, 0 in progress, -1 fatal.
+int handshake_step_locked(TlsConnState* st, Socket* s) {
+  if (api().ERR_clear_error != nullptr) {
+    api().ERR_clear_error();
+  }
+  const int rc = api().SSL_do_handshake(st->ssl);
+  if (rc == 1) {
+    st->phase = TlsConnState::kEstablished;
+    // A KeepWrite fiber may be parked on the writable edge waiting for
+    // the handshake the READ path just completed: poke it.
+    s->on_output_event();
+    return 1;
+  }
+  const int err = api().SSL_get_error(st->ssl, rc);
+  if (err == kSslErrorWantRead || err == kSslErrorWantWrite) {
+    return 0;
+  }
+  LOG(Warning) << "tls handshake with " << endpoint2str(s->remote())
+               << " failed: " << last_ssl_error();
+  return -1;
+}
+
+class TlsTransport final : public Transport {
+ public:
+  ssize_t cut_from_iobuf(Socket* s, IOBuf* from) override {
+    auto* st = static_cast<TlsConnState*>(s->transport_ctx);
+    if (st == nullptr) {
+      errno = EINVAL;
+      return -1;
+    }
+    std::lock_guard<std::mutex> g(st->mu);
+    if (st->phase == TlsConnState::kPlain) {
+      const ssize_t rc = from->cut_into_fd(s->fd());
+      return rc < 0 && (errno == EAGAIN || errno == EWOULDBLOCK) ? 0 : rc;
+    }
+    if (st->phase == TlsConnState::kSniff) {
+      return 0;  // server write before any client byte: wait for sniff
+    }
+    if (st->phase == TlsConnState::kHandshaking) {
+      if (!s->connected() || s->fd() < 0) {
+        return 0;  // spurious pre-connect edge: SSL must not bind fd -1
+      }
+      if (st->ssl == nullptr && !init_ssl_locked(st, s)) {
+        errno = EIO;
+        return -1;
+      }
+      const int hs = handshake_step_locked(st, s);
+      if (hs < 0) {
+        errno = ECONNRESET;
+        return -1;
+      }
+      if (hs == 0) {
+        return 0;  // progress rides the next readable/writable edge
+      }
+    }
+    // Established: encrypt block by block.
+    ssize_t total = 0;
+    while (!from->empty()) {
+      const IOBuf::BlockRef& ref = from->ref_at(0);
+      if (api().ERR_clear_error != nullptr) {
+        api().ERR_clear_error();
+      }
+      const int n = api().SSL_write(
+          st->ssl, ref.block->data + ref.offset, static_cast<int>(ref.length));
+      if (n > 0) {
+        from->pop_front(n);
+        total += n;
+        continue;
+      }
+      const int err = api().SSL_get_error(st->ssl, n);
+      if (err == kSslErrorWantWrite || err == kSslErrorWantRead) {
+        return total;  // partial progress; resume on the next edge
+      }
+      errno = ECONNRESET;
+      return total > 0 ? total : -1;
+    }
+    return total;
+  }
+
+  ssize_t append_to_iobuf(Socket* s, IOBuf* to, size_t max) override {
+    auto* st = static_cast<TlsConnState*>(s->transport_ctx);
+    if (st == nullptr) {
+      errno = EINVAL;
+      return -1;
+    }
+    std::lock_guard<std::mutex> g(st->mu);
+    if (st->phase == TlsConnState::kSniff) {
+      char first = 0;
+      const ssize_t n = recv(s->fd(), &first, 1, MSG_PEEK);
+      if (n < 0) {
+        return errno == EAGAIN || errno == EWOULDBLOCK ? 0 : -1;
+      }
+      if (n == 0) {
+        errno = 0;  // orderly EOF before any byte
+        return -1;
+      }
+      if (first == 0x16) {  // TLS handshake record
+        st->phase = TlsConnState::kHandshaking;
+      } else {
+        st->phase = TlsConnState::kPlain;  // plaintext client, same port
+      }
+    }
+    if (st->phase == TlsConnState::kPlain) {
+      const ssize_t rc = to->append_from_fd(s->fd(), max);
+      if (rc < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        return 0;
+      }
+      if (rc == 0) {
+        errno = 0;
+        return -1;
+      }
+      return rc;
+    }
+    if (st->phase == TlsConnState::kHandshaking) {
+      if (!s->connected() || s->fd() < 0) {
+        return 0;  // spurious pre-connect edge: SSL must not bind fd -1
+      }
+      if (st->ssl == nullptr && !init_ssl_locked(st, s)) {
+        errno = EIO;
+        return -1;
+      }
+      const int hs = handshake_step_locked(st, s);
+      if (hs < 0) {
+        errno = ECONNRESET;
+        return -1;
+      }
+      if (hs == 0) {
+        return 0;
+      }
+    }
+    // Established: decrypt into the IOBuf (one copy — decryption needs a
+    // destination buffer regardless).
+    ssize_t total = 0;
+    char buf[17 * 1024];  // one TLS record + header
+    while (static_cast<size_t>(total) < max) {
+      if (api().ERR_clear_error != nullptr) {
+        api().ERR_clear_error();
+      }
+      const int n = api().SSL_read(st->ssl, buf, sizeof(buf));
+      if (n > 0) {
+        to->append(buf, n);
+        total += n;
+        continue;
+      }
+      const int err = api().SSL_get_error(st->ssl, n);
+      if (err == kSslErrorWantRead || err == kSslErrorWantWrite) {
+        return total;
+      }
+      if (err == kSslErrorZeroReturn) {
+        if (total > 0) {
+          return total;
+        }
+        errno = 0;  // clean TLS shutdown
+        return -1;
+      }
+      if (total > 0) {
+        return total;
+      }
+      errno = ECONNRESET;
+      return -1;
+    }
+    return total;
+  }
+
+  int connect(Socket* s) override {
+    // TCP establishment first; the TLS handshake is driven lazily from
+    // the read/write paths above (both ends nonblocking).
+    return tcp_transport()->connect(s);
+  }
+
+  const char* name() const override { return "tls"; }
+
+ private:
+  static bool init_ssl_locked(TlsConnState* st, Socket* s) {
+    st->ssl = api().SSL_new(st->ctx);
+    if (st->ssl == nullptr) {
+      return false;
+    }
+    if (api().SSL_set_fd(st->ssl, s->fd()) != 1) {
+      api().SSL_free(st->ssl);  // never keep an SSL bound to a bad fd
+      st->ssl = nullptr;
+      return false;
+    }
+    if (st->client) {
+      api().SSL_set_connect_state(st->ssl);
+    } else {
+      api().SSL_set_accept_state(st->ssl);
+    }
+    return true;
+  }
+};
+
+}  // namespace
+
+bool tls_available() { return api().ok; }
+
+void* tls_server_ctx(const std::string& cert_file,
+                     const std::string& key_file, std::string* err) {
+  if (!api().ok) {
+    *err = "libssl not available";
+    return nullptr;
+  }
+  SSL_CTX* ctx = api().SSL_CTX_new(api().TLS_method());
+  if (ctx == nullptr) {
+    *err = last_ssl_error();
+    return nullptr;
+  }
+  if (api().SSL_CTX_use_certificate_chain_file(ctx, cert_file.c_str()) !=
+          1 ||
+      api().SSL_CTX_use_PrivateKey_file(ctx, key_file.c_str(),
+                                        kSslFiletypePem) != 1 ||
+      (api().SSL_CTX_check_private_key != nullptr &&
+       api().SSL_CTX_check_private_key(ctx) != 1)) {
+    *err = last_ssl_error();
+    if (api().SSL_CTX_free != nullptr) {
+      api().SSL_CTX_free(ctx);  // only SUCCESSFUL contexts live forever
+    }
+    return nullptr;
+  }
+  return ctx;
+}
+
+void* tls_client_ctx(std::string* err) {
+  if (!api().ok) {
+    *err = "libssl not available";
+    return nullptr;
+  }
+  static SSL_CTX* ctx = api().SSL_CTX_new(api().TLS_method());
+  if (ctx == nullptr) {
+    *err = last_ssl_error();
+  }
+  return ctx;
+}
+
+Transport* tls_transport() {
+  static TlsTransport t;
+  return &t;
+}
+
+std::shared_ptr<void> tls_conn_server(void* server_ctx) {
+  auto st = std::make_shared<TlsConnState>();
+  st->ctx = static_cast<SSL_CTX*>(server_ctx);
+  st->phase = TlsConnState::kSniff;
+  st->client = false;
+  return st;
+}
+
+std::shared_ptr<void> tls_conn_client(void* client_ctx) {
+  auto st = std::make_shared<TlsConnState>();
+  st->ctx = static_cast<SSL_CTX*>(client_ctx);
+  st->phase = TlsConnState::kHandshaking;
+  st->client = true;
+  return st;
+}
+
+}  // namespace trpc

@@ -1,0 +1,48 @@
+// TLS transport on the Transport seam.
+//
+// Parity: the reference's SSL support (/root/reference/src/brpc/details/
+// ssl_helper.cpp; ServerOptions::mutable_ssl_options; the TLS-vs-plaintext
+// sniff in input_messenger).  Re-designed for this runtime: a Transport
+// wrapper holding per-connection SSL state, with the handshake driven
+// OPPORTUNISTICALLY from whichever side (read fiber / KeepWrite fiber)
+// touches the connection — no dedicated handshake thread.  Server-side
+// connections SNIFF the first byte (0x16 = TLS handshake record): TLS and
+// plaintext clients coexist on one port, like the reference.
+//
+// OpenSSL is loaded at runtime via dlopen(libssl.so.3): the image ships
+// the runtime libraries but no development headers, so the needed subset
+// of the stable libssl ABI is declared locally (tls.cc).
+#pragma once
+
+#include <memory>
+#include <string>
+
+#include "net/transport.h"
+
+namespace trpc {
+
+// True when libssl.so.3 loaded and every needed symbol resolved.
+bool tls_available();
+
+// Server identity: certificate + key (PEM).  Returns an opaque SSL_CTX
+// handle (leaked singleton pattern: contexts live forever), or nullptr
+// with *err filled.
+void* tls_server_ctx(const std::string& cert_file,
+                     const std::string& key_file, std::string* err);
+
+// Client context (no peer verification by default — test/loopback grade,
+// like the reference's default ssl_options).
+void* tls_client_ctx(std::string* err);
+
+// The transport (stateless singleton; per-connection state rides
+// Socket::transport_ctx).  Sockets using it must carry a TlsConnState
+// created by one of the factories below in their transport_ctx_holder.
+Transport* tls_transport();
+
+// Per-connection state factories.  `sniff` (server side): the first byte
+// decides TLS vs plaintext passthrough.  Client connections handshake
+// unconditionally.
+std::shared_ptr<void> tls_conn_server(void* server_ctx);
+std::shared_ptr<void> tls_conn_client(void* client_ctx);
+
+}  // namespace trpc

@@ -37,6 +37,11 @@ class Transport {
   // fiber).  Returns 0 on success.
   virtual int connect(Socket* s) = 0;
 
+  // True when this transport moves bytes through the socket's fd (TCP,
+  // TLS): such sockets need the lazy-connect path before their first
+  // write.  fd-less transports (shm rings) are connected at creation.
+  virtual bool fd_based() const { return true; }
+
   virtual const char* name() const = 0;
 };
 

@@ -14,6 +14,7 @@
 
 #include "base/iobuf.h"
 #include "net/http_message.h"
+#include "net/redis.h"
 #include "net/protocol.h"
 #include "tests/test_util.h"
 
@@ -179,6 +180,77 @@ TEST_CASE(fuzz_http_trickled_state) {
       }
     }
   }
+}
+
+namespace {
+
+std::vector<std::string> resp_corpus() {
+  std::vector<std::string> seeds;
+  // Command form (server side): arrays of bulk strings.
+  std::string c1;
+  resp_pack_command({"SET", "key", "value"}, &c1);
+  std::string c2;
+  resp_pack_command({"MSET", std::string(300, 'k'), std::string(1000, 'v'),
+                     "k2", ""},
+                    &c2);
+  seeds.push_back(c1);
+  seeds.push_back(c2);
+  // Reply form (client side): every type + nesting.
+  RedisReply r = RedisReply::Array({
+      RedisReply::Status("OK"),
+      RedisReply::Error("ERR x"),
+      RedisReply::Integer(-9223372036854775807ll),
+      RedisReply::Bulk(std::string(512, 'b')),
+      RedisReply::Nil(),
+      RedisReply::Array({RedisReply::Array({RedisReply::Integer(1)})}),
+  });
+  std::string rep;
+  r.serialize(&rep);
+  seeds.push_back(rep);
+  return seeds;
+}
+
+}  // namespace
+
+TEST_CASE(fuzz_resp_parsers) {
+  const auto corpus = resp_corpus();
+  for (int iter = 0; iter < 40000; ++iter) {
+    const std::string input = mutate(corpus[rng() % corpus.size()]);
+    // Command parser: must terminate with 1/0/-1 and never read past the
+    // buffer (ASan build enforces); pos only advances on success.
+    {
+      std::vector<std::string> args;
+      size_t pos = 0;
+      const int rc = resp_parse_command(input, &pos, &args);
+      EXPECT(rc >= -1 && rc <= 1);
+      if (rc != 1) {
+        EXPECT_EQ(pos, 0u);
+      } else {
+        EXPECT(pos <= input.size());
+      }
+    }
+    // Reply parser: same contract, plus bounded recursion on hostile
+    // nesting depth.
+    {
+      RedisReply reply;
+      size_t pos = 0;
+      const int rc = resp_parse_reply(input, &pos, &reply);
+      EXPECT(rc >= -1 && rc <= 1);
+      if (rc == 1) {
+        EXPECT(pos <= input.size());
+      }
+    }
+  }
+  // Deep-nesting bomb: 64 levels of "*1\r\n" must be rejected, not
+  // recursed into.
+  std::string bomb;
+  for (int i = 0; i < 64; ++i) {
+    bomb += "*1\r\n";
+  }
+  bomb += ":1\r\n";
+  RedisReply reply;
+  size_t pos = 0;
+  EXPECT_EQ(resp_parse_reply(bomb, &pos, &reply), -1);
 }
 
 TEST_MAIN
