@@ -145,7 +145,100 @@ class P2cEwmaLB : public LoadBalancer {
   }
 };
 
+// Locality-aware: weighted random over every node's expected quality,
+// where weight ~ 1 / (ewma_latency x (1 + inflight) x error-deceleration)
+// (parity: policy/locality_aware_load_balancer.h:41 — same signals and
+// semantics: requests iterate toward lowest-expected-latency servers,
+// errors collapse a node's share sharply, recovery re-earns it).
+// Redesigned at altitude: the reference's partial-sum weight tree buys
+// O(log n) selection for thousand-node clusters; at this runtime's
+// cluster sizes an O(n) scan over the healthy subset is cheaper than the
+// tree's bookkeeping, so the SAME weights feed a direct weighted pick.
+class LocalityAwareLB : public LoadBalancer {
+ public:
+  size_t select(const std::vector<size_t>& healthy,
+                const std::vector<ServerNode>& nodes, uint64_t,
+                int) override {
+    if (healthy.size() == 1) {
+      return healthy[0];
+    }
+    // Pass 1: per-node QUALITY (latency x load x error deceleration) for
+    // nodes with history, tracking the mean so untried nodes (ewma 0)
+    // enter at quality parity — every node gets probed without handing
+    // newcomers the whole cluster.  Static weights multiply at the end
+    // so a newcomer's configured share is respected too.
+    int64_t quality[kMaxScan];
+    const size_t n = std::min(healthy.size(), kMaxScan);
+    int64_t tried_sum = 0;
+    size_t tried = 0;
+    for (size_t i = 0; i < n; ++i) {
+      const ServerNode& node = nodes[healthy[i]];
+      const int64_t lat =
+          node.ewma_latency_us->load(std::memory_order_relaxed);
+      if (lat == 0) {
+        quality[i] = -1;  // untried: filled in pass 2
+        continue;
+      }
+      const int64_t inflight =
+          node.inflight->load(std::memory_order_relaxed);
+      const int64_t fails =
+          node.consecutive_failures->load(std::memory_order_relaxed);
+      // Deceleration: each consecutive error quarters the share again;
+      // one success resets fails and the node re-earns weight from its
+      // (still-remembered) latency.
+      int64_t q = kScale / (lat * (1 + inflight));
+      q >>= std::min<int64_t>(fails * 2, 30);
+      q = std::max<int64_t>(q, kMinWeight);
+      quality[i] = q;
+      tried_sum += q;
+      ++tried;
+    }
+    const int64_t newcomer =
+        tried == 0 ? kScale / 1000 : tried_sum / static_cast<int64_t>(tried);
+    int64_t weights[kMaxScan];
+    for (size_t i = 0; i < n; ++i) {
+      const int64_t q = quality[i] >= 0
+                            ? quality[i]
+                            : std::max<int64_t>(newcomer, kMinWeight);
+      weights[i] = q * std::max(1, nodes[healthy[i]].weight);
+    }
+    return healthy[weighted_pick(weights, n)];
+  }
+
+ private:
+  static constexpr size_t kMaxScan = 1024;  // bound the stack scan
+  static constexpr int64_t kScale = 1ll << 40;
+  static constexpr int64_t kMinWeight = 16;  // floor (min_weight parity)
+};
+
 }  // namespace
+
+int64_t asym_ewma(int64_t prev, int64_t sample) {
+  if (prev == 0) {
+    return sample;
+  }
+  if (sample < prev) {
+    return (prev + sample * 3) / 4;  // improvements take hold fast
+  }
+  return (prev * 7 + sample) / 8;  // degradations blend in slowly
+}
+
+size_t weighted_pick(const int64_t* weights, size_t n) {
+  int64_t total = 0;
+  for (size_t i = 0; i < n; ++i) {
+    total += weights[i];
+  }
+  int64_t dice = static_cast<int64_t>(
+      fast_rand_less_than(static_cast<uint64_t>(std::max<int64_t>(total,
+                                                                  1))));
+  for (size_t i = 0; i < n; ++i) {
+    dice -= weights[i];
+    if (dice < 0) {
+      return i;
+    }
+  }
+  return n - 1;
+}
 
 LoadBalancer* LoadBalancer::create(const std::string& name) {
   if (name == "rr" || name.empty()) {
@@ -160,8 +253,11 @@ LoadBalancer* LoadBalancer::create(const std::string& name) {
   if (name == "wrr") {
     return new WeightedRoundRobinLB();
   }
-  if (name == "p2c" || name == "la") {
+  if (name == "p2c") {
     return new P2cEwmaLB();
+  }
+  if (name == "la") {
+    return new LocalityAwareLB();
   }
   return nullptr;
 }
@@ -514,7 +610,7 @@ void feed_latency(ServerNode& node, int64_t lat_us) {
   }
   const int64_t prev =
       node.ewma_latency_us->load(std::memory_order_relaxed);
-  node.ewma_latency_us->store(prev == 0 ? lat_us : (prev * 7 + lat_us) / 8,
+  node.ewma_latency_us->store(asym_ewma(prev, lat_us),
                               std::memory_order_relaxed);
 }
 }  // namespace

@@ -41,6 +41,17 @@ struct ServerNode {
       std::make_shared<std::atomic<int64_t>>(0);
 };
 
+// Shared feedback/selection primitives (the LA balancer and
+// DynamicPartitionChannel use identical smoothing and dice logic).
+//
+// Asymmetric latency smoothing: degradations blend in slowly (one spike
+// must not evict a node), improvements take hold fast — a recovered node
+// would otherwise need dozens of probes it no longer receives to shed
+// its remembered bad latency (lalb ClearOld/ResetWeight parity).
+int64_t asym_ewma(int64_t prev, int64_t sample);
+// Weighted random pick: index i with probability weights[i]/sum.
+size_t weighted_pick(const int64_t* weights, size_t n);
+
 class LoadBalancer {
  public:
   virtual ~LoadBalancer() = default;

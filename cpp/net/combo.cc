@@ -4,6 +4,8 @@
 
 #include <algorithm>
 
+#include "base/rand.h"
+#include "base/time.h"
 #include "fiber/fiber.h"
 #include "fiber/sync.h"
 
@@ -180,11 +182,17 @@ void SelectiveChannel::CallMethod(const std::string& method,
   }
 }
 
-void PartitionChannel::CallMethod(const std::string& method,
-                                  const IOBuf& request, IOBuf* response,
-                                  Controller* cntl, Partitioner partitioner,
-                                  ParallelChannel::ResponseMerger merger) {
-  if (subs_.empty()) {
+namespace {
+
+// Shared partition fanout: shards `request` over `subs` (all-or-nothing)
+// and merges — the body of PartitionChannel::CallMethod, reused per
+// scheme by DynamicPartitionChannel.
+void partition_fanout(const std::vector<std::shared_ptr<SubChannel>>& subs,
+                      const std::string& method, const IOBuf& request,
+                      IOBuf* response, Controller* cntl,
+                      const PartitionChannel::Partitioner& partitioner,
+                      const ParallelChannel::ResponseMerger& merger) {
+  if (subs.empty()) {
     cntl->SetFailed(ENOENT, "no partitions");
     return;
   }
@@ -193,16 +201,16 @@ void PartitionChannel::CallMethod(const std::string& method,
     return;
   }
   fiber_init(0);
-  std::vector<IOBuf> parts = partitioner(request, subs_.size());
-  if (parts.size() != subs_.size()) {
+  std::vector<IOBuf> parts = partitioner(request, subs.size());
+  if (parts.size() != subs.size()) {
     cntl->SetFailed(EINVAL, "partitioner returned wrong count");
     return;
   }
-  auto ctx = std::make_shared<FanoutCtx>(static_cast<int>(subs_.size()));
-  ctx->subs = subs_;
+  auto ctx = std::make_shared<FanoutCtx>(static_cast<int>(subs.size()));
+  ctx->subs = subs;
   ctx->method = method;
   ctx->requests = std::move(parts);
-  for (size_t i = 0; i < subs_.size(); ++i) {
+  for (size_t i = 0; i < subs.size(); ++i) {
     ctx->cntls[i].set_timeout_ms(cntl->timeout_ms());
     ctx->cntls[i].request_attachment() = cntl->request_attachment();
   }
@@ -222,6 +230,87 @@ void PartitionChannel::CallMethod(const std::string& method,
       response->append(r);
     }
   }
+}
+
+}  // namespace
+
+void PartitionChannel::CallMethod(const std::string& method,
+                                  const IOBuf& request, IOBuf* response,
+                                  Controller* cntl, Partitioner partitioner,
+                                  ParallelChannel::ResponseMerger merger) {
+  partition_fanout(subs_, method, request, response, cntl, partitioner,
+                   merger);
+}
+
+int DynamicPartitionChannel::add_scheme(
+    std::vector<std::shared_ptr<SubChannel>> partitions) {
+  auto s = std::make_unique<Scheme>();
+  s->parts = std::move(partitions);
+  schemes_.push_back(std::move(s));
+  return static_cast<int>(schemes_.size()) - 1;
+}
+
+int64_t DynamicPartitionChannel::weight_of(const Scheme& s) const {
+  // Capacity prior: a 4-way scheme nominally serves 2x a 2-way one
+  // (partition_channel.h:136 capacity semantics).  Quality correction:
+  // latency relative to the best-performing scheme, divided by relative
+  // in-flight load, quartered per consecutive failed fanout.
+  constexpr int64_t kQualityOne = 1 << 16;
+  const int64_t cap = static_cast<int64_t>(s.parts.size());
+  const int64_t lat = s.ewma_us.load(std::memory_order_relaxed);
+  int64_t quality = kQualityOne;  // untried schemes enter at parity
+  if (lat > 0) {
+    int64_t best = lat;
+    for (const auto& other : schemes_) {
+      const int64_t l = other->ewma_us.load(std::memory_order_relaxed);
+      if (l > 0) {
+        best = std::min(best, l);
+      }
+    }
+    quality = kQualityOne * best / lat;
+  }
+  const int64_t load =
+      1 + s.inflight.load(std::memory_order_relaxed) / std::max<int64_t>(
+                                                           cap, 1);
+  int64_t w = cap * quality / load;
+  w >>= std::min(2 * s.fails.load(std::memory_order_relaxed), 30);
+  return std::max<int64_t>(w, 1);
+}
+
+int64_t DynamicPartitionChannel::scheme_weight(int index) const {
+  if (index < 0 || static_cast<size_t>(index) >= schemes_.size()) {
+    return 0;
+  }
+  return weight_of(*schemes_[index]);
+}
+
+void DynamicPartitionChannel::CallMethod(
+    const std::string& method, const IOBuf& request, IOBuf* response,
+    Controller* cntl, PartitionChannel::Partitioner partitioner,
+    ParallelChannel::ResponseMerger merger) {
+  if (schemes_.empty()) {
+    cntl->SetFailed(ENOENT, "no partition schemes");
+    return;
+  }
+  // Capacity x quality weighted random scheme pick.
+  std::vector<int64_t> weights(schemes_.size());
+  for (size_t i = 0; i < schemes_.size(); ++i) {
+    weights[i] = weight_of(*schemes_[i]);
+  }
+  Scheme& s = *schemes_[weighted_pick(weights.data(), weights.size())];
+  s.inflight.fetch_add(1, std::memory_order_relaxed);
+  const int64_t t0 = monotonic_time_us();
+  partition_fanout(s.parts, method, request, response, cntl, partitioner,
+                   merger);
+  const int64_t lat = monotonic_time_us() - t0;
+  s.inflight.fetch_sub(1, std::memory_order_relaxed);
+  if (cntl->Failed()) {
+    s.fails.fetch_add(1, std::memory_order_relaxed);
+    return;
+  }
+  s.fails.store(0, std::memory_order_relaxed);
+  s.ewma_us.store(asym_ewma(s.ewma_us.load(std::memory_order_relaxed), lat),
+                  std::memory_order_relaxed);
 }
 
 }  // namespace trpc

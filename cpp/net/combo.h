@@ -98,4 +98,42 @@ class PartitionChannel {
   std::vector<std::shared_ptr<SubChannel>> subs_;
 };
 
+// Several partition SCHEMES of the same logical service coexisting (a
+// 2-way and a 4-way deployment during a resharding migration): each call
+// picks one scheme and shards across it.  Parity:
+// DynamicPartitionChannel (partition_channel.h:136), which weighs
+// schemes by server capacity — here the capacity prior (partition count)
+// is CLOSED-LOOP corrected by observed per-scheme latency, in-flight
+// load and errors, so a scheme that underperforms its nominal capacity
+// sheds traffic live and re-earns it on recovery (the TPU twin is
+// brpc_tpu/channels/combo.py DynamicPartitionChannel).
+class DynamicPartitionChannel {
+ public:
+  // Adds one scheme (its shard sub-channels, in partition order).
+  // Returns the scheme index.
+  int add_scheme(std::vector<std::shared_ptr<SubChannel>> partitions);
+  size_t scheme_count() const { return schemes_.size(); }
+
+  // Capacity×quality-weighted scheme pick, then a PartitionChannel-style
+  // fanout over the chosen scheme.
+  void CallMethod(const std::string& method, const IOBuf& request,
+                  IOBuf* response, Controller* cntl,
+                  PartitionChannel::Partitioner partitioner,
+                  ParallelChannel::ResponseMerger merger = nullptr);
+
+  // Live effective weight of one scheme (observability / tests).
+  int64_t scheme_weight(int index) const;
+
+ private:
+  struct Scheme {
+    std::vector<std::shared_ptr<SubChannel>> parts;
+    std::atomic<int64_t> ewma_us{0};   // smoothed whole-fanout latency
+    std::atomic<int64_t> inflight{0};
+    std::atomic<int> fails{0};         // consecutive failed fanouts
+  };
+  int64_t weight_of(const Scheme& s) const;
+
+  std::vector<std::unique_ptr<Scheme>> schemes_;
+};
+
 }  // namespace trpc

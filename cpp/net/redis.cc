@@ -121,20 +121,38 @@ int parse_int_line(const Src& data, size_t* pos, int64_t* out) {
   if (i == nl) {
     return -1;
   }
-  int64_t v = 0;
+  // Accumulate the magnitude unsigned so INT64_MIN (magnitude 2^63) is
+  // representable; bound-check BEFORE multiplying (UB-free).
+  const uint64_t limit =
+      neg ? static_cast<uint64_t>(INT64_MAX) + 1 : INT64_MAX;
+  uint64_t v = 0;
   for (; i < nl; ++i) {
     if (buf[i] < '0' || buf[i] > '9') {
       return -1;
     }
-    const int d = buf[i] - '0';
-    if (v > (INT64_MAX - d) / 10) {
-      return -1;  // would overflow (checked BEFORE multiplying: UB-free)
+    const uint64_t d = buf[i] - '0';
+    if (v > (limit - d) / 10) {
+      return -1;  // would overflow
     }
     v = v * 10 + d;
   }
-  *out = neg ? -v : v;
+  *out = neg ? static_cast<int64_t>(0 - v) : static_cast<int64_t>(v);
   *pos += nl + 2;
   return 1;
+}
+
+}  // namespace
+
+namespace {
+
+// Status/error lines are CRLF-delimited on the wire: embedded newlines in
+// handler-supplied text would desync the whole RESP stream (the bytes
+// after the first CRLF parse as the NEXT pipelined reply).  Bulk strings
+// are length-prefixed and need no such laundering.
+void append_line_safe(const std::string& s, std::string* out) {
+  for (char c : s) {
+    out->push_back(c == '\r' || c == '\n' ? ' ' : c);
+  }
 }
 
 }  // namespace
@@ -146,12 +164,12 @@ void RedisReply::serialize(std::string* out) const {
       break;
     case kStatus:
       out->push_back('+');
-      out->append(str);
+      append_line_safe(str, out);
       out->append("\r\n");
       break;
     case kError:
       out->push_back('-');
-      out->append(str);
+      append_line_safe(str, out);
       out->append("\r\n");
       break;
     case kInteger:

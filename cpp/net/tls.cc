@@ -383,7 +383,14 @@ void* tls_client_ctx(std::string* err) {
     *err = "libssl not available";
     return nullptr;
   }
-  static SSL_CTX* ctx = api().SSL_CTX_new(api().TLS_method());
+  // Retry on later calls if the first allocation failed — a transient
+  // failure must not disable client TLS for the process lifetime.
+  static std::mutex mu;
+  static SSL_CTX* ctx = nullptr;
+  std::lock_guard<std::mutex> g(mu);
+  if (ctx == nullptr) {
+    ctx = api().SSL_CTX_new(api().TLS_method());
+  }
   if (ctx == nullptr) {
     *err = last_ssl_error();
   }

@@ -354,4 +354,74 @@ TEST_CASE(p2c_prefers_fast_server) {
   EXPECT(cf.load() > cs.load() * 2);  // strongly skewed to the fast node
 }
 
+TEST_CASE(locality_aware_shifts_and_recovers) {
+  // The locality-aware balancer must (1) move traffic away from a node
+  // whose latency degrades, and (2) give it back after it recovers —
+  // the deceleration/recovery loop of the reference's lalb
+  // (policy/locality_aware_load_balancer.h:41).
+  static Server a, b, c;
+  static std::atomic<int> hits[3];
+  static std::atomic<int64_t> delay_us[3];
+  struct Reg {
+    Reg() {
+      Server* servers[3] = {&a, &b, &c};
+      for (int i = 0; i < 3; ++i) {
+        servers[i]->RegisterMethod(
+            "L.Hit", [i](Controller*, const IOBuf&, IOBuf* r, Closure done) {
+              hits[i].fetch_add(1);
+              const int64_t d = delay_us[i].load();
+              if (d > 0) {
+                fiber_sleep_us(d);
+              }
+              r->append("ok");
+              done();
+            });
+        EXPECT_EQ(servers[i]->Start(0), 0);
+      }
+    }
+  };
+  static Reg reg;
+  ClusterChannel ch;
+  const std::string url = "list://127.0.0.1:" + std::to_string(a.port()) +
+                          ",127.0.0.1:" + std::to_string(b.port()) +
+                          ",127.0.0.1:" + std::to_string(c.port());
+  EXPECT_EQ(ch.Init(url, "la"), 0);
+  auto run = [&](int n) {
+    for (int i = 0; i < n; ++i) {
+      Controller cntl;
+      cntl.set_timeout_ms(2000);
+      IOBuf req, resp;
+      req.append("x");
+      ch.CallMethod("L.Hit", req, &resp, &cntl);
+      EXPECT(!cntl.Failed());
+    }
+  };
+  auto reset = [] {
+    for (auto& h : hits) {
+      h.store(0);
+    }
+  };
+
+  // Phase 1: all healthy — every node earns a real share.
+  run(150);
+  for (auto& h : hits) {
+    EXPECT(h.load() > 15);
+  }
+
+  // Phase 2: node 1 degrades to 5ms — its share collapses.
+  delay_us[1].store(5000);
+  run(100);  // let feedback observe the slowdown
+  reset();
+  run(200);
+  EXPECT(hits[1].load() < 40);  // < 20% (fair share would be ~33%)
+  EXPECT(hits[0].load() + hits[2].load() > 160);
+
+  // Phase 3: node 1 recovers — probing re-earns its share.
+  delay_us[1].store(0);
+  run(400);  // decay the remembered EWMA through probe traffic
+  reset();
+  run(200);
+  EXPECT(hits[1].load() > 30);  // back above 15%
+}
+
 TEST_MAIN

@@ -25,6 +25,7 @@ TEST_CASE(resp_codec_roundtrip) {
       RedisReply::Status("OK"),
       RedisReply::Error("ERR boom"),
       RedisReply::Integer(-42),
+      RedisReply::Integer(INT64_MIN),  // magnitude 2^63 must roundtrip
       RedisReply::Bulk("hello\r\nworld"),  // embedded CRLF must survive
       RedisReply::Nil(),
       RedisReply::Array({RedisReply::Integer(1), RedisReply::Bulk("")}),
@@ -36,14 +37,15 @@ TEST_CASE(resp_codec_roundtrip) {
   EXPECT_EQ(resp_parse_reply(wire, &pos, &out), 1);
   EXPECT_EQ(pos, wire.size());
   EXPECT_EQ(out.type, RedisReply::kArray);
-  EXPECT_EQ(out.elements.size(), 6u);
+  EXPECT_EQ(out.elements.size(), 7u);
   EXPECT(out.elements[0].type == RedisReply::kStatus &&
          out.elements[0].str == "OK");
   EXPECT(out.elements[1].is_error() && out.elements[1].str == "ERR boom");
   EXPECT_EQ(out.elements[2].integer, -42);
-  EXPECT(out.elements[3].str == "hello\r\nworld");
-  EXPECT_EQ(out.elements[4].type, RedisReply::kNil);
-  EXPECT_EQ(out.elements[5].elements.size(), 2u);
+  EXPECT_EQ(out.elements[3].integer, INT64_MIN);
+  EXPECT(out.elements[4].str == "hello\r\nworld");
+  EXPECT_EQ(out.elements[5].type, RedisReply::kNil);
+  EXPECT_EQ(out.elements[6].elements.size(), 2u);
 }
 
 TEST_CASE(resp_codec_partial_and_malformed) {
