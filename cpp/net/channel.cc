@@ -9,6 +9,7 @@
 #include "fiber/timer.h"
 #include "net/h2_client.h"
 #include "net/messenger.h"
+#include "net/progressive.h"
 #include "net/protocol.h"
 #include "net/shm_transport.h"
 #include "net/socket_map.h"
@@ -25,6 +26,13 @@ namespace trpc {
 // Shared with the h2 client response path (h2_client.cc).
 void complete_locked_call(fid_t cid, Controller* cntl) {
   cntl->set_latency_us(monotonic_time_us() - cntl->call().start_us);
+  // Progressive reads get exactly one terminal callback, success or not,
+  // before the caller can observe completion.
+  if (cntl->call().preader != nullptr) {
+    ProgressiveReader* r = cntl->call().preader;
+    cntl->call().preader = nullptr;
+    r->on_done(cntl->error_code(), cntl->error_text());
+  }
   // h2 calls completing WITHOUT a response (timeout / local failure) must
   // drop their client-side stream state, or dead streams accumulate on
   // the multiplexed connection for its whole lifetime.
@@ -423,7 +431,8 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
     uint32_t stream_id = 0;
     const bool ok = h2_client_issue(sid, cid, method, body, proto_ == 2,
                                     endpoint2str(ep_), auth_hdr,
-                                    &stream_id) == 0;
+                                    &stream_id,
+                                    cntl->call().preader) == 0;
     cntl->call().h2_stream = stream_id;
     fid_unlock(cid);
     if (!ok) {

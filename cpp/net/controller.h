@@ -8,6 +8,7 @@
 
 #include <cstdint>
 #include <functional>
+#include <memory>
 #include <string>
 
 #include "base/iobuf.h"
@@ -16,6 +17,9 @@
 namespace trpc {
 
 using Closure = std::function<void()>;
+
+class ProgressiveAttachment;  // net/progressive.h
+class ProgressiveReader;
 
 class Controller {
  public:
@@ -66,6 +70,20 @@ class Controller {
   int64_t latency_us() const { return latency_us_; }
   const std::string& method() const { return method_; }
 
+  // -- progressive bodies (net/progressive.h) --------------------------
+  // Server handler (HTTP serving): the response body will be streamed
+  // incrementally; done() flushes headers (chunked) and the returned
+  // attachment keeps writing from any fiber until close().
+  std::shared_ptr<ProgressiveAttachment> CreateProgressiveAttachment();
+  const std::shared_ptr<ProgressiveAttachment>& progressive_attachment()
+      const {
+    return progressive_;
+  }
+  // Client (h2): response DATA is delivered to `r` piece by piece
+  // instead of accumulating; `r` must outlive the call and gets exactly
+  // one on_done.
+  void ReadProgressively(ProgressiveReader* r) { call_.preader = r; }
+
   // -- internal (framework) --------------------------------------------
   struct CallState {
     fid_t cid = 0;
@@ -88,6 +106,8 @@ class Controller {
     // h2/grpc calls: the stream id issued for this call, so a failed call
     // (timeout) can cancel its client-side stream state (h2_client.h).
     uint32_t h2_stream = 0;
+    // Progressive response consumer (net/progressive.h; h2 client).
+    ProgressiveReader* preader = nullptr;
   };
   CallState& call() { return call_; }
   void set_method(const std::string& m) { method_ = m; }
@@ -104,6 +124,7 @@ class Controller {
   int64_t latency_us_ = 0;
   IOBuf request_attachment_;
   IOBuf response_attachment_;
+  std::shared_ptr<ProgressiveAttachment> progressive_;
   CallState call_;
 };
 

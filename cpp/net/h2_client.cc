@@ -15,6 +15,7 @@
 #include "net/controller.h"
 #include "net/h2_frames.h"
 #include "net/hpack.h"
+#include "net/progressive.h"
 #include "net/protocol.h"
 
 namespace trpc {
@@ -37,6 +38,9 @@ struct H2CliStream {
   bool request_done = false;  // our END_STREAM has been sent
   bool response_end = false;  // peer's END_STREAM seen (may precede
                               // END_HEADERS when trailers span frames)
+  // Progressive consumption (net/progressive.h): DATA frames go to the
+  // reader as they arrive instead of accumulating in `body`.
+  ProgressiveReader* reader = nullptr;
 };
 
 // Per-connection client state, hung on Socket::parse_state.
@@ -129,7 +133,7 @@ ParseError h2c_parse(IOBuf* source, InputMessage* out, Socket* sock) {
     return ParseError::kNotEnoughData;
   }
   H2CliConn* c = conn_of(sock);
-  std::lock_guard<std::mutex> g(c->mu);
+  std::unique_lock<std::mutex> g(c->mu);
   while (true) {
     uint8_t head[kFrameHeaderLen];
     if (source->copy_to(head, kFrameHeaderLen) < kFrameHeaderLen) {
@@ -362,6 +366,60 @@ ParseError h2c_parse(IOBuf* source, InputMessage* out, Socket* sock) {
           break;  // stale stream (reset/completed): discard
         }
         H2CliStream& st = it->second;
+        if (st.reader != nullptr) {
+          // Progressive: hand the piece over OUTSIDE the conn lock but
+          // UNDER the call's fid lock — a concurrent timeout completing
+          // the call fires on_done (after which the user may destroy the
+          // reader), so delivery and completion must serialize to keep
+          // the "no on_part after on_done" contract.  on_part must not
+          // issue sync calls on THIS connection (it runs in its read
+          // fiber).
+          const uint64_t cid = st.cid;
+          const bool end = (flags & kEndStream) != 0;
+          IOBuf piece;
+          piece.append(d, dlen);
+          g.unlock();
+          bool cont = true;
+          bool call_alive = true;
+          {
+            void* data = nullptr;
+            if (fid_lock(cid, &data) != 0) {
+              call_alive = false;  // completed (timed out): stop
+            } else {
+              auto* cntl = static_cast<Controller*>(data);
+              ProgressiveReader* r = cntl->call().preader;
+              if (r != nullptr && dlen > 0) {
+                cont = r->on_part(piece);
+              }
+              fid_unlock(cid);
+            }
+          }
+          g.lock();
+          auto it2 = c->streams.find(stream_id);
+          if (it2 == c->streams.end()) {
+            break;
+          }
+          if (!call_alive || !cont) {  // dead call / consumer abort
+            c->streams.erase(it2);
+            std::string rst;
+            put_u32(&rst, 0x8);  // CANCEL
+            send_frames(sock->id(),
+                        frame_header(4, kRstStream, 0, stream_id) + rst);
+            if (!call_alive) {
+              break;  // nothing left to complete
+            }
+            out->meta.type = RpcMeta::kResponse;
+            out->meta.correlation_id = cid;
+            out->meta.error_code = ECANCELED;
+            out->meta.error_text = "progressive reader aborted";
+            return ParseError::kOk;
+          }
+          if (end) {
+            complete_stream_locked(c, stream_id, &it2->second, out);
+            return ParseError::kOk;
+          }
+          break;
+        }
         st.body.append(d, dlen);
         if (st.body.size() > (1ull << 30)) {
           return ParseError::kCorrupted;
@@ -465,7 +523,7 @@ int h2_client_issue(SocketId sid, uint64_t cid, const std::string& method,
                     const IOBuf& request, bool grpc,
                     const std::string& authority,
                     const std::string& auth_header,
-                    uint32_t* stream_id_out) {
+                    uint32_t* stream_id_out, ProgressiveReader* reader) {
   SocketRef s(Socket::Address(sid));
   if (!s) {
     return -1;
@@ -494,6 +552,7 @@ int h2_client_issue(SocketId sid, uint64_t cid, const std::string& method,
   H2CliStream& st = c->streams[stream_id];
   st.cid = cid;
   st.send_window = c->peer_initial_window;
+  st.reader = reader;
   if (stream_id_out != nullptr) {
     *stream_id_out = stream_id;
   }

@@ -16,6 +16,8 @@
 
 namespace trpc {
 
+class ProgressiveReader;  // net/progressive.h
+
 // Registers the client-side h2 protocol (idempotent) and returns its
 // registry index — client sockets are PRE-pinned to it: the client knows
 // what it speaks, and the server's first bytes (a SETTINGS frame) carry
@@ -40,7 +42,8 @@ int h2_client_issue(SocketId sid, uint64_t cid, const std::string& method,
                     const IOBuf& request, bool grpc,
                     const std::string& authority,
                     const std::string& auth_header,
-                    uint32_t* stream_id_out = nullptr);
+                    uint32_t* stream_id_out = nullptr,
+                    ProgressiveReader* reader = nullptr);
 
 // Drops a stream whose call completed without a response (timeout /
 // local failure): erases the client-side state — otherwise dead streams

@@ -1,4 +1,5 @@
 #include "net/http_protocol.h"
+#include "net/progressive.h"
 
 #include <cstring>
 #include <memory>
@@ -65,15 +66,25 @@ ParseError http_parse(IOBuf* source, InputMessage* out, Socket* sock) {
   return ParseError::kOk;
 }
 
+// One header-block assembler for every response form; `framing` is the
+// body-framing header ("Content-Length: N" / "Transfer-Encoding:
+// chunked").
+std::string http_head(int status, const std::string& content_type,
+                      const std::string& framing, bool keep_alive) {
+  return http_status_line(status) + "\r\nContent-Type: " + content_type +
+         "\r\n" + framing +
+         (keep_alive ? "\r\nConnection: keep-alive\r\n\r\n"
+                     : "\r\nConnection: close\r\n\r\n");
+}
+
 // Response write; honors HEAD (headers only) and Connection semantics
 // (keep-alive by default, flush-then-close on `close`).
 void http_respond(SocketId sid, const HttpRequest& req, int status,
                   const std::string& content_type, const std::string& body) {
   std::string head =
-      http_status_line(status) + "\r\nContent-Type: " + content_type +
-      "\r\nContent-Length: " + std::to_string(body.size()) +
-      (req.keep_alive ? "\r\nConnection: keep-alive\r\n\r\n"
-                      : "\r\nConnection: close\r\n\r\n");
+      http_head(status, content_type,
+                "Content-Length: " + std::to_string(body.size()),
+                req.keep_alive);
   IOBuf out;
   out.append(head);
   if (req.verb != "HEAD") {
@@ -170,8 +181,35 @@ void http_process_request(InputMessage&& msg) {
     if (limiter != nullptr) {
       limiter->on_response(monotonic_time_us() - start_us, cntl->Failed());
     }
+    bool ordering_released = false;
     if (cntl->Failed()) {
       http_respond(sid, *req, 500, "text/plain", cntl->error_text() + "\n");
+    } else if (cntl->progressive_attachment() != nullptr) {
+      // Progressive body: flush the headers (chunked) now; the handler
+      // keeps Write()ing the attachment from any fiber.  The connection's
+      // response ordering (the latch the read fiber parks on) is released
+      // only when the attachment CLOSES — HTTP/1.1 responses cannot
+      // interleave, so a pipelined request must wait out the stream.
+      std::shared_ptr<ProgressiveAttachment> pa =
+          cntl->progressive_attachment();
+      IOBuf out;
+      out.append(http_head(200, "application/octet-stream",
+                           "Transfer-Encoding: chunked", req->keep_alive));
+      if (req->verb == "HEAD") {
+        // Headers only; the attachment's body is discarded (http_respond
+        // parity) and the ordering latch releases normally below.
+        pa->abandon();
+        SocketRef s(Socket::Address(sid));
+        if (s) {
+          s->Write(std::move(out), /*close_after=*/!req->keep_alive);
+        }
+      } else {
+        // bind() writes the headers itself, under the attachment's lock:
+        // the socket publishes only after them, and the latch is owned
+        // by the attachment until it closes.
+        pa->bind(sid, req->keep_alive, latch, std::move(out));
+        ordering_released = true;
+      }
     } else {
       http_respond(sid, *req, 200, "application/octet-stream",
                    response->to_string());
@@ -183,7 +221,9 @@ void http_process_request(InputMessage&& msg) {
     delete cntl;
     srv->requests_served.fetch_add(1, std::memory_order_relaxed);
     srv->in_flight.fetch_sub(1, std::memory_order_acq_rel);
-    latch->signal();
+    if (!ordering_released) {
+      latch->signal();
+    }
   };
   prop->handler(cntl, msg.payload, response, std::move(done));
   latch->wait(-1);

@@ -1,0 +1,112 @@
+#include "net/progressive.h"
+
+#include <cstdio>
+
+#include "net/controller.h"
+
+namespace trpc {
+
+std::shared_ptr<ProgressiveAttachment>
+Controller::CreateProgressiveAttachment() {
+  if (progressive_ == nullptr) {
+    progressive_ = std::make_shared<ProgressiveAttachment>();
+  }
+  return progressive_;
+}
+
+namespace {
+
+void append_chunk(IOBuf* out, const IOBuf& data) {
+  if (data.empty()) {
+    return;  // a zero-length chunk would terminate the body
+  }
+  char head[24];
+  const int n = snprintf(head, sizeof(head), "%zx\r\n", data.size());
+  out->append(head, static_cast<size_t>(n));
+  out->append(data);
+  out->append("\r\n", 2);
+}
+
+}  // namespace
+
+int ProgressiveAttachment::Write(const IOBuf& data) {
+  std::lock_guard<std::mutex> g(mu_);
+  if (closed_ || pre_closed_) {
+    return -1;
+  }
+  if (sid_ == 0) {
+    append_chunk(&queued_, data);  // rides the headers write at bind()
+    return 0;
+  }
+  SocketRef s(Socket::Address(sid_));
+  if (!s) {
+    return -1;
+  }
+  IOBuf out;
+  append_chunk(&out, data);
+  return s->Write(std::move(out));
+}
+
+void ProgressiveAttachment::close() {
+  std::shared_ptr<CountdownEvent> notify;
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    if (closed_ || pre_closed_) {
+      return;
+    }
+    if (sid_ == 0) {
+      pre_closed_ = true;  // terminator rides the headers write
+      return;
+    }
+    closed_ = true;
+    SocketRef s(Socket::Address(sid_));
+    if (s) {
+      IOBuf fin;
+      fin.append("0\r\n\r\n", 5);
+      s->Write(std::move(fin), /*close_after=*/!keep_alive_);
+    }
+    notify = std::move(on_closed_);
+  }
+  if (notify != nullptr) {
+    notify->signal();  // release the connection's response ordering
+  }
+}
+
+void ProgressiveAttachment::bind(SocketId sid, bool keep_alive,
+                                 std::shared_ptr<CountdownEvent> on_closed,
+                                 IOBuf&& head) {
+  std::shared_ptr<CountdownEvent> notify;
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    keep_alive_ = keep_alive;
+    head.append(std::move(queued_));
+    bool terminated = false;
+    if (pre_closed_) {
+      head.append("0\r\n\r\n", 5);
+      closed_ = true;
+      terminated = true;
+      notify = std::move(on_closed);
+    } else {
+      on_closed_ = std::move(on_closed);
+    }
+    SocketRef s(Socket::Address(sid));
+    if (s) {
+      s->Write(std::move(head),
+               /*close_after=*/terminated && !keep_alive);
+    }
+    // Publish the socket only AFTER the headers are queued: Socket::Write
+    // is FIFO, so later Write()/close() bytes order behind them.
+    sid_ = sid;
+  }
+  if (notify != nullptr) {
+    notify->signal();
+  }
+}
+
+void ProgressiveAttachment::abandon() {
+  std::lock_guard<std::mutex> g(mu_);
+  closed_ = true;
+  queued_.clear();
+}
+
+}  // namespace trpc

@@ -1,0 +1,83 @@
+// Progressive bodies — incremental huge payloads without buffering.
+//
+// Parity: brpc's ProgressiveAttachment
+// (/root/reference/src/brpc/progressive_attachment.h:32 — the server
+// responds headers immediately, then streams body pieces for as long as
+// it likes) and ProgressiveReader (progressive_reader.h — the client
+// consumes response pieces through a callback instead of accumulating).
+// SURVEY §5 names these the long-context analogue: a 100GB body moves
+// end-to-end under constant memory.
+//
+// This runtime's forms:
+// - ProgressiveAttachment rides HTTP/1.1 chunked encoding: the handler
+//   creates one from its Controller, calls done() (headers flush with
+//   Transfer-Encoding: chunked), and keeps Write()ing from any fiber;
+//   close() (or destruction) sends the terminating chunk.  Pipelined
+//   requests on the connection wait until the attachment closes —
+//   HTTP/1.1 responses cannot interleave.
+// - ProgressiveReader rides the h2 client: DATA frames are handed to the
+//   callback as they arrive instead of accumulating in the response
+//   buffer.  (For tstd, streaming RPC with credit windows — net/stream.h
+//   — is the first-class progressive path.)
+#pragma once
+
+#include <memory>
+#include <mutex>
+#include <string>
+
+#include "base/iobuf.h"
+#include "fiber/sync.h"
+#include "net/socket.h"
+
+namespace trpc {
+
+class ProgressiveAttachment {
+ public:
+  ~ProgressiveAttachment() { close(); }
+
+  // Appends one body piece (one HTTP chunk).  Pieces written before the
+  // response headers flush are queued and ride the same write as the
+  // headers.  Returns 0, or -1 after close()/connection failure.
+  int Write(const IOBuf& data);
+
+  // Sends the terminating chunk; idempotent.  The connection survives
+  // (keep-alive) unless the request asked for close.
+  void close();
+
+  // -- internal (serving-path wiring) ----------------------------------
+  // Binds the attachment to its connection AND writes `head` (the
+  // response headers) followed by any queued pieces, all under the
+  // attachment's lock — publishing the socket before the headers are on
+  // the wire would let a concurrent Write()/close() put chunk bytes
+  // ahead of the status line, and releasing the ordering latch early
+  // would let a pipelined response overtake.  `on_closed` releases the
+  // connection's response order when the attachment closes.
+  void bind(SocketId sid, bool keep_alive,
+            std::shared_ptr<CountdownEvent> on_closed, IOBuf&& head);
+
+  // Serving-path discard (HEAD requests): headers went out alone; all
+  // writes are dropped and close() becomes a no-op.
+  void abandon();
+
+ private:
+  std::mutex mu_;
+  SocketId sid_ = 0;  // 0 until bound
+  bool keep_alive_ = true;
+  bool closed_ = false;
+  bool pre_closed_ = false;  // closed before headers flushed
+  IOBuf queued_;             // chunk-framed pieces awaiting bind
+  std::shared_ptr<CountdownEvent> on_closed_;
+};
+
+// Client-side consumer of a progressive response (h2: one callback per
+// DATA frame).  Implementations must tolerate calls from the
+// connection's read fiber; on_part returning false cancels the stream.
+class ProgressiveReader {
+ public:
+  virtual ~ProgressiveReader() = default;
+  virtual bool on_part(const IOBuf& piece) = 0;
+  // Always called exactly once, after the last part (or on failure).
+  virtual void on_done(int error_code, const std::string& error_text) = 0;
+};
+
+}  // namespace trpc

@@ -10,7 +10,9 @@
 #include <thread>
 
 #include "base/time.h"
+#include "fiber/fiber.h"
 #include "net/channel.h"
+#include "net/progressive.h"
 #include "net/server.h"
 #include "tests/test_util.h"
 
@@ -201,6 +203,93 @@ TEST_CASE(transfer_encoding_chunked_must_be_exact) {
       "POST /Echo.Echo HTTP/1.1\r\nHost: x\r\n"
       "Transfer-Encoding:  chunked \r\n\r\n5\r\nabcde\r\n0\r\n\r\n");
   EXPECT(ok.find("200") != std::string::npos);
+}
+
+namespace {
+
+std::atomic<bool> g_pa_wrote_last{false};
+
+}  // namespace
+
+TEST_CASE(progressive_attachment_streams_chunks) {
+  // A handler that responds headers immediately and streams the body over
+  // time (ProgressiveAttachment, progressive_attachment.h:32): the client
+  // must see early chunks BEFORE the handler wrote the last one (no
+  // full-body buffering), and the connection must survive for the next
+  // request (keep-alive after the terminating chunk).
+  static Server srv;
+  srv.RegisterMethod("PA.Stream", [](Controller* cntl, const IOBuf&,
+                                     IOBuf*, Closure done) {
+    auto pa = cntl->CreateProgressiveAttachment();
+    done();  // headers flush now; body follows from this fiber
+    for (int i = 0; i < 8; ++i) {
+      IOBuf piece;
+      piece.append(std::string(256 * 1024, static_cast<char>('a' + i)));
+      EXPECT_EQ(pa->Write(piece), 0);
+      fiber_sleep_us(30 * 1000);  // pace: 8 chunks over ~240ms
+    }
+    g_pa_wrote_last.store(true);
+    pa->close();
+  });
+  srv.RegisterMethod("PA.Ping", [](Controller*, const IOBuf&, IOBuf* r,
+                                   Closure done) {
+    r->append("pong");
+    done();
+  });
+  EXPECT_EQ(srv.Start(0), 0);
+
+  const int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in sa = {};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  sa.sin_port = htons(static_cast<uint16_t>(srv.port()));
+  EXPECT_EQ(connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)), 0);
+  const std::string rq = "GET /PA.Stream HTTP/1.1\r\nHost: x\r\n\r\n";
+  EXPECT(write(fd, rq.data(), rq.size()) == static_cast<ssize_t>(rq.size()));
+
+  std::string in;
+  char buf[65536];
+  bool checked_early = false;
+  while (in.find("\r\n0\r\n\r\n") == std::string::npos) {
+    const ssize_t n = read(fd, buf, sizeof(buf));
+    EXPECT(n > 0);
+    in.append(buf, n);
+    if (!checked_early && in.size() > 4096) {
+      // First bytes arrived: the handler must still be mid-stream.
+      EXPECT(!g_pa_wrote_last.load());
+      EXPECT(in.find("Transfer-Encoding: chunked") != std::string::npos);
+      checked_early = true;
+    }
+  }
+  EXPECT(checked_early);
+  // De-chunk and verify the body.
+  const size_t hdr_end = in.find("\r\n\r\n");
+  EXPECT(hdr_end != std::string::npos);
+  std::string body;
+  size_t pos = hdr_end + 4;
+  while (true) {
+    const size_t nl = in.find("\r\n", pos);
+    EXPECT(nl != std::string::npos);
+    const size_t len = strtoul(in.substr(pos, nl - pos).c_str(), nullptr, 16);
+    if (len == 0) {
+      break;
+    }
+    body += in.substr(nl + 2, len);
+    pos = nl + 2 + len + 2;
+  }
+  EXPECT_EQ(body.size(), 8u * 256 * 1024);
+  for (int i = 0; i < 8; ++i) {
+    EXPECT(body[i * 256 * 1024] == 'a' + i);
+  }
+  // Keep-alive: the connection serves the next request after the stream.
+  const std::string rq2 =
+      "POST /PA.Ping HTTP/1.1\r\nHost: x\r\nContent-Length: 0\r\n\r\n";
+  EXPECT(write(fd, rq2.data(), rq2.size()) ==
+         static_cast<ssize_t>(rq2.size()));
+  const ssize_t n2 = read(fd, buf, sizeof(buf));
+  EXPECT(n2 > 0);
+  EXPECT(std::string(buf, n2).find("pong") != std::string::npos);
+  close(fd);
 }
 
 TEST_CASE(uri_query_and_percent_decoding) {
