@@ -5,6 +5,7 @@
 #include <string.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <cstdlib>
 #include <string>
@@ -17,6 +18,7 @@
 #include "net/http_protocol.h"
 #include "net/server.h"
 #include "net/span.h"
+#include "stat/heap_profiler.h"
 #include "stat/profiler.h"
 #include "stat/variable.h"
 
@@ -43,7 +45,8 @@ std::string flags_text() {
 
 }  // namespace
 
-bool builtin_http_dispatch(Server* srv, const HttpRequest& req, int* status,
+bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
+                           const IOBuf& payload, int* status,
                            std::string* body, std::string* content_type) {
   const std::string& path = req.path;
   *status = 200;
@@ -220,6 +223,59 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req, int* status,
     *body = contention_dump();
     return true;
   }
+  if (path == "/pprof/profile") {
+    // gperftools-protocol CPU profile: external pprof tooling attaches
+    // with `pprof http://host:port/pprof/profile` (pprof_service.h:26).
+    int seconds = 10;
+    const std::string* sq = req.query("seconds");
+    if (sq != nullptr) {
+      seconds = atoi(sq->c_str());
+    }
+    seconds = std::min(std::max(seconds, 1), 60);
+    *body = profile_cpu_pprof(seconds);
+    if (body->empty()) {
+      *status = 503;
+      *body = "another profile is already running\n";
+      return true;
+    }
+    *content_type = "application/octet-stream";
+    return true;
+  }
+  if (path == "/pprof/symbol") {
+    // GET: capability probe.  POST: "0xA+0xB" → "0xA\tname" lines.
+    if (req.verb == "POST") {
+      *body = pprof_symbolize_post(payload.to_string());
+    } else {
+      *body = "num_symbols: 1\n";
+    }
+    return true;
+  }
+  if (path == "/pprof/cmdline") {
+    FILE* f = fopen("/proc/self/cmdline", "r");
+    if (f != nullptr) {
+      char buf[4096];
+      const size_t n = fread(buf, 1, sizeof(buf), f);
+      fclose(f);
+      for (size_t i = 0; i < n; ++i) {
+        body->push_back(buf[i] == '\0' ? '\n' : buf[i]);
+      }
+    }
+    return true;
+  }
+  if (path == "/pprof/heap") {
+    // First call enables the sampler (no tcmalloc in the image — the
+    // runtime's own new/delete sampler, heap_profiler.h); later calls
+    // dump the live profile accumulated since.
+    if (!heap_profiler_running()) {
+      heap_profiler_start();
+      *body =
+          "heap sampling enabled; re-query after the workload to get the "
+          "live profile\n";
+      return true;
+    }
+    *body = heap_profiler_dump();
+    return true;
+  }
   if (path == "/fibers" || path == "/bthreads") {
     *body = fiber_dump_all();
     return true;
@@ -249,7 +305,9 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req, int* status,
         "/health\n/version\n/status\n/vars\n/vars/<name>\n/brpc_metrics\n"
         "/connections\n/flags\n/flags/<name>[?setvalue=v]\n/threads\n"
         "/memory\n/list\n/protobufs\n/index\n/rpcz[?trace_id=hex]\n"
-        "/hotspots[?seconds=N]\n/contention\n/fibers\n";
+        "/hotspots[?seconds=N]\n/contention\n/fibers\n"
+        "/pprof/profile[?seconds=N]\n/pprof/symbol\n/pprof/cmdline\n"
+        "/pprof/heap\n";
     return true;
   }
   (void)content_type;

@@ -176,7 +176,9 @@ ParseError h2c_parse(IOBuf* source, InputMessage* out, Socket* sock) {
                                (static_cast<uint32_t>(p[off + 3]) << 16) |
                                (static_cast<uint32_t>(p[off + 4]) << 8) |
                                p[off + 5];
-          if (id == 0x5) {  // MAX_FRAME_SIZE
+          if (id == 0x1) {  // HEADER_TABLE_SIZE (the peer's decoder)
+            c->encoder.set_max_size(val);
+          } else if (id == 0x5) {  // MAX_FRAME_SIZE
             if (val >= 16384 && val <= 1 << 24) {
               c->peer_max_frame = std::min<uint32_t>(val, 1 << 20);
             }

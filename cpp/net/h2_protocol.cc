@@ -39,7 +39,13 @@ struct H2Stream {
   // is correctness).
   IOBuf pending_data;
   bool pending_end = false;
-  std::string pending_trailers;  // pre-framed; sent once data drains
+  // gRPC trailers waiting behind the data: kept as HEADERS (not a
+  // pre-encoded block) and HPACK-encoded at TRANSMISSION time — the
+  // stateful encoder's table mutations must hit the wire in encode
+  // order, and a deferred pre-encoded block would let a later stream's
+  // headers overtake its inserts.
+  HeaderList trailer_headers;
+  bool has_trailers = false;
 };
 
 // Per-connection h2 state, hung on Socket::parse_state.
@@ -107,9 +113,15 @@ void flush_pending_locked(H2Conn* c, SocketId sid, uint32_t stream_id,
     c->conn_send_window -= static_cast<int32_t>(chunk);
   }
   const bool done = st->pending_data.empty();
-  if (done && !st->pending_trailers.empty()) {
-    out.append(st->pending_trailers);  // trailers strictly after last DATA
-    st->pending_trailers.clear();
+  if (done && st->has_trailers) {
+    // Encode NOW, inside the same critical section as the write: wire
+    // order must equal encoder-table mutation order.
+    std::string tblock;
+    c->encoder.encode(st->trailer_headers, &tblock);
+    out.append(frame_header(static_cast<uint32_t>(tblock.size()), kHeaders,
+                            kEndHeaders | kEndStream, stream_id) +
+               tblock);
+    st->has_trailers = false;
   }
   if (!out.empty()) {
     SocketRef s(Socket::Address(sid));
@@ -157,18 +169,13 @@ void h2_respond(SocketId sid, uint32_t stream_id, int status,
     st->pending_data.clear();
     st->pending_data.append(payload);
     st->pending_end = false;
-    HeaderList trailers = {
+    st->trailer_headers = {
         {"grpc-status", std::to_string(grpc_status)},
     };
     if (!grpc_msg.empty()) {
-      trailers.push_back({"grpc-message", grpc_msg});
+      st->trailer_headers.push_back({"grpc-message", grpc_msg});
     }
-    std::string tblock;
-    c->encoder.encode(trailers, &tblock);
-    st->pending_trailers =
-        frame_header(static_cast<uint32_t>(tblock.size()), kHeaders,
-                     kEndHeaders | kEndStream, stream_id) +
-        tblock;
+    st->has_trailers = true;
     send_frames(sid, std::move(out));
     flush_pending_locked(c, sid, stream_id, st);
     return;
@@ -282,7 +289,9 @@ ParseError h2_parse(IOBuf* source, InputMessage* out, Socket* sock) {
                                (static_cast<uint32_t>(p[off + 3]) << 16) |
                                (static_cast<uint32_t>(p[off + 4]) << 8) |
                                p[off + 5];
-          if (id == 0x5) {  // MAX_FRAME_SIZE
+          if (id == 0x1) {  // HEADER_TABLE_SIZE (the peer's decoder)
+            c->encoder.set_max_size(val);
+          } else if (id == 0x5) {  // MAX_FRAME_SIZE
             if (val >= 16384 && val <= 1 << 24) {
               c->peer_max_frame = std::min<uint32_t>(val, 1 << 20);
             }
@@ -621,7 +630,7 @@ void h2_process_request(InputMessage&& msg) {
   std::string body;
   std::string ctype = "text/plain";
   int status = 200;
-  if (!grpc && builtin_http_dispatch(srv, req, &status, &body, &ctype)) {
+  if (!grpc && builtin_http_dispatch(srv, req, msg.payload, &status, &body, &ctype)) {
     h2_respond(msg.socket, stream_id, status, ctype, body, false, 0, "");
     return;
   }

@@ -1,5 +1,7 @@
 #include "net/hpack.h"
 
+#include <algorithm>
+
 #include <cstring>
 
 namespace trpc {
@@ -201,6 +203,36 @@ bool read_string(const uint8_t** p, const uint8_t* end, std::string* out) {
 
 }  // namespace
 
+void HpackDynTable::evict_to(size_t limit) {
+  while (bytes > limit && !entries.empty()) {
+    bytes -= entries.back().first.size() + entries.back().second.size() +
+             kEntryOverhead;
+    entries.pop_back();
+  }
+}
+
+void HpackDynTable::insert(const std::string& name,
+                           const std::string& value, size_t max_size) {
+  const size_t sz = name.size() + value.size() + kEntryOverhead;
+  if (sz > max_size) {  // larger than the table: empties it (§4.4)
+    evict_to(0);
+    return;
+  }
+  evict_to(max_size - sz);
+  entries.insert(entries.begin(), {name, value});
+  bytes += sz;
+}
+
+size_t HpackDynTable::find(const std::string& name,
+                           const std::string& value) const {
+  for (size_t i = 0; i < entries.size(); ++i) {
+    if (entries[i].first == name && entries[i].second == value) {
+      return i;
+    }
+  }
+  return SIZE_MAX;
+}
+
 bool HpackDecoder::lookup(uint64_t index, std::string* name,
                           std::string* value) const {
   if (index == 0) {
@@ -212,32 +244,12 @@ bool HpackDecoder::lookup(uint64_t index, std::string* name,
     return true;
   }
   const uint64_t d = index - kStaticCount - 1;
-  if (d >= dynamic_.size()) {
+  if (d >= table_.entries.size()) {
     return false;
   }
-  *name = dynamic_[d].first;
-  *value = dynamic_[d].second;
+  *name = table_.entries[d].first;
+  *value = table_.entries[d].second;
   return true;
-}
-
-void HpackDecoder::evict_to(size_t limit) {
-  while (dyn_bytes_ > limit && !dynamic_.empty()) {
-    dyn_bytes_ -= dynamic_.back().first.size() +
-                  dynamic_.back().second.size() + kEntryOverhead;
-    dynamic_.pop_back();
-  }
-}
-
-void HpackDecoder::insert(const std::string& name,
-                          const std::string& value) {
-  const size_t sz = name.size() + value.size() + kEntryOverhead;
-  if (sz > max_size_) {  // larger than the table: empties it (§4.4)
-    evict_to(0);
-    return;
-  }
-  evict_to(max_size_ - sz);
-  dynamic_.insert(dynamic_.begin(), {name, value});
-  dyn_bytes_ += sz;
 }
 
 bool HpackDecoder::decode(const uint8_t* data, size_t len,
@@ -277,7 +289,7 @@ bool HpackDecoder::decode(const uint8_t* data, size_t len,
       if (!read_string(&p, end, &value)) {
         return false;
       }
-      insert(name, value);
+      table_.insert(name, value, max_size_);
       total += name.size() + value.size();
       out->emplace_back(std::move(name), std::move(value));
     } else if (b & 0x20) {  // §6.3 dynamic table size update
@@ -289,7 +301,7 @@ bool HpackDecoder::decode(const uint8_t* data, size_t len,
         return false;  // must not exceed the SETTINGS ceiling
       }
       max_size_ = static_cast<uint32_t>(sz);
-      evict_to(max_size_);
+      table_.evict_to(max_size_);
     } else {  // §6.2.2/§6.2.3 literal without indexing / never indexed
       uint64_t index = 0;
       if (!hpack_decode_int(&p, end, 4, &index)) {
@@ -318,7 +330,23 @@ bool HpackDecoder::decode(const uint8_t* data, size_t len,
   return true;
 }
 
+void HpackEncoder::set_max_size(uint32_t peer_max) {
+  // Never grow past our own 4096 budget; shrink to the peer's limit and
+  // open the next block with the §6.3 size update it must observe.
+  const uint32_t next = std::min<uint32_t>(peer_max, 4096);
+  if (next == max_size_) {
+    return;
+  }
+  max_size_ = next;
+  table_.evict_to(max_size_);
+  pending_size_update_ = true;
+}
+
 void HpackEncoder::encode(const HeaderList& headers, std::string* out) {
+  if (pending_size_update_) {
+    hpack_encode_int(max_size_, 5, 0x20, out);  // §6.3
+    pending_size_update_ = false;
+  }
   for (const auto& [name, value] : headers) {
     // Exact static match → one indexed byte.
     uint64_t exact = 0;
@@ -334,18 +362,39 @@ void HpackEncoder::encode(const HeaderList& headers, std::string* out) {
         }
       }
     }
+    if (exact == 0) {
+      const size_t d = table_.find(name, value);
+      if (d != SIZE_MAX) {
+        exact = kStaticCount + d + 1;  // HPACK numbering: newest first
+      }
+    }
     if (exact != 0) {
       hpack_encode_int(exact, 7, 0x80, out);
       continue;
     }
-    // Literal without indexing; indexed name when the static table has it.
-    hpack_encode_int(name_only, 4, 0x00, out);
+    const size_t entry_sz = name.size() + value.size() + kEntryOverhead;
+    if (entry_sz > max_size_ / 2) {
+      // Oversized: indexing would evict the whole table for one entry.
+      // Literal WITHOUT indexing (§6.2.2), indexed name when available.
+      hpack_encode_int(name_only, 4, 0x00, out);
+      if (name_only == 0) {
+        hpack_encode_int(name.size(), 7, 0x00, out);
+        out->append(name);
+      }
+      hpack_encode_int(value.size(), 7, 0x00, out);
+      out->append(value);
+      continue;
+    }
+    // Literal WITH incremental indexing (§6.2.1): the peer's decoder
+    // inserts exactly what we insert, so later blocks can reference it.
+    hpack_encode_int(name_only, 6, 0x40, out);
     if (name_only == 0) {
       hpack_encode_int(name.size(), 7, 0x00, out);
       out->append(name);
     }
     hpack_encode_int(value.size(), 7, 0x00, out);
     out->append(value);
+    table_.insert(name, value, max_size_);
   }
 }
 

@@ -6,8 +6,9 @@
 // decoding by bit-length groups (the RFC code assignment is canonical, so
 // per-length [min_code, max_code] ranges + a symbol array replace the
 // tree entirely), one dynamic table with RFC size accounting, and an
-// encoder that emits never-indexed literals (legal and simple — peers
-// still send us fully indexed/huffman forms, which we decode).
+// encoder with incremental indexing over its own dynamic table (repeated
+// metadata — gRPC paths, authorities, custom headers — shrinks to one
+// index byte per later block, details/hpack.cpp parity).
 #pragma once
 
 #include <cstdint>
@@ -19,6 +20,21 @@ namespace trpc {
 
 using HeaderList = std::vector<std::pair<std::string, std::string>>;
 
+// The RFC 7541 §4.1 dynamic table (newest-first, 32-byte per-entry
+// overhead), shared by decoder and encoder so the size-accounting rules
+// exist exactly once.
+struct HpackDynTable {
+  void evict_to(size_t limit);
+  // §4.4 included: an entry larger than the whole table empties it.
+  void insert(const std::string& name, const std::string& value,
+              size_t max_size);
+  // 0-based position of an exact match, or SIZE_MAX.
+  size_t find(const std::string& name, const std::string& value) const;
+
+  std::vector<std::pair<std::string, std::string>> entries;
+  size_t bytes = 0;
+};
+
 class HpackDecoder {
  public:
   explicit HpackDecoder(uint32_t max_dynamic_size = 4096)
@@ -28,24 +44,40 @@ class HpackDecoder {
   // (connection error per RFC 7540 §4.3).
   bool decode(const uint8_t* data, size_t len, HeaderList* out);
 
-  size_t dynamic_size() const { return dyn_bytes_; }
+  size_t dynamic_size() const { return table_.bytes; }
 
  private:
   bool lookup(uint64_t index, std::string* name, std::string* value) const;
-  void insert(const std::string& name, const std::string& value);
-  void evict_to(size_t limit);
 
   uint32_t max_size_;
   uint32_t settings_cap_ = 4096;  // ceiling for table-size updates
-  std::vector<std::pair<std::string, std::string>> dynamic_;  // newest front
-  size_t dyn_bytes_ = 0;
+  HpackDynTable table_;
 };
 
 class HpackEncoder {
  public:
-  // Appends one header block for `headers` to *out (static-table indexed
-  // where an exact match exists; literal-never-indexed otherwise).
+  explicit HpackEncoder(uint32_t max_dynamic_size = 4096)
+      : max_size_(max_dynamic_size) {}
+
+  // Appends one header block for `headers` to *out: static/dynamic exact
+  // matches emit one index; everything else is a literal WITH incremental
+  // indexing (§6.2.1), entering the encoder's table — which mirrors, by
+  // construction, the table the peer's decoder maintains — so repeats in
+  // later blocks shrink to an index.  Oversized entries (> half the
+  // table) are never indexed: they would evict everything for one entry.
   void encode(const HeaderList& headers, std::string* out);
+
+  // Bounds the encoder's table by the peer decoder's advertised
+  // SETTINGS_HEADER_TABLE_SIZE (RFC 7541 §4.2): shrinks immediately and
+  // schedules the §6.3 size update the next block must open with.
+  void set_max_size(uint32_t peer_max);
+
+  size_t dynamic_size() const { return table_.bytes; }
+
+ private:
+  uint32_t max_size_;
+  bool pending_size_update_ = false;
+  HpackDynTable table_;
 };
 
 // Exposed for tests: RFC 7541 §5.1 prefix integers and §5.2 huffman.

@@ -135,7 +135,7 @@ void http_process_request(InputMessage&& msg) {
   std::string ctype = "text/plain";
   int status = 200;
   if (srv != nullptr &&
-      builtin_http_dispatch(srv, *req, &status, &body, &ctype)) {
+      builtin_http_dispatch(srv, *req, msg.payload, &status, &body, &ctype)) {
     http_respond(msg.socket, *req, status, ctype, body);
     return;
   }

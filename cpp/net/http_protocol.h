@@ -22,7 +22,8 @@ void register_http_protocol();
 // Builtin service dispatch (/vars, /status, /flags, ...).  Returns true
 // when the path is a builtin; fills status/body/content_type.
 class Server;
-bool builtin_http_dispatch(Server* srv, const HttpRequest& req, int* status,
+bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
+                           const IOBuf& payload, int* status,
                            std::string* body, std::string* content_type);
 
 }  // namespace trpc

@@ -1,0 +1,258 @@
+#include "stat/heap_profiler.h"
+
+#include <execinfo.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <new>
+#include <unordered_map>
+#include <vector>
+
+namespace trpc {
+
+namespace {
+
+constexpr size_t kSamplePeriod = 512 * 1024;  // bytes between samples
+constexpr int kMaxDepth = 16;
+
+struct AllocRecord {
+  size_t size = 0;
+  int depth = 0;
+  void* frames[kMaxDepth];
+};
+
+std::atomic<bool> g_on{false};
+// Fast-path gate for frees: true while the live table MAY hold entries
+// (it outlives g_on so records retire correctly after stop()).
+std::atomic<bool> g_have_records{false};
+std::atomic<size_t> g_bytes_since{0};
+
+// Set while THIS thread is inside profiler bookkeeping: the table's own
+// allocations must not recurse into sampling.
+thread_local bool tl_in_hook = false;
+
+std::mutex& table_mu() {
+  static std::mutex* m = new std::mutex();
+  return *m;
+}
+
+std::unordered_map<void*, AllocRecord>& live_table() {
+  static auto* t = new std::unordered_map<void*, AllocRecord>();
+  return *t;
+}
+
+void maybe_sample(void* p, size_t sz) {
+  if (p == nullptr || tl_in_hook ||
+      !g_on.load(std::memory_order_relaxed)) {
+    return;
+  }
+  const size_t before =
+      g_bytes_since.fetch_add(sz, std::memory_order_relaxed);
+  if (before + sz < kSamplePeriod) {
+    return;  // period not yet crossed
+  }
+  // This thread crossed the period boundary: claim the sample (the racy
+  // reset loses at most one concurrent sample — fine for a sampler).
+  g_bytes_since.store(0, std::memory_order_relaxed);
+  tl_in_hook = true;
+  AllocRecord rec;
+  rec.size = sz;
+  rec.depth = backtrace(rec.frames, kMaxDepth);
+  {
+    std::lock_guard<std::mutex> g(table_mu());
+    auto& t = live_table();
+    if (t.size() < 65536) {  // bound the table
+      t[p] = rec;
+      g_have_records.store(true, std::memory_order_relaxed);
+    }
+  }
+  tl_in_hook = false;
+}
+
+void maybe_retire(void* p) {
+  if (p == nullptr || tl_in_hook ||
+      !g_have_records.load(std::memory_order_relaxed)) {
+    return;
+  }
+  tl_in_hook = true;
+  {
+    std::lock_guard<std::mutex> g(table_mu());
+    live_table().erase(p);
+  }
+  tl_in_hook = false;
+}
+
+}  // namespace
+
+// External linkage: the operator overrides below live outside the trpc
+// namespace and funnel here.
+void* alloc_impl(size_t sz) {
+  void* p = malloc(sz);
+  maybe_sample(p, sz);
+  return p;
+}
+
+void* alloc_aligned_impl(size_t sz, size_t align) {
+  void* p = nullptr;
+  if (posix_memalign(&p, align, sz) != 0) {
+    p = nullptr;
+  }
+  maybe_sample(p, sz);
+  return p;
+}
+
+void free_impl(void* p) {
+  maybe_retire(p);
+  free(p);
+}
+
+bool heap_profiler_start() {
+  void* warm[4];
+  backtrace(warm, 4);  // pre-load the unwinder outside hot paths
+  table_mu();          // and construct the leaked singletons
+  live_table();
+  g_bytes_since.store(0, std::memory_order_relaxed);
+  g_on.store(true, std::memory_order_release);
+  return true;
+}
+
+bool heap_profiler_running() {
+  return g_on.load(std::memory_order_acquire);
+}
+
+void heap_profiler_stop() {
+  g_on.store(false, std::memory_order_release);
+  tl_in_hook = true;
+  {
+    std::lock_guard<std::mutex> g(table_mu());
+    live_table().clear();
+    g_have_records.store(false, std::memory_order_relaxed);
+  }
+  tl_in_hook = false;
+}
+
+std::string heap_profiler_dump() {
+  // Aggregate live records by stack.
+  struct StackStat {
+    int64_t count = 0;
+    int64_t bytes = 0;
+  };
+  std::map<std::vector<void*>, StackStat> by_stack;
+  int64_t total_count = 0;
+  int64_t total_bytes = 0;
+  tl_in_hook = true;
+  {
+    std::lock_guard<std::mutex> g(table_mu());
+    for (const auto& [p, rec] : live_table()) {
+      // frames[0..1] are the profiler's own bookkeeping frames.
+      const int skip = rec.depth > 2 ? 2 : 0;
+      std::vector<void*> key(rec.frames + skip, rec.frames + rec.depth);
+      StackStat& s = by_stack[key];
+      s.count += 1;
+      s.bytes += static_cast<int64_t>(rec.size);
+      total_count += 1;
+      total_bytes += static_cast<int64_t>(rec.size);
+    }
+  }
+  tl_in_hook = false;
+
+  char line[512];
+  snprintf(line, sizeof(line),
+           "heap profile: %6lld: %8lld [%6lld: %8lld] @ heap_v2/%zu\n",
+           static_cast<long long>(total_count),
+           static_cast<long long>(total_bytes),
+           static_cast<long long>(total_count),
+           static_cast<long long>(total_bytes), kSamplePeriod);
+  std::string out = line;
+  for (const auto& [frames, st] : by_stack) {
+    snprintf(line, sizeof(line), "%6lld: %8lld [%6lld: %8lld] @",
+             static_cast<long long>(st.count),
+             static_cast<long long>(st.bytes),
+             static_cast<long long>(st.count),
+             static_cast<long long>(st.bytes));
+    out += line;
+    for (void* pc : frames) {
+      snprintf(line, sizeof(line), " %p", pc);
+      out += line;
+    }
+    out += "\n";
+  }
+  out += "\nMAPPED_LIBRARIES:\n";
+  FILE* maps = fopen("/proc/self/maps", "r");
+  if (maps != nullptr) {
+    char buf[4096];
+    size_t n;
+    while ((n = fread(buf, 1, sizeof(buf), maps)) > 0) {
+      out.append(buf, n);
+    }
+    fclose(maps);
+  }
+  return out;
+}
+
+}  // namespace trpc
+
+// ---- global operator new/delete overrides --------------------------------
+// Every variant funnels into alloc_impl/free_impl; while the profiler is
+// off the added cost is one relaxed atomic load per call.
+
+void* operator new(size_t sz) {
+  void* p = trpc::alloc_impl(sz);
+  if (p == nullptr) {
+    throw std::bad_alloc();
+  }
+  return p;
+}
+void* operator new[](size_t sz) { return operator new(sz); }
+void* operator new(size_t sz, const std::nothrow_t&) noexcept {
+  return trpc::alloc_impl(sz);
+}
+void* operator new[](size_t sz, const std::nothrow_t&) noexcept {
+  return trpc::alloc_impl(sz);
+}
+void* operator new(size_t sz, std::align_val_t al) {
+  void* p = trpc::alloc_aligned_impl(sz, static_cast<size_t>(al));
+  if (p == nullptr) {
+    throw std::bad_alloc();
+  }
+  return p;
+}
+void* operator new[](size_t sz, std::align_val_t al) {
+  return operator new(sz, al);
+}
+void* operator new(size_t sz, std::align_val_t al,
+                   const std::nothrow_t&) noexcept {
+  return trpc::alloc_aligned_impl(sz, static_cast<size_t>(al));
+}
+void* operator new[](size_t sz, std::align_val_t al,
+                     const std::nothrow_t&) noexcept {
+  return trpc::alloc_aligned_impl(sz, static_cast<size_t>(al));
+}
+
+void operator delete(void* p) noexcept { trpc::free_impl(p); }
+void operator delete[](void* p) noexcept { trpc::free_impl(p); }
+void operator delete(void* p, size_t) noexcept { trpc::free_impl(p); }
+void operator delete[](void* p, size_t) noexcept { trpc::free_impl(p); }
+void operator delete(void* p, std::align_val_t) noexcept {
+  trpc::free_impl(p);
+}
+void operator delete[](void* p, std::align_val_t) noexcept {
+  trpc::free_impl(p);
+}
+void operator delete(void* p, size_t, std::align_val_t) noexcept {
+  trpc::free_impl(p);
+}
+void operator delete[](void* p, size_t, std::align_val_t) noexcept {
+  trpc::free_impl(p);
+}
+void operator delete(void* p, const std::nothrow_t&) noexcept {
+  trpc::free_impl(p);
+}
+void operator delete[](void* p, const std::nothrow_t&) noexcept {
+  trpc::free_impl(p);
+}

@@ -1,0 +1,29 @@
+// Sampling heap profiler — live allocations by call stack.
+//
+// Parity: the reference exposes tcmalloc's heap profile through
+// /pprof/heap (/root/reference/src/brpc/details/tcmalloc_extension.h:72,
+// builtin/pprof_service.h).  This image has no tcmalloc, so the runtime
+// carries its own sampler: global operator new/delete overrides count
+// allocated bytes and record one call stack per ~512KB allocated; frees
+// of sampled pointers retire their records, so the aggregate approximates
+// LIVE bytes by allocation site.  Overhead while disabled is one relaxed
+// atomic load per new/delete.
+//
+// Dump format is gperftools' text heap profile ("heap profile: ... @
+// heap_v2/<period>" + per-stack lines + MAPPED_LIBRARIES), which standard
+// pprof tooling parses.
+#pragma once
+
+#include <string>
+
+namespace trpc {
+
+// Enables sampling (idempotent).  Returns false if unavailable.
+bool heap_profiler_start();
+bool heap_profiler_running();
+// Renders the live heap profile (empty-profile header when off).
+std::string heap_profiler_dump();
+// Disables sampling and drops the live-record table.
+void heap_profiler_stop();
+
+}  // namespace trpc

@@ -40,10 +40,10 @@ void sigprof_handler(int, siginfo_t*, void*) {
   if (!g_profiling.load(std::memory_order_relaxed) || g_ring == nullptr) {
     return;
   }
-  const size_t slot = g_ring_next.fetch_add(1, std::memory_order_relaxed);
-  if (slot >= kRingSize) {
-    return;  // ring full: drop further samples
-  }
+  // WRAP rather than drop: a long profile keeps its most recent window
+  // instead of silently freezing at the first 16K samples.
+  const size_t slot =
+      g_ring_next.fetch_add(1, std::memory_order_relaxed) % kRingSize;
   Sample& s = g_ring[slot];
   // backtrace() is not strictly async-signal-safe but is the standard
   // practice for SIGPROF samplers (gperftools does its own unwind); the
@@ -107,7 +107,10 @@ bool profiler_start(int hz) {
   return true;
 }
 
-std::string profiler_stop_and_dump(size_t max_rows) {
+namespace {
+
+// Disarms the timer and returns how many ring slots hold valid samples.
+size_t profiler_disarm() {
   itimerval off;
   memset(&off, 0, sizeof(off));
   setitimer(ITIMER_PROF, &off, nullptr);
@@ -115,8 +118,13 @@ std::string profiler_stop_and_dump(size_t max_rows) {
   // A handler delivered just before the disarm may still be mid-write on
   // another thread; give it a beat before reading the ring.
   usleep(2000);
-  const size_t n =
-      std::min(g_ring_next.load(std::memory_order_relaxed), kRingSize);
+  return std::min(g_ring_next.load(std::memory_order_relaxed), kRingSize);
+}
+
+}  // namespace
+
+std::string profiler_stop_and_dump(size_t max_rows) {
+  const size_t n = profiler_disarm();
 
   // Aggregate leaf-ward frames (skip the handler's own frames).
   std::map<std::string, int64_t> by_frame;
@@ -153,6 +161,71 @@ std::string profile_cpu_for(int seconds, int hz) {
   }
   fiber_sleep_us(static_cast<int64_t>(seconds) * 1000000);
   return profiler_stop_and_dump();
+}
+
+std::string profile_cpu_pprof(int seconds, int hz) {
+  if (!profiler_start(hz)) {
+    return "";  // caller reports the conflict
+  }
+  fiber_sleep_us(static_cast<int64_t>(seconds) * 1000000);
+  const size_t n = profiler_disarm();
+
+  // Aggregate identical stacks (handler frames stripped).
+  std::map<std::vector<void*>, int64_t> stacks;
+  for (size_t i = 0; i < n; ++i) {
+    const Sample& s = g_ring[i];
+    if (s.depth <= 2) {
+      continue;
+    }
+    std::vector<void*> key(s.frames + 2, s.frames + s.depth);
+    ++stacks[key];
+  }
+  // gperftools legacy CPU profile format (binary machine words; what
+  // `pprof` reads when given a raw profile: builtin/pprof_service parity):
+  //   header  [0, 3, 0, sampling_period_usec, 0]
+  //   records [count, depth, pc...]
+  //   trailer [0, 1, 0]
+  std::string out;
+  auto put_word = [&out](uintptr_t w) {
+    out.append(reinterpret_cast<const char*>(&w), sizeof(w));
+  };
+  put_word(0);
+  put_word(3);
+  put_word(0);
+  put_word(1000000 / (hz > 0 ? hz : 100));
+  put_word(0);
+  for (const auto& [frames, count] : stacks) {
+    put_word(static_cast<uintptr_t>(count));
+    put_word(frames.size());
+    for (void* pc : frames) {
+      put_word(reinterpret_cast<uintptr_t>(pc));
+    }
+  }
+  put_word(0);
+  put_word(1);
+  put_word(0);
+  g_prof_busy.store(false, std::memory_order_release);
+  return out;
+}
+
+std::string pprof_symbolize_post(const std::string& body) {
+  // /pprof/symbol POST: "0xADDR+0xADDR+..." → "0xADDR\tname" lines.
+  std::string out;
+  size_t pos = 0;
+  while (pos < body.size()) {
+    size_t end = body.find('+', pos);
+    if (end == std::string::npos) {
+      end = body.size();
+    }
+    const std::string tok = body.substr(pos, end - pos);
+    if (!tok.empty()) {
+      const uintptr_t addr = strtoull(tok.c_str(), nullptr, 16);
+      out += tok + "\t" +
+             symbolize(reinterpret_cast<void*>(addr)) + "\n";
+    }
+    pos = end + 1;
+  }
+  return out;
 }
 
 void contention_record(void* site, int64_t wait_us) {

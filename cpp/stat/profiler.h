@@ -22,6 +22,12 @@ bool profiler_start(int hz = 100);
 // Stops sampling and renders a flat text profile: sample counts per
 // symbolized frame, callers included, most-hit first.
 std::string profiler_stop_and_dump(size_t max_rows = 60);
+// /pprof/profile: same sampling, emitted in the gperftools legacy binary
+// CPU-profile format standard pprof tooling reads (pprof_service.h:26
+// parity).  Empty string when another profile is running.
+std::string profile_cpu_pprof(int seconds, int hz = 100);
+// /pprof/symbol POST body ("0xA+0xB+...") → "0xA\tsymbol" lines.
+std::string pprof_symbolize_post(const std::string& body);
 // Convenience for /hotspots: profile this process for `seconds` (the
 // calling fiber sleeps through it).
 std::string profile_cpu_for(int seconds, int hz = 100);

@@ -172,6 +172,59 @@ TEST_CASE(hpack_encoder_roundtrip) {
   }
 }
 
+TEST_CASE(hpack_encoder_dynamic_table_shrinks_repeats) {
+  // Incremental indexing: the second block carrying the same metadata
+  // collapses to index bytes, and the encoder's table stays in sync with
+  // the decoder's through eviction churn.
+  HpackEncoder enc;
+  HpackDecoder dec;
+  HeaderList h = {
+      {":method", "POST"},
+      {":path", "/pkg.Svc/Method"},
+      {":authority", "tpu-host-1234:8080"},
+      {"x-trace-id", "abc123def456"},
+      {"content-type", "application/grpc"},
+  };
+  std::string b1;
+  enc.encode(h, &b1);
+  std::string b2;
+  enc.encode(h, &b2);
+  HeaderList o1, o2;
+  EXPECT(dec.decode(u8(b1), b1.size(), &o1));
+  EXPECT(dec.decode(u8(b2), b2.size(), &o2));
+  EXPECT(o1 == h);
+  EXPECT(o2 == h);
+  EXPECT(b2.size() * 2 < b1.size());  // repeats shrink to index bytes
+  EXPECT(enc.dynamic_size() == 0 || enc.dynamic_size() <= 4096);
+
+  // Flood with distinct entries: the table must bound and evict while
+  // both sides stay aligned.
+  for (int i = 0; i < 500; ++i) {
+    HeaderList hh = {
+        {"x-key-" + std::to_string(i), std::string(40, 'v')}};
+    std::string b;
+    enc.encode(hh, &b);
+    HeaderList oo;
+    EXPECT(dec.decode(u8(b), b.size(), &oo));
+    EXPECT(oo == hh);
+  }
+  EXPECT(enc.dynamic_size() <= 4096);
+  // The original block still roundtrips after the churn evicted it.
+  std::string b3;
+  enc.encode(h, &b3);
+  HeaderList o3;
+  EXPECT(dec.decode(u8(b3), b3.size(), &o3));
+  EXPECT(o3 == h);
+  // Oversized values are never indexed (they would evict everything).
+  HeaderList big = {{"x-big", std::string(8000, 'B')}};
+  std::string bb;
+  enc.encode(big, &bb);
+  HeaderList ob;
+  EXPECT(dec.decode(u8(bb), bb.size(), &ob));
+  EXPECT(ob == big);
+  EXPECT(enc.dynamic_size() <= 4096);
+}
+
 TEST_CASE(hpack_malformed_rejected) {
   HpackDecoder dec;
   HeaderList h;
@@ -804,6 +857,59 @@ TEST_CASE(h2_client_concurrent_multiplex) {
   }
   all.wait(-1);
   EXPECT_EQ(failures.load(), 0);
+}
+
+TEST_CASE(h2_peer_header_table_size_zero) {
+  // A client advertising SETTINGS_HEADER_TABLE_SIZE=0 disables dynamic
+  // indexing: the server must open its next block with a §6.3 size
+  // update and stop emitting dynamic indexes, or a table-less decoder
+  // dies with COMPRESSION_ERROR (RFC 7541 §4.2).
+  start_once();
+  H2TestClient cli;
+  cli.fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in sa = {};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  sa.sin_port = htons(static_cast<uint16_t>(g_port));
+  EXPECT_EQ(connect(cli.fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)),
+            0);
+  std::string settings;
+  settings.append("\x00\x01", 2);  // HEADER_TABLE_SIZE
+  settings.append(4, '\x00');      // = 0
+  std::string wire = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+  wire += fh(static_cast<uint32_t>(settings.size()), 0x4, 0, 0) + settings;
+  HpackEncoder enc;
+  for (uint32_t sid : {1u, 3u}) {  // two rounds: repeats must NOT index
+    HeaderList h = {
+        {":method", "POST"},
+        {":scheme", "http"},
+        {":path", "/Echo.Echo"},
+        {":authority", "t"},
+    };
+    std::string block;
+    enc.encode(h, &block);
+    wire += fh(static_cast<uint32_t>(block.size()), 0x1, 0x4, sid) + block;
+    const std::string body = "tbl0";
+    wire += fh(static_cast<uint32_t>(body.size()), 0x0, 0x1, sid) + body;
+  }
+  EXPECT(cli.send_all(wire));
+  HpackDecoder dec(0);  // the table-less decoder we advertised
+  int done = 0;
+  while (done < 2) {
+    uint8_t type = 0;
+    uint8_t flags = 0;
+    uint32_t sid = 0;
+    std::string payload;
+    EXPECT(cli.read_frame(&type, &flags, &sid, &payload));
+    if (type == 0x1) {
+      HeaderList h;
+      EXPECT(dec.decode(reinterpret_cast<const uint8_t*>(payload.data()),
+                        payload.size(), &h));
+    }
+    if ((type == 0x0 || type == 0x1) && (flags & 0x1) != 0) {
+      ++done;
+    }
+  }
 }
 
 TEST_CASE(h2_client_progressive_reader) {

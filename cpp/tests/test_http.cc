@@ -8,12 +8,15 @@
 #include <atomic>
 #include <string>
 #include <thread>
+#include <vector>
 
 #include "base/time.h"
 #include "fiber/fiber.h"
 #include "net/channel.h"
+#include "net/http_protocol.h"
 #include "net/progressive.h"
 #include "net/server.h"
+#include "stat/heap_profiler.h"
 #include "tests/test_util.h"
 
 using namespace trpc;
@@ -203,6 +206,64 @@ TEST_CASE(transfer_encoding_chunked_must_be_exact) {
       "POST /Echo.Echo HTTP/1.1\r\nHost: x\r\n"
       "Transfer-Encoding:  chunked \r\n\r\n5\r\nabcde\r\n0\r\n\r\n");
   EXPECT(ok.find("200") != std::string::npos);
+}
+
+TEST_CASE(pprof_endpoints) {
+  start_once();
+  // /pprof/profile: legacy binary CPU-profile format — header words
+  // [0, 3, 0, period, 0] — that external pprof tooling parses.
+  {
+    const std::string r = http_get(
+        "GET /pprof/profile?seconds=1 HTTP/1.1\r\nHost: x\r\n\r\n");
+    EXPECT(r.find("200 OK") != std::string::npos);
+    const size_t he = r.find("\r\n\r\n");
+    EXPECT(he != std::string::npos);
+    const char* words = r.data() + he + 4;
+    EXPECT(r.size() - he - 4 >= 8 * sizeof(uintptr_t));
+    uintptr_t w[5];
+    memcpy(w, words, sizeof(w));
+    EXPECT_EQ(w[0], 0u);
+    EXPECT_EQ(w[1], 3u);
+    EXPECT_EQ(w[2], 0u);
+    EXPECT_EQ(w[3], 10000u);  // 100hz → 10ms period
+  }
+  // /pprof/symbol: GET probe + POST address resolution.
+  {
+    std::string r = http_get("GET /pprof/symbol HTTP/1.1\r\nHost: x\r\n\r\n");
+    EXPECT(r.find("num_symbols: 1") != std::string::npos);
+    char addr[32];
+    snprintf(addr, sizeof(addr), "%p",
+             reinterpret_cast<void*>(&builtin_http_dispatch));
+    const std::string body = addr;
+    r = http_get("POST /pprof/symbol HTTP/1.1\r\nHost: x\r\nContent-Length: " +
+                 std::to_string(body.size()) + "\r\n\r\n" + body);
+    EXPECT(r.find("builtin_http_dispatch") != std::string::npos);
+  }
+  // /pprof/cmdline mirrors /proc/self/cmdline.
+  {
+    const std::string r =
+        http_get("GET /pprof/cmdline HTTP/1.1\r\nHost: x\r\n\r\n");
+    EXPECT(r.find("test_http") != std::string::npos);
+  }
+  // /pprof/heap: first call arms the sampler; after allocating enough to
+  // cross sampling periods, the dump carries the gperftools text header
+  // and stack lines.
+  {
+    std::string r = http_get("GET /pprof/heap HTTP/1.1\r\nHost: x\r\n\r\n");
+    EXPECT(r.find("heap sampling enabled") != std::string::npos);
+    std::vector<std::string*> hold;
+    for (int i = 0; i < 64; ++i) {
+      hold.push_back(new std::string(256 * 1024, 'h'));  // cross periods
+    }
+    r = http_get("GET /pprof/heap HTTP/1.1\r\nHost: x\r\n\r\n");
+    EXPECT(r.find("heap profile:") != std::string::npos);
+    EXPECT(r.find("MAPPED_LIBRARIES:") != std::string::npos);
+    EXPECT(r.find(" @ ") != std::string::npos);  // at least one stack row
+    for (auto* s : hold) {
+      delete s;
+    }
+    heap_profiler_stop();
+  }
 }
 
 namespace {
