@@ -1,0 +1,111 @@
+// h2_grpc — the same server speaks tstd, HTTP/1.1, h2 and gRPC on ONE
+// port; this example drives it with our own h2 and gRPC clients,
+// including a progressive (streaming-read) response consumer (parity:
+// example/grpc_c++ + http_c++).
+//
+// Run: ./build/example_h2_grpc
+#include <cstdio>
+#include <string>
+
+#include "net/channel.h"
+#include "net/progressive.h"
+#include "net/server.h"
+
+using namespace trpc;
+
+namespace {
+
+// Collects a progressive response piece by piece (net/progressive.h).
+class PartCounter : public ProgressiveReader {
+ public:
+  bool on_part(const IOBuf& piece) override {
+    ++parts_;
+    bytes_ += piece.size();
+    return true;  // false would cancel the stream
+  }
+  void on_done(int error_code, const std::string&) override {
+    printf("progressive read done: %d parts, %zu bytes, rc=%d\n", parts_,
+           bytes_, error_code);
+  }
+  int parts() const { return parts_; }
+  size_t bytes() const { return bytes_; }
+
+ private:
+  int parts_ = 0;
+  size_t bytes_ = 0;
+};
+
+}  // namespace
+
+int main() {
+  Server server;
+  server.RegisterMethod("Greeter.Hello", [](Controller*, const IOBuf& req,
+                                            IOBuf* resp, Closure done) {
+    resp->append("hello, " + req.to_string());
+    done();
+  });
+  server.RegisterMethod("Blob.Get", [](Controller*, const IOBuf&,
+                                       IOBuf* resp, Closure done) {
+    resp->append(std::string(1 << 20, 'B'));  // 1MB: many DATA frames
+    done();
+  });
+  if (server.Start(0) != 0) {
+    return 1;
+  }
+  const std::string addr = "127.0.0.1:" + std::to_string(server.port());
+
+  // Plain h2: response body = payload, HTTP status surfaces errors.
+  {
+    Channel h2;
+    Channel::Options opts;
+    opts.protocol = "h2";
+    h2.Init(addr, &opts);
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("h2-world");
+    h2.CallMethod("Greeter.Hello", req, &resp, &cntl);
+    printf("h2   : %s\n", cntl.Failed() ? cntl.error_text().c_str()
+                                        : resp.to_string().c_str());
+    if (cntl.Failed()) {
+      return 1;
+    }
+  }
+  // gRPC: length-prefixed framing, grpc-status in trailers; unknown
+  // methods come back as UNIMPLEMENTED, not a transport error.
+  {
+    Channel grpc;
+    Channel::Options opts;
+    opts.protocol = "grpc";
+    grpc.Init(addr, &opts);
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("grpc-world");
+    grpc.CallMethod("Greeter.Hello", req, &resp, &cntl);
+    printf("grpc : %s\n", cntl.Failed() ? cntl.error_text().c_str()
+                                        : resp.to_string().c_str());
+    if (cntl.Failed()) {
+      return 1;
+    }
+  }
+  // Progressive read over h2: 1MB arrives as ~64 flow-controlled DATA
+  // frames, each handed to the reader instead of accumulating.
+  {
+    Channel h2;
+    Channel::Options opts;
+    opts.protocol = "h2";
+    opts.timeout_ms = 5000;
+    h2.Init(addr, &opts);
+    PartCounter reader;
+    Controller cntl;
+    cntl.ReadProgressively(&reader);
+    IOBuf req, resp;
+    h2.CallMethod("Blob.Get", req, &resp, &cntl);
+    if (cntl.Failed() || reader.bytes() != (1u << 20) ||
+        reader.parts() < 2) {
+      fprintf(stderr, "progressive read failed\n");
+      return 1;
+    }
+  }
+  printf("ok\n");
+  return 0;
+}

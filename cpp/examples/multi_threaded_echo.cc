@@ -1,0 +1,96 @@
+// multi_threaded_echo — N fibers hammer one server through a shared
+// channel and report qps + latency percentiles (parity:
+// example/multi_threaded_echo_c++, the reference's benchmark staple).
+//
+// Run: ./build/example_multi_threaded_echo [fibers=32] [seconds=2]
+#include <algorithm>
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "net/channel.h"
+#include "net/server.h"
+
+using namespace trpc;
+
+namespace {
+
+struct WorkerArgs {
+  Channel* channel;
+  int64_t stop_us;
+  std::atomic<long>* ok;
+  std::atomic<long>* failed;
+  std::vector<int64_t>* latencies;  // per-worker, merged at the end
+};
+
+void worker(void* arg) {
+  auto* a = static_cast<WorkerArgs*>(arg);
+  IOBuf request;
+  request.append(std::string(1024, 'e'));
+  while (monotonic_time_us() < a->stop_us) {
+    Controller cntl;
+    cntl.set_timeout_ms(1000);
+    IOBuf response;
+    const int64_t t0 = monotonic_time_us();
+    a->channel->CallMethod("Echo.Echo", request, &response, &cntl);
+    if (cntl.Failed()) {
+      a->failed->fetch_add(1);
+    } else {
+      a->ok->fetch_add(1);
+      a->latencies->push_back(monotonic_time_us() - t0);
+    }
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const int fibers = argc > 1 ? atoi(argv[1]) : 32;
+  const int seconds = argc > 2 ? atoi(argv[2]) : 2;
+
+  Server server;
+  server.RegisterMethod("Echo.Echo", [](Controller*, const IOBuf& req,
+                                        IOBuf* resp, Closure done) {
+    resp->append(req);
+    done();
+  });
+  if (server.Start(0) != 0) {
+    return 1;
+  }
+  Channel channel;
+  channel.Init("127.0.0.1:" + std::to_string(server.port()));
+
+  std::atomic<long> ok{0};
+  std::atomic<long> failed{0};
+  std::vector<std::vector<int64_t>> lats(fibers);
+  std::vector<WorkerArgs> args(fibers);
+  std::vector<fiber_t> ids(fibers);
+  const int64_t t0 = monotonic_time_us();
+  const int64_t stop = t0 + seconds * 1000000LL;
+  for (int i = 0; i < fibers; ++i) {
+    args[i] = {&channel, stop, &ok, &failed, &lats[i]};
+    fiber_start(&ids[i], worker, &args[i]);
+  }
+  for (fiber_t f : ids) {
+    fiber_join(f);
+  }
+  const double secs = (monotonic_time_us() - t0) / 1e6;
+
+  std::vector<int64_t> all;
+  for (auto& v : lats) {
+    all.insert(all.end(), v.begin(), v.end());
+  }
+  std::sort(all.begin(), all.end());
+  auto pct = [&](double p) {
+    return all.empty()
+               ? 0ll
+               : static_cast<long long>(all[std::min(
+                     all.size() - 1, static_cast<size_t>(p * all.size()))]);
+  };
+  printf("fibers=%d qps=%.0f p50=%lldus p99=%lldus failures=%ld\n", fibers,
+         ok.load() / secs, pct(0.5), pct(0.99), failed.load());
+  return failed.load() == 0 ? 0 : 1;
+}

@@ -1,0 +1,27 @@
+#!/bin/sh
+# Smoke-runs every example binary; any nonzero exit fails the run, and
+# finding NO binaries fails too (a stale build tree must not pass
+# vacuously — the example targets come from a cmake GLOB that needs a
+# reconfigure after adding files).
+# Usage: examples/run_all.sh <build-dir>
+set -e
+BUILD="${1:-build}"
+status=0
+count=0
+for exe in "$BUILD"/example_*; do
+  [ -x "$exe" ] || continue
+  count=$((count + 1))
+  name=$(basename "$exe")
+  if out=$("$exe" 2>&1); then
+    echo "PASS $name"
+  else
+    echo "FAIL $name"
+    echo "$out" | tail -20
+    status=1
+  fi
+done
+if [ "$count" -eq 0 ]; then
+  echo "FAIL no example binaries found in $BUILD (stale configure?)"
+  status=1
+fi
+exit $status

@@ -1,0 +1,83 @@
+// streaming — establish a stream over a normal RPC, upload ordered
+// chunks under credit-window flow control, then close (parity:
+// example/streaming_echo_c++; the tstd long-payload path).
+//
+// Run: ./build/example_streaming
+#include <atomic>
+#include <cstdio>
+
+#include "fiber/sync.h"
+#include "net/channel.h"
+#include "net/server.h"
+#include "net/stream.h"
+
+using namespace trpc;
+
+namespace {
+std::atomic<int64_t> g_received_bytes{0};
+std::atomic<int> g_received_chunks{0};
+CountdownEvent g_closed(1);
+}  // namespace
+
+int main() {
+  Server server;
+  // The stream is OFFERED by the client inside an ordinary call; the
+  // handler ACCEPTS it and installs message/close callbacks.
+  server.RegisterMethod("Upload.Open", [](Controller* cntl, const IOBuf&,
+                                          IOBuf* resp, Closure done) {
+    StreamOptions opts;
+    opts.on_message = [](StreamId, IOBuf&& chunk) {
+      g_received_bytes.fetch_add(chunk.size());
+      g_received_chunks.fetch_add(1);
+    };
+    opts.on_closed = [](StreamId sid) {
+      g_closed.signal();
+      StreamClose(sid);  // close our half too
+    };
+    StreamId sid = 0;
+    if (StreamAccept(&sid, cntl, opts) != 0) {
+      cntl->SetFailed(EINVAL, "no stream offered");
+    } else {
+      resp->append("accepted");
+    }
+    done();
+  });
+  if (server.Start(0) != 0) {
+    return 1;
+  }
+  Channel channel;
+  channel.Init("127.0.0.1:" + std::to_string(server.port()));
+
+  // Client side: create the stream against the controller, then make the
+  // call that carries the offer.
+  Controller cntl;
+  cntl.set_timeout_ms(2000);
+  StreamId stream = 0;
+  StreamOptions client_opts;  // upload-only: no on_message needed
+  if (StreamCreate(&stream, &cntl, client_opts) != 0) {
+    return 1;
+  }
+  IOBuf request, response;
+  channel.CallMethod("Upload.Open", request, &response, &cntl);
+  if (cntl.Failed()) {
+    fprintf(stderr, "open failed: %s\n", cntl.error_text().c_str());
+    return 1;
+  }
+
+  // Write 64 x 64KB; StreamWrite blocks (parks the fiber) when the
+  // receiver's credit window is exhausted — built-in backpressure.
+  for (int i = 0; i < 64; ++i) {
+    IOBuf chunk;
+    chunk.append(std::string(64 * 1024, static_cast<char>('a' + i % 26)));
+    if (StreamWrite(stream, std::move(chunk)) != 0) {
+      fprintf(stderr, "stream write failed\n");
+      return 1;
+    }
+  }
+  StreamClose(stream);
+  g_closed.wait(-1);
+  printf("uploaded %d chunks, %lld bytes; server saw them in order\n",
+         g_received_chunks.load(),
+         static_cast<long long>(g_received_bytes.load()));
+  return g_received_bytes.load() == 64ll * 64 * 1024 ? 0 : 1;
+}
