@@ -121,14 +121,17 @@ def _child_sweep(sizes: list[int]) -> None:
         # Goodput: marginal cost between a short and a long chained run.
         # Both runs pay the same constant tunnel-sync cost; the difference
         # is (n2 - n1) genuinely-executed, data-dependent iterations.
-        # Fixed lengths (calibrating from one sample lets a single jitter
-        # spike shrink the long run) with min-of-2 per length to shed
-        # spikes; worst case per-iter cost is ~0.4ms (64MB) so the long
-        # run stays under a second.
-        n1, n2 = 16, 1024
+        # min-of-2 per length sheds jitter spikes; n2 is sized from the
+        # short runs' own marginal estimate so a slow backend (CPU
+        # fallback at 64MB is ~30ms/iter) stays inside the row deadline —
+        # an inflated estimate only shrinks n2, which is the safe
+        # direction.
+        n1 = 16
         t_a, resp = chained(step, resp, n1)
         t_a2, resp = chained(step, resp, n1)
         t_a = min(t_a, t_a2)
+        marg_est = max((t_a - probe) / n1, 1e-5)
+        n2 = max(4 * n1, min(1024, int(8.0 / marg_est)))
         t_b, resp = chained(step, resp, n2)
         t_b2, resp = chained(step, resp, n2)
         t_b = min(t_b, t_b2)

@@ -1,0 +1,493 @@
+#include "base/pbwire.h"
+
+#include <cstring>
+
+namespace trpc {
+
+// ---- primitives ----------------------------------------------------------
+
+void pb_put_varint(std::string* out, uint64_t v) {
+  while (v >= 0x80) {
+    out->push_back(static_cast<char>((v & 0x7f) | 0x80));
+    v >>= 7;
+  }
+  out->push_back(static_cast<char>(v));
+}
+
+void pb_put_tag(std::string* out, uint32_t field, uint32_t wire_type) {
+  pb_put_varint(out, (static_cast<uint64_t>(field) << 3) | wire_type);
+}
+
+uint64_t pb_zigzag(int64_t v) {
+  return (static_cast<uint64_t>(v) << 1) ^
+         static_cast<uint64_t>(v >> 63);
+}
+
+int64_t pb_unzigzag(uint64_t v) {
+  return static_cast<int64_t>((v >> 1) ^ (~(v & 1) + 1));
+}
+
+bool pb_get_varint(std::string_view in, size_t* pos, uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  size_t p = *pos;
+  while (p < in.size() && shift < 70) {
+    uint8_t b = static_cast<uint8_t>(in[p++]);
+    if (shift == 63 && (b & 0x7e) != 0) return false;  // overflows u64
+    v |= static_cast<uint64_t>(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      *pos = p;
+      *out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;  // truncated or > 10 bytes
+}
+
+static void put_fixed32(std::string* out, uint32_t v) {
+  char b[4];
+  std::memcpy(b, &v, 4);  // wire is little-endian == host on x86_64
+  out->append(b, 4);
+}
+
+static void put_fixed64(std::string* out, uint64_t v) {
+  char b[8];
+  std::memcpy(b, &v, 8);
+  out->append(b, 8);
+}
+
+// ---- PbMessage build side ------------------------------------------------
+
+void PbMessage::add_varint(uint32_t field, uint64_t v) {
+  PbField f;
+  f.num = field;
+  f.wire = PbField::kVarint;
+  f.varint = v;
+  fields_.push_back(std::move(f));
+}
+
+void PbMessage::add_sint(uint32_t field, int64_t v) {
+  add_varint(field, pb_zigzag(v));
+}
+
+void PbMessage::add_fixed32(uint32_t field, uint32_t v) {
+  PbField f;
+  f.num = field;
+  f.wire = PbField::kFixed32;
+  f.varint = v;
+  fields_.push_back(std::move(f));
+}
+
+void PbMessage::add_fixed64(uint32_t field, uint64_t v) {
+  PbField f;
+  f.num = field;
+  f.wire = PbField::kFixed64;
+  f.varint = v;
+  fields_.push_back(std::move(f));
+}
+
+void PbMessage::add_double(uint32_t field, double v) {
+  uint64_t bits;
+  std::memcpy(&bits, &v, 8);
+  add_fixed64(field, bits);
+}
+
+void PbMessage::add_float(uint32_t field, float v) {
+  uint32_t bits;
+  std::memcpy(&bits, &v, 4);
+  add_fixed32(field, bits);
+}
+
+void PbMessage::add_bytes(uint32_t field, std::string_view v) {
+  PbField f;
+  f.num = field;
+  f.wire = PbField::kBytes;
+  f.bytes.assign(v.data(), v.size());
+  fields_.push_back(std::move(f));
+}
+
+void PbMessage::add_message(uint32_t field, const PbMessage& m) {
+  add_bytes(field, m.serialize());
+}
+
+// ---- PbMessage read side -------------------------------------------------
+
+static const PbField* first(const std::vector<PbField>& fields,
+                            uint32_t num) {
+  for (const PbField& f : fields) {
+    if (f.num == num) return &f;
+  }
+  return nullptr;
+}
+
+bool PbMessage::has(uint32_t field) const {
+  return first(fields_, field) != nullptr;
+}
+
+uint64_t PbMessage::get_varint(uint32_t field, uint64_t def) const {
+  const PbField* f = first(fields_, field);
+  return (f && f->wire != PbField::kBytes) ? f->varint : def;
+}
+
+int64_t PbMessage::get_sint(uint32_t field, int64_t def) const {
+  const PbField* f = first(fields_, field);
+  return (f && f->wire != PbField::kBytes) ? pb_unzigzag(f->varint) : def;
+}
+
+uint64_t PbMessage::get_fixed(uint32_t field, uint64_t def) const {
+  return get_varint(field, def);
+}
+
+double PbMessage::get_double(uint32_t field, double def) const {
+  const PbField* f = first(fields_, field);
+  if (!f || f->wire != PbField::kFixed64) return def;
+  double d;
+  uint64_t bits = f->varint;
+  std::memcpy(&d, &bits, 8);
+  return d;
+}
+
+std::string_view PbMessage::get_bytes(uint32_t field,
+                                      std::string_view def) const {
+  const PbField* f = first(fields_, field);
+  return (f && f->wire == PbField::kBytes) ? std::string_view(f->bytes)
+                                           : def;
+}
+
+bool PbMessage::get_message(uint32_t field, PbMessage* out) const {
+  const PbField* f = first(fields_, field);
+  if (!f || f->wire != PbField::kBytes) return false;
+  return out->parse(f->bytes);
+}
+
+std::vector<const PbField*> PbMessage::all(uint32_t field) const {
+  std::vector<const PbField*> out;
+  for (const PbField& f : fields_) {
+    if (f.num == field) out.push_back(&f);
+  }
+  return out;
+}
+
+void PbMessage::serialize(std::string* out) const {
+  for (const PbField& f : fields_) {
+    pb_put_tag(out, f.num, f.wire);
+    switch (f.wire) {
+      case PbField::kVarint:
+        pb_put_varint(out, f.varint);
+        break;
+      case PbField::kFixed64:
+        put_fixed64(out, f.varint);
+        break;
+      case PbField::kFixed32:
+        put_fixed32(out, static_cast<uint32_t>(f.varint));
+        break;
+      case PbField::kBytes:
+        pb_put_varint(out, f.bytes.size());
+        out->append(f.bytes);
+        break;
+    }
+  }
+}
+
+std::string PbMessage::serialize() const {
+  std::string out;
+  serialize(&out);
+  return out;
+}
+
+bool PbMessage::parse(std::string_view in) {
+  fields_.clear();
+  size_t pos = 0;
+  while (pos < in.size()) {
+    uint64_t key;
+    if (!pb_get_varint(in, &pos, &key)) return false;
+    uint32_t num = static_cast<uint32_t>(key >> 3);
+    uint32_t wt = static_cast<uint32_t>(key & 7);
+    if (num == 0) return false;  // field 0 is reserved/invalid
+    PbField f;
+    f.num = num;
+    switch (wt) {
+      case 0: {
+        f.wire = PbField::kVarint;
+        if (!pb_get_varint(in, &pos, &f.varint)) return false;
+        break;
+      }
+      case 1: {
+        f.wire = PbField::kFixed64;
+        if (pos + 8 > in.size()) return false;
+        uint64_t v;
+        std::memcpy(&v, in.data() + pos, 8);
+        f.varint = v;
+        pos += 8;
+        break;
+      }
+      case 2: {
+        f.wire = PbField::kBytes;
+        uint64_t len;
+        if (!pb_get_varint(in, &pos, &len)) return false;
+        if (len > in.size() - pos) return false;
+        f.bytes.assign(in.data() + pos, len);
+        pos += len;
+        break;
+      }
+      case 5: {
+        f.wire = PbField::kFixed32;
+        if (pos + 4 > in.size()) return false;
+        uint32_t v;
+        std::memcpy(&v, in.data() + pos, 4);
+        f.varint = v;
+        pos += 4;
+        break;
+      }
+      default:
+        return false;  // groups (3/4) and invalid types rejected
+    }
+    fields_.push_back(std::move(f));
+  }
+  return true;
+}
+
+// ---- schema --------------------------------------------------------------
+
+const PbSchema::Field* PbSchema::by_num(uint32_t num) const {
+  for (const Field& f : fields) {
+    if (f.num == num) return &f;
+  }
+  return nullptr;
+}
+
+const PbSchema::Field* PbSchema::by_name(std::string_view name) const {
+  for (const Field& f : fields) {
+    if (name == f.name) return &f;
+  }
+  return nullptr;
+}
+
+// ---- JSON transcoding ----------------------------------------------------
+
+static const char kHex[] = "0123456789abcdef";
+
+static std::string to_hex(std::string_view in) {
+  std::string out;
+  out.reserve(in.size() * 2);
+  for (unsigned char c : in) {
+    out.push_back(kHex[c >> 4]);
+    out.push_back(kHex[c & 15]);
+  }
+  return out;
+}
+
+static bool from_hex(std::string_view in, std::string* out) {
+  if (in.size() % 2) return false;
+  out->clear();
+  out->reserve(in.size() / 2);
+  for (size_t i = 0; i < in.size(); i += 2) {
+    auto nib = [](char c) -> int {
+      if (c >= '0' && c <= '9') return c - '0';
+      if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+      if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+      return -1;
+    };
+    int hi = nib(in[i]), lo = nib(in[i + 1]);
+    if (hi < 0 || lo < 0) return false;
+    out->push_back(static_cast<char>((hi << 4) | lo));
+  }
+  return true;
+}
+
+static Json field_to_json(const PbField& f, const PbSchema::Field& sf) {
+  switch (sf.kind) {
+    case PbSchema::kInt64:
+      return Json::number(
+          static_cast<double>(static_cast<int64_t>(f.varint)));
+    case PbSchema::kUint64:
+    case PbSchema::kFixed32:
+    case PbSchema::kFixed64:
+      return Json::number(static_cast<double>(f.varint));
+    case PbSchema::kSint64:
+      return Json::number(static_cast<double>(pb_unzigzag(f.varint)));
+    case PbSchema::kBool:
+      return Json::boolean(f.varint != 0);
+    case PbSchema::kString:
+      return Json::str(f.bytes);
+    case PbSchema::kBytesHex:
+      return Json::str(to_hex(f.bytes));
+    case PbSchema::kDouble: {
+      double d;
+      uint64_t bits = f.varint;
+      std::memcpy(&d, &bits, 8);
+      return Json::number(d);
+    }
+    case PbSchema::kFloat: {
+      float fl;
+      uint32_t bits = static_cast<uint32_t>(f.varint);
+      std::memcpy(&fl, &bits, 4);
+      return Json::number(fl);
+    }
+    case PbSchema::kMessage: {
+      PbMessage nested;
+      if (sf.nested && nested.parse(f.bytes)) {
+        return pb_to_json(nested, *sf.nested);
+      }
+      return Json::str(to_hex(f.bytes));
+    }
+  }
+  return Json::null();
+}
+
+Json pb_to_json(const PbMessage& msg, const PbSchema& schema) {
+  Json out = Json::object();
+  // Repeated fields accumulate in a staging map (appending through the
+  // object would copy the growing array per occurrence — quadratic).
+  std::map<std::string, Json> arrays;
+  for (const PbField& f : msg.fields()) {
+    const PbSchema::Field* sf = schema.by_num(f.num);
+    if (!sf) {  // unknown field: keep under its number, best effort
+      std::string key = std::to_string(f.num);
+      if (f.wire == PbField::kBytes) {
+        out.set(key, Json::str(to_hex(f.bytes)));
+      } else {
+        out.set(key, Json::number(static_cast<double>(f.varint)));
+      }
+      continue;
+    }
+    Json v = field_to_json(f, *sf);
+    if (sf->repeated) {
+      Json& slot = arrays.try_emplace(sf->name, Json::array()).first->second;
+      slot.push_back(std::move(v));
+    } else {
+      out.set(sf->name, std::move(v));
+    }
+  }
+  for (auto& [name, arr] : arrays) {
+    out.set(name, std::move(arr));
+  }
+  return out;
+}
+
+static bool json_value_to_field(const Json& v, const PbSchema::Field& sf,
+                                PbMessage* out) {
+  switch (sf.kind) {
+    case PbSchema::kInt64:
+      if (v.type() != Json::Type::kNumber) return false;
+      out->add_varint(sf.num,
+                      static_cast<uint64_t>(
+                          static_cast<int64_t>(v.as_number())));
+      return true;
+    case PbSchema::kUint64:
+      if (v.type() != Json::Type::kNumber) return false;
+      out->add_varint(sf.num, static_cast<uint64_t>(v.as_number()));
+      return true;
+    case PbSchema::kSint64:
+      if (v.type() != Json::Type::kNumber) return false;
+      out->add_sint(sf.num, static_cast<int64_t>(v.as_number()));
+      return true;
+    case PbSchema::kBool:
+      if (v.type() != Json::Type::kBool) return false;
+      out->add_bool(sf.num, v.as_bool());
+      return true;
+    case PbSchema::kString:
+      if (v.type() != Json::Type::kString) return false;
+      out->add_bytes(sf.num, v.as_string());
+      return true;
+    case PbSchema::kBytesHex: {
+      if (v.type() != Json::Type::kString) return false;
+      std::string raw;
+      if (!from_hex(v.as_string(), &raw)) return false;
+      out->add_bytes(sf.num, raw);
+      return true;
+    }
+    case PbSchema::kDouble:
+      if (v.type() != Json::Type::kNumber) return false;
+      out->add_double(sf.num, v.as_number());
+      return true;
+    case PbSchema::kFloat:
+      if (v.type() != Json::Type::kNumber) return false;
+      out->add_float(sf.num, static_cast<float>(v.as_number()));
+      return true;
+    case PbSchema::kFixed32:
+      if (v.type() != Json::Type::kNumber) return false;
+      out->add_fixed32(sf.num, static_cast<uint32_t>(v.as_number()));
+      return true;
+    case PbSchema::kFixed64:
+      if (v.type() != Json::Type::kNumber) return false;
+      out->add_fixed64(sf.num, static_cast<uint64_t>(v.as_number()));
+      return true;
+    case PbSchema::kMessage: {
+      if (v.type() != Json::Type::kObject || !sf.nested) return false;
+      PbMessage nested;
+      if (!json_to_pb(v, *sf.nested, &nested)) return false;
+      out->add_message(sf.num, nested);
+      return true;
+    }
+  }
+  return false;
+}
+
+bool json_to_pb(const Json& j, const PbSchema& schema, PbMessage* out) {
+  if (j.type() != Json::Type::kObject) return false;
+  for (const auto& [key, val] : j.items()) {
+    const PbSchema::Field* sf = schema.by_name(key);
+    if (!sf) continue;  // unknown keys ignored (json2pb behavior)
+    if (sf->repeated && val.type() == Json::Type::kArray) {
+      for (size_t i = 0; i < val.size(); ++i) {
+        if (!json_value_to_field(val[i], *sf, out)) return false;
+      }
+    } else if (!json_value_to_field(val, *sf, out)) {
+      return false;
+    }
+  }
+  return true;
+}
+
+static bool mostly_printable(std::string_view s) {
+  if (s.empty()) return true;
+  size_t printable = 0;
+  for (unsigned char c : s) {
+    if (c == '\t' || c == '\n' || (c >= 0x20 && c < 0x7f)) ++printable;
+  }
+  return printable * 10 >= s.size() * 9;  // >= 90%
+}
+
+Json pb_to_json_schemaless(const PbMessage& msg, int max_depth) {
+  Json out = Json::object();
+  // Stage per-number value lists first (linear), then emit scalars for
+  // single occurrences and arrays for repeats.
+  std::map<std::string, std::vector<Json>> staged;
+  for (const PbField& f : msg.fields()) {
+    std::string key = std::to_string(f.num);
+    Json v;
+    if (f.wire == PbField::kBytes) {
+      PbMessage nested;
+      // Heuristic order matters: short printable buffers often ALSO parse
+      // as messages ("hi" = field 13 varint 105), so printable wins, then
+      // the nested-message attempt, then hex.
+      if (mostly_printable(f.bytes)) {
+        v = Json::str(f.bytes);
+      } else if (max_depth > 0 && !f.bytes.empty() &&
+                 nested.parse(f.bytes)) {
+        v = pb_to_json_schemaless(nested, max_depth - 1);
+      } else {
+        v = Json::str(to_hex(f.bytes));
+      }
+    } else {
+      v = Json::number(static_cast<double>(f.varint));
+    }
+    staged[key].push_back(std::move(v));
+  }
+  for (auto& [key, vals] : staged) {
+    if (vals.size() == 1) {
+      out.set(key, std::move(vals[0]));
+    } else {
+      Json arr = Json::array();
+      for (Json& v : vals) {
+        arr.push_back(std::move(v));
+      }
+      out.set(key, std::move(arr));
+    }
+  }
+  return out;
+}
+
+}  // namespace trpc

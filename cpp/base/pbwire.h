@@ -1,0 +1,146 @@
+// Protobuf wire-format codec — schema-free message model + JSON transcoding.
+//
+// Parity role: the reference's interop protocols carry protobuf metas on the
+// wire (policy/hulu_pbrpc_meta.proto, sofa_pbrpc_meta.proto,
+// public_pbrpc_meta.proto, baidu_rpc_meta.proto) and its json2pb module
+// (/root/reference/src/json2pb/, 2,068 LoC) transcodes pb⇄json through
+// generated descriptors.  This runtime is deliberately protobuf-free, so the
+// equivalent seam is a hand-rolled wire codec: PbMessage models an encoded
+// message as an ordered field list (numbers + wire types, no descriptor),
+// letting protocols build and read byte-compatible metas, and PbSchema is a
+// lightweight runtime descriptor that names fields for proper JSON
+// transcoding both directions (the json2pb replacement — no codegen).
+//
+// Wire format implemented per the public protobuf encoding spec:
+// varint / zigzag sint / fixed32 / fixed64 / length-delimited, tags
+// (field_number << 3) | wire_type.  Groups (deprecated wire types 3/4) are
+// rejected.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <string_view>
+#include <vector>
+
+#include "base/json.h"
+
+namespace trpc {
+
+// ---- primitive encoding (exposed for protocol packers + tests) -----------
+
+void pb_put_varint(std::string* out, uint64_t v);
+void pb_put_tag(std::string* out, uint32_t field, uint32_t wire_type);
+uint64_t pb_zigzag(int64_t v);    // sint encoding
+int64_t pb_unzigzag(uint64_t v);
+
+// Reads one varint at (*pos); false on truncation/overlong (>10 bytes).
+bool pb_get_varint(std::string_view in, size_t* pos, uint64_t* out);
+
+// ---- message model -------------------------------------------------------
+
+// One decoded/buildable field.  `wire` distinguishes how the value is
+// encoded; accessors on PbMessage interpret it.
+struct PbField {
+  enum Wire : uint8_t {
+    kVarint = 0,
+    kFixed64 = 1,
+    kBytes = 2,   // length-delimited: strings, bytes, nested messages
+    kFixed32 = 5,
+  };
+  uint32_t num = 0;
+  Wire wire = kVarint;
+  uint64_t varint = 0;   // kVarint / kFixed32 / kFixed64 payload
+  std::string bytes;     // kBytes payload
+};
+
+// An encoded-message view: fields in wire order, repeated numbers kept.
+// Build-side helpers append; read-side helpers return the FIRST match
+// (proto2 semantics for scalars are "last wins" on merge, but metas here
+// never repeat scalar fields — all() exposes every occurrence for the
+// cases that do repeat).
+class PbMessage {
+ public:
+  // Build side.
+  void add_varint(uint32_t field, uint64_t v);
+  void add_sint(uint32_t field, int64_t v);       // zigzag
+  void add_bool(uint32_t field, bool v) { add_varint(field, v ? 1 : 0); }
+  void add_fixed32(uint32_t field, uint32_t v);
+  void add_fixed64(uint32_t field, uint64_t v);
+  void add_double(uint32_t field, double v);
+  void add_float(uint32_t field, float v);
+  void add_bytes(uint32_t field, std::string_view v);
+  void add_message(uint32_t field, const PbMessage& m);
+
+  // Read side (first occurrence; `def` when absent).
+  bool has(uint32_t field) const;
+  uint64_t get_varint(uint32_t field, uint64_t def = 0) const;
+  int64_t get_sint(uint32_t field, int64_t def = 0) const;
+  bool get_bool(uint32_t field, bool def = false) const {
+    return get_varint(field, def ? 1 : 0) != 0;
+  }
+  uint64_t get_fixed(uint32_t field, uint64_t def = 0) const;
+  double get_double(uint32_t field, double def = 0) const;
+  std::string_view get_bytes(uint32_t field,
+                             std::string_view def = {}) const;
+  // Parses the first occurrence of `field` as a nested message.
+  bool get_message(uint32_t field, PbMessage* out) const;
+  std::vector<const PbField*> all(uint32_t field) const;
+
+  const std::vector<PbField>& fields() const { return fields_; }
+
+  void serialize(std::string* out) const;
+  std::string serialize() const;
+  // Strict parse of the whole buffer; false on malformed input.  Depth
+  // does not apply here (nested messages stay as bytes until
+  // get_message), so arbitrarily deep inputs cost nothing until walked.
+  bool parse(std::string_view in);
+
+ private:
+  std::vector<PbField> fields_;
+};
+
+// ---- JSON transcoding (the json2pb seam) ---------------------------------
+
+// A lightweight runtime descriptor: names + kinds per field number, for
+// schema'd transcoding.  Nested message fields point at another schema.
+struct PbSchema {
+  enum Kind : uint8_t {
+    kInt64,     // varint, signed two's-complement (int32/int64)
+    kUint64,    // varint, unsigned
+    kSint64,    // varint, zigzag
+    kBool,
+    kString,
+    kBytesHex,  // bytes rendered as lowercase hex in JSON
+    kDouble,    // fixed64
+    kFloat,     // fixed32
+    kFixed32,
+    kFixed64,
+    kMessage,
+  };
+  struct Field {
+    uint32_t num;
+    const char* name;
+    Kind kind;
+    const PbSchema* nested = nullptr;  // kMessage only
+    bool repeated = false;
+  };
+  std::vector<Field> fields;
+
+  const Field* by_num(uint32_t num) const;
+  const Field* by_name(std::string_view name) const;
+};
+
+// Schema'd transcodes.  Unknown fields (not in the schema) are emitted
+// under their number as a string key with a best-effort value, so nothing
+// is silently dropped.
+Json pb_to_json(const PbMessage& msg, const PbSchema& schema);
+// Builds a message from JSON per the schema; false if a value's JSON type
+// cannot encode as its field's kind.  Keys not in the schema are ignored.
+bool json_to_pb(const Json& j, const PbSchema& schema, PbMessage* out);
+
+// Schema-less transcode: field numbers become keys; length-delimited
+// payloads that parse cleanly as messages recurse, printable ones become
+// strings, the rest hex.  The /protobufs-style debugging view.
+Json pb_to_json_schemaless(const PbMessage& msg, int max_depth = 8);
+
+}  // namespace trpc

@@ -20,6 +20,7 @@
 #include "net/h2_protocol.h"
 #include "net/http_protocol.h"
 #include "net/redis.h"
+#include "net/thrift.h"
 #include "net/tls.h"
 #include "net/messenger.h"
 #include "net/shm_transport.h"
@@ -206,6 +207,9 @@ int Server::Start(int port) {
   tstd_protocol();  // ensure registered (first: most traffic is RPC)
   register_http_protocol();
   register_h2_protocol();
+  if (thrift_service_ != nullptr) {
+    register_thrift_protocol();
+  }
   if (redis_service_ != nullptr) {
     register_redis_protocol();
   }

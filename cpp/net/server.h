@@ -26,7 +26,8 @@
 
 namespace trpc {
 
-class RedisService;  // net/redis.h
+class RedisService;   // net/redis.h
+class ThriftService;  // net/thrift.h
 
 class Server {
  public:
@@ -71,6 +72,12 @@ class Server {
   // redis.h:194).  Not owned.  Call before Start.
   void set_redis_service(RedisService* rs) { redis_service_ = rs; }
   RedisService* redis_service() const { return redis_service_; }
+
+  // Makes this server speak framed thrift (TBinaryProtocol) on its port
+  // (net/thrift.h; parity: ServerOptions::thrift_service,
+  // thrift_service.h).  Not owned.  Call before Start.
+  void set_thrift_service(ThriftService* ts) { thrift_service_ = ts; }
+  ThriftService* thrift_service() const { return thrift_service_; }
 
   // Serves TLS on this server's port (net/tls.h; parity: ServerOptions::
   // mutable_ssl_options, details/ssl_helper.cpp).  Plaintext clients KEEP
@@ -147,6 +154,7 @@ class Server {
   const Authenticator* auth_ = nullptr;
   Interceptor interceptor_;
   RedisService* redis_service_ = nullptr;
+  ThriftService* thrift_service_ = nullptr;
   void* tls_ctx_ = nullptr;  // SSL_CTX (leaked singleton; net/tls.h)
   FlatMap<std::string, MethodProperty> methods_;
   // (pattern segments, trailing-wildcard, method name), longest first.

@@ -1,0 +1,833 @@
+#include "net/thrift.h"
+
+#include <errno.h>
+
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+
+#include "base/logging.h"
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "net/messenger.h"
+#include "net/protocol.h"
+#include "net/server.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr uint32_t kVersion1 = 0x80010000u;
+constexpr uint32_t kVersionMask = 0xffff0000u;
+constexpr size_t kMaxFrame = 64ull << 20;
+constexpr size_t kMaxMethod = 1024;
+constexpr size_t kMaxElements = 1 << 20;
+constexpr int kMaxDepth = 32;
+// Total decoded values per message: each ThriftValue costs ~150 host
+// bytes, so per-container caps alone allow ~128x amplification from one
+// pre-auth frame (a 64MB frame of 1-byte elements -> ~9GB).  The global
+// budget bounds decode memory to ~150MB worst case.
+constexpr size_t kMaxTotalValues = 1 << 20;
+
+void put_u8(std::string* out, uint8_t v) {
+  out->push_back(static_cast<char>(v));
+}
+
+void put_u16(std::string* out, uint16_t v) {
+  out->push_back(static_cast<char>(v >> 8));
+  out->push_back(static_cast<char>(v));
+}
+
+void put_u32(std::string* out, uint32_t v) {
+  out->push_back(static_cast<char>(v >> 24));
+  out->push_back(static_cast<char>(v >> 16));
+  out->push_back(static_cast<char>(v >> 8));
+  out->push_back(static_cast<char>(v));
+}
+
+void put_u64(std::string* out, uint64_t v) {
+  put_u32(out, static_cast<uint32_t>(v >> 32));
+  put_u32(out, static_cast<uint32_t>(v));
+}
+
+bool get_bytes(std::string_view in, size_t* pos, size_t n, void* dst) {
+  if (in.size() - *pos < n) return false;
+  std::memcpy(dst, in.data() + *pos, n);
+  *pos += n;
+  return true;
+}
+
+bool get_u8(std::string_view in, size_t* pos, uint8_t* v) {
+  return get_bytes(in, pos, 1, v);
+}
+
+bool get_u16(std::string_view in, size_t* pos, uint16_t* v) {
+  uint8_t b[2];
+  if (!get_bytes(in, pos, 2, b)) return false;
+  *v = static_cast<uint16_t>((b[0] << 8) | b[1]);
+  return true;
+}
+
+bool get_u32(std::string_view in, size_t* pos, uint32_t* v) {
+  uint8_t b[4];
+  if (!get_bytes(in, pos, 4, b)) return false;
+  *v = (static_cast<uint32_t>(b[0]) << 24) |
+       (static_cast<uint32_t>(b[1]) << 16) |
+       (static_cast<uint32_t>(b[2]) << 8) | b[3];
+  return true;
+}
+
+bool get_u64(std::string_view in, size_t* pos, uint64_t* v) {
+  uint32_t hi, lo;
+  if (!get_u32(in, pos, &hi) || !get_u32(in, pos, &lo)) return false;
+  *v = (static_cast<uint64_t>(hi) << 32) | lo;
+  return true;
+}
+
+bool valid_ttype(uint8_t t) {
+  switch (static_cast<TType>(t)) {
+    case TType::kBool:
+    case TType::kByte:
+    case TType::kDouble:
+    case TType::kI16:
+    case TType::kI32:
+    case TType::kI64:
+    case TType::kString:
+    case TType::kStruct:
+    case TType::kMap:
+    case TType::kSet:
+    case TType::kList:
+      return true;
+    default:
+      return false;
+  }
+}
+
+}  // namespace
+
+// ---- builders ------------------------------------------------------------
+
+ThriftValue ThriftValue::Bool(bool v) {
+  ThriftValue t;
+  t.type = TType::kBool;
+  t.b = v;
+  return t;
+}
+ThriftValue ThriftValue::Byte(int8_t v) {
+  ThriftValue t;
+  t.type = TType::kByte;
+  t.i = v;
+  return t;
+}
+ThriftValue ThriftValue::I16(int16_t v) {
+  ThriftValue t;
+  t.type = TType::kI16;
+  t.i = v;
+  return t;
+}
+ThriftValue ThriftValue::I32(int32_t v) {
+  ThriftValue t;
+  t.type = TType::kI32;
+  t.i = v;
+  return t;
+}
+ThriftValue ThriftValue::I64(int64_t v) {
+  ThriftValue t;
+  t.type = TType::kI64;
+  t.i = v;
+  return t;
+}
+ThriftValue ThriftValue::Double(double v) {
+  ThriftValue t;
+  t.type = TType::kDouble;
+  t.d = v;
+  return t;
+}
+ThriftValue ThriftValue::Str(std::string s) {
+  ThriftValue t;
+  t.type = TType::kString;
+  t.str = std::move(s);
+  return t;
+}
+ThriftValue ThriftValue::Struct() {
+  ThriftValue t;
+  t.type = TType::kStruct;
+  return t;
+}
+ThriftValue ThriftValue::List(TType elem) {
+  ThriftValue t;
+  t.type = TType::kList;
+  t.elem_type = elem;
+  return t;
+}
+ThriftValue ThriftValue::Set(TType elem) {
+  ThriftValue t;
+  t.type = TType::kSet;
+  t.elem_type = elem;
+  return t;
+}
+ThriftValue ThriftValue::Map(TType key, TType val) {
+  ThriftValue t;
+  t.type = TType::kMap;
+  t.key_type = key;
+  t.val_type = val;
+  return t;
+}
+
+ThriftValue& ThriftValue::add_field(int16_t id, ThriftValue v) {
+  fields.emplace_back(id, std::move(v));
+  return *this;
+}
+
+const ThriftValue* ThriftValue::field(int16_t id) const {
+  for (const auto& [fid, v] : fields) {
+    if (fid == id) return &v;
+  }
+  return nullptr;
+}
+
+bool ThriftValue::operator==(const ThriftValue& o) const {
+  if (type != o.type) return false;
+  switch (type) {
+    case TType::kBool:
+      return b == o.b;
+    case TType::kByte:
+    case TType::kI16:
+    case TType::kI32:
+    case TType::kI64:
+      return i == o.i;
+    case TType::kDouble:
+      return d == o.d;
+    case TType::kString:
+      return str == o.str;
+    case TType::kStruct:
+      return fields == o.fields;
+    case TType::kList:
+    case TType::kSet:
+      return elem_type == o.elem_type && elems == o.elems;
+    case TType::kMap:
+      return key_type == o.key_type && val_type == o.val_type &&
+             kvs == o.kvs;
+    default:
+      return true;
+  }
+}
+
+// ---- codec ---------------------------------------------------------------
+
+void thrift_write_value(const ThriftValue& v, std::string* out) {
+  switch (v.type) {
+    case TType::kBool:
+      put_u8(out, v.b ? 1 : 0);
+      break;
+    case TType::kByte:
+      put_u8(out, static_cast<uint8_t>(v.i));
+      break;
+    case TType::kI16:
+      put_u16(out, static_cast<uint16_t>(v.i));
+      break;
+    case TType::kI32:
+      put_u32(out, static_cast<uint32_t>(v.i));
+      break;
+    case TType::kI64:
+      put_u64(out, static_cast<uint64_t>(v.i));
+      break;
+    case TType::kDouble: {
+      uint64_t bits;
+      std::memcpy(&bits, &v.d, 8);
+      put_u64(out, bits);
+      break;
+    }
+    case TType::kString:
+      put_u32(out, static_cast<uint32_t>(v.str.size()));
+      out->append(v.str);
+      break;
+    case TType::kStruct:
+      for (const auto& [fid, fv] : v.fields) {
+        put_u8(out, static_cast<uint8_t>(fv.type));
+        put_u16(out, static_cast<uint16_t>(fid));
+        thrift_write_value(fv, out);
+      }
+      put_u8(out, 0);  // STOP
+      break;
+    case TType::kMap:
+      put_u8(out, static_cast<uint8_t>(v.key_type));
+      put_u8(out, static_cast<uint8_t>(v.val_type));
+      put_u32(out, static_cast<uint32_t>(v.kvs.size()));
+      for (const auto& [k, val] : v.kvs) {
+        thrift_write_value(k, out);
+        thrift_write_value(val, out);
+      }
+      break;
+    case TType::kSet:
+    case TType::kList:
+      put_u8(out, static_cast<uint8_t>(v.elem_type));
+      put_u32(out, static_cast<uint32_t>(v.elems.size()));
+      for (const ThriftValue& e : v.elems) {
+        thrift_write_value(e, out);
+      }
+      break;
+    default:
+      break;
+  }
+}
+
+namespace {
+
+int read_value_impl(std::string_view in, size_t* pos, TType t,
+                    ThriftValue* out, int depth, size_t* budget) {
+  if (depth > kMaxDepth) return -1;
+  if (*budget == 0) return -1;  // total-values bound (see kMaxTotalValues)
+  --*budget;
+  out->type = t;
+  switch (t) {
+    case TType::kBool: {
+      uint8_t v;
+      if (!get_u8(in, pos, &v)) return 0;
+      out->b = v != 0;
+      return 1;
+    }
+    case TType::kByte: {
+      uint8_t v;
+      if (!get_u8(in, pos, &v)) return 0;
+      out->i = static_cast<int8_t>(v);
+      return 1;
+    }
+    case TType::kI16: {
+      uint16_t v;
+      if (!get_u16(in, pos, &v)) return 0;
+      out->i = static_cast<int16_t>(v);
+      return 1;
+    }
+    case TType::kI32: {
+      uint32_t v;
+      if (!get_u32(in, pos, &v)) return 0;
+      out->i = static_cast<int32_t>(v);
+      return 1;
+    }
+    case TType::kI64: {
+      uint64_t v;
+      if (!get_u64(in, pos, &v)) return 0;
+      out->i = static_cast<int64_t>(v);
+      return 1;
+    }
+    case TType::kDouble: {
+      uint64_t bits;
+      if (!get_u64(in, pos, &bits)) return 0;
+      std::memcpy(&out->d, &bits, 8);
+      return 1;
+    }
+    case TType::kString: {
+      uint32_t len;
+      if (!get_u32(in, pos, &len)) return 0;
+      if (len > kMaxFrame) return -1;
+      if (in.size() - *pos < len) return 0;
+      out->str.assign(in.data() + *pos, len);
+      *pos += len;
+      return 1;
+    }
+    case TType::kStruct: {
+      out->fields.clear();
+      while (true) {
+        uint8_t ft;
+        if (!get_u8(in, pos, &ft)) return 0;
+        if (ft == 0) return 1;  // STOP
+        if (!valid_ttype(ft)) return -1;
+        uint16_t fid;
+        if (!get_u16(in, pos, &fid)) return 0;
+        ThriftValue fv;
+        int rc = read_value_impl(in, pos, static_cast<TType>(ft), &fv,
+                                 depth + 1, budget);
+        if (rc != 1) return rc;
+        out->fields.emplace_back(static_cast<int16_t>(fid),
+                                 std::move(fv));
+      }
+    }
+    case TType::kMap: {
+      uint8_t kt, vt;
+      uint32_t n;
+      if (!get_u8(in, pos, &kt) || !get_u8(in, pos, &vt) ||
+          !get_u32(in, pos, &n)) {
+        return 0;
+      }
+      if (n > kMaxElements) return -1;
+      if (n > 0 && (!valid_ttype(kt) || !valid_ttype(vt))) return -1;
+      out->key_type = static_cast<TType>(kt);
+      out->val_type = static_cast<TType>(vt);
+      out->kvs.clear();
+      for (uint32_t i = 0; i < n; ++i) {
+        ThriftValue k, v;
+        int rc = read_value_impl(in, pos, out->key_type, &k, depth + 1,
+                                 budget);
+        if (rc != 1) return rc;
+        rc = read_value_impl(in, pos, out->val_type, &v, depth + 1, budget);
+        if (rc != 1) return rc;
+        out->kvs.emplace_back(std::move(k), std::move(v));
+      }
+      return 1;
+    }
+    case TType::kSet:
+    case TType::kList: {
+      uint8_t et;
+      uint32_t n;
+      if (!get_u8(in, pos, &et) || !get_u32(in, pos, &n)) return 0;
+      if (n > kMaxElements) return -1;
+      if (n > 0 && !valid_ttype(et)) return -1;
+      out->elem_type = static_cast<TType>(et);
+      out->elems.clear();
+      for (uint32_t i = 0; i < n; ++i) {
+        ThriftValue e;
+        int rc = read_value_impl(in, pos, out->elem_type, &e, depth + 1,
+                                 budget);
+        if (rc != 1) return rc;
+        out->elems.push_back(std::move(e));
+      }
+      return 1;
+    }
+    default:
+      return -1;
+  }
+}
+
+}  // namespace
+
+int thrift_read_value(std::string_view in, size_t* pos, TType t,
+                      ThriftValue* out, int depth) {
+  size_t budget = kMaxTotalValues;
+  return read_value_impl(in, pos, t, out, depth, &budget);
+}
+
+void thrift_pack_message(const ThriftMessage& m, std::string* out) {
+  std::string payload;
+  put_u32(&payload, kVersion1 | static_cast<uint32_t>(m.mtype));
+  put_u32(&payload, static_cast<uint32_t>(m.method.size()));
+  payload.append(m.method);
+  put_u32(&payload, m.seq_id);
+  thrift_write_value(m.body, &payload);
+  put_u32(out, static_cast<uint32_t>(payload.size()));
+  out->append(payload);
+}
+
+bool thrift_parse_payload(std::string_view payload, ThriftMessage* out) {
+  size_t pos = 0;
+  uint32_t verw, name_len;
+  if (!get_u32(payload, &pos, &verw) || (verw & kVersionMask) != kVersion1) {
+    return false;
+  }
+  out->mtype = static_cast<TMessageType>(verw & 0xff);
+  if (!get_u32(payload, &pos, &name_len) || name_len > kMaxMethod ||
+      payload.size() - pos < name_len) {
+    return false;
+  }
+  out->method.assign(payload.data() + pos, name_len);
+  pos += name_len;
+  if (!get_u32(payload, &pos, &out->seq_id)) return false;
+  int rc = thrift_read_value(payload, &pos, TType::kStruct, &out->body, 0);
+  return rc == 1 && pos == payload.size();
+}
+
+// ---- service registry ----------------------------------------------------
+
+bool ThriftService::AddMethodHandler(const std::string& method,
+                                     MethodHandler h) {
+  return handlers_.emplace(method, std::move(h)).second;
+}
+
+const ThriftService::MethodHandler* ThriftService::FindMethodHandler(
+    const std::string& method) const {
+  auto it = handlers_.find(method);
+  return it == handlers_.end() ? nullptr : &it->second;
+}
+
+// ---- shared frame cutter -------------------------------------------------
+
+namespace {
+
+// Cuts one complete frame's PAYLOAD into msg->payload.  The 8-byte peek
+// (length + version word) is also the probe discriminator.
+ParseError cut_thrift_frame(IOBuf* source, InputMessage* out, Socket* sock,
+                            bool probing) {
+  uint8_t head[8];
+  const size_t got = source->copy_to(head, sizeof(head), 0);
+  if (got < sizeof(head)) {
+    // Not enough to discriminate.  While probing, hold the connection
+    // (kNotEnoughData) ONLY if every byte seen so far is still consistent
+    // with a thrift frame — returning kTryOtherProtocol on a short
+    // fragmented prefix would let the probe loop fall through all
+    // protocols and kill a legitimate connection.
+    if (probing) {
+      if (got >= 1 && head[0] > (kMaxFrame >> 24)) {
+        return ParseError::kTryOtherProtocol;
+      }
+      if (got >= 5 && head[4] != 0x80) return ParseError::kTryOtherProtocol;
+      if (got >= 6 && head[5] != 0x01) return ParseError::kTryOtherProtocol;
+    }
+    return ParseError::kNotEnoughData;
+  }
+  const uint32_t frame_len = (static_cast<uint32_t>(head[0]) << 24) |
+                             (static_cast<uint32_t>(head[1]) << 16) |
+                             (static_cast<uint32_t>(head[2]) << 8) |
+                             head[3];
+  const bool versioned = head[4] == 0x80 && head[5] == 0x01;
+  if (probing && (!versioned || frame_len > kMaxFrame || frame_len < 12)) {
+    return ParseError::kTryOtherProtocol;
+  }
+  if (!versioned || frame_len > kMaxFrame || frame_len < 12) {
+    return ParseError::kCorrupted;
+  }
+  if (source->size() < 4u + frame_len) {
+    return ParseError::kNotEnoughData;
+  }
+  source->pop_front(4);
+  source->cutn(&out->payload, frame_len);
+  out->socket = sock != nullptr ? sock->id() : 0;
+  return ParseError::kOk;
+}
+
+// ---- server protocol -----------------------------------------------------
+
+ParseError thrift_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  const bool probing = sock->pinned_protocol < 0;
+  if (probing) {
+    Server* srv = static_cast<Server*>(sock->user_data);
+    if (srv == nullptr || srv->thrift_service() == nullptr) {
+      return ParseError::kTryOtherProtocol;
+    }
+  }
+  return cut_thrift_frame(source, out, sock, probing);
+}
+
+void thrift_respond(Socket* sock, const ThriftMessage& m) {
+  std::string wire;
+  thrift_pack_message(m, &wire);
+  IOBuf out;
+  out.append(wire);
+  sock->Write(std::move(out));
+}
+
+ThriftMessage make_app_exception(const std::string& method, uint32_t seq,
+                                 int32_t type, const std::string& text) {
+  // TApplicationException struct: 1=message string, 2=type i32.
+  ThriftMessage m;
+  m.mtype = TMessageType::kException;
+  m.method = method;
+  m.seq_id = seq;
+  m.body = ThriftValue::Struct();
+  m.body.add_field(1, ThriftValue::Str(text));
+  m.body.add_field(2, ThriftValue::I32(type));
+  return m;
+}
+
+constexpr int32_t kUnknownMethod = 1;   // TApplicationException codes
+constexpr int32_t kInternalError = 6;
+
+// Runs in its own fiber (frames carry seq ids; requests may interleave).
+void thrift_process_request(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  Server* srv = static_cast<Server*>(sock->user_data);
+  if (srv == nullptr || srv->thrift_service() == nullptr) {
+    return;
+  }
+  std::string payload;
+  payload.resize(msg.payload.size());
+  msg.payload.copy_to(payload.data(), payload.size(), 0);
+  ThriftMessage req;
+  if (!thrift_parse_payload(payload, &req) ||
+      (req.mtype != TMessageType::kCall &&
+       req.mtype != TMessageType::kOneway)) {
+    sock->SetFailed(EPROTO);
+    return;
+  }
+  const bool oneway = req.mtype == TMessageType::kOneway;
+
+  {  // Interceptor gate (same body as every serving protocol).
+    int ec = 0;
+    std::string et;
+    if (!srv->accept_request(req.method, sock->remote(), &ec, &et)) {
+      if (!oneway) {
+        thrift_respond(sock.get(), make_app_exception(
+                                       req.method, req.seq_id,
+                                       kInternalError, et));
+      }
+      return;
+    }
+  }
+
+  const ThriftService::MethodHandler* h =
+      srv->thrift_service()->FindMethodHandler(req.method);
+  if (h == nullptr) {
+    if (!oneway) {
+      thrift_respond(sock.get(),
+                     make_app_exception(req.method, req.seq_id,
+                                        kUnknownMethod,
+                                        "Unknown method " + req.method));
+    }
+    return;
+  }
+  std::string app_error;
+  ThriftValue result = (*h)(req.body, &app_error);
+  srv->requests_served.fetch_add(1, std::memory_order_relaxed);
+  if (oneway) {
+    return;
+  }
+  if (!app_error.empty()) {
+    thrift_respond(sock.get(), make_app_exception(req.method, req.seq_id,
+                                                  kInternalError,
+                                                  app_error));
+    return;
+  }
+  ThriftMessage rsp;
+  rsp.mtype = TMessageType::kReply;
+  rsp.method = req.method;
+  rsp.seq_id = req.seq_id;
+  rsp.body = std::move(result);
+  thrift_respond(sock.get(), rsp);
+}
+
+void thrift_process_response(InputMessage&&) {}
+
+}  // namespace
+
+void register_thrift_protocol() {
+  static int once = [] {
+    Protocol p = {"thrift", thrift_parse, thrift_process_request,
+                  thrift_process_response,
+                  /*process_in_order=*/false};
+    return register_protocol(p);
+  }();
+  (void)once;
+}
+
+// ---- client --------------------------------------------------------------
+
+namespace {
+
+struct ThriftWaiter {
+  CountdownEvent ev{1};
+  uint32_t seq = 0;
+  ThriftClient::Result result;
+};
+
+// Replies correlate by seq id (the server runs requests in parallel
+// fibers, so wire order is NOT call order — unlike redis's FIFO).
+struct ThriftCliConn {
+  std::mutex mu;
+  std::map<uint32_t, std::shared_ptr<ThriftWaiter>> pending;
+};
+
+const char kThriftCliTag = 0;
+
+ThriftCliConn* tcli_conn_of(Socket* s) {
+  if (s->parse_state == nullptr ||
+      s->parse_state_owner != &kThriftCliTag) {
+    s->parse_state = std::make_shared<ThriftCliConn>();
+    s->parse_state_owner = &kThriftCliTag;
+  }
+  return static_cast<ThriftCliConn*>(s->parse_state.get());
+}
+
+ParseError thriftc_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  if (sock->pinned_protocol < 0) {
+    return ParseError::kTryOtherProtocol;  // client sockets are pre-pinned
+  }
+  ParseError rc = cut_thrift_frame(source, out, sock, /*probing=*/false);
+  if (rc == ParseError::kOk) {
+    out->meta.type = RpcMeta::kResponse;
+  }
+  return rc;
+}
+
+// Inline in the read fiber: replies resolve their seq-keyed waiter.
+void thriftc_process_response(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  std::string payload;
+  payload.resize(msg.payload.size());
+  msg.payload.copy_to(payload.data(), payload.size(), 0);
+  ThriftMessage rsp;
+  const bool parsed = thrift_parse_payload(payload, &rsp);
+
+  ThriftCliConn* c = tcli_conn_of(sock.get());
+  if (!parsed) {
+    // Framing survived but the payload didn't decode: the stream itself
+    // is suspect — fail every in-flight call and the connection.
+    std::map<uint32_t, std::shared_ptr<ThriftWaiter>> orphans;
+    {
+      std::lock_guard<std::mutex> g(c->mu);
+      orphans.swap(c->pending);
+    }
+    for (auto& [seq, ow] : orphans) {
+      ow->result.error = "malformed reply";
+      ow->ev.signal();
+    }
+    sock->SetFailed(EPROTO);
+    return;
+  }
+  std::shared_ptr<ThriftWaiter> w;
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    auto it = c->pending.find(rsp.seq_id);
+    if (it == c->pending.end()) {
+      return;  // unsolicited / timed-out seq
+    }
+    w = std::move(it->second);
+    c->pending.erase(it);
+  }
+  if (rsp.mtype == TMessageType::kException) {
+    const ThriftValue* text = rsp.body.field(1);
+    w->result.error = text != nullptr && text->type == TType::kString
+                          ? text->str
+                          : "application exception";
+  } else if (rsp.mtype != TMessageType::kReply) {
+    w->result.error = "unexpected mtype";
+  } else {
+    w->result.ok = true;
+    w->result.result = std::move(rsp.body);
+  }
+  w->ev.signal();
+}
+
+void thriftc_process_request(InputMessage&&) {}
+
+int thriftc_protocol_index() {
+  static const int index = [] {
+    Protocol p = {"thriftc", thriftc_parse, thriftc_process_request,
+                  thriftc_process_response,
+                  /*process_in_order=*/true};
+    return register_protocol(p);
+  }();
+  return index;
+}
+
+}  // namespace
+
+ThriftClient::~ThriftClient() {
+  SocketRef s(Socket::Address(sock_));
+  if (s) {
+    s->SetFailed(ESHUTDOWN);
+  }
+}
+
+int ThriftClient::Init(const std::string& addr, const Options* opts) {
+  fiber_init(0);
+  if (opts != nullptr) {
+    opts_ = *opts;
+  }
+  thriftc_protocol_index();
+  return hostname2endpoint(addr.c_str(), &ep_);
+}
+
+int ThriftClient::ensure_socket(SocketId* out) {
+  Socket* s = Socket::Address(sock_);
+  if (s != nullptr) {
+    if (!s->Failed()) {
+      *out = sock_;
+      s->Dereference();
+      return 0;
+    }
+    s->Dereference();
+  }
+  Socket::Options sopts;
+  sopts.fd = -1;  // lazy connect in the write fiber
+  sopts.remote = ep_;
+  sopts.on_readable = &messenger_on_readable;
+  if (Socket::Create(sopts, &sock_) != 0) {
+    return -1;
+  }
+  SocketRef fresh(Socket::Address(sock_));
+  if (!fresh) {
+    return -1;
+  }
+  fresh->pinned_protocol = thriftc_protocol_index();
+  tcli_conn_of(fresh.get());  // install state while single-threaded
+  *out = sock_;
+  return 0;
+}
+
+ThriftClient::Result ThriftClient::call(const std::string& method,
+                                        const ThriftValue& args) {
+  Result fail;
+  ThriftMessage m;
+  m.mtype = TMessageType::kCall;
+  m.method = method;
+  m.body = args;
+
+  SocketId sid = 0;
+  std::shared_ptr<ThriftWaiter> w = std::make_shared<ThriftWaiter>();
+  {
+    LockGuard<FiberMutex> g(sock_mu_);
+    if (ensure_socket(&sid) != 0) {
+      fail.error = "cannot reach " + endpoint2str(ep_);
+      return fail;
+    }
+    m.seq_id = next_seq_++;
+  }
+  w->seq = m.seq_id;
+  SocketRef s(Socket::Address(sid));
+  if (!s) {
+    fail.error = "connection failed";
+    return fail;
+  }
+  ThriftCliConn* c = tcli_conn_of(s.get());
+  std::string wire;
+  thrift_pack_message(m, &wire);
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    c->pending.emplace(w->seq, w);
+  }
+  IOBuf frame;
+  frame.append(wire);
+  if (s->Write(std::move(frame)) != 0) {
+    std::lock_guard<std::mutex> g(c->mu);
+    c->pending.erase(w->seq);
+    fail.error = "write failed";
+    return fail;
+  }
+  const int64_t deadline = monotonic_time_us() + opts_.timeout_ms * 1000;
+  if (w->ev.wait(deadline) != 0) {
+    std::lock_guard<std::mutex> g(c->mu);
+    c->pending.erase(w->seq);  // reclaim the slot; a late reply is dropped
+    fail.error = "timeout";
+    return fail;
+  }
+  return std::move(w->result);
+}
+
+int ThriftClient::call_oneway(const std::string& method,
+                              const ThriftValue& args) {
+  ThriftMessage m;
+  m.mtype = TMessageType::kOneway;
+  m.method = method;
+  m.body = args;
+  SocketId sid = 0;
+  {
+    LockGuard<FiberMutex> g(sock_mu_);
+    if (ensure_socket(&sid) != 0) {
+      return -1;
+    }
+    m.seq_id = next_seq_++;
+  }
+  SocketRef s(Socket::Address(sid));
+  if (!s) {
+    return -1;
+  }
+  std::string wire;
+  thrift_pack_message(m, &wire);
+  IOBuf frame;
+  frame.append(wire);
+  return s->Write(std::move(frame));
+}
+
+}  // namespace trpc

@@ -13,9 +13,11 @@
 #include <vector>
 
 #include "base/iobuf.h"
+#include "base/pbwire.h"
 #include "net/http_message.h"
 #include "net/redis.h"
 #include "net/protocol.h"
+#include "net/thrift.h"
 #include "tests/test_util.h"
 
 using namespace trpc;
@@ -251,6 +253,86 @@ TEST_CASE(fuzz_resp_parsers) {
   RedisReply reply;
   size_t pos = 0;
   EXPECT_EQ(resp_parse_reply(bomb, &pos, &reply), -1);
+}
+
+TEST_CASE(fuzz_pbwire_parser) {
+  // Corpus: the golden meta shapes the legacy pbrpc protocols exchange.
+  std::vector<std::string> corpus;
+  {
+    PbMessage m;
+    m.add_bytes(1, "EchoService");
+    m.add_varint(2, 3);
+    m.add_sint(3, -99);
+    PbMessage inner;
+    inner.add_bytes(1, std::string(200, 'n'));
+    m.add_message(4, inner);
+    m.add_fixed64(5, 0x1122334455667788ULL);
+    m.add_fixed32(6, 0xabcdef01u);
+    corpus.push_back(m.serialize());
+  }
+  for (int iter = 0; iter < 40000; ++iter) {
+    const std::string input = mutate(corpus[rng() % corpus.size()]);
+    PbMessage m;
+    if (m.parse(input)) {
+      // Parse success implies a semantic fixpoint: re-serializing and
+      // re-parsing yields the same field list.  (Byte equality does NOT
+      // hold — the parser accepts overlong varints, the serializer only
+      // emits minimal ones.)
+      const std::string round = m.serialize();
+      PbMessage m2;
+      EXPECT(m2.parse(round));
+      EXPECT_EQ(m2.fields().size(), m.fields().size());
+      for (size_t i = 0; i < m.fields().size(); ++i) {
+        EXPECT_EQ(m2.fields()[i].num, m.fields()[i].num);
+        EXPECT(m2.fields()[i].wire == m.fields()[i].wire);
+        EXPECT_EQ(m2.fields()[i].varint, m.fields()[i].varint);
+        EXPECT(m2.fields()[i].bytes == m.fields()[i].bytes);
+      }
+      EXPECT(m2.serialize() == round);  // minimal form IS a fixpoint
+      // And the schemaless JSON walk terminates on anything parseable.
+      (void)pb_to_json_schemaless(m);
+    }
+  }
+}
+
+TEST_CASE(fuzz_thrift_parser) {
+  std::vector<std::string> corpus;
+  {
+    ThriftMessage m;
+    m.mtype = TMessageType::kCall;
+    m.method = "Echo";
+    m.seq_id = 9;
+    m.body = ThriftValue::Struct();
+    m.body.add_field(1, ThriftValue::Str(std::string(64, 'p')));
+    ThriftValue lst = ThriftValue::List(TType::kI32);
+    lst.elems = {ThriftValue::I32(1), ThriftValue::I32(2)};
+    m.body.add_field(2, lst);
+    ThriftValue mp = ThriftValue::Map(TType::kString, TType::kI64);
+    mp.kvs.emplace_back(ThriftValue::Str("k"), ThriftValue::I64(7));
+    m.body.add_field(3, mp);
+    std::string wire;
+    thrift_pack_message(m, &wire);
+    corpus.push_back(wire.substr(4));  // frame payload (length stripped)
+  }
+  for (int iter = 0; iter < 40000; ++iter) {
+    const std::string input = mutate(corpus[rng() % corpus.size()]);
+    ThriftMessage m;
+    (void)thrift_parse_payload(input, &m);  // must terminate, never crash
+  }
+  // Nesting bomb: struct-in-struct 64 deep must be depth-rejected.
+  std::string deep;
+  deep.append("\x80\x01\x00\x01", 4);
+  deep.append("\x00\x00\x00\x01x", 5);
+  deep.append("\x00\x00\x00\x01", 4);
+  for (int i = 0; i < 64; ++i) {
+    deep.push_back(0x0c);            // field type STRUCT
+    deep.append("\x00\x01", 2);      // fid 1
+  }
+  for (int i = 0; i < 65; ++i) {
+    deep.push_back(0x00);            // matching STOPs
+  }
+  ThriftMessage m;
+  EXPECT(!thrift_parse_payload(deep, &m));
 }
 
 TEST_MAIN

@@ -1,0 +1,191 @@
+// pbwire codec tests: primitives, message roundtrip, byte-for-byte
+// interop against a golden buffer produced by protoc+python-protobuf,
+// and the JSON transcoding seam.
+#include "base/pbwire.h"
+
+#include <cstring>
+
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+static std::string unhex(const char* h) {
+  std::string out;
+  for (size_t i = 0; h[i] && h[i + 1]; i += 2) {
+    auto nib = [](char c) {
+      return c <= '9' ? c - '0' : (c | 0x20) - 'a' + 10;
+    };
+    out.push_back(static_cast<char>((nib(h[i]) << 4) | nib(h[i + 1])));
+  }
+  return out;
+}
+
+// protoc golden: see the message literal in the comment below.
+//   service_name="EchoService" f1, method_index=3 f2(int32),
+//   scid=-12345 f3(sint64), correlation_id=-7 f4(int64), flag=true f5,
+//   d=2.5 f6(double), fl=-1.5 f7(float), f64=0xdeadbeefcafe f8,
+//   f32=0x12345678 f9, raw=00 01 fe f10, inner{s="hi",i=-2} f11,
+//   reps=[1,300,70000] f12(repeated uint32), big=2^63+5 f13.
+static const char* kGoldenHex =
+    "0a0b4563686f53657276696365100318f1c00120f9ffffffffffffffff01280131"
+    "00000000000004403d0000c0bf41fecaefbeadde00004d785634125203000"
+    "1fe5a0f0a02686910feffffffffffffffff01600160ac0260f0a2046885808080"
+    "808080808001";
+
+TEST_CASE(pbwire_varint_primitives) {
+  std::string buf;
+  pb_put_varint(&buf, 0);
+  pb_put_varint(&buf, 127);
+  pb_put_varint(&buf, 128);
+  pb_put_varint(&buf, 0xffffffffffffffffULL);
+  size_t pos = 0;
+  uint64_t v;
+  EXPECT(pb_get_varint(buf, &pos, &v) && v == 0);
+  EXPECT(pb_get_varint(buf, &pos, &v) && v == 127);
+  EXPECT(pb_get_varint(buf, &pos, &v) && v == 128);
+  EXPECT(pb_get_varint(buf, &pos, &v) && v == 0xffffffffffffffffULL);
+  EXPECT_EQ(pos, buf.size());
+  // Truncated varint fails.
+  std::string trunc("\x80", 1);
+  pos = 0;
+  EXPECT(!pb_get_varint(trunc, &pos, &v));
+  // Zigzag.
+  EXPECT_EQ(pb_zigzag(0), 0u);
+  EXPECT_EQ(pb_zigzag(-1), 1u);
+  EXPECT_EQ(pb_zigzag(1), 2u);
+  EXPECT_EQ(pb_unzigzag(pb_zigzag(-12345)), -12345);
+  EXPECT_EQ(pb_unzigzag(pb_zigzag(INT64_MIN)), INT64_MIN);
+}
+
+static PbMessage build_golden() {
+  PbMessage m;
+  m.add_bytes(1, "EchoService");
+  m.add_varint(2, 3);
+  m.add_sint(3, -12345);
+  m.add_varint(4, static_cast<uint64_t>(int64_t{-7}));
+  m.add_bool(5, true);
+  m.add_double(6, 2.5);
+  m.add_float(7, -1.5f);
+  m.add_fixed64(8, 0xdeadbeefcafeULL);
+  m.add_fixed32(9, 0x12345678u);
+  m.add_bytes(10, std::string_view("\x00\x01\xfe", 3));
+  PbMessage inner;
+  inner.add_bytes(1, "hi");
+  inner.add_varint(2, static_cast<uint64_t>(int64_t{-2}));
+  m.add_message(11, inner);
+  m.add_varint(12, 1);
+  m.add_varint(12, 300);
+  m.add_varint(12, 70000);
+  m.add_varint(13, (1ULL << 63) + 5);
+  return m;
+}
+
+TEST_CASE(pbwire_matches_protoc_golden_bytes) {
+  EXPECT(build_golden().serialize() == unhex(kGoldenHex));
+}
+
+TEST_CASE(pbwire_parses_protoc_golden) {
+  PbMessage m;
+  EXPECT(m.parse(unhex(kGoldenHex)));
+  EXPECT(m.get_bytes(1) == "EchoService");
+  EXPECT_EQ(m.get_varint(2), 3u);
+  EXPECT_EQ(m.get_sint(3), -12345);
+  EXPECT_EQ(static_cast<int64_t>(m.get_varint(4)), -7);
+  EXPECT(m.get_bool(5));
+  EXPECT_EQ(m.get_double(6), 2.5);
+  EXPECT_EQ(m.get_fixed(8), 0xdeadbeefcafeULL);
+  EXPECT_EQ(m.get_fixed(9), 0x12345678u);
+  EXPECT(m.get_bytes(10) == std::string_view("\x00\x01\xfe", 3));
+  PbMessage inner;
+  EXPECT(m.get_message(11, &inner));
+  EXPECT(inner.get_bytes(1) == "hi");
+  EXPECT_EQ(static_cast<int64_t>(inner.get_varint(2)), -2);
+  auto reps = m.all(12);
+  EXPECT_EQ(reps.size(), 3u);
+  EXPECT_EQ(reps[1]->varint, 300u);
+  EXPECT_EQ(m.get_varint(13), (1ULL << 63) + 5);
+  // Roundtrip is byte-identical (field order preserved).
+  EXPECT(m.serialize() == unhex(kGoldenHex));
+}
+
+TEST_CASE(pbwire_rejects_malformed) {
+  PbMessage m;
+  EXPECT(!m.parse(std::string_view("\x08", 1)));     // tag, no value
+  EXPECT(!m.parse(std::string_view("\x0a\x05""ab", 4)));  // short bytes
+  EXPECT(!m.parse(std::string_view("\x0b", 1)));     // group wire type 3
+  EXPECT(!m.parse(std::string_view("\x00\x00", 2))); // field number 0
+  // 11-byte varint rejected.
+  std::string over("\x08", 1);
+  for (int i = 0; i < 10; ++i) over.push_back('\x80');
+  over.push_back('\x01');
+  EXPECT(!m.parse(over));
+  // Length overflow (len > remaining, with a huge len that would wrap
+  // naive pos+len arithmetic).
+  std::string wrap("\x0a\xff\xff\xff\xff\xff\xff\xff\xff\xff\x01x", 12);
+  EXPECT(!m.parse(wrap));
+}
+
+static const PbSchema& golden_schema() {
+  static PbSchema inner{{
+      {1, "s", PbSchema::kString},
+      {2, "i", PbSchema::kInt64},
+  }};
+  static PbSchema s{{
+      {1, "service_name", PbSchema::kString},
+      {2, "method_index", PbSchema::kInt64},
+      {3, "scid", PbSchema::kSint64},
+      {4, "correlation_id", PbSchema::kInt64},
+      {5, "flag", PbSchema::kBool},
+      {6, "d", PbSchema::kDouble},
+      {10, "raw", PbSchema::kBytesHex},
+      {11, "inner", PbSchema::kMessage, &inner},
+      {12, "reps", PbSchema::kUint64, nullptr, /*repeated=*/true},
+  }};
+  return s;
+}
+
+TEST_CASE(pbwire_json_transcode_schemad) {
+  PbMessage m;
+  EXPECT(m.parse(unhex(kGoldenHex)));
+  Json j = pb_to_json(m, golden_schema());
+  EXPECT(j.find("service_name") &&
+         j.find("service_name")->as_string() == "EchoService");
+  EXPECT_EQ(static_cast<int64_t>(j.find("scid")->as_number()), -12345);
+  EXPECT_EQ(static_cast<int64_t>(j.find("correlation_id")->as_number()),
+            -7);
+  EXPECT(j.find("flag")->as_bool());
+  EXPECT(j.find("raw")->as_string() == "0001fe");
+  EXPECT(j.find("inner")->find("s")->as_string() == "hi");
+  EXPECT_EQ(j.find("reps")->size(), 3u);
+  // Unknown fields (7/8/9/13 not in schema) surface under their numbers.
+  EXPECT(j.find("8") != nullptr);
+
+  // JSON -> pb -> JSON fixpoint over the schema'd subset.
+  PbMessage back;
+  EXPECT(json_to_pb(j, golden_schema(), &back));
+  Json j2 = pb_to_json(back, golden_schema());
+  EXPECT(j2.find("service_name")->as_string() == "EchoService");
+  EXPECT_EQ(static_cast<int64_t>(j2.find("scid")->as_number()), -12345);
+  EXPECT_EQ(j2.find("reps")->size(), 3u);
+  EXPECT(j2.find("inner")->find("s")->as_string() == "hi");
+  // Type mismatch is rejected, not coerced.
+  Json bad = Json::object();
+  bad.set("flag", Json::number(1));
+  PbMessage sink;
+  EXPECT(!json_to_pb(bad, golden_schema(), &sink));
+}
+
+TEST_CASE(pbwire_json_schemaless_walk) {
+  PbMessage m;
+  EXPECT(m.parse(unhex(kGoldenHex)));
+  Json j = pb_to_json_schemaless(m);
+  EXPECT(j.find("1") && j.find("1")->as_string() == "EchoService");
+  // Nested message recursed under "11".
+  EXPECT(j.find("11") && j.find("11")->find("1") &&
+         j.find("11")->find("1")->as_string() == "hi");
+  // Repeated field 12 collapsed to an array.
+  EXPECT(j.find("12")->type() == Json::Type::kArray);
+  EXPECT_EQ(j.find("12")->size(), 3u);
+}
+
+TEST_MAIN
