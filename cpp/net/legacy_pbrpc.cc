@@ -1,0 +1,932 @@
+#include "net/legacy_pbrpc.h"
+
+#include <errno.h>
+
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+
+#include "base/logging.h"
+#include "base/pbwire.h"
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "net/controller.h"
+#include "net/messenger.h"
+#include "net/nshead.h"
+#include "net/protocol.h"
+#include "net/server.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr size_t kMaxBody = 64ull << 20;
+
+// Hulu meta field numbers (policy/hulu_pbrpc_meta.proto).
+constexpr uint32_t kHuluReqService = 1;
+constexpr uint32_t kHuluReqMethodIndex = 2;
+constexpr uint32_t kHuluReqCorrelation = 4;   // int64
+constexpr uint32_t kHuluReqMethodName = 14;
+constexpr uint32_t kHuluRspErrorCode = 1;
+constexpr uint32_t kHuluRspErrorText = 2;
+constexpr uint32_t kHuluRspCorrelation = 3;   // sint64 (zigzag)
+
+// Sofa meta field numbers (policy/sofa_pbrpc_meta.proto).
+constexpr uint32_t kSofaType = 1;             // 0 request / 1 response
+constexpr uint32_t kSofaSequenceId = 2;
+constexpr uint32_t kSofaMethod = 100;
+constexpr uint32_t kSofaFailed = 200;
+constexpr uint32_t kSofaErrorCode = 201;
+constexpr uint32_t kSofaReason = 202;
+
+// public_pbrpc field numbers (policy/public_pbrpc_meta.proto).
+constexpr uint32_t kPubReqHead = 1;
+constexpr uint32_t kPubReqBody = 2;
+constexpr uint32_t kPubHeadLogId = 7;
+constexpr uint32_t kPubBodyService = 3;
+constexpr uint32_t kPubBodyMethodId = 4;
+constexpr uint32_t kPubBodyId = 5;
+constexpr uint32_t kPubBodyPayload = 6;
+constexpr uint32_t kPubRspHead = 1;
+constexpr uint32_t kPubRspBody = 2;
+constexpr uint32_t kPubRspCode = 1;           // sint32 (zigzag)
+constexpr uint32_t kPubRspText = 2;
+constexpr uint32_t kPubRspPayload = 1;
+constexpr uint32_t kPubRspError = 3;
+constexpr uint32_t kPubRspId = 4;
+
+uint32_t load_u32le(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+uint64_t load_u64le(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+void put_u32le(std::string* out, uint32_t v) {
+  out->append(reinterpret_cast<const char*>(&v), 4);
+}
+
+void put_u64le(std::string* out, uint64_t v) {
+  out->append(reinterpret_cast<const char*>(&v), 8);
+}
+
+// ---- frame cutters (hulu / sofa) -----------------------------------------
+
+struct MetaFrame {
+  PbMessage meta;
+  IOBuf payload;
+};
+
+// [HULU][body_size u32][meta_size u32] native order, meta+payload follow.
+ParseError hulu_cut(IOBuf* source, InputMessage* out, Socket* sock,
+                    bool probing) {
+  uint8_t head[12];
+  const size_t got = source->copy_to(head, sizeof(head), 0);
+  if (got < 4) {
+    return probing && std::memcmp(head, "HULU", got) != 0
+               ? ParseError::kTryOtherProtocol
+               : ParseError::kNotEnoughData;
+  }
+  if (std::memcmp(head, "HULU", 4) != 0) {
+    return probing ? ParseError::kTryOtherProtocol
+                   : ParseError::kCorrupted;
+  }
+  if (got < sizeof(head)) {
+    return ParseError::kNotEnoughData;
+  }
+  const uint32_t body_size = load_u32le(head + 4);
+  const uint32_t meta_size = load_u32le(head + 8);
+  if (body_size > kMaxBody || meta_size > body_size) {
+    return ParseError::kCorrupted;
+  }
+  if (source->size() < sizeof(head) + body_size) {
+    return ParseError::kNotEnoughData;
+  }
+  source->pop_front(sizeof(head));
+  auto frame = std::make_shared<MetaFrame>();
+  IOBuf meta_buf;
+  source->cutn(&meta_buf, meta_size);
+  if (!frame->meta.parse(meta_buf.to_string())) {
+    return ParseError::kCorrupted;
+  }
+  source->cutn(&frame->payload, body_size - meta_size);
+  out->ctx = std::move(frame);
+  out->socket = sock != nullptr ? sock->id() : 0;
+  return ParseError::kOk;
+}
+
+// [SOFA][meta_size u32][body_size u64][message_size u64] native order.
+ParseError sofa_cut(IOBuf* source, InputMessage* out, Socket* sock,
+                    bool probing) {
+  uint8_t head[24];
+  const size_t got = source->copy_to(head, sizeof(head), 0);
+  if (got < 4) {
+    return probing && std::memcmp(head, "SOFA", got) != 0
+               ? ParseError::kTryOtherProtocol
+               : ParseError::kNotEnoughData;
+  }
+  if (std::memcmp(head, "SOFA", 4) != 0) {
+    return probing ? ParseError::kTryOtherProtocol
+                   : ParseError::kCorrupted;
+  }
+  if (got < sizeof(head)) {
+    return ParseError::kNotEnoughData;
+  }
+  const uint32_t meta_size = load_u32le(head + 4);
+  const uint64_t body_size = load_u64le(head + 8);
+  const uint64_t msg_size = load_u64le(head + 16);
+  if (msg_size != meta_size + body_size || msg_size > kMaxBody) {
+    return ParseError::kCorrupted;
+  }
+  if (source->size() < sizeof(head) + msg_size) {
+    return ParseError::kNotEnoughData;
+  }
+  source->pop_front(sizeof(head));
+  auto frame = std::make_shared<MetaFrame>();
+  IOBuf meta_buf;
+  source->cutn(&meta_buf, meta_size);
+  if (!frame->meta.parse(meta_buf.to_string())) {
+    return ParseError::kCorrupted;
+  }
+  source->cutn(&frame->payload, body_size);
+  out->ctx = std::move(frame);
+  out->socket = sock != nullptr ? sock->id() : 0;
+  return ParseError::kOk;
+}
+
+void hulu_pack(const PbMessage& meta, const IOBuf& payload, IOBuf* out) {
+  std::string m = meta.serialize();
+  std::string head = "HULU";
+  put_u32le(&head, static_cast<uint32_t>(m.size() + payload.size()));
+  put_u32le(&head, static_cast<uint32_t>(m.size()));
+  out->append(head);
+  out->append(m);
+  out->append(payload);
+}
+
+void sofa_pack(const PbMessage& meta, const IOBuf& payload, IOBuf* out) {
+  std::string m = meta.serialize();
+  std::string head = "SOFA";
+  put_u32le(&head, static_cast<uint32_t>(m.size()));
+  put_u64le(&head, payload.size());
+  put_u64le(&head, m.size() + payload.size());
+  out->append(head);
+  out->append(m);
+  out->append(payload);
+}
+
+// ---- shared server dispatch ----------------------------------------------
+
+// Runs the registry handler for `mkey`; `respond(cntl, response)` packs
+// and writes the protocol's reply (called exactly once, possibly from
+// the handler's own fiber).  When `latch` is non-null the caller parks
+// on it (FIFO protocols).
+void legacy_dispatch(
+    Server* srv, Socket* sock, const std::string& mkey, IOBuf&& payload,
+    std::function<void(Controller*, IOBuf*)> respond,
+    std::shared_ptr<CountdownEvent> latch) {
+  {  // Interceptor gate (same body as every serving protocol).
+    int ec = 0;
+    std::string et;
+    if (!srv->accept_request(mkey, sock->remote(), &ec, &et)) {
+      Controller fail;
+      fail.SetFailed(ec, et);
+      IOBuf empty;
+      respond(&fail, &empty);
+      if (latch) latch->signal();
+      return;
+    }
+  }
+  const Server::MethodProperty* prop = srv->find_method(mkey);
+  if (prop == nullptr) {
+    Controller fail;
+    fail.SetFailed(ENOENT, "unknown method " + mkey);
+    IOBuf empty;
+    respond(&fail, &empty);
+    if (latch) latch->signal();
+    return;
+  }
+  std::shared_ptr<ConcurrencyLimiter> limiter = prop->limiter;
+  if (limiter != nullptr && !limiter->on_request()) {
+    Controller fail;
+    fail.SetFailed(EAGAIN, "rejected by concurrency limiter");
+    IOBuf empty;
+    respond(&fail, &empty);
+    if (latch) latch->signal();
+    return;
+  }
+  auto* cntl = new Controller();
+  cntl->set_method(mkey);
+  auto* response = new IOBuf();
+  const int64_t start_us = monotonic_time_us();
+  std::shared_ptr<LatencyRecorder> lat = prop->latency;
+  srv->in_flight.fetch_add(1, std::memory_order_acq_rel);
+  Closure done = [srv, cntl, response, respond, latch, lat, limiter,
+                  start_us] {
+    if (limiter != nullptr) {
+      limiter->on_response(monotonic_time_us() - start_us,
+                           cntl->Failed());
+    }
+    respond(cntl, response);
+    if (lat != nullptr) {
+      *lat << (monotonic_time_us() - start_us);
+    }
+    delete response;
+    delete cntl;
+    srv->requests_served.fetch_add(1, std::memory_order_relaxed);
+    srv->in_flight.fetch_sub(1, std::memory_order_acq_rel);
+    if (latch) latch->signal();
+  };
+  prop->handler(cntl, payload, response, std::move(done));
+}
+
+// ---- hulu server ---------------------------------------------------------
+
+ParseError hulu_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  const bool probing = sock->pinned_protocol < 0;
+  if (probing && static_cast<Server*>(sock->user_data) == nullptr) {
+    return ParseError::kTryOtherProtocol;  // serving entry only
+  }
+  return hulu_cut(source, out, sock, probing);
+}
+
+void hulu_process_request(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  Server* srv = static_cast<Server*>(sock->user_data);
+  auto frame = std::static_pointer_cast<MetaFrame>(msg.ctx);
+  if (srv == nullptr || frame == nullptr) {
+    return;
+  }
+  const std::string service(frame->meta.get_bytes(kHuluReqService));
+  const std::string mname(frame->meta.get_bytes(kHuluReqMethodName));
+  const int64_t midx = static_cast<int64_t>(
+      frame->meta.get_varint(kHuluReqMethodIndex));
+  const int64_t cid =
+      static_cast<int64_t>(frame->meta.get_varint(kHuluReqCorrelation));
+  const std::string mkey =
+      !mname.empty() ? service + "." + mname
+                     : service + ".#" + std::to_string(midx);
+  const SocketId sid = msg.socket;
+  legacy_dispatch(
+      srv, sock.get(), mkey, std::move(frame->payload),
+      [sid, cid](Controller* cntl, IOBuf* response) {
+        PbMessage meta;
+        if (cntl->Failed()) {
+          meta.add_varint(kHuluRspErrorCode,
+                          static_cast<uint64_t>(cntl->error_code()));
+          meta.add_bytes(kHuluRspErrorText, cntl->error_text());
+        }
+        meta.add_sint(kHuluRspCorrelation, cid);
+        IOBuf out;
+        hulu_pack(meta, cntl->Failed() ? IOBuf() : *response, &out);
+        SocketRef s(Socket::Address(sid));
+        if (s) {
+          s->Write(std::move(out));
+        }
+      },
+      nullptr);
+}
+
+void hulu_process_response(InputMessage&&) {}
+
+// ---- sofa server ---------------------------------------------------------
+
+ParseError sofa_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  const bool probing = sock->pinned_protocol < 0;
+  if (probing && static_cast<Server*>(sock->user_data) == nullptr) {
+    return ParseError::kTryOtherProtocol;
+  }
+  return sofa_cut(source, out, sock, probing);
+}
+
+void sofa_process_request(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  Server* srv = static_cast<Server*>(sock->user_data);
+  auto frame = std::static_pointer_cast<MetaFrame>(msg.ctx);
+  if (srv == nullptr || frame == nullptr) {
+    return;
+  }
+  const uint64_t seq = frame->meta.get_varint(kSofaSequenceId);
+  const std::string mkey(frame->meta.get_bytes(kSofaMethod));
+  const SocketId sid = msg.socket;
+  legacy_dispatch(
+      srv, sock.get(), mkey, std::move(frame->payload),
+      [sid, seq](Controller* cntl, IOBuf* response) {
+        PbMessage meta;
+        meta.add_varint(kSofaType, 1);  // RESPONSE
+        meta.add_varint(kSofaSequenceId, seq);
+        if (cntl->Failed()) {
+          meta.add_bool(kSofaFailed, true);
+          meta.add_varint(kSofaErrorCode,
+                          static_cast<uint64_t>(cntl->error_code()));
+          meta.add_bytes(kSofaReason, cntl->error_text());
+        }
+        IOBuf out;
+        sofa_pack(meta, cntl->Failed() ? IOBuf() : *response, &out);
+        SocketRef s(Socket::Address(sid));
+        if (s) {
+          s->Write(std::move(out));
+        }
+      },
+      nullptr);
+}
+
+void sofa_process_response(InputMessage&&) {}
+
+// ---- nova server (nshead framing) ----------------------------------------
+
+struct NovaFrame {
+  NsheadHead head;
+  IOBuf body;
+};
+
+ParseError nova_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  const bool probing = sock->pinned_protocol < 0;
+  if (probing) {
+    Server* srv = static_cast<Server*>(sock->user_data);
+    if (srv == nullptr || !srv->nova_pbrpc_enabled()) {
+      return ParseError::kTryOtherProtocol;
+    }
+  }
+  auto frame = std::make_shared<NovaFrame>();
+  const int rc = nshead_cut_frame(source, &frame->head, &frame->body);
+  if (rc == 0) {
+    return probing ? nshead_probe_short(source)
+                   : ParseError::kNotEnoughData;
+  }
+  if (rc < 0) {
+    return probing ? ParseError::kTryOtherProtocol
+                   : ParseError::kCorrupted;
+  }
+  out->ctx = std::move(frame);
+  out->socket = sock->id();
+  return ParseError::kOk;
+}
+
+// FIFO like raw nshead: inline + latch so async handlers keep order.
+void nova_process_request(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  Server* srv = static_cast<Server*>(sock->user_data);
+  auto frame = std::static_pointer_cast<NovaFrame>(msg.ctx);
+  if (srv == nullptr || frame == nullptr) {
+    return;
+  }
+  const std::string mkey =
+      "Nova.#" + std::to_string(frame->head.reserved);
+  const SocketId sid = msg.socket;
+  const NsheadHead req_head = frame->head;
+  auto latch = std::make_shared<CountdownEvent>(1);
+  legacy_dispatch(
+      srv, sock.get(), mkey, std::move(frame->body),
+      [sid, req_head](Controller* cntl, IOBuf* response) {
+        NsheadHead h = req_head;
+        h.version = 0;  // no compression flag on the response
+        IOBuf out;
+        nshead_pack(h, cntl->Failed() ? IOBuf() : *response, &out);
+        SocketRef s(Socket::Address(sid));
+        if (s) {
+          s->Write(std::move(out));
+        }
+      },
+      latch);
+  latch->wait(-1);
+}
+
+void nova_process_response(InputMessage&&) {}
+
+// ---- public_pbrpc server (nshead framing) --------------------------------
+
+ParseError public_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  const bool probing = sock->pinned_protocol < 0;
+  if (probing) {
+    Server* srv = static_cast<Server*>(sock->user_data);
+    if (srv == nullptr || !srv->public_pbrpc_enabled()) {
+      return ParseError::kTryOtherProtocol;
+    }
+  }
+  auto frame = std::make_shared<NovaFrame>();
+  const int rc = nshead_cut_frame(source, &frame->head, &frame->body);
+  if (rc == 0) {
+    return probing ? nshead_probe_short(source)
+                   : ParseError::kNotEnoughData;
+  }
+  if (rc < 0) {
+    return probing ? ParseError::kTryOtherProtocol
+                   : ParseError::kCorrupted;
+  }
+  out->ctx = std::move(frame);
+  out->socket = sock->id();
+  return ParseError::kOk;
+}
+
+void public_process_request(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  Server* srv = static_cast<Server*>(sock->user_data);
+  auto frame = std::static_pointer_cast<NovaFrame>(msg.ctx);
+  if (srv == nullptr || frame == nullptr) {
+    return;
+  }
+  PbMessage req;
+  PbMessage body;
+  if (!req.parse(frame->body.to_string()) ||
+      !req.get_message(kPubReqBody, &body)) {
+    sock->SetFailed(EPROTO);
+    return;
+  }
+  const std::string service(body.get_bytes(kPubBodyService));
+  const uint64_t method_id = body.get_varint(kPubBodyMethodId);
+  const uint64_t id = body.get_varint(kPubBodyId);
+  IOBuf payload;
+  payload.append(std::string(body.get_bytes(kPubBodyPayload)));
+  const std::string mkey =
+      service + ".#" + std::to_string(method_id);
+  const SocketId sid = msg.socket;
+  const NsheadHead req_head = frame->head;
+  auto latch = std::make_shared<CountdownEvent>(1);
+  legacy_dispatch(
+      srv, sock.get(), mkey, std::move(payload),
+      [sid, req_head, id](Controller* cntl, IOBuf* response) {
+        PbMessage head;
+        head.add_sint(kPubRspCode, cntl->Failed() ? cntl->error_code() : 0);
+        if (cntl->Failed()) {
+          head.add_bytes(kPubRspText, cntl->error_text());
+        }
+        PbMessage rbody;
+        if (!cntl->Failed()) {
+          rbody.add_bytes(kPubRspPayload, response->to_string());
+        } else {
+          rbody.add_varint(kPubRspError,
+                           static_cast<uint64_t>(cntl->error_code()));
+        }
+        rbody.add_varint(kPubRspId, id);
+        PbMessage rsp;
+        rsp.add_message(kPubRspHead, head);
+        rsp.add_message(kPubRspBody, rbody);
+        IOBuf body_buf;
+        body_buf.append(rsp.serialize());
+        IOBuf out;
+        nshead_pack(req_head, body_buf, &out);
+        SocketRef s(Socket::Address(sid));
+        if (s) {
+          s->Write(std::move(out));
+        }
+      },
+      latch);
+  latch->wait(-1);
+}
+
+void public_process_response(InputMessage&&) {}
+
+}  // namespace
+
+void register_hulu_protocol() {
+  static int once = [] {
+    Protocol p = {"hulu", hulu_parse, hulu_process_request,
+                  hulu_process_response, /*process_in_order=*/false};
+    return register_protocol(p);
+  }();
+  (void)once;
+}
+
+void register_sofa_protocol() {
+  static int once = [] {
+    Protocol p = {"sofa", sofa_parse, sofa_process_request,
+                  sofa_process_response, /*process_in_order=*/false};
+    return register_protocol(p);
+  }();
+  (void)once;
+}
+
+void register_nova_protocol() {
+  static int once = [] {
+    Protocol p = {"nova", nova_parse, nova_process_request,
+                  nova_process_response, /*process_in_order=*/true};
+    return register_protocol(p);
+  }();
+  (void)once;
+}
+
+void register_public_pbrpc_protocol() {
+  static int once = [] {
+    Protocol p = {"public_pbrpc", public_parse, public_process_request,
+                  public_process_response, /*process_in_order=*/true};
+    return register_protocol(p);
+  }();
+  (void)once;
+}
+
+// ---- client --------------------------------------------------------------
+
+namespace {
+
+struct LegacyWaiter {
+  CountdownEvent ev{1};
+  LegacyRpcClient::Result result;
+};
+
+// One connection's in-flight calls: keyed by correlation id for
+// hulu/sofa/public, FIFO deque for nova (no id on the wire).
+struct LegacyCliConn {
+  std::mutex mu;
+  std::map<uint64_t, std::shared_ptr<LegacyWaiter>> by_id;
+  std::deque<std::shared_ptr<LegacyWaiter>> fifo;
+};
+
+const char kLegacyCliTag = 0;
+
+LegacyCliConn* lcli_conn_of(Socket* s) {
+  return proto_conn_of<LegacyCliConn>(s, &kLegacyCliTag);
+}
+
+int install_legacy_conn(Socket* s) {
+  lcli_conn_of(s);
+  return 0;
+}
+
+std::shared_ptr<LegacyWaiter> take_by_id(Socket* sock, uint64_t id) {
+  LegacyCliConn* c = lcli_conn_of(sock);
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->by_id.find(id);
+  if (it == c->by_id.end()) {
+    return nullptr;
+  }
+  auto w = std::move(it->second);
+  c->by_id.erase(it);
+  return w;
+}
+
+std::shared_ptr<LegacyWaiter> take_fifo(Socket* sock) {
+  LegacyCliConn* c = lcli_conn_of(sock);
+  std::lock_guard<std::mutex> g(c->mu);
+  if (c->fifo.empty()) {
+    return nullptr;
+  }
+  auto w = std::move(c->fifo.front());
+  c->fifo.pop_front();
+  return w;
+}
+
+// -- hulu client protocol --
+
+ParseError huluc_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  if (sock->pinned_protocol < 0) {
+    return ParseError::kTryOtherProtocol;
+  }
+  ParseError rc = hulu_cut(source, out, sock, /*probing=*/false);
+  if (rc == ParseError::kOk) {
+    out->meta.type = RpcMeta::kResponse;
+  }
+  return rc;
+}
+
+void huluc_process_response(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  auto frame = std::static_pointer_cast<MetaFrame>(msg.ctx);
+  const uint64_t cid =
+      static_cast<uint64_t>(frame->meta.get_sint(kHuluRspCorrelation));
+  auto w = take_by_id(sock.get(), cid);
+  if (!w) {
+    return;
+  }
+  const int ec =
+      static_cast<int>(frame->meta.get_varint(kHuluRspErrorCode));
+  if (ec != 0) {
+    w->result.error_code = ec;
+    w->result.error_text =
+        std::string(frame->meta.get_bytes(kHuluRspErrorText));
+  } else {
+    w->result.ok = true;
+    w->result.response = std::move(frame->payload);
+  }
+  w->ev.signal();
+}
+
+void huluc_process_request(InputMessage&&) {}
+
+int huluc_protocol_index() {
+  static const int index = [] {
+    Protocol p = {"huluc", huluc_parse, huluc_process_request,
+                  huluc_process_response, /*process_in_order=*/true};
+    return register_protocol(p);
+  }();
+  return index;
+}
+
+// -- sofa client protocol --
+
+ParseError sofac_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  if (sock->pinned_protocol < 0) {
+    return ParseError::kTryOtherProtocol;
+  }
+  ParseError rc = sofa_cut(source, out, sock, /*probing=*/false);
+  if (rc == ParseError::kOk) {
+    out->meta.type = RpcMeta::kResponse;
+  }
+  return rc;
+}
+
+void sofac_process_response(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  auto frame = std::static_pointer_cast<MetaFrame>(msg.ctx);
+  const uint64_t seq = frame->meta.get_varint(kSofaSequenceId);
+  auto w = take_by_id(sock.get(), seq);
+  if (!w) {
+    return;
+  }
+  if (frame->meta.get_bool(kSofaFailed)) {
+    w->result.error_code =
+        static_cast<int>(frame->meta.get_varint(kSofaErrorCode));
+    w->result.error_text =
+        std::string(frame->meta.get_bytes(kSofaReason));
+    if (w->result.error_code == 0) {
+      w->result.error_code = EREMOTE;
+    }
+  } else {
+    w->result.ok = true;
+    w->result.response = std::move(frame->payload);
+  }
+  w->ev.signal();
+}
+
+void sofac_process_request(InputMessage&&) {}
+
+int sofac_protocol_index() {
+  static const int index = [] {
+    Protocol p = {"sofac", sofac_parse, sofac_process_request,
+                  sofac_process_response, /*process_in_order=*/true};
+    return register_protocol(p);
+  }();
+  return index;
+}
+
+// -- nova / public client protocols (nshead frames back) --
+
+ParseError nsfamc_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  if (sock->pinned_protocol < 0) {
+    return ParseError::kTryOtherProtocol;
+  }
+  auto frame = std::make_shared<NovaFrame>();
+  const int rc = nshead_cut_frame(source, &frame->head, &frame->body);
+  if (rc == 0) {
+    return ParseError::kNotEnoughData;
+  }
+  if (rc < 0) {
+    return ParseError::kCorrupted;
+  }
+  out->ctx = std::move(frame);
+  out->meta.type = RpcMeta::kResponse;
+  out->socket = sock->id();
+  return ParseError::kOk;
+}
+
+void novac_process_response(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  auto frame = std::static_pointer_cast<NovaFrame>(msg.ctx);
+  auto w = take_fifo(sock.get());
+  if (!w) {
+    return;
+  }
+  w->result.ok = true;
+  w->result.response = std::move(frame->body);
+  w->ev.signal();
+}
+
+void novac_process_request(InputMessage&&) {}
+
+int novac_protocol_index() {
+  static const int index = [] {
+    Protocol p = {"novac", nsfamc_parse, novac_process_request,
+                  novac_process_response, /*process_in_order=*/true};
+    return register_protocol(p);
+  }();
+  return index;
+}
+
+void publicc_process_response(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  auto frame = std::static_pointer_cast<NovaFrame>(msg.ctx);
+  PbMessage rsp, head, body;
+  if (!rsp.parse(frame->body.to_string()) ||
+      !rsp.get_message(kPubRspBody, &body)) {
+    sock->SetFailed(EPROTO);
+    return;
+  }
+  auto w = take_by_id(sock.get(), body.get_varint(kPubRspId));
+  if (!w) {
+    return;
+  }
+  int code = 0;
+  if (rsp.get_message(kPubRspHead, &head)) {
+    code = static_cast<int>(head.get_sint(kPubRspCode));
+  }
+  const int berr = static_cast<int>(body.get_varint(kPubRspError));
+  if (code != 0 || berr != 0) {
+    w->result.error_code = code != 0 ? code : berr;
+    w->result.error_text = std::string(head.get_bytes(kPubRspText));
+  } else {
+    w->result.ok = true;
+    w->result.response.append(
+        std::string(body.get_bytes(kPubRspPayload)));
+  }
+  w->ev.signal();
+}
+
+int publicc_protocol_index() {
+  static const int index = [] {
+    Protocol p = {"publicc", nsfamc_parse, novac_process_request,
+                  publicc_process_response, /*process_in_order=*/true};
+    return register_protocol(p);
+  }();
+  return index;
+}
+
+int client_protocol_index(LegacyProto proto) {
+  switch (proto) {
+    case LegacyProto::kHulu:
+      return huluc_protocol_index();
+    case LegacyProto::kSofa:
+      return sofac_protocol_index();
+    case LegacyProto::kNova:
+      return novac_protocol_index();
+    case LegacyProto::kPublic:
+      return publicc_protocol_index();
+  }
+  return -1;
+}
+
+}  // namespace
+
+LegacyRpcClient::~LegacyRpcClient() {
+  csock_.Shutdown();
+}
+
+int LegacyRpcClient::Init(const std::string& addr, LegacyProto proto,
+                          const Options* opts) {
+  fiber_init(0);
+  proto_ = proto;
+  if (opts != nullptr) {
+    opts_ = *opts;
+  }
+  client_protocol_index(proto);
+  return csock_.Init(addr);
+}
+
+LegacyRpcClient::Result LegacyRpcClient::call(const std::string& service,
+                                              const std::string& method,
+                                              int32_t method_index,
+                                              const IOBuf& request) {
+  Result fail;
+  SocketId sid = 0;
+  uint64_t id = 0;
+  {
+    LockGuard<FiberMutex> g(sock_mu_);
+    if (csock_.ensure(client_protocol_index(proto_), install_legacy_conn,
+                      &sid) != 0) {
+      fail.error_code = EHOSTUNREACH;
+      fail.error_text = "cannot reach " + endpoint2str(csock_.endpoint());
+      return fail;
+    }
+    id = next_id_++;
+  }
+  SocketRef s(Socket::Address(sid));
+  if (!s) {
+    fail.error_code = ECONNRESET;
+    fail.error_text = "connection failed";
+    return fail;
+  }
+
+  IOBuf out;
+  switch (proto_) {
+    case LegacyProto::kHulu: {
+      PbMessage meta;
+      meta.add_bytes(kHuluReqService, service);
+      meta.add_varint(kHuluReqMethodIndex,
+                      static_cast<uint64_t>(method_index));
+      meta.add_varint(kHuluReqCorrelation, id);
+      if (!method.empty()) {
+        meta.add_bytes(kHuluReqMethodName, method);
+      }
+      hulu_pack(meta, request, &out);
+      break;
+    }
+    case LegacyProto::kSofa: {
+      PbMessage meta;
+      meta.add_varint(kSofaType, 0);  // REQUEST
+      meta.add_varint(kSofaSequenceId, id);
+      meta.add_bytes(kSofaMethod, service + "." + method);
+      sofa_pack(meta, request, &out);
+      break;
+    }
+    case LegacyProto::kNova: {
+      NsheadHead h;
+      h.reserved = static_cast<uint32_t>(method_index);
+      nshead_pack(h, request, &out);
+      break;
+    }
+    case LegacyProto::kPublic: {
+      PbMessage head;
+      PbMessage body;
+      body.add_bytes(kPubBodyService, service);
+      body.add_varint(kPubBodyMethodId,
+                      static_cast<uint64_t>(method_index));
+      body.add_varint(kPubBodyId, id);
+      body.add_bytes(kPubBodyPayload, request.to_string());
+      PbMessage req;
+      req.add_message(kPubReqHead, head);
+      req.add_message(kPubReqBody, body);
+      IOBuf body_buf;
+      body_buf.append(req.serialize());
+      NsheadHead h;
+      nshead_pack(h, body_buf, &out);
+      break;
+    }
+  }
+
+  LegacyCliConn* c = lcli_conn_of(s.get());
+  auto w = std::make_shared<LegacyWaiter>();
+  const bool fifo = proto_ == LegacyProto::kNova;
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    if (fifo) {
+      c->fifo.push_back(w);
+    } else {
+      c->by_id.emplace(id, w);
+    }
+    if (s->Write(std::move(out)) != 0) {
+      if (fifo) {
+        c->fifo.pop_back();
+      } else {
+        c->by_id.erase(id);
+      }
+      fail.error_code = EPIPE;
+      fail.error_text = "write failed";
+      return fail;
+    }
+  }
+  const int64_t deadline = monotonic_time_us() + opts_.timeout_ms * 1000;
+  if (w->ev.wait(deadline) != 0) {
+    if (!fifo) {
+      std::lock_guard<std::mutex> g(c->mu);
+      c->by_id.erase(id);
+    }
+    // FIFO waiters stay queued so later replies keep their alignment.
+    fail.error_code = ETIMEDOUT;
+    fail.error_text = "timeout";
+    return fail;
+  }
+  return std::move(w->result);
+}
+
+}  // namespace trpc

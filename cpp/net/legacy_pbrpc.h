@@ -1,0 +1,91 @@
+// Legacy Baidu pbrpc protocol family — hulu / sofa / nova / public_pbrpc.
+//
+// Parity: /root/reference/src/brpc/policy/{hulu,sofa,nova,public}_pbrpc_
+// protocol.cpp (+ their .proto metas).  All four are "frame + protobuf
+// meta + payload" variants; the reference decodes the metas with
+// generated protobuf classes, this runtime uses the pbwire codec
+// (base/pbwire.h) with the field numbers straight from the public .proto
+// files:
+//   hulu   : 12B header [HULU][body_size u32][meta_size u32] (native
+//            order), meta HuluRpcRequestMeta{1:service 2:method_index
+//            4:correlation_id 5:log_id 14:method_name} / ResponseMeta
+//            {1:error_code 2:error_text 3:sint64 correlation_id}.
+//   sofa   : 24B header [SOFA][meta u32][body u64][msg u64] (native
+//            order), meta SofaRpcMeta{1:type(0 req/1 rsp) 2:sequence_id
+//            100:method 200:failed 201:error_code 202:reason}.
+//   nova   : nshead framing; head.reserved = method index; body IS the
+//            request payload (no meta).  FIFO correlation.
+//   public : nshead framing; body = PublicPbrpcRequest{1:RequestHead
+//            {7:log_id} 2:RequestBody{3:service 4:method_id 5:id
+//            6:serialized_request}} / PublicPbrpcResponse{1:ResponseHead
+//            {1:sint32 code 2:text} 2:ResponseBody{1:serialized_response
+//            3:error 4:id}}.
+//
+// Serving model: all four dispatch into the Server's ONE method
+// registry, so a handler registered once serves tstd AND every legacy
+// protocol.  Method keys: "<service>.<method_name>" when the wire names
+// the method, "<service>.#<index>" for index-addressed protocols
+// (hulu without method_name, nova as "Nova.#<idx>", public).
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "base/endpoint.h"
+#include "base/iobuf.h"
+#include "fiber/sync.h"
+#include "net/proto_client.h"
+#include "net/socket.h"
+
+namespace trpc {
+
+enum class LegacyProto : uint8_t {
+  kHulu = 0,
+  kSofa = 1,
+  kNova = 2,
+  kPublic = 3,
+};
+
+// Server side: hulu + sofa register unconditionally in Server::Start
+// (their 4-byte magics are unambiguous); nova/public ride nshead and are
+// enabled per server (Server::enable_nova_pbrpc / enable_public_pbrpc —
+// at most one nshead personality per server, see server.h).
+void register_hulu_protocol();
+void register_sofa_protocol();
+void register_nova_protocol();
+void register_public_pbrpc_protocol();
+
+// One client for the whole family.
+class LegacyRpcClient {
+ public:
+  struct Options {
+    int64_t timeout_ms = 1000;
+  };
+
+  struct Result {
+    bool ok = false;
+    int error_code = 0;
+    std::string error_text;
+    IOBuf response;
+  };
+
+  ~LegacyRpcClient();
+  int Init(const std::string& addr, LegacyProto proto,
+           const Options* opts = nullptr);
+
+  // `service` + `method` address the remote handler.  method is a name
+  // ("Echo") where the protocol carries names (hulu sends BOTH name and
+  // index, sofa sends "service.method"), and an index is required where
+  // the wire is index-only (nova, public) — pass it in method_index.
+  Result call(const std::string& service, const std::string& method,
+              int32_t method_index, const IOBuf& request);
+
+ private:
+  LegacyProto proto_ = LegacyProto::kHulu;
+  Options opts_;
+  FiberMutex sock_mu_;
+  ClientSocket csock_;
+  uint64_t next_id_ = 1;
+};
+
+}  // namespace trpc

@@ -1,0 +1,773 @@
+#include "net/memcache.h"
+
+#include <errno.h>
+
+#include <cstring>
+#include <ctime>
+#include <deque>
+#include <mutex>
+
+#include "base/logging.h"
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "net/messenger.h"
+#include "net/protocol.h"
+#include "net/server.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr uint8_t kMagicRequest = 0x80;
+constexpr uint8_t kMagicResponse = 0x81;
+constexpr size_t kHeader = 24;
+constexpr size_t kMaxBody = 64ull << 20;
+constexpr size_t kMaxKey = 250;  // memcached's documented key limit
+
+void put_u16(std::string* out, uint16_t v) {
+  out->push_back(static_cast<char>(v >> 8));
+  out->push_back(static_cast<char>(v));
+}
+
+void put_u32(std::string* out, uint32_t v) {
+  out->push_back(static_cast<char>(v >> 24));
+  out->push_back(static_cast<char>(v >> 16));
+  out->push_back(static_cast<char>(v >> 8));
+  out->push_back(static_cast<char>(v));
+}
+
+void put_u64(std::string* out, uint64_t v) {
+  put_u32(out, static_cast<uint32_t>(v >> 32));
+  put_u32(out, static_cast<uint32_t>(v));
+}
+
+uint16_t read_u16(const uint8_t* p) {
+  return static_cast<uint16_t>((p[0] << 8) | p[1]);
+}
+
+uint32_t read_u32(const uint8_t* p) {
+  return (static_cast<uint32_t>(p[0]) << 24) |
+         (static_cast<uint32_t>(p[1]) << 16) |
+         (static_cast<uint32_t>(p[2]) << 8) | p[3];
+}
+
+uint64_t read_u64(const uint8_t* p) {
+  return (static_cast<uint64_t>(read_u32(p)) << 32) | read_u32(p + 4);
+}
+
+void pack_frame(uint8_t magic, McOp op, uint16_t status_or_vb,
+                uint32_t opaque, uint64_t cas, const std::string& extras,
+                const std::string& key, const std::string& value,
+                std::string* out) {
+  out->push_back(static_cast<char>(magic));
+  out->push_back(static_cast<char>(op));
+  put_u16(out, static_cast<uint16_t>(key.size()));
+  out->push_back(static_cast<char>(extras.size()));
+  out->push_back(0);  // data type
+  put_u16(out, status_or_vb);
+  put_u32(out, static_cast<uint32_t>(extras.size() + key.size() +
+                                     value.size()));
+  put_u32(out, opaque);
+  put_u64(out, cas);
+  out->append(extras);
+  out->append(key);
+  out->append(value);
+}
+
+}  // namespace
+
+void mc_pack_request(const McCommand& cmd, uint32_t opaque,
+                     std::string* out) {
+  std::string extras;
+  std::string value;
+  switch (cmd.op) {
+    case McOp::kSet:
+    case McOp::kAdd:
+    case McOp::kReplace:
+      put_u32(&extras, cmd.flags);
+      put_u32(&extras, cmd.exptime);
+      value = cmd.value;
+      break;
+    case McOp::kIncrement:
+    case McOp::kDecrement:
+      put_u64(&extras, cmd.delta);
+      put_u64(&extras, cmd.initial);
+      put_u32(&extras, cmd.exptime);
+      break;
+    case McOp::kTouch:
+    case McOp::kFlush:
+      put_u32(&extras, cmd.exptime);
+      break;
+    case McOp::kAppend:
+    case McOp::kPrepend:
+      value = cmd.value;
+      break;
+    default:
+      break;
+  }
+  pack_frame(kMagicRequest, cmd.op, /*vbucket=*/0, opaque, cmd.cas,
+             extras, cmd.key, value, out);
+}
+
+void mc_pack_response(McOp op, McStatus status, uint32_t opaque,
+                      uint64_t cas, const std::string& extras,
+                      const std::string& key, const std::string& value,
+                      std::string* out) {
+  pack_frame(kMagicResponse, op, static_cast<uint16_t>(status), opaque,
+             cas, extras, key, value, out);
+}
+
+int mc_parse_frame(const std::string& data, size_t* pos, McFrame* out) {
+  if (data.size() - *pos < kHeader) {
+    return 0;
+  }
+  const uint8_t* h =
+      reinterpret_cast<const uint8_t*>(data.data()) + *pos;
+  if (h[0] != kMagicRequest && h[0] != kMagicResponse) {
+    return -1;
+  }
+  const uint16_t key_len = read_u16(h + 2);
+  const uint8_t extras_len = h[4];
+  const uint32_t total = read_u32(h + 8);
+  if (total > kMaxBody ||
+      static_cast<uint32_t>(key_len) + extras_len > total) {
+    return -1;
+  }
+  if (data.size() - *pos < kHeader + total) {
+    return 0;
+  }
+  out->magic = h[0];
+  out->op = static_cast<McOp>(h[1]);
+  out->status_or_vbucket = read_u16(h + 6);
+  out->opaque = read_u32(h + 12);
+  out->cas = read_u64(h + 16);
+  const char* body = data.data() + *pos + kHeader;
+  out->extras.assign(body, extras_len);
+  out->key.assign(body + extras_len, key_len);
+  out->value.assign(body + extras_len + key_len,
+                    total - extras_len - key_len);
+  *pos += kHeader + total;
+  return 1;
+}
+
+// ---- server-side service -------------------------------------------------
+
+bool MemcacheService::expired_locked(const Item& it) const {
+  return it.expire_at_us != 0 && monotonic_time_us() >= it.expire_at_us;
+}
+
+size_t MemcacheService::item_count() {
+  LockGuard<FiberMutex> g(mu_);
+  // Sweep entries whose keys were never touched after expiring.
+  for (auto it = items_.begin(); it != items_.end();) {
+    it = expired_locked(it->second) ? items_.erase(it) : std::next(it);
+  }
+  return items_.size();
+}
+
+McResult MemcacheService::Execute(const McCommand& cmd) {
+  McResult r;
+  LockGuard<FiberMutex> g(mu_);
+  auto it = items_.find(cmd.key);
+  if (it != items_.end() && expired_locked(it->second)) {
+    // Lazy reclamation: an expired entry is erased the moment any op
+    // touches its key, so short-TTL churn on live keys cannot grow the
+    // map (item_count() sweeps the never-touched remainder).
+    items_.erase(it);
+    it = items_.end();
+  }
+  const bool present = it != items_.end();
+  auto expiry = [&]() -> int64_t {
+    if (cmd.exptime == 0) {
+      return 0;
+    }
+    // Per the memcache protocol, exptime above 30 days is an ABSOLUTE
+    // unix timestamp; at or below it is an offset from now.
+    constexpr uint32_t kRelativeLimit = 60 * 60 * 24 * 30;
+    int64_t rel_s = cmd.exptime <= kRelativeLimit
+                        ? static_cast<int64_t>(cmd.exptime)
+                        : static_cast<int64_t>(cmd.exptime) -
+                              static_cast<int64_t>(::time(nullptr));
+    if (rel_s <= 0) {
+      return monotonic_time_us();  // already expired
+    }
+    return monotonic_time_us() + rel_s * 1000000;
+  };
+  switch (cmd.op) {
+    case McOp::kGet: {
+      if (!present) {
+        r.status = McStatus::kNotFound;
+        break;
+      }
+      r.value = it->second.value;
+      r.flags = it->second.flags;
+      r.cas = it->second.cas;
+      break;
+    }
+    case McOp::kSet: {
+      if (cmd.cas != 0 && present && it->second.cas != cmd.cas) {
+        r.status = McStatus::kExists;
+        break;
+      }
+      if (cmd.cas != 0 && !present) {
+        r.status = McStatus::kNotFound;
+        break;
+      }
+      Item& item = items_[cmd.key];
+      item.value = cmd.value;
+      item.flags = cmd.flags;
+      item.cas = ++next_cas_;
+      item.expire_at_us = expiry();
+      r.cas = item.cas;
+      break;
+    }
+    case McOp::kAdd:
+    case McOp::kReplace: {
+      if (cmd.op == McOp::kAdd ? present : !present) {
+        r.status = McStatus::kNotStored;
+        break;
+      }
+      Item& item = items_[cmd.key];
+      item.value = cmd.value;
+      item.flags = cmd.flags;
+      item.cas = ++next_cas_;
+      item.expire_at_us = expiry();
+      r.cas = item.cas;
+      break;
+    }
+    case McOp::kAppend:
+    case McOp::kPrepend: {
+      if (!present) {
+        r.status = McStatus::kNotStored;
+        break;
+      }
+      if (cmd.op == McOp::kAppend) {
+        it->second.value += cmd.value;
+      } else {
+        it->second.value.insert(0, cmd.value);
+      }
+      it->second.cas = ++next_cas_;
+      r.cas = it->second.cas;
+      break;
+    }
+    case McOp::kDelete: {
+      if (!present) {
+        r.status = McStatus::kNotFound;
+        break;
+      }
+      items_.erase(it);
+      break;
+    }
+    case McOp::kIncrement:
+    case McOp::kDecrement: {
+      if (!present) {
+        // exptime 0xffffffff means "don't create on miss" per the spec.
+        if (cmd.exptime == 0xffffffffu) {
+          r.status = McStatus::kNotFound;
+          break;
+        }
+        Item& item = items_[cmd.key];
+        item.value = std::to_string(cmd.initial);
+        item.cas = ++next_cas_;
+        item.expire_at_us = expiry();
+        r.numeric = cmd.initial;
+        r.cas = item.cas;
+        break;
+      }
+      uint64_t cur = 0;
+      const std::string& v = it->second.value;
+      if (v.empty() ||
+          v.find_first_not_of("0123456789") != std::string::npos) {
+        r.status = McStatus::kDeltaBadValue;
+        break;
+      }
+      cur = strtoull(v.c_str(), nullptr, 10);
+      if (cmd.op == McOp::kIncrement) {
+        cur += cmd.delta;  // wraps at 2^64 per spec
+      } else {
+        cur = cur >= cmd.delta ? cur - cmd.delta : 0;  // floors at 0
+      }
+      it->second.value = std::to_string(cur);
+      it->second.cas = ++next_cas_;
+      r.numeric = cur;
+      r.cas = it->second.cas;
+      break;
+    }
+    case McOp::kTouch: {
+      if (!present) {
+        r.status = McStatus::kNotFound;
+        break;
+      }
+      it->second.expire_at_us = expiry();
+      break;
+    }
+    case McOp::kFlush:
+      items_.clear();
+      break;
+    case McOp::kNoop:
+      break;
+    case McOp::kVersion:
+      r.value = "1.6.0-trpc";
+      break;
+    default:
+      r.status = McStatus::kUnknownCommand;
+      break;
+  }
+  return r;
+}
+
+// ---- server protocol -----------------------------------------------------
+
+namespace {
+
+ParseError mc_cut(IOBuf* source, InputMessage* out, Socket* sock,
+                  uint8_t want_magic, bool probing) {
+  uint8_t head[kHeader];
+  const size_t got = source->copy_to(head, sizeof(head), 0);
+  if (got < 1) {
+    return ParseError::kNotEnoughData;
+  }
+  if (head[0] != want_magic) {
+    return probing ? ParseError::kTryOtherProtocol
+                   : ParseError::kCorrupted;
+  }
+  if (got < kHeader) {
+    return ParseError::kNotEnoughData;
+  }
+  const uint16_t key_len = read_u16(head + 2);
+  const uint8_t extras_len = head[4];
+  const uint32_t total = read_u32(head + 8);
+  if (total > kMaxBody ||
+      static_cast<uint32_t>(key_len) + extras_len > total) {
+    return probing ? ParseError::kTryOtherProtocol
+                   : ParseError::kCorrupted;
+  }
+  if (source->size() < kHeader + total) {
+    return ParseError::kNotEnoughData;
+  }
+  source->cutn(&out->payload, kHeader + total);
+  out->socket = sock != nullptr ? sock->id() : 0;
+  return ParseError::kOk;
+}
+
+ParseError mc_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  const bool probing = sock->pinned_protocol < 0;
+  if (probing) {
+    Server* srv = static_cast<Server*>(sock->user_data);
+    if (srv == nullptr || srv->memcache_service() == nullptr) {
+      return ParseError::kTryOtherProtocol;
+    }
+  }
+  return mc_cut(source, out, sock, kMagicRequest, probing);
+}
+
+// Runs INLINE in the read fiber (process_in_order): memcached answers on
+// one connection strictly in arrival order.
+void mc_process_request(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  Server* srv = static_cast<Server*>(sock->user_data);
+  if (srv == nullptr || srv->memcache_service() == nullptr) {
+    return;
+  }
+  std::string raw = msg.payload.to_string();
+  size_t pos = 0;
+  McFrame f;
+  if (mc_parse_frame(raw, &pos, &f) != 1) {
+    sock->SetFailed(EPROTO);
+    return;
+  }
+
+  McCommand cmd;
+  cmd.op = f.op;
+  cmd.key = std::move(f.key);
+  cmd.value = std::move(f.value);
+  cmd.cas = f.cas;
+  const uint8_t* ex = reinterpret_cast<const uint8_t*>(f.extras.data());
+  switch (f.op) {
+    case McOp::kSet:
+    case McOp::kAdd:
+    case McOp::kReplace:
+      if (f.extras.size() != 8) {
+        sock->SetFailed(EPROTO);
+        return;
+      }
+      cmd.flags = read_u32(ex);
+      cmd.exptime = read_u32(ex + 4);
+      break;
+    case McOp::kIncrement:
+    case McOp::kDecrement:
+      if (f.extras.size() != 20) {
+        sock->SetFailed(EPROTO);
+        return;
+      }
+      cmd.delta = read_u64(ex);
+      cmd.initial = read_u64(ex + 8);
+      cmd.exptime = read_u32(ex + 16);
+      break;
+    case McOp::kTouch:
+    case McOp::kFlush:
+      if (f.extras.size() == 4) {
+        cmd.exptime = read_u32(ex);
+      }
+      break;
+    default:
+      break;
+  }
+  if (cmd.key.size() > kMaxKey) {
+    std::string wire;
+    mc_pack_response(f.op, McStatus::kRemoteError, f.opaque, 0, "", "",
+                     "key too long", &wire);
+    IOBuf out;
+    out.append(wire);
+    sock->Write(std::move(out));
+    return;
+  }
+
+  {  // Interceptor gate (same body as every serving protocol).
+    int ec = 0;
+    std::string et;
+    if (!srv->accept_request("memcache", sock->remote(), &ec, &et)) {
+      std::string wire;
+      mc_pack_response(f.op, McStatus::kRemoteError, f.opaque, 0, "", "",
+                       et, &wire);
+      IOBuf out;
+      out.append(wire);
+      sock->Write(std::move(out));
+      return;
+    }
+  }
+
+  McResult r = srv->memcache_service()->Execute(cmd);
+  srv->requests_served.fetch_add(1, std::memory_order_relaxed);
+
+  std::string extras, value;
+  if (f.op == McOp::kGet && r.ok()) {
+    put_u32(&extras, r.flags);
+    value = std::move(r.value);
+  } else if ((f.op == McOp::kIncrement || f.op == McOp::kDecrement) &&
+             r.ok()) {
+    put_u64(&value, r.numeric);
+  } else if (f.op == McOp::kVersion || !r.ok()) {
+    value = std::move(r.value);
+  }
+  std::string wire;
+  mc_pack_response(f.op, r.status, f.opaque, r.cas, extras, "", value,
+                   &wire);
+  IOBuf out;
+  out.append(wire);
+  sock->Write(std::move(out));
+}
+
+void mc_process_response(InputMessage&&) {}
+
+}  // namespace
+
+void register_memcache_protocol() {
+  static int once = [] {
+    Protocol p = {"memcache", mc_parse, mc_process_request,
+                  mc_process_response,
+                  /*process_in_order=*/true};
+    return register_protocol(p);
+  }();
+  (void)once;
+}
+
+// ---- client --------------------------------------------------------------
+
+namespace {
+
+struct McWaiter {
+  CountdownEvent ev{1};
+  uint32_t opaque = 0;
+  McResult result;
+};
+
+struct McCliConn {
+  std::mutex mu;  // wire order == queue order (responses are FIFO)
+  std::deque<std::shared_ptr<McWaiter>> pending;
+};
+
+const char kMcCliTag = 0;
+
+McCliConn* mcli_conn_of(Socket* s) {
+  return proto_conn_of<McCliConn>(s, &kMcCliTag);
+}
+
+int install_mc_conn(Socket* s) {
+  mcli_conn_of(s);  // install state while single-threaded
+  return 0;
+}
+
+ParseError mcc_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  if (sock->pinned_protocol < 0) {
+    return ParseError::kTryOtherProtocol;  // client sockets are pre-pinned
+  }
+  ParseError rc =
+      mc_cut(source, out, sock, kMagicResponse, /*probing=*/false);
+  if (rc == ParseError::kOk) {
+    out->meta.type = RpcMeta::kResponse;
+  }
+  return rc;
+}
+
+void mcc_process_response(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  std::string raw = msg.payload.to_string();
+  size_t pos = 0;
+  McFrame f;
+  if (mc_parse_frame(raw, &pos, &f) != 1) {
+    sock->SetFailed(EPROTO);
+    return;
+  }
+  McCliConn* c = mcli_conn_of(sock.get());
+  std::shared_ptr<McWaiter> w;
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    if (c->pending.empty()) {
+      return;  // unsolicited
+    }
+    w = std::move(c->pending.front());
+    c->pending.pop_front();
+  }
+  McResult& r = w->result;
+  if (f.opaque != w->opaque) {
+    r.status = McStatus::kRemoteError;
+    r.value = "opaque mismatch";
+  } else {
+    r.status = static_cast<McStatus>(f.status_or_vbucket);
+    r.cas = f.cas;
+    if (f.op == McOp::kGet && r.ok()) {
+      if (f.extras.size() >= 4) {
+        r.flags = read_u32(
+            reinterpret_cast<const uint8_t*>(f.extras.data()));
+      }
+      r.value = std::move(f.value);
+    } else if ((f.op == McOp::kIncrement || f.op == McOp::kDecrement) &&
+               r.ok() && f.value.size() == 8) {
+      r.numeric =
+          read_u64(reinterpret_cast<const uint8_t*>(f.value.data()));
+    } else {
+      r.value = std::move(f.value);
+    }
+  }
+  w->ev.signal();
+}
+
+void mcc_process_request(InputMessage&&) {}
+
+int mcc_protocol_index() {
+  static const int index = [] {
+    Protocol p = {"memcachec", mcc_parse, mcc_process_request,
+                  mcc_process_response,
+                  /*process_in_order=*/true};
+    return register_protocol(p);
+  }();
+  return index;
+}
+
+McResult client_error(std::string text) {
+  McResult r;
+  r.status = McStatus::kRemoteError;
+  r.value = std::move(text);
+  return r;
+}
+
+}  // namespace
+
+MemcacheClient::~MemcacheClient() {
+  csock_.Shutdown();
+}
+
+int MemcacheClient::Init(const std::string& addr, const Options* opts) {
+  fiber_init(0);
+  if (opts != nullptr) {
+    opts_ = *opts;
+  }
+  mcc_protocol_index();
+  return csock_.Init(addr);
+}
+
+std::vector<McResult> MemcacheClient::batch(
+    const std::vector<McCommand>& cmds) {
+  std::vector<McResult> results(cmds.size());
+  SocketId sid = 0;
+  std::string wire;
+  std::vector<std::shared_ptr<McWaiter>> waiters;
+  waiters.reserve(cmds.size());
+  {
+    LockGuard<FiberMutex> g(sock_mu_);
+    if (csock_.ensure(mcc_protocol_index(), install_mc_conn, &sid) != 0) {
+      std::fill(results.begin(), results.end(),
+                client_error("cannot reach " +
+                             endpoint2str(csock_.endpoint())));
+      return results;
+    }
+    for (const McCommand& cmd : cmds) {
+      auto w = std::make_shared<McWaiter>();
+      w->opaque = next_opaque_++;
+      mc_pack_request(cmd, w->opaque, &wire);
+      waiters.push_back(std::move(w));
+    }
+  }
+  SocketRef s(Socket::Address(sid));
+  if (!s) {
+    std::fill(results.begin(), results.end(),
+              client_error("connection failed"));
+    return results;
+  }
+  McCliConn* c = mcli_conn_of(s.get());
+  {
+    // Queue order must equal wire order: both under one lock.
+    std::lock_guard<std::mutex> g(c->mu);
+    for (auto& w : waiters) {
+      c->pending.push_back(w);
+    }
+    IOBuf frame;
+    frame.append(wire);
+    if (s->Write(std::move(frame)) != 0) {
+      for (auto& r : results) {
+        r = client_error("write failed");
+      }
+      return results;
+    }
+  }
+  const int64_t deadline = monotonic_time_us() + opts_.timeout_ms * 1000;
+  for (size_t i = 0; i < waiters.size(); ++i) {
+    if (waiters[i]->ev.wait(deadline) == 0) {
+      results[i] = std::move(waiters[i]->result);
+    } else {
+      results[i] = client_error("timeout");
+    }
+  }
+  return results;
+}
+
+McResult MemcacheClient::one(const McCommand& cmd) {
+  std::vector<McResult> r = batch({cmd});
+  return r.empty() ? client_error("empty batch") : std::move(r[0]);
+}
+
+McResult MemcacheClient::Get(const std::string& key) {
+  McCommand c;
+  c.op = McOp::kGet;
+  c.key = key;
+  return one(c);
+}
+
+McResult MemcacheClient::Set(const std::string& key,
+                             const std::string& value, uint32_t flags,
+                             uint32_t exptime, uint64_t cas) {
+  McCommand c;
+  c.op = McOp::kSet;
+  c.key = key;
+  c.value = value;
+  c.flags = flags;
+  c.exptime = exptime;
+  c.cas = cas;
+  return one(c);
+}
+
+McResult MemcacheClient::Add(const std::string& key,
+                             const std::string& value, uint32_t flags,
+                             uint32_t exptime) {
+  McCommand c;
+  c.op = McOp::kAdd;
+  c.key = key;
+  c.value = value;
+  c.flags = flags;
+  c.exptime = exptime;
+  return one(c);
+}
+
+McResult MemcacheClient::Replace(const std::string& key,
+                                 const std::string& value, uint32_t flags,
+                                 uint32_t exptime) {
+  McCommand c;
+  c.op = McOp::kReplace;
+  c.key = key;
+  c.value = value;
+  c.flags = flags;
+  c.exptime = exptime;
+  return one(c);
+}
+
+McResult MemcacheClient::Append(const std::string& key,
+                                const std::string& value) {
+  McCommand c;
+  c.op = McOp::kAppend;
+  c.key = key;
+  c.value = value;
+  return one(c);
+}
+
+McResult MemcacheClient::Prepend(const std::string& key,
+                                 const std::string& value) {
+  McCommand c;
+  c.op = McOp::kPrepend;
+  c.key = key;
+  c.value = value;
+  return one(c);
+}
+
+McResult MemcacheClient::Delete(const std::string& key) {
+  McCommand c;
+  c.op = McOp::kDelete;
+  c.key = key;
+  return one(c);
+}
+
+McResult MemcacheClient::Increment(const std::string& key, uint64_t delta,
+                                   uint64_t initial, uint32_t exptime) {
+  McCommand c;
+  c.op = McOp::kIncrement;
+  c.key = key;
+  c.delta = delta;
+  c.initial = initial;
+  c.exptime = exptime;
+  return one(c);
+}
+
+McResult MemcacheClient::Decrement(const std::string& key, uint64_t delta,
+                                   uint64_t initial, uint32_t exptime) {
+  McCommand c;
+  c.op = McOp::kDecrement;
+  c.key = key;
+  c.delta = delta;
+  c.initial = initial;
+  c.exptime = exptime;
+  return one(c);
+}
+
+McResult MemcacheClient::Touch(const std::string& key, uint32_t exptime) {
+  McCommand c;
+  c.op = McOp::kTouch;
+  c.key = key;
+  c.exptime = exptime;
+  return one(c);
+}
+
+McResult MemcacheClient::Version() {
+  McCommand c;
+  c.op = McOp::kVersion;
+  return one(c);
+}
+
+McResult MemcacheClient::Flush() {
+  McCommand c;
+  c.op = McOp::kFlush;
+  return one(c);
+}
+
+}  // namespace trpc

@@ -1,0 +1,535 @@
+#include "net/nshead.h"
+
+#include <errno.h>
+
+#include <cstring>
+
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+
+#include "base/logging.h"
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "net/messenger.h"
+#include "net/protocol.h"
+#include "net/server.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr size_t kMaxBody = 64ull << 20;
+
+// Cuts one nshead frame (head stays in out->meta via ctx).  The magic at
+// offset 24 is the probe discriminator.
+struct NsheadFrame {
+  NsheadHead head;
+  IOBuf body;
+};
+
+ParseError nshead_cut(IOBuf* source, InputMessage* out, Socket* sock,
+                      bool probing) {
+  NsheadHead head;
+  IOBuf body;
+  const int rc = nshead_cut_frame(source, &head, &body);
+  if (rc == 0) {
+    return probing ? nshead_probe_short(source)
+                   : ParseError::kNotEnoughData;
+  }
+  if (rc < 0) {
+    return probing ? ParseError::kTryOtherProtocol
+                   : ParseError::kCorrupted;
+  }
+  auto frame = std::make_shared<NsheadFrame>();
+  frame->head = head;
+  frame->body = std::move(body);
+  out->ctx = std::move(frame);
+  out->socket = sock != nullptr ? sock->id() : 0;
+  return ParseError::kOk;
+}
+
+}  // namespace
+
+ParseError nshead_probe_short(IOBuf* source) {
+  // Probing with an incomplete header: HOLD the connection (returning
+  // kTryOtherProtocol would let the probe loop fall through every
+  // protocol and kill a legitimate fragmented first frame) — but only
+  // while the bytes seen could still become an nshead frame.  The magic
+  // at offset 24 rules frames out as soon as 28 bytes are visible; the
+  // leading id/version bytes are arbitrary and rule out nothing.
+  uint8_t pre[28];
+  const size_t got = source->copy_to(pre, sizeof(pre), 0);
+  if (got >= sizeof(pre)) {
+    uint32_t magic;
+    std::memcpy(&magic, pre + 24, 4);
+    if (magic != kNsheadMagic) {
+      return ParseError::kTryOtherProtocol;
+    }
+  }
+  return ParseError::kNotEnoughData;
+}
+
+int nshead_cut_frame(IOBuf* source, NsheadHead* head, IOBuf* body) {
+  const size_t got = source->copy_to(head, sizeof(*head), 0);
+  if (got < sizeof(*head)) {
+    return 0;
+  }
+  if (head->magic_num != kNsheadMagic || head->body_len > kMaxBody) {
+    return -1;
+  }
+  if (source->size() < sizeof(*head) + head->body_len) {
+    return 0;
+  }
+  source->pop_front(sizeof(*head));
+  source->cutn(body, head->body_len);
+  return 1;
+}
+
+void nshead_pack(const NsheadHead& head, const IOBuf& body, IOBuf* out) {
+  NsheadHead h = head;
+  h.magic_num = kNsheadMagic;
+  h.body_len = static_cast<uint32_t>(body.size());
+  out->append(&h, sizeof(h));
+  out->append(body);
+}
+
+// ---- nshead server -------------------------------------------------------
+
+namespace {
+
+ParseError nshead_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  const bool probing = sock->pinned_protocol < 0;
+  if (probing) {
+    Server* srv = static_cast<Server*>(sock->user_data);
+    if (srv == nullptr || srv->nshead_service() == nullptr) {
+      return ParseError::kTryOtherProtocol;
+    }
+  }
+  return nshead_cut(source, out, sock, probing);
+}
+
+// Inline in the read fiber: the wire has no correlation id, so responses
+// must leave in arrival order.
+void nshead_process_request(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  Server* srv = static_cast<Server*>(sock->user_data);
+  auto frame = std::static_pointer_cast<NsheadFrame>(msg.ctx);
+  if (srv == nullptr || srv->nshead_service() == nullptr ||
+      frame == nullptr) {
+    return;
+  }
+  {  // Interceptor gate (same body as every serving protocol).
+    int ec = 0;
+    std::string et;
+    if (!srv->accept_request("nshead", sock->remote(), &ec, &et)) {
+      sock->SetFailed(EACCES);
+      return;
+    }
+  }
+  NsheadHead resp_head = frame->head;  // echo id/version/log_id/provider
+  IOBuf resp_body;
+  srv->nshead_service()->handler()(frame->head, frame->body, &resp_head,
+                                   &resp_body);
+  srv->requests_served.fetch_add(1, std::memory_order_relaxed);
+  IOBuf out;
+  nshead_pack(resp_head, resp_body, &out);
+  sock->Write(std::move(out));
+}
+
+void nshead_process_response(InputMessage&&) {}
+
+}  // namespace
+
+void register_nshead_protocol() {
+  static int once = [] {
+    Protocol p = {"nshead", nshead_parse, nshead_process_request,
+                  nshead_process_response,
+                  /*process_in_order=*/true};
+    return register_protocol(p);
+  }();
+  (void)once;
+}
+
+// ---- nshead client -------------------------------------------------------
+
+namespace {
+
+struct NsheadWaiter {
+  CountdownEvent ev{1};
+  bool ok = false;
+  NsheadHead head;
+  IOBuf body;
+};
+
+struct NsheadCliConn {
+  std::mutex mu;  // queue order == wire order (FIFO correlation)
+  std::deque<std::shared_ptr<NsheadWaiter>> pending;
+};
+
+const char kNsheadCliTag = 0;
+
+NsheadCliConn* nscli_conn_of(Socket* s) {
+  return proto_conn_of<NsheadCliConn>(s, &kNsheadCliTag);
+}
+
+int install_nshead_conn(Socket* s) {
+  nscli_conn_of(s);
+  return 0;
+}
+
+ParseError nsheadc_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  if (sock->pinned_protocol < 0) {
+    return ParseError::kTryOtherProtocol;  // client sockets are pre-pinned
+  }
+  ParseError rc = nshead_cut(source, out, sock, /*probing=*/false);
+  if (rc == ParseError::kOk) {
+    out->meta.type = RpcMeta::kResponse;
+  }
+  return rc;
+}
+
+void nsheadc_process_response(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  auto frame = std::static_pointer_cast<NsheadFrame>(msg.ctx);
+  NsheadCliConn* c = nscli_conn_of(sock.get());
+  std::shared_ptr<NsheadWaiter> w;
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    if (c->pending.empty()) {
+      return;  // unsolicited
+    }
+    w = std::move(c->pending.front());
+    c->pending.pop_front();
+  }
+  w->ok = true;
+  w->head = frame->head;
+  w->body = std::move(frame->body);
+  w->ev.signal();
+}
+
+void nsheadc_process_request(InputMessage&&) {}
+
+int nsheadc_protocol_index() {
+  static const int index = [] {
+    Protocol p = {"nsheadc", nsheadc_parse, nsheadc_process_request,
+                  nsheadc_process_response,
+                  /*process_in_order=*/true};
+    return register_protocol(p);
+  }();
+  return index;
+}
+
+}  // namespace
+
+NsheadClient::~NsheadClient() {
+  csock_.Shutdown();
+}
+
+int NsheadClient::Init(const std::string& addr, const Options* opts) {
+  fiber_init(0);
+  if (opts != nullptr) {
+    opts_ = *opts;
+  }
+  nsheadc_protocol_index();
+  return csock_.Init(addr);
+}
+
+int NsheadClient::call(const NsheadHead& head, const IOBuf& body,
+                       NsheadHead* resp_head, IOBuf* resp_body) {
+  SocketId sid = 0;
+  {
+    LockGuard<FiberMutex> g(sock_mu_);
+    if (csock_.ensure(nsheadc_protocol_index(), install_nshead_conn,
+                      &sid) != 0) {
+      return -1;
+    }
+  }
+  SocketRef s(Socket::Address(sid));
+  if (!s) {
+    return -1;
+  }
+  NsheadCliConn* c = nscli_conn_of(s.get());
+  auto w = std::make_shared<NsheadWaiter>();
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    c->pending.push_back(w);
+    IOBuf out;
+    nshead_pack(head, body, &out);
+    if (s->Write(std::move(out)) != 0) {
+      c->pending.pop_back();
+      return -1;
+    }
+  }
+  const int64_t deadline = monotonic_time_us() + opts_.timeout_ms * 1000;
+  if (w->ev.wait(deadline) != 0 || !w->ok) {
+    return -1;  // waiter stays queued so later replies keep alignment
+  }
+  if (resp_head != nullptr) {
+    *resp_head = w->head;
+  }
+  if (resp_body != nullptr) {
+    *resp_body = std::move(w->body);
+  }
+  return 0;
+}
+
+// ---- esp -----------------------------------------------------------------
+
+bool EspService::AddMessageHandler(uint32_t msg, Handler h) {
+  return handlers_.emplace(msg, std::move(h)).second;
+}
+
+const EspService::Handler* EspService::FindMessageHandler(
+    uint32_t msg) const {
+  auto it = handlers_.find(msg);
+  return it == handlers_.end() ? nullptr : &it->second;
+}
+
+namespace {
+
+struct EspFrame {
+  EspHead head;
+  IOBuf body;
+};
+
+ParseError esp_cut(IOBuf* source, InputMessage* out, Socket* sock,
+                   bool probing) {
+  EspHead head;
+  const size_t got = source->copy_to(&head, sizeof(head), 0);
+  if (got < sizeof(head)) {
+    // esp has NO magic: an esp-enabled server claims the connection on
+    // faith (the reference only ever speaks esp client-side; a server
+    // installing an EspService is dedicating the port to it).  A short
+    // prefix therefore HOLDS — killing it would break any fragmented
+    // first frame on a dedicated esp port.
+    return ParseError::kNotEnoughData;
+  }
+  if (head.body_len < 0 || static_cast<size_t>(head.body_len) > kMaxBody) {
+    return probing ? ParseError::kTryOtherProtocol
+                   : ParseError::kCorrupted;
+  }
+  if (source->size() < sizeof(head) + head.body_len) {
+    return ParseError::kNotEnoughData;
+  }
+  source->pop_front(sizeof(head));
+  auto frame = std::make_shared<EspFrame>();
+  frame->head = head;
+  source->cutn(&frame->body, head.body_len);
+  out->ctx = std::move(frame);
+  out->socket = sock != nullptr ? sock->id() : 0;
+  return ParseError::kOk;
+}
+
+ParseError esp_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  const bool probing = sock->pinned_protocol < 0;
+  if (probing) {
+    Server* srv = static_cast<Server*>(sock->user_data);
+    if (srv == nullptr || srv->esp_service() == nullptr) {
+      return ParseError::kTryOtherProtocol;
+    }
+  }
+  return esp_cut(source, out, sock, probing);
+}
+
+void esp_process_request(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  Server* srv = static_cast<Server*>(sock->user_data);
+  auto frame = std::static_pointer_cast<EspFrame>(msg.ctx);
+  if (srv == nullptr || srv->esp_service() == nullptr ||
+      frame == nullptr) {
+    return;
+  }
+  {  // Interceptor gate.
+    int ec = 0;
+    std::string et;
+    if (!srv->accept_request("esp#" + std::to_string(frame->head.msg),
+                             sock->remote(), &ec, &et)) {
+      sock->SetFailed(EACCES);
+      return;
+    }
+  }
+  const EspService::Handler* h =
+      srv->esp_service()->FindMessageHandler(frame->head.msg);
+  IOBuf resp_body;
+  if (h != nullptr) {
+    (*h)(frame->head, frame->body, &resp_body);
+  }
+  srv->requests_served.fetch_add(1, std::memory_order_relaxed);
+  EspHead resp = frame->head;  // echoes msg_id (the correlation contract)
+  std::swap(resp.from, resp.to);
+  resp.body_len = static_cast<int32_t>(resp_body.size());
+  IOBuf out;
+  out.append(&resp, sizeof(resp));
+  out.append(resp_body);
+  sock->Write(std::move(out));
+}
+
+void esp_process_response(InputMessage&&) {}
+
+}  // namespace
+
+void register_esp_protocol() {
+  static int once = [] {
+    Protocol p = {"esp", esp_parse, esp_process_request,
+                  esp_process_response,
+                  /*process_in_order=*/false};
+    return register_protocol(p);
+  }();
+  (void)once;
+}
+
+// ---- esp client ----------------------------------------------------------
+
+namespace {
+
+struct EspWaiter {
+  CountdownEvent ev{1};
+  bool ok = false;
+  IOBuf body;
+};
+
+struct EspCliConn {
+  std::mutex mu;
+  std::map<uint64_t, std::shared_ptr<EspWaiter>> pending;  // by msg_id
+};
+
+const char kEspCliTag = 0;
+
+EspCliConn* espcli_conn_of(Socket* s) {
+  return proto_conn_of<EspCliConn>(s, &kEspCliTag);
+}
+
+int install_esp_conn(Socket* s) {
+  espcli_conn_of(s);
+  return 0;
+}
+
+ParseError espc_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  if (sock->pinned_protocol < 0) {
+    return ParseError::kTryOtherProtocol;
+  }
+  ParseError rc = esp_cut(source, out, sock, /*probing=*/false);
+  if (rc == ParseError::kOk) {
+    out->meta.type = RpcMeta::kResponse;
+  }
+  return rc;
+}
+
+void espc_process_response(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  auto frame = std::static_pointer_cast<EspFrame>(msg.ctx);
+  EspCliConn* c = espcli_conn_of(sock.get());
+  std::shared_ptr<EspWaiter> w;
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    auto it = c->pending.find(frame->head.msg_id);
+    if (it == c->pending.end()) {
+      return;  // unsolicited / timed out
+    }
+    w = std::move(it->second);
+    c->pending.erase(it);
+  }
+  w->ok = true;
+  w->body = std::move(frame->body);
+  w->ev.signal();
+}
+
+void espc_process_request(InputMessage&&) {}
+
+int espc_protocol_index() {
+  static const int index = [] {
+    Protocol p = {"espc", espc_parse, espc_process_request,
+                  espc_process_response,
+                  /*process_in_order=*/true};
+    return register_protocol(p);
+  }();
+  return index;
+}
+
+}  // namespace
+
+EspClient::~EspClient() {
+  csock_.Shutdown();
+}
+
+int EspClient::Init(const std::string& addr, const Options* opts) {
+  fiber_init(0);
+  if (opts != nullptr) {
+    opts_ = *opts;
+  }
+  espc_protocol_index();
+  return csock_.Init(addr);
+}
+
+int EspClient::call(uint32_t msg, const IOBuf& body, IOBuf* resp_body) {
+  SocketId sid = 0;
+  EspHead head;
+  {
+    LockGuard<FiberMutex> g(sock_mu_);
+    if (csock_.ensure(espc_protocol_index(), install_esp_conn, &sid) !=
+        0) {
+      return -1;
+    }
+    head.msg_id = next_msg_id_++;
+  }
+  head.msg = msg;
+  head.to = static_cast<uint64_t>(opts_.to_stub);
+  head.body_len = static_cast<int32_t>(body.size());
+
+  SocketRef s(Socket::Address(sid));
+  if (!s) {
+    return -1;
+  }
+  EspCliConn* c = espcli_conn_of(s.get());
+  auto w = std::make_shared<EspWaiter>();
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    c->pending.emplace(head.msg_id, w);
+  }
+  IOBuf out;
+  out.append(&head, sizeof(head));
+  out.append(body);
+  if (s->Write(std::move(out)) != 0) {
+    std::lock_guard<std::mutex> g(c->mu);
+    c->pending.erase(head.msg_id);
+    return -1;
+  }
+  const int64_t deadline = monotonic_time_us() + opts_.timeout_ms * 1000;
+  if (w->ev.wait(deadline) != 0 || !w->ok) {
+    std::lock_guard<std::mutex> g(c->mu);
+    c->pending.erase(head.msg_id);
+    return -1;
+  }
+  if (resp_body != nullptr) {
+    *resp_body = std::move(w->body);
+  }
+  return 0;
+}
+
+}  // namespace trpc

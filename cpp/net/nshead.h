@@ -1,0 +1,158 @@
+// nshead + esp — Baidu legacy fixed-header protocols, server AND client.
+//
+// Parity: the reference serves nshead-family traffic through
+// NsheadService (/root/reference/src/brpc/nshead_service.h; wire struct
+// nshead.h: 36-byte native-order header with magic 0xfb709394 and
+// body_len) and speaks esp client-side (esp_message.h / esp_head.h:
+// packed 32-byte head {from,to,msg,msg_id,body_len}, native order;
+// policy/esp_protocol.cpp correlates responses by msg_id).  Condensed
+// forms: raw byte-level services (handlers see head + body IOBuf) and
+// per-protocol clients in the RedisClient mold — nshead correlates FIFO
+// (the wire has no id the peer must echo), esp by msg_id.
+//
+// These are also the substrate for the nova/public pbrpc protocols
+// (net/legacy_pbrpc.h), which ride the same nshead framing.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <string>
+
+#include "base/endpoint.h"
+#include "base/iobuf.h"
+#include "fiber/sync.h"
+#include "net/proto_client.h"
+#include "net/protocol.h"
+#include "net/socket.h"
+
+namespace trpc {
+
+class Server;
+
+// ---- nshead --------------------------------------------------------------
+
+constexpr uint32_t kNsheadMagic = 0xfb709394u;
+
+#pragma pack(push, 1)
+// 36 bytes, native byte order on the wire (the reference inherits this
+// from the unchangeable public/nshead definition).
+struct NsheadHead {
+  uint16_t id = 0;
+  uint16_t version = 0;
+  uint32_t log_id = 0;
+  char provider[16] = {};
+  uint32_t magic_num = kNsheadMagic;
+  uint32_t reserved = 0;
+  uint32_t body_len = 0;
+};
+#pragma pack(pop)
+static_assert(sizeof(NsheadHead) == 36, "nshead wire layout");
+
+// Raw nshead server: one handler sees every message (head + body) and
+// fills the response body (+ optionally mutates the response head, which
+// starts as a copy of the request's with body_len fixed up).  Assign via
+// Server::set_nshead_service.  Runs inline in the read fiber: responses
+// leave in arrival order (the wire has no correlation id).
+class NsheadService {
+ public:
+  using Handler = std::function<void(const NsheadHead& head,
+                                     const IOBuf& body,
+                                     NsheadHead* resp_head,
+                                     IOBuf* resp_body)>;
+  explicit NsheadService(Handler h) : handler_(std::move(h)) {}
+  const Handler& handler() const { return handler_; }
+
+ private:
+  Handler handler_;
+};
+
+void register_nshead_protocol();
+
+// Packs head (fixing body_len) + body.
+void nshead_pack(const NsheadHead& head, const IOBuf& body, IOBuf* out);
+
+// Cuts one complete nshead frame off `source` (shared by the raw nshead
+// protocol and the nova/public pbrpc personalities that ride the same
+// framing).  Returns 1 ok / 0 not-enough-data / -1 not-nshead (probing:
+// magic mismatch or oversized body; the caller maps -1 to
+// kTryOtherProtocol while probing, kCorrupted once pinned).
+int nshead_cut_frame(IOBuf* source, NsheadHead* head, IOBuf* body);
+
+// Probe-time policy for an incomplete nshead header: hold while the
+// visible prefix could still be nshead (magic checked once 28 bytes are
+// visible), else kTryOtherProtocol.  Shared with nova/public pbrpc.
+ParseError nshead_probe_short(IOBuf* source);
+
+// FIFO nshead client (one connection; responses arrive in order).
+class NsheadClient {
+ public:
+  struct Options {
+    int64_t timeout_ms = 1000;
+  };
+
+  ~NsheadClient();
+  int Init(const std::string& addr, const Options* opts = nullptr);
+
+  // One exchange; returns 0 and fills resp_head/resp_body, or -1.
+  int call(const NsheadHead& head, const IOBuf& body,
+           NsheadHead* resp_head, IOBuf* resp_body);
+
+ private:
+  Options opts_;
+  FiberMutex sock_mu_;
+  ClientSocket csock_;
+};
+
+// ---- esp -----------------------------------------------------------------
+
+#pragma pack(push, 1)
+struct EspHead {
+  uint64_t from = 0;  // {stub u16, port u16, ip u32} packed
+  uint64_t to = 0;
+  uint32_t msg = 0;      // message/command number
+  uint64_t msg_id = 0;   // correlation id, echoed by the peer
+  int32_t body_len = 0;
+};
+#pragma pack(pop)
+static_assert(sizeof(EspHead) == 32, "esp wire layout");
+
+// esp server: handlers keyed by msg number; the reply echoes msg_id.
+// Assign via Server::set_esp_service.
+class EspService {
+ public:
+  using Handler =
+      std::function<void(const EspHead& head, const IOBuf& body,
+                         IOBuf* resp_body)>;
+  bool AddMessageHandler(uint32_t msg, Handler h);
+  const Handler* FindMessageHandler(uint32_t msg) const;
+
+ private:
+  std::map<uint32_t, Handler> handlers_;
+};
+
+void register_esp_protocol();
+
+// esp client: call(msg, body) correlates the response by msg_id, so
+// concurrent calls on the shared connection are fine.
+class EspClient {
+ public:
+  struct Options {
+    int64_t timeout_ms = 1000;
+    uint16_t to_stub = 0;  // copied into EspHead.to
+  };
+
+  ~EspClient();
+  int Init(const std::string& addr, const Options* opts = nullptr);
+
+  int call(uint32_t msg, const IOBuf& body, IOBuf* resp_body);
+
+ private:
+  Options opts_;
+  FiberMutex sock_mu_;
+  ClientSocket csock_;
+  uint64_t next_msg_id_ = 1;
+};
+
+}  // namespace trpc

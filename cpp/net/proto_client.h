@@ -1,0 +1,91 @@
+// Shared scaffold for the per-protocol clients (redis/thrift/memcache/
+// nshead/esp/legacy-pbrpc): lazy-connecting pinned socket + typed
+// per-connection parse state.  One implementation of the
+// reconnect-while-failed and install-before-first-byte logic instead of
+// a hand-kept copy per client.
+#pragma once
+
+#include <functional>
+#include <memory>
+#include <string>
+
+#include "base/endpoint.h"
+#include "net/messenger.h"
+#include "net/socket.h"
+
+namespace trpc {
+
+// Returns the connection's protocol-private state, installing a fresh
+// `Conn` when absent or owned by another protocol.  `tag` identifies the
+// owner (one static char per protocol).  Safe to call from the read
+// fiber and from call sites that installed it before the first byte.
+template <typename Conn>
+Conn* proto_conn_of(Socket* s, const char* tag) {
+  if (s->parse_state == nullptr || s->parse_state_owner != tag) {
+    s->parse_state = std::make_shared<Conn>();
+    s->parse_state_owner = tag;
+  }
+  return static_cast<Conn*>(s->parse_state.get());
+}
+
+// One lazily-connected socket bound to a protocol index.  Callers
+// serialize ensure() under their own mutex (they also allocate ids /
+// sequence numbers under it).
+class ClientSocket {
+ public:
+  // Resolves the address; 0 on success.
+  int Init(const std::string& addr) {
+    return hostname2endpoint(addr.c_str(), &ep_);
+  }
+  const EndPoint& endpoint() const { return ep_; }
+
+  // Fills *out with a live socket id, creating a fresh socket (lazy
+  // connect in the write fiber) when absent or failed.  `pinned_index`
+  // is the client protocol to pin; `install` runs on a fresh socket
+  // while it is still single-threaded (install parse state, send an
+  // auth preamble, ...).  Returns 0 on success.
+  int ensure(int pinned_index,
+             const std::function<int(Socket*)>& install, SocketId* out) {
+    Socket* s = Socket::Address(sock_);
+    if (s != nullptr) {
+      if (!s->Failed()) {
+        *out = sock_;
+        s->Dereference();
+        return 0;
+      }
+      s->Dereference();
+    }
+    Socket::Options sopts;
+    sopts.fd = -1;  // lazy connect in the write fiber
+    sopts.remote = ep_;
+    sopts.on_readable = &messenger_on_readable;
+    if (Socket::Create(sopts, &sock_) != 0) {
+      return -1;
+    }
+    SocketRef fresh(Socket::Address(sock_));
+    if (!fresh) {
+      return -1;
+    }
+    fresh->pinned_protocol = pinned_index;
+    if (install && install(fresh.get()) != 0) {
+      fresh->SetFailed(ECONNABORTED);
+      return -1;
+    }
+    *out = sock_;
+    return 0;
+  }
+
+  // Fails the current socket (client destructors).
+  void Shutdown() {
+    SocketRef s(Socket::Address(sock_));
+    if (s) {
+      s->SetFailed(ESHUTDOWN);
+    }
+  }
+
+ private:
+  EndPoint ep_;
+  SocketId sock_ = 0;
+};
+
+}  // namespace trpc

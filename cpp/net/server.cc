@@ -20,6 +20,9 @@
 #include "net/h2_protocol.h"
 #include "net/http_protocol.h"
 #include "net/redis.h"
+#include "net/memcache.h"
+#include "net/legacy_pbrpc.h"
+#include "net/nshead.h"
 #include "net/thrift.h"
 #include "net/tls.h"
 #include "net/messenger.h"
@@ -205,10 +208,29 @@ int Server::Start(int port) {
   fiber_init(0);
   expose_default_variables();
   tstd_protocol();  // ensure registered (first: most traffic is RPC)
+  // hulu/sofa next: their 4-byte ASCII magics must be probed before the
+  // HTTP parser sees the 'H'/'S' and holds the bytes as a method line.
+  register_hulu_protocol();
+  register_sofa_protocol();
   register_http_protocol();
   register_h2_protocol();
   if (thrift_service_ != nullptr) {
     register_thrift_protocol();
+  }
+  if (memcache_service_ != nullptr) {
+    register_memcache_protocol();
+  }
+  if (nshead_service_ != nullptr) {
+    register_nshead_protocol();
+  }
+  if (nova_pbrpc_) {
+    register_nova_protocol();
+  }
+  if (public_pbrpc_) {
+    register_public_pbrpc_protocol();
+  }
+  if (esp_service_ != nullptr) {
+    register_esp_protocol();  // last: esp has no magic to probe
   }
   if (redis_service_ != nullptr) {
     register_redis_protocol();

@@ -28,6 +28,9 @@ namespace trpc {
 
 class RedisService;   // net/redis.h
 class ThriftService;  // net/thrift.h
+class MemcacheService;  // net/memcache.h
+class NsheadService;  // net/nshead.h
+class EspService;     // net/nshead.h
 
 class Server {
  public:
@@ -78,6 +81,31 @@ class Server {
   // thrift_service.h).  Not owned.  Call before Start.
   void set_thrift_service(ThriftService* ts) { thrift_service_ = ts; }
   ThriftService* thrift_service() const { return thrift_service_; }
+
+  // Makes this server speak the memcache binary protocol on its port
+  // (net/memcache.h; the reference is client-only — policy/
+  // memcache_binary_protocol.cpp — the serving side here doubles as the
+  // in-process fixture its tests fake externally).  Not owned.
+  void set_memcache_service(MemcacheService* ms) { memcache_service_ = ms; }
+  MemcacheService* memcache_service() const { return memcache_service_; }
+
+  // nshead-family personalities (net/nshead.h, net/legacy_pbrpc.h).  The
+  // 36-byte head's magic is the shared discriminator, so install at most
+  // ONE nshead-riding personality per server (raw nshead / nova pbrpc /
+  // public pbrpc) — parity: ServerOptions::nshead_service is singular.
+  void set_nshead_service(NsheadService* ns) { nshead_service_ = ns; }
+  NsheadService* nshead_service() const { return nshead_service_; }
+  // esp has NO wire magic: an esp-enabled server dedicates its port.
+  void set_esp_service(EspService* es) { esp_service_ = es; }
+  EspService* esp_service() const { return esp_service_; }
+
+  // nova / public_pbrpc personalities (net/legacy_pbrpc.h): dispatch
+  // nshead-framed pb calls into the method registry ("Nova.#<idx>" /
+  // "<service>.#<id>" keys).  Same one-per-server rule as nshead above.
+  void enable_nova_pbrpc() { nova_pbrpc_ = true; }
+  bool nova_pbrpc_enabled() const { return nova_pbrpc_; }
+  void enable_public_pbrpc() { public_pbrpc_ = true; }
+  bool public_pbrpc_enabled() const { return public_pbrpc_; }
 
   // Serves TLS on this server's port (net/tls.h; parity: ServerOptions::
   // mutable_ssl_options, details/ssl_helper.cpp).  Plaintext clients KEEP
@@ -155,6 +183,11 @@ class Server {
   Interceptor interceptor_;
   RedisService* redis_service_ = nullptr;
   ThriftService* thrift_service_ = nullptr;
+  MemcacheService* memcache_service_ = nullptr;
+  NsheadService* nshead_service_ = nullptr;
+  EspService* esp_service_ = nullptr;
+  bool nova_pbrpc_ = false;
+  bool public_pbrpc_ = false;
   void* tls_ctx_ = nullptr;  // SSL_CTX (leaked singleton; net/tls.h)
   FlatMap<std::string, MethodProperty> methods_;
   // (pattern segments, trailing-wildcard, method name), longest first.

@@ -625,12 +625,7 @@ struct ThriftCliConn {
 const char kThriftCliTag = 0;
 
 ThriftCliConn* tcli_conn_of(Socket* s) {
-  if (s->parse_state == nullptr ||
-      s->parse_state_owner != &kThriftCliTag) {
-    s->parse_state = std::make_shared<ThriftCliConn>();
-    s->parse_state_owner = &kThriftCliTag;
-  }
-  return static_cast<ThriftCliConn*>(s->parse_state.get());
+  return proto_conn_of<ThriftCliConn>(s, &kThriftCliTag);
 }
 
 ParseError thriftc_parse(IOBuf* source, InputMessage* out, Socket* sock) {
@@ -714,11 +709,15 @@ int thriftc_protocol_index() {
 }  // namespace
 
 ThriftClient::~ThriftClient() {
-  SocketRef s(Socket::Address(sock_));
-  if (s) {
-    s->SetFailed(ESHUTDOWN);
-  }
+  csock_.Shutdown();
 }
+
+namespace {
+int install_thrift_conn(Socket* s) {
+  tcli_conn_of(s);  // install state while single-threaded
+  return 0;
+}
+}  // namespace
 
 int ThriftClient::Init(const std::string& addr, const Options* opts) {
   fiber_init(0);
@@ -726,34 +725,7 @@ int ThriftClient::Init(const std::string& addr, const Options* opts) {
     opts_ = *opts;
   }
   thriftc_protocol_index();
-  return hostname2endpoint(addr.c_str(), &ep_);
-}
-
-int ThriftClient::ensure_socket(SocketId* out) {
-  Socket* s = Socket::Address(sock_);
-  if (s != nullptr) {
-    if (!s->Failed()) {
-      *out = sock_;
-      s->Dereference();
-      return 0;
-    }
-    s->Dereference();
-  }
-  Socket::Options sopts;
-  sopts.fd = -1;  // lazy connect in the write fiber
-  sopts.remote = ep_;
-  sopts.on_readable = &messenger_on_readable;
-  if (Socket::Create(sopts, &sock_) != 0) {
-    return -1;
-  }
-  SocketRef fresh(Socket::Address(sock_));
-  if (!fresh) {
-    return -1;
-  }
-  fresh->pinned_protocol = thriftc_protocol_index();
-  tcli_conn_of(fresh.get());  // install state while single-threaded
-  *out = sock_;
-  return 0;
+  return csock_.Init(addr);
 }
 
 ThriftClient::Result ThriftClient::call(const std::string& method,
@@ -768,8 +740,9 @@ ThriftClient::Result ThriftClient::call(const std::string& method,
   std::shared_ptr<ThriftWaiter> w = std::make_shared<ThriftWaiter>();
   {
     LockGuard<FiberMutex> g(sock_mu_);
-    if (ensure_socket(&sid) != 0) {
-      fail.error = "cannot reach " + endpoint2str(ep_);
+    if (csock_.ensure(thriftc_protocol_index(), install_thrift_conn,
+                      &sid) != 0) {
+      fail.error = "cannot reach " + endpoint2str(csock_.endpoint());
       return fail;
     }
     m.seq_id = next_seq_++;
@@ -814,7 +787,8 @@ int ThriftClient::call_oneway(const std::string& method,
   SocketId sid = 0;
   {
     LockGuard<FiberMutex> g(sock_mu_);
-    if (ensure_socket(&sid) != 0) {
+    if (csock_.ensure(thriftc_protocol_index(), install_thrift_conn,
+                      &sid) != 0) {
       return -1;
     }
     m.seq_id = next_seq_++;

@@ -31,6 +31,7 @@
 #include "base/endpoint.h"
 #include "base/iobuf.h"
 #include "fiber/sync.h"
+#include "net/proto_client.h"
 #include "net/socket.h"
 
 namespace trpc {
@@ -168,12 +169,9 @@ class ThriftClient {
   int call_oneway(const std::string& method, const ThriftValue& args);
 
  private:
-  int ensure_socket(SocketId* out);
-
-  EndPoint ep_;
   Options opts_;
   FiberMutex sock_mu_;
-  SocketId sock_ = 0;
+  ClientSocket csock_;
   uint32_t next_seq_ = 1;
 };
 

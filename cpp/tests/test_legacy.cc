@@ -1,0 +1,230 @@
+// Legacy protocol family: nshead raw service + client, esp msg_id
+// correlation, and the four pbrpc personalities (hulu/sofa by magic,
+// nova/public over nshead) all dispatching into the shared method
+// registry.  Every loopback goes over real sockets through protocol
+// probing on the shared port.
+#include <atomic>
+#include <thread>
+
+#include "net/legacy_pbrpc.h"
+#include "net/nshead.h"
+#include "net/server.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+TEST_CASE(nshead_loopback_raw) {
+  NsheadService svc([](const NsheadHead& head, const IOBuf& body,
+                       NsheadHead* resp_head, IOBuf* resp_body) {
+    // Echo body; reflect log_id into reserved to prove head plumbing.
+    resp_head->reserved = head.log_id + 1;
+    resp_body->append(body);
+    resp_body->append("!");
+  });
+  Server server;
+  server.set_nshead_service(&svc);
+  EXPECT_EQ(server.Start(0), 0);
+
+  NsheadClient cli;
+  EXPECT_EQ(cli.Init("127.0.0.1:" + std::to_string(server.port())), 0);
+  NsheadHead head;
+  head.log_id = 41;
+  IOBuf body;
+  body.append("payload");
+  NsheadHead rsp_head;
+  IOBuf rsp_body;
+  EXPECT_EQ(cli.call(head, body, &rsp_head, &rsp_body), 0);
+  EXPECT_EQ(rsp_head.reserved, 42u);
+  EXPECT(rsp_body.to_string() == "payload!");
+  EXPECT_EQ(rsp_head.magic_num, kNsheadMagic);
+
+  server.Stop();
+  server.Join();
+}
+
+TEST_CASE(esp_loopback_msg_id_correlation) {
+  EspService svc;
+  svc.AddMessageHandler(7, [](const EspHead& head, const IOBuf& body,
+                              IOBuf* resp) {
+    resp->append("msg7:");
+    resp->append(body);
+  });
+  Server server;
+  server.set_esp_service(&svc);
+  EXPECT_EQ(server.Start(0), 0);
+
+  EspClient cli;
+  EXPECT_EQ(cli.Init("127.0.0.1:" + std::to_string(server.port())), 0);
+
+  // Concurrent calls: msg_id correlation must route each reply home
+  // even when handlers run in parallel fibers.
+  std::vector<std::thread> ts;
+  std::atomic<int> ok{0};
+  for (int i = 0; i < 6; ++i) {
+    ts.emplace_back([&cli, &ok, i] {
+      IOBuf b;
+      b.append("x" + std::to_string(i));
+      IOBuf r;
+      if (cli.call(7, b, &r) == 0 &&
+          r.to_string() == "msg7:x" + std::to_string(i)) {
+        ok.fetch_add(1);
+      }
+    });
+  }
+  for (auto& t : ts) {
+    t.join();
+  }
+  EXPECT_EQ(ok.load(), 6);
+
+  // Unknown msg -> empty reply body but the call still completes.
+  IOBuf b, r;
+  b.append("?");
+  EXPECT_EQ(cli.call(99, b, &r), 0);
+  EXPECT(r.empty());
+
+  server.Stop();
+  server.Join();
+}
+
+namespace {
+
+void register_echo(Server* server) {
+  // One handler, many protocols: name-addressed and index-addressed keys.
+  Server::Handler echo = [](Controller* cntl, const IOBuf& req,
+                            IOBuf* rsp, Closure done) {
+    rsp->append(req);
+    done();
+  };
+  server->RegisterMethod("EchoService.Echo", echo);
+  server->RegisterMethod("EchoService.#3", echo);
+  server->RegisterMethod("Nova.#5", echo);
+  Server::Handler boom = [](Controller* cntl, const IOBuf&, IOBuf*,
+                            Closure done) {
+    cntl->SetFailed(42, "deliberate failure");
+    done();
+  };
+  server->RegisterMethod("EchoService.Boom", boom);
+}
+
+}  // namespace
+
+TEST_CASE(hulu_loopback_name_and_index) {
+  Server server;
+  register_echo(&server);
+  EXPECT_EQ(server.Start(0), 0);
+
+  LegacyRpcClient cli;
+  EXPECT_EQ(cli.Init("127.0.0.1:" + std::to_string(server.port()),
+                     LegacyProto::kHulu),
+            0);
+  IOBuf req;
+  req.append("hulu-payload");
+  // Name-addressed (method_name field 14 present).
+  LegacyRpcClient::Result r = cli.call("EchoService", "Echo", 0, req);
+  EXPECT(r.ok);
+  EXPECT(r.response.to_string() == "hulu-payload");
+  // Index-addressed (no name -> "EchoService.#3").
+  r = cli.call("EchoService", "", 3, req);
+  EXPECT(r.ok);
+  EXPECT(r.response.to_string() == "hulu-payload");
+  // Handler failure surfaces code+text through the response meta.
+  r = cli.call("EchoService", "Boom", 0, req);
+  EXPECT(!r.ok);
+  EXPECT_EQ(r.error_code, 42);
+  EXPECT(r.error_text.find("deliberate") != std::string::npos);
+  // Unknown method.
+  r = cli.call("EchoService", "Nope", 0, req);
+  EXPECT(!r.ok);
+  EXPECT_EQ(r.error_code, ENOENT);
+
+  server.Stop();
+  server.Join();
+}
+
+TEST_CASE(sofa_loopback) {
+  Server server;
+  register_echo(&server);
+  EXPECT_EQ(server.Start(0), 0);
+
+  LegacyRpcClient cli;
+  EXPECT_EQ(cli.Init("127.0.0.1:" + std::to_string(server.port()),
+                     LegacyProto::kSofa),
+            0);
+  IOBuf req;
+  req.append(std::string(100000, 's'));  // exercise the u64 body sizes
+  LegacyRpcClient::Result r = cli.call("EchoService", "Echo", 0, req);
+  EXPECT(r.ok);
+  EXPECT_EQ(r.response.size(), 100000u);
+  r = cli.call("EchoService", "Boom", 0, req);
+  EXPECT(!r.ok);
+  EXPECT_EQ(r.error_code, 42);
+
+  server.Stop();
+  server.Join();
+}
+
+TEST_CASE(nova_loopback_index_dispatch) {
+  Server server;
+  register_echo(&server);
+  server.enable_nova_pbrpc();
+  EXPECT_EQ(server.Start(0), 0);
+
+  LegacyRpcClient cli;
+  EXPECT_EQ(cli.Init("127.0.0.1:" + std::to_string(server.port()),
+                     LegacyProto::kNova),
+            0);
+  IOBuf req;
+  req.append("nova-pb-bytes");
+  LegacyRpcClient::Result r = cli.call("", "", 5, req);
+  EXPECT(r.ok);
+  EXPECT(r.response.to_string() == "nova-pb-bytes");
+
+  server.Stop();
+  server.Join();
+}
+
+TEST_CASE(public_pbrpc_loopback) {
+  Server server;
+  register_echo(&server);
+  server.enable_public_pbrpc();
+  EXPECT_EQ(server.Start(0), 0);
+
+  LegacyRpcClient cli;
+  EXPECT_EQ(cli.Init("127.0.0.1:" + std::to_string(server.port()),
+                     LegacyProto::kPublic),
+            0);
+  IOBuf req;
+  req.append("public-payload");
+  LegacyRpcClient::Result r = cli.call("EchoService", "", 3, req);
+  EXPECT(r.ok);
+  EXPECT(r.response.to_string() == "public-payload");
+  // Error path: head.code + body.error ride back.
+  r = cli.call("EchoService", "", 999, req);
+  EXPECT(!r.ok);
+  EXPECT_EQ(r.error_code, ENOENT);
+
+  server.Stop();
+  server.Join();
+}
+
+TEST_CASE(legacy_protocols_share_port_with_tstd) {
+  // The SAME server answers hulu and sofa on one port — probing routes
+  // each connection by its magic.
+  Server server;
+  register_echo(&server);
+  EXPECT_EQ(server.Start(0), 0);
+  const std::string addr = "127.0.0.1:" + std::to_string(server.port());
+
+  LegacyRpcClient hulu, sofa;
+  EXPECT_EQ(hulu.Init(addr, LegacyProto::kHulu), 0);
+  EXPECT_EQ(sofa.Init(addr, LegacyProto::kSofa), 0);
+  IOBuf req;
+  req.append("mix");
+  EXPECT(hulu.call("EchoService", "Echo", 0, req).ok);
+  EXPECT(sofa.call("EchoService", "Echo", 0, req).ok);
+
+  server.Stop();
+  server.Join();
+}
+
+TEST_MAIN

@@ -1,0 +1,211 @@
+// Memcache binary protocol: frame codec units, service semantics (CAS,
+// add/replace, incr/decr wrap+floor, expiry), client loopback incl.
+// pipelined batch, and malformed-frame rejection.
+#include "net/memcache.h"
+
+#include <thread>
+
+#include "net/server.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+TEST_CASE(mc_frame_roundtrip) {
+  McCommand cmd;
+  cmd.op = McOp::kSet;
+  cmd.key = "k1";
+  cmd.value = std::string("v\0v", 3);
+  cmd.flags = 0xdeadbeef;
+  cmd.exptime = 3600;
+  cmd.cas = 0x1122334455667788ULL;
+  std::string wire;
+  mc_pack_request(cmd, /*opaque=*/42, &wire);
+  // 24B header + 8B extras + 2B key + 3B value.
+  EXPECT_EQ(wire.size(), 24u + 8 + 2 + 3);
+  EXPECT_EQ(static_cast<uint8_t>(wire[0]), 0x80);
+
+  McFrame f;
+  size_t pos = 0;
+  EXPECT_EQ(mc_parse_frame(wire, &pos, &f), 1);
+  EXPECT_EQ(pos, wire.size());
+  EXPECT(f.op == McOp::kSet);
+  EXPECT(f.key == "k1");
+  EXPECT(f.value == std::string("v\0v", 3));
+  EXPECT_EQ(f.opaque, 42u);
+  EXPECT_EQ(f.cas, 0x1122334455667788ULL);
+  EXPECT_EQ(f.extras.size(), 8u);
+
+  // Truncation -> partial; bad magic -> malformed; inconsistent
+  // lengths -> malformed.
+  pos = 0;
+  std::string cut = wire.substr(0, 30);
+  EXPECT_EQ(mc_parse_frame(cut, &pos, &f), 0);
+  std::string bad = wire;
+  bad[0] = 0x7f;
+  pos = 0;
+  EXPECT_EQ(mc_parse_frame(bad, &pos, &f), -1);
+  std::string inc = wire;
+  inc[2] = 0x7f;  // key_len 0x7f02 > total_body
+  pos = 0;
+  EXPECT_EQ(mc_parse_frame(inc, &pos, &f), -1);
+}
+
+TEST_CASE(mc_service_semantics) {
+  MemcacheService svc;
+  McCommand set;
+  set.op = McOp::kSet;
+  set.key = "n";
+  set.value = "10";
+  McResult r = svc.Execute(set);
+  EXPECT(r.ok());
+  const uint64_t cas1 = r.cas;
+  EXPECT(cas1 != 0);
+
+  // CAS mismatch rejected, match accepted.
+  set.cas = cas1 + 999;
+  EXPECT(svc.Execute(set).status == McStatus::kExists);
+  set.cas = cas1;
+  EXPECT(svc.Execute(set).ok());
+
+  // Add fails on present key; replace fails on absent.
+  McCommand add;
+  add.op = McOp::kAdd;
+  add.key = "n";
+  add.value = "x";
+  EXPECT(svc.Execute(add).status == McStatus::kNotStored);
+  McCommand rep;
+  rep.op = McOp::kReplace;
+  rep.key = "absent";
+  rep.value = "x";
+  EXPECT(svc.Execute(rep).status == McStatus::kNotStored);
+
+  // Incr on numeric value; decr floors at zero.
+  McCommand incr;
+  incr.op = McOp::kIncrement;
+  incr.key = "n";
+  incr.delta = 5;
+  r = svc.Execute(incr);
+  EXPECT(r.ok());
+  EXPECT_EQ(r.numeric, 15u);
+  McCommand decr;
+  decr.op = McOp::kDecrement;
+  decr.key = "n";
+  decr.delta = 100;
+  r = svc.Execute(decr);
+  EXPECT(r.ok());
+  EXPECT_EQ(r.numeric, 0u);
+
+  // Incr on non-numeric -> delta error.
+  McCommand sets;
+  sets.op = McOp::kSet;
+  sets.key = "s";
+  sets.value = "abc";
+  svc.Execute(sets);
+  incr.key = "s";
+  EXPECT(svc.Execute(incr).status == McStatus::kDeltaBadValue);
+
+  // Incr miss with initial creates; with 0xffffffff exptime doesn't.
+  McCommand miss;
+  miss.op = McOp::kIncrement;
+  miss.key = "fresh";
+  miss.delta = 3;
+  miss.initial = 7;
+  r = svc.Execute(miss);
+  EXPECT(r.ok());
+  EXPECT_EQ(r.numeric, 7u);
+  miss.key = "fresh2";
+  miss.exptime = 0xffffffffu;
+  EXPECT(svc.Execute(miss).status == McStatus::kNotFound);
+
+  // Append/prepend require presence.
+  McCommand app;
+  app.op = McOp::kAppend;
+  app.key = "s";
+  app.value = "!";
+  EXPECT(svc.Execute(app).ok());
+  McCommand get;
+  get.op = McOp::kGet;
+  get.key = "s";
+  EXPECT(svc.Execute(get).value == "abc!");
+}
+
+TEST_CASE(mc_loopback_client_server) {
+  MemcacheService svc;
+  Server server;
+  server.set_memcache_service(&svc);
+  EXPECT_EQ(server.Start(0), 0);
+
+  MemcacheClient cli;
+  EXPECT_EQ(cli.Init("127.0.0.1:" + std::to_string(server.port())), 0);
+
+  EXPECT(cli.Version().value.find("trpc") != std::string::npos);
+  McResult set = cli.Set("greeting", "hello", /*flags=*/7);
+  EXPECT(set.ok());
+  McResult get = cli.Get("greeting");
+  EXPECT(get.ok());
+  EXPECT(get.value == "hello");
+  EXPECT_EQ(get.flags, 7u);
+  EXPECT_EQ(get.cas, set.cas);
+
+  // CAS round trip through the wire.
+  EXPECT(cli.Set("greeting", "v2", 0, 0, get.cas).ok());
+  EXPECT(cli.Set("greeting", "v3", 0, 0, get.cas).status ==
+         McStatus::kExists);
+
+  EXPECT(cli.Get("missing").status == McStatus::kNotFound);
+  EXPECT(cli.Delete("greeting").ok());
+  EXPECT(cli.Get("greeting").status == McStatus::kNotFound);
+
+  // Numeric round trip (big-endian u64 response value).
+  EXPECT(cli.Set("ctr", "41").ok());
+  McResult inc = cli.Increment("ctr", 1);
+  EXPECT(inc.ok());
+  EXPECT_EQ(inc.numeric, 42u);
+
+  server.Stop();
+  server.Join();
+}
+
+TEST_CASE(mc_pipelined_batch) {
+  MemcacheService svc;
+  Server server;
+  server.set_memcache_service(&svc);
+  EXPECT_EQ(server.Start(0), 0);
+
+  MemcacheClient cli;
+  EXPECT_EQ(cli.Init("127.0.0.1:" + std::to_string(server.port())), 0);
+
+  std::vector<McCommand> cmds;
+  for (int i = 0; i < 32; ++i) {
+    McCommand c;
+    c.op = McOp::kSet;
+    c.key = "k" + std::to_string(i);
+    c.value = std::string(1000, static_cast<char>('a' + i % 26));
+    cmds.push_back(c);
+  }
+  std::vector<McResult> rs = cli.batch(cmds);
+  EXPECT_EQ(rs.size(), 32u);
+  for (const McResult& r : rs) {
+    EXPECT(r.ok());
+  }
+  EXPECT_EQ(svc.item_count(), 32u);
+
+  cmds.clear();
+  for (int i = 0; i < 32; ++i) {
+    McCommand c;
+    c.op = McOp::kGet;
+    c.key = "k" + std::to_string(i);
+    cmds.push_back(c);
+  }
+  rs = cli.batch(cmds);
+  for (int i = 0; i < 32; ++i) {
+    EXPECT(rs[i].ok());
+    EXPECT_EQ(rs[i].value.size(), 1000u);
+    EXPECT(rs[i].value[0] == static_cast<char>('a' + i % 26));
+  }
+
+  server.Stop();
+  server.Join();
+}
+
+TEST_MAIN
