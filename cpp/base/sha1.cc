@@ -1,0 +1,86 @@
+#include "base/sha1.h"
+
+#include <cstring>
+
+namespace trpc {
+
+namespace {
+
+inline uint32_t rol(uint32_t v, int n) {
+  return (v << n) | (v >> (32 - n));
+}
+
+void process_block(const uint8_t* p, uint32_t h[5]) {
+  uint32_t w[80];
+  for (int i = 0; i < 16; ++i) {
+    w[i] = (static_cast<uint32_t>(p[i * 4]) << 24) |
+           (static_cast<uint32_t>(p[i * 4 + 1]) << 16) |
+           (static_cast<uint32_t>(p[i * 4 + 2]) << 8) | p[i * 4 + 3];
+  }
+  for (int i = 16; i < 80; ++i) {
+    w[i] = rol(w[i - 3] ^ w[i - 8] ^ w[i - 14] ^ w[i - 16], 1);
+  }
+  uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4];
+  for (int i = 0; i < 80; ++i) {
+    uint32_t f, k;
+    if (i < 20) {
+      f = (b & c) | (~b & d);
+      k = 0x5a827999;
+    } else if (i < 40) {
+      f = b ^ c ^ d;
+      k = 0x6ed9eba1;
+    } else if (i < 60) {
+      f = (b & c) | (b & d) | (c & d);
+      k = 0x8f1bbcdc;
+    } else {
+      f = b ^ c ^ d;
+      k = 0xca62c1d6;
+    }
+    const uint32_t t = rol(a, 5) + f + e + k + w[i];
+    e = d;
+    d = c;
+    c = rol(b, 30);
+    b = a;
+    a = t;
+  }
+  h[0] += a;
+  h[1] += b;
+  h[2] += c;
+  h[3] += d;
+  h[4] += e;
+}
+
+}  // namespace
+
+void sha1(const void* data, size_t len, uint8_t digest[20]) {
+  uint32_t h[5] = {0x67452301, 0xefcdab89, 0x98badcfe, 0x10325476,
+                   0xc3d2e1f0};
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  size_t remaining = len;
+  while (remaining >= 64) {
+    process_block(p, h);
+    p += 64;
+    remaining -= 64;
+  }
+  // Final block(s): message || 0x80 || zeros || 64-bit bit length.
+  uint8_t tail[128] = {};
+  std::memcpy(tail, p, remaining);
+  tail[remaining] = 0x80;
+  const size_t tail_len = remaining + 1 + 8 <= 64 ? 64 : 128;
+  const uint64_t bits = static_cast<uint64_t>(len) * 8;
+  for (int i = 0; i < 8; ++i) {
+    tail[tail_len - 1 - i] = static_cast<uint8_t>(bits >> (8 * i));
+  }
+  process_block(tail, h);
+  if (tail_len == 128) {
+    process_block(tail + 64, h);
+  }
+  for (int i = 0; i < 5; ++i) {
+    digest[i * 4] = static_cast<uint8_t>(h[i] >> 24);
+    digest[i * 4 + 1] = static_cast<uint8_t>(h[i] >> 16);
+    digest[i * 4 + 2] = static_cast<uint8_t>(h[i] >> 8);
+    digest[i * 4 + 3] = static_cast<uint8_t>(h[i]);
+  }
+}
+
+}  // namespace trpc

@@ -1,0 +1,25 @@
+// SHA-1 (RFC 3174) — needed by the mysql_native_password scramble
+// (net/mysql.h).  Parity slot: the reference links OpenSSL for this
+// (policy/mysql/mysql_authenticator.cpp); this runtime keeps the base
+// layer dependency-free and hand-rolls the 160-bit digest.
+//
+// Not for new cryptographic designs — present strictly for protocol
+// compatibility (mysql auth predates modern hashes).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+namespace trpc {
+
+// digest must point at 20 writable bytes.
+void sha1(const void* data, size_t len, uint8_t digest[20]);
+
+inline std::string sha1(const std::string& in) {
+  std::string out(20, '\0');
+  sha1(in.data(), in.size(), reinterpret_cast<uint8_t*>(out.data()));
+  return out;
+}
+
+}  // namespace trpc

@@ -320,6 +320,19 @@ McResult MemcacheService::Execute(const McCommand& cmd) {
 
 namespace {
 
+// Parsed frame handed through InputMessage::ctx — the frame is decoded
+// ONCE here (value stays an IOBuf, zero-copy off the read buffer; the
+// hot path of a cache protocol must not flatten+reparse 64MB values).
+struct McFrameCtx {
+  McOp op = McOp::kGet;
+  uint16_t status_or_vbucket = 0;
+  uint32_t opaque = 0;
+  uint64_t cas = 0;
+  std::string extras;  // <= 20 bytes by construction
+  std::string key;
+  IOBuf value;
+};
+
 ParseError mc_cut(IOBuf* source, InputMessage* out, Socket* sock,
                   uint8_t want_magic, bool probing) {
   uint8_t head[kHeader];
@@ -345,7 +358,19 @@ ParseError mc_cut(IOBuf* source, InputMessage* out, Socket* sock,
   if (source->size() < kHeader + total) {
     return ParseError::kNotEnoughData;
   }
-  source->cutn(&out->payload, kHeader + total);
+  auto f = std::make_shared<McFrameCtx>();
+  f->op = static_cast<McOp>(head[1]);
+  f->status_or_vbucket = read_u16(head + 6);
+  f->opaque = read_u32(head + 12);
+  f->cas = read_u64(head + 16);
+  source->pop_front(kHeader);
+  IOBuf ex, key;
+  source->cutn(&ex, extras_len);
+  source->cutn(&key, key_len);
+  f->extras = ex.to_string();
+  f->key = key.to_string();
+  source->cutn(&f->value, total - extras_len - key_len);
+  out->ctx = std::move(f);
   out->socket = sock != nullptr ? sock->id() : 0;
   return ParseError::kOk;
 }
@@ -372,28 +397,23 @@ void mc_process_request(InputMessage&& msg) {
     return;
   }
   Server* srv = static_cast<Server*>(sock->user_data);
-  if (srv == nullptr || srv->memcache_service() == nullptr) {
-    return;
-  }
-  std::string raw = msg.payload.to_string();
-  size_t pos = 0;
-  McFrame f;
-  if (mc_parse_frame(raw, &pos, &f) != 1) {
-    sock->SetFailed(EPROTO);
+  auto f = std::static_pointer_cast<McFrameCtx>(msg.ctx);
+  if (srv == nullptr || srv->memcache_service() == nullptr ||
+      f == nullptr) {
     return;
   }
 
   McCommand cmd;
-  cmd.op = f.op;
-  cmd.key = std::move(f.key);
-  cmd.value = std::move(f.value);
-  cmd.cas = f.cas;
-  const uint8_t* ex = reinterpret_cast<const uint8_t*>(f.extras.data());
-  switch (f.op) {
+  cmd.op = f->op;
+  cmd.key = std::move(f->key);
+  cmd.value = f->value.to_string();  // the service API stores strings
+  cmd.cas = f->cas;
+  const uint8_t* ex = reinterpret_cast<const uint8_t*>(f->extras.data());
+  switch (f->op) {
     case McOp::kSet:
     case McOp::kAdd:
     case McOp::kReplace:
-      if (f.extras.size() != 8) {
+      if (f->extras.size() != 8) {
         sock->SetFailed(EPROTO);
         return;
       }
@@ -402,7 +422,7 @@ void mc_process_request(InputMessage&& msg) {
       break;
     case McOp::kIncrement:
     case McOp::kDecrement:
-      if (f.extras.size() != 20) {
+      if (f->extras.size() != 20) {
         sock->SetFailed(EPROTO);
         return;
       }
@@ -412,7 +432,7 @@ void mc_process_request(InputMessage&& msg) {
       break;
     case McOp::kTouch:
     case McOp::kFlush:
-      if (f.extras.size() == 4) {
+      if (f->extras.size() == 4) {
         cmd.exptime = read_u32(ex);
       }
       break;
@@ -421,7 +441,7 @@ void mc_process_request(InputMessage&& msg) {
   }
   if (cmd.key.size() > kMaxKey) {
     std::string wire;
-    mc_pack_response(f.op, McStatus::kRemoteError, f.opaque, 0, "", "",
+    mc_pack_response(f->op, McStatus::kRemoteError, f->opaque, 0, "", "",
                      "key too long", &wire);
     IOBuf out;
     out.append(wire);
@@ -434,8 +454,8 @@ void mc_process_request(InputMessage&& msg) {
     std::string et;
     if (!srv->accept_request("memcache", sock->remote(), &ec, &et)) {
       std::string wire;
-      mc_pack_response(f.op, McStatus::kRemoteError, f.opaque, 0, "", "",
-                       et, &wire);
+      mc_pack_response(f->op, McStatus::kRemoteError, f->opaque, 0, "",
+                       "", et, &wire);
       IOBuf out;
       out.append(wire);
       sock->Write(std::move(out));
@@ -447,17 +467,17 @@ void mc_process_request(InputMessage&& msg) {
   srv->requests_served.fetch_add(1, std::memory_order_relaxed);
 
   std::string extras, value;
-  if (f.op == McOp::kGet && r.ok()) {
+  if (f->op == McOp::kGet && r.ok()) {
     put_u32(&extras, r.flags);
     value = std::move(r.value);
-  } else if ((f.op == McOp::kIncrement || f.op == McOp::kDecrement) &&
+  } else if ((f->op == McOp::kIncrement || f->op == McOp::kDecrement) &&
              r.ok()) {
     put_u64(&value, r.numeric);
-  } else if (f.op == McOp::kVersion || !r.ok()) {
+  } else if (f->op == McOp::kVersion || !r.ok()) {
     value = std::move(r.value);
   }
   std::string wire;
-  mc_pack_response(f.op, r.status, f.opaque, r.cas, extras, "", value,
+  mc_pack_response(f->op, r.status, f->opaque, r.cas, extras, "", value,
                    &wire);
   IOBuf out;
   out.append(wire);
@@ -524,11 +544,8 @@ void mcc_process_response(InputMessage&& msg) {
   if (!sock) {
     return;
   }
-  std::string raw = msg.payload.to_string();
-  size_t pos = 0;
-  McFrame f;
-  if (mc_parse_frame(raw, &pos, &f) != 1) {
-    sock->SetFailed(EPROTO);
+  auto f = std::static_pointer_cast<McFrameCtx>(msg.ctx);
+  if (f == nullptr) {
     return;
   }
   McCliConn* c = mcli_conn_of(sock.get());
@@ -542,24 +559,25 @@ void mcc_process_response(InputMessage&& msg) {
     c->pending.pop_front();
   }
   McResult& r = w->result;
-  if (f.opaque != w->opaque) {
+  if (f->opaque != w->opaque) {
     r.status = McStatus::kRemoteError;
     r.value = "opaque mismatch";
   } else {
-    r.status = static_cast<McStatus>(f.status_or_vbucket);
-    r.cas = f.cas;
-    if (f.op == McOp::kGet && r.ok()) {
-      if (f.extras.size() >= 4) {
+    r.status = static_cast<McStatus>(f->status_or_vbucket);
+    r.cas = f->cas;
+    if (f->op == McOp::kGet && r.ok()) {
+      if (f->extras.size() >= 4) {
         r.flags = read_u32(
-            reinterpret_cast<const uint8_t*>(f.extras.data()));
+            reinterpret_cast<const uint8_t*>(f->extras.data()));
       }
-      r.value = std::move(f.value);
-    } else if ((f.op == McOp::kIncrement || f.op == McOp::kDecrement) &&
-               r.ok() && f.value.size() == 8) {
-      r.numeric =
-          read_u64(reinterpret_cast<const uint8_t*>(f.value.data()));
+      r.value = f->value.to_string();
+    } else if ((f->op == McOp::kIncrement || f->op == McOp::kDecrement) &&
+               r.ok() && f->value.size() == 8) {
+      uint8_t nbuf[8];
+      f->value.copy_to(nbuf, 8, 0);
+      r.numeric = read_u64(nbuf);
     } else {
-      r.value = std::move(f.value);
+      r.value = f->value.to_string();
     }
   }
   w->ev.signal();

@@ -1,0 +1,685 @@
+#include "net/mongo.h"
+
+#include <errno.h>
+
+#include <algorithm>
+#include <cstring>
+#include <deque>
+#include <mutex>
+
+#include "base/logging.h"
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "net/messenger.h"
+#include "net/protocol.h"
+#include "net/server.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr int32_t kOpMsg = 2013;
+constexpr size_t kMaxMessage = 48 << 20;  // mongod's wire cap
+constexpr size_t kMaxElements = 1 << 20;
+constexpr int kMaxDepth = 32;
+constexpr uint32_t kChecksumPresent = 1;
+constexpr uint32_t kMoreToCome = 2;
+
+void put_i32(std::string* out, int32_t v) {
+  out->append(reinterpret_cast<const char*>(&v), 4);  // LE on x86
+}
+
+void put_i64(std::string* out, int64_t v) {
+  out->append(reinterpret_cast<const char*>(&v), 8);
+}
+
+bool get_i32(const std::string& in, size_t* pos, int32_t* v) {
+  if (in.size() - *pos < 4) return false;
+  std::memcpy(v, in.data() + *pos, 4);
+  *pos += 4;
+  return true;
+}
+
+bool get_i64(const std::string& in, size_t* pos, int64_t* v) {
+  if (in.size() - *pos < 8) return false;
+  std::memcpy(v, in.data() + *pos, 8);
+  *pos += 8;
+  return true;
+}
+
+bool get_cstring(const std::string& in, size_t* pos, std::string* out) {
+  const size_t nul = in.find('\0', *pos);
+  if (nul == std::string::npos) return false;
+  out->assign(in, *pos, nul - *pos);
+  *pos = nul + 1;
+  return true;
+}
+
+}  // namespace
+
+// ---- BSON builders -------------------------------------------------------
+
+BsonValue BsonValue::Double(double v) {
+  BsonValue b;
+  b.type = kDouble;
+  b.d = v;
+  return b;
+}
+BsonValue BsonValue::Str(std::string v) {
+  BsonValue b;
+  b.type = kString;
+  b.str = std::move(v);
+  return b;
+}
+BsonValue BsonValue::Document(BsonDoc v) {
+  BsonValue b;
+  b.type = kDoc;
+  b.doc = std::make_shared<BsonDoc>(std::move(v));
+  return b;
+}
+BsonValue BsonValue::Array(std::vector<BsonValue> v) {
+  BsonValue b;
+  b.type = kArray;
+  b.doc = std::make_shared<BsonDoc>();
+  for (size_t i = 0; i < v.size(); ++i) {
+    b.doc->emplace_back(std::to_string(i), std::move(v[i]));
+  }
+  return b;
+}
+BsonValue BsonValue::Binary(std::string v, uint8_t subtype) {
+  BsonValue b;
+  b.type = kBinary;
+  b.str = std::move(v);
+  b.subtype = subtype;
+  return b;
+}
+BsonValue BsonValue::ObjectId(const std::string& bytes12) {
+  BsonValue b;
+  b.type = kObjectId;
+  b.str = bytes12.substr(0, 12);
+  b.str.resize(12, '\0');
+  return b;
+}
+BsonValue BsonValue::Bool(bool v) {
+  BsonValue b;
+  b.type = kBool;
+  b.b = v;
+  return b;
+}
+BsonValue BsonValue::DateTime(int64_t ms) {
+  BsonValue b;
+  b.type = kDateTime;
+  b.i = ms;
+  return b;
+}
+BsonValue BsonValue::Null() { return BsonValue(); }
+BsonValue BsonValue::Int32(int32_t v) {
+  BsonValue b;
+  b.type = kInt32;
+  b.i = v;
+  return b;
+}
+BsonValue BsonValue::Int64(int64_t v) {
+  BsonValue b;
+  b.type = kInt64;
+  b.i = v;
+  return b;
+}
+
+bool BsonValue::operator==(const BsonValue& o) const {
+  if (type != o.type) return false;
+  switch (type) {
+    case kDouble:
+      return d == o.d;
+    case kString:
+      return str == o.str;
+    case kDoc:
+    case kArray:
+      return (doc == nullptr) == (o.doc == nullptr) &&
+             (doc == nullptr || *doc == *o.doc);
+    case kBinary:
+      return subtype == o.subtype && str == o.str;
+    case kObjectId:
+      return str == o.str;
+    case kBool:
+      return b == o.b;
+    case kDateTime:
+    case kInt64:
+    case kInt32:
+      return i == o.i;
+    case kNull:
+      return true;
+  }
+  return false;
+}
+
+const BsonValue* bson_find(const BsonDoc& doc, const std::string& key) {
+  for (const auto& [k, v] : doc) {
+    if (k == key) return &v;
+  }
+  return nullptr;
+}
+
+// ---- BSON codec ----------------------------------------------------------
+
+namespace {
+
+void write_value(const BsonValue& v, std::string* out);
+
+void write_doc_body(const BsonDoc& doc, std::string* out) {
+  const size_t len_at = out->size();
+  put_i32(out, 0);  // patched below
+  for (const auto& [name, v] : doc) {
+    out->push_back(static_cast<char>(v.type));
+    out->append(name);
+    out->push_back('\0');
+    write_value(v, out);
+  }
+  out->push_back('\0');
+  const int32_t total = static_cast<int32_t>(out->size() - len_at);
+  std::memcpy(out->data() + len_at, &total, 4);
+}
+
+void write_value(const BsonValue& v, std::string* out) {
+  switch (v.type) {
+    case BsonValue::kDouble: {
+      int64_t bits;
+      std::memcpy(&bits, &v.d, 8);
+      put_i64(out, bits);
+      break;
+    }
+    case BsonValue::kString:
+      put_i32(out, static_cast<int32_t>(v.str.size()) + 1);
+      out->append(v.str);
+      out->push_back('\0');
+      break;
+    case BsonValue::kDoc:
+    case BsonValue::kArray:
+      write_doc_body(v.doc != nullptr ? *v.doc : BsonDoc{}, out);
+      break;
+    case BsonValue::kBinary:
+      put_i32(out, static_cast<int32_t>(v.str.size()));
+      out->push_back(static_cast<char>(v.subtype));
+      out->append(v.str);
+      break;
+    case BsonValue::kObjectId:
+      out->append(v.str.data(), 12);
+      break;
+    case BsonValue::kBool:
+      out->push_back(v.b ? 1 : 0);
+      break;
+    case BsonValue::kDateTime:
+    case BsonValue::kInt64:
+      put_i64(out, v.i);
+      break;
+    case BsonValue::kNull:
+      break;
+    case BsonValue::kInt32:
+      put_i32(out, static_cast<int32_t>(v.i));
+      break;
+  }
+}
+
+int read_value(const std::string& in, size_t* pos, uint8_t type,
+               BsonValue* out, int depth);
+
+int read_doc_body(const std::string& in, size_t* pos, BsonDoc* out,
+                  int depth) {
+  if (depth > kMaxDepth) return -1;
+  const size_t start = *pos;
+  int32_t total;
+  if (!get_i32(in, pos, &total)) return 0;
+  if (total < 5 || static_cast<size_t>(total) > kMaxMessage) return -1;
+  if (in.size() - start < static_cast<size_t>(total)) return 0;
+  const size_t end = start + total;
+  out->clear();
+  while (*pos < end - 1) {
+    if (out->size() > kMaxElements) return -1;
+    const uint8_t type = static_cast<uint8_t>(in[*pos]);
+    ++*pos;
+    std::string name;
+    if (!get_cstring(in, pos, &name) || *pos > end) return -1;
+    BsonValue v;
+    const int rc = read_value(in, pos, type, &v, depth + 1);
+    if (rc != 1 || *pos > end) return rc == 0 ? -1 : rc;  // bounded by total
+    out->emplace_back(std::move(name), std::move(v));
+  }
+  if (*pos != end - 1 || in[*pos] != '\0') return -1;
+  ++*pos;
+  return 1;
+}
+
+int read_value(const std::string& in, size_t* pos, uint8_t type,
+               BsonValue* out, int depth) {
+  switch (type) {
+    case BsonValue::kDouble: {
+      int64_t bits;
+      if (!get_i64(in, pos, &bits)) return -1;
+      out->type = BsonValue::kDouble;
+      std::memcpy(&out->d, &bits, 8);
+      return 1;
+    }
+    case BsonValue::kString: {
+      int32_t len;
+      if (!get_i32(in, pos, &len) || len < 1 ||
+          in.size() - *pos < static_cast<size_t>(len)) {
+        return -1;
+      }
+      out->type = BsonValue::kString;
+      out->str.assign(in, *pos, len - 1);
+      if (in[*pos + len - 1] != '\0') return -1;
+      *pos += len;
+      return 1;
+    }
+    case BsonValue::kDoc:
+    case BsonValue::kArray: {
+      out->type = static_cast<BsonValue::Type>(type);
+      out->doc = std::make_shared<BsonDoc>();
+      return read_doc_body(in, pos, out->doc.get(), depth);
+    }
+    case BsonValue::kBinary: {
+      int32_t len;
+      if (!get_i32(in, pos, &len) || len < 0 ||
+          in.size() - *pos < static_cast<size_t>(len) + 1) {
+        return -1;
+      }
+      out->type = BsonValue::kBinary;
+      out->subtype = static_cast<uint8_t>(in[*pos]);
+      ++*pos;
+      out->str.assign(in, *pos, len);
+      *pos += len;
+      return 1;
+    }
+    case BsonValue::kObjectId: {
+      if (in.size() - *pos < 12) return -1;
+      out->type = BsonValue::kObjectId;
+      out->str.assign(in, *pos, 12);
+      *pos += 12;
+      return 1;
+    }
+    case BsonValue::kBool: {
+      if (*pos >= in.size()) return -1;
+      out->type = BsonValue::kBool;
+      out->b = in[*pos] != 0;
+      ++*pos;
+      return 1;
+    }
+    case BsonValue::kDateTime:
+    case BsonValue::kInt64: {
+      if (!get_i64(in, pos, &out->i)) return -1;
+      out->type = static_cast<BsonValue::Type>(type);
+      return 1;
+    }
+    case BsonValue::kNull:
+      out->type = BsonValue::kNull;
+      return 1;
+    case BsonValue::kInt32: {
+      int32_t v;
+      if (!get_i32(in, pos, &v)) return -1;
+      out->type = BsonValue::kInt32;
+      out->i = v;
+      return 1;
+    }
+    default:
+      return -1;  // decimal128 / regex / code: not in the condensed set
+  }
+}
+
+}  // namespace
+
+void bson_write_doc(const BsonDoc& doc, std::string* out) {
+  write_doc_body(doc, out);
+}
+
+int bson_read_doc(const std::string& in, size_t* pos, BsonDoc* out,
+                  int depth) {
+  return read_doc_body(in, pos, out, depth);
+}
+
+// ---- message framing -----------------------------------------------------
+
+namespace {
+
+struct MongoFrame {
+  int32_t request_id = 0;
+  int32_t response_to = 0;
+  uint32_t flags = 0;
+  BsonDoc body;
+};
+
+void mongo_pack(int32_t request_id, int32_t response_to,
+                const BsonDoc& body, std::string* out) {
+  const size_t start = out->size();
+  put_i32(out, 0);  // length, patched
+  put_i32(out, request_id);
+  put_i32(out, response_to);
+  put_i32(out, kOpMsg);
+  put_i32(out, 0);  // flagBits
+  out->push_back(0);  // section kind 0
+  bson_write_doc(body, out);
+  const int32_t total = static_cast<int32_t>(out->size() - start);
+  std::memcpy(out->data() + start, &total, 4);
+}
+
+// Cuts one OP_MSG off `source`.  The opcode at offset 12 is the probe
+// discriminator.
+ParseError mongo_cut(IOBuf* source, InputMessage* out, Socket* sock,
+                     bool probing) {
+  uint8_t head[16];
+  const size_t got = source->copy_to(head, sizeof(head), 0);
+  if (got < sizeof(head)) {
+    // Short prefix: hold unless the length bytes already rule us out
+    // (mongo messages are < 48MB, so byte 3 must be 0x00..0x03).
+    if (probing && got >= 4 && head[3] > 0x03) {
+      return ParseError::kTryOtherProtocol;
+    }
+    return ParseError::kNotEnoughData;
+  }
+  int32_t len, opcode;
+  std::memcpy(&len, head, 4);
+  std::memcpy(&opcode, head + 12, 4);
+  if (opcode != kOpMsg || len < 16 ||
+      static_cast<size_t>(len) > kMaxMessage) {
+    return probing ? ParseError::kTryOtherProtocol
+                   : ParseError::kCorrupted;
+  }
+  if (source->size() < static_cast<size_t>(len)) {
+    return ParseError::kNotEnoughData;
+  }
+  std::string raw;
+  raw.resize(len);
+  source->copy_to(raw.data(), len, 0);
+  source->pop_front(len);
+
+  auto frame = std::make_shared<MongoFrame>();
+  size_t pos = 4;
+  int32_t rid, rto, op;
+  get_i32(raw, &pos, &rid);
+  get_i32(raw, &pos, &rto);
+  get_i32(raw, &pos, &op);
+  frame->request_id = rid;
+  frame->response_to = rto;
+  int32_t flags;
+  if (!get_i32(raw, &pos, &flags)) {
+    return ParseError::kCorrupted;
+  }
+  frame->flags = static_cast<uint32_t>(flags);
+  if (frame->flags & kChecksumPresent) {
+    return ParseError::kCorrupted;  // crc32c sections not negotiated
+  }
+  if (pos >= raw.size() || raw[pos] != 0) {
+    return ParseError::kCorrupted;  // only kind-0 body sections
+  }
+  ++pos;
+  if (bson_read_doc(raw, &pos, &frame->body, 0) != 1) {
+    return ParseError::kCorrupted;
+  }
+  out->ctx = std::move(frame);
+  out->socket = sock != nullptr ? sock->id() : 0;
+  return ParseError::kOk;
+}
+
+}  // namespace
+
+// ---- server --------------------------------------------------------------
+
+bool MongoService::AddCommandHandler(const std::string& name,
+                                     CommandHandler h) {
+  std::string lower = name;
+  std::transform(lower.begin(), lower.end(), lower.begin(), ::tolower);
+  return handlers_.emplace(std::move(lower), std::move(h)).second;
+}
+
+const MongoService::CommandHandler* MongoService::FindCommandHandler(
+    const std::string& lower) const {
+  auto it = handlers_.find(lower);
+  return it == handlers_.end() ? nullptr : &it->second;
+}
+
+BsonDoc MongoService::ok_reply() {
+  return {{"ok", BsonValue::Double(1)}};
+}
+
+namespace {
+
+ParseError mongo_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  const bool probing = sock->pinned_protocol < 0;
+  if (probing) {
+    Server* srv = static_cast<Server*>(sock->user_data);
+    if (srv == nullptr || srv->mongo_service() == nullptr) {
+      return ParseError::kTryOtherProtocol;
+    }
+  }
+  return mongo_cut(source, out, sock, probing);
+}
+
+BsonDoc builtin_command(const std::string& cmd, Server* srv) {
+  if (cmd == "ping") {
+    return MongoService::ok_reply();
+  }
+  if (cmd == "hello" || cmd == "ismaster") {
+    BsonDoc d;
+    d.emplace_back("isWritablePrimary", BsonValue::Bool(true));
+    d.emplace_back("maxBsonObjectSize", BsonValue::Int32(16 << 20));
+    d.emplace_back("maxMessageSizeBytes", BsonValue::Int32(48 << 20));
+    d.emplace_back("maxWireVersion", BsonValue::Int32(17));
+    d.emplace_back("minWireVersion", BsonValue::Int32(0));
+    d.emplace_back("ok", BsonValue::Double(1));
+    return d;
+  }
+  if (cmd == "buildinfo") {
+    BsonDoc d;
+    d.emplace_back("version", BsonValue::Str("7.0.0-trpc"));
+    d.emplace_back("ok", BsonValue::Double(1));
+    return d;
+  }
+  (void)srv;
+  return {};
+}
+
+void mongo_process_request(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  Server* srv = static_cast<Server*>(sock->user_data);
+  auto frame = std::static_pointer_cast<MongoFrame>(msg.ctx);
+  if (srv == nullptr || srv->mongo_service() == nullptr ||
+      frame == nullptr || frame->body.empty()) {
+    return;
+  }
+  std::string cmd = frame->body.front().first;
+  std::transform(cmd.begin(), cmd.end(), cmd.begin(), ::tolower);
+
+  BsonDoc reply;
+  {  // Interceptor gate.
+    int ec = 0;
+    std::string et;
+    if (cmd != "ping" && cmd != "hello" && cmd != "ismaster" &&
+        !srv->accept_request(cmd, sock->remote(), &ec, &et)) {
+      reply.emplace_back("ok", BsonValue::Double(0));
+      reply.emplace_back("errmsg", BsonValue::Str(et));
+      reply.emplace_back("code", BsonValue::Int32(13));  // Unauthorized
+    }
+  }
+  if (reply.empty()) {
+    const MongoService::CommandHandler* h =
+        srv->mongo_service()->FindCommandHandler(cmd);
+    if (h != nullptr) {
+      reply = (*h)(frame->body);
+      srv->requests_served.fetch_add(1, std::memory_order_relaxed);
+    } else {
+      reply = builtin_command(cmd, srv);
+      if (reply.empty()) {
+        reply.emplace_back("ok", BsonValue::Double(0));
+        reply.emplace_back(
+            "errmsg", BsonValue::Str("no such command: '" + cmd + "'"));
+        reply.emplace_back("code", BsonValue::Int32(59));
+      }
+    }
+  }
+  if (frame->flags & kMoreToCome) {
+    return;  // fire-and-forget (unacknowledged writes)
+  }
+  std::string wire;
+  static std::atomic<int32_t> reply_id{1000};
+  mongo_pack(reply_id.fetch_add(1), frame->request_id, reply, &wire);
+  IOBuf out;
+  out.append(wire);
+  sock->Write(std::move(out));
+}
+
+void mongo_process_response(InputMessage&&) {}
+
+}  // namespace
+
+void register_mongo_protocol() {
+  static int once = [] {
+    Protocol p = {"mongo", mongo_parse, mongo_process_request,
+                  mongo_process_response,
+                  /*process_in_order=*/false};
+    return register_protocol(p);
+  }();
+  (void)once;
+}
+
+// ---- client --------------------------------------------------------------
+
+namespace {
+
+struct MongoWaiter {
+  CountdownEvent ev{1};
+  bool ok = false;
+  BsonDoc reply;
+};
+
+struct MongoCliConn {
+  std::mutex mu;
+  std::map<int32_t, std::shared_ptr<MongoWaiter>> pending;  // by requestID
+};
+
+const char kMongoCliTag = 0;
+
+MongoCliConn* mcli_conn_of(Socket* s) {
+  return proto_conn_of<MongoCliConn>(s, &kMongoCliTag);
+}
+
+int install_mongo_conn(Socket* s) {
+  mcli_conn_of(s);
+  return 0;
+}
+
+ParseError mongoc_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  if (sock->pinned_protocol < 0) {
+    return ParseError::kTryOtherProtocol;
+  }
+  ParseError rc = mongo_cut(source, out, sock, /*probing=*/false);
+  if (rc == ParseError::kOk) {
+    out->meta.type = RpcMeta::kResponse;
+  }
+  return rc;
+}
+
+void mongoc_process_response(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  auto frame = std::static_pointer_cast<MongoFrame>(msg.ctx);
+  MongoCliConn* c = mcli_conn_of(sock.get());
+  std::shared_ptr<MongoWaiter> w;
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    auto it = c->pending.find(frame->response_to);
+    if (it == c->pending.end()) {
+      return;
+    }
+    w = std::move(it->second);
+    c->pending.erase(it);
+  }
+  w->ok = true;
+  w->reply = std::move(frame->body);
+  w->ev.signal();
+}
+
+void mongoc_process_request(InputMessage&&) {}
+
+int mongoc_protocol_index() {
+  static const int index = [] {
+    Protocol p = {"mongoc", mongoc_parse, mongoc_process_request,
+                  mongoc_process_response,
+                  /*process_in_order=*/true};
+    return register_protocol(p);
+  }();
+  return index;
+}
+
+}  // namespace
+
+MongoClient::~MongoClient() {
+  csock_.Shutdown();
+}
+
+int MongoClient::Init(const std::string& addr, const Options* opts) {
+  fiber_init(0);
+  if (opts != nullptr) {
+    opts_ = *opts;
+  }
+  mongoc_protocol_index();
+  return csock_.Init(addr);
+}
+
+MongoClient::Result MongoClient::run_command(const BsonDoc& cmd) {
+  Result fail;
+  SocketId sid = 0;
+  int32_t rid = 0;
+  {
+    LockGuard<FiberMutex> g(sock_mu_);
+    if (csock_.ensure(mongoc_protocol_index(), install_mongo_conn,
+                      &sid) != 0) {
+      fail.errmsg = "cannot reach " + endpoint2str(csock_.endpoint());
+      return fail;
+    }
+    rid = static_cast<int32_t>(next_request_++);
+  }
+  SocketRef s(Socket::Address(sid));
+  if (!s) {
+    fail.errmsg = "connection failed";
+    return fail;
+  }
+  MongoCliConn* c = mcli_conn_of(s.get());
+  auto w = std::make_shared<MongoWaiter>();
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    c->pending.emplace(rid, w);
+  }
+  std::string wire;
+  mongo_pack(rid, 0, cmd, &wire);
+  IOBuf out;
+  out.append(wire);
+  if (s->Write(std::move(out)) != 0) {
+    std::lock_guard<std::mutex> g(c->mu);
+    c->pending.erase(rid);
+    fail.errmsg = "write failed";
+    return fail;
+  }
+  const int64_t deadline = monotonic_time_us() + opts_.timeout_ms * 1000;
+  if (w->ev.wait(deadline) != 0) {
+    std::lock_guard<std::mutex> g(c->mu);
+    c->pending.erase(rid);
+    fail.errmsg = "timeout";
+    return fail;
+  }
+  Result r;
+  r.ok = true;
+  r.reply = std::move(w->reply);
+  return r;
+}
+
+}  // namespace trpc

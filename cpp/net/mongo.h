@@ -1,0 +1,142 @@
+// Mongo wire protocol (OP_MSG) server adaptor + client, with a BSON codec.
+//
+// Parity: the reference's server-side mongo adaptor
+// (/root/reference/src/brpc/policy/mongo_protocol.cpp + mongo_head.h:
+// standard 16-byte message header, pb-described sections) lets a brpc
+// server answer mongo drivers.  Condensed tpu-native form: a hand-rolled
+// BSON value tree (no libbson), the modern OP_MSG framing (opcode 2013,
+// kind-0 body section), a MongoService mapping command names (the FIRST
+// element's key, per the mongo command convention) to handlers, and a
+// client correlating replies by responseTo for tests/tools.
+//
+// Wire facts (public BSON + mongo wire spec):
+//   header  : i32 messageLength, i32 requestID, i32 responseTo, i32 opCode
+//   OP_MSG  : u32 flagBits, sections*, [u32 crc when bit 0 set — rejected]
+//   section : u8 kind (0 = one BSON doc; 1 = doc sequence, unsupported)
+//   BSON doc: i32 total, {u8 type, cstring name, value}*, 0x00
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "base/endpoint.h"
+#include "base/iobuf.h"
+#include "fiber/sync.h"
+#include "net/proto_client.h"
+#include "net/socket.h"
+
+namespace trpc {
+
+class Server;
+
+// ---- BSON ----------------------------------------------------------------
+
+struct BsonValue;
+
+// A document is an ordered element list (mongo cares about the order of
+// the first key — it names the command).
+using BsonDoc = std::vector<std::pair<std::string, BsonValue>>;
+
+struct BsonValue {
+  enum Type : uint8_t {
+    kDouble = 0x01,
+    kString = 0x02,
+    kDoc = 0x03,
+    kArray = 0x04,
+    kBinary = 0x05,
+    kObjectId = 0x07,
+    kBool = 0x08,
+    kDateTime = 0x09,  // int64 ms since epoch
+    kNull = 0x0a,
+    kInt32 = 0x10,
+    kInt64 = 0x12,
+  };
+  Type type = kNull;
+  double d = 0;
+  int64_t i = 0;
+  bool b = false;
+  std::string str;             // string / objectid(12B) / binary payload
+  uint8_t subtype = 0;         // binary subtype
+  std::shared_ptr<BsonDoc> doc;  // kDoc / kArray (array keys "0","1",...)
+
+  static BsonValue Double(double v);
+  static BsonValue Str(std::string v);
+  static BsonValue Document(BsonDoc v);
+  static BsonValue Array(std::vector<BsonValue> v);
+  static BsonValue Binary(std::string v, uint8_t subtype = 0);
+  static BsonValue ObjectId(const std::string& bytes12);
+  static BsonValue Bool(bool v);
+  static BsonValue DateTime(int64_t ms);
+  static BsonValue Null();
+  static BsonValue Int32(int32_t v);
+  static BsonValue Int64(int64_t v);
+
+  bool operator==(const BsonValue& o) const;
+};
+
+// Finds the first element named `key` (nullptr when absent).
+const BsonValue* bson_find(const BsonDoc& doc, const std::string& key);
+
+// Serializes a document (including its i32 length and terminator).
+void bson_write_doc(const BsonDoc& doc, std::string* out);
+// Parses one document at (*pos); 1 ok / 0 partial / -1 malformed.
+// Depth- and size-bounded.
+int bson_read_doc(const std::string& in, size_t* pos, BsonDoc* out,
+                  int depth = 0);
+
+// ---- server side ---------------------------------------------------------
+
+// Command handlers keyed by command name (first element key, matched
+// case-insensitively like mongod).  The handler returns the reply
+// document; add "ok": 1.0 yourself (or use ok_reply()).  Unhandled
+// commands get {ok: 0, errmsg, code: 59 CommandNotFound}, except the
+// handshake commands (hello / isMaster / ping / buildInfo) which have
+// builtin defaults so stock drivers can connect.
+class MongoService {
+ public:
+  using CommandHandler = std::function<BsonDoc(const BsonDoc& request)>;
+
+  bool AddCommandHandler(const std::string& name, CommandHandler h);
+  const CommandHandler* FindCommandHandler(const std::string& lower) const;
+
+  static BsonDoc ok_reply();
+
+ private:
+  std::map<std::string, CommandHandler> handlers_;
+};
+
+void register_mongo_protocol();
+
+// ---- client side ---------------------------------------------------------
+
+class MongoClient {
+ public:
+  struct Options {
+    int64_t timeout_ms = 1000;
+  };
+
+  ~MongoClient();
+  int Init(const std::string& addr, const Options* opts = nullptr);
+
+  // Runs one command (OP_MSG roundtrip).  ok=false with errmsg filled on
+  // transport errors; command-level failures come back in the doc
+  // ("ok": 0) like a real driver.
+  struct Result {
+    bool ok = false;
+    std::string errmsg;
+    BsonDoc reply;
+  };
+  Result run_command(const BsonDoc& cmd);
+
+ private:
+  Options opts_;
+  FiberMutex sock_mu_;
+  ClientSocket csock_;
+  uint32_t next_request_ = 1;
+};
+
+}  // namespace trpc

@@ -1,0 +1,507 @@
+#include "net/mysql.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+
+#include "base/logging.h"
+#include "base/sha1.h"
+#include "base/time.h"
+#include "fiber/fiber.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr size_t kMaxPacket = 64ull << 20;
+
+// Capability flags (public protocol constants).
+constexpr uint32_t kLongPassword = 0x1;
+constexpr uint32_t kConnectWithDb = 0x8;
+constexpr uint32_t kProtocol41 = 0x200;
+constexpr uint32_t kTransactions = 0x2000;
+constexpr uint32_t kSecureConnection = 0x8000;
+constexpr uint32_t kPluginAuth = 0x80000;
+
+constexpr uint8_t kComQuit = 0x01;
+constexpr uint8_t kComInitDb = 0x02;
+constexpr uint8_t kComQuery = 0x03;
+constexpr uint8_t kComPing = 0x0e;
+
+// ---- fd IO with fiber-parking waits --------------------------------------
+
+int read_n(int fd, void* buf, size_t n, int64_t deadline_us) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    const ssize_t rc = ::read(fd, p, n);
+    if (rc > 0) {
+      p += rc;
+      n -= rc;
+      continue;
+    }
+    if (rc == 0) {
+      return -1;  // peer closed
+    }
+    if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+      return -1;
+    }
+    if (errno != EINTR &&
+        fiber_fd_wait(fd, EPOLLIN, deadline_us) < 0) {
+      return -1;  // timeout
+    }
+  }
+  return 0;
+}
+
+int write_all(int fd, const void* buf, size_t n, int64_t deadline_us) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    const ssize_t rc = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (rc > 0) {
+      p += rc;
+      n -= rc;
+      continue;
+    }
+    if (rc < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+        errno != EINTR) {
+      return -1;
+    }
+    if (errno != EINTR &&
+        fiber_fd_wait(fd, EPOLLOUT, deadline_us) < 0) {
+      return -1;
+    }
+  }
+  return 0;
+}
+
+// ---- packet layer --------------------------------------------------------
+
+int read_packet(int fd, std::string* payload, uint8_t* seq,
+                int64_t deadline_us) {
+  uint8_t head[4];
+  if (read_n(fd, head, 4, deadline_us) != 0) {
+    return -1;
+  }
+  const uint32_t len = head[0] | (head[1] << 8) | (head[2] << 16);
+  *seq = head[3];
+  if (len > kMaxPacket) {
+    return -1;
+  }
+  payload->resize(len);
+  return read_n(fd, payload->data(), len, deadline_us);
+}
+
+int write_packet(int fd, const std::string& payload, uint8_t seq,
+                 int64_t deadline_us) {
+  if (payload.size() > kMaxPacket) {
+    return -1;
+  }
+  uint8_t head[4] = {static_cast<uint8_t>(payload.size()),
+                     static_cast<uint8_t>(payload.size() >> 8),
+                     static_cast<uint8_t>(payload.size() >> 16), seq};
+  if (write_all(fd, head, 4, deadline_us) != 0) {
+    return -1;
+  }
+  return write_all(fd, payload.data(), payload.size(), deadline_us);
+}
+
+// ---- primitive readers ---------------------------------------------------
+
+bool get_lenenc(const std::string& p, size_t* pos, uint64_t* out) {
+  if (*pos >= p.size()) {
+    return false;
+  }
+  const uint8_t first = static_cast<uint8_t>(p[*pos]);
+  ++*pos;
+  if (first < 0xfb) {
+    *out = first;
+    return true;
+  }
+  int n = first == 0xfc ? 2 : first == 0xfd ? 3 : first == 0xfe ? 8 : -1;
+  if (n < 0 || p.size() - *pos < static_cast<size_t>(n)) {
+    return false;
+  }
+  uint64_t v = 0;
+  for (int i = 0; i < n; ++i) {
+    v |= static_cast<uint64_t>(static_cast<uint8_t>(p[*pos + i]))
+         << (8 * i);
+  }
+  *pos += n;
+  *out = v;
+  return true;
+}
+
+bool get_lenenc_str(const std::string& p, size_t* pos, std::string* out) {
+  uint64_t len;
+  if (!get_lenenc(p, pos, &len) || p.size() - *pos < len) {
+    return false;
+  }
+  out->assign(p, *pos, len);
+  *pos += len;
+  return true;
+}
+
+bool get_nul_str(const std::string& p, size_t* pos, std::string* out) {
+  const size_t nul = p.find('\0', *pos);
+  if (nul == std::string::npos) {
+    return false;
+  }
+  out->assign(p, *pos, nul - *pos);
+  *pos = nul + 1;
+  return true;
+}
+
+void put_u32le(std::string* out, uint32_t v) {
+  out->append(reinterpret_cast<const char*>(&v), 4);
+}
+
+bool is_eof_packet(const std::string& p) {
+  return !p.empty() && static_cast<uint8_t>(p[0]) == 0xfe && p.size() < 9;
+}
+
+// Parses an ERR packet into the result.
+void parse_err(const std::string& p, MysqlClient::Result* r) {
+  r->ok = false;
+  size_t pos = 1;
+  if (p.size() >= 3) {
+    r->error_code = static_cast<uint8_t>(p[1]) |
+                    (static_cast<uint8_t>(p[2]) << 8);
+    pos = 3;
+  }
+  if (pos < p.size() && p[pos] == '#') {
+    pos += 6;  // '#' + 5-char sqlstate
+  }
+  if (pos <= p.size()) {
+    r->error_text.assign(p, pos, p.size() - pos);
+  }
+}
+
+// Parses an OK packet into the result.
+bool parse_ok(const std::string& p, MysqlClient::Result* r) {
+  size_t pos = 1;
+  if (!get_lenenc(p, &pos, &r->affected_rows) ||
+      !get_lenenc(p, &pos, &r->last_insert_id)) {
+    return false;
+  }
+  r->ok = true;
+  return true;
+}
+
+}  // namespace
+
+// ---- scramble ------------------------------------------------------------
+
+std::string MysqlClient::native_scramble(const std::string& password,
+                                         const std::string& nonce20) {
+  if (password.empty()) {
+    return "";
+  }
+  const std::string h1 = sha1(password);
+  const std::string h2 = sha1(h1);
+  const std::string h3 = sha1(nonce20 + h2);
+  std::string out(20, '\0');
+  for (int i = 0; i < 20; ++i) {
+    out[i] = h1[i] ^ h3[i];
+  }
+  return out;
+}
+
+// ---- connection ----------------------------------------------------------
+
+MysqlClient::~MysqlClient() {
+  if (fd_ >= 0) {
+    std::string quit(1, static_cast<char>(kComQuit));
+    write_packet(fd_, quit, 0, monotonic_time_us() + 100000);
+    ::close(fd_);
+  }
+}
+
+int MysqlClient::Init(const std::string& addr, const Options* opts) {
+  fiber_init(0);
+  if (opts != nullptr) {
+    opts_ = *opts;
+  }
+  return hostname2endpoint(addr.c_str(), &ep_);
+}
+
+void MysqlClient::drop_connection() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+int MysqlClient::ensure_connected() {
+  if (fd_ >= 0) {
+    return 0;
+  }
+  const int64_t deadline =
+      monotonic_time_us() + opts_.timeout_ms * 1000;
+  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) {
+    return -1;
+  }
+  sockaddr_in sin = {};
+  sin.sin_family = AF_INET;
+  sin.sin_addr.s_addr = ep_.ip;  // already network byte order
+  sin.sin_port = htons(static_cast<uint16_t>(ep_.port));
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&sin), sizeof(sin)) != 0 &&
+      errno != EINPROGRESS) {
+    ::close(fd);
+    return -1;
+  }
+  if (fiber_fd_wait(fd, EPOLLOUT, deadline) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  int soerr = 0;
+  socklen_t slen = sizeof(soerr);
+  if (::getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen) != 0 ||
+      soerr != 0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+
+  // --- greeting (server speaks first) ---
+  std::string pkt;
+  uint8_t seq = 0;
+  if (read_packet(fd, &pkt, &seq, deadline) != 0 || pkt.empty()) {
+    ::close(fd);
+    return -1;
+  }
+  if (static_cast<uint8_t>(pkt[0]) == 0xff) {
+    ::close(fd);  // server rejected us before auth (too many conns, ...)
+    return -1;
+  }
+  if (static_cast<uint8_t>(pkt[0]) != 10) {
+    ::close(fd);  // only protocol V10
+    return -1;
+  }
+  size_t pos = 1;
+  std::string server_version;
+  if (!get_nul_str(pkt, &pos, &server_version) || pkt.size() < pos + 13) {
+    ::close(fd);
+    return -1;
+  }
+  pos += 4;  // thread id
+  std::string nonce = pkt.substr(pos, 8);
+  pos += 8 + 1;  // auth-data-1 + filler
+  if (pkt.size() < pos + 2) {
+    ::close(fd);
+    return -1;
+  }
+  uint32_t caps = static_cast<uint8_t>(pkt[pos]) |
+                  (static_cast<uint8_t>(pkt[pos + 1]) << 8);
+  pos += 2;
+  if (pkt.size() >= pos + 1 + 2 + 2 + 1 + 10) {
+    pos += 1 + 2;  // charset, status
+    caps |= (static_cast<uint32_t>(static_cast<uint8_t>(pkt[pos])) |
+             (static_cast<uint32_t>(static_cast<uint8_t>(pkt[pos + 1]))
+              << 8))
+            << 16;
+    const uint8_t auth_len = static_cast<uint8_t>(pkt[pos + 2]);
+    pos += 2 + 1 + 10;
+    if (caps & kSecureConnection) {
+      const size_t part2 =
+          auth_len > 8 ? static_cast<size_t>(auth_len) - 8 : 13;
+      if (pkt.size() >= pos + part2) {
+        // part2 includes a trailing NUL; the scramble nonce is 20 bytes.
+        nonce += pkt.substr(pos, part2 >= 13 ? 12 : part2);
+        pos += part2;
+      }
+    }
+  }
+
+  // --- HandshakeResponse41 ---
+  uint32_t my_caps = kLongPassword | kProtocol41 | kTransactions |
+                     kSecureConnection | kPluginAuth;
+  if (!opts_.database.empty()) {
+    my_caps |= kConnectWithDb;
+  }
+  std::string rsp;
+  put_u32le(&rsp, my_caps);
+  put_u32le(&rsp, 16 << 20);  // max packet
+  rsp.push_back(33);          // utf8_general_ci
+  rsp.append(23, '\0');
+  rsp.append(opts_.user);
+  rsp.push_back('\0');
+  const std::string scr = native_scramble(opts_.password, nonce);
+  rsp.push_back(static_cast<char>(scr.size()));
+  rsp.append(scr);
+  if (!opts_.database.empty()) {
+    rsp.append(opts_.database);
+    rsp.push_back('\0');
+  }
+  rsp.append("mysql_native_password");
+  rsp.push_back('\0');
+  if (write_packet(fd, rsp, static_cast<uint8_t>(seq + 1), deadline) !=
+      0) {
+    ::close(fd);
+    return -1;
+  }
+
+  // --- auth result (possibly via AuthSwitchRequest) ---
+  if (read_packet(fd, &pkt, &seq, deadline) != 0 || pkt.empty()) {
+    ::close(fd);
+    return -1;
+  }
+  if (static_cast<uint8_t>(pkt[0]) == 0xfe && pkt.size() > 1) {
+    // AuthSwitchRequest: only mysql_native_password is speakable.
+    size_t sp = 1;
+    std::string plugin, data;
+    if (!get_nul_str(pkt, &sp, &plugin) ||
+        plugin != "mysql_native_password") {
+      ::close(fd);
+      return -1;
+    }
+    data = pkt.substr(sp);
+    if (!data.empty() && data.back() == '\0') {
+      data.pop_back();
+    }
+    if (write_packet(fd, native_scramble(opts_.password, data),
+                     static_cast<uint8_t>(seq + 1), deadline) != 0 ||
+        read_packet(fd, &pkt, &seq, deadline) != 0 || pkt.empty()) {
+      ::close(fd);
+      return -1;
+    }
+  }
+  if (static_cast<uint8_t>(pkt[0]) != 0x00) {
+    LOG(Warning) << "mysql auth failed for user " << opts_.user;
+    ::close(fd);
+    return -1;
+  }
+  fd_ = fd;
+  return 0;
+}
+
+// ---- commands ------------------------------------------------------------
+
+MysqlClient::Result MysqlClient::command(uint8_t com,
+                                         const std::string& arg) {
+  Result r;
+  LockGuard<FiberMutex> g(mu_);
+  const int64_t deadline =
+      monotonic_time_us() + opts_.timeout_ms * 1000;
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    if (ensure_connected() != 0) {
+      r.error_code = 2003;  // CR_CONN_HOST_ERROR
+      r.error_text = "cannot connect to " + endpoint2str(ep_);
+      return r;
+    }
+    std::string req(1, static_cast<char>(com));
+    req.append(arg);
+    std::string pkt;
+    uint8_t seq = 0;
+    if (write_packet(fd_, req, 0, deadline) != 0 ||
+        read_packet(fd_, &pkt, &seq, deadline) != 0 || pkt.empty()) {
+      // Dead connection: drop it and retry ONCE on a fresh one (only
+      // for the first failure — a second means the server is gone).
+      drop_connection();
+      continue;
+    }
+
+    const uint8_t first = static_cast<uint8_t>(pkt[0]);
+    if (first == 0xff) {
+      parse_err(pkt, &r);
+      return r;
+    }
+    if (first == 0x00) {
+      if (!parse_ok(pkt, &r)) {
+        r.error_text = "malformed OK packet";
+      }
+      return r;
+    }
+    // Resultset: column count, defs, EOF, rows, EOF.
+    size_t pos = 0;
+    uint64_t ncols = 0;
+    if (!get_lenenc(pkt, &pos, &ncols) || ncols == 0 || ncols > 4096) {
+      r.error_text = "malformed resultset header";
+      drop_connection();
+      return r;
+    }
+    for (uint64_t i = 0; i < ncols; ++i) {
+      if (read_packet(fd_, &pkt, &seq, deadline) != 0) {
+        drop_connection();
+        r.error_text = "short column definitions";
+        return r;
+      }
+      // Column definition41: catalog/schema/table/org_table/name/...
+      size_t cp = 0;
+      std::string skip, name;
+      if (get_lenenc_str(pkt, &cp, &skip) &&     // catalog ("def")
+          get_lenenc_str(pkt, &cp, &skip) &&     // schema
+          get_lenenc_str(pkt, &cp, &skip) &&     // table
+          get_lenenc_str(pkt, &cp, &skip) &&     // org_table
+          get_lenenc_str(pkt, &cp, &name)) {
+        r.columns.push_back(std::move(name));
+      } else {
+        r.columns.push_back("col" + std::to_string(i));
+      }
+    }
+    if (read_packet(fd_, &pkt, &seq, deadline) != 0 ||
+        !is_eof_packet(pkt)) {
+      drop_connection();
+      r.error_text = "missing EOF after column definitions";
+      return r;
+    }
+    while (true) {
+      if (read_packet(fd_, &pkt, &seq, deadline) != 0) {
+        drop_connection();
+        r.error_text = "short resultset";
+        return r;
+      }
+      if (is_eof_packet(pkt)) {
+        break;
+      }
+      if (!pkt.empty() && static_cast<uint8_t>(pkt[0]) == 0xff) {
+        parse_err(pkt, &r);
+        return r;
+      }
+      std::vector<std::optional<std::string>> row;
+      size_t rp = 0;
+      for (uint64_t i = 0; i < ncols; ++i) {
+        if (rp < pkt.size() && static_cast<uint8_t>(pkt[rp]) == 0xfb) {
+          row.emplace_back(std::nullopt);
+          ++rp;
+          continue;
+        }
+        std::string cell;
+        if (!get_lenenc_str(pkt, &rp, &cell)) {
+          drop_connection();
+          r.error_text = "malformed row";
+          return r;
+        }
+        row.emplace_back(std::move(cell));
+      }
+      r.rows.push_back(std::move(row));
+    }
+    r.ok = true;
+    return r;
+  }
+  r.error_code = 2013;  // CR_SERVER_LOST
+  r.error_text = "lost connection during query";
+  return r;
+}
+
+MysqlClient::Result MysqlClient::Query(const std::string& sql) {
+  return command(kComQuery, sql);
+}
+
+int MysqlClient::Ping() {
+  return command(kComPing, "").ok ? 0 : -1;
+}
+
+int MysqlClient::SelectDb(const std::string& db) {
+  Result r = command(kComInitDb, db);
+  return r.ok ? 0 : -1;
+}
+
+}  // namespace trpc

@@ -559,11 +559,7 @@ struct RedisCliConn {
 const char kRedisCliTag = 0;
 
 RedisCliConn* cli_conn_of(Socket* s) {
-  if (s->parse_state == nullptr || s->parse_state_owner != &kRedisCliTag) {
-    s->parse_state = std::make_shared<RedisCliConn>();
-    s->parse_state_owner = &kRedisCliTag;
-  }
-  return static_cast<RedisCliConn*>(s->parse_state.get());
+  return proto_conn_of<RedisCliConn>(s, &kRedisCliTag);
 }
 
 ParseError redisc_parse(IOBuf* source, InputMessage* out, Socket* sock) {
@@ -633,10 +629,7 @@ RedisReply client_error(const std::string& text) {
 }  // namespace
 
 RedisClient::~RedisClient() {
-  SocketRef s(Socket::Address(sock_));
-  if (s) {
-    s->SetFailed(ESHUTDOWN);
-  }
+  csock_.Shutdown();
 }
 
 int RedisClient::Init(const std::string& addr, const Options* opts) {
@@ -645,36 +638,21 @@ int RedisClient::Init(const std::string& addr, const Options* opts) {
     opts_ = *opts;
   }
   redisc_protocol_index();
-  return hostname2endpoint(addr.c_str(), &ep_);
+  return csock_.Init(addr);
 }
 
-int RedisClient::ensure_socket(SocketId* out) {
-  LockGuard<FiberMutex> g(sock_mu_);
-  Socket* s = Socket::Address(sock_);
-  if (s != nullptr) {
-    if (!s->Failed()) {
-      *out = sock_;
-      s->Dereference();
+std::vector<RedisReply> RedisClient::pipeline(
+    const std::vector<std::vector<std::string>>& cmds) {
+  std::vector<RedisReply> replies(cmds.size());
+  SocketId sid = 0;
+  // The install hook sends the AUTH preamble on fresh connections; its
+  // waiter rides the FIFO like any command, keeping reply alignment.
+  auto install = [this](Socket* fresh) -> int {
+    cli_conn_of(fresh);  // install state while single-threaded
+    if (opts_.password.empty()) {
       return 0;
     }
-    s->Dereference();
-  }
-  Socket::Options sopts;
-  sopts.fd = -1;  // lazy connect in the write fiber
-  sopts.remote = ep_;
-  sopts.on_readable = &messenger_on_readable;
-  if (Socket::Create(sopts, &sock_) != 0) {
-    return -1;
-  }
-  SocketRef fresh(Socket::Address(sock_));
-  if (!fresh) {
-    return -1;
-  }
-  fresh->pinned_protocol = redisc_protocol_index();
-  cli_conn_of(fresh.get());  // install state while single-threaded
-  if (!opts_.password.empty()) {
-    // AUTH rides the FIFO like any command; its waiter keeps alignment.
-    RedisCliConn* c = cli_conn_of(fresh.get());
+    RedisCliConn* c = cli_conn_of(fresh);
     std::string wire;
     resp_pack_command({"AUTH", opts_.password}, &wire);
     auto w = std::make_shared<RedisWaiter>();
@@ -682,22 +660,16 @@ int RedisClient::ensure_socket(SocketId* out) {
     c->pending.push_back(w);
     IOBuf frame;
     frame.append(wire);
-    if (fresh->Write(std::move(frame)) != 0) {
-      return -1;
+    return fresh->Write(std::move(frame));
+  };
+  {
+    LockGuard<FiberMutex> g(sock_mu_);
+    if (csock_.ensure(redisc_protocol_index(), install, &sid) != 0) {
+      std::fill(replies.begin(), replies.end(),
+                client_error("cannot reach " +
+                             endpoint2str(csock_.endpoint())));
+      return replies;
     }
-  }
-  *out = sock_;
-  return 0;
-}
-
-std::vector<RedisReply> RedisClient::pipeline(
-    const std::vector<std::vector<std::string>>& cmds) {
-  std::vector<RedisReply> replies(cmds.size());
-  SocketId sid = 0;
-  if (ensure_socket(&sid) != 0) {
-    std::fill(replies.begin(), replies.end(),
-              client_error("cannot reach " + endpoint2str(ep_)));
-    return replies;
   }
   SocketRef s(Socket::Address(sid));
   if (!s) {

@@ -21,6 +21,7 @@
 #include "base/endpoint.h"
 #include "base/iobuf.h"
 #include "fiber/sync.h"
+#include "net/proto_client.h"
 #include "net/socket.h"
 
 namespace trpc {
@@ -148,12 +149,9 @@ class RedisClient {
       const std::vector<std::vector<std::string>>& cmds);
 
  private:
-  int ensure_socket(SocketId* out);
-
-  EndPoint ep_;
   Options opts_;
   FiberMutex sock_mu_;
-  SocketId sock_ = 0;
+  ClientSocket csock_;
 };
 
 }  // namespace trpc

@@ -21,6 +21,7 @@
 #include "net/http_protocol.h"
 #include "net/redis.h"
 #include "net/memcache.h"
+#include "net/mongo.h"
 #include "net/legacy_pbrpc.h"
 #include "net/nshead.h"
 #include "net/thrift.h"
@@ -220,6 +221,15 @@ int Server::Start(int port) {
   if (memcache_service_ != nullptr) {
     register_memcache_protocol();
   }
+  if (mongo_service_ != nullptr) {
+    register_mongo_protocol();
+  }
+  // redis must precede the nshead family and esp: its '*' marker decides
+  // instantly, while those probers HOLD short prefixes (no magic in the
+  // first bytes) and would shadow a fragmented RESP command forever.
+  if (redis_service_ != nullptr) {
+    register_redis_protocol();
+  }
   if (nshead_service_ != nullptr) {
     register_nshead_protocol();
   }
@@ -231,9 +241,6 @@ int Server::Start(int port) {
   }
   if (esp_service_ != nullptr) {
     register_esp_protocol();  // last: esp has no magic to probe
-  }
-  if (redis_service_ != nullptr) {
-    register_redis_protocol();
   }
   start_time_us_ = monotonic_time_us();
   // Shared-memory transport handshake (net/shm_transport.h): a client sends

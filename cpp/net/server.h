@@ -29,6 +29,7 @@ namespace trpc {
 class RedisService;   // net/redis.h
 class ThriftService;  // net/thrift.h
 class MemcacheService;  // net/memcache.h
+class MongoService;     // net/mongo.h
 class NsheadService;  // net/nshead.h
 class EspService;     // net/nshead.h
 
@@ -88,6 +89,12 @@ class Server {
   // in-process fixture its tests fake externally).  Not owned.
   void set_memcache_service(MemcacheService* ms) { memcache_service_ = ms; }
   MemcacheService* memcache_service() const { return memcache_service_; }
+
+  // Makes this server answer mongo drivers (OP_MSG) on its port
+  // (net/mongo.h; parity: policy/mongo_protocol.cpp server adaptor).
+  // Not owned.  Call before Start.
+  void set_mongo_service(MongoService* ms) { mongo_service_ = ms; }
+  MongoService* mongo_service() const { return mongo_service_; }
 
   // nshead-family personalities (net/nshead.h, net/legacy_pbrpc.h).  The
   // 36-byte head's magic is the shared discriminator, so install at most
@@ -184,6 +191,7 @@ class Server {
   RedisService* redis_service_ = nullptr;
   ThriftService* thrift_service_ = nullptr;
   MemcacheService* memcache_service_ = nullptr;
+  MongoService* mongo_service_ = nullptr;
   NsheadService* nshead_service_ = nullptr;
   EspService* esp_service_ = nullptr;
   bool nova_pbrpc_ = false;

@@ -64,3 +64,27 @@ def test_http():
 
 def test_shm():
     _run("test_shm", timeout=180)
+
+
+def test_pbwire():
+    _run("test_pbwire")
+
+
+def test_thrift():
+    _run("test_thrift", timeout=180)
+
+
+def test_memcache():
+    _run("test_memcache", timeout=180)
+
+
+def test_legacy():
+    _run("test_legacy", timeout=180)
+
+
+def test_mysql():
+    _run("test_mysql", timeout=180)
+
+
+def test_mongo():
+    _run("test_mongo", timeout=180)
