@@ -1,0 +1,34 @@
+#include "base/symbolize.h"
+
+#include <dlfcn.h>
+#include <stdio.h>
+#include <string.h>
+
+#include <cstdint>
+
+namespace trpc {
+
+std::string symbolize_addr(void* addr) {
+  Dl_info info;
+  if (dladdr(addr, &info) != 0) {
+    if (info.dli_sname != nullptr) {
+      return info.dli_sname;  // exported symbol
+    }
+    if (info.dli_fname != nullptr) {
+      // Static functions have no dynamic symbol: report module+offset so
+      // external tooling (addr2line, pprof with the binary) can resolve.
+      const char* base = strrchr(info.dli_fname, '/');
+      char buf[256];
+      snprintf(buf, sizeof(buf), "%s+0x%zx",
+               base != nullptr ? base + 1 : info.dli_fname,
+               reinterpret_cast<uintptr_t>(addr) -
+                   reinterpret_cast<uintptr_t>(info.dli_fbase));
+      return buf;
+    }
+  }
+  char buf[32];
+  snprintf(buf, sizeof(buf), "%p", addr);
+  return buf;
+}
+
+}  // namespace trpc

@@ -1,0 +1,12 @@
+// Address symbolization shared by the profilers and the fiber tracer:
+// dynamic symbol name when exported, else "module+0xoffset" (resolvable
+// by addr2line / pprof against the binary), else the raw pointer.
+#pragma once
+
+#include <string>
+
+namespace trpc {
+
+std::string symbolize_addr(void* addr);
+
+}  // namespace trpc

@@ -1,0 +1,170 @@
+// C++20 coroutine adapter over the fiber runtime.
+//
+// Parity: the reference's experimental coroutine bridge
+// (/root/reference/src/brpc/coroutine.h + usercode_in_coroutine):
+// user code written as co_await chains rides the same scheduler as
+// callback code.  Condensed form: CoTask<T> (eager coroutine whose
+// completion is a fiber-parkable event), co_run (run a callable on a
+// fresh fiber, resume the coroutine when it returns), and co_call
+// (issue an async Channel RPC, resume on its done closure).  A resumed
+// coroutine continues on the fiber that completed the awaited work —
+// the same continuation-stealing the reference's bridge does.
+#pragma once
+
+#include <coroutine>
+#include <functional>
+#include <optional>
+#include <utility>
+
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "net/channel.h"
+#include "net/controller.h"
+
+namespace trpc {
+
+// Eagerly-started coroutine handle.  join() parks the calling fiber (or
+// pthread) until the body completes; co_await composes tasks (single
+// awaiter).  Exceptions thrown by the body rethrow from join() /
+// await_resume().
+template <typename T>
+class CoTask {
+  // `waiter` is the completion handshake: nullptr = running & unawaited,
+  // kDoneSentinel = body finished, anything else = the awaiting parent's
+  // handle.  A single CAS on each side closes the suspend-vs-complete
+  // race (the lost-wakeup and the double-resume are both impossible).
+  static void* done_sentinel() {
+    static char sentinel;
+    return &sentinel;
+  }
+
+ public:
+  struct promise_type {
+    std::optional<T> value;
+    std::exception_ptr error;
+    std::atomic<void*> waiter{nullptr};
+    CountdownEvent done{1};  // for join(); signaled LAST
+
+    CoTask get_return_object() {
+      return CoTask(
+          std::coroutine_handle<promise_type>::from_promise(*this));
+    }
+    std::suspend_never initial_suspend() noexcept { return {}; }
+    struct FinalAwaiter {
+      bool await_ready() noexcept { return false; }
+      std::coroutine_handle<> await_suspend(
+          std::coroutine_handle<promise_type> h) noexcept {
+        promise_type& p = h.promise();
+        // Claim completion; learn whether a parent already attached.
+        void* prev = p.waiter.exchange(done_sentinel(),
+                                       std::memory_order_acq_rel);
+        std::coroutine_handle<> next =
+            prev != nullptr ? std::coroutine_handle<>::from_address(prev)
+                            : std::noop_coroutine();
+        // done.signal() is the LAST touch of the promise: it may release
+        // a join()er whose ~CoTask destroys this frame immediately.
+        p.done.signal();
+        return next;
+      }
+      void await_resume() noexcept {}
+    };
+    FinalAwaiter final_suspend() noexcept { return {}; }
+    void return_value(T v) { value = std::move(v); }
+    void unhandled_exception() { error = std::current_exception(); }
+  };
+
+  explicit CoTask(std::coroutine_handle<promise_type> h) : h_(h) {}
+  CoTask(CoTask&& o) noexcept : h_(std::exchange(o.h_, nullptr)) {}
+  CoTask(const CoTask&) = delete;
+  ~CoTask() {
+    if (h_) {
+      h_.promise().done.wait(-1);  // the frame dies with the task object
+      h_.destroy();
+    }
+  }
+
+  // Parks until the coroutine body has finished; returns its value (or
+  // rethrows what the body threw).
+  T join() {
+    h_.promise().done.wait(-1);
+    return take();
+  }
+
+  // Composition: co_await task.
+  bool await_ready() {
+    return h_.promise().waiter.load(std::memory_order_acquire) ==
+           done_sentinel();
+  }
+  bool await_suspend(std::coroutine_handle<> parent) {
+    void* expected = nullptr;
+    if (h_.promise().waiter.compare_exchange_strong(
+            expected, parent.address(), std::memory_order_acq_rel)) {
+      return true;  // FinalAwaiter will resume the parent
+    }
+    return false;  // completed in the window: resume immediately
+  }
+  T await_resume() { return take(); }
+
+ private:
+  T take() {
+    promise_type& p = h_.promise();
+    if (p.error) {
+      std::rethrow_exception(p.error);
+    }
+    return std::move(*p.value);
+  }
+
+  std::coroutine_handle<promise_type> h_;
+};
+
+// Awaitable running `fn` on a fresh fiber; the coroutine resumes (on
+// that fiber) with fn's return value.
+template <typename Fn>
+auto co_run(Fn fn) {
+  using R = decltype(fn());
+  struct Awaiter {
+    Fn fn;
+    std::optional<R> result;
+    std::coroutine_handle<> h;
+
+    bool await_ready() { return false; }
+    void await_suspend(std::coroutine_handle<> handle) {
+      h = handle;
+      fiber_start(
+          nullptr,
+          [](void* arg) {
+            auto* self = static_cast<Awaiter*>(arg);
+            self->result = self->fn();
+            self->h.resume();  // continuation runs on this fiber
+          },
+          this, 0);
+    }
+    R await_resume() { return std::move(*result); }
+  };
+  return Awaiter{std::move(fn)};
+}
+
+// Awaitable for one async RPC: issues CallMethod with a done closure
+// that resumes the coroutine (on the response fiber).  The caller owns
+// cntl/response, same lifetimes as the callback API.
+inline auto co_call(Channel* ch, const std::string& method,
+                    const IOBuf& request, IOBuf* response,
+                    Controller* cntl) {
+  struct Awaiter {
+    Channel* ch;
+    const std::string& method;
+    const IOBuf& request;
+    IOBuf* response;
+    Controller* cntl;
+
+    bool await_ready() { return false; }
+    void await_suspend(std::coroutine_handle<> h) {
+      ch->CallMethod(method, request, response, cntl,
+                     [h]() mutable { h.resume(); });
+    }
+    void await_resume() {}
+  };
+  return Awaiter{ch, method, request, response, cntl};
+}
+
+}  // namespace trpc

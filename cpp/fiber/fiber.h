@@ -35,10 +35,13 @@ int fiber_join(fiber_t f);
 int fiber_fd_wait(int fd, int events, int64_t deadline_us = -1);
 // Diagnostic dump of all live fibers: id, state (parked/runnable) and
 // the symbolized entry function (parity: the TaskTracer-backed /bthreads
-// service, task_tracer.cpp — condensed to registry introspection; full
-// foreign-stack unwinds need a signal+libunwind machinery this runtime
-// deliberately avoids).
-std::string fiber_dump_all(size_t max_rows = 200);
+// service, task_tracer.cpp:40-43).  With `stacks`, each PARKED fiber's
+// suspension point is unwound by walking its saved rbp chain (the
+// context layout in context.S puts rbp at sp+48, the return address at
+// sp+56; the build keeps frame pointers).  Best-effort: a fiber resuming
+// mid-walk yields stale frames, never a fault — every pointer is
+// bounds-checked against the fiber's own mapped stack.
+std::string fiber_dump_all(size_t max_rows = 200, bool stacks = false);
 // Interrupts a parked fiber (parity: TaskGroup::interrupt, task_group.h:208
 // / bthread_stop): its current (or next) blocking Event::wait returns
 // EINTR.  Cooperative — the fiber decides how to unwind.  Returns 0, or

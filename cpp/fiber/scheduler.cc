@@ -8,6 +8,7 @@
 #include <cstring>
 
 #include "base/logging.h"
+#include "base/symbolize.h"
 #include "base/rand.h"
 #include "base/resource_pool.h"
 #include "fiber/context.h"
@@ -346,7 +347,43 @@ int fiber_start(fiber_t* out, void (*fn)(void*), void* arg, int flags) {
   return 0;
 }
 
-std::string fiber_dump_all(size_t max_rows) {
+namespace {
+
+// Unwinds a PARKED fiber from its saved context (context.S layout:
+// sp+48 saved rbp, sp+56 return address), walking the frame-pointer
+// chain.  Best-effort under concurrency: the fiber may resume mid-walk,
+// so every dereference is bounds-checked against its own stack — reads
+// can go stale but cannot fault (the stack stays mapped while the meta
+// is live).
+std::string walk_parked_stack(FiberMeta* m, int max_frames) {
+  uint8_t* sp = static_cast<uint8_t*>(m->sp);
+  uint8_t* lo = static_cast<uint8_t*>(m->stack.base);
+  uint8_t* hi = lo + m->stack.size;
+  if (lo == nullptr || sp < lo || sp + 64 > hi) {
+    return "";
+  }
+  std::string out;
+  void* pc = *reinterpret_cast<void**>(sp + 56);
+  uint8_t* rbp = *reinterpret_cast<uint8_t**>(sp + 48);
+  for (int i = 0; i < max_frames && pc != nullptr; ++i) {
+    out += "    #" + std::to_string(i) + " " + symbolize_addr(pc) + "\n";
+    if (rbp < sp || rbp + 16 > hi ||
+        (reinterpret_cast<uintptr_t>(rbp) & 7) != 0) {
+      break;  // frame chain left the stack (or was never valid)
+    }
+    pc = *reinterpret_cast<void**>(rbp + 8);
+    uint8_t* next = *reinterpret_cast<uint8_t**>(rbp);
+    if (next <= rbp) {
+      break;  // chains must grow upward; anything else is garbage
+    }
+    rbp = next;
+  }
+  return out;
+}
+
+}  // namespace
+
+std::string fiber_dump_all(size_t max_rows, bool stacks) {
   std::string out = "live fibers (id  state  entry)\n";
   const uint32_t hwm = FiberPool::instance()->hwm();
   size_t shown = 0;
@@ -379,6 +416,9 @@ std::string fiber_dump_all(size_t max_rows) {
                  (static_cast<uint64_t>(ver) << 32) | slot),
              parked != nullptr ? "parked" : "runnable", sym);
     out += line;
+    if (stacks && parked != nullptr) {
+      out += walk_parked_stack(m, 16);
+    }
     ++shown;
   }
   out += std::to_string(live) + " live";

@@ -277,7 +277,11 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
     return true;
   }
   if (path == "/fibers" || path == "/bthreads") {
-    *body = fiber_dump_all();
+    // ?stacks=1 additionally unwinds each parked fiber's suspension
+    // point (TaskTracer parity: where a stuck fiber IS, not just its
+    // entry symbol).
+    const std::string* sv = req.query("stacks");
+    *body = fiber_dump_all(200, sv != nullptr && *sv != "0");
     return true;
   }
   if (path == "/threads") {

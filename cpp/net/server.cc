@@ -22,6 +22,7 @@
 #include "net/redis.h"
 #include "net/memcache.h"
 #include "net/mongo.h"
+#include "net/usercode_pool.h"
 #include "net/legacy_pbrpc.h"
 #include "net/nshead.h"
 #include "net/thrift.h"
@@ -634,6 +635,17 @@ void tstd_process_request(InputMessage&& msg) {
   }
   if (msg.meta.has_checksum) {
     cntl->set_enable_checksum(true);  // checksum the response too
+  }
+  if (srv->usercode_in_pthread()) {
+    // Blocking-tolerant path: the handler runs on a backup pthread so a
+    // pthread-blocking body cannot pin this fiber worker.  done() is
+    // thread-agnostic (Socket::Write is callable from any thread).
+    UsercodePool::instance()->run(
+        [prop, cntl, request = std::move(request), response,
+         done = std::move(done)]() mutable {
+          prop->handler(cntl, request, response, std::move(done));
+        });
+    return;
   }
   prop->handler(cntl, request, response, std::move(done));
 }

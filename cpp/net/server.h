@@ -90,6 +90,14 @@ class Server {
   void set_memcache_service(MemcacheService* ms) { memcache_service_ = ms; }
   MemcacheService* memcache_service() const { return memcache_service_; }
 
+  // Runs method handlers on the usercode backup pthread pool instead of
+  // fiber workers (net/usercode_pool.h; parity: usercode_in_pthread +
+  // details/usercode_backup_pool.h:46).  For handlers that block on
+  // pthread-level primitives, which would otherwise pin fiber workers.
+  // Call before Start.
+  void set_usercode_in_pthread(bool on) { usercode_in_pthread_ = on; }
+  bool usercode_in_pthread() const { return usercode_in_pthread_; }
+
   // Makes this server answer mongo drivers (OP_MSG) on its port
   // (net/mongo.h; parity: policy/mongo_protocol.cpp server adaptor).
   // Not owned.  Call before Start.
@@ -194,6 +202,7 @@ class Server {
   MongoService* mongo_service_ = nullptr;
   NsheadService* nshead_service_ = nullptr;
   EspService* esp_service_ = nullptr;
+  bool usercode_in_pthread_ = false;
   bool nova_pbrpc_ = false;
   bool public_pbrpc_ = false;
   void* tls_ctx_ = nullptr;  // SSL_CTX (leaked singleton; net/tls.h)

@@ -1,0 +1,83 @@
+#include "net/usercode_pool.h"
+
+#include <pthread.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <thread>
+
+#include "stat/variable.h"
+
+namespace trpc {
+
+struct UsercodePool::Impl {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<std::function<void()>> queue;
+  std::atomic<int> inflight{0};
+  std::atomic<int> executed{0};
+
+  void worker() {
+    while (true) {
+      std::function<void()> fn;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv.wait(lk, [this] { return !queue.empty(); });
+        fn = std::move(queue.front());
+        queue.pop_front();
+      }
+      inflight.fetch_add(1, std::memory_order_relaxed);
+      fn();
+      inflight.fetch_sub(1, std::memory_order_relaxed);
+      executed.fetch_add(1, std::memory_order_relaxed);
+    }
+  }
+};
+
+UsercodePool::UsercodePool(int threads) : impl_(new Impl()) {
+  if (threads <= 0) {
+    const unsigned hw = std::thread::hardware_concurrency();
+    threads = hw > 4 ? static_cast<int>(hw) : 4;
+  }
+  for (int i = 0; i < threads; ++i) {
+    std::thread([impl = impl_] { impl->worker(); }).detach();
+  }
+  // Pressure gauges (observability parity: the reference exposes
+  // bthread_count-style vars; here /vars usercode_*).
+  static PassiveStatus<int64_t>* g_inflight =
+      new PassiveStatus<int64_t>([impl = impl_] {
+        return static_cast<int64_t>(impl->inflight.load());
+      });
+  g_inflight->expose("usercode_inflight");
+  static PassiveStatus<int64_t>* g_queue =
+      new PassiveStatus<int64_t>([impl = impl_] {
+        std::lock_guard<std::mutex> g(impl->mu);
+        return static_cast<int64_t>(impl->queue.size());
+      });
+  g_queue->expose("usercode_queue");
+}
+
+UsercodePool* UsercodePool::instance(int threads) {
+  static UsercodePool* p = new UsercodePool(threads);  // leaked singleton
+  return p;
+}
+
+void UsercodePool::run(std::function<void()> fn) {
+  {
+    std::lock_guard<std::mutex> g(impl_->mu);
+    impl_->queue.push_back(std::move(fn));
+  }
+  impl_->cv.notify_one();
+}
+
+int UsercodePool::inflight() const {
+  return impl_->inflight.load(std::memory_order_relaxed);
+}
+
+int UsercodePool::executed() const {
+  return impl_->executed.load(std::memory_order_relaxed);
+}
+
+}  // namespace trpc

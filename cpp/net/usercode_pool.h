@@ -1,0 +1,36 @@
+// Usercode backup pool — run blocking user handlers on pthreads.
+//
+// Parity: the reference's usercode_in_pthread escape hatch
+// (/root/reference/src/brpc/details/usercode_backup_pool.h:46
+// TooManyUserCode + a dedicated pthread pool): user code that blocks on
+// pthread-level primitives would otherwise pin fiber workers and starve
+// the event loop.  Condensed: Server::set_usercode_in_pthread(true)
+// routes every method handler through this pool; the pool is global
+// (like the reference's), lazily started, and exports its pressure as
+// /vars usercode_inflight + usercode_queue.
+#pragma once
+
+#include <functional>
+
+namespace trpc {
+
+class UsercodePool {
+ public:
+  // Global pool (leaked singleton); `threads` applies on first use only.
+  static UsercodePool* instance(int threads = 0);
+
+  // Enqueues `fn` for a backup pthread.  Never blocks the caller; the
+  // queue is unbounded (the concurrency limiter upstream is the
+  // admission control, same as the reference).
+  void run(std::function<void()> fn);
+
+  int inflight() const;   // running right now
+  int executed() const;   // lifetime count
+
+ private:
+  explicit UsercodePool(int threads);
+  struct Impl;
+  Impl* impl_;
+};
+
+}  // namespace trpc

@@ -13,6 +13,7 @@
 #include <mutex>
 #include <vector>
 
+#include "base/symbolize.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
 
@@ -51,15 +52,7 @@ void sigprof_handler(int, siginfo_t*, void*) {
   s.depth = backtrace(s.frames, kMaxDepth);
 }
 
-std::string symbolize(void* addr) {
-  Dl_info info;
-  if (dladdr(addr, &info) != 0 && info.dli_sname != nullptr) {
-    return info.dli_sname;
-  }
-  char buf[32];
-  snprintf(buf, sizeof(buf), "%p", addr);
-  return buf;
-}
+std::string symbolize(void* addr) { return symbolize_addr(addr); }
 
 // One profile at a time.  An atomic flag, NOT a mutex: the /hotspots
 // fiber sleeps between start and stop and may resume on a different OS
