@@ -11,9 +11,13 @@
 // the same continuation-stealing the reference's bridge does.
 #pragma once
 
+#include <atomic>
 #include <coroutine>
+#include <exception>
 #include <functional>
+#include <memory>
 #include <optional>
+#include <string>
 #include <utility>
 
 #include "fiber/fiber.h"
@@ -29,10 +33,23 @@ namespace trpc {
 // await_resume().
 template <typename T>
 class CoTask {
-  // `waiter` is the completion handshake: nullptr = running & unawaited,
-  // kDoneSentinel = body finished, anything else = the awaiting parent's
-  // handle.  A single CAS on each side closes the suspend-vs-complete
-  // race (the lost-wakeup and the double-resume are both impossible).
+  // Completion state lives on the HEAP, shared by the frame's promise,
+  // the task object, and (via a stack-local copy) the completing fiber:
+  // the final signal may release a join()er whose ~CoTask destroys the
+  // coroutine frame instantly, and CountdownEvent::signal touches its
+  // Event after the count hits zero — so the signaled object must
+  // outlive the frame, which a promise member cannot.
+  struct State {
+    std::optional<T> value;
+    std::exception_ptr error;
+    // The completion handshake: nullptr = running & unawaited, the done
+    // sentinel = body finished, anything else = the awaiting parent's
+    // handle.  A single CAS on each side closes the suspend-vs-complete
+    // race (no lost wakeup, no double resume).
+    std::atomic<void*> waiter{nullptr};
+    CountdownEvent done{1};
+  };
+
   static void* done_sentinel() {
     static char sentinel;
     return &sentinel;
@@ -40,45 +57,44 @@ class CoTask {
 
  public:
   struct promise_type {
-    std::optional<T> value;
-    std::exception_ptr error;
-    std::atomic<void*> waiter{nullptr};
-    CountdownEvent done{1};  // for join(); signaled LAST
+    std::shared_ptr<State> state = std::make_shared<State>();
 
     CoTask get_return_object() {
       return CoTask(
-          std::coroutine_handle<promise_type>::from_promise(*this));
+          std::coroutine_handle<promise_type>::from_promise(*this),
+          state);
     }
     std::suspend_never initial_suspend() noexcept { return {}; }
     struct FinalAwaiter {
       bool await_ready() noexcept { return false; }
       std::coroutine_handle<> await_suspend(
           std::coroutine_handle<promise_type> h) noexcept {
-        promise_type& p = h.promise();
-        // Claim completion; learn whether a parent already attached.
-        void* prev = p.waiter.exchange(done_sentinel(),
-                                       std::memory_order_acq_rel);
+        // Stack-local ref: everything after this line must survive the
+        // frame (a released join()er may destroy it concurrently).
+        std::shared_ptr<State> st = h.promise().state;
+        void* prev = st->waiter.exchange(done_sentinel(),
+                                         std::memory_order_acq_rel);
         std::coroutine_handle<> next =
             prev != nullptr ? std::coroutine_handle<>::from_address(prev)
                             : std::noop_coroutine();
-        // done.signal() is the LAST touch of the promise: it may release
-        // a join()er whose ~CoTask destroys this frame immediately.
-        p.done.signal();
+        st->done.signal();  // touches only the heap State
         return next;
       }
       void await_resume() noexcept {}
     };
     FinalAwaiter final_suspend() noexcept { return {}; }
-    void return_value(T v) { value = std::move(v); }
-    void unhandled_exception() { error = std::current_exception(); }
+    void return_value(T v) { state->value = std::move(v); }
+    void unhandled_exception() { state->error = std::current_exception(); }
   };
 
-  explicit CoTask(std::coroutine_handle<promise_type> h) : h_(h) {}
-  CoTask(CoTask&& o) noexcept : h_(std::exchange(o.h_, nullptr)) {}
+  CoTask(std::coroutine_handle<promise_type> h, std::shared_ptr<State> st)
+      : h_(h), st_(std::move(st)) {}
+  CoTask(CoTask&& o) noexcept
+      : h_(std::exchange(o.h_, nullptr)), st_(std::move(o.st_)) {}
   CoTask(const CoTask&) = delete;
   ~CoTask() {
     if (h_) {
-      h_.promise().done.wait(-1);  // the frame dies with the task object
+      st_->done.wait(-1);  // the frame dies with the task object
       h_.destroy();
     }
   }
@@ -86,18 +102,18 @@ class CoTask {
   // Parks until the coroutine body has finished; returns its value (or
   // rethrows what the body threw).
   T join() {
-    h_.promise().done.wait(-1);
+    st_->done.wait(-1);
     return take();
   }
 
   // Composition: co_await task.
   bool await_ready() {
-    return h_.promise().waiter.load(std::memory_order_acquire) ==
+    return st_->waiter.load(std::memory_order_acquire) ==
            done_sentinel();
   }
   bool await_suspend(std::coroutine_handle<> parent) {
     void* expected = nullptr;
-    if (h_.promise().waiter.compare_exchange_strong(
+    if (st_->waiter.compare_exchange_strong(
             expected, parent.address(), std::memory_order_acq_rel)) {
       return true;  // FinalAwaiter will resume the parent
     }
@@ -107,14 +123,14 @@ class CoTask {
 
  private:
   T take() {
-    promise_type& p = h_.promise();
-    if (p.error) {
-      std::rethrow_exception(p.error);
+    if (st_->error) {
+      std::rethrow_exception(st_->error);
     }
-    return std::move(*p.value);
+    return std::move(*st_->value);
   }
 
   std::coroutine_handle<promise_type> h_;
+  std::shared_ptr<State> st_;
 };
 
 // Awaitable running `fn` on a fresh fiber; the coroutine resumes (on
@@ -128,16 +144,23 @@ auto co_run(Fn fn) {
     std::coroutine_handle<> h;
 
     bool await_ready() { return false; }
-    void await_suspend(std::coroutine_handle<> handle) {
+    bool await_suspend(std::coroutine_handle<> handle) {
       h = handle;
-      fiber_start(
-          nullptr,
-          [](void* arg) {
-            auto* self = static_cast<Awaiter*>(arg);
-            self->result = self->fn();
-            self->h.resume();  // continuation runs on this fiber
-          },
-          this, 0);
+      if (fiber_start(
+              nullptr,
+              [](void* arg) {
+                auto* self = static_cast<Awaiter*>(arg);
+                self->result = self->fn();
+                self->h.resume();  // continuation runs on this fiber
+              },
+              this, 0) != 0) {
+        // Spawn failure (fiber exhaustion): run inline and continue
+        // without suspending — hanging the coroutine forever is the one
+        // unacceptable outcome.
+        result = fn();
+        return false;
+      }
+      return true;
     }
     R await_resume() { return std::move(*result); }
   };

@@ -707,6 +707,8 @@ void wait_settled(HedgeCtx* ctx, int64_t deadline_us) {
 // node; the first success wins and the loser's late response dies on its
 // stale correlation id — the same guarantee that makes brpc's backup
 // requests safe (channel.cpp:582-603).
+std::atomic<int> test_fail_hedge_spawns{0};
+
 void ClusterChannel::call_hedged(std::shared_ptr<Cluster> cluster,
                                  const std::string& method,
                                  const IOBuf& request, IOBuf* response,
@@ -747,7 +749,13 @@ void ClusterChannel::call_hedged(std::shared_ptr<Cluster> cluster,
     ctx->cntls[slot].set_enable_checksum(cntl->checksum_enabled());
     ctx->cntls[slot].request_attachment() = ctx->attachment;
     auto* arg = new HedgeFiberArg{ctx, slot};
-    if (fiber_start(nullptr, hedge_attempt_fiber, arg, 0) != 0) {
+    bool inject = false;
+    int cur = test_fail_hedge_spawns.load(std::memory_order_relaxed);
+    while (cur > 0 &&
+           !test_fail_hedge_spawns.compare_exchange_weak(cur, cur - 1)) {
+    }
+    inject = cur > 0;
+    if (inject || fiber_start(nullptr, hedge_attempt_fiber, arg, 0) != 0) {
       // A failed spawn must still settle the slot, or wait_settled(-1)
       // blocks forever (mirrors run_fanout's spawn-failure path).
       delete arg;

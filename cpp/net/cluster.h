@@ -78,6 +78,12 @@ class NamingService {
 
 // Channel over a resolved cluster (parity: Channel::Init(ns_url, lb, opts)
 // composed via details/load_balancer_with_naming).
+// TEST INJECTION (regression coverage): fail the next N hedge-attempt
+// fiber spawns, exercising the spawn-failure settle path — a failed
+// spawn must synthetically settle its slot or wait_settled(-1) hangs
+// forever.  Production value is 0.
+extern std::atomic<int> test_fail_hedge_spawns;
+
 class ClusterChannel {
  public:
   struct Options {

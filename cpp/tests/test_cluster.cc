@@ -424,4 +424,79 @@ TEST_CASE(locality_aware_shifts_and_recovers) {
   EXPECT(hits[1].load() > 30);  // back above 15%
 }
 
+TEST_CASE(hedge_spawn_failure_backup_still_wins) {
+  // Regression: a failed hedge-attempt spawn must settle its slot with a
+  // synthetic error (not hang wait_settled) and must not shadow the
+  // OTHER attempt's real outcome.  Inject one spawn failure: the primary
+  // slot settles synthetically, the backup runs and wins.
+  ClusterChannel::Options opts;
+  opts.backup_request_ms = 10;
+  opts.timeout_ms = 2000;
+  ClusterChannel ch;
+  EXPECT_EQ(ch.Init(list_url(), "rr", &opts), 0);
+
+  test_fail_hedge_spawns.store(1);
+  const std::string r = call_once(ch);
+  test_fail_hedge_spawns.store(0);
+  EXPECT(r.rfind("node-", 0) == 0);  // the surviving attempt answered
+}
+
+TEST_CASE(hedge_spawn_failure_both_attempts) {
+  // Both spawns failing must return promptly with the synthetic error —
+  // the settle accounting (launched vs failures) must terminate the wait.
+  ClusterChannel::Options opts;
+  opts.backup_request_ms = 10;
+  opts.timeout_ms = 2000;
+  ClusterChannel ch;
+  EXPECT_EQ(ch.Init(list_url(), "rr", &opts), 0);
+
+  test_fail_hedge_spawns.store(2);
+  const int64_t t0 = monotonic_time_us();
+  const std::string r = call_once(ch);
+  test_fail_hedge_spawns.store(0);
+  EXPECT(r.rfind("FAILED:", 0) == 0);
+  // Promptly = well under the 2s call timeout (the settle path, not a
+  // timer, ended the call).
+  EXPECT(monotonic_time_us() - t0 < 1500000);
+}
+
+TEST_CASE(destructor_races_inflight_probes) {
+  // Regression for the destructor-vs-probe interaction: tear the channel
+  // down while health-check probes against a blackholed node are still
+  // in flight.  Probe fibers own their state via shared_ptrs; destruction
+  // must neither hang nor touch freed memory (the ASan CI build enforces
+  // the latter).
+  int dead_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in sin = {};
+  sin.sin_family = AF_INET;
+  sin.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  EXPECT_EQ(::bind(dead_fd, reinterpret_cast<sockaddr*>(&sin),
+                   sizeof(sin)),
+            0);
+  socklen_t slen = sizeof(sin);
+  ::getsockname(dead_fd, reinterpret_cast<sockaddr*>(&sin), &slen);
+  const int dead_port = ntohs(sin.sin_port);
+  ::close(dead_fd);  // connections now refuse fast
+
+  for (int round = 0; round < 10; ++round) {
+    ClusterChannel::Options opts;
+    opts.timeout_ms = 200;
+    opts.max_retry = 2;
+    opts.refresh_interval_ms = 10;  // probe cycle fires quickly
+    opts.health_check_method = "Echo.WhoAmI";
+    opts.quarantine_base_ms = 50;
+    ClusterChannel ch;
+    const std::string url =
+        list_url() + ",127.0.0.1:" + std::to_string(dead_port);
+    EXPECT_EQ(ch.Init(url, "rr", &opts), 0);
+    // Trip the breaker on the dead node (calls still succeed via retry).
+    for (int i = 0; i < 12; ++i) {
+      (void)call_once(ch);
+    }
+    // Let a refresher tick launch probes, then destroy mid-flight.
+    usleep(15000 + (round % 3) * 10000);
+    // ~ClusterChannel runs here.
+  }
+}
+
 TEST_MAIN
