@@ -44,9 +44,14 @@ ParseError http_parse(IOBuf* source, InputMessage* out, Socket* sock) {
   }
   std::shared_ptr<void>* state = nullptr;
   if (sock != nullptr) {
-    if (sock->parse_state_owner != &kHttpStateTag) {
-      sock->parse_state.reset();  // not ours (or absent): start fresh
-      sock->parse_state_owner = nullptr;
+    if (sock->parse_state_owner != &kHttpStateTag &&
+        sock->parse_state != nullptr) {
+      // Another protocol keeps in-flight state on this connection (e.g.
+      // the rtmp handshake machine, which spans several probe rounds
+      // before its first complete message pins the socket).  Destroying
+      // it from a PROBE would corrupt that protocol mid-parse — and a
+      // connection someone else has state on is not HTTP anyway.
+      return ParseError::kTryOtherProtocol;
     }
     state = &sock->parse_state;
   }

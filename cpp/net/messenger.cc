@@ -98,7 +98,12 @@ void cut_and_dispatch(Socket* s, SocketId id) {
         return;
       default:
         LOG(Warning) << "corrupted input on " << endpoint2str(s->remote())
-                     << ", closing";
+                     << " (pinned=" << s->pinned_protocol << " proto="
+                     << (s->pinned_protocol >= 0 &&
+                                 protocol_at(s->pinned_protocol) != nullptr
+                             ? protocol_at(s->pinned_protocol)->name
+                             : "?")
+                     << "), closing";
         delete msg;
         s->SetFailed(EBADMSG);
         return;

@@ -1,0 +1,1218 @@
+#include "net/rtmp.h"
+
+#include <errno.h>
+
+#include <cstring>
+#include <deque>
+#include <mutex>
+
+#include "base/logging.h"
+#include "base/rand.h"
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "net/messenger.h"
+#include "net/protocol.h"
+#include "net/server.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr size_t kHandshakeSize = 1536;
+constexpr uint32_t kDefaultChunkSize = 128;
+constexpr uint32_t kOurChunkSize = 4096;
+constexpr size_t kMaxMessage = 16u << 20;
+constexpr uint32_t kCsidCommand = 3;
+constexpr uint32_t kCsidMedia = 4;
+constexpr int kMaxAmfDepth = 16;
+
+void put_u8(std::string* out, uint8_t v) {
+  out->push_back(static_cast<char>(v));
+}
+
+void put_u16be(std::string* out, uint16_t v) {
+  out->push_back(static_cast<char>(v >> 8));
+  out->push_back(static_cast<char>(v));
+}
+
+void put_u24be(std::string* out, uint32_t v) {
+  out->push_back(static_cast<char>(v >> 16));
+  out->push_back(static_cast<char>(v >> 8));
+  out->push_back(static_cast<char>(v));
+}
+
+void put_u32be(std::string* out, uint32_t v) {
+  out->push_back(static_cast<char>(v >> 24));
+  put_u24be(out, v & 0xffffff);
+}
+
+void put_u32le(std::string* out, uint32_t v) {
+  out->append(reinterpret_cast<const char*>(&v), 4);
+}
+
+uint32_t read_u24be(const uint8_t* p) {
+  return (static_cast<uint32_t>(p[0]) << 16) |
+         (static_cast<uint32_t>(p[1]) << 8) | p[2];
+}
+
+uint32_t read_u32be(const uint8_t* p) {
+  return (static_cast<uint32_t>(p[0]) << 24) | read_u24be(p + 1);
+}
+
+uint32_t read_u32le(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+}  // namespace
+
+// ---- AMF0 ----------------------------------------------------------------
+
+Amf0Value Amf0Value::Number(double v) {
+  Amf0Value a;
+  a.type = kNumber;
+  a.num = v;
+  return a;
+}
+Amf0Value Amf0Value::Boolean(bool v) {
+  Amf0Value a;
+  a.type = kBool;
+  a.b = v;
+  return a;
+}
+Amf0Value Amf0Value::Str(std::string v) {
+  Amf0Value a;
+  a.type = kString;
+  a.str = std::move(v);
+  return a;
+}
+Amf0Value Amf0Value::Object(
+    std::vector<std::pair<std::string, Amf0Value>> p) {
+  Amf0Value a;
+  a.type = kObject;
+  a.props = std::move(p);
+  return a;
+}
+Amf0Value Amf0Value::Null() { return Amf0Value(); }
+
+const Amf0Value* Amf0Value::prop(const std::string& key) const {
+  for (const auto& [k, v] : props) {
+    if (k == key) return &v;
+  }
+  return nullptr;
+}
+
+bool Amf0Value::operator==(const Amf0Value& o) const {
+  if (type != o.type) return false;
+  switch (type) {
+    case kNumber:
+      return num == o.num;
+    case kBool:
+      return b == o.b;
+    case kString:
+      return str == o.str;
+    case kObject:
+    case kEcmaArray:
+      return props == o.props;
+    case kNull:
+      return true;
+  }
+  return false;
+}
+
+void amf0_write(const Amf0Value& v, std::string* out) {
+  put_u8(out, v.type);
+  switch (v.type) {
+    case Amf0Value::kNumber: {
+      uint64_t bits;
+      std::memcpy(&bits, &v.num, 8);
+      for (int i = 7; i >= 0; --i) {
+        put_u8(out, static_cast<uint8_t>(bits >> (8 * i)));
+      }
+      break;
+    }
+    case Amf0Value::kBool:
+      put_u8(out, v.b ? 1 : 0);
+      break;
+    case Amf0Value::kString:
+      put_u16be(out, static_cast<uint16_t>(v.str.size()));
+      out->append(v.str);
+      break;
+    case Amf0Value::kEcmaArray:
+      put_u32be(out, static_cast<uint32_t>(v.props.size()));
+      [[fallthrough]];
+    case Amf0Value::kObject:
+      for (const auto& [k, pv] : v.props) {
+        put_u16be(out, static_cast<uint16_t>(k.size()));
+        out->append(k);
+        amf0_write(pv, out);
+      }
+      put_u16be(out, 0);
+      put_u8(out, 0x09);  // object end
+      break;
+    case Amf0Value::kNull:
+      break;
+  }
+}
+
+int amf0_read(const std::string& in, size_t* pos, Amf0Value* out,
+              int depth) {
+  if (depth > kMaxAmfDepth) return -1;
+  if (*pos >= in.size()) return 0;
+  const uint8_t type = static_cast<uint8_t>(in[*pos]);
+  size_t p = *pos + 1;
+  switch (type) {
+    case Amf0Value::kNumber: {
+      if (in.size() - p < 8) return 0;
+      uint64_t bits = 0;
+      for (int i = 0; i < 8; ++i) {
+        bits = (bits << 8) | static_cast<uint8_t>(in[p + i]);
+      }
+      out->type = Amf0Value::kNumber;
+      std::memcpy(&out->num, &bits, 8);
+      *pos = p + 8;
+      return 1;
+    }
+    case Amf0Value::kBool: {
+      if (p >= in.size()) return 0;
+      out->type = Amf0Value::kBool;
+      out->b = in[p] != 0;
+      *pos = p + 1;
+      return 1;
+    }
+    case Amf0Value::kString: {
+      if (in.size() - p < 2) return 0;
+      const uint16_t len = static_cast<uint16_t>(
+          (static_cast<uint8_t>(in[p]) << 8) |
+          static_cast<uint8_t>(in[p + 1]));
+      if (in.size() - p - 2 < len) return 0;
+      out->type = Amf0Value::kString;
+      out->str.assign(in, p + 2, len);
+      *pos = p + 2 + len;
+      return 1;
+    }
+    case Amf0Value::kEcmaArray:
+      if (in.size() - p < 4) return 0;
+      p += 4;  // declared count is advisory; terminator is authoritative
+      [[fallthrough]];
+    case Amf0Value::kObject: {
+      out->type = static_cast<Amf0Value::Type>(type);
+      out->props.clear();
+      while (true) {
+        if (in.size() - p < 2) return 0;
+        const uint16_t klen = static_cast<uint16_t>(
+            (static_cast<uint8_t>(in[p]) << 8) |
+            static_cast<uint8_t>(in[p + 1]));
+        if (in.size() - p - 2 < klen) return 0;
+        if (klen == 0) {
+          if (in.size() - p - 2 < 1) return 0;
+          if (static_cast<uint8_t>(in[p + 2]) != 0x09) return -1;
+          *pos = p + 3;
+          return 1;
+        }
+        std::string key(in, p + 2, klen);
+        p += 2 + klen;
+        Amf0Value pv;
+        size_t vp = p;
+        const int rc = amf0_read(in, &vp, &pv, depth + 1);
+        if (rc != 1) return rc;
+        p = vp;
+        out->props.emplace_back(std::move(key), std::move(pv));
+        if (out->props.size() > 256) return -1;
+      }
+    }
+    case Amf0Value::kNull:
+    case 0x06:  // undefined decodes as null
+      out->type = Amf0Value::kNull;
+      *pos = p;
+      return 1;
+    default:
+      return -1;  // types outside the condensed set
+  }
+}
+
+// ---- connection state ----------------------------------------------------
+
+namespace {
+
+struct RtmpWaiter {
+  CountdownEvent ev{1};
+  bool ok = false;
+  std::vector<Amf0Value> args;  // _result payload after the command name
+};
+
+struct RtmpConn {
+  // Handshake progress.  Server: wait C0+C1, reply S0S1S2, wait C2.
+  // Client: sent C0+C1, wait S0+S1+S2, reply C2.
+  enum Phase { kAwaitC0C1, kAwaitC2, kAwaitS0S1S2, kChunks };
+  Phase phase = kAwaitC0C1;
+  bool is_client = false;
+  Event handshook;  // value 1 once phase == kChunks (client connect waits)
+
+  uint32_t in_chunk_size = kDefaultChunkSize;
+  uint32_t out_chunk_size = kDefaultChunkSize;
+
+  // Per-chunk-stream incoming assembly state.
+  struct CsState {
+    uint8_t type = 0;
+    uint32_t ts = 0;
+    uint32_t ts_delta = 0;
+    uint32_t len = 0;
+    uint32_t msid = 0;
+    bool ext_ts = false;
+    std::string partial;
+  };
+  std::map<uint32_t, CsState> cs_in;
+
+  // Server-side roles.
+  std::string publishing;  // non-empty: this connection publishes it
+  std::vector<std::string> playing;
+
+  // Client-side.
+  std::mutex wmu;
+  std::map<double, std::shared_ptr<RtmpWaiter>> by_txn;
+  std::deque<std::shared_ptr<RtmpWaiter>> status_waiters;  // onStatus FIFO
+  RtmpClient::MediaHandler on_media;
+};
+
+const char kRtmpSrvTag = 0;
+const char kRtmpCliTag = 0;
+
+RtmpConn* rtmp_conn_of(Socket* s, bool client) {
+  return proto_conn_of<RtmpConn>(s, client ? &kRtmpCliTag : &kRtmpSrvTag);
+}
+
+// ---- chunk writer --------------------------------------------------------
+
+// fmt0 message header for `m` (basic header + headers, no payload).
+std::string pack_header(uint32_t csid, const RtmpMessage& m) {
+  std::string out;
+  const uint32_t ts = m.timestamp;
+  const bool ext = ts >= 0xffffff;
+  put_u8(&out, static_cast<uint8_t>(csid & 0x3f));
+  put_u24be(&out, ext ? 0xffffff : ts);
+  put_u24be(&out, static_cast<uint32_t>(m.payload.size()));
+  put_u8(&out, m.type);
+  put_u32le(&out, m.stream_id);
+  if (ext) {
+    put_u32be(&out, ts);
+  }
+  return out;
+}
+
+// Payload split into chunks with fmt3 continuation headers; everything
+// AFTER the fmt0 header (shareable across fan-out targets whose only
+// difference is the header's stream id).
+void pack_tail(uint32_t csid, uint32_t chunk_size, const RtmpMessage& m,
+               std::string* out) {
+  const bool ext = m.timestamp >= 0xffffff;
+  size_t off = 0;
+  while (off < m.payload.size() || m.payload.empty()) {
+    const size_t take =
+        std::min<size_t>(chunk_size, m.payload.size() - off);
+    out->append(m.payload, off, take);
+    off += take;
+    if (off >= m.payload.size()) {
+      break;
+    }
+    put_u8(out, static_cast<uint8_t>(0xc0 | (csid & 0x3f)));  // fmt3
+    if (ext) {
+      put_u32be(out, m.timestamp);  // fmt3 repeats the extended ts
+    }
+  }
+}
+
+// Serializes one message as fmt0 + fmt3 continuation chunks.
+void pack_message(const RtmpConn* conn, uint32_t csid,
+                  const RtmpMessage& m, std::string* out) {
+  out->append(pack_header(csid, m));
+  pack_tail(csid, conn->out_chunk_size, m, out);
+}
+
+void write_message(Socket* sock, RtmpConn* conn, uint32_t csid,
+                   const RtmpMessage& m) {
+  std::string wire;
+  pack_message(conn, csid, m, &wire);
+  IOBuf out;
+  out.append(wire);
+  sock->Write(std::move(out));
+}
+
+void write_command(Socket* sock, RtmpConn* conn, uint32_t msid,
+                   const std::vector<Amf0Value>& fields) {
+  RtmpMessage m;
+  m.type = static_cast<uint8_t>(RtmpMsgType::kCommandAmf0);
+  m.stream_id = msid;
+  for (const Amf0Value& f : fields) {
+    amf0_write(f, &m.payload);
+  }
+  write_message(sock, conn, kCsidCommand, m);
+}
+
+void write_set_chunk_size(Socket* sock, RtmpConn* conn, uint32_t size) {
+  RtmpMessage m;
+  m.type = static_cast<uint8_t>(RtmpMsgType::kSetChunkSize);
+  put_u32be(&m.payload, size);
+  write_message(sock, conn, 2, m);
+  conn->out_chunk_size = size;  // applies to subsequent messages
+}
+
+// ---- chunk reader --------------------------------------------------------
+
+// Consumes ONE chunk if fully available.  1 = consumed (maybe completing
+// *done_msg), 0 = need more bytes, -1 = corrupt.
+int read_one_chunk(IOBuf* source, RtmpConn* conn, RtmpMessage* done_msg,
+                   bool* completed) {
+  *completed = false;
+  uint8_t hdr[3 + 11 + 4];
+  const size_t avail = source->copy_to(hdr, sizeof(hdr), 0);
+  if (avail < 1) {
+    return 0;
+  }
+  const uint8_t fmt = hdr[0] >> 6;
+  uint32_t csid = hdr[0] & 0x3f;
+  size_t pos = 1;
+  if (csid == 0) {
+    if (avail < 2) return 0;
+    csid = 64 + hdr[1];
+    pos = 2;
+  } else if (csid == 1) {
+    if (avail < 3) return 0;
+    csid = 64 + hdr[1] + (static_cast<uint32_t>(hdr[2]) << 8);
+    pos = 3;
+  }
+  RtmpConn::CsState& cs = conn->cs_in[csid];
+  if (conn->cs_in.size() > 64) {
+    return -1;  // bound per-connection chunk streams
+  }
+  const size_t mh_len = fmt == 0 ? 11 : fmt == 1 ? 7 : fmt == 2 ? 3 : 0;
+  if (avail < pos + mh_len) {
+    return 0;
+  }
+  const uint8_t* mh = hdr + pos;
+  uint32_t ts_field = 0;
+  switch (fmt) {
+    case 0:
+      ts_field = read_u24be(mh);
+      cs.len = read_u24be(mh + 3);
+      cs.type = mh[6];
+      cs.msid = read_u32le(mh + 7);
+      cs.ts_delta = 0;
+      break;
+    case 1:
+      ts_field = read_u24be(mh);
+      cs.len = read_u24be(mh + 3);
+      cs.type = mh[6];
+      cs.ts_delta = ts_field;
+      break;
+    case 2:
+      ts_field = read_u24be(mh);
+      cs.ts_delta = ts_field;
+      break;
+    case 3:
+      break;
+  }
+  pos += mh_len;
+  const bool ext = (fmt < 3 && ts_field == 0xffffff) ||
+                   (fmt == 3 && cs.ext_ts);
+  uint32_t ts_full = ts_field;
+  if (ext) {
+    if (avail < pos + 4) return 0;
+    ts_full = read_u32be(hdr + pos);
+    pos += 4;
+  }
+  cs.ext_ts = fmt < 3 ? ts_field == 0xffffff : cs.ext_ts;
+  if (cs.len > kMaxMessage) {
+    return -1;
+  }
+  const size_t remaining = cs.len - cs.partial.size();
+  const size_t take = std::min<size_t>(conn->in_chunk_size, remaining);
+  if (source->size() < pos + take) {
+    return 0;
+  }
+  // Commit: timestamps only advance when a message STARTS.
+  if (cs.partial.empty()) {
+    if (fmt == 0) {
+      cs.ts = ts_full;
+    } else if (fmt == 3 && ext) {
+      // A fmt3 chunk opening a NEW message repeats the extended field as
+      // an ABSOLUTE timestamp (FFmpeg/OBS practice) — adding it as a
+      // delta would double every post-0xffffff timestamp.
+      cs.ts = ts_full;
+    } else {
+      cs.ts += ext ? ts_full : cs.ts_delta;
+    }
+  }
+  source->pop_front(pos);
+  IOBuf body;
+  source->cutn(&body, take);
+  const size_t old = cs.partial.size();
+  cs.partial.resize(old + take);
+  body.copy_to(cs.partial.data() + old, take, 0);
+  if (cs.partial.size() >= cs.len) {
+    done_msg->type = cs.type;
+    done_msg->timestamp = cs.ts;
+    done_msg->stream_id = cs.msid;
+    done_msg->payload = std::move(cs.partial);
+    cs.partial.clear();
+    *completed = true;
+  }
+  return 1;
+}
+
+// Handles protocol-control messages INSIDE the parser (SetChunkSize must
+// apply before the next chunk is cut).  True = consumed internally.
+bool handle_control(RtmpConn* conn, const RtmpMessage& m) {
+  switch (static_cast<RtmpMsgType>(m.type)) {
+    case RtmpMsgType::kSetChunkSize:
+      if (m.payload.size() >= 4) {
+        const uint32_t sz = read_u32be(
+            reinterpret_cast<const uint8_t*>(m.payload.data()));
+        if (sz >= 1 && sz <= kMaxMessage) {
+          conn->in_chunk_size = sz;
+        }
+      }
+      return true;
+    case RtmpMsgType::kAck:
+    case RtmpMsgType::kWindowAckSize:
+    case RtmpMsgType::kSetPeerBandwidth:
+    case RtmpMsgType::kUserControl:
+      return true;  // windows are advisory in the condensed scope
+    default:
+      return false;
+  }
+}
+
+// Shared chunk-phase parse: cut chunks until one full app-level message.
+ParseError parse_chunks(IOBuf* source, InputMessage* out, Socket* sock,
+                        RtmpConn* conn) {
+  while (true) {
+    RtmpMessage msg;
+    bool completed = false;
+    const int rc = read_one_chunk(source, conn, &msg, &completed);
+    if (rc < 0) {
+      uint8_t dbg[16] = {};
+      const size_t n = source->copy_to(dbg, sizeof(dbg), 0);
+      char hex[64];
+      for (size_t i = 0; i < n; ++i) {
+        snprintf(hex + i * 3, 4, "%02x ", dbg[i]);
+      }
+      LOG(Warning) << "rtmp corrupt chunk, head: " << hex;
+      return ParseError::kCorrupted;
+    }
+    if (rc == 0) {
+      return ParseError::kNotEnoughData;
+    }
+    if (!completed) {
+      continue;
+    }
+    if (handle_control(conn, msg)) {
+      continue;
+    }
+    out->ctx = std::make_shared<RtmpMessage>(std::move(msg));
+    out->socket = sock->id();
+    return ParseError::kOk;
+  }
+}
+
+// ---- server protocol -----------------------------------------------------
+
+ParseError rtmp_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  const bool probing = sock->pinned_protocol < 0;
+  if (probing) {
+    Server* srv = static_cast<Server*>(sock->user_data);
+    if (srv == nullptr || srv->rtmp_service() == nullptr) {
+      return ParseError::kTryOtherProtocol;
+    }
+    // The 0x03 first-byte gate only applies to FRESH connections: once
+    // the handshake machine is installed, later probe rounds see C2 /
+    // chunk bytes (arbitrary leading byte) and must re-enter the
+    // machine, not disclaim the connection.
+    const bool ours = sock->parse_state != nullptr &&
+                      sock->parse_state_owner == &kRtmpSrvTag;
+    if (!ours && source->front() != 0x03) {
+      return ParseError::kTryOtherProtocol;
+    }
+  }
+  RtmpConn* conn = rtmp_conn_of(sock, /*client=*/false);
+  if (conn->phase == RtmpConn::kAwaitC0C1) {
+    // First byte 0x03 on an rtmp-enabled server is a strong claim
+    // (checked above while probing): HOLD for the rest of C0+C1 —
+    // kTryOtherProtocol on a fragmented handshake would fall through
+    // every protocol and kill the connection.
+    if (source->size() < 1 + kHandshakeSize) {
+      return ParseError::kNotEnoughData;
+    }
+    uint8_t c0;
+    source->copy_to(&c0, 1, 0);
+    if (c0 != 0x03) {
+      return probing ? ParseError::kTryOtherProtocol
+                     : ParseError::kCorrupted;
+    }
+    source->pop_front(1);
+    IOBuf c1;
+    source->cutn(&c1, kHandshakeSize);
+    // S0 + S1 (our time + random) + S2 (echo of C1).
+    std::string s01;
+    s01.push_back(0x03);
+    put_u32be(&s01, 0);
+    put_u32be(&s01, 0);
+    for (size_t i = 0; i < kHandshakeSize - 8; ++i) {
+      s01.push_back(static_cast<char>(fast_rand()));
+    }
+    IOBuf reply;
+    reply.append(s01);
+    reply.append(c1);  // S2
+    sock->Write(std::move(reply));
+    conn->phase = RtmpConn::kAwaitC2;
+  }
+  if (conn->phase == RtmpConn::kAwaitC2) {
+    if (source->size() < kHandshakeSize) {
+      return ParseError::kNotEnoughData;
+    }
+    source->pop_front(kHandshakeSize);
+    conn->phase = RtmpConn::kChunks;
+  }
+  return parse_chunks(source, out, sock, conn);
+}
+
+double amf_number_or(const std::vector<Amf0Value>& v, size_t i,
+                     double def) {
+  return i < v.size() && v[i].type == Amf0Value::kNumber ? v[i].num : def;
+}
+
+std::string amf_string_or(const std::vector<Amf0Value>& v, size_t i,
+                          const std::string& def) {
+  return i < v.size() && v[i].type == Amf0Value::kString ? v[i].str : def;
+}
+
+std::vector<Amf0Value> decode_amf_list(const std::string& payload) {
+  std::vector<Amf0Value> out;
+  size_t pos = 0;
+  while (pos < payload.size() && out.size() < 16) {
+    Amf0Value v;
+    if (amf0_read(payload, &pos, &v) != 1) {
+      break;
+    }
+    out.push_back(std::move(v));
+  }
+  return out;
+}
+
+Amf0Value status_info(const std::string& code, const std::string& desc) {
+  return Amf0Value::Object({{"level", Amf0Value::Str("status")},
+                            {"code", Amf0Value::Str(code)},
+                            {"description", Amf0Value::Str(desc)}});
+}
+
+void send_on_status(Socket* sock, RtmpConn* conn, uint32_t msid,
+                    const std::string& code) {
+  write_command(sock, conn, msid,
+                {Amf0Value::Str("onStatus"), Amf0Value::Number(0),
+                 Amf0Value::Null(), status_info(code, code)});
+}
+
+void rtmp_process_request(InputMessage&& imsg) {
+  SocketRef sock(Socket::Address(imsg.socket));
+  if (!sock) {
+    return;
+  }
+  Server* srv = static_cast<Server*>(sock->user_data);
+  auto msg = std::static_pointer_cast<RtmpMessage>(imsg.ctx);
+  if (srv == nullptr || srv->rtmp_service() == nullptr || msg == nullptr) {
+    return;
+  }
+  RtmpService* svc = srv->rtmp_service();
+  RtmpConn* conn = rtmp_conn_of(sock.get(), /*client=*/false);
+
+  const RtmpMsgType t = static_cast<RtmpMsgType>(msg->type);
+  if (t == RtmpMsgType::kAudio || t == RtmpMsgType::kVideo ||
+      t == RtmpMsgType::kDataAmf0) {
+    // Publisher media: relay to every player of the stream.
+    if (conn->publishing.empty()) {
+      return;  // media from a non-publisher: drop
+    }
+    srv->requests_served.fetch_add(1, std::memory_order_relaxed);
+    if (svc->observer()) {
+      svc->observer()(conn->publishing, *msg);
+    }
+    std::vector<std::pair<SocketId, uint32_t>> players;
+    {
+      LockGuard<FiberMutex> g(svc->mu);
+      auto it = svc->hubs.find(conn->publishing);
+      if (it != svc->hubs.end()) {
+        players = it->second.players;
+      }
+    }
+    // Fan-out: the chunked payload tail is identical for every player
+    // (only the fmt0 header's stream id differs), so it is packed ONCE
+    // and its blocks are SHARED into each player's write — one payload
+    // copy total, not one per player.  Players negotiated to a different
+    // chunk size (none today; SetChunkSize goes out on connect) fall
+    // back to a private pack.
+    IOBuf shared_tail;
+    {
+      std::string tail;
+      pack_tail(kCsidMedia, kOurChunkSize, *msg, &tail);
+      shared_tail.append(tail);
+    }
+    std::vector<SocketId> dead;
+    for (const auto& [sid, msid] : players) {
+      SocketRef ps(Socket::Address(sid));
+      if (!ps || ps->Failed()) {
+        dead.push_back(sid);
+        continue;
+      }
+      RtmpConn* pconn = rtmp_conn_of(ps.get(), /*client=*/false);
+      RtmpMessage relay;
+      relay.type = msg->type;
+      relay.timestamp = msg->timestamp;
+      relay.stream_id = msid;
+      IOBuf out;
+      if (pconn->out_chunk_size == kOurChunkSize) {
+        out.append(pack_header(kCsidMedia, *msg).substr(0, 8) +
+                   [msid] {
+                     std::string le;
+                     put_u32le(&le, msid);
+                     return le;
+                   }());
+        out.append(shared_tail);  // zero-copy block share
+      } else {
+        relay.payload = msg->payload;
+        std::string wire;
+        pack_message(pconn, kCsidMedia, relay, &wire);
+        out.append(wire);
+      }
+      ps->Write(std::move(out));
+    }
+    if (!dead.empty()) {
+      // Reap players whose sockets died without deleteStream; drop the
+      // hub entirely once nothing references it (unbounded growth from
+      // viewer churn otherwise).
+      LockGuard<FiberMutex> g(svc->mu);
+      auto it = svc->hubs.find(conn->publishing);
+      if (it != svc->hubs.end()) {
+        auto& pl = it->second.players;
+        for (SocketId d : dead) {
+          for (auto pit = pl.begin(); pit != pl.end();) {
+            if (pit->first == d) {
+              pit = pl.erase(pit);
+            } else {
+              ++pit;
+            }
+          }
+        }
+      }
+    }
+    return;
+  }
+  if (t != RtmpMsgType::kCommandAmf0) {
+    return;
+  }
+
+  std::vector<Amf0Value> cmd = decode_amf_list(msg->payload);
+  const std::string name = amf_string_or(cmd, 0, "");
+  const double txn = amf_number_or(cmd, 1, 0);
+  srv->requests_served.fetch_add(1, std::memory_order_relaxed);
+
+  {  // Interceptor gate for the command surface.
+    int ec = 0;
+    std::string et;
+    if (!srv->accept_request("rtmp." + name, sock->remote(), &ec, &et)) {
+      sock->SetFailed(EACCES);
+      return;
+    }
+  }
+
+  if (name == "connect") {
+    // Control burst, then the connect _result.
+    RtmpMessage was;
+    was.type = static_cast<uint8_t>(RtmpMsgType::kWindowAckSize);
+    put_u32be(&was.payload, 2500000);
+    write_message(sock.get(), conn, 2, was);
+    RtmpMessage spb;
+    spb.type = static_cast<uint8_t>(RtmpMsgType::kSetPeerBandwidth);
+    put_u32be(&spb.payload, 2500000);
+    put_u8(&spb.payload, 2);
+    write_message(sock.get(), conn, 2, spb);
+    write_set_chunk_size(sock.get(), conn, kOurChunkSize);
+    write_command(
+        sock.get(), conn, 0,
+        {Amf0Value::Str("_result"), Amf0Value::Number(txn),
+         Amf0Value::Object({{"fmsVer", Amf0Value::Str("TRPC/1,0")},
+                            {"capabilities", Amf0Value::Number(31)}}),
+         Amf0Value::Object(
+             {{"level", Amf0Value::Str("status")},
+              {"code",
+               Amf0Value::Str("NetConnection.Connect.Success")},
+              {"description", Amf0Value::Str("Connection succeeded.")}})});
+    return;
+  }
+  if (name == "createStream") {
+    static std::atomic<uint32_t> next_msid{1};
+    write_command(sock.get(), conn, 0,
+                  {Amf0Value::Str("_result"), Amf0Value::Number(txn),
+                   Amf0Value::Null(),
+                   Amf0Value::Number(next_msid.fetch_add(1))});
+    return;
+  }
+  if (name == "releaseStream" || name == "FCPublish" ||
+      name == "FCUnpublish" || name == "getStreamLength") {
+    write_command(sock.get(), conn, 0,
+                  {Amf0Value::Str("_result"), Amf0Value::Number(txn),
+                   Amf0Value::Null(), Amf0Value::Null()});
+    return;
+  }
+  if (name == "publish") {
+    const std::string stream = amf_string_or(cmd, 3, "");
+    if (stream.empty()) {
+      send_on_status(sock.get(), conn, msg->stream_id,
+                     "NetStream.Publish.BadName");
+      return;
+    }
+    bool taken = false;
+    {
+      LockGuard<FiberMutex> g(svc->mu);
+      RtmpService::Hub& hub = svc->hubs[stream];
+      if (hub.publisher != 0 && hub.publisher != sock->id()) {
+        SocketRef other(Socket::Address(hub.publisher));
+        taken = other && !other->Failed();
+      }
+      if (!taken) {
+        hub.publisher = sock->id();
+      }
+    }
+    if (taken) {
+      send_on_status(sock.get(), conn, msg->stream_id,
+                     "NetStream.Publish.BadName");
+      return;
+    }
+    conn->publishing = stream;
+    send_on_status(sock.get(), conn, msg->stream_id,
+                   "NetStream.Publish.Start");
+    return;
+  }
+  if (name == "play") {
+    const std::string stream = amf_string_or(cmd, 3, "");
+    if (stream.empty()) {
+      send_on_status(sock.get(), conn, msg->stream_id,
+                     "NetStream.Play.StreamNotFound");
+      return;
+    }
+    {
+      LockGuard<FiberMutex> g(svc->mu);
+      svc->hubs[stream].players.emplace_back(sock->id(), msg->stream_id);
+    }
+    conn->playing.push_back(stream);
+    // UserControl StreamBegin(msid).
+    RtmpMessage sb;
+    sb.type = static_cast<uint8_t>(RtmpMsgType::kUserControl);
+    put_u16be(&sb.payload, 0);
+    put_u32be(&sb.payload, msg->stream_id);
+    write_message(sock.get(), conn, 2, sb);
+    send_on_status(sock.get(), conn, msg->stream_id,
+                   "NetStream.Play.Start");
+    return;
+  }
+  if (name == "deleteStream" || name == "closeStream") {
+    const uint32_t msid = static_cast<uint32_t>(amf_number_or(cmd, 3, 0));
+    LockGuard<FiberMutex> g(svc->mu);
+    if (!conn->publishing.empty()) {
+      auto it = svc->hubs.find(conn->publishing);
+      if (it != svc->hubs.end() && it->second.publisher == sock->id()) {
+        it->second.publisher = 0;
+      }
+      conn->publishing.clear();
+    }
+    for (const std::string& stream : conn->playing) {
+      auto it = svc->hubs.find(stream);
+      if (it == svc->hubs.end()) {
+        continue;
+      }
+      auto& pl = it->second.players;
+      for (auto pit = pl.begin(); pit != pl.end();) {
+        if (pit->first == sock->id() &&
+            (msid == 0 || pit->second == msid)) {
+          pit = pl.erase(pit);
+        } else {
+          ++pit;
+        }
+      }
+    }
+    return;
+  }
+  // Unknown command: _error keeps well-behaved clients moving.
+  write_command(sock.get(), conn, 0,
+                {Amf0Value::Str("_error"), Amf0Value::Number(txn),
+                 Amf0Value::Null(),
+                 status_info("NetConnection.Call.Failed", name)});
+}
+
+void rtmp_process_response(InputMessage&&) {}
+
+}  // namespace
+
+size_t RtmpService::publisher_count() const {
+  LockGuard<FiberMutex> g(mu);
+  size_t n = 0;
+  for (const auto& [name, hub] : hubs) {
+    if (hub.publisher != 0) {
+      ++n;
+    }
+  }
+  return n;
+}
+
+size_t RtmpService::player_count(const std::string& name) const {
+  LockGuard<FiberMutex> g(mu);
+  auto it = hubs.find(name);
+  return it == hubs.end() ? 0 : it->second.players.size();
+}
+
+void register_rtmp_protocol() {
+  static int once = [] {
+    Protocol p = {"rtmp", rtmp_parse, rtmp_process_request,
+                  rtmp_process_response,
+                  /*process_in_order=*/true};
+    return register_protocol(p);
+  }();
+  (void)once;
+}
+
+// ---- client --------------------------------------------------------------
+
+namespace {
+
+ParseError rtmpc_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  if (sock->pinned_protocol < 0) {
+    return ParseError::kTryOtherProtocol;
+  }
+  RtmpConn* conn = rtmp_conn_of(sock, /*client=*/true);
+  if (conn->phase == RtmpConn::kAwaitS0S1S2) {
+    if (source->size() < 1 + 2 * kHandshakeSize) {
+      return ParseError::kNotEnoughData;
+    }
+    uint8_t s0;
+    source->copy_to(&s0, 1, 0);
+    if (s0 != 0x03) {
+      return ParseError::kCorrupted;
+    }
+    source->pop_front(1);
+    IOBuf s1;
+    source->cutn(&s1, kHandshakeSize);
+    source->pop_front(kHandshakeSize);  // S2 (echo of our C1; trusted)
+    sock->Write(std::move(s1));        // C2 = echo of S1
+    conn->phase = RtmpConn::kChunks;
+    conn->handshook.value.store(1, std::memory_order_release);
+    conn->handshook.wake_all();
+  }
+  ParseError rc = parse_chunks(source, out, sock, conn);
+  if (rc == ParseError::kOk) {
+    out->meta.type = RpcMeta::kResponse;
+  }
+  return rc;
+}
+
+void rtmpc_process_response(InputMessage&& imsg) {
+  SocketRef sock(Socket::Address(imsg.socket));
+  if (!sock) {
+    return;
+  }
+  auto msg = std::static_pointer_cast<RtmpMessage>(imsg.ctx);
+  RtmpConn* conn = rtmp_conn_of(sock.get(), /*client=*/true);
+  const RtmpMsgType t = static_cast<RtmpMsgType>(msg->type);
+  if (t == RtmpMsgType::kAudio || t == RtmpMsgType::kVideo ||
+      t == RtmpMsgType::kDataAmf0) {
+    if (conn->on_media) {
+      conn->on_media(*msg);
+    }
+    return;
+  }
+  if (t != RtmpMsgType::kCommandAmf0) {
+    return;
+  }
+  std::vector<Amf0Value> cmd = decode_amf_list(msg->payload);
+  const std::string name = amf_string_or(cmd, 0, "");
+  if (name == "_result" || name == "_error") {
+    const double txn = amf_number_or(cmd, 1, 0);
+    std::shared_ptr<RtmpWaiter> w;
+    {
+      std::lock_guard<std::mutex> g(conn->wmu);
+      auto it = conn->by_txn.find(txn);
+      if (it == conn->by_txn.end()) {
+        return;
+      }
+      w = std::move(it->second);
+      conn->by_txn.erase(it);
+    }
+    w->ok = name == "_result";
+    w->args.assign(cmd.begin() + (cmd.size() > 2 ? 2 : cmd.size()),
+                   cmd.end());
+    w->ev.signal();
+    return;
+  }
+  if (name == "onStatus") {
+    std::shared_ptr<RtmpWaiter> w;
+    {
+      std::lock_guard<std::mutex> g(conn->wmu);
+      if (conn->status_waiters.empty()) {
+        return;
+      }
+      w = std::move(conn->status_waiters.front());
+      conn->status_waiters.pop_front();
+    }
+    const Amf0Value* info =
+        cmd.size() > 3 ? &cmd[3] : nullptr;
+    const Amf0Value* code =
+        info != nullptr ? info->prop("code") : nullptr;
+    w->ok = code != nullptr && code->type == Amf0Value::kString &&
+            (code->str.find(".Start") != std::string::npos);
+    w->args.assign(cmd.begin() + (cmd.size() > 2 ? 2 : cmd.size()),
+                   cmd.end());
+    w->ev.signal();
+    return;
+  }
+}
+
+void rtmpc_process_request(InputMessage&&) {}
+
+int rtmpc_protocol_index() {
+  static const int index = [] {
+    Protocol p = {"rtmpc", rtmpc_parse, rtmpc_process_request,
+                  rtmpc_process_response,
+                  /*process_in_order=*/true};
+    return register_protocol(p);
+  }();
+  return index;
+}
+
+}  // namespace
+
+RtmpClient::~RtmpClient() {
+  csock_.Shutdown();
+}
+
+int RtmpClient::Init(const std::string& addr, const Options* opts) {
+  fiber_init(0);
+  if (opts != nullptr) {
+    opts_ = *opts;
+  }
+  rtmpc_protocol_index();
+  return csock_.Init(addr);
+}
+
+int RtmpClient::ensure_connected() {
+  SocketId sid = 0;
+  auto install = [](Socket* s) -> int {
+    RtmpConn* conn = rtmp_conn_of(s, /*client=*/true);
+    conn->is_client = true;
+    conn->phase = RtmpConn::kAwaitS0S1S2;
+    // C0 + C1.
+    std::string c01;
+    c01.push_back(0x03);
+    put_u32be(&c01, 0);
+    put_u32be(&c01, 0);
+    for (size_t i = 0; i < kHandshakeSize - 8; ++i) {
+      c01.push_back(static_cast<char>(fast_rand()));
+    }
+    IOBuf out;
+    out.append(c01);
+    return s->Write(std::move(out));
+  };
+  if (csock_.ensure(rtmpc_protocol_index(), install, &sid) != 0) {
+    return -1;
+  }
+  if (sid != last_sid_) {
+    // ensure() replaced a failed socket: the fresh connection is mid-
+    // handshake and unconnected regardless of what the old one was.
+    connected_ = false;
+    last_sid_ = sid;
+  }
+  if (connected_) {
+    return 0;
+  }
+  SocketRef s(Socket::Address(sid));
+  if (!s) {
+    return -1;
+  }
+  RtmpConn* conn = rtmp_conn_of(s.get(), /*client=*/true);
+  const int64_t deadline =
+      monotonic_time_us() + opts_.timeout_ms * 1000;
+  if (conn->handshook.wait(0, deadline) == ETIMEDOUT) {
+    return -1;
+  }
+  // connect(app) — txn 1 by convention.
+  auto w = std::make_shared<RtmpWaiter>();
+  {
+    std::lock_guard<std::mutex> g(conn->wmu);
+    conn->by_txn.emplace(1.0, w);
+  }
+  write_set_chunk_size(s.get(), conn, kOurChunkSize);
+  write_command(
+      s.get(), conn, 0,
+      {Amf0Value::Str("connect"), Amf0Value::Number(1),
+       Amf0Value::Object({{"app", Amf0Value::Str(opts_.app)},
+                          {"flashVer", Amf0Value::Str("TRPC/1.0")},
+                          {"tcUrl", Amf0Value::Str(
+                                        "rtmp://" +
+                                        endpoint2str(csock_.endpoint()) +
+                                        "/" + opts_.app)}})});
+  if (w->ev.wait(deadline) != 0 || !w->ok) {
+    std::lock_guard<std::mutex> g(conn->wmu);
+    conn->by_txn.erase(1.0);  // a retried connect must get a fresh slot
+    return -1;
+  }
+  connected_ = true;
+  return 0;
+}
+
+int RtmpClient::connect() {
+  LockGuard<FiberMutex> g(mu_);
+  return ensure_connected();
+}
+
+int RtmpClient::create_stream(uint32_t* msid) {
+  LockGuard<FiberMutex> g(mu_);
+  if (ensure_connected() != 0) {
+    return -1;
+  }
+  SocketId sid = 0;
+  if (csock_.ensure(rtmpc_protocol_index(), nullptr, &sid) != 0) {
+    return -1;
+  }
+  SocketRef s(Socket::Address(sid));
+  if (!s) {
+    return -1;
+  }
+  RtmpConn* conn = rtmp_conn_of(s.get(), /*client=*/true);
+  const double txn = next_txn_++;
+  auto w = std::make_shared<RtmpWaiter>();
+  {
+    std::lock_guard<std::mutex> g2(conn->wmu);
+    conn->by_txn.emplace(txn, w);
+  }
+  write_command(s.get(), conn, 0,
+                {Amf0Value::Str("createStream"), Amf0Value::Number(txn),
+                 Amf0Value::Null()});
+  const int64_t deadline =
+      monotonic_time_us() + opts_.timeout_ms * 1000;
+  if (w->ev.wait(deadline) != 0 || !w->ok) {
+    std::lock_guard<std::mutex> g2(conn->wmu);
+    conn->by_txn.erase(txn);
+    return -1;
+  }
+  // args = [command-object(null), stream id]
+  for (const Amf0Value& a : w->args) {
+    if (a.type == Amf0Value::kNumber) {
+      *msid = static_cast<uint32_t>(a.num);
+      return 0;
+    }
+  }
+  return -1;
+}
+
+namespace {
+
+int verb_with_status(ClientSocket* csock, double* next_txn,
+                     int64_t timeout_ms, int proto_index,
+                     const std::string& verb, uint32_t msid,
+                     const std::string& stream,
+                     RtmpClient::MediaHandler on_media) {
+  SocketId sid = 0;
+  if (csock->ensure(proto_index, nullptr, &sid) != 0) {
+    return -1;
+  }
+  SocketRef s(Socket::Address(sid));
+  if (!s) {
+    return -1;
+  }
+  RtmpConn* conn = rtmp_conn_of(s.get(), /*client=*/true);
+  if (on_media) {
+    conn->on_media = std::move(on_media);
+  }
+  const double txn = (*next_txn)++;
+  auto w = std::make_shared<RtmpWaiter>();
+  {
+    std::lock_guard<std::mutex> g(conn->wmu);
+    conn->status_waiters.push_back(w);
+  }
+  std::vector<Amf0Value> cmd = {Amf0Value::Str(verb),
+                                Amf0Value::Number(txn),
+                                Amf0Value::Null(),
+                                Amf0Value::Str(stream)};
+  if (verb == "publish") {
+    cmd.push_back(Amf0Value::Str("live"));
+  }
+  write_command(s.get(), conn, msid, cmd);
+  const int64_t deadline = monotonic_time_us() + timeout_ms * 1000;
+  if (w->ev.wait(deadline) != 0 || !w->ok) {
+    // A timed-out waiter must leave the FIFO or it mispairs the NEXT
+    // onStatus with the wrong verb.
+    std::lock_guard<std::mutex> g(conn->wmu);
+    for (auto it = conn->status_waiters.begin();
+         it != conn->status_waiters.end(); ++it) {
+      if (*it == w) {
+        conn->status_waiters.erase(it);
+        break;
+      }
+    }
+    return -1;
+  }
+  return 0;
+}
+
+}  // namespace
+
+int RtmpClient::publish(uint32_t msid, const std::string& name) {
+  LockGuard<FiberMutex> g(mu_);
+  if (ensure_connected() != 0) {
+    return -1;
+  }
+  return verb_with_status(&csock_, &next_txn_, opts_.timeout_ms,
+                          rtmpc_protocol_index(), "publish", msid, name,
+                          nullptr);
+}
+
+int RtmpClient::play(uint32_t msid, const std::string& name,
+                     MediaHandler on_media) {
+  LockGuard<FiberMutex> g(mu_);
+  if (ensure_connected() != 0) {
+    return -1;
+  }
+  return verb_with_status(&csock_, &next_txn_, opts_.timeout_ms,
+                          rtmpc_protocol_index(), "play", msid, name,
+                          std::move(on_media));
+}
+
+int RtmpClient::send_media(uint32_t msid, RtmpMsgType type,
+                           uint32_t timestamp, const std::string& payload) {
+  LockGuard<FiberMutex> g(mu_);
+  if (ensure_connected() != 0) {
+    return -1;
+  }
+  SocketId sid = 0;
+  if (csock_.ensure(rtmpc_protocol_index(), nullptr, &sid) != 0) {
+    return -1;
+  }
+  SocketRef s(Socket::Address(sid));
+  if (!s) {
+    return -1;
+  }
+  RtmpConn* conn = rtmp_conn_of(s.get(), /*client=*/true);
+  RtmpMessage m;
+  m.type = static_cast<uint8_t>(type);
+  m.timestamp = timestamp;
+  m.stream_id = msid;
+  m.payload = payload;
+  write_message(s.get(), conn, kCsidMedia, m);
+  return 0;
+}
+
+}  // namespace trpc

@@ -1,0 +1,160 @@
+// RTMP media substrate — handshake, chunk streams, AMF0, publish/play relay.
+//
+// Parity: the reference carries a full media-server substrate
+// (/root/reference/src/brpc/rtmp.{h,cpp} ~3.8k, policy/rtmp_protocol.cpp
+// ~3.7k, amf.* ~1.5k: RtmpService with server streams, client streams,
+// retrying clients, FLV/TS muxing).  Condensed tpu-native scope — the
+// live-relay core a media server is built from:
+//   - plain (non-digest) C0/C1/C2 handshake,
+//   - chunk-stream codec both directions (fmt0-3 headers, extended
+//     timestamps, SetChunkSize both ways, message reassembly),
+//   - AMF0 codec (number/bool/string/object/null/ecma-array),
+//   - the NetConnection/NetStream command flow (connect, createStream,
+//     publish, play, deleteStream) with _result/onStatus replies,
+//   - publisher -> players relay of audio/video/data messages keyed by
+//     stream name (the RtmpService registry).
+// Out of scope (kept to the registries): digest handshakes, RTMPS, FLV/TS
+// file muxing, aggregate messages, shared objects.
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "base/endpoint.h"
+#include "base/iobuf.h"
+#include "fiber/sync.h"
+#include "net/proto_client.h"
+#include "net/socket.h"
+
+namespace trpc {
+
+class Server;
+
+// ---- AMF0 ----------------------------------------------------------------
+
+struct Amf0Value {
+  enum Type : uint8_t {
+    kNumber = 0x00,
+    kBool = 0x01,
+    kString = 0x02,
+    kObject = 0x03,
+    kNull = 0x05,
+    kEcmaArray = 0x08,
+  };
+  Type type = kNull;
+  double num = 0;
+  bool b = false;
+  std::string str;
+  // object / ecma array properties, in order.
+  std::vector<std::pair<std::string, Amf0Value>> props;
+
+  static Amf0Value Number(double v);
+  static Amf0Value Boolean(bool v);
+  static Amf0Value Str(std::string v);
+  static Amf0Value Object(std::vector<std::pair<std::string, Amf0Value>> p);
+  static Amf0Value Null();
+
+  const Amf0Value* prop(const std::string& key) const;
+  bool operator==(const Amf0Value& o) const;
+};
+
+void amf0_write(const Amf0Value& v, std::string* out);
+// 1 ok / 0 partial / -1 malformed; depth-bounded.
+int amf0_read(const std::string& in, size_t* pos, Amf0Value* out,
+              int depth = 0);
+
+// ---- messages ------------------------------------------------------------
+
+// RTMP message types used here (public spec values).
+enum class RtmpMsgType : uint8_t {
+  kSetChunkSize = 1,
+  kAck = 3,
+  kUserControl = 4,
+  kWindowAckSize = 5,
+  kSetPeerBandwidth = 6,
+  kAudio = 8,
+  kVideo = 9,
+  kDataAmf0 = 18,
+  kCommandAmf0 = 20,
+};
+
+struct RtmpMessage {
+  uint8_t type = 0;
+  uint32_t timestamp = 0;
+  uint32_t stream_id = 0;  // message stream id (little-endian on wire)
+  std::string payload;
+};
+
+// ---- server side ---------------------------------------------------------
+
+// Publish/play registry; assign via Server::set_rtmp_service.  A media
+// callback observes every relayed message (hooks for recording etc.).
+class RtmpService {
+ public:
+  using MediaObserver = std::function<void(
+      const std::string& stream_name, const RtmpMessage& msg)>;
+
+  void set_media_observer(MediaObserver ob) { observer_ = std::move(ob); }
+  const MediaObserver& observer() const { return observer_; }
+
+  // Introspection (tests, /status).
+  size_t publisher_count() const;
+  size_t player_count(const std::string& name) const;
+
+  // -- internal (protocol) --
+  struct Hub {
+    SocketId publisher = 0;
+    std::vector<std::pair<SocketId, uint32_t>> players;  // (socket, msid)
+  };
+  mutable FiberMutex mu;
+  std::map<std::string, Hub> hubs;
+
+ private:
+  MediaObserver observer_;
+};
+
+void register_rtmp_protocol();
+
+// ---- client side ---------------------------------------------------------
+
+class RtmpClient {
+ public:
+  struct Options {
+    int64_t timeout_ms = 2000;
+    std::string app = "live";
+  };
+  using MediaHandler = std::function<void(const RtmpMessage& msg)>;
+
+  ~RtmpClient();
+  int Init(const std::string& addr, const Options* opts = nullptr);
+
+  // Handshake + connect(app).  0 on success.  Called implicitly by the
+  // verbs below when needed.
+  int connect();
+  // createStream; fills *msid.
+  int create_stream(uint32_t* msid);
+  // Start publishing `name` on msid.
+  int publish(uint32_t msid, const std::string& name);
+  // Start playing `name` on msid; media messages arrive on `on_media`
+  // (called inline on the read fiber).
+  int play(uint32_t msid, const std::string& name, MediaHandler on_media);
+  // Send one audio/video/data message on a published stream.
+  int send_media(uint32_t msid, RtmpMsgType type, uint32_t timestamp,
+                 const std::string& payload);
+
+ private:
+  int ensure_connected();  // under mu_
+
+  Options opts_;
+  FiberMutex mu_;
+  ClientSocket csock_;
+  bool connected_ = false;
+  SocketId last_sid_ = 0;  // detects ensure() replacing a failed socket
+  double next_txn_ = 2;    // txn 1 is connect
+};
+
+}  // namespace trpc

@@ -22,6 +22,7 @@
 #include "net/redis.h"
 #include "net/memcache.h"
 #include "net/mongo.h"
+#include "net/rtmp.h"
 #include "net/usercode_pool.h"
 #include "net/legacy_pbrpc.h"
 #include "net/nshead.h"
@@ -224,6 +225,9 @@ int Server::Start(int port) {
   }
   if (mongo_service_ != nullptr) {
     register_mongo_protocol();
+  }
+  if (rtmp_service_ != nullptr) {
+    register_rtmp_protocol();
   }
   // redis must precede the nshead family and esp: its '*' marker decides
   // instantly, while those probers HOLD short prefixes (no magic in the

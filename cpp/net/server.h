@@ -30,6 +30,7 @@ class RedisService;   // net/redis.h
 class ThriftService;  // net/thrift.h
 class MemcacheService;  // net/memcache.h
 class MongoService;     // net/mongo.h
+class RtmpService;      // net/rtmp.h
 class NsheadService;  // net/nshead.h
 class EspService;     // net/nshead.h
 
@@ -103,6 +104,12 @@ class Server {
   // Not owned.  Call before Start.
   void set_mongo_service(MongoService* ms) { mongo_service_ = ms; }
   MongoService* mongo_service() const { return mongo_service_; }
+
+  // Makes this server speak RTMP (handshake 0x03, publish/play relay)
+  // on its port (net/rtmp.h; parity: ServerOptions::rtmp_service,
+  // rtmp.h).  Not owned.  Call before Start.
+  void set_rtmp_service(RtmpService* rs) { rtmp_service_ = rs; }
+  RtmpService* rtmp_service() const { return rtmp_service_; }
 
   // nshead-family personalities (net/nshead.h, net/legacy_pbrpc.h).  The
   // 36-byte head's magic is the shared discriminator, so install at most
@@ -200,6 +207,7 @@ class Server {
   ThriftService* thrift_service_ = nullptr;
   MemcacheService* memcache_service_ = nullptr;
   MongoService* mongo_service_ = nullptr;
+  RtmpService* rtmp_service_ = nullptr;
   NsheadService* nshead_service_ = nullptr;
   EspService* esp_service_ = nullptr;
   bool usercode_in_pthread_ = false;

@@ -1,0 +1,189 @@
+// RTMP: AMF0 codec roundtrip + malformed rejection, handshake +
+// connect/createStream over loopback with protocol probing, and the
+// publish -> play relay with media flowing publisher -> server -> player
+// across chunk-size renegotiation and multi-chunk payloads.
+#include "net/rtmp.h"
+
+#include <atomic>
+#include <thread>
+
+#include "net/channel.h"
+#include "net/server.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+TEST_CASE(amf0_roundtrip) {
+  std::vector<Amf0Value> vals;
+  vals.push_back(Amf0Value::Number(2.5));
+  vals.push_back(Amf0Value::Number(-1e9));
+  vals.push_back(Amf0Value::Boolean(true));
+  vals.push_back(Amf0Value::Str("stream/key_1"));
+  vals.push_back(Amf0Value::Null());
+  vals.push_back(Amf0Value::Object(
+      {{"app", Amf0Value::Str("live")},
+       {"caps", Amf0Value::Number(31)},
+       {"inner", Amf0Value::Object({{"k", Amf0Value::Str("v")}})}}));
+  for (const Amf0Value& v : vals) {
+    std::string wire;
+    amf0_write(v, &wire);
+    Amf0Value back;
+    size_t pos = 0;
+    EXPECT_EQ(amf0_read(wire, &pos, &back), 1);
+    EXPECT_EQ(pos, wire.size());
+    EXPECT(back == v);
+  }
+  // Golden bytes: Number(1.0) = 00 3F F0 00 00 00 00 00 00.
+  std::string one;
+  amf0_write(Amf0Value::Number(1.0), &one);
+  const uint8_t kOne[] = {0x00, 0x3f, 0xf0, 0, 0, 0, 0, 0, 0};
+  EXPECT_EQ(one.size(), sizeof(kOne));
+  EXPECT(memcmp(one.data(), kOne, sizeof(kOne)) == 0);
+}
+
+TEST_CASE(amf0_rejects_malformed) {
+  Amf0Value v;
+  size_t pos = 0;
+  // Unknown marker.
+  EXPECT_EQ(amf0_read(std::string("\x0d", 1), &pos, &v), -1);
+  // Truncated string.
+  pos = 0;
+  EXPECT_EQ(amf0_read(std::string("\x02\x00\x10hi", 5), &pos, &v), 0);
+  // Object whose end marker byte is wrong (0x00 instead of 0x09).
+  pos = 0;
+  std::string obj("\x03\x00\x01k\x05\x00\x00", 8);  // k:null then bad end
+  EXPECT_EQ(amf0_read(obj, &pos, &v), -1);
+  // Object truncated before its end marker arrives.
+  pos = 0;
+  std::string trunc("\x03\x00\x01k\x05\x00", 6);
+  EXPECT_EQ(amf0_read(trunc, &pos, &v), 0);
+  // Nesting bomb.
+  std::string deep;
+  for (int i = 0; i < 32; ++i) {
+    deep.append("\x03\x00\x01x", 4);
+  }
+  pos = 0;
+  EXPECT_EQ(amf0_read(deep, &pos, &v), -1);
+}
+
+TEST_CASE(rtmp_connect_and_create_stream) {
+  RtmpService svc;
+  Server server;
+  server.set_rtmp_service(&svc);
+  EXPECT_EQ(server.Start(0), 0);
+
+  RtmpClient cli;
+  EXPECT_EQ(cli.Init("127.0.0.1:" + std::to_string(server.port())), 0);
+  EXPECT_EQ(cli.connect(), 0);
+  uint32_t msid = 0;
+  EXPECT_EQ(cli.create_stream(&msid), 0);
+  EXPECT(msid > 0);
+
+  server.Stop();
+  server.Join();
+}
+
+TEST_CASE(rtmp_publish_play_relay) {
+  RtmpService svc;
+  std::atomic<int> observed{0};
+  svc.set_media_observer(
+      [&](const std::string& name, const RtmpMessage& m) {
+        if (name == "cam0") {
+          observed.fetch_add(1);
+        }
+      });
+  Server server;
+  server.set_rtmp_service(&svc);
+  EXPECT_EQ(server.Start(0), 0);
+  const std::string addr = "127.0.0.1:" + std::to_string(server.port());
+
+  // Player first (so nothing relayed is missed).
+  RtmpClient player;
+  EXPECT_EQ(player.Init(addr), 0);
+  uint32_t pmsid = 0;
+  EXPECT_EQ(player.create_stream(&pmsid), 0);
+  std::atomic<int> got_audio{0};
+  std::atomic<int> got_video{0};
+  std::atomic<size_t> video_bytes{0};
+  std::atomic<uint32_t> last_ts{0};
+  EXPECT_EQ(player.play(pmsid, "cam0",
+                        [&](const RtmpMessage& m) {
+                          if (m.type == 8) {
+                            got_audio.fetch_add(1);
+                          }
+                          if (m.type == 9) {
+                            got_video.fetch_add(1);
+                            video_bytes.fetch_add(m.payload.size());
+                            last_ts.store(m.timestamp);
+                          }
+                        }),
+            0);
+  EXPECT_EQ(svc.player_count("cam0"), 1u);
+
+  RtmpClient pub;
+  EXPECT_EQ(pub.Init(addr), 0);
+  uint32_t bmsid = 0;
+  EXPECT_EQ(pub.create_stream(&bmsid), 0);
+  EXPECT_EQ(pub.publish(bmsid, "cam0"), 0);
+  EXPECT_EQ(svc.publisher_count(), 1u);
+
+  // Small audio frame + a multi-chunk video frame (> the 4096 chunk
+  // size, so fmt3 continuation chunks are exercised both directions).
+  EXPECT_EQ(pub.send_media(bmsid, RtmpMsgType::kAudio, 100, "AFRAME"), 0);
+  std::string big(100000, 'V');
+  EXPECT_EQ(pub.send_media(bmsid, RtmpMsgType::kVideo, 200, big), 0);
+
+  for (int spin = 0;
+       spin < 1000 && (got_audio.load() < 1 || got_video.load() < 1);
+       ++spin) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(2));
+  }
+  EXPECT_EQ(got_audio.load(), 1);
+  EXPECT_EQ(got_video.load(), 1);
+  EXPECT_EQ(video_bytes.load(), big.size());
+  EXPECT_EQ(last_ts.load(), 200u);
+  EXPECT_EQ(observed.load(), 2);
+
+  // Second publisher on the same name is refused.
+  RtmpClient pub2;
+  EXPECT_EQ(pub2.Init(addr), 0);
+  uint32_t b2 = 0;
+  EXPECT_EQ(pub2.create_stream(&b2), 0);
+  EXPECT(pub2.publish(b2, "cam0") != 0);
+
+  server.Stop();
+  server.Join();
+}
+
+TEST_CASE(rtmp_shares_port_with_rpc) {
+  // The same server answers tstd RPC and RTMP on one port.
+  RtmpService svc;
+  Server server;
+  server.set_rtmp_service(&svc);
+  server.RegisterMethod("Echo.Echo",
+                        [](Controller*, const IOBuf& req, IOBuf* rsp,
+                           Closure done) {
+                          rsp->append(req);
+                          done();
+                        });
+  EXPECT_EQ(server.Start(0), 0);
+  const std::string addr = "127.0.0.1:" + std::to_string(server.port());
+
+  RtmpClient cli;
+  EXPECT_EQ(cli.Init(addr), 0);
+  EXPECT_EQ(cli.connect(), 0);
+
+  Channel ch;
+  EXPECT_EQ(ch.Init(addr), 0);
+  Controller cntl;
+  IOBuf req, rsp;
+  req.append("mix");
+  ch.CallMethod("Echo.Echo", req, &rsp, &cntl);
+  EXPECT(!cntl.Failed());
+  EXPECT(rsp.to_string() == "mix");
+
+  server.Stop();
+  server.Join();
+}
+
+TEST_MAIN
