@@ -1,0 +1,122 @@
+// polyglot_port — ONE server port speaking six protocols at once: tstd
+// RPC, thrift, memcache, redis, mongo, and hulu pbrpc, each probed off
+// the first bytes of its connection (parity: brpc's "many protocols on
+// one port" headline; InputMessenger protocol multiplexing).
+//
+// Build: cmake --build build --target example_polyglot_port
+// Run:   ./build/example_polyglot_port
+#include <cstdio>
+
+#include "net/channel.h"
+#include "net/legacy_pbrpc.h"
+#include "net/memcache.h"
+#include "net/mongo.h"
+#include "net/redis.h"
+#include "net/server.h"
+#include "net/thrift.h"
+
+using namespace trpc;
+
+int main() {
+  Server server;
+  // The SAME handler serves tstd ("Echo.Echo") and the legacy pbrpc
+  // family ("EchoService.Echo" names arrive from hulu/sofa).
+  Server::Handler echo = [](Controller*, const IOBuf& req, IOBuf* rsp,
+                            Closure done) {
+    rsp->append(req);
+    done();
+  };
+  server.RegisterMethod("Echo.Echo", echo);
+  server.RegisterMethod("EchoService.Echo", echo);
+
+  ThriftService thrift;
+  thrift.AddMethodHandler("Echo", [](const ThriftValue& args,
+                                     std::string*) {
+    ThriftValue result = ThriftValue::Struct();
+    const ThriftValue* s = args.field(1);
+    result.add_field(0,
+                     ThriftValue::Str(s != nullptr ? s->str : ""));
+    return result;
+  });
+  server.set_thrift_service(&thrift);
+
+  MemcacheService memcache;
+  server.set_memcache_service(&memcache);
+
+  RedisService redis;
+  redis.AddCommandHandler("hello", [](const std::vector<std::string>&) {
+    return RedisReply::Status("polyglot");
+  });
+  server.set_redis_service(&redis);
+
+  MongoService mongo;
+  server.set_mongo_service(&mongo);
+
+  if (server.Start(0) != 0) {
+    fprintf(stderr, "start failed\n");
+    return 1;
+  }
+  const std::string addr = "127.0.0.1:" + std::to_string(server.port());
+  printf("one port, six protocols: %s\n", addr.c_str());
+
+  // 1. tstd RPC.
+  Channel ch;
+  Controller cntl;
+  IOBuf req, rsp;
+  req.append("over-tstd");
+  if (ch.Init(addr) != 0) return 1;
+  ch.CallMethod("Echo.Echo", req, &rsp, &cntl);
+  printf("tstd     : %s\n", cntl.Failed() ? "FAILED"
+                                          : rsp.to_string().c_str());
+  if (cntl.Failed()) return 1;
+
+  // 2. thrift framed.
+  ThriftClient tc;
+  if (tc.Init(addr) != 0) return 1;
+  ThriftValue targs = ThriftValue::Struct();
+  targs.add_field(1, ThriftValue::Str("over-thrift"));
+  ThriftClient::Result tr = tc.call("Echo", targs);
+  printf("thrift   : %s\n",
+         tr.ok ? tr.result.field(0)->str.c_str() : "FAILED");
+  if (!tr.ok) return 1;
+
+  // 3. memcache binary.
+  MemcacheClient mc;
+  if (mc.Init(addr) != 0) return 1;
+  mc.Set("k", "over-memcache");
+  McResult got = mc.Get("k");
+  printf("memcache : %s\n", got.ok() ? got.value.c_str() : "FAILED");
+  if (!got.ok()) return 1;
+
+  // 4. redis (RESP).
+  RedisClient rc;
+  if (rc.Init(addr) != 0) return 1;
+  RedisReply rr = rc.execute({"HELLO"});
+  printf("redis    : %s\n",
+         rr.type == RedisReply::kStatus ? rr.str.c_str() : "FAILED");
+  if (rr.type != RedisReply::kStatus) return 1;
+
+  // 5. mongo OP_MSG.
+  MongoClient mg;
+  if (mg.Init(addr) != 0) return 1;
+  BsonDoc ping;
+  ping.emplace_back("ping", BsonValue::Int32(1));
+  MongoClient::Result mr = mg.run_command(ping);
+  printf("mongo    : %s\n", mr.ok ? "ok" : "FAILED");
+  if (!mr.ok) return 1;
+
+  // 6. hulu pbrpc.
+  LegacyRpcClient lc;
+  if (lc.Init(addr, LegacyProto::kHulu) != 0) return 1;
+  IOBuf hreq;
+  hreq.append("over-hulu");
+  LegacyRpcClient::Result hr = lc.call("EchoService", "Echo", 0, hreq);
+  printf("hulu     : %s\n",
+         hr.ok ? hr.response.to_string().c_str() : "FAILED");
+  if (!hr.ok) return 1;
+
+  server.Stop();
+  server.Join();
+  printf("all six protocols answered on one port\n");
+  return 0;
+}

@@ -1,0 +1,69 @@
+// rtmp_relay — a live media relay in ~60 lines: one publisher pushes
+// audio/video messages, two players receive them fanned out by the
+// server's per-stream hub (parity: example rtmp usage of the
+// reference's media substrate).
+//
+// Build: cmake --build build --target example_rtmp_relay
+// Run:   ./build/example_rtmp_relay
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <thread>
+
+#include "net/rtmp.h"
+#include "net/server.h"
+
+using namespace trpc;
+
+int main() {
+  RtmpService svc;
+  Server server;
+  server.set_rtmp_service(&svc);
+  if (server.Start(0) != 0) {
+    fprintf(stderr, "start failed\n");
+    return 1;
+  }
+  const std::string addr = "127.0.0.1:" + std::to_string(server.port());
+  printf("rtmp relay on %s (app=live, stream=cam)\n", addr.c_str());
+
+  std::atomic<int> frames[2] = {{0}, {0}};
+  RtmpClient players[2];
+  for (int i = 0; i < 2; ++i) {
+    if (players[i].Init(addr) != 0) return 1;
+    uint32_t msid = 0;
+    if (players[i].create_stream(&msid) != 0) return 1;
+    if (players[i].play(msid, "cam",
+                        [&frames, i](const RtmpMessage& m) {
+                          if (m.type == 9) {
+                            frames[i].fetch_add(1);
+                          }
+                        }) != 0) {
+      return 1;
+    }
+  }
+
+  RtmpClient pub;
+  if (pub.Init(addr) != 0) return 1;
+  uint32_t msid = 0;
+  if (pub.create_stream(&msid) != 0) return 1;
+  if (pub.publish(msid, "cam") != 0) return 1;
+  for (int f = 0; f < 10; ++f) {
+    if (pub.send_media(msid, RtmpMsgType::kVideo,
+                       static_cast<uint32_t>(f * 33),
+                       std::string(32768, static_cast<char>('0' + f))) !=
+        0) {
+      return 1;
+    }
+  }
+
+  for (int spin = 0;
+       spin < 1000 && (frames[0].load() < 10 || frames[1].load() < 10);
+       ++spin) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(2));
+  }
+  printf("player0=%d player1=%d video frames relayed\n", frames[0].load(),
+         frames[1].load());
+  server.Stop();
+  server.Join();
+  return frames[0].load() == 10 && frames[1].load() == 10 ? 0 : 1;
+}
