@@ -33,6 +33,14 @@ constexpr uint8_t kComQuit = 0x01;
 constexpr uint8_t kComInitDb = 0x02;
 constexpr uint8_t kComQuery = 0x03;
 constexpr uint8_t kComPing = 0x0e;
+constexpr uint8_t kComStmtPrepare = 0x16;
+constexpr uint8_t kComStmtExecute = 0x17;
+constexpr uint8_t kComStmtClose = 0x19;
+
+// Column type codes the binary-row decoder understands.
+constexpr uint8_t kTypeLong = 0x03;
+constexpr uint8_t kTypeLongLong = 0x08;
+constexpr uint8_t kTypeVarString = 0xfd;
 
 // ---- fd IO with fiber-parking waits --------------------------------------
 
@@ -493,6 +501,239 @@ MysqlClient::Result MysqlClient::command(uint8_t com,
 
 MysqlClient::Result MysqlClient::Query(const std::string& sql) {
   return command(kComQuery, sql);
+}
+
+int MysqlClient::Prepare(const std::string& sql, Stmt* out) {
+  LockGuard<FiberMutex> g(mu_);
+  const int64_t deadline = monotonic_time_us() + opts_.timeout_ms * 1000;
+  if (ensure_connected() != 0) {
+    return -1;
+  }
+  std::string req(1, static_cast<char>(kComStmtPrepare));
+  req.append(sql);
+  std::string pkt;
+  uint8_t seq = 0;
+  if (write_packet(fd_, req, 0, deadline) != 0 ||
+      read_packet(fd_, &pkt, &seq, deadline) != 0 || pkt.size() < 12 ||
+      static_cast<uint8_t>(pkt[0]) != 0x00) {
+    drop_connection();
+    return -1;
+  }
+  // PREPARE-OK: [00] stmt_id u32 | num_columns u16 | num_params u16 |
+  // filler | warnings u16 — then param defs + EOF, column defs + EOF.
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(pkt.data());
+  out->id = p[1] | (p[2] << 8) | (p[3] << 16)
+            | (static_cast<uint32_t>(p[4]) << 24);
+  out->n_cols = static_cast<uint16_t>(p[5] | (p[6] << 8));
+  out->n_params = static_cast<uint16_t>(p[7] | (p[8] << 8));
+  for (int section = 0; section < 2; ++section) {
+    const int defs = section == 0 ? out->n_params : out->n_cols;
+    if (defs == 0) {
+      continue;
+    }
+    for (int i = 0; i <= defs; ++i) {  // defs + trailing EOF
+      if (read_packet(fd_, &pkt, &seq, deadline) != 0) {
+        drop_connection();
+        return -1;
+      }
+      if (i == defs && !is_eof_packet(pkt)) {
+        drop_connection();
+        return -1;
+      }
+    }
+  }
+  return 0;
+}
+
+void MysqlClient::CloseStmt(const Stmt& stmt) {
+  LockGuard<FiberMutex> g(mu_);
+  if (fd_ < 0) {
+    return;
+  }
+  std::string req(1, static_cast<char>(kComStmtClose));
+  put_u32le(&req, stmt.id);
+  write_packet(fd_, req, 0, monotonic_time_us() + opts_.timeout_ms * 1000);
+  // COM_STMT_CLOSE has no response by design.
+}
+
+MysqlClient::Result MysqlClient::ExecuteStmt(
+    const Stmt& stmt,
+    const std::vector<std::optional<std::string>>& params) {
+  Result r;
+  LockGuard<FiberMutex> g(mu_);
+  const int64_t deadline = monotonic_time_us() + opts_.timeout_ms * 1000;
+  if (ensure_connected() != 0) {
+    r.error_code = 2003;
+    r.error_text = "not connected";
+    return r;
+  }
+  if (params.size() != stmt.n_params) {
+    r.error_code = 2031;  // CR_PARAMS_NOT_BOUND
+    r.error_text = "parameter count mismatch";
+    return r;
+  }
+  std::string req(1, static_cast<char>(kComStmtExecute));
+  put_u32le(&req, stmt.id);
+  req.push_back(0);  // flags: CURSOR_TYPE_NO_CURSOR
+  put_u32le(&req, 1);  // iteration count
+  if (!params.empty()) {
+    std::string bitmap((params.size() + 7) / 8, '\0');
+    for (size_t i = 0; i < params.size(); ++i) {
+      if (!params[i].has_value()) {
+        bitmap[i / 8] |= static_cast<char>(1 << (i % 8));
+      }
+    }
+    req.append(bitmap);
+    req.push_back(1);  // new-params-bound
+    for (size_t i = 0; i < params.size(); ++i) {
+      req.push_back(static_cast<char>(kTypeVarString));
+      req.push_back(0);  // signed
+    }
+    for (const auto& param : params) {
+      if (!param.has_value()) {
+        continue;  // carried by the NULL bitmap
+      }
+      // lenenc length (all test/realistic params < 16MB).
+      const size_t n = param->size();
+      if (n < 0xfb) {
+        req.push_back(static_cast<char>(n));
+      } else if (n <= 0xffff) {
+        req.push_back(static_cast<char>(0xfc));
+        req.push_back(static_cast<char>(n));
+        req.push_back(static_cast<char>(n >> 8));
+      } else {
+        req.push_back(static_cast<char>(0xfd));
+        req.push_back(static_cast<char>(n));
+        req.push_back(static_cast<char>(n >> 8));
+        req.push_back(static_cast<char>(n >> 16));
+      }
+      req.append(*param);
+    }
+  }
+  std::string pkt;
+  uint8_t seq = 0;
+  if (write_packet(fd_, req, 0, deadline) != 0 ||
+      read_packet(fd_, &pkt, &seq, deadline) != 0 || pkt.empty()) {
+    drop_connection();
+    r.error_code = 2013;
+    r.error_text = "lost connection during execute";
+    return r;
+  }
+  const uint8_t first = static_cast<uint8_t>(pkt[0]);
+  if (first == 0xff) {
+    parse_err(pkt, &r);
+    return r;
+  }
+  if (first == 0x00) {
+    if (!parse_ok(pkt, &r)) {
+      r.error_text = "malformed OK packet";
+    }
+    return r;
+  }
+  // Binary resultset: column count, defs + EOF, binary rows + EOF.
+  size_t pos = 0;
+  uint64_t ncols = 0;
+  std::vector<uint8_t> col_types;
+  if (!get_lenenc(pkt, &pos, &ncols) || ncols == 0 || ncols > 4096) {
+    drop_connection();
+    r.error_text = "malformed resultset header";
+    return r;
+  }
+  for (uint64_t i = 0; i < ncols; ++i) {
+    if (read_packet(fd_, &pkt, &seq, deadline) != 0) {
+      drop_connection();
+      r.error_text = "short column definitions";
+      return r;
+    }
+    size_t cp = 0;
+    std::string skip, name;
+    uint8_t ctype = kTypeVarString;
+    if (get_lenenc_str(pkt, &cp, &skip) && get_lenenc_str(pkt, &cp, &skip) &&
+        get_lenenc_str(pkt, &cp, &skip) && get_lenenc_str(pkt, &cp, &skip) &&
+        get_lenenc_str(pkt, &cp, &name) && get_lenenc_str(pkt, &cp, &skip) &&
+        pkt.size() >= cp + 8) {
+      // fixed part: 0x0c, charset u16, length u32, TYPE u8 at +7.
+      ctype = static_cast<uint8_t>(pkt[cp + 7]);
+      r.columns.push_back(std::move(name));
+    } else {
+      r.columns.push_back("col" + std::to_string(i));
+    }
+    col_types.push_back(ctype);
+  }
+  if (read_packet(fd_, &pkt, &seq, deadline) != 0 || !is_eof_packet(pkt)) {
+    drop_connection();
+    r.error_text = "missing EOF after column definitions";
+    return r;
+  }
+  while (true) {
+    if (read_packet(fd_, &pkt, &seq, deadline) != 0) {
+      drop_connection();
+      r.error_text = "short resultset";
+      return r;
+    }
+    if (is_eof_packet(pkt)) {
+      break;
+    }
+    if (static_cast<uint8_t>(pkt[0]) == 0xff) {
+      parse_err(pkt, &r);
+      return r;
+    }
+    if (static_cast<uint8_t>(pkt[0]) != 0x00) {
+      drop_connection();
+      r.error_text = "malformed binary row";
+      return r;
+    }
+    // Binary row: [00] null-bitmap (offset 2) then typed values.
+    const size_t bitmap_len = (ncols + 7 + 2) / 8;
+    if (pkt.size() < 1 + bitmap_len) {
+      drop_connection();
+      r.error_text = "short binary row";
+      return r;
+    }
+    const uint8_t* bm = reinterpret_cast<const uint8_t*>(pkt.data()) + 1;
+    size_t rp = 1 + bitmap_len;
+    std::vector<std::optional<std::string>> row;
+    bool bad = false;
+    for (uint64_t i = 0; i < ncols && !bad; ++i) {
+      const size_t bit = i + 2;
+      if (bm[bit / 8] & (1 << (bit % 8))) {
+        row.emplace_back(std::nullopt);
+        continue;
+      }
+      switch (col_types[i]) {
+        case kTypeLong: {
+          if (pkt.size() - rp < 4) { bad = true; break; }
+          int32_t v;
+          std::memcpy(&v, pkt.data() + rp, 4);
+          rp += 4;
+          row.emplace_back(std::to_string(v));
+          break;
+        }
+        case kTypeLongLong: {
+          if (pkt.size() - rp < 8) { bad = true; break; }
+          int64_t v;
+          std::memcpy(&v, pkt.data() + rp, 8);
+          rp += 8;
+          row.emplace_back(std::to_string(v));
+          break;
+        }
+        default: {  // string-ish types: lenenc payload
+          std::string cell;
+          if (!get_lenenc_str(pkt, &rp, &cell)) { bad = true; break; }
+          row.emplace_back(std::move(cell));
+          break;
+        }
+      }
+    }
+    if (bad) {
+      drop_connection();
+      r.error_text = "malformed binary row";
+      return r;
+    }
+    r.rows.push_back(std::move(row));
+  }
+  r.ok = true;
+  return r;
 }
 
 int MysqlClient::Ping() {

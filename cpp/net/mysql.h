@@ -58,6 +58,20 @@ class MysqlClient {
   // One statement.  Transactions are plain statements on this bound
   // connection: Query("BEGIN") ... Query("COMMIT").
   Result Query(const std::string& sql);
+
+  // Prepared statements (binary protocol).  Params bind as strings
+  // (MYSQL_TYPE_VAR_STRING — the server coerces, same as the text
+  // protocol) or NULL via nullopt; binary resultset rows decode the
+  // common column types (strings/blobs, LONG/LONGLONG, NULL bitmap).
+  struct Stmt {
+    uint32_t id = 0;
+    uint16_t n_params = 0;
+    uint16_t n_cols = 0;
+  };
+  int Prepare(const std::string& sql, Stmt* out);
+  Result ExecuteStmt(const Stmt& stmt,
+                     const std::vector<std::optional<std::string>>& params);
+  void CloseStmt(const Stmt& stmt);  // fire-and-forget COM_STMT_CLOSE
   // COM_PING round trip; 0 on success.
   int Ping();
   // USE <db> via COM_INIT_DB; 0 on success.

@@ -10,6 +10,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <cstring>
 #include <thread>
@@ -186,6 +187,8 @@ void serve_conn(int fd, std::atomic<int>* authed, bool drop) {
     return;  // simulate a server-side kill right after auth
   }
 
+  int stmt_params = 0;
+  bool stmt_select = false;
   while (recv_pkt(fd, &pkt, &seq)) {
     if (pkt.empty()) {
       return;
@@ -197,6 +200,91 @@ void serve_conn(int fd, std::atomic<int>* authed, bool drop) {
     }
     if (com == 0x0e || com == 0x02) {  // PING / INIT_DB
       send_pkt(fd, ok_pkt(0, 0), 1);
+      continue;
+    }
+    if (com == 0x16) {  // STMT_PREPARE
+      stmt_params = static_cast<int>(
+          std::count(arg.begin(), arg.end(), '?'));
+      stmt_select = arg.rfind("SELECT", 0) == 0;
+      const int ncols = stmt_select ? 2 : 0;
+      std::string ok;
+      ok.push_back(0x00);
+      ok.append("\x07\x00\x00\x00", 4);  // stmt id 7
+      ok.push_back(static_cast<char>(ncols));
+      ok.push_back(0);
+      ok.push_back(static_cast<char>(stmt_params));
+      ok.push_back(0);
+      ok.append("\x00\x00\x00", 3);  // filler + warnings
+      uint8_t s2 = 1;
+      send_pkt(fd, ok, s2++);
+      for (int i = 0; i < stmt_params; ++i) {
+        send_pkt(fd, column_def("?"), s2++);
+      }
+      if (stmt_params > 0) {
+        send_pkt(fd, eof_pkt(), s2++);
+      }
+      for (int i = 0; i < ncols; ++i) {
+        send_pkt(fd, column_def("p" + std::to_string(i)), s2++);
+      }
+      if (ncols > 0) {
+        send_pkt(fd, eof_pkt(), s2++);
+      }
+      continue;
+    }
+    if (com == 0x19) {  // STMT_CLOSE: no response
+      continue;
+    }
+    if (com == 0x17) {  // STMT_EXECUTE
+      // [stmt_id u32][flags][iter u32] + bitmap + new-bound + types + vals.
+      size_t ep = 4 + 1 + 4;
+      std::vector<std::string> vals;
+      std::vector<bool> nulls;
+      if (stmt_params > 0 && arg.size() > ep) {
+        const size_t bml = (stmt_params + 7) / 8;
+        const uint8_t* bm =
+            reinterpret_cast<const uint8_t*>(arg.data()) + ep;
+        ep += bml + 1 + 2 * stmt_params;  // bitmap, bound flag, types
+        for (int i = 0; i < stmt_params; ++i) {
+          const bool is_null = bm[i / 8] & (1 << (i % 8));
+          nulls.push_back(is_null);
+          if (is_null) {
+            vals.emplace_back();
+            continue;
+          }
+          const uint8_t len = static_cast<uint8_t>(arg[ep]);  // short vals
+          vals.push_back(arg.substr(ep + 1, len));
+          ep += 1 + len;
+        }
+      }
+      if (!stmt_select) {
+        send_pkt(fd, ok_pkt(1, 9), 1);
+        continue;
+      }
+      uint8_t s2 = 1;
+      std::string hdr(1, 2);
+      send_pkt(fd, hdr, s2++);
+      send_pkt(fd, column_def("p0"), s2++);
+      send_pkt(fd, column_def("p1"), s2++);
+      send_pkt(fd, eof_pkt(), s2++);
+      // ONE binary row echoing the two params (null bitmap offset 2).
+      std::string row;
+      row.push_back(0x00);
+      uint8_t bm0 = 0;
+      for (int i = 0; i < 2 && i < static_cast<int>(nulls.size()); ++i) {
+        if (nulls[i]) {
+          bm0 |= static_cast<uint8_t>(1 << (i + 2));
+        }
+      }
+      row.push_back(static_cast<char>(bm0));
+      for (int i = 0; i < 2 && i < static_cast<int>(vals.size()); ++i) {
+        if (nulls[i]) {
+          continue;
+        }
+        row.push_back(static_cast<char>(vals[i].size()));
+        row.append(vals[i]);
+      }
+      send_pkt(fd, row, s2++);
+      send_pkt(fd, eof_pkt(), s2++);
       continue;
     }
     if (com != 0x03) {
@@ -338,6 +426,48 @@ TEST_CASE(mysql_auth_rejected) {
   EXPECT(!r.ok);
   EXPECT_EQ(r.error_code, 2003);  // surfaces as connect failure
 
+  srv.shutdown();
+}
+
+TEST_CASE(mysql_prepared_statements) {
+  FakeMysqld srv;
+  srv.start();
+  {
+    MysqlClient cli;
+    MysqlClient::Options opts;
+    opts.user = "tester";
+    opts.password = kPassword;
+    EXPECT_EQ(cli.Init("127.0.0.1:" + std::to_string(srv.port), &opts), 0);
+
+    MysqlClient::Stmt sel;
+    EXPECT_EQ(cli.Prepare("SELECT ? , ?", &sel), 0);
+    EXPECT_EQ(sel.id, 7u);
+    EXPECT_EQ(sel.n_params, 2);
+    EXPECT_EQ(sel.n_cols, 2);
+
+    // Binary roundtrip with one NULL param.
+    MysqlClient::Result r =
+        cli.ExecuteStmt(sel, {std::string("alpha"), std::nullopt});
+    EXPECT(r.ok);
+    EXPECT_EQ(r.rows.size(), 1u);
+    EXPECT(r.rows[0][0].has_value() && *r.rows[0][0] == "alpha");
+    EXPECT(!r.rows[0][1].has_value());
+
+    // Param-count mismatch is a client-side error.
+    EXPECT_EQ(cli.ExecuteStmt(sel, {std::string("x")}).error_code, 2031);
+
+    // Non-SELECT statement answers with an OK packet.
+    MysqlClient::Stmt ins;
+    EXPECT_EQ(cli.Prepare("INSERT INTO t VALUES (?)", &ins), 0);
+    EXPECT_EQ(ins.n_cols, 0);
+    r = cli.ExecuteStmt(ins, {std::string("v")});
+    EXPECT(r.ok);
+    EXPECT_EQ(r.affected_rows, 1u);
+    EXPECT_EQ(r.last_insert_id, 9u);
+
+    cli.CloseStmt(sel);
+    EXPECT_EQ(cli.Ping(), 0);  // connection healthy after CLOSE
+  }
   srv.shutdown();
 }
 
