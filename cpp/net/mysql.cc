@@ -242,6 +242,7 @@ void MysqlClient::drop_connection() {
   if (fd_ >= 0) {
     ::close(fd_);
     fd_ = -1;
+    ++session_gen_;  // invalidates prepared-statement handles
   }
 }
 
@@ -390,6 +391,188 @@ int MysqlClient::ensure_connected() {
   return 0;
 }
 
+// ---- resultset reader (shared by text and binary protocols) --------------
+
+namespace {
+
+// Reads a resultset whose HEADER packet is `first` (already consumed):
+// column definitions + EOF, then rows + EOF.  `binary` picks the row
+// format (COM_STMT_EXECUTE's typed rows vs COM_QUERY's lenenc text).
+// Returns 0 on success (r->ok set), -1 on a protocol error the caller
+// must treat as connection-fatal; a row-level ERR packet fills *r and
+// returns 0 (the connection survives).
+int read_resultset(int fd, const std::string& first, int64_t deadline,
+                   bool binary, MysqlClient::Result* r) {
+  size_t pos = 0;
+  uint64_t ncols = 0;
+  if (!get_lenenc(first, &pos, &ncols) || ncols == 0 || ncols > 4096) {
+    r->error_text = "malformed resultset header";
+    return -1;
+  }
+  std::vector<uint8_t> col_types;
+  std::vector<bool> col_unsigned;
+  std::string pkt;
+  uint8_t seq = 0;
+  for (uint64_t i = 0; i < ncols; ++i) {
+    if (read_packet(fd, &pkt, &seq, deadline) != 0) {
+      r->error_text = "short column definitions";
+      return -1;
+    }
+    size_t cp = 0;
+    std::string skip, name;
+    uint8_t ctype = 0xfd;  // VAR_STRING
+    bool is_unsigned = false;
+    if (get_lenenc_str(pkt, &cp, &skip) &&  // catalog ("def")
+        get_lenenc_str(pkt, &cp, &skip) &&  // schema
+        get_lenenc_str(pkt, &cp, &skip) &&  // table
+        get_lenenc_str(pkt, &cp, &skip) &&  // org_table
+        get_lenenc_str(pkt, &cp, &name)) {
+      r->columns.push_back(std::move(name));
+      // org_name + fixed part: 0x0c, charset u16, length u32, type u8,
+      // flags u16 (bit 5 = UNSIGNED), decimals, filler.
+      std::string org;
+      if (get_lenenc_str(pkt, &cp, &org) && pkt.size() >= cp + 10) {
+        ctype = static_cast<uint8_t>(pkt[cp + 7]);
+        const uint16_t flags = static_cast<uint16_t>(
+            static_cast<uint8_t>(pkt[cp + 8]) |
+            (static_cast<uint8_t>(pkt[cp + 9]) << 8));
+        is_unsigned = (flags & 0x20) != 0;
+      }
+    } else {
+      r->columns.push_back("col" + std::to_string(i));
+    }
+    col_types.push_back(ctype);
+    col_unsigned.push_back(is_unsigned);
+  }
+  if (read_packet(fd, &pkt, &seq, deadline) != 0 || !is_eof_packet(pkt)) {
+    r->error_text = "missing EOF after column definitions";
+    return -1;
+  }
+  while (true) {
+    if (read_packet(fd, &pkt, &seq, deadline) != 0) {
+      r->error_text = "short resultset";
+      return -1;
+    }
+    if (is_eof_packet(pkt)) {
+      break;
+    }
+    if (!pkt.empty() && static_cast<uint8_t>(pkt[0]) == 0xff) {
+      parse_err(pkt, r);
+      return 0;
+    }
+    std::vector<std::optional<std::string>> row;
+    if (!binary) {
+      size_t rp = 0;
+      for (uint64_t i = 0; i < ncols; ++i) {
+        if (rp < pkt.size() && static_cast<uint8_t>(pkt[rp]) == 0xfb) {
+          row.emplace_back(std::nullopt);
+          ++rp;
+          continue;
+        }
+        std::string cell;
+        if (!get_lenenc_str(pkt, &rp, &cell)) {
+          r->error_text = "malformed row";
+          return -1;
+        }
+        row.emplace_back(std::move(cell));
+      }
+    } else {
+      if (pkt.empty() || static_cast<uint8_t>(pkt[0]) != 0x00) {
+        r->error_text = "malformed binary row";
+        return -1;
+      }
+      const size_t bitmap_len = (ncols + 7 + 2) / 8;
+      if (pkt.size() < 1 + bitmap_len) {
+        r->error_text = "short binary row";
+        return -1;
+      }
+      const uint8_t* bm =
+          reinterpret_cast<const uint8_t*>(pkt.data()) + 1;
+      size_t rp = 1 + bitmap_len;
+      for (uint64_t i = 0; i < ncols; ++i) {
+        const size_t bit = i + 2;
+        if (bm[bit / 8] & (1 << (bit % 8))) {
+          row.emplace_back(std::nullopt);
+          continue;
+        }
+        // Fixed-length binary types, signedness-aware; everything else
+        // is length-encoded (strings, blobs, decimals, dates-as-text).
+        auto fixed_int = [&](size_t nbytes) -> bool {
+          if (pkt.size() - rp < nbytes) {
+            return false;
+          }
+          uint64_t u = 0;
+          std::memcpy(&u, pkt.data() + rp, nbytes);
+          rp += nbytes;
+          if (col_unsigned[i]) {
+            row.emplace_back(std::to_string(u));
+          } else {
+            // Sign-extend from nbytes.
+            const int shift = static_cast<int>(64 - 8 * nbytes);
+            row.emplace_back(std::to_string(
+                shift == 0
+                    ? static_cast<int64_t>(u)
+                    : (static_cast<int64_t>(u << shift) >> shift)));
+          }
+          return true;
+        };
+        bool ok = true;
+        switch (col_types[i]) {
+          case 0x01:  // TINY
+            ok = fixed_int(1);
+            break;
+          case 0x02:  // SHORT
+          case 0x0d:  // YEAR
+            ok = fixed_int(2);
+            break;
+          case 0x03:  // LONG
+          case 0x09:  // INT24 (transferred as 4 bytes)
+            ok = fixed_int(4);
+            break;
+          case 0x08:  // LONGLONG
+            ok = fixed_int(8);
+            break;
+          case 0x04: {  // FLOAT
+            float f;
+            if ((ok = pkt.size() - rp >= 4)) {
+              std::memcpy(&f, pkt.data() + rp, 4);
+              rp += 4;
+              row.emplace_back(std::to_string(f));
+            }
+            break;
+          }
+          case 0x05: {  // DOUBLE
+            double d;
+            if ((ok = pkt.size() - rp >= 8)) {
+              std::memcpy(&d, pkt.data() + rp, 8);
+              rp += 8;
+              row.emplace_back(std::to_string(d));
+            }
+            break;
+          }
+          default: {
+            std::string cell;
+            ok = get_lenenc_str(pkt, &rp, &cell);
+            if (ok) {
+              row.emplace_back(std::move(cell));
+            }
+            break;
+          }
+        }
+        if (!ok) {
+          r->error_text = "malformed binary row";
+          return -1;
+        }
+      }
+    }
+    r->rows.push_back(std::move(row));
+  }
+  r->ok = true;
+  return 0;
+}
+
+}  // namespace
+
 // ---- commands ------------------------------------------------------------
 
 MysqlClient::Result MysqlClient::command(uint8_t com,
@@ -427,71 +610,11 @@ MysqlClient::Result MysqlClient::command(uint8_t com,
       }
       return r;
     }
-    // Resultset: column count, defs, EOF, rows, EOF.
-    size_t pos = 0;
-    uint64_t ncols = 0;
-    if (!get_lenenc(pkt, &pos, &ncols) || ncols == 0 || ncols > 4096) {
-      r.error_text = "malformed resultset header";
+    // Resultset: shared reader (text rows).
+    if (read_resultset(fd_, pkt, deadline, /*binary=*/false, &r) != 0) {
       drop_connection();
       return r;
     }
-    for (uint64_t i = 0; i < ncols; ++i) {
-      if (read_packet(fd_, &pkt, &seq, deadline) != 0) {
-        drop_connection();
-        r.error_text = "short column definitions";
-        return r;
-      }
-      // Column definition41: catalog/schema/table/org_table/name/...
-      size_t cp = 0;
-      std::string skip, name;
-      if (get_lenenc_str(pkt, &cp, &skip) &&     // catalog ("def")
-          get_lenenc_str(pkt, &cp, &skip) &&     // schema
-          get_lenenc_str(pkt, &cp, &skip) &&     // table
-          get_lenenc_str(pkt, &cp, &skip) &&     // org_table
-          get_lenenc_str(pkt, &cp, &name)) {
-        r.columns.push_back(std::move(name));
-      } else {
-        r.columns.push_back("col" + std::to_string(i));
-      }
-    }
-    if (read_packet(fd_, &pkt, &seq, deadline) != 0 ||
-        !is_eof_packet(pkt)) {
-      drop_connection();
-      r.error_text = "missing EOF after column definitions";
-      return r;
-    }
-    while (true) {
-      if (read_packet(fd_, &pkt, &seq, deadline) != 0) {
-        drop_connection();
-        r.error_text = "short resultset";
-        return r;
-      }
-      if (is_eof_packet(pkt)) {
-        break;
-      }
-      if (!pkt.empty() && static_cast<uint8_t>(pkt[0]) == 0xff) {
-        parse_err(pkt, &r);
-        return r;
-      }
-      std::vector<std::optional<std::string>> row;
-      size_t rp = 0;
-      for (uint64_t i = 0; i < ncols; ++i) {
-        if (rp < pkt.size() && static_cast<uint8_t>(pkt[rp]) == 0xfb) {
-          row.emplace_back(std::nullopt);
-          ++rp;
-          continue;
-        }
-        std::string cell;
-        if (!get_lenenc_str(pkt, &rp, &cell)) {
-          drop_connection();
-          r.error_text = "malformed row";
-          return r;
-        }
-        row.emplace_back(std::move(cell));
-      }
-      r.rows.push_back(std::move(row));
-    }
-    r.ok = true;
     return r;
   }
   r.error_code = 2013;  // CR_SERVER_LOST
@@ -503,10 +626,14 @@ MysqlClient::Result MysqlClient::Query(const std::string& sql) {
   return command(kComQuery, sql);
 }
 
-int MysqlClient::Prepare(const std::string& sql, Stmt* out) {
+int MysqlClient::Prepare(const std::string& sql, Stmt* out, Result* err) {
   LockGuard<FiberMutex> g(mu_);
   const int64_t deadline = monotonic_time_us() + opts_.timeout_ms * 1000;
   if (ensure_connected() != 0) {
+    if (err != nullptr) {
+      err->error_code = 2003;
+      err->error_text = "cannot connect";
+    }
     return -1;
   }
   std::string req(1, static_cast<char>(kComStmtPrepare));
@@ -514,9 +641,29 @@ int MysqlClient::Prepare(const std::string& sql, Stmt* out) {
   std::string pkt;
   uint8_t seq = 0;
   if (write_packet(fd_, req, 0, deadline) != 0 ||
-      read_packet(fd_, &pkt, &seq, deadline) != 0 || pkt.size() < 12 ||
-      static_cast<uint8_t>(pkt[0]) != 0x00) {
+      read_packet(fd_, &pkt, &seq, deadline) != 0 || pkt.empty()) {
     drop_connection();
+    if (err != nullptr) {
+      err->error_code = 2013;
+      err->error_text = "lost connection during prepare";
+    }
+    return -1;
+  }
+  if (static_cast<uint8_t>(pkt[0]) == 0xff) {
+    // Server-side failure (syntax error, unknown table): the session is
+    // HEALTHY — dropping it here would silently roll back an open
+    // transaction.
+    if (err != nullptr) {
+      parse_err(pkt, err);
+    }
+    return -1;
+  }
+  if (pkt.size() < 12 || static_cast<uint8_t>(pkt[0]) != 0x00) {
+    drop_connection();
+    if (err != nullptr) {
+      err->error_code = 2027;  // CR_MALFORMED_PACKET
+      err->error_text = "malformed PREPARE-OK";
+    }
     return -1;
   }
   // PREPARE-OK: [00] stmt_id u32 | num_columns u16 | num_params u16 |
@@ -526,6 +673,7 @@ int MysqlClient::Prepare(const std::string& sql, Stmt* out) {
             | (static_cast<uint32_t>(p[4]) << 24);
   out->n_cols = static_cast<uint16_t>(p[5] | (p[6] << 8));
   out->n_params = static_cast<uint16_t>(p[7] | (p[8] << 8));
+  out->session = session_gen_;
   for (int section = 0; section < 2; ++section) {
     const int defs = section == 0 ? out->n_params : out->n_cols;
     if (defs == 0) {
@@ -567,10 +715,25 @@ MysqlClient::Result MysqlClient::ExecuteStmt(
     r.error_text = "not connected";
     return r;
   }
+  if (stmt.session != session_gen_) {
+    // The handle was prepared on a connection that has since died; the
+    // fresh session does not know the id — surface that instead of the
+    // server's "unknown prepared statement handler".
+    r.error_code = 2030;  // CR_NO_PREPARE_STMT
+    r.error_text = "statement invalidated by reconnect; re-Prepare";
+    return r;
+  }
   if (params.size() != stmt.n_params) {
     r.error_code = 2031;  // CR_PARAMS_NOT_BOUND
     r.error_text = "parameter count mismatch";
     return r;
+  }
+  for (const auto& param : params) {
+    if (param.has_value() && param->size() >= (1u << 24)) {
+      r.error_code = 2027;  // CR_MALFORMED_PACKET (would need lenenc-8)
+      r.error_text = "parameter exceeds 16MB";
+      return r;
+    }
   }
   std::string req(1, static_cast<char>(kComStmtExecute));
   put_u32le(&req, stmt.id);
@@ -630,109 +793,10 @@ MysqlClient::Result MysqlClient::ExecuteStmt(
     }
     return r;
   }
-  // Binary resultset: column count, defs + EOF, binary rows + EOF.
-  size_t pos = 0;
-  uint64_t ncols = 0;
-  std::vector<uint8_t> col_types;
-  if (!get_lenenc(pkt, &pos, &ncols) || ncols == 0 || ncols > 4096) {
+  // Binary resultset: shared reader (typed binary rows).
+  if (read_resultset(fd_, pkt, deadline, /*binary=*/true, &r) != 0) {
     drop_connection();
-    r.error_text = "malformed resultset header";
-    return r;
   }
-  for (uint64_t i = 0; i < ncols; ++i) {
-    if (read_packet(fd_, &pkt, &seq, deadline) != 0) {
-      drop_connection();
-      r.error_text = "short column definitions";
-      return r;
-    }
-    size_t cp = 0;
-    std::string skip, name;
-    uint8_t ctype = kTypeVarString;
-    if (get_lenenc_str(pkt, &cp, &skip) && get_lenenc_str(pkt, &cp, &skip) &&
-        get_lenenc_str(pkt, &cp, &skip) && get_lenenc_str(pkt, &cp, &skip) &&
-        get_lenenc_str(pkt, &cp, &name) && get_lenenc_str(pkt, &cp, &skip) &&
-        pkt.size() >= cp + 8) {
-      // fixed part: 0x0c, charset u16, length u32, TYPE u8 at +7.
-      ctype = static_cast<uint8_t>(pkt[cp + 7]);
-      r.columns.push_back(std::move(name));
-    } else {
-      r.columns.push_back("col" + std::to_string(i));
-    }
-    col_types.push_back(ctype);
-  }
-  if (read_packet(fd_, &pkt, &seq, deadline) != 0 || !is_eof_packet(pkt)) {
-    drop_connection();
-    r.error_text = "missing EOF after column definitions";
-    return r;
-  }
-  while (true) {
-    if (read_packet(fd_, &pkt, &seq, deadline) != 0) {
-      drop_connection();
-      r.error_text = "short resultset";
-      return r;
-    }
-    if (is_eof_packet(pkt)) {
-      break;
-    }
-    if (static_cast<uint8_t>(pkt[0]) == 0xff) {
-      parse_err(pkt, &r);
-      return r;
-    }
-    if (static_cast<uint8_t>(pkt[0]) != 0x00) {
-      drop_connection();
-      r.error_text = "malformed binary row";
-      return r;
-    }
-    // Binary row: [00] null-bitmap (offset 2) then typed values.
-    const size_t bitmap_len = (ncols + 7 + 2) / 8;
-    if (pkt.size() < 1 + bitmap_len) {
-      drop_connection();
-      r.error_text = "short binary row";
-      return r;
-    }
-    const uint8_t* bm = reinterpret_cast<const uint8_t*>(pkt.data()) + 1;
-    size_t rp = 1 + bitmap_len;
-    std::vector<std::optional<std::string>> row;
-    bool bad = false;
-    for (uint64_t i = 0; i < ncols && !bad; ++i) {
-      const size_t bit = i + 2;
-      if (bm[bit / 8] & (1 << (bit % 8))) {
-        row.emplace_back(std::nullopt);
-        continue;
-      }
-      switch (col_types[i]) {
-        case kTypeLong: {
-          if (pkt.size() - rp < 4) { bad = true; break; }
-          int32_t v;
-          std::memcpy(&v, pkt.data() + rp, 4);
-          rp += 4;
-          row.emplace_back(std::to_string(v));
-          break;
-        }
-        case kTypeLongLong: {
-          if (pkt.size() - rp < 8) { bad = true; break; }
-          int64_t v;
-          std::memcpy(&v, pkt.data() + rp, 8);
-          rp += 8;
-          row.emplace_back(std::to_string(v));
-          break;
-        }
-        default: {  // string-ish types: lenenc payload
-          std::string cell;
-          if (!get_lenenc_str(pkt, &rp, &cell)) { bad = true; break; }
-          row.emplace_back(std::move(cell));
-          break;
-        }
-      }
-    }
-    if (bad) {
-      drop_connection();
-      r.error_text = "malformed binary row";
-      return r;
-    }
-    r.rows.push_back(std::move(row));
-  }
-  r.ok = true;
   return r;
 }
 

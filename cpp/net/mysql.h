@@ -67,8 +67,12 @@ class MysqlClient {
     uint32_t id = 0;
     uint16_t n_params = 0;
     uint16_t n_cols = 0;
+    uint64_t session = 0;  // connection generation; invalidated on drop
   };
-  int Prepare(const std::string& sql, Stmt* out);
+  // err (optional) receives server-side failure details (the connection
+  // stays healthy on an ERR reply — a syntax error must not roll back
+  // an open transaction by dropping the session).
+  int Prepare(const std::string& sql, Stmt* out, Result* err = nullptr);
   Result ExecuteStmt(const Stmt& stmt,
                      const std::vector<std::optional<std::string>>& params);
   void CloseStmt(const Stmt& stmt);  // fire-and-forget COM_STMT_CLOSE
@@ -91,6 +95,7 @@ class MysqlClient {
   Options opts_;
   FiberMutex mu_;  // the whole conversation is serialized
   int fd_ = -1;
+  uint64_t session_gen_ = 0;  // bumped on drop; stamps Stmt handles
 };
 
 }  // namespace trpc
