@@ -1,0 +1,137 @@
+#include "net/couchbase.h"
+
+#include <zlib.h>
+
+namespace trpc {
+
+uint16_t couchbase_vbucket_of(const std::string& key, int n_vbuckets) {
+  const uint32_t crc = static_cast<uint32_t>(
+      crc32(0, reinterpret_cast<const Bytef*>(key.data()),
+            static_cast<uInt>(key.size())));
+  return static_cast<uint16_t>((crc >> 16) & (n_vbuckets - 1));
+}
+
+int CouchbaseClient::Init(const std::vector<std::string>& nodes,
+                          const Options* opts) {
+  if (nodes.empty()) {
+    return -1;
+  }
+  if (opts != nullptr) {
+    opts_ = *opts;
+  }
+  if (opts_.n_vbuckets <= 0 ||
+      (opts_.n_vbuckets & (opts_.n_vbuckets - 1)) != 0) {
+    return -1;
+  }
+  nodes_ = nodes;
+  map_.resize(opts_.n_vbuckets);
+  for (int vb = 0; vb < opts_.n_vbuckets; ++vb) {
+    map_[vb] = vb % static_cast<int>(nodes_.size());
+  }
+  return 0;
+}
+
+int CouchbaseClient::set_vbucket_map(const std::vector<int>& map) {
+  if (static_cast<int>(map.size()) != opts_.n_vbuckets) {
+    return -1;
+  }
+  for (int idx : map) {
+    if (idx < 0 || idx >= static_cast<int>(nodes_.size())) {
+      return -1;
+    }
+  }
+  LockGuard<FiberMutex> g(mu_);
+  map_ = map;
+  return 0;
+}
+
+int CouchbaseClient::vbucket_node(int vb) {
+  LockGuard<FiberMutex> g(mu_);
+  return (vb >= 0 && vb < static_cast<int>(map_.size())) ? map_[vb] : -1;
+}
+
+MemcacheClient* CouchbaseClient::client_at(size_t node_idx) {
+  // Callers hold mu_.
+  auto it = pool_.find(node_idx);
+  if (it != pool_.end()) {
+    return it->second.get();
+  }
+  auto cli = std::make_unique<MemcacheClient>();
+  MemcacheClient::Options copts;
+  copts.timeout_ms = opts_.timeout_ms;
+  if (cli->Init(nodes_[node_idx], &copts) != 0) {
+    return nullptr;
+  }
+  return pool_.emplace(node_idx, std::move(cli)).first->second.get();
+}
+
+McResult CouchbaseClient::route(McCommand cmd) {
+  cmd.vbucket = couchbase_vbucket_of(cmd.key, opts_.n_vbuckets);
+  size_t first;
+  {
+    LockGuard<FiberMutex> g(mu_);
+    first = static_cast<size_t>(map_[cmd.vbucket]);
+  }
+  McResult last;
+  for (size_t probe = 0; probe < nodes_.size(); ++probe) {
+    const size_t idx = (first + probe) % nodes_.size();
+    MemcacheClient* cli;
+    {
+      LockGuard<FiberMutex> g(mu_);
+      cli = client_at(idx);
+    }
+    if (cli == nullptr) {
+      last.status = McStatus::kRemoteError;
+      last.value = "cannot reach " + nodes_[idx];
+      continue;
+    }
+    last = cli->batch({cmd}).front();
+    if (last.status != McStatus::kNotMyVbucket) {
+      if (probe != 0) {
+        LockGuard<FiberMutex> g(mu_);
+        map_[cmd.vbucket] = static_cast<int>(idx);  // learned ownership
+      }
+      return last;
+    }
+  }
+  return last;  // every node declined the vbucket
+}
+
+McResult CouchbaseClient::Get(const std::string& key) {
+  McCommand c;
+  c.op = McOp::kGet;
+  c.key = key;
+  return route(std::move(c));
+}
+
+McResult CouchbaseClient::Set(const std::string& key,
+                              const std::string& value, uint32_t flags,
+                              uint32_t exptime, uint64_t cas) {
+  McCommand c;
+  c.op = McOp::kSet;
+  c.key = key;
+  c.value = value;
+  c.flags = flags;
+  c.exptime = exptime;
+  c.cas = cas;
+  return route(std::move(c));
+}
+
+McResult CouchbaseClient::Delete(const std::string& key) {
+  McCommand c;
+  c.op = McOp::kDelete;
+  c.key = key;
+  return route(std::move(c));
+}
+
+McResult CouchbaseClient::Increment(const std::string& key,
+                                    uint64_t delta, uint64_t initial) {
+  McCommand c;
+  c.op = McOp::kIncrement;
+  c.key = key;
+  c.delta = delta;
+  c.initial = initial;
+  return route(std::move(c));
+}
+
+}  // namespace trpc

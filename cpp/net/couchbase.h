@@ -1,0 +1,70 @@
+// Couchbase client: vbucket-aware routing over the memcache binary
+// substrate.
+//
+// Parity: /root/reference/src/brpc/couchbase.* +
+// policy/couchbase_protocol.* (~3.3k LoC, fork extension) — data ops are
+// memcache binary frames carrying a vbucket id in the header; the
+// client hashes keys to vbuckets (CRC32 >> 16, masked), routes each op
+// to the node the vBucketMap assigns, and on NOT_MY_VBUCKET (0x0007)
+// probes the other nodes and repairs the map entry (the reference
+// re-pulls the whole config; single-entry learning is the same
+// convergence without a config channel, which needs live cluster
+// infra this environment cannot reach).
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "fiber/sync.h"
+#include "net/memcache.h"
+
+namespace trpc {
+
+// vbucket of `key` under an n_vbuckets (power of two) map: standard
+// couchbase hash, IEEE CRC32 of the key, upper half, masked.
+uint16_t couchbase_vbucket_of(const std::string& key, int n_vbuckets);
+
+class CouchbaseClient {
+ public:
+  struct Options {
+    int64_t timeout_ms = 1000;
+    int n_vbuckets = 1024;  // must be a power of two
+  };
+
+  // `nodes` are "host:port" data nodes.  The initial vBucketMap is
+  // vb→nodes[vb % n] (tests and static deployments); real deployments
+  // install the cluster's map via set_vbucket_map.
+  int Init(const std::vector<std::string>& nodes,
+           const Options* opts = nullptr);
+
+  // Installs a full vb→node-index map (size must equal n_vbuckets,
+  // entries index `nodes`).  Returns 0 on success.
+  int set_vbucket_map(const std::vector<int>& map);
+
+  // Current node index of `vb` (diagnostics/tests).
+  int vbucket_node(int vb);
+
+  McResult Get(const std::string& key);
+  McResult Set(const std::string& key, const std::string& value,
+               uint32_t flags = 0, uint32_t exptime = 0, uint64_t cas = 0);
+  McResult Delete(const std::string& key);
+  McResult Increment(const std::string& key, uint64_t delta,
+                     uint64_t initial = 0);
+
+ private:
+  // Routes one keyed command: map-assigned node first, then linear
+  // probe of the rest on NOT_MY_VBUCKET, repairing the map on success.
+  McResult route(McCommand cmd);
+  MemcacheClient* client_at(size_t node_idx);
+
+  Options opts_;
+  std::vector<std::string> nodes_;
+  FiberMutex mu_;  // guards map_ and pool_
+  std::vector<int> map_;
+  std::map<size_t, std::unique_ptr<MemcacheClient>> pool_;
+};
+
+}  // namespace trpc

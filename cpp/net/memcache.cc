@@ -105,7 +105,7 @@ void mc_pack_request(const McCommand& cmd, uint32_t opaque,
     default:
       break;
   }
-  pack_frame(kMagicRequest, cmd.op, /*vbucket=*/0, opaque, cmd.cas,
+  pack_frame(kMagicRequest, cmd.op, cmd.vbucket, opaque, cmd.cas,
              extras, cmd.key, value, out);
 }
 
@@ -168,6 +168,12 @@ size_t MemcacheService::item_count() {
 McResult MemcacheService::Execute(const McCommand& cmd) {
   McResult r;
   LockGuard<FiberMutex> g(mu_);
+  if (vbucket_filter_ && !cmd.key.empty() &&
+      !vbucket_filter_(cmd.vbucket)) {
+    r.status = McStatus::kNotMyVbucket;
+    r.value = "not my vbucket";
+    return r;
+  }
   auto it = items_.find(cmd.key);
   if (it != items_.end() && expired_locked(it->second)) {
     // Lazy reclamation: an expired entry is erased the moment any op
@@ -408,6 +414,7 @@ void mc_process_request(InputMessage&& msg) {
   cmd.key = std::move(f->key);
   cmd.value = f->value.to_string();  // the service API stores strings
   cmd.cas = f->cas;
+  cmd.vbucket = f->status_or_vbucket;  // request header: vbucket id
   const uint8_t* ex = reinterpret_cast<const uint8_t*>(f->extras.data());
   switch (f->op) {
     case McOp::kSet:

@@ -60,6 +60,7 @@ enum class McStatus : uint16_t {
   kExists = 0x0002,          // CAS mismatch
   kNotStored = 0x0005,       // ADD on present / REPLACE on absent
   kDeltaBadValue = 0x0006,
+  kNotMyVbucket = 0x0007,    // couchbase: routed to a non-owning node
   kUnknownCommand = 0x0081,
   kRemoteError = 0x0084,     // client-side transport failures map here
 };
@@ -74,6 +75,7 @@ struct McCommand {
   uint64_t cas = 0;        // 0 = unconditional
   uint64_t delta = 1;      // incr/decr
   uint64_t initial = 0;    // incr/decr when key absent
+  uint16_t vbucket = 0;    // couchbase routing (plain memcache: 0)
 };
 
 // One result (server -> client).
@@ -121,6 +123,15 @@ class MemcacheService {
   // reclaimed lazily when an op touches the key).
   size_t item_count();
 
+  // Couchbase-style ownership gate: when set, keyed ops whose vbucket
+  // the filter rejects answer kNotMyVbucket instead of executing
+  // (reference: policy/couchbase_protocol.* routes by the header's
+  // vbucket field; a real cluster node enforces exactly this).
+  void set_vbucket_filter(std::function<bool(uint16_t)> f) {
+    LockGuard<FiberMutex> g(mu_);  // rebalance can race live requests
+    vbucket_filter_ = std::move(f);
+  }
+
  private:
   struct Item {
     std::string value;
@@ -132,6 +143,7 @@ class MemcacheService {
   mutable FiberMutex mu_;
   std::map<std::string, Item> items_;
   uint64_t next_cas_ = 1;
+  std::function<bool(uint16_t)> vbucket_filter_;
 };
 
 // Registers the memcache server protocol (idempotent); Server::Start

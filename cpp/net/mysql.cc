@@ -90,19 +90,34 @@ int write_all(int fd, const void* buf, size_t n, int64_t deadline_us) {
 
 // ---- packet layer --------------------------------------------------------
 
+// The 3-byte length field caps one wire packet at 0xffffff; larger
+// payloads travel as a run of full chunks terminated by a short
+// (possibly empty) one, with consecutive sequence numbers.  Both ends
+// here speak that splitting, so payloads up to kMaxPacket are safe.
+constexpr size_t kChunk = 0xffffff;
+
 int read_packet(int fd, std::string* payload, uint8_t* seq,
                 int64_t deadline_us) {
-  uint8_t head[4];
-  if (read_n(fd, head, 4, deadline_us) != 0) {
-    return -1;
+  payload->clear();
+  while (true) {
+    uint8_t head[4];
+    if (read_n(fd, head, 4, deadline_us) != 0) {
+      return -1;
+    }
+    const uint32_t len = head[0] | (head[1] << 8) | (head[2] << 16);
+    *seq = head[3];
+    if (payload->size() + len > kMaxPacket) {
+      return -1;
+    }
+    const size_t old = payload->size();
+    payload->resize(old + len);
+    if (read_n(fd, payload->data() + old, len, deadline_us) != 0) {
+      return -1;
+    }
+    if (len < kChunk) {
+      return 0;
+    }
   }
-  const uint32_t len = head[0] | (head[1] << 8) | (head[2] << 16);
-  *seq = head[3];
-  if (len > kMaxPacket) {
-    return -1;
-  }
-  payload->resize(len);
-  return read_n(fd, payload->data(), len, deadline_us);
 }
 
 int write_packet(int fd, const std::string& payload, uint8_t seq,
@@ -110,13 +125,21 @@ int write_packet(int fd, const std::string& payload, uint8_t seq,
   if (payload.size() > kMaxPacket) {
     return -1;
   }
-  uint8_t head[4] = {static_cast<uint8_t>(payload.size()),
-                     static_cast<uint8_t>(payload.size() >> 8),
-                     static_cast<uint8_t>(payload.size() >> 16), seq};
-  if (write_all(fd, head, 4, deadline_us) != 0) {
-    return -1;
+  size_t off = 0;
+  while (true) {
+    const size_t n = std::min(kChunk, payload.size() - off);
+    uint8_t head[4] = {static_cast<uint8_t>(n),
+                       static_cast<uint8_t>(n >> 8),
+                       static_cast<uint8_t>(n >> 16), seq++};
+    if (write_all(fd, head, 4, deadline_us) != 0 ||
+        write_all(fd, payload.data() + off, n, deadline_us) != 0) {
+      return -1;
+    }
+    off += n;
+    if (n < kChunk) {  // a short packet terminates the run
+      return 0;
+    }
   }
-  return write_all(fd, payload.data(), payload.size(), deadline_us);
 }
 
 // ---- primitive readers ---------------------------------------------------
@@ -680,12 +703,13 @@ int MysqlClient::Prepare(const std::string& sql, Stmt* out, Result* err) {
       continue;
     }
     for (int i = 0; i <= defs; ++i) {  // defs + trailing EOF
-      if (read_packet(fd_, &pkt, &seq, deadline) != 0) {
+      if (read_packet(fd_, &pkt, &seq, deadline) != 0 ||
+          (i == defs && !is_eof_packet(pkt))) {
         drop_connection();
-        return -1;
-      }
-      if (i == defs && !is_eof_packet(pkt)) {
-        drop_connection();
+        if (err != nullptr) {
+          err->error_code = 2013;  // CR_SERVER_LOST (mid-definitions)
+          err->error_text = "lost connection draining statement defs";
+        }
         return -1;
       }
     }
@@ -695,7 +719,10 @@ int MysqlClient::Prepare(const std::string& sql, Stmt* out, Result* err) {
 
 void MysqlClient::CloseStmt(const Stmt& stmt) {
   LockGuard<FiberMutex> g(mu_);
-  if (fd_ < 0) {
+  if (fd_ < 0 || stmt.session != session_gen_) {
+    // A handle from before a reconnect must not be closed on the fresh
+    // session: the server may have reassigned the id to a live
+    // statement, and COM_STMT_CLOSE would silently destroy that one.
     return;
   }
   std::string req(1, static_cast<char>(kComStmtClose));

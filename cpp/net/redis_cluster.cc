@@ -1,0 +1,227 @@
+#include "net/redis_cluster.h"
+
+#include <cstring>
+
+namespace trpc {
+
+namespace {
+
+// CRC16-CCITT table, generated from poly 0x1021 (the redis cluster spec
+// appendix publishes this exact table; it is derivable from the poly).
+uint16_t crc16_tab[256];
+bool crc16_init = [] {
+  for (int i = 0; i < 256; ++i) {
+    uint16_t c = static_cast<uint16_t>(i << 8);
+    for (int b = 0; b < 8; ++b) {
+      c = static_cast<uint16_t>((c << 1) ^ ((c & 0x8000) ? 0x1021 : 0));
+    }
+    crc16_tab[i] = c;
+  }
+  return true;
+}();
+
+bool parse_redirect(const std::string& err, const char* kind,
+                    std::string* addr, int* slot) {
+  // "MOVED 3999 127.0.0.1:6381" / "ASK 3999 127.0.0.1:6381"
+  const size_t klen = strlen(kind);
+  if (err.compare(0, klen, kind) != 0 || err.size() <= klen ||
+      err[klen] != ' ') {
+    return false;
+  }
+  const size_t slot_beg = klen + 1;
+  const size_t sp = err.find(' ', slot_beg);
+  if (sp == std::string::npos || sp + 1 >= err.size()) {
+    return false;
+  }
+  char* end = nullptr;
+  const long s = strtol(err.c_str() + slot_beg, &end, 10);
+  if (end != err.c_str() + sp || s < 0 ||
+      s >= RedisClusterClient::kSlots) {
+    return false;
+  }
+  *slot = static_cast<int>(s);
+  *addr = err.substr(sp + 1);
+  return true;
+}
+
+}  // namespace
+
+uint16_t redis_crc16(const char* data, size_t len) {
+  uint16_t crc = 0;
+  for (size_t i = 0; i < len; ++i) {
+    crc = static_cast<uint16_t>(
+        (crc << 8) ^
+        crc16_tab[((crc >> 8) ^ static_cast<uint8_t>(data[i])) & 0xff]);
+  }
+  return crc;
+}
+
+uint16_t redis_key_slot(const std::string& key) {
+  size_t beg = 0, len = key.size();
+  const size_t open = key.find('{');
+  if (open != std::string::npos) {
+    const size_t close = key.find('}', open + 1);
+    if (close != std::string::npos && close > open + 1) {
+      beg = open + 1;
+      len = close - beg;  // non-empty tag: hash only the tag
+    }
+  }
+  return redis_crc16(key.data() + beg, len) % RedisClusterClient::kSlots;
+}
+
+int RedisClusterClient::Init(const std::vector<std::string>& seeds,
+                             const Options* opts) {
+  if (seeds.empty()) {
+    return -1;
+  }
+  if (opts != nullptr) {
+    opts_ = *opts;
+  }
+  seeds_ = seeds;
+  slots_.assign(kSlots, std::string());
+  return 0;
+}
+
+RedisClient* RedisClusterClient::client_for(const std::string& addr) {
+  // Callers hold mu_.
+  auto it = pool_.find(addr);
+  if (it != pool_.end()) {
+    return it->second.get();
+  }
+  auto cli = std::make_unique<RedisClient>();
+  RedisClient::Options copts;
+  copts.timeout_ms = opts_.timeout_ms;
+  copts.password = opts_.password;
+  if (cli->Init(addr, &copts) != 0) {
+    return nullptr;
+  }
+  return pool_.emplace(addr, std::move(cli)).first->second.get();
+}
+
+int RedisClusterClient::RefreshSlotMap() {
+  // CLUSTER SLOTS reply: array of [start, end, [ip, port, ...master],
+  // ...replicas].  Any answering node serves; replicas are ignored —
+  // this client routes to masters only, like the reference.
+  std::vector<std::string> nodes;
+  {
+    LockGuard<FiberMutex> g(mu_);
+    for (const auto& kv : pool_) {
+      nodes.push_back(kv.first);
+    }
+  }
+  nodes.insert(nodes.end(), seeds_.begin(), seeds_.end());
+  for (const auto& addr : nodes) {
+    RedisClient* cli;
+    {
+      LockGuard<FiberMutex> g(mu_);
+      cli = client_for(addr);
+    }
+    if (cli == nullptr) {
+      continue;
+    }
+    RedisReply r = cli->execute({"CLUSTER", "SLOTS"});
+    if (r.type != RedisReply::kArray || r.elements.empty()) {
+      continue;
+    }
+    LockGuard<FiberMutex> g(mu_);
+    bool any = false;
+    for (const RedisReply& range : r.elements) {
+      if (range.type != RedisReply::kArray || range.elements.size() < 3 ||
+          range.elements[0].type != RedisReply::kInteger ||
+          range.elements[1].type != RedisReply::kInteger ||
+          range.elements[2].type != RedisReply::kArray ||
+          range.elements[2].elements.size() < 2) {
+        continue;
+      }
+      const int64_t beg = range.elements[0].integer;
+      const int64_t end = range.elements[1].integer;
+      const RedisReply& master = range.elements[2];
+      if (beg < 0 || end >= kSlots || beg > end) {
+        continue;
+      }
+      const std::string owner = master.elements[0].str + ":" +
+                                std::to_string(master.elements[1].integer);
+      for (int64_t s = beg; s <= end; ++s) {
+        slots_[s] = owner;
+      }
+      any = true;
+    }
+    if (any) {
+      return 0;
+    }
+  }
+  return -1;
+}
+
+std::string RedisClusterClient::slot_owner(int slot) {
+  LockGuard<FiberMutex> g(mu_);
+  return (slot >= 0 && slot < kSlots) ? slots_[slot] : std::string();
+}
+
+RedisReply RedisClusterClient::execute(
+    const std::vector<std::string>& args) {
+  if (args.empty()) {
+    return RedisReply::Error("(client) empty command");
+  }
+  const bool keyed = args.size() > 1;
+  const int slot = keyed ? redis_key_slot(args[1]) : -1;
+
+  std::string target;
+  if (keyed) {
+    LockGuard<FiberMutex> g(mu_);
+    target = slots_[slot];
+  }
+  if (target.empty()) {
+    if (keyed && RefreshSlotMap() == 0) {
+      LockGuard<FiberMutex> g(mu_);
+      target = slots_[slot];
+    }
+    if (target.empty()) {
+      target = seeds_[0];
+    }
+  }
+
+  bool asking = false;
+  RedisReply last;
+  for (int hop = 0; hop <= opts_.max_redirects; ++hop) {
+    RedisClient* cli;
+    {
+      LockGuard<FiberMutex> g(mu_);
+      cli = client_for(target);
+    }
+    if (cli == nullptr) {
+      return RedisReply::Error("(client) cannot reach " + target);
+    }
+    if (asking) {
+      // ASK is one-shot: the target only serves the key when the command
+      // is preceded by ASKING on the same connection.
+      std::vector<RedisReply> rs = cli->pipeline({{"ASKING"}, args});
+      last = rs.size() > 1 ? std::move(rs[1])
+                           : RedisReply::Error("(client) short pipeline");
+      asking = false;
+    } else {
+      last = cli->execute(args);
+    }
+    std::string next;
+    int moved_slot = 0;
+    if (last.is_error() &&
+        parse_redirect(last.str, "MOVED", &next, &moved_slot)) {
+      {
+        LockGuard<FiberMutex> g(mu_);
+        slots_[moved_slot] = next;  // permanent: table was stale
+      }
+      target = std::move(next);
+      continue;
+    }
+    if (last.is_error() &&
+        parse_redirect(last.str, "ASK", &next, &moved_slot)) {
+      target = std::move(next);  // one-shot: table stays
+      asking = true;
+      continue;
+    }
+    return last;
+  }
+  return last;  // redirect budget exhausted: surface the loop
+}
+
+}  // namespace trpc

@@ -1,0 +1,67 @@
+// Redis Cluster client: slot-mapped routing with MOVED/ASK redirects.
+//
+// Parity: /root/reference/src/brpc/redis_cluster.cpp (1,219 LoC) keeps a
+// slot→node table refreshed from CLUSTER SLOTS and re-issues commands on
+// -MOVED (permanent, update the table) / -ASK (one-shot, prefix ASKING)
+// redirect errors.  Condensed form here: a pool of pipelined RedisClients
+// keyed by node address, a 16384-entry owner table under a mutex, and a
+// bounded redirect loop per command.  Slot hashing is the spec's
+// CRC16-CCITT over the {hash tag} when present.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "fiber/sync.h"
+#include "net/redis.h"
+
+namespace trpc {
+
+// CRC16-CCITT (XMODEM: poly 0x1021, init 0) — the redis cluster spec hash.
+uint16_t redis_crc16(const char* data, size_t len);
+
+// Slot of `key`: honours {hash tags} (first '{' with a non-empty segment
+// before the next '}' hashes only that segment).  Range [0, 16384).
+uint16_t redis_key_slot(const std::string& key);
+
+class RedisClusterClient {
+ public:
+  static constexpr int kSlots = 16384;
+
+  struct Options {
+    int64_t timeout_ms = 1000;
+    std::string password;  // forwarded to every node connection
+    int max_redirects = 5;
+  };
+
+  // Seeds are "host:port" of any cluster members; the slot map is pulled
+  // lazily from them (CLUSTER SLOTS) on first use and after MOVED.
+  int Init(const std::vector<std::string>& seeds,
+           const Options* opts = nullptr);
+
+  // Routes by the command's first key (args[1]); keyless commands go to
+  // the first healthy node.  Redirects are followed up to max_redirects;
+  // exceeding that returns the last redirect error verbatim.
+  RedisReply execute(const std::vector<std::string>& args);
+
+  // Re-pulls the slot table from the first seed/node that answers
+  // CLUSTER SLOTS.  0 on success.  Called lazily; exposed for tests.
+  int RefreshSlotMap();
+
+  // Current owner of `slot` ("" when unknown).  For tests/diagnostics.
+  std::string slot_owner(int slot);
+
+ private:
+  RedisClient* client_for(const std::string& addr);
+
+  Options opts_;
+  std::vector<std::string> seeds_;
+  FiberMutex mu_;  // guards slots_ and pool_
+  std::vector<std::string> slots_;
+  std::map<std::string, std::unique_ptr<RedisClient>> pool_;
+};
+
+}  // namespace trpc

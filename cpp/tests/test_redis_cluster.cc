@@ -1,0 +1,249 @@
+// Redis Cluster client: spec CRC16/slot vectors, routing across a
+// simulated two-node cluster, MOVED (permanent) and ASK (one-shot)
+// redirects, and the redirect budget.  The "cluster" is two in-process
+// RedisService servers whose handlers enforce slot ownership the way
+// redis-server does (reference analogue: redis_cluster.cpp's unittest
+// drives a mock node answering MOVED/ASK).
+#include <map>
+#include <string>
+#include <vector>
+
+#include "net/redis.h"
+#include "net/redis_cluster.h"
+#include "net/server.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+namespace {
+
+struct Node {
+  Server srv;
+  std::map<std::string, std::string> store;
+  int slot_beg = 0, slot_end = 0;  // inclusive ownership range
+  std::string addr;
+  int moved_served = 0;  // how many MOVED errors this node issued
+};
+
+Node* node_a() {
+  static Node n;
+  return &n;
+}
+Node* node_b() {
+  static Node n;
+  return &n;
+}
+// Consumed by node B's get when a key was announced via ASKING.
+bool g_asking = false;
+// When non-empty, node A answers ASK→B for exactly this key (simulating
+// a slot mid-migration: A still owns it, the key already moved).
+std::string g_ask_key;
+
+// CLUSTER SLOTS reply advertising `lie_all` = this node owns everything
+// (a deliberately stale map, to force MOVED discovery).
+RedisReply slots_reply(Node* self, bool lie_all) {
+  auto range = [](int beg, int end, const std::string& addr) {
+    const size_t colon = addr.rfind(':');
+    return RedisReply::Array({
+        RedisReply::Integer(beg),
+        RedisReply::Integer(end),
+        RedisReply::Array({
+            RedisReply::Bulk(addr.substr(0, colon)),
+            RedisReply::Integer(atoi(addr.c_str() + colon + 1)),
+        }),
+    });
+  };
+  if (lie_all) {
+    return RedisReply::Array({range(0, 16383, self->addr)});
+  }
+  return RedisReply::Array({
+      range(node_a()->slot_beg, node_a()->slot_end, node_a()->addr),
+      range(node_b()->slot_beg, node_b()->slot_end, node_b()->addr),
+  });
+}
+
+void start_node(Node* n, int beg, int end, bool lie_all) {
+  n->slot_beg = beg;
+  n->slot_end = end;
+  auto* rs = new RedisService();
+  rs->AddCommandHandler(
+      "cluster", [n, lie_all](const std::vector<std::string>& a) {
+        if (a.size() >= 2 && (a[1] == "SLOTS" || a[1] == "slots")) {
+          return slots_reply(n, lie_all);
+        }
+        return RedisReply::Error("ERR unsupported subcommand");
+      });
+  rs->AddCommandHandler("asking", [](const std::vector<std::string>&) {
+    g_asking = true;
+    return RedisReply::Status("OK");
+  });
+  auto owned = [n](const std::string& key) {
+    const int s = redis_key_slot(key);
+    return s >= n->slot_beg && s <= n->slot_end;
+  };
+  auto moved = [n](const std::string& key) {
+    Node* other = (n == node_a()) ? node_b() : node_a();
+    ++n->moved_served;
+    return RedisReply::Error("MOVED " +
+                             std::to_string(redis_key_slot(key)) + " " +
+                             other->addr);
+  };
+  rs->AddCommandHandler(
+      "set", [n, owned, moved](const std::vector<std::string>& a) {
+        if (a.size() != 3) {
+          return RedisReply::Error("ERR wrong number of arguments");
+        }
+        if (!owned(a[1])) {
+          return moved(a[1]);
+        }
+        n->store[a[1]] = a[2];
+        return RedisReply::Status("OK");
+      });
+  rs->AddCommandHandler(
+      "get", [n, owned, moved](const std::vector<std::string>& a) {
+        if (a.size() != 2) {
+          return RedisReply::Error("ERR wrong number of arguments");
+        }
+        if (n == node_a() && !g_ask_key.empty() && a[1] == g_ask_key) {
+          return RedisReply::Error(
+              "ASK " + std::to_string(redis_key_slot(a[1])) + " " +
+              node_b()->addr);
+        }
+        // An ASKING announcement lets a key through even when the slot
+        // map says it moved on (migration import, redis semantics).
+        if (!owned(a[1]) && !g_asking) {
+          return moved(a[1]);
+        }
+        g_asking = false;
+        auto it = n->store.find(a[1]);
+        return it == n->store.end() ? RedisReply::Nil()
+                                    : RedisReply::Bulk(it->second);
+      });
+  n->srv.set_redis_service(rs);
+  EXPECT_EQ(n->srv.Start(0), 0);
+  n->addr = "127.0.0.1:" + std::to_string(n->srv.port());
+}
+
+void start_cluster(bool lie_all = false) {
+  if (!node_a()->addr.empty()) {
+    return;
+  }
+  start_node(node_a(), 0, 8191, lie_all);
+  start_node(node_b(), 8192, 16383, lie_all);
+}
+
+}  // namespace
+
+TEST_CASE(crc16_and_slot_vectors) {
+  // XMODEM check value from the CRC catalogue; slots from the cluster
+  // spec ("foo"→12182, "bar"→5061, hash tags collapse to the tag).
+  EXPECT_EQ(redis_crc16("123456789", 9), 0x31C3);
+  EXPECT_EQ(redis_key_slot("foo"), 12182);
+  EXPECT_EQ(redis_key_slot("bar"), 5061);
+  EXPECT_EQ(redis_key_slot("{user1000}.following"),
+            redis_key_slot("{user1000}.followers"));
+  EXPECT_EQ(redis_key_slot("{user1000}.following"),
+            redis_key_slot("user1000"));
+  // Empty tag "{}" is NOT a tag: the whole key hashes.
+  EXPECT_EQ(redis_key_slot("foo{}{bar}"),
+            redis_crc16("foo{}{bar}", 10) % 16384);
+  // Only the FIRST '{' opens a candidate tag.
+  EXPECT_EQ(redis_key_slot("foo{{bar}}"), redis_crc16("{bar", 4) % 16384);
+}
+
+TEST_CASE(cluster_routes_by_slot) {
+  start_cluster();
+  RedisClusterClient cc;
+  EXPECT_EQ(cc.Init({node_a()->addr}), 0);
+  // "foo"→12182 lives on B, "bar"→5061 on A; both through one client.
+  EXPECT(cc.execute({"SET", "foo", "on-b"}).str == "OK");
+  EXPECT(cc.execute({"SET", "bar", "on-a"}).str == "OK");
+  EXPECT(node_b()->store["foo"] == "on-b");
+  EXPECT(node_a()->store["bar"] == "on-a");
+  EXPECT(cc.execute({"GET", "foo"}).str == "on-b");
+  // The map was learned from CLUSTER SLOTS, not from redirects.
+  EXPECT(cc.slot_owner(12182) == node_b()->addr);
+  EXPECT(cc.slot_owner(5061) == node_a()->addr);
+  EXPECT_EQ(node_a()->moved_served + node_b()->moved_served, 0);
+}
+
+TEST_CASE(moved_updates_map_once) {
+  start_cluster();
+  node_a()->moved_served = 0;
+  node_b()->moved_served = 0;
+  RedisClusterClient cc;
+  EXPECT_EQ(cc.Init({node_a()->addr}), 0);
+  // Pre-poison the map by executing once (learns truth), then simulate
+  // staleness: a fresh client whose first keyed command goes to the
+  // wrong node because we seed only A and skip refresh by using a
+  // keyless warm-up... simplest honest path: force the stale entry.
+  EXPECT(cc.execute({"SET", "foo", "v1"}).str == "OK");  // learns map
+  // Migrate "foo"'s slot to A behind the client's back.
+  node_a()->slot_beg = 0;
+  node_a()->slot_end = 16383;
+  node_b()->slot_beg = 1;
+  node_b()->slot_end = 0;  // owns nothing now
+  node_a()->store["foo"] = "v2";
+  // Stale map points at B; B answers MOVED→A; client retries at A and
+  // repairs the single slot entry.
+  EXPECT(cc.execute({"GET", "foo"}).str == "v2");
+  EXPECT_EQ(node_b()->moved_served, 1);
+  EXPECT(cc.slot_owner(12182) == node_a()->addr);
+  // Second hit goes straight to A: no further MOVED.
+  EXPECT(cc.execute({"GET", "foo"}).str == "v2");
+  EXPECT_EQ(node_b()->moved_served, 1);
+  // Restore the split for later cases.
+  node_a()->slot_beg = 0;
+  node_a()->slot_end = 8191;
+  node_b()->slot_beg = 8192;
+  node_b()->slot_end = 16383;
+  node_a()->store.erase("foo");
+}
+
+TEST_CASE(ask_is_one_shot) {
+  start_cluster();
+  RedisClusterClient cc;
+  EXPECT_EQ(cc.Init({node_a()->addr}), 0);
+  EXPECT(cc.execute({"SET", "bar", "migrating"}).str == "OK");  // on A
+  // A announces "bar" is mid-migration via ASK; B holds the value in
+  // its import buffer and serves it only behind ASKING ("bar"'s slot
+  // 5061 is outside B's range, so a bare GET at B would bounce).
+  g_ask_key = "bar";
+  node_b()->store["bar"] = "imported";
+  RedisReply r = cc.execute({"GET", "bar"});
+  EXPECT(r.str == "imported");
+  // One-shot: the slot map still points at A...
+  EXPECT(cc.slot_owner(5061) == node_a()->addr);
+  // ...and once migration "finishes" traffic flows to A again.
+  g_ask_key.clear();
+  EXPECT(cc.execute({"GET", "bar"}).str == "migrating");
+  node_b()->store.erase("bar");
+}
+
+TEST_CASE(redirect_budget_surfaces_loop) {
+  // Two nodes that each insist the other owns everything: the client
+  // must give up after max_redirects and surface the MOVED error.
+  start_cluster();
+  node_a()->moved_served = 0;
+  node_b()->moved_served = 0;
+  const int a_beg = node_a()->slot_beg, a_end = node_a()->slot_end;
+  const int b_beg = node_b()->slot_beg, b_end = node_b()->slot_end;
+  node_a()->slot_beg = 1;
+  node_a()->slot_end = 0;
+  node_b()->slot_beg = 1;
+  node_b()->slot_end = 0;
+  RedisClusterClient cc;
+  RedisClusterClient::Options opts;
+  opts.max_redirects = 3;
+  EXPECT_EQ(cc.Init({node_a()->addr}, &opts), 0);
+  RedisReply r = cc.execute({"GET", "foo"});
+  EXPECT(r.is_error());
+  EXPECT_EQ(r.str.compare(0, 5, "MOVED"), 0);
+  EXPECT_EQ(node_a()->moved_served + node_b()->moved_served, 4);  // 1+3
+  node_a()->slot_beg = a_beg;
+  node_a()->slot_end = a_end;
+  node_b()->slot_beg = b_beg;
+  node_b()->slot_end = b_end;
+}
+
+TEST_MAIN
