@@ -2,6 +2,8 @@
 
 #include <zlib.h>
 
+#include "base/snappy.h"
+
 #include <cstring>
 #include <vector>
 
@@ -106,8 +108,29 @@ bool zlib_decompress(const IOBuf& in, IOBuf* out, uint64_t limit) {
   return inflate_iobuf(in, out, 15, limit);
 }
 
+// Snappy's matcher needs random access to the uncompressed bytes, so
+// both directions flatten (the reference's snappy sink/source adapters
+// do the same internally for chained buffers).
+bool snappy_c(const IOBuf& in, IOBuf* out) {
+  const std::string flat = in.to_string();
+  std::string wire;
+  snappy_compress(flat.data(), flat.size(), &wire);
+  out->append(wire);
+  return true;
+}
+bool snappy_d(const IOBuf& in, IOBuf* out, uint64_t limit) {
+  const std::string flat = in.to_string();
+  std::string plain;
+  if (!snappy_decompress(flat.data(), flat.size(), &plain, limit)) {
+    return false;
+  }
+  out->append(plain);
+  return true;
+}
+
 const Compressor kGzipC = {"gzip", gzip_compress, gzip_decompress};
 const Compressor kZlibC = {"zlib", zlib_compress, zlib_decompress};
+const Compressor kSnappyC = {"snappy", snappy_c, snappy_d};
 
 // ---- crc32c -------------------------------------------------------------
 
@@ -169,6 +192,8 @@ const Compressor* find_compressor(CompressType type) {
       return &kGzipC;
     case CompressType::kZlib:
       return &kZlibC;
+    case CompressType::kSnappy:
+      return &kSnappyC;
     case CompressType::kNone:
     default:
       return nullptr;

@@ -5,8 +5,9 @@
 // registered global.cpp:421-433) and checksum handlers (crc32c,
 // policy/crc32c_checksum.*, global.cpp:435-441), negotiated per call via
 // the request meta.  Redesigned condensed: a fixed id → vtable table
-// (gzip + zlib via libz; snappy's library isn't in this image, slot kept),
-// and hardware-accelerated crc32c (SSE4.2) with a software fallback.
+// (gzip + zlib via libz; snappy implemented from the format spec in
+// base/snappy.* — its library isn't in this image), and
+// hardware-accelerated crc32c (SSE4.2) with a software fallback.
 #pragma once
 
 #include <cstdint>
@@ -21,6 +22,7 @@ enum class CompressType : uint8_t {
   kNone = 0,
   kGzip = 1,
   kZlib = 2,
+  kSnappy = 3,
 };
 
 struct Compressor {

@@ -1,0 +1,152 @@
+#include "base/sha256.h"
+
+#include <cstring>
+
+namespace trpc {
+
+namespace {
+
+// FIPS 180-4 constants: first 32 bits of the fractional parts of the
+// cube roots of the first 64 primes.
+const uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+    0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+    0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+    0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+    0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+    0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+    0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+    0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+    0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+    0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+    0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+struct Sha256Ctx {
+  uint32_t h[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                   0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  uint8_t block[64];
+  size_t block_len = 0;
+  uint64_t total = 0;
+
+  void process(const uint8_t* p) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; ++i) {
+      w[i] = (static_cast<uint32_t>(p[4 * i]) << 24) |
+             (static_cast<uint32_t>(p[4 * i + 1]) << 16) |
+             (static_cast<uint32_t>(p[4 * i + 2]) << 8) | p[4 * i + 3];
+    }
+    for (int i = 16; i < 64; ++i) {
+      const uint32_t s0 =
+          rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      const uint32_t s1 =
+          rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; ++i) {
+      const uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      const uint32_t ch = (e & f) ^ (~e & g);
+      const uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+      const uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      const uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      const uint32_t t2 = S0 + maj;
+      hh = g;
+      g = f;
+      f = e;
+      e = d + t1;
+      d = c;
+      c = b;
+      b = a;
+      a = t1 + t2;
+    }
+    h[0] += a;
+    h[1] += b;
+    h[2] += c;
+    h[3] += d;
+    h[4] += e;
+    h[5] += f;
+    h[6] += g;
+    h[7] += hh;
+  }
+
+  void update(const uint8_t* p, size_t n) {
+    total += n;
+    while (n > 0) {
+      if (block_len == 0 && n >= 64) {
+        process(p);
+        p += 64;
+        n -= 64;
+        continue;
+      }
+      const size_t take = n < 64 - block_len ? n : 64 - block_len;
+      memcpy(block + block_len, p, take);
+      block_len += take;
+      p += take;
+      n -= take;
+      if (block_len == 64) {
+        process(block);
+        block_len = 0;
+      }
+    }
+  }
+
+  void final(uint8_t out[32]) {
+    const uint64_t bits = total * 8;
+    const uint8_t one = 0x80;
+    update(&one, 1);
+    const uint8_t zero = 0;
+    while (block_len != 56) {
+      update(&zero, 1);
+    }
+    uint8_t len_be[8];
+    for (int i = 0; i < 8; ++i) {
+      len_be[i] = static_cast<uint8_t>(bits >> (56 - 8 * i));
+    }
+    update(len_be, 8);
+    for (int i = 0; i < 8; ++i) {
+      out[4 * i] = static_cast<uint8_t>(h[i] >> 24);
+      out[4 * i + 1] = static_cast<uint8_t>(h[i] >> 16);
+      out[4 * i + 2] = static_cast<uint8_t>(h[i] >> 8);
+      out[4 * i + 3] = static_cast<uint8_t>(h[i]);
+    }
+  }
+};
+
+}  // namespace
+
+void sha256(const void* data, size_t n, uint8_t out[kSha256Size]) {
+  Sha256Ctx ctx;
+  ctx.update(static_cast<const uint8_t*>(data), n);
+  ctx.final(out);
+}
+
+void hmac_sha256(const void* key, size_t key_len, const void* data,
+                 size_t n, uint8_t out[kSha256Size]) {
+  uint8_t k[64] = {0};
+  if (key_len > 64) {
+    sha256(key, key_len, k);
+  } else {
+    memcpy(k, key, key_len);
+  }
+  uint8_t ipad[64], opad[64];
+  for (int i = 0; i < 64; ++i) {
+    ipad[i] = k[i] ^ 0x36;
+    opad[i] = k[i] ^ 0x5c;
+  }
+  uint8_t inner[kSha256Size];
+  Sha256Ctx ictx;
+  ictx.update(ipad, 64);
+  ictx.update(static_cast<const uint8_t*>(data), n);
+  ictx.final(inner);
+  Sha256Ctx octx;
+  octx.update(opad, 64);
+  octx.update(inner, kSha256Size);
+  octx.final(out);
+}
+
+}  // namespace trpc

@@ -1,0 +1,19 @@
+// SHA-256 (FIPS 180-4) + HMAC-SHA256 (RFC 2104), self-contained — the
+// image ships no OpenSSL headers, and the RTMP digest handshake plus
+// future signature needs want a hash that doesn't dlopen anything.
+// Verified against NIST/RFC 4231 vectors in tests.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace trpc {
+
+constexpr size_t kSha256Size = 32;
+
+void sha256(const void* data, size_t n, uint8_t out[kSha256Size]);
+
+void hmac_sha256(const void* key, size_t key_len, const void* data,
+                 size_t n, uint8_t out[kSha256Size]);
+
+}  // namespace trpc

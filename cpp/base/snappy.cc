@@ -1,0 +1,201 @@
+#include "base/snappy.h"
+
+#include <cstring>
+
+namespace trpc {
+
+namespace {
+
+constexpr size_t kFragment = 65536;  // matcher window; offsets fit 16 bits
+constexpr int kHashBits = 14;
+
+void put_varint32(std::string* out, uint32_t v) {
+  while (v >= 0x80) {
+    out->push_back(static_cast<char>(v | 0x80));
+    v >>= 7;
+  }
+  out->push_back(static_cast<char>(v));
+}
+
+bool get_varint32(const char* in, size_t n, size_t* pos, uint32_t* out) {
+  uint32_t v = 0;
+  for (int shift = 0; shift < 35; shift += 7) {
+    if (*pos >= n) {
+      return false;
+    }
+    const uint8_t b = static_cast<uint8_t>(in[(*pos)++]);
+    v |= static_cast<uint32_t>(b & 0x7f) << shift;
+    if ((b & 0x80) == 0) {
+      *out = v;
+      return true;
+    }
+  }
+  return false;  // >5 bytes: not a varint32
+}
+
+uint32_t load32(const char* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+uint32_t hash32(uint32_t v) {
+  return (v * 0x1e35a7bdu) >> (32 - kHashBits);
+}
+
+void emit_literal(std::string* out, const char* p, size_t len) {
+  if (len == 0) {
+    return;
+  }
+  const size_t l = len - 1;
+  if (l < 60) {
+    out->push_back(static_cast<char>(l << 2));
+  } else {
+    int extra = l < (1u << 8) ? 1 : l < (1u << 16) ? 2
+                : l < (1u << 24) ? 3 : 4;
+    out->push_back(static_cast<char>((59 + extra) << 2));
+    for (int i = 0; i < extra; ++i) {
+      out->push_back(static_cast<char>(l >> (8 * i)));
+    }
+  }
+  out->append(p, len);
+}
+
+// Copy with 16-bit offset (tag 2); len must be in [1, 64].
+void emit_copy_chunk(std::string* out, size_t offset, size_t len) {
+  out->push_back(static_cast<char>(((len - 1) << 2) | 2));
+  out->push_back(static_cast<char>(offset));
+  out->push_back(static_cast<char>(offset >> 8));
+}
+
+void emit_copy(std::string* out, size_t offset, size_t len) {
+  // Chunks of ≤64 with the final one ≥4 (decoder accepts any, but the
+  // canonical encoder never emits a sub-4 copy).
+  while (len >= 68) {
+    emit_copy_chunk(out, offset, 64);
+    len -= 64;
+  }
+  if (len > 64) {
+    emit_copy_chunk(out, offset, 60);
+    len -= 60;
+  }
+  emit_copy_chunk(out, offset, len);
+}
+
+void compress_fragment(const char* frag, size_t n, std::string* out) {
+  static thread_local uint16_t table[1 << kHashBits];
+  memset(table, 0, sizeof(table));
+  // Slot 0 doubles as "empty"; position 0 as a candidate is then only
+  // believed when its 4 bytes really match (self-match at ip=0 is
+  // rejected by the offset!=0 check).
+  size_t ip = 0, next_emit = 0;
+  while (ip + 4 <= n) {
+    const uint32_t v = load32(frag + ip);
+    const uint32_t h = hash32(v);
+    const size_t cand = table[h];
+    table[h] = static_cast<uint16_t>(ip);
+    if (cand < ip && load32(frag + cand) == v) {
+      emit_literal(out, frag + next_emit, ip - next_emit);
+      size_t len = 4;
+      while (ip + len < n && frag[cand + len] == frag[ip + len]) {
+        ++len;
+      }
+      emit_copy(out, ip - cand, len);
+      ip += len;
+      next_emit = ip;
+      continue;
+    }
+    ++ip;
+  }
+  emit_literal(out, frag + next_emit, n - next_emit);
+}
+
+}  // namespace
+
+void snappy_compress(const char* in, size_t n, std::string* out) {
+  put_varint32(out, static_cast<uint32_t>(n));
+  for (size_t off = 0; off < n; off += kFragment) {
+    compress_fragment(in + off,
+                      n - off < kFragment ? n - off : kFragment, out);
+  }
+}
+
+bool snappy_decompress(const char* in, size_t n, std::string* out,
+                       uint64_t size_limit) {
+  size_t p = 0;
+  uint32_t total = 0;
+  if (!get_varint32(in, n, &p, &total) || total > size_limit) {
+    return false;
+  }
+  const size_t base = out->size();
+  out->reserve(base + total);
+  while (p < n) {
+    const uint8_t tag = static_cast<uint8_t>(in[p++]);
+    size_t len = 0, offset = 0;
+    switch (tag & 3) {
+      case 0: {  // literal
+        size_t l = tag >> 2;
+        if (l >= 60) {
+          const int extra = static_cast<int>(l) - 59;
+          if (n - p < static_cast<size_t>(extra)) {
+            return false;
+          }
+          l = 0;
+          for (int i = 0; i < extra; ++i) {
+            l |= static_cast<size_t>(static_cast<uint8_t>(in[p++]))
+                 << (8 * i);
+          }
+        }
+        len = l + 1;
+        if (n - p < len || out->size() - base + len > total) {
+          return false;
+        }
+        out->append(in + p, len);
+        p += len;
+        continue;
+      }
+      case 1:
+        if (p >= n) {
+          return false;
+        }
+        len = 4 + ((tag >> 2) & 7);
+        offset = (static_cast<size_t>(tag >> 5) << 8) |
+                 static_cast<uint8_t>(in[p++]);
+        break;
+      case 2:
+        if (n - p < 2) {
+          return false;
+        }
+        len = (tag >> 2) + 1;
+        offset = static_cast<uint8_t>(in[p]) |
+                 (static_cast<size_t>(static_cast<uint8_t>(in[p + 1]))
+                  << 8);
+        p += 2;
+        break;
+      default:  // case 3
+        if (n - p < 4) {
+          return false;
+        }
+        len = (tag >> 2) + 1;
+        offset = 0;
+        for (int i = 0; i < 4; ++i) {
+          offset |= static_cast<size_t>(static_cast<uint8_t>(in[p + i]))
+                    << (8 * i);
+        }
+        p += 4;
+        break;
+    }
+    const size_t produced = out->size() - base;
+    if (offset == 0 || offset > produced || produced + len > total) {
+      return false;
+    }
+    // Byte-wise: copies may overlap their own output (run-length form).
+    size_t src = out->size() - offset;
+    for (size_t i = 0; i < len; ++i) {
+      out->push_back((*out)[src + i]);
+    }
+  }
+  return out->size() - base == total;
+}
+
+}  // namespace trpc

@@ -1,0 +1,27 @@
+// Snappy block-format codec, implemented from the public format
+// description (no external library — this image has none).
+//
+// Parity: the reference registers a snappy compress handler
+// (/root/reference/src/brpc/policy/snappy_compress.*, vendoring
+// butil/third_party/snappy).  Format recap: a varint32 uncompressed
+// length, then tagged elements — tag&3: 0 literal (len-1 in the high 6
+// bits, 60..63 = that many extra LE length bytes), 1 copy len 4..11 /
+// 11-bit offset, 2 copy len 1..64 / 16-bit offset, 3 copy len 1..64 /
+// 32-bit offset.  The encoder works in 64KB fragments with a 4-byte
+// hash matcher, so emitted offsets always fit tag 2.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+namespace trpc {
+
+// Compresses all of `in`; output appends to *out.  Always succeeds.
+void snappy_compress(const char* in, size_t n, std::string* out);
+
+// Decompresses; false on malformed input or when the decoded size would
+// exceed `size_limit` (zip-bomb guard).  *out is appended to.
+bool snappy_decompress(const char* in, size_t n, std::string* out,
+                       uint64_t size_limit);
+
+}  // namespace trpc

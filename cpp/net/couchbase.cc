@@ -86,15 +86,21 @@ McResult CouchbaseClient::route(McCommand cmd) {
       continue;
     }
     last = cli->batch({cmd}).front();
-    if (last.status != McStatus::kNotMyVbucket) {
-      if (probe != 0) {
-        LockGuard<FiberMutex> g(mu_);
-        map_[cmd.vbucket] = static_cast<int>(idx);  // learned ownership
-      }
-      return last;
+    if (last.status == McStatus::kNotMyVbucket ||
+        last.status == McStatus::kRemoteError) {
+      // Declined or unreachable: neither is ownership — keep probing
+      // (a transport error from a stale/non-owning node must not stop
+      // the search before a reachable owner is tried, and must never
+      // be written into the map).
+      continue;
     }
+    if (probe != 0) {
+      LockGuard<FiberMutex> g(mu_);
+      map_[cmd.vbucket] = static_cast<int>(idx);  // learned ownership
+    }
+    return last;
   }
-  return last;  // every node declined the vbucket
+  return last;  // every node declined or was unreachable
 }
 
 McResult CouchbaseClient::Get(const std::string& key) {

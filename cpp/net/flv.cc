@@ -1,0 +1,113 @@
+#include "net/flv.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr size_t kMaxTag = 32u << 20;
+
+void put24(std::string* out, uint32_t v) {
+  out->push_back(static_cast<char>(v >> 16));
+  out->push_back(static_cast<char>(v >> 8));
+  out->push_back(static_cast<char>(v));
+}
+
+void put32(std::string* out, uint32_t v) {
+  out->push_back(static_cast<char>(v >> 24));
+  put24(out, v);
+}
+
+uint32_t get24(const uint8_t* p) {
+  return (static_cast<uint32_t>(p[0]) << 16) |
+         (static_cast<uint32_t>(p[1]) << 8) | p[2];
+}
+
+}  // namespace
+
+void flv_write_header(bool has_audio, bool has_video, std::string* out) {
+  out->append("FLV", 3);
+  out->push_back(1);  // version
+  out->push_back(static_cast<char>((has_audio ? 4 : 0) |
+                                   (has_video ? 1 : 0)));
+  put32(out, 9);  // header size
+  put32(out, 0);  // prev_tag_size of the non-existent tag before
+}
+
+bool flv_write_tag(uint8_t type, uint32_t timestamp,
+                   const std::string& data, std::string* out) {
+  if (data.size() > 0xffffff) {
+    // RTMP admits messages of exactly 16MiB; FLV's size field cannot
+    // represent them — refuse instead of writing a corrupt tag.
+    return false;
+  }
+  out->push_back(static_cast<char>(type));
+  put24(out, static_cast<uint32_t>(data.size()));
+  put24(out, timestamp & 0xffffff);
+  out->push_back(static_cast<char>(timestamp >> 24));  // extension
+  put24(out, 0);  // stream id
+  out->append(data);
+  put32(out, static_cast<uint32_t>(11 + data.size()));
+  return true;
+}
+
+bool flv_write_message(const RtmpMessage& msg, std::string* out) {
+  if (msg.type != static_cast<uint8_t>(RtmpMsgType::kAudio) &&
+      msg.type != static_cast<uint8_t>(RtmpMsgType::kVideo) &&
+      msg.type != static_cast<uint8_t>(RtmpMsgType::kDataAmf0)) {
+    return false;
+  }
+  return flv_write_tag(msg.type, msg.timestamp, msg.payload, out);
+}
+
+int flv_read_header(const std::string& in, size_t* pos, bool* has_audio,
+                    bool* has_video) {
+  if (in.size() - *pos < 13) {
+    return 0;
+  }
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(in.data()) + *pos;
+  if (p[0] != 'F' || p[1] != 'L' || p[2] != 'V' || p[3] != 1) {
+    return -1;
+  }
+  const uint32_t header_size = (static_cast<uint32_t>(p[5]) << 24) |
+                               get24(p + 6);
+  if (header_size < 9 || header_size > 64) {
+    return -1;
+  }
+  if (in.size() - *pos < header_size + 4) {
+    return 0;
+  }
+  *has_audio = (p[4] & 4) != 0;
+  *has_video = (p[4] & 1) != 0;
+  *pos += header_size + 4;  // header + first prev_tag_size
+  return 1;
+}
+
+int flv_read_tag(const std::string& in, size_t* pos, FlvTag* out) {
+  if (in.size() - *pos < 11) {
+    return 0;
+  }
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(in.data()) + *pos;
+  const uint32_t size = get24(p + 1);
+  if (size > kMaxTag) {
+    return -1;
+  }
+  if (in.size() - *pos < 11 + size + 4) {
+    return 0;
+  }
+  out->type = p[0];
+  out->timestamp = get24(p + 4) | (static_cast<uint32_t>(p[7]) << 24);
+  if (get24(p + 8) != 0) {  // stream id is always 0 in files
+    return -1;
+  }
+  out->data.assign(in, *pos + 11, size);
+  const uint8_t* back = p + 11 + size;
+  const uint32_t prev = (static_cast<uint32_t>(back[0]) << 24) |
+                        get24(back + 1);
+  if (prev != 11 + size) {
+    return -1;
+  }
+  *pos += 11 + size + 4;
+  return 1;
+}
+
+}  // namespace trpc

@@ -1,0 +1,45 @@
+// FLV container: mux RTMP media messages into an FLV byte stream and
+// demux one back.
+//
+// Parity: the reference's FLV reader/writer ride inside rtmp.cpp
+// (RtmpFLVWriter etc.) and policy/rtmp_protocol.cpp serves /flv
+// streams.  Format (public Adobe spec): 9-byte header "FLV" ver=1
+// flags(audio|video) header_size=9, then repeated [prev_tag_size u32]
+// [tag: type u8, data_size u24, timestamp u24 + ts_ext u8, stream_id
+// u24(0), data].  Tag types match RTMP message types (8 audio, 9
+// video, 18 script data), which is what makes the relay → FLV file
+// path a straight re-framing.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "net/rtmp.h"
+
+namespace trpc {
+
+struct FlvTag {
+  uint8_t type = 0;  // 8 audio / 9 video / 18 script data
+  uint32_t timestamp = 0;
+  std::string data;
+};
+
+// Appends the 9-byte file header + the first prev_tag_size(0).
+void flv_write_header(bool has_audio, bool has_video, std::string* out);
+
+// Appends one tag + its trailing prev_tag_size.  False (no write) when
+// data exceeds the format's 24-bit size field.
+bool flv_write_tag(uint8_t type, uint32_t timestamp,
+                   const std::string& data, std::string* out);
+
+// Appends an RTMP message as a tag; ignores non-media types (returns
+// false).  Feed this from an RtmpService media observer to record a
+// live stream as FLV.
+bool flv_write_message(const RtmpMessage& msg, std::string* out);
+
+// Resumable readers: 1 ok (advances *pos) / 0 need more / -1 malformed.
+int flv_read_header(const std::string& in, size_t* pos, bool* has_audio,
+                    bool* has_video);
+int flv_read_tag(const std::string& in, size_t* pos, FlvTag* out);
+
+}  // namespace trpc

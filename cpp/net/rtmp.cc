@@ -8,6 +8,7 @@
 
 #include "base/logging.h"
 #include "base/rand.h"
+#include "base/sha256.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
 #include "net/messenger.h"
@@ -19,6 +20,26 @@ namespace trpc {
 namespace {
 
 constexpr size_t kHandshakeSize = 1536;
+
+// Public Genuine-Adobe handshake keys (the 30/36-char strings plus a
+// fixed 32-byte tail; both halves are published constants of the
+// protocol, implemented by every open media server).
+const uint8_t kGenuineTail[32] = {
+    0xF0, 0xEE, 0xC2, 0x4A, 0x80, 0x68, 0xBE, 0xE8, 0x2E, 0x00, 0xD0,
+    0xD1, 0x02, 0x9E, 0x7E, 0x57, 0x6E, 0xEC, 0x5D, 0x2D, 0x29, 0x80,
+    0x6F, 0xAB, 0x93, 0xB8, 0xE6, 0x36, 0xCF, 0xEB, 0x31, 0xAE};
+const char kFpKeyText[] = "Genuine Adobe Flash Player 001";       // 30
+const char kFmsKeyText[] = "Genuine Adobe Flash Media Server 001";  // 36
+
+// Partial key (text only) signs one's own C1/S1; the full key (text +
+// tail) derives the S2/C2 ack key.
+void handshake_keys(bool client, std::string* partial,
+                    std::string* full) {
+  const char* text = client ? kFpKeyText : kFmsKeyText;
+  partial->assign(text);
+  full->assign(text);
+  full->append(reinterpret_cast<const char*>(kGenuineTail), 32);
+}
 constexpr uint32_t kDefaultChunkSize = 128;
 constexpr uint32_t kOurChunkSize = 4096;
 constexpr size_t kMaxMessage = 16u << 20;
@@ -66,6 +87,68 @@ uint32_t read_u32le(const uint8_t* p) {
 }
 
 }  // namespace
+
+// ---- digest handshake ----------------------------------------------------
+
+size_t rtmp_digest_offset(const uint8_t* hs, int scheme) {
+  const size_t base = scheme == 0 ? 8 : 772;
+  const uint32_t sum = hs[base] + hs[base + 1] + hs[base + 2] +
+                       static_cast<uint32_t>(hs[base + 3]);
+  return (sum % 728) + base + 4;
+}
+
+void rtmp_install_digest(std::string* hs, bool client) {
+  std::string partial, full;
+  handshake_keys(client, &partial, &full);
+  const size_t off = rtmp_digest_offset(
+      reinterpret_cast<const uint8_t*>(hs->data()), 0);
+  // Digest = HMAC over the 1504 bytes AROUND the digest slot.
+  std::string msg = hs->substr(0, off) + hs->substr(off + kSha256Size);
+  uint8_t d[kSha256Size];
+  hmac_sha256(partial.data(), partial.size(), msg.data(), msg.size(), d);
+  hs->replace(off, kSha256Size, reinterpret_cast<const char*>(d),
+              kSha256Size);
+}
+
+bool rtmp_verify_digest(const std::string& hs, bool client,
+                        std::string* digest) {
+  if (hs.size() != kHandshakeSize) {
+    return false;
+  }
+  std::string partial, full;
+  handshake_keys(client, &partial, &full);
+  for (int scheme = 0; scheme < 2; ++scheme) {
+    const size_t off = rtmp_digest_offset(
+        reinterpret_cast<const uint8_t*>(hs.data()), scheme);
+    std::string msg = hs.substr(0, off) + hs.substr(off + kSha256Size);
+    uint8_t d[kSha256Size];
+    hmac_sha256(partial.data(), partial.size(), msg.data(), msg.size(),
+                d);
+    if (memcmp(d, hs.data() + off, kSha256Size) == 0) {
+      digest->assign(hs, off, kSha256Size);
+      return true;
+    }
+  }
+  return false;
+}
+
+void rtmp_make_digest_ack(const std::string& peer_digest, bool client,
+                          std::string* out) {
+  std::string partial, full;
+  handshake_keys(client, &partial, &full);
+  out->clear();
+  out->reserve(kHandshakeSize);
+  for (size_t i = 0; i < kHandshakeSize - kSha256Size; ++i) {
+    out->push_back(static_cast<char>(fast_rand()));
+  }
+  // Two-stage: tmp = HMAC(full_key, peer_digest); tail = HMAC(tmp, body).
+  uint8_t tmp[kSha256Size];
+  hmac_sha256(full.data(), full.size(), peer_digest.data(),
+              peer_digest.size(), tmp);
+  uint8_t tail[kSha256Size];
+  hmac_sha256(tmp, kSha256Size, out->data(), out->size(), tail);
+  out->append(reinterpret_cast<const char*>(tail), kSha256Size);
+}
 
 // ---- AMF0 ----------------------------------------------------------------
 
@@ -248,6 +331,7 @@ struct RtmpConn {
   enum Phase { kAwaitC0C1, kAwaitC2, kAwaitS0S1S2, kChunks };
   Phase phase = kAwaitC0C1;
   bool is_client = false;
+  bool use_digest = false;  // client: sent a digested C1
   Event handshook;  // value 1 once phase == kChunks (client connect waits)
 
   uint32_t in_chunk_size = kDefaultChunkSize;
@@ -556,17 +640,33 @@ ParseError rtmp_parse(IOBuf* source, InputMessage* out, Socket* sock) {
     source->pop_front(1);
     IOBuf c1;
     source->cutn(&c1, kHandshakeSize);
-    // S0 + S1 (our time + random) + S2 (echo of C1).
-    std::string s01;
-    s01.push_back(0x03);
-    put_u32be(&s01, 0);
-    put_u32be(&s01, 0);
+    const std::string c1s = c1.to_string();
+    // A nonzero C1 version signals the digest handshake; validate the
+    // client digest (either scheme) and answer with a digested S1 and
+    // a keyed-ack S2.  Version 0 (or an unverifiable digest) takes the
+    // plain path: random S1, S2 = echo of C1.
+    std::string cdigest;
+    const bool complex =
+        (c1s[4] | c1s[5] | c1s[6] | c1s[7]) != 0 &&
+        rtmp_verify_digest(c1s, /*client=*/true, &cdigest);
+    std::string s1;
+    put_u32be(&s1, 0);                            // time
+    put_u32be(&s1, complex ? 0x04050001u : 0u);   // version
     for (size_t i = 0; i < kHandshakeSize - 8; ++i) {
-      s01.push_back(static_cast<char>(fast_rand()));
+      s1.push_back(static_cast<char>(fast_rand()));
     }
     IOBuf reply;
-    reply.append(s01);
-    reply.append(c1);  // S2
+    reply.append("\x03", 1);
+    if (complex) {
+      rtmp_install_digest(&s1, /*client=*/false);
+      reply.append(s1);
+      std::string s2;
+      rtmp_make_digest_ack(cdigest, /*client=*/false, &s2);
+      reply.append(s2);
+    } else {
+      reply.append(s1);
+      reply.append(c1);  // S2
+    }
     sock->Write(std::move(reply));
     conn->phase = RtmpConn::kAwaitC2;
   }
@@ -907,8 +1007,18 @@ ParseError rtmpc_parse(IOBuf* source, InputMessage* out, Socket* sock) {
     source->pop_front(1);
     IOBuf s1;
     source->cutn(&s1, kHandshakeSize);
-    source->pop_front(kHandshakeSize);  // S2 (echo of our C1; trusted)
-    sock->Write(std::move(s1));        // C2 = echo of S1
+    source->pop_front(kHandshakeSize);  // S2 (ack/echo of our C1; trusted)
+    std::string sdigest;
+    if (conn->use_digest &&
+        rtmp_verify_digest(s1.to_string(), /*client=*/false, &sdigest)) {
+      std::string c2;
+      rtmp_make_digest_ack(sdigest, /*client=*/true, &c2);
+      IOBuf out;
+      out.append(c2);
+      sock->Write(std::move(out));
+    } else {
+      sock->Write(std::move(s1));  // C2 = echo of S1 (plain handshake)
+    }
     conn->phase = RtmpConn::kChunks;
     conn->handshook.value.store(1, std::memory_order_release);
     conn->handshook.wake_all();
@@ -1010,20 +1120,25 @@ int RtmpClient::Init(const std::string& addr, const Options* opts) {
 
 int RtmpClient::ensure_connected() {
   SocketId sid = 0;
-  auto install = [](Socket* s) -> int {
+  const bool digest = opts_.use_digest;
+  auto install = [digest](Socket* s) -> int {
     RtmpConn* conn = rtmp_conn_of(s, /*client=*/true);
     conn->is_client = true;
+    conn->use_digest = digest;
     conn->phase = RtmpConn::kAwaitS0S1S2;
-    // C0 + C1.
-    std::string c01;
-    c01.push_back(0x03);
-    put_u32be(&c01, 0);
-    put_u32be(&c01, 0);
+    // C0 + C1 (nonzero version announces the digest handshake).
+    std::string c1;
+    put_u32be(&c1, 0);
+    put_u32be(&c1, digest ? 0x80000702u : 0u);
     for (size_t i = 0; i < kHandshakeSize - 8; ++i) {
-      c01.push_back(static_cast<char>(fast_rand()));
+      c1.push_back(static_cast<char>(fast_rand()));
+    }
+    if (digest) {
+      rtmp_install_digest(&c1, /*client=*/true);
     }
     IOBuf out;
-    out.append(c01);
+    out.append("\x03", 1);
+    out.append(c1);
     return s->Write(std::move(out));
   };
   if (csock_.ensure(rtmpc_protocol_index(), install, &sid) != 0) {

@@ -12,9 +12,12 @@
 //   - the NetConnection/NetStream command flow (connect, createStream,
 //     publish, play, deleteStream) with _result/onStatus replies,
 //   - publisher -> players relay of audio/video/data messages keyed by
-//     stream name (the RtmpService registry).
-// Out of scope (kept to the registries): digest handshakes, RTMPS, FLV/TS
-// file muxing, aggregate messages, shared objects.
+//     stream name (the RtmpService registry),
+//   - the digest ("complex") handshake both ways (HMAC-SHA256 with the
+//     public Genuine-FP/FMS keys, both schemes on verify),
+//   - FLV muxing/demuxing (net/flv.h) fed by the media observer.
+// Out of scope (kept to the registries): RTMPS (ride the TLS transport),
+// MPEG-TS muxing, aggregate messages, shared objects.
 #pragma once
 
 #include <cstdint>
@@ -66,6 +69,27 @@ void amf0_write(const Amf0Value& v, std::string* out);
 // 1 ok / 0 partial / -1 malformed; depth-bounded.
 int amf0_read(const std::string& in, size_t* pos, Amf0Value* out,
               int depth = 0);
+
+// ---- digest ("complex") handshake ---------------------------------------
+// Flash's digest handshake: C1/S1 carry an HMAC-SHA256 digest at an
+// offset derived from four offset bytes (scheme 0: bytes 8..11, digest
+// block first; scheme 1: bytes 772..775, key block first), keyed by the
+// public Genuine-FP/FMS partial keys; S2/C2 ack the peer's digest with
+// a two-stage HMAC.  Exposed for tests.
+
+// Offset of the 32-byte digest inside a 1536-byte C1/S1 for `scheme`
+// (0 or 1); always in range by construction.
+size_t rtmp_digest_offset(const uint8_t* hs, int scheme);
+// Computes and installs the scheme-0 digest into a fully-built
+// 1536-byte C1 (client=true) / S1 (false).
+void rtmp_install_digest(std::string* hs, bool client);
+// Tries both schemes; true when a digest validates, filling *digest.
+bool rtmp_verify_digest(const std::string& hs, bool client,
+                        std::string* digest);
+// Builds the 1536-byte S2 (client=false) / C2 (true) acknowledging the
+// peer's validated digest.
+void rtmp_make_digest_ack(const std::string& peer_digest, bool client,
+                          std::string* out);
 
 // ---- messages ------------------------------------------------------------
 
@@ -126,6 +150,9 @@ class RtmpClient {
   struct Options {
     int64_t timeout_ms = 2000;
     std::string app = "live";
+    // Digest (complex) handshake: C1 carries an FP-keyed digest and C2
+    // acks the server digest instead of echoing S1.
+    bool use_digest = false;
   };
   using MediaHandler = std::function<void(const RtmpMessage& msg)>;
 

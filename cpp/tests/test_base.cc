@@ -12,6 +12,8 @@
 #include "base/iobuf.h"
 #include "base/rand.h"
 #include "base/recordio.h"
+#include "base/sha256.h"
+#include "base/snappy.h"
 #include "base/resource_pool.h"
 #include "base/time.h"
 #include "base/json.h"
@@ -278,6 +280,159 @@ TEST_CASE(json_roundtrip_and_strictness) {
   // Escaping in dump.
   Json s1 = Json::str("a\"b\\c\n");
   EXPECT(s1.dump() == "\"a\\\"b\\\\c\\n\"");
+}
+
+TEST_CASE(sha256_and_hmac_vectors) {
+  auto hex = [](const uint8_t* d, size_t n) {
+    std::string s;
+    for (size_t i = 0; i < n; ++i) {
+      char b[3];
+      snprintf(b, 3, "%02x", d[i]);
+      s += b;
+    }
+    return s;
+  };
+  uint8_t d[32];
+  // FIPS 180-4 vectors.
+  sha256("abc", 3, d);
+  EXPECT(hex(d, 32) ==
+         "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad");
+  sha256("", 0, d);
+  EXPECT(hex(d, 32) ==
+         "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855");
+  sha256("abcdbcdecdefdefgefghfghighijhijkijkljklmklmnlmnomnopnopq", 56,
+         d);
+  EXPECT(hex(d, 32) ==
+         "248d6a61d20638b8e5c026930c3e6039a33ce45964ff2167f6ecedd419db06c1");
+  // One-million-'a' vector exercises the streaming/update path.
+  {
+    std::string m(1000000, 'a');
+    sha256(m.data(), m.size(), d);
+    EXPECT(hex(d, 32) ==
+           "cdc76e5c9914fb9281a1c7e284d73e67f1809a48a497200e046d39ccc7112cd0");
+  }
+  // RFC 4231 HMAC-SHA256 cases 1-2.
+  {
+    std::string key(20, '\x0b');
+    hmac_sha256(key.data(), key.size(), "Hi There", 8, d);
+    EXPECT(hex(d, 32) ==
+           "b0344c61d8db38535ca8afceaf0bf12b881dc200c9833da726e9376c2e32cff7");
+  }
+  hmac_sha256("Jefe", 4, "what do ya want for nothing?", 28, d);
+  EXPECT(hex(d, 32) ==
+         "5bdcc146bf60754e6a042426089575c75a003f089d2739839dec58b964ec3843");
+  // RFC 4231 case 6: key longer than the block (hashed-key path).
+  {
+    std::string key(131, '\xaa');
+    const char* msg = "Test Using Larger Than Block-Size Key - Hash Key First";
+    hmac_sha256(key.data(), key.size(), msg, strlen(msg), d);
+    EXPECT(hex(d, 32) ==
+           "60e431591ee0b67f0d8a26aacbf5b77f8e0bc6213728c5140546040f0ee37f54");
+  }
+}
+
+TEST_CASE(snappy_spec_vectors_decode) {
+  // Hand-assembled frames straight from the format description.
+  // Pure literal: varint(5) + tag(len-1=4)<<2 + "abcde".
+  {
+    std::string wire = "\x05\x10"
+                       "abcde";
+    std::string out;
+    EXPECT(snappy_decompress(wire.data(), wire.size(), &out, 1 << 20));
+    EXPECT(out == "abcde");
+  }
+  // Run-length via overlapping copy: varint(10), literal "x",
+  // tag01 len=9 offset=1 → "x" * 10.
+  {
+    std::string wire("\x0a\x00x\x15\x01", 5);
+    std::string out;
+    EXPECT(snappy_decompress(wire.data(), wire.size(), &out, 1 << 20));
+    EXPECT(out == std::string(10, 'x'));
+  }
+  // Copy with 16-bit offset (tag 2): "abcdabcd".
+  {
+    std::string wire = std::string("\x08\x0c"
+                                   "abcd",
+                                   6);
+    wire += '\x0e';  // tag2 len=4
+    wire += '\x04';  // offset lo
+    wire += '\0';    // offset hi
+    std::string out;
+    EXPECT(snappy_decompress(wire.data(), wire.size(), &out, 1 << 20));
+    EXPECT(out == "abcdabcd");
+  }
+  // Malformed: offset beyond produced output must fail, not read OOB.
+  {
+    std::string wire("\x08\x00x\x15\x09", 5);  // copy offset 9, produced 1
+    std::string out;
+    EXPECT(!snappy_decompress(wire.data(), wire.size(), &out, 1 << 20));
+  }
+  // Zip-bomb guard: declared size above the limit fails fast.
+  {
+    std::string wire = "\xff\xff\xff\x7f";  // varint ~256MB, no body
+    std::string out;
+    EXPECT(!snappy_decompress(wire.data(), wire.size(), &out, 1024));
+  }
+}
+
+TEST_CASE(snappy_roundtrips) {
+  auto rt = [](const std::string& plain) {
+    std::string wire, back;
+    snappy_compress(plain.data(), plain.size(), &wire);
+    EXPECT(snappy_decompress(wire.data(), wire.size(), &back,
+                             plain.size() + 1));
+    EXPECT(back == plain);
+    return wire.size();
+  };
+  rt("");
+  rt("a");
+  rt("hello");
+  // Highly repetitive: must actually compress.
+  std::string runs;
+  for (int i = 0; i < 1000; ++i) {
+    runs += "abcdefgh";
+  }
+  EXPECT(rt(runs) < runs.size() / 4);
+  // Incompressible pseudo-random bytes: correctness over ratio, and the
+  // multi-fragment path (>64KB) must reassemble exactly.
+  std::string rand_big;
+  uint64_t x = 0x9e3779b97f4a7c15ull;
+  for (size_t i = 0; i < 200 * 1024; ++i) {
+    x ^= x << 13;
+    x ^= x >> 7;
+    x ^= x << 17;
+    rand_big += static_cast<char>(x);
+  }
+  rt(rand_big);
+  // Compressible data spanning fragments.
+  std::string mix;
+  for (int i = 0; i < 5000; ++i) {
+    mix += "the quick brown fox jumps over the lazy dog ";
+    mix += static_cast<char>(i);
+  }
+  rt(mix);
+}
+
+TEST_CASE(snappy_decode_rejects_mutations) {
+  // Deterministic mutation fuzz over a valid frame: every single-byte
+  // corruption must either fail cleanly or produce bounded output —
+  // never crash or overread (ASan run covers the latter).
+  std::string plain;
+  for (int i = 0; i < 300; ++i) {
+    plain += "payload-" + std::to_string(i % 37);
+  }
+  std::string wire;
+  snappy_compress(plain.data(), plain.size(), &wire);
+  for (size_t i = 0; i < wire.size(); ++i) {
+    for (int delta : {1, 0x55, 0xff}) {
+      std::string mut = wire;
+      mut[i] = static_cast<char>(mut[i] + delta);
+      std::string out;
+      (void)snappy_decompress(mut.data(), mut.size(), &out,
+                              plain.size() * 4);
+      EXPECT(out.size() <= plain.size() * 4);
+    }
+  }
 }
 
 TEST_MAIN

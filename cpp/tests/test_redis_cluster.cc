@@ -39,9 +39,7 @@ bool g_asking = false;
 // a slot mid-migration: A still owns it, the key already moved).
 std::string g_ask_key;
 
-// CLUSTER SLOTS reply advertising `lie_all` = this node owns everything
-// (a deliberately stale map, to force MOVED discovery).
-RedisReply slots_reply(Node* self, bool lie_all) {
+RedisReply slots_reply() {
   auto range = [](int beg, int end, const std::string& addr) {
     const size_t colon = addr.rfind(':');
     return RedisReply::Array({
@@ -53,23 +51,20 @@ RedisReply slots_reply(Node* self, bool lie_all) {
         }),
     });
   };
-  if (lie_all) {
-    return RedisReply::Array({range(0, 16383, self->addr)});
-  }
   return RedisReply::Array({
       range(node_a()->slot_beg, node_a()->slot_end, node_a()->addr),
       range(node_b()->slot_beg, node_b()->slot_end, node_b()->addr),
   });
 }
 
-void start_node(Node* n, int beg, int end, bool lie_all) {
+void start_node(Node* n, int beg, int end) {
   n->slot_beg = beg;
   n->slot_end = end;
   auto* rs = new RedisService();
   rs->AddCommandHandler(
-      "cluster", [n, lie_all](const std::vector<std::string>& a) {
+      "cluster", [](const std::vector<std::string>& a) {
         if (a.size() >= 2 && (a[1] == "SLOTS" || a[1] == "slots")) {
-          return slots_reply(n, lie_all);
+          return slots_reply();
         }
         return RedisReply::Error("ERR unsupported subcommand");
       });
@@ -124,12 +119,12 @@ void start_node(Node* n, int beg, int end, bool lie_all) {
   n->addr = "127.0.0.1:" + std::to_string(n->srv.port());
 }
 
-void start_cluster(bool lie_all = false) {
+void start_cluster() {
   if (!node_a()->addr.empty()) {
     return;
   }
-  start_node(node_a(), 0, 8191, lie_all);
-  start_node(node_b(), 8192, 16383, lie_all);
+  start_node(node_a(), 0, 8191);
+  start_node(node_b(), 8192, 16383);
 }
 
 }  // namespace
@@ -173,10 +168,6 @@ TEST_CASE(moved_updates_map_once) {
   node_b()->moved_served = 0;
   RedisClusterClient cc;
   EXPECT_EQ(cc.Init({node_a()->addr}), 0);
-  // Pre-poison the map by executing once (learns truth), then simulate
-  // staleness: a fresh client whose first keyed command goes to the
-  // wrong node because we seed only A and skip refresh by using a
-  // keyless warm-up... simplest honest path: force the stale entry.
   EXPECT(cc.execute({"SET", "foo", "v1"}).str == "OK");  // learns map
   // Migrate "foo"'s slot to A behind the client's back.
   node_a()->slot_beg = 0;

@@ -275,7 +275,8 @@ TEST_CASE(compression_and_checksum) {
   for (size_t i = 0; i < big.size(); i += 17) {
     big[i] = static_cast<char>('b' + i % 7);
   }
-  for (uint8_t ct : {uint8_t(1) /*gzip*/, uint8_t(2) /*zlib*/}) {
+  for (uint8_t ct :
+       {uint8_t(1) /*gzip*/, uint8_t(2) /*zlib*/, uint8_t(3) /*snappy*/}) {
     Controller cntl;
     cntl.set_timeout_ms(5000);
     cntl.set_request_compress_type(ct);
