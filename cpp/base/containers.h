@@ -1,0 +1,208 @@
+// The remaining small containers from butil/containers/ that std::
+// doesn't already cover (reference: mru_cache.h, case_ignored_flat_map.h,
+// bounded_queue.h, mpsc_queue.h — /root/reference/src/butil/containers/).
+// Re-designed minimal: each is the data structure the runtime actually
+// needs, not a port of the Chromium originals.
+#pragma once
+
+#include <atomic>
+#include <cctype>
+#include <cstddef>
+#include <list>
+#include <string>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+#include "base/flat_map.h"
+
+namespace trpc {
+
+// Recency-ordered bounded cache (reference mru_cache.h): Put/Get keep a
+// usage list; inserting past capacity evicts the least-recently-used
+// entry.  Not thread-safe (callers lock, as in the reference).
+template <typename K, typename V>
+class MruCache {
+ public:
+  explicit MruCache(size_t capacity) : cap_(capacity) {}
+
+  // Inserts or overwrites; the entry becomes most-recent.
+  void Put(const K& key, V value) {
+    auto it = index_.find(key);
+    if (it != index_.end()) {
+      it->second->second = std::move(value);
+      order_.splice(order_.begin(), order_, it->second);
+      return;
+    }
+    order_.emplace_front(key, std::move(value));
+    index_[key] = order_.begin();
+    if (index_.size() > cap_) {
+      index_.erase(order_.back().first);
+      order_.pop_back();
+    }
+  }
+
+  // nullptr when absent; a hit becomes most-recent.
+  V* Get(const K& key) {
+    auto it = index_.find(key);
+    if (it == index_.end()) {
+      return nullptr;
+    }
+    order_.splice(order_.begin(), order_, it->second);
+    return &it->second->second;
+  }
+
+  // Peek without touching recency (diagnostics).
+  const V* Peek(const K& key) const {
+    auto it = index_.find(key);
+    return it == index_.end() ? nullptr : &it->second->second;
+  }
+
+  bool Erase(const K& key) {
+    auto it = index_.find(key);
+    if (it == index_.end()) {
+      return false;
+    }
+    order_.erase(it->second);
+    index_.erase(it);
+    return true;
+  }
+
+  size_t size() const { return index_.size(); }
+  size_t capacity() const { return cap_; }
+
+ private:
+  size_t cap_;
+  std::list<std::pair<K, V>> order_;  // front = most recent
+  std::unordered_map<K, typename std::list<std::pair<K, V>>::iterator>
+      index_;
+};
+
+// Case-insensitive string map (reference case_ignored_flat_map.h — the
+// HTTP header table).  Keys are canonicalized to lowercase on the way
+// in; lookups accept any casing.
+template <typename V>
+class CaseIgnoredFlatMap {
+ public:
+  static std::string lower(const std::string& s) {
+    std::string out = s;
+    for (char& c : out) {
+      c = static_cast<char>(::tolower(static_cast<unsigned char>(c)));
+    }
+    return out;
+  }
+
+  V& operator[](const std::string& key) { return map_[lower(key)]; }
+  V* seek(const std::string& key) { return map_.seek(lower(key)); }
+  const V* seek(const std::string& key) const {
+    return map_.seek(lower(key));
+  }
+  bool erase(const std::string& key) { return map_.erase(lower(key)); }
+  size_t size() const { return map_.size(); }
+
+  // Iteration sees the canonical (lowercased) keys.
+  template <typename Fn>
+  void for_each(Fn&& fn) const {
+    map_.for_each(std::forward<Fn>(fn));
+  }
+
+ private:
+  FlatMap<std::string, V> map_;
+};
+
+// Fixed-capacity ring (reference bounded_queue.h): no allocation after
+// construction, no thread safety — the building block used under locks
+// (e.g. the remote task queue).
+template <typename T>
+class BoundedQueue {
+ public:
+  explicit BoundedQueue(size_t capacity)
+      : items_(capacity + 1) {}  // one slot sacrificed to tell full/empty
+
+  bool push(T v) {
+    const size_t next = (tail_ + 1) % items_.size();
+    if (next == head_) {
+      return false;  // full
+    }
+    items_[tail_] = std::move(v);
+    tail_ = next;
+    return true;
+  }
+
+  bool pop(T* out) {
+    if (head_ == tail_) {
+      return false;  // empty
+    }
+    *out = std::move(items_[head_]);
+    head_ = (head_ + 1) % items_.size();
+    return true;
+  }
+
+  size_t size() const {
+    return (tail_ + items_.size() - head_) % items_.size();
+  }
+  bool empty() const { return head_ == tail_; }
+  bool full() const { return (tail_ + 1) % items_.size() == head_; }
+  size_t capacity() const { return items_.size() - 1; }
+
+ private:
+  std::vector<T> items_;
+  size_t head_ = 0;
+  size_t tail_ = 0;
+};
+
+// Lock-free intrusive-node MPSC queue (reference mpsc_queue.h), the
+// Vyukov exchange-link design: producers swing an atomic tail and link
+// through it; the single consumer chases `next` pointers.  push is
+// wait-free; pop may observe a momentarily unlinked node and report
+// empty (the producer links it immediately after the exchange) — the
+// consumer retries on its next wakeup, exactly like the ExecutionQueue
+// revision loop this mirrors.
+template <typename T>
+class MpscQueue {
+ public:
+  MpscQueue() {
+    Node* dummy = new Node;
+    dummy->next.store(nullptr, std::memory_order_relaxed);
+    head_ = dummy;
+    tail_.store(dummy, std::memory_order_relaxed);
+  }
+  ~MpscQueue() {
+    T ignored;
+    while (pop(&ignored)) {
+    }
+    delete head_;  // the remaining dummy
+  }
+
+  void push(T v) {
+    Node* n = new Node{std::move(v)};
+    n->next.store(nullptr, std::memory_order_relaxed);
+    Node* prev = tail_.exchange(n, std::memory_order_acq_rel);
+    prev->next.store(n, std::memory_order_release);
+  }
+
+  // Single consumer only.  May report empty while a producer is between
+  // its exchange and its link store; the value surfaces on the next
+  // pop — callers that wake the consumer AFTER push (the normal
+  // pattern) never observe a lost element.
+  bool pop(T* out) {
+    Node* next = head_->next.load(std::memory_order_acquire);
+    if (next == nullptr) {
+      return false;
+    }
+    *out = std::move(next->value);
+    delete head_;
+    head_ = next;  // consumed node becomes the new dummy
+    return true;
+  }
+
+ private:
+  struct Node {
+    T value{};
+    std::atomic<Node*> next{nullptr};
+  };
+  Node* head_;               // consumer-owned dummy
+  std::atomic<Node*> tail_;  // producers exchange here
+};
+
+}  // namespace trpc

@@ -11,6 +11,7 @@
 #include <atomic>
 #include <cstdint>
 #include <mutex>
+#include <string>
 #include <vector>
 
 namespace trpc {
@@ -175,5 +176,40 @@ class ResourcePool {
   std::mutex global_mu_;
   std::vector<uint32_t> global_free_;
 };
+
+// Shared skeleton of the diagnostic table dumps (/fibers /sockets /ids):
+// walk [0, hwm), let `row` decide liveness and format, cap at max_rows,
+// footer with the full live count.  row(slot, item, line_or_null)
+// returns true for live items and fills *line only when non-null (the
+// cap already hit: keep counting, stop formatting).
+template <typename T, typename RowFn>
+std::string dump_pool_table(const char* header, size_t max_rows,
+                            RowFn&& row) {
+  std::string out = header;
+  ResourcePool<T>* pool = ResourcePool<T>::instance();
+  const uint32_t hwm = pool->hwm();
+  size_t live = 0, shown = 0;
+  for (uint32_t slot = 0; slot < hwm; ++slot) {
+    T* item = pool->at(slot);
+    if (item == nullptr) {
+      continue;
+    }
+    std::string line;
+    if (!row(slot, item, shown < max_rows ? &line : nullptr)) {
+      continue;
+    }
+    ++live;
+    if (shown < max_rows) {
+      out += line;
+      ++shown;
+    }
+  }
+  out += std::to_string(live) + " live";
+  if (live > shown) {
+    out += " (rows truncated at " + std::to_string(shown) + ")";
+  }
+  out += "\n";
+  return out;
+}
 
 }  // namespace trpc

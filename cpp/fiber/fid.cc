@@ -124,4 +124,24 @@ int fid_join(fid_t id) {
 
 bool fid_exists(fid_t id) { return meta_of(id) != nullptr; }
 
+std::string fid_dump_all(size_t max_rows) {
+  return dump_pool_table<IdMeta>(
+      "live correlation ids (id  locked)\n", max_rows,
+      [](uint32_t slot, IdMeta* m, std::string* line) {
+        const uint32_t ver = m->version.load(std::memory_order_acquire);
+        if ((ver & 1) == 0) {
+          return false;
+        }
+        if (line != nullptr) {
+          char buf[64];
+          snprintf(buf, sizeof(buf), "%016llx  %s\n",
+                   static_cast<unsigned long long>(
+                       (static_cast<uint64_t>(ver) << 32) | slot),
+                   m->mu.locked() ? "yes" : "no");
+          *line = buf;
+        }
+        return true;
+      });
+}
+
 }  // namespace trpc

@@ -9,6 +9,7 @@
 #pragma once
 
 #include <cstdint>
+#include <string>
 
 namespace trpc {
 
@@ -28,5 +29,10 @@ int fid_error(fid_t id, int error_code);
 // Blocks until the id is destroyed (0 even if already gone).
 int fid_join(fid_t id);
 bool fid_exists(fid_t id);
+
+// Text table of live correlation ids (/ids builtin; reference:
+// builtin/ids_service.cpp).  Capped at max_rows rows; always appends the
+// full live count.
+std::string fid_dump_all(size_t max_rows);
 
 }  // namespace trpc

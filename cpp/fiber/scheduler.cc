@@ -384,49 +384,36 @@ std::string walk_parked_stack(FiberMeta* m, int max_frames) {
 }  // namespace
 
 std::string fiber_dump_all(size_t max_rows, bool stacks) {
-  std::string out = "live fibers (id  state  entry)\n";
-  const uint32_t hwm = FiberPool::instance()->hwm();
-  size_t shown = 0;
-  size_t live = 0;
-  for (uint32_t slot = 0; slot < hwm; ++slot) {
-    FiberMeta* m = FiberPool::instance()->at(slot);
-    if (m == nullptr) {
-      continue;
-    }
-    const uint32_t ver = m->version.load(std::memory_order_acquire);
-    if ((ver & 1) == 0) {
-      continue;  // even = idle slot
-    }
-    ++live;
-    if (shown >= max_rows) {
-      continue;  // keep counting; rows are capped
-    }
-    const Event* parked = m->parked_on.load(std::memory_order_acquire);
-    char line[256];
-    const char* sym = "?";
-    Dl_info info;
-    void* fn = reinterpret_cast<void*>(
-        m->fn.load(std::memory_order_relaxed));
-    if (fn != nullptr && dladdr(fn, &info) != 0 &&
-        info.dli_sname != nullptr) {
-      sym = info.dli_sname;
-    }
-    snprintf(line, sizeof(line), "%016llx  %-8s %s\n",
-             static_cast<unsigned long long>(
-                 (static_cast<uint64_t>(ver) << 32) | slot),
-             parked != nullptr ? "parked" : "runnable", sym);
-    out += line;
-    if (stacks && parked != nullptr) {
-      out += walk_parked_stack(m, 16);
-    }
-    ++shown;
-  }
-  out += std::to_string(live) + " live";
-  if (live > shown) {
-    out += " (rows truncated at " + std::to_string(shown) + ")";
-  }
-  out += "\n";
-  return out;
+  return dump_pool_table<FiberMeta>(
+      "live fibers (id  state  entry)\n", max_rows,
+      [stacks](uint32_t slot, FiberMeta* m, std::string* line) {
+        const uint32_t ver = m->version.load(std::memory_order_acquire);
+        if ((ver & 1) == 0) {
+          return false;  // even = idle slot
+        }
+        if (line == nullptr) {
+          return true;  // counted, rows already capped
+        }
+        const Event* parked = m->parked_on.load(std::memory_order_acquire);
+        char buf[256];
+        const char* sym = "?";
+        Dl_info info;
+        void* fn = reinterpret_cast<void*>(
+            m->fn.load(std::memory_order_relaxed));
+        if (fn != nullptr && dladdr(fn, &info) != 0 &&
+            info.dli_sname != nullptr) {
+          sym = info.dli_sname;
+        }
+        snprintf(buf, sizeof(buf), "%016llx  %-8s %s\n",
+                 static_cast<unsigned long long>(
+                     (static_cast<uint64_t>(ver) << 32) | slot),
+                 parked != nullptr ? "parked" : "runnable", sym);
+        *line = buf;
+        if (stacks && parked != nullptr) {
+          *line += walk_parked_stack(m, 16);
+        }
+        return true;
+      });
 }
 
 int fiber_interrupt(fiber_t f) {

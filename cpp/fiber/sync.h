@@ -49,6 +49,11 @@ class FiberMutex {
     }
   }
 
+  // Diagnostic snapshot (/ids dump); racy by nature, never for control.
+  bool locked() const {
+    return ev_.value.load(std::memory_order_relaxed) != 0;
+  }
+
  private:
   Event ev_;
 };

@@ -8,15 +8,21 @@
 #include <algorithm>
 #include <atomic>
 #include <cstdlib>
+#include <filesystem>
+#include <system_error>
+#include <vector>
 #include <string>
 
 #include "base/flags.h"
 #include "base/json.h"
+#include "base/logging.h"
 #include "base/proc.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
+#include "fiber/fid.h"
 #include "net/http_protocol.h"
 #include "net/server.h"
+#include "net/socket.h"
 #include "net/span.h"
 #include "stat/heap_profiler.h"
 #include "stat/profiler.h"
@@ -304,12 +310,93 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
     *body = std::move(out);
     return true;
   }
+  if (path == "/sockets") {
+    *body = Socket::DumpAll(500);
+    return true;
+  }
+  if (path == "/ids") {
+    *body = fid_dump_all(500);
+    return true;
+  }
+  if (path == "/vlog") {
+    // The reference's /vlog lists VLOG sites; the analogue here is the
+    // runtime log threshold, flippable like /flags (?setlevel=0..4).
+    static const char* kNames[] = {"debug", "info", "warning", "error",
+                                   "fatal"};
+    const std::string* lv = req.query("setlevel");
+    if (lv != nullptr) {
+      char* end = nullptr;
+      const long v = strtol(lv->c_str(), &end, 10);
+      if (end == lv->c_str() || *end != '\0' || v < 0 || v > 4) {
+        *status = 400;
+        *body = "setlevel must be 0(debug)..4(fatal)\n";
+        return true;
+      }
+      log_min_level().store(static_cast<int>(v),
+                            std::memory_order_relaxed);
+    }
+    const int cur = log_min_level().load(std::memory_order_relaxed);
+    *body = "min_log_level " + std::to_string(cur) + " (" +
+            kNames[cur < 0 || cur > 4 ? 1 : cur] + ")\n";
+    return true;
+  }
+  if (path == "/dir" || path.rfind("/dir/", 0) == 0) {
+    // Filesystem browser (reference: builtin/dir_service.cpp serves any
+    // path — same trust model: builtins are an operator surface).
+    std::string target =
+        path.size() > 4 ? path.substr(4) : std::string("/");
+    std::error_code ec;
+    if (std::filesystem::is_directory(target, ec)) {
+      std::string out;
+      std::vector<std::string> rows;
+      for (const auto& entry :
+           std::filesystem::directory_iterator(target, ec)) {
+        std::string row = entry.path().filename().string();
+        if (entry.is_directory(ec)) {
+          row += "/";
+        } else {
+          row += "  " + std::to_string(entry.file_size(ec));
+        }
+        rows.push_back(std::move(row));
+      }
+      std::sort(rows.begin(), rows.end());
+      for (const auto& r : rows) {
+        out += r + "\n";
+      }
+      *body = std::move(out);
+    } else if (std::filesystem::is_regular_file(target, ec)) {
+      FILE* f = fopen(target.c_str(), "rb");
+      if (f == nullptr) {
+        *status = 403;
+        *body = "cannot open " + target + "\n";
+        return true;
+      }
+      char buf[8192];
+      size_t n;
+      constexpr size_t kMaxFile = 4u << 20;
+      while ((n = fread(buf, 1, sizeof(buf), f)) > 0) {
+        body->append(buf, n);
+        if (body->size() > kMaxFile) {
+          body->resize(kMaxFile);
+          body->append("\n... (truncated at 4MB)\n");
+          break;
+        }
+      }
+      fclose(f);
+      *content_type = "application/octet-stream";
+    } else {
+      *status = 404;
+      *body = "no such path: " + target + "\n";
+    }
+    return true;
+  }
   if (path == "/index" || path == "/") {
     *body =
         "/health\n/version\n/status\n/vars\n/vars/<name>\n/brpc_metrics\n"
         "/connections\n/flags\n/flags/<name>[?setvalue=v]\n/threads\n"
         "/memory\n/list\n/protobufs\n/index\n/rpcz[?trace_id=hex]\n"
-        "/hotspots[?seconds=N]\n/contention\n/fibers\n"
+        "/hotspots[?seconds=N]\n/contention\n/fibers\n/sockets\n/ids\n"
+        "/vlog[?setlevel=N]\n/dir/<path>\n"
         "/pprof/profile[?seconds=N]\n/pprof/symbol\n/pprof/cmdline\n"
         "/pprof/heap\n";
     return true;

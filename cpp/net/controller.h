@@ -13,6 +13,7 @@
 
 #include "base/iobuf.h"
 #include "fiber/fid.h"
+#include "net/data_pool.h"
 
 namespace trpc {
 
@@ -108,8 +109,23 @@ class Controller {
     uint32_t h2_stream = 0;
     // Progressive response consumer (net/progressive.h; h2 client).
     ProgressiveReader* preader = nullptr;
+    // Session-local data (net/data_pool.h): the server's pool and the
+    // object lazily borrowed for this request.
+    SimpleDataPool* sl_pool = nullptr;
+    void* sl_data = nullptr;
   };
   CallState& call() { return call_; }
+
+  // Pooled per-request scratch object, created by the server's
+  // session_local_data_factory (simple_data_pool parity).  Null when no
+  // factory is installed.  Returned to the pool after the response.
+  void* session_local_data() {
+    if (call_.sl_data == nullptr && call_.sl_pool != nullptr) {
+      call_.sl_data = call_.sl_pool->Borrow();
+    }
+    return call_.sl_data;
+  }
+
   void set_method(const std::string& m) { method_ = m; }
   void set_latency_us(int64_t us) { latency_us_ = us; }
 

@@ -1,0 +1,89 @@
+// Session-local data pooling (reference: simple_data_pool.{h,cpp} +
+// data_factory.h; ServerOptions::session_local_data_factory →
+// Controller::session_local_data()).  Expensive per-request scratch
+// objects (parsers, arenas, model states) are created once and recycled
+// across requests instead of constructed per call.
+#pragma once
+
+#include <cstddef>
+#include <mutex>
+#include <vector>
+
+namespace trpc {
+
+class DataFactory {
+ public:
+  virtual ~DataFactory() = default;
+  virtual void* CreateData() = 0;
+  virtual void DestroyData(void* d) = 0;
+  // Called before an object is handed out again; default keeps state
+  // (matching the reference, where reuse-with-state is the point).
+  virtual void ResetData(void* d) { (void)d; }
+};
+
+class SimpleDataPool {
+ public:
+  explicit SimpleDataPool(DataFactory* factory) : factory_(factory) {}
+  ~SimpleDataPool() {
+    for (void* d : free_) {
+      factory_->DestroyData(d);
+    }
+  }
+
+  // Pre-creates `n` objects (ServerOptions::reserved_session_local_data
+  // parity) so first requests skip CreateData.
+  void Reserve(size_t n) {
+    std::lock_guard<std::mutex> g(mu_);
+    while (free_.size() < n) {
+      void* d = factory_->CreateData();
+      if (d == nullptr) {
+        return;
+      }
+      ++created_;
+      free_.push_back(d);
+    }
+  }
+
+  void* Borrow() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (!free_.empty()) {
+        void* d = free_.back();
+        free_.pop_back();
+        factory_->ResetData(d);
+        return d;
+      }
+    }
+    void* d = factory_->CreateData();
+    if (d != nullptr) {
+      std::lock_guard<std::mutex> g(mu_);
+      ++created_;
+    }
+    return d;
+  }
+
+  void Return(void* d) {
+    if (d == nullptr) {
+      return;
+    }
+    std::lock_guard<std::mutex> g(mu_);
+    free_.push_back(d);
+  }
+
+  size_t created() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return created_;
+  }
+  size_t free_count() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return free_.size();
+  }
+
+ private:
+  DataFactory* factory_;
+  mutable std::mutex mu_;
+  std::vector<void*> free_;
+  size_t created_ = 0;
+};
+
+}  // namespace trpc

@@ -172,6 +172,7 @@ void http_process_request(InputMessage&& msg) {
 
   auto* cntl = new Controller();
   cntl->set_method(rpc_name);
+  cntl->call().sl_pool = srv->session_data_pool();
   auto* response = new IOBuf();
   const SocketId sid = msg.socket;
   const int64_t start_us = monotonic_time_us();
@@ -223,6 +224,9 @@ void http_process_request(InputMessage&& msg) {
       *lat << (monotonic_time_us() - start_us);
     }
     delete response;
+    if (cntl->call().sl_data != nullptr) {
+      cntl->call().sl_pool->Return(cntl->call().sl_data);
+    }
     delete cntl;
     srv->requests_served.fetch_add(1, std::memory_order_relaxed);
     srv->in_flight.fetch_sub(1, std::memory_order_acq_rel);

@@ -210,6 +210,11 @@ void expose_default_variables();  // stat/default_variables.cc
 int Server::Start(int port) {
   fiber_init(0);
   expose_default_variables();
+  if (session_data_factory_ != nullptr && session_data_pool_ == nullptr) {
+    session_data_pool_ =
+        std::make_unique<SimpleDataPool>(session_data_factory_);
+    session_data_pool_->Reserve(session_data_reserve_);
+  }
   tstd_protocol();  // ensure registered (first: most traffic is RPC)
   // hulu/sofa next: their 4-byte ASCII magics must be probed before the
   // HTTP parser sees the 'H'/'S' and holds the bytes as a method line.
@@ -489,6 +494,8 @@ void tstd_process_request(InputMessage&& msg) {
   cntl->call().socket_id = socket_id;
   cntl->call().peer_stream = msg.meta.stream_id;
   cntl->call().peer_stream_window = msg.meta.ack_bytes;
+  cntl->call().sl_pool =
+      srv != nullptr ? srv->session_data_pool() : nullptr;
   auto* response = new IOBuf();
   const int64_t start_us = monotonic_time_us();
   // rpcz: server span, linked to the client span via the meta's trace
@@ -577,6 +584,9 @@ void tstd_process_request(InputMessage&& msg) {
       span->response_bytes = response->size();
       submit_span(span, cntl->error_code());
     }
+    if (cntl->call().sl_data != nullptr) {
+      cntl->call().sl_pool->Return(cntl->call().sl_data);
+    }
     delete response;
     delete cntl;
     if (srv != nullptr) {
@@ -591,7 +601,7 @@ void tstd_process_request(InputMessage&& msg) {
     done();
     return;
   }
-  if (prop == nullptr) {
+  if (prop == nullptr && !srv->generic_handler()) {
     cntl->SetFailed(ENOENT, "no such method: " + method);
     done();
     return;
@@ -640,18 +650,23 @@ void tstd_process_request(InputMessage&& msg) {
   if (msg.meta.has_checksum) {
     cntl->set_enable_checksum(true);  // checksum the response too
   }
+  // Registered handler, else the catch-all (generic-call parity).  A
+  // pointer, not a copy: both live in server-owned storage that
+  // in_flight keeps alive until the last done() runs.
+  const Server::Handler* handler =
+      prop != nullptr ? &prop->handler : &srv->generic_handler();
   if (srv->usercode_in_pthread()) {
     // Blocking-tolerant path: the handler runs on a backup pthread so a
     // pthread-blocking body cannot pin this fiber worker.  done() is
     // thread-agnostic (Socket::Write is callable from any thread).
     UsercodePool::instance()->run(
-        [prop, cntl, request = std::move(request), response,
+        [handler, cntl, request = std::move(request), response,
          done = std::move(done)]() mutable {
-          prop->handler(cntl, request, response, std::move(done));
+          (*handler)(cntl, request, response, std::move(done));
         });
     return;
   }
-  prop->handler(cntl, request, response, std::move(done));
+  (*handler)(cntl, request, response, std::move(done));
 }
 
 }  // namespace trpc

@@ -21,6 +21,7 @@
 #include "fiber/sync.h"
 #include "net/concurrency_limiter.h"
 #include "net/controller.h"
+#include "net/data_pool.h"
 #include "net/socket.h"
 #include "stat/latency_recorder.h"
 
@@ -99,6 +100,19 @@ class Server {
   void set_usercode_in_pthread(bool on) { usercode_in_pthread_ = on; }
   bool usercode_in_pthread() const { return usercode_in_pthread_; }
 
+  // Session-local data: pooled per-request scratch objects handed to
+  // handlers via Controller::session_local_data() (net/data_pool.h;
+  // parity: ServerOptions::session_local_data_factory +
+  // reserved_session_local_data, simple_data_pool.*).  Factory not
+  // owned.  Call before Start.
+  void set_session_local_data_factory(DataFactory* f, size_t reserve = 0) {
+    session_data_factory_ = f;
+    session_data_reserve_ = reserve;
+  }
+  SimpleDataPool* session_data_pool() const {
+    return session_data_pool_.get();
+  }
+
   // Makes this server answer mongo drivers (OP_MSG) on its port
   // (net/mongo.h; parity: policy/mongo_protocol.cpp server adaptor).
   // Not owned.  Call before Start.
@@ -152,6 +166,14 @@ class Server {
 
   // Register before Start.  Name format "Service.Method" by convention.
   int RegisterMethod(const std::string& full_name, Handler handler);
+
+  // Catch-all handler (parity: BaiduMasterService,
+  // baidu_master_service.h:36 + generic call proxying): requests whose
+  // method has no registered handler route here with the raw body; the
+  // method name is Controller::method().  The building block for
+  // protocol-agnostic proxies.  Call before Start.
+  void set_generic_handler(Handler h) { generic_handler_ = std::move(h); }
+  const Handler& generic_handler() const { return generic_handler_; }
 
   // Maps an HTTP path pattern onto a registered method (parity: the
   // reference's RestfulMap, restful.h:62).  Patterns match whole path
@@ -211,6 +233,10 @@ class Server {
   NsheadService* nshead_service_ = nullptr;
   EspService* esp_service_ = nullptr;
   bool usercode_in_pthread_ = false;
+  Handler generic_handler_;
+  DataFactory* session_data_factory_ = nullptr;
+  size_t session_data_reserve_ = 0;
+  std::unique_ptr<SimpleDataPool> session_data_pool_;
   bool nova_pbrpc_ = false;
   bool public_pbrpc_ = false;
   void* tls_ctx_ = nullptr;  // SSL_CTX (leaked singleton; net/tls.h)

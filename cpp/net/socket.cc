@@ -10,6 +10,7 @@
 #include "base/time.h"
 #include "fiber/fiber.h"
 #include "fiber/scheduler.h"
+#include "net/protocol.h"
 #include "net/dispatcher.h"
 
 namespace trpc {
@@ -111,6 +112,40 @@ bool Socket::Draining(SocketId id) {
 SocketId Socket::id() const {
   return pack(ver_of(ref_ver_.load(std::memory_order_acquire)), 0) |
          slot_.load(std::memory_order_relaxed);
+}
+
+std::string Socket::DumpAll(size_t max_rows) {
+  return dump_pool_table<Socket>(
+      "live sockets (id  fd  remote  mode  proto  state)\n", max_rows,
+      [](uint32_t slot, Socket* s, std::string* line) {
+        const uint64_t rv = s->ref_ver_.load(std::memory_order_acquire);
+        if ((ver_of(rv) & 1) == 0 || ref_of(rv) == 0) {
+          return false;  // even generation = recycled/failed slot
+        }
+        if (line == nullptr) {
+          return true;  // counted, rows already capped
+        }
+        // Hold a real reference while reading the non-atomic fields —
+        // a bare snapshot would race reset_for_reuse on a recycled
+        // slot.  Address re-validates the generation; a slot recycled
+        // since the check above simply drops out of the table.
+        SocketRef ref(Socket::Address(pack(ver_of(rv), 0) | slot));
+        if (!ref) {
+          return false;
+        }
+        const Protocol* p = protocol_at(ref->pinned_protocol);
+        char buf[192];
+        snprintf(buf, sizeof(buf), "%016llx  %3d  %s  %s  %s  %s\n",
+                 static_cast<unsigned long long>(pack(ver_of(rv), slot)),
+                 ref->fd(), endpoint2str(ref->remote()).c_str(),
+                 ref->mode() == SocketMode::kTcp
+                     ? "tcp"
+                     : ref->mode() == SocketMode::kShm ? "shm" : "?",
+                 p != nullptr ? p->name : "-",
+                 ref->connected() ? "connected" : "connecting");
+        *line = buf;
+        return true;
+      });
 }
 
 void Socket::Dereference() {

@@ -71,6 +71,10 @@ class Socket {
   // close before this one leaves.
   int Write(IOBuf&& data, bool close_after = false);
 
+  // Text table of every live socket (/sockets builtin; reference:
+  // builtin/sockets_service.cpp printing Socket::DebugString).
+  static std::string DumpAll(size_t max_rows);
+
   int fd() const { return fd_; }
   SocketMode mode() const { return mode_; }
   SocketId id() const;

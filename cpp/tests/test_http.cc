@@ -579,4 +579,32 @@ TEST_CASE(rpcz_linked_spans) {
   http_get("GET /flags/rpcz_enabled?setvalue=false HTTP/1.1\r\nHost: x\r\n\r\n");
 }
 
+TEST_CASE(sockets_ids_vlog_dir_endpoints) {
+  start_once();
+  // /sockets lists this very connection (it is live while served).
+  std::string r = http_get("GET /sockets HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("live sockets") != std::string::npos);
+  EXPECT(r.find("127.0.0.1") != std::string::npos);
+  EXPECT(r.find(" live") != std::string::npos);
+  // /ids shows the correlation-id table (may be empty between calls).
+  r = http_get("GET /ids HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("live correlation ids") != std::string::npos);
+  // /vlog reads and flips the runtime log threshold, with validation.
+  r = http_get("GET /vlog HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("min_log_level") != std::string::npos);
+  r = http_get("GET /vlog?setlevel=3 HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("min_log_level 3 (error)") != std::string::npos);
+  r = http_get("GET /vlog?setlevel=9 HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("400") != std::string::npos);
+  r = http_get("GET /vlog?setlevel=1 HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("min_log_level 1 (info)") != std::string::npos);
+  // /dir browses directories and serves files.
+  r = http_get("GET /dir/proc/self HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("cmdline") != std::string::npos);
+  r = http_get("GET /dir/proc/self/cmdline HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("test_http") != std::string::npos);
+  r = http_get("GET /dir/no/such/path HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("404") != std::string::npos);
+}
+
 TEST_MAIN

@@ -597,4 +597,129 @@ TEST_CASE(interceptor_gates_every_protocol) {
   }
 }
 
+TEST_CASE(generic_handler_proxies_unknown_methods) {
+  // Backend speaks Echo.Echo; the proxy has NO methods, only the
+  // catch-all, and forwards verbatim (BaiduMasterService/generic-call
+  // parity — the reference's example/baidu_proxy_and_generic_call).
+  start_server_once();
+  Server proxy;
+  auto backend_ch = std::make_shared<Channel>();
+  EXPECT_EQ(backend_ch->Init(addr()), 0);
+  proxy.set_generic_handler([backend_ch](Controller* cntl,
+                                         const IOBuf& req, IOBuf* resp,
+                                         Closure done) {
+    Controller fwd;
+    fwd.set_timeout_ms(2000);
+    backend_ch->CallMethod(cntl->method(), req, resp, &fwd);
+    if (fwd.Failed()) {
+      cntl->SetFailed(fwd.error_code(), "proxy: " + fwd.error_text());
+    }
+    done();
+  });
+  EXPECT_EQ(proxy.Start(0), 0);
+  Channel ch;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(proxy.port())), 0);
+  {
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("through-the-proxy");
+    ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+    EXPECT(resp.to_string() == "through-the-proxy");
+  }
+  {
+    // Methods the BACKEND lacks surface its ENOENT through the proxy.
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("x");
+    ch.CallMethod("No.Such", req, &resp, &cntl);
+    EXPECT(cntl.Failed());
+    EXPECT_EQ(cntl.error_code(), ENOENT);
+  }
+  proxy.Stop();
+  proxy.Join();
+}
+
+namespace {
+// Counting factory: proves pooling (few creates, many requests).
+struct CountingFactory : DataFactory {
+  std::atomic<int> created{0};
+  std::atomic<int> destroyed{0};
+  void* CreateData() override {
+    created.fetch_add(1);
+    return new std::string("scratch");
+  }
+  void DestroyData(void* d) override {
+    destroyed.fetch_add(1);
+    delete static_cast<std::string*>(d);
+  }
+};
+}  // namespace
+
+TEST_CASE(session_local_data_pooled_across_requests) {
+  static CountingFactory factory;
+  {
+    Server srv;
+    srv.set_session_local_data_factory(&factory, /*reserve=*/2);
+    srv.RegisterMethod("S.Use", [](Controller* cntl, const IOBuf&,
+                                   IOBuf* resp, Closure done) {
+      auto* scratch = static_cast<std::string*>(cntl->session_local_data());
+      resp->append(scratch != nullptr ? *scratch : "null");
+      done();
+    });
+    srv.RegisterMethod("S.Skip", [](Controller*, const IOBuf&,
+                                    IOBuf* resp, Closure done) {
+      resp->append("untouched");
+      done();  // never borrows: the pool must not be charged
+    });
+    EXPECT_EQ(srv.Start(0), 0);
+    EXPECT_EQ(factory.created.load(), 2);  // reserve pre-created
+    Channel ch;
+    EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(srv.port())), 0);
+    for (int i = 0; i < 20; ++i) {
+      Controller cntl;
+      IOBuf req, resp;
+      ch.CallMethod("S.Use", req, &resp, &cntl);
+      EXPECT(!cntl.Failed());
+      EXPECT(resp.to_string() == "scratch");
+    }
+    for (int i = 0; i < 5; ++i) {
+      Controller cntl;
+      IOBuf req, resp;
+      ch.CallMethod("S.Skip", req, &resp, &cntl);
+      EXPECT(!cntl.Failed());
+    }
+    // Sequential requests reuse the reserved objects: no growth.
+    EXPECT_EQ(factory.created.load(), 2);
+    EXPECT_EQ(srv.session_data_pool()->free_count(), 2u);
+    srv.Stop();
+    srv.Join();
+  }
+}
+
+TEST_CASE(session_local_data_null_without_factory) {
+  start_server_once();
+  // The shared server has no factory: handlers see nullptr.  Exercised
+  // through a method registered here on a fresh server to keep the
+  // assertion in-handler.
+  Server srv;
+  std::atomic<bool> saw_null{false};
+  srv.RegisterMethod("S.Null", [&saw_null](Controller* cntl, const IOBuf&,
+                                           IOBuf* resp, Closure done) {
+    saw_null.store(cntl->session_local_data() == nullptr);
+    resp->append("ok");
+    done();
+  });
+  EXPECT_EQ(srv.Start(0), 0);
+  Channel ch;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(srv.port())), 0);
+  Controller cntl;
+  IOBuf req, resp;
+  ch.CallMethod("S.Null", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+  EXPECT(saw_null.load());
+  srv.Stop();
+  srv.Join();
+}
+
 TEST_MAIN
