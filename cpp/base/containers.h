@@ -1,8 +1,9 @@
 // The remaining small containers from butil/containers/ that std::
 // doesn't already cover (reference: mru_cache.h, case_ignored_flat_map.h,
 // bounded_queue.h, mpsc_queue.h — /root/reference/src/butil/containers/).
-// Re-designed minimal: each is the data structure the runtime actually
-// needs, not a port of the Chromium originals.
+// Re-designed minimal, offered as the user-facing container surface the
+// reference's public headers provide — the runtime's own hot paths keep
+// their specialized structures (Chase-Lev deque, ExecutionQueue).
 #pragma once
 
 #include <atomic>
@@ -111,8 +112,8 @@ class CaseIgnoredFlatMap {
 };
 
 // Fixed-capacity ring (reference bounded_queue.h): no allocation after
-// construction, no thread safety — the building block used under locks
-// (e.g. the remote task queue).
+// construction, no thread safety — for use under a caller's lock.
+// (The scheduler's remote queue predates this and keeps its own ring.)
 template <typename T>
 class BoundedQueue {
  public:

@@ -1,0 +1,81 @@
+// generic_proxy — a method-agnostic RPC proxy built on the catch-all
+// handler: every request, whatever its method, is forwarded verbatim to
+// a backend and the response relayed (parity:
+// example/baidu_proxy_and_generic_call + BaiduMasterService).
+//
+// Build: cmake --build build --target example_generic_proxy
+#include <cstdio>
+#include <memory>
+
+#include "net/channel.h"
+#include "net/server.h"
+
+using namespace trpc;
+
+int main() {
+  // Backend with two real methods.
+  Server backend;
+  backend.RegisterMethod("Echo.Echo", [](Controller*, const IOBuf& req,
+                                         IOBuf* resp, Closure done) {
+    resp->append(req);
+    done();
+  });
+  backend.RegisterMethod("Math.Square",
+                         [](Controller*, const IOBuf& req, IOBuf* resp,
+                            Closure done) {
+                           const long v = atol(req.to_string().c_str());
+                           resp->append(std::to_string(v * v));
+                           done();
+                         });
+  if (backend.Start(0) != 0) {
+    return 1;
+  }
+
+  // The proxy registers NO methods — only the generic handler, which
+  // sees the method name via cntl->method() and the raw body.
+  Server proxy;
+  auto upstream = std::make_shared<Channel>();
+  if (upstream->Init("127.0.0.1:" + std::to_string(backend.port())) != 0) {
+    return 1;
+  }
+  proxy.set_generic_handler([upstream](Controller* cntl, const IOBuf& req,
+                                       IOBuf* resp, Closure done) {
+    Controller fwd;
+    fwd.set_timeout_ms(2000);
+    upstream->CallMethod(cntl->method(), req, resp, &fwd);
+    if (fwd.Failed()) {
+      cntl->SetFailed(fwd.error_code(), "via proxy: " + fwd.error_text());
+    }
+    done();
+  });
+  if (proxy.Start(0) != 0) {
+    return 1;
+  }
+  printf("proxy %d -> backend %d\n", proxy.port(), backend.port());
+
+  Channel ch;
+  if (ch.Init("127.0.0.1:" + std::to_string(proxy.port())) != 0) {
+    return 1;
+  }
+  for (const auto& [method, body] :
+       {std::pair<std::string, std::string>{"Echo.Echo", "hello"},
+        {"Math.Square", "12"},
+        {"No.Such", "x"}}) {
+    Controller cntl;
+    IOBuf req, resp;
+    req.append(body);
+    ch.CallMethod(method, req, &resp, &cntl);
+    if (cntl.Failed()) {
+      printf("%-12s -> error %d (%s)\n", method.c_str(),
+             cntl.error_code(), cntl.error_text().c_str());
+    } else {
+      printf("%-12s -> %s\n", method.c_str(), resp.to_string().c_str());
+    }
+  }
+  proxy.Stop();
+  proxy.Join();
+  backend.Stop();
+  backend.Join();
+  printf("ok\n");
+  return 0;
+}

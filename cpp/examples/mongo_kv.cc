@@ -1,0 +1,105 @@
+// mongo_kv — a mongo-speaking server (OP_MSG + BSON, stock drivers can
+// connect) exposing insert/find over an in-memory store, driven by the
+// MongoClient (parity: policy/mongo_protocol.cpp server adaptor).
+//
+// Build: cmake --build build --target example_mongo_kv
+#include <cstdio>
+#include <map>
+
+#include "net/mongo.h"
+#include "net/server.h"
+
+using namespace trpc;
+
+int main() {
+  static std::map<std::string, std::string> store;
+  auto* svc = new MongoService();
+  svc->AddCommandHandler("insert", [](const BsonDoc& req) {
+    // {insert: <collection>, documents: [{_id, value}, ...]}
+    const BsonValue* docs = bson_find(req, "documents");
+    int n = 0;
+    if (docs != nullptr && docs->doc != nullptr) {
+      for (const auto& [idx, d] : *docs->doc) {
+        if (d.doc == nullptr) continue;
+        const BsonValue* id = bson_find(*d.doc, "_id");
+        const BsonValue* val = bson_find(*d.doc, "value");
+        if (id != nullptr && val != nullptr) {
+          store[id->str] = val->str;
+          ++n;
+        }
+      }
+    }
+    BsonDoc reply = MongoService::ok_reply();
+    reply.emplace_back("n", BsonValue::Int32(n));
+    return reply;
+  });
+  svc->AddCommandHandler("find", [](const BsonDoc& req) {
+    // {find: <collection>, filter: {_id: key}}
+    BsonDoc reply = MongoService::ok_reply();
+    const BsonValue* filter = bson_find(req, "filter");
+    std::vector<BsonValue> batch;
+    if (filter != nullptr && filter->doc != nullptr) {
+      const BsonValue* id = bson_find(*filter->doc, "_id");
+      auto it = id != nullptr ? store.find(id->str) : store.end();
+      if (it != store.end()) {
+        batch.push_back(BsonValue::Document(
+            {{"_id", BsonValue::Str(it->first)},
+             {"value", BsonValue::Str(it->second)}}));
+      }
+    }
+    reply.emplace_back(
+        "cursor", BsonValue::Document(
+                      {{"id", BsonValue::Int64(0)},
+                       {"firstBatch", BsonValue::Array(std::move(batch))}}));
+    return reply;
+  });
+
+  Server server;
+  server.set_mongo_service(svc);
+  if (server.Start(0) != 0) {
+    return 1;
+  }
+  printf("mongo-speaking server on 127.0.0.1:%d\n", server.port());
+
+  MongoClient cli;
+  if (cli.Init("127.0.0.1:" + std::to_string(server.port())) != 0) {
+    return 1;
+  }
+  // The driver handshake a real client would send works too.
+  MongoClient::Result hello = cli.run_command({{"hello", BsonValue::Int32(1)}});
+  printf("hello -> ok=%d\n", hello.ok);
+
+  MongoClient::Result ins = cli.run_command(
+      {{"insert", BsonValue::Str("kv")},
+       {"documents",
+        BsonValue::Array({BsonValue::Document(
+            {{"_id", BsonValue::Str("alpha")},
+             {"value", BsonValue::Str("the-first-letter")}})})}});
+  const BsonValue* n = ins.ok ? bson_find(ins.reply, "n") : nullptr;
+  printf("insert -> n=%lld\n",
+         n != nullptr ? static_cast<long long>(n->i) : -1);
+
+  MongoClient::Result found = cli.run_command(
+      {{"find", BsonValue::Str("kv")},
+       {"filter", BsonValue::Document({{"_id", BsonValue::Str("alpha")}})}});
+  const BsonValue* cursor =
+      found.ok ? bson_find(found.reply, "cursor") : nullptr;
+  const BsonValue* batch =
+      cursor != nullptr && cursor->doc != nullptr
+          ? bson_find(*cursor->doc, "firstBatch")
+          : nullptr;
+  if (batch == nullptr || batch->doc == nullptr || batch->doc->empty()) {
+    fprintf(stderr, "find returned nothing\n");
+    return 1;
+  }
+  const BsonValue& doc0 = (*batch->doc)[0].second;
+  const BsonValue* value =
+      doc0.doc != nullptr ? bson_find(*doc0.doc, "value") : nullptr;
+  printf("find alpha -> %s\n",
+         value != nullptr ? value->str.c_str() : "?");
+
+  server.Stop();
+  server.Join();
+  printf("ok\n");
+  return 0;
+}

@@ -56,6 +56,8 @@ void Event::lock() {
   while (lock_.test_and_set(std::memory_order_acquire)) {
 #if defined(__x86_64__)
     __builtin_ia32_pause();
+#elif defined(__aarch64__)
+    __asm__ volatile("yield");
 #endif
   }
 }

@@ -109,6 +109,7 @@ extern "C" void trpc_context_trampoline();
 
 void* make_context(void* stack_base, size_t size, void (*entry)(void*)) {
   uintptr_t top = (reinterpret_cast<uintptr_t>(stack_base) + size) & ~15ull;
+#if defined(__x86_64__)
   // Layout (context.S): 64 bytes — fpu word, 6 regs, ret addr.
   uint64_t* frame = reinterpret_cast<uint64_t*>(top - 64);
   uint32_t mxcsr = 0;
@@ -123,6 +124,19 @@ void* make_context(void* stack_base, size_t size, void (*entry)(void*)) {
   frame[6] = 0;                                     // rbp
   frame[7] = reinterpret_cast<uint64_t>(&trpc_context_trampoline);
   return frame;
+#elif defined(__aarch64__)
+  // Layout (context.S): 160 bytes — d8..d15, x19..x28, x29, x30.
+  uint64_t* frame = reinterpret_cast<uint64_t*>(top - 160);
+  for (int i = 0; i < 20; ++i) {
+    frame[i] = 0;  // d8..d15 (8), x19..x28 (10 slots start at [8])
+  }
+  frame[8] = reinterpret_cast<uint64_t>(entry);  // x19 → trampoline target
+  frame[18] = 0;                                 // x29 (fp)
+  frame[19] = reinterpret_cast<uint64_t>(&trpc_context_trampoline);  // x30
+  return frame;
+#else
+#error "unsupported architecture: add a make_context block"
+#endif
 }
 
 }  // namespace trpc

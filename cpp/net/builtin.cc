@@ -341,8 +341,20 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
     return true;
   }
   if (path == "/dir" || path.rfind("/dir/", 0) == 0) {
-    // Filesystem browser (reference: builtin/dir_service.cpp serves any
-    // path — same trust model: builtins are an operator surface).
+    // Filesystem browser.  Opt-in like the reference (DirService only
+    // registers behind -enable_dir_service, server.cpp:119, default
+    // false) because it serves ANY path; flip live via
+    // /flags/enable_dir_service?setvalue=true.
+    static Flag* gate = Flag::define_bool(
+        "enable_dir_service", false,
+        "serve the /dir filesystem browser (reference: "
+        "-enable_dir_service)");
+    if (!gate->bool_value()) {
+      *status = 403;
+      *body =
+          "disabled; enable with /flags/enable_dir_service?setvalue=true\n";
+      return true;
+    }
     std::string target =
         path.size() > 4 ? path.substr(4) : std::string("/");
     std::error_code ec;
@@ -355,7 +367,11 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
         if (entry.is_directory(ec)) {
           row += "/";
         } else {
-          row += "  " + std::to_string(entry.file_size(ec));
+          std::error_code size_ec;
+          const auto sz = entry.file_size(size_ec);
+          // Dangling symlinks / proc pseudo-files have no stat-able
+          // size; print "?" instead of uintmax_t(-1).
+          row += size_ec ? "  ?" : "  " + std::to_string(sz);
         }
         rows.push_back(std::move(row));
       }

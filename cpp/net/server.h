@@ -168,10 +168,11 @@ class Server {
   int RegisterMethod(const std::string& full_name, Handler handler);
 
   // Catch-all handler (parity: BaiduMasterService,
-  // baidu_master_service.h:36 + generic call proxying): requests whose
-  // method has no registered handler route here with the raw body; the
-  // method name is Controller::method().  The building block for
-  // protocol-agnostic proxies.  Call before Start.
+  // baidu_master_service.h:36 + generic call proxying): tstd requests
+  // whose method has no registered handler route here with the raw
+  // body; the method name is Controller::method().  tstd only, like the
+  // reference (BaiduMasterService serves baidu_std exclusively) — HTTP
+  // and h2 answer 404/unimplemented as usual.  Call before Start.
   void set_generic_handler(Handler h) { generic_handler_ = std::move(h); }
   const Handler& generic_handler() const { return generic_handler_; }
 

@@ -598,13 +598,23 @@ TEST_CASE(sockets_ids_vlog_dir_endpoints) {
   EXPECT(r.find("400") != std::string::npos);
   r = http_get("GET /vlog?setlevel=1 HTTP/1.1\r\nHost: x\r\n\r\n");
   EXPECT(r.find("min_log_level 1 (info)") != std::string::npos);
-  // /dir browses directories and serves files.
+  // /dir is opt-in (reference: -enable_dir_service defaults false).
+  r = http_get("GET /dir/proc/self HTTP/1.1\r\nHost: x\r\n\r\n");
+  EXPECT(r.find("403") != std::string::npos);
+  r = http_get(
+      "GET /flags/enable_dir_service?setvalue=true HTTP/1.1\r\n"
+      "Host: x\r\n\r\n");
+  EXPECT(r.find("200 OK") != std::string::npos);
   r = http_get("GET /dir/proc/self HTTP/1.1\r\nHost: x\r\n\r\n");
   EXPECT(r.find("cmdline") != std::string::npos);
   r = http_get("GET /dir/proc/self/cmdline HTTP/1.1\r\nHost: x\r\n\r\n");
   EXPECT(r.find("test_http") != std::string::npos);
   r = http_get("GET /dir/no/such/path HTTP/1.1\r\nHost: x\r\n\r\n");
   EXPECT(r.find("404") != std::string::npos);
+  r = http_get(
+      "GET /flags/enable_dir_service?setvalue=false HTTP/1.1\r\n"
+      "Host: x\r\n\r\n");
+  EXPECT(r.find("200 OK") != std::string::npos);
 }
 
 TEST_MAIN

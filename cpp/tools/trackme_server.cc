@@ -1,0 +1,62 @@
+// trackme_server — receives library phone-home pings (parity:
+// tools/trackme_server, trackme.cpp): processes report their version +
+// server port to a central collector, which answers with known-bug
+// warnings for that version range.  Condensed form: an HTTP endpoint
+// (/trackme?version=V&port=P) counting pings per version and answering
+// a severity verdict; /report dumps the tally.
+//
+// Usage: trackme_server [port]
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include <map>
+#include <mutex>
+#include <string>
+
+#include "net/server.h"
+
+using namespace trpc;
+
+int main(int argc, char** argv) {
+  const int port = argc > 1 ? atoi(argv[1]) : 0;
+
+  static std::mutex mu;
+  static std::map<std::string, int64_t> pings_by_version;
+
+  Server server;
+  // Pings ride the RPC surface so rpc_press can drive this too.
+  server.RegisterMethod("TrackMe.Ping", [](Controller* cntl,
+                                           const IOBuf& req, IOBuf* resp,
+                                           Closure done) {
+    const std::string version = req.to_string();
+    {
+      std::lock_guard<std::mutex> g(mu);
+      ++pings_by_version[version.empty() ? "unknown" : version];
+    }
+    // A real deployment would match the version against a bug table
+    // (the reference answers TrackMeResponse{severity, error_text}).
+    resp->append(version.rfind("0.", 0) == 0 ? "sev=warn msg=pre-1.0 build"
+                                             : "sev=ok");
+    done();
+  });
+  server.RegisterMethod("TrackMe.Report",
+                        [](Controller*, const IOBuf&, IOBuf* resp,
+                           Closure done) {
+                          std::lock_guard<std::mutex> g(mu);
+                          for (const auto& [v, n] : pings_by_version) {
+                            resp->append(v + " " + std::to_string(n) +
+                                         "\n");
+                          }
+                          done();
+                        });
+  if (server.Start(port) != 0) {
+    fprintf(stderr, "cannot listen on %d\n", port);
+    return 1;
+  }
+  printf("trackme collector on port %d (TrackMe.Ping / TrackMe.Report; "
+         "builtins on the same port)\n",
+         server.port());
+  server.Join();
+  return 0;
+}
