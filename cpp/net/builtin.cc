@@ -34,6 +34,13 @@ std::atomic<int64_t> g_socket_count{0};
 
 namespace {
 
+// Defined at load time so /flags can list and flip it before any /dir
+// request arrives (function-local statics in the registry make this
+// initialization-order-safe).
+Flag* dir_service_flag = Flag::define_bool(
+    "enable_dir_service", false,
+    "serve the /dir filesystem browser (reference: -enable_dir_service)");
+
 std::string flags_text() {
   std::string out;
   for (Flag* f : Flag::all()) {
@@ -345,11 +352,7 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
     // registers behind -enable_dir_service, server.cpp:119, default
     // false) because it serves ANY path; flip live via
     // /flags/enable_dir_service?setvalue=true.
-    static Flag* gate = Flag::define_bool(
-        "enable_dir_service", false,
-        "serve the /dir filesystem browser (reference: "
-        "-enable_dir_service)");
-    if (!gate->bool_value()) {
+    if (!dir_service_flag->bool_value()) {
       *status = 403;
       *body =
           "disabled; enable with /flags/enable_dir_service?setvalue=true\n";

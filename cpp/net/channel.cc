@@ -118,11 +118,30 @@ void tstd_process_response(InputMessage&& msg) {
     return;  // stale response (timed out / retried away): harmless
   }
   Controller* cntl = static_cast<Controller*>(data);
-  if (msg.meta.stream_id != 0 && cntl->call().offered_stream != 0) {
-    // Server accepted our stream: bind ids + adopt its advertised window.
-    stream_on_accept_response(cntl->call().offered_stream,
-                              msg.meta.stream_id, cntl->call().socket_id,
-                              msg.meta.ack_bytes);
+  if (cntl->call().offered_stream != 0) {
+    const auto& offered = cntl->call().extra_offered;
+    const auto& accepted = msg.meta.extra_streams;
+    if (msg.meta.stream_id != 0) {
+      // Server accepted: bind ids + adopt its advertised window.
+      stream_on_accept_response(cntl->call().offered_stream,
+                                msg.meta.stream_id,
+                                cntl->call().socket_id,
+                                msg.meta.ack_bytes);
+      // Batch acceptances align by index with our extra offers.
+      for (size_t i = 0; i < offered.size() && i < accepted.size(); ++i) {
+        stream_on_accept_response(offered[i], accepted[i].first,
+                                  cntl->call().socket_id,
+                                  accepted[i].second);
+      }
+    } else {
+      // The handler never accepted (plain response / older peer): a
+      // hanging unestablished stream would park writers forever.
+      StreamClose(cntl->call().offered_stream);
+    }
+    // Extras the server did not accept are dead the same way.
+    for (size_t i = accepted.size(); i < offered.size(); ++i) {
+      StreamClose(offered[i]);
+    }
   }
   if (msg.meta.error_code != 0) {
     cntl->SetFailed(msg.meta.error_code, msg.meta.error_text);
@@ -451,6 +470,9 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
   meta.stream_id = cntl->call().offered_stream;  // stream offer piggyback
   if (meta.stream_id != 0) {
     meta.ack_bytes = stream_recv_window(meta.stream_id);  // advertise window
+    for (uint64_t sid : cntl->call().extra_offered) {  // batch offers
+      meta.extra_streams.emplace_back(sid, stream_recv_window(sid));
+    }
   }
   if (span != nullptr) {
     meta.trace_id = span->trace_id;   // server links as our child

@@ -10,6 +10,8 @@
 #include <functional>
 #include <memory>
 #include <string>
+#include <utility>
+#include <vector>
 
 #include "base/iobuf.h"
 #include "fiber/fid.h"
@@ -104,6 +106,11 @@ class Controller {
     uint64_t peer_stream = 0;
     uint64_t peer_stream_window = 0;
     uint64_t accepted_stream = 0;
+    // Batch establishment (StreamIds parity): offers/acceptances beyond
+    // the first, index-aligned through the meta's extra_streams tail.
+    std::vector<uint64_t> extra_offered;
+    std::vector<std::pair<uint64_t, uint64_t>> extra_peer;  // (sid, window)
+    std::vector<uint64_t> extra_accepted;
     // h2/grpc calls: the stream id issued for this call, so a failed call
     // (timeout) can cancel its client-side stream state (h2_client.h).
     uint32_t h2_stream = 0;

@@ -1,0 +1,346 @@
+#include "net/mpegts.h"
+
+#include <cstring>
+
+namespace trpc {
+
+namespace {
+
+constexpr size_t kTsPacket = 188;
+constexpr uint8_t kSync = 0x47;
+
+void put_pts(std::string* out, uint64_t pts) {
+  // 33 bits over 5 bytes: 0010 | pts[32:30] | 1 | pts[29:15] | 1 |
+  // pts[14:0] | 1.
+  out->push_back(static_cast<char>(0x20 | ((pts >> 29) & 0x0e) | 1));
+  out->push_back(static_cast<char>(pts >> 22));
+  out->push_back(static_cast<char>(((pts >> 14) & 0xfe) | 1));
+  out->push_back(static_cast<char>(pts >> 7));
+  out->push_back(static_cast<char>(((pts << 1) & 0xfe) | 1));
+}
+
+bool get_pts(const uint8_t* p, uint64_t* pts) {
+  if ((p[0] & 0x01) == 0 || (p[2] & 0x01) == 0 || (p[4] & 0x01) == 0) {
+    return false;  // marker bits
+  }
+  *pts = (static_cast<uint64_t>(p[0] & 0x0e) << 29) |
+         (static_cast<uint64_t>(p[1]) << 22) |
+         (static_cast<uint64_t>(p[2] & 0xfe) << 14) |
+         (static_cast<uint64_t>(p[3]) << 7) | (p[4] >> 1);
+  return true;
+}
+
+// Builds a PSI section (pointer_field + table through CRC).
+std::string psi_section(uint8_t table_id, uint16_t table_id_ext,
+                        const std::string& body) {
+  std::string sec;
+  sec.push_back(static_cast<char>(table_id));
+  const size_t len = 5 + body.size() + 4;  // after length field, incl CRC
+  sec.push_back(static_cast<char>(0xb0 | ((len >> 8) & 0x0f)));
+  sec.push_back(static_cast<char>(len));
+  sec.push_back(static_cast<char>(table_id_ext >> 8));
+  sec.push_back(static_cast<char>(table_id_ext));
+  sec.push_back(static_cast<char>(0xc1));  // version 0, current
+  sec.push_back(0);                        // section_number
+  sec.push_back(0);                        // last_section_number
+  sec.append(body);
+  const uint32_t crc = mpeg_crc32(
+      reinterpret_cast<const uint8_t*>(sec.data()), sec.size());
+  for (int i = 3; i >= 0; --i) {
+    sec.push_back(static_cast<char>(crc >> (8 * i)));
+  }
+  return std::string(1, '\0') + sec;  // pointer_field = 0
+}
+
+}  // namespace
+
+uint32_t mpeg_crc32(const uint8_t* data, size_t n) {
+  uint32_t crc = 0xffffffffu;
+  for (size_t i = 0; i < n; ++i) {
+    crc ^= static_cast<uint32_t>(data[i]) << 24;
+    for (int b = 0; b < 8; ++b) {
+      crc = (crc & 0x80000000u) ? (crc << 1) ^ 0x04c11db7u : crc << 1;
+    }
+  }
+  return crc;
+}
+
+void TsMuxer::WritePacket(uint16_t pid, bool pusi, const uint8_t* payload,
+                          size_t n, size_t* consumed, std::string* out,
+                          const uint64_t* pcr) {
+  uint8_t* cc = pid == kVideoPid ? &cc_[0]
+                : pid == kAudioPid ? &cc_[1]
+                : pid == kPmtPid ? &cc_pmt_ : &cc_pat_;
+  std::string pkt;
+  pkt.push_back(static_cast<char>(kSync));
+  pkt.push_back(static_cast<char>((pusi ? 0x40 : 0) | ((pid >> 8) & 0x1f)));
+  pkt.push_back(static_cast<char>(pid));
+  const size_t room = kTsPacket - 4;
+
+  // Adaptation-field content (after its length byte): PCR, then any
+  // stuffing needed to land the payload tail exactly on 188 bytes.
+  std::string af;
+  if (pcr != nullptr) {
+    af.push_back(0x10);  // PCR_flag
+    // 33-bit base | 6 reserved (all ones) | 9-bit extension (0).
+    const uint64_t base = *pcr & ((1ull << 33) - 1);
+    const uint64_t v = (base << 15) | (0x3full << 9);
+    for (int i = 5; i >= 0; --i) {
+      af.push_back(static_cast<char>(v >> (8 * i)));
+    }
+  }
+  bool has_af = !af.empty();
+  size_t space = room - (has_af ? 1 + af.size() : 0);
+  if (n < space) {
+    size_t deficit = space - n;
+    if (!has_af) {
+      has_af = true;
+      --deficit;  // the length byte itself absorbs one
+      if (deficit > 0) {
+        af.push_back(0);  // flags
+        af.append(deficit - 1, '\xff');
+      }
+    } else {
+      af.append(deficit, '\xff');
+    }
+    space = n;
+  }
+  pkt.push_back(
+      static_cast<char>((has_af ? 0x30 : 0x10) | (*cc & 0x0f)));
+  if (has_af) {
+    pkt.push_back(static_cast<char>(af.size()));
+    pkt.append(af);
+  }
+  pkt.append(reinterpret_cast<const char*>(payload), space);
+  *consumed = space;
+  *cc = (*cc + 1) & 0x0f;
+  out->append(pkt);
+}
+
+void TsMuxer::WriteTables(std::string* out) {
+  // PAT: program 1 → PMT PID.
+  std::string pat_body;
+  pat_body.push_back(0);
+  pat_body.push_back(1);  // program_number 1
+  pat_body.push_back(static_cast<char>(0xe0 | ((kPmtPid >> 8) & 0x1f)));
+  pat_body.push_back(static_cast<char>(kPmtPid));
+  const std::string pat = psi_section(0x00, /*tsid=*/1, pat_body);
+  size_t consumed = 0;
+  WritePacket(0x0000, /*pusi=*/true,
+              reinterpret_cast<const uint8_t*>(pat.data()), pat.size(),
+              &consumed, out);
+  // PMT: PCR on video; H.264 (0x1b) + AAC ADTS (0x0f).
+  std::string pmt_body;
+  pmt_body.push_back(static_cast<char>(0xe0 | ((kVideoPid >> 8) & 0x1f)));
+  pmt_body.push_back(static_cast<char>(kVideoPid));  // PCR PID
+  pmt_body.push_back(static_cast<char>(0xf0));
+  pmt_body.push_back(0);  // program_info_length 0
+  const struct {
+    uint8_t type;
+    uint16_t pid;
+  } streams[] = {{0x1b, kVideoPid}, {0x0f, kAudioPid}};
+  for (const auto& s : streams) {
+    pmt_body.push_back(static_cast<char>(s.type));
+    pmt_body.push_back(static_cast<char>(0xe0 | ((s.pid >> 8) & 0x1f)));
+    pmt_body.push_back(static_cast<char>(s.pid));
+    pmt_body.push_back(static_cast<char>(0xf0));
+    pmt_body.push_back(0);  // ES_info_length 0
+  }
+  const std::string pmt = psi_section(0x02, /*program=*/1, pmt_body);
+  WritePacket(kPmtPid, /*pusi=*/true,
+              reinterpret_cast<const uint8_t*>(pmt.data()), pmt.size(),
+              &consumed, out);
+}
+
+size_t TsMuxer::WriteFrame(bool video, uint64_t pts90k,
+                           const std::string& data, std::string* out) {
+  // PES header: 000001 | stream_id | length | '10' flags | PTS.
+  std::string pes;
+  pes.append("\x00\x00\x01", 3);
+  pes.push_back(static_cast<char>(video ? 0xe0 : 0xc0));
+  const size_t tail = 3 + 5 + data.size();  // flags(2)+hdrlen(1)+PTS+data
+  // PES_packet_length: 0 is legal for video (unbounded); audio must fit.
+  const bool unbounded = tail > 0xffff;
+  pes.push_back(static_cast<char>(unbounded ? 0 : tail >> 8));
+  pes.push_back(static_cast<char>(unbounded ? 0 : tail));
+  pes.push_back(static_cast<char>(0x80));  // marker '10'
+  pes.push_back(static_cast<char>(0x80));  // PTS only
+  pes.push_back(5);                        // header data length
+  put_pts(&pes, pts90k & ((1ull << 33) - 1));
+  pes.append(data);
+
+  const uint16_t pid = video ? kVideoPid : kAudioPid;
+  size_t off = 0, packets = 0;
+  bool first = true;
+  while (off < pes.size()) {
+    size_t consumed = 0;
+    // PCR rides the first packet of every video frame (video is the
+    // PMT-declared PCR PID).
+    WritePacket(pid, first,
+                reinterpret_cast<const uint8_t*>(pes.data()) + off,
+                pes.size() - off, &consumed, out,
+                first && video ? &pts90k : nullptr);
+    off += consumed;
+    first = false;
+    ++packets;
+  }
+  return packets;
+}
+
+// ---- demux ---------------------------------------------------------------
+
+namespace {
+
+struct PesAssembly {
+  std::string bytes;
+  bool open = false;
+};
+
+// Parses one complete PES (header + payload) into a frame.
+bool finish_pes(uint16_t pid, PesAssembly* as,
+                std::vector<TsFrame>* frames) {
+  if (!as->open) {
+    return true;
+  }
+  as->open = false;
+  std::string pes = std::move(as->bytes);
+  as->bytes.clear();
+  if (pes.size() < 9 || pes[0] != 0 || pes[1] != 0 || pes[2] != 1) {
+    return false;
+  }
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(pes.data());
+  const size_t hdr_len = p[8];
+  if (pes.size() < 9 + hdr_len) {
+    return false;
+  }
+  TsFrame f;
+  f.pid = pid;
+  if ((p[7] & 0x80) != 0) {  // PTS present
+    if (hdr_len < 5 || !get_pts(p + 9, &f.pts90k)) {
+      return false;
+    }
+  }
+  f.data = pes.substr(9 + hdr_len);
+  // Bounded PES: trim any stuffing the length excludes.
+  const size_t declared = (static_cast<size_t>(p[4]) << 8) | p[5];
+  if (declared != 0) {
+    const size_t payload_len = declared - 3 - hdr_len;
+    if (payload_len > f.data.size()) {
+      return false;
+    }
+    f.data.resize(payload_len);
+  }
+  frames->push_back(std::move(f));
+  return true;
+}
+
+}  // namespace
+
+bool ts_demux(const std::string& in, std::vector<TsFrame>* frames,
+              std::map<uint16_t, uint8_t>* stream_types) {
+  if (in.size() % kTsPacket != 0) {
+    return false;
+  }
+  std::map<uint16_t, PesAssembly> pes;
+  std::map<uint16_t, int> last_cc;
+  uint16_t pmt_pid = 0xffff;
+  for (size_t off = 0; off < in.size(); off += kTsPacket) {
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(in.data()) + off;
+    if (p[0] != kSync) {
+      return false;
+    }
+    const bool pusi = (p[1] & 0x40) != 0;
+    const uint16_t pid = (static_cast<uint16_t>(p[1] & 0x1f) << 8) | p[2];
+    const uint8_t afc = (p[3] >> 4) & 3;
+    const uint8_t cc = p[3] & 0x0f;
+    auto lc = last_cc.find(pid);
+    if (lc != last_cc.end() && ((lc->second + 1) & 0x0f) != cc) {
+      return false;  // continuity break
+    }
+    last_cc[pid] = cc;
+    size_t pos = 4;
+    if (afc == 0 || afc == 2) {
+      continue;  // no payload
+    }
+    if (afc == 3) {
+      const size_t af_len = p[4];
+      pos = 5 + af_len;
+      if (pos > kTsPacket) {
+        return false;
+      }
+    }
+    const uint8_t* payload = p + pos;
+    const size_t n = kTsPacket - pos;
+    if (pid == 0x0000 || pid == pmt_pid) {
+      if (!pusi || n < 1) {
+        continue;  // multi-packet PSI not produced by this muxer
+      }
+      const size_t ptr = payload[0];
+      if (1 + ptr + 3 > n) {
+        return false;
+      }
+      const uint8_t* sec = payload + 1 + ptr;
+      const size_t sec_len =
+          ((static_cast<size_t>(sec[1]) & 0x0f) << 8) | sec[2];
+      if (3 + sec_len > n - 1 - ptr) {
+        return false;
+      }
+      const size_t total = 3 + sec_len;
+      const uint32_t crc = mpeg_crc32(sec, total - 4);
+      const uint32_t want = (static_cast<uint32_t>(sec[total - 4]) << 24) |
+                            (static_cast<uint32_t>(sec[total - 3]) << 16) |
+                            (static_cast<uint32_t>(sec[total - 2]) << 8) |
+                            sec[total - 1];
+      if (crc != want) {
+        return false;
+      }
+      if (sec[0] == 0x00 && total >= 12) {  // PAT
+        pmt_pid = (static_cast<uint16_t>(sec[10] & 0x1f) << 8) | sec[11];
+      } else if (sec[0] == 0x02 && stream_types != nullptr) {  // PMT
+        size_t q = 12;  // past PCR pid + program_info_length (0)
+        while (q + 5 <= total - 4) {
+          const uint8_t type = sec[q];
+          const uint16_t es_pid =
+              (static_cast<uint16_t>(sec[q + 1] & 0x1f) << 8) | sec[q + 2];
+          (*stream_types)[es_pid] = type;
+          const size_t es_info =
+              ((static_cast<size_t>(sec[q + 3]) & 0x0f) << 8) | sec[q + 4];
+          q += 5 + es_info;
+        }
+      }
+      continue;
+    }
+    PesAssembly& as = pes[pid];
+    if (pusi) {
+      if (!finish_pes(pid, &as, frames)) {
+        return false;
+      }
+      as.open = true;
+    }
+    if (as.open) {
+      as.bytes.append(reinterpret_cast<const char*>(payload), n);
+      // A bounded PES (declared length != 0) completes the moment its
+      // bytes are in — keeping frames in true arrival order instead of
+      // parking finished audio until the next start indicator.
+      if (as.bytes.size() >= 6) {
+        const uint8_t* hp =
+            reinterpret_cast<const uint8_t*>(as.bytes.data());
+        const size_t declared =
+            (static_cast<size_t>(hp[4]) << 8) | hp[5];
+        if (declared != 0 && as.bytes.size() >= 6 + declared) {
+          if (!finish_pes(pid, &as, frames)) {
+            return false;
+          }
+        }
+      }
+    }
+  }
+  for (auto& [pid, as] : pes) {
+    if (!finish_pes(pid, &as, frames)) {
+      return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace trpc

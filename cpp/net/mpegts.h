@@ -1,0 +1,67 @@
+// MPEG-TS muxing/demuxing: pack media frames into 188-byte transport
+// stream packets (PAT/PMT signalling, PES framing, PTS timestamps).
+//
+// Parity: the reference's ts.{h,cpp} (~1.7k LoC) muxes RTMP streams
+// into TS for HLS-style consumers.  Condensed single-program form: one
+// PAT (program 1 → PMT), one PMT (H.264 video PID 0x100 + AAC audio
+// PID 0x101, PCR on video), PES with 33-bit PTS, adaptation-field
+// stuffing, per-PID continuity counters.  The demuxer exists for tests
+// and tooling: it reassembles PES payloads and checks PSI CRCs (MPEG
+// CRC-32, the non-reflected 0x04C11DB7 variant).
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace trpc {
+
+// MPEG CRC-32 (poly 0x04C11DB7, init 0xFFFFFFFF, no reflection, no
+// final xor) — the PSI section checksum.  Exposed for tests.
+uint32_t mpeg_crc32(const uint8_t* data, size_t n);
+
+class TsMuxer {
+ public:
+  static constexpr uint16_t kPmtPid = 0x1000;
+  static constexpr uint16_t kVideoPid = 0x100;
+  static constexpr uint16_t kAudioPid = 0x101;
+
+  // Appends PAT + PMT (callers emit them at stream start and then
+  // periodically, e.g. every keyframe, so joiners mid-stream sync).
+  void WriteTables(std::string* out);
+
+  // Appends one frame as PES split across TS packets.  `video` selects
+  // PID/stream id; pts90k is the presentation time in 90kHz ticks
+  // (33 bits used).  Returns the number of TS packets written.
+  size_t WriteFrame(bool video, uint64_t pts90k, const std::string& data,
+                    std::string* out);
+
+ private:
+  // `pcr` non-null emits a PCR (27MHz clock reference, base from the
+  // 90kHz tick) in this packet's adaptation field — ISO 13818-1 wants
+  // one on the declared PCR PID regularly; this muxer stamps every
+  // video frame's first packet.
+  void WritePacket(uint16_t pid, bool pusi, const uint8_t* payload,
+                   size_t n, size_t* consumed, std::string* out,
+                   const uint64_t* pcr = nullptr);
+  // Continuity counters are per PID: video, audio, PAT, PMT.
+  uint8_t cc_[2] = {0, 0};
+  uint8_t cc_pat_ = 0;
+  uint8_t cc_pmt_ = 0;
+};
+
+// Demuxed elementary frame.
+struct TsFrame {
+  uint16_t pid = 0;
+  uint64_t pts90k = 0;
+  std::string data;
+};
+
+// Parses a whole TS byte string: returns false on framing/CRC errors.
+// Fills frames (complete PES payloads, in arrival order) and the
+// PMT-announced pid→stream_type map.
+bool ts_demux(const std::string& in, std::vector<TsFrame>* frames,
+              std::map<uint16_t, uint8_t>* stream_types);
+
+}  // namespace trpc

@@ -70,14 +70,24 @@ std::string encode_meta(const RpcMeta& m) {
   // remain), so presence/absence are both wire-compatible — and the
   // streaming hot path never pays for it.  Layout: trace(24B) then
   // compress+checksum(5B); the second group implies the first.
-  if (m.trace_id != 0 || m.compress_type != 0 || m.has_checksum) {
+  if (m.trace_id != 0 || m.compress_type != 0 || m.has_checksum ||
+      !m.extra_streams.empty()) {
     put_u64(&s, m.trace_id);
     put_u64(&s, m.span_id);
     put_u64(&s, m.parent_span_id);
-    if (m.compress_type != 0 || m.has_checksum) {
+    if (m.compress_type != 0 || m.has_checksum ||
+        !m.extra_streams.empty()) {
       s.push_back(static_cast<char>(m.compress_type));
       s.push_back(m.has_checksum ? 1 : 0);
       put_u32(&s, m.checksum);
+      if (!m.extra_streams.empty()) {
+        // Third tail group: batch stream offers (count + pairs).
+        put_u32(&s, static_cast<uint32_t>(m.extra_streams.size()));
+        for (const auto& [sid, window] : m.extra_streams) {
+          put_u64(&s, sid);
+          put_u64(&s, window);
+        }
+      }
     }
   }
   return s;
@@ -125,6 +135,20 @@ bool decode_meta(const std::string& s, RpcMeta* m) {
       m->compress_type = static_cast<uint8_t>(*p++);
       m->has_checksum = *p++ != 0;
       m->checksum = get_u32(p);
+      p += 4;
+      if (end - p >= 4) {  // optional batch-streams group
+        const uint32_t count = get_u32(p);
+        p += 4;
+        if (count > 256 ||
+            static_cast<uint64_t>(end - p) < count * 16ull) {
+          return false;
+        }
+        m->extra_streams.reserve(count);
+        for (uint32_t i = 0; i < count; ++i) {
+          m->extra_streams.emplace_back(get_u64(p), get_u64(p + 8));
+          p += 16;
+        }
+      }
     }
   }
   return true;

@@ -11,6 +11,8 @@
 #include <cstdint>
 #include <memory>
 #include <string>
+#include <utility>
+#include <vector>
 
 #include "base/iobuf.h"
 
@@ -50,6 +52,10 @@ struct RpcMeta {
   uint64_t stream_id = 0;
   uint8_t stream_flags = 0;
   uint64_t ack_bytes = 0;
+  // Batch stream establishment (StreamIds parity, ref stream.h:114):
+  // further (stream_id, window) offers/acceptances beyond the first,
+  // index-aligned between request and response.  Optional wire tail.
+  std::vector<std::pair<uint64_t, uint64_t>> extra_streams;
   // rpcz trace context (span.h parity: trace_id/span_id/parent propagate
   // inside the meta like the reference's RpcMeta).  Optional wire tail —
   // absent (zero) when the peer predates it or rpcz is off.

@@ -494,6 +494,7 @@ void tstd_process_request(InputMessage&& msg) {
   cntl->call().socket_id = socket_id;
   cntl->call().peer_stream = msg.meta.stream_id;
   cntl->call().peer_stream_window = msg.meta.ack_bytes;
+  cntl->call().extra_peer = msg.meta.extra_streams;
   cntl->call().sl_pool =
       srv != nullptr ? srv->session_data_pool() : nullptr;
   auto* response = new IOBuf();
@@ -548,6 +549,9 @@ void tstd_process_request(InputMessage&& msg) {
     meta.stream_id = cntl->call().accepted_stream;  // acceptance piggyback
     if (meta.stream_id != 0) {
       meta.ack_bytes = stream_recv_window(meta.stream_id);
+      for (uint64_t sid : cntl->call().extra_accepted) {
+        meta.extra_streams.emplace_back(sid, stream_recv_window(sid));
+      }
     }
     IOBuf frame;
     if (!cntl->Failed() && cntl->response_compress_type() != 0) {

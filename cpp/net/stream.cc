@@ -176,6 +176,9 @@ void mark_closed(StreamMeta* m) {
   m->close_ev.wake_all();
   m->window_ev.value.fetch_add(1, std::memory_order_release);
   m->window_ev.wake_all();
+  // Writers parked awaiting establishment must observe the death NOW
+  // (an unaccepted batch offer would otherwise wait out its timeout).
+  m->established_ev.wake_all();
   if (m->opts.on_closed) {
     m->opts.on_closed(m->id());
   }
@@ -193,25 +196,89 @@ int StreamCreate(StreamId* out, Controller* cntl, const StreamOptions& opts) {
   return 0;
 }
 
+namespace {
+
+// Accepts ONE offered (peer_sid, peer_window); returns the local id.
+StreamId accept_one(Controller* cntl, const StreamOptions& opts,
+                    uint64_t peer_sid, uint64_t peer_window) {
+  const StreamId sid = new_stream(opts);
+  if (sid == 0) {
+    return 0;
+  }
+  StreamMeta* m = stream_of(sid);
+  m->sock = cntl->call().socket_id;
+  m->peer_sid.store(peer_sid, std::memory_order_release);
+  // Our send credit is whatever receive window the CLIENT advertised.
+  m->send_window.store(static_cast<int64_t>(peer_window),
+                       std::memory_order_release);
+  m->established_ev.value.store(1, std::memory_order_release);
+  m->established_ev.wake_all();
+  return sid;
+}
+
+}  // namespace
+
 int StreamAccept(StreamId* out, Controller* cntl, const StreamOptions& opts) {
   if (cntl->call().peer_stream == 0) {
     return EINVAL;  // request offered no stream
   }
-  const StreamId sid = new_stream(opts);
+  const StreamId sid = accept_one(cntl, opts, cntl->call().peer_stream,
+                                  cntl->call().peer_stream_window);
   if (sid == 0) {
     return ENOMEM;
   }
-  StreamMeta* m = stream_of(sid);
-  m->sock = cntl->call().socket_id;
-  m->peer_sid.store(cntl->call().peer_stream, std::memory_order_release);
-  // Our send credit is whatever receive window the CLIENT advertised.
-  m->send_window.store(
-      static_cast<int64_t>(cntl->call().peer_stream_window),
-      std::memory_order_release);
-  m->established_ev.value.store(1, std::memory_order_release);
-  m->established_ev.wake_all();
   cntl->call().accepted_stream = sid;  // rides back in the response meta
   *out = sid;
+  return 0;
+}
+
+int StreamCreateBatch(std::vector<StreamId>* out, int count,
+                      Controller* cntl, const StreamOptions& opts) {
+  if (count <= 0 || count > 256) {
+    return EINVAL;
+  }
+  out->clear();
+  for (int i = 0; i < count; ++i) {
+    const StreamId sid = new_stream(opts);
+    if (sid == 0) {
+      for (StreamId created : *out) {
+        StreamClose(created);
+      }
+      out->clear();
+      return ENOMEM;
+    }
+    out->push_back(sid);
+  }
+  cntl->call().offered_stream = (*out)[0];
+  cntl->call().extra_offered.assign(out->begin() + 1, out->end());
+  return 0;
+}
+
+int StreamAcceptBatch(std::vector<StreamId>* out, Controller* cntl,
+                      const StreamOptions& opts) {
+  if (cntl->call().peer_stream == 0) {
+    return EINVAL;
+  }
+  out->clear();
+  const StreamId first = accept_one(cntl, opts, cntl->call().peer_stream,
+                                    cntl->call().peer_stream_window);
+  if (first == 0) {
+    return ENOMEM;
+  }
+  out->push_back(first);
+  for (const auto& [peer_sid, peer_window] : cntl->call().extra_peer) {
+    const StreamId sid = accept_one(cntl, opts, peer_sid, peer_window);
+    if (sid == 0) {
+      for (StreamId created : *out) {
+        StreamClose(created);
+      }
+      out->clear();
+      return ENOMEM;
+    }
+    out->push_back(sid);
+  }
+  cntl->call().accepted_stream = (*out)[0];
+  cntl->call().extra_accepted.assign(out->begin() + 1, out->end());
   return 0;
 }
 

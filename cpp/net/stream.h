@@ -18,6 +18,7 @@
 
 #include <cstdint>
 #include <functional>
+#include <vector>
 
 #include "base/iobuf.h"
 #include "net/controller.h"
@@ -41,6 +42,16 @@ int StreamCreate(StreamId* out, Controller* cntl, const StreamOptions& opts);
 // Server side: accept the stream offered by the current request (fails if
 // the request carries none).  Must be called before done().
 int StreamAccept(StreamId* out, Controller* cntl, const StreamOptions& opts);
+
+// Batch establishment (StreamIds parity, ref stream.h:114): one RPC
+// offers `count` streams at once; the server accepts ALL of them in one
+// call.  All share `opts` (each still gets its own window/queue).  The
+// batch accepts/fails atomically: a mid-batch allocation failure
+// destroys the partial set and returns ENOMEM.
+int StreamCreateBatch(std::vector<StreamId>* out, int count,
+                      Controller* cntl, const StreamOptions& opts);
+int StreamAcceptBatch(std::vector<StreamId>* out, Controller* cntl,
+                      const StreamOptions& opts);
 
 // Ordered write; parks the calling fiber while the peer's window is
 // exhausted.  Returns 0, EINVAL (gone), EPIPE (closed/conn dead).

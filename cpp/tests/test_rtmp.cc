@@ -4,6 +4,7 @@
 // across chunk-size renegotiation and multi-chunk payloads.
 #include "net/rtmp.h"
 #include "net/flv.h"
+#include "net/mpegts.h"
 
 #include <atomic>
 #include <thread>
@@ -299,6 +300,69 @@ TEST_CASE(flv_mux_demux_roundtrip) {
   EXPECT_EQ(flv_read_tag(bad, &p3, &t3), 1);
   EXPECT_EQ(flv_read_tag(bad, &p3, &t3), 1);
   EXPECT_EQ(flv_read_tag(bad, &p3, &t3), -1);
+}
+
+TEST_CASE(mpegts_mux_demux_roundtrip) {
+  // Sync byte + 188 alignment; tables parse with valid CRC; frames come
+  // back with their PTS; continuity counters hold across packets.
+  TsMuxer mux;
+  std::string ts;
+  mux.WriteTables(&ts);
+  EXPECT_EQ(ts.size(), 2 * 188u);
+  EXPECT_EQ(static_cast<uint8_t>(ts[0]), 0x47);
+  EXPECT_EQ(static_cast<uint8_t>(ts[188]), 0x47);
+  // MPEG CRC-32 check value ("123456789" → 0x0376E6E7 in the catalogue).
+  EXPECT_EQ(mpeg_crc32(reinterpret_cast<const uint8_t*>("123456789"), 9),
+            0x0376E6E7u);
+  // Small audio frame (one packet, stuffed) + multi-packet video frame.
+  EXPECT_EQ(mux.WriteFrame(false, 90000, "AAC-FRAME", &ts), 1u);
+  std::string big(1000, 'N');
+  const size_t vpkts = mux.WriteFrame(true, 180000, big, &ts);
+  EXPECT(vpkts >= 6u);  // 1000B + PES header across 184B payloads
+  EXPECT_EQ(ts.size() % 188, 0u);
+  // Tables again mid-stream (as a segmenter would at a keyframe).
+  mux.WriteTables(&ts);
+  EXPECT_EQ(mux.WriteFrame(true, 183600, "NEXT", &ts), 1u);
+
+  // The first packet of a video frame carries a PCR on the declared
+  // PCR PID: adaptation field present, PCR_flag set, base == PTS.
+  {
+    const uint8_t* p =
+        reinterpret_cast<const uint8_t*>(ts.data()) + 3 * 188;
+    EXPECT_EQ(p[0], 0x47);
+    EXPECT_EQ(((p[1] & 0x1f) << 8) | p[2], TsMuxer::kVideoPid);
+    EXPECT_EQ((p[3] >> 4) & 3, 3u);   // adaptation + payload
+    EXPECT(p[5] & 0x10);              // PCR_flag
+    const uint64_t base = (static_cast<uint64_t>(p[6]) << 25) |
+                          (static_cast<uint64_t>(p[7]) << 17) |
+                          (static_cast<uint64_t>(p[8]) << 9) |
+                          (static_cast<uint64_t>(p[9]) << 1) |
+                          (p[10] >> 7);
+    EXPECT_EQ(base, 180000u);
+  }
+
+  std::vector<TsFrame> frames;
+  std::map<uint16_t, uint8_t> types;
+  EXPECT(ts_demux(ts, &frames, &types));
+  EXPECT_EQ(types[TsMuxer::kVideoPid], 0x1b);  // H.264
+  EXPECT_EQ(types[TsMuxer::kAudioPid], 0x0f);  // AAC ADTS
+  EXPECT_EQ(frames.size(), 3u);
+  EXPECT(frames[0].pid == TsMuxer::kAudioPid &&
+         frames[0].pts90k == 90000 && frames[0].data == "AAC-FRAME");
+  EXPECT(frames[1].pid == TsMuxer::kVideoPid &&
+         frames[1].pts90k == 180000 && frames[1].data == big);
+  EXPECT(frames[2].data == "NEXT" && frames[2].pts90k == 183600);
+  // A corrupted byte inside a PSI section must fail the CRC (packet
+  // payloads sit at the END — the front is adaptation stuffing).
+  std::string bad = ts;
+  bad[187] ^= 0x5a;  // last byte of the PAT packet = CRC tail
+  frames.clear();
+  EXPECT(!ts_demux(bad, &frames, nullptr));
+  // A dropped packet must trip the continuity check.
+  std::string gap = ts.substr(0, 2 * 188) + ts.substr(3 * 188);
+  frames.clear();
+  const bool gap_ok = ts_demux(gap, &frames, nullptr);
+  EXPECT(!gap_ok || frames.size() < 3);
 }
 
 TEST_CASE(flv_records_relayed_stream) {

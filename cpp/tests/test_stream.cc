@@ -189,4 +189,118 @@ TEST_CASE(accept_without_offer_fails) {
   EXPECT(resp.to_string() == "no-stream");
 }
 
+namespace {
+// Per-stream tallies for the batch case (indexed by arrival marker).
+std::atomic<int> g_batch_counts[3];
+std::atomic<int> g_batch_accepted{0};
+}  // namespace
+
+TEST_CASE(stream_batch_create_accept) {
+  // One RPC establishes THREE streams (StreamIds parity); each relays
+  // its own ordered chunks, and windows are per stream.  A dedicated
+  // server: methods cannot register on the running shared one.
+  Server srv;
+  srv.RegisterMethod(
+      "Stream.OpenBatch", [](Controller* cntl, const IOBuf&, IOBuf* resp,
+                             Closure done) {
+        StreamOptions opts;
+        opts.on_message = [](StreamId, IOBuf&& chunk) {
+          uint8_t lane = 0;
+          chunk.copy_to(&lane, 1);
+          if (lane < 3) {
+            g_batch_counts[lane].fetch_add(1);
+          }
+        };
+        opts.on_closed = [](StreamId sid) { StreamClose(sid); };
+        std::vector<StreamId> sids;
+        if (StreamAcceptBatch(&sids, cntl, opts) != 0) {
+          resp->append("no-stream");
+          done();
+          return;
+        }
+        g_batch_accepted.store(static_cast<int>(sids.size()));
+        resp->append("accepted " + std::to_string(sids.size()));
+        done();
+      });
+  EXPECT_EQ(srv.Start(0), 0);
+
+  Channel ch;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(srv.port())), 0);
+  Controller cntl;
+  std::vector<StreamId> sids;
+  EXPECT_EQ(StreamCreateBatch(&sids, 3, &cntl, StreamOptions{}), 0);
+  EXPECT_EQ(sids.size(), 3u);
+  IOBuf req, resp;
+  req.append("open");
+  ch.CallMethod("Stream.OpenBatch", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+  EXPECT(resp.to_string() == "accepted 3");
+  EXPECT_EQ(g_batch_accepted.load(), 3);
+
+  // Each lane writes chunks tagged with its index.
+  static std::vector<StreamId> s_sids;
+  s_sids = sids;
+  fiber_t writers[3];
+  for (int lane = 0; lane < 3; ++lane) {
+    fiber_start(&writers[lane], [](void* arg) {
+      const int lane = static_cast<int>(reinterpret_cast<intptr_t>(arg));
+      for (int i = 0; i < 10 + lane; ++i) {
+        IOBuf chunk;
+        const uint8_t tag = static_cast<uint8_t>(lane);
+        chunk.append(&tag, 1);
+        chunk.append("payload");
+        EXPECT_EQ(StreamWrite(s_sids[lane], std::move(chunk)), 0);
+      }
+      StreamClose(s_sids[lane]);
+    }, reinterpret_cast<void*>(static_cast<intptr_t>(lane)));
+  }
+  for (auto& w : writers) {
+    fiber_join(w);
+  }
+  const int64_t deadline = monotonic_time_us() + 5000000;
+  while ((g_batch_counts[0].load() < 10 || g_batch_counts[1].load() < 11 ||
+          g_batch_counts[2].load() < 12) &&
+         monotonic_time_us() < deadline) {
+    usleep(10000);
+  }
+  EXPECT_EQ(g_batch_counts[0].load(), 10);
+  EXPECT_EQ(g_batch_counts[1].load(), 11);
+  EXPECT_EQ(g_batch_counts[2].load(), 12);
+  srv.Stop();
+  srv.Join();
+}
+
+TEST_CASE(unaccepted_batch_offers_close_promptly) {
+  // A handler that uses plain StreamAccept (or none at all) must not
+  // leave the client's extra offers hanging: they close on response and
+  // writers get EPIPE instead of a 10s establishment park.
+  start_once();  // Stream.Open accepts exactly ONE stream
+  Channel ch;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(g_port)), 0);
+  Controller cntl;
+  std::vector<StreamId> sids;
+  EXPECT_EQ(StreamCreateBatch(&sids, 3, &cntl, StreamOptions{}), 0);
+  IOBuf req, resp;
+  req.append("open");
+  ch.CallMethod("Stream.Open", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+  // First lane established and usable...
+  IOBuf chunk;
+  uint64_t seq = g_srv_last_seq.load() + 1;
+  chunk.append(&seq, 8);
+  EXPECT_EQ(StreamWrite(sids[0], std::move(chunk)), 0);
+  // ...lanes 1-2 were never accepted: closed-and-destroyed with the
+  // response (EINVAL = id gone), not a 10s establishment park.
+  const int64_t t0 = monotonic_time_us();
+  IOBuf c1, c2;
+  c1.append("x");
+  c2.append("x");
+  EXPECT(!StreamExists(sids[1]));
+  EXPECT(!StreamExists(sids[2]));
+  EXPECT_EQ(StreamWrite(sids[1], std::move(c1)), EINVAL);
+  EXPECT_EQ(StreamWrite(sids[2], std::move(c2)), EINVAL);
+  EXPECT(monotonic_time_us() - t0 < 2000000);
+  StreamClose(sids[0]);
+}
+
 TEST_MAIN
