@@ -42,6 +42,17 @@ void complete_locked_call(fid_t cid, Controller* cntl) {
     }
     cntl->call().h2_stream = 0;
   }
+  // Same for tstd stream offers: a call that failed before any
+  // acceptance arrived leaves its streams unestablished, and a parked
+  // StreamWrite would otherwise re-arm its establishment wait forever.
+  if (cntl->Failed() && cntl->call().offered_stream != 0) {
+    StreamClose(cntl->call().offered_stream);
+    cntl->call().offered_stream = 0;
+    for (uint64_t sid : cntl->call().extra_offered) {
+      StreamClose(sid);
+    }
+    cntl->call().extra_offered.clear();
+  }
   // Connection-type epilogue: pooled connections go back to the shared
   // pool (socket.h:611-627 parity), short ones close now.
   const SocketId conn = cntl->call().socket_id;
@@ -133,15 +144,22 @@ void tstd_process_response(InputMessage&& msg) {
                                   cntl->call().socket_id,
                                   accepted[i].second);
       }
+      // Extras the server did not accept are dead.
+      for (size_t i = accepted.size(); i < offered.size(); ++i) {
+        StreamClose(offered[i]);
+      }
     } else {
       // The handler never accepted (plain response / older peer): a
-      // hanging unestablished stream would park writers forever.
+      // hanging unestablished stream would park writers forever —
+      // close the primary and EVERY extra, whatever a (buggy/hostile)
+      // peer put in the extra_streams tail of a no-acceptance response.
       StreamClose(cntl->call().offered_stream);
+      for (uint64_t sid : offered) {
+        StreamClose(sid);
+      }
     }
-    // Extras the server did not accept are dead the same way.
-    for (size_t i = accepted.size(); i < offered.size(); ++i) {
-      StreamClose(offered[i]);
-    }
+    cntl->call().offered_stream = 0;
+    cntl->call().extra_offered.clear();
   }
   if (msg.meta.error_code != 0) {
     cntl->SetFailed(msg.meta.error_code, msg.meta.error_text);

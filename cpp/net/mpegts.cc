@@ -253,15 +253,17 @@ bool ts_demux(const std::string& in, std::vector<TsFrame>* frames,
     const uint16_t pid = (static_cast<uint16_t>(p[1] & 0x1f) << 8) | p[2];
     const uint8_t afc = (p[3] >> 4) & 3;
     const uint8_t cc = p[3] & 0x0f;
+    size_t pos = 4;
+    if (afc == 0 || afc == 2 || pid == 0x1fff) {
+      // ISO 13818-1: the counter does not increment on packets without
+      // payload, and is undefined on null packets — neither checks.
+      continue;
+    }
     auto lc = last_cc.find(pid);
     if (lc != last_cc.end() && ((lc->second + 1) & 0x0f) != cc) {
       return false;  // continuity break
     }
     last_cc[pid] = cc;
-    size_t pos = 4;
-    if (afc == 0 || afc == 2) {
-      continue;  // no payload
-    }
     if (afc == 3) {
       const size_t af_len = p[4];
       pos = 5 + af_len;

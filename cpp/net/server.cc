@@ -494,7 +494,7 @@ void tstd_process_request(InputMessage&& msg) {
   cntl->call().socket_id = socket_id;
   cntl->call().peer_stream = msg.meta.stream_id;
   cntl->call().peer_stream_window = msg.meta.ack_bytes;
-  cntl->call().extra_peer = msg.meta.extra_streams;
+  cntl->call().extra_peer = std::move(msg.meta.extra_streams);
   cntl->call().sl_pool =
       srv != nullptr ? srv->session_data_pool() : nullptr;
   auto* response = new IOBuf();

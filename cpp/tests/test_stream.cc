@@ -270,6 +270,39 @@ TEST_CASE(stream_batch_create_accept) {
   srv.Join();
 }
 
+namespace {
+Closure g_parked_done;  // released at test end so Stop/Join can drain
+}
+
+TEST_CASE(failed_call_closes_offered_streams) {
+  // A timed-out call must close its offered streams (all lanes), or
+  // batch writers park in the establishment wait forever.
+  Server srv;
+  srv.RegisterMethod("Stream.Never",
+                     [](Controller*, const IOBuf&, IOBuf*, Closure done) {
+                       g_parked_done = std::move(done);  // never answers
+                     });
+  EXPECT_EQ(srv.Start(0), 0);
+  Channel ch;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(srv.port())), 0);
+  Controller cntl;
+  cntl.set_timeout_ms(200);
+  std::vector<StreamId> sids;
+  EXPECT_EQ(StreamCreateBatch(&sids, 2, &cntl, StreamOptions{}), 0);
+  IOBuf req, resp;
+  req.append("open");
+  ch.CallMethod("Stream.Never", req, &resp, &cntl);
+  EXPECT(cntl.Failed());
+  EXPECT(!StreamExists(sids[0]));
+  EXPECT(!StreamExists(sids[1]));
+  IOBuf c;
+  c.append("x");
+  EXPECT_EQ(StreamWrite(sids[0], std::move(c)), EINVAL);
+  g_parked_done();  // let the server drain
+  srv.Stop();
+  srv.Join();
+}
+
 TEST_CASE(unaccepted_batch_offers_close_promptly) {
   // A handler that uses plain StreamAccept (or none at all) must not
   // leave the client's extra offers hanging: they close on response and
