@@ -33,6 +33,16 @@ int parse_port_dev(const char* s, int* port, int* dev) {
 }  // namespace
 
 int str2endpoint(const char* s, EndPoint* out) {
+  if (strncmp(s, "unix:", 5) == 0 && s[5] != '\0') {
+    // Paths beyond sun_path capacity would silently truncate at bind /
+    // connect time; reject them here where the caller can see it.
+    if (strlen(s + 5) >= sizeof(sockaddr_un{}.sun_path)) {
+      return -1;
+    }
+    *out = EndPoint();
+    out->unix_path = s + 5;
+    return 0;
+  }
   char host[128];
   const char* colon = strrchr(s, ':');
   if (colon == nullptr || colon == s ||
@@ -53,6 +63,7 @@ int str2endpoint(const char* s, EndPoint* out) {
   out->ip = addr.s_addr;
   out->port = port;
   out->device_ordinal = dev;
+  out->unix_path.clear();  // a reused EndPoint must not stay AF_UNIX
   return 0;
 }
 
@@ -81,11 +92,15 @@ int hostname2endpoint(const char* s, EndPoint* out) {
   out->ip = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr.s_addr;
   out->port = port;
   out->device_ordinal = dev;
+  out->unix_path.clear();  // a reused EndPoint must not stay AF_UNIX
   freeaddrinfo(res);
   return 0;
 }
 
 std::string endpoint2str(const EndPoint& ep) {
+  if (ep.is_unix()) {
+    return "unix:" + ep.unix_path;
+  }
   in_addr addr;
   addr.s_addr = ep.ip;
   char ip[INET_ADDRSTRLEN] = {};
@@ -104,6 +119,13 @@ sockaddr_in endpoint2sockaddr(const EndPoint& ep) {
   sa.sin_family = AF_INET;
   sa.sin_addr.s_addr = ep.ip;
   sa.sin_port = htons(static_cast<uint16_t>(ep.port));
+  return sa;
+}
+
+sockaddr_un endpoint2sockaddr_un(const EndPoint& ep) {
+  sockaddr_un sa = {};
+  sa.sun_family = AF_UNIX;
+  strncpy(sa.sun_path, ep.unix_path.c_str(), sizeof(sa.sun_path) - 1);
   return sa;
 }
 

@@ -7,8 +7,10 @@
 #pragma once
 
 #include <netinet/in.h>
+#include <sys/un.h>
 
 #include <cstdint>
+#include <functional>
 #include <string>
 
 namespace trpc {
@@ -17,26 +19,40 @@ struct EndPoint {
   uint32_t ip = 0;          // network byte order
   int port = 0;
   int device_ordinal = -1;  // -1 = host endpoint; >=0 = TPU chip behind host
+  // Non-empty = AF_UNIX address (ip/port unused) — reference endpoint.h
+  // models unix sockets inside EndPoint the same way.
+  std::string unix_path;
+
+  bool is_unix() const { return !unix_path.empty(); }
 
   bool operator==(const EndPoint& o) const {
-    return ip == o.ip && port == o.port && device_ordinal == o.device_ordinal;
+    return ip == o.ip && port == o.port &&
+           device_ordinal == o.device_ordinal && unix_path == o.unix_path;
   }
   bool operator!=(const EndPoint& o) const { return !(*this == o); }
 };
 
-// "1.2.3.4:80" or "1.2.3.4:80/3" (ICI device suffix); returns 0 on success.
+// "1.2.3.4:80", "1.2.3.4:80/3" (ICI device suffix), or "unix:/path";
+// returns 0 on success.
 int str2endpoint(const char* s, EndPoint* out);
-// Resolves "host:port" via getaddrinfo when not dotted-quad.
+// Resolves "host:port" via getaddrinfo when not dotted-quad; passes
+// "unix:/path" through.
 int hostname2endpoint(const char* s, EndPoint* out);
 std::string endpoint2str(const EndPoint& ep);
 sockaddr_in endpoint2sockaddr(const EndPoint& ep);
 EndPoint sockaddr2endpoint(const sockaddr_in& sa);
+// AF_UNIX form.  Paths are validated against sun_path capacity at
+// parse time (str2endpoint), so no truncation can reach here.
+sockaddr_un endpoint2sockaddr_un(const EndPoint& ep);
 
 struct EndPointHash {
   size_t operator()(const EndPoint& ep) const {
     uint64_t v = (static_cast<uint64_t>(ep.ip) << 32) ^
                  (static_cast<uint64_t>(ep.port) << 8) ^
                  static_cast<uint64_t>(ep.device_ordinal + 1);
+    if (ep.is_unix()) {
+      v ^= std::hash<std::string>{}(ep.unix_path);
+    }
     v ^= v >> 33;
     v *= 0xff51afd7ed558ccdull;
     v ^= v >> 33;

@@ -275,15 +275,27 @@ int MysqlClient::ensure_connected() {
   }
   const int64_t deadline =
       monotonic_time_us() + opts_.timeout_ms * 1000;
-  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  // "unix:/var/run/mysqld/mysqld.sock" is the canonical local address.
+  const bool un = ep_.is_unix();
+  int fd = ::socket(un ? AF_UNIX : AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   if (fd < 0) {
     return -1;
   }
-  sockaddr_in sin = {};
-  sin.sin_family = AF_INET;
-  sin.sin_addr.s_addr = ep_.ip;  // already network byte order
-  sin.sin_port = htons(static_cast<uint16_t>(ep_.port));
-  if (::connect(fd, reinterpret_cast<sockaddr*>(&sin), sizeof(sin)) != 0 &&
+  sockaddr_storage ss = {};
+  socklen_t ss_len;
+  if (un) {
+    sockaddr_un sun = endpoint2sockaddr_un(ep_);
+    memcpy(&ss, &sun, sizeof(sun));
+    ss_len = sizeof(sun);
+  } else {
+    sockaddr_in sin = {};
+    sin.sin_family = AF_INET;
+    sin.sin_addr.s_addr = ep_.ip;  // already network byte order
+    sin.sin_port = htons(static_cast<uint16_t>(ep_.port));
+    memcpy(&ss, &sin, sizeof(sin));
+    ss_len = sizeof(sin);
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&ss), ss_len) != 0 &&
       errno != EINPROGRESS) {
     ::close(fd);
     return -1;

@@ -274,24 +274,55 @@ int Server::Start(int port) {
                      done();
                    });
   }
-  const int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
-  if (fd < 0) {
-    return -1;
+  int fd;
+  if (!unix_path_.empty()) {
+    EndPoint uep;
+    uep.unix_path = unix_path_;
+    sockaddr_un su = endpoint2sockaddr_un(uep);
+    // Only a STALE socket file (crashed owner: connect refuses) may be
+    // unlinked — silently stealing a live server's path would leave it
+    // running yet unreachable.
+    const int probe = ::socket(AF_UNIX, SOCK_STREAM, 0);
+    if (probe >= 0) {
+      if (::connect(probe, reinterpret_cast<sockaddr*>(&su),
+                    sizeof(su)) == 0) {
+        close(probe);
+        errno = EADDRINUSE;
+        return -1;  // a live server answers on this path
+      }
+      close(probe);
+    }
+    ::unlink(unix_path_.c_str());
+    fd = ::socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) {
+      return -1;
+    }
+    if (bind(fd, reinterpret_cast<sockaddr*>(&su), sizeof(su)) != 0 ||
+        listen(fd, 1024) != 0) {
+      close(fd);
+      return -1;
+    }
+    port_ = 0;  // no port on AF_UNIX
+  } else {
+    fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) {
+      return -1;
+    }
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in sa = {};
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    sa.sin_port = htons(port > 0 ? static_cast<uint16_t>(port) : 0);
+    if (bind(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0 ||
+        listen(fd, 1024) != 0) {
+      close(fd);
+      return -1;
+    }
+    socklen_t len = sizeof(sa);
+    getsockname(fd, reinterpret_cast<sockaddr*>(&sa), &len);
+    port_ = ntohs(sa.sin_port);
   }
-  int one = 1;
-  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
-  sockaddr_in sa = {};
-  sa.sin_family = AF_INET;
-  sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
-  sa.sin_port = htons(port > 0 ? static_cast<uint16_t>(port) : 0);
-  if (bind(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0 ||
-      listen(fd, 1024) != 0) {
-    close(fd);
-    return -1;
-  }
-  socklen_t len = sizeof(sa);
-  getsockname(fd, reinterpret_cast<sockaddr*>(&sa), &len);
-  port_ = ntohs(sa.sin_port);
 
   Socket::Options opts;
   opts.fd = fd;
@@ -303,8 +334,23 @@ int Server::Start(int port) {
     return -1;
   }
   running_.store(true, std::memory_order_release);
-  LOG(Info) << "server started on 127.0.0.1:" << port_;
+  LOG(Info) << "server started on "
+            << (unix_path_.empty()
+                    ? "127.0.0.1:" + std::to_string(port_)
+                    : "unix:" + unix_path_);
   return 0;
+}
+
+int Server::StartUnix(const std::string& path) {
+  if (path.empty() || path.size() >= sizeof(sockaddr_un{}.sun_path)) {
+    return -1;  // over-long paths would silently truncate at bind
+  }
+  unix_path_ = path;
+  const int rc = Start(0);
+  if (rc != 0) {
+    unix_path_.clear();
+  }
+  return rc;
 }
 
 void Server::Stop() {
@@ -315,6 +361,9 @@ void Server::Stop() {
   if (s != nullptr) {
     s->SetFailed(ESHUTDOWN);
     s->Dereference();
+  }
+  if (!unix_path_.empty()) {
+    ::unlink(unix_path_.c_str());
   }
   // Fail live connections so no NEW request can reach this server while it
   // is being torn down (their user_data points at us).
@@ -371,7 +420,7 @@ void Server::on_acceptable(SocketId id, void* ctx) {
     return;
   }
   while (true) {
-    sockaddr_in peer_sa = {};
+    sockaddr_storage peer_sa = {};
     socklen_t peer_len = sizeof(peer_sa);
     const int fd =
         accept4(listener->fd(), reinterpret_cast<sockaddr*>(&peer_sa),
@@ -379,12 +428,18 @@ void Server::on_acceptable(SocketId id, void* ctx) {
     if (fd < 0) {
       break;  // EAGAIN or error; ET will refire on next connection
     }
-    int one = 1;
-    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     Socket::Options opts;
     opts.fd = fd;
-    opts.remote.ip = peer_sa.sin_addr.s_addr;
-    opts.remote.port = ntohs(peer_sa.sin_port);
+    if (peer_sa.ss_family == AF_UNIX) {
+      // Unix peers are anonymous; identify them by our listening path.
+      opts.remote.unix_path = srv->unix_path_;
+    } else {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      const auto* sin = reinterpret_cast<const sockaddr_in*>(&peer_sa);
+      opts.remote.ip = sin->sin_addr.s_addr;
+      opts.remote.port = ntohs(sin->sin_port);
+    }
     opts.on_readable = &messenger_on_readable;
     opts.user_data = srv;
     if (srv->tls_ctx_ != nullptr) {

@@ -188,6 +188,10 @@ class Server {
 
   // port <= 0 picks an ephemeral port (see port() after).  Returns 0 on ok.
   int Start(int port);
+  // Listens on an AF_UNIX path instead (reference: unix sockets are
+  // first-class EndPoints).  A stale socket file is unlinked first;
+  // Stop unlinks it again.  Channel::Init("unix:<path>") connects.
+  int StartUnix(const std::string& path);
   // Stops accepting, fails live connections; in-flight handlers finish.
   void Stop();
   // Parks until every in-flight request has completed (bounded by
@@ -251,6 +255,7 @@ class Server {
   std::vector<RestfulRule> restful_;
   SocketId listen_id_ = 0;
   int port_ = -1;
+  std::string unix_path_;  // non-empty when listening on AF_UNIX
   std::atomic<bool> running_{false};
   std::mutex conns_mu_;
   std::vector<SocketId> conns_;      // stale ids harmless (versioned)

@@ -243,12 +243,16 @@ int Socket::ensure_connected() {
     return 0;
   }
   if (fd_ < 0) {
-    const int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    const bool un = remote_.is_unix();
+    const int fd =
+        ::socket(un ? AF_UNIX : AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
     if (fd < 0) {
       return -1;
     }
-    int one = 1;
-    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    if (!un) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    }
     fd_ = fd;
     if (EventDispatcher::instance()->add(fd_, id()) != 0) {
       return -1;

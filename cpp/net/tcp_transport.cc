@@ -35,11 +35,22 @@ class TcpTransport final : public Transport {
   }
 
   int connect(Socket* s) override {
-    sockaddr_in sa = endpoint2sockaddr(s->remote());
+    // One storage, two families: the remote's flavor picks the sockaddr.
+    sockaddr_storage ss = {};
+    socklen_t sa_len;
+    if (s->remote().is_unix()) {
+      sockaddr_un su = endpoint2sockaddr_un(s->remote());
+      memcpy(&ss, &su, sizeof(su));
+      sa_len = sizeof(su);
+    } else {
+      sockaddr_in si = endpoint2sockaddr(s->remote());
+      memcpy(&ss, &si, sizeof(si));
+      sa_len = sizeof(si);
+    }
     while (true) {
       const uint32_t snap = s->writable_snap();
       const int rc =
-          ::connect(s->fd(), reinterpret_cast<sockaddr*>(&sa), sizeof(sa));
+          ::connect(s->fd(), reinterpret_cast<sockaddr*>(&ss), sa_len);
       if (rc == 0) {
         return 0;
       }
@@ -53,8 +64,8 @@ class TcpTransport final : public Transport {
         socklen_t len = sizeof(err);
         if (getsockopt(s->fd(), SOL_SOCKET, SO_ERROR, &err, &len) == 0 &&
             err == 0) {
-          int probe = ::connect(s->fd(), reinterpret_cast<sockaddr*>(&sa),
-                                sizeof(sa));
+          int probe = ::connect(s->fd(), reinterpret_cast<sockaddr*>(&ss),
+                                sa_len);
           if (probe == 0 || errno == EISCONN) {
             return 0;
           }

@@ -597,6 +597,65 @@ TEST_CASE(interceptor_gates_every_protocol) {
   }
 }
 
+TEST_CASE(unix_socket_end_to_end) {
+  // AF_UNIX endpoints are first-class: parse/format, server listen,
+  // channel connect, echo roundtrip, and /sockets showing the peer.
+  EndPoint uep;
+  EXPECT_EQ(str2endpoint("unix:/tmp/trpc-test.sock", &uep), 0);
+  EXPECT(uep.is_unix());
+  EXPECT(endpoint2str(uep) == "unix:/tmp/trpc-test.sock");
+  EXPECT(str2endpoint("unix:", &uep) != 0);  // empty path
+
+  const std::string path = "/tmp/trpc_unix_e2e.sock";
+  Server srv;
+  srv.RegisterMethod("Echo.Echo", [](Controller*, const IOBuf& req,
+                                     IOBuf* resp, Closure done) {
+    resp->append(req);
+    done();
+  });
+  EXPECT_EQ(srv.StartUnix(path), 0);
+  Channel ch;
+  EXPECT_EQ(ch.Init("unix:" + path), 0);
+  for (int i = 0; i < 3; ++i) {
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("over-unix-" + std::to_string(i));
+    ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+    EXPECT(resp.to_string() == "over-unix-" + std::to_string(i));
+  }
+  // A second server must NOT steal the live path.
+  {
+    Server thief;
+    thief.RegisterMethod("X.X", [](Controller*, const IOBuf&, IOBuf* r,
+                                   Closure done) {
+      r->append("x");
+      done();
+    });
+    EXPECT(thief.StartUnix(path) != 0);
+  }
+  srv.Stop();
+  srv.Join();
+  // The socket file is gone after Stop.
+  EXPECT(access(path.c_str(), F_OK) != 0);
+  // A stale file (crash leftover) is reclaimed by the next server.
+  {
+    FILE* f = fopen(path.c_str(), "w");  // plain file at the path
+    if (f != nullptr) {
+      fclose(f);
+    }
+    Server heir;
+    heir.RegisterMethod("X.X", [](Controller*, const IOBuf&, IOBuf* r,
+                                  Closure done) {
+      r->append("x");
+      done();
+    });
+    EXPECT_EQ(heir.StartUnix(path), 0);
+    heir.Stop();
+    heir.Join();
+  }
+}
+
 TEST_CASE(generic_handler_proxies_unknown_methods) {
   // Backend speaks Echo.Echo; the proxy has NO methods, only the
   // catch-all, and forwards verbatim (BaiduMasterService/generic-call
