@@ -1,0 +1,225 @@
+#include "net/http_client.h"
+
+#include <algorithm>
+#include <deque>
+#include <memory>
+#include <mutex>
+
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "net/messenger.h"
+#include "net/protocol.h"
+
+namespace trpc {
+
+namespace {
+
+bool ci_eq(const std::string& a, const std::string& b) {
+  return a.size() == b.size() &&
+         std::equal(a.begin(), a.end(), b.begin(), [](char x, char y) {
+           return ::tolower(static_cast<unsigned char>(x)) ==
+                  ::tolower(static_cast<unsigned char>(y));
+         });
+}
+
+// One in-flight request awaiting its FIFO slot's response.  head_only
+// tracks HEAD requests, whose responses carry headers but no body
+// whatever Content-Length says.
+struct HttpWaiter {
+  CountdownEvent ev{1};
+  bool head_only = false;
+  HttpResult result;
+};
+
+struct HttpCliConn {
+  std::mutex mu;  // queue order must match wire order
+  std::deque<std::shared_ptr<HttpWaiter>> pending;
+  // Resumable chunked-body scan state for the response being parsed.
+  std::shared_ptr<void> chunk_state;
+};
+
+const char kHttpCliTag = 0;
+
+HttpCliConn* cli_conn_of(Socket* s) {
+  return proto_conn_of<HttpCliConn>(s, &kHttpCliTag);
+}
+
+ParseError httpc_parse(IOBuf* source, InputMessage* out, Socket* sock) {
+  if (sock == nullptr || source->empty()) {
+    return ParseError::kNotEnoughData;
+  }
+  if (sock->pinned_protocol < 0) {
+    return ParseError::kTryOtherProtocol;  // client sockets are pre-pinned
+  }
+  HttpCliConn* c = cli_conn_of(sock);
+  bool head_only = false;
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    if (!c->pending.empty()) {
+      head_only = c->pending.front()->head_only;
+    }
+  }
+  auto resp = std::make_shared<std::pair<HttpResponse, IOBuf>>();
+  const ParseError rc = http_parse_response(
+      source, &resp->first, &resp->second, &c->chunk_state, head_only);
+  if (rc != ParseError::kOk) {
+    return rc;
+  }
+  if (resp->first.status < 200) {
+    // 1xx interim (100 Continue, 103 Early Hints): NOT the final
+    // response — swallowing it here keeps the FIFO aligned with the
+    // request the real response answers.
+    return source->empty() ? ParseError::kNotEnoughData
+                           : httpc_parse(source, out, sock);
+  }
+  out->meta.type = RpcMeta::kResponse;
+  out->ctx = std::move(resp);
+  out->socket = sock->id();
+  return ParseError::kOk;
+}
+
+void httpc_process_response(InputMessage&& msg) {
+  SocketRef sock(Socket::Address(msg.socket));
+  if (!sock) {
+    return;
+  }
+  auto resp =
+      std::static_pointer_cast<std::pair<HttpResponse, IOBuf>>(msg.ctx);
+  HttpCliConn* c = cli_conn_of(sock.get());
+  std::shared_ptr<HttpWaiter> w;
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    if (c->pending.empty()) {
+      return;  // unsolicited response: drop
+    }
+    w = std::move(c->pending.front());
+    c->pending.pop_front();
+  }
+  w->result.ok = true;
+  w->result.status = resp->first.status;
+  w->result.reason = std::move(resp->first.reason);
+  w->result.headers = std::move(resp->first.headers);
+  w->result.body = resp->second.to_string();
+  const bool close_me = !resp->first.keep_alive;
+  w->ev.signal();
+  if (close_me) {
+    sock->SetFailed(ESHUTDOWN);  // server said Connection: close
+  }
+}
+
+void httpc_process_request(InputMessage&&) {}
+
+int httpc_protocol_index() {
+  static const int index = [] {
+    Protocol p = {"httpc", httpc_parse, httpc_process_request,
+                  httpc_process_response,
+                  /*process_in_order=*/true};
+    return register_protocol(p);
+  }();
+  return index;
+}
+
+}  // namespace
+
+const std::string* HttpResult::header(const std::string& name) const {
+  for (const auto& [k, v] : headers) {
+    if (ci_eq(k, name)) {
+      return &v;
+    }
+  }
+  return nullptr;
+}
+
+HttpClient::~HttpClient() {
+  csock_.Shutdown();
+}
+
+int HttpClient::Init(const std::string& addr, const Options* opts) {
+  fiber_init(0);
+  if (opts != nullptr) {
+    opts_ = *opts;
+  }
+  httpc_protocol_index();
+  std::string target = addr;
+  if (target.rfind("http://", 0) == 0) {
+    target = target.substr(7);
+  }
+  const size_t slash = target.find('/');
+  if (slash != std::string::npos && target.rfind("unix:", 0) != 0) {
+    target.resize(slash);  // strip any path; calls pass paths explicitly
+  }
+  host_ = target;
+  return csock_.Init(target);
+}
+
+HttpResult HttpClient::Do(
+    const std::string& verb, const std::string& path,
+    const std::vector<std::pair<std::string, std::string>>& extra_headers,
+    const std::string& body) {
+  HttpResult fail;
+  auto w = std::make_shared<HttpWaiter>();
+  w->head_only = ci_eq(verb, "HEAD");
+
+  std::string wire = verb + " " + path + " HTTP/1.1\r\nHost: " + host_ +
+                     "\r\n";
+  for (const auto& [k, v] : extra_headers) {
+    wire += k + ": " + v + "\r\n";
+  }
+  if (!body.empty() || ci_eq(verb, "POST") || ci_eq(verb, "PUT")) {
+    wire += "Content-Length: " + std::to_string(body.size()) + "\r\n";
+  }
+  wire += "\r\n";
+  wire += body;
+
+  SocketId sid = 0;
+  {
+    LockGuard<FiberMutex> g(sock_mu_);
+    auto install = [](Socket* fresh) -> int {
+      cli_conn_of(fresh);  // install state while single-threaded
+      return 0;
+    };
+    if (csock_.ensure(httpc_protocol_index(), install, &sid) != 0) {
+      fail.error = "cannot reach " + host_;
+      return fail;
+    }
+  }
+  SocketRef s(Socket::Address(sid));
+  if (!s) {
+    fail.error = "connection failed";
+    return fail;
+  }
+  HttpCliConn* c = cli_conn_of(s.get());
+  {
+    // Queue order must equal wire order: both under one lock.
+    std::lock_guard<std::mutex> g(c->mu);
+    c->pending.push_back(w);
+    IOBuf frame;
+    frame.append(wire);
+    if (s->Write(std::move(frame)) != 0) {
+      c->pending.pop_back();
+      fail.error = "write failed";
+      return fail;
+    }
+  }
+  if (w->ev.wait(monotonic_time_us() + opts_.timeout_ms * 1000) != 0) {
+    fail.error = "timeout";
+    return fail;
+  }
+  return std::move(w->result);
+}
+
+HttpResult HttpClient::Get(const std::string& path) {
+  return Do("GET", path, {}, "");
+}
+
+HttpResult HttpClient::Head(const std::string& path) {
+  return Do("HEAD", path, {}, "");
+}
+
+HttpResult HttpClient::Post(const std::string& path,
+                            const std::string& content_type,
+                            const std::string& body) {
+  return Do("POST", path, {{"Content-Type", content_type}}, body);
+}
+
+}  // namespace trpc

@@ -1,0 +1,62 @@
+// HTTP/1.1 client over the fiber runtime — keep-alive, pipelined FIFO
+// correlation, chunked responses.
+//
+// Parity: the reference issues HTTP calls through Channel with an
+// http:// URL (policy/http_rpc_protocol.cpp client half + Controller's
+// http_request accessors).  Condensed per-protocol-client form (the
+// RedisClient idiom): one lazily-connected pinned socket, requests
+// written in order, responses popped FIFO — HTTP/1.1's ordering
+// guarantee is exactly the pipelined_count contract.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "fiber/sync.h"
+#include "net/http_message.h"
+#include "net/proto_client.h"
+
+namespace trpc {
+
+struct HttpResult {
+  bool ok = false;       // transport-level success (any status counts)
+  std::string error;     // transport failure text when !ok
+  int status = 0;
+  std::string reason;
+  std::vector<std::pair<std::string, std::string>> headers;
+  std::string body;
+
+  // nullptr when absent; case-insensitive.
+  const std::string* header(const std::string& name) const;
+};
+
+class HttpClient {
+ public:
+  struct Options {
+    int64_t timeout_ms = 2000;
+  };
+
+  ~HttpClient();
+  // "host:port", "http://host:port", or "unix:/path".
+  int Init(const std::string& addr, const Options* opts = nullptr);
+
+  HttpResult Get(const std::string& path);
+  HttpResult Post(const std::string& path, const std::string& content_type,
+                  const std::string& body);
+  HttpResult Head(const std::string& path);
+  // Full form: extra headers ride verbatim (Host/Content-Length added).
+  HttpResult Do(const std::string& verb, const std::string& path,
+                const std::vector<std::pair<std::string, std::string>>&
+                    extra_headers,
+                const std::string& body);
+
+ private:
+  Options opts_;
+  std::string host_;  // Host header value
+  FiberMutex sock_mu_;
+  ClientSocket csock_;
+};
+
+}  // namespace trpc

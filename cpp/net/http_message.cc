@@ -238,6 +238,120 @@ void parse_query_string(
   }
 }
 
+
+// Shared header-block scan for BOTH directions.  Every smuggling-
+// hardening rule lives here exactly once: no whitespace before the
+// colon, a single non-list numeric Content-Length, Transfer-Encoding
+// exactly "chunked", CL+TE rejected by the callers.
+struct HeaderScan {
+  std::vector<std::pair<std::string, std::string>> headers;
+  bool chunked = false;
+  bool have_content_length = false;
+  uint64_t content_len = 0;
+  int keep_alive = -1;  // -1 header absent, 0 close, 1 keep-alive
+};
+
+bool parse_header_block(const std::string& window, size_t pos,
+                        size_t hdr_end, HeaderScan* out) {
+  while (pos < hdr_end + 2) {
+    size_t eol = window.find("\r\n", pos);
+    if (eol == std::string::npos || eol > hdr_end) {
+      eol = hdr_end;
+    }
+    const std::string hline = window.substr(pos, eol - pos);
+    pos = eol + 2;
+    if (hline.empty()) {
+      break;
+    }
+    const size_t colon = hline.find(':');
+    if (colon == std::string::npos || colon == 0) {
+      return false;  // a header line without a name
+    }
+    std::string name = hline.substr(0, colon);
+    // RFC 7230 §3.2.4: whitespace between field-name and colon must be
+    // rejected — "Content-Length :" would otherwise dodge the framing
+    // logic while a fronting proxy honors it (request smuggling).
+    if (name.back() == ' ' || name.back() == '\t') {
+      return false;
+    }
+    std::string value = trim_ows(hline.substr(colon + 1));
+    if (ci_equal(name, "content-length")) {
+      // Duplicate or list-valued Content-Length desyncs framing: reject
+      // outright rather than trusting either copy (request smuggling).
+      // 1*DIGIT only (RFC 7230): strtoull's leading '+'/whitespace
+      // tolerance is a smuggling desync vector behind stricter proxies.
+      if (out->have_content_length ||
+          value.find(',') != std::string::npos || value.empty() ||
+          value[0] < '0' || value[0] > '9') {
+        return false;
+      }
+      char* end = nullptr;
+      out->content_len = strtoull(value.c_str(), &end, 10);
+      if (end == value.c_str() || *end != '\0' ||
+          out->content_len > kMaxBody) {
+        return false;
+      }
+      out->have_content_length = true;
+    } else if (ci_equal(name, "transfer-encoding")) {
+      // Only the exact value "chunked" (already OWS-trimmed).  A
+      // substring match would accept "chunked, gzip" — where the body
+      // framing is gzip-of-chunks — as plain chunked (a desync vector
+      // behind proxies honoring the full coding list), and
+      // "gzip, chunked" would hand still-compressed bytes up.
+      if (!ci_equal(value, "chunked")) {
+        return false;
+      }
+      out->chunked = true;
+    } else if (ci_equal(name, "connection")) {
+      if (ci_contains(value, "close")) {
+        out->keep_alive = 0;
+      } else if (ci_contains(value, "keep-alive")) {
+        out->keep_alive = 1;
+      }
+    }
+    out->headers.emplace_back(std::move(name), std::move(value));
+  }
+  return true;
+}
+
+// Shared body framing: chunked (resumable via `state`) or
+// Content-Length.  The no-framing case stays with the callers (request:
+// empty body; response: unsupported read-until-close).
+ParseError parse_framed_body(IOBuf* source, size_t body_off, bool chunked,
+                             uint64_t content_len, IOBuf* body,
+                             std::shared_ptr<void>* state) {
+  if (chunked) {
+    std::shared_ptr<ChunkedState> st;
+    if (state != nullptr && *state != nullptr) {
+      st = std::static_pointer_cast<ChunkedState>(*state);
+    } else {
+      st = std::make_shared<ChunkedState>();
+      st->pos = body_off;
+      if (state != nullptr) {
+        *state = st;
+      }
+    }
+    size_t consumed = 0;
+    const ParseError rc = parse_chunked(*source, st.get(), body, &consumed);
+    if (rc == ParseError::kOk) {
+      if (state != nullptr) {
+        state->reset();
+      }
+      source->pop_front(consumed);
+    } else if (rc == ParseError::kCorrupted && state != nullptr) {
+      state->reset();
+    }
+    return rc;
+  }
+  const uint64_t total = static_cast<uint64_t>(body_off) + content_len;
+  if (source->size() < total) {
+    return ParseError::kNotEnoughData;
+  }
+  source->pop_front(body_off);
+  source->cutn(body, content_len);
+  return ParseError::kOk;
+}
+
 ParseError http_parse_request(IOBuf* source, HttpRequest* req, IOBuf* body,
                               std::shared_ptr<void>* state) {
   // Header window only — the non-chunked body is cut straight from the
@@ -287,110 +401,102 @@ ParseError http_parse_request(IOBuf* source, HttpRequest* req, IOBuf* body,
     return ParseError::kCorrupted;
   }
 
-  // ---- headers ----------------------------------------------------------
-  req->headers.clear();
-  bool have_content_length = false;
-  uint64_t content_len = 0;
-  size_t pos = line_end + 2;
-  while (pos < hdr_end + 2) {
-    size_t eol = window.find("\r\n", pos);
-    if (eol == std::string::npos || eol > hdr_end) {
-      eol = hdr_end;
-    }
-    const std::string hline = window.substr(pos, eol - pos);
-    pos = eol + 2;
-    if (hline.empty()) {
-      break;
-    }
-    const size_t colon = hline.find(':');
-    if (colon == std::string::npos || colon == 0) {
-      return ParseError::kCorrupted;  // a header line without a name
-    }
-    std::string name = hline.substr(0, colon);
-    // RFC 7230 §3.2.4: whitespace between field-name and colon must be
-    // rejected — "Content-Length :" would otherwise dodge the framing
-    // logic while a fronting proxy honors it (request smuggling).
-    if (name.back() == ' ' || name.back() == '\t') {
-      return ParseError::kCorrupted;
-    }
-    std::string value = trim_ows(hline.substr(colon + 1));
-    if (ci_equal(name, "content-length")) {
-      // Duplicate or list-valued Content-Length desyncs framing: reject
-      // outright rather than trusting either copy (request smuggling).
-      if (have_content_length ||
-          value.find(',') != std::string::npos) {
-        return ParseError::kCorrupted;
-      }
-      // 1*DIGIT only (RFC 7230): strtoull's leading '+'/whitespace
-      // tolerance is a smuggling desync vector behind stricter proxies.
-      if (value.empty() || value[0] < '0' || value[0] > '9') {
-        return ParseError::kCorrupted;
-      }
-      char* end = nullptr;
-      content_len = strtoull(value.c_str(), &end, 10);
-      if (end == value.c_str() || *end != '\0' || content_len > kMaxBody) {
-        return ParseError::kCorrupted;
-      }
-      have_content_length = true;
-    } else if (ci_equal(name, "transfer-encoding")) {
-      // Only the exact value "chunked" is supported (value is already
-      // OWS-trimmed).  A substring match would accept "chunked, gzip" —
-      // where the body framing is gzip-of-chunks — as plain chunked (a
-      // desync vector behind proxies honoring the full coding list), and
-      // "gzip, chunked" would hand still-compressed bytes to the handler.
-      if (!ci_equal(value, "chunked")) {
-        return ParseError::kCorrupted;  // unsupported coding list
-      }
-      req->chunked = true;
-    } else if (ci_equal(name, "connection")) {
-      if (ci_contains(value, "close")) {
-        req->keep_alive = false;
-      } else if (ci_contains(value, "keep-alive")) {
-        req->keep_alive = true;
-      }
-    }
-    req->headers.emplace_back(std::move(name), std::move(value));
+  // ---- headers (shared scan) ---------------------------------------------
+  HeaderScan hs;
+  if (!parse_header_block(window, line_end + 2, hdr_end, &hs)) {
+    return ParseError::kCorrupted;
   }
-  if (req->http_1_0 && req->header("Connection") == nullptr) {
+  req->headers = std::move(hs.headers);
+  req->chunked = hs.chunked;
+  if (hs.keep_alive >= 0) {
+    req->keep_alive = hs.keep_alive != 0;
+  } else if (req->http_1_0) {
     req->keep_alive = false;
   }
   // A message with BOTH is a smuggling vector: reject (RFC 7230 §3.3.3).
-  if (req->chunked && have_content_length) {
+  if (req->chunked && hs.have_content_length) {
+    return ParseError::kCorrupted;
+  }
+
+  // ---- body (no framing headers = no body, for requests) -----------------
+  return parse_framed_body(source, hdr_end + 4, req->chunked,
+                           hs.have_content_length ? hs.content_len : 0,
+                           body, state);
+}
+
+const std::string* HttpResponse::header(const std::string& name) const {
+  for (const auto& [k, v] : headers) {
+    if (ci_equal(k, name.c_str())) {
+      return &v;
+    }
+  }
+  return nullptr;
+}
+
+ParseError http_parse_response(IOBuf* source, HttpResponse* resp,
+                               IOBuf* body, std::shared_ptr<void>* state,
+                               bool head_only) {
+  const size_t scan = std::min(source->size(), kMaxHeaderBytes);
+  std::string window;
+  window.resize(scan);
+  source->copy_to(window.data(), window.size());
+
+  const size_t hdr_end = window.find("\r\n\r\n");
+  if (hdr_end == std::string::npos) {
+    return scan >= kMaxHeaderBytes ? ParseError::kCorrupted
+                                   : ParseError::kNotEnoughData;
+  }
+  if (hdr_end + 4 > kMaxHeaderBytes) {
+    return ParseError::kCorrupted;
+  }
+
+  // ---- status line -------------------------------------------------------
+  const size_t line_end = window.find("\r\n");
+  const std::string line = window.substr(0, line_end);
+  if (line.rfind("HTTP/1.", 0) != 0 || line.size() < 12) {
+    return ParseError::kCorrupted;
+  }
+  resp->http_1_0 = line[7] == '0';
+  if (line[8] != ' ' || line[9] < '1' || line[9] > '5' ||
+      line[10] < '0' || line[10] > '9' || line[11] < '0' ||
+      line[11] > '9') {
+    return ParseError::kCorrupted;
+  }
+  if (line.size() > 12 && line[12] != ' ') {
+    return ParseError::kCorrupted;  // "HTTP/1.1 2004" / "200X" forms
+  }
+  resp->status = (line[9] - '0') * 100 + (line[10] - '0') * 10 +
+                 (line[11] - '0');
+  resp->reason = line.size() > 13 ? line.substr(13) : std::string();
+
+  // ---- headers (the shared smuggling-strict scan) -------------------------
+  HeaderScan hs;
+  if (!parse_header_block(window, line_end + 2, hdr_end, &hs)) {
+    return ParseError::kCorrupted;
+  }
+  resp->headers = std::move(hs.headers);
+  resp->chunked = hs.chunked;
+  resp->keep_alive =
+      hs.keep_alive >= 0 ? hs.keep_alive != 0 : !resp->http_1_0;
+  if (resp->chunked && hs.have_content_length) {
     return ParseError::kCorrupted;
   }
 
   // ---- body --------------------------------------------------------------
   const size_t body_off = hdr_end + 4;
-  if (req->chunked) {
-    std::shared_ptr<ChunkedState> st;
-    if (state != nullptr && *state != nullptr) {
-      st = std::static_pointer_cast<ChunkedState>(*state);
-    } else {
-      st = std::make_shared<ChunkedState>();
-      st->pos = body_off;
-      if (state != nullptr) {
-        *state = st;
-      }
-    }
-    size_t consumed = 0;
-    const ParseError rc = parse_chunked(*source, st.get(), body, &consumed);
-    if (rc == ParseError::kOk) {
-      if (state != nullptr) {
-        state->reset();
-      }
-      source->pop_front(consumed);
-    } else if (rc == ParseError::kCorrupted && state != nullptr) {
-      state->reset();
-    }
-    return rc;
+  const bool bodyless = head_only || resp->status == 204 ||
+                        resp->status == 304 ||
+                        (resp->status >= 100 && resp->status < 200);
+  if (bodyless) {
+    source->pop_front(body_off);
+    return ParseError::kOk;
   }
-  const uint64_t total = static_cast<uint64_t>(body_off) + content_len;
-  if (source->size() < total) {
-    return ParseError::kNotEnoughData;
+  if (!resp->chunked && !hs.have_content_length) {
+    // Read-until-close framing: out of scope (see header).
+    return ParseError::kCorrupted;
   }
-  source->pop_front(body_off);
-  source->cutn(body, content_len);
-  return ParseError::kOk;
+  return parse_framed_body(source, body_off, resp->chunked,
+                           hs.content_len, body, state);
 }
 
 std::string http_status_line(int status) {

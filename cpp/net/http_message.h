@@ -60,4 +60,29 @@ void parse_query_string(
 // Response head for the given status; body appended by the caller.
 std::string http_status_line(int status);
 
+// ---- client direction ----------------------------------------------------
+
+struct HttpResponse {
+  int status = 0;
+  std::string reason;
+  bool http_1_0 = false;
+  bool keep_alive = true;
+  bool chunked = false;
+  std::vector<std::pair<std::string, std::string>> headers;
+
+  const std::string* header(const std::string& name) const;
+};
+
+// Cuts ONE complete response off `source` (status line, headers, body by
+// Content-Length / chunked / bodyless-status rules).  Same contract and
+// resumable-chunked `state` slot as http_parse_request.  `head_only`
+// marks a HEAD-request response (headers only, whatever Content-Length
+// claims).  Read-until-close framing (no CL, no TE on an HTTP/1.0-style
+// response) is reported as kCorrupted — this client speaks 1.1 and every
+// modern server frames explicitly.
+ParseError http_parse_response(IOBuf* source, HttpResponse* resp,
+                               IOBuf* body,
+                               std::shared_ptr<void>* state = nullptr,
+                               bool head_only = false);
+
 }  // namespace trpc

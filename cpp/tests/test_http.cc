@@ -13,6 +13,7 @@
 #include "base/time.h"
 #include "fiber/fiber.h"
 #include "net/channel.h"
+#include "net/http_client.h"
 #include "net/http_protocol.h"
 #include "net/progressive.h"
 #include "net/server.h"
@@ -20,6 +21,10 @@
 #include "tests/test_util.h"
 
 using namespace trpc;
+
+namespace trpc {
+extern std::atomic<int64_t> g_socket_count;  // net/builtin.cc
+}
 
 namespace {
 
@@ -577,6 +582,113 @@ TEST_CASE(rpcz_linked_spans) {
   }
   EXPECT(linked);
   http_get("GET /flags/rpcz_enabled?setvalue=false HTTP/1.1\r\nHost: x\r\n\r\n");
+}
+
+TEST_CASE(http_response_parser_vectors) {
+  // Content-Length body.
+  {
+    IOBuf src;
+    src.append("HTTP/1.1 200 OK\r\nContent-Length: 5\r\n\r\nhello");
+    HttpResponse resp;
+    IOBuf body;
+    EXPECT_EQ(static_cast<int>(http_parse_response(&src, &resp, &body)),
+              static_cast<int>(ParseError::kOk));
+    EXPECT_EQ(resp.status, 200);
+    EXPECT(resp.reason == "OK");
+    EXPECT(body.to_string() == "hello");
+    EXPECT_EQ(src.size(), 0u);
+  }
+  // Chunked body arriving in fragments (resumable state).
+  {
+    const std::string full =
+        "HTTP/1.1 200 OK\r\nTransfer-Encoding: chunked\r\n\r\n"
+        "4\r\nwiki\r\n5\r\npedia\r\n0\r\n\r\n";
+    IOBuf src;
+    std::shared_ptr<void> st;
+    HttpResponse resp;
+    IOBuf body;
+    for (size_t cut = 0; cut < full.size(); cut += 7) {
+      src.append(full.substr(cut, 7));
+      const ParseError rc = http_parse_response(&src, &resp, &body, &st);
+      if (cut + 7 < full.size()) {
+        EXPECT_EQ(static_cast<int>(rc),
+                  static_cast<int>(ParseError::kNotEnoughData));
+      } else {
+        EXPECT_EQ(static_cast<int>(rc),
+                  static_cast<int>(ParseError::kOk));
+      }
+    }
+    EXPECT(body.to_string() == "wikipedia");
+  }
+  // 204 has no body even without Content-Length.
+  {
+    IOBuf src;
+    src.append("HTTP/1.1 204 No Content\r\n\r\nNEXT");
+    HttpResponse resp;
+    IOBuf body;
+    EXPECT_EQ(static_cast<int>(http_parse_response(&src, &resp, &body)),
+              static_cast<int>(ParseError::kOk));
+    EXPECT_EQ(resp.status, 204);
+    EXPECT_EQ(body.size(), 0u);
+    EXPECT(src.to_string() == "NEXT");  // next response's bytes survive
+  }
+  // HEAD responses keep their Content-Length but carry no body.
+  {
+    IOBuf src;
+    src.append("HTTP/1.1 200 OK\r\nContent-Length: 999\r\n\r\n");
+    HttpResponse resp;
+    IOBuf body;
+    EXPECT_EQ(static_cast<int>(http_parse_response(
+                  &src, &resp, &body, nullptr, /*head_only=*/true)),
+              static_cast<int>(ParseError::kOk));
+    EXPECT_EQ(body.size(), 0u);
+  }
+  // Smuggling-class rejects: CL+TE together, garbage status line,
+  // unframed body.
+  for (const char* bad :
+       {"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n"
+        "Transfer-Encoding: chunked\r\n\r\nxx",
+        "HTTP/9.9 20x OK\r\n\r\n",
+        "HTTP/1.1 200 OK\r\n\r\nunframed-tail"}) {
+    IOBuf src;
+    src.append(bad);
+    HttpResponse resp;
+    IOBuf body;
+    EXPECT_EQ(static_cast<int>(http_parse_response(&src, &resp, &body)),
+              static_cast<int>(ParseError::kCorrupted));
+  }
+}
+
+TEST_CASE(http_client_end_to_end) {
+  start_once();
+  HttpClient cli;
+  EXPECT_EQ(cli.Init("http://127.0.0.1:" + std::to_string(g_port)), 0);
+  HttpResult r = cli.Get("/health");
+  // Keep-alive: after the first call's connection, further calls must
+  // not create sockets (async teardown of EARLIER tests' sockets may
+  // decrement the global count, so the check is one-sided).
+  const int64_t after_first = g_socket_count.load();
+  EXPECT(r.ok);
+  EXPECT_EQ(r.status, 200);
+  EXPECT(r.body == "OK\n");
+  r = cli.Get("/status?format=json");
+  EXPECT(r.ok && r.status == 200);
+  EXPECT(r.header("Content-Type") != nullptr &&
+         *r.header("Content-Type") == "application/json");
+  EXPECT(r.body.find("requests_served") != std::string::npos);
+  // RPC through the HTTP bridge.
+  r = cli.Post("/Echo.Echo", "application/octet-stream", "via-HttpClient");
+  EXPECT(r.ok && r.status == 200);
+  EXPECT(r.body == "via-HttpClient");
+  // 404 is a successful TRANSPORT result.
+  r = cli.Get("/definitely-not-here");
+  EXPECT(r.ok);
+  EXPECT_EQ(r.status, 404);
+  // HEAD: headers only.
+  r = cli.Head("/health");
+  EXPECT(r.ok && r.status == 200);
+  EXPECT(r.body.empty());
+  EXPECT(g_socket_count.load() <= after_first);
 }
 
 TEST_CASE(sockets_ids_vlog_dir_endpoints) {
