@@ -1,22 +1,174 @@
 #include "base/symbolize.h"
 
+#include <cxxabi.h>
 #include <dlfcn.h>
+#include <elf.h>
+#include <fcntl.h>
 #include <stdio.h>
 #include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
 
+#include <algorithm>
 #include <cstdint>
+#include <map>
+#include <mutex>
+#include <vector>
 
 namespace trpc {
+
+namespace {
+
+// One module's function symbols, sorted by offset.  Built lazily by
+// reading the ELF .symtab (falls back to .dynsym) — dladdr alone only
+// sees the dynamic table, so static functions would print as hex
+// (the reference vendors Chromium's symbolize for the same reason).
+struct ModuleSyms {
+  bool is_dyn = false;  // ET_DYN: st_value is a load-base offset
+  std::vector<std::pair<uint64_t, std::string>> funcs;  // sorted
+};
+
+ModuleSyms load_module_syms(const char* path) {
+  ModuleSyms out;
+  const int fd = open(path, O_RDONLY | O_CLOEXEC);
+  if (fd < 0) {
+    return out;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < static_cast<off_t>(sizeof(Elf64_Ehdr))) {
+    close(fd);
+    return out;
+  }
+  void* map = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (map == MAP_FAILED) {
+    return out;
+  }
+  const uint8_t* base = static_cast<const uint8_t*>(map);
+  const auto* eh = reinterpret_cast<const Elf64_Ehdr*>(base);
+  const auto bounded = [&](uint64_t off, uint64_t n) {
+    return off <= static_cast<uint64_t>(st.st_size) &&
+           n <= static_cast<uint64_t>(st.st_size) - off;
+  };
+  if (memcmp(eh->e_ident, ELFMAG, SELFMAG) != 0 ||
+      eh->e_ident[EI_CLASS] != ELFCLASS64 ||
+      !bounded(eh->e_shoff,
+               static_cast<uint64_t>(eh->e_shnum) * sizeof(Elf64_Shdr))) {
+    munmap(map, st.st_size);
+    return out;
+  }
+  out.is_dyn = eh->e_type == ET_DYN;
+  const auto* sh = reinterpret_cast<const Elf64_Shdr*>(base + eh->e_shoff);
+  // Prefer the full .symtab; .dynsym is the dladdr-visible subset.
+  for (const uint32_t want : {SHT_SYMTAB, SHT_DYNSYM}) {
+    for (int i = 0; i < eh->e_shnum; ++i) {
+      if (sh[i].sh_type != want || sh[i].sh_link >= eh->e_shnum ||
+          sh[i].sh_entsize != sizeof(Elf64_Sym) ||
+          !bounded(sh[i].sh_offset, sh[i].sh_size) ||
+          !bounded(sh[sh[i].sh_link].sh_offset,
+                   sh[sh[i].sh_link].sh_size)) {
+        continue;
+      }
+      const auto* syms =
+          reinterpret_cast<const Elf64_Sym*>(base + sh[i].sh_offset);
+      const size_t n = sh[i].sh_size / sizeof(Elf64_Sym);
+      const char* strtab = reinterpret_cast<const char*>(
+          base + sh[sh[i].sh_link].sh_offset);
+      const size_t str_size = sh[sh[i].sh_link].sh_size;
+      out.funcs.reserve(n);
+      for (size_t s = 0; s < n; ++s) {
+        if (ELF64_ST_TYPE(syms[s].st_info) != STT_FUNC ||
+            syms[s].st_value == 0 || syms[s].st_name >= str_size) {
+          continue;
+        }
+        const char* name = strtab + syms[s].st_name;
+        // Bound the NUL scan by the strtab section: a truncated module
+        // whose strtab ends at EOF without a terminator must not read
+        // past the mapping.
+        const void* nul =
+            memchr(name, 0, str_size - syms[s].st_name);
+        if (nul == nullptr || *name == '\0') {
+          continue;
+        }
+        out.funcs.emplace_back(
+            syms[s].st_value,
+            std::string(name, static_cast<const char*>(nul)));
+      }
+      break;
+    }
+    if (!out.funcs.empty()) {
+      break;
+    }
+  }
+  munmap(map, st.st_size);
+  std::sort(out.funcs.begin(), out.funcs.end(),
+            [](const auto& a, const auto& b) { return a.first < b.first; });
+  return out;
+}
+
+std::mutex g_syms_mu;
+std::map<std::string, ModuleSyms>& syms_cache() {
+  static auto* m = new std::map<std::string, ModuleSyms>();  // leaked
+  return *m;
+}
+
+// Largest function symbol at or below `off`, or nullptr.
+const std::string* lookup(const ModuleSyms& mod, uint64_t off) {
+  auto it = std::upper_bound(
+      mod.funcs.begin(), mod.funcs.end(), off,
+      [](uint64_t v, const auto& p) { return v < p.first; });
+  if (it == mod.funcs.begin()) {
+    return nullptr;
+  }
+  --it;
+  // A hit more than 1MB past the symbol start is a gap, not a function.
+  if (off - it->first > (1u << 20)) {
+    return nullptr;
+  }
+  return &it->second;
+}
+
+std::string demangled(const char* name) {
+  int status = 0;
+  char* d = abi::__cxa_demangle(name, nullptr, nullptr, &status);
+  if (status == 0 && d != nullptr) {
+    std::string out = d;
+    free(d);
+    return out;
+  }
+  free(d);
+  return name;
+}
+
+}  // namespace
 
 std::string symbolize_addr(void* addr) {
   Dl_info info;
   if (dladdr(addr, &info) != 0) {
     if (info.dli_sname != nullptr) {
-      return info.dli_sname;  // exported symbol
+      return demangled(info.dli_sname);  // exported symbol: cheap path
     }
     if (info.dli_fname != nullptr) {
-      // Static functions have no dynamic symbol: report module+offset so
-      // external tooling (addr2line, pprof with the binary) can resolve.
+      // Static functions have no dynamic symbol — consult the module's
+      // full .symtab (built once per module, cached).
+      const ModuleSyms* mod;
+      {
+        std::lock_guard<std::mutex> g(g_syms_mu);
+        auto [it, fresh] = syms_cache().try_emplace(info.dli_fname);
+        if (fresh) {
+          it->second = load_module_syms(info.dli_fname);
+        }
+        mod = &it->second;
+      }
+      const uint64_t off =
+          mod->is_dyn
+              ? reinterpret_cast<uintptr_t>(addr) -
+                    reinterpret_cast<uintptr_t>(info.dli_fbase)
+              : reinterpret_cast<uintptr_t>(addr);
+      if (const std::string* name = lookup(*mod, off)) {
+        return demangled(name->c_str());
+      }
       const char* base = strrchr(info.dli_fname, '/');
       char buf[256];
       snprintf(buf, sizeof(buf), "%s+0x%zx",

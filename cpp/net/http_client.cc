@@ -14,14 +14,6 @@ namespace trpc {
 
 namespace {
 
-bool ci_eq(const std::string& a, const std::string& b) {
-  return a.size() == b.size() &&
-         std::equal(a.begin(), a.end(), b.begin(), [](char x, char y) {
-           return ::tolower(static_cast<unsigned char>(x)) ==
-                  ::tolower(static_cast<unsigned char>(y));
-         });
-}
-
 // One in-flight request awaiting its FIFO slot's response.  head_only
 // tracks HEAD requests, whose responses carry headers but no body
 // whatever Content-Length says.
@@ -59,23 +51,29 @@ ParseError httpc_parse(IOBuf* source, InputMessage* out, Socket* sock) {
       head_only = c->pending.front()->head_only;
     }
   }
-  auto resp = std::make_shared<std::pair<HttpResponse, IOBuf>>();
-  const ParseError rc = http_parse_response(
-      source, &resp->first, &resp->second, &c->chunk_state, head_only);
-  if (rc != ParseError::kOk) {
-    return rc;
+  while (true) {
+    auto resp = std::make_shared<std::pair<HttpResponse, IOBuf>>();
+    const ParseError rc = http_parse_response(
+        source, &resp->first, &resp->second, &c->chunk_state, head_only);
+    if (rc != ParseError::kOk) {
+      return rc;
+    }
+    if (resp->first.status < 200) {
+      // 1xx interim (100 Continue, 103 Early Hints): NOT the final
+      // response — swallow it (a loop, not recursion: a server
+      // streaming thousands of interims must not grow the stack) so
+      // the FIFO stays aligned with the request the real response
+      // answers.
+      if (source->empty()) {
+        return ParseError::kNotEnoughData;
+      }
+      continue;
+    }
+    out->meta.type = RpcMeta::kResponse;
+    out->ctx = std::move(resp);
+    out->socket = sock->id();
+    return ParseError::kOk;
   }
-  if (resp->first.status < 200) {
-    // 1xx interim (100 Continue, 103 Early Hints): NOT the final
-    // response — swallowing it here keeps the FIFO aligned with the
-    // request the real response answers.
-    return source->empty() ? ParseError::kNotEnoughData
-                           : httpc_parse(source, out, sock);
-  }
-  out->meta.type = RpcMeta::kResponse;
-  out->ctx = std::move(resp);
-  out->socket = sock->id();
-  return ParseError::kOk;
 }
 
 void httpc_process_response(InputMessage&& msg) {
@@ -122,12 +120,7 @@ int httpc_protocol_index() {
 }  // namespace
 
 const std::string* HttpResult::header(const std::string& name) const {
-  for (const auto& [k, v] : headers) {
-    if (ci_eq(k, name)) {
-      return &v;
-    }
-  }
-  return nullptr;
+  return http_find_header(headers, name);
 }
 
 HttpClient::~HttpClient() {
@@ -158,14 +151,14 @@ HttpResult HttpClient::Do(
     const std::string& body) {
   HttpResult fail;
   auto w = std::make_shared<HttpWaiter>();
-  w->head_only = ci_eq(verb, "HEAD");
+  w->head_only = http_ci_equal(verb, "HEAD");
 
   std::string wire = verb + " " + path + " HTTP/1.1\r\nHost: " + host_ +
                      "\r\n";
   for (const auto& [k, v] : extra_headers) {
     wire += k + ": " + v + "\r\n";
   }
-  if (!body.empty() || ci_eq(verb, "POST") || ci_eq(verb, "PUT")) {
+  if (!body.empty() || http_ci_equal(verb, "POST") || http_ci_equal(verb, "PUT")) {
     wire += "Content-Length: " + std::to_string(body.size()) + "\r\n";
   }
   wire += "\r\n";

@@ -161,13 +161,23 @@ ParseError parse_chunked(const IOBuf& source, ChunkedState* st,
 
 }  // namespace
 
-const std::string* HttpRequest::header(const std::string& name) const {
+bool http_ci_equal(const std::string& a, const std::string& b) {
+  return ci_equal(a, b.c_str());
+}
+
+const std::string* http_find_header(
+    const std::vector<std::pair<std::string, std::string>>& headers,
+    const std::string& name) {
   for (const auto& [k, v] : headers) {
     if (ci_equal(k, name.c_str())) {
       return &v;
     }
   }
   return nullptr;
+}
+
+const std::string* HttpRequest::header(const std::string& name) const {
+  return http_find_header(headers, name);
 }
 
 const std::string* HttpRequest::query(const std::string& name) const {
@@ -425,12 +435,7 @@ ParseError http_parse_request(IOBuf* source, HttpRequest* req, IOBuf* body,
 }
 
 const std::string* HttpResponse::header(const std::string& name) const {
-  for (const auto& [k, v] : headers) {
-    if (ci_equal(k, name.c_str())) {
-      return &v;
-    }
-  }
-  return nullptr;
+  return http_find_header(headers, name);
 }
 
 ParseError http_parse_response(IOBuf* source, HttpResponse* resp,

@@ -48,6 +48,13 @@ struct HttpRequest {
 ParseError http_parse_request(IOBuf* source, HttpRequest* req, IOBuf* body,
                               std::shared_ptr<void>* state = nullptr);
 
+// Case-insensitive ASCII compare / header lookup — THE header-matching
+// semantics, shared by both directions and the HTTP client.
+bool http_ci_equal(const std::string& a, const std::string& b);
+const std::string* http_find_header(
+    const std::vector<std::pair<std::string, std::string>>& headers,
+    const std::string& name);
+
 // Percent-decodes `in` ('+' becomes space when for_query).  Returns false
 // on malformed escapes (which a strict parser rejects).
 bool percent_decode(const std::string& in, std::string* out, bool for_query);
