@@ -1,5 +1,7 @@
 #include "net/channel.h"
 
+#include <functional>
+
 #include <errno.h>
 
 #include "base/compress.h"
@@ -11,6 +13,7 @@
 #include "net/messenger.h"
 #include "net/progressive.h"
 #include "net/protocol.h"
+#include "net/ici_transport.h"
 #include "net/shm_transport.h"
 #include "net/socket_map.h"
 #include "net/span.h"
@@ -217,16 +220,19 @@ int Channel::Init(const std::string& addr, const Options* opts) {
   if (!parse_connection_type(opts_.connection_type, &ct)) {
     return -1;  // typo'd type must not silently mean "single"
   }
-  if (opts_.use_shm && ct != ConnectionType::kSingle) {
-    return -1;  // shm rings are inherently single-connection
+  if ((opts_.use_shm || opts_.use_ici) && ct != ConnectionType::kSingle) {
+    return -1;  // shm/ici rings are inherently single-connection
+  }
+  if (opts_.use_shm && opts_.use_ici) {
+    return -1;
   }
   if (opts_.use_tls &&
-      (ct != ConnectionType::kSingle || opts_.use_shm ||
+      (ct != ConnectionType::kSingle || opts_.use_shm || opts_.use_ici ||
        !tls_available())) {
     return -1;  // TLS rides the single TCP connection
   }
   if (proto_ != 0) {
-    if (ct != ConnectionType::kSingle || opts_.use_shm) {
+    if (ct != ConnectionType::kSingle || opts_.use_shm || opts_.use_ici) {
       return -1;  // h2 multiplexes one connection by design
     }
     h2_client_protocol_index();  // register before any response arrives
@@ -260,6 +266,42 @@ static int send_credential(SocketId sid, const Authenticator* auth) {
   return s && s->Write(std::move(frame)) == 0 ? 0 : -1;
 }
 
+// Ring-transport bootstrap (rdma_handshake-over-TCP parity, shared by the
+// shm and ICI paths): ship the freshly-minted segment name over a
+// throwaway TCP channel — which carries the channel's authenticator, so
+// auth-gated servers accept the handshake — then install the fd-less ring
+// socket via `attach` and send the credential frame over the rings (the
+// ring connection is a fresh connection to an auth-checking server).
+// Returns 0 with *sock live on success.
+static int ring_bootstrap(const EndPoint& ep, const Channel::Options& copts,
+                          const char* method, const std::string& seg_name,
+                          const std::function<int(SocketId*)>& attach,
+                          SocketId* sock) {
+  Channel tcp;
+  Channel::Options topts;
+  topts.timeout_ms = copts.timeout_ms;
+  topts.auth = copts.auth;
+  if (tcp.Init(endpoint2str(ep), &topts) != 0) {
+    return -1;
+  }
+  Controller cntl;
+  cntl.set_timeout_ms(copts.timeout_ms);
+  IOBuf req, resp;
+  req.append(seg_name);
+  tcp.CallMethod(method, req, &resp, &cntl);
+  if (cntl.Failed() || !resp.equals("ok", 2) || attach(sock) != 0) {
+    return -1;
+  }
+  if (send_credential(*sock, copts.auth) != 0) {
+    SocketRef dead(Socket::Address(*sock));
+    if (dead) {
+      dead->SetFailed(EACCES);
+    }
+    return -1;
+  }
+  return 0;
+}
+
 int Channel::ensure_socket(SocketId* out) {
   LockGuard<FiberMutex> g(sock_mu_);
   Socket* s = Socket::Address(sock_);
@@ -271,31 +313,37 @@ int Channel::ensure_socket(SocketId* out) {
     }
     s->Dereference();
   }
+  if (opts_.use_ici) {
+    std::string name;
+    auto conn = ici_conn_create(&name);
+    if (conn != nullptr &&
+        ring_bootstrap(ep_, opts_, kIciConnectMethod, name,
+                       [&conn](SocketId* sid) {
+                         return ici_socket_create(
+                             conn, &messenger_on_readable, nullptr, sid);
+                       },
+                       &sock_) == 0) {
+      *out = sock_;
+      return 0;
+    }
+    LOG(Warning) << "ici handshake with " << endpoint2str(ep_)
+                 << " failed; falling back to tcp";
+  }
   if (opts_.use_shm) {
-    // Handshake a ring segment over a throwaway TCP channel, then run the
-    // connection fd-less (rdma_handshake-over-TCP parity).
     std::string name;
     auto conn = shm_conn_create(&name);
-    if (conn != nullptr) {
-      Channel tcp;
-      Channel::Options topts;
-      topts.timeout_ms = opts_.timeout_ms;
-      if (tcp.Init(endpoint2str(ep_), &topts) == 0) {
-        Controller cntl;
-        cntl.set_timeout_ms(opts_.timeout_ms);
-        IOBuf req, resp;
-        req.append(name);
-        tcp.CallMethod(kShmConnectMethod, req, &resp, &cntl);
-        if (!cntl.Failed() && resp.equals("ok", 2) &&
-            shm_socket_create(conn, &messenger_on_readable, nullptr,
-                              &sock_) == 0) {
-          *out = sock_;
-          return 0;
-        }
-      }
-      LOG(Warning) << "shm handshake with " << endpoint2str(ep_)
-                   << " failed; falling back to tcp";
+    if (conn != nullptr &&
+        ring_bootstrap(ep_, opts_, kShmConnectMethod, name,
+                       [&conn](SocketId* sid) {
+                         return shm_socket_create(
+                             conn, &messenger_on_readable, nullptr, sid);
+                       },
+                       &sock_) == 0) {
+      *out = sock_;
+      return 0;
     }
+    LOG(Warning) << "shm handshake with " << endpoint2str(ep_)
+                 << " failed; falling back to tcp";
   }
   Socket::Options sopts;
   sopts.fd = -1;  // lazy connect in the write fiber

@@ -43,10 +43,14 @@ class Channel {
     // handshakes a ring segment over TCP, then calls flow through shm.
     // Falls back to TCP transparently if the handshake fails.
     bool use_shm = false;
+    // ICI DMA-ring transport (net/ici_transport.h): posted-block credit
+    // windows over registered DeviceArena slabs, rdma_endpoint parity.
+    // Handshakes over TCP like use_shm; single-connection only.
+    bool use_ici = false;
     // TLS to the server (net/tls.h).  Requires connection_type "single"
     // (the TLS session rides the one multiplexed connection) and excludes
-    // use_shm.  No peer verification by default, like the reference's
-    // default ChannelSSLOptions.
+    // use_shm/use_ici.  No peer verification by default, like the
+    // reference's default ChannelSSLOptions.
     bool use_tls = false;
   };
 
@@ -63,8 +67,8 @@ class Channel {
                   IOBuf* response, Controller* cntl, Closure done = nullptr);
 
   const EndPoint& endpoint() const { return ep_; }
-  // Name of the live connection's transport ("tcp", "shm_ring"), or "" if
-  // no socket has been established yet.
+  // Name of the live connection's transport ("tcp", "shm_ring",
+  // "ici_ring", "tls"), or "" if no socket has been established yet.
   std::string transport_name();
 
  private:

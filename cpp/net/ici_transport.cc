@@ -1,0 +1,875 @@
+#include "net/ici_transport.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <signal.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+#include "base/device_arena.h"
+#include "base/logging.h"
+#include "base/rand.h"
+#include "base/time.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr uint32_t kIciMaxSlots = 1024;
+constexpr uint32_t kIciMaxSlabs = 64;  // per side
+constexpr uint32_t kSlabNameLen = 48;
+constexpr uint64_t kIciMagic = 0x5452504943493254ull;  // "TRPICI2T"
+
+// ---- ring geometry (client proposes, server validates) ------------------
+
+struct Geometry {
+  uint32_t block_size = 64 * 1024;
+  uint32_t slots = 16;
+  // Receive-pool cap per direction (block_pool growth bound): the biggest
+  // message a connection can carry is ≈ (max_blocks - slots) × block_size,
+  // because a frame's blocks stay pinned until it parses whole.
+  uint32_t max_blocks = 1024;
+};
+
+std::mutex& geom_mu() {
+  static std::mutex* mu = new std::mutex();
+  return *mu;
+}
+Geometry& geom() {
+  static Geometry* g = new Geometry();
+  return *g;
+}
+
+bool geometry_valid(uint32_t block_size, uint32_t slots,
+                    uint32_t max_blocks) {
+  return block_size >= 4096 && block_size <= 4 * 1024 * 1024 &&
+         slots >= 2 && slots <= kIciMaxSlots &&
+         (slots & (slots - 1)) == 0 && max_blocks >= slots &&
+         max_blocks <= kIciMaxSlabs * slots &&
+         static_cast<uint64_t>(block_size) * slots <= 256ull * 1024 * 1024;
+}
+
+// ---- slab registration seam ---------------------------------------------
+
+struct Registrar {
+  int (*reg)(void*, size_t, void*, uint64_t*) = nullptr;
+  void (*unreg)(void*, size_t, void*, uint64_t) = nullptr;
+  void* ctx = nullptr;
+};
+std::mutex& reg_mu() {
+  static std::mutex* mu = new std::mutex();
+  return *mu;
+}
+Registrar& registrar() {
+  static Registrar* r = new Registrar();
+  return *r;
+}
+std::atomic<size_t>& registered_slabs() {
+  static std::atomic<size_t>* n = new std::atomic<size_t>(0);
+  return *n;
+}
+
+// Trampolines DeviceArena registration through the swappable registrar.
+int slab_register_tramp(void* base, size_t len, void*, uint64_t* handle) {
+  Registrar r;
+  {
+    std::lock_guard<std::mutex> g(reg_mu());
+    r = registrar();
+  }
+  if (r.reg != nullptr && r.reg(base, len, r.ctx, handle) != 0) {
+    return -1;
+  }
+  if (r.reg == nullptr) {
+    *handle = registered_slabs().load(std::memory_order_relaxed);
+  }
+  registered_slabs().fetch_add(1, std::memory_order_relaxed);
+  return 0;
+}
+void slab_unregister_tramp(void* base, size_t len, void*, uint64_t handle) {
+  Registrar r;
+  {
+    std::lock_guard<std::mutex> g(reg_mu());
+    r = registrar();
+  }
+  if (r.unreg != nullptr) {
+    r.unreg(base, len, r.ctx, handle);
+  }
+  registered_slabs().fetch_sub(1, std::memory_order_relaxed);
+}
+
+// ---- shared control segment ---------------------------------------------
+
+// One one-way DMA lane.  The RECEIVER posts recv blocks — (slab,offset)
+// descriptors into its own registered slabs, the lkey analogue — to
+// post_ring; the SENDER claims them strictly in order, DMAs payload into
+// the peer slab, and publishes a {meta,len} descriptor.  The receiver
+// bumps desc_consumed once it owns the data; that is the sender's send
+// completion (sbuf release point).  Cursors are free-running uint64s.
+struct IciDesc {
+  uint64_t meta;  // slab_id<<32 | offset  (echoes the claimed post entry)
+  uint32_t len;
+  uint32_t pad;
+};
+
+struct IciDir {
+  alignas(64) std::atomic<uint64_t> post_head;      // receiver bumps
+  alignas(64) std::atomic<uint64_t> desc_head;      // sender bumps
+  alignas(64) std::atomic<uint64_t> desc_consumed;  // receiver bumps
+  alignas(64) uint64_t post_ring[kIciMaxSlots];     // (slab,offset) metas
+  IciDesc desc_ring[kIciMaxSlots];
+};
+
+// Each side's receive pool is a set of uniformly-sized registered slabs,
+// published by name so the peer can map them lazily (block_pool growth:
+// new slabs appear while the connection runs).
+struct SlabTable {
+  std::atomic<uint32_t> count;
+  char names[kIciMaxSlabs][kSlabNameLen];
+};
+
+struct IciSegment {
+  uint64_t magic;
+  uint32_t block_size;
+  uint32_t slots;
+  uint32_t max_blocks;
+  uint32_t pad;
+  std::atomic<int32_t> client_pid;
+  std::atomic<int32_t> server_pid;
+  std::atomic<uint64_t> client_beat;
+  std::atomic<uint64_t> server_beat;
+  SlabTable client_slabs;  // client's receive pool (server DMAs into these)
+  SlabTable server_slabs;
+  IciDir c2s;  // client sends, server receives
+  IciDir s2c;
+};
+
+void* map_shm(const char* name, size_t len) {
+  const int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) {
+    return nullptr;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0 || static_cast<size_t>(st.st_size) < len) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  return mem == MAP_FAILED ? nullptr : mem;
+}
+
+}  // namespace
+
+// ---- connection state ----------------------------------------------------
+
+// Receive-pool keepalive: slabs must outlive every IOBuf block wrapped over
+// them, even after the connection is gone (a consumer may sit on received
+// bytes indefinitely).  Deleter contexts share ownership.
+struct IciRx {
+  std::unique_ptr<DeviceArena> arena;
+  std::atomic<uint64_t> wrapped{0};  // blocks held by consumers
+};
+
+void ici_conn_release_name(const std::string& name);
+
+struct IciConn {
+  IciSegment* seg = nullptr;
+  std::string name;
+  bool is_client = false;
+  bool creator = false;
+  bool unlink_on_close = false;
+  uint32_t block_size = 0;
+  uint32_t slots = 0;
+  uint32_t max_blocks = 0;
+
+  // My receive pool + the FIFO of blocks currently posted (post entries
+  // are claimed by the sender strictly in order, so descriptor n resolves
+  // to the n-th posted block).
+  std::shared_ptr<IciRx> rx;
+  std::deque<Block*> posted_fifo;  // poller-owned
+  uint32_t repost_deficit = 0;     // posts deferred on pool exhaustion
+
+  // Peer receive slabs mapped as DMA targets (lazily, as the peer's pool
+  // grows).  Poller-owned.
+  std::vector<char*> tx_slabs;
+  size_t tx_slab_len = 0;
+
+  // Local send queue: the writer fiber posts WRs (each ≤ block_size bytes
+  // of IOBuf refs, uncopied); the poller is the DMA engine.  SPSC.
+  std::vector<IOBuf> sq;
+  alignas(64) std::atomic<uint64_t> sq_head{0};  // writer bumps
+  alignas(64) std::atomic<uint64_t> sq_tail{0};  // poller bumps
+  // DMA'd-but-uncompleted source refs, indexed by descriptor slot
+  // (_sbuf parity: released only when the peer's desc_consumed passes).
+  std::vector<IOBuf> sbuf;
+  uint64_t sbuf_released = 0;  // poller-local completion cursor
+  uint64_t post_tail = 0;      // poller-local posted-credit cursor
+
+  // Receive staging the read fiber drains (poller appends wrapped blocks).
+  std::mutex rx_mu;
+  IOBuf rx_pending;
+  uint64_t rx_desc_tail = 0;  // poller-local
+
+  // Stats.
+  std::atomic<uint64_t> tx_wrs{0}, rx_wrs{0}, tx_bytes{0}, rx_bytes{0};
+  std::atomic<uint64_t> window_exhausted{0};
+
+  IciDir& tx_dir() { return is_client ? seg->c2s : seg->s2c; }
+  IciDir& rx_dir() { return is_client ? seg->s2c : seg->c2s; }
+  SlabTable& my_slabs() {
+    return is_client ? seg->client_slabs : seg->server_slabs;
+  }
+  SlabTable& peer_slabs() {
+    return is_client ? seg->server_slabs : seg->client_slabs;
+  }
+  int32_t peer_pid() const {
+    return (is_client ? seg->server_pid : seg->client_pid)
+        .load(std::memory_order_acquire);
+  }
+  uint64_t peer_beat() const {
+    return (is_client ? seg->server_beat : seg->client_beat)
+        .load(std::memory_order_acquire);
+  }
+  void bump_self_beat() {
+    (is_client ? seg->client_beat : seg->server_beat)
+        .fetch_add(1, std::memory_order_acq_rel);
+  }
+
+  ~IciConn() {
+    sq.clear();     // drop queued source refs (SetFailed mid-transfer)
+    sbuf.clear();   // drop deferred in-flight refs
+    {
+      std::lock_guard<std::mutex> g(rx_mu);
+      rx_pending.clear();
+    }
+    for (Block* b : posted_fifo) {
+      b->release();
+    }
+    for (char* m : tx_slabs) {
+      if (m != nullptr) {
+        munmap(m, tx_slab_len);
+      }
+    }
+    if (seg != nullptr) {
+      munmap(seg, sizeof(IciSegment));
+    }
+    if (creator || unlink_on_close) {
+      shm_unlink(name.c_str());
+    }
+    if (!creator) {
+      ici_conn_release_name(name);
+    }
+  }
+};
+
+namespace {
+
+// Deleter context for a wrapped recv block: returns the block to the pool
+// when the consumer drops the last reference.  Holds the pool alive
+// independently of the connection.
+struct RxBlockCtx {
+  std::shared_ptr<IciRx> rx;
+  Block* block;
+};
+
+void rx_block_deleter(void*, void* vctx) {
+  auto* ctx = static_cast<RxBlockCtx*>(vctx);
+  ctx->rx->wrapped.fetch_sub(1, std::memory_order_relaxed);
+  ctx->block->release();  // back to the arena free list
+  delete ctx;
+}
+
+// Publishes a freshly-grown slab's shm name so the peer can map it.
+// Returns false when the slab table is full/invalid.
+bool publish_slabs(IciConn& c) {
+  SlabTable& t = c.my_slabs();
+  const size_t have = c.rx->arena->slab_count();
+  uint32_t published = t.count.load(std::memory_order_relaxed);
+  while (published < have) {
+    if (published >= kIciMaxSlabs) {
+      return false;
+    }
+    const std::string name = c.rx->arena->slab_shm_name(published);
+    if (name.empty() || name.size() >= kSlabNameLen) {
+      return false;
+    }
+    snprintf(t.names[published], kSlabNameLen, "%s", name.c_str());
+    ++published;
+    t.count.store(published, std::memory_order_release);
+  }
+  return true;
+}
+
+// Allocates and posts one recv block; false when the pool is at its cap
+// (post deferred — pool-exhaustion backpressure), the post ring is full,
+// or the pool is broken.  Ring-fullness bound: the sender consumes post
+// entry n exactly when it publishes descriptor n, so entries it may not
+// have seen yet number post_head - desc_head; reusing a slot before the
+// sender claimed it would tear the window.
+bool post_one_block(IciConn& c, bool* fatal) {
+  IciDir& my_rxd = c.rx_dir();
+  if (my_rxd.post_head.load(std::memory_order_relaxed) -
+          my_rxd.desc_head.load(std::memory_order_acquire) >=
+      c.slots) {
+    return false;
+  }
+  if (c.rx->arena->blocks_in_use() >= c.max_blocks) {
+    return false;
+  }
+  Block* b = c.rx->arena->allocate(c.block_size);
+  if (b == nullptr) {
+    *fatal = true;
+    return false;
+  }
+  if (!publish_slabs(c)) {
+    b->release();
+    *fatal = true;
+    return false;
+  }
+  IciDir& rxd = c.rx_dir();
+  const uint64_t head = rxd.post_head.load(std::memory_order_relaxed);
+  rxd.post_ring[head & (c.slots - 1)] = b->user_meta;
+  c.posted_fifo.push_back(b);
+  rxd.post_head.store(head + 1, std::memory_order_release);
+  return true;
+}
+
+// Resolves a (slab,offset) meta to a DMA target inside the peer's pool,
+// mapping newly-published slabs on first use.  nullptr = invalid/hostile.
+char* resolve_tx_target(IciConn& c, uint64_t meta, uint32_t len) {
+  const uint32_t slab_id = static_cast<uint32_t>(meta >> 32);
+  const uint32_t offset = static_cast<uint32_t>(meta);
+  if (slab_id >= kIciMaxSlabs || offset % c.block_size != 0 ||
+      static_cast<size_t>(offset) + len > c.tx_slab_len) {
+    return nullptr;
+  }
+  SlabTable& t = c.peer_slabs();
+  while (c.tx_slabs.size() <= slab_id) {
+    const uint32_t published = t.count.load(std::memory_order_acquire);
+    const size_t next = c.tx_slabs.size();
+    if (next >= published) {
+      return nullptr;  // descriptor references an unpublished slab
+    }
+    char name[kSlabNameLen];
+    memcpy(name, t.names[next], kSlabNameLen);
+    name[kSlabNameLen - 1] = '\0';
+    if (strncmp(name, "/trpc_arena_", 12) != 0) {
+      return nullptr;
+    }
+    void* mem = map_shm(name, c.tx_slab_len);
+    if (mem == nullptr) {
+      return nullptr;
+    }
+    c.tx_slabs.push_back(static_cast<char*>(mem));
+  }
+  return c.tx_slabs[slab_id] + offset;
+}
+
+// ---- completion poller (PollCq / rdma_use_polling parity) ----------------
+
+struct PolledConn {
+  std::weak_ptr<IciConn> conn;
+  SocketId socket = 0;
+  int64_t created_us = 0;
+  int64_t last_liveness_us = 0;
+  uint64_t last_peer_beat = 0;
+  int64_t peer_beat_changed_us = 0;
+};
+
+class IciPoller {
+ public:
+  static IciPoller* instance() {
+    static IciPoller* p = new IciPoller();  // leaked: thread outlives statics
+    return p;
+  }
+
+  void add(std::shared_ptr<IciConn> conn, SocketId socket) {
+    std::lock_guard<std::mutex> g(mu_);
+    conns_.push_back(PolledConn{conn, socket, monotonic_time_us()});
+  }
+
+ private:
+  IciPoller() {
+    pthread_t tid;
+    pthread_create(
+        &tid, nullptr,
+        [](void* self) -> void* {
+          static_cast<IciPoller*>(self)->run();
+          return nullptr;
+        },
+        this);
+    pthread_detach(tid);
+  }
+
+  // One pass over one connection; returns true if anything moved.  *dead
+  // is set when the shared rings hold values only a corrupted or hostile
+  // peer could have written — the socket is then failed rather than spun
+  // on.
+  bool service(IciConn& c, bool* rx_edge, bool* tx_edge, bool* dead) {
+    const uint32_t mask = c.slots - 1;
+    bool moved = false;
+
+    // 1. RX: wrap freshly published descriptors zero-copy and hand them to
+    // the read path.  Bumping desc_consumed IS the peer's send completion;
+    // a fresh block is posted in the consumed one's place immediately
+    // (block_pool re-post semantics — the pool, not the ring, is the
+    // backpressure bound).
+    IciDir& rxd = c.rx_dir();
+    const uint64_t rx_head = rxd.desc_head.load(std::memory_order_acquire);
+    if (rx_head != c.rx_desc_tail) {
+      std::lock_guard<std::mutex> g(c.rx_mu);
+      while (c.rx_desc_tail != rx_head) {
+        const IciDesc d = rxd.desc_ring[c.rx_desc_tail & mask];
+        if (c.posted_fifo.empty() || d.len > c.block_size) {
+          *dead = true;
+          return moved;
+        }
+        Block* b = c.posted_fifo.front();
+        if (d.meta != b->user_meta) {
+          *dead = true;  // descriptor does not match the claimed post
+          return moved;
+        }
+        c.posted_fifo.pop_front();
+        auto* ctx = new RxBlockCtx{c.rx, b};
+        c.rx->wrapped.fetch_add(1, std::memory_order_relaxed);
+        c.rx_pending.append_user_data(b->data, d.len, &rx_block_deleter,
+                                      ctx, b->user_meta);
+        c.rx_wrs.fetch_add(1, std::memory_order_relaxed);
+        c.rx_bytes.fetch_add(d.len, std::memory_order_relaxed);
+        ++c.rx_desc_tail;
+        rxd.desc_consumed.store(c.rx_desc_tail, std::memory_order_release);
+        bool fatal = false;
+        if (!post_one_block(c, &fatal)) {
+          if (fatal) {
+            *dead = true;
+            return moved;
+          }
+          ++c.repost_deficit;  // pool exhausted; retry when blocks return
+        }
+      }
+      *rx_edge = true;
+      moved = true;
+    }
+
+    // 1b. Clear deferred posts once consumers return blocks to the pool.
+    while (c.repost_deficit > 0) {
+      bool fatal = false;
+      if (!post_one_block(c, &fatal)) {
+        if (fatal) {
+          *dead = true;
+          return moved;
+        }
+        break;
+      }
+      --c.repost_deficit;
+      moved = true;
+    }
+
+    // 2. TX completions: the peer consumed descriptors → release the
+    // deferred source refs (_sbuf) for those WRs.
+    IciDir& txd = c.tx_dir();
+    const uint64_t consumed =
+        txd.desc_consumed.load(std::memory_order_acquire);
+    while (c.sbuf_released < consumed) {
+      c.sbuf[c.sbuf_released & mask].clear();
+      ++c.sbuf_released;
+      moved = true;
+    }
+
+    // 3. TX DMA engine: drain the send queue while the window is open —
+    // a posted peer block (credit) AND a free descriptor slot.
+    const uint64_t sq_head = c.sq_head.load(std::memory_order_acquire);
+    uint64_t sq_tail = c.sq_tail.load(std::memory_order_relaxed);
+    if (sq_tail != sq_head) {
+      const uint64_t post_head =
+          txd.post_head.load(std::memory_order_acquire);
+      uint64_t desc_head = txd.desc_head.load(std::memory_order_relaxed);
+      while (sq_tail != sq_head && c.post_tail != post_head &&
+             desc_head - consumed < c.slots) {
+        IOBuf& wr = c.sq[sq_tail & mask];
+        const uint64_t target_meta = txd.post_ring[c.post_tail & mask];
+        const uint32_t len = static_cast<uint32_t>(wr.size());
+        char* dst = resolve_tx_target(c, target_meta, len);
+        if (dst == nullptr) {
+          *dead = true;
+          return moved;
+        }
+        // The DMA: gather the WR's refs into the peer's posted block.
+        size_t off = 0;
+        for (size_t i = 0; i < wr.block_count(); ++i) {
+          const IOBuf::BlockRef& ref = wr.ref_at(i);
+          memcpy(dst + off, ref.block->data + ref.offset, ref.length);
+          off += ref.length;
+        }
+        // Publish the descriptor; hold the source refs until completion.
+        IciDesc& slot = txd.desc_ring[desc_head & mask];
+        slot.meta = target_meta;
+        slot.len = len;
+        c.sbuf[desc_head & mask] = std::move(wr);
+        ++desc_head;
+        txd.desc_head.store(desc_head, std::memory_order_release);
+        ++c.post_tail;
+        ++sq_tail;
+        c.tx_wrs.fetch_add(1, std::memory_order_relaxed);
+        c.tx_bytes.fetch_add(len, std::memory_order_relaxed);
+      }
+      if (sq_tail != c.sq_tail.load(std::memory_order_relaxed)) {
+        c.sq_tail.store(sq_tail, std::memory_order_release);
+        *tx_edge = true;  // SQ space freed → wake a parked writer
+        moved = true;
+      }
+    }
+    return moved;
+  }
+
+  void run() {
+    int idle_spins = 0;
+    while (true) {
+      bool any = false;
+      {
+        const int64_t now_us = monotonic_time_us();
+        std::lock_guard<std::mutex> g(mu_);
+        for (size_t i = 0; i < conns_.size();) {
+          PolledConn& pc = conns_[i];
+          std::shared_ptr<IciConn> conn = pc.conn.lock();
+          if (conn == nullptr) {
+            conns_[i] = conns_.back();
+            conns_.pop_back();
+            continue;
+          }
+          bool rx_edge = false, tx_edge = false, dead = false;
+          if (service(*conn, &rx_edge, &tx_edge, &dead)) {
+            any = true;
+          }
+          if (dead) {
+            LOG(Warning) << "ici rings corrupt (" << conn->name
+                         << "); failing socket";
+            conn->unlink_on_close = true;
+            SocketRef s(Socket::Address(pc.socket));
+            if (s) {
+              s->SetFailed(EPROTO);
+            }
+            conns_[i] = conns_.back();
+            conns_.pop_back();
+            continue;
+          }
+          if (rx_edge || tx_edge) {
+            SocketRef s(Socket::Address(pc.socket));
+            if (s) {
+              if (rx_edge) {
+                s->on_input_event();
+              }
+              if (tx_edge) {
+                s->on_output_event();
+              }
+            } else if (conn->rx_pending.size() > 0 && rx_edge) {
+              // Socket gone: nobody will ever drain; drop the entry.
+              conns_[i] = conns_.back();
+              conns_.pop_back();
+              continue;
+            }
+          }
+          // Liveness (rate-limited ~1/s): reap on verified exit, a 30s
+          // heartbeat stall, or a peer that never arrived.
+          if (now_us - pc.last_liveness_us > 1000 * 1000) {
+            pc.last_liveness_us = now_us;
+            conn->bump_self_beat();
+            const uint64_t beat = conn->peer_beat();
+            if (beat != pc.last_peer_beat || pc.peer_beat_changed_us == 0) {
+              pc.last_peer_beat = beat;
+              pc.peer_beat_changed_us = now_us;
+            }
+            const int32_t peer = conn->peer_pid();
+            const bool no_pid =
+                peer == 0 && now_us - pc.created_us > 30 * 1000 * 1000;
+            const bool dead_pid =
+                peer != 0 && kill(static_cast<pid_t>(peer), 0) != 0 &&
+                errno == ESRCH;
+            const bool stalled =
+                now_us - pc.peer_beat_changed_us > 30 * 1000 * 1000;
+            if (no_pid || dead_pid || stalled) {
+              LOG(Warning) << "ici peer lost (" << conn->name << ", pid "
+                           << peer << "); reaping";
+              conn->unlink_on_close = true;
+              SocketRef deads(Socket::Address(pc.socket));
+              if (deads) {
+                deads->SetFailed(no_pid ? ETIMEDOUT : ECONNRESET);
+              }
+              conns_[i] = conns_.back();
+              conns_.pop_back();
+              continue;
+            }
+          }
+          ++i;
+        }
+      }
+      if (any) {
+        idle_spins = 0;
+        continue;
+      }
+      if (++idle_spins < 1000) {
+        sched_yield();
+      } else {
+        usleep(100);
+      }
+    }
+  }
+
+  std::mutex mu_;
+  std::vector<PolledConn> conns_;
+};
+
+// ---- the Transport -------------------------------------------------------
+
+class IciRingTransport final : public Transport {
+ public:
+  // Post ≤block_size WRs into the SQ without copying; the poller is the
+  // DMA engine.  Returns 0 (EAGAIN) when the SQ is full — KeepWrite then
+  // parks on the writable Event and the poller wakes it on completion.
+  ssize_t cut_from_iobuf(Socket* s, IOBuf* from) override {
+    auto* c = static_cast<IciConn*>(s->transport_ctx);
+    if (c == nullptr) {
+      errno = ENOTCONN;
+      return -1;
+    }
+    const uint32_t mask = c->slots - 1;
+    size_t total = 0;
+    while (!from->empty()) {
+      const uint64_t head = c->sq_head.load(std::memory_order_relaxed);
+      if (head - c->sq_tail.load(std::memory_order_acquire) >= c->slots) {
+        c->window_exhausted.fetch_add(1, std::memory_order_relaxed);
+        break;
+      }
+      IOBuf& wr = c->sq[head & mask];
+      const size_t n = from->cutn(&wr, c->block_size);
+      c->sq_head.store(head + 1, std::memory_order_release);
+      total += n;
+    }
+    return static_cast<ssize_t>(total);
+  }
+
+  ssize_t append_to_iobuf(Socket* s, IOBuf* to, size_t max) override {
+    auto* c = static_cast<IciConn*>(s->transport_ctx);
+    if (c == nullptr) {
+      errno = ENOTCONN;
+      return -1;
+    }
+    std::lock_guard<std::mutex> g(c->rx_mu);
+    return static_cast<ssize_t>(c->rx_pending.cutn(to, max));
+  }
+
+  int connect(Socket*) override { return 0; }  // established at handshake
+  bool fd_based() const override { return false; }
+  const char* name() const override { return "ici_ring"; }
+};
+
+IciRingTransport* ici_transport() {
+  static IciRingTransport t;
+  return &t;
+}
+
+// One consumer per segment name, ever (duplicate open = two readers on one
+// SPSC lane).
+std::mutex& open_names_mu() {
+  static std::mutex* mu = new std::mutex();
+  return *mu;
+}
+std::vector<std::string>& open_names() {
+  static auto* v = new std::vector<std::string>();
+  return *v;
+}
+
+// Builds one side's receive pool and posts the initial window.
+bool build_rx_side(IciConn& c) {
+  DeviceArena::Options aopts;
+  aopts.block_size = c.block_size;
+  aopts.blocks_per_slab = c.slots;
+  aopts.shm_backed = true;
+  aopts.register_slab = &slab_register_tramp;
+  aopts.unregister_slab = &slab_unregister_tramp;
+  c.rx = std::make_shared<IciRx>();
+  c.rx->arena.reset(new DeviceArena(aopts));
+  c.sq.resize(c.slots);
+  c.sbuf.resize(c.slots);
+  c.tx_slab_len = static_cast<size_t>(c.block_size) * c.slots;
+  for (uint32_t i = 0; i < c.slots; ++i) {
+    bool fatal = false;
+    if (!post_one_block(c, &fatal)) {
+      return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+void ici_conn_release_name(const std::string& name) {
+  std::lock_guard<std::mutex> g(open_names_mu());
+  auto& v = open_names();
+  v.erase(std::remove(v.begin(), v.end(), name), v.end());
+}
+
+void ici_set_ring_geometry(uint32_t block_size, uint32_t slots,
+                           uint32_t max_blocks) {
+  if (max_blocks == 0) {
+    max_blocks = std::min<uint32_t>(1024, kIciMaxSlabs * slots);
+  }
+  std::lock_guard<std::mutex> g(geom_mu());
+  if (geometry_valid(block_size, slots, max_blocks)) {
+    geom() = Geometry{block_size, slots, max_blocks};
+  }
+}
+
+void ici_set_slab_registrar(int (*reg)(void*, size_t, void*, uint64_t*),
+                            void (*unreg)(void*, size_t, void*, uint64_t),
+                            void* ctx) {
+  std::lock_guard<std::mutex> g(reg_mu());
+  registrar() = Registrar{reg, unreg, ctx};
+}
+
+size_t ici_registered_slab_count() {
+  return registered_slabs().load(std::memory_order_relaxed);
+}
+
+std::shared_ptr<IciConn> ici_conn_create(std::string* name_out) {
+  Geometry g;
+  {
+    std::lock_guard<std::mutex> lk(geom_mu());
+    g = geom();
+  }
+  char name[64];
+  snprintf(name, sizeof(name), "/trpc_ici_%d_%llx", getpid(),
+           static_cast<unsigned long long>(fast_rand()));
+  const int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) {
+    return nullptr;
+  }
+  if (ftruncate(fd, sizeof(IciSegment)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, sizeof(IciSegment), PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* seg = static_cast<IciSegment*>(mem);
+  memset(static_cast<void*>(seg), 0, sizeof(IciSegment));
+  seg->block_size = g.block_size;
+  seg->slots = g.slots;
+  seg->max_blocks = g.max_blocks;
+  seg->client_pid.store(static_cast<int32_t>(getpid()),
+                        std::memory_order_release);
+
+  auto conn = std::make_shared<IciConn>();
+  conn->seg = seg;
+  conn->name = name;
+  conn->is_client = true;
+  conn->creator = true;
+  conn->block_size = g.block_size;
+  conn->slots = g.slots;
+  conn->max_blocks = g.max_blocks;
+  if (!build_rx_side(*conn)) {
+    return nullptr;  // dtor unmaps + unlinks
+  }
+  seg->magic = kIciMagic;  // last: publish a fully-built segment
+  *name_out = name;
+  return conn;
+}
+
+std::shared_ptr<IciConn> ici_conn_open(const std::string& name) {
+  if (name.empty() || name[0] != '/' || name.rfind("/trpc_ici_", 0) != 0 ||
+      name.size() > 60) {
+    return nullptr;
+  }
+  {
+    std::lock_guard<std::mutex> g(open_names_mu());
+    auto& v = open_names();
+    if (std::find(v.begin(), v.end(), name) != v.end()) {
+      return nullptr;
+    }
+    v.push_back(name);
+  }
+  auto fail = [&name]() -> std::shared_ptr<IciConn> {
+    ici_conn_release_name(name);
+    return nullptr;
+  };
+  void* mem = map_shm(name.c_str(), sizeof(IciSegment));
+  if (mem == nullptr) {
+    return fail();
+  }
+  auto* seg = static_cast<IciSegment*>(mem);
+  if (seg->magic != kIciMagic ||
+      !geometry_valid(seg->block_size, seg->slots, seg->max_blocks)) {
+    munmap(mem, sizeof(IciSegment));
+    return fail();
+  }
+  auto conn = std::make_shared<IciConn>();
+  conn->seg = seg;
+  conn->name = name;
+  conn->is_client = false;
+  conn->block_size = seg->block_size;
+  conn->slots = seg->slots;
+  conn->max_blocks = seg->max_blocks;
+  if (!build_rx_side(*conn)) {
+    return nullptr;  // dtor unmaps + releases the name
+  }
+  seg->server_pid.store(static_cast<int32_t>(getpid()),
+                        std::memory_order_release);
+  return conn;
+}
+
+int ici_socket_create(std::shared_ptr<IciConn> conn,
+                      void (*on_readable)(SocketId, void*), void* user_data,
+                      SocketId* out) {
+  if (conn == nullptr) {
+    return -1;
+  }
+  Socket::Options opts;
+  opts.fd = -1;
+  opts.mode = SocketMode::kIci;
+  opts.on_readable = on_readable;
+  opts.user_data = user_data;
+  opts.transport = ici_transport();
+  opts.transport_ctx_holder = conn;
+  if (Socket::Create(opts, out) != 0) {
+    return -1;
+  }
+  IciPoller::instance()->add(conn, *out);
+  return 0;
+}
+
+IciConnStats ici_conn_stats(const IciConn& c) {
+  IciConnStats s;
+  s.tx_wrs = c.tx_wrs.load(std::memory_order_relaxed);
+  s.rx_wrs = c.rx_wrs.load(std::memory_order_relaxed);
+  s.tx_bytes = c.tx_bytes.load(std::memory_order_relaxed);
+  s.rx_bytes = c.rx_bytes.load(std::memory_order_relaxed);
+  s.window_exhausted = c.window_exhausted.load(std::memory_order_relaxed);
+  auto& txd = const_cast<IciConn&>(c).tx_dir();
+  s.sbuf_held = txd.desc_head.load(std::memory_order_acquire) -
+                txd.desc_consumed.load(std::memory_order_acquire);
+  s.rx_unposted = c.rx->wrapped.load(std::memory_order_relaxed);
+  s.slots = c.slots;
+  s.block_size = c.block_size;
+  return s;
+}
+
+void ici_conn_set_self_pid(IciConn& c, int32_t pid) {
+  (c.is_client ? c.seg->client_pid : c.seg->server_pid)
+      .store(pid, std::memory_order_release);
+}
+
+}  // namespace trpc

@@ -1,0 +1,106 @@
+// ICI DMA-ring transport — the device-interconnect endpoint behind
+// SocketMode::kIci.
+//
+// Parity: the reference's RDMA endpoint machinery, re-designed for a TPU
+// interconnect whose unit of transfer is a DMA into a registered
+// staging window rather than a byte stream:
+//   - posted receive blocks   (/root/reference/src/brpc/rdma/
+//     rdma_endpoint.h:295-299 `_rbuf` fixed recv blocks)
+//   - send/recv credit windows (`rdma_endpoint.h:292-328` —
+//     _remote_rq_window_size / _sq_window_size; exhaustion returns EAGAIN to
+//     the wait-free write queue so KeepWrite parks; completion wakes it)
+//   - deferred source release  (`_sbuf`: send-side IOBuf refs held until the
+//     completion for that WR, never freed at post time)
+//   - a completion poller      (`rdma_endpoint.h:250` PollCq /
+//     FLAGS_rdma_use_polling dedicated-poller mode)
+//   - registered block memory  (rdma/block_pool.cpp taking over IOBuf
+//     allocation; here base/device_arena.h slabs ARE the registered
+//     windows, and descriptors carry (slab,offset) — the lkey analogue)
+//   - TCP bootstrap handshake  (rdma_handshake-over-TCP: the client mints
+//     the rings, ships their names in an ordinary RPC, both sides then run
+//     fd-less sockets over the rings).
+//
+// TPU-native shape: one connection = two one-way DMA lanes.  Each side owns
+// a DeviceArena slab as its RECEIVE window (registered once — the
+// registration hook is where PJRT/libtpu pinning goes, see
+// ici_set_slab_registrar) and posts its blocks to the peer.  A send is:
+// claim a posted peer block (a credit), DMA the bytes into it, publish a
+// {offset,len} descriptor.  The receiver wraps the block into the IOBuf
+// zero-copy (meta = the block's lkey-analogue) and re-posts it only when
+// the last IOBuf reference drops — backpressure is therefore end-to-end:
+// a slow *consumer* (not just a slow reader) stalls the sender's window.
+//
+// Where this image cannot reach real device DMA, the slabs are shm/host
+// staging memory and the "DMA engine" is the poller thread doing the copy —
+// the machinery (windows, posted blocks, deferred release, completion wake)
+// is identical; see tools/pjrt_probe.md for the committed probe of real
+// device-pointer registration.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+
+#include "net/socket.h"
+
+namespace trpc {
+
+struct IciConn;
+
+// Client side: mint the control segment + receive window, post all recv
+// blocks.  *name_out is the segment name to ship in the handshake RPC.
+std::shared_ptr<IciConn> ici_conn_create(std::string* name_out);
+// Server side: map a client-minted segment, build our receive window, post
+// our blocks.  Validates geometry; nullptr on any mismatch.
+std::shared_ptr<IciConn> ici_conn_open(const std::string& name);
+
+// Builds the fd-less socket bound to `conn` and registers it with the
+// completion poller.
+int ici_socket_create(std::shared_ptr<IciConn> conn,
+                      void (*on_readable)(SocketId, void*), void* user_data,
+                      SocketId* out);
+
+// The handshake method name Servers auto-register.
+inline const char* kIciConnectMethod = "__ici.Connect";
+
+// Ring geometry for NEW client connections (the client proposes, the server
+// validates).  block_size: DMA granularity (clamped 4KB..4MB); slots: posted
+// blocks per direction (power of two, 2..1024); max_blocks: receive-pool
+// growth cap per direction (block_pool bound — the largest frame a
+// connection can carry is ≈ (max_blocks - slots) × block_size; 0 = default
+// 1024 capped at 64×slots).  Tests shrink this to force window exhaustion
+// and pool backpressure; the bench widens it.
+void ici_set_ring_geometry(uint32_t block_size, uint32_t slots,
+                           uint32_t max_blocks = 0);
+
+// Slab registration seam (block_pool::RegisterMemory parity): invoked once
+// per receive-window slab.  The default registrar records the slab in a
+// process-local table (handle = ordinal).  A real device backend (PJRT
+// pinned host memory) swaps itself in here.
+void ici_set_slab_registrar(int (*reg)(void* base, size_t len, void* ctx,
+                                       uint64_t* handle),
+                            void (*unreg)(void* base, size_t len, void* ctx,
+                                          uint64_t handle),
+                            void* ctx);
+// Number of slabs currently registered through the seam (probe/tests).
+size_t ici_registered_slab_count();
+
+// Introspection for tests and /vars.
+struct IciConnStats {
+  uint64_t tx_wrs = 0;           // descriptors published
+  uint64_t rx_wrs = 0;           // descriptors consumed
+  uint64_t tx_bytes = 0;
+  uint64_t rx_bytes = 0;
+  uint64_t window_exhausted = 0; // cut_from_iobuf hit a full window
+  uint64_t sbuf_held = 0;        // send WRs DMA'd but not yet completed
+  uint64_t rx_unposted = 0;      // recv blocks held by consumers (not posted)
+  uint32_t slots = 0;
+  uint32_t block_size = 0;
+};
+IciConnStats ici_conn_stats(const IciConn& c);
+
+// Overrides the pid this side published (liveness tests impersonate a
+// crashed peer without a full client process).
+void ici_conn_set_self_pid(IciConn& c, int32_t pid);
+
+}  // namespace trpc

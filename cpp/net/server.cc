@@ -29,6 +29,7 @@
 #include "net/thrift.h"
 #include "net/tls.h"
 #include "net/messenger.h"
+#include "net/ici_transport.h"
 #include "net/shm_transport.h"
 #include "net/span.h"
 #include "net/stream.h"
@@ -253,27 +254,50 @@ int Server::Start(int port) {
     register_esp_protocol();  // last: esp has no magic to probe
   }
   start_time_us_ = monotonic_time_us();
-  // Shared-memory transport handshake (net/shm_transport.h): a client sends
-  // the segment name it created; we map it and serve that connection over
-  // the rings.  Registered for every server — harmless if unused.
-  if (methods_.seek(kShmConnectMethod) == nullptr) {
-    RegisterMethod(kShmConnectMethod,
-                   [this](Controller* cntl, const IOBuf& req, IOBuf* resp,
-                          Closure done) {
-                     auto conn = shm_conn_open(req.to_string());
-                     SocketId sid = 0;
-                     if (conn == nullptr ||
-                         shm_socket_create(conn, &messenger_on_readable,
-                                           this, &sid) != 0) {
-                       cntl->SetFailed(EINVAL, "bad shm segment");
-                       done();
-                       return;
-                     }
-                     track_connection(sid);
-                     resp->append("ok");
-                     done();
-                   });
-  }
+  // Ring-transport handshakes (net/shm_transport.h, net/ici_transport.h):
+  // a client sends the segment name it minted; we map it and serve that
+  // connection over the rings.  Registered for every server — harmless if
+  // unused.  If the client dies (or gives up) after our "ok", the ring
+  // socket is not leaked: an attached-but-silent peer never bumps its
+  // segment heartbeat, so the poller's 30s stall reaper fails the socket
+  // and unlinks the segment.
+  const auto register_ring = [this](const char* method, const char* what,
+                                    int (*open_and_attach)(
+                                        const std::string&, Server*,
+                                        SocketId*)) {
+    if (methods_.seek(method) != nullptr) {
+      return;
+    }
+    RegisterMethod(method, [this, what, open_and_attach](
+                               Controller* cntl, const IOBuf& req,
+                               IOBuf* resp, Closure done) {
+      SocketId sid = 0;
+      if (open_and_attach(req.to_string(), this, &sid) != 0) {
+        cntl->SetFailed(EINVAL, what);
+        done();
+        return;
+      }
+      track_connection(sid);
+      resp->append("ok");
+      done();
+    });
+  };
+  register_ring(kShmConnectMethod, "bad shm segment",
+                [](const std::string& name, Server* srv, SocketId* sid) {
+                  auto conn = shm_conn_open(name);
+                  return conn != nullptr
+                             ? shm_socket_create(
+                                   conn, &messenger_on_readable, srv, sid)
+                             : -1;
+                });
+  register_ring(kIciConnectMethod, "bad ici segment",
+                [](const std::string& name, Server* srv, SocketId* sid) {
+                  auto conn = ici_conn_open(name);
+                  return conn != nullptr
+                             ? ici_socket_create(
+                                   conn, &messenger_on_readable, srv, sid)
+                             : -1;
+                });
   int fd;
   if (!unix_path_.empty()) {
     EndPoint uep;

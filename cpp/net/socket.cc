@@ -140,7 +140,9 @@ std::string Socket::DumpAll(size_t max_rows) {
                  ref->fd(), endpoint2str(ref->remote()).c_str(),
                  ref->mode() == SocketMode::kTcp
                      ? "tcp"
-                     : ref->mode() == SocketMode::kShm ? "shm" : "?",
+                     : ref->mode() == SocketMode::kShm
+                           ? "shm"
+                           : ref->mode() == SocketMode::kIci ? "ici" : "?",
                  p != nullptr ? p->name : "-",
                  ref->connected() ? "connected" : "connecting");
         *line = buf;

@@ -308,6 +308,9 @@ TEST_CASE(wrr_weight_distribution) {
   EXPECT_EQ(ch.Init(url, "wrr"), 0);
   for (int i = 0; i < 80; ++i) {
     Controller cntl;
+    // Generous: a timeout-driven retry under sanitizer slowdown would
+    // double-count a hit and break the exact-count assertions below.
+    cntl.set_timeout_ms(10000);
     IOBuf req, resp;
     req.append("x");
     ch.CallMethod("W.Hit", req, &resp, &cntl);
@@ -344,7 +347,9 @@ TEST_CASE(p2c_prefers_fast_server) {
   EXPECT_EQ(ch.Init(url, "p2c"), 0);
   for (int i = 0; i < 60; ++i) {
     Controller cntl;
-    cntl.set_timeout_ms(2000);
+    // Generous: under TSan's slowdown a tighter timeout can expire and
+    // retry, double-counting a handler hit (the 61-vs-60 flake).
+    cntl.set_timeout_ms(10000);
     IOBuf req, resp;
     req.append("x");
     ch.CallMethod("P.Hit", req, &resp, &cntl);
@@ -389,7 +394,7 @@ TEST_CASE(locality_aware_shifts_and_recovers) {
   auto run = [&](int n) {
     for (int i = 0; i < n; ++i) {
       Controller cntl;
-      cntl.set_timeout_ms(2000);
+      cntl.set_timeout_ms(10000);
       IOBuf req, resp;
       req.append("x");
       ch.CallMethod("L.Hit", req, &resp, &cntl);
@@ -408,8 +413,10 @@ TEST_CASE(locality_aware_shifts_and_recovers) {
     EXPECT(h.load() > 15);
   }
 
-  // Phase 2: node 1 degrades to 5ms — its share collapses.
-  delay_us[1].store(5000);
+  // Phase 2: node 1 degrades to 15ms — its share collapses.  (15ms, not
+  // 5ms: under TSan's slowdown per-call overhead approaches small
+  // injected delays and washes out the statistical skew.)
+  delay_us[1].store(15000);
   run(100);  // let feedback observe the slowdown
   reset();
   run(200);

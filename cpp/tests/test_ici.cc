@@ -1,0 +1,512 @@
+// ICI DMA-ring transport tests (rdma_endpoint parity): the credit-window
+// machinery itself (posted blocks, window exhaustion parking the writer,
+// deferred _sbuf release, end-to-end consumer backpressure), then the full
+// RPC path over the rings, failure injection, and liveness reaping.
+#include <errno.h>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <functional>
+#include <string>
+#include <vector>
+
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "net/channel.h"
+#include "net/ici_transport.h"
+#include "net/server.h"
+#include "net/stream.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+namespace {
+
+Server* g_server = nullptr;
+int g_port = 0;
+std::atomic<size_t> g_stream_got{0};
+
+void start_once() {
+  if (g_server != nullptr) {
+    return;
+  }
+  g_server = new Server();
+  g_server->RegisterMethod("Echo.Echo", [](Controller*, const IOBuf& req,
+                                           IOBuf* resp, Closure done) {
+    resp->append(req);
+    done();
+  });
+  g_server->RegisterMethod(
+      "IciStream.Up",
+      [](Controller* cntl, const IOBuf&, IOBuf* resp, Closure done) {
+        StreamOptions sopts;
+        sopts.on_message = [](StreamId, IOBuf&& chunk) {
+          g_stream_got.fetch_add(chunk.size());
+        };
+        StreamId sid;
+        if (StreamAccept(&sid, cntl, sopts) != 0) {
+          cntl->SetFailed(EINVAL, "no stream");
+        }
+        resp->append("ok");
+        done();
+      });
+  EXPECT_EQ(g_server->Start(0), 0);
+  g_port = g_server->port();
+}
+
+// ---- raw ring pair (no RPC layer): exposes the machinery ----------------
+
+// A raw receiving end: drains the transport into `acc` on readable edges.
+struct RawSink {
+  IOBuf acc;
+  FiberMutex mu;
+  std::atomic<size_t> total{0};
+  std::atomic<bool> hold{false};  // when set, received refs are KEPT
+  IOBuf held;
+};
+
+void raw_on_readable(SocketId id, void*) {
+  SocketRef s(Socket::Address(id));
+  if (!s) {
+    return;
+  }
+  auto* sink = static_cast<RawSink*>(s->user_data);
+  IOBuf got;
+  while (true) {
+    const ssize_t n = s->transport()->append_to_iobuf(s.get(), &got, 1 << 20);
+    if (n <= 0) {
+      break;
+    }
+  }
+  if (!got.empty()) {
+    LockGuard<FiberMutex> g(sink->mu);
+    sink->total.fetch_add(got.size());
+    if (sink->hold.load()) {
+      sink->held.append(std::move(got));  // refs pin the recv blocks
+    } else {
+      sink->acc.append(std::move(got));
+      sink->acc.clear();  // consume: deleters re-post blocks
+    }
+  }
+}
+
+struct RawPair {
+  std::shared_ptr<IciConn> client, server;
+  SocketId csock = 0, ssock = 0;
+  RawSink csink, ssink;
+
+  bool build() {
+    std::string name;
+    client = ici_conn_create(&name);
+    if (client == nullptr) {
+      return false;
+    }
+    server = ici_conn_open(name);
+    if (server == nullptr) {
+      return false;
+    }
+    // Order matters: the server side must exist (server_arena published)
+    // before the client socket maps its DMA target.
+    if (ici_socket_create(server, &raw_on_readable, nullptr, &ssock) != 0) {
+      return false;
+    }
+    {
+      SocketRef s(Socket::Address(ssock));
+      s->user_data = &ssink;
+    }
+    if (ici_socket_create(client, &raw_on_readable, nullptr, &csock) != 0) {
+      return false;
+    }
+    {
+      SocketRef s(Socket::Address(csock));
+      s->user_data = &csink;
+    }
+    return true;
+  }
+
+  ~RawPair() {
+    SocketRef c(Socket::Address(csock));
+    if (c) {
+      c->SetFailed(ECANCELED);
+    }
+    SocketRef s(Socket::Address(ssock));
+    if (s) {
+      s->SetFailed(ECANCELED);
+    }
+  }
+};
+
+bool wait_until(const std::function<bool()>& pred, int64_t timeout_ms) {
+  const int64_t deadline = monotonic_time_us() + timeout_ms * 1000;
+  while (monotonic_time_us() < deadline) {
+    if (pred()) {
+      return true;
+    }
+    usleep(1000);
+  }
+  return pred();
+}
+
+}  // namespace
+
+TEST_CASE(ici_window_exhaustion_and_deferred_release) {
+  fiber_init(0);
+  // Tiny window: 4 posted blocks of 4KB = 16KB in flight max.  A 1MB write
+  // must cycle the window ~64 times; the writer parks on exhaustion and the
+  // completion poller wakes it.
+  ici_set_ring_geometry(4096, 4);
+  auto* pair = new RawPair();
+  EXPECT(pair->build());
+  const size_t kPayload = 1 << 20;
+  std::string big(kPayload, 'x');
+  for (size_t i = 0; i < big.size(); i += 37) {
+    big[i] = static_cast<char>('A' + (i / 37) % 26);
+  }
+  IOBuf out;
+  out.append(big);
+  {
+    SocketRef c(Socket::Address(pair->csock));
+    EXPECT_EQ(c->Write(std::move(out)), 0);
+  }
+  EXPECT(wait_until([&] { return pair->ssink.total.load() == kPayload; },
+                    10000));
+  // Content integrity across window cycles (held under sink lock).
+  {
+    LockGuard<FiberMutex> g(pair->ssink.mu);
+    // acc was consumed block-by-block; re-read via totals only.
+  }
+  const IciConnStats cs = ici_conn_stats(*pair->client);
+  EXPECT_EQ(cs.tx_bytes, kPayload);
+  EXPECT(cs.tx_wrs >= kPayload / 4096);
+  // The wait-free write queue hit the window (the machinery engaged).
+  EXPECT(cs.window_exhausted > 0);
+  // All completions arrived: no source refs still deferred.
+  EXPECT(wait_until(
+      [&] { return ici_conn_stats(*pair->client).sbuf_held == 0; }, 2000));
+  ici_set_ring_geometry(64 * 1024, 16);
+  delete pair;
+}
+
+TEST_CASE(ici_content_integrity_across_window_cycles) {
+  fiber_init(0);
+  ici_set_ring_geometry(4096, 4);
+  auto* pair = new RawPair();
+  EXPECT(pair->build());
+  // Keep every received ref so we can byte-compare at the end — but that
+  // pins recv blocks, so use a payload small enough to fit... no: holding
+  // refs stalls the sender forever once the window is consumed.  Instead
+  // accumulate a copy.
+  pair->ssink.hold.store(true);
+  const size_t kPayload = 12 * 1024;  // 3/4 of the 16KB window
+  std::string msg(kPayload, 0);
+  for (size_t i = 0; i < msg.size(); ++i) {
+    msg[i] = static_cast<char>(i * 131 + 7);
+  }
+  IOBuf out;
+  out.append(msg);
+  {
+    SocketRef c(Socket::Address(pair->csock));
+    EXPECT_EQ(c->Write(std::move(out)), 0);
+  }
+  EXPECT(wait_until([&] { return pair->ssink.total.load() == kPayload; },
+                    5000));
+  {
+    LockGuard<FiberMutex> g(pair->ssink.mu);
+    EXPECT(pair->ssink.held.to_string() == msg);
+  }
+  ici_set_ring_geometry(64 * 1024, 16);
+  delete pair;
+}
+
+TEST_CASE(ici_consumer_backpressure_reopens_on_release) {
+  fiber_init(0);
+  // Pool-exhaustion backpressure (block_pool-bound semantics): the
+  // receiver READS everything promptly but KEEPS the IOBuf refs.  Re-posts
+  // draw fresh blocks from the pool until its cap (8 blocks here); then
+  // the sender's window must stay shut even though the reader is prompt.
+  ici_set_ring_geometry(4096, 4, /*max_blocks=*/8);
+  auto* pair = new RawPair();
+  EXPECT(pair->build());
+  pair->ssink.hold.store(true);
+  const size_t kPool = 4096 * 8;
+  std::string big(kPool * 2, 'b');
+  IOBuf out;
+  out.append(big);
+  {
+    SocketRef c(Socket::Address(pair->csock));
+    EXPECT_EQ(c->Write(std::move(out)), 0);
+  }
+  // The receiver can take at most the pool while holding refs.
+  EXPECT(wait_until([&] { return pair->ssink.total.load() >= kPool; },
+                    5000));
+  usleep(200 * 1000);  // give a stalled sender time to (wrongly) proceed
+  EXPECT_EQ(pair->ssink.total.load(), kPool);
+  const IciConnStats held = ici_conn_stats(*pair->server);
+  EXPECT_EQ(held.rx_unposted, 8u);  // the whole pool sits with the app
+  // Release the refs → blocks return → deferred posts clear → window
+  // reopens → transfer finishes.
+  {
+    LockGuard<FiberMutex> g(pair->ssink.mu);
+    pair->ssink.hold.store(false);
+    pair->ssink.held.clear();
+  }
+  EXPECT(wait_until([&] { return pair->ssink.total.load() == big.size(); },
+                    10000));
+  ici_set_ring_geometry(64 * 1024, 16);
+  delete pair;
+}
+
+TEST_CASE(ici_setfailed_mid_transfer_releases_everything) {
+  fiber_init(0);
+  ici_set_ring_geometry(4096, 4);
+  const size_t slabs_before = ici_registered_slab_count();
+  {
+    auto* pair = new RawPair();
+    EXPECT(pair->build());
+    EXPECT_EQ(ici_registered_slab_count(), slabs_before + 2);
+    // Receiver holds refs → sender wedges mid-transfer with a full sbuf
+    // and a deep write queue.
+    pair->ssink.hold.store(true);
+    std::string big(1 << 20, 'k');
+    IOBuf out;
+    out.append(big);
+    {
+      SocketRef c(Socket::Address(pair->csock));
+      EXPECT_EQ(c->Write(std::move(out)), 0);
+    }
+    EXPECT(wait_until([&] { return pair->ssink.total.load() >= 4096; },
+                      5000));
+    // Fail the sender mid-transfer from another thread of control.
+    {
+      SocketRef c(Socket::Address(pair->csock));
+      c->SetFailed(ECONNRESET);
+    }
+    // The parked KeepWrite fiber must observe the failure and drop the
+    // remaining queue; held refs on the receiver keep ITS slab alive.
+    EXPECT(wait_until(
+        [&] { return Socket::Address(pair->csock) == nullptr; }, 2000));
+    delete pair;  // fails server socket too
+  }
+  // Sockets drain asynchronously (KeepWrite/read fibers hold refs); both
+  // arenas must unregister once everything lets go.
+  EXPECT(wait_until(
+      [&] { return ici_registered_slab_count() == slabs_before; }, 5000));
+  ici_set_ring_geometry(64 * 1024, 16);
+}
+
+// ---- full RPC path over the rings ---------------------------------------
+
+TEST_CASE(ici_echo_roundtrip) {
+  start_once();
+  Channel ch;
+  Channel::Options opts;
+  opts.use_ici = true;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(g_port), &opts), 0);
+  for (int i = 0; i < 20; ++i) {
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("ici-" + std::to_string(i));
+    ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+    EXPECT(resp.to_string() == "ici-" + std::to_string(i));
+  }
+  EXPECT(ch.transport_name() == "ici_ring");
+}
+
+TEST_CASE(ici_payload_larger_than_window) {
+  start_once();
+  // 5MB payload through a 1MB window (16×64KB): many full window cycles in
+  // both directions under the real RPC framing.
+  Channel ch;
+  Channel::Options opts;
+  opts.use_ici = true;
+  opts.timeout_ms = 15000;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(g_port), &opts), 0);
+  std::string big(5 * 1024 * 1024, 'z');
+  for (size_t i = 0; i < big.size(); i += 101) {
+    big[i] = static_cast<char>('a' + i % 26);
+  }
+  Controller cntl;
+  cntl.set_timeout_ms(15000);
+  IOBuf req, resp;
+  req.append(big);
+  ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+  EXPECT_EQ(resp.size(), big.size());
+  EXPECT(resp.to_string() == big);
+}
+
+TEST_CASE(ici_concurrent_calls) {
+  start_once();
+  static Channel ch;
+  Channel::Options opts;
+  opts.use_ici = true;
+  opts.timeout_ms = 5000;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(g_port), &opts), 0);
+  static std::atomic<int> ok{0};
+  ok = 0;
+  std::vector<fiber_t> ids(16);
+  for (size_t i = 0; i < ids.size(); ++i) {
+    fiber_start(&ids[i], [](void* arg) {
+      const int base = static_cast<int>(reinterpret_cast<intptr_t>(arg));
+      for (int k = 0; k < 20; ++k) {
+        Controller cntl;
+        cntl.set_timeout_ms(5000);
+        IOBuf req, resp;
+        req.append("p" + std::to_string(base * 100 + k) +
+                   std::string(2000, 'q'));
+        ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+        if (!cntl.Failed() && resp.size() == req.size()) {
+          ok.fetch_add(1);
+        }
+      }
+    }, reinterpret_cast<void*>(static_cast<intptr_t>(i)));
+  }
+  for (auto f : ids) {
+    fiber_join(f);
+  }
+  EXPECT_EQ(ok.load(), 16 * 20);
+}
+
+TEST_CASE(ici_streaming_over_rings) {
+  start_once();
+  // Streaming RPC rides any transport; over ICI the stream's credit window
+  // composes with the ring window.
+  g_stream_got = 0;
+  Channel ch;
+  Channel::Options opts;
+  opts.use_ici = true;
+  opts.timeout_ms = 10000;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(g_port), &opts), 0);
+  StreamId sid = 0;
+  Controller cntl;
+  cntl.set_timeout_ms(10000);
+  StreamOptions sopts;
+  EXPECT_EQ(StreamCreate(&sid, &cntl, sopts), 0);
+  IOBuf req, resp;
+  req.append("start");
+  ch.CallMethod("IciStream.Up", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+  const std::string chunk(256 * 1024, 's');
+  size_t sent = 0;
+  for (int i = 0; i < 16; ++i) {
+    IOBuf b;
+    b.append(chunk);
+    if (StreamWrite(sid, std::move(b)) == 0) {
+      sent += chunk.size();
+    }
+  }
+  EXPECT_EQ(sent, chunk.size() * 16);
+  EXPECT(wait_until([&] { return g_stream_got.load() == sent; }, 10000));
+  StreamClose(sid);
+}
+
+namespace {
+// Auth over the rings: the bootstrap TCP channel must carry the
+// credential (the server gates EVERY method, including __ici.Connect),
+// and the fd-less ring socket must then authenticate itself too.
+struct TokenAuth : public Authenticator {
+  int generate_credential(std::string* s) const override {
+    *s = "ici-secret";
+    return 0;
+  }
+  int verify_credential(const std::string& s,
+                        const EndPoint&) const override {
+    return s == "ici-secret" ? 0 : -1;
+  }
+};
+}  // namespace
+
+TEST_CASE(ici_with_authenticated_server) {
+  static TokenAuth auth;
+  Server srv;
+  srv.set_authenticator(&auth);
+  srv.RegisterMethod("Echo.Echo", [](Controller*, const IOBuf& req,
+                                     IOBuf* resp, Closure done) {
+    resp->append(req);
+    done();
+  });
+  EXPECT_EQ(srv.Start(0), 0);
+  Channel ch;
+  Channel::Options opts;
+  opts.use_ici = true;
+  opts.auth = &auth;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(srv.port()), &opts), 0);
+  Controller cntl;
+  IOBuf req, resp;
+  req.append("authed");
+  ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+  EXPECT(resp.to_string() == "authed");
+  // The call must have ridden the rings, not the TCP fallback.
+  EXPECT(ch.transport_name() == "ici_ring");
+  srv.Stop();
+}
+
+TEST_CASE(ici_bad_segment_rejected) {
+  start_once();
+  Channel tcp;
+  EXPECT_EQ(tcp.Init("127.0.0.1:" + std::to_string(g_port)), 0);
+  for (const char* bad :
+       {"/etc/passwd", "not-a-path", "/trpc_ici_", "", "/trpc_arena_x"}) {
+    Controller cntl;
+    IOBuf req, resp;
+    req.append(bad);
+    tcp.CallMethod(kIciConnectMethod, req, &resp, &cntl);
+    EXPECT(cntl.Failed());
+    EXPECT_EQ(cntl.error_code(), EINVAL);
+  }
+  // A well-named segment with hostile contents (bad magic/geometry) must
+  // be rejected too.
+  const char* fake = "/trpc_ici_99999_feed";
+  const int fd = shm_open(fake, O_CREAT | O_EXCL | O_RDWR, 0600);
+  EXPECT(fd >= 0);
+  EXPECT_EQ(ftruncate(fd, 1 << 20), 0);
+  close(fd);
+  Controller cntl;
+  IOBuf req, resp;
+  req.append(fake);
+  tcp.CallMethod(kIciConnectMethod, req, &resp, &cntl);
+  EXPECT(cntl.Failed());
+  shm_unlink(fake);
+}
+
+TEST_CASE(ici_dead_peer_reaped_and_segment_unlinked) {
+  start_once();
+  std::string name;
+  auto client = ici_conn_create(&name);
+  EXPECT(client != nullptr);
+  {
+    Channel tcp;
+    EXPECT_EQ(tcp.Init("127.0.0.1:" + std::to_string(g_port)), 0);
+    Controller cntl;
+    IOBuf req, resp;
+    req.append(name);
+    tcp.CallMethod(kIciConnectMethod, req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+  }
+  pid_t child = fork();
+  if (child == 0) {
+    _exit(0);
+  }
+  int status = 0;
+  waitpid(child, &status, 0);
+  ici_conn_set_self_pid(*client, static_cast<int32_t>(child));
+  bool unlinked = false;
+  for (int i = 0; i < 80 && !unlinked; ++i) {
+    usleep(100 * 1000);
+    const int fd = shm_open(name.c_str(), O_RDWR, 0600);
+    if (fd < 0 && errno == ENOENT) {
+      unlinked = true;
+    } else if (fd >= 0) {
+      close(fd);
+    }
+  }
+  EXPECT(unlinked);
+}
+
+TEST_MAIN
