@@ -17,8 +17,8 @@ import jax.numpy as jnp
 from brpc_tpu.ops.checksum import sum32
 
 _ROWS = 16       # sublane-aligned block rows (uint32 min tile is 8x128);
-                 # 16x8192 (512KB) measured best on v5e across 8..512-row
-                 # blocks inside a scan-chained 64MB echo (~172 GB/s goodput)
+                 # see tools/tune_echo.py for the measured sweep backing
+                 # this default
 _COLS = 8192     # lanes per row
 _BLOCK = _ROWS * _COLS  # uint32 lanes per grid step (512KB)
 
@@ -38,25 +38,29 @@ def _kernel(x_ref, out_ref, acc_ref):
     acc_ref[0, 0] += jnp.sum(block.astype(jnp.int32), dtype=jnp.int32)
 
 
-def echo_fused(payload: jnp.ndarray, interpret: bool = False):
-    """payload: uint32[n] with n % _BLOCK == 0.  Returns (copy, checksum)."""
+def echo_fused(payload: jnp.ndarray, interpret: bool = False,
+               rows: int = _ROWS, cols: int = _COLS):
+    """payload: uint32[n] with n % (rows*cols) == 0.  Returns
+    (copy, checksum).  rows/cols pick the per-grid-step tile (tuning:
+    tools/tune_echo.py)."""
     from jax.experimental import pallas as pl  # noqa: PLC0415
     from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
 
     n = payload.shape[0]
-    assert n % _BLOCK == 0, f"payload lanes {n} not a multiple of {_BLOCK}"
-    x2d = payload.reshape(n // _COLS, _COLS)
-    grid = (n // _BLOCK,)
+    block = rows * cols
+    assert n % block == 0, f"payload lanes {n} not a multiple of {block}"
+    x2d = payload.reshape(n // cols, cols)
+    grid = (n // block,)
     copy, acc = pl.pallas_call(
         _kernel,
         grid=grid,
-        in_specs=[pl.BlockSpec((_ROWS, _COLS), lambda i: (i, 0))],
+        in_specs=[pl.BlockSpec((rows, cols), lambda i: (i, 0))],
         out_specs=[
-            pl.BlockSpec((_ROWS, _COLS), lambda i: (i, 0)),
+            pl.BlockSpec((rows, cols), lambda i: (i, 0)),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n // _COLS, _COLS), jnp.uint32),
+            jax.ShapeDtypeStruct((n // cols, cols), jnp.uint32),
             jax.ShapeDtypeStruct((1, 1), jnp.int32),
         ],
         interpret=interpret,

@@ -1,0 +1,92 @@
+#!/usr/bin/env python3
+"""Roofline tuning sweep for the fused echo kernel (VERDICT r4 item 5).
+
+Measures scan-chained 64MB echo goodput per tile geometry with the
+marginal-cost method (two scan lengths; the constant tunnel-fetch cost
+cancels), and reports achieved HBM bandwidth as a fraction of the chip's
+peak (one read + one write pass per iteration → HBM bytes = 2× goodput
+bytes).
+
+Run on the bench chip: python tools/tune_echo.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from brpc_tpu.ops.echo_kernel import echo_fused
+    from brpc_tpu.ops.roofline import hbm_peak_gbps
+
+    dev = jax.devices()[0]
+    peak = hbm_peak_gbps(dev.device_kind)
+    print(f"# device: {dev.device_kind} (peak {peak} GB/s)")
+
+    size = 64 << 20
+    lanes = size // 4
+
+    def chained(step, n_iters):
+        def body(resp, _):
+            copy, csum = step(resp)
+            return copy, csum
+        def run(payload):
+            final, csums = jax.lax.scan(body, payload, None, length=n_iters)
+            return final, csums[-1]
+        return jax.jit(run, donate_argnums=0)
+
+    def measure(rows, cols):
+        if lanes % (rows * cols) != 0:
+            return None
+        step = partial(echo_fused, rows=rows, cols=cols)
+        n1, n2 = 4, 36
+        short = chained(step, n1)
+        long = chained(step, n2)
+        payload = jnp.arange(lanes, dtype=jnp.uint32)
+        r, c = short(payload)
+        _ = int(c)  # compile + warm short
+        r, c = long(r)
+        _ = int(c)  # compile + warm long
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            r, c = short(r)
+            _ = int(c)
+            t_a = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            r, c = long(r)
+            _ = int(c)
+            t_b = time.perf_counter() - t0
+            if t_b > t_a:
+                g = size * (n2 - n1) / (t_b - t_a) / 1e9
+                best = max(best or 0, g)
+        return best
+
+    results = []
+    for rows in (8, 16, 32, 64, 128, 256, 512):
+        for cols in (8192, 16384, 32768):
+            try:
+                g = measure(rows, cols)
+            except Exception as e:  # noqa: BLE001 — e.g. VMEM OOM: a block
+                # too big to double-buffer (in+out) inside ~16MB VMEM
+                print(f"# {rows}x{cols}: {type(e).__name__} "
+                      f"(block too large for VMEM?)", flush=True)
+                continue
+            if g is None:
+                continue
+            frac = round(2 * g / peak, 3) if peak else None
+            results.append({"rows": rows, "cols": cols,
+                            "goodput_gbps": round(g, 1), "hbm_frac": frac})
+            print(json.dumps(results[-1]), flush=True)
+    best = max(results, key=lambda r: r["goodput_gbps"])
+    print("# best:", json.dumps(best))
+
+
+if __name__ == "__main__":
+    main()
