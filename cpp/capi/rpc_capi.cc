@@ -314,10 +314,23 @@ int trpc_bench_echo_rpc(const void* data, size_t len, int iters,
     return fail("bad arguments");
   }
   const std::string tr = transport != nullptr ? transport : "tcp";
+  // Bench geometry is a process-global proposal for NEW client conns:
+  // restore the embedder's configured value on every exit path so later
+  // ICI connections don't silently inherit bench geometry.
+  struct GeometryGuard {
+    uint32_t bs = 0, sl = 0, mb = 0;
+    bool armed = false;
+    ~GeometryGuard() {
+      if (armed) {
+        ici_set_ring_geometry(bs, sl, mb);
+      }
+    }
+  } geom_guard;
   if (tr == "ici") {
-    // Bench geometry: wide window + 256KB DMA blocks so a 64MB payload is
-    // ~256 WRs and the pool comfortably holds request+response in flight.
-    ici_set_ring_geometry(256 * 1024, 32, 1024);
+    ici_get_ring_geometry(&geom_guard.bs, &geom_guard.sl, &geom_guard.mb);
+    // Wide window + 256KB DMA blocks so a 64MB payload is ~256 WRs and
+    // the pool comfortably holds request+response in flight.
+    geom_guard.armed = ici_set_ring_geometry(256 * 1024, 32, 1024);
   }
   Server server;
   server.RegisterMethod("Echo.Echo", [](Controller*, const IOBuf& req,

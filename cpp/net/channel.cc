@@ -107,7 +107,9 @@ namespace {
 
 int on_call_error(fid_t cid, void* data, int code) {
   Controller* cntl = static_cast<Controller*>(data);
-  cntl->SetFailed(code, code == ETIMEDOUT ? "rpc timeout" : "rpc failed");
+  cntl->SetFailed(code, code == ETIMEDOUT    ? "rpc timeout"
+                        : code == ECANCELED  ? "rpc canceled by caller"
+                                             : "rpc failed");
   complete_locked_call(cid, cntl);
   return 0;
 }

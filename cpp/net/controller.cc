@@ -1,0 +1,35 @@
+// Controller out-of-line bits: cancellation.
+//
+// Parity: /root/reference/src/brpc/controller.h:717 `StartCancel()` and
+// :983 `StartCancel(CallId)` — the reference routes both through
+// bthread_id_error(ECANCELED); ours routes through the equivalent
+// versioned-fid error path (fiber/fid.h), which wakes sync joiners,
+// cancels the timeout timer and runs the async done exactly once via
+// complete_locked_call (net/channel.cc).
+#include "net/controller.h"
+
+#include <errno.h>
+
+#include "net/socket.h"
+
+namespace trpc {
+
+void StartCancel(fid_t cid) {
+  if (cid != 0) {
+    // EINVAL (already completed / never existed) is the documented
+    // harmless case; fid versioning makes double-cancel safe too.
+    fid_error(cid, ECANCELED);
+  }
+}
+
+void Controller::StartCancel() { trpc::StartCancel(call_.cid); }
+
+bool Controller::IsCanceled() const {
+  if (call_.socket_id == 0) {
+    return false;
+  }
+  SocketRef s(Socket::Address(call_.socket_id));
+  return !s || s->Failed();
+}
+
+}  // namespace trpc

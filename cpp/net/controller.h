@@ -73,6 +73,19 @@ class Controller {
   int64_t latency_us() const { return latency_us_; }
   const std::string& method() const { return method_; }
 
+  // -- cancellation ------------------------------------------------------
+  // Parity: reference controller.h:717 StartCancel() / :983 free-function
+  // StartCancel(CallId).  Rides the versioned-fid error path: the call
+  // completes with ECANCELED exactly once, racing responses/timeouts
+  // serialize on the fid, and a cancel after completion is a harmless
+  // no-op (stale version).  Never blocks on the network.
+  fid_t call_id() const { return call_.cid; }
+  void StartCancel();
+  // Server side: has the client gone away (socket failed/closed)?  A long
+  // handler polls this to abandon work nobody will receive
+  // (controller.h:308 IsCanceled parity).
+  bool IsCanceled() const;
+
   // -- progressive bodies (net/progressive.h) --------------------------
   // Server handler (HTTP serving): the response body will be streamed
   // incrementally; done() flushes headers (chunked) and the returned
@@ -150,5 +163,10 @@ class Controller {
   std::shared_ptr<ProgressiveAttachment> progressive_;
   CallState call_;
 };
+
+// Cancels the call identified by `cid` (Controller::call_id(), safe to
+// stash and invoke from any thread/fiber, even after the call completed —
+// the versioned fid makes a stale cancel a no-op).
+void StartCancel(fid_t cid);
 
 }  // namespace trpc

@@ -383,6 +383,7 @@ struct PolledConn {
   int64_t last_liveness_us = 0;
   uint64_t last_peer_beat = 0;
   int64_t peer_beat_changed_us = 0;
+  bool remove = false;  // poller-thread-only: marked dead this pass
 };
 
 class IciPoller {
@@ -393,8 +394,12 @@ class IciPoller {
   }
 
   void add(std::shared_ptr<IciConn> conn, SocketId socket) {
+    auto pc = std::make_shared<PolledConn>();
+    pc->conn = conn;
+    pc->socket = socket;
+    pc->created_us = monotonic_time_us();
     std::lock_guard<std::mutex> g(mu_);
-    conns_.push_back(PolledConn{conn, socket, monotonic_time_us()});
+    conns_.push_back(std::move(pc));
   }
 
  private:
@@ -479,6 +484,15 @@ class IciPoller {
     IciDir& txd = c.tx_dir();
     const uint64_t consumed =
         txd.desc_consumed.load(std::memory_order_acquire);
+    // desc_consumed is peer-writable shared memory.  Legitimately it trails
+    // our published desc_head, which itself never runs more than `slots`
+    // ahead of sbuf_released — so a gap beyond `slots` is a value only a
+    // corrupt or hostile peer could have stored, and draining toward it
+    // would wedge the poller (and every other connection) in this loop.
+    if (consumed - c.sbuf_released > c.slots) {
+      *dead = true;
+      return moved;
+    }
     while (c.sbuf_released < consumed) {
       c.sbuf[c.sbuf_released & mask].clear();
       ++c.sbuf_released;
@@ -533,84 +547,101 @@ class IciPoller {
 
   void run() {
     int idle_spins = 0;
+    std::vector<std::shared_ptr<PolledConn>> snap;
     while (true) {
       bool any = false;
+      bool pruned = false;
+      // Snapshot under the lock; service OUTSIDE it.  The bulk memcpy
+      // "DMA" (up to slots×block_size per pass) and SetFailed/on_input
+      // dispatch would otherwise add head-of-line latency to every other
+      // connection and block add() (new handshakes) for the duration.
+      // PolledConn fields are poller-thread-only, so mutating them on the
+      // snapshot is safe; add() only ever appends fresh entries.
+      snap.clear();
       {
-        const int64_t now_us = monotonic_time_us();
         std::lock_guard<std::mutex> g(mu_);
-        for (size_t i = 0; i < conns_.size();) {
-          PolledConn& pc = conns_[i];
-          std::shared_ptr<IciConn> conn = pc.conn.lock();
-          if (conn == nullptr) {
-            conns_[i] = conns_.back();
-            conns_.pop_back();
-            continue;
-          }
-          bool rx_edge = false, tx_edge = false, dead = false;
-          if (service(*conn, &rx_edge, &tx_edge, &dead)) {
-            any = true;
-          }
-          if (dead) {
-            LOG(Warning) << "ici rings corrupt (" << conn->name
-                         << "); failing socket";
-            conn->unlink_on_close = true;
-            SocketRef s(Socket::Address(pc.socket));
-            if (s) {
-              s->SetFailed(EPROTO);
-            }
-            conns_[i] = conns_.back();
-            conns_.pop_back();
-            continue;
-          }
-          if (rx_edge || tx_edge) {
-            SocketRef s(Socket::Address(pc.socket));
-            if (s) {
-              if (rx_edge) {
-                s->on_input_event();
-              }
-              if (tx_edge) {
-                s->on_output_event();
-              }
-            } else if (conn->rx_pending.size() > 0 && rx_edge) {
-              // Socket gone: nobody will ever drain; drop the entry.
-              conns_[i] = conns_.back();
-              conns_.pop_back();
-              continue;
-            }
-          }
-          // Liveness (rate-limited ~1/s): reap on verified exit, a 30s
-          // heartbeat stall, or a peer that never arrived.
-          if (now_us - pc.last_liveness_us > 1000 * 1000) {
-            pc.last_liveness_us = now_us;
-            conn->bump_self_beat();
-            const uint64_t beat = conn->peer_beat();
-            if (beat != pc.last_peer_beat || pc.peer_beat_changed_us == 0) {
-              pc.last_peer_beat = beat;
-              pc.peer_beat_changed_us = now_us;
-            }
-            const int32_t peer = conn->peer_pid();
-            const bool no_pid =
-                peer == 0 && now_us - pc.created_us > 30 * 1000 * 1000;
-            const bool dead_pid =
-                peer != 0 && kill(static_cast<pid_t>(peer), 0) != 0 &&
-                errno == ESRCH;
-            const bool stalled =
-                now_us - pc.peer_beat_changed_us > 30 * 1000 * 1000;
-            if (no_pid || dead_pid || stalled) {
-              LOG(Warning) << "ici peer lost (" << conn->name << ", pid "
-                           << peer << "); reaping";
-              conn->unlink_on_close = true;
-              SocketRef deads(Socket::Address(pc.socket));
-              if (deads) {
-                deads->SetFailed(no_pid ? ETIMEDOUT : ECONNRESET);
-              }
-              conns_[i] = conns_.back();
-              conns_.pop_back();
-              continue;
-            }
-          }
-          ++i;
+        snap.assign(conns_.begin(), conns_.end());
+      }
+      const int64_t now_us = monotonic_time_us();
+      for (auto& pcp : snap) {
+        PolledConn& pc = *pcp;
+        std::shared_ptr<IciConn> conn = pc.conn.lock();
+        if (conn == nullptr) {
+          pc.remove = true;
+          pruned = true;
+          continue;
         }
+        bool rx_edge = false, tx_edge = false, dead = false;
+        if (service(*conn, &rx_edge, &tx_edge, &dead)) {
+          any = true;
+        }
+        if (dead) {
+          LOG(Warning) << "ici rings corrupt (" << conn->name
+                       << "); failing socket";
+          conn->unlink_on_close = true;
+          SocketRef s(Socket::Address(pc.socket));
+          if (s) {
+            s->SetFailed(EPROTO);
+          }
+          pc.remove = true;
+          pruned = true;
+          continue;
+        }
+        if (rx_edge || tx_edge) {
+          SocketRef s(Socket::Address(pc.socket));
+          if (s) {
+            if (rx_edge) {
+              s->on_input_event();
+            }
+            if (tx_edge) {
+              s->on_output_event();
+            }
+          } else if (conn->rx_pending.size() > 0 && rx_edge) {
+            // Socket gone: nobody will ever drain; drop the entry.
+            pc.remove = true;
+            pruned = true;
+            continue;
+          }
+        }
+        // Liveness (rate-limited ~1/s): reap on verified exit, a 30s
+        // heartbeat stall, or a peer that never arrived.
+        if (now_us - pc.last_liveness_us > 1000 * 1000) {
+          pc.last_liveness_us = now_us;
+          conn->bump_self_beat();
+          const uint64_t beat = conn->peer_beat();
+          if (beat != pc.last_peer_beat || pc.peer_beat_changed_us == 0) {
+            pc.last_peer_beat = beat;
+            pc.peer_beat_changed_us = now_us;
+          }
+          const int32_t peer = conn->peer_pid();
+          const bool no_pid =
+              peer == 0 && now_us - pc.created_us > 30 * 1000 * 1000;
+          const bool dead_pid =
+              peer != 0 && kill(static_cast<pid_t>(peer), 0) != 0 &&
+              errno == ESRCH;
+          const bool stalled =
+              now_us - pc.peer_beat_changed_us > 30 * 1000 * 1000;
+          if (no_pid || dead_pid || stalled) {
+            LOG(Warning) << "ici peer lost (" << conn->name << ", pid "
+                         << peer << "); reaping";
+            conn->unlink_on_close = true;
+            SocketRef deads(Socket::Address(pc.socket));
+            if (deads) {
+              deads->SetFailed(no_pid ? ETIMEDOUT : ECONNRESET);
+            }
+            pc.remove = true;
+            pruned = true;
+          }
+        }
+      }
+      if (pruned) {
+        std::lock_guard<std::mutex> g(mu_);
+        conns_.erase(
+            std::remove_if(conns_.begin(), conns_.end(),
+                           [](const std::shared_ptr<PolledConn>& p) {
+                             return p->remove;
+                           }),
+            conns_.end());
       }
       if (any) {
         idle_spins = 0;
@@ -625,7 +656,7 @@ class IciPoller {
   }
 
   std::mutex mu_;
-  std::vector<PolledConn> conns_;
+  std::vector<std::shared_ptr<PolledConn>> conns_;
 };
 
 // ---- the Transport -------------------------------------------------------
@@ -718,15 +749,28 @@ void ici_conn_release_name(const std::string& name) {
   v.erase(std::remove(v.begin(), v.end(), name), v.end());
 }
 
-void ici_set_ring_geometry(uint32_t block_size, uint32_t slots,
+bool ici_set_ring_geometry(uint32_t block_size, uint32_t slots,
                            uint32_t max_blocks) {
   if (max_blocks == 0) {
     max_blocks = std::min<uint32_t>(1024, kIciMaxSlabs * slots);
   }
   std::lock_guard<std::mutex> g(geom_mu());
-  if (geometry_valid(block_size, slots, max_blocks)) {
-    geom() = Geometry{block_size, slots, max_blocks};
+  if (!geometry_valid(block_size, slots, max_blocks)) {
+    LOG(Warning) << "ici_set_ring_geometry rejected (block_size="
+                 << block_size << " slots=" << slots
+                 << " max_blocks=" << max_blocks << "); keeping previous";
+    return false;
   }
+  geom() = Geometry{block_size, slots, max_blocks};
+  return true;
+}
+
+void ici_get_ring_geometry(uint32_t* block_size, uint32_t* slots,
+                           uint32_t* max_blocks) {
+  std::lock_guard<std::mutex> g(geom_mu());
+  *block_size = geom().block_size;
+  *slots = geom().slots;
+  *max_blocks = geom().max_blocks;
 }
 
 void ici_set_slab_registrar(int (*reg)(void*, size_t, void*, uint64_t*),
@@ -870,6 +914,10 @@ IciConnStats ici_conn_stats(const IciConn& c) {
 void ici_conn_set_self_pid(IciConn& c, int32_t pid) {
   (c.is_client ? c.seg->client_pid : c.seg->server_pid)
       .store(pid, std::memory_order_release);
+}
+
+void ici_conn_corrupt_tx_consumed(IciConn& c, uint64_t value) {
+  c.tx_dir().desc_consumed.store(value, std::memory_order_release);
 }
 
 }  // namespace trpc

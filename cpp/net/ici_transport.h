@@ -69,9 +69,15 @@ inline const char* kIciConnectMethod = "__ici.Connect";
 // growth cap per direction (block_pool bound — the largest frame a
 // connection can carry is ≈ (max_blocks - slots) × block_size; 0 = default
 // 1024 capped at 64×slots).  Tests shrink this to force window exhaustion
-// and pool backpressure; the bench widens it.
-void ici_set_ring_geometry(uint32_t block_size, uint32_t slots,
+// and pool backpressure; the bench widens it.  Returns false (keeping the
+// previous geometry, with a warning log) when validation rejects the
+// proposal, so callers can detect the no-op.
+bool ici_set_ring_geometry(uint32_t block_size, uint32_t slots,
                            uint32_t max_blocks = 0);
+
+// Reads the current proposal (save/restore around scoped overrides).
+void ici_get_ring_geometry(uint32_t* block_size, uint32_t* slots,
+                           uint32_t* max_blocks);
 
 // Slab registration seam (block_pool::RegisterMemory parity): invoked once
 // per receive-window slab.  The default registrar records the slab in a
@@ -102,5 +108,10 @@ IciConnStats ici_conn_stats(const IciConn& c);
 // Overrides the pid this side published (liveness tests impersonate a
 // crashed peer without a full client process).
 void ici_conn_set_self_pid(IciConn& c, int32_t pid);
+
+// Fault injection for tests: scribbles the peer-writable desc_consumed
+// cursor on `c`'s TX direction, impersonating a hostile/corrupt peer.  The
+// poller must fail the socket (EPROTO), not wedge draining toward it.
+void ici_conn_corrupt_tx_consumed(IciConn& c, uint64_t value);
 
 }  // namespace trpc

@@ -298,6 +298,46 @@ TEST_CASE(ici_setfailed_mid_transfer_releases_everything) {
   ici_set_ring_geometry(64 * 1024, 16);
 }
 
+TEST_CASE(ici_hostile_consumed_cursor_fails_socket_not_poller) {
+  fiber_init(0);
+  // ADVICE r4 (medium): a hostile peer storing a huge desc_consumed must
+  // fail THAT socket (like every other ring-corruption check), not wedge
+  // the completion poller draining toward 2^62.
+  ici_set_ring_geometry(4096, 4);
+  auto* pair = new RawPair();
+  EXPECT(pair->build());
+  std::string msg(4096, 'h');
+  IOBuf out;
+  out.append(msg);
+  {
+    SocketRef c(Socket::Address(pair->csock));
+    EXPECT_EQ(c->Write(std::move(out)), 0);
+  }
+  EXPECT(wait_until([&] { return pair->ssink.total.load() == msg.size(); },
+                    5000));
+  ici_conn_corrupt_tx_consumed(*pair->client, uint64_t(1) << 62);
+  // Poller detects corruption and fails the client socket.
+  EXPECT(wait_until(
+      [&] {
+        SocketRef c(Socket::Address(pair->csock));
+        return !c || c->Failed();
+      },
+      5000));
+  // And the poller survived: a fresh pair still moves bytes.
+  auto* pair2 = new RawPair();
+  EXPECT(pair2->build());
+  IOBuf out2;
+  out2.append(std::string(1000, 'y'));
+  {
+    SocketRef c(Socket::Address(pair2->csock));
+    EXPECT_EQ(c->Write(std::move(out2)), 0);
+  }
+  EXPECT(wait_until([&] { return pair2->ssink.total.load() == 1000; }, 5000));
+  ici_set_ring_geometry(64 * 1024, 16);
+  delete pair2;
+  delete pair;
+}
+
 // ---- full RPC path over the rings ---------------------------------------
 
 TEST_CASE(ici_echo_roundtrip) {
