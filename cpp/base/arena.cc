@@ -4,47 +4,18 @@
 #include <new>
 #include <vector>
 
+#include "base/tls_cache.h"
+
 namespace trpc {
 
 namespace {
 
-// Heap-owned TLS cache behind trivially-destructible thread_locals: blocks
-// are released during static destruction (sockets in static servers), after
-// this thread's non-trivial TLS has already died.
-struct TlsBlockCache {
-  std::vector<Block*> blocks;
-};
+struct BlockCacheTag {};
 
-struct TlsCacheGuard {
-  TlsBlockCache** slot = nullptr;
-  bool* dead = nullptr;
-  ~TlsCacheGuard() {
-    if (slot != nullptr && *slot != nullptr) {
-      for (Block* b : (*slot)->blocks) {
-        free(b);
-      }
-      delete *slot;
-      *slot = nullptr;
-    }
-    if (dead != nullptr) {
-      *dead = true;
-    }
-  }
-};
+void drain_block(Block*& b) { free(b); }
 
-TlsBlockCache* tls_cache() {
-  static thread_local TlsBlockCache* cache = nullptr;  // trivial dtor
-  static thread_local bool cache_dead = false;
-  static thread_local TlsCacheGuard guard;
-  if (cache_dead) {
-    return nullptr;
-  }
-  if (cache == nullptr) {
-    cache = new TlsBlockCache();
-    guard.slot = &cache;
-    guard.dead = &cache_dead;
-  }
-  return cache;
+std::vector<Block*>* tls_cache() {
+  return TlsFreeCache<Block*, BlockCacheTag>::get(&drain_block);
 }
 
 constexpr size_t kMaxCachedBlocks = 64;
@@ -69,11 +40,10 @@ HostArena* HostArena::instance() {
 }
 
 Block* HostArena::allocate(uint32_t min_cap) {
-  TlsBlockCache* cache = tls_cache();
-  if (min_cap <= kDefaultBlockSize && cache != nullptr &&
-      !cache->blocks.empty()) {
-    Block* b = cache->blocks.back();
-    cache->blocks.pop_back();
+  std::vector<Block*>* cache = tls_cache();
+  if (min_cap <= kDefaultBlockSize && cache != nullptr && !cache->empty()) {
+    Block* b = cache->back();
+    cache->pop_back();
     b->ref.store(1, std::memory_order_relaxed);
     b->size = 0;
     return b;
@@ -93,24 +63,24 @@ Block* HostArena::allocate(uint32_t min_cap) {
 }
 
 void HostArena::deallocate(Block* b) {
-  TlsBlockCache* cache = tls_cache();
+  std::vector<Block*>* cache = tls_cache();
   if (b->cap == kDefaultBlockSize && cache != nullptr &&
-      cache->blocks.size() < kMaxCachedBlocks) {
-    cache->blocks.push_back(b);
+      cache->size() < kMaxCachedBlocks) {
+    cache->push_back(b);
     return;
   }
   free(b);
 }
 
 void HostArena::flush_tls_cache() {
-  TlsBlockCache* cache = tls_cache();
+  std::vector<Block*>* cache = tls_cache();
   if (cache == nullptr) {
     return;
   }
-  for (Block* b : cache->blocks) {
+  for (Block* b : *cache) {
     free(b);
   }
-  cache->blocks.clear();
+  cache->clear();
 }
 
 Block* make_user_block(void* data, uint32_t len,

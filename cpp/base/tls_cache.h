@@ -1,0 +1,64 @@
+// Trivially-destructible thread-local free-cache with a teardown guard.
+//
+// The pattern (previously hand-rolled in fiber/stack.cc, base/arena.cc and
+// net/socket.cc): a heap-owned cache behind a TRIVIALLY-destructible
+// `thread_local` pointer, so entries released during static destruction
+// (sockets owned by static servers, fibers finishing after main) can still
+// reach it after this thread's non-trivial TLS has died; a separate guard
+// object drains the cache at thread exit and flips a dead flag so late
+// callers see nullptr instead of a resurrected cache.
+#pragma once
+
+#include <vector>
+
+namespace trpc {
+
+// One cache per (Entry, Tag) pair per thread.  `drain` is invoked on each
+// remaining entry at thread teardown; it must be safe to run during TLS
+// destruction (no non-trivial TLS of its own).  The first call on a
+// thread captures `drain`; later calls may pass the same function.
+template <typename Entry, typename Tag>
+struct TlsFreeCache {
+  using DrainFn = void (*)(Entry&);
+
+  // The thread's cache vector, or nullptr after teardown began.
+  static std::vector<Entry>* get(DrainFn drain) {
+    static thread_local State* state = nullptr;  // trivial dtor
+    static thread_local bool dead = false;
+    static thread_local Guard guard;
+    if (dead) {
+      return nullptr;
+    }
+    if (state == nullptr) {
+      state = new State();
+      guard.slot = &state;
+      guard.dead = &dead;
+      guard.drain = drain;
+    }
+    return &state->items;
+  }
+
+ private:
+  struct State {
+    std::vector<Entry> items;
+  };
+  struct Guard {
+    State** slot = nullptr;
+    bool* dead = nullptr;
+    DrainFn drain = nullptr;
+    ~Guard() {
+      if (slot != nullptr && *slot != nullptr) {
+        for (Entry& e : (*slot)->items) {
+          drain(e);
+        }
+        delete *slot;
+        *slot = nullptr;
+      }
+      if (dead != nullptr) {
+        *dead = true;
+      }
+    }
+  };
+};
+
+}  // namespace trpc

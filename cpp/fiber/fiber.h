@@ -20,6 +20,24 @@ using fiber_t = uint64_t;
 
 constexpr int kFiberUrgent = 1;  // run ASAP (caller's queue front)
 
+// -- worker tags (parity: bthread_tag, task_control.h:94-99) --------------
+// Workers are partitioned into tagged groups; spawn and steal stay INSIDE
+// a group, so saturating one tag's workers cannot starve another's (the
+// reference's per-server bthread_tag isolation, server.h:280).  Tag 0 is
+// the default group.  A fiber spawned without an explicit tag inherits the
+// spawning worker's tag (so a tagged server's whole downstream — handler,
+// KeepWrite, timeout fibers — stays in its group).
+constexpr int kMaxFiberTags = 4;
+// OR into fiber_start's flags to pin the fiber to `tag`'s worker group.
+constexpr int fiber_tag_flags(int tag) { return (tag + 1) << 8; }
+// Provisions `workers` pthreads for `tag` (idempotent; tag 0 comes from
+// fiber_init).  Non-zero tags auto-provision a default-sized group on
+// first use.  Returns 0, or EINVAL for an out-of-range tag.
+int fiber_start_tag_workers(int tag, int workers);
+// Tag of the calling fiber's worker (0 off-worker).
+int fiber_current_tag();
+int fiber_worker_count_tag(int tag);
+
 // Start the scheduler with n worker pthreads (idempotent; auto-started with
 // a default on first fiber_start).
 void fiber_init(int workers);

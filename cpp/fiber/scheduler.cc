@@ -137,8 +137,14 @@ Scheduler* Scheduler::instance() {
   return s;
 }
 
-void Scheduler::start(int workers) {
-  std::call_once(start_once_, [this, workers] {
+void Scheduler::start(int workers) { start_tag(0, workers); }
+
+void Scheduler::start_tag(int tag, int workers) {
+  if (tag < 0 || tag >= kMaxTags) {
+    return;
+  }
+  TagGroup& g = tags_[tag];
+  std::call_once(g.once, [this, &g, tag, workers] {
     int n = workers;
     if (n <= 0) {
       const long ncpu = sysconf(_SC_NPROCESSORS_ONLN);
@@ -146,7 +152,7 @@ void Scheduler::start(int workers) {
     }
     n = std::min(n, kMaxWorkers);
     for (int i = 0; i < n; ++i) {
-      workers_[i] = new Worker(this, i);
+      g.workers[i] = new Worker(this, i, tag);
       pthread_t tid;
       pthread_create(
           &tid, nullptr,
@@ -154,18 +160,20 @@ void Scheduler::start(int workers) {
             static_cast<Worker*>(w)->main_loop();
             return nullptr;
           },
-          workers_[i]);
+          g.workers[i]);
       pthread_detach(tid);
     }
-    nworkers_.store(n, std::memory_order_release);
+    g.nworkers.store(n, std::memory_order_release);
   });
 }
 
 void Scheduler::ready_to_run(FiberMeta* m, bool urgent) {
+  TagGroup& g = tags_[m->tag];
   Worker* w = tls_worker;
   // A thread about to block pthread-style must not trap work in its own
-  // queues — it won't return to its scheduler loop until woken.
-  if (w != nullptr && in_pthread_wait_mode()) {
+  // queues — it won't return to its scheduler loop until woken.  A worker
+  // of ANOTHER tag must not take the fiber either: spawn stays in-group.
+  if (w != nullptr && (w->tag() != m->tag || in_pthread_wait_mode())) {
     w = nullptr;
   }
   if (w != nullptr) {
@@ -174,7 +182,7 @@ void Scheduler::ready_to_run(FiberMeta* m, bool urgent) {
       FiberMeta* expect = nullptr;
       if (w->urgent_.compare_exchange_strong(expect, m,
                                              std::memory_order_acq_rel)) {
-        parking_lot.signal(2);
+        g.lot.signal(2);
         return;
       }
     }
@@ -184,32 +192,37 @@ void Scheduler::ready_to_run(FiberMeta* m, bool urgent) {
   } else {
     push_remote(m);
   }
-  parking_lot.signal(urgent ? 2 : 1);
+  g.lot.signal(urgent ? 2 : 1);
 }
 
 void Scheduler::push_remote(FiberMeta* m) {
-  std::lock_guard<std::mutex> g(remote_mu_);
-  remote_q_.push_back(m);
+  TagGroup& g = tags_[m->tag];
+  std::lock_guard<std::mutex> lk(g.remote_mu);
+  g.remote_q.push_back(m);
 }
 
-bool Scheduler::pop_remote(FiberMeta** out) {
-  std::lock_guard<std::mutex> g(remote_mu_);
-  if (remote_q_.empty()) {
+bool Scheduler::pop_remote(FiberMeta** out, int tag) {
+  TagGroup& g = tags_[tag];
+  std::lock_guard<std::mutex> lk(g.remote_mu);
+  if (g.remote_q.empty()) {
     return false;
   }
-  *out = remote_q_.front();
-  remote_q_.pop_front();
+  *out = g.remote_q.front();
+  g.remote_q.pop_front();
   return true;
 }
 
 bool Scheduler::steal(FiberMeta** out, Worker* thief) {
-  const int n = nworkers_.load(std::memory_order_acquire);
+  // Steal range = the thief's own tag group (task_control.h:94 parity:
+  // per-tag groups do not poach each other's work).
+  TagGroup& g = tags_[thief->tag()];
+  const int n = g.nworkers.load(std::memory_order_acquire);
   if (n <= 1) {
     return false;
   }
   const uint64_t start = fast_rand_less_than(n);
   for (int i = 0; i < n; ++i) {
-    Worker* victim = workers_[(start + i) % n];
+    Worker* victim = g.workers[(start + i) % n];
     if (victim == nullptr || victim == thief) {
       continue;
     }
@@ -228,7 +241,8 @@ bool Scheduler::steal(FiberMeta** out, Worker* thief) {
   return false;
 }
 
-Worker::Worker(Scheduler* sched, int index) : sched_(sched), index_(index) {}
+Worker::Worker(Scheduler* sched, int index, int tag)
+    : sched_(sched), index_(index), tag_(tag) {}
 
 FiberMeta* Worker::pick_next() {
   FiberMeta* m = urgent_.exchange(nullptr, std::memory_order_acq_rel);
@@ -238,7 +252,7 @@ FiberMeta* Worker::pick_next() {
   if (runq_.pop(&m)) {
     return m;
   }
-  if (sched_->pop_remote(&m)) {
+  if (sched_->pop_remote(&m, tag_)) {
     return m;
   }
   if (sched_->steal(&m, this)) {
@@ -298,19 +312,20 @@ void Worker::main_loop() {
     pthread_attr_destroy(&attr);
   }
 #endif
+  ParkingLot& lot = sched_->group(tag_).lot;
   while (true) {
     FiberMeta* m = pick_next();
     if (m != nullptr) {
       run_fiber(m);
       continue;
     }
-    const int stamp = sched_->parking_lot.stamp();
+    const int stamp = lot.stamp();
     m = pick_next();  // re-check after stamp: closes the missed-signal window
     if (m != nullptr) {
       run_fiber(m);
       continue;
     }
-    sched_->parking_lot.wait(stamp);
+    lot.wait(stamp);
   }
 }
 
@@ -320,10 +335,44 @@ void fiber_init(int workers) { Scheduler::instance()->start(workers); }
 
 int fiber_worker_count() { return Scheduler::instance()->worker_count(); }
 
+int fiber_start_tag_workers(int tag, int workers) {
+  if (tag < 0 || tag >= kMaxFiberTags) {
+    return EINVAL;
+  }
+  Scheduler::instance()->start_tag(tag, workers);
+  return 0;
+}
+
+int fiber_current_tag() {
+  Worker* w = tls_worker;
+  return w != nullptr ? w->tag() : 0;
+}
+
+int fiber_worker_count_tag(int tag) {
+  if (tag < 0 || tag >= kMaxFiberTags) {
+    return 0;
+  }
+  return Scheduler::instance()->worker_count(tag);
+}
+
 int fiber_start(fiber_t* out, void (*fn)(void*), void* arg, int flags) {
   Scheduler* sched = Scheduler::instance();
   if (!sched->started()) {
     sched->start(0);
+  }
+  // Tag resolution: explicit flag wins; otherwise inherit the spawning
+  // worker's tag (keeps a tagged server's downstream fibers in-group).
+  int tag = (flags >> 8) & 0xff;
+  if (tag == 0) {
+    tag = fiber_current_tag();
+  } else {
+    tag -= 1;
+    if (tag >= kMaxFiberTags) {
+      return -1;
+    }
+  }
+  if (tag != 0 && sched->worker_count(tag) == 0) {
+    sched->start_tag(tag, 0);  // auto-provision a default-sized group
   }
   FiberMeta* m = nullptr;
   const uint32_t slot = FiberPool::instance()->acquire(&m);
@@ -331,6 +380,7 @@ int fiber_start(fiber_t* out, void (*fn)(void*), void* arg, int flags) {
     return -1;
   }
   m->slot = slot;
+  m->tag = static_cast<uint8_t>(tag);
   m->fn.store(fn, std::memory_order_relaxed);
   m->arg = arg;
   m->interrupted.store(false, std::memory_order_relaxed);

@@ -61,6 +61,7 @@ struct FiberMeta {
   };
   std::vector<FlsSlot> fls;
   uint32_t slot = 0;  // own index in the pool
+  uint8_t tag = 0;    // worker-group pin (task_control.h:94 tag parity)
 
   fiber_t id() const {
     return (static_cast<uint64_t>(version.load(std::memory_order_relaxed))
@@ -85,32 +86,45 @@ class ParkingLot {
 
 class Scheduler {
  public:
-  static Scheduler* instance();
-  void start(int workers);
-  bool started() const { return nworkers_.load(std::memory_order_acquire) > 0; }
-  int worker_count() const { return nworkers_.load(std::memory_order_acquire); }
+  static constexpr int kMaxTags = 4;  // kMaxFiberTags (fiber.h)
 
-  // Make a runnable fiber visible to some worker (from any thread).
+  static Scheduler* instance();
+  void start(int workers);                  // tag 0
+  void start_tag(int tag, int workers);     // idempotent per tag
+  bool started() const {
+    return tags_[0].nworkers.load(std::memory_order_acquire) > 0;
+  }
+  int worker_count(int tag = 0) const {
+    return tags_[tag].nworkers.load(std::memory_order_acquire);
+  }
+
+  // Make a runnable fiber visible to some worker OF ITS TAG (any thread).
   void ready_to_run(FiberMeta* m, bool urgent = false);
   bool steal(FiberMeta** out, Worker* thief);
-  bool pop_remote(FiberMeta** out);
+  bool pop_remote(FiberMeta** out, int tag);
   void push_remote(FiberMeta* m);
 
-  ParkingLot parking_lot;
+  // Per-tag worker group: spawn/steal/park confined inside (the
+  // reference's per-tag TaskControl groups, task_control.h:94-99).
+  struct TagGroup {
+    Worker* workers[64] = {};
+    std::atomic<int> nworkers{0};
+    std::mutex remote_mu;
+    std::deque<FiberMeta*> remote_q;
+    ParkingLot lot;
+    std::once_flag once;
+  };
+  TagGroup& group(int tag) { return tags_[tag]; }
 
  private:
   Scheduler() = default;
   static constexpr int kMaxWorkers = 64;
-  Worker* workers_[kMaxWorkers] = {};
-  std::atomic<int> nworkers_{0};
-  std::mutex remote_mu_;
-  std::deque<FiberMeta*> remote_q_;
-  std::once_flag start_once_;
+  TagGroup tags_[kMaxTags];
 };
 
 class Worker {
  public:
-  explicit Worker(Scheduler* sched, int index);
+  Worker(Scheduler* sched, int index, int tag);
   void main_loop();  // pthread entry
 
   // Called from a running fiber: switch back to the scheduler context.
@@ -122,6 +136,7 @@ class Worker {
   FiberMeta* current() const { return current_; }
   WorkStealingQueue<FiberMeta*>& runq() { return runq_; }
   int index() const { return index_; }
+  int tag() const { return tag_; }
 
  private:
   friend class Scheduler;
@@ -130,6 +145,7 @@ class Worker {
 
   Scheduler* sched_;
   int index_;
+  int tag_;
   // One-deep priority slot checked before the run queue (kFiberUrgent).
   std::atomic<FiberMeta*> urgent_{nullptr};
   WorkStealingQueue<FiberMeta*> runq_;

@@ -3,9 +3,11 @@
 #include <sys/mman.h>
 #include <unistd.h>
 
+#include <mutex>
 #include <vector>
 
 #include "base/logging.h"
+#include "base/tls_cache.h"
 #include "fiber/context.h"
 
 // ASan's fiber support (__sanitizer_start_switch_fiber in scheduler.cc)
@@ -32,59 +34,64 @@ namespace trpc {
 
 namespace {
 
-// Heap-owned TLS cache behind trivially-destructible thread_locals (same
-// static-destruction hazard as the resource-pool caches).
-struct TlsStackCache {
-  std::vector<StackMem> stacks;
-};
+struct StackCacheTag {};
 
-struct TlsStackGuard {
-  TlsStackCache** slot = nullptr;
-  bool* dead = nullptr;
-  ~TlsStackGuard() {
-    if (slot != nullptr && *slot != nullptr) {
-      for (StackMem& s : (*slot)->stacks) {
-        TRPC_UNPOISON_STACK(s.base, s.size);
-        munmap(s.base, s.size);
-      }
-      delete *slot;
-      *slot = nullptr;
-    }
-    if (dead != nullptr) {
-      *dead = true;
-    }
-  }
-};
+void drain_stack(StackMem& s) {
+  TRPC_UNPOISON_STACK(s.base, s.size);
+  munmap(s.base, s.size);
+}
 
-TlsStackCache* tls_stack_cache() {
-  static thread_local TlsStackCache* cache = nullptr;  // trivial dtor
-  static thread_local bool cache_dead = false;
-  static thread_local TlsStackGuard guard;
-  if (cache_dead) {
-    return nullptr;
-  }
-  if (cache == nullptr) {
-    cache = new TlsStackCache();
-    guard.slot = &cache;
-    guard.dead = &cache_dead;
-  }
-  return cache;
+std::vector<StackMem>* tls_stack_cache() {
+  return TlsFreeCache<StackMem, StackCacheTag>::get(&drain_stack);
 }
 
 constexpr size_t kMaxCachedStacks = 32;
 
+// Second-level shared cache (bthread StackFactory get/return_stack global
+// pool parity, stack_inl.h).  The TLS caches alone defeat themselves under
+// this runtime's thread asymmetry: dispatcher/poller pthreads SPAWN fibers
+// (read fibers, timers) but never finish one, so their TLS cache is
+// forever empty and every spawn paid mmap+mprotect+first-touch faults —
+// ~25% of the 1KB-echo profile (r5).  Producers overflow here in batches;
+// consumers refill in batches; one lock hit amortizes over kBatch spawns.
+struct GlobalStackCache {
+  std::mutex mu;
+  std::vector<StackMem> stacks;
+};
+
+GlobalStackCache& global_stack_cache() {
+  static auto* g = new GlobalStackCache();  // leaked: released after statics
+  return *g;
+}
+
+constexpr size_t kMaxGlobalStacks = 512;
+constexpr size_t kBatch = 8;
+
 }  // namespace
 
 StackMem allocate_stack(size_t size) {
-  TlsStackCache* cache = tls_stack_cache();
-  if (cache != nullptr && !cache->stacks.empty()) {
-    StackMem s = cache->stacks.back();
-    cache->stacks.pop_back();
-    if (s.size == size) {
-      return s;
+  std::vector<StackMem>* cache = tls_stack_cache();
+  if (cache != nullptr) {
+    if (cache->empty()) {
+      // Refill a batch from the shared cache (only same-size stacks live
+      // there, so no per-entry size screening needed beyond the check
+      // below).
+      GlobalStackCache& g = global_stack_cache();
+      std::lock_guard<std::mutex> lk(g.mu);
+      while (!g.stacks.empty() && cache->size() < kBatch) {
+        cache->push_back(g.stacks.back());
+        g.stacks.pop_back();
+      }
     }
-    TRPC_UNPOISON_STACK(s.base, s.size);
-    munmap(s.base, s.size);
+    if (!cache->empty()) {
+      StackMem s = cache->back();
+      cache->pop_back();
+      if (s.size == size) {
+        return s;
+      }
+      TRPC_UNPOISON_STACK(s.base, s.size);
+      munmap(s.base, s.size);
+    }
   }
   const size_t page = static_cast<size_t>(sysconf(_SC_PAGESIZE));
   void* mem = mmap(nullptr, size, PROT_READ | PROT_WRITE,
@@ -96,10 +103,28 @@ StackMem allocate_stack(size_t size) {
 }
 
 void release_stack(StackMem s) {
-  TlsStackCache* cache = tls_stack_cache();
-  if (cache != nullptr && cache->stacks.size() < kMaxCachedStacks) {
-    cache->stacks.push_back(s);
+  if (s.size != kDefaultStackSize) {
+    // Odd sizes never enter the caches; keeps the shared pool uniform.
+    TRPC_UNPOISON_STACK(s.base, s.size);
+    munmap(s.base, s.size);
     return;
+  }
+  std::vector<StackMem>* cache = tls_stack_cache();
+  if (cache != nullptr) {
+    if (cache->size() >= kMaxCachedStacks) {
+      // Spill a batch to the shared cache so spawn-only threads can eat.
+      GlobalStackCache& g = global_stack_cache();
+      std::lock_guard<std::mutex> lk(g.mu);
+      while (g.stacks.size() < kMaxGlobalStacks &&
+             cache->size() > kMaxCachedStacks - kBatch) {
+        g.stacks.push_back(cache->back());
+        cache->pop_back();
+      }
+    }
+    if (cache->size() < kMaxCachedStacks) {
+      cache->push_back(s);
+      return;
+    }
   }
   TRPC_UNPOISON_STACK(s.base, s.size);
   munmap(s.base, s.size);

@@ -301,6 +301,14 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
     *body = "fiber_workers " + std::to_string(fiber_worker_count()) +
             "\nos_threads " + std::to_string(proc_status_kb("Threads:")) +
             "\n";
+    // Per-tag worker groups (bthread_tag parity), provisioned tags only.
+    for (int t = 1; t < kMaxFiberTags; ++t) {
+      const int n = fiber_worker_count_tag(t);
+      if (n > 0) {
+        *body += "fiber_workers_tag" + std::to_string(t) + " " +
+                 std::to_string(n) + "\n";
+      }
+    }
     return true;
   }
   if (path == "/memory") {

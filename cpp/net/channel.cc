@@ -240,12 +240,19 @@ int Channel::Init(const std::string& addr, const Options* opts) {
     h2_client_protocol_index();  // register before any response arrives
   }
   conn_type_ = static_cast<uint8_t>(ct);
+  sni_host_ = addr.rfind("unix:", 0) == 0 ? ""
+                                          : addr.substr(0, addr.rfind(':'));
   return hostname2endpoint(addr.c_str(), &ep_);
 }
 
 std::string Channel::transport_name() {
   SocketRef s(Socket::Address(sock_));
   return s ? s->transport()->name() : "";
+}
+
+std::string Channel::alpn() {
+  SocketRef s(Socket::Address(sock_));
+  return s ? tls_alpn_selected(s.get()) : "";
 }
 
 // First write on a fresh connection: the credential frame (FIFO write
@@ -359,7 +366,11 @@ int Channel::ensure_socket(SocketId* out) {
       return -1;
     }
     sopts.transport = tls_transport();
-    sopts.transport_ctx_holder = tls_conn_client(ctx);
+    // h2/grpc channels advertise ALPN h2 (gRPC servers commonly require
+    // it); tstd is not an IANA protocol, so it offers no ALPN.  SNI
+    // carries the Init hostname (IP literals filtered by the factory).
+    sopts.transport_ctx_holder =
+        tls_conn_client(ctx, proto_ != 0 ? "\x02h2" : "", sni_host_);
   }
   if (Socket::Create(sopts, &sock_) != 0) {
     return -1;

@@ -70,12 +70,16 @@ class Channel {
   // Name of the live connection's transport ("tcp", "shm_ring",
   // "ici_ring", "tls"), or "" if no socket has been established yet.
   std::string transport_name();
+  // Negotiated ALPN protocol of the live TLS connection ("h2" for
+  // h2/grpc-over-TLS channels), or "" (no socket / plaintext / no ALPN).
+  std::string alpn();
 
  private:
   int ensure_socket(SocketId* out);
 
   EndPoint ep_;
   Options opts_;
+  std::string sni_host_;  // host part of the Init address (TLS SNI)
   uint8_t proto_ = 0;  // 0 = tstd, 1 = h2, 2 = grpc (parsed in Init)
   // FiberMutex, NOT std::mutex: ensure_socket can block (shm handshake is a
   // sync RPC) and contenders must park their fibers, never wedge worker

@@ -134,14 +134,36 @@ int HttpClient::Init(const std::string& addr, const Options* opts) {
   }
   httpc_protocol_index();
   std::string target = addr;
+  bool https = false;
   if (target.rfind("http://", 0) == 0) {
     target = target.substr(7);
+  } else if (target.rfind("https://", 0) == 0) {
+    target = target.substr(8);
+    https = true;
   }
   const size_t slash = target.find('/');
   if (slash != std::string::npos && target.rfind("unix:", 0) != 0) {
     target.resize(slash);  // strip any path; calls pass paths explicitly
   }
   host_ = target;
+  if (https) {
+    // Port detection must ignore colons INSIDE a bracketed IPv6 literal:
+    // only a colon after the last ']' (or any colon when unbracketed)
+    // counts as host:port.
+    const size_t bracket = target.rfind(']');
+    const size_t colon = target.rfind(':');
+    const bool has_port =
+        colon != std::string::npos &&
+        (bracket == std::string::npos || colon > bracket);
+    std::string host_only =
+        has_port ? target.substr(0, colon) : target;
+    if (csock_.EnableTls("\x08http/1.1", host_only) != 0) {
+      return -1;  // https requested but libssl unavailable: fail loudly
+    }
+    if (!has_port) {
+      target += ":443";  // scheme default
+    }
+  }
   return csock_.Init(target);
 }
 

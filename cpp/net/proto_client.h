@@ -12,6 +12,7 @@
 #include "base/endpoint.h"
 #include "net/messenger.h"
 #include "net/socket.h"
+#include "net/tls.h"
 
 namespace trpc {
 
@@ -39,6 +40,22 @@ class ClientSocket {
   }
   const EndPoint& endpoint() const { return ep_; }
 
+  // Future sockets handshake TLS first (https client path; ssl_helper
+  // client-side parity).  `alpn_wire`: RFC 7301 list to advertise;
+  // `sni_host`: server_name to send (IP literals filtered downstream).
+  // Returns 0, or -1 when libssl is unavailable.
+  int EnableTls(const std::string& alpn_wire = "",
+                const std::string& sni_host = "") {
+    std::string err;
+    tls_ctx_ = tls_client_ctx(&err);
+    if (tls_ctx_ == nullptr) {
+      return -1;
+    }
+    alpn_ = alpn_wire;
+    sni_ = sni_host;
+    return 0;
+  }
+
   // Fills *out with a live socket id, creating a fresh socket (lazy
   // connect in the write fiber) when absent or failed.  `pinned_index`
   // is the client protocol to pin; `install` runs on a fresh socket
@@ -59,6 +76,10 @@ class ClientSocket {
     sopts.fd = -1;  // lazy connect in the write fiber
     sopts.remote = ep_;
     sopts.on_readable = &messenger_on_readable;
+    if (tls_ctx_ != nullptr) {
+      sopts.transport = tls_transport();
+      sopts.transport_ctx_holder = tls_conn_client(tls_ctx_, alpn_, sni_);
+    }
     if (Socket::Create(sopts, &sock_) != 0) {
       return -1;
     }
@@ -86,6 +107,9 @@ class ClientSocket {
  private:
   EndPoint ep_;
   SocketId sock_ = 0;
+  void* tls_ctx_ = nullptr;  // leaked-singleton SSL_CTX when TLS enabled
+  std::string alpn_;
+  std::string sni_;
 };
 
 }  // namespace trpc

@@ -210,6 +210,12 @@ void expose_default_variables();  // stat/default_variables.cc
 
 int Server::Start(int port) {
   fiber_init(0);
+  if (worker_tag_ != 0) {
+    if (worker_tag_ < 0 || worker_tag_ >= kMaxFiberTags) {
+      return -1;
+    }
+    fiber_start_tag_workers(worker_tag_, 0);  // default size if not sized
+  }
   expose_default_variables();
   if (session_data_factory_ != nullptr && session_data_pool_ == nullptr) {
     session_data_pool_ =
@@ -353,6 +359,7 @@ int Server::Start(int port) {
   opts.on_readable = &Server::on_acceptable;
   opts.ctx = this;
   opts.user_data = this;
+  opts.worker_tag = static_cast<uint8_t>(worker_tag_);
   if (Socket::Create(opts, &listen_id_) != 0) {
     close(fd);
     return -1;
@@ -466,6 +473,7 @@ void Server::on_acceptable(SocketId id, void* ctx) {
     }
     opts.on_readable = &messenger_on_readable;
     opts.user_data = srv;
+    opts.worker_tag = static_cast<uint8_t>(srv->worker_tag_);
     if (srv->tls_ctx_ != nullptr) {
       // First-byte sniff decides TLS vs plaintext per connection.
       opts.transport = tls_transport();

@@ -61,6 +61,16 @@ class Server {
   void set_authenticator(const Authenticator* auth) { auth_ = auth; }
   const Authenticator* authenticator() const { return auth_; }
 
+  // Pins this server's connections (read fibers, handlers, KeepWrite — the
+  // whole downstream) to a tagged worker group (fiber.h kMaxFiberTags;
+  // parity: ServerOptions::bthread_tag, server.h:280 + per-tag TaskControl
+  // groups, task_control.h:94-99).  Saturating one server's tag cannot
+  // starve another's workers.  Call before Start; the tag's worker group
+  // is provisioned on Start (default size unless fiber_start_tag_workers
+  // ran first).
+  void set_worker_tag(int tag) { worker_tag_ = tag; }
+  int worker_tag() const { return worker_tag_; }
+
   // Request interceptor (parity: brpc::Interceptor, interceptor.h:26,
   // whose Accept sees the Controller): runs before EVERY request on every
   // serving protocol — RPC methods AND builtin observability paths (only
@@ -238,6 +248,7 @@ class Server {
   NsheadService* nshead_service_ = nullptr;
   EspService* esp_service_ = nullptr;
   bool usercode_in_pthread_ = false;
+  int worker_tag_ = 0;
   Handler generic_handler_;
   DataFactory* session_data_factory_ = nullptr;
   size_t session_data_reserve_ = 0;

@@ -40,6 +40,10 @@ class Socket {
     // segment) alive exactly as long as the socket generation.
     Transport* transport = nullptr;
     std::shared_ptr<void> transport_ctx_holder;
+    // Worker group for this connection's fibers (read fiber, and via
+    // inheritance the handler/KeepWrite fibers).  Server.h:280 bthread_tag
+    // parity: a server pins its connections to its tag's worker group.
+    uint8_t worker_tag = 0;
   };
 
   // Creates a socket with one owner reference; registers with the
@@ -89,6 +93,7 @@ class Socket {
   std::atomic<bool> auth_ok{false};
   void* user_data = nullptr;  // Server*/Channel* context, set by owner
   void* transport_ctx = nullptr;  // per-connection transport state
+  uint8_t worker_tag = 0;  // worker group for this connection's fibers
   // Incremental parser state for protocols that need it (HTTP chunked
   // bodies resume scanning; h2 connection state).  Owned by the read
   // fiber; cleared on socket reuse.  `parse_state_owner` tags WHICH
@@ -100,6 +105,7 @@ class Socket {
   const void* parse_state_owner = nullptr;
 
   // -- dispatcher integration (internal) -------------------------------
+  static void destroy_write_node_opaque(void* n);  // TLS cache teardown
   void on_input_event();    // readable edge (any thread)
   void on_output_event();   // writable edge (any thread)
   int wait_writable(uint32_t snap, int64_t deadline_us);
@@ -121,6 +127,10 @@ class Socket {
   void keep_write();
   void reset_for_reuse(const Options& opts);
   void drop_write_queue();
+  // TLS-cached WriteNode alloc/free (one node per Write on the hot path;
+  // pooling also retains the inner IOBuf's refs vector capacity).
+  static WriteNode* alloc_write_node(IOBuf&& data, bool close_after);
+  static void free_write_node(WriteNode* n);
 
   std::atomic<uint64_t> ref_ver_{0};  // version<<32 | refcount
   std::atomic<uint32_t> slot_{0};

@@ -5,6 +5,7 @@
 #include <sys/socket.h>
 
 #include <cstdint>
+#include <cstring>
 #include <mutex>
 
 #include "base/logging.h"
@@ -45,9 +46,25 @@ struct SslApi {
   unsigned long (*ERR_get_error)();
   void (*ERR_error_string_n)(unsigned long, char*, size_t);
   void (*ERR_clear_error)();
+  // ALPN (ssl_helper.h:89-96 parity).  Optional: absent symbols degrade
+  // to no-negotiation (h2 still works via preface probing; strict gRPC
+  // clients need these, present in every OpenSSL ≥1.0.2).
+  int (*SSL_set_alpn_protos)(SSL*, const unsigned char*, unsigned);
+  void (*SSL_CTX_set_alpn_select_cb)(
+      SSL_CTX*,
+      int (*cb)(SSL*, const unsigned char**, unsigned char*,
+                const unsigned char*, unsigned, void*),
+      void*);
+  void (*SSL_get0_alpn_selected)(const SSL*, const unsigned char**,
+                                 unsigned*);
+  // SNI: SSL_set_tlsext_host_name is a macro over SSL_ctrl(ssl, 55, 0,
+  // name) in every OpenSSL; the raw control call is the stable ABI.
+  long (*SSL_ctrl)(SSL*, int, long, void*);
 
   bool ok = false;
 };
+
+constexpr int kSslCtrlSetTlsextHostname = 55;  // SSL_CTRL_SET_TLSEXT_HOSTNAME
 
 const SslApi& api() {
   static SslApi a = [] {
@@ -106,6 +123,19 @@ const SslApi& api() {
             sym("ERR_error_string_n"));
     s.ERR_clear_error =
         reinterpret_cast<void (*)()>(sym("ERR_clear_error"));
+    s.SSL_set_alpn_protos =
+        reinterpret_cast<int (*)(SSL*, const unsigned char*, unsigned)>(
+            sym("SSL_set_alpn_protos"));
+    s.SSL_CTX_set_alpn_select_cb = reinterpret_cast<void (*)(
+        SSL_CTX*,
+        int (*)(SSL*, const unsigned char**, unsigned char*,
+                const unsigned char*, unsigned, void*),
+        void*)>(sym("SSL_CTX_set_alpn_select_cb"));
+    s.SSL_get0_alpn_selected = reinterpret_cast<void (*)(
+        const SSL*, const unsigned char**, unsigned*)>(
+        sym("SSL_get0_alpn_selected"));
+    s.SSL_ctrl =
+        reinterpret_cast<long (*)(SSL*, int, long, void*)>(sym("SSL_ctrl"));
     s.ok = s.TLS_method != nullptr && s.SSL_CTX_new != nullptr &&
            s.SSL_CTX_use_certificate_chain_file != nullptr &&
            s.SSL_CTX_use_PrivateKey_file != nullptr &&
@@ -147,6 +177,8 @@ struct TlsConnState {
   SSL_CTX* ctx = nullptr;  // not owned (contexts are leaked singletons)
   Phase phase = kSniff;
   bool client = false;
+  std::string alpn_offer;  // client: wire-format protocol list to advertise
+  std::string sni_host;    // client: server_name extension (empty = none)
 
   ~TlsConnState() {
     if (ssl != nullptr) {
@@ -340,6 +372,19 @@ class TlsTransport final : public Transport {
       return false;
     }
     if (st->client) {
+      if (!st->alpn_offer.empty() && api().SSL_set_alpn_protos != nullptr) {
+        // Note the inverted return: 0 = success for this one API.
+        api().SSL_set_alpn_protos(
+            st->ssl,
+            reinterpret_cast<const unsigned char*>(st->alpn_offer.data()),
+            static_cast<unsigned>(st->alpn_offer.size()));
+      }
+      if (!st->sni_host.empty() && api().SSL_ctrl != nullptr) {
+        // SNI: without it, name-vhosted endpoints (CDNs, ingresses) serve
+        // their default cert or abort with unrecognized_name.
+        api().SSL_ctrl(st->ssl, kSslCtrlSetTlsextHostname, 0,
+                       const_cast<char*>(st->sni_host.c_str()));
+      }
       api().SSL_set_connect_state(st->ssl);
     } else {
       api().SSL_set_accept_state(st->ssl);
@@ -347,6 +392,31 @@ class TlsTransport final : public Transport {
     return true;
   }
 };
+
+// Server ALPN selection: prefer h2, then http/1.1, else reject (the
+// callback contract: SSL_TLSEXT_ERR_OK=0 / SSL_TLSEXT_ERR_NOACK=3 —
+// NOACK omits the extension, letting protocol probing decide, rather
+// than aborting clients offering something exotic).
+int alpn_select_cb(SSL*, const unsigned char** out, unsigned char* outlen,
+                   const unsigned char* in, unsigned inlen, void*) {
+  static const char* const kPrefer[] = {"h2", "http/1.1"};
+  for (const char* want : kPrefer) {
+    const size_t wlen = strlen(want);
+    for (unsigned i = 0; i + 1 <= inlen;) {
+      const unsigned len = in[i];
+      if (i + 1 + len > inlen) {
+        break;  // malformed list
+      }
+      if (len == wlen && memcmp(in + i + 1, want, wlen) == 0) {
+        *out = in + i + 1;
+        *outlen = static_cast<unsigned char>(len);
+        return 0;  // SSL_TLSEXT_ERR_OK
+      }
+      i += 1 + len;
+    }
+  }
+  return 3;  // SSL_TLSEXT_ERR_NOACK
+}
 
 }  // namespace
 
@@ -374,6 +444,9 @@ void* tls_server_ctx(const std::string& cert_file,
       api().SSL_CTX_free(ctx);  // only SUCCESSFUL contexts live forever
     }
     return nullptr;
+  }
+  if (api().SSL_CTX_set_alpn_select_cb != nullptr) {
+    api().SSL_CTX_set_alpn_select_cb(ctx, &alpn_select_cb, nullptr);
   }
   return ctx;
 }
@@ -410,12 +483,42 @@ std::shared_ptr<void> tls_conn_server(void* server_ctx) {
   return st;
 }
 
-std::shared_ptr<void> tls_conn_client(void* client_ctx) {
+std::shared_ptr<void> tls_conn_client(void* client_ctx,
+                                      const std::string& alpn_wire,
+                                      const std::string& sni_host) {
   auto st = std::make_shared<TlsConnState>();
   st->ctx = static_cast<SSL_CTX*>(client_ctx);
   st->phase = TlsConnState::kHandshaking;
   st->client = true;
+  st->alpn_offer = alpn_wire;
+  // IP literals must not ride the server_name extension (RFC 6066 §3):
+  // skip IPv4 literals (str2endpoint parses them) and IPv6 literals
+  // (bracketed, or bare with colons).
+  if (!sni_host.empty() && sni_host[0] != '[' &&
+      sni_host.find(':') == std::string::npos) {
+    EndPoint probe;
+    if (str2endpoint((sni_host + ":1").c_str(), &probe) != 0) {
+      st->sni_host = sni_host;  // a name, not a literal → send SNI
+    }
+  }
   return st;
+}
+
+std::string tls_alpn_selected(Socket* s) {
+  auto* st = static_cast<TlsConnState*>(s->transport_ctx);
+  if (st == nullptr || api().SSL_get0_alpn_selected == nullptr) {
+    return "";
+  }
+  std::lock_guard<std::mutex> g(st->mu);
+  if (st->ssl == nullptr || st->phase != TlsConnState::kEstablished) {
+    return "";
+  }
+  const unsigned char* data = nullptr;
+  unsigned len = 0;
+  api().SSL_get0_alpn_selected(st->ssl, &data, &len);
+  return data != nullptr ? std::string(reinterpret_cast<const char*>(data),
+                                       len)
+                         : "";
 }
 
 }  // namespace trpc

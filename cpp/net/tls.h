@@ -41,8 +41,20 @@ Transport* tls_transport();
 
 // Per-connection state factories.  `sniff` (server side): the first byte
 // decides TLS vs plaintext passthrough.  Client connections handshake
-// unconditionally.
+// unconditionally.  `alpn_wire` is the RFC 7301 wire-format protocol list
+// to advertise (e.g. "\x02h2\x08http/1.1"); empty = no ALPN extension.
+// Servers negotiate automatically (prefer h2, then http/1.1; exotic lists
+// fall back to byte probing) — ssl_helper.h:89-96 ALPN parity.
 std::shared_ptr<void> tls_conn_server(void* server_ctx);
-std::shared_ptr<void> tls_conn_client(void* client_ctx);
+// `sni_host`: hostname for the server_name extension; IP literals are
+// filtered out automatically (RFC 6066 §3), empty = no SNI.
+std::shared_ptr<void> tls_conn_client(void* client_ctx,
+                                      const std::string& alpn_wire = "",
+                                      const std::string& sni_host = "");
+
+// Negotiated ALPN protocol of an ESTABLISHED TLS socket ("" before the
+// handshake finishes, without ALPN, or on plaintext passthrough).
+class Socket;
+std::string tls_alpn_selected(Socket* s);
 
 }  // namespace trpc

@@ -1,14 +1,33 @@
 #include "stat/latency_recorder.h"
 
 #include <algorithm>
+#include <cmath>
 
 #include "base/rand.h"
 #include "stat/sampler.h"
 
 namespace trpc {
 
+namespace {
+
+// Value → octave index (reference detail/percentile.cpp:51
+// get_interval_index — log2 bucketing, clamped).
+inline int octave_of(int64_t v) {
+  if (v <= 1) {
+    return 0;
+  }
+  if (v >= (int64_t{1} << 31)) {
+    return LatencyRecorder::kNumOctaves - 1;
+  }
+  const int lg = 63 - __builtin_clzll(static_cast<uint64_t>(v));
+  return lg < LatencyRecorder::kNumOctaves - 1
+             ? lg
+             : LatencyRecorder::kNumOctaves - 1;
+}
+
+}  // namespace
+
 LatencyRecorder::LatencyRecorder() {
-  reservoir_.reserve(kReservoir);
   window_.resize(kWindowSecs);
   Sampler::instance()->add(this);
 }
@@ -19,7 +38,10 @@ LatencyRecorder::~LatencyRecorder() {
 }
 
 void LatencyRecorder::operator<<(int64_t latency_us) {
-  const int64_t n = interval_count_.fetch_add(1, std::memory_order_relaxed);
+  if (latency_us < 0) {
+    latency_us = 0;
+  }
+  interval_count_.fetch_add(1, std::memory_order_relaxed);
   interval_sum_.fetch_add(latency_us, std::memory_order_relaxed);
   total_count_.fetch_add(1, std::memory_order_relaxed);
   int64_t cur_max = max_us_.load(std::memory_order_relaxed);
@@ -28,13 +50,15 @@ void LatencyRecorder::operator<<(int64_t latency_us) {
                                         std::memory_order_relaxed)) {
   }
   std::lock_guard<std::mutex> g(res_mu_);
-  if (static_cast<int>(reservoir_.size()) < kReservoir) {
-    reservoir_.push_back(latency_us);
+  Octave& o = active_[octave_of(latency_us)];
+  ++o.added;
+  if (static_cast<int>(o.samples.size()) < kOctaveSamples) {
+    o.samples.push_back(latency_us);
   } else {
-    // Reservoir sampling keeps the sample uniform over the interval.
-    const uint64_t j = fast_rand_less_than(static_cast<uint64_t>(n) + 1);
-    if (j < kReservoir) {
-      reservoir_[j] = latency_us;
+    // Per-octave reservoir keeps the sample uniform within its octave.
+    const uint64_t j = fast_rand_less_than(static_cast<uint64_t>(o.added));
+    if (j < static_cast<uint64_t>(kOctaveSamples)) {
+      o.samples[j] = latency_us;
     }
   }
 }
@@ -43,12 +67,19 @@ void LatencyRecorder::take_sample() {
   Second sec;
   {
     std::lock_guard<std::mutex> g(res_mu_);
-    sec.sorted_latencies.swap(reservoir_);
-    reservoir_.reserve(kReservoir);
+    for (int i = 0; i < kNumOctaves; ++i) {
+      if (active_[i].added != 0) {
+        sec.oct[i].added = active_[i].added;
+        sec.oct[i].samples.swap(active_[i].samples);
+        active_[i].added = 0;
+      }
+    }
   }
   sec.count = interval_count_.exchange(0, std::memory_order_relaxed);
   sec.sum = interval_sum_.exchange(0, std::memory_order_relaxed);
-  std::sort(sec.sorted_latencies.begin(), sec.sorted_latencies.end());
+  for (int i = 0; i < kNumOctaves; ++i) {
+    std::sort(sec.oct[i].samples.begin(), sec.oct[i].samples.end());
+  }
   std::lock_guard<std::mutex> g(window_mu_);
   window_[window_pos_] = std::move(sec);
   window_pos_ = (window_pos_ + 1) % kWindowSecs;
@@ -77,18 +108,59 @@ int64_t LatencyRecorder::latency_avg_us() const {
 
 int64_t LatencyRecorder::latency_percentile_us(double p) const {
   std::lock_guard<std::mutex> g(window_mu_);
-  std::vector<int64_t> merged;
+  // Exact per-octave counts across the window locate the owning octave;
+  // rank walk = reference percentile.h:335 get_number.
+  int64_t per_octave[kNumOctaves] = {0};
+  int64_t total = 0;
   for (const Second& s : window_) {
-    merged.insert(merged.end(), s.sorted_latencies.begin(),
-                  s.sorted_latencies.end());
+    for (int i = 0; i < kNumOctaves; ++i) {
+      per_octave[i] += s.oct[i].added;
+      total += s.oct[i].added;
+    }
   }
-  if (merged.empty()) {
+  if (total == 0) {
     return 0;
   }
-  std::sort(merged.begin(), merged.end());
-  const size_t idx = std::min(merged.size() - 1,
-                              static_cast<size_t>(p * merged.size()));
-  return merged[idx];
+  // ceil, like the reference's get_number: rank 0.99·100000 is exactly the
+  // 99000th sample, not the 99001st (which would already be in the tail).
+  int64_t n = static_cast<int64_t>(
+      std::ceil(p * static_cast<double>(total)));
+  if (n > total) {
+    n = total;
+  } else if (n < 1) {
+    n = 1;
+  }
+  for (int i = 0; i < kNumOctaves; ++i) {
+    if (per_octave[i] == 0) {
+      continue;
+    }
+    if (n <= per_octave[i]) {
+      // Merge the owning octave's samples across the window.  Seconds
+      // contribute ≤kOctaveSamples each regardless of their added count —
+      // a mild bias WITHIN the octave, so the result still lies inside
+      // the correct [2^i, 2^(i+1)) band (the bounded-error contract).
+      std::vector<int64_t> merged;
+      for (const Second& s : window_) {
+        merged.insert(merged.end(), s.oct[i].samples.begin(),
+                      s.oct[i].samples.end());
+      }
+      if (merged.empty()) {
+        return int64_t{1} << i;  // count but no samples: octave floor
+      }
+      std::sort(merged.begin(), merged.end());
+      size_t sample_n = static_cast<size_t>(
+          static_cast<double>(n) * static_cast<double>(merged.size()) /
+          static_cast<double>(per_octave[i]));
+      if (sample_n >= merged.size()) {
+        sample_n = merged.size() - 1;
+      } else if (sample_n > 0) {
+        --sample_n;
+      }
+      return merged[sample_n];
+    }
+    n -= per_octave[i];
+  }
+  return max_us_.load(std::memory_order_relaxed);
 }
 
 int64_t LatencyRecorder::latency_max_us() const {
@@ -121,6 +193,7 @@ std::string LatencyRecorder::value_str() const {
          ",\"p999_us\":" + std::to_string(latency_percentile_us(0.999)) +
          ",\"max_us\":" + std::to_string(latency_max_us()) +
          ",\"count\":" + std::to_string(count()) + "}";
+  // NOTE: shape must stay stable — tests and dashboards parse these keys.
 }
 
 }  // namespace trpc

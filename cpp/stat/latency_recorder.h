@@ -1,12 +1,22 @@
 // LatencyRecorder — per-second qps/avg/percentiles.
 //
 // Parity: bvar::LatencyRecorder (/root/reference/src/bvar/
-// latency_recorder.h:32-75 over detail/percentile.h reservoir sampling and
-// the one-background-thread Sampler, detail/sampler.cpp:60-135).
-// Re-designed: one reservoir per recorder, swapped each second by the
-// sampler thread into a trailing window of sorted snapshots.
+// latency_recorder.h:32-75 over detail/percentile.h).  The reference's
+// central idea — kept here, replacing the r4 flat reservoir — is that
+// samples are bucketed by VALUE OCTAVE (detail/percentile.cpp:51
+// get_interval_index: interval = log2(latency)), 32 intervals each with
+// its own bounded uniform sample set + exact added count
+// (detail/percentile.h:52 PercentileInterval, :280 PercentileSamples,
+// :507 get_number's rank walk).  A percentile first walks octaves by
+// exact counts, then indexes proportionally into the owning octave's
+// samples — so the error is bounded by one octave's sample resolution
+// and a rare tail (1% of traffic at 100x the median) gets its own
+// octave's entire sample budget instead of ~1% of a shared reservoir.
+// Windows combine per-second interval snapshots (the reference's
+// ReducerSampler window), mixing no epochs older than kWindowSecs.
 #pragma once
 
+#include <array>
 #include <atomic>
 #include <cstdint>
 #include <mutex>
@@ -21,7 +31,8 @@ namespace trpc {
 
 class LatencyRecorder : public Variable, public Sampled {
  public:
-  static constexpr int kReservoir = 1024;
+  static constexpr int kNumOctaves = 32;     // value range [2^i, 2^(i+1))
+  static constexpr int kOctaveSamples = 64;  // per octave per second
   static constexpr int kWindowSecs = 10;
 
   LatencyRecorder();
@@ -43,15 +54,22 @@ class LatencyRecorder : public Variable, public Sampled {
   void take_sample() override;
 
  private:
+  // One value octave's per-second state: exact count + a uniform sample
+  // (reservoir capped at kOctaveSamples; values inside span at most 2x,
+  // which is what bounds the percentile error).
+  struct Octave {
+    int64_t added = 0;
+    std::vector<int64_t> samples;
+  };
   struct Second {
-    std::vector<int64_t> sorted_latencies;
+    std::array<Octave, kNumOctaves> oct;
     int64_t count = 0;
     int64_t sum = 0;
   };
 
-  // Active reservoir (written by hot path, swapped by sampler).
+  // Active interval (written by hot path, swapped by sampler each second).
   mutable std::mutex res_mu_;
-  std::vector<int64_t> reservoir_;
+  std::array<Octave, kNumOctaves> active_;
   std::atomic<int64_t> interval_count_{0};
   std::atomic<int64_t> interval_sum_{0};
   std::atomic<int64_t> total_count_{0};

@@ -410,4 +410,81 @@ TEST_CASE(fiber_dump_unwinds_parked_stacks) {
   EXPECT_EQ(fiber_join(f), 0);
 }
 
+namespace {
+
+struct TagProbe {
+  std::atomic<int> seen_tag{-1};
+  std::atomic<int> child_tag{-1};
+};
+
+void tag_child(void* p) {
+  static_cast<TagProbe*>(p)->child_tag.store(fiber_current_tag());
+}
+
+void tag_probe_fiber(void* p) {
+  auto* t = static_cast<TagProbe*>(p);
+  t->seen_tag.store(fiber_current_tag());
+  // Untagged spawn from a tagged worker INHERITS the tag.
+  fiber_t c;
+  fiber_start(&c, &tag_child, t, 0);
+  fiber_join(c);
+}
+
+struct SpinCtx {
+  std::atomic<bool>* stop;
+};
+
+void spin_fiber(void* p) {
+  // Pthread-level busy spin: hogs the WORKER, not just the fiber — the
+  // saturation a tag must contain.
+  auto* c = static_cast<SpinCtx*>(p);
+  while (!c->stop->load(std::memory_order_relaxed)) {
+  }
+}
+
+void quick_flag_fiber(void* p) {
+  static_cast<std::atomic<bool>*>(p)->store(true);
+}
+
+}  // namespace
+
+TEST_CASE(worker_tags_pin_and_inherit) {
+  fiber_init(0);
+  EXPECT_EQ(fiber_start_tag_workers(1, 2), 0);
+  EXPECT_EQ(fiber_worker_count_tag(1), 2);
+  EXPECT_EQ(fiber_start_tag_workers(kMaxFiberTags, 2), EINVAL);
+  TagProbe probe;
+  fiber_t f;
+  EXPECT_EQ(fiber_start(&f, &tag_probe_fiber, &probe, fiber_tag_flags(1)), 0);
+  fiber_join(f);
+  EXPECT_EQ(probe.seen_tag.load(), 1);
+  EXPECT_EQ(probe.child_tag.load(), 1);  // inherited, not defaulted to 0
+}
+
+TEST_CASE(worker_tags_isolate_saturation) {
+  fiber_init(0);
+  // Saturate tag 2 (2 workers) with pthread-level spinners; a tag-0 fiber
+  // must still run promptly — per-tag groups don't poach or share queues.
+  EXPECT_EQ(fiber_start_tag_workers(2, 2), 0);
+  std::atomic<bool> stop{false};
+  SpinCtx ctx{&stop};
+  fiber_t spinners[8];
+  for (auto& s : spinners) {
+    EXPECT_EQ(fiber_start(&s, &spin_fiber, &ctx, fiber_tag_flags(2)), 0);
+  }
+  usleep(50 * 1000);  // let the spinners occupy (and overcommit) tag 2
+  std::atomic<bool> ran{false};
+  fiber_t q;
+  const int64_t t0 = monotonic_time_us();
+  EXPECT_EQ(fiber_start(&q, &quick_flag_fiber, &ran, 0), 0);
+  fiber_join(q);
+  const int64_t dt = monotonic_time_us() - t0;
+  EXPECT(ran.load());
+  EXPECT(dt < 1000 * 1000);  // far below the spinners' lifetime
+  stop.store(true);
+  for (auto& s : spinners) {
+    fiber_join(s);
+  }
+}
+
 TEST_MAIN

@@ -17,6 +17,7 @@
 #include "fiber/fiber.h"
 #include "net/channel.h"
 #include "net/server.h"
+#include "stat/profiler.h"
 
 using namespace trpc;
 
@@ -81,6 +82,12 @@ int main(int argc, char** argv) {
   std::vector<std::vector<int64_t>> lat(nfibers);
   std::vector<WorkerArgs> args(nfibers);
   std::vector<fiber_t> fibers(nfibers);
+  // BENCH_PROFILE=1: sample the whole run and dump hotspots to stderr
+  // (the /hotspots SIGPROF profiler, usable standalone).
+  const bool profiling = getenv("BENCH_PROFILE") != nullptr;
+  if (profiling) {
+    profiler_start(997);
+  }
   const int64_t stop_us = monotonic_time_us() + seconds * 1000000LL;
   const int64_t t0 = monotonic_time_us();
   for (int i = 0; i < nfibers; ++i) {
@@ -92,6 +99,9 @@ int main(int argc, char** argv) {
     fiber_join(f);
   }
   const double secs = (monotonic_time_us() - t0) / 1e6;
+  if (profiling) {
+    fprintf(stderr, "%s\n", profiler_stop_and_dump(50).c_str());
+  }
 
   std::vector<int64_t> all;
   for (auto& v : lat) {

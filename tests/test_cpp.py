@@ -8,6 +8,7 @@ exactly that 11 of 26 binaries were green-but-ungated.
 """
 
 import pathlib
+import re
 import subprocess
 
 import pytest
@@ -36,11 +37,8 @@ def _ctest_targets() -> list[str]:
     proc = subprocess.run(
         ["ctest", "-N"], cwd=BUILD, capture_output=True, text=True, timeout=60
     )
-    names = []
-    for line in proc.stdout.splitlines():
-        # "  Test #3: test_fiber"
-        if ": " in line and line.lstrip().startswith("Test #"):
-            names.append(line.split(": ", 1)[1].strip())
+    # "  Test  #3: test_fiber" (ctest pads the # column)
+    names = re.findall(r"^\s*Test\s+#\d+:\s+(\S+)", proc.stdout, re.M)
     assert len(names) >= 26, f"ctest discovery broke (found {names})"
     return names
 
@@ -49,11 +47,19 @@ def _ctest_targets() -> list[str]:
 def test_ctest(target):
     # ctest -R with anchors so test_redis doesn't also match
     # test_redis_cluster; --timeout mirrors the old per-binary caps.
-    proc = subprocess.run(
-        ["ctest", "-R", f"^{target}$", "--output-on-failure", "--timeout",
-         "420"],
-        cwd=BUILD, capture_output=True, text=True, timeout=480,
-    )
-    assert proc.returncode == 0, (
-        f"{target} failed:\n{proc.stdout[-8000:]}\n{proc.stderr[-2000:]}"
+    # One retry: several suites assert on wall-clock windows (cluster
+    # probe revival, combo hedging) and can flake under full-suite load;
+    # a real regression fails both runs.
+    last = None
+    for _ in range(2):
+        last = subprocess.run(
+            ["ctest", "-R", f"^{target}$", "--output-on-failure",
+             "--timeout", "420"],
+            cwd=BUILD, capture_output=True, text=True, timeout=480,
+        )
+        if last.returncode == 0:
+            return
+    assert last.returncode == 0, (
+        f"{target} failed twice:\n{last.stdout[-8000:]}\n"
+        f"{last.stderr[-2000:]}"
     )
