@@ -213,18 +213,45 @@ def _child_tpu_rpc() -> None:
     dev = jnp.arange(size // 4, dtype=jnp.uint32)
     expected = int(jnp.sum(dev, dtype=jnp.uint64))  # forces materialize
 
-    # Staging DMA: the one unavoidable device→host hop (tools/PJRT_PROBE.md:
-    # this image's PJRT exposes no device pointers, so np.asarray IS the
-    # transport hop — the NIC-DMA analogue).
+    # Registered staging slab (VERDICT r4 #3): the device→host DMA lands
+    # in ici-registered shm memory, so the ici leg ships it with
+    # SENDER-OWNED descriptors — no ring DMA copy, one descriptor per
+    # payload (the rdma block_pool takeover analogue; a PJRT pinned-host
+    # backend would land the fetch here directly).
+    lib.trpc_ici_staging_alloc.restype = ctypes.c_void_p
+    lib.trpc_ici_staging_alloc.argtypes = [
+        ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint32)]
+    lib.trpc_ici_zero_copy_counters.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
+    ord_out = ctypes.c_uint32()
+    slab = lib.trpc_ici_staging_alloc(size, ctypes.byref(ord_out))
+
+    # The PJRT hop (np.asarray; this image exposes no device pointers —
+    # tools/PJRT_PROBE.md), then the landing into registered memory.
     t0 = time.perf_counter()
-    staging = np.asarray(dev)
+    fetched = np.asarray(dev).view(np.uint8)
     dma_s = time.perf_counter() - t0
+    if slab:
+        staging = np.frombuffer(
+            (ctypes.c_char * size).from_address(slab), dtype=np.uint8)
+        t0 = time.perf_counter()
+        np.copyto(staging, fetched)
+        land_s = time.perf_counter() - t0
+    else:  # staging alloc failed: fall back to numpy-owned memory
+        staging = fetched
+        land_s = 0.0
 
     iters = 12
     row = {"kind": "tpu_rpc_64MB", "platform": platform,
-           "staging_dma_gbps": round(size / dma_s / 1e9, 3), "rpc": {}}
+           "staging_dma_gbps": round(size / dma_s / 1e9, 3),
+           "staging_land_gbps": round(size / land_s / 1e9, 3)
+           if land_s > 0 else None,
+           "rpc": {}}
     best = 0.0
     resp = np.empty(size, dtype=np.uint8)
+    zc0_w, zc0_b = ctypes.c_uint64(), ctypes.c_uint64()
+    lib.trpc_ici_zero_copy_counters(ctypes.byref(zc0_w),
+                                    ctypes.byref(zc0_b))
     for tr in ("ici", "shm", "tcp"):
         g = ctypes.c_double()
         used = ctypes.create_string_buffer(32)
@@ -237,6 +264,17 @@ def _child_tpu_rpc() -> None:
             best = max(best, g.value)
         else:
             row["rpc"][tr] = f"failed: {err.value.decode()}"
+    zc1_w, zc1_b = ctypes.c_uint64(), ctypes.c_uint64()
+    lib.trpc_ici_zero_copy_counters(ctypes.byref(zc1_w),
+                                    ctypes.byref(zc1_b))
+    # The no-extra-host-copy assertion: the ici leg's payload bytes rode
+    # sender-owned descriptors (ring DMA elided), not the bounce path.
+    row["ici_zero_copy"] = {
+        "wrs": zc1_w.value - zc0_w.value,
+        "bytes": zc1_b.value - zc0_b.value,
+        "payload_covered": bool(slab) and
+        (zc1_b.value - zc0_b.value) >= size * iters,
+    }
 
     # Close the loop: echoed bytes back onto the device, verified there.
     back = jax.device_put(resp.view(np.uint32))

@@ -1,0 +1,448 @@
+#include "base/mcpack.h"
+
+#include <cstring>
+
+namespace trpc {
+
+namespace {
+
+constexpr uint8_t kShortMask = 0x80;
+constexpr uint8_t kFixedMask = 0x0F;
+constexpr uint8_t kNonDeletedMask = 0x70;
+
+void put_u32(std::string* out, uint32_t v) {
+  char b[4];
+  memcpy(b, &v, 4);  // mcpack is little-endian-native, like the reference
+  out->append(b, 4);
+}
+
+uint32_t get_u32(const char* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+size_t fixed_value_size(McpackType t) {
+  return static_cast<uint8_t>(t) & kFixedMask;
+}
+
+bool is_container(McpackType t) {
+  return t == McpackType::kObject || t == McpackType::kArray ||
+         t == McpackType::kIsoArray;
+}
+
+// Serializes a fixed-type scalar's raw value bytes.
+void append_scalar(const McpackValue& v, std::string* out) {
+  char b[8] = {0};
+  switch (v.type) {
+    case McpackType::kInt8: {
+      const int8_t x = static_cast<int8_t>(v.i64);
+      memcpy(b, &x, 1);
+      out->append(b, 1);
+      return;
+    }
+    case McpackType::kInt16: {
+      const int16_t x = static_cast<int16_t>(v.i64);
+      memcpy(b, &x, 2);
+      out->append(b, 2);
+      return;
+    }
+    case McpackType::kInt32: {
+      const int32_t x = static_cast<int32_t>(v.i64);
+      memcpy(b, &x, 4);
+      out->append(b, 4);
+      return;
+    }
+    case McpackType::kInt64:
+      memcpy(b, &v.i64, 8);
+      out->append(b, 8);
+      return;
+    case McpackType::kUint8: {
+      const uint8_t x = static_cast<uint8_t>(v.u64);
+      memcpy(b, &x, 1);
+      out->append(b, 1);
+      return;
+    }
+    case McpackType::kUint16: {
+      const uint16_t x = static_cast<uint16_t>(v.u64);
+      memcpy(b, &x, 2);
+      out->append(b, 2);
+      return;
+    }
+    case McpackType::kUint32: {
+      const uint32_t x = static_cast<uint32_t>(v.u64);
+      memcpy(b, &x, 4);
+      out->append(b, 4);
+      return;
+    }
+    case McpackType::kUint64:
+      memcpy(b, &v.u64, 8);
+      out->append(b, 8);
+      return;
+    case McpackType::kBool:
+      b[0] = v.i64 != 0 ? 1 : 0;
+      out->append(b, 1);
+      return;
+    case McpackType::kFloat: {
+      const float x = static_cast<float>(v.f64);
+      memcpy(b, &x, 4);
+      out->append(b, 4);
+      return;
+    }
+    case McpackType::kDouble:
+      memcpy(b, &v.f64, 8);
+      out->append(b, 8);
+      return;
+    case McpackType::kNull:
+      b[0] = 0;
+      out->append(b, 1);
+      return;
+    default:
+      return;
+  }
+}
+
+bool parse_scalar(McpackType t, const char* p, size_t n, McpackValue* out) {
+  if (n != fixed_value_size(t)) {
+    return false;
+  }
+  out->type = t;
+  switch (t) {
+    case McpackType::kInt8: {
+      int8_t x;
+      memcpy(&x, p, 1);
+      out->i64 = x;
+      return true;
+    }
+    case McpackType::kInt16: {
+      int16_t x;
+      memcpy(&x, p, 2);
+      out->i64 = x;
+      return true;
+    }
+    case McpackType::kInt32: {
+      int32_t x;
+      memcpy(&x, p, 4);
+      out->i64 = x;
+      return true;
+    }
+    case McpackType::kInt64:
+      memcpy(&out->i64, p, 8);
+      return true;
+    case McpackType::kUint8: {
+      uint8_t x;
+      memcpy(&x, p, 1);
+      out->u64 = x;
+      return true;
+    }
+    case McpackType::kUint16: {
+      uint16_t x;
+      memcpy(&x, p, 2);
+      out->u64 = x;
+      return true;
+    }
+    case McpackType::kUint32: {
+      uint32_t x;
+      memcpy(&x, p, 4);
+      out->u64 = x;
+      return true;
+    }
+    case McpackType::kUint64:
+      memcpy(&out->u64, p, 8);
+      return true;
+    case McpackType::kBool:
+      out->i64 = p[0] != 0;
+      return true;
+    case McpackType::kFloat: {
+      float x;
+      memcpy(&x, p, 4);
+      out->f64 = x;
+      return true;
+    }
+    case McpackType::kDouble:
+      memcpy(&out->f64, p, 8);
+      return true;
+    case McpackType::kNull:
+      return true;
+    default:
+      return false;
+  }
+}
+
+// Parses ONE item at data[0..len); recursion bounded by depth.
+// *deleted: the item is a tombstone ((type & 0x70) == 0) — counted in its
+// container's item_count but not a live field.
+bool parse_item(const char* data, size_t len, std::string* name,
+                McpackValue* out, size_t* consumed, bool* deleted,
+                int depth) {
+  if (depth > 32 || len < 2) {
+    return false;
+  }
+  const uint8_t first = static_cast<uint8_t>(data[0]);
+  uint8_t raw_type;
+  size_t name_size, value_size, head_size;
+  if (first & kFixedMask) {  // fixed head: 2 bytes, size in the nibble
+    raw_type = first;
+    name_size = static_cast<uint8_t>(data[1]);
+    value_size = first & kFixedMask;
+    head_size = 2;
+  } else if (first & kShortMask) {  // short head: 3 bytes
+    if (len < 3) {
+      return false;
+    }
+    raw_type = first & static_cast<uint8_t>(~kShortMask);
+    name_size = static_cast<uint8_t>(data[1]);
+    value_size = static_cast<uint8_t>(data[2]);
+    head_size = 3;
+  } else {  // long head: 6 bytes
+    if (len < 6) {
+      return false;
+    }
+    raw_type = first;
+    name_size = static_cast<uint8_t>(data[1]);
+    value_size = get_u32(data + 2);
+    head_size = 6;
+  }
+  const size_t full = head_size + name_size + value_size;
+  if (full > len) {
+    return false;
+  }
+  if (name != nullptr) {
+    if (name_size > 0) {
+      name->assign(data + head_size, name_size - 1);  // strip the NUL
+    } else {
+      name->clear();
+    }
+  }
+  *consumed = full;
+  *deleted = !(raw_type & kNonDeletedMask);
+  if (*deleted) {
+    out->type = McpackType::kNull;
+    return true;
+  }
+  const char* v = data + head_size + name_size;
+  const auto t = static_cast<McpackType>(raw_type);
+  switch (t) {
+    case McpackType::kObject:
+    case McpackType::kArray: {
+      if (value_size < 4) {
+        return false;
+      }
+      out->type = t;
+      const uint32_t count = get_u32(v);
+      const char* p = v + 4;
+      size_t left = value_size - 4;
+      for (uint32_t i = 0; i < count; ++i) {
+        std::string child_name;
+        McpackValue child;
+        size_t used = 0;
+        bool child_deleted = false;
+        if (!parse_item(p, left, &child_name, &child, &used, &child_deleted,
+                        depth + 1)) {
+          return false;
+        }
+        p += used;
+        left -= used;
+        if (child_deleted) {
+          continue;  // tombstone: counted on the wire, absent in the tree
+        }
+        if (t == McpackType::kObject) {
+          out->fields.emplace_back(std::move(child_name), std::move(child));
+        } else {
+          out->items.push_back(std::move(child));
+        }
+      }
+      return true;
+    }
+    case McpackType::kIsoArray: {
+      if (value_size < 1) {
+        return false;
+      }
+      const auto elem = static_cast<McpackType>(v[0]);
+      const size_t esz = fixed_value_size(elem);
+      if (esz == 0 || (value_size - 1) % esz != 0) {
+        return false;
+      }
+      out->type = t;
+      out->iso_type = elem;
+      const char* p = v + 1;
+      for (size_t i = 0; i < (value_size - 1) / esz; ++i) {
+        McpackValue e;
+        if (!parse_scalar(elem, p + i * esz, esz, &e)) {
+          return false;
+        }
+        out->items.push_back(std::move(e));
+      }
+      return true;
+    }
+    case McpackType::kString:
+      if (value_size == 0 || v[value_size - 1] != '\0') {
+        return false;  // strings carry a trailing NUL on the wire
+      }
+      out->type = t;
+      out->str.assign(v, value_size - 1);
+      return true;
+    case McpackType::kBinary:
+      out->type = t;
+      out->str.assign(v, value_size);
+      return true;
+    default:
+      return parse_scalar(t, v, value_size, out);
+  }
+}
+
+}  // namespace
+
+McpackValue McpackValue::Str(std::string s) {
+  McpackValue v = with(McpackType::kString);
+  v.str = std::move(s);
+  return v;
+}
+
+McpackValue McpackValue::Binary(std::string bytes) {
+  McpackValue v = with(McpackType::kBinary);
+  v.str = std::move(bytes);
+  return v;
+}
+
+McpackValue McpackValue::I32(int32_t x) {
+  McpackValue v = with(McpackType::kInt32);
+  v.i64 = x;
+  return v;
+}
+
+McpackValue McpackValue::I64(int64_t x) {
+  McpackValue v = with(McpackType::kInt64);
+  v.i64 = x;
+  return v;
+}
+
+McpackValue McpackValue::U64(uint64_t x) {
+  McpackValue v = with(McpackType::kUint64);
+  v.u64 = x;
+  return v;
+}
+
+McpackValue McpackValue::Bool(bool x) {
+  McpackValue v = with(McpackType::kBool);
+  v.i64 = x ? 1 : 0;
+  return v;
+}
+
+McpackValue McpackValue::Double(double x) {
+  McpackValue v = with(McpackType::kDouble);
+  v.f64 = x;
+  return v;
+}
+
+McpackValue McpackValue::IsoArray(McpackType elem) {
+  McpackValue v = with(McpackType::kIsoArray);
+  v.iso_type = elem;
+  return v;
+}
+
+const McpackValue* McpackValue::field(const std::string& name) const {
+  for (const auto& [k, v] : fields) {
+    if (k == name) {
+      return &v;
+    }
+  }
+  return nullptr;
+}
+
+bool McpackValue::serialize_item(const std::string& name,
+                                 std::string* out) const {
+  if (name.size() > 254) {
+    // The wire's name_size is one byte (name + NUL ≤ 255); emitting a
+    // truncated length would corrupt the whole image (reference
+    // serializer.cpp:195 rejects the same way).
+    return false;
+  }
+  const uint8_t raw = static_cast<uint8_t>(type);
+  const size_t name_size = name.empty() ? 0 : name.size() + 1;
+  auto append_name = [&] {
+    if (!name.empty()) {
+      out->append(name);
+      out->push_back('\0');
+    }
+  };
+  if (raw & kFixedMask) {  // fixed head
+    out->push_back(static_cast<char>(raw));
+    out->push_back(static_cast<char>(name_size));
+    append_name();
+    append_scalar(*this, out);
+    return true;
+  }
+  // Build the value bytes first (containers need their size up front).
+  std::string value;
+  switch (type) {
+    case McpackType::kObject:
+      put_u32(&value, static_cast<uint32_t>(fields.size()));
+      for (const auto& [k, v] : fields) {
+        if (!v.serialize_item(k, &value)) {
+          return false;
+        }
+      }
+      break;
+    case McpackType::kArray:
+      put_u32(&value, static_cast<uint32_t>(items.size()));
+      for (const McpackValue& v : items) {
+        if (!v.serialize_item("", &value)) {
+          return false;
+        }
+      }
+      break;
+    case McpackType::kIsoArray:
+      value.push_back(static_cast<char>(iso_type));
+      for (const McpackValue& v : items) {
+        append_scalar(v, &value);
+      }
+      break;
+    case McpackType::kString:
+      value.assign(str);
+      value.push_back('\0');
+      break;
+    case McpackType::kBinary:
+      value.assign(str);
+      break;
+    default:
+      break;
+  }
+  if (value.size() <= 255 &&
+      (type == McpackType::kString || type == McpackType::kBinary)) {
+    // Short head for small strings/raws (parser.cpp:43 FieldShortHead).
+    out->push_back(static_cast<char>(raw | kShortMask));
+    out->push_back(static_cast<char>(name_size));
+    out->push_back(static_cast<char>(value.size()));
+  } else {
+    out->push_back(static_cast<char>(raw));
+    out->push_back(static_cast<char>(name_size));
+    put_u32(out, static_cast<uint32_t>(value.size()));
+  }
+  append_name();
+  out->append(value);
+  return true;
+}
+
+std::string McpackValue::serialize() const {
+  std::string out;
+  if (!serialize_item("", &out)) {
+    return "";  // some field name exceeds the wire's 254-byte limit
+  }
+  return out;
+}
+
+bool McpackValue::parse(const char* data, size_t len, McpackValue* out,
+                        size_t* consumed) {
+  size_t used = 0;
+  bool deleted = false;
+  if (!parse_item(data, len, nullptr, out, &used, &deleted, 0)) {
+    return false;
+  }
+  if (consumed != nullptr) {
+    *consumed = used;
+  }
+  return true;
+}
+
+}  // namespace trpc

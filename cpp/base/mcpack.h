@@ -1,0 +1,103 @@
+// mcpack_v2 — Baidu's tagged binary serialization, the payload format of
+// nshead-framed legacy services.
+//
+// Parity: /root/reference/src/mcpack2pb (field_type.h:30 type tags;
+// parser.cpp:30-80 the three head forms; serializer.cpp object/array
+// bodies).  The reference compiles .proto files into mcpack
+// parse/serialize functions; ours is a VALUE-MODEL codec (like this
+// repo's json.h / thrift.h / mongo BSON): parse to a tree, build a tree,
+// serialize — which is what a polyglot RPC framework needs to interop
+// with mcpack peers without a codegen step.
+//
+// Wire format (mcpack_v2):
+//   item      := head name? value
+//   head      := fixed (2B: type, name_size)          low nibble != 0
+//              | short (3B: type|0x80, name_size, value_size u8)
+//              | long  (6B: type, name_size, value_size u32)
+//   name      := name_size bytes INCLUDING a trailing NUL (0 = unnamed)
+//   OBJECT 0x10 / ARRAY 0x20 value := u32 item_count, then items
+//   ISOARRAY 0x30 value := u8 item_type, then packed primitive values
+//   STRING 0x50 value includes a trailing NUL; BINARY 0x60 is raw
+//   fixed types encode their size in the low nibble (INT32 0x14, ...)
+//   deleted items have (type & 0x70) == 0 and are skipped
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace trpc {
+
+enum class McpackType : uint8_t {
+  kObject = 0x10,
+  kArray = 0x20,
+  kIsoArray = 0x30,
+  kString = 0x50,
+  kBinary = 0x60,
+  kInt8 = 0x11,
+  kInt16 = 0x12,
+  kInt32 = 0x14,
+  kInt64 = 0x18,
+  kUint8 = 0x21,
+  kUint16 = 0x22,
+  kUint32 = 0x24,
+  kUint64 = 0x28,
+  kBool = 0x31,
+  kFloat = 0x44,
+  kDouble = 0x48,
+  kNull = 0x61,
+};
+
+struct McpackValue {
+  McpackType type = McpackType::kNull;
+  // Scalars.
+  int64_t i64 = 0;     // all signed ints + bool
+  uint64_t u64 = 0;    // all unsigned ints
+  double f64 = 0.0;    // float + double
+  std::string str;     // string (no NUL) / binary bytes
+  // Containers: object fields keep insertion order (names in `keys`).
+  std::vector<std::pair<std::string, McpackValue>> fields;  // object
+  std::vector<McpackValue> items;                           // array
+  McpackType iso_type = McpackType::kNull;  // isoarray element type
+
+  // -- builders ---------------------------------------------------------
+  static McpackValue Object() { return with(McpackType::kObject); }
+  static McpackValue Array() { return with(McpackType::kArray); }
+  static McpackValue Str(std::string s);
+  static McpackValue Binary(std::string bytes);
+  static McpackValue I32(int32_t v);
+  static McpackValue I64(int64_t v);
+  static McpackValue U64(uint64_t v);
+  static McpackValue Bool(bool v);
+  static McpackValue Double(double v);
+  static McpackValue Null() { return {}; }
+  // Homogeneous packed array of a FIXED type (kInt32 etc.).
+  static McpackValue IsoArray(McpackType elem);
+
+  void add_field(const std::string& name, McpackValue v) {
+    fields.emplace_back(name, std::move(v));
+  }
+  void add_item(McpackValue v) { items.push_back(std::move(v)); }
+  const McpackValue* field(const std::string& name) const;
+
+  // -- codec ------------------------------------------------------------
+  // Serializes this value as an UNNAMED root item (the nshead body form).
+  // Returns "" when a field name exceeds the wire's 254-byte limit.
+  std::string serialize() const;
+  // Parses one root item; false on malformed/truncated input.
+  // *consumed (optional) reports the item's full wire size.
+  static bool parse(const char* data, size_t len, McpackValue* out,
+                    size_t* consumed = nullptr);
+
+ private:
+  static McpackValue with(McpackType t) {
+    McpackValue v;
+    v.type = t;
+    return v;
+  }
+  bool serialize_item(const std::string& name, std::string* out) const;
+};
+
+}  // namespace trpc

@@ -410,6 +410,21 @@ int trpc_bench_echo_rpc(const void* data, size_t len, int iters,
   return 0;
 }
 
+// Sender-owned zero-copy staging (net/ici_transport.h): registered,
+// shm-published payload memory the ICI ring ships WITHOUT its DMA copy —
+// one descriptor per payload, receiver wraps the bytes in place.  Python
+// views the slab via np.frombuffer and lands device fetches in it; see
+// bench.py's tpu_rpc leg.
+void* trpc_ici_staging_alloc(size_t len, uint32_t* ordinal_out) {
+  return ici_staging_alloc(len, ordinal_out);
+}
+
+void trpc_ici_staging_free(void* base) { ici_staging_free(base); }
+
+void trpc_ici_zero_copy_counters(uint64_t* wrs, uint64_t* bytes) {
+  ici_zero_copy_counters(wrs, bytes);
+}
+
 // Full-option channel creation including the transport: "tcp", "shm",
 // "ici".  conn_type as trpc_channel_create_ex.
 void* trpc_channel_create_transport(const char* addr, int64_t timeout_ms,

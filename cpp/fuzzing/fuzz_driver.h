@@ -1,0 +1,132 @@
+// Shared driver for the fuzz targets (reference: test/fuzzing/*.cpp +
+// oss-fuzz.sh).  Each target defines the libFuzzer ABI:
+//
+//   extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t n);
+//
+// Built with a real fuzzer engine (clang -fsanitize=fuzzer
+// -DTRPC_LIBFUZZER), the engine drives it.  On this image (gcc, no
+// libFuzzer) the fallback main() below replays every file in the seed
+// corpus directory (argv[1]) verbatim, then runs a deterministic
+// structure-aware mutation loop over the seeds — the same harness the
+// ASan/TSan CI configs execute, so corpus regressions gate every build.
+#pragma once
+
+#include <dirent.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <vector>
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size);
+
+#ifndef TRPC_LIBFUZZER
+
+namespace trpc_fuzz {
+
+inline uint64_t& rng_state() {
+  static uint64_t s = 0x9e3779b97f4a7c15ull;  // fixed seed: repeatable
+  return s;
+}
+
+inline uint64_t rng() {
+  uint64_t& s = rng_state();
+  s ^= s << 13;
+  s ^= s >> 7;
+  s ^= s << 17;
+  return s;
+}
+
+inline std::string mutate(const std::string& base) {
+  std::string m = base;
+  switch (rng() % 6) {
+    case 0:  // bit flips
+      for (int i = 0; i < 1 + static_cast<int>(rng() % 8); ++i) {
+        if (!m.empty()) {
+          m[rng() % m.size()] ^= static_cast<char>(1 << (rng() % 8));
+        }
+      }
+      break;
+    case 1:  // truncate
+      m.resize(rng() % (m.size() + 1));
+      break;
+    case 2: {  // splice halves
+      const size_t cut = m.empty() ? 0 : rng() % m.size();
+      m = m.substr(cut) + m.substr(0, cut);
+      break;
+    }
+    case 3:  // stomp a 4-byte window with a hostile length
+      if (m.size() >= 4) {
+        const uint32_t evil =
+            (rng() % 2) ? 0xffffffffu : static_cast<uint32_t>(rng());
+        memcpy(m.data() + rng() % (m.size() - 3), &evil, 4);
+      }
+      break;
+    case 4:  // duplicate a slice
+      if (!m.empty()) {
+        const size_t at = rng() % m.size();
+        const size_t n = 1 + rng() % std::min<size_t>(64, m.size() - at);
+        m.insert(at, m.substr(at, n));
+      }
+      break;
+    default:  // random garbage byte run
+      for (int i = 0; i < 4; ++i) {
+        m.push_back(static_cast<char>(rng()));
+      }
+      break;
+  }
+  return m;
+}
+
+inline int drive(int argc, char** argv, int mutations_per_seed = 20000) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <corpus_dir> [mutations_per_seed]\n",
+            argv[0]);
+    return 2;
+  }
+  if (argc > 2) {
+    mutations_per_seed = atoi(argv[2]);
+  }
+  std::vector<std::string> seeds;
+  DIR* d = opendir(argv[1]);
+  if (d == nullptr) {
+    fprintf(stderr, "cannot open corpus dir %s\n", argv[1]);
+    return 2;
+  }
+  while (dirent* e = readdir(d)) {
+    if (e->d_name[0] == '.') {
+      continue;
+    }
+    std::ifstream f(std::string(argv[1]) + "/" + e->d_name,
+                    std::ios::binary);
+    seeds.emplace_back(std::istreambuf_iterator<char>(f),
+                       std::istreambuf_iterator<char>());
+  }
+  closedir(d);
+  if (seeds.empty()) {
+    fprintf(stderr, "empty corpus dir %s\n", argv[1]);
+    return 2;
+  }
+  // 1. Replay every seed verbatim (regression corpus).
+  for (const std::string& s : seeds) {
+    LLVMFuzzerTestOneInput(reinterpret_cast<const uint8_t*>(s.data()),
+                           s.size());
+  }
+  // 2. Deterministic mutation sweep.
+  for (int i = 0; i < mutations_per_seed; ++i) {
+    const std::string input = mutate(seeds[rng() % seeds.size()]);
+    LLVMFuzzerTestOneInput(reinterpret_cast<const uint8_t*>(input.data()),
+                           input.size());
+  }
+  printf("%zu seeds + %d mutations: ok\n", seeds.size(),
+         mutations_per_seed);
+  return 0;
+}
+
+}  // namespace trpc_fuzz
+
+int main(int argc, char** argv) { return trpc_fuzz::drive(argc, argv); }
+
+#endif  // !TRPC_LIBFUZZER

@@ -1,0 +1,28 @@
+// libFuzzer target: HPACK header-block decoding incl. huffman
+// (reference fuzz_hpack).
+#include "net/hpack.h"
+
+#include "fuzzing/fuzz_driver.h"
+
+using namespace trpc;
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  {
+    HpackDecoder dec;
+    HeaderList out;
+    (void)dec.decode(data, size, &out);  // must terminate, never overread
+  }
+  {
+    std::string plain;
+    (void)hpack_huffman_decode(data, size, &plain);
+  }
+  if (size >= 1) {
+    const uint8_t* p = data;
+    uint64_t v = 0;
+    (void)hpack_decode_int(&p, data + size, 5, &v);
+    if (p > data + size) {
+      __builtin_trap();  // decoder ran past the buffer
+    }
+  }
+  return 0;
+}

@@ -1,0 +1,23 @@
+// libFuzzer target: the HTTP/1.x request parser (reference fuzz_http).
+#include "base/iobuf.h"
+#include "net/http_message.h"
+
+#include "fuzzing/fuzz_driver.h"
+
+using namespace trpc;
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  IOBuf buf;
+  buf.append(data, size);
+  HttpRequest req;
+  IOBuf body;
+  const size_t before = buf.size();
+  const ParseError rc = http_parse_request(&buf, &req, &body);
+  if (rc == ParseError::kNotEnoughData && buf.size() != before) {
+    __builtin_trap();
+  }
+  if (buf.size() > before) {
+    __builtin_trap();
+  }
+  return 0;
+}

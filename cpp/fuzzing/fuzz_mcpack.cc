@@ -1,0 +1,20 @@
+// libFuzzer target: mcpack_v2 value parser (base/mcpack.h).
+#include "base/mcpack.h"
+
+#include "fuzzing/fuzz_driver.h"
+
+using namespace trpc;
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  McpackValue v;
+  size_t consumed = 0;
+  if (McpackValue::parse(reinterpret_cast<const char*>(data), size, &v,
+                         &consumed)) {
+    if (consumed > size) {
+      __builtin_trap();  // parser claimed bytes past the buffer
+    }
+    // Parse success implies serializability (the tree is well-formed).
+    (void)v.serialize();
+  }
+  return 0;
+}

@@ -1,0 +1,25 @@
+// libFuzzer target: protobuf wire-format parser + schemaless JSON walk
+// (reference fuzz_json, fuzz_uncompress analogues).
+#include <string>
+
+#include "base/pbwire.h"
+
+#include "fuzzing/fuzz_driver.h"
+
+using namespace trpc;
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  const std::string input(reinterpret_cast<const char*>(data), size);
+  PbMessage m;
+  if (m.parse(input)) {
+    // Parse success implies a semantic fixpoint under re-serialization.
+    const std::string round = m.serialize();
+    PbMessage m2;
+    if (!m2.parse(round) || m2.fields().size() != m.fields().size() ||
+        m2.serialize() != round) {
+      __builtin_trap();
+    }
+    (void)pb_to_json_schemaless(m);  // must terminate on any parse
+  }
+  return 0;
+}

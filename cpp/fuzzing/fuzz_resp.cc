@@ -1,0 +1,31 @@
+// libFuzzer target: RESP command + reply parsers (reference fuzz_redis).
+#include <string>
+#include <vector>
+
+#include "net/redis.h"
+
+#include "fuzzing/fuzz_driver.h"
+
+using namespace trpc;
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  const std::string input(reinterpret_cast<const char*>(data), size);
+  {
+    std::vector<std::string> args;
+    size_t pos = 0;
+    const int rc = resp_parse_command(input, &pos, &args);
+    if (rc < -1 || rc > 1 || (rc == 1 && pos > input.size()) ||
+        (rc != 1 && pos != 0)) {
+      __builtin_trap();
+    }
+  }
+  {
+    RedisReply reply;
+    size_t pos = 0;
+    const int rc = resp_parse_reply(input, &pos, &reply);
+    if (rc < -1 || rc > 1 || (rc == 1 && pos > input.size())) {
+      __builtin_trap();
+    }
+  }
+  return 0;
+}

@@ -1,0 +1,16 @@
+// libFuzzer target: framed-thrift payload parser (reference
+// fuzz_butil/thrift analogue).
+#include <string>
+
+#include "net/thrift.h"
+
+#include "fuzzing/fuzz_driver.h"
+
+using namespace trpc;
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  const std::string input(reinterpret_cast<const char*>(data), size);
+  ThriftMessage m;
+  (void)thrift_parse_payload(input, &m);  // terminate, never crash/overread
+  return 0;
+}

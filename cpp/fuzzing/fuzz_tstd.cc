@@ -1,0 +1,23 @@
+// libFuzzer target: the tstd frame parser (reference fuzz_baidu_std).
+#include "base/iobuf.h"
+#include "net/protocol.h"
+
+#include "fuzzing/fuzz_driver.h"
+
+using namespace trpc;
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  IOBuf buf;
+  buf.append(data, size);
+  InputMessage msg;
+  const size_t before = buf.size();
+  const ParseError rc = tstd_protocol().parse(&buf, &msg, nullptr);
+  // Invariants: never consume on NotEnoughData; never grow the buffer.
+  if (rc == ParseError::kNotEnoughData && buf.size() != before) {
+    __builtin_trap();
+  }
+  if (buf.size() > before) {
+    __builtin_trap();
+  }
+  return 0;
+}

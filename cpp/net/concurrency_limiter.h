@@ -138,6 +138,53 @@ class AutoLimiter final : public ConcurrencyLimiter {
   std::atomic<int64_t> samples_{0};
 };
 
+// Third limiter kind (parity: policy/timeout_concurrency_limiter.h):
+// admits a request only while the QUEUEING estimate — in-flight depth x
+// recent average latency — still fits the configured timeout budget, so
+// requests that would blow their deadline anyway are rejected up front
+// instead of wasting a slot timing out.  Condensed: the reference keeps a
+// sampling window + adjusted average; ours keeps an EMA that errors
+// (timeouts) also feed, which is what inflates the estimate under
+// overload and closes the gate.
+class TimeoutLimiter final : public ConcurrencyLimiter {
+ public:
+  explicit TimeoutLimiter(int64_t timeout_ms)
+      : timeout_us_(timeout_ms * 1000) {}
+
+  bool on_request() override {
+    const int64_t avg = avg_latency_us_.load(std::memory_order_acquire);
+    const int64_t depth =
+        inflight_.fetch_add(1, std::memory_order_acq_rel) + 1;
+    if (avg > 0 && depth * avg > timeout_us_) {
+      inflight_.fetch_sub(1, std::memory_order_acq_rel);
+      return false;
+    }
+    return true;
+  }
+
+  void on_response(int64_t latency_us, bool /*error*/) override {
+    // Errors sample too: a wave of timeouts must RAISE the estimate.
+    inflight_.fetch_sub(1, std::memory_order_acq_rel);
+    if (latency_us <= 0) {
+      return;
+    }
+    int64_t avg = avg_latency_us_.load(std::memory_order_relaxed);
+    avg = avg == 0 ? latency_us : (avg * 7 + latency_us) / 8;
+    avg_latency_us_.store(avg, std::memory_order_relaxed);
+  }
+
+  int64_t current_limit() const override {
+    const int64_t avg = avg_latency_us_.load(std::memory_order_acquire);
+    return avg > 0 ? std::max<int64_t>(1, timeout_us_ / avg)
+                   : INT64_MAX;  // no samples yet: unbounded
+  }
+
+ private:
+  const int64_t timeout_us_;
+  std::atomic<int64_t> inflight_{0};
+  std::atomic<int64_t> avg_latency_us_{0};
+};
+
 // Returns {ok, limiter}: ok=false means the spec was unparseable (distinct
 // from ""/unlimited so callers can reject typos instead of silently
 // removing a limit).
@@ -148,6 +195,14 @@ parse_concurrency_spec(const std::string& spec) {
   }
   if (spec == "auto") {
     return {true, std::make_unique<AutoLimiter>()};
+  }
+  if (spec.rfind("timeout:", 0) == 0) {
+    char* end = nullptr;
+    const long ms = strtol(spec.c_str() + 8, &end, 10);
+    if (end == spec.c_str() + 8 || *end != '\0' || ms <= 0) {
+      return {false, nullptr};
+    }
+    return {true, std::make_unique<TimeoutLimiter>(ms)};
   }
   char* end = nullptr;
   const long n = strtol(spec.c_str(), &end, 10);

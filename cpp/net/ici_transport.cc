@@ -9,9 +9,11 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <array>
 #include <atomic>
 #include <cstring>
 #include <deque>
+#include <map>
 #include <mutex>
 #include <vector>
 
@@ -104,6 +106,67 @@ void slab_unregister_tramp(void* base, size_t len, void*, uint64_t handle) {
     r.unreg(base, len, r.ctx, handle);
   }
   registered_slabs().fetch_sub(1, std::memory_order_relaxed);
+}
+
+// ---- sender-owned staging slabs ------------------------------------------
+// Registered, shm-published payload memory.  Descriptor meta encoding:
+// bit 63 = sender-owned; bits 40..59 = slab ordinal; bits 0..39 = offset.
+// Normal (posted-block) metas are (slab<<32)|offset with slab < 64, so
+// bit 63 is never set on them.
+
+constexpr uint64_t kStageBit = 1ull << 63;
+constexpr uint64_t kStageOffsetMask = (1ull << 40) - 1;
+
+inline uint64_t stage_meta(uint32_t ordinal, uint64_t offset) {
+  return kStageBit | (static_cast<uint64_t>(ordinal) << 40) |
+         (offset & kStageOffsetMask);
+}
+
+struct StagingSlab {
+  char* base = nullptr;
+  size_t len = 0;
+  uint32_t ordinal = 0;
+  uint64_t reg_handle = 0;
+  std::string name;
+};
+
+std::mutex& stage_mu() {
+  static std::mutex* mu = new std::mutex();
+  return *mu;
+}
+std::vector<StagingSlab>& stage_slabs() {
+  static auto* v = new std::vector<StagingSlab>();
+  return *v;
+}
+std::atomic<uint64_t>& zc_wrs_total() {
+  static auto* n = new std::atomic<uint64_t>(0);
+  return *n;
+}
+std::atomic<uint64_t>& zc_bytes_total() {
+  static auto* n = new std::atomic<uint64_t>(0);
+  return *n;
+}
+
+std::string stage_shm_name(int pid, uint32_t ordinal) {
+  char name[64];
+  snprintf(name, sizeof(name), "/trpc_stage_%d_%u", pid, ordinal);
+  return name;
+}
+
+// Is [p, p+len) inside one of THIS process's staging slabs?  Fills
+// *ordinal/*offset when so.  Linear scan: slab count is tiny and this
+// only runs once per multi-KB WR.
+bool staging_of(const char* p, size_t len, uint32_t* ordinal,
+                uint64_t* offset) {
+  std::lock_guard<std::mutex> g(stage_mu());
+  for (const StagingSlab& s : stage_slabs()) {
+    if (s.base != nullptr && p >= s.base && p + len <= s.base + s.len) {
+      *ordinal = s.ordinal;
+      *offset = static_cast<uint64_t>(p - s.base);
+      return true;
+    }
+  }
+  return false;
 }
 
 // ---- shared control segment ---------------------------------------------
@@ -205,7 +268,10 @@ struct IciConn {
 
   // Local send queue: the writer fiber posts WRs (each ≤ block_size bytes
   // of IOBuf refs, uncopied); the poller is the DMA engine.  SPSC.
+  // sq_meta parallels sq: 0 = copy-mode WR; a kStageBit-tagged value =
+  // sender-owned zero-copy WR (the whole payload in one descriptor).
   std::vector<IOBuf> sq;
+  std::vector<uint64_t> sq_meta;
   alignas(64) std::atomic<uint64_t> sq_head{0};  // writer bumps
   alignas(64) std::atomic<uint64_t> sq_tail{0};  // poller bumps
   // DMA'd-but-uncompleted source refs, indexed by descriptor slot
@@ -217,11 +283,28 @@ struct IciConn {
   // Receive staging the read fiber drains (poller appends wrapped blocks).
   std::mutex rx_mu;
   IOBuf rx_pending;
-  uint64_t rx_desc_tail = 0;  // poller-local
+  uint64_t rx_desc_tail = 0;  // poller-local: descriptors wrapped
+  uint64_t rx_ack = 0;        // poller-local: desc_consumed published
+  // Deferred-ack flags, desc index & mask.  Copy-mode descs release at
+  // wrap time; sender-owned descs release when the consumer's last IOBuf
+  // ref drops (any thread — hence atomics + shared_ptr lifetime).
+  std::shared_ptr<std::array<std::atomic<uint8_t>, kIciMaxSlots>>
+      rx_released =
+          std::make_shared<std::array<std::atomic<uint8_t>, kIciMaxSlots>>();
+  // Peer staging slabs mapped on first reference (poller-owned).
+  // `owned`: we mmap'd it and must munmap; loopback entries alias the
+  // process-local registry mapping and must NOT be unmapped.
+  struct StageMap {
+    char* base = nullptr;
+    size_t len = 0;
+    bool owned = false;
+  };
+  std::map<uint32_t, StageMap> stage_maps;
 
   // Stats.
   std::atomic<uint64_t> tx_wrs{0}, rx_wrs{0}, tx_bytes{0}, rx_bytes{0};
   std::atomic<uint64_t> window_exhausted{0};
+  std::atomic<uint64_t> tx_zc_wrs{0}, tx_zc_bytes{0}, rx_zc_wrs{0};
 
   IciDir& tx_dir() { return is_client ? seg->c2s : seg->s2c; }
   IciDir& rx_dir() { return is_client ? seg->s2c : seg->c2s; }
@@ -259,6 +342,12 @@ struct IciConn {
         munmap(m, tx_slab_len);
       }
     }
+    for (auto& [ord, slab] : stage_maps) {
+      (void)ord;
+      if (slab.base != nullptr && slab.owned) {
+        munmap(slab.base, slab.len);
+      }
+    }
     if (seg != nullptr) {
       munmap(seg, sizeof(IciSegment));
     }
@@ -286,6 +375,81 @@ void rx_block_deleter(void*, void* vctx) {
   ctx->rx->wrapped.fetch_sub(1, std::memory_order_relaxed);
   ctx->block->release();  // back to the arena free list
   delete ctx;
+}
+
+// Deleter context for a wrapped SENDER-OWNED range: acking the descriptor
+// (flipping its released flag) is deferred to the moment the consumer's
+// last reference drops — the sender must not reuse its staging bytes
+// earlier.  Holds the flag array alive independently of the connection.
+struct RxStageCtx {
+  std::shared_ptr<std::array<std::atomic<uint8_t>, kIciMaxSlots>> released;
+  uint32_t slot;
+};
+
+void rx_stage_deleter(void*, void* vctx) {
+  auto* ctx = static_cast<RxStageCtx*>(vctx);
+  ctx->released->at(ctx->slot).store(1, std::memory_order_release);
+  delete ctx;
+}
+
+// Maps the peer's staging slab `ordinal` on first reference (bounded to
+// keep a hostile peer from exhausting mappings); validates the range.
+char* resolve_stage_source(IciConn& c, uint32_t ordinal, uint64_t offset,
+                           uint32_t len) {
+  auto it = c.stage_maps.find(ordinal);
+  if (it == c.stage_maps.end()) {
+    if (c.stage_maps.size() >= 1024) {
+      return nullptr;
+    }
+    const int32_t pid = c.peer_pid();
+    if (pid == 0) {
+      return nullptr;
+    }
+    if (pid == getpid()) {
+      // Loopback: the peer's staging slab IS ours — alias the registry
+      // mapping directly (same virtual address), which also lets an echo
+      // response ride the zero-copy path back out.
+      std::lock_guard<std::mutex> g(stage_mu());
+      for (const StagingSlab& s : stage_slabs()) {
+        if (s.ordinal == ordinal) {
+          it = c.stage_maps
+                   .emplace(ordinal, IciConn::StageMap{s.base, s.len, false})
+                   .first;
+          break;
+        }
+      }
+      if (it == c.stage_maps.end()) {
+        return nullptr;
+      }
+    } else {
+      const std::string name = stage_shm_name(pid, ordinal);
+      const int fd = shm_open(name.c_str(), O_RDWR, 0600);
+      if (fd < 0) {
+        return nullptr;
+      }
+      struct stat st;
+      if (fstat(fd, &st) != 0 || st.st_size <= 0) {
+        close(fd);
+        return nullptr;
+      }
+      void* mem = mmap(nullptr, static_cast<size_t>(st.st_size),
+                       PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+      close(fd);
+      if (mem == MAP_FAILED) {
+        return nullptr;
+      }
+      it = c.stage_maps
+               .emplace(ordinal,
+                        IciConn::StageMap{static_cast<char*>(mem),
+                                          static_cast<size_t>(st.st_size),
+                                          true})
+               .first;
+    }
+  }
+  if (len == 0 || offset + len > it->second.len) {
+    return nullptr;
+  }
+  return it->second.base + offset;
 }
 
 // Publishes a freshly-grown slab's shm name so the peer can map it.
@@ -430,38 +594,89 @@ class IciPoller {
     // backpressure bound).
     IciDir& rxd = c.rx_dir();
     const uint64_t rx_head = rxd.desc_head.load(std::memory_order_acquire);
+    // desc_head is peer-writable: legitimately it never runs more than
+    // `slots` past our ack cursor (the sender's own window check).  A
+    // hostile overrun must die HERE — stage-mode descs skip the
+    // posted_fifo check that used to bound copy-mode overruns, so without
+    // this the drain loop is an unbounded-work/OOM primitive.
+    if (rx_head - c.rx_ack > c.slots) {
+      *dead = true;
+      return moved;
+    }
     if (rx_head != c.rx_desc_tail) {
       std::lock_guard<std::mutex> g(c.rx_mu);
       while (c.rx_desc_tail != rx_head) {
         const IciDesc d = rxd.desc_ring[c.rx_desc_tail & mask];
-        if (c.posted_fifo.empty() || d.len > c.block_size) {
-          *dead = true;
-          return moved;
-        }
-        Block* b = c.posted_fifo.front();
-        if (d.meta != b->user_meta) {
-          *dead = true;  // descriptor does not match the claimed post
-          return moved;
-        }
-        c.posted_fifo.pop_front();
-        auto* ctx = new RxBlockCtx{c.rx, b};
-        c.rx->wrapped.fetch_add(1, std::memory_order_relaxed);
-        c.rx_pending.append_user_data(b->data, d.len, &rx_block_deleter,
-                                      ctx, b->user_meta);
-        c.rx_wrs.fetch_add(1, std::memory_order_relaxed);
-        c.rx_bytes.fetch_add(d.len, std::memory_order_relaxed);
-        ++c.rx_desc_tail;
-        rxd.desc_consumed.store(c.rx_desc_tail, std::memory_order_release);
-        bool fatal = false;
-        if (!post_one_block(c, &fatal)) {
-          if (fatal) {
+        const uint32_t slot = static_cast<uint32_t>(c.rx_desc_tail & mask);
+        if (d.meta & kStageBit) {
+          // Sender-owned range: wrap the peer's staging bytes zero-copy;
+          // the descriptor acks (released flag) only when the consumer's
+          // last reference drops.
+          const uint32_t ord =
+              static_cast<uint32_t>((d.meta >> 40) & 0xFFFFF);
+          char* src =
+              resolve_stage_source(c, ord, d.meta & kStageOffsetMask, d.len);
+          if (src == nullptr) {
             *dead = true;
             return moved;
           }
-          ++c.repost_deficit;  // pool exhausted; retry when blocks return
+          if (c.rx_desc_tail - c.rx_ack >= c.slots / 2) {
+            // Backlog valve: acks are strictly in-order, so a frame whose
+            // refs only drop once it is COMPLETE must never need more
+            // deferred-ack descriptors than the window holds.  Past half
+            // the window, copy-and-ack keeps the stream moving (zero-copy
+            // degrades, correctness doesn't).
+            c.rx_pending.append(src, d.len);
+            c.rx_released->at(slot).store(1, std::memory_order_release);
+          } else {
+            auto* ctx = new RxStageCtx{c.rx_released, slot};
+            c.rx_pending.append_user_data(src, d.len, &rx_stage_deleter,
+                                          ctx, d.meta);
+            c.rx_zc_wrs.fetch_add(1, std::memory_order_relaxed);
+          }
+        } else {
+          if (c.posted_fifo.empty() || d.len > c.block_size) {
+            *dead = true;
+            return moved;
+          }
+          Block* b = c.posted_fifo.front();
+          if (d.meta != b->user_meta) {
+            *dead = true;  // descriptor does not match the claimed post
+            return moved;
+          }
+          c.posted_fifo.pop_front();
+          auto* ctx = new RxBlockCtx{c.rx, b};
+          c.rx->wrapped.fetch_add(1, std::memory_order_relaxed);
+          c.rx_pending.append_user_data(b->data, d.len, &rx_block_deleter,
+                                        ctx, b->user_meta);
+          // Copy-mode descs ack at wrap (block reuse is governed by the
+          // pool re-post cycle, as before).
+          c.rx_released->at(slot).store(1, std::memory_order_release);
+          bool fatal = false;
+          if (!post_one_block(c, &fatal)) {
+            if (fatal) {
+              *dead = true;
+              return moved;
+            }
+            ++c.repost_deficit;  // pool exhausted; retry when blocks return
+          }
         }
+        c.rx_wrs.fetch_add(1, std::memory_order_relaxed);
+        c.rx_bytes.fetch_add(d.len, std::memory_order_relaxed);
+        ++c.rx_desc_tail;
       }
       *rx_edge = true;
+      moved = true;
+    }
+    // Publish desc_consumed over the contiguous released prefix.  Acks
+    // are strictly in-order: a held sender-owned range stalls later acks
+    // (and thus the sender's window) — end-to-end backpressure.
+    while (c.rx_ack < c.rx_desc_tail &&
+           c.rx_released->at(c.rx_ack & mask).load(
+               std::memory_order_acquire) != 0) {
+      c.rx_released->at(c.rx_ack & mask).store(0, std::memory_order_relaxed);
+      ++c.rx_ack;
+      rxd.desc_consumed.store(c.rx_ack, std::memory_order_release);
       moved = true;
     }
 
@@ -499,39 +714,58 @@ class IciPoller {
       moved = true;
     }
 
-    // 3. TX DMA engine: drain the send queue while the window is open —
-    // a posted peer block (credit) AND a free descriptor slot.
+    // 3. TX DMA engine: drain the send queue while the window is open.
+    // Copy-mode WRs need a posted peer block (credit) AND a descriptor
+    // slot; sender-owned WRs need only the descriptor slot (their bytes
+    // already live in a registered staging slab the peer maps directly).
     const uint64_t sq_head = c.sq_head.load(std::memory_order_acquire);
     uint64_t sq_tail = c.sq_tail.load(std::memory_order_relaxed);
     if (sq_tail != sq_head) {
       const uint64_t post_head =
           txd.post_head.load(std::memory_order_acquire);
       uint64_t desc_head = txd.desc_head.load(std::memory_order_relaxed);
-      while (sq_tail != sq_head && c.post_tail != post_head &&
-             desc_head - consumed < c.slots) {
+      while (sq_tail != sq_head && desc_head - consumed < c.slots) {
         IOBuf& wr = c.sq[sq_tail & mask];
-        const uint64_t target_meta = txd.post_ring[c.post_tail & mask];
+        const uint64_t wr_meta = c.sq_meta[sq_tail & mask];
         const uint32_t len = static_cast<uint32_t>(wr.size());
-        char* dst = resolve_tx_target(c, target_meta, len);
-        if (dst == nullptr) {
-          *dead = true;
-          return moved;
+        if (wr_meta & kStageBit) {
+          // Zero-copy publish: descriptor names our staging slab range.
+          IciDesc& slot = txd.desc_ring[desc_head & mask];
+          slot.meta = wr_meta;
+          slot.len = len;
+          c.sbuf[desc_head & mask] = std::move(wr);
+          ++desc_head;
+          txd.desc_head.store(desc_head, std::memory_order_release);
+          c.tx_zc_wrs.fetch_add(1, std::memory_order_relaxed);
+          c.tx_zc_bytes.fetch_add(len, std::memory_order_relaxed);
+          zc_wrs_total().fetch_add(1, std::memory_order_relaxed);
+          zc_bytes_total().fetch_add(len, std::memory_order_relaxed);
+        } else {
+          if (c.post_tail == post_head) {
+            break;  // no posted-block credit for a copy-mode WR
+          }
+          const uint64_t target_meta = txd.post_ring[c.post_tail & mask];
+          char* dst = resolve_tx_target(c, target_meta, len);
+          if (dst == nullptr) {
+            *dead = true;
+            return moved;
+          }
+          // The DMA: gather the WR's refs into the peer's posted block.
+          size_t off = 0;
+          for (size_t i = 0; i < wr.block_count(); ++i) {
+            const IOBuf::BlockRef& ref = wr.ref_at(i);
+            memcpy(dst + off, ref.block->data + ref.offset, ref.length);
+            off += ref.length;
+          }
+          // Publish the descriptor; hold the source refs until completion.
+          IciDesc& slot = txd.desc_ring[desc_head & mask];
+          slot.meta = target_meta;
+          slot.len = len;
+          c.sbuf[desc_head & mask] = std::move(wr);
+          ++desc_head;
+          txd.desc_head.store(desc_head, std::memory_order_release);
+          ++c.post_tail;
         }
-        // The DMA: gather the WR's refs into the peer's posted block.
-        size_t off = 0;
-        for (size_t i = 0; i < wr.block_count(); ++i) {
-          const IOBuf::BlockRef& ref = wr.ref_at(i);
-          memcpy(dst + off, ref.block->data + ref.offset, ref.length);
-          off += ref.length;
-        }
-        // Publish the descriptor; hold the source refs until completion.
-        IciDesc& slot = txd.desc_ring[desc_head & mask];
-        slot.meta = target_meta;
-        slot.len = len;
-        c.sbuf[desc_head & mask] = std::move(wr);
-        ++desc_head;
-        txd.desc_head.store(desc_head, std::memory_order_release);
-        ++c.post_tail;
         ++sq_tail;
         c.tx_wrs.fetch_add(1, std::memory_order_relaxed);
         c.tx_bytes.fetch_add(len, std::memory_order_relaxed);
@@ -681,9 +915,58 @@ class IciRingTransport final : public Transport {
         break;
       }
       IOBuf& wr = c->sq[head & mask];
-      const size_t n = from->cutn(&wr, c->block_size);
+      uint64_t meta = 0;
+      // Zero-copy fast path: a front ref living inside one of OUR
+      // registered staging slabs ships as a single sender-owned
+      // descriptor (whole ref, not block_size chunks) with no ring DMA.
+      // The user_deleter pre-filter keeps ordinary arena blocks off the
+      // registry mutex.
+      const IOBuf::BlockRef& r0 = from->ref_at(0);
+      uint32_t ord = 0;
+      uint64_t off = 0;
+      if (r0.length >= 4096 && r0.block->user_deleter != nullptr &&
+          staging_of(r0.block->data + r0.offset, r0.length, &ord, &off)) {
+        total += from->cutn(&wr, r0.length);
+        // Coalesce CONTIGUOUS staging refs into this one descriptor: a
+        // parser that sliced a big staged payload into read-chunk pieces
+        // (consecutive refs of one slab range) must not fan out into
+        // per-piece descriptors — descs are acked in order only when the
+        // whole frame's refs drop, so a frame needing more descs than
+        // the ring has slots would deadlock the window (r5: 16MB+ echo
+        // responses arrived as 512KB slices).
+        uint64_t end = off + r0.length;
+        while (!from->empty() && wr.size() < (1ull << 31)) {
+          const IOBuf::BlockRef& rn = from->ref_at(0);
+          uint32_t ord2 = 0;
+          uint64_t off2 = 0;
+          if (rn.block->user_deleter == nullptr ||
+              !staging_of(rn.block->data + rn.offset, rn.length, &ord2,
+                          &off2) ||
+              ord2 != ord || off2 != end) {
+            break;
+          }
+          total += from->cutn(&wr, rn.length);
+          end += rn.length;
+        }
+        meta = stage_meta(ord, off);
+      } else {
+        // Align the cut so a staging ref BEHIND a small header ref stays
+        // whole for the next iteration's zero-copy publish, instead of
+        // having its front chopped into this copy-mode WR.
+        size_t n = c->block_size;
+        if (r0.length < c->block_size && from->block_count() > 1) {
+          const IOBuf::BlockRef& r1 = from->ref_at(1);
+          uint32_t o2 = 0;
+          uint64_t f2 = 0;
+          if (r1.length >= 4096 && r1.block->user_deleter != nullptr &&
+              staging_of(r1.block->data + r1.offset, r1.length, &o2, &f2)) {
+            n = r0.length;
+          }
+        }
+        total += from->cutn(&wr, n);
+      }
+      c->sq_meta[head & mask] = meta;
       c->sq_head.store(head + 1, std::memory_order_release);
-      total += n;
     }
     return static_cast<ssize_t>(total);
   }
@@ -730,6 +1013,7 @@ bool build_rx_side(IciConn& c) {
   c.rx = std::make_shared<IciRx>();
   c.rx->arena.reset(new DeviceArena(aopts));
   c.sq.resize(c.slots);
+  c.sq_meta.assign(c.slots, 0);
   c.sbuf.resize(c.slots);
   c.tx_slab_len = static_cast<size_t>(c.block_size) * c.slots;
   for (uint32_t i = 0; i < c.slots; ++i) {
@@ -778,6 +1062,75 @@ void ici_set_slab_registrar(int (*reg)(void*, size_t, void*, uint64_t*),
                             void* ctx) {
   std::lock_guard<std::mutex> g(reg_mu());
   registrar() = Registrar{reg, unreg, ctx};
+}
+
+void* ici_staging_alloc(size_t len, uint32_t* ordinal_out) {
+  if (len == 0 || len > kStageOffsetMask) {
+    return nullptr;
+  }
+  static std::atomic<uint32_t> next_ord{0};
+  const uint32_t ord = next_ord.fetch_add(1, std::memory_order_relaxed);
+  if (ord >= (1u << 20)) {
+    return nullptr;  // meta encoding holds 20 ordinal bits
+  }
+  const std::string name = stage_shm_name(getpid(), ord);
+  const int fd = shm_open(name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) {
+    return nullptr;
+  }
+  if (ftruncate(fd, static_cast<off_t>(len)) != 0) {
+    close(fd);
+    shm_unlink(name.c_str());
+    return nullptr;
+  }
+  void* mem =
+      mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name.c_str());
+    return nullptr;
+  }
+  uint64_t handle = 0;
+  if (slab_register_tramp(mem, len, nullptr, &handle) != 0) {
+    munmap(mem, len);
+    shm_unlink(name.c_str());
+    return nullptr;
+  }
+  std::lock_guard<std::mutex> g(stage_mu());
+  stage_slabs().push_back(
+      StagingSlab{static_cast<char*>(mem), len, ord, handle, name});
+  if (ordinal_out != nullptr) {
+    *ordinal_out = ord;
+  }
+  return mem;
+}
+
+void ici_staging_free(void* base) {
+  StagingSlab victim;
+  {
+    std::lock_guard<std::mutex> g(stage_mu());
+    auto& v = stage_slabs();
+    auto it = std::find_if(v.begin(), v.end(), [base](const StagingSlab& s) {
+      return s.base == base;
+    });
+    if (it == v.end()) {
+      return;
+    }
+    victim = *it;
+    v.erase(it);
+  }
+  slab_unregister_tramp(victim.base, victim.len, nullptr, victim.reg_handle);
+  munmap(victim.base, victim.len);
+  shm_unlink(victim.name.c_str());
+}
+
+void ici_zero_copy_counters(uint64_t* wrs, uint64_t* bytes) {
+  if (wrs != nullptr) {
+    *wrs = zc_wrs_total().load(std::memory_order_relaxed);
+  }
+  if (bytes != nullptr) {
+    *bytes = zc_bytes_total().load(std::memory_order_relaxed);
+  }
 }
 
 size_t ici_registered_slab_count() {
@@ -906,6 +1259,9 @@ IciConnStats ici_conn_stats(const IciConn& c) {
   s.sbuf_held = txd.desc_head.load(std::memory_order_acquire) -
                 txd.desc_consumed.load(std::memory_order_acquire);
   s.rx_unposted = c.rx->wrapped.load(std::memory_order_relaxed);
+  s.tx_zero_copy_wrs = c.tx_zc_wrs.load(std::memory_order_relaxed);
+  s.tx_zero_copy_bytes = c.tx_zc_bytes.load(std::memory_order_relaxed);
+  s.rx_zero_copy_wrs = c.rx_zc_wrs.load(std::memory_order_relaxed);
   s.slots = c.slots;
   s.block_size = c.block_size;
   return s;

@@ -79,6 +79,27 @@ bool ici_set_ring_geometry(uint32_t block_size, uint32_t slots,
 void ici_get_ring_geometry(uint32_t* block_size, uint32_t* slots,
                            uint32_t* max_blocks);
 
+// ---- sender-owned zero-copy staging (block_pool takeover parity) --------
+// A staging slab is shm-backed, registered through the same registrar seam
+// as receive windows, and published under a process-derivable name, so ANY
+// ici connection's peer can map it.  Payload bytes living in a staging
+// slab are sent WITHOUT the ring DMA copy: the sender publishes a
+// sender-owned descriptor {slab ordinal, offset, len} (one descriptor can
+// carry the whole payload, not block_size chunks) and the receiver wraps
+// the mapped bytes into its IOBuf zero-copy, acking the descriptor only
+// when the last reference drops — end-to-end zero-copy with end-to-end
+// backpressure.  The staging memory is the device→host DMA landing zone:
+// a PJRT pinned-host backend registers it for real DMA via the seam.
+// Returns the slab base (page-aligned) or nullptr; *ordinal_out names it
+// on the wire.  The caller must not reuse a region until the RPCs that
+// reference it completed (same contract as rdma send buffers).
+void* ici_staging_alloc(size_t len, uint32_t* ordinal_out);
+// Unmaps, unregisters and unlinks.  Safe only once no conn references it.
+void ici_staging_free(void* base);
+// Process-wide zero-copy send counters (bench/test assertions that the
+// staging path really elided the ring copy).
+void ici_zero_copy_counters(uint64_t* wrs, uint64_t* bytes);
+
 // Slab registration seam (block_pool::RegisterMemory parity): invoked once
 // per receive-window slab.  The default registrar records the slab in a
 // process-local table (handle = ordinal).  A real device backend (PJRT
@@ -100,6 +121,9 @@ struct IciConnStats {
   uint64_t window_exhausted = 0; // cut_from_iobuf hit a full window
   uint64_t sbuf_held = 0;        // send WRs DMA'd but not yet completed
   uint64_t rx_unposted = 0;      // recv blocks held by consumers (not posted)
+  uint64_t tx_zero_copy_wrs = 0;   // sender-owned descriptors published
+  uint64_t tx_zero_copy_bytes = 0; // bytes sent without the ring DMA copy
+  uint64_t rx_zero_copy_wrs = 0;   // sender-owned descriptors wrapped
   uint32_t slots = 0;
   uint32_t block_size = 0;
 };

@@ -338,6 +338,139 @@ TEST_CASE(ici_hostile_consumed_cursor_fails_socket_not_poller) {
   delete pair;
 }
 
+TEST_CASE(ici_staging_zero_copy_single_descriptor) {
+  fiber_init(0);
+  // A 1MB payload in a registered staging slab crosses the ring as ONE
+  // sender-owned descriptor — no window cycling (4KB x 4 slots would need
+  // ~256 cycles copy-mode), no ring DMA copy.
+  ici_set_ring_geometry(4096, 4);
+  // Earlier tests' failed sockets drain their arenas asynchronously;
+  // settle before sampling the baseline or the final check misreads.
+  size_t slabs_before = ici_registered_slab_count();
+  wait_until(
+      [&] {
+        const size_t now = ici_registered_slab_count();
+        if (now == slabs_before) {
+          return true;
+        }
+        slabs_before = now;
+        return false;
+      },
+      3000);
+  uint32_t ord = 0;
+  const size_t kLen = 1 << 20;
+  char* stage = static_cast<char*>(ici_staging_alloc(kLen, &ord));
+  EXPECT(stage != nullptr);
+  EXPECT_EQ(ici_registered_slab_count(), slabs_before + 1);
+  for (size_t i = 0; i < kLen; ++i) {
+    stage[i] = static_cast<char>(i * 31 + 5);
+  }
+  auto* pair = new RawPair();
+  EXPECT(pair->build());
+  pair->ssink.hold.store(true);  // keep refs: verify content + deferral
+  IOBuf out;
+  out.append_user_data(stage, kLen, [](void*, void*) {}, nullptr, 0);
+  {
+    SocketRef c(Socket::Address(pair->csock));
+    EXPECT_EQ(c->Write(std::move(out)), 0);
+  }
+  EXPECT(wait_until([&] { return pair->ssink.total.load() == kLen; }, 5000));
+  const IciConnStats cs = ici_conn_stats(*pair->client);
+  EXPECT_EQ(cs.tx_zero_copy_wrs, 1u);       // ONE descriptor for 1MB
+  EXPECT_EQ(cs.tx_zero_copy_bytes, kLen);
+  EXPECT_EQ(ici_conn_stats(*pair->server).rx_zero_copy_wrs, 1u);
+  {
+    LockGuard<FiberMutex> g(pair->ssink.mu);
+    EXPECT(pair->ssink.held.to_string() ==
+           std::string(stage, kLen));  // zero-copy content intact
+  }
+  // Deferred ack: while the receiver holds the wrapped range, the
+  // descriptor must NOT complete (sender staging is still referenced).
+  usleep(100 * 1000);
+  EXPECT_EQ(ici_conn_stats(*pair->client).sbuf_held, 1u);
+  {
+    LockGuard<FiberMutex> g(pair->ssink.mu);
+    pair->ssink.hold.store(false);
+    pair->ssink.held.clear();  // drop refs → deleter acks → sbuf drains
+  }
+  EXPECT(wait_until(
+      [&] { return ici_conn_stats(*pair->client).sbuf_held == 0; }, 2000));
+  ici_set_ring_geometry(64 * 1024, 16);
+  delete pair;
+  ici_staging_free(stage);
+  EXPECT(wait_until(
+      [&] { return ici_registered_slab_count() <= slabs_before; }, 5000));
+}
+
+TEST_CASE(ici_staging_rpc_echo_roundtrip_zero_copy) {
+  // Full RPC over the rings with a staged payload: request AND (loopback)
+  // response ride sender-owned descriptors; content verified end-to-end.
+  start_once();
+  uint64_t zc_wrs0 = 0, zc_bytes0 = 0;
+  ici_zero_copy_counters(&zc_wrs0, &zc_bytes0);
+  const size_t kLen = 2 << 20;
+  uint32_t ord = 0;
+  char* stage = static_cast<char*>(ici_staging_alloc(kLen, &ord));
+  EXPECT(stage != nullptr);
+  for (size_t i = 0; i < kLen; ++i) {
+    stage[i] = static_cast<char>(i * 131 + 7);
+  }
+  Channel ch;
+  Channel::Options opts;
+  opts.use_ici = true;
+  opts.timeout_ms = 10000;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(g_port), &opts), 0);
+  Controller cntl;
+  IOBuf req, resp;
+  req.append_user_data(stage, kLen, [](void*, void*) {}, nullptr, 0);
+  ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+  EXPECT(resp.to_string() == std::string(stage, kLen));
+  uint64_t zc_wrs1 = 0, zc_bytes1 = 0;
+  ici_zero_copy_counters(&zc_wrs1, &zc_bytes1);
+  // At least the request payload went zero-copy (the tstd frame header
+  // rides a normal block; the big ref is its own descriptor); loopback
+  // echoes typically add the response too.
+  EXPECT(zc_wrs1 > zc_wrs0);
+  EXPECT(zc_bytes1 - zc_bytes0 >= kLen);
+  ici_staging_free(stage);
+}
+
+TEST_CASE(ici_staging_repeated_large_echo_bench_geometry) {
+  // Bench-shaped repro: 256KB x 32 rings, 64MB staged payload, repeated
+  // sync echoes (the r5 bench wedged here at ~call 2).
+  start_once();
+  ici_set_ring_geometry(256 * 1024, 32, 1024);
+  const size_t kLen = 64 << 20;
+  uint32_t ord = 0;
+  char* stage = static_cast<char*>(ici_staging_alloc(kLen, &ord));
+  EXPECT(stage != nullptr);
+  memset(stage, 0x5a, kLen);
+  Channel ch;
+  Channel::Options opts;
+  opts.use_ici = true;
+  opts.timeout_ms = 15000;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(g_port), &opts), 0);
+  for (size_t len : {size_t{2} << 20, size_t{8} << 20, size_t{16} << 20,
+                     size_t{32} << 20, size_t{64} << 20}) {
+    for (int i = 0; i < 2; ++i) {
+      Controller cntl;
+      cntl.set_timeout_ms(8000);
+      IOBuf req, resp;
+      req.append_user_data(stage, len, [](void*, void*) {}, nullptr, 0);
+      ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+      if (cntl.Failed()) {
+        fprintf(stderr, "FAILED at len=%zu iter=%d: %s\n", len, i,
+                cntl.error_text().c_str());
+      }
+      EXPECT(!cntl.Failed());
+      EXPECT_EQ(resp.size(), len);
+    }
+  }
+  ici_set_ring_geometry(64 * 1024, 16);
+  ici_staging_free(stage);
+}
+
 // ---- full RPC path over the rings ---------------------------------------
 
 TEST_CASE(ici_echo_roundtrip) {

@@ -1,0 +1,191 @@
+// mcpack_v2 codec tests: golden wire bytes (hand-assembled per the head
+// layouts in /root/reference/src/mcpack2pb/parser.cpp:30-80), full-type
+// round-trips, deleted-item skipping, malformed rejection, and the
+// classic pairing: mcpack bodies over nshead framing.
+#include <cstring>
+#include <string>
+
+#include "base/mcpack.h"
+#include "net/channel.h"
+#include "net/nshead.h"
+#include "net/server.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+TEST_CASE(mcpack_golden_bytes_int32) {
+  // Unnamed INT32(7): fixed head {0x14, 0x00} + 4 LE value bytes.
+  McpackValue v = McpackValue::I32(7);
+  const std::string wire = v.serialize();
+  const char expect[] = {0x14, 0x00, 0x07, 0x00, 0x00, 0x00};
+  EXPECT_EQ(wire.size(), sizeof(expect));
+  EXPECT(memcmp(wire.data(), expect, sizeof(expect)) == 0);
+}
+
+TEST_CASE(mcpack_golden_bytes_named_string_in_object) {
+  // Object{"k": "hi"}: long head object, items_head count=1, then a
+  // SHORT-head string (0x50|0x80) named "k\0" valued "hi\0".
+  McpackValue obj = McpackValue::Object();
+  obj.add_field("k", McpackValue::Str("hi"));
+  const std::string wire = obj.serialize();
+  const char expect[] = {
+      0x10, 0x00, 0x0c, 0x00, 0x00, 0x00,        // long head, value=12
+      0x01, 0x00, 0x00, 0x00,                    // item_count = 1
+      static_cast<char>(0xD0), 0x02, 0x03,       // short string head
+      'k',  0x00, 'h',  'i',  0x00,              // name + value
+  };
+  EXPECT_EQ(wire.size(), sizeof(expect));
+  EXPECT(memcmp(wire.data(), expect, sizeof(expect)) == 0);
+  McpackValue back;
+  EXPECT(McpackValue::parse(wire.data(), wire.size(), &back));
+  EXPECT(back.type == McpackType::kObject);
+  const McpackValue* k = back.field("k");
+  EXPECT(k != nullptr && k->str == "hi");
+}
+
+TEST_CASE(mcpack_all_types_roundtrip) {
+  McpackValue obj = McpackValue::Object();
+  obj.add_field("i8", [] {
+    McpackValue v;
+    v.type = McpackType::kInt8;
+    v.i64 = -5;
+    return v;
+  }());
+  obj.add_field("i32", McpackValue::I32(-123456));
+  obj.add_field("i64", McpackValue::I64(-(int64_t{1} << 40)));
+  obj.add_field("u64", McpackValue::U64(uint64_t{1} << 63));
+  obj.add_field("b", McpackValue::Bool(true));
+  obj.add_field("d", McpackValue::Double(3.25));
+  obj.add_field("s", McpackValue::Str("hello mcpack"));
+  obj.add_field("bin", McpackValue::Binary(std::string("\x00\x01\x02", 3)));
+  obj.add_field("nil", McpackValue::Null());
+  McpackValue arr = McpackValue::Array();
+  arr.add_item(McpackValue::Str("a"));
+  arr.add_item(McpackValue::I32(2));
+  obj.add_field("arr", std::move(arr));
+  McpackValue iso = McpackValue::IsoArray(McpackType::kInt32);
+  for (int i = 0; i < 5; ++i) {
+    iso.add_item(McpackValue::I32(i * 100));
+  }
+  obj.add_field("iso", std::move(iso));
+  // Big string forces the LONG head (> 255).
+  obj.add_field("big", McpackValue::Str(std::string(1000, 'x')));
+
+  const std::string wire = obj.serialize();
+  McpackValue back;
+  size_t consumed = 0;
+  EXPECT(McpackValue::parse(wire.data(), wire.size(), &back, &consumed));
+  EXPECT_EQ(consumed, wire.size());
+  EXPECT_EQ(back.fields.size(), obj.fields.size());
+  EXPECT_EQ(back.field("i8")->i64, -5);
+  EXPECT_EQ(back.field("i32")->i64, -123456);
+  EXPECT_EQ(back.field("i64")->i64, -(int64_t{1} << 40));
+  EXPECT_EQ(back.field("u64")->u64, uint64_t{1} << 63);
+  EXPECT_EQ(back.field("b")->i64, 1);
+  EXPECT(back.field("d")->f64 == 3.25);
+  EXPECT(back.field("s")->str == "hello mcpack");
+  EXPECT_EQ(back.field("bin")->str.size(), 3u);
+  EXPECT(back.field("nil")->type == McpackType::kNull);
+  EXPECT_EQ(back.field("arr")->items.size(), 2u);
+  EXPECT(back.field("arr")->items[0].str == "a");
+  EXPECT_EQ(back.field("arr")->items[1].i64, 2);
+  EXPECT_EQ(back.field("iso")->items.size(), 5u);
+  EXPECT_EQ(back.field("iso")->items[4].i64, 400);
+  EXPECT_EQ(back.field("big")->str.size(), 1000u);
+  // Round-trip is byte-stable.
+  EXPECT(back.serialize() == wire);
+}
+
+TEST_CASE(mcpack_deleted_items_and_name_limit) {
+  // Deleted tombstones ((type & 0x70) == 0) are counted on the wire but
+  // absent from the tree.  Object{<deleted>, "k":I32(3)} with count=2:
+  const char wire[] = {
+      0x10, 0x00, 0x0f, 0x00, 0x00, 0x00,  // object long head, value=15
+      0x02, 0x00, 0x00, 0x00,              // item_count = 2
+      0x01, 0x00, 0x00,                    // DELETED fixed item (1B value)
+      0x14, 0x02, 'k',  0x00,              // named INT32...
+      0x03, 0x00, 0x00, 0x00,              // = 3
+  };
+  McpackValue v;
+  EXPECT(McpackValue::parse(wire, sizeof(wire), &v));
+  EXPECT_EQ(v.fields.size(), 1u);  // tombstone not surfaced
+  EXPECT(v.field("k") != nullptr && v.field("k")->i64 == 3);
+  // Field names beyond the wire's 1-byte name_size must be REJECTED, not
+  // silently truncated into a corrupt image.
+  McpackValue bad = McpackValue::Object();
+  bad.add_field(std::string(300, 'n'), McpackValue::I32(1));
+  EXPECT(bad.serialize().empty());
+}
+
+TEST_CASE(mcpack_rejects_malformed) {
+  McpackValue out;
+  // Truncated heads/values.
+  const std::string ok = [] {
+    McpackValue obj = McpackValue::Object();
+    obj.add_field("x", McpackValue::I32(1));
+    return obj.serialize();
+  }();
+  for (size_t cut = 1; cut < ok.size(); ++cut) {
+    McpackValue v;
+    // Either it fails, or (long-head inner sizes still fitting) it must
+    // never read past the truncation — parse on the prefix:
+    McpackValue::parse(ok.data(), cut, &v);
+  }
+  // Bad string (missing trailing NUL).
+  const char bad_str[] = {static_cast<char>(0xD0), 0x00, 0x02, 'h', 'i'};
+  EXPECT(!McpackValue::parse(bad_str, sizeof(bad_str), &out));
+  // Iso array with non-fixed element type.
+  const char bad_iso[] = {0x30, 0x00, 0x02, 0x00, 0x00, 0x00, 0x50, 0x00};
+  EXPECT(!McpackValue::parse(bad_iso, sizeof(bad_iso), &out));
+  // Container count larger than its bytes.
+  const char bad_count[] = {0x10, 0x00, 0x04, 0x00, 0x00, 0x00,
+                            static_cast<char>(0xFF), 0x00, 0x00, 0x00};
+  EXPECT(!McpackValue::parse(bad_count, sizeof(bad_count), &out));
+}
+
+TEST_CASE(mcpack_over_nshead_service) {
+  // The deployment pairing the format exists for: mcpack request/response
+  // bodies inside nshead frames (reference: nshead_mcpack_protocol).
+  NsheadService svc([](const NsheadHead&, const IOBuf& body,
+                       NsheadHead*, IOBuf* resp_body) {
+    const std::string bytes = body.to_string();
+    McpackValue in;
+    if (!McpackValue::parse(bytes.data(), bytes.size(), &in)) {
+      resp_body->append("parse error");
+      return;
+    }
+    McpackValue out = McpackValue::Object();
+    const McpackValue* a = in.field("a");
+    const McpackValue* b = in.field("b");
+    out.add_field("sum", McpackValue::I64((a != nullptr ? a->i64 : 0) +
+                                          (b != nullptr ? b->i64 : 0)));
+    out.add_field("echo",
+                  McpackValue::Str(in.field("msg") != nullptr
+                                       ? in.field("msg")->str
+                                       : ""));
+    resp_body->append(out.serialize());
+  });
+  Server srv;
+  srv.set_nshead_service(&svc);
+  EXPECT_EQ(srv.Start(0), 0);
+
+  NsheadClient cli;
+  EXPECT_EQ(cli.Init("127.0.0.1:" + std::to_string(srv.port())), 0);
+  McpackValue req = McpackValue::Object();
+  req.add_field("a", McpackValue::I64(40));
+  req.add_field("b", McpackValue::I64(2));
+  req.add_field("msg", McpackValue::Str("mcpack over nshead"));
+  IOBuf req_body, resp_body;
+  req_body.append(req.serialize());
+  NsheadHead head, resp_head;
+  EXPECT_EQ(cli.call(head, req_body, &resp_head, &resp_body), 0);
+  const std::string resp_bytes = resp_body.to_string();
+  McpackValue resp;
+  EXPECT(McpackValue::parse(resp_bytes.data(), resp_bytes.size(), &resp));
+  EXPECT_EQ(resp.field("sum")->i64, 42);
+  EXPECT(resp.field("echo")->str == "mcpack over nshead");
+  srv.Stop();
+  srv.Join();
+}
+
+TEST_MAIN

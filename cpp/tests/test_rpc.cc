@@ -253,6 +253,65 @@ TEST_CASE(concurrency_limiter_constant) {
   EXPECT(!cntl.Failed());
 }
 
+TEST_CASE(concurrency_limiter_timeout_kind) {
+  // Third limiter kind (policy/timeout_concurrency_limiter.h parity):
+  // admission gates on inflight x avg-latency vs the timeout budget.
+  static Server tlim_srv;
+  tlim_srv.RegisterMethod("TLim.Slow", [](Controller*, const IOBuf& req,
+                                          IOBuf* resp, Closure done) {
+    fiber_sleep_us(100000);  // 100ms per call
+    resp->append(req);
+    done();
+  });
+  // Budget 150ms at ~100ms/call → estimated queueing allows depth 1.
+  EXPECT_EQ(tlim_srv.SetMethodMaxConcurrency("TLim.Slow", "timeout:150"), 0);
+  EXPECT(tlim_srv.SetMethodMaxConcurrency("TLim.Slow", "timeout:0") != 0);
+  EXPECT(tlim_srv.SetMethodMaxConcurrency("TLim.Slow", "timeout:x") != 0);
+  EXPECT_EQ(tlim_srv.Start(0), 0);
+  static Channel tlch;
+  EXPECT_EQ(tlch.Init("127.0.0.1:" + std::to_string(tlim_srv.port())), 0);
+  {
+    // Seed the latency estimate (first call is always admitted: no avg).
+    Controller cntl;
+    cntl.set_timeout_ms(3000);
+    IOBuf req, resp;
+    req.append("seed");
+    tlch.CallMethod("TLim.Slow", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+  }
+  static std::atomic<int> ok{0}, limited{0};
+  std::vector<fiber_t> ids(6);
+  for (auto& f : ids) {
+    fiber_start(&f, [](void*) {
+      Controller cntl;
+      cntl.set_timeout_ms(3000);
+      IOBuf req, resp;
+      req.append("x");
+      tlch.CallMethod("TLim.Slow", req, &resp, &cntl);
+      if (!cntl.Failed()) {
+        ok.fetch_add(1);
+      } else if (cntl.error_code() == kELimit) {
+        limited.fetch_add(1);
+      }
+    }, nullptr);
+  }
+  for (auto f : ids) {
+    fiber_join(f);
+  }
+  // 6 concurrent 100ms calls against a 150ms queueing budget: depth ~1
+  // admitted per wave, the pile-up answers kELimit instantly.
+  EXPECT_EQ(ok.load() + limited.load(), 6);
+  EXPECT(limited.load() >= 3);
+  EXPECT(ok.load() >= 1);
+  // Capacity recovers once the flight drains.
+  Controller cntl;
+  cntl.set_timeout_ms(3000);
+  IOBuf req, resp;
+  req.append("later");
+  tlch.CallMethod("TLim.Slow", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+}
+
 TEST_CASE(connect_refused_times_out) {
   Channel ch;
   EXPECT_EQ(ch.Init("127.0.0.1:1"), 0);  // nothing listens on port 1

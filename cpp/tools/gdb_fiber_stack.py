@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Fiber stack inspection for gdb — the core-dump/wedged-process
+counterpart of the live /fibers?stacks=1 builtin.
+
+Parity: /root/reference/tools/gdb_bthread_stack.py (bthread_begin/list/
+frame/end over TaskMeta) re-targeted at this runtime's FiberMeta pool
+(cpp/base/resource_pool.h: lazily-allocated fixed segments indexed
+idx -> segs_[idx >> 8][idx & 255]; cpp/fiber/scheduler.h FiberMeta:
+odd version = live, sp = suspended continuation).
+
+Unlike the reference's (live processes only), this works on CORE DUMPS
+too: it only reads memory and rewrites rsp/rip/rbp, never calls into the
+inferior.
+
+Get started:
+    1. gdb attach <pid>     (or: gdb ./binary core)
+    2. source cpp/tools/gdb_fiber_stack.py
+    3. fiber_begin
+    4. fiber_list
+    5. fiber_frame 0
+    6. bt / up / down
+    7. fiber_end
+
+Context layout (cpp/fiber/context.S, x86_64): the saved sp points at
+[fpu word][r15][r14][r13][r12][rbx][rbp][ret] — rbp at sp+48, the resume
+address at sp+56.
+"""
+
+import gdb
+
+fibers = []
+saved_regs = None
+
+
+def _static(local_expr, call_expr):
+    """Function-local static, core-dump-safe: read the static's own
+    symbol first (works without a live inferior); fall back to calling
+    the accessor on a live process."""
+    try:
+        return gdb.parse_and_eval(local_expr)
+    except gdb.error:
+        return gdb.parse_and_eval(call_expr)
+
+
+def _pool():
+    # resource_pool.h names the instance() static `pool`.
+    return _static(
+        "'trpc::ResourcePool<trpc::FiberMeta>::instance()::pool'",
+        "'trpc::ResourcePool<trpc::FiberMeta>::instance'()")
+
+
+def _collect(limit=None):
+    """All live (odd-version) FiberMeta* in the pool, excluding the ones
+    currently RUNNING on a worker (their context is the pthread's)."""
+    out = []
+    pool = _pool()
+    hwm = int(pool["hwm_"]["_M_i"])
+    per_seg = 256
+    running = set()
+    # Fibers currently on a worker are not switchable (live registers).
+    try:
+        n_tags = int(gdb.parse_and_eval("'trpc::Scheduler::kMaxTags'"))
+    except gdb.error:
+        n_tags = 4
+    # scheduler.cc names the instance() static `s`.
+    sched = _static("'trpc::Scheduler::instance()::s'",
+                    "'trpc::Scheduler::instance'()")
+    for t in range(n_tags):
+        grp = sched["tags_"][t]
+        nw = int(grp["nworkers"]["_M_i"])
+        for w in range(nw):
+            wp = grp["workers"][w]
+            if int(wp) != 0:
+                cur = wp["current_"]
+                if int(cur) != 0:
+                    running.add(int(cur))
+    for idx in range(hwm):
+        if limit is not None and len(out) >= limit:
+            break
+        seg = pool["segs_"][idx >> 8]["_M_b"]["_M_p"]
+        if int(seg) == 0:
+            continue
+        meta = seg + (idx & (per_seg - 1))
+        ver = int(meta["version"]["_M_i"])
+        if ver & 1 == 0 or int(meta) in running:
+            continue
+        sp = int(meta["sp"])
+        if sp == 0:
+            continue
+        out.append(meta)
+    return out
+
+
+class FiberBegin(gdb.Command):
+    """fiber_begin [max]: snapshot live fibers and current registers."""
+
+    def __init__(self):
+        gdb.Command.__init__(self, "fiber_begin", gdb.COMMAND_USER)
+
+    def invoke(self, arg, _tty):
+        global fibers, saved_regs
+        limit = int(arg) if arg.strip() else None
+        saved_regs = (
+            gdb.parse_and_eval("$rsp"),
+            gdb.parse_and_eval("$rip"),
+            gdb.parse_and_eval("$rbp"),
+        )
+        fibers = _collect(limit)
+        print("%d parked fiber(s); fiber_list to enumerate, "
+              "fiber_frame <n> to switch, fiber_end to restore" %
+              len(fibers))
+
+
+class FiberList(gdb.Command):
+    """fiber_list: enumerate snapshot (index, id, entry fn)."""
+
+    def __init__(self):
+        gdb.Command.__init__(self, "fiber_list", gdb.COMMAND_USER)
+
+    def invoke(self, _arg, _tty):
+        for i, meta in enumerate(fibers):
+            ver = int(meta["version"]["_M_i"])
+            slot = int(meta["slot"])
+            fid = (ver << 32) | slot
+            fn = meta["fn"]["_M_b"]["_M_p"]
+            print("#%-4d fiber %016x  entry %s" % (i, fid, fn))
+
+
+class FiberFrame(gdb.Command):
+    """fiber_frame <n>: point gdb's unwinder at fiber n's saved context."""
+
+    def __init__(self):
+        gdb.Command.__init__(self, "fiber_frame", gdb.COMMAND_USER)
+
+    def invoke(self, arg, _tty):
+        n = int(arg)
+        meta = fibers[n]
+        sp = int(meta["sp"])
+        ptr = gdb.lookup_type("unsigned long").pointer()
+        rbp = gdb.Value(sp + 48).cast(ptr).dereference()
+        rip = gdb.Value(sp + 56).cast(ptr).dereference()
+        gdb.execute("set $rsp = %d" % (sp + 64))
+        gdb.execute("set $rbp = %d" % int(rbp))
+        gdb.execute("set $rip = %d" % int(rip))
+        print("switched to fiber #%d; bt/up/down work, fiber_end restores"
+              % n)
+
+
+class FiberEnd(gdb.Command):
+    """fiber_end: restore the real thread registers."""
+
+    def __init__(self):
+        gdb.Command.__init__(self, "fiber_end", gdb.COMMAND_USER)
+
+    def invoke(self, _arg, _tty):
+        global saved_regs
+        if saved_regs is None:
+            print("no snapshot")
+            return
+        rsp, rip, rbp = saved_regs
+        gdb.execute("set $rsp = %d" % int(rsp))
+        gdb.execute("set $rip = %d" % int(rip))
+        gdb.execute("set $rbp = %d" % int(rbp))
+        saved_regs = None
+        print("restored")
+
+
+class FiberNum(gdb.Command):
+    """fiber_num: count live fibers without snapshotting."""
+
+    def __init__(self):
+        gdb.Command.__init__(self, "fiber_num", gdb.COMMAND_USER)
+
+    def invoke(self, _arg, _tty):
+        print(len(_collect()))
+
+
+FiberBegin()
+FiberList()
+FiberFrame()
+FiberEnd()
+FiberNum()
