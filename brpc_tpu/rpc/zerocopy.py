@@ -115,6 +115,59 @@ def call_zero_copy(channel, method: str, array, timeout_ms: int = 0) -> bytes:
         lib.trpc_iobuf_destroy(ctypes.c_void_p(resp))
 
 
+def alloc_staging(nbytes: int, lib=None) -> np.ndarray:
+    """Allocates a REGISTERED ICI staging slab and returns a uint8 numpy
+    view over it (no copy).  Bytes living here cross ici ring connections
+    as SENDER-OWNED descriptors — one descriptor per payload, no ring DMA
+    copy, receiver wraps them in place (cpp/net/ici_transport.h; the rdma
+    block_pool takeover analogue).  Land device fetches here
+    (np.copyto(view, np.asarray(dev_array))) and pass view.ctypes.data to
+    the native call APIs.  Free with free_staging() only after every RPC
+    referencing the region has completed."""
+    lib = lib or load_library()
+    lib.trpc_ici_staging_alloc.restype = ctypes.c_void_p
+    lib.trpc_ici_staging_alloc.argtypes = [
+        ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint32)]
+    ordinal = ctypes.c_uint32()
+    base = lib.trpc_ici_staging_alloc(nbytes, ctypes.byref(ordinal))
+    if not base:
+        raise MemoryError(f"ici staging alloc of {nbytes} bytes failed")
+    view = np.frombuffer(
+        (ctypes.c_char * nbytes).from_address(base), dtype=np.uint8)
+    with _lock:
+        _staging[int(base)] = True
+    return view
+
+
+def free_staging(view: np.ndarray, lib=None) -> None:
+    """Unregisters and unlinks a slab from alloc_staging; the unmap is
+    deferred past any in-flight wrapped references by the native
+    refcount.  Pass the slab-base view (what alloc_staging returned, or
+    any zero-offset view of it — resolution is by base address); no view
+    or slice may be used afterwards."""
+    lib = lib or load_library()
+    base = int(view.ctypes.data)
+    with _lock:
+        known = _staging.pop(base, None)
+    if known is not None:
+        lib.trpc_ici_staging_free.argtypes = [ctypes.c_void_p]
+        lib.trpc_ici_staging_free(ctypes.c_void_p(base))
+
+
+def zero_copy_counters(lib=None) -> tuple[int, int]:
+    """Process-wide (descriptors, bytes) sent via the sender-owned path —
+    asserts that a staged payload really elided the ring copy."""
+    lib = lib or load_library()
+    lib.trpc_ici_zero_copy_counters.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
+    wrs, nbytes = ctypes.c_uint64(), ctypes.c_uint64()
+    lib.trpc_ici_zero_copy_counters(ctypes.byref(wrs), ctypes.byref(nbytes))
+    return wrs.value, nbytes.value
+
+
+_staging: dict[int, int] = {}
+
+
 def block_ptr(iobuf_ptr: int, index: int = 0, lib=None) -> int:
     """Data pointer of an IOBuf block ref (pointer-identity tests)."""
     lib = lib or load_library()

@@ -122,9 +122,23 @@ inline uint64_t stage_meta(uint32_t ordinal, uint64_t offset) {
          (offset & kStageOffsetMask);
 }
 
-struct StagingSlab {
+// One mapped staging slab, REF-COUNTED: the registry (local slabs) and
+// every conn cache / wrapped-range consumer (RxStageCtx) co-own it, so
+// neither a dying connection nor ici_staging_free can munmap under a
+// live reader; the memory unmaps when the LAST reference drops.
+struct StageMapping {
   char* base = nullptr;
   size_t len = 0;
+  bool owned = false;  // false: alias of another mapping (never unmapped)
+  ~StageMapping() {
+    if (base != nullptr && owned) {
+      munmap(base, len);
+    }
+  }
+};
+
+struct StagingSlab {
+  std::shared_ptr<StageMapping> mapping;  // owned=true
   uint32_t ordinal = 0;
   uint64_t reg_handle = 0;
   std::string name;
@@ -160,9 +174,10 @@ bool staging_of(const char* p, size_t len, uint32_t* ordinal,
                 uint64_t* offset) {
   std::lock_guard<std::mutex> g(stage_mu());
   for (const StagingSlab& s : stage_slabs()) {
-    if (s.base != nullptr && p >= s.base && p + len <= s.base + s.len) {
+    char* base = s.mapping != nullptr ? s.mapping->base : nullptr;
+    if (base != nullptr && p >= base && p + len <= base + s.mapping->len) {
       *ordinal = s.ordinal;
-      *offset = static_cast<uint64_t>(p - s.base);
+      *offset = static_cast<uint64_t>(p - base);
       return true;
     }
   }
@@ -291,15 +306,12 @@ struct IciConn {
   std::shared_ptr<std::array<std::atomic<uint8_t>, kIciMaxSlots>>
       rx_released =
           std::make_shared<std::array<std::atomic<uint8_t>, kIciMaxSlots>>();
-  // Peer staging slabs mapped on first reference (poller-owned).
-  // `owned`: we mmap'd it and must munmap; loopback entries alias the
-  // process-local registry mapping and must NOT be unmapped.
-  struct StageMap {
-    char* base = nullptr;
-    size_t len = 0;
-    bool owned = false;
-  };
-  std::map<uint32_t, StageMap> stage_maps;
+  // Peer staging slabs mapped on first reference (poller-owned map of
+  // REF-COUNTED StageMapping).  Consumers of wrapped ranges co-own the
+  // mapping through their RxStageCtx, so neither a dying connection nor
+  // ici_staging_free can munmap under them; loopback entries SHARE the
+  // registry's own mapping object.
+  std::map<uint32_t, std::shared_ptr<StageMapping>> stage_maps;
 
   // Stats.
   std::atomic<uint64_t> tx_wrs{0}, rx_wrs{0}, tx_bytes{0}, rx_bytes{0};
@@ -342,12 +354,7 @@ struct IciConn {
         munmap(m, tx_slab_len);
       }
     }
-    for (auto& [ord, slab] : stage_maps) {
-      (void)ord;
-      if (slab.base != nullptr && slab.owned) {
-        munmap(slab.base, slab.len);
-      }
-    }
+    stage_maps.clear();  // mappings with live consumer refs survive
     if (seg != nullptr) {
       munmap(seg, sizeof(IciSegment));
     }
@@ -380,9 +387,11 @@ void rx_block_deleter(void*, void* vctx) {
 // Deleter context for a wrapped SENDER-OWNED range: acking the descriptor
 // (flipping its released flag) is deferred to the moment the consumer's
 // last reference drops — the sender must not reuse its staging bytes
-// earlier.  Holds the flag array alive independently of the connection.
+// earlier.  Holds the flag array AND the slab mapping alive independently
+// of the connection.
 struct RxStageCtx {
   std::shared_ptr<std::array<std::atomic<uint8_t>, kIciMaxSlots>> released;
+  std::shared_ptr<StageMapping> mapping;  // co-owns the slab memory
   uint32_t slot;
 };
 
@@ -394,8 +403,11 @@ void rx_stage_deleter(void*, void* vctx) {
 
 // Maps the peer's staging slab `ordinal` on first reference (bounded to
 // keep a hostile peer from exhausting mappings); validates the range.
+// On success fills *mapping (the ref-counted holder; RxStageCtx co-owns
+// it so consumers outlive the connection).
 char* resolve_stage_source(IciConn& c, uint32_t ordinal, uint64_t offset,
-                           uint32_t len) {
+                           uint32_t len,
+                           std::shared_ptr<StageMapping>* mapping) {
   auto it = c.stage_maps.find(ordinal);
   if (it == c.stage_maps.end()) {
     if (c.stage_maps.size() >= 1024) {
@@ -405,20 +417,20 @@ char* resolve_stage_source(IciConn& c, uint32_t ordinal, uint64_t offset,
     if (pid == 0) {
       return nullptr;
     }
+    std::shared_ptr<StageMapping> m;
     if (pid == getpid()) {
-      // Loopback: the peer's staging slab IS ours — alias the registry
-      // mapping directly (same virtual address), which also lets an echo
-      // response ride the zero-copy path back out.
+      // Loopback: the peer's staging slab IS ours — SHARE the registry's
+      // mapping object (same virtual address; the shared refcount also
+      // defers ici_staging_free's munmap past every consumer), which
+      // lets an echo response ride the zero-copy path back out too.
       std::lock_guard<std::mutex> g(stage_mu());
       for (const StagingSlab& s : stage_slabs()) {
         if (s.ordinal == ordinal) {
-          it = c.stage_maps
-                   .emplace(ordinal, IciConn::StageMap{s.base, s.len, false})
-                   .first;
+          m = s.mapping;
           break;
         }
       }
-      if (it == c.stage_maps.end()) {
+      if (m == nullptr) {
         return nullptr;
       }
     } else {
@@ -438,18 +450,18 @@ char* resolve_stage_source(IciConn& c, uint32_t ordinal, uint64_t offset,
       if (mem == MAP_FAILED) {
         return nullptr;
       }
-      it = c.stage_maps
-               .emplace(ordinal,
-                        IciConn::StageMap{static_cast<char*>(mem),
-                                          static_cast<size_t>(st.st_size),
-                                          true})
-               .first;
+      m = std::make_shared<StageMapping>();
+      m->base = static_cast<char*>(mem);
+      m->len = static_cast<size_t>(st.st_size);
+      m->owned = true;
     }
+    it = c.stage_maps.emplace(ordinal, std::move(m)).first;
   }
-  if (len == 0 || offset + len > it->second.len) {
+  if (len == 0 || offset + len > it->second->len) {
     return nullptr;
   }
-  return it->second.base + offset;
+  *mapping = it->second;
+  return it->second->base + offset;
 }
 
 // Publishes a freshly-grown slab's shm name so the peer can map it.
@@ -614,8 +626,9 @@ class IciPoller {
           // last reference drops.
           const uint32_t ord =
               static_cast<uint32_t>((d.meta >> 40) & 0xFFFFF);
-          char* src =
-              resolve_stage_source(c, ord, d.meta & kStageOffsetMask, d.len);
+          std::shared_ptr<StageMapping> mapping;
+          char* src = resolve_stage_source(
+              c, ord, d.meta & kStageOffsetMask, d.len, &mapping);
           if (src == nullptr) {
             *dead = true;
             return moved;
@@ -629,7 +642,8 @@ class IciPoller {
             c.rx_pending.append(src, d.len);
             c.rx_released->at(slot).store(1, std::memory_order_release);
           } else {
-            auto* ctx = new RxStageCtx{c.rx_released, slot};
+            auto* ctx =
+                new RxStageCtx{c.rx_released, std::move(mapping), slot};
             c.rx_pending.append_user_data(src, d.len, &rx_stage_deleter,
                                           ctx, d.meta);
             c.rx_zc_wrs.fetch_add(1, std::memory_order_relaxed);
@@ -1096,9 +1110,12 @@ void* ici_staging_alloc(size_t len, uint32_t* ordinal_out) {
     shm_unlink(name.c_str());
     return nullptr;
   }
+  auto mapping = std::make_shared<StageMapping>();
+  mapping->base = static_cast<char*>(mem);
+  mapping->len = len;
+  mapping->owned = true;
   std::lock_guard<std::mutex> g(stage_mu());
-  stage_slabs().push_back(
-      StagingSlab{static_cast<char*>(mem), len, ord, handle, name});
+  stage_slabs().push_back(StagingSlab{std::move(mapping), ord, handle, name});
   if (ordinal_out != nullptr) {
     *ordinal_out = ord;
   }
@@ -1111,16 +1128,19 @@ void ici_staging_free(void* base) {
     std::lock_guard<std::mutex> g(stage_mu());
     auto& v = stage_slabs();
     auto it = std::find_if(v.begin(), v.end(), [base](const StagingSlab& s) {
-      return s.base == base;
+      return s.mapping != nullptr && s.mapping->base == base;
     });
     if (it == v.end()) {
       return;
     }
-    victim = *it;
+    victim = std::move(*it);
     v.erase(it);
   }
-  slab_unregister_tramp(victim.base, victim.len, nullptr, victim.reg_handle);
-  munmap(victim.base, victim.len);
+  // Unregister + unlink NOW (the name and DMA registration are gone for
+  // new users); the munmap itself is deferred by the mapping's refcount
+  // until the last wrapped-range consumer drops (use-after-free guard).
+  slab_unregister_tramp(victim.mapping->base, victim.mapping->len, nullptr,
+                        victim.reg_handle);
   shm_unlink(victim.name.c_str());
 }
 

@@ -388,8 +388,18 @@ TEST_CASE(ici_staging_zero_copy_single_descriptor) {
   // descriptor must NOT complete (sender staging is still referenced).
   usleep(100 * 1000);
   EXPECT_EQ(ici_conn_stats(*pair->client).sbuf_held, 1u);
+  // Free-while-referenced: the slab's name+registration go away now, but
+  // the MAPPING must survive until the held refs drop (the consumer
+  // keeps reading valid bytes — use-after-munmap regression).
+  ici_staging_free(stage);
+  // Unregistration is immediate (the pair's two rx arenas remain).
+  EXPECT(wait_until(
+      [&] { return ici_registered_slab_count() <= slabs_before + 2; },
+      5000));
   {
     LockGuard<FiberMutex> g(pair->ssink.mu);
+    EXPECT(pair->ssink.held.to_string() ==
+           std::string(stage, kLen));  // still readable post-free
     pair->ssink.hold.store(false);
     pair->ssink.held.clear();  // drop refs → deleter acks → sbuf drains
   }
@@ -397,7 +407,6 @@ TEST_CASE(ici_staging_zero_copy_single_descriptor) {
       [&] { return ici_conn_stats(*pair->client).sbuf_held == 0; }, 2000));
   ici_set_ring_geometry(64 * 1024, 16);
   delete pair;
-  ici_staging_free(stage);
   EXPECT(wait_until(
       [&] { return ici_registered_slab_count() <= slabs_before; }, 5000));
 }

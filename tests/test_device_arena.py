@@ -111,3 +111,55 @@ def test_arena_block_meta_and_release(echo_server):
     for blk in blocks:
         blk.release()
     arena.close()
+
+
+def test_ici_staging_zero_copy_from_python():
+    """Python face of the sender-owned zero-copy path (VERDICT r4 #3):
+    allocate a registered staging slab, land payload bytes in it via a
+    numpy view, run the native echo over the ici rings, and assert the
+    payload crossed as sender-owned descriptors (ring DMA elided) with
+    the roundtrip content verified."""
+    import ctypes
+
+    import numpy as np
+
+    from brpc_tpu.rpc import zerocopy
+    from brpc_tpu.rpc._lib import load_library
+
+    lib = load_library()
+    size = 4 << 20
+    view = zerocopy.alloc_staging(size)
+    try:
+        _staging_roundtrip(zerocopy, lib, view, size)
+    finally:
+        zerocopy.free_staging(view)
+
+
+def _staging_roundtrip(zerocopy, lib, view, size):
+    import ctypes
+
+    import numpy as np
+
+    assert view.size == size
+    payload = np.arange(size // 4, dtype=np.uint32)
+    np.copyto(view, payload.view(np.uint8))  # the "device DMA landing"
+
+    wrs0, bytes0 = zerocopy.zero_copy_counters()
+    f = lib.trpc_bench_echo_rpc
+    f.restype = ctypes.c_int
+    f.argtypes = [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int,
+                  ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p,
+                  ctypes.POINTER(ctypes.c_double), ctypes.c_char_p,
+                  ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t]
+    resp = np.empty(size, dtype=np.uint8)
+    gbps = ctypes.c_double()
+    used = ctypes.create_string_buffer(32)
+    err = ctypes.create_string_buffer(256)
+    rc = f(view.ctypes.data, size, 4, 1, b"ici", resp.ctypes.data,
+           ctypes.byref(gbps), used, 32, err, 256)
+    assert rc == 0, err.value
+    assert used.value == b"ici_ring"
+    assert np.array_equal(resp.view(np.uint32), payload)  # roundtrip
+    wrs1, bytes1 = zerocopy.zero_copy_counters()
+    assert wrs1 > wrs0
+    assert bytes1 - bytes0 >= size  # the payload rode sender-owned descs
