@@ -155,7 +155,11 @@ class TimeoutLimiter final : public ConcurrencyLimiter {
     const int64_t avg = avg_latency_us_.load(std::memory_order_acquire);
     const int64_t depth =
         inflight_.fetch_add(1, std::memory_order_acq_rel) + 1;
-    if (avg > 0 && depth * avg > timeout_us_) {
+    // depth 1 always admits: the estimate gates QUEUEING delay, and a
+    // lone request has no queue — otherwise a latency spike above the
+    // budget would close the gate permanently (nothing left running to
+    // decay the EMA).
+    if (depth > 1 && avg > 0 && depth * avg > timeout_us_) {
       inflight_.fetch_sub(1, std::memory_order_acq_rel);
       return false;
     }
