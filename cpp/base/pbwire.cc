@@ -490,4 +490,236 @@ Json pb_to_json_schemaless(const PbMessage& msg, int max_depth) {
   return out;
 }
 
+
+// ---- runtime .proto parsing (rpc_press_impl parity) ----------------------
+
+namespace {
+
+// Tokenizer: identifiers/numbers, punctuation chars, skips whitespace,
+// // and /* */ comments.
+struct ProtoLexer {
+  std::string_view s;
+  size_t i = 0;
+
+  void skip_ws() {
+    while (i < s.size()) {
+      if (isspace(static_cast<unsigned char>(s[i]))) {
+        ++i;
+      } else if (s.compare(i, 2, "//") == 0) {
+        while (i < s.size() && s[i] != '\n') {
+          ++i;
+        }
+      } else if (s.compare(i, 2, "/*") == 0) {
+        const size_t end = s.find("*/", i + 2);
+        i = end == std::string_view::npos ? s.size() : end + 2;
+      } else {
+        break;
+      }
+    }
+  }
+
+  std::string next() {
+    skip_ws();
+    if (i >= s.size()) {
+      return "";
+    }
+    const char c = s[i];
+    if (isalnum(static_cast<unsigned char>(c)) || c == '_' || c == '.') {
+      const size_t start = i;
+      while (i < s.size() &&
+             (isalnum(static_cast<unsigned char>(s[i])) || s[i] == '_' ||
+              s[i] == '.')) {
+        ++i;
+      }
+      return std::string(s.substr(start, i - start));
+    }
+    if (c == '"') {  // string literal (option values)
+      const size_t start = i++;
+      while (i < s.size() && s[i] != '"') {
+        ++i;
+      }
+      ++i;
+      return std::string(s.substr(start, i - start));
+    }
+    ++i;
+    return std::string(1, c);
+  }
+
+};
+
+bool scalar_kind(const std::string& type, PbSchema::Kind* kind) {
+  if (type == "int32" || type == "int64") {
+    *kind = PbSchema::kInt64;
+  } else if (type == "uint32" || type == "uint64") {
+    *kind = PbSchema::kUint64;
+  } else if (type == "sint32" || type == "sint64") {
+    *kind = PbSchema::kSint64;
+  } else if (type == "bool") {
+    *kind = PbSchema::kBool;
+  } else if (type == "string") {
+    *kind = PbSchema::kString;
+  } else if (type == "bytes") {
+    *kind = PbSchema::kBytesHex;
+  } else if (type == "double") {
+    *kind = PbSchema::kDouble;
+  } else if (type == "float") {
+    *kind = PbSchema::kFloat;
+  } else if (type == "fixed32") {
+    *kind = PbSchema::kFixed32;
+  } else if (type == "fixed64") {
+    *kind = PbSchema::kFixed64;
+  } else {
+    return false;
+  }
+  return true;
+}
+
+struct PendingField {
+  std::string message;  // owning message
+  std::string type;     // unresolved message-type name
+  size_t index;         // field slot in that schema
+};
+
+// Parses one message block (after "message Name {"); nested message
+// definitions recurse and register under their bare name.
+bool parse_message_block(ProtoLexer* lex, const std::string& name,
+                         std::map<std::string, PbSchema>* out,
+                         std::vector<PendingField>* pending,
+                         std::string* err) {
+  if (out->count(name) != 0) {
+    // Bare-name registry: silently merging two same-named messages
+    // (e.g. nested `Entry` in two siblings) would interleave their
+    // fields; reject instead.
+    *err = "duplicate message name " + name +
+           " (the runtime subset registers bare names)";
+    return false;
+  }
+  PbSchema& schema = (*out)[name];  // node address stable from here on
+  while (true) {
+    std::string tok = lex->next();
+    if (tok.empty()) {
+      *err = "unterminated message " + name;
+      return false;
+    }
+    if (tok == "}") {
+      return true;
+    }
+    if (tok == ";") {
+      continue;
+    }
+    if (tok == "message") {  // nested definition
+      const std::string inner = lex->next();
+      if (lex->next() != "{") {
+        *err = "expected { after nested message " + inner;
+        return false;
+      }
+      if (!parse_message_block(lex, inner, out, pending, err)) {
+        return false;
+      }
+      continue;
+    }
+    if (tok == "option" || tok == "reserved") {
+      while (!tok.empty() && tok != ";") {
+        tok = lex->next();
+      }
+      continue;
+    }
+    // Field: [repeated|optional|required] <type> <name> = <num> [...] ;
+    bool repeated = false;
+    if (tok == "repeated") {
+      repeated = true;
+      tok = lex->next();
+    } else if (tok == "optional" || tok == "required") {
+      tok = lex->next();
+    }
+    const std::string type = tok;
+    const std::string fname = lex->next();
+    if (lex->next() != "=") {
+      *err = "expected = after field " + fname + " in " + name;
+      return false;
+    }
+    const std::string numtok = lex->next();
+    char* endp = nullptr;
+    const long num = strtol(numtok.c_str(), &endp, 10);
+    if (endp == numtok.c_str() || num <= 0) {
+      *err = "bad field number for " + fname + " in " + name;
+      return false;
+    }
+    // Swallow options/semicolon.
+    for (std::string t = lex->next(); !t.empty() && t != ";";
+         t = lex->next()) {
+    }
+    if (type == "sfixed32" || type == "sfixed64" || type == "group" ||
+        type == "map" || type == "enum" || type == "oneof") {
+      *err = "unsupported field type " + type + " (field " + fname +
+             " in " + name + ")";
+      return false;
+    }
+    schema.name_pool.push_back(fname);
+    PbSchema::Field f;
+    f.num = static_cast<uint32_t>(num);
+    f.name = schema.name_pool.back().c_str();
+    f.repeated = repeated;
+    if (!scalar_kind(type, &f.kind)) {
+      f.kind = PbSchema::kMessage;  // message type: resolve after parsing
+      pending->push_back(PendingField{name, type, schema.fields.size()});
+    }
+    schema.fields.push_back(f);
+  }
+}
+
+}  // namespace
+
+bool parse_proto_file(const std::string& text,
+                      std::map<std::string, PbSchema>* out,
+                      std::string* err) {
+  ProtoLexer lex{text};
+  std::vector<PendingField> pending;
+  while (true) {
+    std::string tok = lex.next();
+    if (tok.empty()) {
+      break;
+    }
+    if (tok == "syntax" || tok == "package" || tok == "option" ||
+        tok == "import") {
+      while (!tok.empty() && tok != ";") {
+        tok = lex.next();
+      }
+      continue;
+    }
+    if (tok == "message") {
+      const std::string name = lex.next();
+      if (lex.next() != "{") {
+        *err = "expected { after message " + name;
+        return false;
+      }
+      if (!parse_message_block(&lex, name, out, &pending, err)) {
+        return false;
+      }
+      continue;
+    }
+    if (tok == ";") {
+      continue;
+    }
+    *err = "unsupported construct: " + tok;
+    return false;
+  }
+  // Resolve message-typed fields (bare name, or the last dotted segment).
+  for (const PendingField& pf : pending) {
+    std::string type = pf.type;
+    const size_t dot = type.rfind('.');
+    if (dot != std::string::npos) {
+      type = type.substr(dot + 1);
+    }
+    auto it = out->find(type);
+    if (it == out->end()) {
+      *err = "unknown message type " + pf.type + " (field in " +
+             pf.message + ")";
+      return false;
+    }
+    (*out)[pf.message].fields[pf.index].nested = &it->second;
+  }
+  return true;
+}
+
 }  // namespace trpc

@@ -18,6 +18,8 @@
 #pragma once
 
 #include <cstdint>
+#include <deque>
+#include <map>
 #include <string>
 #include <string_view>
 #include <vector>
@@ -128,7 +130,26 @@ struct PbSchema {
 
   const Field* by_num(uint32_t num) const;
   const Field* by_name(std::string_view name) const;
+
+  // Backing store for names owned by RUNTIME-parsed schemas
+  // (parse_proto_file); compile-time schemas use literals and leave it
+  // empty.  Field::name points into it, so such schemas must not be
+  // copied after construction (the registry map's node stability is the
+  // contract).
+  std::deque<std::string> name_pool;
 };
+
+// Parses a .proto definition at RUNTIME (tools/rpc_press_impl parity —
+// the reference compiles .proto files on the fly via libprotobuf's
+// importer; ours parses the subset the wire codec speaks): proto2/proto3
+// `message` blocks with scalar/string/bytes fields, nested or sibling
+// message types, `repeated`, `=N` tags; `syntax`/`package`/`option`/
+// comments skipped.  Returns schemas keyed by message name — map node
+// addresses are stable, which is what nested Field::nested pointers rely
+// on.  False + *err on anything outside the subset.
+bool parse_proto_file(const std::string& text,
+                      std::map<std::string, PbSchema>* out,
+                      std::string* err);
 
 // Schema'd transcodes.  Unknown fields (not in the schema) are emitted
 // under their number as a string key with a best-effort value, so nothing

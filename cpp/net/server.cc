@@ -1,5 +1,7 @@
 #include "net/server.h"
 
+#include <signal.h>
+
 #include <errno.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -424,6 +426,23 @@ int Server::Join(int64_t timeout_ms) {
     }
   }
   return 0;
+}
+
+namespace {
+std::atomic<bool> g_asked_to_quit{false};
+void quit_signal_handler(int) {
+  g_asked_to_quit.store(true, std::memory_order_release);
+}
+}  // namespace
+
+void Server::RunUntilAskedToQuit() {
+  struct sigaction sa = {};
+  sa.sa_handler = &quit_signal_handler;
+  sigaction(SIGINT, &sa, nullptr);
+  sigaction(SIGTERM, &sa, nullptr);
+  while (!g_asked_to_quit.load(std::memory_order_acquire)) {
+    usleep(100 * 1000);
+  }
 }
 
 void Server::track_connection(SocketId id) {

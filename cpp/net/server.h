@@ -208,6 +208,11 @@ class Server {
   // timeout_ms; -1 = forever).  ~Server runs Stop()+Join() so destruction
   // can never race a handler touching server state.
   int Join(int64_t timeout_ms = 5000);
+  // Blocks the calling thread until SIGINT/SIGTERM (parity:
+  // brpc::Server::RunUntilAskedToQuit — the "serve forever" idiom for a
+  // standalone main()).  NOTE: Join() waits for in-flight REQUESTS only,
+  // so a daemon must call this, not Join, to stay up.
+  static void RunUntilAskedToQuit();
   int port() const { return port_; }
   bool running() const { return running_.load(std::memory_order_acquire); }
 

@@ -298,14 +298,29 @@ TEST_CASE(concurrency_limiter_timeout_kind) {
   for (auto f : ids) {
     fiber_join(f);
   }
-  // 6 concurrent 100ms calls against a 150ms queueing budget: depth ~1
-  // admitted per wave, the pile-up answers kELimit instantly.
+  // 6 concurrent 100ms calls against a 150ms queueing budget: every call
+  // resolves coherently (served or shed instantly).  The admitted/shed
+  // SPLIT is scheduling-dependent on one core (fully-serialized fibers
+  // can all run at depth 1), so the gate arithmetic itself is asserted
+  // deterministically below instead.
   EXPECT_EQ(ok.load() + limited.load(), 6);
-  EXPECT(limited.load() >= 3);
   EXPECT(ok.load() >= 1);
-  // Capacity recovers once the flight drains.
+  {
+    TimeoutLimiter gate(150);             // 150ms budget
+    EXPECT(gate.on_request());            // no samples yet: admit
+    gate.on_response(100 * 1000, false);  // seeds avg = 100ms, drains
+    EXPECT(gate.on_request());            // depth 1 always admits
+    EXPECT(!gate.on_request());           // depth 2: 200ms > budget → shed
+    gate.on_response(100 * 1000, false);  // the admitted one completes
+    EXPECT_EQ(gate.current_limit(), 1);   // budget/avg
+    EXPECT(gate.on_request());            // capacity recovered
+    gate.on_response(100 * 1000, false);
+  }
+  // Capacity recovers once the flight drains (generous budget: under
+  // sanitizer slowdown the six 100ms calls serialize to multiple
+  // seconds; this call must be ADMITTED, which depth-1 guarantees).
   Controller cntl;
-  cntl.set_timeout_ms(3000);
+  cntl.set_timeout_ms(15000);
   IOBuf req, resp;
   req.append("later");
   tlch.CallMethod("TLim.Slow", req, &resp, &cntl);

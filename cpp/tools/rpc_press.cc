@@ -9,9 +9,12 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <fstream>
+#include <map>
 #include <string>
 #include <vector>
 
+#include "base/pbwire.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
 #include "net/cluster.h"
@@ -51,6 +54,12 @@ void press_fiber(void* p) {
     const int64_t t0 = monotonic_time_us();
     a->ch->CallMethod(a->method, req, &resp, &cntl);
     if (cntl.Failed()) {
+      static std::atomic<bool> warned{false};
+      bool expect = false;
+      if (warned.compare_exchange_strong(expect, true)) {
+        fprintf(stderr, "first failure: %d %s\n", cntl.error_code(),
+                cntl.error_text().c_str());
+      }
       a->failed->fetch_add(1);
     } else {
       a->ok->fetch_add(1);
@@ -66,18 +75,82 @@ int main(int argc, char** argv) {
   if (argc < 3) {
     fprintf(stderr,
             "usage: %s <addr|list://h:p,...> <method> [qps=0] [payload=1024]"
-            " [fibers=32] [seconds=5] [lb=rr] [protocol=tstd|h2|grpc]\n",
+            " [fibers=32] [seconds=5] [lb=rr] [protocol=tstd|h2|grpc]\n"
+            "       [proto=FILE message=NAME input=JSON]\n"
+            "With proto=: the request body is the JSON input encoded as\n"
+            "protobuf per the runtime-loaded .proto (rpc_press_impl\n"
+            "parity) instead of a synthetic payload.\n",
             argv[0]);
     return 1;
   }
-  const std::string addr = argv[1];
-  const std::string method = argv[2];
-  const long target_qps = argc > 3 ? atol(argv[3]) : 0;
-  const size_t payload = argc > 4 ? atol(argv[4]) : 1024;
-  const int fibers = argc > 5 ? atoi(argv[5]) : 32;
-  const int seconds = argc > 6 ? atoi(argv[6]) : 5;
-  const std::string lb = argc > 7 ? argv[7] : "rr";
-  const std::string protocol = argc > 8 ? argv[8] : "tstd";
+  // key=value options may appear anywhere after the method.
+  std::string proto_file, message_name, input_json;
+  std::vector<const char*> pos;
+  for (int i = 1; i < argc; ++i) {
+    const std::string a = argv[i];
+    if (a.rfind("proto=", 0) == 0) {
+      proto_file = a.substr(6);
+    } else if (a.rfind("message=", 0) == 0) {
+      message_name = a.substr(8);
+    } else if (a.rfind("input=", 0) == 0) {
+      input_json = a.substr(6);
+    } else {
+      pos.push_back(argv[i]);
+    }
+  }
+  const int n = static_cast<int>(pos.size());
+  if (n < 2) {
+    fprintf(stderr, "need <addr> and <method> positional args\n");
+    return 1;
+  }
+  const std::string addr = pos[0];
+  const std::string method = pos[1];
+  const long target_qps = n > 2 ? atol(pos[2]) : 0;
+  const size_t payload = n > 3 ? atol(pos[3]) : 1024;
+  const int fibers = n > 4 ? atoi(pos[4]) : 32;
+  const int seconds = n > 5 ? atoi(pos[5]) : 5;
+  const std::string lb = n > 6 ? pos[6] : "rr";
+  const std::string protocol = n > 7 ? pos[7] : "tstd";
+
+  // Runtime-schema body: load the .proto, encode the JSON input.  A
+  // separate flag, not pb_body.empty(): an all-defaults proto3 message
+  // legitimately serializes to ZERO bytes and must still be sent as-is.
+  const bool use_proto = !proto_file.empty();
+  std::string pb_body;
+  if (use_proto) {
+    std::ifstream f(proto_file, std::ios::binary);
+    if (!f) {
+      fprintf(stderr, "cannot read %s\n", proto_file.c_str());
+      return 1;
+    }
+    const std::string text((std::istreambuf_iterator<char>(f)),
+                           std::istreambuf_iterator<char>());
+    std::map<std::string, PbSchema> schemas;
+    std::string err;
+    if (!parse_proto_file(text, &schemas, &err)) {
+      fprintf(stderr, "proto parse failed: %s\n", err.c_str());
+      return 1;
+    }
+    auto it = message_name.empty() ? schemas.begin()
+                                   : schemas.find(message_name);
+    if (it == schemas.end()) {
+      fprintf(stderr, "message %s not found in %s\n", message_name.c_str(),
+              proto_file.c_str());
+      return 1;
+    }
+    Json j;
+    if (!Json::parse(input_json.empty() ? "{}" : input_json, &j)) {
+      fprintf(stderr, "input= is not valid JSON\n");
+      return 1;
+    }
+    PbMessage m;
+    if (!json_to_pb(j, it->second, &m)) {
+      fprintf(stderr, "input JSON does not match message %s\n",
+              it->first.c_str());
+      return 1;
+    }
+    pb_body = m.serialize();
+  }
 
   ClusterChannel ch;
   ClusterChannel::Options opts;
@@ -96,9 +169,15 @@ int main(int argc, char** argv) {
   const int64_t interval =
       target_qps > 0 ? fibers * 1000000LL / target_qps : 0;
   for (int i = 0; i < fibers; ++i) {
-    args[i] = PressArgs{&ch,     method,      std::string(payload, 'p'),
-                        stop_us, interval,    &ok,
-                        &failed, &resp_bytes, &lat[i]};
+    args[i] = PressArgs{&ch,
+                        method,
+                        use_proto ? pb_body : std::string(payload, 'p'),
+                        stop_us,
+                        interval,
+                        &ok,
+                        &failed,
+                        &resp_bytes,
+                        &lat[i]};
     fiber_start(&ids[i], press_fiber, &args[i]);
   }
   for (auto f : ids) {

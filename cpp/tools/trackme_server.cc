@@ -57,6 +57,6 @@ int main(int argc, char** argv) {
   printf("trackme collector on port %d (TrackMe.Ping / TrackMe.Report; "
          "builtins on the same port)\n",
          server.port());
-  server.Join();
+  Server::RunUntilAskedToQuit();  // Join() only waits for in-flight reqs
   return 0;
 }
