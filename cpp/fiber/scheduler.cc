@@ -124,11 +124,19 @@ FiberMeta* fiber_meta_of(fiber_t f) {
 
 void ParkingLot::signal(int n) {
   seq_.fetch_add(1, std::memory_order_release);
-  sys_futex(&seq_, FUTEX_WAKE_PRIVATE, n);
+  // seq_ is already bumped, so a worker past its stamp() re-check that
+  // has not yet reached FUTEX_WAIT will see the changed word and return
+  // without sleeping — skipping the wake syscall when nobody has
+  // registered as parked is therefore lost-wakeup-free.
+  if (waiters_.load(std::memory_order_acquire) > 0) {
+    sys_futex(&seq_, FUTEX_WAKE_PRIVATE, n);
+  }
 }
 
 void ParkingLot::wait(int stamp) {
+  waiters_.fetch_add(1, std::memory_order_acq_rel);
   sys_futex(&seq_, FUTEX_WAIT_PRIVATE, stamp);
+  waiters_.fetch_sub(1, std::memory_order_acq_rel);
 }
 
 Scheduler* Scheduler::instance() {

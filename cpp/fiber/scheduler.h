@@ -82,6 +82,11 @@ class ParkingLot {
 
  private:
   std::atomic<int> seq_{0};
+  // FUTEX_WAKE costs a syscall even with nobody parked — at 100k+ qps
+  // most ready_to_run calls hit busy workers.  signal() ALWAYS bumps
+  // seq_ (so a waiter between stamp and FUTEX_WAIT sees the change and
+  // returns) and only syscalls when someone is actually parked.
+  std::atomic<int> waiters_{0};
 };
 
 class Scheduler {

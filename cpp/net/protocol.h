@@ -70,6 +70,27 @@ struct RpcMeta {
   uint32_t checksum = 0;
   std::string method;
   std::string error_text;
+
+  // Back to defaults, RETAINING string/vector capacity (the pooled
+  // InputMessage reuse path; a fresh `= RpcMeta{}` would free it).
+  void reset() {
+    type = kRequest;
+    correlation_id = 0;
+    error_code = 0;
+    attachment_size = 0;
+    stream_id = 0;
+    stream_flags = 0;
+    ack_bytes = 0;
+    extra_streams.clear();
+    trace_id = 0;
+    span_id = 0;
+    parent_span_id = 0;
+    compress_type = 0;
+    has_checksum = false;
+    checksum = 0;
+    method.clear();
+    error_text.clear();
+  }
 };
 
 struct InputMessage {
