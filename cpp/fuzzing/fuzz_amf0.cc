@@ -1,0 +1,19 @@
+// libFuzzer target: the AMF0 value reader (RTMP command payloads).
+#include <string>
+
+#include "net/rtmp.h"
+
+#include "fuzzing/fuzz_driver.h"
+
+using namespace trpc;
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  const std::string input(reinterpret_cast<const char*>(data), size);
+  Amf0Value v;
+  size_t pos = 0;
+  const int rc = amf0_read(input, &pos, &v, 0);
+  if (rc < -1 || rc > 1 || (rc == 1 && pos > input.size())) {
+    __builtin_trap();
+  }
+  return 0;
+}

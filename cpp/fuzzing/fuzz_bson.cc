@@ -1,0 +1,19 @@
+// libFuzzer target: the BSON document reader (mongo OP_MSG bodies).
+#include <string>
+
+#include "net/mongo.h"
+
+#include "fuzzing/fuzz_driver.h"
+
+using namespace trpc;
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  const std::string input(reinterpret_cast<const char*>(data), size);
+  BsonDoc doc;
+  size_t pos = 0;
+  const int rc = bson_read_doc(input, &pos, &doc, 0);
+  if (rc < -1 || rc > 1 || (rc == 1 && pos > input.size())) {
+    __builtin_trap();
+  }
+  return 0;
+}

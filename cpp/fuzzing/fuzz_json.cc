@@ -1,0 +1,21 @@
+// libFuzzer target: the strict JSON parser (reference fuzz_json).
+#include <string>
+
+#include "base/json.h"
+
+#include "fuzzing/fuzz_driver.h"
+
+using namespace trpc;
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  const std::string input(reinterpret_cast<const char*>(data), size);
+  Json j;
+  if (Json::parse(input, &j)) {
+    // Parse success implies dump terminates and re-parses.
+    Json j2;
+    if (!Json::parse(j.dump(), &j2)) {
+      __builtin_trap();
+    }
+  }
+  return 0;
+}

@@ -1,0 +1,19 @@
+// libFuzzer target: the memcache binary frame parser.
+#include <string>
+
+#include "net/memcache.h"
+
+#include "fuzzing/fuzz_driver.h"
+
+using namespace trpc;
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  const std::string input(reinterpret_cast<const char*>(data), size);
+  McFrame frame;
+  size_t pos = 0;
+  const int rc = mc_parse_frame(input, &pos, &frame);
+  if (rc < -1 || rc > 1 || (rc == 1 && pos > input.size())) {
+    __builtin_trap();
+  }
+  return 0;
+}

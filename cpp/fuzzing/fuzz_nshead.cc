@@ -1,0 +1,23 @@
+// libFuzzer target: nshead frame cutting (magic/body_len validation).
+#include "base/iobuf.h"
+#include "net/nshead.h"
+
+#include "fuzzing/fuzz_driver.h"
+
+using namespace trpc;
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  IOBuf buf;
+  buf.append(data, size);
+  NsheadHead head;
+  IOBuf body;
+  const size_t before = buf.size();
+  const int rc = nshead_cut_frame(&buf, &head, &body);
+  if (rc < -1 || rc > 1) {
+    __builtin_trap();
+  }
+  if (rc == 0 && buf.size() != before) {
+    __builtin_trap();  // not-enough-data must not consume
+  }
+  return 0;
+}

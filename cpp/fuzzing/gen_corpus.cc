@@ -9,10 +9,14 @@
 #include <string>
 
 #include "base/iobuf.h"
+#include "base/json.h"
 #include "base/mcpack.h"
 #include "base/pbwire.h"
 #include "net/hpack.h"
+#include "net/mongo.h"
+#include "net/nshead.h"
 #include "net/protocol.h"
+#include "net/rtmp.h"
 #include "net/thrift.h"
 
 using namespace trpc;
@@ -148,6 +152,63 @@ int main(int argc, char** argv) {
     obj.add_field("big", McpackValue::Str(std::string(1000, 'x')));
     put("mcpack", "object", obj.serialize());
     put("mcpack", "scalar", McpackValue::I32(7).serialize());
+  }
+
+  // -- json --------------------------------------------------------------
+  put("json", "object",
+      "{\"a\":1,\"b\":[true,null,2.5],\"c\":{\"d\":\"e\\u00e9\"}}");
+  put("json", "escapes", "[\"line\\n\\ttab\\\"q\\\\\",-0.5e-3,1e9]");
+
+  // -- bson (via the real writer) ---------------------------------------
+  {
+    BsonDoc doc;
+    doc.emplace_back("str", BsonValue::Str("hello"));
+    doc.emplace_back("num", BsonValue::Double(2.5));
+    BsonDoc inner;
+    inner.emplace_back("k", BsonValue::Str("v"));
+    doc.emplace_back("sub", BsonValue::Document(inner));
+    std::string wire;
+    bson_write_doc(doc, &wire);
+    put("bson", "doc", wire);
+  }
+
+  // -- amf0 (via the real writer) ---------------------------------------
+  {
+    std::string wire;
+    amf0_write(Amf0Value::Str("connect"), &wire);
+    amf0_write(Amf0Value::Number(1), &wire);
+    amf0_write(Amf0Value::Object({{"app", Amf0Value::Str("live")},
+                                  {"flashVer", Amf0Value::Str("F")}}),
+               &wire);
+    put("amf0", "connect", wire);
+  }
+
+  // -- memcache binary ---------------------------------------------------
+  {
+    // GET request: magic 0x80, opcode 0x00, key "k".
+    std::string get;
+    get.push_back(static_cast<char>(0x80));
+    get.push_back(0x00);
+    get.append("\x00\x01", 2);           // key len 1
+    get.push_back(0x00);                   // extras len
+    get.push_back(0x00);                   // data type
+    get.append("\x00\x00", 2);           // vbucket
+    get.append("\x00\x00\x00\x01", 4); // total body 1
+    get.append("\x00\x00\x00\x07", 4); // opaque
+    get.append(8, '\x00');                // cas
+    get.push_back('k');
+    put("memcache", "get", get);
+  }
+
+  // -- nshead ------------------------------------------------------------
+  {
+    NsheadHead head;
+    head.body_len = 11;
+    IOBuf body;
+    body.append("hello-nshd!");
+    IOBuf frame;
+    nshead_pack(head, body, &frame);
+    put("nshead", "frame", frame.to_string());
   }
 
   printf("corpus written under %s\n", g_root.c_str());
