@@ -233,6 +233,10 @@ int Channel::Init(const std::string& addr, const Options* opts) {
        !tls_available())) {
     return -1;  // TLS rides the single TCP connection
   }
+  if (!opts_.use_tls &&
+      (!opts_.tls_cert.empty() || !opts_.tls_ca.empty())) {
+    return -1;  // cert/CA options without use_tls must not silently no-op
+  }
   if (proto_ != 0) {
     if (ct != ConnectionType::kSingle || opts_.use_shm || opts_.use_ici) {
       return -1;  // h2 multiplexes one connection by design
@@ -360,7 +364,10 @@ int Channel::ensure_socket(SocketId* out) {
   sopts.on_readable = &messenger_on_readable;
   if (opts_.use_tls) {
     std::string err;
-    void* ctx = tls_client_ctx(&err);
+    void* ctx = opts_.tls_cert.empty() && opts_.tls_ca.empty()
+                    ? tls_client_ctx(&err)
+                    : tls_client_ctx_mtls(opts_.tls_cert, opts_.tls_key,
+                                          opts_.tls_ca, &err);
     if (ctx == nullptr) {
       LOG(Warning) << "tls client init failed: " << err;
       return -1;

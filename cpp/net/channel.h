@@ -52,6 +52,15 @@ class Channel {
     // use_shm/use_ici.  No peer verification by default, like the
     // reference's default ChannelSSLOptions.
     bool use_tls = false;
+    // mTLS client half (ChannelSSLOptions::client_cert parity): present
+    // this certificate during the handshake (may be empty with tls_ca
+    // set: verification-only).  With tls_ca, the server's CHAIN is
+    // verified against it — and when the Init address is a hostname, the
+    // certificate must match that name too (IP literals: chain-only).
+    // All PEM paths; Init fails if set without use_tls.
+    std::string tls_cert;
+    std::string tls_key;
+    std::string tls_ca;
   };
 
   ~Channel();  // fails the pooled socket so its resources (fd / shm

@@ -509,9 +509,10 @@ void Server::on_acceptable(SocketId id, void* ctx) {
 }
 
 int Server::EnableTls(const std::string& cert_file,
-                      const std::string& key_file) {
+                      const std::string& key_file,
+                      const std::string& ca_file) {
   std::string err;
-  tls_ctx_ = tls_server_ctx(cert_file, key_file, &err);
+  tls_ctx_ = tls_server_ctx(cert_file, key_file, &err, ca_file);
   if (tls_ctx_ == nullptr) {
     LOG(Warning) << "EnableTls failed: " << err;
     return -1;

@@ -159,7 +159,11 @@ class Server {
   // byte (0x16 = TLS handshake record) and picks the path, like the
   // reference's sniffing acceptor.  PEM cert + key.  Call before Start;
   // returns 0 on success.
-  int EnableTls(const std::string& cert_file, const std::string& key_file);
+  // With a non-empty ca_file, client certificates are REQUIRED and
+  // verified against it (mTLS); plaintext sniffing on the same port is
+  // unaffected.
+  int EnableTls(const std::string& cert_file, const std::string& key_file,
+                const std::string& ca_file = "");
   // Shared acceptance check (one body for all protocols).  True = admit;
   // false fills *error_code/*error_text.
   bool accept_request(const std::string& method, const EndPoint& peer,

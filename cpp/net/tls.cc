@@ -6,6 +6,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <map>
 #include <mutex>
 
 #include "base/logging.h"
@@ -60,9 +61,17 @@ struct SslApi {
   // SNI: SSL_set_tlsext_host_name is a macro over SSL_ctrl(ssl, 55, 0,
   // name) in every OpenSSL; the raw control call is the stable ABI.
   long (*SSL_ctrl)(SSL*, int, long, void*);
+  // mTLS (optional symbols like ALPN).
+  int (*SSL_CTX_load_verify_locations)(SSL_CTX*, const char*, const char*);
+  void (*SSL_CTX_set_verify)(SSL_CTX*, int,
+                             int (*)(int, void*));
+  int (*SSL_set1_host)(SSL*, const char*);  // hostname pin (≥1.1.0)
 
   bool ok = false;
 };
+
+constexpr int kSslVerifyPeer = 0x01;
+constexpr int kSslVerifyFailIfNoPeerCert = 0x02;
 
 constexpr int kSslCtrlSetTlsextHostname = 55;  // SSL_CTRL_SET_TLSEXT_HOSTNAME
 
@@ -136,6 +145,14 @@ const SslApi& api() {
         sym("SSL_get0_alpn_selected"));
     s.SSL_ctrl =
         reinterpret_cast<long (*)(SSL*, int, long, void*)>(sym("SSL_ctrl"));
+    s.SSL_CTX_load_verify_locations =
+        reinterpret_cast<int (*)(SSL_CTX*, const char*, const char*)>(
+            sym("SSL_CTX_load_verify_locations"));
+    s.SSL_CTX_set_verify =
+        reinterpret_cast<void (*)(SSL_CTX*, int, int (*)(int, void*))>(
+            sym("SSL_CTX_set_verify"));
+    s.SSL_set1_host =
+        reinterpret_cast<int (*)(SSL*, const char*)>(sym("SSL_set1_host"));
     s.ok = s.TLS_method != nullptr && s.SSL_CTX_new != nullptr &&
            s.SSL_CTX_use_certificate_chain_file != nullptr &&
            s.SSL_CTX_use_PrivateKey_file != nullptr &&
@@ -379,11 +396,22 @@ class TlsTransport final : public Transport {
             reinterpret_cast<const unsigned char*>(st->alpn_offer.data()),
             static_cast<unsigned>(st->alpn_offer.size()));
       }
-      if (!st->sni_host.empty() && api().SSL_ctrl != nullptr) {
-        // SNI: without it, name-vhosted endpoints (CDNs, ingresses) serve
-        // their default cert or abort with unrecognized_name.
-        api().SSL_ctrl(st->ssl, kSslCtrlSetTlsextHostname, 0,
-                       const_cast<char*>(st->sni_host.c_str()));
+      if (!st->sni_host.empty()) {
+        if (api().SSL_ctrl != nullptr) {
+          // SNI: without it, name-vhosted endpoints (CDNs, ingresses)
+          // serve their default cert or abort with unrecognized_name.
+          api().SSL_ctrl(st->ssl, kSslCtrlSetTlsextHostname, 0,
+                         const_cast<char*>(st->sni_host.c_str()));
+        }
+        if (api().SSL_set1_host != nullptr) {
+          // Hostname pin: when peer VERIFICATION is enabled on the ctx
+          // (tls_client_ctx_mtls with a CA), the chain must also match
+          // this name — chain-only acceptance would let any same-CA
+          // certificate impersonate the server.  No-op when
+          // verification is off, and unset for IP-literal addresses
+          // (sni_host is empty then): those get chain-only checks.
+          api().SSL_set1_host(st->ssl, st->sni_host.c_str());
+        }
       }
       api().SSL_set_connect_state(st->ssl);
     } else {
@@ -420,10 +448,31 @@ int alpn_select_cb(SSL*, const unsigned char** out, unsigned char* outlen,
 
 }  // namespace
 
+namespace {
+
+// Loads cert chain + private key into `ctx` (shared by the server and
+// mTLS-client context builders so their error paths cannot drift).
+bool load_identity(SSL_CTX* ctx, const std::string& cert_file,
+                   const std::string& key_file, std::string* err) {
+  if (api().SSL_CTX_use_certificate_chain_file(ctx, cert_file.c_str()) !=
+          1 ||
+      api().SSL_CTX_use_PrivateKey_file(ctx, key_file.c_str(),
+                                        kSslFiletypePem) != 1 ||
+      (api().SSL_CTX_check_private_key != nullptr &&
+       api().SSL_CTX_check_private_key(ctx) != 1)) {
+    *err = last_ssl_error();
+    return false;
+  }
+  return true;
+}
+
+}  // namespace
+
 bool tls_available() { return api().ok; }
 
 void* tls_server_ctx(const std::string& cert_file,
-                     const std::string& key_file, std::string* err) {
+                     const std::string& key_file, std::string* err,
+                     const std::string& ca_file) {
   if (!api().ok) {
     *err = "libssl not available";
     return nullptr;
@@ -433,13 +482,7 @@ void* tls_server_ctx(const std::string& cert_file,
     *err = last_ssl_error();
     return nullptr;
   }
-  if (api().SSL_CTX_use_certificate_chain_file(ctx, cert_file.c_str()) !=
-          1 ||
-      api().SSL_CTX_use_PrivateKey_file(ctx, key_file.c_str(),
-                                        kSslFiletypePem) != 1 ||
-      (api().SSL_CTX_check_private_key != nullptr &&
-       api().SSL_CTX_check_private_key(ctx) != 1)) {
-    *err = last_ssl_error();
+  if (!load_identity(ctx, cert_file, key_file, err)) {
     if (api().SSL_CTX_free != nullptr) {
       api().SSL_CTX_free(ctx);  // only SUCCESSFUL contexts live forever
     }
@@ -448,6 +491,72 @@ void* tls_server_ctx(const std::string& cert_file,
   if (api().SSL_CTX_set_alpn_select_cb != nullptr) {
     api().SSL_CTX_set_alpn_select_cb(ctx, &alpn_select_cb, nullptr);
   }
+  if (!ca_file.empty()) {
+    if (api().SSL_CTX_load_verify_locations == nullptr ||
+        api().SSL_CTX_set_verify == nullptr) {
+      *err = "libssl lacks client-verification symbols";
+      api().SSL_CTX_free(ctx);
+      return nullptr;
+    }
+    if (api().SSL_CTX_load_verify_locations(ctx, ca_file.c_str(),
+                                            nullptr) != 1) {
+      *err = last_ssl_error();
+      api().SSL_CTX_free(ctx);
+      return nullptr;
+    }
+    // mTLS: a missing or unverifiable client certificate FAILS the
+    // handshake (plaintext sniffing on the same port is unaffected).
+    api().SSL_CTX_set_verify(
+        ctx, kSslVerifyPeer | kSslVerifyFailIfNoPeerCert, nullptr);
+  }
+  return ctx;
+}
+
+void* tls_client_ctx_mtls(const std::string& cert_file,
+                          const std::string& key_file,
+                          const std::string& ca_file, std::string* err) {
+  if (!api().ok) {
+    *err = "libssl not available";
+    return nullptr;
+  }
+  // Contexts are immutable after construction; cache by configuration so
+  // a flapping connection does not leak an SSL_CTX + X509 store per
+  // reconnect (ensure_socket re-enters here on every fresh socket).
+  static std::mutex mu;
+  static auto* cache = new std::map<std::string, SSL_CTX*>();
+  const std::string key = cert_file + "\x1f" + key_file + "\x1f" + ca_file;
+  std::lock_guard<std::mutex> g(mu);
+  auto it = cache->find(key);
+  if (it != cache->end()) {
+    return it->second;
+  }
+  SSL_CTX* ctx = api().SSL_CTX_new(api().TLS_method());
+  if (ctx == nullptr) {
+    *err = last_ssl_error();
+    return nullptr;
+  }
+  // cert may be empty: CA-only mode (server verification without a
+  // client identity).
+  if (!cert_file.empty() && !load_identity(ctx, cert_file, key_file, err)) {
+    api().SSL_CTX_free(ctx);
+    return nullptr;
+  }
+  if (!ca_file.empty()) {
+    if (api().SSL_CTX_load_verify_locations == nullptr ||
+        api().SSL_CTX_set_verify == nullptr) {
+      *err = "libssl lacks client-verification symbols";
+      api().SSL_CTX_free(ctx);
+      return nullptr;
+    }
+    if (api().SSL_CTX_load_verify_locations(ctx, ca_file.c_str(),
+                                            nullptr) != 1) {
+      *err = last_ssl_error();
+      api().SSL_CTX_free(ctx);
+      return nullptr;
+    }
+    api().SSL_CTX_set_verify(ctx, kSslVerifyPeer, nullptr);
+  }
+  (*cache)[key] = ctx;
   return ctx;
 }
 

@@ -24,15 +24,29 @@ namespace trpc {
 // True when libssl.so.3 loaded and every needed symbol resolved.
 bool tls_available();
 
-// Server identity: certificate + key (PEM).  Returns an opaque SSL_CTX
-// handle (leaked singleton pattern: contexts live forever), or nullptr
-// with *err filled.
+// Server identity: certificate + key (PEM).  With a non-empty
+// `ca_file`, client certificates are REQUIRED and verified against it
+// (mTLS; parity: VerifyOptions/ca_file_path in the reference's
+// ServerSSLOptions — handshakes without a valid client cert fail).
+// Returns an opaque SSL_CTX handle (leaked singleton pattern: contexts
+// live forever), or nullptr with *err filled.
 void* tls_server_ctx(const std::string& cert_file,
-                     const std::string& key_file, std::string* err);
+                     const std::string& key_file, std::string* err,
+                     const std::string& ca_file = "");
 
 // Client context (no peer verification by default — test/loopback grade,
 // like the reference's default ssl_options).
 void* tls_client_ctx(std::string* err);
+
+// Client context presenting a certificate (the mTLS client half;
+// ChannelSSLOptions::client_cert parity); cert may be empty for CA-only
+// mode.  With `ca_file`, the SERVER's chain is verified against it, and
+// when the channel address is a HOSTNAME the certificate must also match
+// it (IP-literal addresses get chain-only verification).  Contexts are
+// cached per (cert,key,ca) configuration.
+void* tls_client_ctx_mtls(const std::string& cert_file,
+                          const std::string& key_file,
+                          const std::string& ca_file, std::string* err);
 
 // The transport (stateless singleton; per-connection state rides
 // Socket::transport_ctx).  Sockets using it must carry a TlsConnState
