@@ -316,15 +316,23 @@ TEST_CASE(concurrency_limiter_timeout_kind) {
     EXPECT(gate.on_request());            // capacity recovered
     gate.on_response(100 * 1000, false);
   }
-  // Capacity recovers once the flight drains (generous budget: under
-  // sanitizer slowdown the six 100ms calls serialize to multiple
-  // seconds; this call must be ADMITTED, which depth-1 guarantees).
-  Controller cntl;
-  cntl.set_timeout_ms(15000);
-  IOBuf req, resp;
-  req.append("later");
-  tlch.CallMethod("TLim.Slow", req, &resp, &cntl);
-  EXPECT(!cntl.Failed());
+  // Capacity recovers once the flight drains.  Brief retry: the last
+  // burst client can observe its response a beat before the server's
+  // on_response bookkeeping lands, so one immediate follow-up may still
+  // see depth 2; a recovered gate admits within a retry or two.
+  bool recovered = false;
+  for (int attempt = 0; attempt < 10 && !recovered; ++attempt) {
+    Controller cntl;
+    cntl.set_timeout_ms(15000);
+    IOBuf req, resp;
+    req.append("later");
+    tlch.CallMethod("TLim.Slow", req, &resp, &cntl);
+    recovered = !cntl.Failed();
+    if (!recovered) {
+      fiber_sleep_us(50 * 1000);
+    }
+  }
+  EXPECT(recovered);
 }
 
 TEST_CASE(connect_refused_times_out) {
