@@ -8,9 +8,12 @@ library on demand with cmake if it isn't present.
 from __future__ import annotations
 
 import ctypes
+import os
 import pathlib
+import shutil
 import subprocess
 import threading
+from concurrent.futures import ThreadPoolExecutor
 
 _REPO = pathlib.Path(__file__).resolve().parent.parent.parent
 _BUILD = _REPO / "build"
@@ -22,19 +25,91 @@ _lib = None
 def _newest_source_mtime() -> float:
     newest = 0.0
     for path in (_REPO / "cpp").rglob("*"):
-        if path.suffix in (".cc", ".h", ".S", ".txt"):
+        if path.suffix in (".cc", ".h", ".inc", ".S", ".txt"):
             newest = max(newest, path.stat().st_mtime)
     return newest
+
+
+def _build_with_compiler() -> None:
+    """cmake-less fallback: compile cpp/ straight with the system C++
+    compiler (same flags as cpp/CMakeLists.txt) into build/obj/ and link
+    libtpurpc.so.  Keeps the Python suite alive on minimal images that
+    bake a toolchain but no cmake; the C++ unit BINARIES still need the
+    cmake build (tests/test_cpp.py skips them instead)."""
+    cxx = shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
+    if cxx is None:
+        raise FileNotFoundError(
+            "neither cmake nor a C++ compiler available to build "
+            "libtpurpc.so"
+        )
+    cpp = _REPO / "cpp"
+    obj_dir = _BUILD / "obj"
+    obj_dir.mkdir(parents=True, exist_ok=True)
+    sources: list[pathlib.Path] = []
+    for sub, pats in (
+        ("base", ("*.cc",)),
+        ("fiber", ("*.cc", "*.S")),
+        ("stat", ("*.cc",)),
+        ("net", ("*.cc",)),
+        ("capi", ("*.cc",)),
+    ):
+        for pat in pats:
+            sources.extend(sorted((cpp / sub).glob(pat)))
+    flags = [
+        "-std=c++20", "-fPIC", "-O2", "-g", "-Wall", "-Wextra",
+        "-Wno-unused-parameter", "-fno-omit-frame-pointer", "-I", str(cpp),
+    ]
+    # A header edit invalidates every object (no dependency scanning here;
+    # conservative and correct).
+    newest_h = 0.0
+    for pat in ("*.h", "*.inc"):
+        for p in cpp.rglob(pat):
+            newest_h = max(newest_h, p.stat().st_mtime)
+
+    def run_tool(cmd: list[str]) -> None:
+        # Surface the compiler diagnostics: a bare CalledProcessError with
+        # swallowed stderr is undiagnosable from an import failure.
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"fallback build failed: {' '.join(cmd[:2])} ...\n"
+                f"{e.stderr}"
+            ) from e
+
+    def compile_one(src: pathlib.Path) -> str:
+        obj = obj_dir / (
+            str(src.relative_to(cpp)).replace("/", "_") + ".o"
+        )
+        if (
+            not obj.exists()
+            or obj.stat().st_mtime < max(src.stat().st_mtime, newest_h)
+        ):
+            run_tool([cxx, *flags, "-c", str(src), "-o", str(obj)])
+        return str(obj)
+
+    with ThreadPoolExecutor(max_workers=os.cpu_count() or 4) as pool:
+        objs = list(pool.map(compile_one, sources))
+    run_tool(
+        [cxx, "-shared", "-o", str(_LIB_PATH), *objs,
+         "-lpthread", "-lrt", "-lz", "-ldl"]
+    )
 
 
 def ensure_built(all_targets: bool = False) -> None:
     """(Re)build the native library when missing or older than any cpp/
     source.  Shared by the bindings and the pytest fixture so there is one
-    build recipe."""
+    build recipe.  Without cmake, falls back to a direct compiler build of
+    the library alone (all_targets callers must check for cmake/ctest
+    themselves and skip)."""
     stale = (
         not _LIB_PATH.exists()
         or _LIB_PATH.stat().st_mtime < _newest_source_mtime()
     )
+    if shutil.which("cmake") is None:
+        if stale:
+            _build_with_compiler()
+        return
     if not stale and not all_targets:
         return
     subprocess.run(
@@ -137,6 +212,25 @@ def load_library() -> ctypes.CDLL:
                 ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
             ]
             lib.trpc_cluster_create.restype = ctypes.c_void_p
+            lib.trpc_cluster_create_ex.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.c_int, ctypes.c_int64, ctypes.c_char_p,
+                ctypes.c_int64, ctypes.c_int64,
+            ]
+            lib.trpc_cluster_create_ex.restype = ctypes.c_void_p
+            # Fault injection (cpp/net/fault.h).
+            lib.trpc_fault_set.argtypes = [ctypes.c_char_p]
+            lib.trpc_fault_set.restype = ctypes.c_int
+            lib.trpc_fault_get.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+            lib.trpc_fault_get.restype = ctypes.c_int
+            lib.trpc_fault_log.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+            lib.trpc_fault_log.restype = ctypes.c_size_t
+            lib.trpc_fault_reset.argtypes = []
+            lib.trpc_fault_injected.restype = ctypes.c_uint64
+            lib.trpc_server_fault_set.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+            ]
+            lib.trpc_server_fault_set.restype = ctypes.c_int
             lib.trpc_cluster_destroy.argtypes = [ctypes.c_void_p]
             lib.trpc_cluster_call.argtypes = [
                 ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,

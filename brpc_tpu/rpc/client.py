@@ -58,15 +58,29 @@ class Channel:
 
 
 class ClusterChannel:
-    """Client over a named cluster with LB + retry + circuit breaking
-    (parity: cpp/net/cluster.h).  naming_url: list://h:p,... or file://path;
-    lb: rr | random | c_hash."""
+    """Client over a named cluster with LB + retry + circuit breaking +
+    hedging (parity: cpp/net/cluster.h).  naming_url: list://h:p,... or
+    file://path; lb: rr | random | c_hash | wrr | p2c | la.
+
+    backup_request_ms > 0 arms hedging: if the primary attempt hasn't
+    answered within that budget a backup races it on another node and the
+    first success wins.  health_check_method probes quarantined nodes every
+    refresh tick and revives any that answer ('' disables probing);
+    refresh_interval_ms is the re-resolve/probe cadence."""
 
     def __init__(self, naming_url: str, lb: str = "rr",
-                 timeout_ms: int = 1000, max_retry: int = 2):
+                 timeout_ms: int = 1000, max_retry: int = 2,
+                 backup_request_ms: int = 0,
+                 health_check_method: str | None = None,
+                 health_check_timeout_ms: int = 0,
+                 refresh_interval_ms: int = 0):
         self._lib = load_library()
-        self._ptr = self._lib.trpc_cluster_create(
-            naming_url.encode(), lb.encode(), timeout_ms, max_retry
+        self._ptr = self._lib.trpc_cluster_create_ex(
+            naming_url.encode(), lb.encode(), timeout_ms, max_retry,
+            backup_request_ms,
+            None if health_check_method is None
+            else health_check_method.encode(),
+            health_check_timeout_ms, refresh_interval_ms,
         )
         if not self._ptr:
             raise ValueError(f"cluster init failed: {naming_url!r}")

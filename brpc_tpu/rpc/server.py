@@ -66,6 +66,14 @@ class Server:
         if self._lib.trpc_server_register(self._ptr, method.encode(), cb, None) != 0:
             raise RuntimeError(f"register {method!r} failed (server running?)")
 
+    def set_faults(self, spec: str) -> None:
+        """Server-side fault injection (cpp/net/fault.h svr_* fields):
+        svr_delay=P:MS delays dispatch, svr_error=P:CODE answers with an
+        injected error, svr_reject=P closes fresh connections.  ''
+        disables.  Callable at runtime; raises on a malformed spec."""
+        if self._lib.trpc_server_fault_set(self._ptr, spec.encode()) != 0:
+            raise ValueError(f"bad server fault schedule: {spec!r}")
+
     def start(self, port: int = 0) -> int:
         if self._lib.trpc_server_start(self._ptr, port) != 0:
             raise RuntimeError("server start failed")

@@ -207,6 +207,12 @@ bool parse_item(const char* data, size_t len, std::string* name,
   if (full > len) {
     return false;
   }
+  // The reference treats names as C-strings INCLUDING the trailing NUL;
+  // a name whose last byte is not NUL is malformed, and stripping it
+  // anyway would silently eat the name's last real byte (ADVICE r5).
+  if (name_size > 0 && data[head_size + name_size - 1] != '\0') {
+    return false;
+  }
   if (name != nullptr) {
     if (name_size > 0) {
       name->assign(data + head_size, name_size - 1);  // strip the NUL

@@ -18,6 +18,7 @@
 #include "net/channel.h"
 #include "net/cluster.h"
 #include "net/controller.h"
+#include "net/fault.h"
 #include "net/ici_transport.h"
 #include "net/server.h"
 
@@ -112,7 +113,10 @@ void* create_channel(const char* addr, int64_t timeout_ms, bool use_shm,
 // first check, per-method bounds at registration); a fresh process using
 // ONLY the flag API would otherwise see "unknown flag".  Touch the static
 // runtime flags here.
-void ensure_runtime_flags() { rpcz_enabled(); }
+void ensure_runtime_flags() {
+  rpcz_enabled();
+  fault_register_flag();
+}
 }  // namespace
 
 void* trpc_channel_create(const char* addr, int64_t timeout_ms) {
@@ -210,14 +214,101 @@ int trpc_channel_call_buf(void* ch, const char* method, void* req_iobuf,
                            resp_iobuf, timeout_ms, err_buf, err_buf_len);
 }
 
+// ---- fault injection (net/fault.h) --------------------------------------
+
+// Installs the process-wide transport fault schedule through the
+// fault_schedule flag (so /flags and /faults observe the same value).
+// Empty spec disables.  Returns 0, nonzero on a malformed spec.
+int trpc_fault_set(const char* spec) {
+  ensure_runtime_flags();
+  return Flag::set("fault_schedule", spec != nullptr ? spec : "");
+}
+
+// Copies the canonical active schedule ("" when off).  Returns 0, or -2
+// when the buffer is too small.
+int trpc_fault_get(char* out, size_t out_len) {
+  const std::string s = FaultActor::global().spec();
+  if (out == nullptr || out_len == 0 || s.size() + 1 > out_len) {
+    return -2;
+  }
+  memcpy(out, s.c_str(), s.size() + 1);
+  return 0;
+}
+
+// Copies the injected-fault log ("#index point kind" lines, oldest
+// first; truncated from the front if the buffer is too small).  Returns
+// the number of bytes written (excluding the NUL).
+size_t trpc_fault_log(char* out, size_t out_len) {
+  if (out == nullptr || out_len == 0) {
+    return 0;
+  }
+  std::string s = FaultActor::global().log_text();
+  if (s.size() + 1 > out_len) {
+    // Truncate from the front on a LINE boundary so the first returned
+    // entry is never a garbled fragment.
+    size_t start = s.size() + 1 - out_len;
+    const size_t nl = s.find('\n', start);
+    start = nl == std::string::npos ? s.size() : nl + 1;
+    s = s.substr(start);
+  }
+  memcpy(out, s.c_str(), s.size() + 1);
+  return s.size();
+}
+
+// Restarts the deterministic sequence (counter + log; schedule kept) —
+// the seam the seed-replay assertion uses.
+void trpc_fault_reset() { FaultActor::global().reset_counters(); }
+
+uint64_t trpc_fault_injected() { return FaultActor::global().injected(); }
+
+// Per-server dispatch/accept fault schedule (svr_* fields).  Returns 0,
+// -1 on a malformed spec.
+int trpc_server_fault_set(void* srv, const char* spec) {
+  return static_cast<Server*>(srv)->SetFaults(spec != nullptr ? spec : "");
+}
+
 // ---- cluster channel ----------------------------------------------------
+
+void* trpc_cluster_create_ex(const char* naming_url, const char* lb,
+                             int64_t timeout_ms, int max_retry,
+                             int64_t backup_request_ms,
+                             const char* health_method,
+                             int64_t health_timeout_ms,
+                             int64_t refresh_interval_ms);
 
 void* trpc_cluster_create(const char* naming_url, const char* lb,
                           int64_t timeout_ms, int max_retry) {
+  return trpc_cluster_create_ex(naming_url, lb, timeout_ms, max_retry, 0,
+                                nullptr, 0, 0);
+}
+
+// Full-option cluster creation: hedging (backup_request_ms > 0 races a
+// second attempt after that budget), health-check probe method/timeout
+// (empty method disables probing) and the re-resolve/probe cadence.
+// Zero/negative numeric options mean "keep the default"; health_method
+// nullptr keeps the default, "" disables.
+void* trpc_cluster_create_ex(const char* naming_url, const char* lb,
+                             int64_t timeout_ms, int max_retry,
+                             int64_t backup_request_ms,
+                             const char* health_method,
+                             int64_t health_timeout_ms,
+                             int64_t refresh_interval_ms) {
   auto* ch = new ClusterChannel();
   ClusterChannel::Options opts;
   opts.timeout_ms = timeout_ms;
   opts.max_retry = max_retry;
+  if (backup_request_ms > 0) {
+    opts.backup_request_ms = backup_request_ms;
+  }
+  if (health_method != nullptr) {
+    opts.health_check_method = health_method;
+  }
+  if (health_timeout_ms > 0) {
+    opts.health_check_timeout_ms = health_timeout_ms;
+  }
+  if (refresh_interval_ms > 0) {
+    opts.refresh_interval_ms = refresh_interval_ms;
+  }
   if (ch->Init(naming_url, lb, &opts) != 0) {
     delete ch;
     return nullptr;

@@ -20,6 +20,7 @@
 #include "base/time.h"
 #include "fiber/fiber.h"
 #include "fiber/fid.h"
+#include "net/fault.h"
 #include "net/http_protocol.h"
 #include "net/server.h"
 #include "net/socket.h"
@@ -178,6 +179,56 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
     }
     *body = name + " = " + f->value_string() + "  # " + f->description() +
             "\n";
+    return true;
+  }
+  if (path == "/faults") {
+    // Live fault-injection control (net/fault.h).  ?set=<spec> installs
+    // the process-wide TRANSPORT schedule (via the fault_schedule flag,
+    // so /flags stays in sync); ?server=<spec> installs THIS server's
+    // dispatch/accept schedule; ?reset=1 restarts both deterministic
+    // sequences (counters + logs; schedules kept).  GET renders state +
+    // the injected-fault log.
+    fault_register_flag();
+    // Validate BOTH specs before applying EITHER: a 400 must mean
+    // "nothing changed", never "half the request armed".
+    const std::string* setv = req.query("set");
+    const std::string* srvv = req.query("server");
+    if (setv != nullptr && !FaultActor::global().parse_ok(*setv)) {
+      *status = 400;
+      *body = "bad fault schedule: " + *setv + "\n";
+      return true;
+    }
+    if (srvv != nullptr && !srv->faults().parse_ok(*srvv)) {
+      *status = 400;
+      *body = "bad server fault schedule: " + *srvv + "\n";
+      return true;
+    }
+    if (setv != nullptr && Flag::set("fault_schedule", *setv) != 0) {
+      *status = 400;
+      *body = "bad fault schedule: " + *setv + "\n";
+      return true;
+    }
+    if (srvv != nullptr && srv->SetFaults(*srvv) != 0) {
+      *status = 400;
+      *body = "bad server fault schedule: " + *srvv + "\n";
+      return true;
+    }
+    const std::string* rst = req.query("reset");
+    if (rst != nullptr && *rst != "0") {
+      FaultActor::global().reset_counters();
+      srv->faults().reset_counters();
+    }
+    FaultActor& g = FaultActor::global();
+    *body = "transport_schedule " + (g.active() ? g.spec() : "(off)") +
+            "\ntransport_decisions " + std::to_string(g.decisions()) +
+            "\ntransport_injected " + std::to_string(g.injected()) +
+            "\nserver_schedule " +
+            (srv->faults().active() ? srv->faults().spec() : "(off)") +
+            "\nserver_decisions " +
+            std::to_string(srv->faults().decisions()) +
+            "\nserver_injected " +
+            std::to_string(srv->faults().injected()) + "\nlog:\n" +
+            g.log_text() + srv->faults().log_text();
     return true;
   }
   if (path == "/rpcz") {
@@ -422,6 +473,7 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
         "/health\n/version\n/status\n/vars\n/vars/<name>\n/brpc_metrics\n"
         "/connections\n/flags\n/flags/<name>[?setvalue=v]\n/threads\n"
         "/memory\n/list\n/protobufs\n/index\n/rpcz[?trace_id=hex]\n"
+        "/faults[?set=spec&server=spec&reset=1]\n"
         "/hotspots[?seconds=N]\n/contention\n/fibers\n/sockets\n/ids\n"
         "/vlog[?setlevel=N]\n/dir/<path>\n"
         "/pprof/profile[?seconds=N]\n/pprof/symbol\n/pprof/cmdline\n"

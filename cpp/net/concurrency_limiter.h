@@ -172,9 +172,16 @@ class TimeoutLimiter final : public ConcurrencyLimiter {
     if (latency_us <= 0) {
       return;
     }
+    // CAS loop, not load/compute/store: concurrent completions would
+    // otherwise overwrite each other's samples, and the estimate lags
+    // exactly under the overload this limiter is meant to gate
+    // (ADVICE r5).
     int64_t avg = avg_latency_us_.load(std::memory_order_relaxed);
-    avg = avg == 0 ? latency_us : (avg * 7 + latency_us) / 8;
-    avg_latency_us_.store(avg, std::memory_order_relaxed);
+    int64_t next;
+    do {
+      next = avg == 0 ? latency_us : (avg * 7 + latency_us) / 8;
+    } while (!avg_latency_us_.compare_exchange_weak(
+        avg, next, std::memory_order_relaxed));
   }
 
   int64_t current_limit() const override {

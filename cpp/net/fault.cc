@@ -1,0 +1,593 @@
+#include "net/fault.h"
+
+#include <errno.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include <algorithm>
+#include <map>
+
+#include "base/flags.h"
+#include "base/iobuf.h"
+#include "base/logging.h"
+#include "net/socket.h"
+#include "net/transport.h"
+
+namespace trpc {
+
+void fiber_sleep_us(int64_t us);  // fiber/fiber.h (avoid the heavy include)
+
+const char* fault_point_name(FaultPoint p) {
+  switch (p) {
+    case FaultPoint::kTx:
+      return "tx";
+    case FaultPoint::kRx:
+      return "rx";
+    case FaultPoint::kConnect:
+      return "connect";
+    case FaultPoint::kDispatch:
+      return "dispatch";
+    case FaultPoint::kAccept:
+      return "accept";
+  }
+  return "?";
+}
+
+const char* fault_kind_name(FaultKind k) {
+  switch (k) {
+    case FaultKind::kNone:
+      return "none";
+    case FaultKind::kDrop:
+      return "drop";
+    case FaultKind::kCorrupt:
+      return "corrupt";
+    case FaultKind::kTrunc:
+      return "trunc";
+    case FaultKind::kPartial:
+      return "partial";
+    case FaultKind::kReset:
+      return "reset";
+    case FaultKind::kRefuse:
+      return "refuse";
+    case FaultKind::kDelay:
+      return "delay";
+    case FaultKind::kSvrDelay:
+      return "svr_delay";
+    case FaultKind::kSvrError:
+      return "svr_error";
+    case FaultKind::kSvrReject:
+      return "svr_reject";
+  }
+  return "?";
+}
+
+namespace {
+
+// splitmix64: the decision PRNG.  Stateless — verdict i is a pure
+// function of (seed, i), which is what makes replay exact regardless of
+// thread interleaving (concurrency can reorder which OPERATION gets
+// index i, never what index i decides).
+uint64_t mix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+double unit_interval(uint64_t r) {
+  return static_cast<double>(r >> 11) * (1.0 / 9007199254740992.0);  // 2^-53
+}
+
+// "P" or "P:EXTRA" → probability (+ optional int64 parameter).
+bool parse_prob(const std::string& v, double* p, int64_t* extra) {
+  const size_t colon = v.find(':');
+  char* end = nullptr;
+  const std::string head = v.substr(0, colon);
+  *p = strtod(head.c_str(), &end);
+  // !(>= && <=) rather than (< || >): NaN fails every comparison, and a
+  // NaN probability would install an "active" schedule that can never
+  // fire — the silent no-op this parser exists to reject.
+  if (end == head.c_str() || *end != '\0' || !(*p >= 0.0 && *p <= 1.0)) {
+    return false;
+  }
+  if (colon == std::string::npos) {
+    return extra == nullptr;  // kinds that need EXTRA must get one
+  }
+  if (extra == nullptr) {
+    return false;
+  }
+  const std::string tail = v.substr(colon + 1);
+  *extra = strtoll(tail.c_str(), &end, 10);
+  return end != tail.c_str() && *end == '\0' && *extra >= 0;
+}
+
+bool parse_u64(const std::string& v, uint64_t* out) {
+  char* end = nullptr;
+  *out = strtoull(v.c_str(), &end, 10);
+  return end != v.c_str() && *end == '\0';
+}
+
+}  // namespace
+
+bool FaultSchedule::parse(const std::string& spec, FaultSchedule* out) {
+  *out = FaultSchedule();
+  size_t pos = 0;
+  while (pos < spec.size()) {
+    size_t end = spec.find_first_of(";,", pos);
+    if (end == std::string::npos) {
+      end = spec.size();
+    }
+    std::string field = spec.substr(pos, end - pos);
+    pos = end + 1;
+    // Trim surrounding whitespace.
+    const size_t b = field.find_first_not_of(" \t");
+    const size_t e = field.find_last_not_of(" \t");
+    if (b == std::string::npos) {
+      continue;
+    }
+    field = field.substr(b, e - b + 1);
+    const size_t eq = field.find('=');
+    if (eq == std::string::npos) {
+      return false;
+    }
+    const std::string key = field.substr(0, eq);
+    const std::string val = field.substr(eq + 1);
+    int64_t extra = 0;
+    bool ok = true;
+    if (key == "seed") {
+      ok = parse_u64(val, &out->seed);
+    } else if (key == "peer") {
+      ok = hostname2endpoint(val.c_str(), &out->peer) == 0;
+      out->has_peer = ok;
+    } else if (key == "after") {
+      ok = parse_u64(val, &out->after);
+    } else if (key == "max") {
+      ok = parse_u64(val, &out->max_faults);
+    } else if (key == "drop") {
+      ok = parse_prob(val, &out->drop, nullptr);
+    } else if (key == "corrupt") {
+      ok = parse_prob(val, &out->corrupt, nullptr);
+    } else if (key == "trunc") {
+      ok = parse_prob(val, &out->trunc, nullptr);
+    } else if (key == "partial") {
+      ok = parse_prob(val, &out->partial, nullptr);
+    } else if (key == "reset") {
+      ok = parse_prob(val, &out->reset, nullptr);
+    } else if (key == "refuse") {
+      ok = parse_prob(val, &out->refuse, nullptr);
+    } else if (key == "delay") {
+      ok = parse_prob(val, &out->delay, &extra);
+      out->delay_ms = extra;
+    } else if (key == "svr_delay") {
+      ok = parse_prob(val, &out->svr_delay, &extra);
+      out->svr_delay_ms = extra;
+    } else if (key == "svr_error") {
+      ok = parse_prob(val, &out->svr_error, &extra) && extra > 0;
+      out->svr_error_code = static_cast<int>(extra);
+    } else if (key == "svr_reject") {
+      ok = parse_prob(val, &out->svr_reject, nullptr);
+    } else {
+      return false;  // unknown key: reject, never silently no-op
+    }
+    if (!ok) {
+      return false;
+    }
+  }
+  return true;
+}
+
+std::string FaultSchedule::to_string() const {
+  char buf[64];
+  std::string s = "seed=" + std::to_string(seed);
+  if (has_peer) {
+    s += ";peer=" + endpoint2str(peer);
+  }
+  if (after != 0) {
+    s += ";after=" + std::to_string(after);
+  }
+  if (max_faults != 0) {
+    s += ";max=" + std::to_string(max_faults);
+  }
+  const auto prob = [&s, &buf](const char* k, double p) {
+    if (p > 0) {
+      snprintf(buf, sizeof(buf), ";%s=%g", k, p);
+      s += buf;
+    }
+  };
+  prob("drop", drop);
+  prob("corrupt", corrupt);
+  prob("trunc", trunc);
+  prob("partial", partial);
+  prob("reset", reset);
+  prob("refuse", refuse);
+  if (delay > 0) {
+    snprintf(buf, sizeof(buf), ";delay=%g:%lld", delay,
+             static_cast<long long>(delay_ms));
+    s += buf;
+  }
+  if (svr_delay > 0) {
+    snprintf(buf, sizeof(buf), ";svr_delay=%g:%lld", svr_delay,
+             static_cast<long long>(svr_delay_ms));
+    s += buf;
+  }
+  if (svr_error > 0) {
+    snprintf(buf, sizeof(buf), ";svr_error=%g:%d", svr_error,
+             svr_error_code);
+    s += buf;
+  }
+  prob("svr_reject", svr_reject);
+  return s;
+}
+
+// ---- FaultActor ----------------------------------------------------------
+
+namespace {
+
+// Scope check: a spec whose fields can never fire on this actor's fault
+// points must be rejected loudly, not installed as a silent no-op.
+bool schedule_in_scope(const FaultSchedule& s, FaultScope scope) {
+  const bool has_transport = s.drop > 0 || s.corrupt > 0 || s.trunc > 0 ||
+                             s.partial > 0 || s.reset > 0 ||
+                             s.refuse > 0 || s.delay > 0;
+  const bool has_server =
+      s.svr_delay > 0 || s.svr_error > 0 || s.svr_reject > 0;
+  switch (scope) {
+    case FaultScope::kTransport:
+      return !has_server;
+    case FaultScope::kServer:
+      return !has_transport;
+    case FaultScope::kAny:
+      break;
+  }
+  return true;
+}
+
+}  // namespace
+
+bool FaultActor::parse_ok(const std::string& spec) const {
+  if (spec.empty()) {
+    return true;
+  }
+  FaultSchedule s;
+  return FaultSchedule::parse(spec, &s) && schedule_in_scope(s, scope_);
+}
+
+int FaultActor::set(const std::string& spec) {
+  std::shared_ptr<const FaultSchedule> fresh;
+  if (!spec.empty()) {
+    auto parsed = std::make_shared<FaultSchedule>();
+    if (!FaultSchedule::parse(spec, parsed.get()) ||
+        !schedule_in_scope(*parsed, scope_)) {
+      return -1;
+    }
+    fresh = std::move(parsed);
+  }
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    schedule_ = fresh;
+  }
+  reset_counters();
+  active_.store(fresh != nullptr, std::memory_order_release);
+  return 0;
+}
+
+std::string FaultActor::spec() const {
+  auto s = snapshot();
+  return s != nullptr ? s->to_string() : std::string();
+}
+
+std::shared_ptr<const FaultSchedule> FaultActor::snapshot() const {
+  std::lock_guard<std::mutex> g(mu_);
+  return schedule_;
+}
+
+void FaultActor::reset_counters() {
+  counter_.store(0, std::memory_order_relaxed);
+  injected_.store(0, std::memory_order_relaxed);
+  std::lock_guard<std::mutex> g(log_mu_);
+  log_.clear();
+  log_head_ = 0;
+}
+
+FaultDecision FaultActor::decide(FaultPoint point, const EndPoint& peer) {
+  FaultDecision d;
+  if (!active()) {
+    return d;
+  }
+  auto sched = snapshot();
+  if (sched == nullptr) {
+    return d;
+  }
+  if (sched->has_peer && !(sched->peer == peer)) {
+    return d;
+  }
+  d.index = counter_.fetch_add(1, std::memory_order_relaxed);
+  if (d.index < sched->after) {
+    return d;
+  }
+  if (sched->max_faults != 0 &&
+      injected_.load(std::memory_order_relaxed) >= sched->max_faults) {
+    return d;
+  }
+  d.rand = mix64(sched->seed ^ (d.index + 1) * 0x9e3779b97f4a7c15ull);
+  const double u = unit_interval(d.rand);
+  // Per-point kinds in fixed precedence; cumulative thresholds so at most
+  // one fires per decision.
+  double cum = 0;
+  const auto hit = [&cum, u](double p) {
+    if (p <= 0) {
+      return false;
+    }
+    cum += p;
+    return u < cum;
+  };
+  switch (point) {
+    case FaultPoint::kTx:
+      if (hit(sched->reset)) {
+        d.kind = FaultKind::kReset;
+      } else if (hit(sched->drop)) {
+        d.kind = FaultKind::kDrop;
+      } else if (hit(sched->trunc)) {
+        d.kind = FaultKind::kTrunc;
+      } else if (hit(sched->corrupt)) {
+        d.kind = FaultKind::kCorrupt;
+      } else if (hit(sched->partial)) {
+        d.kind = FaultKind::kPartial;
+      }
+      break;
+    case FaultPoint::kRx:
+      if (hit(sched->reset)) {
+        d.kind = FaultKind::kReset;
+      } else if (hit(sched->trunc)) {
+        d.kind = FaultKind::kTrunc;
+      } else if (hit(sched->corrupt)) {
+        d.kind = FaultKind::kCorrupt;
+      } else if (hit(sched->delay)) {
+        d.kind = FaultKind::kDelay;
+        d.delay_ms = sched->delay_ms;
+      }
+      break;
+    case FaultPoint::kConnect:
+      if (hit(sched->refuse)) {
+        d.kind = FaultKind::kRefuse;
+      }
+      break;
+    case FaultPoint::kDispatch:
+      if (hit(sched->svr_error)) {
+        d.kind = FaultKind::kSvrError;
+        d.error_code = sched->svr_error_code;
+      } else if (hit(sched->svr_delay)) {
+        d.kind = FaultKind::kSvrDelay;
+        d.delay_ms = sched->svr_delay_ms;
+      }
+      break;
+    case FaultPoint::kAccept:
+      if (hit(sched->svr_reject)) {
+        d.kind = FaultKind::kSvrReject;
+      }
+      break;
+  }
+  if (d.kind != FaultKind::kNone) {
+    // max= is a hard blast-radius bound even under concurrent decisions:
+    // RESERVE a slot (fetch_add-then-check), don't check-then-add — the
+    // early read above is only a fast-path skip.
+    if (sched->max_faults != 0 &&
+        injected_.fetch_add(1, std::memory_order_relaxed) >=
+            sched->max_faults) {
+      injected_.fetch_sub(1, std::memory_order_relaxed);
+      d.kind = FaultKind::kNone;
+      d.delay_ms = 0;
+      d.error_code = 0;
+      return d;
+    }
+    if (sched->max_faults == 0) {
+      injected_.fetch_add(1, std::memory_order_relaxed);
+    }
+    std::lock_guard<std::mutex> g(log_mu_);
+    if (log_.size() < kLogCap) {
+      log_.push_back({d.index, point, d.kind});
+    } else {
+      log_[log_head_] = {d.index, point, d.kind};
+      log_head_ = (log_head_ + 1) % kLogCap;
+    }
+  }
+  return d;
+}
+
+std::string FaultActor::log_text(size_t max_rows) const {
+  std::lock_guard<std::mutex> g(log_mu_);
+  std::string out;
+  const size_t n = log_.size();
+  const size_t take = std::min(n, max_rows);
+  char line[64];
+  for (size_t i = n - take; i < n; ++i) {
+    const LogEntry& e = log_[(log_head_ + i) % std::max<size_t>(n, 1)];
+    snprintf(line, sizeof(line), "#%llu %s %s\n",
+             static_cast<unsigned long long>(e.index),
+             fault_point_name(e.point), fault_kind_name(e.kind));
+    out += line;
+  }
+  return out;
+}
+
+FaultActor& FaultActor::global() {
+  static FaultActor* a = new FaultActor(FaultScope::kTransport);
+  return *a;
+}
+
+// ---- FaultTransport ------------------------------------------------------
+
+namespace {
+
+class FaultTransport final : public Transport {
+ public:
+  explicit FaultTransport(Transport* inner) : inner_(inner) {}
+  Transport* inner() const { return inner_; }
+
+  ssize_t cut_from_iobuf(Socket* s, IOBuf* from) override {
+    FaultActor& a = FaultActor::global();
+    if (!a.active() || from->empty()) {
+      return inner_->cut_from_iobuf(s, from);
+    }
+    const FaultDecision d = a.decide(FaultPoint::kTx, s->remote());
+    switch (d.kind) {
+      case FaultKind::kReset:
+        errno = ECONNRESET;
+        return -1;
+      case FaultKind::kDrop: {
+        // The bytes vanish on the wire but look sent: the caller observes
+        // a stuck peer (timeout path), not a local error.
+        const size_t n = from->size();
+        from->clear();
+        return static_cast<ssize_t>(n);
+      }
+      case FaultKind::kTrunc: {
+        // Deliver a prefix, discard the tail of what was queued.  The
+        // receiver sees a frame that never completes (or misframed
+        // follow-on bytes) — its parser must time out or reject, never
+        // accept a short payload.
+        IOBuf head;
+        from->cutn(&head, from->size() / 2 + 1);
+        from->clear();
+        *from = std::move(head);
+        return inner_->cut_from_iobuf(s, from);
+      }
+      case FaultKind::kCorrupt: {
+        // Flatten-copy then flip one byte: queued blocks may be shared
+        // zero-copy with the caller's request buffer, which must never
+        // be scribbled.
+        std::string flat = from->to_string();
+        flat[d.rand % flat.size()] ^= 0x01;
+        from->clear();
+        from->append(flat);
+        return inner_->cut_from_iobuf(s, from);
+      }
+      case FaultKind::kPartial: {
+        // Only a short prefix moves this round; the rest is re-queued so
+        // KeepWrite exercises its resumption path.
+        IOBuf head;
+        const size_t k =
+            1 + static_cast<size_t>(d.rand % (from->size() / 2 + 1));
+        from->cutn(&head, k);
+        const ssize_t rc = inner_->cut_from_iobuf(s, &head);
+        head.append(std::move(*from));
+        *from = std::move(head);
+        return rc;
+      }
+      default:
+        return inner_->cut_from_iobuf(s, from);
+    }
+  }
+
+  ssize_t append_to_iobuf(Socket* s, IOBuf* to, size_t max) override {
+    FaultActor& a = FaultActor::global();
+    if (!a.active()) {
+      return inner_->append_to_iobuf(s, to, max);
+    }
+    // Read FIRST, decide only when bytes actually arrived: the messenger
+    // drains until EAGAIN, and letting empty reads consume decision
+    // indices would make the seed-replay sequence depend on kernel
+    // chunking instead of on the byte stream.
+    IOBuf tmp;
+    const ssize_t rc = inner_->append_to_iobuf(s, &tmp, max);
+    if (rc <= 0) {
+      return rc;
+    }
+    const FaultDecision d = a.decide(FaultPoint::kRx, s->remote());
+    switch (d.kind) {
+      case FaultKind::kReset:
+        errno = ECONNRESET;
+        return -1;
+      case FaultKind::kDelay:
+        // Park the read fiber: bytes arrive late, connection stays up.
+        fiber_sleep_us(d.delay_ms * 1000);
+        to->append(std::move(tmp));
+        return rc;
+      case FaultKind::kTrunc: {
+        // Never return 0 here: rc > 0 bytes were consumed from the
+        // kernel, and 0 means EAGAIN to the messenger — under ET epoll
+        // that would stall the drain loop, not truncate the stream.
+        const size_t keep = std::max<size_t>(1, tmp.size() / 2);
+        IOBuf head;
+        tmp.cutn(&head, keep);
+        to->append(std::move(head));
+        return static_cast<ssize_t>(keep);
+      }
+      case FaultKind::kCorrupt: {
+        std::string flat = tmp.to_string();
+        flat[d.rand % flat.size()] ^= 0x01;
+        to->append(flat);
+        return rc;
+      }
+      default:
+        to->append(std::move(tmp));
+        return rc;
+    }
+  }
+
+  int connect(Socket* s) override {
+    FaultActor& a = FaultActor::global();
+    if (a.active() &&
+        a.decide(FaultPoint::kConnect, s->remote()).kind ==
+            FaultKind::kRefuse) {
+      errno = ECONNREFUSED;
+      return -1;
+    }
+    return inner_->connect(s);
+  }
+
+  bool fd_based() const override { return inner_->fd_based(); }
+  const char* name() const override { return inner_->name(); }
+
+ private:
+  Transport* const inner_;
+};
+
+}  // namespace
+
+Transport* fault_wrap(Transport* inner) {
+  if (inner == nullptr || dynamic_cast<FaultTransport*>(inner) != nullptr) {
+    return inner;
+  }
+  static std::mutex* mu = new std::mutex();
+  static auto* cache = new std::map<Transport*, Transport*>();
+  std::lock_guard<std::mutex> g(*mu);
+  auto it = cache->find(inner);
+  if (it == cache->end()) {
+    it = cache->emplace(inner, new FaultTransport(inner)).first;
+  }
+  return it->second;
+}
+
+Transport* fault_unwrap(Transport* t) {
+  auto* f = dynamic_cast<FaultTransport*>(t);
+  return f != nullptr ? f->inner() : t;
+}
+
+// ---- flag plumbing -------------------------------------------------------
+
+void fault_register_flag() {
+  static Flag* flag = [] {
+    Flag* f = Flag::define_string(
+        "fault_schedule", "",
+        "transport fault-injection schedule (net/fault.h grammar; empty = "
+        "off)");
+    if (f != nullptr) {
+      f->set_validator([](const std::string& v) {
+        return FaultActor::global().parse_ok(v);
+      });
+      f->on_update([](Flag* self) {
+        FaultActor::global().set(self->string_value());
+      });
+    }
+    return f;
+  }();
+  (void)flag;
+}
+
+namespace {
+// Registered at load so /flags lists it before any /faults request.
+const bool g_fault_flag_registered = (fault_register_flag(), true);
+}  // namespace
+
+}  // namespace trpc

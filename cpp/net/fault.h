@@ -1,0 +1,213 @@
+// Deterministic fault injection — seeded, schedule-driven chaos for the
+// transport plane and the server dispatch path.
+//
+// Motivation ("RPC Considered Harmful", PAPERS.md): what decides whether an
+// RPC stack survives distributed ML workloads is its behavior under
+// transport-level failure, not its API shape.  The retry / hedging /
+// circuit-breaker / health-check machinery in net/cluster.* therefore gets
+// a first-class adversary: a FaultTransport decorator that wraps ANY
+// Transport (tcp, tls, shm_ring, ici) and injects faults drawn from a
+// seeded PRNG, plus server-side fault points (delayed dispatch, forced
+// error codes, reject-at-accept) consulted in server.cc.
+//
+// Determinism: every fault point evaluation consumes one index from an
+// atomic counter and derives its verdict as splitmix64(seed, index) — the
+// (index → decision) mapping is a pure function of the schedule, so a
+// given seed replays the identical fault sequence (the chaos soak's replay
+// assertion).  Injected faults are recorded in a bounded event log.
+//
+// Schedule grammar (';' or ',' separated key[=value] fields, all optional):
+//   seed=N          PRNG seed (default 1)
+//   peer=ip:port    only sockets whose remote matches (default: all)
+//   after=N         pass through the first N decisions (warmup)
+//   max=N           inject at most N faults, then pass through
+//   drop=P          tx: silently discard the queued bytes ("sent" to /dev/null)
+//   corrupt=P       tx+rx: flip one byte of the moved payload
+//   trunc=P         tx+rx: deliver only a prefix, discard the tail
+//   partial=P       tx: write only a small prefix this round (exercises
+//                   KeepWrite resumption / partial-write handling)
+//   reset=P         tx+rx: fail the operation with ECONNRESET
+//   refuse=P        connect: fail with ECONNREFUSED
+//   delay=P:MS      rx: park the read fiber MS ms before delivering
+//   svr_delay=P:MS  server: sleep MS ms before dispatching the handler
+//   svr_error=P:E   server: answer with error code E instead of dispatching
+//   svr_reject=P    server: close freshly accepted connections
+// P is a probability in [0,1].  Probabilities are evaluated per fault
+// point in a fixed precedence order; at most one fault fires per decision.
+// Scoping: drop..delay belong on the GLOBAL transport actor, svr_* on a
+// Server's private actor (Server::SetFaults / /faults?server=); a scoped
+// actor rejects fields it could never fire (see FaultScope).
+//
+// Control planes (all runtime, no rebuild):
+//   - flag "fault_schedule"      (base/flags.h; /flags/fault_schedule?setvalue=)
+//   - builtin "/faults" endpoint (net/builtin.cc; ?set= ?server= ?reset=)
+//   - C ABI trpc_fault_*        (capi/rpc_capi.cc → brpc_tpu/rpc/fault.py)
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "base/endpoint.h"
+
+namespace trpc {
+
+class Transport;
+class Socket;
+
+enum class FaultPoint : uint8_t {
+  kTx = 0,       // Transport::cut_from_iobuf
+  kRx,           // Transport::append_to_iobuf
+  kConnect,      // Transport::connect
+  kDispatch,     // server request dispatch (tstd_process_request)
+  kAccept,       // server accept loop
+};
+
+enum class FaultKind : uint8_t {
+  kNone = 0,
+  kDrop,
+  kCorrupt,
+  kTrunc,
+  kPartial,
+  kReset,
+  kRefuse,
+  kDelay,
+  kSvrDelay,
+  kSvrError,
+  kSvrReject,
+};
+
+const char* fault_point_name(FaultPoint p);
+const char* fault_kind_name(FaultKind k);
+
+// Parsed schedule (immutable once installed; see FaultActor::set).
+struct FaultSchedule {
+  uint64_t seed = 1;
+  bool has_peer = false;
+  EndPoint peer;
+  uint64_t after = 0;
+  uint64_t max_faults = 0;  // 0 = unlimited
+  double drop = 0, corrupt = 0, trunc = 0, partial = 0, reset = 0,
+         refuse = 0;
+  double delay = 0;
+  int64_t delay_ms = 0;
+  double svr_delay = 0;
+  int64_t svr_delay_ms = 0;
+  double svr_error = 0;
+  int svr_error_code = 0;
+  double svr_reject = 0;
+
+  // Parses `spec` (grammar above).  Returns false on any unknown key or
+  // malformed value — a typo'd schedule must not silently mean "no
+  // faults" (same contract as parse_concurrency_spec).
+  static bool parse(const std::string& spec, FaultSchedule* out);
+  std::string to_string() const;  // canonical re-rendering
+};
+
+// One fault-point verdict.  `rand` is the decision's raw draw — fault
+// implementations reuse it for sub-choices (byte offset, prefix length)
+// so those stay seed-deterministic too.
+struct FaultDecision {
+  FaultKind kind = FaultKind::kNone;
+  int64_t delay_ms = 0;
+  int error_code = 0;
+  uint64_t index = 0;
+  uint64_t rand = 0;
+};
+
+// Which fault-point family an actor serves.  Installing a spec whose
+// only active kinds belong to the OTHER scope would silently inject
+// nothing — exactly the "typo'd schedule must never silently mean no
+// faults" failure — so scoped actors reject mis-scoped fields loudly.
+enum class FaultScope : uint8_t {
+  kAny = 0,        // unit tests / embedders driving decide() directly
+  kTransport,      // kTx/kRx/kConnect: drop..refuse/delay only
+  kServer,         // kDispatch/kAccept: svr_* only
+};
+
+// A schedule + its decision counter + injected-fault log.  One global
+// instance drives every FaultTransport; each Server owns a private one
+// for its dispatch/accept points (so one node of an in-process cluster
+// can fail while its siblings stay clean).
+class FaultActor {
+ public:
+  explicit FaultActor(FaultScope scope = FaultScope::kAny)
+      : scope_(scope) {}
+
+  // Installs a schedule ("" disables).  Returns 0, or -1 on parse error
+  // OR a field outside this actor's scope (previous schedule kept).
+  // Resets the decision counter and log — installing a schedule starts a
+  // fresh deterministic sequence.
+  int set(const std::string& spec);
+  std::string spec() const;
+  // Parse + scope pre-check without installing (the /faults endpoint
+  // validates both specs before applying either).
+  bool parse_ok(const std::string& spec) const;
+
+  // Fast inactive check (one relaxed load) for hot paths.
+  bool active() const { return active_.load(std::memory_order_acquire); }
+
+  // Draws the verdict for one fault-point evaluation.  kNone when
+  // inactive, the peer filter excludes `peer`, the warmup/max bounds
+  // apply, or the dice say pass.
+  FaultDecision decide(FaultPoint point, const EndPoint& peer);
+
+  // Restarts the deterministic sequence: counter to zero, log cleared
+  // (schedule kept).  The seed-replay test is: set → run → log_text →
+  // reset_counters → run → log_text, expecting identical text.
+  void reset_counters();
+
+  uint64_t decisions() const {
+    return counter_.load(std::memory_order_relaxed);
+  }
+  uint64_t injected() const {
+    return injected_.load(std::memory_order_relaxed);
+  }
+  // "#<index> <point> <kind>" per injected fault, oldest first.  The
+  // default renders everything the ring retains (kLogCap entries; older
+  // ones fall off the ring itself).
+  std::string log_text(size_t max_rows = 512) const;
+
+  // The process-wide transport-plane actor.
+  static FaultActor& global();
+
+ private:
+  std::shared_ptr<const FaultSchedule> snapshot() const;
+
+  const FaultScope scope_ = FaultScope::kAny;
+  mutable std::mutex mu_;
+  std::shared_ptr<const FaultSchedule> schedule_;
+  std::atomic<bool> active_{false};
+  std::atomic<uint64_t> counter_{0};
+  std::atomic<uint64_t> injected_{0};
+
+  struct LogEntry {
+    uint64_t index;
+    FaultPoint point;
+    FaultKind kind;
+  };
+  static constexpr size_t kLogCap = 512;
+  mutable std::mutex log_mu_;
+  std::vector<LogEntry> log_;
+  size_t log_head_ = 0;  // ring cursor once log_ reaches kLogCap
+};
+
+// Returns the (cached, process-lifetime) FaultTransport decorating
+// `inner`.  Idempotent: wrapping a wrapper returns it unchanged.  The
+// decorator forwards name()/fd_based() so observable transport identity
+// ("tcp", "shm_ring") is unchanged; when the global actor is inactive the
+// overhead is one virtual hop + one atomic load.
+Transport* fault_wrap(Transport* inner);
+
+// The wrapped transport's inner instance (t itself when not a wrapper).
+Transport* fault_unwrap(Transport* t);
+
+// Registers the "fault_schedule" flag (idempotent); called from static
+// init in fault.cc and from ensure_runtime_flags in the C ABI so a fresh
+// process sees the flag before first use.
+void fault_register_flag();
+
+}  // namespace trpc

@@ -401,6 +401,34 @@ void rx_stage_deleter(void*, void* vctx) {
   delete ctx;
 }
 
+// Maps a REMOTE peer's staging slab READ-ONLY: the receiver only ever
+// reads published ranges, and a receiver-side bug scribbling the sender's
+// registered payload memory would corrupt frames the sender believes are
+// immutably in flight (ADVICE r5).  Only the loopback branch — where the
+// "peer" slab IS our own registry mapping — stays writable.
+std::shared_ptr<StageMapping> map_peer_stage(const std::string& name) {
+  const int fd = shm_open(name.c_str(), O_RDONLY, 0600);
+  if (fd < 0) {
+    return nullptr;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size <= 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, static_cast<size_t>(st.st_size), PROT_READ,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    return nullptr;
+  }
+  auto m = std::make_shared<StageMapping>();
+  m->base = static_cast<char*>(mem);
+  m->len = static_cast<size_t>(st.st_size);
+  m->owned = true;
+  return m;
+}
+
 // Maps the peer's staging slab `ordinal` on first reference (bounded to
 // keep a hostile peer from exhausting mappings); validates the range.
 // On success fills *mapping (the ref-counted holder; RxStageCtx co-owns
@@ -434,26 +462,10 @@ char* resolve_stage_source(IciConn& c, uint32_t ordinal, uint64_t offset,
         return nullptr;
       }
     } else {
-      const std::string name = stage_shm_name(pid, ordinal);
-      const int fd = shm_open(name.c_str(), O_RDWR, 0600);
-      if (fd < 0) {
+      m = map_peer_stage(stage_shm_name(pid, ordinal));
+      if (m == nullptr) {
         return nullptr;
       }
-      struct stat st;
-      if (fstat(fd, &st) != 0 || st.st_size <= 0) {
-        close(fd);
-        return nullptr;
-      }
-      void* mem = mmap(nullptr, static_cast<size_t>(st.st_size),
-                       PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
-      close(fd);
-      if (mem == MAP_FAILED) {
-        return nullptr;
-      }
-      m = std::make_shared<StageMapping>();
-      m->base = static_cast<char*>(mem);
-      m->len = static_cast<size_t>(st.st_size);
-      m->owned = true;
     }
     it = c.stage_maps.emplace(ordinal, std::move(m)).first;
   }
@@ -956,7 +968,12 @@ class IciRingTransport final : public Transport {
           if (rn.block->user_deleter == nullptr ||
               !staging_of(rn.block->data + rn.offset, rn.length, &ord2,
                           &off2) ||
-              ord2 != ord || off2 != end) {
+              ord2 != ord || off2 != end ||
+              // Descriptor lengths publish as uint32 (slot.len below):
+              // growing past UINT32_MAX would silently truncate at the
+              // static_cast and corrupt >4GiB staged frames — the tail
+              // refs start a fresh WR instead (ADVICE r5).
+              !ici_desc_len_fits(wr.size(), rn.length)) {
             break;
           }
           total += from->cutn(&wr, rn.length);
@@ -1294,6 +1311,24 @@ void ici_conn_set_self_pid(IciConn& c, int32_t pid) {
 
 void ici_conn_corrupt_tx_consumed(IciConn& c, uint64_t value) {
   c.tx_dir().desc_consumed.store(value, std::memory_order_release);
+}
+
+std::string ici_test_stage_shm_name(int32_t pid, uint32_t ordinal) {
+  return stage_shm_name(pid, ordinal);
+}
+
+char* ici_test_map_peer_stage(const std::string& shm_name, size_t* len_out) {
+  auto m = map_peer_stage(shm_name);
+  if (m == nullptr) {
+    return nullptr;
+  }
+  if (len_out != nullptr) {
+    *len_out = m->len;
+  }
+  // Detach: the caller owns the munmap (test-only path).
+  char* base = m->base;
+  m->owned = false;
+  return base;
 }
 
 }  // namespace trpc

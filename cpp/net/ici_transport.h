@@ -138,4 +138,19 @@ void ici_conn_set_self_pid(IciConn& c, int32_t pid);
 // poller must fail the socket (EPROTO), not wedge draining toward it.
 void ici_conn_corrupt_tx_consumed(IciConn& c, uint64_t value);
 
+// Descriptor lengths publish as uint32: a coalesced zero-copy WR may only
+// grow while the published length stays exact (the >4GiB truncation guard
+// in cut_from_iobuf's staging coalesce loop; ADVICE r5).
+constexpr bool ici_desc_len_fits(uint64_t cur_size, uint64_t add_len) {
+  return cur_size + add_len <= 0xffffffffull;
+}
+
+// Test hooks for the peer-staging mapping path (resolve_stage_source):
+// the shm name a peer derives for (pid, ordinal), and the same READ-ONLY
+// mapping a receiver makes of a remote peer's staging slab (regression:
+// a receiver-side bug must not be able to scribble the sender's
+// registered payload memory).  Caller munmaps base/len.
+std::string ici_test_stage_shm_name(int32_t pid, uint32_t ordinal);
+char* ici_test_map_peer_stage(const std::string& shm_name, size_t* len_out);
+
 }  // namespace trpc

@@ -478,17 +478,29 @@ void Server::on_acceptable(SocketId id, void* ctx) {
     if (fd < 0) {
       break;  // EAGAIN or error; ET will refire on next connection
     }
-    Socket::Options opts;
-    opts.fd = fd;
+    EndPoint peer_ep;
     if (peer_sa.ss_family == AF_UNIX) {
       // Unix peers are anonymous; identify them by our listening path.
-      opts.remote.unix_path = srv->unix_path_;
+      peer_ep.unix_path = srv->unix_path_;
     } else {
+      const auto* sin = reinterpret_cast<const sockaddr_in*>(&peer_sa);
+      peer_ep.ip = sin->sin_addr.s_addr;
+      peer_ep.port = ntohs(sin->sin_port);
+    }
+    // Fault point: reject-at-accept (net/fault.h svr_reject) — the peer
+    // sees an immediate close, exercising its connect-retry path.
+    if (srv->faults_.active() &&
+        srv->faults_.decide(FaultPoint::kAccept, peer_ep).kind ==
+            FaultKind::kSvrReject) {
+      close(fd);
+      continue;
+    }
+    Socket::Options opts;
+    opts.fd = fd;
+    opts.remote = peer_ep;
+    if (peer_sa.ss_family != AF_UNIX) {
       int one = 1;
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      const auto* sin = reinterpret_cast<const sockaddr_in*>(&peer_sa);
-      opts.remote.ip = sin->sin_addr.s_addr;
-      opts.remote.port = ntohs(sin->sin_port);
     }
     opts.on_readable = &messenger_on_readable;
     opts.user_data = srv;
@@ -729,6 +741,22 @@ void tstd_process_request(InputMessage&& msg) {
       cntl->SetFailed(ec, et);
       done();
       return;
+    }
+  }
+  // Fault points: forced error / delayed dispatch (net/fault.h svr_error,
+  // svr_delay).  A forced error is a CLEAN failure — the client gets a
+  // well-formed response frame carrying the injected code; a delay parks
+  // this request's fiber, exercising client timeout/hedging machinery.
+  if (srv->faults().active()) {
+    const FaultDecision fd =
+        srv->faults().decide(FaultPoint::kDispatch, sock->remote());
+    if (fd.kind == FaultKind::kSvrError) {
+      cntl->SetFailed(fd.error_code, "injected server fault");
+      done();
+      return;
+    }
+    if (fd.kind == FaultKind::kSvrDelay) {
+      fiber_sleep_us(fd.delay_ms * 1000);
     }
   }
   srv->maybe_dump(method, msg.meta.attachment_size, msg.payload);

@@ -22,6 +22,7 @@
 #include "net/concurrency_limiter.h"
 #include "net/controller.h"
 #include "net/data_pool.h"
+#include "net/fault.h"
 #include "net/socket.h"
 #include "stat/latency_recorder.h"
 
@@ -240,6 +241,15 @@ class Server {
   void maybe_dump(const std::string& method, uint32_t attachment_size,
                   const IOBuf& payload);
 
+  // Server-side fault injection (net/fault.h; svr_delay / svr_error /
+  // svr_reject fields): a PRIVATE actor per server, so one node of an
+  // in-process cluster can misbehave while its siblings stay clean (the
+  // chaos soak's quarantine-isolation scenario).  "" disables; callable
+  // at runtime (also reachable via this server's /faults?server=...).
+  // Returns 0, or -1 on a malformed spec (previous schedule kept).
+  int SetFaults(const std::string& spec) { return faults_.set(spec); }
+  FaultActor& faults() { return faults_; }
+
  private:
   static void on_acceptable(SocketId id, void* ctx);
   int64_t start_time_us_ = 0;
@@ -280,6 +290,9 @@ class Server {
   std::mutex conns_mu_;
   std::vector<SocketId> conns_;      // stale ids harmless (versioned)
   std::vector<SocketId> drain_ids_;  // failed at Stop; awaited in ~Server
+  // Server-side fault points; kServer scope rejects transport-only specs
+  // that could never fire here (silent no-op prevention).
+  FaultActor faults_{FaultScope::kServer};
 };
 
 }  // namespace trpc

@@ -11,6 +11,7 @@
 #include "base/time.h"
 #include "fiber/fiber.h"
 #include "fiber/scheduler.h"
+#include "net/fault.h"
 #include "net/protocol.h"
 #include "net/dispatcher.h"
 
@@ -56,7 +57,11 @@ void Socket::reset_for_reuse(const Options& opts) {
   fd_ = opts.fd;
   mode_ = opts.mode;
   remote_ = opts.remote;
-  transport_ = opts.transport != nullptr ? opts.transport : tcp_transport();
+  // Every socket's transport rides behind the fault-injection decorator
+  // (net/fault.h): one atomic load when inactive, schedule-driven chaos
+  // when armed — runtime-togglable without touching live sockets.
+  transport_ = fault_wrap(
+      opts.transport != nullptr ? opts.transport : tcp_transport());
   transport_ctx_holder_ = opts.transport_ctx_holder;
   transport_ctx = transport_ctx_holder_.get();
   failed_.store(false, std::memory_order_relaxed);

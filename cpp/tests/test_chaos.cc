@@ -1,0 +1,559 @@
+// Chaos soak for the retry / hedging / quarantine stack under the
+// deterministic fault-injection subsystem (net/fault.h).
+//
+// What is being proven (ISSUE 1 acceptance):
+//   (a) under drop/delay/corrupt/trunc/reset schedules every client call
+//       either succeeds with EXACT payload or fails with a clean error —
+//       no hangs, no accepted-but-corrupted responses (checksummed);
+//   (b) quarantine isolates a faulty node and health-check probes restore
+//       it once faults clear;
+//   (c) a given seed replays the identical fault sequence.
+#include <unistd.h>
+
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "base/flags.h"
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "net/channel.h"
+#include "net/cluster.h"
+#include "net/controller.h"
+#include "net/fault.h"
+#include "net/server.h"
+#include "net/transport.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+namespace {
+
+// Clears the global schedule on every exit path so one test's chaos can
+// never leak into the next.
+struct FaultGuard {
+  ~FaultGuard() { FaultActor::global().set(""); }
+};
+
+struct Node {
+  Server server;
+  int port = 0;
+};
+
+Node g_nodes[3];
+bool g_started = false;
+
+void start_nodes() {
+  if (g_started) {
+    return;
+  }
+  g_started = true;
+  for (int i = 0; i < 3; ++i) {
+    g_nodes[i].server.RegisterMethod(
+        "Echo.Echo", [](Controller*, const IOBuf& req, IOBuf* resp,
+                        Closure done) {
+          resp->append(req);
+          done();
+        });
+    g_nodes[i].server.RegisterMethod(
+        "Echo.WhoAmI",
+        [i](Controller*, const IOBuf&, IOBuf* resp, Closure done) {
+          resp->append("node-" + std::to_string(i));
+          done();
+        });
+    EXPECT_EQ(g_nodes[i].server.Start(0), 0);
+    g_nodes[i].port = g_nodes[i].server.port();
+  }
+}
+
+std::string node_addr(int i) {
+  return "127.0.0.1:" + std::to_string(g_nodes[i].port);
+}
+
+std::string list_url() {
+  start_nodes();
+  return "list://" + node_addr(0) + "," + node_addr(1) + "," + node_addr(2);
+}
+
+}  // namespace
+
+// ---- schedule grammar ----------------------------------------------------
+
+TEST_CASE(schedule_parse_roundtrip) {
+  FaultSchedule s;
+  EXPECT(FaultSchedule::parse(
+      "seed=42;peer=127.0.0.1:8002;after=10;max=5;drop=0.25;"
+      "delay=0.1:50;svr_error=0.5:1234", &s));
+  EXPECT_EQ(s.seed, 42u);
+  EXPECT(s.has_peer);
+  EXPECT_EQ(s.peer.port, 8002);
+  EXPECT_EQ(s.after, 10u);
+  EXPECT_EQ(s.max_faults, 5u);
+  EXPECT(s.drop == 0.25);
+  EXPECT(s.delay == 0.1);
+  EXPECT_EQ(s.delay_ms, 50);
+  EXPECT(s.svr_error == 0.5);
+  EXPECT_EQ(s.svr_error_code, 1234);
+  // Canonical rendering re-parses to the same schedule.
+  FaultSchedule s2;
+  EXPECT(FaultSchedule::parse(s.to_string(), &s2));
+  EXPECT_EQ(s2.seed, s.seed);
+  EXPECT(s2.drop == s.drop);
+  EXPECT_EQ(s2.delay_ms, s.delay_ms);
+  // Whitespace + comma separators are accepted.
+  EXPECT(FaultSchedule::parse("seed=1, drop=0.5", &s));
+  // Rejections: unknown key, bad probability, missing/forbidden extras.
+  EXPECT(!FaultSchedule::parse("dorp=0.5", &s));
+  EXPECT(!FaultSchedule::parse("drop=1.5", &s));
+  EXPECT(!FaultSchedule::parse("drop=nan", &s));
+  EXPECT(!FaultSchedule::parse("drop=inf", &s));
+  EXPECT(!FaultSchedule::parse("drop=0.5:10", &s));
+  EXPECT(!FaultSchedule::parse("delay=0.5", &s));
+  EXPECT(!FaultSchedule::parse("svr_error=0.5:0", &s));
+  EXPECT(!FaultSchedule::parse("drop", &s));
+  EXPECT(!FaultSchedule::parse("peer=notanaddr", &s));
+}
+
+TEST_CASE(decision_stream_is_seed_deterministic) {
+  // (c) at the engine level: the (index → verdict) mapping is a pure
+  // function of the schedule, independent of actor instance.
+  const char* spec = "seed=7;drop=0.3;corrupt=0.2;reset=0.1";
+  EndPoint ep;
+  EXPECT_EQ(hostname2endpoint("127.0.0.1:9999", &ep), 0);
+  FaultActor a, b;
+  EXPECT_EQ(a.set(spec), 0);
+  EXPECT_EQ(b.set(spec), 0);
+  std::vector<FaultKind> seq_a, seq_b;
+  for (int i = 0; i < 500; ++i) {
+    seq_a.push_back(a.decide(FaultPoint::kTx, ep).kind);
+    seq_b.push_back(b.decide(FaultPoint::kTx, ep).kind);
+  }
+  EXPECT(seq_a == seq_b);
+  EXPECT(a.injected() > 0);           // the dice actually fired
+  EXPECT(a.injected() < 500);         // ... and pass sometimes too
+  EXPECT(a.log_text() == b.log_text());
+  // reset_counters restarts the identical sequence.
+  const std::string log1 = a.log_text();
+  a.reset_counters();
+  EXPECT_EQ(a.injected(), 0u);
+  for (int i = 0; i < 500; ++i) {
+    a.decide(FaultPoint::kTx, ep);
+  }
+  EXPECT(a.log_text() == log1);
+  // A different seed gives a different stream.
+  FaultActor c;
+  EXPECT_EQ(c.set("seed=8;drop=0.3;corrupt=0.2;reset=0.1"), 0);
+  std::vector<FaultKind> seq_c;
+  for (int i = 0; i < 500; ++i) {
+    seq_c.push_back(c.decide(FaultPoint::kTx, ep).kind);
+  }
+  EXPECT(seq_a != seq_c);
+}
+
+TEST_CASE(after_and_max_bound_the_faults) {
+  EndPoint ep;
+  EXPECT_EQ(hostname2endpoint("127.0.0.1:9999", &ep), 0);
+  FaultActor a;
+  EXPECT_EQ(a.set("seed=3;drop=1;after=10;max=4"), 0);
+  int faulted = 0;
+  for (int i = 0; i < 100; ++i) {
+    const FaultDecision d = a.decide(FaultPoint::kTx, ep);
+    if (d.kind != FaultKind::kNone) {
+      EXPECT(d.index >= 10);  // warmup passed through
+      ++faulted;
+    }
+  }
+  EXPECT_EQ(faulted, 4);  // capped by max
+  // The cap is a HARD bound under concurrency too (slot reservation, not
+  // check-then-inject): hammer a fresh actor from 8 threads.
+  FaultActor hammered;
+  EXPECT_EQ(hammered.set("seed=3;drop=1;max=7"), 0);
+  {
+    std::vector<std::thread> threads;
+    for (int t = 0; t < 8; ++t) {
+      threads.emplace_back([&hammered, &ep] {
+        for (int i = 0; i < 200; ++i) {
+          hammered.decide(FaultPoint::kTx, ep);
+        }
+      });
+    }
+    for (auto& th : threads) {
+      th.join();
+    }
+  }
+  EXPECT_EQ(hammered.injected(), 7u);
+  // Peer filter: a non-matching remote never draws (or counts).
+  FaultActor b;
+  EXPECT_EQ(b.set("seed=3;drop=1;peer=127.0.0.1:1"), 0);
+  for (int i = 0; i < 50; ++i) {
+    EXPECT(b.decide(FaultPoint::kTx, ep).kind == FaultKind::kNone);
+  }
+  EXPECT_EQ(b.decisions(), 0u);
+  // Bad spec keeps the previous schedule.
+  EXPECT_EQ(b.set("drop=oops"), -1);
+  EXPECT(b.active());
+}
+
+TEST_CASE(mis_scoped_schedules_rejected_loudly) {
+  // A parseable spec whose kinds can never fire on the target actor must
+  // be rejected, not installed as a silent no-op (the same contract as
+  // typo rejection).
+  start_nodes();
+  EXPECT_EQ(FaultActor::global().set("seed=1;svr_delay=1:50"), -1);
+  EXPECT(!FaultActor::global().active());
+  EXPECT(!FaultActor::global().parse_ok("svr_error=1:13"));
+  EXPECT_EQ(g_nodes[0].server.SetFaults("seed=1;drop=0.5"), -1);
+  EXPECT(!g_nodes[0].server.faults().active());
+  EXPECT(!g_nodes[0].server.faults().parse_ok("reset=1"));
+  // Correctly-scoped specs still land on either side.
+  EXPECT_EQ(FaultActor::global().set("seed=1;drop=0.5;max=1"), 0);
+  EXPECT_EQ(g_nodes[0].server.SetFaults("seed=1;svr_reject=0.5"), 0);
+  EXPECT_EQ(FaultActor::global().set(""), 0);
+  EXPECT_EQ(g_nodes[0].server.SetFaults(""), 0);
+  // An unscoped actor (unit-test harness form) accepts both families.
+  FaultActor any;
+  EXPECT_EQ(any.set("drop=0.5;svr_reject=0.5"), 0);
+}
+
+TEST_CASE(fault_transport_wraps_and_forwards_identity) {
+  Transport* tcp = tcp_transport();
+  Transport* wrapped = fault_wrap(tcp);
+  EXPECT(wrapped != tcp);
+  EXPECT_EQ(fault_wrap(tcp), wrapped);        // cached
+  EXPECT_EQ(fault_wrap(wrapped), wrapped);    // idempotent
+  EXPECT_EQ(fault_unwrap(wrapped), tcp);
+  EXPECT(std::string(wrapped->name()) == "tcp");
+  EXPECT_EQ(wrapped->fd_based(), tcp->fd_based());
+}
+
+// ---- fault behaviors through the live stack ------------------------------
+
+namespace {
+
+// One checksummed echo call; returns 0 on success (payload verified
+// EXACT) or the clean error code.  Any hang is caught by the timeout;
+// any accepted-but-wrong payload fails the test immediately.
+int checked_echo(Channel& ch, const std::string& payload,
+                 int64_t timeout_ms = 400) {
+  Controller cntl;
+  cntl.set_timeout_ms(timeout_ms);
+  cntl.set_enable_checksum(true);
+  IOBuf req, resp;
+  req.append(payload);
+  ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+  if (cntl.Failed()) {
+    EXPECT(cntl.error_code() != 0);  // clean: a real code, not silence
+    return cntl.error_code();
+  }
+  EXPECT_EQ(resp.size(), payload.size());
+  EXPECT(resp.to_string() == payload);
+  return 0;
+}
+
+}  // namespace
+
+TEST_CASE(tx_reset_fails_cleanly) {
+  start_nodes();
+  FaultGuard guard;
+  Channel ch;
+  EXPECT_EQ(ch.Init(node_addr(0)), 0);
+  EXPECT_EQ(checked_echo(ch, "warm"), 0);  // connection up
+  EXPECT_EQ(FaultActor::global().set("seed=1;reset=1;peer=" + node_addr(0)),
+            0);
+  const int rc = checked_echo(ch, "doomed");
+  EXPECT(rc != 0);
+  EXPECT(FaultActor::global().injected() > 0);
+  // Clearing the schedule heals the channel (fresh socket, clean call).
+  EXPECT_EQ(FaultActor::global().set(""), 0);
+  EXPECT_EQ(checked_echo(ch, "healed"), 0);
+}
+
+TEST_CASE(connect_refused_fails_cleanly) {
+  start_nodes();
+  FaultGuard guard;
+  EXPECT_EQ(
+      FaultActor::global().set("seed=1;refuse=1;peer=" + node_addr(0)), 0);
+  Channel ch;
+  EXPECT_EQ(ch.Init(node_addr(0)), 0);
+  EXPECT(checked_echo(ch, "nope") != 0);
+}
+
+TEST_CASE(tx_drop_times_out_not_hangs) {
+  start_nodes();
+  FaultGuard guard;
+  Channel ch;
+  EXPECT_EQ(ch.Init(node_addr(1)), 0);
+  EXPECT_EQ(checked_echo(ch, "warm"), 0);
+  EXPECT_EQ(FaultActor::global().set("seed=1;drop=1;peer=" + node_addr(1)),
+            0);
+  const int64_t t0 = monotonic_time_us();
+  const int rc = checked_echo(ch, "into-the-void", 250);
+  const int64_t dt_ms = (monotonic_time_us() - t0) / 1000;
+  EXPECT_EQ(rc, ETIMEDOUT);
+  EXPECT(dt_ms >= 200 && dt_ms < 5000);  // timed out, did not hang
+}
+
+TEST_CASE(corruption_never_yields_wrong_payload) {
+  // corrupt=1 scrambles EVERY moved chunk both ways; with checksums on,
+  // every call must fail (or — impossible here — succeed exactly).
+  start_nodes();
+  FaultGuard guard;
+  EXPECT_EQ(
+      FaultActor::global().set("seed=5;corrupt=1;peer=" + node_addr(2)), 0);
+  Channel ch;
+  EXPECT_EQ(ch.Init(node_addr(2)), 0);
+  int failures = 0;
+  for (int i = 0; i < 5; ++i) {
+    if (checked_echo(ch, "payload-" + std::to_string(i), 300) != 0) {
+      ++failures;
+    }
+  }
+  EXPECT(failures == 5);
+  EXPECT(FaultActor::global().injected() > 0);
+}
+
+TEST_CASE(server_fault_points) {
+  start_nodes();
+  // Forced error code: a CLEAN well-formed error response.
+  EXPECT_EQ(g_nodes[0].server.SetFaults("seed=1;svr_error=1:1234"), 0);
+  {
+    Channel ch;
+    EXPECT_EQ(ch.Init(node_addr(0)), 0);
+    Controller cntl;
+    cntl.set_timeout_ms(500);
+    IOBuf req, resp;
+    req.append("x");
+    ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+    EXPECT(cntl.Failed());
+    EXPECT_EQ(cntl.error_code(), 1234);
+  }
+  // Reject-at-accept: fresh connections die; the client sees a clean
+  // error, not a hang.
+  EXPECT_EQ(g_nodes[0].server.SetFaults("seed=1;svr_reject=1"), 0);
+  {
+    Channel ch;
+    EXPECT_EQ(ch.Init(node_addr(0)), 0);
+    EXPECT(checked_echo(ch, "rejected", 300) != 0);
+  }
+  // Delayed dispatch: the call takes at least the injected delay.
+  EXPECT_EQ(g_nodes[0].server.SetFaults("seed=1;svr_delay=1:120"), 0);
+  {
+    Channel ch;
+    EXPECT_EQ(ch.Init(node_addr(0)), 0);
+    const int64_t t0 = monotonic_time_us();
+    EXPECT_EQ(checked_echo(ch, "slow", 1000), 0);
+    EXPECT((monotonic_time_us() - t0) / 1000 >= 100);
+  }
+  EXPECT_EQ(g_nodes[0].server.SetFaults(""), 0);
+  EXPECT(!g_nodes[0].server.faults().active());
+  {
+    Channel ch;
+    EXPECT_EQ(ch.Init(node_addr(0)), 0);
+    EXPECT_EQ(checked_echo(ch, "post-clear"), 0);
+  }
+}
+
+TEST_CASE(hedging_beats_delayed_node) {
+  // Satellite: backup_request_ms racing a second node while the primary
+  // is stuck behind an injected server-side delay.  With ALL nodes
+  // delayed except the backup candidates, whichever primary the LB picks
+  // the hedge must win well before the 400ms injected delay.
+  start_nodes();
+  EXPECT_EQ(g_nodes[0].server.SetFaults("seed=1;svr_delay=1:400"), 0);
+  ClusterChannel ch;
+  ClusterChannel::Options opts;
+  opts.timeout_ms = 2000;
+  opts.backup_request_ms = 60;
+  EXPECT_EQ(ch.Init(list_url(), "rr", &opts), 0);
+  int fast = 0;
+  for (int i = 0; i < 6; ++i) {
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("x");
+    const int64_t t0 = monotonic_time_us();
+    ch.CallMethod("Echo.WhoAmI", req, &resp, &cntl);
+    const int64_t dt_ms = (monotonic_time_us() - t0) / 1000;
+    EXPECT(!cntl.Failed());
+    if (dt_ms < 350) {
+      ++fast;
+      EXPECT(resp.to_string() != "node-0");  // the delayed node lost
+    }
+  }
+  // rr lands on node-0 in 2 of every 3 calls; hedges must have rescued
+  // them (without hedging those calls take the full 400ms delay).
+  EXPECT(fast >= 4);
+  EXPECT_EQ(g_nodes[0].server.SetFaults(""), 0);
+}
+
+TEST_CASE(fault_transport_composes_with_shm_ring) {
+  // Acceptance: the decorator wraps fd-less transports too.  Establish a
+  // same-host ring channel, then fail its (wrapped) ring transport — the
+  // call dies cleanly and the channel re-handshakes once faults clear.
+  start_nodes();
+  FaultGuard guard;
+  Channel ch;
+  Channel::Options copts;
+  copts.use_shm = true;
+  EXPECT_EQ(ch.Init(node_addr(0), &copts), 0);
+  EXPECT_EQ(checked_echo(ch, "over-rings"), 0);
+  EXPECT(ch.transport_name() == "shm_ring");  // identity forwards through
+  EXPECT_EQ(FaultActor::global().set("seed=4;reset=1"), 0);
+  EXPECT(checked_echo(ch, "doomed") != 0);
+  EXPECT(FaultActor::global().injected() > 0);
+  EXPECT_EQ(FaultActor::global().set(""), 0);
+  EXPECT_EQ(checked_echo(ch, "healed"), 0);
+  EXPECT(ch.transport_name() == "shm_ring");  // fresh rings, not tcp
+}
+
+// ---- the soak ------------------------------------------------------------
+
+TEST_CASE(chaos_soak_escalating_schedules) {
+  start_nodes();
+  FaultGuard guard;
+  ClusterChannel ch;
+  ClusterChannel::Options opts;
+  opts.timeout_ms = 250;
+  opts.max_retry = 2;
+  opts.quarantine_base_ms = 50;
+  opts.quarantine_max_ms = 400;
+  opts.health_check_method = "Echo.Echo";
+  opts.refresh_interval_ms = 100;
+  EXPECT_EQ(ch.Init(list_url(), "rr", &opts), 0);
+  // Escalating phases, installed through the FLAG path (the same seam
+  // /flags and /faults use).  Every call must complete (success or clean
+  // error) and every success must carry the exact payload.
+  const char* phases[] = {
+      "seed=11;drop=0.15;delay=0.2:30",
+      "seed=12;corrupt=0.2;trunc=0.1;partial=0.3",
+      "seed=13;reset=0.2;refuse=0.2;drop=0.1",
+  };
+  for (const char* phase : phases) {
+    EXPECT_EQ(Flag::set("fault_schedule", phase), 0);
+    int ok = 0, clean_fail = 0;
+    for (int i = 0; i < 25; ++i) {
+      const std::string payload =
+          "soak-" + std::to_string(i) + std::string(64, 'x');
+      Controller cntl;
+      cntl.set_enable_checksum(true);
+      IOBuf req, resp;
+      req.append(payload);
+      const int64_t t0 = monotonic_time_us();
+      ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+      const int64_t dt_ms = (monotonic_time_us() - t0) / 1000;
+      EXPECT(dt_ms < 5000);  // bounded: never hangs
+      if (cntl.Failed()) {
+        EXPECT(cntl.error_code() != 0);
+        ++clean_fail;
+      } else {
+        EXPECT(resp.to_string() == payload);  // exact, never corrupted
+        ++ok;
+      }
+    }
+    // Retry + multiple nodes must rescue a healthy majority of calls.
+    EXPECT(ok > 0);
+    (void)clean_fail;
+  }
+  EXPECT_EQ(Flag::set("fault_schedule", ""), 0);
+  // Post-chaos: the cluster heals completely.
+  for (int i = 0; i < 6; ++i) {
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("healed");
+    ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+    EXPECT(resp.to_string() == "healed");
+  }
+}
+
+TEST_CASE(quarantine_isolates_then_probes_revive) {
+  start_nodes();
+  FaultGuard guard;
+  ClusterChannel ch;
+  ClusterChannel::Options opts;
+  opts.timeout_ms = 250;
+  opts.max_retry = 2;
+  // Quarantine windows far beyond the test horizon: ONLY health-check
+  // probes can revive the node (expiry cannot), which is exactly the
+  // behavior under test.
+  opts.quarantine_base_ms = 60000;
+  opts.quarantine_max_ms = 60000;
+  opts.health_check_method = "Echo.WhoAmI";
+  opts.health_check_timeout_ms = 150;
+  opts.refresh_interval_ms = 100;
+  EXPECT_EQ(ch.Init(list_url(), "rr", &opts), 0);
+  // Fault ONLY node 1: every byte toward it dies with a reset.
+  EXPECT_EQ(
+      FaultActor::global().set("seed=2;reset=1;peer=" + node_addr(1)), 0);
+  // Drive calls until the breaker isolates node 1.  Calls themselves
+  // must keep succeeding (retry routes around the faulty node).
+  int64_t deadline = monotonic_time_us() + 10 * 1000 * 1000;
+  while (ch.healthy_count() != 2 && monotonic_time_us() < deadline) {
+    Controller cntl;
+    cntl.set_enable_checksum(true);
+    IOBuf req, resp;
+    req.append("x");
+    ch.CallMethod("Echo.WhoAmI", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());  // retries rescue every call
+  }
+  EXPECT_EQ(ch.healthy_count(), 2u);
+  // While quarantined, traffic spreads over the two healthy nodes only.
+  for (int i = 0; i < 8; ++i) {
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("x");
+    ch.CallMethod("Echo.WhoAmI", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+    EXPECT(resp.to_string() != "node-1");
+  }
+  // Faults clear → the next probe tick revives node 1 (windows cannot
+  // expire within the test, so a revival PROVES the probe path).
+  EXPECT_EQ(FaultActor::global().set(""), 0);
+  deadline = monotonic_time_us() + 10 * 1000 * 1000;
+  while (ch.healthy_count() != 3 && monotonic_time_us() < deadline) {
+    usleep(20 * 1000);
+  }
+  EXPECT_EQ(ch.healthy_count(), 3u);
+  // ... and node 1 actually serves again.
+  std::set<std::string> seen;
+  for (int i = 0; i < 9; ++i) {
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("x");
+    ch.CallMethod("Echo.WhoAmI", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+    seen.insert(resp.to_string());
+  }
+  EXPECT(seen.count("node-1") == 1);
+}
+
+TEST_CASE(seed_replay_end_to_end) {
+  // (c) through the live stack: one client, one node, sequential
+  // checksummed calls — the injected-fault log replays byte-identical
+  // for the same seed.
+  start_nodes();
+  FaultGuard guard;
+  // drop-only: a dropped frame never perturbs the connection, so the
+  // per-call decision sequence (connect, tx, rx-per-response) is exactly
+  // reproducible; kinds that kill sockets reconnect at racy times.
+  const std::string spec = "seed=21;drop=0.25;peer=" + node_addr(2);
+  std::string logs[2];
+  int outcomes[2][12];
+  for (int run = 0; run < 2; ++run) {
+    EXPECT_EQ(FaultActor::global().set(spec), 0);  // set resets counters
+    Channel ch;
+    EXPECT_EQ(ch.Init(node_addr(2)), 0);
+    for (int i = 0; i < 12; ++i) {
+      outcomes[run][i] = checked_echo(ch, "replay-" + std::to_string(i),
+                                      200);
+    }
+    logs[run] = FaultActor::global().log_text();
+  }
+  EXPECT(!logs[0].empty());
+  EXPECT(logs[0] == logs[1]);
+  for (int i = 0; i < 12; ++i) {
+    EXPECT_EQ(outcomes[0][i], outcomes[1][i]);
+  }
+}
+
+TEST_MAIN

@@ -691,4 +691,60 @@ TEST_CASE(ici_dead_peer_reaped_and_segment_unlinked) {
   EXPECT(unlinked);
 }
 
+TEST_CASE(ici_coalesce_desc_len_guard) {
+  // Regression (ADVICE r5): the staging coalesce loop publishes the WR
+  // length as uint32; growing a coalesced WR past UINT32_MAX would
+  // silently truncate at the static_cast and corrupt >4GiB frames.  The
+  // guard must stop EXACTLY at the boundary.
+  const uint64_t max32 = 0xffffffffull;
+  EXPECT(ici_desc_len_fits(0, max32));
+  EXPECT(ici_desc_len_fits(max32 - 1, 1));
+  EXPECT(ici_desc_len_fits(max32, 0));
+  EXPECT(!ici_desc_len_fits(max32, 1));
+  EXPECT(!ici_desc_len_fits(max32 - 1, 2));
+  // The old loop bound (2^31 pre-append) admitted a 4GiB-1 ref on top of
+  // a near-2^31 WR — exactly the silent-truncation shape.
+  EXPECT(!ici_desc_len_fits((1ull << 31) - 1, max32));
+  EXPECT(ici_desc_len_fits((1ull << 31) - 1, 1ull << 31));
+}
+
+TEST_CASE(ici_peer_stage_maps_read_only) {
+  // Regression (ADVICE r5): a REMOTE peer's staging slab must map
+  // PROT_READ — a receiver-side bug scribbling the sender's registered
+  // payload memory would corrupt frames the sender believes are already
+  // immutably in flight.  Map our own slab through the same path a
+  // remote receiver uses and check the kernel's view of the mapping.
+  constexpr size_t kLen = 64 * 1024;
+  uint32_t ord = 0;
+  char* stage = static_cast<char*>(ici_staging_alloc(kLen, &ord));
+  EXPECT(stage != nullptr);
+  memset(stage, 0x5a, kLen);
+  const std::string name = ici_test_stage_shm_name(getpid(), ord);
+  size_t mapped_len = 0;
+  char* ro = ici_test_map_peer_stage(name, &mapped_len);
+  EXPECT(ro != nullptr);
+  EXPECT(mapped_len >= kLen);
+  EXPECT(ro[0] == 0x5a && ro[kLen - 1] == 0x5a);  // readable, same bytes
+  // /proc/self/maps must report the mapping read-only ("r--").
+  char want[64];
+  snprintf(want, sizeof(want), "%lx-", reinterpret_cast<unsigned long>(ro));
+  FILE* maps = fopen("/proc/self/maps", "r");
+  EXPECT(maps != nullptr);
+  bool found = false, readonly = false;
+  char line[512];
+  while (fgets(line, sizeof(line), maps) != nullptr) {
+    if (strncmp(line, want, strlen(want)) == 0) {
+      found = true;
+      const char* perms = strchr(line, ' ');
+      readonly = perms != nullptr && strncmp(perms + 1, "r--", 3) == 0;
+      break;
+    }
+  }
+  fclose(maps);
+  EXPECT(found);
+  EXPECT(readonly);
+  munmap(ro, mapped_len);
+  ici_staging_free(stage);
+}
+
 TEST_MAIN

@@ -134,6 +134,17 @@ TEST_CASE(mcpack_rejects_malformed) {
   // Bad string (missing trailing NUL).
   const char bad_str[] = {static_cast<char>(0xD0), 0x00, 0x02, 'h', 'i'};
   EXPECT(!McpackValue::parse(bad_str, sizeof(bad_str), &out));
+  // Name whose last byte is not NUL (ADVICE r5): the reference treats
+  // names as C-strings INCLUDING the NUL, so this is malformed — it must
+  // be REJECTED, not silently parsed with its last real byte eaten
+  // (golden layout: 0xD0, name_size, value_size, name..., value...).
+  const char bad_name[] = {static_cast<char>(0xD0), 0x02, 0x03,
+                           'k',  'X',  'h', 'i', 0x00};
+  EXPECT(!McpackValue::parse(bad_name, sizeof(bad_name), &out));
+  // Control: the same item with a proper NUL-terminated name parses.
+  const char good_name[] = {static_cast<char>(0xD0), 0x02, 0x03,
+                            'k',  0x00, 'h', 'i', 0x00};
+  EXPECT(McpackValue::parse(good_name, sizeof(good_name), &out));
   // Iso array with non-fixed element type.
   const char bad_iso[] = {0x30, 0x00, 0x02, 0x00, 0x00, 0x00, 0x50, 0x00};
   EXPECT(!McpackValue::parse(bad_iso, sizeof(bad_iso), &out));

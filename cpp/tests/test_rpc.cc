@@ -8,6 +8,8 @@
 
 #include <atomic>
 #include <string>
+#include <thread>
+#include <vector>
 
 #include "base/compress.h"
 #include "base/device_arena.h"
@@ -333,6 +335,47 @@ TEST_CASE(concurrency_limiter_timeout_kind) {
     }
   }
   EXPECT(recovered);
+}
+
+TEST_CASE(timeout_limiter_ema_update_is_atomic) {
+  // Regression (ADVICE r5): on_response used a load/compute/store EMA
+  // update; concurrent completions overwrote each other's samples and the
+  // estimate lagged exactly under overload.  Now a CAS loop folds EVERY
+  // sample in.
+  // Sequential semantics are unchanged: avg' = (avg*7 + sample)/8.
+  {
+    TimeoutLimiter gate(1000);
+    EXPECT(gate.on_request());
+    gate.on_response(8000, false);  // first sample seeds the EMA
+    EXPECT_EQ(gate.current_limit(), 1000000 / 8000);
+    EXPECT(gate.on_request());
+    gate.on_response(16000, false);  // (8000*7 + 16000)/8 = 9000
+    EXPECT_EQ(gate.current_limit(), 1000000 / 9000);
+  }
+  // Concurrent hammering: every admission is paired with one response,
+  // all with the same latency — whatever the interleaving, an EMA that
+  // loses no samples must sit EXACTLY on that latency (any torn update
+  // would have to manufacture a different value to land elsewhere), and
+  // the inflight ledger must drain to a state that still admits.
+  {
+    static TimeoutLimiter gate(1 << 20);  // budget wide open: all admitted
+    constexpr int kThreads = 8, kIters = 5000;
+    std::vector<std::thread> threads;
+    for (int t = 0; t < kThreads; ++t) {
+      threads.emplace_back([] {
+        for (int i = 0; i < kIters; ++i) {
+          EXPECT(gate.on_request());
+          gate.on_response(4096, false);
+        }
+      });
+    }
+    for (auto& th : threads) {
+      th.join();
+    }
+    EXPECT_EQ(gate.current_limit(), (1ll << 20) * 1000 / 4096);
+    EXPECT(gate.on_request());  // ledger drained: depth 1 admits
+    gate.on_response(4096, false);
+  }
 }
 
 TEST_CASE(connect_refused_times_out) {

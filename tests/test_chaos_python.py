@@ -1,0 +1,193 @@
+"""Chaos soak from Python: the deterministic fault-injection subsystem
+(cpp/net/fault.h) driven through its three control planes — the
+trpc_fault_* bindings (brpc_tpu.rpc.fault), the runtime /faults HTTP
+endpoint on a live server (no rebuild, no restart), and per-server
+svr_* fault points — against the retry/hedge/quarantine stack.
+
+Acceptance (ISSUE 1): every call under chaos either succeeds with the
+exact payload or raises a clean RpcError (no hangs, no corrupted bytes
+accepted — the wire checksum turns corruption into failure); a
+quarantined node returns to rotation once faults clear; and a given seed
+replays the identical fault sequence."""
+
+import time
+import urllib.request
+
+import pytest
+
+from brpc_tpu.rpc import Channel, ClusterChannel, RpcError, Server, fault
+
+
+@pytest.fixture()
+def cluster3():
+    """Three echo servers + their list:// naming url."""
+    servers = []
+    for i in range(3):
+        srv = Server()
+
+        def echo(call, req):
+            call.respond(req)
+
+        def who(call, req, i=i):
+            call.respond(b"node-%d" % i)
+
+        srv.register("Echo.Echo", echo)
+        srv.register("Echo.WhoAmI", who)
+        srv.start(0)
+        servers.append(srv)
+    url = "list://" + ",".join(f"127.0.0.1:{s.port}" for s in servers)
+    yield servers, url
+    fault.set_schedule("")
+    for s in servers:
+        s.set_faults("")
+        s.stop()
+
+
+def _http(port: int, path: str) -> str:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5
+    ) as r:
+        return r.read().decode()
+
+
+def test_faults_runtime_toggle_over_http(cluster3):
+    """The /faults builtin flips the live transport schedule with zero
+    rebuild: calls fail while armed, heal when cleared — and the /flags
+    view stays in sync (one knob, two spellings)."""
+    servers, _ = cluster3
+    port = servers[0].port
+    ch = Channel(f"127.0.0.1:{port}", timeout_ms=300)
+    assert ch.call("Echo.Echo", b"before") == b"before"
+
+    body = _http(port, f"/faults?set=seed=9;reset=1;peer=127.0.0.1:{port}")
+    assert "transport_schedule seed=9" in body
+    with pytest.raises(RpcError):
+        ch.call("Echo.Echo", b"doomed")
+    # Injected faults show up as "#<index> <point> reset" LOG lines (the
+    # log section, not the schedule rendering).
+    assert any(
+        line.startswith("#") and line.endswith("reset")
+        for line in _http(port, "/faults").splitlines()
+    )
+    assert fault.injected() > 0
+    assert "seed=9" in _http(port, "/flags/fault_schedule")
+
+    body = _http(port, "/faults?set=")
+    assert "transport_schedule (off)" in body
+    assert ch.call("Echo.Echo", b"after") == b"after"
+
+    # Per-server dispatch faults ride the same endpoint (?server=).
+    _http(port, "/faults?server=seed=1;svr_error=1:1234")
+    with pytest.raises(RpcError) as ei:
+        ch.call("Echo.Echo", b"x")
+    assert ei.value.code == 1234
+    _http(port, "/faults?server=")
+    assert ch.call("Echo.Echo", b"healed") == b"healed"
+
+    # A typo'd schedule is rejected loudly, never silently "no faults" —
+    # and so is a mis-scoped one (svr_* belongs to Server.set_faults).
+    with pytest.raises(urllib.error.HTTPError):
+        _http(port, "/faults?set=dorp=0.5")
+    with pytest.raises(urllib.error.HTTPError):
+        _http(port, "/faults?set=svr_delay=1:50")
+    with pytest.raises(ValueError):
+        fault.set_schedule("svr_error=1:13")
+    with pytest.raises(ValueError):
+        servers[0].set_faults("drop=0.5")
+    ch.close()
+
+
+def test_seed_replay_via_bindings(cluster3):
+    """Same seed → identical injected-fault sequence (drop-only so the
+    connection itself never churns; see cpp/tests/test_chaos.cc)."""
+    servers, _ = cluster3
+    port = servers[2].port
+    spec = f"seed=21;drop=0.25;peer=127.0.0.1:{port}"
+    logs, outcomes = [], []
+    for _ in range(2):
+        fault.set_schedule(spec)  # installing restarts the sequence
+        assert fault.get_schedule().startswith("seed=21")
+        ch = Channel(f"127.0.0.1:{port}", timeout_ms=200)
+        run = []
+        for i in range(12):
+            payload = b"replay-%d" % i
+            try:
+                assert ch.call("Echo.Echo", payload) == payload
+                run.append("ok")
+            except RpcError as e:
+                assert e.code != 0
+                run.append("err")
+        ch.close()
+        logs.append(fault.log())
+        outcomes.append(run)
+        fault.set_schedule("")
+    assert logs[0], "expected the dice to fire at least once"
+    assert logs[0] == logs[1]
+    assert outcomes[0] == outcomes[1]
+
+
+def test_hedging_fires_against_delayed_node(cluster3):
+    """Satellite: backup_request_ms through the Python ClusterChannel —
+    with node 0 stuck behind an injected 400ms dispatch delay, hedged
+    calls finish fast on another node; without hedging they crawl."""
+    servers, url = cluster3
+    servers[0].set_faults("seed=1;svr_delay=1:400")
+
+    hedged = ClusterChannel(url, "rr", timeout_ms=2000, backup_request_ms=60)
+    fast = 0
+    for _ in range(6):
+        t0 = time.monotonic()
+        resp = hedged.call("Echo.WhoAmI", b"x")
+        dt_ms = (time.monotonic() - t0) * 1000
+        if dt_ms < 350:
+            fast += 1
+            assert resp != b"node-0"  # the delayed node lost the race
+    # rr lands on node-0 two calls in three; hedges must rescue those.
+    assert fast >= 4
+    hedged.close()
+
+    plain = ClusterChannel(url, "rr", timeout_ms=2000)
+    slow = 0
+    for _ in range(3):
+        t0 = time.monotonic()
+        plain.call("Echo.WhoAmI", b"x")
+        if (time.monotonic() - t0) * 1000 >= 350:
+            slow += 1
+    assert slow >= 1  # at least one call ate the full delay un-hedged
+    plain.close()
+    servers[0].set_faults("")
+
+
+def test_chaos_soak_and_quarantine_revival(cluster3):
+    """The soak: reset-storm one node of three via the bindings; every
+    call must succeed (retries route around it), the breaker must
+    quarantine the faulty node, and clearing the schedule must bring it
+    back into rotation (the 100ms probe cadence beats the default
+    quarantine windows; cpp/tests/test_chaos.cc pins the windows beyond
+    the horizon for the strict probes-only proof)."""
+    servers, url = cluster3
+    bad_port = servers[1].port
+    ch = ClusterChannel(
+        url, "rr", timeout_ms=250, max_retry=2,
+        health_check_method="Echo.WhoAmI", health_check_timeout_ms=150,
+        refresh_interval_ms=100,
+    )
+    # ClusterChannel has no healthy_count binding; observe quarantine
+    # through traffic: once isolated, node-1 vanishes from responses.
+    fault.set_schedule(f"seed=2;reset=1;peer=127.0.0.1:{bad_port}")
+    for _ in range(6):
+        assert ch.call("Echo.WhoAmI", b"x") in (b"node-0", b"node-2")
+    assert fault.injected() > 0
+    seen = {ch.call("Echo.WhoAmI", b"x") for _ in range(8)}
+    assert b"node-1" not in seen
+    assert seen == {b"node-0", b"node-2"}
+
+    fault.set_schedule("")
+    deadline = time.monotonic() + 10
+    revived = False
+    while time.monotonic() < deadline and not revived:
+        revived = ch.call("Echo.WhoAmI", b"x") == b"node-1"
+        if not revived:
+            time.sleep(0.05)
+    assert revived, "health-check probes must restore the node"
+    ch.close()

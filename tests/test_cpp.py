@@ -9,6 +9,7 @@ exactly that 11 of 26 binaries were green-but-ungated.
 
 import pathlib
 import re
+import shutil
 import subprocess
 
 import pytest
@@ -16,9 +17,13 @@ import pytest
 REPO = pathlib.Path(__file__).resolve().parent.parent
 BUILD = REPO / "build"
 
+_NO_CMAKE = shutil.which("cmake") is None or shutil.which("ctest") is None
+
 
 @pytest.fixture(scope="session", autouse=True)
 def built():
+    if _NO_CMAKE:
+        return  # targets are skip-marked below; nothing to build here
     from brpc_tpu.rpc._lib import ensure_built
 
     try:
@@ -27,7 +32,18 @@ def built():
         pytest.fail(f"C++ build failed:\n{e.stdout}\n{e.stderr}")
 
 
-def _ctest_targets() -> list[str]:
+def _ctest_targets() -> list:
+    # Minimal images bake a compiler but no cmake/ctest: the shared
+    # library still builds (brpc_tpu.rpc._lib falls back to direct g++),
+    # but the unit BINARIES need the cmake tree — skip them instead of
+    # blowing up the whole collection with FileNotFoundError.
+    if _NO_CMAKE:
+        return [pytest.param(
+            "unavailable",
+            marks=pytest.mark.skip(
+                reason="cmake/ctest not installed; C++ unit binaries "
+                       "require the cmake build"),
+        )]
     # Collection runs before fixtures; a fresh checkout has no build tree
     # yet, so configure it here (full compile still happens in `built`).
     if not (BUILD / "CTestTestfile.cmake").exists():
