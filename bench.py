@@ -393,14 +393,25 @@ class _RowReader:
 
 def _cpp_rows() -> list:
     """Loopback numbers from the C++ runtime (multi_threaded_echo analogue);
-    skipped when the binary isn't built."""
+    builds the binary on demand (works without cmake), else skips."""
     exe = os.path.join(os.path.dirname(os.path.abspath(__file__)), "build",
                        "bench_echo")
-    if not os.path.exists(exe):
-        return []
+    try:
+        from brpc_tpu.rpc._lib import ensure_bench_echo
+
+        exe = str(ensure_bench_echo())
+    except Exception:  # noqa: BLE001 — fall back to a prebuilt binary
+        if not os.path.exists(exe):
+            return []
     rows = []
+    # Small-payload rows cover single AND multi-connection (pooled) so the
+    # wait-free hot path (inline writes, batched dispatch, bulk wakeups)
+    # is tracked per round; large rows guard against coalescing
+    # regressions on the throughput path.
     for fibers, payload, conn in (
         (64, 1024, "single"),
+        (64, 1024, "pooled"),
+        (256, 1024, "pooled"),
         (8, 2 << 20, "single"),
         (8, 2 << 20, "pooled"),
     ):

@@ -127,6 +127,34 @@ def ensure_built(all_targets: bool = False) -> None:
 _ensure_built = ensure_built
 
 
+def ensure_bench_echo() -> pathlib.Path:
+    """Build build/bench_echo (the C++ loopback echo benchmark) when
+    missing or stale.  Links against libtpurpc.so so it works on
+    cmake-less images too; bench.py and the perf smoke test share it."""
+    ensure_built()
+    exe = _BUILD / "bench_echo"
+    src = _REPO / "cpp" / "tools" / "bench_echo.cc"
+    if exe.exists() and exe.stat().st_mtime >= max(
+        src.stat().st_mtime, _LIB_PATH.stat().st_mtime
+    ):
+        return exe
+    cxx = shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
+    if cxx is None:
+        raise FileNotFoundError("no C++ compiler to build bench_echo")
+    subprocess.run(
+        [
+            cxx, "-std=c++20", "-O2", "-g", "-fno-omit-frame-pointer",
+            "-I", str(_REPO / "cpp"), str(src),
+            "-L", str(_BUILD), f"-Wl,-rpath,{_BUILD}",
+            "-ltpurpc", "-lpthread", "-o", str(exe),
+        ],
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+    return exe
+
+
 def load_library() -> ctypes.CDLL:
     global _lib
     with _lock:

@@ -11,6 +11,9 @@ namespace trpc {
 
 namespace {
 constexpr int kMaxIov = 64;
+// The write side carries whole coalesced KeepWrite batches (many small
+// responses → many refs); a bigger budget keeps one drain = one writev.
+constexpr int kMaxWriteIov = 256;
 }
 
 IOBuf::IOBuf(const IOBuf& other) : size_(other.size_), arena_(other.arena_) {
@@ -316,8 +319,8 @@ ssize_t IOBuf::append_from_fd(int fd, size_t max_bytes) {
 }
 
 ssize_t IOBuf::cut_into_fd(int fd, size_t max_bytes) {
-  iovec iov[kMaxIov];
-  const int n = fill_iovec(iov, kMaxIov, max_bytes);
+  iovec iov[kMaxWriteIov];
+  const int n = fill_iovec(iov, kMaxWriteIov, max_bytes);
   if (n == 0) {
     return 0;
   }

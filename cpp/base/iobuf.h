@@ -38,6 +38,16 @@ class IOBuf {
   bool empty() const { return size_ == 0; }
   size_t block_count() const { return refs_.size(); }
   void clear();
+  // Heap bytes pinned by the refs vector itself (the blocks are released
+  // by clear(); this capacity is what a pooled empty IOBuf still holds).
+  size_t ref_capacity_bytes() const {
+    return refs_.capacity() * sizeof(BlockRef);
+  }
+  // clear() + drop the refs vector's heap storage (pooled-object cap).
+  void shrink_storage() {
+    clear();
+    std::vector<BlockRef>().swap(refs_);
+  }
 
   // -- writing ---------------------------------------------------------
   void append(const void* data, size_t n);

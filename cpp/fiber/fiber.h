@@ -44,6 +44,23 @@ void fiber_init(int workers);
 int fiber_worker_count();
 
 int fiber_start(fiber_t* out, void (*fn)(void*), void* arg, int flags = 0);
+// Bulk spawn: starts fn(args[i]) for i in [0, n) and publishes them with
+// ONE ParkingLot signal per 64-fiber stride (one futex syscall wakes up
+// to 64 workers, where 64 fiber_start calls would signal — and
+// potentially syscall — 64 times).  Queue-push order follows args order,
+// but EXECUTION
+// order is unspecified (a spawning worker pops its own run queue LIFO and
+// thieves steal FIFO) — callers needing strict FIFO must publish from a
+// non-worker thread into a single-worker tag group, or order themselves.
+// Tag/urgent flags as fiber_start (the whole batch shares them; urgent
+// claims the one-deep priority slot for the FIRST fiber only).  Returns
+// the number of fibers actually started (< n only on pool exhaustion).
+size_t fiber_start_batch(void (*fn)(void*), void* const* args, size_t n,
+                         int flags = 0);
+// Cumulative bulk-wake telemetry: batches published, fibers across them,
+// and the largest single batch (stat/ exposes these as /vars series).
+void fiber_bulk_wake_stats(uint64_t* batches, uint64_t* fibers,
+                           uint64_t* max_batch);
 // Waits until the fiber finishes.  Returns 0 (also for already-gone ids).
 int fiber_join(fiber_t f);
 // Parks the calling fiber until `fd` has any of `events` (EPOLLIN /

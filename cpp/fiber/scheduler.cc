@@ -209,6 +209,65 @@ void Scheduler::push_remote(FiberMeta* m) {
   g.remote_q.push_back(m);
 }
 
+void Scheduler::ready_to_run_batch(FiberMeta* const* ms, size_t n,
+                                   bool urgent) {
+  if (n == 0) {
+    return;
+  }
+  if (n == 1) {
+    ready_to_run(ms[0], urgent);
+    return;
+  }
+  TagGroup& g = tags_[ms[0]->tag];
+  Worker* w = tls_worker;
+  if (w != nullptr && (w->tag() != ms[0]->tag || in_pthread_wait_mode())) {
+    w = nullptr;
+  }
+  size_t first = 0;
+  if (urgent && w != nullptr) {
+    // Urgent batches claim the one-deep priority slot for their FIRST
+    // fiber (the slot is one-deep by design); the rest queue normally
+    // but still ride the elevated signal below.
+    FiberMeta* expect = nullptr;
+    if (w->urgent_.compare_exchange_strong(expect, ms[0],
+                                           std::memory_order_acq_rel)) {
+      first = 1;
+    }
+  }
+  if (w != nullptr) {
+    // Push to the caller's own queue in order; thieves + the signal below
+    // fan the batch out.  Overflow spills to the remote queue under ONE
+    // lock (a nearly-full runq is exactly the loaded case where per-node
+    // locking would hurt).
+    size_t i = first;
+    while (i < n && w->runq().push(ms[i])) {
+      ++i;
+    }
+    if (i < n) {
+      std::lock_guard<std::mutex> lk(g.remote_mu);
+      for (; i < n; ++i) {
+        g.remote_q.push_back(ms[i]);
+      }
+    }
+  } else {
+    std::lock_guard<std::mutex> lk(g.remote_mu);
+    for (size_t i = first; i < n; ++i) {
+      g.remote_q.push_back(ms[i]);
+    }
+  }
+  bulk_wake_batches.fetch_add(1, std::memory_order_relaxed);
+  bulk_wake_fibers.fetch_add(n, std::memory_order_relaxed);
+  uint64_t cur = bulk_wake_max.load(std::memory_order_relaxed);
+  while (n > cur && !bulk_wake_max.compare_exchange_weak(
+                        cur, n, std::memory_order_relaxed)) {
+  }
+  // ONE signal for the whole batch: a single FUTEX_WAKE releases up to n
+  // parked workers, where per-spawn publication would re-enter the futex
+  // path n times.  Urgent batches wake one extra worker, mirroring
+  // ready_to_run's signal(2) bias.
+  g.lot.signal(static_cast<int>(n) + (urgent ? 1 : 0));
+}
+
 bool Scheduler::pop_remote(FiberMeta** out, int tag) {
   TagGroup& g = tags_[tag];
   std::lock_guard<std::mutex> lk(g.remote_mu);
@@ -363,13 +422,12 @@ int fiber_worker_count_tag(int tag) {
   return Scheduler::instance()->worker_count(tag);
 }
 
-int fiber_start(fiber_t* out, void (*fn)(void*), void* arg, int flags) {
-  Scheduler* sched = Scheduler::instance();
-  if (!sched->started()) {
-    sched->start(0);
-  }
-  // Tag resolution: explicit flag wins; otherwise inherit the spawning
-  // worker's tag (keeps a tagged server's downstream fibers in-group).
+namespace {
+
+// Shared by fiber_start / fiber_start_batch: resolve the worker tag from
+// `flags` (explicit flag wins, else inherit the spawning worker's tag) and
+// provision its group.  Returns the tag, or -1 for an out-of-range flag.
+int resolve_spawn_tag(Scheduler* sched, int flags) {
   int tag = (flags >> 8) & 0xff;
   if (tag == 0) {
     tag = fiber_current_tag();
@@ -382,10 +440,15 @@ int fiber_start(fiber_t* out, void (*fn)(void*), void* arg, int flags) {
   if (tag != 0 && sched->worker_count(tag) == 0) {
     sched->start_tag(tag, 0);  // auto-provision a default-sized group
   }
+  return tag;
+}
+
+// Acquire + initialize one runnable meta (not yet published).
+FiberMeta* make_fiber_meta(void (*fn)(void*), void* arg, int tag) {
   FiberMeta* m = nullptr;
   const uint32_t slot = FiberPool::instance()->acquire(&m);
   if (m == nullptr) {
-    return -1;
+    return nullptr;
   }
   m->slot = slot;
   m->tag = static_cast<uint8_t>(tag);
@@ -398,11 +461,72 @@ int fiber_start(fiber_t* out, void (*fn)(void*), void* arg, int flags) {
   m->version.store(ver, std::memory_order_relaxed);
   m->stack = allocate_stack(kDefaultStackSize);
   m->sp = make_context(m->stack.base, m->stack.size, fiber_entry);
+  return m;
+}
+
+}  // namespace
+
+int fiber_start(fiber_t* out, void (*fn)(void*), void* arg, int flags) {
+  Scheduler* sched = Scheduler::instance();
+  if (!sched->started()) {
+    sched->start(0);
+  }
+  const int tag = resolve_spawn_tag(sched, flags);
+  if (tag < 0) {
+    return -1;
+  }
+  FiberMeta* m = make_fiber_meta(fn, arg, tag);
+  if (m == nullptr) {
+    return -1;
+  }
   if (out != nullptr) {
     *out = m->id();
   }
   sched->ready_to_run(m, (flags & kFiberUrgent) != 0);
   return 0;
+}
+
+size_t fiber_start_batch(void (*fn)(void*), void* const* args, size_t n,
+                         int flags) {
+  if (n == 0) {
+    return 0;
+  }
+  Scheduler* sched = Scheduler::instance();
+  if (!sched->started()) {
+    sched->start(0);
+  }
+  const int tag = resolve_spawn_tag(sched, flags);
+  if (tag < 0) {
+    return 0;
+  }
+  constexpr size_t kStride = 64;
+  FiberMeta* ms[kStride];
+  size_t started = 0;
+  while (started < n) {
+    const size_t want = std::min(n - started, kStride);
+    size_t got = 0;
+    while (got < want) {
+      FiberMeta* m = make_fiber_meta(fn, args[started + got], tag);
+      if (m == nullptr) {
+        break;  // pool exhausted: publish what we have
+      }
+      ms[got++] = m;
+    }
+    sched->ready_to_run_batch(ms, got, (flags & kFiberUrgent) != 0);
+    started += got;
+    if (got < want) {
+      break;
+    }
+  }
+  return started;
+}
+
+void fiber_bulk_wake_stats(uint64_t* batches, uint64_t* fibers,
+                           uint64_t* max_batch) {
+  Scheduler* s = Scheduler::instance();
+  *batches = s->bulk_wake_batches.load(std::memory_order_relaxed);
+  *fibers = s->bulk_wake_fibers.load(std::memory_order_relaxed);
+  *max_batch = s->bulk_wake_max.load(std::memory_order_relaxed);
 }
 
 namespace {

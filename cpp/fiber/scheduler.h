@@ -105,9 +105,18 @@ class Scheduler {
 
   // Make a runnable fiber visible to some worker OF ITS TAG (any thread).
   void ready_to_run(FiberMeta* m, bool urgent = false);
+  // Publish n runnables of ONE tag with a single ParkingLot signal (the
+  // bulk-wake path behind fiber_start_batch).  Queue-push order follows
+  // ms[]; execution order is unspecified (see fiber_start_batch).
+  void ready_to_run_batch(FiberMeta* const* ms, size_t n, bool urgent);
   bool steal(FiberMeta** out, Worker* thief);
   bool pop_remote(FiberMeta** out, int tag);
   void push_remote(FiberMeta* m);
+
+  // Bulk-wake telemetry (read by fiber_bulk_wake_stats).
+  std::atomic<uint64_t> bulk_wake_batches{0};
+  std::atomic<uint64_t> bulk_wake_fibers{0};
+  std::atomic<uint64_t> bulk_wake_max{0};
 
   // Per-tag worker group: spawn/steal/park confined inside (the
   // reference's per-tag TaskControl groups, task_control.h:94-99).

@@ -2,6 +2,7 @@
 
 #include <pthread.h>
 
+#include <atomic>
 #include <condition_variable>
 #include <mutex>
 #include <queue>
@@ -22,76 +23,111 @@ struct TimerEntry {
   }
 };
 
-struct TimerThread::Impl {
+namespace {
+
+// Sharded: every RPC schedules a timeout at call start and unschedules it
+// at completion — two lock acquisitions per call on what used to be ONE
+// global mutex, contending with the timer loop itself.  With lazy
+// cancellation the single heap also held ~qps × timeout_s dead entries
+// (400k at 80k qps / 5s timeouts), so each push paid log2 of that under
+// the lock.  Shards split both the contention and the heap depth; ids
+// carry their shard in the low bits so unschedule is lock-local too.
+constexpr int kTimerShardBits = 2;
+constexpr int kTimerShards = 1 << kTimerShardBits;
+constexpr uint64_t kShardMask = kTimerShards - 1;
+
+struct Shard {
   std::mutex mu;
   std::condition_variable cv;
   std::priority_queue<TimerEntry, std::vector<TimerEntry>,
                       std::greater<TimerEntry>>
       heap;
   std::unordered_set<uint64_t> pending;
-  uint64_t next_id = 1;
+  uint64_t next_seq = 1;
+};
+
+}  // namespace
+
+struct TimerThread::Impl {
+  Shard shards[kTimerShards];
 };
 
 TimerThread* TimerThread::instance() {
-  // Deliberately leaked: the timer pthread outlives static destruction.
+  // Deliberately leaked: the timer pthreads outlive static destruction.
   static TimerThread* t = new TimerThread();
   return t;
 }
 
 TimerThread::TimerThread() : impl_(new Impl) {
-  pthread_t tid;
-  pthread_create(
-      &tid, nullptr,
-      [](void* self) -> void* {
-        static_cast<TimerThread*>(self)->run();
-        return nullptr;
-      },
-      this);
-  pthread_detach(tid);
+  for (int i = 0; i < kTimerShards; ++i) {
+    pthread_t tid;
+    struct Arg {
+      TimerThread* self;
+      int shard;
+    };
+    pthread_create(
+        &tid, nullptr,
+        [](void* p) -> void* {
+          Arg* a = static_cast<Arg*>(p);
+          TimerThread* self = a->self;
+          const int shard = a->shard;
+          delete a;
+          self->run(shard);
+          return nullptr;
+        },
+        new Arg{this, i});
+    pthread_detach(tid);
+  }
 }
 
 uint64_t TimerThread::schedule(int64_t deadline_us, Fn fn, void* arg) {
-  std::unique_lock<std::mutex> g(impl_->mu);
-  const uint64_t id = impl_->next_id++;
-  impl_->heap.push(TimerEntry{deadline_us, id, fn, arg});
-  impl_->pending.insert(id);
+  // Spread load across shards; the TLS counter keeps one thread's
+  // schedule/unschedule pairs mostly shard-local without any sharing.
+  static thread_local uint32_t rr = 0;
+  Shard& s = impl_->shards[++rr & kShardMask];
+  std::unique_lock<std::mutex> g(s.mu);
+  const uint64_t id =
+      (s.next_seq++ << kTimerShardBits) | (&s - impl_->shards);
+  s.heap.push(TimerEntry{deadline_us, id, fn, arg});
+  s.pending.insert(id);
   // Wake the loop if the new timer is the earliest.
-  if (impl_->heap.top().id == id) {
-    impl_->cv.notify_one();
+  if (s.heap.top().id == id) {
+    s.cv.notify_one();
   }
   return id;
 }
 
 bool TimerThread::unschedule(uint64_t id) {
-  std::lock_guard<std::mutex> g(impl_->mu);
-  return impl_->pending.erase(id) > 0;  // heap entry skipped lazily
+  Shard& s = impl_->shards[id & kShardMask];
+  std::lock_guard<std::mutex> g(s.mu);
+  return s.pending.erase(id) > 0;  // heap entry skipped lazily
 }
 
-void TimerThread::run() {
-  std::unique_lock<std::mutex> g(impl_->mu);
+void TimerThread::run(int shard) {
+  Shard& s = impl_->shards[shard];
+  std::unique_lock<std::mutex> g(s.mu);
   while (true) {
-    while (!impl_->heap.empty()) {
-      TimerEntry top = impl_->heap.top();
-      if (impl_->pending.count(top.id) == 0) {  // cancelled
-        impl_->heap.pop();
+    while (!s.heap.empty()) {
+      TimerEntry top = s.heap.top();
+      if (s.pending.count(top.id) == 0) {  // cancelled
+        s.heap.pop();
         continue;
       }
       const int64_t now = monotonic_time_us();
       if (top.deadline_us > now) {
         break;
       }
-      impl_->heap.pop();
-      impl_->pending.erase(top.id);
+      s.heap.pop();
+      s.pending.erase(top.id);
       g.unlock();
       top.fn(top.arg);
       g.lock();
     }
-    if (impl_->heap.empty()) {
-      impl_->cv.wait(g);
+    if (s.heap.empty()) {
+      s.cv.wait(g);
     } else {
-      impl_->cv.wait_for(g, std::chrono::microseconds(
-                                impl_->heap.top().deadline_us -
-                                monotonic_time_us()));
+      s.cv.wait_for(g, std::chrono::microseconds(s.heap.top().deadline_us -
+                                                 monotonic_time_us()));
     }
   }
 }

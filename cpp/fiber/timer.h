@@ -24,7 +24,7 @@ class TimerThread {
 
  private:
   TimerThread();
-  void run();
+  void run(int shard);
   struct Impl;
   Impl* impl_;
 };

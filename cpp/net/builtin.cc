@@ -378,6 +378,12 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
   }
   if (path == "/sockets") {
     *body = Socket::DumpAll(500);
+    // ?hot=1 appends per-socket hot-path state (queued-write flag,
+    // writer role, pending events) — the wedge-forensics view.
+    const std::string* hot = req.query("hot");
+    if (hot != nullptr && *hot == "1") {
+      *body += "\n" + Socket::DumpHotState();
+    }
     return true;
   }
   if (path == "/ids") {

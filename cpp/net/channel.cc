@@ -99,7 +99,27 @@ void complete_locked_call(fid_t cid, Controller* cntl) {
     TimerThread::instance()->unschedule(timer);
   }
   if (done) {
-    done();
+    // A non-empty done is the USER's async completion (sync callers join
+    // the fid instead).  When this completion is running inline on a
+    // connection's dispatch fiber (batched-dispatch fast path), arbitrary
+    // user code must not park it — everything behind it on the connection
+    // would stall — so the closure gets its own fiber there.
+    if (messenger_in_inline_dispatch()) {
+      auto* heap_done = new Closure(std::move(done));
+      if (fiber_start(
+              nullptr,
+              [](void* p) {
+                auto* d = static_cast<Closure*>(p);
+                (*d)();
+                delete d;
+              },
+              heap_done) != 0) {
+        (*heap_done)();  // pool exhausted: inline beats dropping
+        delete heap_done;
+      }
+    } else {
+      done();
+    }
   }
 }
 

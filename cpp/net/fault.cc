@@ -536,6 +536,11 @@ class FaultTransport final : public Transport {
     return inner_->connect(s);
   }
 
+  // Doorbells pass straight through: faults act on bytes, not on the
+  // publish step (swallowing a flush would wedge ring transports, which
+  // is a hang, not an injected fault).
+  void flush(Socket* s) override { inner_->flush(s); }
+
   bool fd_based() const override { return inner_->fd_based(); }
   const char* name() const override { return inner_->name(); }
 

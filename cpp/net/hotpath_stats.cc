@@ -1,0 +1,67 @@
+#include "net/hotpath_stats.h"
+
+#include "fiber/fiber.h"
+#include "stat/variable.h"
+
+namespace trpc {
+
+namespace {
+
+// Bulk-wake counters live in the fiber layer (scheduler.cc) because net/
+// sits above fiber/; they surface here as pull-based vars so every
+// hot-path series shares one /vars namespace.
+struct BulkWakeVars {
+  PassiveStatus<long> batches{[] {
+    uint64_t b = 0, f = 0, m = 0;
+    fiber_bulk_wake_stats(&b, &f, &m);
+    return static_cast<long>(b);
+  }};
+  PassiveStatus<long> fibers{[] {
+    uint64_t b = 0, f = 0, m = 0;
+    fiber_bulk_wake_stats(&b, &f, &m);
+    return static_cast<long>(f);
+  }};
+  PassiveStatus<long> max{[] {
+    uint64_t b = 0, f = 0, m = 0;
+    fiber_bulk_wake_stats(&b, &f, &m);
+    return static_cast<long>(m);
+  }};
+};
+
+}  // namespace
+
+HotPathVars::HotPathVars() {
+  write_coalesce_drains.expose("socket_write_coalesce_drains");
+  write_coalesce_nodes.expose("socket_write_coalesce_nodes");
+  write_coalesce_max.expose("socket_write_coalesce_max");
+  write_coalesce_batch.expose("socket_write_coalesce_batch");
+  inline_write_attempts.expose("socket_inline_write_attempts");
+  inline_write_hits.expose("socket_inline_write_hits");
+  dispatch_batches.expose("messenger_dispatch_batches");
+  dispatch_msgs.expose("messenger_dispatch_messages");
+  dispatch_inline.expose("messenger_dispatch_inline");
+  dispatch_max.expose("messenger_dispatch_max");
+  dispatch_batch.expose("messenger_dispatch_batch");
+  probe_rounds.expose("messenger_probe_rounds");
+  probe_stall_skips.expose("messenger_probe_stall_skips");
+}
+
+HotPathVars& hotpath_vars() {
+  // Leaked with the registry: worker threads outlive static destruction.
+  static HotPathVars* v = new HotPathVars();
+  return *v;
+}
+
+void expose_hotpath_variables() {
+  hotpath_vars();
+  static BulkWakeVars* bw = [] {
+    auto* b = new BulkWakeVars();
+    b->batches.expose("fiber_bulk_wake_batches");
+    b->fibers.expose("fiber_bulk_wake_fibers");
+    b->max.expose("fiber_bulk_wake_max");
+    return b;
+  }();
+  (void)bw;
+}
+
+}  // namespace trpc

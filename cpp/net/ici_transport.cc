@@ -288,6 +288,11 @@ struct IciConn {
   std::vector<IOBuf> sq;
   std::vector<uint64_t> sq_meta;
   alignas(64) std::atomic<uint64_t> sq_head{0};  // writer bumps
+  // Staged (unpublished) sq_head, owned by the socket's single writer
+  // role; UINT64_MAX = nothing staged.  cut_from_iobuf posts WRs here and
+  // Transport::flush publishes once per drain — the poller (DMA engine)
+  // sees one doorbell per KeepWrite sweep instead of one per WR.
+  uint64_t sq_staged = UINT64_MAX;
   alignas(64) std::atomic<uint64_t> sq_tail{0};  // poller bumps
   // DMA'd-but-uncompleted source refs, indexed by descriptor slot
   // (_sbuf parity: released only when the peer's desc_consumed passes).
@@ -933,9 +938,12 @@ class IciRingTransport final : public Transport {
       return -1;
     }
     const uint32_t mask = c->slots - 1;
+    if (c->sq_staged == UINT64_MAX) {
+      c->sq_staged = c->sq_head.load(std::memory_order_relaxed);
+    }
     size_t total = 0;
     while (!from->empty()) {
-      const uint64_t head = c->sq_head.load(std::memory_order_relaxed);
+      const uint64_t head = c->sq_staged;
       if (head - c->sq_tail.load(std::memory_order_acquire) >= c->slots) {
         c->window_exhausted.fetch_add(1, std::memory_order_relaxed);
         break;
@@ -997,9 +1005,18 @@ class IciRingTransport final : public Transport {
         total += from->cutn(&wr, n);
       }
       c->sq_meta[head & mask] = meta;
-      c->sq_head.store(head + 1, std::memory_order_release);
+      c->sq_staged = head + 1;
     }
     return static_cast<ssize_t>(total);
+  }
+
+  void flush(Socket* s) override {
+    auto* c = static_cast<IciConn*>(s->transport_ctx);
+    if (c == nullptr || c->sq_staged == UINT64_MAX) {
+      return;
+    }
+    c->sq_head.store(c->sq_staged, std::memory_order_release);
+    c->sq_staged = UINT64_MAX;
   }
 
   ssize_t append_to_iobuf(Socket* s, IOBuf* to, size_t max) override {

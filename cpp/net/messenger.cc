@@ -5,6 +5,7 @@
 #include "base/logging.h"
 #include "base/tls_cache.h"
 #include "fiber/fiber.h"
+#include "net/hotpath_stats.h"
 #include "net/protocol.h"
 #include "net/stream.h"
 
@@ -13,6 +14,8 @@ namespace trpc {
 namespace {
 
 constexpr size_t kReadChunk = 512 * 1024;
+
+thread_local bool tls_inline_dispatch = false;
 
 // TLS InputMessage freelist: one is allocated per parsed message — at
 // 100k+ qps the malloc/free pair plus the meta's string/vector churn is
@@ -54,8 +57,8 @@ void free_input_message(InputMessage* m) {
   delete m;
 }
 
-void process_message_fiber(void* arg) {
-  InputMessage* msg = static_cast<InputMessage*>(arg);
+// Shared by the inline (first-of-batch) and fiber dispatch paths.
+void process_parsed_message(InputMessage* msg) {
   const Protocol* p = protocol_at(0);  // resolved below via pinned index
   Socket* s = Socket::Address(msg->socket);
   if (s != nullptr) {
@@ -73,24 +76,107 @@ void process_message_fiber(void* arg) {
   free_input_message(msg);
 }
 
-// Cut as many whole messages as available; dispatch each in its own fiber
-// (the last one inline, like input_messenger.cpp:307-309's batch flush).
+void process_message_fiber(void* arg) {
+  process_parsed_message(static_cast<InputMessage*>(arg));
+}
+
+// Upper bound on messages batched per dispatch round (also the bulk-
+// enqueue fan-out cap; the reference flushes unconditionally at the end
+// of each read sweep, input_messenger.cpp:307-309).
+constexpr size_t kDispatchBatch = 64;
+
+// Batch of concurrent-protocol messages cut in one sweep.  Flushing
+// bulk-enqueues fiber-bound messages through the scheduler's
+// single-signal path FIRST, then — when the first message is a client
+// RESPONSE — runs it INLINE on this dispatch fiber: the common
+// single-response event (sync small RPC) completes with zero fiber
+// spawns and zero ParkingLot signals.  Requests are NEVER run inline:
+// a handler is arbitrary user code and may park for seconds, and an
+// inline handler would serialize every later message on this connection
+// behind it (a response completion only wakes the waiting call — bounded
+// framework work).
+struct DispatchBatch {
+  InputMessage* msgs[kDispatchBatch];
+  size_t n = 0;
+
+  void flush() {
+    if (n == 0) {
+      return;
+    }
+    HotPathVars& hv = hotpath_vars();
+    hv.dispatch_batches << 1;
+    hv.dispatch_msgs << static_cast<int64_t>(n);
+    hv.dispatch_max << static_cast<int64_t>(n);
+    if (hotpath_sample16()) {
+      hv.dispatch_batch << static_cast<int64_t>(n);
+    }
+    InputMessage* inline_msg = nullptr;
+    size_t spawn_from = 0;
+    if (msgs[0]->meta.type == RpcMeta::kResponse) {
+      inline_msg = msgs[0];
+      spawn_from = 1;
+      hv.dispatch_inline << 1;
+    }
+    if (n > spawn_from) {
+      void* args[kDispatchBatch];
+      for (size_t i = spawn_from; i < n; ++i) {
+        args[i - spawn_from] = msgs[i];
+      }
+      const size_t started = fiber_start_batch(process_message_fiber, args,
+                                               n - spawn_from, 0);
+      // Pool exhaustion: never drop a parsed message — run stragglers
+      // inline (slow, but the pool being empty means the process is
+      // drowning in fibers anyway).  Inline-window flag stays set so
+      // user done() callbacks still divert off this dispatch fiber.
+      if (started < n - spawn_from) {
+        tls_inline_dispatch = true;
+        for (size_t i = spawn_from + started; i < n; ++i) {
+          process_parsed_message(msgs[i]);
+        }
+        tls_inline_dispatch = false;
+      }
+    }
+    n = 0;
+    if (inline_msg != nullptr) {
+      // Mark the inline window: completion paths divert user callbacks
+      // (async done) to their own fiber so arbitrary user code never
+      // parks this connection's dispatch fiber.
+      tls_inline_dispatch = true;
+      process_parsed_message(inline_msg);
+      tls_inline_dispatch = false;
+    }
+  }
+};
+
+// Cut as many whole messages as available per readable sweep; batch
+// concurrent-protocol messages and dispatch them in bulk (first inline,
+// rest via one bulk fiber wakeup).  Order-sensitive frames (streams,
+// auth, in-order protocols) flush the batch first and run inline, so
+// per-connection processing order is exactly the pre-batching order.
 void cut_and_dispatch(Socket* s, SocketId id) {
   IOBuf& buf = s->read_buf();
+  DispatchBatch batch;
   while (!buf.empty()) {
     InputMessage* msg = alloc_input_message();
     msg->socket = id;
     ParseError rc = ParseError::kTryOtherProtocol;
     if (s->pinned_protocol >= 0) {
       rc = protocol_at(s->pinned_protocol)->parse(&buf, msg, s);
+    } else if (buf.size() <= s->probe_stall_len) {
+      // Probe memo: every protocol already saw this prefix length and
+      // asked for more bytes — skip the whole sweep until they arrive.
+      hotpath_vars().probe_stall_skips << 1;
+      rc = ParseError::kNotEnoughData;
     } else {
       // Pin ONLY on a successful parse: with a partial prefix several
       // protocols may legitimately say "need more data", and pinning early
       // would misroute the connection once the real format shows.
+      hotpath_vars().probe_rounds << 1;
       for (int i = 0; i < protocol_count(); ++i) {
         rc = protocol_at(i)->parse(&buf, msg, s);
         if (rc == ParseError::kOk) {
           s->pinned_protocol = i;
+          s->probe_stall_len = 0;
           break;
         }
         if (rc == ParseError::kNotEnoughData ||
@@ -98,12 +184,16 @@ void cut_and_dispatch(Socket* s, SocketId id) {
           break;
         }
       }
+      if (rc == ParseError::kNotEnoughData) {
+        s->probe_stall_len = buf.size();
+      }
     }
     switch (rc) {
       case ParseError::kOk: {
         if (msg->meta.type == RpcMeta::kStreamFrame) {
           // Stream frames keep per-connection arrival order: handled inline
           // (the per-stream ExecutionQueue serializes the user callback).
+          batch.flush();
           stream_on_frame(std::move(*msg));
           free_input_message(msg);
           continue;
@@ -114,6 +204,7 @@ void cut_and_dispatch(Socket* s, SocketId id) {
           // cut after this frame must observe auth_ok (the reference's
           // first-message verify fight, input_messenger.cpp:271-289 —
           // spawning a fiber here would let a request race the verify).
+          batch.flush();
           p->process_request(std::move(*msg));
           free_input_message(msg);
           continue;
@@ -123,6 +214,7 @@ void cut_and_dispatch(Socket* s, SocketId id) {
           // connection's response order.
           // kResponse is the only client-bound type; everything else
           // (requests, kAuth credentials) belongs to the serving path.
+          batch.flush();
           if (msg->meta.type == RpcMeta::kResponse) {
             p->process_response(std::move(*msg));
           } else {
@@ -130,12 +222,16 @@ void cut_and_dispatch(Socket* s, SocketId id) {
           }
           free_input_message(msg);
         } else {
-          fiber_start(nullptr, process_message_fiber, msg, 0);
+          batch.msgs[batch.n++] = msg;
+          if (batch.n == kDispatchBatch) {
+            batch.flush();
+          }
         }
         continue;
       }
       case ParseError::kNotEnoughData:
         free_input_message(msg);
+        batch.flush();
         return;
       default:
         LOG(Warning) << "corrupted input on " << endpoint2str(s->remote())
@@ -146,13 +242,18 @@ void cut_and_dispatch(Socket* s, SocketId id) {
                              : "?")
                      << "), closing";
         free_input_message(msg);
+        // Messages cut intact BEFORE the corruption still get delivered.
+        batch.flush();
         s->SetFailed(EBADMSG);
         return;
     }
   }
+  batch.flush();
 }
 
 }  // namespace
+
+bool messenger_in_inline_dispatch() { return tls_inline_dispatch; }
 
 void messenger_on_readable(SocketId id, void* /*ctx*/) {
   Socket* s = Socket::Address(id);

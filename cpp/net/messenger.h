@@ -12,4 +12,11 @@ namespace trpc {
 // Socket::Options::on_readable for any RPC connection (server or client).
 void messenger_on_readable(SocketId id, void* ctx);
 
+// True while the calling fiber is processing a first-of-batch message
+// INLINE on a connection's dispatch fiber (the batched-dispatch fast
+// path).  Completion paths use this to push arbitrary user callbacks
+// (async done closures) into their own fiber instead of parking the read
+// fiber — everything behind it on the connection would stall.
+bool messenger_in_inline_dispatch();
+
 }  // namespace trpc

@@ -209,6 +209,7 @@ int Server::SetMethodMaxConcurrency(const std::string& method,
 }
 
 void expose_default_variables();  // stat/default_variables.cc
+void expose_hotpath_variables();  // net/hotpath_stats.cc
 
 int Server::Start(int port) {
   fiber_init(0);
@@ -219,6 +220,7 @@ int Server::Start(int port) {
     fiber_start_tag_workers(worker_tag_, 0);  // default size if not sized
   }
   expose_default_variables();
+  expose_hotpath_variables();
   if (session_data_factory_ != nullptr && session_data_pool_ == nullptr) {
     session_data_pool_ =
         std::make_unique<SimpleDataPool>(session_data_factory_);

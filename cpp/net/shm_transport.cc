@@ -39,8 +39,13 @@ struct Ring {
   }
   uint32_t writable() const { return kRingCap - readable(); }
 
-  uint32_t write(const char* src, uint32_t n) {
-    const uint64_t h = head.load(std::memory_order_relaxed);
+  // Copy bytes at *cursor without publishing: the batched-doorbell write
+  // path (the ONLY producer) stages a whole KeepWrite drain, then
+  // publish()es once.  The consumer only sees bytes at publish, so a
+  // drain of N messages costs the peer one head-cursor cache-line
+  // transfer instead of N.
+  uint32_t write_staged(const char* src, uint32_t n, uint64_t* cursor) {
+    const uint64_t h = *cursor;
     const uint32_t space =
         kRingCap -
         static_cast<uint32_t>(h - tail.load(std::memory_order_acquire));
@@ -49,8 +54,12 @@ struct Ring {
     const uint32_t first = std::min(n, kRingCap - off);
     memcpy(data + off, src, first);
     memcpy(data, src + first, n - first);
-    head.store(h + n, std::memory_order_release);
+    *cursor = h + n;
     return n;
+  }
+
+  void publish(uint64_t cursor) {
+    head.store(cursor, std::memory_order_release);
   }
 
   uint32_t read(char* dst, uint32_t n) {
@@ -93,6 +102,9 @@ struct ShmConn {
   std::string name;
   bool is_client = false;  // client writes c2s, reads s2c
   bool creator = false;
+  // Staged (unpublished) tx head cursor, owned by the socket's single
+  // writer role; UINT64_MAX = nothing staged (Transport::flush contract).
+  uint64_t tx_staged = UINT64_MAX;
 
   Ring& tx() { return is_client ? seg->c2s : seg->s2c; }
   Ring& rx() { return is_client ? seg->s2c : seg->c2s; }
@@ -272,11 +284,16 @@ class ShmRingTransport final : public Transport {
       return -1;
     }
     Ring& tx = conn->tx();
+    // Stage the whole buffer at an unpublished cursor; flush() rings the
+    // doorbell once per drain (peer sees nothing until then).
+    if (conn->tx_staged == UINT64_MAX) {
+      conn->tx_staged = tx.head.load(std::memory_order_relaxed);
+    }
     size_t total = 0;
     while (!from->empty()) {
       const IOBuf::BlockRef& ref = from->ref_at(0);
-      const uint32_t wrote =
-          tx.write(ref.block->data + ref.offset, ref.length);
+      const uint32_t wrote = tx.write_staged(ref.block->data + ref.offset,
+                                             ref.length, &conn->tx_staged);
       if (wrote == 0) {
         break;  // ring full
       }
@@ -284,6 +301,15 @@ class ShmRingTransport final : public Transport {
       total += wrote;
     }
     return static_cast<ssize_t>(total);  // 0 = EAGAIN-equivalent
+  }
+
+  void flush(Socket* s) override {
+    auto* conn = static_cast<ShmConn*>(s->transport_ctx);
+    if (conn == nullptr || conn->tx_staged == UINT64_MAX) {
+      return;
+    }
+    conn->tx().publish(conn->tx_staged);
+    conn->tx_staged = UINT64_MAX;
   }
 
   ssize_t append_to_iobuf(Socket* s, IOBuf* to, size_t max) override {

@@ -12,6 +12,7 @@
 #include "fiber/fiber.h"
 #include "fiber/scheduler.h"
 #include "net/fault.h"
+#include "net/hotpath_stats.h"
 #include "net/protocol.h"
 #include "net/dispatcher.h"
 
@@ -78,6 +79,9 @@ void Socket::reset_for_reuse(const Options& opts) {
   worker_tag = opts.worker_tag;
   wr_ev_.value.store(0, std::memory_order_relaxed);
   writing_.store(false, std::memory_order_relaxed);
+  pending_.clear();
+  pending_close_ = false;
+  probe_stall_len = 0;
   parse_state.reset();
   parse_state_owner = nullptr;
   auth_ok.store(false, std::memory_order_relaxed);
@@ -157,6 +161,37 @@ std::string Socket::DumpAll(size_t max_rows) {
       });
 }
 
+std::string Socket::DumpHotState() {
+  return dump_pool_table<Socket>(
+      "socket hot state (fd  nevent  writing  queued  conn  failed)\n",
+      200, [](uint32_t slot, Socket* s, std::string* line) {
+        const uint64_t rv = s->ref_ver_.load(std::memory_order_acquire);
+        if ((ver_of(rv) & 1) == 0 || ref_of(rv) == 0) {
+          return false;
+        }
+        if (line == nullptr) {
+          return true;
+        }
+        SocketRef ref(Socket::Address(pack(ver_of(rv), 0) | slot));
+        if (!ref) {
+          return false;
+        }
+        // Atomics only — never walk the write chain (a concurrent drain
+        // frees/reuses nodes) and never touch the read buffer (owned by
+        // the read fiber).  queued=1 with writing=0 is the wedge
+        // signature this view exists to catch.
+        const bool queued =
+            ref->wq_head_.load(std::memory_order_acquire) != nullptr;
+        char buf[160];
+        snprintf(buf, sizeof(buf),
+                 "fd=%d nevent=%d writing=%d queued=%d conn=%d failed=%d\n",
+                 ref->fd(), ref->nevent_.load(), (int)ref->writing_.load(),
+                 (int)queued, (int)ref->connected(), (int)ref->Failed());
+        *line = buf;
+        return true;
+      });
+}
+
 void Socket::Dereference() {
   const uint64_t prev = ref_ver_.fetch_sub(kRefUnit, std::memory_order_acq_rel);
   if (ref_of(prev) == 1) {
@@ -168,6 +203,8 @@ void Socket::Dereference() {
       fd_ = -1;
     }
     drop_write_queue();
+    pending_.clear();
+    pending_close_ = false;
     read_buf_.clear();
     transport_ctx = nullptr;
     transport_ctx_holder_.reset();  // releases e.g. the shm mapping
@@ -213,6 +250,15 @@ std::vector<void*>* tls_write_node_cache() {
 }
 
 constexpr size_t kMaxCachedWriteNodes = 64;
+// Byte cap on what the freelist may pin: a cached node's cleared IOBuf
+// still owns its refs-vector capacity (a 64MB write sliced into 16KB
+// blocks leaves a ~64KB vector), so 64 nodes could silently hold MBs per
+// thread.  Nodes over the per-thread budget get their storage shrunk
+// before caching.
+constexpr size_t kMaxCachedWriteBytes = 256 * 1024;
+
+// Refs-vector capacity bytes currently pinned by this thread's cache.
+thread_local size_t tls_write_node_cache_bytes = 0;
 
 }  // namespace
 
@@ -221,6 +267,9 @@ Socket::WriteNode* Socket::alloc_write_node(IOBuf&& data, bool close_after) {
   if (cache != nullptr && !cache->empty()) {
     auto* n = static_cast<WriteNode*>(cache->back());
     cache->pop_back();
+    const size_t held = n->data.ref_capacity_bytes();
+    tls_write_node_cache_bytes -=
+        std::min(tls_write_node_cache_bytes, held);
     n->data = std::move(data);
     n->close_after = close_after;
     n->next = nullptr;
@@ -233,6 +282,12 @@ void Socket::free_write_node(WriteNode* n) {
   std::vector<void*>* cache = tls_write_node_cache();
   if (cache != nullptr && cache->size() < kMaxCachedWriteNodes) {
     n->data.clear();  // release block refs NOW, not at reuse time
+    size_t held = n->data.ref_capacity_bytes();
+    if (tls_write_node_cache_bytes + held > kMaxCachedWriteBytes) {
+      n->data.shrink_storage();  // over budget: drop the vector heap too
+      held = n->data.ref_capacity_bytes();
+    }
+    tls_write_node_cache_bytes += held;
     cache->push_back(n);
     return;
   }
@@ -324,6 +379,23 @@ int Socket::ensure_connected() {
 }
 
 // ---- wait-free write path ----------------------------------------------
+//
+// One MPSC Treiber chain + a writer-role flag.  The producer that pushes
+// onto an EMPTY chain claims the role; everyone else just enqueues.  The
+// role-holder drains the WHOLE reversed chain into pending_ (one
+// coalesced buffer → one writev/doorbell per drain) and, on the fast
+// path, flushes it INLINE on the caller — no KeepWrite fiber, no
+// ParkingLot signal, no context switch.  Only EAGAIN leftovers, lazy
+// connects and close_after teardown fall back to the KeepWrite fiber.
+//
+// The role handoff is the delicate part: the exit sequence
+// [writing_=false; re-check head] races the producer sequence
+// [push head; try-claim writing_].  Both sides are seq_cst — with
+// anything weaker the StoreLoad pairs can miss each other (x86 reorders
+// a release-store past a later acquire-load of a DIFFERENT word), each
+// side concludes the other owns the drain, and the queued node wedges
+// the connection forever.  That exact lost-wakeup shipped in the seed
+// and capped the 1KB bench at a few hundred QPS per wedge window.
 
 int Socket::Write(IOBuf&& data, bool close_after) {
   if (Failed()) {
@@ -334,23 +406,139 @@ int Socket::Write(IOBuf&& data, bool close_after) {
   do {
     node->next = old;
   } while (!wq_head_.compare_exchange_weak(old, node,
-                                           std::memory_order_release,
+                                           std::memory_order_seq_cst,
                                            std::memory_order_relaxed));
-  if (old == nullptr) {
+  if (old != nullptr) {
+    return 0;  // an active writer owns the drain
+  }
+  bool expect = false;
+  if (!writing_.compare_exchange_strong(expect, true,
+                                        std::memory_order_seq_cst)) {
+    return 0;  // the exiting writer's re-check adopts our node
+  }
+  // We hold the writer role.  Fast path: flush inline on this thread.
+  // A true return covers graceful close_after teardown and transport
+  // errors too — like the KeepWrite path, those surface through the
+  // socket's failed state, not through this (already-accepted) Write.
+  if (try_inline_write()) {
+    return 0;
+  }
+  // Leftovers (EAGAIN / not yet connected / bounded rounds exhausted):
+  // continue in a KeepWrite fiber that inherits pending_ with the role.
+  // Take a strong ref for the fiber's lifetime.
+  Socket* self = Socket::Address(id());
+  if (self == nullptr) {
+    // Failed under us; nothing will ever drain — purge and bail.
+    abort_writer(ECONNRESET);
+    return -1;
+  }
+  fiber_start(nullptr, &Socket::keep_write_thunk, self,
+              kFiberUrgent | fiber_tag_flags(worker_tag));
+  return 0;
+}
+
+size_t Socket::drain_queue_into_pending() {
+  WriteNode* chain = wq_head_.exchange(nullptr, std::memory_order_acquire);
+  if (chain == nullptr) {
+    return 0;
+  }
+  WriteNode* fifo = nullptr;
+  while (chain != nullptr) {  // LIFO chain → FIFO
+    WriteNode* next = chain->next;
+    chain->next = fifo;
+    fifo = chain;
+    chain = next;
+  }
+  size_t n = 0;
+  while (fifo != nullptr) {
+    pending_.append(std::move(fifo->data));
+    pending_close_ |= fifo->close_after;
+    WriteNode* done = fifo;
+    fifo = fifo->next;
+    free_write_node(done);
+    ++n;
+  }
+  HotPathVars& hv = hotpath_vars();
+  hv.write_coalesce_drains << 1;
+  hv.write_coalesce_nodes << static_cast<int64_t>(n);
+  hv.write_coalesce_max << static_cast<int64_t>(n);
+  if (hotpath_sample16()) {
+    hv.write_coalesce_batch << static_cast<int64_t>(n);
+  }
+  return n;
+}
+
+bool Socket::release_writer_role() {
+  writing_.store(false, std::memory_order_seq_cst);
+  if (wq_head_.load(std::memory_order_seq_cst) != nullptr) {
     bool expect = false;
     if (writing_.compare_exchange_strong(expect, true,
-                                         std::memory_order_acq_rel)) {
-      // Become the writer.  Take a strong ref for the fiber's lifetime.
-      Socket* self = Socket::Address(id());
-      if (self == nullptr) {
-        writing_.store(false, std::memory_order_release);
-        return -1;
-      }
-      fiber_start(nullptr, &Socket::keep_write_thunk, self,
-                  kFiberUrgent | fiber_tag_flags(worker_tag));
+                                         std::memory_order_seq_cst)) {
+      return false;  // adopted a late node; keep draining
     }
   }
-  return 0;
+  return true;
+}
+
+void Socket::abort_writer(int err) {
+  SetFailed(err);
+  pending_.clear();
+  pending_close_ = false;
+  drop_write_queue();
+  // writing_ stays true: the socket is failed, so no producer will ever
+  // need the role again; reset_for_reuse re-arms it with the slot.
+}
+
+bool Socket::try_inline_write() {
+  // Lazy connects park the calling fiber — never inline-eligible.
+  if (!connected_.load(std::memory_order_acquire)) {
+    return false;
+  }
+  HotPathVars& hv = hotpath_vars();
+  hv.inline_write_attempts << 1;
+  // Bounded rounds: an inline writer should flush what WAS queued, not
+  // become an unwitting forever-writer for every concurrent producer.
+  for (int round = 0; round < 4; ++round) {
+    drain_queue_into_pending();
+    if (pending_.empty()) {
+      if (pending_close_) {
+        // An empty-payload close_after batch (everything before it
+        // already flushed): honor the close now — releasing the role
+        // here would drop the close AND leave the latch armed for an
+        // unrelated later batch.
+        drop_write_queue();
+        SetFailed(ESHUTDOWN);
+        return true;
+      }
+      if (release_writer_role()) {
+        hv.inline_write_hits << 1;
+        return true;
+      }
+      continue;  // late node adopted with the role
+    }
+    while (!pending_.empty()) {
+      const ssize_t rc = transport_->cut_from_iobuf(this, &pending_);
+      if (rc < 0) {
+        transport_->flush(this);
+        abort_writer(errno);
+        return true;  // role retired with the socket
+      }
+      if (rc == 0) {  // EAGAIN: the KeepWrite fiber parks on the edge
+        transport_->flush(this);
+        return false;
+      }
+    }
+    transport_->flush(this);
+    if (pending_close_) {
+      // Fully flushed Connection:-close batch — graceful close here;
+      // anything enqueued after it is void by contract.
+      drop_write_queue();
+      SetFailed(ESHUTDOWN);
+      return true;
+    }
+  }
+  // Rounds exhausted with the queue still live: hand off.
+  return false;
 }
 
 void Socket::keep_write_thunk(void* arg) {
@@ -360,56 +548,39 @@ void Socket::keep_write_thunk(void* arg) {
 }
 
 void Socket::keep_write() {
-  IOBuf pending;
   while (true) {
-    // Drain newly queued nodes (LIFO chain → FIFO).
-    WriteNode* chain = wq_head_.exchange(nullptr, std::memory_order_acquire);
-    if (chain == nullptr && pending.empty()) {
-      writing_.store(false, std::memory_order_release);
-      // Close the race with producers that saw head non-null.
-      if (wq_head_.load(std::memory_order_acquire) != nullptr) {
-        bool expect = false;
-        if (writing_.compare_exchange_strong(expect, true,
-                                             std::memory_order_acq_rel)) {
-          continue;
-        }
+    // Drain newly queued nodes on top of any inline-path leftovers.
+    drain_queue_into_pending();
+    if (pending_.empty()) {
+      if (pending_close_) {  // empty-payload close_after: honor it now
+        drop_write_queue();
+        SetFailed(ESHUTDOWN);
+        return;
       }
-      return;
-    }
-    WriteNode* fifo = nullptr;
-    while (chain != nullptr) {
-      WriteNode* next = chain->next;
-      chain->next = fifo;
-      fifo = chain;
-      chain = next;
-    }
-    bool close_after = false;
-    while (fifo != nullptr) {
-      pending.append(std::move(fifo->data));
-      close_after |= fifo->close_after;
-      WriteNode* done = fifo;
-      fifo = fifo->next;
-      free_write_node(done);
+      if (release_writer_role()) {
+        return;
+      }
+      continue;
     }
     if (ensure_connected() != 0) {
-      SetFailed(errno);
-      pending.clear();
-      drop_write_queue();
+      abort_writer(errno);
       return;
     }
-    while (!pending.empty()) {
+    while (!pending_.empty()) {
       const uint32_t snap = writable_snap();
-      const ssize_t rc = transport_->cut_from_iobuf(this, &pending);
+      const ssize_t rc = transport_->cut_from_iobuf(this, &pending_);
       if (rc < 0) {
-        SetFailed(errno);
-        pending.clear();
-        drop_write_queue();
+        transport_->flush(this);
+        abort_writer(errno);
         return;
       }
       if (rc == 0) {  // EAGAIN: park until the writable edge
+        // Publish staged descriptors BEFORE parking: a ring that only
+        // learns of them at the next flush would never drain, and the
+        // writable edge this fiber waits for would never come.
+        transport_->flush(this);
         if (Failed()) {
-          pending.clear();
-          drop_write_queue();
+          abort_writer(ECONNRESET);
           return;
         }
         // Sliced wait: fd-less transports have no HUP edge, so a dead peer
@@ -417,7 +588,8 @@ void Socket::keep_write() {
         wait_writable(snap, monotonic_time_us() + 1000000);
       }
     }
-    if (close_after) {
+    transport_->flush(this);
+    if (pending_close_) {
       // This batch carried a Connection: close response and it has fully
       // flushed — graceful close (anything enqueued after it is void).
       drop_write_queue();

@@ -78,6 +78,9 @@ class Socket {
   // Text table of every live socket (/sockets builtin; reference:
   // builtin/sockets_service.cpp printing Socket::DebugString).
   static std::string DumpAll(size_t max_rows);
+  // One line per live socket of hot-path state (queued-write flag, writer
+  // role, pending input events) — wedge forensics, atomics only.
+  static std::string DumpHotState();
 
   int fd() const { return fd_; }
   SocketMode mode() const { return mode_; }
@@ -94,6 +97,12 @@ class Socket {
   void* user_data = nullptr;  // Server*/Channel* context, set by owner
   void* transport_ctx = nullptr;  // per-connection transport state
   uint8_t worker_tag = 0;  // worker group for this connection's fibers
+  // Protocol-probe memo: buffer length at the last inconclusive probe
+  // sweep (every protocol said NotEnoughData/TryOther).  The messenger
+  // skips re-probing until more bytes than this have arrived — a partial
+  // prefix no longer pays a full multi-protocol probe per read event.
+  // 0 = no stalled probe.  Read-fiber-owned; reset with the socket.
+  size_t probe_stall_len = 0;
   // Incremental parser state for protocols that need it (HTTP chunked
   // bodies resume scanning; h2 connection state).  Owned by the read
   // fiber; cleared on socket reuse.  `parse_state_owner` tags WHICH
@@ -125,6 +134,22 @@ class Socket {
   static void read_fiber_thunk(void* arg);
   static void keep_write_thunk(void* arg);
   void keep_write();
+  // Inline fast path: called by Write with the writer role held.  Returns
+  // true when the queue fully flushed (or the socket failed) and the role
+  // is done with; false when bytes remain and a KeepWrite fiber must take
+  // over (role stays held).
+  bool try_inline_write();
+  // Moves the whole MPSC chain (reversed to FIFO) into pending_; returns
+  // the node count absorbed.  Writer-role holder only.
+  size_t drain_queue_into_pending();
+  // Releases the writer role with the seq_cst handoff that closes the
+  // producer/exit Dekker race; returns false when new nodes arrived and
+  // the role was re-acquired (caller must keep draining).
+  bool release_writer_role();
+  // Failure/teardown of an active writer: fail the socket, purge pending_
+  // and the queue.  The writer role is intentionally left held — the
+  // socket is dead, reset_for_reuse re-arms the flag.
+  void abort_writer(int err);
   void reset_for_reuse(const Options& opts);
   void drop_write_queue();
   // TLS-cached WriteNode alloc/free (one node per Write on the hot path;
@@ -149,6 +174,11 @@ class Socket {
   // MPSC write queue.
   std::atomic<WriteNode*> wq_head_{nullptr};
   std::atomic<bool> writing_{false};
+  // Coalesced unwritten bytes + deferred close flag, owned by whoever
+  // holds the writer role (writing_): the inline fast path hands both to
+  // the KeepWrite fiber through here on EAGAIN.
+  IOBuf pending_;
+  bool pending_close_ = false;
 };
 
 void make_nonblocking(int fd);

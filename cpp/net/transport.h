@@ -33,6 +33,15 @@ class Transport {
   // EAGAIN-equivalent, -1 on error/EOF(-with errno 0).
   virtual ssize_t append_to_iobuf(Socket* s, IOBuf* to, size_t max) = 0;
 
+  // Publish everything cut_from_iobuf staged since the last flush — the
+  // per-drain doorbell.  Descriptor/ring transports (shm, ici) defer their
+  // peer-visible cursor publish to here so a KeepWrite drain of N writes
+  // rings the peer once, not N times.  The write path guarantees a flush
+  // after every cut_from_iobuf sequence, including before parking on
+  // EAGAIN and before abandoning a failed socket.  Default: no-op (TCP's
+  // writev is its own doorbell).
+  virtual void flush(Socket* s) { (void)s; }
+
   // Establish the connection if needed (non-blocking; may park the calling
   // fiber).  Returns 0 on success.
   virtual int connect(Socket* s) = 0;

@@ -4,6 +4,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <mutex>
 #include <thread>
 #include <vector>
 
@@ -459,6 +460,93 @@ TEST_CASE(worker_tags_pin_and_inherit) {
   fiber_join(f);
   EXPECT_EQ(probe.seen_tag.load(), 1);
   EXPECT_EQ(probe.child_tag.load(), 1);  // inherited, not defaulted to 0
+}
+
+namespace bulkns {
+std::atomic<int> bulk_count{0};
+void bulk_count_fiber(void*) { bulk_count.fetch_add(1); }
+
+std::mutex order_mu;
+std::vector<long> order_seen;
+void bulk_order_fiber(void* arg) {
+  std::lock_guard<std::mutex> g(order_mu);
+  order_seen.push_back(reinterpret_cast<long>(arg));
+}
+}  // namespace bulkns
+
+TEST_CASE(bulk_start_runs_all) {
+  fiber_init(0);
+  bulkns::bulk_count = 0;
+  constexpr size_t kN = 1000;
+  std::vector<void*> args(kN, nullptr);
+  // One publish per internal stride instead of kN signals; every fiber
+  // must still run (none lost in a queue with no wakeup).
+  EXPECT_EQ(fiber_start_batch(&bulkns::bulk_count_fiber, args.data(), kN),
+            kN);
+  const int64_t deadline = monotonic_time_us() + 10 * 1000 * 1000;
+  while (bulkns::bulk_count.load() < static_cast<int>(kN) &&
+         monotonic_time_us() < deadline) {
+    usleep(1000);
+  }
+  EXPECT_EQ(bulkns::bulk_count.load(), static_cast<int>(kN));
+  uint64_t batches = 0, fibers = 0, maxb = 0;
+  fiber_bulk_wake_stats(&batches, &fibers, &maxb);
+  EXPECT(batches >= 1);
+  EXPECT(fibers >= kN);
+  EXPECT(maxb >= 2);
+}
+
+TEST_CASE(bulk_start_preserves_enqueue_order) {
+  fiber_init(0);
+  // One worker in tag 3, batch published from a NON-worker thread → the
+  // remote queue drains FIFO on a single thread: batched fibers run
+  // exactly in args order.  (This is the documented FIFO recipe; a
+  // worker-local publish pops its own queue LIFO, which is why batched
+  // message dispatch only ever batches order-insensitive messages.)
+  EXPECT_EQ(fiber_start_tag_workers(3, 1), 0);
+  EXPECT_EQ(fiber_worker_count_tag(3), 1);
+  bulkns::order_seen.clear();
+  constexpr long kN = 200;
+  std::vector<void*> args(kN);
+  for (long i = 0; i < kN; ++i) {
+    args[i] = reinterpret_cast<void*>(i);
+  }
+  EXPECT_EQ(fiber_start_batch(&bulkns::bulk_order_fiber, args.data(), kN,
+                              fiber_tag_flags(3)),
+            static_cast<size_t>(kN));
+  const int64_t deadline = monotonic_time_us() + 10 * 1000 * 1000;
+  while (monotonic_time_us() < deadline) {
+    std::lock_guard<std::mutex> g(bulkns::order_mu);
+    if (bulkns::order_seen.size() == static_cast<size_t>(kN)) {
+      break;
+    }
+  }
+  std::lock_guard<std::mutex> g(bulkns::order_mu);
+  EXPECT_EQ(bulkns::order_seen.size(), static_cast<size_t>(kN));
+  for (long i = 0; i < kN; ++i) {
+    EXPECT_EQ(bulkns::order_seen[i], i);
+  }
+}
+
+TEST_CASE(bulk_start_wakes_parked_workers) {
+  fiber_init(0);
+  // Let every worker park, then publish a batch with its single signal:
+  // all fibers must still run promptly (the one-futex wake reaches
+  // enough workers; nothing relies on per-spawn signals).
+  usleep(100 * 1000);
+  bulkns::bulk_count = 0;
+  constexpr size_t kN = 64;
+  std::vector<void*> args(kN, nullptr);
+  const int64_t t0 = monotonic_time_us();
+  EXPECT_EQ(fiber_start_batch(&bulkns::bulk_count_fiber, args.data(), kN),
+            kN);
+  const int64_t deadline = monotonic_time_us() + 5 * 1000 * 1000;
+  while (bulkns::bulk_count.load() < static_cast<int>(kN) &&
+         monotonic_time_us() < deadline) {
+    usleep(1000);
+  }
+  EXPECT_EQ(bulkns::bulk_count.load(), static_cast<int>(kN));
+  EXPECT(monotonic_time_us() - t0 < 5 * 1000 * 1000);
 }
 
 TEST_CASE(worker_tags_isolate_saturation) {

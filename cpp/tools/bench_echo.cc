@@ -18,6 +18,7 @@
 #include "net/channel.h"
 #include "net/server.h"
 #include "stat/profiler.h"
+#include "stat/variable.h"
 
 using namespace trpc;
 
@@ -101,6 +102,16 @@ int main(int argc, char** argv) {
   const double secs = (monotonic_time_us() - t0) / 1e6;
   if (profiling) {
     fprintf(stderr, "%s\n", profiler_stop_and_dump(50).c_str());
+  }
+  // BENCH_DUMP_VARS=1: print the hot-path stat vars (write coalescing,
+  // inline-write hit rate, dispatch batching, bulk wakes) to stderr.
+  if (getenv("BENCH_DUMP_VARS") != nullptr) {
+    for (auto& [name, value] : Variable::dump_exposed()) {
+      if (name.rfind("socket_", 0) == 0 || name.rfind("messenger_", 0) == 0 ||
+          name.rfind("fiber_bulk_", 0) == 0) {
+        fprintf(stderr, "%s : %s\n", name.c_str(), value.c_str());
+      }
+    }
   }
 
   std::vector<int64_t> all;

@@ -16,3 +16,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: perf smoke / long soaks, excluded from the tier-1 gate "
+        "(run with -m slow)",
+    )
