@@ -167,7 +167,24 @@ def _child_sweep(sizes: list[int]) -> None:
             "latency_method": f"marginal_chain_m{m}",
             "fetch_ms": round(fetch_ms, 1),
             "platform": platform,
+            "goodput_method": "device_chain",
         }
+        # Edge sizes (weak #3): the r05 1KB/64MB numbers were Python
+        # per-call overhead, not the runtime.  Drive these rows through
+        # the batched RPC pipeline at depth >= 8 so goodput measures the
+        # data plane again; the device-chain number stays alongside.
+        if size in (SIZES[0], SIZES[-1]):
+            # Small payloads need a deep window to amortize per-call
+            # runtime cost (native 1KB echo is ~90k calls/s; 8-deep
+            # leaves the pipe mostly empty); big payloads need few.
+            rpc = _rpc_batch_goodput(
+                size, depth=8 if size >= (1 << 20) else 256)
+            if rpc is not None:
+                row["device_step_gbps"] = row["goodput_gbps"]
+                row["goodput_gbps"] = rpc["goodput_gbps"]
+                row["pipeline_depth"] = rpc["pipeline_depth"]
+                row["bytes_moved_per_iter"] = rpc["bytes_moved_per_iter"]
+                row["goodput_method"] = "rpc_call_batch"
         if hbm_peak is not None and step is fused:
             # One read + one write pass per echo → HBM bytes = 2× goodput
             # bytes.  The roofline discipline of BASELINE.md applied to
@@ -242,7 +259,14 @@ def _child_tpu_rpc() -> None:
         land_s = 0.0
 
     iters = 12
+    # Honest labeling (VERDICT r5 weak #4): this leg is a LOOPBACK
+    # descriptor-path measurement — the ici number counts sender-owned
+    # descriptors over in-process rings, not bytes across a chip
+    # interconnect, and each iteration's goodput-counted payload is
+    # `size` bytes.  The fields make that unmistakable in the artifact.
     row = {"kind": "tpu_rpc_64MB", "platform": platform,
+           "loopback": True,
+           "bytes_moved_per_iter": size,
            "staging_dma_gbps": round(size / dma_s / 1e9, 3),
            "staging_land_gbps": round(size / land_s / 1e9, 3)
            if land_s > 0 else None,
@@ -284,17 +308,124 @@ def _child_tpu_rpc() -> None:
     print(json.dumps(row), flush=True)
 
 
+def _rpc_batch_goodput(size: int, depth: int = 8,
+                       target_s: float = 1.0) -> dict | None:
+    """Loopback echo goodput of the PYTHON DATA PLANE at `depth`-deep
+    pipelining: a WINDOWED submit/poll pipeline (batch API, one GIL
+    crossing per drain, completions polled off-GIL) with buffer-protocol
+    zero-copy requests and responses landing in recycled caller buffers;
+    native echo server so the server side has no GIL in the path.  The
+    window stays full in steady state — poll k, resubmit k — so there is
+    no wait-for-all bubble between batches (the per-call-bounce artifact
+    this leg exists to retire).  None on any failure (bench must still
+    print its line)."""
+    try:
+        import numpy as np
+
+        from brpc_tpu.rpc import Channel, Server
+
+        srv = Server()
+        srv.register_native_echo("Echo.Echo")
+        srv.start(0)
+        ch = pipe = None
+        try:
+            # Large payloads stream best over per-call pooled sockets
+            # (the batch pipeline fans out one issue fiber per member);
+            # small ones over the single multiplexed connection.
+            conn = "pooled" if size >= (1 << 20) else "single"
+            ch = Channel(f"127.0.0.1:{srv.port}", timeout_ms=60000,
+                         connection_type=conn)
+            payload = np.empty(size, dtype=np.uint8)
+            payload.reshape(-1, 256)[:] = np.arange(256, dtype=np.uint8)
+            pipe = ch.pipeline()
+            free_bufs = [np.empty(size, dtype=np.uint8)
+                         for _ in range(depth)]
+            token2buf: dict[int, object] = {}
+
+            def submit_k(k: int) -> None:
+                bs = [free_bufs.pop() for _ in range(k)]
+                toks = pipe.submit("Echo.Echo", [payload] * k,
+                                   resp_bufs=bs)
+                token2buf.update(zip(toks, bs))
+
+            # Warm pass (untimed): fault in the landing buffers, grow the
+            # block pool and connections to steady state — at 64MB the
+            # first window alone moves 512MB through cold pages and would
+            # dominate a short measurement.
+            verified = False
+            submit_k(depth)
+            warm_left = depth
+            while warm_left > 0:
+                cs = pipe.poll(max_n=depth, timeout_ms=60000)
+                if not cs:
+                    return None  # wedged: bench must still print its line
+                for c in cs:
+                    if not c.ok:
+                        return None
+                    buf = token2buf.pop(c.token)
+                    if not verified:
+                        if not np.array_equal(buf, payload):
+                            return None
+                        verified = True
+                    free_bufs.append(buf)
+                    warm_left -= 1
+
+            submit_k(depth)  # prime the measured window
+            completed = 0
+            t0 = time.perf_counter()
+            inflight = depth
+            submitting = True
+            while inflight > 0:
+                cs = pipe.poll(max_n=depth, timeout_ms=60000)
+                if not cs:
+                    return None  # wedged
+                for c in cs:
+                    if not c.ok:
+                        return None  # a failed member voids the run
+                    free_bufs.append(token2buf.pop(c.token))
+                completed += len(cs)
+                inflight -= len(cs)
+                if submitting and (time.perf_counter() - t0 >= target_s
+                                   or completed >= 200_000):
+                    submitting = False  # drain the tail, stop refilling
+                if submitting:
+                    submit_k(len(cs))
+                    inflight += len(cs)
+            dt = time.perf_counter() - t0
+            if completed == 0 or not verified:
+                return None
+            return {
+                "goodput_gbps": round(size * completed / dt / 1e9, 3),
+                "pipeline_depth": depth,
+                "bytes_moved_per_iter": size * depth,
+                "conn": conn,
+            }
+        finally:
+            if pipe is not None:
+                pipe.close()
+            if ch is not None:
+                ch.close()
+            srv.stop()
+    except Exception:  # noqa: BLE001
+        return None
+
+
 def _child_zerocopy() -> None:
-    """Loopback RPC echo: bytes-copy path vs zero-copy (dlpack reference)
-    path — the staged-vs-copied delta VERDICT r2 asked to measure."""
+    """Loopback RPC echo, three Python-boundary strategies at 4MB: the
+    per-call bytes-copy path, the per-call dlpack zero-copy path, and the
+    headline — the 8-deep batched pipeline (one GIL crossing per batch,
+    zero-copy both directions).  All three run against a NATIVE echo
+    server so the numbers measure the client data plane, not the server's
+    GIL (the r05 row measured a Python handler on the far side)."""
     import numpy as np
 
     from brpc_tpu.rpc import zerocopy
     from brpc_tpu.rpc.client import Channel
     from brpc_tpu.rpc.server import Server
 
+    depth = int(os.environ.get("BENCH_PIPELINE_DEPTH", "8"))
     srv = Server()
-    srv.register("Echo.Echo", lambda call, req: call.respond(req))
+    srv.register_native_echo("Echo.Echo")
     srv.start(0)
     ch = Channel(f"127.0.0.1:{srv.port}", timeout_ms=10000)
     size = 4 << 20
@@ -313,13 +444,21 @@ def _child_zerocopy() -> None:
     for _ in range(iters):
         zerocopy.call_zero_copy(ch, "Echo.Echo", payload)
     zc_dt = time.perf_counter() - t0
-
-    print(json.dumps({
-        "kind": "py_loopback_4MB",
-        "copied_gbps": round(size * iters / copied_dt / 1e9, 3),
-        "zerocopy_gbps": round(size * iters / zc_dt / 1e9, 3),
-    }), flush=True)
     ch.close()
+
+    batched = _rpc_batch_goodput(size, depth=depth, target_s=1.5)
+    row = {
+        "kind": "py_loopback_4MB",
+        "server": "native_echo",
+        "copied_gbps": round(size * iters / copied_dt / 1e9, 3),
+        "percall_zerocopy_gbps": round(size * iters / zc_dt / 1e9, 3),
+        # Headline: the pipelined zero-copy plane (ISSUE 3 acceptance:
+        # >= 1.5 GB/s at 4MB x 8-deep vs 0.293 per-call in r05).
+        "zerocopy_gbps": batched["goodput_gbps"] if batched else None,
+        "pipeline_depth": depth,
+        "bytes_moved_per_iter": size * depth,
+    }
+    print(json.dumps(row), flush=True)
     srv.stop()
 
 
@@ -414,6 +553,10 @@ def _cpp_rows() -> list:
         (256, 1024, "pooled"),
         (8, 2 << 20, "single"),
         (8, 2 << 20, "pooled"),
+        # Native anchor for the Python batch leg: same 4MB x 8-deep
+        # geometry the zerocopy pipeline row runs, all-native — the gap
+        # between the two IS the Python-boundary cost per round.
+        (8, 4 << 20, "pooled"),
     ):
         try:
             out = subprocess.run(

@@ -1,5 +1,10 @@
 from brpc_tpu.rpc import fault  # noqa: F401
 from brpc_tpu.rpc._lib import IOBuf, load_library, parse_endpoint  # noqa: F401
+from brpc_tpu.rpc.batch import (  # noqa: F401
+    Batch,
+    Completion,
+    ZeroCopyResponse,
+)
 from brpc_tpu.rpc.client import Channel, ClusterChannel, RpcError  # noqa: F401
 from brpc_tpu.rpc.flags import get_flag, set_flag  # noqa: F401
 from brpc_tpu.rpc.server import Call, Server  # noqa: F401

@@ -196,6 +196,10 @@ def load_library() -> ctypes.CDLL:
             lib.trpc_iobuf_pop_front.restype = ctypes.c_size_t
             lib.trpc_iobuf_block_count.argtypes = [ctypes.c_void_p]
             lib.trpc_iobuf_block_count.restype = ctypes.c_size_t
+            lib.trpc_iobuf_block_ptr.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t,
+            ]
+            lib.trpc_iobuf_block_ptr.restype = ctypes.c_void_p
             lib.trpc_endpoint_parse.argtypes = [
                 ctypes.c_char_p,
                 ctypes.c_char_p,
@@ -259,6 +263,40 @@ def load_library() -> ctypes.CDLL:
                 ctypes.c_void_p, ctypes.c_char_p,
             ]
             lib.trpc_server_fault_set.restype = ctypes.c_int
+            # Batched async pipeline (capi/batch_capi.cc).
+            lib.trpc_batch_create.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.trpc_batch_create.restype = ctypes.c_void_p
+            lib.trpc_batch_submit.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_size_t),
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_size_t),
+                ctypes.c_size_t, ctypes.c_int64,
+                ctypes.c_void_p,  # deleter fn ptr (CFUNCTYPE or None)
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.trpc_batch_submit.restype = ctypes.c_size_t
+            lib.trpc_batch_poll.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
+                ctypes.c_int64,
+            ]
+            lib.trpc_batch_poll.restype = ctypes.c_size_t
+            lib.trpc_batch_cancel.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64,
+            ]
+            lib.trpc_batch_cancel.restype = ctypes.c_int
+            lib.trpc_batch_outstanding.argtypes = [ctypes.c_void_p]
+            lib.trpc_batch_outstanding.restype = ctypes.c_size_t
+            lib.trpc_batch_inflight.argtypes = [ctypes.c_void_p]
+            lib.trpc_batch_inflight.restype = ctypes.c_size_t
+            lib.trpc_batch_quiesce.argtypes = [ctypes.c_void_p]
+            lib.trpc_batch_destroy.argtypes = [ctypes.c_void_p]
+            lib.trpc_server_register_echo.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+            ]
+            lib.trpc_server_register_echo.restype = ctypes.c_int
             lib.trpc_cluster_destroy.argtypes = [ctypes.c_void_p]
             lib.trpc_cluster_call.argtypes = [
                 ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,

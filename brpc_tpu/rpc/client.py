@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import ctypes
 
+from brpc_tpu.rpc import batch as _batch
 from brpc_tpu.rpc._lib import IOBuf, load_library
 
 
@@ -12,6 +13,74 @@ class RpcError(Exception):
         super().__init__(f"rpc failed (code {code}): {text}")
         self.code = code
         self.text = text
+
+
+class _BatchMixin:
+    """Pipelined data plane shared by Channel and ClusterChannel: one GIL
+    crossing submits N calls, completions drain with the GIL released
+    (brpc_tpu/rpc/batch.py over cpp/capi/batch_capi.cc)."""
+
+    _default_batch = None
+    _pipelines = None
+
+    def pipeline(self) -> "_batch.Batch":
+        """A dedicated submit/poll pipeline over this channel."""
+        b = _batch.Batch(self)
+        self._track_pipeline(b)
+        return b
+
+    def _track_pipeline(self, b) -> None:
+        # Weakly tracked so close() can settle every live pipeline before
+        # the native channel dies under their issuing fibers; a pipeline
+        # the caller dropped closes itself via __del__ and falls out.
+        import weakref
+
+        if self._pipelines is None:
+            self._pipelines = weakref.WeakSet()
+        self._pipelines.add(b)
+
+    def _batch_default(self) -> "_batch.Batch":
+        if self._default_batch is None:
+            self._default_batch = _batch.Batch(self)
+            self._track_pipeline(self._default_batch)
+        return self._default_batch
+
+    def submit(self, method: str, requests, resp_bufs=None,
+               timeout_ms: int = 0) -> list[int]:
+        """Async pipelined issue: submits the requests (buffer-protocol
+        zero-copy) on this channel's default pipeline and returns their
+        tokens immediately; pair with poll()."""
+        return self._batch_default().submit(
+            method, requests, resp_bufs=resp_bufs, timeout_ms=timeout_ms)
+
+    def poll(self, max_n: int = 64, timeout_ms: int = -1):
+        """Drains completions from the default pipeline (GIL released
+        while waiting); see batch.Batch.poll."""
+        return self._batch_default().poll(max_n=max_n, timeout_ms=timeout_ms)
+
+    def cancel(self, token: int) -> bool:
+        """Cancels one in-flight submitted call by token."""
+        return self._batch_default().cancel(token)
+
+    def call_batch(self, method: str, requests, resp_bufs=None,
+                   timeout_ms: int = 0) -> list:
+        """Synchronous batched call over a fresh pipeline: all requests
+        issue concurrently (one crossing in, one poll loop out), results
+        return ALIGNED with requests, failed members as RpcError
+        instances (error isolation — one failure never poisons the
+        rest)."""
+        return _batch.call_batch(self, method, requests,
+                                 resp_bufs=resp_bufs, timeout_ms=timeout_ms)
+
+    def _close_default_batch(self) -> None:
+        b, self._default_batch = self._default_batch, None
+        if b is not None:
+            b.close()
+        # Explicit pipelines with members in flight would be left calling
+        # into a freed channel: quiesce each (cancel + settle).  Their
+        # buffered completions stay drainable; only close() frees them.
+        for p in list(self._pipelines or ()):
+            p.quiesce()
 
 
 def _call(lib, fn, ptr, method: str, request: bytes, extra) -> bytes:
@@ -24,7 +93,7 @@ def _call(lib, fn, ptr, method: str, request: bytes, extra) -> bytes:
     return resp.to_bytes()
 
 
-class Channel:
+class Channel(_BatchMixin):
     """Client stub for one server (parity: cpp/net/channel.h).
 
     use_shm routes same-host calls over shared-memory rings (TCP-handshaked;
@@ -52,12 +121,17 @@ class Channel:
         return out.value.decode()
 
     def close(self) -> None:
+        # The default pipeline settles first: destroying the channel with
+        # batch members in flight would pull the socket out from under
+        # them.  Buffered completions on explicit pipelines stay
+        # drainable after this returns.
+        self._close_default_batch()
         ptr, self._ptr = self._ptr, None
         if ptr:
             self._lib.trpc_channel_destroy(ptr)
 
 
-class ClusterChannel:
+class ClusterChannel(_BatchMixin):
     """Client over a named cluster with LB + retry + circuit breaking +
     hedging (parity: cpp/net/cluster.h).  naming_url: list://h:p,... or
     file://path; lb: rr | random | c_hash | wrr | p2c | la.
@@ -90,6 +164,7 @@ class ClusterChannel:
                      method, request, hash_key)
 
     def close(self) -> None:
+        self._close_default_batch()
         ptr, self._ptr = self._ptr, None
         if ptr:
             self._lib.trpc_cluster_destroy(ptr)

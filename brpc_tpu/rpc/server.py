@@ -66,6 +66,17 @@ class Server:
         if self._lib.trpc_server_register(self._ptr, method.encode(), cb, None) != 0:
             raise RuntimeError(f"register {method!r} failed (server running?)")
 
+    def register_native_echo(self, method: str = "Echo.Echo") -> None:
+        """Registers a NATIVE zero-copy echo handler for `method` — the
+        request blocks are ref-shared into the response with no Python
+        callback and no GIL.  The server-side anchor for data-plane
+        benchmarks: a Python handler would measure the server's GIL, not
+        the client pipeline."""
+        if self._lib.trpc_server_register_echo(
+                self._ptr, method.encode()) != 0:
+            raise RuntimeError(
+                f"register_native_echo {method!r} failed (server running?)")
+
     def set_faults(self, spec: str) -> None:
         """Server-side fault injection (cpp/net/fault.h svr_* fields):
         svr_delay=P:MS delays dispatch, svr_error=P:CODE answers with an

@@ -48,9 +48,36 @@ def _release(data, ctx):  # noqa: ARG001 - data unused, identity is ctx
 
 
 def live_sends() -> int:
-    """Number of arrays currently pinned by in-flight sends (tests)."""
+    """Number of buffers currently pinned by in-flight sends (tests).
+    One registry serves every zero-copy producer (per-call `append_jax`
+    AND the batch pipeline), so a pin leaked by either is visible here."""
     with _lock:
         return len(_live)
+
+
+# The CFUNCTYPE deleter for ctypes callers outside this module (the batch
+# pipeline): pass as the request deleter with a `pin(...)` token as ctx.
+release_cb = _release
+
+
+def pin(*objs) -> int:
+    """Registers `objs` in the live-send registry and returns the token
+    to hand the native side as deleter ctx (with `release_cb`); the
+    entry — and the last Python reference to the pinned buffers — drops
+    when the runtime runs the deleter."""
+    global _next_token
+    with _lock:
+        token = _next_token
+        _next_token += 1
+        _live[token] = objs
+    return token
+
+
+def unpin(token: int) -> None:
+    """Drops a pin that was never handed to the native side (failed
+    submit paths); a pin the runtime owns is released by its deleter."""
+    with _lock:
+        _live.pop(token, None)
 
 
 def host_view(array):

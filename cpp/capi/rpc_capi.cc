@@ -82,6 +82,19 @@ int trpc_call_respond(void* call_handle, const char* data, size_t len,
   return 0;
 }
 
+// Registers a NATIVE zero-copy echo handler (response shares the request
+// blocks by reference; no Python callback, no GIL).  The server-side
+// anchor for the Python data-plane benchmarks and the batch-API perf
+// floor: against a Python handler they would measure the server's GIL,
+// not the client pipeline.
+int trpc_server_register_echo(void* srv, const char* method) {
+  return static_cast<Server*>(srv)->RegisterMethod(
+      method, [](Controller*, const IOBuf& req, IOBuf* resp, Closure done) {
+        resp->append(req);  // zero-copy ref share
+        done();
+      });
+}
+
 int trpc_server_start(void* srv, int port) {
   return static_cast<Server*>(srv)->Start(port);
 }
@@ -115,6 +128,7 @@ void* create_channel(const char* addr, int64_t timeout_ms, bool use_shm,
 // runtime flags here.
 void ensure_runtime_flags() {
   rpcz_enabled();
+  rpcz_ring_capacity();  // registers trpc_rpcz_ring_size
   fault_register_flag();
 }
 }  // namespace

@@ -93,6 +93,7 @@ void complete_locked_call(fid_t cid, Controller* cntl) {
     submit_span(span, cntl->error_code());
   }
   const uint64_t timer = cntl->call().timeout_timer;
+  const bool inline_safe = cntl->done_inline_safe();
   Closure done = std::move(cntl->call().done);
   fid_unlock_and_destroy(cid);
   if (timer != 0) {
@@ -103,8 +104,10 @@ void complete_locked_call(fid_t cid, Controller* cntl) {
     // the fid instead).  When this completion is running inline on a
     // connection's dispatch fiber (batched-dispatch fast path), arbitrary
     // user code must not park it — everything behind it on the connection
-    // would stall — so the closure gets its own fiber there.
-    if (messenger_in_inline_dispatch()) {
+    // would stall — so the closure gets its own fiber there.  Dones the
+    // framework marked inline-safe (batch-pipeline completions: bounded,
+    // park-free) skip the spawn and run here directly.
+    if (messenger_in_inline_dispatch() && !inline_safe) {
       auto* heap_done = new Closure(std::move(done));
       if (fiber_start(
               nullptr,

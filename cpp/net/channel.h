@@ -76,6 +76,12 @@ class Channel {
                   IOBuf* response, Controller* cntl, Closure done = nullptr);
 
   const EndPoint& endpoint() const { return ep_; }
+  // Connection type parsed in Init (socket_map.h ConnectionType raw
+  // value; 0 = single).  The batch pipeline keys its issue strategy on
+  // this: single-connection channels issue from ONE fiber (FIFO wire
+  // order), pooled/short fan out one issue fiber per call so inline
+  // request writes overlap across their sockets.
+  uint8_t conn_type_raw() const { return conn_type_; }
   // Name of the live connection's transport ("tcp", "shm_ring",
   // "ici_ring", "tls"), or "" if no socket has been established yet.
   std::string transport_name();

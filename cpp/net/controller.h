@@ -86,6 +86,16 @@ class Controller {
   // (controller.h:308 IsCanceled parity).
   bool IsCanceled() const;
 
+  // Async-completion hook (batch pipeline): a done closure marked
+  // inline-safe is BOUNDED FRAMEWORK WORK (memcpy + atomic push + wake,
+  // never parks, never runs user code) and may execute directly on a
+  // connection's dispatch fiber instead of costing a completion-fiber
+  // spawn per call (net/channel.cc complete_locked_call).  Default off:
+  // arbitrary user dones must not stall everything behind them on the
+  // connection.
+  void set_done_inline_safe(bool on) { done_inline_safe_ = on; }
+  bool done_inline_safe() const { return done_inline_safe_; }
+
   // -- progressive bodies (net/progressive.h) --------------------------
   // Server handler (HTTP serving): the response body will be streamed
   // incrementally; done() flushes headers (chunked) and the returned
@@ -157,6 +167,7 @@ class Controller {
   uint8_t req_compress_ = 0;
   uint8_t resp_compress_ = 0;
   bool checksum_ = false;
+  bool done_inline_safe_ = false;
   int64_t latency_us_ = 0;
   IOBuf request_attachment_;
   IOBuf response_attachment_;

@@ -1,5 +1,6 @@
 #include "net/span.h"
 
+#include <cstdlib>
 #include <mutex>
 
 #include "base/flags.h"
@@ -11,12 +12,21 @@ namespace trpc {
 
 namespace {
 
-constexpr size_t kRingSize = 4096;
+constexpr size_t kDefaultRingSize = 4096;
+
+Flag* rpcz_ring_size_flag();
 
 Flag* rpcz_flag() {
-  static Flag* f = Flag::define_bool(
-      "rpcz_enabled", false,
-      "collect per-RPC spans, browsable via /rpcz (reference: -enable_rpcz)");
+  static Flag* f = [] {
+    // Register the companion ring-size knob alongside, so any process
+    // that can flip rpcz_enabled (every server's /flags) can also widen
+    // the span window without a separate lazy touch.
+    rpcz_ring_size_flag();
+    return Flag::define_bool(
+        "rpcz_enabled", false,
+        "collect per-RPC spans, browsable via /rpcz "
+        "(reference: -enable_rpcz)");
+  }();
   return f;
 }
 
@@ -26,13 +36,58 @@ std::mutex& ring_mu() {
   return *mu;
 }
 struct SpanRing {
-  std::vector<Span> slots{kRingSize};
+  std::vector<Span> slots{kDefaultRingSize};
   size_t next = 0;
   size_t count = 0;
 };
 SpanRing& ring() {
   static SpanRing* r = new SpanRing();
   return *r;
+}
+
+// Rebuilds the ring at `cap` slots, keeping the newest spans that fit
+// (oldest-of-kept lands at slot 0 so the walk order is unchanged).
+void resize_ring(size_t cap) {
+  std::lock_guard<std::mutex> g(ring_mu());
+  SpanRing& r = ring();
+  if (cap == r.slots.size()) {
+    return;
+  }
+  std::vector<Span> fresh(cap);
+  const size_t keep = r.count < cap ? r.count : cap;
+  for (size_t i = 0; i < keep; ++i) {
+    const size_t idx =
+        (r.next + r.slots.size() - keep + i) % r.slots.size();
+    fresh[i] = std::move(r.slots[idx]);
+  }
+  r.slots = std::move(fresh);
+  r.count = keep;
+  r.next = keep % cap;
+}
+
+// Reloadable ring capacity: a busy server at the default 4096 evicts a
+// hunted span in well under a second; /flags/trpc_rpcz_ring_size lets an
+// operator widen the window live without a restart.
+Flag* rpcz_ring_size_flag() {
+  static Flag* f = [] {
+    Flag* fl = Flag::define_int64(
+        "trpc_rpcz_ring_size", kDefaultRingSize,
+        "rpcz span ring capacity (spans kept for /rpcz); reloadable, "
+        "16..1048576, newest spans survive a resize");
+    fl->set_validator([](const std::string& v) {
+      if (v.empty()) {
+        return false;
+      }
+      char* end = nullptr;
+      const long n = strtol(v.c_str(), &end, 10);
+      return end != nullptr && *end == '\0' && n >= 16 && n <= (1 << 20);
+    });
+    fl->on_update([](Flag* f2) {
+      resize_ring(static_cast<size_t>(f2->int64_value()));
+    });
+    return fl;
+  }();
+  return f;
 }
 
 // Ambient (fiber-local) trace context, stored by VALUE: the two u64 ids
@@ -108,9 +163,10 @@ void submit_span(Span* s, int32_t error_code) {
   {
     std::lock_guard<std::mutex> g(ring_mu());
     SpanRing& r = ring();
+    const size_t cap = r.slots.size();
     r.slots[r.next] = std::move(*s);
-    r.next = (r.next + 1) % kRingSize;
-    if (r.count < kRingSize) {
+    r.next = (r.next + 1) % cap;
+    if (r.count < cap) {
       ++r.count;
     }
   }
@@ -133,15 +189,22 @@ std::vector<Span> recent_spans(size_t limit, uint64_t trace_id) {
   std::vector<Span> out;
   std::lock_guard<std::mutex> g(ring_mu());
   const SpanRing& r = ring();
+  const size_t cap = r.slots.size();
   for (size_t i = 0; i < r.count && out.size() < limit; ++i) {
     // Newest first: walk backward from next-1.
-    const size_t idx = (r.next + kRingSize - 1 - i) % kRingSize;
+    const size_t idx = (r.next + cap - 1 - i) % cap;
     const Span& s = r.slots[idx];
     if (trace_id == 0 || s.trace_id == trace_id) {
       out.push_back(s);
     }
   }
   return out;
+}
+
+size_t rpcz_ring_capacity() {
+  rpcz_ring_size_flag();  // ensure registration
+  std::lock_guard<std::mutex> g(ring_mu());
+  return ring().slots.size();
 }
 
 }  // namespace trpc

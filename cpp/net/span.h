@@ -5,11 +5,15 @@
 // channel.cpp:506-527 and baidu_rpc_protocol.cpp:648-661; trace context
 // trace_id/span_id/parent_span_id rides inside the RpcMeta; spans browsed
 // via /rpcz, builtin/rpcz_service.*).  Redesigned condensed: spans land in
-// a fixed-size in-memory ring (the reference persists to a per-process
+// an in-memory ring (the reference persists to a per-process
 // leveldb — an embedded KV store is out of scope; the ring holds the
-// recent window /rpcz actually shows), collection is gated by the
-// reloadable flag `rpcz_enabled`, and the ambient trace context lives in
-// fiber-local storage so nested client calls inherit the server span.
+// recent window /rpcz actually shows) whose capacity is the reloadable
+// flag `trpc_rpcz_ring_size` (default 4096; flip via
+// /flags/trpc_rpcz_ring_size?setvalue=N so a busy server does not evict
+// the span being hunted before it can be read), collection is gated by
+// the reloadable flag `rpcz_enabled`, and the ambient trace context
+// lives in fiber-local storage so nested client calls inherit the
+// server span.
 #pragma once
 
 #include <cstdint>
@@ -54,6 +58,11 @@ void get_ambient_trace(uint64_t* trace_id, uint64_t* span_id);
 // /rpcz support: most-recent spans, newest first (bounded by ring size);
 // trace_id filter when nonzero.
 std::vector<Span> recent_spans(size_t limit, uint64_t trace_id = 0);
+
+// Live span-ring capacity (the `trpc_rpcz_ring_size` flag's value;
+// touching this also registers the flag).  Resizing preserves the
+// newest spans that fit.
+size_t rpcz_ring_capacity();
 
 uint64_t new_span_id();
 

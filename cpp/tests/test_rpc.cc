@@ -14,6 +14,8 @@
 
 #include "base/compress.h"
 #include "base/device_arena.h"
+#include "base/flags.h"
+#include "net/span.h"
 #include "net/socket_map.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
@@ -1534,6 +1536,280 @@ TEST_CASE(hotpath_vars_visible_and_counting) {
   EXPECT(drains > 0);
   EXPECT(nodes >= drains);  // every drain absorbed ≥1 node
   EXPECT(msgs > 0);
+}
+
+// ---- batch pipeline (capi/batch_capi.cc) --------------------------------
+// The C ABI the Python data plane drives: N calls per submit crossing,
+// completions drained from an MPSC ring.  Layout below is the ABI mirror
+// of batch_capi.cc's trpc_batch_completion.
+
+extern "C" {
+struct trpc_batch_completion {
+  uint64_t token;
+  int32_t status;
+  uint32_t resp_copied;
+  uint64_t resp_len;
+  void* resp_iobuf;
+  char err[120];
+};
+void* trpc_batch_create(void* channel, int is_cluster);
+size_t trpc_batch_submit(void* batch, const char* method,
+                         const void* const* reqs, const size_t* req_lens,
+                         void* const* resp_bufs, const size_t* resp_caps,
+                         size_t n, int64_t timeout_ms,
+                         void (*req_deleter)(void*, void*),
+                         void* const* req_deleter_ctxs,
+                         uint64_t* tokens_out);
+size_t trpc_batch_poll(void* batch, trpc_batch_completion* out, size_t max,
+                       int64_t timeout_ms);
+int trpc_batch_cancel(void* batch, uint64_t token);
+size_t trpc_batch_outstanding(void* batch);
+void trpc_batch_destroy(void* batch);
+void trpc_iobuf_destroy(void* buf);
+}
+
+namespace {
+
+// Drains completions until `want` records (or the deadline) — poll may
+// legitimately return them across several wakeups.
+std::vector<trpc_batch_completion> drain_batch(void* b, size_t want,
+                                               int64_t deadline_ms) {
+  std::vector<trpc_batch_completion> out;
+  const int64_t deadline = monotonic_time_us() + deadline_ms * 1000;
+  while (out.size() < want && monotonic_time_us() < deadline) {
+    trpc_batch_completion got[64];
+    const size_t n = trpc_batch_poll(b, got, 64, 500);
+    for (size_t i = 0; i < n; ++i) {
+      out.push_back(got[i]);
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
+TEST_CASE(batch_submit_poll_completeness) {
+  start_server_once();
+  for (const char* conn : {"single", "pooled"}) {
+    Channel ch;
+    Channel::Options opts;
+    opts.timeout_ms = 10000;
+    opts.connection_type = conn;
+    EXPECT_EQ(ch.Init(addr(), &opts), 0);
+    void* b = trpc_batch_create(&ch, 0);
+    EXPECT(b != nullptr);
+    // Every member distinct so a cross-wired completion is detectable.
+    const size_t kCalls = 48;
+    std::vector<std::string> payloads;
+    std::vector<const void*> reqs;
+    std::vector<size_t> lens;
+    for (size_t i = 0; i < kCalls; ++i) {
+      payloads.push_back("batch-payload-" + std::to_string(i) + "-" +
+                         std::string(1 + i * 37, 'a' + i % 26));
+      reqs.push_back(payloads.back().data());
+      lens.push_back(payloads.back().size());
+    }
+    // Half the members land in caller buffers (the zero-copy receive
+    // path), half ride out as IOBuf handles.
+    std::vector<std::string> landing(kCalls);
+    std::vector<void*> resp_bufs(kCalls, nullptr);
+    std::vector<size_t> resp_caps(kCalls, 0);
+    for (size_t i = 0; i < kCalls; i += 2) {
+      landing[i].resize(payloads[i].size());
+      resp_bufs[i] = landing[i].data();
+      resp_caps[i] = landing[i].size();
+    }
+    std::vector<uint64_t> tokens(kCalls);
+    EXPECT_EQ(trpc_batch_submit(b, "Echo.Echo", reqs.data(), lens.data(),
+                                resp_bufs.data(), resp_caps.data(), kCalls,
+                                10000, nullptr, nullptr, tokens.data()),
+              kCalls);
+    // Tokens are handed out in submit order.
+    for (size_t i = 1; i < kCalls; ++i) {
+      EXPECT(tokens[i] > tokens[i - 1]);
+    }
+    auto done = drain_batch(b, kCalls, 15000);
+    EXPECT_EQ(done.size(), kCalls);
+    std::vector<bool> seen(kCalls, false);
+    for (const auto& c : done) {
+      size_t idx = kCalls;
+      for (size_t i = 0; i < kCalls; ++i) {
+        if (tokens[i] == c.token) {
+          idx = i;
+          break;
+        }
+      }
+      EXPECT(idx < kCalls);
+      EXPECT(!seen[idx]);  // exactly once
+      seen[idx] = true;
+      EXPECT_EQ(c.status, 0);
+      EXPECT_EQ(c.resp_len, payloads[idx].size());
+      if (resp_bufs[idx] != nullptr) {
+        EXPECT_EQ(c.resp_copied, 1u);
+        EXPECT(c.resp_iobuf == nullptr);
+        EXPECT(landing[idx] == payloads[idx]);
+      } else {
+        EXPECT_EQ(c.resp_copied, 0u);
+        EXPECT(c.resp_iobuf != nullptr);
+        std::string back(c.resp_len, '\0');
+        static_cast<IOBuf*>(c.resp_iobuf)->copy_to(back.data(), back.size());
+        EXPECT(back == payloads[idx]);
+        trpc_iobuf_destroy(c.resp_iobuf);
+      }
+    }
+    EXPECT_EQ(trpc_batch_outstanding(b), 0u);
+    trpc_batch_destroy(b);
+  }
+}
+
+TEST_CASE(batch_member_failure_is_isolated) {
+  start_server_once();
+  Channel ch;
+  Channel::Options opts;
+  opts.timeout_ms = 5000;
+  EXPECT_EQ(ch.Init(addr(), &opts), 0);
+  void* b = trpc_batch_create(&ch, 0);
+  // A failing batch rides the same ring as a succeeding one; neither
+  // poisons the other.
+  const char* freq[2] = {"f0", "f1"};
+  const void* freqs[2] = {freq[0], freq[1]};
+  size_t flens[2] = {2, 2};
+  uint64_t ftok[2];
+  EXPECT_EQ(trpc_batch_submit(b, "Echo.Fail", freqs, flens, nullptr,
+                              nullptr, 2, 5000, nullptr, nullptr, ftok),
+            2u);
+  const void* ereqs[2] = {"ok0", "ok1"};
+  size_t elens[2] = {3, 3};
+  uint64_t etok[2];
+  EXPECT_EQ(trpc_batch_submit(b, "Echo.Echo", ereqs, elens, nullptr,
+                              nullptr, 2, 5000, nullptr, nullptr, etok),
+            2u);
+  auto done = drain_batch(b, 4, 10000);
+  EXPECT_EQ(done.size(), 4u);
+  int failed = 0, succeeded = 0;
+  for (const auto& c : done) {
+    if (c.token == ftok[0] || c.token == ftok[1]) {
+      EXPECT_EQ(c.status, 42);
+      EXPECT(strstr(c.err, "deliberate failure") != nullptr);
+      ++failed;
+    } else {
+      EXPECT_EQ(c.status, 0);
+      EXPECT_EQ(c.resp_len, 3u);
+      if (c.resp_iobuf != nullptr) {
+        trpc_iobuf_destroy(c.resp_iobuf);
+      }
+      ++succeeded;
+    }
+  }
+  EXPECT_EQ(failed, 2);
+  EXPECT_EQ(succeeded, 2);
+  trpc_batch_destroy(b);
+}
+
+TEST_CASE(batch_cancel_mid_flight) {
+  start_server_once();
+  Channel ch;
+  Channel::Options opts;
+  opts.timeout_ms = 10000;
+  EXPECT_EQ(ch.Init(addr(), &opts), 0);
+  void* b = trpc_batch_create(&ch, 0);
+  const void* reqs[4] = {"s0", "s1", "s2", "s3"};
+  size_t lens[4] = {2, 2, 2, 2};
+  uint64_t tokens[4];
+  // Echo.Slow parks 300ms per call; cancel one while all four are parked.
+  EXPECT_EQ(trpc_batch_submit(b, "Echo.Slow", reqs, lens, nullptr, nullptr,
+                              4, 10000, nullptr, nullptr, tokens),
+            4u);
+  fiber_sleep_us(50 * 1000);  // let the members reach the server
+  EXPECT_EQ(trpc_batch_cancel(b, tokens[1]), 0);
+  EXPECT_EQ(trpc_batch_cancel(b, 999999u), -1);  // unknown token
+  auto done = drain_batch(b, 4, 10000);
+  EXPECT_EQ(done.size(), 4u);
+  for (const auto& c : done) {
+    if (c.token == tokens[1]) {
+      EXPECT_EQ(c.status, ECANCELED);
+    } else {
+      EXPECT_EQ(c.status, 0);
+      if (c.resp_iobuf != nullptr) {
+        trpc_iobuf_destroy(c.resp_iobuf);
+      }
+    }
+  }
+  // A polled token is gone: cancel is a clean miss, not a crash.
+  EXPECT_EQ(trpc_batch_cancel(b, tokens[1]), -1);
+  trpc_batch_destroy(b);
+}
+
+TEST_CASE(batch_destroy_with_inflight_settles) {
+  start_server_once();
+  auto* ch = new Channel();
+  Channel::Options opts;
+  opts.timeout_ms = 10000;
+  EXPECT_EQ(ch->Init(addr(), &opts), 0);
+  void* b = trpc_batch_create(ch, 0);
+  const void* reqs[8];
+  size_t lens[8];
+  for (int i = 0; i < 8; ++i) {
+    reqs[i] = "x";
+    lens[i] = 1;
+  }
+  uint64_t tokens[8];
+  EXPECT_EQ(trpc_batch_submit(b, "Echo.Slow", reqs, lens, nullptr, nullptr,
+                              8, 10000, nullptr, nullptr, tokens),
+            8u);
+  // Destroy races the in-flight members: it must cancel them, wait for
+  // every completion to settle and free the unpolled records — the
+  // channel must outlive this call, nothing else.
+  trpc_batch_destroy(b);
+  // The channel is still healthy afterwards.
+  Controller cntl;
+  IOBuf req, resp;
+  req.append("after-destroy");
+  ch->CallMethod("Echo.Echo", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+  EXPECT(resp.to_string() == "after-destroy");
+  delete ch;
+}
+
+TEST_CASE(rpcz_ring_size_reloadable) {
+  start_server_once();
+  const size_t original = rpcz_ring_capacity();
+  EXPECT(original >= 16);
+  // Undersized and oversized values are rejected by the validator.
+  EXPECT(Flag::set("trpc_rpcz_ring_size", "4") != 0);
+  EXPECT(Flag::set("trpc_rpcz_ring_size", "notanumber") != 0);
+  EXPECT_EQ(Flag::set("trpc_rpcz_ring_size", "32"), 0);
+  EXPECT_EQ(rpcz_ring_capacity(), 32u);
+  EXPECT_EQ(Flag::set("rpcz_enabled", "true"), 0);
+  Channel ch;
+  EXPECT_EQ(ch.Init(addr()), 0);
+  for (int i = 0; i < 80; ++i) {  // >> 32 spans (client + server side)
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("span");
+    ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+  }
+  EXPECT(recent_spans(1000).size() <= 32);
+  EXPECT(!recent_spans(1000).empty());
+  // Growing the ring keeps the newest spans and raises the ceiling.
+  EXPECT_EQ(Flag::set("trpc_rpcz_ring_size", "128"), 0);
+  EXPECT_EQ(rpcz_ring_capacity(), 128u);
+  const size_t kept = recent_spans(1000).size();
+  EXPECT(kept > 0);
+  EXPECT(kept <= 32);  // a resize never invents spans
+  for (int i = 0; i < 40; ++i) {
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("span2");
+    ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+  }
+  EXPECT(recent_spans(1000).size() > 32);  // the wider window is live
+  EXPECT_EQ(Flag::set("rpcz_enabled", "false"), 0);
+  EXPECT_EQ(Flag::set("trpc_rpcz_ring_size",
+                      std::to_string(original).c_str()),
+            0);
 }
 
 TEST_MAIN

@@ -17,6 +17,7 @@ Run with: pytest -m slow tests/test_perf_smoke.py
 
 import json
 import subprocess
+import time
 
 import pytest
 
@@ -53,3 +54,83 @@ def test_1kb_never_wedges_across_connection_types():
         row = _run_bench(32, 1024, conn)
         assert row["failures"] == 0, f"{conn}: {row}"
         assert row["qps"] > 0, f"{conn}: {row}"
+
+
+BATCH_GBPS_FLOOR = 1.5
+BATCH_SIZE = 4 << 20
+BATCH_DEPTH = 8
+
+
+def test_batch_api_4mb_8deep_zerocopy_floor():
+    """The Python data-plane floor (ISSUE 3): 4MB x 8-deep loopback echo
+    through the batch submit/poll pipeline — buffer-protocol zero-copy
+    requests, responses landing in recycled caller buffers, native echo
+    server, window held full (poll k / resubmit k) — must sustain
+    >= 1.5 GB/s with zero failures.  Guards the pipeline against
+    regressing back to the per-call GIL-bounce ceiling (~0.3 GB/s in
+    r05)."""
+    import numpy as np
+
+    from brpc_tpu.rpc import Channel, Server
+
+    srv = Server()
+    srv.register_native_echo("Echo.Echo")
+    srv.start(0)
+    try:
+        # Pooled connections: the batch pipeline fans out one issue fiber
+        # per call, so the 8 members stream over 8 sockets concurrently.
+        ch = Channel(f"127.0.0.1:{srv.port}", timeout_ms=30000,
+                     connection_type="pooled")
+        payload = np.arange(BATCH_SIZE // 4, dtype=np.uint32).view(np.uint8)
+        pipe = ch.pipeline()
+        free_bufs = [np.empty(BATCH_SIZE, dtype=np.uint8)
+                     for _ in range(BATCH_DEPTH)]
+        token2buf = {}
+        failures = 0
+
+        def submit_k(k: int) -> None:
+            bufs = [free_bufs.pop() for _ in range(k)]
+            toks = pipe.submit("Echo.Echo", [payload] * k, resp_bufs=bufs)
+            token2buf.update(zip(toks, bufs))
+
+        def drain(n: int) -> int:
+            nonlocal failures
+            got = 0
+            while got < n:
+                cs = pipe.poll(max_n=BATCH_DEPTH, timeout_ms=30000)
+                assert cs, "batch pipeline wedged: poll timed out"
+                for c in cs:
+                    failures += 0 if c.ok else 1
+                    free_bufs.append(token2buf.pop(c.token))
+                    got += 1
+            return got
+
+        # Warm pass: fault in buffers, grow pool blocks + connections.
+        submit_k(BATCH_DEPTH)
+        drain(BATCH_DEPTH)
+        assert np.array_equal(free_bufs[0], payload), "echo corrupted"
+
+        iters = 64  # 2GB total, window never drains mid-run
+        submit_k(BATCH_DEPTH)
+        inflight = BATCH_DEPTH
+        completed = 0
+        t0 = time.perf_counter()
+        while completed < iters * BATCH_DEPTH:
+            n = drain(1)
+            completed += n
+            inflight -= n
+            refill = min(iters * BATCH_DEPTH - completed - inflight, n)
+            if completed + inflight < iters * BATCH_DEPTH:
+                submit_k(refill)
+                inflight += refill
+        dt = time.perf_counter() - t0
+        gbps = BATCH_SIZE * completed / dt / 1e9
+        pipe.close()
+        assert failures == 0, f"{failures} batch members failed"
+        assert gbps >= BATCH_GBPS_FLOOR, (
+            f"4MB x {BATCH_DEPTH}-deep batch zerocopy {gbps:.3f} GB/s "
+            f"under floor {BATCH_GBPS_FLOOR} (Python data plane regressed "
+            f"toward the per-call bounce)"
+        )
+    finally:
+        srv.stop()
