@@ -185,6 +185,8 @@ def _child_sweep(sizes: list[int]) -> None:
                 row["pipeline_depth"] = rpc["pipeline_depth"]
                 row["bytes_moved_per_iter"] = rpc["bytes_moved_per_iter"]
                 row["goodput_method"] = "rpc_call_batch"
+                if rpc.get("vars"):
+                    row["vars"] = rpc["vars"]
         if hbm_peak is not None and step is fused:
             # One read + one write pass per echo → HBM bytes = 2× goodput
             # bytes.  The roofline discipline of BASELINE.md applied to
@@ -308,6 +310,42 @@ def _child_tpu_rpc() -> None:
     print(json.dumps(row), flush=True)
 
 
+def _observe_snapshot() -> dict | None:
+    """Key observability vars for a BENCH row (ISSUE 4): every perf
+    number ships with its own attribution — how often the wait-free
+    inline write path hit, how big dispatch batches ran, how deep the
+    pipeline actually was, and the per-method/client p99s.  Tolerant of
+    ANY missing var (older libraries, partial registries): absent keys
+    are simply omitted so BENCH artifacts stay comparable across
+    rounds."""
+    try:
+        from brpc_tpu.rpc import observe
+        v = observe.Vars.dump()
+    except Exception:  # noqa: BLE001 — bench must still print its line
+        return None
+    out: dict = {}
+    try:
+        att = v.get("socket_inline_write_attempts", 0)
+        hit = v.get("socket_inline_write_hits", 0)
+        if att:
+            out["inline_write_ratio"] = round(hit / att, 4)
+    except Exception:  # noqa: BLE001
+        pass
+    for var, key, field in (
+        ("messenger_dispatch_batch", "dispatch_batch_p50", "p50_us"),
+        ("rpc_server_Echo.Echo", "server_echo_p99_us", "p99_us"),
+        ("rpc_client_batch", "client_batch_p99_us", "p99_us"),
+    ):
+        try:
+            out[key] = getattr(observe.Latency.read(var), field)
+        except Exception:  # noqa: BLE001 — var not registered in this run
+            pass
+    for name in ("batch_depth", "batch_inflight"):
+        if isinstance(v.get(name), (int, float)) and v[name] >= 0:
+            out[name] = v[name]
+    return out or None
+
+
 def _rpc_batch_goodput(size: int, depth: int = 8,
                        target_s: float = 1.0) -> dict | None:
     """Loopback echo goodput of the PYTHON DATA PLANE at `depth`-deep
@@ -399,6 +437,10 @@ def _rpc_batch_goodput(size: int, depth: int = 8,
                 "pipeline_depth": depth,
                 "bytes_moved_per_iter": size * depth,
                 "conn": conn,
+                # Built-in attribution (ISSUE 4): the observability-plane
+                # snapshot taken right after the measured window, from
+                # the process that ran it.
+                "vars": _observe_snapshot(),
             }
         finally:
             if pipe is not None:
@@ -457,6 +499,7 @@ def _child_zerocopy() -> None:
         "zerocopy_gbps": batched["goodput_gbps"] if batched else None,
         "pipeline_depth": depth,
         "bytes_moved_per_iter": size * depth,
+        "vars": (batched or {}).get("vars") or _observe_snapshot(),
     }
     print(json.dumps(row), flush=True)
     srv.stop()

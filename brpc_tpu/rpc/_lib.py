@@ -297,6 +297,61 @@ def load_library() -> ctypes.CDLL:
                 ctypes.c_void_p, ctypes.c_char_p,
             ]
             lib.trpc_server_register_echo.restype = ctypes.c_int
+            # Observability plane (capi/observe_capi.cc).
+            lib.trpc_vars_dump.argtypes = [
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.trpc_vars_dump.restype = ctypes.c_size_t
+            lib.trpc_var_read.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.trpc_var_read.restype = ctypes.c_int
+            lib.trpc_latency_read.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_double),
+            ]
+            lib.trpc_latency_read.restype = ctypes.c_int
+            lib.trpc_var_exists.argtypes = [ctypes.c_char_p]
+            lib.trpc_var_exists.restype = ctypes.c_int
+            lib.trpc_rpcz_dump.argtypes = [
+                ctypes.c_size_t, ctypes.c_uint64, ctypes.c_int,
+                ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.trpc_rpcz_dump.restype = ctypes.c_size_t
+            lib.trpc_trace_get.argtypes = [
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.trpc_trace_set.argtypes = [
+                ctypes.c_uint64, ctypes.c_uint64,
+            ]
+            lib.trpc_trace_clear.argtypes = []
+            lib.trpc_trace_new_id.restype = ctypes.c_uint64
+            lib.trpc_span_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
+            lib.trpc_span_start.restype = ctypes.c_void_p
+            lib.trpc_span_annotate.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+            ]
+            lib.trpc_span_ids.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.trpc_span_end.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.trpc_latency_create.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p,
+            ]
+            lib.trpc_latency_create.restype = ctypes.c_void_p
+            lib.trpc_latency_record.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+            ]
+            lib.trpc_latency_destroy.argtypes = [ctypes.c_void_p]
+            lib.trpc_gauge_create.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p,
+            ]
+            lib.trpc_gauge_create.restype = ctypes.c_void_p
+            lib.trpc_gauge_set.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+            lib.trpc_gauge_add.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+            lib.trpc_gauge_add.restype = ctypes.c_int64
+            lib.trpc_gauge_destroy.argtypes = [ctypes.c_void_p]
             lib.trpc_cluster_destroy.argtypes = [ctypes.c_void_p]
             lib.trpc_cluster_call.argtypes = [
                 ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,

@@ -3,8 +3,10 @@
 from __future__ import annotations
 
 import ctypes
+import time
 
 from brpc_tpu.rpc import batch as _batch
+from brpc_tpu.rpc import observe as _observe
 from brpc_tpu.rpc._lib import IOBuf, load_library
 
 
@@ -83,11 +85,18 @@ class _BatchMixin:
             p.quiesce()
 
 
-def _call(lib, fn, ptr, method: str, request: bytes, extra) -> bytes:
+def _call(lib, fn, ptr, method: str, request: bytes, extra,
+          latency=None) -> bytes:
     resp = IOBuf()
     err = ctypes.create_string_buffer(256)
+    t0 = time.perf_counter()
     rc = fn(ptr, method.encode(), request, len(request), resp._ptr, extra,
             err, 256)
+    if latency is not None:
+        # Client-side view of the same call the server's per-method
+        # recorder times: includes queueing, wire, and (on errors) the
+        # full timeout wait — the gap between the two IS the network.
+        latency.record(int((time.perf_counter() - t0) * 1e6))
     if rc != 0:
         raise RpcError(rc, err.value.decode(errors="replace"))
     return resp.to_bytes()
@@ -108,10 +117,19 @@ class Channel(_BatchMixin):
         if not self._ptr:
             raise ValueError(
                 f"bad address or options: {addr!r} / {connection_type!r}")
+        # Client-side latency recorder in the shared var registry
+        # (observe plane): shows in /vars + /brpc_metrics next to the
+        # server's rpc_server_* series, readable in-process via
+        # observe.Latency.read(ch.latency.name) or ch.latency.stats().
+        # unique_var_name: a second channel to the same address gets
+        # rpc_client_<addr>#2 instead of shadowing this recorder.
+        self.latency = _observe.Latency(
+            _observe.unique_var_name(f"rpc_client_{addr}"),
+            f"client-side latency of sync calls on channel {addr}")
 
     def call(self, method: str, request: bytes, timeout_ms: int = 0) -> bytes:
         return _call(self._lib, self._lib.trpc_channel_call, self._ptr,
-                     method, request, timeout_ms)
+                     method, request, timeout_ms, latency=self.latency)
 
     @property
     def transport(self) -> str:
@@ -129,6 +147,7 @@ class Channel(_BatchMixin):
         ptr, self._ptr = self._ptr, None
         if ptr:
             self._lib.trpc_channel_destroy(ptr)
+        self.latency.close()
 
 
 class ClusterChannel(_BatchMixin):
@@ -158,13 +177,18 @@ class ClusterChannel(_BatchMixin):
         )
         if not self._ptr:
             raise ValueError(f"cluster init failed: {naming_url!r}")
+        self.latency = _observe.Latency(
+            _observe.unique_var_name(f"rpc_client_{naming_url}"),
+            f"client-side latency of sync calls on cluster {naming_url} "
+            "(includes retries and hedges)")
 
     def call(self, method: str, request: bytes, hash_key: int = 0) -> bytes:
         return _call(self._lib, self._lib.trpc_cluster_call, self._ptr,
-                     method, request, hash_key)
+                     method, request, hash_key, latency=self.latency)
 
     def close(self) -> None:
         self._close_default_batch()
         ptr, self._ptr = self._ptr, None
         if ptr:
             self._lib.trpc_cluster_destroy(ptr)
+        self.latency.close()

@@ -36,6 +36,10 @@
 #include "net/channel.h"
 #include "net/cluster.h"
 #include "net/controller.h"
+#include "net/span.h"
+#include "stat/latency_recorder.h"
+#include "stat/reducer.h"
+#include "stat/variable.h"
 
 using namespace trpc;
 
@@ -56,6 +60,53 @@ namespace {
 
 struct Batch;
 
+// Pipeline-wide observability (ISSUE 4): the pair of /vars series the
+// perf PRs read first — how deep is the window NOW (batch_inflight) and
+// how deep has it ever been (batch_depth) — plus the client-side latency
+// recorder every batch member reports into (the mirror of the server's
+// per-method recorder; the gap between the two is queueing + wire).
+std::atomic<int64_t> g_batch_inflight{0};
+
+struct BatchPipelineVars {
+  PassiveStatus<long> inflight{[] {
+    return static_cast<long>(
+        g_batch_inflight.load(std::memory_order_relaxed));
+  }};
+  Maxer depth;
+  LatencyRecorder latency;
+  BatchPipelineVars() {
+    inflight.expose("batch_inflight",
+                    "batch-pipeline calls currently in flight, summed "
+                    "over all live batches");
+    depth.expose("batch_depth",
+                 "high-water pipeline depth (max concurrent in-flight "
+                 "batch calls) since process start");
+    latency.expose("rpc_client_batch",
+                   "client-side latency of batch-pipeline calls");
+  }
+};
+
+BatchPipelineVars& batch_vars() {
+  // Leaked with the registry: completion fibers outlive static dtors.
+  static auto* v = new BatchPipelineVars();
+  return *v;
+}
+
+// One trpc_batch_submit's span: the parent every member's client span
+// links under, carrying the submitter's ambient trace (so a Python
+// trace() around submit+poll owns the whole batch).  Submitted into the
+// ring when the LAST member completes — the span covers the window from
+// submit to final completion.
+struct SubmitGroup {
+  Span* span = nullptr;
+  std::atomic<int64_t> remaining{0};
+  // First member failure: the batch span must not read error_code 0
+  // when its members failed (a trace filtered for errors would skip
+  // exactly the failing batches).
+  std::atomic<int32_t> first_error{0};
+  std::atomic<int64_t> failures{0};
+};
+
 struct BatchCall {
   Batch* batch = nullptr;
   uint64_t token = 0;
@@ -66,6 +117,12 @@ struct BatchCall {
   void* resp_buf = nullptr;  // caller-provided landing buffer (optional)
   size_t resp_cap = 0;
   int64_t timeout_ms = 0;
+  SubmitGroup* group = nullptr;  // non-null iff rpcz was on at submit
+  // Stamped just before CallMethod — the batch's own clock for the
+  // rpc_client_batch recorder.  (Channel stamps cntl.call().start_us,
+  // but ClusterChannel never does; relying on it dropped every cluster
+  // member from the recorder.)
+  int64_t issue_us = 0;
   std::atomic<bool> canceled{false};
   // Published by the issuer after CallMethod returns, so a cancel can
   // reach the in-flight fid (0 = not yet issued / cluster-internal).
@@ -107,6 +164,34 @@ struct Batch {
 // atomic push, one wake.
 void on_call_done(BatchCall* c) {
   Batch* b = c->batch;
+  // Client-side latency into the shared recorder (issue_us 0 means the
+  // call failed before issue — nothing to time).
+  if (c->issue_us != 0) {
+    batch_vars().latency << monotonic_time_us() - c->issue_us;
+  }
+  g_batch_inflight.fetch_sub(1, std::memory_order_relaxed);
+  SubmitGroup* g = c->group;
+  if (g != nullptr) {
+    if (c->cntl.Failed()) {
+      int32_t expect = 0;
+      const int32_t code =
+          c->cntl.error_code() != 0 ? c->cntl.error_code() : -1;
+      g->first_error.compare_exchange_strong(expect, code,
+                                             std::memory_order_relaxed);
+      g->failures.fetch_add(1, std::memory_order_relaxed);
+    }
+    if (g->remaining.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+      // Last member: the batch span's window closes here, carrying the
+      // first member failure (if any) as its error code.
+      const int64_t failed = g->failures.load(std::memory_order_relaxed);
+      if (failed > 0) {
+        span_annotate(g->span,
+                      std::to_string(failed) + " member(s) failed");
+      }
+      submit_span(g->span, g->first_error.load(std::memory_order_relaxed));
+      delete g;
+    }
+  }
   if (c->cntl.Failed()) {
     c->status = c->cntl.error_code() != 0 ? c->cntl.error_code() : -1;
     c->err = c->cntl.error_text();
@@ -152,6 +237,20 @@ void issue_call(Batch* b, BatchCall* c) {
   if (c->timeout_ms > 0) {
     c->cntl.set_timeout_ms(c->timeout_ms);
   }
+  // Trace linkage: the member's client span (created inside CallMethod
+  // when rpcz is on) must parent under the batch's submit span, and the
+  // issuing context here is a fiber (or, pool-exhausted, the caller's
+  // pthread) with its OWN ambient slot — install the batch span around
+  // the issue and restore after (the pool-exhausted inline path would
+  // otherwise leak it into the caller's thread-local context).
+  uint64_t prev_trace = 0;
+  uint64_t prev_span = 0;
+  if (c->group != nullptr) {
+    get_ambient_trace(&prev_trace, &prev_span);
+    set_ambient_trace(c->group->span->trace_id, c->group->span->span_id);
+  }
+  const bool restore_ambient = c->group != nullptr;
+  c->issue_us = monotonic_time_us();
   BatchCall* cc = c;
   Closure done = [cc] { on_call_done(cc); };
   if (b->is_cluster) {
@@ -162,6 +261,11 @@ void issue_call(Batch* b, BatchCall* c) {
     static_cast<Channel*>(b->channel)
         ->CallMethod(c->method, c->request, &c->response, &c->cntl,
                      std::move(done));
+  }
+  if (restore_ambient) {
+    // c->group may already be freed (inline completion of the last
+    // member) — restore from the saved ids, never through the group.
+    set_ambient_trace(prev_trace, prev_span);
   }
   // Single-channel async calls return with the fid live; publish it so
   // cancel can reach the in-flight call.  (Cluster members issue on
@@ -268,6 +372,7 @@ void* trpc_batch_create(void* channel, int is_cluster) {
   if (channel == nullptr) {
     return nullptr;
   }
+  batch_vars();  // register batch_inflight/batch_depth before traffic
   auto* b = new Batch();
   b->channel = channel;
   b->is_cluster = is_cluster != 0;
@@ -296,12 +401,31 @@ size_t trpc_batch_submit(void* batch, const char* method,
       b->closing.load(std::memory_order_acquire)) {
     return 0;
   }
+  // rpcz: one parent span per submit.  start_span resolves the parent
+  // from THIS thread's ambient context — ctypes callers run submit on
+  // their own pthread, where a Python trace()/trpc_trace_set installed
+  // it — so the whole batch hangs under the user's trace.
+  SubmitGroup* group = nullptr;
+  if (rpcz_enabled()) {
+    group = new SubmitGroup();
+    group->span =
+        start_span(/*server_side=*/false, std::string("batch:") + method);
+    span_annotate(group->span, "submit n=" + std::to_string(n));
+    group->remaining.store(static_cast<int64_t>(n),
+                           std::memory_order_relaxed);
+  }
+  const int64_t now_inflight =
+      g_batch_inflight.fetch_add(static_cast<int64_t>(n),
+                                 std::memory_order_relaxed) +
+      static_cast<int64_t>(n);
+  batch_vars().depth << now_inflight;
   auto job = std::make_unique<IssueJob>();
   job->b = b;
   job->calls.reserve(n);
   for (size_t i = 0; i < n; ++i) {
     auto* c = new BatchCall();
     c->batch = b;
+    c->group = group;
     c->token = b->next_token.fetch_add(1, std::memory_order_relaxed);
     c->method = method;
     if (reqs != nullptr && reqs[i] != nullptr && req_lens[i] > 0) {

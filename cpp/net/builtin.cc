@@ -232,15 +232,37 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
     return true;
   }
   if (path == "/rpcz") {
-    if (!rpcz_enabled()) {
-      *body =
-          "rpcz is off; enable with /flags/rpcz_enabled?setvalue=true\n";
-      return true;
-    }
     uint64_t want_trace = 0;
     const std::string* tq = req.query("trace_id");
     if (tq != nullptr) {
       want_trace = strtoull(tq->c_str(), nullptr, 16);
+    }
+    const std::string* fmt = req.query("format");
+    if (fmt != nullptr && *fmt == "json") {
+      // Structured spans for tools/trace_stitch.py (and anything else
+      // programmatic).  Served even while collection is off: the ring
+      // may hold spans from an earlier enabled window, and a stitcher
+      // fanning out to N nodes needs a parseable body from each.
+      // Capped well below the max ring size: recent_spans deep-copies
+      // under the same mutex submit_span takes on every RPC completion,
+      // so an unbounded dump would stall live traffic from the very
+      // tool meant to debug it.
+      size_t limit = 200;
+      const std::string* lq = req.query("limit");
+      if (lq != nullptr) {
+        const long v = atol(lq->c_str());
+        if (v > 0 && v <= (1 << 16)) {
+          limit = static_cast<size_t>(v);
+        }
+      }
+      *body = rpcz_dump_json(limit, want_trace);
+      *content_type = "application/json";
+      return true;
+    }
+    if (!rpcz_enabled()) {
+      *body =
+          "rpcz is off; enable with /flags/rpcz_enabled?setvalue=true\n";
+      return true;
     }
     char line[512];
     std::string out =
@@ -478,7 +500,8 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
     *body =
         "/health\n/version\n/status\n/vars\n/vars/<name>\n/brpc_metrics\n"
         "/connections\n/flags\n/flags/<name>[?setvalue=v]\n/threads\n"
-        "/memory\n/list\n/protobufs\n/index\n/rpcz[?trace_id=hex]\n"
+        "/memory\n/list\n/protobufs\n/index\n"
+        "/rpcz[?trace_id=hex&format=json&limit=N]\n"
         "/faults[?set=spec&server=spec&reset=1]\n"
         "/hotspots[?seconds=N]\n/contention\n/fibers\n/sockets\n/ids\n"
         "/vlog[?setlevel=N]\n/dir/<path>\n"

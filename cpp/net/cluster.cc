@@ -1,6 +1,7 @@
 #include "net/cluster.h"
 
 #include "net/concurrency_limiter.h"
+#include "net/span.h"
 
 #include <arpa/inet.h>
 #include <errno.h>
@@ -599,6 +600,12 @@ struct AsyncCall {
   Controller* cntl;
   Closure done;
   uint64_t hash_key;
+  // The caller's ambient trace context, captured at submit: the retry
+  // fiber has its own (empty) fiber-local storage, so without this the
+  // attempt's client span would root a fresh trace instead of linking
+  // under the caller's (rpcz propagation, ISSUE 4).
+  uint64_t amb_trace = 0;
+  uint64_t amb_span = 0;
 };
 }  // namespace
 
@@ -654,6 +661,9 @@ struct HedgeCtx {
   std::atomic<int> failures{0};
   std::atomic<int> launched{1};
   Event ev;  // bumped on every attempt completion
+  // Caller's ambient trace context (attempt fibers have empty fls).
+  uint64_t amb_trace = 0;
+  uint64_t amb_span = 0;
 
   bool settled() const {
     return winner.load(std::memory_order_acquire) >= 0 ||
@@ -683,6 +693,10 @@ void hedge_attempt_fiber(void* p) {
   std::unique_ptr<HedgeFiberArg> arg(static_cast<HedgeFiberArg*>(p));
   HedgeCtx* ctx = arg->ctx.get();
   const int i = arg->index;
+  // Both racing attempts carry the caller's trace: their spans show up
+  // side by side under one parent in /rpcz (hedges are exactly the kind
+  // of tail behavior a timeline exists to expose).
+  set_ambient_trace(ctx->amb_trace, ctx->amb_span);
   ctx->channels[i]->CallMethod(ctx->method, ctx->request,
                                &ctx->responses[i], &ctx->cntls[i]);
   ctx->on_attempt_done(i);
@@ -740,6 +754,7 @@ void ClusterChannel::call_hedged(std::shared_ptr<Cluster> cluster,
   ctx->method = method;
   ctx->request = request;  // zero-copy share
   ctx->attachment = attachment;
+  get_ambient_trace(&ctx->amb_trace, &ctx->amb_span);
 
   auto arm = [&](int slot, size_t node_idx) {
     ctx->channels[slot] = cluster->channels[node_idx];
@@ -829,10 +844,14 @@ void ClusterChannel::CallMethod(const std::string& method,
     auto* call = new AsyncCall{this,     method, request, response,
                                cntl,     {},     hash_key};
     call->done = std::move(done);
+    get_ambient_trace(&call->amb_trace, &call->amb_span);
     if (fiber_start(
             nullptr,
             [](void* arg) {
               std::unique_ptr<AsyncCall> c(static_cast<AsyncCall*>(arg));
+              // Fresh fiber, empty fls: re-install the caller's trace
+              // context (cleared with the fiber's fls at exit).
+              set_ambient_trace(c->amb_trace, c->amb_span);
               c->ch->CallMethod(c->method, c->request, c->response, c->cntl,
                                 nullptr, c->hash_key);
               c->done();

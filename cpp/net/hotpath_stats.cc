@@ -31,19 +31,41 @@ struct BulkWakeVars {
 }  // namespace
 
 HotPathVars::HotPathVars() {
-  write_coalesce_drains.expose("socket_write_coalesce_drains");
-  write_coalesce_nodes.expose("socket_write_coalesce_nodes");
-  write_coalesce_max.expose("socket_write_coalesce_max");
-  write_coalesce_batch.expose("socket_write_coalesce_batch");
-  inline_write_attempts.expose("socket_inline_write_attempts");
-  inline_write_hits.expose("socket_inline_write_hits");
-  dispatch_batches.expose("messenger_dispatch_batches");
-  dispatch_msgs.expose("messenger_dispatch_messages");
-  dispatch_inline.expose("messenger_dispatch_inline");
-  dispatch_max.expose("messenger_dispatch_max");
-  dispatch_batch.expose("messenger_dispatch_batch");
-  probe_rounds.expose("messenger_probe_rounds");
-  probe_stall_skips.expose("messenger_probe_stall_skips");
+  write_coalesce_drains.expose(
+      "socket_write_coalesce_drains",
+      "write-queue drain sweeps (one coalesced writev each)");
+  write_coalesce_nodes.expose(
+      "socket_write_coalesce_nodes",
+      "queued Writes absorbed into coalesced drains");
+  write_coalesce_max.expose(
+      "socket_write_coalesce_max",
+      "high-water queued Writes absorbed by one drain");
+  write_coalesce_batch.expose(
+      "socket_write_coalesce_batch",
+      "coalesced-drain batch size (1-in-16 sampled)");
+  inline_write_attempts.expose(
+      "socket_inline_write_attempts",
+      "Socket::Write calls that tried the wait-free inline flush");
+  inline_write_hits.expose(
+      "socket_inline_write_hits",
+      "inline flushes that drained the whole queue on the caller");
+  dispatch_batches.expose(
+      "messenger_dispatch_batches",
+      "readable sweeps that cut at least one message");
+  dispatch_msgs.expose("messenger_dispatch_messages",
+                       "messages cut from readable sweeps");
+  dispatch_inline.expose(
+      "messenger_dispatch_inline",
+      "messages run inline on the dispatch fiber (first-of-batch)");
+  dispatch_max.expose("messenger_dispatch_max",
+                      "high-water messages cut in one readable sweep");
+  dispatch_batch.expose("messenger_dispatch_batch",
+                        "dispatch batch size (1-in-16 sampled)");
+  probe_rounds.expose("messenger_probe_rounds",
+                      "full multi-protocol probe sweeps");
+  probe_stall_skips.expose(
+      "messenger_probe_stall_skips",
+      "probe sweeps elided by the per-socket prefix-length memo");
 }
 
 HotPathVars& hotpath_vars() {

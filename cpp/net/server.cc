@@ -137,7 +137,8 @@ int Server::RegisterMethod(const std::string& full_name, Handler handler) {
   MethodProperty prop;
   prop.handler = std::move(handler);
   prop.latency = std::make_shared<LatencyRecorder>();
-  prop.latency->expose("rpc_server_" + full_name);
+  prop.latency->expose("rpc_server_" + full_name,
+                       "server-side latency of " + full_name);
   methods_[full_name] = std::move(prop);
   return 0;
 }

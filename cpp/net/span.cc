@@ -1,9 +1,13 @@
 #include "net/span.h"
 
+#include <unistd.h>
+
+#include <cstdio>
 #include <cstdlib>
 #include <mutex>
 
 #include "base/flags.h"
+#include "base/json.h"
 #include "base/rand.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
@@ -112,6 +116,12 @@ fls_key_t ambient_span_key() {
   return key;
 }
 
+// Off-fiber fallback: ctypes callers (Python threads) have no fiber
+// context, but must still be able to install a trace around their sync
+// calls — trpc_trace_set / trpc_span_start land here.
+thread_local uint64_t tls_ambient_trace = 0;
+thread_local uint64_t tls_ambient_span = 0;
+
 }  // namespace
 
 bool rpcz_enabled() { return rpcz_flag()->bool_value(); }
@@ -174,15 +184,28 @@ void submit_span(Span* s, int32_t error_code) {
 }
 
 void set_ambient_span(const Span* s) {
-  fls_set(ambient_trace_key(),
-          reinterpret_cast<void*>(s != nullptr ? s->trace_id : 0));
-  fls_set(ambient_span_key(),
-          reinterpret_cast<void*>(s != nullptr ? s->span_id : 0));
+  set_ambient_trace(s != nullptr ? s->trace_id : 0,
+                    s != nullptr ? s->span_id : 0);
+}
+
+void set_ambient_trace(uint64_t trace_id, uint64_t span_id) {
+  if (in_fiber()) {
+    fls_set(ambient_trace_key(), reinterpret_cast<void*>(trace_id));
+    fls_set(ambient_span_key(), reinterpret_cast<void*>(span_id));
+  } else {
+    tls_ambient_trace = trace_id;
+    tls_ambient_span = span_id;
+  }
 }
 
 void get_ambient_trace(uint64_t* trace_id, uint64_t* span_id) {
-  *trace_id = reinterpret_cast<uint64_t>(fls_get(ambient_trace_key()));
-  *span_id = reinterpret_cast<uint64_t>(fls_get(ambient_span_key()));
+  if (in_fiber()) {
+    *trace_id = reinterpret_cast<uint64_t>(fls_get(ambient_trace_key()));
+    *span_id = reinterpret_cast<uint64_t>(fls_get(ambient_span_key()));
+  } else {
+    *trace_id = tls_ambient_trace;
+    *span_id = tls_ambient_span;
+  }
 }
 
 std::vector<Span> recent_spans(size_t limit, uint64_t trace_id) {
@@ -205,6 +228,55 @@ size_t rpcz_ring_capacity() {
   rpcz_ring_size_flag();  // ensure registration
   std::lock_guard<std::mutex> g(ring_mu());
   return ring().slots.size();
+}
+
+namespace {
+std::string hex_id(uint64_t id) {
+  char buf[20];
+  snprintf(buf, sizeof(buf), "%016llx",
+           static_cast<unsigned long long>(id));
+  return buf;
+}
+}  // namespace
+
+std::string rpcz_dump_json(size_t limit, uint64_t trace_id) {
+  Json root = Json::object();
+  root.set("pid", Json::number(getpid()));
+  // The mono/wall pair is read as close together as possible so the
+  // stitcher's monotonic→wall mapping error is bounded by this gap.
+  root.set("now_mono_us", Json::number(
+      static_cast<double>(monotonic_time_us())));
+  root.set("now_wall_us", Json::number(
+      static_cast<double>(realtime_us())));
+  Json spans = Json::array();
+  for (const Span& s : recent_spans(limit, trace_id)) {
+    Json j = Json::object();
+    j.set("trace_id", Json::str(hex_id(s.trace_id)));
+    j.set("span_id", Json::str(hex_id(s.span_id)));
+    j.set("parent_span_id", Json::str(hex_id(s.parent_span_id)));
+    j.set("side", Json::str(s.server_side ? "server" : "client"));
+    j.set("method", Json::str(s.method));
+    j.set("start_us", Json::number(static_cast<double>(s.start_us)));
+    j.set("end_us", Json::number(static_cast<double>(s.end_us)));
+    j.set("latency_us",
+          Json::number(static_cast<double>(s.end_us - s.start_us)));
+    j.set("error_code", Json::number(s.error_code));
+    j.set("request_bytes",
+          Json::number(static_cast<double>(s.request_bytes)));
+    j.set("response_bytes",
+          Json::number(static_cast<double>(s.response_bytes)));
+    Json anns = Json::array();
+    for (const auto& [ts, text] : s.annotations) {
+      Json a = Json::object();
+      a.set("ts_us", Json::number(static_cast<double>(ts)));
+      a.set("text", Json::str(text));
+      anns.push_back(std::move(a));
+    }
+    j.set("annotations", std::move(anns));
+    spans.push_back(std::move(j));
+  }
+  root.set("spans", std::move(spans));
+  return root.dump();
 }
 
 }  // namespace trpc

@@ -50,14 +50,26 @@ void span_annotate(Span* s, const std::string& text);
 // Finishes the span and moves it into the ring (frees it).
 void submit_span(Span* s, int32_t error_code);
 
-// Ambient trace context (fiber-local): the server span a request handler
-// runs under; client spans started on this fiber become its children.
+// Ambient trace context: the server span a request handler runs under;
+// client spans started in this context become its children.  Storage is
+// fiber-local on fibers and falls back to plain thread-local off them,
+// so a ctypes caller (Python, a non-fiber pthread) can install a trace
+// around `trpc_channel_call` and have the client span inherit it.
 void set_ambient_span(const Span* s);  // nullptr clears
+void set_ambient_trace(uint64_t trace_id, uint64_t span_id);  // 0,0 clears
 void get_ambient_trace(uint64_t* trace_id, uint64_t* span_id);
 
 // /rpcz support: most-recent spans, newest first (bounded by ring size);
 // trace_id filter when nonzero.
 std::vector<Span> recent_spans(size_t limit, uint64_t trace_id = 0);
+
+// Structured span dump shared by /rpcz?format=json and trpc_rpcz_dump:
+// {"pid":n,"now_mono_us":n,"now_wall_us":n,"spans":[...]} with 64-bit ids
+// as 16-hex-digit strings (doubles would truncate them) and annotations
+// as [{"ts_us":n,"text":s}].  The mono/wall clock pair lets a cross-node
+// stitcher (tools/trace_stitch.py) map each node's monotonic span times
+// onto one wall-clock timeline.
+std::string rpcz_dump_json(size_t limit, uint64_t trace_id = 0);
 
 // Live span-ring capacity (the `trpc_rpcz_ring_size` flag's value;
 // touching this also registers the flag).  Resizing preserves the

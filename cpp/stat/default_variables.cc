@@ -60,11 +60,12 @@ struct DefaultVars {
   PassiveStatus<double> cpu{[] { return cpu_percent(); }};
 
   DefaultVars() {
-    rss.expose("process_memory_rss_kb");
-    vsz.expose("process_memory_vsz_kb");
-    threads.expose("process_threads");
-    fds.expose("process_fd_count");
-    cpu.expose("process_cpu_percent");
+    rss.expose("process_memory_rss_kb", "resident set size (VmRSS)");
+    vsz.expose("process_memory_vsz_kb", "virtual size (VmSize)");
+    threads.expose("process_threads", "OS thread count");
+    fds.expose("process_fd_count", "open file descriptors");
+    cpu.expose("process_cpu_percent",
+               "CPU use since the previous dump, percent of one core");
   }
 };
 

@@ -97,27 +97,38 @@ int64_t LatencyRecorder::qps() const {
 }
 
 int64_t LatencyRecorder::latency_avg_us() const {
-  std::lock_guard<std::mutex> g(window_mu_);
-  int64_t total = 0, cnt = 0;
-  for (const Second& s : window_) {
-    total += s.sum;
-    cnt += s.count;
-  }
-  return cnt > 0 ? total / cnt : 0;
-}
-
-int64_t LatencyRecorder::latency_percentile_us(double p) const {
-  std::lock_guard<std::mutex> g(window_mu_);
-  // Exact per-octave counts across the window locate the owning octave;
-  // rank walk = reference percentile.h:335 get_number.
-  int64_t per_octave[kNumOctaves] = {0};
-  int64_t total = 0;
-  for (const Second& s : window_) {
-    for (int i = 0; i < kNumOctaves; ++i) {
-      per_octave[i] += s.oct[i].added;
-      total += s.oct[i].added;
+  {
+    std::lock_guard<std::mutex> g(window_mu_);
+    int64_t total = 0, cnt = 0;
+    for (const Second& s : window_) {
+      total += s.sum;
+      cnt += s.count;
+    }
+    if (cnt > 0) {
+      return total / cnt;
     }
   }
+  // Window empty (recorder younger than one sampler tick): the live
+  // interval's running sum keeps fresh in-process reads meaningful.
+  const int64_t cnt = interval_count_.load(std::memory_order_relaxed);
+  return cnt > 0 ? interval_sum_.load(std::memory_order_relaxed) / cnt
+                 : 0;
+}
+
+int64_t LatencyRecorder::percentile_over(
+    const std::vector<const Second*>& secs, double p,
+    int64_t* total_out) const {
+  // Exact per-octave counts locate the owning octave; rank walk =
+  // reference percentile.h:335 get_number.
+  int64_t per_octave[kNumOctaves] = {0};
+  int64_t total = 0;
+  for (const Second* s : secs) {
+    for (int i = 0; i < kNumOctaves; ++i) {
+      per_octave[i] += s->oct[i].added;
+      total += s->oct[i].added;
+    }
+  }
+  *total_out = total;
   if (total == 0) {
     return 0;
   }
@@ -140,9 +151,9 @@ int64_t LatencyRecorder::latency_percentile_us(double p) const {
       // a mild bias WITHIN the octave, so the result still lies inside
       // the correct [2^i, 2^(i+1)) band (the bounded-error contract).
       std::vector<int64_t> merged;
-      for (const Second& s : window_) {
-        merged.insert(merged.end(), s.oct[i].samples.begin(),
-                      s.oct[i].samples.end());
+      for (const Second* s : secs) {
+        merged.insert(merged.end(), s->oct[i].samples.begin(),
+                      s->oct[i].samples.end());
       }
       if (merged.empty()) {
         return int64_t{1} << i;  // count but no samples: octave floor
@@ -163,13 +174,104 @@ int64_t LatencyRecorder::latency_percentile_us(double p) const {
   return max_us_.load(std::memory_order_relaxed);
 }
 
+int64_t LatencyRecorder::latency_percentile_us(double p) const {
+  {
+    std::lock_guard<std::mutex> g(window_mu_);
+    std::vector<const Second*> secs;
+    secs.reserve(window_.size());
+    for (const Second& s : window_) {
+      secs.push_back(&s);
+    }
+    int64_t total = 0;
+    const int64_t r = percentile_over(secs, p, &total);
+    if (total > 0) {
+      return r;
+    }
+  }
+  // Window empty — the sampler thread hasn't rotated a full second into
+  // it yet.  An in-process reader (trpc_latency_read right after a burst
+  // of calls) should see the live interval, not zeros, so snapshot the
+  // active octaves and walk those instead.  percentile_over sorts its
+  // own merged copy, so the unsorted active samples are fine.
+  Second live;
+  {
+    std::lock_guard<std::mutex> g(res_mu_);
+    for (int i = 0; i < kNumOctaves; ++i) {
+      live.oct[i].added = active_[i].added;
+      live.oct[i].samples = active_[i].samples;
+    }
+  }
+  std::vector<const Second*> secs{&live};
+  int64_t total = 0;
+  return percentile_over(secs, p, &total);
+}
+
 int64_t LatencyRecorder::latency_max_us() const {
   return max_us_.load(std::memory_order_relaxed);
 }
 
+void LatencyRecorder::read_stats(double out[8]) const {
+  static const double kQuantiles[4] = {0.5, 0.9, 0.99, 0.999};
+  out[0] = static_cast<double>(count());
+  out[7] = static_cast<double>(latency_max_us());
+  {
+    std::lock_guard<std::mutex> g(window_mu_);
+    std::vector<const Second*> secs;
+    secs.reserve(window_.size());
+    int64_t sum = 0, cnt = 0;
+    for (const Second& s : window_) {
+      secs.push_back(&s);
+      sum += s.sum;
+      cnt += s.count;
+    }
+    out[1] = window_.empty()
+                 ? 0.0
+                 : static_cast<double>(cnt) /
+                       static_cast<double>(window_.size());
+    if (cnt > 0) {
+      out[2] = static_cast<double>(sum / cnt);
+      int64_t total = 0;
+      for (int i = 0; i < 4; ++i) {
+        out[3 + i] =
+            static_cast<double>(percentile_over(secs, kQuantiles[i],
+                                                &total));
+      }
+      if (total > 0) {
+        return;
+      }
+    }
+  }
+  // Window empty: live-interval fallback, one snapshot for all four
+  // quantiles (mirrors latency_percentile_us's fresh-recorder path).
+  const int64_t icnt = interval_count_.load(std::memory_order_relaxed);
+  out[2] = icnt > 0 ? static_cast<double>(
+                          interval_sum_.load(std::memory_order_relaxed) /
+                          icnt)
+                    : 0.0;
+  Second live;
+  {
+    std::lock_guard<std::mutex> g(res_mu_);
+    for (int i = 0; i < kNumOctaves; ++i) {
+      live.oct[i].added = active_[i].added;
+      live.oct[i].samples = active_[i].samples;
+    }
+  }
+  std::vector<const Second*> secs{&live};
+  int64_t total = 0;
+  for (int i = 0; i < 4; ++i) {
+    out[3 + i] = static_cast<double>(
+        percentile_over(secs, kQuantiles[i], &total));
+  }
+}
+
 std::string LatencyRecorder::prometheus_str(const std::string& name) const {
   const std::string metric = sanitize_metric_name(name);
-  std::string out = "# TYPE " + metric + "_latency_us summary\n";
+  std::string out;
+  if (!description().empty()) {
+    out += "# HELP " + metric + "_latency_us " +
+           escape_help(description()) + "\n";
+  }
+  out += "# TYPE " + metric + "_latency_us summary\n";
   static const std::pair<const char*, double> kQuantiles[] = {
       {"0.5", 0.5}, {"0.9", 0.9}, {"0.99", 0.99}, {"0.999", 0.999}};
   for (const auto& [label, q] : kQuantiles) {
@@ -178,8 +280,11 @@ std::string LatencyRecorder::prometheus_str(const std::string& name) const {
   }
   out += "# TYPE " + metric + "_qps gauge\n" + metric + "_qps " +
          std::to_string(qps()) + "\n";
-  out += "# TYPE " + metric + "_count counter\n" + metric + "_count " +
-         std::to_string(count()) + "\n";
+  // The cumulative call count is monotonic: counter-typed with the
+  // conventional `_total` suffix (the bare `_count` form collided with
+  // the Prometheus summary's reserved `<name>_count` series anyway).
+  out += "# TYPE " + metric + "_count_total counter\n" + metric +
+         "_count_total " + std::to_string(count()) + "\n";
   out += "# TYPE " + metric + "_latency_max_us gauge\n" + metric +
          "_latency_max_us " + std::to_string(latency_max_us()) + "\n";
   return out;

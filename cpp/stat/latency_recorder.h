@@ -44,6 +44,12 @@ class LatencyRecorder : public Variable, public Sampled {
   int64_t latency_avg_us() const;   // trailing window
   int64_t latency_percentile_us(double p) const;  // 0 < p < 1
   int64_t latency_max_us() const;
+  // One-pass bulk read for the C API (trpc_latency_read): fills
+  // out[8] = {count, qps, avg_us, p50, p90, p99, p999, max_us} taking
+  // the window lock ONCE for all four quantiles — callers hold the
+  // global var-registry mutex around this, so per-quantile re-locking
+  // and re-snapshotting would multiply that critical section by five.
+  void read_stats(double out[8]) const;
   int64_t count() const { return total_count_.load(std::memory_order_relaxed); }
 
   std::string value_str() const override;
@@ -66,6 +72,12 @@ class LatencyRecorder : public Variable, public Sampled {
     int64_t count = 0;
     int64_t sum = 0;
   };
+
+  // Rank-walk percentile over a set of per-second snapshots (samples need
+  // not be pre-sorted).  *total_out = combined exact add count; the
+  // return value is meaningless when it is 0.
+  int64_t percentile_over(const std::vector<const Second*>& secs, double p,
+                          int64_t* total_out) const;
 
   // Active interval (written by hot path, swapped by sampler each second).
   mutable std::mutex res_mu_;

@@ -55,7 +55,9 @@ std::string MAdder::value_str() const {
 }
 
 std::string MAdder::prometheus_str(const std::string& name) const {
-  const std::string metric = sanitize_metric_name(name);
+  // Labeled adders are monotonic: `_total`-suffixed like scalar counters.
+  const std::string metric =
+      ensure_total_suffix(sanitize_metric_name(name));
   std::lock_guard<std::mutex> g(mu_);
   std::string out = "# TYPE " + metric + " counter\n";
   for (const auto& [labels, v] : series_) {

@@ -13,11 +13,14 @@
 #include <limits>
 #include <memory>
 #include <mutex>
+#include <type_traits>
 #include <vector>
 
 #include "stat/variable.h"
 
 namespace trpc {
+
+struct OpAdd;
 
 template <typename Op>
 class Reducer : public Variable {
@@ -63,6 +66,13 @@ class Reducer : public Variable {
 
   std::string value_str() const override {
     return std::to_string(get_value());
+  }
+
+  // Adders are the monotonic event counters of this runtime; Prometheus
+  // wants them typed `counter` (with the `_total` suffix the base class
+  // appends) so rate()/increase() work.  Maxer/Miner stay gauges.
+  const char* prometheus_type() const override {
+    return std::is_same_v<Op, OpAdd> ? "counter" : "gauge";
   }
 
  private:

@@ -19,14 +19,31 @@ std::map<std::string, Variable*>& vars() {
 }
 }  // namespace
 
+std::string Variable::escape_help(const std::string& v) {
+  std::string out;
+  out.reserve(v.size());
+  for (char c : v) {
+    if (c == '\\') {
+      out += "\\\\";
+    } else if (c == '\n') {
+      out += "\\n";
+    } else {
+      out.push_back(c);
+    }
+  }
+  return out;
+}
+
 Variable::~Variable() { hide(); }
 
-int Variable::expose(const std::string& name) {
+int Variable::expose(const std::string& name,
+                     const std::string& description) {
   std::lock_guard<std::mutex> g(vars_mu());
   if (!name_.empty()) {
     vars().erase(name_);
   }
   name_ = name;
+  description_ = description;
   vars()[name] = this;
   return 0;
 }
@@ -58,16 +75,37 @@ std::string Variable::sanitize_metric_name(const std::string& name) {
   return out;
 }
 
+std::string Variable::ensure_total_suffix(std::string metric) {
+  static const std::string kSuffix = "_total";
+  if (metric.size() < kSuffix.size() ||
+      metric.compare(metric.size() - kSuffix.size(), kSuffix.size(),
+                     kSuffix) != 0) {
+    metric += kSuffix;
+  }
+  return metric;
+}
+
 std::string Variable::prometheus_str(const std::string& name) const {
   const std::string v = value_str();
-  // Emit only plainly numeric values as gauges.
+  // Emit only plainly numeric values.
   char* end = nullptr;
   strtod(v.c_str(), &end);
   if (end == v.c_str() || *end != '\0') {
     return "";
   }
-  const std::string metric = sanitize_metric_name(name);
-  return "# TYPE " + metric + " gauge\n" + metric + " " + v + "\n";
+  const char* type = prometheus_type();
+  std::string metric = sanitize_metric_name(name);
+  if (type == std::string("counter")) {
+    // Monotonic series carry the conventional `_total` suffix so
+    // Prometheus tooling (rate(), increase()) treats them correctly.
+    metric = ensure_total_suffix(metric);
+  }
+  std::string out;
+  if (!description_.empty()) {
+    out += "# HELP " + metric + " " + escape_help(description_) + "\n";
+  }
+  out += "# TYPE " + metric + " " + type + "\n" + metric + " " + v + "\n";
+  return out;
 }
 
 std::string Variable::dump_prometheus() {
@@ -87,6 +125,29 @@ std::vector<std::pair<std::string, std::string>> Variable::dump_exposed() {
     out.emplace_back(name, var->value_str());
   }
   return out;
+}
+
+bool Variable::read_exposed(const std::string& name, std::string* out) {
+  std::lock_guard<std::mutex> g(vars_mu());
+  auto it = vars().find(name);
+  if (it == vars().end()) {
+    return false;
+  }
+  if (out != nullptr) {
+    *out = it->second->value_str();
+  }
+  return true;
+}
+
+bool Variable::with_exposed(const std::string& name,
+                            const std::function<void(Variable*)>& fn) {
+  std::lock_guard<std::mutex> g(vars_mu());
+  auto it = vars().find(name);
+  if (it == vars().end()) {
+    return false;
+  }
+  fn(it->second);
+  return true;
 }
 
 }  // namespace trpc

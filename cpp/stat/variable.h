@@ -1,8 +1,10 @@
 // Variable — named metric registry (parity: bvar::Variable,
 // /root/reference/src/bvar/variable.h:118 expose/dump_exposed, the substrate
-// of the /vars builtin service).
+// of the /vars builtin service and the trpc_vars_* C API).
 #pragma once
 
+#include <atomic>
+#include <cstdint>
 #include <functional>
 #include <string>
 #include <vector>
@@ -14,23 +16,47 @@ class Variable {
   virtual ~Variable();
   virtual std::string value_str() const = 0;
   // Prometheus exposition lines for this variable (may be several series,
-  // e.g. latency quantiles).  Default: one gauge when value_str is numeric.
+  // e.g. latency quantiles).  Default: one gauge (or counter, per
+  // prometheus_type) when value_str is numeric, with a # HELP line when a
+  // description was given at expose time.
   virtual std::string prometheus_str(const std::string& name) const;
+  // Exposition type for the DEFAULT single-series renderer: "gauge" or
+  // "counter".  Counters get the Prometheus `_total` suffix appended to
+  // the metric name unless it is already there.
+  virtual const char* prometheus_type() const { return "gauge"; }
 
   // Registers under `name` (replaces any previous owner of the name).
-  int expose(const std::string& name);
+  // The description feeds the # HELP exposition line ("" = no HELP).
+  int expose(const std::string& name, const std::string& description = "");
   void hide();
   const std::string& name() const { return name_; }
+  const std::string& description() const { return description_; }
 
   static std::vector<std::pair<std::string, std::string>> dump_exposed();
+  // Single-variable read under the registry lock; false when unknown.
+  static bool read_exposed(const std::string& name, std::string* out);
+  // Runs fn(var) under the registry lock (the var cannot be hidden or
+  // destroyed while fn runs); false when the name is unknown.  fn must
+  // not touch the registry (expose/hide) — that would self-deadlock.
+  static bool with_exposed(const std::string& name,
+                           const std::function<void(Variable*)>& fn);
   // Rewrites a name into the Prometheus metric charset.
   static std::string sanitize_metric_name(const std::string& name);
+  // Appends `_total` to an (already sanitized) counter metric name when
+  // missing — the Prometheus convention for monotonic series.
+  static std::string ensure_total_suffix(std::string metric);
+  // Escapes a description for a # HELP payload (newlines/backslashes —
+  // a raw newline would start a bogus sample line).  Every renderer
+  // emitting HELP must route descriptions through this; they can be
+  // arbitrary user input via trpc_latency_create/trpc_gauge_create.
+  static std::string escape_help(const std::string& description);
   // Full Prometheus text-format dump (parity: builtin/
   // prometheus_metrics_service.*, served at /brpc_metrics).
   static std::string dump_prometheus();
 
  private:
   std::string name_;
+  std::string description_;
 };
 
 // Pull-based variable: value computed by a callback at dump time (parity:
@@ -47,6 +73,31 @@ class PassiveStatus : public Variable {
 
  private:
   std::function<T()> fn_;
+};
+
+// Push-based scalar gauge: a level someone SETS (pipeline depth, window
+// size, inflight count) rather than a monotonic event count.  The C API
+// hands these to Python (trpc_gauge_*) so client-side metrics live in the
+// same registry as the native ones (parity: bvar::Status<int64_t>).
+class IntGauge : public Variable {
+ public:
+  IntGauge() = default;
+  explicit IntGauge(int64_t initial) : value_(initial) {}
+  ~IntGauge() override { hide(); }
+
+  void set(int64_t v) { value_.store(v, std::memory_order_relaxed); }
+  int64_t add(int64_t delta) {
+    return value_.fetch_add(delta, std::memory_order_relaxed) + delta;
+  }
+  int64_t get_value() const {
+    return value_.load(std::memory_order_relaxed);
+  }
+  std::string value_str() const override {
+    return std::to_string(get_value());
+  }
+
+ private:
+  std::atomic<int64_t> value_{0};
 };
 
 }  // namespace trpc

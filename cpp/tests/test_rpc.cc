@@ -15,6 +15,7 @@
 #include "base/compress.h"
 #include "base/device_arena.h"
 #include "base/flags.h"
+#include "base/json.h"
 #include "net/span.h"
 #include "net/socket_map.h"
 #include "base/time.h"
@@ -1769,6 +1770,120 @@ TEST_CASE(batch_destroy_with_inflight_settles) {
   EXPECT(!cntl.Failed());
   EXPECT(resp.to_string() == "after-destroy");
   delete ch;
+}
+
+TEST_CASE(offthread_ambient_trace_links_client_spans) {
+  // ISSUE 4: a plain pthread (the ctypes caller's shape) installs a
+  // trace context and its client spans parent under it — the off-fiber
+  // thread-local fallback in span.cc.
+  start_server_once();
+  EXPECT_EQ(Flag::set("rpcz_enabled", "true"), 0);
+  const uint64_t trace = new_span_id();
+  const uint64_t parent = new_span_id();
+  std::thread caller([&] {
+    EXPECT(!in_fiber());
+    set_ambient_trace(trace, parent);
+    uint64_t t = 0, s = 0;
+    get_ambient_trace(&t, &s);
+    EXPECT_EQ(t, trace);
+    EXPECT_EQ(s, parent);
+    Channel ch;
+    EXPECT_EQ(ch.Init(addr()), 0);
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("traced");
+    ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+    set_ambient_trace(0, 0);
+  });
+  caller.join();
+  bool client_linked = false;
+  bool server_linked = false;
+  for (const Span& s : recent_spans(1000, trace)) {
+    EXPECT_EQ(s.trace_id, trace);
+    if (!s.server_side && s.parent_span_id == parent) {
+      client_linked = true;
+    }
+    if (s.server_side) {
+      server_linked = true;  // carried over the wire via RpcMeta
+    }
+  }
+  EXPECT(client_linked);
+  EXPECT(server_linked);
+  // The structured dump parses and carries the filtered trace.
+  const std::string json = rpcz_dump_json(100, trace);
+  Json parsed;
+  EXPECT(Json::parse(json, &parsed));
+  EXPECT(parsed.find("spans") != nullptr);
+  EXPECT(parsed.find("spans")->size() >= 2);
+  EXPECT(parsed.find("now_wall_us") != nullptr);
+  EXPECT_EQ(Flag::set("rpcz_enabled", "false"), 0);
+}
+
+TEST_CASE(batch_submit_opens_parent_span_and_depth_vars) {
+  // ISSUE 4 satellite: a batch submit under an ambient trace opens ONE
+  // parent span carrying that trace, every member's client span links
+  // under it, and batch_inflight/batch_depth land in /vars.
+  start_server_once();
+  EXPECT_EQ(Flag::set("rpcz_enabled", "true"), 0);
+  Channel ch;
+  EXPECT_EQ(ch.Init(addr()), 0);
+  void* b = trpc_batch_create(&ch, 0);
+  EXPECT(b != nullptr);
+  const uint64_t trace = new_span_id();
+  const uint64_t root = new_span_id();
+  set_ambient_trace(trace, root);
+  const size_t kCalls = 6;
+  std::vector<std::string> payloads;
+  std::vector<const void*> reqs;
+  std::vector<size_t> lens;
+  for (size_t i = 0; i < kCalls; ++i) {
+    payloads.push_back("span-batch-" + std::to_string(i));
+    reqs.push_back(payloads.back().data());
+    lens.push_back(payloads.back().size());
+  }
+  std::vector<uint64_t> tokens(kCalls);
+  EXPECT_EQ(trpc_batch_submit(b, "Echo.Echo", reqs.data(), lens.data(),
+                              nullptr, nullptr, kCalls, 10000, nullptr,
+                              nullptr, tokens.data()),
+            kCalls);
+  set_ambient_trace(0, 0);
+  auto done = drain_batch(b, kCalls, 15000);
+  EXPECT_EQ(done.size(), kCalls);
+  for (const auto& c : done) {
+    EXPECT_EQ(c.status, 0);
+    if (c.resp_iobuf != nullptr) {
+      trpc_iobuf_destroy(c.resp_iobuf);
+    }
+  }
+  // One batch parent under (trace, root); kCalls member client spans
+  // under the batch span.
+  uint64_t batch_span_id = 0;
+  size_t members = 0;
+  for (const Span& s : recent_spans(1000, trace)) {
+    if (s.method == "batch:Echo.Echo") {
+      EXPECT_EQ(s.parent_span_id, root);
+      EXPECT(!s.annotations.empty());  // "submit n=6"
+      batch_span_id = s.span_id;
+    }
+  }
+  EXPECT(batch_span_id != 0);
+  for (const Span& s : recent_spans(1000, trace)) {
+    if (!s.server_side && s.method == "Echo.Echo" &&
+        s.parent_span_id == batch_span_id) {
+      ++members;
+    }
+  }
+  EXPECT_EQ(members, kCalls);
+  // The depth/inflight pair is registered and the high-water moved.
+  std::string depth;
+  EXPECT(Variable::read_exposed("batch_depth", &depth));
+  EXPECT(atoll(depth.c_str()) >= static_cast<long long>(kCalls));
+  std::string inflight;
+  EXPECT(Variable::read_exposed("batch_inflight", &inflight));
+  EXPECT_EQ(atoll(inflight.c_str()), 0);  // everything settled
+  trpc_batch_destroy(b);
+  EXPECT_EQ(Flag::set("rpcz_enabled", "false"), 0);
 }
 
 TEST_CASE(rpcz_ring_size_reloadable) {

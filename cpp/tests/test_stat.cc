@@ -1,6 +1,8 @@
 // L3 stat library unit tests (parity model: test/bvar_* in the reference).
 #include <unistd.h>
 
+#include <map>
+#include <sstream>
 #include <thread>
 #include <vector>
 
@@ -190,6 +192,102 @@ TEST_CASE(mvariable_labeled_series) {
     }
   }
   EXPECT(found);
+}
+
+TEST_CASE(prometheus_exposition_validates) {
+  // ISSUE 4 satellite: the /brpc_metrics body must be WELL-FORMED
+  // Prometheus text format — every sample preceded by a TYPE, counters
+  // `_total`-suffixed, HELP lines from var descriptions, numeric values.
+  // Register one of each shape, then run a small format parser over the
+  // WHOLE dump (so any registered var violating the rules fails too).
+  Adder reqs;
+  reqs.expose("promtest_requests", "requests served by the test");
+  reqs << 5;
+  Maxer peak;
+  peak.expose("promtest_peak");
+  peak << 9;
+  IntGauge depth;
+  depth.expose("promtest_depth", "current window depth");
+  depth.set(4);
+  LatencyRecorder lat;
+  lat.expose("promtest_latency", "latency of the test op");
+  lat << 100;
+  lat.take_sample();
+  MAdder errs("promtest_errors", {"code"});
+  errs.add({"14"}, 2);
+
+  const std::string prom = Variable::dump_prometheus();
+  std::map<std::string, std::string> types;
+  std::vector<std::string> helps;
+  std::map<std::string, std::string> samples;  // metric{labels} -> value
+  std::istringstream in(prom);
+  std::string line;
+  auto ends_with_total = [](const std::string& s) {
+    return s.size() >= 6 && s.compare(s.size() - 6, 6, "_total") == 0;
+  };
+  while (std::getline(in, line)) {
+    if (line.empty()) {
+      continue;
+    }
+    if (line.rfind("# TYPE ", 0) == 0) {
+      std::istringstream ls(line.substr(7));
+      std::string name, type;
+      ls >> name >> type;
+      EXPECT(!name.empty());
+      EXPECT(type == "counter" || type == "gauge" || type == "summary");
+      EXPECT(types.find(name) == types.end());  // no duplicate TYPE
+      if (type == "counter") {
+        EXPECT(ends_with_total(name));  // monotonic => _total suffix
+      }
+      types[name] = type;
+      continue;
+    }
+    if (line.rfind("# HELP ", 0) == 0) {
+      std::istringstream ls(line.substr(7));
+      std::string name;
+      ls >> name;
+      helps.push_back(name);
+      continue;
+    }
+    EXPECT(line[0] != '#');  // only HELP/TYPE comments are emitted
+    // Sample line: metric[{labels}] value
+    const size_t sp = line.rfind(' ');
+    EXPECT(sp != std::string::npos && sp + 1 < line.size());
+    const std::string value = line.substr(sp + 1);
+    char* end = nullptr;
+    strtod(value.c_str(), &end);
+    EXPECT(end != value.c_str() && *end == '\0');  // numeric value
+    std::string metric = line.substr(0, sp);
+    const size_t brace = metric.find('{');
+    const std::string base =
+        brace == std::string::npos ? metric : metric.substr(0, brace);
+    // Every sample's base metric was TYPEd first.
+    EXPECT(types.find(base) != types.end());
+    samples[metric] = value;
+  }
+  // The registered shapes landed with the right types and names.
+  EXPECT(types["promtest_requests_total"] == "counter");
+  EXPECT(types["promtest_peak"] == "gauge");
+  EXPECT(types["promtest_depth"] == "gauge");
+  EXPECT(types["promtest_latency_latency_us"] == "summary");
+  EXPECT(types["promtest_latency_count_total"] == "counter");
+  EXPECT(types["promtest_errors_total"] == "counter");
+  EXPECT(samples["promtest_requests_total"] == "5");
+  EXPECT(samples["promtest_depth"] == "4");
+  EXPECT(samples["promtest_errors_total{code=\"14\"}"] == "2");
+  EXPECT(samples.count("promtest_latency_latency_us{quantile=\"0.99\"}")
+         == 1u);
+  // Descriptions surfaced as HELP on the (suffixed) metric name.
+  bool help_reqs = false;
+  bool help_depth = false;
+  for (const std::string& h : helps) {
+    help_reqs = help_reqs || h == "promtest_requests_total";
+    help_depth = help_depth || h == "promtest_depth";
+  }
+  EXPECT(help_reqs);
+  EXPECT(help_depth);
+  EXPECT(prom.find("# HELP promtest_requests_total requests served by "
+                   "the test") != std::string::npos);
 }
 
 TEST_CASE(collector_budget_and_drain) {

@@ -1,0 +1,50 @@
+"""Subprocess helper for tests/test_observe.py: one RPC node of a
+multi-hop chain (client → A → B), a REAL separate process so each node
+has its own span ring, clocks and /rpcz — what the cross-node stitcher
+exists to join.
+
+Serves `Hop.Hop`: leaf nodes echo; nodes started with --next forward the
+request to the next hop first (the nested call runs on the handler fiber,
+so its client span inherits the server span's ambient trace — the
+propagation link under test).  rpcz collection is enabled at startup.
+
+Prints one JSON line {"port": N} when serving, then exits when stdin
+closes (the parent's handle on our lifetime).
+"""
+
+import argparse
+import json
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--next", dest="next_addr", default=None,
+                    help="host:port of the next hop (absent = leaf)")
+    args = ap.parse_args()
+
+    from brpc_tpu.rpc import Channel, Server, observe
+
+    observe.enable_rpcz(True)
+    nxt = Channel(args.next_addr, timeout_ms=10000) if args.next_addr \
+        else None
+    srv = Server()
+
+    def hop(call, req: bytes) -> None:
+        if nxt is not None:
+            call.respond(nxt.call("Hop.Hop", req))
+        else:
+            call.respond(req)
+
+    srv.register("Hop.Hop", hop)
+    srv.start(0)
+    print(json.dumps({"port": srv.port}), flush=True)
+    sys.stdin.read()  # parent closes stdin to stop us
+    srv.stop()
+    if nxt is not None:
+        nxt.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,434 @@
+"""The in-process observability plane (ISSUE 4).
+
+Tier-1 coverage for the four layers: the observe C API surface (vars /
+latency / rpcz / trace context read from Python with no HTTP), the
+batch pipeline's spans and depth vars, cross-node trace propagation over
+a REAL 2-hop chain (client → A → B, each hop its own process), and the
+trace stitcher's Chrome-trace output.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import select
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from brpc_tpu.rpc import Channel, ClusterChannel, Server, observe
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+sys.path.insert(0, TOOLS)
+
+import trace_stitch  # noqa: E402  (tools/ is not a package)
+
+
+@pytest.fixture
+def rpcz():
+    observe.enable_rpcz(True)
+    yield
+    observe.enable_rpcz(False)
+
+
+def _echo_server() -> Server:
+    srv = Server()
+    srv.register("Echo.Echo", lambda call, req: call.respond(req))
+    srv.start(0)
+    return srv
+
+
+# ------------------------------------------------------- in-process reads --
+
+
+def test_latency_read_server_and_client_no_http():
+    """The acceptance read: a server method's p99 AND a client channel's
+    p99, straight from the registry — no HTTP, no scraping."""
+    srv = _echo_server()
+    try:
+        ch = Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+        for _ in range(64):
+            assert ch.call("Echo.Echo", b"p" * 256) == b"p" * 256
+        server = observe.Latency.read("rpc_server_Echo.Echo")
+        client = observe.Latency.read(ch.latency.name)
+        assert server.count >= 64 and client.count == 64
+        assert server.p99_us > 0 and client.p99_us > 0
+        assert client.p50_us <= client.p99_us <= client.max_us
+        # The client clock starts before the server's and stops after.
+        assert client.max_us >= server.p50_us
+        # Same numbers through the generic var read (JSON summary shape).
+        v = observe.Vars.read(ch.latency.name)
+        assert v["count"] == 64 and v["p99_us"] > 0
+        ch.close()
+    finally:
+        srv.stop()
+    with pytest.raises(KeyError):
+        observe.Latency.read("no_such_recorder_anywhere")
+    with pytest.raises(TypeError):
+        observe.Latency.read("process_memory_rss_kb")
+
+
+def test_vars_dump_and_prometheus_text():
+    srv = _echo_server()
+    try:
+        ch = Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+        ch.call("Echo.Echo", b"x")
+        v = observe.Vars.dump()
+        # Native series and the Python-registered channel recorder live
+        # in ONE registry.
+        assert "socket_inline_write_attempts" in v
+        assert "rpc_server_Echo.Echo" in v
+        assert ch.latency.name in v
+        prom = observe.Vars.prometheus()
+        # Counters carry the _total suffix, HELP lines surface
+        # descriptions (the exposition-fix satellite).
+        assert "# TYPE socket_inline_write_attempts_total counter" in prom
+        assert "# HELP socket_inline_write_attempts_total" in prom
+        # The HTTP endpoint serves the same renderer (values may tick
+        # between the two reads; the series set is what matters).
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/brpc_metrics",
+                timeout=5) as r:
+            http_prom = r.read().decode()
+        assert "# TYPE socket_inline_write_attempts_total counter" \
+            in http_prom
+        assert "rpc_server_Echo_Echo_latency_us{quantile=\"0.99\"}" \
+            in http_prom
+        ch.close()
+    finally:
+        srv.stop()
+
+
+def test_gauge_registers_and_updates():
+    g = observe.Gauge("test_observe_gauge", "test gauge")
+    try:
+        g.set(7)
+        assert observe.Vars.read("test_observe_gauge") == 7
+        assert g.add(3) == 10
+        assert observe.Vars.read("test_observe_gauge") == 10
+    finally:
+        g.close()
+    with pytest.raises(KeyError):
+        observe.Vars.read("test_observe_gauge")
+
+
+# ------------------------------------------------------------ trace spans --
+
+
+def test_trace_context_roundtrip():
+    tid = observe.new_trace_id()
+    assert tid != 0
+    observe.set_trace(tid, 42)
+    assert observe.get_trace() == (tid, 42)
+    observe.clear_trace()
+    assert observe.get_trace() == (0, 0)
+
+
+def test_trace_block_owns_client_spans(rpcz):
+    srv = _echo_server()
+    try:
+        ch = Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+        with observe.trace("unit-trace") as t:
+            t.annotate("first")
+            ch.call("Echo.Echo", b"z")
+            t.annotate("second")
+        assert t.trace_id != 0
+        sp = observe.spans(limit=500, trace_id=t.trace_id)
+        # Root + client + server (loopback: both sides share the ring).
+        methods = {s.method for s in sp}
+        assert "unit-trace" in methods and "Echo.Echo" in methods
+        root = [s for s in sp if s.method == "unit-trace"][0]
+        assert [a[1] for a in root.annotations] == ["first", "second"]
+        kids = [s for s in sp if s.parent_span_id == root.span_id]
+        assert kids, "client span did not parent under the trace root"
+        # Ambient context restored after the block.
+        assert observe.get_trace() == (0, 0)
+        ch.close()
+    finally:
+        srv.stop()
+
+
+def test_batch_spans_and_depth_vars(rpcz):
+    """PR-3 batch pipeline satellite: a submit opens a parent span under
+    the ambient trace, members are its children, and the
+    batch_inflight/batch_depth pair lands in /vars."""
+    srv = Server()
+    srv.register_native_echo("Echo.Echo")
+    srv.start(0)
+    try:
+        ch = Channel(f"127.0.0.1:{srv.port}", timeout_ms=10000)
+        with observe.trace("batch-trace") as t:
+            results = ch.call_batch("Echo.Echo", [b"a" * 64] * 5)
+        assert all(r == b"a" * 64 for r in results)
+        sp = observe.spans(limit=500, trace_id=t.trace_id)
+        by_method = {}
+        for s in sp:
+            by_method.setdefault(s.method, []).append(s)
+        assert "batch:Echo.Echo" in by_method, sorted(by_method)
+        batch_span = by_method["batch:Echo.Echo"][0]
+        root = by_method["batch-trace"][0]
+        assert batch_span.parent_span_id == root.span_id
+        assert any("submit n=5" in a[1] for a in batch_span.annotations)
+        members = [s for s in by_method.get("Echo.Echo", [])
+                   if s.side == "client"
+                   and s.parent_span_id == batch_span.span_id]
+        assert len(members) == 5, \
+            f"expected 5 member spans under the batch, got {len(members)}"
+        v = observe.Vars.dump()
+        assert v.get("batch_depth", 0) >= 5
+        assert "batch_inflight" in v
+        assert observe.Latency.read("rpc_client_batch").count >= 5
+        ch.close()
+    finally:
+        srv.stop()
+
+
+def test_batch_span_carries_member_failure(rpcz):
+    """A batch whose members fail must not report error_code 0 on its
+    parent span — error-filtered trace views would skip exactly the
+    failing batches."""
+    srv = Server()
+    srv.register_native_echo("Echo.Echo")
+    srv.start(0)
+    try:
+        ch = Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+        from brpc_tpu.rpc import RpcError
+
+        with observe.trace("failing-batch") as t:
+            results = ch.call_batch("No.Such", [b"x"] * 2)
+        assert all(isinstance(r, RpcError) for r in results)
+        sp = [s for s in observe.spans(limit=200, trace_id=t.trace_id)
+              if s.method == "batch:No.Such"]
+        assert sp and sp[0].error_code != 0
+        assert any("2 member(s) failed" in a[1]
+                   for a in sp[0].annotations)
+        ch.close()
+    finally:
+        srv.stop()
+
+
+def test_cluster_batch_carries_trace_and_records_latency(rpcz):
+    """Cluster calls run their attempts on freshly spawned fibers (empty
+    fiber-local storage): the ambient trace must be captured at submit
+    and re-installed there, and rpc_client_batch must time cluster
+    members too (they never get Channel's start_us stamp)."""
+    srv = Server()
+    srv.register_native_echo("Echo.Echo")
+    srv.start(0)
+    try:
+        cc = ClusterChannel(f"list://127.0.0.1:{srv.port}",
+                            timeout_ms=10000)
+        try:
+            before = observe.Latency.read("rpc_client_batch").count
+        except KeyError:
+            before = 0
+        with observe.trace("cluster-batch") as t:
+            results = cc.call_batch("Echo.Echo", [b"c" * 32] * 4)
+        assert all(r == b"c" * 32 for r in results)
+        sp = observe.spans(limit=500, trace_id=t.trace_id)
+        batch = [s for s in sp if s.method == "batch:Echo.Echo"]
+        assert batch, "batch parent span missing for cluster submit"
+        members = [s for s in sp if s.side == "client"
+                   and s.method == "Echo.Echo"
+                   and s.parent_span_id == batch[0].span_id]
+        assert len(members) == 4, (
+            f"cluster members lost the ambient trace: {len(members)}/4 "
+            f"linked under the batch span")
+        assert observe.Latency.read("rpc_client_batch").count >= before + 4
+        cc.close()
+    finally:
+        srv.stop()
+
+
+def test_two_channels_same_address_keep_separate_recorders():
+    """expose() replaces a name's owner, so a second channel to the same
+    address must take a suffixed name instead of shadowing the first."""
+    srv = _echo_server()
+    try:
+        addr = f"127.0.0.1:{srv.port}"
+        ch1 = Channel(addr, timeout_ms=5000)
+        ch2 = Channel(addr, timeout_ms=5000)
+        assert ch1.latency.name != ch2.latency.name
+        for _ in range(3):
+            ch1.call("Echo.Echo", b"1")
+        for _ in range(5):
+            ch2.call("Echo.Echo", b"2")
+        assert observe.Latency.read(ch1.latency.name).count == 3
+        assert observe.Latency.read(ch2.latency.name).count == 5
+        ch2.close()
+        # Closing the second must not erase the first's series.
+        assert observe.Latency.read(ch1.latency.name).count == 3
+        ch1.close()
+    finally:
+        srv.stop()
+
+
+def test_help_lines_escape_multiline_descriptions():
+    lat = observe.Latency("test_help_escape", "line1\nline2 \\ tail")
+    try:
+        prom = observe.Vars.prometheus()
+        helps = [ln for ln in prom.splitlines()
+                 if ln.startswith("# HELP test_help_escape")]
+        assert helps, "HELP line missing"
+        assert "\\n" in helps[0] and "line2" in helps[0]
+        # No raw-newline leakage: every non-comment line is a sample.
+        for ln in prom.splitlines():
+            if ln and not ln.startswith("#"):
+                assert " " in ln, f"bogus exposition line: {ln!r}"
+    finally:
+        lat.close()
+
+
+# -------------------------------------------------- 2-hop chain + stitch --
+
+
+def _spawn_node(next_addr: str | None):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "_trace_hop_node.py")]
+    if next_addr:
+        cmd += ["--next", next_addr]
+    proc = subprocess.Popen(cmd, env=env, stdin=subprocess.PIPE,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
+    deadline = time.time() + 120  # first jax import can be slow
+    buf = b""
+    while b"\n" not in buf:
+        left = deadline - time.time()
+        if left <= 0 or proc.poll() is not None:
+            err = proc.communicate()[1].decode(errors="replace") \
+                if proc.poll() is not None else "(still running)"
+            proc.kill()
+            raise AssertionError(
+                f"hop node produced no port line; stderr:\n{err}")
+        ready, _, _ = select.select([proc.stdout], [], [], min(left, 1.0))
+        if not ready:
+            continue
+        chunk = os.read(proc.stdout.fileno(), 4096)
+        if not chunk:
+            raise AssertionError(
+                "hop node exited early: "
+                + proc.communicate()[1].decode(errors="replace"))
+        buf += chunk
+    port = json.loads(buf.split(b"\n")[0])["port"]
+    return proc, port
+
+
+def _stop_node(proc) -> None:
+    try:
+        proc.stdin.close()
+        proc.wait(timeout=10)
+    except Exception:  # noqa: BLE001
+        proc.kill()
+
+
+def test_two_hop_trace_propagation_and_stitch(rpcz, tmp_path):
+    """The tentpole end-to-end: client → A → B across three PROCESSES,
+    one trace_id in all three span sets, /rpcz?trace_id= filtering on
+    both nodes, and a stitched Chrome trace with >= 3 parent-linked
+    spans that json.loads cleanly."""
+    node_b = node_a = None
+    try:
+        node_b, port_b = _spawn_node(None)
+        node_a, port_a = _spawn_node(f"127.0.0.1:{port_b}")
+        ch = Channel(f"127.0.0.1:{port_a}", timeout_ms=30000)
+        with observe.trace("2hop") as t:
+            assert ch.call("Hop.Hop", b"ping") == b"ping"
+        hexid = f"{t.trace_id:016x}"
+
+        # One trace_id across all three span sets.  A server submits its
+        # span AFTER writing the response, so the remote rings can trail
+        # the client's return by a moment — poll briefly.
+        def fetch_until(port: int, want: int) -> dict:
+            deadline = time.time() + 5
+            while True:
+                d = trace_stitch.fetch_rpcz(f"127.0.0.1:{port}", hexid)
+                if len(d["spans"]) >= want or time.time() > deadline:
+                    return d
+                time.sleep(0.02)
+
+        local = observe.rpcz_dump(trace_id=hexid)
+        dump_a = fetch_until(port_a, 2)
+        dump_b = fetch_until(port_b, 1)
+        assert {s["trace_id"] for s in local["spans"]} == {hexid}
+        assert {s["trace_id"] for s in dump_a["spans"]} == {hexid}
+        assert {s["trace_id"] for s in dump_b["spans"]} == {hexid}
+        # A carries a server span AND its forwarding client span; B the
+        # leaf server span.
+        assert {s["side"] for s in dump_a["spans"]} == {"server",
+                                                        "client"}
+        assert [s["side"] for s in dump_b["spans"]] == ["server"]
+
+        # The trace_id filter actually filters (bogus id -> nothing;
+        # node A saw other traffic markers too — its own hop to B).
+        empty = trace_stitch.fetch_rpcz(f"127.0.0.1:{port_a}",
+                                        "deadbeefdeadbeef")
+        assert empty["spans"] == []
+
+        # Stitch -> Chrome trace-event JSON, through a real file.
+        trace = trace_stitch.stitch(
+            {"client": local, f"A:{port_a}": dump_a,
+             f"B:{port_b}": dump_b}, hexid)
+        out = tmp_path / "trace.json"
+        out.write_text(json.dumps(trace))
+        loaded = json.load(open(out))
+        events = loaded["traceEvents"]
+        xs = [e for e in events if e.get("ph") == "X"]
+        # client span + A server + A client + B server + trace root
+        assert len(xs) >= 5
+        linked = [e for e in xs if e["args"].get("parent_linked")]
+        assert len(linked) >= 3, (
+            f"expected >=3 parent-linked spans, got {len(linked)}")
+        assert loaded["stitch"]["parent_linked"] >= 3
+        # Every node contributed a track.
+        assert len({e["pid"] for e in xs}) == 3
+        # Clock alignment: each child's midpoint sits inside its
+        # parent's [start, end] window after stitching.
+        by_id = {e["args"]["span_id"]: e for e in xs}
+        contained = 0
+        for e in xs:
+            p = by_id.get(e["args"]["parent_span_id"])
+            if p is None:
+                continue
+            mid = e["ts"] + e["dur"] / 2
+            assert p["ts"] - 1 <= mid <= p["ts"] + p["dur"] + 1, (
+                f"child {e['name']} not inside parent {p['name']}")
+            contained += 1
+        assert contained >= 3
+        ch.close()
+    finally:
+        if node_a is not None:
+            _stop_node(node_a)
+        if node_b is not None:
+            _stop_node(node_b)
+
+
+def test_rpcz_json_endpoint_shape(rpcz):
+    """/rpcz?format=json serves the stitcher's contract: clock pair +
+    structured spans with hex ids and annotations."""
+    srv = _echo_server()
+    try:
+        ch = Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+        ch.call("Echo.Echo", b"q")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/rpcz?format=json",
+                timeout=5) as r:
+            dump = json.loads(r.read().decode())
+        assert dump["pid"] > 0
+        assert dump["now_wall_us"] > dump["now_mono_us"] > 0
+        assert dump["spans"], "no spans despite rpcz on + traffic"
+        s = dump["spans"][0]
+        assert len(s["trace_id"]) == 16 and len(s["span_id"]) == 16
+        assert s["side"] in ("client", "server")
+        assert s["end_us"] >= s["start_us"]
+        ch.close()
+    finally:
+        srv.stop()

@@ -47,6 +47,28 @@ def test_1kb_single_conn_qps_floor():
     )
 
 
+def test_observability_idle_free_with_rpcz_off():
+    """ISSUE 4 satellite: the observability plane must be FREE when idle.
+    rpcz_enabled defaults to false; with it pinned off, the PR-2 1KB QPS
+    floor still holds — span collection, the var registry and the new
+    capi surface add nothing to the hot path unless switched on."""
+    from brpc_tpu.rpc import get_flag, set_flag
+
+    # Read BEFORE writing: nothing in the slow suite toggles rpcz, so
+    # this observes the compiled-in default (a set-then-get would pass
+    # even if someone flipped the default to true).
+    assert get_flag("rpcz_enabled") == "false", \
+        "rpcz must default off (hot path pays for spans only on opt-in)"
+    set_flag("rpcz_enabled", "false")  # pin for the measured run
+    row = _run_bench(64, 1024, "single")
+    assert row["failures"] == 0, f"echo calls failed: {row}"
+    assert row["qps"] >= QPS_FLOOR, (
+        f"1KB QPS {row['qps']:.0f} under floor {QPS_FLOOR} with rpcz "
+        f"off — the observability plane is taxing the idle hot path: "
+        f"{row}"
+    )
+
+
 def test_1kb_never_wedges_across_connection_types():
     # The historical failure mode was a permanently wedged write queue;
     # pooled exercises socket reuse, single exercises the MPSC drain.
