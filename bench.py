@@ -173,7 +173,10 @@ def _child_sweep(sizes: list[int]) -> None:
         # per-call overhead, not the runtime.  Drive these rows through
         # the batched RPC pipeline at depth >= 8 so goodput measures the
         # data plane again; the device-chain number stays alongside.
-        if size in (SIZES[0], SIZES[-1]):
+        # 16MB rides along (ISSUE 5): the mid-large band is where the
+        # monolithic-frame path collapsed, so it gets an RPC-path number
+        # (and a perf-smoke floor) of its own.
+        if size == SIZES[0] or size >= (16 << 20):
             # Small payloads need a deep window to amortize per-call
             # runtime cost (native 1KB echo is ~90k calls/s; 8-deep
             # leaves the pipe mostly empty); big payloads need few.
@@ -185,6 +188,9 @@ def _child_sweep(sizes: list[int]) -> None:
                 row["pipeline_depth"] = rpc["pipeline_depth"]
                 row["bytes_moved_per_iter"] = rpc["bytes_moved_per_iter"]
                 row["goodput_method"] = "rpc_call_batch"
+                for k in ("stripe_rails", "stripe_chunk_bytes"):
+                    if k in rpc:
+                        row[k] = rpc[k]
                 if rpc.get("vars"):
                     row["vars"] = rpc["vars"]
         if hbm_peak is not None and step is fused:
@@ -432,7 +438,7 @@ def _rpc_batch_goodput(size: int, depth: int = 8,
             dt = time.perf_counter() - t0
             if completed == 0 or not verified:
                 return None
-            return {
+            row = {
                 "goodput_gbps": round(size * completed / dt / 1e9, 3),
                 "pipeline_depth": depth,
                 "bytes_moved_per_iter": size * depth,
@@ -442,6 +448,21 @@ def _rpc_batch_goodput(size: int, depth: int = 8,
                 # the process that ran it.
                 "vars": _observe_snapshot(),
             }
+            # Large-message striping attribution (ISSUE 5): which rail /
+            # chunk geometry this row ran under, so goodput deltas across
+            # rounds are attributable to config, not code alone.  Only
+            # stamped when the payload actually striped.
+            try:
+                from brpc_tpu.rpc import get_flag
+
+                thr = int(get_flag("trpc_stripe_threshold"))
+                if thr > 0 and size > thr:  # 0 = striping disabled
+                    row["stripe_rails"] = int(get_flag("trpc_stripe_rails"))
+                    row["stripe_chunk_bytes"] = int(
+                        get_flag("trpc_stripe_chunk_bytes"))
+            except Exception:  # noqa: BLE001 — bench must still print
+                pass
+            return row
         finally:
             if pipe is not None:
                 pipe.close()
@@ -600,6 +621,10 @@ def _cpp_rows() -> list:
         # geometry the zerocopy pipeline row runs, all-native — the gap
         # between the two IS the Python-boundary cost per round.
         (8, 4 << 20, "pooled"),
+        # Mid-large band (ISSUE 5): the striped multi-rail path at native
+        # sync-call geometry — the row the monolithic-frame collapse
+        # (407 MB/s in r05) used to hide in.
+        (8, 16 << 20, "pooled"),
     ):
         try:
             out = subprocess.run(

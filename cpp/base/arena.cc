@@ -1,9 +1,11 @@
 #include "base/arena.h"
 
 #include <cstdlib>
+#include <mutex>
 #include <new>
 #include <vector>
 
+#include "base/flags.h"
 #include "base/tls_cache.h"
 
 namespace trpc {
@@ -19,6 +21,60 @@ std::vector<Block*>* tls_cache() {
 }
 
 constexpr size_t kMaxCachedBlocks = 64;
+
+// ---- big-block pool ------------------------------------------------------
+// Size classes are powers of two from kBigBlockMin up to 1GB; allocate
+// rounds up so a released block serves any later request of its class.
+// One mutex is fine here: big blocks move at MB granularity (thousands of
+// ops/s at line rate), never per small message.
+
+constexpr int kBigClasses = 13;  // 256KB << 0 .. 256KB << 12 (1GB)
+
+int big_class_of(uint32_t cap) {
+  int cls = 0;
+  uint64_t sz = HostArena::kBigBlockMin;
+  while (sz < cap && cls < kBigClasses - 1) {
+    sz <<= 1;
+    ++cls;
+  }
+  return sz >= cap ? cls : -1;
+}
+
+uint32_t big_class_bytes(int cls) {
+  return HostArena::kBigBlockMin << cls;
+}
+
+std::mutex& big_mu() {
+  static std::mutex* mu = new std::mutex();
+  return *mu;
+}
+// Deliberately leaked (like the mutex): detached poller/timer threads may
+// release big blocks after static destruction, and a destructed vector
+// under a still-valid mutex would be a shutdown use-after-free.
+std::vector<Block*>* const g_big_pool = new std::vector<Block*>[kBigClasses];
+size_t g_big_pool_bytes = 0;
+
+Flag* big_pool_cap_flag() {
+  static Flag* f = [] {
+    Flag* flag = Flag::define_int64(
+        "trpc_big_block_pool_bytes", 1ll << 30,
+        "byte cap on pooled large IOBuf blocks (bulk reads + stripe "
+        "landing buffers); blocks over the cap free to the heap");
+    if (flag != nullptr) {
+      flag->set_validator([](const std::string& v) {
+        char* end = nullptr;
+        const long long n = strtoll(v.c_str(), &end, 10);
+        return end != v.c_str() && *end == '\0' && n >= 0;
+      });
+    }
+    return flag;
+  }();
+  return f;
+}
+
+// Eager definition: the flag must be settable (and visible in /flags)
+// before the first big-block release would lazily create it.
+[[maybe_unused]] Flag* const g_big_pool_flag_eager = big_pool_cap_flag();
 
 }  // namespace
 
@@ -48,9 +104,22 @@ Block* HostArena::allocate(uint32_t min_cap) {
     b->size = 0;
     return b;
   }
-  const uint32_t cap = min_cap <= kDefaultBlockSize
-                           ? kDefaultBlockSize
-                           : min_cap;
+  uint32_t cap = min_cap <= kDefaultBlockSize ? kDefaultBlockSize : min_cap;
+  if (min_cap >= kBigBlockMin) {
+    const int cls = big_class_of(min_cap);
+    if (cls >= 0) {
+      cap = big_class_bytes(cls);  // pow2 class so releases are reusable
+      std::lock_guard<std::mutex> g(big_mu());
+      if (!g_big_pool[cls].empty()) {
+        Block* b = g_big_pool[cls].back();
+        g_big_pool[cls].pop_back();
+        g_big_pool_bytes -= b->cap;
+        b->ref.store(1, std::memory_order_relaxed);
+        b->size = 0;
+        return b;
+      }
+    }
+  }
   void* mem = malloc(sizeof(Block) + cap);
   if (mem == nullptr) {
     throw std::bad_alloc();
@@ -69,7 +138,38 @@ void HostArena::deallocate(Block* b) {
     cache->push_back(b);
     return;
   }
+  if (b->cap >= kBigBlockMin) {
+    const int cls = big_class_of(b->cap);
+    if (cls >= 0 && big_class_bytes(cls) == b->cap) {
+      const size_t cap_bytes = static_cast<size_t>(
+          big_pool_cap_flag() != nullptr
+              ? big_pool_cap_flag()->int64_value()
+              : 0);
+      std::lock_guard<std::mutex> g(big_mu());
+      if (g_big_pool_bytes + b->cap <= cap_bytes) {
+        g_big_pool[cls].push_back(b);
+        g_big_pool_bytes += b->cap;
+        return;
+      }
+    }
+  }
   free(b);
+}
+
+size_t HostArena::big_pool_bytes() {
+  std::lock_guard<std::mutex> g(big_mu());
+  return g_big_pool_bytes;
+}
+
+void HostArena::flush_big_pool() {
+  std::lock_guard<std::mutex> g(big_mu());
+  for (int cls = 0; cls < kBigClasses; ++cls) {
+    for (Block* b : g_big_pool[cls]) {
+      free(b);
+    }
+    g_big_pool[cls].clear();
+  }
+  g_big_pool_bytes = 0;
 }
 
 void HostArena::flush_tls_cache() {

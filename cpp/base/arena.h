@@ -45,10 +45,18 @@ class BlockArena {
 
 // Default heap arena: header+payload in one allocation, thread-local free
 // cache of default-size blocks (parity: iobuf TLS block caching used at
-// input_messenger.cpp:239).
+// input_messenger.cpp:239), plus a global size-classed pool of LARGE
+// blocks.  Large blocks exist for the bulk data path (multi-MB reads and
+// stripe landing buffers — net/stripe.h): a fresh multi-MB malloc per
+// message means fresh mmap'd pages, and first-touch page faults are what
+// caps large-transfer goodput on paravirtualized kernels.  Pooled blocks
+// keep their pages warm; the pool is byte-capped (reloadable flag
+// trpc_big_block_pool_bytes) and classes are powers of two.
 class HostArena : public BlockArena {
  public:
   static constexpr uint32_t kDefaultBlockSize = 8192;
+  // Blocks at/above this capacity go through the big-block pool.
+  static constexpr uint32_t kBigBlockMin = 256 * 1024;
   static HostArena* instance();
 
   Block* allocate(uint32_t min_cap) override;
@@ -56,6 +64,10 @@ class HostArena : public BlockArena {
 
   // Drop this thread's cached blocks (called on thread exit / tests).
   static void flush_tls_cache();
+  // Bytes currently parked in the big-block pool (tests/introspection).
+  static size_t big_pool_bytes();
+  // Free every pooled big block (tests reclaiming memory between cases).
+  static void flush_big_pool();
 };
 
 // Wraps caller-owned memory in a Block without copying.

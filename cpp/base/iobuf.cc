@@ -95,11 +95,18 @@ void IOBuf::append(const void* data, size_t n) {
     }
     // Ask for ONE byte so every arena serves at its own granularity (a
     // device arena hands out full fixed-size blocks; large appends span
-    // as many as needed).  Genuine exhaustion (slab growth failure) is a
-    // hard programming/resource error at this copying entry point — the
-    // zero-copy path (append_block/trpc_arena_alloc) reports it
-    // recoverably instead.
-    Block* nb = arena->allocate(1);
+    // as many as needed) — EXCEPT bulk appends on the host arena, which
+    // get large pooled blocks: a multi-MB body in 8KB slivers costs one
+    // iovec per sliver at the writev below it, and per-iovec overhead is
+    // what caps bulk goodput on paravirtualized kernels.  Genuine
+    // exhaustion (slab growth failure) is a hard programming/resource
+    // error at this copying entry point — the zero-copy path
+    // (append_block/trpc_arena_alloc) reports it recoverably instead.
+    const uint32_t want =
+        (arena == HostArena::instance() && n >= HostArena::kBigBlockMin)
+            ? static_cast<uint32_t>(std::min<size_t>(n, 8u << 20))
+            : 1;
+    Block* nb = arena->allocate(want);
     CHECK(nb != nullptr) << "arena exhausted appending " << n << " bytes";
     const size_t take = std::min<size_t>(n, nb->cap);
     memcpy(nb->data, p, take);
@@ -266,8 +273,12 @@ int IOBuf::fill_iovec(iovec* iov, int max_iov, size_t max_bytes) const {
   return n;
 }
 
-ssize_t IOBuf::append_from_fd(int fd, size_t max_bytes) {
+ssize_t IOBuf::append_from_fd(int fd, size_t max_bytes, size_t block_hint) {
   BlockArena* arena = arena_ ? arena_ : HostArena::instance();
+  const uint32_t fresh_cap = block_hint > HostArena::kDefaultBlockSize
+                                 ? static_cast<uint32_t>(std::min<size_t>(
+                                       block_hint, 64ull << 20))
+                                 : HostArena::kDefaultBlockSize;
   // Read into up to kMaxIov fresh blocks with readv.
   iovec iov[kMaxIov];
   Block* blocks[kMaxIov];
@@ -283,7 +294,7 @@ ssize_t IOBuf::append_from_fd(int fd, size_t max_bytes) {
       ++n;
       continue;
     }
-    Block* nb = arena->allocate(HostArena::kDefaultBlockSize);
+    Block* nb = arena->allocate(fresh_cap);
     iov[n].iov_base = nb->data;
     iov[n].iov_len = std::min<size_t>(nb->cap, max_bytes - planned);
     blocks[n] = nb;

@@ -92,7 +92,10 @@ class IOBuf {
                  size_t max_bytes = SIZE_MAX) const;
   // Append by taking ownership semantics from readv-style writes:
   // append up to n bytes read from fd; returns bytes read or -1.
-  ssize_t append_from_fd(int fd, size_t max_bytes);
+  // block_hint > 0 sizes the fresh blocks (bulk path: a few multi-MB
+  // blocks instead of thousands of 8KB ones — fewer iovecs per syscall,
+  // contiguous landing for the stripe layer); 0 = default block size.
+  ssize_t append_from_fd(int fd, size_t max_bytes, size_t block_hint = 0);
   // Write to fd with writev, popping written bytes; returns written or -1.
   ssize_t cut_into_fd(int fd, size_t max_bytes = SIZE_MAX);
 

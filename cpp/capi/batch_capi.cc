@@ -202,7 +202,16 @@ void on_call_done(BatchCall* c) {
       c->status = EMSGSIZE;
       c->err = "response larger than caller buffer";
     } else {
-      c->response.copy_to(c->resp_buf, n);
+      // Striped responses may ALREADY be in the caller's buffer (the
+      // stripe layer landed chunks there in place); copying a buffer
+      // onto itself would be both wasted bandwidth and UB.
+      const bool in_place =
+          c->response.block_count() == 1 &&
+          c->response.ref_at(0).block->data + c->response.ref_at(0).offset ==
+              c->resp_buf;
+      if (!in_place) {
+        c->response.copy_to(c->resp_buf, n);
+      }
       c->resp_copied = true;
       c->response.clear();  // recycle pool blocks now, not at poll
     }
@@ -251,6 +260,14 @@ void issue_call(Batch* b, BatchCall* c) {
   }
   const bool restore_ambient = c->group != nullptr;
   c->issue_us = monotonic_time_us();
+  if (!b->is_cluster && c->resp_buf != nullptr) {
+    // Stripe-aware landing (net/stripe.h): a striped response's chunks
+    // memcpy straight into the caller's buffer instead of bouncing
+    // through an arena block — the completion below detects the in-place
+    // view and skips its copy.
+    c->cntl.call().land_buf = c->resp_buf;
+    c->cntl.call().land_cap = c->resp_cap;
+  }
   BatchCall* cc = c;
   Closure done = [cc] { on_call_done(cc); };
   if (b->is_cluster) {

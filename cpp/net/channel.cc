@@ -18,6 +18,7 @@
 #include "net/socket_map.h"
 #include "net/span.h"
 #include "net/stream.h"
+#include "net/stripe.h"
 #include "net/tls.h"
 
 namespace trpc {
@@ -92,6 +93,16 @@ void complete_locked_call(fid_t cid, Controller* cntl) {
     }
     submit_span(span, cntl->error_code());
   }
+  // Landing registration must die BEFORE the fid can recycle: a late
+  // stripe chunk for this cid must never memcpy into a buffer the caller
+  // has already reclaimed (stripe_unregister_landing drains in-flight
+  // landers).  Cheap no-op for the unregistered (non-batch) hot path.
+  if (cntl->call().land_registered) {
+    stripe_unregister_landing(cid);
+    cntl->call().land_registered = false;
+  }
+  cntl->call().land_buf = nullptr;
+  cntl->call().land_cap = 0;
   const uint64_t timer = cntl->call().timeout_timer;
   const bool inline_safe = cntl->done_inline_safe();
   Closure done = std::move(cntl->call().done);
@@ -610,14 +621,60 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
     body.append(cntl->request_attachment());
   }
   if (cntl->checksum_enabled()) {
-    meta.has_checksum = true;
-    meta.checksum = crc32c(body);
+    meta.has_checksum = true;  // striped sends CRC per chunk (stripe.cc)
   }
-  IOBuf frame;
-  tstd_pack(&frame, meta, body);
+  // Striped response landing (batch plane): register the caller's buffer
+  // under the cid BEFORE the request can reach the server, so even a
+  // chunk that beats the head frame lands in place.  Only worth it when
+  // the buffer could hold a striped (above-threshold) response.
+  if (cntl->call().land_buf != nullptr &&
+      stripe_eligible(cntl->call().land_cap)) {
+    stripe_register_landing(cid, cntl->call().land_buf,
+                            cntl->call().land_cap);
+    cntl->call().land_registered = true;
+  }
 
-  SocketRef s(Socket::Address(sid));
-  const bool write_ok = s && s->Write(std::move(frame)) == 0;
+  bool write_ok;
+  if (stripe_should(sid, meta.stream_id, body.size())) {
+    // Multi-rail large-message path (net/stripe.h): cut the body into
+    // chunk frames issued concurrently.  Pooled channels spread chunks
+    // over extra pooled connections to the same endpoint (each rail has
+    // its own kernel pipe + read fiber on the far side); single/shm
+    // channels stripe over the one connection, which still pipelines the
+    // receiver's landing memcpys against the wire.
+    std::vector<SocketId> rails{sid};
+    std::vector<SocketId> extra;
+    if (ct == ConnectionType::kPooled) {
+      const int want = stripe_rails();
+      for (int i = 1; i < want; ++i) {
+        SocketId rid = 0;
+        bool fresh = false;
+        if (SocketMap::instance()->take_pooled(ep_, opts_.auth, &rid,
+                                               &fresh) != 0) {
+          break;
+        }
+        if (fresh && send_credential(rid, opts_.auth) != 0) {
+          SocketRef dead(Socket::Address(rid));
+          if (dead) {
+            dead->SetFailed(EACCES);
+          }
+          break;
+        }
+        extra.push_back(rid);
+        rails.push_back(rid);
+      }
+    }
+    write_ok = stripe_send(sid, rails, std::move(meta), std::move(body),
+                           stripe_make_id()) == 0;
+    // Rails go straight back to the pool: their chunk frames are queued
+    // FIFO on each socket, so a later borrower's frames follow ours.
+    for (SocketId rid : extra) {
+      SocketMap::instance()->give_back(ep_, opts_.auth, rid);
+    }
+  } else {
+    write_ok =
+        stripe_frame_send(sid, std::move(meta), std::move(body)) == 0;
+  }
   fid_unlock(cid);
   if (!write_ok) {
     fid_error(cid, ECONNRESET);

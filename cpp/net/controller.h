@@ -143,6 +143,16 @@ class Controller {
     // object lazily borrowed for this request.
     SimpleDataPool* sl_pool = nullptr;
     void* sl_data = nullptr;
+    // Large-message striping (net/stripe.h).  Client: a caller-owned
+    // response landing buffer (batch plane) — registered under the cid
+    // so striped response chunks memcpy straight into it; unregistered
+    // (with a lander drain) in complete_locked_call before the fid can
+    // recycle.  Server: the rails the striped REQUEST arrived over, so
+    // the response stripes back across the same connections.
+    void* land_buf = nullptr;
+    size_t land_cap = 0;
+    bool land_registered = false;
+    std::vector<uint64_t> stripe_rails;
   };
   CallState& call() { return call_; }
 

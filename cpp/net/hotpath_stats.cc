@@ -66,6 +66,22 @@ HotPathVars::HotPathVars() {
   probe_stall_skips.expose(
       "messenger_probe_stall_skips",
       "probe sweeps elided by the per-socket prefix-length memo");
+  stripe_tx_chunks.expose(
+      "stripe_tx_chunks",
+      "large-message stripe chunk frames sent (heads included)");
+  stripe_rx_chunks.expose(
+      "stripe_rx_chunks",
+      "large-message stripe chunk frames received (heads included)");
+  stripe_reassembled.expose(
+      "stripe_reassembled",
+      "striped messages fully reassembled and dispatched");
+  stripe_expired.expose(
+      "stripe_expired",
+      "stripe reassemblies dropped by timeout or abandonment");
+  cut_budget_yields.expose(
+      "messenger_cut_budget_yields",
+      "read sweeps that yielded their worker after exhausting the "
+      "per-sweep cut budget (bulk transfers sharing with small RPCs)");
 }
 
 HotPathVars& hotpath_vars() {

@@ -45,6 +45,18 @@ struct HotPathVars {
   Adder probe_rounds;
   Adder probe_stall_skips;
 
+  // Large-message striping (net/stripe.h).  All four stay EXACTLY zero
+  // on sub-threshold traffic — that invariant is what proves small RPCs
+  // bypass the stripe layer entirely.
+  Adder stripe_tx_chunks;    // chunk frames sent (head included)
+  Adder stripe_rx_chunks;    // chunk frames received (head included)
+  Adder stripe_reassembled;  // messages fully reassembled and dispatched
+  Adder stripe_expired;      // reassemblies dropped by timeout/abandon
+
+  // Read sweeps that yielded mid-drain (trpc_messenger_cut_budget): how
+  // often a bulk transfer handed its worker back to small-RPC dispatch.
+  Adder cut_budget_yields;
+
   HotPathVars();
 };
 

@@ -305,6 +305,15 @@ struct IciConn {
   IOBuf rx_pending;
   uint64_t rx_desc_tail = 0;  // poller-local: descriptors wrapped
   uint64_t rx_ack = 0;        // poller-local: desc_consumed published
+  // Copy-mode descriptors received == posted entries the PEER has claimed
+  // (it claims strictly in order, one per copy-mode WR).  This — not
+  // desc_head — bounds post-ring slot reuse: sender-owned (zero-copy)
+  // descriptors advance desc_head WITHOUT claiming a post, so a stream
+  // mixing the two (striped chunks: tiny copy-mode header + zero-copy
+  // payload each) drifts desc_head arbitrarily far past post_head, and
+  // the old `post_head - desc_head >= slots` guard underflowed and
+  // wedged posting permanently.
+  uint64_t posts_claimed_by_peer = 0;  // poller-local
   // Deferred-ack flags, desc index & mask.  Copy-mode descs release at
   // wrap time; sender-owned descs release when the consumer's last IOBuf
   // ref drops (any thread — hence atomics + shared_ptr lifetime).
@@ -504,14 +513,15 @@ bool publish_slabs(IciConn& c) {
 
 // Allocates and posts one recv block; false when the pool is at its cap
 // (post deferred — pool-exhaustion backpressure), the post ring is full,
-// or the pool is broken.  Ring-fullness bound: the sender consumes post
-// entry n exactly when it publishes descriptor n, so entries it may not
-// have seen yet number post_head - desc_head; reusing a slot before the
-// sender claimed it would tear the window.
+// or the pool is broken.  Ring-fullness bound: the sender claims post
+// entries strictly in order, one per COPY-MODE descriptor it publishes
+// (zero-copy descriptors claim nothing), so entries it may not have
+// claimed yet number post_head - posts_claimed_by_peer; reusing a slot
+// before the sender claimed it would tear the window.
 bool post_one_block(IciConn& c, bool* fatal) {
   IciDir& my_rxd = c.rx_dir();
   if (my_rxd.post_head.load(std::memory_order_relaxed) -
-          my_rxd.desc_head.load(std::memory_order_acquire) >=
+          c.posts_claimed_by_peer >=
       c.slots) {
     return false;
   }
@@ -676,6 +686,7 @@ class IciPoller {
             return moved;
           }
           c.posted_fifo.pop_front();
+          ++c.posts_claimed_by_peer;  // the post-ring slot reuse bound
           auto* ctx = new RxBlockCtx{c.rx, b};
           c.rx->wrapped.fetch_add(1, std::memory_order_relaxed);
           c.rx_pending.append_user_data(b->data, d.len, &rx_block_deleter,

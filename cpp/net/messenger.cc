@@ -2,18 +2,51 @@
 
 #include <errno.h>
 
+#include <algorithm>
+
+#include "base/flags.h"
 #include "base/logging.h"
 #include "base/tls_cache.h"
 #include "fiber/fiber.h"
 #include "net/hotpath_stats.h"
 #include "net/protocol.h"
 #include "net/stream.h"
+#include "net/stripe.h"
 
 namespace trpc {
 
 namespace {
 
 constexpr size_t kReadChunk = 512 * 1024;
+// Ceiling on one readv when the parser hinted a large frame remainder:
+// big enough to amortize per-syscall cost, small enough that the cut
+// budget below still interleaves other sockets' work.
+constexpr size_t kMaxBulkRead = 8 * 1024 * 1024;
+
+// Per-readable-sweep cut budget: after this many bytes are read+parsed
+// in one sweep, the read fiber YIELDS its worker (re-armed, back of the
+// run queue) so one 64MB socket cannot head-of-line-block the dispatch
+// fibers of small RPCs queued behind it on the same worker.
+Flag* cut_budget_flag() {
+  static Flag* f = [] {
+    Flag* flag = Flag::define_int64(
+        "trpc_messenger_cut_budget", 8ll << 20,
+        "bytes one readable sweep may read+parse before yielding its "
+        "worker to queued fibers (0 = never yield)");
+    if (flag != nullptr) {
+      flag->set_validator([](const std::string& v) {
+        char* end = nullptr;
+        const long long n = strtoll(v.c_str(), &end, 10);
+        return end != v.c_str() && *end == '\0' && n >= 0;
+      });
+    }
+    return flag;
+  }();
+  return f;
+}
+
+// Eager definition (settable before the first readable sweep).
+[[maybe_unused]] Flag* const g_cut_budget_flag_eager = cut_budget_flag();
 
 thread_local bool tls_inline_dispatch = false;
 
@@ -198,6 +231,23 @@ void cut_and_dispatch(Socket* s, SocketId id) {
           free_input_message(msg);
           continue;
         }
+        if (msg->meta.type == RpcMeta::kStripe) {
+          // Stripe chunks are offset-addressed and order-free: consume
+          // them here (the landing memcpy fans out to worker fibers) —
+          // no batch flush, no dispatch fiber.
+          stripe_on_chunk(std::move(*msg));
+          free_input_message(msg);
+          continue;
+        }
+        if (msg->meta.stripe_id != 0 &&
+            (msg->meta.type == RpcMeta::kRequest ||
+             msg->meta.type == RpcMeta::kResponse)) {
+          // Striped HEAD: only chunk 0 rode this frame; the message
+          // dispatches from the reassembly layer once every chunk lands.
+          stripe_on_head(std::move(*msg));
+          free_input_message(msg);
+          continue;
+        }
         const Protocol* p = protocol_at(s->pinned_protocol);
         if (p != nullptr && msg->meta.type == RpcMeta::kAuth) {
           // Credential frames verify INLINE in the read fiber: requests
@@ -260,11 +310,30 @@ void messenger_on_readable(SocketId id, void* /*ctx*/) {
   if (s == nullptr) {
     return;
   }
+  const int64_t budget = cut_budget_flag()->int64_value();
+  int64_t swept = 0;
   while (!s->Failed()) {
+    // Bulk hint: a parser that knows the current frame's remainder lets
+    // this sweep read it in few large-block readvs instead of 512KB
+    // slivers of 8KB blocks.
+    size_t want = kReadChunk;
+    if (s->read_block_hint > want) {
+      want = std::min(s->read_block_hint, kMaxBulkRead);
+    }
     const ssize_t rc =
-        s->transport()->append_to_iobuf(s, &s->read_buf(), kReadChunk);
+        s->transport()->append_to_iobuf(s, &s->read_buf(), want);
     if (rc > 0) {
       cut_and_dispatch(s, id);
+      swept += rc;
+      if (budget > 0 && swept >= budget) {
+        // Cut budget spent: hand the worker to whatever queued behind
+        // this sweep (small-RPC dispatch fibers), then resume.  The
+        // socket's bytes wait in the kernel/read_buf; nothing re-arms
+        // because this fiber IS still the armed reader.
+        hotpath_vars().cut_budget_yields << 1;
+        swept = 0;
+        fiber_yield();
+      }
       continue;
     }
     if (rc == 0) {

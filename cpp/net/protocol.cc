@@ -7,6 +7,7 @@
 #include <vector>
 
 #include "base/logging.h"
+#include "net/socket.h"
 
 namespace trpc {
 
@@ -68,24 +69,33 @@ std::string encode_meta(const RpcMeta& m) {
   // Optional tail, only when any of its fields is active: decoders treat
   // it as length-gated (they only look past error_text when bytes
   // remain), so presence/absence are both wire-compatible — and the
-  // streaming hot path never pays for it.  Layout: trace(24B) then
-  // compress+checksum(5B); the second group implies the first.
-  if (m.trace_id != 0 || m.compress_type != 0 || m.has_checksum ||
-      !m.extra_streams.empty()) {
+  // streaming hot path never pays for it.  Layout: trace(24B), then
+  // compress+checksum(6B), then batch streams(4B+), then stripe(24B);
+  // each later group implies every earlier one.
+  const bool has_stripe = m.stripe_id != 0;
+  const bool has_streams = !m.extra_streams.empty() || has_stripe;
+  const bool has_comp =
+      m.compress_type != 0 || m.has_checksum || has_streams;
+  if (m.trace_id != 0 || has_comp) {
     put_u64(&s, m.trace_id);
     put_u64(&s, m.span_id);
     put_u64(&s, m.parent_span_id);
-    if (m.compress_type != 0 || m.has_checksum ||
-        !m.extra_streams.empty()) {
+    if (has_comp) {
       s.push_back(static_cast<char>(m.compress_type));
       s.push_back(m.has_checksum ? 1 : 0);
       put_u32(&s, m.checksum);
-      if (!m.extra_streams.empty()) {
+      if (has_streams) {
         // Third tail group: batch stream offers (count + pairs).
         put_u32(&s, static_cast<uint32_t>(m.extra_streams.size()));
         for (const auto& [sid, window] : m.extra_streams) {
           put_u64(&s, sid);
           put_u64(&s, window);
+        }
+        if (has_stripe) {
+          // Fourth tail group: large-message striping (net/stripe.h).
+          put_u64(&s, m.stripe_id);
+          put_u64(&s, m.stripe_offset);
+          put_u64(&s, m.stripe_total);
         }
       }
     }
@@ -148,13 +158,19 @@ bool decode_meta(const std::string& s, RpcMeta* m) {
           m->extra_streams.emplace_back(get_u64(p), get_u64(p + 8));
           p += 16;
         }
+        if (end - p >= 24) {  // optional stripe group
+          m->stripe_id = get_u64(p);
+          m->stripe_offset = get_u64(p + 8);
+          m->stripe_total = get_u64(p + 16);
+          p += 24;
+        }
       }
     }
   }
   return true;
 }
 
-ParseError tstd_parse(IOBuf* source, InputMessage* out, Socket*) {
+ParseError tstd_parse(IOBuf* source, InputMessage* out, Socket* sock) {
   // Reject a wrong magic as soon as the available prefix disagrees, so the
   // messenger can offer the bytes to other protocols without waiting.
   char header[kHeaderLen];
@@ -171,7 +187,18 @@ ParseError tstd_parse(IOBuf* source, InputMessage* out, Socket*) {
     return ParseError::kCorrupted;
   }
   if (source->size() < kHeaderLen + meta_len + payload_len) {
+    // Bulk-read hint: the frame length is known, so the messenger can
+    // read the remainder into a few LARGE blocks (one readv iovec each)
+    // instead of 8KB slivers — under gVisor-style kernels the per-iovec
+    // cost is what caps large-message goodput.
+    if (sock != nullptr) {
+      sock->read_block_hint =
+          kHeaderLen + meta_len + payload_len - source->size();
+    }
     return ParseError::kNotEnoughData;
+  }
+  if (sock != nullptr) {
+    sock->read_block_hint = 0;
   }
   source->pop_front(kHeaderLen);
   std::string meta_bytes;

@@ -35,6 +35,12 @@ struct RpcMeta {
     kStreamFrame = 2,
     // Connection-scoped credential, sent as the FIRST frame (auth.h).
     kAuth = 3,
+    // Large-message striping (net/stripe.h): one chunk of a payload that
+    // was cut into K concurrent frames.  correlation_id carries the
+    // stripe id; the chunk lands at stripe_offset of a stripe_total-byte
+    // reassembly buffer.  May arrive on ANY connection between the two
+    // processes (multi-rail), in any order.
+    kStripe = 4,
   };
   // Stream flags (parity: streaming_rpc_meta.proto frame types).
   enum StreamFlags : uint8_t {
@@ -68,6 +74,16 @@ struct RpcMeta {
   uint8_t compress_type = 0;
   bool has_checksum = false;  // presence flag: a zero CRC is still a CRC
   uint32_t checksum = 0;
+  // Large-message striping (net/stripe.h).  On a HEAD frame
+  // (kRequest/kResponse): stripe_id != 0 announces that only the first
+  // chunk rides this frame and stripe_total payload bytes follow across
+  // kStripe frames sharing the id.  On a kStripe chunk: the payload
+  // lands at [stripe_offset, stripe_offset+len) of the reassembly
+  // buffer.  Zero everywhere on the (sub-threshold) hot path — the
+  // fourth optional wire-tail group, absent from small frames.
+  uint64_t stripe_id = 0;
+  uint64_t stripe_offset = 0;
+  uint64_t stripe_total = 0;
   std::string method;
   std::string error_text;
 
@@ -88,6 +104,9 @@ struct RpcMeta {
     compress_type = 0;
     has_checksum = false;
     checksum = 0;
+    stripe_id = 0;
+    stripe_offset = 0;
+    stripe_total = 0;
     method.clear();
     error_text.clear();
   }

@@ -35,6 +35,7 @@
 #include "net/shm_transport.h"
 #include "net/span.h"
 #include "net/stream.h"
+#include "net/stripe.h"
 #include "net/protocol.h"
 
 namespace trpc {
@@ -617,6 +618,12 @@ void tstd_process_request(InputMessage&& msg) {
   cntl->call().peer_stream = msg.meta.stream_id;
   cntl->call().peer_stream_window = msg.meta.ack_bytes;
   cntl->call().extra_peer = std::move(msg.meta.extra_streams);
+  if (msg.ctx != nullptr && msg.meta.stripe_id != 0) {
+    // Reassembled striped request: remember the rails it arrived over so
+    // the response stripes back across the same connections.
+    cntl->call().stripe_rails =
+        static_cast<StripeArrival*>(msg.ctx.get())->rails;
+  }
   cntl->call().sl_pool =
       srv != nullptr ? srv->session_data_pool() : nullptr;
   auto* response = new IOBuf();
@@ -675,7 +682,6 @@ void tstd_process_request(InputMessage&& msg) {
         meta.extra_streams.emplace_back(sid, stream_recv_window(sid));
       }
     }
-    IOBuf frame;
     if (!cntl->Failed() && cntl->response_compress_type() != 0) {
       const Compressor* c = find_compressor(
           static_cast<CompressType>(cntl->response_compress_type()));
@@ -691,13 +697,23 @@ void tstd_process_request(InputMessage&& msg) {
       response->append(std::move(cntl->response_attachment()));
     }
     if (cntl->checksum_enabled()) {
-      meta.has_checksum = true;
-      meta.checksum = crc32c(*response);
+      meta.has_checksum = true;  // striped sends CRC per chunk
     }
-    tstd_pack(&frame, meta, *response);
-    SocketRef s(Socket::Address(socket_id));
-    if (s) {
-      s->Write(std::move(frame));
+    const size_t response_bytes = response->size();
+    if (stripe_should(socket_id, meta.stream_id, response_bytes)) {
+      // Large response: stripe it back over the rails the request
+      // arrived on (or just this connection).  stripe_id is the cid —
+      // unique in the client process, and the key its registered
+      // landing buffer (batch plane) waits under.
+      std::vector<SocketId> rails = cntl->call().stripe_rails;
+      if (rails.empty()) {
+        rails.push_back(socket_id);
+      }
+      stripe_send(socket_id, rails, std::move(meta),
+                  std::move(*response), cid);
+    } else {
+      stripe_frame_send(socket_id, std::move(meta),
+                        std::move(*response));
     }
     const int64_t latency_us = monotonic_time_us() - start_us;
     if (limiter != nullptr) {
@@ -707,7 +723,7 @@ void tstd_process_request(InputMessage&& msg) {
       *lat << latency_us;
     }
     if (span != nullptr) {
-      span->response_bytes = response->size();
+      span->response_bytes = response_bytes;
       submit_span(span, cntl->error_code());
     }
     if (cntl->call().sl_data != nullptr) {

@@ -14,6 +14,7 @@
 #include <mutex>
 #include <vector>
 
+#include "base/flags.h"
 #include "base/logging.h"
 #include "base/rand.h"
 #include "base/time.h"
@@ -22,62 +23,49 @@ namespace trpc {
 
 namespace {
 
-constexpr uint32_t kRingCap = 1 << 20;  // 1MB per direction (power of 2)
-constexpr uint64_t kShmMagic = 0x54525053484d3254ull;  // "TRPSHM2T"
+constexpr uint64_t kShmMagic = 0x54525053484d3354ull;  // "TRPSHM3T"
 
-// SPSC byte ring; head/tail are free-running cursors (cap power of 2).
-struct Ring {
-  // Cursors on separate cache lines (cross-process false sharing would sit
-  // on the hottest path), data likewise aligned.
+// Ring capacity per direction: a reloadable flag read at SEGMENT CREATE
+// time (the cap is baked into the segment header; live connections keep
+// theirs).  The old fixed 1MB ring forced a 64MB transfer through 64
+// fill/drain round trips with a wakeup each — large-message throughput
+// satellite of the stripe work (ISSUE 5).
+Flag* ring_bytes_flag() {
+  static Flag* f = [] {
+    Flag* flag = Flag::define_int64(
+        "trpc_shm_ring_bytes", 4 << 20,
+        "shm ring capacity per direction for NEW connections (bytes, "
+        "power of two in [64KB, 256MB])");
+    if (flag != nullptr) {
+      flag->set_validator([](const std::string& v) {
+        char* end = nullptr;
+        const long long n = strtoll(v.c_str(), &end, 10);
+        return end != v.c_str() && *end == '\0' && n >= (64 << 10) &&
+               n <= (256ll << 20) && (n & (n - 1)) == 0;
+      });
+    }
+    return flag;
+  }();
+  return f;
+}
+[[maybe_unused]] Flag* const g_ring_bytes_eager = ring_bytes_flag();
+
+// Producer publishes its staged cursor every this-many staged bytes so
+// the consumer's copy-out overlaps the producer's copy-in (double/triple
+// buffering through the ring) instead of waiting for the whole drain.
+constexpr uint32_t kEagerPublishBytes = 128 * 1024;
+
+// SPSC byte ring: head/tail are free-running cursors over a power-of-two
+// capacity picked at segment creation; cursors live on their own cache
+// lines (cross-process false sharing would sit on the hottest path).
+struct RingHdr {
   alignas(64) std::atomic<uint64_t> head;  // producer cursor
   alignas(64) std::atomic<uint64_t> tail;  // consumer cursor
-  alignas(64) char data[kRingCap];
-
-  uint32_t readable() const {
-    return static_cast<uint32_t>(head.load(std::memory_order_acquire) -
-                                 tail.load(std::memory_order_acquire));
-  }
-  uint32_t writable() const { return kRingCap - readable(); }
-
-  // Copy bytes at *cursor without publishing: the batched-doorbell write
-  // path (the ONLY producer) stages a whole KeepWrite drain, then
-  // publish()es once.  The consumer only sees bytes at publish, so a
-  // drain of N messages costs the peer one head-cursor cache-line
-  // transfer instead of N.
-  uint32_t write_staged(const char* src, uint32_t n, uint64_t* cursor) {
-    const uint64_t h = *cursor;
-    const uint32_t space =
-        kRingCap -
-        static_cast<uint32_t>(h - tail.load(std::memory_order_acquire));
-    n = std::min(n, space);
-    const uint32_t off = static_cast<uint32_t>(h) & (kRingCap - 1);
-    const uint32_t first = std::min(n, kRingCap - off);
-    memcpy(data + off, src, first);
-    memcpy(data, src + first, n - first);
-    *cursor = h + n;
-    return n;
-  }
-
-  void publish(uint64_t cursor) {
-    head.store(cursor, std::memory_order_release);
-  }
-
-  uint32_t read(char* dst, uint32_t n) {
-    const uint64_t t = tail.load(std::memory_order_relaxed);
-    const uint32_t avail =
-        static_cast<uint32_t>(head.load(std::memory_order_acquire) - t);
-    n = std::min(n, avail);
-    const uint32_t off = static_cast<uint32_t>(t) & (kRingCap - 1);
-    const uint32_t first = std::min(n, kRingCap - off);
-    memcpy(dst, data + off, first);
-    memcpy(dst + first, data, n - first);
-    tail.store(t + n, std::memory_order_release);
-    return n;
-  }
 };
 
 struct Segment {
   uint64_t magic;
+  uint32_t ring_cap;  // bytes per direction (power of two)
   // Liveness: each side publishes its pid at map time and its poller
   // bumps a heartbeat word ~1/s. A peer is reaped (crash cleanup) when
   // its process is verifiably gone (ESRCH) OR its heartbeat stalls long
@@ -89,8 +77,60 @@ struct Segment {
   std::atomic<int32_t> server_pid;
   std::atomic<uint64_t> client_beat;
   std::atomic<uint64_t> server_beat;
-  Ring c2s;
-  Ring s2c;
+  RingHdr c2s;
+  RingHdr s2c;
+  alignas(64) char ring_data[];  // c2s bytes, then s2c bytes
+};
+
+size_t segment_size(uint32_t cap) {
+  return sizeof(Segment) + 2ull * cap;
+}
+
+// Header + data-slice view of one direction (cap from the mapped header).
+struct RingView {
+  RingHdr* h;
+  char* data;
+  uint32_t cap;
+
+  uint32_t readable() const {
+    return static_cast<uint32_t>(h->head.load(std::memory_order_acquire) -
+                                 h->tail.load(std::memory_order_acquire));
+  }
+
+  // Copy bytes at *cursor without publishing: the batched-doorbell write
+  // path (the ONLY producer) stages a KeepWrite drain and publishes at
+  // eager intervals + once at flush, so the peer sees few head-cursor
+  // cache-line transfers while still overlapping its copy-out.
+  uint32_t write_staged(const char* src, uint32_t n, uint64_t* cursor) {
+    const uint64_t hd = *cursor;
+    const uint32_t space =
+        cap - static_cast<uint32_t>(
+                  hd - h->tail.load(std::memory_order_acquire));
+    n = std::min(n, space);
+    const uint32_t off = static_cast<uint32_t>(hd) & (cap - 1);
+    const uint32_t first = std::min(n, cap - off);
+    memcpy(data + off, src, first);
+    memcpy(data, src + first, n - first);
+    *cursor = hd + n;
+    return n;
+  }
+
+  void publish(uint64_t cursor) {
+    h->head.store(cursor, std::memory_order_release);
+  }
+
+  uint32_t read(char* dst, uint32_t n) {
+    const uint64_t t = h->tail.load(std::memory_order_relaxed);
+    const uint32_t avail =
+        static_cast<uint32_t>(h->head.load(std::memory_order_acquire) - t);
+    n = std::min(n, avail);
+    const uint32_t off = static_cast<uint32_t>(t) & (cap - 1);
+    const uint32_t first = std::min(n, cap - off);
+    memcpy(dst, data + off, first);
+    memcpy(dst + first, data, n - first);
+    h->tail.store(t + n, std::memory_order_release);
+    return n;
+  }
 };
 
 }  // namespace
@@ -106,8 +146,15 @@ struct ShmConn {
   // writer role; UINT64_MAX = nothing staged (Transport::flush contract).
   uint64_t tx_staged = UINT64_MAX;
 
-  Ring& tx() { return is_client ? seg->c2s : seg->s2c; }
-  Ring& rx() { return is_client ? seg->s2c : seg->c2s; }
+  RingView ring(bool c2s_dir) {
+    RingView v;
+    v.h = c2s_dir ? &seg->c2s : &seg->s2c;
+    v.cap = seg->ring_cap;
+    v.data = seg->ring_data + (c2s_dir ? 0 : seg->ring_cap);
+    return v;
+  }
+  RingView tx() { return ring(is_client); }
+  RingView rx() { return ring(!is_client); }
   int32_t peer_pid() const {
     return (is_client ? seg->server_pid : seg->client_pid)
         .load(std::memory_order_acquire);
@@ -126,7 +173,7 @@ struct ShmConn {
 
   ~ShmConn() {
     if (seg != nullptr) {
-      munmap(seg, sizeof(Segment));
+      munmap(seg, segment_size(seg->ring_cap));
     }
     if (creator || unlink_on_close) {
       shm_unlink(name.c_str());
@@ -195,7 +242,7 @@ class ShmPoller {
             continue;
           }
           const uint64_t rx_head =
-              conn->rx().head.load(std::memory_order_acquire);
+              conn->rx().h->head.load(std::memory_order_acquire);
           // Liveness, rate-limited to ~1/s per ring (kill() is a syscall
           // and beats are cross-core cache traffic). Reap when:
           //  - the peer never published a pid (hostile/foreign segment
@@ -245,7 +292,7 @@ class ShmPoller {
             }
           }
           const uint64_t tx_tail =
-              conn->tx().tail.load(std::memory_order_acquire);
+              conn->tx().h->tail.load(std::memory_order_acquire);
           if (tx_tail != pr.last_tx_tail) {
             pr.last_tx_tail = tx_tail;
             any = true;
@@ -283,11 +330,13 @@ class ShmRingTransport final : public Transport {
       errno = ENOTCONN;
       return -1;
     }
-    Ring& tx = conn->tx();
-    // Stage the whole buffer at an unpublished cursor; flush() rings the
-    // doorbell once per drain (peer sees nothing until then).
+    RingView tx = conn->tx();
+    // Stage at an unpublished cursor; publish at eager intervals so the
+    // peer's copy-out overlaps this copy-in (a multi-MB drain would
+    // otherwise fill the whole ring before the consumer sees byte one),
+    // with flush() as the final doorbell of the drain.
     if (conn->tx_staged == UINT64_MAX) {
-      conn->tx_staged = tx.head.load(std::memory_order_relaxed);
+      conn->tx_staged = tx.h->head.load(std::memory_order_relaxed);
     }
     size_t total = 0;
     while (!from->empty()) {
@@ -299,6 +348,11 @@ class ShmRingTransport final : public Transport {
       }
       from->pop_front(wrote);
       total += wrote;
+      if (conn->tx_staged -
+              tx.h->head.load(std::memory_order_relaxed) >=
+          kEagerPublishBytes) {
+        tx.publish(conn->tx_staged);
+      }
     }
     return static_cast<ssize_t>(total);  // 0 = EAGAIN-equivalent
   }
@@ -318,17 +372,30 @@ class ShmRingTransport final : public Transport {
       errno = ENOTCONN;
       return -1;
     }
-    Ring& rx = conn->rx();
-    char tmp[16 * 1024];
+    RingView rx = conn->rx();
     size_t total = 0;
     while (total < max) {
-      const uint32_t got = rx.read(
-          tmp, static_cast<uint32_t>(std::min(sizeof(tmp), max - total)));
-      if (got == 0) {
+      // Single copy, ring → IOBuf tail: reserve what is readable (bulk
+      // transfers get big pooled blocks) instead of bouncing through a
+      // 16KB stack buffer.  avail only grows under the consumer, so
+      // read() returns exactly n.
+      const uint32_t avail = rx.readable();
+      if (avail == 0) {
         break;
       }
-      to->append(tmp, got);
-      total += got;
+      uint32_t n = static_cast<uint32_t>(
+          std::min<size_t>(avail, max - total));
+      if (n < HostArena::kBigBlockMin) {
+        // Mid-size reserves would allocate odd-cap blocks that neither
+        // the TLS block cache (exact default size) nor the big-block
+        // pool (>=256KB pow2) recycles — cut them to default-block
+        // granularity so a steady small-message stream reuses cached
+        // blocks instead of malloc/free per sweep.
+        n = std::min(n, HostArena::kDefaultBlockSize);
+      }
+      char* dst = to->reserve(n);
+      rx.read(dst, n);
+      total += n;
     }
     return static_cast<ssize_t>(total);  // 0 = drained
   }
@@ -343,9 +410,9 @@ ShmRingTransport* shm_transport() {
   return &t;
 }
 
-Segment* map_segment(int fd) {
-  void* mem = mmap(nullptr, sizeof(Segment), PROT_READ | PROT_WRITE,
-                   MAP_SHARED, fd, 0);
+Segment* map_segment(int fd, size_t bytes) {
+  void* mem =
+      mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   close(fd);
   return mem == MAP_FAILED ? nullptr : static_cast<Segment*>(mem);
 }
@@ -356,22 +423,26 @@ std::shared_ptr<ShmConn> shm_conn_create(std::string* name_out) {
   char name[64];
   snprintf(name, sizeof(name), "/trpc_%d_%llx", getpid(),
            static_cast<unsigned long long>(fast_rand()));
+  const uint32_t cap =
+      static_cast<uint32_t>(ring_bytes_flag()->int64_value());
+  const size_t bytes = segment_size(cap);
   const int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
   if (fd < 0) {
     return nullptr;
   }
-  if (ftruncate(fd, sizeof(Segment)) != 0) {
+  if (ftruncate(fd, static_cast<off_t>(bytes)) != 0) {
     close(fd);
     shm_unlink(name);
     return nullptr;
   }
-  Segment* seg = map_segment(fd);
+  Segment* seg = map_segment(fd, bytes);
   if (seg == nullptr) {
     shm_unlink(name);
     return nullptr;
   }
   memset(static_cast<void*>(seg), 0, sizeof(Segment));
   seg->magic = kShmMagic;
+  seg->ring_cap = cap;
   seg->client_pid.store(static_cast<int32_t>(getpid()),
                         std::memory_order_release);
   auto conn = std::make_shared<ShmConn>();
@@ -422,16 +493,23 @@ std::shared_ptr<ShmConn> shm_conn_open(const std::string& name) {
     shm_conn_release_name(name);
     return nullptr;
   }
+  // The header carries the creator's ring capacity; validate BEFORE
+  // trusting it: magic + power-of-two cap + exact file size (a hostile
+  // or stale segment must not become out-of-bounds ring indexing).
   struct stat st;
-  if (fstat(fd, &st) != 0 || st.st_size != sizeof(Segment)) {
+  if (fstat(fd, &st) != 0 ||
+      st.st_size < static_cast<off_t>(sizeof(Segment))) {
     close(fd);
     shm_conn_release_name(name);
     return nullptr;
   }
-  Segment* seg = map_segment(fd);
-  if (seg == nullptr || seg->magic != kShmMagic) {
+  Segment* seg = map_segment(fd, static_cast<size_t>(st.st_size));
+  if (seg == nullptr || seg->magic != kShmMagic ||
+      seg->ring_cap < (64 << 10) || seg->ring_cap > (256u << 20) ||
+      (seg->ring_cap & (seg->ring_cap - 1)) != 0 ||
+      static_cast<size_t>(st.st_size) != segment_size(seg->ring_cap)) {
     if (seg != nullptr) {
-      munmap(seg, sizeof(Segment));
+      munmap(seg, static_cast<size_t>(st.st_size));
     }
     shm_conn_release_name(name);
     return nullptr;

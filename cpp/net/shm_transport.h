@@ -29,7 +29,8 @@ namespace trpc {
 
 struct ShmConn;  // mapped segment + direction binding
 
-// Creates a new segment (1MB rings each way) and maps it as the CLIENT side.
+// Creates a new segment (ring capacity per direction from the reloadable
+// trpc_shm_ring_bytes flag, default 4MB) and maps it as the CLIENT side.
 // Returns nullptr on failure; *name_out is the segment name to send to the
 // server.
 std::shared_ptr<ShmConn> shm_conn_create(std::string* name_out);

@@ -82,6 +82,7 @@ void Socket::reset_for_reuse(const Options& opts) {
   pending_.clear();
   pending_close_ = false;
   probe_stall_len = 0;
+  read_block_hint = 0;
   parse_state.reset();
   parse_state_owner = nullptr;
   auth_ok.store(false, std::memory_order_relaxed);

@@ -103,6 +103,12 @@ class Socket {
   // prefix no longer pays a full multi-protocol probe per read event.
   // 0 = no stalled probe.  Read-fiber-owned; reset with the socket.
   size_t probe_stall_len = 0;
+  // Bulk-read hint: bytes the current (partially buffered) frame still
+  // needs, published by the parser on NotEnoughData.  The messenger and
+  // transport size their next reads/blocks from it, turning a 64MB body
+  // into a few large-iovec readvs instead of thousands of 8KB ones.
+  // 0 = no known remainder.  Read-fiber-owned; reset with the socket.
+  size_t read_block_hint = 0;
   // Incremental parser state for protocols that need it (HTTP chunked
   // bodies resume scanning; h2 connection state).  Owned by the read
   // fiber; cleared on socket reuse.  `parse_state_owner` tags WHICH

@@ -23,7 +23,10 @@ class TcpTransport final : public Transport {
   }
 
   ssize_t append_to_iobuf(Socket* s, IOBuf* to, size_t max) override {
-    const ssize_t rc = to->append_from_fd(s->fd(), max);
+    // Bulk hint from the parser: the frame's known remainder sizes the
+    // fresh blocks, so a multi-MB body arrives in a few contiguous
+    // blocks (one iovec each) instead of thousands of 8KB slivers.
+    const ssize_t rc = to->append_from_fd(s->fd(), max, s->read_block_hint);
     if (rc < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
       return 0;
     }

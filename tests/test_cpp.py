@@ -59,6 +59,74 @@ def _ctest_targets() -> list:
     return names
 
 
+@pytest.mark.slow
+def test_stripe_under_tsan():
+    """ISSUE 5 satellite: the stripe layer's new shared state — the
+    reassembly map, per-entry lander counts, the caller-landing registry
+    and the arena big-block pool — all run hot across parse fibers,
+    landing fibers and completion paths.  Build the runtime + test_stripe
+    with ThreadSanitizer (the repo's existing TSan config: cpp/tsan.supp)
+    and run every stripe case under it."""
+    import os
+
+    cxx = shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        pytest.skip("no C++ compiler")
+    probe = subprocess.run(
+        [cxx, "-fsanitize=thread", "-x", "c++", "-", "-o", "/dev/null"],
+        input="int main(){return 0;}", capture_output=True, text=True)
+    if probe.returncode != 0:
+        pytest.skip("toolchain lacks ThreadSanitizer runtime")
+    cpp = REPO / "cpp"
+    obj_dir = BUILD / "tsan_obj"
+    obj_dir.mkdir(parents=True, exist_ok=True)
+    sources = []
+    for sub in ("base", "fiber", "stat", "net", "capi"):
+        sources.extend(sorted((cpp / sub).glob("*.cc")))
+        sources.extend(sorted((cpp / sub).glob("*.S")))
+    flags = ["-std=c++20", "-fPIC", "-O1", "-g", "-fsanitize=thread",
+             "-fno-omit-frame-pointer", "-I", str(cpp)]
+    newest_h = max(p.stat().st_mtime
+                   for pat in ("*.h", "*.inc") for p in cpp.rglob(pat))
+
+    def compile_one(src):
+        obj = obj_dir / (str(src.relative_to(cpp)).replace("/", "_") + ".o")
+        if (not obj.exists()
+                or obj.stat().st_mtime < max(src.stat().st_mtime, newest_h)):
+            subprocess.run([cxx, *flags, "-c", str(src), "-o", str(obj)],
+                           check=True, capture_output=True, text=True)
+        return str(obj)
+
+    from concurrent.futures import ThreadPoolExecutor
+    try:
+        with ThreadPoolExecutor(max_workers=os.cpu_count() or 4) as pool:
+            objs = list(pool.map(compile_one, sources))
+        lib = BUILD / "libtpurpc_tsan.so"
+        subprocess.run(
+            [cxx, "-shared", "-fsanitize=thread", "-o", str(lib), *objs,
+             "-lpthread", "-lrt", "-lz", "-ldl"],
+            check=True, capture_output=True, text=True)
+        exe = BUILD / "test_stripe_tsan"
+        subprocess.run(
+            [cxx, *flags, str(cpp / "tests" / "test_stripe.cc"),
+             "-L", str(BUILD), f"-Wl,-rpath,{BUILD}", "-l:libtpurpc_tsan.so",
+             "-lpthread", "-o", str(exe)],
+            check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:
+        pytest.fail(f"TSan build failed:\n{e.stderr[-4000:]}")
+    env = dict(os.environ)
+    env["TSAN_OPTIONS"] = (
+        f"suppressions={cpp / 'tsan.supp'} halt_on_error=0 exitcode=66")
+    # Every stripe-prefixed case (the timing-bound p99 test stays native).
+    out = subprocess.run([str(exe), "stripe"], capture_output=True,
+                         text=True, timeout=900, env=env)
+    assert out.returncode == 0, (
+        f"stripe tests under TSan failed (rc={out.returncode}):\n"
+        f"{out.stderr[-8000:]}")
+    assert "WARNING: ThreadSanitizer" not in out.stderr, (
+        f"TSan reported races in the stripe layer:\n{out.stderr[-8000:]}")
+
+
 @pytest.mark.parametrize("target", _ctest_targets())
 def test_ctest(target):
     # ctest -R with anchors so test_redis doesn't also match

@@ -156,3 +156,104 @@ def test_batch_api_4mb_8deep_zerocopy_floor():
         )
     finally:
         srv.stop()
+
+
+# Large-message floors (ISSUE 5): the multi-rail stripe path.  This box
+# does ~3.5-3.9 GB/s at both sizes; the floors are conservative for
+# shared CI boxes, but far above the monolithic-frame collapse they
+# guard against (r05: 0.99 GB/s at 16MB, 0.59 at 64MB).
+STRIPE_GBPS_FLOOR = 1.5
+STRIPE_DEPTH = 8
+
+
+@pytest.mark.parametrize("size_mb", [16, 64])
+def test_striped_large_echo_floor(size_mb):
+    import numpy as np
+
+    from brpc_tpu.rpc import Channel, Server
+
+    size = size_mb << 20
+    srv = Server()
+    srv.register_native_echo("Echo.Echo")
+    srv.start(0)
+    try:
+        ch = Channel(f"127.0.0.1:{srv.port}", timeout_ms=60000,
+                     connection_type="pooled")
+        payload = np.arange(size, dtype=np.uint8)
+        pipe = ch.pipeline()
+        free_bufs = [np.empty(size, dtype=np.uint8)
+                     for _ in range(STRIPE_DEPTH)]
+        token2buf = {}
+
+        def submit_k(k):
+            bufs = [free_bufs.pop() for _ in range(k)]
+            toks = pipe.submit("Echo.Echo", [payload] * k, resp_bufs=bufs)
+            token2buf.update(zip(toks, bufs))
+
+        def drain(n):
+            got = 0
+            while got < n:
+                cs = pipe.poll(max_n=STRIPE_DEPTH, timeout_ms=60000)
+                assert cs, "striped pipeline wedged"
+                for c in cs:
+                    assert c.ok, f"striped member failed: {c}"
+                    free_bufs.append(token2buf.pop(c.token))
+                    got += 1
+            return got
+
+        submit_k(STRIPE_DEPTH)  # warm: connections, rails, landing pool
+        drain(STRIPE_DEPTH)
+        assert np.array_equal(free_bufs[-1], payload), "echo corrupted"
+
+        rounds = max(2, (2 << 30) // (size * STRIPE_DEPTH))
+        submit_k(STRIPE_DEPTH)
+        inflight = STRIPE_DEPTH
+        completed = 0
+        total = rounds * STRIPE_DEPTH
+        t0 = time.perf_counter()
+        while completed < total:
+            n = drain(1)
+            completed += n
+            inflight -= n
+            if completed + inflight < total:
+                submit_k(n)
+                inflight += n
+        dt = time.perf_counter() - t0
+        gbps = size * completed / dt / 1e9
+        pipe.close()
+        ch.close()
+        assert gbps >= STRIPE_GBPS_FLOOR, (
+            f"{size_mb}MB x {STRIPE_DEPTH}-deep striped echo {gbps:.3f} "
+            f"GB/s under floor {STRIPE_GBPS_FLOOR} (mid-large band "
+            f"regressed toward the monolithic-frame collapse)"
+        )
+    finally:
+        srv.stop()
+
+
+def test_small_rpc_hot_path_unchanged_by_stripe_layer():
+    """Acceptance guard: sub-threshold traffic must leave every stripe
+    stat var untouched — the wait-free inline-write small-RPC path is
+    byte-identical with the stripe layer in the build."""
+    from brpc_tpu.rpc import Channel, Server
+    from brpc_tpu.rpc import observe
+
+    srv = Server()
+    srv.register_native_echo("Echo.Echo")
+    srv.start(0)
+    try:
+        ch = Channel(f"127.0.0.1:{srv.port}", timeout_ms=10000)
+        ch.call("Echo.Echo", b"warm")
+        before = {k: observe.Vars.dump().get(k, 0) for k in
+                  ("stripe_tx_chunks", "stripe_rx_chunks",
+                   "stripe_reassembled", "stripe_expired")}
+        for _ in range(200):
+            ch.call("Echo.Echo", b"x" * 1024)
+        after = {k: observe.Vars.dump().get(k, 0) for k in before}
+        ch.close()
+        assert after == before, (
+            f"stripe vars moved on sub-threshold traffic: {before} -> "
+            f"{after}"
+        )
+    finally:
+        srv.stop()
