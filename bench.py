@@ -473,6 +473,96 @@ def _rpc_batch_goodput(size: int, depth: int = 8,
         return None
 
 
+def _child_qos_mixed() -> None:
+    """Mixed-workload QoS row (ISSUE 6): the high-priority 1KB floor
+    measured WHILE low-priority 64MB streams saturate the same server and
+    an admission-limited background tenant floods it.  Load generators
+    run in their OWN processes — in-process threads would measure this
+    interpreter's GIL, not the server's isolation.  Extends the PR-5
+    cut-budget HOL guard into a published number: the ratio column is the
+    acceptance metric (loaded p99 within 2x unloaded)."""
+    import statistics
+
+    from brpc_tpu.rpc import Channel, Server, set_flag
+
+    lanes = 4
+    lane_weights = "8,4,2,1"
+    bg_spec = "bg:weight=1,limit=4;*:limit=10000"
+    bulk_bytes = 64 << 20
+    set_flag("trpc_qos_lanes", str(lanes))
+    set_flag("trpc_qos_lane_weights", lane_weights)
+    srv = Server()
+    srv.register_native_echo("Echo.Echo")
+    srv.set_qos(bg_spec)
+    srv.start(0)
+    addr = f"127.0.0.1:{srv.port}"
+    fg = Channel(addr, timeout_ms=10000, qos_tenant="fg", qos_priority=0)
+
+    def p99(lat: list) -> float:
+        lat = sorted(lat)
+        return lat[len(lat) * 99 // 100]
+
+    def sample(seconds: float) -> list:
+        lat = []
+        end = time.perf_counter() + seconds
+        while time.perf_counter() < end:
+            t0 = time.perf_counter()
+            fg.call("Echo.Echo", b"x" * 1024)
+            lat.append((time.perf_counter() - t0) * 1e6)
+        return lat
+
+    for _ in range(100):  # warm: connections, pools, lazy init
+        fg.call("Echo.Echo", b"x" * 1024)
+    unloaded = sample(3.0)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    load_secs = 14
+    bulk_code = (
+        "import time\nfrom brpc_tpu.rpc import Channel\n"
+        f"ch = Channel({addr!r}, timeout_ms=60000, "
+        "connection_type='pooled', qos_tenant='bulk', qos_priority=3)\n"
+        f"buf = b'b' * {bulk_bytes}\n"
+        f"end = time.time() + {load_secs}\n"
+        "while time.time() < end:\n    ch.call('Echo.Echo', buf)\n")
+    flood_code = (
+        "import time\nfrom brpc_tpu.rpc import Channel\n"
+        f"ch = Channel({addr!r}, timeout_ms=2000, qos_tenant='bg', "
+        "qos_priority=2)\n"
+        f"end = time.time() + {load_secs}\n"
+        "while time.time() < end:\n"
+        "    try: ch.call('Echo.Echo', b'y' * 1024)\n"
+        "    except Exception: pass\n")
+    procs = [subprocess.Popen([sys.executable, "-c", bulk_code], env=env)
+             for _ in range(2)]
+    procs += [subprocess.Popen([sys.executable, "-c", flood_code], env=env)
+              for _ in range(2)]
+    time.sleep(3)  # let the bulk streams reach steady state
+    loaded = sample(8.0)
+    for p in procs:
+        p.wait()
+    fg.close()
+    srv.stop()
+    row = {
+        "workload": "qos_mixed_1kb_hi_under_64mb_lo",
+        "p99_unloaded_us": round(p99(unloaded)),
+        "p99_loaded_us": round(p99(loaded)),
+        "median_unloaded_us": round(statistics.median(unloaded)),
+        "median_loaded_us": round(statistics.median(loaded)),
+        "ratio_p99": round(p99(loaded) / max(p99(unloaded), 1.0), 3),
+        "samples_loaded": len(loaded),
+        # Lane/tenant config stamped on the row: a future run with a
+        # different config must not be read as the same series.
+        "qos_lanes": lanes,
+        "lane_weights": lane_weights,
+        "qos_spec": bg_spec,
+        "bulk_bytes": bulk_bytes,
+        "bulk_streams": 2,
+        "bg_flooders": 2,
+    }
+    print(json.dumps(row))
+
+
 def _child_zerocopy() -> None:
     """Loopback RPC echo, three Python-boundary strategies at 4MB: the
     per-call bytes-copy path, the per-call dlpack zero-copy path, and the
@@ -684,6 +774,9 @@ def main() -> None:
     if os.environ.get("BENCH_ZC"):
         _child_zerocopy()
         return
+    if os.environ.get("BENCH_QOS"):
+        _child_qos_mixed()
+        return
     if os.environ.get("BENCH_TPU_RPC"):
         _child_tpu_rpc()
         return
@@ -735,6 +828,7 @@ def main() -> None:
             "bench produced no rows on TPU or CPU; last child stderr:\n" +
             open("/tmp/bench_child.err").read()[-2000:])
     zerocopy = _run_json_child({"BENCH_ZC": "1"}, 60)
+    qos_mixed = _run_json_child({"BENCH_QOS": "1"}, 90)
 
     # tpu_rpc leg, same retry contract; a CPU-platform run is still a real
     # measurement of the native RPC stack, so fall back rather than emit
@@ -768,6 +862,7 @@ def main() -> None:
         "tpu_rpc": tpu_rpc,
         "cpp": _cpp_rows(),
         "zerocopy": zerocopy,
+        "qos_mixed": qos_mixed,
     }))
 
 

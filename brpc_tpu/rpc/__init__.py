@@ -6,6 +6,11 @@ from brpc_tpu.rpc.batch import (  # noqa: F401
     Completion,
     ZeroCopyResponse,
 )
-from brpc_tpu.rpc.client import Channel, ClusterChannel, RpcError  # noqa: F401
+from brpc_tpu.rpc.client import (  # noqa: F401
+    Channel,
+    ClusterChannel,
+    OverloadedError,
+    RpcError,
+)
 from brpc_tpu.rpc.flags import get_flag, set_flag  # noqa: F401
 from brpc_tpu.rpc.server import Call, Server  # noqa: F401

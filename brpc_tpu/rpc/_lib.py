@@ -263,6 +263,34 @@ def load_library() -> ctypes.CDLL:
                 ctypes.c_void_p, ctypes.c_char_p,
             ]
             lib.trpc_server_fault_set.restype = ctypes.c_int
+            # QoS subsystem (capi/qos_capi.cc; cpp/net/qos.h).
+            lib.trpc_server_set_qos.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+            ]
+            lib.trpc_server_set_qos.restype = ctypes.c_int
+            lib.trpc_server_set_reuseport.argtypes = [
+                ctypes.c_void_p, ctypes.c_int,
+            ]
+            lib.trpc_server_set_reuseport.restype = ctypes.c_int
+            lib.trpc_server_accept_counts.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_int,
+            ]
+            lib.trpc_server_accept_counts.restype = ctypes.c_int
+            lib.trpc_channel_set_qos.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ]
+            lib.trpc_cluster_set_qos.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ]
+            lib.trpc_call_qos.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.trpc_call_qos.restype = ctypes.c_int
+            lib.trpc_qos_overloaded_code.argtypes = []
+            lib.trpc_qos_overloaded_code.restype = ctypes.c_int
+            lib.trpc_qos_lane_depth.argtypes = [ctypes.c_int]
+            lib.trpc_qos_lane_depth.restype = ctypes.c_int64
             # Batched async pipeline (capi/batch_capi.cc).
             lib.trpc_batch_create.argtypes = [ctypes.c_void_p, ctypes.c_int]
             lib.trpc_batch_create.restype = ctypes.c_void_p

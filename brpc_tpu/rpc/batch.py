@@ -380,7 +380,7 @@ def call_batch(channel, method: str, requests, resp_bufs=None,
     landed in the matching resp_bufs entry).  Runs on its own private
     pipeline — a shared one could hand it completions belonging to other
     submitters."""
-    from brpc_tpu.rpc.client import RpcError
+    from brpc_tpu.rpc.client import make_rpc_error
 
     b = Batch(channel)
     track = getattr(channel, "_track_pipeline", None)
@@ -395,7 +395,8 @@ def call_batch(channel, method: str, requests, resp_bufs=None,
             for c in b.poll(max_n=len(want), timeout_ms=-1):
                 want.discard(c.token)
                 if not c.ok:
-                    by_token[c.token] = RpcError(c.status, c.error)
+                    by_token[c.token] = make_rpc_error(
+                        channel._lib, c.status, c.error)
                 elif c.in_caller_buffer:
                     by_token[c.token] = None
                 elif c.data is not None:

@@ -17,6 +17,31 @@ class RpcError(Exception):
         self.text = text
 
 
+class OverloadedError(RpcError):
+    """The server shed this request via per-tenant admission control
+    (cpp/net/qos.h kEOverloaded, code 2005): the node is ALIVE but over
+    this tenant's bound.  Back off or route elsewhere — a ClusterChannel
+    does that automatically (immediate failover to a different node +
+    quarantine backoff on the shedding one)."""
+
+
+def _overloaded_code(lib) -> int:
+    return lib.trpc_qos_overloaded_code()
+
+
+def make_rpc_error(lib, code: int, text: str) -> RpcError:
+    """The typed error for a failed call's status code — OverloadedError
+    for an admission-control shed, RpcError otherwise.  Shared by the
+    sync call paths and the batch plane so both surface the same type."""
+    if code == _overloaded_code(lib):
+        return OverloadedError(code, text)
+    return RpcError(code, text)
+
+
+def _raise_rpc_error(lib, code: int, text: str):
+    raise make_rpc_error(lib, code, text)
+
+
 class _BatchMixin:
     """Pipelined data plane shared by Channel and ClusterChannel: one GIL
     crossing submits N calls, completions drain with the GIL released
@@ -98,7 +123,7 @@ def _call(lib, fn, ptr, method: str, request: bytes, extra,
         # full timeout wait — the gap between the two IS the network.
         latency.record(int((time.perf_counter() - t0) * 1e6))
     if rc != 0:
-        raise RpcError(rc, err.value.decode(errors="replace"))
+        _raise_rpc_error(lib, rc, err.value.decode(errors="replace"))
     return resp.to_bytes()
 
 
@@ -109,7 +134,8 @@ class Channel(_BatchMixin):
     transparent TCP fallback)."""
 
     def __init__(self, addr: str, timeout_ms: int = 1000,
-                 use_shm: bool = False, connection_type: str = "single"):
+                 use_shm: bool = False, connection_type: str = "single",
+                 qos_tenant: str = "", qos_priority: int = 0):
         self._lib = load_library()
         self._ptr = self._lib.trpc_channel_create_ex(
             addr.encode(), ctypes.c_int64(timeout_ms),
@@ -117,6 +143,8 @@ class Channel(_BatchMixin):
         if not self._ptr:
             raise ValueError(
                 f"bad address or options: {addr!r} / {connection_type!r}")
+        if qos_tenant or qos_priority:
+            self.set_qos(qos_tenant, qos_priority)
         # Client-side latency recorder in the shared var registry
         # (observe plane): shows in /vars + /brpc_metrics next to the
         # server's rpc_server_* series, readable in-process via
@@ -126,6 +154,14 @@ class Channel(_BatchMixin):
         self.latency = _observe.Latency(
             _observe.unique_var_name(f"rpc_client_{addr}"),
             f"client-side latency of sync calls on channel {addr}")
+
+    def set_qos(self, tenant: str, priority: int = 0) -> None:
+        """Default QoS tag for subsequent calls on this channel: `tenant`
+        bills the server's per-tenant admission control (cpp/net/qos.h),
+        `priority` picks the dispatch lane (0 = highest).  A shed request
+        raises OverloadedError."""
+        self._lib.trpc_channel_set_qos(
+            self._ptr, tenant.encode(), int(priority))
 
     def call(self, method: str, request: bytes, timeout_ms: int = 0) -> bytes:
         return _call(self._lib, self._lib.trpc_channel_call, self._ptr,
@@ -166,7 +202,8 @@ class ClusterChannel(_BatchMixin):
                  backup_request_ms: int = 0,
                  health_check_method: str | None = None,
                  health_check_timeout_ms: int = 0,
-                 refresh_interval_ms: int = 0):
+                 refresh_interval_ms: int = 0,
+                 qos_tenant: str = "", qos_priority: int = 0):
         self._lib = load_library()
         self._ptr = self._lib.trpc_cluster_create_ex(
             naming_url.encode(), lb.encode(), timeout_ms, max_retry,
@@ -177,10 +214,19 @@ class ClusterChannel(_BatchMixin):
         )
         if not self._ptr:
             raise ValueError(f"cluster init failed: {naming_url!r}")
+        if qos_tenant or qos_priority:
+            self.set_qos(qos_tenant, qos_priority)
         self.latency = _observe.Latency(
             _observe.unique_var_name(f"rpc_client_{naming_url}"),
             f"client-side latency of sync calls on cluster {naming_url} "
             "(includes retries and hedges)")
+
+    def set_qos(self, tenant: str, priority: int = 0) -> None:
+        """Default QoS tag for every member channel's subsequent calls
+        (cpp/net/qos.h).  A node shedding this tenant (OverloadedError
+        code) fails over to a different node inside the same call."""
+        self._lib.trpc_cluster_set_qos(
+            self._ptr, tenant.encode(), int(priority))
 
     def call(self, method: str, request: bytes, hash_key: int = 0) -> bytes:
         return _call(self._lib, self._lib.trpc_cluster_call, self._ptr,

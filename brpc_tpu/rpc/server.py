@@ -26,9 +26,15 @@ class Call:
     can never double-complete.
     """
 
-    def __init__(self, lib, handle: int):
+    def __init__(self, lib, handle: int, tenant: str = "",
+                 priority: int = 0):
         self._lib = lib
         self._handle = handle
+        #: QoS tag of this request (cpp/net/qos.h): the tenant it bills
+        #: and its dispatch-lane priority (0 = highest).  Empty/0 on
+        #: untagged traffic.
+        self.tenant = tenant
+        self.priority = priority
 
     def respond(self, data: bytes = b"", error_code: int = 0,
                 error_text: str = "") -> bool:
@@ -51,7 +57,13 @@ class Server:
         lib = self._lib
 
         def thunk(handle, req_ptr, req_len, _ctx):
-            call = Call(lib, handle)
+            # QoS tag fetched EAGERLY: the handle dies at respond(), and a
+            # lazy property read after an async respond would be a
+            # use-after-free.
+            tbuf = ctypes.create_string_buffer(80)
+            prio = lib.trpc_call_qos(handle, tbuf, 80)
+            call = Call(lib, handle, tbuf.value.decode(errors="replace"),
+                        prio)
             try:
                 data = ctypes.string_at(req_ptr, req_len)
                 fn(call, data)
@@ -76,6 +88,29 @@ class Server:
                 self._ptr, method.encode()) != 0:
             raise RuntimeError(
                 f"register_native_echo {method!r} failed (server running?)")
+
+    def set_qos(self, spec: str) -> None:
+        """Per-tenant QoS admission control (cpp/net/qos.h grammar):
+        ';'-separated `tenant:weight=N,limit=<spec>` clauses, tenant '*'
+        as the default.  Shed requests answer the overloaded status
+        (OverloadedError client-side).  '' removes.  Call before start;
+        raises on a malformed spec."""
+        if self._lib.trpc_server_set_qos(self._ptr, spec.encode()) != 0:
+            raise ValueError(f"bad qos spec (or server running): {spec!r}")
+
+    def set_reuseport_shards(self, shards: int) -> None:
+        """Shards the TCP acceptor across `shards` SO_REUSEPORT listeners
+        (each on its own event-dispatcher slot — see the
+        trpc_event_dispatchers flag).  Call before start."""
+        if self._lib.trpc_server_set_reuseport(self._ptr, shards) != 0:
+            raise ValueError(
+                f"bad shard count (or server running): {shards}")
+
+    def accept_counts(self) -> list:
+        """Connections accepted per REUSEPORT shard (scale telemetry)."""
+        out = (ctypes.c_uint64 * 16)()
+        n = self._lib.trpc_server_accept_counts(self._ptr, out, 16)
+        return [int(out[i]) for i in range(n)]
 
     def set_faults(self, spec: str) -> None:
         """Server-side fault injection (cpp/net/fault.h svr_* fields):

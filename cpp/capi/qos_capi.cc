@@ -1,0 +1,84 @@
+// C ABI for the QoS subsystem (net/qos.h): per-tenant admission specs,
+// channel-default tags, acceptor sharding, and the server-side view of a
+// request's tag — the Python surface of the million-user front door.
+#include <cstring>
+#include <string>
+
+#include "net/channel.h"
+#include "net/cluster.h"
+#include "net/concurrency_limiter.h"
+#include "net/controller.h"
+#include "net/qos.h"
+#include "net/server.h"
+
+using namespace trpc;
+
+// Defined in rpc_capi.cc (the PendingCall layout owner).
+namespace trpc {
+Controller* trpc_internal_pending_controller(void* call_handle);
+}
+
+extern "C" {
+
+// Per-tenant QoS spec (Server::SetQos; net/qos.h grammar).  "" removes.
+// Returns 0, -1 on a malformed spec or a running server.
+int trpc_server_set_qos(void* srv, const char* spec) {
+  return static_cast<Server*>(srv)->SetQos(spec != nullptr ? spec : "");
+}
+
+// SO_REUSEPORT acceptor shards (Server::set_reuseport_shards).  Call
+// before start.  Returns 0, -1 on a bad count or a running server.
+int trpc_server_set_reuseport(void* srv, int shards) {
+  return static_cast<Server*>(srv)->set_reuseport_shards(shards);
+}
+
+// Per-shard accepted-connection counters; returns the number written
+// (≤ cap) — accept-distribution telemetry for the scale harness.
+int trpc_server_accept_counts(void* srv, uint64_t* out, int cap) {
+  const auto counts = static_cast<Server*>(srv)->accept_counts();
+  int n = 0;
+  for (; n < static_cast<int>(counts.size()) && n < cap; ++n) {
+    out[n] = counts[n];
+  }
+  return n;
+}
+
+// Default QoS tag for every subsequent call on this channel (tenant may
+// be ""/null = untagged; priority 0 = highest lane).
+void trpc_channel_set_qos(void* ch, const char* tenant, int priority) {
+  static_cast<Channel*>(ch)->set_default_qos(
+      tenant != nullptr ? tenant : "",
+      static_cast<uint8_t>(priority < 0 ? 0 : priority));
+}
+
+// Same for a cluster channel: stored for future member channels and
+// pushed into the live ones.
+void trpc_cluster_set_qos(void* ch, const char* tenant, int priority) {
+  static_cast<ClusterChannel*>(ch)->set_default_qos(
+      tenant != nullptr ? tenant : "",
+      static_cast<uint8_t>(priority < 0 ? 0 : priority));
+}
+
+// The QoS tag of an in-flight server call (read inside the handler
+// callback, BEFORE trpc_call_respond frees the handle).  Returns the
+// priority; copies the tenant (truncated if needed) into tenant_out.
+int trpc_call_qos(void* call_handle, char* tenant_out, size_t tenant_len) {
+  Controller* cntl = trpc::trpc_internal_pending_controller(call_handle);
+  if (tenant_out != nullptr && tenant_len > 0) {
+    const std::string& t = cntl->qos_tenant();
+    const size_t n = t.size() < tenant_len - 1 ? t.size() : tenant_len - 1;
+    memcpy(tenant_out, t.data(), n);
+    tenant_out[n] = '\0';
+  }
+  return cntl->qos_priority();
+}
+
+// The kEOverloaded status code (admission-control shed), so bindings
+// never hardcode it.
+int trpc_qos_overloaded_code() { return kEOverloaded; }
+
+// Live depth of one QoS lane (test/telemetry convenience; the same value
+// rides /vars as qos_lane_depth_<i>).
+int64_t trpc_qos_lane_depth(int lane) { return qos_lane_depth(lane); }
+
+}  // extern "C"

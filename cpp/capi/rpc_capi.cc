@@ -38,6 +38,15 @@ using HandlerCb = void (*)(void* call_handle, const char* req, size_t req_len,
 
 }  // namespace
 
+namespace trpc {
+// Internal accessor for sibling capi TUs (qos_capi.cc): the controller of
+// an in-flight PendingCall handle.  Valid only while the handle is —
+// i.e. before its trpc_call_respond.
+Controller* trpc_internal_pending_controller(void* call_handle) {
+  return static_cast<PendingCall*>(call_handle)->cntl;
+}
+}  // namespace trpc
+
 extern "C" {
 
 // ---- server -------------------------------------------------------------

@@ -587,6 +587,15 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
   meta.type = RpcMeta::kRequest;
   meta.correlation_id = cid;
   meta.method = method;
+  // QoS tag (net/qos.h): the caller's explicit tag wins, else the
+  // channel default; untagged stays absent from the wire entirely.
+  if (cntl->qos_set()) {
+    meta.qos_priority = cntl->qos_priority();
+    meta.qos_tenant = cntl->qos_tenant();
+  } else {
+    meta.qos_priority = opts_.qos_priority;
+    meta.qos_tenant = opts_.qos_tenant;
+  }
   meta.stream_id = cntl->call().offered_stream;  // stream offer piggyback
   if (meta.stream_id != 0) {
     meta.ack_bytes = stream_recv_window(meta.stream_id);  // advertise window

@@ -61,6 +61,12 @@ class Channel {
     std::string tls_cert;
     std::string tls_key;
     std::string tls_ca;
+    // Default QoS tag stamped on every request whose controller didn't
+    // set its own (net/qos.h: tenant bills per-tenant admission, priority
+    // picks the dispatch lane; 0 = highest).  Tenant names cap at 64
+    // bytes (wire limit).
+    std::string qos_tenant;
+    uint8_t qos_priority = 0;
   };
 
   ~Channel();  // fails the pooled socket so its resources (fd / shm
@@ -74,6 +80,14 @@ class Channel {
   // the status and *response the payload.
   void CallMethod(const std::string& method, const IOBuf& request,
                   IOBuf* response, Controller* cntl, Closure done = nullptr);
+
+  // Retargets the channel's default QoS tag (Options::qos_tenant/
+  // qos_priority) for subsequent calls.  Set before issuing traffic —
+  // not synchronized against concurrent CallMethods.
+  void set_default_qos(const std::string& tenant, uint8_t priority) {
+    opts_.qos_tenant = tenant.size() > 64 ? tenant.substr(0, 64) : tenant;
+    opts_.qos_priority = priority;
+  }
 
   const EndPoint& endpoint() const { return ep_; }
   // Connection type parsed in Init (socket_map.h ConnectionType raw

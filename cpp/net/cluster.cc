@@ -445,6 +445,11 @@ int ClusterChannel::refresh() {
         copts.connection_type = opts_.connection_type;
         copts.auth = opts_.auth;
         copts.protocol = opts_.protocol;
+        {
+          std::lock_guard<std::mutex> qg(qos_mu_);
+          copts.qos_tenant = opts_.qos_tenant;
+          copts.qos_priority = opts_.qos_priority;
+        }
         if (ch->Init(endpoint2str(ep), &copts) != 0) {
           continue;
         }
@@ -467,6 +472,26 @@ int ClusterChannel::refresh() {
     fiber_start(nullptr, &ClusterChannel::refresh_fiber, this, 0);
   }
   return 0;
+}
+
+void ClusterChannel::set_default_qos(const std::string& tenant,
+                                     uint8_t priority) {
+  std::string capped = tenant.size() > 64 ? tenant.substr(0, 64) : tenant;
+  {
+    std::lock_guard<std::mutex> qg(qos_mu_);
+    opts_.qos_tenant = capped;
+    opts_.qos_priority = priority;
+  }
+  std::shared_ptr<Cluster> cluster;
+  {
+    auto cur = cluster_.Read();
+    cluster = *cur;
+  }
+  if (cluster != nullptr) {
+    for (const auto& ch : cluster->channels) {
+      ch->set_default_qos(capped, priority);
+    }
+  }
 }
 
 void ClusterChannel::refresh_fiber(void* arg) {
@@ -509,10 +534,14 @@ void probe_fiber(void* p) {
   ctx->channel->CallMethod(ctx->method, req, &resp, &cntl);
   // ALLOWLIST of "the server definitely answered": success, or the
   // server-side errors a probe legitimately produces (no such method,
-  // admission-limited).  Everything else — including local failures like
-  // fid exhaustion — must NOT revive the node.
+  // admission-limited, tenant-shed).  Everything else — including local
+  // failures like fid exhaustion — must NOT revive the node.  A
+  // kEOverloaded answer proves the TRANSPORT alive (the shed is QoS
+  // policy, not node death), so the node revives and the next real call
+  // re-judges it.
   const bool answered = !cntl.Failed() || cntl.error_code() == ENOENT ||
                         cntl.error_code() == kELimit ||
+                        cntl.error_code() == kEOverloaded ||
                         cntl.error_code() == ESHUTDOWN;
   if (answered) {
     ctx->quarantined_until->store(0, std::memory_order_relaxed);
@@ -762,6 +791,12 @@ void ClusterChannel::call_hedged(std::shared_ptr<Cluster> cluster,
     ctx->cntls[slot].set_timeout_ms(eff_timeout_ms);
     ctx->cntls[slot].set_request_compress_type(cntl->request_compress_type());
     ctx->cntls[slot].set_enable_checksum(cntl->checksum_enabled());
+    if (cntl->qos_set()) {
+      // Per-call tag outranks the member channels' default on BOTH
+      // racing attempts (the retry loop keeps the caller's controller,
+      // so it propagates there for free).
+      ctx->cntls[slot].set_qos(cntl->qos_tenant(), cntl->qos_priority());
+    }
     ctx->cntls[slot].request_attachment() = ctx->attachment;
     auto* arg = new HedgeFiberArg{ctx, slot};
     bool inject = false;
@@ -940,7 +975,13 @@ void ClusterChannel::CallMethod(const std::string& method,
       }
       return;
     }
-    feed_breaker(node, false);  // exponential quarantine
+    // Exponential quarantine.  kEOverloaded (per-tenant admission shed,
+    // net/qos.h) rides this same path BY DESIGN: the node is alive but
+    // shedding, so the retry moves to a different node immediately (the
+    // tried-set exclusion above never re-picks this one) and the breaker
+    // backs traffic off it until the quarantine window expires or a
+    // health probe answers.
+    feed_breaker(node, false);
     if (last_attempt) {
       break;
     }

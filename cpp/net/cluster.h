@@ -11,6 +11,7 @@
 
 #include <atomic>
 #include <memory>
+#include <mutex>
 #include <string>
 #include <utility>
 #include <vector>
@@ -110,6 +111,11 @@ class ClusterChannel {
     std::string connection_type = "single";
     const Authenticator* auth = nullptr;
     std::string protocol = "tstd";
+    // Default QoS tag for every member channel (net/qos.h); per-call
+    // Controller::set_qos overrides.  A tagged cluster client pairs the
+    // shed status (kEOverloaded) with the failover machinery above.
+    std::string qos_tenant;
+    uint8_t qos_priority = 0;
   };
 
   ~ClusterChannel();
@@ -118,6 +124,13 @@ class ClusterChannel {
   void CallMethod(const std::string& method, const IOBuf& request,
                   IOBuf* response, Controller* cntl, Closure done = nullptr,
                   uint64_t hash_key = 0);
+
+  // Retargets the default QoS tag: stored for future member channels
+  // (mutex-guarded — the refresh fiber reads it when building them) AND
+  // pushed into the live ones.  Set before issuing traffic: the push
+  // into live member channels follows Channel::set_default_qos's
+  // unsynchronized-vs-CallMethod contract.
+  void set_default_qos(const std::string& tenant, uint8_t priority);
 
   // Re-resolves now (also runs periodically in a refresh fiber).
   int refresh();
@@ -140,6 +153,11 @@ class ClusterChannel {
   std::string ns_param_;
   std::unique_ptr<LoadBalancer> lb_;
   Options opts_;
+  // Guards opts_.qos_tenant/qos_priority ONLY: set_default_qos may run
+  // while the refresh fiber is building member channels from opts_ (a
+  // torn std::string read would be UB).  The rest of opts_ is
+  // immutable after Init.
+  mutable std::mutex qos_mu_;
   DoublyBufferedData<std::shared_ptr<Cluster>> cluster_;
   std::atomic<bool> stopping_{false};
   std::atomic<bool> refresher_started_{false};

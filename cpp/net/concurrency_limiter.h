@@ -20,6 +20,13 @@ namespace trpc {
 
 // Rejected-by-limiter error code (parity: brpc::ELIMIT).
 constexpr int kELimit = 2004;
+// Shed by per-tenant admission control (net/qos.h) — deliberately
+// DISTINCT from kELimit: kELimit means "this method is at its bound,
+// try again here later"; kEOverloaded means "this server is shedding
+// your tenant's load — fail over NOW".  The cluster client treats it as
+// a node failure (immediate retry on a different node + quarantine
+// backoff), and health probes treat it as proof of life.
+constexpr int kEOverloaded = 2005;
 
 class ConcurrencyLimiter {
  public:

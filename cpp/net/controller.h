@@ -65,6 +65,21 @@ class Controller {
   void set_enable_checksum(bool on) { checksum_ = on; }
   bool checksum_enabled() const { return checksum_; }
 
+  // -- QoS tag (net/qos.h) ---------------------------------------------
+  // Client: per-call override of the channel's default tenant/priority
+  // (set BEFORE CallMethod; rides the request meta's qos tail group).
+  // Server: the arriving request's tag, readable in the handler.
+  // Tenant names are capped at 64 bytes (wire decoder limit) — longer
+  // ones are truncated at send.  Priority 0 is the highest lane.
+  void set_qos(const std::string& tenant, uint8_t priority) {
+    qos_tenant_ = tenant.size() > 64 ? tenant.substr(0, 64) : tenant;
+    qos_priority_ = priority;
+    qos_set_ = true;
+  }
+  bool qos_set() const { return qos_set_; }
+  const std::string& qos_tenant() const { return qos_tenant_; }
+  uint8_t qos_priority() const { return qos_priority_; }
+
   // Payload carried outside the main body (parity: attachment in
   // baidu_std; rides the same frame after the response body).
   IOBuf& request_attachment() { return request_attachment_; }
@@ -178,6 +193,9 @@ class Controller {
   uint8_t resp_compress_ = 0;
   bool checksum_ = false;
   bool done_inline_safe_ = false;
+  bool qos_set_ = false;
+  uint8_t qos_priority_ = 0;
+  std::string qos_tenant_;
   int64_t latency_us_ = 0;
   IOBuf request_attachment_;
   IOBuf response_attachment_;

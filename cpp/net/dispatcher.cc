@@ -1,17 +1,61 @@
 #include "net/dispatcher.h"
 
 #include <pthread.h>
+#include <stdlib.h>
 #include <sys/epoll.h>
 
+#include "base/flags.h"
 #include "base/logging.h"
 #include "net/socket.h"
 
 namespace trpc {
 
-EventDispatcher* EventDispatcher::instance() {
+namespace {
+
+Flag* dispatchers_flag() {
+  static Flag* f = [] {
+    Flag* flag = Flag::define_int64(
+        "trpc_event_dispatchers", 1,
+        "epoll event loops fds are hash-sharded across (latched at the "
+        "first socket registration; raise BEFORE any traffic)");
+    if (flag != nullptr) {
+      flag->set_validator([](const std::string& v) {
+        char* end = nullptr;
+        const long n = strtol(v.c_str(), &end, 10);
+        return end != v.c_str() && *end == '\0' && n >= 1 &&
+               n <= EventDispatcher::kMaxDispatchers;
+      });
+    }
+    return flag;
+  }();
+  return f;
+}
+
+[[maybe_unused]] Flag* const g_dispatchers_eager = dispatchers_flag();
+
+}  // namespace
+
+int EventDispatcher::count() {
+  // Latched once: a later flag flip must not strand registered fds on
+  // loops that for_fd would no longer pick for them.
+  static const int n = [] {
+    const int64_t v = dispatchers_flag()->int64_value();
+    return v >= 1 && v <= kMaxDispatchers ? static_cast<int>(v) : 1;
+  }();
+  return n;
+}
+
+EventDispatcher* EventDispatcher::for_fd(int fd) {
   // Deliberately leaked: detached threads outlive static destruction.
-  static EventDispatcher* d = new EventDispatcher();
-  return d;
+  static EventDispatcher* const* loops = [] {
+    auto** all = new EventDispatcher*[kMaxDispatchers];
+    for (int i = 0; i < count(); ++i) {
+      all[i] = new EventDispatcher();
+    }
+    return const_cast<EventDispatcher* const*>(all);
+  }();
+  const int n = count();
+  return loops[fd >= 0 ? fd % n : 0];
 }
 
 EventDispatcher::EventDispatcher() {

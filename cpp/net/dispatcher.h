@@ -1,12 +1,18 @@
 // EventDispatcher — the epoll-ET loop feeding sockets.
 //
 // Parity: brpc EventDispatcher (/root/reference/src/brpc/event_dispatcher.h:
-// 96-197; Run loop event_dispatcher_epoll.cpp:207-213).  The epoll payload
-// is the versioned SocketId, never a pointer, so stale events on recycled
-// slots are dropped by the version check in Socket::Address — the same
-// armor as the reference's IOEventDataId.  Re-designed: the loop runs in a
-// dedicated pthread (the reference runs it in a bthread) since our fibers
-// park on Events, not fds.
+// 96-197; Run loop event_dispatcher_epoll.cpp:207-213; the reference runs
+// -event_dispatcher_num loops and hashes fds across them,
+// event_dispatcher.cpp:113).  The epoll payload is the versioned SocketId,
+// never a pointer, so stale events on recycled slots are dropped by the
+// version check in Socket::Address — the same armor as the reference's
+// IOEventDataId.  Re-designed: each loop runs in a dedicated pthread (the
+// reference runs it in a bthread) since our fibers park on Events, not fds.
+//
+// Sharding: trpc_event_dispatchers (latched at first use, 1..kMaxDispatchers)
+// epoll loops; a socket's fd hashes to its loop via for_fd, so add/remove
+// for one fd always land on the same epoll set.  One loop (the default)
+// keeps the pre-sharding behavior bit-for-bit.
 #pragma once
 
 #include <cstdint>
@@ -15,7 +21,12 @@ namespace trpc {
 
 class EventDispatcher {
  public:
-  static EventDispatcher* instance();
+  static constexpr int kMaxDispatchers = 8;
+
+  // The dispatcher responsible for `fd` (fd-hash over the latched count).
+  static EventDispatcher* for_fd(int fd);
+  // Dispatcher count latched from trpc_event_dispatchers at first use.
+  static int count();
 
   // Registers fd for edge-triggered IN|OUT with the given versioned id.
   int add(int fd, uint64_t socket_id);

@@ -10,6 +10,7 @@
 #include "fiber/fiber.h"
 #include "net/hotpath_stats.h"
 #include "net/protocol.h"
+#include "net/qos.h"
 #include "net/stream.h"
 #include "net/stripe.h"
 
@@ -189,6 +190,9 @@ struct DispatchBatch {
 void cut_and_dispatch(Socket* s, SocketId id) {
   IOBuf& buf = s->read_buf();
   DispatchBatch batch;
+  // QoS lane routing (net/qos.h): hoisted flag read — one atomic load
+  // per sweep, zero when disabled (the default).
+  const int qos_lanes = qos_lane_count();
   while (!buf.empty()) {
     InputMessage* msg = alloc_input_message();
     msg->socket = id;
@@ -271,6 +275,15 @@ void cut_and_dispatch(Socket* s, SocketId id) {
             p->process_request(std::move(*msg));
           }
           free_input_message(msg);
+        } else if (qos_lanes > 0 && msg->meta.type == RpcMeta::kRequest) {
+          // Priority lanes: server-bound requests route through the QoS
+          // weighted-fair dequeue instead of direct batch dispatch, so a
+          // high-priority small RPC dispatches ahead of queued bulk work
+          // even when both arrived in the same sweep (or on different
+          // sockets whose sweeps interleave on one worker).  Responses
+          // never queue here — a parked caller is itself the backpressure.
+          qos_enqueue(qos_lane_for(msg->meta.qos_priority, qos_lanes),
+                      msg->meta.qos_tenant, msg, &process_message_fiber);
         } else {
           batch.msgs[batch.n++] = msg;
           if (batch.n == kDispatchBatch) {

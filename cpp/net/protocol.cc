@@ -70,9 +70,10 @@ std::string encode_meta(const RpcMeta& m) {
   // it as length-gated (they only look past error_text when bytes
   // remain), so presence/absence are both wire-compatible — and the
   // streaming hot path never pays for it.  Layout: trace(24B), then
-  // compress+checksum(6B), then batch streams(4B+), then stripe(24B);
-  // each later group implies every earlier one.
-  const bool has_stripe = m.stripe_id != 0;
+  // compress+checksum(6B), then batch streams(4B+), then stripe(24B),
+  // then qos(3B+); each later group implies every earlier one.
+  const bool has_qos = m.qos_priority != 0 || !m.qos_tenant.empty();
+  const bool has_stripe = m.stripe_id != 0 || has_qos;
   const bool has_streams = !m.extra_streams.empty() || has_stripe;
   const bool has_comp =
       m.compress_type != 0 || m.has_checksum || has_streams;
@@ -96,6 +97,19 @@ std::string encode_meta(const RpcMeta& m) {
           put_u64(&s, m.stripe_id);
           put_u64(&s, m.stripe_offset);
           put_u64(&s, m.stripe_total);
+          if (has_qos) {
+            // Fifth tail group: QoS tag (net/qos.h).  Tenant clamps to
+            // the decoder's 64-byte cap HERE — the single choke point —
+            // so an over-long name set through any surface (e.g. the
+            // public Channel::Options field) truncates instead of
+            // producing a frame the peer rejects as corrupt.
+            s.push_back(static_cast<char>(m.qos_priority));
+            const uint16_t tlen = static_cast<uint16_t>(
+                m.qos_tenant.size() > 64 ? 64 : m.qos_tenant.size());
+            s.push_back(static_cast<char>(tlen & 0xff));
+            s.push_back(static_cast<char>(tlen >> 8));
+            s.append(m.qos_tenant.data(), tlen);
+          }
         }
       }
     }
@@ -163,6 +177,19 @@ bool decode_meta(const std::string& s, RpcMeta* m) {
           m->stripe_offset = get_u64(p + 8);
           m->stripe_total = get_u64(p + 16);
           p += 24;
+          if (end - p >= 3) {  // optional qos group
+            m->qos_priority = static_cast<uint8_t>(*p++);
+            const uint16_t tlen =
+                static_cast<uint16_t>(static_cast<uint8_t>(p[0])) |
+                (static_cast<uint16_t>(static_cast<uint8_t>(p[1])) << 8);
+            p += 2;
+            if (tlen > 64 ||
+                static_cast<uint64_t>(end - p) < static_cast<uint64_t>(tlen)) {
+              return false;
+            }
+            m->qos_tenant.assign(p, tlen);
+            p += tlen;
+          }
         }
       }
     }

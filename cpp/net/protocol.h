@@ -84,6 +84,14 @@ struct RpcMeta {
   uint64_t stripe_id = 0;
   uint64_t stripe_offset = 0;
   uint64_t stripe_total = 0;
+  // QoS tag (net/qos.h): priority class (0 = highest lane; also the
+  // default, so untagged traffic rides the top lane when lanes are on)
+  // and the tenant the request bills to (per-tenant weighted-fair
+  // dequeue + admission control).  Fifth optional wire-tail group —
+  // absent (zero/empty) on untagged traffic, so the default hot path
+  // never pays for it.
+  uint8_t qos_priority = 0;
+  std::string qos_tenant;
   std::string method;
   std::string error_text;
 
@@ -107,6 +115,8 @@ struct RpcMeta {
     stripe_id = 0;
     stripe_offset = 0;
     stripe_total = 0;
+    qos_priority = 0;
+    qos_tenant.clear();
     method.clear();
     error_text.clear();
   }

@@ -1,0 +1,141 @@
+// QoS — per-tenant quality of service: priority dispatch lanes with
+// weighted-fair (deficit round-robin) dequeue, and per-tenant admission
+// control wrapping the concurrency limiters.
+//
+// No direct brpc parity: the reference stops at a per-method
+// ConcurrencyLimiter (concurrency_limiter.h) and a global
+// -max_concurrency.  This subsystem is the "framework owns isolation"
+// argument of "RPC Considered Harmful" (PAPERS.md) made concrete: the
+// messenger routes tagged requests into N priority lanes drained by DRR
+// over per-lane shard queues (tenants hash to shards, shard quanta scale
+// with tenant weight), and a per-Server TenantGovernor admits or sheds
+// each request against its tenant's own limiter BEFORE the handler runs,
+// answering rejects with kEOverloaded — a status the cluster client's
+// retry/hedging/quarantine machinery routes around.
+//
+// Everything here is OFF by default: with trpc_qos_lanes=0 and no
+// governor installed, the hot path reads one flag per sweep and is
+// otherwise byte-identical to the pre-QoS pipeline.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "net/concurrency_limiter.h"
+#include "stat/latency_recorder.h"
+#include "stat/reducer.h"
+
+namespace trpc {
+
+struct InputMessage;
+
+// Lanes are priority classes: lane 0 is served with the largest DRR
+// quantum (highest priority), lane kQosMaxLanes-1 the smallest.  A
+// request's wire tag `qos_priority` IS its lane index (clamped).
+constexpr int kQosMaxLanes = 4;
+// Tenant-hash shard queues per lane: tenants map stably to shards, so a
+// flooding tenant fills ITS shard while the round-robin drain keeps
+// serving the others (approximate per-tenant fairness inside one lane;
+// exact when tenants hash apart, which the weighted quanta then scale).
+constexpr int kQosLaneShards = 8;
+
+// Number of active lanes: the validated reloadable flag trpc_qos_lanes
+// (0 = subsystem disabled, the default; 2..kQosMaxLanes enables).
+int qos_lane_count();
+// Lane for a wire priority tag under `lanes` active lanes (clamped into
+// [0, lanes-1]).
+int qos_lane_for(uint8_t priority, int lanes);
+
+// Enqueues a parsed server-bound request into its lane and drives the
+// weighted-fair drain (the enqueuing read fiber becomes the drainer when
+// the role is free).  Takes ownership of `msg`; `process` consumes and
+// frees it, running on a fiber (fiber_start_batch) exactly like the
+// messenger's direct dispatch path.
+void qos_enqueue(int lane, const std::string& tenant, InputMessage* msg,
+                 void (*process)(void*));
+
+// Live queued depth of one lane (test + /vars surface).
+int64_t qos_lane_depth(int lane);
+
+// Process-global tenant weight registry feeding the shard DRR quanta
+// (installed by TenantGovernor::parse / tests).  Weight clamps to
+// [1, 1024]; unknown tenants weigh 1.
+void qos_set_tenant_weight(const std::string& tenant, int weight);
+int qos_tenant_weight(const std::string& tenant);
+
+// ---- test hooks ---------------------------------------------------------
+// Pause suspends the drain (enqueues accumulate) so ordering tests can
+// stage a backlog; resume with pause(false) then qos_test_drive.
+void qos_test_pause(bool paused);
+// Tap observes each message at POP time (drainer-ordered, pre-fiber):
+// the deterministic view of the weighted-fair dequeue order.
+void qos_test_tap(void (*tap)(int lane, const std::string& tenant));
+// Drives a drain round from a test (same body the enqueue path runs).
+void qos_test_drive(void (*process)(void*));
+
+// ---- stat vars ----------------------------------------------------------
+struct QosVars {
+  Adder enqueued;                      // qos_enqueue_total
+  Adder shed_total;                    // admission rejects, all tenants
+  Adder lane_dispatch[kQosMaxLanes];   // qos_lane_dispatch_total_<i>
+  std::vector<std::unique_ptr<PassiveStatus<long>>> lane_depth;  // gauges
+  std::unique_ptr<PassiveStatus<long>> live_sockets;  // socket-map size
+  QosVars();
+};
+QosVars& qos_vars();
+// Idempotent registration (Server::Start calls it like the hotpath vars).
+void expose_qos_variables();
+
+// ---- per-tenant admission control ---------------------------------------
+// One governor per Server (Server::SetQos).  Spec grammar, ';'-separated
+// tenant clauses:
+//
+//   <tenant>:key=val[,key=val...]
+//     weight=N          DRR shard quantum scale (1..1024, default 1)
+//     limit=<spec>      concurrency_limiter.h grammar: "<N>" | "auto" |
+//                       "timeout:<MS>" (absent = unlimited)
+//
+// The tenant name "*" is the default clause for requests whose tenant has
+// no clause of its own (including the empty tenant).  A request whose
+// tenant resolves to no clause at all is admitted unlimited.
+// Rejections answer kEOverloaded (distinct from the per-method kELimit so
+// clients can tell "this method is saturated" from "this server is
+// shedding your tenant").
+class TenantGovernor {
+ public:
+  struct Entry {
+    std::string name;
+    int weight = 1;
+    std::shared_ptr<ConcurrencyLimiter> limiter;  // null = unlimited
+    // qos_tenant_<name>: per-tenant qps/p50/p99 via the observe plane.
+    std::shared_ptr<LatencyRecorder> latency;
+    // qos_tenant_<name>_shed_total: requests this tenant had shed.
+    std::shared_ptr<Adder> shed;
+  };
+
+  // Returns nullptr and fills *err on a malformed spec (a typo must not
+  // silently mean "no QoS").  Empty spec → nullptr with empty *err
+  // (governor removed).
+  static std::shared_ptr<TenantGovernor> parse(const std::string& spec,
+                                               std::string* err);
+
+  // Admission for one request.  Returns the entry that admitted it (to
+  // pair with on_response exactly once), nullptr with *admitted=true when
+  // no clause applies (unlimited), or *admitted=false when the tenant's
+  // limiter shed the request (no on_response then).
+  Entry* admit(const std::string& tenant, bool* admitted);
+  void on_response(Entry* e, int64_t latency_us, bool error);
+
+  const std::vector<std::unique_ptr<Entry>>& entries() const {
+    return entries_;
+  }
+
+ private:
+  Entry* find(const std::string& tenant);
+  std::vector<std::unique_ptr<Entry>> entries_;  // address-stable
+  Entry* default_entry_ = nullptr;
+};
+
+}  // namespace trpc

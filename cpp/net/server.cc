@@ -210,6 +210,63 @@ int Server::SetMethodMaxConcurrency(const std::string& method,
   return 0;
 }
 
+int Server::SetQos(const std::string& spec) {
+  if (running()) {
+    return -1;
+  }
+  if (spec.empty()) {
+    qos_.reset();
+    return 0;
+  }
+  std::string err;
+  auto gov = TenantGovernor::parse(spec, &err);
+  if (gov == nullptr) {
+    LOG(Warning) << "bad qos spec '" << spec << "': " << err;
+    return -1;  // a typo must not silently mean "no QoS"
+  }
+  qos_ = std::move(gov);
+  return 0;
+}
+
+int Server::set_reuseport_shards(int n) {
+  if (running() || n < 1 || n > kMaxAcceptShards) {
+    return -1;
+  }
+  reuseport_shards_ = n;
+  return 0;
+}
+
+std::vector<uint64_t> Server::accept_counts() const {
+  std::vector<uint64_t> out(static_cast<size_t>(reuseport_shards_), 0);
+  for (size_t i = 0; i < out.size(); ++i) {
+    out[i] = accept_counts_[i].load(std::memory_order_relaxed);
+  }
+  return out;
+}
+
+int Server::install_listener(int fd, int shard) {
+  auto actx = std::make_unique<AcceptCtx>();
+  actx->srv = this;
+  actx->shard = shard;
+  Socket::Options opts;
+  opts.fd = fd;
+  opts.on_readable = &Server::on_acceptable;
+  opts.ctx = actx.get();
+  opts.user_data = this;
+  opts.worker_tag = static_cast<uint8_t>(worker_tag_);
+  SocketId id = 0;
+  if (Socket::Create(opts, &id) != 0) {
+    return -1;
+  }
+  accept_ctxs_.push_back(std::move(actx));
+  if (shard == 0) {
+    listen_id_ = id;
+  } else {
+    extra_listen_ids_.push_back(id);
+  }
+  return 0;
+}
+
 void expose_default_variables();  // stat/default_variables.cc
 void expose_hotpath_variables();  // net/hotpath_stats.cc
 
@@ -223,6 +280,7 @@ int Server::Start(int port) {
   }
   expose_default_variables();
   expose_hotpath_variables();
+  expose_qos_variables();
   if (session_data_factory_ != nullptr && session_data_pool_ == nullptr) {
     session_data_pool_ =
         std::make_unique<SimpleDataPool>(session_data_factory_);
@@ -346,12 +404,17 @@ int Server::Start(int port) {
     }
     int one = 1;
     setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    if (reuseport_shards_ > 1) {
+      // Every shard (this first socket included) must opt in BEFORE bind
+      // for the kernel to co-bind them on one port.
+      setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
+    }
     sockaddr_in sa = {};
     sa.sin_family = AF_INET;
     sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
     sa.sin_port = htons(port > 0 ? static_cast<uint16_t>(port) : 0);
     if (bind(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0 ||
-        listen(fd, 1024) != 0) {
+        listen(fd, 4096) != 0) {
       close(fd);
       return -1;
     }
@@ -360,15 +423,53 @@ int Server::Start(int port) {
     port_ = ntohs(sa.sin_port);
   }
 
-  Socket::Options opts;
-  opts.fd = fd;
-  opts.on_readable = &Server::on_acceptable;
-  opts.ctx = this;
-  opts.user_data = this;
-  opts.worker_tag = static_cast<uint8_t>(worker_tag_);
-  if (Socket::Create(opts, &listen_id_) != 0) {
+  if (install_listener(fd, 0) != 0) {
     close(fd);
     return -1;
+  }
+  if (unix_path_.empty() && reuseport_shards_ > 1) {
+    // Acceptor sharding (the 100k-connection front door): sibling
+    // SO_REUSEPORT listeners on the discovered port.  Distinct fds land
+    // on distinct event-dispatcher epoll threads (dispatcher.h for_fd),
+    // so accept storms parallelize instead of serializing behind one
+    // listener's read fiber.
+    const auto fail_listeners = [this] {
+      // running_ is still false here, so Stop() would no-op: tear the
+      // partially-installed listeners down directly.
+      Socket* s0 = Socket::Address(listen_id_);
+      if (s0 != nullptr) {
+        s0->SetFailed(ESHUTDOWN);
+        s0->Dereference();
+      }
+      for (SocketId id : extra_listen_ids_) {
+        Socket* s = Socket::Address(id);
+        if (s != nullptr) {
+          s->SetFailed(ESHUTDOWN);
+          s->Dereference();
+        }
+      }
+      extra_listen_ids_.clear();
+    };
+    for (int shard = 1; shard < reuseport_shards_; ++shard) {
+      const int sfd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+      if (sfd < 0) {
+        fail_listeners();
+        return -1;
+      }
+      int one = 1;
+      setsockopt(sfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+      setsockopt(sfd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
+      sockaddr_in sa = {};
+      sa.sin_family = AF_INET;
+      sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+      sa.sin_port = htons(static_cast<uint16_t>(port_));
+      if (bind(sfd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0 ||
+          listen(sfd, 4096) != 0 || install_listener(sfd, shard) != 0) {
+        close(sfd);
+        fail_listeners();
+        return -1;
+      }
+    }
   }
   running_.store(true, std::memory_order_release);
   LOG(Info) << "server started on "
@@ -381,6 +482,13 @@ int Server::Start(int port) {
 int Server::StartUnix(const std::string& path) {
   if (path.empty() || path.size() >= sizeof(sockaddr_un{}.sun_path)) {
     return -1;  // over-long paths would silently truncate at bind
+  }
+  if (reuseport_shards_ > 1) {
+    // SO_REUSEPORT sharding is a TCP feature; silently ignoring it here
+    // would leave the operator reading n-1 forever-zero accept counters
+    // as a broken kernel spread instead of an unsupported config.
+    LOG(Warning) << "reuseport shards unsupported on AF_UNIX";
+    return -1;
   }
   unix_path_ = path;
   const int rc = Start(0);
@@ -398,6 +506,13 @@ void Server::Stop() {
   if (s != nullptr) {
     s->SetFailed(ESHUTDOWN);
     s->Dereference();
+  }
+  for (SocketId id : extra_listen_ids_) {
+    Socket* shard = Socket::Address(id);
+    if (shard != nullptr) {
+      shard->SetFailed(ESHUTDOWN);
+      shard->Dereference();
+    }
   }
   if (!unix_path_.empty()) {
     ::unlink(unix_path_.c_str());
@@ -451,8 +566,14 @@ void Server::RunUntilAskedToQuit() {
 
 void Server::track_connection(SocketId id) {
   std::lock_guard<std::mutex> g(conns_mu_);
-  if (conns_.size() > 4096) {  // prune stale versioned ids occasionally
+  if (conns_.size() >= conns_prune_at_) {
+    // Prune stale versioned ids.  The threshold then moves to 2x the
+    // LIVE count: a fixed threshold would re-walk the whole vector on
+    // every accept once past it — O(n^2) across a 100k-connection ramp
+    // (the scale harness found exactly that); doubling amortizes the
+    // walk to O(1) per accept at any connection count.
     std::vector<SocketId> live;
+    live.reserve(conns_.size());
     for (SocketId sid : conns_) {
       Socket* s = Socket::Address(sid);
       if (s != nullptr) {
@@ -461,6 +582,7 @@ void Server::track_connection(SocketId id) {
       }
     }
     conns_.swap(live);
+    conns_prune_at_ = std::max<size_t>(4096, conns_.size() * 2);
   }
   conns_.push_back(id);
 }
@@ -468,7 +590,8 @@ void Server::track_connection(SocketId id) {
 // Accept-until-EAGAIN (acceptor.cpp:251 parity); runs in the listen
 // socket's read fiber.
 void Server::on_acceptable(SocketId id, void* ctx) {
-  Server* srv = static_cast<Server*>(ctx);
+  auto* actx = static_cast<AcceptCtx*>(ctx);
+  Server* srv = actx->srv;
   Socket* listener = Socket::Address(id);
   if (listener == nullptr) {
     return;
@@ -482,6 +605,8 @@ void Server::on_acceptable(SocketId id, void* ctx) {
     if (fd < 0) {
       break;  // EAGAIN or error; ET will refire on next connection
     }
+    srv->accept_counts_[actx->shard].fetch_add(1,
+                                               std::memory_order_relaxed);
     EndPoint peer_ep;
     if (peer_sa.ss_family == AF_UNIX) {
       // Unix peers are anonymous; identify them by our listening path.
@@ -614,6 +739,8 @@ void tstd_process_request(InputMessage&& msg) {
 
   auto* cntl = new Controller();
   cntl->set_method(method);
+  // Surface the request's QoS tag to the handler (and the capi).
+  cntl->set_qos(msg.meta.qos_tenant, msg.meta.qos_priority);
   cntl->call().socket_id = socket_id;
   cntl->call().peer_stream = msg.meta.stream_id;
   cntl->call().peer_stream_window = msg.meta.ack_bytes;
@@ -658,9 +785,23 @@ void tstd_process_request(InputMessage&& msg) {
       prop != nullptr ? prop->latency : nullptr;
   std::shared_ptr<ConcurrencyLimiter> limiter =
       prop != nullptr ? prop->limiter : nullptr;
+  // Per-tenant QoS admission (net/qos.h): runs FIRST so a shed request
+  // never consumes a per-method slot.  A shed answers kEOverloaded —
+  // distinct from kELimit so the cluster client fails over immediately.
+  std::shared_ptr<TenantGovernor> gov =
+      srv != nullptr ? srv->qos_governor() : nullptr;
+  TenantGovernor::Entry* tenant_entry = nullptr;
+  bool tenant_admitted = true;
+  if (gov != nullptr) {
+    tenant_entry = gov->admit(msg.meta.qos_tenant, &tenant_admitted);
+    if (!tenant_admitted) {
+      tenant_entry = nullptr;  // no on_response for shed calls
+    }
+  }
   // Admission gate (MethodStatus parity): rejected calls never reach the
   // handler and answer immediately with kELimit.
-  const bool admitted = limiter == nullptr || limiter->on_request();
+  const bool admitted =
+      tenant_admitted && (limiter == nullptr || limiter->on_request());
   if (!admitted) {
     limiter = nullptr;  // no on_response for rejected calls
   }
@@ -669,7 +810,7 @@ void tstd_process_request(InputMessage&& msg) {
     srv->in_flight.fetch_add(1, std::memory_order_acq_rel);
   }
   Closure done = [socket_id, cid, cntl, response, start_us, srv, lat,
-                  limiter, span] {
+                  limiter, gov, tenant_entry, span] {
     RpcMeta meta;
     meta.type = RpcMeta::kResponse;
     meta.correlation_id = cid;
@@ -719,6 +860,10 @@ void tstd_process_request(InputMessage&& msg) {
     if (limiter != nullptr) {
       limiter->on_response(latency_us, cntl->Failed());
     }
+    if (gov != nullptr && tenant_entry != nullptr) {
+      // Frees the tenant's slot and feeds its qos_tenant_<name> series.
+      gov->on_response(tenant_entry, latency_us, cntl->Failed());
+    }
     if (lat != nullptr) {
       *lat << latency_us;
     }
@@ -749,7 +894,13 @@ void tstd_process_request(InputMessage&& msg) {
     return;
   }
   if (!admitted) {
-    cntl->SetFailed(kELimit, "rejected by concurrency limiter");
+    if (!tenant_admitted) {
+      cntl->SetFailed(kEOverloaded,
+                      "overloaded: tenant '" + msg.meta.qos_tenant +
+                          "' shed by admission control");
+    } else {
+      cntl->SetFailed(kELimit, "rejected by concurrency limiter");
+    }
     done();
     return;
   }

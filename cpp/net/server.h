@@ -23,6 +23,7 @@
 #include "net/controller.h"
 #include "net/data_pool.h"
 #include "net/fault.h"
+#include "net/qos.h"
 #include "net/socket.h"
 #include "stat/latency_recorder.h"
 
@@ -55,6 +56,28 @@ class Server {
   // (AIMD).  Call before Start.
   int SetMethodMaxConcurrency(const std::string& method,
                               const std::string& spec);
+
+  // Per-tenant QoS (net/qos.h TenantGovernor): weighted-fair tenants with
+  // their own admission limiters, shedding kEOverloaded when a tenant is
+  // over its bound.  Spec grammar (';'-separated):
+  //   "<tenant>:weight=N,limit=<spec>" with tenant "*" as the default
+  //   clause; limit uses the concurrency_limiter.h grammar.
+  // Composes with (runs BEFORE) the per-method limiter.  "" removes.
+  // Call before Start.  Returns 0, or -1 on a malformed spec (previous
+  // governor kept).
+  int SetQos(const std::string& spec);
+  std::shared_ptr<TenantGovernor> qos_governor() const { return qos_; }
+
+  // Shards the TCP acceptor across `n` SO_REUSEPORT listen sockets
+  // (1..kMaxAcceptShards), each registered with its own event-dispatcher
+  // slot (trpc_event_dispatchers) so accept storms spread over epoll
+  // threads instead of serializing on one listener.  The kernel spreads
+  // connections across shards by 4-tuple hash.  Call before Start.
+  static constexpr int kMaxAcceptShards = 16;
+  int set_reuseport_shards(int n);
+  int reuseport_shards() const { return reuseport_shards_; }
+  // Connections accepted by each shard (accept-distribution telemetry).
+  std::vector<uint64_t> accept_counts() const;
 
   // Installs connection authentication (auth.h; not owned).  Call before
   // Start.  With an authenticator set, every framed-protocol connection
@@ -252,6 +275,15 @@ class Server {
 
  private:
   static void on_acceptable(SocketId id, void* ctx);
+  // One per listen shard; ctx handed to on_acceptable so the accept
+  // counter attributes to the right shard.  Address-stable (unique_ptr)
+  // for the sockets' lifetime.
+  struct AcceptCtx {
+    Server* srv;
+    int shard;
+  };
+  // Creates + registers one listen socket for `fd` as shard `shard`.
+  int install_listener(int fd, int shard);
   int64_t start_time_us_ = 0;
   std::unique_ptr<RecordWriter> dump_writer_;
   FiberMutex dump_mu_;
@@ -284,11 +316,19 @@ class Server {
   };
   std::vector<RestfulRule> restful_;
   SocketId listen_id_ = 0;
+  // REUSEPORT shards beyond the first (listen_id_ stays shard 0 so the
+  // single-listener paths are untouched).
+  std::vector<SocketId> extra_listen_ids_;
+  std::vector<std::unique_ptr<AcceptCtx>> accept_ctxs_;
+  std::atomic<uint64_t> accept_counts_[kMaxAcceptShards] = {};
+  int reuseport_shards_ = 1;
+  std::shared_ptr<TenantGovernor> qos_;
   int port_ = -1;
   std::string unix_path_;  // non-empty when listening on AF_UNIX
   std::atomic<bool> running_{false};
   std::mutex conns_mu_;
   std::vector<SocketId> conns_;      // stale ids harmless (versioned)
+  size_t conns_prune_at_ = 4096;     // doubles with the live set (scale)
   std::vector<SocketId> drain_ids_;  // failed at Stop; awaited in ~Server
   // Server-side fault points; kServer scope rejects transport-only specs
   // that could never fire here (silent no-op prevention).

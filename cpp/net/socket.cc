@@ -47,7 +47,7 @@ int Socket::Create(const Options& opts, SocketId* out) {
   *out = pack(ver, 0) | slot;  // ver<<32 | slot (ref bits reused as slot)
   if (s->fd_ >= 0) {
     make_nonblocking(s->fd_);
-    if (EventDispatcher::instance()->add(s->fd_, *out) != 0) {
+    if (EventDispatcher::for_fd(s->fd_)->add(s->fd_, *out) != 0) {
       LOG(Error) << "epoll add failed for fd " << s->fd_;
     }
   }
@@ -199,7 +199,7 @@ void Socket::Dereference() {
     // Last reference.  SetFailed already bumped the version to even, so
     // Address() cannot revive this slot — teardown is single-threaded here.
     if (fd_ >= 0) {
-      EventDispatcher::instance()->remove(fd_);
+      EventDispatcher::for_fd(fd_)->remove(fd_);
       close(fd_);
       fd_ = -1;
     }
@@ -368,7 +368,7 @@ int Socket::ensure_connected() {
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     }
     fd_ = fd;
-    if (EventDispatcher::instance()->add(fd_, id()) != 0) {
+    if (EventDispatcher::for_fd(fd_)->add(fd_, id()) != 0) {
       return -1;
     }
   }

@@ -59,25 +59,31 @@ def _ctest_targets() -> list:
     return names
 
 
-@pytest.mark.slow
-def test_stripe_under_tsan():
-    """ISSUE 5 satellite: the stripe layer's new shared state — the
-    reassembly map, per-entry lander counts, the caller-landing registry
-    and the arena big-block pool — all run hot across parse fibers,
-    landing fibers and completion paths.  Build the runtime + test_stripe
-    with ThreadSanitizer (the repo's existing TSan config: cpp/tsan.supp)
-    and run every stripe case under it."""
+def _build_direct(cxx, test_src: str, exe_name: str, *, tsan: bool):
+    """Builds one cpp/tests binary straight with the compiler (no cmake),
+    against a freshly-ensured runtime library: native builds link the
+    regular libtpurpc.so, TSan builds compile the whole runtime into
+    build/tsan_obj and link libtpurpc_tsan.so."""
     import os
 
-    cxx = shutil.which("g++") or shutil.which("c++")
-    if cxx is None:
-        pytest.skip("no C++ compiler")
-    probe = subprocess.run(
-        [cxx, "-fsanitize=thread", "-x", "c++", "-", "-o", "/dev/null"],
-        input="int main(){return 0;}", capture_output=True, text=True)
-    if probe.returncode != 0:
-        pytest.skip("toolchain lacks ThreadSanitizer runtime")
     cpp = REPO / "cpp"
+    if not tsan:
+        from brpc_tpu.rpc._lib import ensure_built
+
+        ensure_built()
+        exe = BUILD / exe_name
+        src = cpp / "tests" / test_src
+        if (not exe.exists()
+                or exe.stat().st_mtime < max(
+                    src.stat().st_mtime,
+                    (BUILD / "libtpurpc.so").stat().st_mtime)):
+            subprocess.run(
+                [cxx, "-std=c++20", "-O1", "-g", "-fno-omit-frame-pointer",
+                 "-I", str(cpp), str(src), "-L", str(BUILD),
+                 f"-Wl,-rpath,{BUILD}", "-l:libtpurpc.so", "-lpthread",
+                 "-o", str(exe)],
+                check=True, capture_output=True, text=True)
+        return exe
     obj_dir = BUILD / "tsan_obj"
     obj_dir.mkdir(parents=True, exist_ok=True)
     sources = []
@@ -98,25 +104,106 @@ def test_stripe_under_tsan():
         return str(obj)
 
     from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=os.cpu_count() or 4) as pool:
+        objs = list(pool.map(compile_one, sources))
+    lib = BUILD / "libtpurpc_tsan.so"
+    subprocess.run(
+        [cxx, "-shared", "-fsanitize=thread", "-o", str(lib), *objs,
+         "-lpthread", "-lrt", "-lz", "-ldl"],
+        check=True, capture_output=True, text=True)
+    exe = BUILD / exe_name
+    subprocess.run(
+        [cxx, *flags, str(cpp / "tests" / test_src),
+         "-L", str(BUILD), f"-Wl,-rpath,{BUILD}", "-l:libtpurpc_tsan.so",
+         "-lpthread", "-o", str(exe)],
+        check=True, capture_output=True, text=True)
+    return exe
+
+
+def test_qos_cpp_suite_native():
+    """ISSUE 6: the cpp QoS suite (weighted-fair lane ordering,
+    per-tenant fairness, starvation-freedom, kEOverloaded shed + cluster
+    failover, REUSEPORT accept distribution, default-off byte-identity,
+    the high-priority p99 guard) gates tier-1 even without cmake — built
+    straight with the compiler against libtpurpc.so."""
+    import shutil as _sh
+
+    cxx = _sh.which("g++") or _sh.which("c++")
+    if cxx is None:
+        pytest.skip("no C++ compiler")
     try:
-        with ThreadPoolExecutor(max_workers=os.cpu_count() or 4) as pool:
-            objs = list(pool.map(compile_one, sources))
-        lib = BUILD / "libtpurpc_tsan.so"
-        subprocess.run(
-            [cxx, "-shared", "-fsanitize=thread", "-o", str(lib), *objs,
-             "-lpthread", "-lrt", "-lz", "-ldl"],
-            check=True, capture_output=True, text=True)
-        exe = BUILD / "test_stripe_tsan"
-        subprocess.run(
-            [cxx, *flags, str(cpp / "tests" / "test_stripe.cc"),
-             "-L", str(BUILD), f"-Wl,-rpath,{BUILD}", "-l:libtpurpc_tsan.so",
-             "-lpthread", "-o", str(exe)],
-            check=True, capture_output=True, text=True)
+        exe = _build_direct(cxx, "test_qos.cc", "test_qos_native",
+                            tsan=False)
+    except subprocess.CalledProcessError as e:
+        pytest.fail(f"test_qos build failed:\n{e.stderr[-4000:]}")
+    out = subprocess.run([str(exe)], capture_output=True, text=True,
+                         timeout=420)
+    assert out.returncode == 0, (
+        f"qos suite failed (rc={out.returncode}):\n{out.stderr[-8000:]}")
+
+
+@pytest.mark.slow
+def test_qos_under_tsan():
+    """ISSUE 6 satellite: the QoS layer's shared state — lane shard
+    queues, the drainer role handoff, the tenant weight registry, the
+    governor's limiters fed from handler completion fibers — all run hot
+    across read fibers and dispatch fibers.  Build runtime + test_qos
+    with ThreadSanitizer and run every qos-prefixed case (the
+    timing-bound p99 case stays native)."""
+    import os
+
+    cxx = shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        pytest.skip("no C++ compiler")
+    probe = subprocess.run(
+        [cxx, "-fsanitize=thread", "-x", "c++", "-", "-o", "/dev/null"],
+        input="int main(){return 0;}", capture_output=True, text=True)
+    if probe.returncode != 0:
+        pytest.skip("toolchain lacks ThreadSanitizer runtime")
+    try:
+        exe = _build_direct(cxx, "test_qos.cc", "test_qos_tsan", tsan=True)
     except subprocess.CalledProcessError as e:
         pytest.fail(f"TSan build failed:\n{e.stderr[-4000:]}")
     env = dict(os.environ)
     env["TSAN_OPTIONS"] = (
-        f"suppressions={cpp / 'tsan.supp'} halt_on_error=0 exitcode=66")
+        f"suppressions={REPO / 'cpp' / 'tsan.supp'} halt_on_error=0 "
+        "exitcode=66")
+    out = subprocess.run([str(exe), "qos"], capture_output=True,
+                         text=True, timeout=900, env=env)
+    assert out.returncode == 0, (
+        f"qos tests under TSan failed (rc={out.returncode}):\n"
+        f"{out.stderr[-8000:]}")
+    assert "WARNING: ThreadSanitizer" not in out.stderr, (
+        f"TSan reported races in the QoS layer:\n{out.stderr[-8000:]}")
+
+
+@pytest.mark.slow
+def test_stripe_under_tsan():
+    """ISSUE 5 satellite: the stripe layer's new shared state — the
+    reassembly map, per-entry lander counts, the caller-landing registry
+    and the arena big-block pool — all run hot across parse fibers,
+    landing fibers and completion paths.  Build the runtime + test_stripe
+    with ThreadSanitizer (the repo's existing TSan config: cpp/tsan.supp)
+    and run every stripe case under it."""
+    import os
+
+    cxx = shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        pytest.skip("no C++ compiler")
+    probe = subprocess.run(
+        [cxx, "-fsanitize=thread", "-x", "c++", "-", "-o", "/dev/null"],
+        input="int main(){return 0;}", capture_output=True, text=True)
+    if probe.returncode != 0:
+        pytest.skip("toolchain lacks ThreadSanitizer runtime")
+    try:
+        exe = _build_direct(cxx, "test_stripe.cc", "test_stripe_tsan",
+                            tsan=True)
+    except subprocess.CalledProcessError as e:
+        pytest.fail(f"TSan build failed:\n{e.stderr[-4000:]}")
+    env = dict(os.environ)
+    env["TSAN_OPTIONS"] = (
+        f"suppressions={REPO / 'cpp' / 'tsan.supp'} halt_on_error=0 "
+        "exitcode=66")
     # Every stripe-prefixed case (the timing-bound p99 test stays native).
     out = subprocess.run([str(exe), "stripe"], capture_output=True,
                          text=True, timeout=900, env=env)

@@ -231,6 +231,71 @@ def test_striped_large_echo_floor(size_mb):
         srv.stop()
 
 
+def test_load_orchestrator_smoke():
+    """ISSUE 6 satellite: the 100k-connection scale path must not rot —
+    the orchestrator's bounded smoke mode (a few thousand connections,
+    REUSEPORT shards + multi-dispatcher, mixed 1KB/4MB) runs end to end
+    with zero wedged connections and reports socket-map memory.  Where
+    the box's fd limits cannot even cover the smoke target, the
+    orchestrator scales down and says so (fd_limited) instead of lying."""
+    import os
+    import pathlib
+    import sys
+
+    tool = pathlib.Path(__file__).resolve().parent.parent / "tools" / \
+        "load_orchestrator.py"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, str(tool), "--smoke", "--json"],
+        capture_output=True, text=True, timeout=180, env=env)
+    line = next((ln for ln in out.stdout.splitlines()[::-1]
+                 if ln.startswith("{")), None)
+    assert line, f"orchestrator produced no report:\n{out.stdout}\n" \
+                 f"{out.stderr[-2000:]}"
+    report = json.loads(line)
+    assert out.returncode == 0, f"orchestrator failed: {report}"
+    assert report["wedged"] == 0, report
+    assert report["echoed"] == report["connected"] >= 1000, report
+    peak = report["server_peak"]
+    assert peak["live_sockets"] >= report["connected"], report
+    assert peak["rss_kb"] > 0, "socket-map memory must be reported"
+    assert sum(peak["accept_counts"]) >= report["connected"], report
+
+
+def test_qos_1kb_p99_within_2x_under_saturation():
+    """ISSUE 6 acceptance: under saturating low-priority 64MB streams
+    plus an admission-limited background tenant, the high-priority 1KB
+    p99 stays within 2x its unloaded value.  Reuses the bench child
+    (BENCH_QOS) so the asserted number and the published bench row are
+    the SAME measurement.  A small absolute floor (1.5ms) absorbs the
+    degenerate case where the unloaded p99 lands unrealistically low on
+    an idle CI box — the 2x criterion dominates everywhere real."""
+    import os
+    import pathlib
+    import sys
+
+    bench = pathlib.Path(__file__).resolve().parent.parent / "bench.py"
+    env = dict(os.environ)
+    env["BENCH_QOS"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    row = None
+    for _ in range(2):  # one retry: the measurement is timing-bound
+        out = subprocess.run([sys.executable, str(bench)],
+                             capture_output=True, text=True, timeout=120,
+                             env=env)
+        line = next((ln for ln in out.stdout.splitlines()[::-1]
+                     if ln.startswith("{")), None)
+        assert line, f"qos bench child produced no row:\n{out.stderr[-2000:]}"
+        row = json.loads(line)
+        bound = max(2 * row["p99_unloaded_us"], 1500)
+        if row["p99_loaded_us"] <= bound:
+            return
+    raise AssertionError(
+        f"high-priority 1KB p99 degraded more than 2x under low-priority "
+        f"64MB saturation (QoS lanes failed to isolate): {row}")
+
+
 def test_small_rpc_hot_path_unchanged_by_stripe_layer():
     """Acceptance guard: sub-threshold traffic must leave every stripe
     stat var untouched — the wait-free inline-write small-RPC path is
