@@ -206,6 +206,45 @@ def load_library() -> ctypes.CDLL:
                 ctypes.c_size_t,
             ]
             lib.trpc_endpoint_parse.restype = ctypes.c_int
+            # Device arena + zero-copy surface (capi/base_capi.cc).
+            # Explicit marshalling for every pointer-crossing entry —
+            # tools/lint_trpc.py's capi-gil rule gates this: a missing
+            # restype silently truncates a 64-bit pointer/size_t.
+            lib.trpc_arena_create.argtypes = [
+                ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int,
+            ]
+            lib.trpc_arena_create.restype = ctypes.c_void_p
+            lib.trpc_arena_destroy.argtypes = [ctypes.c_void_p]
+            lib.trpc_arena_destroy.restype = None
+            lib.trpc_arena_alloc.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.trpc_arena_alloc.restype = ctypes.c_void_p
+            lib.trpc_arena_release.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p,
+            ]
+            lib.trpc_arena_release.restype = None
+            lib.trpc_arena_block_size.argtypes = [ctypes.c_void_p]
+            lib.trpc_arena_block_size.restype = ctypes.c_uint32
+            lib.trpc_arena_blocks_in_use.argtypes = [ctypes.c_void_p]
+            lib.trpc_arena_blocks_in_use.restype = ctypes.c_size_t
+            lib.trpc_iobuf_append_block.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32,
+            ]
+            lib.trpc_iobuf_append_block.restype = ctypes.c_int
+            lib.trpc_iobuf_append_user_data.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
+                ctypes.c_void_p,  # deleter fn ptr (CFUNCTYPE or None)
+                ctypes.c_void_p,
+            ]
+            lib.trpc_iobuf_append_user_data.restype = None
+            lib.trpc_channel_call_buf.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.trpc_channel_call_buf.restype = ctypes.c_int
             # RPC surface (capi/rpc_capi.cc).
             lib.trpc_server_create.restype = ctypes.c_void_p
             lib.trpc_server_destroy.argtypes = [ctypes.c_void_p]

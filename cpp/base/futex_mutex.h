@@ -1,0 +1,67 @@
+// Futex mutex (Drepper, "Futexes Are Tricky" §6): 0 free, 1 held,
+// 2 held-with-waiters.  For pthread-blocking critical sections that are
+// shared between FIBERS and plain pthreads and must stay analyzable
+// under TSan: gcc-10 libtsan loses the pthread_mutex interceptor
+// pairing across __tsan_switch_to_fiber (a mutex locked from a fiber
+// came back "already destroyed", yielding phantom double-lock/data-race
+// reports on textbook lock-protected state — the old blanket
+// TimerThread suppressions, ISSUE 7).  Plain atomics carry real
+// acquire/release edges TSan models natively, with no interceptor to
+// confuse.  Not a FiberMutex: blocking parks the calling PTHREAD, so
+// keep critical sections short; use fiber/sync.h when the waiter should
+// yield its worker instead.
+#pragma once
+
+#include <linux/futex.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <ctime>
+
+namespace trpc {
+
+inline int futex_word_op(std::atomic<uint32_t>* addr, int op, uint32_t val,
+                         const timespec* timeout) {
+  return syscall(SYS_futex, reinterpret_cast<int*>(addr), op, val, timeout,
+                 nullptr, 0);
+}
+
+// The kernel treats the futex word as an opaque 32-bit value; signed
+// callers (ParkingLot's seq_) share the same wrapper.
+inline int futex_word_op(std::atomic<int>* addr, int op, int val,
+                         const timespec* timeout) {
+  return syscall(SYS_futex, reinterpret_cast<int*>(addr), op, val, timeout,
+                 nullptr, 0);
+}
+
+class FutexMutex {
+ public:
+  void lock() {
+    uint32_t c = 0;
+    if (word_.compare_exchange_strong(c, 1, std::memory_order_acquire,
+                                      std::memory_order_relaxed)) {
+      return;
+    }
+    do {
+      if (c == 2 ||
+          word_.compare_exchange_strong(c, 2, std::memory_order_acquire,
+                                        std::memory_order_relaxed)) {
+        futex_word_op(&word_, FUTEX_WAIT_PRIVATE, 2, nullptr);
+      }
+      c = 0;
+    } while (!word_.compare_exchange_strong(c, 2, std::memory_order_acquire,
+                                            std::memory_order_relaxed));
+  }
+
+  void unlock() {
+    if (word_.exchange(0, std::memory_order_release) == 2) {
+      futex_word_op(&word_, FUTEX_WAKE_PRIVATE, 1, nullptr);
+    }
+  }
+
+ private:
+  std::atomic<uint32_t> word_{0};
+};
+
+}  // namespace trpc

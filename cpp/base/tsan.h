@@ -1,0 +1,59 @@
+// ThreadSanitizer annotation shim — the ONE place the runtime talks to
+// TSan (ISSUE 7: suppressions → fixes).
+//
+// Two families:
+//
+//  * Fiber identity (__tsan_create/destroy/switch_to_fiber): without
+//    them TSan sees one pthread's shadow stack teleporting between
+//    fiber stacks and reports phantom races.  Used by the scheduler's
+//    context switches (fiber/scheduler.cc).
+//
+//  * Explicit happens-before edges (__tsan_acquire/__tsan_release):
+//    for handoffs whose ordering is real but flows through a channel
+//    TSan cannot model — a futex syscall pair (ParkingLot park/wake,
+//    the timer shard sleep), a kernel-mediated epoll edge (socket
+//    connect → first readable), or a fiber-sync mutex whose ownership
+//    transfers across __tsan_switch_to_fiber.  TRPC_TSAN_RELEASE(addr)
+//    on the publishing side + TRPC_TSAN_ACQUIRE(addr) on the observing
+//    side draw the edge on `addr` exactly where the kernel guarantees
+//    it; both compile to nothing outside -fsanitize=thread builds.
+//
+// Policy: prefer restructuring onto plain atomics (TSan models
+// acquire/release natively — see the timer-shard futex mutex) over
+// annotations, and annotations over cpp/tsan.supp lines.  Every
+// remaining suppression must cite the unmodeled edge it papers over.
+#pragma once
+
+#include <cstddef>
+
+// gcc spells it __SANITIZE_THREAD__; clang only __has_feature.
+#if defined(__SANITIZE_THREAD__)
+#define TRPC_TSAN 1
+#elif defined(__has_feature)
+#if __has_feature(thread_sanitizer)
+#define TRPC_TSAN 1
+#endif
+#endif
+#ifndef TRPC_TSAN
+#define TRPC_TSAN 0
+#endif
+
+#if TRPC_TSAN
+extern "C" {
+void* __tsan_get_current_fiber();
+void* __tsan_create_fiber(unsigned flags);
+void __tsan_destroy_fiber(void* fiber);
+void __tsan_switch_to_fiber(void* fiber, unsigned flags);
+void __tsan_acquire(void* addr);
+void __tsan_release(void* addr);
+}
+#define TRPC_TSAN_ACQUIRE(addr) __tsan_acquire((void*)(addr))
+#define TRPC_TSAN_RELEASE(addr) __tsan_release((void*)(addr))
+#else
+static inline void* __tsan_get_current_fiber() { return nullptr; }
+static inline void* __tsan_create_fiber(unsigned) { return nullptr; }
+static inline void __tsan_destroy_fiber(void*) {}
+static inline void __tsan_switch_to_fiber(void*, unsigned) {}
+#define TRPC_TSAN_ACQUIRE(addr) ((void)0)
+#define TRPC_TSAN_RELEASE(addr) ((void)0)
+#endif

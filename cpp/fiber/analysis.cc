@@ -1,0 +1,382 @@
+#include "fiber/analysis.h"
+
+#include <mutex>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+#include "base/flags.h"
+#include "base/symbolize.h"
+#include "fiber/fiber.h"
+#include "stat/reducer.h"
+
+namespace trpc {
+namespace analysis {
+
+std::atomic<bool> g_enabled{false};
+std::atomic<bool> g_graph_used{false};
+
+namespace {
+
+// ---- flag ---------------------------------------------------------------
+
+Flag* analysis_flag() {
+  static Flag* f = [] {
+    Flag* flag = Flag::define_bool(
+        "trpc_analysis", false,
+        "runtime invariant checkers: fiber-aware lock-order recording and "
+        "blocking-call-on-dispatch detection (default off; reports via "
+        "analysis_* vars and /analysis)");
+    if (flag != nullptr) {
+      flag->set_validator([](const std::string& v) {
+        return v == "true" || v == "false" || v == "1" || v == "0" ||
+               v == "on" || v == "off";
+      });
+      flag->on_update([](Flag* self) {
+        g_enabled.store(self->bool_value(), std::memory_order_release);
+      });
+    }
+    return flag;
+  }();
+  return f;
+}
+
+}  // namespace
+
+void ensure_registered();
+
+namespace {
+
+// Eager registration so /flags?setvalue can flip the flag and /vars can
+// scrape the (zero) counters before the first /analysis request.
+[[maybe_unused]] const bool g_eager = [] {
+  ensure_registered();
+  return true;
+}();
+
+// ---- vars ---------------------------------------------------------------
+
+struct AnalysisVars {
+  Adder cycles;
+  Adder violations;
+  AnalysisVars() {
+    cycles.expose("analysis_lock_cycles",
+                  "lock-order inversions (acquisition-graph cycles) found "
+                  "by the trpc_analysis lock recorder");
+    violations.expose("analysis_blocking_violations",
+                      "blocking calls observed inside a dispatch scope "
+                      "(messenger inline window / QoS drainer role) by "
+                      "the trpc_analysis checker");
+  }
+};
+
+AnalysisVars& avars() {
+  // Deliberately leaked: hooks may fire during static destruction.
+  static AnalysisVars* v = new AnalysisVars();
+  return *v;
+}
+
+// ---- per-context state (fiber-local, pthread fallback) ------------------
+
+constexpr int kMaxHeld = 16;
+
+struct Ctx {
+  void* held[kMaxHeld];
+  void* sites[kMaxHeld];
+  int n_held = 0;
+  int dispatch_depth = 0;
+  int bounded_depth = 0;  // inside a ScopedBoundedWait (lock slow path)
+  const char* dispatch_what = nullptr;
+};
+
+void ctx_dtor(void* p) { delete static_cast<Ctx*>(p); }
+
+fls_key_t ctx_key() {
+  static fls_key_t key = [] {
+    fls_key_t k;
+    fls_key_create(&k, ctx_dtor);
+    return k;
+  }();
+  return key;
+}
+
+Ctx* ctx() {
+  if (in_fiber()) {
+    void* v = fls_get(ctx_key());
+    if (v == nullptr) {
+      v = new Ctx();
+      fls_set(ctx_key(), v);
+    }
+    return static_cast<Ctx*>(v);
+  }
+  static thread_local Ctx c;
+  return &c;
+}
+
+// ---- acquisition graph --------------------------------------------------
+
+constexpr size_t kMaxNodes = 4096;    // runaway-growth backstop
+constexpr size_t kMaxReports = 32;    // report ring depth
+
+struct Graph {
+  std::mutex mu;
+  // lock instance → set of lock instances acquired while holding it.
+  std::unordered_map<void*, std::unordered_set<void*>> edges;
+  // acquisition site per lock (latest wins; for reports only).
+  std::unordered_map<void*, void*> site_of;
+  // edges already reported as cycle-closing (one report per held→lock
+  // pair), keyed like `edges` so destroy can purge them — a stale entry
+  // would silently swallow a real inversion between NEW locks recycled
+  // onto the same addresses.
+  std::unordered_map<void*, std::unordered_set<void*>> reported;
+  std::vector<std::string> cycle_reports;
+  std::vector<std::string> blocking_reports;
+  uint64_t cycles = 0;
+  uint64_t violations = 0;
+  // kMaxNodes hit: edge recording stopped, "0 inversions" no longer
+  // means "checked clean" — surfaced in report() so an operator can
+  // tell saturation from a clean bill.
+  bool saturated = false;
+};
+
+Graph& graph() {
+  // Deliberately leaked: fibers may release locks during static
+  // destruction.
+  static Graph* g = new Graph();
+  return *g;
+}
+
+// Iterative DFS under graph().mu: is `to` reachable from `from`?
+// Explicit worklist, NOT recursion — this runs on fiber stacks (1MB)
+// and the graph cap is 4096 nodes.  Path reconstructed via parent map
+// for the report (reverse order: from → … → to pushed back-to-front).
+bool reachable(const Graph& g, void* from, void* to,
+               std::vector<void*>* path, std::unordered_set<void*>* seen) {
+  std::unordered_map<void*, void*> parent;
+  std::vector<void*> work{from};
+  seen->insert(from);
+  while (!work.empty()) {
+    void* cur = work.back();
+    work.pop_back();
+    if (cur == to) {
+      for (void* p = cur; ; p = parent[p]) {
+        path->push_back(p);
+        if (p == from) {
+          break;
+        }
+      }
+      return true;
+    }
+    auto it = g.edges.find(cur);
+    if (it == g.edges.end()) {
+      continue;
+    }
+    for (void* next : it->second) {
+      if (seen->insert(next).second) {
+        parent[next] = cur;
+        work.push_back(next);
+      }
+    }
+  }
+  return false;
+}
+
+std::string site_str(const Graph& g, void* lock) {
+  auto it = g.site_of.find(lock);
+  std::string s = "lock@";
+  char buf[32];
+  snprintf(buf, sizeof(buf), "%p", lock);
+  s += buf;
+  if (it != g.site_of.end()) {
+    s += " acquired at " + symbolize_addr(it->second);
+  }
+  return s;
+}
+
+}  // namespace
+
+void ensure_registered() {
+  analysis_flag();
+  avars();  // scrapeable at 0, not only after the first finding
+}
+
+void on_lock_acquired(void* lock, void* site) {
+  Ctx* c = ctx();
+  if (c->n_held > 0) {
+    Graph& g = graph();
+    std::lock_guard<std::mutex> lk(g.mu);
+    if (g.edges.size() >= kMaxNodes) {
+      g.saturated = true;
+    } else {
+      // Armed under g.mu, only when the graph actually gains state —
+      // destructors need the purge path exactly while nodes exist.
+      g_graph_used.store(true, std::memory_order_relaxed);
+      g.site_of[lock] = site;
+      for (int i = 0; i < c->n_held; ++i) {
+        void* held = c->held[i];
+        if (held == lock) {
+          continue;  // recursive re-acquire reports elsewhere
+        }
+        if (!g.edges[held].insert(lock).second) {
+          continue;  // known edge, already cycle-checked
+        }
+        // New edge held→lock: a path lock→…→held makes it a cycle.
+        std::vector<void*> path;
+        std::unordered_set<void*> seen;
+        if (reachable(g, lock, held, &path, &seen) &&
+            g.reported[held].insert(lock).second) {
+          ++g.cycles;
+          avars().cycles << 1;
+          std::string r = "lock-order inversion: holding " +
+                          site_str(g, held) + " while acquiring " +
+                          site_str(g, lock) + "; reverse path:";
+          for (auto pit = path.rbegin(); pit != path.rend(); ++pit) {
+            r += "\n    " + site_str(g, *pit);
+          }
+          if (g.cycle_reports.size() < kMaxReports) {
+            g.cycle_reports.push_back(std::move(r));
+          }
+        }
+      }
+    }
+  }
+  if (c->n_held < kMaxHeld) {
+    c->held[c->n_held] = lock;
+    c->sites[c->n_held] = site;
+    ++c->n_held;
+  }
+}
+
+void on_lock_released(void* lock) {
+  Ctx* c = ctx();
+  for (int i = c->n_held - 1; i >= 0; --i) {  // newest first (stack-ish)
+    if (c->held[i] == lock) {
+      for (int j = i; j < c->n_held - 1; ++j) {
+        c->held[j] = c->held[j + 1];
+        c->sites[j] = c->sites[j + 1];
+      }
+      --c->n_held;
+      return;
+    }
+  }
+}
+
+void on_lock_destroyed(void* lock) {
+  Graph& g = graph();
+  std::lock_guard<std::mutex> lk(g.mu);
+  if (g.edges.empty() && g.site_of.empty()) {
+    return;
+  }
+  g.edges.erase(lock);
+  for (auto& [from, outs] : g.edges) {
+    outs.erase(lock);
+  }
+  g.site_of.erase(lock);
+  g.reported.erase(lock);
+  for (auto& [from, outs] : g.reported) {
+    outs.erase(lock);
+  }
+  if (g.edges.empty() && g.site_of.empty()) {
+    // Graph drained: restore the destructor fast path (one relaxed load,
+    // no global mutex) — otherwise a single flag toggle would serialize
+    // every FiberMutex teardown for the rest of the process.
+    g_graph_used.store(false, std::memory_order_relaxed);
+  }
+}
+
+const char* dispatch_scope_enter(const char* what) {
+  Ctx* c = ctx();
+  ++c->dispatch_depth;
+  const char* prev = c->dispatch_what;
+  c->dispatch_what = what;
+  return prev;
+}
+
+void dispatch_scope_exit(const char* prev) {
+  Ctx* c = ctx();
+  if (c->dispatch_depth > 0) {
+    --c->dispatch_depth;
+  }
+  c->dispatch_what = c->dispatch_depth == 0 ? nullptr : prev;
+}
+
+bool in_dispatch_scope() { return ctx()->dispatch_depth > 0; }
+
+void bounded_wait_enter() { ++ctx()->bounded_depth; }
+
+void bounded_wait_exit() {
+  Ctx* c = ctx();
+  if (c->bounded_depth > 0) {
+    --c->bounded_depth;
+  }
+}
+
+void on_blocking_point(const char* what) {
+  Ctx* c = ctx();
+  if (c->dispatch_depth <= 0 || c->bounded_depth > 0) {
+    return;
+  }
+  Graph& g = graph();
+  std::lock_guard<std::mutex> lk(g.mu);
+  ++g.violations;
+  avars().violations << 1;
+  if (g.blocking_reports.size() < kMaxReports) {
+    std::string r = std::string("blocking call (") + what +
+                    ") inside dispatch scope ";
+    r += c->dispatch_what != nullptr ? c->dispatch_what : "?";
+    g.blocking_reports.push_back(std::move(r));
+  }
+}
+
+uint64_t lock_cycles_found() {
+  Graph& g = graph();
+  std::lock_guard<std::mutex> lk(g.mu);
+  return g.cycles;
+}
+
+uint64_t blocking_violations() {
+  Graph& g = graph();
+  std::lock_guard<std::mutex> lk(g.mu);
+  return g.violations;
+}
+
+std::string report() {
+  ensure_registered();
+  Graph& g = graph();
+  std::lock_guard<std::mutex> lk(g.mu);
+  std::string out = "analysis ";
+  out += enabled() ? "ON" : "OFF (set /flags/trpc_analysis?setvalue=true)";
+  out += "\nlock graph: " + std::to_string(g.edges.size()) + " nodes";
+  if (g.saturated) {
+    out += " (SATURATED: node cap hit, edge recording stopped — "
+           "inversion counts are a lower bound)";
+  }
+  out += "\n";
+  out += "lock-order inversions: " + std::to_string(g.cycles) + "\n";
+  out += "blocking-in-dispatch violations: " +
+         std::to_string(g.violations) + "\n";
+  for (const std::string& r : g.cycle_reports) {
+    out += "\n" + r + "\n";
+  }
+  for (const std::string& r : g.blocking_reports) {
+    out += "\n" + r + "\n";
+  }
+  return out;
+}
+
+void reset_for_test() {
+  Graph& g = graph();
+  std::lock_guard<std::mutex> lk(g.mu);
+  g.edges.clear();
+  g.site_of.clear();
+  g.reported.clear();
+  g.cycle_reports.clear();
+  g.blocking_reports.clear();
+  g.cycles = 0;
+  g.violations = 0;
+  g.saturated = false;
+  g_graph_used.store(false, std::memory_order_relaxed);
+}
+
+}  // namespace analysis
+}  // namespace trpc

@@ -1,0 +1,133 @@
+// Runtime invariant checkers (ISSUE 7) — fiber-aware lock-order
+// recording (lockdep-lite) and a blocking-call-on-dispatch-context
+// detector, both behind the default-off reloadable `trpc_analysis` flag.
+//
+// Why in-process instead of leaning on TSan alone: TSan sees memory
+// orderings, not POLICIES.  A lock-order inversion that has not yet
+// deadlocked and a handler that parks a messenger dispatch fiber are
+// both invisible to it, yet both are the exact failure classes of an
+// M:N fiber runtime (the no-pinned-read-fiber invariant behind the
+// messenger's inline windows and the QoS drainer role).  These checkers
+// run in ANY build — including production, flipped on via
+// /flags/trpc_analysis?setvalue=true — and report through vars
+// (analysis_lock_cycles / analysis_blocking_violations) and the
+// /analysis builtin.  With the flag off every hook is one relaxed
+// atomic load + branch; the perf-smoke floors gate that.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+
+namespace trpc {
+namespace analysis {
+
+// Backing switch for the reloadable trpc_analysis flag (kept in a plain
+// atomic so the hot-path gate below inlines to one relaxed load; the
+// flag's on_update hook writes it).  Call ensure_registered() once per
+// surface that can flip the flag before first use (builtin /flags does).
+extern std::atomic<bool> g_enabled;
+// Sticky: set on the first recorded acquisition and never cleared, so
+// cold paths (lock destructors) can skip the graph mutex entirely in
+// processes that never armed the mode — while a process that toggled
+// the flag off STILL purges destroyed locks from the populated graph
+// (gating purely on enabled() resurrects address-reuse phantom cycles).
+extern std::atomic<bool> g_graph_used;
+void ensure_registered();
+
+inline bool enabled() {
+  return g_enabled.load(std::memory_order_relaxed);
+}
+
+inline bool graph_used() {
+  return g_graph_used.load(std::memory_order_relaxed);
+}
+
+// ---- fiber-aware lock-order recorder (lockdep-lite) --------------------
+// Instrumented by FiberMutex (fiber/sync.h).  Held-lock stacks live in
+// fiber-local storage (a parked fiber migrating workers keeps its
+// stack); plain pthreads fall back to thread-local.  Each acquisition
+// adds held→new edges to a global acquisition graph; an edge that
+// closes a cycle is a lock-order inversion, reported once per edge with
+// the symbolized acquisition sites.  `site` is the caller's return
+// address (the acquisition site named in reports).
+void on_lock_acquired(void* lock, void* site);
+void on_lock_released(void* lock);
+// Called from the lock's destructor: drops the instance's node and every
+// edge touching it.  Without this, address reuse (a destroyed stack/heap
+// mutex's address recycled by an unrelated one) would stitch phantom
+// cycles between locks that never coexisted, and dead nodes would pin
+// the graph's node cap forever.
+void on_lock_destroyed(void* lock);
+
+// ---- blocking-call-on-dispatch-context detector ------------------------
+// The messenger's inline dispatch windows and the QoS drainer role mark
+// themselves as dispatch scopes; any would-block point reached inside
+// one (Event::wait about to park, ScopedPthreadWait pinning the worker)
+// is a violation of the no-pinned-read-fiber invariant.
+// enter returns the PREVIOUS scope label; pass it back to exit so a
+// nested scope (messenger inline window → QoS drainer role) restores
+// the outer label instead of leaving violations misattributed.
+const char* dispatch_scope_enter(const char* what);
+void dispatch_scope_exit(const char* prev);
+bool in_dispatch_scope();
+void on_blocking_point(const char* what);
+
+// RAII for runtime call sites; no-ops (and no FLS touch) when disabled.
+class ScopedDispatch {
+ public:
+  explicit ScopedDispatch(const char* what) : armed_(enabled()) {
+    if (armed_) {
+      prev_ = dispatch_scope_enter(what);
+    }
+  }
+  ~ScopedDispatch() {
+    if (armed_) {
+      dispatch_scope_exit(prev_);
+    }
+  }
+
+ private:
+  bool armed_;
+  const char* prev_ = nullptr;
+};
+
+// Marks a BOUNDED wait — a park whose duration is capped by framework
+// lock-hold times (FiberMutex's contended slow path), not by arbitrary
+// user code or external events.  The blocking detector exempts these:
+// contended-lock microsleeps inside an inline dispatch window are
+// normal (and showed up 249 times in a 3s echo run when first armed);
+// reporting them would bury the real unbounded parks the
+// no-pinned-read-fiber invariant is about.  Fiber-aware (the flag lives
+// in the same FLS context), so a lock waiter migrating workers keeps it.
+void bounded_wait_enter();
+void bounded_wait_exit();
+class ScopedBoundedWait {
+ public:
+  ScopedBoundedWait() : armed_(enabled()) {
+    if (armed_) {
+      bounded_wait_enter();
+    }
+  }
+  ~ScopedBoundedWait() {
+    if (armed_) {
+      bounded_wait_exit();
+    }
+  }
+
+ private:
+  bool armed_;
+};
+
+// ---- reporting ---------------------------------------------------------
+uint64_t lock_cycles_found();
+uint64_t blocking_violations();
+// Human-readable state dump for the /analysis builtin: enabled bit,
+// graph size, recorded cycles and blocking violations (newest last).
+std::string report();
+// Test support: drop the graph, rings and counters (vars keep their
+// lifetime totals; the report ring is cleared).
+void reset_for_test();
+
+}  // namespace analysis
+}  // namespace trpc

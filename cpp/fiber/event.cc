@@ -8,6 +8,7 @@
 
 #include "base/logging.h"
 #include "base/time.h"
+#include "fiber/analysis.h"
 #include "fiber/scheduler.h"
 #include "fiber/timer.h"
 
@@ -142,6 +143,10 @@ void Event::publish_post(void* a1, void* a2) {
 thread_local bool tls_force_pthread_wait = false;
 
 ScopedPthreadWait::ScopedPthreadWait() : prev_(tls_force_pthread_wait) {
+  // No analysis report here: entering pthread-wait mode only pins the
+  // worker if a wait actually blocks, and Event::wait reports at that
+  // would-block point — a ctor report would double-count it (and fire
+  // even on paths that never block).
   tls_force_pthread_wait = true;
 }
 
@@ -152,6 +157,12 @@ bool in_pthread_wait_mode() { return tls_force_pthread_wait; }
 int Event::wait(uint32_t expected, int64_t deadline_us) {
   if (value.load(std::memory_order_acquire) != expected) {
     return EWOULDBLOCK;
+  }
+  // Invariant checker (ISSUE 7): about to actually block — a park inside
+  // a dispatch scope (messenger inline window, QoS drainer role) pins
+  // connection/lane dispatch behind arbitrary wait time.  Report-only.
+  if (analysis::enabled() && analysis::in_dispatch_scope()) {
+    analysis::on_blocking_point("Event::wait");
   }
   Worker* w = tls_worker;
   if (w != nullptr && w->current() != nullptr && !tls_force_pthread_wait) {

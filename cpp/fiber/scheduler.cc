@@ -7,6 +7,7 @@
 
 #include <cstring>
 
+#include "base/futex_mutex.h"
 #include "base/logging.h"
 #include "base/symbolize.h"
 #include "base/rand.h"
@@ -44,32 +45,16 @@ static inline void __sanitizer_finish_switch_fiber(void*, const void**,
 // TSan fiber-switch annotations: without them TSan sees one pthread's
 // shadow stack teleporting between fiber stacks and reports phantom
 // races.  No-ops unless built with -fsanitize=thread.
-#if defined(__SANITIZE_THREAD__)
-extern "C" {
-void* __tsan_get_current_fiber();
-void* __tsan_create_fiber(unsigned flags);
-void __tsan_destroy_fiber(void* fiber);
-void __tsan_switch_to_fiber(void* fiber, unsigned flags);
-}
-#define TRPC_TSAN_FIBERS 1
-#else
-#define TRPC_TSAN_FIBERS 0
-static inline void* __tsan_get_current_fiber() { return nullptr; }
-static inline void* __tsan_create_fiber(unsigned) { return nullptr; }
-static inline void __tsan_destroy_fiber(void*) {}
-static inline void __tsan_switch_to_fiber(void*, unsigned) {}
-#endif
+// Declarations + the acquire/release edge macros live in the shared shim
+// (base/tsan.h); everything no-ops outside -fsanitize=thread.
+#include "base/tsan.h"
+#define TRPC_TSAN_FIBERS TRPC_TSAN
 
 namespace trpc {
 
 thread_local Worker* tls_worker = nullptr;
 
 namespace {
-
-int sys_futex(std::atomic<int>* addr, int op, int val) {
-  return syscall(SYS_futex, reinterpret_cast<int*>(addr), op, val, nullptr,
-                 nullptr, 0);
-}
 
 using FiberPool = ResourcePool<FiberMeta>;
 
@@ -123,20 +108,29 @@ FiberMeta* fiber_meta_of(fiber_t f) {
 }
 
 void ParkingLot::signal(int n) {
+  // Edge to a waker-to-parked-worker handoff TSan cannot model: the
+  // release below pairs with wait()'s acquire only when the waiter
+  // re-reads seq_, but a worker woken by the FUTEX_WAKE syscall itself
+  // never touches seq_ again — annotate the same edge explicitly so
+  // everything published before signal() is visible after wait().
+  TRPC_TSAN_RELEASE(&seq_);
   seq_.fetch_add(1, std::memory_order_release);
   // seq_ is already bumped, so a worker past its stamp() re-check that
   // has not yet reached FUTEX_WAIT will see the changed word and return
   // without sleeping — skipping the wake syscall when nobody has
   // registered as parked is therefore lost-wakeup-free.
   if (waiters_.load(std::memory_order_acquire) > 0) {
-    sys_futex(&seq_, FUTEX_WAKE_PRIVATE, n);
+    futex_word_op(&seq_, FUTEX_WAKE_PRIVATE, n, nullptr);
   }
 }
 
 void ParkingLot::wait(int stamp) {
   waiters_.fetch_add(1, std::memory_order_acq_rel);
-  sys_futex(&seq_, FUTEX_WAIT_PRIVATE, stamp);
+  futex_word_op(&seq_, FUTEX_WAIT_PRIVATE, stamp, nullptr);
   waiters_.fetch_sub(1, std::memory_order_acq_rel);
+  // Close the signal() edge (see above): the kernel ordered the wake
+  // after the waker's seq_ bump, but no acquire-read of seq_ follows.
+  TRPC_TSAN_ACQUIRE(&seq_);
 }
 
 Scheduler* Scheduler::instance() {

@@ -6,6 +6,7 @@
 #include <cerrno>
 
 #include "base/time.h"
+#include "fiber/analysis.h"
 #include "fiber/event.h"
 #include "stat/profiler.h"
 
@@ -14,36 +15,79 @@ namespace trpc {
 // Futex-style mutex: 0 unlocked, 1 locked, 2 locked with waiters.
 class FiberMutex {
  public:
+  ~FiberMutex() {
+    // Keep the analysis graph honest across address reuse (analysis.h).
+    // Gated on graph_used, NOT enabled(): a process that toggled the
+    // flag off still holds graph nodes that must purge, while one that
+    // never armed the mode pays a relaxed load + untaken branch.
+    if (analysis::graph_used()) {
+      analysis::on_lock_destroyed(this);
+    }
+  }
+
   void lock() {
     uint32_t c = 0;
     if (ev_.value.compare_exchange_strong(c, 1, std::memory_order_acquire,
                                           std::memory_order_relaxed)) {
+      // Lock-order recording (ISSUE 7): one relaxed load + untaken
+      // branch on the uncontended path when trpc_analysis is off.
+      // tracked_ latches the decision for THIS acquisition so a flag
+      // flip while held can't strand a stale held-stack entry (only the
+      // holder touches tracked_, ordered by the mutex itself).
+      if (analysis::enabled()) {
+        tracked_ = true;
+        analysis::on_lock_acquired(this, __builtin_return_address(0));
+      }
       return;
     }
     // Contended slow path: sampled by the contention profiler (parity:
     // bthread/mutex.cpp's lock-wait sampling feeding /contention).
     const int64_t t0 = monotonic_time_us();
-    do {
-      if (c == 2 ||
-          ev_.value.compare_exchange_strong(c, 2, std::memory_order_acquire,
-                                            std::memory_order_relaxed)) {
-        ev_.wait(2, -1);
-      }
-      c = 0;
-    } while (!ev_.value.compare_exchange_strong(c, 2,
-                                                std::memory_order_acquire,
-                                                std::memory_order_relaxed));
+    {
+      // Bounded framework wait: the blocking detector must not count a
+      // contended-lock microsleep as a dispatch-scope park (analysis.h).
+      analysis::ScopedBoundedWait bounded;
+      do {
+        if (c == 2 ||
+            ev_.value.compare_exchange_strong(c, 2,
+                                              std::memory_order_acquire,
+                                              std::memory_order_relaxed)) {
+          ev_.wait(2, -1);
+        }
+        c = 0;
+      } while (!ev_.value.compare_exchange_strong(
+          c, 2, std::memory_order_acquire, std::memory_order_relaxed));
+    }
     contention_record(__builtin_return_address(0),
                       monotonic_time_us() - t0);
+    if (analysis::enabled()) {
+      tracked_ = true;
+      analysis::on_lock_acquired(this, __builtin_return_address(0));
+    }
   }
 
   bool try_lock() {
     uint32_t c = 0;
-    return ev_.value.compare_exchange_strong(c, 1, std::memory_order_acquire,
-                                             std::memory_order_relaxed);
+    if (ev_.value.compare_exchange_strong(c, 1, std::memory_order_acquire,
+                                          std::memory_order_relaxed)) {
+      if (analysis::enabled()) {
+        tracked_ = true;
+        analysis::on_lock_acquired(this, __builtin_return_address(0));
+      }
+      return true;
+    }
+    return false;
   }
 
   void unlock() {
+    // Keyed on the acquisition-time latch, not the live flag: release
+    // bookkeeping must run even if trpc_analysis was flipped off while
+    // this lock was held, or the FLS held-stack entry leaks and seeds
+    // phantom edges after a re-enable.
+    if (tracked_) {
+      tracked_ = false;
+      analysis::on_lock_released(this);
+    }
     if (ev_.value.exchange(0, std::memory_order_release) == 2) {
       ev_.wake(1);
     }
@@ -56,6 +100,9 @@ class FiberMutex {
 
  private:
   Event ev_;
+  // Whether the CURRENT hold was recorded with the analysis plane; holder-
+  // owned (written under the lock), so no atomicity needed.
+  bool tracked_ = false;
 };
 
 // Countdown latch (parity: bthread::CountdownEvent).

@@ -1,14 +1,14 @@
 #include "fiber/timer.h"
 
+#include <linux/futex.h>
 #include <pthread.h>
 
 #include <atomic>
-#include <condition_variable>
-#include <mutex>
 #include <queue>
 #include <unordered_set>
 #include <vector>
 
+#include "base/futex_mutex.h"
 #include "base/time.h"
 
 namespace trpc {
@@ -37,8 +37,14 @@ constexpr int kTimerShards = 1 << kTimerShardBits;
 constexpr uint64_t kShardMask = kTimerShards - 1;
 
 struct Shard {
-  std::mutex mu;
-  std::condition_variable cv;
+  // base/futex_mutex.h, NOT std::mutex: schedule() runs on fibers while
+  // run() is a plain pthread — see the header for the gcc-10 libtsan
+  // interceptor story this sidesteps (ISSUE 7).
+  FutexMutex mu;
+  // Sleep word for the shard loop: bumped (release) by schedule() when a
+  // new earliest deadline lands, so a loop that read its stamp under the
+  // lock can never sleep past it (the futex compare closes the window).
+  std::atomic<uint32_t> wake_seq{0};
   std::priority_queue<TimerEntry, std::vector<TimerEntry>,
                       std::greater<TimerEntry>>
       heap;
@@ -85,28 +91,36 @@ uint64_t TimerThread::schedule(int64_t deadline_us, Fn fn, void* arg) {
   // schedule/unschedule pairs mostly shard-local without any sharing.
   static thread_local uint32_t rr = 0;
   Shard& s = impl_->shards[++rr & kShardMask];
-  std::unique_lock<std::mutex> g(s.mu);
+  s.mu.lock();
   const uint64_t id =
       (s.next_seq++ << kTimerShardBits) | (&s - impl_->shards);
   s.heap.push(TimerEntry{deadline_us, id, fn, arg});
   s.pending.insert(id);
   // Wake the loop if the new timer is the earliest.
-  if (s.heap.top().id == id) {
-    s.cv.notify_one();
+  const bool earliest = s.heap.top().id == id;
+  if (earliest) {
+    s.wake_seq.fetch_add(1, std::memory_order_release);
+  }
+  s.mu.unlock();
+  if (earliest) {
+    futex_word_op(&s.wake_seq, FUTEX_WAKE_PRIVATE, 1, nullptr);
   }
   return id;
 }
 
 bool TimerThread::unschedule(uint64_t id) {
   Shard& s = impl_->shards[id & kShardMask];
-  std::lock_guard<std::mutex> g(s.mu);
-  return s.pending.erase(id) > 0;  // heap entry skipped lazily
+  s.mu.lock();
+  const bool erased = s.pending.erase(id) > 0;  // heap entry skipped lazily
+  s.mu.unlock();
+  return erased;
 }
 
 void TimerThread::run(int shard) {
   Shard& s = impl_->shards[shard];
-  std::unique_lock<std::mutex> g(s.mu);
   while (true) {
+    s.mu.lock();
+    int64_t next_deadline = -1;
     while (!s.heap.empty()) {
       TimerEntry top = s.heap.top();
       if (s.pending.count(top.id) == 0) {  // cancelled
@@ -115,20 +129,32 @@ void TimerThread::run(int shard) {
       }
       const int64_t now = monotonic_time_us();
       if (top.deadline_us > now) {
+        next_deadline = top.deadline_us;
         break;
       }
       s.heap.pop();
       s.pending.erase(top.id);
-      g.unlock();
+      s.mu.unlock();
       top.fn(top.arg);
-      g.lock();
+      s.mu.lock();
     }
-    if (s.heap.empty()) {
-      s.cv.wait(g);
-    } else {
-      s.cv.wait_for(g, std::chrono::microseconds(s.heap.top().deadline_us -
-                                                 monotonic_time_us()));
+    // Stamp read UNDER the lock: a schedule() that lands an earlier
+    // deadline can only run after our unlock, and its bump makes the
+    // futex compare below fail — no sleep can outlive a new earliest.
+    const uint32_t stamp = s.wake_seq.load(std::memory_order_acquire);
+    s.mu.unlock();
+    timespec ts;
+    timespec* tsp = nullptr;
+    if (next_deadline >= 0) {
+      const int64_t left = next_deadline - monotonic_time_us();
+      if (left <= 0) {
+        continue;
+      }
+      ts.tv_sec = left / 1000000;
+      ts.tv_nsec = (left % 1000000) * 1000;
+      tsp = &ts;
     }
+    futex_word_op(&s.wake_seq, FUTEX_WAIT_PRIVATE, stamp, tsp);
   }
 }
 

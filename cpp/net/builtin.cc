@@ -18,6 +18,7 @@
 #include "base/logging.h"
 #include "base/proc.h"
 #include "base/time.h"
+#include "fiber/analysis.h"
 #include "fiber/fiber.h"
 #include "fiber/fid.h"
 #include "net/fault.h"
@@ -309,6 +310,14 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
     *body = contention_dump();
     return true;
   }
+  if (path == "/analysis") {
+    // Runtime invariant checkers (fiber/analysis.h): lock-order
+    // inversions + blocking-in-dispatch violations recorded while the
+    // reloadable trpc_analysis flag is on (flip it via
+    // /flags/trpc_analysis?setvalue=true).
+    *body = analysis::report();
+    return true;
+  }
   if (path == "/pprof/profile") {
     // gperftools-protocol CPU profile: external pprof tooling attaches
     // with `pprof http://host:port/pprof/profile` (pprof_service.h:26).
@@ -503,7 +512,8 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
         "/memory\n/list\n/protobufs\n/index\n"
         "/rpcz[?trace_id=hex&format=json&limit=N]\n"
         "/faults[?set=spec&server=spec&reset=1]\n"
-        "/hotspots[?seconds=N]\n/contention\n/fibers\n/sockets\n/ids\n"
+        "/hotspots[?seconds=N]\n/contention\n/analysis\n/fibers\n"
+        "/sockets\n/ids\n"
         "/vlog[?setlevel=N]\n/dir/<path>\n"
         "/pprof/profile[?seconds=N]\n/pprof/symbol\n/pprof/cmdline\n"
         "/pprof/heap\n";

@@ -94,9 +94,13 @@ void expose_hotpath_variables() {
   hotpath_vars();
   static BulkWakeVars* bw = [] {
     auto* b = new BulkWakeVars();
-    b->batches.expose("fiber_bulk_wake_batches");
-    b->fibers.expose("fiber_bulk_wake_fibers");
-    b->max.expose("fiber_bulk_wake_max");
+    b->batches.expose("fiber_bulk_wake_batches",
+                      "ready_to_run_batch publications (one ParkingLot "
+                      "signal per batch)");
+    b->fibers.expose("fiber_bulk_wake_fibers",
+                     "fibers published through the bulk-wake path");
+    b->max.expose("fiber_bulk_wake_max",
+                  "largest single bulk-wake batch observed");
     return b;
   }();
   (void)bw;

@@ -7,6 +7,7 @@
 #include "base/flags.h"
 #include "base/logging.h"
 #include "base/tls_cache.h"
+#include "fiber/analysis.h"
 #include "fiber/fiber.h"
 #include "net/hotpath_stats.h"
 #include "net/protocol.h"
@@ -164,6 +165,7 @@ struct DispatchBatch {
       // user done() callbacks still divert off this dispatch fiber.
       if (started < n - spawn_from) {
         tls_inline_dispatch = true;
+        analysis::ScopedDispatch scope("messenger exhaustion-inline window");
         for (size_t i = spawn_from + started; i < n; ++i) {
           process_parsed_message(msgs[i]);
         }
@@ -174,8 +176,11 @@ struct DispatchBatch {
     if (inline_msg != nullptr) {
       // Mark the inline window: completion paths divert user callbacks
       // (async done) to their own fiber so arbitrary user code never
-      // parks this connection's dispatch fiber.
+      // parks this connection's dispatch fiber.  The analysis scope
+      // (ISSUE 7) turns any park that slips through into a reported
+      // no-pinned-read-fiber violation.
       tls_inline_dispatch = true;
+      analysis::ScopedDispatch scope("messenger inline-response window");
       process_parsed_message(inline_msg);
       tls_inline_dispatch = false;
     }

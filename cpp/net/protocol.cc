@@ -78,27 +78,29 @@ std::string encode_meta(const RpcMeta& m) {
   const bool has_comp =
       m.compress_type != 0 || m.has_checksum || has_streams;
   if (m.trace_id != 0 || has_comp) {
+    // tail-group 1 (trace): trace/span/parent ids, 24B.
     put_u64(&s, m.trace_id);
     put_u64(&s, m.span_id);
     put_u64(&s, m.parent_span_id);
     if (has_comp) {
+      // tail-group 2 (compress): compress id + checksum presence/value, 6B.
       s.push_back(static_cast<char>(m.compress_type));
       s.push_back(m.has_checksum ? 1 : 0);
       put_u32(&s, m.checksum);
       if (has_streams) {
-        // Third tail group: batch stream offers (count + pairs).
+        // tail-group 3 (streams): batch stream offers (count + pairs).
         put_u32(&s, static_cast<uint32_t>(m.extra_streams.size()));
         for (const auto& [sid, window] : m.extra_streams) {
           put_u64(&s, sid);
           put_u64(&s, window);
         }
         if (has_stripe) {
-          // Fourth tail group: large-message striping (net/stripe.h).
+          // tail-group 4 (stripe): large-message striping (net/stripe.h).
           put_u64(&s, m.stripe_id);
           put_u64(&s, m.stripe_offset);
           put_u64(&s, m.stripe_total);
           if (has_qos) {
-            // Fifth tail group: QoS tag (net/qos.h).  Tenant clamps to
+            // tail-group 5 (qos): QoS tag (net/qos.h).  Tenant clamps to
             // the decoder's 64-byte cap HERE — the single choke point —
             // so an over-long name set through any surface (e.g. the
             // public Channel::Options field) truncates instead of
@@ -150,17 +152,17 @@ bool decode_meta(const std::string& s, RpcMeta* m) {
   }
   m->error_text.assign(p, elen);
   p += elen;
-  if (end - p >= 24) {  // optional trace-context tail
+  if (end - p >= 24) {  // tail-group 1 (trace)
     m->trace_id = get_u64(p);
     m->span_id = get_u64(p + 8);
     m->parent_span_id = get_u64(p + 16);
     p += 24;
-    if (end - p >= 6) {  // optional compress/checksum group
+    if (end - p >= 6) {  // tail-group 2 (compress)
       m->compress_type = static_cast<uint8_t>(*p++);
       m->has_checksum = *p++ != 0;
       m->checksum = get_u32(p);
       p += 4;
-      if (end - p >= 4) {  // optional batch-streams group
+      if (end - p >= 4) {  // tail-group 3 (streams)
         const uint32_t count = get_u32(p);
         p += 4;
         if (count > 256 ||
@@ -172,12 +174,12 @@ bool decode_meta(const std::string& s, RpcMeta* m) {
           m->extra_streams.emplace_back(get_u64(p), get_u64(p + 8));
           p += 16;
         }
-        if (end - p >= 24) {  // optional stripe group
+        if (end - p >= 24) {  // tail-group 4 (stripe)
           m->stripe_id = get_u64(p);
           m->stripe_offset = get_u64(p + 8);
           m->stripe_total = get_u64(p + 16);
           p += 24;
-          if (end - p >= 3) {  // optional qos group
+          if (end - p >= 3) {  // tail-group 5 (qos)
             m->qos_priority = static_cast<uint8_t>(*p++);
             const uint16_t tlen =
                 static_cast<uint16_t>(static_cast<uint8_t>(p[0])) |

@@ -9,6 +9,7 @@
 
 #include "base/flags.h"
 #include "base/logging.h"
+#include "fiber/analysis.h"
 #include "fiber/fiber.h"
 #include "net/protocol.h"
 #include "stat/variable.h"
@@ -151,6 +152,8 @@ QosState& state() {
 int64_t total_depth() {
   int64_t n = 0;
   for (Lane& lane : state().lanes) {
+    // Acquire: pairs with the enqueue's depth increment so a drainer
+    // deciding "empty" cannot miss a message already published.
     n += lane.depth.load(std::memory_order_acquire);
   }
   return n;
@@ -232,10 +235,13 @@ bool drain_all(void (*process)(void*), std::vector<void*>* overflow) {
   QosBatch batch;
   int64_t budget = kDrainBudgetPops;
   bool any = true;
+  // Acquire on paused/depth: the drainer must observe the test pause
+  // flag and enqueue publications from other threads, not cached zeros.
   while (any && budget > 0 && !st.paused.load(std::memory_order_acquire)) {
     any = false;
     for (int i = 0; i < kQosMaxLanes; ++i) {
       Lane& lane = st.lanes[i];
+      // Acquire: pairs with enqueue publication (see loop header).
       if (lane.depth.load(std::memory_order_acquire) == 0) {
         lane.deficit = 0;  // an idle lane accrues no credit (DRR)
         continue;
@@ -251,6 +257,8 @@ bool drain_all(void (*process)(void*), std::vector<void*>* overflow) {
         --lane.deficit;
         --budget;
         vars.lane_dispatch[i] << 1;
+        // Acquire: the test tap's callable must be fully constructed
+        // before this drainer invokes it.
         auto tap = st.tap.load(std::memory_order_acquire);
         if (tap != nullptr) {
           tap(i, m->meta.qos_tenant);
@@ -293,7 +301,14 @@ void drive(void (*process)(void*)) {
       return;  // current drainer will observe our message
     }
     std::vector<void*> overflow;
-    const bool finished = drain_all(process, &overflow);
+    bool finished;
+    {
+      // The drainer role is process-wide: a park while holding it wedges
+      // every lane and socket at once — dispatch scope for the analysis
+      // blocking detector (ISSUE 7).
+      analysis::ScopedDispatch scope("qos drainer role");
+      finished = drain_all(process, &overflow);
+    }
     st.draining.store(false, std::memory_order_release);
     // Pool-exhaustion stragglers run AFTER the role release: a parking
     // handler now stalls only this fiber, never global lane dispatch.
@@ -362,6 +377,7 @@ int64_t qos_lane_depth(int lane) {
   if (lane < 0 || lane >= kQosMaxLanes) {
     return 0;
   }
+  // Acquire: vars/tests reading depth pair with enqueue publication.
   return state().lanes[lane].depth.load(std::memory_order_acquire);
 }
 
@@ -410,6 +426,8 @@ QosVars::QosVars() {
         "requests currently queued in QoS lane " + std::to_string(i));
   }
   live_sockets = std::make_unique<PassiveStatus<long>>([] {
+    // Relaxed: a monotonic-ish diagnostic gauge — off-by-a-few during a
+    // churn burst is fine, no data hangs off the count.
     return static_cast<long>(
         g_socket_count.load(std::memory_order_relaxed));
   });

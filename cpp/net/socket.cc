@@ -9,6 +9,7 @@
 #include "base/logging.h"
 #include "base/tls_cache.h"
 #include "base/time.h"
+#include "base/tsan.h"
 #include "fiber/fiber.h"
 #include "fiber/scheduler.h"
 #include "net/fault.h"
@@ -37,6 +38,8 @@ int Socket::Create(const Options& opts, SocketId* out) {
   if (s == nullptr) {
     return -1;
   }
+  // Relaxed: the release store of ref_ver_ below is the single
+  // publication point — nothing reads slot_/count before it lands.
   s->slot_.store(slot, std::memory_order_relaxed);
   s->reset_for_reuse(opts);
   const uint32_t ver =
@@ -65,6 +68,9 @@ void Socket::reset_for_reuse(const Options& opts) {
       opts.transport != nullptr ? opts.transport : tcp_transport());
   transport_ctx_holder_ = opts.transport_ctx_holder;
   transport_ctx = transport_ctx_holder_.get();
+  // Relaxed init stores through wq_head_ below: this slot is not yet
+  // published (Address() can't hand out refs until Create()'s release
+  // store of ref_ver_), so there is no concurrent reader to order with.
   failed_.store(false, std::memory_order_relaxed);
   // fd-less transports (shm/ICI) are born connected.
   connected_.store(opts.fd >= 0 ||
@@ -77,16 +83,16 @@ void Socket::reset_for_reuse(const Options& opts) {
   pinned_protocol = -1;
   user_data = opts.user_data;
   worker_tag = opts.worker_tag;
-  wr_ev_.value.store(0, std::memory_order_relaxed);
-  writing_.store(false, std::memory_order_relaxed);
+  wr_ev_.value.store(0, std::memory_order_relaxed);   // pre-publication
+  writing_.store(false, std::memory_order_relaxed);    // pre-publication
   pending_.clear();
   pending_close_ = false;
   probe_stall_len = 0;
   read_block_hint = 0;
   parse_state.reset();
   parse_state_owner = nullptr;
-  auth_ok.store(false, std::memory_order_relaxed);
-  wq_head_.store(nullptr, std::memory_order_relaxed);
+  auth_ok.store(false, std::memory_order_relaxed);    // pre-publication
+  wq_head_.store(nullptr, std::memory_order_relaxed);  // pre-publication
 }
 
 Socket* Socket::Address(SocketId id) {
@@ -99,6 +105,8 @@ Socket* Socket::Address(SocketId id) {
   if (s == nullptr) {
     return nullptr;
   }
+  // Acquire: pairs with Create()'s release publication so a ref taken
+  // here sees the fully-initialized socket state behind it.
   uint64_t rv = s->ref_ver_.load(std::memory_order_acquire);
   while (true) {
     if (ver_of(rv) != ver) {
@@ -116,12 +124,16 @@ bool Socket::Draining(SocketId id) {
   if (s == nullptr) {
     return false;
   }
+  // Acquire: must observe SetFailed's generation bump, not a stale odd
+  // version that would misreport a draining socket as live.
   const uint64_t rv = s->ref_ver_.load(std::memory_order_acquire);
   // SetFailed bumped the generation to id_ver+1 (even); refs drain to 0.
   return ver_of(rv) == static_cast<uint32_t>(id >> 32) + 1 && ref_of(rv) > 0;
 }
 
 SocketId Socket::id() const {
+  // Acquire on the version (diagnostic readers must not see a stale
+  // generation); slot_ is immutable after Create → relaxed.
   return pack(ver_of(ref_ver_.load(std::memory_order_acquire)), 0) |
          slot_.load(std::memory_order_relaxed);
 }
@@ -130,6 +142,7 @@ std::string Socket::DumpAll(size_t max_rows) {
   return dump_pool_table<Socket>(
       "live sockets (id  fd  remote  mode  proto  state)\n", max_rows,
       [](uint32_t slot, Socket* s, std::string* line) {
+        // Acquire: liveness must see the latest generation/refcount.
         const uint64_t rv = s->ref_ver_.load(std::memory_order_acquire);
         if ((ver_of(rv) & 1) == 0 || ref_of(rv) == 0) {
           return false;  // even generation = recycled/failed slot
@@ -166,6 +179,7 @@ std::string Socket::DumpHotState() {
   return dump_pool_table<Socket>(
       "socket hot state (fd  nevent  writing  queued  conn  failed)\n",
       200, [](uint32_t slot, Socket* s, std::string* line) {
+        // Acquire: liveness must see the latest generation/refcount.
         const uint64_t rv = s->ref_ver_.load(std::memory_order_acquire);
         if ((ver_of(rv) & 1) == 0 || ref_of(rv) == 0) {
           return false;
@@ -300,6 +314,8 @@ void Socket::destroy_write_node_opaque(void* n) {
 }
 
 void Socket::drop_write_queue() {
+  // Acquire: claims the chain — must see every producer's node payload
+  // (their CAS push released it into wq_head_).
   WriteNode* n = wq_head_.exchange(nullptr, std::memory_order_acquire);
   while (n != nullptr) {
     WriteNode* next = n->next;
@@ -328,6 +344,8 @@ void Socket::read_fiber_thunk(void* arg) {
   if (s == nullptr) {
     return;
   }
+  // Close the connect→first-readable kernel edge (see ensure_connected).
+  TRPC_TSAN_ACQUIRE(s);
   while (true) {
     const int seen = s->nevent_.load(std::memory_order_acquire);
     s->on_readable_(id, s->ctx_);
@@ -374,6 +392,13 @@ int Socket::ensure_connected() {
   }
   const int rc = transport_->connect(this);
   if (rc == 0) {
+    // Kernel-mediated edge TSan cannot model: the read fiber's first
+    // readv is ordered after connect() by the kernel (a readable event
+    // needs delivered bytes, which need an established connection), but
+    // TSan only draws epoll_ctl→epoll_wait.  Pairs with the acquire at
+    // read_fiber_thunk entry; replaces the old blanket
+    // race:trpc::Socket::ensure_connected suppression (ISSUE 7).
+    TRPC_TSAN_RELEASE(this);
     connected_.store(true, std::memory_order_release);
   }
   return rc;
@@ -403,11 +428,15 @@ int Socket::Write(IOBuf&& data, bool close_after) {
     return -1;
   }
   WriteNode* node = alloc_write_node(std::move(data), close_after);
+  // Relaxed initial read: the CAS below (seq_cst, see the role-handoff
+  // comment above Write) is what orders the push; a stale head only
+  // costs one CAS retry.
   WriteNode* old = wq_head_.load(std::memory_order_relaxed);
   do {
     node->next = old;
   } while (!wq_head_.compare_exchange_weak(old, node,
                                            std::memory_order_seq_cst,
+                                           // failure: retry re-reads head
                                            std::memory_order_relaxed));
   if (old != nullptr) {
     return 0;  // an active writer owns the drain
@@ -439,6 +468,8 @@ int Socket::Write(IOBuf&& data, bool close_after) {
 }
 
 size_t Socket::drain_queue_into_pending() {
+  // Acquire: claims the chain — pairs with producers' CAS release so
+  // the drain sees every node's IOBuf payload.
   WriteNode* chain = wq_head_.exchange(nullptr, std::memory_order_acquire);
   if (chain == nullptr) {
     return 0;

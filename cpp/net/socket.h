@@ -59,10 +59,14 @@ class Socket {
   // Marks failed: future Address() fails, fd closed once refs drain, the
   // owner reference is dropped, waiters woken.
   void SetFailed(int err);
+  // Acquire on both state bits: an observer acting on failed/connected
+  // (e.g. skipping ensure_connected) must also see the writes SetFailed
+  // or the connect path published before flipping them.
   bool Failed() const {
     return failed_.load(std::memory_order_acquire);
   }
   bool connected() const {
+    // Acquire: see Failed() — same publication pairing.
     return connected_.load(std::memory_order_acquire);
   }
 

@@ -150,6 +150,8 @@ std::atomic<int64_t> g_last_gc_us{0};
 
 void maybe_gc() {
   const int64_t now = monotonic_time_us();
+  // Relaxed load + CAS: the stamp only rate-limits GC claims; the map
+  // itself is read under map_mu(), so no data rides this word.
   int64_t last = g_last_gc_us.load(std::memory_order_relaxed);
   if (now - last < 1000 * 1000 ||
       !g_last_gc_us.compare_exchange_strong(last, now,
@@ -204,6 +206,8 @@ std::shared_ptr<StripeEntry> admit_chunk(uint64_t id, uint64_t total,
       e->block->size = static_cast<uint32_t>(total);
       e->dest = e->block->data;
     }
+    // Relaxed: pure accounting var (stripe_pending_bytes) — readers
+    // tolerate transient skew, no ordering needed.
     g_pending_bytes.fetch_add(total, std::memory_order_relaxed);
     entries().emplace(id, e);
   }
@@ -235,6 +239,7 @@ std::shared_ptr<StripeEntry> admit_chunk(uint64_t id, uint64_t total,
 }
 
 void drop_entry_locked(const std::shared_ptr<StripeEntry>& e) {
+  // Relaxed: accounting only (see the fetch_add at entry creation).
   g_pending_bytes.fetch_sub(e->total, std::memory_order_relaxed);
   entries().erase(e->id);
 }
@@ -281,6 +286,8 @@ void maybe_finalize(const std::shared_ptr<StripeEntry>& e) {
   }
   {
     std::lock_guard<std::mutex> g(e->mu);
+    // Acquire on abandoned: pairs with the GC's release store so a
+    // dispatch racing expiry never delivers a half-reclaimed entry.
     if (!e->have_head || e->dispatched ||
         e->abandoned.load(std::memory_order_acquire)) {
       return;
@@ -303,6 +310,9 @@ struct LandJob {
 void land_job_run(LandJob* j) {
   const std::shared_ptr<StripeEntry>& e = j->entry;
   const uint64_t n = j->data.size();
+  // Acquire: a lander observing the GC's abandoned release-store must
+  // also see the entry's landing block already detached — copying into
+  // e->dest after reclaim would scribble freed arena memory.
   if (!e->abandoned.load(std::memory_order_acquire)) {
     j->data.copy_to(e->dest + j->offset, n);
   }
@@ -527,6 +537,9 @@ void stripe_gc(int64_t now_us) {
     auto& m = entries();
     for (auto it = m.begin(); it != m.end();) {
       StripeEntry& e = *it->second;
+      // Acquire/release on abandoned: the release store publishes the
+      // expiry decision to landers (land_job_run's acquire); relaxed on
+      // the byte counter — accounting only.
       if (e.abandoned.load(std::memory_order_acquire) ||
           now_us - e.created_us > timeout_us) {
         e.abandoned.store(true, std::memory_order_release);

@@ -61,21 +61,46 @@ class TcpTransport final : public Transport {
         return 0;
       }
       if (errno == EINPROGRESS || errno == EALREADY) {
-        // Park until the writable edge, then re-check with SO_ERROR.
-        s->wait_writable(snap, monotonic_time_us() + 10 * 1000 * 1000);
-        int err = 0;
-        socklen_t len = sizeof(err);
-        if (getsockopt(s->fd(), SOL_SOCKET, SO_ERROR, &err, &len) == 0 &&
-            err == 0) {
-          int probe = ::connect(s->fd(), reinterpret_cast<sockaddr*>(&ss),
-                                sa_len);
-          if (probe == 0 || errno == EISCONN) {
+        // Completion loop: once the handshake is in flight we only ever
+        // park + probe — NEVER re-issue ::connect.  Probing completion
+        // with getpeername instead of a second ::connect matters twice
+        // over (ISSUE 7): connect() on an ESTABLISHED fd performs
+        // fd-context writes that race the read fiber's first readv at
+        // the TSan interceptor level (the exact report the old blanket
+        // ensure_connected suppression papered over), while getpeername
+        // succeeds iff the handshake completed (ENOTCONN while still in
+        // flight) and writes nothing.
+        // One overall 10s application deadline for the whole handshake —
+        // re-arming it per park would wait out the kernel's ~2min SYN
+        // retry ladder against a blackholed peer.
+        const int64_t deadline_us = monotonic_time_us() + 10 * 1000 * 1000;
+        uint32_t wsnap = snap;
+        while (true) {
+          const int wait_rc = s->wait_writable(wsnap, deadline_us);
+          wsnap = s->writable_snap();  // re-arm before the next probe
+          int err = 0;
+          socklen_t len = sizeof(err);
+          if (getsockopt(s->fd(), SOL_SOCKET, SO_ERROR, &err, &len) != 0 ||
+              err != 0) {
+            errno = err != 0 ? err : ETIMEDOUT;
+            return -1;
+          }
+          sockaddr_storage peer;
+          socklen_t plen = sizeof(peer);
+          if (getpeername(s->fd(), reinterpret_cast<sockaddr*>(&peer),
+                          &plen) == 0) {
             return 0;
           }
-          continue;
+          if (errno != ENOTCONN) {
+            return -1;
+          }
+          if (wait_rc == ETIMEDOUT) {
+            errno = ETIMEDOUT;
+            return -1;
+          }
+          // Spurious wake before establishment: park again; the kernel
+          // surfaces a failed handshake through SO_ERROR above.
         }
-        errno = err != 0 ? err : ETIMEDOUT;
-        return -1;
       }
       if (errno == EINTR) {
         continue;

@@ -78,13 +78,22 @@ constexpr int kSslCtrlSetTlsextHostname = 55;  // SSL_CTRL_SET_TLSEXT_HOSTNAME
 const SslApi& api() {
   static SslApi a = [] {
     SslApi s = {};
+    // Soname ladder: 3.x, the dev symlink, then 1.1 (this box ships only
+    // libssl.so.1.1 — every symbol SslApi binds is a real function there
+    // too, so the 1.1 fallback is fully served).
     void* ssl = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
     if (ssl == nullptr) {
       ssl = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
     }
+    if (ssl == nullptr) {
+      ssl = dlopen("libssl.so.1.1", RTLD_NOW | RTLD_GLOBAL);
+    }
     // ERR_* live in libcrypto; RTLD_GLOBAL above lets one handle serve,
     // but resolve via an explicit handle as well for robustness.
     void* crypto = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (crypto == nullptr) {
+      crypto = dlopen("libcrypto.so.1.1", RTLD_NOW | RTLD_GLOBAL);
+    }
     if (ssl == nullptr) {
       return s;
     }

@@ -50,13 +50,17 @@ UsercodePool::UsercodePool(int threads) : impl_(new Impl()) {
       new PassiveStatus<int64_t>([impl = impl_] {
         return static_cast<int64_t>(impl->inflight.load());
       });
-  g_inflight->expose("usercode_inflight");
+  g_inflight->expose("usercode_inflight",
+                     "user callbacks currently running on the pthread "
+                     "backup pool (usercode_in_pthread path)");
   static PassiveStatus<int64_t>* g_queue =
       new PassiveStatus<int64_t>([impl = impl_] {
         std::lock_guard<std::mutex> g(impl->mu);
         return static_cast<int64_t>(impl->queue.size());
       });
-  g_queue->expose("usercode_queue");
+  g_queue->expose("usercode_queue",
+                  "user callbacks queued for the pthread backup pool "
+                  "(sustained growth = pool undersized)");
 }
 
 UsercodePool* UsercodePool::instance(int threads) {

@@ -1,0 +1,279 @@
+// Tests for the runtime invariant checkers (fiber/analysis.h, ISSUE 7):
+// a seeded deliberate lock-order inversion and a deliberate blocking
+// call on a dispatch context must be CAUGHT with trpc_analysis on, and
+// INVISIBLE with it off (the default).
+#include "fiber/analysis.h"
+
+#include <atomic>
+#include <new>
+#include <string>
+
+#include "base/flags.h"
+#include "fiber/event.h"
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+namespace {
+
+void set_analysis(bool on) {
+  analysis::ensure_registered();
+  EXPECT_EQ(Flag::set("trpc_analysis", on ? "true" : "false"), 0);
+}
+
+struct InversionArgs {
+  FiberMutex* a;
+  FiberMutex* b;
+  CountdownEvent* done;
+};
+
+// Two fibers acquiring {a,b} in opposite orders — the textbook
+// inversion.  Serialized (second order runs after the first completes)
+// so the test records the ORDER VIOLATION without ever risking the
+// actual deadlock.
+void lock_ab(void* p) {
+  auto* args = static_cast<InversionArgs*>(p);
+  args->a->lock();
+  args->b->lock();
+  args->b->unlock();
+  args->a->unlock();
+  args->done->signal();
+}
+
+void lock_ba(void* p) {
+  auto* args = static_cast<InversionArgs*>(p);
+  args->b->lock();
+  args->a->lock();
+  args->a->unlock();
+  args->b->unlock();
+  args->done->signal();
+}
+
+uint64_t run_seeded_inversion() {
+  FiberMutex a;
+  FiberMutex b;
+  {
+    CountdownEvent done(1);
+    InversionArgs args{&a, &b, &done};
+    EXPECT_EQ(fiber_start(nullptr, lock_ab, &args, 0), 0);
+    EXPECT_EQ(done.wait(), 0);
+  }
+  {
+    CountdownEvent done(1);
+    InversionArgs args{&a, &b, &done};
+    EXPECT_EQ(fiber_start(nullptr, lock_ba, &args, 0), 0);
+    EXPECT_EQ(done.wait(), 0);
+  }
+  return analysis::lock_cycles_found();
+}
+
+struct BlockArgs {
+  CountdownEvent* done;
+};
+
+// A fiber that enters a dispatch scope (as the messenger inline window
+// and QoS drainer role do) and then parks on an Event — the deliberate
+// no-pinned-read-fiber violation.
+void block_in_dispatch(void* p) {
+  auto* args = static_cast<BlockArgs*>(p);
+  {
+    analysis::ScopedDispatch scope("test dispatch scope");
+    fiber_sleep_us(10 * 1000);  // parks via Event::wait
+  }
+  args->done->signal();
+}
+
+uint64_t run_deliberate_block() {
+  CountdownEvent done(1);
+  BlockArgs args{&done};
+  EXPECT_EQ(fiber_start(nullptr, block_in_dispatch, &args, 0), 0);
+  EXPECT_EQ(done.wait(), 0);
+  return analysis::blocking_violations();
+}
+
+}  // namespace
+
+TEST_CASE(analysis_off_by_default_and_invisible) {
+  fiber_init(0);
+  analysis::reset_for_test();
+  set_analysis(false);
+  EXPECT(!analysis::enabled());
+  const uint64_t cycles0 = run_seeded_inversion();
+  const uint64_t blocks0 = run_deliberate_block();
+  // Flag off: the same seeded misbehavior records NOTHING.
+  EXPECT_EQ(cycles0, 0u);
+  EXPECT_EQ(blocks0, 0u);
+  EXPECT(analysis::report().find("OFF") != std::string::npos);
+}
+
+TEST_CASE(analysis_catches_seeded_lock_inversion) {
+  fiber_init(0);
+  analysis::reset_for_test();
+  set_analysis(true);
+  const uint64_t before = analysis::lock_cycles_found();
+  const uint64_t after = run_seeded_inversion();
+  set_analysis(false);
+  EXPECT_EQ(before, 0u);
+  EXPECT(after >= 1u);
+  const std::string r = analysis::report();
+  EXPECT(r.find("lock-order inversion") != std::string::npos);
+}
+
+TEST_CASE(analysis_catches_blocking_on_dispatch_fiber) {
+  fiber_init(0);
+  analysis::reset_for_test();
+  set_analysis(true);
+  const uint64_t after = run_deliberate_block();
+  set_analysis(false);
+  EXPECT(after >= 1u);
+  const std::string r = analysis::report();
+  EXPECT(r.find("blocking call (Event::wait)") != std::string::npos);
+  EXPECT(r.find("test dispatch scope") != std::string::npos);
+}
+
+TEST_CASE(analysis_scope_exit_clears_context) {
+  fiber_init(0);
+  analysis::reset_for_test();
+  set_analysis(true);
+  const uint64_t before = analysis::blocking_violations();
+  // Same park, but OUTSIDE any dispatch scope: clean.
+  CountdownEvent done(1);
+  BlockArgs args{&done};
+  fiber_start(
+      nullptr,
+      [](void* p) {
+        {
+          analysis::ScopedDispatch scope("transient scope");
+        }
+        fiber_sleep_us(5 * 1000);  // scope already exited — no violation
+        static_cast<BlockArgs*>(p)->done->signal();
+      },
+      &args, 0);
+  EXPECT_EQ(done.wait(), 0);
+  set_analysis(false);
+  EXPECT_EQ(analysis::blocking_violations(), before);
+}
+
+namespace {
+
+// Flag flipped OFF while a recorded lock is held: the unlock must still
+// run release bookkeeping (per-acquisition latch), or `a` stays on the
+// fiber's held stack and the later b-acquisition records a phantom a→b.
+void toggle_while_held(void* p) {
+  auto* args = static_cast<InversionArgs*>(p);
+  args->a->lock();
+  Flag::set("trpc_analysis", "false");
+  args->a->unlock();
+  Flag::set("trpc_analysis", "true");
+  args->b->lock();
+  args->b->unlock();
+  args->done->signal();
+}
+
+}  // namespace
+
+TEST_CASE(analysis_flag_toggle_while_held_leaves_no_stale_state) {
+  fiber_init(0);
+  analysis::reset_for_test();
+  set_analysis(true);
+  FiberMutex a;
+  FiberMutex b;
+  {
+    CountdownEvent done(1);
+    InversionArgs args{&a, &b, &done};
+    EXPECT_EQ(fiber_start(nullptr, toggle_while_held, &args, 0), 0);
+    EXPECT_EQ(done.wait(), 0);
+  }
+  {
+    // Reverse order b→a: a cycle can exist ONLY via the stale a→b edge
+    // a leaked held-stack entry would have recorded above.
+    CountdownEvent done(1);
+    InversionArgs args{&a, &b, &done};
+    EXPECT_EQ(fiber_start(nullptr, lock_ba, &args, 0), 0);
+    EXPECT_EQ(done.wait(), 0);
+  }
+  set_analysis(false);
+  EXPECT_EQ(analysis::lock_cycles_found(), 0u);
+}
+
+TEST_CASE(analysis_lock_destruction_clears_graph_node) {
+  fiber_init(0);
+  analysis::reset_for_test();
+  set_analysis(true);
+  // Same ADDRESS, two distinct lock lifetimes, opposite orders against
+  // G: without the destructor hook the recycled address would stitch a
+  // phantom cycle between locks that never coexisted.
+  FiberMutex g;
+  alignas(FiberMutex) unsigned char storage[sizeof(FiberMutex)];
+  {
+    auto* l1 = new (storage) FiberMutex();
+    CountdownEvent done(1);
+    InversionArgs args{&g, l1, &done};
+    EXPECT_EQ(fiber_start(nullptr, lock_ab, &args, 0), 0);  // g → l1
+    EXPECT_EQ(done.wait(), 0);
+    l1->~FiberMutex();
+  }
+  {
+    auto* l2 = new (storage) FiberMutex();  // same address, new lock
+    CountdownEvent done(1);
+    InversionArgs args{&g, l2, &done};
+    EXPECT_EQ(fiber_start(nullptr, lock_ba, &args, 0), 0);  // l2 → g
+    EXPECT_EQ(done.wait(), 0);
+    l2->~FiberMutex();
+  }
+  set_analysis(false);
+  EXPECT_EQ(analysis::lock_cycles_found(), 0u);
+}
+
+TEST_CASE(analysis_recycled_addresses_report_fresh_inversion) {
+  fiber_init(0);
+  analysis::reset_for_test();
+  set_analysis(true);
+  // Dual of the destruction test: a REAL inversion between new locks
+  // recycled onto previously-reported addresses must be reported again —
+  // a stale reported-pair entry surviving destroy would swallow it.
+  alignas(FiberMutex) unsigned char sa[sizeof(FiberMutex)];
+  alignas(FiberMutex) unsigned char sb[sizeof(FiberMutex)];
+  for (int life = 0; life < 2; ++life) {
+    auto* a = new (sa) FiberMutex();
+    auto* b = new (sb) FiberMutex();
+    {
+      CountdownEvent done(1);
+      InversionArgs args{a, b, &done};
+      EXPECT_EQ(fiber_start(nullptr, lock_ab, &args, 0), 0);
+      EXPECT_EQ(done.wait(), 0);
+    }
+    {
+      CountdownEvent done(1);
+      InversionArgs args{a, b, &done};
+      EXPECT_EQ(fiber_start(nullptr, lock_ba, &args, 0), 0);
+      EXPECT_EQ(done.wait(), 0);
+    }
+    EXPECT_EQ(analysis::lock_cycles_found(), uint64_t(life + 1));
+    b->~FiberMutex();
+    a->~FiberMutex();
+  }
+  set_analysis(false);
+}
+
+TEST_CASE(analysis_ordered_locks_report_nothing) {
+  fiber_init(0);
+  analysis::reset_for_test();
+  set_analysis(true);
+  // Consistent a→b order across many fibers: a graph, but no cycle.
+  FiberMutex a;
+  FiberMutex b;
+  constexpr int kFibers = 8;
+  CountdownEvent done(kFibers);
+  InversionArgs args{&a, &b, &done};
+  for (int i = 0; i < kFibers; ++i) {
+    EXPECT_EQ(fiber_start(nullptr, lock_ab, &args, 0), 0);
+  }
+  EXPECT_EQ(done.wait(), 0);
+  set_analysis(false);
+  EXPECT_EQ(analysis::lock_cycles_found(), 0u);
+}
+
+TEST_MAIN

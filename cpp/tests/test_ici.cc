@@ -263,7 +263,21 @@ TEST_CASE(ici_consumer_backpressure_reopens_on_release) {
 TEST_CASE(ici_setfailed_mid_transfer_releases_everything) {
   fiber_init(0);
   ici_set_ring_geometry(4096, 4);
-  const size_t slabs_before = ici_registered_slab_count();
+  // Earlier tests' failed sockets drain their arenas asynchronously (and
+  // sanitizer slowdown stretches that window); settle before sampling
+  // the baseline or the +2 check below misreads a late unregister.
+  size_t slabs_before = ici_registered_slab_count();
+  wait_until(
+      [&] {
+        usleep(50 * 1000);  // count must hold across a 50ms window
+        const size_t now = ici_registered_slab_count();
+        if (now == slabs_before) {
+          return true;
+        }
+        slabs_before = now;
+        return false;
+      },
+      3000);
   {
     auto* pair = new RawPair();
     EXPECT(pair->build());

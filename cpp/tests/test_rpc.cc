@@ -1835,6 +1835,10 @@ TEST_CASE(batch_submit_opens_parent_span_and_depth_vars) {
   set_ambient_trace(trace, root);
   const size_t kCalls = 6;
   std::vector<std::string> payloads;
+  // These payloads sit in SSO storage INSIDE the vector's buffer, so a
+  // push_back reallocation moves the bytes the reqs pointers reference
+  // (heap-use-after-free caught by the ISSUE 7 ASan gate): reserve first.
+  payloads.reserve(kCalls);
   std::vector<const void*> reqs;
   std::vector<size_t> lens;
   for (size_t i = 0; i < kCalls; ++i) {

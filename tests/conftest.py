@@ -24,3 +24,11 @@ def pytest_configure(config):
         "slow: perf smoke / long soaks, excluded from the tier-1 gate "
         "(run with -m slow)",
     )
+    config.addinivalue_line(
+        "markers",
+        "san: the sanitizer matrix (TSan suite sweep, ASan+LSan full "
+        "suite, fuzz-corpus replay) — run with -m san; every test "
+        "skips gracefully when the toolchain lacks the sanitizer "
+        "runtime.  Tier-1 keeps a bounded TSan smoke (fiber suite) and "
+        "tools/lint_trpc.py instead of the whole matrix.",
+    )

@@ -1,0 +1,211 @@
+"""Shared sanitizer build harness for the C++ runtime and test suites.
+
+Generalizes what used to be private logic inside tests/test_cpp.py
+(_build_direct's build/tsan_obj tree): one content-hash-cached,
+parallel-compiling, cmake-less build that produces
+``build/libtpurpc_<kind>.so`` for any sanitizer kind and links test or
+fuzz binaries against it.  Used by the TSan suite matrix, the ASan+LSan
+full-suite gate and the fuzz-corpus replay gate (tests/test_cpp.py,
+tests/test_fuzz_replay.py) — no per-test rebuild logic anywhere else.
+
+Caching is keyed on CONTENT, not mtimes: each object carries a stamp of
+sha1(flags + source bytes + global header digest), so a `git checkout`
+or a touch that doesn't change bytes never triggers a recompile, and a
+real edit always does (the old mtime scheme missed rebuilds when a
+checkout restored an older timestamp).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pathlib
+import shutil
+import subprocess
+from concurrent.futures import ThreadPoolExecutor
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CPP = REPO / "cpp"
+BUILD = REPO / "build"
+
+# Per-kind compile/link flag sets.  "address" folds LSan in (leak
+# detection is part of ASan's runtime; LSAN_OPTIONS gates it at run time).
+SAN_FLAGS = {
+    "thread": ["-fsanitize=thread"],
+    "address": ["-fsanitize=address"],
+}
+
+_BASE_FLAGS = [
+    "-std=c++20", "-fPIC", "-O1", "-g", "-fno-omit-frame-pointer",
+    # gcc-10 gates C++20 coroutines (fiber/coroutine.h, test_usercode)
+    # behind an explicit flag; later gcc/clang just ignore it being on.
+    "-fcoroutines",
+]
+
+_probe_cache: dict = {}
+
+
+def compiler() -> str | None:
+    return shutil.which("g++") or shutil.which("c++")
+
+
+def has_sanitizer(kind: str) -> bool:
+    """True when the toolchain can link -fsanitize=<kind> (cached)."""
+    if kind in _probe_cache:
+        return _probe_cache[kind]
+    cxx = compiler()
+    ok = False
+    if cxx is not None:
+        probe = subprocess.run(
+            [cxx, *SAN_FLAGS[kind], "-x", "c++", "-", "-o", "/dev/null"],
+            input="int main(){return 0;}", capture_output=True, text=True)
+        ok = probe.returncode == 0
+    _probe_cache[kind] = ok
+    return ok
+
+
+_hdr_digest_cache: list = []
+
+
+def _headers_digest() -> str:
+    """One digest over every header/inc: any header edit invalidates all
+    objects (no per-file dependency scan; conservative and correct).
+    Memoized per process — a `-m san` run makes ~50+ build calls and
+    headers don't change mid-run; without the cache each call re-reads
+    and re-hashes the whole tree."""
+    if _hdr_digest_cache:
+        return _hdr_digest_cache[0]
+    h = hashlib.sha1()
+    for pat in ("*.h", "*.inc"):
+        for p in sorted(CPP.rglob(pat)):
+            h.update(str(p.relative_to(CPP)).encode())
+            h.update(p.read_bytes())
+    _hdr_digest_cache.append(h.hexdigest())
+    return _hdr_digest_cache[0]
+
+
+def _runtime_sources() -> list:
+    srcs = []
+    for sub, pats in (
+        ("base", ("*.cc",)),
+        ("fiber", ("*.cc", "*.S")),
+        ("stat", ("*.cc",)),
+        ("net", ("*.cc",)),
+        ("capi", ("*.cc",)),
+    ):
+        for pat in pats:
+            srcs.extend(sorted((CPP / sub).glob(pat)))
+    return srcs
+
+
+def _compile_cached(cxx, src: pathlib.Path, obj: pathlib.Path,
+                    flags: list, hdr_digest: str) -> bool:
+    """Compile src → obj unless the content-hash stamp matches.
+    Returns True when the object was (re)built."""
+    key = hashlib.sha1()
+    key.update(" ".join(flags).encode())
+    key.update(hdr_digest.encode())
+    key.update(src.read_bytes())
+    digest = key.hexdigest()
+    stamp = obj.with_suffix(obj.suffix + ".hash")
+    if obj.exists() and stamp.exists() and stamp.read_text() == digest:
+        return False
+    subprocess.run([cxx, *flags, "-c", str(src), "-o", str(obj)],
+                   check=True, capture_output=True, text=True)
+    stamp.write_text(digest)
+    return True
+
+
+_runtime_lib_cache: dict = {}
+
+
+def runtime_lib(kind: str) -> pathlib.Path:
+    """Build (or reuse) build/libtpurpc_<kind>.so with -fsanitize=<kind>.
+
+    Parallel across all runtime sources; per-object content-hash cache;
+    the link reruns only when some object changed or the lib is missing.
+    Memoized per (process, kind): sources can't change between the
+    parametrized tests of one pytest run, so only the first caller pays
+    even the stamp-check file reads.
+    """
+    if kind in _runtime_lib_cache:
+        return _runtime_lib_cache[kind]
+    cxx = compiler()
+    assert cxx is not None, "no C++ compiler"
+    obj_dir = BUILD / "san" / kind
+    obj_dir.mkdir(parents=True, exist_ok=True)
+    flags = [*_BASE_FLAGS, *SAN_FLAGS[kind], "-I", str(CPP)]
+    hdr = _headers_digest()
+    sources = _runtime_sources()
+
+    relinked = []
+
+    def compile_one(src: pathlib.Path) -> str:
+        obj = obj_dir / (str(src.relative_to(CPP)).replace("/", "_") + ".o")
+        if _compile_cached(cxx, src, obj, flags, hdr):
+            relinked.append(src)
+        return str(obj)
+
+    with ThreadPoolExecutor(max_workers=os.cpu_count() or 4) as pool:
+        objs = list(pool.map(compile_one, sources))
+    lib = BUILD / f"libtpurpc_{kind}.so"
+    if relinked or not lib.exists():
+        subprocess.run(
+            [cxx, "-shared", *SAN_FLAGS[kind], "-o", str(lib), *objs,
+             "-lpthread", "-lrt", "-lz", "-ldl"],
+            check=True, capture_output=True, text=True)
+    _runtime_lib_cache[kind] = lib
+    return lib
+
+
+def _binary(kind: str, src: pathlib.Path, exe_name: str) -> pathlib.Path:
+    """Build one standalone binary (test suite or fuzz target) against
+    the <kind>-sanitized runtime — single build recipe so the two
+    callers can't drift to different flag/link configurations."""
+    cxx = compiler()
+    lib = runtime_lib(kind)
+    exe = BUILD / exe_name
+    flags = [*_BASE_FLAGS, *SAN_FLAGS[kind], "-I", str(CPP)]
+    obj = BUILD / "san" / kind / (exe_name + ".o")
+    rebuilt = _compile_cached(cxx, src, obj, flags, _headers_digest())
+    if rebuilt or not exe.exists() or (
+            exe.stat().st_mtime < lib.stat().st_mtime):
+        subprocess.run(
+            [cxx, *flags, str(obj), "-L", str(BUILD),
+             f"-Wl,-rpath,{BUILD}", f"-l:libtpurpc_{kind}.so",
+             "-lpthread", "-lrt", "-o", str(exe)],
+            check=True, capture_output=True, text=True)
+    return exe
+
+
+def test_binary(kind: str, test_src: str, exe_name: str) -> pathlib.Path:
+    """Build one cpp/tests binary against the <kind>-sanitized runtime."""
+    return _binary(kind, CPP / "tests" / test_src, exe_name)
+
+
+def fuzz_binary(kind: str, fuzz_src: str, exe_name: str) -> pathlib.Path:
+    """Build one cpp/fuzzing target (fallback-driver main) against the
+    <kind>-sanitized runtime."""
+    return _binary(kind, CPP / "fuzzing" / fuzz_src, exe_name)
+
+
+def sanitizer_env(kind: str, **overrides) -> dict:
+    """Process env with the repo's suppression files wired in.
+
+    Suppression policy (ARCHITECTURE.md "Correctness tooling"): every
+    line in cpp/tsan.supp / cpp/lsan.supp must cite the unmodeled
+    happens-before edge (or teardown state) it papers over; the gates
+    here always run with those files so an undocumented suppression has
+    nowhere to hide.
+    """
+    env = dict(os.environ)
+    if kind == "thread":
+        env["TSAN_OPTIONS"] = (
+            f"suppressions={CPP / 'tsan.supp'} halt_on_error=0 "
+            "exitcode=66 second_deadlock_stack=1")
+    elif kind == "address":
+        env["ASAN_OPTIONS"] = "exitcode=67 detect_stack_use_after_return=0"
+        env["LSAN_OPTIONS"] = (
+            f"suppressions={CPP / 'lsan.supp'} exitcode=68")
+    env.update({k: str(v) for k, v in overrides.items()})
+    return env

@@ -1,10 +1,22 @@
-"""Build the C++ runtime and run EVERY registered ctest target.
+"""Build the C++ runtime and run EVERY registered ctest target, plus the
+sanitizer matrix (ISSUE 7).
 
 Mirrors the reference's CI strategy (test/run_tests.sh runs everything;
 .github/workflows/ci-linux.yml gates on the whole suite): the target list
 is discovered from ctest itself, so a newly-added test binary gates
 automatically and a broken one fails pytest — VERDICT r4 weak #2 was
 exactly that 11 of 26 binaries were green-but-ungated.
+
+Sanitizer matrix (shared harness: tests/san_build.py, content-hash
+cached, no cmake needed):
+  * TSan: every concurrency-critical suite (fiber, rpc, stream, shm,
+    ici, chaos, stat, qos, stripe, analysis) with cpp/tsan.supp —
+    currently EMPTY of rules; suites must be race-clean on merit.
+  * ASan+LSan: the FULL suite with cpp/lsan.supp minimized to the two
+    documented OpenSSL process-lifetime lines.
+Both matrices are `-m san` (slow); tier-1 keeps a bounded smoke (the
+fiber suite under TSan) so a race regression in the scheduler core
+can't land between matrix runs.
 """
 
 import pathlib
@@ -14,10 +26,23 @@ import subprocess
 
 import pytest
 
+import san_build
+
 REPO = pathlib.Path(__file__).resolve().parent.parent
 BUILD = REPO / "build"
 
 _NO_CMAKE = shutil.which("cmake") is None or shutil.which("ctest") is None
+
+# Suites whose shared state runs hot across fibers and pthreads — the
+# TSan half of the matrix.  The full-suite ASan list is discovered from
+# cpp/tests/ so a new suite gates automatically.
+TSAN_SUITES = [
+    "fiber", "rpc", "stream", "shm", "ici", "chaos", "stat", "qos",
+    "stripe", "analysis",
+]
+ALL_SUITES = sorted(
+    p.stem[len("test_"):] for p in (REPO / "cpp" / "tests").glob("test_*.cc")
+)
 
 
 @pytest.fixture(scope="session", autouse=True)
@@ -59,65 +84,50 @@ def _ctest_targets() -> list:
     return names
 
 
-def _build_direct(cxx, test_src: str, exe_name: str, *, tsan: bool):
-    """Builds one cpp/tests binary straight with the compiler (no cmake),
-    against a freshly-ensured runtime library: native builds link the
-    regular libtpurpc.so, TSan builds compile the whole runtime into
-    build/tsan_obj and link libtpurpc_tsan.so."""
-    import os
+def _build_direct(cxx, test_src: str, exe_name: str):
+    """Builds one cpp/tests binary straight with the compiler (no cmake)
+    against a freshly-ensured NATIVE runtime library.  Sanitizer builds
+    go through tests/san_build.py instead."""
+    from brpc_tpu.rpc._lib import ensure_built
 
+    ensure_built()
     cpp = REPO / "cpp"
-    if not tsan:
-        from brpc_tpu.rpc._lib import ensure_built
-
-        ensure_built()
-        exe = BUILD / exe_name
-        src = cpp / "tests" / test_src
-        if (not exe.exists()
-                or exe.stat().st_mtime < max(
-                    src.stat().st_mtime,
-                    (BUILD / "libtpurpc.so").stat().st_mtime)):
-            subprocess.run(
-                [cxx, "-std=c++20", "-O1", "-g", "-fno-omit-frame-pointer",
-                 "-I", str(cpp), str(src), "-L", str(BUILD),
-                 f"-Wl,-rpath,{BUILD}", "-l:libtpurpc.so", "-lpthread",
-                 "-o", str(exe)],
-                check=True, capture_output=True, text=True)
-        return exe
-    obj_dir = BUILD / "tsan_obj"
-    obj_dir.mkdir(parents=True, exist_ok=True)
-    sources = []
-    for sub in ("base", "fiber", "stat", "net", "capi"):
-        sources.extend(sorted((cpp / sub).glob("*.cc")))
-        sources.extend(sorted((cpp / sub).glob("*.S")))
-    flags = ["-std=c++20", "-fPIC", "-O1", "-g", "-fsanitize=thread",
-             "-fno-omit-frame-pointer", "-I", str(cpp)]
-    newest_h = max(p.stat().st_mtime
-                   for pat in ("*.h", "*.inc") for p in cpp.rglob(pat))
-
-    def compile_one(src):
-        obj = obj_dir / (str(src.relative_to(cpp)).replace("/", "_") + ".o")
-        if (not obj.exists()
-                or obj.stat().st_mtime < max(src.stat().st_mtime, newest_h)):
-            subprocess.run([cxx, *flags, "-c", str(src), "-o", str(obj)],
-                           check=True, capture_output=True, text=True)
-        return str(obj)
-
-    from concurrent.futures import ThreadPoolExecutor
-    with ThreadPoolExecutor(max_workers=os.cpu_count() or 4) as pool:
-        objs = list(pool.map(compile_one, sources))
-    lib = BUILD / "libtpurpc_tsan.so"
-    subprocess.run(
-        [cxx, "-shared", "-fsanitize=thread", "-o", str(lib), *objs,
-         "-lpthread", "-lrt", "-lz", "-ldl"],
-        check=True, capture_output=True, text=True)
     exe = BUILD / exe_name
-    subprocess.run(
-        [cxx, *flags, str(cpp / "tests" / test_src),
-         "-L", str(BUILD), f"-Wl,-rpath,{BUILD}", "-l:libtpurpc_tsan.so",
-         "-lpthread", "-o", str(exe)],
-        check=True, capture_output=True, text=True)
+    src = cpp / "tests" / test_src
+    # mtimes catch the test source and runtime lib; the header digest
+    # (shared with san_build's cache key) catches edits to headers the
+    # suite includes (test_util.h etc.), which mtimes alone miss.
+    stamp = BUILD / (exe_name + ".hdrkey")
+    hdr_key = san_build._headers_digest()
+    if (not exe.exists()
+            or exe.stat().st_mtime < max(
+                src.stat().st_mtime,
+                (BUILD / "libtpurpc.so").stat().st_mtime)
+            or not stamp.exists() or stamp.read_text() != hdr_key):
+        subprocess.run(
+            [cxx, "-std=c++20", "-O1", "-g", "-fcoroutines",
+             "-fno-omit-frame-pointer",
+             "-I", str(cpp), str(src), "-L", str(BUILD),
+             f"-Wl,-rpath,{BUILD}", "-l:libtpurpc.so", "-lpthread", "-lrt",
+             "-o", str(exe)],
+            check=True, capture_output=True, text=True)
+        stamp.write_text(hdr_key)
     return exe
+
+
+def _run_native_suite(test_src: str, exe_name: str, desc: str,
+                      timeout: int = 420):
+    cxx = shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        pytest.skip("no C++ compiler")
+    try:
+        exe = _build_direct(cxx, test_src, exe_name)
+    except subprocess.CalledProcessError as e:
+        pytest.fail(f"{desc} build failed:\n{e.stderr[-4000:]}")
+    out = subprocess.run([str(exe)], capture_output=True, text=True,
+                         timeout=timeout)
+    assert out.returncode == 0, (
+        f"{desc} failed (rc={out.returncode}):\n{out.stderr[-8000:]}")
 
 
 def test_qos_cpp_suite_native():
@@ -126,92 +136,82 @@ def test_qos_cpp_suite_native():
     failover, REUSEPORT accept distribution, default-off byte-identity,
     the high-priority p99 guard) gates tier-1 even without cmake — built
     straight with the compiler against libtpurpc.so."""
-    import shutil as _sh
+    _run_native_suite("test_qos.cc", "test_qos_native", "qos suite")
 
-    cxx = _sh.which("g++") or _sh.which("c++")
-    if cxx is None:
+
+def test_analysis_cpp_suite_native():
+    """ISSUE 7 satellite: the invariant checkers themselves are gated —
+    a seeded lock-order inversion and a deliberate blocking call on a
+    dispatch fiber must be caught with trpc_analysis on and invisible
+    with it off."""
+    _run_native_suite("test_analysis.cc", "test_analysis_native",
+                      "analysis suite")
+
+
+# Wall-clock-window cases (the p99 guards) stay native under sanitizer
+# slowdown (TSan 5-15x, ASan ~2x plus its teardown quiesce): these
+# filters keep the old test_{qos,stripe}_under_tsan behavior of running
+# every suite-prefixed case only.
+_SAN_CASE_FILTER = {"qos": "qos", "stripe": "stripe"}
+
+
+def _run_suite_under(kind: str, suite: str, timeout: int = 900):
+    """Build suite with -fsanitize=<kind> via the shared cached harness
+    and fail on any sanitizer report."""
+    if san_build.compiler() is None:
         pytest.skip("no C++ compiler")
+    if not san_build.has_sanitizer(kind):
+        pytest.skip(f"toolchain lacks the {kind} sanitizer runtime")
     try:
-        exe = _build_direct(cxx, "test_qos.cc", "test_qos_native",
-                            tsan=False)
+        exe = san_build.test_binary(kind, f"test_{suite}.cc",
+                                    f"test_{suite}_{kind}")
     except subprocess.CalledProcessError as e:
-        pytest.fail(f"test_qos build failed:\n{e.stderr[-4000:]}")
-    out = subprocess.run([str(exe)], capture_output=True, text=True,
-                         timeout=420)
+        pytest.fail(f"{kind} build of {suite} failed:\n{e.stderr[-4000:]}")
+    cmd = [str(exe)]
+    if suite in _SAN_CASE_FILTER:
+        cmd.append(_SAN_CASE_FILTER[suite])
+    out = subprocess.run(cmd, capture_output=True, text=True,
+                         timeout=timeout, env=san_build.sanitizer_env(kind))
     assert out.returncode == 0, (
-        f"qos suite failed (rc={out.returncode}):\n{out.stderr[-8000:]}")
+        f"{suite} under {kind} sanitizer failed (rc={out.returncode}):\n"
+        f"{out.stderr[-8000:]}")
+    if kind == "thread":
+        assert "WARNING: ThreadSanitizer" not in out.stderr, (
+            f"TSan reported races in {suite}:\n{out.stderr[-8000:]}")
 
 
 @pytest.mark.slow
-def test_qos_under_tsan():
-    """ISSUE 6 satellite: the QoS layer's shared state — lane shard
-    queues, the drainer role handoff, the tenant weight registry, the
-    governor's limiters fed from handler completion fibers — all run hot
-    across read fibers and dispatch fibers.  Build runtime + test_qos
-    with ThreadSanitizer and run every qos-prefixed case (the
-    timing-bound p99 case stays native)."""
-    import os
-
-    cxx = shutil.which("g++") or shutil.which("c++")
-    if cxx is None:
-        pytest.skip("no C++ compiler")
-    probe = subprocess.run(
-        [cxx, "-fsanitize=thread", "-x", "c++", "-", "-o", "/dev/null"],
-        input="int main(){return 0;}", capture_output=True, text=True)
-    if probe.returncode != 0:
-        pytest.skip("toolchain lacks ThreadSanitizer runtime")
-    try:
-        exe = _build_direct(cxx, "test_qos.cc", "test_qos_tsan", tsan=True)
-    except subprocess.CalledProcessError as e:
-        pytest.fail(f"TSan build failed:\n{e.stderr[-4000:]}")
-    env = dict(os.environ)
-    env["TSAN_OPTIONS"] = (
-        f"suppressions={REPO / 'cpp' / 'tsan.supp'} halt_on_error=0 "
-        "exitcode=66")
-    out = subprocess.run([str(exe), "qos"], capture_output=True,
-                         text=True, timeout=900, env=env)
-    assert out.returncode == 0, (
-        f"qos tests under TSan failed (rc={out.returncode}):\n"
-        f"{out.stderr[-8000:]}")
-    assert "WARNING: ThreadSanitizer" not in out.stderr, (
-        f"TSan reported races in the QoS layer:\n{out.stderr[-8000:]}")
+@pytest.mark.san
+@pytest.mark.parametrize("suite", TSAN_SUITES)
+def test_suite_under_tsan(suite):
+    """ISSUE 7 tentpole: the concurrency-critical suites run under
+    ThreadSanitizer with cpp/tsan.supp holding ZERO rules — the blanket
+    TimerThread mutex:/deadlock:/race: lines died with the futex-mutex
+    timer rewrite, and race:Socket::ensure_connected died with the
+    getpeername connect probe + the base/tsan.h connect→readable edge.
+    (Subsumes the old test_qos_under_tsan / test_stripe_under_tsan and
+    their private build/tsan_obj build logic.)"""
+    _run_suite_under("thread", suite)
 
 
 @pytest.mark.slow
-def test_stripe_under_tsan():
-    """ISSUE 5 satellite: the stripe layer's new shared state — the
-    reassembly map, per-entry lander counts, the caller-landing registry
-    and the arena big-block pool — all run hot across parse fibers,
-    landing fibers and completion paths.  Build the runtime + test_stripe
-    with ThreadSanitizer (the repo's existing TSan config: cpp/tsan.supp)
-    and run every stripe case under it."""
-    import os
+@pytest.mark.san
+@pytest.mark.parametrize("suite", ALL_SUITES)
+def test_suite_under_asan(suite):
+    """ISSUE 7 tentpole: the FULL suite under ASan+LSan with
+    cpp/lsan.supp minimized to the two documented OpenSSL lines (the
+    leak:trpc::tstd_pack teardown suppression is gone — the state it
+    described no longer exists)."""
+    _run_suite_under("address", suite, timeout=600)
 
-    cxx = shutil.which("g++") or shutil.which("c++")
-    if cxx is None:
-        pytest.skip("no C++ compiler")
-    probe = subprocess.run(
-        [cxx, "-fsanitize=thread", "-x", "c++", "-", "-o", "/dev/null"],
-        input="int main(){return 0;}", capture_output=True, text=True)
-    if probe.returncode != 0:
-        pytest.skip("toolchain lacks ThreadSanitizer runtime")
-    try:
-        exe = _build_direct(cxx, "test_stripe.cc", "test_stripe_tsan",
-                            tsan=True)
-    except subprocess.CalledProcessError as e:
-        pytest.fail(f"TSan build failed:\n{e.stderr[-4000:]}")
-    env = dict(os.environ)
-    env["TSAN_OPTIONS"] = (
-        f"suppressions={REPO / 'cpp' / 'tsan.supp'} halt_on_error=0 "
-        "exitcode=66")
-    # Every stripe-prefixed case (the timing-bound p99 test stays native).
-    out = subprocess.run([str(exe), "stripe"], capture_output=True,
-                         text=True, timeout=900, env=env)
-    assert out.returncode == 0, (
-        f"stripe tests under TSan failed (rc={out.returncode}):\n"
-        f"{out.stderr[-8000:]}")
-    assert "WARNING: ThreadSanitizer" not in out.stderr, (
-        f"TSan reported races in the stripe layer:\n{out.stderr[-8000:]}")
+
+def test_fiber_suite_tsan_smoke():
+    """Tier-1 bounded sanitizer smoke (ISSUE 7 satellite): the fiber
+    suite — scheduler core, ParkingLot, timer shards, Event — under
+    TSan on every tier-1 run, so a race regression in the primitives
+    everything else builds on cannot wait for the `-m san` matrix.
+    ~4s on this box once the content-hash cache is warm."""
+    _run_suite_under("thread", "fiber", timeout=600)
 
 
 @pytest.mark.parametrize("target", _ctest_targets())

@@ -1,0 +1,62 @@
+"""Fuzz-corpus replay gate under ASan (ISSUE 7 satellite).
+
+Every cpp/fuzzing/fuzz_*.cc target (discovered, so a new parser target
+gates automatically) is built against the ASan runtime via the shared
+tests/san_build.py harness and replays its checked-in seed corpus plus
+the driver's deterministic structure-aware mutation sweep
+(cpp/fuzzing/fuzz_driver.h — fixed xorshift seed, repeatable).  A parser
+crash, overflow or leak fails the gate with the ASan report attached.
+
+`-m san` (slow matrix) like the suite matrices; skips cleanly when the
+toolchain lacks -fsanitize=address.
+"""
+
+import pathlib
+import subprocess
+
+import pytest
+
+import san_build
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FUZZ_DIR = REPO / "cpp" / "fuzzing"
+
+TARGETS = sorted(p.stem for p in FUZZ_DIR.glob("fuzz_*.cc"))
+
+# Replay + mutation volume per seed.  The whole sweep is milliseconds
+# per target on this box (parsers are pure CPU); the timeout below is
+# pure headroom for cold sanitizer runtimes.
+MUTATIONS_PER_SEED = 20000
+PER_TARGET_TIMEOUT_S = 120
+
+
+def test_targets_discovered():
+    # The wire-parser fuzz surface: one target per hand-rolled decoder.
+    assert len(TARGETS) >= 12, TARGETS
+    for t in TARGETS:
+        assert (FUZZ_DIR / "corpus" / t[len("fuzz_"):]).is_dir(), (
+            f"{t} has no seed corpus directory")
+
+
+@pytest.mark.slow
+@pytest.mark.san
+@pytest.mark.parametrize("target", TARGETS)
+def test_corpus_replay_under_asan(target):
+    if san_build.compiler() is None:
+        pytest.skip("no C++ compiler")
+    if not san_build.has_sanitizer("address"):
+        pytest.skip("toolchain lacks the address sanitizer runtime")
+    try:
+        exe = san_build.fuzz_binary("address", f"{target}.cc",
+                                    f"{target}_asan")
+    except subprocess.CalledProcessError as e:
+        pytest.fail(f"ASan build of {target} failed:\n{e.stderr[-4000:]}")
+    corpus = FUZZ_DIR / "corpus" / target[len("fuzz_"):]
+    out = subprocess.run(
+        [str(exe), str(corpus), str(MUTATIONS_PER_SEED)],
+        capture_output=True, text=True, timeout=PER_TARGET_TIMEOUT_S,
+        env=san_build.sanitizer_env("address"))
+    assert out.returncode == 0, (
+        f"{target} corpus replay under ASan failed "
+        f"(rc={out.returncode}):\n{out.stdout[-2000:]}\n"
+        f"{out.stderr[-8000:]}")

@@ -1,0 +1,293 @@
+#!/usr/bin/env python3
+"""lint_trpc — mechanical repo invariants the type system can't hold
+(ISSUE 7 tentpole, run in tier-1 via tests/test_lint_trpc.py).
+
+Rules (each names the incident class it prevents):
+
+  flag-validator     Every runtime `Flag::define_*` whose name is a
+                     `trpc_*` literal (or flows in via a variable, i.e.
+                     a wrapper/per-method definition) must install a
+                     set_validator (or set_reloadable(false)) nearby.
+                     Reloadable-without-validation means /flags?setvalue
+                     can land garbage in a hot path at runtime.
+
+  var-help           Every `expose(` call site must pass a description:
+                     the Prometheus exposition renders it as # HELP, and
+                     a bare metric name is unreadable on a dashboard
+                     three PRs later.
+
+  capi-gil           The Python boundary must release/reacquire the GIL
+                     around every native call: the library loads via
+                     ctypes.CDLL (never PyDLL — that HOLDS the GIL
+                     through the call, so a parked fiber wait would
+                     freeze the interpreter), and every capi symbol
+                     Python touches declares explicit marshalling —
+                     restype when the C return is a pointer/64-bit
+                     (silent truncation otherwise), argtypes when it
+                     takes arguments.
+
+  tail-group         The tstd optional meta-tail is positional: encode
+                     and decode must agree on the exact group sequence.
+                     `// tail-group N (name)` markers in protocol.cc
+                     must be unique, consecutive from 1, and identical
+                     between encode_meta and decode_meta — adding a
+                     sixth group to one side only is a wire break.
+
+  atomic-comment     Every memory_order_relaxed / memory_order_acquire
+                     in the socket/messenger/qos/stripe hot paths must
+                     carry a justification comment (same line or within
+                     the 4 lines above): a bare relaxed atomic is
+                     indistinguishable from a missed edge in review.
+
+Exit 0 clean; exit 1 with one line per violation.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CPP = REPO / "cpp"
+RUNTIME_DIRS = ["base", "fiber", "stat", "net", "capi"]
+
+violations: list = []
+
+
+def flag(path: pathlib.Path, line: int, rule: str, msg: str) -> None:
+    violations.append(
+        f"{path.relative_to(REPO)}:{line}: [{rule}] {msg}")
+
+
+def runtime_files(exts=(".cc", ".h")) -> list:
+    out = []
+    for d in RUNTIME_DIRS:
+        for p in sorted((CPP / d).iterdir()):
+            if p.suffix in exts:
+                out.append(p)
+    return out
+
+
+# ---- flag-validator ------------------------------------------------------
+
+def check_flag_validators() -> None:
+    call = re.compile(r"define_(?:bool|int64|double|string)\(")
+    for path in runtime_files():
+        lines = path.read_text().splitlines()
+        for i, text in enumerate(lines):
+            if not call.search(text):
+                continue
+            if ("Flag* Flag::define_" in text
+                    or "static Flag* define_" in text):
+                continue  # the registry's own declaration/definition
+            # First argument: the rest of this line + the next (the
+            # repo wraps define calls at most once before the name).
+            head = text + " " + (lines[i + 1] if i + 1 < len(lines) else "")
+            m = re.search(r"define_(?:bool|int64|double|string)\(\s*([^,)]+)",
+                          head)
+            first = m.group(1).strip() if m else ""
+            if first.startswith('"') and not first.startswith('"trpc_'):
+                continue  # non-trpc namespace: outside this rule
+            if not first or first.startswith("//"):
+                continue
+            # Window stops at the NEXT define_ call: a neighbour flag's
+            # set_validator must not be credited to this one.
+            window_lines = [text]
+            for nxt in lines[i + 1:i + 30]:
+                if call.search(nxt):
+                    break
+                window_lines.append(nxt)
+            window = "\n".join(window_lines)
+            if ("set_validator" not in window
+                    and "set_reloadable(false)" not in window):
+                flag(path, i + 1, "flag-validator",
+                     f"define of {first or '<flag>'} has no set_validator "
+                     "(or set_reloadable(false)) within 30 lines")
+
+
+# ---- var-help ------------------------------------------------------------
+
+def check_var_help() -> None:
+    site = re.compile(r"[\w\])](?:\.|->)expose\(")
+    for path in runtime_files():
+        text = path.read_text()
+        lines = text.splitlines()
+        for m in site.finditer(text):
+            start = text.index("(", m.start() + 1)
+            depth, j = 0, start
+            while j < len(text):
+                if text[j] == "(":
+                    depth += 1
+                elif text[j] == ")":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                j += 1
+            args = text[start + 1:j]
+            # ≥2 args ⇔ a comma at paren/brace depth 0 outside strings.
+            d, in_str, has_comma = 0, False, False
+            k = 0
+            while k < len(args):
+                c = args[k]
+                if in_str:
+                    if c == "\\":
+                        k += 2
+                        continue
+                    if c == '"':
+                        in_str = False
+                elif c == '"':
+                    in_str = True
+                elif c in "([{":
+                    d += 1
+                elif c in ")]}":
+                    d -= 1
+                elif c == "," and d == 0:
+                    has_comma = True
+                    break
+                k += 1
+            if not has_comma:
+                line = text[:m.start()].count("\n") + 1
+                snippet = lines[line - 1].strip()
+                flag(path, line, "var-help",
+                     f"expose() without a HELP description: {snippet}")
+
+
+# ---- capi-gil ------------------------------------------------------------
+
+def _extern_c_spans(text: str) -> list:
+    spans = []
+    for m in re.finditer(r'extern\s+"C"\s*\{', text):
+        depth, j = 0, m.end() - 1
+        while j < len(text):
+            if text[j] == "{":
+                depth += 1
+            elif text[j] == "}":
+                depth -= 1
+                if depth == 0:
+                    break
+            j += 1
+        spans.append((m.end(), j))
+    return spans
+
+
+def check_capi_bindings() -> None:
+    py_text = ""
+    for p in sorted((REPO / "brpc_tpu").rglob("*.py")):
+        py_text += p.read_text()
+    lib_py = REPO / "brpc_tpu" / "rpc" / "_lib.py"
+    if "ctypes.CDLL(" not in lib_py.read_text():
+        flag(lib_py, 1, "capi-gil",
+             "_lib.py must load the runtime via ctypes.CDLL")
+    if "PyDLL" in py_text:
+        for p in sorted((REPO / "brpc_tpu").rglob("*.py")):
+            for i, text in enumerate(p.read_text().splitlines()):
+                if "PyDLL" in text:
+                    flag(p, i + 1, "capi-gil",
+                         "PyDLL holds the GIL across native calls; "
+                         "bind through ctypes.CDLL")
+    sig = re.compile(
+        r"^([A-Za-z_][A-Za-z0-9_ ]*\**)\s*(trpc_[a-z0-9_]+)\s*\(([^)]*)",
+        re.M)
+    for path in sorted((CPP / "capi").glob("*.cc")):
+        text = path.read_text()
+        for lo, hi in _extern_c_spans(text):
+            body = text[lo:hi]
+            for m in sig.finditer(body):
+                ret, name, params = (m.group(1).strip(), m.group(2),
+                                     m.group(3).strip())
+                if f"lib.{name}" not in py_text:
+                    continue  # C++-side surface (tools/tests): no binding
+                line = text[:lo + m.start()].count("\n") + 1
+                wide = ("*" in ret or "int64" in ret or "uint64" in ret
+                        or "size_t" in ret)
+                if wide and f"lib.{name}.restype" not in py_text:
+                    flag(path, line, "capi-gil",
+                         f"{name} returns `{ret}` but no Python binding "
+                         "sets restype (defaults to 32-bit int)")
+                has_params = params not in ("", "void")
+                if has_params and f"lib.{name}.argtypes" not in py_text:
+                    flag(path, line, "capi-gil",
+                         f"{name} takes arguments but no Python binding "
+                         "sets argtypes")
+
+
+# ---- tail-group ----------------------------------------------------------
+
+def check_tail_groups() -> None:
+    path = CPP / "net" / "protocol.cc"
+    text = path.read_text()
+
+    def groups_in(fn: str) -> list:
+        m = re.search(rf"\n\S[^\n]*\b{fn}\(", text)
+        if m is None:
+            flag(path, 1, "tail-group", f"cannot locate {fn}()")
+            return []
+        # Function extent: up to the next top-level definition.
+        nxt = re.search(r"\n[A-Za-z_][^\n]*\([^\n]*\)\s*\{", text[m.end():])
+        body = text[m.start():m.end() + (nxt.start() if nxt else len(text))]
+        out = []
+        for g in re.finditer(r"//\s*tail-group\s+(\d+)\s*\(([a-z0-9_]+)\)",
+                             body):
+            out.append((int(g.group(1)), g.group(2)))
+        return out
+
+    enc = groups_in("encode_meta")
+    dec = groups_in("decode_meta")
+    for fn, seq in (("encode_meta", enc), ("decode_meta", dec)):
+        ids = [n for n, _ in seq]
+        if len(ids) != len(set(ids)):
+            flag(path, 1, "tail-group",
+                 f"{fn} has duplicate tail-group ids: {ids}")
+        if ids != sorted(ids) or (ids and ids != list(range(1, len(ids) + 1))):
+            flag(path, 1, "tail-group",
+                 f"{fn} tail-group ids not consecutive from 1: {ids}")
+    if enc and dec and enc != dec:
+        flag(path, 1, "tail-group",
+             f"encode/decode tail groups diverge: {enc} vs {dec} — "
+             "a one-sided group is a wire break")
+
+
+# ---- atomic-comment ------------------------------------------------------
+
+ATOMIC_FILES = [
+    "net/socket.cc", "net/socket.h", "net/messenger.cc", "net/messenger.h",
+    "net/qos.cc", "net/qos.h", "net/stripe.cc", "net/stripe.h",
+]
+ATOMIC_RE = re.compile(r"memory_order_(relaxed|acquire)\b")
+# "//" inside a string literal ("http://...") is not a comment.
+STRING_LIT_RE = re.compile(r'"(?:[^"\\]|\\.)*"')
+
+
+def check_atomic_comments() -> None:
+    for rel in ATOMIC_FILES:
+        path = CPP / rel
+        lines = path.read_text().splitlines()
+        for i, text in enumerate(lines):
+            if not ATOMIC_RE.search(text):
+                continue
+            window = [text] + lines[max(0, i - 4):i]
+            if any("//" in STRING_LIT_RE.sub('""', w) for w in window):
+                continue
+            flag(path, i + 1, "atomic-comment",
+                 "relaxed/acquire atomic without a justification comment "
+                 "(same line or the 4 lines above): " + text.strip())
+
+
+def main() -> int:
+    check_flag_validators()
+    check_var_help()
+    check_capi_bindings()
+    check_tail_groups()
+    check_atomic_comments()
+    if violations:
+        print(f"lint_trpc: {len(violations)} violation(s)")
+        for v in violations:
+            print("  " + v)
+        return 1
+    print("lint_trpc: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
