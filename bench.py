@@ -188,7 +188,8 @@ def _child_sweep(sizes: list[int]) -> None:
                 row["pipeline_depth"] = rpc["pipeline_depth"]
                 row["bytes_moved_per_iter"] = rpc["bytes_moved_per_iter"]
                 row["goodput_method"] = "rpc_call_batch"
-                for k in ("stripe_rails", "stripe_chunk_bytes"):
+                for k in ("stripe_rails", "stripe_chunk_bytes",
+                          "timeline"):
                     if k in rpc:
                         row[k] = rpc[k]
                 if rpc.get("vars"):
@@ -455,6 +456,11 @@ def _rpc_batch_goodput(size: int, depth: int = 8,
             try:
                 from brpc_tpu.rpc import get_flag
 
+                # Flight-recorder attribution (ISSUE 9): rows stamp
+                # whether trpc_timeline was recording during the
+                # measured window, so BENCH comparability across rounds
+                # is explicit (a timeline-on row is not the same series).
+                row["timeline"] = get_flag("trpc_timeline") == "true"
                 thr = int(get_flag("trpc_stripe_threshold"))
                 if thr > 0 and size > thr:  # 0 = striping disabled
                     row["stripe_rails"] = int(get_flag("trpc_stripe_rails"))
@@ -483,7 +489,7 @@ def _child_qos_mixed() -> None:
     acceptance metric (loaded p99 within 2x unloaded)."""
     import statistics
 
-    from brpc_tpu.rpc import Channel, Server, set_flag
+    from brpc_tpu.rpc import Channel, Server, get_flag, set_flag
 
     lanes = 4
     lane_weights = "8,4,2,1"
@@ -552,7 +558,10 @@ def _child_qos_mixed() -> None:
         "ratio_p99": round(p99(loaded) / max(p99(unloaded), 1.0), 3),
         "samples_loaded": len(loaded),
         # Lane/tenant config stamped on the row: a future run with a
-        # different config must not be read as the same series.
+        # different config must not be read as the same series.  The
+        # timeline stamp (ISSUE 9) keeps flight-recorder-on runs out of
+        # the comparable series too.
+        "timeline": get_flag("trpc_timeline") == "true",
         "qos_lanes": lanes,
         "lane_weights": lane_weights,
         "qos_spec": bg_spec,

@@ -384,6 +384,14 @@ def load_library() -> ctypes.CDLL:
                 ctypes.c_char_p, ctypes.c_size_t,
             ]
             lib.trpc_rpcz_dump.restype = ctypes.c_size_t
+            # Timeline flight recorder (ISSUE 9).
+            lib.trpc_timeline_dump.argtypes = [
+                ctypes.c_int, ctypes.c_size_t, ctypes.c_char_p,
+                ctypes.c_size_t,
+            ]
+            lib.trpc_timeline_dump.restype = ctypes.c_size_t
+            lib.trpc_timeline_enabled.restype = ctypes.c_int
+            lib.trpc_timeline_reset.restype = None
             lib.trpc_trace_get.argtypes = [
                 ctypes.POINTER(ctypes.c_uint64),
                 ctypes.POINTER(ctypes.c_uint64),

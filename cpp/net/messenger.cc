@@ -14,6 +14,7 @@
 #include "net/qos.h"
 #include "net/stream.h"
 #include "net/stripe.h"
+#include "stat/timeline.h"
 
 namespace trpc {
 
@@ -179,10 +180,19 @@ struct DispatchBatch {
       // parks this connection's dispatch fiber.  The analysis scope
       // (ISSUE 7) turns any park that slips through into a reported
       // no-pinned-read-fiber violation.
+      const SocketId sid = inline_msg->socket;
+      if (timeline::enabled()) {
+        timeline::record(timeline::kInlineBegin, sid, 0);
+      }
       tls_inline_dispatch = true;
-      analysis::ScopedDispatch scope("messenger inline-response window");
-      process_parsed_message(inline_msg);
+      {
+        analysis::ScopedDispatch scope("messenger inline-response window");
+        process_parsed_message(inline_msg);
+      }
       tls_inline_dispatch = false;
+      if (timeline::enabled()) {
+        timeline::record(timeline::kInlineEnd, sid, 0);
+      }
     }
   }
 };
@@ -192,9 +202,12 @@ struct DispatchBatch {
 // rest via one bulk fiber wakeup).  Order-sensitive frames (streams,
 // auth, in-order protocols) flush the batch first and run inline, so
 // per-connection processing order is exactly the pre-batching order.
-void cut_and_dispatch(Socket* s, SocketId id) {
+// Returns the number of whole messages cut (the flight recorder's
+// sweep_end cut count).
+size_t cut_and_dispatch(Socket* s, SocketId id) {
   IOBuf& buf = s->read_buf();
   DispatchBatch batch;
+  size_t cuts = 0;
   // QoS lane routing (net/qos.h): hoisted flag read — one atomic load
   // per sweep, zero when disabled (the default).
   const int qos_lanes = qos_lane_count();
@@ -232,6 +245,7 @@ void cut_and_dispatch(Socket* s, SocketId id) {
     }
     switch (rc) {
       case ParseError::kOk: {
+        ++cuts;
         if (msg->meta.type == RpcMeta::kStreamFrame) {
           // Stream frames keep per-connection arrival order: handled inline
           // (the per-stream ExecutionQueue serializes the user callback).
@@ -300,7 +314,7 @@ void cut_and_dispatch(Socket* s, SocketId id) {
       case ParseError::kNotEnoughData:
         free_input_message(msg);
         batch.flush();
-        return;
+        return cuts;
       default:
         LOG(Warning) << "corrupted input on " << endpoint2str(s->remote())
                      << " (pinned=" << s->pinned_protocol << " proto="
@@ -313,10 +327,11 @@ void cut_and_dispatch(Socket* s, SocketId id) {
         // Messages cut intact BEFORE the corruption still get delivered.
         batch.flush();
         s->SetFailed(EBADMSG);
-        return;
+        return cuts;
     }
   }
   batch.flush();
+  return cuts;
 }
 
 }  // namespace
@@ -330,6 +345,11 @@ void messenger_on_readable(SocketId id, void* /*ctx*/) {
   }
   const int64_t budget = cut_budget_flag()->int64_value();
   int64_t swept = 0;
+  size_t cuts_total = 0;
+  const bool tl = timeline::enabled();  // hoisted: one load per sweep
+  if (tl) {
+    timeline::record(timeline::kSweepStart, id, 0);
+  }
   while (!s->Failed()) {
     // Bulk hint: a parser that knows the current frame's remainder lets
     // this sweep read it in few large-block readvs instead of 512KB
@@ -341,7 +361,7 @@ void messenger_on_readable(SocketId id, void* /*ctx*/) {
     const ssize_t rc =
         s->transport()->append_to_iobuf(s, &s->read_buf(), want);
     if (rc > 0) {
-      cut_and_dispatch(s, id);
+      cuts_total += cut_and_dispatch(s, id);
       swept += rc;
       if (budget > 0 && swept >= budget) {
         // Cut budget spent: hand the worker to whatever queued behind
@@ -365,6 +385,9 @@ void messenger_on_readable(SocketId id, void* /*ctx*/) {
     }
     s->SetFailed(errno != 0 ? errno : ECONNRESET);
     break;
+  }
+  if (tl) {
+    timeline::record(timeline::kSweepEnd, id, cuts_total);
   }
   s->Dereference();
 }

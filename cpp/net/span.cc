@@ -11,6 +11,8 @@
 #include "base/rand.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
+#include "fiber/scheduler.h"
+#include "stat/timeline.h"
 
 namespace trpc {
 
@@ -94,33 +96,28 @@ Flag* rpcz_ring_size_flag() {
   return f;
 }
 
-// Ambient (fiber-local) trace context, stored by VALUE: the two u64 ids
-// ride directly in two fls pointer slots (no per-RPC allocation, no
-// destructor, and the Span object may die before a child fiber reads the
-// context).
-fls_key_t ambient_trace_key() {
-  static fls_key_t key = [] {
-    fls_key_t k;
-    fls_key_create(&k, nullptr);
-    return k;
-  }();
-  return key;
-}
-
-fls_key_t ambient_span_key() {
-  static fls_key_t key = [] {
-    fls_key_t k;
-    fls_key_create(&k, nullptr);
-    return k;
-  }();
-  return key;
-}
+// Ambient (fiber-local) trace context, stored by VALUE directly on the
+// FiberMeta (two relaxed-atomic u64 fields — no per-RPC allocation, no
+// destructor, and the Span object may die before a child fiber reads
+// the context).  Moved off FLS slots in ISSUE 9: the timeline recorder's
+// scheduler-side events (ready/wake, emitted on the WAKER's thread) must
+// read the TARGET fiber's context, which thread-keyed fls_get cannot
+// serve.
 
 // Off-fiber fallback: ctypes callers (Python threads) have no fiber
 // context, but must still be able to install a trace around their sync
 // calls — trpc_trace_set / trpc_span_start land here.
 thread_local uint64_t tls_ambient_trace = 0;
 thread_local uint64_t tls_ambient_span = 0;
+
+// Register the ambient context as the flight recorder's context reader
+// (stat/timeline.h): every timeline event carries the trace/span of the
+// fiber (or pthread) that emitted it.  Safe at static init — the hook
+// slot is a constant-initialized atomic.
+[[maybe_unused]] const bool g_timeline_ctx_hook = [] {
+  timeline::set_context_reader(&get_ambient_trace);
+  return true;
+}();
 
 }  // namespace
 
@@ -139,6 +136,7 @@ Span* start_span(bool server_side, const std::string& method,
   auto* s = new Span();
   s->server_side = server_side;
   s->method = method;
+  s->fid = fiber_self();  // exact span↔timeline join key (0 off-fiber)
   s->start_us = monotonic_time_us();
   s->span_id = new_span_id();
   if (trace_id != 0) {
@@ -189,9 +187,13 @@ void set_ambient_span(const Span* s) {
 }
 
 void set_ambient_trace(uint64_t trace_id, uint64_t span_id) {
-  if (in_fiber()) {
-    fls_set(ambient_trace_key(), reinterpret_cast<void*>(trace_id));
-    fls_set(ambient_span_key(), reinterpret_cast<void*>(span_id));
+  Worker* w = tls_worker;
+  if (w != nullptr && w->current() != nullptr) {
+    // Relaxed: same-fiber reads are program-ordered (migration rides the
+    // scheduler's queue handoff); cross-thread timeline reads tolerate a
+    // stale snapshot (see scheduler.h).
+    w->current()->ambient_trace.store(trace_id, std::memory_order_relaxed);
+    w->current()->ambient_span.store(span_id, std::memory_order_relaxed);
   } else {
     tls_ambient_trace = trace_id;
     tls_ambient_span = span_id;
@@ -199,9 +201,11 @@ void set_ambient_trace(uint64_t trace_id, uint64_t span_id) {
 }
 
 void get_ambient_trace(uint64_t* trace_id, uint64_t* span_id) {
-  if (in_fiber()) {
-    *trace_id = reinterpret_cast<uint64_t>(fls_get(ambient_trace_key()));
-    *span_id = reinterpret_cast<uint64_t>(fls_get(ambient_span_key()));
+  Worker* w = tls_worker;
+  if (w != nullptr && w->current() != nullptr) {
+    // Relaxed: own-fiber context read (see set_ambient_trace).
+    *trace_id = w->current()->ambient_trace.load(std::memory_order_relaxed);
+    *span_id = w->current()->ambient_span.load(std::memory_order_relaxed);
   } else {
     *trace_id = tls_ambient_trace;
     *span_id = tls_ambient_span;
@@ -254,6 +258,7 @@ std::string rpcz_dump_json(size_t limit, uint64_t trace_id) {
     j.set("trace_id", Json::str(hex_id(s.trace_id)));
     j.set("span_id", Json::str(hex_id(s.span_id)));
     j.set("parent_span_id", Json::str(hex_id(s.parent_span_id)));
+    j.set("fid", Json::str(hex_id(s.fid)));
     j.set("side", Json::str(s.server_side ? "server" : "client"));
     j.set("method", Json::str(s.method));
     j.set("start_us", Json::number(static_cast<double>(s.start_us)));

@@ -1,0 +1,400 @@
+#include "stat/timeline.h"
+
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <vector>
+
+#include "base/flags.h"
+#include "base/json.h"
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "fiber/scheduler.h"
+#include "stat/variable.h"
+
+namespace trpc {
+namespace timeline {
+
+std::atomic<bool> g_enabled{false};
+
+namespace {
+
+// One recorded event.  Every field is an atomic so a concurrent dump is
+// race-free under TSan; the per-slot seqlock below is what makes the
+// VALUES coherent (torn slots are discarded, never surfaced).  64 bytes
+// = one cache line per slot.
+struct Slot {
+  std::atomic<uint64_t> seq{0};  // absolute index + 1; 0 = being written
+  std::atomic<int64_t> ts_us{0};
+  std::atomic<uint64_t> a{0};
+  std::atomic<uint64_t> b{0};
+  std::atomic<uint64_t> trace_id{0};
+  std::atomic<uint64_t> span_id{0};
+  std::atomic<uint64_t> fid{0};
+  std::atomic<uint32_t> type{0};
+  uint32_t pad = 0;
+};
+static_assert(sizeof(Slot) == 64, "one cache line per slot");
+
+struct Ring {
+  explicit Ring(size_t nslots) : slots(nslots), mask(nslots - 1) {}
+  std::vector<Slot> slots;  // power-of-two
+  const uint64_t mask;
+  // head = lifetime events written by the owner thread (single writer).
+  std::atomic<uint64_t> head{0};
+  // Dumps hide indices below floor (reset() support); writers ignore it.
+  std::atomic<uint64_t> floor{0};
+  uint64_t tid = 0;
+  char name[16] = {};
+};
+
+std::mutex& registry_mu() {
+  static std::mutex* mu = new std::mutex();
+  return *mu;
+}
+
+// Leaked, append-only: a ring outlives its thread so late dumps stay
+// safe, and readers can walk the vector snapshot without per-ring locks.
+std::vector<Ring*>& rings() {
+  static auto* v = new std::vector<Ring*>();
+  return *v;
+}
+
+std::atomic<void (*)(uint64_t*, uint64_t*)> g_ctx_reader{nullptr};
+
+Flag* ring_kb_flag() {
+  static Flag* f = [] {
+    Flag* flag = Flag::define_int64(
+        "trpc_timeline_ring_kb", 256,
+        "per-thread flight-recorder ring size in KB (64 bytes/event; "
+        "applies to rings created after the set — a live thread keeps "
+        "its ring)");
+    if (flag != nullptr) {
+      flag->set_validator([](const std::string& v) {
+        char* end = nullptr;
+        const long n = strtol(v.c_str(), &end, 10);
+        return end != v.c_str() && *end == '\0' && n >= 64 && n <= 65536;
+      });
+    }
+    return flag;
+  }();
+  return f;
+}
+
+Flag* timeline_flag() {
+  static Flag* f = [] {
+    ring_kb_flag();  // companion knob registers alongside
+    Flag* flag = Flag::define_bool(
+        "trpc_timeline", false,
+        "flight recorder: per-thread rings of fiber/messenger/socket/"
+        "stripe/QoS timeline events, browsable via /timeline and merged "
+        "into Perfetto by tools/trace_stitch.py --timeline (default off; "
+        "flag-off cost is one relaxed load per hook)");
+    if (flag != nullptr) {
+      flag->set_validator([](const std::string& v) {
+        return v == "true" || v == "false" || v == "1" || v == "0" ||
+               v == "on" || v == "off";
+      });
+      flag->on_update([](Flag* self) {
+        g_enabled.store(self->bool_value(), std::memory_order_release);
+      });
+    }
+    return flag;
+  }();
+  return f;
+}
+
+struct TimelineVars {
+  std::unique_ptr<PassiveStatus<long>> events;
+  std::unique_ptr<PassiveStatus<long>> ring_gauge;
+
+  TimelineVars() {
+    events = std::make_unique<PassiveStatus<long>>(
+        [] { return static_cast<long>(events_total()); });
+    events->expose(
+        "timeline_events_total",
+        "flight-recorder events written across all per-thread rings "
+        "(frozen at 0 while trpc_timeline has never been on)");
+    ring_gauge = std::make_unique<PassiveStatus<long>>(
+        [] { return static_cast<long>(ring_count()); });
+    ring_gauge->expose(
+        "timeline_rings",
+        "per-thread flight-recorder rings created so far");
+  }
+};
+
+thread_local Ring* tls_ring = nullptr;
+
+uint64_t pow2_floor(uint64_t n) {
+  uint64_t p = 1;
+  while (p * 2 <= n) {
+    p *= 2;
+  }
+  return p;
+}
+
+Ring* ring_for_this_thread() {
+  Ring* r = tls_ring;
+  if (r != nullptr) {
+    return r;
+  }
+  const int64_t kb = ring_kb_flag()->int64_value();
+  const uint64_t nslots =
+      pow2_floor(std::max<uint64_t>(256, kb * 1024 / sizeof(Slot)));
+  r = new Ring(nslots);
+  r->tid = static_cast<uint64_t>(syscall(SYS_gettid));
+  Worker* w = tls_worker;
+  if (w != nullptr) {
+    snprintf(r->name, sizeof(r->name), "w%d.%d", w->tag(), w->index());
+  } else {
+    snprintf(r->name, sizeof(r->name), "thread");
+  }
+  {
+    std::lock_guard<std::mutex> g(registry_mu());
+    rings().push_back(r);
+  }
+  tls_ring = r;
+  return r;
+}
+
+void write_event(uint32_t type, uint64_t a, uint64_t b, uint64_t trace_id,
+                 uint64_t span_id) {
+  Ring* r = ring_for_this_thread();
+  // Relaxed single-writer head read: only this thread advances it.
+  const uint64_t idx = r->head.load(std::memory_order_relaxed);
+  Slot& s = r->slots[idx & r->mask];
+  // Per-slot seqlock write: invalidate, fence, payload, publish.  The
+  // release fence orders the invalidation before the payload stores so
+  // a dump that read any new payload byte also sees seq == 0 at its
+  // re-check (the standard seqlock store-store edge).
+  // Relaxed: ordered by the release fence below, not by this store.
+  s.seq.store(0, std::memory_order_relaxed);
+  std::atomic_thread_fence(std::memory_order_release);
+  // Relaxed payload: coherence comes from the seqlock protocol (readers
+  // discard slots whose seq moved), not from per-field ordering.
+  s.ts_us.store(monotonic_time_us(), std::memory_order_relaxed);
+  s.a.store(a, std::memory_order_relaxed);
+  s.b.store(b, std::memory_order_relaxed);
+  s.trace_id.store(trace_id, std::memory_order_relaxed);
+  s.span_id.store(span_id, std::memory_order_relaxed);
+  s.fid.store(fiber_self(), std::memory_order_relaxed);
+  s.type.store(type, std::memory_order_relaxed);
+  s.seq.store(idx + 1, std::memory_order_release);
+  r->head.store(idx + 1, std::memory_order_release);
+}
+
+struct EventCopy {
+  int64_t ts_us;
+  uint64_t a, b, trace_id, span_id, fid;
+  uint32_t type;
+};
+
+// Snapshot of one ring's visible window, oldest first.  Slots the writer
+// is overwriting (or has lapped) fail the seqlock re-check and drop out.
+std::vector<EventCopy> snapshot(Ring* r, size_t limit) {
+  std::vector<EventCopy> out;
+  // Acquire: pairs with the writer's release publish so every slot at or
+  // below head is at least attempted.
+  const uint64_t h = r->head.load(std::memory_order_acquire);
+  const uint64_t cap = r->mask + 1;
+  uint64_t lo = h > cap ? h - cap : 0;
+  // Acquire: a reset() racing this dump must hide a coherent prefix.
+  // The floor is snapshotted AFTER head, so it can momentarily exceed
+  // our h — that means "everything you saw is hidden", not underflow.
+  const uint64_t floor = r->floor.load(std::memory_order_acquire);
+  lo = std::max(lo, floor);
+  if (lo >= h) {
+    return out;
+  }
+  if (limit > 0 && h - lo > limit) {
+    lo = h - limit;
+  }
+  out.reserve(h - lo);
+  for (uint64_t idx = lo; idx < h; ++idx) {
+    Slot& s = r->slots[idx & r->mask];
+    // Acquire: pairs with the writer's release publish of this slot.
+    if (s.seq.load(std::memory_order_acquire) != idx + 1) {
+      continue;  // being rewritten / already lapped
+    }
+    EventCopy e;
+    // Relaxed payload reads validated by the seqlock re-check below.
+    e.ts_us = s.ts_us.load(std::memory_order_relaxed);
+    e.a = s.a.load(std::memory_order_relaxed);
+    e.b = s.b.load(std::memory_order_relaxed);
+    e.trace_id = s.trace_id.load(std::memory_order_relaxed);
+    e.span_id = s.span_id.load(std::memory_order_relaxed);
+    e.fid = s.fid.load(std::memory_order_relaxed);
+    e.type = s.type.load(std::memory_order_relaxed);
+    std::atomic_thread_fence(std::memory_order_acquire);
+    // Relaxed re-check: the fence above closes the torn-read window.
+    if (s.seq.load(std::memory_order_relaxed) != idx + 1) {
+      continue;  // torn: the writer lapped us mid-copy
+    }
+    out.push_back(e);
+  }
+  return out;
+}
+
+std::vector<Ring*> ring_snapshot() {
+  std::lock_guard<std::mutex> g(registry_mu());
+  return rings();
+}
+
+std::string hex_id(uint64_t id) {
+  char buf[20];
+  snprintf(buf, sizeof(buf), "%016llx",
+           static_cast<unsigned long long>(id));
+  return buf;
+}
+
+template <typename T>
+void append_le(std::string* out, T v) {
+  char buf[sizeof(T)];
+  memcpy(buf, &v, sizeof(T));
+  out->append(buf, sizeof(T));
+}
+
+// Eager registration: /flags can list+flip trpc_timeline and /vars shows
+// the zeroed series before any traffic (same pattern as the stripe/QoS
+// eager flag definitions).
+[[maybe_unused]] const bool g_timeline_eager = [] {
+  ensure_registered();
+  return true;
+}();
+
+}  // namespace
+
+void ensure_registered() {
+  timeline_flag();
+  // Deliberately leaked (the registry outlives statics), volatile so the
+  // otherwise-unread pointer store survives optimization — without a
+  // live root LSan reports the singleton as a direct leak.
+  static TimelineVars* volatile vars = new TimelineVars();
+  (void)vars;
+}
+
+void set_context_reader(void (*fn)(uint64_t*, uint64_t*)) {
+  g_ctx_reader.store(fn, std::memory_order_release);
+}
+
+void record(uint32_t type, uint64_t a, uint64_t b) {
+  if (!enabled()) {
+    return;  // call sites gate too; this is belt-and-braces
+  }
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+  // Acquire: the reader fn must be fully published before invocation.
+  auto fn = g_ctx_reader.load(std::memory_order_acquire);
+  if (fn != nullptr) {
+    fn(&trace_id, &span_id);
+  }
+  write_event(type, a, b, trace_id, span_id);
+}
+
+void record_ctx(uint32_t type, uint64_t a, uint64_t b, uint64_t trace_id,
+                uint64_t span_id) {
+  if (!enabled()) {
+    return;
+  }
+  write_event(type, a, b, trace_id, span_id);
+}
+
+std::string dump_json(size_t per_thread_limit) {
+  ensure_registered();
+  Json root = Json::object();
+  root.set("pid", Json::number(getpid()));
+  // Mono/wall pair read back-to-back (same contract as rpcz_dump_json):
+  // the stitcher maps this node's monotonic event times onto wall clock.
+  root.set("now_mono_us",
+           Json::number(static_cast<double>(monotonic_time_us())));
+  root.set("now_wall_us",
+           Json::number(static_cast<double>(realtime_us())));
+  root.set("enabled", Json::boolean(enabled()));
+  Json threads = Json::array();
+  for (Ring* r : ring_snapshot()) {
+    Json t = Json::object();
+    t.set("tid", Json::number(static_cast<double>(r->tid)));
+    t.set("name", Json::str(r->name));
+    Json events = Json::array();
+    for (const EventCopy& e : snapshot(r, per_thread_limit)) {
+      Json j = Json::object();
+      j.set("ts_us", Json::number(static_cast<double>(e.ts_us)));
+      j.set("type", Json::number(e.type));
+      j.set("name", Json::str(e.type < kEventTypeCount
+                                  ? kEventNames[e.type]
+                                  : "unknown"));
+      // Hex strings, not numbers: a/b often carry versioned 64-bit
+      // handles (fid, socket id) whose low bits a JSON double rounds
+      // away past 2^53 — same convention as the trace/span ids.
+      j.set("a", Json::str(hex_id(e.a)));
+      j.set("b", Json::str(hex_id(e.b)));
+      j.set("trace_id", Json::str(hex_id(e.trace_id)));
+      j.set("span_id", Json::str(hex_id(e.span_id)));
+      j.set("fid", Json::str(hex_id(e.fid)));
+      events.push_back(std::move(j));
+    }
+    t.set("events", std::move(events));
+    threads.push_back(std::move(t));
+  }
+  root.set("threads", std::move(threads));
+  return root.dump();
+}
+
+std::string dump_binary(size_t per_thread_limit) {
+  ensure_registered();
+  std::string out;
+  out.append("TRPCTL01", 8);
+  append_le<int64_t>(&out, monotonic_time_us());
+  append_le<int64_t>(&out, realtime_us());
+  std::vector<Ring*> rs = ring_snapshot();
+  append_le<uint32_t>(&out, static_cast<uint32_t>(rs.size()));
+  for (Ring* r : rs) {
+    const std::vector<EventCopy> evs = snapshot(r, per_thread_limit);
+    append_le<uint64_t>(&out, r->tid);
+    out.append(r->name, sizeof(r->name));
+    append_le<uint32_t>(&out, static_cast<uint32_t>(evs.size()));
+    for (const EventCopy& e : evs) {
+      append_le<uint32_t>(&out, e.type);
+      append_le<int64_t>(&out, e.ts_us);
+      append_le<uint64_t>(&out, e.a);
+      append_le<uint64_t>(&out, e.b);
+      append_le<uint64_t>(&out, e.trace_id);
+      append_le<uint64_t>(&out, e.span_id);
+      append_le<uint64_t>(&out, e.fid);
+    }
+  }
+  return out;
+}
+
+void reset() {
+  for (Ring* r : ring_snapshot()) {
+    // Acquire on head: the floor must cover every event published so
+    // far, not a stale head that would leave old events visible.
+    r->floor.store(r->head.load(std::memory_order_acquire),
+                   std::memory_order_release);
+  }
+}
+
+uint64_t events_total() {
+  uint64_t n = 0;
+  for (Ring* r : ring_snapshot()) {
+    // Relaxed: a lifetime counter read for /vars — transient skew is
+    // fine, no data hangs off the sum.
+    n += r->head.load(std::memory_order_relaxed);
+  }
+  return n;
+}
+
+int ring_count() {
+  std::lock_guard<std::mutex> g(registry_mu());
+  return static_cast<int>(rings().size());
+}
+
+}  // namespace timeline
+}  // namespace trpc

@@ -1,0 +1,171 @@
+// Always-on runtime flight recorder (ISSUE 9) — per-thread lock-free
+// rings of fixed-size binary events tracing fiber scheduler transitions,
+// messenger phases, socket write-path decisions, stripe chunk lifecycle
+// and QoS lane drains, all joinable to rpcz spans via the trace/span ids
+// stamped into every event (and the fiber id stamped into every span).
+//
+// Why a timeline tier on top of the sampling tier (vars, rpcz, pprof):
+// a span says an RPC took 9ms; only a timeline says WHERE the 9ms went —
+// runnable-but-not-scheduled, parked on a lane drainer, waiting on a
+// stripe rail, or stuck behind a coalesced write.  The recorder is gated
+// by the reloadable `trpc_timeline` flag (default off); with the flag
+// off every hook is ONE relaxed atomic load + branch, the same contract
+// as `trpc_analysis` (perf-smoke floors gate it).
+//
+// Ring model: one single-writer ring per OS thread (the owning thread is
+// the only producer, so writes are wait-free — no CAS, no lock).  Each
+// slot is a per-slot seqlock: the writer invalidates seq, stores the
+// payload, then publishes seq = absolute-index+1 with release; a dump
+// re-reads seq around the payload and discards torn slots.  Payload
+// fields are relaxed atomics so concurrent dumps are race-free under
+// TSan without taxing the writer (plain MOVs on x86).  Rings are sized
+// by `trpc_timeline_ring_kb` at ring creation and overwrite oldest —
+// a flight recorder keeps the recent window, not history.
+//
+// Readers: the /timeline builtin (JSON + binary), the trpc_timeline_*
+// C API (brpc_tpu/rpc/observe.py timeline()), and tools/trace_stitch.py
+// --timeline which merges these events with stitched rpcz spans into
+// ONE Perfetto file.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+
+namespace trpc {
+namespace timeline {
+
+// Event-type table.  MUST stay in lockstep with TIMELINE_EVENTS in
+// brpc_tpu/rpc/observe.py — tools/lint_trpc.py's timeline-event rule
+// compares the `timeline-event N (name)` markers on kEventNames below
+// against the Python decoder's and requires ids consecutive from 1 and
+// identical both sides.  Ids are APPEND-ONLY: a recorded binary dump
+// must stay decodable by a newer reader.
+enum EventType : uint32_t {
+  kNone = 0,
+  // -- fiber scheduler transitions (a = target fid unless noted) --------
+  kFiberCreate = 1,   // a=fid
+  kFiberReady = 2,    // a=fid (first publish of a never-run fiber)
+  kFiberRun = 3,      // a=fid b=worker index
+  kFiberPark = 4,     // a=fid (suspends; Event wait / yield)
+  kFiberWake = 5,     // a=fid (re-publish of a fiber that ran before)
+  kFiberSteal = 6,    // a=fid b=victim worker index
+  kFiberMigrate = 7,  // a=fid b=new worker index (ran elsewhere before)
+  kFiberDone = 8,     // a=fid
+  // -- messenger phases -------------------------------------------------
+  kSweepStart = 9,    // a=socket id
+  kSweepEnd = 10,     // a=socket id b=messages cut this sweep
+  kInlineBegin = 11,  // a=socket id (inline-response window opens)
+  kInlineEnd = 12,    // a=socket id
+  kBulkWake = 13,     // a=batch size (one ParkingLot signal for a spawns)
+  // -- socket write path ------------------------------------------------
+  kWriteFlush = 14,     // a=socket id b=bytes flushed inline (wait-free)
+  kWriterHandoff = 15,  // a=socket id (role handed to a KeepWrite fiber)
+  kWriteCoalesce = 16,  // a=socket id b=queued Writes absorbed by a drain
+  // -- stripe chunk lifecycle (a = stripe_id) ---------------------------
+  kStripeCut = 17,   // b=total body bytes (sender starts cutting)
+  kStripeSend = 18,  // b=(rail index << 48) | chunk offset; rail index
+                     // kStripePrimaryRail = the call's primary socket
+                     // (head frame, or a dead-rail fallback retry)
+  kStripeLand = 19,  // b=chunk offset (receiver-side memcpy done)
+  kStripeDone = 20,  // b=total (reassembly complete, dispatching)
+  // -- QoS lane drains --------------------------------------------------
+  kQosDrain = 21,  // a=(lane | shard cursor << 8) b=round quantum
+  kEventTypeCount,
+};
+
+// Names rendered in the JSON dump and Perfetto export; lint markers on
+// each entry keep this table and the Python decoder's in lockstep.
+constexpr const char* kEventNames[] = {
+    "none",
+    "fiber_create",    // timeline-event 1 (fiber_create)
+    "fiber_ready",     // timeline-event 2 (fiber_ready)
+    "fiber_run",       // timeline-event 3 (fiber_run)
+    "fiber_park",      // timeline-event 4 (fiber_park)
+    "fiber_wake",      // timeline-event 5 (fiber_wake)
+    "fiber_steal",     // timeline-event 6 (fiber_steal)
+    "fiber_migrate",   // timeline-event 7 (fiber_migrate)
+    "fiber_done",      // timeline-event 8 (fiber_done)
+    "sweep_start",     // timeline-event 9 (sweep_start)
+    "sweep_end",       // timeline-event 10 (sweep_end)
+    "inline_begin",    // timeline-event 11 (inline_begin)
+    "inline_end",      // timeline-event 12 (inline_end)
+    "bulk_wake",       // timeline-event 13 (bulk_wake)
+    "write_flush",     // timeline-event 14 (write_flush)
+    "writer_handoff",  // timeline-event 15 (writer_handoff)
+    "write_coalesce",  // timeline-event 16 (write_coalesce)
+    "stripe_cut",      // timeline-event 17 (stripe_cut)
+    "stripe_send",     // timeline-event 18 (stripe_send)
+    "stripe_land",     // timeline-event 19 (stripe_land)
+    "stripe_done",     // timeline-event 20 (stripe_done)
+    "qos_drain",       // timeline-event 21 (qos_drain)
+};
+static_assert(sizeof(kEventNames) / sizeof(kEventNames[0]) ==
+                  kEventTypeCount,
+              "kEventNames must cover every EventType");
+
+// kStripeSend rail index meaning "the call's primary socket" — the head
+// frame always rides the primary, and a chunk whose rail died retries
+// there; labeling either as rail 0 would mis-attribute load to a real
+// rail track.  Mirrored by the Python decoders.
+constexpr uint64_t kStripePrimaryRail = 0xffff;
+
+// Backing switch for the reloadable trpc_timeline flag (the flag's
+// on_update hook writes it; hot-path gates inline to one relaxed load).
+extern std::atomic<bool> g_enabled;
+// Registers the flags + vars (idempotent); any surface that can flip the
+// flag before first traffic calls it (builtin /flags does via the eager
+// definition in timeline.cc).
+void ensure_registered();
+
+inline bool enabled() {
+  return g_enabled.load(std::memory_order_relaxed);
+}
+
+// Records one event into the calling thread's ring.  trace/span context
+// is read through the registered context reader (net/span.cc registers
+// its ambient-trace accessor, covering fibers AND plain pthreads); the
+// emitting fiber id is captured automatically.  Call sites MUST gate on
+// enabled() themselves — record() re-checks, but the call itself should
+// cost nothing when the flag is off.
+void record(uint32_t type, uint64_t a, uint64_t b);
+// Same, with an explicit trace/span context — the scheduler uses this to
+// stamp the TARGET fiber's ambient trace onto ready/wake events emitted
+// from the waker's thread.
+void record_ctx(uint32_t type, uint64_t a, uint64_t b, uint64_t trace_id,
+                uint64_t span_id);
+
+// Installs the ambient-trace accessor record() consults (net/span.cc's
+// get_ambient_trace).  A hook instead of a direct include keeps stat/
+// from depending on net/.
+void set_context_reader(void (*fn)(uint64_t* trace_id, uint64_t* span_id));
+
+// Structured dump shared by /timeline?format=json and
+// trpc_timeline_dump: {"pid","now_mono_us","now_wall_us","enabled",
+// "threads":[{"tid","name","events":[{"ts_us","type","name","a","b",
+// "trace_id","span_id","fid"}]}]}.  ALL 64-bit fields (a, b and the
+// ids) render as 16-hex-digit strings — a/b often carry versioned
+// handles whose low bits a JSON double rounds away past 2^53 (same
+// convention as rpcz_dump_json).  Newest `per_thread_limit` events per
+// thread, oldest first within a thread.
+std::string dump_json(size_t per_thread_limit);
+// Compact binary form (observe.py parses it with struct): header
+// {char magic[8]="TRPCTL01", i64 now_mono_us, i64 now_wall_us,
+// u32 nrings}; per ring {u64 tid, char name[16], u32 nevents}; events
+// packed little-endian {u32 type, i64 ts_us, u64 a, u64 b, u64 trace_id,
+// u64 span_id, u64 fid} (52 bytes each, no padding).
+std::string dump_binary(size_t per_thread_limit);
+
+// Test support: hides everything recorded so far (raises each ring's
+// floor to its head — safe against concurrent writers; nothing is
+// deallocated).  Lifetime counters keep counting.
+void reset();
+
+// Lifetime events recorded across all rings (the timeline_events_total
+// var; provably frozen at 0 while the flag has never been on).
+uint64_t events_total();
+// Per-thread rings created so far (the timeline_rings var).
+int ring_count();
+
+}  // namespace timeline
+}  // namespace trpc

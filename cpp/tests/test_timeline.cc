@@ -1,0 +1,279 @@
+// Flight-recorder tests (stat/timeline.h, ISSUE 9): flag-off
+// invisibility (vars frozen at 0, no rings created), ring wrap keeping
+// the newest window, per-thread event ordering under live RPC load,
+// stripe chunk lifecycle + QoS lane-drain events present under the
+// matching workloads, and reset() hiding recorded history.  Also runs
+// under TSan via tests/test_cpp.py (the per-slot seqlock must be
+// race-clean on merit — concurrent dumps race live writers by design).
+#include "stat/timeline.h"
+
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+#include "base/flags.h"
+#include "base/json.h"
+#include "fiber/fiber.h"
+#include "net/channel.h"
+#include "net/server.h"
+#include "stat/variable.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+namespace {
+
+Server* g_server = nullptr;
+int g_port = 0;
+
+void start_once() {
+  if (g_server != nullptr) {
+    return;
+  }
+  g_server = new Server();
+  g_server->RegisterMethod("Echo.Echo", [](Controller*, const IOBuf& req,
+                                           IOBuf* resp, Closure done) {
+    resp->append(req);
+    done();
+  });
+  EXPECT_EQ(g_server->Start(0), 0);
+  g_port = g_server->port();
+}
+
+std::string addr() { return "127.0.0.1:" + std::to_string(g_port); }
+
+void set_timeline(bool on) {
+  timeline::ensure_registered();
+  EXPECT_EQ(Flag::set("trpc_timeline", on ? "true" : "false"), 0);
+}
+
+struct Ev {
+  int64_t ts_us;
+  uint32_t type;
+  uint64_t a, b;
+};
+
+// Parsed {thread name -> events} view of dump_json (the same body
+// /timeline serves — testing through the real surface).
+std::vector<std::vector<Ev>> parse_dump(size_t limit = 1 << 16) {
+  Json root;
+  EXPECT(Json::parse(timeline::dump_json(limit), &root));
+  const Json* threads = root.find("threads");
+  EXPECT(threads != nullptr);
+  std::vector<std::vector<Ev>> out;
+  for (size_t i = 0; i < threads->size(); ++i) {
+    const Json& t = (*threads)[i];
+    const Json* evs = t.find("events");
+    EXPECT(evs != nullptr);
+    std::vector<Ev> list;
+    for (size_t j = 0; j < evs->size(); ++j) {
+      const Json& e = (*evs)[j];
+      // a/b render as 16-hex strings (64-bit handles; doubles round).
+      list.push_back(Ev{
+          static_cast<int64_t>(e.find("ts_us")->as_number()),
+          static_cast<uint32_t>(e.find("type")->as_number()),
+          strtoull(e.find("a")->as_string().c_str(), nullptr, 16),
+          strtoull(e.find("b")->as_string().c_str(), nullptr, 16),
+      });
+    }
+    out.push_back(std::move(list));
+  }
+  return out;
+}
+
+size_t count_type(const std::vector<std::vector<Ev>>& dump, uint32_t type) {
+  size_t n = 0;
+  for (const auto& t : dump) {
+    for (const Ev& e : t) {
+      n += e.type == type ? 1 : 0;
+    }
+  }
+  return n;
+}
+
+void echo_n(int n, size_t payload, const char* conn = "single") {
+  Channel ch;
+  Channel::Options opts;
+  opts.connection_type = conn;
+  opts.timeout_ms = 30000;
+  EXPECT_EQ(ch.Init(addr(), &opts), 0);
+  const std::string body(payload, 'x');
+  for (int i = 0; i < n; ++i) {
+    Controller cntl;
+    IOBuf req, resp;
+    req.append(body);
+    ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+    EXPECT_EQ(resp.size(), body.size());
+  }
+}
+
+}  // namespace
+
+TEST_CASE(timeline_flag_off_invisible) {
+  // MUST run first (registration order): proves the default-off recorder
+  // creates nothing — no rings, no events, vars frozen at 0 — while real
+  // traffic (fibers, sweeps, inline writes) flows.
+  timeline::ensure_registered();
+  EXPECT(!timeline::enabled());
+  start_once();
+  echo_n(64, 1024);
+  EXPECT_EQ(timeline::events_total(), 0u);
+  EXPECT_EQ(timeline::ring_count(), 0);
+  std::string v;
+  EXPECT(Variable::read_exposed("timeline_events_total", &v));
+  EXPECT(v == "0");
+  const auto dump = parse_dump();
+  EXPECT_EQ(dump.size(), 0u);
+}
+
+TEST_CASE(timeline_ring_wrap_keeps_newest_window) {
+  // 64KB ring = 1024 slots of 64 bytes; 5000 events must wrap to the
+  // newest ≤1024 with per-thread order intact and the tail exact.
+  EXPECT_EQ(Flag::set("trpc_timeline_ring_kb", "64"), 0);
+  set_timeline(true);
+  constexpr uint32_t kProbe = timeline::kBulkWake;  // any scalar type
+  for (uint64_t i = 0; i < 5000; ++i) {
+    timeline::record(kProbe, /*a=*/i, /*b=*/0xabc);
+  }
+  set_timeline(false);
+  EXPECT(timeline::events_total() >= 5000);
+  EXPECT(timeline::ring_count() >= 1);
+  // Find this thread's probe events in the served dump.
+  const auto dump = parse_dump();
+  bool found = false;
+  for (const auto& t : dump) {
+    std::vector<Ev> probes;
+    for (const Ev& e : t) {
+      if (e.type == kProbe && e.b == 0xabc) {
+        probes.push_back(e);
+      }
+    }
+    if (probes.empty()) {
+      continue;
+    }
+    found = true;
+    EXPECT(probes.size() <= 1024);
+    EXPECT(probes.size() >= 512);  // wrap must still keep a real window
+    EXPECT_EQ(probes.back().a, 4999u);  // newest survives the wrap
+    for (size_t i = 1; i < probes.size(); ++i) {
+      EXPECT_EQ(probes[i].a, probes[i - 1].a + 1);  // gap-free window
+      EXPECT(probes[i].ts_us >= probes[i - 1].ts_us);
+    }
+  }
+  EXPECT(found);
+  timeline::reset();
+  EXPECT_EQ(Flag::set("trpc_timeline_ring_kb", "256"), 0);
+}
+
+TEST_CASE(timeline_per_thread_order_and_scheduler_events_under_load) {
+  start_once();
+  set_timeline(true);
+  echo_n(200, 1024);
+  set_timeline(false);
+  const auto dump = parse_dump();
+  // Per-thread timestamps are non-decreasing (the single-writer ring
+  // preserves emission order exactly).
+  size_t total = 0;
+  for (const auto& t : dump) {
+    for (size_t i = 1; i < t.size(); ++i) {
+      EXPECT(t[i].ts_us >= t[i - 1].ts_us);
+    }
+    total += t.size();
+  }
+  EXPECT(total > 0);
+  // The echo load must leave scheduler + messenger footprints: fibers
+  // created/run/finished, sweeps opened AND closed with cut counts.
+  EXPECT(count_type(dump, timeline::kFiberCreate) > 0);
+  EXPECT(count_type(dump, timeline::kFiberRun) > 0);
+  EXPECT(count_type(dump, timeline::kFiberDone) > 0);
+  const size_t sweeps = count_type(dump, timeline::kSweepStart);
+  EXPECT(sweeps > 0);
+  EXPECT(count_type(dump, timeline::kSweepEnd) > 0);
+  timeline::reset();
+}
+
+TEST_CASE(timeline_stripe_lifecycle_events_under_striped_load) {
+  start_once();
+  set_timeline(true);
+  echo_n(2, 8 << 20, "pooled");  // > trpc_stripe_threshold: stripes
+  set_timeline(false);
+  const auto dump = parse_dump();
+  EXPECT(count_type(dump, timeline::kStripeCut) >= 2);   // req + resp
+  EXPECT(count_type(dump, timeline::kStripeSend) >= 4);  // 8MB / 2MB
+  EXPECT(count_type(dump, timeline::kStripeLand) >= 4);
+  EXPECT(count_type(dump, timeline::kStripeDone) >= 2);
+  // Every done id has a matching cut id (request or response side).
+  for (const auto& t : dump) {
+    for (const Ev& e : t) {
+      if (e.type != timeline::kStripeDone) {
+        continue;
+      }
+      bool matched = false;
+      for (const auto& t2 : dump) {
+        for (const Ev& e2 : t2) {
+          matched |= e2.type == timeline::kStripeCut && e2.a == e.a;
+        }
+      }
+      EXPECT(matched);
+    }
+  }
+  // A striped echo parks (KeepWrite EAGAIN, reassembly waits): the
+  // run/park pairing the Perfetto fiber slices are built from exists.
+  EXPECT(count_type(dump, timeline::kFiberPark) > 0);
+  timeline::reset();
+}
+
+TEST_CASE(timeline_qos_drain_events_with_lanes_on) {
+  start_once();
+  EXPECT_EQ(Flag::set("trpc_qos_lanes", "2"), 0);
+  set_timeline(true);
+  {
+    Channel ch;
+    Channel::Options opts;
+    opts.timeout_ms = 30000;
+    opts.qos_tenant = "tl_tenant";
+    opts.qos_priority = 1;
+    EXPECT_EQ(ch.Init(addr(), &opts), 0);
+    for (int i = 0; i < 32; ++i) {
+      Controller cntl;
+      IOBuf req, resp;
+      req.append("qos");
+      ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+      EXPECT(!cntl.Failed());
+    }
+  }
+  set_timeline(false);
+  EXPECT_EQ(Flag::set("trpc_qos_lanes", "0"), 0);
+  const auto dump = parse_dump();
+  size_t drains = 0;
+  for (const auto& t : dump) {
+    for (const Ev& e : t) {
+      if (e.type == timeline::kQosDrain) {
+        ++drains;
+        EXPECT((e.a & 0xff) < 4);  // lane index in range
+        EXPECT(e.b > 0);           // a real DRR quantum
+      }
+    }
+  }
+  EXPECT(drains > 0);
+  timeline::reset();
+}
+
+TEST_CASE(timeline_reset_hides_history_and_off_freezes_counters) {
+  start_once();
+  set_timeline(true);
+  echo_n(16, 1024);
+  set_timeline(false);
+  timeline::reset();
+  const auto dump = parse_dump();
+  for (const auto& t : dump) {
+    EXPECT_EQ(t.size(), 0u);  // floors cover everything recorded
+  }
+  // Flag off again: traffic moves nothing (the one-relaxed-load gate).
+  const uint64_t frozen = timeline::events_total();
+  echo_n(32, 1024);
+  EXPECT_EQ(timeline::events_total(), frozen);
+}
+
+TEST_MAIN

@@ -13,6 +13,7 @@
 #include <string>
 #include <vector>
 
+#include "base/flags.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
 #include "net/channel.h"
@@ -60,6 +61,31 @@ int main(int argc, char** argv) {
   const size_t payload = argc > 2 ? atoi(argv[2]) : 1024;
   const int seconds = argc > 3 ? atoi(argv[3]) : 3;
   const char* conn_type = argc > 4 ? argv[4] : "single";
+
+  // TRPC_BENCH_FLAGS="name=value,name=value": validated runtime flag
+  // flips applied before any traffic, so a harness can measure the same
+  // binary with a feature armed (e.g. trpc_timeline=true for the
+  // flag-ON overhead bound in test_perf_smoke).
+  if (const char* spec = getenv("TRPC_BENCH_FLAGS")) {
+    std::string s(spec);
+    size_t pos = 0;
+    while (pos < s.size()) {
+      size_t end = s.find(',', pos);
+      if (end == std::string::npos) {
+        end = s.size();
+      }
+      const std::string kv = s.substr(pos, end - pos);
+      pos = end + 1;
+      const size_t eq = kv.find('=');
+      if (eq == std::string::npos || kv.empty()) {
+        continue;
+      }
+      if (Flag::set(kv.substr(0, eq), kv.substr(eq + 1)) != 0) {
+        fprintf(stderr, "bad TRPC_BENCH_FLAGS entry: %s\n", kv.c_str());
+        return 1;
+      }
+    }
+  }
 
   Server server;
   server.RegisterMethod("Echo.Echo", [](Controller*, const IOBuf& req,

@@ -1,0 +1,30 @@
+"""Subprocess helper for tests/test_timeline_python.py: one echo server
+in its OWN process with rpcz + the timeline flight recorder armed — the
+far side of the 2-process striped run whose spans and timeline the
+stitcher merges into one Perfetto file.
+
+Serves a native `Echo.Echo` (striped above trpc_stripe_threshold).
+Prints one JSON line {"port": N} when serving, then exits when stdin
+closes (the parent's handle on our lifetime).
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    from brpc_tpu.rpc import Server, observe
+
+    observe.enable_rpcz(True)
+    observe.enable_timeline(True)
+    srv = Server()
+    srv.register_native_echo("Echo.Echo")
+    srv.start(0)
+    print(json.dumps({"port": srv.port}), flush=True)
+    sys.stdin.read()  # parent closes stdin to stop us
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,7 +38,7 @@ _NO_CMAKE = shutil.which("cmake") is None or shutil.which("ctest") is None
 # cpp/tests/ so a new suite gates automatically.
 TSAN_SUITES = [
     "fiber", "rpc", "stream", "shm", "ici", "chaos", "stat", "qos",
-    "stripe", "analysis",
+    "stripe", "analysis", "timeline",
 ]
 ALL_SUITES = sorted(
     p.stem[len("test_"):] for p in (REPO / "cpp" / "tests").glob("test_*.cc")
@@ -146,6 +146,16 @@ def test_analysis_cpp_suite_native():
     with it off."""
     _run_native_suite("test_analysis.cc", "test_analysis_native",
                       "analysis suite")
+
+
+def test_timeline_cpp_suite_native():
+    """ISSUE 9: the flight recorder gates tier-1 — flag-off
+    invisibility (vars frozen at 0, zero rings), ring wrap keeping the
+    newest gap-free window, per-thread event ordering under live load,
+    stripe/QoS lifecycle events present under the matching workloads,
+    and reset() hiding history."""
+    _run_native_suite("test_timeline.cc", "test_timeline_native",
+                      "timeline suite")
 
 
 # Wall-clock-window cases (the p99 guards) stay native under sanitizer

@@ -16,6 +16,7 @@ Run with: pytest -m slow tests/test_perf_smoke.py
 """
 
 import json
+import os
 import subprocess
 import time
 
@@ -27,13 +28,17 @@ SECONDS = 2
 pytestmark = pytest.mark.slow
 
 
-def _run_bench(fibers: int, payload: int, conn: str) -> dict:
+def _run_bench(fibers: int, payload: int, conn: str,
+               flags: str | None = None) -> dict:
     from brpc_tpu.rpc._lib import ensure_bench_echo
 
     exe = str(ensure_bench_echo())
+    env = dict(os.environ)
+    if flags:
+        env["TRPC_BENCH_FLAGS"] = flags
     out = subprocess.run(
         [exe, str(fibers), str(payload), str(SECONDS), conn],
-        capture_output=True, text=True, timeout=120, check=True,
+        capture_output=True, text=True, timeout=120, check=True, env=env,
     )
     return json.loads(out.stdout.strip().splitlines()[-1])
 
@@ -294,6 +299,39 @@ def test_qos_1kb_p99_within_2x_under_saturation():
     raise AssertionError(
         f"high-priority 1KB p99 degraded more than 2x under low-priority "
         f"64MB saturation (QoS lanes failed to isolate): {row}")
+
+
+def test_timeline_off_is_default_and_on_overhead_bounded():
+    """ISSUE 9 satellite: the flight recorder defaults OFF — so every
+    other floor in this file (the 1KB QPS floor, the 16/64MB striped
+    floors) already gates its flag-off cost at one relaxed load per
+    hook — and a flag-ON run must cost <= 10% of the flag-off 1KB QPS
+    (fixed-size binary events into a per-thread wait-free ring).
+    Best-of-2 on each side: the measurement is timing-bound on shared
+    boxes and a real regression loses both rounds."""
+    from brpc_tpu.rpc import get_flag
+
+    assert get_flag("trpc_timeline") == "false", \
+        "trpc_timeline must default off (timeline is opt-in)"
+    best_off = 0.0
+    best_on = 0.0
+    for _ in range(2):
+        row_off = _run_bench(64, 1024, "single")
+        assert row_off["failures"] == 0, row_off
+        best_off = max(best_off, row_off["qps"])
+        row_on = _run_bench(64, 1024, "single",
+                            flags="trpc_timeline=true")
+        assert row_on["failures"] == 0, row_on
+        best_on = max(best_on, row_on["qps"])
+        if best_off >= QPS_FLOOR and best_on >= 0.9 * best_off:
+            break
+    assert best_off >= QPS_FLOOR, (
+        f"flag-off 1KB QPS {best_off:.0f} under floor {QPS_FLOOR} — the "
+        f"timeline hooks tax the idle hot path")
+    assert best_on >= 0.9 * best_off, (
+        f"flag-ON 1KB QPS {best_on:.0f} fell more than 10% below the "
+        f"flag-off {best_off:.0f} — recording is too expensive for an "
+        f"always-on flight recorder")
 
 
 def test_small_rpc_hot_path_unchanged_by_stripe_layer():

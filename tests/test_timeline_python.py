@@ -1,0 +1,262 @@
+"""The timeline flight recorder's Python/HTTP surface (ISSUE 9).
+
+Tier-1 coverage for the four read paths: the `/timeline` builtin (JSON
+and binary over HTTP), the `trpc_timeline_*` C API via
+`observe.timeline()`, the binary decoder (whose event-type table
+tools/lint_trpc.py pins against the C++ encoder), and the end-to-end
+deliverable — a 2-process striped run stitched WITH timelines into one
+Perfetto file where fiber slices land on the same node tracks as the
+rpcz spans they execute, joinable by fid and trace id.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import select
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from brpc_tpu.rpc import Channel, Server, get_flag, observe, set_flag
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+sys.path.insert(0, TOOLS)
+
+import trace_stitch  # noqa: E402  (tools/ is not a package)
+
+
+@pytest.fixture
+def recorder():
+    observe.enable_timeline(True)
+    yield
+    observe.enable_timeline(False)
+    observe.reset_timeline()
+
+
+def _echo_server() -> Server:
+    srv = Server()
+    srv.register_native_echo("Echo.Echo")
+    srv.start(0)
+    return srv
+
+
+def test_timeline_defaults_off_and_flag_validates():
+    assert get_flag("trpc_timeline") == "false", \
+        "the flight recorder must default off (hot path pays one " \
+        "relaxed load only)"
+    assert not observe.timeline_enabled()
+    with pytest.raises(ValueError):
+        set_flag("trpc_timeline", "sideways")
+    with pytest.raises(ValueError):
+        set_flag("trpc_timeline_ring_kb", "1")  # below the 64KB floor
+    set_flag("trpc_timeline_ring_kb", "256")
+
+
+def test_timeline_http_endpoint_json_and_binary(recorder):
+    srv = _echo_server()
+    try:
+        ch = Channel(f"127.0.0.1:{srv.port}", timeout_ms=10000)
+        for _ in range(32):
+            ch.call("Echo.Echo", b"t" * 1024)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/timeline?limit=2000",
+                timeout=5) as r:
+            dump = json.loads(r.read().decode())
+        assert dump["enabled"] is True
+        assert dump["now_wall_us"] > dump["now_mono_us"] > 0
+        events = [e for t in dump["threads"] for e in t["events"]]
+        assert events, "no events despite recorder on + traffic"
+        names = {e["name"] for e in events}
+        assert {"fiber_run", "sweep_start", "sweep_end"} <= names
+        for e in events[:50]:
+            assert len(e["trace_id"]) == 16 and len(e["fid"]) == 16
+        # Per-thread timestamps arrive in emission order.
+        for t in dump["threads"]:
+            ts = [e["ts_us"] for e in t["events"]]
+            assert ts == sorted(ts)
+        # Binary body parses through the lint-pinned decoder table and
+        # carries the same thread set.
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/timeline?format=binary",
+                timeout=5) as r:
+            raw = r.read()
+        parsed = observe.parse_timeline_binary(raw)
+        assert {t["tid"] for t in parsed["threads"]} == \
+            {t["tid"] for t in dump["threads"]}
+        bin_names = {e["name"] for t in parsed["threads"]
+                     for e in t["events"]}
+        assert "unknown" not in bin_names, \
+            "binary dump carries an event type missing from " \
+            "observe.TIMELINE_EVENTS — the encoder/decoder tables drifted"
+        ch.close()
+    finally:
+        srv.stop()
+
+
+def test_observe_timeline_reader_and_span_fid_join(recorder):
+    """The in-process read path: observe.timeline() events join
+    exactly onto rpcz spans — a server span's fid IS the fid of
+    fiber_run events, no timestamp inference."""
+    observe.enable_rpcz(True)
+    srv = _echo_server()
+    try:
+        ch = Channel(f"127.0.0.1:{srv.port}", timeout_ms=10000)
+        with observe.trace("tl-join") as t:
+            for _ in range(16):
+                ch.call("Echo.Echo", b"j" * 512)
+        evs = observe.timeline()
+        assert evs and evs == sorted(evs, key=lambda e: e.ts_us)
+        run_fids = {e.fid for e in evs if e.name == "fiber_run"}
+        spans = observe.spans(limit=500, trace_id=t.trace_id)
+        server_fids = {s.fid for s in spans if s.side == "server"}
+        assert any(f != "0" * 16 for f in server_fids), \
+            "server spans must be stamped with their handler fiber id"
+        assert server_fids & run_fids, \
+            "span fid did not join to any timeline fiber_run event"
+        # Events emitted inside the handler carry the ambient trace.
+        hexid = f"{t.trace_id:016x}"
+        assert any(e.trace_id == hexid for e in evs), \
+            "no timeline event carries the trace id (FLS stamp broken)"
+    finally:
+        observe.enable_rpcz(False)
+        srv.stop()
+
+
+def test_timeline_off_records_nothing():
+    observe.enable_timeline(False)
+    observe.reset_timeline()
+    srv = _echo_server()
+    try:
+        ch = Channel(f"127.0.0.1:{srv.port}", timeout_ms=10000)
+        before = observe.Vars.dump().get("timeline_events_total", 0)
+        for _ in range(64):
+            ch.call("Echo.Echo", b"z" * 1024)
+        after = observe.Vars.dump().get("timeline_events_total", 0)
+        assert after == before, (
+            f"timeline vars moved with the flag off: {before} -> {after}")
+        assert all(not t["events"]
+                   for t in observe.timeline_dump()["threads"])
+        ch.close()
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------- 2-process striped stitch --
+
+
+def _spawn_node():
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "_timeline_node.py")]
+    proc = subprocess.Popen(cmd, env=env, stdin=subprocess.PIPE,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
+    deadline = time.time() + 120
+    buf = b""
+    while b"\n" not in buf:
+        left = deadline - time.time()
+        if left <= 0 or proc.poll() is not None:
+            err = proc.communicate()[1].decode(errors="replace") \
+                if proc.poll() is not None else "(still running)"
+            proc.kill()
+            raise AssertionError(
+                f"timeline node produced no port line; stderr:\n{err}")
+        ready, _, _ = select.select([proc.stdout], [], [], min(left, 1.0))
+        if not ready:
+            continue
+        chunk = os.read(proc.stdout.fileno(), 4096)
+        if not chunk:
+            raise AssertionError(
+                "timeline node exited early: "
+                + proc.communicate()[1].decode(errors="replace"))
+        buf += chunk
+    port = json.loads(buf.split(b"\n")[0])["port"]
+    return proc, port
+
+
+def test_two_process_striped_run_merges_into_one_perfetto_file(
+        recorder, tmp_path):
+    """The acceptance deliverable: a striped transfer between two REAL
+    processes produces, from the stitcher alone, one Perfetto-loadable
+    file holding stitched spans AND both nodes' flight recordings —
+    with >= 1 fiber slice parented under a stitched span's node track
+    (same pid, joined by fid), stripe-rail tracks, and messenger sweep
+    slices."""
+    observe.enable_rpcz(True)
+    node = None
+    try:
+        node, port = _spawn_node()
+        ch = Channel(f"127.0.0.1:{port}", timeout_ms=60000,
+                     connection_type="pooled")
+        with observe.trace("striped-2proc") as t:
+            assert ch.call("Echo.Echo", b"k" * 1024) == b"k" * 1024
+            big = b"s" * (8 << 20)  # > 2MB threshold: stripes both ways
+            assert ch.call("Echo.Echo", big) == big
+        hexid = f"{t.trace_id:016x}"
+
+        # Server submits its span after responding — poll briefly.
+        deadline = time.time() + 5
+        while True:
+            dump_n = trace_stitch.fetch_rpcz(f"127.0.0.1:{port}", hexid)
+            if len(dump_n["spans"]) >= 2 or time.time() > deadline:
+                break
+            time.sleep(0.02)
+        assert len(dump_n["spans"]) >= 2  # 1KB + striped server spans
+
+        dumps = {"client": observe.rpcz_dump(trace_id=hexid),
+                 f"node:{port}": dump_n}
+        timelines = {"client": observe.timeline_dump(),
+                     f"node:{port}": trace_stitch.fetch_timeline(
+                         f"127.0.0.1:{port}")}
+        trace = trace_stitch.stitch(dumps, hexid, timelines)
+        out = tmp_path / "merged.json"
+        out.write_text(json.dumps(trace))
+        loaded = json.load(open(out))  # ONE Perfetto-loadable file
+        events = loaded["traceEvents"]
+
+        xs = [e for e in events if e.get("ph") == "X"]
+        span_xs = [e for e in xs if e.get("cat") in ("server", "client")]
+        fiber_xs = [e for e in xs if e.get("cat") == "fiber"]
+        sweep_xs = [e for e in xs if e.get("name") == "sweep"]
+        assert len(span_xs) >= 3 and fiber_xs and sweep_xs
+
+        # >= 1 fiber slice parented under a stitched span's node track:
+        # same pid AND the span's fid matches the slice's fid.
+        span_keys = {(e["pid"], e["args"]["fid"]) for e in span_xs
+                     if e["args"]["fid"] != "0" * 16}
+        fiber_keys = {(e["pid"], e["args"]["fid"]) for e in fiber_xs}
+        assert span_keys & fiber_keys, (
+            "no fiber slice shares (node track, fid) with a stitched "
+            f"span: spans={sorted(span_keys)[:4]} "
+            f"fibers={len(fiber_keys)}")
+
+        # Stripe rails surfaced as named tracks with send instants.
+        rail_meta = [e for e in events if e.get("ph") == "M"
+                     and "stripe rail" in
+                     str(e.get("args", {}).get("name", ""))]
+        assert rail_meta, "no stripe rail tracks in the merged file"
+        sends = [e for e in events if e.get("name") == "stripe_send"]
+        assert sends
+        # Both processes contributed timeline events.
+        tl_pids = {e["pid"] for e in events
+                   if e.get("cat") in ("fiber", "timeline", "messenger")}
+        assert len(tl_pids) >= 2, f"one-sided timeline merge: {tl_pids}"
+        assert loaded["stitch"]["timeline_events"] > 0
+        ch.close()
+    finally:
+        observe.enable_rpcz(False)
+        if node is not None:
+            try:
+                node.stdin.close()
+                node.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                node.kill()

@@ -1,5 +1,6 @@
 #!/usr/bin/env python3
-"""Cross-node trace stitcher: rpcz span sets → Chrome trace-event JSON.
+"""Cross-node trace stitcher: rpcz span sets (+ flight-recorder
+timelines) → ONE Chrome trace-event JSON.
 
 Given N node endpoints and a trace_id, pulls every node's spans from
 `/rpcz?format=json&trace_id=...`, joins parent/child links across hops,
@@ -7,6 +8,17 @@ and emits Chrome trace-event JSON loadable in Perfetto (ui.perfetto.dev)
 or chrome://tracing: one process track per node, spans as complete
 events (ph "X", server vs client on separate thread tracks), span
 annotations as instant events (ph "i").
+
+With `--timeline` (ISSUE 9) each node's `/timeline` flight-recorder dump
+merges into the SAME file: a thread track per worker pthread carrying
+fiber run→park slices (named by the rpcz method whose span they execute
+when the span_id stamped into the run event resolves), messenger sweep
+and inline-response slices nested under them, scheduler instants
+(create/ready/wake/steal/migrate), and synthetic per-node async tracks
+for stripe rails (one per rail, chunk sends + lifecycle) and QoS lanes
+(DRR drain rounds).  Spans and timeline events join exactly: every event
+carries the emitting fiber's ambient trace/span ids, and every span
+carries the fid it ran on.
 
 Clock model: span times are each node's CLOCK_MONOTONIC, mutually
 meaningless across processes.  Every rpcz dump carries a
@@ -24,9 +36,13 @@ Usage:
         --out trace.json host1:port1 host2:port2
     # merge spans of THIS process (e.g. the client side of the trace):
     python tools/trace_stitch.py --trace-id 1f00d... --local client ...
+    # one file with spans AND the flight-recorder timeline of every node:
+    python tools/trace_stitch.py --trace-id 1f00d... --timeline \\
+        --local client --out trace.json host1:port1 host2:port2
 
-Importable pieces (used by tests/test_observe.py): `fetch_rpcz`,
-`local_rpcz`, `stitch`.
+Importable pieces (used by tests/test_observe.py and
+tests/test_timeline_python.py): `fetch_rpcz`, `local_rpcz`, `stitch`,
+`fetch_timeline`, `local_timeline`.
 """
 
 from __future__ import annotations
@@ -55,6 +71,22 @@ def local_rpcz(trace_id: str | None = None, limit: int = 4096) -> dict:
     from brpc_tpu.rpc import observe
 
     return observe.rpcz_dump(limit=limit, trace_id=trace_id)
+
+
+def fetch_timeline(endpoint: str, limit: int = 4096,
+                   timeout: float = 5.0) -> dict:
+    """One node's flight-recorder dump ({"pid","now_mono_us",
+    "now_wall_us","threads":[...]}) via its builtin HTTP service."""
+    url = f"http://{endpoint}/timeline?limit={limit}"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def local_timeline(limit: int = 4096) -> dict:
+    """THIS process's flight-recorder dump (no server needed)."""
+    from brpc_tpu.rpc import observe
+
+    return observe.timeline_dump(limit=limit)
 
 
 def _mid(s: dict) -> float:
@@ -108,13 +140,127 @@ def _node_offsets(dumps: dict[str, dict]) -> dict[str, float]:
     return offsets
 
 
-def stitch(dumps: dict[str, dict], trace_id: str | None = None) -> dict:
+# Synthetic per-node track ids for the flight-recorder's async lanes.
+# Real worker tids are kernel tids (well below these); span tracks use
+# tid 0/1 — no collisions.
+_TL_STRIPE_TID = 900000       # stripe lifecycle (cut / land / done)
+_TL_STRIPE_RAIL_TID = 900001  # + rail index: one track per stripe rail
+_TL_QOS_TID = 950000          # + lane index: one track per QoS lane
+# kStripeSend rail index meaning "the call's primary socket" (head
+# frame / dead-rail fallback) — cpp/stat/timeline.h kStripePrimaryRail.
+_TL_PRIMARY_RAIL = 0xFFFF
+_TL_PRIMARY_RAIL_TID = 900900  # its own track, distinct from real rails
+
+
+def _timeline_chrome_events(pid: int, dump: dict, base: float,
+                            span_by_id: dict, span_by_fid: dict) -> list:
+    """One node's flight-recorder dump → Chrome events: per-worker
+    thread tracks with fiber run→park slices (named by the rpcz span
+    they execute when the join resolves) and messenger sweep /
+    inline-response slices nested under them, scheduler/write-path
+    instants, plus synthetic stripe-rail and QoS-lane tracks."""
+    events = []
+    named_tids = set()
+
+    def track(tid: int, name: str) -> int:
+        if tid not in named_tids:
+            named_tids.add(tid)
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": tid, "args": {"name": name}})
+        return tid
+
+    for thr in dump.get("threads", []):
+        tid = int(thr["tid"])
+        track(tid, f"{thr.get('name', 'thread')} (tid {tid})")
+        open_fiber: dict = {}  # fid(hex of event `a`... keyed by a) -> (ts, ev)
+        open_span: dict = {}   # (event name, a) -> (ts, ev)
+        for e in thr.get("events", []):
+            name = e.get("name", "?")
+            ts = float(e["ts_us"]) + base
+            if name == "fiber_run":
+                open_fiber[e["a"]] = (ts, e)
+                continue
+            if name in ("fiber_park", "fiber_done") and e["a"] in open_fiber:
+                t0, run = open_fiber.pop(e["a"])
+                # Exact span join: the run/park events carry the fiber's
+                # own id in `fid` and its ambient span in `span_id`;
+                # spans carry the fid they started on.
+                method = (span_by_id.get(run["span_id"])
+                          or span_by_fid.get(run["fid"]))
+                label = (f"fiber:{method}" if method
+                         else f"fiber {run['fid'][-8:]}")
+                events.append({
+                    "ph": "X", "name": label, "cat": "fiber",
+                    "pid": pid, "tid": tid, "ts": t0,
+                    "dur": max(ts - t0, 1.0),
+                    "args": {"fid": run["fid"],
+                             "trace_id": run["trace_id"],
+                             "span_id": run["span_id"],
+                             "worker": int(run["b"], 16),
+                             "end": name},
+                })
+                continue
+            if name in ("sweep_start", "inline_begin"):
+                open_span[(name, e["a"])] = (ts, e)
+                continue
+            if name == "sweep_end" and ("sweep_start", e["a"]) in open_span:
+                t0, _ = open_span.pop(("sweep_start", e["a"]))
+                events.append({
+                    "ph": "X", "name": "sweep", "cat": "messenger",
+                    "pid": pid, "tid": tid, "ts": t0,
+                    "dur": max(ts - t0, 1.0),
+                    "args": {"socket": e["a"], "cuts": int(e["b"], 16),
+                             "trace_id": e["trace_id"]},
+                })
+                continue
+            if name == "inline_end" and \
+                    ("inline_begin", e["a"]) in open_span:
+                t0, _ = open_span.pop(("inline_begin", e["a"]))
+                events.append({
+                    "ph": "X", "name": "inline-response",
+                    "cat": "messenger", "pid": pid, "tid": tid, "ts": t0,
+                    "dur": max(ts - t0, 1.0),
+                    "args": {"socket": e["a"], "trace_id": e["trace_id"]},
+                })
+                continue
+            # Everything else renders as an instant; stripe/QoS events
+            # additionally land on their synthetic async tracks.
+            out_tid = tid
+            if name == "stripe_send":
+                rail = int(e["b"], 16) >> 48
+                if rail == _TL_PRIMARY_RAIL:
+                    out_tid = track(_TL_PRIMARY_RAIL_TID,
+                                    "stripe primary (head/fallback)")
+                else:
+                    out_tid = track(_TL_STRIPE_RAIL_TID + rail,
+                                    f"stripe rail {rail}")
+            elif name in ("stripe_cut", "stripe_land", "stripe_done"):
+                out_tid = track(_TL_STRIPE_TID, "stripe lifecycle")
+            elif name == "qos_drain":
+                lane = int(e["a"], 16) & 0xff
+                out_tid = track(_TL_QOS_TID + lane, f"qos lane {lane}")
+            events.append({
+                "ph": "i", "name": name, "s": "t", "cat": "timeline",
+                "pid": pid, "tid": out_tid, "ts": ts,
+                "args": {"a": e["a"], "b": e["b"],
+                         "trace_id": e["trace_id"],
+                         "span_id": e["span_id"], "fid": e["fid"]},
+            })
+    return events
+
+
+def stitch(dumps: dict[str, dict], trace_id: str | None = None,
+           timeline_dumps: dict[str, dict] | None = None) -> dict:
     """Joins {node_name: rpcz_dump} into one Chrome trace-event object.
 
     Returns {"traceEvents": [...], "displayTimeUnit": "ms", "stitch":
     {summary}} — JSON-dumpable straight into Perfetto.  When `trace_id`
     is given, spans from other traces are dropped (belt + braces for
-    dumps fetched without the server-side filter)."""
+    dumps fetched without the server-side filter).  `timeline_dumps`
+    ({node_name: /timeline dump}) merges each node's flight-recorder
+    events into the same file on the same corrected clocks — timeline
+    events are NOT trace-filtered (the scheduling/transport context
+    AROUND a span is exactly what the timeline tier exists to show)."""
     offsets = _node_offsets(dumps)
     # Global index for parent-link accounting (across ALL nodes).
     all_ids = set()
@@ -125,7 +271,6 @@ def stitch(dumps: dict[str, dict], trace_id: str | None = None) -> dict:
             all_ids.add(s["span_id"])
     events = []
     parent_linked = 0
-    t0 = None  # rebase so the trace starts near 0 (Perfetto-friendly)
     spans_total = 0
     for pid, (node, dump) in enumerate(sorted(dumps.items())):
         base = float(dump.get("now_wall_us", 0)) - \
@@ -145,8 +290,6 @@ def stitch(dumps: dict[str, dict], trace_id: str | None = None) -> dict:
             spans_total += 1
             start = float(s["start_us"]) + base
             dur = max(float(s["end_us"]) - float(s["start_us"]), 1.0)
-            if t0 is None or start < t0:
-                t0 = start
             linked = s.get("parent_span_id", "0" * 16) in all_ids
             parent_linked += 1 if linked else 0
             tid = 0 if s["side"] == "server" else 1
@@ -157,6 +300,7 @@ def stitch(dumps: dict[str, dict], trace_id: str | None = None) -> dict:
                     "trace_id": s["trace_id"], "span_id": s["span_id"],
                     "parent_span_id": s["parent_span_id"],
                     "parent_linked": linked,
+                    "fid": s.get("fid", "0" * 16),
                     "error_code": s["error_code"],
                     "request_bytes": s["request_bytes"],
                     "response_bytes": s["response_bytes"],
@@ -168,6 +312,40 @@ def stitch(dumps: dict[str, dict], trace_id: str | None = None) -> dict:
                     "pid": pid, "tid": tid,
                     "ts": float(a["ts_us"]) + base,
                 })
+    timeline_events = 0
+    if timeline_dumps:
+        pid_of = {node: p for p, node in enumerate(sorted(dumps))}
+        next_pid = len(pid_of)
+        for node in sorted(timeline_dumps):
+            tl = timeline_dumps[node]
+            if node not in pid_of:  # timeline-only node: its own track
+                pid_of[node] = next_pid
+                events.append({
+                    "ph": "M", "name": "process_name",
+                    "pid": next_pid,
+                    "args": {"name": f"{node} (pid {tl.get('pid', '?')})"},
+                })
+                next_pid += 1
+            base = float(tl.get("now_wall_us", 0)) - \
+                float(tl.get("now_mono_us", 0)) + offsets.get(node, 0.0)
+            # Span join tables for fiber-slice naming, restricted to
+            # this node's spans (fibers never execute a remote span).
+            span_by_id: dict = {}
+            span_by_fid: dict = {}
+            for s in dumps.get(node, {}).get("spans", []):
+                if trace_id and s["trace_id"] != trace_id:
+                    continue
+                span_by_id[s["span_id"]] = s["method"]
+                fid = s.get("fid", "0" * 16)
+                if fid != "0" * 16:
+                    span_by_fid.setdefault(fid, s["method"])
+            evs = _timeline_chrome_events(pid_of[node], tl, base,
+                                          span_by_id, span_by_fid)
+            timeline_events += sum(1 for e in evs if e["ph"] != "M")
+            events.extend(evs)
+    # Rebase so the trace starts near 0 (Perfetto-friendly); timeline
+    # events can precede the first span, so take the global minimum.
+    t0 = min((e["ts"] for e in events if "ts" in e), default=None)
     if t0 is not None:
         for e in events:
             if "ts" in e:
@@ -180,6 +358,8 @@ def stitch(dumps: dict[str, dict], trace_id: str | None = None) -> dict:
             "nodes": sorted(dumps),
             "spans": spans_total,
             "parent_linked": parent_linked,
+            "timeline_events": timeline_events,
+            "timeline_nodes": sorted(timeline_dumps or {}),
             "node_offsets_us": {n: round(v, 1)
                                 for n, v in offsets.items()},
         },
@@ -198,6 +378,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="max spans pulled per node")
     ap.add_argument("--local", metavar="NAME", default=None,
                     help="also merge THIS process's spans as node NAME")
+    ap.add_argument("--timeline", action="store_true",
+                    help="also pull each node's /timeline flight-recorder "
+                         "dump and merge fiber/messenger/stripe/QoS "
+                         "events into the same Perfetto file")
+    ap.add_argument("--timeline-limit", type=int, default=4096,
+                    help="max timeline events pulled per node thread")
     ap.add_argument("--out", default="-",
                     help="output path (default: stdout)")
     args = ap.parse_args(argv)
@@ -208,7 +394,15 @@ def main(argv: list[str] | None = None) -> int:
         dumps[args.local] = local_rpcz(args.trace_id, args.limit)
     if not dumps:
         ap.error("no endpoints given (and --local not set)")
-    trace = stitch(dumps, args.trace_id)
+    timeline_dumps: dict[str, dict] | None = None
+    if args.timeline:
+        timeline_dumps = {}
+        for ep in args.endpoints:
+            timeline_dumps[ep] = fetch_timeline(ep, args.timeline_limit)
+        if args.local:
+            timeline_dumps[args.local] = local_timeline(
+                args.timeline_limit)
+    trace = stitch(dumps, args.trace_id, timeline_dumps)
     text = json.dumps(trace)
     if args.out == "-":
         print(text)
@@ -217,7 +411,8 @@ def main(argv: list[str] | None = None) -> int:
             f.write(text)
         s = trace["stitch"]
         print(f"wrote {args.out}: {s['spans']} spans "
-              f"({s['parent_linked']} parent-linked) from "
+              f"({s['parent_linked']} parent-linked) + "
+              f"{s['timeline_events']} timeline events from "
               f"{len(s['nodes'])} nodes", file=sys.stderr)
     return 0
 
