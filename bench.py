@@ -273,30 +273,70 @@ def _child_tpu_rpc() -> None:
     # descriptors over in-process rings, not bytes across a chip
     # interconnect, and each iteration's goodput-counted payload is
     # `size` bytes.  The fields make that unmistakable in the artifact.
+    # Path attribution (ISSUE 10): each ring leg is stamped rma|copy from
+    # the rma_rx_msgs delta around it, plus the rail counts in force, so
+    # a BENCH row can never silently change data path between rounds.
+    def _var(name: str) -> int:
+        out = ctypes.create_string_buffer(64)
+        return (int(out.value) if lib.trpc_var_read(name.encode(), out, 64)
+                == 0 and out.value else 0)
+
+    def _flag(name: str) -> str:
+        out = ctypes.create_string_buffer(64)
+        return (out.value.decode() if
+                lib.trpc_flag_get(name.encode(), out, 64) == 0 else "?")
+
     row = {"kind": "tpu_rpc_64MB", "platform": platform,
            "loopback": True,
            "bytes_moved_per_iter": size,
            "staging_dma_gbps": round(size / dma_s / 1e9, 3),
            "staging_land_gbps": round(size / land_s / 1e9, 3)
            if land_s > 0 else None,
-           "rpc": {}}
+           "rpc": {}, "rpc_path": {}, "rpc_16mb": {}, "rpc_16mb_path": {},
+           "rma_rails": {"shm": _flag("trpc_shm_rails"),
+                         "ici": _flag("trpc_ici_rails")}}
     best = 0.0
     resp = np.empty(size, dtype=np.uint8)
     zc0_w, zc0_b = ctypes.c_uint64(), ctypes.c_uint64()
     lib.trpc_ici_zero_copy_counters(ctypes.byref(zc0_w),
                                     ctypes.byref(zc0_b))
-    for tr in ("ici", "shm", "tcp"):
+
+    def _zc_bytes() -> int:
+        w, b = ctypes.c_uint64(), ctypes.c_uint64()
+        lib.trpc_ici_zero_copy_counters(ctypes.byref(w), ctypes.byref(b))
+        return b.value
+
+    def _ring_leg(tr: str, leg_size: int, leg_iters: int, resp_ptr,
+                  goodput_out: dict, path_out: dict) -> float:
+        """One echo leg + its path stamp (rma | desc_zero_copy | copy)."""
         g = ctypes.c_double()
         used = ctypes.create_string_buffer(32)
         err = ctypes.create_string_buffer(256)
-        rc = f(staging.ctypes.data, size, iters, 1, tr.encode(),
-               resp.ctypes.data if tr == "ici" else None,
-               ctypes.byref(g), used, 32, err, 256)
-        if rc == 0:
-            row["rpc"][used.value.decode()] = round(g.value, 3)
-            best = max(best, g.value)
+        rma0 = _var("rma_rx_msgs")
+        zcb0 = _zc_bytes()
+        rc = f(staging.ctypes.data, leg_size, leg_iters, 1, tr.encode(),
+               resp_ptr, ctypes.byref(g), used, 32, err, 256)
+        if rc != 0:
+            goodput_out[tr] = f"failed: {err.value.decode()}"
+            return 0.0
+        name = used.value.decode()
+        goodput_out[name] = round(g.value, 3)
+        if _var("rma_rx_msgs") > rma0:
+            path_out[name] = "rma"
+        elif _zc_bytes() - zcb0 >= leg_size:
+            path_out[name] = "desc_zero_copy"  # sender-owned descriptors
         else:
-            row["rpc"][tr] = f"failed: {err.value.decode()}"
+            path_out[name] = "copy"
+        return g.value
+
+    for tr in ("ici", "shm", "tcp"):
+        best = max(best, _ring_leg(
+            tr, size, iters, resp.ctypes.data if tr == "ici" else None,
+            row["rpc"], row["rpc_path"]))
+    # 16MB ring legs (same stack, mid-large band) with their own stamps.
+    for tr in ("ici", "shm"):
+        _ring_leg(tr, 16 << 20, iters * 4, None,
+                  row["rpc_16mb"], row["rpc_16mb_path"])
     zc1_w, zc1_b = ctypes.c_uint64(), ctypes.c_uint64()
     lib.trpc_ici_zero_copy_counters(ctypes.byref(zc1_w),
                                     ctypes.byref(zc1_b))

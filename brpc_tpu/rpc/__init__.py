@@ -13,4 +13,5 @@ from brpc_tpu.rpc.client import (  # noqa: F401
     RpcError,
 )
 from brpc_tpu.rpc.flags import get_flag, set_flag  # noqa: F401
+from brpc_tpu.rpc.rma import RmaBuffer, kernel_supports  # noqa: F401
 from brpc_tpu.rpc.server import Call, Server  # noqa: F401

@@ -245,6 +245,22 @@ def load_library() -> ctypes.CDLL:
                 ctypes.c_char_p, ctypes.c_size_t,
             ]
             lib.trpc_channel_call_buf.restype = ctypes.c_int
+            # One-sided RMA regions + kernel probe (capi/rpc_capi.cc;
+            # net/rma.h, base/proc.h).
+            lib.trpc_rma_alloc.argtypes = [
+                ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.trpc_rma_alloc.restype = ctypes.c_void_p
+            lib.trpc_rma_free.argtypes = [ctypes.c_void_p]
+            lib.trpc_rma_free.restype = None
+            lib.trpc_rma_reg.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+            lib.trpc_rma_reg.restype = ctypes.c_uint64
+            lib.trpc_rma_unreg.argtypes = [ctypes.c_uint64]
+            lib.trpc_rma_unreg.restype = ctypes.c_int
+            lib.trpc_rma_region_count.argtypes = []
+            lib.trpc_rma_region_count.restype = ctypes.c_size_t
+            lib.trpc_kernel_supports.argtypes = [ctypes.c_char_p]
+            lib.trpc_kernel_supports.restype = ctypes.c_int
             # RPC surface (capi/rpc_capi.cc).
             lib.trpc_server_create.restype = ctypes.c_void_p
             lib.trpc_server_destroy.argtypes = [ctypes.c_void_p]

@@ -324,6 +324,12 @@ TIMELINE_EVENTS = {
 # frame / dead-rail fallback) — cpp/stat/timeline.h kStripePrimaryRail.
 TIMELINE_STRIPE_PRIMARY_RAIL = 0xFFFF
 
+# kStripeSend rail values with this bit set are one-sided RMA rails
+# (net/rma.h): the chunk was WRITTEN into the peer's registered region
+# by rail (value & 0x7FFF) — no ring/socket copy happened.  Mirrors
+# cpp/stat/timeline.h kStripeRmaRailBit.
+TIMELINE_STRIPE_RMA_BIT = 0x8000
+
 _TL_MAGIC = b"TRPCTL01"
 _TL_HEADER = struct.Struct("<qqI")       # now_mono_us, now_wall_us, nrings
 _TL_RING = struct.Struct("<Q16sI")       # tid, name, nevents

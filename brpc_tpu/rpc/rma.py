@@ -1,0 +1,111 @@
+"""One-sided RMA regions — registered, remotely-writable response buffers.
+
+Parity: brpc's RDMA one-sided verbs register caller memory so a peer can
+WRITE results into it directly; fabric-lib (arXiv 2510.27656) builds its
+KV-cache transfer engine on exactly that shape.  `RmaBuffer` is the
+Python surface of cpp/net/rma.h's region registry: the buffer's bytes are
+shm-backed and registered under an rkey, so a batch call that uses it as
+`resp_buf` advertises the rkey on the request (meta tail-group 6) and —
+over shm/ici connections — the SERVER writes the response payload
+straight into this buffer with zero receiver-side copies, signalling
+completion with a release-fenced chunk bitmap plus one tiny control
+frame.  Over TCP (or when the one-sided plane is off) the same buffer
+transparently degrades to the striped copy-path landing of PR 5.
+
+Usage:
+
+    buf = rma.RmaBuffer(64 << 20)
+    batch = ch.call_batch([("Echo.Echo", req)], resp_bufs=[buf.view])
+    ...
+    buf.free()        # or use it as a context manager
+
+The memory stays mapped until `free()` ran AND the runtime's references
+drop: the region registry defers the unmap while any in-flight call is
+still bound to the buffer (its landing registration), and zero-copy
+views hold it past that.  Contract for FAILED calls: a call that timed
+out or was cancelled while using this buffer may have a server-side put
+still writing into the shared pages — do not REUSE the buffer for a new
+call until that horizon passes (the runtime rejects a stale transfer's
+completion via its correlation token, but a writer racing mid-flight is
+inherent to shared memory); `free()` and allocating a fresh buffer is
+the cheap, always-safe pattern.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from brpc_tpu.rpc._lib import load_library
+
+
+class RmaBuffer:
+    """`size` shm-backed bytes registered for one-sided remote writes."""
+
+    def __init__(self, size: int):
+        if size <= 0:
+            raise ValueError("RmaBuffer size must be positive")
+        lib = load_library()
+        rkey = ctypes.c_uint64()
+        base = lib.trpc_rma_alloc(size, ctypes.byref(rkey))
+        if not base:
+            raise MemoryError(f"trpc_rma_alloc({size}) failed")
+        self._lib = lib
+        self._base = base
+        self._size = size
+        self._rkey = rkey.value
+        # A ctypes array over the mapped bytes: buffer-protocol writable,
+        # so it works anywhere a bytearray/numpy resp_buf does.
+        self._view = (ctypes.c_char * size).from_address(base)
+
+    @property
+    def view(self):
+        """Writable buffer-protocol view of the registered bytes."""
+        if self._base is None:
+            raise ValueError("RmaBuffer already freed")
+        return self._view
+
+    @property
+    def rkey(self) -> int:
+        return self._rkey
+
+    @property
+    def nbytes(self) -> int:
+        return self._size
+
+    @property
+    def address(self) -> int:
+        if self._base is None:
+            raise ValueError("RmaBuffer already freed")
+        return self._base
+
+    def free(self) -> None:
+        """Unregisters the region (idempotent).  The unmap is deferred
+        while an in-flight call's landing registration or a zero-copy
+        view still references the bytes; new calls can no longer use
+        the buffer."""
+        if self._base is not None:
+            self._view = None
+            self._lib.trpc_rma_free(self._base)
+            self._base = None
+
+    def __enter__(self) -> "RmaBuffer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.free()
+
+    def __len__(self) -> int:
+        return self._size
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.free()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+def kernel_supports(feature: str) -> int:
+    """Runtime kernel-capability probe (base/proc.h): 1 supported, 0 not,
+    -1 unknown.  ``kernel_supports("io_uring")`` is the ROADMAP item 2
+    gate — kernels before 5.1 (this dev box: 4.4.0) answer ENOSYS."""
+    return int(load_library().trpc_kernel_supports(feature.encode()))

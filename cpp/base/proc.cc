@@ -1,9 +1,12 @@
 #include "base/proc.h"
 
 #include <dirent.h>
+#include <errno.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <sys/syscall.h>
+#include <unistd.h>
 
 #include <cmath>
 
@@ -60,6 +63,37 @@ bool parse_plain_number(const char* s, double* out) {
   }
   *out = v;
   return true;
+}
+
+#ifndef __NR_io_uring_setup
+// x86_64 and aarch64 share the unified syscall number; an older libc's
+// headers may predate it even where the kernel could support it.
+#define __NR_io_uring_setup 425
+#endif
+
+int kernel_supports(const char* feature) {
+  if (feature == nullptr) {
+    return -1;
+  }
+  if (strcmp(feature, "io_uring") == 0) {
+    // Probed once: deliberately-invalid arguments, so a supporting
+    // kernel answers EINVAL/EFAULT while a pre-5.1 kernel (this dev
+    // box: 4.4.0) answers ENOSYS.  EPERM (a seccomp profile blocking
+    // the syscall — Docker's default since 2023) counts as UNSUPPORTED:
+    // the question this gate answers is "can this process actually use
+    // io_uring here", not "does the kernel have the code".  Never
+    // creates a ring.
+    static const int supported = [] {
+      errno = 0;
+      const long rc = syscall(__NR_io_uring_setup, 0, nullptr);
+      if (rc >= 0) {  // unreachable with these args, but be safe
+        return 1;
+      }
+      return (errno == ENOSYS || errno == EPERM) ? 0 : 1;
+    }();
+    return supported;
+  }
+  return -1;
 }
 
 }  // namespace trpc

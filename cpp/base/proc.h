@@ -14,4 +14,14 @@ bool parse_plain_number(const char* s, double* out);
 // Open fd count for this process (-1 on failure).
 long proc_fd_count();
 
+// Runtime kernel-capability probe: 1 = the running kernel supports the
+// feature, 0 = it does not, -1 = unknown feature name.  Known features:
+//   "io_uring"  io_uring_setup reachable (kernel >= 5.1; ENOSYS on this
+//               repo's 4.4.0 dev box — the gate that killed the ROADMAP
+//               item 2 io_uring backend as a buildable tentpole here).
+// Surfaced in /vars as kernel_io_uring_supported and through the
+// trpc_kernel_supports C ABI so future issues can check before picking
+// kernel-gated work.
+int kernel_supports(const char* feature);
+
 }  // namespace trpc

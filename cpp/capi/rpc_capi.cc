@@ -19,7 +19,9 @@
 #include "net/cluster.h"
 #include "net/controller.h"
 #include "net/fault.h"
+#include "base/proc.h"
 #include "net/ici_transport.h"
+#include "net/rma.h"
 #include "net/server.h"
 
 using namespace trpc;
@@ -537,6 +539,34 @@ void trpc_ici_staging_free(void* base) { ici_staging_free(base); }
 
 void trpc_ici_zero_copy_counters(uint64_t* wrs, uint64_t* bytes) {
   ici_zero_copy_counters(wrs, bytes);
+}
+
+// One-sided RMA regions (net/rma.h).  trpc_rma_alloc returns `len`
+// usable shm-backed bytes registered under *rkey_out; a batch resp_buf
+// pointing at them becomes a genuine remote-write target (the request
+// advertises the rkey, the server puts the response straight in).
+// Python views the buffer via (ctypes.c_char * len).from_address.
+void* trpc_rma_alloc(size_t len, uint64_t* rkey_out) {
+  return rma_alloc(len, rkey_out);
+}
+
+void trpc_rma_free(void* data) { rma_free(data); }
+
+// Local-only pin of arbitrary caller memory (0 on failure).
+uint64_t trpc_rma_reg(const void* buf, size_t len) {
+  return rma_reg(buf, len);
+}
+
+int trpc_rma_unreg(uint64_t rkey) { return rma_unreg(rkey); }
+
+// Live regions (tests).
+size_t trpc_rma_region_count() { return rma_region_count(); }
+
+// Runtime kernel-capability probe (base/proc.h): 1 supported, 0 not,
+// -1 unknown feature.  "io_uring" records the ROADMAP item 2 gate —
+// this box's 4.4.0 kernel answers ENOSYS.
+int trpc_kernel_supports(const char* feature) {
+  return kernel_supports(feature);
 }
 
 // Full-option channel creation including the transport: "tcp", "shm",

@@ -18,6 +18,7 @@
 #include "net/socket_map.h"
 #include "net/span.h"
 #include "net/stream.h"
+#include "net/rma.h"
 #include "net/stripe.h"
 #include "net/tls.h"
 
@@ -641,10 +642,22 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
     stripe_register_landing(cid, cntl->call().land_buf,
                             cntl->call().land_cap);
     cntl->call().land_registered = true;
+    // One-sided advertisement (net/rma.h): when the landing buffer is
+    // itself an exportable rma region and this connection has an rma
+    // session, the request's meta names it — the server then PUTS the
+    // response straight into the caller's buffer.
+    rma_advertise_response(sid, cid, &meta);
   }
 
   bool write_ok;
-  if (stripe_should(sid, meta.stream_id, body.size())) {
+  const int rma_rc = rma_try_send(sid, &meta, &body, 0, 0);
+  if (rma_rc == 0) {
+    // Body written one-sided into the peer's window; the control frame
+    // is queued.  Nothing rides the stripe layer.
+    write_ok = true;
+  } else if (rma_rc < 0) {
+    write_ok = false;
+  } else if (stripe_should(sid, meta.stream_id, body.size())) {
     // Multi-rail large-message path (net/stripe.h): cut the body into
     // chunk frames issued concurrently.  Pooled channels spread chunks
     // over extra pooled connections to the same endpoint (each rail has

@@ -167,6 +167,12 @@ class Controller {
     void* land_buf = nullptr;
     size_t land_cap = 0;
     bool land_registered = false;
+    // One-sided RMA (net/rma.h), server side: the request's advertised
+    // response-landing region — when set (and the connection has an rma
+    // session) the response is PUT straight into the caller's registered
+    // buffer instead of riding frames back.
+    uint64_t rma_resp_rkey = 0;
+    uint64_t rma_resp_max = 0;
     std::vector<uint64_t> stripe_rails;
   };
   CallState& call() { return call_; }

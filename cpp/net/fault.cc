@@ -541,6 +541,12 @@ class FaultTransport final : public Transport {
   // is a hang, not an injected fault).
   void flush(Socket* s) override { inner_->flush(s); }
 
+  // One-sided capability passes through untouched: rma chunk writes
+  // consult the global actor themselves (net/rma.cc rail_run, kTx
+  // decisions), and the control frame rides the wrapped byte plane —
+  // so drop/trunc/delay compose on both halves of an rma transfer.
+  RmaSession* rma(Socket* s) override { return inner_->rma(s); }
+
   bool fd_based() const override { return inner_->fd_based(); }
   const char* name() const override { return inner_->name(); }
 

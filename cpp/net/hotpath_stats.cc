@@ -82,6 +82,26 @@ HotPathVars::HotPathVars() {
       "messenger_cut_budget_yields",
       "read sweeps that yielded their worker after exhausting the "
       "per-sweep cut budget (bulk transfers sharing with small RPCs)");
+  rma_tx_msgs.expose("rma_tx_msgs",
+                     "one-sided transfers sent (control frames queued "
+                     "after the last chunk write landed)");
+  rma_tx_chunks.expose(
+      "rma_tx_chunks",
+      "chunks written one-sided into peer registered regions");
+  rma_tx_bytes.expose("rma_tx_bytes",
+                      "payload bytes moved by one-sided writes (no "
+                      "ring/socket copy)");
+  rma_rx_msgs.expose("rma_rx_msgs",
+                     "rma control frames resolved into complete "
+                     "payloads and dispatched");
+  rma_window_full.expose(
+      "rma_window_full",
+      "one-sided sends that fell back to the striped copy path because "
+      "no window span was free");
+  rma_rejected.expose(
+      "rma_rejected",
+      "rma control frames dropped whole (incomplete completion bitmap, "
+      "bad bounds, or an unknown/unbound region)");
 }
 
 HotPathVars& hotpath_vars() {

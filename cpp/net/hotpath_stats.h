@@ -57,6 +57,17 @@ struct HotPathVars {
   // often a bulk transfer handed its worker back to small-RPC dispatch.
   Adder cut_budget_yields;
 
+  // One-sided RMA plane (net/rma.h).  Like the stripe vars, every one
+  // of these stays EXACTLY zero on sub-threshold traffic — the proof
+  // that small RPCs never touch the rma layer.
+  Adder rma_tx_msgs;      // one-sided transfers sent (control frames)
+  Adder rma_tx_chunks;    // chunks written into peer regions
+  Adder rma_tx_bytes;     // payload bytes moved one-sided
+  Adder rma_rx_msgs;      // control frames resolved and dispatched
+  Adder rma_window_full;  // sends that fell back (no window span free)
+  Adder rma_rejected;     // control frames dropped whole (incomplete
+                          // bitmap, bad bounds, unknown region)
+
   HotPathVars();
 };
 

@@ -21,6 +21,7 @@
 #include "base/logging.h"
 #include "base/rand.h"
 #include "base/time.h"
+#include "net/rma.h"
 
 namespace trpc {
 
@@ -29,7 +30,9 @@ namespace {
 constexpr uint32_t kIciMaxSlots = 1024;
 constexpr uint32_t kIciMaxSlabs = 64;  // per side
 constexpr uint32_t kSlabNameLen = 48;
-constexpr uint64_t kIciMagic = 0x5452504943493254ull;  // "TRPICI2T"
+// Bumped from "...2T": the segment grew the per-side rma window rkey
+// words (net/rma.h) — a mixed-version pair must fail the handshake.
+constexpr uint64_t kIciMagic = 0x5452504943493354ull;  // "TRPICI3T"
 
 // ---- ring geometry (client proposes, server validates) ------------------
 
@@ -224,6 +227,12 @@ struct IciSegment {
   std::atomic<int32_t> server_pid;
   std::atomic<uint64_t> client_beat;
   std::atomic<uint64_t> server_beat;
+  // One-sided plane (net/rma.h): each side publishes its registered
+  // receive window's rkey (release; 0 while absent/disabled).  Large
+  // copy-mode bodies are then WRITTEN into the peer window by parallel
+  // rail fibers instead of serializing through the poller's ring DMA.
+  std::atomic<uint64_t> client_rma_rkey;
+  std::atomic<uint64_t> server_rma_rkey;
   SlabTable client_slabs;  // client's receive pool (server DMAs into these)
   SlabTable server_slabs;
   IciDir c2s;  // client sends, server receives
@@ -320,6 +329,9 @@ struct IciConn {
   std::shared_ptr<std::array<std::atomic<uint8_t>, kIciMaxSlots>>
       rx_released =
           std::make_shared<std::array<std::atomic<uint8_t>, kIciMaxSlots>>();
+  // One-sided session (net/rma.h): local window + peer window resolve.
+  std::shared_ptr<RmaSession> rma;
+
   // Peer staging slabs mapped on first reference (poller-owned map of
   // REF-COUNTED StageMapping).  Consumers of wrapped ranges co-own the
   // mapping through their RxStageCtx, so neither a dying connection nor
@@ -1043,6 +1055,13 @@ class IciRingTransport final : public Transport {
   int connect(Socket*) override { return 0; }  // established at handshake
   bool fd_based() const override { return false; }
   const char* name() const override { return "ici_ring"; }
+
+  // One-sided capability: the connection's window session (nullptr when
+  // trpc_rma_window_bytes was 0 at establishment).
+  RmaSession* rma(Socket* s) override {
+    auto* c = static_cast<IciConn*>(s->transport_ctx);
+    return c != nullptr ? c->rma.get() : nullptr;
+  }
 };
 
 IciRingTransport* ici_transport() {
@@ -1246,6 +1265,14 @@ std::shared_ptr<IciConn> ici_conn_create(std::string* name_out) {
   if (!build_rx_side(*conn)) {
     return nullptr;  // dtor unmaps + unlinks
   }
+  conn->rma = rma_session_create();
+  if (conn->rma != nullptr) {
+    conn->rma->peer_rkey_slot = &seg->server_rma_rkey;
+    // Release: the window region is fully built before the peer can
+    // observe its rkey.
+    seg->client_rma_rkey.store(conn->rma->local_rkey,
+                               std::memory_order_release);
+  }
   seg->magic = kIciMagic;  // last: publish a fully-built segment
   *name_out = name;
   return conn;
@@ -1287,6 +1314,13 @@ std::shared_ptr<IciConn> ici_conn_open(const std::string& name) {
   conn->max_blocks = seg->max_blocks;
   if (!build_rx_side(*conn)) {
     return nullptr;  // dtor unmaps + releases the name
+  }
+  conn->rma = rma_session_create();
+  if (conn->rma != nullptr) {
+    conn->rma->peer_rkey_slot = &seg->client_rma_rkey;
+    // Release: pairs with the peer's acquire read at first rma send.
+    seg->server_rma_rkey.store(conn->rma->local_rkey,
+                               std::memory_order_release);
   }
   seg->server_pid.store(static_cast<int32_t>(getpid()),
                         std::memory_order_release);
@@ -1339,6 +1373,25 @@ void ici_conn_set_self_pid(IciConn& c, int32_t pid) {
 
 void ici_conn_corrupt_tx_consumed(IciConn& c, uint64_t value) {
   c.tx_dir().desc_consumed.store(value, std::memory_order_release);
+}
+
+bool ici_payload_prefers_descriptors(const IOBuf& body) {
+  // Staging-backed bytes ship as sender-owned descriptors with ZERO
+  // copies; an rma put would reintroduce one.  The user_deleter
+  // pre-filter keeps ordinary arena blocks off the registry mutex (same
+  // screen as cut_from_iobuf's zero-copy fast path).
+  uint64_t staged = 0;
+  const uint64_t total = body.size();
+  for (size_t i = 0; i < body.block_count(); ++i) {
+    const IOBuf::BlockRef& r = body.ref_at(i);
+    uint32_t ord = 0;
+    uint64_t off = 0;
+    if (r.length >= 4096 && r.block->user_deleter != nullptr &&
+        staging_of(r.block->data + r.offset, r.length, &ord, &off)) {
+      staged += r.length;
+    }
+  }
+  return total != 0 && staged * 2 >= total;
 }
 
 std::string ici_test_stage_shm_name(int32_t pid, uint32_t ordinal) {

@@ -145,6 +145,13 @@ constexpr bool ici_desc_len_fits(uint64_t cur_size, uint64_t add_len) {
   return cur_size + add_len <= 0xffffffffull;
 }
 
+// True when `body` should ride sender-owned zero-copy descriptors
+// rather than the one-sided rma put path (net/rma.h): at least half its
+// bytes already live in OUR registered staging slabs, so descriptors
+// move them with ZERO copies — an rma put would add one.  Consulted by
+// rma_try_send for SocketMode::kIci bodies.
+bool ici_payload_prefers_descriptors(const IOBuf& body);
+
 // Test hooks for the peer-staging mapping path (resolve_stage_source):
 // the shm name a peer derives for (pid, ordinal), and the same READ-ONLY
 // mapping a receiver makes of a remote peer's staging slab (regression:

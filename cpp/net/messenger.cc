@@ -13,6 +13,7 @@
 #include "net/protocol.h"
 #include "net/qos.h"
 #include "net/stream.h"
+#include "net/rma.h"
 #include "net/stripe.h"
 #include "stat/timeline.h"
 
@@ -270,6 +271,19 @@ size_t cut_and_dispatch(Socket* s, SocketId id) {
           stripe_on_head(std::move(*msg));
           free_input_message(msg);
           continue;
+        }
+        if (msg->meta.rma_rkey != 0 &&
+            (msg->meta.type == RpcMeta::kRequest ||
+             msg->meta.type == RpcMeta::kResponse)) {
+          // One-sided control frame (net/rma.h): the payload landed
+          // out-of-band in a registered region.  Resolve swaps it in
+          // (verifying the release-fenced completion bitmap) and the
+          // message then dispatches like any other; a failed resolve
+          // drops it whole — the call times out, never partial bytes.
+          if (!rma_resolve(msg, s)) {
+            free_input_message(msg);
+            continue;
+          }
         }
         const Protocol* p = protocol_at(s->pinned_protocol);
         if (p != nullptr && msg->meta.type == RpcMeta::kAuth) {

@@ -72,7 +72,9 @@ std::string encode_meta(const RpcMeta& m) {
   // streaming hot path never pays for it.  Layout: trace(24B), then
   // compress+checksum(6B), then batch streams(4B+), then stripe(24B),
   // then qos(3B+); each later group implies every earlier one.
-  const bool has_qos = m.qos_priority != 0 || !m.qos_tenant.empty();
+  const bool has_rma = m.rma_rkey != 0 || m.rma_resp_rkey != 0;
+  const bool has_qos =
+      m.qos_priority != 0 || !m.qos_tenant.empty() || has_rma;
   const bool has_stripe = m.stripe_id != 0 || has_qos;
   const bool has_streams = !m.extra_streams.empty() || has_stripe;
   const bool has_comp =
@@ -111,6 +113,16 @@ std::string encode_meta(const RpcMeta& m) {
             s.push_back(static_cast<char>(tlen & 0xff));
             s.push_back(static_cast<char>(tlen >> 8));
             s.append(m.qos_tenant.data(), tlen);
+            if (has_rma) {
+              // tail-group 6 (rma): one-sided transfer descriptor +
+              // response-landing advertisement (net/rma.h), 44B.
+              put_u64(&s, m.rma_rkey);
+              put_u64(&s, m.rma_off);
+              put_u64(&s, m.rma_len);
+              put_u32(&s, m.rma_chunk);
+              put_u64(&s, m.rma_resp_rkey);
+              put_u64(&s, m.rma_resp_max);
+            }
           }
         }
       }
@@ -191,6 +203,15 @@ bool decode_meta(const std::string& s, RpcMeta* m) {
             }
             m->qos_tenant.assign(p, tlen);
             p += tlen;
+            if (end - p >= 44) {  // tail-group 6 (rma)
+              m->rma_rkey = get_u64(p);
+              m->rma_off = get_u64(p + 8);
+              m->rma_len = get_u64(p + 16);
+              m->rma_chunk = get_u32(p + 24);
+              m->rma_resp_rkey = get_u64(p + 28);
+              m->rma_resp_max = get_u64(p + 36);
+              p += 44;
+            }
           }
         }
       }

@@ -92,6 +92,22 @@ struct RpcMeta {
   // never pays for it.
   uint8_t qos_priority = 0;
   std::string qos_tenant;
+  // One-sided RMA (net/rma.h).  On a control frame (kRequest/kResponse
+  // with rma_rkey != 0 and an EMPTY payload): the body landed
+  // out-of-band — rma_len bytes written by the sender into the named
+  // registered region at rma_off of its data area (kRmaDirectOff = the
+  // region's own data start, completion bitmap in the region header),
+  // in rma_chunk-sized chunks whose release-fenced completion bits the
+  // receiver verifies before dispatch.  rma_resp_rkey/rma_resp_max on a
+  // REQUEST advertise the caller's registered landing region so the
+  // response can be put straight into the caller's buffer.  Sixth
+  // optional wire-tail group — all-zero (absent) on every non-rma frame.
+  uint64_t rma_rkey = 0;
+  uint64_t rma_off = 0;
+  uint64_t rma_len = 0;
+  uint32_t rma_chunk = 0;
+  uint64_t rma_resp_rkey = 0;
+  uint64_t rma_resp_max = 0;
   std::string method;
   std::string error_text;
 
@@ -117,6 +133,12 @@ struct RpcMeta {
     stripe_total = 0;
     qos_priority = 0;
     qos_tenant.clear();
+    rma_rkey = 0;
+    rma_off = 0;
+    rma_len = 0;
+    rma_chunk = 0;
+    rma_resp_rkey = 0;
+    rma_resp_max = 0;
     method.clear();
     error_text.clear();
   }

@@ -1,0 +1,1081 @@
+#include "net/rma.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <unordered_map>
+#include <vector>
+
+#include "base/compress.h"
+#include "base/flags.h"
+#include "base/logging.h"
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "net/fault.h"
+#include "net/hotpath_stats.h"
+#include "net/ici_transport.h"
+#include "net/socket.h"
+#include "net/stripe.h"
+#include "stat/timeline.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr uint64_t kRmaMagic = 0x545250524d413154ull;  // "TRPRMA1T"
+// Region layout: [RmaSegHdr, padded to kRmaDataOffset][data area].
+// Window spans reserve kRmaSpanHdr at their start for the transfer
+// header; direct (caller-buffer) transfers use the RmaSegHdr's embedded
+// header so the payload can land at data offset 0.
+constexpr uint32_t kRmaDataOffset = 8192;
+constexpr uint32_t kRmaSpanHdr = 8192;
+constexpr uint32_t kRmaMaxChunks = 1024;
+constexpr uint32_t kRmaBitWords = kRmaMaxChunks / 64;
+// Window slots fit ONE bitmap word: span allocation is a single CAS and
+// a span is always a contiguous run of ≤ 64 slots.
+constexpr uint32_t kRmaWindowSlots = 64;
+constexpr uint32_t kXferCrcPresent = 1u << 0;
+
+// One transfer's completion state, shared memory.  The sender writes
+// the scalar fields before any chunk, sets chunk_bits with release as
+// each chunk's bytes land, and the receiver admits the payload only
+// when every bit reads set (acquire) — the control frame alone never
+// proves the bytes arrived (a faulted chunk leaves its bit clear).
+struct RmaXfer {
+  // Release/acquire: `total` is the sender's first store and doubles as
+  // the header-initialized marker for the direct path.
+  std::atomic<uint64_t> total;
+  // The transfer's correlation id, stamped at init and matched at
+  // resolve: a LATE put from a timed-out call that re-initializes a
+  // reused direct landing region after the live call's init makes the
+  // live resolve reject (clean whole-call failure) instead of admitting
+  // interleaved bytes.  (A stale writer racing mid-flight is inherent to
+  // shared memory — see the reuse contract in rma.h/RmaBuffer.)
+  uint64_t token;
+  uint32_t chunk_bytes;
+  uint32_t nchunks;
+  uint32_t flags;  // kXferCrcPresent: chunk_crc[] carries per-chunk crc32c
+  uint32_t pad;
+  // Release per set bit (pairs with the receiver's acquire scan): a set
+  // bit publishes that chunk's payload bytes.
+  std::atomic<uint64_t> chunk_bits[kRmaBitWords];
+  uint32_t chunk_crc[kRmaMaxChunks];
+};
+static_assert(sizeof(RmaXfer) <= kRmaSpanHdr, "span header overflow");
+
+struct RmaSegHdr {
+  uint64_t magic;
+  uint32_t data_off;
+  uint32_t nslots;  // 0: plain region (no window allocator)
+  uint64_t data_len;
+  uint32_t slot_bytes;
+  uint32_t reserved;
+  // Window slot bitmap, shared: the PEER allocates spans (CAS set,
+  // acquire — a freed slot's payload reads must not be reordered before
+  // the claim), the owner frees them (fetch_and clear, release — the
+  // consumer finished reading before the slot recycles).
+  std::atomic<uint64_t> slot_map;
+  // Direct-to-region transfers (caller landing buffers) complete here.
+  RmaXfer direct;
+};
+static_assert(sizeof(RmaSegHdr) <= kRmaDataOffset, "region header overflow");
+
+int64_t flag_value(Flag* f, int64_t dflt) {
+  return f != nullptr ? f->int64_value() : dflt;
+}
+
+Flag* int_flag(const char* name, int64_t dflt, const char* desc, int64_t lo,
+               int64_t hi) {
+  Flag* f = Flag::define_int64(name, dflt, desc);
+  if (f != nullptr) {
+    f->set_validator([lo, hi](const std::string& v) {
+      char* end = nullptr;
+      const long long n = strtoll(v.c_str(), &end, 10);
+      return end != v.c_str() && *end == '\0' && n >= lo && n <= hi;
+    });
+  }
+  return f;
+}
+
+Flag* window_flag() {
+  static Flag* f = [] {
+    Flag* flag = Flag::define_int64(
+        "trpc_rma_window_bytes", 256ll << 20,
+        "per-connection one-sided receive window for NEW shm/ici "
+        "connections (bytes, 0 disables the rma plane, else a power of "
+        "two in [16MB, 4GB]; the largest one-sided transfer is the "
+        "window minus one 4MB-granularity slot)");
+    if (flag != nullptr) {
+      flag->set_validator([](const std::string& v) {
+        char* end = nullptr;
+        const long long n = strtoll(v.c_str(), &end, 10);
+        return end != v.c_str() && *end == '\0' &&
+               (n == 0 || (n >= (16ll << 20) && n <= (4ll << 30) &&
+                           (n & (n - 1)) == 0));
+      });
+    }
+    return flag;
+  }();
+  return f;
+}
+
+Flag* shm_rails_flag() {
+  static Flag* f = int_flag(
+      "trpc_shm_rails", 4,
+      "concurrent one-sided writer lanes for rma transfers over shm "
+      "connections (parallel rail fibers, each owning a contiguous "
+      "chunk range)",
+      1, 16);
+  return f;
+}
+
+Flag* ici_rails_flag() {
+  static Flag* f = int_flag(
+      "trpc_ici_rails", 4,
+      "concurrent one-sided writer lanes for rma transfers over ici "
+      "connections (parallel rail fibers, each owning a contiguous "
+      "chunk range)",
+      1, 16);
+  return f;
+}
+
+[[maybe_unused]] Flag* const g_rma_flags_eager[] = {
+    window_flag(), shm_rails_flag(), ici_rails_flag()};
+
+// ---- registry ------------------------------------------------------------
+
+// TRUSTED geometry snapshot of a region.  The live header lives in
+// peer-writable shared memory, so every consumer works from a snapshot
+// taken when WE created the region (registry) or validated the mapping
+// (peer windows) — a peer scribbling its header afterwards can corrupt
+// its own data plane but can never push our arithmetic out of bounds
+// (slot_bytes=0 division, data_off past the mapping, ...).
+struct RmaGeom {
+  uint64_t data_len = 0;
+  uint32_t slot_bytes = 0;
+  uint32_t nslots = 0;  // 0: plain region
+};
+
+struct RegionRec {
+  uint64_t rkey = 0;
+  std::shared_ptr<RmaMapping> map;  // null for local pins (rma_reg)
+  std::string name;                 // shm name for exportable regions
+  const char* pin_base = nullptr;   // local pins: the pinned range
+  size_t pin_len = 0;
+  bool window = false;
+  // rma_free arrived while a landing bind (an in-flight call's resp_buf)
+  // still referenced this region: the striped copy-path fallback holds
+  // the raw data pointer, so the unmap defers until the last bind drops
+  // (rma_landing_unbind) instead of pulling pages out from under a late
+  // landing memcpy.
+  bool free_pending = false;
+  RmaGeom geom;
+};
+
+struct LandingBind {
+  uint64_t rkey = 0;
+  uint64_t cap = 0;
+};
+
+std::mutex& reg_mu() {
+  static std::mutex* mu = new std::mutex();
+  return *mu;
+}
+std::vector<RegionRec>& regions() {
+  static auto* v = new std::vector<RegionRec>();
+  return *v;
+}
+std::unordered_map<uint64_t, LandingBind>& landing_binds() {
+  static auto* m = new std::unordered_map<uint64_t, LandingBind>();
+  return *m;
+}
+// Relaxed: ordinal mint only needs uniqueness, no ordering.
+std::atomic<uint32_t> g_next_ordinal{1};
+
+std::string rma_shm_name(int32_t pid, uint32_t ordinal) {
+  char name[64];
+  snprintf(name, sizeof(name), "/trpc_rma_%d_%u", pid, ordinal);
+  return name;
+}
+
+uint64_t make_rkey(uint32_t ordinal) {
+  return (static_cast<uint64_t>(static_cast<uint32_t>(getpid())) << 32) |
+         ordinal;
+}
+
+RmaSegHdr* hdr_of(const std::shared_ptr<RmaMapping>& m) {
+  return reinterpret_cast<RmaSegHdr*>(m->base);
+}
+
+// Creates + registers one exportable region.  window: initialize the
+// slot allocator over the data area.
+void* region_create(size_t data_len, bool window, uint64_t* rkey_out) {
+  if (data_len == 0 || data_len > (4ull << 30)) {
+    return nullptr;
+  }
+  // Relaxed: ordinal mint needs uniqueness only, no ordering.
+  const uint32_t ord =
+      g_next_ordinal.fetch_add(1, std::memory_order_relaxed);
+  const std::string name = rma_shm_name(getpid(), ord);
+  const size_t bytes = kRmaDataOffset + data_len;
+  const int fd = shm_open(name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) {
+    return nullptr;
+  }
+  if (ftruncate(fd, static_cast<off_t>(bytes)) != 0) {
+    close(fd);
+    shm_unlink(name.c_str());
+    return nullptr;
+  }
+  void* mem =
+      mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name.c_str());
+    return nullptr;
+  }
+  auto* h = static_cast<RmaSegHdr*>(mem);
+  memset(static_cast<void*>(h), 0, sizeof(RmaSegHdr));
+  h->data_off = kRmaDataOffset;
+  h->data_len = data_len;
+  if (window) {
+    h->nslots = kRmaWindowSlots;
+    h->slot_bytes = static_cast<uint32_t>(data_len / kRmaWindowSlots);
+  }
+  // Release via the magic store position: peers validate magic before
+  // trusting any other field (plain store is fine — the name is only
+  // shipped to peers after this returns).
+  h->magic = kRmaMagic;
+  auto mapping = std::make_shared<RmaMapping>();
+  mapping->base = static_cast<char*>(mem);
+  mapping->len = bytes;
+  mapping->owned = true;
+  RegionRec rec;
+  rec.rkey = make_rkey(ord);
+  rec.map = mapping;
+  rec.name = name;
+  rec.window = window;
+  rec.geom.data_len = data_len;
+  rec.geom.slot_bytes = h->slot_bytes;
+  rec.geom.nslots = h->nslots;
+  {
+    std::lock_guard<std::mutex> g(reg_mu());
+    regions().push_back(std::move(rec));
+  }
+  if (rkey_out != nullptr) {
+    *rkey_out = make_rkey(ord);
+  }
+  return static_cast<char*>(mem) + kRmaDataOffset;
+}
+
+// Local-registry lookup (receiver side; loopback peer resolution) with
+// the TRUSTED creation-time geometry.
+std::shared_ptr<RmaMapping> local_region(uint64_t rkey, bool* window,
+                                         RmaGeom* geom) {
+  std::lock_guard<std::mutex> g(reg_mu());
+  for (const RegionRec& r : regions()) {
+    if (r.rkey == rkey && r.map != nullptr) {
+      if (window != nullptr) {
+        *window = r.window;
+      }
+      if (geom != nullptr) {
+        *geom = r.geom;
+      }
+      return r.map;
+    }
+  }
+  return nullptr;
+}
+
+// Maps a PEER's exportable region by rkey, snapshotting its geometry
+// from the header ONCE under validation (all later arithmetic uses the
+// snapshot).  Loopback (peer pid == ours) shares the registry's own
+// mapping — same virtual address, and the shared refcount defers
+// rma_free's munmap past this user.
+std::shared_ptr<RmaMapping> map_peer_region(uint64_t rkey, RmaGeom* geom) {
+  const int32_t pid = static_cast<int32_t>(rkey >> 32);
+  const uint32_t ord = static_cast<uint32_t>(rkey);
+  if (pid == getpid()) {
+    return local_region(rkey, nullptr, geom);
+  }
+  const std::string name = rma_shm_name(pid, ord);
+  const int fd = shm_open(name.c_str(), O_RDWR, 0600);
+  if (fd < 0) {
+    return nullptr;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0 ||
+      st.st_size < static_cast<off_t>(kRmaDataOffset)) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, static_cast<size_t>(st.st_size),
+                   PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    return nullptr;
+  }
+  auto m = std::make_shared<RmaMapping>();
+  m->base = static_cast<char*>(mem);
+  m->len = static_cast<size_t>(st.st_size);
+  m->owned = true;
+  const RmaSegHdr* h = hdr_of(m);
+  // Copy-then-validate: each field is read ONCE into the snapshot; the
+  // live header may be scribbled by its owner afterwards.
+  RmaGeom snap;
+  snap.data_len = h->data_len;
+  snap.slot_bytes = h->slot_bytes;
+  snap.nslots = h->nslots;
+  if (h->magic != kRmaMagic || h->data_off != kRmaDataOffset ||
+      snap.data_len == 0 || snap.data_len > m->len - kRmaDataOffset) {
+    return nullptr;  // mapping dtor unmaps
+  }
+  if (snap.nslots != 0 &&
+      (snap.nslots != kRmaWindowSlots || snap.slot_bytes < kRmaSpanHdr ||
+       static_cast<uint64_t>(snap.slot_bytes) * snap.nslots >
+           snap.data_len)) {
+    return nullptr;
+  }
+  if (geom != nullptr) {
+    *geom = snap;
+  }
+  return m;
+}
+
+// ---- window span allocator ----------------------------------------------
+
+// Claims a contiguous run of slots covering `need` bytes (trusted
+// geometry only — never the live header's).  Single-word CAS: ≤ 64
+// slots per window by construction.  -1 when no run fits (window full —
+// the caller falls back to the copy path).
+int span_alloc(RmaSegHdr* h, const RmaGeom& g, uint64_t need,
+               uint64_t* off_out) {
+  const uint32_t k =
+      static_cast<uint32_t>((need + g.slot_bytes - 1) / g.slot_bytes);
+  if (k == 0 || k > g.nslots) {
+    return -1;
+  }
+  const uint64_t run = k == 64 ? ~0ull : ((1ull << k) - 1);
+  // Acquire on the claim: the payload bytes we are about to write into
+  // a recycled slot must not be ordered before the observation that the
+  // receiver freed it.
+  uint64_t cur = h->slot_map.load(std::memory_order_acquire);
+  while (true) {
+    int start = -1;
+    for (uint32_t s = 0; s + k <= g.nslots; ++s) {
+      if ((cur & (run << s)) == 0) {
+        start = static_cast<int>(s);
+        break;
+      }
+    }
+    if (start < 0) {
+      return -1;
+    }
+    // Acquire on both CAS orders: claiming (or re-reading) the bitmap
+    // must happen-before our writes into possibly-recycled slots — pairs
+    // with span_free's release clear after the consumer's last read.
+    if (h->slot_map.compare_exchange_weak(cur, cur | (run << start),
+                                          std::memory_order_acquire,
+                                          std::memory_order_acquire)) {
+      *off_out = static_cast<uint64_t>(start) * g.slot_bytes;
+      return 0;
+    }
+  }
+}
+
+void span_free(RmaSegHdr* h, const RmaGeom& g, uint64_t off,
+               uint64_t need) {
+  const uint32_t k =
+      static_cast<uint32_t>((need + g.slot_bytes - 1) / g.slot_bytes);
+  const uint32_t start = static_cast<uint32_t>(off / g.slot_bytes);
+  const uint64_t run = k == 64 ? ~0ull : ((1ull << k) - 1);
+  // Release: every read of the span's payload happened before the slots
+  // recycle to the allocating peer.
+  h->slot_map.fetch_and(~(run << start), std::memory_order_release);
+}
+
+// ---- send path -----------------------------------------------------------
+
+// Effective chunk size: the configured stripe chunk, grown until the
+// count fits the bitmap.
+uint64_t effective_chunk(uint64_t total) {
+  uint64_t chunk = std::max<uint64_t>(64 << 10, stripe_chunk_bytes());
+  while ((total + chunk - 1) / chunk > kRmaMaxChunks) {
+    chunk *= 2;
+  }
+  return chunk;
+}
+
+void xfer_init(RmaXfer* x, uint64_t total, uint64_t chunk, bool crc,
+               uint64_t token) {
+  const uint32_t nchunks =
+      static_cast<uint32_t>((total + chunk - 1) / chunk);
+  x->token = token;
+  x->chunk_bytes = static_cast<uint32_t>(chunk);
+  x->nchunks = nchunks;
+  x->flags = crc ? kXferCrcPresent : 0;
+  for (uint32_t i = 0; i < kRmaBitWords; ++i) {
+    // Relaxed: bits are re-published per chunk with release below; the
+    // zeroing itself is ordered by the `total` release store that marks
+    // the header live.
+    x->chunk_bits[i].store(0, std::memory_order_relaxed);
+  }
+  // Release: publishes the scalar header fields (and the cleared bitmap)
+  // before any chunk bit can be observed set.
+  x->total.store(total, std::memory_order_release);
+}
+
+struct RailJob {
+  RmaXfer* x = nullptr;
+  char* dst_base = nullptr;  // payload base in the peer region
+  IOBuf data;                // this rail's contiguous byte range
+  uint32_t first_chunk = 0;
+  uint64_t chunk = 0;
+  uint64_t total = 0;
+  uint64_t cid = 0;     // timeline correlation
+  uint32_t rail = 0;
+  bool crc = false;
+  EndPoint peer;
+  std::atomic<uint32_t>* remaining = nullptr;
+};
+
+// Writes one rail's chunk range: memcpy into the peer region, then a
+// release-fenced bit per chunk.  Fault points compose with the global
+// transport actor (kTx): drop skips write+bit, trunc writes a prefix and
+// skips the bit (whole-call failure either way), delay parks first.
+void rail_run(RailJob* j) {
+  FaultActor& fa = FaultActor::global();
+  const bool tl = timeline::enabled();
+  uint32_t ci = j->first_chunk;
+  uint64_t off = static_cast<uint64_t>(ci) * j->chunk;
+  while (!j->data.empty()) {
+    IOBuf piece;
+    j->data.cutn(&piece, j->chunk);
+    const uint64_t n = piece.size();
+    bool write_bytes = true;
+    bool set_bit = true;
+    uint64_t trunc_to = n;
+    bool corrupt = false;
+    if (fa.active()) {
+      // Same kTx decision stream as the byte plane (FaultTransport), so
+      // chunk faults replay by seed alongside everything else.  delay
+      // faults compose via the control frame's rx path instead — a
+      // delayed ring read stalls the whole transfer's completion.
+      const FaultDecision d = fa.decide(FaultPoint::kTx, j->peer);
+      switch (d.kind) {
+        case FaultKind::kDrop:
+        case FaultKind::kReset:
+          write_bytes = false;
+          set_bit = false;
+          break;
+        case FaultKind::kTrunc:
+        case FaultKind::kPartial:
+          trunc_to = n > 1 ? d.rand % n : 0;
+          set_bit = false;
+          break;
+        case FaultKind::kCorrupt:
+          corrupt = true;  // flip one byte AFTER the copy
+          break;
+        default:
+          break;
+      }
+    }
+    if (write_bytes) {
+      piece.copy_to(j->dst_base + off, trunc_to);
+      if (corrupt && trunc_to > 0) {
+        // One flipped byte in the landed chunk: the per-chunk CRC (when
+        // the call checksums) rejects the whole transfer at resolve.
+        j->dst_base[off] ^= 0x20;
+      }
+    }
+    if (set_bit) {
+      if (j->crc) {
+        j->x->chunk_crc[ci] = crc32c(piece);
+      }
+      // Release: publishes this chunk's payload bytes (and its CRC slot)
+      // to the receiver's acquire bitmap scan.
+      j->x->chunk_bits[ci / 64].fetch_or(1ull << (ci % 64),
+                                         std::memory_order_release);
+    }
+    if (tl) {
+      // Rail index carries the rma marker bit so Perfetto's rail tracks
+      // show one-sided puts distinctly from ring-copied stripe sends.
+      timeline::record(timeline::kStripeSend, j->cid,
+                       ((timeline::kStripeRmaRailBit |
+                         static_cast<uint64_t>(j->rail))
+                        << 48) |
+                           off);
+    }
+    ci += 1;
+    off += n;
+  }
+  // Release on the countdown: the joining sender must observe every
+  // chunk write this rail issued before sending the control frame.
+  j->remaining->fetch_sub(1, std::memory_order_release);
+}
+
+void rail_fiber(void* arg) {
+  auto* j = static_cast<RailJob*>(arg);
+  rail_run(j);
+  delete j;
+}
+
+// Cuts body into rail ranges and writes them concurrently; returns when
+// every rail finished.  payload_dst points at the transfer's payload
+// base in the peer region.
+void put_body(RmaXfer* x, char* payload_dst, IOBuf&& body, uint64_t chunk,
+              int rails, uint64_t cid, bool crc, const EndPoint& peer) {
+  const uint64_t total = body.size();
+  const uint32_t nchunks =
+      static_cast<uint32_t>((total + chunk - 1) / chunk);
+  const uint32_t want =
+      std::max(1u, std::min<uint32_t>(static_cast<uint32_t>(rails),
+                                      nchunks));
+  const uint32_t per = (nchunks + want - 1) / want;  // chunks per rail
+  // Rails actually used: ceil(nchunks/per) — may be fewer than `want`
+  // when the rounding above packs the chunks tighter (the join counts
+  // REAL rails, or it would wait forever on lanes that never ran).
+  const uint32_t r = (nchunks + per - 1) / per;
+  std::atomic<uint32_t> remaining{r};
+  RailJob* inline_job = nullptr;
+  for (uint32_t i = 0; i < r; ++i) {
+    auto* j = new RailJob();
+    j->x = x;
+    j->dst_base = payload_dst;
+    j->first_chunk = i * per;
+    j->chunk = chunk;
+    j->total = total;
+    j->cid = cid;
+    j->rail = i;
+    j->crc = crc;
+    j->peer = peer;
+    j->remaining = &remaining;
+    const uint64_t rail_bytes =
+        std::min<uint64_t>(static_cast<uint64_t>(per) * chunk, body.size());
+    body.cutn(&j->data, rail_bytes);
+    const bool last = i + 1 == r;
+    if (!last) {
+      if (fiber_start(nullptr, rail_fiber, j, 0) != 0) {
+        rail_run(j);
+        delete j;
+      }
+    } else {
+      inline_job = j;  // the caller is rail r-1's writer
+      break;
+    }
+  }
+  if (inline_job != nullptr) {
+    rail_run(inline_job);
+    delete inline_job;
+  }
+  // Bounded join: each rail is a finite chunk-range memcpy.  Acquire
+  // pairs with the rails' release countdown so every chunk write
+  // happens-before the control frame below.
+  while (remaining.load(std::memory_order_acquire) != 0) {
+    if (in_fiber()) {
+      fiber_sleep_us(20);
+    } else {
+      usleep(20);
+    }
+  }
+}
+
+// Queues the zero-payload control frame.  0 on success.
+int send_control(SocketId primary, RpcMeta&& meta) {
+  IOBuf frame;
+  tstd_pack(&frame, meta, IOBuf());
+  SocketRef s(Socket::Address(primary));
+  return s && s->Write(std::move(frame)) == 0 ? 0 : -1;
+}
+
+// Resolves (and caches) the peer's window for a session.
+std::shared_ptr<RmaMapping> resolve_peer_window(RmaSession* rs,
+                                                uint64_t* rkey_out,
+                                                RmaGeom* geom_out) {
+  std::lock_guard<std::mutex> g(rs->mu);
+  // Acquire: the peer published its window rkey into the shared segment
+  // after fully creating the region.
+  const uint64_t prk =
+      rs->peer_rkey_slot != nullptr
+          ? rs->peer_rkey_slot->load(std::memory_order_acquire)
+          : 0;
+  if (prk == 0) {
+    return nullptr;
+  }
+  if (rs->peer_map == nullptr || rs->peer_rkey != prk) {
+    RmaGeom snap;
+    std::shared_ptr<RmaMapping> m = map_peer_region(prk, &snap);
+    if (m == nullptr || snap.nslots == 0) {
+      return nullptr;
+    }
+    rs->peer_map = std::move(m);
+    rs->peer_rkey = prk;
+    rs->peer_data_len = snap.data_len;
+    rs->peer_slot_bytes = snap.slot_bytes;
+    rs->peer_nslots = snap.nslots;
+  }
+  *rkey_out = rs->peer_rkey;
+  geom_out->data_len = rs->peer_data_len;
+  geom_out->slot_bytes = rs->peer_slot_bytes;
+  geom_out->nslots = rs->peer_nslots;
+  return rs->peer_map;
+}
+
+// Deleter context for a window-span payload: frees the span's slots in
+// OUR OWN window when the consumer's last reference drops, holding the
+// mapping alive meanwhile.  Carries the trusted geometry — the deleter
+// may run long after a hostile peer scribbled the live header.
+struct SpanCtx {
+  std::shared_ptr<RmaMapping> map;
+  RmaGeom geom;
+  uint64_t off = 0;
+  uint64_t need = 0;
+};
+
+void span_deleter(void*, void* vctx) {
+  auto* ctx = static_cast<SpanCtx*>(vctx);
+  span_free(hdr_of(ctx->map), ctx->geom, ctx->off, ctx->need);
+  delete ctx;
+}
+
+// Deleter context for a direct (caller-region) payload: the caller owns
+// the bytes; only the mapping refcount is held (so rma_free defers).
+struct DirectCtx {
+  std::shared_ptr<RmaMapping> map;
+};
+
+void direct_deleter(void*, void* vctx) {
+  delete static_cast<DirectCtx*>(vctx);
+}
+
+// Verifies a transfer header + bitmap + optional CRCs against the data
+// area.  All header fields are copied locally FIRST: the header lives in
+// shared memory and a hostile peer can mutate it between check and use.
+bool xfer_verify(const RmaXfer* x, uint64_t want_token, const char* payload,
+                 uint64_t want_len, uint64_t avail) {
+  // Acquire: pairs with the sender's header-publishing release store.
+  const uint64_t total = x->total.load(std::memory_order_acquire);
+  const uint64_t token = x->token;
+  const uint64_t chunk = x->chunk_bytes;
+  const uint32_t nchunks = x->nchunks;
+  const uint32_t flags = x->flags;
+  if (token != want_token || total == 0 || total != want_len ||
+      total > avail || chunk < 1024 ||
+      nchunks == 0 || nchunks > kRmaMaxChunks ||
+      static_cast<uint64_t>(nchunks - 1) * chunk >= total ||
+      static_cast<uint64_t>(nchunks) * chunk < total) {
+    return false;
+  }
+  for (uint32_t i = 0; i < nchunks; i += 64) {
+    const uint32_t in_word = std::min(64u, nchunks - i);
+    const uint64_t want =
+        in_word == 64 ? ~0ull : ((1ull << in_word) - 1);
+    // Acquire: a set bit publishes that chunk's payload bytes.
+    if ((x->chunk_bits[i / 64].load(std::memory_order_acquire) & want) !=
+        want) {
+      return false;  // incomplete transfer: faulted chunk — drop whole
+    }
+  }
+  if (flags & kXferCrcPresent) {
+    for (uint32_t i = 0; i < nchunks; ++i) {
+      const uint64_t off = static_cast<uint64_t>(i) * chunk;
+      const uint64_t n = std::min(chunk, total - off);
+      if (crc32c(payload + off, n) != x->chunk_crc[i]) {
+        return false;
+      }
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+RmaMapping::~RmaMapping() {
+  if (base != nullptr && owned) {
+    munmap(base, len);
+  }
+}
+
+RmaSession::~RmaSession() {
+  if (local_rkey != 0) {
+    // Release the window region: unlink + drop the registry ref; the
+    // munmap defers past any still-wrapped payload.
+    std::lock_guard<std::mutex> g(reg_mu());
+    auto& v = regions();
+    for (auto it = v.begin(); it != v.end(); ++it) {
+      if (it->rkey == local_rkey) {
+        if (!it->name.empty()) {
+          shm_unlink(it->name.c_str());
+        }
+        v.erase(it);
+        break;
+      }
+    }
+  }
+}
+
+std::shared_ptr<RmaSession> rma_session_create() {
+  const int64_t bytes = flag_value(window_flag(), 0);
+  if (bytes <= 0) {
+    return nullptr;
+  }
+  uint64_t rkey = 0;
+  if (region_create(static_cast<size_t>(bytes), /*window=*/true, &rkey) ==
+      nullptr) {
+    return nullptr;
+  }
+  auto s = std::make_shared<RmaSession>();
+  s->local_rkey = rkey;
+  return s;
+}
+
+void* rma_alloc(size_t len, uint64_t* rkey_out) {
+  return region_create(len, /*window=*/false, rkey_out);
+}
+
+void rma_free(void* data) {
+  if (data == nullptr) {
+    return;
+  }
+  const char* base = static_cast<const char*>(data) - kRmaDataOffset;
+  std::lock_guard<std::mutex> g(reg_mu());
+  auto& v = regions();
+  for (auto it = v.begin(); it != v.end(); ++it) {
+    if (it->map != nullptr && it->map->base == base) {
+      if (!it->name.empty()) {
+        shm_unlink(it->name.c_str());  // no NEW peer maps either way
+      }
+      for (const auto& [cid, bind] : landing_binds()) {
+        if (bind.rkey == it->rkey) {
+          // An in-flight call still lands here (possibly via the striped
+          // copy path, which holds the raw pointer): defer the erase —
+          // and with it the munmap — to the last unbind.
+          it->free_pending = true;
+          return;
+        }
+      }
+      v.erase(it);  // mapping refcount defers the munmap
+      return;
+    }
+  }
+}
+
+uint64_t rma_reg(const void* buf, size_t len) {
+  if (buf == nullptr || len == 0) {
+    return 0;
+  }
+  // Relaxed: ordinal mint needs uniqueness only, no ordering.
+  const uint32_t ord =
+      g_next_ordinal.fetch_add(1, std::memory_order_relaxed);
+  const uint64_t rkey = make_rkey(ord);
+  RegionRec rec;
+  rec.rkey = rkey;
+  rec.pin_base = static_cast<const char*>(buf);
+  rec.pin_len = len;
+  std::lock_guard<std::mutex> g(reg_mu());
+  regions().push_back(std::move(rec));
+  return rkey;
+}
+
+int rma_unreg(uint64_t rkey) {
+  std::lock_guard<std::mutex> g(reg_mu());
+  auto& v = regions();
+  for (auto it = v.begin(); it != v.end(); ++it) {
+    if (it->rkey == rkey && it->map == nullptr) {
+      v.erase(it);
+      return 0;
+    }
+  }
+  return -1;
+}
+
+bool rma_exportable(const void* buf, size_t len, uint64_t* rkey,
+                    uint64_t* off) {
+  const char* p = static_cast<const char*>(buf);
+  std::lock_guard<std::mutex> g(reg_mu());
+  for (const RegionRec& r : regions()) {
+    if (r.map == nullptr || r.window || r.free_pending) {
+      continue;  // windows are connection-owned, not caller landings;
+                 // free_pending regions accept no NEW registrations
+    }
+    const char* data = r.map->base + kRmaDataOffset;
+    if (p >= data && len <= r.geom.data_len &&
+        p + len <= data + r.geom.data_len) {
+      if (rkey != nullptr) {
+        *rkey = r.rkey;
+      }
+      if (off != nullptr) {
+        *off = static_cast<uint64_t>(p - data);
+      }
+      return true;
+    }
+  }
+  return false;
+}
+
+size_t rma_region_count() {
+  std::lock_guard<std::mutex> g(reg_mu());
+  return regions().size();
+}
+
+void rma_landing_bind(uint64_t cid, void* buf, size_t cap) {
+  uint64_t rkey = 0;
+  uint64_t off = 0;
+  if (!rma_exportable(buf, cap, &rkey, &off) || off != 0) {
+    return;  // copy-path landing only (arbitrary caller memory)
+  }
+  std::lock_guard<std::mutex> g(reg_mu());
+  landing_binds()[cid] = LandingBind{rkey, cap};
+}
+
+void rma_landing_unbind(uint64_t cid) {
+  std::lock_guard<std::mutex> g(reg_mu());
+  auto it = landing_binds().find(cid);
+  if (it == landing_binds().end()) {
+    return;
+  }
+  const uint64_t rkey = it->second.rkey;
+  landing_binds().erase(it);
+  for (const auto& [other_cid, bind] : landing_binds()) {
+    if (bind.rkey == rkey) {
+      return;  // another in-flight call still lands in the region
+    }
+  }
+  auto& v = regions();
+  for (auto rit = v.begin(); rit != v.end(); ++rit) {
+    if (rit->rkey == rkey && rit->free_pending) {
+      v.erase(rit);  // the deferred rma_free completes here
+      return;
+    }
+  }
+}
+
+uint64_t rma_landing_rkey(uint64_t cid, uint64_t* max_out) {
+  std::lock_guard<std::mutex> g(reg_mu());
+  auto it = landing_binds().find(cid);
+  if (it == landing_binds().end()) {
+    return 0;
+  }
+  if (max_out != nullptr) {
+    *max_out = it->second.cap;
+  }
+  return it->second.rkey;
+}
+
+int rma_rails_for(int socket_mode) {
+  return static_cast<int>(
+      socket_mode == static_cast<int>(SocketMode::kIci)
+          ? flag_value(ici_rails_flag(), 4)
+          : flag_value(shm_rails_flag(), 4));
+}
+
+void rma_advertise_response(SocketId sid, uint64_t cid, RpcMeta* meta) {
+  uint64_t max = 0;
+  const uint64_t rkey = rma_landing_rkey(cid, &max);
+  if (rkey == 0) {
+    return;
+  }
+  SocketRef s(Socket::Address(sid));
+  if (!s || s->transport() == nullptr ||
+      s->transport()->rma(s.get()) == nullptr) {
+    return;  // no one-sided plane on this connection
+  }
+  meta->rma_resp_rkey = rkey;
+  meta->rma_resp_max = max;
+}
+
+int rma_try_send(SocketId primary, RpcMeta* meta, IOBuf* body,
+                 uint64_t target_rkey, uint64_t target_max) {
+  const uint64_t total = body->size();
+  if (meta->stream_id != 0 || !stripe_eligible(total)) {
+    return 1;
+  }
+  SocketRef s(Socket::Address(primary));
+  if (!s || s->transport() == nullptr) {
+    return 1;
+  }
+  RmaSession* rs = s->transport()->rma(s.get());
+  if (rs == nullptr) {
+    return 1;
+  }
+  if (s->mode() == SocketMode::kIci &&
+      ici_payload_prefers_descriptors(*body)) {
+    return 1;  // staging-backed bodies ride sender-owned descriptors
+  }
+  const uint64_t chunk = effective_chunk(total);
+  const bool crc = meta->has_checksum;
+  const int rails = rma_rails_for(static_cast<int>(s->mode()));
+  const uint64_t cid = meta->correlation_id;
+  const EndPoint peer = s->remote();
+
+  // Direct-to-region: the peer advertised a registered caller buffer for
+  // this payload (response landing) — write at data offset 0, completion
+  // bitmap in the region header.
+  if (target_rkey != 0 && total <= target_max) {
+    RmaGeom tg;
+    std::shared_ptr<RmaMapping> m = map_peer_region(target_rkey, &tg);
+    if (m != nullptr) {
+      RmaSegHdr* h = hdr_of(m);
+      if (tg.nslots == 0 && total <= tg.data_len) {
+        if (timeline::enabled()) {
+          timeline::record(timeline::kStripeCut, cid, total);
+        }
+        xfer_init(&h->direct, total, chunk, crc, cid);
+        const uint32_t nchunks =
+            static_cast<uint32_t>((total + chunk - 1) / chunk);
+        put_body(&h->direct, m->base + kRmaDataOffset, std::move(*body),
+                 chunk, rails, cid, crc, peer);
+        meta->rma_rkey = target_rkey;
+        meta->rma_off = kRmaDirectOff;
+        meta->rma_len = total;
+        meta->rma_chunk = chunk;
+        // The control frame's payload is empty, so a checksummed call's
+        // frame carries crc32c("") == 0 — has_checksum stays SET (the
+        // server derives response-checksum intent from it; the real
+        // integrity rides the per-chunk CRCs in the transfer header).
+        meta->checksum = 0;
+        hotpath_vars().rma_tx_msgs << 1;
+        hotpath_vars().rma_tx_chunks << nchunks;
+        hotpath_vars().rma_tx_bytes << static_cast<int64_t>(total);
+        return send_control(primary, std::move(*meta)) == 0 ? 0 : -1;
+      }
+    }
+    // Advertised region unusable: fall through to the window path.
+  }
+
+  uint64_t peer_rkey = 0;
+  RmaGeom wg;
+  std::shared_ptr<RmaMapping> m = resolve_peer_window(rs, &peer_rkey, &wg);
+  if (m == nullptr) {
+    return 1;  // peer window not published (old peer / disabled)
+  }
+  RmaSegHdr* h = hdr_of(m);
+  uint64_t off = 0;
+  const uint64_t need = kRmaSpanHdr + total;
+  if (span_alloc(h, wg, need, &off) != 0) {
+    hotpath_vars().rma_window_full << 1;
+    return 1;  // window full: copy path carries this one
+  }
+  auto* x = reinterpret_cast<RmaXfer*>(m->base + kRmaDataOffset + off);
+  if (timeline::enabled()) {
+    timeline::record(timeline::kStripeCut, cid, total);
+  }
+  xfer_init(x, total, chunk, crc, cid);
+  const uint32_t nchunks =
+      static_cast<uint32_t>((total + chunk - 1) / chunk);
+  put_body(x, reinterpret_cast<char*>(x) + kRmaSpanHdr, std::move(*body),
+           chunk, rails, cid, crc, peer);
+  meta->rma_rkey = peer_rkey;
+  meta->rma_off = off;
+  meta->rma_len = total;
+  meta->rma_chunk = chunk;
+  // Empty control payload: crc32c("") == 0; has_checksum stays SET so
+  // the server still derives response-checksum intent from the request.
+  meta->checksum = 0;
+  hotpath_vars().rma_tx_msgs << 1;
+  hotpath_vars().rma_tx_chunks << nchunks;
+  hotpath_vars().rma_tx_bytes << static_cast<int64_t>(total);
+  if (send_control(primary, std::move(*meta)) != 0) {
+    span_free(h, wg, off, need);  // control never queued: reclaim now
+    return -1;
+  }
+  return 0;
+}
+
+bool rma_resolve(InputMessage* msg, Socket* sock) {
+  RpcMeta& m = msg->meta;
+  const uint64_t rkey = m.rma_rkey;
+  const uint64_t total = m.rma_len;
+  const bool direct = m.rma_off == kRmaDirectOff;
+  auto reject = [&](const char* why) {
+    hotpath_vars().rma_rejected << 1;
+    LOG(Warning) << "rma control rejected (" << why << ", rkey=" << rkey
+                 << " off=" << m.rma_off << " len=" << total << ")";
+    return false;
+  };
+  if (total == 0 || !msg->payload.empty()) {
+    return reject("bad control frame");
+  }
+  if (direct) {
+    // Response into the caller's registered buffer: the rkey must be the
+    // one THIS process advertised for this cid — a control frame naming
+    // anything else (freed region, another caller's buffer) drops whole.
+    if (m.type != RpcMeta::kResponse) {
+      return reject("direct put on a non-response");
+    }
+    uint64_t cap = 0;
+    if (rma_landing_rkey(m.correlation_id, &cap) != rkey || total > cap) {
+      return reject("not the advertised landing");
+    }
+    bool window = false;
+    RmaGeom geom;  // trusted creation-time geometry, never the header's
+    std::shared_ptr<RmaMapping> map = local_region(rkey, &window, &geom);
+    if (map == nullptr || window) {
+      return reject("unknown region");
+    }
+    RmaSegHdr* h = hdr_of(map);
+    char* payload = map->base + kRmaDataOffset;
+    if (total > geom.data_len ||
+        !xfer_verify(&h->direct, m.correlation_id, payload, total,
+                     geom.data_len)) {
+      return reject("incomplete or corrupt transfer");
+    }
+    auto* ctx = new DirectCtx{std::move(map)};
+    msg->payload.append_user_data(payload, total, &direct_deleter, ctx);
+  } else {
+    // Window span: only the window bound to THIS connection's session is
+    // addressable — the control frame cannot name other local regions.
+    RmaSession* rs = sock != nullptr && sock->transport() != nullptr
+                         ? sock->transport()->rma(sock)
+                         : nullptr;
+    if (rs == nullptr || rs->local_rkey != rkey) {
+      return reject("not this connection's window");
+    }
+    bool window = false;
+    RmaGeom geom;  // trusted creation-time geometry, never the header's
+    std::shared_ptr<RmaMapping> map = local_region(rkey, &window, &geom);
+    if (map == nullptr || !window) {
+      return reject("unknown window");
+    }
+    RmaSegHdr* h = hdr_of(map);
+    const uint64_t need = kRmaSpanHdr + total;
+    if (m.rma_off % geom.slot_bytes != 0 || m.rma_off >= geom.data_len ||
+        need > geom.data_len - m.rma_off) {
+      return reject("span out of bounds");
+    }
+    auto* x = reinterpret_cast<RmaXfer*>(map->base + kRmaDataOffset +
+                                         m.rma_off);
+    char* payload = reinterpret_cast<char*>(x) + kRmaSpanHdr;
+    if (!xfer_verify(x, m.correlation_id, payload, total,
+                     geom.data_len - m.rma_off - kRmaSpanHdr)) {
+      span_free(h, geom, m.rma_off, need);  // reclaim the faulted span
+      return reject("incomplete or corrupt transfer");
+    }
+    auto* ctx = new SpanCtx{std::move(map), geom, m.rma_off, need};
+    msg->payload.append_user_data(payload, total, &span_deleter, ctx);
+  }
+  if (timeline::enabled()) {
+    timeline::record(timeline::kStripeDone, m.correlation_id, total);
+  }
+  hotpath_vars().rma_rx_msgs << 1;
+  // The payload is in place: clear the transfer fields (the response
+  // advertisement, if any, stays — it belongs to the request's reply
+  // path) and let the messenger dispatch the message normally.
+  m.rma_rkey = 0;
+  m.rma_off = 0;
+  m.rma_len = 0;
+  m.rma_chunk = 0;
+  // Chunk CRCs were verified out-of-band; the zeroed checksum must not
+  // masquerade as a whole-body one, but has_checksum stays as parsed —
+  // the server derives response-checksum intent from it (the same
+  // contract as stripe.cc's dispatch_entry).
+  m.checksum = 0;
+  return true;
+}
+
+}  // namespace trpc

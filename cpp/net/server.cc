@@ -35,6 +35,7 @@
 #include "net/shm_transport.h"
 #include "net/span.h"
 #include "net/stream.h"
+#include "net/rma.h"
 #include "net/stripe.h"
 #include "net/protocol.h"
 
@@ -751,6 +752,10 @@ void tstd_process_request(InputMessage&& msg) {
     cntl->call().stripe_rails =
         static_cast<StripeArrival*>(msg.ctx.get())->rails;
   }
+  // One-sided response target (net/rma.h): the caller advertised a
+  // registered landing region — the response puts straight into it.
+  cntl->call().rma_resp_rkey = msg.meta.rma_resp_rkey;
+  cntl->call().rma_resp_max = msg.meta.rma_resp_max;
   cntl->call().sl_pool =
       srv != nullptr ? srv->session_data_pool() : nullptr;
   auto* response = new IOBuf();
@@ -841,7 +846,18 @@ void tstd_process_request(InputMessage&& msg) {
       meta.has_checksum = true;  // striped sends CRC per chunk
     }
     const size_t response_bytes = response->size();
-    if (stripe_should(socket_id, meta.stream_id, response_bytes)) {
+    // One-sided first (net/rma.h): over shm/ici rings the response body
+    // is WRITTEN into the caller's advertised region (or this
+    // connection's window) and only a control frame rides back; 1 =
+    // not applicable / window full — the stripe/frame path carries it.
+    const int rma_rc =
+        rma_try_send(socket_id, &meta, response,
+                     cntl->call().rma_resp_rkey,
+                     cntl->call().rma_resp_max);
+    if (rma_rc != 1) {
+      // Sent (0) or hard-failed (-1, socket dead: the client times out
+      // exactly as a failed stripe_send would have left it).
+    } else if (stripe_should(socket_id, meta.stream_id, response_bytes)) {
       // Large response: stripe it back over the rails the request
       // arrived on (or just this connection).  stripe_id is the cid —
       // unique in the client process, and the key its registered

@@ -18,12 +18,16 @@
 #include "base/logging.h"
 #include "base/rand.h"
 #include "base/time.h"
+#include "net/rma.h"
 
 namespace trpc {
 
 namespace {
 
-constexpr uint64_t kShmMagic = 0x54525053484d3354ull;  // "TRPSHM3T"
+// Bumped from "...3T": the segment grew the per-side rma window rkey
+// words — a mixed-version pair must fail the handshake, not misread
+// ring offsets.
+constexpr uint64_t kShmMagic = 0x54525053484d3454ull;  // "TRPSHM4T"
 
 // Ring capacity per direction: a reloadable flag read at SEGMENT CREATE
 // time (the cap is baked into the segment header; live connections keep
@@ -77,6 +81,12 @@ struct Segment {
   std::atomic<int32_t> server_pid;
   std::atomic<uint64_t> client_beat;
   std::atomic<uint64_t> server_beat;
+  // One-sided plane (net/rma.h): each side publishes the rkey of its
+  // registered receive window here (release; 0 while absent/disabled).
+  // The peer maps it and WRITES large bodies straight in — the rings
+  // then carry only control frames for those transfers.
+  std::atomic<uint64_t> client_rma_rkey;
+  std::atomic<uint64_t> server_rma_rkey;
   RingHdr c2s;
   RingHdr s2c;
   alignas(64) char ring_data[];  // c2s bytes, then s2c bytes
@@ -145,6 +155,8 @@ struct ShmConn {
   // Staged (unpublished) tx head cursor, owned by the socket's single
   // writer role; UINT64_MAX = nothing staged (Transport::flush contract).
   uint64_t tx_staged = UINT64_MAX;
+  // One-sided session (net/rma.h): local window + peer window resolve.
+  std::shared_ptr<RmaSession> rma;
 
   RingView ring(bool c2s_dir) {
     RingView v;
@@ -403,6 +415,13 @@ class ShmRingTransport final : public Transport {
   int connect(Socket*) override { return 0; }  // established at handshake
   bool fd_based() const override { return false; }
   const char* name() const override { return "shm_ring"; }
+
+  // One-sided capability: the connection's window session (nullptr when
+  // trpc_rma_window_bytes was 0 at establishment).
+  RmaSession* rma(Socket* s) override {
+    auto* conn = static_cast<ShmConn*>(s->transport_ctx);
+    return conn != nullptr ? conn->rma.get() : nullptr;
+  }
 };
 
 ShmRingTransport* shm_transport() {
@@ -450,6 +469,14 @@ std::shared_ptr<ShmConn> shm_conn_create(std::string* name_out) {
   conn->name = name;
   conn->is_client = true;
   conn->creator = true;
+  conn->rma = rma_session_create();
+  if (conn->rma != nullptr) {
+    conn->rma->peer_rkey_slot = &seg->server_rma_rkey;
+    // Release: the window region is fully built before the peer can
+    // observe its rkey.
+    seg->client_rma_rkey.store(conn->rma->local_rkey,
+                               std::memory_order_release);
+  }
   *name_out = name;
   return conn;
 }
@@ -520,6 +547,13 @@ std::shared_ptr<ShmConn> shm_conn_open(const std::string& name) {
   conn->seg = seg;
   conn->name = name;
   conn->is_client = false;
+  conn->rma = rma_session_create();
+  if (conn->rma != nullptr) {
+    conn->rma->peer_rkey_slot = &seg->client_rma_rkey;
+    // Release: pairs with the peer's acquire read at first rma send.
+    seg->server_rma_rkey.store(conn->rma->local_rkey,
+                               std::memory_order_release);
+  }
   return conn;
 }
 

@@ -14,6 +14,7 @@
 namespace trpc {
 
 class Socket;
+struct RmaSession;  // net/rma.h — per-connection one-sided state
 
 enum class SocketMode : int {
   kTcp = 0,
@@ -50,6 +51,18 @@ class Transport {
   // TLS): such sockets need the lazy-connect path before their first
   // write.  fd-less transports (shm rings) are connected at creation.
   virtual bool fd_based() const { return true; }
+
+  // Optional one-sided capability (net/rma.h): transports whose peers
+  // share addressable memory (shm, ici) return the connection's RMA
+  // session — registered local window + peer window resolution — and
+  // large bodies are then WRITTEN into the peer's registered region
+  // (rma_put) with only a control frame riding the byte plane.
+  // Default: nullptr — TCP/TLS have no one-sided plane and are untouched
+  // by it.
+  virtual RmaSession* rma(Socket* s) {
+    (void)s;
+    return nullptr;
+  }
 
   virtual const char* name() const = 0;
 };

@@ -58,6 +58,8 @@ struct DefaultVars {
   PassiveStatus<long> threads{[] { return proc_status_kb("Threads:"); }};
   PassiveStatus<long> fds{[] { return proc_fd_count(); }};
   PassiveStatus<double> cpu{[] { return cpu_percent(); }};
+  PassiveStatus<long> io_uring{
+      [] { return static_cast<long>(kernel_supports("io_uring")); }};
 
   DefaultVars() {
     rss.expose("process_memory_rss_kb", "resident set size (VmRSS)");
@@ -66,6 +68,11 @@ struct DefaultVars {
     fds.expose("process_fd_count", "open file descriptors");
     cpu.expose("process_cpu_percent",
                "CPU use since the previous dump, percent of one core");
+    io_uring.expose(
+        "kernel_io_uring_supported",
+        "1 when the running kernel answers io_uring_setup (>= 5.1); 0 "
+        "when it returns ENOSYS — the runtime capability gate for the "
+        "ROADMAP io_uring data-plane backend");
   }
 };
 

@@ -110,6 +110,14 @@ static_assert(sizeof(kEventNames) / sizeof(kEventNames[0]) ==
 // rail track.  Mirrored by the Python decoders.
 constexpr uint64_t kStripePrimaryRail = 0xffff;
 
+// kStripeSend rail values with this bit set are one-sided RMA rails
+// (net/rma.h): the chunk was WRITTEN into the peer's registered region
+// by rail (value & 0x7fff) — no ring/socket copy happened.  Distinct
+// from kStripePrimaryRail (all-ones).  tools/trace_stitch.py renders
+// them as their own "rma rail N" tracks so Perfetto shows the elided
+// memcpys; brpc_tpu/rpc/observe.py mirrors the constant.
+constexpr uint64_t kStripeRmaRailBit = 0x8000;
+
 // Backing switch for the reloadable trpc_timeline flag (the flag's
 // on_update hook writes it; hot-path gates inline to one relaxed load).
 extern std::atomic<bool> g_enabled;

@@ -38,7 +38,7 @@ _NO_CMAKE = shutil.which("cmake") is None or shutil.which("ctest") is None
 # cpp/tests/ so a new suite gates automatically.
 TSAN_SUITES = [
     "fiber", "rpc", "stream", "shm", "ici", "chaos", "stat", "qos",
-    "stripe", "analysis", "timeline",
+    "stripe", "analysis", "timeline", "rma",
 ]
 ALL_SUITES = sorted(
     p.stem[len("test_"):] for p in (REPO / "cpp" / "tests").glob("test_*.cc")
@@ -156,6 +156,15 @@ def test_timeline_cpp_suite_native():
     and reset() hiding history."""
     _run_native_suite("test_timeline.cc", "test_timeline_native",
                       "timeline suite")
+
+
+def test_rma_cpp_suite_native():
+    """ISSUE 10: the one-sided RMA plane gates tier-1 — registration
+    lifecycle, use-after-unregister rejection, shm multi-rail 64MB and
+    ici parallel-rail integrity, direct-to-caller-region landing,
+    cancel-mid-put quiescence, sub-threshold bypass, window-full
+    fallback, and chunk-fault whole-or-nothing semantics."""
+    _run_native_suite("test_rma.cc", "test_rma_native", "rma suite")
 
 
 # Wall-clock-window cases (the p99 guards) stay native under sanitizer

@@ -149,6 +149,13 @@ _TL_QOS_TID = 950000          # + lane index: one track per QoS lane
 # kStripeSend rail index meaning "the call's primary socket" (head
 # frame / dead-rail fallback) — cpp/stat/timeline.h kStripePrimaryRail.
 _TL_PRIMARY_RAIL = 0xFFFF
+# Rail values with this bit set are one-sided RMA rails (net/rma.h): the
+# chunk was written straight into the peer's registered region — no
+# ring/socket copy.  Own track family so Perfetto shows the elided
+# memcpys next to the copy-path rails.  cpp/stat/timeline.h
+# kStripeRmaRailBit.
+_TL_RMA_RAIL_BIT = 0x8000
+_TL_RMA_RAIL_TID = 900800  # + rma rail index
 _TL_PRIMARY_RAIL_TID = 900900  # its own track, distinct from real rails
 
 
@@ -231,6 +238,10 @@ def _timeline_chrome_events(pid: int, dump: dict, base: float,
                 if rail == _TL_PRIMARY_RAIL:
                     out_tid = track(_TL_PRIMARY_RAIL_TID,
                                     "stripe primary (head/fallback)")
+                elif rail & _TL_RMA_RAIL_BIT:
+                    rma_rail = rail & 0x7FFF
+                    out_tid = track(_TL_RMA_RAIL_TID + rma_rail,
+                                    f"rma rail {rma_rail}")
                 else:
                     out_tid = track(_TL_STRIPE_RAIL_TID + rail,
                                     f"stripe rail {rail}")
