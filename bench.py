@@ -612,6 +612,30 @@ def _child_qos_mixed() -> None:
     print(json.dumps(row))
 
 
+def _child_kv_disagg() -> None:
+    """Disaggregated prefill/decode KV row (ISSUE 11): KV-block goodput
+    measured WHILE the token-RPC p99 is sampled against the same prefill
+    server — the two metrics must hold *simultaneously* (the qos_mixed
+    HOL guard generalized to the real serving workload).  The prefill
+    server, the decode block puller, and this sampler are three separate
+    PROCESSES (tools/kv_disagg.py driver), so the row measures the
+    server's isolation, not one interpreter's GIL.  The row stamps the
+    rails/lanes/rma-path config it ran under, like every BENCH series."""
+    import subprocess as sp
+
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "kv_disagg.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    out = sp.run([sys.executable, tool, "--json", "--seconds", "6"],
+                 env=env, capture_output=True, text=True, timeout=240)
+    for ln in out.stdout.splitlines()[::-1]:
+        if ln.startswith("{"):
+            print(ln, flush=True)
+            return
+    raise RuntimeError(f"kv_disagg produced no row:\n{out.stderr[-2000:]}")
+
+
 def _child_zerocopy() -> None:
     """Loopback RPC echo, three Python-boundary strategies at 4MB: the
     per-call bytes-copy path, the per-call dlpack zero-copy path, and the
@@ -826,6 +850,9 @@ def main() -> None:
     if os.environ.get("BENCH_QOS"):
         _child_qos_mixed()
         return
+    if os.environ.get("BENCH_KV"):
+        _child_kv_disagg()
+        return
     if os.environ.get("BENCH_TPU_RPC"):
         _child_tpu_rpc()
         return
@@ -878,6 +905,7 @@ def main() -> None:
             open("/tmp/bench_child.err").read()[-2000:])
     zerocopy = _run_json_child({"BENCH_ZC": "1"}, 60)
     qos_mixed = _run_json_child({"BENCH_QOS": "1"}, 90)
+    kv_disagg = _run_json_child({"BENCH_KV": "1"}, 240)
 
     # tpu_rpc leg, same retry contract; a CPU-platform run is still a real
     # measurement of the native RPC stack, so fall back rather than emit
@@ -912,6 +940,7 @@ def main() -> None:
         "cpp": _cpp_rows(),
         "zerocopy": zerocopy,
         "qos_mixed": qos_mixed,
+        "kv_disagg": kv_disagg,
     }))
 
 

@@ -261,6 +261,35 @@ def load_library() -> ctypes.CDLL:
             lib.trpc_rma_region_count.restype = ctypes.c_size_t
             lib.trpc_kernel_supports.argtypes = [ctypes.c_char_p]
             lib.trpc_kernel_supports.restype = ctypes.c_int
+            # Paged KV-block registry (capi/kv_capi.cc; net/kvstore.h).
+            lib.trpc_server_enable_kv_registry.argtypes = [ctypes.c_void_p]
+            lib.trpc_server_enable_kv_registry.restype = ctypes.c_int
+            lib.trpc_server_enable_kv_store.argtypes = [ctypes.c_void_p]
+            lib.trpc_server_enable_kv_store.restype = ctypes.c_int
+            lib.trpc_kv_publish.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint64,
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.trpc_kv_publish.restype = ctypes.c_int
+            lib.trpc_kv_withdraw.argtypes = [ctypes.c_uint64]
+            lib.trpc_kv_withdraw.restype = ctypes.c_int
+            lib.trpc_kv_renew.argtypes = [ctypes.c_uint64, ctypes.c_int64]
+            lib.trpc_kv_renew.restype = ctypes.c_int
+            lib.trpc_kv_store_count.argtypes = []
+            lib.trpc_kv_store_count.restype = ctypes.c_size_t
+            lib.trpc_kv_store_bytes_used.argtypes = []
+            lib.trpc_kv_store_bytes_used.restype = ctypes.c_uint64
+            lib.trpc_kv_registry_count.argtypes = []
+            lib.trpc_kv_registry_count.restype = ctypes.c_size_t
+            lib.trpc_kv_codes.argtypes = [
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+            ]
+            lib.trpc_kv_codes.restype = None
+            lib.trpc_kv_reset.argtypes = []
+            lib.trpc_kv_reset.restype = None
             # RPC surface (capi/rpc_capi.cc).
             lib.trpc_server_create.restype = ctypes.c_void_p
             lib.trpc_server_destroy.argtypes = [ctypes.c_void_p]

@@ -1,0 +1,339 @@
+"""Paged KV-block registry client — block-addressed KV-cache transfer.
+
+The Python surface of cpp/net/kvstore.h (fabric-lib's abstraction,
+arXiv 2510.27656): KV blocks are addressed by BLOCK ID through a
+registry record {node, rkey, offset, len, generation}, never by
+connection.  A prefill node `publish()`es blocks out of an `RmaBuffer`
+(the store serves their bytes zero-copy from the registered pages) and
+registers them; a decode node's `KvClient` looks blocks up (cached,
+generation-checked), fetches them from the owning node, and can land
+them ONE-SIDED in its own `RmaBuffer` via the PR 10 direct-landing path
+(`fetch(..., resp_buf=...)`) — zero receiver-side copies over shm/ici,
+transparent striped-copy degradation over TCP.
+
+Cache-coherence contract: a cached lookup is used until a fetch proves
+it stale — the owning node validates generation AND lease at serve time
+and answers kv-stale (KvStaleError) on any mismatch, which invalidates
+the cached record, re-resolves it through the registry once, and
+retries.  A lease that expires while a fetch is in flight therefore
+never admits stale bytes; a chunk fault fails the call whole (the
+landing buffer is never partially complete).
+
+Typical prefill side::
+
+    srv = Server(); srv.enable_kv_store(); srv.enable_kv_registry()
+    srv.start(0)
+    pages = RmaBuffer(64 << 20)
+    ...fill pages.view...
+    meta = kv.publish(1001, pages, length=4 << 20,
+                      node=f"127.0.0.1:{srv.port}")
+    reg = kv.KvRegistryClient(Channel(f"127.0.0.1:{srv.port}"))
+    reg.register(meta)
+
+Typical decode side::
+
+    cli = kv.KvClient(registry_addr, use_shm=True)
+    land = RmaBuffer(4 << 20)
+    n = cli.fetch(1001, resp_buf=land.view)   # one-sided landing
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import struct
+
+from brpc_tpu.rpc._lib import load_library
+from brpc_tpu.rpc.client import Channel, RpcError
+
+# Wire form shared by every Kv RPC — MUST mirror cpp/net/kvstore.h
+# KvWire (kv-wire marker: fixed little-endian, 112 bytes).
+_WIRE = struct.Struct("<QQQQQq64s")
+assert _WIRE.size == 112
+
+FETCH_METHOD = "Kv.Fetch"
+REGISTER_METHOD = "KvReg.Register"
+LOOKUP_METHOD = "KvReg.Lookup"
+EVICT_METHOD = "KvReg.Evict"
+RENEW_METHOD = "KvReg.Renew"
+
+
+class KvError(RpcError):
+    """Base of the kv error family (codes 2101..2103)."""
+
+
+class KvMissError(KvError):
+    """Block unknown (never registered, or lease expired and pruned)."""
+
+
+class KvStaleError(KvError):
+    """The caller's record is outdated — generation bumped, lease
+    lapsed, or block evicted.  Cached lookups must invalidate."""
+
+
+class KvExistsError(KvError):
+    """Double-register of a live block (ownership is exclusive while
+    the lease holds)."""
+
+
+def _codes() -> tuple[int, int, int]:
+    lib = load_library()
+    miss = ctypes.c_int()
+    stale = ctypes.c_int()
+    exists = ctypes.c_int()
+    lib.trpc_kv_codes(ctypes.byref(miss), ctypes.byref(stale),
+                      ctypes.byref(exists))
+    return miss.value, stale.value, exists.value
+
+
+def _kv_error(e: RpcError) -> RpcError:
+    miss, stale, exists = _codes()
+    cls = {miss: KvMissError, stale: KvStaleError,
+           exists: KvExistsError}.get(e.code)
+    return cls(e.code, e.text) if cls is not None else e
+
+
+@dataclasses.dataclass
+class KvBlockMeta:
+    """One registry record: where block_id's bytes live right now."""
+
+    block_id: int
+    generation: int
+    rkey: int
+    off: int
+    length: int
+    node: str = ""
+    lease_left_ms: int = 0
+
+    def pack(self, lease_ms: int = 0) -> bytes:
+        return _WIRE.pack(self.block_id, self.generation, self.rkey,
+                          self.off, self.length, lease_ms,
+                          self.node.encode()[:63])
+
+    @classmethod
+    def unpack(cls, data: bytes) -> "KvBlockMeta":
+        bid, gen, rkey, off, length, lease, node = _WIRE.unpack_from(data)
+        return cls(bid, gen, rkey, off, length,
+                   node.split(b"\0", 1)[0].decode(errors="replace"), lease)
+
+
+def _req(block_id: int, generation: int = 0, lease_ms: int = 0) -> bytes:
+    return _WIRE.pack(block_id, generation, 0, 0, 0, lease_ms, b"")
+
+
+def publish(block_id: int, buffer, offset: int = 0, length: int | None = None,
+            lease_ms: int = 0, node: str = "") -> KvBlockMeta:
+    """Publishes `length` bytes at `offset` of an RmaBuffer into this
+    process's block store (native, zero-copy serving) and returns the
+    registry-ready record.  lease_ms <= 0 uses the trpc_kv_lease_ms
+    default.  Raises KvExistsError while the block is live."""
+    base = buffer.address if hasattr(buffer, "address") else \
+        ctypes.addressof((ctypes.c_char * 0).from_buffer(buffer))
+    size = buffer.nbytes if hasattr(buffer, "nbytes") else len(buffer)
+    if length is None:
+        length = size - offset
+    if offset < 0 or length <= 0 or offset + length > size:
+        raise ValueError(f"bad block range: off={offset} len={length} "
+                         f"of {size}")
+    lib = load_library()
+    gen = ctypes.c_uint64()
+    rkey = ctypes.c_uint64()
+    off = ctypes.c_uint64()
+    rc = lib.trpc_kv_publish(
+        ctypes.c_void_p(base + offset), ctypes.c_size_t(length),
+        ctypes.c_uint64(block_id), ctypes.c_int64(lease_ms),
+        ctypes.byref(gen), ctypes.byref(rkey), ctypes.byref(off))
+    if rc != 0:
+        miss, stale, exists = _codes()
+        if rc == exists:
+            raise KvExistsError(rc, f"block {block_id} is live")
+        raise MemoryError(
+            f"kv publish failed (rc={rc}): the bytes must lie inside an "
+            "RmaBuffer and fit trpc_kv_store_bytes")
+    return KvBlockMeta(block_id, gen.value, rkey.value, off.value, length,
+                       node)
+
+
+def withdraw(block_id: int) -> None:
+    """Evicts a local block (its generation tombstones, so stale fetches
+    stay detectable).  Raises KvMissError if unknown."""
+    rc = load_library().trpc_kv_withdraw(ctypes.c_uint64(block_id))
+    if rc != 0:
+        raise KvMissError(rc, f"block {block_id} not in the local store")
+
+
+def renew(block_id: int, lease_ms: int = 0) -> None:
+    """Extends a local block's lease."""
+    rc = load_library().trpc_kv_renew(ctypes.c_uint64(block_id),
+                                      ctypes.c_int64(lease_ms))
+    if rc != 0:
+        raise KvMissError(rc, f"block {block_id} not in the local store")
+
+
+def store_count() -> int:
+    return int(load_library().trpc_kv_store_count())
+
+
+def store_bytes_used() -> int:
+    return int(load_library().trpc_kv_store_bytes_used())
+
+
+def registry_count() -> int:
+    return int(load_library().trpc_kv_registry_count())
+
+
+def reset() -> None:
+    """Test support: drops every local block and registry record."""
+    load_library().trpc_kv_reset()
+
+
+class KvRegistryClient:
+    """Thin RPC client for the registry methods over one channel."""
+
+    def __init__(self, channel: Channel, owns_channel: bool = False):
+        self._ch = channel
+        self._owns = owns_channel
+
+    def register(self, meta: KvBlockMeta, lease_ms: int = 0) -> int:
+        """Records meta under a lease; returns the accepted generation.
+        Raises KvExistsError while a live record holds the block."""
+        try:
+            resp = self._ch.call(REGISTER_METHOD, meta.pack(lease_ms))
+        except RpcError as e:
+            raise _kv_error(e) from None
+        return struct.unpack("<Q", resp)[0]
+
+    def lookup(self, block_id: int) -> KvBlockMeta:
+        try:
+            resp = self._ch.call(LOOKUP_METHOD, _req(block_id))
+        except RpcError as e:
+            raise _kv_error(e) from None
+        return KvBlockMeta.unpack(resp)
+
+    def evict(self, block_id: int) -> int:
+        """Removes the record; returns the evicted generation."""
+        try:
+            resp = self._ch.call(EVICT_METHOD, _req(block_id))
+        except RpcError as e:
+            raise _kv_error(e) from None
+        return struct.unpack("<Q", resp)[0]
+
+    def renew(self, block_id: int, lease_ms: int = 0) -> int:
+        """Extends a live record's lease; returns its generation."""
+        try:
+            resp = self._ch.call(RENEW_METHOD,
+                                 _req(block_id, lease_ms=lease_ms))
+        except RpcError as e:
+            raise _kv_error(e) from None
+        return struct.unpack("<Q", resp)[0]
+
+    def close(self) -> None:
+        if self._owns:
+            self._ch.close()
+
+
+class KvClient:
+    """Decode-side client: registry lookups cached with generation-
+    checked invalidation, per-node channel pool, one-sided landings.
+
+    `fetch(block_id)` returns the bytes; `fetch(block_id, resp_buf=v)`
+    lands them natively in `v` (an RmaBuffer view for the one-sided
+    path) and returns the landed length.  A kv-stale answer invalidates
+    the cached record, re-resolves, and retries once."""
+
+    def __init__(self, registry_addr: str, use_shm: bool = True,
+                 timeout_ms: int = 30000, qos_tenant: str = "",
+                 qos_priority: int = 0):
+        self._use_shm = use_shm
+        self._timeout_ms = timeout_ms
+        self._qos = (qos_tenant, qos_priority)
+        self._reg_ch = Channel(registry_addr, timeout_ms=timeout_ms,
+                               qos_tenant=qos_tenant,
+                               qos_priority=qos_priority)
+        self.registry = KvRegistryClient(self._reg_ch)
+        self._node_chs: dict[str, Channel] = {}
+        self._cache: dict[int, KvBlockMeta] = {}
+        #: Lookup-cache telemetry (reads served without a registry RPC /
+        #: registry round-trips / stale-triggered invalidations).
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.invalidations = 0
+
+    def _node_channel(self, node: str) -> Channel:
+        ch = self._node_chs.get(node)
+        if ch is None:
+            tenant, prio = self._qos
+            # shm rings are single-connection by construction; TCP block
+            # pulls spread over pooled sockets (stripe rails).
+            ch = Channel(node, timeout_ms=self._timeout_ms,
+                         use_shm=self._use_shm,
+                         connection_type="single" if self._use_shm
+                         else "pooled",
+                         qos_tenant=tenant, qos_priority=prio)
+            self._node_chs[node] = ch
+        return ch
+
+    def lookup(self, block_id: int, refresh: bool = False) -> KvBlockMeta:
+        if not refresh:
+            meta = self._cache.get(block_id)
+            if meta is not None:
+                self.cache_hits += 1
+                return meta
+        self.cache_misses += 1
+        meta = self.registry.lookup(block_id)
+        self._cache[block_id] = meta
+        return meta
+
+    def invalidate(self, block_id: int) -> None:
+        if self._cache.pop(block_id, None) is not None:
+            self.invalidations += 1
+
+    def fetch(self, block_id: int, resp_buf=None):
+        """Bytes of block_id (or the landed length with resp_buf)."""
+        last: RpcError | None = None
+        for attempt in range(2):
+            meta = self.lookup(block_id, refresh=attempt > 0)
+            req = _req(block_id, generation=meta.generation)
+            ch = self._node_channel(meta.node)
+            try:
+                if resp_buf is None:
+                    return ch.call(FETCH_METHOD, req,
+                                   timeout_ms=self._timeout_ms)
+                return self._fetch_into(ch, req, resp_buf)
+            except RpcError as e:
+                e = _kv_error(e)
+                if not isinstance(e, (KvStaleError, KvMissError)):
+                    raise  # transport/chaos failure: the record may be fine
+                last = e
+                self.invalidate(block_id)  # generation-checked invalidation
+        raise last
+
+    def _fetch_into(self, ch: Channel, req: bytes, resp_buf) -> int:
+        """One fetch whose response lands natively in resp_buf (the
+        one-sided direct path when resp_buf is RmaBuffer-backed and the
+        node connection is shm/ici)."""
+        pipe = ch.pipeline()
+        try:
+            pipe.submit(FETCH_METHOD, [req], resp_bufs=[resp_buf],
+                        timeout_ms=self._timeout_ms)
+            cs = pipe.poll(max_n=1, timeout_ms=self._timeout_ms)
+            if not cs:
+                raise RpcError(-1, "kv fetch timed out in poll")
+            c = cs[0]
+            if not c.ok:
+                raise _kv_error(RpcError(c.status, c.error))
+            if not c.in_caller_buffer and c.data is not None:
+                # Copy-path degradation where the runtime returned a
+                # view instead of landing in place (tiny responses).
+                view = memoryview(resp_buf).cast("B")
+                view[:c.resp_len] = c.data.view()[:c.resp_len]
+                c.data.release()
+            return c.resp_len
+        finally:
+            pipe.close()
+
+    def close(self) -> None:
+        for ch in self._node_chs.values():
+            ch.close()
+        self._node_chs.clear()
+        self._reg_ch.close()

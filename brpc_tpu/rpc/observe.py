@@ -318,7 +318,13 @@ TIMELINE_EVENTS = {
     19: "stripe_land",    # timeline-event 19 (stripe_land)
     20: "stripe_done",    # timeline-event 20 (stripe_done)
     21: "qos_drain",      # timeline-event 21 (qos_drain)
+    22: "kv_block",       # timeline-event 22 (kv_block)
 }
+
+# kKvBlock `b` op tags (cpp/net/kvstore.h: b = op << 56 | payload len) —
+# how a kv_block event reads: the store published / served / evicted a
+# block, or rejected a stale-generation fetch.
+TIMELINE_KV_OPS = {1: "publish", 2: "serve", 3: "evict", 4: "stale"}
 
 # kStripeSend rail index meaning "the call's primary socket" (head
 # frame / dead-rail fallback) — cpp/stat/timeline.h kStripePrimaryRail.

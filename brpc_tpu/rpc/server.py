@@ -89,6 +89,24 @@ class Server:
             raise RuntimeError(
                 f"register_native_echo {method!r} failed (server running?)")
 
+    def enable_kv_store(self) -> None:
+        """Attaches the NATIVE KV block-store fetch handler (Kv.Fetch,
+        cpp/net/kvstore.h): blocks published from this process (kv.publish)
+        are served zero-copy out of their registered pages with no Python
+        callback and no GIL — the prefill side of the disaggregation
+        workload.  Call before start."""
+        if self._lib.trpc_server_enable_kv_store(self._ptr) != 0:
+            raise RuntimeError("enable_kv_store failed (server running?)")
+
+    def enable_kv_registry(self) -> None:
+        """Attaches the NATIVE KV-block registry handlers
+        (KvReg.Register/Lookup/Evict/Renew, cpp/net/kvstore.h): this
+        server becomes a block directory mapping block_id -> {node, rkey,
+        offset, len, generation} under lease-based ownership.  Call
+        before start."""
+        if self._lib.trpc_server_enable_kv_registry(self._ptr) != 0:
+            raise RuntimeError("enable_kv_registry failed (server running?)")
+
     def set_qos(self, spec: str) -> None:
         """Per-tenant QoS admission control (cpp/net/qos.h grammar):
         ';'-separated `tenant:weight=N,limit=<spec>` clauses, tenant '*'

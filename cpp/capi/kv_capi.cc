@@ -1,0 +1,92 @@
+// C ABI for the paged KV-block registry (net/kvstore.h) — the Python
+// surface brpc_tpu/rpc/kv.py binds.  The data plane stays native: the
+// store serves block bytes zero-copy out of registered regions with no
+// Python in the path; these entry points only publish/withdraw blocks
+// and attach the native handlers to a server.
+#include <string.h>
+
+#include "base/iobuf.h"
+#include "net/kvstore.h"
+#include "net/server.h"
+
+using namespace trpc;
+
+extern "C" {
+
+// Attaches the registry handlers (KvReg.Register/Lookup/Evict/Renew) to
+// a not-yet-started server.  Returns 0, or -1 (server already running —
+// the registrations were refused).
+int trpc_server_enable_kv_registry(void* srv) {
+  return kv_attach_registry(static_cast<Server*>(srv));
+}
+
+// Attaches the block-store fetch handler (Kv.Fetch).  Returns 0, or -1
+// (server already running — the registration was refused).
+int trpc_server_enable_kv_store(void* srv) {
+  return kv_attach_store(static_cast<Server*>(srv));
+}
+
+// Publishes [data, data+len) — which must lie inside an rma_alloc'd
+// region (RmaBuffer bytes) — as block_id under a lease (lease_ms <= 0:
+// the trpc_kv_lease_ms default).  Fills the minted generation and the
+// region coordinates for the registry record.  Returns 0, kEKvExists
+// (2103) while the block is live, or -1 (not registered memory / over
+// budget).
+int trpc_kv_publish(const void* data, size_t len, uint64_t block_id,
+                    int64_t lease_ms, uint64_t* gen_out, uint64_t* rkey_out,
+                    uint64_t* off_out) {
+  KvBlockMeta m;
+  const int rc =
+      kv_store().publish(block_id, data, len, lease_ms, &m);
+  if (rc != 0) {
+    return rc;
+  }
+  if (gen_out != nullptr) {
+    *gen_out = m.generation;
+  }
+  if (rkey_out != nullptr) {
+    *rkey_out = m.rkey;
+  }
+  if (off_out != nullptr) {
+    *off_out = m.off;
+  }
+  return 0;
+}
+
+// Evicts a local block (generation tombstoned).  0 or kEKvMiss (2101).
+int trpc_kv_withdraw(uint64_t block_id) {
+  return kv_store().withdraw(block_id);
+}
+
+// Extends a local block's lease.  0 or kEKvMiss.
+int trpc_kv_renew(uint64_t block_id, int64_t lease_ms) {
+  return kv_store().renew(block_id, lease_ms);
+}
+
+size_t trpc_kv_store_count() { return kv_store().count(); }
+
+uint64_t trpc_kv_store_bytes_used() { return kv_store().bytes_used(); }
+
+size_t trpc_kv_registry_count() { return kv_registry().count(); }
+
+// The kv error-code family (net/kvstore.h), read once by kv.py so the
+// Python exception mapping can never drift from the C++ constants.
+void trpc_kv_codes(int* miss, int* stale, int* exists) {
+  if (miss != nullptr) {
+    *miss = kEKvMiss;
+  }
+  if (stale != nullptr) {
+    *stale = kEKvStale;
+  }
+  if (exists != nullptr) {
+    *exists = kEKvExists;
+  }
+}
+
+// Test support: drops every local block, tombstone, and registry record.
+void trpc_kv_reset() {
+  kv_store().clear();
+  kv_registry().clear();
+}
+
+}  // extern "C"

@@ -1,0 +1,663 @@
+#include "net/kvstore.h"
+
+#include <errno.h>
+#include <string.h>
+
+#include <algorithm>
+#include <limits>
+
+#include "base/flags.h"
+#include "base/logging.h"
+#include "base/time.h"
+#include "net/channel.h"
+#include "net/controller.h"
+#include "net/rma.h"
+#include "net/server.h"
+#include "stat/latency_recorder.h"
+#include "stat/reducer.h"
+#include "stat/timeline.h"
+
+namespace trpc {
+
+namespace {
+
+Flag* lease_flag() {
+  static Flag* f = [] {
+    Flag* flag = Flag::define_int64(
+        "trpc_kv_lease_ms", 30000,
+        "default KV-block lease for publishes/registrations that pass "
+        "lease_ms <= 0 (ms, [50, 86400000]); an expired lease "
+        "invalidates the block everywhere — lookups answer kv-miss, "
+        "fetches answer kv-stale");
+    if (flag != nullptr) {
+      flag->set_validator([](const std::string& v) {
+        char* end = nullptr;
+        const long long n = strtoll(v.c_str(), &end, 10);
+        return end != v.c_str() && *end == '\0' && n >= 50 &&
+               n <= 86400000;
+      });
+    }
+    return flag;
+  }();
+  return f;
+}
+
+Flag* store_bytes_flag() {
+  static Flag* f = [] {
+    Flag* flag = Flag::define_int64(
+        "trpc_kv_store_bytes", 1ll << 30,
+        "node-local KV-block store byte budget ([1MB, 64GB]); a publish "
+        "that would exceed it evicts expired-then-LRU blocks (their "
+        "generation tombstones survive, so evicted fetches answer "
+        "kv-stale, never partial bytes)");
+    if (flag != nullptr) {
+      flag->set_validator([](const std::string& v) {
+        char* end = nullptr;
+        const long long n = strtoll(v.c_str(), &end, 10);
+        return end != v.c_str() && *end == '\0' && n >= (1ll << 20) &&
+               n <= (64ll << 30);
+      });
+    }
+    return flag;
+  }();
+  return f;
+}
+
+int64_t effective_lease_us(int64_t lease_ms) {
+  if (lease_ms <= 0) {
+    lease_ms = lease_flag() != nullptr ? lease_flag()->int64_value() : 30000;
+  }
+  return monotonic_time_us() + lease_ms * 1000;
+}
+
+// ---- vars ----------------------------------------------------------------
+
+struct KvVars {
+  Adder publish_total;
+  Adder evict_total;
+  Adder fetch_total;
+  Adder fetch_bytes;
+  Adder stale_total;
+  Adder register_total;
+  Adder lookup_total;
+  Adder lookup_miss_total;
+  std::unique_ptr<PassiveStatus<long>> store_blocks;
+  std::unique_ptr<PassiveStatus<long>> store_bytes;
+  std::unique_ptr<PassiveStatus<long>> registry_blocks;
+  KvVars() {
+    publish_total.expose(
+        "kv_publish_total",
+        "KV blocks published into this node's block store");
+    evict_total.expose(
+        "kv_evict_total",
+        "KV blocks evicted from this node's store (budget pressure, "
+        "lease expiry, or explicit withdraw)");
+    fetch_total.expose("kv_fetch_total",
+                       "KV block fetches served by this node");
+    fetch_bytes.expose("kv_fetch_bytes",
+                       "payload bytes served by KV block fetches");
+    stale_total.expose(
+        "kv_stale_total",
+        "KV fetches rejected with kv-stale (generation mismatch, lease "
+        "lapsed, or evicted block) — each one invalidates a client's "
+        "cached lookup");
+    register_total.expose("kv_register_total",
+                          "KV-block registrations accepted by the "
+                          "registry on this node");
+    lookup_total.expose("kv_lookup_total",
+                        "KV-block lookups answered by the registry on "
+                        "this node");
+    lookup_miss_total.expose(
+        "kv_lookup_miss_total",
+        "registry lookups answering kv-miss (unknown block or expired "
+        "lease)");
+    store_blocks = std::make_unique<PassiveStatus<long>>(
+        [] { return static_cast<long>(kv_store().count()); });
+    store_blocks->expose("kv_store_blocks",
+                         "KV blocks currently live in this node's store");
+    store_bytes = std::make_unique<PassiveStatus<long>>(
+        [] { return static_cast<long>(kv_store().bytes_used()); });
+    store_bytes->expose(
+        "kv_store_bytes",
+        "payload bytes currently held by this node's KV store (bounded "
+        "by trpc_kv_store_bytes)");
+    registry_blocks = std::make_unique<PassiveStatus<long>>(
+        [] { return static_cast<long>(kv_registry().count()); });
+    registry_blocks->expose(
+        "kv_registry_blocks",
+        "KV-block records currently live in the registry on this node");
+  }
+};
+
+KvVars& kv_vars() {
+  static KvVars* v = new KvVars();
+  return *v;
+}
+
+void record_kv(uint64_t block_id, uint64_t op, uint64_t len) {
+  if (timeline::enabled()) {
+    timeline::record(timeline::kKvBlock, block_id,
+                     (op << 56) | (len & ((1ull << 56) - 1)));
+  }
+}
+
+}  // namespace
+
+void kv_ensure_registered() {
+  lease_flag();
+  store_bytes_flag();
+  kv_vars();
+}
+
+// ---- KvStore -------------------------------------------------------------
+
+KvStore& kv_store() {
+  static KvStore* s = new KvStore();
+  return *s;
+}
+
+void KvStore::evict_locked(uint64_t block_id, bool count_var) {
+  auto it = blocks_.find(block_id);
+  if (it == blocks_.end()) {
+    return;
+  }
+  tombstones_[block_id] = it->second.meta.generation;
+  bytes_ -= it->second.meta.len;
+  record_kv(block_id, kKvOpEvict, it->second.meta.len);
+  blocks_.erase(it);
+  if (count_var) {
+    kv_vars().evict_total << 1;
+  }
+}
+
+int KvStore::publish(uint64_t block_id, const void* data, size_t len,
+                     int64_t lease_ms, KvBlockMeta* out) {
+  kv_ensure_registered();
+  if (data == nullptr || len == 0) {
+    return -1;
+  }
+  uint64_t rkey = 0;
+  uint64_t off = 0;
+  std::shared_ptr<RmaMapping> map =
+      rma_pin_exportable(data, len, &rkey, &off);
+  if (map == nullptr) {
+    return -1;  // not registered memory: the store serves zero-copy only
+  }
+  const uint64_t budget = static_cast<uint64_t>(std::max<int64_t>(
+      store_bytes_flag() != nullptr ? store_bytes_flag()->int64_value()
+                                    : (1ll << 30),
+      1));
+  if (len > budget) {
+    return -1;  // cannot fit even an empty store
+  }
+  const int64_t now = monotonic_time_us();
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = blocks_.find(block_id);
+  if (it != blocks_.end()) {
+    if (it->second.deadline_us > now) {
+      return kEKvExists;  // live block: ownership is exclusive
+    }
+    evict_locked(block_id, /*count_var=*/true);  // lapsed: fold to tombstone
+  }
+  // Budget pressure: evict expired leases first, then LRU by touch_seq.
+  while (bytes_ + len > budget && !blocks_.empty()) {
+    uint64_t victim = 0;
+    uint64_t oldest_touch = std::numeric_limits<uint64_t>::max();
+    bool found_expired = false;
+    for (const auto& [id, b] : blocks_) {
+      if (b.deadline_us <= now) {
+        victim = id;
+        found_expired = true;
+        break;
+      }
+      if (b.touch_seq < oldest_touch) {
+        oldest_touch = b.touch_seq;
+        victim = id;
+      }
+    }
+    (void)found_expired;
+    evict_locked(victim, /*count_var=*/true);
+  }
+  Block b;
+  b.meta.block_id = block_id;
+  b.meta.generation = tombstones_[block_id] + 1;
+  tombstones_[block_id] = b.meta.generation;
+  b.meta.rkey = rkey;
+  b.meta.off = off;
+  b.meta.len = len;
+  b.data = static_cast<const char*>(data);
+  b.map = std::move(map);
+  b.deadline_us = effective_lease_us(lease_ms);
+  b.touch_seq = ++touch_counter_;
+  bytes_ += len;
+  if (out != nullptr) {
+    *out = b.meta;
+  }
+  record_kv(block_id, kKvOpPublish, len);
+  blocks_[block_id] = std::move(b);
+  kv_vars().publish_total << 1;
+  return 0;
+}
+
+int KvStore::withdraw(uint64_t block_id) {
+  std::lock_guard<std::mutex> g(mu_);
+  if (blocks_.find(block_id) == blocks_.end()) {
+    return kEKvMiss;
+  }
+  evict_locked(block_id, /*count_var=*/true);
+  return 0;
+}
+
+int KvStore::renew(uint64_t block_id, int64_t lease_ms) {
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = blocks_.find(block_id);
+  if (it == blocks_.end()) {
+    return kEKvMiss;
+  }
+  it->second.deadline_us = effective_lease_us(lease_ms);
+  return 0;
+}
+
+namespace {
+// Deleter context for a served block: co-owns the region mapping so the
+// bytes stay mapped until the response's last IOBuf reference drops
+// (send queues, rma rails, a late cancel) — rma_free's munmap defers.
+struct KvServeCtx {
+  std::shared_ptr<RmaMapping> map;
+};
+void kv_serve_deleter(void*, void* vctx) {
+  delete static_cast<KvServeCtx*>(vctx);
+}
+}  // namespace
+
+int KvStore::fetch(uint64_t block_id, uint64_t expected_gen, IOBuf* out) {
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = blocks_.find(block_id);
+  const int64_t now = monotonic_time_us();
+  if (it == blocks_.end() || it->second.deadline_us <= now) {
+    if (it != blocks_.end()) {
+      // Lease lapsed: fold to a tombstone NOW — serve time is the
+      // validity decision point, so a fetch racing the expiry can
+      // never admit the stale bytes.
+      evict_locked(block_id, /*count_var=*/true);
+    }
+    const bool known = tombstones_.find(block_id) != tombstones_.end();
+    if (known) {
+      kv_vars().stale_total << 1;
+      record_kv(block_id, kKvOpStale, 0);
+      return kEKvStale;
+    }
+    return kEKvMiss;
+  }
+  Block& b = it->second;
+  if (b.meta.generation != expected_gen) {
+    kv_vars().stale_total << 1;
+    record_kv(block_id, kKvOpStale, b.meta.len);
+    return kEKvStale;
+  }
+  b.touch_seq = ++touch_counter_;
+  auto* ctx = new KvServeCtx{b.map};
+  out->append_user_data(const_cast<char*>(b.data), b.meta.len,
+                        &kv_serve_deleter, ctx);
+  kv_vars().fetch_total << 1;
+  kv_vars().fetch_bytes << static_cast<int64_t>(b.meta.len);
+  record_kv(block_id, kKvOpServe, b.meta.len);
+  return 0;
+}
+
+size_t KvStore::count() {
+  std::lock_guard<std::mutex> g(mu_);
+  return blocks_.size();
+}
+
+uint64_t KvStore::bytes_used() {
+  std::lock_guard<std::mutex> g(mu_);
+  return bytes_;
+}
+
+void KvStore::clear() {
+  std::lock_guard<std::mutex> g(mu_);
+  blocks_.clear();
+  tombstones_.clear();
+  bytes_ = 0;
+}
+
+// ---- KvRegistry ----------------------------------------------------------
+
+KvRegistry& kv_registry() {
+  static KvRegistry* r = new KvRegistry();
+  return *r;
+}
+
+int KvRegistry::do_register(const KvBlockMeta& meta, int64_t lease_ms,
+                            uint64_t* gen_out) {
+  kv_ensure_registered();
+  if (meta.block_id == 0 || meta.len == 0 || meta.generation == 0) {
+    return kEKvStale;  // generation 0 is never minted
+  }
+  const int64_t now = monotonic_time_us();
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = entries_.find(meta.block_id);
+  if (it != entries_.end()) {
+    if (it->second.deadline_us <= now) {
+      entries_.erase(it);  // lapsed: prune, fall through to admit
+    } else if (meta.generation > it->second.meta.generation) {
+      entries_.erase(it);  // re-publish with a newer generation replaces
+    } else if (meta.generation == it->second.meta.generation) {
+      return kEKvExists;  // double-register: ownership is exclusive
+    } else {
+      return kEKvStale;  // zombie publisher re-offering an old generation
+    }
+  }
+  if (last_gen_[meta.block_id] != 0 &&
+      meta.generation < last_gen_[meta.block_id]) {
+    return kEKvStale;  // zombie publisher re-offering an old generation
+  }
+  Entry e;
+  e.meta = meta;
+  e.deadline_us = effective_lease_us(lease_ms);
+  last_gen_[meta.block_id] =
+      std::max(last_gen_[meta.block_id], meta.generation);
+  entries_[meta.block_id] = e;
+  if (gen_out != nullptr) {
+    *gen_out = meta.generation;
+  }
+  kv_vars().register_total << 1;
+  return 0;
+}
+
+int KvRegistry::lookup(uint64_t block_id, KvBlockMeta* out,
+                       int64_t* lease_left_ms) {
+  const int64_t now = monotonic_time_us();
+  std::lock_guard<std::mutex> g(mu_);
+  kv_vars().lookup_total << 1;
+  auto it = entries_.find(block_id);
+  if (it == entries_.end() || it->second.deadline_us <= now) {
+    if (it != entries_.end()) {
+      entries_.erase(it);  // lazy lease pruning
+    }
+    kv_vars().lookup_miss_total << 1;
+    return kEKvMiss;
+  }
+  if (out != nullptr) {
+    *out = it->second.meta;
+  }
+  if (lease_left_ms != nullptr) {
+    *lease_left_ms = (it->second.deadline_us - now) / 1000;
+  }
+  return 0;
+}
+
+int KvRegistry::evict(uint64_t block_id, uint64_t* gen_out) {
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = entries_.find(block_id);
+  if (it == entries_.end()) {
+    return kEKvMiss;
+  }
+  if (gen_out != nullptr) {
+    *gen_out = it->second.meta.generation;
+  }
+  entries_.erase(it);
+  return 0;
+}
+
+int KvRegistry::renew(uint64_t block_id, int64_t lease_ms,
+                      uint64_t* gen_out) {
+  const int64_t now = monotonic_time_us();
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = entries_.find(block_id);
+  if (it == entries_.end() || it->second.deadline_us <= now) {
+    if (it != entries_.end()) {
+      entries_.erase(it);
+    }
+    return kEKvMiss;  // a lapsed lease cannot be revived, only re-registered
+  }
+  it->second.deadline_us = effective_lease_us(lease_ms);
+  if (gen_out != nullptr) {
+    *gen_out = it->second.meta.generation;
+  }
+  return 0;
+}
+
+size_t KvRegistry::count() {
+  std::lock_guard<std::mutex> g(mu_);
+  return entries_.size();
+}
+
+void KvRegistry::clear() {
+  std::lock_guard<std::mutex> g(mu_);
+  entries_.clear();
+  last_gen_.clear();
+}
+
+// ---- native handlers -----------------------------------------------------
+
+namespace {
+
+bool parse_wire(const IOBuf& req, KvWire* w) {
+  if (req.size() < sizeof(KvWire)) {
+    return false;
+  }
+  req.copy_to(w, sizeof(KvWire));
+  w->node[sizeof(w->node) - 1] = '\0';
+  return true;
+}
+
+void respond_gen(IOBuf* resp, uint64_t gen) {
+  resp->append(&gen, sizeof(gen));
+}
+
+void fail_kv(Controller* cntl, int code, const char* what) {
+  const char* why = code == kEKvMiss     ? "kv-miss"
+                    : code == kEKvStale  ? "kv-stale"
+                    : code == kEKvExists ? "kv-exists"
+                                         : "kv-error";
+  cntl->SetFailed(code, std::string(why) + ": " + what);
+}
+
+}  // namespace
+
+int kv_attach_store(Server* s) {
+  kv_ensure_registered();
+  return s->RegisterMethod(
+      kKvFetchMethod, [](Controller* cntl, const IOBuf& req, IOBuf* resp,
+                         Closure done) {
+        KvWire w;
+        if (!parse_wire(req, &w)) {
+          cntl->SetFailed(EINVAL, "bad Kv.Fetch request");
+          done();
+          return;
+        }
+        const int rc = kv_store().fetch(w.block_id, w.generation, resp);
+        if (rc != 0) {
+          fail_kv(cntl, rc, "fetch");
+        }
+        done();
+      }) == 0
+             ? 0
+             : -1;
+}
+
+int kv_attach_registry(Server* s) {
+  kv_ensure_registered();
+  int rcs[4] = {0, 0, 0, 0};
+  rcs[0] = s->RegisterMethod(
+      kKvRegisterMethod, [](Controller* cntl, const IOBuf& req, IOBuf* resp,
+                            Closure done) {
+        KvWire w;
+        if (!parse_wire(req, &w)) {
+          cntl->SetFailed(EINVAL, "bad KvReg.Register request");
+          done();
+          return;
+        }
+        KvBlockMeta m;
+        m.block_id = w.block_id;
+        m.generation = w.generation;
+        m.rkey = w.rkey;
+        m.off = w.off;
+        m.len = w.len;
+        memcpy(m.node, w.node, sizeof(m.node));
+        uint64_t gen = 0;
+        const int rc = kv_registry().do_register(m, w.lease_ms, &gen);
+        if (rc != 0) {
+          fail_kv(cntl, rc, "register");
+        } else {
+          respond_gen(resp, gen);
+        }
+        done();
+      });
+  rcs[1] = s->RegisterMethod(
+      kKvLookupMethod, [](Controller* cntl, const IOBuf& req, IOBuf* resp,
+                          Closure done) {
+        KvWire w;
+        if (!parse_wire(req, &w)) {
+          cntl->SetFailed(EINVAL, "bad KvReg.Lookup request");
+          done();
+          return;
+        }
+        KvBlockMeta m;
+        int64_t left_ms = 0;
+        const int rc = kv_registry().lookup(w.block_id, &m, &left_ms);
+        if (rc != 0) {
+          fail_kv(cntl, rc, "lookup");
+        } else {
+          KvWire o;
+          memset(&o, 0, sizeof(o));
+          o.block_id = m.block_id;
+          o.generation = m.generation;
+          o.rkey = m.rkey;
+          o.off = m.off;
+          o.len = m.len;
+          o.lease_ms = left_ms;
+          memcpy(o.node, m.node, sizeof(o.node));
+          resp->append(&o, sizeof(o));
+        }
+        done();
+      });
+  rcs[2] = s->RegisterMethod(
+      kKvEvictMethod, [](Controller* cntl, const IOBuf& req, IOBuf* resp,
+                         Closure done) {
+        KvWire w;
+        if (!parse_wire(req, &w)) {
+          cntl->SetFailed(EINVAL, "bad KvReg.Evict request");
+          done();
+          return;
+        }
+        uint64_t gen = 0;
+        const int rc = kv_registry().evict(w.block_id, &gen);
+        if (rc != 0) {
+          fail_kv(cntl, rc, "evict");
+        } else {
+          respond_gen(resp, gen);
+        }
+        done();
+      });
+  rcs[3] = s->RegisterMethod(
+      kKvRenewMethod, [](Controller* cntl, const IOBuf& req, IOBuf* resp,
+                         Closure done) {
+        KvWire w;
+        if (!parse_wire(req, &w)) {
+          cntl->SetFailed(EINVAL, "bad KvReg.Renew request");
+          done();
+          return;
+        }
+        uint64_t gen = 0;
+        const int rc = kv_registry().renew(w.block_id, w.lease_ms, &gen);
+        if (rc != 0) {
+          fail_kv(cntl, rc, "renew");
+        } else {
+          respond_gen(resp, gen);  // the wire contract: one u64 generation
+        }
+        done();
+      });
+  return rcs[0] == 0 && rcs[1] == 0 && rcs[2] == 0 && rcs[3] == 0 ? 0 : -1;
+}
+
+// ---- KvCache -------------------------------------------------------------
+
+namespace {
+
+// One registry RPC carrying a KvWire request; 0 or the call's error code.
+int kv_call(Channel* ch, const char* method, const KvWire& w, IOBuf* resp) {
+  IOBuf req;
+  req.append(&w, sizeof(w));
+  Controller cntl;
+  ch->CallMethod(method, req, resp, &cntl);
+  if (cntl.Failed()) {
+    return cntl.error_code() != 0 ? cntl.error_code() : -1;
+  }
+  return 0;
+}
+
+}  // namespace
+
+int KvCache::lookup(uint64_t block_id, KvBlockMeta* out, bool refresh) {
+  if (!refresh) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = cache_.find(block_id);
+    if (it != cache_.end()) {
+      *out = it->second;
+      // Relaxed: monotonic stat counter, no ordering carried.
+      hits_.fetch_add(1, std::memory_order_relaxed);
+      return 0;
+    }
+  }
+  // Relaxed: monotonic stat counter, no ordering carried.
+  misses_.fetch_add(1, std::memory_order_relaxed);
+  KvWire w;
+  memset(&w, 0, sizeof(w));
+  w.block_id = block_id;
+  IOBuf resp;
+  const int rc = kv_call(reg_, kKvLookupMethod, w, &resp);
+  if (rc != 0) {
+    return rc;
+  }
+  KvWire o;
+  if (!parse_wire(resp, &o)) {
+    return -1;
+  }
+  KvBlockMeta m;
+  m.block_id = o.block_id;
+  m.generation = o.generation;
+  m.rkey = o.rkey;
+  m.off = o.off;
+  m.len = o.len;
+  memcpy(m.node, o.node, sizeof(m.node));
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    cache_[block_id] = m;
+  }
+  *out = m;
+  return 0;
+}
+
+void KvCache::invalidate(uint64_t block_id) {
+  std::lock_guard<std::mutex> g(mu_);
+  cache_.erase(block_id);
+}
+
+int KvCache::fetch(Channel* node_ch, uint64_t block_id, IOBuf* out) {
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    KvBlockMeta m;
+    int rc = lookup(block_id, &m, /*refresh=*/attempt > 0);
+    if (rc != 0) {
+      return rc;
+    }
+    KvWire w;
+    memset(&w, 0, sizeof(w));
+    w.block_id = block_id;
+    w.generation = m.generation;
+    out->clear();
+    rc = kv_call(node_ch, kKvFetchMethod, w, out);
+    if (rc == 0) {
+      return 0;
+    }
+    if (rc != kEKvStale && rc != kEKvMiss) {
+      return rc;  // transport/chaos failure: the record may be fine
+    }
+    invalidate(block_id);  // generation-checked invalidation, retry once
+  }
+  return kEKvStale;
+}
+
+}  // namespace trpc

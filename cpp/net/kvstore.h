@@ -1,0 +1,256 @@
+// Paged KV-block registry + node-local block store — block-addressed
+// one-sided KV-cache transfer over the RMA fabric (ISSUE 11 tentpole).
+//
+// No direct brpc parity: the reference stops at connection-addressed
+// RPC.  This is fabric-lib's (arXiv 2510.27656) central abstraction made
+// concrete on our transport stack: a KV-cache block is addressed by
+// BLOCK ID, not by connection — the registry maps
+//   block_id → {node, rkey, offset, len, generation}
+// and any client holding that record can fetch the bytes from the
+// owning node, landing them ONE-SIDED in its own registered pages (the
+// PR 10 direct-landing path: the fetch response is PUT straight into
+// the caller's RmaBuffer, zero receiver-side copies).  T3-style overlap
+// (arXiv 2401.16677) falls out of the existing planes: MB-scale block
+// fetches ride the striped/RMA rails while the small token-RPC decode
+// stream keeps dispatching through the messenger cut budget and QoS
+// lanes — the disaggregated prefill/decode workload composes instead of
+// head-of-line blocking.
+//
+// Roles:
+//  - KvStore (one per process, `kv_store()`): the PREFILL side.  Blocks
+//    are published out of exportable (rma_alloc'd) regions; the store
+//    pins the region mapping so fetches serve the bytes zero-copy (an
+//    IOBuf wrap of the registered pages) and rma_free can never unmap
+//    them under an in-flight response.  Publishing mints the block's
+//    GENERATION (monotonic per block id, tombstones survive eviction);
+//    a byte budget (trpc_kv_store_bytes) evicts expired-then-LRU blocks
+//    under pressure.  `kv_attach_store(Server*)` serves "Kv.Fetch".
+//  - KvRegistry (`kv_registry()`): the directory.  Lease-based
+//    ownership: every record carries a deadline; expired records answer
+//    kEKvMiss and are pruned lazily.  Double-register of a live block
+//    is rejected (kEKvExists) unless the incoming generation is newer
+//    (the publisher re-published after a local evict).
+//    `kv_attach_registry(Server*)` serves "KvReg.{Register,Lookup,
+//    Evict,Renew}" — the registry can run on any node, including a
+//    third party.
+//  - KvCache: the DECODE-side lookup cache.  Lookups are cached until
+//    proven stale: a fetch answered kEKvStale/kEKvMiss (generation
+//    bumped, lease expired, block evicted) invalidates the cached
+//    record, re-looks-up once, and retries — the generation check is
+//    what makes caching safe, never a freshness timer.
+//
+// Fault semantics (the whole-or-nothing contract, inherited from the
+// RMA/stripe planes and extended by generations):
+//  - A chunk fault (drop/trunc/corrupt) during a block fetch fails the
+//    CALL whole — the landing buffer is never observable as complete
+//    with partial bytes (rma_resolve / stripe reassembly drop whole).
+//  - Generation and lease are validated AT SERVE TIME, so a lease that
+//    expires while the fetch is queued (svr_delay, chaos) answers
+//    kEKvStale and the client admits nothing stale — there is no
+//    admit-then-invalidate window.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+#include "base/iobuf.h"
+
+namespace trpc {
+
+class Channel;
+class Server;
+struct RmaMapping;
+
+// Error codes, continuing the 2004/2005 (kELimit/kEOverloaded) family.
+// kEKvMiss: the block is unknown (never registered here, or expired and
+// pruned) — look it up again or re-publish.  kEKvStale: the caller's
+// record is outdated (generation bumped, lease lapsed, block evicted) —
+// a cached lookup MUST be invalidated.  kEKvExists: double-register of
+// a live block (ownership is exclusive while the lease holds).
+constexpr int kEKvMiss = 2101;
+constexpr int kEKvStale = 2102;
+constexpr int kEKvExists = 2103;
+
+// Addressing record: where one block's bytes live.  `node` is the
+// owning node's RPC endpoint ("host:port") — any connection to it can
+// serve the block; the block is NOT bound to a connection.
+struct KvBlockMeta {
+  uint64_t block_id = 0;
+  uint64_t generation = 0;
+  uint64_t rkey = 0;  // exportable region holding the bytes
+  uint64_t off = 0;   // byte offset inside the region's data area
+  uint64_t len = 0;
+  char node[64] = {};
+};
+
+// Wire form shared by every Kv RPC (fixed little-endian, 112 bytes;
+// mirrored by brpc_tpu/rpc/kv.py _WIRE — kv-wire marker for review):
+// Register sends all fields; Lookup/Evict send block_id only; Fetch
+// sends block_id + generation; Renew sends block_id + lease_ms.
+// Lookup's RESPONSE is the same struct with lease_ms = remaining ms;
+// Register/Evict/Renew respond with one u64 generation.
+struct KvWire {
+  uint64_t block_id;
+  uint64_t generation;
+  uint64_t rkey;
+  uint64_t off;
+  uint64_t len;
+  int64_t lease_ms;
+  char node[64];
+};
+static_assert(sizeof(KvWire) == 112, "KvWire is wire format — fixed");
+
+// Method names (tstd, served by the attach functions below).
+inline constexpr const char* kKvFetchMethod = "Kv.Fetch";
+inline constexpr const char* kKvRegisterMethod = "KvReg.Register";
+inline constexpr const char* kKvLookupMethod = "KvReg.Lookup";
+inline constexpr const char* kKvEvictMethod = "KvReg.Evict";
+inline constexpr const char* kKvRenewMethod = "KvReg.Renew";
+
+// timeline kKvBlock `b` op tags (b = op<<56 | len; mirrored by
+// observe.py TIMELINE_KV_OPS and tools/trace_stitch.py).
+constexpr uint64_t kKvOpPublish = 1;
+constexpr uint64_t kKvOpServe = 2;
+constexpr uint64_t kKvOpEvict = 3;
+constexpr uint64_t kKvOpStale = 4;
+
+// ---- node-local block store (prefill side) -------------------------------
+
+class KvStore {
+ public:
+  // Publishes [data, data+len) as block_id under a lease (lease_ms <= 0
+  // uses trpc_kv_lease_ms).  `data` MUST lie inside an exportable
+  // (rma_alloc'd) region — the store pins the region mapping and serves
+  // fetches zero-copy from it.  Mints the generation (monotonic per
+  // block id across evictions) and fills *out (node left empty — the
+  // publisher stamps its own endpoint when registering).  Evicts
+  // expired-then-LRU blocks to fit the trpc_kv_store_bytes budget.
+  // Returns 0, kEKvExists when the block is live (withdraw first),
+  // or -1 (not exportable memory / larger than the whole budget).
+  int publish(uint64_t block_id, const void* data, size_t len,
+              int64_t lease_ms, KvBlockMeta* out);
+  // Explicit eviction.  The generation survives as a tombstone so a
+  // re-publish mints a NEWER generation and stale fetches stay
+  // detectable.  Returns 0, or kEKvMiss.
+  int withdraw(uint64_t block_id);
+  // Extends the lease (lease_ms <= 0: the flag default).  0 or kEKvMiss.
+  int renew(uint64_t block_id, int64_t lease_ms);
+  // Serves one block: validates generation AND lease at serve time,
+  // then appends the bytes zero-copy (the region mapping rides the
+  // IOBuf deleter).  Returns 0, kEKvStale (generation mismatch, lease
+  // lapsed, or evicted-but-tombstoned) or kEKvMiss (never seen).
+  int fetch(uint64_t block_id, uint64_t expected_gen, IOBuf* out);
+
+  size_t count();
+  uint64_t bytes_used();
+  void clear();  // tests: drop every block AND tombstone
+
+ private:
+  struct Block {
+    KvBlockMeta meta;
+    const char* data = nullptr;
+    std::shared_ptr<RmaMapping> map;
+    int64_t deadline_us = 0;
+    uint64_t touch_seq = 0;  // LRU clock (publish/fetch bumps)
+  };
+  // Evicts one block under mu_ (iterator-safe helper).
+  void evict_locked(uint64_t block_id, bool count_var);
+  std::mutex mu_;
+  std::unordered_map<uint64_t, Block> blocks_;
+  // Last generation minted per block id, surviving eviction: a
+  // re-published block continues the sequence, and a fetch for an
+  // evicted block answers kEKvStale (record invalid) instead of
+  // kEKvMiss (record unknown).
+  std::unordered_map<uint64_t, uint64_t> tombstones_;
+  uint64_t bytes_ = 0;
+  uint64_t touch_counter_ = 0;
+};
+KvStore& kv_store();
+
+// ---- registry (directory) ------------------------------------------------
+
+class KvRegistry {
+ public:
+  // Records meta under a lease.  Rejects kEKvExists while a live record
+  // holds the block with generation >= meta.generation; a NEWER
+  // generation replaces (re-publish).  A generation at or below the
+  // last seen for this id is rejected kEKvStale (zombie publisher).
+  // Returns 0 and echoes the accepted generation.
+  int do_register(const KvBlockMeta& meta, int64_t lease_ms,
+                  uint64_t* gen_out);
+  // Fills *out (+ remaining lease ms).  Expired records prune here and
+  // answer kEKvMiss.
+  int lookup(uint64_t block_id, KvBlockMeta* out,
+             int64_t* lease_left_ms = nullptr);
+  int evict(uint64_t block_id, uint64_t* gen_out = nullptr);
+  // Extends a live record's lease; echoes the current generation.
+  int renew(uint64_t block_id, int64_t lease_ms,
+            uint64_t* gen_out = nullptr);
+  size_t count();
+  void clear();  // tests
+
+ private:
+  struct Entry {
+    KvBlockMeta meta;
+    int64_t deadline_us = 0;
+  };
+  std::mutex mu_;
+  std::unordered_map<uint64_t, Entry> entries_;
+  std::unordered_map<uint64_t, uint64_t> last_gen_;
+};
+KvRegistry& kv_registry();
+
+// Attach the native handlers (call before Server::Start).  Both may be
+// attached to the same server; the registry may also run on a node that
+// stores nothing.  Return 0, or -1 when any registration was refused
+// (server already running).
+int kv_attach_store(Server* s);
+int kv_attach_registry(Server* s);
+
+// ---- client-side lookup cache (decode side) ------------------------------
+
+// Caches registry lookups with generation-checked invalidation.  NOT a
+// freshness timer: a cached record is used until a fetch proves it
+// stale (kEKvStale/kEKvMiss), then invalidated and re-resolved once.
+class KvCache {
+ public:
+  // `registry_ch` (not owned) must outlive the cache.
+  explicit KvCache(Channel* registry_ch) : reg_(registry_ch) {}
+
+  // Cached lookup (refresh forces a registry round-trip).  0 or error.
+  int lookup(uint64_t block_id, KvBlockMeta* out, bool refresh = false);
+  void invalidate(uint64_t block_id);
+
+  // Fetches block_id's bytes from `node_ch` (a channel to meta.node,
+  // caller-routed) using the cached record; on a stale answer
+  // invalidates, re-looks-up, and retries ONCE with the fresh
+  // generation.  0 on success (bytes in *out), else the final error.
+  int fetch(Channel* node_ch, uint64_t block_id, IOBuf* out);
+
+  uint64_t hits() const {
+    // Relaxed: monotonic test/stat counters — no ordering carried.
+    return hits_.load(std::memory_order_relaxed);
+  }
+  uint64_t misses() const {
+    // Relaxed: monotonic test/stat counters — no ordering carried.
+    return misses_.load(std::memory_order_relaxed);
+  }
+
+ private:
+  Channel* reg_;
+  std::mutex mu_;
+  std::unordered_map<uint64_t, KvBlockMeta> cache_;
+  // Relaxed counters: diagnostics only, no synchronization piggybacks.
+  std::atomic<uint64_t> hits_{0};
+  std::atomic<uint64_t> misses_{0};
+};
+
+// Flag registration (idempotent; attach functions and the capi call it
+// so /flags sees the kv knobs before first traffic).
+void kv_ensure_registered();
+
+}  // namespace trpc

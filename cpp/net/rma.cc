@@ -292,11 +292,40 @@ std::shared_ptr<RmaMapping> local_region(uint64_t rkey, bool* window,
   return nullptr;
 }
 
+// Cross-pid peer mappings cached by rkey (bounded, FIFO-evicted): the
+// direct-landing path puts into the SAME caller regions over and over
+// (a decode node cycling a handful of landing buffers), and paying
+// shm_open+mmap+munmap plus cold soft-faults per transfer capped the
+// cross-process KV pull at ~1.2 GB/s where the in-process path ran 6+.
+// A hit is revalidated against the shm object's CURRENT inode (one
+// shm_open+fstat, no mmap, pages stay warm): rkeys embed pid+ordinal,
+// and pid RECYCLING can re-mint an old rkey for a brand-new region — an
+// identity check is what makes the cache safe, not the mint alone.  A
+// peer that merely freed its region is harmless either way: the
+// receiver's resolve rejects the transfer whole.
+struct PeerMapEntry {
+  std::shared_ptr<RmaMapping> map;
+  RmaGeom geom;
+  dev_t dev = 0;  // shm object identity at map time
+  ino_t ino = 0;
+};
+struct PeerMapCache {
+  std::mutex mu;
+  std::unordered_map<uint64_t, PeerMapEntry> map;
+  std::vector<uint64_t> order;  // insertion order for eviction
+};
+PeerMapCache& peer_map_cache() {
+  static auto* c = new PeerMapCache();
+  return *c;
+}
+constexpr size_t kPeerMapCacheCap = 64;
+
 // Maps a PEER's exportable region by rkey, snapshotting its geometry
 // from the header ONCE under validation (all later arithmetic uses the
 // snapshot).  Loopback (peer pid == ours) shares the registry's own
 // mapping — same virtual address, and the shared refcount defers
-// rma_free's munmap past this user.
+// rma_free's munmap past this user.  Cross-pid mappings come from the
+// bounded cache above.
 std::shared_ptr<RmaMapping> map_peer_region(uint64_t rkey, RmaGeom* geom) {
   const int32_t pid = static_cast<int32_t>(rkey >> 32);
   const uint32_t ord = static_cast<uint32_t>(rkey);
@@ -304,6 +333,37 @@ std::shared_ptr<RmaMapping> map_peer_region(uint64_t rkey, RmaGeom* geom) {
     return local_region(rkey, nullptr, geom);
   }
   const std::string name = rma_shm_name(pid, ord);
+  {
+    PeerMapCache& c = peer_map_cache();
+    std::lock_guard<std::mutex> g(c.mu);
+    auto it = c.map.find(rkey);
+    if (it != c.map.end()) {
+      // Revalidate identity: the same rkey naming a DIFFERENT shm
+      // object (pid recycled, ordinal re-minted) must not serve the
+      // dead peer's orphaned pages.
+      struct stat st;
+      const int vfd = shm_open(name.c_str(), O_RDONLY, 0600);
+      const bool same = vfd >= 0 && fstat(vfd, &st) == 0 &&
+                        st.st_dev == it->second.dev &&
+                        st.st_ino == it->second.ino;
+      if (vfd >= 0) {
+        close(vfd);
+      }
+      if (same) {
+        if (geom != nullptr) {
+          *geom = it->second.geom;
+        }
+        return it->second.map;
+      }
+      c.map.erase(it);  // stale identity: fall through to a fresh map
+      for (auto oit = c.order.begin(); oit != c.order.end(); ++oit) {
+        if (*oit == rkey) {
+          c.order.erase(oit);
+          break;
+        }
+      }
+    }
+  }
   const int fd = shm_open(name.c_str(), O_RDWR, 0600);
   if (fd < 0) {
     return nullptr;
@@ -343,6 +403,22 @@ std::shared_ptr<RmaMapping> map_peer_region(uint64_t rkey, RmaGeom* geom) {
   }
   if (geom != nullptr) {
     *geom = snap;
+  }
+  {
+    PeerMapCache& c = peer_map_cache();
+    std::lock_guard<std::mutex> g(c.mu);
+    if (c.map.size() >= kPeerMapCacheCap && !c.order.empty()) {
+      c.map.erase(c.order.front());  // shared_ptr defers the munmap
+      c.order.erase(c.order.begin());
+    }
+    PeerMapEntry e;
+    e.map = m;
+    e.geom = snap;
+    e.dev = st.st_dev;  // identity captured at map time (fstat above)
+    e.ino = st.st_ino;
+    if (c.map.emplace(rkey, std::move(e)).second) {
+      c.order.push_back(rkey);
+    }
   }
   return m;
 }
@@ -796,6 +872,19 @@ int rma_unreg(uint64_t rkey) {
 
 bool rma_exportable(const void* buf, size_t len, uint64_t* rkey,
                     uint64_t* off) {
+  return rma_pin_exportable(buf, len, rkey, off) != nullptr;
+}
+
+size_t rma_region_count() {
+  std::lock_guard<std::mutex> g(reg_mu());
+  return regions().size();
+}
+
+// The one authoritative exportable-region scan: rma_exportable is a
+// thin boolean wrapper over it.
+std::shared_ptr<RmaMapping> rma_pin_exportable(const void* buf, size_t len,
+                                               uint64_t* rkey,
+                                               uint64_t* off) {
   const char* p = static_cast<const char*>(buf);
   std::lock_guard<std::mutex> g(reg_mu());
   for (const RegionRec& r : regions()) {
@@ -812,15 +901,10 @@ bool rma_exportable(const void* buf, size_t len, uint64_t* rkey,
       if (off != nullptr) {
         *off = static_cast<uint64_t>(p - data);
       }
-      return true;
+      return r.map;
     }
   }
-  return false;
-}
-
-size_t rma_region_count() {
-  std::lock_guard<std::mutex> g(reg_mu());
-  return regions().size();
+  return nullptr;
 }
 
 void rma_landing_bind(uint64_t cid, void* buf, size_t cap) {

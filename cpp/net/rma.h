@@ -120,6 +120,14 @@ bool rma_exportable(const void* buf, size_t len, uint64_t* rkey,
                     uint64_t* off);
 // Live regions (tests, /vars).
 size_t rma_region_count();
+// Co-owning reference to the exportable region containing [buf, buf+len)
+// (net/kvstore.h serves KV-block bytes zero-copy out of registered
+// pages; the returned mapping refcount defers rma_free's munmap past
+// any in-flight reader).  Fills *rkey/*off like rma_exportable.
+// nullptr when the range is not inside one live exportable region.
+std::shared_ptr<RmaMapping> rma_pin_exportable(const void* buf, size_t len,
+                                               uint64_t* rkey,
+                                               uint64_t* off);
 
 // -- landing binds (batch plane) ------------------------------------------
 

@@ -71,6 +71,9 @@ enum EventType : uint32_t {
   kStripeDone = 20,  // b=total (reassembly complete, dispatching)
   // -- QoS lane drains --------------------------------------------------
   kQosDrain = 21,  // a=(lane | shard cursor << 8) b=round quantum
+  // -- KV-block registry / disaggregation (net/kvstore.h) ---------------
+  kKvBlock = 22,  // a=block id, b=(op << 56) | payload len; ops:
+                  // 1 publish, 2 serve, 3 evict, 4 stale-reject
   kEventTypeCount,
 };
 
@@ -99,6 +102,7 @@ constexpr const char* kEventNames[] = {
     "stripe_land",     // timeline-event 19 (stripe_land)
     "stripe_done",     // timeline-event 20 (stripe_done)
     "qos_drain",       // timeline-event 21 (qos_drain)
+    "kv_block",        // timeline-event 22 (kv_block)
 };
 static_assert(sizeof(kEventNames) / sizeof(kEventNames[0]) ==
                   kEventTypeCount,

@@ -38,7 +38,7 @@ _NO_CMAKE = shutil.which("cmake") is None or shutil.which("ctest") is None
 # cpp/tests/ so a new suite gates automatically.
 TSAN_SUITES = [
     "fiber", "rpc", "stream", "shm", "ici", "chaos", "stat", "qos",
-    "stripe", "analysis", "timeline", "rma",
+    "stripe", "analysis", "timeline", "rma", "kvstore",
 ]
 ALL_SUITES = sorted(
     p.stem[len("test_"):] for p in (REPO / "cpp" / "tests").glob("test_*.cc")
@@ -165,6 +165,17 @@ def test_rma_cpp_suite_native():
     cancel-mid-put quiescence, sub-threshold bypass, window-full
     fallback, and chunk-fault whole-or-nothing semantics."""
     _run_native_suite("test_rma.cc", "test_rma_native", "rma suite")
+
+
+def test_kvstore_cpp_suite_native():
+    """ISSUE 11: the paged KV-block registry gates tier-1 — registry
+    lifecycle and lease semantics, generation minting across evictions,
+    double-register rejection, store eviction under byte-budget
+    pressure, zero-copy serving, lookup-cache invalidation on stale
+    generations, the one-sided shm fetch ride, and chunk-fault
+    whole-or-nothing composition."""
+    _run_native_suite("test_kvstore.cc", "test_kvstore_native",
+                      "kvstore suite")
 
 
 # Wall-clock-window cases (the p99 guards) stay native under sanitizer

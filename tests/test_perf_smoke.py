@@ -414,3 +414,65 @@ def test_small_rpc_hot_path_unchanged_by_stripe_layer():
         )
     finally:
         srv.stop()
+
+
+# KV-disagg floor (ISSUE 11): the disaggregated prefill/decode workload
+# must hold BOTH headline metrics in the SAME run — block goodput over
+# the one-sided fabric AND the token-RPC p99 — with the acceptance
+# artifact (a stitched two-role Perfetto trace) produced by the same
+# measurement.  The goodput floor is the ISSUE acceptance number (2
+# GB/s; this box does ~30+ over shm rma after the peer-map cache), and
+# the p99 criterion mirrors qos_mixed: loaded <= 2x unloaded with a
+# small absolute floor absorbing idle-box degenerate baselines.
+KV_DISAGG_GOODPUT_FLOOR_GBPS = 2.0
+
+
+def test_kv_disagg_goodput_and_token_p99_hold_together():
+    """ISSUE 11 acceptance: KV goodput >= 2 GB/s AND token-RPC p99 <=
+    2x its unloaded baseline, measured simultaneously (three separate
+    processes: prefill server, decode block puller, token sampler),
+    with the stitched 2-process Perfetto trace carrying spans from both
+    roles and flight-recorder timelines including the kv_block track."""
+    import pathlib
+    import sys
+
+    tool = pathlib.Path(__file__).resolve().parent.parent / "tools" / \
+        "kv_disagg.py"
+    trace_path = "/tmp/kv_disagg_trace.json"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(tool.parent.parent)
+    env["JAX_PLATFORMS"] = "cpu"
+    row = None
+    for _ in range(2):  # one retry: the p99 side is timing-bound
+        out = subprocess.run(
+            [sys.executable, str(tool), "--json", "--seconds", "6",
+             "--timeline", "--out", trace_path],
+            capture_output=True, text=True, timeout=240, env=env)
+        line = next((ln for ln in out.stdout.splitlines()[::-1]
+                     if ln.startswith("{")), None)
+        assert line, f"kv_disagg produced no row:\n{out.stderr[-3000:]}"
+        row = json.loads(line)
+        assert row["kv_failures"] == 0, row
+        assert row["verified"], f"block content verification failed: {row}"
+        assert row["rpc_path"] == "rma", (
+            f"block pulls did not ride the one-sided plane: {row}")
+        bound = max(2 * row["token_p99_unloaded_us"], 1500)
+        if (row["kv_goodput_gbps"] >= KV_DISAGG_GOODPUT_FLOOR_GBPS
+                and row["token_p99_loaded_us"] <= bound):
+            break
+    else:
+        raise AssertionError(
+            f"kv_disagg failed to hold goodput >= "
+            f"{KV_DISAGG_GOODPUT_FLOOR_GBPS} GB/s and token p99 <= 2x "
+            f"unloaded together: {row}")
+    # The acceptance artifact: one stitched file, spans from BOTH roles
+    # (prefill server spans + decode client spans), timelines from both
+    # processes, and the kv_block events rendered on their own track.
+    trace = json.load(open(trace_path))
+    s = trace["stitch"]
+    assert s["spans"] > 0 and s["span_nodes"] >= 2, s
+    assert len(s["timeline_nodes"]) >= 2, s
+    assert s["timeline_events"] > 0, s
+    kv_events = [e for e in trace["traceEvents"]
+                 if str(e.get("name", "")).startswith("kv_")]
+    assert kv_events, "no kv_block events in the stitched artifact"

@@ -1,0 +1,393 @@
+#!/usr/bin/env python3
+"""Prefill/decode disaggregation demo over the KV-block fabric (ISSUE 11).
+
+The workload the transport stack exists for (fabric-lib, arXiv
+2510.27656; overlap discipline from T3, arXiv 2401.16677), composed
+from the repo's own planes:
+
+  PREFILL process — a Server hosting the node-local KV block store
+  (Kv.Fetch serves published blocks zero-copy out of RmaBuffer pages),
+  the block registry (KvReg.*), and a native token-step echo.  Publishes
+  N blocks of M MB and registers them.  Per-tenant QoS is on: the token
+  tenant outweighs the kv tenant, so MB-scale block pulls cannot
+  head-of-line block the decode stream.
+
+  DECODE process — a KvClient that resolves blocks through the registry
+  (cached lookups, generation-checked) and pulls them continuously over
+  an shm connection with a D-deep pipeline, each block landing
+  ONE-SIDED in a registered RmaBuffer (the PR 10 direct path).  Runs its
+  own Server purely to export /rpcz + /timeline for stitching.
+
+  DRIVER (this process) — orchestrates both, samples the token-RPC p99
+  against the prefill server UNLOADED and then LOADED (while the decode
+  process saturates the same server with block pulls — the load
+  generator and the latency sampler are separate processes, per the
+  qos_mixed bench discipline), stitches a cross-node Perfetto trace
+  (spans + flight-recorder timelines from BOTH roles, kv_block events on
+  their own track), and prints one JSON row:
+
+    kv_goodput_gbps AND token p99 ratio, held simultaneously.
+
+Usage:
+    python tools/kv_disagg.py --json                # the bench row
+    python tools/kv_disagg.py --json --seconds 8 \
+        --out /tmp/kv_disagg_trace.json            # + Perfetto artifact
+    python tools/kv_disagg.py --chaos 'corrupt=0.02' ...  # chunk chaos
+
+Importable pieces (tests): `run_driver`, `DEFAULTS`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULTS = {
+    "blocks": 12,
+    "block_mb": 8,
+    "depth": 4,
+    "seconds": 8.0,
+    "qos_lanes": 4,
+    "lane_weights": "8,4,2,1",
+    "qos_spec": "tok:weight=8;kv:weight=1",
+}
+
+
+# ---------------------------------------------------------------- roles ----
+
+def run_prefill(args) -> None:
+    import numpy as np
+
+    from brpc_tpu.rpc import (Channel, RmaBuffer, Server, kv, observe,
+                              set_flag)
+
+    if args.timeline:
+        set_flag("trpc_timeline", "true")
+    observe.enable_rpcz()
+    set_flag("trpc_qos_lanes", str(args.qos_lanes))
+    set_flag("trpc_qos_lane_weights", args.lane_weights)
+    srv = Server()
+    srv.enable_kv_store()
+    srv.enable_kv_registry()
+    srv.register_native_echo("Token.Step")
+    if args.qos_spec:
+        srv.set_qos(args.qos_spec)
+    srv.start(args.port)
+    addr = f"127.0.0.1:{srv.port}"
+    if args.chaos:
+        from brpc_tpu.rpc import fault
+
+        fault.set_schedule(args.chaos)
+
+    block_bytes = args.block_mb << 20
+    pages = RmaBuffer(args.blocks * block_bytes)
+    view = np.frombuffer(pages.view, dtype=np.uint8)
+    # Per-block pattern: a block landed at the wrong offset (or torn)
+    # can never byte-match its own pattern.
+    for i in range(args.blocks):
+        blk = view[i * block_bytes:(i + 1) * block_bytes]
+        blk[:] = ((np.arange(block_bytes, dtype=np.uint64) * 2654435761
+                   + i * 97) >> 13).astype(np.uint8)
+    reg = kv.KvRegistryClient(Channel(addr, timeout_ms=10000),
+                              owns_channel=True)
+    for i in range(args.blocks):
+        meta = kv.publish(1 + i, pages, offset=i * block_bytes,
+                          length=block_bytes, lease_ms=args.lease_ms,
+                          node=addr)
+        reg.register(meta, lease_ms=args.lease_ms)
+    print(f"PORT {srv.port}", flush=True)
+    sys.stdin.readline()  # parent closes stdin to stop us
+    reg.close()
+    srv.stop()
+
+
+def run_decode(args) -> None:
+    import numpy as np
+
+    from brpc_tpu.rpc import RmaBuffer, Server, kv, observe, set_flag
+
+    if args.timeline:
+        set_flag("trpc_timeline", "true")
+    observe.enable_rpcz()
+    # Observability-only server: /rpcz + /timeline for the stitcher.
+    srv = Server()
+    srv.start(args.port)
+    print(f"PORT {srv.port}", flush=True)
+
+    block_bytes = args.block_mb << 20
+    cli = kv.KvClient(args.prefill, use_shm=not args.tcp,
+                      timeout_ms=30000, qos_tenant="kv", qos_priority=3)
+    metas = [cli.lookup(1 + i) for i in range(args.blocks)]
+    node_ch = cli._node_channel(metas[0].node)
+
+    from brpc_tpu.rpc import observe as _obs
+    rma0 = _obs.Vars.dump().get("rma_rx_msgs", 0)
+
+    # One content check before the measured loop: block 0 must match its
+    # generator pattern exactly (the whole-or-nothing guard, verified).
+    land_check = RmaBuffer(block_bytes)
+    n = cli.fetch(1, resp_buf=land_check.view)
+    got = np.frombuffer(land_check.view, dtype=np.uint8)
+    want = ((np.arange(block_bytes, dtype=np.uint64) * 2654435761 + 0 * 97)
+            >> 13).astype(np.uint8)
+    verified = n == block_bytes and bool(np.array_equal(got, want))
+    land_check.free()
+
+    # D-deep pull pipeline: D landing buffers cycle through submits so
+    # the shm rails stay saturated (pull k, resubmit k — no bubbles).
+    pipe = node_ch.pipeline()
+    lands = [RmaBuffer(block_bytes) for _ in range(args.depth)]
+    free = list(range(args.depth))
+    tok2land: dict[int, int] = {}
+    fetched = 0
+    failures = 0
+    bytes_done = 0
+    rr = 0
+
+    def submit_one() -> None:
+        nonlocal rr
+        li = free.pop()
+        m = metas[rr % len(metas)]
+        rr += 1
+        req = kv._req(m.block_id, generation=m.generation)
+        toks = pipe.submit(kv.FETCH_METHOD, [req],
+                          resp_bufs=[lands[li].view], timeout_ms=30000)
+        tok2land[toks[0]] = li
+
+    for _ in range(args.depth):
+        submit_one()
+    t0 = time.perf_counter()
+    end = t0 + args.seconds
+    draining = False
+    while tok2land:
+        cs = pipe.poll(max_n=args.depth, timeout_ms=30000)
+        if not cs:
+            failures += len(tok2land)
+            break
+        for c in cs:
+            free.append(tok2land.pop(c.token))
+            if c.ok:
+                fetched += 1
+                bytes_done += c.resp_len
+            else:
+                failures += 1
+        if not draining and time.perf_counter() >= end:
+            draining = True
+        if not draining:
+            while free:
+                submit_one()
+    dt = time.perf_counter() - t0
+    rma1 = _obs.Vars.dump().get("rma_rx_msgs", 0)
+    pipe.close()
+    row = {
+        "kv_goodput_gbps": round(bytes_done / dt / 1e9, 3),
+        "kv_fetches": fetched,
+        "kv_failures": failures,
+        "kv_bytes": bytes_done,
+        "verified": verified,
+        "rpc_path": "rma" if rma1 > rma0 else "copy",
+        "cache_hits": cli.cache_hits,
+        "cache_misses": cli.cache_misses,
+    }
+    print("ROW " + json.dumps(row), flush=True)
+    sys.stdin.readline()  # stay up for the trace fetch
+    for b in lands:
+        b.free()
+    cli.close()
+    srv.stop()
+
+
+# --------------------------------------------------------------- driver ----
+
+def _spawn_role(role: str, extra: list[str]) -> tuple[subprocess.Popen, int]:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    p = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--role", role] + extra,
+        env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+    port = None
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        line = p.stdout.readline()
+        if not line:
+            raise RuntimeError(f"{role} died before PORT")
+        if line.startswith("PORT "):
+            port = int(line.split()[1])
+            break
+    if port is None:
+        raise RuntimeError(f"{role} never printed PORT")
+    return p, port
+
+
+def _p99(lat: list[float]) -> float:
+    lat = sorted(lat)
+    return lat[len(lat) * 99 // 100] if lat else 0.0
+
+
+def run_driver(args) -> dict:
+    from brpc_tpu.rpc import Channel, get_flag, observe
+
+    observe.enable_rpcz()
+    base_flags = [
+        "--blocks", str(args.blocks), "--block-mb", str(args.block_mb),
+        "--qos-lanes", str(args.qos_lanes),
+        "--lane-weights", args.lane_weights,
+        "--qos-spec", args.qos_spec, "--lease-ms", str(args.lease_ms),
+    ]
+    if args.timeline:
+        base_flags.append("--timeline")
+    pre_extra = list(base_flags)
+    if args.chaos:
+        pre_extra += ["--chaos", args.chaos]
+    prefill, pre_port = _spawn_role("prefill", pre_extra)
+    decode = None
+    try:
+        tok = Channel(f"127.0.0.1:{pre_port}", timeout_ms=10000,
+                      qos_tenant="tok", qos_priority=0)
+
+        def sample(seconds: float) -> list[float]:
+            lat = []
+            stop = time.perf_counter() + seconds
+            payload = b"t" * 1024
+            while time.perf_counter() < stop:
+                t0 = time.perf_counter()
+                tok.call("Token.Step", payload)
+                lat.append((time.perf_counter() - t0) * 1e6)
+            return lat
+
+        for _ in range(100):  # warm connections, pools, lanes
+            tok.call("Token.Step", b"t" * 1024)
+        unloaded = sample(min(3.0, args.seconds / 2))
+
+        dec_extra = base_flags + [
+            "--prefill", f"127.0.0.1:{pre_port}",
+            "--depth", str(args.depth), "--seconds", str(args.seconds),
+        ]
+        if args.tcp:
+            dec_extra.append("--tcp")
+        decode, dec_port = _spawn_role("decode", dec_extra)
+        time.sleep(1.0)  # let the pull pipeline reach steady state
+        loaded = sample(max(args.seconds - 2.0, 2.0))
+        dec_row = None
+        deadline = time.time() + args.seconds + 60
+        while time.time() < deadline:
+            line = decode.stdout.readline()
+            if not line:
+                break
+            if line.startswith("ROW "):
+                dec_row = json.loads(line[4:])
+                break
+        if dec_row is None:
+            raise RuntimeError("decode child produced no row")
+
+        trace_summary = None
+        if args.out:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            import trace_stitch
+
+            eps = [f"127.0.0.1:{pre_port}", f"127.0.0.1:{dec_port}"]
+            dumps = {ep: trace_stitch.fetch_rpcz(ep) for ep in eps}
+            dumps["driver"] = trace_stitch.local_rpcz()
+            tl = None
+            if args.timeline:
+                tl = {ep: trace_stitch.fetch_timeline(ep) for ep in eps}
+            trace = trace_stitch.stitch(dumps, timeline_dumps=tl)
+            trace_summary = trace["stitch"]
+            trace_summary["path"] = args.out
+            # Per-node span presence: the artifact must carry BOTH roles.
+            by_pid: dict[str, int] = {}
+            for e in trace["traceEvents"]:
+                if e.get("ph") == "X" and e.get("cat") in ("server",
+                                                           "client"):
+                    by_pid[str(e["pid"])] = by_pid.get(str(e["pid"]), 0) + 1
+            trace_summary["span_nodes"] = len(by_pid)
+            with open(args.out, "w") as f:
+                json.dump(trace, f)
+        import statistics
+
+        p99_unloaded = _p99(unloaded)
+        p99_loaded = _p99(loaded)
+        row = {
+            "workload": "kv_disagg_prefill_decode",
+            **dec_row,
+            "token_median_unloaded_us": round(statistics.median(unloaded)),
+            "token_median_loaded_us": round(statistics.median(loaded)),
+            "blocks": args.blocks,
+            "block_bytes": args.block_mb << 20,
+            "depth": args.depth,
+            "token_p99_unloaded_us": round(p99_unloaded),
+            "token_p99_loaded_us": round(p99_loaded),
+            "ratio_p99": round(p99_loaded / max(p99_unloaded, 1.0), 3),
+            "token_samples_loaded": len(loaded),
+            "qos_lanes": args.qos_lanes,
+            "lane_weights": args.lane_weights,
+            "qos_spec": args.qos_spec,
+            "rma_rails_shm": get_flag("trpc_shm_rails"),
+            "timeline": bool(args.timeline),
+            "chaos": args.chaos or None,
+            "trace": trace_summary,
+        }
+        tok.close()
+        return row
+    finally:
+        for p in (decode, prefill):
+            if p is None:
+                continue
+            try:
+                p.stdin.close()
+                p.wait(timeout=15)
+            except Exception:  # noqa: BLE001
+                p.kill()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--role", choices=["driver", "prefill", "decode"],
+                    default="driver")
+    ap.add_argument("--blocks", type=int, default=DEFAULTS["blocks"])
+    ap.add_argument("--block-mb", type=int, default=DEFAULTS["block_mb"])
+    ap.add_argument("--depth", type=int, default=DEFAULTS["depth"])
+    ap.add_argument("--seconds", type=float, default=DEFAULTS["seconds"])
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--prefill", default="",
+                    help="decode role: prefill node host:port")
+    ap.add_argument("--qos-lanes", type=int, default=DEFAULTS["qos_lanes"])
+    ap.add_argument("--lane-weights", default=DEFAULTS["lane_weights"])
+    ap.add_argument("--qos-spec", default=DEFAULTS["qos_spec"])
+    ap.add_argument("--lease-ms", type=int, default=120000)
+    ap.add_argument("--tcp", action="store_true",
+                    help="pull blocks over TCP instead of shm (copy path)")
+    ap.add_argument("--chaos", default="",
+                    help="fault schedule installed in the prefill process")
+    ap.add_argument("--timeline", action="store_true",
+                    help="record + stitch flight-recorder timelines")
+    ap.add_argument("--out", default="",
+                    help="driver: write the stitched Perfetto trace here")
+    ap.add_argument("--json", action="store_true",
+                    help="driver: print the result row as one JSON line")
+    args = ap.parse_args(argv)
+    if args.role == "prefill":
+        run_prefill(args)
+        return 0
+    if args.role == "decode":
+        if not args.prefill:
+            ap.error("--role decode requires --prefill")
+        run_decode(args)
+        return 0
+    row = run_driver(args)
+    if args.json:
+        print(json.dumps(row))
+    else:
+        print(json.dumps(row, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -157,6 +157,12 @@ _TL_PRIMARY_RAIL = 0xFFFF
 _TL_RMA_RAIL_BIT = 0x8000
 _TL_RMA_RAIL_TID = 900800  # + rma rail index
 _TL_PRIMARY_RAIL_TID = 900900  # its own track, distinct from real rails
+# kv_block events (net/kvstore.h): block publishes / zero-copy serves /
+# evictions / stale-generation rejects on their own per-node track, so a
+# disaggregation trace shows block transfers next to the rails that
+# carried them.  b = op << 56 | payload len (TIMELINE_KV_OPS mirror).
+_TL_KV_TID = 970000
+_TL_KV_OPS = {1: "publish", 2: "serve", 3: "evict", 4: "stale"}
 
 
 def _timeline_chrome_events(pid: int, dump: dict, base: float,
@@ -230,9 +236,23 @@ def _timeline_chrome_events(pid: int, dump: dict, base: float,
                     "args": {"socket": e["a"], "trace_id": e["trace_id"]},
                 })
                 continue
-            # Everything else renders as an instant; stripe/QoS events
+            # Everything else renders as an instant; stripe/QoS/kv events
             # additionally land on their synthetic async tracks.
             out_tid = tid
+            if name == "kv_block":
+                b = int(e["b"], 16)
+                op = b >> 56
+                out_tid = track(_TL_KV_TID, "kv blocks")
+                events.append({
+                    "ph": "i", "s": "t", "cat": "timeline",
+                    "name": f"kv_{_TL_KV_OPS.get(op, op)}",
+                    "pid": pid, "tid": out_tid, "ts": ts,
+                    "args": {"block_id": e["a"],
+                             "len": b & ((1 << 56) - 1),
+                             "trace_id": e["trace_id"],
+                             "span_id": e["span_id"], "fid": e["fid"]},
+                })
+                continue
             if name == "stripe_send":
                 rail = int(e["b"], 16) >> 48
                 if rail == _TL_PRIMARY_RAIL:
