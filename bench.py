@@ -636,6 +636,33 @@ def _child_kv_disagg() -> None:
     raise RuntimeError(f"kv_disagg produced no row:\n{out.stderr[-2000:]}")
 
 
+def _child_rolling_restart() -> None:
+    """Cluster control-plane row (ISSUE 12): drain + hot-restart one
+    node of a 3-node naming-backed cluster under mixed 1KB + striped
+    load and KV pulls (tools/load_orchestrator.py --rolling-restart,
+    separate hub/node/successor/worker PROCESSES).  Stamps the
+    client-visible error count (acceptance: 0), the drain-window p99
+    against steady state (acceptance: <= 2x), and the stale-KV-admit
+    count (acceptance: 0) — the zero-downtime restart headline."""
+    import subprocess as sp
+
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "load_orchestrator.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = sp.run([sys.executable, tool, "--rolling-restart", "--json",
+                  "--seconds", "6", "--big-every", "50",
+                  "--big-bytes", str(1 << 20)],
+                 env=env, capture_output=True, text=True, timeout=240)
+    for ln in out.stdout.splitlines()[::-1]:
+        if ln.startswith("{"):
+            print(ln, flush=True)
+            return
+    raise RuntimeError(
+        f"rolling_restart produced no row:\n{out.stderr[-2000:]}")
+
+
 def _child_zerocopy() -> None:
     """Loopback RPC echo, three Python-boundary strategies at 4MB: the
     per-call bytes-copy path, the per-call dlpack zero-copy path, and the
@@ -853,6 +880,9 @@ def main() -> None:
     if os.environ.get("BENCH_KV"):
         _child_kv_disagg()
         return
+    if os.environ.get("BENCH_RR"):
+        _child_rolling_restart()
+        return
     if os.environ.get("BENCH_TPU_RPC"):
         _child_tpu_rpc()
         return
@@ -906,6 +936,7 @@ def main() -> None:
     zerocopy = _run_json_child({"BENCH_ZC": "1"}, 60)
     qos_mixed = _run_json_child({"BENCH_QOS": "1"}, 90)
     kv_disagg = _run_json_child({"BENCH_KV": "1"}, 240)
+    rolling_restart = _run_json_child({"BENCH_RR": "1"}, 240)
 
     # tpu_rpc leg, same retry contract; a CPU-platform run is still a real
     # measurement of the native RPC stack, so fall back rather than emit
@@ -941,6 +972,7 @@ def main() -> None:
         "zerocopy": zerocopy,
         "qos_mixed": qos_mixed,
         "kv_disagg": kv_disagg,
+        "rolling_restart": rolling_restart,
     }))
 
 

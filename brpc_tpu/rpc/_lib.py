@@ -273,6 +273,14 @@ def load_library() -> ctypes.CDLL:
                 ctypes.POINTER(ctypes.c_uint64),
             ]
             lib.trpc_kv_publish.restype = ctypes.c_int
+            lib.trpc_kv_publish_ex.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint64,
+                ctypes.c_int64, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.trpc_kv_publish_ex.restype = ctypes.c_int
             lib.trpc_kv_withdraw.argtypes = [ctypes.c_uint64]
             lib.trpc_kv_withdraw.restype = ctypes.c_int
             lib.trpc_kv_renew.argtypes = [ctypes.c_uint64, ctypes.c_int64]
@@ -290,6 +298,39 @@ def load_library() -> ctypes.CDLL:
             lib.trpc_kv_codes.restype = None
             lib.trpc_kv_reset.argtypes = []
             lib.trpc_kv_reset.restype = None
+            # Cluster control plane (capi/naming_capi.cc; net/naming.h):
+            # naming registry + graceful drain / hot-restart handoff.
+            lib.trpc_server_enable_naming.argtypes = [ctypes.c_void_p]
+            lib.trpc_server_enable_naming.restype = ctypes.c_int
+            lib.trpc_server_announce.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_int,
+            ]
+            lib.trpc_server_announce.restype = ctypes.c_int
+            lib.trpc_server_drain.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p,
+            ]
+            lib.trpc_server_drain.restype = ctypes.c_int
+            lib.trpc_server_start_handoff.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ]
+            lib.trpc_server_start_handoff.restype = ctypes.c_int
+            lib.trpc_server_draining.argtypes = [ctypes.c_void_p]
+            lib.trpc_server_draining.restype = ctypes.c_int
+            lib.trpc_draining_code.argtypes = []
+            lib.trpc_draining_code.restype = ctypes.c_int
+            lib.trpc_naming_codes.argtypes = [
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ]
+            lib.trpc_naming_codes.restype = None
+            lib.trpc_naming_member_count.argtypes = [ctypes.c_char_p]
+            lib.trpc_naming_member_count.restype = ctypes.c_size_t
+            lib.trpc_naming_reset.argtypes = []
+            lib.trpc_naming_reset.restype = None
+            lib.trpc_kv_withdraw_all.argtypes = []
+            lib.trpc_kv_withdraw_all.restype = ctypes.c_size_t
+            lib.trpc_rma_spans_in_use.argtypes = []
+            lib.trpc_rma_spans_in_use.restype = ctypes.c_size_t
             # RPC surface (capi/rpc_capi.cc).
             lib.trpc_server_create.restype = ctypes.c_void_p
             lib.trpc_server_destroy.argtypes = [ctypes.c_void_p]

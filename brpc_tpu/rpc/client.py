@@ -25,16 +25,27 @@ class OverloadedError(RpcError):
     quarantine backoff on the shedding one)."""
 
 
+class DrainingError(RpcError):
+    """The server is draining gracefully (cpp/net/server.h Drain,
+    kEDraining code 2006): healthy, just leaving the fleet.  A
+    ClusterChannel fails over to a different node inside the same call
+    WITHOUT quarantining the endpoint (its hot-restart successor revives
+    there moments later); only a bare Channel surfaces this."""
+
+
 def _overloaded_code(lib) -> int:
     return lib.trpc_qos_overloaded_code()
 
 
 def make_rpc_error(lib, code: int, text: str) -> RpcError:
     """The typed error for a failed call's status code — OverloadedError
-    for an admission-control shed, RpcError otherwise.  Shared by the
-    sync call paths and the batch plane so both surface the same type."""
+    for an admission-control shed, DrainingError for a graceful leave,
+    RpcError otherwise.  Shared by the sync call paths and the batch
+    plane so both surface the same type."""
     if code == _overloaded_code(lib):
         return OverloadedError(code, text)
+    if code == lib.trpc_draining_code():
+        return DrainingError(code, text)
     return RpcError(code, text)
 
 

@@ -122,11 +122,16 @@ def _req(block_id: int, generation: int = 0, lease_ms: int = 0) -> bytes:
 
 
 def publish(block_id: int, buffer, offset: int = 0, length: int | None = None,
-            lease_ms: int = 0, node: str = "") -> KvBlockMeta:
+            lease_ms: int = 0, node: str = "",
+            min_generation: int = 0) -> KvBlockMeta:
     """Publishes `length` bytes at `offset` of an RmaBuffer into this
     process's block store (native, zero-copy serving) and returns the
     registry-ready record.  lease_ms <= 0 uses the trpc_kv_lease_ms
-    default.  Raises KvExistsError while the block is live."""
+    default.  Raises KvExistsError while the block is live.
+    min_generation floors the minted generation — a hot-restart
+    successor (fresh pid) passes the predecessor's last registry
+    generation + 1 so its takeover re-publish outranks every cached
+    record (drain flow, cpp/net/naming.h)."""
     base = buffer.address if hasattr(buffer, "address") else \
         ctypes.addressof((ctypes.c_char * 0).from_buffer(buffer))
     size = buffer.nbytes if hasattr(buffer, "nbytes") else len(buffer)
@@ -139,9 +144,10 @@ def publish(block_id: int, buffer, offset: int = 0, length: int | None = None,
     gen = ctypes.c_uint64()
     rkey = ctypes.c_uint64()
     off = ctypes.c_uint64()
-    rc = lib.trpc_kv_publish(
+    rc = lib.trpc_kv_publish_ex(
         ctypes.c_void_p(base + offset), ctypes.c_size_t(length),
         ctypes.c_uint64(block_id), ctypes.c_int64(lease_ms),
+        ctypes.c_uint64(min_generation),
         ctypes.byref(gen), ctypes.byref(rkey), ctypes.byref(off))
     if rc != 0:
         miss, stale, exists = _codes()
@@ -243,7 +249,8 @@ class KvClient:
 
     def __init__(self, registry_addr: str, use_shm: bool = True,
                  timeout_ms: int = 30000, qos_tenant: str = "",
-                 qos_priority: int = 0):
+                 qos_priority: int = 0, naming_addr: str | None = None,
+                 naming_service: str = "kv"):
         self._use_shm = use_shm
         self._timeout_ms = timeout_ms
         self._qos = (qos_tenant, qos_priority)
@@ -253,11 +260,21 @@ class KvClient:
         self.registry = KvRegistryClient(self._reg_ch)
         self._node_chs: dict[str, Channel] = {}
         self._cache: dict[int, KvBlockMeta] = {}
+        # Optional cluster-membership view (cpp/net/naming.h registry at
+        # naming_addr, service naming_service): when a fetch fails at the
+        # TRANSPORT level and the cached node has left the fleet (drained
+        # or died), the dead channel is dropped and the record re-resolves
+        # through the registry instead of retrying a dead pid.
+        self._naming = None
+        self._naming_args = (naming_addr, naming_service)
         #: Lookup-cache telemetry (reads served without a registry RPC /
         #: registry round-trips / stale-triggered invalidations).
         self.cache_hits = 0
         self.cache_misses = 0
         self.invalidations = 0
+        #: Fetches re-routed because the naming view said the cached
+        #: node is gone (drain/crash re-resolution telemetry).
+        self.node_reresolves = 0
 
     def _node_channel(self, node: str) -> Channel:
         ch = self._node_chs.get(node)
@@ -288,10 +305,32 @@ class KvClient:
         if self._cache.pop(block_id, None) is not None:
             self.invalidations += 1
 
+    def _node_gone(self, node: str) -> bool:
+        """True when the naming view is configured AND `node` is not a
+        member of it (the owner drained or died — its withdrawn/expired
+        announcement is the authoritative 'do not retry this pid')."""
+        naming_addr, service = self._naming_args
+        if naming_addr is None:
+            return False
+        if self._naming is None:
+            from brpc_tpu.rpc import naming as _naming
+
+            self._naming = _naming.NamingClient(naming_addr,
+                                                timeout_ms=self._timeout_ms)
+        try:
+            _version, members = self._naming.resolve(service)
+        except RpcError:
+            return False  # registry unreachable: no verdict, keep the node
+        return all(m.addr != node for m in members)
+
     def fetch(self, block_id: int, resp_buf=None):
         """Bytes of block_id (or the landed length with resp_buf)."""
         last: RpcError | None = None
-        for attempt in range(2):
+        # With a naming view a third attempt is budgeted: transport-dead
+        # node -> drop channel + re-resolve -> fetch the re-published
+        # block from its new owner.
+        attempts = 3 if self._naming_args[0] is not None else 2
+        for attempt in range(attempts):
             meta = self.lookup(block_id, refresh=attempt > 0)
             req = _req(block_id, generation=meta.generation)
             ch = self._node_channel(meta.node)
@@ -302,10 +341,24 @@ class KvClient:
                 return self._fetch_into(ch, req, resp_buf)
             except RpcError as e:
                 e = _kv_error(e)
-                if not isinstance(e, (KvStaleError, KvMissError)):
-                    raise  # transport/chaos failure: the record may be fine
-                last = e
-                self.invalidate(block_id)  # generation-checked invalidation
+                if isinstance(e, (KvStaleError, KvMissError)):
+                    last = e
+                    self.invalidate(block_id)  # generation-checked
+                    continue
+                # Transport/chaos failure: the record MAY be fine — but
+                # if the naming view says the owner left the fleet, the
+                # dead channel must not be retried (it would only time
+                # out again): drop it and re-resolve through the
+                # registry, which the new owner re-publishes into.
+                if attempt + 1 < attempts and self._node_gone(meta.node):
+                    dead = self._node_chs.pop(meta.node, None)
+                    if dead is not None:
+                        dead.close()
+                    self.invalidate(block_id)
+                    self.node_reresolves += 1
+                    last = e
+                    continue
+                raise
         raise last
 
     def _fetch_into(self, ch: Channel, req: bytes, resp_buf) -> int:
@@ -336,4 +389,7 @@ class KvClient:
         for ch in self._node_chs.values():
             ch.close()
         self._node_chs.clear()
+        if self._naming is not None:
+            self._naming.close()
+            self._naming = None
         self._reg_ch.close()

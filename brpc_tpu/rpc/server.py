@@ -107,6 +107,62 @@ class Server:
         if self._lib.trpc_server_enable_kv_registry(self._ptr) != 0:
             raise RuntimeError("enable_kv_registry failed (server running?)")
 
+    def enable_naming_registry(self) -> None:
+        """Attaches the NATIVE naming-registry handlers
+        (Naming.Announce/Withdraw/Resolve/Watch, cpp/net/naming.h): this
+        server becomes a membership directory — nodes announce {addr,
+        zone, weight, epoch} under leases, clients watch for push-based
+        deltas.  Call before start."""
+        if self._lib.trpc_server_enable_naming(self._ptr) != 0:
+            raise RuntimeError("enable_naming_registry failed "
+                               "(server running?)")
+
+    def announce(self, registry_addr: str, service: str, zone: str = "",
+                 weight: int = 1) -> None:
+        """Announces this RUNNING server's address into `service` at the
+        registry and keeps the lease renewed from a native fiber.  The
+        announcement withdraws automatically on drain() (FIRST, so
+        watchers re-balance before in-flight work finishes) and on
+        close."""
+        rc = self._lib.trpc_server_announce(
+            self._ptr, registry_addr.encode(), service.encode(),
+            zone.encode(), int(weight))
+        if rc != 0:
+            raise RuntimeError(
+                f"announce to {registry_addr!r} failed (server not "
+                "started, or registry unreachable)")
+
+    def drain(self, deadline_ms: int = 0, handoff_path: str = "") -> bool:
+        """Graceful drain (cpp/net/server.h Drain): new requests answer
+        the draining status (DrainingError on a bare Channel; silent
+        failover on a ClusterChannel), drain hooks withdraw this node's
+        naming announcements and tombstone its KV blocks, and — with
+        handoff_path — the SO_REUSEPORT listener set is served to a
+        successor process (start_from_handoff) before our own fds close,
+        so no connection is ever refused.  Then waits out in-flight
+        requests and RMA window spans.  deadline_ms <= 0 uses the
+        trpc_drain_deadline_ms flag.  Returns True when fully quiesced,
+        False when the deadline cut the wait short."""
+        return self._lib.trpc_server_drain(
+            self._ptr, int(deadline_ms), handoff_path.encode()) == 0
+
+    def start_from_handoff(self, handoff_path: str,
+                           timeout_ms: int = 10000) -> int:
+        """Hot-restart successor entry point: adopts the draining
+        predecessor's listener fds from its handoff socket (retrying
+        until the predecessor serves them) and starts THIS server on
+        them — same port, shared accept queues, fresh process (and
+        fresh RMA rkeys).  Register methods first, like start()."""
+        if self._lib.trpc_server_start_handoff(
+                self._ptr, handoff_path.encode(), int(timeout_ms)) != 0:
+            raise RuntimeError(
+                f"listener handoff from {handoff_path!r} failed")
+        return self.port
+
+    @property
+    def draining(self) -> bool:
+        return bool(self._lib.trpc_server_draining(self._ptr))
+
     def set_qos(self, spec: str) -> None:
         """Per-tenant QoS admission control (cpp/net/qos.h grammar):
         ';'-separated `tenant:weight=N,limit=<spec>` clauses, tenant '*'

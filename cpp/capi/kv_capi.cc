@@ -32,12 +32,28 @@ int trpc_server_enable_kv_store(void* srv) {
 // region coordinates for the registry record.  Returns 0, kEKvExists
 // (2103) while the block is live, or -1 (not registered memory / over
 // budget).
+int trpc_kv_publish_ex(const void* data, size_t len, uint64_t block_id,
+                       int64_t lease_ms, uint64_t min_generation,
+                       uint64_t* gen_out, uint64_t* rkey_out,
+                       uint64_t* off_out);
+
 int trpc_kv_publish(const void* data, size_t len, uint64_t block_id,
                     int64_t lease_ms, uint64_t* gen_out, uint64_t* rkey_out,
                     uint64_t* off_out) {
+  return trpc_kv_publish_ex(data, len, block_id, lease_ms, 0, gen_out,
+                            rkey_out, off_out);
+}
+
+// Takeover variant (net/naming.h drain + hot restart): min_generation
+// floors the minted generation so a successor pid's re-publish outranks
+// the dead predecessor's registry record and cached lookups.
+int trpc_kv_publish_ex(const void* data, size_t len, uint64_t block_id,
+                       int64_t lease_ms, uint64_t min_generation,
+                       uint64_t* gen_out, uint64_t* rkey_out,
+                       uint64_t* off_out) {
   KvBlockMeta m;
-  const int rc =
-      kv_store().publish(block_id, data, len, lease_ms, &m);
+  const int rc = kv_store().publish(block_id, data, len, lease_ms, &m,
+                                    min_generation);
   if (rc != 0) {
     return rc;
   }

@@ -1,0 +1,96 @@
+// C ABI for the cluster control plane (net/naming.h + Server drain/hot
+// restart) — Python ctypes binding surface (brpc_tpu/rpc/server.py,
+// brpc_tpu/rpc/naming.py).
+#include <cstring>
+
+#include "fiber/event.h"
+#include "net/kvstore.h"
+#include "net/naming.h"
+#include "net/rma.h"
+#include "net/server.h"
+
+using namespace trpc;
+
+extern "C" {
+
+// Attaches the native naming-registry handlers
+// (Naming.Announce/Withdraw/Resolve/Watch) to a not-yet-started server.
+int trpc_server_enable_naming(void* srv) {
+  return naming_attach(static_cast<Server*>(srv));
+}
+
+// Announces "127.0.0.1:<port>" of a RUNNING server into `service` at the
+// registry (zone/weight ride the membership record), wiring withdrawal
+// into the server's drain hooks.  Returns 0, or -1.
+int trpc_server_announce(void* srv, const char* registry_addr,
+                         const char* service, const char* zone,
+                         int weight) {
+  // The first announce is a sync RPC: same pthread-pinning contract as
+  // every other sync capi entry (ctypes must return on the thread it
+  // entered on).
+  ScopedPthreadWait pin;
+  return server_announce(static_cast<Server*>(srv),
+                         registry_addr != nullptr ? registry_addr : "",
+                         service != nullptr ? service : "default",
+                         zone != nullptr ? zone : "", weight);
+}
+
+// Graceful drain (Server::Drain): answers kEDraining, runs drain hooks
+// (naming withdrawal + KV tombstoning), optionally serves the listener
+// handoff at `handoff_path` (null/"" = plain drain), then waits out
+// in-flight requests and RMA window spans.  Returns 0 when quiesced,
+// ETIMEDOUT when the deadline cut it short, -1 if not running.
+int trpc_server_drain(void* srv, int64_t deadline_ms,
+                      const char* handoff_path) {
+  // Drain parks the calling pthread (ctypes released the GIL) — same
+  // contract as the sync call paths.
+  ScopedPthreadWait pin;
+  return static_cast<Server*>(srv)->Drain(
+      deadline_ms, handoff_path != nullptr ? handoff_path : "");
+}
+
+// Hot-restart successor: adopts the predecessor's SO_REUSEPORT listener
+// set from its handoff socket and starts serving (register methods
+// first, like trpc_server_start).  Returns 0 on ok.
+int trpc_server_start_handoff(void* srv, const char* handoff_path,
+                              int64_t timeout_ms) {
+  ScopedPthreadWait pin;
+  return static_cast<Server*>(srv)->StartFromHandoff(
+      handoff_path != nullptr ? handoff_path : "", timeout_ms);
+}
+
+int trpc_server_draining(void* srv) {
+  return static_cast<Server*>(srv)->draining() ? 1 : 0;
+}
+
+// The kEDraining status code (graceful-leave failover), so bindings
+// never hardcode 2006.
+int trpc_draining_code() { return kEDraining; }
+
+// The naming error family (kENamingStaleEpoch / kENamingMiss).
+void trpc_naming_codes(int* stale_epoch, int* miss) {
+  if (stale_epoch != nullptr) {
+    *stale_epoch = kENamingStaleEpoch;
+  }
+  if (miss != nullptr) {
+    *miss = kENamingMiss;
+  }
+}
+
+// Registry introspection + test support.
+size_t trpc_naming_member_count(const char* service) {
+  return naming_registry().member_count(
+      service != nullptr ? service : "default");
+}
+
+void trpc_naming_reset() { naming_registry().clear(); }
+
+// Drain support for embedders driving the KV plane from Python: every
+// local block withdrawn + tombstoned (decode caches fail kv-stale and
+// re-resolve).  Returns the number withdrawn.
+size_t trpc_kv_withdraw_all() { return kv_store().withdraw_all(); }
+
+// RMA window spans currently held by peers (the drain quiesce probe).
+size_t trpc_rma_spans_in_use() { return rma_spans_in_use(); }
+
+}  // extern "C"

@@ -17,6 +17,7 @@
 #include "net/span.h"
 #include "net/channel.h"
 #include "net/cluster.h"
+#include "net/naming.h"
 #include "net/controller.h"
 #include "net/fault.h"
 #include "base/proc.h"
@@ -55,7 +56,13 @@ extern "C" {
 
 void* trpc_server_create() { return new Server(); }
 
-void trpc_server_destroy(void* srv) { delete static_cast<Server*>(srv); }
+void trpc_server_destroy(void* srv) {
+  // ~Server may run an owned Announcer's withdraw RPC (net/naming.h)
+  // and fiber joins: pin like the sync call paths so a ctypes caller
+  // returns on the pthread it entered on.
+  ScopedPthreadWait pin;
+  delete static_cast<Server*>(srv);
+}
 
 int trpc_server_register(void* srv, const char* method, HandlerCb cb,
                          void* user_ctx) {
@@ -141,6 +148,9 @@ void ensure_runtime_flags() {
   rpcz_enabled();
   rpcz_ring_capacity();  // registers trpc_rpcz_ring_size
   fault_register_flag();
+  cluster_ensure_registered();     // trpc_cluster_* knobs
+  Server::drain_ensure_registered();  // trpc_drain_deadline_ms
+  naming_ensure_registered();      // trpc_naming_* knobs
 }
 }  // namespace
 

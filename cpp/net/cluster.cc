@@ -8,23 +8,97 @@
 #include <netdb.h>
 #include <string.h>
 #include <sys/socket.h>
+#include <unistd.h>
 
 #include <algorithm>
 #include <atomic>
 #include <fstream>
 #include <sstream>
 
+#include "base/flags.h"
 #include "base/logging.h"
 #include "base/rand.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
 #include "fiber/sync.h"
+#include "net/fault.h"
+#include "net/naming.h"
 
 namespace trpc {
 
 // ---- load balancers -------------------------------------------------------
 
 namespace {
+
+uint64_t mix_u64(uint64_t v) {
+  v ^= v >> 33;
+  v *= 0xff51afd7ed558ccdull;
+  v ^= v >> 33;
+  return v;
+}
+
+// This client's locality label for the zone-preferring balancer.
+Flag* zone_flag() {
+  static Flag* f = [] {
+    Flag* flag = Flag::define_string(
+        "trpc_cluster_zone", "",
+        "this client's locality zone for the zone_la balancer: same-"
+        "zone members keep their full latency-derived share, members in "
+        "a DIFFERENT non-empty zone pay a 4x share penalty ('' = no "
+        "preference); max 15 chars (the naming wire zone field)");
+    if (flag != nullptr) {
+      flag->set_validator(
+          [](const std::string& v) { return v.size() <= 15; });
+    }
+    return flag;
+  }();
+  return f;
+}
+
+// Bounded-load factor for c_hash_bl (Mirrokni et al: consistent hashing
+// with bounded loads — ring affinity, but a node already carrying more
+// than factor x the mean in-flight load is skipped clockwise).
+Flag* chash_load_factor_flag() {
+  static Flag* f = [] {
+    Flag* flag = Flag::define_double(
+        "trpc_cluster_chash_load_factor", 1.25,
+        "bounded-load factor for the c_hash_bl balancer ([1.0, 16.0]): "
+        "a ring-preferred node whose in-flight count exceeds factor x "
+        "the healthy-set mean is skipped clockwise, trading affinity "
+        "for overload diffusion");
+    if (flag != nullptr) {
+      flag->set_validator([](const std::string& v) {
+        char* end = nullptr;
+        const double d = strtod(v.c_str(), &end);
+        return end != v.c_str() && *end == '\0' && d >= 1.0 && d <= 16.0;
+      });
+    }
+    return flag;
+  }();
+  return f;
+}
+
+Flag* subset_size_flag() {
+  static Flag* f = [] {
+    Flag* flag = Flag::define_int64(
+        "trpc_cluster_subset_size", 0,
+        "deterministic subsetting: each ClusterChannel holds member "
+        "channels to at most this many servers (rendezvous-hashed by a "
+        "per-process seed, so the fleet's clients spread evenly and "
+        "each keeps a STABLE subset across refreshes).  0 = unlimited.  "
+        "Mandatory at scale — N clients x M servers full-mesh is what "
+        "exhausts the fd budget ([0, 65536])");
+    if (flag != nullptr) {
+      flag->set_validator([](const std::string& v) {
+        char* end = nullptr;
+        const long long n = strtoll(v.c_str(), &end, 10);
+        return end != v.c_str() && *end == '\0' && n >= 0 && n <= 65536;
+      });
+    }
+    return flag;
+  }();
+  return f;
+}
 
 class RoundRobinLB : public LoadBalancer {
  public:
@@ -58,11 +132,11 @@ class ConsistentHashLB : public LoadBalancer {
                 int attempt) override {
     size_t best = healthy[0];
     uint64_t best_dist = UINT64_MAX;
-    const uint64_t h = mix(key);
+    const uint64_t h = mix_u64(key);
     for (size_t idx : healthy) {
       const uint64_t base = EndPointHash()(nodes[idx].ep);
       for (int r = 0; r < kReplicas; ++r) {
-        const uint64_t nh = mix(base + r * 0x9e3779b97f4a7c15ull);
+        const uint64_t nh = mix_u64(base + r * 0x9e3779b97f4a7c15ull);
         const uint64_t dist = nh - h;  // wrapping distance clockwise
         if (dist < best_dist) {
           best_dist = dist;
@@ -77,13 +151,57 @@ class ConsistentHashLB : public LoadBalancer {
     }
     return best;
   }
+};
 
- private:
-  static uint64_t mix(uint64_t v) {
-    v ^= v >> 33;
-    v *= 0xff51afd7ed558ccdull;
-    v ^= v >> 33;
-    return v;
+// Consistent hashing with BOUNDED loads (c_hash_bl): same ketama ring,
+// but the clockwise walk skips any node whose live in-flight count
+// exceeds trpc_cluster_chash_load_factor x the healthy-set mean — key
+// affinity holds while a node is healthy-and-not-hot, and a hotspot
+// key's overflow diffuses to the next nodes on the ring instead of
+// melting one server (the fabric-serving failure mode plain c_hash has).
+class ConsistentHashBoundedLB : public LoadBalancer {
+ public:
+  size_t select(const std::vector<size_t>& healthy,
+                const std::vector<ServerNode>& nodes, uint64_t key,
+                int attempt) override {
+    // Ring order: every healthy node's minimal clockwise distance.
+    const uint64_t h = mix_u64(key);
+    std::vector<std::pair<uint64_t, size_t>> order;
+    order.reserve(healthy.size());
+    int64_t inflight_sum = 0;
+    for (size_t idx : healthy) {
+      const uint64_t base = EndPointHash()(nodes[idx].ep);
+      uint64_t best_dist = UINT64_MAX;
+      for (int r = 0; r < ConsistentHashLB::kReplicas; ++r) {
+        const uint64_t nh = mix_u64(base + r * 0x9e3779b97f4a7c15ull);
+        best_dist = std::min(best_dist, nh - h);  // wrapping clockwise
+      }
+      order.emplace_back(best_dist, idx);
+      // Relaxed: advisory load sample; staleness only softens the bound.
+      inflight_sum +=
+          nodes[idx].inflight->load(std::memory_order_relaxed);
+    }
+    std::sort(order.begin(), order.end());
+    Flag* f = chash_load_factor_flag();
+    const double factor = f != nullptr ? f->double_value() : 1.25;
+    // +1: the candidate's own admission counts against the bound, and
+    // the ceiling keeps a cold cluster (mean 0) from rejecting everyone.
+    const double bound =
+        factor * (static_cast<double>(inflight_sum) / healthy.size() + 1);
+    const size_t start = static_cast<size_t>(attempt) % order.size();
+    // Full wrap from the retry offset: an under-bound node earlier in
+    // ring order must stay reachable on retries, or the walk would hand
+    // a retry to an over-bound node while an idle one exists.
+    for (size_t i = 0; i < order.size(); ++i) {
+      const size_t idx = order[(start + i) % order.size()].second;
+      // Relaxed: see above.
+      if (nodes[idx].inflight->load(std::memory_order_relaxed) + 1 <=
+          bound) {
+        return idx;
+      }
+    }
+    // Every node over the bound (burst): ring-preferred wins anyway.
+    return order[start].second;
   }
 };
 
@@ -155,8 +273,16 @@ class P2cEwmaLB : public LoadBalancer {
 // O(log n) selection for thousand-node clusters; at this runtime's
 // cluster sizes an O(n) scan over the healthy subset is cheaper than the
 // tree's bookkeeping, so the SAME weights feed a direct weighted pick.
+// zone_la extension: constructed with this client's zone, the same
+// latency/load/error weights additionally pay kZonePenalty when the
+// member sits in a DIFFERENT non-empty zone — traffic prefers local
+// replicas while remote ones stay warm enough to absorb a zone failure
+// (locality-aware parity, locality made literal).
 class LocalityAwareLB : public LoadBalancer {
  public:
+  explicit LocalityAwareLB(std::string my_zone = "")
+      : my_zone_(std::move(my_zone)) {}
+
   size_t select(const std::vector<size_t>& healthy,
                 const std::vector<ServerNode>& nodes, uint64_t,
                 int) override {
@@ -198,9 +324,17 @@ class LocalityAwareLB : public LoadBalancer {
         tried == 0 ? kScale / 1000 : tried_sum / static_cast<int64_t>(tried);
     int64_t weights[kMaxScan];
     for (size_t i = 0; i < n; ++i) {
-      const int64_t q = quality[i] >= 0
-                            ? quality[i]
-                            : std::max<int64_t>(newcomer, kMinWeight);
+      int64_t q = quality[i] >= 0
+                      ? quality[i]
+                      : std::max<int64_t>(newcomer, kMinWeight);
+      // Zone preference: penalize only a KNOWN-remote member (both
+      // zones non-empty and different) — unlabeled members ride at par
+      // so a partially-labeled fleet degrades to plain la, not to
+      // starving the unlabeled half.
+      const std::string& nz = nodes[healthy[i]].zone;
+      if (!my_zone_.empty() && !nz.empty() && nz != my_zone_) {
+        q = std::max<int64_t>(q / kZonePenalty, kMinWeight);
+      }
       weights[i] = q * std::max(1, nodes[healthy[i]].weight);
     }
     return healthy[weighted_pick(weights, n)];
@@ -210,9 +344,17 @@ class LocalityAwareLB : public LoadBalancer {
   static constexpr size_t kMaxScan = 1024;  // bound the stack scan
   static constexpr int64_t kScale = 1ll << 40;
   static constexpr int64_t kMinWeight = 16;  // floor (min_weight parity)
+  static constexpr int64_t kZonePenalty = 4;
+  const std::string my_zone_;
 };
 
 }  // namespace
+
+void cluster_ensure_registered() {
+  zone_flag();
+  chash_load_factor_flag();
+  subset_size_flag();
+}
 
 int64_t asym_ewma(int64_t prev, int64_t sample) {
   if (prev == 0) {
@@ -251,6 +393,10 @@ LoadBalancer* LoadBalancer::create(const std::string& name) {
   if (name == "c_hash") {
     return new ConsistentHashLB();
   }
+  if (name == "c_hash_bl") {
+    chash_load_factor_flag();  // register before first /flags read
+    return new ConsistentHashBoundedLB();
+  }
   if (name == "wrr") {
     return new WeightedRoundRobinLB();
   }
@@ -260,6 +406,10 @@ LoadBalancer* LoadBalancer::create(const std::string& name) {
   if (name == "la") {
     return new LocalityAwareLB();
   }
+  if (name == "zone_la") {
+    Flag* f = zone_flag();
+    return new LocalityAwareLB(f != nullptr ? f->string_value() : "");
+  }
   return nullptr;
 }
 
@@ -268,7 +418,7 @@ LoadBalancer* LoadBalancer::create(const std::string& name) {
 namespace {
 
 int parse_server_list(const std::string& text,
-                      std::vector<std::pair<EndPoint, int>>* out) {
+                      std::vector<NsEntry>* out) {
   std::stringstream ss(text);
   std::string token;
   while (std::getline(ss, token, ',')) {
@@ -279,16 +429,20 @@ int parse_server_list(const std::string& text,
       continue;
     }
     token = token.substr(b, e - b + 1);
-    // Optional "host:port <weight>" (file-NS column parity, for wrr).
-    int weight = 1;
-    const size_t sp = token.find_first_of(" \t");
+    // Optional "host:port <weight> <zone>" columns (file-NS parity: the
+    // weight feeds wrr/p2c, the zone feeds zone_la).
+    NsEntry entry;
+    size_t sp = token.find_first_of(" \t");
     if (sp != std::string::npos) {
-      weight = std::max(1, atoi(token.c_str() + sp + 1));
+      std::stringstream cols(token.substr(sp + 1));
+      std::string w, z;
+      cols >> w >> z;
+      entry.weight = std::max(1, atoi(w.c_str()));
+      entry.zone = z;
       token = token.substr(0, sp);
     }
-    EndPoint ep;
-    if (hostname2endpoint(token.c_str(), &ep) == 0) {
-      out->emplace_back(ep, weight);
+    if (hostname2endpoint(token.c_str(), &entry.ep) == 0) {
+      out->push_back(std::move(entry));
     } else {
       LOG(Warning) << "bad server '" << token << "' in list";
     }
@@ -299,7 +453,7 @@ int parse_server_list(const std::string& text,
 class ListNS : public NamingService {
  public:
   int resolve(const std::string& param,
-              std::vector<std::pair<EndPoint, int>>* out) override {
+              std::vector<NsEntry>* out) override {
     return parse_server_list(param, out);
   }
 };
@@ -308,7 +462,7 @@ class ListNS : public NamingService {
 class FileNS : public NamingService {
  public:
   int resolve(const std::string& param,
-              std::vector<std::pair<EndPoint, int>>* out) override {
+              std::vector<NsEntry>* out) override {
     std::ifstream in(param);
     if (!in) {
       return -1;
@@ -331,7 +485,7 @@ class FileNS : public NamingService {
 class DnsNS : public NamingService {
  public:
   int resolve(const std::string& param,
-              std::vector<std::pair<EndPoint, int>>* out) override {
+              std::vector<NsEntry>* out) override {
     const size_t colon = param.rfind(':');
     if (colon == std::string::npos) {
       return -1;
@@ -348,14 +502,109 @@ class DnsNS : public NamingService {
     }
     for (addrinfo* p = res; p != nullptr; p = p->ai_next) {
       const auto* sa = reinterpret_cast<sockaddr_in*>(p->ai_addr);
-      EndPoint ep;
-      ep.ip = sa->sin_addr.s_addr;
-      ep.port = ntohs(sa->sin_port);
-      out->emplace_back(ep, 1);
+      NsEntry entry;
+      entry.ep.ip = sa->sin_addr.s_addr;
+      entry.ep.port = ntohs(sa->sin_port);
+      out->push_back(std::move(entry));
     }
     freeaddrinfo(res);
     return out->empty() ? -1 : 0;
   }
+};
+
+// naming://registry_host:port/service — the in-repo naming service
+// (net/naming.h): members announced into the registry resolve with
+// their zone/weight, and watch() long-polls the registry so membership
+// deltas PUSH into the cluster channel instead of waiting a refresh
+// tick.  One channel to the registry, shared by resolve and watch (the
+// tstd connection multiplexes; a parked watch never blocks a resolve).
+class RegistryNS : public NamingService {
+ public:
+  int resolve(const std::string& param,
+              std::vector<NsEntry>* out) override {
+    std::vector<NamingMember> members;
+    {
+      // A watch() answer already carried the full member view; the
+      // refresh it triggers consumes it here (one-shot) instead of
+      // paying a second Naming.Resolve round-trip per push.
+      std::lock_guard<std::mutex> g(mu_);
+      if (pushed_valid_) {
+        members = std::move(pushed_view_);
+        pushed_view_.clear();
+        pushed_valid_ = false;
+      }
+    }
+    if (members.empty()) {
+      Channel* ch = channel(param);
+      if (ch == nullptr) {
+        return -1;
+      }
+      uint64_t version = 0;
+      if (naming_resolve(ch, service_of(param), &members, &version) !=
+          0) {
+        return -1;
+      }
+    }
+    for (const NamingMember& m : members) {
+      NsEntry entry;
+      if (hostname2endpoint(m.addr.c_str(), &entry.ep) != 0) {
+        LOG(Warning) << "bad member addr '" << m.addr << "' in naming view";
+        continue;
+      }
+      entry.weight = std::max<int>(m.weight, 1);
+      entry.zone = m.zone;
+      out->push_back(std::move(entry));
+    }
+    return out->empty() ? -1 : 0;
+  }
+
+  int watch(const std::string& param, uint64_t* version,
+            int64_t park_budget_ms) override {
+    Channel* ch = channel(param);
+    if (ch == nullptr) {
+      return -1;
+    }
+    const uint64_t before = version != nullptr ? *version : 0;
+    std::vector<NamingMember> members;
+    const int rc = naming_watch(ch, service_of(param), &members, version,
+                                park_budget_ms, park_budget_ms + 2000);
+    if (rc == 0 && version != nullptr && *version != before) {
+      // Stash the pushed view for the refresh this answer triggers.
+      std::lock_guard<std::mutex> g(mu_);
+      pushed_view_ = std::move(members);
+      pushed_valid_ = true;
+    }
+    return rc;
+  }
+
+  bool supports_watch() const override { return true; }
+
+ private:
+  static std::string addr_of(const std::string& param) {
+    return param.substr(0, param.find('/'));
+  }
+  static std::string service_of(const std::string& param) {
+    const size_t slash = param.find('/');
+    return slash == std::string::npos ? "default" : param.substr(slash + 1);
+  }
+  Channel* channel(const std::string& param) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (ch_ == nullptr) {
+      auto ch = std::make_unique<Channel>();
+      Channel::Options opts;
+      opts.timeout_ms = 2000;
+      if (ch->Init(addr_of(param), &opts) != 0) {
+        return nullptr;
+      }
+      ch_ = std::move(ch);
+    }
+    return ch_.get();
+  }
+  std::mutex mu_;
+  std::unique_ptr<Channel> ch_;
+  // One-shot view handed from watch() to the resolve() it triggers.
+  std::vector<NamingMember> pushed_view_;
+  bool pushed_valid_ = false;
 };
 
 }  // namespace
@@ -374,6 +623,10 @@ std::unique_ptr<NamingService> NamingService::create(const std::string& url,
     *param = url.substr(6);
     return std::make_unique<DnsNS>();
   }
+  if (url.rfind("naming://", 0) == 0) {
+    *param = url.substr(9);  // "registry_host:port/service"
+    return std::make_unique<RegistryNS>();
+  }
   // Bare "host:port" degenerates to a one-server list.
   *param = url;
   return std::make_unique<ListNS>();
@@ -383,6 +636,18 @@ std::unique_ptr<NamingService> NamingService::create(const std::string& url,
 
 ClusterChannel::~ClusterChannel() {
   stopping_.store(true, std::memory_order_release);
+  if (watcher_started_.load(std::memory_order_acquire)) {
+    // Wake + join the naming watch fiber first (it may be parked inside
+    // a long-poll RPC; its bounded park budget caps this wait).
+    watch_wake_.value.fetch_add(1, std::memory_order_release);
+    watch_wake_.wake_all();
+    while (watch_done_.value.load(std::memory_order_acquire) == 0) {
+      watch_done_.wait(0, -1);
+    }
+    while (!watcher_exited_.load(std::memory_order_acquire)) {
+      sched_yield();
+    }
+  }
   if (refresher_started_.load(std::memory_order_acquire)) {
     // Wake the refresher out of its sleep and wait for it to exit — it
     // holds `this`, so destruction must not race it.
@@ -414,25 +679,52 @@ int ClusterChannel::Init(const std::string& naming_url,
 }
 
 int ClusterChannel::refresh() {
-  std::vector<std::pair<EndPoint, int>> eps;
+  std::vector<NsEntry> eps;
   if (ns_->resolve(ns_param_, &eps) != 0) {
     return -1;
+  }
+  // Deterministic subsetting (fd-budget discipline): rendezvous-hash
+  // every member against this client's seed and keep the top-k.  The
+  // same (seed, member) pair always scores the same, so a member
+  // add/remove perturbs the subset minimally and a plain refresh never
+  // churns connections; different seeds (default: pid) spread the
+  // fleet's clients evenly over the servers.
+  int64_t subset = opts_.subset_size;
+  if (subset == 0) {
+    Flag* f = subset_size_flag();
+    subset = f != nullptr ? f->int64_value() : 0;
+  }
+  if (subset > 0 && eps.size() > static_cast<size_t>(subset)) {
+    // The seed is PRE-mixed: small consecutive seeds (pids) xor'd raw
+    // into an avalanched endpoint hash barely perturb the final mix's
+    // ordering, and every client would elect the same subset.
+    const uint64_t seed = mix_u64(opts_.subset_seed != 0
+                                      ? opts_.subset_seed
+                                      : static_cast<uint64_t>(getpid()));
+    std::stable_sort(eps.begin(), eps.end(),
+                     [seed](const NsEntry& a, const NsEntry& b) {
+                       return mix_u64(seed ^ EndPointHash()(a.ep)) >
+                              mix_u64(seed ^ EndPointHash()(b.ep));
+                     });
+    eps.resize(static_cast<size_t>(subset));
   }
   // Preserve breaker state + channels of endpoints that survive.
   auto fresh = std::make_shared<Cluster>();
   {
     auto cur = cluster_.Read();
     const Cluster* old = cur->get();
-    for (const auto& [ep, weight] : eps) {
+    for (const auto& [ep, weight, zone] : eps) {
       ServerNode node;
       node.ep = ep;
       node.weight = weight;
+      node.zone = zone;
       std::shared_ptr<Channel> ch;
       if (old != nullptr) {
         for (size_t i = 0; i < old->nodes.size(); ++i) {
           if (old->nodes[i].ep == ep) {
             node = old->nodes[i];
-            node.weight = weight;  // refresh may re-weight
+            node.weight = weight;  // refresh may re-weight...
+            node.zone = zone;      // ...and re-label
             ch = old->channels[i];
             break;
           }
@@ -471,7 +763,56 @@ int ClusterChannel::refresh() {
     fiber_init(0);
     fiber_start(nullptr, &ClusterChannel::refresh_fiber, this, 0);
   }
+  // Push-based membership: when the NS can long-poll, a watch fiber
+  // turns registry version bumps into immediate refreshes (the periodic
+  // refresher stays as the poll fallback / health-check cadence).
+  if (ns_->supports_watch()) {
+    expect = false;
+    if (watcher_started_.compare_exchange_strong(expect, true)) {
+      if (fiber_start(nullptr, &ClusterChannel::watch_fiber, this, 0) !=
+          0) {
+        // Spawn failed: keep watcher_started_ TRUE and settle the join
+        // state the destructor waits on.  Resetting the flag would let a
+        // later refresh() (possibly racing the destructor) spawn a
+        // watcher the destructor never joins — push degrades to the
+        // periodic poll instead.
+        watch_done_.value.store(1, std::memory_order_release);
+        watch_done_.wake_all();
+        watcher_exited_.store(true, std::memory_order_release);
+      }
+    }
+  }
   return 0;
+}
+
+void ClusterChannel::watch_fiber(void* arg) {
+  auto* self = static_cast<ClusterChannel*>(arg);
+  uint64_t version = 0;
+  while (!self->stopping_.load(std::memory_order_acquire)) {
+    const uint64_t before = version;
+    // Bounded park budget per round: a change still answers IMMEDIATELY
+    // (the registry wakes the parked handler); the budget only caps how
+    // long the destructor can be stuck behind an idle poll.
+    const int rc = self->ns_->watch(self->ns_param_, &version, 1000);
+    if (self->stopping_.load(std::memory_order_acquire)) {
+      break;
+    }
+    if (rc == 0) {
+      if (version != before) {
+        self->refresh();  // push delivery: apply the delta NOW
+      }
+      continue;
+    }
+    // Registry unreachable (or watch unsupported after all): back off
+    // briefly, interruptibly; the periodic refresher keeps polling.
+    const uint32_t snap =
+        self->watch_wake_.value.load(std::memory_order_acquire);
+    self->watch_wake_.wait(snap, monotonic_time_us() + 500000);
+  }
+  self->watch_done_.value.store(1, std::memory_order_release);
+  self->watch_done_.wake_all();
+  // LAST access to *self (see ~ClusterChannel).
+  self->watcher_exited_.store(true, std::memory_order_release);
 }
 
 void ClusterChannel::set_default_qos(const std::string& tenant,
@@ -539,9 +880,13 @@ void probe_fiber(void* p) {
   // kEOverloaded answer proves the TRANSPORT alive (the shed is QoS
   // policy, not node death), so the node revives and the next real call
   // re-judges it.
+  // kEDraining joins the allowlist for the same reason as kEOverloaded:
+  // a draining node's transport demonstrably works (and its successor
+  // revives on this endpoint), so the breaker may open.
   const bool answered = !cntl.Failed() || cntl.error_code() == ENOENT ||
                         cntl.error_code() == kELimit ||
                         cntl.error_code() == kEOverloaded ||
+                        cntl.error_code() == kEDraining ||
                         cntl.error_code() == ESHUTDOWN;
   if (answered) {
     ctx->quarantined_until->store(0, std::memory_order_relaxed);
@@ -654,15 +999,29 @@ void feed_latency(ServerNode& node, int64_t lat_us) {
 void ClusterChannel::feed_breaker(ServerNode& node, bool success) {
   if (success) {
     node.consecutive_failures->store(0, std::memory_order_relaxed);
+    // Relaxed: advisory backoff state, no ordering carried.
+    node.backoff_ms->store(0, std::memory_order_relaxed);
     return;
   }
-  const int fails =
-      node.consecutive_failures->fetch_add(1, std::memory_order_relaxed) + 1;
-  int64_t quarantine_ms = opts_.quarantine_base_ms;
-  for (int i = 1; i < fails && quarantine_ms < opts_.quarantine_max_ms; ++i) {
-    quarantine_ms *= 2;
+  node.consecutive_failures->fetch_add(1, std::memory_order_relaxed);
+  // Decorrelated jitter (AWS-style: window ~ U[base, min(cap, prev*3)]),
+  // drawn from the FaultActor splitmix64 SIDE stream so a seeded chaos
+  // schedule replays the identical backoff sequence.  Plain doubling
+  // synchronized every client that watched the same node die — they all
+  // re-probed the reviving node in lockstep, re-knocking it over.
+  // Relaxed: advisory backoff state, no ordering carried.
+  const int64_t prev = node.backoff_ms->load(std::memory_order_relaxed);
+  const int64_t base = std::max<int64_t>(opts_.quarantine_base_ms, 1);
+  const int64_t hi = std::min(opts_.quarantine_max_ms,
+                              std::max(prev * 3, base));
+  int64_t quarantine_ms = base;
+  if (hi > base) {
+    quarantine_ms +=
+        static_cast<int64_t>(FaultActor::global().jitter_draw() %
+                             static_cast<uint64_t>(hi - base + 1));
   }
-  quarantine_ms = std::min(quarantine_ms, opts_.quarantine_max_ms);
+  // Relaxed: see above.
+  node.backoff_ms->store(quarantine_ms, std::memory_order_relaxed);
   node.quarantined_until_us->store(monotonic_time_us() + quarantine_ms * 1000,
                                    std::memory_order_relaxed);
 }
@@ -845,6 +1204,12 @@ void ClusterChannel::call_hedged(std::shared_ptr<Cluster> cluster,
         !ctx->done[i].load(std::memory_order_acquire)) {
       continue;
     }
+    if (ctx->cntls[i].Failed() &&
+        ctx->cntls[i].error_code() == kEDraining) {
+      // Graceful leave: the hedge already failed over; quarantining the
+      // endpoint would poison the successor reviving on it.
+      continue;
+    }
     feed_breaker(cluster->nodes[ctx->node_idx[i]], !ctx->cntls[i].Failed());
     if (!ctx->cntls[i].Failed()) {
       feed_latency(cluster->nodes[ctx->node_idx[i]],
@@ -975,12 +1340,23 @@ void ClusterChannel::CallMethod(const std::string& method,
       }
       return;
     }
-    // Exponential quarantine.  kEOverloaded (per-tenant admission shed,
-    // net/qos.h) rides this same path BY DESIGN: the node is alive but
-    // shedding, so the retry moves to a different node immediately (the
-    // tried-set exclusion above never re-picks this one) and the breaker
-    // backs traffic off it until the quarantine window expires or a
-    // health probe answers.
+    // kEDraining (Server::Drain, concurrency_limiter.h) is immediate-
+    // failover-WITHOUT-quarantine: the node is healthy, just leaving —
+    // the tried-set exclusion already moves this call to a different
+    // node, and leaving the breaker closed keeps the endpoint clean for
+    // the hot-restart successor that revives on it.
+    if (cntl->error_code() == kEDraining) {
+      if (last_attempt) {
+        break;
+      }
+      continue;
+    }
+    // Exponential (jittered) quarantine.  kEOverloaded (per-tenant
+    // admission shed, net/qos.h) rides this same path BY DESIGN: the
+    // node is alive but shedding, so the retry moves to a different node
+    // immediately (the tried-set exclusion above never re-picks this
+    // one) and the breaker backs traffic off it until the quarantine
+    // window expires or a health probe answers.
     feed_breaker(node, false);
     if (last_attempt) {
       break;

@@ -29,6 +29,15 @@ struct ServerNode {
   EndPoint ep;
   // Static weight (wrr; parsed from the server list, default 1).
   int weight = 1;
+  // Locality label from the naming view ("" = unknown).  Fed to the
+  // zone-preferring balancer (zone_la): same-zone nodes keep their full
+  // latency-derived share, cross-zone nodes pay a penalty.
+  std::string zone;
+  // Previous quarantine window (ms) for decorrelated backoff jitter
+  // (feed_breaker): each new window draws from [base, min(max, prev*3)]
+  // via the FaultActor splitmix64 side stream.
+  std::shared_ptr<std::atomic<int64_t>> backoff_ms =
+      std::make_shared<std::atomic<int64_t>>(0);
   // Circuit-breaker state.
   std::shared_ptr<std::atomic<int64_t>> quarantined_until_us =
       std::make_shared<std::atomic<int64_t>>(0);
@@ -42,6 +51,11 @@ struct ServerNode {
       std::make_shared<std::atomic<int64_t>>(0);
 };
 
+// Flag registration (idempotent): trpc_cluster_zone,
+// trpc_cluster_chash_load_factor, trpc_cluster_subset_size — the capi
+// calls it so /flags sees the cluster knobs before first traffic.
+void cluster_ensure_registered();
+
 // Shared feedback/selection primitives (the LA balancer and
 // DynamicPartitionChannel use identical smoothing and dice logic).
 //
@@ -53,6 +67,14 @@ int64_t asym_ewma(int64_t prev, int64_t sample);
 // Weighted random pick: index i with probability weights[i]/sum.
 size_t weighted_pick(const int64_t* weights, size_t n);
 
+// One resolved member of a cluster.  zone rides from the naming view
+// (3rd column of list://, file:// rows; the registry's announce field).
+struct NsEntry {
+  EndPoint ep;
+  int weight = 1;
+  std::string zone;
+};
+
 class LoadBalancer {
  public:
   virtual ~LoadBalancer() = default;
@@ -62,17 +84,32 @@ class LoadBalancer {
   virtual size_t select(const std::vector<size_t>& healthy,
                         const std::vector<ServerNode>& nodes, uint64_t key,
                         int attempt) = 0;
-  static LoadBalancer* create(const std::string& name);  // rr|random|c_hash
+  // The balancing-policy seam: rr | random | c_hash | c_hash_bl (bounded
+  // load: trpc_cluster_chash_load_factor) | wrr | p2c | la | zone_la
+  // (locality/weighted-latency preferring this client's
+  // trpc_cluster_zone).
+  static LoadBalancer* create(const std::string& name);
 };
 
 class NamingService {
  public:
   virtual ~NamingService() = default;
-  // Resolves to (endpoint, weight) pairs; weight defaults to 1 and feeds
-  // the wrr/p2c balancers.
+  // Resolves the member set; weight defaults to 1 and feeds the wrr/p2c
+  // balancers, zone feeds zone_la.
   virtual int resolve(const std::string& param,
-                      std::vector<std::pair<EndPoint, int>>* out) = 0;
-  // "list://h1:p1,h2:p2" | "file:///path" | "host:port"
+                      std::vector<NsEntry>* out) = 0;
+  // Push support (long-poll): parks up to park_budget_ms until the
+  // view's version differs from *version, then updates *version.
+  // Returns 0 (answered — the caller re-resolves if the version moved),
+  // -1 when this NS has no push path (the periodic refresher is the
+  // poll fallback), or a positive transport error.
+  virtual int watch(const std::string& /*param*/, uint64_t* /*version*/,
+                    int64_t /*park_budget_ms*/) {
+    return -1;
+  }
+  virtual bool supports_watch() const { return false; }
+  // "list://h1:p1,h2:p2" | "file:///path" | "dns://host:port" |
+  // "naming://registry_host:port/service" (push-based) | "host:port"
   static std::unique_ptr<NamingService> create(const std::string& url,
                                                std::string* param);
 };
@@ -116,6 +153,16 @@ class ClusterChannel {
     // shed status (kEOverloaded) with the failover machinery above.
     std::string qos_tenant;
     uint8_t qos_priority = 0;
+    // Deterministic subsetting: cap how many members THIS client holds
+    // channels to (rendezvous-hash by subset_seed, so the fleet's
+    // clients spread evenly over the servers while each keeps a stable
+    // subset across refreshes).  0 = the trpc_cluster_subset_size flag;
+    // negative = explicitly unlimited.  Mandatory at scale: N clients x
+    // M servers full-mesh is what blows the fd budget.
+    int subset_size = 0;
+    // Seed for the rendezvous hash (0 = derive from pid: every process
+    // lands on a different-but-stable subset).
+    uint64_t subset_seed = 0;
   };
 
   ~ClusterChannel();
@@ -132,7 +179,10 @@ class ClusterChannel {
   // unsynchronized-vs-CallMethod contract.
   void set_default_qos(const std::string& tenant, uint8_t priority);
 
-  // Re-resolves now (also runs periodically in a refresh fiber).
+  // Re-resolves now (also runs periodically in a refresh fiber, and
+  // immediately whenever the naming watch fiber sees a version bump —
+  // push-based membership, no reconnect storm: surviving endpoints keep
+  // their channels and breaker state across every refresh).
   int refresh();
   // Probes quarantined nodes; revives any that answer (runs periodically).
   void health_check();
@@ -144,6 +194,7 @@ class ClusterChannel {
     std::vector<std::shared_ptr<Channel>> channels;  // parallel to nodes
   };
   static void refresh_fiber(void* arg);
+  static void watch_fiber(void* arg);
   void call_hedged(std::shared_ptr<Cluster> cluster, const std::string& method,
                    const IOBuf& request, IOBuf* response, Controller* cntl,
                    uint64_t hash_key);
@@ -166,6 +217,13 @@ class ClusterChannel {
   // Set strictly AFTER the refresher's last touch of this object; the
   // destructor spins on it so it can't free members mid-wake.
   std::atomic<bool> refresher_exited_{false};
+  // Naming watch fiber (push-based membership; only when the NS
+  // supports_watch): long-polls the registry and refreshes on every
+  // version bump.  Same teardown protocol as the refresher.
+  std::atomic<bool> watcher_started_{false};
+  Event watch_wake_;
+  Event watch_done_;
+  std::atomic<bool> watcher_exited_{false};
 };
 
 }  // namespace trpc

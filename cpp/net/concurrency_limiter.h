@@ -27,6 +27,13 @@ constexpr int kELimit = 2004;
 // a node failure (immediate retry on a different node + quarantine
 // backoff), and health probes treat it as proof of life.
 constexpr int kEOverloaded = 2005;
+// Answered by a server that entered graceful drain (Server::Drain): the
+// node is HEALTHY but leaving the fleet.  Distinct from kEOverloaded on
+// purpose — the cluster client fails over immediately like a shed, but
+// does NOT feed the circuit breaker (quarantining a deliberately-leaving
+// node would poison its successor, which revives on the same endpoint
+// moments later after the hot-restart listener handoff).
+constexpr int kEDraining = 2006;
 
 class ConcurrencyLimiter {
  public:

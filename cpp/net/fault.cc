@@ -284,9 +284,22 @@ std::shared_ptr<const FaultSchedule> FaultActor::snapshot() const {
 void FaultActor::reset_counters() {
   counter_.store(0, std::memory_order_relaxed);
   injected_.store(0, std::memory_order_relaxed);
+  jitter_counter_.store(0, std::memory_order_relaxed);
   std::lock_guard<std::mutex> g(log_mu_);
   log_.clear();
   log_head_ = 0;
+}
+
+uint64_t FaultActor::jitter_draw() {
+  // Relaxed: the index only needs uniqueness within the stream; the
+  // (index -> value) mapping is the pure splitmix64 function.
+  const uint64_t i = jitter_counter_.fetch_add(1, std::memory_order_relaxed);
+  auto sched = snapshot();
+  const uint64_t seed = sched != nullptr ? sched->seed : 1;
+  // Offset namespace (~0x6a77) keeps the jitter stream disjoint from the
+  // decision stream even under the same seed and colliding indices.
+  return mix64(seed ^ 0x6a77000000000000ull ^
+               (i + 1) * 0x9e3779b97f4a7c15ull);
 }
 
 FaultDecision FaultActor::decide(FaultPoint point, const EndPoint& peer) {

@@ -174,6 +174,15 @@ class FaultActor {
   // The process-wide transport-plane actor.
   static FaultActor& global();
 
+  // Seed-deterministic SIDE stream for backoff jitter (cluster
+  // quarantine decorrelation): splitmix64(seed, jitter_index++) off a
+  // counter SEPARATE from the decision counter, so drawing jitter never
+  // perturbs which fault index a transport operation lands on — chaos
+  // replays stay byte-identical while the jitter sequence itself replays
+  // under the same seed.  Uses the installed schedule's seed (1 when no
+  // schedule is active).
+  uint64_t jitter_draw();
+
  private:
   std::shared_ptr<const FaultSchedule> snapshot() const;
 
@@ -183,6 +192,7 @@ class FaultActor {
   std::atomic<bool> active_{false};
   std::atomic<uint64_t> counter_{0};
   std::atomic<uint64_t> injected_{0};
+  std::atomic<uint64_t> jitter_counter_{0};
 
   struct LogEntry {
     uint64_t index;

@@ -171,7 +171,8 @@ void KvStore::evict_locked(uint64_t block_id, bool count_var) {
 }
 
 int KvStore::publish(uint64_t block_id, const void* data, size_t len,
-                     int64_t lease_ms, KvBlockMeta* out) {
+                     int64_t lease_ms, KvBlockMeta* out,
+                     uint64_t min_generation) {
   kv_ensure_registered();
   if (data == nullptr || len == 0) {
     return -1;
@@ -220,7 +221,12 @@ int KvStore::publish(uint64_t block_id, const void* data, size_t len,
   }
   Block b;
   b.meta.block_id = block_id;
-  b.meta.generation = tombstones_[block_id] + 1;
+  // min_generation: a hot-restart successor continues the DEAD pid's
+  // sequence (its own tombstones start empty) by flooring at
+  // last-known-gen + 1, so the registry's zombie fence accepts the
+  // takeover and old cached records fail kv-stale into a re-resolve.
+  b.meta.generation =
+      std::max(tombstones_[block_id] + 1, min_generation);
   tombstones_[block_id] = b.meta.generation;
   b.meta.rkey = rkey;
   b.meta.off = off;
@@ -246,6 +252,16 @@ int KvStore::withdraw(uint64_t block_id) {
   }
   evict_locked(block_id, /*count_var=*/true);
   return 0;
+}
+
+size_t KvStore::withdraw_all() {
+  std::lock_guard<std::mutex> g(mu_);
+  size_t n = 0;
+  while (!blocks_.empty()) {
+    evict_locked(blocks_.begin()->first, /*count_var=*/true);
+    ++n;
+  }
+  return n;
 }
 
 int KvStore::renew(uint64_t block_id, int64_t lease_ms) {
@@ -459,6 +475,11 @@ void fail_kv(Controller* cntl, int code, const char* what) {
 
 int kv_attach_store(Server* s) {
   kv_ensure_registered();
+  // Drain hook (Server::Drain, ISSUE 12): tombstone every published
+  // block before the listener handoff — a decode cache holding this
+  // node's records fails kv-stale, invalidates, and re-resolves through
+  // the registry instead of ever fetching from a dying pid.
+  s->add_drain_hook([] { kv_store().withdraw_all(); });
   return s->RegisterMethod(
       kKvFetchMethod, [](Controller* cntl, const IOBuf& req, IOBuf* resp,
                          Closure done) {

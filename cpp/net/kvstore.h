@@ -131,12 +131,22 @@ class KvStore {
   // expired-then-LRU blocks to fit the trpc_kv_store_bytes budget.
   // Returns 0, kEKvExists when the block is live (withdraw first),
   // or -1 (not exportable memory / larger than the whole budget).
+  // min_generation floors the minted generation: a hot-restart
+  // successor (fresh pid, empty tombstones) passes the predecessor's
+  // last registry generation + 1 so its takeover re-publish outranks
+  // every cached record (net/naming.h drain flow).
   int publish(uint64_t block_id, const void* data, size_t len,
-              int64_t lease_ms, KvBlockMeta* out);
+              int64_t lease_ms, KvBlockMeta* out,
+              uint64_t min_generation = 0);
   // Explicit eviction.  The generation survives as a tombstone so a
   // re-publish mints a NEWER generation and stale fetches stay
   // detectable.  Returns 0, or kEKvMiss.
   int withdraw(uint64_t block_id);
+  // Drain support (Server::Drain hook, net/naming.h): withdraws EVERY
+  // live block, tombstoning each generation — a decode cache that still
+  // holds this node's records gets kv-stale (invalidate + re-resolve),
+  // never bytes from a process that is about to die.  Returns the count.
+  size_t withdraw_all();
   // Extends the lease (lease_ms <= 0: the flag default).  0 or kEKvMiss.
   int renew(uint64_t block_id, int64_t lease_ms);
   // Serves one block: validates generation AND lease at serve time,

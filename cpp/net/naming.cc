@@ -1,0 +1,731 @@
+#include "net/naming.h"
+
+#include <string.h>
+
+#include <algorithm>
+
+#include "base/flags.h"
+#include "base/logging.h"
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "net/channel.h"
+#include "net/controller.h"
+#include "net/server.h"
+#include "stat/reducer.h"
+
+namespace trpc {
+
+namespace {
+
+Flag* lease_flag() {
+  static Flag* f = [] {
+    Flag* flag = Flag::define_int64(
+        "trpc_naming_lease_ms", 10000,
+        "default membership lease for announcements that pass "
+        "lease_ms <= 0 (ms, [200, 3600000]); a member whose announcer "
+        "stops renewing falls out of every watcher's view within one "
+        "lease");
+    if (flag != nullptr) {
+      flag->set_validator([](const std::string& v) {
+        char* end = nullptr;
+        const long long n = strtoll(v.c_str(), &end, 10);
+        return end != v.c_str() && *end == '\0' && n >= 200 &&
+               n <= 3600000;
+      });
+    }
+    return flag;
+  }();
+  return f;
+}
+
+Flag* watch_flag() {
+  static Flag* f = [] {
+    Flag* flag = Flag::define_int64(
+        "trpc_naming_watch_ms", 10000,
+        "server-side park budget for one Naming.Watch long-poll round "
+        "(ms, [50, 600000]); a change answers immediately — this only "
+        "caps how long an idle watcher fiber stays parked");
+    if (flag != nullptr) {
+      flag->set_validator([](const std::string& v) {
+        char* end = nullptr;
+        const long long n = strtoll(v.c_str(), &end, 10);
+        return end != v.c_str() && *end == '\0' && n >= 50 && n <= 600000;
+      });
+    }
+    return flag;
+  }();
+  return f;
+}
+
+struct NamingVars {
+  Adder announce_total;
+  Adder withdraw_total;
+  Adder expire_total;
+  Adder watch_wake_total;
+  NamingVars() {
+    announce_total.expose(
+        "naming_announce_total",
+        "membership announcements accepted by the registry on this node "
+        "(new members, epoch takeovers, and lease renewals)");
+    withdraw_total.expose(
+        "naming_withdraw_total",
+        "membership withdrawals accepted by the registry on this node "
+        "(graceful drains and explicit leaves)");
+    expire_total.expose(
+        "naming_expire_total",
+        "members pruned by lease expiry (announcer died or stopped "
+        "renewing) — each one is a membership change watchers see");
+    watch_wake_total.expose(
+        "naming_watch_wake_total",
+        "Naming.Watch long-polls answered because the membership "
+        "version moved (push deliveries, as opposed to idle timeouts)");
+  }
+};
+
+NamingVars& naming_vars() {
+  static NamingVars* v = new NamingVars();
+  return *v;
+}
+
+int64_t effective_lease_us(int64_t lease_ms) {
+  if (lease_ms <= 0) {
+    lease_ms = lease_flag() != nullptr ? lease_flag()->int64_value() : 10000;
+  }
+  return monotonic_time_us() + lease_ms * 1000;
+}
+
+// Withdraw-tombstone TTL: generous vs one in-flight renewal RPC (the
+// race it fences), bounded so addr churn can't grow the map forever.
+int64_t tombstone_expire_us() {
+  const int64_t lease_ms =
+      lease_flag() != nullptr ? lease_flag()->int64_value() : 10000;
+  return monotonic_time_us() +
+         std::max<int64_t>(60000, 4 * lease_ms) * 1000;
+}
+
+void copy_str(char* dst, size_t cap, const std::string& src) {
+  const size_t n = std::min(src.size(), cap - 1);
+  memcpy(dst, src.data(), n);
+  memset(dst + n, 0, cap - n);
+}
+
+std::string wire_str(const char* src, size_t cap) {
+  return std::string(src, strnlen(src, cap));
+}
+
+}  // namespace
+
+void naming_ensure_registered() {
+  lease_flag();
+  watch_flag();
+  naming_vars();
+}
+
+// ---- NamingRegistry -------------------------------------------------------
+
+NamingRegistry& naming_registry() {
+  static NamingRegistry* r = new NamingRegistry();
+  return *r;
+}
+
+NamingRegistry::Service* NamingRegistry::service_locked(
+    const std::string& name) {
+  return &services_[name];
+}
+
+void NamingRegistry::prune_locked(Service* s) {
+  const int64_t now = monotonic_time_us();
+  bool changed = false;
+  for (auto it = s->members.begin(); it != s->members.end();) {
+    if (it->second.deadline_us <= now) {
+      it = s->members.erase(it);
+      changed = true;
+      naming_vars().expire_total << 1;
+    } else {
+      ++it;
+    }
+  }
+  // Expired withdraw tombstones fall out silently (no version bump —
+  // nothing a watcher can observe changes).
+  for (auto it = s->withdrawn_epochs.begin();
+       it != s->withdrawn_epochs.end();) {
+    if (it->second.expire_us <= now) {
+      it = s->withdrawn_epochs.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  if (changed) {
+    ++s->version;
+    // Release: a watcher that observes the bumped event value must see
+    // the membership mutation made above (it re-reads under mu_, but the
+    // event wake itself races the lock-free fast check).
+    s->changed->value.fetch_add(1, std::memory_order_release);
+    s->changed->wake_all();
+  }
+}
+
+int NamingRegistry::announce(const std::string& service,
+                             const NamingMember& m, int64_t lease_ms) {
+  naming_ensure_registered();
+  std::lock_guard<std::mutex> g(mu_);
+  Service* s = service_locked(service);
+  prune_locked(s);
+  auto tomb = s->withdrawn_epochs.find(m.addr);
+  if (tomb != s->withdrawn_epochs.end() && m.epoch <= tomb->second.epoch) {
+    // Zombie-renewal fence: this epoch (or an older one) explicitly
+    // withdrew — a renewal that raced its own Withdraw must not
+    // resurrect the member.  A successor's newer epoch passes (and
+    // clears the tombstone below).
+    return kENamingStaleEpoch;
+  }
+  auto it = s->members.find(m.addr);
+  bool changed = false;
+  if (it == s->members.end()) {
+    changed = true;
+  } else if (m.epoch < it->second.m.epoch) {
+    return kENamingStaleEpoch;  // zombie predecessor of a restarted node
+  } else {
+    // Same epoch = renewal; newer epoch = takeover.  Either way a zone/
+    // weight/epoch difference is a change watchers must see.
+    changed = m.epoch != it->second.m.epoch ||
+              m.weight != it->second.m.weight || m.zone != it->second.m.zone;
+  }
+  if (tomb != s->withdrawn_epochs.end()) {
+    s->withdrawn_epochs.erase(tomb);  // newer epoch: takeover admitted
+  }
+  Member rec;
+  rec.m = m;
+  rec.m.lease_left_ms = 0;
+  rec.deadline_us = effective_lease_us(lease_ms);
+  s->members[m.addr] = std::move(rec);
+  naming_vars().announce_total << 1;
+  if (changed) {
+    ++s->version;
+    // Release: see prune_locked.
+    s->changed->value.fetch_add(1, std::memory_order_release);
+    s->changed->wake_all();
+  }
+  return 0;
+}
+
+int NamingRegistry::withdraw(const std::string& service,
+                             const std::string& addr, uint64_t epoch) {
+  std::lock_guard<std::mutex> g(mu_);
+  Service* s = service_locked(service);
+  prune_locked(s);
+  auto it = s->members.find(addr);
+  if (it == s->members.end()) {
+    // Goal state already holds (idempotent leave) — but still fence the
+    // epoch so an in-flight renewal racing this withdraw cannot
+    // resurrect the member afterwards.
+    Service::Tombstone& t = s->withdrawn_epochs[addr];
+    t.epoch = std::max(t.epoch, epoch);
+    t.expire_us = tombstone_expire_us();
+    return 0;
+  }
+  if (epoch < it->second.m.epoch) {
+    return kENamingStaleEpoch;  // zombie must not unregister the successor
+  }
+  Service::Tombstone& t = s->withdrawn_epochs[addr];
+  t.epoch = std::max(t.epoch, std::max(epoch, it->second.m.epoch));
+  t.expire_us = tombstone_expire_us();
+  s->members.erase(it);
+  naming_vars().withdraw_total << 1;
+  ++s->version;
+  // Release: see prune_locked.
+  s->changed->value.fetch_add(1, std::memory_order_release);
+  s->changed->wake_all();
+  return 0;
+}
+
+int NamingRegistry::resolve(const std::string& service,
+                            std::vector<NamingMember>* out,
+                            uint64_t* version) {
+  std::lock_guard<std::mutex> g(mu_);
+  auto sit = services_.find(service);
+  if (sit == services_.end()) {
+    return kENamingMiss;
+  }
+  Service* s = &sit->second;
+  prune_locked(s);
+  const int64_t now = monotonic_time_us();
+  out->clear();
+  out->reserve(s->members.size());
+  for (const auto& [addr, rec] : s->members) {
+    NamingMember m = rec.m;
+    m.lease_left_ms = (rec.deadline_us - now) / 1000;
+    out->push_back(std::move(m));
+  }
+  // Deterministic order: watchers diff successive views by position-
+  // independent content, but tests and logs read far better sorted.
+  std::sort(out->begin(), out->end(),
+            [](const NamingMember& a, const NamingMember& b) {
+              return a.addr < b.addr;
+            });
+  if (version != nullptr) {
+    *version = s->version;
+  }
+  return 0;
+}
+
+int NamingRegistry::watch(const std::string& service, uint64_t known_version,
+                          int64_t park_budget_ms,
+                          std::vector<NamingMember>* out, uint64_t* version,
+                          const std::function<bool()>& keep_waiting) {
+  const int64_t deadline_us =
+      monotonic_time_us() + std::max<int64_t>(park_budget_ms, 0) * 1000;
+  std::shared_ptr<Event> ev;
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    // Creates the service entry if needed: a watcher of a not-yet-
+    // announced service parks until its first member arrives.  The
+    // shared_ptr co-owns the Event past a concurrent clear().
+    ev = service_locked(service)->changed;
+  }
+  while (true) {
+    uint32_t snap;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      Service* s = service_locked(service);
+      prune_locked(s);
+      if (s->version != known_version) {
+        break;  // changed (or the caller's view was never current)
+      }
+      // Snapshot INSIDE the lock: a bump between this load and wait()
+      // makes wait return EWOULDBLOCK instead of missing the wake.
+      // Acquire pairs with the bump's release.
+      snap = ev->value.load(std::memory_order_acquire);
+    }
+    const int64_t now = monotonic_time_us();
+    if (now >= deadline_us ||
+        (keep_waiting != nullptr && !keep_waiting())) {
+      break;  // idle timeout / host leaving: answer the unchanged view
+    }
+    // Sliced park (<= 250ms per round): the keep_waiting re-check above
+    // bounds how long a parked watcher fiber can stall its host's
+    // Stop()/Join — a change still wakes it immediately.
+    const int64_t slice_us = std::min(deadline_us, now + 250 * 1000);
+    if (ev->wait(snap, slice_us) == 0) {
+      naming_vars().watch_wake_total << 1;
+    }
+  }
+  return resolve(service, out, version);
+}
+
+size_t NamingRegistry::member_count(const std::string& service) {
+  std::lock_guard<std::mutex> g(mu_);
+  auto sit = services_.find(service);
+  if (sit == services_.end()) {
+    return 0;
+  }
+  prune_locked(&sit->second);
+  return sit->second.members.size();
+}
+
+void NamingRegistry::wake_all() {
+  std::lock_guard<std::mutex> g(mu_);
+  for (auto& [name, s] : services_) {
+    // Version bump, not just a wake: the watch loop re-parks on a
+    // spurious wake when the version is unchanged, and a draining host
+    // needs its watcher fibers to ANSWER (they hold in_flight slots the
+    // quiesce wait would otherwise spin on).
+    ++s.version;
+    // Release: parked watchers re-read state under mu_ after waking.
+    s.changed->value.fetch_add(1, std::memory_order_release);
+    s.changed->wake_all();
+  }
+}
+
+void NamingRegistry::clear() {
+  std::lock_guard<std::mutex> g(mu_);
+  for (auto& [name, s] : services_) {
+    ++s.version;  // release parked watchers (see wake_all)
+    s.changed->value.fetch_add(1, std::memory_order_release);
+    s.changed->wake_all();
+  }
+  // Parked watchers still co-own their Event via the shared_ptr they
+  // copied in watch(); dropping the map can never free it under them.
+  services_.clear();
+}
+
+// ---- wire helpers ---------------------------------------------------------
+
+namespace {
+
+bool parse_wire(const IOBuf& req, NamingWire* w) {
+  if (req.size() < sizeof(NamingWire)) {
+    return false;
+  }
+  req.copy_to(w, sizeof(NamingWire));
+  w->service[sizeof(w->service) - 1] = '\0';
+  w->addr[sizeof(w->addr) - 1] = '\0';
+  w->zone[sizeof(w->zone) - 1] = '\0';
+  return true;
+}
+
+void pack_member_row(IOBuf* out, const NamingMember& m) {
+  NamingWire row;
+  memset(&row, 0, sizeof(row));
+  copy_str(row.addr, sizeof(row.addr), m.addr);
+  copy_str(row.zone, sizeof(row.zone), m.zone);
+  row.weight = m.weight;
+  row.epoch = m.epoch;
+  row.lease_ms = m.lease_left_ms;
+  out->append(&row, sizeof(row));
+}
+
+void pack_view(IOBuf* out, const std::vector<NamingMember>& members,
+               uint64_t version) {
+  NamingWire head;
+  memset(&head, 0, sizeof(head));
+  head.version = version;
+  head.weight = static_cast<int32_t>(members.size());
+  out->append(&head, sizeof(head));
+  for (const NamingMember& m : members) {
+    pack_member_row(out, m);
+  }
+}
+
+int unpack_view(const IOBuf& resp, std::vector<NamingMember>* out,
+                uint64_t* version) {
+  if (resp.size() < sizeof(NamingWire)) {
+    return -1;
+  }
+  std::string flat = resp.to_string();
+  const auto* head = reinterpret_cast<const NamingWire*>(flat.data());
+  const size_t count = static_cast<size_t>(std::max(head->weight, 0));
+  if (flat.size() < sizeof(NamingWire) * (count + 1)) {
+    return -1;
+  }
+  out->clear();
+  out->reserve(count);
+  for (size_t i = 1; i <= count; ++i) {
+    const auto* row =
+        reinterpret_cast<const NamingWire*>(flat.data() +
+                                            i * sizeof(NamingWire));
+    NamingMember m;
+    m.addr = wire_str(row->addr, sizeof(row->addr));
+    m.zone = wire_str(row->zone, sizeof(row->zone));
+    m.weight = row->weight;
+    m.epoch = row->epoch;
+    m.lease_left_ms = row->lease_ms;
+    out->push_back(std::move(m));
+  }
+  if (version != nullptr) {
+    *version = head->version;
+  }
+  return 0;
+}
+
+void fail_naming(Controller* cntl, int code, const char* what) {
+  const char* why = code == kENamingStaleEpoch ? "naming-stale-epoch"
+                    : code == kENamingMiss    ? "naming-miss"
+                                              : "naming-error";
+  cntl->SetFailed(code, std::string(why) + ": " + what);
+}
+
+}  // namespace
+
+// ---- native handlers ------------------------------------------------------
+
+int naming_attach(Server* s) {
+  naming_ensure_registered();
+  int rcs[4] = {0, 0, 0, 0};
+  rcs[0] = s->RegisterMethod(
+      kNamingAnnounceMethod, [](Controller* cntl, const IOBuf& req,
+                                IOBuf* resp, Closure done) {
+        NamingWire w;
+        if (!parse_wire(req, &w)) {
+          cntl->SetFailed(EINVAL, "bad Naming.Announce request");
+          done();
+          return;
+        }
+        NamingMember m;
+        m.addr = wire_str(w.addr, sizeof(w.addr));
+        m.zone = wire_str(w.zone, sizeof(w.zone));
+        m.weight = std::max(w.weight, 1);
+        m.epoch = w.epoch;
+        const int rc = naming_registry().announce(
+            wire_str(w.service, sizeof(w.service)), m, w.lease_ms);
+        if (rc != 0) {
+          fail_naming(cntl, rc, "announce");
+        } else {
+          uint64_t ok = 1;
+          resp->append(&ok, sizeof(ok));
+        }
+        done();
+      });
+  rcs[1] = s->RegisterMethod(
+      kNamingWithdrawMethod, [](Controller* cntl, const IOBuf& req,
+                                IOBuf* resp, Closure done) {
+        NamingWire w;
+        if (!parse_wire(req, &w)) {
+          cntl->SetFailed(EINVAL, "bad Naming.Withdraw request");
+          done();
+          return;
+        }
+        const int rc = naming_registry().withdraw(
+            wire_str(w.service, sizeof(w.service)),
+            wire_str(w.addr, sizeof(w.addr)), w.epoch);
+        if (rc != 0) {
+          fail_naming(cntl, rc, "withdraw");
+        } else {
+          uint64_t ok = 1;
+          resp->append(&ok, sizeof(ok));
+        }
+        done();
+      });
+  rcs[2] = s->RegisterMethod(
+      kNamingResolveMethod, [](Controller* cntl, const IOBuf& req,
+                               IOBuf* resp, Closure done) {
+        NamingWire w;
+        if (!parse_wire(req, &w)) {
+          cntl->SetFailed(EINVAL, "bad Naming.Resolve request");
+          done();
+          return;
+        }
+        std::vector<NamingMember> members;
+        uint64_t version = 0;
+        const int rc = naming_registry().resolve(
+            wire_str(w.service, sizeof(w.service)), &members, &version);
+        if (rc != 0) {
+          fail_naming(cntl, rc, "resolve");
+        } else {
+          pack_view(resp, members, version);
+        }
+        done();
+      });
+  rcs[3] = s->RegisterMethod(
+      kNamingWatchMethod, [s](Controller* cntl, const IOBuf& req,
+                              IOBuf* resp, Closure done) {
+        NamingWire w;
+        if (!parse_wire(req, &w)) {
+          cntl->SetFailed(EINVAL, "bad Naming.Watch request");
+          done();
+          return;
+        }
+        // Park budget: the smaller of the caller's ask and the server's
+        // cap — a malicious/buggy client must not pin handler fibers.
+        int64_t budget =
+            watch_flag() != nullptr ? watch_flag()->int64_value() : 10000;
+        if (w.lease_ms > 0) {
+          budget = std::min(budget, w.lease_ms);
+        }
+        std::vector<NamingMember> members;
+        uint64_t version = 0;
+        // keep_waiting: a parked watcher holds one of the HOST server's
+        // in_flight slots — answer early the moment the host stops or
+        // drains, instead of stalling its Join through the park budget.
+        const int rc = naming_registry().watch(
+            wire_str(w.service, sizeof(w.service)), w.version, budget,
+            &members, &version,
+            [s] { return s->running() && !s->draining(); });
+        if (rc != 0 && rc != kENamingMiss) {
+          fail_naming(cntl, rc, "watch");
+        } else {
+          // kENamingMiss after a full park = still no members; answer an
+          // empty view so the watcher's loop stays cheap and uniform.
+          pack_view(resp, members, version);
+        }
+        done();
+      });
+  s->add_drain_hook([] { naming_registry().wake_all(); });
+  return rcs[0] == 0 && rcs[1] == 0 && rcs[2] == 0 && rcs[3] == 0 ? 0 : -1;
+}
+
+// ---- client helpers -------------------------------------------------------
+
+namespace {
+
+// One naming RPC round-trip; 0 or the call's error code.
+int naming_call(Channel* ch, const char* method, const NamingWire& w,
+                IOBuf* resp, int64_t timeout_ms = 0) {
+  IOBuf req;
+  req.append(&w, sizeof(w));
+  Controller cntl;
+  if (timeout_ms > 0) {
+    cntl.set_timeout_ms(timeout_ms);
+  }
+  ch->CallMethod(method, req, resp, &cntl);
+  if (cntl.Failed()) {
+    return cntl.error_code() != 0 ? cntl.error_code() : -1;
+  }
+  return 0;
+}
+
+}  // namespace
+
+int naming_announce(Channel* ch, const std::string& service,
+                    const NamingMember& m, int64_t lease_ms) {
+  NamingWire w;
+  memset(&w, 0, sizeof(w));
+  copy_str(w.service, sizeof(w.service), service);
+  copy_str(w.addr, sizeof(w.addr), m.addr);
+  copy_str(w.zone, sizeof(w.zone), m.zone);
+  w.weight = m.weight;
+  w.epoch = m.epoch;
+  w.lease_ms = lease_ms;
+  IOBuf resp;
+  return naming_call(ch, kNamingAnnounceMethod, w, &resp);
+}
+
+int naming_withdraw(Channel* ch, const std::string& service,
+                    const std::string& addr, uint64_t epoch) {
+  NamingWire w;
+  memset(&w, 0, sizeof(w));
+  copy_str(w.service, sizeof(w.service), service);
+  copy_str(w.addr, sizeof(w.addr), addr);
+  w.epoch = epoch;
+  IOBuf resp;
+  return naming_call(ch, kNamingWithdrawMethod, w, &resp);
+}
+
+int naming_resolve(Channel* ch, const std::string& service,
+                   std::vector<NamingMember>* out, uint64_t* version) {
+  NamingWire w;
+  memset(&w, 0, sizeof(w));
+  copy_str(w.service, sizeof(w.service), service);
+  IOBuf resp;
+  const int rc = naming_call(ch, kNamingResolveMethod, w, &resp);
+  if (rc != 0) {
+    return rc;
+  }
+  return unpack_view(resp, out, version);
+}
+
+int naming_watch(Channel* ch, const std::string& service,
+                 std::vector<NamingMember>* out, uint64_t* version,
+                 int64_t park_budget_ms, int64_t timeout_ms) {
+  NamingWire w;
+  memset(&w, 0, sizeof(w));
+  copy_str(w.service, sizeof(w.service), service);
+  w.version = version != nullptr ? *version : 0;
+  w.lease_ms = park_budget_ms;
+  IOBuf resp;
+  const int rc = naming_call(ch, kNamingWatchMethod, w, &resp, timeout_ms);
+  if (rc != 0) {
+    return rc;
+  }
+  return unpack_view(resp, out, version);
+}
+
+// ---- Announcer ------------------------------------------------------------
+
+Announcer::~Announcer() {
+  Withdraw();
+  stopping_.store(true, std::memory_order_release);
+  if (renewer_started_.load(std::memory_order_acquire)) {
+    renew_wake_.value.fetch_add(1, std::memory_order_release);
+    renew_wake_.wake_all();
+    while (renew_done_.value.load(std::memory_order_acquire) == 0) {
+      renew_done_.wait(0, -1);
+    }
+    // Same teardown fence as ~ClusterChannel: the wake that satisfied us
+    // may still be inside wake_all touching the Event.
+    while (!renewer_exited_.load(std::memory_order_acquire)) {
+      sched_yield();
+    }
+  }
+}
+
+int Announcer::Start(const std::string& registry_addr,
+                     const std::string& service,
+                     const std::string& self_addr, const std::string& zone,
+                     int weight, uint64_t epoch) {
+  naming_ensure_registered();
+  ch_ = std::make_unique<Channel>();
+  Channel::Options opts;
+  opts.timeout_ms = 2000;
+  if (ch_->Init(registry_addr, &opts) != 0) {
+    ch_.reset();
+    return -1;
+  }
+  service_ = service;
+  self_addr_ = self_addr;
+  zone_ = zone;
+  weight_ = std::max(weight, 1);
+  // Realtime µs: strictly newer across restarts of the same endpoint
+  // (monotonic clocks restart at boot-relative values per process).
+  epoch_ = epoch != 0 ? epoch : static_cast<uint64_t>(realtime_us());
+  NamingMember m;
+  m.addr = self_addr_;
+  m.zone = zone_;
+  m.weight = weight_;
+  m.epoch = epoch_;
+  if (naming_announce(ch_.get(), service_, m, 0) != 0) {
+    ch_.reset();
+    return -1;
+  }
+  bool expect = false;
+  if (renewer_started_.compare_exchange_strong(expect, true)) {
+    fiber_init(0);
+    if (fiber_start(nullptr, &Announcer::renew_fiber, this, 0) != 0) {
+      renewer_started_.store(false, std::memory_order_release);
+    }
+  }
+  return 0;
+}
+
+void Announcer::Withdraw() {
+  if (withdrawn_.exchange(true)) {
+    return;
+  }
+  if (ch_ != nullptr) {
+    naming_withdraw(ch_.get(), service_, self_addr_, epoch_);
+  }
+}
+
+void Announcer::renew_fiber(void* arg) {
+  auto* self = static_cast<Announcer*>(arg);
+  const int64_t lease_ms =
+      lease_flag() != nullptr ? lease_flag()->int64_value() : 10000;
+  while (!self->stopping_.load(std::memory_order_acquire)) {
+    // Renew at lease/3 so two consecutive drops still keep us alive.
+    const uint32_t snap =
+        self->renew_wake_.value.load(std::memory_order_acquire);
+    self->renew_wake_.wait(
+        snap, monotonic_time_us() + std::max<int64_t>(lease_ms / 3, 100) *
+                                        1000);
+    if (self->stopping_.load(std::memory_order_acquire) ||
+        self->withdrawn_.load(std::memory_order_acquire)) {
+      break;
+    }
+    NamingMember m;
+    m.addr = self->self_addr_;
+    m.zone = self->zone_;
+    m.weight = self->weight_;
+    m.epoch = self->epoch_;
+    const int rc = naming_announce(self->ch_.get(), self->service_, m, 0);
+    if (rc == kENamingStaleEpoch) {
+      // A successor announced a newer epoch on our addr: we are the
+      // zombie — stop renewing instead of fighting the takeover.
+      break;
+    }
+  }
+  self->renew_done_.value.store(1, std::memory_order_release);
+  self->renew_done_.wake_all();
+  // LAST access to *self (see ~Announcer).
+  self->renewer_exited_.store(true, std::memory_order_release);
+}
+
+int server_announce(Server* srv, const std::string& registry_addr,
+                    const std::string& service, const std::string& zone,
+                    int weight) {
+  if (srv == nullptr || !srv->running() || srv->port() <= 0) {
+    return -1;
+  }
+  auto a = std::make_shared<Announcer>();
+  const std::string self_addr =
+      "127.0.0.1:" + std::to_string(srv->port());
+  if (a->Start(registry_addr, service, self_addr, zone, weight) != 0) {
+    return -1;
+  }
+  // Withdraw FIRST in the drain sequence (hooks run before the in-flight
+  // wait): watchers re-balance away while remaining work completes.
+  srv->add_drain_hook([a] { a->Withdraw(); });
+  srv->own_component(a);
+  return 0;
+}
+
+}  // namespace trpc

@@ -1,0 +1,250 @@
+// Cluster naming service — push-based membership over the RPC plane
+// (ISSUE 12 tentpole).
+//
+// Parity: brpc's NamingService push model (naming_service.h:45-56 —
+// actions->ResetServers pushed from a watcher thread) and its
+// NamingServiceThread sharing, grown past the reference: where brpc only
+// CONSUMES external naming systems (BNS, consul, nacos), this registry
+// IS one — any Server can host it, nodes announce themselves under the
+// same lease semantics as the KV registry (net/kvstore.h: expired =
+// gone, epoch-checked re-announce), and clients receive push-based
+// membership deltas through a parked Watch RPC (long-poll over the
+// existing request path; plain Resolve is the poll fallback), feeding
+// ClusterChannel so adds/removals/weight changes apply without
+// reconnect storms.
+//
+// Model:
+//  - NamingRegistry (process-global `naming_registry()`): service name →
+//    member set.  Each member {addr, zone, weight, epoch} holds a lease;
+//    expired members prune lazily on any read and count as a membership
+//    change.  EPOCH rules (the zombie fence): a re-announce with the
+//    recorded epoch renews the lease; a NEWER epoch replaces the member
+//    (restarted process); an OLDER one is rejected kENamingStaleEpoch —
+//    a zombie predecessor can never shadow its successor.
+//  - Every mutation bumps the service VERSION and wakes parked watchers;
+//    pure lease renewals do not (watchers would spin on heartbeats).
+//  - `naming_attach(Server*)` serves Naming.{Announce,Withdraw,Resolve,
+//    Watch}.  Watch parks its handler fiber (bounded by the smaller of
+//    the caller's budget and trpc_naming_watch_ms) until the version
+//    moves, then answers the full member list — deltas are computed
+//    client-side against the previous view, which makes the wire
+//    idempotent and loss-tolerant (a missed wake only costs latency,
+//    never correctness).
+//  - Announcer: the server-side self-registration helper.  Announces
+//    {addr, zone, weight} under a fresh epoch (realtime µs — strictly
+//    newer across restarts of the same endpoint), renews at lease/3
+//    from a private fiber, and withdraws on Server::Drain (hook) or
+//    destruction.
+//
+// Drain + hot restart (net/server.h Drain/StartFromHandoff) composes
+// with this: a draining node withdraws its announcement FIRST (watchers
+// re-balance away immediately), answers kEDraining while in-flight work
+// completes, then hands its SO_REUSEPORT listener set to the successor,
+// which announces the same addr under a newer epoch.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "fiber/event.h"
+
+namespace trpc {
+
+class Channel;
+class Server;
+
+// Error codes, continuing the 2004..2103 family (concurrency_limiter.h,
+// kvstore.h).  kENamingStaleEpoch: an announce/withdraw carried an epoch
+// OLDER than the recorded member's — the caller is a zombie predecessor
+// of a restarted node and must not touch the record.
+constexpr int kENamingStaleEpoch = 2111;
+constexpr int kENamingMiss = 2112;  // unknown service (resolve/watch)
+
+// Method names (tstd, served by naming_attach).
+inline constexpr const char* kNamingAnnounceMethod = "Naming.Announce";
+inline constexpr const char* kNamingWithdrawMethod = "Naming.Withdraw";
+inline constexpr const char* kNamingResolveMethod = "Naming.Resolve";
+inline constexpr const char* kNamingWatchMethod = "Naming.Watch";
+
+// One member of a named service (also the resolve/watch response row).
+struct NamingMember {
+  std::string addr;  // "host:port"
+  std::string zone;  // locality label ("" = unknown)
+  int32_t weight = 1;
+  uint64_t epoch = 0;
+  int64_t lease_left_ms = 0;  // response-only
+};
+
+// Wire form shared by every Naming RPC (fixed little-endian, 176 bytes;
+// mirrored by brpc_tpu/rpc/naming.py _WIRE — naming-wire marker):
+//   Announce: service+addr+zone+weight+epoch+lease_ms
+//   Withdraw: service+addr+epoch
+//   Resolve:  service
+//   Watch:    service + version (the caller's known version) + lease_ms
+//             reused as the park budget in ms
+// Resolve/Watch RESPONSE: one NamingWire header whose version is the
+// current version and weight is the member count, followed by count
+// member rows (addr/zone/weight/epoch filled, lease_ms = remaining).
+struct NamingWire {
+  char service[64];
+  char addr[64];
+  char zone[16];
+  int32_t weight;
+  uint32_t reserved;
+  uint64_t epoch;
+  int64_t lease_ms;
+  uint64_t version;
+};
+static_assert(sizeof(NamingWire) == 176, "NamingWire is wire format");
+
+// ---- registry (any node can host it) -------------------------------------
+
+class NamingRegistry {
+ public:
+  // Upserts (service, addr).  Epoch rules above; lease_ms <= 0 uses
+  // trpc_naming_lease_ms.  Returns 0, or kENamingStaleEpoch.
+  int announce(const std::string& service, const NamingMember& m,
+               int64_t lease_ms);
+  // Removes (service, addr) when `epoch` >= the recorded member's.
+  // Idempotent: an unknown member answers 0 (the caller's goal state —
+  // "I am not a member" — already holds).  kENamingStaleEpoch when a
+  // LIVE record holds a newer epoch (zombie withdraw must not unregister
+  // the successor).
+  int withdraw(const std::string& service, const std::string& addr,
+               uint64_t epoch);
+  // Fills *out (pruning expired members) and *version.  kENamingMiss for
+  // a service with no live members and no history.
+  int resolve(const std::string& service, std::vector<NamingMember>* out,
+              uint64_t* version);
+  // Parks the CALLING fiber until the service's version != known_version
+  // (or park_budget_ms passes), then resolves.  Returns resolve()'s
+  // result; *version always reflects the answered view.  An unknown
+  // service parks too (the first announce is exactly the change a
+  // watcher is waiting for).  `keep_waiting` (nullable) is re-checked
+  // every park slice (<= ~250ms): when it turns false the watch answers
+  // early — the Naming.Watch handler passes the host server's
+  // running-and-not-draining state so a parked watcher fiber can never
+  // stall a plain Stop()/Join through its park budget.
+  int watch(const std::string& service, uint64_t known_version,
+            int64_t park_budget_ms, std::vector<NamingMember>* out,
+            uint64_t* version,
+            const std::function<bool()>& keep_waiting = nullptr);
+
+  size_t member_count(const std::string& service);
+  // RELEASES every parked watcher (drain hook: a draining registry host
+  // must not hold watcher fibers through its in-flight wait).  Bumps
+  // each service's version so the watch loop answers instead of
+  // re-parking; clients see a spurious no-delta refresh, which is
+  // idempotent.
+  void wake_all();
+  void clear();  // tests
+
+ private:
+  struct Member {
+    NamingMember m;
+    int64_t deadline_us = 0;
+  };
+  struct Service {
+    std::unordered_map<std::string, Member> members;  // by addr
+    // Highest explicitly-WITHDRAWN epoch per addr (the zombie-renewal
+    // fence): a late in-flight renewal racing its own Withdraw must not
+    // resurrect the member, so an announce at or below this epoch is
+    // rejected.  A successor's newer epoch passes.  Lease EXPIRY does
+    // not tombstone — a partitioned node that heals may legitimately
+    // re-announce its live epoch.  TTL-bounded (max(60s, 4 leases),
+    // pruned with the members): the fence only needs to outlive an
+    // in-flight renewal RPC, and ephemeral-port churn on a long-lived
+    // registry must not grow this map forever.
+    struct Tombstone {
+      uint64_t epoch = 0;
+      int64_t expire_us = 0;
+    };
+    std::unordered_map<std::string, Tombstone> withdrawn_epochs;
+    uint64_t version = 1;
+    // Watchers park here; every version bump increments value + wakes.
+    // shared_ptr: a parked watcher co-owns the Event, so clear() while
+    // a Watch long-poll is in flight can never free it underneath.
+    std::shared_ptr<Event> changed = std::make_shared<Event>();
+  };
+  // Prunes expired members of s (bumping version if any fell); mu_ held.
+  void prune_locked(Service* s);
+  Service* service_locked(const std::string& name);
+  std::mutex mu_;
+  std::unordered_map<std::string, Service> services_;
+};
+NamingRegistry& naming_registry();
+
+// Attaches the native handlers (call before Server::Start).  Also
+// registers a drain hook that wakes parked watchers.  Returns 0, or -1
+// when any registration was refused (server already running).
+int naming_attach(Server* s);
+
+// ---- client-side RPC helpers (shared by Announcer / RegistryNS) ----------
+
+// One announce round-trip over `ch`.  0, kENamingStaleEpoch, or the
+// transport error.
+int naming_announce(Channel* ch, const std::string& service,
+                    const NamingMember& m, int64_t lease_ms);
+int naming_withdraw(Channel* ch, const std::string& service,
+                    const std::string& addr, uint64_t epoch);
+int naming_resolve(Channel* ch, const std::string& service,
+                   std::vector<NamingMember>* out, uint64_t* version);
+// Long-poll: answers when the registry's version != *version (or after
+// its park budget).  Updates *version to the answered view's.
+int naming_watch(Channel* ch, const std::string& service,
+                 std::vector<NamingMember>* out, uint64_t* version,
+                 int64_t park_budget_ms, int64_t timeout_ms);
+
+// ---- Announcer (server-side self-registration) ---------------------------
+
+class Announcer {
+ public:
+  ~Announcer();  // withdraws + joins the renew fiber
+  // Announces `self_addr` into `service` at the registry and starts the
+  // renew fiber.  Epoch defaults to realtime µs (0 = mint one).
+  // Returns 0, or -1 (channel init / first announce failed).
+  int Start(const std::string& registry_addr, const std::string& service,
+            const std::string& self_addr, const std::string& zone,
+            int weight, uint64_t epoch = 0);
+  // Withdraws the announcement and stops renewing (idempotent; the
+  // Server::Drain hook calls this FIRST so watchers re-balance before
+  // in-flight work drains).
+  void Withdraw();
+  uint64_t epoch() const { return epoch_; }
+  const std::string& self_addr() const { return self_addr_; }
+
+ private:
+  static void renew_fiber(void* arg);
+  std::unique_ptr<Channel> ch_;
+  std::string service_;
+  std::string self_addr_;
+  std::string zone_;
+  int weight_ = 1;
+  uint64_t epoch_ = 0;
+  std::atomic<bool> withdrawn_{false};
+  std::atomic<bool> stopping_{false};
+  std::atomic<bool> renewer_started_{false};
+  Event renew_wake_;
+  Event renew_done_;
+  std::atomic<bool> renewer_exited_{false};
+};
+
+// Creates an Announcer for `srv` (must be started; uses its port),
+// announces "127.0.0.1:<port>" and wires Withdraw into the server's
+// drain hooks; the server owns the announcer for its lifetime.  Returns
+// 0, or -1.
+int server_announce(Server* srv, const std::string& registry_addr,
+                    const std::string& service, const std::string& zone,
+                    int weight);
+
+// Flag registration (idempotent): trpc_naming_lease_ms,
+// trpc_naming_watch_ms.
+void naming_ensure_registered();
+
+}  // namespace trpc

@@ -880,6 +880,21 @@ size_t rma_region_count() {
   return regions().size();
 }
 
+size_t rma_spans_in_use() {
+  std::lock_guard<std::mutex> g(reg_mu());
+  size_t n = 0;
+  for (const RegionRec& r : regions()) {
+    if (!r.window || r.map == nullptr) {
+      continue;
+    }
+    // Acquire: pairs with the peer's CAS claim so a span counted here
+    // was fully published before we read the bitmap.
+    n += static_cast<size_t>(__builtin_popcountll(
+        hdr_of(r.map)->slot_map.load(std::memory_order_acquire)));
+  }
+  return n;
+}
+
 // The one authoritative exportable-region scan: rma_exportable is a
 // thin boolean wrapper over it.
 std::shared_ptr<RmaMapping> rma_pin_exportable(const void* buf, size_t len,

@@ -120,6 +120,13 @@ bool rma_exportable(const void* buf, size_t len, uint64_t* rkey,
                     uint64_t* off);
 // Live regions (tests, /vars).
 size_t rma_region_count();
+// Window spans currently ALLOCATED across this process's receive
+// windows (set bits in the shared slot bitmaps).  A peer's in-flight
+// one-sided put holds its span until the payload's last IOBuf reference
+// drops, so Server::Drain polls this to zero before tearing the process
+// down — handing the listeners off while a span is live would let the
+// successor's client observe a half-written window.
+size_t rma_spans_in_use();
 // Co-owning reference to the exportable region containing [buf, buf+len)
 // (net/kvstore.h serves KV-block bytes zero-copy out of registered
 // pages; the returned mapping refcount defers rma_free's munmap past

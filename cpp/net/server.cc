@@ -57,6 +57,10 @@ Server::~Server() {
     }
   }
   Join();
+  // Owned components (announcers etc.) die only after every in-flight
+  // handler finished — their drain hooks may reference them.
+  std::lock_guard<std::mutex> g(drain_mu_);
+  components_.clear();
 }
 
 namespace {
@@ -271,12 +275,9 @@ int Server::install_listener(int fd, int shard) {
 void expose_default_variables();  // stat/default_variables.cc
 void expose_hotpath_variables();  // net/hotpath_stats.cc
 
-int Server::Start(int port) {
+void Server::start_runtime_init() {
   fiber_init(0);
   if (worker_tag_ != 0) {
-    if (worker_tag_ < 0 || worker_tag_ >= kMaxFiberTags) {
-      return -1;
-    }
     fiber_start_tag_workers(worker_tag_, 0);  // default size if not sized
   }
   expose_default_variables();
@@ -369,6 +370,14 @@ int Server::Start(int port) {
                                    conn, &messenger_on_readable, srv, sid)
                              : -1;
                 });
+}
+
+int Server::Start(int port) {
+  if (worker_tag_ != 0 &&
+      (worker_tag_ < 0 || worker_tag_ >= kMaxFiberTags)) {
+    return -1;
+  }
+  start_runtime_init();
   int fd;
   if (!unix_path_.empty()) {
     EndPoint uep;
@@ -499,10 +508,7 @@ int Server::StartUnix(const std::string& path) {
   return rc;
 }
 
-void Server::Stop() {
-  if (!running_.exchange(false)) {
-    return;
-  }
+void Server::fail_listeners() {
   Socket* s = Socket::Address(listen_id_);
   if (s != nullptr) {
     s->SetFailed(ESHUTDOWN);
@@ -515,6 +521,13 @@ void Server::Stop() {
       shard->Dereference();
     }
   }
+}
+
+void Server::Stop() {
+  if (!running_.exchange(false)) {
+    return;
+  }
+  fail_listeners();
   if (!unix_path_.empty()) {
     ::unlink(unix_path_.c_str());
   }
@@ -530,6 +543,249 @@ void Server::Stop() {
     }
   }
   conns_.clear();
+}
+
+namespace {
+
+Flag* drain_deadline_flag() {
+  static Flag* f = [] {
+    Flag* flag = Flag::define_int64(
+        "trpc_drain_deadline_ms", 5000,
+        "default Server::Drain quiesce budget (ms, [100, 600000]): how "
+        "long a draining node waits for in-flight requests and RMA "
+        "window spans before giving up (ETIMEDOUT) and proceeding with "
+        "shutdown anyway");
+    if (flag != nullptr) {
+      flag->set_validator([](const std::string& v) {
+        char* end = nullptr;
+        const long long n = strtoll(v.c_str(), &end, 10);
+        return end != v.c_str() && *end == '\0' && n >= 100 &&
+               n <= 600000;
+      });
+    }
+    return flag;
+  }();
+  return f;
+}
+
+}  // namespace
+
+void Server::drain_ensure_registered() { drain_deadline_flag(); }
+
+void Server::add_drain_hook(std::function<void()> hook) {
+  std::lock_guard<std::mutex> g(drain_mu_);
+  drain_hooks_.push_back(std::move(hook));
+}
+
+void Server::own_component(std::shared_ptr<void> c) {
+  std::lock_guard<std::mutex> g(drain_mu_);
+  components_.push_back(std::move(c));
+}
+
+int Server::Drain(int64_t deadline_ms, const std::string& handoff_path) {
+  if (!running()) {
+    return -1;
+  }
+  if (deadline_ms <= 0) {
+    Flag* f = drain_deadline_flag();
+    deadline_ms = f != nullptr ? f->int64_value() : 5000;
+  }
+  const int64_t deadline_us = monotonic_time_us() + deadline_ms * 1000;
+  draining_.store(true, std::memory_order_release);
+  // 1. Leave the fleet: naming withdrawal, KV-block tombstoning, watcher
+  // wakeups.  Hooks run OUTSIDE drain_mu_ (a hook may add components).
+  std::vector<std::function<void()>> hooks;
+  {
+    std::lock_guard<std::mutex> g(drain_mu_);
+    hooks = drain_hooks_;
+  }
+  for (const auto& hook : hooks) {
+    hook();
+  }
+  // 2. Hand the SO_REUSEPORT listener set to the successor BEFORE
+  // closing our own fds: the shared accept queues stay owned throughout,
+  // so no SYN is refused across the restart.  A handoff failure (no
+  // successor showed up inside the deadline) degrades to a plain drain.
+  if (!handoff_path.empty()) {
+    if (serve_handoff(handoff_path, deadline_us) != 0) {
+      LOG(Warning) << "drain: listener handoff on " << handoff_path
+                   << " failed; draining without a successor";
+    }
+  }
+  fail_listeners();
+  // 3. Quiesce: every in-flight request completed AND every peer-held
+  // RMA window span freed (a span outlives its request until the
+  // payload's last IOBuf reference drops).
+  while (in_flight.load(std::memory_order_acquire) > 0 ||
+         rma_spans_in_use() > 0) {
+    if (monotonic_time_us() >= deadline_us) {
+      return ETIMEDOUT;
+    }
+    if (in_fiber()) {
+      fiber_sleep_us(1000);
+    } else {
+      usleep(1000);
+    }
+  }
+  return 0;
+}
+
+int Server::serve_handoff(const std::string& path, int64_t deadline_us) {
+  if (path.empty() || path.size() >= sizeof(sockaddr_un{}.sun_path)) {
+    return -1;
+  }
+  // Dup every listener fd: the dup shares the open file description (and
+  // its accept queue), so the successor's copies keep working after we
+  // fail our Socket objects (which close the originals).
+  std::vector<int> fds;
+  const auto grab = [&fds](SocketId id) {
+    Socket* s = Socket::Address(id);
+    if (s != nullptr) {
+      const int d = ::dup(s->fd());
+      if (d >= 0) {
+        fds.push_back(d);
+      }
+      s->Dereference();
+    }
+  };
+  grab(listen_id_);
+  for (SocketId id : extra_listen_ids_) {
+    grab(id);
+  }
+  const auto fail = [&fds](int lfd, const std::string& p) {
+    for (int fd : fds) {
+      close(fd);
+    }
+    if (lfd >= 0) {
+      close(lfd);
+      ::unlink(p.c_str());
+    }
+    return -1;
+  };
+  if (fds.empty()) {
+    return fail(-1, path);
+  }
+  sockaddr_un su = {};
+  su.sun_family = AF_UNIX;
+  memcpy(su.sun_path, path.c_str(), path.size() + 1);
+  ::unlink(path.c_str());
+  const int lfd = ::socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (lfd < 0 ||
+      bind(lfd, reinterpret_cast<sockaddr*>(&su), sizeof(su)) != 0 ||
+      listen(lfd, 1) != 0) {
+    return fail(lfd, path);
+  }
+  int cfd = -1;
+  while (monotonic_time_us() < deadline_us) {
+    cfd = ::accept(lfd, nullptr, nullptr);
+    if (cfd >= 0) {
+      break;
+    }
+    usleep(10000);
+  }
+  if (cfd < 0) {
+    return fail(lfd, path);
+  }
+  // {port, nfds} + every fd in ONE SCM_RIGHTS control block.
+  int32_t head[2] = {static_cast<int32_t>(port_),
+                     static_cast<int32_t>(fds.size())};
+  iovec iov = {head, sizeof(head)};
+  char cbuf[CMSG_SPACE(sizeof(int) * kMaxAcceptShards)] = {};
+  msghdr msg = {};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  msg.msg_control = cbuf;
+  msg.msg_controllen = CMSG_SPACE(sizeof(int) * fds.size());
+  cmsghdr* cm = CMSG_FIRSTHDR(&msg);
+  cm->cmsg_level = SOL_SOCKET;
+  cm->cmsg_type = SCM_RIGHTS;
+  cm->cmsg_len = CMSG_LEN(sizeof(int) * fds.size());
+  memcpy(CMSG_DATA(cm), fds.data(), sizeof(int) * fds.size());
+  const ssize_t sent = ::sendmsg(cfd, &msg, 0);
+  close(cfd);
+  const int rc = sent == static_cast<ssize_t>(sizeof(head)) ? 0 : -1;
+  fail(lfd, path);  // close OUR dups + the handoff listener either way
+  return rc;
+}
+
+int Server::StartFromHandoff(const std::string& path, int64_t timeout_ms) {
+  if (running() || path.empty() ||
+      path.size() >= sizeof(sockaddr_un{}.sun_path)) {
+    return -1;
+  }
+  if (worker_tag_ != 0 &&
+      (worker_tag_ < 0 || worker_tag_ >= kMaxFiberTags)) {
+    return -1;
+  }
+  const int64_t deadline_us = monotonic_time_us() + timeout_ms * 1000;
+  sockaddr_un su = {};
+  su.sun_family = AF_UNIX;
+  memcpy(su.sun_path, path.c_str(), path.size() + 1);
+  int cfd = -1;
+  // Retry until the predecessor starts serving the handoff: the two
+  // processes race by design (the successor is launched first so the
+  // drain window stays minimal).
+  while (monotonic_time_us() < deadline_us) {
+    cfd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+    if (cfd < 0) {
+      return -1;
+    }
+    if (::connect(cfd, reinterpret_cast<sockaddr*>(&su), sizeof(su)) == 0) {
+      break;
+    }
+    close(cfd);
+    cfd = -1;
+    usleep(20000);
+  }
+  if (cfd < 0) {
+    return -1;
+  }
+  int32_t head[2] = {0, 0};
+  iovec iov = {head, sizeof(head)};
+  char cbuf[CMSG_SPACE(sizeof(int) * kMaxAcceptShards)] = {};
+  msghdr msg = {};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  msg.msg_control = cbuf;
+  msg.msg_controllen = sizeof(cbuf);
+  const ssize_t got = ::recvmsg(cfd, &msg, MSG_CMSG_CLOEXEC);
+  close(cfd);
+  std::vector<int> fds;
+  for (cmsghdr* cm = CMSG_FIRSTHDR(&msg); cm != nullptr;
+       cm = CMSG_NXTHDR(&msg, cm)) {
+    if (cm->cmsg_level == SOL_SOCKET && cm->cmsg_type == SCM_RIGHTS) {
+      const size_t n = (cm->cmsg_len - CMSG_LEN(0)) / sizeof(int);
+      const int* data = reinterpret_cast<const int*>(CMSG_DATA(cm));
+      fds.assign(data, data + n);
+    }
+  }
+  const auto close_all = [&fds] {
+    for (int fd : fds) {
+      close(fd);
+    }
+    return -1;
+  };
+  if (got != static_cast<ssize_t>(sizeof(head)) || fds.empty() ||
+      static_cast<size_t>(head[1]) != fds.size() ||
+      fds.size() > static_cast<size_t>(kMaxAcceptShards)) {
+    return close_all();
+  }
+  start_runtime_init();
+  port_ = head[0];
+  reuseport_shards_ = static_cast<int>(fds.size());
+  for (size_t i = 0; i < fds.size(); ++i) {
+    if (install_listener(fds[i], static_cast<int>(i)) != 0) {
+      for (size_t j = i; j < fds.size(); ++j) {
+        close(fds[j]);
+      }
+      fail_listeners();
+      return -1;
+    }
+  }
+  running_.store(true, std::memory_order_release);
+  LOG(Info) << "server adopted " << fds.size()
+            << " handed-off listener(s) on 127.0.0.1:" << port_;
+  return 0;
 }
 
 int Server::Join(int64_t timeout_ms) {
@@ -901,6 +1157,16 @@ void tstd_process_request(InputMessage&& msg) {
 
   if (srv == nullptr || !srv->running()) {
     cntl->SetFailed(ESHUTDOWN, "server stopped");
+    done();
+    return;
+  }
+  if (srv->draining()) {
+    // Graceful leave (Server::Drain): the node is healthy but exiting —
+    // answer a WELL-FORMED status the cluster client fails over around
+    // WITHOUT quarantining us (kEDraining, concurrency_limiter.h), so
+    // the successor that revives on this endpoint moments later isn't
+    // serving into a poisoned breaker.
+    cntl->SetFailed(kEDraining, "server draining: fail over");
     done();
     return;
   }

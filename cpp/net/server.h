@@ -226,6 +226,45 @@ class Server {
 
   // port <= 0 picks an ephemeral port (see port() after).  Returns 0 on ok.
   int Start(int port);
+  // Hot-restart successor entry point (the receiving half of Drain's
+  // listener handoff): connects to the predecessor's unix handoff socket
+  // at `path` (retrying until timeout_ms — the predecessor may not be
+  // serving the handoff yet), receives the SO_REUSEPORT listener fds via
+  // SCM_RIGHTS, and starts THIS server on them — the shared accept
+  // queues mean no SYN is ever refused across the restart.  Register
+  // methods before calling, exactly like Start.  The successor's RMA
+  // windows/regions are minted fresh in this process (new shm segments,
+  // new rkeys) — clients re-handshake rings on reconnect and never see a
+  // stale rkey.  Returns 0 on ok.
+  int StartFromHandoff(const std::string& path, int64_t timeout_ms = 10000);
+  // Graceful drain (zero-downtime leave; ISSUE 12): flips this server to
+  // kEDraining (new requests answer immediately with that status — the
+  // cluster client fails over WITHOUT quarantining us), runs the drain
+  // hooks (naming withdrawal, KV-block tombstoning), then — with a
+  // non-empty handoff_path — serves the duplicated listener fds to the
+  // successor over a unix socket at that path BEFORE closing our own, so
+  // the kernel accept queues never go unowned.  Finally waits out
+  // in-flight requests AND in-flight RMA window spans under the
+  // deadline (<= 0 uses trpc_drain_deadline_ms).  Returns 0 when fully
+  // quiesced, ETIMEDOUT when the deadline cut the wait short (the
+  // server is draining either way; call Stop()/destroy as usual).
+  int Drain(int64_t deadline_ms = 0, const std::string& handoff_path = "");
+  // True from the start of Drain until destruction: new requests are
+  // being answered kEDraining.
+  bool draining() const {
+    return draining_.load(std::memory_order_acquire);
+  }
+  // Flag registration (idempotent): trpc_drain_deadline_ms — the capi
+  // calls it so /flags sees the drain knob before the first Drain.
+  static void drain_ensure_registered();
+  // Registers a hook run at the START of Drain (before the in-flight
+  // wait): the seam the naming announcer (withdraw), the KV store
+  // (tombstone + withdraw_all) and embedders use to leave the fleet
+  // before the listener handoff.  Callable before or after Start.
+  void add_drain_hook(std::function<void()> hook);
+  // Ties a component's lifetime to this server (freed after Stop+Join in
+  // ~Server) — e.g. the Announcer created by server_announce.
+  void own_component(std::shared_ptr<void> c);
   // Listens on an AF_UNIX path instead (reference: unix sockets are
   // first-class EndPoints).  A stale socket file is unlinked first;
   // Stop unlinks it again.  Channel::Init("unix:<path>") connects.
@@ -275,6 +314,15 @@ class Server {
 
  private:
   static void on_acceptable(SocketId id, void* ctx);
+  // Shared pre-listen initialization (fibers, vars, protocol registry,
+  // ring-handshake methods) for Start and StartFromHandoff.
+  void start_runtime_init();
+  // The serving half of the hot-restart handoff: listens on `path`,
+  // waits (bounded) for the successor to connect, ships {port, nfds} +
+  // dup'd listener fds via SCM_RIGHTS.  0 on success.
+  int serve_handoff(const std::string& path, int64_t deadline_us);
+  // Fails every listen socket (Drain hands off first; Stop reuses it).
+  void fail_listeners();
   // One per listen shard; ctx handed to on_acceptable so the accept
   // counter attributes to the right shard.  Address-stable (unique_ptr)
   // for the sockets' lifetime.
@@ -326,6 +374,10 @@ class Server {
   int port_ = -1;
   std::string unix_path_;  // non-empty when listening on AF_UNIX
   std::atomic<bool> running_{false};
+  std::atomic<bool> draining_{false};
+  std::mutex drain_mu_;  // guards drain_hooks_ and components_
+  std::vector<std::function<void()>> drain_hooks_;
+  std::vector<std::shared_ptr<void>> components_;
   std::mutex conns_mu_;
   std::vector<SocketId> conns_;      // stale ids harmless (versioned)
   size_t conns_prune_at_ = 4096;     // doubles with the live set (scale)

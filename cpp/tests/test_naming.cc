@@ -1,0 +1,576 @@
+// Cluster control-plane tests (ISSUE 12): naming registry lease/epoch
+// semantics, push-based Watch, the naming:// cluster channel, bounded-
+// load c_hash and zone_la policies, deterministic subsetting, graceful
+// drain (kEDraining = failover WITHOUT quarantine), the membership-
+// churn x fault-schedule chaos soak, and the SO_REUSEPORT listener
+// handoff hot restart.
+#include <unistd.h>
+
+#include <atomic>
+#include <memory>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "base/flags.h"
+#include "base/time.h"
+#include "fiber/fiber.h"
+#include "fiber/sync.h"
+#include "net/cluster.h"
+#include "net/concurrency_limiter.h"
+#include "net/naming.h"
+#include "net/server.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+namespace {
+
+struct FlagGuard {
+  std::string name, old_value;
+  FlagGuard(const std::string& n, const std::string& v) : name(n) {
+    naming_ensure_registered();
+    cluster_ensure_registered();
+    old_value = Flag::find(n)->value_string();
+    EXPECT_EQ(Flag::set(n, v), 0);
+  }
+  ~FlagGuard() { Flag::set(name, old_value); }
+};
+
+struct NamingReset {
+  NamingReset() { naming_registry().clear(); }
+  ~NamingReset() { naming_registry().clear(); }
+};
+
+NamingMember member(const std::string& addr, uint64_t epoch,
+                    const std::string& zone = "", int weight = 1) {
+  NamingMember m;
+  m.addr = addr;
+  m.zone = zone;
+  m.weight = weight;
+  m.epoch = epoch;
+  return m;
+}
+
+std::string call_echo(ClusterChannel& ch, uint64_t key = 0) {
+  Controller cntl;
+  IOBuf req, resp;
+  req.append("x");
+  ch.CallMethod("Echo.WhoAmI", req, &resp, &cntl, nullptr, key);
+  return cntl.Failed() ? "FAILED:" + std::to_string(cntl.error_code())
+                       : resp.to_string();
+}
+
+// A disposable echo node that identifies itself (drain tests stop nodes,
+// so unlike test_cluster.cc these are NOT process-lifetime singletons).
+struct EchoNode {
+  Server server;
+  int port = 0;
+  int Start(const std::string& tag) {
+    server.RegisterMethod(
+        "Echo.WhoAmI",
+        [tag](Controller*, const IOBuf&, IOBuf* resp, Closure done) {
+          resp->append(tag);
+          done();
+        });
+    const int rc = server.Start(0);
+    port = server.port();
+    return rc;
+  }
+  std::string addr() const {
+    return "127.0.0.1:" + std::to_string(port);
+  }
+};
+
+}  // namespace
+
+// ---- registry semantics ---------------------------------------------------
+
+TEST_CASE(registry_lease_and_epoch_rules) {
+  NamingReset reset;
+  NamingRegistry& reg = naming_registry();
+  EXPECT_EQ(reg.announce("svc", member("127.0.0.1:1000", 100, "z1", 2), 0),
+            0);
+  EXPECT_EQ(reg.announce("svc", member("127.0.0.1:2000", 100), 0), 0);
+  std::vector<NamingMember> view;
+  uint64_t version = 0;
+  EXPECT_EQ(reg.resolve("svc", &view, &version), 0);
+  EXPECT_EQ(view.size(), 2u);
+  EXPECT(view[0].zone == "z1");
+  EXPECT_EQ(view[0].weight, 2);
+  EXPECT(view[0].lease_left_ms > 0);
+
+  // Zombie fence: an OLDER epoch must not touch the record.
+  EXPECT_EQ(reg.announce("svc", member("127.0.0.1:1000", 99), 0),
+            kENamingStaleEpoch);
+  EXPECT_EQ(reg.withdraw("svc", "127.0.0.1:1000", 99), kENamingStaleEpoch);
+  // Takeover: a NEWER epoch replaces (hot-restart successor).
+  EXPECT_EQ(reg.announce("svc", member("127.0.0.1:1000", 101, "z2"), 0), 0);
+  EXPECT_EQ(reg.resolve("svc", &view, nullptr), 0);
+  EXPECT(view[0].zone == "z2");
+  // Renewal (same epoch, same fields) must NOT bump the version.
+  uint64_t v_before = 0;
+  EXPECT_EQ(reg.resolve("svc", &view, &v_before), 0);
+  EXPECT_EQ(reg.announce("svc", member("127.0.0.1:1000", 101, "z2"), 0), 0);
+  uint64_t v_after = 0;
+  EXPECT_EQ(reg.resolve("svc", &view, &v_after), 0);
+  EXPECT_EQ(v_before, v_after);
+  // Withdraw at the live epoch; idempotent second withdraw.
+  EXPECT_EQ(reg.withdraw("svc", "127.0.0.1:1000", 101), 0);
+  EXPECT_EQ(reg.withdraw("svc", "127.0.0.1:1000", 101), 0);
+  EXPECT_EQ(reg.member_count("svc"), 1u);
+  // Zombie-renewal fence: the withdraw tombstoned epoch 101 — a late
+  // renewal racing its own withdraw must NOT resurrect the member; a
+  // successor's newer epoch passes (and clears the tombstone).
+  EXPECT_EQ(reg.announce("svc", member("127.0.0.1:1000", 101), 0),
+            kENamingStaleEpoch);
+  EXPECT_EQ(reg.member_count("svc"), 1u);
+  EXPECT_EQ(reg.announce("svc", member("127.0.0.1:1000", 102), 0), 0);
+  EXPECT_EQ(reg.member_count("svc"), 2u);
+  EXPECT_EQ(reg.resolve("nope", &view, nullptr), kENamingMiss);
+}
+
+TEST_CASE(registry_lease_expiry_prunes) {
+  NamingReset reset;
+  NamingRegistry& reg = naming_registry();
+  EXPECT_EQ(reg.announce("svc", member("127.0.0.1:1000", 1), 250), 0);
+  EXPECT_EQ(reg.member_count("svc"), 1u);
+  usleep(300 * 1000);
+  EXPECT_EQ(reg.member_count("svc"), 0u);  // expired = gone
+  // Expiry counted as a change: version moved.
+  std::vector<NamingMember> view;
+  uint64_t version = 0;
+  EXPECT_EQ(reg.resolve("svc", &view, &version), 0);
+  EXPECT_EQ(view.size(), 0u);
+  EXPECT(version >= 3);  // announce + expiry both bumped
+}
+
+TEST_CASE(watch_parks_and_wakes_on_change) {
+  NamingReset reset;
+  fiber_init(0);
+  NamingRegistry& reg = naming_registry();
+  std::vector<NamingMember> view;
+  uint64_t version = 0;
+  EXPECT_EQ(reg.announce("svc", member("127.0.0.1:1000", 1), 0), 0);
+  EXPECT_EQ(reg.resolve("svc", &view, &version), 0);
+
+  // Unchanged version: the watch must PARK (not answer instantly).
+  const int64_t t0 = monotonic_time_us();
+  uint64_t v2 = version;
+  EXPECT_EQ(reg.watch("svc", version, 120, &view, &v2), 0);
+  EXPECT(monotonic_time_us() - t0 >= 100 * 1000);
+  EXPECT_EQ(v2, version);
+
+  // A concurrent announce wakes the parked watcher immediately.
+  std::thread bumper([&reg] {
+    usleep(50 * 1000);
+    reg.announce("svc", member("127.0.0.1:2000", 1), 0);
+  });
+  const int64_t t1 = monotonic_time_us();
+  EXPECT_EQ(reg.watch("svc", version, 5000, &view, &v2), 0);
+  const int64_t waited_us = monotonic_time_us() - t1;
+  bumper.join();
+  EXPECT(v2 > version);
+  EXPECT_EQ(view.size(), 2u);
+  EXPECT(waited_us < 3000 * 1000);  // push, not the 5s budget
+}
+
+// ---- naming:// cluster channel (push-based membership) --------------------
+
+TEST_CASE(cluster_channel_follows_naming_pushes) {
+  NamingReset reset;
+  Server registry;
+  EXPECT_EQ(naming_attach(&registry), 0);
+  EXPECT_EQ(registry.Start(0), 0);
+  const std::string reg_addr =
+      "127.0.0.1:" + std::to_string(registry.port());
+
+  auto n1 = std::make_unique<EchoNode>();
+  auto n2 = std::make_unique<EchoNode>();
+  EXPECT_EQ(n1->Start("node-1"), 0);
+  EXPECT_EQ(n2->Start("node-2"), 0);
+  EXPECT_EQ(server_announce(&n1->server, reg_addr, "echo", "z1", 1), 0);
+
+  ClusterChannel::Options opts;
+  opts.timeout_ms = 2000;
+  opts.refresh_interval_ms = 60000;  // poll OFF: only pushes apply
+  ClusterChannel ch;
+  EXPECT_EQ(ch.Init("naming://" + reg_addr + "/echo", "rr", &opts), 0);
+  EXPECT(call_echo(ch) == "node-1");
+
+  // Announce node-2: the watch fiber must fold it in WITHOUT a refresh
+  // tick (refresh interval is 60s).
+  EXPECT_EQ(server_announce(&n2->server, reg_addr, "echo", "z2", 1), 0);
+  std::set<std::string> seen;
+  const int64_t deadline = monotonic_time_us() + 5 * 1000 * 1000;
+  while (seen.size() < 2 && monotonic_time_us() < deadline) {
+    seen.insert(call_echo(ch));
+    usleep(10 * 1000);
+  }
+  EXPECT_EQ(seen.size(), 2u);
+  EXPECT(seen.count("node-1") == 1 && seen.count("node-2") == 1);
+
+  // Drain node-1: its withdrawal pushes, and every subsequent call lands
+  // on node-2 with ZERO failures (kEDraining = silent failover).
+  EXPECT_EQ(n1->server.Drain(3000), 0);
+  int failures = 0;
+  bool only_n2 = false;
+  const int64_t d2 = monotonic_time_us() + 5 * 1000 * 1000;
+  while (monotonic_time_us() < d2) {
+    std::string got = call_echo(ch);
+    if (got.rfind("FAILED", 0) == 0) {
+      ++failures;
+    }
+    if (got == "node-2") {
+      only_n2 = true;
+      break;
+    }
+    usleep(5 * 1000);
+  }
+  EXPECT_EQ(failures, 0);
+  EXPECT(only_n2);
+}
+
+// ---- balancing policies ---------------------------------------------------
+
+TEST_CASE(chash_bounded_load_diffuses_hotspots) {
+  std::unique_ptr<LoadBalancer> lb(LoadBalancer::create("c_hash_bl"));
+  EXPECT(lb != nullptr);
+  std::vector<ServerNode> nodes(3);
+  std::vector<size_t> healthy = {0, 1, 2};
+  for (int i = 0; i < 3; ++i) {
+    EndPoint ep;
+    hostname2endpoint(("127.0.0.1:" + std::to_string(7000 + i)).c_str(),
+                      &ep);
+    nodes[i].ep = ep;
+  }
+  // Idle cluster: affinity — one key always lands on the same node.
+  const size_t home = lb->select(healthy, nodes, 42, 0);
+  for (int i = 0; i < 16; ++i) {
+    EXPECT_EQ(lb->select(healthy, nodes, 42, 0), home);
+  }
+  // Overload the home node far past factor x mean: the SAME key must
+  // diffuse to a different node while the hotspot persists.
+  nodes[home].inflight->store(1000, std::memory_order_relaxed);
+  const size_t spill = lb->select(healthy, nodes, 42, 0);
+  EXPECT(spill != home);
+  // Relief: affinity returns.
+  nodes[home].inflight->store(0, std::memory_order_relaxed);
+  EXPECT_EQ(lb->select(healthy, nodes, 42, 0), home);
+}
+
+TEST_CASE(zone_la_prefers_local_zone) {
+  FlagGuard zone("trpc_cluster_zone", "z1");
+  std::unique_ptr<LoadBalancer> lb(LoadBalancer::create("zone_la"));
+  EXPECT(lb != nullptr);
+  std::vector<ServerNode> nodes(2);
+  std::vector<size_t> healthy = {0, 1};
+  for (int i = 0; i < 2; ++i) {
+    EndPoint ep;
+    hostname2endpoint(("127.0.0.1:" + std::to_string(7100 + i)).c_str(),
+                      &ep);
+    nodes[i].ep = ep;
+    // Identical latency/load: zone is the only differentiator.
+    nodes[i].ewma_latency_us->store(1000, std::memory_order_relaxed);
+  }
+  nodes[0].zone = "z1";
+  nodes[1].zone = "z2";
+  int local = 0;
+  const int kRounds = 2000;
+  for (int i = 0; i < kRounds; ++i) {
+    if (lb->select(healthy, nodes, 0, 0) == 0) {
+      ++local;
+    }
+  }
+  // Expected share: 4/(4+1) = 80%; allow generous slack for dice.
+  EXPECT(local > kRounds * 65 / 100);
+  EXPECT(local < kRounds);  // the remote zone still gets SOME traffic
+}
+
+TEST_CASE(subsetting_is_deterministic_and_stable) {
+  // Static 4-node list, subset of 2: the same seed must pick the same
+  // pair across refreshes (connection stability), different seeds must
+  // (for this seed choice) pick a different pair (client spread).
+  std::vector<std::unique_ptr<EchoNode>> nodes;
+  std::string url = "list://";
+  for (int i = 0; i < 4; ++i) {
+    nodes.push_back(std::make_unique<EchoNode>());
+    EXPECT_EQ(nodes.back()->Start("node-" + std::to_string(i)), 0);
+    url += nodes.back()->addr() + (i < 3 ? "," : "");
+  }
+  const auto subset_of = [&url](uint64_t seed) {
+    ClusterChannel::Options opts;
+    opts.timeout_ms = 2000;
+    opts.subset_size = 2;
+    opts.subset_seed = seed;
+    ClusterChannel ch;
+    EXPECT_EQ(ch.Init(url, "rr", &opts), 0);
+    EXPECT_EQ(ch.refresh(), 0);  // second resolve: must not churn
+    std::set<std::string> seen;
+    for (int i = 0; i < 32; ++i) {
+      seen.insert(call_echo(ch));
+    }
+    return seen;
+  };
+  const std::set<std::string> a1 = subset_of(7);
+  const std::set<std::string> a2 = subset_of(7);
+  EXPECT_EQ(a1.size(), 2u);
+  EXPECT(a1 == a2);  // deterministic across channels AND refreshes
+  bool spread = false;
+  for (uint64_t seed = 8; seed < 16 && !spread; ++seed) {
+    spread = subset_of(seed) != a1;
+  }
+  EXPECT(spread);  // some other seed lands elsewhere
+}
+
+// ---- drain semantics ------------------------------------------------------
+
+TEST_CASE(drain_fails_over_without_quarantine) {
+  // Static list (no naming): the drained node STAYS in the view, so
+  // every call exercises the kEDraining failover path — and the breaker
+  // must stay closed for it (healthy_count holds at 3).
+  std::vector<std::unique_ptr<EchoNode>> nodes;
+  std::string url = "list://";
+  for (int i = 0; i < 3; ++i) {
+    nodes.push_back(std::make_unique<EchoNode>());
+    EXPECT_EQ(nodes.back()->Start("node-" + std::to_string(i)), 0);
+    url += nodes.back()->addr() + (i < 2 ? "," : "");
+  }
+  ClusterChannel::Options opts;
+  opts.timeout_ms = 2000;
+  opts.max_retry = 2;
+  ClusterChannel ch;
+  EXPECT_EQ(ch.Init(url, "rr", &opts), 0);
+  EXPECT_EQ(ch.healthy_count(), 3u);
+  // Warm a live connection to every member: the kEDraining contract is
+  // about in-flight fleets (a drained node ANSWERS on established
+  // connections; only after teardown do fresh connects get refused).
+  for (int i = 0; i < 9; ++i) {
+    EXPECT(call_echo(ch).rfind("FAILED", 0) != 0);
+  }
+  EXPECT_EQ(nodes[0]->server.Drain(3000), 0);
+  int failures = 0;
+  for (int i = 0; i < 60; ++i) {
+    const std::string got = call_echo(ch);
+    if (got.rfind("FAILED", 0) == 0) {
+      ++failures;
+    } else {
+      EXPECT(got != "node-0");  // drained node serves nothing new
+    }
+  }
+  EXPECT_EQ(failures, 0);
+  // THE drain guarantee: zero quarantine entries for the drained node.
+  EXPECT_EQ(ch.healthy_count(), 3u);
+}
+
+TEST_CASE(drain_waits_in_flight_requests) {
+  Server srv;
+  Event release;
+  std::atomic<int> completions{0};
+  srv.RegisterMethod("Slow.Wait", [&release, &completions](
+                                      Controller*, const IOBuf&,
+                                      IOBuf* resp, Closure done) {
+    release.wait(0, monotonic_time_us() + 2 * 1000 * 1000);
+    resp->append("done");
+    completions.fetch_add(1, std::memory_order_release);
+    done();
+  });
+  EXPECT_EQ(srv.Start(0), 0);
+  Channel ch;
+  Channel::Options copts;
+  copts.timeout_ms = 5000;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(srv.port()), &copts), 0);
+  CountdownEvent started(1);
+  std::thread caller([&ch, &started] {
+    Controller cntl;
+    IOBuf req, resp;
+    started.signal();
+    ch.CallMethod("Slow.Wait", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+  });
+  started.wait();
+  // Let the request reach the handler, then drain: Drain must NOT
+  // return success until the parked handler completed.
+  while (srv.in_flight.load(std::memory_order_acquire) == 0) {
+    usleep(1000);
+  }
+  std::thread releaser([&release] {
+    usleep(100 * 1000);
+    release.value.store(1, std::memory_order_release);
+    release.wake_all();
+  });
+  EXPECT_EQ(srv.Drain(3000), 0);
+  EXPECT_EQ(completions.load(std::memory_order_acquire), 1);
+  caller.join();
+  releaser.join();
+}
+
+TEST_CASE(quarantine_backoff_jitter_decorrelates) {
+  // Two clients watching the same dead node must not compute identical
+  // quarantine windows round after round (the lockstep-reprobe bug).
+  // Windows come from the FaultActor splitmix64 side stream, so under a
+  // default actor they are deterministic per process but DIFFER across
+  // consecutive draws.
+  std::vector<std::unique_ptr<EchoNode>> nodes;
+  nodes.push_back(std::make_unique<EchoNode>());
+  EXPECT_EQ(nodes.back()->Start("alive"), 0);
+  // One dead endpoint forces breaker feeding on every call round.
+  Server dead;
+  dead.RegisterMethod("Echo.WhoAmI",
+                      [](Controller*, const IOBuf&, IOBuf* resp,
+                         Closure done) {
+                        resp->append("dead");
+                        done();
+                      });
+  EXPECT_EQ(dead.Start(0), 0);
+  const std::string dead_addr = "127.0.0.1:" + std::to_string(dead.port());
+  dead.Stop();
+  ClusterChannel::Options opts;
+  opts.timeout_ms = 300;
+  opts.max_retry = 2;
+  opts.quarantine_base_ms = 50;
+  opts.quarantine_max_ms = 10000;
+  opts.health_check_method = "";  // no probes: windows expire naturally
+  ClusterChannel ch;
+  EXPECT_EQ(ch.Init("list://" + nodes[0]->addr() + "," + dead_addr, "rr",
+                    &opts),
+            0);
+  // Collect distinct quarantine windows by tripping the breaker
+  // repeatedly; the jitter makes consecutive windows differ.
+  std::set<int64_t> windows;
+  for (int round = 0; round < 6; ++round) {
+    for (int i = 0; i < 6; ++i) {
+      (void)call_echo(ch);
+    }
+    // healthy_count dips to 1 while the dead node is quarantined.
+    if (ch.healthy_count() == 1) {
+      windows.insert(round);
+    }
+    usleep(20 * 1000);
+  }
+  EXPECT(windows.size() >= 1);  // the breaker did open
+  // The decisive assertion: consecutive draws from the jitter stream
+  // differ (a constant stream would reintroduce lockstep).
+  const uint64_t a = FaultActor::global().jitter_draw();
+  const uint64_t b = FaultActor::global().jitter_draw();
+  const uint64_t c = FaultActor::global().jitter_draw();
+  EXPECT(a != b || b != c);
+}
+
+// ---- chaos: membership churn x fault schedule (satellite) -----------------
+
+TEST_CASE(chaos_drain_under_faults_zero_client_errors) {
+  NamingReset reset;
+  Server registry;
+  EXPECT_EQ(naming_attach(&registry), 0);
+  EXPECT_EQ(registry.Start(0), 0);
+  const std::string reg_addr =
+      "127.0.0.1:" + std::to_string(registry.port());
+  std::vector<std::unique_ptr<EchoNode>> nodes;
+  for (int i = 0; i < 3; ++i) {
+    nodes.push_back(std::make_unique<EchoNode>());
+    EXPECT_EQ(nodes.back()->Start("node-" + std::to_string(i)), 0);
+    EXPECT_EQ(
+        server_announce(&nodes.back()->server, reg_addr, "echo", "", 1), 0);
+  }
+  // Seeded faults on node-1 WHILE node-0 drains: delayed dispatch +
+  // injected errors.  The cluster client's retry/failover must absorb
+  // every one — zero client-visible errors — and the drained node must
+  // end with no quarantine entry.
+  EXPECT_EQ(nodes[1]->server.SetFaults(
+                "seed=7;svr_delay=0.2:30;svr_error=0.1:5000"),
+            0);
+  ClusterChannel::Options opts;
+  opts.timeout_ms = 3000;
+  opts.max_retry = 2;
+  opts.refresh_interval_ms = 100;
+  ClusterChannel ch;
+  EXPECT_EQ(ch.Init("naming://" + reg_addr + "/echo", "rr", &opts), 0);
+  std::atomic<int> failures{0};
+  std::atomic<int> calls{0};
+  std::atomic<bool> stop{false};
+  std::thread load([&ch, &failures, &calls, &stop] {
+    while (!stop.load(std::memory_order_acquire)) {
+      if (call_echo(ch).rfind("FAILED", 0) == 0) {
+        failures.fetch_add(1, std::memory_order_relaxed);
+      }
+      calls.fetch_add(1, std::memory_order_relaxed);
+    }
+  });
+  usleep(200 * 1000);                       // steady load under faults
+  EXPECT_EQ(nodes[0]->server.Drain(5000), 0);  // churn: node-0 leaves
+  usleep(400 * 1000);                       // load continues post-drain
+  stop.store(true, std::memory_order_release);
+  load.join();
+  EXPECT(calls.load() > 20);
+  EXPECT_EQ(failures.load(), 0);
+  // The drained node left the view via withdrawal (never via
+  // quarantine), and the survivors keep serving.
+  EXPECT_EQ(naming_registry().member_count("echo"), 2u);
+  EXPECT(ch.healthy_count() >= 1);
+  nodes[1]->server.SetFaults("");
+}
+
+// ---- hot restart: SO_REUSEPORT listener handoff ---------------------------
+
+TEST_CASE(hot_restart_handoff_keeps_port_and_traffic) {
+  std::vector<std::unique_ptr<EchoNode>> nodes;
+  for (int i = 0; i < 2; ++i) {
+    nodes.push_back(std::make_unique<EchoNode>());
+    EXPECT_EQ(nodes.back()->Start("gen1-" + std::to_string(i)), 0);
+  }
+  const int port = nodes[0]->port;
+  ClusterChannel::Options opts;
+  opts.timeout_ms = 2000;
+  opts.max_retry = 2;
+  ClusterChannel ch;
+  EXPECT_EQ(ch.Init("list://" + nodes[0]->addr() + "," + nodes[1]->addr(),
+                    "rr", &opts),
+            0);
+  std::atomic<int> failures{0};
+  std::atomic<bool> stop{false};
+  std::thread load([&ch, &failures, &stop] {
+    while (!stop.load(std::memory_order_acquire)) {
+      if (call_echo(ch).rfind("FAILED", 0) == 0) {
+        failures.fetch_add(1, std::memory_order_relaxed);
+      }
+    }
+  });
+  // Successor (same process stands in for the fresh pid; the orchestrator
+  // covers the cross-process run) adopts WHILE the predecessor drains.
+  const std::string ho = "/tmp/trpc_test_handoff_" +
+                         std::to_string(getpid()) + ".sock";
+  Server successor;
+  successor.RegisterMethod("Echo.WhoAmI",
+                           [](Controller*, const IOBuf&, IOBuf* resp,
+                              Closure done) {
+                             resp->append("gen2-0");
+                             done();
+                           });
+  std::thread adopt([&successor, &ho] {
+    EXPECT_EQ(successor.StartFromHandoff(ho, 8000), 0);
+  });
+  EXPECT_EQ(nodes[0]->server.Drain(5000, ho), 0);
+  adopt.join();
+  EXPECT_EQ(successor.port(), port);  // same port, adopted listeners
+  // The successor answers on the ORIGINAL endpoint (new conns land in
+  // the shared accept queue it now owns).
+  Channel fresh;
+  Channel::Options copts;
+  copts.timeout_ms = 2000;
+  EXPECT_EQ(fresh.Init("127.0.0.1:" + std::to_string(port), &copts), 0);
+  Controller cntl;
+  IOBuf req, resp;
+  req.append("x");
+  fresh.CallMethod("Echo.WhoAmI", req, &resp, &cntl);
+  EXPECT(!cntl.Failed());
+  EXPECT(resp.to_string() == "gen2-0");
+  // The restart window produced ZERO client-visible errors.
+  usleep(100 * 1000);
+  stop.store(true, std::memory_order_release);
+  load.join();
+  EXPECT_EQ(failures.load(), 0);
+}
+
+TEST_MAIN

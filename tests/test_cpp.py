@@ -38,7 +38,7 @@ _NO_CMAKE = shutil.which("cmake") is None or shutil.which("ctest") is None
 # cpp/tests/ so a new suite gates automatically.
 TSAN_SUITES = [
     "fiber", "rpc", "stream", "shm", "ici", "chaos", "stat", "qos",
-    "stripe", "analysis", "timeline", "rma", "kvstore",
+    "stripe", "analysis", "timeline", "rma", "kvstore", "naming",
 ]
 ALL_SUITES = sorted(
     p.stem[len("test_"):] for p in (REPO / "cpp" / "tests").glob("test_*.cc")
@@ -165,6 +165,19 @@ def test_rma_cpp_suite_native():
     cancel-mid-put quiescence, sub-threshold bypass, window-full
     fallback, and chunk-fault whole-or-nothing semantics."""
     _run_native_suite("test_rma.cc", "test_rma_native", "rma suite")
+
+
+def test_naming_cpp_suite_native():
+    """ISSUE 12: the cluster control plane gates tier-1 — naming
+    registry lease/epoch semantics (zombie fence, takeover, renewal),
+    push-based Watch park/wake, the naming:// cluster channel folding
+    membership deltas in without a refresh tick, bounded-load c_hash
+    hotspot diffusion, zone_la locality preference, deterministic
+    subsetting, graceful drain (kEDraining failover WITHOUT quarantine,
+    in-flight waits), the membership-churn x fault-schedule chaos soak,
+    and the SO_REUSEPORT listener-handoff hot restart."""
+    _run_native_suite("test_naming.cc", "test_naming_native",
+                      "naming suite")
 
 
 def test_kvstore_cpp_suite_native():

@@ -384,6 +384,77 @@ def test_kv_chaos_composition_whole_or_nothing(fresh_kv):
         tok_srv.stop()
 
 
+def test_kv_fetch_reresolves_through_naming_when_node_gone(fresh_kv):
+    """ISSUE 12 satellite: a KvClient given a naming view re-resolves a
+    TRANSPORT-dead owner through it — the cached (dead) channel is
+    dropped and the re-published block fetches from its new owner,
+    instead of retrying the dead pid once and surfacing the error."""
+    from brpc_tpu.rpc import naming
+
+    naming.reset()
+    # Registry host: kv registry + naming registry, survives the churn.
+    hub = Server()
+    hub.enable_kv_registry()
+    hub.enable_naming_registry()
+    hub.start(0)
+    hub_addr = f"127.0.0.1:{hub.port}"
+
+    # Node A: publishes block 7 and announces itself.
+    node_a = Server()
+    node_a.enable_kv_store()
+    node_a.start(0)
+    a_addr = f"127.0.0.1:{node_a.port}"
+    node_a.announce(hub_addr, "kv")
+    pages = RmaBuffer(1 << 20)
+    np.frombuffer(pages.view, dtype=np.uint8)[:4096] = _pattern(4096, 9)
+    reg = kv.KvRegistryClient(Channel(hub_addr, timeout_ms=5000),
+                              owns_channel=True)
+    meta_a = kv.publish(7, pages, length=4096, lease_ms=600000,
+                        node=a_addr)
+    reg.register(meta_a, lease_ms=600000)
+
+    cli = kv.KvClient(hub_addr, use_shm=False, timeout_ms=2000,
+                      naming_addr=hub_addr, naming_service="kv")
+    try:
+        assert cli.fetch(7) == _pattern(4096, 9).tobytes()  # warm cache
+
+        # Node A dies abruptly (no graceful drain): its channel goes
+        # transport-dead and its announcement withdraws with it.
+        node_a.close()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if naming.local_member_count("kv") == 0:
+                break
+            time.sleep(0.02)
+        assert naming.local_member_count("kv") == 0
+
+        # Successor node B re-publishes block 7 (newer generation) and
+        # announces; the registry record now points at B.
+        node_b = Server()
+        node_b.enable_kv_store()
+        node_b.start(0)
+        b_addr = f"127.0.0.1:{node_b.port}"
+        node_b.announce(hub_addr, "kv")
+        kv.withdraw(7)  # process-local store shared in this test
+        meta_b = kv.publish(7, pages, length=4096, lease_ms=600000,
+                            node=b_addr)
+        assert meta_b.generation == 2
+        reg.register(meta_b, lease_ms=600000)
+
+        # THE regression: the fetch must drop the dead channel, consult
+        # the naming view, re-resolve, and land on node B — one call,
+        # no surfaced transport error.
+        assert cli.fetch(7) == _pattern(4096, 9).tobytes()
+        assert cli.node_reresolves == 1
+        node_b.close()
+    finally:
+        cli.close()
+        reg.close()
+        pages.free()
+        hub.close()
+        naming.reset()
+
+
 def test_kv_flag_validators():
     old_lease = get_flag("trpc_kv_lease_ms")
     old_bytes = get_flag("trpc_kv_store_bytes")

@@ -1,0 +1,284 @@
+"""Cluster control plane through the Python surface (ISSUE 12).
+
+The C++ tier (cpp/net/naming.h + Server::Drain/StartFromHandoff) is the
+membership/drain machinery; brpc_tpu/rpc/naming.py and the Server
+drain/announce/handoff methods are its Python surface.  These tests pin
+the Python-visible contract:
+
+- announce/resolve/watch roundtrip + typed naming errors (the epoch
+  zombie fence surfaces as NamingStaleEpochError);
+- a ClusterChannel("naming://...") following announce/withdraw pushes;
+- graceful drain: established clients fail over with ZERO errors, a
+  bare Channel surfaces DrainingError, the drained node's announcement
+  withdraws, and its KV blocks tombstone (kv-stale, never dead bytes);
+- the 3-node drain-under-chaos soak (membership churn x fault schedule:
+  svr_delay + svr_error on a sibling while one node drains — zero
+  client-visible errors);
+- hot restart ACROSS PROCESSES: a successor process adopts the
+  SO_REUSEPORT listener set and serves the same port;
+- cluster flag validators (trpc_cluster_*/trpc_drain_*/trpc_naming_*).
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+import pytest
+
+from brpc_tpu.rpc import (Channel, ClusterChannel, DrainingError, Server,
+                          naming)
+from brpc_tpu.rpc import get_flag, set_flag
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def fresh_naming():
+    naming.reset()
+    yield
+    naming.reset()
+
+
+@pytest.fixture()
+def registry(fresh_naming):
+    srv = Server()
+    srv.enable_naming_registry()
+    srv.start(0)
+    yield srv
+    srv.close()
+
+
+def _echo_node(registry_port: int, service: str = "echo",
+               zone: str = "") -> Server:
+    srv = Server()
+    srv.register_native_echo("Echo.Echo")
+    srv.start(0)
+    srv.announce(f"127.0.0.1:{registry_port}", service, zone=zone)
+    return srv
+
+
+def test_announce_resolve_watch_roundtrip(registry):
+    nc = naming.NamingClient(f"127.0.0.1:{registry.port}")
+    try:
+        epoch = nc.announce("svc", "127.0.0.1:7001", zone="z1", weight=2)
+        version, members = nc.resolve("svc")
+        assert [(m.addr, m.zone, m.weight) for m in members] == [
+            ("127.0.0.1:7001", "z1", 2)]
+        assert members[0].lease_left_ms > 0
+
+        # Zombie fence: an older epoch cannot touch the record.
+        with pytest.raises(naming.NamingStaleEpochError):
+            nc.announce("svc", "127.0.0.1:7001", epoch=epoch - 1)
+        with pytest.raises(naming.NamingMissError):
+            nc.resolve("never-announced")
+
+        # Watch parks on an unchanged version, answers the moment a
+        # member joins (push, well under the park budget).
+        t0 = time.monotonic()
+        v2, members = nc.watch("svc", version, park_ms=150)
+        assert time.monotonic() - t0 >= 0.1 and v2 == version
+
+        nc.announce("svc", "127.0.0.1:7002", epoch=epoch)
+        v3, members = nc.watch("svc", version, park_ms=5000)
+        assert v3 > version and len(members) == 2
+
+        # Withdraw at the live epoch is idempotent.
+        nc.withdraw("svc", "127.0.0.1:7002", epoch)
+        nc.withdraw("svc", "127.0.0.1:7002", epoch)
+        assert len(nc.resolve("svc")[1]) == 1
+    finally:
+        nc.close()
+
+
+def test_cluster_channel_follows_membership(registry):
+    n1 = _echo_node(registry.port, zone="z1")
+    n2 = _echo_node(registry.port, zone="z2")
+    ch = ClusterChannel(f"naming://127.0.0.1:{registry.port}/echo",
+                        lb="rr", timeout_ms=2000)
+    try:
+        for _ in range(6):
+            assert ch.call("Echo.Echo", b"hi") == b"hi"
+        # Drain n1: withdrawal pushes into the channel; calls keep
+        # succeeding with zero errors (kEDraining = silent failover).
+        assert n1.drain(deadline_ms=3000)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            assert ch.call("Echo.Echo", b"hi") == b"hi"
+            if naming.local_member_count("echo") == 1:
+                break
+            time.sleep(0.02)
+        assert naming.local_member_count("echo") == 1
+        for _ in range(10):
+            assert ch.call("Echo.Echo", b"hi") == b"hi"
+    finally:
+        ch.close()
+        n1.close()
+        n2.close()
+
+
+def test_bare_channel_surfaces_draining_error(registry):
+    srv = _echo_node(registry.port)
+    bare = Channel(f"127.0.0.1:{srv.port}", timeout_ms=1500)
+    try:
+        assert bare.call("Echo.Echo", b"x") == b"x"  # conn established
+        assert srv.drain(deadline_ms=2000)
+        assert srv.draining
+        with pytest.raises(DrainingError):
+            bare.call("Echo.Echo", b"x")
+    finally:
+        bare.close()
+        srv.close()
+
+
+def test_drain_tombstones_kv_blocks(registry):
+    """The drain hook withdraws + tombstones every published KV block:
+    a decode client that keeps using its established channel can never
+    be handed the dying node's bytes — its post-drain fetch fails with a
+    clean status (DrainingError here; kv-stale once the successor
+    re-publishes under a newer generation, covered by the C++ suite)."""
+    from brpc_tpu.rpc import RmaBuffer, kv
+
+    kv.reset()
+    srv = Server()
+    srv.enable_kv_store()
+    srv.enable_kv_registry()
+    srv.register_native_echo("Echo.Echo")
+    srv.start(0)
+    pages = RmaBuffer(1 << 20)
+    try:
+        meta = kv.publish(42, pages, length=4096,
+                          node=f"127.0.0.1:{srv.port}")
+        reg = kv.KvRegistryClient(Channel(f"127.0.0.1:{srv.port}"),
+                                  owns_channel=True)
+        reg.register(meta)
+        assert kv.store_count() == 1
+        # Establish the decode channel BEFORE the drain (the in-flight
+        # fleet scenario) and prove a good fetch.
+        cli = kv.KvClient(f"127.0.0.1:{srv.port}", use_shm=False,
+                          timeout_ms=2000)
+        assert len(cli.fetch(42)) == 4096
+        assert srv.drain(deadline_ms=3000)
+        assert kv.store_count() == 0  # withdrawn + tombstoned
+        with pytest.raises(DrainingError):
+            cli.fetch(42)
+        cli.close()
+        reg.close()
+    finally:
+        pages.free()
+        srv.close()
+        kv.reset()
+
+
+def test_drain_soak_under_faults_zero_errors(registry):
+    """Membership churn x fault schedule (the satellite soak): 3 nodes,
+    one drains while a sibling runs seeded svr_delay/svr_error faults —
+    the cluster client's retry/failover absorbs every event."""
+    nodes = [_echo_node(registry.port) for _ in range(3)]
+    nodes[1].set_faults("seed=7;svr_delay=0.2:30;svr_error=0.1:5000")
+    ch = ClusterChannel(f"naming://127.0.0.1:{registry.port}/echo",
+                        lb="rr", timeout_ms=3000, max_retry=2,
+                        refresh_interval_ms=100)
+    errors = 0
+    calls = 0
+    try:
+        deadline = time.monotonic() + 0.5
+        while time.monotonic() < deadline:
+            calls += 1
+            try:
+                assert ch.call("Echo.Echo", b"x") == b"x"
+            except Exception:
+                errors += 1
+        assert nodes[0].drain(deadline_ms=5000)
+        deadline = time.monotonic() + 0.5
+        while time.monotonic() < deadline:
+            calls += 1
+            try:
+                assert ch.call("Echo.Echo", b"x") == b"x"
+            except Exception:
+                errors += 1
+        assert calls > 20
+        assert errors == 0, f"{errors}/{calls} client-visible errors"
+        assert naming.local_member_count("echo") == 2
+    finally:
+        nodes[1].set_faults("")
+        ch.close()
+        for n in nodes:
+            n.close()
+
+
+_SUCCESSOR_SNIPPET = """
+import sys
+sys.path.insert(0, {repo!r})
+from brpc_tpu.rpc import Server
+srv = Server()
+srv.register_native_echo("Echo.Echo")
+srv.start_from_handoff({path!r}, 15000)
+print("ADOPTED", srv.port, flush=True)
+import time
+deadline = time.time() + 30
+while time.time() < deadline:
+    line = sys.stdin.readline()
+    if not line or line.strip() == "quit":
+        break
+srv.close()
+"""
+
+
+def test_hot_restart_across_processes(registry, tmp_path):
+    """The headline: a SEPARATE successor process adopts the draining
+    server's SO_REUSEPORT listener set via the unix handoff socket and
+    serves the same port — fresh pid, fresh RMA state, same endpoint."""
+    srv = _echo_node(registry.port)
+    port = srv.port
+    ho = str(tmp_path / "handoff.sock")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    succ = subprocess.Popen(
+        [sys.executable, "-c",
+         _SUCCESSOR_SNIPPET.format(repo=str(REPO), path=ho)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env, text=True)
+    try:
+        assert srv.drain(deadline_ms=10000, handoff_path=ho)
+        line = succ.stdout.readline()
+        assert line.startswith("ADOPTED"), line
+        assert int(line.split()[1]) == port  # same port, adopted fds
+        srv.close()  # predecessor fully gone
+        # A fresh connection lands on the successor process.
+        ch = Channel(f"127.0.0.1:{port}", timeout_ms=3000)
+        assert ch.call("Echo.Echo", b"generation-2") == b"generation-2"
+        ch.close()
+    finally:
+        try:
+            succ.stdin.write("quit\n")
+            succ.stdin.flush()
+        except (BrokenPipeError, ValueError):
+            pass
+        succ.wait(timeout=30)
+
+
+def test_cluster_flag_validators():
+    """trpc_cluster_*/trpc_drain_*/trpc_naming_* knobs exist, hold their
+    documented defaults, and reject garbage (lint_trpc's flag-validator
+    rule guarantees the validators exist; this pins their behavior)."""
+    assert get_flag("trpc_cluster_subset_size") == "0"
+    assert get_flag("trpc_cluster_zone") == ""
+    assert float(get_flag("trpc_cluster_chash_load_factor")) == 1.25
+    assert int(get_flag("trpc_drain_deadline_ms")) == 5000
+    assert int(get_flag("trpc_naming_lease_ms")) == 10000
+    assert int(get_flag("trpc_naming_watch_ms")) == 10000
+    for name, bad in [("trpc_cluster_subset_size", "-1"),
+                      ("trpc_cluster_zone", "x" * 16),
+                      ("trpc_cluster_chash_load_factor", "0.5"),
+                      ("trpc_drain_deadline_ms", "5"),
+                      ("trpc_naming_lease_ms", "1"),
+                      ("trpc_naming_watch_ms", "0")]:
+        with pytest.raises(ValueError):
+            set_flag(name, bad)
+    # Round-trip a good value.
+    set_flag("trpc_cluster_subset_size", "8")
+    assert get_flag("trpc_cluster_subset_size") == "8"
+    set_flag("trpc_cluster_subset_size", "0")

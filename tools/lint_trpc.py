@@ -45,6 +45,14 @@ Rules (each names the incident class it prevents):
                      rule catches renames/renumbers/one-sided additions,
                      the same incident class as tail-group.
 
+  flag-exists        Every `trpc_*` flag name a Python surface, tool or
+                     test references literally (set_flag/get_flag) must
+                     be defined by a `Flag::define_*` in the C++ runtime.
+                     A typo'd name in tooling (e.g. the ISSUE 12
+                     trpc_cluster_*/trpc_drain_*/trpc_naming_* knobs)
+                     otherwise only fails at run time, on the one box
+                     that exercises that code path.
+
   atomic-comment     Every memory_order_relaxed / memory_order_acquire
                      in the socket/messenger/qos/stripe hot paths must
                      carry a justification comment (same line or within
@@ -295,6 +303,39 @@ def check_timeline_events() -> None:
              "— a one-sided event type breaks every recorded binary dump")
 
 
+# ---- flag-exists ---------------------------------------------------------
+
+def check_flag_references() -> None:
+    # Flags the C++ runtime defines with a literal name — directly
+    # (Flag::define_*) or through a defining wrapper (rma.cc int_flag,
+    # per-file *_flag helpers), whose idiom is `<something>flag(\n "name"`.
+    defined = set()
+    defpat = re.compile(
+        r'(?:define_(?:bool|int64|double|string)|[a-z_]*flag)\(\s*'
+        r'"(trpc_[a-z0-9_]+)"')
+    for path in runtime_files():
+        for m in defpat.finditer(path.read_text()):
+            defined.add(m.group(1))
+    # Names minted at runtime from dynamic strings (per-method bounds).
+    dynamic_prefixes = ("max_concurrency_",)
+    ref = re.compile(r'(?:set_flag|get_flag|trpc_flag_set|trpc_flag_get)'
+                     r'\(\s*[bf]?"(trpc_[a-z0-9_]+)"')
+    py_roots = [REPO / "brpc_tpu", REPO / "tools", REPO / "tests",
+                REPO / "bench.py"]
+    for root in py_roots:
+        files = [root] if root.is_file() else sorted(root.rglob("*.py"))
+        for p in files:
+            text = p.read_text()
+            for m in ref.finditer(text):
+                name = m.group(1)
+                if name in defined or name.startswith(dynamic_prefixes):
+                    continue
+                line = text[:m.start()].count("\n") + 1
+                flag(p, line, "flag-exists",
+                     f"flag '{name}' is referenced here but no "
+                     "Flag::define_* in cpp/ defines it")
+
+
 # ---- atomic-comment ------------------------------------------------------
 
 ATOMIC_FILES = [
@@ -328,6 +369,7 @@ def main() -> int:
     check_capi_bindings()
     check_tail_groups()
     check_timeline_events()
+    check_flag_references()
     check_atomic_comments()
     if violations:
         print(f"lint_trpc: {len(violations)} violation(s)")

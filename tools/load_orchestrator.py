@@ -306,6 +306,374 @@ def run_worker(args) -> None:
         c.sock.close()
 
 
+# ---- rolling restart (ISSUE 12) ------------------------------------------
+#
+# Zero-downtime drain + hot restart of one node in a 3-node cluster,
+# driven end to end: a hub process hosts the naming + KV registries,
+# node processes announce themselves and publish KV blocks, worker
+# processes drive mixed 1KB + striped load through
+# ClusterChannel("naming://...") under deterministic subsetting
+# (trpc_cluster_subset_size — the fd-cap discipline), and a KV puller
+# fetches the nodes' blocks with naming-aware re-resolution.  Mid-run,
+# node 0 drains: its announcement withdraws (watchers re-balance), its
+# KV blocks tombstone, its SO_REUSEPORT listeners hand off to a fresh
+# successor process which re-announces under a newer epoch and
+# re-publishes the blocks under a newer generation.  The report stamps
+# client-visible errors (must be 0), steady vs drain-window p99, and
+# stale KV admits (must be 0).
+
+def _percentile(vals: list, p: float) -> float:
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    return s[min(len(s) - 1, int(len(s) * p))]
+
+
+def _block_bytes(bid: int, gen: int, n: int) -> bytes:
+    """Deterministic block content with a (bid, gen) header: a fetch
+    that returns a LOWER embedded generation than one already observed
+    is a stale admit — the thing the generation fence must prevent."""
+    hdr = struct.pack("<QQ", bid, gen)
+    pat = bytes((i * 131 + bid * 7 + gen * 13) % 251 for i in range(256))
+    body = (pat * ((n - 16) // 256 + 1))[:n - 16]
+    return hdr + body
+
+
+def run_rr_hub(args) -> None:
+    sys.path.insert(0, str(REPO))
+    from brpc_tpu.rpc import Server
+
+    srv = Server()
+    srv.enable_naming_registry()
+    srv.enable_kv_registry()
+    srv.start(0)
+    print(json.dumps({"port": srv.port}), flush=True)
+    for line in sys.stdin:
+        if line.strip() == "quit":
+            break
+    srv.close()
+
+
+def _publish_blocks(srv_port: int, hub_addr: str, index: int, blocks: int,
+                    block_bytes: int, gen: int, min_generation: int = 0):
+    """Publish this node's blocks (ids index*100+i) + register at the
+    hub.  Returns (pages, registry_client) — both must stay alive."""
+    from brpc_tpu.rpc import Channel, RmaBuffer, kv
+
+    addr = f"127.0.0.1:{srv_port}"
+    pages = RmaBuffer(max(blocks * block_bytes, 1 << 16))
+    view = memoryview(pages.view).cast("B")
+    reg = kv.KvRegistryClient(Channel(hub_addr, timeout_ms=5000),
+                              owns_channel=True)
+    for i in range(blocks):
+        bid = (index + 1) * 100 + i  # ids start at 100: block 0 is reserved
+        view[i * block_bytes:(i + 1) * block_bytes] = \
+            _block_bytes(bid, gen, block_bytes)
+        m = kv.publish(bid, pages, offset=i * block_bytes,
+                       length=block_bytes, lease_ms=600000, node=addr,
+                       min_generation=min_generation)
+        reg.register(m, lease_ms=600000)
+    return pages, reg
+
+
+def run_rr_node(args) -> None:
+    sys.path.insert(0, str(REPO))
+    from brpc_tpu.rpc import Server
+
+    hub_addr = f"127.0.0.1:{args.port}"
+    srv = Server()
+    srv.register_native_echo("Echo.Echo")
+    srv.enable_kv_store()
+    srv.start(0)
+    srv.announce(hub_addr, "echo")
+    pages, reg = _publish_blocks(srv.port, hub_addr, args.index,
+                                 args.blocks, args.block_bytes, gen=1)
+    print(json.dumps({"port": srv.port}), flush=True)
+    for line in sys.stdin:
+        cmd = line.strip().split()
+        if not cmd:
+            continue
+        if cmd[0] == "drain":
+            ok = srv.drain(deadline_ms=20000, handoff_path=cmd[1])
+            print(json.dumps({"drained": ok}), flush=True)
+        elif cmd[0] == "quit":
+            break
+    reg.close()
+    pages.free()
+    srv.close()
+
+
+def run_rr_succ(args) -> None:
+    """Hot-restart successor: adopts the draining node's listeners,
+    re-announces the endpoint under a newer epoch, and re-publishes its
+    KV blocks under a newer generation (fresh pid => fresh rkeys; the
+    min_generation floor keeps the registry's zombie fence satisfied)."""
+    sys.path.insert(0, str(REPO))
+    from brpc_tpu.rpc import Channel, Server, kv
+
+    hub_addr = f"127.0.0.1:{args.port}"
+    srv = Server()
+    srv.register_native_echo("Echo.Echo")
+    srv.enable_kv_store()
+    srv.start_from_handoff(args.handoff, 30000)
+    srv.announce(hub_addr, "echo")
+    probe = kv.KvRegistryClient(Channel(hub_addr, timeout_ms=5000),
+                                owns_channel=True)
+    old_gens = {}
+    for i in range(args.blocks):
+        bid = (args.index + 1) * 100 + i
+        try:
+            old_gens[bid] = probe.lookup(bid).generation
+        except kv.KvError:
+            old_gens[bid] = 1
+    probe.close()
+    min_gen = max(old_gens.values()) + 1
+    pages, reg = _publish_blocks(srv.port, hub_addr, args.index,
+                                 args.blocks, args.block_bytes,
+                                 gen=min_gen, min_generation=min_gen)
+    print(json.dumps({"adopted_port": srv.port, "generation": min_gen}),
+          flush=True)
+    for line in sys.stdin:
+        if line.strip() == "quit":
+            break
+    reg.close()
+    pages.free()
+    srv.close()
+
+
+def run_rr_worker(args) -> None:
+    sys.path.insert(0, str(REPO))
+    from brpc_tpu.rpc import ClusterChannel, set_flag
+
+    if args.subset > 0:
+        # fd-budget discipline (mandatory under this box's 20k fd cap at
+        # real scale): each worker holds channels to `subset` of the
+        # cluster, rendezvous-picked per pid.
+        set_flag("trpc_cluster_subset_size", str(args.subset))
+    ch = ClusterChannel(f"naming://127.0.0.1:{args.port}/echo", lb="rr",
+                        timeout_ms=5000, max_retry=2,
+                        refresh_interval_ms=500)
+    small = b"x" * args.small_bytes
+    big = b"y" * args.big_bytes
+    samples = []  # (wall_s, latency_us, ok)
+    errors = 0
+    i = 0
+    end = time.time() + args.seconds
+    while time.time() < end:
+        payload = big if args.big_every > 0 and i % args.big_every == 0 \
+            else small
+        w = time.time()
+        t0 = time.perf_counter()
+        try:
+            ok = len(ch.call("Echo.Echo", payload)) == len(payload)
+        except Exception:
+            ok = False
+        lat_us = (time.perf_counter() - t0) * 1e6
+        if not ok:
+            errors += 1
+        samples.append((w, lat_us, ok))
+        i += 1
+    ch.close()
+    # The drain window is known only after the fact: the orchestrator
+    # writes it once the drain cycle completes.  WAIT for it (bounded) —
+    # reporting without it would make the drain-window p99 acceptance
+    # pass vacuously whenever the drain outlasts the load.
+    window = None
+    wait_deadline = time.time() + 30
+    while window is None and time.time() < wait_deadline:
+        try:
+            with open(args.window_file) as f:
+                window = json.load(f)
+        except (OSError, json.JSONDecodeError, TypeError):
+            time.sleep(0.1)
+    steady = [lat for w, lat, _ in samples
+              if window is None or not (
+                  window["start"] <= w <= window["end"])]
+    drained = [lat for w, lat, _ in samples
+               if window is not None and
+               window["start"] <= w <= window["end"]]
+    print(json.dumps({
+        "index": args.index,
+        "calls": len(samples),
+        "errors": errors,
+        "steady_p99_us": round(_percentile(steady, 0.99)),
+        "drain_p99_us": round(_percentile(drained, 0.99)),
+        "drain_samples": len(drained),
+    }), flush=True)
+
+
+def run_rr_kvpuller(args) -> None:
+    """Fetches every node's blocks in a loop, verifying the embedded
+    (bid, gen) header.  Transient failures during the drain window are
+    retried (and counted); a fetch whose embedded generation moves
+    BACKWARD is a stale admit — the acceptance criterion is zero."""
+    sys.path.insert(0, str(REPO))
+    from brpc_tpu.rpc import kv
+
+    hub_addr = f"127.0.0.1:{args.port}"
+    cli = kv.KvClient(hub_addr, use_shm=False, timeout_ms=5000,
+                      naming_addr=hub_addr, naming_service="echo")
+    bids = [(n + 1) * 100 + i for n in range(args.nodes)
+            for i in range(args.blocks)]
+    fetches = 0
+    transient = 0
+    stale_admits = 0
+    mismatches = 0
+    max_gen = {}
+    end = time.time() + args.seconds
+    while time.time() < end:
+        for bid in bids:
+            if time.time() >= end:
+                break
+            try:
+                data = cli.fetch(bid)
+            except Exception:
+                transient += 1
+                time.sleep(0.05)
+                continue
+            fetches += 1
+            got_bid, got_gen = struct.unpack_from("<QQ", data)
+            if got_bid != bid or \
+                    data != _block_bytes(bid, got_gen, len(data)):
+                mismatches += 1
+            if got_gen < max_gen.get(bid, 0):
+                stale_admits += 1  # generation moved BACKWARD: stale
+            max_gen[bid] = max(max_gen.get(bid, 0), got_gen)
+    cli.close()
+    print(json.dumps({
+        "fetches": fetches,
+        "transient_retries": transient,
+        "stale_admits": stale_admits,
+        "mismatches": mismatches,
+        "reresolves": cli.node_reresolves,
+        "takeover_gens": {str(k): v for k, v in max_gen.items()
+                          if v > 1},
+    }), flush=True)
+
+
+def run_rolling_restart(args) -> int:
+    raise_fd_limit(8192)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    me = str(pathlib.Path(__file__).resolve())
+
+    def spawn(role: str, *extra: str) -> subprocess.Popen:
+        return subprocess.Popen(
+            [sys.executable, me, "--role", role, *extra],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env,
+            text=True)
+
+    t_start = time.monotonic()
+    hub = spawn("rr-hub")
+    hub_port = json.loads(hub.stdout.readline())["port"]
+
+    nodes = []
+    for i in range(args.nodes):
+        n = spawn("rr-node", "--index", str(i), "--port", str(hub_port),
+                  "--blocks", str(args.blocks),
+                  "--block-bytes", str(args.block_bytes))
+        nodes.append(n)
+    node_ports = [json.loads(n.stdout.readline())["port"] for n in nodes]
+
+    window_file = f"/tmp/trpc_rr_window_{os.getpid()}.json"
+    handoff = f"/tmp/trpc_rr_handoff_{os.getpid()}.sock"
+    try:
+        os.unlink(window_file)
+    except OSError:
+        pass
+
+    workers = [spawn("rr-worker", "--index", str(i),
+                     "--port", str(hub_port),
+                     "--seconds", str(args.seconds),
+                     "--big-every", str(args.big_every),
+                     "--big-bytes", str(args.big_bytes),
+                     "--small-bytes", str(args.small_bytes),
+                     "--subset", str(args.subset),
+                     "--window-file", window_file)
+               for i in range(args.rr_workers)]
+    puller = spawn("rr-kvpuller", "--port", str(hub_port),
+                   "--seconds", str(args.seconds),
+                   "--nodes", str(args.nodes),
+                   "--blocks", str(args.blocks))
+
+    # Steady-state ramp, then the drain + handoff cycle on node 0.
+    time.sleep(min(2.0, args.seconds / 4))
+    t_drain0 = time.time()
+    succ = spawn("rr-succ", "--index", "0", "--port", str(hub_port),
+                 "--handoff", handoff, "--blocks", str(args.blocks),
+                 "--block-bytes", str(args.block_bytes))
+    nodes[0].stdin.write(f"drain {handoff}\n")
+    nodes[0].stdin.flush()
+    drain_report = json.loads(nodes[0].stdout.readline())
+    succ_report = json.loads(succ.stdout.readline())
+    t_drain1 = time.time()
+    with open(window_file, "w") as f:
+        json.dump({"start": t_drain0, "end": t_drain1}, f)
+
+    worker_reports = [json.loads(w.stdout.readline()) for w in workers]
+    puller_report = json.loads(puller.stdout.readline())
+    for w in workers:
+        w.wait(timeout=60)
+    puller.wait(timeout=60)
+    for p, msg in [(nodes[0], "quit"), (succ, "quit"), (hub, "quit")] + \
+            [(n, "quit") for n in nodes[1:]]:
+        try:
+            p.stdin.write(msg + "\n")
+            p.stdin.flush()
+        except (BrokenPipeError, ValueError):
+            pass
+    for p in nodes + [succ, hub]:
+        p.wait(timeout=60)
+    try:
+        os.unlink(window_file)
+    except OSError:
+        pass
+
+    errors = sum(r["errors"] for r in worker_reports)
+    calls = sum(r["calls"] for r in worker_reports)
+    steady = [r["steady_p99_us"] for r in worker_reports
+              if r["steady_p99_us"] > 0]
+    drain = [r["drain_p99_us"] for r in worker_reports
+             if r["drain_samples"] > 0]
+    drain_samples_total = sum(r["drain_samples"] for r in worker_reports)
+    steady_p99 = max(steady) if steady else 0
+    drain_p99 = max(drain) if drain else 0
+    ratio = round(drain_p99 / steady_p99, 3) if steady_p99 and drain_p99 \
+        else 0.0
+    summary = {
+        "mode": "rolling_restart",
+        "nodes": args.nodes,
+        "workers": args.rr_workers,
+        "seconds": args.seconds,
+        "subset": args.subset,
+        "calls": calls,
+        "errors": errors,
+        "steady_p99_us": steady_p99,
+        "drain_p99_us": drain_p99,
+        "drain_p99_ratio": ratio,
+        "drain_samples_total": drain_samples_total,
+        "drain_window_s": round(t_drain1 - t_drain0, 3),
+        "drained_clean": drain_report.get("drained", False),
+        "adopted_port": succ_report.get("adopted_port"),
+        "takeover_generation": succ_report.get("generation"),
+        "same_port": succ_report.get("adopted_port") == node_ports[0],
+        "kv": puller_report,
+        "elapsed_s": round(time.monotonic() - t_start, 1),
+    }
+    print(json.dumps(summary, indent=None if args.json else 2), flush=True)
+    # The p99 criterion must be MEASURED, not vacuously true: at least
+    # one call has to land inside the drain window.
+    ok = (errors == 0 and calls > 0 and
+          summary["drained_clean"] and summary["same_port"] and
+          puller_report["stale_admits"] == 0 and
+          puller_report["mismatches"] == 0 and
+          puller_report["fetches"] > 0 and
+          drain_samples_total > 0 and steady_p99 > 0 and
+          ratio > 0 and ratio <= 2.0)
+    return 0 if ok else 1
+
+
 # ---- orchestrator --------------------------------------------------------
 
 def run_orchestrator(args) -> int:
@@ -402,8 +770,28 @@ def run_orchestrator(args) -> int:
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--role", choices=["orchestrator", "server", "worker"],
+    ap.add_argument("--role",
+                    choices=["orchestrator", "server", "worker", "rr-hub",
+                             "rr-node", "rr-succ", "rr-worker",
+                             "rr-kvpuller"],
                     default="orchestrator")
+    ap.add_argument("--rolling-restart", action="store_true",
+                    help="ISSUE 12 acceptance cycle: drain + hot-restart "
+                         "one node of a 3-node naming-backed cluster "
+                         "under mixed 1KB + striped load and KV pulls; "
+                         "reports errors (must be 0), steady vs drain-"
+                         "window p99, and stale KV admits (must be 0)")
+    ap.add_argument("--seconds", type=float, default=10.0,
+                    help="rolling-restart load duration per worker")
+    ap.add_argument("--nodes", type=int, default=3)
+    ap.add_argument("--rr-workers", type=int, default=2)
+    ap.add_argument("--blocks", type=int, default=2,
+                    help="KV blocks published per node")
+    ap.add_argument("--block-bytes", type=int, default=256 << 10)
+    ap.add_argument("--subset", type=int, default=2,
+                    help="trpc_cluster_subset_size per worker (0 = off)")
+    ap.add_argument("--window-file", default="")
+    ap.add_argument("--handoff", default="")
     ap.add_argument("--conns", type=int, default=100_000)
     ap.add_argument("--workers", type=int, default=12)
     ap.add_argument("--big-every", type=int, default=1000,
@@ -453,6 +841,23 @@ def main() -> int:
     if args.role == "worker":
         run_worker(args)
         return 0
+    if args.role == "rr-hub":
+        run_rr_hub(args)
+        return 0
+    if args.role == "rr-node":
+        run_rr_node(args)
+        return 0
+    if args.role == "rr-succ":
+        run_rr_succ(args)
+        return 0
+    if args.role == "rr-worker":
+        run_rr_worker(args)
+        return 0
+    if args.role == "rr-kvpuller":
+        run_rr_kvpuller(args)
+        return 0
+    if args.rolling_restart:
+        return run_rolling_restart(args)
     return run_orchestrator(args)
 
 
