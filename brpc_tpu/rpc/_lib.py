@@ -331,6 +331,59 @@ def load_library() -> ctypes.CDLL:
             lib.trpc_kv_withdraw_all.restype = ctypes.c_size_t
             lib.trpc_rma_spans_in_use.argtypes = []
             lib.trpc_rma_spans_in_use.restype = ctypes.c_size_t
+            # Collective transfer schedules (capi/coll_capi.cc;
+            # net/collective.h): group put plans over the RMA fabric.
+            lib.trpc_server_enable_collective.argtypes = [ctypes.c_void_p]
+            lib.trpc_server_enable_collective.restype = ctypes.c_int
+            lib.trpc_coll_group_create.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint32, ctypes.c_int64,
+                ctypes.c_int,
+            ]
+            lib.trpc_coll_group_create.restype = ctypes.c_void_p
+            lib.trpc_coll_group_create_naming.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.c_int,
+            ]
+            lib.trpc_coll_group_create_naming.restype = ctypes.c_void_p
+            lib.trpc_coll_group_destroy.argtypes = [ctypes.c_void_p]
+            lib.trpc_coll_group_destroy.restype = None
+            lib.trpc_coll_group_rank.argtypes = [ctypes.c_void_p]
+            lib.trpc_coll_group_rank.restype = ctypes.c_uint32
+            lib.trpc_coll_group_size.argtypes = [ctypes.c_void_p]
+            lib.trpc_coll_group_size.restype = ctypes.c_uint32
+            lib.trpc_coll_group_version.argtypes = [ctypes.c_void_p]
+            lib.trpc_coll_group_version.restype = ctypes.c_uint64
+            lib.trpc_coll_run.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
+                ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_uint64,
+            ]
+            lib.trpc_coll_run.restype = ctypes.c_int
+            lib.trpc_coll_reshard_run.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+                ctypes.c_uint32, ctypes.c_uint64, ctypes.c_void_p,
+                ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.c_uint64,
+            ]
+            lib.trpc_coll_reshard_run.restype = ctypes.c_int
+            lib.trpc_coll_reshard_plan.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32,
+                ctypes.c_uint64, ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint32),
+            ]
+            lib.trpc_coll_reshard_plan.restype = ctypes.c_int
+            lib.trpc_coll_codes.argtypes = [
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+            ]
+            lib.trpc_coll_codes.restype = None
+            lib.trpc_coll_sessions.argtypes = []
+            lib.trpc_coll_sessions.restype = ctypes.c_size_t
+            lib.trpc_rma_scavenge.argtypes = []
+            lib.trpc_rma_scavenge.restype = ctypes.c_size_t
             # RPC surface (capi/rpc_capi.cc).
             lib.trpc_server_create.restype = ctypes.c_void_p
             lib.trpc_server_destroy.argtypes = [ctypes.c_void_p]

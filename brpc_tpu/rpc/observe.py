@@ -319,12 +319,19 @@ TIMELINE_EVENTS = {
     20: "stripe_done",    # timeline-event 20 (stripe_done)
     21: "qos_drain",      # timeline-event 21 (qos_drain)
     22: "kv_block",       # timeline-event 22 (kv_block)
+    23: "coll_step",      # timeline-event 23 (coll_step)
 }
 
 # kKvBlock `b` op tags (cpp/net/kvstore.h: b = op << 56 | payload len) —
 # how a kv_block event reads: the store published / served / evicted a
 # block, or rejected a stale-generation fetch.
 TIMELINE_KV_OPS = {1: "publish", 2: "serve", 3: "evict", 4: "stale"}
+
+# kCollStep `b` op tags (cpp/net/collective.h CollOp: b = op << 56 |
+# step bytes; a = step index) — one event per completed collective
+# schedule step on the member that completed it.
+TIMELINE_COLL_OPS = {1: "all_gather", 2: "reduce_scatter",
+                     3: "all_to_all", 4: "reshard"}
 
 # kStripeSend rail index meaning "the call's primary socket" (head
 # frame / dead-rail fallback) — cpp/stat/timeline.h kStripePrimaryRail.

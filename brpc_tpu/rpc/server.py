@@ -107,6 +107,16 @@ class Server:
         if self._lib.trpc_server_enable_kv_registry(self._ptr) != 0:
             raise RuntimeError("enable_kv_registry failed (server running?)")
 
+    def enable_collective(self) -> None:
+        """Attaches the NATIVE collective handlers (Coll.Put/Abort,
+        Reshard.Plan/Execute, cpp/net/collective.h): this server can
+        receive group put schedules — chunks land one-sided through the
+        RMA plane and wake the local member's step countdown — and
+        serve the resharding service (Plan is stateless; Execute moves
+        KV-block-addressed shards).  Call before start."""
+        if self._lib.trpc_server_enable_collective(self._ptr) != 0:
+            raise RuntimeError("enable_collective failed (server running?)")
+
     def enable_naming_registry(self) -> None:
         """Attaches the NATIVE naming-registry handlers
         (Naming.Announce/Withdraw/Resolve/Watch, cpp/net/naming.h): this

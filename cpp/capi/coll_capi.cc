@@ -1,0 +1,224 @@
+// C ABI for collective transfer schedules (net/collective.h) — the
+// Python surface brpc_tpu/rpc/collective.py binds.  The data plane stays
+// native: puts ride the one-sided RMA fabric with no Python in the path;
+// these entry points compile groups/plans and block (GIL released by
+// ctypes) while a schedule runs.
+#include <string.h>
+
+#include <string>
+#include <vector>
+
+#include "net/channel.h"
+#include "net/collective.h"
+#include "net/rma.h"
+#include "net/server.h"
+
+using namespace trpc;
+
+namespace {
+
+// Unpacks ShardRangeWire rows (collective.py packs the same wire it
+// sends to Reshard.Plan — one marshalling, two consumers).
+void unpack_sharding(const void* rows, uint32_t count, uint64_t total,
+                     uint32_t skip, Sharding* out) {
+  out->total = total;
+  const auto* w = static_cast<const ShardRangeWire*>(rows) + skip;
+  for (uint32_t i = 0; i < count; ++i) {
+    ShardRange r;
+    r.rank = w[i].rank;
+    r.off = w[i].off;
+    r.len = w[i].len;
+    out->ranges.push_back(r);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Attaches the native handlers (Coll.Put/Abort, Reshard.Plan/Execute)
+// to a not-yet-started server.  Returns 0, or -1.
+int trpc_server_enable_collective(void* srv) {
+  return coll_attach(static_cast<Server*>(srv));
+}
+
+// Compiles a group from a comma-separated ordered member list (every
+// member passes the SAME list; members[my_rank] is this process).
+// Returns an opaque handle, or NULL.
+void* trpc_coll_group_create(const char* members_csv, uint32_t my_rank,
+                             int64_t timeout_ms, int use_shm) {
+  if (members_csv == nullptr) {
+    return nullptr;
+  }
+  std::vector<std::string> members;
+  const char* p = members_csv;
+  while (*p != '\0') {
+    const char* comma = strchr(p, ',');
+    members.emplace_back(p, comma != nullptr ? comma - p : strlen(p));
+    if (comma == nullptr) {
+      break;
+    }
+    p = comma + 1;
+  }
+  auto* g = new GroupChannel();
+  GroupChannel::Options opts;
+  opts.timeout_ms = timeout_ms > 0 ? timeout_ms : 30000;
+  opts.use_shm = use_shm != 0;
+  if (g->Init(members, my_rank, &opts) != 0) {
+    delete g;
+    return nullptr;
+  }
+  return g;
+}
+
+// Snapshots a naming:// view ("naming://host:port/service") into a
+// group; self_addr must be an announced member.  Returns NULL when the
+// resolve fails or self is not a member.
+void* trpc_coll_group_create_naming(const char* naming_url,
+                                    const char* self_addr,
+                                    int64_t timeout_ms, int use_shm) {
+  if (naming_url == nullptr || self_addr == nullptr) {
+    return nullptr;
+  }
+  auto* g = new GroupChannel();
+  GroupChannel::Options opts;
+  opts.timeout_ms = timeout_ms > 0 ? timeout_ms : 30000;
+  opts.use_shm = use_shm != 0;
+  if (g->InitNaming(naming_url, self_addr, &opts) != 0) {
+    delete g;
+    return nullptr;
+  }
+  return g;
+}
+
+void trpc_coll_group_destroy(void* g) {
+  delete static_cast<GroupChannel*>(g);
+}
+
+uint32_t trpc_coll_group_rank(void* g) {
+  return static_cast<GroupChannel*>(g)->my_rank();
+}
+
+uint32_t trpc_coll_group_size(void* g) {
+  return static_cast<GroupChannel*>(g)->nmembers();
+}
+
+uint64_t trpc_coll_group_version(void* g) {
+  return static_cast<GroupChannel*>(g)->naming_version();
+}
+
+// Runs one collective (op: 1 all_gather, 2 reduce_scatter, 3 all_to_all
+// — CollOp values).  shard_bytes is the per-member shard (all_gather:
+// send size; reduce_scatter: recv size; all_to_all: send_len/n when 0).
+// reduce_scatter MUTATES sendbuf (ring accumulator).  Blocks until the
+// schedule completes; every member must call with the same run_seq
+// sequence (0 = the group's internal counter).  Returns 0, a coll error
+// code (2121..2123), or a transport errno.
+int trpc_coll_run(void* g, int op, const void* sendbuf, uint64_t send_len,
+                  void* recvbuf, uint64_t recv_len, uint64_t shard_bytes,
+                  uint64_t run_seq) {
+  auto* group = static_cast<GroupChannel*>(g);
+  TransferSchedule plan;
+  switch (op) {
+    case 1:
+      plan = plan_all_gather(group->nmembers(),
+                             shard_bytes != 0 ? shard_bytes : send_len);
+      break;
+    case 2:
+      plan = plan_reduce_scatter(
+          group->nmembers(),
+          shard_bytes != 0 ? shard_bytes : recv_len);
+      break;
+    case 3:
+      if (shard_bytes == 0) {
+        // A remainder would silently drop the tail (the shard floors).
+        if (group->nmembers() == 0 ||
+            send_len % group->nmembers() != 0) {
+          return kECollMismatch;
+        }
+        shard_bytes = send_len / group->nmembers();
+      }
+      plan = plan_all_to_all(group->nmembers(), shard_bytes);
+      break;
+    default:
+      return kECollMismatch;
+  }
+  return group->run(plan, sendbuf, send_len, recvbuf, recv_len, run_seq);
+}
+
+// Runs a reshard over the group.  `ranges` is (nsrc + ndst) packed
+// ShardRangeWire rows (source rows first — the same wire collective.py
+// sends to Reshard.Plan).  sendbuf holds this rank's source ranges
+// concatenated; recvbuf receives its target ranges.  Returns like
+// trpc_coll_run.
+int trpc_coll_reshard_run(void* g, const void* ranges, uint32_t nsrc,
+                          uint32_t ndst, uint64_t total,
+                          const void* sendbuf, uint64_t send_len,
+                          void* recvbuf, uint64_t recv_len,
+                          uint64_t run_seq) {
+  auto* group = static_cast<GroupChannel*>(g);
+  Sharding src, dst;
+  unpack_sharding(ranges, nsrc, total, 0, &src);
+  unpack_sharding(ranges, ndst, total, nsrc, &dst);
+  if (!sharding_valid(src, group->nmembers()) ||
+      !sharding_valid(dst, group->nmembers())) {
+    return kECollMismatch;
+  }
+  return group->run(plan_reshard(src, dst, group->nmembers()), sendbuf,
+                    send_len, recvbuf, recv_len, run_seq);
+}
+
+// Plans a reshard WITHOUT executing (local, no RPC): fills the bytes the
+// schedule would move / reuse and the naive full-exchange baseline —
+// the minimality stamp bench rows and tests assert.  Returns 0, or -1
+// on invalid shardings.
+int trpc_coll_reshard_plan(const void* ranges, uint32_t nsrc,
+                           uint32_t ndst, uint64_t total,
+                           uint32_t nmembers, uint64_t* moved,
+                           uint64_t* reused, uint64_t* naive_out,
+                           uint32_t* steps_out) {
+  Sharding src, dst;
+  unpack_sharding(ranges, nsrc, total, 0, &src);
+  unpack_sharding(ranges, ndst, total, nsrc, &dst);
+  if (!sharding_valid(src, nmembers) || !sharding_valid(dst, nmembers)) {
+    return -1;
+  }
+  const TransferSchedule plan = plan_reshard(src, dst, nmembers);
+  if (moved != nullptr) {
+    *moved = plan.bytes_moved();
+  }
+  if (reused != nullptr) {
+    *reused = plan.bytes_reused();
+  }
+  if (naive_out != nullptr) {
+    *naive_out = reshard_naive_bytes(src, nmembers);
+  }
+  if (steps_out != nullptr) {
+    *steps_out = static_cast<uint32_t>(plan.steps.size());
+  }
+  return 0;
+}
+
+// The coll error-code family (net/collective.h), read once by
+// collective.py so the Python exception mapping can never drift.
+void trpc_coll_codes(int* abort_code, int* epoch, int* mismatch) {
+  if (abort_code != nullptr) {
+    *abort_code = kECollAbort;
+  }
+  if (epoch != nullptr) {
+    *epoch = kECollEpoch;
+  }
+  if (mismatch != nullptr) {
+    *mismatch = kECollMismatch;
+  }
+}
+
+// Receive sessions currently registered (0 = quiesced; tests).
+size_t trpc_coll_sessions() { return coll_sessions_live(); }
+
+// One explicit scavenger pass over this process's receive windows
+// (net/rma.h rma_scavenge); returns slots reclaimed.  The runtime also
+// runs it lazily (resolve tick + drain poll) — this is for tests/tools.
+size_t trpc_rma_scavenge() { return rma_scavenge(); }
+
+}  // extern "C"

@@ -173,6 +173,7 @@ class Controller {
     // buffer instead of riding frames back.
     uint64_t rma_resp_rkey = 0;
     uint64_t rma_resp_max = 0;
+    uint64_t rma_resp_off = 0;
     std::vector<uint64_t> stripe_rails;
   };
   CallState& call() { return call_; }

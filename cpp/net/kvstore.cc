@@ -321,6 +321,39 @@ int KvStore::fetch(uint64_t block_id, uint64_t expected_gen, IOBuf* out) {
   return 0;
 }
 
+int KvStore::pin(uint64_t block_id, uint64_t expected_gen,
+                 const char** data, uint64_t* len,
+                 std::shared_ptr<RmaMapping>* map, uint64_t* gen_out) {
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = blocks_.find(block_id);
+  const int64_t now = monotonic_time_us();
+  if (it == blocks_.end() || it->second.deadline_us <= now) {
+    if (it != blocks_.end()) {
+      evict_locked(block_id, /*count_var=*/true);  // serve-time validity
+    }
+    return tombstones_.find(block_id) != tombstones_.end() ? kEKvStale
+                                                           : kEKvMiss;
+  }
+  Block& b = it->second;
+  if (expected_gen != 0 && b.meta.generation != expected_gen) {
+    return kEKvStale;
+  }
+  b.touch_seq = ++touch_counter_;
+  if (data != nullptr) {
+    *data = b.data;
+  }
+  if (len != nullptr) {
+    *len = b.meta.len;
+  }
+  if (map != nullptr) {
+    *map = b.map;
+  }
+  if (gen_out != nullptr) {
+    *gen_out = b.meta.generation;
+  }
+  return 0;
+}
+
 size_t KvStore::count() {
   std::lock_guard<std::mutex> g(mu_);
   return blocks_.size();

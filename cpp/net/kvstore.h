@@ -154,6 +154,15 @@ class KvStore {
   // IOBuf deleter).  Returns 0, kEKvStale (generation mismatch, lease
   // lapsed, or evicted-but-tombstoned) or kEKvMiss (never seen).
   int fetch(uint64_t block_id, uint64_t expected_gen, IOBuf* out);
+  // In-process zero-copy access for group-transfer machinery
+  // (net/collective.h Reshard.Execute): pins the block's region mapping
+  // and hands out the raw bytes.  expected_gen 0 accepts any live
+  // generation.  Validity is decided now, like fetch; the returned
+  // mapping reference keeps the pages alive past rma_free.  Returns 0,
+  // kEKvStale, or kEKvMiss.
+  int pin(uint64_t block_id, uint64_t expected_gen, const char** data,
+          uint64_t* len, std::shared_ptr<RmaMapping>* map,
+          uint64_t* gen_out);
 
   size_t count();
   uint64_t bytes_used();

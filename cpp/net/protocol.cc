@@ -115,13 +115,14 @@ std::string encode_meta(const RpcMeta& m) {
             s.append(m.qos_tenant.data(), tlen);
             if (has_rma) {
               // tail-group 6 (rma): one-sided transfer descriptor +
-              // response-landing advertisement (net/rma.h), 44B.
+              // response-landing advertisement (net/rma.h), 52B.
               put_u64(&s, m.rma_rkey);
               put_u64(&s, m.rma_off);
               put_u64(&s, m.rma_len);
               put_u32(&s, m.rma_chunk);
               put_u64(&s, m.rma_resp_rkey);
               put_u64(&s, m.rma_resp_max);
+              put_u64(&s, m.rma_resp_off);
             }
           }
         }
@@ -210,7 +211,17 @@ bool decode_meta(const std::string& s, RpcMeta* m) {
               m->rma_chunk = get_u32(p + 24);
               m->rma_resp_rkey = get_u64(p + 28);
               m->rma_resp_max = get_u64(p + 36);
-              p += 44;
+              if (end - p >= 52) {
+                m->rma_resp_off = get_u64(p + 44);
+                p += 52;
+              } else {
+                // Previous-version frame (44B group, pre-rma_resp_off):
+                // the descriptor is intact, the landing offset defaults
+                // to the region start — mixed-version one-sided traffic
+                // keeps working across a rolling upgrade.
+                m->rma_resp_off = 0;
+                p += 44;
+              }
             }
           }
         }

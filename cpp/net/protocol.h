@@ -100,14 +100,17 @@ struct RpcMeta {
   // in rma_chunk-sized chunks whose release-fenced completion bits the
   // receiver verifies before dispatch.  rma_resp_rkey/rma_resp_max on a
   // REQUEST advertise the caller's registered landing region so the
-  // response can be put straight into the caller's buffer.  Sixth
-  // optional wire-tail group — all-zero (absent) on every non-rma frame.
+  // response can be put straight into the caller's buffer, rma_resp_off
+  // bytes into its data area (collective pulls land a shard mid-region;
+  // 0 = the region start, the batch-plane shape).  Sixth optional
+  // wire-tail group — all-zero (absent) on every non-rma frame.
   uint64_t rma_rkey = 0;
   uint64_t rma_off = 0;
   uint64_t rma_len = 0;
   uint32_t rma_chunk = 0;
   uint64_t rma_resp_rkey = 0;
   uint64_t rma_resp_max = 0;
+  uint64_t rma_resp_off = 0;
   std::string method;
   std::string error_text;
 
@@ -139,6 +142,7 @@ struct RpcMeta {
     rma_chunk = 0;
     rma_resp_rkey = 0;
     rma_resp_max = 0;
+    rma_resp_off = 0;
     method.clear();
     error_text.clear();
   }

@@ -21,6 +21,7 @@
 #include "net/ici_transport.h"
 #include "net/socket.h"
 #include "net/stripe.h"
+#include "stat/reducer.h"
 #include "stat/timeline.h"
 
 namespace trpc {
@@ -144,8 +145,21 @@ Flag* ici_rails_flag() {
   return f;
 }
 
+Flag* scavenge_flag() {
+  static Flag* f = int_flag(
+      "trpc_rma_span_scavenge_ms", 10000,
+      "age after which an allocated-but-never-admitted receive-window "
+      "span is reclaimed (ms, [50, 600000]) — a dropped control frame "
+      "(chaos, dying sender) otherwise leaks the slots until connection "
+      "teardown, and group-transfer schedules hammer the window hard "
+      "enough that the leak stops being theoretical; must exceed the "
+      "slowest legitimate write+control latency",
+      50, 600000);
+  return f;
+}
+
 [[maybe_unused]] Flag* const g_rma_flags_eager[] = {
-    window_flag(), shm_rails_flag(), ici_rails_flag()};
+    window_flag(), shm_rails_flag(), ici_rails_flag(), scavenge_flag()};
 
 // ---- registry ------------------------------------------------------------
 
@@ -161,6 +175,18 @@ struct RmaGeom {
   uint32_t nslots = 0;  // 0: plain region
 };
 
+// Scavenger state for one receive window (owner side).  `admitted`
+// marks slots whose span rma_resolve admitted and whose payload is
+// still referenced — exempt from scavenging however old; per-slot
+// first-seen stamps (guarded by reg_mu — only the scavenger pass reads
+// or writes them) age everything else.
+struct WindowScav {
+  // Release on set (admit) / clear (last payload ref dropped) pairs
+  // with the scavenger's acquire read: an admitted span is never aged.
+  std::atomic<uint64_t> admitted{0};
+  int64_t first_seen_us[kRmaWindowSlots] = {};
+};
+
 struct RegionRec {
   uint64_t rkey = 0;
   std::shared_ptr<RmaMapping> map;  // null for local pins (rma_reg)
@@ -168,6 +194,7 @@ struct RegionRec {
   const char* pin_base = nullptr;   // local pins: the pinned range
   size_t pin_len = 0;
   bool window = false;
+  std::shared_ptr<WindowScav> scav;  // windows only
   // rma_free arrived while a landing bind (an in-flight call's resp_buf)
   // still referenced this region: the striped copy-path fallback holds
   // the raw data pointer, so the unmap defers until the last bind drops
@@ -180,6 +207,7 @@ struct RegionRec {
 struct LandingBind {
   uint64_t rkey = 0;
   uint64_t cap = 0;
+  uint64_t off = 0;  // landing offset inside the region's data area
 };
 
 std::mutex& reg_mu() {
@@ -260,6 +288,9 @@ void* region_create(size_t data_len, bool window, uint64_t* rkey_out) {
   rec.map = mapping;
   rec.name = name;
   rec.window = window;
+  if (window) {
+    rec.scav = std::make_shared<WindowScav>();
+  }
   rec.geom.data_len = data_len;
   rec.geom.slot_bytes = h->slot_bytes;
   rec.geom.nslots = h->nslots;
@@ -275,8 +306,9 @@ void* region_create(size_t data_len, bool window, uint64_t* rkey_out) {
 
 // Local-registry lookup (receiver side; loopback peer resolution) with
 // the TRUSTED creation-time geometry.
-std::shared_ptr<RmaMapping> local_region(uint64_t rkey, bool* window,
-                                         RmaGeom* geom) {
+std::shared_ptr<RmaMapping> local_region(
+    uint64_t rkey, bool* window, RmaGeom* geom,
+    std::shared_ptr<WindowScav>* scav = nullptr) {
   std::lock_guard<std::mutex> g(reg_mu());
   for (const RegionRec& r : regions()) {
     if (r.rkey == rkey && r.map != nullptr) {
@@ -286,10 +318,22 @@ std::shared_ptr<RmaMapping> local_region(uint64_t rkey, bool* window,
       if (geom != nullptr) {
         *geom = r.geom;
       }
+      if (scav != nullptr) {
+        *scav = r.scav;
+      }
       return r.map;
     }
   }
   return nullptr;
+}
+
+// Slot-run mask of a span [off, off+need) under geometry g.
+uint64_t span_slot_mask(const RmaGeom& g, uint64_t off, uint64_t need) {
+  const uint32_t k =
+      static_cast<uint32_t>((need + g.slot_bytes - 1) / g.slot_bytes);
+  const uint32_t start = static_cast<uint32_t>(off / g.slot_bytes);
+  const uint64_t run = k >= 64 ? ~0ull : ((1ull << k) - 1);
+  return run << start;
 }
 
 // Cross-pid peer mappings cached by rkey (bounded, FIFO-evicted): the
@@ -708,13 +752,44 @@ std::shared_ptr<RmaMapping> resolve_peer_window(RmaSession* rs,
 // may run long after a hostile peer scribbled the live header.
 struct SpanCtx {
   std::shared_ptr<RmaMapping> map;
+  std::shared_ptr<WindowScav> scav;  // null when scav state is gone
   RmaGeom geom;
   uint64_t off = 0;
   uint64_t need = 0;
 };
 
+// Forgets the scavenger's first-seen stamps for a span's slots: called
+// whenever the OWNER knows the span's identity ended (payload freed, or
+// a faulted transfer rejected) so a successor span allocated into the
+// same slots ages from ITS OWN birth — without this, a busy slot
+// recycled between scavenger ticks would inherit its predecessor's age
+// and a healthy in-flight span could be reclaimed early.
+void scav_forget_span(WindowScav* scav, const RmaGeom& g, uint64_t off,
+                      uint64_t need) {
+  if (scav == nullptr) {
+    return;
+  }
+  const uint64_t mask = span_slot_mask(g, off, need);
+  std::lock_guard<std::mutex> lk(reg_mu());
+  for (uint32_t i = 0; i < kRmaWindowSlots; ++i) {
+    if ((mask & (1ull << i)) != 0) {
+      scav->first_seen_us[i] = 0;
+    }
+  }
+}
+
 void span_deleter(void*, void* vctx) {
   auto* ctx = static_cast<SpanCtx*>(vctx);
+  if (ctx->scav != nullptr) {
+    // Clear the admitted marks BEFORE the slots recycle: a slot that
+    // reads set-but-not-admitted merely starts aging fresh (harmless);
+    // the reverse order could shield a brand-new span with stale marks.
+    // Release pairs with the scavenger's acquire read.
+    ctx->scav->admitted.fetch_and(
+        ~span_slot_mask(ctx->geom, ctx->off, ctx->need),
+        std::memory_order_release);
+    scav_forget_span(ctx->scav.get(), ctx->geom, ctx->off, ctx->need);
+  }
   span_free(hdr_of(ctx->map), ctx->geom, ctx->off, ctx->need);
   delete ctx;
 }
@@ -880,7 +955,74 @@ size_t rma_region_count() {
   return regions().size();
 }
 
+namespace {
+
+Adder& span_scavenged_var() {
+  static Adder* a = [] {
+    auto* v = new Adder();
+    v->expose("rma_span_scavenged",
+              "receive-window slots reclaimed by the span scavenger "
+              "(allocated by a peer but never admitted — the control "
+              "frame was dropped or the sender died mid-put; bounded by "
+              "trpc_rma_span_scavenge_ms)");
+    return v;
+  }();
+  return *a;
+}
+
+[[maybe_unused]] Adder& g_scavenged_eager = span_scavenged_var();
+
+}  // namespace
+
+size_t rma_scavenge(int64_t now_us) {
+  if (now_us == 0) {
+    now_us = monotonic_time_us();
+  }
+  const int64_t age_us = flag_value(scavenge_flag(), 10000) * 1000;
+  size_t reclaimed = 0;
+  std::lock_guard<std::mutex> g(reg_mu());
+  for (RegionRec& r : regions()) {
+    if (!r.window || r.map == nullptr || r.scav == nullptr) {
+      continue;
+    }
+    RmaSegHdr* h = hdr_of(r.map);
+    // Acquire pairs with the peer's CAS claim (span_alloc) — a slot
+    // counted here was fully published before this scan.
+    const uint64_t cur = h->slot_map.load(std::memory_order_acquire);
+    // Acquire pairs with rma_resolve's admit / span_deleter's clear.
+    const uint64_t admitted =
+        r.scav->admitted.load(std::memory_order_acquire);
+    uint64_t reclaim = 0;
+    for (uint32_t i = 0; i < kRmaWindowSlots; ++i) {
+      const uint64_t bit = 1ull << i;
+      if ((cur & bit) == 0 || (admitted & bit) != 0) {
+        r.scav->first_seen_us[i] = 0;  // free, or a live admitted span
+        continue;
+      }
+      if (r.scav->first_seen_us[i] == 0) {
+        r.scav->first_seen_us[i] = now_us;  // start aging
+      } else if (now_us - r.scav->first_seen_us[i] > age_us) {
+        reclaim |= bit;
+        r.scav->first_seen_us[i] = 0;
+      }
+    }
+    if (reclaim != 0) {
+      // Release mirrors span_free: nothing of ours reads the span, but
+      // the allocating peer's next claim must not fold into stale state.
+      h->slot_map.fetch_and(~reclaim, std::memory_order_release);
+      reclaimed += static_cast<size_t>(__builtin_popcountll(reclaim));
+    }
+  }
+  if (reclaimed != 0) {
+    span_scavenged_var() << static_cast<int64_t>(reclaimed);
+  }
+  return reclaimed;
+}
+
 size_t rma_spans_in_use() {
+  // The drain quiesce poll doubles as the scavenger's lazy tick: a
+  // leaked span must not hold a draining server hostage.
+  rma_scavenge();
   std::lock_guard<std::mutex> g(reg_mu());
   size_t n = 0;
   for (const RegionRec& r : regions()) {
@@ -925,11 +1067,19 @@ std::shared_ptr<RmaMapping> rma_pin_exportable(const void* buf, size_t len,
 void rma_landing_bind(uint64_t cid, void* buf, size_t cap) {
   uint64_t rkey = 0;
   uint64_t off = 0;
-  if (!rma_exportable(buf, cap, &rkey, &off) || off != 0) {
+  if (!rma_exportable(buf, cap, &rkey, &off)) {
     return;  // copy-path landing only (arbitrary caller memory)
   }
   std::lock_guard<std::mutex> g(reg_mu());
-  landing_binds()[cid] = LandingBind{rkey, cap};
+  for (const auto& [other_cid, bind] : landing_binds()) {
+    if (bind.rkey == rkey && other_cid != cid) {
+      // One direct transfer per region at a time: the region header
+      // holds a single completion descriptor.  This call still lands
+      // via the striped copy path — correct, just not zero-copy.
+      return;
+    }
+  }
+  landing_binds()[cid] = LandingBind{rkey, cap, off};
 }
 
 void rma_landing_unbind(uint64_t cid) {
@@ -954,7 +1104,8 @@ void rma_landing_unbind(uint64_t cid) {
   }
 }
 
-uint64_t rma_landing_rkey(uint64_t cid, uint64_t* max_out) {
+uint64_t rma_landing_rkey(uint64_t cid, uint64_t* max_out,
+                          uint64_t* off_out) {
   std::lock_guard<std::mutex> g(reg_mu());
   auto it = landing_binds().find(cid);
   if (it == landing_binds().end()) {
@@ -962,6 +1113,9 @@ uint64_t rma_landing_rkey(uint64_t cid, uint64_t* max_out) {
   }
   if (max_out != nullptr) {
     *max_out = it->second.cap;
+  }
+  if (off_out != nullptr) {
+    *off_out = it->second.off;
   }
   return it->second.rkey;
 }
@@ -975,7 +1129,8 @@ int rma_rails_for(int socket_mode) {
 
 void rma_advertise_response(SocketId sid, uint64_t cid, RpcMeta* meta) {
   uint64_t max = 0;
-  const uint64_t rkey = rma_landing_rkey(cid, &max);
+  uint64_t off = 0;
+  const uint64_t rkey = rma_landing_rkey(cid, &max, &off);
   if (rkey == 0) {
     return;
   }
@@ -986,10 +1141,12 @@ void rma_advertise_response(SocketId sid, uint64_t cid, RpcMeta* meta) {
   }
   meta->rma_resp_rkey = rkey;
   meta->rma_resp_max = max;
+  meta->rma_resp_off = off;
 }
 
 int rma_try_send(SocketId primary, RpcMeta* meta, IOBuf* body,
-                 uint64_t target_rkey, uint64_t target_max) {
+                 uint64_t target_rkey, uint64_t target_max,
+                 uint64_t target_off) {
   const uint64_t total = body->size();
   if (meta->stream_id != 0 || !stripe_eligible(total)) {
     return 1;
@@ -1020,15 +1177,16 @@ int rma_try_send(SocketId primary, RpcMeta* meta, IOBuf* body,
     std::shared_ptr<RmaMapping> m = map_peer_region(target_rkey, &tg);
     if (m != nullptr) {
       RmaSegHdr* h = hdr_of(m);
-      if (tg.nslots == 0 && total <= tg.data_len) {
+      if (tg.nslots == 0 && target_off <= tg.data_len &&
+          total <= tg.data_len - target_off) {
         if (timeline::enabled()) {
           timeline::record(timeline::kStripeCut, cid, total);
         }
         xfer_init(&h->direct, total, chunk, crc, cid);
         const uint32_t nchunks =
             static_cast<uint32_t>((total + chunk - 1) / chunk);
-        put_body(&h->direct, m->base + kRmaDataOffset, std::move(*body),
-                 chunk, rails, cid, crc, peer);
+        put_body(&h->direct, m->base + kRmaDataOffset + target_off,
+                 std::move(*body), chunk, rails, cid, crc, peer);
         meta->rma_rkey = target_rkey;
         meta->rma_off = kRmaDirectOff;
         meta->rma_len = total;
@@ -1087,6 +1245,21 @@ int rma_try_send(SocketId primary, RpcMeta* meta, IOBuf* body,
 }
 
 bool rma_resolve(InputMessage* msg, Socket* sock) {
+  {
+    // Lazy scavenger tick, rate-limited to ~4/s: while one-sided
+    // traffic flows, leaked spans (dropped control frames) reclaim
+    // without any dedicated thread; the drain poll covers idle windows.
+    static std::atomic<int64_t> last_scan{0};
+    const int64_t now = monotonic_time_us();
+    // Relaxed: the limiter only needs an approximate winner; the
+    // scavenger itself synchronizes through reg_mu and the bitmaps.
+    int64_t prev = last_scan.load(std::memory_order_relaxed);
+    if (now - prev > 250 * 1000 &&
+        last_scan.compare_exchange_strong(prev, now,
+                                          std::memory_order_relaxed)) {
+      rma_scavenge(now);
+    }
+  }
   RpcMeta& m = msg->meta;
   const uint64_t rkey = m.rma_rkey;
   const uint64_t total = m.rma_len;
@@ -1108,7 +1281,9 @@ bool rma_resolve(InputMessage* msg, Socket* sock) {
       return reject("direct put on a non-response");
     }
     uint64_t cap = 0;
-    if (rma_landing_rkey(m.correlation_id, &cap) != rkey || total > cap) {
+    uint64_t land_off = 0;
+    if (rma_landing_rkey(m.correlation_id, &cap, &land_off) != rkey ||
+        total > cap) {
       return reject("not the advertised landing");
     }
     bool window = false;
@@ -1118,10 +1293,15 @@ bool rma_resolve(InputMessage* msg, Socket* sock) {
       return reject("unknown region");
     }
     RmaSegHdr* h = hdr_of(map);
-    char* payload = map->base + kRmaDataOffset;
-    if (total > geom.data_len ||
-        !xfer_verify(&h->direct, m.correlation_id, payload, total,
-                     geom.data_len)) {
+    // The landing offset comes from the LOCAL bind (what this process
+    // registered), never the frame — a control frame cannot steer the
+    // payload pointer anywhere the caller didn't bind.
+    if (land_off > geom.data_len || total > geom.data_len - land_off) {
+      return reject("landing out of bounds");
+    }
+    char* payload = map->base + kRmaDataOffset + land_off;
+    if (!xfer_verify(&h->direct, m.correlation_id, payload, total,
+                     geom.data_len - land_off)) {
       return reject("incomplete or corrupt transfer");
     }
     auto* ctx = new DirectCtx{std::move(map)};
@@ -1137,7 +1317,9 @@ bool rma_resolve(InputMessage* msg, Socket* sock) {
     }
     bool window = false;
     RmaGeom geom;  // trusted creation-time geometry, never the header's
-    std::shared_ptr<RmaMapping> map = local_region(rkey, &window, &geom);
+    std::shared_ptr<WindowScav> scav;
+    std::shared_ptr<RmaMapping> map =
+        local_region(rkey, &window, &geom, &scav);
     if (map == nullptr || !window) {
       return reject("unknown window");
     }
@@ -1147,15 +1329,44 @@ bool rma_resolve(InputMessage* msg, Socket* sock) {
         need > geom.data_len - m.rma_off) {
       return reject("span out of bounds");
     }
+    // A span is addressable only while its slots are ALLOCATED: clear
+    // bits mean the scavenger reclaimed it (its control frame was
+    // presumed lost — this is that frame, arriving late).  Neither
+    // admit nor free: a successor span may already own the memory.
+    // Acquire pairs with the peer's claim CAS.
+    const uint64_t slot_mask = span_slot_mask(geom, m.rma_off, need);
+    if ((h->slot_map.load(std::memory_order_acquire) & slot_mask) !=
+        slot_mask) {
+      return reject("span was scavenged");
+    }
     auto* x = reinterpret_cast<RmaXfer*>(map->base + kRmaDataOffset +
                                          m.rma_off);
     char* payload = reinterpret_cast<char*>(x) + kRmaSpanHdr;
+    // Token gate on RECLAMATION: only a frame whose correlation id owns
+    // the span header may free the slots on verification failure — a
+    // scavenged-and-reused span (successor's token) or a hostile frame
+    // must reject WITHOUT freeing someone else's live span.  Acquire
+    // pairs with the sender's header-publishing release store.
+    const bool owns =
+        x->total.load(std::memory_order_acquire) != 0 &&
+        x->token == m.correlation_id;
     if (!xfer_verify(x, m.correlation_id, payload, total,
                      geom.data_len - m.rma_off - kRmaSpanHdr)) {
-      span_free(h, geom, m.rma_off, need);  // reclaim the faulted span
+      if (owns) {
+        scav_forget_span(scav.get(), geom, m.rma_off, need);
+        span_free(h, geom, m.rma_off, need);  // reclaim the faulted span
+      }
       return reject("incomplete or corrupt transfer");
     }
-    auto* ctx = new SpanCtx{std::move(map), geom, m.rma_off, need};
+    if (scav != nullptr) {
+      // Admit marks: the span is live for as long as the payload holds
+      // a reference — the scavenger must never age it.  Release pairs
+      // with the scavenger's acquire read.
+      scav->admitted.fetch_or(span_slot_mask(geom, m.rma_off, need),
+                              std::memory_order_release);
+    }
+    auto* ctx = new SpanCtx{std::move(map), std::move(scav), geom,
+                            m.rma_off, need};
     msg->payload.append_user_data(payload, total, &span_deleter, ctx);
   }
   if (timeline::enabled()) {

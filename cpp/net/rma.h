@@ -139,14 +139,22 @@ std::shared_ptr<RmaMapping> rma_pin_exportable(const void* buf, size_t len,
 // -- landing binds (batch plane) ------------------------------------------
 
 // Binds cid → the exportable region holding [buf, buf+cap) so the
-// request can advertise it as the response's remote-write target.  No-op
-// when the buffer is not the start of an exportable region's data area
-// (the striped copy path still catches it).  Called by
-// stripe_register_landing — one registration surface for both paths.
+// request can advertise it as the response's remote-write target.  The
+// buffer may sit at ANY offset inside the region's data area
+// (collective pulls land shards mid-buffer); the offset is recorded
+// locally and advertised, and resolve trusts only the LOCAL record.
+// No-op when the buffer is not inside an exportable region, or when
+// another in-flight cid is already bound to the same region — the
+// region header holds ONE direct-transfer completion descriptor, so
+// direct puts into one region are serialized; the striped copy path
+// still catches the refused call.  Called by stripe_register_landing —
+// one registration surface for both paths.
 void rma_landing_bind(uint64_t cid, void* buf, size_t cap);
 void rma_landing_unbind(uint64_t cid);
-// The bound rkey for cid (0 = none); *max_out = usable bytes.
-uint64_t rma_landing_rkey(uint64_t cid, uint64_t* max_out);
+// The bound rkey for cid (0 = none); *max_out = usable bytes,
+// *off_out = byte offset of the landing inside the region's data area.
+uint64_t rma_landing_rkey(uint64_t cid, uint64_t* max_out,
+                          uint64_t* off_out = nullptr);
 
 // -- send (channel.cc / server.cc) ----------------------------------------
 
@@ -163,10 +171,12 @@ void rma_advertise_response(SocketId sid, uint64_t cid, RpcMeta* meta);
 //      the stripe/frame path.
 //  -1  hard failure (control write failed / fault reset): the call fails.
 // target_rkey (from the request's advertisement) routes a response
-// direct-to-region when the body fits target_max; otherwise the
-// connection window is used.
+// direct-to-region when the body fits target_max — written target_off
+// bytes into the region's data area; otherwise the connection window
+// is used.
 int rma_try_send(SocketId primary, RpcMeta* meta, IOBuf* body,
-                 uint64_t target_rkey, uint64_t target_max);
+                 uint64_t target_rkey, uint64_t target_max,
+                 uint64_t target_off = 0);
 
 // -- receive (messenger hook) ---------------------------------------------
 
@@ -181,5 +191,25 @@ bool rma_resolve(InputMessage* msg, Socket* sock);
 
 // Rails configured for a mode (trpc_shm_rails / trpc_ici_rails).
 int rma_rails_for(int socket_mode);
+
+// -- span scavenger --------------------------------------------------------
+
+// Reclaims receive-window slots whose control frame never arrived (the
+// documented span-leak-on-dropped-control degradation): a slot that has
+// stayed allocated for longer than trpc_rma_span_scavenge_ms WITHOUT its
+// span ever being admitted by rma_resolve is leaked — the sender's
+// control frame was dropped (chaos) or its connection died mid-handoff —
+// and is cleared back into the window.  Admitted spans are exempt for as
+// long as any payload reference holds them, so a long-lived zero-copy
+// consumer is never scavenged.  Runs lazily: piggybacked (rate-limited)
+// on rma_resolve, from rma_spans_in_use (the drain quiesce poll), and
+// callable directly.  Reclaims are counted by the rma_span_scavenged
+// var.  The timeout must exceed the slowest legitimate write+control
+// latency: a still-writing sender whose span is scavenged out from
+// under it degrades to a failed call (token/bitmap/CRC verification
+// rejects the stale transfer), never a torn admit — the same inherent
+// shared-memory race class as the documented RmaBuffer reuse contract.
+// `now_us` 0 reads the clock.  Returns slots reclaimed by THIS pass.
+size_t rma_scavenge(int64_t now_us = 0);
 
 }  // namespace trpc

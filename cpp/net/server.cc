@@ -1012,6 +1012,7 @@ void tstd_process_request(InputMessage&& msg) {
   // registered landing region — the response puts straight into it.
   cntl->call().rma_resp_rkey = msg.meta.rma_resp_rkey;
   cntl->call().rma_resp_max = msg.meta.rma_resp_max;
+  cntl->call().rma_resp_off = msg.meta.rma_resp_off;
   cntl->call().sl_pool =
       srv != nullptr ? srv->session_data_pool() : nullptr;
   auto* response = new IOBuf();
@@ -1109,7 +1110,8 @@ void tstd_process_request(InputMessage&& msg) {
     const int rma_rc =
         rma_try_send(socket_id, &meta, response,
                      cntl->call().rma_resp_rkey,
-                     cntl->call().rma_resp_max);
+                     cntl->call().rma_resp_max,
+                     cntl->call().rma_resp_off);
     if (rma_rc != 1) {
       // Sent (0) or hard-failed (-1, socket dead: the client times out
       // exactly as a failed stripe_send would have left it).

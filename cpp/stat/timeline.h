@@ -74,6 +74,10 @@ enum EventType : uint32_t {
   // -- KV-block registry / disaggregation (net/kvstore.h) ---------------
   kKvBlock = 22,  // a=block id, b=(op << 56) | payload len; ops:
                   // 1 publish, 2 serve, 3 evict, 4 stale-reject
+  // -- collective transfer schedules (net/collective.h) ------------------
+  kCollStep = 23,  // a=step index, b=(op << 56) | step bytes; ops:
+                   // 1 all_gather, 2 reduce_scatter, 3 all_to_all,
+                   // 4 reshard (CollOp values)
   kEventTypeCount,
 };
 
@@ -103,6 +107,7 @@ constexpr const char* kEventNames[] = {
     "stripe_done",     // timeline-event 20 (stripe_done)
     "qos_drain",       // timeline-event 21 (qos_drain)
     "kv_block",        // timeline-event 22 (kv_block)
+    "coll_step",       // timeline-event 23 (coll_step)
 };
 static_assert(sizeof(kEventNames) / sizeof(kEventNames[0]) ==
                   kEventTypeCount,

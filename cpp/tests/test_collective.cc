@@ -1,0 +1,573 @@
+// Collective transfer-schedule tests (net/collective.h): plan
+// correctness for all three ops at 3/4/8 members, pull/push execution
+// over in-process member fleets (shm rings, one-sided landings),
+// chunk-fault whole-step failure + recovery, window-full fallback,
+// reshard plan minimality vs the naive full-exchange, naming-epoch
+// whole-or-nothing, and cancel-mid-schedule quiescence — the group
+// put-schedule tier ROADMAP item 3 names.
+#include <string.h>
+#include <unistd.h>
+
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "base/flags.h"
+#include "base/iobuf.h"
+#include "base/time.h"
+#include "net/channel.h"
+#include "net/collective.h"
+#include "net/controller.h"
+#include "net/fault.h"
+#include "net/hotpath_stats.h"
+#include "net/naming.h"
+#include "net/rma.h"
+#include "net/server.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+namespace {
+
+struct FaultGuard {
+  ~FaultGuard() { FaultActor::global().set(""); }
+};
+
+struct FlagGuard {
+  std::string name, old_value;
+  FlagGuard(const std::string& n, const std::string& v) : name(n) {
+    old_value = Flag::find(n)->value_string();
+    EXPECT_EQ(Flag::set(n, v), 0);
+  }
+  ~FlagGuard() { Flag::set(name, old_value); }
+};
+
+// One in-process member fleet: n servers with the collective handlers
+// and n GroupChannels (rank r's channels to everyone else).
+struct Fleet {
+  std::vector<std::unique_ptr<Server>> servers;
+  std::vector<std::string> members;
+  std::vector<std::unique_ptr<GroupChannel>> groups;
+  uint64_t seq = 0;
+
+  explicit Fleet(uint32_t n, int64_t timeout_ms = 20000) {
+    for (uint32_t i = 0; i < n; ++i) {
+      auto s = std::make_unique<Server>();
+      EXPECT_EQ(coll_attach(s.get()), 0);
+      EXPECT_EQ(s->Start(0), 0);
+      members.push_back("127.0.0.1:" + std::to_string(s->port()));
+      servers.push_back(std::move(s));
+    }
+    for (uint32_t r = 0; r < n; ++r) {
+      auto g = std::make_unique<GroupChannel>();
+      GroupChannel::Options opts;
+      opts.timeout_ms = timeout_ms;
+      opts.use_shm = true;
+      EXPECT_EQ(g->Init(members, r, &opts), 0);
+      groups.push_back(std::move(g));
+    }
+  }
+
+  ~Fleet() {
+    groups.clear();
+    for (auto& s : servers) {
+      s->Stop();
+    }
+  }
+
+  // Runs one collective on every member concurrently; returns per-rank
+  // result codes.
+  std::vector<int> run_all(
+      const std::function<int(GroupChannel*, uint32_t, uint64_t)>& fn) {
+    seq += 1;
+    std::vector<int> rcs(groups.size(), -1);
+    std::vector<std::thread> threads;
+    for (uint32_t r = 0; r < groups.size(); ++r) {
+      threads.emplace_back([&, r] { rcs[r] = fn(groups[r].get(), r, seq); });
+    }
+    for (auto& t : threads) {
+      t.join();
+    }
+    return rcs;
+  }
+};
+
+char pat(uint32_t rank, size_t i) {
+  return static_cast<char>(((i + rank * 131) * 2654435761u) >> 17);
+}
+
+struct MemberBufs {
+  char* send = nullptr;
+  char* recv = nullptr;
+  uint64_t send_rkey = 0, recv_rkey = 0;
+  MemberBufs(size_t send_len, size_t recv_len) {
+    send = static_cast<char*>(rma_alloc(send_len, &send_rkey));
+    recv = static_cast<char*>(rma_alloc(recv_len, &recv_rkey));
+    EXPECT(send != nullptr && recv != nullptr);
+  }
+  ~MemberBufs() {
+    rma_free(send);
+    rma_free(recv);
+  }
+};
+
+void all_gather_case(uint32_t n, uint64_t shard) {
+  Fleet fleet(n);
+  std::vector<std::unique_ptr<MemberBufs>> bufs;
+  for (uint32_t r = 0; r < n; ++r) {
+    bufs.push_back(std::make_unique<MemberBufs>(shard, n * shard));
+    for (size_t i = 0; i < shard; ++i) {
+      bufs[r]->send[i] = pat(r, i);
+    }
+  }
+  auto rcs = fleet.run_all([&](GroupChannel* g, uint32_t r, uint64_t seq) {
+    return g->run(plan_all_gather(n, shard), bufs[r]->send, shard,
+                  bufs[r]->recv, n * shard, seq);
+  });
+  for (uint32_t r = 0; r < n; ++r) {
+    EXPECT_EQ(rcs[r], 0);
+    for (uint32_t src = 0; src < n; ++src) {
+      for (size_t i = 0; i < shard; i += 37) {
+        EXPECT_EQ(bufs[r]->recv[src * shard + i], pat(src, i));
+      }
+    }
+  }
+}
+
+}  // namespace
+
+// -- plans (pure, no fabric) -----------------------------------------------
+
+TEST_CASE(plans_are_deterministic_and_cover) {
+  for (uint32_t n : {2u, 3u, 4u, 8u}) {
+    const uint64_t shard = 64 << 10;
+    const TransferSchedule ag = plan_all_gather(n, shard);
+    EXPECT_EQ(ag.steps.size(), n - 1);
+    EXPECT_EQ(ag.bytes_moved(), static_cast<uint64_t>(n) * (n - 1) * shard);
+    EXPECT_EQ(ag.bytes_reused(), static_cast<uint64_t>(n) * shard);
+    const TransferSchedule rs = plan_reduce_scatter(n, shard);
+    EXPECT_EQ(rs.steps.size(), n - 1);
+    EXPECT_EQ(rs.final_copies.size(), n);
+    const TransferSchedule aa = plan_all_to_all(n, shard);
+    EXPECT_EQ(aa.steps.size(), n - 1);
+    EXPECT_EQ(aa.bytes_moved(), static_cast<uint64_t>(n) * (n - 1) * shard);
+    // Every member receives exactly (n-1) shards across each plan.
+    for (uint32_t r = 0; r < n; ++r) {
+      uint64_t recv = 0;
+      for (const CollStep& s : ag.steps) {
+        for (const CollTransfer& t : s.puts) {
+          if (t.dst == r) {
+            recv += t.len;
+          }
+        }
+      }
+      EXPECT_EQ(recv, (n - 1) * shard);
+    }
+  }
+}
+
+TEST_CASE(reshard_plan_minimal_vs_naive_full_exchange) {
+  // Overlapping shardings: most bytes stay put, only the boundary strip
+  // moves — the 2112.01075 decomposition must beat the all-gather
+  // strawman by a wide margin.
+  const uint64_t total = 4 << 20;
+  const uint64_t quarter = total / 4;
+  Sharding src;
+  src.total = total;
+  for (uint32_t r = 0; r < 4; ++r) {
+    src.ranges.push_back({r, r * quarter, quarter});
+  }
+  Sharding dst;
+  dst.total = total;
+  const uint64_t shift = 64 << 10;  // each rank's range shifts by 64KB
+  dst.ranges.push_back({0, 0, quarter + shift});
+  dst.ranges.push_back({1, quarter + shift, quarter});
+  dst.ranges.push_back({2, 2 * quarter + shift, quarter});
+  dst.ranges.push_back({3, 3 * quarter + shift, quarter - shift});
+  EXPECT(sharding_valid(src, 4));
+  EXPECT(sharding_valid(dst, 4));
+  const TransferSchedule plan = plan_reshard(src, dst, 4);
+  const uint64_t naive = reshard_naive_bytes(src, 4);
+  EXPECT_EQ(naive, 3 * total);
+  // Only the shifted strips move: 3 boundaries x 64KB.
+  EXPECT_EQ(plan.bytes_moved(), 3 * shift);
+  EXPECT(plan.bytes_moved() < naive);
+  EXPECT_EQ(plan.bytes_moved() + plan.bytes_reused(), total);
+  // Identity reshard moves NOTHING.
+  const TransferSchedule ident = plan_reshard(src, src, 4);
+  EXPECT_EQ(ident.bytes_moved(), 0u);
+  EXPECT_EQ(ident.bytes_reused(), total);
+}
+
+// -- execution over the fabric ---------------------------------------------
+
+TEST_CASE(all_gather_3_4_8_members) {
+  all_gather_case(3, 1 << 20);
+  all_gather_case(4, 512 << 10);
+  all_gather_case(8, 128 << 10);
+}
+
+TEST_CASE(reduce_scatter_u32_sums) {
+  const uint32_t n = 4;
+  const uint64_t shard = 256 << 10;  // u32-aligned
+  Fleet fleet(n);
+  std::vector<std::unique_ptr<MemberBufs>> bufs;
+  for (uint32_t r = 0; r < n; ++r) {
+    bufs.push_back(std::make_unique<MemberBufs>(n * shard, shard));
+    auto* v = reinterpret_cast<uint32_t*>(bufs[r]->send);
+    for (size_t i = 0; i < n * shard / 4; ++i) {
+      v[i] = static_cast<uint32_t>(i + r * 1000003);
+    }
+  }
+  auto rcs = fleet.run_all([&](GroupChannel* g, uint32_t r, uint64_t seq) {
+    return g->run(plan_reduce_scatter(n, shard), bufs[r]->send, n * shard,
+                  bufs[r]->recv, shard, seq);
+  });
+  for (uint32_t r = 0; r < n; ++r) {
+    EXPECT_EQ(rcs[r], 0);
+    const auto* got = reinterpret_cast<const uint32_t*>(bufs[r]->recv);
+    for (size_t i = 0; i < shard / 4; i += 97) {
+      const size_t gi = r * (shard / 4) + i;
+      uint32_t want = 0;
+      for (uint32_t src = 0; src < n; ++src) {
+        want += static_cast<uint32_t>(gi + src * 1000003);
+      }
+      EXPECT_EQ(got[i], want);
+    }
+  }
+}
+
+TEST_CASE(all_to_all_transposes_blocks) {
+  const uint32_t n = 3;
+  const uint64_t shard = 512 << 10;
+  Fleet fleet(n);
+  std::vector<std::unique_ptr<MemberBufs>> bufs;
+  for (uint32_t r = 0; r < n; ++r) {
+    bufs.push_back(std::make_unique<MemberBufs>(n * shard, n * shard));
+    for (uint32_t d = 0; d < n; ++d) {
+      memset(bufs[r]->send + d * shard, static_cast<int>(1 + r * 16 + d),
+             shard);
+    }
+  }
+  auto rcs = fleet.run_all([&](GroupChannel* g, uint32_t r, uint64_t seq) {
+    return g->run(plan_all_to_all(n, shard), bufs[r]->send, n * shard,
+                  bufs[r]->recv, n * shard, seq);
+  });
+  for (uint32_t d = 0; d < n; ++d) {
+    EXPECT_EQ(rcs[d], 0);
+    for (uint32_t src = 0; src < n; ++src) {
+      for (size_t i = 0; i < shard; i += 131) {
+        EXPECT_EQ(bufs[d]->recv[src * shard + i],
+                  static_cast<char>(1 + src * 16 + d));
+      }
+    }
+  }
+}
+
+TEST_CASE(reshard_executes_minimal_schedule) {
+  const uint32_t n = 3;
+  const uint64_t total = 3 << 20;
+  const uint64_t third = total / 3;
+  Sharding src;
+  src.total = total;
+  for (uint32_t r = 0; r < n; ++r) {
+    src.ranges.push_back({r, r * third, third});
+  }
+  // Target: rank 0 shrinks to half, ranks 1/2 shift left accordingly —
+  // an overlapping pair, so the plan must move < naive.
+  Sharding dst;
+  dst.total = total;
+  dst.ranges.push_back({0, 0, third / 2});
+  dst.ranges.push_back({1, third / 2, third});
+  dst.ranges.push_back({2, third / 2 + third, total - third - third / 2});
+  const TransferSchedule plan = plan_reshard(src, dst, n);
+  EXPECT(plan.bytes_moved() < reshard_naive_bytes(src, n));
+  EXPECT(plan.bytes_moved() > 0);
+
+  Fleet fleet(n);
+  std::vector<std::unique_ptr<MemberBufs>> bufs;
+  for (uint32_t r = 0; r < n; ++r) {
+    bufs.push_back(std::make_unique<MemberBufs>(
+        sharding_local_bytes(src, r), sharding_local_bytes(dst, r)));
+  }
+  // Fill each member's source shard from one global pattern.
+  for (const ShardRange& sr : src.ranges) {
+    uint64_t local = 0;
+    for (const ShardRange& prev : src.ranges) {
+      if (prev.rank == sr.rank && prev.off < sr.off) {
+        local += prev.len;
+      }
+    }
+    for (uint64_t i = 0; i < sr.len; ++i) {
+      bufs[sr.rank]->send[local + i] = pat(7, sr.off + i);
+    }
+  }
+  auto rcs = fleet.run_all([&](GroupChannel* g, uint32_t r, uint64_t seq) {
+    return g->reshard(src, dst, bufs[r]->send,
+                      sharding_local_bytes(src, r), bufs[r]->recv,
+                      sharding_local_bytes(dst, r), seq);
+  });
+  for (uint32_t r = 0; r < n; ++r) {
+    EXPECT_EQ(rcs[r], 0);
+  }
+  // Verify the target layout against the global pattern.
+  for (const ShardRange& dr : dst.ranges) {
+    uint64_t local = 0;
+    for (const ShardRange& prev : dst.ranges) {
+      if (prev.rank == dr.rank && prev.off < dr.off) {
+        local += prev.len;
+      }
+    }
+    for (uint64_t i = 0; i < dr.len; i += 41) {
+      EXPECT_EQ(bufs[dr.rank]->recv[local + i], pat(7, dr.off + i));
+    }
+  }
+}
+
+// -- fault semantics -------------------------------------------------------
+
+TEST_CASE(chunk_fault_fails_step_whole_and_recovers) {
+  const uint32_t n = 3;
+  const uint64_t shard = 2 << 20;
+  Fleet fleet(n, /*timeout_ms=*/4000);
+  std::vector<std::unique_ptr<MemberBufs>> bufs;
+  for (uint32_t r = 0; r < n; ++r) {
+    bufs.push_back(std::make_unique<MemberBufs>(shard, n * shard));
+    for (size_t i = 0; i < shard; ++i) {
+      bufs[r]->send[i] = pat(r, i);
+    }
+  }
+  auto ag = [&](GroupChannel* g, uint32_t r, uint64_t seq) {
+    return g->run(plan_all_gather(n, shard), bufs[r]->send, shard,
+                  bufs[r]->recv, n * shard, seq);
+  };
+  // Clean baseline.
+  auto rcs = fleet.run_all(ag);
+  for (int rc : rcs) {
+    EXPECT_EQ(rc, 0);
+  }
+  {
+    // Chunk drops: some member's transfer faults; its step fails
+    // whole-or-nothing and the abort fans out — no member may report
+    // success with torn bytes.
+    FaultGuard guard;
+    EXPECT_EQ(FaultActor::global().set("seed=23;drop=0.6;max=48"), 0);
+    // Poison the recv patterns so a torn admit would be detectable.
+    for (uint32_t r = 0; r < n; ++r) {
+      memset(bufs[r]->recv, 0, n * shard);
+    }
+    rcs = fleet.run_all(ag);
+    bool any_failed = false;
+    for (uint32_t r = 0; r < n; ++r) {
+      if (rcs[r] != 0) {
+        any_failed = true;
+      } else {
+        // A member that DID report success must hold exact bytes.
+        for (uint32_t src = 0; src < n; ++src) {
+          for (size_t i = 0; i < shard; i += 53) {
+            EXPECT_EQ(bufs[r]->recv[src * shard + i], pat(src, i));
+          }
+        }
+      }
+    }
+    EXPECT(any_failed);
+  }
+  EXPECT_EQ(coll_sessions_live(), 0u);
+  // Faults cleared: the SAME fleet recovers byte-exact (connections may
+  // have fallen back to tcp — correctness is transport-independent).
+  rcs = fleet.run_all(ag);
+  for (uint32_t r = 0; r < n; ++r) {
+    EXPECT_EQ(rcs[r], 0);
+    for (uint32_t src = 0; src < n; ++src) {
+      for (size_t i = 0; i < shard; i += 53) {
+        EXPECT_EQ(bufs[r]->recv[src * shard + i], pat(src, i));
+      }
+    }
+  }
+}
+
+TEST_CASE(window_full_falls_back_to_copy_path) {
+  // A tiny receive window cannot hold two in-flight 8MB push chunks:
+  // reduce-scatter's pushes must degrade to the striped copy path and
+  // stay byte-correct (rma_window_full counts the fallbacks).
+  FlagGuard window("trpc_rma_window_bytes", std::to_string(16 << 20));
+  FlagGuard chunk("trpc_coll_chunk_bytes", std::to_string(8 << 20));
+  const uint32_t n = 3;
+  const uint64_t shard = 12 << 20;
+  Fleet fleet(n);
+  std::vector<std::unique_ptr<MemberBufs>> bufs;
+  for (uint32_t r = 0; r < n; ++r) {
+    bufs.push_back(std::make_unique<MemberBufs>(n * shard, shard));
+    auto* v = reinterpret_cast<uint32_t*>(bufs[r]->send);
+    for (size_t i = 0; i < n * shard / 4; ++i) {
+      v[i] = static_cast<uint32_t>(i * 3 + r);
+    }
+  }
+  auto rcs = fleet.run_all([&](GroupChannel* g, uint32_t r, uint64_t seq) {
+    return g->run(plan_reduce_scatter(n, shard), bufs[r]->send, n * shard,
+                  bufs[r]->recv, shard, seq);
+  });
+  for (uint32_t r = 0; r < n; ++r) {
+    EXPECT_EQ(rcs[r], 0);
+    const auto* got = reinterpret_cast<const uint32_t*>(bufs[r]->recv);
+    for (size_t i = 0; i < shard / 4; i += 1009) {
+      const size_t gi = r * (shard / 4) + i;
+      uint32_t want = 0;
+      for (uint32_t src = 0; src < n; ++src) {
+        want += static_cast<uint32_t>(gi * 3 + src);
+      }
+      EXPECT_EQ(got[i], want);
+    }
+  }
+}
+
+TEST_CASE(cancel_mid_schedule_quiesces) {
+  // Rank 2 never enters the collective: the others' step parks at the
+  // serve/arrival barrier and must fail within the run budget, abort
+  // cleanly, and leave ZERO live sessions (no leaked receive state, no
+  // handler still copying).
+  FlagGuard rendezvous("trpc_coll_rendezvous_ms", "600");
+  const uint32_t n = 3;
+  const uint64_t shard = 1 << 20;
+  Fleet fleet(n, /*timeout_ms=*/1500);
+  std::vector<std::unique_ptr<MemberBufs>> bufs;
+  for (uint32_t r = 0; r < n; ++r) {
+    bufs.push_back(std::make_unique<MemberBufs>(shard, n * shard));
+  }
+  fleet.seq += 1;
+  const uint64_t seq = fleet.seq;
+  std::vector<int> rcs(2, -1);
+  std::vector<std::thread> threads;
+  for (uint32_t r = 0; r < 2; ++r) {
+    threads.emplace_back([&, r] {
+      rcs[r] = fleet.groups[r]->run(plan_all_gather(n, shard),
+                                    bufs[r]->send, shard, bufs[r]->recv,
+                                    n * shard, seq);
+    });
+  }
+  for (auto& t : threads) {
+    t.join();
+  }
+  EXPECT(rcs[0] != 0);
+  EXPECT(rcs[1] != 0);
+  // Quiesced: sessions unregistered, in-flight puts cancelled/drained.
+  EXPECT_EQ(coll_sessions_live(), 0u);
+  // The fleet is not poisoned: a full run afterwards succeeds.
+  for (uint32_t r = 0; r < n; ++r) {
+    for (size_t i = 0; i < shard; ++i) {
+      bufs[r]->send[i] = pat(r, i);
+    }
+  }
+  auto rcs2 = fleet.run_all([&](GroupChannel* g, uint32_t r, uint64_t s) {
+    return g->run(plan_all_gather(n, shard), bufs[r]->send, shard,
+                  bufs[r]->recv, n * shard, s);
+  });
+  for (uint32_t r = 0; r < n; ++r) {
+    EXPECT_EQ(rcs2[r], 0);
+  }
+}
+
+// -- naming-backed groups --------------------------------------------------
+
+TEST_CASE(naming_group_epoch_change_fails_step) {
+  naming_ensure_registered();
+  Server registry;
+  EXPECT_EQ(naming_attach(&registry), 0);
+  EXPECT_EQ(registry.Start(0), 0);
+  const std::string reg_addr =
+      "127.0.0.1:" + std::to_string(registry.port());
+
+  const uint32_t n = 3;
+  std::vector<std::unique_ptr<Server>> servers;
+  std::vector<std::string> addrs;
+  Channel reg_ch;
+  EXPECT_EQ(reg_ch.Init(reg_addr), 0);
+  for (uint32_t i = 0; i < n; ++i) {
+    auto s = std::make_unique<Server>();
+    EXPECT_EQ(coll_attach(s.get()), 0);
+    EXPECT_EQ(s->Start(0), 0);
+    const std::string addr = "127.0.0.1:" + std::to_string(s->port());
+    NamingMember m;
+    m.addr = addr;
+    m.zone = "z1";
+    m.epoch = 1000 + i;
+    EXPECT_EQ(naming_announce(&reg_ch, "collsvc", m, 60000), 0);
+    addrs.push_back(addr);
+    servers.push_back(std::move(s));
+  }
+  const std::string url = "naming://" + reg_addr + "/collsvc";
+  std::vector<std::unique_ptr<GroupChannel>> groups(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    groups[i] = std::make_unique<GroupChannel>();
+    GroupChannel::Options opts;
+    opts.timeout_ms = 10000;
+    EXPECT_EQ(groups[i]->InitNaming(url, addrs[i], &opts), 0);
+    EXPECT_EQ(groups[i]->nmembers(), n);
+  }
+  // Ranks are the sorted-address order — identical on every member.
+  std::vector<std::string> sorted_addrs = addrs;
+  std::sort(sorted_addrs.begin(), sorted_addrs.end());
+  std::vector<GroupChannel*> by_rank(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    by_rank[groups[i]->my_rank()] = groups[i].get();
+    EXPECT(sorted_addrs[groups[i]->my_rank()] == addrs[i]);
+  }
+  const uint64_t shard = 256 << 10;
+  std::vector<std::unique_ptr<MemberBufs>> bufs;
+  for (uint32_t r = 0; r < n; ++r) {
+    bufs.push_back(std::make_unique<MemberBufs>(shard, n * shard));
+    for (size_t i = 0; i < shard; ++i) {
+      bufs[r]->send[i] = pat(r, i);
+    }
+  }
+  auto run_all = [&](uint64_t seq) {
+    std::vector<int> rcs(n, -1);
+    std::vector<std::thread> threads;
+    for (uint32_t r = 0; r < n; ++r) {
+      threads.emplace_back([&, r] {
+        rcs[r] = by_rank[r]->run(plan_all_gather(n, shard), bufs[r]->send,
+                                 shard, bufs[r]->recv, n * shard, seq);
+      });
+    }
+    for (auto& t : threads) {
+      t.join();
+    }
+    return rcs;
+  };
+  auto rcs = run_all(1);
+  for (uint32_t r = 0; r < n; ++r) {
+    EXPECT_EQ(rcs[r], 0);
+  }
+  // Rolling restart analogue: a member re-announces under a NEWER epoch
+  // (restarted process) — the view version moves, and every member's
+  // next step fails kECollEpoch whole-or-nothing.
+  NamingMember restarted;
+  restarted.addr = addrs[0];
+  restarted.zone = "z1";
+  restarted.epoch = 99999;
+  EXPECT_EQ(naming_announce(&reg_ch, "collsvc", restarted, 60000), 0);
+  rcs = run_all(2);
+  for (uint32_t r = 0; r < n; ++r) {
+    EXPECT_EQ(rcs[r], kECollEpoch);
+  }
+  EXPECT_EQ(coll_sessions_live(), 0u);
+  // Recompiling from the new view restores service.
+  for (uint32_t i = 0; i < n; ++i) {
+    groups[i] = std::make_unique<GroupChannel>();
+    GroupChannel::Options opts;
+    opts.timeout_ms = 10000;
+    EXPECT_EQ(groups[i]->InitNaming(url, addrs[i], &opts), 0);
+    by_rank[groups[i]->my_rank()] = groups[i].get();
+  }
+  rcs = run_all(3);
+  for (uint32_t r = 0; r < n; ++r) {
+    EXPECT_EQ(rcs[r], 0);
+  }
+  groups.clear();
+  for (auto& s : servers) {
+    s->Stop();
+  }
+  registry.Stop();
+}
+
+TEST_MAIN

@@ -429,6 +429,91 @@ TEST_CASE(rma_chunk_corrupt_rejected_by_chunk_crc) {
   EXPECT(d.d_rejected() >= 1);
 }
 
+TEST_CASE(rma_span_scavenger_reclaims_leaked_never_live) {
+  // The documented span-leak-on-dropped-control degradation: a sender
+  // allocates a window span, writes (or drops) its chunks, and the
+  // CONTROL frame vanishes in transit — the slots stayed allocated
+  // until connection teardown.  The scavenger must reclaim exactly
+  // those spans, and never a live admitted one.
+  start_once();
+  Channel ch;
+  Channel::Options opts;
+  opts.use_shm = true;
+  opts.timeout_ms = 60000;
+  EXPECT_EQ(ch.Init(addr(), &opts), 0);
+  {
+    Controller cntl;  // establish the ring before arming faults
+    IOBuf req, resp;
+    req.append("warm");
+    ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+  }
+  FlagGuard age("trpc_rma_span_scavenge_ms", "150");
+  // A LIVE span first: hold the zero-copy response (it wraps a span in
+  // OUR window) past the scavenge age — admitted spans are exempt.
+  {
+    Controller cntl;
+    cntl.set_timeout_ms(20000);
+    IOBuf req, resp;
+    const std::string body = pattern(8 << 20, 23);
+    req.append(body);
+    ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+    EXPECT(resp.equals(body.data(), body.size()));
+    EXPECT(rma_spans_in_use() >= 1);
+    usleep(300 * 1000);  // older than the scavenge age, but admitted
+    EXPECT_EQ(rma_scavenge(), 0u);
+    EXPECT(rma_spans_in_use() >= 1);  // still held by `resp`
+  }
+  // The response ref dropped: its span frees via the deleter, not the
+  // scavenger.  (The request-side span frees when the echo's shared
+  // payload refs drop — poll briefly for the async release.)
+  for (int i = 0; i < 100 && rma_spans_in_use() != 0; ++i) {
+    usleep(10 * 1000);
+  }
+  EXPECT_EQ(rma_spans_in_use(), 0u);
+
+  // Now the leak: drop EVERYTHING (chunk writes and the control frame
+  // itself) — the span allocated in the peer window is never resolved
+  // and never freed.
+  const int64_t scavenged_before = [] {
+    // rma_span_scavenged is registry-read (no struct access needed).
+    std::string out;
+    return Variable::read_exposed("rma_span_scavenged", &out)
+               ? strtoll(out.c_str(), nullptr, 10)
+               : 0;
+  }();
+  {
+    FaultGuard guard;
+    EXPECT_EQ(FaultActor::global().set("seed=31;drop=1.0;max=64"), 0);
+    Controller cntl;
+    cntl.set_timeout_ms(800);
+    IOBuf req, resp;
+    req.append(pattern(8 << 20, 29));
+    ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+    EXPECT(cntl.Failed());  // control frame dropped: the call dies whole
+    EXPECT_EQ(resp.size(), 0u);
+  }
+  EXPECT(rma_spans_in_use() >= 1);  // the leaked span
+  usleep(250 * 1000);  // first pass stamps first-seen...
+  rma_scavenge();
+  usleep(250 * 1000);  // ...second pass ages it past 150ms and reclaims
+  rma_scavenge();
+  EXPECT_EQ(rma_spans_in_use(), 0u);
+  std::string out;
+  EXPECT(Variable::read_exposed("rma_span_scavenged", &out));
+  EXPECT(strtoll(out.c_str(), nullptr, 10) > scavenged_before);
+  // The window is healthy again: a clean large echo reuses the slots.
+  Controller ok;
+  ok.set_timeout_ms(20000);
+  IOBuf req2, resp2;
+  const std::string big = pattern(4 << 20, 31);
+  req2.append(big);
+  ch.CallMethod("Echo.Echo", req2, &resp2, &ok);
+  EXPECT(!ok.Failed());
+  EXPECT(resp2.equals(big.data(), big.size()));
+}
+
 TEST_CASE(rma_kernel_capability_probe) {
   // The satellite gate: the probe answers deterministically, and on this
   // repo's dev boxes (kernel 4.4.0) io_uring is known-absent — but the

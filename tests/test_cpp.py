@@ -39,6 +39,7 @@ _NO_CMAKE = shutil.which("cmake") is None or shutil.which("ctest") is None
 TSAN_SUITES = [
     "fiber", "rpc", "stream", "shm", "ici", "chaos", "stat", "qos",
     "stripe", "analysis", "timeline", "rma", "kvstore", "naming",
+    "collective",
 ]
 ALL_SUITES = sorted(
     p.stem[len("test_"):] for p in (REPO / "cpp" / "tests").glob("test_*.cc")
@@ -178,6 +179,18 @@ def test_naming_cpp_suite_native():
     and the SO_REUSEPORT listener-handoff hot restart."""
     _run_native_suite("test_naming.cc", "test_naming_native",
                       "naming suite")
+
+
+def test_collective_cpp_suite_native():
+    """ISSUE 13: the collective transfer-schedule tier gates tier-1 —
+    deterministic ring/pairwise/reshard planners, all three ops executed
+    byte-exact over in-process member fleets (pull-based one-sided
+    landings + push-based reduce folds), chunk-fault whole-step failure
+    with recovery, window-full fallback, reshard plan minimality vs the
+    naive full-exchange, naming-epoch whole-or-nothing, and
+    cancel-mid-schedule session quiescence."""
+    _run_native_suite("test_collective.cc", "test_collective_native",
+                      "collective suite")
 
 
 def test_kvstore_cpp_suite_native():

@@ -432,6 +432,59 @@ def test_shm_64mb_one_sided_floor():
         f"the rma path regressed below what it replaced)")
 
 
+# Collective floor (ISSUE 13 acceptance): a 4-member all-gather of 64MB
+# shards over shm must sustain >= 50% of the point-to-point one-sided
+# 64MB put bandwidth (BENCH_r05 baseline ~7.6 GB/s => >= 3.8 GB/s per
+# link), demonstrably over the one-sided plane — and the reshard plan
+# must move strictly fewer bytes than the naive full-exchange.
+ALL_GATHER_PER_LINK_FLOOR_GBPS = 3.8
+
+
+def test_all_gather_4x64mb_per_link_floor_and_reshard_minimality():
+    """Reuses the bench child (BENCH_COLL) so the asserted numbers and
+    the published bench row are the SAME measurement.  Best-of-3: the
+    per-link number is timing-bound on shared boxes and a real
+    regression loses every round."""
+    import pathlib
+    import sys
+
+    bench = pathlib.Path(__file__).resolve().parent.parent / "bench.py"
+    env = dict(os.environ)
+    env["BENCH_COLL"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    best = None
+    for _ in range(3):
+        out = subprocess.run([sys.executable, str(bench)],
+                             capture_output=True, text=True, timeout=240,
+                             env=env)
+        line = next((ln for ln in out.stdout.splitlines()[::-1]
+                     if ln.startswith("{")), None)
+        assert line, f"collective bench child produced no row:\n" \
+                     f"{out.stderr[-3000:]}"
+        row = json.loads(line)
+        ag = row["all_gather"]
+        rs = row["reshard"]
+        # Hard invariants — never timing-excused.
+        assert ag["verified"], f"all-gather bytes torn: {row}"
+        assert ag["rpc_path"] == "rma", (
+            f"collective pulls did not ride the one-sided plane — the "
+            f"floor below would re-baseline onto the copy path: {row}")
+        assert rs["minimal"], (
+            f"reshard plan moved >= naive full-exchange bytes: {row}")
+        assert rs["bytes_moved"] + rs["bytes_reused"] == \
+            rs["total_bytes"], row
+        if best is None or ag["per_link_gbps"] > best["all_gather"][
+                "per_link_gbps"]:
+            best = row
+        if ag["per_link_gbps"] >= ALL_GATHER_PER_LINK_FLOOR_GBPS:
+            return
+    raise AssertionError(
+        f"4-member 64MB all-gather per-link "
+        f"{best['all_gather']['per_link_gbps']} GB/s under floor "
+        f"{ALL_GATHER_PER_LINK_FLOOR_GBPS} (>= 50% of the point-to-point "
+        f"one-sided 64MB put baseline): {best}")
+
+
 def test_small_rpc_hot_path_unchanged_by_stripe_layer():
     """Acceptance guard: sub-threshold traffic must leave every stripe
     stat var untouched — the wait-free inline-write small-RPC path is

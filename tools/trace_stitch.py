@@ -163,6 +163,14 @@ _TL_PRIMARY_RAIL_TID = 900900  # its own track, distinct from real rails
 # carried them.  b = op << 56 | payload len (TIMELINE_KV_OPS mirror).
 _TL_KV_TID = 970000
 _TL_KV_OPS = {1: "publish", 2: "serve", 3: "evict", 4: "stale"}
+# coll_step events (net/collective.h): one instant per completed
+# collective schedule step on its own per-node "collective" track —
+# a = step index, b = op << 56 | step bytes (TIMELINE_COLL_OPS mirror),
+# so a group-transfer trace shows schedule progress next to the rma
+# rails that moved the shards.
+_TL_COLL_TID = 980000
+_TL_COLL_OPS = {1: "all_gather", 2: "reduce_scatter", 3: "all_to_all",
+                4: "reshard"}
 
 
 def _timeline_chrome_events(pid: int, dump: dict, base: float,
@@ -249,6 +257,20 @@ def _timeline_chrome_events(pid: int, dump: dict, base: float,
                     "pid": pid, "tid": out_tid, "ts": ts,
                     "args": {"block_id": e["a"],
                              "len": b & ((1 << 56) - 1),
+                             "trace_id": e["trace_id"],
+                             "span_id": e["span_id"], "fid": e["fid"]},
+                })
+                continue
+            if name == "coll_step":
+                b = int(e["b"], 16)
+                op = b >> 56
+                out_tid = track(_TL_COLL_TID, "collective")
+                events.append({
+                    "ph": "i", "s": "t", "cat": "timeline",
+                    "name": f"coll_{_TL_COLL_OPS.get(op, op)}",
+                    "pid": pid, "tid": out_tid, "ts": ts,
+                    "args": {"step": int(e["a"], 16),
+                             "bytes": b & ((1 << 56) - 1),
                              "trace_id": e["trace_id"],
                              "span_id": e["span_id"], "fid": e["fid"]},
                 })
