@@ -775,6 +775,225 @@ def _child_collective() -> None:
     print(json.dumps(row), flush=True)
 
 
+def _child_self_tune() -> None:
+    """Self-tuning row (ISSUE 14 / ROADMAP item 4): each leg measures a
+    workload hand-tuned (compiled defaults, tuner off), then re-runs it
+    from DELIBERATELY-WRONG flags with the tuner ON and reports the
+    recovery ratio (tuned/hand for throughput, hand/tuned for latency),
+    the per-second recovery trajectory, the converged knob values, and
+    the decision counts — the `tuner:` stamp that makes a tuning run a
+    comparable BENCH series.  Wrong seeds, chosen for measured damage
+    on this box: stripe chunk 64KB + 1 rail (~5x off on 64MB striped),
+    messenger cut budget 64KB (the AIMD growth path on the 1KB and
+    qos_mixed rows).  All knob movement goes through the validated
+    reload path; defaults are restored between legs."""
+    import numpy as np
+
+    from brpc_tpu.rpc import (Channel, Server, get_flag, observe,
+                              set_flag, tuner)
+
+    TUNER_INTERVAL_MS = 50
+    TUNER_EVAL_TICKS = 2
+
+    defaults = {f["name"]: f["default"] for f in observe.flags()}
+    # Every knob the controller can actuate: restored wholesale between
+    # legs, so a side-effect move in one leg (e.g. the budget rule
+    # firing on the striped leg's yields) can never contaminate the
+    # next leg's hand-tuned baseline.
+    tuner_knobs = [
+        "trpc_stripe_chunk_bytes", "trpc_stripe_rails",
+        "trpc_messenger_cut_budget", "trpc_rma_window_bytes",
+        "trpc_qos_lane_weights",
+    ]
+
+    def restore(names):
+        for n in names:
+            set_flag(n, defaults[n])
+
+    def tuner_on():
+        set_flag("trpc_tuner_interval_ms", str(TUNER_INTERVAL_MS))
+        set_flag("trpc_tuner_eval_ticks", str(TUNER_EVAL_TICKS))
+        tuner.enable_tuner(True)
+
+    def tuner_off():
+        tuner.enable_tuner(False)
+        restore(["trpc_tuner_interval_ms", "trpc_tuner_eval_ticks"])
+
+    def pipeline_rate(size, depth, seconds):
+        """Loopback echo through the batch pipeline; returns (per-second
+        completion buckets, completions/s over the final 3 buckets)."""
+        srv = Server()
+        srv.register_native_echo("Echo.Echo")
+        srv.start(0)
+        conn = "pooled" if size >= (1 << 20) else "single"
+        ch = Channel(f"127.0.0.1:{srv.port}", timeout_ms=60000,
+                     connection_type=conn)
+        payload = np.zeros(size, dtype=np.uint8)
+        pipe = ch.pipeline()
+        free = [np.empty(size, dtype=np.uint8) for _ in range(depth)]
+        t2b: dict = {}
+
+        def submit(k):
+            bs = [free.pop() for _ in range(k)]
+            toks = pipe.submit("Echo.Echo", [payload] * k, resp_bufs=bs)
+            t2b.update(zip(toks, bs))
+
+        try:
+            submit(depth)
+            t0 = time.perf_counter()
+            done = last_done = 0
+            last = t0
+            buckets = []
+            while time.perf_counter() - t0 < seconds:
+                cs = pipe.poll(max_n=depth, timeout_ms=60000)
+                if not cs:
+                    raise RuntimeError("self_tune pipeline wedged")
+                for c in cs:
+                    if not c.ok:
+                        raise RuntimeError(f"self_tune member failed: {c}")
+                    free.append(t2b.pop(c.token))
+                    done += 1
+                submit(len(cs))
+                now = time.perf_counter()
+                if now - last >= 1.0:
+                    buckets.append((done - last_done) / (now - last))
+                    last, last_done = now, done
+            while t2b:
+                for c in pipe.poll(max_n=depth, timeout_ms=60000):
+                    free.append(t2b.pop(c.token))
+        finally:
+            pipe.close()
+            ch.close()
+            srv.stop()
+        tail = buckets[-3:] if len(buckets) >= 3 else buckets
+        return buckets, sum(tail) / len(tail)
+
+    legs = {}
+    decisions_before = 0
+
+    def leg_decisions():
+        nonlocal decisions_before
+        now = tuner.counters()["decisions"]
+        n, decisions_before = now - decisions_before, now
+        return n
+
+    # ---- leg 1: 64MB striped goodput --------------------------------
+    size = 64 << 20
+    stripe_knobs = ["trpc_stripe_chunk_bytes", "trpc_stripe_rails"]
+    _, hand_rate = pipeline_rate(size, depth=4, seconds=5)
+    hand_gbps = hand_rate * size / 1e9
+    set_flag("trpc_stripe_chunk_bytes", "65536")
+    set_flag("trpc_stripe_rails", "1")
+    tuner_on()
+    traj, tuned_rate = pipeline_rate(size, depth=4, seconds=14)
+    tuner_off()
+    tuned_gbps = tuned_rate * size / 1e9
+    legs["striped_64mb"] = {
+        "metric": "goodput_gbps",
+        "hand": round(hand_gbps, 3),
+        "wrong_flags": {"trpc_stripe_chunk_bytes": 65536,
+                        "trpc_stripe_rails": 1},
+        "tuned": round(tuned_gbps, 3),
+        "recovery": round(tuned_gbps / hand_gbps, 3),
+        "trajectory_gbps": [round(b * size / 1e9, 2) for b in traj],
+        "converged": {k: int(get_flag(k)) for k in stripe_knobs},
+        "decisions": leg_decisions(),
+    }
+    restore(tuner_knobs)
+
+    # ---- leg 2: 1KB pipelined QPS -----------------------------------
+    _, hand_qps = pipeline_rate(1024, depth=256, seconds=5)
+    set_flag("trpc_messenger_cut_budget", "65536")
+    tuner_on()
+    traj, tuned_qps = pipeline_rate(1024, depth=256, seconds=10)
+    tuner_off()
+    legs["one_kb"] = {
+        "metric": "qps",
+        "hand": round(hand_qps),
+        "wrong_flags": {"trpc_messenger_cut_budget": 65536},
+        "tuned": round(tuned_qps),
+        "recovery": round(tuned_qps / hand_qps, 3),
+        "trajectory_qps": [round(b) for b in traj],
+        "converged": {"trpc_messenger_cut_budget":
+                      int(get_flag("trpc_messenger_cut_budget"))},
+        "decisions": leg_decisions(),
+    }
+    restore(tuner_knobs)
+
+    # ---- leg 3: qos_mixed fg p99 under bulk saturation --------------
+    set_flag("trpc_qos_lanes", "4")
+    srv = Server()
+    srv.register_native_echo("Echo.Echo")
+    srv.set_qos("bg:weight=1,limit=4;*:limit=10000")
+    srv.start(0)
+    addr = f"127.0.0.1:{srv.port}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    load_secs = 26
+    bulk_code = (
+        "import time\nfrom brpc_tpu.rpc import Channel\n"
+        f"ch = Channel({addr!r}, timeout_ms=60000, "
+        "connection_type='pooled', qos_tenant='bulk', qos_priority=3)\n"
+        f"buf = b'b' * {64 << 20}\n"
+        f"end = time.time() + {load_secs}\n"
+        "while time.time() < end:\n    ch.call('Echo.Echo', buf)\n")
+    procs = [subprocess.Popen([sys.executable, "-c", bulk_code], env=env)
+             for _ in range(2)]
+    fg = Channel(addr, timeout_ms=20000, qos_tenant="fg", qos_priority=0)
+
+    def p99(seconds):
+        lat = []
+        for _ in range(100):
+            fg.call("Echo.Echo", b"x" * 1024)
+        end = time.perf_counter() + seconds
+        while time.perf_counter() < end:
+            t0 = time.perf_counter()
+            fg.call("Echo.Echo", b"x" * 1024)
+            lat.append((time.perf_counter() - t0) * 1e6)
+        lat.sort()
+        return lat[len(lat) * 99 // 100], len(lat)
+
+    try:
+        time.sleep(2.5)  # bulk streams to steady state
+        hand_p99, hand_n = p99(5.0)
+        set_flag("trpc_messenger_cut_budget", "65536")
+        tuner_on()
+        time.sleep(3.0)  # convergence window under live load
+        tuned_p99, tuned_n = p99(5.0)
+        tuner_off()
+    finally:
+        fg.close()
+        for p in procs:  # measurements done: don't idle out their timer
+            p.terminate()
+        for p in procs:
+            p.wait()
+        srv.stop()
+    legs["qos_mixed"] = {
+        "metric": "fg_p99_us",
+        "hand": round(hand_p99),
+        "wrong_flags": {"trpc_messenger_cut_budget": 65536},
+        "tuned": round(tuned_p99),
+        # Latency: recovery = hand/tuned (1.0 = fully recovered;
+        # >1 = the tuned box beat the hand numbers).
+        "recovery": round(hand_p99 / max(tuned_p99, 1.0), 3),
+        "samples": {"hand": hand_n, "tuned": tuned_n},
+        "converged": {"trpc_messenger_cut_budget":
+                      int(get_flag("trpc_messenger_cut_budget"))},
+        "decisions": leg_decisions(),
+    }
+    restore(tuner_knobs + ["trpc_qos_lanes"])
+
+    row = {
+        "workload": "self_tune",
+        "tuner": {"interval_ms": TUNER_INTERVAL_MS,
+                  "eval_ticks": TUNER_EVAL_TICKS,
+                  "counters": tuner.counters()},
+        "legs": legs,
+        "timeline": get_flag("trpc_timeline") == "true",
+    }
+    print(json.dumps(row), flush=True)
+
+
 def _child_rolling_restart() -> None:
     """Cluster control-plane row (ISSUE 12): drain + hot-restart one
     node of a 3-node naming-backed cluster under mixed 1KB + striped
@@ -1025,6 +1244,9 @@ def main() -> None:
     if os.environ.get("BENCH_COLL"):
         _child_collective()
         return
+    if os.environ.get("BENCH_SELF_TUNE"):
+        _child_self_tune()
+        return
     if os.environ.get("BENCH_TPU_RPC"):
         _child_tpu_rpc()
         return
@@ -1080,6 +1302,7 @@ def main() -> None:
     kv_disagg = _run_json_child({"BENCH_KV": "1"}, 240)
     rolling_restart = _run_json_child({"BENCH_RR": "1"}, 240)
     coll = _run_json_child({"BENCH_COLL": "1"}, 240)
+    self_tune = _run_json_child({"BENCH_SELF_TUNE": "1"}, 240)
 
     # tpu_rpc leg, same retry contract; a CPU-platform run is still a real
     # measurement of the native RPC stack, so fall back rather than emit
@@ -1117,6 +1340,7 @@ def main() -> None:
         "kv_disagg": kv_disagg,
         "rolling_restart": rolling_restart,
         "collective": coll,
+        "self_tune": self_tune,
     }))
 
 

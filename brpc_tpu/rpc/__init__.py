@@ -3,6 +3,7 @@ from brpc_tpu.rpc import fault  # noqa: F401
 from brpc_tpu.rpc import kv  # noqa: F401
 from brpc_tpu.rpc import naming  # noqa: F401
 from brpc_tpu.rpc import observe  # noqa: F401
+from brpc_tpu.rpc import tuner  # noqa: F401
 from brpc_tpu.rpc._lib import IOBuf, load_library, parse_endpoint  # noqa: F401
 from brpc_tpu.rpc.batch import (  # noqa: F401
     Batch,

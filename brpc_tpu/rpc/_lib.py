@@ -531,6 +531,28 @@ def load_library() -> ctypes.CDLL:
             lib.trpc_timeline_dump.restype = ctypes.c_size_t
             lib.trpc_timeline_enabled.restype = ctypes.c_int
             lib.trpc_timeline_reset.restype = None
+            # Self-tuning controller + flag introspection
+            # (capi/tuner_capi.cc; stat/tuner.h).
+            lib.trpc_flags_dump.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.trpc_flags_dump.restype = ctypes.c_size_t
+            lib.trpc_tuner_enabled.restype = ctypes.c_int
+            lib.trpc_tuner_dump.argtypes = [
+                ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.trpc_tuner_dump.restype = ctypes.c_size_t
+            lib.trpc_tuner_counters.argtypes = [
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.trpc_tuner_counters.restype = None
+            lib.trpc_server_enable_tuner.argtypes = [ctypes.c_void_p]
+            lib.trpc_server_enable_tuner.restype = ctypes.c_int
+            lib.trpc_tuner_reset.argtypes = []
+            lib.trpc_tuner_reset.restype = None
             lib.trpc_trace_get.argtypes = [
                 ctypes.POINTER(ctypes.c_uint64),
                 ctypes.POINTER(ctypes.c_uint64),

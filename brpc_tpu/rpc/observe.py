@@ -97,6 +97,21 @@ class Vars:
             lambda buf, n: lib.trpc_vars_dump(1, buf, n)).decode()
 
 
+# ---------------------------------------------------------------- flags ----
+
+
+def flags() -> list[dict]:
+    """Every runtime flag with its introspection record: {"name",
+    "type", "value", "default", "reloadable"} plus "min"/"max" where
+    the flag declared numeric bounds (base/flags.h set_int_range) — the
+    same body /flags?format=json serves.  Tools (and the self-tuning
+    controller) read actuation bounds from here instead of guessing, so
+    out-of-range writes are impossible by construction."""
+    lib = load_library()
+    raw = _dump_with_retry(lambda buf, n: lib.trpc_flags_dump(buf, n))
+    return json.loads(raw.decode())
+
+
 # ------------------------------------------------------------- latency ----
 
 
@@ -320,6 +335,7 @@ TIMELINE_EVENTS = {
     21: "qos_drain",      # timeline-event 21 (qos_drain)
     22: "kv_block",       # timeline-event 22 (kv_block)
     23: "coll_step",      # timeline-event 23 (coll_step)
+    24: "tuner_decision",  # timeline-event 24 (tuner_decision)
 }
 
 # kKvBlock `b` op tags (cpp/net/kvstore.h: b = op << 56 | payload len) —

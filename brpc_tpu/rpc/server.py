@@ -117,6 +117,16 @@ class Server:
         if self._lib.trpc_server_enable_collective(self._ptr) != 0:
             raise RuntimeError("enable_collective failed (server running?)")
 
+    def enable_tuner(self) -> None:
+        """Attaches the self-tuning controller (cpp/stat/tuner.h):
+        registers the trpc_tuner* flags/vars and flips `trpc_tuner` on
+        through the validated reload path.  The controller is
+        process-wide (it actuates process-wide flags); disable with
+        rpc.tuner.enable_tuner(False).  Callable before or after
+        start."""
+        if self._lib.trpc_server_enable_tuner(self._ptr) != 0:
+            raise RuntimeError("enable_tuner failed")
+
     def enable_naming_registry(self) -> None:
         """Attaches the NATIVE naming-registry handlers
         (Naming.Announce/Withdraw/Resolve/Watch, cpp/net/naming.h): this

@@ -4,6 +4,8 @@
 #include <cstdlib>
 #include <map>
 
+#include "base/json.h"
+
 namespace trpc {
 
 namespace {
@@ -196,6 +198,57 @@ void Flag::set_validator(std::function<bool(const std::string&)> v) {
 void Flag::on_update(std::function<void(Flag*)> cb) {
   std::lock_guard<std::mutex> g(hook_mu_);
   update_cb_ = std::move(cb);
+}
+
+void Flag::set_int_range(int64_t lo, int64_t hi) {
+  set_validator([lo, hi](const std::string& v) {
+    char* end = nullptr;
+    const long long n = strtoll(v.c_str(), &end, 10);
+    return end != v.c_str() && *end == '\0' && n >= lo && n <= hi;
+  });
+  set_bounds_hint(lo, hi);
+}
+
+void Flag::set_bounds_hint(int64_t lo, int64_t hi) {
+  std::lock_guard<std::mutex> g(hook_mu_);
+  has_bounds_ = true;
+  bound_lo_ = lo;
+  bound_hi_ = hi;
+}
+
+bool Flag::bounds(int64_t* lo, int64_t* hi) const {
+  std::lock_guard<std::mutex> g(hook_mu_);
+  if (!has_bounds_) {
+    return false;
+  }
+  if (lo != nullptr) {
+    *lo = bound_lo_;
+  }
+  if (hi != nullptr) {
+    *hi = bound_hi_;
+  }
+  return true;
+}
+
+std::string Flag::dump_json() {
+  static const char* kTypeNames[] = {"bool", "int64", "double", "string"};
+  Json arr = Json::array();
+  for (Flag* f : all()) {
+    Json j = Json::object();
+    j.set("name", Json::str(f->name()));
+    j.set("type", Json::str(kTypeNames[static_cast<int>(f->type())]));
+    j.set("value", Json::str(f->value_string()));
+    j.set("default", Json::str(f->default_value()));
+    j.set("reloadable", Json::boolean(f->reloadable()));
+    int64_t lo = 0;
+    int64_t hi = 0;
+    if (f->bounds(&lo, &hi)) {
+      j.set("min", Json::number(static_cast<double>(lo)));
+      j.set("max", Json::number(static_cast<double>(hi)));
+    }
+    arr.push_back(std::move(j));
+  }
+  return arr.dump();
 }
 
 }  // namespace trpc

@@ -70,6 +70,25 @@ class Flag {
   // Runs after a successful set — push the new value into live components.
   void on_update(std::function<void(Flag*)> cb);
 
+  // Declared numeric bounds, introspectable via dump_json (the /flags
+  // ?format=json and trpc_flags_dump surfaces) and honored by actuators
+  // like the stat/tuner controller, which clamp into [lo, hi] BEFORE
+  // attempting a set — out-of-range actuation is impossible by
+  // construction, not by hoping the validator catches it.
+  // set_int_range installs BOTH a standard [lo, hi] range validator and
+  // the bounds record; set_bounds_hint records bounds only (for flags
+  // whose validator checks more than a range, e.g. power-of-two).
+  void set_int_range(int64_t lo, int64_t hi);
+  void set_bounds_hint(int64_t lo, int64_t hi);
+  // False when no bounds were declared (out params untouched).
+  bool bounds(int64_t* lo, int64_t* hi) const;
+
+  // Introspection dump for tooling: a JSON array of {"name", "type",
+  // "value", "default", "reloadable"} plus "min"/"max" where bounds
+  // were declared.  The shape /flags?format=json serves and
+  // observe.py flags() parses.
+  static std::string dump_json();
+
  private:
   Flag(std::string name, Type t, std::string dflt, std::string desc);
   static Flag* define(const std::string& name, Type t,
@@ -84,9 +103,12 @@ class Flag {
   std::atomic<double> real_{0.0};   // double
   mutable std::mutex str_mu_;       // string payload
   std::string str_;
-  std::mutex hook_mu_;
+  mutable std::mutex hook_mu_;  // bounds() reads under it from const
   std::function<bool(const std::string&)> validator_;
   std::function<void(Flag*)> update_cb_;
+  bool has_bounds_ = false;  // guarded by hook_mu_ (with lo/hi below)
+  int64_t bound_lo_ = 0;
+  int64_t bound_hi_ = 0;
 };
 
 }  // namespace trpc

@@ -1,0 +1,26 @@
+// Shared helpers for the C ABI surface (capi/*.cc).
+#pragma once
+
+#include <cstring>
+#include <string>
+
+namespace trpc {
+namespace capi {
+
+// The buffer protocol every dump-style capi call follows: the return
+// value is the FULL byte length of the rendered text (excluding the
+// NUL); the buffer receives min(full, out_len-1) bytes plus a NUL.  A
+// caller seeing ret >= out_len re-calls with a bigger buffer — no
+// truncated body is ever parsed by accident.  One definition, so the
+// contract cannot drift between capi files.
+inline size_t copy_out(const std::string& s, char* out, size_t out_len) {
+  if (out != nullptr && out_len > 0) {
+    const size_t n = s.size() < out_len - 1 ? s.size() : out_len - 1;
+    memcpy(out, s.data(), n);
+    out[n] = '\0';
+  }
+  return s.size();
+}
+
+}  // namespace capi
+}  // namespace trpc

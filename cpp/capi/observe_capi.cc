@@ -20,23 +20,16 @@
 
 #include "base/json.h"
 #include "base/proc.h"
+#include "capi/capi_util.h"
 #include "net/span.h"
 #include "stat/latency_recorder.h"
 #include "stat/timeline.h"
 #include "stat/variable.h"
 
 using namespace trpc;
+using trpc::capi::copy_out;
 
 namespace {
-
-size_t copy_out(const std::string& s, char* out, size_t out_len) {
-  if (out != nullptr && out_len > 0) {
-    const size_t n = s.size() < out_len - 1 ? s.size() : out_len - 1;
-    memcpy(out, s.data(), n);
-    out[n] = '\0';
-  }
-  return s.size();
-}
 
 // An explicit span handle: the span itself plus the ambient context it
 // displaced, restored at end so nested trace()/span scopes unwind

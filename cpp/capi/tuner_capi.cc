@@ -1,0 +1,80 @@
+// C ABI for the self-tuning controller (stat/tuner.h) and the flag
+// introspection surface it rides on (Python ctypes binding surface —
+// brpc_tpu/rpc/tuner.py and observe.py flags()).
+//
+// Buffer protocol: capi/capi_util.h copy_out — dump calls return the
+// FULL byte length; a caller seeing ret >= out_len re-calls bigger.
+#include <cstdint>
+#include <string>
+
+#include "base/flags.h"
+#include "capi/capi_util.h"
+#include "net/server.h"
+#include "stat/tuner.h"
+
+using namespace trpc;
+using trpc::capi::copy_out;
+
+extern "C" {
+
+// ---- flag introspection --------------------------------------------------
+
+// Every runtime flag as a JSON array of {"name", "type", "value",
+// "default", "reloadable"} plus "min"/"max" where bounds were declared
+// (base/flags.h set_int_range / set_bounds_hint) — the same body
+// /flags?format=json serves.  Tools read bounds from here instead of
+// guessing, so out-of-range actuation is impossible by construction.
+size_t trpc_flags_dump(char* out, size_t out_len) {
+  return copy_out(Flag::dump_json(), out, out_len);
+}
+
+// ---- tuner ---------------------------------------------------------------
+
+// 1 while the trpc_tuner flag is on (the control loop is ticking).
+int trpc_tuner_enabled() {
+  tuner::ensure_registered();
+  return tuner::enabled() ? 1 : 0;
+}
+
+// The /tuner body, in-process: {"enabled", counters, "rules", "inputs",
+// "decisions" (newest `limit`, oldest first)}.  Served even while the
+// tuner is off — the journal may hold decisions from an earlier
+// enabled window.
+size_t trpc_tuner_dump(size_t limit, char* out, size_t out_len) {
+  if (limit == 0 || limit > 512) {
+    limit = limit == 0 ? 128 : 512;  // journal ring cap
+  }
+  return copy_out(tuner::dump_json(limit), out, out_len);
+}
+
+// Lifetime counters (the tuner_* vars, one crossing).
+void trpc_tuner_counters(uint64_t* ticks, uint64_t* decisions,
+                         uint64_t* reverts, uint64_t* freezes) {
+  if (ticks != nullptr) {
+    *ticks = tuner::ticks_total();
+  }
+  if (decisions != nullptr) {
+    *decisions = tuner::decisions_total();
+  }
+  if (reverts != nullptr) {
+    *reverts = tuner::reverts_total();
+  }
+  if (freezes != nullptr) {
+    *freezes = tuner::freezes_total();
+  }
+}
+
+// Attach point: registers the tuner flags/vars and flips trpc_tuner on
+// for this process (Server::EnableTuner — the embedder's one-liner).
+// Returns 0 on success.
+int trpc_server_enable_tuner(void* srv) {
+  if (srv == nullptr) {
+    return -1;
+  }
+  return static_cast<Server*>(srv)->EnableTuner() ? 0 : -1;
+}
+
+// Test support: clears rules/state/journal/counters (flag must be off).
+void trpc_tuner_reset() { tuner::reset_for_test(); }
+
+}  // extern "C"

@@ -29,6 +29,7 @@
 #include "stat/heap_profiler.h"
 #include "stat/profiler.h"
 #include "stat/timeline.h"
+#include "stat/tuner.h"
 #include "stat/variable.h"
 
 namespace trpc {
@@ -153,6 +154,16 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
   }
   // ---- round-2 additions -------------------------------------------------
   if (path == "/flags" || path == "/flags/") {
+    // ?format=json serves the introspection dump the tuner and tools
+    // consume: {name, type, value, default, reloadable, min?, max?} —
+    // bounds from the declared validators (base/flags.h set_int_range),
+    // same body as trpc_flags_dump / observe.py flags().
+    const std::string* fmt = req.query("format");
+    if (fmt != nullptr && *fmt == "json") {
+      *body = Flag::dump_json();
+      *content_type = "application/json";
+      return true;
+    }
     *body = flags_text();
     return true;
   }
@@ -340,6 +351,26 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
       *body = timeline::dump_json(limit);
       *content_type = "application/json";
     }
+    return true;
+  }
+  if (path == "/tuner") {
+    // Self-tuning controller (stat/tuner.h): status, live rule table,
+    // sampled inputs and the structured decision journal, recorded
+    // while the reloadable trpc_tuner flag is on (flip it via
+    // /flags/trpc_tuner?setvalue=true).  Served even while tuning is
+    // off — the journal may hold decisions from an earlier enabled
+    // window.  ?limit=N caps journal entries (default 128, max 512).
+    size_t limit = 128;
+    const std::string* lq = req.query("limit");
+    if (lq != nullptr) {
+      const long v = atol(lq->c_str());
+      if (v > 0) {
+        limit = std::min(static_cast<size_t>(v),
+                         static_cast<size_t>(512));
+      }
+    }
+    *body = tuner::dump_json(limit);
+    *content_type = "application/json";
     return true;
   }
   if (path == "/analysis") {
@@ -540,10 +571,12 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
   if (path == "/index" || path == "/") {
     *body =
         "/health\n/version\n/status\n/vars\n/vars/<name>\n/brpc_metrics\n"
-        "/connections\n/flags\n/flags/<name>[?setvalue=v]\n/threads\n"
+        "/connections\n/flags[?format=json]\n/flags/<name>[?setvalue=v]\n"
+        "/threads\n"
         "/memory\n/list\n/protobufs\n/index\n"
         "/rpcz[?trace_id=hex&format=json&limit=N]\n"
         "/timeline[?format=binary&limit=N]\n"
+        "/tuner[?limit=N]\n"
         "/faults[?set=spec&server=spec&reset=1]\n"
         "/hotspots[?seconds=N]\n/contention\n/analysis\n/fibers\n"
         "/sockets\n/ids\n"

@@ -30,11 +30,9 @@ Flag* int_flag(const char* name, int64_t dflt, const char* desc, int64_t lo,
                int64_t hi) {
   Flag* f = Flag::define_int64(name, dflt, desc);
   if (f != nullptr) {
-    f->set_validator([lo, hi](const std::string& v) {
-      char* end = nullptr;
-      const long long n = strtoll(v.c_str(), &end, 10);
-      return end != v.c_str() && *end == '\0' && n >= lo && n <= hi;
-    });
+    // Range validator + introspectable bounds in one declaration (the
+    // tuner and /flags?format=json read them back).
+    f->set_int_range(lo, hi);
   }
   return f;
 }

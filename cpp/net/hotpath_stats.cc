@@ -72,6 +72,14 @@ HotPathVars::HotPathVars() {
   stripe_rx_chunks.expose(
       "stripe_rx_chunks",
       "large-message stripe chunk frames received (heads included)");
+  stripe_tx_bytes.expose(
+      "stripe_tx_bytes",
+      "striped payload bytes sent (whole message bodies; the tuner's "
+      "sender-side throughput signal for the stripe knobs)");
+  stripe_rx_bytes.expose(
+      "stripe_rx_bytes",
+      "striped payload bytes landed at receivers (per-chunk sizes; the "
+      "tuner's hill-climb target for stripe chunk/rail geometry)");
   stripe_reassembled.expose(
       "stripe_reassembled",
       "striped messages fully reassembled and dispatched");

@@ -50,6 +50,8 @@ struct HotPathVars {
   // bypass the stripe layer entirely.
   Adder stripe_tx_chunks;    // chunk frames sent (head included)
   Adder stripe_rx_chunks;    // chunk frames received (head included)
+  Adder stripe_tx_bytes;     // striped payload bytes sent (whole bodies)
+  Adder stripe_rx_bytes;     // striped payload bytes landed (chunk sizes)
   Adder stripe_reassembled;  // messages fully reassembled and dispatched
   Adder stripe_expired;      // reassemblies dropped by timeout/abandon
 

@@ -36,13 +36,11 @@ Flag* cut_budget_flag() {
     Flag* flag = Flag::define_int64(
         "trpc_messenger_cut_budget", 8ll << 20,
         "bytes one readable sweep may read+parse before yielding its "
-        "worker to queued fibers (0 = never yield)");
+        "worker to queued fibers ([0, 1GB]; 0 = never yield)");
     if (flag != nullptr) {
-      flag->set_validator([](const std::string& v) {
-        char* end = nullptr;
-        const long long n = strtoll(v.c_str(), &end, 10);
-        return end != v.c_str() && *end == '\0' && n >= 0;
-      });
+      // Range validator + introspectable bounds (the tuner's AIMD rule
+      // actuates this knob and clamps into the declared range).
+      flag->set_int_range(0, 1ll << 30);
     }
     return flag;
   }();

@@ -94,11 +94,9 @@ Flag* int_flag(const char* name, int64_t dflt, const char* desc, int64_t lo,
                int64_t hi) {
   Flag* f = Flag::define_int64(name, dflt, desc);
   if (f != nullptr) {
-    f->set_validator([lo, hi](const std::string& v) {
-      char* end = nullptr;
-      const long long n = strtoll(v.c_str(), &end, 10);
-      return end != v.c_str() && *end == '\0' && n >= lo && n <= hi;
-    });
+    // Range validator + introspectable bounds in one declaration (the
+    // tuner and /flags?format=json read them back).
+    f->set_int_range(lo, hi);
   }
   return f;
 }
@@ -119,6 +117,11 @@ Flag* window_flag() {
                (n == 0 || (n >= (16ll << 20) && n <= (4ll << 30) &&
                            (n & (n - 1)) == 0));
       });
+      // Bounds hint only: the validator additionally requires 0 or a
+      // power of two, so set_int_range would be too permissive.  The
+      // tuner's window rule doubles within these bounds (preserving
+      // power-of-two) and never touches a 0 (= disabled) window.
+      flag->set_bounds_hint(16ll << 20, 4ll << 30);
     }
     return flag;
   }();

@@ -38,6 +38,7 @@
 #include "net/rma.h"
 #include "net/stripe.h"
 #include "net/protocol.h"
+#include "stat/tuner.h"
 
 namespace trpc {
 
@@ -571,6 +572,11 @@ Flag* drain_deadline_flag() {
 }  // namespace
 
 void Server::drain_ensure_registered() { drain_deadline_flag(); }
+
+bool Server::EnableTuner(bool on) {
+  tuner::ensure_registered();
+  return Flag::set("trpc_tuner", on ? "true" : "false") == 0;
+}
 
 void Server::add_drain_hook(std::function<void()> hook) {
   std::lock_guard<std::mutex> g(drain_mu_);

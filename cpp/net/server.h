@@ -257,6 +257,14 @@ class Server {
   // Flag registration (idempotent): trpc_drain_deadline_ms — the capi
   // calls it so /flags sees the drain knob before the first Drain.
   static void drain_ensure_registered();
+  // Attaches the self-tuning controller (stat/tuner.h): registers the
+  // trpc_tuner* flags/vars and flips trpc_tuner through the validated
+  // reload path — the embedder's one-liner for "tune this process".
+  // The controller is process-wide (it actuates process-wide flags),
+  // so this is a convenience attach point, not per-server state.
+  // Callable before or after Start; on=false flips it back off.
+  // Returns true on success.
+  bool EnableTuner(bool on = true);
   // Registers a hook run at the START of Drain (before the in-flight
   // wait): the seam the naming announcer (withdraw), the KV store
   // (tombstone + withdraw_all) and embedders use to leave the fleet

@@ -47,6 +47,9 @@ Flag* ring_bytes_flag() {
         return end != v.c_str() && *end == '\0' && n >= (64 << 10) &&
                n <= (256ll << 20) && (n & (n - 1)) == 0;
       });
+      // Bounds hint only: the validator checks power-of-two on top of
+      // the range, so set_int_range would be too permissive.
+      flag->set_bounds_hint(64 << 10, 256ll << 20);
     }
     return flag;
   }();

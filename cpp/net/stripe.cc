@@ -41,11 +41,9 @@ Flag* int_flag(const char* name, int64_t dflt, const char* desc,
                int64_t lo, int64_t hi) {
   Flag* f = Flag::define_int64(name, dflt, desc);
   if (f != nullptr) {
-    f->set_validator([lo, hi](const std::string& v) {
-      char* end = nullptr;
-      const long long n = strtoll(v.c_str(), &end, 10);
-      return end != v.c_str() && *end == '\0' && n >= lo && n <= hi;
-    });
+    // Range validator + introspectable bounds in one declaration (the
+    // tuner and /flags?format=json read them back).
+    f->set_int_range(lo, hi);
   }
   return f;
 }
@@ -481,12 +479,15 @@ int stripe_send(SocketId primary, const std::vector<SocketId>& rails,
     }
   }
   hotpath_vars().stripe_tx_chunks << static_cast<int64_t>(nchunks);
+  hotpath_vars().stripe_tx_bytes << static_cast<int64_t>(total);
   return 0;
 }
 
 void stripe_on_head(InputMessage&& msg) {
   maybe_gc();
   hotpath_vars().stripe_rx_chunks << 1;
+  hotpath_vars().stripe_rx_bytes
+      << static_cast<int64_t>(msg.payload.size());
   const uint64_t id = msg.meta.stripe_id;
   const uint64_t total = msg.meta.stripe_total;
   const uint64_t off = msg.meta.stripe_offset;
@@ -510,6 +511,8 @@ void stripe_on_head(InputMessage&& msg) {
 void stripe_on_chunk(InputMessage&& msg) {
   maybe_gc();
   hotpath_vars().stripe_rx_chunks << 1;
+  hotpath_vars().stripe_rx_bytes
+      << static_cast<int64_t>(msg.payload.size());
   const uint64_t off = msg.meta.stripe_offset;
   std::shared_ptr<StripeEntry> e =
       admit_chunk(msg.meta.stripe_id, msg.meta.stripe_total, off,

@@ -76,11 +76,8 @@ Flag* ring_kb_flag() {
         "applies to rings created after the set — a live thread keeps "
         "its ring)");
     if (flag != nullptr) {
-      flag->set_validator([](const std::string& v) {
-        char* end = nullptr;
-        const long n = strtol(v.c_str(), &end, 10);
-        return end != v.c_str() && *end == '\0' && n >= 64 && n <= 65536;
-      });
+      // Range validator + introspectable bounds in one declaration.
+      flag->set_int_range(64, 65536);
     }
     return flag;
   }();

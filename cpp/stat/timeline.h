@@ -78,6 +78,12 @@ enum EventType : uint32_t {
   kCollStep = 23,  // a=step index, b=(op << 56) | step bytes; ops:
                    // 1 all_gather, 2 reduce_scatter, 3 all_to_all,
                    // 4 reshard (CollOp values)
+  // -- self-tuning controller (stat/tuner.h) -----------------------------
+  kTunerDecision = 24,  // a=knob hash (tuner::knob_hash, FNV-1a of the
+                        // flag name), b=(old & 0xffffffff) << 32 |
+                        // (new & 0xffffffff) — values wider than 32
+                        // bits truncate here; the /tuner journal keeps
+                        // them exact
   kEventTypeCount,
 };
 
@@ -108,6 +114,7 @@ constexpr const char* kEventNames[] = {
     "qos_drain",       // timeline-event 21 (qos_drain)
     "kv_block",        // timeline-event 22 (kv_block)
     "coll_step",       // timeline-event 23 (coll_step)
+    "tuner_decision",  // timeline-event 24 (tuner_decision)
 };
 static_assert(sizeof(kEventNames) / sizeof(kEventNames[0]) ==
                   kEventTypeCount,

@@ -39,7 +39,7 @@ _NO_CMAKE = shutil.which("cmake") is None or shutil.which("ctest") is None
 TSAN_SUITES = [
     "fiber", "rpc", "stream", "shm", "ici", "chaos", "stat", "qos",
     "stripe", "analysis", "timeline", "rma", "kvstore", "naming",
-    "collective",
+    "collective", "tuner",
 ]
 ALL_SUITES = sorted(
     p.stem[len("test_"):] for p in (REPO / "cpp" / "tests").glob("test_*.cc")
@@ -191,6 +191,18 @@ def test_collective_cpp_suite_native():
     cancel-mid-schedule session quiescence."""
     _run_native_suite("test_collective.cc", "test_collective_native",
                       "collective suite")
+
+
+def test_tuner_cpp_suite_native():
+    """ISSUE 14: the self-tuning controller gates tier-1 — flag-off
+    invisibility (vars frozen at 0, no knob ever touched), convergence
+    from a deliberately-wrong knob on a synthetic metric, the
+    revert-on-regression guard + freeze/backoff, bounds clamping
+    through the declared-bounds path (tuner_set_rejected provably 0),
+    journal/timeline agreement, and the background control loop's
+    tick/stop behavior."""
+    _run_native_suite("test_tuner.cc", "test_tuner_native",
+                      "tuner suite")
 
 
 def test_kvstore_cpp_suite_native():

@@ -7,7 +7,8 @@ Rules (each names the incident class it prevents):
   flag-validator     Every runtime `Flag::define_*` whose name is a
                      `trpc_*` literal (or flows in via a variable, i.e.
                      a wrapper/per-method definition) must install a
-                     set_validator (or set_reloadable(false)) nearby.
+                     set_validator / set_int_range (or
+                     set_reloadable(false)) nearby.
                      Reloadable-without-validation means /flags?setvalue
                      can land garbage in a hot path at runtime.
 
@@ -52,6 +53,19 @@ Rules (each names the incident class it prevents):
                      trpc_cluster_*/trpc_drain_*/trpc_naming_* knobs)
                      otherwise only fails at run time, on the one box
                      that exercises that code path.
+
+  tuner-rule         The self-tuning controller actuates flags named in
+                     cpp/stat/tuner.cc's rule table and samples the vars
+                     in its input list.  Every `tuner-knob (name)` marker
+                     must sit on the line assigning that exact literal,
+                     and the knob must be a defined, validated,
+                     *reloadable* trpc_* flag (a typo'd knob silently
+                     never tunes; an immutable one can never be
+                     actuated).  Every `tuner-input` var must be exposed
+                     WITH a Prometheus HELP description (names ending in
+                     '_' match the dynamically-suffixed families by
+                     prefix) — the controller's inputs must be
+                     dashboard-readable, since /tuner republishes them.
 
   atomic-comment     Every memory_order_relaxed / memory_order_acquire
                      in the socket/messenger/qos/stripe hot paths must
@@ -120,54 +134,69 @@ def check_flag_validators() -> None:
                 window_lines.append(nxt)
             window = "\n".join(window_lines)
             if ("set_validator" not in window
+                    and "set_int_range" not in window
                     and "set_reloadable(false)" not in window):
                 flag(path, i + 1, "flag-validator",
-                     f"define of {first or '<flag>'} has no set_validator "
-                     "(or set_reloadable(false)) within 30 lines")
+                     f"define of {first or '<flag>'} has no set_validator/"
+                     "set_int_range (or set_reloadable(false)) within 30 "
+                     "lines")
 
 
 # ---- var-help ------------------------------------------------------------
 
-def check_var_help() -> None:
+def _expose_calls(text: str) -> list:
+    """Every `.expose(` / `->expose(` call site in `text` as
+    (line, first_arg, rest_args) with the split at the first
+    paren/brace-depth-0 comma outside strings (rest_args = "" when the
+    call has a single argument)."""
+    out = []
     site = re.compile(r"[\w\])](?:\.|->)expose\(")
+    for m in site.finditer(text):
+        start = text.index("(", m.start() + 1)
+        depth, j = 0, start
+        while j < len(text):
+            if text[j] == "(":
+                depth += 1
+            elif text[j] == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            j += 1
+        args = text[start + 1:j]
+        d, in_str, split_at = 0, False, -1
+        k = 0
+        while k < len(args):
+            c = args[k]
+            if in_str:
+                if c == "\\":
+                    k += 2
+                    continue
+                if c == '"':
+                    in_str = False
+            elif c == '"':
+                in_str = True
+            elif c in "([{":
+                d += 1
+            elif c in ")]}":
+                d -= 1
+            elif c == "," and d == 0:
+                split_at = k
+                break
+            k += 1
+        line = text[:m.start()].count("\n") + 1
+        if split_at < 0:
+            out.append((line, args, ""))
+        else:
+            out.append((line, args[:split_at], args[split_at + 1:]))
+    return out
+
+
+def check_var_help() -> None:
     for path in runtime_files():
         text = path.read_text()
         lines = text.splitlines()
-        for m in site.finditer(text):
-            start = text.index("(", m.start() + 1)
-            depth, j = 0, start
-            while j < len(text):
-                if text[j] == "(":
-                    depth += 1
-                elif text[j] == ")":
-                    depth -= 1
-                    if depth == 0:
-                        break
-                j += 1
-            args = text[start + 1:j]
-            # ≥2 args ⇔ a comma at paren/brace depth 0 outside strings.
-            d, in_str, has_comma = 0, False, False
-            k = 0
-            while k < len(args):
-                c = args[k]
-                if in_str:
-                    if c == "\\":
-                        k += 2
-                        continue
-                    if c == '"':
-                        in_str = False
-                elif c == '"':
-                    in_str = True
-                elif c in "([{":
-                    d += 1
-                elif c in ")]}":
-                    d -= 1
-                elif c == "," and d == 0:
-                    has_comma = True
-                    break
-                k += 1
-            if not has_comma:
-                line = text[:m.start()].count("\n") + 1
+        for line, _first, rest in _expose_calls(text):
+            if not rest:
                 snippet = lines[line - 1].strip()
                 flag(path, line, "var-help",
                      f"expose() without a HELP description: {snippet}")
@@ -336,6 +365,99 @@ def check_flag_references() -> None:
                      "Flag::define_* in cpp/ defines it")
 
 
+# ---- tuner-rule ----------------------------------------------------------
+
+def _defined_flag_windows() -> dict:
+    """{flag_name: define-window text} for every trpc_* flag defined
+    with a literal name in cpp/ (directly or via a defining wrapper)."""
+    defpat = re.compile(
+        r'(?:define_(?:bool|int64|double|string)|[a-z_]*flag)\(\s*'
+        r'"(trpc_[a-z0-9_]+)"')
+    out = {}
+    for path in runtime_files():
+        text = path.read_text()
+        for m in defpat.finditer(text):
+            # The window the flag-validator rule checks: up to 30 lines
+            # after the define — set_reloadable(false) there marks the
+            # flag immutable.
+            tail = text[m.start():]
+            out[m.group(1)] = "\n".join(tail.splitlines()[:30])
+    return out
+
+
+def check_tuner_rules() -> None:
+    path = CPP / "stat" / "tuner.cc"
+    text = path.read_text()
+    lines = text.splitlines()
+    windows = _defined_flag_windows()
+
+    # Knob assignments must carry a marker naming the SAME literal.
+    marker = re.compile(r"//\s*tuner-knob\s*\((trpc_[a-z0-9_]+)\)")
+    assign = re.compile(r'\.knob\s*=\s*"(trpc_[a-z0-9_]+)"')
+    knobs = []
+    for i, ln in enumerate(lines):
+        am = assign.search(ln)
+        mm = marker.search(ln)
+        if am is None and mm is None:
+            continue
+        if am is None or mm is None or am.group(1) != mm.group(1):
+            flag(path, i + 1, "tuner-rule",
+                 "rule-table knob assignment and its tuner-knob marker "
+                 f"must name the same flag: {ln.strip()}")
+            continue
+        knobs.append((i + 1, am.group(1)))
+    if not knobs:
+        flag(path, 1, "tuner-rule",
+             "no tuner-knob markers found in the built-in rule table")
+    for line, knob in knobs:
+        window = windows.get(knob)
+        if window is None:
+            flag(path, line, "tuner-rule",
+                 f"tuner knob '{knob}' is not defined by any "
+                 "Flag::define_* in cpp/ — the rule can never actuate")
+            continue
+        if "set_reloadable(false)" in window:
+            flag(path, line, "tuner-rule",
+                 f"tuner knob '{knob}' is defined immutable — the "
+                 "validated reload path would refuse every actuation")
+        # Validated: the flag-validator rule already requires every
+        # trpc_* define to install a validator; nothing extra here.
+
+    # Input vars: exposed somewhere in cpp/ WITH a non-empty HELP.
+    inputs = []
+    inpat = re.compile(r'"([a-z0-9_]+)",\s*//\s*tuner-input')
+    for i, ln in enumerate(lines):
+        m = inpat.search(ln)
+        if m is not None:
+            inputs.append((i + 1, m.group(1)))
+    if not inputs:
+        flag(path, 1, "tuner-rule", "no tuner-input markers found")
+    exposes = []
+    for p in runtime_files():
+        exposes.extend(
+            (p, line, first, rest)
+            for line, first, rest in _expose_calls(p.read_text()))
+    for line, name in inputs:
+        hit = False
+        for _p, _l, first, rest in exposes:
+            lead = first.strip()
+            # Exact names expose as the full literal; names ending in
+            # '_' are dynamic families — match the prefix with the
+            # quote left OPEN so both the `"prefix" + suffix` concat
+            # form and a spelled-out `"prefix0"` literal count.
+            if not (lead.startswith(f'"{name}"')
+                    or (name.endswith("_")
+                        and lead.startswith(f'"{name}'))):
+                continue
+            if re.search(r'"[^"]', rest):  # non-empty HELP string
+                hit = True
+                break
+        if not hit:
+            flag(path, line, "tuner-rule",
+                 f"tuner input var '{name}' is not exposed with a "
+                 "Prometheus HELP description anywhere in cpp/")
+
+
 # ---- atomic-comment ------------------------------------------------------
 
 ATOMIC_FILES = [
@@ -370,6 +492,7 @@ def main() -> int:
     check_tail_groups()
     check_timeline_events()
     check_flag_references()
+    check_tuner_rules()
     check_atomic_comments()
     if violations:
         print(f"lint_trpc: {len(violations)} violation(s)")

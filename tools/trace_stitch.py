@@ -171,6 +171,13 @@ _TL_KV_OPS = {1: "publish", 2: "serve", 3: "evict", 4: "stale"}
 _TL_COLL_TID = 980000
 _TL_COLL_OPS = {1: "all_gather", 2: "reduce_scatter", 3: "all_to_all",
                 4: "reshard"}
+# tuner_decision events (stat/tuner.h): one instant per knob actuation
+# by the self-tuning controller on its own per-node "tuner" track —
+# a = knob hash (tuner::knob_hash of the flag name), b = old << 32 |
+# new (32-bit-truncated; the /tuner journal keeps exact values) — so a
+# tuning run reads as a Perfetto artifact: decisions next to the rails/
+# lanes they retuned.
+_TL_TUNER_TID = 990000
 
 
 def _timeline_chrome_events(pid: int, dump: dict, base: float,
@@ -271,6 +278,20 @@ def _timeline_chrome_events(pid: int, dump: dict, base: float,
                     "pid": pid, "tid": out_tid, "ts": ts,
                     "args": {"step": int(e["a"], 16),
                              "bytes": b & ((1 << 56) - 1),
+                             "trace_id": e["trace_id"],
+                             "span_id": e["span_id"], "fid": e["fid"]},
+                })
+                continue
+            if name == "tuner_decision":
+                b = int(e["b"], 16)
+                out_tid = track(_TL_TUNER_TID, "tuner")
+                events.append({
+                    "ph": "i", "s": "t", "cat": "timeline",
+                    "name": "tuner_decision",
+                    "pid": pid, "tid": out_tid, "ts": ts,
+                    "args": {"knob_hash": e["a"],
+                             "old": b >> 32,
+                             "new": b & 0xFFFFFFFF,
                              "trace_id": e["trace_id"],
                              "span_id": e["span_id"], "fid": e["fid"]},
                 })
