@@ -13,9 +13,11 @@ from brpc_tpu.rpc.batch import (  # noqa: F401
 from brpc_tpu.rpc.client import (  # noqa: F401
     Channel,
     ClusterChannel,
+    DeadlineExpiredError,
     DrainingError,
     OverloadedError,
     RpcError,
+    deadline_scope,
 )
 from brpc_tpu.rpc.flags import get_flag, set_flag  # noqa: F401
 from brpc_tpu.rpc.rma import RmaBuffer, kernel_supports  # noqa: F401

@@ -21,6 +21,28 @@ _LIB_PATH = _BUILD / "libtpurpc.so"
 _lock = threading.Lock()
 _lib = None
 
+# Canonical mirror of the C++ runtime's error-code table (the
+# `constexpr int kE* = NNNN;` constants in cpp/net/*.h).  The
+# error-code-sync rule in tools/lint_trpc.py keeps the two in lockstep —
+# a code added or renumbered on one side only fails tier-1 instead of
+# silently mis-typing exceptions.  The typed-exception constructors in
+# client.py / kv.py / naming.py / collective.py resolve codes through
+# the runtime capi at call time; this table is the build-time contract.
+ERROR_CODES = {
+    "kELimit": 2004,
+    "kEOverloaded": 2005,
+    "kEDraining": 2006,
+    "kEDeadlineExpired": 2007,
+    "kEKvMiss": 2101,
+    "kEKvStale": 2102,
+    "kEKvExists": 2103,
+    "kENamingStaleEpoch": 2111,
+    "kENamingMiss": 2112,
+    "kECollAbort": 2121,
+    "kECollEpoch": 2122,
+    "kECollMismatch": 2123,
+}
+
 
 def _newest_source_mtime() -> float:
     newest = 0.0
@@ -467,6 +489,24 @@ def load_library() -> ctypes.CDLL:
             lib.trpc_call_qos.restype = ctypes.c_int
             lib.trpc_qos_overloaded_code.argtypes = []
             lib.trpc_qos_overloaded_code.restype = ctypes.c_int
+            # Deadline & cancellation plane (capi/deadline_capi.cc;
+            # cpp/net/deadline.h).
+            lib.trpc_deadline_expired_code.argtypes = []
+            lib.trpc_deadline_expired_code.restype = ctypes.c_int
+            lib.trpc_call_remaining_us.argtypes = [ctypes.c_void_p]
+            lib.trpc_call_remaining_us.restype = ctypes.c_int64
+            lib.trpc_call_cancelled.argtypes = [ctypes.c_void_p]
+            lib.trpc_call_cancelled.restype = ctypes.c_int
+            lib.trpc_deadline_ambient_set.argtypes = [ctypes.c_int64]
+            lib.trpc_deadline_ambient_set.restype = None
+            lib.trpc_deadline_ambient_remaining.argtypes = []
+            lib.trpc_deadline_ambient_remaining.restype = ctypes.c_int64
+            lib.trpc_deadline_ambient_clear.argtypes = []
+            lib.trpc_deadline_ambient_clear.restype = None
+            lib.trpc_cancel_registered.argtypes = []
+            lib.trpc_cancel_registered.restype = ctypes.c_size_t
+            lib.trpc_deadline_ensure_registered.argtypes = []
+            lib.trpc_deadline_ensure_registered.restype = None
             lib.trpc_qos_lane_depth.argtypes = [ctypes.c_int]
             lib.trpc_qos_lane_depth.restype = ctypes.c_int64
             # Batched async pipeline (capi/batch_capi.cc).

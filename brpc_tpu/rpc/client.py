@@ -33,6 +33,15 @@ class DrainingError(RpcError):
     there moments later); only a bare Channel surfaces this."""
 
 
+class DeadlineExpiredError(RpcError):
+    """The call's end-to-end budget ran out (cpp/net/deadline.h
+    kEDeadlineExpired, code 2007): the request was shed before dispatch
+    (server side, budget expired in flight or queued), or failed fast
+    locally because the ambient budget was already exhausted.  NOT
+    retriable — the budget is just as dead on every other node, and a
+    ClusterChannel stops its attempt chain on it."""
+
+
 def _overloaded_code(lib) -> int:
     return lib.trpc_qos_overloaded_code()
 
@@ -40,13 +49,60 @@ def _overloaded_code(lib) -> int:
 def make_rpc_error(lib, code: int, text: str) -> RpcError:
     """The typed error for a failed call's status code — OverloadedError
     for an admission-control shed, DrainingError for a graceful leave,
-    RpcError otherwise.  Shared by the sync call paths and the batch
-    plane so both surface the same type."""
+    DeadlineExpiredError for an exhausted end-to-end budget, RpcError
+    otherwise.  Shared by the sync call paths and the batch plane so
+    both surface the same type."""
     if code == _overloaded_code(lib):
         return OverloadedError(code, text)
     if code == lib.trpc_draining_code():
         return DrainingError(code, text)
+    if code == lib.trpc_deadline_expired_code():
+        return DeadlineExpiredError(code, text)
     return RpcError(code, text)
+
+
+class deadline_scope:
+    """Ambient end-to-end budget for the CURRENT THREAD's sync calls
+    (cpp/net/deadline.h): inside the scope, every call stamps
+    min(timeout, remaining budget) into meta tail-group 7, so a chain of
+    proxied calls decrements one budget instead of each hop restarting
+    its own.  Re-entrant scopes tighten only (an inner, longer budget is
+    clamped to the outer one's remainder).
+
+        with rpc.deadline_scope(50):       # 50ms end to end
+            ch.call("A.Plan", req)          # stamps <= 50ms
+            ch.call("A.Execute", req2)      # stamps what's left
+    """
+
+    def __init__(self, budget_ms: float):
+        self._budget_us = int(budget_ms * 1000)
+        self._lib = load_library()
+        self._outer = -1
+
+    def __enter__(self) -> "deadline_scope":
+        self._outer = self._lib.trpc_deadline_ambient_remaining()
+        self._t0 = time.monotonic()
+        budget = self._budget_us
+        if 0 <= self._outer < budget:
+            budget = self._outer  # inner scopes only ever tighten
+        self._lib.trpc_deadline_ambient_set(max(budget, 1))
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._outer >= 0:
+            # Restore the OUTER budget minus the time this scope burned:
+            # a nested scope must never hand time back.
+            elapsed = int((time.monotonic() - self._t0) * 1e6)
+            self._lib.trpc_deadline_ambient_set(
+                max(self._outer - elapsed, 1))
+        else:
+            self._lib.trpc_deadline_ambient_clear()
+
+    @property
+    def remaining_us(self) -> int:
+        """Remaining budget right now (0 = exhausted)."""
+        rem = self._lib.trpc_deadline_ambient_remaining()
+        return rem if rem >= 0 else 0
 
 
 def _raise_rpc_error(lib, code: int, text: str):

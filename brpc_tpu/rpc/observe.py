@@ -336,6 +336,7 @@ TIMELINE_EVENTS = {
     22: "kv_block",       # timeline-event 22 (kv_block)
     23: "coll_step",      # timeline-event 23 (coll_step)
     24: "tuner_decision",  # timeline-event 24 (tuner_decision)
+    25: "deadline",       # timeline-event 25 (deadline)
 }
 
 # kKvBlock `b` op tags (cpp/net/kvstore.h: b = op << 56 | payload len) —

@@ -45,6 +45,22 @@ class Call:
         )
         return rc == 0
 
+    @property
+    def remaining_us(self) -> int:
+        """Remaining end-to-end budget of this request in µs
+        (cpp/net/deadline.h): the caller's wire-propagated deadline minus
+        elapsed time since arrival.  A very large value (INT64 max) when
+        the caller set none, 0 when already past.  Only valid BEFORE
+        respond() — the handle dies with the call."""
+        return self._lib.trpc_call_remaining_us(self._handle)
+
+    @property
+    def cancelled(self) -> bool:
+        """True when the caller cancelled this request (kCancel control
+        frame) or its connection died — abandon work nobody will
+        receive.  Only valid BEFORE respond()."""
+        return bool(self._lib.trpc_call_cancelled(self._handle))
+
 
 class Server:
     def __init__(self):

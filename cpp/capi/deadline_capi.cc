@@ -1,0 +1,69 @@
+// C ABI for the deadline & cancellation plane (net/deadline.h) — the
+// Python surface's view of propagated budgets and cascading cancel.
+//
+// Handlers read the serving request's remaining budget / cancel state
+// through their call handle; client pthreads install an ambient budget
+// around their sync calls exactly like the ambient trace context
+// (trpc_trace_set), so a Python proxy re-stamps budget-minus-elapsed on
+// every downstream call without passing anything explicitly.
+#include <cstdint>
+
+#include "base/time.h"
+#include "net/controller.h"
+#include "net/deadline.h"
+
+using namespace trpc;
+
+namespace trpc {
+// capi/rpc_capi.cc: the controller of an in-flight PendingCall handle.
+Controller* trpc_internal_pending_controller(void* call_handle);
+}  // namespace trpc
+
+extern "C" {
+
+// The kEDeadlineExpired status (2007) — Python maps it to the typed
+// DeadlineExpiredError (the lint error-code-sync rule pins the table).
+int trpc_deadline_expired_code() { return kEDeadlineExpired; }
+
+// Remaining budget of an in-flight call handle in µs: INT64_MAX when the
+// caller set no deadline, 0 when already past.  Valid only before the
+// handle's trpc_call_respond (like trpc_call_qos).
+int64_t trpc_call_remaining_us(void* call_handle) {
+  return trpc_internal_pending_controller(call_handle)->remaining_us();
+}
+
+// 1 when the call's cancel scope fired (client kCancel / dead
+// connection), else 0.  Same handle-validity contract as above.
+int trpc_call_cancelled(void* call_handle) {
+  Controller* cntl = trpc_internal_pending_controller(call_handle);
+  return cntl->IsCanceled() ? 1 : 0;
+}
+
+// Ambient budget for the CALLING pthread: remaining_us from now.  Sync
+// calls issued on this thread fold it into their stamped budget
+// (min(timeout, ambient)); 0/negative clears.
+void trpc_deadline_ambient_set(int64_t remaining_us) {
+  set_ambient_deadline(
+      remaining_us > 0 ? monotonic_time_us() + remaining_us : 0);
+}
+
+// Remaining ambient budget in µs (-1 = none set).
+int64_t trpc_deadline_ambient_remaining() {
+  const int64_t abs_us = ambient_deadline();
+  if (abs_us == 0) {
+    return -1;
+  }
+  const int64_t rem = abs_us - monotonic_time_us();
+  return rem > 0 ? rem : 0;
+}
+
+void trpc_deadline_ambient_clear() { set_ambient_deadline(0); }
+
+// Live cancel-scope registrations (tests: drains to 0 when idle).
+size_t trpc_cancel_registered() { return cancel_registered(); }
+
+// Registers the deadline flags/vars eagerly (so /flags?setvalue and the
+// observe plane see them before first traffic).
+void trpc_deadline_ensure_registered() { deadline_ensure_registered(); }
+
+}  // extern "C"

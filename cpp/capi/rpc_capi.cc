@@ -17,6 +17,7 @@
 #include "net/span.h"
 #include "net/channel.h"
 #include "net/cluster.h"
+#include "net/deadline.h"
 #include "net/naming.h"
 #include "net/controller.h"
 #include "net/fault.h"
@@ -151,6 +152,7 @@ void ensure_runtime_flags() {
   cluster_ensure_registered();     // trpc_cluster_* knobs
   Server::drain_ensure_registered();  // trpc_drain_deadline_ms
   naming_ensure_registered();      // trpc_naming_* knobs
+  deadline_ensure_registered();    // trpc_deadline_wire + retry budget
 }
 }  // namespace
 

@@ -516,6 +516,8 @@ FiberMeta* make_fiber_meta(void (*fn)(void*), void* arg, int tag) {
   // fiber's trace context or worker history.
   m->ambient_trace.store(0, std::memory_order_relaxed);
   m->ambient_span.store(0, std::memory_order_relaxed);
+  m->ambient_deadline.store(0, std::memory_order_relaxed);
+  m->ambient_cancel.store(nullptr, std::memory_order_relaxed);
   m->last_worker.store(-1, std::memory_order_relaxed);
   const uint32_t ver = m->version.load(std::memory_order_relaxed) + 1;  // odd
   m->done_event.value.store(ver, std::memory_order_relaxed);

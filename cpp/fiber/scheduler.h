@@ -57,6 +57,15 @@ struct FiberMeta {
   // queue handoff, cross-thread reads are diagnostic-only).
   std::atomic<uint64_t> ambient_trace{0};
   std::atomic<uint64_t> ambient_span{0};
+  // Ambient deadline plane (net/deadline.h): the absolute monotonic
+  // deadline (µs; 0 = none) and cancel scope of the request this fiber
+  // is serving.  Same storage rationale as ambient_trace; unlike the
+  // trace pair these are only ever read by the OWNING fiber, but they
+  // live here (not FLS) so the values follow the fiber across worker
+  // migration.  Relaxed: same-fiber accesses are program-ordered across
+  // migration by the scheduler's queue handoff.
+  std::atomic<int64_t> ambient_deadline{0};
+  std::atomic<void*> ambient_cancel{nullptr};
   // Last worker index this fiber ran on (-1 = never ran).  Written only
   // by the running worker; ready_to_run on a waker thread reads it to
   // tell first-ready from wake — atomic for that cross-thread read.

@@ -11,6 +11,7 @@
 #include "fiber/timer.h"
 #include "net/h2_client.h"
 #include "net/messenger.h"
+#include "net/deadline.h"
 #include "net/progressive.h"
 #include "net/protocol.h"
 #include "net/ici_transport.h"
@@ -142,9 +143,12 @@ namespace {
 
 int on_call_error(fid_t cid, void* data, int code) {
   Controller* cntl = static_cast<Controller*>(data);
-  cntl->SetFailed(code, code == ETIMEDOUT    ? "rpc timeout"
-                        : code == ECANCELED  ? "rpc canceled by caller"
-                                             : "rpc failed");
+  cntl->SetFailed(code,
+                  code == ETIMEDOUT   ? "rpc timeout"
+                  : code == ECANCELED ? "rpc canceled by caller"
+                  : code == kEDeadlineExpired
+                      ? "end-to-end deadline expired"
+                      : "rpc failed");
   complete_locked_call(cid, cntl);
   return 0;
 }
@@ -157,6 +161,19 @@ void timeout_fiber(void* arg) {
 // completion — fid locking and the user's done() — moves to a fiber.
 void timeout_cb(void* arg) {
   fiber_start(nullptr, timeout_fiber, arg, 0);
+}
+
+// Deadline-bound variant (net/deadline.h): when the AMBIENT end-to-end
+// budget is strictly tighter than the call's own timeout, its expiry is
+// budget exhaustion, not a per-hop timeout — surfaced as the typed
+// kEDeadlineExpired so retry layers stop the chain instead of re-burning
+// a budget that is equally dead everywhere.
+void deadline_fiber(void* arg) {
+  fid_error(reinterpret_cast<fid_t>(arg), kEDeadlineExpired);
+}
+
+void deadline_cb(void* arg) {
+  fiber_start(nullptr, deadline_fiber, arg, 0);
 }
 
 }  // namespace
@@ -477,6 +494,41 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
   // parity).
   CHECK(fid_lock(cid, nullptr) == 0);
 
+  // Deadline plane (net/deadline.h): the effective budget is
+  // min(caller/channel timeout, the ambient deadline of the request this
+  // fiber is serving) — a proxied call therefore re-stamps
+  // budget-minus-elapsed at every hop.  The serving request's cancel
+  // scope learns this call's id so a cascading cancel reaches it.
+  int64_t deadline_abs = 0;
+  bool ambient_bound = false;  // the ambient budget is the tight constraint
+  const int64_t eff_timeout_ms = cntl->timeout_ms_or(opts_.timeout_ms);
+  if (deadline_wire_enabled()) {
+    if (eff_timeout_ms > 0) {
+      deadline_abs = cntl->call().start_us + eff_timeout_ms * 1000;
+    }
+    const int64_t amb = ambient_deadline();
+    if (amb != 0 && (deadline_abs == 0 || amb < deadline_abs)) {
+      deadline_abs = amb;
+      ambient_bound = true;
+    }
+  }
+  CancelScope* parent_scope = ambient_cancel();
+  if (parent_scope != nullptr) {
+    parent_scope->add_call(cid);
+  }
+  if (deadline_abs != 0 && monotonic_time_us() >= deadline_abs) {
+    // Budget already exhausted: fail fast without touching the wire —
+    // dispatching a request nobody can wait for is exactly the wasted
+    // work the plane exists to shed.
+    deadline_vars().client_expired_total << 1;
+    fid_unlock(cid);
+    fid_error(cid, kEDeadlineExpired);
+    if (sync) {
+      fid_join(cid);
+    }
+    return;
+  }
+
   SocketId sid = 0;
   const auto ct = static_cast<ConnectionType>(conn_type_);
   if (proto_ != 0 &&
@@ -543,10 +595,17 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
   cntl->call().conn_type = static_cast<uint8_t>(ct);
   cntl->call().conn_auth = opts_.auth;
 
-  const int64_t eff_timeout_ms = cntl->timeout_ms_or(opts_.timeout_ms);
-  if (eff_timeout_ms > 0) {
+  // Local timer at the TIGHTER of the caller's timeout and the ambient
+  // deadline: an explicit-0 timeout still dies when the end-to-end
+  // budget does.
+  int64_t timer_at =
+      eff_timeout_ms > 0 ? cntl->call().start_us + eff_timeout_ms * 1000 : 0;
+  if (deadline_abs != 0 && (timer_at == 0 || deadline_abs < timer_at)) {
+    timer_at = deadline_abs;
+  }
+  if (timer_at > 0) {
     cntl->call().timeout_timer = TimerThread::instance()->schedule(
-        cntl->call().start_us + eff_timeout_ms * 1000, timeout_cb,
+        timer_at, ambient_bound ? deadline_cb : timeout_cb,
         reinterpret_cast<void*>(cid));
   }
 
@@ -609,6 +668,14 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
     meta.span_id = span->span_id;
     span_annotate(span, "request packed");
   }
+  if (deadline_abs != 0) {
+    // Wire stamp (tail-group 7): the REMAINING budget at send — never 0
+    // here (0 means unset); a budget that just hit zero stamps 1µs and
+    // sheds at the server instead.
+    const int64_t rem = deadline_abs - monotonic_time_us();
+    meta.deadline_us = static_cast<uint64_t>(rem > 0 ? rem : 1);
+    deadline_vars().stamped_total << 1;
+  }
   IOBuf body = request;  // zero-copy share
   if (cntl->request_compress_type() != 0) {
     const Compressor* c = find_compressor(
@@ -650,7 +717,10 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
   }
 
   bool write_ok;
-  const int rma_rc = rma_try_send(sid, &meta, &body, 0, 0);
+  // Long-transfer loops poll the token between chunks: a cancelled
+  // caller (or an expired budget) stops writing within one chunk.
+  const DeadlineToken dtok{parent_scope, deadline_abs};
+  const int rma_rc = rma_try_send(sid, &meta, &body, 0, 0, 0, dtok);
   if (rma_rc == 0) {
     // Body written one-sided into the peer's window; the control frame
     // is queued.  Nothing rides the stripe layer.
@@ -687,7 +757,7 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
       }
     }
     write_ok = stripe_send(sid, rails, std::move(meta), std::move(body),
-                           stripe_make_id()) == 0;
+                           stripe_make_id(), dtok) == 0;
     // Rails go straight back to the pool: their chunk frames are queued
     // FIFO on each socket, so a later borrower's frames follow ours.
     for (SocketId rid : extra) {
@@ -699,7 +769,16 @@ void Channel::CallMethod(const std::string& method, const IOBuf& request,
   }
   fid_unlock(cid);
   if (!write_ok) {
-    fid_error(cid, ECONNRESET);
+    // A send the DEADLINE TOKEN aborted mid-transfer is not a transport
+    // fault: surface the cancel/budget code so retry layers stop the
+    // chain and no healthy node gets quarantined for the caller's clock.
+    if (dtok.aborted()) {
+      fid_error(cid, parent_scope != nullptr && parent_scope->cancelled()
+                         ? ECANCELED
+                         : kEDeadlineExpired);
+    } else {
+      fid_error(cid, ECONNRESET);
+    }
   }
   if (sync) {
     fid_join(cid);

@@ -21,8 +21,10 @@
 #include "base/time.h"
 #include "fiber/fiber.h"
 #include "fiber/sync.h"
+#include "net/deadline.h"
 #include "net/fault.h"
 #include "net/naming.h"
+#include "stat/timeline.h"
 
 namespace trpc {
 
@@ -980,6 +982,9 @@ struct AsyncCall {
   // under the caller's (rpcz propagation, ISSUE 4).
   uint64_t amb_trace = 0;
   uint64_t amb_span = 0;
+  // Ambient deadline, same capture rationale (value-only: the caller's
+  // cancel scope may die before this detached fiber runs).
+  int64_t amb_deadline = 0;
 };
 }  // namespace
 
@@ -995,6 +1000,55 @@ void feed_latency(ServerNode& node, int64_t lat_us) {
                               std::memory_order_relaxed);
 }
 }  // namespace
+
+namespace {
+// One retry token in bucket units, and the bucket cap (100 banked
+// retries — the SRE convention: the budget bounds a STORM, it never
+// starves the occasional isolated retry).
+constexpr int64_t kRetryTokenCost = 100;
+constexpr int64_t kRetryTokenCap = 100 * kRetryTokenCost;
+}  // namespace
+
+void ClusterChannel::retry_budget_earn() {
+  const int64_t pct = cluster_retry_budget_pct();
+  if (pct <= 0) {
+    return;  // budget off
+  }
+  // Relaxed CAS loop: the bucket is advisory rate-limiting state — no
+  // data is published through it.
+  int64_t cur = retry_tokens_.load(std::memory_order_relaxed);
+  while (cur < kRetryTokenCap) {
+    const int64_t next = std::min(cur + pct, kRetryTokenCap);
+    if (retry_tokens_.compare_exchange_weak(cur, next,
+                                            std::memory_order_relaxed)) {
+      break;
+    }
+  }
+}
+
+bool ClusterChannel::retry_budget_take() {
+  if (cluster_retry_budget_pct() <= 0) {
+    return true;  // budget off: pre-budget retry semantics
+  }
+  // Relaxed: see retry_budget_earn.
+  int64_t cur = retry_tokens_.load(std::memory_order_relaxed);
+  while (cur >= kRetryTokenCost) {
+    if (retry_tokens_.compare_exchange_weak(cur, cur - kRetryTokenCost,
+                                            std::memory_order_relaxed)) {
+      return true;
+    }
+  }
+  return false;
+}
+
+void ClusterChannel::feed_cluster_latency(int64_t lat_us) {
+  if (lat_us <= 0) {
+    return;
+  }
+  // Relaxed: advisory smoothing state (hedge feasibility estimate).
+  const int64_t prev = lat_ewma_us_.load(std::memory_order_relaxed);
+  lat_ewma_us_.store(asym_ewma(prev, lat_us), std::memory_order_relaxed);
+}
 
 void ClusterChannel::feed_breaker(ServerNode& node, bool success) {
   if (success) {
@@ -1052,6 +1106,12 @@ struct HedgeCtx {
   // Caller's ambient trace context (attempt fibers have empty fls).
   uint64_t amb_trace = 0;
   uint64_t amb_span = 0;
+  // Caller's ambient deadline, re-installed in each attempt fiber so
+  // the wire stamp carries the caller's REMAINING budget, not a fresh
+  // full timeout.  Value-only: the caller's cancel scope is not
+  // propagated — a losing attempt may outlive the serving request, and
+  // the scope's lifetime is bounded by it (net/deadline.h).
+  int64_t amb_deadline = 0;
 
   bool settled() const {
     return winner.load(std::memory_order_acquire) >= 0 ||
@@ -1085,6 +1145,7 @@ void hedge_attempt_fiber(void* p) {
   // side by side under one parent in /rpcz (hedges are exactly the kind
   // of tail behavior a timeline exists to expose).
   set_ambient_trace(ctx->amb_trace, ctx->amb_span);
+  set_ambient_deadline(ctx->amb_deadline);
   ctx->channels[i]->CallMethod(ctx->method, ctx->request,
                                &ctx->responses[i], &ctx->cntls[i]);
   ctx->on_attempt_done(i);
@@ -1143,6 +1204,8 @@ void ClusterChannel::call_hedged(std::shared_ptr<Cluster> cluster,
   ctx->request = request;  // zero-copy share
   ctx->attachment = attachment;
   get_ambient_trace(&ctx->amb_trace, &ctx->amb_span);
+  ctx->amb_deadline = ambient_deadline();
+  retry_budget_earn();  // the primary attempt funds the bucket
 
   auto arm = [&](int slot, size_t node_idx) {
     ctx->channels[slot] = cluster->channels[node_idx];
@@ -1186,7 +1249,41 @@ void ClusterChannel::call_hedged(std::shared_ptr<Cluster> cluster,
         others.push_back(i);
       }
     }
-    if (!others.empty()) {
+    // Hedge governance (net/deadline.h): a backup is pure extra load
+    // when the remaining budget cannot cover a typical attempt (the
+    // cluster's observed smoothed latency), and it spends a retry
+    // token like any other extra attempt.
+    bool allow = !others.empty();
+    if (allow) {
+      // Relaxed: advisory estimate (see feed_cluster_latency).
+      const int64_t p50 = lat_ewma_us_.load(std::memory_order_relaxed);
+      int64_t remaining = INT64_MAX;
+      if (eff_timeout_ms > 0) {
+        remaining = now + eff_timeout_ms * 1000 - monotonic_time_us();
+      }
+      if (ctx->amb_deadline != 0) {
+        remaining = std::min(remaining,
+                             ctx->amb_deadline - monotonic_time_us());
+      }
+      if (p50 > 0 && remaining < p50) {
+        allow = false;
+        deadline_vars().hedge_suppressed << 1;
+        if (timeline::enabled()) {
+          timeline::record(
+              timeline::kDeadline, 0,
+              (timeline::kDeadlineHedgeSuppressed << 56) |
+                  static_cast<uint64_t>(remaining > 0 ? remaining : 0));
+        }
+      } else if (!retry_budget_take()) {
+        allow = false;
+        deadline_vars().hedge_suppressed << 1;
+        if (timeline::enabled()) {
+          timeline::record(timeline::kDeadline, 0,
+                           timeline::kDeadlineRetrySuppressed << 56);
+        }
+      }
+    }
+    if (allow) {
       ctx->launched.store(2, std::memory_order_release);
       arm(1, lb_->select(others, cluster->nodes, hash_key, 1));
     }
@@ -1205,15 +1302,19 @@ void ClusterChannel::call_hedged(std::shared_ptr<Cluster> cluster,
       continue;
     }
     if (ctx->cntls[i].Failed() &&
-        ctx->cntls[i].error_code() == kEDraining) {
-      // Graceful leave: the hedge already failed over; quarantining the
-      // endpoint would poison the successor reviving on it.
+        (ctx->cntls[i].error_code() == kEDraining ||
+         ctx->cntls[i].error_code() == kEDeadlineExpired ||
+         ctx->cntls[i].error_code() == ECANCELED)) {
+      // Graceful leave / expired budget / cancelled caller: the node is
+      // healthy either way — quarantining it would punish it for the
+      // caller's clock.
       continue;
     }
     feed_breaker(cluster->nodes[ctx->node_idx[i]], !ctx->cntls[i].Failed());
     if (!ctx->cntls[i].Failed()) {
       feed_latency(cluster->nodes[ctx->node_idx[i]],
                    ctx->cntls[i].latency_us());
+      feed_cluster_latency(ctx->cntls[i].latency_us());
     }
   }
   if (w < 0) {
@@ -1245,6 +1346,7 @@ void ClusterChannel::CallMethod(const std::string& method,
                                cntl,     {},     hash_key};
     call->done = std::move(done);
     get_ambient_trace(&call->amb_trace, &call->amb_span);
+    call->amb_deadline = ambient_deadline();
     if (fiber_start(
             nullptr,
             [](void* arg) {
@@ -1252,6 +1354,7 @@ void ClusterChannel::CallMethod(const std::string& method,
               // Fresh fiber, empty fls: re-install the caller's trace
               // context (cleared with the fiber's fls at exit).
               set_ambient_trace(c->amb_trace, c->amb_span);
+              set_ambient_deadline(c->amb_deadline);
               c->ch->CallMethod(c->method, c->request, c->response, c->cntl,
                                 nullptr, c->hash_key);
               c->done();
@@ -1291,8 +1394,20 @@ void ClusterChannel::CallMethod(const std::string& method,
   // default on every attempt.
   const int64_t eff_timeout_ms = cntl->timeout_ms_or(opts_.timeout_ms);
   const int attempts = 1 + opts_.max_retry;
+  retry_budget_earn();  // this primary call funds the bucket
   std::vector<size_t> tried;
   for (int attempt = 0; attempt < attempts; ++attempt) {
+    if (attempt > 0 && !retry_budget_take()) {
+      // Retry-storm governor (net/deadline.h): the budget bounds attempt
+      // amplification at ~(1 + pct/100)x under total downstream failure
+      // — every layer retrying independently is how outages multiply.
+      deadline_vars().retry_suppressed << 1;
+      if (timeline::enabled()) {
+        timeline::record(timeline::kDeadline, 0,
+                         timeline::kDeadlineRetrySuppressed << 56);
+      }
+      break;
+    }
     const int64_t now = monotonic_time_us();
     std::vector<size_t> healthy;
     for (size_t i = 0; i < cluster->nodes.size(); ++i) {
@@ -1335,10 +1450,19 @@ void ClusterChannel::CallMethod(const std::string& method,
     if (!cntl->Failed()) {
       feed_breaker(node, true);
       feed_latency(node, cntl->latency_us());
+      feed_cluster_latency(cntl->latency_us());
       if (done) {
         done();
       }
       return;
+    }
+    if (cntl->error_code() == kEDeadlineExpired ||
+        cntl->error_code() == ECANCELED) {
+      // The caller's budget is just as dead on every other node (and a
+      // cancelled caller wants nothing at all): retrying the chain is
+      // pure wasted work (net/deadline.h).  The breaker stays closed —
+      // the server is healthy, the clock ran out / the caller left.
+      break;
     }
     // kEDraining (Server::Drain, concurrency_limiter.h) is immediate-
     // failover-WITHOUT-quarantine: the node is healthy, just leaving —

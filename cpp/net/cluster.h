@@ -199,6 +199,13 @@ class ClusterChannel {
                    const IOBuf& request, IOBuf* response, Controller* cntl,
                    uint64_t hash_key);
   void feed_breaker(ServerNode& node, bool success);
+  // Retry-budget token bucket (net/deadline.h,
+  // trpc_cluster_retry_budget_pct): each primary attempt deposits pct
+  // hundredths of a token, each retry/hedge withdraws 100.  take()
+  // always succeeds with the budget off (pct 0).
+  void retry_budget_earn();
+  bool retry_budget_take();
+  void feed_cluster_latency(int64_t lat_us);
 
   std::unique_ptr<NamingService> ns_;
   std::string ns_param_;
@@ -224,6 +231,12 @@ class ClusterChannel {
   Event watch_wake_;
   Event watch_done_;
   std::atomic<bool> watcher_exited_{false};
+  // Retry-budget tokens in hundredths (capped: an idle cluster must not
+  // bank unlimited retries) and the cluster-wide smoothed success
+  // latency — the hedge-feasibility estimate: a hedge whose remaining
+  // budget cannot cover a typical attempt is suppressed as pure load.
+  std::atomic<int64_t> retry_tokens_{0};
+  std::atomic<int64_t> lat_ewma_us_{0};
 };
 
 }  // namespace trpc

@@ -1253,7 +1253,21 @@ int GroupChannel::run(const TransferSchedule& plan, const void* sendbuf,
   uint32_t steps_done = 0;
   for (size_t s = 0; s < plan.steps.size() && rc == 0; ++s) {
     const int64_t step_start = monotonic_time_us();
-    const int64_t deadline = step_start + opts_.timeout_ms * 1000;
+    int64_t deadline = step_start + opts_.timeout_ms * 1000;
+    // Deadline plane (net/deadline.h): the serving request's remaining
+    // budget bounds every step — an expired budget aborts the schedule
+    // whole-or-nothing through the same group-abort path a failed put
+    // takes, instead of grinding out steps nobody is waiting for.
+    const int64_t amb = ambient_deadline();
+    if (amb != 0) {
+      if (step_start >= amb) {
+        rc = kEDeadlineExpired;
+        fail(rc, "caller deadline expired before step " +
+                     std::to_string(s));
+        break;
+      }
+      deadline = std::min(deadline, amb);
+    }
     if ((rc = check_epoch()) != 0) {
       fail(rc, "membership epoch moved under the schedule");
       break;

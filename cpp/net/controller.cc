@@ -1,35 +1,65 @@
-// Controller out-of-line bits: cancellation.
+// Controller out-of-line bits: cancellation + the deadline plane.
 //
 // Parity: /root/reference/src/brpc/controller.h:717 `StartCancel()` and
 // :983 `StartCancel(CallId)` — the reference routes both through
 // bthread_id_error(ECANCELED); ours routes through the equivalent
 // versioned-fid error path (fiber/fid.h), which wakes sync joiners,
 // cancels the timeout timer and runs the async done exactly once via
-// complete_locked_call (net/channel.cc).
+// complete_locked_call (net/channel.cc).  Beyond the reference, a cancel
+// also ships a kCancel control frame to the server (net/deadline.h), so
+// downstream work the handler started is abandoned instead of running to
+// completion — the cascading half brpc never had.
 #include "net/controller.h"
 
 #include <errno.h>
 
+#include "base/time.h"
+#include "net/deadline.h"
 #include "net/socket.h"
 
 namespace trpc {
 
 void StartCancel(fid_t cid) {
-  if (cid != 0) {
-    // EINVAL (already completed / never existed) is the documented
-    // harmless case; fid versioning makes double-cancel safe too.
-    fid_error(cid, ECANCELED);
+  if (cid == 0) {
+    return;
   }
+  // Best-effort cascading cancel: while the call is still live, read its
+  // connection under the fid lock and queue the kCancel frame BEFORE the
+  // local error completes the call (completion may recycle pooled
+  // sockets).  A call that completed in the meantime skips the frame —
+  // and a frame racing the response on the server is a harmless registry
+  // miss.  h2 calls have their own stream-level cancel
+  // (complete_locked_call); only tstd connections speak kCancel.
+  void* data = nullptr;
+  if (fid_lock(cid, &data) == 0) {
+    auto* cntl = static_cast<Controller*>(data);
+    const uint64_t sid =
+        cntl->call().h2_stream == 0 ? cntl->call().socket_id : 0;
+    fid_unlock(cid);
+    if (sid != 0) {
+      send_cancel_frame(sid, cid);
+    }
+  }
+  // EINVAL (already completed / never existed) is the documented
+  // harmless case; fid versioning makes double-cancel safe too.
+  fid_error(cid, ECANCELED);
 }
 
 void Controller::StartCancel() { trpc::StartCancel(call_.cid); }
 
 bool Controller::IsCanceled() const {
+  if (call_.cancel_scope != nullptr && call_.cancel_scope->cancelled()) {
+    return true;  // explicit kCancel fan-out beat the socket poll
+  }
   if (call_.socket_id == 0) {
     return false;
   }
   SocketRef s(Socket::Address(call_.socket_id));
   return !s || s->Failed();
+}
+
+int64_t Controller::remaining_us() const {
+  return deadline_remaining_us(deadline_abs_us_);
 }
 
 }  // namespace trpc

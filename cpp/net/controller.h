@@ -23,6 +23,7 @@ using Closure = std::function<void()>;
 
 class ProgressiveAttachment;  // net/progressive.h
 class ProgressiveReader;
+class CancelScope;  // net/deadline.h
 
 class Controller {
  public:
@@ -96,6 +97,17 @@ class Controller {
   // no-op (stale version).  Never blocks on the network.
   fid_t call_id() const { return call_.cid; }
   void StartCancel();
+
+  // -- deadline plane (net/deadline.h) -----------------------------------
+  // Server side: the request's absolute monotonic deadline, anchored at
+  // arrival from the wire's remaining-budget stamp (0 = the caller set
+  // no deadline).  Handlers poll remaining_us() to right-size or
+  // abandon work; long transfer loops check it between chunks.
+  void set_deadline_abs_us(int64_t abs_us) { deadline_abs_us_ = abs_us; }
+  int64_t deadline_abs_us() const { return deadline_abs_us_; }
+  // Remaining budget in µs: INT64_MAX when no deadline, 0 when already
+  // past (never negative — callers compare against work estimates).
+  int64_t remaining_us() const;
   // Server side: has the client gone away (socket failed/closed)?  A long
   // handler polls this to abandon work nobody will receive
   // (controller.h:308 IsCanceled parity).
@@ -175,6 +187,12 @@ class Controller {
     uint64_t rma_resp_max = 0;
     uint64_t rma_resp_off = 0;
     std::vector<uint64_t> stripe_rails;
+    // Cancellation scope of a DISPATCHED server request (net/deadline.h):
+    // co-owned with the cancel registry so the response path (which may
+    // run rma_try_send long after the handler fiber exited) can still
+    // poll it between chunks.  Null on the client side and on requests
+    // shed before dispatch.
+    std::shared_ptr<CancelScope> cancel_scope;
   };
   CallState& call() { return call_; }
 
@@ -201,6 +219,7 @@ class Controller {
   bool checksum_ = false;
   bool done_inline_safe_ = false;
   bool qos_set_ = false;
+  int64_t deadline_abs_us_ = 0;
   uint8_t qos_priority_ = 0;
   std::string qos_tenant_;
   int64_t latency_us_ = 0;

@@ -1,0 +1,378 @@
+#include "net/deadline.h"
+
+#include <deque>
+#include <unordered_map>
+#include <unordered_set>
+
+#include "base/flags.h"
+#include "net/controller.h"
+#include "net/protocol.h"
+#include "net/socket.h"
+#include "fiber/scheduler.h"
+
+namespace trpc {
+
+// ---- CancelScope ---------------------------------------------------------
+
+void CancelScope::Cancel() {
+  // Release on the flag: a loop that observes cancelled() also observes
+  // everything the canceller wrote before triggering.  The exchange
+  // makes the fan-out exactly-once under racing triggers (kCancel frame
+  // vs. a poller).
+  if (cancelled_.exchange(true, std::memory_order_acq_rel)) {
+    return;
+  }
+  std::vector<fid_t> calls;
+  std::vector<std::function<void()>> hooks;
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    calls.swap(calls_);
+    hooks.swap(hooks_);
+  }
+  deadline_vars().cancel_fanout_total << 1;
+  for (fid_t cid : calls) {
+    StartCancel(cid);  // versioned fid: stale/completed calls no-op
+  }
+  for (auto& hook : hooks) {
+    hook();
+  }
+}
+
+bool CancelScope::triggered(int64_t now_us) const {
+  if (cancelled()) {
+    return true;
+  }
+  if (deadline_us != 0 &&
+      (now_us != 0 ? now_us : monotonic_time_us()) >= deadline_us) {
+    return true;
+  }
+  if (socket != 0) {
+    SocketRef s(Socket::Address(socket));
+    if (!s || s->Failed()) {
+      return true;  // the caller's connection died: nobody wants this work
+    }
+  }
+  return false;
+}
+
+void CancelScope::add_call(fid_t cid) {
+  if (cid == 0) {
+    return;
+  }
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!cancelled()) {
+      // Bound the record: a request issuing thousands of downstream
+      // calls keeps only the newest window — older ones have almost
+      // certainly completed, and a stale fid cancel is a no-op anyway.
+      if (calls_.size() >= 1024) {
+        calls_.erase(calls_.begin(), calls_.begin() + 512);
+      }
+      calls_.push_back(cid);
+      return;
+    }
+  }
+  StartCancel(cid);  // late registration after the trigger: cancel now
+}
+
+void CancelScope::add_hook(std::function<void()> hook) {
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!cancelled()) {
+      hooks_.push_back(std::move(hook));
+      return;
+    }
+  }
+  hook();
+}
+
+// ---- ambient propagation -------------------------------------------------
+
+namespace {
+
+// Off-fiber fallback (ctypes callers on Python pthreads, like the
+// ambient trace context in net/span.cc).
+thread_local int64_t tls_ambient_deadline = 0;
+thread_local CancelScope* tls_ambient_cancel = nullptr;
+
+}  // namespace
+
+void set_ambient_deadline(int64_t abs_us) {
+  Worker* w = tls_worker;
+  if (w != nullptr && w->current() != nullptr) {
+    // Relaxed: own-fiber context write (see scheduler.h ambient_deadline).
+    w->current()->ambient_deadline.store(abs_us, std::memory_order_relaxed);
+  } else {
+    tls_ambient_deadline = abs_us;
+  }
+}
+
+int64_t ambient_deadline() {
+  Worker* w = tls_worker;
+  if (w != nullptr && w->current() != nullptr) {
+    // Relaxed: own-fiber context read (see scheduler.h ambient_deadline).
+    return w->current()->ambient_deadline.load(std::memory_order_relaxed);
+  }
+  return tls_ambient_deadline;
+}
+
+void set_ambient_cancel(CancelScope* scope) {
+  Worker* w = tls_worker;
+  if (w != nullptr && w->current() != nullptr) {
+    // Relaxed: own-fiber context write (see scheduler.h ambient_cancel).
+    w->current()->ambient_cancel.store(scope, std::memory_order_relaxed);
+  } else {
+    tls_ambient_cancel = scope;
+  }
+}
+
+CancelScope* ambient_cancel() {
+  Worker* w = tls_worker;
+  if (w != nullptr && w->current() != nullptr) {
+    // Relaxed: own-fiber context read (see scheduler.h ambient_cancel).
+    return static_cast<CancelScope*>(
+        w->current()->ambient_cancel.load(std::memory_order_relaxed));
+  }
+  return tls_ambient_cancel;
+}
+
+// ---- registry ------------------------------------------------------------
+
+namespace {
+
+// Sharded by (socket, cid) so the per-request register/unregister pair
+// never funnels the whole server through one mutex.  Leaked statics:
+// runtime registries outlive static destruction order.
+constexpr size_t kCancelShards = 16;
+
+struct CancelKey {
+  uint64_t socket;
+  uint64_t cid;
+  bool operator==(const CancelKey& o) const {
+    return socket == o.socket && cid == o.cid;
+  }
+};
+
+struct CancelKeyHash {
+  size_t operator()(const CancelKey& k) const {
+    // splitmix-style fold: sockets are dense ids, cids dense counters —
+    // xor alone would collide systematically.
+    uint64_t x = k.socket * 0x9e3779b97f4a7c15ull ^ k.cid;
+    x ^= x >> 30;
+    x *= 0xbf58476d1ce4e5b9ull;
+    x ^= x >> 27;
+    return static_cast<size_t>(x);
+  }
+};
+
+struct CancelShard {
+  std::mutex mu;
+  std::unordered_map<CancelKey, std::shared_ptr<CancelScope>, CancelKeyHash>
+      map;
+  // Cancels that arrived BEFORE their request dispatched (still queued
+  // in a QoS lane / dispatch backlog): cancel_register consumes the
+  // tombstone and sheds the request.  FIFO-capped — an evicted
+  // tombstone degrades to the old execute-anyway behavior, never leaks.
+  std::unordered_set<CancelKey, CancelKeyHash> tombs;
+  std::deque<CancelKey> tomb_order;
+};
+
+constexpr size_t kTombCapPerShard = 512;
+
+CancelShard* cancel_shards() {
+  static CancelShard* s = new CancelShard[kCancelShards];
+  return s;
+}
+
+CancelShard& shard_for(uint64_t socket, uint64_t cid) {
+  return cancel_shards()[CancelKeyHash{}({socket, cid}) % kCancelShards];
+}
+
+}  // namespace
+
+bool cancel_register(uint64_t socket, uint64_t cid,
+                     std::shared_ptr<CancelScope> scope) {
+  CancelShard& sh = shard_for(socket, cid);
+  const CancelKey key{socket, cid};
+  std::lock_guard<std::mutex> g(sh.mu);
+  auto tomb = sh.tombs.find(key);
+  if (tomb != sh.tombs.end()) {
+    // The cancel raced ahead of dispatch: consume the tombstone, shed.
+    sh.tombs.erase(tomb);
+    for (auto it = sh.tomb_order.begin(); it != sh.tomb_order.end(); ++it) {
+      if (*it == key) {
+        sh.tomb_order.erase(it);
+        break;
+      }
+    }
+    return false;
+  }
+  sh.map[key] = std::move(scope);
+  return true;
+}
+
+void cancel_unregister(uint64_t socket, uint64_t cid) {
+  CancelShard& sh = shard_for(socket, cid);
+  std::lock_guard<std::mutex> g(sh.mu);
+  sh.map.erase(CancelKey{socket, cid});
+}
+
+bool cancel_fire(uint64_t socket, uint64_t cid) {
+  std::shared_ptr<CancelScope> scope;
+  {
+    CancelShard& sh = shard_for(socket, cid);
+    const CancelKey key{socket, cid};
+    std::lock_guard<std::mutex> g(sh.mu);
+    auto it = sh.map.find(key);
+    if (it == sh.map.end()) {
+      // Not dispatched (yet): leave a tombstone so a still-queued
+      // request sheds at registration.  Already-completed calls never
+      // see it (versioned cids are not reused) — it just ages out.
+      if (sh.tombs.insert(key).second) {
+        sh.tomb_order.push_back(key);
+        if (sh.tomb_order.size() > kTombCapPerShard) {
+          sh.tombs.erase(sh.tomb_order.front());
+          sh.tomb_order.pop_front();
+        }
+      }
+      return false;
+    }
+    scope = it->second;
+  }
+  // Fan-out OUTSIDE the shard mutex: StartCancel may complete a call
+  // inline, and that completion path must never need this shard.
+  scope->Cancel();
+  return true;
+}
+
+size_t cancel_registered() {
+  size_t n = 0;
+  for (size_t i = 0; i < kCancelShards; ++i) {
+    std::lock_guard<std::mutex> g(cancel_shards()[i].mu);
+    n += cancel_shards()[i].map.size();
+  }
+  return n;
+}
+
+void send_cancel_frame(uint64_t sid, uint64_t cid) {
+  if (sid == 0 || cid == 0) {
+    return;
+  }
+  SocketRef s(Socket::Address(sid));
+  if (!s || s->Failed()) {
+    return;  // connection already gone: its death cancels server-side
+  }
+  RpcMeta meta;
+  meta.type = RpcMeta::kCancel;
+  meta.correlation_id = cid;
+  IOBuf frame;
+  tstd_pack(&frame, meta, IOBuf());
+  s->Write(std::move(frame));
+}
+
+// ---- flags ---------------------------------------------------------------
+
+namespace {
+
+Flag* wire_flag() {
+  static Flag* f = [] {
+    Flag* flag = Flag::define_bool(
+        "trpc_deadline_wire", true,
+        "stamp meta tail-group 7 (remaining deadline budget, µs) on "
+        "outbound tstd requests from min(Controller timeout, ambient "
+        "deadline); off = byte-identical pre-deadline-plane frames and "
+        "no server-side budget enforcement");
+    if (flag != nullptr) {
+      flag->set_validator([](const std::string& v) {
+        return v == "true" || v == "false" || v == "1" || v == "0" ||
+               v == "on" || v == "off";
+      });
+    }
+    return flag;
+  }();
+  return f;
+}
+
+Flag* retry_budget_flag() {
+  static Flag* f = [] {
+    Flag* flag = Flag::define_int64(
+        "trpc_cluster_retry_budget_pct", 0,
+        "cluster retry token bucket: each primary attempt earns pct/100 "
+        "of a retry token, each retry or hedge spends one ([0, 100]; "
+        "0 = unlimited, the pre-budget behavior; ~10 bounds retry-storm "
+        "amplification to ~1.1x under total downstream failure)");
+    if (flag != nullptr) {
+      flag->set_int_range(0, 100);
+    }
+    return flag;
+  }();
+  return f;
+}
+
+// Eager definitions so /flags?setvalue (and tests) can set them before
+// first traffic.
+[[maybe_unused]] Flag* const g_wire_flag_eager = wire_flag();
+[[maybe_unused]] Flag* const g_retry_budget_flag_eager = retry_budget_flag();
+
+}  // namespace
+
+bool deadline_wire_enabled() { return wire_flag()->bool_value(); }
+
+int64_t cluster_retry_budget_pct() {
+  return retry_budget_flag()->int64_value();
+}
+
+void deadline_ensure_registered() {
+  wire_flag();
+  retry_budget_flag();
+  deadline_vars();
+}
+
+// ---- vars ----------------------------------------------------------------
+
+DeadlineVars::DeadlineVars() {
+  shed_total.expose(
+      "deadline_expired_shed_total",
+      "requests shed before handler dispatch because their propagated "
+      "budget (meta tail-group 7) had already expired on arrival or "
+      "while queued (kEDeadlineExpired)");
+  stamped_total.expose(
+      "deadline_stamped_total",
+      "outbound requests that carried a remaining-budget stamp in meta "
+      "tail-group 7");
+  client_expired_total.expose(
+      "deadline_client_expired_total",
+      "calls failed locally (kEDeadlineExpired) because the ambient "
+      "budget was exhausted before the request could be sent");
+  cancel_fanout_total.expose(
+      "deadline_cancel_fanout_total",
+      "cancel scopes triggered (client kCancel frame, dead connection, "
+      "or expired budget) that fanned out to downstream calls and "
+      "in-flight transfers");
+  tombstone_shed.expose(
+      "deadline_cancel_tombstone_shed_total",
+      "requests shed at dispatch because their kCancel control frame "
+      "raced ahead of them (cancelled while still queued in a QoS lane "
+      "or dispatch backlog)");
+  cancel_saved_bytes.expose(
+      "deadline_cancel_saved_bytes",
+      "payload bytes NOT written by one-sided/striped transfer loops "
+      "because the request was cancelled or its budget expired "
+      "mid-transfer (wasted work avoided by cascading cancellation)");
+  retry_suppressed.expose(
+      "cluster_retry_suppressed_total",
+      "cluster retries suppressed by the trpc_cluster_retry_budget_pct "
+      "token bucket (retry-storm governor)");
+  hedge_suppressed.expose(
+      "cluster_hedge_suppressed_total",
+      "cluster hedges suppressed because the retry budget was empty or "
+      "the remaining deadline could not cover a fresh attempt "
+      "(observed p50)");
+}
+
+DeadlineVars& deadline_vars() {
+  static DeadlineVars* v = new DeadlineVars();
+  return *v;
+}
+
+}  // namespace trpc

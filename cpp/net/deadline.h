@@ -1,0 +1,209 @@
+// Deadline & cancellation plane — end-to-end budgets, cascading cancel,
+// and the cluster retry budget's shared state.
+//
+// No direct brpc parity: the reference propagates nothing past the first
+// hop (a brpc server never learns the caller's remaining budget, and a
+// dead caller leaves downstream work running to completion).  This plane
+// closes that gap three ways:
+//
+//  1. WIRE — meta tail-group 7 `(deadline)` carries the caller's
+//     *remaining* budget in µs (relative, so clock skew between hosts is
+//     irrelevant; zero bytes on the wire when unset).  Channels stamp it
+//     from min(Controller::timeout_ms, ambient deadline) at send; a
+//     proxied call therefore re-stamps budget-minus-elapsed at every hop
+//     automatically, exactly like the rpcz trace context rides ambient
+//     fiber state (net/span.h).
+//
+//  2. SERVER ENFORCEMENT — the parse path stamps the request's arrival
+//     time; requests whose budget expired while in flight or queued in a
+//     QoS lane are shed BEFORE handler dispatch with the distinct
+//     kEDeadlineExpired status (the cluster client stops the attempt
+//     chain on it: retrying a dead budget is pure waste).  Handlers read
+//     Controller::remaining_us(), and long-running transfer loops
+//     (stripe rails, one-sided RMA chunk writers, collective steps)
+//     check a DeadlineToken between chunks and abort whole-or-nothing
+//     through the existing fault semantics.
+//
+//  3. CASCADING CANCELLATION — every dispatched request owns a
+//     CancelScope registered under (connection, correlation id).  A
+//     kCancel control frame (client StartCancel), or the scope's
+//     triggered() poll observing a dead connection / expired budget,
+//     fans the cancel out to every downstream call the handler issued
+//     (registered via the ambient scope) and aborts in-flight one-sided
+//     transfers between chunks — a dead caller's work stops within one
+//     chunk budget instead of running to completion.
+//
+// The retry budget itself (SRE-style token bucket, ~10% of primary
+// traffic) lives in net/cluster.cc; this header owns its flag +
+// counters so the whole deadline plane's observability sits in one
+// place.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <vector>
+
+#include "base/time.h"
+#include "fiber/fid.h"
+#include "stat/reducer.h"
+
+namespace trpc {
+
+// Continues the 2004..2006 (kELimit/kEOverloaded/kEDraining) family in
+// concurrency_limiter.h.  kEDeadlineExpired: the caller's propagated
+// budget ran out before (or while) this node could do the work.  The
+// cluster client treats it as NON-RETRIABLE for the same attempt chain
+// — the budget is just as dead on every other node — and Python
+// surfaces it as the typed DeadlineExpiredError.
+constexpr int kEDeadlineExpired = 2007;
+
+// Per-request cancellation scope (server side).  Owned by shared_ptr:
+// the registry, the request's Controller and the dispatch fiber co-own
+// it, so a cancel frame racing request completion can never touch a
+// freed scope.  Downstream calls registered here are cancelled via the
+// versioned-fid error path, so a stale registration (call already
+// completed) is a harmless no-op and completion never needs to
+// unregister.
+class CancelScope {
+ public:
+  // Idempotent trigger: fans StartCancel out to every registered
+  // downstream call and runs the abort hooks exactly once.
+  void Cancel();
+  bool cancelled() const {
+    // Acquire: pairs with Cancel()'s release store so a loop observing
+    // the flag also observes any state the canceller wrote before it.
+    return cancelled_.load(std::memory_order_acquire);
+  }
+  // Full trigger poll for long-running loops: cancelled, the request's
+  // connection died, or the request's budget expired.  `now_us` 0 reads
+  // the clock.
+  bool triggered(int64_t now_us = 0) const;
+
+  // Registers an in-flight downstream call / an abort hook (hooks abort
+  // non-call work: RMA sessions, collective schedules).  Registration
+  // after Cancel() fires immediately — a handler that keeps issuing
+  // downstream work after its caller died has that work cancelled too.
+  void add_call(fid_t cid);
+  void add_hook(std::function<void()> hook);
+
+  // Bound state, written once at registration (before the scope is
+  // published to the registry).
+  uint64_t socket = 0;        // request connection; its death = cancel
+  int64_t deadline_us = 0;    // absolute monotonic; 0 = none
+
+ private:
+  std::atomic<bool> cancelled_{false};
+  std::mutex mu_;
+  std::vector<fid_t> calls_;
+  std::vector<std::function<void()>> hooks_;
+};
+
+// ---- ambient propagation (like the rpcz trace context) -------------------
+
+// Absolute monotonic deadline of the request the current fiber (or, off
+// fiber, the current pthread) is serving; 0 = none.  Channels fold it
+// into every outbound call's stamped budget, so the budget decrements
+// by elapsed time at every hop without anyone passing it explicitly.
+void set_ambient_deadline(int64_t abs_us);  // 0 clears
+int64_t ambient_deadline();
+
+// The serving request's cancel scope.  Raw pointer by design: it is
+// only ever read synchronously inside the handler extent, where the
+// dispatch fiber's shared_ptr keeps the scope alive (same lifetime
+// argument as the ambient span).  Cleared by the dispatch fiber's guard
+// on every exit path.
+void set_ambient_cancel(CancelScope* scope);  // nullptr clears
+CancelScope* ambient_cancel();
+
+// Remaining budget of an absolute deadline (INT64_MAX when abs_us == 0,
+// 0 when already past).
+inline int64_t deadline_remaining_us(int64_t abs_us) {
+  if (abs_us == 0) {
+    return INT64_MAX;
+  }
+  const int64_t rem = abs_us - monotonic_time_us();
+  return rem > 0 ? rem : 0;
+}
+
+// Abort predicate checked between chunks by the long-running transfer
+// loops (rma rails, stripe sender, collective steps).  Both fields are
+// borrowed: the scope must outlive the loop (the caller holds the
+// owning shared_ptr across it).
+struct DeadlineToken {
+  const CancelScope* scope = nullptr;
+  int64_t deadline_us = 0;  // absolute monotonic; 0 = none
+  bool aborted(int64_t now_us = 0) const {
+    if (scope != nullptr && scope->triggered(now_us)) {
+      return true;
+    }
+    if (deadline_us != 0) {
+      return (now_us != 0 ? now_us : monotonic_time_us()) >= deadline_us;
+    }
+    return false;
+  }
+};
+
+// ---- (connection, correlation id) → scope registry -----------------------
+
+// Sharded registry the kCancel control frame resolves through.  One
+// entry per DISPATCHED request (shed/early-error requests never own
+// work worth cancelling); unregistered by the response path.
+//
+// Returns false when a cancel TOMBSTONE for (socket, cid) was pending:
+// the kCancel frame raced ahead of dispatch (request still queued in a
+// QoS lane / dispatch backlog when it arrived) — the caller must shed
+// the request as cancelled instead of executing work nobody wants.
+// The scope is NOT registered in that case.
+bool cancel_register(uint64_t socket, uint64_t cid,
+                     std::shared_ptr<CancelScope> scope);
+void cancel_unregister(uint64_t socket, uint64_t cid);
+// Fires the scope registered under (socket, cid), if any.  Returns true
+// when one was found (counted by deadline_cancel_fanout_total).  A miss
+// leaves a bounded TOMBSTONE instead: the request may still be queued
+// (QoS lane, dispatch backlog) — when it finally reaches
+// cancel_register, it is shed as cancelled.  Versioned correlation ids
+// make a tombstone for an already-completed call harmless (the id is
+// never reused), and the per-shard cap bounds the memory.
+bool cancel_fire(uint64_t socket, uint64_t cid);
+// Live registrations (tests: must drain to 0 with no traffic in flight).
+size_t cancel_registered();
+
+// Queues a kCancel control frame for `cid` on `sid` (fire-and-forget;
+// no-op when the socket is gone).  Shared by Controller::StartCancel and
+// the free StartCancel(fid_t).
+void send_cancel_frame(uint64_t sid, uint64_t cid);
+
+// ---- flags ---------------------------------------------------------------
+
+// trpc_deadline_wire (default true): stamp tail-group 7 from the
+// effective timeout / ambient budget.  Off = byte-identical pre-plane
+// frames (the byte-identity guard's lever).
+bool deadline_wire_enabled();
+// trpc_cluster_retry_budget_pct (default 0 = unlimited): SRE-style
+// retry token bucket — each primary attempt earns pct/100 of a retry
+// token, each retry or hedge spends one.  ~10 is the recommended
+// production value; the default keeps existing retry semantics intact.
+int64_t cluster_retry_budget_pct();
+// Idempotent flag/var registration (the capi calls it so /flags sees
+// the knobs before first traffic).
+void deadline_ensure_registered();
+
+// ---- vars ----------------------------------------------------------------
+
+struct DeadlineVars {
+  Adder shed_total;            // deadline_expired_shed_total
+  Adder stamped_total;         // deadline_stamped_total
+  Adder client_expired_total;  // deadline_client_expired_total
+  Adder cancel_fanout_total;   // deadline_cancel_fanout_total
+  Adder cancel_saved_bytes;    // deadline_cancel_saved_bytes
+  Adder tombstone_shed;        // deadline_cancel_tombstone_shed_total
+  Adder retry_suppressed;      // cluster_retry_suppressed_total
+  Adder hedge_suppressed;      // cluster_hedge_suppressed_total
+  DeadlineVars();
+};
+DeadlineVars& deadline_vars();
+
+}  // namespace trpc

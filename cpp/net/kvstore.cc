@@ -522,6 +522,17 @@ int kv_attach_store(Server* s) {
           done();
           return;
         }
+        if (cntl->remaining_us() == 0) {
+          // The puller's budget died between dispatch and here (the
+          // pre-dispatch shed catches arrival-expired requests; this
+          // catches a budget that expired while other fetches queued
+          // ahead): never pin megabytes of block pages for a response
+          // the decode side has already abandoned.
+          cntl->SetFailed(kEDeadlineExpired,
+                          "deadline expired before block fetch");
+          done();
+          return;
+        }
         const int rc = kv_store().fetch(w.block_id, w.generation, resp);
         if (rc != 0) {
           fail_kv(cntl, rc, "fetch");

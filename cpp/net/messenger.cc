@@ -86,6 +86,7 @@ void free_input_message(InputMessage* m) {
     m->ctx.reset();
     m->meta.reset();
     m->socket = 0;
+    m->arrival_us = 0;
     cache->push_back(m);
     return;
   }

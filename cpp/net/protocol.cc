@@ -1,6 +1,7 @@
 #include "net/protocol.h"
 
 #include "base/compress.h"
+#include "base/time.h"
 
 #include <cstring>
 #include <mutex>
@@ -71,8 +72,11 @@ std::string encode_meta(const RpcMeta& m) {
   // remain), so presence/absence are both wire-compatible — and the
   // streaming hot path never pays for it.  Layout: trace(24B), then
   // compress+checksum(6B), then batch streams(4B+), then stripe(24B),
-  // then qos(3B+); each later group implies every earlier one.
-  const bool has_rma = m.rma_rkey != 0 || m.rma_resp_rkey != 0;
+  // then qos(3B+), then rma(52B), then deadline(8B); each later group
+  // implies every earlier one.
+  const bool has_deadline = m.deadline_us != 0;
+  const bool has_rma =
+      m.rma_rkey != 0 || m.rma_resp_rkey != 0 || has_deadline;
   const bool has_qos =
       m.qos_priority != 0 || !m.qos_tenant.empty() || has_rma;
   const bool has_stripe = m.stripe_id != 0 || has_qos;
@@ -123,6 +127,11 @@ std::string encode_meta(const RpcMeta& m) {
               put_u64(&s, m.rma_resp_rkey);
               put_u64(&s, m.rma_resp_max);
               put_u64(&s, m.rma_resp_off);
+              if (has_deadline) {
+                // tail-group 7 (deadline): remaining budget µs, 8B
+                // (net/deadline.h).
+                put_u64(&s, m.deadline_us);
+              }
             }
           }
         }
@@ -214,6 +223,10 @@ bool decode_meta(const std::string& s, RpcMeta* m) {
               if (end - p >= 52) {
                 m->rma_resp_off = get_u64(p + 44);
                 p += 52;
+                if (end - p >= 8) {  // tail-group 7 (deadline)
+                  m->deadline_us = get_u64(p);
+                  p += 8;
+                }
               } else {
                 // Previous-version frame (44B group, pre-rma_resp_off):
                 // the descriptor is intact, the landing offset defaults
@@ -270,6 +283,12 @@ ParseError tstd_parse(IOBuf* source, InputMessage* out, Socket* sock) {
   }
   if (!decode_meta(meta_bytes, &out->meta)) {
     return ParseError::kCorrupted;
+  }
+  if (out->meta.deadline_us != 0) {
+    // Anchor the relative budget to OUR clock at cut time: queueing
+    // (QoS lanes, dispatch backlog) then counts against it.  Unstamped
+    // traffic skips the clock read.
+    out->arrival_us = monotonic_time_us();
   }
   source->cutn(&out->payload, payload_len);
   if (out->meta.has_checksum &&

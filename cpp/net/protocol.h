@@ -41,6 +41,12 @@ struct RpcMeta {
     // reassembly buffer.  May arrive on ANY connection between the two
     // processes (multi-rail), in any order.
     kStripe = 4,
+    // Cascading-cancel control frame (net/deadline.h): correlation_id
+    // names the in-flight REQUEST to cancel on the receiving server —
+    // its cancel scope fans out to every downstream call and transfer
+    // the handler started.  Empty payload; never answered (the caller
+    // already gave up on the call).
+    kCancel = 5,
   };
   // Stream flags (parity: streaming_rpc_meta.proto frame types).
   enum StreamFlags : uint8_t {
@@ -111,6 +117,12 @@ struct RpcMeta {
   uint64_t rma_resp_rkey = 0;
   uint64_t rma_resp_max = 0;
   uint64_t rma_resp_off = 0;
+  // End-to-end deadline (net/deadline.h): the caller's REMAINING budget
+  // in µs at send time (relative, so clock skew between hosts never
+  // corrupts it; the receiver anchors it to its own arrival clock).
+  // Seventh optional wire-tail group — zero (absent) when the caller
+  // has no deadline, so unset traffic stays byte-identical.
+  uint64_t deadline_us = 0;
   std::string method;
   std::string error_text;
 
@@ -143,6 +155,7 @@ struct RpcMeta {
     rma_resp_rkey = 0;
     rma_resp_max = 0;
     rma_resp_off = 0;
+    deadline_us = 0;
     method.clear();
     error_text.clear();
   }
@@ -152,6 +165,11 @@ struct InputMessage {
   RpcMeta meta;
   IOBuf payload;  // body (+ attachment tail per meta.attachment_size)
   SocketId socket = 0;
+  // Arrival clock of a deadline-stamped request, read at parse (cut)
+  // time: the server's absolute deadline is arrival_us + deadline_us,
+  // so time spent queued in a QoS lane counts against the budget.  0 on
+  // unstamped traffic — the hot path never reads the clock for it.
+  int64_t arrival_us = 0;
   // Protocol-private context (the reference subclasses InputMessageBase per
   // protocol; an opaque pointer is the condensed seam).  HTTP stores its
   // parsed HttpRequest here.

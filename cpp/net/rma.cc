@@ -565,6 +565,13 @@ struct RailJob {
   bool crc = false;
   EndPoint peer;
   std::atomic<uint32_t>* remaining = nullptr;
+  // Deadline plane (net/deadline.h): polled between chunks; a triggered
+  // token stops this rail (skipped bytes counted into *aborted — the
+  // cancel_saved_bytes accounting and the caller's no-control-frame
+  // decision).  The token's scope is kept alive by the rma_try_send
+  // caller across put_body's bounded join.
+  DeadlineToken tok;
+  std::atomic<uint64_t>* aborted = nullptr;
 };
 
 // Writes one rail's chunk range: memcpy into the peer region, then a
@@ -577,6 +584,13 @@ void rail_run(RailJob* j) {
   uint32_t ci = j->first_chunk;
   uint64_t off = static_cast<uint64_t>(ci) * j->chunk;
   while (!j->data.empty()) {
+    if (j->aborted != nullptr && j->tok.aborted()) {
+      // Cascading cancel / expired budget: stop within one chunk.  The
+      // remaining chunks' bits stay clear, so the receiver (if the
+      // control frame raced out at all) drops the transfer whole.
+      j->aborted->fetch_add(j->data.size(), std::memory_order_acq_rel);
+      break;
+    }
     IOBuf piece;
     j->data.cutn(&piece, j->chunk);
     const uint64_t n = piece.size();
@@ -651,8 +665,10 @@ void rail_fiber(void* arg) {
 // Cuts body into rail ranges and writes them concurrently; returns when
 // every rail finished.  payload_dst points at the transfer's payload
 // base in the peer region.
-void put_body(RmaXfer* x, char* payload_dst, IOBuf&& body, uint64_t chunk,
-              int rails, uint64_t cid, bool crc, const EndPoint& peer) {
+// Returns the bytes SKIPPED by a mid-transfer cancel (0 = fully put).
+uint64_t put_body(RmaXfer* x, char* payload_dst, IOBuf&& body,
+                  uint64_t chunk, int rails, uint64_t cid, bool crc,
+                  const EndPoint& peer, const DeadlineToken& tok) {
   const uint64_t total = body.size();
   const uint32_t nchunks =
       static_cast<uint32_t>((total + chunk - 1) / chunk);
@@ -665,6 +681,7 @@ void put_body(RmaXfer* x, char* payload_dst, IOBuf&& body, uint64_t chunk,
   // REAL rails, or it would wait forever on lanes that never ran).
   const uint32_t r = (nchunks + per - 1) / per;
   std::atomic<uint32_t> remaining{r};
+  std::atomic<uint64_t> aborted_bytes{0};
   RailJob* inline_job = nullptr;
   for (uint32_t i = 0; i < r; ++i) {
     auto* j = new RailJob();
@@ -678,6 +695,8 @@ void put_body(RmaXfer* x, char* payload_dst, IOBuf&& body, uint64_t chunk,
     j->crc = crc;
     j->peer = peer;
     j->remaining = &remaining;
+    j->tok = tok;
+    j->aborted = &aborted_bytes;
     const uint64_t rail_bytes =
         std::min<uint64_t>(static_cast<uint64_t>(per) * chunk, body.size());
     body.cutn(&j->data, rail_bytes);
@@ -706,6 +725,8 @@ void put_body(RmaXfer* x, char* payload_dst, IOBuf&& body, uint64_t chunk,
       usleep(20);
     }
   }
+  // Acquire pairs with the rails' abort accounting above.
+  return aborted_bytes.load(std::memory_order_acquire);
 }
 
 // Queues the zero-payload control frame.  0 on success.
@@ -1149,7 +1170,7 @@ void rma_advertise_response(SocketId sid, uint64_t cid, RpcMeta* meta) {
 
 int rma_try_send(SocketId primary, RpcMeta* meta, IOBuf* body,
                  uint64_t target_rkey, uint64_t target_max,
-                 uint64_t target_off) {
+                 uint64_t target_off, const DeadlineToken& tok) {
   const uint64_t total = body->size();
   if (meta->stream_id != 0 || !stripe_eligible(total)) {
     return 1;
@@ -1188,8 +1209,17 @@ int rma_try_send(SocketId primary, RpcMeta* meta, IOBuf* body,
         xfer_init(&h->direct, total, chunk, crc, cid);
         const uint32_t nchunks =
             static_cast<uint32_t>((total + chunk - 1) / chunk);
-        put_body(&h->direct, m->base + kRmaDataOffset + target_off,
-                 std::move(*body), chunk, rails, cid, crc, peer);
+        const uint64_t skipped =
+            put_body(&h->direct, m->base + kRmaDataOffset + target_off,
+                     std::move(*body), chunk, rails, cid, crc, peer, tok);
+        if (skipped != 0) {
+          // Cancelled mid-transfer: no control frame — the receiver
+          // never admits the partial put; the caller's fid is already
+          // dying (the cancel reached it first).
+          deadline_vars().cancel_saved_bytes
+              << static_cast<int64_t>(skipped);
+          return -1;
+        }
         meta->rma_rkey = target_rkey;
         meta->rma_off = kRmaDirectOff;
         meta->rma_len = total;
@@ -1228,8 +1258,16 @@ int rma_try_send(SocketId primary, RpcMeta* meta, IOBuf* body,
   xfer_init(x, total, chunk, crc, cid);
   const uint32_t nchunks =
       static_cast<uint32_t>((total + chunk - 1) / chunk);
-  put_body(x, reinterpret_cast<char*>(x) + kRmaSpanHdr, std::move(*body),
-           chunk, rails, cid, crc, peer);
+  const uint64_t skipped =
+      put_body(x, reinterpret_cast<char*>(x) + kRmaSpanHdr,
+               std::move(*body), chunk, rails, cid, crc, peer, tok);
+  if (skipped != 0) {
+    // Cancelled mid-transfer: reclaim the span now (no control frame
+    // will ever admit it) and fail the call whole.
+    deadline_vars().cancel_saved_bytes << static_cast<int64_t>(skipped);
+    span_free(h, wg, off, need);
+    return -1;
+  }
   meta->rma_rkey = peer_rkey;
   meta->rma_off = off;
   meta->rma_len = total;

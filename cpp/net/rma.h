@@ -47,6 +47,7 @@
 #include <mutex>
 
 #include "base/iobuf.h"
+#include "net/deadline.h"
 #include "net/protocol.h"
 
 namespace trpc {
@@ -174,9 +175,16 @@ void rma_advertise_response(SocketId sid, uint64_t cid, RpcMeta* meta);
 // direct-to-region when the body fits target_max — written target_off
 // bytes into the region's data area; otherwise the connection window
 // is used.
+// tok (net/deadline.h): the rail writers poll it between chunks — a
+// cancelled request / expired budget stops the transfer within one
+// chunk (remaining chunks never written, their bits never set, the
+// control frame never sent, so the receiver's whole-or-nothing admit
+// drops nothing partial; an abandoned window span is reclaimed by the
+// scavenger).  Cancelled sends return -1.
 int rma_try_send(SocketId primary, RpcMeta* meta, IOBuf* body,
                  uint64_t target_rkey, uint64_t target_max,
-                 uint64_t target_off = 0);
+                 uint64_t target_off = 0,
+                 const DeadlineToken& tok = DeadlineToken{});
 
 // -- receive (messenger hook) ---------------------------------------------
 

@@ -30,10 +30,12 @@
 #include "net/nshead.h"
 #include "net/thrift.h"
 #include "net/tls.h"
+#include "net/deadline.h"
 #include "net/messenger.h"
 #include "net/ici_transport.h"
 #include "net/shm_transport.h"
 #include "net/span.h"
+#include "stat/timeline.h"
 #include "net/stream.h"
 #include "net/rma.h"
 #include "net/stripe.h"
@@ -981,6 +983,22 @@ void tstd_process_request(InputMessage&& msg) {
     }
     return;  // credential frames carry no request
   }
+  if (msg.meta.type == RpcMeta::kCancel) {
+    // Cascading-cancel control frame (net/deadline.h): fans out to the
+    // named in-flight request's downstream calls and transfers.  Never
+    // answered (the caller already abandoned the call); dropped on an
+    // unauthenticated connection — an unverified peer must not cancel
+    // other clients' work.
+    if (srv == nullptr || srv->authenticator() == nullptr ||
+        sock->auth_ok.load(std::memory_order_acquire)) {
+      if (cancel_fire(msg.socket, msg.meta.correlation_id) &&
+          timeline::enabled()) {
+        timeline::record(timeline::kDeadline, msg.meta.correlation_id,
+                         timeline::kDeadlineCancelFanout << 56);
+      }
+    }
+    return;
+  }
   if (srv != nullptr && srv->authenticator() != nullptr &&
       !sock->auth_ok.load(std::memory_order_acquire)) {
     RpcMeta meta;
@@ -1023,6 +1041,30 @@ void tstd_process_request(InputMessage&& msg) {
       srv != nullptr ? srv->session_data_pool() : nullptr;
   auto* response = new IOBuf();
   const int64_t start_us = monotonic_time_us();
+  // Deadline plane (net/deadline.h): anchor the wire's relative budget
+  // to the request's parse-time arrival clock, so QoS-lane queueing and
+  // dispatch backlog count against it.  A budget that already expired
+  // is shed below, BEFORE it can consume an admission slot or a
+  // handler.
+  int64_t deadline_abs = 0;
+  if (msg.meta.deadline_us != 0 && msg.arrival_us != 0 &&
+      deadline_wire_enabled()) {
+    // Gated on the SAME flag that controls stamping: trpc_deadline_wire
+    // off is the operator kill-switch for the whole plane on this node
+    // — incoming stamps from flag-on peers are then ignored too, as the
+    // flag's help text promises.
+    // The wire value is untrusted (the frame CRC covers only the
+    // payload): clamp to a sane ceiling before anchoring, or a hostile
+    // u64 near INT64_MAX signed-overflows the add (UB) and wraps a
+    // live request into an instant shed.
+    constexpr uint64_t kMaxBudgetUs = 24ull * 3600 * 1000 * 1000;  // 24h
+    const uint64_t budget = msg.meta.deadline_us < kMaxBudgetUs
+                                ? msg.meta.deadline_us
+                                : kMaxBudgetUs;
+    deadline_abs = msg.arrival_us + static_cast<int64_t>(budget);
+    cntl->set_deadline_abs_us(deadline_abs);
+  }
+  const bool deadline_dead = deadline_abs != 0 && start_us >= deadline_abs;
   // rpcz: server span, linked to the client span via the meta's trace
   // context (baidu_rpc_protocol.cpp:648-661 parity).  Ambient context
   // makes client calls issued from inside the handler children of this
@@ -1060,18 +1102,21 @@ void tstd_process_request(InputMessage&& msg) {
       srv != nullptr ? srv->qos_governor() : nullptr;
   TenantGovernor::Entry* tenant_entry = nullptr;
   bool tenant_admitted = true;
-  if (gov != nullptr) {
+  if (gov != nullptr && !deadline_dead) {
     tenant_entry = gov->admit(msg.meta.qos_tenant, &tenant_admitted);
     if (!tenant_admitted) {
       tenant_entry = nullptr;  // no on_response for shed calls
     }
   }
   // Admission gate (MethodStatus parity): rejected calls never reach the
-  // handler and answer immediately with kELimit.
+  // handler and answer immediately with kELimit.  An already-expired
+  // request skips admission entirely — it is shed below without ever
+  // billing a tenant or a concurrency slot.
   const bool admitted =
-      tenant_admitted && (limiter == nullptr || limiter->on_request());
-  if (!admitted) {
-    limiter = nullptr;  // no on_response for rejected calls
+      deadline_dead ||
+      (tenant_admitted && (limiter == nullptr || limiter->on_request()));
+  if (!admitted || deadline_dead) {
+    limiter = nullptr;  // no on_response for rejected/shed calls
   }
 
   if (srv != nullptr) {
@@ -1113,11 +1158,17 @@ void tstd_process_request(InputMessage&& msg) {
     // is WRITTEN into the caller's advertised region (or this
     // connection's window) and only a control frame rides back; 1 =
     // not applicable / window full — the stripe/frame path carries it.
+    // Long response transfers poll the request's cancel scope and
+    // remaining budget between chunks (net/deadline.h): a caller that
+    // cancelled, died, or ran out of budget stops the put within one
+    // chunk instead of shipping bytes nobody will read.
+    const DeadlineToken resp_tok{cntl->call().cancel_scope.get(),
+                                 cntl->deadline_abs_us()};
     const int rma_rc =
         rma_try_send(socket_id, &meta, response,
                      cntl->call().rma_resp_rkey,
                      cntl->call().rma_resp_max,
-                     cntl->call().rma_resp_off);
+                     cntl->call().rma_resp_off, resp_tok);
     if (rma_rc != 1) {
       // Sent (0) or hard-failed (-1, socket dead: the client times out
       // exactly as a failed stripe_send would have left it).
@@ -1131,7 +1182,7 @@ void tstd_process_request(InputMessage&& msg) {
         rails.push_back(socket_id);
       }
       stripe_send(socket_id, rails, std::move(meta),
-                  std::move(*response), cid);
+                  std::move(*response), cid, resp_tok);
     } else {
       stripe_frame_send(socket_id, std::move(meta),
                         std::move(*response));
@@ -1154,6 +1205,12 @@ void tstd_process_request(InputMessage&& msg) {
     if (cntl->call().sl_data != nullptr) {
       cntl->call().sl_pool->Return(cntl->call().sl_data);
     }
+    if (cntl->call().cancel_scope != nullptr) {
+      // Unregistered only AFTER the response send: a kCancel racing the
+      // response must still find the scope to abort an in-flight
+      // one-sided put.
+      cancel_unregister(socket_id, cid);
+    }
     delete response;
     delete cntl;
     if (srv != nullptr) {
@@ -1175,6 +1232,23 @@ void tstd_process_request(InputMessage&& msg) {
     // the successor that revives on this endpoint moments later isn't
     // serving into a poisoned breaker.
     cntl->SetFailed(kEDraining, "server draining: fail over");
+    done();
+    return;
+  }
+  if (deadline_dead) {
+    // The caller's end-to-end budget expired before we could dispatch
+    // (in flight, or queued in a QoS lane — arrival was stamped at
+    // parse).  Shed with the distinct non-retriable status: executing
+    // (or retrying) a dead budget is pure wasted work.
+    deadline_vars().shed_total << 1;
+    if (timeline::enabled()) {
+      timeline::record(timeline::kDeadline, cid,
+                       (timeline::kDeadlineShedPreDispatch << 56) |
+                           static_cast<uint64_t>(msg.meta.deadline_us &
+                                                 0xffffffffffffffull));
+    }
+    cntl->SetFailed(kEDeadlineExpired,
+                    "deadline expired before dispatch: " + method);
     done();
     return;
   }
@@ -1219,6 +1293,20 @@ void tstd_process_request(InputMessage&& msg) {
       fiber_sleep_us(fd.delay_ms * 1000);
     }
   }
+  if (deadline_abs != 0 && monotonic_time_us() >= deadline_abs) {
+    // Expired while parked in the (injected) dispatch delay — the
+    // queueing class the plane exists to shed: never half-execute work
+    // whose caller has already given up.
+    deadline_vars().shed_total << 1;
+    if (timeline::enabled()) {
+      timeline::record(timeline::kDeadline, cid,
+                       timeline::kDeadlineShedQueued << 56);
+    }
+    cntl->SetFailed(kEDeadlineExpired,
+                    "deadline expired in dispatch queue: " + method);
+    done();
+    return;
+  }
   srv->maybe_dump(method, msg.meta.attachment_size, msg.payload);
   // Split the attachment tail off the payload.
   IOBuf request = std::move(msg.payload);
@@ -1248,6 +1336,49 @@ void tstd_process_request(InputMessage&& msg) {
   }
   if (msg.meta.has_checksum) {
     cntl->set_enable_checksum(true);  // checksum the response too
+  }
+  // Cascading cancellation (net/deadline.h): every DISPATCHED request
+  // owns a cancel scope, registered under (connection, cid) so a
+  // kCancel control frame — or a poller observing the dead connection /
+  // expired budget — fans out to the downstream calls and transfers the
+  // handler starts.  Shed/early-error paths above never create one:
+  // they own no work worth cancelling.
+  auto cancel_scope = std::make_shared<CancelScope>();
+  cancel_scope->socket = socket_id;
+  cancel_scope->deadline_us = deadline_abs;
+  if (!cancel_register(socket_id, cid, cancel_scope)) {
+    // The caller's kCancel raced ahead of dispatch (request was still
+    // queued when it arrived): shed as cancelled — executing work
+    // nobody wants is the waste this plane exists to stop.  The scope
+    // was never registered, so done() has nothing to unregister.
+    deadline_vars().tombstone_shed << 1;
+    if (timeline::enabled()) {
+      timeline::record(timeline::kDeadline, cid,
+                       timeline::kDeadlineCancelFanout << 56);
+    }
+    cntl->SetFailed(ECANCELED, "request cancelled before dispatch");
+    done();
+    return;
+  }
+  cntl->call().cancel_scope = cancel_scope;
+  // Ambient deadline + scope for the handler extent (cleared by this
+  // fiber on every exit path, like the span ambient): client calls the
+  // handler issues inherit the remaining budget and register for
+  // cancellation automatically.  The pthread-pool path skips it — the
+  // handler runs off-fiber there and polls the Controller instead.
+  struct DeadlineAmbientGuard {
+    bool active = false;
+    ~DeadlineAmbientGuard() {
+      if (active) {
+        set_ambient_deadline(0);
+        set_ambient_cancel(nullptr);
+      }
+    }
+  } deadline_ambient_guard;
+  if (!srv->usercode_in_pthread()) {
+    set_ambient_deadline(deadline_abs);
+    set_ambient_cancel(cancel_scope.get());
+    deadline_ambient_guard.active = true;
   }
   // Registered handler, else the catch-all (generic-call parity).  A
   // pointer, not a copy: both live in server-owned storage that

@@ -396,7 +396,8 @@ int stripe_frame_send(SocketId primary, RpcMeta&& meta, IOBuf&& body) {
 }
 
 int stripe_send(SocketId primary, const std::vector<SocketId>& rails,
-                RpcMeta&& meta, IOBuf&& body, uint64_t stripe_id) {
+                RpcMeta&& meta, IOBuf&& body, uint64_t stripe_id,
+                const DeadlineToken& tok) {
   const uint64_t total = body.size();
   const uint64_t chunk =
       std::max<uint64_t>(64 << 10, stripe_chunk_bytes());
@@ -431,6 +432,14 @@ int stripe_send(SocketId primary, const std::vector<SocketId>& rails,
   uint64_t off = chunk;
   size_t rail_i = 0;
   while (!body.empty()) {
+    if (tok.aborted()) {
+      // Cascading cancel / expired budget: stop cutting within one
+      // chunk.  The receiver's partial reassembly never dispatches and
+      // expires whole after trpc_stripe_reassembly_timeout_ms.
+      deadline_vars().cancel_saved_bytes
+          << static_cast<int64_t>(body.size());
+      return -1;
+    }
     IOBuf piece;
     body.cutn(&piece, chunk);
     RpcMeta cm;

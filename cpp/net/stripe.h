@@ -32,6 +32,7 @@
 #include <vector>
 
 #include "base/iobuf.h"
+#include "net/deadline.h"
 #include "net/protocol.h"
 
 namespace trpc {
@@ -74,9 +75,13 @@ int stripe_frame_send(SocketId primary, RpcMeta&& meta, IOBuf&& body);
 // the primary.  meta's stripe fields are filled here; with
 // meta.has_checksum each frame carries the crc32c of ITS OWN payload
 // (verified per frame by the receiving parser).  Returns 0 when every
-// frame was accepted by a write queue.
+// frame was accepted by a write queue.  tok (net/deadline.h): polled
+// between chunk frames — a cancelled caller / expired budget stops
+// cutting, the receiver's partial reassembly expires whole (reassembly
+// timeout), and the skipped bytes count as cancel_saved_bytes.
 int stripe_send(SocketId primary, const std::vector<SocketId>& rails,
-                RpcMeta&& meta, IOBuf&& body, uint64_t stripe_id);
+                RpcMeta&& meta, IOBuf&& body, uint64_t stripe_id,
+                const DeadlineToken& tok = DeadlineToken{});
 
 // -- receiving (messenger hooks) ------------------------------------------
 

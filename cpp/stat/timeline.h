@@ -84,8 +84,19 @@ enum EventType : uint32_t {
                         // (new & 0xffffffff) — values wider than 32
                         // bits truncate here; the /tuner journal keeps
                         // them exact
+  kDeadline = 25,  // a=correlation id (0 where none applies),
+                   // b=(op << 56) | detail; ops: kDeadlineShed* below.
+                   // The deadline plane's shed / cancel-fan-out /
+                   // suppression decisions (net/deadline.h)
   kEventTypeCount,
 };
+
+// kDeadline b-field ops (high byte).
+constexpr uint64_t kDeadlineShedPreDispatch = 1;  // detail=stamped budget µs
+constexpr uint64_t kDeadlineShedQueued = 2;       // expired in dispatch queue
+constexpr uint64_t kDeadlineCancelFanout = 3;     // kCancel frame resolved
+constexpr uint64_t kDeadlineHedgeSuppressed = 4;  // detail=remaining µs
+constexpr uint64_t kDeadlineRetrySuppressed = 5;  // retry budget empty
 
 // Names rendered in the JSON dump and Perfetto export; lint markers on
 // each entry keep this table and the Python decoder's in lockstep.
@@ -115,6 +126,7 @@ constexpr const char* kEventNames[] = {
     "kv_block",        // timeline-event 22 (kv_block)
     "coll_step",       // timeline-event 23 (coll_step)
     "tuner_decision",  // timeline-event 24 (tuner_decision)
+    "deadline",        // timeline-event 25 (deadline)
 };
 static_assert(sizeof(kEventNames) / sizeof(kEventNames[0]) ==
                   kEventTypeCount,

@@ -309,7 +309,11 @@ TEST_CASE(corruption_never_yields_wrong_payload) {
       ++failures;
     }
   }
-  EXPECT(failures == 5);
+  // checked_echo already fails the test on any accepted-but-wrong
+  // payload; a flip can also land in an INERT meta byte (the trace /
+  // deadline tail groups, ISSUE 15) and leave a call byte-exact — so
+  // assert "almost always fails, never lies", not an exact count.
+  EXPECT(failures >= 4);
   EXPECT(FaultActor::global().injected() > 0);
 }
 

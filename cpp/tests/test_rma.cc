@@ -282,7 +282,13 @@ TEST_CASE(rma_cancel_mid_put_buffer_quiescent) {
   memset(land, 0x77, cap);
   // Server answers late; the call times out first — the client-side
   // completion unregisters the landing BEFORE the response's one-sided
-  // put could be resolved against it.
+  // put could be resolved against it.  Deadline stamping OFF for this
+  // scenario: with the deadline plane (ISSUE 15) a stamped budget makes
+  // the server SHED the delayed request instead of producing the late
+  // response — this test models the peer that never learned of the
+  // abandonment (old client / wire stamping disabled), where the
+  // landing-unbind defense is the only line left.
+  FlagGuard wire("trpc_deadline_wire", "false");
   EXPECT_EQ(g_server->SetFaults("svr_delay=1:800"), 0);
   RmaDelta d;
   {
@@ -449,6 +455,15 @@ TEST_CASE(rma_span_scavenger_reclaims_leaked_never_live) {
     EXPECT(!cntl.Failed());
   }
   FlagGuard age("trpc_rma_span_scavenge_ms", "150");
+  // Earlier suite tests (chunk-drop/corrupt, cancel/deadline races)
+  // legitimately leak never-admitted spans — exactly the class this
+  // scavenger exists for.  Purge that residue first so the live-span
+  // exemption below is judged on this test's own span only.  Two passes
+  // a full age apart: the scavenger is mark-then-sweep (first_seen
+  // stamping), so one pass only STARTS aging a slot it never saw.
+  rma_scavenge();
+  usleep(200 * 1000);
+  rma_scavenge();
   // A LIVE span first: hold the zero-copy response (it wraps a span in
   // OUR window) past the scavenge age — admitted spans are exempt.
   {

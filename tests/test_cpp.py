@@ -39,7 +39,7 @@ _NO_CMAKE = shutil.which("cmake") is None or shutil.which("ctest") is None
 TSAN_SUITES = [
     "fiber", "rpc", "stream", "shm", "ici", "chaos", "stat", "qos",
     "stripe", "analysis", "timeline", "rma", "kvstore", "naming",
-    "collective", "tuner",
+    "collective", "tuner", "deadline",
 ]
 ALL_SUITES = sorted(
     p.stem[len("test_"):] for p in (REPO / "cpp" / "tests").glob("test_*.cc")
@@ -203,6 +203,20 @@ def test_tuner_cpp_suite_native():
     tick/stop behavior."""
     _run_native_suite("test_tuner.cc", "test_tuner_native",
                       "tuner suite")
+
+
+def test_deadline_cpp_suite_native():
+    """ISSUE 15: the deadline & cancellation plane gates tier-1 — wire
+    tail-group 7 roundtrip + unset-traffic byte identity, shed before
+    dispatch (in-flight / injected delay / QoS-lane queueing),
+    handler-visible remaining budget, budget-minus-elapsed re-stamping
+    across proxy hops, cancel fan-out to downstream calls and
+    mid-transfer one-sided puts, chunk-drop chaos composition, the typed
+    kEDeadlineExpired stopping the retry chain, the retry-budget token
+    bucket bounding storm amplification ≤1.2x, hedge suppression on
+    insufficient remaining budget, and cancel-registry hygiene."""
+    _run_native_suite("test_deadline.cc", "test_deadline_native",
+                      "deadline suite")
 
 
 def test_kvstore_cpp_suite_native():

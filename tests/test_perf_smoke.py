@@ -52,6 +52,86 @@ def test_1kb_single_conn_qps_floor():
     )
 
 
+def test_1kb_qps_floor_with_deadlines_stamped():
+    """ISSUE 15: the deadline plane is ON by default — every bench call
+    (5s controller timeout) stamps meta tail-group 7 AND registers a
+    per-request cancel scope server-side.  The 1KB floor must hold with
+    that overhead; the flag is pinned explicitly so this guard keeps
+    measuring the stamped path even if the default ever flips."""
+    row = _run_bench(64, 1024, "single", flags="trpc_deadline_wire=true")
+    assert row["failures"] == 0, f"echo calls failed: {row}"
+    assert row["qps"] >= QPS_FLOOR, (
+        f"1KB QPS {row['qps']:.0f} under floor {QPS_FLOOR} with deadline "
+        "stamping on (tail-group 7 + cancel-scope registration overhead "
+        "regressed the hot path)")
+
+
+def test_deadline_shed_keeps_in_deadline_p99():
+    """ISSUE 15 acceptance: under svr_delay chaos with 50% tight-deadline
+    traffic, every expired request is shed BEFORE dispatch (shed counter
+    moves, zero handler executions for them) while the in-deadline
+    half's p99 holds ≤2x its baseline under the SAME chaos without the
+    doomed traffic — shed work must consume no handler capacity."""
+    from brpc_tpu.rpc import (Channel, DeadlineExpiredError, Server,
+                              deadline_scope, observe)
+
+    execs = {"n": 0}
+    srv = Server()
+
+    def handler(call, data):
+        execs["n"] += 1
+        call.respond(data)
+
+    srv.register("Echo.D", handler)
+    srv.start(0)
+    ch = Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+    try:
+        srv.set_faults("seed=1;svr_delay=1:30")  # every dispatch +30ms
+
+        def p99(lat):
+            lat = sorted(lat)
+            return lat[len(lat) * 99 // 100]
+
+        def loose_call():
+            t0 = time.perf_counter()
+            assert ch.call("Echo.D", b"x" * 1024) == b"x" * 1024
+            return (time.perf_counter() - t0) * 1e6
+
+        n = 150
+        baseline = [loose_call() for _ in range(n)]
+        shed0 = observe.Vars.dump().get("deadline_expired_shed_total", 0)
+        execs0 = execs["n"]
+        mixed = []
+        tight_shed_client = 0
+        for i in range(n):
+            # Tight half: a 10ms budget dies inside the 30ms delay.
+            with deadline_scope(10):
+                try:
+                    ch.call("Echo.D", b"x" * 1024)
+                except DeadlineExpiredError:
+                    tight_shed_client += 1
+            mixed.append(loose_call())
+        deadline = time.time() + 5
+        while observe.Vars.dump().get(
+                "deadline_expired_shed_total", 0) - shed0 < n and \
+                time.time() < deadline:
+            time.sleep(0.02)
+        shed = observe.Vars.dump().get(
+            "deadline_expired_shed_total", 0) - shed0
+        assert tight_shed_client == n, tight_shed_client
+        assert shed >= n, f"expired requests not shed pre-dispatch: {shed}"
+        # ZERO handler executions for the doomed half: only the loose
+        # calls ran.
+        assert execs["n"] - execs0 == n, (execs["n"] - execs0, n)
+        assert p99(mixed) <= 2 * p99(baseline), (
+            f"in-deadline p99 {p99(mixed):.0f}us vs baseline "
+            f"{p99(baseline):.0f}us — shed traffic consumed capacity")
+    finally:
+        srv.set_faults("")
+        ch.close()
+        srv.stop()
+
+
 def test_observability_idle_free_with_rpcz_off():
     """ISSUE 4 satellite: the observability plane must be FREE when idle.
     rpcz_enabled defaults to false; with it pinned off, the PR-2 1KB QPS
@@ -644,6 +724,12 @@ def test_kv_disagg_goodput_and_token_p99_hold_together():
         assert row["verified"], f"block content verification failed: {row}"
         assert row["rpc_path"] == "rma", (
             f"block pulls did not ride the one-sided plane: {row}")
+        # ISSUE 15: the cancel probe's wasted-work accounting is present
+        # and coherent — abandoned pulls must not ship MORE than they
+        # would have without propagation.
+        assert row["cancel_wasted_bytes_before"] > 0, row
+        assert 0 <= row["cancel_wasted_bytes_after"] <= \
+            row["cancel_wasted_bytes_before"], row
         bound = max(2 * row["token_p99_unloaded_us"], 1500)
         if (row["kv_goodput_gbps"] >= KV_DISAGG_GOODPUT_FLOOR_GBPS
                 and row["token_p99_loaded_us"] <= bound):

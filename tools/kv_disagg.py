@@ -185,6 +185,43 @@ def run_decode(args) -> None:
                 submit_one()
     dt = time.perf_counter() - t0
     rma1 = _obs.Vars.dump().get("rma_rx_msgs", 0)
+    # Cancellation-propagation probe (ISSUE 15): pulls abandoned right
+    # after submit.  Without the deadline plane every one of these
+    # blocks ships to a dead caller (wasted_before); with cascading
+    # cancel the serving side's put aborts between chunks, and the
+    # saved bytes show up in deadline_cancel_saved_bytes (plus fully
+    # shed fetches that never started a put).
+    saved0 = _obs.Vars.dump().get("deadline_cancel_saved_bytes", 0)
+    # One DISTINCT free landing buffer per probe pull (the PR-13 landing
+    # rule allows one direct bind per region); a drained pipeline has
+    # all `depth` buffers free — if the measured loop broke on a poll
+    # timeout some stayed outstanding, and the probe shrinks (or skips)
+    # rather than alias or crash.
+    probe_n = min(len(metas), len(free))
+    probe_bytes = probe_n * block_bytes
+    probe_shipped = 0
+    # Submit a burst as deep as the pipeline, then abandon it whole —
+    # still-queued pulls shed via cancel tombstones, the in-flight one
+    # aborts between chunks.
+    probe_toks: list[int] = []
+    for i in range(probe_n):
+        m = metas[i % len(metas)]
+        req = kv._req(m.block_id, generation=m.generation)
+        toks = pipe.submit(kv.FETCH_METHOD, [req],
+                           resp_bufs=[lands[free[i]].view],
+                           timeout_ms=30000)
+        probe_toks.append(toks[0])
+    for t in probe_toks:
+        pipe.cancel(t)
+    pending = set(probe_toks)
+    deadline = time.perf_counter() + 20
+    while pending and time.perf_counter() < deadline:
+        for c in pipe.poll(max_n=max(probe_n, 1), timeout_ms=5000):
+            pending.discard(c.token)
+            if c.ok:
+                probe_shipped += c.resp_len
+    cancel_saved = _obs.Vars.dump().get(
+        "deadline_cancel_saved_bytes", 0) - saved0
     pipe.close()
     row = {
         "kv_goodput_gbps": round(bytes_done / dt / 1e9, 3),
@@ -195,6 +232,13 @@ def run_decode(args) -> None:
         "rpc_path": "rma" if rma1 > rma0 else "copy",
         "cache_hits": cli.cache_hits,
         "cache_misses": cli.cache_misses,
+        # Wasted-work accounting (ISSUE 15): bytes the abandoned pulls
+        # WOULD have shipped without cancellation propagation (before)
+        # vs what the client actually observed landing (after); the
+        # server-side saved counter covers mid-transfer aborts.
+        "cancel_wasted_bytes_before": probe_bytes,
+        "cancel_wasted_bytes_after": probe_shipped,
+        "cancel_saved_bytes": cancel_saved,
     }
     print("ROW " + json.dumps(row), flush=True)
     sys.stdin.readline()  # stay up for the trace fetch

@@ -67,6 +67,18 @@ Rules (each names the incident class it prevents):
                      prefix) — the controller's inputs must be
                      dashboard-readable, since /tuner republishes them.
 
+  error-code-sync    The cpp error-code table (`constexpr int kE* = N;`
+                     in cpp/net/*.h — kEOverloaded/kEDraining/
+                     kEDeadlineExpired/the kv/naming/coll families) must
+                     match the ERROR_CODES mirror in
+                     brpc_tpu/rpc/_lib.py exactly (both directions, same
+                     values), and no two names may share a code.  The
+                     typed-exception constructors resolve codes through
+                     the capi at run time, but a code added or
+                     renumbered on one side only used to drift silently
+                     until a client mis-typed an exception in
+                     production.
+
   atomic-comment     Every memory_order_relaxed / memory_order_acquire
                      in the socket/messenger/qos/stripe hot paths must
                      carry a justification comment (same line or within
@@ -458,6 +470,55 @@ def check_tuner_rules() -> None:
                  "Prometheus HELP description anywhere in cpp/")
 
 
+# ---- error-code-sync -----------------------------------------------------
+
+def check_error_codes() -> None:
+    defpat = re.compile(r"constexpr\s+int\s+(kE[A-Za-z0-9]+)\s*=\s*(\d+)\s*;")
+    cpp_codes: dict = {}
+    by_value: dict = {}
+    for path in runtime_files(exts=(".h",)):
+        text = path.read_text()
+        for m in defpat.finditer(text):
+            name, code = m.group(1), int(m.group(2))
+            line = text[:m.start()].count("\n") + 1
+            if name in cpp_codes and cpp_codes[name][0] != code:
+                flag(path, line, "error-code-sync",
+                     f"{name} redefined with a different value "
+                     f"({cpp_codes[name][0]} vs {code})")
+            cpp_codes[name] = (code, path, line)
+            other = by_value.get(code)
+            if other is not None and other != name:
+                flag(path, line, "error-code-sync",
+                     f"{name} and {other} share code {code} — clients "
+                     "cannot type the exception")
+            by_value[code] = name
+    lib_py = REPO / "brpc_tpu" / "rpc" / "_lib.py"
+    text = lib_py.read_text()
+    block = re.search(r"ERROR_CODES\s*=\s*\{(.*?)\}", text, re.S)
+    if block is None:
+        flag(lib_py, 1, "error-code-sync",
+             "_lib.py must define the ERROR_CODES mirror of the cpp "
+             "kE* table")
+        return
+    py_codes: dict = {}
+    for m in re.finditer(r'"(kE[A-Za-z0-9]+)":\s*(\d+)', block.group(1)):
+        py_codes[m.group(1)] = int(m.group(2))
+    py_line = text[:block.start()].count("\n") + 1
+    for name, (code, path, line) in sorted(cpp_codes.items()):
+        if name not in py_codes:
+            flag(path, line, "error-code-sync",
+                 f"{name} ({code}) has no entry in _lib.py ERROR_CODES")
+        elif py_codes[name] != code:
+            flag(lib_py, py_line, "error-code-sync",
+                 f"ERROR_CODES[{name!r}] = {py_codes[name]} but cpp "
+                 f"defines {code}")
+    for name in sorted(py_codes):
+        if name not in cpp_codes:
+            flag(lib_py, py_line, "error-code-sync",
+                 f"ERROR_CODES entry {name!r} matches no constexpr kE* "
+                 "in cpp/")
+
+
 # ---- atomic-comment ------------------------------------------------------
 
 ATOMIC_FILES = [
@@ -493,6 +554,7 @@ def main() -> int:
     check_timeline_events()
     check_flag_references()
     check_tuner_rules()
+    check_error_codes()
     check_atomic_comments()
     if violations:
         print(f"lint_trpc: {len(violations)} violation(s)")
