@@ -1021,6 +1021,186 @@ def _child_rolling_restart() -> None:
         f"rolling_restart produced no row:\n{out.stderr[-2000:]}")
 
 
+def _child_replay() -> None:
+    """Capture-and-replay regression row (ISSUE 16).  Records a mixed-
+    tenant window on a QoS-laned server (fg 1KB echo — every 5th under a
+    deadline scope — concurrent with a bulk tenant moving striped 16MB
+    bodies from its own process), dumps the capture, then regresses two
+    planes against it:
+
+    exact leg — tools/traffic_replay.py re-offers the window open-loop
+    at the recorded inter-arrival times with tenant/priority/deadline
+    re-stamped; the capture tier stays armed through the replay, so the
+    row compares the REPLAYED window's server-side per-tenant p99/rate
+    against the RECORDED baseline apples-to-apples (acceptance: rate
+    within 10%, p99 <= 2x, zero untyped errors).
+
+    stat leg — statistical mode at 2x the fitted rate, composed with
+    server-side chaos (svr_delay): shed-don't-degrade, i.e. every error
+    is a typed shed (kELimit/kEOverloaded/kEDraining/kEDeadlineExpired),
+    never an untyped failure."""
+    import tempfile
+
+    from brpc_tpu.rpc import Channel, Server, set_flag
+    from brpc_tpu.rpc import capture as cap
+
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "traffic_replay.py")
+    bulk_bytes = 16 << 20
+    lanes = 4
+    qos_spec = "fg:weight=8,limit=16;bulk:weight=1,limit=64;*:limit=10000"
+    set_flag("trpc_qos_lanes", str(lanes))
+    srv = Server()
+    srv.register_native_echo("Echo.Echo")
+    srv.set_qos(qos_spec)
+    srv.start(0)
+    addr = f"127.0.0.1:{srv.port}"
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+
+    # ---- record the mixed-tenant window -------------------------------
+    # Load generators run in their OWN processes, TWO fg senders + one
+    # bulk, matching the replay side's two worker processes — the
+    # recorded baseline and the replayed window then see the same client
+    # concurrency, so the p99 comparison is apples-to-apples.
+    fg = Channel(addr, timeout_ms=5000, qos_tenant="fg", qos_priority=0)
+    small = b"x" * 1024
+    for _ in range(50):  # warm: connections, pools, lazy init
+        fg.call("Echo.Echo", small)
+    fg.close()
+    record_secs = 5.0
+    # Bulk is recorded OPEN-LOOP (Batch, fixed 100ms cadence, bounded
+    # in-flight) — the replayer is open-loop too, so a closed-loop
+    # recording would hand it a baseline that never self-overlaps and
+    # every replayed overlap would read as a regression.
+    bulk_code = (
+        "import time\nfrom brpc_tpu.rpc import Batch, Channel\n"
+        f"ch = Channel({addr!r}, timeout_ms=60000, "
+        "connection_type='pooled', qos_tenant='bulk', qos_priority=3)\n"
+        "b = Batch(ch)\n"
+        f"buf = b'b' * {bulk_bytes}\n"
+        f"end = time.time() + {record_secs}\n"
+        "next_t = time.time()\n"
+        "pending = 0\n"
+        "while time.time() < end:\n"
+        "    if time.time() >= next_t and pending < 4:\n"
+        "        b.submit('Echo.Echo', [buf], timeout_ms=60000)\n"
+        "        pending += 1\n"
+        "        next_t += 0.1\n"
+        "    pending -= len(b.poll(max_n=8, timeout_ms=10))\n"
+        "while pending > 0:\n"
+        "    got = len(b.poll(max_n=8, timeout_ms=1000))\n"
+        "    if not got:\n        break\n"
+        "    pending -= got\n"
+        "b.close()\nch.close()\n")
+    fg_code = (
+        "import time\n"
+        "from brpc_tpu.rpc import Channel, deadline_scope\n"
+        f"ch = Channel({addr!r}, timeout_ms=5000, qos_tenant='fg', "
+        "qos_priority=0)\n"
+        "buf = b'x' * 1024\n"
+        f"end = time.time() + {record_secs}\n"
+        "i = 0\n"
+        "while time.time() < end:\n"
+        "    try:\n"
+        "        if i % 5 == 0:\n"
+        "            with deadline_scope(500):\n"
+        "                ch.call('Echo.Echo', buf)\n"
+        "        else:\n"
+        "            ch.call('Echo.Echo', buf)\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "    i += 1\n"
+        "    time.sleep(0.002)\n")
+    cap.enable_capture(True)
+    cap.reset_capture()
+    procs = [subprocess.Popen([sys.executable, "-c", bulk_code], env=env)]
+    procs += [subprocess.Popen([sys.executable, "-c", fg_code], env=env)
+              for _ in range(2)]
+    for p in procs:
+        p.wait(timeout=120)
+    recorded = cap.summary()
+    cap_path = tempfile.mktemp(prefix="bench_replay_", suffix=".cap")
+    n_records = cap.dump(cap_path)
+
+    def _tool_row(extra: list) -> dict:
+        out = subprocess.run(
+            [sys.executable, tool, "--addr", addr, "--capture", cap_path,
+             "--workers", "2", "--default-timeout-ms", "30000", *extra],
+            env=env, capture_output=True, text=True, timeout=240)
+        for ln in out.stdout.splitlines()[::-1]:
+            if ln.startswith("{"):
+                return json.loads(ln)
+        raise RuntimeError(f"replayer produced no row:\n{out.stderr[-2000:]}")
+
+    # ---- exact leg: capture stays armed to measure the replayed window
+    cap.reset_capture()
+    exact = _tool_row([])
+    replayed = cap.summary()
+
+    tenants = {}
+    worst_p99_ratio = 0.0
+    worst_rate_dev = 0.0
+    for t, rec_t in recorded["summary"].get("tenants", {}).items():
+        rep_t = replayed["summary"].get("tenants", {}).get(t, {})
+        ex_t = exact.get("tenants", {}).get(t, {})
+        p99_ratio = (rep_t.get("p99_us", 0) /
+                     max(rec_t.get("p99_us", 0), 1.0))
+        rate_ratio = (rep_t.get("est_rate_rps", 0.0) /
+                      max(rec_t.get("est_rate_rps", 0.0), 1e-9))
+        worst_p99_ratio = max(worst_p99_ratio, p99_ratio)
+        worst_rate_dev = max(worst_rate_dev, abs(1.0 - rate_ratio))
+        tenants[t] = {
+            "recorded_p99_us": rec_t.get("p99_us", 0),
+            "replayed_p99_us": rep_t.get("p99_us", 0),
+            "p99_ratio": round(p99_ratio, 3),
+            "recorded_rate_rps": round(rec_t.get("est_rate_rps", 0.0), 1),
+            "replayed_rate_rps": round(rep_t.get("est_rate_rps", 0.0), 1),
+            "rate_ratio": round(rate_ratio, 3),
+            "client_errors": ex_t.get("errors", {}),
+        }
+
+    # ---- stat leg: 2x fitted rate + server-side chaos -----------------
+    srv.set_faults("svr_delay=1:20")
+    cap.reset_capture()
+    stat = _tool_row(["--mode", "stat", "--rate-scale", "2.0",
+                      "--duration", "4"])
+    srv.set_faults("")
+    stat_sheds = sum(sum(t.get("errors", {}).values())
+                     for t in stat.get("tenants", {}).values())
+    stat_sent = sum(t.get("sent", 0) for t in stat.get("tenants", {}).values())
+
+    cap.enable_capture(False)
+    try:
+        os.unlink(cap_path)
+    except OSError:
+        pass
+    srv.stop()
+    print(json.dumps({
+        "workload": "capture_replay_mixed_tenant",
+        "captured_records": n_records,
+        "capture_window_us": recorded["summary"].get("window_us", 0),
+        "burstiness_cv": recorded["summary"].get("burstiness_cv", 0.0),
+        "tenants": tenants,
+        "worst_p99_ratio": round(worst_p99_ratio, 3),
+        "worst_rate_deviation": round(worst_rate_dev, 3),
+        "exact_untyped_errors": exact.get("untyped_errors", -1),
+        "exact_typed_only": exact.get("typed_errors_only", False),
+        "stat_rate_scale": 2.0,
+        "stat_chaos": "svr_delay=1:20",
+        "stat_sent": stat_sent,
+        "stat_sheds": stat_sheds,
+        "stat_errors": {t: d.get("errors", {})
+                        for t, d in stat.get("tenants", {}).items()},
+        "stat_untyped_errors": stat.get("untyped_errors", -1),
+        "stat_typed_only": stat.get("typed_errors_only", False),
+        "qos_lanes": lanes,
+        "qos_spec": qos_spec,
+        "bulk_bytes": bulk_bytes,
+    }))
+
+
 def _child_zerocopy() -> None:
     """Loopback RPC echo, three Python-boundary strategies at 4MB: the
     per-call bytes-copy path, the per-call dlpack zero-copy path, and the
@@ -1241,6 +1421,9 @@ def main() -> None:
     if os.environ.get("BENCH_RR"):
         _child_rolling_restart()
         return
+    if os.environ.get("BENCH_REPLAY"):
+        _child_replay()
+        return
     if os.environ.get("BENCH_COLL"):
         _child_collective()
         return
@@ -1301,6 +1484,7 @@ def main() -> None:
     qos_mixed = _run_json_child({"BENCH_QOS": "1"}, 90)
     kv_disagg = _run_json_child({"BENCH_KV": "1"}, 240)
     rolling_restart = _run_json_child({"BENCH_RR": "1"}, 240)
+    replay = _run_json_child({"BENCH_REPLAY": "1"}, 300)
     coll = _run_json_child({"BENCH_COLL": "1"}, 240)
     self_tune = _run_json_child({"BENCH_SELF_TUNE": "1"}, 240)
 
@@ -1339,6 +1523,7 @@ def main() -> None:
         "qos_mixed": qos_mixed,
         "kv_disagg": kv_disagg,
         "rolling_restart": rolling_restart,
+        "replay": replay,
         "collective": coll,
         "self_tune": self_tune,
     }))

@@ -1,3 +1,4 @@
+from brpc_tpu.rpc import capture  # noqa: F401
 from brpc_tpu.rpc import collective  # noqa: F401
 from brpc_tpu.rpc import fault  # noqa: F401
 from brpc_tpu.rpc import kv  # noqa: F401

@@ -593,6 +593,23 @@ def load_library() -> ctypes.CDLL:
             lib.trpc_server_enable_tuner.restype = ctypes.c_int
             lib.trpc_tuner_reset.argtypes = []
             lib.trpc_tuner_reset.restype = None
+            # Traffic capture (capi/capture_capi.cc; stat/capture.h).
+            lib.trpc_capture_enabled.restype = ctypes.c_int
+            lib.trpc_capture_dump.argtypes = [
+                ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.trpc_capture_dump.restype = ctypes.c_size_t
+            lib.trpc_capture_dump_file.argtypes = [ctypes.c_char_p]
+            lib.trpc_capture_dump_file.restype = ctypes.c_longlong
+            lib.trpc_capture_counters.argtypes = [
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.trpc_capture_counters.restype = None
+            lib.trpc_capture_reset.argtypes = []
+            lib.trpc_capture_reset.restype = None
             lib.trpc_trace_get.argtypes = [
                 ctypes.POINTER(ctypes.c_uint64),
                 ctypes.POINTER(ctypes.c_uint64),

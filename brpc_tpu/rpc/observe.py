@@ -337,7 +337,13 @@ TIMELINE_EVENTS = {
     23: "coll_step",      # timeline-event 23 (coll_step)
     24: "tuner_decision",  # timeline-event 24 (tuner_decision)
     25: "deadline",       # timeline-event 25 (deadline)
+    26: "capture",        # timeline-event 26 (capture)
 }
+
+# kCapture `b` op tags (cpp/stat/capture.cc: b = op << 56 | request
+# bytes, or records written for "dump") — traffic-capture reservoir
+# keep/drop decisions and file dumps.
+TIMELINE_CAPTURE_OPS = {1: "keep", 2: "drop", 3: "dump"}
 
 # kKvBlock `b` op tags (cpp/net/kvstore.h: b = op << 56 | payload len) —
 # how a kv_block event reads: the store published / served / evicted a

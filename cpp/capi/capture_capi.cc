@@ -1,0 +1,65 @@
+// C ABI for the traffic-capture plane (stat/capture.h) — Python ctypes
+// binding surface, brpc_tpu/rpc/capture.py.
+//
+// Buffer protocol: capi/capi_util.h copy_out — dump calls return the
+// FULL byte length; a caller seeing ret >= out_len re-calls bigger.
+#include <cstdint>
+#include <string>
+
+#include "capi/capi_util.h"
+#include "stat/capture.h"
+
+using namespace trpc;
+using trpc::capi::copy_out;
+
+extern "C" {
+
+// 1 while the trpc_capture flag is on (requests are being recorded).
+int trpc_capture_enabled() {
+  capture::ensure_registered();
+  return capture::enabled() ? 1 : 0;
+}
+
+// The /capture body, in-process: {"enabled", counters, flags, "summary"
+// (arrival-process + per-tenant baseline), "records" (newest
+// `max_records`) when max_records > 0}.  Served even while capture is
+// off — the reservoir may hold an earlier enabled window.
+size_t trpc_capture_dump(size_t max_records, char* out, size_t out_len) {
+  if (max_records > (1u << 16)) {
+    max_records = 1u << 16;
+  }
+  return copy_out(capture::dump_json(max_records), out, out_len);
+}
+
+// Writes the reservoir to a recordio capture file (header record +
+// binary records).  Returns records written, or -1 on I/O error.
+long long trpc_capture_dump_file(const char* path) {
+  if (path == nullptr) {
+    return -1;
+  }
+  return capture::dump_file(path);
+}
+
+// Lifetime admission counters (the capture_* vars, one crossing) plus
+// the records currently held.
+void trpc_capture_counters(uint64_t* seen, uint64_t* sampled,
+                           uint64_t* dropped, uint64_t* records) {
+  if (seen != nullptr) {
+    *seen = capture::seen_total();
+  }
+  if (sampled != nullptr) {
+    *sampled = capture::sampled_total();
+  }
+  if (dropped != nullptr) {
+    *dropped = capture::dropped_total();
+  }
+  if (records != nullptr) {
+    *records = capture::records_held();
+  }
+}
+
+// Test/windowing support: clears the reservoir, window counters and the
+// sampling decision index (lifetime capture_*_total vars keep counting).
+void trpc_capture_reset() { capture::reset(); }
+
+}  // extern "C"

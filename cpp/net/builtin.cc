@@ -26,6 +26,7 @@
 #include "net/server.h"
 #include "net/socket.h"
 #include "net/span.h"
+#include "stat/capture.h"
 #include "stat/heap_profiler.h"
 #include "stat/profiler.h"
 #include "stat/timeline.h"
@@ -353,6 +354,47 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
     }
     return true;
   }
+  if (path == "/capture") {
+    // Traffic capture (stat/capture.h): arrival-process summary +
+    // per-tenant baseline over the records held while the reloadable
+    // trpc_capture flag was on (flip it via
+    // /flags/trpc_capture?setvalue=true).  Served even while capture is
+    // off — the reservoir may hold an earlier enabled window.
+    // ?records=N embeds the newest N records (max 65536);
+    // ?dump=<path> writes the binary capture file server-side and
+    // answers {"dumped": N}; ?reset=1 clears the window.
+    const std::string* dq = req.query("dump");
+    if (dq != nullptr && !dq->empty()) {
+      const int64_t n = capture::dump_file(*dq);
+      if (n < 0) {
+        *status = 500;
+        *body = "cannot write " + *dq + "\n";
+        return true;
+      }
+      *body = "{\"dumped\": " + std::to_string(n) + "}";
+      *content_type = "application/json";
+      return true;
+    }
+    const std::string* rq = req.query("reset");
+    if (rq != nullptr && *rq == "1") {
+      capture::reset();
+      *body = "{\"reset\": true}";
+      *content_type = "application/json";
+      return true;
+    }
+    size_t records = 0;
+    const std::string* nq = req.query("records");
+    if (nq != nullptr) {
+      const long v = atol(nq->c_str());
+      if (v > 0) {
+        records = std::min(static_cast<size_t>(v),
+                           static_cast<size_t>(1 << 16));
+      }
+    }
+    *body = capture::dump_json(records);
+    *content_type = "application/json";
+    return true;
+  }
   if (path == "/tuner") {
     // Self-tuning controller (stat/tuner.h): status, live rule table,
     // sampled inputs and the structured decision journal, recorded
@@ -576,6 +618,7 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
         "/memory\n/list\n/protobufs\n/index\n"
         "/rpcz[?trace_id=hex&format=json&limit=N]\n"
         "/timeline[?format=binary&limit=N]\n"
+        "/capture[?records=N&dump=path&reset=1]\n"
         "/tuner[?limit=N]\n"
         "/faults[?set=spec&server=spec&reset=1]\n"
         "/hotspots[?seconds=N]\n/contention\n/analysis\n/fibers\n"

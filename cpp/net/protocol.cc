@@ -9,6 +9,7 @@
 
 #include "base/logging.h"
 #include "net/socket.h"
+#include "stat/capture.h"
 
 namespace trpc {
 
@@ -284,10 +285,12 @@ ParseError tstd_parse(IOBuf* source, InputMessage* out, Socket* sock) {
   if (!decode_meta(meta_bytes, &out->meta)) {
     return ParseError::kCorrupted;
   }
-  if (out->meta.deadline_us != 0) {
+  if (out->meta.deadline_us != 0 || capture::enabled()) {
     // Anchor the relative budget to OUR clock at cut time: queueing
     // (QoS lanes, dispatch backlog) then counts against it.  Unstamped
-    // traffic skips the clock read.
+    // traffic skips the clock read — unless traffic capture is on,
+    // which needs a parse-time arrival for every request so recorded
+    // queue time and inter-arrival gaps are honest.
     out->arrival_us = monotonic_time_us();
   }
   source->cutn(&out->payload, payload_len);

@@ -35,6 +35,7 @@
 #include "net/ici_transport.h"
 #include "net/shm_transport.h"
 #include "net/span.h"
+#include "stat/capture.h"
 #include "stat/timeline.h"
 #include "net/stream.h"
 #include "net/rma.h"
@@ -1122,8 +1123,22 @@ void tstd_process_request(InputMessage&& msg) {
   if (srv != nullptr) {
     srv->in_flight.fetch_add(1, std::memory_order_acq_rel);
   }
+  // Traffic capture (stat/capture.h): freeze the pre-dispatch facts now
+  // — msg.payload is consumed below.  done() submits the record so it
+  // also carries status, response bytes and handler latency; shed paths
+  // run done() too, so the recorded error mix covers kEOverloaded /
+  // kEDeadlineExpired sheds, not just handler outcomes.
+  const bool cap_on = capture::enabled();
+  const int64_t cap_arrival =
+      msg.arrival_us != 0 ? msg.arrival_us : start_us;
+  const uint64_t cap_req_bytes = msg.payload.size();
+  const uint32_t cap_budget = static_cast<uint32_t>(
+      std::min<uint64_t>(msg.meta.deadline_us, 0xffffffffull));
+  const uint64_t cap_trace = msg.meta.trace_id;
+  const uint64_t cap_pspan = msg.meta.span_id;
   Closure done = [socket_id, cid, cntl, response, start_us, srv, lat,
-                  limiter, gov, tenant_entry, span] {
+                  limiter, gov, tenant_entry, span, cap_on, cap_arrival,
+                  cap_req_bytes, cap_budget, cap_trace, cap_pspan] {
     RpcMeta meta;
     meta.type = RpcMeta::kResponse;
     meta.correlation_id = cid;
@@ -1197,6 +1212,24 @@ void tstd_process_request(InputMessage&& msg) {
     }
     if (lat != nullptr) {
       *lat << latency_us;
+    }
+    if (cap_on && capture::enabled()) {
+      capture::Sample cs;
+      cs.arrival_mono_us = cap_arrival;
+      cs.trace_id = cap_trace;
+      cs.parent_span_id = cap_pspan;
+      cs.request_bytes = cap_req_bytes;
+      cs.response_bytes = response_bytes;
+      cs.status = cntl->error_code();
+      cs.queue_us = static_cast<uint32_t>(
+          std::max<int64_t>(0, start_us - cap_arrival));
+      cs.handler_us =
+          static_cast<uint32_t>(std::max<int64_t>(0, latency_us));
+      cs.deadline_budget_us = cap_budget;
+      cs.priority = cntl->qos_priority();
+      cs.method = cntl->method();
+      cs.tenant = cntl->qos_tenant();
+      capture::record(std::move(cs));
     }
     if (span != nullptr) {
       span->response_bytes = response_bytes;

@@ -88,6 +88,10 @@ enum EventType : uint32_t {
                    // b=(op << 56) | detail; ops: kDeadlineShed* below.
                    // The deadline plane's shed / cancel-fan-out /
                    // suppression decisions (net/deadline.h)
+  // -- traffic capture (stat/capture.h) ----------------------------------
+  kCapture = 26,  // a=trace id, b=(op << 56) | request bytes; ops:
+                  // 1 keep (record retained), 2 drop (reservoir full),
+                  // 3 dump (b low bits = records written)
   kEventTypeCount,
 };
 
@@ -127,6 +131,7 @@ constexpr const char* kEventNames[] = {
     "coll_step",       // timeline-event 23 (coll_step)
     "tuner_decision",  // timeline-event 24 (tuner_decision)
     "deadline",        // timeline-event 25 (deadline)
+    "capture",         // timeline-event 26 (capture)
 };
 static_assert(sizeof(kEventNames) / sizeof(kEventNames[0]) ==
                   kEventTypeCount,

@@ -1,0 +1,355 @@
+// Traffic-capture tests (stat/capture.h, ISSUE 16): flag-off
+// invisibility (vars frozen at 0), deterministic sampling under a
+// seeded stream, per-tenant stratified quotas with exact drop
+// accounting, capture-file roundtrip including the tail-group metadata
+// (tenant/priority/deadline budget/trace ids), bounded memory under
+// 64MB bodies, and an end-to-end pass over a live server with QoS-
+// tagged + deadline-stamped traffic.  Also runs under TSan via
+// tests/test_cpp.py (record() contends with concurrent dumps by
+// design).
+#include "stat/capture.h"
+
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "base/flags.h"
+#include "base/json.h"
+#include "base/recordio.h"
+#include "net/channel.h"
+#include "net/server.h"
+#include "stat/variable.h"
+#include "tests/test_util.h"
+
+using namespace trpc;
+
+namespace {
+
+Server* g_server = nullptr;
+int g_port = 0;
+
+void start_once() {
+  if (g_server != nullptr) {
+    return;
+  }
+  g_server = new Server();
+  g_server->RegisterMethod("Echo.Echo", [](Controller*, const IOBuf& req,
+                                           IOBuf* resp, Closure done) {
+    resp->append(req);
+    done();
+  });
+  EXPECT_EQ(g_server->Start(0), 0);
+  g_port = g_server->port();
+}
+
+std::string addr() { return "127.0.0.1:" + std::to_string(g_port); }
+
+void set_capture(bool on) {
+  capture::ensure_registered();
+  EXPECT_EQ(Flag::set("trpc_capture", on ? "true" : "false"), 0);
+}
+
+capture::Sample make_sample(uint64_t i, const std::string& tenant) {
+  capture::Sample s;
+  s.arrival_mono_us = static_cast<int64_t>(1000000 + i);
+  s.arrival_wall_us = static_cast<int64_t>(1754000000000000ull + i);
+  s.trace_id = i + 1;  // identity marker for determinism checks
+  s.parent_span_id = i * 3;
+  s.request_bytes = 1024 + i;
+  s.response_bytes = 2048 + i;
+  s.status = i % 7 == 0 ? 2005 : 0;
+  s.queue_us = static_cast<uint32_t>(i % 50);
+  s.handler_us = static_cast<uint32_t>(100 + i % 900);
+  s.deadline_budget_us = static_cast<uint32_t>(i % 2 == 0 ? 250000 : 0);
+  s.priority = static_cast<uint8_t>(i % 3);
+  s.method = "Echo.Echo";
+  s.tenant = tenant;
+  return s;
+}
+
+std::set<uint64_t> kept_trace_ids() {
+  Json root;
+  EXPECT(Json::parse(capture::dump_json(1 << 17), &root));
+  const Json* recs = root.find("records");
+  EXPECT(recs != nullptr);
+  std::set<uint64_t> out;
+  for (size_t i = 0; i < recs->size(); ++i) {
+    out.insert(strtoull((*recs)[i].find("trace_id")->as_string().c_str(),
+                        nullptr, 16));
+  }
+  return out;
+}
+
+}  // namespace
+
+TEST_CASE(capture_flag_off_invisible) {
+  // MUST run first (registration order): proves the default-off
+  // recorder retains nothing — vars frozen at 0 — while real traffic
+  // flows.
+  capture::ensure_registered();
+  EXPECT(!capture::enabled());
+  start_once();
+  Channel ch;
+  Channel::Options opts;
+  opts.timeout_ms = 30000;
+  EXPECT_EQ(ch.Init(addr(), &opts), 0);
+  for (int i = 0; i < 32; ++i) {
+    Controller cntl;
+    IOBuf req, resp;
+    req.append("ping");
+    ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+  }
+  EXPECT_EQ(capture::seen_total(), 0u);
+  EXPECT_EQ(capture::sampled_total(), 0u);
+  EXPECT_EQ(capture::dropped_total(), 0u);
+  EXPECT_EQ(capture::records_held(), 0u);
+  std::string v;
+  EXPECT(Variable::read_exposed("capture_seen_total", &v));
+  EXPECT(v == "0");
+  EXPECT(Variable::read_exposed("capture_dropped_total", &v));
+  EXPECT(v == "0");
+  // record() offered while off is a no-op, not a crash.
+  capture::record(make_sample(0, "t"));
+  EXPECT_EQ(capture::records_held(), 0u);
+}
+
+TEST_CASE(capture_record_serialize_roundtrip) {
+  // The binary record layout must carry every tail-group-derived field
+  // (tenant/priority from group 5, deadline budget from group 7, trace
+  // ids) bit-exactly through serialize -> parse.
+  capture::Sample in = make_sample(41, "tenant-α");
+  in.method = "Model.Forward";
+  IOBuf buf;
+  capture::serialize_record(in, &buf);
+  capture::Sample out;
+  EXPECT(capture::parse_record(buf, &out));
+  EXPECT_EQ(out.arrival_mono_us, in.arrival_mono_us);
+  EXPECT_EQ(out.arrival_wall_us, in.arrival_wall_us);
+  EXPECT_EQ(out.trace_id, in.trace_id);
+  EXPECT_EQ(out.parent_span_id, in.parent_span_id);
+  EXPECT_EQ(out.request_bytes, in.request_bytes);
+  EXPECT_EQ(out.response_bytes, in.response_bytes);
+  EXPECT_EQ(out.status, in.status);
+  EXPECT_EQ(out.queue_us, in.queue_us);
+  EXPECT_EQ(out.handler_us, in.handler_us);
+  EXPECT_EQ(out.deadline_budget_us, in.deadline_budget_us);
+  EXPECT_EQ(out.priority, in.priority);
+  EXPECT(out.method == in.method);
+  EXPECT(out.tenant == in.tenant);
+  // Truncated payloads are rejected, not mis-parsed.
+  IOBuf trunc;
+  std::string flat = buf.to_string();
+  trunc.append(flat.data(), flat.size() - 3);
+  capture::Sample bad;
+  EXPECT(!capture::parse_record(trunc, &bad));
+}
+
+TEST_CASE(capture_sampling_determinism) {
+  // Same seed + same stream => the SAME kept set, twice.  The admission
+  // hash and the reservoir eviction slots both key off the per-window
+  // decision index, so a seeded stream is exactly reproducible.
+  EXPECT_EQ(Flag::set("trpc_capture_max_records", "256"), 0);
+  EXPECT_EQ(Flag::set("trpc_capture_sample_permille", "500"), 0);
+  EXPECT_EQ(Flag::set("trpc_capture_seed", "42"), 0);
+  set_capture(true);
+  capture::reset();
+  for (uint64_t i = 0; i < 2000; ++i) {
+    capture::record(make_sample(i, "det"));
+  }
+  const std::set<uint64_t> first = kept_trace_ids();
+  EXPECT(first.size() > 0);
+  EXPECT(first.size() <= 256);
+  capture::reset();
+  for (uint64_t i = 0; i < 2000; ++i) {
+    capture::record(make_sample(i, "det"));
+  }
+  const std::set<uint64_t> second = kept_trace_ids();
+  EXPECT(first == second);
+  // A different seed keeps a different set (sanity that the seed is
+  // actually in the hash, not a constant).
+  EXPECT_EQ(Flag::set("trpc_capture_seed", "43"), 0);
+  capture::reset();
+  for (uint64_t i = 0; i < 2000; ++i) {
+    capture::record(make_sample(i, "det"));
+  }
+  EXPECT(kept_trace_ids() != first);
+  set_capture(false);
+  capture::reset();
+  EXPECT_EQ(Flag::set("trpc_capture_sample_permille", "1000"), 0);
+  EXPECT_EQ(Flag::set("trpc_capture_seed", "1"), 0);
+}
+
+TEST_CASE(capture_stratified_quota_and_drop_accounting) {
+  // 3 tenants with a 100:10:1 traffic skew into a 256-slot reservoir:
+  // stratification must hold every tenant near capacity/3 (the minority
+  // tenant keeps EVERYTHING it sent), and the drop accounting must be
+  // exact — kept == sampled - dropped, never silent thinning.
+  EXPECT_EQ(Flag::set("trpc_capture_max_records", "256"), 0);
+  set_capture(true);
+  capture::reset();
+  const uint64_t before_sampled = capture::sampled_total();
+  const uint64_t before_dropped = capture::dropped_total();
+  uint64_t id = 0;
+  for (int round = 0; round < 3000; ++round) {
+    capture::record(make_sample(id++, "heavy"));
+    if (round % 10 == 0) {
+      capture::record(make_sample(id++, "mid"));
+    }
+    if (round % 100 == 0) {
+      capture::record(make_sample(id++, "rare"));
+    }
+  }
+  Json root;
+  EXPECT(Json::parse(capture::dump_json(0), &root));
+  const Json* tenants = root.find("summary")->find("tenants");
+  EXPECT(tenants != nullptr);
+  const size_t heavy = static_cast<size_t>(
+      tenants->find("heavy")->find("kept")->as_number());
+  const size_t mid = static_cast<size_t>(
+      tenants->find("mid")->find("kept")->as_number());
+  const size_t rare = static_cast<size_t>(
+      tenants->find("rare")->find("kept")->as_number());
+  // Quota = 256/3 = 85.  heavy and mid both saturate it; rare sent only
+  // 30 and keeps every one (stratification = minority tenants are never
+  // crowded out by the heavy hitter).
+  EXPECT(heavy <= 86);
+  EXPECT(heavy >= 80);
+  EXPECT(mid <= 86);
+  EXPECT(mid >= 80);
+  EXPECT_EQ(rare, 30u);
+  const uint64_t sampled = capture::sampled_total() - before_sampled;
+  const uint64_t dropped = capture::dropped_total() - before_dropped;
+  EXPECT_EQ(capture::records_held(), heavy + mid + rare);
+  // Exact coverage accounting: every sampled record is either held or
+  // counted dropped.
+  EXPECT_EQ(sampled - dropped, static_cast<uint64_t>(heavy + mid + rare));
+  EXPECT(dropped > 0);
+  set_capture(false);
+  capture::reset();
+}
+
+TEST_CASE(capture_bounded_memory_under_64mb_bodies) {
+  // A record of a 64MB request must cost ~100 bytes of reservoir
+  // memory: sizes are kept as integers, strings clamp to 64 bytes.
+  EXPECT_EQ(Flag::set("trpc_capture_max_records", "1024"), 0);
+  set_capture(true);
+  capture::reset();
+  for (uint64_t i = 0; i < 1024; ++i) {
+    capture::Sample s = make_sample(i, std::string(300, 't'));
+    s.method = std::string(300, 'm');
+    s.request_bytes = 64ull << 20;
+    s.response_bytes = 64ull << 20;
+    capture::record(std::move(s));
+  }
+  EXPECT_EQ(capture::records_held(), 1024u);
+  // 1024 records of 64MB traffic: the reservoir must stay under 1MB.
+  EXPECT(capture::approx_bytes() < (1u << 20));
+  Json root;
+  EXPECT(Json::parse(capture::dump_json(1), &root));
+  const Json* recs = root.find("records");
+  EXPECT_EQ(recs->size(), 1u);
+  EXPECT_EQ((*recs)[0].find("tenant")->as_string().size(), 64u);
+  EXPECT_EQ((*recs)[0].find("method")->as_string().size(), 64u);
+  set_capture(false);
+  capture::reset();
+  EXPECT_EQ(Flag::set("trpc_capture_max_records", "65536"), 0);
+}
+
+TEST_CASE(capture_file_roundtrip_via_recordio) {
+  // dump_file -> RecordReader + parse_record must reproduce the
+  // reservoir exactly, with the JSON header carrying the window
+  // counters and the per-tenant baseline.
+  set_capture(true);
+  capture::reset();
+  for (uint64_t i = 0; i < 100; ++i) {
+    capture::record(make_sample(i, i % 2 == 0 ? "a" : "b"));
+  }
+  char path[] = "/tmp/trpc_capture_test_XXXXXX";
+  const int fd = mkstemp(path);
+  EXPECT(fd >= 0);
+  close(fd);
+  EXPECT_EQ(capture::dump_file(path), 100);
+  RecordReader reader(path);
+  EXPECT(reader.valid());
+  IOBuf head;
+  EXPECT(reader.read(&head));
+  const std::string hs = head.to_string();
+  EXPECT(hs.size() > 8);
+  EXPECT_EQ(hs.compare(0, 8, capture::kFileMagic, 8), 0);
+  Json header;
+  EXPECT(Json::parse(hs.substr(8), &header));
+  EXPECT_EQ(header.find("counters")->find("window_sampled")->as_number(),
+            100.0);
+  EXPECT(header.find("summary")->find("tenants")->find("a") != nullptr);
+  size_t n = 0;
+  int64_t prev_arrival = 0;
+  IOBuf rec;
+  while (reader.read(&rec)) {
+    capture::Sample s;
+    EXPECT(capture::parse_record(rec, &s));
+    EXPECT(s.arrival_mono_us >= prev_arrival);  // arrival order
+    prev_arrival = s.arrival_mono_us;
+    EXPECT(s.tenant == "a" || s.tenant == "b");
+    EXPECT(s.method == "Echo.Echo");
+    rec.clear();
+    n++;
+  }
+  EXPECT_EQ(n, 100u);
+  std::remove(path);
+  set_capture(false);
+  capture::reset();
+}
+
+TEST_CASE(capture_e2e_live_server_with_qos_and_deadline) {
+  // Live traffic: QoS-tagged + deadline-stamped calls over a real
+  // connection must land in the reservoir with tenant, priority,
+  // budget, sizes and latency filled by the server-side hook.
+  start_once();
+  set_capture(true);
+  capture::reset();
+  Channel ch;
+  Channel::Options opts;
+  opts.timeout_ms = 30000;
+  EXPECT_EQ(ch.Init(addr(), &opts), 0);
+  for (int i = 0; i < 40; ++i) {
+    Controller cntl;
+    cntl.set_qos("fg", 1);
+    cntl.set_timeout_ms(5000);  // stamps tail-group 7
+    IOBuf req, resp;
+    req.append(std::string(1024, 'x'));
+    ch.CallMethod("Echo.Echo", req, &resp, &cntl);
+    EXPECT(!cntl.Failed());
+  }
+  EXPECT(capture::seen_total() >= 40);
+  EXPECT(capture::records_held() >= 40);
+  Json root;
+  EXPECT(Json::parse(capture::dump_json(1 << 12), &root));
+  const Json* tenants = root.find("summary")->find("tenants");
+  const Json* fg = tenants->find("fg");
+  EXPECT(fg != nullptr);
+  EXPECT(fg->find("kept")->as_number() >= 40);
+  EXPECT(fg->find("p99_us")->as_number() > 0);
+  const Json* recs = root.find("records");
+  bool saw_budget = false;
+  for (size_t i = 0; i < recs->size(); ++i) {
+    const Json& r = (*recs)[i];
+    if (r.find("tenant")->as_string() != "fg") {
+      continue;
+    }
+    EXPECT(r.find("method")->as_string() == "Echo.Echo");
+    EXPECT_EQ(r.find("priority")->as_number(), 1.0);
+    EXPECT_EQ(r.find("request_bytes")->as_number(), 1024.0);
+    EXPECT_EQ(r.find("response_bytes")->as_number(), 1024.0);
+    saw_budget |= r.find("deadline_budget_us")->as_number() > 0;
+  }
+  EXPECT(saw_budget);  // tail-group 7 budget made it into the records
+  set_capture(false);
+  capture::reset();
+}
+
+TEST_MAIN

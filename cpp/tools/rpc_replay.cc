@@ -1,27 +1,173 @@
-// rpc_replay — re-sends rpc_dump'd traffic (parity: tools/rpc_replay).
+// rpc_replay — re-sends recorded traffic (parity: tools/rpc_replay).
 //
-// Usage: rpc_replay <recordio_file> <addr|list://...> [qps=0(max)] [lb=rr]
-// Each record is a full tstd request frame written by Server::EnableDump.
-#include <cstdio>
-#include <cstdlib>
+// Usage: rpc_replay <file> <addr|list://...> [time_scale=1.0] [lb=rr]
+//
+// Two input formats, auto-detected from record 0:
+//
+//   - capture files ("TRPCCAP1", stat/capture.h): per-request METADATA
+//     records from the trpc_capture tier.  Replayed OPEN-LOOP at the
+//     recorded inter-arrival offsets (divided by time_scale), with the
+//     recorded tenant/priority re-stamped as wire tail-group 5
+//     (cntl->set_qos) and the recorded deadline budget as tail-group 7
+//     (cntl->set_timeout_ms) on every call.  Bodies are synthetic
+//     ('x'-fill at the recorded request size).
+//
+//   - body dumps (raw tstd frames from Server::EnableDump): replayed
+//     open-loop at the fixed rate given by time_scale (interpreted as
+//     qps; 0 = as fast as possible).  No recorded timestamps exist in
+//     this format.
+//
+// Open-loop means calls are issued asynchronously on schedule and never
+// paced by their responses — a slow or overloaded server sees the full
+// offered rate (and sheds), exactly as in production.  The old
+// closed-loop sync sender self-throttled and hid overload.
 #include <unistd.h>
 
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
 #include <string>
 
+#include "base/iobuf.h"
 #include "base/recordio.h"
 #include "base/time.h"
 #include "net/cluster.h"
+#include "net/concurrency_limiter.h"
+#include "net/deadline.h"
 #include "net/protocol.h"
+#include "stat/capture.h"
 
 using namespace trpc;
 
+namespace {
+
+// Memory backstop only — pacing is unaffected below it.
+constexpr long kMaxInFlight = 4096;
+constexpr uint64_t kMaxReplayBody = 16ull << 20;
+
+std::atomic<long> g_inflight{0};
+std::atomic<long> g_ok{0};
+std::atomic<long> g_shed{0};    // typed: kELimit/kEOverloaded/kEDraining/
+                                //        kEDeadlineExpired
+std::atomic<long> g_failed{0};  // untyped — a regression under replay
+
+bool is_typed_shed(int code) {
+  return code == kELimit || code == kEOverloaded || code == kEDraining ||
+         code == kEDeadlineExpired;
+}
+
+// Issues one async call; the done closure owns cntl/resp and feeds the
+// tallies, so the send loop never waits on a response.
+void issue(ClusterChannel* ch, const std::string& method,
+           const IOBuf& payload, const std::string& tenant, uint8_t priority,
+           uint32_t budget_us) {
+  while (g_inflight.load(std::memory_order_relaxed) >= kMaxInFlight) {
+    usleep(200);
+  }
+  auto* cntl = new Controller;
+  auto* resp = new IOBuf;
+  if (!tenant.empty() || priority != 0) cntl->set_qos(tenant, priority);
+  if (budget_us != 0) {
+    cntl->set_timeout_ms(budget_us < 1000 ? 1 : budget_us / 1000);
+  }
+  g_inflight.fetch_add(1, std::memory_order_relaxed);
+  ch->CallMethod(method, payload, resp, cntl, [cntl, resp] {
+    if (!cntl->Failed()) {
+      g_ok.fetch_add(1, std::memory_order_relaxed);
+    } else if (is_typed_shed(cntl->error_code())) {
+      g_shed.fetch_add(1, std::memory_order_relaxed);
+    } else {
+      g_failed.fetch_add(1, std::memory_order_relaxed);
+    }
+    delete resp;
+    delete cntl;
+    g_inflight.fetch_sub(1, std::memory_order_relaxed);
+  });
+}
+
+const IOBuf& synthetic_body(uint64_t size) {
+  static std::map<uint64_t, IOBuf> cache;
+  if (size > kMaxReplayBody) size = kMaxReplayBody;
+  auto it = cache.find(size);
+  if (it == cache.end()) {
+    std::string fill(static_cast<size_t>(size), 'x');
+    it = cache.emplace(size, IOBuf()).first;
+    it->second.append(fill);
+  }
+  return it->second;
+}
+
+long replay_capture(RecordReader* reader, ClusterChannel* ch,
+                    double time_scale) {
+  long sent = 0;
+  int64_t first_arrival = -1;
+  const int64_t t0 = monotonic_time_us();
+  IOBuf record;
+  while (reader->read(&record)) {
+    capture::Sample s;
+    if (!capture::parse_record(record, &s)) {
+      fprintf(stderr, "corrupt capture record #%ld, stopping\n", sent);
+      break;
+    }
+    record.clear();
+    if (first_arrival < 0) first_arrival = s.arrival_mono_us;
+    const int64_t target =
+        t0 + static_cast<int64_t>((s.arrival_mono_us - first_arrival) /
+                                  time_scale);
+    const int64_t now = monotonic_time_us();
+    if (now < target) usleep(static_cast<useconds_t>(target - now));
+    issue(ch, s.method.empty() ? "Echo.Echo" : s.method,
+          synthetic_body(s.request_bytes), s.tenant, s.priority,
+          s.deadline_budget_us);
+    ++sent;
+  }
+  return sent;
+}
+
+long replay_bodies(RecordReader* reader, ClusterChannel* ch, double qps) {
+  long sent = 0;
+  const int64_t t0 = monotonic_time_us();
+  int64_t next = t0;
+  IOBuf record;
+  while (reader->read(&record)) {
+    InputMessage msg;
+    if (tstd_protocol().parse(&record, &msg, nullptr) != ParseError::kOk) {
+      fprintf(stderr, "corrupt record #%ld, stopping\n", sent);
+      break;
+    }
+    record.clear();
+    if (qps > 0) {
+      const int64_t now = monotonic_time_us();
+      if (now < next) usleep(static_cast<useconds_t>(next - now));
+      next += static_cast<int64_t>(1000000 / qps);
+    }
+    // Carry the captured tail-groups: a dumped frame's meta already
+    // holds tenant/priority (group 5) and deadline budget (group 7).
+    issue(ch, msg.meta.method, msg.payload, msg.meta.qos_tenant,
+          msg.meta.qos_priority,
+          static_cast<uint32_t>(
+              msg.meta.deadline_us > 0xffffffffll ? 0xffffffffll
+                                                  : msg.meta.deadline_us));
+    ++sent;
+  }
+  return sent;
+}
+
+}  // namespace
+
 int main(int argc, char** argv) {
   if (argc < 3) {
-    fprintf(stderr, "usage: %s <file> <addr|list://...> [qps=0] [lb=rr]\n",
+    fprintf(stderr,
+            "usage: %s <file> <addr|list://...> [time_scale=1.0] [lb=rr]\n"
+            "  capture files (TRPCCAP1): open-loop at recorded offsets /"
+            " time_scale\n  body dumps: open-loop at time_scale qps"
+            " (0 = max)\n",
             argv[0]);
     return 1;
   }
-  const long qps = argc > 3 ? atol(argv[3]) : 0;
+  const double time_scale = argc > 3 ? atof(argv[3]) : 1.0;
   ClusterChannel ch;
   ClusterChannel::Options opts;
   opts.timeout_ms = 5000;
@@ -34,32 +180,49 @@ int main(int argc, char** argv) {
     fprintf(stderr, "cannot open %s\n", argv[1]);
     return 1;
   }
-  long sent = 0, ok = 0;
+  // Record 0 decides the format: capture header vs first tstd frame.
+  IOBuf head;
+  if (!reader.read(&head)) {
+    fprintf(stderr, "empty file %s\n", argv[1]);
+    return 1;
+  }
+  std::string head_str = head.to_string();
+  const bool is_capture =
+      head_str.size() >= strlen(capture::kFileMagic) &&
+      memcmp(head_str.data(), capture::kFileMagic,
+             strlen(capture::kFileMagic)) == 0;
+
   const int64_t t0 = monotonic_time_us();
-  int64_t next = t0;
-  IOBuf record;
-  while (reader.read(&record)) {
+  long sent = 0;
+  if (is_capture) {
+    sent = replay_capture(&reader, &ch, time_scale > 0 ? time_scale : 1.0);
+  } else {
+    // Not a capture header: record 0 is itself a dumped frame — rewind
+    // is not possible on the streaming reader, so replay it first.
     InputMessage msg;
-    if (tstd_protocol().parse(&record, &msg, nullptr) != ParseError::kOk) {
-      fprintf(stderr, "corrupt record #%ld, stopping\n", sent);
-      break;
+    if (tstd_protocol().parse(&head, &msg, nullptr) == ParseError::kOk) {
+      issue(&ch, msg.meta.method, msg.payload, msg.meta.qos_tenant,
+            msg.meta.qos_priority, 0);
+      ++sent;
     }
-    record.clear();
-    if (qps > 0) {
-      const int64_t now = monotonic_time_us();
-      if (now < next) {
-        usleep(static_cast<useconds_t>(next - now));
-      }
-      next += 1000000 / qps;
-    }
-    Controller cntl;
-    IOBuf resp;
-    ch.CallMethod(msg.meta.method, msg.payload, &resp, &cntl);
-    ++sent;
-    ok += !cntl.Failed();
+    sent += replay_bodies(&reader, &ch, time_scale);
+  }
+
+  // Drain: everything in flight completes or times out (5s timeout on
+  // the channel bounds this).
+  const int64_t drain_deadline = monotonic_time_us() + 10 * 1000000;
+  while (g_inflight.load(std::memory_order_acquire) > 0 &&
+         monotonic_time_us() < drain_deadline) {
+    usleep(1000);
   }
   const double secs = (monotonic_time_us() - t0) / 1e6;
-  printf("{\"replayed\": %ld, \"ok\": %ld, \"qps\": %.0f}\n", sent, ok,
-         sent / secs);
-  return 0;
+  printf(
+      "{\"mode\": \"%s\", \"replayed\": %ld, \"ok\": %ld, \"shed\": %ld, "
+      "\"failed\": %ld, \"undrained\": %ld, \"qps\": %.0f}\n",
+      is_capture ? "capture" : "bodies", sent,
+      g_ok.load(std::memory_order_relaxed),
+      g_shed.load(std::memory_order_relaxed),
+      g_failed.load(std::memory_order_relaxed),
+      g_inflight.load(std::memory_order_relaxed), sent / (secs > 0 ? secs : 1));
+  return g_failed.load(std::memory_order_relaxed) == 0 ? 0 : 2;
 }

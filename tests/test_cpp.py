@@ -39,7 +39,7 @@ _NO_CMAKE = shutil.which("cmake") is None or shutil.which("ctest") is None
 TSAN_SUITES = [
     "fiber", "rpc", "stream", "shm", "ici", "chaos", "stat", "qos",
     "stripe", "analysis", "timeline", "rma", "kvstore", "naming",
-    "collective", "tuner", "deadline",
+    "collective", "tuner", "deadline", "capture",
 ]
 ALL_SUITES = sorted(
     p.stem[len("test_"):] for p in (REPO / "cpp" / "tests").glob("test_*.cc")
@@ -217,6 +217,19 @@ def test_deadline_cpp_suite_native():
     insufficient remaining budget, and cancel-registry hygiene."""
     _run_native_suite("test_deadline.cc", "test_deadline_native",
                       "deadline suite")
+
+
+def test_capture_cpp_suite_native():
+    """ISSUE 16: the traffic-capture plane gates tier-1 — flag-off
+    invisibility with vars frozen at 0, binary record roundtrip
+    including tail-group metadata (tenant/priority/deadline budget/
+    trace ids), deterministic sampling under a seeded stream,
+    per-tenant stratified quotas with exact capture_dropped_total
+    accounting, bounded reservoir memory under 64MB bodies, capture-
+    file roundtrip through recordio, and the end-to-end server hook
+    recording QoS-tagged + deadline-stamped live traffic."""
+    _run_native_suite("test_capture.cc", "test_capture_native",
+                      "capture suite")
 
 
 def test_kvstore_cpp_suite_native():

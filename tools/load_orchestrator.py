@@ -34,6 +34,7 @@ import errno
 import json
 import os
 import pathlib
+import random
 import resource
 import selectors
 import socket
@@ -82,6 +83,51 @@ def parse_response(buf: bytearray):
         return None
     _type, cid, err = struct.unpack_from("<BQI", buf, 16)
     return cid, err, payload_len, frame
+
+
+# ---- capture-shape sampling ----------------------------------------------
+#
+# --shape <capture>: drive the connection storm with a RECORDED traffic
+# shape instead of the fixed small/big split — each connection samples
+# its (request size, tenant, priority) from the empirical distribution
+# in a trpc capture file (brpc_tpu/rpc/capture.py format: recordio
+# envelope, "TRPCCAP1" header record, packed metadata records).  The
+# reader is standalone on purpose: workers speak raw sockets and must
+# not import brpc_tpu.
+
+_CAP_RECORD = struct.Struct("<BqqQQQQiIIIBBB")  # capture.py RECORD_STRUCT
+
+
+def load_shape(path: str) -> list:
+    """Returns [(request_bytes, tenant: bytes, priority), ...] in
+    recorded arrival order."""
+    triples = []
+    with open(path, "rb") as f:
+        first = True
+        while True:
+            head = f.read(8)
+            if len(head) < 8:
+                break
+            if head[:4] != b"TREC":
+                raise ValueError(f"bad recordio magic in {path}")
+            (length,) = struct.unpack("<I", head[4:])
+            payload = f.read(length)
+            if len(payload) < length:
+                break
+            if first:
+                first = False
+                if not payload.startswith(b"TRPCCAP1"):
+                    raise ValueError(f"{path} is not a capture file")
+                continue
+            if not payload or payload[0] != 1:  # record version gate
+                continue
+            (_v, _am, _aw, _tid, _ps, req, _resp, _st, _q, _h, _b,
+             prio, mlen, tlen) = _CAP_RECORD.unpack_from(payload)
+            off = _CAP_RECORD.size + mlen
+            triples.append((req, payload[off:off + tlen], prio))
+    if not triples:
+        raise ValueError(f"no records in capture {path}")
+    return triples
 
 
 # ---- fd limits -----------------------------------------------------------
@@ -145,15 +191,16 @@ def run_server(args) -> None:
 # ---- worker role ---------------------------------------------------------
 
 class Conn:
-    __slots__ = ("sock", "state", "buf", "out", "echoed", "big")
+    __slots__ = ("sock", "state", "buf", "out", "echoed", "big", "shape")
 
-    def __init__(self, sock, big: bool):
+    def __init__(self, sock, big: bool, shape=None):
         self.sock = sock
         self.state = "connecting"
         self.buf = bytearray()
         self.out = b""
         self.echoed = 0
         self.big = big
+        self.shape = shape  # (request_bytes, tenant, priority) or None
 
 
 def run_worker(args) -> None:
@@ -171,6 +218,13 @@ def run_worker(args) -> None:
 
     small = b"x" * args.small_bytes
     big = b"y" * args.big_bytes
+    # Recorded traffic shape: each connection draws its (size, tenant,
+    # priority) from the capture's empirical distribution.  Seeded per
+    # worker index so a re-run offers the same sampled mix.
+    shape = load_shape(args.shape) if args.shape else None
+    shape_rng = random.Random(args.index + 1)
+    shape_cache: dict[int, bytes] = {}
+    shape_mix: dict[str, int] = {}
     sel = selectors.DefaultSelector()
     conns: dict[int, Conn] = {}
     failures = {"connect": 0, "reset": 0, "proto": 0}
@@ -184,7 +238,12 @@ def run_worker(args) -> None:
         s.setblocking(False)
         if bind_ok:
             s.bind((src_ip, 0))
-        c = Conn(s, args.big_every > 0 and i % args.big_every == 0)
+        triple = None
+        if shape is not None:
+            triple = shape[shape_rng.randrange(len(shape))]
+            tname = triple[1].decode(errors="replace")
+            shape_mix[tname] = shape_mix.get(tname, 0) + 1
+        c = Conn(s, args.big_every > 0 and i % args.big_every == 0, triple)
         try:
             rc = s.connect_ex(addr)
         except OSError:
@@ -199,10 +258,19 @@ def run_worker(args) -> None:
         sel.register(s, selectors.EVENT_WRITE, c)
 
     def start_request(c: Conn) -> None:
-        payload = big if c.big else small
-        c.out = pack_request(1, "Echo.Echo", payload,
-                             tenant=args.tenant.encode(),
-                             priority=args.priority)
+        if c.shape is not None:
+            size, tenant, priority = c.shape
+            size = min(size, args.big_bytes)  # memory backstop
+            payload = shape_cache.get(size)
+            if payload is None:
+                payload = shape_cache[size] = b"z" * size
+            c.out = pack_request(1, "Echo.Echo", payload,
+                                 tenant=tenant, priority=priority)
+        else:
+            payload = big if c.big else small
+            c.out = pack_request(1, "Echo.Echo", payload,
+                                 tenant=args.tenant.encode(),
+                                 priority=args.priority)
         sel.modify(c.sock, selectors.EVENT_WRITE | selectors.EVENT_READ, c)
 
     def pump(c: Conn) -> None:
@@ -299,6 +367,8 @@ def run_worker(args) -> None:
         "failures": failures,
         "src_bind": bind_ok,
     }
+    if shape is not None:
+        report["shape_mix"] = shape_mix
     print(json.dumps(report), flush=True)
     if args.hold > 0:
         time.sleep(args.hold)  # keep sockets open while the parent polls
@@ -719,6 +789,7 @@ def run_orchestrator(args) -> int:
              "--timeout", str(args.timeout),
              "--ramp-batch", str(args.ramp_batch),
              "--tenant", args.tenant, "--priority", str(args.priority),
+             "--shape", args.shape,
              "--hold", str(args.hold)],
             stdout=subprocess.PIPE, env=env, text=True))
 
@@ -761,6 +832,13 @@ def run_orchestrator(args) -> int:
         "shards": args.shards,
         "dispatchers": args.dispatchers,
     }
+    if args.shape:
+        mix: dict[str, int] = {}
+        for r in reports:
+            for t, n in r.get("shape_mix", {}).items():
+                mix[t] = mix.get(t, 0) + n
+        summary["shape"] = args.shape
+        summary["shape_mix"] = mix
     print(json.dumps(summary, indent=None if args.json else 2), flush=True)
     ok = (summary["wedged"] == 0 and
           summary["echoed"] == summary["connected"] and
@@ -808,6 +886,11 @@ def main() -> int:
     ap.add_argument("--qos-lanes", type=int, default=0)
     ap.add_argument("--tenant", default="")
     ap.add_argument("--priority", type=int, default=0)
+    ap.add_argument("--shape", default="",
+                    help="trpc capture file: sample each connection's "
+                         "(request size, tenant, priority) from the "
+                         "recorded empirical distribution instead of the "
+                         "fixed small/big split")
     ap.add_argument("--timeout", type=float, default=300.0,
                     help="worker ramp+verify budget (s)")
     ap.add_argument("--ramp-batch", type=int, default=256,
