@@ -623,12 +623,17 @@ def _child_kv_disagg() -> None:
     rails/lanes/rma-path config it ran under, like every BENCH series."""
     import subprocess as sp
 
-    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "tools", "kv_disagg.py")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tool = os.path.join(repo, "tools", "kv_disagg.py")
+    shape = os.path.join(repo, "tests", "data", "golden_mixed.cap")
     env = dict(os.environ)
-    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
-    out = sp.run([sys.executable, tool, "--json", "--seconds", "6"],
-                 env=env, capture_output=True, text=True, timeout=240)
+    env["PYTHONPATH"] = repo
+    cmd = [sys.executable, tool, "--json", "--seconds", "6"]
+    if os.path.exists(shape):
+        # ISSUE 17: the prefix-cache phase rides the same run, with the
+        # tenant mix shaped by the golden capture's recorded shares.
+        cmd += ["--shape", shape]
+    out = sp.run(cmd, env=env, capture_output=True, text=True, timeout=240)
     for ln in out.stdout.splitlines()[::-1]:
         if ln.startswith("{"):
             print(ln, flush=True)
@@ -1483,6 +1488,20 @@ def main() -> None:
     zerocopy = _run_json_child({"BENCH_ZC": "1"}, 60)
     qos_mixed = _run_json_child({"BENCH_QOS": "1"}, 90)
     kv_disagg = _run_json_child({"BENCH_KV": "1"}, 240)
+    # prefix_cache row (ISSUE 17): the content-addressed cache metrics
+    # measured in the SAME kv_disagg run (the goodput/p99 floors and the
+    # recompute drop must hold simultaneously), lifted into their own
+    # headline row.
+    prefix_cache = None
+    if kv_disagg and "prefix_recompute_drop" in kv_disagg:
+        prefix_cache = {
+            "workload": "prefix_cache_zipf_multitenant",
+            "same_run_as": "kv_disagg",
+            "kv_goodput_gbps": kv_disagg["kv_goodput_gbps"],
+            "ratio_p99": kv_disagg["ratio_p99"],
+        }
+        prefix_cache.update({k: v for k, v in kv_disagg.items()
+                             if k.startswith(("prefix_", "lb_hint_"))})
     rolling_restart = _run_json_child({"BENCH_RR": "1"}, 240)
     replay = _run_json_child({"BENCH_REPLAY": "1"}, 300)
     coll = _run_json_child({"BENCH_COLL": "1"}, 240)
@@ -1522,6 +1541,7 @@ def main() -> None:
         "zerocopy": zerocopy,
         "qos_mixed": qos_mixed,
         "kv_disagg": kv_disagg,
+        "prefix_cache": prefix_cache,
         "rolling_restart": rolling_restart,
         "replay": replay,
         "collective": coll,

@@ -320,6 +320,54 @@ def load_library() -> ctypes.CDLL:
             lib.trpc_kv_codes.restype = None
             lib.trpc_kv_reset.argtypes = []
             lib.trpc_kv_reset.restype = None
+            # Content-addressed prefix cache (capi/kv_capi.cc; ISSUE 17).
+            lib.trpc_kv_content_hash.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.trpc_kv_content_hash.restype = None
+            lib.trpc_kv_prefix_chain.argtypes = [
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t,
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_size_t,
+            ]
+            lib.trpc_kv_prefix_chain.restype = ctypes.c_size_t
+            lib.trpc_kv_prefix_publish.argtypes = [
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32,
+                ctypes.c_void_p, ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t,
+                ctypes.c_int64, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.trpc_kv_prefix_publish.restype = ctypes.c_int
+            lib.trpc_kv_prefix_withdraw.argtypes = [
+                ctypes.c_uint64, ctypes.c_uint64,
+            ]
+            lib.trpc_kv_prefix_withdraw.restype = ctypes.c_int
+            lib.trpc_kv_prefix_store_count.argtypes = []
+            lib.trpc_kv_prefix_store_count.restype = ctypes.c_size_t
+            lib.trpc_kv_prefix_hot_bytes.argtypes = []
+            lib.trpc_kv_prefix_hot_bytes.restype = ctypes.c_uint64
+            lib.trpc_kv_prefix_cold_bytes.argtypes = []
+            lib.trpc_kv_prefix_cold_bytes.restype = ctypes.c_uint64
+            lib.trpc_kv_prefix_registry_count.argtypes = []
+            lib.trpc_kv_prefix_registry_count.restype = ctypes.c_size_t
+            lib.trpc_kv_prefix_registry_replicas.argtypes = []
+            lib.trpc_kv_prefix_registry_replicas.restype = ctypes.c_size_t
+            lib.trpc_kv_prefix_counters.argtypes = [
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.trpc_kv_prefix_counters.restype = None
             # Cluster control plane (capi/naming_capi.cc; net/naming.h):
             # naming registry + graceful drain / hot-restart handoff.
             lib.trpc_server_enable_naming.argtypes = [ctypes.c_void_p]
@@ -652,6 +700,19 @@ def load_library() -> ctypes.CDLL:
                 ctypes.c_char_p, ctypes.c_size_t,
             ]
             lib.trpc_cluster_call.restype = ctypes.c_int
+            # Cache-aware routing (capi/rpc_capi.cc; net/lb_hint.h).
+            lib.trpc_cluster_call_hinted.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_size_t, ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.trpc_cluster_call_hinted.restype = ctypes.c_int
+            lib.trpc_lb_hint_counters.argtypes = [
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.trpc_lb_hint_counters.restype = None
             _lib = lib
     return _lib
 

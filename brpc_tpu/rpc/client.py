@@ -295,9 +295,26 @@ class ClusterChannel(_BatchMixin):
         self._lib.trpc_cluster_set_qos(
             self._ptr, tenant.encode(), int(priority))
 
-    def call(self, method: str, request: bytes, hash_key: int = 0) -> bytes:
-        return _call(self._lib, self._lib.trpc_cluster_call, self._ptr,
-                     method, request, hash_key, latency=self.latency)
+    def call(self, method: str, request: bytes, hash_key: int = 0,
+             hint: str = "") -> bytes:
+        """One cluster call.  `hint` ("host:port") names the preferred
+        member — the node holding the longest cached KV prefix — and is
+        honored by the c_hash_bl walk unless bounded load vetoes it
+        (cpp/net/lb_hint.h).  Advisory only: an unknown or overloaded
+        hint falls back to the plain ring walk."""
+        if not hint:
+            return _call(self._lib, self._lib.trpc_cluster_call, self._ptr,
+                         method, request, hash_key, latency=self.latency)
+        resp = IOBuf()
+        err = ctypes.create_string_buffer(256)
+        t0 = time.perf_counter()
+        rc = self._lib.trpc_cluster_call_hinted(
+            self._ptr, method.encode(), request, len(request), resp._ptr,
+            hash_key, hint.encode(), err, 256)
+        self.latency.record(int((time.perf_counter() - t0) * 1e6))
+        if rc != 0:
+            _raise_rpc_error(self._lib, rc, err.value.decode(errors="replace"))
+        return resp.to_bytes()
 
     def close(self) -> None:
         self._close_default_batch()
@@ -305,3 +322,17 @@ class ClusterChannel(_BatchMixin):
         if ptr:
             self._lib.trpc_cluster_destroy(ptr)
         self.latency.close()
+
+
+def lb_hint_counters() -> tuple[int, int, int]:
+    """(hit, veto, miss) cache-aware routing outcomes since process
+    start: hit = hinted member selected, veto = bounded load overrode
+    the hint (the ring walk took over), miss = hinted member absent or
+    unhealthy (cpp/net/lb_hint.h)."""
+    lib = load_library()
+    hit = ctypes.c_uint64()
+    veto = ctypes.c_uint64()
+    miss = ctypes.c_uint64()
+    lib.trpc_lb_hint_counters(ctypes.byref(hit), ctypes.byref(veto),
+                              ctypes.byref(miss))
+    return hit.value, veto.value, miss.value

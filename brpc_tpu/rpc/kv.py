@@ -51,11 +51,20 @@ from brpc_tpu.rpc.client import Channel, RpcError
 _WIRE = struct.Struct("<QQQQQq64s")
 assert _WIRE.size == 112
 
+# Prefix-cache wire form — MUST mirror cpp/net/kvstore.h KvPrefixWire
+# (kv-wire marker: fixed little-endian, 144 bytes): key hi/lo, hash
+# hi/lo, generation, rkey, off, len, lease_ms, depth, flags, node.
+_PREFIX_WIRE = struct.Struct("<QQQQQQQQqII64s")
+assert _PREFIX_WIRE.size == 144
+
 FETCH_METHOD = "Kv.Fetch"
 REGISTER_METHOD = "KvReg.Register"
 LOOKUP_METHOD = "KvReg.Lookup"
 EVICT_METHOD = "KvReg.Evict"
 RENEW_METHOD = "KvReg.Renew"
+PREFIX_PUT_METHOD = "KvReg.PutPrefix"
+PREFIX_MATCH_METHOD = "KvReg.Match"
+PREFIX_FETCH_METHOD = "Kv.FetchPrefix"
 
 
 class KvError(RpcError):
@@ -193,6 +202,164 @@ def reset() -> None:
     load_library().trpc_kv_reset()
 
 
+# ---- content-addressed prefix cache (ISSUE 17) ---------------------------
+
+
+@dataclasses.dataclass
+class KvPrefixMeta:
+    """One prefix-block replica record: chain key (where in the trie),
+    content hash (what bytes), and where this replica lives."""
+
+    key_hi: int
+    key_lo: int
+    hash_hi: int
+    hash_lo: int
+    generation: int
+    rkey: int = 0
+    off: int = 0
+    length: int = 0
+    depth: int = 0
+    node: str = ""
+    lease_left_ms: int = 0
+    flags: int = 0  # bit 0: replica currently cold (tier telemetry)
+
+    @property
+    def key(self) -> tuple[int, int]:
+        return self.key_hi, self.key_lo
+
+    @property
+    def hash(self) -> tuple[int, int]:
+        return self.hash_hi, self.hash_lo
+
+    def pack(self, lease_ms: int = 0) -> bytes:
+        return _PREFIX_WIRE.pack(self.key_hi, self.key_lo, self.hash_hi,
+                                 self.hash_lo, self.generation, self.rkey,
+                                 self.off, self.length, lease_ms,
+                                 self.depth, self.flags,
+                                 self.node.encode()[:63])
+
+    @classmethod
+    def unpack(cls, data: bytes, offset: int = 0) -> "KvPrefixMeta":
+        (khi, klo, hhi, hlo, gen, rkey, off, length, lease, depth, flags,
+         node) = _PREFIX_WIRE.unpack_from(data, offset)
+        return cls(khi, klo, hhi, hlo, gen, rkey, off, length, depth,
+                   node.split(b"\0", 1)[0].decode(errors="replace"),
+                   lease, flags)
+
+
+def _token_array(tokens):
+    toks = list(tokens)
+    return (ctypes.c_uint64 * max(len(toks), 1))(*toks), len(toks)
+
+
+def content_hash(data, tokens=()) -> tuple[int, int]:
+    """128-bit content hash of (block bytes, token-id span) — identical
+    inputs hash identically in every process (the fleet dedup key)."""
+    lib = load_library()
+    buf = bytes(data)
+    tok_arr, ntok = _token_array(tokens)
+    hi = ctypes.c_uint64()
+    lo = ctypes.c_uint64()
+    lib.trpc_kv_content_hash(
+        ctypes.cast(ctypes.c_char_p(buf), ctypes.c_void_p),
+        ctypes.c_size_t(len(buf)), tok_arr, ctypes.c_size_t(ntok),
+        ctypes.byref(hi), ctypes.byref(lo))
+    return hi.value, lo.value
+
+
+def prefix_chain(tokens, block_tokens: int = 0) -> list[tuple[int, int]]:
+    """Chain keys for a token-id sequence: key_i names the WHOLE prefix
+    through block i, so longest-prefix match is a walk until first miss.
+    Only FULL block_tokens-sized blocks produce keys (the partial tail is
+    never cacheable).  block_tokens <= 0 uses trpc_kv_prefix_block_tokens
+    — every node must agree on it for keys to dedup."""
+    lib = load_library()
+    tok_arr, ntok = _token_array(tokens)
+    if ntok == 0:
+        return []
+    keys = (ctypes.c_uint64 * (2 * ntok))()
+    wrote = lib.trpc_kv_prefix_chain(tok_arr, ctypes.c_size_t(ntok),
+                                     ctypes.c_int64(block_tokens), keys,
+                                     ctypes.c_size_t(ntok))
+    return [(keys[2 * i], keys[2 * i + 1]) for i in range(int(wrote))]
+
+
+def prefix_publish(key: tuple[int, int], depth: int, data, tokens,
+                   lease_ms: int = 0, node: str = "",
+                   min_generation: int = 0) -> tuple[KvPrefixMeta, bool]:
+    """Publishes one prefix block into the local two-tier store under its
+    content hash (bytes are COPIED into store-owned registered pages —
+    any buffer works, no RmaBuffer needed).  Returns (meta, fresh):
+    fresh=False is the cache-hit path — identical content was already
+    live, the lease renewed, and NO bytes were admitted (the caller's
+    bytes-not-recomputed accounting)."""
+    lib = load_library()
+    buf = bytes(data)
+    if not buf:
+        raise ValueError("empty prefix block")
+    tok_arr, ntok = _token_array(tokens)
+    hash_hi = ctypes.c_uint64()
+    hash_lo = ctypes.c_uint64()
+    gen = ctypes.c_uint64()
+    rkey = ctypes.c_uint64()
+    off = ctypes.c_uint64()
+    rc = lib.trpc_kv_prefix_publish(
+        ctypes.c_uint64(key[0]), ctypes.c_uint64(key[1]),
+        ctypes.c_uint32(depth),
+        ctypes.cast(ctypes.c_char_p(buf), ctypes.c_void_p),
+        ctypes.c_size_t(len(buf)), tok_arr, ctypes.c_size_t(ntok),
+        ctypes.c_int64(lease_ms), ctypes.c_uint64(min_generation),
+        ctypes.byref(hash_hi), ctypes.byref(hash_lo), ctypes.byref(gen),
+        ctypes.byref(rkey), ctypes.byref(off))
+    _miss, _stale, exists = _codes()
+    if rc != 0 and rc != exists:
+        raise MemoryError(
+            f"kv prefix publish failed (rc={rc}): the block must fit "
+            "trpc_kv_store_bytes")
+    meta = KvPrefixMeta(key[0], key[1], hash_hi.value, hash_lo.value,
+                        gen.value, rkey.value, off.value, len(buf), depth,
+                        node)
+    return meta, rc == 0
+
+
+def prefix_withdraw(hash_key: tuple[int, int]) -> None:
+    """Evicts a local prefix block by content hash (tombstoned)."""
+    rc = load_library().trpc_kv_prefix_withdraw(
+        ctypes.c_uint64(hash_key[0]), ctypes.c_uint64(hash_key[1]))
+    if rc != 0:
+        raise KvMissError(rc, "prefix block not in the local store")
+
+
+def prefix_store_count() -> int:
+    return int(load_library().trpc_kv_prefix_store_count())
+
+
+def prefix_hot_bytes() -> int:
+    return int(load_library().trpc_kv_prefix_hot_bytes())
+
+
+def prefix_cold_bytes() -> int:
+    return int(load_library().trpc_kv_prefix_cold_bytes())
+
+
+def prefix_registry_count() -> int:
+    return int(load_library().trpc_kv_prefix_registry_count())
+
+
+def prefix_registry_replicas() -> int:
+    return int(load_library().trpc_kv_prefix_registry_replicas())
+
+
+def prefix_counters() -> dict[str, int]:
+    """Prefix-tier outcome counters since process start (promote,
+    demote, hot_hits, cold_hits, dedup)."""
+    lib = load_library()
+    vals = [ctypes.c_uint64() for _ in range(5)]
+    lib.trpc_kv_prefix_counters(*[ctypes.byref(v) for v in vals])
+    return dict(zip(("promote", "demote", "hot_hits", "cold_hits",
+                     "dedup"), (v.value for v in vals)))
+
+
 class KvRegistryClient:
     """Thin RPC client for the registry methods over one channel."""
 
@@ -232,6 +399,40 @@ class KvRegistryClient:
         except RpcError as e:
             raise _kv_error(e) from None
         return struct.unpack("<Q", resp)[0]
+
+    def put_prefix(self, meta: KvPrefixMeta,
+                   lease_ms: int = 0) -> tuple[int, bool]:
+        """Records one prefix-block replica; N publishers of the same
+        chain key + content hash fold into ONE record with a replica
+        set.  Returns (generation, fresh): fresh=False means the
+        registry already held this exact replica and only renewed its
+        lease (the idempotent re-offer every cache hit makes)."""
+        try:
+            resp = self._ch.call(PREFIX_PUT_METHOD, meta.pack(lease_ms))
+        except RpcError as e:
+            e = _kv_error(e)
+            if isinstance(e, KvExistsError):
+                return meta.generation, False
+            raise e from None
+        return struct.unpack("<Q", resp)[0], True
+
+    def match(self, keys) -> list[KvPrefixMeta]:
+        """Longest cached prefix: one replica record per live replica of
+        every matched chain key, grouped in chain order (the walk stops
+        at the first key with no live replica).  Empty list = nothing
+        cached."""
+        keys = list(keys)
+        if not keys:
+            return []
+        req = struct.pack("<Q", len(keys)) + b"".join(
+            struct.pack("<QQ", hi, lo) for hi, lo in keys)
+        try:
+            resp = self._ch.call(PREFIX_MATCH_METHOD, req)
+        except RpcError as e:
+            raise _kv_error(e) from None
+        (count,) = struct.unpack_from("<Q", resp)
+        return [KvPrefixMeta.unpack(resp, 8 + i * _PREFIX_WIRE.size)
+                for i in range(count)]
 
     def close(self) -> None:
         if self._owns:
@@ -275,10 +476,45 @@ class KvClient:
         #: Fetches re-routed because the naming view said the cached
         #: node is gone (drain/crash re-resolution telemetry).
         self.node_reresolves = 0
+        #: Pooled node channels dropped because their node left the
+        #: naming view (the pool must not grow with membership churn).
+        self.channels_evicted = 0
+
+    #: Pool size at which creating a NEW node channel first prunes
+    #: channels whose nodes left the naming view — bounds the pool to
+    #: (live members + a little churn slack) instead of every node that
+    #: ever served a block.
+    _POOL_PRUNE_AT = 4
+
+    def _prune_gone_channels(self) -> None:
+        """Evicts pooled channels for nodes absent from the naming view
+        (one resolve for the whole sweep; no view configured or registry
+        unreachable = no verdict, keep everything)."""
+        naming_addr, service = self._naming_args
+        if naming_addr is None:
+            return
+        if self._naming is None:
+            from brpc_tpu.rpc import naming as _naming
+
+            self._naming = _naming.NamingClient(naming_addr,
+                                                timeout_ms=self._timeout_ms)
+        try:
+            _version, members = self._naming.resolve(service)
+        except RpcError:
+            return
+        live = {m.addr for m in members}
+        for node in [n for n in self._node_chs if n not in live]:
+            self._node_chs.pop(node).close()
+            self.channels_evicted += 1
 
     def _node_channel(self, node: str) -> Channel:
         ch = self._node_chs.get(node)
         if ch is None:
+            if len(self._node_chs) >= self._POOL_PRUNE_AT:
+                # The pool is about to grow past the prune threshold:
+                # drop channels for departed nodes first so membership
+                # churn can't grow it unboundedly.
+                self._prune_gone_channels()
             tenant, prio = self._qos
             # shm rings are single-connection by construction; TCP block
             # pulls spread over pooled sockets (stripe rails).
@@ -384,6 +620,61 @@ class KvClient:
             return c.resp_len
         finally:
             pipe.close()
+
+    # ---- content-addressed prefix cache (ISSUE 17) ----
+
+    def match_prefix(self, tokens,
+                     block_tokens: int = 0) -> list[list[KvPrefixMeta]]:
+        """Longest cached prefix for `tokens`: replica groups in chain
+        order (groups[i] = every live replica of prefix block i).  An
+        empty list means nothing is cached — full recompute."""
+        keys = prefix_chain(tokens, block_tokens)
+        if not keys:
+            return []
+        records = self.registry.match(keys)
+        groups: list[list[KvPrefixMeta]] = []
+        cur = None
+        for r in records:
+            if r.key != cur:
+                groups.append([])
+                cur = r.key
+            groups[-1].append(r)
+        return groups
+
+    @staticmethod
+    def prefix_hint(groups: list[list[KvPrefixMeta]]) -> str:
+        """The routing hint for this prompt: the node holding the
+        DEEPEST matched block ("host:port", "" when nothing matched).
+        Pass it to ClusterChannel.call(..., hint=...) so decode/prefill
+        traffic lands where the cache already is — unless bounded load
+        vetoes."""
+        return groups[-1][0].node if groups else ""
+
+    def fetch_prefix(self, tokens, block_tokens: int = 0) -> list[bytes]:
+        """Fetches every cached prefix block for `tokens` in chain
+        order, failing over across replicas: a replica that answers
+        stale/faulted serves nothing (whole-or-nothing per block) and
+        the next replica is tried.  The returned list may be shorter
+        than the match when every replica of a block fails — the
+        cacheable prefix simply ends there (callers recompute the
+        rest)."""
+        blocks: list[bytes] = []
+        for group in self.match_prefix(tokens, block_tokens):
+            data = None
+            for rep in group:
+                ch = self._node_channel(rep.node)
+                try:
+                    data = ch.call(PREFIX_FETCH_METHOD, rep.pack(),
+                                   timeout_ms=self._timeout_ms)
+                    break
+                except RpcError:
+                    # Stale, chunk-faulted, or dead replica: the block
+                    # is never admitted partially — try the next one.
+                    continue
+            if data is None:
+                break
+            blocks.append(data)
+        return blocks
 
     def close(self) -> None:
         for ch in self._node_chs.values():

@@ -347,8 +347,10 @@ TIMELINE_CAPTURE_OPS = {1: "keep", 2: "drop", 3: "dump"}
 
 # kKvBlock `b` op tags (cpp/net/kvstore.h: b = op << 56 | payload len) —
 # how a kv_block event reads: the store published / served / evicted a
-# block, or rejected a stale-generation fetch.
-TIMELINE_KV_OPS = {1: "publish", 2: "serve", 3: "evict", 4: "stale"}
+# block, rejected a stale-generation fetch, or moved a prefix block
+# between the hot (registered) and cold (heap) tiers.
+TIMELINE_KV_OPS = {1: "publish", 2: "serve", 3: "evict", 4: "stale",
+                   5: "promote", 6: "demote"}
 
 # kCollStep `b` op tags (cpp/net/collective.h CollOp: b = op << 56 |
 # step bytes; a = step index) — one event per completed collective

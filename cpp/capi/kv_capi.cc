@@ -99,10 +99,133 @@ void trpc_kv_codes(int* miss, int* stale, int* exists) {
   }
 }
 
-// Test support: drops every local block, tombstone, and registry record.
+// ---- content-addressed prefix cache (ISSUE 17) ---------------------------
+
+// 128-bit content hash of (block bytes, token-id span) — deterministic
+// across processes: the fleet-wide dedup key.
+void trpc_kv_content_hash(const void* data, size_t len,
+                          const uint64_t* tokens, size_t ntokens,
+                          uint64_t* hi, uint64_t* lo) {
+  Key128 k;
+  kv_content_hash(data, len, tokens, ntokens, &k);
+  if (hi != nullptr) {
+    *hi = k.hi;
+  }
+  if (lo != nullptr) {
+    *lo = k.lo;
+  }
+}
+
+// Chain keys for a token-id sequence, written as interleaved (hi, lo)
+// u64 pairs (Key128's exact layout).  block_tokens <= 0 uses
+// trpc_kv_prefix_block_tokens.  Returns the number of FULL blocks.
+size_t trpc_kv_prefix_chain(const uint64_t* tokens, size_t ntokens,
+                            int64_t block_tokens, uint64_t* keys_out,
+                            size_t max_keys) {
+  static_assert(sizeof(Key128) == 16, "interleaved (hi, lo) pairs");
+  return kv_prefix_chain(tokens, ntokens, block_tokens,
+                         reinterpret_cast<Key128*>(keys_out), max_keys);
+}
+
+// Publishes one prefix block into the two-tier store (bytes are COPIED
+// into store-owned registered pages — any caller memory works).  Fills
+// the content hash, minted generation and hot-tier coordinates.
+// Returns 0 (fresh bytes admitted), kEKvExists (2103: identical content
+// already live — the cache-hit path, lease renewed, outputs filled), or
+// -1 (over budget / bad args).
+int trpc_kv_prefix_publish(uint64_t key_hi, uint64_t key_lo, uint32_t depth,
+                           const void* data, size_t len,
+                           const uint64_t* tokens, size_t ntokens,
+                           int64_t lease_ms, uint64_t min_generation,
+                           uint64_t* hash_hi, uint64_t* hash_lo,
+                           uint64_t* gen_out, uint64_t* rkey_out,
+                           uint64_t* off_out) {
+  Key128 key;
+  key.hi = key_hi;
+  key.lo = key_lo;
+  KvPrefixMeta m;
+  const int rc = kv_store().publish_prefix(key, depth, data, len, tokens,
+                                           ntokens, lease_ms, &m,
+                                           min_generation);
+  if (rc != 0 && rc != kEKvExists) {
+    return rc;
+  }
+  if (hash_hi != nullptr) {
+    *hash_hi = m.hash.hi;
+  }
+  if (hash_lo != nullptr) {
+    *hash_lo = m.hash.lo;
+  }
+  if (gen_out != nullptr) {
+    *gen_out = m.generation;
+  }
+  if (rkey_out != nullptr) {
+    *rkey_out = m.rkey;
+  }
+  if (off_out != nullptr) {
+    *off_out = m.off;
+  }
+  return rc;
+}
+
+// Evicts a local prefix block by content hash (generation tombstoned).
+int trpc_kv_prefix_withdraw(uint64_t hash_hi, uint64_t hash_lo) {
+  Key128 h;
+  h.hi = hash_hi;
+  h.lo = hash_lo;
+  return kv_store().withdraw_prefix(h);
+}
+
+size_t trpc_kv_prefix_store_count() { return kv_store().prefix_count(); }
+
+uint64_t trpc_kv_prefix_hot_bytes() { return kv_store().prefix_hot_bytes(); }
+
+uint64_t trpc_kv_prefix_cold_bytes() {
+  return kv_store().prefix_cold_bytes();
+}
+
+size_t trpc_kv_prefix_registry_count() {
+  return kv_registry().prefix_count();
+}
+
+size_t trpc_kv_prefix_registry_replicas() {
+  return kv_registry().prefix_replicas();
+}
+
+// Prefix-tier outcome counters since process start.
+void trpc_kv_prefix_counters(uint64_t* promote, uint64_t* demote,
+                             uint64_t* hot_hits, uint64_t* cold_hits,
+                             uint64_t* dedup) {
+  KvPrefixCounters& c = kv_prefix_counters();
+  if (promote != nullptr) {
+    *promote = KvPrefixCounters::read(c.promote);
+  }
+  if (demote != nullptr) {
+    *demote = KvPrefixCounters::read(c.demote);
+  }
+  if (hot_hits != nullptr) {
+    *hot_hits = KvPrefixCounters::read(c.hot_hits);
+  }
+  if (cold_hits != nullptr) {
+    *cold_hits = KvPrefixCounters::read(c.cold_hits);
+  }
+  if (dedup != nullptr) {
+    *dedup = KvPrefixCounters::read(c.dedup);
+  }
+}
+
+// Test support: drops every local block, tombstone, and registry record
+// (both the id-addressed and content-addressed tiers) and zeroes the
+// prefix outcome counters.
 void trpc_kv_reset() {
   kv_store().clear();
   kv_registry().clear();
+  KvPrefixCounters& c = kv_prefix_counters();
+  c.promote.store(0, std::memory_order_relaxed);
+  c.demote.store(0, std::memory_order_relaxed);
+  c.hot_hits.store(0, std::memory_order_relaxed);
+  c.cold_hits.store(0, std::memory_order_relaxed);
+  c.dedup.store(0, std::memory_order_relaxed);
 }
 
 }  // extern "C"

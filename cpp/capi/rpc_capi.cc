@@ -18,6 +18,7 @@
 #include "net/channel.h"
 #include "net/cluster.h"
 #include "net/deadline.h"
+#include "net/lb_hint.h"
 #include "net/naming.h"
 #include "net/controller.h"
 #include "net/fault.h"
@@ -375,6 +376,59 @@ int trpc_cluster_call(void* ch, const char* method, const char* req,
     return cntl.error_code() != 0 ? cntl.error_code() : -1;
   }
   return 0;
+}
+
+// Cache-aware variant (net/lb_hint.h): hint_addr ("host:port") names
+// the member holding the longest cached prefix; the c_hash_bl walk
+// honors it on attempt 0 unless bounded load vetoes.  An empty or
+// unparseable hint degrades to trpc_cluster_call semantics — routing
+// hints are advisory, never load-bearing for correctness.
+int trpc_cluster_call_hinted(void* ch, const char* method, const char* req,
+                             size_t req_len, void* resp_iobuf,
+                             uint64_t hash_key, const char* hint_addr,
+                             char* err_buf, size_t err_buf_len) {
+  EndPoint hint;
+  const bool have_hint = hint_addr != nullptr && hint_addr[0] != '\0' &&
+                         hostname2endpoint(hint_addr, &hint) == 0;
+  ScopedPthreadWait pin;  // see trpc_channel_call
+  Controller cntl;
+  IOBuf request;
+  request.append(req, req_len);
+  {
+    // Scope the ambient hint to exactly this call: a leaked hint would
+    // silently re-route the thread's next unrelated call.
+    LbHintScope scope(have_hint ? hint : EndPoint());
+    if (!have_hint) {
+      lb_hint_clear();
+    }
+    static_cast<ClusterChannel*>(ch)->CallMethod(
+        method, request, static_cast<IOBuf*>(resp_iobuf), &cntl, nullptr,
+        hash_key);
+  }
+  if (cntl.Failed()) {
+    if (err_buf != nullptr && err_buf_len > 0) {
+      strncpy(err_buf, cntl.error_text().c_str(), err_buf_len - 1);
+      err_buf[err_buf_len - 1] = '\0';
+    }
+    return cntl.error_code() != 0 ? cntl.error_code() : -1;
+  }
+  return 0;
+}
+
+// Hint routing outcomes since process start (hit = hinted member
+// selected, veto = bounded load overrode the hint, miss = hinted member
+// absent or unhealthy).
+void trpc_lb_hint_counters(uint64_t* hit, uint64_t* veto, uint64_t* miss) {
+  LbHintCounters& c = lb_hint_counters();
+  if (hit != nullptr) {
+    *hit = LbHintCounters::read(c.hit);
+  }
+  if (veto != nullptr) {
+    *veto = LbHintCounters::read(c.veto);
+  }
+  if (miss != nullptr) {
+    *miss = LbHintCounters::read(c.miss);
+  }
 }
 
 }  // extern "C"

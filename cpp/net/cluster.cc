@@ -23,7 +23,9 @@
 #include "fiber/sync.h"
 #include "net/deadline.h"
 #include "net/fault.h"
+#include "net/lb_hint.h"
 #include "net/naming.h"
+#include "stat/reducer.h"
 #include "stat/timeline.h"
 
 namespace trpc {
@@ -190,6 +192,31 @@ class ConsistentHashBoundedLB : public LoadBalancer {
     // the ceiling keeps a cold cluster (mean 0) from rejecting everyone.
     const double bound =
         factor * (static_cast<double>(inflight_sum) / healthy.size() + 1);
+    // Cache-aware routing (ISSUE 17): a caller-installed hint names the
+    // member holding the longest cached prefix.  Honor it on the FIRST
+    // attempt only (retries already exclude the tried node) and only
+    // while it is under the same bounded-load bound the ring walk
+    // enforces — affinity never outranks overload diffusion (veto).
+    EndPoint hinted;
+    if (attempt == 0 && lb_hint_get(&hinted)) {
+      bool found = false;
+      for (size_t idx : healthy) {
+        if (nodes[idx].ep == hinted) {
+          found = true;
+          // Relaxed: advisory load sample, see the ring walk below.
+          if (nodes[idx].inflight->load(std::memory_order_relaxed) + 1 <=
+              bound) {
+            lb_hint_counters().bump(lb_hint_counters().hit);
+            return idx;
+          }
+          lb_hint_counters().bump(lb_hint_counters().veto);
+          break;
+        }
+      }
+      if (!found) {
+        lb_hint_counters().bump(lb_hint_counters().miss);
+      }
+    }
     const size_t start = static_cast<size_t>(attempt) % order.size();
     // Full wrap from the retry offset: an under-bound node earlier in
     // ring order must stay reachable on retries, or the walk would hand
@@ -350,12 +377,60 @@ class LocalityAwareLB : public LoadBalancer {
   const std::string my_zone_;
 };
 
+// Routing-hint outcome vars (net/lb_hint.h): dashboards read the
+// hit/veto split to judge whether cache-aware routing is actually
+// landing on prefix owners or being load-vetoed back onto the ring.
+struct LbHintVars {
+  std::unique_ptr<PassiveStatus<long>> hit;
+  std::unique_ptr<PassiveStatus<long>> veto;
+  std::unique_ptr<PassiveStatus<long>> miss;
+  LbHintVars() {
+    hit = std::make_unique<PassiveStatus<long>>([] {
+      return static_cast<long>(
+          LbHintCounters::read(lb_hint_counters().hit));
+    });
+    hit->expose("lb_hint_hit_total",
+                "cluster calls routed to their cache-affinity hint (the "
+                "hinted member was healthy and under the c_hash_bl "
+                "bounded-load bound)");
+    veto = std::make_unique<PassiveStatus<long>>([] {
+      return static_cast<long>(
+          LbHintCounters::read(lb_hint_counters().veto));
+    });
+    veto->expose(
+        "lb_hint_veto_total",
+        "cluster calls whose cache-affinity hint was VETOED by the "
+        "bounded-load check (hinted member over factor x mean in-flight) "
+        "and fell back to the ring walk");
+    miss = std::make_unique<PassiveStatus<long>>([] {
+      return static_cast<long>(
+          LbHintCounters::read(lb_hint_counters().miss));
+    });
+    miss->expose(
+        "lb_hint_miss_total",
+        "cluster calls whose cache-affinity hint named a member not in "
+        "the healthy set (drained, quarantined, or gone) — routed by "
+        "the plain ring walk");
+  }
+};
+
+LbHintVars& lb_hint_vars() {
+  static LbHintVars* v = new LbHintVars();
+  return *v;
+}
+
 }  // namespace
+
+LbHintCounters& lb_hint_counters() {
+  static LbHintCounters* c = new LbHintCounters();
+  return *c;
+}
 
 void cluster_ensure_registered() {
   zone_flag();
   chash_load_factor_flag();
   subset_size_flag();
+  lb_hint_vars();
 }
 
 int64_t asym_ewma(int64_t prev, int64_t sample) {
@@ -985,6 +1060,10 @@ struct AsyncCall {
   // Ambient deadline, same capture rationale (value-only: the caller's
   // cancel scope may die before this detached fiber runs).
   int64_t amb_deadline = 0;
+  // Ambient routing hint (net/lb_hint.h), same capture rationale: the
+  // retry fiber's thread has no hint installed.
+  bool amb_hint_set = false;
+  EndPoint amb_hint;
 };
 }  // namespace
 
@@ -1347,6 +1426,7 @@ void ClusterChannel::CallMethod(const std::string& method,
     call->done = std::move(done);
     get_ambient_trace(&call->amb_trace, &call->amb_span);
     call->amb_deadline = ambient_deadline();
+    call->amb_hint_set = lb_hint_get(&call->amb_hint);
     if (fiber_start(
             nullptr,
             [](void* arg) {
@@ -1355,8 +1435,12 @@ void ClusterChannel::CallMethod(const std::string& method,
               // context (cleared with the fiber's fls at exit).
               set_ambient_trace(c->amb_trace, c->amb_span);
               set_ambient_deadline(c->amb_deadline);
+              if (c->amb_hint_set) {
+                lb_hint_set(c->amb_hint);
+              }
               c->ch->CallMethod(c->method, c->request, c->response, c->cntl,
                                 nullptr, c->hash_key);
+              lb_hint_clear();
               c->done();
             },
             call, 0) != 0) {

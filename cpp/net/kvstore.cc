@@ -63,6 +63,46 @@ Flag* store_bytes_flag() {
   return f;
 }
 
+Flag* prefix_hot_bytes_flag() {
+  static Flag* f = [] {
+    Flag* flag = Flag::define_int64(
+        "trpc_kv_prefix_hot_bytes", 256ll << 20,
+        "hot-tier byte budget for content-addressed prefix blocks "
+        "([1MB, 64GB]); hot blocks live in registered-RMA pages and "
+        "serve zero-copy — exceeding the budget DEMOTES LRU blocks to "
+        "the unregistered cold tier (never drops them)");
+    if (flag != nullptr) {
+      flag->set_validator([](const std::string& v) {
+        char* end = nullptr;
+        const long long n = strtoll(v.c_str(), &end, 10);
+        return end != v.c_str() && *end == '\0' && n >= (1ll << 20) &&
+               n <= (64ll << 30);
+      });
+    }
+    return flag;
+  }();
+  return f;
+}
+
+Flag* prefix_block_tokens_flag() {
+  static Flag* f = [] {
+    Flag* flag = Flag::define_int64(
+        "trpc_kv_prefix_block_tokens", 128,
+        "token span per prefix-cache block ([1, 65536]); chain keys fold "
+        "one block_tokens-sized chunk at a time, so every node in the "
+        "fleet MUST agree on this value for content hashes to dedup");
+    if (flag != nullptr) {
+      flag->set_validator([](const std::string& v) {
+        char* end = nullptr;
+        const long long n = strtoll(v.c_str(), &end, 10);
+        return end != v.c_str() && *end == '\0' && n >= 1 && n <= 65536;
+      });
+    }
+    return flag;
+  }();
+  return f;
+}
+
 int64_t effective_lease_us(int64_t lease_ms) {
   if (lease_ms <= 0) {
     lease_ms = lease_flag() != nullptr ? lease_flag()->int64_value() : 30000;
@@ -134,6 +174,112 @@ KvVars& kv_vars() {
   return *v;
 }
 
+struct KvPrefixVars {
+  Adder publish_total;
+  Adder fetch_total;
+  Adder put_total;
+  Adder match_total;
+  Adder match_blocks;
+  std::unique_ptr<PassiveStatus<long>> dedup_total;
+  std::unique_ptr<PassiveStatus<long>> promote_total;
+  std::unique_ptr<PassiveStatus<long>> demote_total;
+  std::unique_ptr<PassiveStatus<long>> hot_hit_total;
+  std::unique_ptr<PassiveStatus<long>> cold_hit_total;
+  std::unique_ptr<PassiveStatus<long>> store_blocks;
+  std::unique_ptr<PassiveStatus<long>> store_hot_bytes;
+  std::unique_ptr<PassiveStatus<long>> store_cold_bytes;
+  std::unique_ptr<PassiveStatus<long>> registry_records;
+  KvPrefixVars() {
+    publish_total.expose(
+        "kv_prefix_publish_total",
+        "content-addressed prefix blocks published (fresh bytes copied "
+        "into this node's two-tier prefix store)");
+    fetch_total.expose("kv_prefix_fetch_total",
+                       "prefix-block fetches served by this node (hot "
+                       "zero-copy + cold/promoted)");
+    put_total.expose(
+        "kv_prefix_put_total",
+        "prefix-replica registrations accepted by the registry on this "
+        "node (one chain key folds N publishers into a replica set)");
+    match_total.expose(
+        "kv_prefix_match_total",
+        "longest-cached-prefix queries answered by the registry on this "
+        "node (KvReg.Match walks chain keys until first miss)");
+    match_blocks.expose(
+        "kv_prefix_match_blocks",
+        "prefix blocks matched across all KvReg.Match answers (sum of "
+        "matched depths — divide by kv_prefix_match_total for the mean "
+        "cached-prefix length)");
+    dedup_total = std::make_unique<PassiveStatus<long>>([] {
+      return static_cast<long>(
+          KvPrefixCounters::read(kv_prefix_counters().dedup));
+    });
+    dedup_total->expose(
+        "kv_prefix_dedup_total",
+        "publishes that folded into an existing replica set instead of "
+        "minting a new record (fleet-wide content dedup events)");
+    promote_total = std::make_unique<PassiveStatus<long>>([] {
+      return static_cast<long>(
+          KvPrefixCounters::read(kv_prefix_counters().promote));
+    });
+    promote_total->expose(
+        "kv_prefix_promote_total",
+        "cold prefix blocks promoted back into registered-RMA pages on "
+        "fetch (promotion-on-hit)");
+    demote_total = std::make_unique<PassiveStatus<long>>([] {
+      return static_cast<long>(
+          KvPrefixCounters::read(kv_prefix_counters().demote));
+    });
+    demote_total->expose(
+        "kv_prefix_demote_total",
+        "hot prefix blocks spilled to the unregistered cold tier under "
+        "trpc_kv_prefix_hot_bytes pressure (demoted, not dropped)");
+    hot_hit_total = std::make_unique<PassiveStatus<long>>([] {
+      return static_cast<long>(
+          KvPrefixCounters::read(kv_prefix_counters().hot_hits));
+    });
+    hot_hit_total->expose(
+        "kv_prefix_hot_hit_total",
+        "prefix fetches served zero-copy from hot registered pages");
+    cold_hit_total = std::make_unique<PassiveStatus<long>>([] {
+      return static_cast<long>(
+          KvPrefixCounters::read(kv_prefix_counters().cold_hits));
+    });
+    cold_hit_total->expose(
+        "kv_prefix_cold_hit_total",
+        "prefix fetches that found the block demoted in the cold tier "
+        "(each one attempts promotion back to hot)");
+    store_blocks = std::make_unique<PassiveStatus<long>>(
+        [] { return static_cast<long>(kv_store().prefix_count()); });
+    store_blocks->expose(
+        "kv_prefix_store_blocks",
+        "prefix blocks currently live in this node's two-tier store");
+    store_hot_bytes = std::make_unique<PassiveStatus<long>>(
+        [] { return static_cast<long>(kv_store().prefix_hot_bytes()); });
+    store_hot_bytes->expose(
+        "kv_prefix_store_hot_bytes",
+        "prefix bytes currently pinned in registered-RMA pages (bounded "
+        "by trpc_kv_prefix_hot_bytes)");
+    store_cold_bytes = std::make_unique<PassiveStatus<long>>(
+        [] { return static_cast<long>(kv_store().prefix_cold_bytes()); });
+    store_cold_bytes->expose(
+        "kv_prefix_store_cold_bytes",
+        "prefix bytes currently demoted to the unregistered cold tier "
+        "(counted against trpc_kv_store_bytes)");
+    registry_records = std::make_unique<PassiveStatus<long>>(
+        [] { return static_cast<long>(kv_registry().prefix_count()); });
+    registry_records->expose(
+        "kv_prefix_registry_records",
+        "chain keys with at least one live replica in the registry on "
+        "this node");
+  }
+};
+
+KvPrefixVars& kv_prefix_vars() {
+  static KvPrefixVars* v = new KvPrefixVars();
+  return *v;
+}
+
 void record_kv(uint64_t block_id, uint64_t op, uint64_t len) {
   if (timeline::enabled()) {
     timeline::record(timeline::kKvBlock, block_id,
@@ -146,7 +292,94 @@ void record_kv(uint64_t block_id, uint64_t op, uint64_t len) {
 void kv_ensure_registered() {
   lease_flag();
   store_bytes_flag();
+  prefix_hot_bytes_flag();
+  prefix_block_tokens_flag();
   kv_vars();
+  kv_prefix_vars();
+}
+
+KvPrefixCounters& kv_prefix_counters() {
+  static KvPrefixCounters* c = new KvPrefixCounters();
+  return *c;
+}
+
+// ---- content addressing --------------------------------------------------
+
+namespace {
+
+// splitmix64 finalizer: full-avalanche 64-bit mix, deterministic across
+// processes and architectures (the dedup contract).
+inline uint64_t kv_mix64(uint64_t x) {
+  x ^= x >> 33;
+  x *= 0xff51afd7ed558ccdull;
+  x ^= x >> 33;
+  x *= 0xc4ceb9fe1a85ec53ull;
+  x ^= x >> 33;
+  return x;
+}
+
+}  // namespace
+
+void kv_content_hash(const void* data, size_t len, const uint64_t* tokens,
+                     size_t ntokens, Key128* out) {
+  // Two lanes with distinct seeds and distinct fold ops (xor-mix vs
+  // add-mix) so hi/lo fail independently — 128 bits of key space from
+  // two 64-bit walks.  Length and token count seed the lanes: a prefix
+  // of the bytes can never alias the whole.
+  uint64_t h1 = 0x9e3779b97f4a7c15ull ^ kv_mix64(len);
+  uint64_t h2 = 0xc2b2ae3d27d4eb4full ^ kv_mix64(ntokens + 0x100);
+  const unsigned char* p = static_cast<const unsigned char*>(data);
+  size_t i = 0;
+  for (; i + 8 <= len; i += 8) {
+    uint64_t w;
+    memcpy(&w, p + i, 8);
+    h1 = kv_mix64(h1 ^ w);
+    h2 = kv_mix64(h2 + w);
+  }
+  if (i < len) {
+    uint64_t tail = 0;
+    memcpy(&tail, p + i, len - i);
+    h1 = kv_mix64(h1 ^ tail);
+    h2 = kv_mix64(h2 + tail);
+  }
+  for (size_t t = 0; t < ntokens; ++t) {
+    h1 = kv_mix64(h1 ^ tokens[t]);
+    h2 = kv_mix64(h2 + kv_mix64(tokens[t]));
+  }
+  out->hi = h1;
+  out->lo = h2;
+}
+
+size_t kv_prefix_chain(const uint64_t* tokens, size_t ntokens,
+                       int64_t block_tokens, Key128* keys,
+                       size_t max_keys) {
+  kv_ensure_registered();
+  if (block_tokens <= 0) {
+    block_tokens = prefix_block_tokens_flag() != nullptr
+                       ? prefix_block_tokens_flag()->int64_value()
+                       : 128;
+  }
+  const size_t bt = static_cast<size_t>(std::max<int64_t>(block_tokens, 1));
+  const size_t nblocks = ntokens / bt;
+  // Chain seed folds the block size: the same token stream chunked at a
+  // different granularity must never alias the same chain keys.
+  Key128 prev;
+  prev.hi = 0x27d4eb2f165667c5ull ^ kv_mix64(bt);
+  prev.lo = 0x85ebca77c2b2ae63ull + kv_mix64(bt);
+  size_t written = 0;
+  for (size_t b = 0; b < nblocks && written < max_keys; ++b) {
+    uint64_t h1 = prev.hi;
+    uint64_t h2 = prev.lo;
+    for (size_t t = b * bt; t < (b + 1) * bt; ++t) {
+      h1 = kv_mix64(h1 ^ tokens[t]);
+      h2 = kv_mix64(h2 + kv_mix64(tokens[t] ^ 0x94d049bb133111ebull));
+    }
+    keys[written].hi = h1;
+    keys[written].lo = h2;
+    prev = keys[written];
+    ++written;
+  }
+  return written;
 }
 
 // ---- KvStore -------------------------------------------------------------
@@ -261,6 +494,14 @@ size_t KvStore::withdraw_all() {
     evict_locked(blocks_.begin()->first, /*count_var=*/true);
     ++n;
   }
+  // Drain covers the prefix tier too: every cached prefix block
+  // tombstones, so a decode side holding this node's replica records
+  // gets kv-stale and fails over to another replica (or re-publishes) —
+  // never bytes from a dying pid.
+  while (!prefix_blocks_.empty()) {
+    evict_prefix_locked(prefix_blocks_.begin()->first);
+    ++n;
+  }
   return n;
 }
 
@@ -369,6 +610,312 @@ void KvStore::clear() {
   blocks_.clear();
   tombstones_.clear();
   bytes_ = 0;
+  for (auto& [hash, b] : prefix_blocks_) {
+    if (b.hot && b.hot_data != nullptr) {
+      b.map.reset();
+      rma_free(b.hot_data);
+    }
+  }
+  prefix_blocks_.clear();
+  prefix_tombstones_.clear();
+  prefix_hot_bytes_ = 0;
+  prefix_cold_bytes_ = 0;
+}
+
+// ---- KvStore prefix tier (two-tier content-addressed store) --------------
+
+void KvStore::demote_locked(PrefixBlock* b) {
+  if (!b->hot) {
+    return;
+  }
+  // Copy out FIRST, then release the pages: any in-flight serve holds
+  // its own mapping reference (KvServeCtx), so rma_free's munmap defers
+  // past it — the demote is invisible to readers mid-response.
+  b->cold.assign(b->hot_data, b->meta.len);
+  b->map.reset();
+  rma_free(b->hot_data);
+  b->hot_data = nullptr;
+  b->meta.rkey = 0;
+  b->meta.off = 0;
+  b->hot = false;
+  prefix_hot_bytes_ -= b->meta.len;
+  prefix_cold_bytes_ += b->meta.len;
+  kv_prefix_counters().bump(kv_prefix_counters().demote);
+  record_kv(b->meta.hash.lo, kKvOpDemote, b->meta.len);
+}
+
+void KvStore::evict_prefix_locked(const Key128& hash) {
+  auto it = prefix_blocks_.find(hash);
+  if (it == prefix_blocks_.end()) {
+    return;
+  }
+  PrefixBlock& b = it->second;
+  prefix_tombstones_[hash] = b.meta.generation;
+  if (b.hot) {
+    prefix_hot_bytes_ -= b.meta.len;
+    b.map.reset();
+    rma_free(b.hot_data);
+  } else {
+    prefix_cold_bytes_ -= b.meta.len;
+  }
+  record_kv(hash.lo, kKvOpEvict, b.meta.len);
+  kv_vars().evict_total << 1;
+  prefix_blocks_.erase(it);
+}
+
+bool KvStore::fit_hot_locked(uint64_t incoming, uint64_t hot_budget) {
+  if (incoming > hot_budget) {
+    return false;  // publishes straight to cold
+  }
+  // Hot pressure DEMOTES (never drops): the bytes stay serveable, they
+  // just lose the zero-copy fast path until a hit promotes them back.
+  while (prefix_hot_bytes_ + incoming > hot_budget) {
+    PrefixBlock* victim = nullptr;
+    uint64_t oldest_touch = std::numeric_limits<uint64_t>::max();
+    for (auto& [hash, b] : prefix_blocks_) {
+      if (b.hot && b.touch_seq < oldest_touch) {
+        oldest_touch = b.touch_seq;
+        victim = &b;
+      }
+    }
+    if (victim == nullptr) {
+      return false;  // nothing left to demote yet still over: can't fit
+    }
+    demote_locked(victim);
+  }
+  return true;
+}
+
+int KvStore::publish_prefix(const Key128& key, uint32_t depth,
+                            const void* data, size_t len,
+                            const uint64_t* tokens, size_t ntokens,
+                            int64_t lease_ms, KvPrefixMeta* out,
+                            uint64_t min_generation) {
+  kv_ensure_registered();
+  if (key.zero() || data == nullptr || len == 0) {
+    return -1;
+  }
+  Key128 hash;
+  kv_content_hash(data, len, tokens, ntokens, &hash);
+  const uint64_t total_budget = static_cast<uint64_t>(std::max<int64_t>(
+      store_bytes_flag() != nullptr ? store_bytes_flag()->int64_value()
+                                    : (1ll << 30),
+      1));
+  const uint64_t hot_budget = static_cast<uint64_t>(std::max<int64_t>(
+      prefix_hot_bytes_flag() != nullptr
+          ? prefix_hot_bytes_flag()->int64_value()
+          : (256ll << 20),
+      1));
+  if (len > total_budget) {
+    return -1;
+  }
+  const int64_t now = monotonic_time_us();
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = prefix_blocks_.find(hash);
+  if (it != prefix_blocks_.end()) {
+    if (it->second.deadline_us > now) {
+      // Live block with identical content: THE cache-hit path.  The
+      // lease renews and the record echoes, but kEKvExists tells the
+      // caller these bytes did NOT need recomputing/copying.
+      PrefixBlock& b = it->second;
+      b.deadline_us = effective_lease_us(lease_ms);
+      b.touch_seq = ++touch_counter_;
+      if (out != nullptr) {
+        *out = b.meta;
+      }
+      return kEKvExists;
+    }
+    evict_prefix_locked(hash);  // lapsed: fold to tombstone, re-admit
+  }
+  // Total-store pressure (blocks + hot + cold vs trpc_kv_store_bytes):
+  // expired blocks drop first, then LRU cold, then LRU hot — dropping
+  // always tombstones so evicted fetches answer kv-stale.
+  while (bytes_ + prefix_hot_bytes_ + prefix_cold_bytes_ + len >
+             total_budget &&
+         !prefix_blocks_.empty()) {
+    Key128 victim;
+    uint64_t oldest_cold = std::numeric_limits<uint64_t>::max();
+    uint64_t oldest_hot = std::numeric_limits<uint64_t>::max();
+    Key128 victim_cold;
+    Key128 victim_hot;
+    bool found = false;
+    for (const auto& [h, b] : prefix_blocks_) {
+      if (b.deadline_us <= now) {
+        victim = h;
+        found = true;
+        break;
+      }
+      if (!b.hot && b.touch_seq < oldest_cold) {
+        oldest_cold = b.touch_seq;
+        victim_cold = h;
+      }
+      if (b.hot && b.touch_seq < oldest_hot) {
+        oldest_hot = b.touch_seq;
+        victim_hot = h;
+      }
+    }
+    if (!found) {
+      victim = oldest_cold != std::numeric_limits<uint64_t>::max()
+                   ? victim_cold
+                   : victim_hot;
+    }
+    evict_prefix_locked(victim);
+  }
+  if (bytes_ + prefix_hot_bytes_ + prefix_cold_bytes_ + len >
+      total_budget) {
+    return -1;  // regular blocks own the budget: don't evict them here
+  }
+  PrefixBlock b;
+  b.meta.key = key;
+  b.meta.hash = hash;
+  b.meta.generation =
+      std::max(prefix_tombstones_[hash] + 1, min_generation);
+  prefix_tombstones_[hash] = b.meta.generation;
+  b.meta.len = len;
+  b.meta.depth = depth;
+  b.deadline_us = effective_lease_us(lease_ms);
+  b.touch_seq = ++touch_counter_;
+  // Hot placement: store-owned registered pages so fetches serve
+  // zero-copy.  Falls to the cold tier when the block outsizes the hot
+  // budget or registered memory is exhausted — cold still serves.
+  bool placed_hot = false;
+  if (fit_hot_locked(len, hot_budget)) {
+    uint64_t rkey = 0;
+    void* pages = rma_alloc(len, &rkey);
+    if (pages != nullptr) {
+      memcpy(pages, data, len);
+      uint64_t pin_rkey = 0;
+      uint64_t pin_off = 0;
+      b.map = rma_pin_exportable(pages, len, &pin_rkey, &pin_off);
+      if (b.map != nullptr) {
+        b.hot_data = static_cast<char*>(pages);
+        b.meta.rkey = pin_rkey;
+        b.meta.off = pin_off;
+        b.hot = true;
+        prefix_hot_bytes_ += len;
+        placed_hot = true;
+      } else {
+        rma_free(pages);
+      }
+    }
+  }
+  if (!placed_hot) {
+    b.cold.assign(static_cast<const char*>(data), len);
+    prefix_cold_bytes_ += len;
+  }
+  if (out != nullptr) {
+    *out = b.meta;
+  }
+  record_kv(hash.lo, kKvOpPublish, len);
+  prefix_blocks_[hash] = std::move(b);
+  kv_prefix_vars().publish_total << 1;
+  return 0;
+}
+
+int KvStore::fetch_prefix(const Key128& hash, uint64_t expected_gen,
+                          IOBuf* out) {
+  kv_ensure_registered();
+  const int64_t now = monotonic_time_us();
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = prefix_blocks_.find(hash);
+  if (it == prefix_blocks_.end() || it->second.deadline_us <= now) {
+    if (it != prefix_blocks_.end()) {
+      evict_prefix_locked(hash);  // serve-time validity, as fetch()
+    }
+    if (prefix_tombstones_.find(hash) != prefix_tombstones_.end()) {
+      kv_vars().stale_total << 1;
+      record_kv(hash.lo, kKvOpStale, 0);
+      return kEKvStale;
+    }
+    return kEKvMiss;
+  }
+  PrefixBlock& b = it->second;
+  // expected_gen 0 accepts any live generation (content addressing
+  // already names the exact bytes; the generation only fences zombies).
+  if (expected_gen != 0 && b.meta.generation != expected_gen) {
+    kv_vars().stale_total << 1;
+    record_kv(hash.lo, kKvOpStale, b.meta.len);
+    return kEKvStale;
+  }
+  b.touch_seq = ++touch_counter_;
+  if (!b.hot) {
+    kv_prefix_counters().bump(kv_prefix_counters().cold_hits);
+    // Promotion-on-hit: copy back into registered pages so the NEXT
+    // fetch is zero-copy again.  Failure to promote (registered memory
+    // exhausted) still serves — a plain copy of the cold bytes.
+    const uint64_t hot_budget = static_cast<uint64_t>(std::max<int64_t>(
+        prefix_hot_bytes_flag() != nullptr
+            ? prefix_hot_bytes_flag()->int64_value()
+            : (256ll << 20),
+        1));
+    bool promoted = false;
+    if (fit_hot_locked(b.meta.len, hot_budget)) {
+      uint64_t rkey = 0;
+      void* pages = rma_alloc(b.meta.len, &rkey);
+      if (pages != nullptr) {
+        memcpy(pages, b.cold.data(), b.meta.len);
+        uint64_t pin_rkey = 0;
+        uint64_t pin_off = 0;
+        std::shared_ptr<RmaMapping> map =
+            rma_pin_exportable(pages, b.meta.len, &pin_rkey, &pin_off);
+        if (map != nullptr) {
+          b.hot_data = static_cast<char*>(pages);
+          b.map = std::move(map);
+          b.meta.rkey = pin_rkey;
+          b.meta.off = pin_off;
+          b.hot = true;
+          prefix_hot_bytes_ += b.meta.len;
+          prefix_cold_bytes_ -= b.meta.len;
+          b.cold.clear();
+          b.cold.shrink_to_fit();
+          kv_prefix_counters().bump(kv_prefix_counters().promote);
+          record_kv(hash.lo, kKvOpPromote, b.meta.len);
+          promoted = true;
+        } else {
+          rma_free(pages);
+        }
+      }
+    }
+    if (!promoted) {
+      out->append(b.cold.data(), b.meta.len);
+      kv_prefix_vars().fetch_total << 1;
+      kv_vars().fetch_bytes << static_cast<int64_t>(b.meta.len);
+      record_kv(hash.lo, kKvOpServe, b.meta.len);
+      return 0;
+    }
+  } else {
+    kv_prefix_counters().bump(kv_prefix_counters().hot_hits);
+  }
+  auto* ctx = new KvServeCtx{b.map};
+  out->append_user_data(b.hot_data, b.meta.len, &kv_serve_deleter, ctx);
+  kv_prefix_vars().fetch_total << 1;
+  kv_vars().fetch_bytes << static_cast<int64_t>(b.meta.len);
+  record_kv(hash.lo, kKvOpServe, b.meta.len);
+  return 0;
+}
+
+int KvStore::withdraw_prefix(const Key128& hash) {
+  std::lock_guard<std::mutex> g(mu_);
+  if (prefix_blocks_.find(hash) == prefix_blocks_.end()) {
+    return kEKvMiss;
+  }
+  evict_prefix_locked(hash);
+  return 0;
+}
+
+size_t KvStore::prefix_count() {
+  std::lock_guard<std::mutex> g(mu_);
+  return prefix_blocks_.size();
+}
+
+uint64_t KvStore::prefix_hot_bytes() {
+  std::lock_guard<std::mutex> g(mu_);
+  return prefix_hot_bytes_;
+}
+
+uint64_t KvStore::prefix_cold_bytes() {
+  std::lock_guard<std::mutex> g(mu_);
+  return prefix_cold_bytes_;
 }
 
 // ---- KvRegistry ----------------------------------------------------------
@@ -468,6 +1015,166 @@ int KvRegistry::renew(uint64_t block_id, int64_t lease_ms,
   return 0;
 }
 
+// ---- KvRegistry prefix records (content-addressed replica sets) ----------
+
+int KvRegistry::put_prefix(const KvPrefixMeta& meta, int64_t lease_ms,
+                           uint64_t* gen_out) {
+  kv_ensure_registered();
+  if (meta.key.zero() || meta.hash.zero() || meta.len == 0 ||
+      meta.generation == 0 || meta.node[0] == '\0') {
+    return kEKvStale;  // generation 0 is never minted; anonymous
+                       // replicas can't be fetched from
+  }
+  const int64_t now = monotonic_time_us();
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = prefix_.find(meta.key);
+  if (it == prefix_.end()) {
+    PrefixEntry e;
+    e.hash = meta.hash;
+    e.depth = meta.depth;
+    e.len = meta.len;
+    it = prefix_.emplace(meta.key, std::move(e)).first;
+  } else if (it->second.hash != meta.hash) {
+    // Same chain key, different bytes: token/content divergence (a
+    // nondeterministic prefill, or corruption).  Never silently alias —
+    // the publisher must treat its bytes as uncacheable.
+    return kEKvStale;
+  }
+  PrefixEntry& e = it->second;
+  // Lazy lease pruning (the fence map survives — pruning a replica
+  // must not reopen the zombie window).
+  e.replicas.erase(
+      std::remove_if(e.replicas.begin(), e.replicas.end(),
+                     [now](const PrefixReplica& r) {
+                       return r.deadline_us <= now;
+                     }),
+      e.replicas.end());
+  const std::string node(meta.node);
+  uint64_t& fence = e.last_gen[node];
+  if (meta.generation < fence) {
+    return kEKvStale;  // zombie publisher re-offering an old generation
+  }
+  for (PrefixReplica& r : e.replicas) {
+    if (node == r.meta.node) {
+      if (meta.generation == r.meta.generation) {
+        // Idempotent re-register: content addressing makes this the
+        // common path (every cache hit re-offers) — renew the lease.
+        r.deadline_us = effective_lease_us(lease_ms);
+        if (gen_out != nullptr) {
+          *gen_out = meta.generation;
+        }
+        return kEKvExists;
+      }
+      r.meta = meta;  // newer generation replaces in place
+      r.deadline_us = effective_lease_us(lease_ms);
+      fence = meta.generation;
+      if (gen_out != nullptr) {
+        *gen_out = meta.generation;
+      }
+      kv_prefix_vars().put_total << 1;
+      return 0;
+    }
+  }
+  const bool folded = !e.replicas.empty();
+  PrefixReplica r;
+  r.meta = meta;
+  r.deadline_us = effective_lease_us(lease_ms);
+  e.replicas.push_back(std::move(r));
+  fence = std::max(fence, meta.generation);
+  if (folded) {
+    // N publishers, one record: the fleet-wide dedup event.
+    kv_prefix_counters().bump(kv_prefix_counters().dedup);
+  }
+  kv_prefix_vars().put_total << 1;
+  if (gen_out != nullptr) {
+    *gen_out = meta.generation;
+  }
+  return 0;
+}
+
+size_t KvRegistry::match(const Key128* keys, size_t n,
+                         std::vector<KvPrefixMeta>* out,
+                         std::vector<int64_t>* lease_out) {
+  kv_ensure_registered();
+  const int64_t now = monotonic_time_us();
+  std::lock_guard<std::mutex> g(mu_);
+  kv_prefix_vars().match_total << 1;
+  size_t matched = 0;
+  for (size_t i = 0; i < n; ++i) {
+    auto it = prefix_.find(keys[i]);
+    if (it == prefix_.end()) {
+      break;  // first miss ends the longest cached prefix
+    }
+    PrefixEntry& e = it->second;
+    e.replicas.erase(
+        std::remove_if(e.replicas.begin(), e.replicas.end(),
+                       [now](const PrefixReplica& r) {
+                         return r.deadline_us <= now;
+                       }),
+        e.replicas.end());
+    if (e.replicas.empty()) {
+      break;  // all replicas lapsed: the chain stops here
+    }
+    for (const PrefixReplica& r : e.replicas) {
+      if (out != nullptr) {
+        out->push_back(r.meta);
+      }
+      if (lease_out != nullptr) {
+        lease_out->push_back((r.deadline_us - now) / 1000);
+      }
+    }
+    ++matched;
+  }
+  kv_prefix_vars().match_blocks << static_cast<int64_t>(matched);
+  return matched;
+}
+
+int KvRegistry::evict_prefix(const Key128& key, const char* node) {
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = prefix_.find(key);
+  if (it == prefix_.end()) {
+    return kEKvMiss;
+  }
+  std::vector<PrefixReplica>& reps = it->second.replicas;
+  for (auto r = reps.begin(); r != reps.end(); ++r) {
+    if (node != nullptr && strncmp(r->meta.node, node,
+                                   sizeof(r->meta.node)) == 0) {
+      reps.erase(r);
+      return 0;  // the fence map stays: no zombie window reopens
+    }
+  }
+  return kEKvMiss;
+}
+
+size_t KvRegistry::prefix_count() {
+  const int64_t now = monotonic_time_us();
+  std::lock_guard<std::mutex> g(mu_);
+  size_t n = 0;
+  for (const auto& [key, e] : prefix_) {
+    for (const PrefixReplica& r : e.replicas) {
+      if (r.deadline_us > now) {
+        ++n;
+        break;
+      }
+    }
+  }
+  return n;
+}
+
+size_t KvRegistry::prefix_replicas() {
+  const int64_t now = monotonic_time_us();
+  std::lock_guard<std::mutex> g(mu_);
+  size_t n = 0;
+  for (const auto& [key, e] : prefix_) {
+    for (const PrefixReplica& r : e.replicas) {
+      if (r.deadline_us > now) {
+        ++n;
+      }
+    }
+  }
+  return n;
+}
+
 size_t KvRegistry::count() {
   std::lock_guard<std::mutex> g(mu_);
   return entries_.size();
@@ -477,6 +1184,7 @@ void KvRegistry::clear() {
   std::lock_guard<std::mutex> g(mu_);
   entries_.clear();
   last_gen_.clear();
+  prefix_.clear();
 }
 
 // ---- native handlers -----------------------------------------------------
@@ -490,6 +1198,31 @@ bool parse_wire(const IOBuf& req, KvWire* w) {
   req.copy_to(w, sizeof(KvWire));
   w->node[sizeof(w->node) - 1] = '\0';
   return true;
+}
+
+bool parse_prefix_wire(const IOBuf& req, KvPrefixWire* w) {
+  if (req.size() < sizeof(KvPrefixWire)) {
+    return false;
+  }
+  req.copy_to(w, sizeof(KvPrefixWire));
+  w->node[sizeof(w->node) - 1] = '\0';
+  return true;
+}
+
+void prefix_meta_to_wire(const KvPrefixMeta& m, int64_t lease_ms,
+                         KvPrefixWire* w) {
+  memset(w, 0, sizeof(*w));
+  w->key_hi = m.key.hi;
+  w->key_lo = m.key.lo;
+  w->hash_hi = m.hash.hi;
+  w->hash_lo = m.hash.lo;
+  w->generation = m.generation;
+  w->rkey = m.rkey;
+  w->off = m.off;
+  w->len = m.len;
+  w->lease_ms = lease_ms;
+  w->depth = m.depth;
+  memcpy(w->node, m.node, sizeof(w->node));
 }
 
 void respond_gen(IOBuf* resp, uint64_t gen) {
@@ -513,7 +1246,7 @@ int kv_attach_store(Server* s) {
   // node's records fails kv-stale, invalidates, and re-resolves through
   // the registry instead of ever fetching from a dying pid.
   s->add_drain_hook([] { kv_store().withdraw_all(); });
-  return s->RegisterMethod(
+  const int rc_fetch = s->RegisterMethod(
       kKvFetchMethod, [](Controller* cntl, const IOBuf& req, IOBuf* resp,
                          Closure done) {
         KvWire w;
@@ -538,14 +1271,39 @@ int kv_attach_store(Server* s) {
           fail_kv(cntl, rc, "fetch");
         }
         done();
-      }) == 0
-             ? 0
-             : -1;
+      });
+  const int rc_prefix = s->RegisterMethod(
+      kKvPrefixFetchMethod, [](Controller* cntl, const IOBuf& req,
+                               IOBuf* resp, Closure done) {
+        KvPrefixWire w;
+        if (!parse_prefix_wire(req, &w)) {
+          cntl->SetFailed(EINVAL, "bad Kv.FetchPrefix request");
+          done();
+          return;
+        }
+        if (cntl->remaining_us() == 0) {
+          // Same shed as Kv.Fetch: never pin block pages for a response
+          // whose budget already died in the queue.
+          cntl->SetFailed(kEDeadlineExpired,
+                          "deadline expired before prefix fetch");
+          done();
+          return;
+        }
+        Key128 hash;
+        hash.hi = w.hash_hi;
+        hash.lo = w.hash_lo;
+        const int rc = kv_store().fetch_prefix(hash, w.generation, resp);
+        if (rc != 0) {
+          fail_kv(cntl, rc, "fetch-prefix");
+        }
+        done();
+      });
+  return rc_fetch == 0 && rc_prefix == 0 ? 0 : -1;
 }
 
 int kv_attach_registry(Server* s) {
   kv_ensure_registered();
-  int rcs[4] = {0, 0, 0, 0};
+  int rcs[6] = {0, 0, 0, 0, 0, 0};
   rcs[0] = s->RegisterMethod(
       kKvRegisterMethod, [](Controller* cntl, const IOBuf& req, IOBuf* resp,
                             Closure done) {
@@ -635,7 +1393,76 @@ int kv_attach_registry(Server* s) {
         }
         done();
       });
-  return rcs[0] == 0 && rcs[1] == 0 && rcs[2] == 0 && rcs[3] == 0 ? 0 : -1;
+  rcs[4] = s->RegisterMethod(
+      kKvPrefixPutMethod, [](Controller* cntl, const IOBuf& req,
+                             IOBuf* resp, Closure done) {
+        KvPrefixWire w;
+        if (!parse_prefix_wire(req, &w)) {
+          cntl->SetFailed(EINVAL, "bad KvReg.PutPrefix request");
+          done();
+          return;
+        }
+        KvPrefixMeta m;
+        m.key.hi = w.key_hi;
+        m.key.lo = w.key_lo;
+        m.hash.hi = w.hash_hi;
+        m.hash.lo = w.hash_lo;
+        m.generation = w.generation;
+        m.rkey = w.rkey;
+        m.off = w.off;
+        m.len = w.len;
+        m.depth = w.depth;
+        memcpy(m.node, w.node, sizeof(m.node));
+        uint64_t gen = 0;
+        const int rc = kv_registry().put_prefix(m, w.lease_ms, &gen);
+        if (rc != 0) {
+          // kEKvExists included: the caller already holds this exact
+          // record (idempotent renew) — the Python client maps it to
+          // its dedup/cache-hit accounting, not to a failure.
+          fail_kv(cntl, rc, "put-prefix");
+        } else {
+          respond_gen(resp, gen);
+        }
+        done();
+      });
+  rcs[5] = s->RegisterMethod(
+      kKvPrefixMatchMethod, [](Controller* cntl, const IOBuf& req,
+                               IOBuf* resp, Closure done) {
+        static_assert(sizeof(Key128) == 16, "Key128 is wire format");
+        uint64_t nkeys = 0;
+        if (req.size() < sizeof(nkeys)) {
+          cntl->SetFailed(EINVAL, "bad KvReg.Match request");
+          done();
+          return;
+        }
+        req.copy_to(&nkeys, sizeof(nkeys));
+        if (nkeys == 0 || nkeys > 4096 ||
+            req.size() < sizeof(nkeys) + nkeys * sizeof(Key128)) {
+          cntl->SetFailed(EINVAL, "bad KvReg.Match key count");
+          done();
+          return;
+        }
+        std::vector<Key128> keys(nkeys);
+        req.copy_to(keys.data(), nkeys * sizeof(Key128), sizeof(nkeys));
+        std::vector<KvPrefixMeta> metas;
+        std::vector<int64_t> leases;
+        kv_registry().match(keys.data(), keys.size(), &metas, &leases);
+        // Response: u64 record count, then one KvPrefixWire per live
+        // replica, grouped in chain order (lease_ms = remaining ms).
+        // Zero records is a valid answer: no cached prefix.
+        const uint64_t nrecords = metas.size();
+        resp->append(&nrecords, sizeof(nrecords));
+        for (size_t i = 0; i < metas.size(); ++i) {
+          KvPrefixWire w;
+          prefix_meta_to_wire(metas[i], leases[i], &w);
+          resp->append(&w, sizeof(w));
+        }
+        done();
+      });
+  return rcs[0] == 0 && rcs[1] == 0 && rcs[2] == 0 && rcs[3] == 0 &&
+                 rcs[4] == 0 && rcs[5] == 0
+             ? 0
+             : -1;
 }
 
 // ---- KvCache -------------------------------------------------------------

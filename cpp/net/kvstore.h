@@ -56,6 +56,7 @@
 #include <mutex>
 #include <string>
 #include <unordered_map>
+#include <vector>
 
 #include "base/iobuf.h"
 
@@ -110,6 +111,9 @@ inline constexpr const char* kKvRegisterMethod = "KvReg.Register";
 inline constexpr const char* kKvLookupMethod = "KvReg.Lookup";
 inline constexpr const char* kKvEvictMethod = "KvReg.Evict";
 inline constexpr const char* kKvRenewMethod = "KvReg.Renew";
+inline constexpr const char* kKvPrefixPutMethod = "KvReg.PutPrefix";
+inline constexpr const char* kKvPrefixMatchMethod = "KvReg.Match";
+inline constexpr const char* kKvPrefixFetchMethod = "Kv.FetchPrefix";
 
 // timeline kKvBlock `b` op tags (b = op<<56 | len; mirrored by
 // observe.py TIMELINE_KV_OPS and tools/trace_stitch.py).
@@ -117,6 +121,101 @@ constexpr uint64_t kKvOpPublish = 1;
 constexpr uint64_t kKvOpServe = 2;
 constexpr uint64_t kKvOpEvict = 3;
 constexpr uint64_t kKvOpStale = 4;
+constexpr uint64_t kKvOpPromote = 5;  // cold prefix block re-pinned hot
+constexpr uint64_t kKvOpDemote = 6;   // hot prefix block spilled cold
+
+// ---- content addressing (prefix cache, ISSUE 17) -------------------------
+
+// 128-bit content key.  crc32c is taken by the transport checksum
+// plane, so prefix blocks use a two-lane 64-bit mix over the block
+// bytes AND the token-id span: identical (bytes, tokens) pairs hash
+// identically on every node — the fleet-wide dedup key.
+struct Key128 {
+  uint64_t hi = 0;
+  uint64_t lo = 0;
+  bool operator==(const Key128& o) const {
+    return hi == o.hi && lo == o.lo;
+  }
+  bool operator!=(const Key128& o) const { return !(*this == o); }
+  bool zero() const { return hi == 0 && lo == 0; }
+};
+struct Key128Hash {
+  size_t operator()(const Key128& k) const {
+    return static_cast<size_t>(k.hi ^ (k.lo * 0x9e3779b97f4a7c15ull));
+  }
+};
+
+// Content hash of one prefix block: the block bytes plus the token-id
+// span they were computed from (two prompts that collide on bytes but
+// diverge on tokens must NOT dedup).  Deterministic across processes.
+void kv_content_hash(const void* data, size_t len, const uint64_t* tokens,
+                     size_t ntokens, Key128* out);
+
+// Chain keys for a token-id sequence: key_i folds key_{i-1} with the
+// i-th block_tokens-sized token chunk, so key_i names the WHOLE prefix
+// through block i — the registry's "trie" is a flat map over chain
+// keys, and longest-prefix match is a walk until first miss.  Computed
+// from token ids alone: the decode side derives them without holding
+// any bytes.  block_tokens <= 0 uses trpc_kv_prefix_block_tokens.
+// Returns the number of FULL blocks written (partial tail ignored).
+size_t kv_prefix_chain(const uint64_t* tokens, size_t ntokens,
+                       int64_t block_tokens, Key128* keys, size_t max_keys);
+
+// Addressing record for one prefix-block replica: chain key (where in
+// the trie), content hash (what bytes), and where THIS replica lives.
+struct KvPrefixMeta {
+  Key128 key;         // chain key (token-derived)
+  Key128 hash;        // content hash (bytes + token span)
+  uint64_t generation = 0;
+  uint64_t rkey = 0;  // valid while the replica is hot
+  uint64_t off = 0;
+  uint64_t len = 0;
+  uint32_t depth = 0;  // 0-based block index in the prefix chain
+  char node[64] = {};
+};
+
+// Wire form of every prefix-cache RPC (fixed little-endian, 144 bytes;
+// mirrored by brpc_tpu/rpc/kv.py _PREFIX_WIRE — kv-wire marker).
+// PutPrefix sends all fields; FetchPrefix sends hash + generation;
+// Match sends a u64 count + count x 16-byte chain keys and answers a
+// u64 record count + that many KvPrefixWire records (one per live
+// replica, grouped in chain order — lease_ms = remaining ms).
+struct KvPrefixWire {
+  uint64_t key_hi;
+  uint64_t key_lo;
+  uint64_t hash_hi;
+  uint64_t hash_lo;
+  uint64_t generation;
+  uint64_t rkey;
+  uint64_t off;
+  uint64_t len;
+  int64_t lease_ms;
+  uint32_t depth;
+  uint32_t flags;  // bit 0: replica currently cold (tier telemetry)
+  char node[64];
+};
+static_assert(sizeof(KvPrefixWire) == 144,
+              "KvPrefixWire is wire format — fixed");
+
+// Process-wide prefix-tier outcome counters (read by the capi and the
+// perf harness; mirrored as vars by kvstore.cc).
+struct KvPrefixCounters {
+  std::atomic<uint64_t> promote{0};    // cold block re-pinned hot on fetch
+  std::atomic<uint64_t> demote{0};     // hot block spilled to the heap tier
+  std::atomic<uint64_t> hot_hits{0};   // prefix fetches served zero-copy
+  std::atomic<uint64_t> cold_hits{0};  // prefix fetches served from cold
+  std::atomic<uint64_t> dedup{0};      // registry replica folds (same hash)
+  // Relaxed: monotonic stat counters — nothing is published through
+  // them; a stale read only blurs a dashboard or test assertion.
+  void bump(std::atomic<uint64_t>& c) {
+    c.fetch_add(1, std::memory_order_relaxed);
+  }
+  // Relaxed: same monotonic-stat rationale as bump().
+  static uint64_t read(const std::atomic<uint64_t>& c) {
+    return c.load(std::memory_order_relaxed);
+  }
+};
+KvPrefixCounters& kv_prefix_counters();
 
 // ---- node-local block store (prefill side) -------------------------------
 
@@ -164,6 +263,38 @@ class KvStore {
           uint64_t* len, std::shared_ptr<RmaMapping>* map,
           uint64_t* gen_out);
 
+  // ---- content-addressed prefix tier (two-tier store, ISSUE 17) ----
+  //
+  // Publishes one prefix block under its CONTENT hash.  Unlike
+  // publish(), the store COPIES the bytes into a store-owned
+  // registered-RMA region (hot tier) — callers need no RmaBuffer, and
+  // demote/promote can move the bytes without caller coordination.
+  // The content hash is computed here (bytes + token span) and echoed
+  // in *out with the minted generation.  Re-publishing a LIVE block
+  // with the same content hash is the cache-hit path: the lease renews
+  // and *out fills, but the return is kEKvExists so callers can count
+  // bytes-NOT-recomputed.  Budget: hot bytes under
+  // trpc_kv_prefix_hot_bytes (LRU hot blocks DEMOTE to the cold heap
+  // tier, never drop); total store bytes (blocks + hot + cold) under
+  // trpc_kv_store_bytes (expired-then-LRU cold blocks drop with
+  // generation tombstones).  Returns 0, kEKvExists, or -1.
+  int publish_prefix(const Key128& key, uint32_t depth, const void* data,
+                     size_t len, const uint64_t* tokens, size_t ntokens,
+                     int64_t lease_ms, KvPrefixMeta* out,
+                     uint64_t min_generation = 0);
+  // Serves one prefix block by content hash: generation AND lease
+  // validated at serve time (same stale rules as fetch()).  A hot hit
+  // serves zero-copy from the registered pages; a cold hit PROMOTES the
+  // block back into a registered region first (falling back to a plain
+  // copy if registered memory is exhausted).  0, kEKvStale, kEKvMiss.
+  int fetch_prefix(const Key128& hash, uint64_t expected_gen, IOBuf* out);
+  // Explicit eviction by content hash (generation tombstones).
+  int withdraw_prefix(const Key128& hash);
+
+  size_t prefix_count();
+  uint64_t prefix_hot_bytes();
+  uint64_t prefix_cold_bytes();
+
   size_t count();
   uint64_t bytes_used();
   void clear();  // tests: drop every block AND tombstone
@@ -176,16 +307,34 @@ class KvStore {
     int64_t deadline_us = 0;
     uint64_t touch_seq = 0;  // LRU clock (publish/fetch bumps)
   };
+  struct PrefixBlock {
+    KvPrefixMeta meta;        // rkey/off valid only while hot
+    char* hot_data = nullptr;  // store-owned rma_alloc region (hot tier)
+    std::shared_ptr<RmaMapping> map;  // pins hot pages across serves
+    std::string cold;                 // the bytes while demoted
+    bool hot = false;
+    int64_t deadline_us = 0;
+    uint64_t touch_seq = 0;
+  };
   // Evicts one block under mu_ (iterator-safe helper).
   void evict_locked(uint64_t block_id, bool count_var);
+  // Prefix-tier helpers, all under mu_: spill one hot block's bytes to
+  // the heap tier / drop one block entirely (tombstoning) / make room.
+  void demote_locked(PrefixBlock* b);
+  void evict_prefix_locked(const Key128& hash);
+  bool fit_hot_locked(uint64_t incoming, uint64_t hot_budget);
   std::mutex mu_;
   std::unordered_map<uint64_t, Block> blocks_;
+  std::unordered_map<Key128, PrefixBlock, Key128Hash> prefix_blocks_;
   // Last generation minted per block id, surviving eviction: a
   // re-published block continues the sequence, and a fetch for an
   // evicted block answers kEKvStale (record invalid) instead of
   // kEKvMiss (record unknown).
   std::unordered_map<uint64_t, uint64_t> tombstones_;
+  std::unordered_map<Key128, uint64_t, Key128Hash> prefix_tombstones_;
   uint64_t bytes_ = 0;
+  uint64_t prefix_hot_bytes_ = 0;
+  uint64_t prefix_cold_bytes_ = 0;
   uint64_t touch_counter_ = 0;
 };
 KvStore& kv_store();
@@ -209,6 +358,35 @@ class KvRegistry {
   // Extends a live record's lease; echoes the current generation.
   int renew(uint64_t block_id, int64_t lease_ms,
             uint64_t* gen_out = nullptr);
+
+  // ---- content-addressed prefix records (replica sets, ISSUE 17) ----
+  //
+  // Records one replica of a prefix block.  N publishers of the SAME
+  // chain key + content hash fold into ONE record with a replica set
+  // (fleet-wide dedup); each replica keeps its own lease deadline and
+  // generation, with the PR 12 zombie fence applied PER NODE (a
+  // publisher re-offering a generation at or below its last accepted
+  // one answers kEKvStale).  A chain key re-offered with a DIFFERENT
+  // content hash is rejected kEKvStale — token/content divergence must
+  // never silently alias.  Returns 0 and echoes the accepted
+  // generation; kEKvExists on an exact same-node same-generation
+  // double-register (the lease still renews — content-addressed
+  // registration is idempotent).
+  int put_prefix(const KvPrefixMeta& meta, int64_t lease_ms,
+                 uint64_t* gen_out);
+  // Longest cached prefix: walks keys[0..n) in order, stopping at the
+  // first key with no live replica.  Appends one KvPrefixMeta per LIVE
+  // replica of every matched block (grouped in chain order; expired
+  // replicas prune here) plus its remaining lease into the parallel
+  // lease_out (ms).  Returns the number of matched BLOCKS (depths).
+  size_t match(const Key128* keys, size_t n,
+               std::vector<KvPrefixMeta>* out,
+               std::vector<int64_t>* lease_out = nullptr);
+  // Drops one node's replica of one chain key (drain support).
+  int evict_prefix(const Key128& key, const char* node);
+  size_t prefix_count();   // live prefix records (chain keys)
+  size_t prefix_replicas();  // live replicas across all records
+
   size_t count();
   void clear();  // tests
 
@@ -217,9 +395,23 @@ class KvRegistry {
     KvBlockMeta meta;
     int64_t deadline_us = 0;
   };
+  struct PrefixReplica {
+    KvPrefixMeta meta;
+    int64_t deadline_us = 0;
+  };
+  struct PrefixEntry {
+    Key128 hash;        // the content hash every replica must agree on
+    uint32_t depth = 0;
+    uint64_t len = 0;
+    std::vector<PrefixReplica> replicas;
+    // Per-node zombie fence, surviving replica pruning: highest
+    // generation ever accepted from each node for this chain key.
+    std::unordered_map<std::string, uint64_t> last_gen;
+  };
   std::mutex mu_;
   std::unordered_map<uint64_t, Entry> entries_;
   std::unordered_map<uint64_t, uint64_t> last_gen_;
+  std::unordered_map<Key128, PrefixEntry, Key128Hash> prefix_;
 };
 KvRegistry& kv_registry();
 

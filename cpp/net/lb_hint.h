@@ -1,0 +1,84 @@
+// Ambient routing hint for cache-aware balancing (ISSUE 17).
+//
+// The c_hash_bl balancer walks a ketama ring blind to what each member
+// already holds; for KV-prefix traffic the decode side KNOWS (from a
+// KvReg.Match answer) which member holds the longest cached prefix.
+// A hint is that knowledge made ambient: the caller installs the
+// preferred endpoint around a ClusterChannel call, and the bounded-load
+// walk honors it IFF the hinted member is healthy and under the load
+// bound — bounded load always outranks affinity, so a hot replica's
+// overflow still diffuses along the ring (veto) instead of melting the
+// prefix owner.
+//
+// Thread-local by design: the sync ClusterChannel::CallMethod path
+// selects on the caller's thread (the async wrapper re-installs ambient
+// state in its fiber the same way trace context rides AsyncCall).  The
+// hint is one-shot per attempt 0 — retries already exclude the tried
+// node, so re-applying the hint would only re-pick a failed member.
+#pragma once
+
+#include <atomic>
+
+#include "base/endpoint.h"
+
+namespace trpc {
+
+// Fleet-visible outcome counters, exposed as vars by cluster.cc
+// (lb_hint_hit_total / lb_hint_veto_total / lb_hint_miss_total).
+struct LbHintCounters {
+  std::atomic<uint64_t> hit{0};    // hinted member selected
+  std::atomic<uint64_t> veto{0};   // hinted member over the load bound
+  std::atomic<uint64_t> miss{0};   // hinted member absent/unhealthy
+
+  // Relaxed: monotonic stat counters — nothing is published through
+  // them and staleness only blurs a dashboard read.
+  void bump(std::atomic<uint64_t>& c) {
+    c.fetch_add(1, std::memory_order_relaxed);
+  }
+  // Relaxed: same monotonic-stat rationale as bump().
+  static uint64_t read(const std::atomic<uint64_t>& c) {
+    return c.load(std::memory_order_relaxed);
+  }
+};
+LbHintCounters& lb_hint_counters();  // defined in cluster.cc
+
+namespace lb_hint_detail {
+// One slot per thread: {set, endpoint}.  inline thread_local keeps the
+// header self-contained (no TU to add for a hint that is pure state).
+struct Slot {
+  bool set = false;
+  EndPoint ep;
+};
+inline thread_local Slot tls_slot;
+}  // namespace lb_hint_detail
+
+inline void lb_hint_set(const EndPoint& ep) {
+  lb_hint_detail::tls_slot.set = true;
+  lb_hint_detail::tls_slot.ep = ep;
+}
+
+inline void lb_hint_clear() { lb_hint_detail::tls_slot.set = false; }
+
+// True (and fills *out) when a hint is installed on this thread.
+inline bool lb_hint_get(EndPoint* out) {
+  if (!lb_hint_detail::tls_slot.set) {
+    return false;
+  }
+  if (out != nullptr) {
+    *out = lb_hint_detail::tls_slot.ep;
+  }
+  return true;
+}
+
+// RAII scope for the capi / call sites: install on entry, always clear
+// on exit (a leaked hint would silently re-route the thread's NEXT
+// unrelated call).
+class LbHintScope {
+ public:
+  explicit LbHintScope(const EndPoint& ep) { lb_hint_set(ep); }
+  LbHintScope(const LbHintScope&) = delete;
+  LbHintScope& operator=(const LbHintScope&) = delete;
+  ~LbHintScope() { lb_hint_clear(); }
+};
+
+}  // namespace trpc

@@ -5,13 +5,16 @@
 
 #include <atomic>
 #include <fstream>
+#include <memory>
 #include <set>
 #include <string>
+#include <vector>
 
 #include "base/time.h"
 #include "fiber/fiber.h"
 #include "fiber/sync.h"
 #include "net/cluster.h"
+#include "net/lb_hint.h"
 #include "net/server.h"
 #include "tests/test_util.h"
 
@@ -512,6 +515,65 @@ TEST_CASE(destructor_races_inflight_probes) {
     usleep(15000 + (round % 3) * 10000);
     // ~ClusterChannel runs here.
   }
+}
+
+// Cache-aware routing (ISSUE 17): a prefix-hash hint steers c_hash_bl
+// to the member holding the cached prefix — unless bounded load vetoes,
+// in which case the ring walk takes over.
+TEST_CASE(chash_bl_hint_routing_and_veto) {
+  std::unique_ptr<LoadBalancer> lb(LoadBalancer::create("c_hash_bl"));
+  EXPECT(lb != nullptr);
+  std::vector<ServerNode> nodes(3);
+  std::vector<size_t> healthy = {0, 1, 2};
+  for (int i = 0; i < 3; ++i) {
+    nodes[i].ep.ip = 0x0100007f;  // 127.0.0.1
+    nodes[i].ep.port = 9000 + i;
+  }
+  uint64_t hit0, veto0, miss0;
+  LbHintCounters& c = lb_hint_counters();
+  hit0 = LbHintCounters::read(c.hit);
+  veto0 = LbHintCounters::read(c.veto);
+  miss0 = LbHintCounters::read(c.miss);
+  // Idle cluster + valid hint: honored regardless of ring order.
+  for (int i = 0; i < 3; ++i) {
+    LbHintScope scope(nodes[i].ep);
+    EXPECT_EQ(lb->select(healthy, nodes, 12345, 0),
+              static_cast<size_t>(i));
+  }
+  EXPECT_EQ(LbHintCounters::read(c.hit), hit0 + 3);
+  // Retries NEVER honor the hint (the hinted node was just tried).
+  {
+    LbHintScope scope(nodes[0].ep);
+    (void)lb->select(healthy, nodes, 12345, 1);
+    EXPECT_EQ(LbHintCounters::read(c.hit), hit0 + 3);
+    EXPECT_EQ(LbHintCounters::read(c.veto), veto0);
+  }
+  // Hinted node over the bounded-load bound: VETO, and the ring walk
+  // must pick one of the under-bound members instead.
+  nodes[2].inflight->store(100, std::memory_order_relaxed);
+  {
+    LbHintScope scope(nodes[2].ep);
+    const size_t picked = lb->select(healthy, nodes, 12345, 0);
+    EXPECT(picked == 0 || picked == 1);
+  }
+  EXPECT_EQ(LbHintCounters::read(c.veto), veto0 + 1);
+  nodes[2].inflight->store(0, std::memory_order_relaxed);
+  // Hint naming a member OUTSIDE the view (it drained away): miss,
+  // ring walk decides.
+  EndPoint gone;
+  gone.ip = 0x0100007f;
+  gone.port = 9999;
+  {
+    LbHintScope scope(gone);
+    (void)lb->select(healthy, nodes, 12345, 0);
+  }
+  EXPECT_EQ(LbHintCounters::read(c.miss), miss0 + 1);
+  // The scope is RAII: once it unwinds, no residue steers later picks.
+  EndPoint residue;
+  EXPECT(!lb_hint_get(&residue));
+  const size_t ring = lb->select(healthy, nodes, 12345, 0);
+  EXPECT_EQ(lb->select(healthy, nodes, 12345, 0), ring);  // pure ring
+  EXPECT_EQ(LbHintCounters::read(c.hit), hit0 + 3);
 }
 
 TEST_MAIN

@@ -434,4 +434,253 @@ TEST_CASE(kv_chunk_fault_whole_or_nothing_and_recovery) {
   rma_free(region);
 }
 
+// -- content-addressed prefix cache (ISSUE 17) ------------------------------
+
+namespace {
+
+KvPrefixMeta prefix_meta_for(const Key128& key, const Key128& hash,
+                             uint64_t gen, uint64_t len,
+                             const char* node, uint32_t depth = 0) {
+  KvPrefixMeta m;
+  m.key = key;
+  m.hash = hash;
+  m.generation = gen;
+  m.len = len;
+  m.depth = depth;
+  snprintf(m.node, sizeof(m.node), "%s", node);
+  return m;
+}
+
+Key128 k128(uint64_t hi, uint64_t lo) {
+  Key128 k;
+  k.hi = hi;
+  k.lo = lo;
+  return k;
+}
+
+}  // namespace
+
+TEST_CASE(kv_prefix_registry_dedup_replica_sets) {
+  KvReset reset;
+  KvRegistry& reg = kv_registry();
+  const Key128 key = k128(0x11, 0x22);
+  const Key128 hash = k128(0xAA, 0xBB);
+  uint64_t gen = 0;
+  // Two publishers of the SAME (key, hash): one record, two replicas.
+  EXPECT_EQ(reg.put_prefix(
+                prefix_meta_for(key, hash, 1, 4096, "127.0.0.1:1"),
+                60000, &gen), 0);
+  const uint64_t dedup0 =
+      KvPrefixCounters::read(kv_prefix_counters().dedup);
+  EXPECT_EQ(reg.put_prefix(
+                prefix_meta_for(key, hash, 1, 4096, "127.0.0.1:2"),
+                60000, &gen), 0);
+  EXPECT_EQ(reg.prefix_count(), 1u);
+  EXPECT_EQ(reg.prefix_replicas(), 2u);
+  EXPECT_EQ(KvPrefixCounters::read(kv_prefix_counters().dedup),
+            dedup0 + 1);
+  // Same node, same generation: idempotent renew (every cache hit
+  // re-offers), answered kEKvExists — no third replica.
+  EXPECT_EQ(reg.put_prefix(
+                prefix_meta_for(key, hash, 1, 4096, "127.0.0.1:1"),
+                60000, &gen), kEKvExists);
+  EXPECT_EQ(reg.prefix_replicas(), 2u);
+  // Same node, newer generation: replaces in place.
+  EXPECT_EQ(reg.put_prefix(
+                prefix_meta_for(key, hash, 3, 4096, "127.0.0.1:1"),
+                60000, &gen), 0);
+  EXPECT_EQ(gen, 3u);
+  EXPECT_EQ(reg.prefix_replicas(), 2u);
+  // Zombie publisher re-offering an older generation: fenced.
+  EXPECT_EQ(reg.put_prefix(
+                prefix_meta_for(key, hash, 2, 4096, "127.0.0.1:1"),
+                60000, &gen), kEKvStale);
+  // Same chain key, DIFFERENT content hash: divergence, never aliased.
+  EXPECT_EQ(reg.put_prefix(
+                prefix_meta_for(key, k128(0xAA, 0xCC), 1, 4096,
+                                "127.0.0.1:3"),
+                60000, &gen), kEKvStale);
+  // Generation 0 is never minted: malformed.
+  EXPECT_EQ(reg.put_prefix(
+                prefix_meta_for(key, hash, 0, 4096, "127.0.0.1:4"),
+                60000, &gen), kEKvStale);
+}
+
+TEST_CASE(kv_prefix_replica_lease_expiry_and_zombie_fence) {
+  KvReset reset;
+  KvRegistry& reg = kv_registry();
+  const Key128 key = k128(0x31, 0x32);
+  const Key128 hash = k128(0xDD, 0xEE);
+  uint64_t gen = 0;
+  EXPECT_EQ(reg.put_prefix(
+                prefix_meta_for(key, hash, 5, 1024, "127.0.0.1:1"),
+                60, &gen), 0);
+  EXPECT_EQ(reg.put_prefix(
+                prefix_meta_for(key, hash, 2, 1024, "127.0.0.1:2"),
+                60000, &gen), 0);
+  EXPECT_EQ(reg.prefix_replicas(), 2u);
+  usleep(90 * 1000);  // node 1's lease lapses; node 2's holds
+  std::vector<KvPrefixMeta> out;
+  EXPECT_EQ(reg.match(&key, 1, &out), 1u);
+  EXPECT_EQ(out.size(), 1u);  // the expired replica pruned in match
+  EXPECT(std::string(out[0].node) == "127.0.0.1:2");
+  // The per-node fence SURVIVES pruning: node 1 re-offering its old
+  // generation is still a zombie; a fresh generation re-admits.
+  EXPECT_EQ(reg.put_prefix(
+                prefix_meta_for(key, hash, 4, 1024, "127.0.0.1:1"),
+                60000, &gen), kEKvStale);
+  EXPECT_EQ(reg.put_prefix(
+                prefix_meta_for(key, hash, 6, 1024, "127.0.0.1:1"),
+                60000, &gen), 0);
+  EXPECT_EQ(reg.prefix_replicas(), 2u);
+}
+
+TEST_CASE(kv_prefix_trie_longest_match_walk) {
+  KvReset reset;
+  // Chain keys: deterministic, prefix-stable, block-size-sensitive.
+  uint64_t tokens[512];
+  for (size_t i = 0; i < 512; ++i) {
+    tokens[i] = 1000 + i;
+  }
+  Key128 chain[4], chain2[4], shorter[2];
+  EXPECT_EQ(kv_prefix_chain(tokens, 512, 128, chain, 4), 4u);
+  EXPECT_EQ(kv_prefix_chain(tokens, 512, 128, chain2, 4), 4u);
+  EXPECT_EQ(kv_prefix_chain(tokens, 300, 128, shorter, 2), 2u);
+  for (int i = 0; i < 4; ++i) {
+    EXPECT(chain[i] == chain2[i]);
+  }
+  EXPECT(chain[0] == shorter[0] && chain[1] == shorter[1]);
+  Key128 other_bs[2];
+  EXPECT_EQ(kv_prefix_chain(tokens, 512, 256, other_bs, 2), 2u);
+  EXPECT(other_bs[0] != chain[0]);  // block size folds into the keys
+  // A diverging token in block 1 changes keys 1..3 but not key 0.
+  uint64_t diverged[512];
+  memcpy(diverged, tokens, sizeof(tokens));
+  diverged[200] ^= 1;
+  Key128 chain_d[4];
+  EXPECT_EQ(kv_prefix_chain(diverged, 512, 128, chain_d, 4), 4u);
+  EXPECT(chain_d[0] == chain[0]);
+  EXPECT(chain_d[1] != chain[1] && chain_d[3] != chain[3]);
+
+  // Registry walk: 3 of 4 blocks cached -> longest prefix is 3; a hole
+  // at depth 1 stops the walk at 1 regardless of deeper blocks.
+  KvRegistry& reg = kv_registry();
+  const Key128 hash = k128(0x77, 0x88);
+  uint64_t gen = 0;
+  for (uint32_t d = 0; d < 3; ++d) {
+    EXPECT_EQ(reg.put_prefix(
+                  prefix_meta_for(chain[d], k128(0x77, 0x88 + d), 1,
+                                  4096, "127.0.0.1:1", d),
+                  60000, &gen), 0);
+  }
+  (void)hash;
+  std::vector<KvPrefixMeta> out;
+  std::vector<int64_t> leases;
+  EXPECT_EQ(reg.match(chain, 4, &out, &leases), 3u);
+  EXPECT_EQ(out.size(), 3u);
+  EXPECT_EQ(leases.size(), 3u);
+  for (size_t i = 0; i < out.size(); ++i) {
+    EXPECT_EQ(out[i].depth, static_cast<uint32_t>(i));
+    EXPECT(leases[i] > 0);
+  }
+  EXPECT_EQ(reg.evict_prefix(chain[1], "127.0.0.1:1"), 0);
+  EXPECT_EQ(reg.match(chain, 4, nullptr), 1u);  // the walk stops at the hole
+}
+
+TEST_CASE(kv_prefix_two_tier_promotion_on_hit) {
+  KvReset reset;
+  FlagGuard hot("trpc_kv_prefix_hot_bytes", std::to_string(1 << 20));
+  const size_t len = 768 << 10;
+  std::string a(len, '\0'), b(len, '\0');
+  fill_pattern(a.data(), len, 41);
+  fill_pattern(b.data(), len, 42);
+  uint64_t toks_a[4] = {1, 2, 3, 4}, toks_b[4] = {5, 6, 7, 8};
+  KvPrefixMeta ma, mb;
+  EXPECT_EQ(kv_store().publish_prefix(k128(1, 1), 0, a.data(), len,
+                                      toks_a, 4, 60000, &ma), 0);
+  EXPECT_EQ(ma.generation, 1u);
+  EXPECT(ma.rkey != 0);  // hot: registered pages
+  EXPECT_EQ(kv_store().prefix_hot_bytes(), len);
+  // Identical re-publish: the cache-hit path — kEKvExists, record
+  // echoed, NO new bytes admitted.
+  KvPrefixMeta dup;
+  EXPECT_EQ(kv_store().publish_prefix(k128(1, 1), 0, a.data(), len,
+                                      toks_a, 4, 60000, &dup), kEKvExists);
+  EXPECT(dup.hash == ma.hash);
+  EXPECT_EQ(kv_store().prefix_count(), 1u);
+  // Block B exceeds the remaining hot budget: A (LRU) demotes, B lands
+  // hot.  Nothing drops.
+  const uint64_t demote0 =
+      KvPrefixCounters::read(kv_prefix_counters().demote);
+  EXPECT_EQ(kv_store().publish_prefix(k128(1, 2), 1, b.data(), len,
+                                      toks_b, 4, 60000, &mb), 0);
+  EXPECT_EQ(kv_store().prefix_count(), 2u);
+  EXPECT_EQ(kv_store().prefix_hot_bytes(), len);
+  EXPECT_EQ(kv_store().prefix_cold_bytes(), len);
+  EXPECT_EQ(KvPrefixCounters::read(kv_prefix_counters().demote),
+            demote0 + 1);
+  // Fetching demoted A is a COLD hit that promotes it back (B demotes
+  // in turn) — the bytes are identical either way.
+  const uint64_t promote0 =
+      KvPrefixCounters::read(kv_prefix_counters().promote);
+  IOBuf out_a;
+  EXPECT_EQ(kv_store().fetch_prefix(ma.hash, ma.generation, &out_a), 0);
+  EXPECT(check_pattern(out_a, len, 41));
+  EXPECT_EQ(KvPrefixCounters::read(kv_prefix_counters().promote),
+            promote0 + 1);
+  EXPECT_EQ(kv_store().prefix_hot_bytes(), len);   // A hot again
+  EXPECT_EQ(kv_store().prefix_cold_bytes(), len);  // B demoted
+  // A second fetch of A is a hot zero-copy hit.
+  const uint64_t hot0 =
+      KvPrefixCounters::read(kv_prefix_counters().hot_hits);
+  IOBuf out_a2;
+  EXPECT_EQ(kv_store().fetch_prefix(ma.hash, ma.generation, &out_a2), 0);
+  EXPECT(check_pattern(out_a2, len, 41));
+  EXPECT_EQ(out_a2.block_count(), 1u);  // served from registered pages
+  EXPECT_EQ(KvPrefixCounters::read(kv_prefix_counters().hot_hits),
+            hot0 + 1);
+  // Wrong generation: stale.  Unknown hash: miss.
+  IOBuf bad;
+  EXPECT_EQ(kv_store().fetch_prefix(ma.hash, 99, &bad), kEKvStale);
+  EXPECT_EQ(kv_store().fetch_prefix(k128(9, 9), 0, &bad), kEKvMiss);
+}
+
+TEST_CASE(kv_prefix_demote_under_budget_drops_cold_last) {
+  KvReset reset;
+  FlagGuard total("trpc_kv_store_bytes", std::to_string(3 << 20));
+  FlagGuard hot("trpc_kv_prefix_hot_bytes", std::to_string(1 << 20));
+  const size_t len = 1 << 20;
+  std::string buf(len, '\0');
+  KvPrefixMeta m[4];
+  for (uint64_t i = 0; i < 4; ++i) {
+    fill_pattern(buf.data(), len, 50 + i);
+    uint64_t toks[2] = {i, i + 1};
+    EXPECT_EQ(kv_store().publish_prefix(k128(2, i), 0, buf.data(), len,
+                                        toks, 2, 60000, &m[i]), 0);
+  }
+  // Budget holds 3 x 1MB: block 0 (the LRU COLD block) dropped with a
+  // tombstone; 1..3 survive — the newest hot, the others demoted.
+  EXPECT_EQ(kv_store().prefix_count(), 3u);
+  EXPECT_EQ(kv_store().prefix_hot_bytes(), len);
+  EXPECT_EQ(kv_store().prefix_cold_bytes(), 2 * len);
+  IOBuf out;
+  EXPECT_EQ(kv_store().fetch_prefix(m[0].hash, m[0].generation, &out),
+            kEKvStale);  // dropped block: tombstoned, never silent
+  EXPECT_EQ(kv_store().fetch_prefix(m[1].hash, m[1].generation, &out), 0);
+  EXPECT(check_pattern(out, len, 51));
+  // A re-publish of the dropped block mints a NEWER generation.
+  fill_pattern(buf.data(), len, 50);
+  uint64_t toks0[2] = {0, 1};
+  KvPrefixMeta again;
+  EXPECT_EQ(kv_store().publish_prefix(k128(2, 0), 0, buf.data(), len,
+                                      toks0, 2, 60000, &again), 0);
+  EXPECT_EQ(again.generation, m[0].generation + 1);
+  // Drain tombstones EVERY prefix block (successor re-homing relies on
+  // the stale answer, never on silence).
+  EXPECT(kv_store().withdraw_all() >= 3u);
+  EXPECT_EQ(kv_store().prefix_count(), 0u);
+  EXPECT_EQ(kv_store().fetch_prefix(again.hash, again.generation, &out),
+            kEKvStale);
+}
+
 TEST_MAIN

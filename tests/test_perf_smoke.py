@@ -383,6 +383,18 @@ def test_rolling_restart_zero_errors_p99_bounded():
         assert report["kv"]["mismatches"] == 0, report
         assert report["kv"]["fetches"] > 0, report
         assert report["takeover_generation"] >= 2, report
+        # ISSUE 17 regression — the REPLICA-SET path across the drain:
+        # the shared prompt prefix (one record per chain key, one
+        # replica per node) keeps serving byte-exact through the
+        # restart, the successor re-homes the drained node's replicas
+        # above the zombie fence, and the match view never shows a
+        # generation moving backward.
+        assert report["kv"]["prefix_fetches"] > 0, report
+        assert report["kv"]["prefix_stale_admits"] == 0, report
+        assert report["kv"]["prefix_gen_regressions"] == 0, report
+        assert report["kv"]["prefix_takeover_gen"] >= 2, report
+        assert report["kv"]["prefix_replicas_peak"] >= 3, report
+        assert report["prefix_takeover_generation"] >= 2, report
         assert report["drain_samples_total"] > 0, \
             f"drain window carried no samples — p99 bound unmeasured: " \
             f"{report}"
@@ -710,11 +722,12 @@ def test_kv_disagg_goodput_and_token_p99_hold_together():
     env = dict(os.environ)
     env["PYTHONPATH"] = str(tool.parent.parent)
     env["JAX_PLATFORMS"] = "cpu"
+    shape = str(tool.parent.parent / "tests" / "data" / "golden_mixed.cap")
     row = None
     for _ in range(2):  # one retry: the p99 side is timing-bound
         out = subprocess.run(
             [sys.executable, str(tool), "--json", "--seconds", "6",
-             "--timeline", "--out", trace_path],
+             "--timeline", "--out", trace_path, "--shape", shape],
             capture_output=True, text=True, timeout=240, env=env)
         line = next((ln for ln in out.stdout.splitlines()[::-1]
                      if ln.startswith("{")), None)
@@ -730,6 +743,22 @@ def test_kv_disagg_goodput_and_token_p99_hold_together():
         assert row["cancel_wasted_bytes_before"] > 0, row
         assert 0 <= row["cancel_wasted_bytes_after"] <= \
             row["cancel_wasted_bytes_before"], row
+        # ISSUE 17 acceptance, SAME run as the goodput/p99 floors: the
+        # Zipfian multi-tenant prompt mix (tenant shape from the golden
+        # capture) drops prefill bytes-recomputed >= 5x with the cache
+        # on, the longest-prefix hit rate is nonzero, the hottest
+        # prompt's blocks fetch byte-exact cross-process, and the
+        # routing hint is honored (no vetoes on an idle prefill node).
+        assert row["prefix_recompute_drop"] >= 5.0, row
+        assert 0 < row["prefix_hit_ratio"] < 1, row
+        assert row["prefix_bytes_recomputed_on"] < \
+            row["prefix_bytes_recomputed_off"], row
+        assert row["prefix_fetch_verified"], row
+        assert row["prefix_matched_depth"] > 0, row
+        assert row["prefix_hint_node"], row
+        assert row["lb_hint_hit"] > 0 and row["lb_hint_miss"] == 0, row
+        # The tenant mix came from the golden capture, not synthesized.
+        assert [t for t, _w in row["prefix_tenants"]] == ["fg", "bulk"], row
         bound = max(2 * row["token_p99_unloaded_us"], 1500)
         if (row["kv_goodput_gbps"] >= KV_DISAGG_GOODPUT_FLOOR_GBPS
                 and row["token_p99_loaded_us"] <= bound):

@@ -59,6 +59,130 @@ DEFAULTS = {
     "qos_spec": "tok:weight=8;kv:weight=1",
 }
 
+# Prefix-cache phase defaults (ISSUE 17): a Zipfian multi-tenant prompt
+# mix replayed against the prefill node's content-addressed store.
+PREFIX_DEFAULTS = {
+    "seed": 17,
+    "samples": 64,
+    "tenants": 4,
+    "prompts_per_tenant": 8,
+    "sys_blocks": 4,     # per-tenant shared system-prompt prefix
+    "tail_blocks": 2,    # per-prompt unique suffix
+    "block_tokens": 128,
+    "block_kb": 256,
+    "zipf_s": 1.1,
+}
+
+
+def _shape_tenant_weights(shape_path: str, tenants: int) -> list:
+    """Tenant mix for the prompt population.  With --shape, the weights
+    are the golden capture's recorded per-tenant record shares (the
+    REAL tenant mix, not a synthetic one); otherwise `tenants` equal
+    synthetic tenants."""
+    if shape_path:
+        from brpc_tpu.rpc import capture
+
+        _header, records = capture.load_capture(shape_path)
+        counts: dict = {}
+        for r in records:
+            t = r.tenant or "anon"
+            counts[t] = counts.get(t, 0) + 1
+        if counts:
+            return sorted(counts.items(), key=lambda kv: -kv[1])
+    return [(f"tenant{i}", 1) for i in range(tenants)]
+
+
+def _prompt_tokens(spec: dict, ti: int, rank: int) -> list:
+    """Deterministic token ids for (tenant, prompt-rank): a per-tenant
+    shared system prefix + a per-prompt unique tail.  Content bytes
+    derive from the chain keys, so every process regenerates the same
+    blocks — the content-addressed dedup scenario."""
+    bt = spec["block_tokens"]
+    sys_part = [1_000_000 * (ti + 1) + j
+                for j in range(spec["sys_blocks"] * bt)]
+    tail = [500_000_000 + 1_000_000 * ti + 10_000 * (rank + 1) + j
+            for j in range(spec["tail_blocks"] * bt)]
+    return sys_part + tail
+
+
+def _prefix_block_bytes(key: tuple, nbytes: int) -> bytes:
+    import numpy as np
+
+    salt = (key[1] & 0xFFFFFFFF) | 1
+    return (((np.arange(nbytes, dtype=np.uint64) * 2654435761 + salt)
+             >> 13).astype(np.uint8)).tobytes()
+
+
+def _prefix_phase(addr: str, spec: dict) -> dict:
+    """Runs inside the PREFILL process (the store owner): samples the
+    Zipfian prompt mix, asks the registry for each prompt's longest
+    cached prefix, 'recomputes' (publishes + registers) only the missed
+    blocks, and accounts prefill bytes-recomputed with the cache OFF
+    (every block, every prompt) vs ON (missed blocks only)."""
+    import random
+
+    from brpc_tpu.rpc import Channel, kv
+
+    rng = random.Random(spec["seed"])
+    bt = spec["block_tokens"]
+    pb = spec["block_kb"] << 10
+    tenants = spec["tenant_weights"]
+    t_weights = [w for _name, w in tenants]
+    ranks = list(range(spec["prompts_per_tenant"]))
+    zipf_w = [1.0 / (r + 1) ** spec["zipf_s"] for r in ranks]
+
+    reg = kv.KvRegistryClient(Channel(addr, timeout_ms=10000),
+                              owns_channel=True)
+    bytes_off = 0       # cache OFF: the full prefix recomputes each time
+    bytes_on = 0        # cache ON: only the missed blocks recompute
+    blocks_hit = 0
+    blocks_total = 0
+    t0 = time.perf_counter()
+    for _ in range(spec["samples"]):
+        ti = rng.choices(range(len(tenants)), weights=t_weights)[0]
+        rank = rng.choices(ranks, weights=zipf_w)[0]
+        tokens = _prompt_tokens(spec, ti, rank)
+        keys = kv.prefix_chain(tokens, bt)
+        bytes_off += len(keys) * pb
+        blocks_total += len(keys)
+        hit_depth = len({(r.key_hi, r.key_lo) for r in reg.match(keys)})
+        blocks_hit += hit_depth
+        for d in range(hit_depth, len(keys)):
+            data = _prefix_block_bytes(keys[d], pb)
+            span = tokens[d * bt:(d + 1) * bt]
+            meta, fresh = kv.prefix_publish(keys[d], d, data, span,
+                                            lease_ms=600000, node=addr)
+            reg.put_prefix(meta, lease_ms=600000)
+            if fresh:
+                bytes_on += pb  # genuinely recomputed + admitted
+    dt = time.perf_counter() - t0
+    counters = kv.prefix_counters()
+    reg.close()
+    # The hottest prompt (heaviest tenant, rank 0): the driver replays
+    # its match -> hint -> hinted-call path from OUTSIDE this process.
+    hot = _prompt_tokens(spec, 0, 0)
+    return {
+        "prefix_bytes_recomputed_off": bytes_off,
+        "prefix_bytes_recomputed_on": bytes_on,
+        "prefix_recompute_drop": round(bytes_off / max(bytes_on, 1), 2),
+        "prefix_hit_ratio": round(blocks_hit / max(blocks_total, 1), 4),
+        "prefix_samples": spec["samples"],
+        "prefix_blocks_total": blocks_total,
+        "prefix_block_bytes": pb,
+        "prefix_block_tokens": bt,
+        "prefix_tenants": [list(t) for t in tenants],
+        "prefix_zipf_s": spec["zipf_s"],
+        "prefix_phase_s": round(dt, 3),
+        "prefix_store_count": kv.prefix_store_count(),
+        "prefix_store_hot_bytes": kv.prefix_hot_bytes(),
+        "prefix_store_cold_bytes": kv.prefix_cold_bytes(),
+        "prefix_registry_records": kv.prefix_registry_count(),
+        "prefix_registry_replicas": kv.prefix_registry_replicas(),
+        "prefix_promotions": counters["promote"],
+        "prefix_demotions": counters["demote"],
+        "hot_tokens": hot,
+    }
+
 
 # ---------------------------------------------------------------- roles ----
 
@@ -103,7 +227,15 @@ def run_prefill(args) -> None:
                           node=addr)
         reg.register(meta, lease_ms=args.lease_ms)
     print(f"PORT {srv.port}", flush=True)
-    sys.stdin.readline()  # parent closes stdin to stop us
+    # Command loop: the driver asks for the prefix-cache phase mid-run
+    # (the store lives HERE); closing stdin stops us, as before.
+    for line in sys.stdin:
+        line = line.strip()
+        if line.startswith("PREFIX "):
+            prow = _prefix_phase(addr, json.loads(line[len("PREFIX "):]))
+            print("PREFIXROW " + json.dumps(prow), flush=True)
+        else:
+            break
     reg.close()
     srv.stop()
 
@@ -331,6 +463,68 @@ def run_driver(args) -> dict:
         if dec_row is None:
             raise RuntimeError("decode child produced no row")
 
+        # Prefix-cache phase (ISSUE 17), SAME run as the goodput/p99
+        # measurement above: the prefill process replays the Zipfian
+        # prompt mix against its content-addressed store, then this
+        # process replays the hottest prompt's match -> hint -> hinted
+        # c_hash_bl call path from the outside.
+        prefix_row = None
+        if not args.no_prefix:
+            spec = dict(PREFIX_DEFAULTS)
+            spec["seed"] = args.prefix_seed
+            spec["samples"] = args.prefix_samples
+            spec["tenant_weights"] = _shape_tenant_weights(
+                args.shape, spec["tenants"])
+            prefill.stdin.write("PREFIX " + json.dumps(spec) + "\n")
+            prefill.stdin.flush()
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                line = prefill.stdout.readline()
+                if not line:
+                    break
+                if line.startswith("PREFIXROW "):
+                    prefix_row = json.loads(line[len("PREFIXROW "):])
+                    break
+            if prefix_row is None:
+                raise RuntimeError("prefill child produced no prefix row")
+            hot_tokens = prefix_row.pop("hot_tokens")
+            from brpc_tpu.rpc import kv
+            from brpc_tpu.rpc.client import (ClusterChannel,
+                                             lb_hint_counters)
+
+            bt = prefix_row["prefix_block_tokens"]
+            pb = prefix_row["prefix_block_bytes"]
+            cli = kv.KvClient(f"127.0.0.1:{pre_port}", use_shm=False,
+                              timeout_ms=10000)
+            ch = ClusterChannel(f"list://127.0.0.1:{pre_port}",
+                                "c_hash_bl", timeout_ms=10000)
+            try:
+                groups = cli.match_prefix(hot_tokens, bt)
+                hint = kv.KvClient.prefix_hint(groups)
+                h0 = lb_hint_counters()
+                for _ in range(8):
+                    ch.call("Token.Step", b"t" * 256, hint=hint)
+                h1 = lb_hint_counters()
+                blocks = cli.fetch_prefix(hot_tokens, bt)
+                keys = kv.prefix_chain(hot_tokens, bt)
+                prefix_row.update({
+                    "prefix_hint_node": hint,
+                    "prefix_matched_depth": len(groups),
+                    "prefix_fetch_blocks": len(blocks),
+                    # Whole-or-nothing, from a DIFFERENT process: every
+                    # fetched block byte-matches its content recipe.
+                    "prefix_fetch_verified": bool(
+                        len(blocks) == len(keys)
+                        and all(b == _prefix_block_bytes(tuple(k), pb)
+                                for b, k in zip(blocks, keys))),
+                    "lb_hint_hit": h1[0] - h0[0],
+                    "lb_hint_veto": h1[1] - h0[1],
+                    "lb_hint_miss": h1[2] - h0[2],
+                })
+            finally:
+                ch.close()
+                cli.close()
+
         trace_summary = None
         if args.out:
             sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -376,6 +570,8 @@ def run_driver(args) -> dict:
             "rma_rails_shm": get_flag("trpc_shm_rails"),
             "timeline": bool(args.timeline),
             "chaos": args.chaos or None,
+            "shape": args.shape or None,
+            **(prefix_row or {}),
             "trace": trace_summary,
         }
         tok.close()
@@ -410,6 +606,16 @@ def main(argv=None) -> int:
                     help="pull blocks over TCP instead of shm (copy path)")
     ap.add_argument("--chaos", default="",
                     help="fault schedule installed in the prefill process")
+    ap.add_argument("--no-prefix", action="store_true",
+                    help="skip the prefix-cache phase")
+    ap.add_argument("--shape", default="",
+                    help="capture file whose per-tenant record shares "
+                         "set the prompt mix (e.g. "
+                         "tests/data/golden_mixed.cap)")
+    ap.add_argument("--prefix-samples", type=int,
+                    default=PREFIX_DEFAULTS["samples"])
+    ap.add_argument("--prefix-seed", type=int,
+                    default=PREFIX_DEFAULTS["seed"])
     ap.add_argument("--timeline", action="store_true",
                     help="record + stitch flight-recorder timelines")
     ap.add_argument("--out", default="",

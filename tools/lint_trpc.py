@@ -525,6 +525,7 @@ ATOMIC_FILES = [
     "net/socket.cc", "net/socket.h", "net/messenger.cc", "net/messenger.h",
     "net/qos.cc", "net/qos.h", "net/stripe.cc", "net/stripe.h",
     "net/rma.cc", "net/rma.h", "net/kvstore.cc", "net/kvstore.h",
+    "net/lb_hint.h",
 ]
 ATOMIC_RE = re.compile(r"memory_order_(relaxed|acquire)\b")
 # "//" inside a string literal ("http://...") is not a comment.

@@ -409,6 +409,51 @@ def _block_bytes(bid: int, gen: int, n: int) -> bytes:
     return hdr + body
 
 
+_PREFIX_BT = 128  # tokens per prefix block (trpc_kv_prefix_block_tokens)
+
+
+def _prefix_tokens(blocks: int) -> list:
+    """The cluster-shared prompt prefix: fixed token ids, so every node
+    derives the same chain keys (and the successor re-derives them)."""
+    return [42_000_000 + j for j in range(blocks * _PREFIX_BT)]
+
+
+def _prefix_content(key, n: int) -> bytes:
+    """Block bytes derived from the CHAIN KEY alone: every publisher
+    regenerates identical content -> one content hash per chain key, a
+    replica per node.  A fetched block that does not byte-match this
+    recipe is a stale admit."""
+    salt = key[1] & 0xFFFFFFFF
+    pat = bytes((salt + i * 131) % 251 for i in range(256))
+    return (pat * ((n + 255) // 256))[:n]
+
+
+def _publish_prefix_chain(srv_port: int, hub_addr: str, blocks: int,
+                          block_bytes: int, min_gen: int = 0) -> int:
+    """Publishes the shared prompt-prefix chain into this node's
+    content-addressed store and registers each block's replica at the
+    hub.  Returns the highest accepted generation."""
+    from brpc_tpu.rpc import Channel, kv
+
+    addr = f"127.0.0.1:{srv_port}"
+    tokens = _prefix_tokens(blocks)
+    keys = kv.prefix_chain(tokens, _PREFIX_BT)
+    reg = kv.KvRegistryClient(Channel(hub_addr, timeout_ms=5000),
+                              owns_channel=True)
+    top = 0
+    try:
+        for d, key in enumerate(keys):
+            span = tokens[d * _PREFIX_BT:(d + 1) * _PREFIX_BT]
+            meta, _fresh = kv.prefix_publish(
+                key, d, _prefix_content(key, block_bytes), span,
+                lease_ms=600000, node=addr, min_generation=min_gen)
+            gen, _fresh_reg = reg.put_prefix(meta, lease_ms=600000)
+            top = max(top, gen)
+    finally:
+        reg.close()
+    return top
+
+
 def run_rr_hub(args) -> None:
     sys.path.insert(0, str(REPO))
     from brpc_tpu.rpc import Server
@@ -458,6 +503,11 @@ def run_rr_node(args) -> None:
     srv.announce(hub_addr, "echo")
     pages, reg = _publish_blocks(srv.port, hub_addr, args.index,
                                  args.blocks, args.block_bytes, gen=1)
+    # Every node also offers the cluster-shared prompt prefix: identical
+    # content => the registry folds the offers into one record per chain
+    # key with an N-node replica set (ISSUE 17).
+    _publish_prefix_chain(srv.port, hub_addr, args.blocks,
+                          args.block_bytes)
     print(json.dumps({"port": srv.port}), flush=True)
     for line in sys.stdin:
         cmd = line.strip().split()
@@ -501,7 +551,28 @@ def run_rr_succ(args) -> None:
     pages, reg = _publish_blocks(srv.port, hub_addr, args.index,
                                  args.blocks, args.block_bytes,
                                  gen=min_gen, min_generation=min_gen)
-    print(json.dumps({"adopted_port": srv.port, "generation": min_gen}),
+    # Re-home the drained node's prefix replicas (ISSUE 17): the
+    # registry's per-node generation fence makes the dead pid's records
+    # zombies, so the successor's offers must clear it — probe the
+    # replica set for this endpoint's last generation and publish above
+    # it.  Same content, same hashes: only the replica's (gen, rkey)
+    # re-homes; the other nodes' replicas are untouched.
+    pprobe = kv.KvRegistryClient(Channel(hub_addr, timeout_ms=5000),
+                                 owns_channel=True)
+    my_addr = f"127.0.0.1:{srv.port}"
+    prefix_fence = 1
+    try:
+        for m in pprobe.match(kv.prefix_chain(
+                _prefix_tokens(args.blocks), _PREFIX_BT)):
+            if m.node == my_addr:
+                prefix_fence = max(prefix_fence, m.generation)
+    finally:
+        pprobe.close()
+    prefix_gen = _publish_prefix_chain(srv.port, hub_addr, args.blocks,
+                                       args.block_bytes,
+                                       min_gen=prefix_fence + 1)
+    print(json.dumps({"adopted_port": srv.port, "generation": min_gen,
+                      "prefix_generation": prefix_gen}),
           flush=True)
     for line in sys.stdin:
         if line.strip() == "quit":
@@ -590,8 +661,47 @@ def run_rr_kvpuller(args) -> None:
     stale_admits = 0
     mismatches = 0
     max_gen = {}
+    # Prefix-cache lane (ISSUE 17): the cluster-shared prompt prefix is
+    # matched and fetched continuously through the replica-set path.
+    # Content addressing makes staleness structural — a served block
+    # that does not byte-match the chain-key recipe is a stale admit,
+    # and a replica whose generation moves backward in the match view
+    # is a fence regression.  Both must stay at zero across the drain.
+    ptokens = _prefix_tokens(args.blocks)
+    pkeys = kv.prefix_chain(ptokens, _PREFIX_BT)
+    pwant = [_prefix_content(k, args.block_bytes) for k in pkeys]
+    prefix_fetches = 0
+    prefix_stale_admits = 0
+    prefix_short = 0
+    prefix_transient = 0
+    prefix_gen_regressions = 0
+    prefix_takeover_gen = 0
+    prefix_replicas_peak = 0
+    pgen = {}
     end = time.time() + args.seconds
     while time.time() < end:
+        try:
+            groups = cli.match_prefix(ptokens)
+            blocks = cli.fetch_prefix(ptokens)
+        except Exception:
+            prefix_transient += 1
+            groups, blocks = [], []
+        prefix_replicas_peak = max(prefix_replicas_peak,
+                                   sum(len(g) for g in groups))
+        for g in groups:
+            for m in g:
+                kk = (m.key_hi, m.key_lo, m.node)
+                if m.generation < pgen.get(kk, 0):
+                    prefix_gen_regressions += 1
+                pgen[kk] = max(pgen.get(kk, 0), m.generation)
+                prefix_takeover_gen = max(prefix_takeover_gen,
+                                          m.generation)
+        for d, b in enumerate(blocks):
+            prefix_fetches += 1
+            if b != pwant[d]:
+                prefix_stale_admits += 1
+        if blocks and len(blocks) < len(pkeys):
+            prefix_short += 1  # every replica of a block failed whole
         for bid in bids:
             if time.time() >= end:
                 break
@@ -618,6 +728,13 @@ def run_rr_kvpuller(args) -> None:
         "reresolves": cli.node_reresolves,
         "takeover_gens": {str(k): v for k, v in max_gen.items()
                           if v > 1},
+        "prefix_fetches": prefix_fetches,
+        "prefix_stale_admits": prefix_stale_admits,
+        "prefix_short_reads": prefix_short,
+        "prefix_transient": prefix_transient,
+        "prefix_gen_regressions": prefix_gen_regressions,
+        "prefix_takeover_gen": prefix_takeover_gen,
+        "prefix_replicas_peak": prefix_replicas_peak,
     }), flush=True)
 
 
@@ -727,18 +844,25 @@ def run_rolling_restart(args) -> int:
         "drained_clean": drain_report.get("drained", False),
         "adopted_port": succ_report.get("adopted_port"),
         "takeover_generation": succ_report.get("generation"),
+        "prefix_takeover_generation": succ_report.get("prefix_generation"),
         "same_port": succ_report.get("adopted_port") == node_ports[0],
         "kv": puller_report,
         "elapsed_s": round(time.monotonic() - t_start, 1),
     }
     print(json.dumps(summary, indent=None if args.json else 2), flush=True)
     # The p99 criterion must be MEASURED, not vacuously true: at least
-    # one call has to land inside the drain window.
+    # one call has to land inside the drain window.  The prefix lane's
+    # replica-set path must re-home across the drain with ZERO stale
+    # admits and no generation regressions in the match view.
     ok = (errors == 0 and calls > 0 and
           summary["drained_clean"] and summary["same_port"] and
           puller_report["stale_admits"] == 0 and
           puller_report["mismatches"] == 0 and
           puller_report["fetches"] > 0 and
+          puller_report["prefix_stale_admits"] == 0 and
+          puller_report["prefix_gen_regressions"] == 0 and
+          puller_report["prefix_fetches"] > 0 and
+          puller_report["prefix_takeover_gen"] >= 2 and
           drain_samples_total > 0 and steady_p99 > 0 and
           ratio > 0 and ratio <= 2.0)
     return 0 if ok else 1

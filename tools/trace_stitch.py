@@ -162,7 +162,8 @@ _TL_PRIMARY_RAIL_TID = 900900  # its own track, distinct from real rails
 # disaggregation trace shows block transfers next to the rails that
 # carried them.  b = op << 56 | payload len (TIMELINE_KV_OPS mirror).
 _TL_KV_TID = 970000
-_TL_KV_OPS = {1: "publish", 2: "serve", 3: "evict", 4: "stale"}
+_TL_KV_OPS = {1: "publish", 2: "serve", 3: "evict", 4: "stale",
+              5: "promote", 6: "demote"}
 # coll_step events (net/collective.h): one instant per completed
 # collective schedule step on its own per-node "collective" track —
 # a = step index, b = op << 56 | step bytes (TIMELINE_COLL_OPS mirror),
