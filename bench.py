@@ -641,6 +641,34 @@ def _child_kv_disagg() -> None:
     raise RuntimeError(f"kv_disagg produced no row:\n{out.stderr[-2000:]}")
 
 
+def _child_pipeline_overlap() -> None:
+    """Pipeline-parallel overlapped dataflow row (ISSUE 18): a 4-member
+    fleet runs M microbatches of real jax CPU gradient compute whose
+    reduce-scatter/all-gather rides UNDER the next microbatch's compute
+    — transfers fire per-chunk as the producer stamps a readiness map
+    (trpc_coll_overlap) instead of waiting for a whole-buffer barrier.
+    Headline metric: overlap_efficiency = step_time / max(compute,
+    comm) (1.0 = perfect overlap) plus the speedup over the sequential
+    compute-then-communicate baseline of the SAME dataflow (acceptance
+    ≥ 1.25x, byte-exact).  Driver is tools/pipeline_step.py so the row
+    measures the multi-threaded fleet, not this interpreter's state."""
+    import subprocess as sp
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tool = os.path.join(repo, "tools", "pipeline_step.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable, tool, "--json"]
+    out = sp.run(cmd, env=env, capture_output=True, text=True, timeout=240)
+    for ln in out.stdout.splitlines()[::-1]:
+        if ln.startswith("{"):
+            print(ln, flush=True)
+            return
+    raise RuntimeError(
+        f"pipeline_step produced no row:\n{out.stderr[-2000:]}")
+
+
 def _child_collective() -> None:
     """Collective-fabric row (ISSUE 13): a 4-member in-process fleet
     all-gathers 64MB shards over shm — every transfer a pull whose
@@ -1432,6 +1460,9 @@ def main() -> None:
     if os.environ.get("BENCH_COLL"):
         _child_collective()
         return
+    if os.environ.get("BENCH_OVERLAP"):
+        _child_pipeline_overlap()
+        return
     if os.environ.get("BENCH_SELF_TUNE"):
         _child_self_tune()
         return
@@ -1505,6 +1536,7 @@ def main() -> None:
     rolling_restart = _run_json_child({"BENCH_RR": "1"}, 240)
     replay = _run_json_child({"BENCH_REPLAY": "1"}, 300)
     coll = _run_json_child({"BENCH_COLL": "1"}, 240)
+    pipeline_overlap = _run_json_child({"BENCH_OVERLAP": "1"}, 240)
     self_tune = _run_json_child({"BENCH_SELF_TUNE": "1"}, 240)
 
     # tpu_rpc leg, same retry contract; a CPU-platform run is still a real
@@ -1545,6 +1577,7 @@ def main() -> None:
         "rolling_restart": rolling_restart,
         "replay": replay,
         "collective": coll,
+        "pipeline_overlap": pipeline_overlap,
         "self_tune": self_tune,
     }))
 

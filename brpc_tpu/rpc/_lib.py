@@ -429,6 +429,26 @@ def load_library() -> ctypes.CDLL:
                 ctypes.c_uint64, ctypes.c_uint64,
             ]
             lib.trpc_coll_run.restype = ctypes.c_int
+            # Overlap-aware path: trpc_coll_run + a readiness-map handle
+            # over the caller's send buffer (ISSUE 18).
+            lib.trpc_coll_run_ready.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
+                ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+            ]
+            lib.trpc_coll_run_ready.restype = ctypes.c_int
+            lib.trpc_coll_ready_create.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ]
+            lib.trpc_coll_ready_create.restype = ctypes.c_uint64
+            lib.trpc_coll_ready_stamp.argtypes = [
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+            ]
+            lib.trpc_coll_ready_stamp.restype = ctypes.c_int
+            lib.trpc_coll_ready_destroy.argtypes = [ctypes.c_uint64]
+            lib.trpc_coll_ready_destroy.restype = None
+            lib.trpc_coll_ready_maps.argtypes = []
+            lib.trpc_coll_ready_maps.restype = ctypes.c_size_t
             lib.trpc_coll_reshard_run.argtypes = [
                 ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
                 ctypes.c_uint32, ctypes.c_uint64, ctypes.c_void_p,

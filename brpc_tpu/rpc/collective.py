@@ -141,6 +141,74 @@ def plan_reshard_bytes(src_ranges, dst_ranges, total: int,
             "naive_bytes": naive.value, "steps": steps.value}
 
 
+class ReadyMap:
+    """Producer-stamped chunk-ready bitmap over a send buffer (the
+    overlap-aware collective seam, ISSUE 18).  Create it over the SAME
+    buffer a collective will read, ``stamp(off, len)`` ranges as the
+    producer fills them (release-fenced after the writes), and pass the
+    map as ``ready=`` to a Group collective: with ``trpc_coll_overlap``
+    on, transfers fire the moment their compiled input chunks are
+    stamped — microbatch i's communication overlapping microbatch i+1's
+    compute; off, the executor waits once for the whole producer extent
+    (byte-identical results either way).  The map does not own the
+    buffer — keep the buffer alive while the map exists.
+
+        ready = collective.ReadyMap(send, granularity=1 << 20)
+        fill(send, 0, CHUNK); ready.stamp(0, CHUNK)   # ... keep filling
+        g.reduce_scatter(send, recv, shard_bytes=S, ready=ready)
+    """
+
+    def __init__(self, buf, granularity: int = 0):
+        lib = load_library()
+        addr, nbytes = _buf_addr_len(buf)
+        handle = lib.trpc_coll_ready_create(addr, nbytes, granularity)
+        if handle == 0:
+            raise ValueError(
+                "ready map creation failed (empty buffer or bad "
+                "granularity)")
+        self._lib = lib
+        self._handle = handle
+        self.nbytes = nbytes
+
+    @property
+    def handle(self) -> int:
+        """The opaque native handle (0 after close)."""
+        return self._handle or 0
+
+    def stamp(self, off: int, length: int) -> None:
+        """Marks [off, off+length) ready.  `off` must be chunk-aligned
+        and `length` a chunk multiple (or reach the buffer end); call it
+        AFTER writing the bytes.  Monotonic — restamping is a no-op."""
+        rc = self._lib.trpc_coll_ready_stamp(self._handle, off, length)
+        if rc != 0:
+            raise ValueError(
+                f"bad stamp [{off}, {off + length}) — misaligned or "
+                f"outside the {self.nbytes}-byte map")
+
+    def close(self) -> None:
+        handle, self._handle = self._handle, None
+        if handle:
+            self._lib.trpc_coll_ready_destroy(handle)
+
+    def __enter__(self) -> "ReadyMap":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+def ready_maps_live() -> int:
+    """Readiness maps currently registered in THIS process (0 when all
+    closed — the quiescence probe for overlap tests)."""
+    return int(load_library().trpc_coll_ready_maps())
+
+
 class Group:
     """Channels to one member snapshot; every member must issue the same
     sequence of collectives.  Not safe for concurrent calls."""
@@ -180,32 +248,41 @@ class Group:
         return self._lib.trpc_coll_group_version(self._ptr)
 
     def _run(self, op: int, send, recv, shard_bytes: int,
-             run_seq: int) -> None:
+             run_seq: int, ready=None) -> None:
         saddr, slen = _buf_addr_len(send)
         raddr, rlen = _buf_addr_len(recv)
-        rc = self._lib.trpc_coll_run(self._ptr, op, saddr, slen, raddr,
-                                     rlen, shard_bytes, run_seq)
+        if ready is not None:
+            handle = ready if isinstance(ready, int) else ready.handle
+            rc = self._lib.trpc_coll_run_ready(
+                self._ptr, op, saddr, slen, raddr, rlen, shard_bytes,
+                run_seq, handle)
+        else:
+            rc = self._lib.trpc_coll_run(self._ptr, op, saddr, slen, raddr,
+                                         rlen, shard_bytes, run_seq)
         if rc != 0:
             raise _coll_error(rc, f"collective op {op} failed (rc={rc})")
 
     def all_gather(self, send, recv, shard_bytes: int = 0,
-                   run_seq: int = 0) -> None:
+                   run_seq: int = 0, ready=None) -> None:
         """Gathers every member's `send` shard into everyone's `recv`
-        (rank-ordered).  shard_bytes defaults to len(send)."""
-        self._run(ALL_GATHER, send, recv, shard_bytes, run_seq)
+        (rank-ordered).  shard_bytes defaults to len(send).  `ready`:
+        an optional ReadyMap over `send` (overlap-aware path)."""
+        self._run(ALL_GATHER, send, recv, shard_bytes, run_seq, ready)
 
     def reduce_scatter(self, send, recv, shard_bytes: int = 0,
-                       run_seq: int = 0) -> None:
+                       run_seq: int = 0, ready=None) -> None:
         """Element-wise u32-sums the members' `send` arrays (n*shard
         each) and scatters chunk r to rank r's `recv`.  MUTATES `send`
-        (it is the ring accumulator)."""
-        self._run(REDUCE_SCATTER, send, recv, shard_bytes, run_seq)
+        (it is the ring accumulator).  `ready`: an optional ReadyMap
+        over `send` (overlap-aware path)."""
+        self._run(REDUCE_SCATTER, send, recv, shard_bytes, run_seq, ready)
 
     def all_to_all(self, send, recv, shard_bytes: int = 0,
-                   run_seq: int = 0) -> None:
+                   run_seq: int = 0, ready=None) -> None:
         """Transposes blocks: rank r's block d lands at rank d's block
-        r.  shard_bytes defaults to len(send) / group size."""
-        self._run(ALL_TO_ALL, send, recv, shard_bytes, run_seq)
+        r.  shard_bytes defaults to len(send) / group size.  `ready`:
+        an optional ReadyMap over `send` (overlap-aware path)."""
+        self._run(ALL_TO_ALL, send, recv, shard_bytes, run_seq, ready)
 
     def reshard(self, src_ranges, dst_ranges, total: int, send, recv,
                 run_seq: int = 0) -> None:

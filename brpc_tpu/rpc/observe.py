@@ -338,6 +338,7 @@ TIMELINE_EVENTS = {
     24: "tuner_decision",  # timeline-event 24 (tuner_decision)
     25: "deadline",       # timeline-event 25 (deadline)
     26: "capture",        # timeline-event 26 (capture)
+    27: "coll_ready",     # timeline-event 27 (coll_ready)
 }
 
 # kCapture `b` op tags (cpp/stat/capture.cc: b = op << 56 | request

@@ -146,6 +146,74 @@ int trpc_coll_run(void* g, int op, const void* sendbuf, uint64_t send_len,
   return group->run(plan, sendbuf, send_len, recvbuf, recv_len, run_seq);
 }
 
+// trpc_coll_run with a readiness map attached (overlap-aware path):
+// `ready` is a trpc_coll_ready_create handle over THIS member's
+// sendbuf.  Transfers whose compiled input ranges are stamped fire
+// immediately when trpc_coll_overlap is on; off, the executor waits
+// once for the full producer extent — byte-identical either way.
+// ready = 0 degrades to trpc_coll_run exactly.
+int trpc_coll_run_ready(void* g, int op, const void* sendbuf,
+                        uint64_t send_len, void* recvbuf,
+                        uint64_t recv_len, uint64_t shard_bytes,
+                        uint64_t run_seq, uint64_t ready) {
+  auto* group = static_cast<GroupChannel*>(g);
+  TransferSchedule plan;
+  switch (op) {
+    case 1:
+      plan = plan_all_gather(group->nmembers(),
+                             shard_bytes != 0 ? shard_bytes : send_len);
+      break;
+    case 2:
+      plan = plan_reduce_scatter(
+          group->nmembers(),
+          shard_bytes != 0 ? shard_bytes : recv_len);
+      break;
+    case 3:
+      if (shard_bytes == 0) {
+        if (group->nmembers() == 0 ||
+            send_len % group->nmembers() != 0) {
+          return kECollMismatch;
+        }
+        shard_bytes = send_len / group->nmembers();
+      }
+      plan = plan_all_to_all(group->nmembers(), shard_bytes);
+      break;
+    default:
+      return kECollMismatch;
+  }
+  return group->run(plan, sendbuf, send_len, recvbuf, recv_len, run_seq,
+                    ready);
+}
+
+// Registers a readiness map over [base, base+len) at `granularity`
+// bytes per chunk (0 = trpc_coll_ready_granularity_bytes).  The
+// producer stamps ranges as it fills them; collective runs with the
+// handle attached gate their transfers on the stamps.  Returns a
+// non-zero handle, or 0 on invalid arguments.
+uint64_t trpc_coll_ready_create(const void* base, uint64_t len,
+                                uint64_t granularity) {
+  coll_ensure_registered();
+  if (granularity == 0) {
+    granularity = coll_ready_default_granularity();
+  }
+  return rma_ready_create(base, len, granularity);
+}
+
+// Marks [off, off+len) ready (release-fenced after the producer's
+// writes; off chunk-aligned, len a chunk multiple or reaching the
+// buffer end).  Returns 0, or -1 on bad handle / misaligned span.
+int trpc_coll_ready_stamp(uint64_t handle, uint64_t off, uint64_t len) {
+  return rma_ready_stamp(handle, off, len);
+}
+
+// Unregisters a readiness map; parked waiters wake and fail cleanly.
+void trpc_coll_ready_destroy(uint64_t handle) {
+  rma_ready_destroy(handle);
+}
+
+// Live readiness maps in this process (0 = quiesced; tests).
+size_t trpc_coll_ready_maps() { return rma_ready_maps(); }
+
 // Runs a reshard over the group.  `ranges` is (nsrc + ndst) packed
 // ShardRangeWire rows (source rows first — the same wire collective.py
 // sends to Reshard.Plan).  sendbuf holds this rank's source ranges

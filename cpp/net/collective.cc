@@ -69,8 +69,43 @@ Flag* rendezvous_flag() {
   return f;
 }
 
+Flag* ready_granularity_flag() {
+  static Flag* f = int_flag(
+      "trpc_coll_ready_granularity_bytes", 1 << 20,
+      "chunk granularity of collective readiness maps (bytes, [4KB, "
+      "256MB]) — producers stamp send-buffer ranges at this grain and "
+      "readiness-triggered transfers fire per stamped chunk; finer "
+      "grains overlap earlier at more stamp/scan cost",
+      4 << 10, 256ll << 20);
+  return f;
+}
+
+Flag* overlap_flag() {
+  static Flag* f = [] {
+    Flag* fl = Flag::define_bool(
+        "trpc_coll_overlap", false,
+        "fire collective transfers as their input chunks are stamped "
+        "ready (T3-style compute/comm overlap) instead of waiting for "
+        "the whole send buffer; off = barrier semantics, byte-identical "
+        "with or without a readiness map attached");
+    if (fl != nullptr) {
+      fl->set_validator([](const std::string& v) {
+        return v == "true" || v == "false" || v == "1" || v == "0" ||
+               v == "on" || v == "off";
+      });
+    }
+    return fl;
+  }();
+  return f;
+}
+
 int64_t flag_val(Flag* f, int64_t dflt) {
   return f != nullptr ? f->int64_value() : dflt;
+}
+
+bool overlap_enabled() {
+  Flag* f = overlap_flag();
+  return f != nullptr && f->bool_value();
 }
 
 // ---- vars ----------------------------------------------------------------
@@ -84,6 +119,8 @@ struct CollVars {
   Adder epoch_fails_total;
   Adder reshard_plans_total;
   Adder reshard_execs_total;
+  Adder ready_triggers_total;
+  Adder overlap_runs_total;
   std::unique_ptr<PassiveStatus<long>> sessions;
   // Per-op step latency, Prometheus-exposed with HELP so dashboards can
   // tell a slow reshard from a slow all-gather.
@@ -117,6 +154,16 @@ struct CollVars {
     reshard_execs_total.expose(
         "coll_reshard_execs_total",
         "Reshard.Execute runs this node participated in");
+    ready_triggers_total.expose(
+        "coll_ready_triggers_total",
+        "collective transfers fired by a readiness stamp before the "
+        "whole-buffer barrier would have released them (frozen at 0 "
+        "with trpc_coll_overlap off)");
+    overlap_runs_total.expose(
+        "coll_overlap_runs_total",
+        "collective runs executed with readiness-triggered overlap "
+        "(a ready map attached AND trpc_coll_overlap on; frozen at 0 "
+        "otherwise)");
     sessions = std::make_unique<PassiveStatus<long>>(
         [] { return static_cast<long>(coll_sessions_live()); });
     sessions->expose("coll_sessions",
@@ -183,6 +230,10 @@ struct RecvSession {
   // the send buffer, and `dst` again for ring-forwarded bytes.
   const char* send_base = nullptr;
   uint64_t send_len = 0;
+  // Readiness map over send_base (0 = none): Coll.Get serves of
+  // NON-forwarded bytes additionally gate on the producer's stamp, so a
+  // pull fires the moment its input chunks land (overlap mode).
+  uint64_t ready_handle = 0;
   Event changed;  // bumped on every arrival / serve / abort / put-ack
   std::mutex mu;  // guards the fields below
   std::vector<uint64_t> expected_bytes;  // per step (my receives)
@@ -261,7 +312,7 @@ std::shared_ptr<RecvSession> register_session(
     uint64_t group_id, uint64_t run_seq, uint32_t dst_rank, char* dst,
     uint64_t dst_len, const char* send_base, uint64_t send_len,
     std::vector<uint64_t> expected, std::vector<uint64_t> expected_serve,
-    int* poison_code) {
+    int* poison_code, uint64_t ready_handle = 0) {
   auto s = std::make_shared<RecvSession>();
   s->group_id = group_id;
   s->run_seq = run_seq;
@@ -270,6 +321,7 @@ std::shared_ptr<RecvSession> register_session(
   s->dst_len = dst_len;
   s->send_base = send_base;
   s->send_len = send_len;
+  s->ready_handle = ready_handle;
   s->expected_bytes = std::move(expected);
   s->arrived_bytes.assign(s->expected_bytes.size(), 0);
   s->expected_serve = std::move(expected_serve);
@@ -359,6 +411,18 @@ void record_coll_step(CollOp op, uint32_t step, uint64_t bytes) {
   }
 }
 
+// A transfer fired off a readiness stamp instead of the barrier:
+// a = step, b = chunk<<32|bytes (chunk = dep offset / granularity).
+void record_coll_ready(uint32_t step, uint64_t dep_off, uint64_t bytes) {
+  coll_vars().ready_triggers_total << 1;
+  if (timeline::enabled()) {
+    const uint64_t g =
+        static_cast<uint64_t>(flag_val(ready_granularity_flag(), 1 << 20));
+    timeline::record(timeline::kCollReady, step,
+                     ((dep_off / g) << 32) | (bytes & 0xFFFFFFFFull));
+  }
+}
+
 }  // namespace
 
 const char* coll_op_name(CollOp op) {
@@ -379,7 +443,14 @@ void coll_ensure_registered() {
   chunk_flag();
   inflight_flag();
   rendezvous_flag();
+  ready_granularity_flag();
+  overlap_flag();
   coll_vars();
+}
+
+uint64_t coll_ready_default_granularity() {
+  return static_cast<uint64_t>(
+      flag_val(ready_granularity_flag(), 1 << 20));
 }
 
 size_t coll_sessions_live() {
@@ -406,6 +477,37 @@ uint64_t TransferSchedule::bytes_reused() const {
     n += t.len;
   }
   return n;
+}
+
+CollDep transfer_input_dep(const CollTransfer& t) {
+  if (t.src_from_recv) {
+    // Ring-forwarded bytes: produced by a PRIOR step's arrivals, which
+    // the step barrier already orders — no send-buffer dependency.
+    return CollDep{};
+  }
+  return CollDep{t.src_off, t.len};
+}
+
+uint64_t plan_producer_extent(const TransferSchedule& plan, uint32_t rank) {
+  uint64_t extent = 0;
+  auto fold = [&](const CollTransfer& t) {
+    if (t.src != rank) {
+      return;
+    }
+    const CollDep d = transfer_input_dep(t);
+    if (d.len != 0) {
+      extent = std::max(extent, d.off + d.len);
+    }
+  };
+  for (const CollTransfer& t : plan.local_copies) {
+    fold(t);
+  }
+  for (const CollStep& s : plan.steps) {
+    for (const CollTransfer& t : s.puts) {
+      fold(t);
+    }
+  }
+  return extent;
 }
 
 TransferSchedule plan_all_gather(uint32_t n, uint64_t shard) {
@@ -651,6 +753,47 @@ void handle_put(Controller* cntl, const IOBuf& req, IOBuf* resp,
     }
     s->busy += 1;  // pin dst against unregistration while copying
   }
+  if ((w.flags & kCollFlagReduce) != 0 && s->ready_handle != 0 &&
+      s->dst == s->send_base) {
+    // In-place reduce with a readiness map: the accumulator IS the
+    // producer-stamped send buffer, so folding into an unstamped range
+    // would be overwritten by the still-running producer (a lost
+    // update).  Park until the local producer stamped the target range,
+    // bounded by the rendezvous budget — a producer that never stamps
+    // fails the put (and with it the step, whole-or-nothing) instead of
+    // wedging.
+    const int64_t rdl =
+        monotonic_time_us() + flag_val(rendezvous_flag(), 15000) * 1000;
+    while (rma_ready_test(s->ready_handle, w.dst_off, w.len) != 1) {
+      int abort_code = 0;
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        abort_code = s->abort_code;
+      }
+      const int64_t now = monotonic_time_us();
+      if (abort_code != 0 || now >= rdl) {
+        {
+          std::lock_guard<std::mutex> g(s->mu);
+          s->busy -= 1;
+        }
+        wake_session(s.get());
+        if (abort_code != 0) {
+          cntl->SetFailed(abort_code, "coll-abort: aborted while "
+                                      "waiting for accumulator stamp");
+        } else {
+          cntl->SetFailed(kECollAbort,
+                          "coll-abort: accumulator range never stamped "
+                          "ready (producer stalled)");
+        }
+        done();
+        return;
+      }
+      // Sliced park: woken the instant the range is stamped, re-checks
+      // abort/teardown every slice.
+      rma_ready_wait(s->ready_handle, w.dst_off, w.len,
+                     std::min(rdl, now + 10 * 1000));
+    }
+  }
   if ((w.flags & kCollFlagReduce) != 0) {
     // Element-wise u32 add.  One bounded staging copy: the payload may
     // arrive as a chained IOBuf whose block boundaries are not
@@ -718,6 +861,8 @@ void handle_get(Controller* cntl, const IOBuf& req, IOBuf* resp,
       monotonic_time_us() + flag_val(rendezvous_flag(), 15000) * 1000;
   while (true) {
     uint32_t v;
+    bool ready_blocked = false;
+    uint64_t ready_handle = 0;
     {
       std::lock_guard<std::mutex> g(s->mu);
       if (s->abort_code != 0) {
@@ -741,13 +886,25 @@ void handle_get(Controller* cntl, const IOBuf& req, IOBuf* resp,
       }
       // Ring-forwarded bytes exist only once the PREVIOUS step's
       // arrivals landed here — the data dependency the schedule
-      // encodes; sendbuf reads are ready from registration.
-      if (!from_recv ||
-          s->arrived_bytes[w.step - 1] >= s->expected_bytes[w.step - 1]) {
+      // encodes; sendbuf reads are ready from registration — UNLESS a
+      // readiness map is attached, in which case the producer's stamp
+      // over the requested range is the send-buffer dependency (a get
+      // never ships unstamped bytes, overlap flag on or off).
+      const bool dep_ok =
+          from_recv
+              ? s->arrived_bytes[w.step - 1] >= s->expected_bytes[w.step - 1]
+              : (s->ready_handle == 0 ||
+                 rma_ready_test(s->ready_handle, w.shard_off, w.len) == 1);
+      if (dep_ok) {
+        if (!from_recv && s->ready_handle != 0 && overlap_enabled()) {
+          record_coll_ready(w.step, w.shard_off, w.len);
+        }
         s->busy += 1;  // released by the response payload's deleter
         s->served_bytes[w.step] += w.len;
         break;
       }
+      ready_blocked = !from_recv;
+      ready_handle = s->ready_handle;
       // Acquire pairs with wake_session's release bump.
       v = s->changed.value.load(std::memory_order_acquire);
     }
@@ -758,7 +915,15 @@ void handle_get(Controller* cntl, const IOBuf& req, IOBuf* resp,
       done();
       return;
     }
-    s->changed.wait(v, deadline);
+    if (ready_blocked) {
+      // Blocked on the producer's stamp: park on the ready map (woken
+      // the instant the range is stamped), sliced so abort/teardown is
+      // still observed promptly.
+      rma_ready_wait(ready_handle, w.shard_off, w.len,
+                     std::min(deadline, monotonic_time_us() + 10 * 1000));
+    } else {
+      s->changed.wait(v, deadline);
+    }
   }
   const char* base = from_recv ? s->dst : s->send_base;
   auto* ctx = new ServeCtx{s};
@@ -1144,7 +1309,7 @@ struct RunState {
 
 int GroupChannel::run(const TransferSchedule& plan, const void* sendbuf,
                       uint64_t send_len, void* recvbuf, uint64_t recv_len,
-                      uint64_t run_seq) {
+                      uint64_t run_seq, uint64_t ready) {
   coll_vars().runs_total << 1;
   if (plan.nmembers != nmembers() || my_rank_ >= plan.nmembers) {
     return kECollMismatch;
@@ -1213,18 +1378,10 @@ int GroupChannel::run(const TransferSchedule& plan, const void* sendbuf,
   int poison = 0;
   rs.sess = register_session(group_id_, run_seq, my_rank_, acc, acc_len,
                              static_cast<const char*>(sendbuf), send_len,
-                             expected, expected_serve, &poison);
+                             expected, expected_serve, &poison, ready);
   if (rs.sess == nullptr) {
     coll_vars().aborts_total << 1;
     return poison != 0 ? poison : kECollAbort;
-  }
-
-  // Local moves first: the member's own bytes never ride the fabric.
-  for (const CollTransfer& t : plan.local_copies) {
-    if (t.src == my_rank_) {
-      memcpy(acc + t.dst_off,
-             static_cast<const char*>(sendbuf) + t.src_off, t.len);
-    }
   }
 
   const uint64_t chunk_bytes =
@@ -1248,8 +1405,85 @@ int GroupChannel::run(const TransferSchedule& plan, const void* sendbuf,
     }
     return code;
   };
+  // Parks until [off, off+len) of the send buffer is stamped ready,
+  // sliced so peer aborts/failures stay promptly observed.  0, or the
+  // error code the step fails with.
+  auto wait_ready = [&](uint64_t off, uint64_t len,
+                        int64_t rdl) -> int {
+    while (true) {
+      const int r = rma_ready_test(ready, off, len);
+      if (r == 1) {
+        return 0;
+      }
+      if (r < 0) {
+        return kECollMismatch;  // dep outside the map: plan/map mismatch
+      }
+      int code;
+      {
+        std::lock_guard<std::mutex> g(rs.sess->mu);
+        code = rs.sess->abort_code;
+      }
+      if (code != 0) {
+        return code;
+      }
+      const int64_t now = monotonic_time_us();
+      if (now >= rdl) {
+        return ETIMEDOUT;
+      }
+      rma_ready_wait(ready, off, len, std::min(rdl, now + 10 * 1000));
+    }
+  };
 
+  const bool overlap = ready != 0 && overlap_enabled();
+  if (overlap) {
+    coll_vars().overlap_runs_total << 1;
+  }
   int rc = 0;
+  // Entry budget for producer stamps (the step budget, ambient-folded —
+  // the PR 15 deadline plane reaches a stalled producer too).
+  int64_t entry_deadline = monotonic_time_us() + opts_.timeout_ms * 1000;
+  {
+    const int64_t amb = ambient_deadline();
+    if (amb != 0) {
+      entry_deadline = std::min(entry_deadline, amb);
+    }
+  }
+  if (ready != 0 && !overlap) {
+    // Overlap off: wait ONCE for everything this rank will ever read
+    // from its send buffer, then run the unchanged barrier path —
+    // byte-identical semantics, single wait.
+    const uint64_t extent = plan_producer_extent(plan, my_rank_);
+    if (extent != 0) {
+      const int wrc = wait_ready(0, extent, entry_deadline);
+      if (wrc != 0) {
+        rc = wrc == ETIMEDOUT ? kEDeadlineExpired : wrc;
+        fail(rc, "send buffer never stamped ready (producer stalled)");
+      }
+    }
+  }
+
+  // Local moves first: the member's own bytes never ride the fabric.
+  // Overlap mode gates each copy on its input stamp (the producer may
+  // still be filling later ranges).
+  for (const CollTransfer& t : plan.local_copies) {
+    if (t.src != my_rank_ || rc != 0) {
+      continue;
+    }
+    if (overlap) {
+      const CollDep d = transfer_input_dep(t);
+      if (d.len != 0) {
+        const int wrc = wait_ready(d.off, d.len, entry_deadline);
+        if (wrc != 0) {
+          rc = wrc == ETIMEDOUT ? kEDeadlineExpired : wrc;
+          fail(rc, "local copy input never stamped ready");
+          break;
+        }
+        record_coll_ready(0, d.off, d.len);
+      }
+    }
+    memcpy(acc + t.dst_off,
+           static_cast<const char*>(sendbuf) + t.src_off, t.len);
+  }
   uint32_t steps_done = 0;
   for (size_t s = 0; s < plan.steps.size() && rc == 0; ++s) {
     const int64_t step_start = monotonic_time_us();
@@ -1405,12 +1639,27 @@ int GroupChannel::run(const TransferSchedule& plan, const void* sendbuf,
       const uint32_t nchunks = static_cast<uint32_t>(
           (t.len + chunk_bytes - 1) / chunk_bytes);
       for (uint32_t c = 0; c < nchunks && rc == 0; ++c) {
+        const uint64_t off = static_cast<uint64_t>(c) * chunk_bytes;
+        const uint64_t len = std::min(chunk_bytes, t.len - off);
+        if (overlap && !t.src_from_recv) {
+          // Readiness-triggered push: this chunk fires the moment the
+          // producer stamped its input range — the T3 per-chunk
+          // overlap seam.  A producer that never stamps trips the step
+          // deadline (whole-or-nothing), never a wedge.
+          const int wrc = wait_ready(t.src_off + off, len, deadline);
+          if (wrc != 0) {
+            rc = wrc;
+            fail(rc, "push input never stamped ready at step " +
+                         std::to_string(s));
+            break;
+          }
+          record_coll_ready(static_cast<uint32_t>(s), t.src_off + off,
+                            len);
+        }
         throttle();
         if (rc != 0) {
           break;
         }
-        const uint64_t off = static_cast<uint64_t>(c) * chunk_bytes;
-        const uint64_t len = std::min(chunk_bytes, t.len - off);
         CollPutWire w;
         memset(&w, 0, sizeof(w));
         w.group_id = group_id_;

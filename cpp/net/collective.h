@@ -143,6 +143,29 @@ struct TransferSchedule {
   uint64_t bytes_reused() const;
 };
 
+// ---- input dependencies (overlap-aware execution) ------------------------
+
+// The send-buffer range a transfer READS, compiled from the plan: with a
+// readiness map attached (see run() `ready`), the transfer may fire as
+// soon as this range is stamped instead of waiting for the whole-buffer
+// barrier.  len == 0 means no send-buffer input (the transfer reads the
+// receive/accumulator buffer — ring forwarding — whose readiness the
+// step barrier already orders).
+struct CollDep {
+  uint64_t off = 0;
+  uint64_t len = 0;
+};
+
+// Input dependency of one transfer as executed by rank `src`: its
+// send-buffer source range unless src_from_recv.
+CollDep transfer_input_dep(const CollTransfer& t);
+
+// Max send-buffer extent (off+len) rank `rank` reads anywhere in the
+// plan — the range the barrier path waits on when a readiness map is
+// attached with overlap disabled (byte-identical semantics, single
+// wait).  0 when the rank never reads its send buffer.
+uint64_t plan_producer_extent(const TransferSchedule& plan, uint32_t rank);
+
 // Deterministic ring/pairwise planners — every member compiles the same
 // plan from the same arguments.
 //   all_gather:     send = shard, recv = n*shard; n-1 ring steps.
@@ -220,9 +243,18 @@ class GroupChannel {
   // contract.  run_seq must advance identically on every member; pass 0
   // to use the group's internal call counter.  Returns 0, kECollAbort,
   // kECollEpoch, kECollMismatch, or a transport errno.
+  //
+  // `ready` (optional): an rma_ready_create handle over THIS member's
+  // sendbuf.  The caller stamps ranges as it fills them; transfers whose
+  // compiled input dependency (transfer_input_dep) is stamped fire
+  // immediately.  With trpc_coll_overlap off the executor instead waits
+  // once for the full producer extent before executing the unchanged
+  // barrier path — byte-identical results either way.  A producer that
+  // never stamps trips the step deadline (whole-or-nothing abort), never
+  // a wedge.  0 = no readiness gating (legacy barrier semantics).
   int run(const TransferSchedule& plan, const void* sendbuf,
           uint64_t send_len, void* recvbuf, uint64_t recv_len,
-          uint64_t run_seq = 0);
+          uint64_t run_seq = 0, uint64_t ready = 0);
 
   // Convenience wrappers: compile + run.
   int all_gather(const void* sendbuf, uint64_t shard_bytes, void* recvbuf,
@@ -267,9 +299,14 @@ class GroupChannel {
 int coll_attach(Server* s);
 
 // Flag registration (idempotent): trpc_coll_chunk_bytes,
-// trpc_coll_inflight, trpc_coll_rendezvous_ms — the capi calls it so
-// /flags sees the knobs before first traffic.
+// trpc_coll_inflight, trpc_coll_rendezvous_ms,
+// trpc_coll_ready_granularity_bytes, trpc_coll_overlap — the capi calls
+// it so /flags sees the knobs before first traffic.
 void coll_ensure_registered();
+
+// Current trpc_coll_ready_granularity_bytes value (the default chunk
+// granularity for readiness maps created through the C API).
+uint64_t coll_ready_default_granularity();
 
 // ---- wire ----------------------------------------------------------------
 

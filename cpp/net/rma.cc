@@ -15,6 +15,7 @@
 #include "base/flags.h"
 #include "base/logging.h"
 #include "base/time.h"
+#include "fiber/event.h"
 #include "fiber/fiber.h"
 #include "net/fault.h"
 #include "net/hotpath_stats.h"
@@ -1427,6 +1428,174 @@ bool rma_resolve(InputMessage* msg, Socket* sock) {
   // contract as stripe.cc's dispatch_entry).
   m.checksum = 0;
   return true;
+}
+
+// -- readiness maps --------------------------------------------------------
+//
+// Producer-stamped chunk-ready bitmaps with the RmaXfer fence
+// discipline: stamp = release fetch_or after the producer's writes,
+// test = acquire scan so a true answer publishes those writes.  Maps
+// are process-local; waiters park on a fiber Event so both fibers and
+// pthreads (ctypes callers) can block.
+
+namespace {
+
+struct ReadyMap {
+  const char* base = nullptr;
+  uint64_t len = 0;
+  uint64_t granularity = 0;
+  uint64_t nchunks = 0;
+  std::vector<std::atomic<uint64_t>> bits;
+  // Monotonic count of bytes stamped (first-time bits only).
+  // relaxed: stats only, read with no ordering requirement.
+  std::atomic<uint64_t> ready_bytes{0};
+  // Bumped (and woken) on every stamp so range waiters re-scan.
+  Event changed;
+
+  ReadyMap(const void* b, uint64_t l, uint64_t g)
+      : base(static_cast<const char*>(b)),
+        len(l),
+        granularity(g),
+        nchunks((l + g - 1) / g),
+        bits((nchunks + 63) / 64) {}
+};
+
+std::mutex& ready_mu() {
+  static std::mutex* mu = new std::mutex();
+  return *mu;
+}
+
+std::unordered_map<uint64_t, std::shared_ptr<ReadyMap>>& ready_reg() {
+  static auto* reg =
+      new std::unordered_map<uint64_t, std::shared_ptr<ReadyMap>>();
+  return *reg;
+}
+
+uint64_t& ready_next_handle() {
+  static uint64_t next = 1;
+  return next;
+}
+
+std::shared_ptr<ReadyMap> ready_find(uint64_t handle) {
+  std::lock_guard<std::mutex> g(ready_mu());
+  auto it = ready_reg().find(handle);
+  return it == ready_reg().end() ? nullptr : it->second;
+}
+
+// Chunk index range [first, last] covering [off, off+len); false when
+// the span falls outside the map.
+bool ready_span(const ReadyMap& m, uint64_t off, uint64_t len,
+                uint64_t* first, uint64_t* last) {
+  if (len == 0 || off > m.len || m.len - off < len) return false;
+  *first = off / m.granularity;
+  *last = (off + len - 1) / m.granularity;
+  return true;
+}
+
+// Acquire scan: 1 when every chunk in [first, last] is stamped.
+bool ready_all_set(const ReadyMap& m, uint64_t first, uint64_t last) {
+  for (uint64_t c = first; c <= last; ++c) {
+    // acquire: pairs with the stamp's release fetch_or — observing the
+    // bit set publishes the producer's buffer writes up to the stamp.
+    const uint64_t w = m.bits[c / 64].load(std::memory_order_acquire);
+    if (!(w & (1ull << (c % 64)))) return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+uint64_t rma_ready_create(const void* base, uint64_t len,
+                          uint64_t granularity) {
+  if (base == nullptr || len == 0 || granularity == 0) return 0;
+  auto map = std::make_shared<ReadyMap>(base, len, granularity);
+  std::lock_guard<std::mutex> g(ready_mu());
+  const uint64_t h = ready_next_handle()++;
+  ready_reg().emplace(h, std::move(map));
+  return h;
+}
+
+int rma_ready_stamp(uint64_t handle, uint64_t off, uint64_t len) {
+  auto m = ready_find(handle);
+  if (!m) return -1;
+  uint64_t first, last;
+  if (!ready_span(*m, off, len, &first, &last)) return -1;
+  // Alignment contract: stamps cover whole chunks so a later test of
+  // any sub-range is never half-true.
+  if (off % m->granularity != 0) return -1;
+  if (len % m->granularity != 0 && off + len != m->len) return -1;
+  uint64_t fresh_bytes = 0;
+  for (uint64_t c = first; c <= last; ++c) {
+    const uint64_t bit = 1ull << (c % 64);
+    // release: publishes the producer's preceding buffer writes to any
+    // consumer whose acquire scan observes this bit (RmaXfer pattern).
+    const uint64_t prev =
+        m->bits[c / 64].fetch_or(bit, std::memory_order_release);
+    if (!(prev & bit)) {
+      fresh_bytes += std::min(m->granularity, m->len - c * m->granularity);
+    }
+  }
+  if (fresh_bytes != 0) {
+    // relaxed: stats counter, no ordering needed beyond the bit fence.
+    m->ready_bytes.fetch_add(fresh_bytes, std::memory_order_relaxed);
+  }
+  // relaxed: the Event word is only a wakeup ticket — waiters re-scan
+  // the bitmap (acquire) after every wake, so no ordering rides on it.
+  m->changed.value.fetch_add(1, std::memory_order_relaxed);
+  m->changed.wake_all();
+  return 0;
+}
+
+int rma_ready_test(uint64_t handle, uint64_t off, uint64_t len) {
+  auto m = ready_find(handle);
+  if (!m) return -1;
+  uint64_t first, last;
+  if (!ready_span(*m, off, len, &first, &last)) return -1;
+  return ready_all_set(*m, first, last) ? 1 : 0;
+}
+
+int rma_ready_wait(uint64_t handle, uint64_t off, uint64_t len,
+                   int64_t deadline_us) {
+  for (;;) {
+    auto m = ready_find(handle);
+    if (!m) return EINVAL;  // destroyed under a parked waiter
+    uint64_t first, last;
+    if (!ready_span(*m, off, len, &first, &last)) return EINVAL;
+    // relaxed: ticket read only; the authoritative answer is the
+    // acquire bitmap scan below, re-run after every wake.
+    const uint32_t v = m->changed.value.load(std::memory_order_relaxed);
+    if (ready_all_set(*m, first, last)) return 0;
+    if (deadline_us >= 0 && monotonic_time_us() >= deadline_us) {
+      return ETIMEDOUT;
+    }
+    m->changed.wait(v, deadline_us);
+  }
+}
+
+uint64_t rma_ready_bytes(uint64_t handle) {
+  auto m = ready_find(handle);
+  // relaxed: stats read, no ordering requirement.
+  return m ? m->ready_bytes.load(std::memory_order_relaxed) : 0;
+}
+
+void rma_ready_destroy(uint64_t handle) {
+  std::shared_ptr<ReadyMap> m;
+  {
+    std::lock_guard<std::mutex> g(ready_mu());
+    auto it = ready_reg().find(handle);
+    if (it == ready_reg().end()) return;
+    m = std::move(it->second);
+    ready_reg().erase(it);
+  }
+  // Wake parked waiters; they re-resolve the handle and see EINVAL.
+  // relaxed: wakeup ticket only (see rma_ready_stamp).
+  m->changed.value.fetch_add(1, std::memory_order_relaxed);
+  m->changed.wake_all();
+}
+
+size_t rma_ready_maps() {
+  std::lock_guard<std::mutex> g(ready_mu());
+  return ready_reg().size();
 }
 
 }  // namespace trpc

@@ -220,4 +220,55 @@ int rma_rails_for(int socket_mode);
 // `now_us` 0 reads the clock.  Returns slots reclaimed by THIS pass.
 size_t rma_scavenge(int64_t now_us = 0);
 
+// -- readiness maps (producer-stamped chunk-ready bitmaps) -----------------
+//
+// A ready map tracks which granularity-sized chunks of a producer's
+// buffer have been filled, with the SAME release-fence discipline as
+// the RMA completion bitmaps above: the producer stamps a range with a
+// release fetch_or AFTER writing the bytes, and any consumer that
+// observes the bit with an acquire load is guaranteed to see the
+// producer's bytes.  Maps are process-local (the collective serve
+// handlers and push loops run in the producer's process); the handle
+// is an opaque non-zero token safe to pass through the C API.
+//
+// Used by the overlap-aware collective executor (net/collective.h):
+// transfers whose compiled input dependency covers [off, off+len) fire
+// as soon as the range is stamped instead of waiting for a
+// whole-buffer barrier.
+
+// Registers [base, base+len) with the given chunk granularity
+// (bytes > 0; the final chunk may be short).  Returns a non-zero
+// handle, or 0 on invalid arguments.
+uint64_t rma_ready_create(const void* base, uint64_t len,
+                          uint64_t granularity);
+
+// Marks [off, off+len) ready.  `off` must be chunk-aligned and `len` a
+// multiple of the granularity (or reach exactly to the end of the
+// buffer); release-fenced against the producer's preceding writes.
+// Stamping is monotonic — re-stamping a range is a no-op.  Wakes all
+// range waiters.  Returns 0, or -1 on bad handle / misaligned or
+// out-of-range span.
+int rma_ready_stamp(uint64_t handle, uint64_t off, uint64_t len);
+
+// True (1) when every chunk overlapping [off, off+len) is stamped;
+// acquire-fenced so a true answer publishes the producer's bytes.
+// 0 when not yet ready, -1 on bad handle / out-of-range span.
+int rma_ready_test(uint64_t handle, uint64_t off, uint64_t len);
+
+// Blocks until rma_ready_test(handle, off, len) would return 1, or the
+// absolute deadline (monotonic µs; -1 = no deadline) passes.
+// Fiber- and pthread-safe (fiber Event underneath).  Returns 0 ready,
+// ETIMEDOUT on deadline, EINVAL on bad handle / span.
+int rma_ready_wait(uint64_t handle, uint64_t off, uint64_t len,
+                   int64_t deadline_us);
+
+// Bytes stamped ready so far (monotonic; for stats/tests).
+uint64_t rma_ready_bytes(uint64_t handle);
+
+// Unregisters the map.  Pending waiters wake and observe EINVAL.
+void rma_ready_destroy(uint64_t handle);
+
+// Live map count (quiescence checks in tests).
+size_t rma_ready_maps();
+
 }  // namespace trpc

@@ -92,6 +92,10 @@ enum EventType : uint32_t {
   kCapture = 26,  // a=trace id, b=(op << 56) | request bytes; ops:
                   // 1 keep (record retained), 2 drop (reservoir full),
                   // 3 dump (b low bits = records written)
+  // -- overlap-aware collectives (net/collective.h) ----------------------
+  kCollReady = 27,  // a=schedule step, b=(chunk << 32) | bytes — a
+                    // transfer fired by a readiness stamp (chunk =
+                    // dep offset / trpc_coll_ready_granularity_bytes)
   kEventTypeCount,
 };
 
@@ -132,6 +136,7 @@ constexpr const char* kEventNames[] = {
     "tuner_decision",  // timeline-event 24 (tuner_decision)
     "deadline",        // timeline-event 25 (deadline)
     "capture",         // timeline-event 26 (capture)
+    "coll_ready",      // timeline-event 27 (coll_ready)
 };
 static_assert(sizeof(kEventNames) / sizeof(kEventNames[0]) ==
                   kEventTypeCount,

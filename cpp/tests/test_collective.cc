@@ -570,4 +570,339 @@ TEST_CASE(naming_group_epoch_change_fails_step) {
   registry.Stop();
 }
 
+// -- readiness-triggered transfers (overlap-aware collectives) -------------
+
+namespace {
+
+// Per-rank ready maps over each member's sendbuf, destroyed on scope
+// exit; stamp_all marks the full buffer, stamp_to a prefix.
+struct ReadyMaps {
+  std::vector<uint64_t> handles;
+  ReadyMaps(const std::vector<std::unique_ptr<MemberBufs>>& bufs,
+            uint64_t send_len, uint64_t granularity) {
+    for (const auto& b : bufs) {
+      const uint64_t h = rma_ready_create(b->send, send_len, granularity);
+      EXPECT(h != 0);
+      handles.push_back(h);
+    }
+  }
+  ~ReadyMaps() {
+    for (uint64_t h : handles) {
+      rma_ready_destroy(h);
+    }
+  }
+  void stamp_to(uint32_t r, uint64_t len) {
+    if (len > 0) {
+      EXPECT_EQ(rma_ready_stamp(handles[r], 0, len), 0);
+    }
+  }
+};
+
+}  // namespace
+
+TEST_CASE(overlap_off_ready_map_byte_identical) {
+  // Default trpc_coll_overlap=false: a run with a ready map attached
+  // waits ONCE for the producer extent, then takes the unchanged
+  // barrier path — bytes identical to a plain run, even when the
+  // producer stamps late from another thread (serves never ship
+  // unstamped bytes in either mode).
+  const size_t maps0 = rma_ready_maps();
+  const uint32_t n = 3;
+  const uint64_t shard = 256 << 10;
+  const uint64_t gran = 64 << 10;
+  Fleet fleet(n);
+  std::vector<std::unique_ptr<MemberBufs>> bufs;
+  for (uint32_t r = 0; r < n; ++r) {
+    bufs.push_back(std::make_unique<MemberBufs>(n * shard, shard));
+  }
+  auto fill = [&] {
+    for (uint32_t r = 0; r < n; ++r) {
+      auto* v = reinterpret_cast<uint32_t*>(bufs[r]->send);
+      for (size_t i = 0; i < n * shard / 4; ++i) {
+        v[i] = static_cast<uint32_t>(i * 7 + r * 1000003);
+      }
+    }
+  };
+  // Plain run → golden recv bytes (reduce_scatter MUTATES send, so the
+  // ready-map run refills before reproducing it).
+  fill();
+  auto rcs = fleet.run_all([&](GroupChannel* g, uint32_t r, uint64_t seq) {
+    return g->run(plan_reduce_scatter(n, shard), bufs[r]->send, n * shard,
+                  bufs[r]->recv, shard, seq);
+  });
+  std::vector<std::string> golden;
+  for (uint32_t r = 0; r < n; ++r) {
+    EXPECT_EQ(rcs[r], 0);
+    golden.emplace_back(bufs[r]->recv, shard);
+    memset(bufs[r]->recv, 0, shard);
+  }
+  fill();
+  {
+    ReadyMaps maps(bufs, n * shard, gran);
+    // Producers stamp LATE, chunk by chunk, from their own threads —
+    // the overlap-off executor must park until the extent is ready.
+    std::vector<std::thread> producers;
+    for (uint32_t r = 0; r < n; ++r) {
+      producers.emplace_back([&, r] {
+        for (uint64_t off = 0; off < n * shard; off += gran) {
+          usleep(200);
+          EXPECT_EQ(rma_ready_stamp(maps.handles[r], off, gran), 0);
+        }
+      });
+    }
+    rcs = fleet.run_all([&](GroupChannel* g, uint32_t r, uint64_t seq) {
+      return g->run(plan_reduce_scatter(n, shard), bufs[r]->send,
+                    n * shard, bufs[r]->recv, shard, seq,
+                    maps.handles[r]);
+    });
+    for (auto& t : producers) {
+      t.join();
+    }
+    for (uint32_t r = 0; r < n; ++r) {
+      EXPECT_EQ(rcs[r], 0);
+      EXPECT_EQ(memcmp(bufs[r]->recv, golden[r].data(), shard), 0);
+    }
+  }
+  EXPECT_EQ(coll_sessions_live(), 0u);
+  EXPECT_EQ(rma_ready_maps(), maps0);
+}
+
+TEST_CASE(overlapped_run_byte_exact_vs_barrier) {
+  // trpc_coll_overlap=true: transfers fire per-chunk as producers
+  // stamp; the result must still be byte-exact against the barrier
+  // run's golden bytes (whole-or-nothing step semantics preserved).
+  FlagGuard overlap("trpc_coll_overlap", "true");
+  const size_t maps0 = rma_ready_maps();
+  const uint32_t n = 3;
+  const uint64_t shard = 256 << 10;
+  const uint64_t gran = 64 << 10;
+  Fleet fleet(n);
+  std::vector<std::unique_ptr<MemberBufs>> bufs;
+  for (uint32_t r = 0; r < n; ++r) {
+    bufs.push_back(std::make_unique<MemberBufs>(n * shard, shard));
+  }
+  auto fill = [&] {
+    for (uint32_t r = 0; r < n; ++r) {
+      auto* v = reinterpret_cast<uint32_t*>(bufs[r]->send);
+      for (size_t i = 0; i < n * shard / 4; ++i) {
+        v[i] = static_cast<uint32_t>(i * 13 + r * 999983);
+      }
+    }
+  };
+  fill();
+  auto rcs = fleet.run_all([&](GroupChannel* g, uint32_t r, uint64_t seq) {
+    return g->run(plan_reduce_scatter(n, shard), bufs[r]->send, n * shard,
+                  bufs[r]->recv, shard, seq);
+  });
+  std::vector<std::string> golden;
+  for (uint32_t r = 0; r < n; ++r) {
+    EXPECT_EQ(rcs[r], 0);
+    golden.emplace_back(bufs[r]->recv, shard);
+    memset(bufs[r]->recv, 0, shard);
+  }
+  fill();
+  {
+    ReadyMaps maps(bufs, n * shard, gran);
+    std::vector<std::thread> producers;
+    for (uint32_t r = 0; r < n; ++r) {
+      producers.emplace_back([&, r] {
+        for (uint64_t off = 0; off < n * shard; off += gran) {
+          usleep(200);
+          EXPECT_EQ(rma_ready_stamp(maps.handles[r], off, gran), 0);
+        }
+      });
+    }
+    rcs = fleet.run_all([&](GroupChannel* g, uint32_t r, uint64_t seq) {
+      return g->run(plan_reduce_scatter(n, shard), bufs[r]->send,
+                    n * shard, bufs[r]->recv, shard, seq,
+                    maps.handles[r]);
+    });
+    for (auto& t : producers) {
+      t.join();
+    }
+    for (uint32_t r = 0; r < n; ++r) {
+      EXPECT_EQ(rcs[r], 0);
+      EXPECT_EQ(memcmp(bufs[r]->recv, golden[r].data(), shard), 0);
+    }
+  }
+  EXPECT_EQ(coll_sessions_live(), 0u);
+  EXPECT_EQ(rma_ready_maps(), maps0);
+}
+
+TEST_CASE(never_stamped_producer_trips_deadline_not_wedge) {
+  // A producer that NEVER stamps must trip the run deadline — in both
+  // modes — not wedge the fleet; sessions quiesce and the same fleet
+  // serves a clean run afterwards.
+  FlagGuard rendezvous("trpc_coll_rendezvous_ms", "600");
+  const size_t maps0 = rma_ready_maps();
+  const uint32_t n = 3;
+  const uint64_t shard = 128 << 10;
+  Fleet fleet(n, /*timeout_ms=*/1500);
+  std::vector<std::unique_ptr<MemberBufs>> bufs;
+  for (uint32_t r = 0; r < n; ++r) {
+    bufs.push_back(std::make_unique<MemberBufs>(n * shard, shard));
+    memset(bufs[r]->send, 1 + r, n * shard);
+  }
+  for (const char* mode : {"false", "true"}) {
+    FlagGuard overlap("trpc_coll_overlap", mode);
+    ReadyMaps maps(bufs, n * shard, 64 << 10);  // never stamped
+    auto rcs = fleet.run_all([&](GroupChannel* g, uint32_t r,
+                                 uint64_t seq) {
+      return g->run(plan_reduce_scatter(n, shard), bufs[r]->send,
+                    n * shard, bufs[r]->recv, shard, seq,
+                    maps.handles[r]);
+    });
+    for (uint32_t r = 0; r < n; ++r) {
+      EXPECT(rcs[r] != 0);
+    }
+    EXPECT_EQ(coll_sessions_live(), 0u);
+  }
+  EXPECT_EQ(rma_ready_maps(), maps0);
+  // Not poisoned: a plain run on the SAME fleet succeeds byte-exact.
+  for (uint32_t r = 0; r < n; ++r) {
+    auto* v = reinterpret_cast<uint32_t*>(bufs[r]->send);
+    for (size_t i = 0; i < n * shard / 4; ++i) {
+      v[i] = static_cast<uint32_t>(i + r * 1000003);
+    }
+  }
+  auto rcs = fleet.run_all([&](GroupChannel* g, uint32_t r, uint64_t seq) {
+    return g->run(plan_reduce_scatter(n, shard), bufs[r]->send, n * shard,
+                  bufs[r]->recv, shard, seq);
+  });
+  for (uint32_t r = 0; r < n; ++r) {
+    EXPECT_EQ(rcs[r], 0);
+    const auto* got = reinterpret_cast<const uint32_t*>(bufs[r]->recv);
+    for (size_t i = 0; i < shard / 4; i += 97) {
+      const size_t gi = r * (shard / 4) + i;
+      uint32_t want = 0;
+      for (uint32_t src = 0; src < n; ++src) {
+        want += static_cast<uint32_t>(gi + src * 1000003);
+      }
+      EXPECT_EQ(got[i], want);
+    }
+  }
+}
+
+TEST_CASE(chunk_fault_on_triggered_transfer_fails_whole) {
+  // Chaos (chunk drops) against the readiness-TRIGGERED path: a step
+  // whose transfer faults fails whole-or-nothing — a member reporting
+  // success must hold exact bytes — and the fleet recovers once faults
+  // clear.  Mirrors chunk_fault_fails_step_whole_and_recovers with the
+  // overlap machinery live.
+  FlagGuard overlap("trpc_coll_overlap", "true");
+  const size_t maps0 = rma_ready_maps();
+  const uint32_t n = 3;
+  const uint64_t shard = 1 << 20;
+  Fleet fleet(n, /*timeout_ms=*/4000);
+  std::vector<std::unique_ptr<MemberBufs>> bufs;
+  for (uint32_t r = 0; r < n; ++r) {
+    bufs.push_back(std::make_unique<MemberBufs>(shard, n * shard));
+    for (size_t i = 0; i < shard; ++i) {
+      bufs[r]->send[i] = pat(r, i);
+    }
+  }
+  {
+    FaultGuard guard;
+    EXPECT_EQ(FaultActor::global().set("seed=23;drop=0.6;max=48"), 0);
+    ReadyMaps maps(bufs, shard, 64 << 10);
+    std::vector<std::thread> producers;
+    for (uint32_t r = 0; r < n; ++r) {
+      producers.emplace_back([&, r] {
+        for (uint64_t off = 0; off < shard; off += 64 << 10) {
+          usleep(100);
+          EXPECT_EQ(rma_ready_stamp(maps.handles[r], off, 64 << 10), 0);
+        }
+      });
+    }
+    auto rcs = fleet.run_all([&](GroupChannel* g, uint32_t r,
+                                 uint64_t seq) {
+      return g->run(plan_all_gather(n, shard), bufs[r]->send, shard,
+                    bufs[r]->recv, n * shard, seq, maps.handles[r]);
+    });
+    for (auto& t : producers) {
+      t.join();
+    }
+    bool any_failed = false;
+    for (uint32_t r = 0; r < n; ++r) {
+      if (rcs[r] != 0) {
+        any_failed = true;
+      } else {
+        for (uint32_t src = 0; src < n; ++src) {
+          for (size_t i = 0; i < shard; i += 53) {
+            EXPECT_EQ(bufs[r]->recv[src * shard + i], pat(src, i));
+          }
+        }
+      }
+    }
+    EXPECT(any_failed);
+  }
+  EXPECT_EQ(coll_sessions_live(), 0u);
+  EXPECT_EQ(rma_ready_maps(), maps0);
+  // Faults cleared: the SAME fleet recovers byte-exact.
+  auto rcs = fleet.run_all([&](GroupChannel* g, uint32_t r, uint64_t seq) {
+    return g->run(plan_all_gather(n, shard), bufs[r]->send, shard,
+                  bufs[r]->recv, n * shard, seq);
+  });
+  for (uint32_t r = 0; r < n; ++r) {
+    EXPECT_EQ(rcs[r], 0);
+    for (uint32_t src = 0; src < n; ++src) {
+      for (size_t i = 0; i < shard; i += 53) {
+        EXPECT_EQ(bufs[r]->recv[src * shard + i], pat(src, i));
+      }
+    }
+  }
+}
+
+TEST_CASE(cancel_mid_overlapped_dataflow_quiesces) {
+  // Rank 2 never enters the overlapped dataflow and the producers only
+  // stamp HALF their buffers: the others' steps must fail within the
+  // run budget, abort cleanly, and leave zero sessions and no parked
+  // readiness waiter (destroying the maps afterwards must not find
+  // anyone still attached).
+  FlagGuard overlap("trpc_coll_overlap", "true");
+  FlagGuard rendezvous("trpc_coll_rendezvous_ms", "600");
+  const size_t maps0 = rma_ready_maps();
+  const uint32_t n = 3;
+  const uint64_t shard = 512 << 10;
+  Fleet fleet(n, /*timeout_ms=*/1500);
+  std::vector<std::unique_ptr<MemberBufs>> bufs;
+  for (uint32_t r = 0; r < n; ++r) {
+    bufs.push_back(std::make_unique<MemberBufs>(n * shard, shard));
+    memset(bufs[r]->send, 1 + r, n * shard);
+  }
+  {
+    ReadyMaps maps(bufs, n * shard, 64 << 10);
+    for (uint32_t r = 0; r < 2; ++r) {
+      maps.stamp_to(r, n * shard / 2);  // half, never the rest
+    }
+    fleet.seq += 1;
+    const uint64_t seq = fleet.seq;
+    std::vector<int> rcs(2, -1);
+    std::vector<std::thread> threads;
+    for (uint32_t r = 0; r < 2; ++r) {
+      threads.emplace_back([&, r] {
+        rcs[r] = fleet.groups[r]->run(plan_reduce_scatter(n, shard),
+                                      bufs[r]->send, n * shard,
+                                      bufs[r]->recv, shard, seq,
+                                      maps.handles[r]);
+      });
+    }
+    for (auto& t : threads) {
+      t.join();
+    }
+    EXPECT(rcs[0] != 0);
+    EXPECT(rcs[1] != 0);
+    EXPECT_EQ(coll_sessions_live(), 0u);
+  }
+  EXPECT_EQ(rma_ready_maps(), maps0);
+  // The fleet is not poisoned: a full plain run afterwards succeeds.
+  auto rcs2 = fleet.run_all([&](GroupChannel* g, uint32_t r, uint64_t s) {
+    return g->run(plan_reduce_scatter(n, shard), bufs[r]->send, n * shard,
+                  bufs[r]->recv, shard, s);
+  });
+  for (uint32_t r = 0; r < n; ++r) {
+    EXPECT_EQ(rcs2[r], 0);
+  }
+}
+
 TEST_MAIN

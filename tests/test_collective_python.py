@@ -29,8 +29,8 @@ import time
 import numpy as np
 import pytest
 
-from brpc_tpu.rpc import (Channel, Server, collective, fault, observe, rma,
-                          set_flag)
+from brpc_tpu.rpc import (Channel, Server, collective, fault, get_flag,
+                          observe, rma, set_flag)
 
 
 class Fleet:
@@ -434,3 +434,208 @@ def test_error_mapping_and_mismatch():
         assert collective.sessions_live() == 0
     finally:
         fleet.close()
+
+
+# -- overlap-aware collectives (ISSUE 18) ----------------------------------
+
+
+def test_overlap_flag_validation_and_ready_map_contract():
+    """The runtime knobs reject garbage loudly and the ReadyMap argument
+    contract (chunk alignment, bounds, idempotent stamps, close
+    quiescence) raises instead of corrupting."""
+    buf = rma.RmaBuffer(256 << 10)
+    try:
+        live0 = collective.ready_maps_live()
+        m = collective.ReadyMap(buf, granularity=64 << 10)
+        assert m.handle != 0
+        # ReadyMap creation registered the collective runtime — the
+        # flags exist from here on.
+        with pytest.raises(ValueError):
+            set_flag("trpc_coll_overlap", "banana")
+        with pytest.raises(ValueError):
+            set_flag("trpc_coll_ready_granularity_bytes", "1024")  # < 4KB
+        with pytest.raises(ValueError):
+            set_flag("trpc_coll_ready_granularity_bytes", str(1 << 40))
+        assert get_flag("trpc_coll_overlap") == "false"  # default off
+        with pytest.raises(ValueError):
+            m.stamp(1, 64 << 10)  # misaligned offset
+        with pytest.raises(ValueError):
+            m.stamp(0, 512 << 10)  # beyond the buffer end
+        with pytest.raises(ValueError):
+            m.stamp(0, (64 << 10) + 1)  # not a chunk multiple
+        m.stamp(0, 64 << 10)
+        m.stamp(0, 64 << 10)  # monotonic: restamp is a no-op
+        m.stamp(64 << 10, 192 << 10)  # reaches the buffer end
+        assert collective.ready_maps_live() == live0 + 1
+        m.close()
+        assert m.handle == 0
+        assert collective.ready_maps_live() == live0
+    finally:
+        buf.free()
+
+
+def test_overlap_off_ready_attached_is_invisible_and_exact():
+    """Default trpc_coll_overlap=false with a ready map ATTACHED: the
+    run waits once for the producer extent, results are byte-identical,
+    and the overlap vars stay frozen at 0 — the feature is invisible
+    until the flag flips."""
+    n, shard = 2, 128 << 10
+    w = shard // 4
+    fleet = Fleet(n)
+    try:
+        sends = [rma.RmaBuffer(n * shard) for _ in range(n)]
+        recvs = [rma.RmaBuffer(shard) for _ in range(n)]
+        base = np.arange(n * w, dtype=np.uint32)
+        for r in range(n):
+            np.frombuffer(memoryview(sends[r].view),
+                          dtype=np.uint32)[:] = base * 5 + r
+        maps = [collective.ReadyMap(sends[r], granularity=32 << 10)
+                for r in range(n)]
+        for m in maps:
+            m.stamp(0, m.nbytes)
+        v0 = observe.Vars.dump()
+        errs = fleet.run_all(
+            lambda g, r, seq: g.reduce_scatter(sends[r], recvs[r],
+                                               shard_bytes=shard,
+                                               run_seq=seq,
+                                               ready=maps[r]))
+        assert not any(errs), errs
+        v1 = observe.Vars.dump()
+        assert v1.get("coll_ready_triggers_total", 0) == \
+            v0.get("coll_ready_triggers_total", 0), \
+            "overlap off must never readiness-trigger a transfer"
+        assert v1.get("coll_overlap_runs_total", 0) == \
+            v0.get("coll_overlap_runs_total", 0), \
+            "overlap off must not count overlap runs"
+        for r in range(n):
+            got = np.frombuffer(memoryview(recvs[r].view), dtype=np.uint32)
+            want = sum((base[r * w:(r + 1) * w] * np.uint32(5) + k)
+                       for k in range(n)).astype(np.uint32)
+            assert np.array_equal(got, want), f"rank {r} reduction wrong"
+        for m in maps:
+            m.close()
+        assert collective.sessions_live() == 0
+    finally:
+        fleet.close()
+
+
+_OVL_CHILD_SRC = r"""
+import sys, threading, time
+import numpy as np
+from brpc_tpu.rpc import (Server, collective, naming, observe, rma,
+                          set_flag)
+
+reg_addr, n, shard, M = (sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+                         int(sys.argv[4]))
+w = shard // 4
+srv = Server(); srv.enable_collective(); srv.start(0)
+srv.announce(reg_addr, "coll_ovl", zone="z1")
+self_addr = f"127.0.0.1:{srv.port}"
+nc = naming.NamingClient(reg_addr, timeout_ms=5000)
+deadline = time.time() + 30
+while True:
+    _v, members = nc.resolve("coll_ovl")
+    if len(members) >= n:
+        break
+    if time.time() > deadline:
+        print("RENDEZVOUS_TIMEOUT", flush=True); sys.exit(2)
+    time.sleep(0.05)
+g = collective.Group(naming_url=f"naming://{reg_addr}/coll_ovl",
+                     self_addr=self_addr, timeout_ms=30000)
+r = g.rank
+grads = [rma.RmaBuffer(n * shard) for _ in range(M)]
+reds = [rma.RmaBuffer(shard) for _ in range(M)]
+gaths = [rma.RmaBuffer(n * shard) for _ in range(M)]
+
+def fill(m):
+    v = np.frombuffer(memoryview(grads[m].view), dtype=np.uint32)
+    for p in range(n):
+        v[p*w:(p+1)*w] = (np.arange(w, dtype=np.uint32)
+                          * np.uint32(2654435761)
+                          + np.uint32(r*1000003 + m*10007 + p*101))
+
+# Sequential baseline: fill whole buffer, then communicate.
+for m in range(M):
+    fill(m)
+    g.reduce_scatter(grads[m], reds[m], shard_bytes=shard,
+                     run_seq=1 + 2*m)
+    g.all_gather(reds[m], gaths[m], shard_bytes=shard, run_seq=2 + 2*m)
+golden = [bytes(memoryview(gaths[m].view)) for m in range(M)]
+
+# Overlapped: per-microbatch ReadyMap; the comm lane runs UNDER the
+# producer, transfers firing as pieces stamp.
+set_flag("trpc_coll_overlap", "true")
+readies = [collective.ReadyMap(grads[m], granularity=shard)
+           for m in range(M)]
+base = 2 * M
+
+def comm():
+    for m in range(M):
+        g.reduce_scatter(grads[m], reds[m], shard_bytes=shard,
+                         run_seq=base + 1 + 2*m, ready=readies[m])
+        g.all_gather(reds[m], gaths[m], shard_bytes=shard,
+                     run_seq=base + 2 + 2*m)
+
+t = threading.Thread(target=comm)
+t.start()
+for m in range(M):
+    v = np.frombuffer(memoryview(grads[m].view), dtype=np.uint32)
+    for p in range(n):
+        v[p*w:(p+1)*w] = (np.arange(w, dtype=np.uint32)
+                          * np.uint32(2654435761)
+                          + np.uint32(r*1000003 + m*10007 + p*101))
+        readies[m].stamp(p * shard, shard)
+        time.sleep(0.002)
+t.join(120)
+if t.is_alive():
+    print("WEDGED", flush=True); sys.exit(4)
+if any(bytes(memoryview(gaths[m].view)) != golden[m] for m in range(M)):
+    print(f"MISMATCH rank={r}", flush=True); sys.exit(3)
+trig = observe.Vars.dump().get("coll_ready_triggers_total", 0)
+if trig <= 0:
+    print("NO_TRIGGERS", flush=True); sys.exit(5)
+for rm in readies:
+    rm.close()
+if collective.sessions_live() != 0 or collective.ready_maps_live() != 0:
+    print("NOT_QUIESCED", flush=True); sys.exit(6)
+print(f"OK rank={r} triggers={trig}", flush=True)
+g.close(); srv.stop()
+"""
+
+
+def test_multi_process_overlapped_pipeline_byte_exact():
+    """The overlapped dataflow across GENUINE process boundaries: N
+    member processes rendezvous through a naming registry, run M
+    microbatches sequentially (golden bytes), then re-run the same
+    dataflow overlapped — per-microbatch ReadyMap, producer stamping
+    piece by piece while the comm lane is already inside the
+    collective — and byte-verify against the sequential golden in every
+    member, with readiness triggers observed and full quiescence."""
+    n, shard, microbatches = 3, 128 << 10, 2
+    registry = Server()
+    registry.enable_naming_registry()
+    registry.start(0)
+    reg_addr = f"127.0.0.1:{registry.port}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _OVL_CHILD_SRC, reg_addr, str(n),
+         str(shard), str(microbatches)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for _ in range(n)]
+    try:
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=120)
+            outs.append((p.returncode, out, err))
+        oks = [o for rc, o, _ in outs if rc == 0 and "OK rank=" in o]
+        assert len(oks) == n, f"multi-process overlap failed: {outs}"
+        ranks = sorted(int(o.split("OK rank=")[1].split()[0]) for o in oks)
+        assert ranks == list(range(n)), outs
+        assert all("triggers=" in o for o in oks), outs
+    finally:
+        for p in procs:
+            p.kill()
+        registry.stop()

@@ -666,6 +666,60 @@ def test_all_gather_4x64mb_per_link_floor_and_reshard_minimality():
         f"one-sided 64MB put baseline): {best}")
 
 
+# Overlap floor (ISSUE 18 acceptance): the pipeline-parallel dataflow —
+# readiness-triggered transfers riding UNDER the next microbatch's jax
+# compute over an emulated-latency link — must beat the sequential
+# compute-then-communicate baseline of the SAME dataflow by >= 1.25x,
+# byte-exact.  Measured at 1.35-1.42x on quiet runs of the 4-member
+# 8-microbatch 256KB-shard workload (tools/pipeline_step.py, compute
+# auto-calibrated to ~0.8x the in-step comm).
+PIPELINE_OVERLAP_SPEEDUP_FLOOR = 1.25
+
+
+def test_pipeline_overlap_speedup_floor():
+    """Reuses the bench child (BENCH_OVERLAP) so the asserted speedup
+    and the published bench row are the SAME measurement.  Best-of-3:
+    the speedup is timing-bound on shared boxes and a real regression
+    loses every round.  Correctness invariants (byte-exactness, stamps
+    actually triggering transfers, quiescence) are asserted EVERY
+    round — never timing-excused."""
+    import pathlib
+    import sys
+
+    bench = pathlib.Path(__file__).resolve().parent.parent / "bench.py"
+    env = dict(os.environ)
+    env["BENCH_OVERLAP"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    best = None
+    for _ in range(3):
+        out = subprocess.run([sys.executable, str(bench)],
+                             capture_output=True, text=True, timeout=240,
+                             env=env)
+        line = next((ln for ln in out.stdout.splitlines()[::-1]
+                     if ln.startswith("{")), None)
+        assert line, f"pipeline_overlap bench child produced no row:\n" \
+                     f"{out.stderr[-3000:]}"
+        row = json.loads(line)
+        # Hard invariants — never timing-excused.
+        assert row["byte_exact"], (
+            f"overlapped dataflow diverged from the sequential bytes — "
+            f"a transfer fired before its input was ready: {row}")
+        assert row["ready_triggers"] > 0, (
+            f"no transfer was readiness-triggered — the overlapped run "
+            f"silently fell back to the barrier path: {row}")
+        assert row["sessions_live"] == 0, f"leaked recv sessions: {row}"
+        assert row["ready_maps_live"] == 0, f"leaked ready maps: {row}"
+        if best is None or row["speedup"] > best["speedup"]:
+            best = row
+        if row["speedup"] >= PIPELINE_OVERLAP_SPEEDUP_FLOOR:
+            return
+    raise AssertionError(
+        f"overlapped pipeline step speedup {best['speedup']}x under "
+        f"floor {PIPELINE_OVERLAP_SPEEDUP_FLOOR}x over the sequential "
+        f"baseline (overlap_efficiency "
+        f"{best['overlap_efficiency']}): {best}")
+
+
 def test_small_rpc_hot_path_unchanged_by_stripe_layer():
     """Acceptance guard: sub-threshold traffic must leave every stripe
     stat var untouched — the wait-free inline-write small-RPC path is

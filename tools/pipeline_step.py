@@ -1,0 +1,360 @@
+#!/usr/bin/env python3
+"""Pipeline-parallel training step as ONE overlapped dataflow (ISSUE 18).
+
+A 4-member fleet runs M microbatches of a data-parallel training step:
+each microbatch's gradient buffer is produced by REAL jax CPU compute
+(an iterated u32 multiply-add kernel, deterministic per (rank,
+microbatch, piece)), reduce-scattered across the fleet, and the reduced
+chunk all-gathered back — the reduce-scatter/all-gather decomposition
+of a data-parallel optimizer step.
+
+Two executions of the SAME dataflow:
+
+  sequential — microbatch m computes, THEN communicates: step time is
+      the ~sum of compute and comm (the whole-buffer-barrier world).
+  overlapped — each rank's comm lane issues every microbatch's
+      reduce-scatter up front with a `collective.ReadyMap` over the
+      gradient buffer while the compute lane keeps producing: transfers
+      fire per-chunk as the producer stamps (`trpc_coll_overlap`), so
+      microbatch m's communication rides UNDER microbatch m+1's compute.
+
+Headline metric: **overlap efficiency** = step_time / max(compute_time,
+comm_time) — 1.0 is perfect overlap (the step costs only its longest
+lane); the sequential baseline sits near (compute + comm) /
+max(compute, comm).  Results are byte-exact across both modes (asserted
+here, gated in tests/test_perf_smoke.py together with a ≥1.25x
+step-time improvement).
+
+Compute iterations are calibrated so compute_time ≈ comm_time — the
+regime where overlap pays the most and a sequential step pays ~2x.
+
+The fleet is loopback on one box, so raw comm is memcpy (pure CPU) and
+overlapping two CPU-bound lanes on one core cannot move wall time.  A
+real fabric's comm lane is LATENCY-bound — the transfer engine waits on
+the wire while the cores compute — so the driver emulates the link with
+the deterministic fault plane (`delay=1:MS` parks the rx fiber, burning
+no CPU — netem for the in-process fleet).  Both modes pay the identical
+emulated link; the row stamps it as link_delay_ms.
+
+Run: JAX_PLATFORMS=cpu python tools/pipeline_step.py --json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from brpc_tpu.rpc import (Server, collective, fault, get_flag,  # noqa: E402
+                          observe, rma, set_flag)
+
+# u32 LCG constants (Numerical Recipes) — the jax kernel iterates them.
+_MUL = np.uint32(1664525)
+_ADD = np.uint32(1013904223)
+
+
+def _make_kernel(iters: int):
+    import jax
+
+    @jax.jit
+    def kernel(x):
+        def body(_, v):
+            return v * _MUL + _ADD
+        return jax.lax.fori_loop(0, iters, body, x)
+
+    return kernel
+
+
+def _piece_seed(rank: int, m: int, piece: int, words: int) -> np.ndarray:
+    # Deterministic per (rank, microbatch, piece): both modes produce
+    # bit-identical gradients, so the results must match byte-for-byte.
+    base = np.uint32(rank * 1000003 + m * 10007 + piece * 101 + 1)
+    return (np.arange(words, dtype=np.uint32) * np.uint32(2654435761)
+            + base)
+
+
+class Fleet:
+    """n collective members in one process (one Server + Group each);
+    run_all drives one callable per rank on its own thread."""
+
+    def __init__(self, n: int, timeout_ms: int = 60000):
+        self.n = n
+        self.srvs = []
+        for _ in range(n):
+            s = Server()
+            s.enable_collective()
+            s.start(0)
+            self.srvs.append(s)
+        members = [f"127.0.0.1:{s.port}" for s in self.srvs]
+        self.groups = [collective.Group(members, r, timeout_ms=timeout_ms)
+                       for r in range(n)]
+
+    def run_all(self, fn) -> float:
+        errs = [None] * self.n
+
+        def go(r):
+            try:
+                fn(r)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errs[r] = e
+
+        threads = [threading.Thread(target=go, args=(r,))
+                   for r in range(self.n)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(240)
+        dt = time.perf_counter() - t0
+        if any(t.is_alive() for t in threads):
+            raise TimeoutError("pipeline member wedged")
+        if any(errs):
+            raise RuntimeError(f"pipeline member failed: {errs}")
+        return dt
+
+    def close(self):
+        for g in self.groups:
+            g.close()
+        for s in self.srvs:
+            s.stop()
+
+
+def run_pipeline(members: int = 4, shard_kb: int = 256,
+                 microbatches: int = 8, target_ms: float = 0.0,
+                 link_delay_ms: int = 2) -> dict:
+    n = members
+    shard = shard_kb << 10
+    m_count = microbatches
+    words = shard // 4
+    fleet = Fleet(n)
+    # Per rank per microbatch: gradient accumulator (n*shard, MUTATED by
+    # reduce_scatter), reduced chunk (shard), gathered result (n*shard).
+    grads = [[rma.RmaBuffer(n * shard) for _ in range(m_count)]
+             for _ in range(n)]
+    reds = [[rma.RmaBuffer(shard) for _ in range(m_count)]
+            for _ in range(n)]
+    gaths = [[rma.RmaBuffer(n * shard) for _ in range(m_count)]
+             for _ in range(n)]
+    seq_no = [0]
+
+    def next_seqs():
+        # Two collectives per microbatch, same run_seq on every member.
+        base = seq_no[0] + 1
+        seq_no[0] += 2 * m_count
+        return base
+
+    def grad_view(r, m):
+        return np.frombuffer(memoryview(grads[r][m].view), dtype=np.uint32)
+
+    def fill_all(kernel):
+        # Pre-fill every gradient buffer (no timing): comm-only probes.
+        for r in range(n):
+            for m in range(m_count):
+                v = grad_view(r, m)
+                for p in range(n):
+                    v[p * words:(p + 1) * words] = np.asarray(
+                        kernel(_piece_seed(r, m, p, words)))
+
+    def comm_lane(r, base):
+        # The barrier-world comm schedule: every microbatch's
+        # reduce-scatter + all-gather issued strictly in order (used by
+        # the comm-only calibration probe and the sequential baseline).
+        for m in range(m_count):
+            fleet.groups[r].reduce_scatter(
+                grads[r][m], reds[r][m], shard_bytes=shard,
+                run_seq=base + 2 * m)
+            fleet.groups[r].all_gather(
+                reds[r][m], gaths[r][m], shard_bytes=shard,
+                run_seq=base + 2 * m + 1)
+
+    # Emulated link (netem for the in-process fleet): park every rx
+    # fiber link_delay_ms before delivery — comm goes latency-bound (as
+    # on a real fabric) while the core stays free for compute.  Both
+    # modes below pay the identical link.
+    if link_delay_ms > 0:
+        fault.set_schedule(f"delay=1:{int(link_delay_ms)}")
+
+    # --- calibrate: comm-only time, then iters so compute ≈ comm ---
+    kernel_probe = _make_kernel(1)
+    fill_all(kernel_probe)
+    for _ in range(2):  # warm rings/windows/connections (twice: stable)
+        base = next_seqs()
+        fleet.run_all(lambda r: comm_lane(r, base))
+    fill_all(kernel_probe)
+    base = next_seqs()
+    comm_probe_s = fleet.run_all(lambda r: comm_lane(r, base))
+    if target_ms > 0:
+        comm_probe_s = target_ms / 1e3
+    probe = _make_kernel(64)
+    x = np.asarray(probe(_piece_seed(0, 0, 0, words)))  # compile+warm
+    t0 = time.perf_counter()
+    for _ in range(4):
+        x = np.asarray(probe(_piece_seed(0, 0, 0, words)))
+    per_iter_s = (time.perf_counter() - t0) / 4 / 64
+    pieces_per_rank = m_count * n
+    # Initial guess: compute ≈ 0.55x the comm-only probe (the probe
+    # overstates in-step comm because compute gaps absorb the rx tail).
+    # The sequential baseline below then measures the TRUE in-step
+    # compute/comm split and the guess is refined until compute sits at
+    # ~0.85x comm — comm stays the longer lane (the overlapped dataflow
+    # hides all of compute under it) with the least dead air.
+    iters = max(8, int(0.55 * comm_probe_s / max(per_iter_s, 1e-9)
+                       / pieces_per_rank))
+    iters = min(iters, 1 << 20)
+
+    compute_s = [0.0] * n
+    comm_s = [0.0] * n
+    set_flag("trpc_coll_overlap", "false")
+    for attempt in range(3):
+        kernel = _make_kernel(iters)
+        np.asarray(kernel(_piece_seed(0, 0, 0, words)))  # compile
+
+        def compute_piece(r, m, p, _k=kernel):
+            t0 = time.perf_counter()
+            out = np.asarray(_k(_piece_seed(r, m, p, words)))
+            grad_view(r, m)[p * words:(p + 1) * words] = out
+            compute_s[r] += time.perf_counter() - t0
+
+        # --- sequential baseline: compute m, then communicate m ---
+        compute_s[:] = [0.0] * n
+        comm_s[:] = [0.0] * n
+        base = next_seqs()
+
+        def seq_member(r, _base=base, _cp=compute_piece):
+            for m in range(m_count):
+                for p in range(n):
+                    _cp(r, m, p)
+                t0 = time.perf_counter()
+                fleet.groups[r].reduce_scatter(
+                    grads[r][m], reds[r][m], shard_bytes=shard,
+                    run_seq=_base + 2 * m)
+                fleet.groups[r].all_gather(
+                    reds[r][m], gaths[r][m], shard_bytes=shard,
+                    run_seq=_base + 2 * m + 1)
+                comm_s[r] += time.perf_counter() - t0
+
+        seq_step_s = fleet.run_all(seq_member)
+        compute_ms = max(compute_s) * 1e3
+        comm_ms = max(comm_s) * 1e3
+        ratio = compute_ms / max(comm_ms, 1e-6)
+        if 0.65 <= ratio <= 0.92 or iters >= (1 << 20):
+            break
+        # Re-aim at 0.8x the comm actually measured in-step and redo
+        # the baseline with the rescaled kernel.
+        iters = min(1 << 20, max(8, int(iters * 0.80 / max(ratio, 1e-6))))
+
+    seq_golden = [[bytes(memoryview(gaths[r][m].view))
+                   for m in range(m_count)] for r in range(n)]
+
+    # --- overlapped: one comm lane riding under the compute lane ---
+    set_flag("trpc_coll_overlap", "true")
+    rx0 = observe.Vars.dump().get("rma_rx_msgs", 0)
+    trig0 = observe.Vars.dump().get("coll_ready_triggers_total", 0)
+    base = next_seqs()
+
+    def ovl_member(r):
+        readies = [collective.ReadyMap(grads[r][m], granularity=shard)
+                   for m in range(m_count)]
+
+        # ONE dataflow: the comm lane's reduce-scatter for microbatch m
+        # fires per-chunk as the producer stamps, so RS(m) + AG(m) ride
+        # under compute(m+1..). A single lane — concurrent collectives
+        # would contend on the emulated link's serialized rx fibers.
+        def comm_thread():
+            for m in range(m_count):
+                fleet.groups[r].reduce_scatter(
+                    grads[r][m], reds[r][m], shard_bytes=shard,
+                    run_seq=base + 2 * m, ready=readies[m])
+                fleet.groups[r].all_gather(
+                    reds[r][m], gaths[r][m], shard_bytes=shard,
+                    run_seq=base + 2 * m + 1)
+
+        comm = threading.Thread(target=comm_thread)
+        comm.start()
+        # The compute lane: produce microbatch m's pieces and stamp each
+        # — m's transfers fire under m+1's compute.
+        for m in range(m_count):
+            for p in range(n):
+                compute_piece(r, m, p)
+                readies[m].stamp(p * shard, shard)
+        comm.join(240)
+        alive = comm.is_alive()
+        for rm in readies:
+            rm.close()
+        if alive:
+            raise TimeoutError(f"rank {r} comm lane wedged")
+
+    ovl_step_s = fleet.run_all(ovl_member)
+    set_flag("trpc_coll_overlap", "false")
+    if link_delay_ms > 0:
+        fault.set_schedule("")
+    rpc_path = ("rma" if observe.Vars.dump().get("rma_rx_msgs", 0) > rx0
+                else "copy")
+    ready_triggers = (observe.Vars.dump().get("coll_ready_triggers_total", 0)
+                      - trig0)
+
+    byte_exact = all(
+        bytes(memoryview(gaths[r][m].view)) == seq_golden[r][m]
+        for r in range(n) for m in range(m_count))
+
+    row = {
+        "workload": "pipeline_overlap",
+        "members": n,
+        "microbatches": m_count,
+        "shard_bytes": shard,
+        "link_delay_ms": int(link_delay_ms),
+        "compute_iters": iters,
+        "seq_step_ms": round(seq_step_s * 1e3, 1),
+        "ovl_step_ms": round(ovl_step_s * 1e3, 1),
+        "compute_ms": round(compute_ms, 1),
+        "comm_ms": round(comm_ms, 1),
+        # 1.0 = perfect overlap: the step costs only its longest lane.
+        "overlap_efficiency": round(
+            ovl_step_s * 1e3 / max(compute_ms, comm_ms, 1e-6), 3),
+        "seq_efficiency": round(
+            seq_step_s * 1e3 / max(compute_ms, comm_ms, 1e-6), 3),
+        "speedup": round(seq_step_s / max(ovl_step_s, 1e-9), 3),
+        "byte_exact": byte_exact,
+        "ready_triggers": int(ready_triggers),
+        "rpc_path": rpc_path,
+        "granularity_bytes": int(
+            get_flag("trpc_coll_ready_granularity_bytes")),
+        "sessions_live": collective.sessions_live(),
+        "ready_maps_live": collective.ready_maps_live(),
+    }
+    for bufs in (grads, reds, gaths):
+        for per_rank in bufs:
+            for b in per_rank:
+                b.free()
+    fleet.close()
+    return row
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true",
+                    help="print the row as one JSON line")
+    ap.add_argument("--members", type=int, default=4)
+    ap.add_argument("--shard-kb", type=int, default=256)
+    ap.add_argument("--microbatches", type=int, default=8)
+    ap.add_argument("--link-delay-ms", type=int, default=2,
+                    help="emulated rx link latency (0 = raw loopback)")
+    args = ap.parse_args()
+    row = run_pipeline(args.members, args.shard_kb, args.microbatches,
+                       link_delay_ms=args.link_delay_ms)
+    if args.json:
+        print(json.dumps(row), flush=True)
+    else:
+        for k, v in row.items():
+            print(f"{k:>20}: {v}")
+
+
+if __name__ == "__main__":
+    main()

@@ -172,6 +172,13 @@ _TL_KV_OPS = {1: "publish", 2: "serve", 3: "evict", 4: "stale",
 _TL_COLL_TID = 980000
 _TL_COLL_OPS = {1: "all_gather", 2: "reduce_scatter", 3: "all_to_all",
                 4: "reshard"}
+# coll_ready events (net/collective.h): one instant per transfer fired
+# by a producer readiness stamp before the whole-buffer barrier would
+# have released it — a = step index, b = chunk << 32 | bytes (chunk =
+# dep offset / trpc_coll_ready_granularity_bytes) — its own per-node
+# "coll ready" track NEXT to "collective", so compute/comm overlap is
+# visible as ready instants interleaving step completions.
+_TL_COLL_READY_TID = 981000
 # tuner_decision events (stat/tuner.h): one instant per knob actuation
 # by the self-tuning controller on its own per-node "tuner" track —
 # a = knob hash (tuner::knob_hash of the flag name), b = old << 32 |
@@ -279,6 +286,20 @@ def _timeline_chrome_events(pid: int, dump: dict, base: float,
                     "pid": pid, "tid": out_tid, "ts": ts,
                     "args": {"step": int(e["a"], 16),
                              "bytes": b & ((1 << 56) - 1),
+                             "trace_id": e["trace_id"],
+                             "span_id": e["span_id"], "fid": e["fid"]},
+                })
+                continue
+            if name == "coll_ready":
+                b = int(e["b"], 16)
+                out_tid = track(_TL_COLL_READY_TID, "coll ready")
+                events.append({
+                    "ph": "i", "s": "t", "cat": "timeline",
+                    "name": "coll_ready",
+                    "pid": pid, "tid": out_tid, "ts": ts,
+                    "args": {"step": int(e["a"], 16),
+                             "chunk": b >> 32,
+                             "bytes": b & 0xFFFFFFFF,
                              "trace_id": e["trace_id"],
                              "span_id": e["span_id"], "fid": e["fid"]},
                 })
