@@ -808,6 +808,179 @@ def _child_collective() -> None:
     print(json.dumps(row), flush=True)
 
 
+def _child_slo_fleet() -> None:
+    """Fleet-observability row (ISSUE 19): a 3-node in-process fleet —
+    every node an SLO-armed echo server publishing its digest+SLO blob
+    into a naming registry — serves the golden-capture tenant mix
+    (tests/data/golden_mixed.cap: fg 1KB foreground + bulk large), and
+    the row reports the MERGED per-tenant view (/fleet body) against a
+    pooled-digest oracle built from the very blobs the nodes published
+    (p99_oracle_ratio; acceptance <= 2.0, the octave bound), the 1KB
+    QPS with the publisher ON vs OFF (publication must ride the
+    Announcer's renew cadence, not the request path), and the time for
+    an induced latency regression on ONE node to flip that tenant's
+    burn-rate alert (breach_detect_ms; acceptance <= one fast window)."""
+    from brpc_tpu.rpc import Channel, Server, get_flag, observe, set_flag
+    from brpc_tpu.rpc.capture import load_capture
+    from brpc_tpu.rpc.naming import NamingClient
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    fast_ms = 1500
+    saved = {f: get_flag(f) for f in
+             ("trpc_slo", "trpc_fleet_publish", "trpc_slo_fast_window_ms",
+              "trpc_slo_slow_window_ms", "trpc_naming_lease_ms")}
+    set_flag("trpc_slo_fast_window_ms", str(fast_ms))
+    set_flag("trpc_slo_slow_window_ms", "8000")
+    set_flag("trpc_naming_lease_ms", "400")
+    observe.enable_slo(True)
+    observe.enable_fleet_publish(False)
+
+    spec = ("fg:p99_us=5000,avail=99.0;bulk:p99_us=200000,avail=99.0;"
+            "*:p99_us=100000")
+    registry = Server()
+    registry.enable_naming_registry()
+    registry.start(0)
+    reg_addr = f"127.0.0.1:{registry.port}"
+    srvs = []
+    for _ in range(3):
+        s = Server()
+        s.register_native_echo("Echo.Echo")
+        s.set_slo(spec)
+        s.start(0)
+        srvs.append(s)
+    addrs = [f"127.0.0.1:{s.port}" for s in srvs]
+    chans = {}
+
+    def chan(node: int, tenant: str) -> Channel:
+        key = (node, tenant)
+        if key not in chans:
+            chans[key] = Channel(addrs[node], timeout_ms=10000,
+                                 qos_tenant=tenant)
+        return chans[key]
+
+    def qps_1kb(seconds: float = 1.2) -> float:
+        # Untagged (scored under '*'): the probe volume must not drown
+        # tenant fg's burn windows before the breach-detection leg.
+        ch = chan(0, "")
+        body = b"q" * 1024
+        for _ in range(30):
+            ch.call("Echo.Echo", body)
+        n = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            ch.call("Echo.Echo", body)
+            n += 1
+        return n / (time.perf_counter() - t0)
+
+    # Publisher OFF vs ON, interleaved best-of-2 each: publication rides
+    # the Announcer's renew thread, so the request path must not notice.
+    qps_off = qps_1kb()
+    observe.enable_fleet_publish(True)
+    for i, s in enumerate(srvs):
+        s.announce(reg_addr, "fleet", zone=f"z{i}")
+    time.sleep(0.6)  # a few renew rounds so publication is in flight
+    qps_on = qps_1kb()
+    observe.enable_fleet_publish(False)
+    qps_off = max(qps_off, qps_1kb())
+    observe.enable_fleet_publish(True)
+    qps_on = max(qps_on, qps_1kb())
+
+    # The golden-capture tenant mix, striped across the 3 nodes.
+    _, records = load_capture(
+        os.path.join(repo, "tests", "data", "golden_mixed.cap"))
+    driven = {}
+    for i, r in enumerate(records[:600]):
+        tenant = r.tenant or "fg"
+        size = min(max(int(r.request_bytes), 1), 64 << 10)
+        chan(i % 3, tenant).call("Echo.Echo", b"m" * size)
+        driven[tenant] = driven.get(tenant, 0) + 1
+
+    # Wait until every node's published blob covers the traffic, then
+    # build the pooled oracle FROM those blobs (the single-recorder
+    # ground truth the octave bound is stated against).
+    nc = NamingClient(reg_addr)
+    deadline = time.time() + 30
+    decoded = []
+    while time.time() < deadline:
+        _, recs = nc.stats("fleet")
+        blobs = [r.payload for r in recs if r.payload]
+        if len(blobs) == 3:
+            decoded = [observe.fleet_blob_decode(b) for b in blobs]
+            fg = [t for d in decoded for t in d["tenants"]
+                  if t["tenant"] == "fg"]
+            if sum(t["slow_total"] for t in fg) >= driven.get("fg", 0):
+                break
+        time.sleep(0.2)
+    if len(decoded) != 3:
+        raise RuntimeError("fleet blobs never covered the driven traffic")
+    pooled = {}
+    for d in decoded:
+        for t in d["tenants"]:
+            dg = t["digest"]
+            if t["tenant"] in pooled:
+                observe.digest_merge(pooled[t["tenant"]], dg)
+            else:
+                pooled[t["tenant"]] = dg
+
+    view = observe.fleet_dump("fleet")
+    tenants = []
+    worst_ratio = 0.0
+    for row in view["tenants"]:
+        oracle = pooled.get(row["tenant"])
+        if oracle is None or oracle.count == 0:
+            continue
+        oracle_p99 = observe.digest_percentile_us(oracle, 0.99)
+        ratio = (max(row["p99_us"], oracle_p99)
+                 / max(min(row["p99_us"], oracle_p99), 1))
+        worst_ratio = max(worst_ratio, ratio)
+        tenants.append({
+            "tenant": row["tenant"], "nodes": row["nodes"],
+            "rate": row["rate"], "p50_us": row["p50_us"],
+            "p99_us": row["p99_us"], "oracle_p99_us": oracle_p99,
+            "p99_oracle_ratio": round(ratio, 3),
+            "error_rate": row["error_rate"],
+            "budget_remaining": row["budget_remaining"],
+            "burn_fast": row["burn_fast"], "burn_slow": row["burn_slow"],
+        })
+
+    # Induced regression on ONE node: time-to-alert for tenant fg.
+    srvs[0].set_faults("svr_delay=1:25")
+    ch = chan(0, "fg")
+    t0 = time.perf_counter()
+    breach_detect_ms = None
+    while time.perf_counter() - t0 < fast_ms / 1000 * 4:
+        ch.call("Echo.Echo", b"d" * 1024)
+        fg_row = [t for t in srvs[0].slo_dump()["tenants"]
+                  if t["tenant"] == "fg"]
+        if fg_row and fg_row[0]["breached"]:
+            breach_detect_ms = round((time.perf_counter() - t0) * 1e3, 1)
+            break
+    srvs[0].set_faults("")
+
+    row = {
+        "workload": "slo_fleet",
+        "nodes": 3,
+        "capture": "tests/data/golden_mixed.cap",
+        "calls_driven": sum(driven.values()),
+        "tenant_mix": driven,
+        "tenants": tenants,
+        "p99_oracle_ratio_worst": round(worst_ratio, 3),
+        "qps_1kb_publish_off": round(qps_off, 1),
+        "qps_1kb_publish_on": round(qps_on, 1),
+        "publish_qps_ratio": round(qps_on / max(qps_off, 1e-9), 3),
+        "breach_detect_ms": breach_detect_ms,
+        "fast_window_ms": fast_ms,
+    }
+    for c in chans.values():
+        c.close()
+    for s in srvs:
+        s.stop()
+    registry.stop()
+    for f, v in saved.items():
+        set_flag(f, v)
+    print(json.dumps(row), flush=True)
+
+
 def _child_self_tune() -> None:
     """Self-tuning row (ISSUE 14 / ROADMAP item 4): each leg measures a
     workload hand-tuned (compiled defaults, tuner off), then re-runs it
@@ -1463,6 +1636,9 @@ def main() -> None:
     if os.environ.get("BENCH_OVERLAP"):
         _child_pipeline_overlap()
         return
+    if os.environ.get("BENCH_SLO_FLEET"):
+        _child_slo_fleet()
+        return
     if os.environ.get("BENCH_SELF_TUNE"):
         _child_self_tune()
         return
@@ -1537,6 +1713,7 @@ def main() -> None:
     replay = _run_json_child({"BENCH_REPLAY": "1"}, 300)
     coll = _run_json_child({"BENCH_COLL": "1"}, 240)
     pipeline_overlap = _run_json_child({"BENCH_OVERLAP": "1"}, 240)
+    slo_fleet = _run_json_child({"BENCH_SLO_FLEET": "1"}, 240)
     self_tune = _run_json_child({"BENCH_SELF_TUNE": "1"}, 240)
 
     # tpu_rpc leg, same retry contract; a CPU-platform run is still a real
@@ -1578,6 +1755,7 @@ def main() -> None:
         "replay": replay,
         "collective": coll,
         "pipeline_overlap": pipeline_overlap,
+        "slo_fleet": slo_fleet,
         "self_tune": self_tune,
     }))
 

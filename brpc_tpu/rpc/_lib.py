@@ -639,6 +639,26 @@ def load_library() -> ctypes.CDLL:
             lib.trpc_timeline_dump.restype = ctypes.c_size_t
             lib.trpc_timeline_enabled.restype = ctypes.c_int
             lib.trpc_timeline_reset.restype = None
+            # SLO engine + fleet observability (capi/slo_capi.cc;
+            # stat/slo.h, net/naming.h fleet publication).
+            lib.trpc_server_set_slo.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+            ]
+            lib.trpc_server_set_slo.restype = ctypes.c_int
+            lib.trpc_slo_dump.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.trpc_slo_dump.restype = ctypes.c_size_t
+            lib.trpc_fleet_blob.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.trpc_fleet_blob.restype = ctypes.c_size_t
+            lib.trpc_fleet_dump.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.trpc_fleet_dump.restype = ctypes.c_size_t
+            lib.trpc_slo_enabled.restype = ctypes.c_int
+            lib.trpc_slo_breach_total.restype = ctypes.c_uint64
             # Self-tuning controller + flag introspection
             # (capi/tuner_capi.cc; stat/tuner.h).
             lib.trpc_flags_dump.argtypes = [

@@ -47,6 +47,8 @@ ANNOUNCE_METHOD = "Naming.Announce"
 WITHDRAW_METHOD = "Naming.Withdraw"
 RESOLVE_METHOD = "Naming.Resolve"
 WATCH_METHOD = "Naming.Watch"
+PUBLISH_METHOD = "Naming.Publish"
+STATS_METHOD = "Naming.Stats"
 
 
 class NamingError(RpcError):
@@ -85,6 +87,19 @@ class Member:
     weight: int = 1
     epoch: int = 0
     lease_left_ms: int = 0
+
+
+@dataclasses.dataclass
+class StatsRecord:
+    """One member's stats row (Naming.Stats): membership identity plus
+    the opaque publication payload it last attached — for the fleet
+    observability plane, a digest-wire 2 blob (observe.fleet_blob_decode
+    reads it).  age_ms is how stale the payload is (-1 = never
+    published)."""
+
+    member: Member
+    age_ms: int = -1
+    payload: bytes = b""
 
 
 def _pack(service: str, addr: str = "", zone: str = "", weight: int = 0,
@@ -166,6 +181,50 @@ class NamingClient:
         except RpcError as e:
             raise _naming_error(e) from None
         return _unpack_view(resp)
+
+    def publish(self, service: str, addr: str, epoch: int,
+                payload: bytes) -> None:
+        """Attaches an opaque stats payload to a LIVE member record —
+        the fleet observability publication path (the native Announcer
+        does this every renew round under trpc_fleet_publish).  Same
+        fencing as announce: the member must exist (lease un-expired,
+        NamingMissError otherwise) and `epoch` must be no older than the
+        recorded one (NamingStaleEpochError — a zombie predecessor can't
+        overwrite its successor's stats).  Payloads die with the member
+        and do NOT bump the membership version (watchers stay parked)."""
+        try:
+            self._ch.call(PUBLISH_METHOD,
+                          _pack(service, addr, epoch=epoch) + payload)
+        except RpcError as e:
+            raise _naming_error(e) from None
+
+    def stats(self, service: str) -> tuple[int, list[StatsRecord]]:
+        """(version, records): every live member with its last published
+        payload, sorted by addr — what /fleet and tools/fleet_top.py
+        merge.  Raises NamingMissError for an unknown service."""
+        try:
+            resp = self._ch.call(STATS_METHOD, _pack(service))
+        except RpcError as e:
+            raise _naming_error(e) from None
+        (_svc, _addr, _zone, count, _res, _epoch, _lease,
+         version) = _WIRE.unpack_from(resp)
+        records = []
+        pos = _WIRE.size
+        for _ in range(max(count, 0)):
+            (_s, addr, zone, weight, _r, epoch, age_ms,
+             _v) = _WIRE.unpack_from(resp, pos)
+            pos += _WIRE.size
+            (plen,) = struct.unpack_from("<Q", resp, pos)
+            pos += 8
+            payload = bytes(resp[pos:pos + plen])
+            pos += plen
+            records.append(StatsRecord(
+                member=Member(
+                    addr.split(b"\0", 1)[0].decode(errors="replace"),
+                    zone.split(b"\0", 1)[0].decode(errors="replace"),
+                    weight, epoch),
+                age_ms=age_ms, payload=payload))
+        return version, records
 
     def close(self) -> None:
         self._ch.close()

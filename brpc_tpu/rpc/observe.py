@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import ctypes
 import json
+import math
 import struct
 from dataclasses import dataclass, field
 
@@ -339,6 +340,7 @@ TIMELINE_EVENTS = {
     25: "deadline",       # timeline-event 25 (deadline)
     26: "capture",        # timeline-event 26 (capture)
     27: "coll_ready",     # timeline-event 27 (coll_ready)
+    28: "slo_breach",     # timeline-event 28 (slo_breach)
 }
 
 # kCapture `b` op tags (cpp/stat/capture.cc: b = op << 56 | request
@@ -358,6 +360,11 @@ TIMELINE_KV_OPS = {1: "publish", 2: "serve", 3: "evict", 4: "stale",
 # schedule step on the member that completed it.
 TIMELINE_COLL_OPS = {1: "all_gather", 2: "reduce_scatter",
                      3: "all_to_all", 4: "reshard"}
+
+# kSloBreach `b` op tags (cpp/stat/slo.cc: b = op << 56 | fast-window
+# burn rate in milli-units; a = FNV-1a hash of the tenant name) — one
+# event per breach-state EDGE, never per evaluation.
+TIMELINE_SLO_OPS = {1: "breach", 2: "clear"}
 
 # kStripeSend rail index meaning "the call's primary socket" (head
 # frame / dead-rail fallback) — cpp/stat/timeline.h kStripePrimaryRail.
@@ -475,6 +482,198 @@ def timeline(limit: int = 4096) -> list[TimelineEvent]:
                 fid=e["fid"], tid=int(t["tid"]), thread=t["name"]))
     out.sort(key=lambda e: e.ts_us)
     return out
+
+
+# ------------------------------------------------- digests + SLO fleet ----
+
+
+# Decoder side of the mergeable latency digest and the fleet publication
+# blob (cpp/stat/digest.h documents both layouts; tools/lint_trpc.py's
+# digest-wire rule keeps encoder and decoder in lockstep via these
+# markers).  Digests pool the recorder's octave-bucketed SAMPLES, so
+# fleet percentiles come from a rank walk over merged data — never from
+# averaging per-node p99s — with the recorder's own one-octave (2x)
+# error bound.
+_DG_MAGIC = b"TRPCDG01"  # digest-wire 1 (TRPCDG01)
+_DG_OCTAVES = 32
+# count, sum_us, max_us, total_count, window_secs, noct
+_DG_HEAD = struct.Struct("<qqqqdI")
+_DG_OCT = struct.Struct("<IqI")          # octave index, added, nsamples
+
+_FL_MAGIC = b"TRPCFL01"  # digest-wire 2 (TRPCFL01)
+_FL_HEAD = struct.Struct("<qI")          # wall_us, nentries
+# p99_target_us, avail_target, fast_window_ms, slow_window_ms,
+# fast_total, fast_bad, fast_err, slow_total, slow_bad, slow_err,
+# burn_fast, burn_slow, breached
+_FL_TENANT = struct.Struct("<qd" + "q" * 8 + "ddB")
+
+# INT64_MAX in the p99_target_us slot means "latency-unbounded" (the
+# tenant only declared an availability target).
+SLO_NO_P99_TARGET = (1 << 63) - 1
+
+
+@dataclass
+class Digest:
+    """One decoded latency digest: pooled octave counts + reservoir
+    samples.  `oct` maps octave index -> (added, [samples_us...])."""
+
+    count: int = 0
+    sum_us: int = 0
+    max_us: int = 0
+    total_count: int = 0
+    window_secs: float = 0.0
+    oct: dict = field(default_factory=dict)
+
+    @property
+    def qps(self) -> float:
+        w = self.window_secs if self.window_secs > 0 else 1.0
+        return self.count / w
+
+    @property
+    def avg_us(self) -> float:
+        return self.sum_us / self.count if self.count else 0.0
+
+
+def digest_decode(raw: bytes, off: int = 0) -> tuple[Digest, int]:
+    """Decodes one digest-wire 1 block starting at `off`; returns
+    (digest, bytes_consumed).  Mirrors cpp/stat/digest.cc digest_decode
+    byte for byte; raises ValueError on a malformed block."""
+    if raw[off:off + 8] != _DG_MAGIC:
+        raise ValueError(f"bad digest magic: {raw[off:off + 8]!r}")
+    start = off
+    off += 8
+    count, sum_us, max_us, total_count, window_secs, noct = \
+        _DG_HEAD.unpack_from(raw, off)
+    off += _DG_HEAD.size
+    if noct > _DG_OCTAVES:
+        raise ValueError(f"digest noct {noct} > {_DG_OCTAVES}")
+    d = Digest(count=count, sum_us=sum_us, max_us=max_us,
+               total_count=total_count, window_secs=window_secs)
+    for _ in range(noct):
+        idx, added, nsamp = _DG_OCT.unpack_from(raw, off)
+        off += _DG_OCT.size
+        if idx >= _DG_OCTAVES or off + 4 * nsamp > len(raw):
+            raise ValueError("malformed digest octave")
+        samples = list(struct.unpack_from(f"<{nsamp}I", raw, off))
+        off += 4 * nsamp
+        d.oct[idx] = (added, samples)
+    return d, off - start
+
+
+def digest_merge(into: Digest, other: Digest) -> Digest:
+    """Octave-wise pooling — counts sum, reservoirs concatenate (the
+    merge digest_percentile_us rank-walks over)."""
+    into.count += other.count
+    into.sum_us += other.sum_us
+    into.total_count += other.total_count
+    into.max_us = max(into.max_us, other.max_us)
+    into.window_secs = max(into.window_secs, other.window_secs)
+    for idx, (added, samples) in other.oct.items():
+        a, s = into.oct.get(idx, (0, []))
+        into.oct[idx] = (a + added, s + samples)
+    return into
+
+
+def digest_percentile_us(d: Digest, p: float) -> int:
+    """Rank walk over the pooled octaves — the same arithmetic as
+    cpp/stat/digest.cc digest_percentile_us (and the recorder's own
+    window percentiles), so a merged fleet digest and a pooled
+    single-recorder oracle agree within one octave (2x)."""
+    total = sum(added for added, _ in d.oct.values())
+    if total == 0:
+        return 0
+    n = min(max(math.ceil(p * total), 1), total)
+    for i in range(_DG_OCTAVES):
+        added, samples = d.oct.get(i, (0, []))
+        if added == 0:
+            continue
+        if n <= added:
+            if not samples:
+                return 1 << i  # count but no samples: octave floor
+            merged = sorted(samples)
+            sample_n = int(n * len(merged) / added)
+            if sample_n >= len(merged):
+                sample_n = len(merged) - 1
+            elif sample_n > 0:
+                sample_n -= 1
+            return merged[sample_n]
+        n -= added
+    return d.max_us
+
+
+def fleet_blob_decode(raw: bytes) -> dict:
+    """Decodes one node's digest-wire 2 publication blob: {"wall_us",
+    "tenants": [{tenant, p99_target_us (None when unbounded),
+    avail_target, windows, counters, burns, breached, digest}]}.
+    Mirrors cpp/stat/slo.cc fleet_blob_decode."""
+    if raw[:8] != _FL_MAGIC:
+        raise ValueError(f"bad fleet blob magic: {raw[:8]!r}")
+    off = 8
+    wall_us, nentries = _FL_HEAD.unpack_from(raw, off)
+    off += _FL_HEAD.size
+    if nentries > 4096:
+        raise ValueError(f"fleet blob nentries {nentries} > 4096")
+    tenants = []
+    for _ in range(nentries):
+        (name_len,) = struct.unpack_from("<H", raw, off)
+        off += 2
+        name = raw[off:off + name_len].decode()
+        off += name_len
+        (p99_target_us, avail_target, fast_window_ms, slow_window_ms,
+         fast_total, fast_bad, fast_err, slow_total, slow_bad, slow_err,
+         burn_fast, burn_slow, breached) = _FL_TENANT.unpack_from(raw, off)
+        off += _FL_TENANT.size
+        digest, used = digest_decode(raw, off)
+        off += used
+        tenants.append({
+            "tenant": name,
+            "p99_target_us": (None if p99_target_us == SLO_NO_P99_TARGET
+                              else p99_target_us),
+            "avail_target": avail_target,
+            "fast_window_ms": fast_window_ms,
+            "slow_window_ms": slow_window_ms,
+            "fast_total": fast_total, "fast_bad": fast_bad,
+            "fast_err": fast_err,
+            "slow_total": slow_total, "slow_bad": slow_bad,
+            "slow_err": slow_err,
+            "burn_fast": burn_fast, "burn_slow": burn_slow,
+            "breached": breached != 0,
+            "digest": digest,
+        })
+    return {"wall_us": wall_us, "tenants": tenants}
+
+
+def enable_slo(on: bool = True) -> None:
+    """Flips the SLO engine (the reloadable `trpc_slo` flag; off by
+    default — flag-off, the response path pays one relaxed load and
+    every slo_* var stays frozen)."""
+    set_flag("trpc_slo", "true" if on else "false")
+
+
+def slo_enabled() -> bool:
+    return load_library().trpc_slo_enabled() == 1
+
+
+def enable_fleet_publish(on: bool = True) -> None:
+    """Flips fleet publication (the reloadable `trpc_fleet_publish`
+    flag): when on, each Announcer renew round piggybacks this node's
+    digest+SLO blob onto its lease/epoch-fenced naming record."""
+    set_flag("trpc_fleet_publish", "true" if on else "false")
+
+
+def slo_breach_total() -> int:
+    """Lifetime breach EDGES across all engines (slo_breach_total)."""
+    return int(load_library().trpc_slo_breach_total())
+
+
+def fleet_dump(service: str = "fleet") -> dict:
+    """The fleet-wide merged per-tenant view over the LOCAL naming
+    registry (the /fleet builtin body): digests merged octave-wise,
+    window counters summed, burn rates recomputed from pooled counters."""
+    lib = load_library()
+    raw = _dump_with_retry(
+        lambda buf, n: lib.trpc_fleet_dump(service.encode(), buf, n))
+    return json.loads(raw.decode())
 
 
 # --------------------------------------------------------------- traces ----

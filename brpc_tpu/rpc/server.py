@@ -208,6 +208,42 @@ class Server:
         if self._lib.trpc_server_set_qos(self._ptr, spec.encode()) != 0:
             raise ValueError(f"bad qos spec (or server running): {spec!r}")
 
+    def set_slo(self, spec: str) -> None:
+        """Per-tenant SLO targets (cpp/stat/slo.h grammar): ';'-separated
+        `tenant:p99_us=N,avail=PCT` clauses, tenant '*' as the default —
+        e.g. "tenantA:p99_us=2000,avail=99.9;*:p99_us=10000".  Needs the
+        reloadable `trpc_slo` flag on (observe.enable_slo) to record;
+        exposes slo_tenant_* vars, the /slo builtin, and — with
+        trpc_fleet_publish — this node's digest blob over naming://.
+        '' removes.  Call before start; raises on a malformed spec."""
+        if self._lib.trpc_server_set_slo(self._ptr, spec.encode()) != 0:
+            raise ValueError(f"bad slo spec (or server running): {spec!r}")
+
+    def slo_dump(self) -> dict:
+        """This server's per-tenant SLO attainment/burn-rate view (the
+        /slo builtin body): {"enabled", "tenants": [{tenant, targets,
+        window counters, burn_fast/burn_slow, attainment, breached}]}."""
+        import json as _json
+        size = 1 << 14
+        while True:
+            out = ctypes.create_string_buffer(size)
+            need = self._lib.trpc_slo_dump(self._ptr, out, size)
+            if need < size:
+                return _json.loads(out.raw[:need].decode())
+            size = need + 1
+
+    def fleet_blob(self) -> bytes:
+        """This node's fleet publication blob (digest-wire 2 — the exact
+        bytes the Announcer publishes; observe.fleet_blob_decode reads
+        it).  b'' without an SLO engine."""
+        size = 1 << 14
+        while True:
+            out = ctypes.create_string_buffer(size)
+            need = self._lib.trpc_fleet_blob(self._ptr, out, size)
+            if need < size:
+                return out.raw[:need]
+            size = need + 1
+
     def set_reuseport_shards(self, shards: int) -> None:
         """Shards the TCP acceptor across `shards` SO_REUSEPORT listeners
         (each on its own event-dispatcher slot — see the

@@ -25,6 +25,7 @@
 #include "base/proc.h"
 #include "net/ici_transport.h"
 #include "net/rma.h"
+#include "stat/slo.h"
 #include "net/server.h"
 
 using namespace trpc;
@@ -152,8 +153,9 @@ void ensure_runtime_flags() {
   fault_register_flag();
   cluster_ensure_registered();     // trpc_cluster_* knobs
   Server::drain_ensure_registered();  // trpc_drain_deadline_ms
-  naming_ensure_registered();      // trpc_naming_* knobs
+  naming_ensure_registered();      // trpc_naming_* + trpc_fleet_publish
   deadline_ensure_registered();    // trpc_deadline_wire + retry budget
+  slo::ensure_registered();        // trpc_slo + burn windows/alert
 }
 }  // namespace
 

@@ -1,0 +1,63 @@
+// C ABI for the SLO / fleet observability plane (stat/slo.h,
+// stat/digest.h, net/naming.h fleet publication) — the Python surface of
+// /slo, /fleet and the digest-wire blobs fleet_top.py merges.
+#include <cstring>
+#include <string>
+
+#include "base/time.h"
+#include "capi/capi_util.h"
+#include "net/naming.h"
+#include "net/server.h"
+#include "stat/digest.h"
+#include "stat/slo.h"
+
+using namespace trpc;
+
+extern "C" {
+
+// Per-tenant SLO spec (Server::SetSlo; stat/slo.h grammar, e.g.
+// "tenantA:p99_us=2000,avail=99.9;*:p99_us=10000").  "" removes.
+// Returns 0, -1 on a malformed spec or a running server.
+int trpc_server_set_slo(void* srv, const char* spec) {
+  return static_cast<Server*>(srv)->SetSlo(spec != nullptr ? spec : "");
+}
+
+// /slo JSON for this server's engine (copy_out contract: returns the
+// full length; re-call with a bigger buffer when ret >= out_len).
+size_t trpc_slo_dump(void* srv, char* out, size_t out_len) {
+  auto slo = static_cast<Server*>(srv)->slo_engine();
+  const std::string body =
+      slo != nullptr ? slo->dump_json()
+                     : std::string("{\"enabled\":") +
+                           (slo::enabled() ? "true" : "false") +
+                           ",\"tenants\":[]}";
+  return capi::copy_out(body, out, out_len);
+}
+
+// This node's fleet publication blob (digest-wire 2, binary — the exact
+// bytes the Announcer publishes).  Empty ("" → returns 0) without an
+// engine.  copy_out contract; the blob is binary, so callers slice
+// out[:ret] instead of reading to the NUL.
+size_t trpc_fleet_blob(void* srv, char* out, size_t out_len) {
+  auto slo = static_cast<Server*>(srv)->slo_engine();
+  if (slo == nullptr) {
+    return capi::copy_out(std::string(), out, out_len);
+  }
+  return capi::copy_out(slo->encode_blob(realtime_us()), out, out_len);
+}
+
+// Fleet-wide merged JSON over the LOCAL naming registry (the /fleet
+// builtin's body; copy_out contract).
+size_t trpc_fleet_dump(const char* service, char* out, size_t out_len) {
+  return capi::copy_out(
+      fleet_dump_json(service != nullptr ? service : "fleet"), out,
+      out_len);
+}
+
+// One relaxed load of the trpc_slo switch (flag-off invisibility tests).
+int trpc_slo_enabled() { return slo::enabled() ? 1 : 0; }
+
+// Lifetime breach edges across all engines (slo_breach_total).
+uint64_t trpc_slo_breach_total() { return slo::breach_total(); }
+
+}  // extern "C"

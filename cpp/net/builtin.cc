@@ -23,11 +23,13 @@
 #include "fiber/fid.h"
 #include "net/fault.h"
 #include "net/http_protocol.h"
+#include "net/naming.h"
 #include "net/server.h"
 #include "net/socket.h"
 #include "net/span.h"
 #include "stat/capture.h"
 #include "stat/heap_profiler.h"
+#include "stat/slo.h"
 #include "stat/profiler.h"
 #include "stat/timeline.h"
 #include "stat/tuner.h"
@@ -415,6 +417,31 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
     *content_type = "application/json";
     return true;
   }
+  if (path == "/slo") {
+    // Per-tenant SLO attainment + burn rates (stat/slo.h), recorded
+    // while the reloadable trpc_slo flag is on.  Served even with no
+    // engine installed — the shape stays machine-readable either way.
+    auto slo = srv != nullptr ? srv->slo_engine() : nullptr;
+    if (slo != nullptr) {
+      *body = slo->dump_json();
+    } else {
+      *body = std::string("{\"enabled\":") +
+              (slo::enabled() ? "true" : "false") +
+              ",\"tenants\":[]}";
+    }
+    *content_type = "application/json";
+    return true;
+  }
+  if (path == "/fleet") {
+    // Fleet-wide merged view over the LOCAL naming registry: per-tenant
+    // rate/p50/p99/error-rate/budget-remaining/burn-rate from merged
+    // digests (octave-wise sample pooling — never averaged node p99s).
+    // ?service=<name> selects the service (default "fleet").
+    const std::string* sq = req.query("service");
+    *body = fleet_dump_json(sq != nullptr ? *sq : "fleet");
+    *content_type = "application/json";
+    return true;
+  }
   if (path == "/analysis") {
     // Runtime invariant checkers (fiber/analysis.h): lock-order
     // inversions + blocking-in-dispatch violations recorded while the
@@ -620,6 +647,7 @@ bool builtin_http_dispatch(Server* srv, const HttpRequest& req,
         "/timeline[?format=binary&limit=N]\n"
         "/capture[?records=N&dump=path&reset=1]\n"
         "/tuner[?limit=N]\n"
+        "/slo\n/fleet[?service=name]\n"
         "/faults[?set=spec&server=spec&reset=1]\n"
         "/hotspots[?seconds=N]\n/contention\n/analysis\n/fibers\n"
         "/sockets\n/ids\n"

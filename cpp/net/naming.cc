@@ -5,13 +5,16 @@
 #include <algorithm>
 
 #include "base/flags.h"
+#include "base/json.h"
 #include "base/logging.h"
 #include "base/time.h"
 #include "fiber/fiber.h"
 #include "net/channel.h"
 #include "net/controller.h"
 #include "net/server.h"
+#include "stat/digest.h"
 #include "stat/reducer.h"
+#include "stat/slo.h"
 
 namespace trpc {
 
@@ -57,11 +60,39 @@ Flag* watch_flag() {
   return f;
 }
 
+std::atomic<bool> g_fleet_publish{false};
+
+Flag* fleet_publish_flag() {
+  static Flag* f = [] {
+    Flag* flag = Flag::define_bool(
+        "trpc_fleet_publish", false,
+        "fleet observability publication: each Announcer renew round "
+        "also publishes the node's latency digest + SLO attainment blob "
+        "(stat/digest.h digest-wire 2) onto its own naming:// membership "
+        "record, feeding /fleet and tools/fleet_top.py (default off; "
+        "payloads are lease/epoch-fenced and die with the member)");
+    if (flag != nullptr) {
+      flag->set_validator([](const std::string& v) {
+        return v == "true" || v == "false" || v == "1" || v == "0" ||
+               v == "on" || v == "off";
+      });
+      flag->on_update([](Flag* self) {
+        g_fleet_publish.store(self->bool_value(),
+                              std::memory_order_release);
+      });
+    }
+    return flag;
+  }();
+  return f;
+}
+
 struct NamingVars {
   Adder announce_total;
   Adder withdraw_total;
   Adder expire_total;
   Adder watch_wake_total;
+  Adder publish_total;
+  Adder stats_pull_total;
   NamingVars() {
     announce_total.expose(
         "naming_announce_total",
@@ -79,6 +110,15 @@ struct NamingVars {
         "naming_watch_wake_total",
         "Naming.Watch long-polls answered because the membership "
         "version moved (push deliveries, as opposed to idle timeouts)");
+    publish_total.expose(
+        "fleet_publish_total",
+        "stats payloads accepted onto membership records by the "
+        "registry on this node (frozen at 0 while trpc_fleet_publish "
+        "has never been on anywhere in the fleet)");
+    stats_pull_total.expose(
+        "fleet_stats_pull_total",
+        "Naming.Stats pulls served by the registry on this node "
+        "(/fleet renders and fleet_top.py refreshes)");
   }
 };
 
@@ -118,7 +158,12 @@ std::string wire_str(const char* src, size_t cap) {
 void naming_ensure_registered() {
   lease_flag();
   watch_flag();
+  fleet_publish_flag();
   naming_vars();
+}
+
+bool fleet_publish_enabled() {
+  return g_fleet_publish.load(std::memory_order_relaxed);
 }
 
 // ---- NamingRegistry -------------------------------------------------------
@@ -311,6 +356,63 @@ int NamingRegistry::watch(const std::string& service, uint64_t known_version,
     }
   }
   return resolve(service, out, version);
+}
+
+int NamingRegistry::publish(const std::string& service,
+                            const std::string& addr, uint64_t epoch,
+                            std::string payload) {
+  std::lock_guard<std::mutex> g(mu_);
+  auto sit = services_.find(service);
+  if (sit == services_.end()) {
+    return kENamingMiss;
+  }
+  Service* s = &sit->second;
+  prune_locked(s);
+  auto it = s->members.find(addr);
+  if (it == s->members.end()) {
+    return kENamingMiss;  // expired/unknown member: a dead node can't publish
+  }
+  if (epoch < it->second.m.epoch) {
+    return kENamingStaleEpoch;  // zombie can't overwrite the successor's stats
+  }
+  it->second.payload = std::move(payload);
+  it->second.payload_us = monotonic_time_us();
+  naming_vars().publish_total << 1;
+  // Deliberately NO version bump: stats churn every renew round and must
+  // not wake membership watchers (same reason lease renewals don't).
+  return 0;
+}
+
+int NamingRegistry::stats(const std::string& service,
+                          std::vector<NamingStatsRecord>* out,
+                          uint64_t* version) {
+  std::lock_guard<std::mutex> g(mu_);
+  auto sit = services_.find(service);
+  if (sit == services_.end()) {
+    return kENamingMiss;
+  }
+  Service* s = &sit->second;
+  prune_locked(s);
+  const int64_t now = monotonic_time_us();
+  out->clear();
+  out->reserve(s->members.size());
+  for (const auto& [addr, rec] : s->members) {
+    NamingStatsRecord r;
+    r.member = rec.m;
+    r.member.lease_left_ms = (rec.deadline_us - now) / 1000;
+    r.age_ms = rec.payload_us > 0 ? (now - rec.payload_us) / 1000 : -1;
+    r.payload = rec.payload;
+    out->push_back(std::move(r));
+  }
+  std::sort(out->begin(), out->end(),
+            [](const NamingStatsRecord& a, const NamingStatsRecord& b) {
+              return a.member.addr < b.member.addr;
+            });
+  naming_vars().stats_pull_total << 1;
+  if (version != nullptr) {
+    *version = s->version;
+  }
+  return 0;
 }
 
 size_t NamingRegistry::member_count(const std::string& service) {
@@ -530,8 +632,73 @@ int naming_attach(Server* s) {
         }
         done();
       });
+  int rc_pub = s->RegisterMethod(
+      kNamingPublishMethod, [](Controller* cntl, const IOBuf& req,
+                               IOBuf* resp, Closure done) {
+        NamingWire w;
+        if (!parse_wire(req, &w)) {
+          cntl->SetFailed(EINVAL, "bad Naming.Publish request");
+          done();
+          return;
+        }
+        // Payload rides after the fixed header.
+        const std::string flat = req.to_string();
+        std::string payload = flat.substr(sizeof(NamingWire));
+        const int rc = naming_registry().publish(
+            wire_str(w.service, sizeof(w.service)),
+            wire_str(w.addr, sizeof(w.addr)), w.epoch, std::move(payload));
+        if (rc != 0) {
+          fail_naming(cntl, rc, "publish");
+        } else {
+          uint64_t ok = 1;
+          resp->append(&ok, sizeof(ok));
+        }
+        done();
+      });
+  int rc_stats = s->RegisterMethod(
+      kNamingStatsMethod, [](Controller* cntl, const IOBuf& req,
+                             IOBuf* resp, Closure done) {
+        NamingWire w;
+        if (!parse_wire(req, &w)) {
+          cntl->SetFailed(EINVAL, "bad Naming.Stats request");
+          done();
+          return;
+        }
+        std::vector<NamingStatsRecord> records;
+        uint64_t version = 0;
+        const int rc = naming_registry().stats(
+            wire_str(w.service, sizeof(w.service)), &records, &version);
+        if (rc != 0) {
+          fail_naming(cntl, rc, "stats");
+        } else {
+          // Head row (version, weight=count), then per member one
+          // NamingWire row + u64 payload_len + payload bytes.
+          NamingWire head;
+          memset(&head, 0, sizeof(head));
+          head.version = version;
+          head.weight = static_cast<int32_t>(records.size());
+          resp->append(&head, sizeof(head));
+          for (const NamingStatsRecord& r : records) {
+            NamingWire row;
+            memset(&row, 0, sizeof(row));
+            copy_str(row.addr, sizeof(row.addr), r.member.addr);
+            copy_str(row.zone, sizeof(row.zone), r.member.zone);
+            row.weight = r.member.weight;
+            row.epoch = r.member.epoch;
+            row.lease_ms = r.age_ms;  // publish age rides the lease slot
+            resp->append(&row, sizeof(row));
+            const uint64_t plen = r.payload.size();
+            resp->append(&plen, sizeof(plen));
+            resp->append(r.payload.data(), r.payload.size());
+          }
+        }
+        done();
+      });
   s->add_drain_hook([] { naming_registry().wake_all(); });
-  return rcs[0] == 0 && rcs[1] == 0 && rcs[2] == 0 && rcs[3] == 0 ? 0 : -1;
+  return rcs[0] == 0 && rcs[1] == 0 && rcs[2] == 0 && rcs[3] == 0 &&
+                 rc_pub == 0 && rc_stats == 0
+             ? 0
+             : -1;
 }
 
 // ---- client helpers -------------------------------------------------------
@@ -610,6 +777,74 @@ int naming_watch(Channel* ch, const std::string& service,
   return unpack_view(resp, out, version);
 }
 
+int naming_publish(Channel* ch, const std::string& service,
+                   const std::string& addr, uint64_t epoch,
+                   const std::string& payload) {
+  NamingWire w;
+  memset(&w, 0, sizeof(w));
+  copy_str(w.service, sizeof(w.service), service);
+  copy_str(w.addr, sizeof(w.addr), addr);
+  w.epoch = epoch;
+  IOBuf req;
+  req.append(&w, sizeof(w));
+  req.append(payload.data(), payload.size());
+  IOBuf resp;
+  Controller cntl;
+  ch->CallMethod(kNamingPublishMethod, req, &resp, &cntl);
+  if (cntl.Failed()) {
+    return cntl.error_code() != 0 ? cntl.error_code() : -1;
+  }
+  return 0;
+}
+
+int naming_stats(Channel* ch, const std::string& service,
+                 std::vector<NamingStatsRecord>* out, uint64_t* version) {
+  NamingWire w;
+  memset(&w, 0, sizeof(w));
+  copy_str(w.service, sizeof(w.service), service);
+  IOBuf resp;
+  const int rc = naming_call(ch, kNamingStatsMethod, w, &resp);
+  if (rc != 0) {
+    return rc;
+  }
+  const std::string flat = resp.to_string();
+  if (flat.size() < sizeof(NamingWire)) {
+    return -1;
+  }
+  const auto* head = reinterpret_cast<const NamingWire*>(flat.data());
+  const size_t count = static_cast<size_t>(std::max(head->weight, 0));
+  if (version != nullptr) {
+    *version = head->version;
+  }
+  out->clear();
+  out->reserve(count);
+  size_t pos = sizeof(NamingWire);
+  for (size_t i = 0; i < count; ++i) {
+    if (flat.size() < pos + sizeof(NamingWire) + sizeof(uint64_t)) {
+      return -1;
+    }
+    const auto* row =
+        reinterpret_cast<const NamingWire*>(flat.data() + pos);
+    pos += sizeof(NamingWire);
+    uint64_t plen = 0;
+    memcpy(&plen, flat.data() + pos, sizeof(plen));
+    pos += sizeof(plen);
+    if (flat.size() < pos + plen) {
+      return -1;
+    }
+    NamingStatsRecord r;
+    r.member.addr = wire_str(row->addr, sizeof(row->addr));
+    r.member.zone = wire_str(row->zone, sizeof(row->zone));
+    r.member.weight = row->weight;
+    r.member.epoch = row->epoch;
+    r.age_ms = row->lease_ms;
+    r.payload.assign(flat.data() + pos, plen);
+    pos += plen;
+    out->push_back(std::move(r));
+  }
+  return 0;
+}
+
 // ---- Announcer ------------------------------------------------------------
 
 Announcer::~Announcer() {
@@ -657,6 +892,7 @@ int Announcer::Start(const std::string& registry_addr,
     ch_.reset();
     return -1;
   }
+  publish_stats();  // fresh node visible in /fleet before a renew round
   bool expect = false;
   if (renewer_started_.compare_exchange_strong(expect, true)) {
     fiber_init(0);
@@ -674,6 +910,20 @@ void Announcer::Withdraw() {
   if (ch_ != nullptr) {
     naming_withdraw(ch_.get(), service_, self_addr_, epoch_);
   }
+}
+
+void Announcer::publish_stats() {
+  // Fleet publication rides the renew cadence (lease/3): one relaxed
+  // flag load when off, one digest snapshot + Publish RPC when on.
+  if (!fleet_publish_enabled() || stats_provider_ == nullptr ||
+      ch_ == nullptr) {
+    return;
+  }
+  const std::string payload = stats_provider_();
+  if (payload.empty()) {
+    return;
+  }
+  naming_publish(ch_.get(), service_, self_addr_, epoch_, payload);
 }
 
 void Announcer::renew_fiber(void* arg) {
@@ -702,6 +952,7 @@ void Announcer::renew_fiber(void* arg) {
       // zombie — stop renewing instead of fighting the takeover.
       break;
     }
+    self->publish_stats();
   }
   self->renew_done_.value.store(1, std::memory_order_release);
   self->renew_done_.wake_all();
@@ -718,6 +969,18 @@ int server_announce(Server* srv, const std::string& registry_addr,
   auto a = std::make_shared<Announcer>();
   const std::string self_addr =
       "127.0.0.1:" + std::to_string(srv->port());
+  // Fleet observability provider: with trpc_fleet_publish on, each renew
+  // round snapshots the server's SLO engine (digests + attainment) into a
+  // digest-wire 2 blob on this node's membership record.  The server
+  // outlives the announcer (own_component below), so the raw pointer is
+  // safe for the announcer's lifetime.
+  a->set_stats_provider([srv]() -> std::string {
+    auto slo = srv->slo_engine();
+    if (slo == nullptr || !slo::enabled()) {
+      return std::string();
+    }
+    return slo->encode_blob(realtime_us());
+  });
   if (a->Start(registry_addr, service, self_addr, zone, weight) != 0) {
     return -1;
   }
@@ -726,6 +989,116 @@ int server_announce(Server* srv, const std::string& registry_addr,
   srv->add_drain_hook([a] { a->Withdraw(); });
   srv->own_component(a);
   return 0;
+}
+
+// ---- fleet aggregation ----------------------------------------------------
+
+std::string fleet_dump_json(const std::string& service) {
+  std::vector<NamingStatsRecord> records;
+  uint64_t version = 0;
+  const int rc = naming_registry().stats(service, &records, &version);
+  Json root = Json::object();
+  root.set("service", Json::str(service));
+  root.set("publish_enabled", Json::boolean(fleet_publish_enabled()));
+  if (rc != 0) {
+    root.set("error", Json::str(rc == kENamingMiss ? "naming-miss"
+                                                   : "naming-error"));
+    root.set("nodes", Json::array());
+    root.set("tenants", Json::array());
+    return root.dump();
+  }
+  root.set("version", Json::number(static_cast<double>(version)));
+
+  // Per-tenant fleet aggregate: digests MERGE (octave-wise pooling) and
+  // window counters SUM; burn rates are recomputed from the pooled
+  // counters — the fleet burns budget as one pool, it does not average
+  // per-node burn rates (nor p99s).
+  struct Agg {
+    LatencyDigest digest;
+    int64_t p99_target_us = INT64_MAX;
+    double avail_target = 0;
+    int64_t fast_total = 0, fast_bad = 0, fast_err = 0;
+    int64_t slow_total = 0, slow_bad = 0, slow_err = 0;
+    int nodes = 0;
+    int breached_nodes = 0;
+  };
+  std::map<std::string, Agg> tenants;
+
+  Json nodes = Json::array();
+  for (const NamingStatsRecord& r : records) {
+    Json node = Json::object();
+    node.set("addr", Json::str(r.member.addr));
+    node.set("zone", Json::str(r.member.zone));
+    node.set("epoch", Json::number(static_cast<double>(r.member.epoch)));
+    node.set("age_ms", Json::number(static_cast<double>(r.age_ms)));
+    FleetNodeBlob blob;
+    const bool ok = !r.payload.empty() &&
+                    fleet_blob_decode(r.payload.data(), r.payload.size(),
+                                      &blob);
+    node.set("published", Json::boolean(ok));
+    nodes.push_back(std::move(node));
+    if (!ok) {
+      continue;
+    }
+    for (FleetTenantRecord& t : blob.tenants) {
+      Agg& a = tenants[t.tenant];
+      digest_merge(&a.digest, t.digest);
+      a.p99_target_us = std::min(a.p99_target_us, t.p99_target_us);
+      a.avail_target = std::max(a.avail_target, t.avail_target);
+      a.fast_total += t.fast_total;
+      a.fast_bad += t.fast_bad;
+      a.fast_err += t.fast_err;
+      a.slow_total += t.slow_total;
+      a.slow_bad += t.slow_bad;
+      a.slow_err += t.slow_err;
+      ++a.nodes;
+      if (t.breached) {
+        ++a.breached_nodes;
+      }
+    }
+  }
+  root.set("nodes", std::move(nodes));
+
+  Json tarr = Json::array();
+  for (auto& [name, a] : tenants) {
+    Json t = Json::object();
+    t.set("tenant", Json::str(name));
+    t.set("nodes", Json::number(a.nodes));
+    t.set("breached_nodes", Json::number(a.breached_nodes));
+    t.set("p99_target_us",
+          Json::number(a.p99_target_us == INT64_MAX
+                           ? -1.0
+                           : static_cast<double>(a.p99_target_us)));
+    t.set("avail_target", Json::number(a.avail_target));
+    t.set("rate", Json::number(a.digest.qps()));
+    t.set("p50_us", Json::number(static_cast<double>(
+                        digest_percentile_us(a.digest, 0.5))));
+    t.set("p99_us", Json::number(static_cast<double>(
+                        digest_percentile_us(a.digest, 0.99))));
+    t.set("avg_us", Json::number(a.digest.avg_us()));
+    t.set("count", Json::number(static_cast<double>(a.digest.count)));
+    const double err_rate =
+        a.slow_total > 0
+            ? static_cast<double>(a.slow_err) / a.slow_total
+            : 0.0;
+    t.set("error_rate", Json::number(err_rate));
+    const double allowed = std::max(1.0 - a.avail_target, 1e-6);
+    const double burn_fast =
+        a.fast_total > 0
+            ? (static_cast<double>(a.fast_bad) / a.fast_total) / allowed
+            : 0.0;
+    const double burn_slow =
+        a.slow_total > 0
+            ? (static_cast<double>(a.slow_bad) / a.slow_total) / allowed
+            : 0.0;
+    t.set("burn_fast", Json::number(burn_fast));
+    t.set("burn_slow", Json::number(burn_slow));
+    t.set("budget_remaining",
+          Json::number(std::max(0.0, std::min(1.0, 1.0 - burn_slow))));
+    tarr.push_back(std::move(t));
+  }
+  root.set("tenants", std::move(tarr));
+  return root.dump();
 }
 
 }  // namespace trpc

@@ -71,6 +71,13 @@ inline constexpr const char* kNamingAnnounceMethod = "Naming.Announce";
 inline constexpr const char* kNamingWithdrawMethod = "Naming.Withdraw";
 inline constexpr const char* kNamingResolveMethod = "Naming.Resolve";
 inline constexpr const char* kNamingWatchMethod = "Naming.Watch";
+// Fleet observability publication (ISSUE 19): a member attaches an opaque
+// stats payload (digest + SLO attainment blob, stat/digest.h digest-wire
+// 2) to its own membership record; Stats returns every live member's
+// latest payload.  Payloads ride the member's lease — a dead node's stats
+// vanish with its membership — and are epoch-fenced like announces.
+inline constexpr const char* kNamingPublishMethod = "Naming.Publish";
+inline constexpr const char* kNamingStatsMethod = "Naming.Stats";
 
 // One member of a named service (also the resolve/watch response row).
 struct NamingMember {
@@ -102,6 +109,13 @@ struct NamingWire {
   uint64_t version;
 };
 static_assert(sizeof(NamingWire) == 176, "NamingWire is wire format");
+
+// One member's published stats (Naming.Stats response row).
+struct NamingStatsRecord {
+  NamingMember member;
+  int64_t age_ms = -1;  // since the member's last publish; -1 = never
+  std::string payload;  // opaque (digest-wire 2 blob for fleet nodes)
+};
 
 // ---- registry (any node can host it) -------------------------------------
 
@@ -136,6 +150,19 @@ class NamingRegistry {
             uint64_t* version,
             const std::function<bool()>& keep_waiting = nullptr);
 
+  // Attaches `payload` to the LIVE member (service, addr).  Lease/epoch
+  // fenced: kENamingMiss when the member is unknown or expired (a dead
+  // node cannot publish), kENamingStaleEpoch when `epoch` is older than
+  // the recorded member's (a zombie predecessor cannot overwrite its
+  // successor's stats).  Does NOT bump the service version — stats churn
+  // every renew round and must not wake membership watchers.
+  int publish(const std::string& service, const std::string& addr,
+              uint64_t epoch, std::string payload);
+  // Fills *out with every live member + its latest payload (empty when
+  // the member never published).  kENamingMiss like resolve().
+  int stats(const std::string& service,
+            std::vector<NamingStatsRecord>* out, uint64_t* version);
+
   size_t member_count(const std::string& service);
   // RELEASES every parked watcher (drain hook: a draining registry host
   // must not hold watcher fibers through its in-flight wait).  Bumps
@@ -149,6 +176,9 @@ class NamingRegistry {
   struct Member {
     NamingMember m;
     int64_t deadline_us = 0;
+    // Latest published stats payload (dies with the member).
+    std::string payload;
+    int64_t payload_us = 0;  // monotonic stamp of the last publish
   };
   struct Service {
     std::unordered_map<std::string, Member> members;  // by addr
@@ -200,6 +230,20 @@ int naming_resolve(Channel* ch, const std::string& service,
 int naming_watch(Channel* ch, const std::string& service,
                  std::vector<NamingMember>* out, uint64_t* version,
                  int64_t park_budget_ms, int64_t timeout_ms);
+// Publishes an opaque stats payload onto (service, addr)'s live record.
+int naming_publish(Channel* ch, const std::string& service,
+                   const std::string& addr, uint64_t epoch,
+                   const std::string& payload);
+// Pulls every live member's latest payload.
+int naming_stats(Channel* ch, const std::string& service,
+                 std::vector<NamingStatsRecord>* out, uint64_t* version);
+
+// Fleet aggregation over the LOCAL registry (the /fleet builtin and
+// trpc_fleet_dump): resolves `service`'s live members, decodes each
+// published digest-wire 2 blob, merges digests octave-wise per tenant and
+// rank-walks the pooled samples — fleet per-tenant rate / p50 / p99 /
+// error-rate / budget-remaining / burn-rate, never averaged node p99s.
+std::string fleet_dump_json(const std::string& service);
 
 // ---- Announcer (server-side self-registration) ---------------------------
 
@@ -218,15 +262,26 @@ class Announcer {
   void Withdraw();
   uint64_t epoch() const { return epoch_; }
   const std::string& self_addr() const { return self_addr_; }
+  // Installs the stats provider the renew fiber publishes each round
+  // while the reloadable `trpc_fleet_publish` flag is on (an empty return
+  // skips the round).  Call BEFORE Start — Start publishes once
+  // immediately so a fresh node is visible in /fleet without waiting a
+  // renew round.
+  void set_stats_provider(std::function<std::string()> fn) {
+    stats_provider_ = std::move(fn);
+  }
 
  private:
   static void renew_fiber(void* arg);
+  // One publication round (flag-gated; no-op without a provider).
+  void publish_stats();
   std::unique_ptr<Channel> ch_;
   std::string service_;
   std::string self_addr_;
   std::string zone_;
   int weight_ = 1;
   uint64_t epoch_ = 0;
+  std::function<std::string()> stats_provider_;
   std::atomic<bool> withdrawn_{false};
   std::atomic<bool> stopping_{false};
   std::atomic<bool> renewer_started_{false};
@@ -244,7 +299,11 @@ int server_announce(Server* srv, const std::string& registry_addr,
                     int weight);
 
 // Flag registration (idempotent): trpc_naming_lease_ms,
-// trpc_naming_watch_ms.
+// trpc_naming_watch_ms, trpc_fleet_publish.
 void naming_ensure_registered();
+
+// True while the reloadable trpc_fleet_publish flag is on (one relaxed
+// load — announcer renew rounds gate their publish on it).
+bool fleet_publish_enabled();
 
 }  // namespace trpc

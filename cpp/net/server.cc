@@ -36,6 +36,7 @@
 #include "net/shm_transport.h"
 #include "net/span.h"
 #include "stat/capture.h"
+#include "stat/slo.h"
 #include "stat/timeline.h"
 #include "net/stream.h"
 #include "net/rma.h"
@@ -234,6 +235,24 @@ int Server::SetQos(const std::string& spec) {
     return -1;  // a typo must not silently mean "no QoS"
   }
   qos_ = std::move(gov);
+  return 0;
+}
+
+int Server::SetSlo(const std::string& spec) {
+  if (running()) {
+    return -1;
+  }
+  if (spec.empty()) {
+    slo_.reset();
+    return 0;
+  }
+  std::string err;
+  auto eng = SloEngine::parse(spec, &err);
+  if (eng == nullptr) {
+    LOG(Warning) << "bad slo spec '" << spec << "': " << err;
+    return -1;  // a typo must not silently mean "no SLO"
+  }
+  slo_ = std::move(eng);
   return 0;
 }
 
@@ -1101,6 +1120,10 @@ void tstd_process_request(InputMessage&& msg) {
   // distinct from kELimit so the cluster client fails over immediately.
   std::shared_ptr<TenantGovernor> gov =
       srv != nullptr ? srv->qos_governor() : nullptr;
+  // SLO scoring (stat/slo.h): flag-off this is ONE relaxed load and the
+  // engine is never even ref-counted into the closure.
+  std::shared_ptr<SloEngine> slo =
+      (srv != nullptr && slo::enabled()) ? srv->slo_engine() : nullptr;
   TenantGovernor::Entry* tenant_entry = nullptr;
   bool tenant_admitted = true;
   if (gov != nullptr && !deadline_dead) {
@@ -1137,8 +1160,9 @@ void tstd_process_request(InputMessage&& msg) {
   const uint64_t cap_trace = msg.meta.trace_id;
   const uint64_t cap_pspan = msg.meta.span_id;
   Closure done = [socket_id, cid, cntl, response, start_us, srv, lat,
-                  limiter, gov, tenant_entry, span, cap_on, cap_arrival,
-                  cap_req_bytes, cap_budget, cap_trace, cap_pspan] {
+                  limiter, gov, slo, tenant_entry, span, cap_on,
+                  cap_arrival, cap_req_bytes, cap_budget, cap_trace,
+                  cap_pspan] {
     RpcMeta meta;
     meta.type = RpcMeta::kResponse;
     meta.correlation_id = cid;
@@ -1212,6 +1236,12 @@ void tstd_process_request(InputMessage&& msg) {
     }
     if (lat != nullptr) {
       *lat << latency_us;
+    }
+    if (slo != nullptr) {
+      // Sheds run done() too, so kEOverloaded/kEDeadlineExpired count
+      // against the tenant's error budget — an overloaded tenant can't
+      // look healthy by shedding its way under its latency target.
+      slo->on_response(cntl->qos_tenant(), latency_us, cntl->Failed());
     }
     if (cap_on && capture::enabled()) {
       capture::Sample cs;

@@ -36,6 +36,7 @@ class MongoService;     // net/mongo.h
 class RtmpService;      // net/rtmp.h
 class NsheadService;  // net/nshead.h
 class EspService;     // net/nshead.h
+class SloEngine;      // stat/slo.h
 
 class Server {
  public:
@@ -67,6 +68,17 @@ class Server {
   // governor kept).
   int SetQos(const std::string& spec);
   std::shared_ptr<TenantGovernor> qos_governor() const { return qos_; }
+
+  // Per-tenant SLO targets (stat/slo.h SloEngine): windowed attainment +
+  // multi-window error-budget burn rates, fed from the dispatch path when
+  // the reloadable `trpc_slo` flag is on.  Spec grammar (';'-separated):
+  //   "<tenant>:p99_us=N,avail=P" with tenant "*" as the default clause;
+  //   avail is a percent like 99.9.  "" removes.  Call before Start.
+  // Returns 0, or -1 on a malformed spec (previous engine kept).
+  // Surfaced by /slo, slo_* vars, timeline event 28 and — with
+  // trpc_fleet_publish on — the naming:// fleet publication.
+  int SetSlo(const std::string& spec);
+  std::shared_ptr<SloEngine> slo_engine() const { return slo_; }
 
   // Shards the TCP acceptor across `n` SO_REUSEPORT listen sockets
   // (1..kMaxAcceptShards), each registered with its own event-dispatcher
@@ -379,6 +391,7 @@ class Server {
   std::atomic<uint64_t> accept_counts_[kMaxAcceptShards] = {};
   int reuseport_shards_ = 1;
   std::shared_ptr<TenantGovernor> qos_;
+  std::shared_ptr<SloEngine> slo_;
   int port_ = -1;
   std::string unix_path_;  // non-empty when listening on AF_UNIX
   std::atomic<bool> running_{false};

@@ -1,0 +1,185 @@
+#include "stat/digest.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+
+namespace trpc {
+
+namespace {
+
+// Append a POD value in the native (little-endian on every supported box,
+// same assumption NamingWire already bakes in) layout.
+template <typename T>
+void put(std::string* out, T v) {
+  out->append(reinterpret_cast<const char*>(&v), sizeof(T));
+}
+
+template <typename T>
+bool get(const uint8_t*& p, const uint8_t* end, T* v) {
+  if (static_cast<size_t>(end - p) < sizeof(T)) {
+    return false;
+  }
+  std::memcpy(v, p, sizeof(T));
+  p += sizeof(T);
+  return true;
+}
+
+}  // namespace
+
+constexpr char LatencyDigest::kMagic[];
+
+int digest_octave_of(int64_t v) {
+  if (v <= 1) {
+    return 0;
+  }
+  if (v >= (int64_t{1} << 31)) {
+    return LatencyDigest::kOctaves - 1;
+  }
+  const int lg = 63 - __builtin_clzll(static_cast<uint64_t>(v));
+  return lg < LatencyDigest::kOctaves - 1 ? lg
+                                          : LatencyDigest::kOctaves - 1;
+}
+
+void digest_merge(LatencyDigest* into, const LatencyDigest& from) {
+  into->count += from.count;
+  into->sum_us += from.sum_us;
+  into->total_count += from.total_count;
+  if (from.max_us > into->max_us) {
+    into->max_us = from.max_us;
+  }
+  // Nodes snapshot the same wall-clock window width, so the pooled window
+  // is as wide as the widest contributor and fleet qps = count/window.
+  if (from.window_secs > into->window_secs) {
+    into->window_secs = from.window_secs;
+  }
+  for (int i = 0; i < LatencyDigest::kOctaves; ++i) {
+    into->oct[i].added += from.oct[i].added;
+    into->oct[i].samples.insert(into->oct[i].samples.end(),
+                                from.oct[i].samples.begin(),
+                                from.oct[i].samples.end());
+  }
+}
+
+int64_t digest_percentile_us(const LatencyDigest& d, double p) {
+  // Identical rank walk to the reference recorder (percentile.h:335
+  // get_number): exact per-octave counts locate the owning octave, the
+  // pooled reservoir resolves the value within it.
+  int64_t total = 0;
+  for (int i = 0; i < LatencyDigest::kOctaves; ++i) {
+    total += d.oct[i].added;
+  }
+  if (total == 0) {
+    return 0;
+  }
+  int64_t n =
+      static_cast<int64_t>(std::ceil(p * static_cast<double>(total)));
+  if (n > total) {
+    n = total;
+  } else if (n < 1) {
+    n = 1;
+  }
+  for (int i = 0; i < LatencyDigest::kOctaves; ++i) {
+    const int64_t in_oct = d.oct[i].added;
+    if (in_oct == 0) {
+      continue;
+    }
+    if (n <= in_oct) {
+      if (d.oct[i].samples.empty()) {
+        return int64_t{1} << i;  // count but no samples: octave floor
+      }
+      std::vector<int64_t> merged = d.oct[i].samples;
+      std::sort(merged.begin(), merged.end());
+      size_t sample_n = static_cast<size_t>(
+          static_cast<double>(n) * static_cast<double>(merged.size()) /
+          static_cast<double>(in_oct));
+      if (sample_n >= merged.size()) {
+        sample_n = merged.size() - 1;
+      } else if (sample_n > 0) {
+        --sample_n;
+      }
+      return merged[sample_n];
+    }
+    n -= in_oct;
+  }
+  return d.max_us;
+}
+
+std::string digest_encode(const LatencyDigest& d) {
+  std::string out;
+  out.append(LatencyDigest::kMagic, 8);
+  put<int64_t>(&out, d.count);
+  put<int64_t>(&out, d.sum_us);
+  put<int64_t>(&out, d.max_us);
+  put<int64_t>(&out, d.total_count);
+  put<double>(&out, d.window_secs);
+  uint32_t noct = 0;
+  for (int i = 0; i < LatencyDigest::kOctaves; ++i) {
+    if (d.oct[i].added != 0 || !d.oct[i].samples.empty()) {
+      ++noct;
+    }
+  }
+  put<uint32_t>(&out, noct);
+  for (int i = 0; i < LatencyDigest::kOctaves; ++i) {
+    const auto& o = d.oct[i];
+    if (o.added == 0 && o.samples.empty()) {
+      continue;
+    }
+    put<uint32_t>(&out, static_cast<uint32_t>(i));
+    put<int64_t>(&out, o.added);
+    put<uint32_t>(&out, static_cast<uint32_t>(o.samples.size()));
+    for (int64_t s : o.samples) {
+      // u32 caps at ~71 minutes — far above octave 31's 2^31us floor
+      // ever resolving finer, and well inside the one-octave error bound.
+      const uint64_t clamped =
+          s < 0 ? 0
+                : std::min<uint64_t>(static_cast<uint64_t>(s), UINT32_MAX);
+      put<uint32_t>(&out, static_cast<uint32_t>(clamped));
+    }
+  }
+  return out;
+}
+
+size_t digest_decode(const void* data, size_t len, LatencyDigest* out) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  const uint8_t* end = p + len;
+  if (len < 8 || std::memcmp(p, LatencyDigest::kMagic, 8) != 0) {
+    return 0;
+  }
+  p += 8;
+  *out = LatencyDigest();
+  uint32_t noct = 0;
+  if (!get(p, end, &out->count) || !get(p, end, &out->sum_us) ||
+      !get(p, end, &out->max_us) || !get(p, end, &out->total_count) ||
+      !get(p, end, &out->window_secs) || !get(p, end, &noct)) {
+    return 0;
+  }
+  if (noct > LatencyDigest::kOctaves) {
+    return 0;
+  }
+  for (uint32_t k = 0; k < noct; ++k) {
+    uint32_t idx = 0, nsamp = 0;
+    int64_t added = 0;
+    if (!get(p, end, &idx) || !get(p, end, &added) ||
+        !get(p, end, &nsamp)) {
+      return 0;
+    }
+    if (idx >= LatencyDigest::kOctaves ||
+        nsamp > static_cast<size_t>(end - p) / sizeof(uint32_t)) {
+      return 0;
+    }
+    auto& o = out->oct[idx];
+    o.added = added;
+    o.samples.reserve(nsamp);
+    for (uint32_t s = 0; s < nsamp; ++s) {
+      uint32_t v = 0;
+      if (!get(p, end, &v)) {
+        return 0;
+      }
+      o.samples.push_back(static_cast<int64_t>(v));
+    }
+  }
+  return static_cast<size_t>(p - static_cast<const uint8_t*>(data));
+}
+
+}  // namespace trpc

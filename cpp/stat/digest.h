@@ -1,0 +1,95 @@
+// Mergeable latency digests — a versioned, compact binary snapshot of a
+// LatencyRecorder's octave-bucketed percentile samples plus counter/qps
+// state.  Digests from many nodes MERGE by octave-wise sample pooling;
+// fleet percentiles come from a rank walk over the *merged* samples —
+// never from averaging per-node p99s (which is statistically meaningless).
+// The error bound of a merged percentile is the recorder's existing octave
+// bound: the reported value lies within the owning octave [2^i, 2^(i+1)),
+// i.e. within 2x of the true pooled percentile.
+//
+// Wire format (version marker pinned by tools/lint_trpc.py against the
+// Python decoder in brpc_tpu/rpc/observe.py):
+//
+//   digest-wire 1 (TRPCDG01)
+//     char[8]  magic = "TRPCDG01"
+//     int64    count         (window total sample count)
+//     int64    sum_us        (window latency sum, us)
+//     int64    max_us        (max latency ever observed, us)
+//     int64    total_count   (lifetime sample count — rate/qps basis)
+//     double   window_secs   (seconds of data pooled into the window)
+//     uint32   noct          (number of non-empty octaves that follow)
+//     per octave:
+//       uint32 index         (octave i: values in [2^i, 2^(i+1)) us)
+//       int64  added         (exact count of values landing in octave)
+//       uint32 nsamples      (reservoir samples encoded)
+//       uint32 sample[nsamples]   (us; values are clamped to u32 max
+//                                  ~71min, far above octave 31's floor)
+//
+//   digest-wire 2 (TRPCFL01)
+//     Fleet node blob published via naming://: char[8] magic "TRPCFL01",
+//     int64 wall_us, uint32 nentries, then per tenant entry:
+//       uint16 name_len, name bytes,
+//       int64 p99_target_us, double avail_target,
+//       int64 fast_window_ms, int64 slow_window_ms,
+//       int64 fast_total, int64 fast_bad, int64 fast_err,
+//       int64 slow_total, int64 slow_bad, int64 slow_err,
+//       double burn_fast, double burn_slow, uint8 breached,
+//       <digest>  (one TRPCDG01 block, variable length)
+//     (Encoded by SloEngine::encode_blob in cpp/stat/slo.cc; decoded by
+//      observe.decode_fleet_blob.)
+#pragma once
+
+#include <array>
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace trpc {
+
+struct LatencyDigest {
+  static constexpr int kOctaves = 32;
+  static constexpr char kMagic[9] = "TRPCDG01";
+
+  struct Oct {
+    int64_t added = 0;                 // exact per-octave count
+    std::vector<int64_t> samples;      // reservoir sample values (us)
+  };
+
+  int64_t count = 0;        // window sample count
+  int64_t sum_us = 0;       // window latency sum
+  int64_t max_us = 0;       // lifetime max
+  int64_t total_count = 0;  // lifetime count
+  double window_secs = 0;   // seconds pooled into the window
+  std::array<Oct, kOctaves> oct;
+
+  bool empty() const { return count == 0; }
+  double qps() const {
+    return window_secs > 0 ? static_cast<double>(count) / window_secs : 0.0;
+  }
+  double avg_us() const {
+    return count > 0 ? static_cast<double>(sum_us) / count : 0.0;
+  }
+};
+
+// Octave index of a value: clamped floor(log2(v)).  Mirrors the recorder's
+// internal bucketing so pooled digests and live recorders agree.
+int digest_octave_of(int64_t v);
+
+// Octave-wise pooling: adds `from` into `into` (counts sum, reservoirs
+// concatenate, max takes max, window spans take max — nodes snapshot the
+// same wall window, so pooled qps = sum(count)/window).
+void digest_merge(LatencyDigest* into, const LatencyDigest& from);
+
+// Rank walk over the pooled samples: identical math to
+// LatencyRecorder::percentile_over (which delegates here), so a merged
+// fleet percentile carries the same one-octave error bound as a single
+// node's.  p in (0,1].  Returns 0 for an empty digest.
+int64_t digest_percentile_us(const LatencyDigest& d, double p);
+
+// Versioned binary encode/decode.  decode returns the number of bytes
+// consumed, or 0 on malformed input; `len` may extend past the digest
+// (fleet blobs embed digests back-to-back).
+std::string digest_encode(const LatencyDigest& d);
+size_t digest_decode(const void* data, size_t len, LatencyDigest* out);
+
+}  // namespace trpc

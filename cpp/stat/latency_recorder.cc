@@ -118,60 +118,26 @@ int64_t LatencyRecorder::latency_avg_us() const {
 int64_t LatencyRecorder::percentile_over(
     const std::vector<const Second*>& secs, double p,
     int64_t* total_out) const {
-  // Exact per-octave counts locate the owning octave; rank walk =
-  // reference percentile.h:335 get_number.
-  int64_t per_octave[kNumOctaves] = {0};
-  int64_t total = 0;
+  // Pool the seconds into a digest and delegate to the shared rank walk
+  // (digest_percentile_us — reference percentile.h:335 get_number).  One
+  // implementation serves both the live recorder and merged fleet
+  // digests, so both carry the identical one-octave error bound.  Seconds
+  // contribute ≤kOctaveSamples each regardless of their added count — a
+  // mild bias WITHIN the owning octave, so the result still lies inside
+  // the correct [2^i, 2^(i+1)) band (the bounded-error contract).
+  LatencyDigest d;
   for (const Second* s : secs) {
     for (int i = 0; i < kNumOctaves; ++i) {
-      per_octave[i] += s->oct[i].added;
-      total += s->oct[i].added;
+      d.oct[i].added += s->oct[i].added;
+      d.count += s->oct[i].added;
+      d.oct[i].samples.insert(d.oct[i].samples.end(),
+                              s->oct[i].samples.begin(),
+                              s->oct[i].samples.end());
     }
   }
-  *total_out = total;
-  if (total == 0) {
-    return 0;
-  }
-  // ceil, like the reference's get_number: rank 0.99·100000 is exactly the
-  // 99000th sample, not the 99001st (which would already be in the tail).
-  int64_t n = static_cast<int64_t>(
-      std::ceil(p * static_cast<double>(total)));
-  if (n > total) {
-    n = total;
-  } else if (n < 1) {
-    n = 1;
-  }
-  for (int i = 0; i < kNumOctaves; ++i) {
-    if (per_octave[i] == 0) {
-      continue;
-    }
-    if (n <= per_octave[i]) {
-      // Merge the owning octave's samples across the window.  Seconds
-      // contribute ≤kOctaveSamples each regardless of their added count —
-      // a mild bias WITHIN the octave, so the result still lies inside
-      // the correct [2^i, 2^(i+1)) band (the bounded-error contract).
-      std::vector<int64_t> merged;
-      for (const Second* s : secs) {
-        merged.insert(merged.end(), s->oct[i].samples.begin(),
-                      s->oct[i].samples.end());
-      }
-      if (merged.empty()) {
-        return int64_t{1} << i;  // count but no samples: octave floor
-      }
-      std::sort(merged.begin(), merged.end());
-      size_t sample_n = static_cast<size_t>(
-          static_cast<double>(n) * static_cast<double>(merged.size()) /
-          static_cast<double>(per_octave[i]));
-      if (sample_n >= merged.size()) {
-        sample_n = merged.size() - 1;
-      } else if (sample_n > 0) {
-        --sample_n;
-      }
-      return merged[sample_n];
-    }
-    n -= per_octave[i];
-  }
-  return max_us_.load(std::memory_order_relaxed);
+  *total_out = d.count;
+  d.max_us = max_us_.load(std::memory_order_relaxed);
+  return digest_percentile_us(d, p);
 }
 
 int64_t LatencyRecorder::latency_percentile_us(double p) const {
@@ -262,6 +228,40 @@ void LatencyRecorder::read_stats(double out[8]) const {
     out[3 + i] = static_cast<double>(
         percentile_over(secs, kQuantiles[i], &total));
   }
+}
+
+void LatencyRecorder::snapshot_digest(LatencyDigest* out) const {
+  *out = LatencyDigest();
+  {
+    std::lock_guard<std::mutex> g(window_mu_);
+    out->window_secs = static_cast<double>(
+        window_.empty() ? 1 : window_.size());
+    for (const Second& s : window_) {
+      out->count += s.count;
+      out->sum_us += s.sum;
+      for (int i = 0; i < kNumOctaves; ++i) {
+        out->oct[i].added += s.oct[i].added;
+        out->oct[i].samples.insert(out->oct[i].samples.end(),
+                                   s.oct[i].samples.begin(),
+                                   s.oct[i].samples.end());
+      }
+    }
+  }
+  {
+    // Fold in the live interval so a recorder younger than one sampler
+    // tick still publishes its traffic (same fallback the read paths use).
+    std::lock_guard<std::mutex> g(res_mu_);
+    for (int i = 0; i < kNumOctaves; ++i) {
+      out->oct[i].added += active_[i].added;
+      out->oct[i].samples.insert(out->oct[i].samples.end(),
+                                 active_[i].samples.begin(),
+                                 active_[i].samples.end());
+    }
+  }
+  out->count += interval_count_.load(std::memory_order_relaxed);
+  out->sum_us += interval_sum_.load(std::memory_order_relaxed);
+  out->max_us = max_us_.load(std::memory_order_relaxed);
+  out->total_count = total_count_.load(std::memory_order_relaxed);
 }
 
 std::string LatencyRecorder::prometheus_str(const std::string& name) const {

@@ -23,6 +23,7 @@
 #include <string>
 #include <vector>
 
+#include "stat/digest.h"
 #include "stat/reducer.h"
 #include "stat/sampler.h"
 #include "stat/variable.h"
@@ -51,6 +52,14 @@ class LatencyRecorder : public Variable, public Sampled {
   // and re-snapshotting would multiply that critical section by five.
   void read_stats(double out[8]) const;
   int64_t count() const { return total_count_.load(std::memory_order_relaxed); }
+
+  // Mergeable snapshot: pools the trailing window (plus the live interval,
+  // so fresh recorders aren't empty) into a LatencyDigest — octave counts
+  // and reservoirs, window span, lifetime count/max.  Fleet aggregation
+  // merges digests octave-wise and rank-walks the pooled samples
+  // (digest_percentile_us — the same walk percentile_over delegates to),
+  // keeping the one-octave error bound.
+  void snapshot_digest(LatencyDigest* out) const;
 
   std::string value_str() const override;
   // Quantile/qps/count series (prometheus_metrics_service parity).

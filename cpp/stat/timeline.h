@@ -96,6 +96,10 @@ enum EventType : uint32_t {
   kCollReady = 27,  // a=schedule step, b=(chunk << 32) | bytes — a
                     // transfer fired by a readiness stamp (chunk =
                     // dep offset / trpc_coll_ready_granularity_bytes)
+  // -- SLO engine (stat/slo.h) -------------------------------------------
+  kSloBreach = 28,  // a=tenant hash (slo::tenant_hash, FNV-1a of the
+                    // tenant name), b=(op << 56) | burn-rate in milli
+                    // (fast window, clamped); ops: 1 breach, 2 clear
   kEventTypeCount,
 };
 
@@ -137,6 +141,7 @@ constexpr const char* kEventNames[] = {
     "deadline",        // timeline-event 25 (deadline)
     "capture",         // timeline-event 26 (capture)
     "coll_ready",      // timeline-event 27 (coll_ready)
+    "slo_breach",      // timeline-event 28 (slo_breach)
 };
 static_assert(sizeof(kEventNames) / sizeof(kEventNames[0]) ==
                   kEventTypeCount,

@@ -1,0 +1,56 @@
+"""Subprocess helper for tests/test_slo_python.py: one fleet node in its
+OWN process — an echo server with a per-tenant SLO engine armed
+(`trpc_slo`) and fleet publication on (`trpc_fleet_publish`), announcing
+into the parent's naming registry so the Announcer's renew rounds
+piggyback this node's digest-wire 2 blob onto its membership record.
+
+Env knobs (all optional except FLEET_REGISTRY):
+  FLEET_REGISTRY   registry host:port to announce into (required)
+  FLEET_SERVICE    service name (default "fleet")
+  FLEET_ZONE       zone tag (default "")
+  FLEET_SPEC       SLO spec (default "tenantA:p99_us=2000,avail=99.0;
+                   *:p99_us=10000")
+  FLEET_FAST_MS / FLEET_SLOW_MS   compressed burn windows (set BEFORE
+                   set_slo — widths are captured at install time)
+  FLEET_LEASE_MS   naming lease (publication cadence = lease/3)
+
+Prints one JSON line {"port": N} when serving, then exits when stdin
+closes (the parent's handle on our lifetime).
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    from brpc_tpu.rpc import Server, observe
+    from brpc_tpu.rpc.flags import set_flag
+
+    registry = os.environ["FLEET_REGISTRY"]
+    service = os.environ.get("FLEET_SERVICE", "fleet")
+    zone = os.environ.get("FLEET_ZONE", "")
+    spec = os.environ.get(
+        "FLEET_SPEC", "tenantA:p99_us=2000,avail=99.0;*:p99_us=10000")
+    set_flag("trpc_slo_fast_window_ms",
+             os.environ.get("FLEET_FAST_MS", "2000"))
+    set_flag("trpc_slo_slow_window_ms",
+             os.environ.get("FLEET_SLOW_MS", "8000"))
+    set_flag("trpc_naming_lease_ms",
+             os.environ.get("FLEET_LEASE_MS", "600"))
+    observe.enable_slo(True)
+    observe.enable_fleet_publish(True)
+
+    srv = Server()
+    srv.register_native_echo("Echo.Echo")
+    srv.set_slo(spec)
+    srv.start(0)
+    srv.announce(registry, service, zone=zone)
+    print(json.dumps({"port": srv.port}), flush=True)
+    sys.stdin.read()  # parent closes stdin to stop us
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

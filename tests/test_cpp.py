@@ -39,7 +39,7 @@ _NO_CMAKE = shutil.which("cmake") is None or shutil.which("ctest") is None
 TSAN_SUITES = [
     "fiber", "rpc", "stream", "shm", "ici", "chaos", "stat", "qos",
     "stripe", "analysis", "timeline", "rma", "kvstore", "naming",
-    "collective", "tuner", "deadline", "capture",
+    "collective", "tuner", "deadline", "capture", "slo",
 ]
 ALL_SUITES = sorted(
     p.stem[len("test_"):] for p in (REPO / "cpp" / "tests").glob("test_*.cc")
@@ -230,6 +230,19 @@ def test_capture_cpp_suite_native():
     recording QoS-tagged + deadline-stamped live traffic."""
     _run_native_suite("test_capture.cc", "test_capture_native",
                       "capture suite")
+
+
+def test_slo_cpp_suite_native():
+    """ISSUE 19: the SLO / fleet-observability plane gates tier-1 —
+    flag-off invisibility (every slo_* var provably frozen at 0),
+    digest wire roundtrip + truncation rejection, the merge-vs-pooled-
+    oracle property (fleet percentiles from octave-wise sample pooling
+    within the recorder's one-octave bound of a single recorder that
+    saw all the traffic, across seeds), spec parse/reject, compressed-
+    window burn-rate breach fire + clear with timeline event 28 edges
+    only on transitions, fleet blob roundtrip, and in-process Announcer
+    publication + merged /fleet dump over a live naming registry."""
+    _run_native_suite("test_slo.cc", "test_slo_native", "slo suite")
 
 
 def test_kvstore_cpp_suite_native():

@@ -46,6 +46,16 @@ Rules (each names the incident class it prevents):
                      rule catches renames/renumbers/one-sided additions,
                      the same incident class as tail-group.
 
+  digest-wire        The mergeable latency digest and the fleet
+                     publication blob are binary on the wire (naming://
+                     payloads, /fleet, fleet_top.py): the
+                     `digest-wire N (MAGIC)` markers in
+                     cpp/stat/digest.h (encoder) and
+                     brpc_tpu/rpc/observe.py (decoder) must be unique,
+                     consecutive from 1, and identical on both sides —
+                     a one-sided layout change silently corrupts every
+                     fleet merge instead of failing loudly.
+
   flag-exists        Every `trpc_*` flag name a Python surface, tool or
                      test references literally (set_flag/get_flag) must
                      be defined by a `Flag::define_*` in the C++ runtime.
@@ -344,6 +354,42 @@ def check_timeline_events() -> None:
              "— a one-sided event type breaks every recorded binary dump")
 
 
+# ---- digest-wire ---------------------------------------------------------
+
+def check_digest_wire() -> None:
+    cpp_path = CPP / "stat" / "digest.h"
+    py_path = REPO / "brpc_tpu" / "rpc" / "observe.py"
+    marker = r"digest-wire\s+(\d+)\s*\(([A-Z0-9_]+)\)"
+
+    def table(path: pathlib.Path, comment: str) -> list:
+        out = []
+        for m in re.finditer(comment + r"\s*" + marker, path.read_text()):
+            out.append((int(m.group(1)), m.group(2)))
+        return out
+
+    enc = table(cpp_path, r"//")
+    # The C++ side documents each format once in digest.h; the Python
+    # decoder marks its struct tables.  slo.cc re-states the TRPCFL01
+    # marker at the encode site but digest.h owns the canonical table.
+    dec = table(py_path, r"#")
+    for path, side, seq in ((cpp_path, "encoder", enc),
+                            (py_path, "decoder", dec)):
+        if not seq:
+            flag(path, 1, "digest-wire",
+                 f"no digest-wire markers found on the {side} side")
+            continue
+        ids = sorted(n for n, _ in seq)
+        if ids != list(range(1, len(ids) + 1)):
+            flag(path, 1, "digest-wire",
+                 f"{side} digest-wire ids not unique/consecutive from 1 "
+                 f"(append-only table): {ids}")
+    if enc and dec and sorted(enc) != sorted(dec):
+        flag(cpp_path, 1, "digest-wire",
+             f"encoder/decoder digest-wire tables diverge: {sorted(enc)} "
+             f"vs {sorted(dec)} — a one-sided layout change corrupts "
+             "every fleet merge")
+
+
 # ---- flag-exists ---------------------------------------------------------
 
 def check_flag_references() -> None:
@@ -553,6 +599,7 @@ def main() -> int:
     check_capi_bindings()
     check_tail_groups()
     check_timeline_events()
+    check_digest_wire()
     check_flag_references()
     check_tuner_rules()
     check_error_codes()

@@ -186,6 +186,14 @@ _TL_COLL_READY_TID = 981000
 # tuning run reads as a Perfetto artifact: decisions next to the rails/
 # lanes they retuned.
 _TL_TUNER_TID = 990000
+# slo_breach events (stat/slo.h): one instant per breach-state EDGE on
+# its own per-node "slo" track — a = FNV-1a hash of the tenant name,
+# b = op << 56 | fast-window burn rate in milli-units
+# (TIMELINE_SLO_OPS mirror: 1 = breach, 2 = clear) — so an incident
+# trace shows exactly when a tenant's error budget started and stopped
+# burning, next to the fibers and rails that caused it.
+_TL_SLO_TID = 991000
+_TL_SLO_OPS = {1: "breach", 2: "clear"}
 
 
 def _timeline_chrome_events(pid: int, dump: dict, base: float,
@@ -300,6 +308,20 @@ def _timeline_chrome_events(pid: int, dump: dict, base: float,
                     "args": {"step": int(e["a"], 16),
                              "chunk": b >> 32,
                              "bytes": b & 0xFFFFFFFF,
+                             "trace_id": e["trace_id"],
+                             "span_id": e["span_id"], "fid": e["fid"]},
+                })
+                continue
+            if name == "slo_breach":
+                b = int(e["b"], 16)
+                op = b >> 56
+                out_tid = track(_TL_SLO_TID, "slo")
+                events.append({
+                    "ph": "i", "s": "t", "cat": "timeline",
+                    "name": f"slo_{_TL_SLO_OPS.get(op, op)}",
+                    "pid": pid, "tid": out_tid, "ts": ts,
+                    "args": {"tenant_hash": e["a"],
+                             "burn_fast_milli": b & ((1 << 56) - 1),
                              "trace_id": e["trace_id"],
                              "span_id": e["span_id"], "fid": e["fid"]},
                 })
