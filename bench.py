@@ -641,6 +641,34 @@ def _child_kv_disagg() -> None:
     raise RuntimeError(f"kv_disagg produced no row:\n{out.stderr[-2000:]}")
 
 
+def _child_infer_serving() -> None:
+    """Streamed-inference front door row (ISSUE 20): the four-phase
+    tools/load_orchestrator.py --infer cycle — ramp 100k logical token
+    streams over a handful of connections (the fd proof), drain every
+    one to EOS (zero wedged), measure client-observed TTFT/TPOT through
+    the prefix cache (cached prompt blocks skip recompute), then shed a
+    2x-overloaded hog tenant typed-only while the victim tenant's TPOT
+    p99 stays within 2x unloaded.  One driver run IS the row — the
+    perf-smoke gate (BENCH_INFER_STREAMS scaled down) asserts the same
+    measurement bench publishes."""
+    import subprocess as sp
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tool = os.path.join(repo, "tools", "load_orchestrator.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo
+    streams = os.environ.get("BENCH_INFER_STREAMS", "100000")
+    out = sp.run([sys.executable, tool, "--infer", "--json",
+                  "--infer-streams", streams, "--seconds", "6"],
+                 env=env, capture_output=True, text=True, timeout=560)
+    for ln in out.stdout.splitlines()[::-1]:
+        if ln.startswith("{"):
+            print(ln, flush=True)
+            return
+    raise RuntimeError(
+        f"infer orchestrator produced no row:\n{out.stderr[-2000:]}")
+
+
 def _child_pipeline_overlap() -> None:
     """Pipeline-parallel overlapped dataflow row (ISSUE 18): a 4-member
     fleet runs M microbatches of real jax CPU gradient compute whose
@@ -1624,6 +1652,9 @@ def main() -> None:
     if os.environ.get("BENCH_KV"):
         _child_kv_disagg()
         return
+    if os.environ.get("BENCH_INFER"):
+        _child_infer_serving()
+        return
     if os.environ.get("BENCH_RR"):
         _child_rolling_restart()
         return
@@ -1715,6 +1746,7 @@ def main() -> None:
     pipeline_overlap = _run_json_child({"BENCH_OVERLAP": "1"}, 240)
     slo_fleet = _run_json_child({"BENCH_SLO_FLEET": "1"}, 240)
     self_tune = _run_json_child({"BENCH_SELF_TUNE": "1"}, 240)
+    infer_serving = _run_json_child({"BENCH_INFER": "1"}, 600)
 
     # tpu_rpc leg, same retry contract; a CPU-platform run is still a real
     # measurement of the native RPC stack, so fall back rather than emit
@@ -1757,6 +1789,7 @@ def main() -> None:
         "pipeline_overlap": pipeline_overlap,
         "slo_fleet": slo_fleet,
         "self_tune": self_tune,
+        "infer_serving": infer_serving,
     }))
 
 

@@ -1,9 +1,11 @@
 from brpc_tpu.rpc import capture  # noqa: F401
 from brpc_tpu.rpc import collective  # noqa: F401
 from brpc_tpu.rpc import fault  # noqa: F401
+from brpc_tpu.rpc import infer  # noqa: F401
 from brpc_tpu.rpc import kv  # noqa: F401
 from brpc_tpu.rpc import naming  # noqa: F401
 from brpc_tpu.rpc import observe  # noqa: F401
+from brpc_tpu.rpc import stream  # noqa: F401
 from brpc_tpu.rpc import tuner  # noqa: F401
 from brpc_tpu.rpc._lib import IOBuf, load_library, parse_endpoint  # noqa: F401
 from brpc_tpu.rpc.batch import (  # noqa: F401
@@ -21,5 +23,12 @@ from brpc_tpu.rpc.client import (  # noqa: F401
     deadline_scope,
 )
 from brpc_tpu.rpc.flags import get_flag, set_flag  # noqa: F401
+from brpc_tpu.rpc.infer import InferClient  # noqa: F401
 from brpc_tpu.rpc.rma import RmaBuffer, kernel_supports  # noqa: F401
 from brpc_tpu.rpc.server import Call, Server  # noqa: F401
+from brpc_tpu.rpc.stream import (  # noqa: F401
+    Stream,
+    StreamClosedError,
+    StreamTimeoutError,
+    open_stream,
+)

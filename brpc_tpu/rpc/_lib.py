@@ -753,6 +753,53 @@ def load_library() -> ctypes.CDLL:
                 ctypes.POINTER(ctypes.c_uint64),
             ]
             lib.trpc_lb_hint_counters.restype = None
+            # Streaming plane (capi/stream_capi.cc; net/stream.h; ISSUE 20).
+            lib.trpc_stream_open.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_size_t, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_int), ctypes.c_char_p,
+                ctypes.c_size_t,
+            ]
+            lib.trpc_stream_open.restype = ctypes.c_void_p
+            lib.trpc_call_stream_accept.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+            ]
+            lib.trpc_call_stream_accept.restype = ctypes.c_void_p
+            lib.trpc_stream_read.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_int64,
+            ]
+            lib.trpc_stream_read.restype = ctypes.c_long
+            lib.trpc_stream_write.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.trpc_stream_write.restype = ctypes.c_int
+            lib.trpc_stream_close.argtypes = [ctypes.c_void_p]
+            lib.trpc_stream_close.restype = ctypes.c_int
+            lib.trpc_stream_destroy.argtypes = [ctypes.c_void_p]
+            lib.trpc_stream_destroy.restype = None
+            lib.trpc_stream_id.argtypes = [ctypes.c_void_p]
+            lib.trpc_stream_id.restype = ctypes.c_uint64
+            lib.trpc_stream_pending.argtypes = [ctypes.c_void_p]
+            lib.trpc_stream_pending.restype = ctypes.c_size_t
+            # Streamed-inference front door (capi/infer_capi.cc;
+            # net/infer.h; ISSUE 20).
+            lib.trpc_server_enable_infer.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
+                ctypes.c_char_p,
+            ]
+            lib.trpc_server_enable_infer.restype = ctypes.c_void_p
+            lib.trpc_infer_stop.argtypes = [ctypes.c_void_p]
+            lib.trpc_infer_stop.restype = None
+            lib.trpc_infer_dump.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.trpc_infer_dump.restype = ctypes.c_size_t
+            lib.trpc_infer_streams_live.argtypes = [ctypes.c_void_p]
+            lib.trpc_infer_streams_live.restype = ctypes.c_longlong
+            lib.trpc_infer_streams_peak.argtypes = [ctypes.c_void_p]
+            lib.trpc_infer_streams_peak.restype = ctypes.c_longlong
             _lib = lib
     return _lib
 

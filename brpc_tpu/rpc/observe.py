@@ -341,6 +341,7 @@ TIMELINE_EVENTS = {
     26: "capture",        # timeline-event 26 (capture)
     27: "coll_ready",     # timeline-event 27 (coll_ready)
     28: "slo_breach",     # timeline-event 28 (slo_breach)
+    29: "token_step",     # timeline-event 29 (token_step)
 }
 
 # kCapture `b` op tags (cpp/stat/capture.cc: b = op << 56 | request
@@ -365,6 +366,14 @@ TIMELINE_COLL_OPS = {1: "all_gather", 2: "reduce_scatter",
 # burn rate in milli-units; a = FNV-1a hash of the tenant name) — one
 # event per breach-state EDGE, never per evaluation.
 TIMELINE_SLO_OPS = {1: "breach", 2: "clear"}
+
+# kTokenStep `b` op tags (cpp/net/infer.h: b = op << 56 | low bits;
+# a = request id) — one request's life through the continuous batch:
+# admit (low bits = prefix-cache-matched tokens), prefill_done, one
+# `token` per decode step (low bits = token index), eos / cancel (low
+# bits = tokens emitted), shed (low bits = error code; a = 0).
+TIMELINE_TOKEN_OPS = {1: "admit", 2: "prefill_done", 3: "token",
+                      4: "eos", 5: "cancel", 6: "shed"}
 
 # kStripeSend rail index meaning "the call's primary socket" (head
 # frame / dead-rail fallback) — cpp/stat/timeline.h kStripePrimaryRail.

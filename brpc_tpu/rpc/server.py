@@ -61,12 +61,26 @@ class Call:
         receive.  Only valid BEFORE respond()."""
         return bool(self._lib.trpc_call_cancelled(self._handle))
 
+    def accept_stream(self, window_bytes: int = 0):
+        """Accepts the stream the request OFFERED (stream.open_stream
+        client-side) and returns an established stream.Stream.  MUST be
+        called before respond() — acceptance rides the response wire.
+        Returns None when the request offered no stream.  window_bytes
+        = 0 keeps the flag default credit window."""
+        from brpc_tpu.rpc import stream as _stream
+        handle = self._lib.trpc_call_stream_accept(self._handle,
+                                                   window_bytes)
+        if not handle:
+            return None
+        return _stream.Stream(self._lib, handle)
+
 
 class Server:
     def __init__(self):
         self._lib = load_library()
         self._ptr = self._lib.trpc_server_create()
         self._keepalive = []  # ctypes callbacks must outlive the server
+        self._infer = None  # InferScheduler handle (enable_infer)
 
     def register(self, method: str, fn: Callable[[Call, bytes], None]) -> None:
         """fn(call, request_bytes) — call call.respond(...) when done."""
@@ -142,6 +156,51 @@ class Server:
         start."""
         if self._lib.trpc_server_enable_tuner(self._ptr) != 0:
             raise RuntimeError("enable_tuner failed")
+
+    def enable_infer(self, prefix_cache: bool = True,
+                     kv_fetch_addr: str = "", node: str = "") -> None:
+        """Attaches the streamed-inference front door (cpp/net/infer.h):
+        registers Infer.Submit and starts the continuous-batching decode
+        loop — requests join/leave the running batch every step, tokens
+        push down per-request logical streams (infer.InferClient).
+        prefix_cache wires the process kv_store()/kv_registry()
+        singletons so matched prompt blocks skip recompute (composes
+        with enable_kv_store/enable_kv_registry); kv_fetch_addr pulls
+        matched blocks over Kv.FetchPrefix from that node instead
+        (prefill/decode disaggregation).  Call before start; the
+        scheduler stops automatically on close()."""
+        sched = self._lib.trpc_server_enable_infer(
+            self._ptr, 1 if prefix_cache else 0, kv_fetch_addr.encode(),
+            node.encode())
+        if not sched:
+            raise RuntimeError("enable_infer failed (server running?)")
+        self._infer = sched
+
+    def infer_dump(self) -> dict:
+        """The inference scheduler's live stats (the bench/orchestrator
+        read): active/waiting/streams_live/streams_peak, admission and
+        token counters, prefill cache bytes, and ttft/tpot percentile
+        blocks.  Raises without enable_infer()."""
+        if self._infer is None:
+            raise RuntimeError("enable_infer() was not called")
+        import json as _json
+        size = 1 << 12
+        while True:
+            out = ctypes.create_string_buffer(size)
+            need = self._lib.trpc_infer_dump(self._infer, out, size)
+            if need < size:
+                return _json.loads(out.raw[:need].decode())
+            size = need + 1
+
+    def infer_streams_live(self) -> int:
+        if self._infer is None:
+            return 0
+        return int(self._lib.trpc_infer_streams_live(self._infer))
+
+    def infer_streams_peak(self) -> int:
+        if self._infer is None:
+            return 0
+        return int(self._lib.trpc_infer_streams_peak(self._infer))
 
     def enable_naming_registry(self) -> None:
         """Attaches the NATIVE naming-registry handlers
@@ -281,6 +340,12 @@ class Server:
     def close(self) -> None:
         """Stops and frees the native server.  Only call once no requests
         are in flight (handlers hold references into the server)."""
+        # The inference scheduler must stop BEFORE the server dies: its
+        # loop fiber cancels/closes every live token stream on the way
+        # out, and those streams reference server-side sockets.
+        sched, self._infer = self._infer, None
+        if sched is not None:
+            self._lib.trpc_infer_stop(sched)
         ptr, self._ptr = self._ptr, None
         if ptr:
             self._lib.trpc_server_stop(ptr)

@@ -3,6 +3,7 @@
 #include "base/logging.h"
 
 #include <errno.h>
+#include <sys/socket.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -335,7 +336,16 @@ ssize_t IOBuf::cut_into_fd(int fd, size_t max_bytes) {
   if (n == 0) {
     return 0;
   }
-  const ssize_t rc = writev(fd, iov, n);
+  // MSG_NOSIGNAL: a peer racing its close ahead of this write must surface
+  // as EPIPE, not a process-killing SIGPIPE — no global handler is owned
+  // here.  Non-socket fds (pipes) fall back to writev.
+  msghdr msg{};
+  msg.msg_iov = iov;
+  msg.msg_iovlen = static_cast<size_t>(n);
+  ssize_t rc = ::sendmsg(fd, &msg, MSG_NOSIGNAL);
+  if (rc < 0 && errno == ENOTSOCK) {
+    rc = writev(fd, iov, n);
+  }
   if (rc > 0) {
     pop_front(static_cast<size_t>(rc));
   }

@@ -1,0 +1,66 @@
+// C ABI for the streamed-inference front door (net/infer.h) — the Python
+// surface of brpc_tpu/rpc/infer.py.  Submission itself needs no capi:
+// clients pack the InferSubmitWire request and offer a stream via
+// trpc_stream_open; this file covers server-side attach/stop and the
+// stats dump the orchestrator and bench read.
+#include <string>
+
+#include "capi/capi_util.h"
+#include "fiber/fiber.h"
+#include "net/infer.h"
+#include "net/kvstore.h"
+#include "net/server.h"
+
+using namespace trpc;
+
+extern "C" {
+
+// Attaches the continuous-batching scheduler to `srv` (registers
+// Infer.Submit, starts the decode loop).  use_prefix_cache != 0 wires the
+// PROCESS-wide kv_store()/kv_registry() singletons (composes with
+// trpc_server_enable_kv_store/_registry and cross-node prefill);
+// kv_fetch_addr non-empty pulls matched blocks over Kv.FetchPrefix from
+// that node instead of the local store.  Returns the scheduler handle,
+// NULL on failure.  Stop with trpc_infer_stop BEFORE destroying the
+// server.
+void* trpc_server_enable_infer(void* srv, int use_prefix_cache,
+                               const char* kv_fetch_addr,
+                               const char* node) {
+  InferOptions opts;
+  if (use_prefix_cache != 0) {
+    opts.store = &kv_store();
+    opts.registry = &kv_registry();
+  }
+  if (kv_fetch_addr != nullptr) {
+    opts.kv_fetch_addr = kv_fetch_addr;
+  }
+  if (node != nullptr && node[0] != '\0') {
+    opts.node = node;
+  }
+  return infer_attach(static_cast<Server*>(srv), opts);
+}
+
+// Stops the loop (cancelling every queued/active request) and frees the
+// scheduler.  Joins fibers: pinned like the other sync paths.
+void trpc_infer_stop(void* sched) {
+  ScopedPthreadWait pin;
+  infer_stop(static_cast<InferScheduler*>(sched));
+}
+
+// Scheduler stats JSON (copy_out contract: returns the full length;
+// re-call with a bigger buffer when ret >= out_len).
+size_t trpc_infer_dump(void* sched, char* out, size_t out_len) {
+  return capi::copy_out(infer_dump_json(static_cast<InferScheduler*>(sched)),
+                        out, out_len);
+}
+
+// Fast-path gauges for the scale orchestrator (≥100k-streams proof).
+long long trpc_infer_streams_live(void* sched) {
+  return infer_streams_live(static_cast<InferScheduler*>(sched));
+}
+
+long long trpc_infer_streams_peak(void* sched) {
+  return infer_streams_peak(static_cast<InferScheduler*>(sched));
+}
+
+}  // extern "C"

@@ -24,6 +24,8 @@
 #include "net/fault.h"
 #include "base/proc.h"
 #include "net/ici_transport.h"
+#include "net/infer.h"
+#include "net/kvstore.h"
 #include "net/rma.h"
 #include "stat/slo.h"
 #include "net/server.h"
@@ -156,6 +158,8 @@ void ensure_runtime_flags() {
   naming_ensure_registered();      // trpc_naming_* + trpc_fleet_publish
   deadline_ensure_registered();    // trpc_deadline_wire + retry budget
   slo::ensure_registered();        // trpc_slo + burn windows/alert
+  kv_ensure_registered();          // trpc_kv_* incl. prefix block span
+  infer_ensure_registered();       // trpc_infer_* serving knobs
 }
 }  // namespace
 

@@ -1,0 +1,216 @@
+// C ABI for the streaming plane (net/stream.h) — ordered byte-chunk
+// streams with credit flow control, surfaced to Python as
+// brpc_tpu/rpc/stream.py.
+//
+// A handle wraps a queue-backed CStream: the C++ on_message callback
+// (consume fiber) enqueues chunks and notifies; trpc_stream_read blocks
+// the calling pthread on a plain condition variable (ctypes releases the
+// GIL), so Python readers never touch fiber primitives.  The handle is a
+// heap shared_ptr holder — the stream's callbacks keep their own
+// reference, so a destroy racing a late consume batch can never free the
+// queue under the consumer.
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+
+#include "base/iobuf.h"
+#include "capi/capi_util.h"
+#include "fiber/fiber.h"
+#include "net/channel.h"
+#include "net/controller.h"
+#include "net/stream.h"
+
+using namespace trpc;
+
+namespace trpc {
+Controller* trpc_internal_pending_controller(void* call_handle);
+}
+
+namespace {
+
+struct CStream {
+  StreamId sid = 0;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<std::string> chunks;
+  bool closed = false;
+};
+
+using CStreamPtr = std::shared_ptr<CStream>;
+
+// The handle Python holds: a heap shared_ptr (callbacks hold siblings).
+CStreamPtr& of(void* h) { return *static_cast<CStreamPtr*>(h); }
+
+StreamOptions options_for(const CStreamPtr& cs, int64_t window_bytes) {
+  StreamOptions opts;
+  if (window_bytes > 0) {
+    opts.window_bytes = window_bytes;
+  }
+  opts.on_message = [cs](StreamId, IOBuf&& chunk) {
+    std::string bytes = chunk.to_string();
+    {
+      std::lock_guard<std::mutex> g(cs->mu);
+      cs->chunks.push_back(std::move(bytes));
+    }
+    cs->cv.notify_all();
+  };
+  opts.on_closed = [cs](StreamId) {
+    {
+      std::lock_guard<std::mutex> g(cs->mu);
+      cs->closed = true;
+    }
+    cs->cv.notify_all();
+  };
+  return opts;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Client side: offer a stream on `method`'s request and return the
+// established stream handle.  The RPC runs synchronously; *resp_iobuf
+// (a trpc_iobuf handle) receives the response body.  On failure returns
+// NULL with *err_code / err_buf filled (the offered stream is destroyed
+// by the failed-call path).  tenant/priority override the channel's QoS
+// default when tenant is non-empty.
+void* trpc_stream_open(void* ch, const char* method, const char* req,
+                       size_t req_len, int64_t timeout_ms,
+                       int64_t window_bytes, const char* tenant,
+                       int priority, void* resp_iobuf, int* err_code,
+                       char* err_buf, size_t err_buf_len) {
+  ScopedPthreadWait pin;  // sync CallMethod parks; see trpc_channel_call
+  auto cs = std::make_shared<CStream>();
+  Controller cntl;
+  if (timeout_ms > 0) {
+    cntl.set_timeout_ms(timeout_ms);
+  }
+  if (tenant != nullptr && tenant[0] != '\0') {
+    cntl.set_qos(tenant, static_cast<uint8_t>(priority));
+  }
+  StreamId sid = 0;
+  if (StreamCreate(&sid, &cntl, options_for(cs, window_bytes)) != 0) {
+    if (err_code != nullptr) {
+      *err_code = ENOMEM;
+    }
+    return nullptr;
+  }
+  cs->sid = sid;
+  IOBuf request;
+  if (req != nullptr && req_len > 0) {
+    request.append(req, req_len);
+  }
+  static_cast<Channel*>(ch)->CallMethod(
+      method, request, static_cast<IOBuf*>(resp_iobuf), &cntl);
+  if (cntl.Failed()) {
+    if (err_code != nullptr) {
+      *err_code = cntl.error_code() != 0 ? cntl.error_code() : -1;
+    }
+    if (err_buf != nullptr && err_buf_len > 0) {
+      strncpy(err_buf, cntl.error_text().c_str(), err_buf_len - 1);
+      err_buf[err_buf_len - 1] = '\0';
+    }
+    // The failed-call path already closed the offered stream; the
+    // callbacks' shared_ptr unwinds with the stream options.
+    return nullptr;
+  }
+  if (err_code != nullptr) {
+    *err_code = 0;
+  }
+  return new CStreamPtr(std::move(cs));
+}
+
+// Server side: accept the stream offered by the request behind an
+// in-flight call handle (brpc_tpu server thunk).  Must be called BEFORE
+// trpc_call_respond.  NULL when the request offered no stream.
+void* trpc_call_stream_accept(void* call_handle, int64_t window_bytes) {
+  Controller* cntl = trpc_internal_pending_controller(call_handle);
+  auto cs = std::make_shared<CStream>();
+  StreamId sid = 0;
+  if (StreamAccept(&sid, cntl, options_for(cs, window_bytes)) != 0) {
+    return nullptr;
+  }
+  cs->sid = sid;
+  return new CStreamPtr(std::move(cs));
+}
+
+// Blocking read of ONE chunk: returns the chunk's full length (bytes
+// beyond `cap` are DROPPED — size buffers to the protocol's chunk bound),
+// -1 when the stream is closed and drained, -2 on timeout (timeout_ms
+// < 0 waits forever).
+long trpc_stream_read(void* h, char* buf, size_t cap, int64_t timeout_ms) {
+  const CStreamPtr& cs = of(h);
+  std::unique_lock<std::mutex> g(cs->mu);
+  const bool wait_forever = timeout_ms < 0;
+  auto ready = [&cs] { return !cs->chunks.empty() || cs->closed; };
+  if (wait_forever) {
+    cs->cv.wait(g, ready);
+  } else if (!cs->cv.wait_for(g, std::chrono::milliseconds(timeout_ms),
+                              ready)) {
+    return -2;
+  }
+  if (cs->chunks.empty()) {
+    return -1;  // closed and drained
+  }
+  std::string chunk = std::move(cs->chunks.front());
+  cs->chunks.pop_front();
+  g.unlock();
+  const size_t n = chunk.size() < cap ? chunk.size() : cap;
+  if (buf != nullptr && n > 0) {
+    memcpy(buf, chunk.data(), n);
+  }
+  return static_cast<long>(chunk.size());
+}
+
+// Ordered write; parks while the peer's credit window is exhausted.
+// Returns 0, EPIPE (closed / connection dead), EINVAL (gone).
+int trpc_stream_write(void* h, const char* data, size_t len) {
+  ScopedPthreadWait pin;  // StreamWrite parks on the credit window
+  const CStreamPtr& cs = of(h);
+  IOBuf chunk;
+  if (data != nullptr && len > 0) {
+    chunk.append(data, len);
+  }
+  return StreamWrite(cs->sid, std::move(chunk));
+}
+
+// Graceful close of the local end.  Buffered chunks stay readable; reads
+// return -1 once drained.  Idempotent.
+int trpc_stream_close(void* h) {
+  const CStreamPtr& cs = of(h);
+  {
+    std::lock_guard<std::mutex> g(cs->mu);
+    if (cs->closed && !StreamExists(cs->sid)) {
+      return 0;
+    }
+  }
+  return StreamClose(cs->sid);
+}
+
+// Close (if still open) and free the handle.  The stream's callbacks
+// hold their own reference, so a consume batch mid-delivery finishes
+// against live memory.
+void trpc_stream_destroy(void* h) {
+  if (h == nullptr) {
+    return;
+  }
+  trpc_stream_close(h);
+  delete static_cast<CStreamPtr*>(h);
+}
+
+unsigned long long trpc_stream_id(void* h) {
+  return static_cast<unsigned long long>(of(h)->sid);
+}
+
+// Chunks currently buffered client-side (observability / tests).
+size_t trpc_stream_pending(void* h) {
+  const CStreamPtr& cs = of(h);
+  std::lock_guard<std::mutex> g(cs->mu);
+  return cs->chunks.size();
+}
+
+}  // extern "C"

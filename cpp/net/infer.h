@@ -1,0 +1,147 @@
+// Streamed-inference front door — continuous batching over multiplexed
+// token streams (ROADMAP item 3, the workload every other plane exists
+// for).
+//
+// One InferScheduler per serving process: requests arrive as normal RPCs
+// ("Infer.Submit") that OFFER a stream (net/stream.h); the scheduler
+// accepts the stream, admits the request into a continuously-batched
+// decode loop, and pushes one TokenRecord per decode step down the
+// request's stream.  Requests join and leave the running batch at every
+// step — a finished or cancelled request frees its slot before the same
+// step's admission scan, so the batch never idles a slot for a step.
+//
+// Prefill rides the PR 17 content-addressed prefix cache: the prompt's
+// token chain (kv_prefix_chain) is matched against a KvRegistry, matched
+// blocks are FETCHED (locally zero-copy or over Kv.FetchPrefix from a
+// prefill node) instead of recomputed, and only the uncached suffix pays
+// simulated prefill time (trpc_infer_prefill_us_per_token).  After
+// prefill, the request's uncached blocks are published back so the next
+// identical prompt hits.
+//
+// Cancellation composes the PR 15 plane end-to-end: every request owns a
+// CancelScope bound to its submit connection + stamped deadline.  Client
+// disconnect (socket failure → stream_on_connection_failed → on_closed),
+// an explicit stream close, or budget expiry all cancel the request —
+// closing its token stream, aborting in-flight prefix fetches mid-RPC
+// (the fetch fiber runs under the scope as ambient cancel, so
+// Channel::CallMethod registers the call for StartCancel fan-out), and
+// crediting the bytes NOT pulled to deadline_cancel_saved_bytes.  The
+// freed slot is re-admitted the same step.
+//
+// Admission is per-tenant: under pressure (live requests past half the
+// box), a tenant above its weighted share (net/qos.h qos_tenant_weight)
+// sheds with kEOverloaded (2005); a tenant currently burning its SLO
+// error budget (stat/slo.h tenant_breached) has its share halved so
+// in-SLO tenants degrade nothing at 2x overload.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "base/iobuf.h"
+#include "stat/latency_recorder.h"
+#include "stat/reducer.h"
+
+namespace trpc {
+
+class Server;
+class KvStore;
+class KvRegistry;
+class InferScheduler;
+
+// ---- wire formats (fixed little-endian; mirrored by ----------------------
+// brpc_tpu/rpc/infer.py — infer-wire marker) -------------------------------
+
+// Infer.Submit request: header + n_prompt_tokens x u64 token ids.  The
+// request must offer exactly one stream (StreamCreate before CallMethod);
+// the response stream carries TokenRecords.
+struct InferSubmitWire {
+  uint32_t magic = 0;       // kInferMagic
+  uint32_t flags = 0;       // kSubmitNoPublish: skip post-prefill publish
+  uint32_t max_new_tokens = 0;  // 0 = flag default
+  uint32_t n_prompt_tokens = 0;
+};
+constexpr uint32_t kInferMagic = 0x31464e49;  // "INF1"
+constexpr uint32_t kSubmitNoPublish = 1;
+
+// Infer.Submit response.
+struct InferSubmitReply {
+  uint64_t request_id = 0;
+  uint32_t cached_tokens = 0;  // prefix-cache-matched prompt tokens
+  uint32_t block_tokens = 0;   // chain block size the match used
+};
+
+// One decode step's output for one request (one stream chunk may carry
+// exactly one record; readers parse 16-byte records).
+struct TokenRecord {
+  uint64_t token = 0;
+  uint32_t index = 0;  // 0-based position in the generated sequence
+  uint32_t flags = 0;
+};
+constexpr uint32_t kTokenEos = 1;        // final record of a completion
+constexpr uint32_t kTokenCancelled = 2;  // stream cancelled mid-decode
+
+// ---- scheduler ------------------------------------------------------------
+
+struct InferOptions {
+  // Prefix-cache wiring (all optional; nullptr disables the cache path).
+  // `registry` answers chain matches; `store` serves local fetches and
+  // receives post-prefill publishes.  When `kv_fetch_addr` is set,
+  // matched blocks are pulled over Kv.FetchPrefix from that node instead
+  // of the local store (prefill/decode disaggregation) — those pulls are
+  // what mid-flight cancellation aborts.
+  KvStore* store = nullptr;
+  KvRegistry* registry = nullptr;
+  std::string kv_fetch_addr;
+  // Identity stamped on published prefix replicas.
+  std::string node = "local";
+};
+
+// Registers "Infer.Submit" on `s` and starts the scheduler loop.  Returns
+// nullptr when registration fails.  The scheduler must be stopped with
+// infer_stop BEFORE the server is destroyed (it holds the Server* only
+// for registration-time use; the loop owns no server state).
+InferScheduler* infer_attach(Server* s, const InferOptions& opts);
+// Stops the loop, cancels every queued/active request (closing their
+// streams with kTokenCancelled), joins the loop fiber and frees the
+// scheduler.  Idempotent per pointer is NOT provided — call once.
+void infer_stop(InferScheduler* sched);
+
+// Introspection (capi / tests / the /infer builtin).
+size_t infer_active(InferScheduler* sched);
+size_t infer_waiting(InferScheduler* sched);
+// Streams concurrently held (waiting + active), and the high-water mark —
+// the ≥100k-logical-streams proof the orchestrator reads.
+int64_t infer_streams_live(InferScheduler* sched);
+int64_t infer_streams_peak(InferScheduler* sched);
+// {"active","waiting","streams_live","streams_peak","submitted","done",
+//  "cancelled","shed","tokens","steps","prefill_tokens","cached_tokens",
+//  "bytes_recomputed","bytes_cached","fetch_aborted","publish_dedup",
+//  "ttft":{count,p50_us,p99_us},"tpot":{count,p50_us,p99_us}}
+std::string infer_dump_json(InferScheduler* sched);
+
+// ---- flags / vars ---------------------------------------------------------
+
+struct InferVars {
+  Adder submitted_total;       // infer_submitted_total
+  Adder admitted_total;        // infer_admitted_total
+  Adder shed_total;            // infer_shed_total
+  Adder done_total;            // infer_done_total
+  Adder cancelled_total;       // infer_cancelled_total
+  Adder tokens_total;          // infer_tokens_total
+  Adder steps_total;           // infer_steps_total
+  Adder prefill_tokens_total;  // infer_prefill_tokens_total
+  Adder prefill_cached_tokens_total;  // infer_prefill_cached_tokens_total
+  Adder prefill_bytes_recomputed;     // infer_prefill_bytes_recomputed_total
+  Adder prefill_bytes_cached;         // infer_prefill_bytes_cached_total
+  Adder prefix_fetch_aborted;  // infer_prefix_fetch_aborted_total
+  Adder publish_dedup_total;   // infer_prefix_publish_dedup_total
+  LatencyRecorder ttft;        // infer_ttft (submit → first token, µs)
+  LatencyRecorder tpot;        // infer_tpot (inter-token gap, µs)
+  InferVars();
+};
+InferVars& infer_vars();
+// Registers the trpc_infer_* flags and the vars (idempotent).
+void infer_ensure_registered();
+
+}  // namespace trpc

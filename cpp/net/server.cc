@@ -729,7 +729,7 @@ int Server::serve_handoff(const std::string& path, int64_t deadline_us) {
   cm->cmsg_type = SCM_RIGHTS;
   cm->cmsg_len = CMSG_LEN(sizeof(int) * fds.size());
   memcpy(CMSG_DATA(cm), fds.data(), sizeof(int) * fds.size());
-  const ssize_t sent = ::sendmsg(cfd, &msg, 0);
+  const ssize_t sent = ::sendmsg(cfd, &msg, MSG_NOSIGNAL);
   close(cfd);
   const int rc = sent == static_cast<ssize_t>(sizeof(head)) ? 0 : -1;
   fail(lfd, path);  // close OUR dups + the handoff listener either way

@@ -229,6 +229,14 @@ void Socket::Dereference() {
   }
 }
 
+namespace {
+std::atomic<void (*)(SocketId)> g_failure_observer{nullptr};
+}  // namespace
+
+void Socket::set_failure_observer(void (*cb)(SocketId)) {
+  g_failure_observer.store(cb, std::memory_order_release);
+}
+
 void Socket::SetFailed(int err) {
   bool expect = false;
   if (!failed_.compare_exchange_strong(expect, true,
@@ -236,6 +244,10 @@ void Socket::SetFailed(int err) {
     return;  // already failed
   }
   (void)err;
+  // Captured BEFORE the version bump: this is the id every holder (stream
+  // bindings, pending calls) stored; id() after the bump names the next
+  // incarnation.
+  const SocketId failed_id = id();
   // Bump the version to even FIRST: from this point Address() fails, so the
   // refcount can only drain — the teardown in Dereference can never race a
   // revival (the ordering socket.h:498's versioned-ref pattern exists for).
@@ -246,6 +258,11 @@ void Socket::SetFailed(int err) {
   // Wake any fiber parked on writability so it observes the failure.
   wr_ev_.value.fetch_add(1, std::memory_order_release);
   wr_ev_.wake_all();
+  void (*observer)(SocketId) =
+      g_failure_observer.load(std::memory_order_acquire);
+  if (observer != nullptr) {
+    observer(failed_id);
+  }
   // Drop the owner reference (Create's).
   Dereference();
 }

@@ -59,6 +59,13 @@ class Socket {
   // Marks failed: future Address() fails, fd closed once refs drain, the
   // owner reference is dropped, waiters woken.
   void SetFailed(int err);
+  // Single-slot observer invoked once per socket failure (from whatever
+  // thread called SetFailed), with the PRE-failure id — the generation
+  // holders stored before the version bump invalidated it.  The stream
+  // plane registers here so logical streams bound to a dead connection
+  // close promptly instead of waiting out a write probe (net/stream.cc).
+  // The callback must not park and must tolerate ids it never saw.
+  static void set_failure_observer(void (*cb)(SocketId id));
   // Acquire on both state bits: an observer acting on failed/connected
   // (e.g. skipping ensure_connected) must also see the writes SetFailed
   // or the connect path published before flipping them.

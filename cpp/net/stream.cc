@@ -1,6 +1,9 @@
 #include "net/stream.h"
 
 #include <cerrno>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
 
 #include "base/logging.h"
 #include "base/resource_pool.h"
@@ -58,6 +61,45 @@ struct StreamMeta {
 using StreamPool = ResourcePool<StreamMeta>;
 
 void mark_closed(StreamMeta* m);
+
+// socket id → live StreamIds bound to it, so a connection failure can
+// close its streams eagerly (stream_on_connection_failed).  Bound at
+// establishment (when m->sock is set), unbound at StreamClose.  A plain
+// mutex: establishment/close are per-stream events, not per-frame.
+std::mutex& by_socket_mu() {
+  static std::mutex mu;
+  return mu;
+}
+std::unordered_multimap<uint64_t, StreamId>& by_socket() {
+  // Heap-allocated and intentionally never destroyed: detached consumer
+  // fibers can still be delivering deferred CLOSEs (→ StreamClose →
+  // unbind_socket) while static destructors run at process exit, and an
+  // at-exit teardown of this map races them.
+  static auto* m = new std::unordered_multimap<uint64_t, StreamId>();
+  return *m;
+}
+
+void bind_socket(uint64_t sock, StreamId sid) {
+  if (sock == 0) {
+    return;
+  }
+  std::lock_guard<std::mutex> g(by_socket_mu());
+  by_socket().emplace(sock, sid);
+}
+
+void unbind_socket(uint64_t sock, StreamId sid) {
+  if (sock == 0) {
+    return;
+  }
+  std::lock_guard<std::mutex> g(by_socket_mu());
+  auto range = by_socket().equal_range(sock);
+  for (auto it = range.first; it != range.second; ++it) {
+    if (it->second == sid) {
+      by_socket().erase(it);
+      return;
+    }
+  }
+}
 
 void drop_chunk(IOBuf*& chunk) { delete chunk; }
 
@@ -129,10 +171,34 @@ int consume_handler(void* meta, IOBuf** chunks, size_t n) {
 }
 
 StreamId new_stream(const StreamOptions& opts) {
+  // First stream in the process arms the socket-failure observer so
+  // connection death reaches every bound stream (closes the wedge where a
+  // reader with no pending write never learns the peer died).
+  static const bool hooked = [] {
+    Socket::set_failure_observer(&stream_on_connection_failed);
+    return true;
+  }();
+  (void)hooked;
   StreamMeta* m = nullptr;
   const uint32_t slot = StreamPool::instance()->acquire(&m);
   if (m == nullptr) {
     return 0;
+  }
+  if (m->consume_q != nullptr) {
+    // Previous incarnation's consumer must finish BEFORE any state is
+    // reset, not merely before the queue is reconfigured: a peer CLOSE
+    // sentinel that raced into the queue just ahead of the local
+    // StreamClose is still draining here, and its mark_closed must land
+    // on the old incarnation (where `closed` is already true — a no-op)
+    // rather than close the next stream at birth.  Found as a ~2%
+    // born-closed rate under sequential completion traffic.
+    while (!m->consume_q->idle()) {
+      if (in_fiber()) {
+        fiber_yield();
+      } else {
+        sched_yield();
+      }
+    }
   }
   m->slot = slot;
   m->opts = opts;
@@ -144,17 +210,6 @@ StreamId new_stream(const StreamOptions& opts) {
   m->unacked.store(0, std::memory_order_relaxed);
   m->closed.store(false, std::memory_order_relaxed);
   m->close_ev.value.store(0, std::memory_order_relaxed);
-  if (m->consume_q != nullptr) {
-    // Previous incarnation's consumer must finish before the queue is
-    // reconfigured (frames can't enter: it is stopped).
-    while (!m->consume_q->idle()) {
-      if (in_fiber()) {
-        fiber_yield();
-      } else {
-        sched_yield();
-      }
-    }
-  }
   m->lock();
   if (m->consume_q == nullptr) {
     m->consume_q = new ExecutionQueue<IOBuf*>();
@@ -213,6 +268,7 @@ StreamId accept_one(Controller* cntl, const StreamOptions& opts,
                        std::memory_order_release);
   m->established_ev.value.store(1, std::memory_order_release);
   m->established_ev.wake_all();
+  bind_socket(m->sock, sid);
   return sid;
 }
 
@@ -366,6 +422,7 @@ int StreamClose(StreamId id) {
   // the version under the same lock, so no frame can enter the queue after
   // the bump; the queue itself is persistent (stopped, reused on next
   // incarnation after it drains).
+  const uint64_t sock = m->sock;
   const uint32_t ver = static_cast<uint32_t>(id >> 32);
   m->lock();
   uint32_t expect = ver;
@@ -376,6 +433,7 @@ int StreamClose(StreamId id) {
   }
   m->consume_q->stop();
   m->unlock();
+  unbind_socket(sock, id);
   StreamPool::instance()->release(m->slot);
   return 0;
 }
@@ -461,6 +519,7 @@ void stream_on_accept_response(uint64_t local_sid, uint64_t peer_sid,
                        std::memory_order_release);
   m->established_ev.value.store(1, std::memory_order_release);
   m->established_ev.wake_all();
+  bind_socket(socket_id, local_sid);
 }
 
 uint64_t stream_recv_window(StreamId id) {
@@ -468,8 +527,34 @@ uint64_t stream_recv_window(StreamId id) {
   return m != nullptr ? static_cast<uint64_t>(m->opts.window_bytes) : 0;
 }
 
-void stream_on_connection_failed(uint64_t) {
-  // v1: streams discover death via write failure / close timeout.
+uint64_t stream_send_window(StreamId id) {
+  StreamMeta* m = stream_of(id);
+  if (m == nullptr) {
+    return 0;
+  }
+  const int64_t w = m->send_window.load(std::memory_order_acquire);
+  return w > 0 ? static_cast<uint64_t>(w) : 0;
+}
+
+void stream_on_connection_failed(uint64_t socket_id) {
+  // Snapshot-then-close: mark_closed runs user on_closed callbacks, which
+  // may call StreamClose (unbind takes the same mutex) — never hold the
+  // registry lock across them.
+  std::vector<StreamId> victims;
+  {
+    std::lock_guard<std::mutex> g(by_socket_mu());
+    auto range = by_socket().equal_range(socket_id);
+    for (auto it = range.first; it != range.second; ++it) {
+      victims.push_back(it->second);
+    }
+    by_socket().erase(socket_id);
+  }
+  for (StreamId sid : victims) {
+    StreamMeta* m = stream_of(sid);
+    if (m != nullptr) {
+      mark_closed(m);
+    }
+  }
 }
 
 }  // namespace trpc

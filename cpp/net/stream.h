@@ -77,6 +77,14 @@ void stream_on_accept_response(uint64_t local_sid, uint64_t peer_sid,
                                uint64_t socket_id, uint64_t peer_window);
 // The receive window a local stream grants (advertised to the peer).
 uint64_t stream_recv_window(StreamId id);
+// Remaining send credit (the peer's advertised window minus unacked
+// writes).  0 for unknown/unestablished ids.  The inference scheduler
+// caps per-request token budgets with this so a batch write can never
+// park the shared decode loop on one slow reader.
+uint64_t stream_send_window(StreamId id);
+// Invoked by Socket::SetFailed (registered failure observer): closes
+// every stream bound to the dead connection so readers get on_closed
+// promptly instead of wedging until a write probes the socket.
 void stream_on_connection_failed(uint64_t socket_id);
 
 }  // namespace trpc

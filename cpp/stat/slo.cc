@@ -692,6 +692,11 @@ bool SloEngine::any_breached() const {
   return false;
 }
 
+bool SloEngine::tenant_breached(const std::string& tenant) const {
+  Entry* e = find(tenant);
+  return e != nullptr && snap_entry(e).breached;
+}
+
 size_t SloEngine::tenant_count() const { return entries_.size(); }
 
 }  // namespace trpc

@@ -114,6 +114,11 @@ class SloEngine {
   std::string encode_blob(int64_t wall_us) const;
 
   bool any_breached() const;
+  // Per-tenant burn state for admission planes (net/infer.h): true while
+  // the tenant's clause (or the "*" default) is burning past the alert
+  // threshold on both windows.  Tenants with no clause never read as
+  // breached.
+  bool tenant_breached(const std::string& tenant) const;
   size_t tenant_count() const;
 
   struct Entry;  // opaque per-tenant state

@@ -100,8 +100,23 @@ enum EventType : uint32_t {
   kSloBreach = 28,  // a=tenant hash (slo::tenant_hash, FNV-1a of the
                     // tenant name), b=(op << 56) | burn-rate in milli
                     // (fast window, clamped); ops: 1 breach, 2 clear
+  // -- streamed-inference front door (net/infer.h) -----------------------
+  kTokenStep = 29,  // a=request id, b=(op << 56) | token index; ops:
+                    // kTokenStep* below (admit / prefill-done / token /
+                    // eos / cancel / shed).  The continuous-batching
+                    // scheduler's per-request lifecycle
   kEventTypeCount,
 };
+
+// kTokenStep b-field ops (high byte).  For kTokenStepAdmit the low bits
+// carry the prefix-cache-matched token count instead of a token index;
+// for kTokenStepShed they carry the shed reason (the error code).
+constexpr uint64_t kTokenStepAdmit = 1;
+constexpr uint64_t kTokenStepPrefillDone = 2;
+constexpr uint64_t kTokenStepToken = 3;
+constexpr uint64_t kTokenStepEos = 4;
+constexpr uint64_t kTokenStepCancel = 5;
+constexpr uint64_t kTokenStepShed = 6;
 
 // kDeadline b-field ops (high byte).
 constexpr uint64_t kDeadlineShedPreDispatch = 1;  // detail=stamped budget µs
@@ -142,6 +157,7 @@ constexpr const char* kEventNames[] = {
     "capture",         // timeline-event 26 (capture)
     "coll_ready",      // timeline-event 27 (coll_ready)
     "slo_breach",      // timeline-event 28 (slo_breach)
+    "token_step",      // timeline-event 29 (token_step)
 };
 static_assert(sizeof(kEventNames) / sizeof(kEventNames[0]) ==
                   kEventTypeCount,

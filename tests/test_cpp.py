@@ -39,7 +39,7 @@ _NO_CMAKE = shutil.which("cmake") is None or shutil.which("ctest") is None
 TSAN_SUITES = [
     "fiber", "rpc", "stream", "shm", "ici", "chaos", "stat", "qos",
     "stripe", "analysis", "timeline", "rma", "kvstore", "naming",
-    "collective", "tuner", "deadline", "capture", "slo",
+    "collective", "tuner", "deadline", "capture", "slo", "infer",
 ]
 ALL_SUITES = sorted(
     p.stem[len("test_"):] for p in (REPO / "cpp" / "tests").glob("test_*.cc")
@@ -254,6 +254,29 @@ def test_kvstore_cpp_suite_native():
     whole-or-nothing composition."""
     _run_native_suite("test_kvstore.cc", "test_kvstore_native",
                       "kvstore suite")
+
+
+def test_stream_cpp_suite_native():
+    """ISSUE 20 satellite: the streaming plane gates tier-1 directly —
+    establish over a normal RPC, strict chunk ordering, credit-window
+    backpressure throttling a fast writer against a slow consumer,
+    batch offer/accept, and failed-call/unaccepted-offer cleanup (the
+    multiplexing substrate the inference front door rides)."""
+    _run_native_suite("test_stream.cc", "test_stream_native",
+                      "stream suite")
+
+
+def test_infer_cpp_suite_native():
+    """ISSUE 20: the streamed-inference front door gates tier-1 —
+    end-to-end token streams with EOS, continuous batching (mid-flight
+    join/leave without idling a slot), prefix-cache prefill skipping
+    recompute, deadline expiry and client close cancelling mid-stream,
+    the chaos disconnect-under-svr_delay case (prefix fetches abort
+    whole-or-nothing, deadline_cancel_saved_bytes credited, nothing
+    wedged), per-tenant typed shedding, flag bounds, and token_step
+    timeline events."""
+    _run_native_suite("test_infer.cc", "test_infer_native",
+                      "infer suite")
 
 
 # Wall-clock-window cases (the p99 guards) stay native under sanitizer

@@ -738,6 +738,450 @@ def run_rr_kvpuller(args) -> None:
     }), flush=True)
 
 
+# ---- streamed-inference roles (ISSUE 20) ---------------------------------
+#
+# The 100k-LOGICAL-STREAM proof: the conn orchestrator above spends one fd
+# per connection, so its scale is fd-bound; the inference front door
+# multiplexes thousands of token streams per connection, so the SAME box
+# (20k fd cap) holds 100k+ concurrent completions.  Four phases against
+# one serving process:
+#
+#   ramp     hold-workers submit completions against a parked scheduler
+#            (step_us maxed, batch_max=1): every accepted submit holds a
+#            live logical stream while the server's fd count stays at a
+#            handful of connections.  Peak streams + /proc fd count are
+#            the headline numbers.
+#   drain    flip the RELOADABLE knobs (step_us=0 drain mode, batch_max
+#            wide) and every held stream must decode to EOS — zero
+#            wedged at scale.
+#   serving  steady-state TTFT/TPOT with a hot prompt pool through the
+#            prefix cache (cached prompt blocks skip recompute).
+#   overload hog tenant offers ~2x the admission cap; every hog failure
+#            must be TYPED (2005/2007) and the in-SLO victim tenant's
+#            TPOT p99 must stay within 2x its unloaded value.
+
+def run_infer_server(args) -> None:
+    raise_fd_limit(args.fd_cap + 8192)
+    sys.path.insert(0, str(REPO))
+    from brpc_tpu.rpc import Server, observe, set_flag
+
+    set_flag("trpc_event_dispatchers", str(args.dispatchers))
+    for spec in args.flags.split(","):
+        if spec:
+            k, v = spec.split("=", 1)
+            set_flag(k, v)
+    srv = Server()
+    if args.qos:
+        srv.set_qos(args.qos)
+    srv.enable_infer(prefix_cache=True)
+    srv.start(0)
+    print(json.dumps({"port": srv.port, "pid": os.getpid()}), flush=True)
+
+    def stats() -> dict:
+        d = srv.infer_dump()
+        vars_ = observe.Vars.dump()
+        # The fd-cap proof: every open fd of the SERVING process while
+        # it holds the full stream population.
+        d["fds"] = len(os.listdir("/proc/self/fd"))
+        d["rss_kb"] = vars_.get("process_memory_rss_kb", 0)
+        d["live_sockets"] = vars_.get("rpc_socket_live", 0)
+        return d
+
+    for line in sys.stdin:
+        parts = line.strip().split(" ", 1)
+        if parts[0] == "stats":
+            print(json.dumps(stats()), flush=True)
+        elif parts[0] == "flags" and len(parts) == 2:
+            # Reposture between phases without restarting (every
+            # trpc_infer_* knob is reloadable).
+            for spec in parts[1].split(","):
+                k, v = spec.split("=", 1)
+                set_flag(k, v)
+            print(json.dumps({"ok": True}), flush=True)
+        elif parts[0] == "quit":
+            break
+    print(json.dumps(stats()), flush=True)
+    srv.close()
+
+
+def run_infer_hold(args) -> None:
+    """Submits --streams completions over --channels connections and
+    HOLDS them (the scheduler is parked), then drains every one to EOS
+    on the orchestrator's signal.  Token ids are worker-unique so no
+    prompt accidentally prefix-matches another's."""
+    sys.path.insert(0, str(REPO))
+    from brpc_tpu.rpc import Channel, InferClient
+
+    addr = f"{args.host}:{args.port}"
+    chans = [Channel(addr, timeout_ms=600000)
+             for _ in range(max(1, args.channels))]
+    clients = [InferClient(ch) for ch in chans]
+    held = []
+    failed = 0
+    base = (args.index + 1) * 10_000_000
+    for i in range(args.streams):
+        prompt = [base + i * 4 + j for j in range(4)]
+        try:
+            # A 10-minute budget: the submit's wire deadline is the
+            # stream's cancel budget, and the hold phase must outlive
+            # the whole ramp across every worker.
+            held.append(clients[i % len(clients)].submit(
+                prompt, max_new_tokens=2, publish=False,
+                timeout_ms=600000))
+        except Exception:
+            failed += 1
+    print(json.dumps({"submitted": len(held), "failed": failed}),
+          flush=True)
+
+    sys.stdin.readline()  # orchestrator says the scheduler is draining
+    eos = cancelled = errors = 0
+    for comp in held:
+        try:
+            last = None
+            for rec in comp.records(timeout_ms=300000):
+                last = rec
+            if last is not None and last.eos:
+                eos += 1
+            elif comp.cancelled:
+                cancelled += 1
+            else:
+                errors += 1
+        except Exception:
+            errors += 1
+        comp.close()
+    print(json.dumps({"eos": eos, "cancelled": cancelled,
+                      "errors": errors}), flush=True)
+    for ch in chans:
+        ch.close()
+
+
+def run_infer_serve(args) -> None:
+    """Closed-loop completion traffic for --seconds: submit from a hot
+    prompt pool (shared across workers, so the prefix cache converges),
+    consume every token, record client-observed TTFT and TPOT."""
+    sys.path.insert(0, str(REPO))
+    from brpc_tpu.rpc import (Channel, DeadlineExpiredError, InferClient,
+                              OverloadedError)
+
+    ch = Channel(f"{args.host}:{args.port}", timeout_ms=30000)
+    cli = InferClient(ch, tenant=args.tenant, priority=args.priority)
+    rng = random.Random(args.index + 7)
+    pool = [[1000 + p * 1000 + t for t in range(args.prompt_tokens)]
+            for p in range(args.pool)]
+    ttft, tpot = [], []
+    done = cancelled = typed = untyped = 0
+    end = time.monotonic() + args.seconds
+    while time.monotonic() < end:
+        prompt = pool[rng.randrange(len(pool))]
+        t0 = time.monotonic()
+        try:
+            comp = cli.submit(prompt, max_new_tokens=args.max_new,
+                              timeout_ms=20000)
+        except (OverloadedError, DeadlineExpiredError):
+            typed += 1
+            time.sleep(0.002)
+            continue
+        except Exception:
+            untyped += 1
+            continue
+        prev = None
+        try:
+            for _rec in comp.records(timeout_ms=20000):
+                now = time.monotonic()
+                if prev is None:
+                    ttft.append((now - t0) * 1e6)
+                else:
+                    tpot.append((now - prev) * 1e6)
+                prev = now
+            if comp.cancelled:
+                cancelled += 1
+            else:
+                done += 1
+        except Exception:
+            untyped += 1
+        comp.close()
+    ch.close()
+    print(json.dumps({"done": done, "cancelled": cancelled,
+                      "typed_errors": typed, "untyped_errors": untyped,
+                      "ttft_us": [round(v) for v in ttft],
+                      "tpot_us": [round(v) for v in tpot]}), flush=True)
+
+
+def run_infer_flood(args) -> None:
+    """The hog tenant: tries to hold --hold-streams concurrent
+    completions (sized ~2x the admission cap by the orchestrator) for
+    --seconds.  Every rejection must be TYPED — an untyped failure here
+    is an isolation bug, not load."""
+    sys.path.insert(0, str(REPO))
+    from brpc_tpu.rpc import (Channel, DeadlineExpiredError, InferClient,
+                              OverloadedError)
+    from brpc_tpu.rpc.infer import CancelledError
+
+    ch = Channel(f"{args.host}:{args.port}", timeout_ms=30000)
+    cli = InferClient(ch, tenant=args.tenant, priority=args.priority)
+    held = []
+    admitted = typed = untyped = 0
+    base = 900_000_000 + args.index * 1_000_000
+    n = 0
+    end = time.monotonic() + args.seconds
+    while time.monotonic() < end:
+        if len(held) < args.hold_streams:
+            n += 1
+            prompt = [base + n * 4 + j for j in range(4)]
+            try:
+                held.append(cli.submit(prompt, max_new_tokens=4,
+                                       publish=False, timeout_ms=15000))
+                admitted += 1
+            except (OverloadedError, DeadlineExpiredError):
+                typed += 1
+                time.sleep(0.005)
+            except Exception:
+                untyped += 1
+            continue
+        comp = held.pop(0)
+        try:
+            for _rec in comp.records(timeout_ms=20000):
+                pass
+        except CancelledError:
+            typed += 1  # deadline-reaped mid-decode: typed cancel
+        except Exception:
+            untyped += 1
+        comp.close()
+    for comp in held:
+        comp.close()
+    ch.close()
+    print(json.dumps({"admitted": admitted, "typed": typed,
+                      "untyped": untyped}), flush=True)
+
+
+def run_infer_orchestrator(args) -> int:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    me = str(pathlib.Path(__file__).resolve())
+    t0 = time.monotonic()
+    target = args.infer_streams
+    per_worker = (target + args.workers - 1) // args.workers
+    queue_max = min(1_000_000, target + 1024)
+
+    # Ramp posture: park the scheduler (10s ticks, batch of 1) so every
+    # accepted submit HOLDS its stream in the waiting queue.
+    ramp_flags = (f"trpc_infer_step_us=10000000,trpc_infer_batch_max=1,"
+                  f"trpc_infer_queue_max={queue_max},"
+                  f"trpc_infer_prefill_us_per_token=0")
+    server = subprocess.Popen(
+        [sys.executable, me, "--role", "infer-server",
+         "--dispatchers", str(args.dispatchers),
+         "--fd-cap", str(args.fd_cap),
+         "--qos", "victim:weight=4;hog:weight=1",
+         "--flags", ramp_flags],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env, text=True)
+    boot = server.stdout.readline()
+    try:
+        port = json.loads(boot)["port"]
+    except (json.JSONDecodeError, KeyError):
+        print(f"infer server failed to start: {boot!r}", file=sys.stderr)
+        server.kill()
+        return 1
+
+    def ask(cmd: str) -> dict:
+        server.stdin.write(cmd + "\n")
+        server.stdin.flush()
+        return json.loads(server.stdout.readline())
+
+    def spawn_worker(role: str, *extra: str) -> subprocess.Popen:
+        return subprocess.Popen(
+            [sys.executable, me, "--role", role, "--host", "127.0.0.1",
+             "--port", str(port), *extra],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env,
+            text=True)
+
+    # -- phase 1: ramp ----------------------------------------------------
+    holders = [spawn_worker("infer-hold", "--index", str(i),
+                            "--streams", str(per_worker),
+                            "--channels", str(args.channels))
+               for i in range(args.workers)]
+    ramp = []
+    for w in holders:
+        line = w.stdout.readline()
+        try:
+            ramp.append(json.loads(line))
+        except json.JSONDecodeError:
+            ramp.append({"submitted": 0, "failed": per_worker})
+    peak = ask("stats")  # all workers still hold their streams
+
+    # -- phase 2: drain ---------------------------------------------------
+    ask("flags trpc_infer_step_us=0,trpc_infer_batch_max=65536")
+    for w in holders:
+        w.stdin.write("drain\n")
+        w.stdin.flush()
+    drained = []
+    for w in holders:
+        line = w.stdout.readline()
+        try:
+            drained.append(json.loads(line))
+        except json.JSONDecodeError:
+            drained.append({"eos": 0, "cancelled": 0,
+                            "errors": per_worker})
+    for w in holders:
+        w.wait(timeout=60)
+    post_drain = ask("stats")
+
+    submitted = sum(r.get("submitted", 0) for r in ramp)
+    submit_failed = sum(r.get("failed", 0) for r in ramp)
+    eos = sum(r.get("eos", 0) for r in drained)
+    wedged = submitted - eos
+
+    def pctls(rows: list) -> dict:
+        ttft = [v for r in rows for v in r.get("ttft_us", [])]
+        tpot = [v for r in rows for v in r.get("tpot_us", [])]
+        return {
+            "done": sum(r.get("done", 0) for r in rows),
+            "cancelled": sum(r.get("cancelled", 0) for r in rows),
+            "typed_errors": sum(r.get("typed_errors", 0) for r in rows),
+            "untyped_errors": sum(r.get("untyped_errors", 0)
+                                  for r in rows),
+            "ttft_p50_us": round(_percentile(ttft, 0.50)),
+            "ttft_p99_us": round(_percentile(ttft, 0.99)),
+            "tpot_p50_us": round(_percentile(tpot, 0.50)),
+            "tpot_p99_us": round(_percentile(tpot, 0.99)),
+            "tpot_samples": len(tpot),
+        }
+
+    def serve_phase(n: int, seconds: float, tenant: str) -> list:
+        ws = [spawn_worker("infer-serve", "--index", str(i),
+                           "--seconds", str(seconds), "--tenant", tenant,
+                           "--max-new", str(args.max_new),
+                           "--prompt-tokens", str(args.prompt_tokens),
+                           "--pool", str(args.pool))
+              for i in range(n)]
+        rows = []
+        for w in ws:
+            line = w.stdout.readline()
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                rows.append({"untyped_errors": 1})
+            w.wait(timeout=60)
+        return rows
+
+    # -- phase 3: steady serving through the prefix cache -----------------
+    dump_before = ask("stats")
+    ask(f"flags trpc_infer_step_us={args.step_us},"
+        f"trpc_infer_batch_max=256,"
+        f"trpc_infer_prefill_us_per_token={args.prefill_us},"
+        f"trpc_kv_prefix_block_tokens=8")
+    serve_rows = pctls(serve_phase(args.serve_workers, args.seconds,
+                                   "victim"))
+    dump_serve = ask("stats")
+    d_cached = dump_serve["bytes_cached"] - dump_before["bytes_cached"]
+    d_recomp = (dump_serve["bytes_recomputed"] -
+                dump_before["bytes_recomputed"])
+    d_tokens = dump_serve["tokens"] - dump_before["tokens"]
+    serving = dict(serve_rows)
+    serving.update({
+        "seconds": args.seconds,
+        "tokens_per_s": round(d_tokens / max(args.seconds, 0.001)),
+        "recompute_ratio_cached": round(
+            d_cached / max(d_cached + d_recomp, 1), 4),
+        # Server-side recorders span the ramp/drain phases too (a held
+        # stream's TTFT is its park time), so the row's TTFT/TPOT are
+        # the client-measured serving-phase numbers above; the recorder
+        # count is kept as a liveness cross-check only.
+        "server_tpot_count": dump_serve["tpot"]["count"],
+    })
+
+    # -- phase 4: overload (hog at ~2x the admission cap) -----------------
+    # A coarser decode tick than the serving phase: the ratio compares
+    # loaded vs unloaded TPOT, and on small CI boxes a 1ms tick is mostly
+    # scheduler oversleep once flooders burn the spare core — which would
+    # measure the BOX, not the admission plane.  Both halves of the ratio
+    # run the same tick, so the comparison stays honest.
+    cap = 16
+    ask(f"flags trpc_infer_batch_max=8,trpc_infer_queue_max=8,"
+        f"trpc_infer_step_us={args.overload_step_us}")
+    unloaded = pctls(serve_phase(max(1, args.serve_workers // 2),
+                                 max(3.0, args.seconds / 2), "victim"))
+    floods = [spawn_worker("infer-flood", "--index", str(i),
+                           "--seconds", str(args.seconds),
+                           "--tenant", "hog",
+                           "--hold-streams", str(cap))
+              for i in range(args.flood_workers)]
+    time.sleep(0.5)  # flooders reach the admission wall first
+    loaded = pctls(serve_phase(max(1, args.serve_workers // 2),
+                               max(3.0, args.seconds / 2), "victim"))
+    flood_rows = []
+    for w in floods:
+        line = w.stdout.readline()
+        try:
+            flood_rows.append(json.loads(line))
+        except json.JSONDecodeError:
+            flood_rows.append({"untyped": 1})
+        w.wait(timeout=60)
+    overload = {
+        "admission_cap": cap,
+        "step_us": args.overload_step_us,
+        "hog_workers": args.flood_workers,
+        "hog_hold_target": cap * args.flood_workers,
+        "hog_admitted": sum(r.get("admitted", 0) for r in flood_rows),
+        "hog_typed": sum(r.get("typed", 0) for r in flood_rows),
+        "hog_untyped": sum(r.get("untyped", 0) for r in flood_rows),
+        "victim_unloaded_tpot_p99_us": unloaded["tpot_p99_us"],
+        "victim_loaded_tpot_p99_us": loaded["tpot_p99_us"],
+        "victim_tpot_ratio_p99": round(
+            loaded["tpot_p99_us"] / max(unloaded["tpot_p99_us"], 1), 3),
+        "victim_done_loaded": loaded["done"],
+        "victim_untyped": (unloaded["untyped_errors"] +
+                           loaded["untyped_errors"]),
+        "shed_total": ask("stats")["shed"],
+    }
+
+    final = ask("stats")
+    server.stdin.write("quit\n")
+    server.stdin.flush()
+    json.loads(server.stdout.readline())
+    server.wait(timeout=60)
+
+    summary = {
+        "workload": "infer_serving",
+        "streams_target": target,
+        "streams_submitted": submitted,
+        "streams_peak": peak["streams_live"],
+        "streams_peak_hwm": peak["streams_peak"],
+        "submit_failed": submit_failed,
+        "eos": eos,
+        "wedged": wedged,
+        "drain_cancelled": sum(r.get("cancelled", 0) for r in drained),
+        "drain_errors": sum(r.get("errors", 0) for r in drained),
+        "post_drain_live": post_drain["streams_live"],
+        "server_fds_peak": peak["fds"],
+        "server_conns_peak": peak["live_sockets"],
+        "fd_cap": args.fd_cap,
+        "rss_kb_peak": peak["rss_kb"],
+        "workers": args.workers,
+        "channels_per_worker": args.channels,
+        "serving": serving,
+        "overload": overload,
+        "knobs": {"step_us": args.step_us, "max_new": args.max_new,
+                  "prompt_tokens": args.prompt_tokens,
+                  "pool": args.pool,
+                  "prefill_us_per_token": args.prefill_us,
+                  "block_tokens": 8,
+                  "dispatchers": args.dispatchers},
+        "elapsed_s": round(time.monotonic() - t0, 1),
+        "final_cancelled": final["cancelled"],
+    }
+    print(json.dumps(summary, indent=None if args.json else 2),
+          flush=True)
+    ok = (submit_failed == 0 and wedged == 0 and
+          summary["streams_peak"] >= target and
+          summary["server_fds_peak"] < args.fd_cap and
+          serving["untyped_errors"] == 0 and
+          overload["hog_untyped"] == 0 and
+          overload["victim_untyped"] == 0)
+    return 0 if ok else 1
+
+
 def run_rolling_restart(args) -> int:
     raise_fd_limit(8192)
     env = dict(os.environ)
@@ -975,8 +1419,50 @@ def main() -> int:
     ap.add_argument("--role",
                     choices=["orchestrator", "server", "worker", "rr-hub",
                              "rr-node", "rr-succ", "rr-worker",
-                             "rr-kvpuller"],
+                             "rr-kvpuller", "infer-server", "infer-hold",
+                             "infer-serve", "infer-flood"],
                     default="orchestrator")
+    ap.add_argument("--infer", action="store_true",
+                    help="ISSUE 20 acceptance cycle: ramp 100k logical "
+                         "token streams over a handful of connections "
+                         "(fd proof), drain every one to EOS, measure "
+                         "TTFT/TPOT through the prefix cache, then shed "
+                         "a 2x-overloaded hog tenant typed-only")
+    ap.add_argument("--infer-streams", type=int, default=100_000,
+                    help="concurrent logical token streams to hold")
+    ap.add_argument("--channels", type=int, default=2,
+                    help="connections per hold worker (streams "
+                         "multiplex; the whole point is channels << "
+                         "streams)")
+    ap.add_argument("--streams", type=int, default=0,
+                    help="(infer-hold role) completions this worker "
+                         "submits and holds")
+    ap.add_argument("--serve-workers", type=int, default=4)
+    ap.add_argument("--flood-workers", type=int, default=2)
+    ap.add_argument("--hold-streams", type=int, default=16,
+                    help="(infer-flood role) concurrent completions the "
+                         "hog tries to keep in flight")
+    ap.add_argument("--max-new", type=int, default=16,
+                    help="decode tokens per serving-phase completion")
+    ap.add_argument("--prompt-tokens", type=int, default=32)
+    ap.add_argument("--pool", type=int, default=8,
+                    help="hot prompts shared across serve workers (the "
+                         "prefix cache converges on these)")
+    ap.add_argument("--step-us", type=int, default=1000,
+                    help="serving-phase decode tick (trpc_infer_step_us)")
+    ap.add_argument("--overload-step-us", type=int, default=5000,
+                    help="overload-phase decode tick (coarser: the "
+                         "loaded/unloaded TPOT ratio must measure "
+                         "admission isolation, not scheduler oversleep "
+                         "on a saturated box)")
+    ap.add_argument("--prefill-us", type=int, default=5,
+                    help="serving-phase trpc_infer_prefill_us_per_token")
+    ap.add_argument("--fd-cap", type=int, default=20_000,
+                    help="the box's fd ceiling the stream proof must "
+                         "stay under")
+    ap.add_argument("--flags", default="",
+                    help="(infer-server role) comma-joined k=v flags set "
+                         "before the server starts")
     ap.add_argument("--rolling-restart", action="store_true",
                     help="ISSUE 12 acceptance cycle: drain + hot-restart "
                          "one node of a 3-node naming-backed cluster "
@@ -1032,7 +1518,12 @@ def main() -> int:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     args = ap.parse_args()
-    if args.smoke:
+    if args.smoke and args.infer:
+        args.infer_streams = min(args.infer_streams, 4000)
+        args.workers = min(args.workers, 4)
+        args.seconds = min(args.seconds, 4.0)
+        args.serve_workers = min(args.serve_workers, 2)
+    elif args.smoke:
         args.conns = min(args.conns, 2000)
         args.workers = min(args.workers, 4)
         args.timeout = min(args.timeout, 60.0)
@@ -1063,6 +1554,20 @@ def main() -> int:
     if args.role == "rr-kvpuller":
         run_rr_kvpuller(args)
         return 0
+    if args.role == "infer-server":
+        run_infer_server(args)
+        return 0
+    if args.role == "infer-hold":
+        run_infer_hold(args)
+        return 0
+    if args.role == "infer-serve":
+        run_infer_serve(args)
+        return 0
+    if args.role == "infer-flood":
+        run_infer_flood(args)
+        return 0
+    if args.infer:
+        return run_infer_orchestrator(args)
     if args.rolling_restart:
         return run_rolling_restart(args)
     return run_orchestrator(args)

@@ -194,6 +194,16 @@ _TL_TUNER_TID = 990000
 # burning, next to the fibers and rails that caused it.
 _TL_SLO_TID = 991000
 _TL_SLO_OPS = {1: "breach", 2: "clear"}
+# token_step events (net/infer.h): one instant per continuous-batching
+# scheduler transition on its own per-node "inference" track — a =
+# request id, b = op << 56 | low bits (TIMELINE_TOKEN_OPS mirror:
+# admit carries prefix-cache-matched tokens, token carries the token
+# index, eos/cancel carry tokens emitted, shed carries the error
+# code) — so a serving trace shows requests joining/leaving the batch
+# and every decode step next to the streams that carried the tokens.
+_TL_TOKEN_TID = 992000
+_TL_TOKEN_OPS = {1: "admit", 2: "prefill_done", 3: "token", 4: "eos",
+                 5: "cancel", 6: "shed"}
 
 
 def _timeline_chrome_events(pid: int, dump: dict, base: float,
@@ -322,6 +332,20 @@ def _timeline_chrome_events(pid: int, dump: dict, base: float,
                     "pid": pid, "tid": out_tid, "ts": ts,
                     "args": {"tenant_hash": e["a"],
                              "burn_fast_milli": b & ((1 << 56) - 1),
+                             "trace_id": e["trace_id"],
+                             "span_id": e["span_id"], "fid": e["fid"]},
+                })
+                continue
+            if name == "token_step":
+                b = int(e["b"], 16)
+                op = b >> 56
+                out_tid = track(_TL_TOKEN_TID, "inference")
+                events.append({
+                    "ph": "i", "s": "t", "cat": "timeline",
+                    "name": f"infer_{_TL_TOKEN_OPS.get(op, op)}",
+                    "pid": pid, "tid": out_tid, "ts": ts,
+                    "args": {"request_id": e["a"],
+                             "value": b & ((1 << 56) - 1),
                              "trace_id": e["trace_id"],
                              "span_id": e["span_id"], "fid": e["fid"]},
                 })
