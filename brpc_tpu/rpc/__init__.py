@@ -28,6 +28,7 @@ from brpc_tpu.rpc.rma import RmaBuffer, kernel_supports  # noqa: F401
 from brpc_tpu.rpc.server import Call, Server  # noqa: F401
 from brpc_tpu.rpc.stream import (  # noqa: F401
     Stream,
+    StreamChunkTooLargeError,
     StreamClosedError,
     StreamTimeoutError,
     open_stream,

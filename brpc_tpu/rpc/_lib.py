@@ -771,6 +771,8 @@ def load_library() -> ctypes.CDLL:
                 ctypes.c_int64,
             ]
             lib.trpc_stream_read.restype = ctypes.c_long
+            lib.trpc_stream_next_len.argtypes = [ctypes.c_void_p]
+            lib.trpc_stream_next_len.restype = ctypes.c_long
             lib.trpc_stream_write.argtypes = [
                 ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
             ]

@@ -105,7 +105,11 @@ class Completion:
         """Yields TokenRecords until the EOS or CANCELLED record
         (inclusive), or until the stream closes without one (connection
         death — surfaces as plain StopIteration after marking
-        cancelled)."""
+        cancelled).  A chunk that is not exactly one TokenRecord is a
+        protocol desync and raises: an oversized chunk (e.g. a widened
+        record from a newer server) surfaces StreamChunkTooLargeError
+        from the read, a short one raises ValueError — never silently
+        skipped, which would desynchronize the token stream."""
         while not self.finished:
             try:
                 chunk = self.stream.read(max_bytes=_TOKEN_RECORD.size,
@@ -114,8 +118,11 @@ class Completion:
                 self.finished = True
                 self.cancelled = True
                 return
-            if len(chunk) < _TOKEN_RECORD.size:
-                continue  # not a token record; tolerate and keep reading
+            if len(chunk) != _TOKEN_RECORD.size:
+                self.finished = True
+                raise ValueError(
+                    f"request {self.request_id}: malformed token record "
+                    f"({len(chunk)} bytes, expected {_TOKEN_RECORD.size})")
             rec = TokenRecord(*_TOKEN_RECORD.unpack(chunk))
             if rec.eos or rec.cancelled:
                 self.finished = True

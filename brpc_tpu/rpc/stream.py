@@ -42,6 +42,21 @@ class StreamTimeoutError(RpcError):
         self.stream_id = stream_id
 
 
+class StreamChunkTooLargeError(RpcError):
+    """The next buffered chunk is larger than read()'s max_bytes.
+    NOTHING was consumed or truncated — the chunk stays queued; retry
+    with max_bytes >= .needed (silently dropping the tail would
+    desynchronize framed readers without any error)."""
+
+    def __init__(self, stream_id: int, needed: int, cap: int):
+        super().__init__(
+            0, f"stream {stream_id} next chunk is {needed} bytes but the "
+               f"read buffer holds only {cap}")
+        self.stream_id = stream_id
+        self.needed = needed
+        self.cap = cap
+
+
 class Stream:
     """One end of an established stream.  Wraps the capi handle; close()
     is graceful (buffered chunks stay readable on the peer), __del__
@@ -57,11 +72,12 @@ class Stream:
         return int(self._lib.trpc_stream_id(self._handle))
 
     def read(self, max_bytes: int = 65536, timeout_ms: int = -1) -> bytes:
-        """One ordered chunk (chunks never coalesce or split).  Bytes
-        beyond max_bytes are DROPPED — size to the protocol's chunk
-        bound.  timeout_ms < 0 waits forever.  Raises StreamClosedError
-        once the stream is closed and drained, StreamTimeoutError on
-        timeout."""
+        """One ordered chunk (chunks never coalesce, split, or
+        truncate).  timeout_ms < 0 waits forever.  Raises
+        StreamClosedError once the stream is closed and drained,
+        StreamTimeoutError on timeout, and StreamChunkTooLargeError
+        when the next chunk exceeds max_bytes — the chunk stays queued,
+        so retry with max_bytes >= the error's .needed."""
         if self._handle is None:
             raise StreamClosedError(0)
         buf = ctypes.create_string_buffer(max_bytes)
@@ -71,7 +87,10 @@ class Stream:
             raise StreamClosedError(self.id)
         if n == -2:
             raise StreamTimeoutError(self.id, timeout_ms)
-        return buf.raw[:min(n, max_bytes)]
+        if n == -3:
+            needed = int(self._lib.trpc_stream_next_len(self._handle))
+            raise StreamChunkTooLargeError(self.id, needed, max_bytes)
+        return buf.raw[:n]
 
     def write(self, data: bytes) -> None:
         """Ordered write; parks while the peer's credit window is

@@ -138,10 +138,13 @@ void* trpc_call_stream_accept(void* call_handle, int64_t window_bytes) {
   return new CStreamPtr(std::move(cs));
 }
 
-// Blocking read of ONE chunk: returns the chunk's full length (bytes
-// beyond `cap` are DROPPED — size buffers to the protocol's chunk bound),
-// -1 when the stream is closed and drained, -2 on timeout (timeout_ms
-// < 0 waits forever).
+// Blocking read of ONE chunk: returns the chunk's length (always <=
+// `cap` — the chunk is copied whole or not at all), -1 when the stream
+// is closed and drained, -2 on timeout (timeout_ms < 0 waits forever),
+// -3 when the next chunk is LARGER than `cap`.  A -3 chunk stays queued
+// and nothing is consumed: query trpc_stream_next_len and retry with a
+// buffer that fits — silent truncation would desynchronize framed
+// readers (e.g. fixed-size TokenRecord streams) without any error.
 long trpc_stream_read(void* h, char* buf, size_t cap, int64_t timeout_ms) {
   const CStreamPtr& cs = of(h);
   std::unique_lock<std::mutex> g(cs->mu);
@@ -156,14 +159,25 @@ long trpc_stream_read(void* h, char* buf, size_t cap, int64_t timeout_ms) {
   if (cs->chunks.empty()) {
     return -1;  // closed and drained
   }
+  if (cs->chunks.front().size() > cap) {
+    return -3;  // caller's buffer too small; chunk left queued
+  }
   std::string chunk = std::move(cs->chunks.front());
   cs->chunks.pop_front();
   g.unlock();
-  const size_t n = chunk.size() < cap ? chunk.size() : cap;
-  if (buf != nullptr && n > 0) {
-    memcpy(buf, chunk.data(), n);
+  if (buf != nullptr && !chunk.empty()) {
+    memcpy(buf, chunk.data(), chunk.size());
   }
   return static_cast<long>(chunk.size());
+}
+
+// Length of the next buffered chunk (bytes), -1 when none is buffered.
+// Pairs with a -3 read: resize and retry without losing the chunk.
+long trpc_stream_next_len(void* h) {
+  const CStreamPtr& cs = of(h);
+  std::lock_guard<std::mutex> g(cs->mu);
+  return cs->chunks.empty() ? -1
+                            : static_cast<long>(cs->chunks.front().size());
 }
 
 // Ordered write; parks while the peer's credit window is exhausted.

@@ -166,6 +166,15 @@ struct InferReq {
 
 using ReqPtr = std::shared_ptr<InferReq>;
 
+// Tracks detached prefix-fetch fibers so stop() can wait for them to
+// retire.  Shared (not scheduler-owned): a retiring fiber touches ONLY
+// this block after its decrement, so the scheduler may be freed the
+// moment inflight hits zero even if the fiber hasn't returned yet.
+struct FetchDrain {
+  std::atomic<int64_t> inflight{0};
+  Event ev;  // bumped on every retirement
+};
+
 }  // namespace
 
 // ---- scheduler ------------------------------------------------------------
@@ -196,6 +205,19 @@ class InferScheduler {
     if (loop_started_) {
       fiber_join(loop_fid_);
     }
+    // The loop's teardown cancelled every request scope, so in-flight
+    // fetch fibers abort promptly — but they hold a raw scheduler
+    // pointer and may still be inside CallMethod on fetch_ch_ or waking
+    // work_ev_.  Wait for every one to retire before anything is freed.
+    while (true) {
+      const uint32_t snap =
+          fetch_drain_->ev.value.load(std::memory_order_acquire);
+      if (fetch_drain_->inflight.load(std::memory_order_acquire) == 0) {
+        break;
+      }
+      fetch_drain_->ev.wait(snap, monotonic_time_us() + 50 * 1000);
+    }
+    std::lock_guard<std::mutex> g(fetch_ch_mu_);
     if (fetch_ch_ != nullptr) {
       delete fetch_ch_;
       fetch_ch_ = nullptr;
@@ -300,7 +322,7 @@ class InferScheduler {
   void publish_blocks(const ReqPtr& r);
   bool step_request(const ReqPtr& r, int64_t now);
   void finish(const ReqPtr& r, bool cancelled);
-  void drop_live(const ReqPtr& r);
+  void release_slot(const std::string& tenant);
 
   Server* srv_;
   InferOptions opts_;
@@ -323,6 +345,7 @@ class InferScheduler {
 
   std::mutex fetch_ch_mu_;
   Channel* fetch_ch_ = nullptr;
+  std::shared_ptr<FetchDrain> fetch_drain_ = std::make_shared<FetchDrain>();
 };
 
 void InferScheduler::submit(Controller* cntl, const IOBuf& req, IOBuf* resp,
@@ -360,6 +383,18 @@ void InferScheduler::submit(Controller* cntl, const IOBuf& req, IOBuf* resp,
       shed(cntl, tenant);
       done();
       return;
+    }
+    // Reserve the slot in the SAME critical section as the cap/share
+    // check: N concurrent submits would otherwise all pass the check
+    // before any increment lands, overshooting batch+queue and the
+    // per-tenant shares.  Failure paths below release the reservation.
+    tenant_live_[tenant] += 1;
+    const int64_t now_live =
+        streams_live_.fetch_add(1, std::memory_order_acq_rel) + 1;
+    int64_t peak = streams_peak_.load(std::memory_order_relaxed);
+    while (now_live > peak &&
+           !streams_peak_.compare_exchange_weak(peak, now_live,
+                                                std::memory_order_acq_rel)) {
     }
   }
 
@@ -423,19 +458,32 @@ void InferScheduler::submit(Controller* cntl, const IOBuf& req, IOBuf* resp,
   };
   StreamId sid = 0;
   if (StreamAccept(&sid, cntl, sopts) != 0) {
+    release_slot(tenant);
     cntl->SetFailed(EINVAL, "stream accept failed");
     done();
     return;
   }
   r->sid = sid;
   // Never let one request's token output exceed the client's advertised
-  // credit: the decode loop writes without parking.
+  // credit: the decode loop writes without parking.  A window that can't
+  // even fit ONE TokenRecord is rejected outright — leaving max_new
+  // unclamped would park the shared decode fiber on the first write,
+  // stalling every tenant's requests (and the deadline reaper with them).
   const uint64_t credit = stream_send_window(sid);
-  if (credit > 0) {
-    const uint64_t fit = credit / sizeof(TokenRecord);
-    if (fit > 0 && fit < max_new) {
-      max_new = static_cast<uint32_t>(fit);
-    }
+  if (credit < sizeof(TokenRecord)) {
+    StreamClose(sid);
+    // Don't advertise the destroyed stream in the failed response — the
+    // client's not-accepted path closes its offered end cleanly.
+    cntl->call().accepted_stream = 0;
+    release_slot(tenant);
+    cntl->SetFailed(EINVAL,
+                    "stream window smaller than one TokenRecord");
+    done();
+    return;
+  }
+  const uint64_t fit = credit / sizeof(TokenRecord);
+  if (fit < max_new) {
+    max_new = static_cast<uint32_t>(fit);
   }
   r->max_new = max_new > 0 ? max_new : 1;
 
@@ -449,14 +497,6 @@ void InferScheduler::submit(Controller* cntl, const IOBuf& req, IOBuf* resp,
     std::lock_guard<std::mutex> g(mu_);
     waiting_.push_back(r);
     waiting_n_.store(waiting_.size(), std::memory_order_release);
-    tenant_live_[r->tenant] += 1;
-    const int64_t live =
-        streams_live_.fetch_add(1, std::memory_order_acq_rel) + 1;
-    int64_t peak = streams_peak_.load(std::memory_order_relaxed);
-    while (live > peak &&
-           !streams_peak_.compare_exchange_weak(peak, live,
-                                                std::memory_order_acq_rel)) {
-    }
   }
   wake();
 
@@ -502,16 +542,27 @@ void InferScheduler::begin_prefill(const ReqPtr& r, int64_t now) {
     struct FetchArg {
       InferScheduler* self;
       ReqPtr req;
+      std::shared_ptr<FetchDrain> drain;
     };
-    auto* arg = new FetchArg{this, r};
+    fetch_drain_->inflight.fetch_add(1, std::memory_order_acq_rel);
+    auto* arg = new FetchArg{this, r, fetch_drain_};
     fiber_t fid;
     if (fiber_start(
             &fid,
             [](void* p) {
               std::unique_ptr<FetchArg> a(static_cast<FetchArg*>(p));
               a->self->fetch_blocks(a->req);
+              // Retire AFTER the last scheduler touch: once inflight
+              // hits zero stop() may free the scheduler, so only the
+              // shared drain block is safe past this point.
+              std::shared_ptr<FetchDrain> drain = std::move(a->drain);
+              a.reset();
+              drain->inflight.fetch_sub(1, std::memory_order_acq_rel);
+              drain->ev.value.fetch_add(1, std::memory_order_release);
+              drain->ev.wake_all();
             },
             arg) != 0) {
+      fetch_drain_->inflight.fetch_sub(1, std::memory_order_acq_rel);
       delete arg;
       // No fiber: fall back to recompute for every matched block.
       r->fallback_tokens.store(r->cached_tokens, std::memory_order_release);
@@ -709,9 +760,9 @@ bool InferScheduler::step_request(const ReqPtr& r, int64_t now) {
   return true;
 }
 
-void InferScheduler::drop_live(const ReqPtr& r) {
+void InferScheduler::release_slot(const std::string& tenant) {
   std::lock_guard<std::mutex> g(mu_);
-  auto it = tenant_live_.find(r->tenant);
+  auto it = tenant_live_.find(tenant);
   if (it != tenant_live_.end() && --it->second <= 0) {
     tenant_live_.erase(it);
   }
@@ -743,7 +794,7 @@ void InferScheduler::finish(const ReqPtr& r, bool cancelled) {
             r->emitted);
   }
   StreamClose(r->sid);
-  drop_live(r);
+  release_slot(r->tenant);
 }
 
 void InferScheduler::loop() {

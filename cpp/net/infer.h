@@ -103,8 +103,9 @@ struct InferOptions {
 // for registration-time use; the loop owns no server state).
 InferScheduler* infer_attach(Server* s, const InferOptions& opts);
 // Stops the loop, cancels every queued/active request (closing their
-// streams with kTokenCancelled), joins the loop fiber and frees the
-// scheduler.  Idempotent per pointer is NOT provided — call once.
+// streams with kTokenCancelled), joins the loop fiber, waits for
+// in-flight prefix-fetch fibers to retire, and frees the scheduler.
+// Idempotent per pointer is NOT provided — call once.
 void infer_stop(InferScheduler* sched);
 
 // Introspection (capi / tests / the /infer builtin).

@@ -551,7 +551,23 @@ void stream_on_connection_failed(uint64_t socket_id) {
   }
   for (StreamId sid : victims) {
     StreamMeta* m = stream_of(sid);
-    if (m != nullptr) {
+    if (m == nullptr) {
+      continue;
+    }
+    // Route the close through the consume queue under the meta lock
+    // (the kStreamFrame close path): a concurrent StreamClose + slot
+    // reuse between the stream_of snapshot and an unguarded mark_closed
+    // would close the NEXT incarnation at birth.  The version bump and
+    // queue stop happen under this same lock, so a stale sid can no
+    // longer reach the new stream; a sentinel that lands anyway drains
+    // against the old incarnation before new_stream resets state.
+    m->lock();
+    const bool ver_ok = m->version.load(std::memory_order_relaxed) ==
+                        static_cast<uint32_t>(sid >> 32);
+    const bool queued = ver_ok && m->consume_q != nullptr &&
+                        m->consume_q->execute(nullptr) == 0;
+    m->unlock();
+    if (ver_ok && !queued) {
       mark_closed(m);
     }
   }

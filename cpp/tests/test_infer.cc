@@ -96,7 +96,8 @@ struct TokenClient {
 
 TokenClient submit(Channel* ch, const std::vector<uint64_t>& prompt,
                    uint32_t max_new, int64_t timeout_ms = 30000,
-                   const std::string& tenant = "", uint32_t flags = 0) {
+                   const std::string& tenant = "", uint32_t flags = 0,
+                   int64_t window_bytes = 0) {
   TokenClient c;
   auto st = c.st;
   Controller cntl;
@@ -107,6 +108,9 @@ TokenClient submit(Channel* ch, const std::vector<uint64_t>& prompt,
     cntl.set_qos(tenant, 0);
   }
   StreamOptions opts;
+  if (window_bytes > 0) {
+    opts.window_bytes = window_bytes;
+  }
   opts.on_message = [st](StreamId, IOBuf&& chunk) {
     TokenRecord rec;
     if (chunk.size() >= sizeof(rec)) {
@@ -449,6 +453,89 @@ TEST_CASE(infer_overload_sheds_typed_per_tenant) {
     StreamClose(c.sid);
   }
   EXPECT_EQ(wait_live_zero(s.sched, 10000), 0);
+}
+
+// A client advertising a stream window that cannot fit even ONE
+// TokenRecord must be rejected at submit: admitting it unclamped would
+// park the shared decode fiber on the first StreamWrite, stalling every
+// tenant's requests (and the deadline reaper that runs in the same
+// fiber).
+TEST_CASE(infer_tiny_window_rejected_not_parked) {
+  reset_infer_flags();
+  Serving s;
+  make_serving(&s);
+  Channel ch;
+  EXPECT_EQ(ch.Init(addr_of(s)), 0);
+
+  TokenClient tiny = submit(&ch, make_prompt(50, 4), 8, 30000, "", 0,
+                            /*window_bytes=*/8);
+  EXPECT(!tiny.ok);
+  EXPECT_EQ(tiny.error_code, EINVAL);
+  // The admission slot reserved for it was released, not leaked.
+  EXPECT_EQ(wait_live_zero(s.sched), 0);
+
+  // The decode loop never parked: a sane request still completes.
+  TokenClient c = submit(&ch, make_prompt(51, 4), 4);
+  EXPECT(c.ok);
+  EXPECT(c.wait_closed());
+  EXPECT_EQ(c.records().back().flags, kTokenEos);
+  EXPECT_EQ(wait_live_zero(s.sched), 0);
+}
+
+// Shutdown with a prefix fetch mid-RPC: infer_stop must cancel the
+// request, WAIT for the detached fetch fiber to retire, and only then
+// free the fetch channel and scheduler — the fiber holds a raw
+// scheduler pointer, so ASan/TSan catch any early free here.
+TEST_CASE(infer_stop_drains_inflight_prefix_fetch) {
+  reset_infer_flags();
+  Server* kvsrv = new Server();
+  EXPECT_EQ(kv_attach_store(kvsrv), 0);
+  EXPECT_EQ(kvsrv->Start(0), 0);
+  EXPECT_EQ(kvsrv->SetFaults("svr_delay=1:100"), 0);
+  const std::string kv_addr =
+      "127.0.0.1:" + std::to_string(kvsrv->port());
+
+  const auto prompt = make_prompt(52, 32);
+  static KvRegistry registry;
+  Key128 keys[8];
+  const size_t nkeys = kv_prefix_chain(prompt.data(), prompt.size(), 8,
+                                       keys, 8);
+  EXPECT_EQ(nkeys, 4u);
+  std::vector<uint8_t> block(8 * 64, 0xcd);
+  for (size_t d = 0; d < nkeys; ++d) {
+    KvPrefixMeta meta;
+    EXPECT_EQ(kv_store().publish_prefix(keys[d], static_cast<uint32_t>(d),
+                                        block.data(), block.size(),
+                                        prompt.data() + d * 8, 8, 60000,
+                                        &meta),
+              0);
+    snprintf(meta.node, sizeof(meta.node), "kvnode");
+    uint64_t gen = 0;
+    EXPECT_EQ(registry.put_prefix(meta, 60000, &gen), 0);
+  }
+
+  InferOptions opts;
+  opts.registry = &registry;
+  opts.kv_fetch_addr = kv_addr;
+  auto* srv = new Server();
+  InferScheduler* sched = infer_attach(srv, opts);
+  EXPECT(sched != nullptr);
+  EXPECT_EQ(srv->Start(0), 0);
+  Channel ch;
+  EXPECT_EQ(ch.Init("127.0.0.1:" + std::to_string(srv->port())), 0);
+
+  TokenClient c = submit(&ch, prompt, 4);
+  EXPECT(c.ok);
+  EXPECT_EQ(c.reply.cached_tokens, 32u);
+  // 4 blocks x 100ms delay each: stop ~120ms in, fetch mid-chain.
+  usleep(120 * 1000);
+  infer_stop(sched);
+  delete srv;
+  EXPECT(c.wait_closed());
+
+  registry.clear();
+  kv_store().clear();
+  delete kvsrv;
 }
 
 TEST_CASE(infer_flag_bounds_validated) {

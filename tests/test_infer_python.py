@@ -30,6 +30,7 @@ from brpc_tpu.rpc import (
     InferClient,
     OverloadedError,
     Server,
+    StreamChunkTooLargeError,
     StreamClosedError,
     infer,
     kv,
@@ -102,6 +103,37 @@ def test_stream_echo_roundtrip():
         assert st.read(timeout_ms=3000) == b"last"
         with pytest.raises(StreamClosedError):
             st.read(timeout_ms=3000)
+        st.destroy()
+        peer.destroy()
+    finally:
+        ch.close()
+        srv.close()
+
+
+def test_stream_read_never_truncates():
+    """A chunk larger than the read buffer raises typed — nothing is
+    dropped or truncated (silent truncation would desynchronize framed
+    readers like the 16-byte TokenRecord stream)."""
+    srv = Server()
+    accepted = []
+
+    def handler(call, req):
+        accepted.append(call.accept_stream())
+        call.respond(b"ok")
+
+    srv.register("Echo.Stream", handler)
+    port = srv.start()
+    ch = Channel(f"127.0.0.1:{port}", timeout_ms=5000)
+    try:
+        st, _ = open_stream(ch, "Echo.Stream")
+        assert _wait(lambda: len(accepted) == 1)
+        peer = accepted[0]
+        peer.write(b"x" * 32)
+        with pytest.raises(StreamChunkTooLargeError) as ei:
+            st.read(max_bytes=16, timeout_ms=3000)
+        assert ei.value.needed == 32
+        # The chunk stayed queued: a fitting retry gets ALL of it.
+        assert st.read(max_bytes=32, timeout_ms=3000) == b"x" * 32
         st.destroy()
         peer.destroy()
     finally:
